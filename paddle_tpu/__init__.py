@@ -1,0 +1,57 @@
+"""paddle_tpu: a TPU-native framework with the Fluid capability surface.
+
+Usage mirrors the reference (``import paddle.fluid as fluid`` becomes
+``import paddle_tpu as fluid``): build a Program with layers, run it with an
+Executor on CPUPlace/TPUPlace.  Execution lowers whole blocks to XLA via JAX.
+"""
+
+from . import framework
+from .framework import (
+    CPUPlace,
+    CUDAPinnedPlace,
+    CUDAPlace,
+    Program,
+    TPUPlace,
+    Variable,
+    cpu_places,
+    cuda_places,
+    default_main_program,
+    default_startup_program,
+    in_dygraph_mode,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+    name_scope,
+    program_guard,
+    tpu_places,
+    core,
+)
+from .core.executor import Executor, global_scope, scope_guard
+from .core.scope import Scope
+from .compiler import BuildStrategy, CompiledProgram, ExecutionStrategy
+from .param_attr import ParamAttr, WeightNormParamAttr
+from .backward import append_backward, gradients
+from . import layers
+from . import initializer
+from . import optimizer
+from . import regularizer
+from . import clip
+from . import backward
+from . import unique_name_compat as unique_name  # noqa: F401
+from .data_feeder import DataFeeder
+from . import io
+from .io import save_inference_model, load_inference_model
+
+__version__ = "0.1.0"
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """fluid.data — batch dim must be given explicitly (often -1)."""
+    return layers.data(
+        name=name, shape=shape, dtype=dtype, lod_level=lod_level,
+        append_batch_size=False,
+    )
+
+
+class DataFeedDesc:  # placeholder until dataset/trainer path lands
+    def __init__(self, proto_file=None):
+        self.proto_file = proto_file

@@ -1,0 +1,276 @@
+"""Autodiff by program rewriting.
+
+TPU-native port of ``python/paddle/fluid/backward.py`` (append_backward at
+:933): walk the block's ops in reverse, emit each op's grad ops (from the
+registry's grad makers — hand-written or the vjp-based default), accumulate
+duplicate gradients with `sum` ops (_addup_repetitive_outputs_ analog), and
+annotate ops with op_role/op_role_var so optimizers and the distributed
+transpilers can find {param, grad} pairs.
+"""
+
+from .core.registry import get_op_def
+from .framework import (
+    GRAD_SUFFIX,
+    OP_ROLE_KEY,
+    OP_ROLE_VAR_KEY,
+    OpRole,
+    Parameter,
+    _grad_var_name,
+)
+
+__all__ = ["append_backward", "gradients", "calc_gradient"]
+
+
+def _collect_no_grad(block, no_grad_set):
+    ng = set(no_grad_set or ())
+    for name, var in block.vars.items():
+        if var.stop_gradient:
+            ng.add(name)
+    return ng
+
+
+def _relevant_ops(block, loss_name, no_grad_set, stop_at=None):
+    """Reverse-reachability: ops whose outputs (transitively) feed the loss.
+    Returns (op_index_list_in_reverse, grad_flow_names)."""
+    grad_flow = {loss_name}
+    relevant = []
+    for idx in range(len(block.ops) - 1, -1, -1):
+        op = block.ops[idx]
+        if op.attr(OP_ROLE_KEY) == OpRole.Optimize:
+            continue
+        outs = [n for n in op.output_arg_names if n]
+        if not any(n in grad_flow for n in outs):
+            continue
+        opdef = get_op_def(op.type)
+        if opdef.grad_maker is None:
+            continue
+        relevant.append(idx)
+        for slot in opdef.input_slots:
+            if slot in opdef.no_grad_inputs:
+                continue
+            for n in op.input(slot):
+                if n and n not in no_grad_set:
+                    if stop_at is not None and n in stop_at:
+                        continue
+                    grad_flow.add(n)
+    return relevant, grad_flow
+
+
+def _dedup_grad_ops(grad_op_descs):
+    """Rename duplicate grad outputs and insert sum ops
+    (_addup_repetitive_outputs_ analog, reference backward.py:167)."""
+    producers = {}
+    for gop in grad_op_descs:
+        for slot, names in gop.outputs.items():
+            for n in names:
+                if n:
+                    producers[n] = producers.get(n, 0) + 1
+    multi = {n for n, c in producers.items() if c > 1}
+    if not multi:
+        return grad_op_descs
+
+    result = []
+    seen = {n: 0 for n in multi}
+    renames = {n: [] for n in multi}
+    remaining = {n: producers[n] for n in multi}
+    from .core.registry import GradOpDesc
+
+    for gop in grad_op_descs:
+        finished = []
+        for slot, names in list(gop.outputs.items()):
+            new_names = []
+            for n in names:
+                if n in multi:
+                    i = seen[n]
+                    seen[n] += 1
+                    rn = "%s@RENAME@%d" % (n, i)
+                    renames[n].append(rn)
+                    remaining[n] -= 1
+                    if remaining[n] == 0:
+                        finished.append(n)
+                    new_names.append(rn)
+                else:
+                    new_names.append(n)
+            gop.outputs[slot] = new_names
+        result.append(gop)
+        for n in finished:
+            result.append(
+                GradOpDesc(
+                    "sum",
+                    {"X": list(renames[n])},
+                    {"Out": [n]},
+                    {OP_ROLE_KEY: OpRole.Backward},
+                )
+            )
+    return result
+
+
+def _append_grad_op(block, gop, grad_to_var):
+    """Materialize a GradOpDesc: create missing grad vars then append."""
+    for slot, names in gop.outputs.items():
+        for n in names:
+            if not n or block.has_var_recursive(n):
+                continue
+            base = n.split("@RENAME@")[0]
+            src = None
+            if base.endswith(GRAD_SUFFIX):
+                src = block._find_var_recursive(base[: -len(GRAD_SUFFIX)])
+            if src is not None:
+                block.create_var(name=n, shape=src.shape, dtype=src.dtype)
+            else:
+                block.create_var(name=n)
+    attrs = dict(gop.attrs)
+    attrs[OP_ROLE_KEY] = OpRole.Backward
+    return block.append_op(
+        type=gop.type, inputs=gop.inputs, outputs=gop.outputs, attrs=attrs
+    )
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """Append grad ops for `loss` to its program; return [(param, grad)].
+
+    Reference: backward.py:933.  `checkpoints` triggers recompute-friendly
+    ordering (the vjp-based grads already recompute forward locally; XLA CSE
+    or jax.checkpoint policies control materialization).
+    """
+    program = loss.block.program
+    block = program.global_block()
+    no_grad = _collect_no_grad(block, no_grad_set)
+
+    with program._backward_role_guard():
+        # d(loss)/d(loss) = 1
+        loss_grad_name = _grad_var_name(loss.name)
+        block.create_var(name=loss_grad_name, shape=loss.shape or (1,),
+                         dtype=loss.dtype)
+        from .ops.common import dtype_enum
+
+        block.append_op(
+            type="fill_constant",
+            outputs={"Out": [loss_grad_name]},
+            attrs={
+                "shape": list(loss.shape or (1,)),
+                "value": 1.0,
+                "dtype": dtype_enum(loss.dtype or "float32"),
+                OP_ROLE_KEY: OpRole.Backward | OpRole.Loss,
+            },
+        )
+
+        relevant, grad_flow = _relevant_ops(block, loss.name, no_grad)
+
+        grad_op_descs = []
+        for idx in relevant:
+            op = block.ops[idx]
+            opdef = get_op_def(op.type)
+            ng = no_grad | {n for n in op.input_arg_names
+                            if n and n not in grad_flow}
+            gops = opdef.make_grad_ops(op, ng)
+            grad_op_descs.extend(gops)
+
+        grad_op_descs = _dedup_grad_ops(grad_op_descs)
+
+        grad_to_var = {}
+        for gop in grad_op_descs:
+            _append_grad_op(block, gop, grad_to_var)
+
+    # collect (param, grad) pairs
+    if parameter_list is not None:
+        params = []
+        for p in parameter_list:
+            params.append(block.var(p) if isinstance(p, str) else p)
+    else:
+        params = [p for p in block.all_parameters() if p.trainable]
+
+    params_and_grads = []
+    for p in params:
+        gname = _grad_var_name(p.name)
+        if not block.has_var_recursive(gname):
+            continue
+        g = block.var(gname)
+        if g.shape is None or g.shape != p.shape:
+            g.shape = p.shape
+        if g.dtype is None:
+            g.dtype = p.dtype
+        params_and_grads.append((p, g))
+
+    # annotate op_role_var on the grad-producing ops (collective transpiler
+    # keys off this to insert c_allreduce between backward and optimize,
+    # reference transpiler/collective.py:208)
+    grad_names = {g.name: p.name for p, g in params_and_grads}
+    for op in block.ops:
+        if op.attr(OP_ROLE_KEY) is None or not (
+            int(op.attr(OP_ROLE_KEY)) & OpRole.Backward
+        ):
+            continue
+        rv = list(op.attrs.get(OP_ROLE_VAR_KEY, []))
+        for n in op.output_arg_names:
+            if n in grad_names:
+                rv.extend([grad_names[n], n])
+        if rv:
+            op.attrs[OP_ROLE_VAR_KEY] = rv
+
+    return params_and_grads
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """d(targets)/d(inputs) as new grad vars (reference backward.py:1199)."""
+    if not isinstance(targets, (list, tuple)):
+        targets = [targets]
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    program = targets[0].block.program
+    block = program.global_block()
+    no_grad = _collect_no_grad(block, no_grad_set)
+    input_names = {v.name for v in inputs}
+
+    with program._backward_role_guard():
+        from .ops.common import dtype_enum
+
+        grad_op_descs = []
+        for i, t in enumerate(targets):
+            tg_name = _grad_var_name(t.name)
+            if target_gradients is not None and target_gradients[i] is not None:
+                tg = target_gradients[i]
+                block.append_op(
+                    type="assign",
+                    inputs={"X": [tg.name]},
+                    outputs={"Out": [tg_name]},
+                )
+                block.create_var(name=tg_name, shape=t.shape, dtype=t.dtype)
+            else:
+                block.create_var(name=tg_name, shape=t.shape, dtype=t.dtype)
+                block.append_op(
+                    type="fill_constant",
+                    outputs={"Out": [tg_name]},
+                    attrs={
+                        "shape": list(t.shape or (1,)),
+                        "value": 1.0,
+                        "dtype": dtype_enum(t.dtype or "float32"),
+                    },
+                )
+
+        relevant_all = set()
+        flow_all = set()
+        for t in targets:
+            rel, flow = _relevant_ops(block, t.name, no_grad)
+            relevant_all |= set(rel)
+            flow_all |= flow
+        for idx in sorted(relevant_all, reverse=True):
+            op = block.ops[idx]
+            opdef = get_op_def(op.type)
+            ng = no_grad | {n for n in op.input_arg_names
+                            if n and n not in flow_all}
+            grad_op_descs.extend(opdef.make_grad_ops(op, ng))
+
+        grad_op_descs = _dedup_grad_ops(grad_op_descs)
+        for gop in grad_op_descs:
+            _append_grad_op(block, gop, {})
+
+    outs = []
+    for v in inputs:
+        gname = _grad_var_name(v.name)
+        outs.append(block.var(gname) if block.has_var_recursive(gname) else None)
+    return outs
+
+
+calc_gradient = gradients

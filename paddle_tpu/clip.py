@@ -1,0 +1,138 @@
+"""Gradient clipping (parity: python/paddle/fluid/clip.py)."""
+
+from .framework import default_main_program
+
+__all__ = [
+    "set_gradient_clip",
+    "ErrorClipByValue",
+    "GradientClipByValue",
+    "GradientClipByNorm",
+    "GradientClipByGlobalNorm",
+    "append_gradient_clip_ops",
+]
+
+_clip_attr = {"global": None}
+
+
+class BaseGradientClipAttr:
+    def _process(self, params_grads):
+        raise NotImplementedError
+
+
+class ErrorClipByValue:
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = min if min is not None else -max
+
+
+class GradientClipByValue(BaseGradientClipAttr):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def _process(self, params_grads):
+        from . import layers
+
+        out = []
+        program = default_main_program()
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            with program._optimized_guard([p, g]):
+                ng = layers.clip(g, self.min, self.max)
+            out.append((p, ng))
+        return out
+
+
+class GradientClipByNorm(BaseGradientClipAttr):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _process(self, params_grads):
+        from . import layers
+
+        out = []
+        program = default_main_program()
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            with program._optimized_guard([p, g]):
+                ng = layers.clip_by_norm(g, self.clip_norm)
+            out.append((p, ng))
+        return out
+
+
+class GradientClipByGlobalNorm(BaseGradientClipAttr):
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def _process(self, params_grads):
+        from . import layers
+
+        program = default_main_program()
+        block = program.global_block()
+        norms = []
+        with program._backward_role_guard():
+            for p, g in params_grads:
+                if g is None:
+                    continue
+                helper_out = block.create_var(
+                    name=g.name + "@sq_l2", shape=(1,), dtype=g.dtype
+                )
+                block.append_op(
+                    type="squared_l2_norm",
+                    inputs={"X": [g]},
+                    outputs={"Out": [helper_out]},
+                )
+                norms.append(helper_out)
+            if not norms:
+                return params_grads
+            total = block.create_var(
+                name="global_norm@" + self.group_name + "@var",
+                shape=(1,), dtype=norms[0].dtype
+            )
+            block.append_op(
+                type="sum", inputs={"X": norms}, outputs={"Out": [total]}
+            )
+            gnorm = layers.sqrt(total)
+            clip_var = layers.fill_constant((1,), gnorm.dtype, self.clip_norm)
+            scale = layers.elementwise_div(
+                clip_var,
+                layers.elementwise_max(clip_var, gnorm),
+            )
+            out = []
+            for p, g in params_grads:
+                if g is None:
+                    out.append((p, g))
+                    continue
+                ng = layers.elementwise_mul(g, scale)
+                out.append((p, ng))
+        return out
+
+
+def set_gradient_clip(clip, param_list=None, program=None):
+    _clip_attr["global"] = clip
+    if param_list is not None:
+        for p in param_list:
+            if hasattr(p, "gradient_clip_attr"):
+                p.gradient_clip_attr = clip
+
+
+def append_gradient_clip_ops(params_grads):
+    clip = _clip_attr.get("global")
+    # per-param attr wins
+    per_param = [getattr(p, "gradient_clip_attr", None) for p, _ in params_grads]
+    if clip is None and not any(per_param):
+        return params_grads
+    if clip is not None:
+        return clip._process(params_grads)
+    out = []
+    for (p, g), attr in zip(params_grads, per_param):
+        if attr is None or g is None:
+            out.append((p, g))
+        else:
+            out.extend(attr._process([(p, g)]))
+    return out

@@ -1,0 +1,92 @@
+"""CompiledProgram: multi-device execution via jax.sharding.Mesh.
+
+TPU-native analog of ``python/paddle/fluid/compiler.py:65`` +
+``paddle/fluid/framework/parallel_executor.cc``.  Instead of replicating the
+graph into per-device SSA op handles with NCCL all-reduce handles, data
+parallelism is expressed as SPMD sharding: the feed batch is sharded over the
+mesh 'data' axis, parameters are replicated (or sharded per their annotation
+for tensor parallelism), and XLA's SPMD partitioner inserts the ICI
+collectives (the all-reduce the reference builds by hand in
+details/all_reduce_op_handle.cc falls out of the partitioner).
+"""
+
+import jax
+
+__all__ = ["CompiledProgram", "BuildStrategy", "ExecutionStrategy"]
+
+
+class BuildStrategy:
+    """Knobs kept for API parity (details/build_strategy.h:58-139).  Most are
+    no-ops under XLA (fusion/memory-reuse are the compiler's job); the ones
+    that matter map to sharding/compile choices."""
+
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    class GradientScaleStrategy:
+        CoeffNumDevice = 0
+        One = 1
+        Customized = 2
+
+    def __init__(self):
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = BuildStrategy.GradientScaleStrategy.CoeffNumDevice
+        self.fuse_all_reduce_ops = True
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_all_optimizer_ops = False
+        self.enable_inplace = True
+        self.memory_optimize = True
+        self.nccl_comm_num = 1
+        self.use_hierarchical_allreduce = False
+        self.hierarchical_allreduce_inter_nranks = 0
+        self.enable_sequential_execution = False
+        self.remove_unnecessary_lock = True
+        self.sync_batch_norm = False
+        self.num_trainers = 1
+        self.trainer_id = 0
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 0
+        self.num_iteration_per_drop_scope = 1
+        self.use_experimental_executor = False
+
+
+class CompiledProgram:
+    def __init__(self, program_or_graph, build_strategy=None):
+        self._program = program_or_graph
+        self._build_strategy = build_strategy or BuildStrategy()
+        self._is_data_parallel = False
+        self._data_axis = None
+        self._places = None
+        self._mesh_cached = None
+        self._loss_name = None
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, share_vars_from=None,
+                           places=None):
+        self._is_data_parallel = True
+        self._loss_name = loss_name
+        if build_strategy is not None:
+            self._build_strategy = build_strategy
+        self._places = places
+        self._data_axis = "data"
+        return self
+
+    def with_inference_optimize(self, config):
+        return self
+
+    def _mesh(self):
+        if not self._is_data_parallel:
+            return None
+        if self._mesh_cached is None:
+            devices = jax.devices()
+            if self._places is not None:
+                devices = devices[: len(self._places)] or devices
+            from jax.sharding import Mesh
+            import numpy as np
+
+            self._mesh_cached = Mesh(np.array(devices), ("data",))
+        return self._mesh_cached

@@ -1,0 +1,235 @@
+"""Executor: runs Programs by compiling whole blocks to XLA.
+
+TPU-native analog of ``paddle/fluid/framework/executor.cc:94`` +
+``python/paddle/fluid/executor.py:423``.  Instead of interpreting ops one by
+one, `run()` builds (and caches) a single jitted function per
+(program-version, feed-signature, fetch-list) key: parameters stream in from
+the Scope, get donated when the block overwrites them (optimizer update), and
+the updated values are stored back.  Data-parallel / sharded execution reuses
+the same path with a `jax.sharding.Mesh` (see paddle_tpu.compiler).
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import (
+    CPUPlace,
+    Program,
+    Variable,
+    default_main_program,
+    dtype_to_np,
+)
+from .lowering import BlockPlan, build_block_fn
+from .scope import Scope
+
+__all__ = ["Executor", "global_scope", "scope_guard"]
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    global _global_scope
+    old = _global_scope
+    _global_scope = scope
+    try:
+        yield
+    finally:
+        _global_scope = old
+
+
+def _fetch_name(f):
+    if isinstance(f, Variable):
+        return f.name
+    if isinstance(f, str):
+        return f
+    raise TypeError("bad fetch target %r" % (f,))
+
+
+def as_numpy(t):
+    return np.asarray(t)
+
+
+class _CompiledPlan:
+    __slots__ = ("plan", "jfn", "in_shardings", "feed_dim0")
+
+    def __init__(self, plan, jfn):
+        self.plan = plan
+        self.jfn = jfn
+
+
+class Executor:
+    """Per-place executor with a program cache."""
+
+    def __init__(self, place=None):
+        self.place = place if place is not None else CPUPlace()
+        self._cache = {}
+
+    def close(self):
+        self._cache.clear()
+
+    # -- main entry ----------------------------------------------------------
+    def run(
+        self,
+        program=None,
+        feed=None,
+        fetch_list=None,
+        feed_var_name="feed",
+        fetch_var_name="fetch",
+        scope=None,
+        return_numpy=True,
+        use_program_cache=True,
+    ):
+        from ..compiler import CompiledProgram
+
+        scope = scope if scope is not None else global_scope()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        fetch_names = [_fetch_name(f) for f in fetch_list]
+
+        mesh = None
+        data_axis = None
+        if isinstance(program, CompiledProgram):
+            compiled = program
+            program = compiled._program
+            mesh = compiled._mesh()
+            data_axis = compiled._data_axis
+        if program is None:
+            program = default_main_program()
+
+        feed_arrays = {}
+        block = program.global_block()
+        for name, value in feed.items():
+            arr = np.asarray(value) if not isinstance(value, jax.Array) else value
+            v = block._find_var_recursive(name)
+            if v is not None and v.dtype is not None and arr.dtype != dtype_to_np(v.dtype):
+                arr = np.asarray(arr, dtype=dtype_to_np(v.dtype))
+            feed_arrays[name] = arr
+
+        key = (
+            id(program),
+            program.version,
+            tuple(sorted((n, a.shape, str(a.dtype)) for n, a in feed_arrays.items())),
+            tuple(fetch_names),
+            id(mesh) if mesh is not None else None,
+        )
+        entry = self._cache.get(key) if use_program_cache else None
+        if entry is None:
+            entry = self._compile(program, list(feed_arrays), fetch_names, mesh, data_axis)
+            if use_program_cache:
+                self._cache[key] = entry
+        plan = entry.plan
+
+        # gather params from scope
+        params_ro, params_rw = {}, {}
+        for n in plan.ro_names:
+            params_ro[n] = self._scope_value(scope, n, block)
+        for n in plan.rw_names:
+            params_rw[n] = self._scope_value(scope, n, block)
+
+        # deterministic functional PRNG: (program seed, per-scope step counter)
+        seed = program.random_seed or 0
+        rng = jax.random.fold_in(jax.random.key(seed), scope._rng_counter)
+        scope._rng_counter += 1
+
+        if mesh is not None:
+            feed_arrays = self._shard_feeds(feed_arrays, mesh, data_axis)
+
+        with jax.default_device(self._jax_device(mesh)):
+            fetches, updated = entry.jfn(feed_arrays, params_ro, params_rw, rng)
+
+        for n, val in updated.items():
+            scope.var(n).set(val)
+
+        if return_numpy:
+            return [as_numpy(f) for f in fetches]
+        return list(fetches)
+
+    # -- internals -----------------------------------------------------------
+    def _jax_device(self, mesh):
+        if mesh is not None:
+            return None
+        try:
+            return self.place.jax_device()
+        except Exception:
+            return None
+
+    def _scope_value(self, scope, name, block):
+        var = scope.find_var(name)
+        if var is None or not var.get_tensor()._is_initialized():
+            raise RuntimeError(
+                "variable %r is not initialized in scope — run the startup "
+                "program first (fluid.Executor.run(fluid.default_startup_program()))"
+                % name
+            )
+        val = var.get_tensor().get()
+        v = block._find_var_recursive(name)
+        if (
+            v is not None
+            and v.dtype is not None
+            and not isinstance(val, jax.Array)
+        ):
+            val = np.asarray(val, dtype=dtype_to_np(v.dtype))
+        return val
+
+    def _compile(self, program, feed_names, fetch_names, mesh, data_axis):
+        block = program.global_block()
+        plan = BlockPlan(block, feed_names, fetch_names)
+        fn = build_block_fn(plan, mesh=mesh)
+        if mesh is None:
+            jfn = jax.jit(fn, donate_argnums=(2,))
+        else:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            replicated = NamedSharding(mesh, P())
+            out_shardings = ([replicated] * len(fetch_names),
+                             {n: self._param_sharding(mesh, block, n)
+                              for n in plan.persist_written})
+            jfn = jax.jit(fn, donate_argnums=(2,), out_shardings=out_shardings)
+        return _CompiledPlan(plan, jfn)
+
+    def _param_sharding(self, mesh, block, name):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        v = block._find_var_recursive(name)
+        if v is not None and v.sharding:
+            return NamedSharding(mesh, P(*v.sharding))
+        return NamedSharding(mesh, P())
+
+    def _shard_feeds(self, feed_arrays, mesh, data_axis):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        out = {}
+        for n, a in feed_arrays.items():
+            if a.ndim >= 1 and data_axis and a.shape[0] % mesh.shape[data_axis] == 0:
+                spec = P(data_axis, *([None] * (a.ndim - 1)))
+            else:
+                spec = P()
+            out[n] = jax.device_put(a, NamedSharding(mesh, spec))
+        return out
+
+    # -- dataset/trainer entry points (C++ trainer path analog) --------------
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        from ..trainer import train_from_dataset
+
+        return train_from_dataset(self, program, dataset, scope, thread,
+                                  fetch_list, fetch_info, print_period)
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        from ..trainer import infer_from_dataset
+
+        return infer_from_dataset(self, program, dataset, scope, thread,
+                                  fetch_list, fetch_info, print_period)

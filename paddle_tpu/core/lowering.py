@@ -1,0 +1,199 @@
+"""Block capture: lower a whole Block's op list into ONE pure JAX function.
+
+This replaces the reference's op-at-a-time interpreter
+(``paddle/fluid/framework/executor.cc:448`` — `for op in ops: op->Run`) with
+whole-block staging: every op's registered lowering is traced into a single
+XLA computation which `jax.jit` compiles once per (program, shapes) key.  This
+is the TPU-idiomatic execution model — XLA fuses across op boundaries, plans
+HBM, and overlaps collectives; per-op dispatch only exists in dygraph mode.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import get_op_def, _lower_attrs
+
+__all__ = ["LowerCtx", "BlockPlan", "analyze_block", "build_block_fn"]
+
+
+class LowerCtx:
+    """Per-op context handed to lowerings.
+
+    Carries the PRNG key (functional randomness — TPU-native replacement for
+    the reference's per-device curand generators), the op desc being lowered,
+    and mesh/axis info when lowering inside a shard_map (manual collectives).
+    """
+
+    def __init__(self, rng_key=None, op=None, block=None, mesh=None,
+                 axis_names=(), mode="traced", runner=None):
+        self._rng_key = rng_key
+        self._rng_n = 0
+        self.op = op
+        self.block = block
+        self.mesh = mesh
+        self.axis_names = tuple(axis_names)
+        self.mode = mode  # "traced" | "abstract" | "eager"
+        self.runner = runner  # BlockRunner for ops with sub-blocks
+
+    def rng(self):
+        if self._rng_key is None:
+            if self.mode == "abstract":
+                return jax.random.key(0)
+            raise RuntimeError(
+                "op %s requested randomness but no PRNG key is available"
+                % (self.op.type if self.op else "?")
+            )
+        k = jax.random.fold_in(self._rng_key, self._rng_n)
+        self._rng_n += 1
+        return k
+
+    @classmethod
+    def abstract(cls, n_rng=0):
+        return cls(mode="abstract")
+
+
+def _iter_runtime_ops(block):
+    for op in block.ops:
+        if op.type in ("feed", "fetch"):
+            continue
+        yield op
+
+
+def analyze_block(block, feed_names):
+    """Liveness analysis: which names must come from the scope (external),
+    and which persistables are (re)written and must be stored back."""
+    feed = set(feed_names)
+    written = set()
+    external = []
+    external_set = set()
+    for op in _iter_runtime_ops(block):
+        for name in op.input_arg_names:
+            if not name:
+                continue
+            if name in feed or name in written or name in external_set:
+                continue
+            if name.endswith("@GRAD") or "@GRAD@" in name:
+                # grad var not yet produced: implicit zeros (handled by the
+                # grad lowering), never an external scope read
+                continue
+            external.append(name)
+            external_set.add(name)
+        for name in op.output_arg_names:
+            if name:
+                written.add(name)
+    persist_written = []
+    for op in _iter_runtime_ops(block):
+        for name in op.output_arg_names:
+            if not name or name in feed:
+                continue
+            v = block._find_var_recursive(name)
+            if v is not None and v.persistable and name not in persist_written:
+                persist_written.append(name)
+    return external, written, persist_written
+
+
+class BlockPlan:
+    """Compiled execution plan for one block + feed/fetch signature."""
+
+    def __init__(self, block, feed_names, fetch_names):
+        self.block = block
+        self.feed_names = list(feed_names)
+        self.fetch_names = list(fetch_names)
+        ext, written, persist_written = analyze_block(block, feed_names)
+        self.external = ext
+        self.persist_written = persist_written
+        # external names that get overwritten -> donatable (read-write)
+        self.rw_names = [n for n in ext if n in set(persist_written)]
+        rw = set(self.rw_names)
+        self.ro_names = [n for n in ext if n not in rw]
+
+
+def _gather_slot(opdef, op, slot, env):
+    names = op.input(slot)
+    duplicable = slot in opdef.duplicable_inputs
+    optional = (
+        slot in opdef.optional_inputs
+        or slot.startswith("GRAD@")
+        or slot.startswith("Out@")
+    )
+    vals = []
+    for n in names:
+        if not n:
+            vals.append(None)
+            continue
+        if n in env:
+            vals.append(env[n])
+        elif optional or n.endswith("@GRAD") or "@GRAD@" in n:
+            vals.append(None)
+        else:
+            raise KeyError(
+                "op %s input %s=%r is not initialized (not fed, not in scope, "
+                "not produced by a prior op)" % (op.type, slot, n)
+            )
+    if duplicable:
+        return vals
+    if not vals:
+        return None
+    return vals[0]
+
+
+def _scatter_slot(opdef, op, slot, value, env):
+    names = op.output(slot)
+    if not names:
+        return
+    duplicable = slot in opdef.duplicable_outputs
+    if duplicable:
+        items = list(value) if value is not None else [None] * len(names)
+    else:
+        items = [value]
+    for n, v in zip(names, items):
+        if n and v is not None:
+            env[n] = v
+
+
+def run_op(op, env, rng_key, mesh=None, axis_names=(), runner=None):
+    """Lower one op: gather inputs from env, call the lowering, scatter
+    outputs back into env."""
+    opdef = get_op_def(op.type)
+    args = [_gather_slot(opdef, op, s, env) for s in opdef.input_slots]
+    ctx = LowerCtx(rng_key=rng_key, op=op, block=op.block, mesh=mesh,
+                   axis_names=axis_names, runner=runner)
+    out = opdef.lower(ctx, *args, **_lower_attrs(op.attrs))
+    if len(opdef.output_slots) == 1 and not isinstance(out, (tuple, list)):
+        out = (out,)
+    elif isinstance(out, list):
+        out = tuple(out)
+    if len(opdef.output_slots) == 1 and len(out) != 1:
+        # single duplicable output returned as tuple of items
+        out = (list(out),)
+    for slot, val in zip(opdef.output_slots, out):
+        _scatter_slot(opdef, op, slot, val, env)
+
+
+def build_block_fn(plan, mesh=None, axis_names=()):
+    """Return fn(feeds, params_ro, params_rw, rng) -> (fetches, updated_rw).
+
+    feeds/params are dicts name->array. `rng` is a jax PRNG key; op i uses
+    fold_in(rng, i) so randomness is deterministic per (seed, step, op).
+    """
+    block = plan.block
+    fetch_names = plan.fetch_names
+    persist_written = plan.persist_written
+
+    def fn(feeds, params_ro, params_rw, rng):
+        env = {}
+        env.update(params_ro)
+        env.update(params_rw)
+        env.update(feeds)
+        for i, op in enumerate(_iter_runtime_ops(block)):
+            key = jax.random.fold_in(rng, i) if rng is not None else None
+            run_op(op, env, key, mesh=mesh, axis_names=axis_names)
+        fetches = []
+        for n in fetch_names:
+            if n not in env:
+                raise KeyError("fetch target %r was never produced" % n)
+            fetches.append(env[n])
+        updated = {n: env[n] for n in persist_written if n in env}
+        return fetches, updated
+
+    return fn

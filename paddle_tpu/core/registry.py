@@ -1,0 +1,430 @@
+"""Op registry: each op type has a lowering to JAX/XLA, shape inference, and a
+grad-op maker.
+
+TPU-native analog of the reference's ``REGISTER_OPERATOR`` /
+``OpInfoMap`` (paddle/fluid/framework/op_registry.h:68,199): instead of
+per-device kernel functors, an op registers a **lowering** — a pure function
+built from jax.numpy / lax that the executor traces into one XLA computation
+per block.  Gradients come either from a hand-written grad op (parity with the
+reference's grad-op-desc makers, grad_op_desc_maker.h) or from a default
+maker that differentiates the forward lowering with ``jax.vjp`` inside the
+same trace (XLA CSE merges the recomputed forward).
+"""
+
+import functools
+
+import numpy as np
+
+__all__ = [
+    "OpDef",
+    "register_op",
+    "get_op_def",
+    "has_op_def",
+    "all_op_types",
+    "GradOpDesc",
+]
+
+_OP_REGISTRY = {}
+
+
+class GradOpDesc:
+    """Description of one grad op to append (analog of OpDesc from a C++
+    grad-op maker)."""
+
+    def __init__(self, type, inputs, outputs, attrs=None):
+        self.type = type
+        self.inputs = inputs
+        self.outputs = outputs
+        self.attrs = dict(attrs or {})
+
+
+class OpDef:
+    """Registered metadata + behavior for one op type."""
+
+    def __init__(
+        self,
+        type,
+        inputs=(),
+        outputs=(),
+        attrs=None,
+        lower=None,
+        infer_shape=None,
+        grad_maker="auto",
+        no_grad_inputs=(),
+        optional_inputs=(),
+        duplicable_inputs=(),
+        duplicable_outputs=(),
+        stateful=False,
+        n_rng=0,
+    ):
+        self.type = type
+        self.input_slots = tuple(inputs)
+        self.output_slots = tuple(outputs)
+        self.default_attrs = dict(attrs or {})
+        self.lower = lower
+        self.infer_shape = infer_shape
+        # grad_maker: "auto" (vjp-based default), None (no gradient), or a
+        # callable op -> list[GradOpDesc]
+        self.grad_maker = grad_maker
+        self.no_grad_inputs = frozenset(no_grad_inputs)
+        self.optional_inputs = frozenset(optional_inputs)
+        self.duplicable_inputs = frozenset(duplicable_inputs)
+        self.duplicable_outputs = frozenset(duplicable_outputs)
+        self.stateful = stateful
+        self.n_rng = n_rng  # number of PRNG keys the lowering consumes
+
+    # -- validation ----------------------------------------------------------
+    def validate(self, op):
+        for slot in op.inputs:
+            if slot not in self.input_slots:
+                raise ValueError(
+                    "op %s has no input slot %r (has %s)"
+                    % (self.type, slot, self.input_slots)
+                )
+        for slot in op.outputs:
+            if slot not in self.output_slots:
+                raise ValueError(
+                    "op %s has no output slot %r (has %s)"
+                    % (self.type, slot, self.output_slots)
+                )
+        for k, v in self.default_attrs.items():
+            op.attrs.setdefault(k, v)
+
+    # -- shape inference -----------------------------------------------------
+    def run_infer_shape(self, op, block):
+        try:
+            if self.infer_shape is not None:
+                self.infer_shape(op, block)
+            elif self.lower is not None:
+                _default_infer_shape(self, op, block)
+        except NotImplementedError:
+            pass
+
+    # -- gradient ------------------------------------------------------------
+    def make_grad_ops(self, op, no_grad_set):
+        """Return list[GradOpDesc] for this forward op.
+
+        The default ("auto") maker emits one `<type>_grad` op taking the
+        forward inputs, forward outputs, and output grads, producing input
+        grads; its lowering replays the forward via jax.vjp.
+        """
+        if self.grad_maker is None:
+            return []
+        if callable(self.grad_maker):
+            return self.grad_maker(op, no_grad_set)
+        # auto
+        from ..framework import _grad_var_name
+
+        inputs = {}
+        for slot in self.input_slots:
+            if op.input(slot):
+                inputs[slot] = list(op.input(slot))
+        for slot in self.output_slots:
+            if op.output(slot):
+                inputs["Out@" + slot] = list(op.output(slot))
+                inputs["GRAD@" + slot] = [
+                    _grad_var_name(n) for n in op.output(slot)
+                ]
+        outputs = {}
+        block = op.block
+        for slot in self.input_slots:
+            if slot in self.no_grad_inputs:
+                continue
+            names = []
+            for n in op.input(slot):
+                v = block._find_var_recursive(n) if block is not None else None
+                is_float = v is None or v.dtype is None or v.dtype.startswith(
+                    ("float", "bfloat")
+                )
+                if n in no_grad_set or not is_float:
+                    names.append("")  # hole: no gradient wanted
+                else:
+                    names.append(_grad_var_name(n))
+            if any(names):
+                outputs["X@" + slot] = names
+        if not outputs:
+            return []
+        return [
+            GradOpDesc(
+                self.type + "_grad",
+                inputs,
+                outputs,
+                dict(op.attrs),
+            )
+        ]
+
+
+# ---------------------------------------------------------------------------
+# Synthesized grad ops: `<type>_grad` differentiates the registered forward
+# lowering with jax.vjp inside the same block trace.  The forward replay is
+# CSE'd with the real forward by XLA (and is exactly what remat wants).
+# ---------------------------------------------------------------------------
+
+
+def _synthesize_grad_opdef(base):
+    import jax
+    import jax.numpy as jnp
+
+    in_slots = list(base.input_slots)
+    dup_in = set(base.duplicable_inputs)
+    opt_in = set(base.optional_inputs)
+    for s in base.output_slots:
+        in_slots += ["Out@" + s, "GRAD@" + s]
+        if s in base.duplicable_outputs:
+            dup_in.update(("Out@" + s, "GRAD@" + s))
+        opt_in.update(("Out@" + s, "GRAD@" + s))
+    out_slots = ["X@" + s for s in base.input_slots]
+    dup_out = set("X@" + s for s in base.input_slots if s in base.duplicable_inputs)
+
+    n_in = len(base.input_slots)
+    n_out = len(base.output_slots)
+
+    def grad_lower(ctx, *args, **attrs):
+        fwd_ins = list(args[:n_in])
+        rest = args[n_in:]
+        fwd_outs = [rest[2 * i] for i in range(n_out)]
+        out_grads = [rest[2 * i + 1] for i in range(n_out)]
+
+        op = ctx.op
+        requested = []
+        for i, s in enumerate(base.input_slots):
+            names = op.output("X@" + s) if op is not None else []
+            requested.append(bool(names) and any(names) and fwd_ins[i] is not None)
+        diff_idx = [i for i, r in enumerate(requested) if r]
+        if not diff_idx:
+            return tuple(None for _ in out_slots)
+
+        def fwd(*diff_vals):
+            full = list(fwd_ins)
+            for j, i in enumerate(diff_idx):
+                full[i] = diff_vals[j]
+            out = base.lower(ctx, *full, **attrs)
+            return out if isinstance(out, tuple) else (out,)
+
+        primals = [fwd_ins[i] for i in diff_idx]
+        outs, vjp_fn = jax.vjp(fwd, *primals)
+        cots = []
+        for o, g in zip(outs, out_grads):
+            if g is None:
+                cots.append(jax.tree_util.tree_map(jnp.zeros_like, o))
+            elif isinstance(o, (list, tuple)):
+                cots.append(
+                    type(o)(
+                        gi if gi is not None else jnp.zeros_like(oi)
+                        for oi, gi in zip(o, g)
+                    )
+                )
+            else:
+                cots.append(g.astype(o.dtype) if g.dtype != o.dtype else g)
+        grads = vjp_fn(tuple(cots))
+        result = []
+        gi = 0
+        for i in range(n_in):
+            if i in diff_idx:
+                result.append(grads[gi])
+                gi += 1
+            else:
+                result.append(None)
+        return tuple(result)
+
+    def grad_infer_shape(op, block):
+        # each input grad has the shape/dtype of its forward input
+        for s in base.input_slots:
+            for fwd_name, gname in zip(op.input(s), op.output("X@" + s)):
+                if not gname:
+                    continue
+                fv = block._find_var_recursive(fwd_name)
+                gv = block._find_var_recursive(gname)
+                if fv is not None and gv is not None:
+                    gv.shape = fv.shape
+                    if gv.dtype is None:
+                        gv.dtype = fv.dtype
+
+    return OpDef(
+        base.type + "_grad",
+        inputs=in_slots,
+        outputs=out_slots,
+        lower=grad_lower,
+        infer_shape=grad_infer_shape,
+        grad_maker=None,
+        optional_inputs=opt_in,
+        duplicable_inputs=dup_in,
+        duplicable_outputs=dup_out,
+    )
+
+
+def register_op(
+    type,
+    inputs=(),
+    outputs=(),
+    attrs=None,
+    infer_shape=None,
+    grad_maker="auto",
+    no_grad_inputs=(),
+    optional_inputs=(),
+    duplicable_inputs=(),
+    duplicable_outputs=(),
+    stateful=False,
+    n_rng=0,
+):
+    """Decorator registering a lowering function as op `type`.
+
+    The lowering signature is ``lower(ctx, *input_slot_values, **attrs)`` and
+    must return a tuple matching ``outputs`` (or a single value for one
+    output).  Slot values are lists when the slot is duplicable, otherwise a
+    single jax array (or None for absent optional inputs).
+    """
+
+    def deco(fn):
+        opdef = OpDef(
+            type,
+            inputs=inputs,
+            outputs=outputs,
+            attrs=attrs,
+            lower=fn,
+            infer_shape=infer_shape,
+            grad_maker=grad_maker,
+            no_grad_inputs=no_grad_inputs,
+            optional_inputs=optional_inputs,
+            duplicable_inputs=duplicable_inputs,
+            duplicable_outputs=duplicable_outputs,
+            stateful=stateful,
+            n_rng=n_rng,
+        )
+        if type in _OP_REGISTRY:
+            raise ValueError("op %r registered twice" % type)
+        _OP_REGISTRY[type] = opdef
+        fn.opdef = opdef
+        return fn
+
+    return deco
+
+
+def get_op_def(type):
+    _ensure_ops_loaded()
+    if type not in _OP_REGISTRY:
+        if type.endswith("_grad"):
+            base = _OP_REGISTRY.get(type[: -len("_grad")])
+            if base is not None and base.grad_maker == "auto":
+                _OP_REGISTRY[type] = _synthesize_grad_opdef(base)
+                return _OP_REGISTRY[type]
+        raise ValueError("unknown op type %r" % type)
+    return _OP_REGISTRY[type]
+
+
+def has_op_def(type):
+    _ensure_ops_loaded()
+    return type in _OP_REGISTRY
+
+
+def all_op_types():
+    _ensure_ops_loaded()
+    return sorted(_OP_REGISTRY)
+
+
+_ops_loaded = False
+
+
+def _ensure_ops_loaded():
+    global _ops_loaded
+    if not _ops_loaded:
+        _ops_loaded = True
+        from .. import ops  # noqa: F401  (registers everything)
+
+
+# ---------------------------------------------------------------------------
+# Default shape inference via jax.eval_shape with a symbolic batch dim.
+# -1 dims in input shapes become one shared symbolic size `b`; output dims
+# containing `b` map back to -1.
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=1)
+def _sym_batch():
+    from jax import export
+
+    return export.symbolic_shape("_pb")[0]
+
+
+def _sym_struct(shape, dtype):
+    import jax
+
+    from ..framework import dtype_to_np
+
+    b = _sym_batch()
+    dims = tuple(b if d == -1 else d for d in (shape or ()))
+    return jax.ShapeDtypeStruct(dims, dtype_to_np(dtype))
+
+
+def _unsym(dims):
+    out = []
+    for d in dims:
+        if isinstance(d, int):
+            out.append(d)
+        else:
+            out.append(-1)  # symbolic expression involving the batch dim
+    return tuple(out)
+
+
+def _default_infer_shape(opdef, op, block):
+    import jax
+
+    from .lowering import LowerCtx
+
+    in_structs = []
+    for slot in opdef.input_slots:
+        names = op.input(slot)
+        if not names:
+            in_structs.append([] if slot in opdef.duplicable_inputs else None)
+            continue
+        structs = []
+        for n in names:
+            v = block.var(n)
+            if v.shape is None or v.dtype is None:
+                raise NotImplementedError  # cannot infer
+            structs.append(_sym_struct(v.shape, v.dtype))
+        if slot in opdef.duplicable_inputs:
+            in_structs.append(structs)
+        else:
+            in_structs.append(structs[0])
+
+    ctx = LowerCtx.abstract(n_rng=opdef.n_rng)
+
+    def fn(*args):
+        return opdef.lower(ctx, *args, **_lower_attrs(op.attrs))
+
+    try:
+        out = jax.eval_shape(fn, *in_structs)
+    except Exception:
+        return  # leave declared shapes in place when symbolic eval fails
+    if not isinstance(out, (tuple, list)):
+        out = (out,)
+    flat = []
+    for o in out:
+        if isinstance(o, (tuple, list)):
+            flat.append(list(o))
+        else:
+            flat.append(o)
+    for slot, o in zip(opdef.output_slots, flat):
+        names = op.output(slot)
+        if not names:
+            continue
+        items = o if isinstance(o, list) else [o]
+        for n, st in zip(names, items):
+            if st is None:
+                continue
+            v = block.var(n)
+            v.shape = _unsym(st.shape)
+            if v.dtype is None:
+                from ..framework import convert_np_dtype_to_dtype_
+
+                v.dtype = convert_np_dtype_to_dtype_(st.dtype)
+
+
+def _lower_attrs(attrs):
+    """Strip framework-internal attrs before passing to a lowering."""
+    from ..framework import OP_ROLE_KEY, OP_ROLE_VAR_KEY
+
+    skip = (OP_ROLE_KEY, OP_ROLE_VAR_KEY, "op_namescope", "op_callstack",
+            "op_device", "with_quant_attr")
+    return {k: v for k, v in attrs.items() if k not in skip}

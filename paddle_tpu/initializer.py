@@ -1,0 +1,268 @@
+"""Initializers — emit init ops into the startup program.
+
+Parity: python/paddle/fluid/initializer.py (Constant/Uniform/Normal/
+TruncatedNormal/Xavier/MSRA/Bilinear/NumpyArray).
+"""
+
+import math
+
+import numpy as np
+
+from .framework import default_startup_program
+from .ops.common import dtype_enum
+
+__all__ = [
+    "Constant",
+    "Uniform",
+    "Normal",
+    "TruncatedNormal",
+    "Xavier",
+    "MSRA",
+    "Bilinear",
+    "NumpyArrayInitializer",
+    "ConstantInitializer",
+    "UniformInitializer",
+    "NormalInitializer",
+    "TruncatedNormalInitializer",
+    "XavierInitializer",
+    "MSRAInitializer",
+    "BilinearInitializer",
+    "force_init_on_cpu",
+]
+
+
+def force_init_on_cpu():
+    return False
+
+
+class Initializer:
+    def __call__(self, var, block=None):
+        raise NotImplementedError
+
+    def _startup_block(self, block):
+        if block is not None:
+            return block
+        return default_startup_program().global_block()
+
+    def _declare(self, var, block):
+        """Mirror the var into the startup block so the init op validates."""
+        if not block.has_var(var.name):
+            block.create_var(
+                name=var.name,
+                shape=var.shape,
+                dtype=var.dtype,
+                persistable=var.persistable,
+            )
+
+
+class ConstantInitializer(Initializer):
+    def __init__(self, value=0.0, force_cpu=False):
+        self._value = value
+
+    def __call__(self, var, block=None):
+        block = self._startup_block(block)
+        self._declare(var, block)
+        return block.append_op(
+            type="fill_constant",
+            outputs={"Out": [var.name]},
+            attrs={
+                "shape": list(var.shape),
+                "dtype": dtype_enum(var.dtype),
+                "value": float(self._value),
+            },
+        )
+
+
+class UniformInitializer(Initializer):
+    def __init__(self, low=-1.0, high=1.0, seed=0):
+        self._low, self._high, self._seed = low, high, seed
+
+    def __call__(self, var, block=None):
+        block = self._startup_block(block)
+        self._declare(var, block)
+        return block.append_op(
+            type="uniform_random",
+            outputs={"Out": [var.name]},
+            attrs={
+                "shape": list(var.shape),
+                "dtype": dtype_enum(var.dtype),
+                "min": self._low,
+                "max": self._high,
+                "seed": self._seed,
+            },
+        )
+
+
+class NormalInitializer(Initializer):
+    def __init__(self, loc=0.0, scale=1.0, seed=0):
+        self._mean, self._std, self._seed = loc, scale, seed
+
+    def __call__(self, var, block=None):
+        block = self._startup_block(block)
+        self._declare(var, block)
+        return block.append_op(
+            type="gaussian_random",
+            outputs={"Out": [var.name]},
+            attrs={
+                "shape": list(var.shape),
+                "dtype": dtype_enum(var.dtype),
+                "mean": self._mean,
+                "std": self._std,
+                "seed": self._seed,
+            },
+        )
+
+
+class TruncatedNormalInitializer(Initializer):
+    def __init__(self, loc=0.0, scale=1.0, seed=0):
+        self._mean, self._std, self._seed = loc, scale, seed
+
+    def __call__(self, var, block=None):
+        block = self._startup_block(block)
+        self._declare(var, block)
+        return block.append_op(
+            type="truncated_gaussian_random",
+            outputs={"Out": [var.name]},
+            attrs={
+                "shape": list(var.shape),
+                "dtype": dtype_enum(var.dtype),
+                "mean": self._mean,
+                "std": self._std,
+                "seed": self._seed,
+            },
+        )
+
+
+def _fan_in_out(var):
+    shape = var.shape
+    if len(shape) < 2:
+        return int(shape[0]) if shape else 1, int(shape[0]) if shape else 1
+    receptive = 1
+    for d in shape[2:]:
+        receptive *= int(d)
+    fan_in = int(shape[0]) * receptive if len(shape) > 2 else int(shape[0])
+    fan_out = int(shape[1]) * receptive if len(shape) > 2 else int(shape[1])
+    # conv weights are [out_c, in_c, kh, kw] in fluid layout
+    if len(shape) > 2:
+        fan_in = int(shape[1]) * receptive
+        fan_out = int(shape[0]) * receptive
+    return fan_in, fan_out
+
+
+class XavierInitializer(Initializer):
+    def __init__(self, uniform=True, fan_in=None, fan_out=None, seed=0):
+        self._uniform = uniform
+        self._fan_in, self._fan_out = fan_in, fan_out
+        self._seed = seed
+
+    def __call__(self, var, block=None):
+        block = self._startup_block(block)
+        self._declare(var, block)
+        fi, fo = _fan_in_out(var)
+        fan_in = self._fan_in if self._fan_in is not None else fi
+        fan_out = self._fan_out if self._fan_out is not None else fo
+        if self._uniform:
+            limit = math.sqrt(6.0 / (fan_in + fan_out))
+            return block.append_op(
+                type="uniform_random",
+                outputs={"Out": [var.name]},
+                attrs={
+                    "shape": list(var.shape),
+                    "dtype": dtype_enum(var.dtype),
+                    "min": -limit,
+                    "max": limit,
+                    "seed": self._seed,
+                },
+            )
+        std = math.sqrt(2.0 / (fan_in + fan_out))
+        return block.append_op(
+            type="gaussian_random",
+            outputs={"Out": [var.name]},
+            attrs={
+                "shape": list(var.shape),
+                "dtype": dtype_enum(var.dtype),
+                "mean": 0.0,
+                "std": std,
+                "seed": self._seed,
+            },
+        )
+
+
+class MSRAInitializer(Initializer):
+    def __init__(self, uniform=True, fan_in=None, seed=0):
+        self._uniform, self._fan_in, self._seed = uniform, fan_in, seed
+
+    def __call__(self, var, block=None):
+        block = self._startup_block(block)
+        self._declare(var, block)
+        fi, _ = _fan_in_out(var)
+        fan_in = self._fan_in if self._fan_in is not None else fi
+        if self._uniform:
+            limit = math.sqrt(6.0 / fan_in)
+            attrs = {"min": -limit, "max": limit}
+            op_type = "uniform_random"
+        else:
+            attrs = {"mean": 0.0, "std": math.sqrt(2.0 / fan_in)}
+            op_type = "gaussian_random"
+        attrs.update(
+            shape=list(var.shape), dtype=dtype_enum(var.dtype), seed=self._seed
+        )
+        return block.append_op(
+            type=op_type, outputs={"Out": [var.name]}, attrs=attrs
+        )
+
+
+class BilinearInitializer(Initializer):
+    """For upsampling deconv weights (initializer.py:Bilinear)."""
+
+    def __call__(self, var, block=None):
+        block = self._startup_block(block)
+        self._declare(var, block)
+        shape = var.shape
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer needs a 4-D weight")
+        f = math.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        weight = np.zeros(shape, dtype="float32")
+        size = shape[2] * shape[3]
+        for i in np.arange(np.prod(shape)):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            idx = np.unravel_index(int(i), shape)
+            weight[idx] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        return NumpyArrayInitializer(weight)(var, block)
+
+
+class NumpyArrayInitializer(Initializer):
+    def __init__(self, value):
+        self._value = np.asarray(value)
+
+    def __call__(self, var, block=None):
+        block = self._startup_block(block)
+        self._declare(var, block)
+        v = self._value
+        key = {
+            "float32": "fp32_values",
+            "float64": "fp32_values",
+            "int32": "int32_values",
+            "int64": "int64_values",
+            "bool": "bool_values",
+        }.get(var.dtype, "fp32_values")
+        return block.append_op(
+            type="assign_value",
+            outputs={"Out": [var.name]},
+            attrs={
+                "shape": list(v.shape),
+                "dtype": dtype_enum(var.dtype),
+                key: [float(x) if "fp" in key else int(x) for x in v.flatten()],
+            },
+        )
+
+
+Constant = ConstantInitializer
+Uniform = UniformInitializer
+Normal = NormalInitializer
+TruncatedNormal = TruncatedNormalInitializer
+Xavier = XavierInitializer
+MSRA = MSRAInitializer
+Bilinear = BilinearInitializer

@@ -1,0 +1,174 @@
+"""Checkpointing & inference-model save/load.
+
+Parity: python/paddle/fluid/io.py (save_vars:149, save_persistables:523,
+load_vars:588, load_persistables:801, save_inference_model:1011,
+load_inference_model:1215) + the save/load ops (operators/save_op.h).
+Format: one .npz per var-set + a JSON program desc (instead of the
+reference's per-var binary streams + __model__ protobuf).
+"""
+
+import json
+import os
+
+import numpy as np
+
+from .core.executor import global_scope
+from .framework import Parameter, Program, Variable
+
+__all__ = [
+    "save_vars",
+    "save_params",
+    "save_persistables",
+    "load_vars",
+    "load_params",
+    "load_persistables",
+    "save_inference_model",
+    "load_inference_model",
+]
+
+
+def _is_persistable(var):
+    return var.persistable and not var.is_data
+
+
+def _is_parameter(var):
+    return isinstance(var, Parameter)
+
+
+def _gather(executor, dirname, program, predicate, filename):
+    program = program or _default_main()
+    scope = global_scope()
+    out = {}
+    for var in program.list_vars():
+        if not predicate(var):
+            continue
+        sv = scope.find_var(var.name)
+        if sv is None or not sv.get_tensor()._is_initialized():
+            continue
+        out[var.name] = np.asarray(sv.get_tensor().numpy())
+    os.makedirs(dirname, exist_ok=True)
+    path = os.path.join(dirname, filename or "__params__.npz")
+    np.savez(path, **out)
+    return path
+
+
+def _default_main():
+    from .framework import default_main_program
+
+    return default_main_program()
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    if vars is not None:
+        names = {v.name if isinstance(v, Variable) else v for v in vars}
+        predicate = lambda v: v.name in names  # noqa: E731
+    return _gather(executor, dirname, main_program, predicate, filename)
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    return _gather(executor, dirname, main_program, _is_parameter, filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    return _gather(executor, dirname, main_program, _is_persistable, filename)
+
+
+def _scatter(executor, dirname, program, predicate, filename):
+    program = program or _default_main()
+    scope = global_scope()
+    path = os.path.join(dirname, filename or "__params__.npz")
+    data = np.load(path, allow_pickle=False)
+    loaded = 0
+    for var in program.list_vars():
+        if not predicate(var):
+            continue
+        if var.name in data.files:
+            scope.var(var.name).set(data[var.name])
+            loaded += 1
+    return loaded
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    if vars is not None:
+        names = {v.name if isinstance(v, Variable) else v for v in vars}
+        predicate = lambda v: v.name in names  # noqa: E731
+    return _scatter(executor, dirname, main_program, predicate, filename)
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    return _scatter(executor, dirname, main_program, _is_parameter, filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    return _scatter(executor, dirname, main_program, _is_persistable, filename)
+
+
+def _prune_for_inference(program, feed_names, target_names):
+    """Keep only ops needed to compute targets from feeds (reference
+    prune.cc): backward slice over the op list, dropping
+    backward/optimize-role ops."""
+    from .framework import OP_ROLE_KEY, OpRole
+
+    block = program.global_block()
+    needed = set(target_names)
+    keep = []
+    for op in reversed(block.ops):
+        role = op.attr(OP_ROLE_KEY) or 0
+        if int(role) & (OpRole.Backward | OpRole.Optimize):
+            continue
+        if int(role) == OpRole.LRSched:
+            continue
+        outs = [n for n in op.output_arg_names if n]
+        if not any(n in needed for n in outs):
+            continue
+        keep.append(op)
+        for n in op.input_arg_names:
+            if n:
+                needed.add(n)
+    keep.reverse()
+    pruned = program.clone(for_test=True)
+    pb = pruned.global_block()
+    kept_keys = {(op.type, json.dumps(op.inputs, sort_keys=True),
+                  json.dumps(op.outputs, sort_keys=True)) for op in keep}
+    pb.ops = [
+        op for op in pb.ops
+        if (op.type, json.dumps(op.inputs, sort_keys=True),
+            json.dumps(op.outputs, sort_keys=True)) in kept_keys
+    ]
+    pruned._bump_version()
+    return pruned
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, export_for_deployment=True,
+                         program_only=False):
+    program = main_program or _default_main()
+    target_names = [v.name if isinstance(v, Variable) else v for v in target_vars]
+    pruned = _prune_for_inference(program, feeded_var_names, target_names)
+    os.makedirs(dirname, exist_ok=True)
+    model = {
+        "program": pruned.to_dict(),
+        "feed_names": list(feeded_var_names),
+        "fetch_names": target_names,
+    }
+    with open(os.path.join(dirname, model_filename or "__model__.json"), "w") as f:
+        json.dump(model, f)
+    if not program_only:
+        save_persistables(executor, dirname, pruned, params_filename)
+    return target_names
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    with open(os.path.join(dirname, model_filename or "__model__.json")) as f:
+        model = json.load(f)
+    program = Program.from_dict(model["program"])
+    try:
+        load_persistables(executor, dirname, program, params_filename)
+    except FileNotFoundError:
+        pass
+    fetch_vars = [program.global_block().var(n) for n in model["fetch_names"]]
+    return program, model["feed_names"], fetch_vars

@@ -1,0 +1,83 @@
+"""Operator overloading on Variable (parity: layers/math_op_patch.py)."""
+
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+
+_supported = [
+    ("__add__", "elementwise_add", False),
+    ("__radd__", "elementwise_add", True),
+    ("__sub__", "elementwise_sub", False),
+    ("__rsub__", "elementwise_sub", True),
+    ("__mul__", "elementwise_mul", False),
+    ("__rmul__", "elementwise_mul", True),
+    ("__truediv__", "elementwise_div", False),
+    ("__rtruediv__", "elementwise_div", True),
+    ("__pow__", "elementwise_pow", False),
+    ("__mod__", "elementwise_mod", False),
+    ("__floordiv__", "elementwise_floordiv", False),
+    ("__lt__", "less_than", False),
+    ("__le__", "less_equal", False),
+    ("__gt__", "greater_than", False),
+    ("__ge__", "greater_equal", False),
+]
+
+
+def _scalar_to_var(val, ref):
+    from . import tensor
+
+    return tensor.fill_constant([1], ref.dtype, float(val))
+
+
+def _binary(op_type, reverse):
+    def impl(self, other):
+        if not isinstance(other, Variable):
+            if isinstance(other, (int, float)):
+                # scalar fast path via scale op for add/sub/mul/div
+                if op_type == "elementwise_add" and not reverse:
+                    from .nn import scale
+
+                    return scale(self, scale=1.0, bias=float(other))
+                if op_type == "elementwise_mul":
+                    from .nn import scale
+
+                    return scale(self, scale=float(other))
+                other = _scalar_to_var(other, self)
+            else:
+                return NotImplemented
+        x, y = (other, self) if reverse else (self, other)
+        helper = LayerHelper(op_type)
+        is_cmp = op_type in ("less_than", "less_equal", "greater_than",
+                             "greater_equal", "equal", "not_equal")
+        out = helper.create_variable_for_type_inference(
+            dtype="bool" if is_cmp else x.dtype
+        )
+        helper.append_op(
+            type=op_type,
+            inputs={"X": [x], "Y": [y]},
+            outputs={"Out": [out]},
+            attrs={} if is_cmp else {"axis": -1},
+        )
+        return out
+
+    return impl
+
+
+def _neg(self):
+    from .nn import scale
+
+    return scale(self, scale=-1.0)
+
+
+def _eq(self, other):
+    # keep identity semantics for dict/set usage; layers.equal exists for
+    # elementwise compare
+    return self is other
+
+
+def monkey_patch_variable():
+    for name, op_type, rev in _supported:
+        setattr(Variable, name, _binary(op_type, rev))
+    Variable.__neg__ = _neg
+
+
+monkey_patch_variable()

@@ -1,0 +1,324 @@
+"""Tensor creation / IO layer API (parity: layers/tensor.py + layers/io.py
+`data`)."""
+
+import numpy as np
+
+from ..framework import (
+    Variable,
+    convert_np_dtype_to_dtype_,
+    default_main_program,
+    default_startup_program,
+)
+from ..layer_helper import LayerHelper
+from ..ops.common import dtype_enum
+
+__all__ = [
+    "data",
+    "create_tensor",
+    "create_parameter",
+    "create_global_var",
+    "cast",
+    "concat",
+    "sums",
+    "assign",
+    "fill_constant",
+    "fill_constant_batch_size_like",
+    "ones",
+    "zeros",
+    "ones_like",
+    "zeros_like",
+    "reverse",
+    "has_inf",
+    "has_nan",
+    "isfinite",
+    "range",
+    "linspace",
+    "diag",
+    "eye",
+    "argmax",
+    "argmin",
+]
+
+
+def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
+         type=None, stop_gradient=True):
+    """Declare an input variable (reference layers/io.py:data / fluid.data).
+
+    With append_batch_size=True a leading -1 batch dim is added.
+    """
+    helper = LayerHelper("data")
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    return helper.block.program.global_block().create_var(
+        name=name,
+        shape=shape,
+        dtype=dtype,
+        lod_level=lod_level,
+        is_data=True,
+        need_check_feed=True,
+        stop_gradient=stop_gradient,
+    )
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    helper = LayerHelper("create_tensor", name=name)
+    return helper.create_variable(
+        name=helper.name, dtype=dtype, persistable=persistable
+    )
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..param_attr import ParamAttr
+
+    helper = LayerHelper("create_parameter", name=name)
+    attr = ParamAttr._to_attr(attr)
+    if name is not None and attr.name is None:
+        attr.name = name
+    return helper.create_parameter(attr, shape, dtype, is_bias,
+                                   default_initializer)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    helper = LayerHelper("global_var", name=name)
+    var = helper.create_global_variable(
+        dtype=dtype, shape=list(shape), persistable=persistable,
+        name=name
+    )
+    from ..initializer import Constant
+
+    helper.set_variable_initializer(var, Constant(value))
+    return var
+
+
+def cast(x, dtype):
+    helper = LayerHelper("cast")
+    dtype = convert_np_dtype_to_dtype_(dtype)
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op(
+        type="cast",
+        inputs={"X": [x]},
+        outputs={"Out": [out]},
+        attrs={"in_dtype": dtype_enum(x.dtype), "out_dtype": dtype_enum(dtype)},
+    )
+    return out
+
+
+def concat(input, axis=0, name=None):
+    from .nn import concat as _concat
+
+    return _concat(input, axis, name)
+
+
+def sums(input, out=None):
+    from .nn import sums as _sums
+
+    return _sums(input, out)
+
+
+def assign(input, output=None):
+    helper = LayerHelper("assign")
+    if isinstance(input, Variable):
+        if output is None:
+            output = helper.create_variable_for_type_inference(dtype=input.dtype)
+        helper.append_op(
+            type="assign", inputs={"X": [input]}, outputs={"Out": [output]}
+        )
+        return output
+    arr = np.asarray(input)
+    if output is None:
+        output = helper.create_variable_for_type_inference(
+            dtype=convert_np_dtype_to_dtype_(arr.dtype)
+        )
+    key = {
+        "float32": "fp32_values",
+        "int32": "int32_values",
+        "int64": "int64_values",
+        "bool": "bool_values",
+    }.get(convert_np_dtype_to_dtype_(arr.dtype), "fp32_values")
+    helper.append_op(
+        type="assign_value",
+        outputs={"Out": [output]},
+        attrs={
+            "shape": list(arr.shape),
+            "dtype": dtype_enum(convert_np_dtype_to_dtype_(arr.dtype)),
+            key: [float(v) if key == "fp32_values" else int(v)
+                  for v in arr.flatten()],
+        },
+    )
+    return output
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None):
+    helper = LayerHelper("fill_constant")
+    dtype = convert_np_dtype_to_dtype_(dtype)
+    if out is None:
+        out = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op(
+        type="fill_constant",
+        outputs={"Out": [out]},
+        attrs={"shape": list(shape), "dtype": dtype_enum(dtype),
+               "value": float(value), "force_cpu": force_cpu},
+    )
+    out.stop_gradient = True
+    return out
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0,
+                                  force_cpu=False):
+    helper = LayerHelper("fill_constant_batch_size_like")
+    dtype = convert_np_dtype_to_dtype_(dtype)
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op(
+        type="fill_constant_batch_size_like",
+        inputs={"Input": [input]},
+        outputs={"Out": [out]},
+        attrs={"shape": list(shape), "dtype": dtype_enum(dtype),
+               "value": float(value), "input_dim_idx": input_dim_idx,
+               "output_dim_idx": output_dim_idx},
+    )
+    out.stop_gradient = True
+    return out
+
+
+def ones(shape, dtype="float32", force_cpu=False):
+    return fill_constant(shape, dtype, 1.0, force_cpu)
+
+
+def zeros(shape, dtype="float32", force_cpu=False):
+    return fill_constant(shape, dtype, 0.0, force_cpu)
+
+
+def ones_like(x, out=None):
+    helper = LayerHelper("ones_like")
+    if out is None:
+        out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        type="fill_any_like",
+        inputs={"X": [x]},
+        outputs={"Out": [out]},
+        attrs={"value": 1.0},
+    )
+    return out
+
+
+def zeros_like(x, out=None):
+    helper = LayerHelper("zeros_like")
+    if out is None:
+        out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        type="fill_zeros_like", inputs={"X": [x]}, outputs={"Out": [out]}
+    )
+    return out
+
+
+def reverse(x, axis):
+    helper = LayerHelper("reverse")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        type="reverse",
+        inputs={"X": [x]},
+        outputs={"Out": [out]},
+        attrs={"axis": [axis] if isinstance(axis, int) else list(axis)},
+    )
+    return out
+
+
+def has_inf(x):
+    helper = LayerHelper("isinf")
+    out = helper.create_variable_for_type_inference(dtype="bool")
+    helper.append_op(type="isinf_v2", inputs={"X": [x]}, outputs={"Out": [out]})
+    from .nn import reduce_any
+
+    return reduce_any(out)
+
+
+def has_nan(x):
+    helper = LayerHelper("isnan")
+    out = helper.create_variable_for_type_inference(dtype="bool")
+    helper.append_op(type="isnan_v2", inputs={"X": [x]}, outputs={"Out": [out]})
+    from .nn import reduce_any
+
+    return reduce_any(out)
+
+
+def isfinite(x):
+    helper = LayerHelper("isfinite")
+    out = helper.create_variable_for_type_inference(dtype="bool")
+    helper.append_op(type="isfinite", inputs={"X": [x]}, outputs={"Out": [out]})
+    return out
+
+
+def range(start, end, step, dtype):
+    helper = LayerHelper("range")
+    dtype = convert_np_dtype_to_dtype_(dtype)
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    # concrete bounds only (static shapes on TPU)
+    import numpy as _np
+
+    arr = _np.arange(start, end, step)
+    return assign(arr.astype(dtype), out)
+
+
+def linspace(start, stop, num, dtype="float32"):
+    helper = LayerHelper("linspace")
+    from . import tensor as _t
+
+    start_v = fill_constant([1], dtype, start) if not isinstance(start, Variable) else start
+    stop_v = fill_constant([1], dtype, stop) if not isinstance(stop, Variable) else stop
+    num_v = fill_constant([1], "int32", num) if not isinstance(num, Variable) else num
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op(
+        type="linspace",
+        inputs={"Start": [start_v], "Stop": [stop_v], "Num": [num_v]},
+        outputs={"Out": [out]},
+        attrs={"dtype": dtype_enum(convert_np_dtype_to_dtype_(dtype))},
+    )
+    return out
+
+
+def diag(diagonal):
+    import numpy as _np
+
+    helper = LayerHelper("diag")
+    if isinstance(diagonal, Variable):
+        from .nn import _single_out_layer
+
+        raise NotImplementedError("diag of Variable: use layers.eye composition")
+    arr = _np.diag(_np.asarray(diagonal))
+    return assign(arr)
+
+
+def eye(num_rows, num_columns=None, batch_shape=None, dtype="float32"):
+    helper = LayerHelper("eye")
+    dtype = convert_np_dtype_to_dtype_(dtype)
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op(
+        type="eye",
+        outputs={"Out": [out]},
+        attrs={"num_rows": num_rows,
+               "num_columns": num_columns if num_columns else num_rows,
+               "dtype": dtype_enum(dtype)},
+    )
+    if batch_shape:
+        from .nn import expand, unsqueeze
+
+        for _ in batch_shape:
+            out = unsqueeze(out, [0])
+        out = expand(out, list(batch_shape) + [1, 1])
+    return out
+
+
+def argmax(x, axis=0):
+    from .nn import argmax as _argmax
+
+    return _argmax(x, axis)
+
+
+def argmin(x, axis=0):
+    from .nn import argmin as _argmin
+
+    return _argmin(x, axis)

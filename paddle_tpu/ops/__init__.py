@@ -1,0 +1,14 @@
+"""Operator library: registrations of all op lowerings.
+
+Importing this package populates the registry (analog of the reference's
+static REGISTER_OPERATOR initializers, op_registry.h:199).
+"""
+
+from . import creation  # noqa: F401
+from . import math  # noqa: F401
+from . import activations  # noqa: F401
+from . import loss  # noqa: F401
+from . import manip  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import metrics  # noqa: F401

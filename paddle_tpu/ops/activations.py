@@ -1,0 +1,147 @@
+"""Activation ops (~30 in the reference activation_op.cc) — all VPU-friendly
+elementwise lowerings; XLA fuses them into adjacent matmuls/convs."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _register_act(name, fn, attrs=None):
+    @register_op(name, inputs=("X",), outputs=("Out",), attrs=attrs or {})
+    def _low(ctx, x, _fn=fn, **kw):
+        return _fn(x, **kw)
+
+    return _low
+
+
+_register_act("relu", jax.nn.relu)
+_register_act("sigmoid", jax.nn.sigmoid)
+_register_act("tanh", jnp.tanh)
+_register_act("exp", jnp.exp)
+_register_act("log", jnp.log)
+_register_act("log2", jnp.log2)
+_register_act("log10", jnp.log10)
+_register_act("log1p", jnp.log1p)
+_register_act("sqrt", jnp.sqrt)
+_register_act("rsqrt", lambda x: jax.lax.rsqrt(x))
+_register_act("abs", jnp.abs)
+_register_act("square", jnp.square)
+_register_act("reciprocal", lambda x: 1.0 / x)
+_register_act("softplus", jax.nn.softplus)
+_register_act("softsign", jax.nn.soft_sign)
+_register_act("sin", jnp.sin)
+_register_act("cos", jnp.cos)
+_register_act("tan", jnp.tan)
+_register_act("asin", jnp.arcsin)
+_register_act("acos", jnp.arccos)
+_register_act("atan", jnp.arctan)
+_register_act("sinh", jnp.sinh)
+_register_act("cosh", jnp.cosh)
+_register_act("ceil", jnp.ceil)
+_register_act("floor", jnp.floor)
+_register_act("round", jnp.round)
+_register_act("tanh_shrink", lambda x: x - jnp.tanh(x))
+_register_act("silu", jax.nn.silu)
+_register_act("swish", lambda x, beta=1.0: x * jax.nn.sigmoid(beta * x),
+              attrs={"beta": 1.0})
+_register_act("logsigmoid", jax.nn.log_sigmoid)
+_register_act("sign", jnp.sign)
+_register_act("erf", jax.scipy.special.erf)
+
+_register_act(
+    "leaky_relu",
+    lambda x, alpha=0.02: jnp.where(x >= 0, x, alpha * x),
+    attrs={"alpha": 0.02},
+)
+_register_act(
+    "elu",
+    lambda x, alpha=1.0: jnp.where(x > 0, x, alpha * (jnp.exp(x) - 1.0)),
+    attrs={"alpha": 1.0},
+)
+_register_act(
+    "relu6",
+    lambda x, threshold=6.0: jnp.clip(x, 0.0, threshold),
+    attrs={"threshold": 6.0},
+)
+_register_act(
+    "brelu",
+    lambda x, t_min=0.0, t_max=24.0: jnp.clip(x, t_min, t_max),
+    attrs={"t_min": 0.0, "t_max": 24.0},
+)
+_register_act(
+    "hard_sigmoid",
+    lambda x, slope=0.2, offset=0.5: jnp.clip(slope * x + offset, 0.0, 1.0),
+    attrs={"slope": 0.2, "offset": 0.5},
+)
+_register_act(
+    "hard_swish",
+    lambda x, threshold=6.0, scale=6.0, offset=3.0: x
+    * jnp.clip(x + offset, 0.0, threshold)
+    / scale,
+    attrs={"threshold": 6.0, "scale": 6.0, "offset": 3.0},
+)
+_register_act(
+    "hard_shrink",
+    lambda x, threshold=0.5: jnp.where(jnp.abs(x) > threshold, x, 0.0),
+    attrs={"threshold": 0.5},
+)
+_register_act(
+    "soft_shrink",
+    lambda x, lambda_=0.5: jnp.sign(x) * jnp.maximum(jnp.abs(x) - lambda_, 0.0),
+    attrs={"lambda": 0.5},
+)
+_register_act(
+    "thresholded_relu",
+    lambda x, threshold=1.0: jnp.where(x > threshold, x, 0.0),
+    attrs={"threshold": 1.0},
+)
+_register_act(
+    "stanh",
+    lambda x, scale_a=0.67, scale_b=1.7159: scale_b * jnp.tanh(scale_a * x),
+    attrs={"scale_a": 0.67, "scale_b": 1.7159},
+)
+_register_act(
+    "gelu",
+    lambda x, approximate=False: jax.nn.gelu(x, approximate=approximate),
+    attrs={"approximate": False},
+)
+_register_act(
+    "pow",
+    lambda x, factor=1.0: jnp.power(x, factor),
+    attrs={"factor": 1.0},
+)
+
+
+@register_op("softmax", inputs=("X",), outputs=("Out",),
+             attrs={"axis": -1, "use_cudnn": False, "use_mkldnn": False})
+def softmax(ctx, x, axis=-1, **_):
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register_op("log_softmax", inputs=("X",), outputs=("Out",),
+             attrs={"axis": -1})
+def log_softmax(ctx, x, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@register_op("prelu", inputs=("X", "Alpha"), outputs=("Out",),
+             attrs={"mode": "all"})
+def prelu(ctx, x, alpha, mode="all"):
+    if mode == "all":
+        a = alpha.reshape(())
+    elif mode == "channel":
+        a = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    else:  # element
+        a = alpha.reshape((1,) + x.shape[1:])
+    return jnp.where(x > 0, x, a * x)
+
+
+@register_op("maxout", inputs=("X",), outputs=("Out",),
+             attrs={"groups": 1, "axis": 1})
+def maxout(ctx, x, groups=1, axis=1):
+    c = x.shape[axis]
+    new_shape = x.shape[:axis] + (c // groups, groups) + x.shape[axis + 1:]
+    return jnp.max(x.reshape(new_shape), axis=axis + 1)

@@ -1,0 +1,63 @@
+"""Shared helpers for op lowerings."""
+
+import numpy as np
+import jax.numpy as jnp
+
+# fluid VarType dtype enum (framework.proto:107-125) -> dtype name, kept so
+# programs/attrs using integer dtype codes stay compatible.
+_DTYPE_ENUM = {
+    0: "bool",
+    1: "int16",
+    2: "int32",
+    3: "int64",
+    4: "float16",
+    5: "float32",
+    6: "float64",
+    19: "int64",  # SIZE_T
+    20: "uint8",
+    21: "int8",
+    22: "bfloat16",
+}
+_DTYPE_TO_ENUM = {
+    "bool": 0,
+    "int16": 1,
+    "int32": 2,
+    "int64": 3,
+    "float16": 4,
+    "float32": 5,
+    "float64": 6,
+    "uint8": 20,
+    "int8": 21,
+    "bfloat16": 22,
+}
+
+
+def attr_dtype(v, default="float32"):
+    """Normalize a dtype attr (int enum / str / np dtype) to a jnp dtype."""
+    from ..framework import dtype_to_np
+
+    if v is None:
+        return dtype_to_np(default)
+    if isinstance(v, (int, np.integer)):
+        return dtype_to_np(_DTYPE_ENUM[int(v)])
+    from ..framework import convert_np_dtype_to_dtype_
+
+    return dtype_to_np(convert_np_dtype_to_dtype_(v))
+
+
+def dtype_enum(name):
+    return _DTYPE_TO_ENUM[name]
+
+
+def bcast_y(x, y, axis=-1):
+    """Fluid elementwise broadcast semantics (elementwise_op.h): align y's
+    dims to a contiguous run of x's dims starting at `axis` (axis=-1 means
+    rightmost alignment); trailing unit dims of y are squeezed first."""
+    if x.shape == y.shape or y.ndim == 0:
+        return y
+    yshape = list(y.shape)
+    while len(yshape) > 1 and yshape[-1] == 1:
+        yshape.pop()
+    ax = x.ndim - len(yshape) if axis == -1 else axis
+    new_shape = [1] * ax + yshape + [1] * (x.ndim - ax - len(yshape))
+    return jnp.reshape(y, new_shape)

@@ -1,0 +1,227 @@
+"""Tensor creation / random / casting ops.
+
+Parity targets: fill_constant_op.cc, uniform_random_op.cc,
+gaussian_random_op.cc, assign_op.cc, cast_op.cc, fill_zeros_like_op.cc,
+fill_constant_batch_size_like_op.cc (all under paddle/fluid/operators/).
+Randomness is functional (threaded PRNG keys) instead of stateful curand.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import attr_dtype
+
+
+@register_op(
+    "fill_constant",
+    inputs=("ShapeTensor", "ShapeTensorList", "ValueTensor"),
+    outputs=("Out",),
+    attrs={"shape": [], "value": 0.0, "dtype": 5, "force_cpu": False, "str_value": ""},
+    optional_inputs=("ShapeTensor", "ShapeTensorList", "ValueTensor"),
+    duplicable_inputs=("ShapeTensorList",),
+    grad_maker=None,
+)
+def fill_constant(ctx, shape_tensor, shape_tensor_list, value_tensor, shape=(),
+                  value=0.0, dtype=5, force_cpu=False, str_value=""):
+    dt = attr_dtype(dtype)
+    if str_value not in ("", None):
+        value = float(str_value)
+    if value_tensor is not None:
+        value = value_tensor.reshape(())
+    return jnp.full(tuple(int(s) for s in shape), value, dtype=dt)
+
+
+@register_op(
+    "fill_zeros_like",
+    inputs=("X",),
+    outputs=("Out",),
+    grad_maker=None,
+)
+def fill_zeros_like(ctx, x):
+    return jnp.zeros_like(x)
+
+
+@register_op(
+    "fill_any_like",
+    inputs=("X",),
+    outputs=("Out",),
+    attrs={"value": 0.0, "dtype": -1},
+    grad_maker=None,
+)
+def fill_any_like(ctx, x, value=0.0, dtype=-1):
+    dt = x.dtype if dtype in (-1, None) else attr_dtype(dtype)
+    return jnp.full_like(x, value, dtype=dt)
+
+
+@register_op(
+    "fill_constant_batch_size_like",
+    inputs=("Input",),
+    outputs=("Out",),
+    attrs={"shape": [], "value": 0.0, "dtype": 5, "input_dim_idx": 0,
+           "output_dim_idx": 0, "force_cpu": False},
+    grad_maker=None,
+)
+def fill_constant_batch_size_like(ctx, input, shape=(), value=0.0, dtype=5,
+                                  input_dim_idx=0, output_dim_idx=0,
+                                  force_cpu=False):
+    dt = attr_dtype(dtype)
+    out_shape = list(int(s) for s in shape)
+    out_shape[output_dim_idx] = input.shape[input_dim_idx]
+    return jnp.full(tuple(out_shape), value, dtype=dt)
+
+
+@register_op(
+    "uniform_random",
+    inputs=("ShapeTensor", "ShapeTensorList"),
+    outputs=("Out",),
+    attrs={"shape": [], "min": -1.0, "max": 1.0, "seed": 0, "dtype": 5,
+           "diag_num": 0, "diag_step": 0, "diag_val": 1.0},
+    optional_inputs=("ShapeTensor", "ShapeTensorList"),
+    duplicable_inputs=("ShapeTensorList",),
+    grad_maker=None,
+    n_rng=1,
+)
+def uniform_random(ctx, shape_tensor, shape_tensor_list, shape=(), min=-1.0,
+                   max=1.0, seed=0, dtype=5, diag_num=0, diag_step=0,
+                   diag_val=1.0):
+    dt = attr_dtype(dtype)
+    key = jax.random.key(seed) if seed else ctx.rng()
+    return jax.random.uniform(
+        key, tuple(int(s) for s in shape), dtype=dt, minval=min, maxval=max
+    )
+
+
+@register_op(
+    "gaussian_random",
+    inputs=("ShapeTensor", "ShapeTensorList"),
+    outputs=("Out",),
+    attrs={"shape": [], "mean": 0.0, "std": 1.0, "seed": 0, "dtype": 5},
+    optional_inputs=("ShapeTensor", "ShapeTensorList"),
+    duplicable_inputs=("ShapeTensorList",),
+    grad_maker=None,
+    n_rng=1,
+)
+def gaussian_random(ctx, shape_tensor, shape_tensor_list, shape=(), mean=0.0,
+                    std=1.0, seed=0, dtype=5):
+    dt = attr_dtype(dtype)
+    key = jax.random.key(seed) if seed else ctx.rng()
+    return mean + std * jax.random.normal(key, tuple(int(s) for s in shape), dtype=dt)
+
+
+@register_op(
+    "truncated_gaussian_random",
+    inputs=(),
+    outputs=("Out",),
+    attrs={"shape": [], "mean": 0.0, "std": 1.0, "seed": 0, "dtype": 5},
+    grad_maker=None,
+    n_rng=1,
+)
+def truncated_gaussian_random(ctx, shape=(), mean=0.0, std=1.0, seed=0, dtype=5):
+    dt = attr_dtype(dtype)
+    key = jax.random.key(seed) if seed else ctx.rng()
+    x = jax.random.truncated_normal(key, -2.0, 2.0, tuple(int(s) for s in shape),
+                                    dtype=dt)
+    return mean + std * x
+
+
+@register_op(
+    "randint",
+    inputs=(),
+    outputs=("Out",),
+    attrs={"shape": [], "low": 0, "high": 1, "seed": 0, "dtype": 3},
+    grad_maker=None,
+    n_rng=1,
+)
+def randint(ctx, shape=(), low=0, high=1, seed=0, dtype=3):
+    dt = attr_dtype(dtype)
+    key = jax.random.key(seed) if seed else ctx.rng()
+    return jax.random.randint(key, tuple(int(s) for s in shape), low, high, dtype=dt)
+
+
+@register_op("assign", inputs=("X",), outputs=("Out",))
+def assign(ctx, x):
+    return x
+
+
+@register_op(
+    "assign_value",
+    inputs=(),
+    outputs=("Out",),
+    attrs={"shape": [], "dtype": 5, "fp32_values": [], "int32_values": [],
+           "int64_values": [], "bool_values": []},
+    grad_maker=None,
+)
+def assign_value(ctx, shape=(), dtype=5, fp32_values=(), int32_values=(),
+                 int64_values=(), bool_values=()):
+    dt = attr_dtype(dtype)
+    vals = fp32_values or int32_values or int64_values or bool_values
+    return jnp.asarray(np.array(vals), dtype=dt).reshape(tuple(int(s) for s in shape))
+
+
+@register_op("cast", inputs=("X",), outputs=("Out",),
+             attrs={"in_dtype": 5, "out_dtype": 5},
+             grad_maker="auto")
+def cast(ctx, x, in_dtype=5, out_dtype=5):
+    return x.astype(attr_dtype(out_dtype))
+
+
+@register_op("shape", inputs=("Input",), outputs=("Out",), grad_maker=None)
+def shape_op(ctx, input):
+    return jnp.asarray(np.array(input.shape, dtype=np.int32))
+
+
+@register_op(
+    "range",
+    inputs=("Start", "End", "Step"),
+    outputs=("Out",),
+    optional_inputs=("Start", "End", "Step"),
+    grad_maker=None,
+)
+def range_op(ctx, start, end, step):
+    # static-shape requirement: bounds must be concrete on TPU
+    s = float(np.asarray(start)) if start is not None else 0.0
+    e = float(np.asarray(end))
+    st = float(np.asarray(step)) if step is not None else 1.0
+    return jnp.arange(s, e, st)
+
+
+@register_op(
+    "eye",
+    inputs=(),
+    outputs=("Out",),
+    attrs={"num_rows": 0, "num_columns": -1, "dtype": 5},
+    grad_maker=None,
+)
+def eye(ctx, num_rows=0, num_columns=-1, dtype=5):
+    n = num_columns if num_columns > 0 else num_rows
+    return jnp.eye(num_rows, n, dtype=attr_dtype(dtype))
+
+
+@register_op(
+    "linspace",
+    inputs=("Start", "Stop", "Num"),
+    outputs=("Out",),
+    attrs={"dtype": 5},
+    grad_maker=None,
+)
+def linspace(ctx, start, stop, num, dtype=5):
+    n = int(np.asarray(num))
+    return jnp.linspace(start.reshape(()), stop.reshape(()), n,
+                        dtype=attr_dtype(dtype))
+
+
+# feed/fetch are structural ops (executor handles data movement directly);
+# registered so saved inference programs load & validate
+# (reference: operators/controlflow/feed_op.cc, fetch_op.cc).
+@register_op("feed", inputs=("X",), outputs=("Out",), attrs={"col": 0},
+             grad_maker=None, optional_inputs=("X",))
+def feed(ctx, x, col=0):
+    return x
+
+
+@register_op("fetch", inputs=("X",), outputs=("Out",), attrs={"col": 0},
+             grad_maker=None, optional_inputs=("X",))
+def fetch(ctx, x, col=0):
+    return x

@@ -1,0 +1,174 @@
+"""Loss ops: softmax_with_cross_entropy, cross_entropy, and friends.
+
+Parity: softmax_with_cross_entropy_op.cc, cross_entropy_op.cc,
+sigmoid_cross_entropy_with_logits_op.cc, huber_loss_op.cc,
+smooth_l1_loss_op.cc (paddle/fluid/operators/).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _take_label(logp, label, axis):
+    """Gather logp at integer labels along axis; label has a trailing 1 dim
+    (fluid convention) or matches logp without the class axis."""
+    lab = label
+    if lab.shape == logp.shape[:axis] + (1,) + logp.shape[axis + 1:] or (
+        lab.ndim == logp.ndim and lab.shape[axis] == 1
+    ):
+        picked = jnp.take_along_axis(logp, lab.astype(jnp.int32), axis=axis)
+        return picked
+    lab = jnp.expand_dims(lab, axis)
+    return jnp.take_along_axis(logp, lab.astype(jnp.int32), axis=axis)
+
+
+@register_op(
+    "softmax_with_cross_entropy",
+    inputs=("Logits", "Label"),
+    outputs=("Softmax", "Loss"),
+    attrs={"soft_label": False, "ignore_index": -100, "numeric_stable_mode": True,
+           "axis": -1},
+    no_grad_inputs=("Label",),
+)
+def softmax_with_cross_entropy(ctx, logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               axis=-1):
+    ax = axis if axis >= 0 else logits.ndim + axis
+    logp = jax.nn.log_softmax(logits, axis=ax)
+    softmax = jnp.exp(logp)
+    if soft_label:
+        loss = -jnp.sum(label * logp, axis=ax, keepdims=True)
+    else:
+        picked = _take_label(logp, label, ax)
+        loss = -picked
+        if ignore_index >= 0:
+            lab = label if label.ndim == loss.ndim else jnp.expand_dims(label, ax)
+            loss = jnp.where(lab == ignore_index, 0.0, loss)
+    return softmax, loss
+
+
+@register_op(
+    "cross_entropy",
+    inputs=("X", "Label"),
+    outputs=("Y",),
+    attrs={"soft_label": False, "ignore_index": -100},
+    no_grad_inputs=("Label",),
+)
+def cross_entropy(ctx, x, label, soft_label=False, ignore_index=-100):
+    logp = jnp.log(jnp.clip(x, 1e-20, None))
+    if soft_label:
+        return -jnp.sum(label * logp, axis=-1, keepdims=True)
+    picked = _take_label(logp, label, x.ndim - 1)
+    loss = -picked
+    if ignore_index >= 0:
+        lab = label if label.ndim == loss.ndim else jnp.expand_dims(label, -1)
+        loss = jnp.where(lab == ignore_index, 0.0, loss)
+    return loss
+
+
+@register_op(
+    "cross_entropy2",
+    inputs=("X", "Label"),
+    outputs=("Y", "XShape", "MatchX"),
+    attrs={"ignore_index": -100},
+    no_grad_inputs=("Label",),
+)
+def cross_entropy2(ctx, x, label, ignore_index=-100):
+    logp = jnp.log(jnp.clip(x, 1e-20, None))
+    picked = _take_label(logp, label, x.ndim - 1)
+    return -picked, None, jnp.exp(picked)
+
+
+@register_op(
+    "sigmoid_cross_entropy_with_logits",
+    inputs=("X", "Label"),
+    outputs=("Out",),
+    attrs={"ignore_index": -100, "normalize": False},
+    no_grad_inputs=("Label",),
+)
+def sigmoid_cross_entropy_with_logits(ctx, x, label, ignore_index=-100,
+                                      normalize=False):
+    loss = jnp.maximum(x, 0.0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    mask = label != ignore_index
+    loss = jnp.where(mask, loss, 0.0)
+    if normalize:
+        loss = loss / jnp.maximum(jnp.sum(mask.astype(loss.dtype)), 1.0)
+    return loss
+
+
+@register_op(
+    "huber_loss",
+    inputs=("X", "Y"),
+    outputs=("Residual", "Out"),
+    attrs={"delta": 1.0},
+    no_grad_inputs=("Y",),
+)
+def huber_loss(ctx, x, y, delta=1.0):
+    r = y - x
+    ar = jnp.abs(r)
+    out = jnp.where(ar <= delta, 0.5 * r * r, delta * (ar - 0.5 * delta))
+    return r, out
+
+
+@register_op(
+    "smooth_l1_loss",
+    inputs=("X", "Y", "InsideWeight", "OutsideWeight"),
+    outputs=("Diff", "Out"),
+    attrs={"sigma": 1.0},
+    optional_inputs=("InsideWeight", "OutsideWeight"),
+    no_grad_inputs=("Y", "InsideWeight", "OutsideWeight"),
+)
+def smooth_l1_loss(ctx, x, y, iw, ow, sigma=1.0):
+    s2 = sigma * sigma
+    diff = x - y
+    if iw is not None:
+        diff = diff * iw
+    ad = jnp.abs(diff)
+    val = jnp.where(ad < 1.0 / s2, 0.5 * s2 * diff * diff, ad - 0.5 / s2)
+    if ow is not None:
+        val = val * ow
+    out = jnp.sum(val.reshape(val.shape[0], -1), axis=1, keepdims=True)
+    return diff, out
+
+
+@register_op(
+    "kldiv_loss",
+    inputs=("X", "Target"),
+    outputs=("Loss",),
+    attrs={"reduction": "mean"},
+    no_grad_inputs=("Target",),
+)
+def kldiv_loss(ctx, x, target, reduction="mean"):
+    loss = jnp.where(target > 0, target * (jnp.log(target) - x), 0.0)
+    if reduction == "mean":
+        return jnp.mean(loss).reshape((1,))
+    if reduction == "sum":
+        return jnp.sum(loss).reshape((1,))
+    if reduction == "batchmean":
+        return (jnp.sum(loss) / x.shape[0]).reshape((1,))
+    return loss
+
+
+@register_op(
+    "log_loss",
+    inputs=("Predicted", "Labels"),
+    outputs=("Loss",),
+    attrs={"epsilon": 1e-4},
+    no_grad_inputs=("Labels",),
+)
+def log_loss(ctx, pred, label, epsilon=1e-4):
+    return -label * jnp.log(pred + epsilon) - (1.0 - label) * jnp.log(
+        1.0 - pred + epsilon
+    )
+
+
+@register_op(
+    "mse_loss",
+    inputs=("X", "Y"),
+    outputs=("Out",),
+    no_grad_inputs=("Y",),
+)
+def mse_loss(ctx, x, y):
+    return jnp.square(x - y)

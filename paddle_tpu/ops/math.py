@@ -1,0 +1,225 @@
+"""Dense math ops: mul/matmul, elementwise family, reductions, scale/sum.
+
+Parity targets: mul_op.cc, matmul_op.cc, elementwise/*.cc, reduce_ops/*.cc,
+scale_op.cc, sum_op.cc, mean_op.cc, clip_op.cc (paddle/fluid/operators/).
+All map onto the MXU via jnp dot/matmul; grads come from the auto vjp maker.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import bcast_y
+
+
+def _flatten2d(x, num_col_dims):
+    lead = 1
+    for d in x.shape[:num_col_dims]:
+        lead *= d
+    rest = 1
+    for d in x.shape[num_col_dims:]:
+        rest *= d
+    return jnp.reshape(x, (lead, rest))
+
+
+@register_op(
+    "mul",
+    inputs=("X", "Y"),
+    outputs=("Out",),
+    attrs={"x_num_col_dims": 1, "y_num_col_dims": 1,
+           "scale_x": 1.0, "scale_y": [1.0], "scale_out": 1.0,
+           "force_fp32_output": False},
+)
+def mul(ctx, x, y, x_num_col_dims=1, y_num_col_dims=1, **_):
+    """out[i, j] = sum_k x2d[i,k] y2d[k,j], with fluid's flatten-to-2D rule
+    (mul_op.cc:37); output keeps the unflattened leading/trailing dims."""
+    x2d = _flatten2d(x, x_num_col_dims)
+    y2d = _flatten2d(y, y_num_col_dims)
+    out = jnp.dot(x2d, y2d, preferred_element_type=None)
+    out_shape = x.shape[:x_num_col_dims] + y.shape[y_num_col_dims:]
+    return jnp.reshape(out, out_shape)
+
+
+@register_op(
+    "matmul",
+    inputs=("X", "Y"),
+    outputs=("Out",),
+    attrs={"transpose_X": False, "transpose_Y": False, "alpha": 1.0,
+           "head_number": 1},
+)
+def matmul(ctx, x, y, transpose_X=False, transpose_Y=False, alpha=1.0,
+           head_number=1):
+    def t(a, flag):
+        if not flag:
+            return a
+        if a.ndim == 1:
+            return a
+        perm = list(range(a.ndim))
+        perm[-1], perm[-2] = perm[-2], perm[-1]
+        return jnp.transpose(a, perm)
+
+    x_, y_ = t(x, transpose_X), t(y, transpose_Y)
+    # fluid allows [K] vectors: matmul handles 1-D semantics like numpy
+    out = jnp.matmul(x_, y_)
+    if alpha != 1.0:
+        out = out * jnp.asarray(alpha, dtype=out.dtype)
+    return out
+
+
+@register_op(
+    "matmul_v2",
+    inputs=("X", "Y"),
+    outputs=("Out",),
+    attrs={"trans_x": False, "trans_y": False},
+)
+def matmul_v2(ctx, x, y, trans_x=False, trans_y=False):
+    return matmul(ctx, x, y, transpose_X=trans_x, transpose_Y=trans_y)
+
+
+def _register_elementwise(name, fn):
+    @register_op(
+        "elementwise_" + name,
+        inputs=("X", "Y"),
+        outputs=("Out",),
+        attrs={"axis": -1},
+    )
+    def _low(ctx, x, y, axis=-1, _fn=fn):
+        yb = bcast_y(x, y, axis)
+        return _fn(x, yb)
+
+    return _low
+
+
+_register_elementwise("add", jnp.add)
+_register_elementwise("sub", jnp.subtract)
+_register_elementwise("mul", jnp.multiply)
+_register_elementwise("div", jnp.divide)
+_register_elementwise("max", jnp.maximum)
+_register_elementwise("min", jnp.minimum)
+_register_elementwise("pow", jnp.power)
+_register_elementwise("mod", jnp.mod)
+_register_elementwise("floordiv", jnp.floor_divide)
+
+
+@register_op("scale", inputs=("X", "ScaleTensor"), outputs=("Out",),
+             attrs={"scale": 1.0, "bias": 0.0, "bias_after_scale": True},
+             optional_inputs=("ScaleTensor",))
+def scale(ctx, x, scale_tensor, scale=1.0, bias=0.0, bias_after_scale=True):
+    s = scale_tensor.reshape(()) if scale_tensor is not None else jnp.asarray(
+        scale, dtype=x.dtype)
+    b = jnp.asarray(bias, dtype=x.dtype)
+    if bias_after_scale:
+        return x * s + b
+    return (x + b) * s
+
+
+@register_op("sum", inputs=("X",), outputs=("Out",),
+             duplicable_inputs=("X",))
+def sum_op(ctx, xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+@register_op("mean", inputs=("X",), outputs=("Out",))
+def mean(ctx, x):
+    return jnp.mean(x).reshape((1,))
+
+
+def _reduce_dims(x, dim, reduce_all):
+    if reduce_all or dim is None or len(dim) == 0:
+        return None
+    return tuple(d if d >= 0 else d + x.ndim for d in dim)
+
+
+def _register_reduce(name, fn):
+    @register_op(
+        "reduce_" + name,
+        inputs=("X",),
+        outputs=("Out",),
+        attrs={"dim": [0], "keep_dim": False, "reduce_all": False},
+    )
+    def _low(ctx, x, dim=(0,), keep_dim=False, reduce_all=False, _fn=fn):
+        axes = _reduce_dims(x, dim, reduce_all)
+        out = _fn(x, axis=axes, keepdims=keep_dim)
+        if out.ndim == 0:
+            out = out.reshape((1,))
+        return out
+
+    return _low
+
+
+_register_reduce("sum", jnp.sum)
+_register_reduce("mean", jnp.mean)
+_register_reduce("max", jnp.max)
+_register_reduce("min", jnp.min)
+_register_reduce("prod", jnp.prod)
+_register_reduce("all", jnp.all)
+_register_reduce("any", jnp.any)
+
+
+@register_op("clip", inputs=("X", "Min", "Max"), outputs=("Out",),
+             attrs={"min": 0.0, "max": 0.0},
+             optional_inputs=("Min", "Max"))
+def clip(ctx, x, min_t, max_t, min=0.0, max=0.0):
+    lo = min_t.reshape(()) if min_t is not None else min
+    hi = max_t.reshape(()) if max_t is not None else max
+    return jnp.clip(x, lo, hi)
+
+
+@register_op("clip_by_norm", inputs=("X",), outputs=("Out",),
+             attrs={"max_norm": 1.0})
+def clip_by_norm(ctx, x, max_norm=1.0):
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    scale = jnp.minimum(max_norm / jnp.maximum(norm, 1e-12), 1.0)
+    return x * scale
+
+
+@register_op("squared_l2_norm", inputs=("X",), outputs=("Out",))
+def squared_l2_norm(ctx, x):
+    return jnp.sum(jnp.square(x)).reshape((1,))
+
+
+@register_op("increment", inputs=("X",), outputs=("Out",),
+             attrs={"step": 1.0}, grad_maker=None)
+def increment(ctx, x, step=1.0):
+    return x + jnp.asarray(step, dtype=x.dtype)
+
+
+@register_op("p_norm", inputs=("X",), outputs=("Out",),
+             attrs={"porder": 2.0, "axis": -1, "epsilon": 1e-12,
+                    "keepdim": False, "asvector": False})
+def p_norm(ctx, x, porder=2.0, axis=-1, epsilon=1e-12, keepdim=False,
+           asvector=False):
+    if asvector:
+        x = x.reshape(-1)
+        axis = 0
+    return jnp.power(
+        jnp.sum(jnp.power(jnp.abs(x), porder), axis=axis, keepdims=keepdim)
+        + epsilon,
+        1.0 / porder,
+    )
+
+
+@register_op("dot", inputs=("X", "Y"), outputs=("Out",))
+def dot(ctx, x, y):
+    return jnp.sum(x * y, axis=-1, keepdims=True)
+
+
+@register_op("cumsum", inputs=("X",), outputs=("Out",),
+             attrs={"axis": -1, "flatten": False, "exclusive": False,
+                    "reverse": False})
+def cumsum(ctx, x, axis=-1, flatten=False, exclusive=False, reverse=False):
+    if flatten:
+        x = x.reshape(-1)
+        axis = 0
+    if reverse:
+        x = jnp.flip(x, axis)
+    out = jnp.cumsum(x, axis=axis)
+    if exclusive:
+        out = out - x
+    if reverse:
+        out = jnp.flip(out, axis)
+    return out

@@ -1,0 +1,173 @@
+"""Metric + comparison/logical ops.
+
+Parity: operators/metrics/ (accuracy_op.cc, auc_op.cc), top_k_op.cc,
+arg_max_op.cc, arg_min_op.cc, compare_op.cc, logical_op.cc, isfinite_op.cc.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+@register_op("top_k", inputs=("X", "K"), outputs=("Out", "Indices"),
+             attrs={"k": 1}, optional_inputs=("K",), grad_maker="auto")
+def top_k(ctx, x, k_t, k=1):
+    if k_t is not None:
+        k = int(k_t.reshape(()))  # requires concrete K on TPU
+    vals, idx = jax.lax.top_k(x, k)
+    return vals, idx.astype(jnp.int64)
+
+
+@register_op("top_k_v2", inputs=("X", "K"), outputs=("Out", "Indices"),
+             attrs={"k": 1, "axis": -1, "largest": True, "sorted": True},
+             optional_inputs=("K",))
+def top_k_v2(ctx, x, k_t, k=1, axis=-1, largest=True, sorted=True):
+    if k_t is not None:
+        k = int(k_t.reshape(()))
+    ax = axis if axis >= 0 else x.ndim + axis
+    moved = jnp.moveaxis(x, ax, -1)
+    if not largest:
+        moved = -moved
+    vals, idx = jax.lax.top_k(moved, k)
+    if not largest:
+        vals = -vals
+    return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(jnp.int64), -1, ax)
+
+
+@register_op("accuracy", inputs=("Out", "Indices", "Label"),
+             outputs=("Accuracy", "Correct", "Total"), grad_maker=None)
+def accuracy(ctx, out, indices, label):
+    n = indices.shape[0]
+    lab = label.reshape(n, 1)
+    correct = jnp.any(indices == lab, axis=1).sum()
+    return (
+        (correct / n).astype(jnp.float32).reshape((1,)),
+        correct.astype(jnp.int32).reshape((1,)),
+        jnp.asarray([n], dtype=jnp.int32),
+    )
+
+
+@register_op("arg_max", inputs=("X",), outputs=("Out",),
+             attrs={"axis": -1, "keepdims": False, "dtype": 3, "flatten": False},
+             grad_maker=None)
+def arg_max(ctx, x, axis=-1, keepdims=False, dtype=3, flatten=False):
+    if flatten:
+        x = x.reshape(-1)
+        axis = 0
+    return jnp.argmax(x, axis=axis, keepdims=keepdims).astype(jnp.int64)
+
+
+@register_op("arg_min", inputs=("X",), outputs=("Out",),
+             attrs={"axis": -1, "keepdims": False, "dtype": 3, "flatten": False},
+             grad_maker=None)
+def arg_min(ctx, x, axis=-1, keepdims=False, dtype=3, flatten=False):
+    if flatten:
+        x = x.reshape(-1)
+        axis = 0
+    return jnp.argmin(x, axis=axis, keepdims=keepdims).astype(jnp.int64)
+
+
+@register_op("argsort", inputs=("X",), outputs=("Out", "Indices"),
+             attrs={"axis": -1, "descending": False}, grad_maker=None)
+def argsort(ctx, x, axis=-1, descending=False):
+    idx = jnp.argsort(-x if descending else x, axis=axis)
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    return out, idx.astype(jnp.int64)
+
+
+def _register_compare(name, fn):
+    @register_op(name, inputs=("X", "Y"), outputs=("Out",),
+                 attrs={"axis": -1, "force_cpu": False}, grad_maker=None)
+    def _low(ctx, x, y, axis=-1, force_cpu=False, _fn=fn):
+        return _fn(x, y)
+
+    return _low
+
+
+_register_compare("equal", jnp.equal)
+_register_compare("not_equal", jnp.not_equal)
+_register_compare("less_than", jnp.less)
+_register_compare("less_equal", jnp.less_equal)
+_register_compare("greater_than", jnp.greater)
+_register_compare("greater_equal", jnp.greater_equal)
+
+
+def _register_logical(name, fn, binary=True):
+    if binary:
+        @register_op(name, inputs=("X", "Y"), outputs=("Out",), grad_maker=None)
+        def _low(ctx, x, y, _fn=fn):
+            return _fn(x, y)
+    else:
+        @register_op(name, inputs=("X",), outputs=("Out",), grad_maker=None)
+        def _low(ctx, x, _fn=fn):
+            return _fn(x)
+    return _low
+
+
+_register_logical("logical_and", jnp.logical_and)
+_register_logical("logical_or", jnp.logical_or)
+_register_logical("logical_xor", jnp.logical_xor)
+_register_logical("logical_not", jnp.logical_not, binary=False)
+
+
+@register_op("isfinite", inputs=("X",), outputs=("Out",), grad_maker=None,
+             duplicable_inputs=("X",))
+def isfinite(ctx, xs):
+    ok = jnp.asarray(True)
+    for x in xs:
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(x)))
+    return ok.reshape((1,))
+
+
+@register_op("isfinite_v2", inputs=("X",), outputs=("Out",), grad_maker=None)
+def isfinite_v2(ctx, x):
+    return jnp.isfinite(x)
+
+
+@register_op("isnan_v2", inputs=("X",), outputs=("Out",), grad_maker=None)
+def isnan_v2(ctx, x):
+    return jnp.isnan(x)
+
+
+@register_op("isinf_v2", inputs=("X",), outputs=("Out",), grad_maker=None)
+def isinf_v2(ctx, x):
+    return jnp.isinf(x)
+
+
+@register_op(
+    "auc",
+    inputs=("Predict", "Label", "StatPos", "StatNeg"),
+    outputs=("AUC", "StatPosOut", "StatNegOut"),
+    attrs={"curve": "ROC", "num_thresholds": 4095, "slide_steps": 1},
+    grad_maker=None,
+)
+def auc(ctx, predict, label, stat_pos, stat_neg, curve="ROC",
+        num_thresholds=4095, slide_steps=1):
+    """Streaming AUC via threshold buckets (metrics/auc_op.h)."""
+    pos_prob = predict[:, 1] if predict.ndim == 2 and predict.shape[1] == 2 else predict.reshape(-1)
+    bucket = jnp.clip(
+        (pos_prob * num_thresholds).astype(jnp.int32), 0, num_thresholds
+    )
+    lab = label.reshape(-1).astype(jnp.int32)
+    pos_hist = jnp.zeros(num_thresholds + 1, jnp.int64).at[bucket].add(lab)
+    neg_hist = jnp.zeros(num_thresholds + 1, jnp.int64).at[bucket].add(1 - lab)
+    new_pos = stat_pos + pos_hist
+    new_neg = stat_neg + neg_hist
+    # integrate: sum over thresholds of tp/fp trapezoid
+    tp = jnp.cumsum(new_pos[::-1])[::-1].astype(jnp.float64)
+    fp = jnp.cumsum(new_neg[::-1])[::-1].astype(jnp.float64)
+    tot_pos = tp[0]
+    tot_neg = fp[0]
+    # pairs: area via rank-sum equivalent
+    neg_below = jnp.cumsum(new_neg) - new_neg
+    auc_val = jnp.sum(
+        new_pos.astype(jnp.float64)
+        * (neg_below.astype(jnp.float64) + new_neg.astype(jnp.float64) * 0.5)
+    )
+    denom = jnp.maximum(tot_pos * tot_neg, 1.0)
+    return (
+        (auc_val / denom).astype(jnp.float64).reshape((1,)),
+        new_pos,
+        new_neg,
+    )

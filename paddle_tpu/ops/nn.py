@@ -1,0 +1,544 @@
+"""NN ops: conv2d, pooling, batch/layer/group/instance norm, dropout,
+interpolation.
+
+Parity: conv_op.cc (+conv_cudnn_op.cu), pool_op.cc, batch_norm_op.cc,
+layer_norm_op.cc, group_norm_op.cc, instance_norm_op.cc, dropout_op.cc,
+label_smooth_op.cc, interpolate_op.cc, unfold_op.cc, pixel_shuffle_op.cc
+(paddle/fluid/operators/).  Convs lower to lax.conv_general_dilated (MXU);
+norms are jnp compositions XLA fuses; dropout uses functional PRNG with an
+explicit Mask output so the grad replays exactly.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import GradOpDesc, register_op
+from ..framework import _grad_var_name
+from .common import attr_dtype, dtype_enum
+
+
+# -- conv --------------------------------------------------------------------
+
+
+def _conv_dims(data_format):
+    if data_format in ("NCHW", "AnyLayout"):
+        return ("NCHW", "OIHW", "NCHW")
+    return ("NHWC", "HWIO", "NHWC")
+
+
+@register_op(
+    "conv2d",
+    inputs=("Input", "Filter"),
+    outputs=("Output",),
+    attrs={"strides": [1, 1], "paddings": [0, 0], "dilations": [1, 1],
+           "groups": 1, "data_format": "NCHW", "padding_algorithm": "EXPLICIT",
+           "use_cudnn": True, "use_mkldnn": False, "fuse_relu_before_depthwise_conv": False,
+           "workspace_size_MB": 512, "exhaustive_search": False},
+)
+def conv2d(ctx, x, w, strides=(1, 1), paddings=(0, 0), dilations=(1, 1),
+           groups=1, data_format="NCHW", padding_algorithm="EXPLICIT", **_):
+    if padding_algorithm == "SAME":
+        pad = "SAME"
+    elif padding_algorithm == "VALID":
+        pad = "VALID"
+    else:
+        p = list(paddings)
+        if len(p) == 2:
+            pad = [(p[0], p[0]), (p[1], p[1])]
+        else:  # [top, bottom, left, right]
+            pad = [(p[0], p[1]), (p[2], p[3])]
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, _conv_dims(data_format))
+    return lax.conv_general_dilated(
+        x, w,
+        window_strides=tuple(strides),
+        padding=pad,
+        rhs_dilation=tuple(dilations),
+        dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
+    ).astype(x.dtype)
+
+
+@register_op(
+    "depthwise_conv2d",
+    inputs=("Input", "Filter"),
+    outputs=("Output",),
+    attrs={"strides": [1, 1], "paddings": [0, 0], "dilations": [1, 1],
+           "groups": 1, "data_format": "NCHW", "padding_algorithm": "EXPLICIT",
+           "use_cudnn": False},
+)
+def depthwise_conv2d(ctx, x, w, strides=(1, 1), paddings=(0, 0),
+                     dilations=(1, 1), groups=1, data_format="NCHW",
+                     padding_algorithm="EXPLICIT", **_):
+    return conv2d(ctx, x, w, strides, paddings, dilations, groups,
+                  data_format, padding_algorithm)
+
+
+@register_op(
+    "conv2d_transpose",
+    inputs=("Input", "Filter"),
+    outputs=("Output",),
+    attrs={"strides": [1, 1], "paddings": [0, 0], "dilations": [1, 1],
+           "groups": 1, "data_format": "NCHW", "output_size": [],
+           "padding_algorithm": "EXPLICIT", "use_cudnn": True},
+)
+def conv2d_transpose(ctx, x, w, strides=(1, 1), paddings=(0, 0),
+                     dilations=(1, 1), groups=1, data_format="NCHW",
+                     output_size=(), padding_algorithm="EXPLICIT", **_):
+    # filter layout IOHW (fluid conv2d_transpose: [in_c, out_c/g, kh, kw])
+    p = list(paddings)
+    pads = [(p[0], p[0]), (p[1], p[1])] if len(p) == 2 else [
+        (p[0], p[1]), (p[2], p[3])
+    ]
+    kh, kw = w.shape[2], w.shape[3]
+    sh, sw = strides
+    # transpose conv = lhs-dilated conv with flipped kernel
+    wt = jnp.flip(w, axis=(2, 3))  # IOHW flipped
+    wt = jnp.swapaxes(wt, 0, 1)  # -> OIHW with O=out_c/g*g? handle groups=1
+    dn = lax.conv_dimension_numbers(x.shape, wt.shape, ("NCHW", "OIHW", "NCHW"))
+    out = lax.conv_general_dilated(
+        x, wt,
+        window_strides=(1, 1),
+        padding=[(kh - 1 - pads[0][0], kh - 1 - pads[0][1]),
+                 (kw - 1 - pads[1][0], kw - 1 - pads[1][1])],
+        lhs_dilation=(sh, sw),
+        rhs_dilation=tuple(dilations),
+        dimension_numbers=dn,
+        feature_group_count=groups,
+    )
+    return out
+
+
+# -- pooling -----------------------------------------------------------------
+
+
+@register_op(
+    "pool2d",
+    inputs=("X",),
+    outputs=("Out",),
+    attrs={"pooling_type": "max", "ksize": [1, 1], "strides": [1, 1],
+           "paddings": [0, 0], "global_pooling": False, "ceil_mode": False,
+           "exclusive": True, "adaptive": False, "data_format": "NCHW",
+           "padding_algorithm": "EXPLICIT", "use_cudnn": True},
+)
+def pool2d(ctx, x, pooling_type="max", ksize=(1, 1), strides=(1, 1),
+           paddings=(0, 0), global_pooling=False, ceil_mode=False,
+           exclusive=True, adaptive=False, data_format="NCHW", **_):
+    nchw = data_format in ("NCHW", "AnyLayout")
+    h_ax, w_ax = (2, 3) if nchw else (1, 2)
+    if global_pooling:
+        if pooling_type == "max":
+            return jnp.max(x, axis=(h_ax, w_ax), keepdims=True)
+        return jnp.mean(x, axis=(h_ax, w_ax), keepdims=True)
+    if adaptive:
+        oh, ow = int(ksize[0]), int(ksize[1])
+        H, W = x.shape[h_ax], x.shape[w_ax]
+        if H % oh or W % ow:
+            raise NotImplementedError(
+                "adaptive pool needs divisible sizes on TPU (static shapes)"
+            )
+        fh, fw = H // oh, W // ow
+        if nchw:
+            r = x.reshape(x.shape[0], x.shape[1], oh, fh, ow, fw)
+            return (jnp.max(r, axis=(3, 5)) if pooling_type == "max"
+                    else jnp.mean(r, axis=(3, 5)))
+        r = x.reshape(x.shape[0], oh, fh, ow, fw, x.shape[3])
+        return (jnp.max(r, axis=(2, 4)) if pooling_type == "max"
+                else jnp.mean(r, axis=(2, 4)))
+
+    kh, kw = int(ksize[0]), int(ksize[1])
+    sh, sw = int(strides[0]), int(strides[1])
+    ph, pw = int(paddings[0]), int(paddings[1])
+    if ceil_mode:
+        H, W = x.shape[h_ax], x.shape[w_ax]
+        extra_h = -(H + 2 * ph - kh) % sh
+        extra_w = -(W + 2 * pw - kw) % sw
+        pad_h = (ph, ph + extra_h)
+        pad_w = (pw, pw + extra_w)
+    else:
+        pad_h, pad_w = (ph, ph), (pw, pw)
+    if nchw:
+        window = (1, 1, kh, kw)
+        strides_ = (1, 1, sh, sw)
+        pads = ((0, 0), (0, 0), pad_h, pad_w)
+    else:
+        window = (1, kh, kw, 1)
+        strides_ = (1, sh, sw, 1)
+        pads = ((0, 0), pad_h, pad_w, (0, 0))
+    if pooling_type == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, jnp.asarray(init, x.dtype), lax.max,
+                                 window, strides_, pads)
+    s = lax.reduce_window(x, jnp.asarray(0.0, x.dtype), lax.add, window,
+                          strides_, pads)
+    if exclusive and (pad_h != (0, 0) or pad_w != (0, 0)):
+        ones = jnp.ones_like(x)
+        cnt = lax.reduce_window(ones, jnp.asarray(0.0, x.dtype), lax.add,
+                                window, strides_, pads)
+        return s / cnt
+    return s / (kh * kw)
+
+
+# -- normalization -----------------------------------------------------------
+
+
+def _bn_grad_maker(op, no_grad_set):
+    """batch_norm grad: differentiate through Y only (running stats are
+    stop-gradient); uses SavedMean/SavedVariance like batch_norm_grad op."""
+    inputs = {
+        "X": list(op.input("X")),
+        "Scale": list(op.input("Scale")),
+        "Bias": list(op.input("Bias")),
+        "SavedMean": list(op.output("SavedMean")),
+        "SavedVariance": list(op.output("SavedVariance")),
+        "GRAD@Y": [_grad_var_name(op.output("Y")[0])],
+    }
+    outputs = {}
+    for slot in ("X", "Scale", "Bias"):
+        n = op.input(slot)[0]
+        if n not in no_grad_set:
+            outputs["X@" + slot] = [_grad_var_name(n)]
+    if not outputs:
+        return []
+    return [GradOpDesc("batch_norm_grad", inputs, outputs, dict(op.attrs))]
+
+
+@register_op(
+    "batch_norm",
+    inputs=("X", "Scale", "Bias", "Mean", "Variance"),
+    outputs=("Y", "MeanOut", "VarianceOut", "SavedMean", "SavedVariance",
+             "ReserveSpace"),
+    attrs={"momentum": 0.9, "epsilon": 1e-5, "is_test": False,
+           "data_layout": "NCHW", "use_global_stats": False,
+           "trainable_statistics": False, "fuse_with_relu": False},
+    grad_maker=_bn_grad_maker,
+)
+def batch_norm(ctx, x, scale, bias, mean, variance, momentum=0.9,
+               epsilon=1e-5, is_test=False, data_layout="NCHW",
+               use_global_stats=False, **_):
+    nchw = data_layout in ("NCHW", "AnyLayout")
+    axes = (0, 2, 3) if (nchw and x.ndim == 4) else tuple(
+        i for i in range(x.ndim) if i != (1 if nchw else x.ndim - 1)
+    )
+    cshape = [1] * x.ndim
+    c_ax = 1 if nchw else x.ndim - 1
+    cshape[c_ax] = x.shape[c_ax]
+
+    if is_test or use_global_stats:
+        m, v = mean, variance
+        new_mean, new_var = mean, variance
+        saved_mean = mean
+        saved_var = 1.0 / jnp.sqrt(variance + epsilon)
+    else:
+        m = jnp.mean(x, axis=axes)
+        v = jnp.var(x, axis=axes)
+        new_mean = momentum * mean + (1 - momentum) * m
+        new_var = momentum * variance + (1 - momentum) * v
+        saved_mean = m
+        saved_var = 1.0 / jnp.sqrt(v + epsilon)
+    inv = 1.0 / jnp.sqrt(v + epsilon)
+    y = (x - m.reshape(cshape)) * inv.reshape(cshape)
+    y = y * scale.reshape(cshape) + bias.reshape(cshape)
+    return y, new_mean, new_var, saved_mean, saved_var, None
+
+
+@register_op(
+    "batch_norm_grad",
+    inputs=("X", "Scale", "Bias", "SavedMean", "SavedVariance", "GRAD@Y"),
+    outputs=("X@X", "X@Scale", "X@Bias"),
+    attrs={"momentum": 0.9, "epsilon": 1e-5, "is_test": False,
+           "data_layout": "NCHW", "use_global_stats": False},
+    grad_maker=None,
+    optional_inputs=("GRAD@Y",),
+)
+def batch_norm_grad(ctx, x, scale, bias, saved_mean, saved_inv_std, dy,
+                    momentum=0.9, epsilon=1e-5, is_test=False,
+                    data_layout="NCHW", use_global_stats=False, **_):
+    nchw = data_layout in ("NCHW", "AnyLayout")
+    c_ax = 1 if nchw else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != c_ax)
+    cshape = [1] * x.ndim
+    cshape[c_ax] = x.shape[c_ax]
+    if dy is None:
+        dy = jnp.zeros_like(x)
+    n = 1
+    for i in axes:
+        n *= x.shape[i]
+    mu = saved_mean.reshape(cshape)
+    inv = saved_inv_std.reshape(cshape)
+    xhat = (x - mu) * inv
+    dscale = jnp.sum(dy * xhat, axis=axes)
+    dbias = jnp.sum(dy, axis=axes)
+    if is_test or use_global_stats:
+        dx = dy * scale.reshape(cshape) * inv
+    else:
+        dx = (
+            scale.reshape(cshape)
+            * inv
+            / n
+            * (n * dy - dbias.reshape(cshape) - xhat * dscale.reshape(cshape))
+        )
+    return dx, dscale, dbias
+
+
+@register_op(
+    "layer_norm",
+    inputs=("X", "Scale", "Bias"),
+    outputs=("Y", "Mean", "Variance"),
+    attrs={"epsilon": 1e-5, "begin_norm_axis": 1},
+    optional_inputs=("Scale", "Bias"),
+)
+def layer_norm(ctx, x, scale, bias, epsilon=1e-5, begin_norm_axis=1):
+    axes = tuple(range(begin_norm_axis, x.ndim))
+    m = jnp.mean(x, axis=axes, keepdims=True)
+    v = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - m) / jnp.sqrt(v + epsilon)
+    tail = x.shape[begin_norm_axis:]
+    if scale is not None:
+        y = y * scale.reshape(tail)
+    if bias is not None:
+        y = y + bias.reshape(tail)
+    lead = x.shape[:begin_norm_axis]
+    return y, m.reshape(lead), v.reshape(lead)
+
+
+@register_op(
+    "group_norm",
+    inputs=("X", "Scale", "Bias"),
+    outputs=("Y", "Mean", "Variance"),
+    attrs={"epsilon": 1e-5, "groups": 1, "data_layout": "NCHW"},
+    optional_inputs=("Scale", "Bias"),
+)
+def group_norm(ctx, x, scale, bias, epsilon=1e-5, groups=1,
+               data_layout="NCHW"):
+    N = x.shape[0]
+    if data_layout == "NCHW":
+        C = x.shape[1]
+        r = x.reshape(N, groups, C // groups, *x.shape[2:])
+        axes = tuple(range(2, r.ndim))
+        m = jnp.mean(r, axis=axes, keepdims=True)
+        v = jnp.var(r, axis=axes, keepdims=True)
+        y = ((r - m) / jnp.sqrt(v + epsilon)).reshape(x.shape)
+        cshape = (1, C) + (1,) * (x.ndim - 2)
+    else:
+        C = x.shape[-1]
+        r = x.reshape(N, *x.shape[1:-1], groups, C // groups)
+        axes = tuple(range(1, r.ndim - 2)) + (r.ndim - 1,)
+        m = jnp.mean(r, axis=axes, keepdims=True)
+        v = jnp.var(r, axis=axes, keepdims=True)
+        y = ((r - m) / jnp.sqrt(v + epsilon)).reshape(x.shape)
+        cshape = (1,) * (x.ndim - 1) + (C,)
+    if scale is not None:
+        y = y * scale.reshape(cshape)
+    if bias is not None:
+        y = y + bias.reshape(cshape)
+    return y, m.reshape(N, groups), v.reshape(N, groups)
+
+
+@register_op(
+    "instance_norm",
+    inputs=("X", "Scale", "Bias"),
+    outputs=("Y", "SavedMean", "SavedVariance"),
+    attrs={"epsilon": 1e-5},
+    optional_inputs=("Scale", "Bias"),
+)
+def instance_norm(ctx, x, scale, bias, epsilon=1e-5):
+    axes = tuple(range(2, x.ndim))
+    m = jnp.mean(x, axis=axes, keepdims=True)
+    v = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - m) / jnp.sqrt(v + epsilon)
+    cshape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+    if scale is not None:
+        y = y * scale.reshape(cshape)
+    if bias is not None:
+        y = y + bias.reshape(cshape)
+    return y, jnp.squeeze(m, axes), 1.0 / jnp.sqrt(jnp.squeeze(v, axes) + epsilon)
+
+
+@register_op(
+    "norm",
+    inputs=("X",),
+    outputs=("Norm", "Out"),
+    attrs={"axis": 1, "epsilon": 1e-10},
+)
+def norm(ctx, x, axis=1, epsilon=1e-10):
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + epsilon)
+    return norm, x / norm
+
+
+# -- dropout -----------------------------------------------------------------
+
+
+def _dropout_grad_maker(op, no_grad_set):
+    x = op.input("X")[0]
+    if x in no_grad_set:
+        return []
+    return [
+        GradOpDesc(
+            "dropout_grad",
+            {"Mask": list(op.output("Mask")),
+             "GRAD@Out": [_grad_var_name(op.output("Out")[0])]},
+            {"X@X": [_grad_var_name(x)]},
+            dict(op.attrs),
+        )
+    ]
+
+
+@register_op(
+    "dropout",
+    inputs=("X",),
+    outputs=("Out", "Mask"),
+    attrs={"dropout_prob": 0.5, "is_test": False, "fix_seed": False,
+           "seed": 0, "dropout_implementation": "downgrade_in_infer"},
+    grad_maker=_dropout_grad_maker,
+    n_rng=1,
+)
+def dropout(ctx, x, dropout_prob=0.5, is_test=False, fix_seed=False, seed=0,
+            dropout_implementation="downgrade_in_infer", **_):
+    if is_test:
+        if dropout_implementation == "upscale_in_train":
+            return x, jnp.ones_like(x, dtype=jnp.uint8)
+        return x * (1.0 - dropout_prob), jnp.ones_like(x, dtype=jnp.uint8)
+    key = jax.random.key(seed) if fix_seed else ctx.rng()
+    keep = jax.random.bernoulli(key, 1.0 - dropout_prob, x.shape)
+    mask = keep.astype(jnp.uint8)
+    if dropout_implementation == "upscale_in_train":
+        out = jnp.where(keep, x / (1.0 - dropout_prob), 0.0)
+    else:
+        out = jnp.where(keep, x, 0.0)
+    return out, mask
+
+
+@register_op(
+    "dropout_grad",
+    inputs=("Mask", "GRAD@Out"),
+    outputs=("X@X",),
+    attrs={"dropout_prob": 0.5, "is_test": False, "fix_seed": False,
+           "seed": 0, "dropout_implementation": "downgrade_in_infer"},
+    grad_maker=None,
+)
+def dropout_grad(ctx, mask, dy, dropout_prob=0.5, is_test=False,
+                 dropout_implementation="downgrade_in_infer", **_):
+    m = mask.astype(dy.dtype)
+    if dropout_implementation == "upscale_in_train":
+        return dy * m / (1.0 - dropout_prob)
+    return dy * m
+
+
+@register_op(
+    "label_smooth",
+    inputs=("X", "PriorDist"),
+    outputs=("Out",),
+    attrs={"epsilon": 0.1},
+    optional_inputs=("PriorDist",),
+)
+def label_smooth(ctx, x, prior, epsilon=0.1):
+    k = x.shape[-1]
+    if prior is not None:
+        return (1.0 - epsilon) * x + epsilon * prior.reshape((1,) * (x.ndim - 1) + (k,))
+    return (1.0 - epsilon) * x + epsilon / k
+
+
+# -- interpolation / layout --------------------------------------------------
+
+
+def _interp(x, out_h, out_w, method, data_layout):
+    nchw = data_layout in ("NCHW", "AnyLayout")
+    if nchw:
+        shape = (x.shape[0], x.shape[1], out_h, out_w)
+    else:
+        shape = (x.shape[0], out_h, out_w, x.shape[3])
+    return jax.image.resize(x, shape, method=method)
+
+
+@register_op(
+    "bilinear_interp",
+    inputs=("X", "OutSize", "SizeTensor", "Scale"),
+    outputs=("Out",),
+    attrs={"out_h": -1, "out_w": -1, "align_corners": True, "align_mode": 1,
+           "data_layout": "NCHW", "interp_method": "bilinear", "scale": 0.0},
+    optional_inputs=("OutSize", "SizeTensor", "Scale"),
+    duplicable_inputs=("SizeTensor",),
+)
+def bilinear_interp(ctx, x, out_size, size_tensor, scale_t, out_h=-1,
+                    out_w=-1, align_corners=True, align_mode=1,
+                    data_layout="NCHW", scale=0.0, **_):
+    if scale and out_h < 0:
+        out_h = int(x.shape[2] * scale)
+        out_w = int(x.shape[3] * scale)
+    return _interp(x, out_h, out_w, "bilinear", data_layout)
+
+
+@register_op(
+    "nearest_interp",
+    inputs=("X", "OutSize", "SizeTensor", "Scale"),
+    outputs=("Out",),
+    attrs={"out_h": -1, "out_w": -1, "align_corners": True,
+           "data_layout": "NCHW", "interp_method": "nearest", "scale": 0.0},
+    optional_inputs=("OutSize", "SizeTensor", "Scale"),
+    duplicable_inputs=("SizeTensor",),
+)
+def nearest_interp(ctx, x, out_size, size_tensor, scale_t, out_h=-1,
+                   out_w=-1, align_corners=True, data_layout="NCHW",
+                   scale=0.0, **_):
+    if scale and out_h < 0:
+        out_h = int(x.shape[2] * scale)
+        out_w = int(x.shape[3] * scale)
+    return _interp(x, out_h, out_w, "nearest", data_layout)
+
+
+@register_op(
+    "unfold",
+    inputs=("X",),
+    outputs=("Y",),
+    attrs={"kernel_sizes": [1, 1], "strides": [1, 1],
+           "paddings": [0, 0, 0, 0], "dilations": [1, 1]},
+)
+def unfold(ctx, x, kernel_sizes=(1, 1), strides=(1, 1),
+           paddings=(0, 0, 0, 0), dilations=(1, 1)):
+    patches = lax.conv_general_dilated_patches(
+        x,
+        filter_shape=tuple(kernel_sizes),
+        window_strides=tuple(strides),
+        padding=[(paddings[0], paddings[2]), (paddings[1], paddings[3])],
+        rhs_dilation=tuple(dilations),
+        dimension_numbers=lax.conv_dimension_numbers(
+            x.shape, (1, 1) + tuple(kernel_sizes), ("NCHW", "OIHW", "NCHW")
+        ),
+    )
+    n, ckk, oh, ow = patches.shape
+    return patches.reshape(n, ckk, oh * ow)
+
+
+@register_op(
+    "pixel_shuffle",
+    inputs=("X",),
+    outputs=("Out",),
+    attrs={"upscale_factor": 1},
+)
+def pixel_shuffle(ctx, x, upscale_factor=1):
+    n, c, h, w = x.shape
+    r = upscale_factor
+    out = x.reshape(n, c // (r * r), r, r, h, w)
+    out = jnp.transpose(out, (0, 1, 4, 2, 5, 3))
+    return out.reshape(n, c // (r * r), h * r, w * r)
+
+
+@register_op(
+    "uniform_random_batch_size_like",
+    inputs=("Input",),
+    outputs=("Out",),
+    attrs={"shape": [], "input_dim_idx": 0, "output_dim_idx": 0,
+           "min": -1.0, "max": 1.0, "seed": 0, "dtype": 5},
+    grad_maker=None,
+    n_rng=1,
+)
+def uniform_random_batch_size_like(ctx, input, shape=(), input_dim_idx=0,
+                                   output_dim_idx=0, min=-1.0, max=1.0,
+                                   seed=0, dtype=5):
+    out_shape = list(int(s) for s in shape)
+    out_shape[output_dim_idx] = input.shape[input_dim_idx]
+    key = jax.random.key(seed) if seed else ctx.rng()
+    return jax.random.uniform(key, tuple(out_shape), dtype=attr_dtype(dtype),
+                              minval=min, maxval=max)

@@ -1,0 +1,720 @@
+"""Optimizers (parity: python/paddle/fluid/optimizer.py, 21 classes).
+
+`minimize` = append_backward + regularization/clip + per-param update ops,
+exactly the reference's structure; the update ops themselves are fused XLA
+computations (ops/optimizer_ops.py) that run inside the same compiled step
+as forward/backward — no separate optimizer kernel launches.
+"""
+
+import numpy as np
+
+from .backward import append_backward
+from .framework import Parameter, Variable, default_main_program, default_startup_program
+from .initializer import Constant
+from .layer_helper import LayerHelper
+from .utils import unique_name
+
+__all__ = [
+    "SGD",
+    "Momentum",
+    "Adagrad",
+    "Adam",
+    "Adamax",
+    "DecayedAdagrad",
+    "Adadelta",
+    "RMSProp",
+    "Ftrl",
+    "Lamb",
+    "SGDOptimizer",
+    "MomentumOptimizer",
+    "AdagradOptimizer",
+    "AdamOptimizer",
+    "AdamaxOptimizer",
+    "DecayedAdagradOptimizer",
+    "AdadeltaOptimizer",
+    "RMSPropOptimizer",
+    "FtrlOptimizer",
+    "LambOptimizer",
+    "LarsMomentum",
+    "LarsMomentumOptimizer",
+    "ExponentialMovingAverage",
+    "ModelAverage",
+    "RecomputeOptimizer",
+    "LookaheadOptimizer",
+]
+
+
+class Optimizer:
+    def __init__(self, learning_rate, regularization=None, name=None,
+                 grad_clip=None):
+        self._learning_rate = learning_rate
+        self.regularization = regularization
+        self._grad_clip = grad_clip
+        self._name = name
+        self._learning_rate_map = {}
+        self._accumulators = {}  # accum_name -> {param_name: var}
+        self.helper = None
+        self.type = getattr(self, "type", "optimizer")
+
+    # -- learning rate -------------------------------------------------------
+    def _create_global_learning_rate(self):
+        program = default_main_program()
+        lr = self._learning_rate_map.get(program)
+        if lr is not None:
+            return
+        if isinstance(self._learning_rate, Variable):
+            self._learning_rate_map[program] = self._learning_rate
+            return
+        lr_name = unique_name.generate("learning_rate")
+        lr_var = program.global_block().create_var(
+            name=lr_name, shape=(1,), dtype="float32", persistable=True
+        )
+        lr_var.stop_gradient = True
+        Constant(float(self._learning_rate))(lr_var)
+        self._learning_rate_map[program] = lr_var
+
+    def _global_learning_rate(self, program=None):
+        program = program or default_main_program()
+        return self._learning_rate_map.get(program)
+
+    def _create_param_lr(self, param_and_grad):
+        param = param_and_grad[0]
+        base = self._global_learning_rate()
+        param_lr = param.optimize_attr.get("learning_rate", 1.0)
+        if param_lr == 1.0:
+            return base
+        from . import layers
+
+        with default_main_program()._lr_schedule_guard():
+            return layers.scale(base, scale=float(param_lr))
+
+    @property
+    def current_step_lr(self):
+        from .core.executor import global_scope
+
+        lr = self._global_learning_rate()
+        if lr is None:
+            return self._learning_rate
+        t = global_scope().find_var(lr.name)
+        return float(np.asarray(t.get_tensor().numpy())[0]) if t else None
+
+    # -- accumulators --------------------------------------------------------
+    def _add_accumulator(self, name, param, dtype=None, fill_value=0.0,
+                        shape=None):
+        accum = self._accumulators.setdefault(name, {})
+        if param.name in accum:
+            return accum[param.name]
+        var = default_main_program().global_block().create_var(
+            name=unique_name.generate("%s_%s" % (param.name, name)),
+            shape=shape if shape is not None else param.shape,
+            dtype=dtype or param.dtype,
+            persistable=True,
+        )
+        var.stop_gradient = True
+        Constant(float(fill_value))(var)
+        accum[param.name] = var
+        return var
+
+    def _get_accumulator(self, name, param):
+        return self._accumulators[name][param.name]
+
+    # -- main ---------------------------------------------------------------
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, grad_clip=None):
+        params_grads = self.backward(loss, startup_program, parameter_list,
+                                     no_grad_set)
+        optimize_ops = self.apply_optimize(loss, startup_program, params_grads)
+        return optimize_ops, params_grads
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return append_backward(loss, parameter_list, no_grad_set, callbacks)
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        return self.apply_gradients(params_grads)
+
+    def apply_gradients(self, params_grads):
+        from .clip import append_gradient_clip_ops
+        from .regularizer import append_regularization_ops
+
+        params_grads = sorted(params_grads, key=lambda x: x[0].name)
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        else:
+            params_grads = append_gradient_clip_ops(params_grads)
+        params_grads = append_regularization_ops(params_grads,
+                                                 self.regularization)
+        return self._create_optimization_pass(params_grads)
+
+    def _create_optimization_pass(self, params_grads):
+        program = default_main_program()
+        self.helper = LayerHelper(self.__class__.__name__)
+        self._create_global_learning_rate()
+        self._create_accumulators(
+            program.global_block(), [p for p, g in params_grads if g is not None]
+        )
+        optimize_ops = []
+        for param_and_grad in params_grads:
+            if param_and_grad[1] is None:
+                continue
+            if not param_and_grad[0].trainable:
+                continue
+            with program._optimized_guard(param_and_grad):
+                op = self._append_optimize_op(program.global_block(),
+                                              param_and_grad)
+                optimize_ops.append(op)
+        self._finish_update(program.global_block(), params_grads)
+        return optimize_ops
+
+    def _create_accumulators(self, block, parameters):
+        pass
+
+    def _finish_update(self, block, params_grads):
+        pass
+
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError
+
+
+class SGDOptimizer(Optimizer):
+    type = "sgd"
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        return block.append_op(
+            type="sgd",
+            inputs={
+                "Param": [param],
+                "Grad": [grad],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={"ParamOut": [param]},
+        )
+
+
+class MomentumOptimizer(Optimizer):
+    type = "momentum"
+
+    def __init__(self, learning_rate, momentum, use_nesterov=False, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        velocity = self._get_accumulator("velocity", param)
+        return block.append_op(
+            type="momentum",
+            inputs={
+                "Param": [param],
+                "Grad": [grad],
+                "Velocity": [velocity],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={"ParamOut": [param], "VelocityOut": [velocity]},
+            attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov},
+        )
+
+
+class LarsMomentumOptimizer(Optimizer):
+    type = "lars_momentum"
+
+    def __init__(self, learning_rate, momentum, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        velocity = self._get_accumulator("velocity", param)
+        return block.append_op(
+            type="lars_momentum",
+            inputs={
+                "Param": [param],
+                "Grad": [grad],
+                "Velocity": [velocity],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={"ParamOut": [param], "VelocityOut": [velocity]},
+            attrs={"mu": self._momentum, "lars_coeff": self._lars_coeff,
+                   "lars_weight_decay": self._lars_weight_decay},
+        )
+
+
+class AdagradOptimizer(Optimizer):
+    type = "adagrad"
+
+    def __init__(self, learning_rate, epsilon=1e-6,
+                 initial_accumulator_value=0.0, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._epsilon = epsilon
+        self._initial = initial_accumulator_value
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p, fill_value=self._initial)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        moment = self._get_accumulator("moment", param)
+        return block.append_op(
+            type="adagrad",
+            inputs={
+                "Param": [param],
+                "Grad": [grad],
+                "Moment": [moment],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={"ParamOut": [param], "MomentOut": [moment]},
+            attrs={"epsilon": self._epsilon},
+        )
+
+
+class AdamOptimizer(Optimizer):
+    type = "adam"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_mode=False, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._lazy_mode = lazy_mode
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment1", p)
+            self._add_accumulator("moment2", p)
+            self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1,
+                                  shape=[1])
+            self._add_accumulator("beta2_pow_acc", p, fill_value=self._beta2,
+                                  shape=[1])
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        m1 = self._get_accumulator("moment1", param)
+        m2 = self._get_accumulator("moment2", param)
+        b1p = self._get_accumulator("beta1_pow_acc", param)
+        b2p = self._get_accumulator("beta2_pow_acc", param)
+        return block.append_op(
+            type="adam",
+            inputs={
+                "Param": [param],
+                "Grad": [grad],
+                "Moment1": [m1],
+                "Moment2": [m2],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+                "Beta1Pow": [b1p],
+                "Beta2Pow": [b2p],
+            },
+            outputs={
+                "ParamOut": [param],
+                "Moment1Out": [m1],
+                "Moment2Out": [m2],
+                "Beta1PowOut": [b1p],
+                "Beta2PowOut": [b2p],
+            },
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon, "lazy_mode": self._lazy_mode},
+        )
+
+
+class AdamaxOptimizer(Optimizer):
+    type = "adamax"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+            self._add_accumulator("inf_norm", p)
+            self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1,
+                                  shape=[1])
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        return block.append_op(
+            type="adamax",
+            inputs={
+                "Param": [param],
+                "Grad": [grad],
+                "Moment": [self._get_accumulator("moment", param)],
+                "InfNorm": [self._get_accumulator("inf_norm", param)],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+                "Beta1Pow": [self._get_accumulator("beta1_pow_acc", param)],
+            },
+            outputs={
+                "ParamOut": [param],
+                "MomentOut": [self._get_accumulator("moment", param)],
+                "InfNormOut": [self._get_accumulator("inf_norm", param)],
+            },
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon},
+        )
+
+    def _finish_update(self, block, params_grads):
+        for p, g in params_grads:
+            if g is None:
+                continue
+            b1p = self._get_accumulator("beta1_pow_acc", p)
+            with default_main_program()._optimized_guard([p, g]):
+                block.append_op(
+                    type="scale",
+                    inputs={"X": [b1p]},
+                    outputs={"Out": [b1p]},
+                    attrs={"scale": self._beta1},
+                )
+
+
+class DecayedAdagradOptimizer(Optimizer):
+    type = "decayed_adagrad"
+
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._decay, self._epsilon = decay, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        moment = self._get_accumulator("moment", param)
+        return block.append_op(
+            type="decayed_adagrad",
+            inputs={
+                "Param": [param], "Grad": [grad], "Moment": [moment],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={"ParamOut": [param], "MomentOut": [moment]},
+            attrs={"decay": self._decay, "epsilon": self._epsilon},
+        )
+
+
+class AdadeltaOptimizer(Optimizer):
+    type = "adadelta"
+
+    def __init__(self, learning_rate, epsilon=1e-6, rho=0.95, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("__avg_squared_grad", p)
+            self._add_accumulator("__avg_squared_update", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        g2 = self._get_accumulator("__avg_squared_grad", param)
+        u2 = self._get_accumulator("__avg_squared_update", param)
+        return block.append_op(
+            type="adadelta",
+            inputs={"Param": [param], "Grad": [grad],
+                    "AvgSquaredGrad": [g2], "AvgSquaredUpdate": [u2]},
+            outputs={"ParamOut": [param], "AvgSquaredGradOut": [g2],
+                     "AvgSquaredUpdateOut": [u2]},
+            attrs={"epsilon": self._epsilon, "rho": self._rho},
+        )
+
+
+class RMSPropOptimizer(Optimizer):
+    type = "rmsprop"
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("momentum", p)
+            self._add_accumulator("mean_square", p)
+            self._add_accumulator("mean_grad", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        mom = self._get_accumulator("momentum", param)
+        ms = self._get_accumulator("mean_square", param)
+        mg = self._get_accumulator("mean_grad", param)
+        return block.append_op(
+            type="rmsprop",
+            inputs={
+                "Param": [param], "Grad": [grad], "Moment": [mom],
+                "MeanSquare": [ms], "MeanGrad": [mg],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={"ParamOut": [param], "MomentOut": [mom],
+                     "MeanSquareOut": [ms], "MeanGradOut": [mg]},
+            attrs={"decay": self._rho, "epsilon": self._epsilon,
+                   "momentum": self._momentum, "centered": self._centered},
+        )
+
+
+class FtrlOptimizer(Optimizer):
+    type = "ftrl"
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("squared", p)
+            self._add_accumulator("linear", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        sq = self._get_accumulator("squared", param)
+        lin = self._get_accumulator("linear", param)
+        return block.append_op(
+            type="ftrl",
+            inputs={
+                "Param": [param], "Grad": [grad],
+                "SquaredAccumulator": [sq], "LinearAccumulator": [lin],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={"ParamOut": [param], "SquaredAccumOut": [sq],
+                     "LinearAccumOut": [lin]},
+            attrs={"l1": self._l1, "l2": self._l2, "lr_power": self._lr_power},
+        )
+
+
+class LambOptimizer(AdamOptimizer):
+    type = "lamb"
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6,
+                 exclude_from_weight_decay_fn=None, **kwargs):
+        super().__init__(learning_rate, beta1, beta2, epsilon, **kwargs)
+        self._weight_decay = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        wd = self._weight_decay
+        if self._exclude_fn is not None and self._exclude_fn(param):
+            wd = 0.0
+        m1 = self._get_accumulator("moment1", param)
+        m2 = self._get_accumulator("moment2", param)
+        b1p = self._get_accumulator("beta1_pow_acc", param)
+        b2p = self._get_accumulator("beta2_pow_acc", param)
+        return block.append_op(
+            type="lamb",
+            inputs={
+                "Param": [param], "Grad": [grad],
+                "Moment1": [m1], "Moment2": [m2],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+                "Beta1Pow": [b1p], "Beta2Pow": [b2p],
+            },
+            outputs={"ParamOut": [param], "Moment1Out": [m1],
+                     "Moment2Out": [m2], "Beta1PowOut": [b1p],
+                     "Beta2PowOut": [b2p]},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon, "weight_decay": wd},
+        )
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters (reference optimizer.py:2869)."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._name = name or ""
+        self._ema_vars = {}
+        from . import layers
+
+        block = default_main_program().global_block()
+        self._params = [p for p in block.all_parameters() if p.trainable]
+        for p in self._params:
+            ema = block.create_var(
+                name=unique_name.generate(p.name + ".ema"),
+                shape=p.shape, dtype=p.dtype, persistable=True,
+            )
+            Constant(0.0)(ema)
+            self._ema_vars[p.name] = ema
+
+    def update(self):
+        from . import layers
+
+        block = default_main_program().global_block()
+        for p in self._params:
+            ema = self._ema_vars[p.name]
+            block.append_op(
+                type="scale", inputs={"X": [ema]}, outputs={"Out": [ema]},
+                attrs={"scale": self._decay},
+            )
+            tmp = layers.scale(p, scale=1.0 - self._decay)
+            block.append_op(
+                type="elementwise_add",
+                inputs={"X": [ema], "Y": [tmp]},
+                outputs={"Out": [ema]},
+            )
+
+    def apply(self, executor, need_restore=True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def guard():
+            from .core.executor import global_scope
+
+            scope = global_scope()
+            backup = {}
+            for p in self._params:
+                backup[p.name] = np.asarray(scope.find_var(p.name).get_tensor().numpy())
+                ema_v = scope.find_var(self._ema_vars[p.name].name)
+                if ema_v is not None:
+                    scope.var(p.name).set(ema_v.get_tensor().get())
+            try:
+                yield
+            finally:
+                if need_restore:
+                    for name, val in backup.items():
+                        scope.var(name).set(val)
+
+        return guard()
+
+    def restore(self, executor):
+        pass
+
+
+class ModelAverage(Optimizer):
+    """Parameter averaging over a window (reference optimizer.py:2567).
+    Simplified: maintains running sum + count; apply() swaps averages in."""
+
+    def __init__(self, average_window_rate, min_average_window=10000,
+                 max_average_window=10000, **kwargs):
+        super().__init__(0.0, **kwargs)
+        self._sums = {}
+        self._counts = {}
+        block = default_main_program().global_block()
+        params = [p for p in block.all_parameters() if p.trainable]
+        for p in params:
+            s = block.create_var(
+                name=unique_name.generate(p.name + ".avg_sum"),
+                shape=p.shape, dtype=p.dtype, persistable=True)
+            Constant(0.0)(s)
+            self._sums[p.name] = s
+            block.append_op(
+                type="elementwise_add", inputs={"X": [s], "Y": [p]},
+                outputs={"Out": [s]})
+        self._params = params
+        cnt = block.create_var(name=unique_name.generate("avg_count"),
+                               shape=(1,), dtype="float32", persistable=True)
+        Constant(0.0)(cnt)
+        block.append_op(type="increment", inputs={"X": [cnt]},
+                        outputs={"Out": [cnt]}, attrs={"step": 1.0})
+        self._count = cnt
+
+    def apply(self, executor, need_restore=True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def guard():
+            from .core.executor import global_scope
+
+            scope = global_scope()
+            backup = {}
+            cnt = float(np.asarray(scope.find_var(self._count.name).get_tensor().numpy())[0])
+            cnt = max(cnt, 1.0)
+            for p in self._params:
+                backup[p.name] = np.asarray(scope.find_var(p.name).get_tensor().numpy())
+                s = np.asarray(scope.find_var(self._sums[p.name].name).get_tensor().numpy())
+                scope.var(p.name).set(s / cnt)
+            try:
+                yield
+            finally:
+                if need_restore:
+                    for name, val in backup.items():
+                        scope.var(name).set(val)
+
+        return guard()
+
+
+class RecomputeOptimizer(Optimizer):
+    """Activation recompute (reference optimizer.py:3396).  The vjp-based
+    grads already replay forward locally; segment checkpoints map to
+    jax.checkpoint policies applied at block-compile time."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+        self._checkpoints = None
+
+    def _set_checkpoints(self, checkpoints):
+        self._checkpoints = checkpoints
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        loss.block.program._recompute_checkpoints = [
+            c.name if isinstance(c, Variable) else c for c in (self._checkpoints or [])
+        ]
+        return self._optimizer.minimize(loss, startup_program, parameter_list,
+                                        no_grad_set)
+
+
+class LookaheadOptimizer:
+    """Lookahead wrapper (reference optimizer.py:3689): slow/fast weights."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+
+    def minimize(self, loss, startup_program=None):
+        ops, pgs = self.inner_optimizer.minimize(loss, startup_program)
+        block = default_main_program().global_block()
+        for p, g in pgs:
+            if g is None:
+                continue
+            slow = block.create_var(
+                name=unique_name.generate(p.name + ".slow"),
+                shape=p.shape, dtype=p.dtype, persistable=True)
+            # slow init: copy of param at startup
+            sb = default_startup_program().global_block()
+            if not sb.has_var(slow.name):
+                sb.create_var(name=slow.name, shape=p.shape, dtype=p.dtype,
+                              persistable=True)
+            if not sb.has_var(p.name):
+                sb.create_var(name=p.name, shape=p.shape, dtype=p.dtype,
+                              persistable=True)
+            sb.append_op(type="assign", inputs={"X": [p.name]},
+                         outputs={"Out": [slow.name]})
+            # every step: slow += alpha*(fast-slow)/k approximation of the
+            # k-step sync (static-graph-friendly smoothing)
+            from . import layers
+
+            diff = layers.elementwise_sub(p, slow)
+            upd = layers.scale(diff, scale=self.alpha / self.k)
+            block.append_op(type="elementwise_add",
+                            inputs={"X": [slow], "Y": [upd]},
+                            outputs={"Out": [slow]})
+        return ops, pgs
+
+
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adagrad = AdagradOptimizer
+Adam = AdamOptimizer
+Adamax = AdamaxOptimizer
+DecayedAdagrad = DecayedAdagradOptimizer
+Adadelta = AdadeltaOptimizer
+RMSProp = RMSPropOptimizer
+Ftrl = FtrlOptimizer
+Lamb = LambOptimizer
+LarsMomentum = LarsMomentumOptimizer

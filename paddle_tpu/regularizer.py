@@ -1,0 +1,62 @@
+"""Weight-decay regularizers (parity: python/paddle/fluid/regularizer.py)."""
+
+from .framework import default_main_program
+
+__all__ = ["L1Decay", "L2Decay", "L1DecayRegularizer", "L2DecayRegularizer",
+           "append_regularization_ops"]
+
+
+class WeightDecayRegularizer:
+    def __call__(self, param, grad, block):
+        raise NotImplementedError
+
+
+class L2DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._coeff = regularization_coeff
+
+    def __call__(self, param, grad, block):
+        from . import layers
+
+        decay = layers.scale(param, scale=self._coeff)
+        return decay
+
+
+class L1DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._coeff = regularization_coeff
+
+    def __call__(self, param, grad, block):
+        from . import layers
+
+        sign = layers.sign(param)
+        return layers.scale(sign, scale=self._coeff)
+
+
+def append_regularization_ops(parameters_and_grads, regularization=None):
+    """grad += coeff * penalty'(param) for each param with a regularizer
+    (reference regularizer.py:append_regularization_ops)."""
+    program = default_main_program()
+    block = program.global_block()
+    out = []
+    for param, grad in parameters_and_grads:
+        if grad is None:
+            out.append((param, grad))
+            continue
+        reg = getattr(param, "regularizer", None) or regularization
+        if reg is None:
+            out.append((param, grad))
+            continue
+        with program._optimized_guard([param, grad]):
+            decay = reg(param, grad, block)
+            block.append_op(
+                type="sum",
+                inputs={"X": [grad, decay]},
+                outputs={"Out": [grad]},
+            )
+        out.append((param, grad))
+    return out
+
+
+L1Decay = L1DecayRegularizer
+L2Decay = L2DecayRegularizer

@@ -1,0 +1,3 @@
+"""fluid.unique_name compatibility alias."""
+
+from .utils.unique_name import generate, guard, switch  # noqa: F401

@@ -1,0 +1,49 @@
+"""Unique name generation for variables/ops.
+
+Mirrors the capability of ``python/paddle/fluid/unique_name.py`` in the
+reference (generator with prefix counters, guard for scoped renaming).
+"""
+
+import contextlib
+import threading
+
+__all__ = ["generate", "guard", "switch"]
+
+
+class _NameGenerator:
+    def __init__(self, prefix=""):
+        self._prefix = prefix
+        self._counters = {}
+        self._lock = threading.Lock()
+
+    def generate(self, key):
+        with self._lock:
+            idx = self._counters.get(key, 0)
+            self._counters[key] = idx + 1
+        return "%s%s_%d" % (self._prefix, key, idx)
+
+
+_generator = _NameGenerator()
+
+
+def generate(key):
+    """Generate a unique name like ``fc_0.w_0`` for the given key."""
+    return _generator.generate(key)
+
+
+def switch(new_generator=None):
+    global _generator
+    old = _generator
+    _generator = new_generator if new_generator is not None else _NameGenerator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    if isinstance(new_generator, str):
+        new_generator = _NameGenerator(new_generator)
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        switch(old)

@@ -1,0 +1,104 @@
+"""Executor tests: feed/fetch, scope persistence, startup init, donation,
+program cache (mirrors reference test_executor_* family)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _new_progs():
+    return fluid.Program(), fluid.Program()
+
+
+def test_feed_fetch_roundtrip():
+    main, startup = _new_progs()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[3])
+        y = fluid.layers.scale(x, scale=2.0, bias=1.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        xv = np.arange(6, dtype="float32").reshape(2, 3)
+        out, = exe.run(main, feed={"x": xv}, fetch_list=[y])
+        np.testing.assert_allclose(out, xv * 2 + 1, rtol=1e-6)
+
+
+def test_startup_initializes_params():
+    main, startup = _new_progs()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        out = fluid.layers.fc(x, 2, param_attr=fluid.ParamAttr(name="w_test"),
+                              bias_attr=False)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        w = scope.find_var("w_test")
+        assert w is not None
+        assert np.asarray(w.get_tensor().numpy()).shape == (4, 2)
+
+
+def test_persistable_updates_written_back():
+    main, startup = _new_progs()
+    with fluid.program_guard(main, startup):
+        counter = fluid.layers.create_global_var(
+            shape=[1], value=0.0, dtype="float32", persistable=True,
+            name="step_counter")
+        main.global_block().append_op(
+            type="increment", inputs={"X": [counter]},
+            outputs={"Out": [counter]}, attrs={"step": 1.0})
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(3):
+            exe.run(main)
+        val = np.asarray(scope.find_var("step_counter").get_tensor().numpy())
+        assert val[0] == 3.0
+
+
+def test_uninitialized_param_raises():
+    main, startup = _new_progs()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        out = fluid.layers.fc(x, 2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        # no startup run
+        try:
+            exe.run(main, feed={"x": np.zeros((1, 4), "float32")},
+                    fetch_list=[out])
+            assert False, "expected RuntimeError"
+        except RuntimeError as e:
+            assert "startup" in str(e)
+
+
+def test_randomness_deterministic_per_seed():
+    main, startup = _new_progs()
+    main.random_seed = 7
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[100])
+        y = fluid.layers.dropout(x, 0.5)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.ones((2, 100), "float32")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        a, = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        b, = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    np.testing.assert_array_equal(a, b)
+
+
+def test_varying_batch_size_recompiles():
+    main, startup = _new_progs()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[3])
+        y = fluid.layers.reduce_sum(x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for bs in (2, 5, 8):
+            xv = np.ones((bs, 3), "float32")
+            out, = exe.run(main, feed={"x": xv}, fetch_list=[y])
+            assert float(out[0]) == bs * 3

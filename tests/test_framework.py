@@ -1,0 +1,99 @@
+"""Core IR tests: Program/Block/Operator/Variable, shape inference,
+serialization (mirrors reference test_program.py / test_operator_desc.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.framework import Program
+
+
+def test_program_blocks():
+    p = Program()
+    assert p.global_block().idx == 0
+    b1 = p._create_block()
+    assert b1.parent_idx == 0
+    p._rollback()
+    assert p.current_block() is p.global_block()
+
+
+def test_create_var_and_param():
+    p = Program()
+    with fluid.program_guard(p):
+        blk = p.global_block()
+        v = blk.create_var(name="x", shape=[-1, 4], dtype="float32")
+        assert v.shape == (-1, 4)
+        w = blk.create_parameter(shape=[4, 3], dtype="float32")
+        assert w.persistable
+        assert w in blk.all_parameters()
+
+
+def test_append_op_infers_shape():
+    p = Program()
+    with fluid.program_guard(p):
+        blk = p.global_block()
+        blk.create_var(name="a", shape=[-1, 4], dtype="float32")
+        blk.create_var(name="b", shape=[4, 3], dtype="float32")
+        out = blk.create_var(name="c")
+        blk.append_op(
+            type="mul",
+            inputs={"X": ["a"], "Y": ["b"]},
+            outputs={"Out": ["c"]},
+        )
+        assert out.shape == (-1, 3)
+        assert out.dtype == "float32"
+
+
+def test_unknown_op_rejected():
+    p = Program()
+    with fluid.program_guard(p):
+        with pytest.raises(ValueError):
+            p.global_block().append_op(type="definitely_not_an_op")
+
+
+def test_bad_slot_rejected():
+    p = Program()
+    with fluid.program_guard(p):
+        blk = p.global_block()
+        blk.create_var(name="a", shape=[2], dtype="float32")
+        with pytest.raises(ValueError):
+            blk.append_op(
+                type="relu", inputs={"NotASlot": ["a"]}, outputs={"Out": ["b"]}
+            )
+
+
+def test_program_clone_for_test_freezes_dropout():
+    p = Program()
+    with fluid.program_guard(p):
+        x = fluid.layers.data("x", shape=[4])
+        y = fluid.layers.dropout(x, 0.5)
+    t = p.clone(for_test=True)
+    drop_ops = [op for op in t.global_block().ops if op.type == "dropout"]
+    assert drop_ops and all(op.attr("is_test") for op in drop_ops)
+
+
+def test_serialization_roundtrip():
+    p = Program()
+    with fluid.program_guard(p, Program()):
+        x = fluid.layers.data("x", shape=[4])
+        h = fluid.layers.fc(x, 8, act="relu")
+    d = p.to_dict()
+    p2 = Program.from_dict(d)
+    assert [op.type for op in p2.global_block().ops] == [
+        op.type for op in p.global_block().ops
+    ]
+    assert set(p2.global_block().vars) == set(p.global_block().vars)
+
+
+def test_variable_operator_overloading():
+    p = Program()
+    with fluid.program_guard(p):
+        a = fluid.layers.data("a", shape=[4])
+        b = fluid.layers.data("b", shape=[4])
+        c = a + b
+        d = c * 2.0
+        assert c.shape == (-1, 4)
+        assert d.shape == (-1, 4)
+        types = [op.type for op in p.global_block().ops]
+        assert "elementwise_add" in types
+        assert "scale" in types

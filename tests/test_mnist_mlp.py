@@ -1,0 +1,100 @@
+"""BASELINE config 1: MNIST-style MLP end-to-end (mirrors reference
+tests/book/test_recognize_digits.py).  Synthetic separable data stands in
+for MNIST download (no egress); full MNIST runs via paddle_tpu.datasets."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _make_data(n=256, d=64, k=10, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(k, d).astype("float32") * 2.0
+    ys = rng.randint(0, k, n)
+    xs = centers[ys] + rng.randn(n, d).astype("float32") * 0.5
+    return xs.astype("float32"), ys.reshape(-1, 1).astype("int64")
+
+
+def build_mlp(img_dim=64, num_classes=10, lr=0.1, optimizer="sgd"):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[img_dim])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        h1 = fluid.layers.fc(img, 128, act="relu")
+        h2 = fluid.layers.fc(h1, 64, act="relu")
+        logits = fluid.layers.fc(h2, num_classes)
+        loss = fluid.layers.softmax_with_cross_entropy(logits, label)
+        avg_loss = fluid.layers.mean(loss)
+        acc = fluid.layers.accuracy(fluid.layers.softmax(logits), label)
+        if optimizer == "sgd":
+            opt = fluid.optimizer.SGD(learning_rate=lr)
+        else:
+            opt = fluid.optimizer.Adam(learning_rate=lr)
+        opt.minimize(avg_loss)
+    return main, startup, avg_loss, acc
+
+
+def _train(optimizer, lr, steps=60):
+    xs, ys = _make_data()
+    main, startup, avg_loss, acc = build_mlp(lr=lr, optimizer=optimizer)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses, accs = [], []
+        for i in range(steps):
+            lo, ac = exe.run(
+                main, feed={"img": xs, "label": ys},
+                fetch_list=[avg_loss, acc],
+            )
+            losses.append(float(lo[0]))
+            accs.append(float(ac[0]))
+    return losses, accs
+
+
+def test_mnist_mlp_sgd_converges():
+    losses, accs = _train("sgd", 0.1, steps=80)
+    assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
+    assert accs[-1] > 0.9, accs[-1]
+
+
+def test_mnist_mlp_adam_converges():
+    losses, accs = _train("adam", 1e-3, steps=80)
+    assert losses[-1] < losses[0] * 0.5
+    assert accs[-1] > 0.85
+
+
+def test_loss_matches_numpy_reference():
+    """Loss-parity harness: same init + same data => same first-step loss as
+    a numpy forward implementation."""
+    d, k = 8, 3
+    xs = np.random.RandomState(1).randn(32, d).astype("float32")
+    ys = np.random.RandomState(2).randint(0, k, (32, 1)).astype("int64")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[d])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        logits = fluid.layers.fc(
+            img, k,
+            param_attr=fluid.ParamAttr(
+                name="w0", initializer=fluid.initializer.Constant(0.05)),
+            bias_attr=fluid.ParamAttr(
+                name="b0", initializer=fluid.initializer.Constant(0.0)),
+        )
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        out, = exe.run(main, feed={"img": xs, "label": ys},
+                       fetch_list=[loss])
+
+    # numpy reference
+    w = np.full((d, k), 0.05, "float32")
+    b = np.zeros(k, "float32")
+    z = xs @ w + b
+    z = z - z.max(axis=1, keepdims=True)
+    p = np.exp(z) / np.exp(z).sum(axis=1, keepdims=True)
+    ref = -np.log(p[np.arange(32), ys.ravel()] + 1e-12).mean()
+    np.testing.assert_allclose(float(out[0]), ref, rtol=1e-5)
