@@ -166,16 +166,16 @@ def pool2d(ctx, x, pooling_type="max", ksize=(1, 1), strides=(1, 1),
         window = (1, kh, kw, 1)
         strides_ = (1, sh, sw, 1)
         pads = ((0, 0), pad_h, pad_w, (0, 0))
+    # NB: init values must be Python scalars for JAX to select the
+    # differentiable reduce_window_{max,sum} primitives
     if pooling_type == "max":
-        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
-        return lax.reduce_window(x, jnp.asarray(init, x.dtype), lax.max,
-                                 window, strides_, pads)
-    s = lax.reduce_window(x, jnp.asarray(0.0, x.dtype), lax.add, window,
-                          strides_, pads)
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else int(
+            jnp.iinfo(x.dtype).min)
+        return lax.reduce_window(x, init, lax.max, window, strides_, pads)
+    s = lax.reduce_window(x, 0.0, lax.add, window, strides_, pads)
     if exclusive and (pad_h != (0, 0) or pad_w != (0, 0)):
         ones = jnp.ones_like(x)
-        cnt = lax.reduce_window(ones, jnp.asarray(0.0, x.dtype), lax.add,
-                                window, strides_, pads)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides_, pads)
         return s / cnt
     return s / (kh * kw)
 

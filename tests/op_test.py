@@ -1,0 +1,226 @@
+"""Per-op golden + gradient test harness.
+
+Port of the reference's workhorse ``unittests/op_test.py`` (OpTest at
+op_test.py:136): a test declares `op_type`, numpy `inputs`/`attrs` and
+expected `outputs`; `check_output` runs the single op through the real
+executor comparing to numpy; `check_grad` compares analytic gradients (built
+via append_backward over the registered grad ops) against central-difference
+numeric gradients of the same scalar loss.
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.framework import Program, convert_np_dtype_to_dtype_
+
+
+def _as_items(val):
+    """Normalize a slot value: ndarray | (lod, ndarray) | list[(name, arr)]"""
+    if isinstance(val, list) and val and isinstance(val[0], tuple):
+        return val  # duplicable
+    if isinstance(val, tuple) and len(val) == 2 and isinstance(val[1], np.ndarray):
+        return [(None, val[0])] if False else [("", val[0])]
+    return [("", val)]
+
+
+class OpTest:
+    op_type = None
+    atol = 1e-5
+    rtol = 1e-4
+
+    # subclasses set these in setup_method or directly
+    inputs = {}
+    outputs = {}
+    attrs = {}
+
+    def _build_program(self, extra_grad=False, inputs_to_check=(),
+                       output_names=None):
+        main, startup = Program(), Program()
+        feed = {}
+        with fluid.program_guard(main, startup):
+            block = main.global_block()
+            in_slots = {}
+            for slot, val in self.inputs.items():
+                if isinstance(val, list):  # duplicable: [(name, arr), ...]
+                    names = []
+                    for name, arr in val:
+                        arr = np.asarray(arr)
+                        v = block.create_var(
+                            name=name,
+                            shape=arr.shape,
+                            dtype=convert_np_dtype_to_dtype_(arr.dtype),
+                            stop_gradient=(name not in inputs_to_check
+                                           and slot not in inputs_to_check),
+                        )
+                        feed[name] = arr
+                        names.append(name)
+                    in_slots[slot] = names
+                else:
+                    arr = np.asarray(val)
+                    name = "in_" + slot
+                    block.create_var(
+                        name=name,
+                        shape=arr.shape,
+                        dtype=convert_np_dtype_to_dtype_(arr.dtype),
+                        stop_gradient=slot not in inputs_to_check,
+                    )
+                    feed[name] = arr
+                    in_slots[slot] = [name]
+            out_slots = {}
+            out_names = {}
+            for slot, val in self.outputs.items():
+                if isinstance(val, list):
+                    names = [n for n, _ in val]
+                else:
+                    names = ["out_" + slot]
+                for n in names:
+                    block.create_var(name=n)
+                out_slots[slot] = names
+                out_names[slot] = names
+            block.append_op(
+                type=self.op_type,
+                inputs=in_slots,
+                outputs=out_slots,
+                attrs=dict(self.attrs),
+            )
+            loss = None
+            if extra_grad:
+                targets = output_names or [
+                    out_names[s][0] for s in self.outputs
+                    if not isinstance(self.outputs[s], list)
+                ][:1]
+                means = []
+                for tname in targets:
+                    tvar = block.var(tname)
+                    means.append(fluid.layers.mean(tvar))
+                loss = means[0]
+                for m in means[1:]:
+                    loss = fluid.layers.elementwise_add(loss, m)
+        return main, startup, feed, out_names, loss
+
+    # -- forward check -------------------------------------------------------
+    def check_output(self, atol=None, rtol=None, no_check_set=()):
+        atol = atol if atol is not None else self.atol
+        rtol = rtol if rtol is not None else self.rtol
+        main, startup, feed, out_names, _ = self._build_program()
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        fetch = []
+        expected = []
+        for slot, val in self.outputs.items():
+            if slot in no_check_set:
+                continue
+            if isinstance(val, list):
+                for (n, arr) in val:
+                    if arr is not None:
+                        fetch.append(n)
+                        expected.append(np.asarray(arr))
+            else:
+                if val is None:
+                    continue
+                fetch.append(out_names[slot][0])
+                expected.append(np.asarray(val))
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            got = exe.run(main, feed=feed, fetch_list=fetch)
+        for name, g, e in zip(fetch, got, expected):
+            g = np.asarray(g)
+            if e.dtype == np.bool_ or g.dtype == np.bool_:
+                np.testing.assert_array_equal(g, e, err_msg="output %s" % name)
+            else:
+                np.testing.assert_allclose(
+                    g.astype("float64"),
+                    e.astype("float64"),
+                    atol=atol,
+                    rtol=rtol,
+                    err_msg="output %s of op %s" % (name, self.op_type),
+                )
+
+    # -- gradient check ------------------------------------------------------
+    def check_grad(self, inputs_to_check, output_names=None,
+                   max_relative_error=0.01, numeric_delta=5e-3,
+                   no_grad_set=None, max_elements=512):
+        if isinstance(output_names, str):
+            output_names = [output_names]
+        if output_names is not None:
+            output_names = [
+                n if n.startswith("out_") or any(
+                    isinstance(v, list) and any(n == nm for nm, _ in v)
+                    for v in self.outputs.values()
+                ) else "out_" + n
+                for n in output_names
+            ]
+        main, startup, feed, out_names, loss = self._build_program(
+            extra_grad=True, inputs_to_check=inputs_to_check,
+            output_names=output_names,
+        )
+        from paddle_tpu.backward import append_backward
+
+        with fluid.program_guard(main, startup):
+            append_backward(loss, no_grad_set=no_grad_set)
+
+        grad_names = []
+        for slot in inputs_to_check:
+            if slot in self.inputs and not isinstance(self.inputs[slot], list):
+                grad_names.append("in_%s@GRAD" % slot)
+            else:
+                grad_names.append("%s@GRAD" % slot)  # by var name
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            res = exe.run(main, feed=feed,
+                          fetch_list=[loss.name] + grad_names)
+        analytic = {s: np.asarray(g) for s, g in
+                    zip(inputs_to_check, res[1:])}
+
+        # numeric: central difference of the same scalar loss
+        fwd_main, fwd_startup, fwd_feed, fwd_out_names, fwd_loss = (
+            self._build_program(extra_grad=True,
+                                inputs_to_check=inputs_to_check,
+                                output_names=output_names)
+        )
+        fexe = fluid.Executor(fluid.CPUPlace())
+        fscope = fluid.Scope()
+
+        def run_loss(feed_dict):
+            with fluid.scope_guard(fscope):
+                out, = fexe.run(fwd_main, feed=feed_dict,
+                                fetch_list=[fwd_loss.name])
+            return float(np.asarray(out).reshape(-1)[0])
+
+        with fluid.scope_guard(fscope):
+            fexe.run(fwd_startup)
+
+        rng = np.random.RandomState(0)
+        for slot in inputs_to_check:
+            key = "in_" + slot if slot in self.inputs and not isinstance(
+                self.inputs[slot], list) else slot
+            base = np.array(fwd_feed[key], dtype="float64")
+            flat = base.reshape(-1)
+            n = flat.size
+            idxs = (np.arange(n) if n <= max_elements
+                    else rng.choice(n, max_elements, replace=False))
+            num_grad = np.zeros(n)
+            for i in idxs:
+                d = numeric_delta
+                fplus = dict(fwd_feed)
+                pert = flat.copy()
+                pert[i] += d
+                fplus[key] = pert.reshape(base.shape).astype(
+                    fwd_feed[key].dtype)
+                lp = run_loss(fplus)
+                fminus = dict(fwd_feed)
+                pert = flat.copy()
+                pert[i] -= d
+                fminus[key] = pert.reshape(base.shape).astype(
+                    fwd_feed[key].dtype)
+                lm = run_loss(fminus)
+                num_grad[i] = (lp - lm) / (2 * d)
+            a = analytic[slot].reshape(-1)
+            for i in idxs:
+                diff = abs(a[i] - num_grad[i])
+                denom = max(abs(a[i]), abs(num_grad[i]), 1e-3)
+                assert diff / denom <= max_relative_error or diff < 1e-5, (
+                    "grad mismatch op=%s input=%s elem=%d analytic=%g "
+                    "numeric=%g" % (self.op_type, slot, i, a[i], num_grad[i])
+                )

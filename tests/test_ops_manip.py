@@ -1,0 +1,264 @@
+"""Golden tests for shape-manipulation ops (mirrors reference
+test_reshape_op.py, test_transpose_op.py, test_concat_op.py, test_split_op.py,
+test_slice_op.py, test_gather_op.py, test_one_hot_op.py, test_stack_op.py)."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def _rand(*shape):
+    return np.random.RandomState(sum(shape) + 13).uniform(
+        -1, 1, shape
+    ).astype("float32")
+
+
+class TestReshape2(OpTest):
+    op_type = "reshape2"
+
+    def setup_method(self, m):
+        x = _rand(2, 3, 4)
+        self.inputs = {"X": x}
+        self.attrs = {"shape": [0, -1]}
+        self.outputs = {"Out": [("out", x.reshape(2, 12))],
+                        "XShape": [("xshape", None)]}
+
+    def test_output(self):
+        self.check_output(no_check_set=("XShape",))
+
+    def test_grad(self):
+        self.check_grad(["X"], output_names=["out"])
+
+
+class TestTranspose2(OpTest):
+    op_type = "transpose2"
+
+    def setup_method(self, m):
+        x = _rand(2, 3, 4)
+        self.inputs = {"X": x}
+        self.attrs = {"axis": [1, 2, 0]}
+        self.outputs = {"Out": [("out", x.transpose(1, 2, 0))],
+                        "XShape": [("xshape", None)]}
+
+    def test_output(self):
+        self.check_output(no_check_set=("XShape",))
+
+
+class TestConcat(OpTest):
+    op_type = "concat"
+
+    def setup_method(self, m):
+        a, b = _rand(2, 3), _rand(2, 5)
+        self.inputs = {"X": [("a", a), ("b", b)]}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": np.concatenate([a, b], axis=1)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSplitSections(OpTest):
+    op_type = "split"
+
+    def setup_method(self, m):
+        x = _rand(4, 10)
+        self.inputs = {"X": x}
+        self.attrs = {"axis": 1, "num": 0, "sections": [3, 3, 4]}
+        parts = np.split(x, [3, 6], axis=1)
+        self.outputs = {"Out": [("o0", parts[0]), ("o1", parts[1]),
+                                ("o2", parts[2])]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSplitNum(OpTest):
+    op_type = "split"
+
+    def setup_method(self, m):
+        x = _rand(4, 6)
+        self.inputs = {"X": x}
+        self.attrs = {"axis": 1, "num": 2, "sections": []}
+        parts = np.split(x, 2, axis=1)
+        self.outputs = {"Out": [("o0", parts[0]), ("o1", parts[1])]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSlice(OpTest):
+    op_type = "slice"
+
+    def setup_method(self, m):
+        x = _rand(4, 5, 6)
+        self.inputs = {"Input": x}
+        self.attrs = {"axes": [0, 2], "starts": [1, -3], "ends": [3, 6],
+                      "decrease_axis": [], "infer_flags": [1, 1]}
+        self.outputs = {"Out": x[1:3, :, 3:6]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestGather(OpTest):
+    op_type = "gather"
+
+    def setup_method(self, m):
+        x = _rand(6, 3)
+        idx = np.array([0, 2, 5], "int64")
+        self.inputs = {"X": x, "Index": idx}
+        self.outputs = {"Out": x[idx]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestGatherNd(OpTest):
+    op_type = "gather_nd"
+
+    def setup_method(self, m):
+        x = _rand(3, 4, 5)
+        idx = np.array([[0, 1], [2, 3]], "int64")
+        self.inputs = {"X": x, "Index": idx}
+        self.outputs = {"Out": x[[0, 2], [1, 3]]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestStack(OpTest):
+    op_type = "stack"
+
+    def setup_method(self, m):
+        a, b = _rand(3, 4), _rand(3, 4)
+        self.inputs = {"X": [("a", a), ("b", b)]}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Y": np.stack([a, b], axis=1)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestOneHot(OpTest):
+    op_type = "one_hot_v2"
+
+    def setup_method(self, m):
+        ids = np.array([1, 0, 3], "int64")
+        out = np.eye(4, dtype="float32")[ids]
+        self.inputs = {"X": ids}
+        self.attrs = {"depth": 4}
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestExpand(OpTest):
+    op_type = "expand"
+
+    def setup_method(self, m):
+        x = _rand(2, 1, 3)
+        self.inputs = {"X": x}
+        self.attrs = {"expand_times": [1, 4, 2]}
+        self.outputs = {"Out": np.tile(x, (1, 4, 2))}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestPad(OpTest):
+    op_type = "pad"
+
+    def setup_method(self, m):
+        x = _rand(2, 3)
+        self.inputs = {"X": x}
+        self.attrs = {"paddings": [0, 1, 2, 0], "pad_value": 0.5}
+        self.outputs = {"Out": np.pad(x, ((0, 1), (2, 0)),
+                                      constant_values=0.5)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSqueeze2(OpTest):
+    op_type = "squeeze2"
+
+    def setup_method(self, m):
+        x = _rand(2, 1, 3, 1)
+        self.inputs = {"X": x}
+        self.attrs = {"axes": [1, 3]}
+        self.outputs = {"Out": [("out", x.reshape(2, 3))],
+                        "XShape": [("xs", None)]}
+
+    def test_output(self):
+        self.check_output(no_check_set=("XShape",))
+
+
+class TestUnsqueeze2(OpTest):
+    op_type = "unsqueeze2"
+
+    def setup_method(self, m):
+        x = _rand(2, 3)
+        self.inputs = {"X": x}
+        self.attrs = {"axes": [0, 3]}
+        self.outputs = {"Out": [("out", x.reshape(1, 2, 3, 1))],
+                        "XShape": [("xs", None)]}
+
+    def test_output(self):
+        self.check_output(no_check_set=("XShape",))
+
+
+class TestWhere(OpTest):
+    op_type = "where"
+
+    def setup_method(self, m):
+        c = np.array([[True, False], [False, True]])
+        x, y = _rand(2, 2), _rand(2, 2)
+        self.inputs = {"Condition": c, "X": x, "Y": y}
+        self.outputs = {"Out": np.where(c, x, y)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestTopK(OpTest):
+    op_type = "top_k"
+
+    def setup_method(self, m):
+        x = np.array([[1.0, 3.0, 2.0], [5.0, 4.0, 6.0]], "float32")
+        self.inputs = {"X": x}
+        self.attrs = {"k": 2}
+        self.outputs = {
+            "Out": [("vals", np.array([[3.0, 2.0], [6.0, 5.0]], "float32"))],
+            "Indices": [("idx", np.array([[1, 2], [2, 0]], "int64"))],
+        }
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestArgMax(OpTest):
+    op_type = "arg_max"
+
+    def setup_method(self, m):
+        x = _rand(3, 5)
+        self.inputs = {"X": x}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": x.argmax(axis=1).astype("int64")}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestLabelSmooth(OpTest):
+    op_type = "label_smooth"
+
+    def setup_method(self, m):
+        x = np.eye(4, dtype="float32")[[0, 2, 3]]
+        self.inputs = {"X": x}
+        self.attrs = {"epsilon": 0.1}
+        self.outputs = {"Out": 0.9 * x + 0.1 / 4}
+
+    def test_output(self):
+        self.check_output()

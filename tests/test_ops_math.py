@@ -1,0 +1,278 @@
+"""Golden + gradient tests for dense-math ops (mirrors reference
+test_mul_op.py, test_matmul_op.py, test_elementwise_*_op.py,
+test_reduce_op.py, test_scale_op.py, test_sum_op.py, test_clip_op.py)."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def _rand(*shape):
+    return np.random.RandomState(sum(shape) + len(shape)).uniform(
+        -1, 1, shape
+    ).astype("float32")
+
+
+class TestMulOp(OpTest):
+    op_type = "mul"
+
+    def setup_method(self, m):
+        x, y = _rand(4, 5), _rand(5, 3)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"x_num_col_dims": 1, "y_num_col_dims": 1}
+        self.outputs = {"Out": x @ y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"])
+
+
+class TestMulOpFlatten(OpTest):
+    op_type = "mul"
+
+    def setup_method(self, m):
+        x, y = _rand(2, 3, 4), _rand(4, 2, 3)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"x_num_col_dims": 2, "y_num_col_dims": 1}
+        out = (x.reshape(6, 4) @ y.reshape(4, 6)).reshape(2, 3, 2, 3)
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestMatMulOp(OpTest):
+    op_type = "matmul"
+
+    def setup_method(self, m):
+        x, y = _rand(3, 4, 5), _rand(3, 5, 6)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"transpose_X": False, "transpose_Y": False}
+        self.outputs = {"Out": np.matmul(x, y)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], max_elements=128)
+
+
+class TestMatMulTranspose(OpTest):
+    op_type = "matmul"
+
+    def setup_method(self, m):
+        x, y = _rand(4, 5), _rand(6, 5)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"transpose_X": False, "transpose_Y": True, "alpha": 2.0}
+        self.outputs = {"Out": 2.0 * (x @ y.T)}
+
+    def test_output(self):
+        self.check_output()
+
+
+@pytest.mark.parametrize(
+    "op,fn",
+    [
+        ("elementwise_add", np.add),
+        ("elementwise_sub", np.subtract),
+        ("elementwise_mul", np.multiply),
+        ("elementwise_div", np.divide),
+        ("elementwise_max", np.maximum),
+        ("elementwise_min", np.minimum),
+    ],
+)
+def test_elementwise_same_shape(op, fn):
+    class T(OpTest):
+        op_type = op
+
+    t = T()
+    x = _rand(3, 4) + 2.0
+    y = _rand(3, 4) + 2.0
+    t.inputs = {"X": x, "Y": y}
+    t.attrs = {"axis": -1}
+    t.outputs = {"Out": fn(x, y)}
+    t.check_output()
+
+
+def test_elementwise_add_broadcast_axis():
+    class T(OpTest):
+        op_type = "elementwise_add"
+
+    t = T()
+    x = _rand(2, 3, 4)
+    y = _rand(3)
+    t.inputs = {"X": x, "Y": y}
+    t.attrs = {"axis": 1}
+    t.outputs = {"Out": x + y.reshape(1, 3, 1)}
+    t.check_output()
+
+
+def test_elementwise_add_grad():
+    class T(OpTest):
+        op_type = "elementwise_add"
+
+    t = T()
+    x, y = _rand(3, 4), _rand(4)
+    t.inputs = {"X": x, "Y": y}
+    t.attrs = {"axis": -1}
+    t.outputs = {"Out": x + y}
+    t.check_grad(["X", "Y"])
+
+
+class TestScaleOp(OpTest):
+    op_type = "scale"
+
+    def setup_method(self, m):
+        x = _rand(4, 6)
+        self.inputs = {"X": x}
+        self.attrs = {"scale": 2.5, "bias": 0.5, "bias_after_scale": True}
+        self.outputs = {"Out": x * 2.5 + 0.5}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"])
+
+
+class TestSumOp(OpTest):
+    op_type = "sum"
+
+    def setup_method(self, m):
+        a, b, c = _rand(3, 4), _rand(3, 4), _rand(3, 4)
+        self.inputs = {"X": [("a", a), ("b", b), ("c", c)]}
+        self.outputs = {"Out": a + b + c}
+
+    def test_output(self):
+        self.check_output()
+
+
+@pytest.mark.parametrize(
+    "op,fn",
+    [
+        ("reduce_sum", np.sum),
+        ("reduce_mean", np.mean),
+        ("reduce_max", np.max),
+        ("reduce_min", np.min),
+        ("reduce_prod", np.prod),
+    ],
+)
+@pytest.mark.parametrize("dims,keep", [([1], False), ([0, 2], True)])
+def test_reduce(op, fn, dims, keep):
+    class T(OpTest):
+        op_type = op
+
+    t = T()
+    x = _rand(2, 3, 4) + 1.5
+    t.inputs = {"X": x}
+    t.attrs = {"dim": dims, "keep_dim": keep, "reduce_all": False}
+    t.outputs = {"Out": fn(x, axis=tuple(dims), keepdims=keep)}
+    t.check_output()
+
+
+def test_reduce_all_flag():
+    class T(OpTest):
+        op_type = "reduce_sum"
+
+    t = T()
+    x = _rand(2, 3)
+    t.inputs = {"X": x}
+    t.attrs = {"dim": [0], "keep_dim": False, "reduce_all": True}
+    t.outputs = {"Out": np.array([x.sum()], dtype="float32")}
+    t.check_output()
+
+
+class TestMeanOp(OpTest):
+    op_type = "mean"
+
+    def setup_method(self, m):
+        x = _rand(5, 7)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.array([x.mean()], "float32")}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"])
+
+
+class TestClipOp(OpTest):
+    op_type = "clip"
+
+    def setup_method(self, m):
+        x = _rand(4, 5) * 2
+        self.inputs = {"X": x}
+        self.attrs = {"min": -0.5, "max": 0.7}
+        self.outputs = {"Out": np.clip(x, -0.5, 0.7)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSoftmaxOp(OpTest):
+    op_type = "softmax"
+
+    def setup_method(self, m):
+        x = _rand(4, 10)
+        e = np.exp(x - x.max(axis=-1, keepdims=True))
+        self.inputs = {"X": x}
+        self.attrs = {"axis": -1}
+        self.outputs = {"Out": e / e.sum(axis=-1, keepdims=True)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"])
+
+
+class TestCastOp(OpTest):
+    op_type = "cast"
+
+    def setup_method(self, m):
+        x = _rand(3, 4)
+        self.inputs = {"X": x}
+        self.attrs = {"in_dtype": 5, "out_dtype": 6}
+        self.outputs = {"Out": x.astype("float64")}
+
+    def test_output(self):
+        self.check_output()
+
+
+@pytest.mark.parametrize(
+    "op,fn",
+    [
+        ("relu", lambda x: np.maximum(x, 0)),
+        ("sigmoid", lambda x: 1 / (1 + np.exp(-x))),
+        ("tanh", np.tanh),
+        ("exp", np.exp),
+        ("square", np.square),
+        ("abs", np.abs),
+        ("leaky_relu", lambda x: np.where(x >= 0, x, 0.02 * x)),
+    ],
+)
+def test_activation(op, fn):
+    class T(OpTest):
+        op_type = op
+
+    t = T()
+    x = _rand(4, 17)
+    t.inputs = {"X": x}
+    t.outputs = {"Out": fn(x)}
+    t.check_output()
+
+
+def test_activation_grads():
+    for op in ("relu", "sigmoid", "tanh", "gelu"):
+        class T(OpTest):
+            op_type = op
+
+        t = T()
+        x = _rand(3, 7) + 0.1  # keep away from relu kink
+        t.inputs = {"X": x}
+        t.outputs = {"Out": None}
+        t.check_grad(["X"], max_elements=21)

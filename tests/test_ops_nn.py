@@ -1,0 +1,350 @@
+"""Golden + gradient tests for NN ops (mirrors reference test_conv2d_op.py,
+test_pool2d_op.py, test_batch_norm_op.py, test_layer_norm_op.py,
+test_dropout_op.py, test_lookup_table_op.py,
+test_softmax_with_cross_entropy_op.py)."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def _rand(*shape, seed=None):
+    return np.random.RandomState(seed or (sum(shape) + 7)).uniform(
+        -1, 1, shape
+    ).astype("float32")
+
+
+def _conv2d_ref(x, w, stride, pad):
+    n, c, h, wd = x.shape
+    oc, ic, kh, kw = w.shape
+    sh, sw = stride
+    ph, pw = pad
+    xp = np.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+    oh = (h + 2 * ph - kh) // sh + 1
+    ow = (wd + 2 * pw - kw) // sw + 1
+    out = np.zeros((n, oc, oh, ow), "float32")
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, :, i * sh:i * sh + kh, j * sw:j * sw + kw]
+            out[:, :, i, j] = np.einsum("nchw,ochw->no", patch, w)
+    return out
+
+
+class TestConv2dOp(OpTest):
+    op_type = "conv2d"
+    atol = 1e-4
+
+    def setup_method(self, m):
+        x = _rand(2, 3, 8, 8)
+        w = _rand(4, 3, 3, 3)
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [2, 2], "paddings": [1, 1],
+                      "dilations": [1, 1], "groups": 1}
+        self.outputs = {"Output": _conv2d_ref(x, w, (2, 2), (1, 1))}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["Input", "Filter"], output_names="Output",
+                        max_elements=64, max_relative_error=0.02)
+
+
+class TestDepthwiseConv(OpTest):
+    op_type = "depthwise_conv2d"
+    atol = 1e-4
+
+    def setup_method(self, m):
+        x = _rand(1, 4, 6, 6)
+        w = _rand(4, 1, 3, 3)
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [1, 1], "paddings": [1, 1],
+                      "dilations": [1, 1], "groups": 4}
+        # reference: per-channel conv
+        out = np.zeros((1, 4, 6, 6), "float32")
+        xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+        for ch in range(4):
+            for i in range(6):
+                for j in range(6):
+                    out[0, ch, i, j] = (
+                        xp[0, ch, i:i + 3, j:j + 3] * w[ch, 0]
+                    ).sum()
+        self.outputs = {"Output": out}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestPool2dMax(OpTest):
+    op_type = "pool2d"
+
+    def setup_method(self, m):
+        x = _rand(2, 3, 6, 6)
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "max", "ksize": [2, 2],
+                      "strides": [2, 2], "paddings": [0, 0]}
+        out = x.reshape(2, 3, 3, 2, 3, 2).max(axis=(3, 5))
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], max_elements=64)
+
+
+class TestPool2dAvg(OpTest):
+    op_type = "pool2d"
+
+    def setup_method(self, m):
+        x = _rand(2, 3, 6, 6)
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "avg", "ksize": [2, 2],
+                      "strides": [2, 2], "paddings": [0, 0]}
+        out = x.reshape(2, 3, 3, 2, 3, 2).mean(axis=(3, 5))
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestPool2dGlobal(OpTest):
+    op_type = "pool2d"
+
+    def setup_method(self, m):
+        x = _rand(2, 3, 5, 5)
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "avg", "ksize": [1, 1],
+                      "strides": [1, 1], "paddings": [0, 0],
+                      "global_pooling": True}
+        self.outputs = {"Out": x.mean(axis=(2, 3), keepdims=True)}
+
+    def test_output(self):
+        self.check_output()
+
+
+def _bn_ref(x, scale, bias, eps):
+    m = x.mean(axis=(0, 2, 3))
+    v = x.var(axis=(0, 2, 3))
+    xh = (x - m.reshape(1, -1, 1, 1)) / np.sqrt(v + eps).reshape(1, -1, 1, 1)
+    return xh * scale.reshape(1, -1, 1, 1) + bias.reshape(1, -1, 1, 1), m, v
+
+
+class TestBatchNormTrain(OpTest):
+    op_type = "batch_norm"
+    atol = 1e-4
+
+    def setup_method(self, m):
+        x = _rand(4, 3, 5, 5)
+        scale, bias = _rand(3, seed=1), _rand(3, seed=2)
+        mean = np.zeros(3, "float32")
+        var = np.ones(3, "float32")
+        eps = 1e-5
+        mom = 0.9
+        y, bm, bv = _bn_ref(x, scale, bias, eps)
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias,
+                       "Mean": mean, "Variance": var}
+        self.attrs = {"momentum": mom, "epsilon": eps, "is_test": False}
+        self.outputs = {
+            "Y": [("y", y)],
+            "MeanOut": [("mean_out", mom * mean + (1 - mom) * bm)],
+            "VarianceOut": [("var_out", mom * var + (1 - mom) * bv)],
+            "SavedMean": [("saved_mean", bm)],
+            "SavedVariance": [("saved_var", 1.0 / np.sqrt(bv + eps))],
+            "ReserveSpace": [("rs", None)],
+        }
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestBatchNormInfer(OpTest):
+    op_type = "batch_norm"
+    atol = 1e-4
+
+    def setup_method(self, m):
+        x = _rand(4, 3, 5, 5)
+        scale, bias = _rand(3, seed=1), _rand(3, seed=2)
+        mean = _rand(3, seed=3) * 0.1
+        var = np.abs(_rand(3, seed=4)) + 0.5
+        eps = 1e-5
+        xh = (x - mean.reshape(1, -1, 1, 1)) / np.sqrt(
+            var + eps).reshape(1, -1, 1, 1)
+        y = xh * scale.reshape(1, -1, 1, 1) + bias.reshape(1, -1, 1, 1)
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias,
+                       "Mean": mean, "Variance": var}
+        self.attrs = {"momentum": 0.9, "epsilon": eps, "is_test": True}
+        self.outputs = {"Y": [("y", y)]}
+
+    def test_output(self):
+        self.check_output(no_check_set=("MeanOut", "VarianceOut",
+                                        "SavedMean", "SavedVariance",
+                                        "ReserveSpace"))
+
+
+class TestLayerNorm(OpTest):
+    op_type = "layer_norm"
+    atol = 1e-4
+
+    def setup_method(self, m):
+        x = _rand(4, 6)
+        scale, bias = _rand(6, seed=5), _rand(6, seed=6)
+        eps = 1e-5
+        mu = x.mean(axis=1, keepdims=True)
+        var = x.var(axis=1, keepdims=True)
+        y = (x - mu) / np.sqrt(var + eps) * scale + bias
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias}
+        self.attrs = {"epsilon": eps, "begin_norm_axis": 1}
+        self.outputs = {
+            "Y": [("y", y)],
+            "Mean": [("mean", mu.ravel())],
+            "Variance": [("var", var.ravel())],
+        }
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Scale", "Bias"], output_names=["y"],
+                        max_elements=48, max_relative_error=0.02)
+
+
+class TestDropoutTestMode(OpTest):
+    op_type = "dropout"
+
+    def setup_method(self, m):
+        x = _rand(4, 8)
+        self.inputs = {"X": x}
+        self.attrs = {"dropout_prob": 0.3, "is_test": True,
+                      "dropout_implementation": "upscale_in_train"}
+        self.outputs = {"Out": x, "Mask": None}
+
+    def test_output(self):
+        self.check_output(no_check_set=("Mask",))
+
+
+def test_dropout_train_statistics():
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[1000])
+        y = fluid.layers.dropout(x, 0.4,
+                                 dropout_implementation="upscale_in_train")
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        out, = exe.run(main, feed={"x": np.ones((8, 1000), "float32")},
+                       fetch_list=[y])
+    drop_rate = (np.asarray(out) == 0).mean()
+    assert abs(drop_rate - 0.4) < 0.03
+    kept = np.asarray(out)[np.asarray(out) != 0]
+    np.testing.assert_allclose(kept, 1 / 0.6, rtol=1e-5)
+
+
+class TestLookupTableV2(OpTest):
+    op_type = "lookup_table_v2"
+
+    def setup_method(self, m):
+        w = _rand(10, 4)
+        ids = np.array([[1, 3], [7, 0]], "int64")
+        self.inputs = {"W": w, "Ids": ids}
+        self.attrs = {"padding_idx": -1}
+        self.outputs = {"Out": w[ids]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestLookupTablePadding(OpTest):
+    op_type = "lookup_table_v2"
+
+    def setup_method(self, m):
+        w = _rand(10, 4)
+        ids = np.array([[1, 2], [2, 5]], "int64")
+        out = w[ids].copy()
+        out[ids == 2] = 0.0
+        self.inputs = {"W": w, "Ids": ids}
+        self.attrs = {"padding_idx": 2}
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSoftmaxWithCE(OpTest):
+    op_type = "softmax_with_cross_entropy"
+
+    def setup_method(self, m):
+        logits = _rand(5, 7)
+        label = np.random.RandomState(3).randint(0, 7, (5, 1)).astype("int64")
+        z = logits - logits.max(axis=1, keepdims=True)
+        sm = np.exp(z) / np.exp(z).sum(axis=1, keepdims=True)
+        loss = -np.log(sm[np.arange(5), label.ravel()]).reshape(5, 1)
+        self.inputs = {"Logits": logits, "Label": label}
+        self.outputs = {"Softmax": [("sm", sm)], "Loss": [("loss", loss)]}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["Logits"], output_names=["loss"], max_elements=35)
+
+
+class TestSoftmaxWithCESoftLabel(OpTest):
+    op_type = "softmax_with_cross_entropy"
+
+    def setup_method(self, m):
+        logits = _rand(4, 6)
+        lab = np.abs(_rand(4, 6, seed=9)) + 0.01
+        lab = lab / lab.sum(axis=1, keepdims=True)
+        z = logits - logits.max(axis=1, keepdims=True)
+        sm = np.exp(z) / np.exp(z).sum(axis=1, keepdims=True)
+        loss = -(lab * np.log(sm)).sum(axis=1, keepdims=True)
+        self.inputs = {"Logits": logits, "Label": lab.astype("float32")}
+        self.attrs = {"soft_label": True}
+        self.outputs = {"Softmax": [("sm", sm)], "Loss": [("loss", loss)]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestCrossEntropy(OpTest):
+    op_type = "cross_entropy"
+
+    def setup_method(self, m):
+        p = np.abs(_rand(4, 5)) + 0.05
+        p = p / p.sum(axis=1, keepdims=True)
+        label = np.random.RandomState(5).randint(0, 5, (4, 1)).astype("int64")
+        loss = -np.log(p[np.arange(4), label.ravel()]).reshape(4, 1)
+        self.inputs = {"X": p.astype("float32"), "Label": label}
+        self.outputs = {"Y": loss.astype("float32")}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestGroupNorm(OpTest):
+    op_type = "group_norm"
+    atol = 1e-4
+
+    def setup_method(self, m):
+        x = _rand(2, 4, 3, 3)
+        scale, bias = _rand(4, seed=11), _rand(4, seed=12)
+        eps = 1e-5
+        r = x.reshape(2, 2, 2, 3, 3)
+        mu = r.mean(axis=(2, 3, 4), keepdims=True)
+        var = r.var(axis=(2, 3, 4), keepdims=True)
+        y = ((r - mu) / np.sqrt(var + eps)).reshape(2, 4, 3, 3)
+        y = y * scale.reshape(1, 4, 1, 1) + bias.reshape(1, 4, 1, 1)
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias}
+        self.attrs = {"epsilon": eps, "groups": 2}
+        self.outputs = {
+            "Y": [("y", y)],
+            "Mean": [("mean", mu.reshape(2, 2))],
+            "Variance": [("var", var.reshape(2, 2))],
+        }
+
+    def test_output(self):
+        self.check_output()
