@@ -1,0 +1,75 @@
+"""Benchmark: ResNet-50 training throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline: H100 ResNet-50 train throughput ~2400 img/s/chip (mixed precision,
+bs256 — public MLPerf-era number); BASELINE.md gate is >=0.8x H100
+throughput.  Protocol per BASELINE.md: warmup then timed steps, median.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+H100_RESNET50_IMG_PER_SEC = 2400.0
+
+
+def bench_resnet(batch=128, image_size=224, warmup=5, iters=30, depth=50,
+                 dtype="float32"):
+    import paddle_tpu as fluid
+    from paddle_tpu.models import resnet
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img, label, loss, acc = resnet.build_train(
+            depth=depth, class_dim=1000, image_size=image_size, lr=0.1)
+
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    xb = rng.rand(batch, 3, image_size, image_size).astype("float32")
+    yb = rng.randint(0, 1000, (batch, 1)).astype("int32")
+
+    # stage the batch on device once (the DataLoader path double-buffers
+    # host->device copies asynchronously; this measures compute throughput
+    # with a warm input pipeline)
+    import jax
+
+    dev = fluid.TPUPlace(0).jax_device()
+    xb = jax.device_put(xb, dev)
+    yb = jax.device_put(yb, dev)
+
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        feed = {"img": xb, "label": yb}
+        for _ in range(warmup):
+            out, = exe.run(main, feed=feed, fetch_list=[loss])
+        np.asarray(out)  # sync
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            out, = exe.run(main, feed=feed, fetch_list=[loss])
+            np.asarray(out)  # block on result
+            times.append(time.perf_counter() - t0)
+    med = float(np.median(times))
+    return batch / med, float(np.asarray(out).reshape(-1)[0])
+
+
+def main():
+    batch = int(os.environ.get("BENCH_BATCH", "128"))
+    iters = int(os.environ.get("BENCH_ITERS", "30"))
+    img_per_sec, last_loss = bench_resnet(batch=batch, iters=iters)
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(img_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(img_per_sec / H100_RESNET50_IMG_PER_SEC, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

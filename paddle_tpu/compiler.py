@@ -78,6 +78,20 @@ class CompiledProgram:
     def with_inference_optimize(self, config):
         return self
 
+    def _with_mesh(self, mesh, data_axis="data"):
+        """TPU extension: run over an explicit jax.sharding.Mesh (e.g. a
+        ('data','model') mesh for DP x TP).  Parameters annotated with
+        Variable.sharding get the corresponding PartitionSpec."""
+        if data_axis not in mesh.axis_names:
+            raise ValueError(
+                "data_axis %r is not an axis of the mesh (axes: %s)"
+                % (data_axis, mesh.axis_names)
+            )
+        self._is_data_parallel = True
+        self._mesh_cached = mesh
+        self._data_axis = data_axis
+        return self
+
     def _mesh(self):
         if not self._is_data_parallel:
             return None

@@ -143,8 +143,12 @@ class Executor:
 
         if mesh is not None:
             feed_arrays = self._shard_feeds(feed_arrays, mesh, data_axis)
+            params_ro = self._shard_params(params_ro, mesh, block)
+            params_rw = self._shard_params(params_rw, mesh, block)
 
-        with jax.default_device(self._jax_device(mesh)):
+        dev = self._jax_device(mesh)
+        ctx = jax.default_device(dev) if dev is not None else contextlib.nullcontext()
+        with ctx:
             fetches, updated = entry.jfn(feed_arrays, params_ro, params_rw, rng)
 
         for n, val in updated.items():
@@ -204,6 +208,12 @@ class Executor:
         if v is not None and v.sharding:
             return NamedSharding(mesh, P(*v.sharding))
         return NamedSharding(mesh, P())
+
+    def _shard_params(self, params, mesh, block):
+        out = {}
+        for n, v in params.items():
+            out[n] = jax.device_put(v, self._param_sharding(mesh, block, n))
+        return out
 
     def _shard_feeds(self, feed_arrays, mesh, data_axis):
         from jax.sharding import NamedSharding, PartitionSpec as P

@@ -1,0 +1,214 @@
+"""LR schedules as in-graph ops (parity:
+python/paddle/fluid/layers/learning_rate_scheduler.py): noam, exponential,
+natural_exp, inverse_time, polynomial, piecewise, cosine, warmup."""
+
+import math
+
+from ..framework import default_main_program
+from ..initializer import Constant
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "exponential_decay",
+    "natural_exp_decay",
+    "inverse_time_decay",
+    "polynomial_decay",
+    "piecewise_decay",
+    "noam_decay",
+    "cosine_decay",
+    "linear_lr_warmup",
+]
+
+
+def _decay_step_counter(begin=0):
+    helper = LayerHelper("global_step_counter")
+    counter = helper.create_or_get_global_variable(
+        name="@LR_DECAY_COUNTER@", dtype="float32", shape=[1],
+        persistable=True
+    )
+    counter.stop_gradient = True
+    program = default_main_program()
+    already = any(
+        op.type == "increment" and op.output("Out") == [counter.name]
+        for op in program.global_block().ops
+    )
+    if not already:
+        Constant(float(begin))(counter)
+        with program._lr_schedule_guard():
+            program.global_block().append_op(
+                type="increment",
+                inputs={"X": [counter]},
+                outputs={"Out": [counter]},
+                attrs={"step": 1.0},
+            )
+    return counter
+
+
+def noam_decay(d_model, warmup_steps):
+    from . import nn, tensor
+
+    program = default_main_program()
+    with program._lr_schedule_guard():
+        step = _decay_step_counter(begin=1)
+        a = nn.pow(step, factor=-0.5)
+        b = nn.scale(step, scale=warmup_steps ** -1.5)
+        lr = nn.scale(
+            nn.elementwise_min(a, b), scale=d_model ** -0.5
+        )
+    return lr
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    from . import nn
+
+    program = default_main_program()
+    with program._lr_schedule_guard():
+        step = _decay_step_counter()
+        div = nn.scale(step, scale=1.0 / decay_steps)
+        if staircase:
+            div = nn.floor(div)
+        lr = nn.scale(
+            nn.elementwise_pow(_const_like(div, decay_rate), div),
+            scale=float(learning_rate),
+        )
+    return lr
+
+
+def _const_like(ref, value):
+    from . import tensor
+
+    return tensor.fill_constant([1], ref.dtype, value)
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    from . import nn
+
+    program = default_main_program()
+    with program._lr_schedule_guard():
+        step = _decay_step_counter()
+        div = nn.scale(step, scale=1.0 / decay_steps)
+        if staircase:
+            div = nn.floor(div)
+        # lr * exp(-decay_rate * t)
+        ex = nn.exp(nn.scale(div, scale=-decay_rate))
+        lr = nn.scale(ex, scale=float(learning_rate))
+    return lr
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate,
+                       staircase=False):
+    from . import nn, tensor
+
+    program = default_main_program()
+    with program._lr_schedule_guard():
+        step = _decay_step_counter()
+        div = nn.scale(step, scale=1.0 / decay_steps)
+        if staircase:
+            div = nn.floor(div)
+        denom = nn.scale(div, scale=decay_rate, bias=1.0)
+        lr = nn.elementwise_div(
+            tensor.fill_constant([1], "float32", float(learning_rate)), denom
+        )
+    return lr
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=0.0001,
+                     power=1.0, cycle=False):
+    from . import nn, tensor
+
+    program = default_main_program()
+    with program._lr_schedule_guard():
+        step = _decay_step_counter()
+        if cycle:
+            ratio = nn.scale(step, scale=1.0 / decay_steps)
+            div = nn.ceil(nn.elementwise_max(
+                ratio, tensor.fill_constant([1], "float32", 1e-12)))
+            steps = nn.scale(div, scale=float(decay_steps))
+        else:
+            steps = tensor.fill_constant([1], "float32", float(decay_steps))
+            step = nn.elementwise_min(step, steps)
+        frac = nn.elementwise_div(step, steps)
+        one_minus = nn.scale(frac, scale=-1.0, bias=1.0)
+        powed = nn.pow(one_minus, factor=power)
+        lr = nn.scale(powed, scale=float(learning_rate - end_learning_rate),
+                      bias=float(end_learning_rate))
+    return lr
+
+
+def piecewise_decay(boundaries, values):
+    """sum_i values[i] * 1[b_{i-1} <= step < b_i]"""
+    from . import nn, tensor
+    from . import cast as _cast  # noqa: F401
+
+    assert len(boundaries) + 1 == len(values)
+    program = default_main_program()
+    with program._lr_schedule_guard():
+        step = _decay_step_counter()
+        pieces = []
+        prev = None
+        for i, v in enumerate(values):
+            if i == 0:
+                cond = step < tensor.fill_constant([1], "float32",
+                                                  float(boundaries[0]))
+            elif i < len(boundaries):
+                lo = tensor.fill_constant([1], "float32",
+                                          float(boundaries[i - 1]))
+                hi = tensor.fill_constant([1], "float32",
+                                          float(boundaries[i]))
+                from .. import layers as L
+
+                cond = L.logical_and(step >= lo, step < hi)
+            else:
+                lo = tensor.fill_constant([1], "float32",
+                                          float(boundaries[-1]))
+                cond = step >= lo
+            ind = tensor.cast(cond, "float32")
+            pieces.append(nn.scale(ind, scale=float(v)))
+        lr = pieces[0]
+        for p in pieces[1:]:
+            lr = nn.elementwise_add(lr, p)
+    return lr
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    from . import nn
+
+    program = default_main_program()
+    with program._lr_schedule_guard():
+        step = _decay_step_counter()
+        epoch = nn.floor(nn.scale(step, scale=1.0 / step_each_epoch))
+        cos_arg = nn.scale(epoch, scale=math.pi / epochs)
+        # lr = 0.5 * base * (cos(epoch*pi/epochs) + 1)
+        lr = nn.scale(_cos(cos_arg), scale=0.5 * learning_rate,
+                      bias=0.5 * learning_rate)
+    return lr
+
+
+def _cos(x):
+    from ..layer_helper import LayerHelper
+
+    helper = LayerHelper("cos")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="cos", inputs={"X": [x]}, outputs={"Out": [out]})
+    return out
+
+
+def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    """lr = start + (end-start)*step/warmup while step<warmup else base."""
+    from . import nn, tensor
+
+    program = default_main_program()
+    with program._lr_schedule_guard():
+        step = _decay_step_counter()
+        wsteps = tensor.fill_constant([1], "float32", float(warmup_steps))
+        frac = nn.elementwise_div(nn.elementwise_min(step, wsteps), wsteps)
+        warm = nn.scale(frac, scale=float(end_lr - start_lr),
+                        bias=float(start_lr))
+        in_warm = tensor.cast(step < wsteps, "float32")
+        if not hasattr(learning_rate, "name"):
+            learning_rate = tensor.fill_constant(
+                [1], "float32", float(learning_rate))
+        after = nn.elementwise_mul(
+            learning_rate, nn.scale(in_warm, scale=-1.0, bias=1.0))
+        lr = nn.elementwise_add(nn.elementwise_mul(warm, in_warm), after)
+    return lr

@@ -1,0 +1,5 @@
+"""Model zoo built on the fluid-style layer API (BASELINE configs 1-4)."""
+
+from . import mnist  # noqa: F401
+from . import resnet  # noqa: F401
+from . import bert  # noqa: F401

@@ -1,0 +1,150 @@
+"""BERT-style transformer encoder (BASELINE config 3).
+
+Built from the fluid layer API exactly as the reference's ERNIE/BERT scripts
+compose it (fc/matmul/softmax/dropout/layer_norm; the fused attention path in
+the reference is inference-only multihead_matmul_op.cu — here attention is
+left to XLA fusion, with a Pallas flash-attention kernel as the fast path,
+see paddle_tpu/pallas_kernels/flash_attention.py).
+
+TP sharding: pass ``mesh_tp=True`` to annotate qkv/ffn weights with
+PartitionSpec axis names consumed by the executor for tensor parallelism.
+"""
+
+import paddle_tpu as fluid
+from paddle_tpu.param_attr import ParamAttr
+
+
+class BertConfig:
+    def __init__(self, vocab_size=30522, hidden=768, layers=12, heads=12,
+                 ffn=3072, max_pos=512, type_vocab=2, dropout=0.1):
+        self.vocab_size = vocab_size
+        self.hidden = hidden
+        self.layers = layers
+        self.heads = heads
+        self.ffn = ffn
+        self.max_pos = max_pos
+        self.type_vocab = type_vocab
+        self.dropout = dropout
+
+
+BERT_BASE = BertConfig()
+BERT_TINY = BertConfig(vocab_size=1024, hidden=64, layers=2, heads=4,
+                       ffn=128, max_pos=64)
+
+
+def _attr(name, tp_axes=None, use_tp=False):
+    return ParamAttr(name=name, sharding=tp_axes if use_tp else None)
+
+
+def multi_head_attention(x, cfg, prefix, is_test=False, use_tp=False,
+                         attn_mask=None):
+    """Self-attention from primitives; XLA fuses QK^T-softmax-V; the Pallas
+    fast path replaces the inner three ops when enabled."""
+    h, heads = cfg.hidden, cfg.heads
+    d = h // heads
+    q = fluid.layers.fc(x, h, num_flatten_dims=2,
+                        param_attr=_attr(prefix + "_q_w", (None, "model"), use_tp))
+    k = fluid.layers.fc(x, h, num_flatten_dims=2,
+                        param_attr=_attr(prefix + "_k_w", (None, "model"), use_tp))
+    v = fluid.layers.fc(x, h, num_flatten_dims=2,
+                        param_attr=_attr(prefix + "_v_w", (None, "model"), use_tp))
+
+    def split_heads(t):
+        t = fluid.layers.reshape(t, [0, 0, heads, d])
+        return fluid.layers.transpose(t, [0, 2, 1, 3])
+
+    q, k, v = split_heads(q), split_heads(k), split_heads(v)
+    scores = fluid.layers.matmul(q, k, transpose_y=True,
+                                 alpha=d ** -0.5)
+    if attn_mask is not None:
+        scores = fluid.layers.elementwise_add(scores, attn_mask)
+    probs = fluid.layers.softmax(scores)
+    if cfg.dropout and not is_test:
+        probs = fluid.layers.dropout(
+            probs, cfg.dropout, is_test=is_test,
+            dropout_implementation="upscale_in_train")
+    ctxv = fluid.layers.matmul(probs, v)
+    ctxv = fluid.layers.transpose(ctxv, [0, 2, 1, 3])
+    ctxv = fluid.layers.reshape(ctxv, [0, 0, h])
+    out = fluid.layers.fc(ctxv, h, num_flatten_dims=2,
+                          param_attr=_attr(prefix + "_out_w", ("model", None),
+                                           use_tp))
+    return out
+
+
+def encoder_layer(x, cfg, prefix, is_test=False, use_tp=False,
+                  attn_mask=None):
+    attn = multi_head_attention(x, cfg, prefix + "_attn", is_test, use_tp,
+                                attn_mask)
+    if cfg.dropout and not is_test:
+        attn = fluid.layers.dropout(
+            attn, cfg.dropout, is_test=is_test,
+            dropout_implementation="upscale_in_train")
+    x = fluid.layers.layer_norm(
+        fluid.layers.elementwise_add(x, attn), begin_norm_axis=2)
+    ffn = fluid.layers.fc(x, cfg.ffn, num_flatten_dims=2, act="gelu",
+                          param_attr=_attr(prefix + "_ffn1_w",
+                                           (None, "model"), use_tp))
+    ffn = fluid.layers.fc(ffn, cfg.hidden, num_flatten_dims=2,
+                          param_attr=_attr(prefix + "_ffn2_w",
+                                           ("model", None), use_tp))
+    if cfg.dropout and not is_test:
+        ffn = fluid.layers.dropout(
+            ffn, cfg.dropout, is_test=is_test,
+            dropout_implementation="upscale_in_train")
+    return fluid.layers.layer_norm(
+        fluid.layers.elementwise_add(x, ffn), begin_norm_axis=2)
+
+
+def embeddings(src_ids, pos_ids, sent_ids, cfg, is_test=False):
+    w = fluid.layers.embedding(src_ids, (cfg.vocab_size, cfg.hidden),
+                               param_attr=ParamAttr(name="word_emb"))
+    p = fluid.layers.embedding(pos_ids, (cfg.max_pos, cfg.hidden),
+                               param_attr=ParamAttr(name="pos_emb"))
+    s = fluid.layers.embedding(sent_ids, (cfg.type_vocab, cfg.hidden),
+                               param_attr=ParamAttr(name="sent_emb"))
+    emb = fluid.layers.elementwise_add(
+        fluid.layers.elementwise_add(w, p), s)
+    emb = fluid.layers.layer_norm(emb, begin_norm_axis=2)
+    if cfg.dropout and not is_test:
+        emb = fluid.layers.dropout(
+            emb, cfg.dropout, is_test=is_test,
+            dropout_implementation="upscale_in_train")
+    return emb
+
+
+def bert_encoder(cfg, seq_len, is_test=False, use_tp=False):
+    """Declare inputs + build the encoder stack; returns (inputs, sequence_output)."""
+    src_ids = fluid.layers.data("src_ids", shape=[seq_len, 1], dtype="int64")
+    pos_ids = fluid.layers.data("pos_ids", shape=[seq_len, 1], dtype="int64")
+    sent_ids = fluid.layers.data("sent_ids", shape=[seq_len, 1], dtype="int64")
+    input_mask = fluid.layers.data("input_mask", shape=[seq_len, 1])
+    x = embeddings(src_ids, pos_ids, sent_ids, cfg, is_test)
+    # attention mask: (1-m)(1-m)^T -> -1e4 where padded
+    mask2d = fluid.layers.matmul(input_mask, input_mask, transpose_y=True)
+    attn_mask = fluid.layers.scale(mask2d, scale=1e4, bias=-1e4)
+    attn_mask = fluid.layers.unsqueeze(attn_mask, [1])  # [B,1,S,S]
+    for i in range(cfg.layers):
+        x = encoder_layer(x, cfg, "layer_%d" % i, is_test, use_tp, attn_mask)
+    return (src_ids, pos_ids, sent_ids, input_mask), x
+
+
+def build_pretrain(cfg=BERT_BASE, seq_len=128, lr=1e-4, is_test=False,
+                   use_tp=False, mask_frac=0.15):
+    """Masked-LM pretraining objective (simplified: predict at mask
+    positions supplied as gather indices, like the reference's
+    mask_label/mask_pos feeds)."""
+    inputs, seq_out = bert_encoder(cfg, seq_len, is_test, use_tp)
+    mask_pos = fluid.layers.data("mask_pos", shape=[1], dtype="int64")
+    mask_label = fluid.layers.data("mask_label", shape=[1], dtype="int64")
+    flat = fluid.layers.reshape(seq_out, [-1, cfg.hidden])
+    picked = fluid.layers.gather(flat, mask_pos)
+    trans = fluid.layers.fc(picked, cfg.hidden, act="gelu")
+    trans = fluid.layers.layer_norm(trans, begin_norm_axis=1)
+    logits = fluid.layers.fc(trans, cfg.vocab_size)
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, mask_label))
+    if not is_test:
+        opt = fluid.optimizer.Adam(learning_rate=lr)
+        opt.minimize(loss)
+    return inputs + (mask_pos, mask_label), loss

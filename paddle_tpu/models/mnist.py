@@ -1,0 +1,31 @@
+"""MNIST models (BASELINE config 1; mirrors reference
+tests/book/test_recognize_digits.py model builders)."""
+
+import paddle_tpu as fluid
+
+
+def build_mlp(img_shape=(784,), num_classes=10):
+    img = fluid.layers.data("img", shape=list(img_shape))
+    label = fluid.layers.data("label", shape=[1], dtype="int64")
+    h = fluid.layers.fc(img, 200, act="relu")
+    h = fluid.layers.fc(h, 200, act="relu")
+    logits = fluid.layers.fc(h, num_classes)
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, label))
+    acc = fluid.layers.accuracy(fluid.layers.softmax(logits), label)
+    return img, label, logits, loss, acc
+
+
+def build_conv(num_classes=10):
+    """LeNet-style convnet (reference: conv_net in test_recognize_digits)."""
+    img = fluid.layers.data("img", shape=[1, 28, 28])
+    label = fluid.layers.data("label", shape=[1], dtype="int64")
+    c1 = fluid.layers.conv2d(img, 20, 5, act="relu")
+    p1 = fluid.layers.pool2d(c1, pool_size=2, pool_stride=2)
+    c2 = fluid.layers.conv2d(p1, 50, 5, act="relu")
+    p2 = fluid.layers.pool2d(c2, pool_size=2, pool_stride=2)
+    logits = fluid.layers.fc(p2, num_classes)
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, label))
+    acc = fluid.layers.accuracy(fluid.layers.softmax(logits), label)
+    return img, label, logits, loss, acc

@@ -1,0 +1,82 @@
+"""ResNet for ImageNet (BASELINE config 2).
+
+Built with the fluid layer API the same way the reference's model scripts do
+(conv2d + batch_norm + momentum; cf. dist_se_resnext.py test payload and the
+classic fluid ResNet script).  bottleneck v1.5 architecture.
+"""
+
+import paddle_tpu as fluid
+
+DEPTH_CFG = {
+    18: ("basic", [2, 2, 2, 2]),
+    34: ("basic", [3, 4, 6, 3]),
+    50: ("bottleneck", [3, 4, 6, 3]),
+    101: ("bottleneck", [3, 4, 23, 3]),
+    152: ("bottleneck", [3, 8, 36, 3]),
+}
+
+
+def conv_bn(x, filters, size, stride=1, act=None, is_test=False, name=None):
+    c = fluid.layers.conv2d(
+        x, filters, size, stride=stride, padding=(size - 1) // 2,
+        bias_attr=False, name=name,
+    )
+    return fluid.layers.batch_norm(c, act=act, is_test=is_test)
+
+
+def basic_block(x, filters, stride, is_test=False):
+    conv0 = conv_bn(x, filters, 3, stride, act="relu", is_test=is_test)
+    conv1 = conv_bn(conv0, filters, 3, 1, is_test=is_test)
+    if stride != 1 or x.shape[1] != filters:
+        shortcut = conv_bn(x, filters, 1, stride, is_test=is_test)
+    else:
+        shortcut = x
+    return fluid.layers.relu(fluid.layers.elementwise_add(conv1, shortcut))
+
+
+def bottleneck_block(x, filters, stride, is_test=False):
+    conv0 = conv_bn(x, filters, 1, 1, act="relu", is_test=is_test)
+    conv1 = conv_bn(conv0, filters, 3, stride, act="relu", is_test=is_test)
+    conv2 = conv_bn(conv1, filters * 4, 1, 1, is_test=is_test)
+    if stride != 1 or x.shape[1] != filters * 4:
+        shortcut = conv_bn(x, filters * 4, 1, stride, is_test=is_test)
+    else:
+        shortcut = x
+    return fluid.layers.relu(fluid.layers.elementwise_add(conv2, shortcut))
+
+
+def resnet(img, class_dim=1000, depth=50, is_test=False):
+    block_fn, counts = (
+        (basic_block, DEPTH_CFG[depth][1])
+        if DEPTH_CFG[depth][0] == "basic"
+        else (bottleneck_block, DEPTH_CFG[depth][1])
+    )
+    x = conv_bn(img, 64, 7, 2, act="relu", is_test=is_test)
+    x = fluid.layers.pool2d(x, pool_size=3, pool_stride=2, pool_padding=1)
+    for stage, n in enumerate(counts):
+        filters = 64 * (2 ** stage)
+        for i in range(n):
+            stride = 2 if (i == 0 and stage > 0) else 1
+            x = block_fn(x, filters, stride, is_test=is_test)
+    x = fluid.layers.pool2d(x, pool_type="avg", global_pooling=True)
+    logits = fluid.layers.fc(x, class_dim)
+    return logits
+
+
+def build_train(depth=50, class_dim=1000, image_size=224, lr=0.1,
+                momentum=0.9, weight_decay=1e-4, is_test=False):
+    """Returns (img, label, loss, acc) inside the current program guard."""
+    img = fluid.layers.data("img", shape=[3, image_size, image_size])
+    label = fluid.layers.data("label", shape=[1], dtype="int64")
+    logits = resnet(img, class_dim, depth, is_test=is_test)
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, label))
+    acc = fluid.layers.accuracy(fluid.layers.softmax(logits), label)
+    if not is_test:
+        opt = fluid.optimizer.Momentum(
+            learning_rate=lr,
+            momentum=momentum,
+            regularization=fluid.regularizer.L2Decay(weight_decay),
+        )
+        opt.minimize(loss)
+    return img, label, loss, acc

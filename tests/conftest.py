@@ -1,14 +1,19 @@
 """Test config: run JAX on a virtual 8-device CPU mesh (multi-chip sharding
-tests run here; the driver separately dry-runs the real TPU path)."""
+tests run here; the driver separately dry-runs the real TPU path).
+
+NB: the axon sitecustomize registers the TPU plugin and overrides
+jax_platforms at interpreter start, so env vars alone are not enough — the
+config updates below force the CPU backend before any backend is created.
+"""
 
 import os
 import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,106 @@
+"""Model-zoo smoke tests on tiny shapes (mirror of the reference's book
+tests; full-size runs happen in bench.py on real hardware)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.models import bert, resnet
+
+
+def test_resnet18_tiny_trains():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img, label, loss, acc = resnet.build_train(
+            depth=18, class_dim=10, image_size=32, lr=0.01)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    xb = rng.randn(4, 3, 32, 32).astype("float32")
+    yb = rng.randint(0, 10, (4, 1)).astype("int64")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(3):
+            lo, = exe.run(main, feed={"img": xb, "label": yb},
+                          fetch_list=[loss])
+            losses.append(float(lo[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_resnet50_builds():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img, label, loss, acc = resnet.build_train(
+            depth=50, class_dim=100, image_size=64, lr=0.1)
+    n_params = len(main.global_block().all_parameters())
+    # 53 convs + 53 BN(scale,bias) + fc(w,b) = 161
+    assert n_params == 161, n_params
+
+
+def test_bert_tiny_trains():
+    cfg = bert.BERT_TINY
+    seq = 16
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        inputs, loss = bert.build_pretrain(cfg, seq_len=seq, lr=1e-3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    B = 2
+    feed = {
+        "src_ids": rng.randint(0, cfg.vocab_size, (B, seq, 1)).astype("int64"),
+        "pos_ids": np.tile(np.arange(seq).reshape(1, seq, 1), (B, 1, 1)).astype("int64"),
+        "sent_ids": np.zeros((B, seq, 1), "int64"),
+        "input_mask": np.ones((B, seq, 1), "float32"),
+        "mask_pos": np.array([1, 5, seq + 2], "int64"),
+        "mask_label": rng.randint(0, cfg.vocab_size, (3, 1)).astype("int64"),
+    }
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(4):
+            lo, = exe.run(main, feed=feed, fetch_list=[loss])
+            losses.append(float(lo[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_lr_scheduler_piecewise():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        lr = fluid.layers.piecewise_decay([2, 4], [0.1, 0.01, 0.001])
+        x = fluid.layers.data("x", shape=[2])
+        w = fluid.layers.fc(x, 2, bias_attr=False)
+        loss = fluid.layers.mean(w)
+        opt = fluid.optimizer.SGD(learning_rate=lr)
+        opt.minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xb = np.ones((1, 2), "float32")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        lrs = []
+        for _ in range(6):
+            out, = exe.run(main, feed={"x": xb}, fetch_list=[lr])
+            lrs.append(float(out[0]))
+    assert lrs[0] == pytest.approx(0.1)
+    assert lrs[2] == pytest.approx(0.01)
+    assert lrs[5] == pytest.approx(0.001)
+
+
+def test_lr_scheduler_noam_and_warmup():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        lr = fluid.layers.noam_decay(64, 10)
+        x = fluid.layers.data("x", shape=[2])
+        loss = fluid.layers.mean(fluid.layers.fc(x, 2))
+        fluid.optimizer.Adam(learning_rate=lr).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        vals = []
+        for _ in range(5):
+            out, = exe.run(main, feed={"x": np.ones((1, 2), "f")},
+                           fetch_list=[lr])
+            vals.append(float(out[0]))
+    # warmup region: increasing
+    assert vals[1] > vals[0]
