@@ -18,15 +18,16 @@ import numpy as np
 H100_RESNET50_IMG_PER_SEC = 2400.0
 
 
-def bench_resnet(batch=128, image_size=224, warmup=5, iters=30, depth=50,
-                 dtype="float32"):
+def bench_resnet(batch=512, image_size=224, warmup=5, iters=30, depth=50,
+                 amp=True):
     import paddle_tpu as fluid
     from paddle_tpu.models import resnet
 
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
         img, label, loss, acc = resnet.build_train(
-            depth=depth, class_dim=1000, image_size=image_size, lr=0.1)
+            depth=depth, class_dim=1000, image_size=image_size, lr=0.1,
+            amp=amp)
 
     exe = fluid.Executor(fluid.TPUPlace(0))
     scope = fluid.Scope()
@@ -60,9 +61,10 @@ def bench_resnet(batch=128, image_size=224, warmup=5, iters=30, depth=50,
 
 
 def main():
-    batch = int(os.environ.get("BENCH_BATCH", "128"))
+    batch = int(os.environ.get("BENCH_BATCH", "512"))
     iters = int(os.environ.get("BENCH_ITERS", "30"))
-    img_per_sec, last_loss = bench_resnet(batch=batch, iters=iters)
+    amp = os.environ.get("BENCH_AMP", "1") == "1"
+    img_per_sec, last_loss = bench_resnet(batch=batch, iters=iters, amp=amp)
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(img_per_sec, 2),

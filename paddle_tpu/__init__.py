@@ -36,6 +36,7 @@ from . import optimizer
 from . import regularizer
 from . import clip
 from . import backward
+from . import contrib
 from . import unique_name_compat as unique_name  # noqa: F401
 from .data_feeder import DataFeeder
 from . import io

@@ -1,0 +1,172 @@
+"""Mixed-precision optimizer decorator.
+
+Parity: contrib/mixed_precision/decorator.py (decorate at :216,
+OptimizerWithMixedPrecision at :27).  TPU-native policy: instead of the
+reference's fp16 program-rewrite, the program is flagged for **bf16 MXU
+compute** (matmul/conv lowerings read the flag; the MXU accumulates in f32
+in hardware) — numerically robust on TPU without loss scaling.  Static and
+dynamic loss scaling (reference decorator.py:112-185) are implemented
+branchlessly (mask arithmetic instead of control-flow ops): on overflow the
+unscaled grads are zeroed — making the update a near-no-op — and the scale
+backs off by decr_ratio; after incr_every_n_steps clean steps it grows by
+incr_ratio.
+"""
+
+from ...framework import default_main_program
+from ...initializer import Constant
+from ...utils import unique_name
+from .fp16_lists import AutoMixedPrecisionLists
+
+__all__ = ["decorate", "OptimizerWithMixedPrecision"]
+
+
+class OptimizerWithMixedPrecision:
+    def __init__(self, optimizer, amp_lists=None, init_loss_scaling=1.0,
+                 use_dynamic_loss_scaling=False, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, incr_ratio=2.0, decr_ratio=0.8):
+        self._optimizer = optimizer
+        self._amp_lists = amp_lists or AutoMixedPrecisionLists()
+        self._init_loss_scaling = float(init_loss_scaling)
+        self._use_dynamic_loss_scaling = use_dynamic_loss_scaling
+        self._incr_every_n_steps = incr_every_n_steps
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._loss_scaling_var = None
+        self._scaled_loss = None
+
+    def get_loss_scaling(self):
+        return self._loss_scaling_var
+
+    def get_scaled_loss(self):
+        return self._scaled_loss
+
+    # -- helpers -------------------------------------------------------------
+    def _create_scale_var(self, block):
+        var = block.create_var(
+            name=unique_name.generate("loss_scaling"),
+            shape=(1,), dtype="float32", persistable=True)
+        var.stop_gradient = True
+        Constant(self._init_loss_scaling)(var)
+        self._loss_scaling_var = var
+        good = block.create_var(
+            name=unique_name.generate("good_steps"),
+            shape=(1,), dtype="float32", persistable=True)
+        good.stop_gradient = True
+        Constant(0.0)(good)
+        self._good_steps_var = good
+        return var
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        from ... import layers
+
+        program = loss.block.program
+        block = program.global_block()
+        program._amp_bf16 = True  # bf16 MXU policy for all matmul/conv
+
+        dynamic = self._use_dynamic_loss_scaling
+        static_scale = self._init_loss_scaling != 1.0 and not dynamic
+
+        if dynamic:
+            scale_var = self._create_scale_var(block)
+            self._scaled_loss = layers.elementwise_mul(loss, scale_var)
+        elif static_scale:
+            self._scaled_loss = layers.scale(loss,
+                                             scale=self._init_loss_scaling)
+        else:
+            self._scaled_loss = loss
+
+        params_grads = self._optimizer.backward(
+            self._scaled_loss, startup_program, parameter_list, no_grad_set,
+            callbacks)
+
+        if not (dynamic or static_scale):
+            return params_grads
+
+        with program._backward_role_guard():
+            grads = [g for _, g in params_grads if g is not None]
+            if dynamic:
+                # isfinite op is duplicable over X: one fused all-finite check
+                fin = block.create_var(
+                    name=unique_name.generate("all_grads_finite"),
+                    shape=(1,), dtype="bool")
+                block.append_op(type="isfinite", inputs={"X": grads},
+                                outputs={"Out": [fin]})
+                fin_f = layers.cast(fin, "float32")
+                inv_scale = layers.elementwise_div(
+                    fin_f, self._loss_scaling_var)  # 0 on overflow
+                unscaled = []
+                for p, g in params_grads:
+                    if g is None:
+                        unscaled.append((p, g))
+                        continue
+                    unscaled.append((p, layers.elementwise_mul(g, inv_scale)))
+                self._append_scale_update(fin_f)
+                return unscaled
+            # static
+            unscaled = []
+            for p, g in params_grads:
+                if g is None:
+                    unscaled.append((p, g))
+                    continue
+                unscaled.append(
+                    (p, layers.scale(g, scale=1.0 / self._init_loss_scaling)))
+            return unscaled
+
+    def _append_scale_update(self, fin_f):
+        """good' = (good+1)*fin; scale' = fin*(good'>=N ? scale*incr : scale)
+        + (1-fin)*scale*decr; good'' = good' mod-reset at N."""
+        from ... import layers
+
+        scale_var = self._loss_scaling_var
+        good = self._good_steps_var
+        one_minus = layers.scale(fin_f, scale=-1.0, bias=1.0)
+        good_next = layers.elementwise_mul(
+            layers.scale(good, bias=1.0), fin_f)
+        from ...layers import tensor as ltensor
+
+        n = ltensor.fill_constant([1], "float32",
+                                  float(self._incr_every_n_steps))
+        reached = layers.cast(good_next >= n, "float32")
+        not_reached = layers.scale(reached, scale=-1.0, bias=1.0)
+        grown = layers.scale(scale_var, scale=self._incr_ratio)
+        shrunk = layers.scale(scale_var, scale=self._decr_ratio)
+        keep_or_grow = layers.elementwise_add(
+            layers.elementwise_mul(grown, reached),
+            layers.elementwise_mul(scale_var, not_reached))
+        new_scale = layers.elementwise_add(
+            layers.elementwise_mul(keep_or_grow, fin_f),
+            layers.elementwise_mul(shrunk, one_minus))
+        new_good = layers.elementwise_mul(good_next, not_reached)
+        block = scale_var.block
+        block.append_op(type="assign", inputs={"X": [new_scale]},
+                        outputs={"Out": [scale_var]})
+        block.append_op(type="assign", inputs={"X": [new_good]},
+                        outputs={"Out": [good]})
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        params_grads = self.backward(loss, startup_program, parameter_list,
+                                     no_grad_set)
+        optimize_ops = self.apply_gradients(params_grads)
+        return optimize_ops, params_grads
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=1.0,
+             incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+             incr_ratio=2.0, decr_ratio=0.8,
+             use_dynamic_loss_scaling=False):
+    """Wrap an optimizer for mixed-precision training (reference
+    decorator.py:216)."""
+    return OptimizerWithMixedPrecision(
+        optimizer, amp_lists, init_loss_scaling, use_dynamic_loss_scaling,
+        incr_every_n_steps, decr_every_n_nan_or_inf, incr_ratio, decr_ratio)

@@ -109,7 +109,12 @@ class Executor:
         feed_arrays = {}
         block = program.global_block()
         for name, value in feed.items():
-            arr = np.asarray(value) if not isinstance(value, jax.Array) else value
+            if isinstance(value, jax.Array):
+                # device-resident feed: never pull back to host for dtype
+                # coercion (x64-disabled JAX can't hold int64 anyway)
+                feed_arrays[name] = value
+                continue
+            arr = np.asarray(value)
             v = block._find_var_recursive(name)
             if v is not None and v.dtype is not None and arr.dtype != dtype_to_np(v.dtype):
                 arr = np.asarray(arr, dtype=dtype_to_np(v.dtype))

@@ -47,6 +47,13 @@ class LowerCtx:
         self._rng_n += 1
         return k
 
+    def amp_bf16(self):
+        """True when the program requests the bf16 mixed-precision policy
+        (set by paddle_tpu.contrib.mixed_precision.decorate)."""
+        blk = self.block
+        prog = blk.program if blk is not None else None
+        return bool(getattr(prog, "_amp_bf16", False))
+
     @classmethod
     def abstract(cls, n_rng=0):
         return cls(mode="abstract")

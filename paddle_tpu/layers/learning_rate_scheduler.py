@@ -44,6 +44,16 @@ def _decay_step_counter(begin=0):
     return counter
 
 
+
+
+def _unary(op_type, x):
+    """Append a single-input activation op (exp/floor/ceil/cos live in the
+    op registry, not the nn module namespace)."""
+    helper = LayerHelper(op_type)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type=op_type, inputs={"X": [x]}, outputs={"Out": [out]})
+    return out
+
 def noam_decay(d_model, warmup_steps):
     from . import nn, tensor
 
@@ -66,7 +76,7 @@ def exponential_decay(learning_rate, decay_steps, decay_rate, staircase=False):
         step = _decay_step_counter()
         div = nn.scale(step, scale=1.0 / decay_steps)
         if staircase:
-            div = nn.floor(div)
+            div = _unary("floor", div)
         lr = nn.scale(
             nn.elementwise_pow(_const_like(div, decay_rate), div),
             scale=float(learning_rate),
@@ -88,9 +98,9 @@ def natural_exp_decay(learning_rate, decay_steps, decay_rate, staircase=False):
         step = _decay_step_counter()
         div = nn.scale(step, scale=1.0 / decay_steps)
         if staircase:
-            div = nn.floor(div)
+            div = _unary("floor", div)
         # lr * exp(-decay_rate * t)
-        ex = nn.exp(nn.scale(div, scale=-decay_rate))
+        ex = _unary("exp", nn.scale(div, scale=-decay_rate))
         lr = nn.scale(ex, scale=float(learning_rate))
     return lr
 
@@ -104,7 +114,7 @@ def inverse_time_decay(learning_rate, decay_steps, decay_rate,
         step = _decay_step_counter()
         div = nn.scale(step, scale=1.0 / decay_steps)
         if staircase:
-            div = nn.floor(div)
+            div = _unary("floor", div)
         denom = nn.scale(div, scale=decay_rate, bias=1.0)
         lr = nn.elementwise_div(
             tensor.fill_constant([1], "float32", float(learning_rate)), denom
@@ -121,7 +131,7 @@ def polynomial_decay(learning_rate, decay_steps, end_learning_rate=0.0001,
         step = _decay_step_counter()
         if cycle:
             ratio = nn.scale(step, scale=1.0 / decay_steps)
-            div = nn.ceil(nn.elementwise_max(
+            div = _unary("ceil", nn.elementwise_max(
                 ratio, tensor.fill_constant([1], "float32", 1e-12)))
             steps = nn.scale(div, scale=float(decay_steps))
         else:
@@ -138,7 +148,6 @@ def polynomial_decay(learning_rate, decay_steps, end_learning_rate=0.0001,
 def piecewise_decay(boundaries, values):
     """sum_i values[i] * 1[b_{i-1} <= step < b_i]"""
     from . import nn, tensor
-    from . import cast as _cast  # noqa: F401
 
     assert len(boundaries) + 1 == len(values)
     program = default_main_program()
@@ -176,7 +185,7 @@ def cosine_decay(learning_rate, step_each_epoch, epochs):
     program = default_main_program()
     with program._lr_schedule_guard():
         step = _decay_step_counter()
-        epoch = nn.floor(nn.scale(step, scale=1.0 / step_each_epoch))
+        epoch = _unary("floor", nn.scale(step, scale=1.0 / step_each_epoch))
         cos_arg = nn.scale(epoch, scale=math.pi / epochs)
         # lr = 0.5 * base * (cos(epoch*pi/epochs) + 1)
         lr = nn.scale(_cos(cos_arg), scale=0.5 * learning_rate,

@@ -64,7 +64,7 @@ def resnet(img, class_dim=1000, depth=50, is_test=False):
 
 
 def build_train(depth=50, class_dim=1000, image_size=224, lr=0.1,
-                momentum=0.9, weight_decay=1e-4, is_test=False):
+                momentum=0.9, weight_decay=1e-4, is_test=False, amp=False):
     """Returns (img, label, loss, acc) inside the current program guard."""
     img = fluid.layers.data("img", shape=[3, image_size, image_size])
     label = fluid.layers.data("label", shape=[1], dtype="int64")
@@ -78,5 +78,7 @@ def build_train(depth=50, class_dim=1000, image_size=224, lr=0.1,
             momentum=momentum,
             regularization=fluid.regularizer.L2Decay(weight_decay),
         )
+        if amp:
+            opt = fluid.contrib.mixed_precision.decorate(opt)
         opt.minimize(loss)
     return img, label, loss, acc

@@ -23,6 +23,20 @@ def _flatten2d(x, num_col_dims):
     return jnp.reshape(x, (lead, rest))
 
 
+def _amp_dot(ctx, x, y, contract_fn):
+    """Matmul helper honoring the program's AMP policy: bf16 operands with
+    the result cast back to f32.  On TPU the MXU accumulates bf16 products
+    in f32 in hardware; the output dtype stays bf16 (not
+    preferred_element_type=f32) so operand and cotangent dtypes remain
+    uniform and the dot/conv transpose rules are well-typed under vjp.
+    (XLA:CPU may round-trip partials through bf16 — test-only backend.)
+    TPU-native replacement for the reference's fp16 cast-rewrite."""
+    if ctx is not None and ctx.amp_bf16() and x.dtype == jnp.float32:
+        out = contract_fn(x.astype(jnp.bfloat16), y.astype(jnp.bfloat16))
+        return out.astype(jnp.float32)
+    return contract_fn(x, y)
+
+
 @register_op(
     "mul",
     inputs=("X", "Y"),
@@ -36,7 +50,7 @@ def mul(ctx, x, y, x_num_col_dims=1, y_num_col_dims=1, **_):
     (mul_op.cc:37); output keeps the unflattened leading/trailing dims."""
     x2d = _flatten2d(x, x_num_col_dims)
     y2d = _flatten2d(y, y_num_col_dims)
-    out = jnp.dot(x2d, y2d, preferred_element_type=None)
+    out = _amp_dot(ctx, x2d, y2d, jnp.dot)
     out_shape = x.shape[:x_num_col_dims] + y.shape[y_num_col_dims:]
     return jnp.reshape(out, out_shape)
 
@@ -61,7 +75,7 @@ def matmul(ctx, x, y, transpose_X=False, transpose_Y=False, alpha=1.0,
 
     x_, y_ = t(x, transpose_X), t(y, transpose_Y)
     # fluid allows [K] vectors: matmul handles 1-D semantics like numpy
-    out = jnp.matmul(x_, y_)
+    out = _amp_dot(ctx, x_, y_, jnp.matmul)
     if alpha != 1.0:
         out = out * jnp.asarray(alpha, dtype=out.dtype)
     return out

@@ -49,15 +49,20 @@ def conv2d(ctx, x, w, strides=(1, 1), paddings=(0, 0), dilations=(1, 1),
         else:  # [top, bottom, left, right]
             pad = [(p[0], p[1]), (p[2], p[3])]
     dn = lax.conv_dimension_numbers(x.shape, w.shape, _conv_dims(data_format))
-    return lax.conv_general_dilated(
-        x, w,
+    # AMP: bf16 operands (MXU accumulates f32 internally), cast up after —
+    # keeping operand/cotangent dtypes uniform so the conv transpose rule
+    # stays well-typed under vjp
+    amp = ctx is not None and ctx.amp_bf16() and x.dtype == jnp.float32
+    xc, wc = (x.astype(jnp.bfloat16), w.astype(jnp.bfloat16)) if amp else (x, w)
+    out = lax.conv_general_dilated(
+        xc, wc,
         window_strides=tuple(strides),
         padding=pad,
         rhs_dilation=tuple(dilations),
         dimension_numbers=dn,
         feature_group_count=groups,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
-    ).astype(x.dtype)
+    )
+    return out.astype(x.dtype)
 
 
 @register_op(

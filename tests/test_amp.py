@@ -1,0 +1,115 @@
+"""Mixed-precision tests (parity: contrib/mixed_precision tests): bf16
+policy trains to the same quality as fp32 within tolerance."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _train_mlp(use_amp, steps=60, loss_scaling=1.0):
+    rng = np.random.RandomState(0)
+    C = rng.randn(4, 16).astype("f") * 2
+    ys = rng.randint(0, 4, 128)
+    xs = (C[ys] + rng.randn(128, 16) * 0.3).astype("f")
+    yb = ys.reshape(-1, 1).astype("int64")
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 5
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[16])
+        y = fluid.layers.data("y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, 32, act="relu")
+        logits = fluid.layers.fc(h, 4)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        opt = fluid.optimizer.SGD(0.1)
+        if use_amp:
+            opt = fluid.contrib.mixed_precision.decorate(
+                opt, init_loss_scaling=loss_scaling)
+        opt.minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(steps):
+            lo, = exe.run(main, feed={"x": xs, "y": yb}, fetch_list=[loss])
+            losses.append(float(lo[0]))
+    return losses
+
+
+def test_amp_converges_like_fp32():
+    fp32 = _train_mlp(False)
+    amp = _train_mlp(True)
+    assert amp[-1] < fp32[0] * 0.3
+    assert abs(amp[-1] - fp32[-1]) < 0.1, (amp[-1], fp32[-1])
+
+
+def test_amp_with_loss_scaling():
+    amp = _train_mlp(True, loss_scaling=128.0)
+    assert amp[-1] < amp[0] * 0.3
+
+
+def test_dynamic_loss_scaling_backs_off_on_overflow():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        h = fluid.layers.fc(x, 4, bias_attr=False)
+        loss = fluid.layers.mean(h)
+        opt = fluid.contrib.mixed_precision.decorate(
+            fluid.optimizer.SGD(0.1), init_loss_scaling=64.0,
+            use_dynamic_loss_scaling=True, incr_every_n_steps=2,
+            incr_ratio=2.0, decr_ratio=0.5)
+        opt.minimize(loss)
+    scale_var = opt.get_loss_scaling()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        # clean steps: scale should grow after incr_every_n_steps=2
+        ok = np.ones((2, 4), "float32")
+        s0, = exe.run(main, feed={"x": ok}, fetch_list=[scale_var])
+        s1, = exe.run(main, feed={"x": ok}, fetch_list=[scale_var])
+        assert float(s1[0]) == 128.0, float(s1[0])
+        # overflow step: scale should back off by decr_ratio
+        bad = np.full((2, 4), np.inf, "float32")
+        s2, = exe.run(main, feed={"x": bad}, fetch_list=[scale_var])
+        assert float(s2[0]) == 64.0, float(s2[0])
+
+
+def test_lr_schedules_all_execute():
+    import paddle_tpu.layers as L
+
+    builders = [
+        lambda: L.exponential_decay(0.1, 10, 0.9, staircase=True),
+        lambda: L.natural_exp_decay(0.1, 10, 0.9),
+        lambda: L.inverse_time_decay(0.1, 10, 0.5, staircase=True),
+        lambda: L.polynomial_decay(0.1, 10, cycle=True),
+        lambda: L.cosine_decay(0.1, 5, 10),
+        lambda: L.linear_lr_warmup(0.1, 5, 0.0, 0.1),
+    ]
+    for build in builders:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            lr = build()
+            x = fluid.layers.data("x", shape=[2])
+            loss = fluid.layers.mean(fluid.layers.fc(x, 2))
+            fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            vals = []
+            for _ in range(3):
+                out, = exe.run(main, feed={"x": np.ones((1, 2), "f")},
+                               fetch_list=[lr])
+                vals.append(float(out[0]))
+        assert np.isfinite(vals).all(), vals
+
+
+def test_amp_flag_reaches_lowering():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        w = fluid.layers.fc(x, 4, bias_attr=False)
+        loss = fluid.layers.mean(w)
+        opt = fluid.contrib.mixed_precision.decorate(fluid.optimizer.SGD(0.1))
+        opt.minimize(loss)
+    assert main._amp_bf16
