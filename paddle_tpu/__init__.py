@@ -37,6 +37,9 @@ from . import regularizer
 from . import clip
 from . import backward
 from . import contrib
+from . import transpiler
+from . import incubate
+from . import distributed
 from . import unique_name_compat as unique_name  # noqa: F401
 from .data_feeder import DataFeeder
 from . import io

@@ -60,11 +60,13 @@ def as_numpy(t):
 
 
 class _CompiledPlan:
-    __slots__ = ("plan", "jfn", "in_shardings", "feed_dim0")
+    __slots__ = ("plan", "jfn", "mesh", "data_axis")
 
-    def __init__(self, plan, jfn):
+    def __init__(self, plan, jfn, mesh=None, data_axis=None):
         self.plan = plan
         self.jfn = jfn
+        self.mesh = mesh
+        self.data_axis = data_axis
 
 
 class Executor:
@@ -133,6 +135,9 @@ class Executor:
             if use_program_cache:
                 self._cache[key] = entry
         plan = entry.plan
+        if entry.mesh is not None and mesh is None:
+            mesh = entry.mesh
+            data_axis = entry.data_axis
 
         # gather params from scope
         params_ro, params_rw = {}, {}
@@ -191,8 +196,23 @@ class Executor:
         return val
 
     def _compile(self, program, feed_names, fetch_names, mesh, data_axis):
+        from .lowering import build_spmd_block_fn, has_collective_ops
+
         block = program.global_block()
         plan = BlockPlan(block, feed_names, fetch_names)
+        if mesh is None and has_collective_ops(block):
+            # fleet/transpiler collective path: program-level c_* ops ->
+            # manual SPMD over all local devices (reference: one process
+            # per GPU + NCCL ring; here: shard_map over the mesh axis).
+            # Runs even on 1 device (psum over a size-1 axis is identity)
+            # so the transpiler's 1/nranks loss-grad scale stays paired
+            # with a real — if degenerate — allreduce.
+            from jax.sharding import Mesh
+
+            mesh = Mesh(np.array(jax.devices()), ("data",))
+            fn = build_spmd_block_fn(plan, mesh, axis="data")
+            jfn = jax.jit(fn, donate_argnums=(2,))
+            return _CompiledPlan(plan, jfn, mesh, "data")
         fn = build_block_fn(plan, mesh=mesh)
         if mesh is None:
             jfn = jax.jit(fn, donate_argnums=(2,))

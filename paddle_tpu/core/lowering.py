@@ -177,6 +177,82 @@ def run_op(op, env, rng_key, mesh=None, axis_names=(), runner=None):
         _scatter_slot(opdef, op, slot, val, env)
 
 
+def has_collective_ops(block):
+    """True if the block contains program-level collectives (fleet/transpiler
+    path) that require manual SPMD (shard_map) execution."""
+    manual = ("c_allreduce_sum", "c_allreduce_max", "c_allreduce_min",
+              "c_allreduce_prod", "c_broadcast", "c_allgather",
+              "c_reducescatter", "allreduce", "broadcast")
+    return any(op.type in manual for op in block.ops)
+
+
+def build_spmd_block_fn(plan, mesh, axis="data"):
+    """Lower the block for per-rank execution under shard_map: every op runs
+    on its shard, collectives (c_*) ride the mesh axis via lax.psum & co.
+
+    This is the TPU-native analog of the reference's one-process-per-GPU
+    fleet-collective runtime (transpiler/collective.py + NCCL): rank =
+    position along the mesh axis, feeds are batch-sharded, parameters
+    replicated.  Fetches come back stacked along the axis (shape [nranks,
+    ...] per rank-local value, concatenated on dim 0).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax import shard_map as _new_shard_map
+
+        def _shard_map(f, mesh, in_specs, out_specs):
+            return _new_shard_map(f, mesh=mesh, in_specs=in_specs,
+                                  out_specs=out_specs, check_vma=False)
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _old_shard_map
+
+        def _shard_map(f, mesh, in_specs, out_specs):
+            return _old_shard_map(f, mesh=mesh, in_specs=in_specs,
+                                  out_specs=out_specs, check_rep=False)
+
+    block = plan.block
+    fetch_names = plan.fetch_names
+    persist_written = plan.persist_written
+
+    def local(feeds, params_ro, params_rw, rng):
+        env = {}
+        env.update(params_ro)
+        env.update(params_rw)
+        env.update(feeds)
+        rank = jax.lax.axis_index(axis)
+        for i, op in enumerate(_iter_runtime_ops(block)):
+            key = None
+            if rng is not None:
+                key = jax.random.fold_in(jax.random.fold_in(rng, i), rank)
+            run_op(op, env, key, mesh=mesh, axis_names=(axis,))
+        fetches = [env[n] for n in fetch_names]
+        updated = {n: env[n] for n in persist_written if n in env}
+        return fetches, updated
+
+    nranks = mesh.shape[axis]
+
+    def fn(feeds, params_ro, params_rw, rng):
+        feed_specs = {}
+        for n, v in feeds.items():
+            if v.ndim >= 1 and v.shape[0] % nranks == 0:
+                feed_specs[n] = P(axis, *([None] * (v.ndim - 1)))
+            else:
+                feed_specs[n] = P()  # 0-d / non-divisible: replicate
+        param_ro_specs = {n: P() for n in params_ro}
+        param_rw_specs = {n: P() for n in params_rw}
+        out_specs = ([P(axis)] * len(fetch_names), {n: P() for n in persist_written})
+        sm = _shard_map(
+            local,
+            mesh,
+            (feed_specs, param_ro_specs, param_rw_specs, P()),
+            out_specs,
+        )
+        return sm(feeds, params_ro, params_rw, rng)
+
+    return fn
+
+
 def build_block_fn(plan, mesh=None, axis_names=()):
     """Return fn(feeds, params_ro, params_rw, rng) -> (fetches, updated_rw).
 

@@ -1,0 +1,8 @@
+"""Distributed runtime: process launcher + multi-host bootstrap.
+
+The reference's NCCL data plane is replaced by XLA collectives over the
+device mesh; the control plane (who talks to whom) keeps the reference's
+env-var scheme so launch scripts port unchanged.
+"""
+
+from . import launch  # noqa: F401

@@ -1,0 +1,76 @@
+"""Process launcher (port of python/paddle/distributed/launch.py:283).
+
+On GPU the reference spawns one trainer process per device; on TPU one host
+process drives all local chips via SPMD, so the launcher spawns one process
+per *host* and exports the same env-var scheme
+(PADDLE_TRAINER_ID/PADDLE_CURRENT_ENDPOINT/PADDLE_TRAINERS_NUM/
+PADDLE_TRAINER_ENDPOINTS).  Multi-host jobs additionally get
+PADDLE_COORDINATOR for jax.distributed.initialize.
+
+Usage: python -m paddle_tpu.distributed.launch [--started_port P]
+           [--cluster_node_ips ip1,ip2] [--node_ip ip] training_script args...
+"""
+
+import argparse
+import os
+import subprocess
+import sys
+
+__all__ = ["launch", "init_multihost"]
+
+
+def _parse_args(argv=None):
+    parser = argparse.ArgumentParser(description="paddle_tpu launcher")
+    parser.add_argument("--cluster_node_ips", type=str, default="127.0.0.1")
+    parser.add_argument("--node_ip", type=str, default="127.0.0.1")
+    parser.add_argument("--started_port", type=int, default=6170)
+    parser.add_argument("--print_config", type=bool, default=True)
+    parser.add_argument("--selected_tpus", type=str, default=None,
+                        help="unused on TPU SPMD (all local chips)")
+    parser.add_argument("--selected_gpus", type=str, default=None,
+                        help="compat alias, ignored")
+    parser.add_argument("--log_dir", type=str, default=None)
+    parser.add_argument("training_script", type=str)
+    parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(argv)
+
+
+def launch(args=None):
+    args = args or _parse_args()
+    node_ips = [ip.strip() for ip in args.cluster_node_ips.split(",")]
+    node_id = node_ips.index(args.node_ip) if args.node_ip in node_ips else 0
+    endpoints = ["%s:%d" % (ip, args.started_port) for ip in node_ips]
+
+    env = dict(os.environ)
+    env.update({
+        "PADDLE_TRAINER_ID": str(node_id),
+        "PADDLE_CURRENT_ENDPOINT": endpoints[node_id],
+        "PADDLE_TRAINERS_NUM": str(len(node_ips)),
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+        "PADDLE_COORDINATOR": endpoints[0],
+    })
+    cmd = [sys.executable, "-u", args.training_script] + args.training_script_args
+    proc = subprocess.Popen(cmd, env=env)
+    proc.wait()
+    if proc.returncode != 0:
+        raise subprocess.CalledProcessError(proc.returncode, cmd)
+
+
+def init_multihost():
+    """Bootstrap jax.distributed from the launcher env (DCN control plane);
+    call once at the top of a multi-host training script."""
+    n = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+    if n <= 1:
+        return False
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=os.getenv("PADDLE_COORDINATOR"),
+        num_processes=n,
+        process_id=int(os.getenv("PADDLE_TRAINER_ID", "0")),
+    )
+    return True
+
+
+if __name__ == "__main__":
+    launch()

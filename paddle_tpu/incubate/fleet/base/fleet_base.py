@@ -1,0 +1,90 @@
+"""Fleet base classes (port of incubate/fleet/base/fleet_base.py:345)."""
+
+import abc
+
+from ....core.executor import Executor
+
+__all__ = ["Fleet", "DistributedOptimizer", "Mode"]
+
+
+class Mode:
+    TRANSPILER = 1
+    PSLIB = 2
+    COLLECTIVE = 3
+
+
+class Fleet(metaclass=abc.ABCMeta):
+    def __init__(self, mode):
+        self._is_initialized = False
+        self._mode = mode
+        self._optimizer = None
+        self._role_maker = None
+        self._executor = None
+
+    def init(self, role_maker=None):
+        from . import role_maker as rm
+
+        if role_maker is None:
+            role_maker = rm.UserDefinedCollectiveRoleMaker()
+        self._role_maker = role_maker
+        self._role_maker.generate_role()
+        self._executor = Executor()
+        self._is_initialized = True
+
+    def is_first_worker(self):
+        return self._role_maker.is_first_worker()
+
+    def worker_index(self):
+        return self._role_maker.worker_index()
+
+    def worker_num(self):
+        return self._role_maker.worker_num()
+
+    def is_worker(self):
+        return self._role_maker.is_worker()
+
+    def server_num(self):
+        return self._role_maker.server_num()
+
+    def server_index(self):
+        return self._role_maker.server_index()
+
+    def is_server(self):
+        return self._role_maker.is_server()
+
+    @property
+    def worker_endpoints(self):
+        return self._role_maker.get_trainer_endpoints()
+
+    @property
+    def server_endpoints(self):
+        return self._role_maker.get_pserver_endpoints()
+
+    # subclass API ----------------------------------------------------------
+    @abc.abstractmethod
+    def distributed_optimizer(self, optimizer, strategy=None):
+        pass
+
+    @abc.abstractmethod
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None,
+                             export_for_deployment=True):
+        pass
+
+    @abc.abstractmethod
+    def save_persistables(self, executor, dirname, main_program=None):
+        pass
+
+
+class DistributedOptimizer(metaclass=abc.ABCMeta):
+    def __init__(self, optimizer, strategy=None):
+        self._optimizer = optimizer
+        self._strategy = strategy
+
+    @abc.abstractmethod
+    def minimize(self, losses, scopes=None, startup_programs=None,
+                 parameter_list=None, no_grad_set=None):
+        pass
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)

@@ -1,0 +1,125 @@
+"""Role makers: who am I in the job? (port of
+python/paddle/fluid/incubate/fleet/base/role_maker.py:327).
+
+PaddleCloudRoleMaker reads the same env-var scheme as the reference
+(PADDLE_TRAINER_ID / PADDLE_TRAINER_ENDPOINTS / PADDLE_PSERVERS_IP_PORT_LIST
+/ TRAINING_ROLE), which paddle_tpu.distributed.launch sets.  On TPU a
+"trainer" is a host process driving its local chips; multi-host jobs
+bootstrap jax.distributed from the same env vars.
+"""
+
+import os
+
+__all__ = [
+    "Role",
+    "RoleMakerBase",
+    "PaddleCloudRoleMaker",
+    "UserDefinedRoleMaker",
+    "UserDefinedCollectiveRoleMaker",
+]
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+
+
+class RoleMakerBase:
+    def __init__(self):
+        self._worker_endpoints = []
+        self._server_endpoints = []
+        self._role_is_generated = False
+        self._role = None
+        self._current_id = -1
+
+    def is_worker(self):
+        return self._role == Role.WORKER
+
+    def is_server(self):
+        return self._role == Role.SERVER
+
+    def is_first_worker(self):
+        return self._role == Role.WORKER and self._current_id == 0
+
+    def worker_num(self):
+        return len(self._worker_endpoints) or 1
+
+    def server_num(self):
+        return len(self._server_endpoints)
+
+    def worker_index(self):
+        return self._current_id
+
+    def server_index(self):
+        return self._current_id
+
+    def get_trainer_endpoints(self):
+        return self._worker_endpoints
+
+    def get_pserver_endpoints(self):
+        return self._server_endpoints
+
+    def generate_role(self):
+        raise NotImplementedError
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    def __init__(self, is_collective=False):
+        super().__init__()
+        self._is_collective = is_collective
+
+    def generate_role(self):
+        if self._role_is_generated:
+            return
+        if self._is_collective:
+            self._current_id = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+            eps = os.getenv("PADDLE_TRAINER_ENDPOINTS", "127.0.0.1:6170")
+            self._worker_endpoints = eps.split(",")
+            self._role = Role.WORKER
+        else:
+            role = os.getenv("TRAINING_ROLE", "TRAINER")
+            eps = os.getenv("PADDLE_PSERVERS_IP_PORT_LIST", "")
+            self._server_endpoints = eps.split(",") if eps else []
+            worker_eps = os.getenv("PADDLE_TRAINER_ENDPOINTS", "")
+            self._worker_endpoints = worker_eps.split(",") if worker_eps else []
+            if role == "TRAINER":
+                self._role = Role.WORKER
+                self._current_id = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+            else:
+                self._role = Role.SERVER
+                cur = os.getenv("POD_IP", "127.0.0.1") + ":" + os.getenv(
+                    "PADDLE_PORT", "6174")
+                self._current_id = (
+                    self._server_endpoints.index(cur)
+                    if cur in self._server_endpoints else 0
+                )
+        self._role_is_generated = True
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    def __init__(self, current_id=0, role=Role.WORKER, worker_num=1,
+                 server_endpoints=None):
+        super().__init__()
+        self._current_id = current_id
+        self._role = role
+        self._worker_num = worker_num
+        self._server_endpoints = server_endpoints or []
+        self._worker_endpoints = ["127.0.0.1:%d" % (6170 + i)
+                                  for i in range(worker_num)]
+
+    def worker_num(self):
+        return self._worker_num
+
+    def generate_role(self):
+        self._role_is_generated = True
+
+
+class UserDefinedCollectiveRoleMaker(RoleMakerBase):
+    def __init__(self, current_id=0, worker_endpoints=None):
+        super().__init__()
+        self._current_id = current_id
+        self._worker_endpoints = worker_endpoints or ["127.0.0.1:6170"]
+        self._role = Role.WORKER
+
+    def generate_role(self):
+        self._role_is_generated = True

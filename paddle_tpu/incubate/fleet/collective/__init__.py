@@ -1,0 +1,155 @@
+"""fleet collective backend (port of incubate/fleet/collective/__init__.py:
+Collective(Fleet) at :45, CollectiveOptimizer at :182, DistributedStrategy
+at :134).
+
+`fleet.distributed_optimizer(opt).minimize(loss)` applies the GradAllReduce
+transpiler so the main program carries scale + c_allreduce_sum per grad; the
+executor then runs it SPMD over the local chip mesh (shard_map + lax.psum),
+which is the TPU equivalent of the reference's one-process-per-GPU NCCL
+rings.  Multi-host scaling bootstraps jax.distributed from the same env-var
+scheme the reference's launcher sets.
+"""
+
+from ....compiler import BuildStrategy
+from ....framework import default_main_program, default_startup_program
+from ..base.fleet_base import DistributedOptimizer, Fleet, Mode
+
+__all__ = ["fleet", "Collective", "CollectiveOptimizer", "DistributedStrategy"]
+
+
+class DistributedStrategy:
+    """Strategy knobs (reference collective/__init__.py:134)."""
+
+    def __init__(self):
+        self.use_local_sgd = False
+        self.local_sgd_frequency = 1
+        self.mode = "grad_allreduce"
+        self.collective_mode = "grad_allreduce"
+        self.nccl_comm_num = 1
+        self.forward_recompute = False
+        self.recompute_checkpoints = []
+        self.use_amp = False
+        self.amp_loss_scaling = 2 ** 15
+        self.exec_strategy = None
+        self.build_strategy = BuildStrategy()
+
+
+class Collective(Fleet):
+    def __init__(self):
+        super().__init__(Mode.COLLECTIVE)
+        self._local_ip = 0
+        self.startup_program = None
+        self.main_program = None
+
+    def init_worker(self):
+        pass
+
+    def run_worker(self, main_programs=None, scopes=None):
+        pass
+
+    def stop_worker(self):
+        pass
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        self._optimizer = CollectiveOptimizer(optimizer, strategy)
+        return self._optimizer
+
+    def save_inference_model(self, executor, dirname, feeded_var_names=None,
+                             target_vars=None, main_program=None,
+                             export_for_deployment=True):
+        from .... import io
+
+        return io.save_inference_model(dirname, feeded_var_names,
+                                       target_vars, executor, main_program)
+
+    def save_persistables(self, executor, dirname, main_program=None,
+                          filename=None):
+        from .... import io
+
+        return io.save_persistables(executor, dirname, main_program, filename)
+
+
+fleet = Collective()
+
+
+class CollectiveOptimizer(DistributedOptimizer):
+    """Wraps an optimizer; minimize applies the collective transpiler
+    (reference collective/__init__.py:182)."""
+
+    def __init__(self, optimizer, strategy=None):
+        super().__init__(optimizer, strategy or DistributedStrategy())
+        self.print_config = False
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return self._optimizer.backward(loss, startup_program,
+                                        parameter_list, no_grad_set,
+                                        callbacks)
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def _get_node_ips_from_endpoints(self, endpoints):
+        return list(dict.fromkeys(ep.split(":")[0] for ep in endpoints))
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        strategy = self._strategy
+        optimizer = self._optimizer
+        if strategy.use_amp:
+            from ....contrib import mixed_precision
+
+            optimizer = mixed_precision.decorate(
+                optimizer, init_loss_scaling=strategy.amp_loss_scaling,
+                use_dynamic_loss_scaling=True)
+        if strategy.forward_recompute:
+            from ....optimizer import RecomputeOptimizer
+
+            optimizer = RecomputeOptimizer(optimizer)
+            optimizer._set_checkpoints(strategy.recompute_checkpoints)
+
+        main_program = loss.block.program
+        startup_program = startup_program or default_startup_program()
+
+        optimize_ops, params_grads = optimizer.minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+
+        worker_endpoints = fleet.worker_endpoints or ["127.0.0.1:6170"]
+        trainer_id = fleet.worker_index()
+        current_endpoint = (
+            worker_endpoints[trainer_id]
+            if trainer_id < len(worker_endpoints) else worker_endpoints[0]
+        )
+
+        from ....transpiler.collective import GradAllReduce, LocalSGD
+
+        # nranks for gradient scaling: number of SPMD ranks = local devices
+        # per host x hosts (each rank sees 1/nranks of the global batch)
+        import jax
+
+        n_dev = len(jax.devices())
+        nranks = max(n_dev, len(worker_endpoints))
+        if len(worker_endpoints) > n_dev:
+            import warnings
+
+            warnings.warn(
+                "fleet: %d worker endpoints but only %d visible devices — "
+                "multi-host jobs must call "
+                "paddle_tpu.distributed.launch.init_multihost() before "
+                "building the model so jax.distributed exposes all chips"
+                % (len(worker_endpoints), n_dev))
+        if nranks > 1:
+            cls = LocalSGD if strategy.use_local_sgd else GradAllReduce
+            t = cls(strategy.nccl_comm_num)
+            eps = worker_endpoints
+            if len(eps) < nranks:
+                eps = ["local:%d" % i for i in range(nranks)]
+                current = eps[0]
+            else:
+                current = current_endpoint
+            t.transpile(startup_program, main_program, trainer_id, eps,
+                        current)
+
+        fleet.main_program = main_program
+        fleet.startup_program = startup_program
+        return optimize_ops, params_grads

@@ -12,3 +12,4 @@ from . import manip  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import metrics  # noqa: F401
+from . import collective  # noqa: F401

@@ -1,0 +1,5 @@
+from .collective import Collective, GradAllReduce, LocalSGD  # noqa: F401
+from .distribute_transpiler import (  # noqa: F401
+    DistributeTranspiler,
+    DistributeTranspilerConfig,
+)

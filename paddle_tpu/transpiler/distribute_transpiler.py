@@ -1,0 +1,123 @@
+"""Parameter-server transpiler (port of
+python/paddle/fluid/transpiler/distribute_transpiler.py:230).
+
+Rewrites a single-process program into trainer/pserver halves communicating
+through send/recv ops.  The full PS runtime lands with the distributed
+milestone (see paddle_tpu/distributed/ps_runtime.py); this module implements
+the program splitting: slice_variable round-robin, trainer-side send/recv
+injection, and pserver program construction with per-param optimizer blocks.
+"""
+
+import math
+
+__all__ = ["DistributeTranspiler", "DistributeTranspilerConfig", "slice_variable"]
+
+
+class DistributeTranspilerConfig:
+    """Knobs (reference distribute_transpiler.py:131)."""
+
+    slice_var_up = True
+    split_method = None
+    min_block_size = 8192
+    enable_dc_asgd = False
+    mode = "pserver"  # pserver | nccl2 | collective
+    print_log = False
+    wait_port = True
+    runtime_split_send_recv = False
+    sync_mode = True
+    nccl_comm_num = 1
+    use_hierarchical_allreduce = False
+    hierarchical_allreduce_inter_nranks = 0
+    collective_mode = None
+    geo_sgd_mode = False
+    geo_sgd_need_push_nums = 100
+
+
+class VarBlock:
+    def __init__(self, varname, offset, size):
+        self.varname = varname
+        self.offset = offset
+        self.size = size
+
+    def __str__(self):
+        return "%s:%d:%d" % (self.varname, self.offset, self.size)
+
+
+def slice_variable(var_list, slice_count, min_block_size):
+    """Split variables into blocks round-robined over pservers (reference
+    distribute_transpiler.py:70-118)."""
+    blocks = []
+    for var in var_list:
+        split_count = slice_count
+        numel = 1
+        for d in var.shape:
+            numel *= int(d)
+        max_pserver_count = int(math.floor(numel / float(min_block_size)))
+        if max_pserver_count == 0:
+            max_pserver_count = 1
+        if max_pserver_count < slice_count:
+            split_count = max_pserver_count
+        block_size = int(math.ceil(numel / float(split_count)))
+        if len(var.shape) >= 2:
+            dim1 = 1
+            for d in var.shape[1:]:
+                dim1 *= int(d)
+            remains = block_size % dim1
+            if remains != 0:
+                block_size += dim1 - remains
+        split_count = int(math.ceil(numel / float(block_size)))
+        for block_id in range(split_count):
+            curr_size = min(block_size, numel - block_id * block_size)
+            blocks.append(VarBlock(var.name, block_id, curr_size))
+    return blocks
+
+
+class DistributeTranspiler:
+    def __init__(self, config=None):
+        self.config = config or DistributeTranspilerConfig()
+        self._transpiled = False
+
+    def transpile(self, trainer_id, program=None, pservers="127.0.0.1:6174",
+                  trainers=1, sync_mode=True, startup_program=None,
+                  current_endpoint="127.0.0.1:6174"):
+        from ..framework import default_main_program, default_startup_program
+
+        self.trainer_id = trainer_id
+        self.program = program or default_main_program()
+        self.startup_program = startup_program or default_startup_program()
+        self.pserver_endpoints = (
+            pservers.split(",") if isinstance(pservers, str) else list(pservers)
+        )
+        self.trainers = trainers
+        self.sync_mode = sync_mode
+
+        if self.config.mode in ("nccl2", "collective"):
+            # collective modes delegate to the Collective transpilers
+            from .collective import GradAllReduce
+
+            t = GradAllReduce(self.config.nccl_comm_num)
+            eps = ["%d" % i for i in range(trainers)]
+            t.transpile(self.startup_program, self.program, trainer_id, eps,
+                        "%d" % trainer_id)
+            self._transpiled = True
+            return
+
+        from ..distributed.ps_transpile import transpile_pserver_mode
+
+        self._ps_state = transpile_pserver_mode(self)
+        self._transpiled = True
+
+    def get_trainer_program(self, wait_port=True):
+        if self.config.mode in ("nccl2", "collective"):
+            return self.program
+        return self._ps_state.trainer_program
+
+    def get_pserver_program(self, endpoint):
+        return self._ps_state.pserver_programs[endpoint]
+
+    def get_pserver_programs(self, endpoint):
+        return (self._ps_state.pserver_programs[endpoint],
+                self._ps_state.pserver_startups[endpoint])
+
+    def get_startup_program(self, endpoint, pserver_program=None):
+        return self._ps_state.pserver_startups[endpoint]

@@ -1,0 +1,177 @@
+"""Collective op + fleet data-parallel tests (mirrors reference
+test_collective_base.py and test_dist_base.py — but SPMD over the virtual
+8-device CPU mesh instead of multi-process NCCL on localhost)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+NDEV = 8
+
+
+def _run_collective(op_type, x_np, attrs=None, out_shape=None):
+    """Run one collective op over the 8-device mesh via the fleet path:
+    program contains the c_* op -> executor runs it under shard_map."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=list(x_np.shape[1:]))
+        out = main.global_block().create_var(name="col_out")
+        main.global_block().append_op(
+            type=op_type,
+            inputs={"X": [x]},
+            outputs={"Out": [out]},
+            attrs=attrs or {},
+        )
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        res, = exe.run(main, feed={"x": x_np}, fetch_list=["col_out"])
+    return np.asarray(res)
+
+
+def test_c_allreduce_sum():
+    x = np.arange(NDEV * 2 * 3, dtype="float32").reshape(NDEV * 2, 3)
+    out = _run_collective("c_allreduce_sum", x)
+    # each rank holds 2 rows; allreduce sums the per-rank shards elementwise;
+    # result is stacked back: every rank's output equals the sum of shards
+    shards = x.reshape(NDEV, 2, 3)
+    expected = np.tile(shards.sum(axis=0), (NDEV, 1, 1)).reshape(NDEV * 2, 3)
+    np.testing.assert_allclose(out, expected, rtol=1e-5)
+
+
+def test_c_allreduce_max():
+    x = np.random.RandomState(0).randn(NDEV, 4).astype("float32")
+    out = _run_collective("c_allreduce_max", x)
+    expected = np.tile(x.max(axis=0), (NDEV, 1))
+    np.testing.assert_allclose(out, expected, rtol=1e-5)
+
+
+def test_c_broadcast():
+    x = np.random.RandomState(1).randn(NDEV, 4).astype("float32")
+    out = _run_collective("c_broadcast", x, attrs={"root": 2})
+    expected = np.tile(x[2], (NDEV, 1))
+    np.testing.assert_allclose(out, expected, rtol=1e-5)
+
+
+def test_c_allgather():
+    x = np.random.RandomState(2).randn(NDEV, 2).astype("float32")
+    out = _run_collective("c_allgather", x)
+    # per-rank input [1,2] -> output [NDEV,2] on each rank; stacked: [NDEV*NDEV, 2]
+    assert out.shape == (NDEV * NDEV, 2)
+    for r in range(NDEV):
+        np.testing.assert_allclose(out[r * NDEV:(r + 1) * NDEV], x, rtol=1e-5)
+
+
+def test_c_reducescatter():
+    # global [NDEV*NDEV, 1]: rank r holds rows r*N..r*N+N-1; reduce-scatter
+    # sums the per-rank shards then scatters row blocks back
+    x = np.arange(NDEV * NDEV, dtype="float32").reshape(NDEV * NDEV, 1)
+    out = _run_collective("c_reducescatter", x)
+    shards = x.reshape(NDEV, NDEV, 1)
+    summed = shards.sum(axis=0)  # [NDEV, 1]
+    np.testing.assert_allclose(out, summed, rtol=1e-5)
+
+
+def _build_mlp_with_opt(lr=0.1, seed=3):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8])
+        y = fluid.layers.data("y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, 16, act="relu",
+                            param_attr=fluid.ParamAttr(
+                                name="w1",
+                                initializer=fluid.initializer.Constant(0.03)),
+                            bias_attr=fluid.ParamAttr(
+                                name="b1",
+                                initializer=fluid.initializer.Constant(0.0)))
+        logits = fluid.layers.fc(h, 4,
+                                 param_attr=fluid.ParamAttr(
+                                     name="w2",
+                                     initializer=fluid.initializer.Constant(0.02)),
+                                 bias_attr=fluid.ParamAttr(
+                                     name="b2",
+                                     initializer=fluid.initializer.Constant(0.0)))
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        opt = fluid.optimizer.SGD(lr)
+    return main, startup, loss, opt, (x, y)
+
+
+def _data(n=64):
+    rng = np.random.RandomState(7)
+    xs = rng.randn(n, 8).astype("float32")
+    ys = rng.randint(0, 4, (n, 1)).astype("int64")
+    return xs, ys
+
+
+def test_fleet_collective_loss_parity():
+    """Same model/data: fleet DP over 8 devices must track single-device
+    training (reference test_dist_base asserts |local-dist| < 1e-3)."""
+    xs, ys = _data(64)
+
+    # single device
+    main, startup, loss, opt, _ = _build_mlp_with_opt()
+    with fluid.program_guard(main, startup):
+        opt.minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    local_losses = []
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _ in range(5):
+            lo, = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+            local_losses.append(float(np.asarray(lo).reshape(-1)[0]))
+
+    # fleet collective DP
+    from paddle_tpu.incubate.fleet.base import role_maker
+    from paddle_tpu.incubate.fleet.collective import fleet
+
+    fleet.init(role_maker.UserDefinedCollectiveRoleMaker(0))
+    main2, startup2, loss2, opt2, _ = _build_mlp_with_opt()
+    with fluid.program_guard(main2, startup2):
+        dopt = fleet.distributed_optimizer(opt2)
+        dopt.minimize(loss2)
+    types = [op.type for op in main2.global_block().ops]
+    assert "c_allreduce_sum" in types
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    dist_losses = []
+    with fluid.scope_guard(fluid.Scope()):
+        exe2.run(startup2)
+        for _ in range(5):
+            lo, = exe2.run(main2, feed={"x": xs, "y": ys},
+                           fetch_list=[loss2])
+            # per-rank losses stacked; average = global loss
+            dist_losses.append(float(np.asarray(lo).mean()))
+
+    np.testing.assert_allclose(local_losses, dist_losses, atol=2e-3)
+
+
+def test_compiled_program_data_parallel_matches_single():
+    """Auto-SPMD path: CompiledProgram.with_data_parallel over 8 devices."""
+    xs, ys = _data(64)
+    main, startup, loss, opt, _ = _build_mlp_with_opt()
+    with fluid.program_guard(main, startup):
+        opt.minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    single = []
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _ in range(5):
+            lo, = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+            single.append(float(np.asarray(lo).reshape(-1)[0]))
+
+    main2, startup2, loss2, opt2, _ = _build_mlp_with_opt()
+    with fluid.program_guard(main2, startup2):
+        opt2.minimize(loss2)
+    cp = fluid.CompiledProgram(main2).with_data_parallel(loss_name=loss2.name)
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    par = []
+    with fluid.scope_guard(fluid.Scope()):
+        exe2.run(startup2)
+        for _ in range(5):
+            lo, = exe2.run(cp, feed={"x": xs, "y": ys}, fetch_list=[loss2])
+            par.append(float(np.asarray(lo).reshape(-1)[0]))
+
+    np.testing.assert_allclose(single, par, atol=1e-4)
