@@ -25,7 +25,7 @@ class LowerCtx:
     """
 
     def __init__(self, rng_key=None, op=None, block=None, mesh=None,
-                 axis_names=(), mode="traced", runner=None):
+                 axis_names=(), mode="traced", runner=None, env=None):
         self._rng_key = rng_key
         self._rng_n = 0
         self.op = op
@@ -34,6 +34,20 @@ class LowerCtx:
         self.axis_names = tuple(axis_names)
         self.mode = mode  # "traced" | "abstract" | "eager"
         self.runner = runner  # BlockRunner for ops with sub-blocks
+        # live name->value environment of the enclosing block trace; used by
+        # control-flow ops (while/conditional_block) whose sub-blocks read
+        # outer variables (analog of the reference's kid-scope chain,
+        # paddle/fluid/framework/scope.h:46)
+        self.env = env
+
+    def run_sub_block(self, block_idx, env, base_key=None):
+        """Run every op of a sub-block against `env` (in place)."""
+        block = self.block.program.block(block_idx)
+        for i, op in enumerate(block.ops):
+            key = None
+            if base_key is not None:
+                key = jax.random.fold_in(base_key, i)
+            run_op(op, env, key, mesh=self.mesh, axis_names=self.axis_names)
 
     def rng(self):
         if self._rng_key is None:
@@ -82,6 +96,11 @@ def analyze_block(block, feed_names):
             if name.endswith("@GRAD") or "@GRAD@" in name:
                 # grad var not yet produced: implicit zeros (handled by the
                 # grad lowering), never an external scope read
+                continue
+            v = block._find_var_recursive(name)
+            if v is not None and getattr(v, "type", None) == "LOD_TENSOR_ARRAY":
+                # tensor arrays are trace-local (Python lists in the env),
+                # never scope-resident; first write creates them
                 continue
             external.append(name)
             external_set.add(name)
@@ -158,14 +177,43 @@ def _scatter_slot(opdef, op, slot, value, env):
             env[n] = v
 
 
+_AXIS_OPS = frozenset((
+    "c_allreduce_sum", "c_allreduce_max", "c_allreduce_min",
+    "c_allreduce_prod", "c_broadcast", "c_allgather", "c_reducescatter",
+    "allreduce", "broadcast",
+))
+
+
+def _any_tracer(args):
+    for a in args:
+        if isinstance(a, (list, tuple)):
+            if _any_tracer(a):
+                return True
+        elif isinstance(a, jax.core.Tracer):
+            return True
+    return False
+
+
 def run_op(op, env, rng_key, mesh=None, axis_names=(), runner=None):
     """Lower one op: gather inputs from env, call the lowering, scatter
     outputs back into env."""
     opdef = get_op_def(op.type)
     args = [_gather_slot(opdef, op, s, env) for s in opdef.input_slots]
     ctx = LowerCtx(rng_key=rng_key, op=op, block=op.block, mesh=mesh,
-                   axis_names=axis_names, runner=runner)
-    out = opdef.lower(ctx, *args, **_lower_attrs(op.attrs))
+                   axis_names=axis_names, runner=runner, env=env)
+    # Constant folding at trace time: ops whose inputs are all trace-time
+    # constants evaluate eagerly.  This keeps loop counters / bounds concrete
+    # so `while` can unroll and tensor arrays can grow (ops/control_flow.py).
+    # Collectives are excluded (lax.axis_index & co. need the enclosing
+    # shard_map trace), as are rng-consuming ops when a key is present (the
+    # key is usually traced anyway).
+    if (op.type not in _AXIS_OPS
+            and (opdef.n_rng == 0 or rng_key is None)
+            and not _any_tracer(args)):
+        with jax.ensure_compile_time_eval():
+            out = opdef.lower(ctx, *args, **_lower_attrs(op.attrs))
+    else:
+        out = opdef.lower(ctx, *args, **_lower_attrs(op.attrs))
     if len(opdef.output_slots) == 1 and not isinstance(out, (tuple, list)):
         out = (out,)
     elif isinstance(out, list):
@@ -180,10 +228,7 @@ def run_op(op, env, rng_key, mesh=None, axis_names=(), runner=None):
 def has_collective_ops(block):
     """True if the block contains program-level collectives (fleet/transpiler
     path) that require manual SPMD (shard_map) execution."""
-    manual = ("c_allreduce_sum", "c_allreduce_max", "c_allreduce_min",
-              "c_allreduce_prod", "c_broadcast", "c_allgather",
-              "c_reducescatter", "allreduce", "broadcast")
-    return any(op.type in manual for op in block.ops)
+    return any(op.type in _AXIS_OPS for op in block.ops)
 
 
 def build_spmd_block_fn(plan, mesh, axis="data"):
@@ -241,6 +286,12 @@ def build_spmd_block_fn(plan, mesh, axis="data"):
                 feed_specs[n] = P()  # 0-d / non-divisible: replicate
         param_ro_specs = {n: P() for n in params_ro}
         param_rw_specs = {n: P() for n in params_rw}
+        # persist_written declared replicated: grads are allreduced before any
+        # optimizer write, so params stay bitwise-identical across ranks.
+        # Rank-local persistable state (e.g. non-sync batch_norm running
+        # stats) resolves to one rank's value — same semantics as the
+        # reference's DP, where device-0's copy is the one saved
+        # (parallel_executor.cc BCastParamsToDevices / save from scope 0).
         out_specs = ([P(axis)] * len(fetch_names), {n: P() for n in persist_written})
         sm = _shard_map(
             local,

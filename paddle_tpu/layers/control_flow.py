@@ -1,0 +1,500 @@
+"""Control-flow layer API: While, StaticRNN, Switch, IfElse, tensor arrays.
+
+Parity: python/paddle/fluid/layers/control_flow.py (While :1024, Switch
+:1721, IfElse :2193, StaticRNN :417, array_write :1373, array_read :1518,
+increment :1335, array_length :1589).
+
+On TPU these build sub-blocks that the executor lowers to trace-time
+unrolling, `lax.while_loop`, `lax.cond`, or `lax.scan` (see
+ops/control_flow.py for the lowering rules).
+"""
+
+import contextlib
+
+from ..framework import Variable, default_main_program
+from ..layer_helper import LayerHelper
+from ..utils import unique_name
+
+__all__ = [
+    "While",
+    "Switch",
+    "IfElse",
+    "StaticRNN",
+    "increment",
+    "array_write",
+    "array_read",
+    "array_length",
+    "create_array",
+    "less_than",
+    "less_equal",
+    "greater_than",
+    "greater_equal",
+    "equal",
+    "not_equal",
+    "cond",
+    "is_empty",
+    "Print",
+]
+
+
+@contextlib.contextmanager
+def _sub_block(program):
+    block = program._create_block()
+    try:
+        yield block
+    finally:
+        program._rollback()
+
+
+def _collect_captures(blk, parent, skip=()):
+    """(captured, out_names) for a completed sub-block.
+
+    captured = names read before any in-block write (excluding `skip`) —
+    includes parameters created inside the block, which live in the global
+    scope.  out_names = names written by the block that exist outside it
+    (the vars the enclosing op "returns").
+    """
+    writes = set()
+    captured = []
+    skip = set(skip)
+    for op in blk.ops:
+        for n in op.input_arg_names:
+            if n and n not in writes and n not in skip and n not in captured:
+                captured.append(n)
+        for n in op.output_arg_names:
+            if n:
+                writes.add(n)
+    out_names = sorted(
+        n for n in writes
+        if parent.has_var_recursive(n) and not blk.has_var(n)
+    )
+    return captured, out_names
+
+
+def increment(x, value=1.0, in_place=True):
+    helper = LayerHelper("increment")
+    if in_place:
+        out = x
+    else:
+        out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        type="increment", inputs={"X": [x]}, outputs={"Out": [out]},
+        attrs={"step": float(value)},
+    )
+    return out
+
+
+def create_array(dtype):
+    helper = LayerHelper("array")
+    return helper.main_program.current_block().create_var(
+        name=unique_name.generate("array"), dtype=dtype, shape=None,
+        type="LOD_TENSOR_ARRAY",
+    )
+
+
+def array_write(x, i, array=None):
+    helper = LayerHelper("array_write")
+    if array is None:
+        array = create_array(x.dtype)
+    inputs = {"X": [x], "I": [i], "Array": [array]}
+    helper.append_op(
+        type="write_to_array", inputs=inputs, outputs={"Out": [array]}
+    )
+    return array
+
+
+def array_read(array, i):
+    helper = LayerHelper("array_read")
+    out = helper.create_variable_for_type_inference(dtype=array.dtype)
+    helper.append_op(
+        type="read_from_array", inputs={"X": [array], "I": [i]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def array_length(array):
+    helper = LayerHelper("array_length")
+    out = helper.create_variable_for_type_inference(dtype="int64")
+    out.shape = ()
+    helper.append_op(
+        type="lod_array_length", inputs={"X": [array]}, outputs={"Out": [out]}
+    )
+    return out
+
+
+def is_empty(x, cond=None):
+    helper = LayerHelper("is_empty")
+    out = cond or helper.create_variable_for_type_inference(dtype="bool")
+    helper.append_op(type="is_empty", inputs={"X": [x]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def Print(input, first_n=-1, message=None, summarize=20, **kwargs):
+    helper = LayerHelper("print")
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        type="print", inputs={"In": [input]}, outputs={"Out": [out]},
+        attrs={"message": message or "", "first_n": first_n,
+               "summarize": summarize},
+    )
+    return out
+
+
+# comparison layers (the reference keeps these in layers.control_flow)
+def _make_compare(op_type):
+    def layer(x, y, cond=None, force_cpu=None):
+        helper = LayerHelper(op_type)
+        out = cond or helper.create_variable_for_type_inference(dtype="bool")
+        helper.append_op(type=op_type, inputs={"X": [x], "Y": [y]},
+                         outputs={"Out": [out]})
+        return out
+
+    layer.__name__ = op_type
+    return layer
+
+
+less_than = _make_compare("less_than")
+less_equal = _make_compare("less_equal")
+greater_than = _make_compare("greater_than")
+greater_equal = _make_compare("greater_equal")
+equal = _make_compare("equal")
+not_equal = _make_compare("not_equal")
+
+
+class While:
+    """``with While(cond).block(): ...`` — loop while `cond` is true.  The
+    body must update `cond` (reference layers/control_flow.py:1024)."""
+
+    def __init__(self, cond, is_test=False, name=None):
+        self.helper = LayerHelper("while", name=name)
+        self.cond_var = cond
+        self.is_test = is_test
+
+    @contextlib.contextmanager
+    def block(self):
+        program = self.helper.main_program
+        parent = program.current_block()
+        with _sub_block(program) as blk:
+            yield
+        captured, out_names = _collect_captures(blk, parent)
+        parent.append_op(
+            type="while",
+            inputs={"X": captured, "Condition": [self.cond_var]},
+            outputs={"Out": out_names, "StepScopes": []},
+            attrs={"sub_block": blk.idx, "is_test": self.is_test},
+        )
+
+
+class Switch:
+    """``with switch.case(cond): ...`` / ``with switch.default(): ...``
+    (reference layers/control_flow.py:1721).  Lowers to a chain of
+    conditional_block ops with not-any-previous predicates."""
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("switch", name=name)
+        self.inside_scope = False
+        self.pre_not_conditions = []
+
+    @contextlib.contextmanager
+    def case(self, condition):
+        from . import tensor as ltensor
+
+        if len(self.pre_not_conditions) == 0:
+            cond = condition
+            not_cond = logical_not_layer(condition)
+        else:
+            pre = self.pre_not_conditions[-1]
+            cond = logical_and_layer(pre, condition)
+            not_cond = logical_and_layer(pre, logical_not_layer(condition))
+        self.pre_not_conditions.append(not_cond)
+        with _cond_block(self.helper, cond):
+            yield
+
+    @contextlib.contextmanager
+    def default(self):
+        if not self.pre_not_conditions:
+            raise ValueError("default() must follow at least one case()")
+        with _cond_block(self.helper, self.pre_not_conditions[-1]):
+            yield
+
+
+def logical_not_layer(x):
+    helper = LayerHelper("logical_not")
+    out = helper.create_variable_for_type_inference(dtype="bool")
+    helper.append_op(type="logical_not", inputs={"X": [x]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def logical_and_layer(x, y):
+    helper = LayerHelper("logical_and")
+    out = helper.create_variable_for_type_inference(dtype="bool")
+    helper.append_op(type="logical_and", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    return out
+
+
+@contextlib.contextmanager
+def _cond_block(helper, condition):
+    program = helper.main_program
+    parent = program.current_block()
+    with _sub_block(program) as blk:
+        yield
+    captured, out_names = _collect_captures(blk, parent)
+    parent.append_op(
+        type="conditional_block",
+        inputs={"Cond": [condition], "Input": captured},
+        outputs={"Out": out_names, "Scope": []},
+        attrs={"sub_block": blk.idx, "is_scalar_condition": True},
+    )
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """Functional two-branch conditional built from two conditional_blocks
+    writing the same output vars (2.x-style convenience; the reference 1.6
+    equivalent is IfElse)."""
+    from . import tensor as ltensor
+
+    helper = LayerHelper("cond", name=name)
+    true_out = None
+    false_out = None
+    # stage both branches into assigns onto shared output vars
+    results = {}
+
+    def run_branch(fn, condition):
+        nonlocal results
+        with _cond_block(helper, condition):
+            out = fn()
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            for i, o in enumerate(outs):
+                if i not in results:
+                    results[i] = helper.main_program.current_block().\
+                        parent_block.create_var(
+                            name=unique_name.generate("cond_out"),
+                            dtype=o.dtype, shape=o.shape)
+                helper.append_op(type="assign", inputs={"X": [o]},
+                                 outputs={"Out": [results[i]]})
+            return len(outs)
+
+    n_true = run_branch(true_fn, pred) if true_fn is not None else 0
+    if false_fn is not None:
+        notp = logical_not_layer(pred)
+        run_branch(false_fn, notp)
+    outs = [results[i] for i in sorted(results)]
+    if len(outs) == 1:
+        return outs[0]
+    return outs
+
+
+class IfElse:
+    """Reference layers/control_flow.py:2193 — here a thin adapter over two
+    conditional blocks with shared outputs."""
+
+    OUT_IF_ELSE_BLOCKS = 2
+    IN_IF_ELSE_TRUE_BLOCKS = 0
+    IN_IF_ELSE_FALSE_BLOCKS = 1
+
+    def __init__(self, cond, name=None):
+        self.helper = LayerHelper("ifelse", name=name)
+        self.cond = cond
+        self._slots = []       # shared output vars (parent block)
+        self._counts = {True: 0, False: 0}
+        self._branch = None
+
+    @contextlib.contextmanager
+    def true_block(self):
+        self._branch = True
+        with _cond_block(self.helper, self.cond):
+            yield
+        self._branch = None
+
+    @contextlib.contextmanager
+    def false_block(self):
+        self._branch = False
+        notp = logical_not_layer(self.cond)
+        with _cond_block(self.helper, notp):
+            yield
+        self._branch = None
+
+    def input(self, x):
+        return x
+
+    def output(self, *outs):
+        """Both branches assign into SHARED parent-block slot vars (by call
+        position), so a concretely-skipped branch leaves the other branch's
+        write in place and no merge op is needed; under a traced predicate
+        the two lax.cond selections compose to a where."""
+        if self._branch is None:
+            raise ValueError("output() must be called inside a branch block")
+        program = self.helper.main_program
+        cur = program.current_block()
+        parent = cur.parent_block
+        base = self._counts[self._branch]
+        for k, o in enumerate(outs):
+            i = base + k
+            if i >= len(self._slots):
+                self._slots.append(parent.create_var(
+                    name=unique_name.generate("ifelse_out"), dtype=o.dtype,
+                    shape=o.shape,
+                ))
+            cur.append_op(type="assign", inputs={"X": [o]},
+                          outputs={"Out": [self._slots[i].name]})
+        self._counts[self._branch] = base + len(outs)
+
+    def __call__(self):
+        if self._counts[True] != self._counts[False] and \
+                0 not in (self._counts[True], self._counts[False]):
+            raise ValueError("true/false branches produced different arity")
+        return list(self._slots)
+
+
+class StaticRNN:
+    """Fixed-length RNN over the time axis (reference
+    layers/control_flow.py:417) lowered to one `recurrent` op = lax.scan.
+
+    Usage::
+
+        rnn = StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)          # x: [T, B, D] -> x_t: [B, D]
+            h_prev = rnn.memory(init=h0)     # or shape/value init
+            h = some_layers(x_t, h_prev)
+            rnn.update_memory(h_prev, h)
+            rnn.step_output(h)
+        out = rnn()                          # [T, B, H]
+    """
+
+    BEFORE_RNN_BLOCK = 0
+    IN_RNN_BLOCK = 1
+    AFTER_RNN_BLOCK = 2
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("static_rnn", name=name)
+        self.status = StaticRNN.BEFORE_RNN_BLOCK
+        self.seq_inputs = []      # (outer var, inner var)
+        self.memories = {}        # inner pre-state name -> (init var, new inner var)
+        self.step_outputs = []    # inner vars
+        self._block = None
+        self.outputs = []
+
+    @contextlib.contextmanager
+    def step(self):
+        program = self.helper.main_program
+        self._parent = program.current_block()
+        self.status = StaticRNN.IN_RNN_BLOCK
+        with _sub_block(program) as blk:
+            self._block = blk
+            yield
+        self.status = StaticRNN.AFTER_RNN_BLOCK
+        self._complete()
+
+    def _assert_in_rnn_block(self):
+        if self.status != StaticRNN.IN_RNN_BLOCK:
+            raise ValueError("must be called inside rnn.step()")
+
+    def step_input(self, x):
+        self._assert_in_rnn_block()
+        inner = self._block.create_var(
+            name=unique_name.generate("rnn_step_in"),
+            dtype=x.dtype,
+            shape=tuple(x.shape[1:]) if x.shape else None,
+        )
+        self.seq_inputs.append((x, inner))
+        return inner
+
+    def memory(self, init=None, shape=None, batch_ref=None, init_value=0.0,
+               init_batch_dim_idx=0, ref_batch_dim_idx=1, dtype="float32"):
+        self._assert_in_rnn_block()
+        if init is None:
+            if shape is None or batch_ref is None:
+                raise ValueError("memory needs `init` or (`shape`+`batch_ref`)")
+            from . import tensor as ltensor
+
+            # build init in the parent block
+            program = self.helper.main_program
+            cur = program.current_block_idx
+            program.current_block_idx = self._parent.idx
+            try:
+                init = ltensor.fill_constant_batch_size_like(
+                    input=batch_ref, shape=[-1] + list(shape),
+                    value=init_value, dtype=dtype,
+                    input_dim_idx=ref_batch_dim_idx, output_dim_idx=0,
+                )
+            finally:
+                program.current_block_idx = cur
+        inner = self._block.create_var(
+            name=unique_name.generate("rnn_mem"),
+            dtype=init.dtype, shape=init.shape,
+        )
+        self.memories[inner.name] = [init, None]
+        return inner
+
+    def update_memory(self, mem, var):
+        self._assert_in_rnn_block()
+        if mem.name not in self.memories:
+            raise ValueError("%r is not a memory of this RNN" % mem.name)
+        self.memories[mem.name][1] = var
+
+    def step_output(self, o):
+        self._assert_in_rnn_block()
+        self.step_outputs.append(o)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def _complete(self):
+        blk = self._block
+        parent = self._parent
+        for name, (init, new) in self.memories.items():
+            if new is None:
+                raise ValueError("memory %r never updated" % name)
+        special = set(i.name for _, i in self.seq_inputs) | set(self.memories)
+        captured, _ = _collect_captures(blk, parent, skip=special)
+
+        outer_outs = []
+        for o in self.step_outputs:
+            ov = parent.create_var(
+                name=unique_name.generate("rnn_out"), dtype=o.dtype,
+                shape=None,
+            )
+            outer_outs.append(ov)
+        final_states = []
+        state_names = [self.memories[k][1].name for k in self.memories]
+        for k in self.memories:
+            init, new = self.memories[k]
+            fv = parent.create_var(
+                name=unique_name.generate("rnn_final"), dtype=new.dtype,
+                shape=new.shape,
+            )
+            final_states.append(fv)
+
+        parent.append_op(
+            type="recurrent",
+            inputs={
+                "StepInputs": [x.name for x, _ in self.seq_inputs],
+                "Initials": [self.memories[k][0].name for k in self.memories],
+                "Captured": captured,
+            },
+            outputs={
+                "StepOutputs": [v.name for v in outer_outs],
+                "FinalStates": [v.name for v in final_states],
+            },
+            attrs={
+                "sub_block": blk.idx,
+                "step_input_names": [i.name for _, i in self.seq_inputs],
+                "pre_state_names": list(self.memories.keys()),
+                "state_names": state_names,
+                "step_output_names": [o.name for o in self.step_outputs],
+                "captured_names": captured,
+                "reverse": False,
+            },
+        )
+        self.outputs = outer_outs
+
+    def __call__(self, *args):
+        if len(self.outputs) == 1:
+            return self.outputs[0]
+        return self.outputs

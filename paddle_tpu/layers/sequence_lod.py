@@ -1,0 +1,183 @@
+"""Sequence layer API over padded batches + lengths.
+
+Parity: python/paddle/fluid/layers/sequence_lod.py (sequence_pool :331,
+sequence_conv :30, sequence_softmax :235, sequence_expand :650,
+sequence_pad :960, sequence_unpad :1055, sequence_reverse, sequence_concat
+:553, sequence_first_step :441, sequence_last_step :487, sequence_mask).
+
+See ops/sequence.py for the LoD→padded+mask design rationale.  Every layer
+takes an optional ``seq_len`` (per-row lengths, [B] int tensor) in place of
+the reference's hidden LoD metadata.
+"""
+
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "sequence_conv",
+    "sequence_pool",
+    "sequence_softmax",
+    "sequence_expand",
+    "sequence_expand_as",
+    "sequence_pad",
+    "sequence_unpad",
+    "sequence_reverse",
+    "sequence_concat",
+    "sequence_first_step",
+    "sequence_last_step",
+    "sequence_mask",
+    "sequence_enumerate",
+]
+
+
+def _seq_inputs(x, seq_len, extra=None):
+    inputs = {"X": [x]}
+    if seq_len is not None:
+        inputs["Length"] = [seq_len]
+    if extra:
+        inputs.update(extra)
+    return inputs
+
+
+def sequence_pool(input, pool_type, is_test=False, pad_value=0.0, seq_len=None):
+    helper = LayerHelper("sequence_pool")
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        type="sequence_pool",
+        inputs=_seq_inputs(input, seq_len),
+        outputs={"Out": [out]},
+        attrs={"pooltype": pool_type.upper(), "pad_value": float(pad_value)},
+    )
+    return out
+
+
+def sequence_first_step(input, seq_len=None):
+    return sequence_pool(input, "first", seq_len=seq_len)
+
+
+def sequence_last_step(input, seq_len=None):
+    return sequence_pool(input, "last", seq_len=seq_len)
+
+
+def sequence_softmax(input, use_cudnn=False, name=None, seq_len=None):
+    helper = LayerHelper("sequence_softmax", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        type="sequence_softmax",
+        inputs=_seq_inputs(input, seq_len),
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    helper = LayerHelper("sequence_expand", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        type="sequence_expand", inputs={"X": [x], "Y": [y]},
+        outputs={"Out": [out]}, attrs={"ref_level": ref_level},
+    )
+    return out
+
+
+def sequence_expand_as(x, y, name=None):
+    helper = LayerHelper("sequence_expand_as", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        type="sequence_expand_as", inputs={"X": [x], "Y": [y]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def sequence_pad(x, pad_value, maxlen=None, name=None, seq_len=None):
+    helper = LayerHelper("sequence_pad", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    length = helper.create_variable_for_type_inference(dtype="int64")
+    inputs = {"X": [x], "PadValue": [pad_value]}
+    if seq_len is not None:
+        inputs["Length"] = [seq_len]
+    helper.append_op(
+        type="sequence_pad", inputs=inputs,
+        outputs={"Out": [out], "Length@OUT": [length]},
+        attrs={"padded_length": -1 if maxlen is None else int(maxlen)},
+    )
+    return out, length
+
+
+def sequence_unpad(x, length, name=None):
+    helper = LayerHelper("sequence_unpad", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        type="sequence_unpad", inputs={"X": [x], "Length": [length]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def sequence_reverse(x, name=None, seq_len=None):
+    helper = LayerHelper("sequence_reverse", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        type="sequence_reverse",
+        inputs=_seq_inputs(x, seq_len),
+        outputs={"Y": [out]},
+    )
+    return out
+
+
+def sequence_concat(input, name=None):
+    helper = LayerHelper("sequence_concat", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input[0].dtype)
+    helper.append_op(
+        type="sequence_concat", inputs={"X": list(input)},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, name=None, seq_len=None):
+    helper = LayerHelper("sequence_conv", name=name, bias_attr=bias_attr,
+                         param_attr=param_attr, act=act)
+    dtype = input.dtype
+    filter_shape = [filter_size * input.shape[-1], num_filters]
+    filter_param = helper.create_parameter(
+        attr=helper.kwargs.get("param_attr"), shape=filter_shape, dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    if padding_start is None:
+        padding_start = -int(filter_size // 2)
+    inputs = _seq_inputs(input, seq_len, {"Filter": [filter_param]})
+    helper.append_op(
+        type="sequence_conv",
+        inputs=inputs,
+        outputs={"Out": [out]},
+        attrs={"contextStride": filter_stride, "contextStart": padding_start,
+               "contextLength": filter_size},
+    )
+    out = helper.append_bias_op(out, dim_start=2, dim_end=3)
+    return helper.append_activation(out)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    from ..ops.common import dtype_enum
+
+    helper = LayerHelper("sequence_mask", name=name)
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op(
+        type="sequence_mask", inputs={"X": [x]}, outputs={"Y": [out]},
+        attrs={"maxlen": -1 if maxlen is None else int(maxlen),
+               "out_dtype": dtype_enum(dtype)},
+    )
+    return out
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    helper = LayerHelper("sequence_enumerate", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        type="sequence_enumerate", inputs={"X": [input]},
+        outputs={"Out": [out]},
+        attrs={"win_size": int(win_size), "pad_value": int(pad_value)},
+    )
+    return out

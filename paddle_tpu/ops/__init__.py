@@ -13,3 +13,5 @@ from . import nn  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import metrics  # noqa: F401
 from . import collective  # noqa: F401
+from . import control_flow  # noqa: F401
+from . import sequence  # noqa: F401

@@ -136,11 +136,11 @@ def gen_nccl_id(ctx, **_):
              attrs={"reduce_type": 0}, grad_maker=None)
 def allreduce(ctx, x, reduce_type=0):
     """Dygraph-mode allreduce; reduce_type enum matches the reference
-    (allreduce_op.h:56-68): 0=sum, 1=prod, 2=max, 3=min."""
+    (allreduce_op.h RedType): 0=sum, 1=max, 2=min, 3=prod."""
     axis = _axis_for_ring(ctx, 0)
     if axis is None:
         return x
-    fns = [lax.psum, _pprod, lax.pmax, lax.pmin]
+    fns = [lax.psum, lax.pmax, lax.pmin, _pprod]
     return fns[int(reduce_type)](x, axis)
 
 

@@ -1,0 +1,400 @@
+"""Control-flow ops: while, conditional_block, recurrent (StaticRNN), tensor
+arrays, is_empty, print.
+
+Parity targets: paddle/fluid/operators/controlflow/while_op.cc,
+conditional_block_op.cc, operators/recurrent_op.cc,
+operators/array_operator.h (write_to_array / read_from_array),
+operators/lod_array_length_op.cc, operators/is_empty_op.cc,
+operators/print_op.cc.
+
+TPU-native execution model (vs the reference's scope-per-iteration
+interpreter): the whole block is traced once into XLA, so loops take one of
+two lowerings:
+
+1. **Trace-time unroll** — when the loop condition is a *concrete* value at
+   trace time (counter vs constant bound, the dominant pattern in fluid
+   models: beam-search decode with a max_len counter, scheduled loops), the
+   sub-block is re-traced per iteration in Python.  Tensor arrays are plain
+   Python lists in the trace environment, so they may grow freely — XLA sees
+   straight-line code.
+2. **lax.while_loop** — when the condition is data-dependent (a traced
+   value), the loop lowers to `jax.lax.while_loop` with the loop-carried
+   variables gathered automatically from the sub-block's reads/writes.
+   Tensor arrays cannot grow inside this form (XLA static shapes) — use a
+   concrete bound instead, or `recurrent` (lax.scan) for fixed-length
+   recurrence.
+
+`recurrent` is the StaticRNN engine: lax.scan over the time axis, with
+explicit Captured inputs so jax.vjp differentiates through the scan (the
+reference builds recurrent_grad by block rewriting; here the scan is
+natively differentiable).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from ..core.lowering import run_op
+
+
+def _is_concrete(x):
+    """True when x is a trace-time constant (not a jax Tracer)."""
+    return not isinstance(x, jax.core.Tracer)
+
+
+_MAX_UNROLL = 10000
+
+
+# ---------------------------------------------------------------------------
+# tensor arrays (LoDTensorArray analog: a Python list in the trace env)
+# ---------------------------------------------------------------------------
+
+
+@register_op(
+    "write_to_array",
+    inputs=("X", "I", "Array"),
+    outputs=("Out",),
+    optional_inputs=("Array",),
+    grad_maker=None,
+    stateful=True,
+)
+def write_to_array(ctx, x, i, array):
+    if not _is_concrete(i):
+        raise NotImplementedError(
+            "write_to_array index must be a trace-time constant (use a "
+            "concrete loop counter, or `recurrent`/lax.scan for traced "
+            "indices)"
+        )
+    idx = int(np.asarray(i).reshape(()))
+    arr = list(array) if array is not None else []
+    while len(arr) <= idx:
+        arr.append(None)
+    arr[idx] = x
+    return (arr,)  # tuple-wrapped: a bare list would read as multi-output
+
+
+@register_op(
+    "read_from_array",
+    inputs=("X", "I"),
+    outputs=("Out",),
+    grad_maker=None,
+)
+def read_from_array(ctx, x, i):
+    if isinstance(x, list):
+        if _is_concrete(i):
+            return x[int(np.asarray(i).reshape(()))]
+        # traced index over a materialized array: stack + dynamic gather
+        stacked = jnp.stack([v for v in x])
+        return stacked[i.astype(jnp.int32).reshape(())]
+    return x[i.astype(jnp.int32).reshape(())]
+
+
+@register_op(
+    "lod_array_length",
+    inputs=("X",),
+    outputs=("Out",),
+    grad_maker=None,
+)
+def lod_array_length(ctx, x):
+    return jnp.asarray(len(x) if isinstance(x, list) else x.shape[0],
+                       dtype=jnp.int64)
+
+
+@register_op(
+    "is_empty",
+    inputs=("X",),
+    outputs=("Out",),
+    grad_maker=None,
+)
+def is_empty(ctx, x):
+    if isinstance(x, list):
+        return jnp.asarray(len(x) == 0)
+    return jnp.asarray(int(np.prod(x.shape)) == 0)
+
+
+# ---------------------------------------------------------------------------
+# while
+# ---------------------------------------------------------------------------
+
+
+def _sub_block_reads_writes(block):
+    """(reads-before-write, writes) of a sub-block, by name."""
+    written = set()
+    reads = []
+    for op in block.ops:
+        for n in op.input_arg_names:
+            if n and n not in written and n not in reads:
+                reads.append(n)
+        for n in op.output_arg_names:
+            if n:
+                written.add(n)
+    return reads, written
+
+
+@register_op(
+    "while",
+    inputs=("X", "Condition"),
+    outputs=("Out", "StepScopes"),
+    attrs={"sub_block": -1, "is_test": False},
+    duplicable_inputs=("X",),
+    duplicable_outputs=("Out",),
+    optional_inputs=("X",),
+    grad_maker=None,
+    stateful=True,
+)
+def while_op(ctx, xs, cond, sub_block=-1, is_test=False, **_):
+    env = ctx.env
+    block = ctx.block.program.block(sub_block)
+    cond_name = ctx.op.input("Condition")[0]
+
+    if _is_concrete(cond):
+        # trace-time unroll: condition chain stays concrete as long as no
+        # traced value flows into it
+        it = 0
+        while True:
+            c = env[cond_name]
+            if not _is_concrete(c):
+                raise RuntimeError(
+                    "while condition %r became data-dependent mid-loop; "
+                    "seed the loop with a traced condition instead" % cond_name
+                )
+            if not bool(np.asarray(c).reshape(())):
+                break
+            key = jax.random.fold_in(ctx.rng(), it) if ctx._rng_key is not None else None
+            ctx.run_sub_block(sub_block, env, key)
+            it += 1
+            if it > _MAX_UNROLL:
+                raise RuntimeError("while unrolled past %d iterations" % _MAX_UNROLL)
+        return None, None
+
+    # data-dependent: lax.while_loop over automatically discovered carries
+    reads, writes = _sub_block_reads_writes(block)
+    carried = [n for n in reads if n in writes and n in env]
+    for n in sorted(writes):
+        if n in env and n not in carried:
+            carried.append(n)
+    if cond_name not in carried:
+        raise RuntimeError(
+            "while sub-block never updates its condition %r" % cond_name
+        )
+    for n in carried:
+        if isinstance(env[n], list):
+            raise NotImplementedError(
+                "tensor arrays cannot be loop-carried through a "
+                "data-dependent while (XLA static shapes); bound the loop "
+                "with a concrete counter or use `recurrent`"
+            )
+    outer = {k: v for k, v in env.items() if k not in carried}
+
+    def cond_fn(carry):
+        return jnp.asarray(carry[carried.index(cond_name)]).reshape(()) != 0
+
+    def body_fn(carry):
+        local = dict(outer)
+        local.update(zip(carried, carry))
+        for i, op in enumerate(block.ops):
+            run_op(op, local, None, mesh=ctx.mesh, axis_names=ctx.axis_names)
+        return tuple(local[n] for n in carried)
+
+    init = tuple(env[n] for n in carried)
+    final = jax.lax.while_loop(cond_fn, body_fn, init)
+    env.update(zip(carried, final))
+    return None, None
+
+
+# ---------------------------------------------------------------------------
+# conditional_block
+# ---------------------------------------------------------------------------
+
+
+@register_op(
+    "conditional_block",
+    inputs=("Cond", "Input"),
+    outputs=("Out", "Scope"),
+    attrs={"sub_block": -1, "is_scalar_condition": True},
+    duplicable_inputs=("Cond", "Input"),
+    duplicable_outputs=("Out",),
+    optional_inputs=("Input",),
+    grad_maker=None,
+    stateful=True,
+)
+def conditional_block(ctx, conds, inputs, sub_block=-1, is_scalar_condition=True, **_):
+    env = ctx.env
+    block = ctx.block.program.block(sub_block)
+    cond = conds[0]
+    if is_scalar_condition:
+        pred = cond.reshape(())
+    else:
+        pred = jnp.all(cond)
+
+    if _is_concrete(pred):
+        if bool(np.asarray(pred)):
+            ctx.run_sub_block(sub_block, env,
+                              ctx.rng() if ctx._rng_key is not None else None)
+        return None, None
+
+    # traced predicate: lax.cond over the sub-block's written vars.  Vars the
+    # branch would create fresh get zero-initialized defaults from an
+    # abstract trace so both branches return the same structure.
+    _, writes = _sub_block_reads_writes(block)
+    writes = sorted(writes)
+    outer = dict(env)
+
+    def run_branch(_):
+        local = dict(outer)
+        for op in block.ops:
+            run_op(op, local, None, mesh=ctx.mesh, axis_names=ctx.axis_names)
+        return tuple(local[n] for n in writes)
+
+    shapes = jax.eval_shape(run_branch, 0)
+    defaults = tuple(
+        env[n] if n in env else jnp.zeros(s.shape, s.dtype)
+        for n, s in zip(writes, shapes)
+    )
+
+    def false_branch(_):
+        return defaults
+
+    out = jax.lax.cond(pred != 0, run_branch, false_branch, 0)
+    env.update(zip(writes, out))
+    return None, None
+
+
+# ---------------------------------------------------------------------------
+# recurrent (StaticRNN): lax.scan over the leading (time) axis
+# ---------------------------------------------------------------------------
+
+
+@register_op(
+    "recurrent",
+    inputs=("StepInputs", "Initials", "Captured"),
+    outputs=("StepOutputs", "FinalStates"),
+    attrs={
+        "sub_block": -1,
+        "step_input_names": [],   # inner per-step names, parallel to StepInputs
+        "pre_state_names": [],    # inner names holding state(t-1)
+        "state_names": [],        # inner names the block writes as state(t)
+        "step_output_names": [],  # inner names stacked along time into StepOutputs
+        "captured_names": [],     # inner==outer names of captured (weight) vars
+        "reverse": False,
+    },
+    duplicable_inputs=("StepInputs", "Initials", "Captured"),
+    duplicable_outputs=("StepOutputs", "FinalStates"),
+    optional_inputs=("StepInputs", "Captured"),
+    grad_maker="auto",
+    stateful=False,
+)
+def recurrent(ctx, step_inputs, initials, captured, sub_block=-1,
+              step_input_names=(), pre_state_names=(), state_names=(),
+              step_output_names=(), captured_names=(), reverse=False, **_):
+    block = ctx.block.program.block(sub_block)
+    step_inputs = [x for x in (step_inputs or [])]
+    captured = [x for x in (captured or [])]
+    mesh, axis_names = ctx.mesh, ctx.axis_names
+
+    base_key = ctx.rng() if ctx._rng_key is not None else None
+    T = step_inputs[0].shape[0] if step_inputs else None
+    if T is None:
+        raise ValueError("recurrent requires at least one step input")
+
+    def body(carry, xs):
+        step_vals, key = xs
+        env = dict(zip(captured_names, captured))
+        env.update(zip(pre_state_names, carry))
+        env.update(zip(step_input_names, step_vals))
+        for i, op in enumerate(block.ops):
+            k = jax.random.fold_in(key, i) if key is not None else None
+            run_op(op, env, k, mesh=mesh, axis_names=axis_names)
+        new_carry = tuple(env[n] for n in state_names)
+        outs = tuple(env[n] for n in step_output_names)
+        return new_carry, outs
+
+    xs_stacked = tuple(step_inputs)
+    if base_key is not None:
+        keys = jax.random.split(base_key, T)
+    else:
+        # scan still needs a leaf of length T for the key slot
+        keys = None
+    init = tuple(initials)
+    final, ys = jax.lax.scan(
+        lambda c, x: body(c, x), init, (xs_stacked, keys), reverse=bool(reverse)
+    )
+    return list(ys), list(final)
+
+
+def _recurrent_infer(op, block):
+    prog = block.program
+    sub = prog.block(op.attr("sub_block"))
+    step_out_names = op.attr("step_output_names") or []
+    sin = op.input("StepInputs")
+    T = None
+    if sin:
+        v = block._find_var_recursive(sin[0])
+        if v is not None and v.shape:
+            T = v.shape[0]
+    for outer_name, inner_name in zip(op.output("StepOutputs"), step_out_names):
+        iv = sub._find_var_recursive(inner_name)
+        ov = block._find_var_recursive(outer_name)
+        if iv is not None and ov is not None and iv.shape is not None:
+            ov.shape = (T,) + tuple(iv.shape) if T is not None else None
+            ov.dtype = iv.dtype
+    for outer_name, inner_name in zip(op.output("FinalStates"),
+                                      op.attr("state_names") or []):
+        iv = sub._find_var_recursive(inner_name)
+        ov = block._find_var_recursive(outer_name)
+        if iv is not None and ov is not None:
+            ov.shape = iv.shape
+            ov.dtype = iv.dtype
+
+
+recurrent.opdef.infer_shape = _recurrent_infer
+
+
+# ---------------------------------------------------------------------------
+# print (debug passthrough; reference operators/print_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@register_op(
+    "print",
+    inputs=("In",),
+    outputs=("Out",),
+    attrs={"message": "", "first_n": -1, "summarize": 20,
+           "print_tensor_name": True, "print_tensor_type": True,
+           "print_tensor_shape": True, "print_tensor_lod": False,
+           "print_phase": "BOTH"},
+    grad_maker=None,
+)
+def print_op(ctx, x, message="", **_):
+    jax.debug.print(message + "{x}", x=x)
+    return x
+
+
+# ---------------------------------------------------------------------------
+# static shape inference: control-flow ops cannot be abstractly traced at
+# append_op time (they need the live trace env), so give them explicit rules
+# ---------------------------------------------------------------------------
+
+
+def _noop_infer(op, block):
+    return None
+
+
+def _copy_x_infer(op, block):
+    xv = block._find_var_recursive(op.input("In")[0])
+    ov = block._find_var_recursive(op.output("Out")[0])
+    if xv is not None and ov is not None:
+        ov.shape = xv.shape
+        if ov.dtype is None:
+            ov.dtype = xv.dtype
+
+
+for _t in ("write_to_array", "read_from_array", "while", "conditional_block"):
+    from ..core.registry import get_op_def as _g
+
+    _g(_t).infer_shape = _noop_infer
+
+for _t in ("lod_array_length", "is_empty"):
+    _g(_t).infer_shape = _noop_infer
+_g("print").infer_shape = _copy_x_infer

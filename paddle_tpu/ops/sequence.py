@@ -1,0 +1,268 @@
+"""Sequence ops over padded dense batches + explicit lengths.
+
+Parity targets: paddle/fluid/operators/sequence_ops/ (sequence_pool_op.cc,
+sequence_softmax_op.cc, sequence_expand_op.cc, sequence_pad_op.cc,
+sequence_unpad_op.cc, sequence_conv_op.cc, sequence_reverse_op.h,
+sequence_concat_op.cc, sequence_mask_op.cc…).
+
+LoD design note (SURVEY.md §5 "Long-context"): the reference represents
+variable-length batches as LoDTensor — a flat [total_tokens, D] buffer plus
+ragged offsets — and its sequence kernels iterate offsets on the host.  That
+layout cannot be compiled by XLA (dynamic shapes), and on TPU ragged
+iteration wastes the MXU.  This framework instead uses the TPU-native
+layout: **padded dense [batch, max_len, ...] tensors + a per-row Length
+vector**, with masking inside the lowering.  The `sequence_*` op names and
+semantics (pool/softmax/expand/reverse/conv per-sequence, respecting
+lengths) are preserved; `Length` rides as an explicit optional input instead
+of hidden LoD metadata.  XLA fuses every mask with its consumer, so the
+masked forms cost ~0 extra HBM traffic.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import attr_dtype
+
+
+def _time_mask(x_btd, length, dtype=None):
+    """[B, T] validity mask from per-row lengths (None -> all valid)."""
+    B, T = x_btd.shape[0], x_btd.shape[1]
+    if length is None:
+        m = jnp.ones((B, T), dtype=dtype or x_btd.dtype)
+    else:
+        t = jnp.arange(T)[None, :]
+        m = (t < length.reshape(-1, 1)).astype(dtype or x_btd.dtype)
+    return m
+
+
+def _expand_mask(m, x):
+    """Broadcast a [B, T] mask across x's trailing dims."""
+    return m.reshape(m.shape + (1,) * (x.ndim - 2))
+
+
+@register_op(
+    "sequence_mask",
+    inputs=("X", "MaxLenTensor"),
+    outputs=("Y",),
+    attrs={"maxlen": -1, "out_dtype": 5},
+    optional_inputs=("MaxLenTensor",),
+    grad_maker=None,
+)
+def sequence_mask(ctx, x, maxlen_tensor, maxlen=-1, out_dtype=5):
+    if maxlen_tensor is not None:
+        maxlen = int(np.asarray(maxlen_tensor).reshape(()))
+    if maxlen < 0:
+        raise ValueError(
+            "sequence_mask needs a static maxlen on TPU (XLA static shapes); "
+            "pass maxlen explicitly"
+        )
+    dt = attr_dtype(out_dtype)
+    t = jnp.arange(maxlen)
+    m = t.reshape((1,) * x.ndim + (maxlen,)) < x[..., None]
+    return m.astype(dt)
+
+
+@register_op(
+    "sequence_pool",
+    inputs=("X", "Length"),
+    outputs=("Out", "MaxIndex"),
+    attrs={"pooltype": "AVERAGE", "pad_value": 0.0},
+    optional_inputs=("Length", "MaxIndex"),
+)
+def sequence_pool(ctx, x, length, pooltype="AVERAGE", pad_value=0.0):
+    pooltype = pooltype.upper()
+    m = _expand_mask(_time_mask(x, length), x)
+    T = x.shape[1]
+    if length is None:
+        n = jnp.full((x.shape[0],) + (1,) * (x.ndim - 2), float(T), x.dtype)
+    else:
+        n = jnp.maximum(length.astype(x.dtype), 1).reshape(
+            (-1,) + (1,) * (x.ndim - 2))
+    if pooltype == "SUM":
+        out = jnp.sum(x * m, axis=1)
+    elif pooltype == "AVERAGE":
+        out = jnp.sum(x * m, axis=1) / n
+    elif pooltype == "SQRT":
+        out = jnp.sum(x * m, axis=1) / jnp.sqrt(n)
+    elif pooltype == "MAX":
+        neg = jnp.asarray(jnp.finfo(x.dtype).min, x.dtype)
+        out = jnp.max(jnp.where(m > 0, x, neg), axis=1)
+    elif pooltype == "LAST":
+        if length is None:
+            out = x[:, -1]
+        else:
+            idx = jnp.maximum(length.astype(jnp.int32) - 1, 0).reshape(-1)
+            out = jnp.take_along_axis(
+                x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1
+            ).squeeze(1)
+    elif pooltype == "FIRST":
+        out = x[:, 0]
+    else:
+        raise ValueError("unknown pooltype %r" % pooltype)
+    if length is not None and pooltype in ("MAX", "LAST", "FIRST"):
+        valid = (length > 0).reshape((-1,) + (1,) * (out.ndim - 1))
+        out = jnp.where(valid, out, jnp.asarray(pad_value, out.dtype))
+    return out, None
+
+
+@register_op(
+    "sequence_softmax",
+    inputs=("X", "Length"),
+    outputs=("Out",),
+    optional_inputs=("Length",),
+)
+def sequence_softmax(ctx, x, length):
+    # x: [B, T] (or [B, T, 1]); softmax over the valid T per row
+    squeeze = x.ndim == 3 and x.shape[-1] == 1
+    v = x.reshape(x.shape[:2]) if squeeze else x
+    m = _time_mask(v, length, dtype=jnp.bool_)
+    neg = jnp.asarray(jnp.finfo(v.dtype).min, v.dtype)
+    logits = jnp.where(m, v, neg)
+    out = jax.nn.softmax(logits, axis=1)
+    out = jnp.where(m, out, jnp.zeros_like(out))
+    return out.reshape(x.shape) if squeeze else out
+
+
+@register_op(
+    "sequence_expand",
+    inputs=("X", "Y"),
+    outputs=("Out",),
+    attrs={"ref_level": -1},
+    no_grad_inputs=("Y",),
+)
+def sequence_expand(ctx, x, y, ref_level=-1):
+    # padded semantics: broadcast x [B, ...] along y's time axis -> [B, T, ...]
+    T = y.shape[1]
+    return jnp.broadcast_to(
+        x[:, None], (x.shape[0], T) + tuple(x.shape[1:])
+    )
+
+
+@register_op(
+    "sequence_expand_as",
+    inputs=("X", "Y"),
+    outputs=("Out",),
+    no_grad_inputs=("Y",),
+)
+def sequence_expand_as(ctx, x, y):
+    T = y.shape[1]
+    return jnp.broadcast_to(x[:, None], (x.shape[0], T) + tuple(x.shape[1:]))
+
+
+@register_op(
+    "sequence_reverse",
+    inputs=("X", "Length"),
+    outputs=("Y",),
+    optional_inputs=("Length",),
+)
+def sequence_reverse(ctx, x, length):
+    T = x.shape[1]
+    if length is None:
+        return jnp.flip(x, axis=1)
+    t = jnp.arange(T)[None, :]
+    L = length.reshape(-1, 1).astype(jnp.int32)
+    idx = jnp.where(t < L, L - 1 - t, t)  # reverse valid prefix, keep pad
+    return jnp.take_along_axis(
+        x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)), axis=1
+    )
+
+
+@register_op(
+    "sequence_pad",
+    inputs=("X", "PadValue", "Length"),
+    outputs=("Out", "Length@OUT"),
+    attrs={"padded_length": -1},
+    optional_inputs=("Length",),
+    no_grad_inputs=("PadValue", "Length"),
+)
+def sequence_pad(ctx, x, pad_value, length, padded_length=-1):
+    # already-padded world: fill positions beyond each row's length with
+    # pad_value (and optionally re-pad time to padded_length).  Lengths
+    # default to the ORIGINAL time extent (before any re-pad) so Length out
+    # reports true pre-pad row lengths.
+    orig_T = x.shape[1]
+    L = length if length is not None else jnp.full(
+        (x.shape[0],), orig_T, jnp.int64)
+    if padded_length > 0 and padded_length != orig_T:
+        if padded_length > orig_T:
+            pad = [(0, 0)] * x.ndim
+            pad[1] = (0, padded_length - orig_T)
+            x = jnp.pad(x, pad)
+        else:
+            x = x[:, :padded_length]
+    m = _expand_mask(_time_mask(x, L), x)
+    pv = pad_value.reshape(()) if pad_value is not None else jnp.asarray(0, x.dtype)
+    out = x * m + (1 - m) * pv.astype(x.dtype)
+    return out, L
+
+
+@register_op(
+    "sequence_unpad",
+    inputs=("X", "Length"),
+    outputs=("Out",),
+    no_grad_inputs=("Length",),
+)
+def sequence_unpad(ctx, x, length):
+    # padded world: zero out the padding (shape stays static)
+    m = _expand_mask(_time_mask(x, length), x)
+    return x * m
+
+
+@register_op(
+    "sequence_concat",
+    inputs=("X",),
+    outputs=("Out",),
+    duplicable_inputs=("X",),
+)
+def sequence_concat(ctx, xs):
+    return jnp.concatenate(list(xs), axis=1)
+
+
+@register_op(
+    "sequence_conv",
+    inputs=("X", "Filter", "PaddingData", "Length"),
+    outputs=("Out",),
+    attrs={"contextLength": 3, "contextStart": -1, "contextStride": 1,
+           "paddingTrainable": False},
+    optional_inputs=("PaddingData", "Length"),
+    no_grad_inputs=("PaddingData", "Length"),
+)
+def sequence_conv(ctx, x, filt, padding_data, length, contextLength=3,
+                  contextStart=-1, contextStride=1, paddingTrainable=False):
+    # x: [B, T, D]; filter: [contextLength*D, M] -> out [B, T, M]
+    if contextStride != 1:
+        raise NotImplementedError("sequence_conv contextStride must be 1")
+    B, T, D = x.shape
+    m = _expand_mask(_time_mask(x, length), x)
+    xm = x * m
+    cols = []
+    for k in range(contextLength):
+        off = contextStart + k
+        shifted = jnp.roll(xm, -off, axis=1)
+        t = jnp.arange(T)
+        valid = ((t + off) >= 0) & ((t + off) < T)
+        cols.append(shifted * valid[None, :, None].astype(x.dtype))
+    im = jnp.concatenate(cols, axis=-1)  # [B, T, ctx*D]
+    out = jnp.einsum("btc,cm->btm", im, filt)
+    return out * _expand_mask(_time_mask(out, length), out)
+
+
+@register_op(
+    "sequence_enumerate",
+    inputs=("X",),
+    outputs=("Out",),
+    attrs={"win_size": 2, "pad_value": 0},
+    grad_maker=None,
+)
+def sequence_enumerate(ctx, x, win_size=2, pad_value=0):
+    # x: [B, T] int ids -> [B, T, win_size] sliding windows padded w/ pad_value
+    B, T = x.shape[0], x.shape[1]
+    outs = []
+    for k in range(win_size):
+        shifted = jnp.roll(x, -k, axis=1)
+        valid = (jnp.arange(T) + k) < T
+        outs.append(jnp.where(valid[None, :], shifted,
+                              jnp.asarray(pad_value, x.dtype)))
+    return jnp.stack(outs, axis=-1)

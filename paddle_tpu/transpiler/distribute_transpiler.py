@@ -102,7 +102,7 @@ class DistributeTranspiler:
             self._transpiled = True
             return
 
-        from ..distributed.ps_transpile import transpile_pserver_mode
+        from .ps_transpile import transpile_pserver_mode
 
         self._ps_state = transpile_pserver_mode(self)
         self._transpiled = True
@@ -110,6 +110,8 @@ class DistributeTranspiler:
     def get_trainer_program(self, wait_port=True):
         if self.config.mode in ("nccl2", "collective"):
             return self.program
+        if getattr(self, "_ps_state", None) is None:
+            raise RuntimeError("call transpile() before get_trainer_program()")
         return self._ps_state.trainer_program
 
     def get_pserver_program(self, endpoint):

@@ -1,0 +1,26 @@
+"""Parameter-server program splitting (reference
+transpiler/distribute_transpiler.py:495 `transpile`, :230).
+
+Rewrites a trainer program into (trainer half, per-pserver halves): grads are
+sent to their owning pserver, the pserver runs the optimizer sub-program per
+received grad, and updated params are pulled back (reference flow §3.4 in
+SURVEY.md).
+"""
+
+
+class PSState:
+    def __init__(self, trainer_program, pserver_programs, pserver_startups,
+                 param_map):
+        self.trainer_program = trainer_program
+        self.pserver_programs = pserver_programs
+        self.pserver_startups = pserver_startups
+        self.param_map = param_map
+
+
+def transpile_pserver_mode(t):
+    raise NotImplementedError(
+        "parameter-server transpile mode is not implemented yet; use "
+        "mode='collective' (fleet collective DP over the mesh) — the PS "
+        "runtime (listen_and_serv / send / recv over the C++ RPC backend) "
+        "is tracked in SURVEY.md §7 step 8"
+    )

@@ -1,0 +1,210 @@
+"""Control flow: while (unrolled + lax.while_loop), tensor arrays,
+conditional_block/Switch, StaticRNN (lax.scan) incl. gradients.
+
+Parity model: reference unittests test_while_op.py, test_array_read_write.py,
+test_switch.py, test_recurrent_op.py.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _run(main, startup, feed, fetch_list):
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        return exe.run(main, feed=feed, fetch_list=fetch_list)
+
+
+def test_while_concrete_counter_sums():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = layers.fill_constant(shape=[1], dtype="int64", value=0)
+        limit = layers.fill_constant(shape=[1], dtype="int64", value=10)
+        total = layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+        x = layers.data("x", shape=[10], append_batch_size=False)
+        cond = layers.less_than(i, limit)
+        w = layers.While(cond)
+        with w.block():
+            xi = layers.gather(x, i)
+            layers.assign(layers.elementwise_add(total, xi), total)
+            layers.increment(i, value=1, in_place=True)
+            layers.less_than(i, limit, cond=cond)
+    xs = np.arange(10).astype("float32")
+    (out,) = _run(main, startup, {"x": xs}, [total])
+    assert np.allclose(out, xs.sum())
+
+
+def test_while_traced_condition_lax_loop():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        n = layers.data("n", shape=[1], dtype="int64", append_batch_size=False)
+        i = layers.zeros(shape=[1], dtype="int64")
+        i = layers.elementwise_add(i, layers.zeros(shape=[1], dtype="int64"))
+        acc = layers.data("acc0", shape=[1], append_batch_size=False)
+        cond = layers.less_than(i, n)  # traced: n is fed
+        w = layers.While(cond)
+        with w.block():
+            layers.assign(layers.elementwise_add(acc, acc), acc)  # acc *= 2
+            layers.increment(i, value=1, in_place=True)
+            layers.less_than(i, n, cond=cond)
+    (out,) = _run(main, startup,
+                  {"n": np.array([5], "int64"), "acc0": np.array([1.0], "float32")},
+                  [acc])
+    assert np.allclose(out, 32.0)
+
+
+def test_array_write_read_length():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[3], append_batch_size=False)
+        i0 = layers.fill_constant(shape=[1], dtype="int64", value=0)
+        i1 = layers.fill_constant(shape=[1], dtype="int64", value=1)
+        arr = layers.array_write(x, i0)
+        y = layers.elementwise_add(x, x)
+        layers.array_write(y, i1, array=arr)
+        n = layers.array_length(arr)
+        r0 = layers.array_read(arr, i0)
+        r1 = layers.array_read(arr, i1)
+    xs = np.array([1.0, 2.0, 3.0], "float32")
+    n_v, r0_v, r1_v = _run(main, startup, {"x": xs}, [n, r0, r1])
+    assert int(n_v) == 2
+    assert np.allclose(r0_v, xs)
+    assert np.allclose(r1_v, 2 * xs)
+
+
+def test_switch_concrete():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        lr = layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+        step = layers.fill_constant(shape=[1], dtype="float32", value=7.0)
+        boundary = layers.fill_constant(shape=[1], dtype="float32", value=5.0)
+        sw = layers.Switch()
+        with sw.case(layers.less_than(step, boundary)):
+            layers.assign(layers.fill_constant(shape=[1], dtype="float32",
+                                               value=0.1), lr)
+        with sw.default():
+            layers.assign(layers.fill_constant(shape=[1], dtype="float32",
+                                               value=0.01), lr)
+    (out,) = _run(main, startup, {}, [lr])
+    assert np.allclose(out, 0.01)
+
+
+def test_conditional_block_traced_pred():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], append_batch_size=False)
+        flag = layers.data("flag", shape=[1], dtype="float32",
+                           append_batch_size=False)
+        out = layers.fill_constant(shape=[4], dtype="float32", value=-1.0)
+        out = layers.elementwise_add(out, layers.zeros([4], "float32"))
+        pred = layers.greater_than(flag, layers.zeros([1], "float32"))
+        sw = layers.Switch()
+        with sw.case(pred):
+            layers.assign(layers.elementwise_mul(x, x), out)
+    xs = np.array([1, 2, 3, 4], "float32")
+    (o1,) = _run(main, startup, {"x": xs, "flag": np.array([1.0], "float32")}, [out])
+    assert np.allclose(o1, xs * xs)
+    (o0,) = _run(main, startup, {"x": xs, "flag": np.array([-1.0], "float32")}, [out])
+    assert np.allclose(o0, -np.ones(4, "float32"))
+
+
+def test_static_rnn_forward():
+    T, B, D = 5, 2, 3
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[T, B, D], append_batch_size=False)
+        h0 = layers.data("h0", shape=[B, D], append_batch_size=False)
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)
+            h_prev = rnn.memory(init=h0)
+            h = layers.elementwise_add(x_t, h_prev)
+            rnn.update_memory(h_prev, h)
+            rnn.step_output(h)
+        out = rnn()
+    xs = np.random.RandomState(0).randn(T, B, D).astype("float32")
+    h0v = np.zeros((B, D), "float32")
+    (o,) = _run(main, startup, {"x": xs, "h0": h0v}, [out])
+    assert o.shape == (T, B, D)
+    assert np.allclose(o, np.cumsum(xs, axis=0), atol=1e-5)
+
+
+def test_static_rnn_trains():
+    """Gradient flows through lax.scan: train weights of a tiny RNN."""
+    T, B, D, H = 4, 8, 3, 5
+    rng = np.random.RandomState(1)
+    xs = rng.randn(T, B, D).astype("float32")
+    ys = rng.randn(B, 1).astype("float32")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[T, B, D], append_batch_size=False)
+        y = layers.data("y", shape=[B, 1], append_batch_size=False)
+        h0 = layers.fill_constant(shape=[B, H], dtype="float32", value=0.0)
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)
+            h_prev = rnn.memory(init=h0)
+            z = layers.fc(input=x_t, size=H, act=None, name="rnn_fc")
+            h = layers.tanh(layers.elementwise_add(z, h_prev))
+            rnn.update_memory(h_prev, h)
+            rnn.step_output(h)
+        out = rnn()
+        last = layers.slice(out, axes=[0], starts=[T - 1], ends=[T])
+        last = layers.reshape(last, [B, H])
+        pred = layers.fc(input=last, size=1, act=None)
+        loss = layers.reduce_mean(layers.square(pred - y))
+        opt = fluid.optimizer.SGDOptimizer(learning_rate=0.1)
+        opt.minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = []
+        for _ in range(15):
+            (lv,) = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+            losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_ifelse_merge():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = layers.data("a", shape=[1], append_batch_size=False)
+        b = layers.data("b", shape=[1], append_batch_size=False)
+        pred = layers.less_than(a, b)
+        ie = layers.IfElse(pred)
+        with ie.true_block():
+            ie.output(layers.elementwise_add(a, b))
+        with ie.false_block():
+            ie.output(layers.elementwise_sub(a, b))
+        (out,) = ie()
+    (o,) = _run(main, startup,
+                {"a": np.array([1.0], "float32"), "b": np.array([2.0], "float32")},
+                [out])
+    assert np.allclose(o, 3.0)
+
+
+def test_ifelse_concrete_pred():
+    """Concrete predicate: only the taken branch runs; shared slots still
+    produce the right output (regression: untaken-branch KeyError)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = layers.fill_constant(shape=[1], dtype="float32", value=1.0)
+        b = layers.fill_constant(shape=[1], dtype="float32", value=2.0)
+        pred = layers.less_than(a, b)
+        ie = layers.IfElse(pred)
+        with ie.true_block():
+            ie.output(layers.elementwise_add(a, b))
+        with ie.false_block():
+            ie.output(layers.elementwise_sub(a, b))
+        (out,) = ie()
+        out = layers.scale(out, scale=1.0)
+    (o,) = _run(main, startup, {}, [out])
+    assert np.allclose(o, 3.0)

@@ -1,0 +1,173 @@
+"""Sequence ops on the padded+lengths layout vs numpy references.
+
+Parity model: reference unittests test_sequence_pool.py,
+test_sequence_softmax_op.py, test_sequence_reverse.py, test_sequence_mask.py,
+test_sequence_conv.py (LoD cases mapped to padded+Length)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _run(build, feed):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        fetches = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        return exe.run(main, feed=feed, fetch_list=fetches)
+
+
+B, T, D = 3, 5, 4
+RNG = np.random.RandomState(0)
+X = RNG.randn(B, T, D).astype("float32")
+LEN = np.array([5, 3, 1], "int64")
+MASK = (np.arange(T)[None, :] < LEN[:, None]).astype("float32")
+
+
+def _build_xlen():
+    x = layers.data("x", shape=[B, T, D], append_batch_size=False)
+    ln = layers.data("len", shape=[B], dtype="int64", append_batch_size=False)
+    return x, ln
+
+
+def test_sequence_pool_modes():
+    def build():
+        x, ln = _build_xlen()
+        return [
+            layers.sequence_pool(x, "sum", seq_len=ln),
+            layers.sequence_pool(x, "average", seq_len=ln),
+            layers.sequence_pool(x, "max", seq_len=ln),
+            layers.sequence_last_step(x, seq_len=ln),
+            layers.sequence_first_step(x, seq_len=ln),
+        ]
+
+    s, a, m, last, first = _run(build, {"x": X, "len": LEN})
+    xm = X * MASK[:, :, None]
+    assert np.allclose(s, xm.sum(1), atol=1e-5)
+    assert np.allclose(a, xm.sum(1) / LEN[:, None], atol=1e-5)
+    expect_max = np.stack([X[i, : LEN[i]].max(0) for i in range(B)])
+    assert np.allclose(m, expect_max, atol=1e-5)
+    expect_last = np.stack([X[i, LEN[i] - 1] for i in range(B)])
+    assert np.allclose(last, expect_last, atol=1e-5)
+    assert np.allclose(first, X[:, 0], atol=1e-5)
+
+
+def test_sequence_softmax():
+    def build():
+        x = layers.data("x", shape=[B, T], append_batch_size=False)
+        ln = layers.data("len", shape=[B], dtype="int64", append_batch_size=False)
+        return [layers.sequence_softmax(x, seq_len=ln)]
+
+    x2 = X[:, :, 0]
+    (out,) = _run(build, {"x": x2, "len": LEN})
+    for i in range(B):
+        L = LEN[i]
+        e = np.exp(x2[i, :L] - x2[i, :L].max())
+        assert np.allclose(out[i, :L], e / e.sum(), atol=1e-5)
+        assert np.allclose(out[i, L:], 0.0)
+
+
+def test_sequence_reverse():
+    def build():
+        x, ln = _build_xlen()
+        return [layers.sequence_reverse(x, seq_len=ln)]
+
+    (out,) = _run(build, {"x": X, "len": LEN})
+    for i in range(B):
+        L = LEN[i]
+        assert np.allclose(out[i, :L], X[i, :L][::-1], atol=1e-6)
+        assert np.allclose(out[i, L:], X[i, L:], atol=1e-6)
+
+
+def test_sequence_mask():
+    def build():
+        ln = layers.data("len", shape=[B], dtype="int64", append_batch_size=False)
+        return [layers.sequence_mask(ln, maxlen=T, dtype="float32")]
+
+    (out,) = _run(build, {"len": LEN})
+    assert np.allclose(out, MASK)
+
+
+def test_sequence_expand_as():
+    def build():
+        v = layers.data("v", shape=[B, D], append_batch_size=False)
+        x = layers.data("x", shape=[B, T, D], append_batch_size=False)
+        return [layers.sequence_expand_as(v, x)]
+
+    v = RNG.randn(B, D).astype("float32")
+    (out,) = _run(build, {"v": v, "x": X})
+    assert out.shape == (B, T, D)
+    assert np.allclose(out, np.broadcast_to(v[:, None], (B, T, D)))
+
+
+def test_sequence_pad_unpad():
+    def build():
+        x, ln = _build_xlen()
+        pv = layers.fill_constant(shape=[1], dtype="float32", value=9.0)
+        padded, _ = layers.sequence_pad(x, pv, seq_len=ln)
+        unpadded = layers.sequence_unpad(x, ln)
+        return [padded, unpadded]
+
+    padded, unpadded = _run(build, {"x": X, "len": LEN})
+    for i in range(B):
+        L = LEN[i]
+        assert np.allclose(padded[i, :L], X[i, :L])
+        assert np.allclose(padded[i, L:], 9.0)
+        assert np.allclose(unpadded[i, L:], 0.0)
+
+
+def test_sequence_conv_full_length():
+    def build():
+        x = layers.data("x", shape=[B, T, D], append_batch_size=False)
+        out = layers.sequence_conv(x, num_filters=6, filter_size=3,
+                                   padding_start=-1, bias_attr=False,
+                                   param_attr=fluid.ParamAttr(
+                                       initializer=fluid.initializer.Constant(0.5)))
+        return [out]
+
+    (out,) = _run(build, {"x": X})
+    # numpy im2col reference with zero padding outside [0, T)
+    W = np.full((3 * D, 6), 0.5, "float32")
+    cols = []
+    for off in (-1, 0, 1):
+        sh = np.zeros_like(X)
+        for t in range(T):
+            if 0 <= t + off < T:
+                sh[:, t] = X[:, t + off]
+        cols.append(sh)
+    im = np.concatenate(cols, axis=-1)
+    expect = im @ W
+    assert np.allclose(out, expect, atol=1e-4)
+
+
+def test_sequence_enumerate():
+    def build():
+        x = layers.data("x", shape=[B, T], dtype="int64", append_batch_size=False)
+        return [layers.sequence_enumerate(x, win_size=2, pad_value=0)]
+
+    ids = RNG.randint(1, 9, (B, T)).astype("int64")
+    (out,) = _run(build, {"x": ids})
+    assert out.shape == (B, T, 2)
+    assert np.all(out[:, :-1, 1] == ids[:, 1:])
+    assert np.all(out[:, -1, 1] == 0)
+
+
+def test_sequence_pad_maxlen_no_length():
+    """Regression: re-pad beyond T must use pad_value and report original
+    lengths when no Length input is given."""
+    def build():
+        x = layers.data("x", shape=[2, 3], append_batch_size=False)
+        pv = layers.fill_constant(shape=[1], dtype="float32", value=-1.0)
+        padded, length = layers.sequence_pad(x, pv, maxlen=5)
+        return [padded, length]
+
+    ones = np.ones((2, 3), "float32")
+    padded, length = _run(build, {"x": ones})
+    assert padded.shape == (2, 5)
+    assert np.allclose(padded[:, :3], 1.0)
+    assert np.allclose(padded[:, 3:], -1.0)
+    assert np.all(length == 3)
