@@ -44,6 +44,12 @@ from . import unique_name_compat as unique_name  # noqa: F401
 from .data_feeder import DataFeeder
 from . import io
 from .io import save_inference_model, load_inference_model
+from .reader import DataLoader, PyReader
+from .dataset import DatasetFactory
+from . import dataset
+from . import datasets
+from . import reader  # DataLoader module; also re-exports the decorators
+from .reader_decorator import batch
 
 __version__ = "0.1.0"
 

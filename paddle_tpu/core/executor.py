@@ -94,7 +94,6 @@ class Executor:
         from ..compiler import CompiledProgram
 
         scope = scope if scope is not None else global_scope()
-        feed = feed or {}
         fetch_list = fetch_list or []
         fetch_names = [_fetch_name(f) for f in fetch_list]
 
@@ -107,6 +106,16 @@ class Executor:
             data_axis = compiled._data_axis
         if program is None:
             program = default_main_program()
+
+        if not feed:
+            # program-driven input: a started non-iterable DataLoader
+            # attached to this program supplies the batch (the reference's
+            # py_reader `read` op path; raises core.EOFException at end)
+            for loader in program._attached_loaders:
+                if loader._started:
+                    feed = loader._next_feed()
+                    break
+        feed = feed or {}
 
         feed_arrays = {}
         block = program.global_block()

@@ -1,0 +1,206 @@
+"""fluid.dataset analog: file-backed datasets parsed by the native C++ store.
+
+Parity: python/paddle/fluid/dataset.py (DatasetFactory:819, InMemoryDataset,
+QueueDataset) over the C++ MultiSlot data feed
+(paddle/fluid/framework/data_feed.h:532, data_set.h:135).  Files are
+MultiSlot text: per line, for each declared slot, ``<n> <v1> ... <vn>``.
+Parsing/shuffling runs in C++ (paddle_tpu/native/csrc/multislot.cc); batches
+come back as dense padded arrays (ragged slots pad to the batch max — the
+LoD→mask design, SURVEY §5 long-context note).
+"""
+
+import ctypes
+
+import numpy as np
+
+from .framework import Variable, dtype_to_np
+
+__all__ = ["DatasetFactory", "InMemoryDataset", "QueueDataset",
+           "DatasetLoader"]
+
+
+class DatasetFactory:
+    def create_dataset(self, datafeed_class="QueueDataset"):
+        if datafeed_class == "InMemoryDataset":
+            return InMemoryDataset()
+        if datafeed_class == "QueueDataset":
+            return QueueDataset()
+        raise ValueError("unknown dataset class %r" % datafeed_class)
+
+
+class DatasetBase:
+    def __init__(self):
+        self._batch_size = 1
+        self._thread = 1
+        self._filelist = []
+        self._use_vars = []
+        self._pipe_command = "cat"
+        self._rank = 0
+        self._nranks = 1
+        self._store = None
+        self._hdfs_config = None
+
+    # -- reference API surface ----------------------------------------------
+    def set_batch_size(self, batch_size):
+        self._batch_size = batch_size
+
+    def set_thread(self, thread_num):
+        self._thread = thread_num
+
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+
+    def set_use_var(self, var_list):
+        for v in var_list:
+            if not isinstance(v, Variable):
+                raise TypeError("set_use_var expects Variables")
+        self._use_vars = list(var_list)
+
+    def set_pipe_command(self, pipe_command):
+        self._pipe_command = pipe_command  # accepted; parsing is native
+
+    def set_hdfs_config(self, fs_name, fs_ugi):
+        self._hdfs_config = (fs_name, fs_ugi)
+
+    def set_download_cmd(self, download_cmd):
+        pass
+
+    # -- native store --------------------------------------------------------
+    def _slot_types(self):
+        types = []
+        for v in self._use_vars:
+            dt = v.dtype or "float32"
+            types.append(0 if dt.startswith("int") else 1)
+        return types
+
+    def _ensure_store(self):
+        from .native import load
+
+        if self._store is None:
+            lib = load()
+            types = (ctypes.c_int * len(self._use_vars))(*self._slot_types())
+            self._store = lib.ms_create(len(self._use_vars), types)
+            self._lib = lib
+        return self._store
+
+    def _load_files(self, files):
+        store = self._ensure_store()
+        total = 0
+        for path in files:
+            n = self._lib.ms_load_file(store, path.encode())
+            if n < 0:
+                raise IOError("cannot read dataset file %r" % path)
+            total += n
+        return total
+
+    def _num_records(self):
+        if self._store is None:
+            return 0
+        return self._lib.ms_num_records(self._store)
+
+    def _batch(self, begin, end):
+        """Extract records [begin, end) as a feed dict of padded arrays."""
+        store = self._ensure_store()
+        lib = self._lib
+        n = end - begin
+        feed = {}
+        for slot, var in enumerate(self._use_vars):
+            lengths = (ctypes.c_int64 * n)()
+            total = lib.ms_batch_slot_len(store, begin, end, slot)
+            is_int = self._slot_types()[slot] == 0
+            buf = np.empty(int(total), dtype=np.int64 if is_int else np.float32)
+            lib.ms_batch_fill(
+                store, begin, end, slot,
+                buf.ctypes.data_as(ctypes.c_void_p), lengths)
+            lens = np.frombuffer(lengths, dtype=np.int64)
+            maxlen = int(lens.max()) if n else 0
+            if n and (lens == lens[0]).all():
+                arr = buf.reshape(n, int(lens[0]))
+            else:
+                arr = np.zeros((n, maxlen), dtype=buf.dtype)
+                off = 0
+                for i, ln in enumerate(lens):
+                    arr[i, : int(ln)] = buf[off:off + int(ln)]
+                    off += int(ln)
+            want = dtype_to_np(var.dtype or "float32")
+            if arr.dtype != want:
+                arr = arr.astype(want)
+            feed[var.name] = arr
+        return feed
+
+    def _iter_batches(self, drop_last=True):
+        n = self._num_records()
+        bs = self._batch_size
+        end = (n // bs) * bs if drop_last else n
+        for begin in range(0, end, bs):
+            yield self._batch(begin, min(begin + bs, n))
+
+    def desc(self):
+        return {
+            "batch_size": self._batch_size,
+            "thread": self._thread,
+            "slots": [v.name for v in self._use_vars],
+        }
+
+
+class InMemoryDataset(DatasetBase):
+    """Load-then-shuffle dataset (reference InMemoryDataset: load_into_memory
+    + local/global shuffle through the PS channel; here global shuffle
+    re-seeds deterministically per rank over the same files)."""
+
+    def __init__(self):
+        super().__init__()
+        self._loaded = False
+        self._seed = 0
+
+    def load_into_memory(self):
+        files = self._filelist[self._rank::self._nranks] \
+            if self._nranks > 1 else self._filelist
+        self._load_files(files)
+        self._loaded = True
+
+    def local_shuffle(self):
+        self._ensure_store()
+        self._lib.ms_shuffle(self._store, self._seed)
+        self._seed += 1
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        # all ranks shuffle with a shared seed; with per-rank file splits the
+        # union over ranks is a global permutation of the corpus
+        self._ensure_store()
+        self._lib.ms_shuffle(self._store, 0x9E3779B9 + self._seed)
+        self._seed += 1
+
+    def release_memory(self):
+        if self._store is not None:
+            self._lib.ms_clear(self._store)
+        self._loaded = False
+
+    def get_memory_data_size(self, fleet=None):
+        return int(self._num_records())
+
+    def get_shuffle_data_size(self, fleet=None):
+        return int(self._num_records())
+
+
+class QueueDataset(DatasetBase):
+    """Streaming dataset: files parsed lazily epoch by epoch."""
+
+    def _iter_batches(self, drop_last=True):
+        # parse (native) then stream; store cleared after the epoch
+        self._ensure_store()
+        self._lib.ms_clear(self._store)
+        self._load_files(self._filelist)
+        yield from super()._iter_batches(drop_last)
+        self._lib.ms_clear(self._store)
+
+
+class DatasetLoader:
+    """DataLoader.from_dataset: iterate a Dataset as feed dicts."""
+
+    def __init__(self, dataset, places=None, drop_last=True):
+        self._dataset = dataset
+        self._drop_last = drop_last
+
+    def __iter__(self):
+        return self._dataset._iter_batches(self._drop_last)

@@ -1,0 +1,13 @@
+"""Dataset corpora package (python/paddle/dataset analog).
+
+The reference downloads real corpora (mnist, uci_housing, imdb, wmt16…).
+This environment has zero network egress, so each module synthesizes a
+deterministic, learnable stand-in corpus with the same reader interface
+(nullary callables yielding samples) — the pipeline, batching, and model
+code paths are identical to the reference's.
+"""
+
+from . import mnist  # noqa: F401
+from . import uci_housing  # noqa: F401
+from . import imdb  # noqa: F401
+from . import wmt16  # noqa: F401

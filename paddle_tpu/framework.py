@@ -515,6 +515,9 @@ class Program:
         # executor hints
         self._is_test = False
         self._sharding_mesh = None
+        # non-iterable DataLoaders attached to this program (reader.py):
+        # exe.run(feed=None) pulls batches from the first started one
+        self._attached_loaders = []
 
     # -- version (invalidates executor caches) ------------------------------
     def _bump_version(self):

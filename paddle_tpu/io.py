@@ -16,6 +16,8 @@ from .core.executor import global_scope
 from .framework import Parameter, Program, Variable
 
 __all__ = [
+    "DataLoader",
+    "PyReader",
     "save_vars",
     "save_params",
     "save_persistables",
@@ -25,6 +27,14 @@ __all__ = [
     "save_inference_model",
     "load_inference_model",
 ]
+
+
+def __getattr__(name):  # lazy: io imports before reader in __init__
+    if name in ("DataLoader", "PyReader"):
+        from . import reader
+
+        return getattr(reader, name)
+    raise AttributeError(name)
 
 
 def _is_persistable(var):

@@ -1,0 +1,102 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference keeps its data hand-off and dataset parsing in C++
+(paddle/fluid/operators/reader/blocking_queue.h,
+paddle/fluid/framework/data_feed.cc); so do we.  Sources live in
+``csrc/`` and are compiled on first import with g++ into a cached shared
+library (no pybind11 in this image — plain C ABI + ctypes).
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_CSRC = os.path.join(_HERE, "csrc")
+_LIB_PATH = os.path.join(_HERE, "_libpaddle_tpu_native.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _sources():
+    return sorted(
+        os.path.join(_CSRC, f) for f in os.listdir(_CSRC) if f.endswith(".cc")
+    )
+
+
+def _needs_build():
+    if not os.path.exists(_LIB_PATH):
+        return True
+    so_mtime = os.path.getmtime(_LIB_PATH)
+    return any(os.path.getmtime(s) > so_mtime for s in _sources())
+
+
+def _build():
+    cmd = [
+        "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        *_sources(), "-o", _LIB_PATH + ".tmp",
+    ]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(_LIB_PATH + ".tmp", _LIB_PATH)
+
+
+def _declare(lib):
+    c = ctypes
+    lib.dq_create.restype = c.c_void_p
+    lib.dq_create.argtypes = [c.c_int]
+    lib.dq_destroy.argtypes = [c.c_void_p]
+    lib.dq_push.restype = c.c_int
+    lib.dq_push.argtypes = [c.c_void_p, c.c_char_p, c.c_int64, c.c_int]
+    lib.dq_pop.restype = c.c_int64
+    lib.dq_pop.argtypes = [c.c_void_p, c.POINTER(c.c_void_p), c.c_int]
+    lib.dq_free.argtypes = [c.c_void_p]
+    lib.dq_close.argtypes = [c.c_void_p]
+    lib.dq_kill.argtypes = [c.c_void_p]
+    lib.dq_reopen.argtypes = [c.c_void_p]
+    lib.dq_size.restype = c.c_int
+    lib.dq_size.argtypes = [c.c_void_p]
+    lib.dq_is_closed.restype = c.c_int
+    lib.dq_is_closed.argtypes = [c.c_void_p]
+
+    lib.ms_create.restype = c.c_void_p
+    lib.ms_create.argtypes = [c.c_int, c.POINTER(c.c_int)]
+    lib.ms_destroy.argtypes = [c.c_void_p]
+    lib.ms_load_file.restype = c.c_int64
+    lib.ms_load_file.argtypes = [c.c_void_p, c.c_char_p]
+    lib.ms_num_records.restype = c.c_int64
+    lib.ms_num_records.argtypes = [c.c_void_p]
+    lib.ms_shuffle.argtypes = [c.c_void_p, c.c_uint64]
+    lib.ms_clear.argtypes = [c.c_void_p]
+    lib.ms_batch_slot_len.restype = c.c_int64
+    lib.ms_batch_slot_len.argtypes = [c.c_void_p, c.c_int64, c.c_int64, c.c_int]
+    lib.ms_batch_fill.argtypes = [
+        c.c_void_p, c.c_int64, c.c_int64, c.c_int, c.c_void_p,
+        c.POINTER(c.c_int64),
+    ]
+
+
+def load():
+    """Compile (if stale) and load the native library. Thread-safe."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if _needs_build():
+            _build()
+        lib = ctypes.CDLL(_LIB_PATH)
+        _declare(lib)
+        _lib = lib
+    return _lib
+
+
+def available():
+    """True when the native library can be built/loaded on this machine."""
+    try:
+        load()
+        return True
+    except Exception:
+        return False

@@ -1,0 +1,158 @@
+// Native MultiSlot dataset store: load + parse slot-format text files,
+// in-memory shuffle, batch extraction.
+//
+// TPU-native analog of the reference's C++ data feed
+// (paddle/fluid/framework/data_feed.h:222 InMemoryDataFeed,
+// :532 MultiSlotDataFeed; paddle/fluid/framework/data_set.h:135 DatasetImpl
+// LoadIntoMemory/LocalShuffle).  Line format, per record:
+//   for each slot: "<n> <v_1> ... <v_n>"
+// with slot types declared up front (0 = int64 ids, 1 = float values).
+// Parsing and shuffling happen in C++ off the Python GIL; Python pulls
+// padded/concatenated batches through the C ABI below (ctypes).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <random>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Record {
+  // per slot: offset+count into the type-specific value pools
+  std::vector<int64_t> offset;
+  std::vector<int64_t> count;
+};
+
+struct Store {
+  std::vector<int> types;  // 0 = int64, 1 = float
+  std::vector<int64_t> ipool;
+  std::vector<float> fpool;
+  std::vector<Record> records;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ms_create(int nslots, const int* types) {
+  Store* s = new Store();
+  s->types.assign(types, types + nslots);
+  return s;
+}
+
+void ms_destroy(void* sp) { delete static_cast<Store*>(sp); }
+
+// Returns number of records parsed, or -1 on open failure / parse error.
+int64_t ms_load_file(void* sp, const char* path) {
+  Store* s = static_cast<Store*>(sp);
+  FILE* f = std::fopen(path, "r");
+  if (!f) return -1;
+  int64_t added = 0;
+  char* line = nullptr;
+  size_t cap = 0;
+  ssize_t n;
+  const int nslots = static_cast<int>(s->types.size());
+  while ((n = getline(&line, &cap, f)) != -1) {
+    if (n <= 1) continue;
+    char* p = line;
+    Record rec;
+    rec.offset.resize(nslots);
+    rec.count.resize(nslots);
+    bool ok = true;
+    for (int slot = 0; slot < nslots && ok; ++slot) {
+      char* end;
+      long cnt = std::strtol(p, &end, 10);
+      if (end == p || cnt < 0) {
+        ok = false;
+        break;
+      }
+      p = end;
+      rec.count[slot] = cnt;
+      if (s->types[slot] == 0) {
+        rec.offset[slot] = static_cast<int64_t>(s->ipool.size());
+        for (long i = 0; i < cnt; ++i) {
+          long long v = std::strtoll(p, &end, 10);
+          if (end == p) {
+            ok = false;
+            break;
+          }
+          p = end;
+          s->ipool.push_back(v);
+        }
+      } else {
+        rec.offset[slot] = static_cast<int64_t>(s->fpool.size());
+        for (long i = 0; i < cnt; ++i) {
+          float v = std::strtof(p, &end);
+          if (end == p) {
+            ok = false;
+            break;
+          }
+          p = end;
+          s->fpool.push_back(v);
+        }
+      }
+    }
+    if (ok) {
+      s->records.push_back(std::move(rec));
+      ++added;
+    }
+  }
+  free(line);
+  std::fclose(f);
+  return added;
+}
+
+int64_t ms_num_records(void* sp) {
+  return static_cast<int64_t>(static_cast<Store*>(sp)->records.size());
+}
+
+void ms_shuffle(void* sp, uint64_t seed) {
+  Store* s = static_cast<Store*>(sp);
+  std::mt19937_64 rng(seed);
+  std::shuffle(s->records.begin(), s->records.end(), rng);
+}
+
+void ms_clear(void* sp) {
+  Store* s = static_cast<Store*>(sp);
+  s->records.clear();
+  s->ipool.clear();
+  s->fpool.clear();
+}
+
+// Total number of values of `slot` across records [begin, end).
+int64_t ms_batch_slot_len(void* sp, int64_t begin, int64_t end, int slot) {
+  Store* s = static_cast<Store*>(sp);
+  int64_t total = 0;
+  for (int64_t r = begin; r < end && r < (int64_t)s->records.size(); ++r)
+    total += s->records[r].count[slot];
+  return total;
+}
+
+// Fill `values_out` (int64_t* or float* matching the slot type) with the
+// concatenated values of `slot` over records [begin, end), and
+// `lengths_out[i]` with each record's count (ragged batch lengths — the
+// LoD analog that the Python layer pads/masks for XLA static shapes).
+void ms_batch_fill(void* sp, int64_t begin, int64_t end, int slot,
+                   void* values_out, int64_t* lengths_out) {
+  Store* s = static_cast<Store*>(sp);
+  int64_t vi = 0;
+  for (int64_t r = begin; r < end && r < (int64_t)s->records.size(); ++r) {
+    const Record& rec = s->records[r];
+    int64_t cnt = rec.count[slot];
+    lengths_out[r - begin] = cnt;
+    if (s->types[slot] == 0) {
+      std::memcpy(static_cast<int64_t*>(values_out) + vi,
+                  s->ipool.data() + rec.offset[slot], cnt * sizeof(int64_t));
+    } else {
+      std::memcpy(static_cast<float*>(values_out) + vi,
+                  s->fpool.data() + rec.offset[slot], cnt * sizeof(float));
+    }
+    vi += cnt;
+  }
+}
+
+}  // extern "C"

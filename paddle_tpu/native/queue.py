@@ -1,0 +1,106 @@
+"""Python wrapper over the native blocking queue: batches of ndarrays
+cross the producer/consumer boundary as single contiguous byte buffers.
+
+Analog of the reference's LoDTensorBlockingQueue hand-off
+(operators/reader/lod_tensor_blocking_queue.h) with the tensor wire header
+playing the role of the LoDTensor serialization (framework/lod_tensor.h:208).
+"""
+
+import ctypes
+import struct
+
+import numpy as np
+
+from . import load
+
+__all__ = ["NativeBlockingQueue", "QueueClosed"]
+
+
+class QueueClosed(Exception):
+    pass
+
+
+def _pack(arrays):
+    parts = [struct.pack("<i", len(arrays))]
+    for a in arrays:
+        a = np.asarray(a)
+        if a.ndim and not a.flags["C_CONTIGUOUS"]:
+            a = np.ascontiguousarray(a)
+        dt = a.dtype.str.encode()  # e.g. b"<f4"
+        parts.append(struct.pack("<i", len(dt)))
+        parts.append(dt)
+        parts.append(struct.pack("<i", a.ndim))
+        parts.append(struct.pack("<%dq" % a.ndim, *a.shape))
+        parts.append(a.tobytes())
+    return b"".join(parts)
+
+
+def _unpack(buf):
+    off = 0
+    (n,) = struct.unpack_from("<i", buf, off)
+    off += 4
+    out = []
+    for _ in range(n):
+        (dtlen,) = struct.unpack_from("<i", buf, off)
+        off += 4
+        dt = np.dtype(buf[off:off + dtlen].decode())
+        off += dtlen
+        (ndim,) = struct.unpack_from("<i", buf, off)
+        off += 4
+        shape = struct.unpack_from("<%dq" % ndim, buf, off)
+        off += 8 * ndim
+        nvals = int(np.prod(shape)) if ndim else 1
+        arr = np.frombuffer(buf, dtype=dt, count=nvals, offset=off)
+        out.append(arr.reshape(shape))
+        off += nvals * dt.itemsize
+    return out
+
+
+class NativeBlockingQueue:
+    """Bounded blocking queue of ndarray batches backed by C++."""
+
+    def __init__(self, capacity=64):
+        self._lib = load()
+        self._q = self._lib.dq_create(int(capacity))
+
+    def push(self, arrays, timeout_ms=-1):
+        buf = _pack(arrays)
+        rc = self._lib.dq_push(self._q, buf, len(buf), timeout_ms)
+        if rc == -1:
+            raise QueueClosed()
+        return rc == 0
+
+    def pop(self, timeout_ms=-1):
+        out = ctypes.c_void_p()
+        n = self._lib.dq_pop(self._q, ctypes.byref(out), timeout_ms)
+        if n == -1:
+            raise QueueClosed()
+        if n == -2:
+            return None  # timeout
+        try:
+            buf = ctypes.string_at(out, n)
+        finally:
+            self._lib.dq_free(out)
+        return _unpack(buf)
+
+    def close(self):
+        self._lib.dq_close(self._q)
+
+    def kill(self):
+        self._lib.dq_kill(self._q)
+
+    def reopen(self):
+        self._lib.dq_reopen(self._q)
+
+    def size(self):
+        return self._lib.dq_size(self._q)
+
+    def is_closed(self):
+        return bool(self._lib.dq_is_closed(self._q))
+
+    def __del__(self):
+        try:
+            self._lib.dq_kill(self._q)
+            self._lib.dq_destroy(self._q)
+        except Exception:
+            pass

@@ -1,0 +1,316 @@
+"""DataLoader / PyReader: the host-side input pipeline.
+
+Parity: python/paddle/fluid/reader.py (DataLoader.from_generator:75,
+PyReader, GeneratorLoader) + operators/reader/buffered_reader.cc (async
+double buffering).  TPU-native shape: a producer thread feeds batches into
+the native C++ blocking queue (paddle_tpu/native/csrc/dataqueue.cc); the
+consumer side optionally stages the *next* batch onto the device with
+``jax.device_put`` while the current one is being consumed, so host→HBM
+copies overlap compute (the buffered_reader double-buffer analog).
+
+Non-iterable mode keeps the reference's program-driven contract: after
+``loader.start()``, ``exe.run(program)`` with no feed pulls the next batch
+from the queue and raises ``fluid.core.EOFException`` when the epoch ends.
+"""
+
+import threading
+
+import numpy as np
+
+from .framework import Variable, core, dtype_to_np
+from .reader_decorator import (  # noqa: F401  (paddle.reader.* decorators)
+    batch, buffered, cache, chain, compose, firstn, map_readers,
+    multiprocess_reader, shuffle, xmap_readers,
+)
+
+__all__ = ["DataLoader", "PyReader", "GeneratorLoader"]
+
+
+class EOFException(Exception):
+    """Raised by exe.run when a started (non-iterable) DataLoader drains."""
+
+
+core.EOFException = EOFException  # framework._CoreShim
+from . import core as _core_pkg  # noqa: E402  (fluid.core resolves here)
+
+_core_pkg.EOFException = EOFException
+
+
+def _to_numpy_batch(items, feed_vars):
+    """Coerce one batch (tuple/list of arrays) to the feed vars' dtypes."""
+    out = []
+    for i, x in enumerate(items):
+        arr = np.asarray(x)
+        if feed_vars and i < len(feed_vars):
+            v = feed_vars[i]
+            if v.dtype is not None:
+                want = dtype_to_np(v.dtype)
+                if arr.dtype != want:
+                    arr = arr.astype(want)
+        out.append(arr)
+    return out
+
+
+class GeneratorLoader:
+    def __init__(self, feed_list=None, capacity=64, use_double_buffer=True,
+                 iterable=True, return_list=False, drop_last=True):
+        if feed_list is None:
+            feed_list = []
+        self._feed_vars = [v for v in feed_list]
+        for v in self._feed_vars:
+            if not isinstance(v, Variable):
+                raise TypeError("feed_list must contain Variables")
+        self._names = [v.name for v in self._feed_vars]
+        self._capacity = capacity
+        self._use_double_buffer = use_double_buffer
+        self._iterable = iterable
+        self._return_list = return_list
+        self._drop_last = drop_last
+        self._batch_reader = None
+        self._places = None
+        self._queue = None
+        self._thread = None
+        self._started = False
+        self._producer_exc = None
+        self._iter = None  # persistent iterator for next()
+        if not iterable:
+            # program-driven mode: attach to the program that owns the feed
+            # vars so Executor.run(program, feed=None) can find us; a new
+            # loader over the same feed names replaces the old one
+            if not self._feed_vars:
+                raise ValueError("non-iterable DataLoader needs a feed_list")
+            program = self._feed_vars[0].block.program
+            program._attached_loaders = [
+                l for l in program._attached_loaders
+                if set(l._names) != set(self._names)
+            ] + [self]
+
+    # -- wiring --------------------------------------------------------------
+    def set_sample_generator(self, reader, batch_size, drop_last=True,
+                             places=None):
+        def batch_reader():
+            batch = []
+            for sample in reader():
+                if not isinstance(sample, (list, tuple)):
+                    sample = (sample,)
+                batch.append(sample)
+                if len(batch) == batch_size:
+                    yield [np.stack([np.asarray(s[i]) for s in batch])
+                           for i in range(len(batch[0]))]
+                    batch = []
+            if batch and not drop_last:
+                yield [np.stack([np.asarray(s[i]) for s in batch])
+                       for i in range(len(batch[0]))]
+
+        return self.set_batch_generator(batch_reader, places)
+
+    def set_sample_list_generator(self, reader, places=None):
+        def batch_reader():
+            for batch in reader():
+                yield [np.stack([np.asarray(s[i]) for s in batch])
+                       for i in range(len(batch[0]))]
+
+        return self.set_batch_generator(batch_reader, places)
+
+    def set_batch_generator(self, reader, places=None):
+        self._batch_reader = reader
+        self._places = places
+        return self
+
+    # -- producer ------------------------------------------------------------
+    def _producer(self, queue):
+        from .native.queue import QueueClosed
+
+        try:
+            for batch in self._batch_reader():
+                if not isinstance(batch, (list, tuple)):
+                    batch = (batch,)
+                if isinstance(batch, (list, tuple)) and len(batch) == 1 and \
+                        isinstance(batch[0], dict):
+                    batch = [batch[0][n] for n in self._names]
+                if isinstance(batch, dict):
+                    batch = [batch[n] for n in self._names]
+                try:
+                    queue.push(_to_numpy_batch(batch, self._feed_vars))
+                except QueueClosed:
+                    return
+        except BaseException as e:  # surface in the consumer, not stderr
+            self._producer_exc = e
+        finally:
+            queue.close()
+
+    def _start_thread(self):
+        from .native.queue import NativeBlockingQueue
+
+        if self._batch_reader is None:
+            raise RuntimeError(
+                "DataLoader has no data source — call set_sample_generator/"
+                "set_sample_list_generator/set_batch_generator first")
+        self._producer_exc = None
+        self._queue = NativeBlockingQueue(self._capacity)
+        self._thread = threading.Thread(
+            target=self._producer, args=(self._queue,), daemon=True)
+        self._thread.start()
+        self._started = True
+
+    def _stop(self, queue=None):
+        if queue is not None and queue is not self._queue:
+            # stale generator cleanup: kill only its own (abandoned) queue,
+            # never the currently active pipeline
+            queue.kill()
+            return
+        if self._queue is not None:
+            self._queue.kill()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._queue = None
+        self._thread = None
+        self._started = False
+
+    def _check_producer(self):
+        if self._producer_exc is not None:
+            exc, self._producer_exc = self._producer_exc, None
+            raise RuntimeError("DataLoader generator raised") from exc
+
+    # -- iterable mode -------------------------------------------------------
+    def __iter__(self):
+        from .native.queue import QueueClosed
+
+        if self._iterable is False:
+            raise RuntimeError("this DataLoader is non-iterable; use "
+                               "start()/reset() with exe.run()")
+        self._stop()
+        self._start_thread()
+        dev = self._device()
+        queue = self._queue
+
+        def gen():
+            pending = None  # device-staged batch (double buffer)
+            try:
+                while True:
+                    try:
+                        batch = queue.pop()
+                    except QueueClosed:
+                        batch = None
+                    if batch is None:
+                        self._check_producer()
+                    if self._use_double_buffer and dev is not None:
+                        staged = pending
+                        if batch is not None:
+                            import jax
+
+                            pending = [jax.device_put(a, dev) for a in batch]
+                        else:
+                            pending = None
+                        if staged is None:
+                            if pending is None:
+                                return
+                            continue  # prime the buffer
+                        yield self._emit(staged)
+                    else:
+                        if batch is None:
+                            return
+                        yield self._emit(batch)
+            finally:
+                self._stop(queue)
+
+        return gen()
+
+    def _device(self):
+        if not self._use_double_buffer:
+            return None
+        places = self._places
+        if places:
+            p = places[0] if isinstance(places, (list, tuple)) else places
+            try:
+                return p.jax_device()
+            except Exception:
+                return None
+        return None
+
+    def _emit(self, batch):
+        if self._return_list:
+            return list(batch)
+        return dict(zip(self._names, batch))
+
+    # -- program-driven (non-iterable) mode ----------------------------------
+    def start(self):
+        if self._iterable:
+            raise RuntimeError("start() is only for non-iterable loaders")
+        self._stop()
+        self._start_thread()
+
+    def reset(self):
+        self._stop()
+
+    def _next_feed(self):
+        """Called by Executor.run(feed=None). Raises EOFException at end."""
+        from .native.queue import QueueClosed
+
+        if not self._started:
+            raise RuntimeError("DataLoader.start() was not called")
+        try:
+            batch = self._queue.pop()
+        except QueueClosed:
+            batch = None
+        if batch is None:
+            self._check_producer()
+            raise EOFException("data loader drained")
+        return dict(zip(self._names, batch))
+
+    # reference-API convenience: successive batches from one live epoch
+    def next(self):
+        if self._iter is None:
+            self._iter = iter(self)
+        try:
+            return next(self._iter)
+        except StopIteration:
+            self._iter = None
+            raise
+
+
+class DataLoader:
+    @staticmethod
+    def from_generator(feed_list=None, capacity=64, use_double_buffer=True,
+                       iterable=True, return_list=False, use_multiprocess=False,
+                       drop_last=True):
+        # use_multiprocess accepted for API parity; the native queue +
+        # thread producer already overlaps host work with device steps
+        return GeneratorLoader(feed_list, capacity, use_double_buffer,
+                               iterable, return_list, drop_last)
+
+    @staticmethod
+    def from_dataset(dataset, places=None, drop_last=True):
+        from .dataset import DatasetLoader
+
+        return DatasetLoader(dataset, places, drop_last)
+
+
+class PyReader:
+    """Legacy fluid.io.PyReader facade over GeneratorLoader
+    (python/paddle/fluid/reader.py PyReader)."""
+
+    def __init__(self, feed_list=None, capacity=64, use_double_buffer=True,
+                 iterable=True, return_list=False):
+        self._loader = GeneratorLoader(feed_list, capacity, use_double_buffer,
+                                       iterable, return_list)
+
+    def decorate_sample_generator(self, sample_generator, batch_size,
+                                  drop_last=True, places=None):
+        self._loader.set_sample_generator(sample_generator, batch_size,
+                                          drop_last, places)
+
+    def decorate_sample_list_generator(self, reader, places=None):
+        self._loader.set_sample_list_generator(reader, places)
+
+    def decorate_batch_generator(self, reader, places=None):
+        self._loader.set_batch_generator(reader, places)
+
+    def start(self):
+        self._loader.start()
+
+    def reset(self):
+        self._loader.reset()
+
+    def __iter__(self):
+        return iter(self._loader)

@@ -1,0 +1,128 @@
+"""Dataset-driven training loop (the C++ trainer/device-worker path).
+
+Parity: paddle/fluid/framework/trainer.h (MultiTrainer),
+hogwild_worker.cc:163 (TrainFiles: ``while reader->Next(): run ops``) and
+Executor::RunFromDataset (executor.cc:182), entered from Python via
+``Executor.train_from_dataset`` (executor.py:1098).
+
+TPU-native shape: the reference runs N CPU worker threads each interpreting
+the op list over its own data feed.  On TPU there is one compiled program
+and one device stream, so the N "device workers" become N *feed* workers
+that parse/batch in parallel (native C++ store + blocking queue) while a
+single dispatcher drives the compiled XLA step — same epoch/metric
+semantics, hardware-appropriate execution.
+"""
+
+import threading
+import time
+
+import numpy as np
+
+__all__ = ["train_from_dataset", "infer_from_dataset", "TrainerDesc",
+           "DeviceWorker", "Hogwild", "MultiTrainer"]
+
+
+class TrainerDesc:
+    """Facade mirroring trainer_desc.py (proto emission is replaced by a
+    plain config object — there is no C++ proto consumer here)."""
+
+    def __init__(self):
+        self._worker = "HogwildWorker"
+        self._thread_num = 1
+        self._fetch_vars = []
+        self._fetch_info = []
+        self._print_period = 100
+
+    def set_thread(self, n):
+        self._thread_num = n
+
+    def set_fetch_var_and_info(self, fetch_vars, fetch_info, print_period):
+        self._fetch_vars = fetch_vars
+        self._fetch_info = fetch_info
+        self._print_period = print_period
+
+
+class DeviceWorker:
+    pass
+
+
+class Hogwild(DeviceWorker):
+    pass
+
+
+class MultiTrainer:
+    pass
+
+
+def _run_loop(exe, program, dataset, scope, thread, fetch_list, fetch_info,
+              print_period, train):
+    from .core.executor import global_scope
+    from .native.queue import NativeBlockingQueue, QueueClosed
+
+    if dataset is None:
+        raise ValueError("dataset must be provided")
+    scope = scope or global_scope()
+    fetch_list = fetch_list or []
+    fetch_info = fetch_info or [getattr(v, "name", str(v)) for v in fetch_list]
+    nthread = max(int(thread) or dataset._thread or 1, 1)
+
+    # one feed producer decouples native parse/pad from the device step; the
+    # reference's N device workers have no analog on a single-stream TPU
+    # (`thread` still sizes the prefetch window)
+    queue = NativeBlockingQueue(capacity=max(4 * nthread, 8))
+    names = [v.name for v in dataset._use_vars]
+
+    def feed_worker():
+        try:
+            for feed in dataset._iter_batches(drop_last=train):
+                try:
+                    queue.push([feed[n] for n in names])
+                except QueueClosed:
+                    return
+        finally:
+            queue.close()
+
+    workers = [threading.Thread(target=feed_worker, daemon=True)]
+    for w in workers:
+        w.start()
+
+    step = 0
+    t0 = time.time()
+    results = []
+    try:
+        while True:
+            try:
+                arrs = queue.pop()
+            except QueueClosed:
+                break
+            if arrs is None:
+                break
+            feed = dict(zip(names, arrs))
+            out = exe.run(program, feed=feed, fetch_list=fetch_list,
+                          scope=scope)
+            step += 1
+            if fetch_list and print_period and step % print_period == 0:
+                vals = ", ".join(
+                    "%s=%s" % (info, np.asarray(v).reshape(-1)[:1])
+                    for info, v in zip(fetch_info, out))
+                print("[trainer] step %d (%.1f steps/s): %s"
+                      % (step, step / max(time.time() - t0, 1e-9), vals))
+            if fetch_list:
+                results = out
+    finally:
+        queue.kill()
+        for w in workers:
+            w.join(timeout=5)
+    return results
+
+
+def train_from_dataset(exe, program, dataset, scope, thread, fetch_list,
+                       fetch_info, print_period):
+    return _run_loop(exe, program, dataset, scope, thread, fetch_list,
+                     fetch_info, print_period, train=True)
+
+
+def infer_from_dataset(exe, program, dataset, scope, thread, fetch_list,
+                       fetch_info, print_period):
+    return _run_loop(exe, program, dataset, scope, thread, fetch_list,
+                     fetch_info, print_period, train=False)

@@ -1,0 +1,339 @@
+"""Data pipeline tests: native blocking queue, DataLoader (iterable and
+program-driven), reader decorators, Dataset/trainer path, corpora.
+
+Mirrors the reference's reader tests (unittests/test_generator_dataloader.py,
+test_py_reader_*, test_dataset.py, reader decorator tests)."""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.native.queue import NativeBlockingQueue, QueueClosed
+from paddle_tpu import reader as decorators
+
+
+# ---------------------------------------------------------------------------
+# native queue
+# ---------------------------------------------------------------------------
+
+
+def test_native_queue_roundtrip():
+    q = NativeBlockingQueue(4)
+    a = np.arange(12, dtype="float32").reshape(3, 4)
+    b = np.array([[1, 2]], dtype="int64")
+    c = np.float32(3.5).reshape(())  # 0-d
+    q.push([a, b, c])
+    out = q.pop()
+    np.testing.assert_array_equal(out[0], a)
+    np.testing.assert_array_equal(out[1], b)
+    assert out[2].shape == () and out[2] == np.float32(3.5)
+    assert out[0].dtype == np.float32 and out[1].dtype == np.int64
+
+
+def test_native_queue_close_drains_then_raises():
+    q = NativeBlockingQueue(4)
+    q.push([np.zeros(2)])
+    q.close()
+    assert q.pop() is not None
+    with pytest.raises(QueueClosed):
+        q.pop()
+    with pytest.raises(QueueClosed):
+        q.push([np.zeros(2)])
+
+
+def test_native_queue_blocking_and_threads():
+    q = NativeBlockingQueue(2)
+    n = 200
+
+    def producer():
+        for i in range(n):
+            q.push([np.full((4,), i, dtype="int32")])
+        q.close()
+
+    t = threading.Thread(target=producer)
+    t.start()
+    got = []
+    while True:
+        try:
+            item = q.pop()
+        except QueueClosed:
+            break
+        got.append(int(item[0][0]))
+    t.join()
+    assert got == list(range(n))
+
+
+def test_native_queue_kill_unblocks():
+    q = NativeBlockingQueue(1)
+    errs = []
+
+    def blocked_pop():
+        try:
+            q.pop()
+        except QueueClosed:
+            errs.append("closed")
+
+    t = threading.Thread(target=blocked_pop)
+    t.start()
+    q.kill()
+    t.join(timeout=5)
+    assert errs == ["closed"]
+
+
+# ---------------------------------------------------------------------------
+# DataLoader
+# ---------------------------------------------------------------------------
+
+
+def _mlp_program(d=8, k=3):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[d])
+        y = fluid.layers.data("y", shape=[1], dtype="int64")
+        logits = fluid.layers.fc(x, k)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss
+
+
+def _batches(n_batches=6, bs=16, d=8, k=3, seed=0):
+    rng = np.random.RandomState(seed)
+    for _ in range(n_batches):
+        yield [rng.randn(bs, d).astype("float32"),
+               rng.randint(0, k, (bs, 1)).astype("int64")]
+
+
+def test_dataloader_iterable_trains():
+    main, startup, loss = _mlp_program()
+    x = main.global_block().var("x")
+    y = main.global_block().var("y")
+    loader = fluid.io.DataLoader.from_generator(
+        feed_list=[x, y], capacity=8, use_double_buffer=False)
+    loader.set_batch_generator(lambda: _batches(12))
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        seen = 0
+        for feed in loader:
+            out, = exe.run(main, feed=feed, fetch_list=[loss])
+            seen += 1
+        assert seen == 12
+
+
+def test_dataloader_sample_generator_batching():
+    def samples():
+        for i in range(25):
+            yield np.full((4,), i, "float32"), np.int64(i % 3)
+
+    x = None
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        y = fluid.layers.data("y", shape=[1], dtype="int64")
+    loader = fluid.io.DataLoader.from_generator(
+        feed_list=[x, y], capacity=4, use_double_buffer=False)
+    loader.set_sample_generator(samples, batch_size=10, drop_last=True)
+    batches = list(loader)
+    assert len(batches) == 2
+    assert batches[0]["x"].shape == (10, 4)
+    assert batches[0]["y"].dtype == np.int64
+
+
+def test_dataloader_non_iterable_eof():
+    main, startup, loss = _mlp_program()
+    x = main.global_block().var("x")
+    y = main.global_block().var("y")
+    with fluid.program_guard(main, startup):
+        loader = fluid.io.DataLoader.from_generator(
+            feed_list=[x, y], capacity=4, iterable=False)
+    loader.set_batch_generator(lambda: _batches(5))
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _epoch in range(2):
+            loader.start()
+            steps = 0
+            try:
+                while True:
+                    exe.run(main, fetch_list=[loss])
+                    steps += 1
+            except fluid.core.EOFException:
+                loader.reset()
+            assert steps == 5
+
+
+# ---------------------------------------------------------------------------
+# decorators
+# ---------------------------------------------------------------------------
+
+
+def test_reader_decorators():
+    r = lambda: iter(range(10))  # noqa: E731
+    assert list(decorators.firstn(r, 3)()) == [0, 1, 2]
+    assert sorted(decorators.shuffle(r, 5)()) == list(range(10))
+    assert list(decorators.map_readers(lambda a, b: a + b, r, r)()) == \
+        [2 * i for i in range(10)]
+    assert list(decorators.chain(r, r)()) == list(range(10)) * 2
+    assert list(decorators.cache(r)()) == list(range(10))
+    assert list(decorators.buffered(r, 2)()) == list(range(10))
+    got = list(decorators.compose(r, r)())
+    assert got[0] == (0, 0) and len(got) == 10
+    bs = list(decorators.batch(r, 4)())
+    assert bs == [[0, 1, 2, 3], [4, 5, 6, 7], [8, 9]]
+    bs = list(decorators.batch(r, 4, drop_last=True)())
+    assert bs == [[0, 1, 2, 3], [4, 5, 6, 7]]
+    out = list(decorators.xmap_readers(lambda x: x * 2, r, 3, 4, order=True)())
+    assert out == [2 * i for i in range(10)]
+    out = sorted(decorators.xmap_readers(lambda x: x * 2, r, 3, 4)())
+    assert out == [2 * i for i in range(10)]
+
+
+# ---------------------------------------------------------------------------
+# Dataset (native MultiSlot store) + trainer path
+# ---------------------------------------------------------------------------
+
+
+def _write_multislot(tmp_path, n=64, seed=0):
+    """Records: slot0 = 4 float features, slot1 = 1 int label."""
+    rng = np.random.RandomState(seed)
+    w = np.array([0.5, -1.0, 2.0, 0.25], "float32")
+    path = os.path.join(tmp_path, "part-0.txt")
+    with open(path, "w") as f:
+        for _ in range(n):
+            x = rng.randn(4).astype("float32")
+            yv = int(x @ w > 0)
+            f.write("4 %s 1 %d\n" % (" ".join("%.6f" % v for v in x), yv))
+    return path
+
+
+def test_inmemory_dataset_and_train_from_dataset(tmp_path):
+    path = _write_multislot(str(tmp_path), n=64)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        y = fluid.layers.data("y", shape=[1], dtype="int64")
+        logits = fluid.layers.fc(x, 2)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(16)
+    ds.set_thread(2)
+    ds.set_filelist([path])
+    ds.set_use_var([x, y])
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 64
+    ds.local_shuffle()
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        out = exe.train_from_dataset(
+            main, ds, thread=2, fetch_list=[loss], fetch_info=["loss"],
+            print_period=100)
+        assert out and np.isfinite(float(out[0][0]))
+
+
+def test_dataset_loader_batches(tmp_path):
+    path = _write_multislot(str(tmp_path), n=32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        y = fluid.layers.data("y", shape=[1], dtype="int64")
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(8)
+    ds.set_filelist([path])
+    ds.set_use_var([x, y])
+    ds.load_into_memory()
+    batches = list(fluid.io.DataLoader.from_dataset(ds))
+    assert len(batches) == 4
+    assert batches[0]["x"].shape == (8, 4)
+    assert batches[0]["x"].dtype == np.float32
+    assert batches[0]["y"].dtype == np.int64
+
+
+# ---------------------------------------------------------------------------
+# corpora
+# ---------------------------------------------------------------------------
+
+
+def test_corpora_smoke():
+    from paddle_tpu import datasets
+
+    img, lbl = next(datasets.mnist.train()())
+    assert img.shape == (784,) and 0 <= int(lbl) < 10
+    x, y = next(datasets.uci_housing.train()())
+    assert x.shape == (13,) and y.shape == (1,)
+    ids, sent = next(datasets.imdb.train()())
+    assert len(ids) >= 1 and int(sent) in (0, 1)
+    s, t, tn = next(datasets.wmt16.train(100, 100)())
+    assert len(t) == len(tn) and t[0] == 0 and tn[-1] == 1
+
+
+def test_mnist_learnable_with_dataloader():
+    """End-to-end: synthetic-MNIST via DataLoader trains to high accuracy."""
+    from paddle_tpu import datasets
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[784])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(img, 64, act="relu")
+        logits = fluid.layers.fc(h, 10)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        acc = fluid.layers.accuracy(fluid.layers.softmax(logits), label)
+        fluid.optimizer.Adam(1e-3).minimize(loss)
+
+    loader = fluid.io.DataLoader.from_generator(
+        feed_list=[img, label], capacity=8, use_double_buffer=False)
+    loader.set_sample_generator(
+        decorators.firstn(datasets.mnist.train(), 2048), batch_size=128)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        last_acc = 0.0
+        for _epoch in range(3):
+            for feed in loader:
+                _, a = exe.run(main, feed=feed, fetch_list=[loss, acc])
+                last_acc = float(a[0])
+        assert last_acc > 0.9, last_acc
+
+
+def test_dataloader_generator_exception_propagates():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+    loader = fluid.io.DataLoader.from_generator(
+        feed_list=[x], capacity=2, use_double_buffer=False)
+
+    def bad_batches():
+        yield [np.zeros((2, 4), "float32")]
+        raise ValueError("corrupt shard")
+
+    loader.set_batch_generator(bad_batches)
+    it = iter(loader)
+    next(it)
+    with pytest.raises(RuntimeError, match="generator raised"):
+        for _ in it:
+            pass
+
+
+def test_dataloader_next_advances():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[1])
+    loader = fluid.io.DataLoader.from_generator(
+        feed_list=[x], capacity=2, use_double_buffer=False)
+    loader.set_batch_generator(
+        lambda: iter([[np.full((1, 1), i, "float32")] for i in range(3)]))
+    vals = [float(loader.next()["x"][0, 0]) for _ in range(3)]
+    assert vals == [0.0, 1.0, 2.0]
+    with pytest.raises(StopIteration):
+        loader.next()
