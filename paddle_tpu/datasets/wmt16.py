@@ -26,13 +26,14 @@ def _reader(n, seed, src_vocab_size, trg_vocab_size, min_len=4, max_len=16):
     return reader
 
 
-def train(src_dict_size, trg_dict_size, src_lang="en"):
-    return _reader(4096, 11, src_dict_size, trg_dict_size)
+def train(src_dict_size, trg_dict_size, src_lang="en", min_len=4, max_len=16):
+    return _reader(4096, 11, src_dict_size, trg_dict_size, min_len, max_len)
 
 
-def test(src_dict_size, trg_dict_size, src_lang="en"):
-    return _reader(512, 12, src_dict_size, trg_dict_size)
+def test(src_dict_size, trg_dict_size, src_lang="en", min_len=4, max_len=16):
+    return _reader(512, 12, src_dict_size, trg_dict_size, min_len, max_len)
 
 
-def validation(src_dict_size, trg_dict_size, src_lang="en"):
-    return _reader(512, 13, src_dict_size, trg_dict_size)
+def validation(src_dict_size, trg_dict_size, src_lang="en", min_len=4,
+               max_len=16):
+    return _reader(512, 13, src_dict_size, trg_dict_size, min_len, max_len)

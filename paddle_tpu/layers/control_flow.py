@@ -417,8 +417,12 @@ class StaticRNN:
             cur = program.current_block_idx
             program.current_block_idx = self._parent.idx
             try:
+                # reference API passes shape WITH the batch dim as -1
+                # (layers/control_flow.py StaticRNN.memory); accept both
+                full = list(shape) if shape and shape[0] == -1 \
+                    else [-1] + list(shape)
                 init = ltensor.fill_constant_batch_size_like(
-                    input=batch_ref, shape=[-1] + list(shape),
+                    input=batch_ref, shape=full,
                     value=init_value, dtype=dtype,
                     input_dim_idx=ref_batch_dim_idx, output_dim_idx=0,
                 )

@@ -1,0 +1,151 @@
+"""RNN / decoding layers: beam search, GRU/LSTM units.
+
+Parity: python/paddle/fluid/layers/rnn.py + layers/nn.py beam_search
+(wrapping operators/beam_search_op.cc) and the dynamic/static RNN units.
+"""
+
+from ..layer_helper import LayerHelper
+
+__all__ = ["beam_search", "beam_search_decode", "gru_unit", "lstm_unit",
+           "dynamic_gru", "dynamic_lstm"]
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, is_accumulated=True, name=None,
+                return_parent_idx=True):
+    """One beam expansion step on dense [batch, beam] state (see
+    ops/beam_search.py for the LoD→dense mapping)."""
+    helper = LayerHelper("beam_search", name=name)
+    selected_ids = helper.create_variable_for_type_inference(dtype=pre_ids.dtype)
+    selected_scores = helper.create_variable_for_type_inference(
+        dtype=scores.dtype)
+    parent_idx = helper.create_variable_for_type_inference(dtype=pre_ids.dtype)
+    inputs = {"pre_ids": [pre_ids], "pre_scores": [pre_scores],
+              "scores": [scores]}
+    if ids is not None:
+        inputs["ids"] = [ids]
+    helper.append_op(
+        type="beam_search",
+        inputs=inputs,
+        outputs={"selected_ids": [selected_ids],
+                 "selected_scores": [selected_scores],
+                 "parent_idx": [parent_idx]},
+        attrs={"beam_size": beam_size, "end_id": end_id, "level": level,
+               "is_accumulated": is_accumulated},
+    )
+    if return_parent_idx:
+        return selected_ids, selected_scores, parent_idx
+    return selected_ids, selected_scores
+
+
+def beam_search_decode(ids, parent_idx, scores=None, beam_size=4, end_id=1,
+                       name=None):
+    """Backtrack tensor arrays of (ids, parents) into sequences."""
+    helper = LayerHelper("beam_search_decode", name=name)
+    sentence_ids = helper.create_variable_for_type_inference(dtype="int64")
+    sentence_scores = helper.create_variable_for_type_inference(dtype="float32")
+    inputs = {"Ids": [ids], "ParentIdx": [parent_idx]}
+    if scores is not None:
+        inputs["Scores"] = [scores]
+    helper.append_op(
+        type="beam_search_decode",
+        inputs=inputs,
+        outputs={"SentenceIds": [sentence_ids],
+                 "SentenceScores": [sentence_scores]},
+        attrs={"beam_size": beam_size, "end_id": end_id},
+    )
+    return sentence_ids, sentence_scores
+
+
+def _act(op_type, x):
+    helper = LayerHelper(op_type)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type=op_type, inputs={"X": [x]}, outputs={"Out": [out]})
+    return out
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid", name=None):
+    """GRU cell step (reference operators/gru_unit_op.cc): input is the
+    projected step input [B, 3*D], hidden [B, D]."""
+    from . import nn
+
+    D = size // 3
+    gates_w = nn.fc(hidden, 2 * D, param_attr=param_attr,
+                    bias_attr=bias_attr, name=(name or "gru") + "_gates")
+    xu, xr, xc = nn.split(input, 3, dim=-1)
+    hu, hr = nn.split(gates_w, 2, dim=-1)
+    u = _act(gate_activation, xu + hu)
+    r = _act(gate_activation, xr + hr)
+    cand_h = nn.fc(hidden * r, D, param_attr=param_attr,
+                   bias_attr=False, name=(name or "gru") + "_cand")
+    c = _act(activation, xc + cand_h)
+    new_hidden = u * hidden + (1.0 - u) * c
+    return new_hidden, new_hidden, c
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """LSTM cell step (reference layers/nn.py lstm_unit)."""
+    from . import nn, tensor
+
+    D = hidden_t_prev.shape[-1]
+    concat_in = tensor.concat([x_t, hidden_t_prev], axis=-1)
+    gates = nn.fc(concat_in, 4 * D, param_attr=param_attr,
+                  bias_attr=bias_attr, name=(name or "lstm") + "_gates")
+    i, f, c, o = nn.split(gates, 4, dim=-1)
+    i = _act("sigmoid", i)
+    f = _act("sigmoid", f + forget_bias)
+    o = _act("sigmoid", o)
+    c = _act("tanh", c)
+    new_cell = f * cell_t_prev + i * c
+    new_hidden = o * _act("tanh", new_cell)
+    return new_hidden, new_cell
+
+
+def dynamic_gru(input, size, seq_len=None, h_0=None, reverse=False,
+                param_attr=None, bias_attr=None, name=None):
+    """GRU over the time axis via StaticRNN/lax.scan (reference
+    operators/gru_op.cc; LoD ragged input becomes padded + seq_len mask)."""
+    from .control_flow import StaticRNN
+    from . import nn, tensor
+
+    name = name or "dynamic_gru"
+    proj = nn.fc(input, 3 * size, num_flatten_dims=2, param_attr=param_attr,
+                 bias_attr=bias_attr, name=name + "_proj")
+    proj_t = nn.transpose(proj, [1, 0, 2])  # [T, B, 3D]
+    rnn = StaticRNN()
+    with rnn.step():
+        x_t = rnn.step_input(proj_t)
+        h_prev = rnn.memory(init=h_0, shape=(-1, size), batch_ref=input,
+                            init_value=0.0, ref_batch_dim_idx=0)
+        h, _, _ = gru_unit(x_t, h_prev, 3 * size, name=name)
+        rnn.update_memory(h_prev, h)
+        rnn.step_output(h)
+    out = rnn()  # [T, B, D]
+    return nn.transpose(out, [1, 0, 2])
+
+
+def dynamic_lstm(input, size, seq_len=None, h_0=None, c_0=None,
+                 reverse=False, param_attr=None, bias_attr=None, name=None):
+    """LSTM over the time axis via StaticRNN/lax.scan (reference
+    operators/lstm_op.cc)."""
+    from .control_flow import StaticRNN
+    from . import nn
+
+    name = name or "dynamic_lstm"
+    D = size // 4
+    x_t_all = nn.transpose(input, [1, 0, 2])  # [T, B, F]
+    rnn = StaticRNN()
+    with rnn.step():
+        x_t = rnn.step_input(x_t_all)
+        h_prev = rnn.memory(init=h_0, shape=(-1, D), batch_ref=input,
+                            init_value=0.0, ref_batch_dim_idx=0)
+        c_prev = rnn.memory(init=c_0, shape=(-1, D), batch_ref=input,
+                            init_value=0.0, ref_batch_dim_idx=0)
+        h, c = lstm_unit(x_t, h_prev, c_prev, name=name)
+        rnn.update_memory(h_prev, h)
+        rnn.update_memory(c_prev, c)
+        rnn.step_output(h)
+    out = rnn()
+    return nn.transpose(out, [1, 0, 2])

@@ -3,3 +3,4 @@
 from . import mnist  # noqa: F401
 from . import resnet  # noqa: F401
 from . import bert  # noqa: F401
+from . import transformer  # noqa: F401

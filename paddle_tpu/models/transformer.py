@@ -1,0 +1,289 @@
+"""Transformer NMT seq2seq (BASELINE config 4 — the variable-length path).
+
+Mirrors the reference's fluid transformer example (the model family behind
+dist_transformer.py in its distributed tests): encoder-decoder with
+multi-head attention, sinusoidal positions, label-smoothed CE, and
+beam-search decode.  Variable-length LoD batching becomes padded dense
+batches + masks (SURVEY §5); decode builds a statically-unrolled program
+(each step's ops are appended at build time — XLA sees straight-line code,
+the TPU-idiomatic equivalent of the reference's while_op + beam_search loop).
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.param_attr import ParamAttr
+
+BOS, EOS = 0, 1
+
+
+class TransformerConfig:
+    def __init__(self, src_vocab=1000, trg_vocab=1000, d_model=64, heads=4,
+                 enc_layers=2, dec_layers=2, ffn=128, max_len=64,
+                 dropout=0.1, label_smooth=0.1):
+        self.src_vocab = src_vocab
+        self.trg_vocab = trg_vocab
+        self.d_model = d_model
+        self.heads = heads
+        self.enc_layers = enc_layers
+        self.dec_layers = dec_layers
+        self.ffn = ffn
+        self.max_len = max_len
+        self.dropout = dropout
+        self.label_smooth = label_smooth
+
+
+def _pos_encoding(max_len, d_model):
+    pos = np.arange(max_len)[:, None].astype("float32")
+    i = np.arange(d_model)[None, :].astype("float32")
+    angle = pos / np.power(10000.0, (2 * (i // 2)) / d_model)
+    enc = np.zeros((max_len, d_model), "float32")
+    enc[:, 0::2] = np.sin(angle[:, 0::2])
+    enc[:, 1::2] = np.cos(angle[:, 1::2])
+    return enc
+
+
+def _attention(q_in, kv_in, cfg, prefix, mask=None, is_test=False):
+    """Multi-head attention; q_in [B, Tq, D], kv_in [B, Tk, D],
+    mask broadcastable to [B, heads, Tq, Tk] additive."""
+    L = fluid.layers
+    D, H = cfg.d_model, cfg.heads
+    dh = D // H
+
+    def proj(x, nm):
+        return L.fc(x, D, num_flatten_dims=2,
+                    param_attr=ParamAttr(name=prefix + nm + "_w"),
+                    bias_attr=ParamAttr(name=prefix + nm + "_b"))
+
+    def split_heads(t, T):
+        t = L.reshape(t, [-1, T, H, dh])
+        return L.transpose(t, [0, 2, 1, 3])
+
+    Tq = q_in.shape[1]
+    Tk = kv_in.shape[1]
+    q = split_heads(proj(q_in, "_q"), Tq)
+    k = split_heads(proj(kv_in, "_k"), Tk)
+    v = split_heads(proj(kv_in, "_v"), Tk)
+    scores = L.matmul(q, k, transpose_y=True, alpha=dh ** -0.5)
+    if mask is not None:
+        scores = L.elementwise_add(scores, mask)
+    attn = L.softmax(scores)
+    if cfg.dropout and not is_test:
+        attn = L.dropout(attn, cfg.dropout, is_test=is_test)
+    out = L.matmul(attn, v)  # [B, H, Tq, dh]
+    out = L.transpose(out, [0, 2, 1, 3])
+    out = L.reshape(out, [-1, Tq, D])
+    return L.fc(out, D, num_flatten_dims=2,
+                param_attr=ParamAttr(name=prefix + "_o_w"),
+                bias_attr=ParamAttr(name=prefix + "_o_b"))
+
+
+def _ffn(x, cfg, prefix, is_test=False):
+    L = fluid.layers
+    h = L.fc(x, cfg.ffn, num_flatten_dims=2, act="relu",
+             param_attr=ParamAttr(name=prefix + "_fc1_w"),
+             bias_attr=ParamAttr(name=prefix + "_fc1_b"))
+    if cfg.dropout and not is_test:
+        h = L.dropout(h, cfg.dropout, is_test=is_test)
+    return L.fc(h, cfg.d_model, num_flatten_dims=2,
+                param_attr=ParamAttr(name=prefix + "_fc2_w"),
+                bias_attr=ParamAttr(name=prefix + "_fc2_b"))
+
+
+def _ln(x, prefix):
+    return fluid.layers.layer_norm(
+        x, begin_norm_axis=2,
+        param_attr=ParamAttr(name=prefix + "_ln_s"),
+        bias_attr=ParamAttr(name=prefix + "_ln_b"))
+
+
+def _embed(ids, vocab, cfg, name, seq_len):
+    L = fluid.layers
+    # explicit trailing 1: fluid's lookup_table squeezes [..., 1] ids, which
+    # would collapse a length-1 decode prefix ([B,1] -> [B,D])
+    ids3 = L.reshape(ids, [-1, seq_len, 1])
+    emb = L.embedding(ids3, size=[vocab, cfg.d_model],
+                      param_attr=ParamAttr(name=name))
+    emb = L.scale(emb, scale=cfg.d_model ** 0.5)
+    pos = fluid.layers.tensor.assign(
+        _pos_encoding(cfg.max_len, cfg.d_model)[:seq_len])
+    return L.elementwise_add(emb, pos)
+
+
+def encoder(src_ids, src_mask, cfg, seq_len, is_test=False):
+    """src_ids [B, S] int64; src_mask [B, 1, 1, S] additive (-1e9 on pad)."""
+    x = _embed(src_ids, cfg.src_vocab, cfg, "src_emb", seq_len)
+    for i in range(cfg.enc_layers):
+        p = "enc%d" % i
+        x = _ln(x + _attention(x, x, cfg, p + "_self", src_mask,
+                               is_test), p + "_att")
+        x = _ln(x + _ffn(x, cfg, p, is_test), p + "_ffn")
+    return x
+
+
+def decoder(trg_emb, enc_out, cfg, self_mask, cross_mask, is_test=False):
+    x = trg_emb
+    for i in range(cfg.dec_layers):
+        p = "dec%d" % i
+        x = _ln(x + _attention(x, x, cfg, p + "_self", self_mask,
+                               is_test), p + "_att")
+        x = _ln(x + _attention(x, enc_out, cfg, p + "_cross", cross_mask,
+                               is_test), p + "_cross")
+        x = _ln(x + _ffn(x, cfg, p, is_test), p + "_ffn")
+    return x
+
+
+def _logits(dec_out, cfg):
+    return fluid.layers.fc(
+        dec_out, cfg.trg_vocab, num_flatten_dims=2,
+        param_attr=ParamAttr(name="out_proj_w"),
+        bias_attr=ParamAttr(name="out_proj_b"))
+
+
+def _causal_mask(T):
+    m = np.triu(np.full((T, T), -1e9, "float32"), k=1)
+    return fluid.layers.tensor.assign(m.reshape(1, 1, T, T))
+
+
+def _pad_mask(ids, pad_id=EOS):
+    """[B, T] ids -> [B, 1, 1, T] additive mask; pad positions get -1e9.
+    By convention padded source positions hold EOS."""
+    L = fluid.layers
+    is_pad = L.cast(L.equal(ids, L.fill_constant([1], "int64", pad_id)),
+                    "float32")
+    m = L.scale(is_pad, scale=-1e9)
+    return L.reshape(m, [-1, 1, 1, ids.shape[1]])
+
+
+def build_train(cfg, src_len, trg_len, lr=1e-3, warmup=400):
+    """Training graph over padded batches.  Returns (feeds, avg_loss)."""
+    L = fluid.layers
+    src = L.data("src_ids", shape=[-1, src_len], dtype="int64",
+                 append_batch_size=False)
+    trg = L.data("trg_ids", shape=[-1, trg_len], dtype="int64",
+                 append_batch_size=False)
+    lbl = L.data("trg_next", shape=[-1, trg_len], dtype="int64",
+                 append_batch_size=False)
+    weights = L.data("trg_weight", shape=[-1, trg_len], dtype="float32",
+                     append_batch_size=False)
+
+    src_mask = _pad_mask(src)
+    enc_out = encoder(src, src_mask, cfg, src_len)
+    trg_emb = _embed(trg, cfg.trg_vocab, cfg, "trg_emb", trg_len)
+    dec_out = decoder(trg_emb, enc_out, cfg, _causal_mask(trg_len), src_mask)
+    logits = _logits(dec_out, cfg)
+
+    label = L.reshape(lbl, [-1, trg_len, 1])
+    if cfg.label_smooth:
+        one_hot = L.one_hot(L.reshape(lbl, [-1, trg_len]), cfg.trg_vocab)
+        smooth = L.label_smooth(one_hot, epsilon=cfg.label_smooth)
+        ce = L.softmax_with_cross_entropy(logits, smooth, soft_label=True)
+    else:
+        ce = L.softmax_with_cross_entropy(logits, label)
+    ce = L.reshape(ce, [-1, trg_len])
+    token_loss = L.elementwise_mul(ce, weights)
+    avg_loss = L.reduce_sum(token_loss) / L.reduce_sum(weights)
+
+    sched = L.learning_rate_scheduler.noam_decay(cfg.d_model, warmup) \
+        if warmup else lr
+    opt = fluid.optimizer.Adam(learning_rate=sched, beta1=0.9, beta2=0.997,
+                               epsilon=1e-9)
+    opt.minimize(avg_loss)
+    return [src, trg, lbl, weights], avg_loss
+
+
+def build_beam_infer(cfg, src_len, beam_size=4, max_out_len=None):
+    """Beam-search decode graph (statically unrolled decode loop + the
+    beam_search/beam_search_decode ops).  Returns (src var, seq_ids [B,K,T],
+    seq_scores [B,K])."""
+    L = fluid.layers
+    K = beam_size
+    T = max_out_len or cfg.max_len
+
+    src = L.data("src_ids", shape=[-1, src_len], dtype="int64",
+                 append_batch_size=False)
+    src_mask = _pad_mask(src)
+    enc_out = encoder(src, src_mask, cfg, src_len, is_test=True)
+
+    # expand encoder state to beams: [B, S, D] -> [B*K, S, D]
+    enc_k = L.expand(L.unsqueeze(enc_out, [1]), [1, K, 1, 1])
+    enc_k = L.reshape(enc_k, [-1, src_len, cfg.d_model])
+    srcm_k = L.expand(src_mask, [1, K, 1, 1])  # [B, K, 1, S]
+    srcm_k = L.reshape(srcm_k, [-1, 1, 1, src_len])
+
+    # alive state: prefix [B*K, t], scores [B, K]
+    prefix = L.fill_constant_batch_size_like(src, [-1, 1], "int64", BOS)
+    prefix = L.expand(L.reshape(prefix, [-1, 1, 1]), [1, K, 1])
+    prefix = L.reshape(prefix, [-1, 1])  # [B*K, 1] of BOS
+    init = np.full((1, K), -1e9, "float32")
+    init[0, 0] = 0.0
+    pre_scores = L.elementwise_add(
+        L.fill_constant_batch_size_like(src, [-1, K], "float32", 0.0),
+        fluid.layers.tensor.assign(init))
+    pre_ids = L.fill_constant_batch_size_like(src, [-1, K], "int64", BOS)
+
+    ids_array = L.create_array("int64")
+    parents_array = L.create_array("int64")
+    counter = L.zeros([1], "int64")
+
+    for t in range(T):
+        cur = t + 1
+        trg_emb = _embed(prefix, cfg.trg_vocab, cfg, "trg_emb", cur)
+        dec_out = decoder(trg_emb, enc_k, cfg, _causal_mask(cur), srcm_k,
+                          is_test=True)
+        last = L.slice(dec_out, axes=[1], starts=[cur - 1], ends=[cur])
+        logits = _logits(last, cfg)  # [B*K, 1, V]
+        logp = L.log_softmax(L.reshape(logits, [-1, K, cfg.trg_vocab]),
+                             axis=-1)
+        acc = L.elementwise_add(logp, pre_scores, axis=0)
+        sel_ids, sel_scores, parent = L.beam_search(
+            pre_ids, pre_scores, None, acc, beam_size=K, end_id=EOS)
+        L.array_write(sel_ids, counter, ids_array)
+        L.array_write(parent, counter, parents_array)
+        counter = L.increment(counter, 1, in_place=False)
+
+        # re-order prefixes by parent beam and append the new token
+        pref3 = L.reshape(prefix, [-1, K, cur])
+        new_pref = _reorder_and_append(pref3, parent, sel_ids, K, cur)
+        prefix = L.reshape(new_pref, [-1, cur + 1])
+        pre_scores = sel_scores
+        pre_ids = sel_ids
+
+    seq_ids, seq_scores = L.beam_search_decode(
+        ids_array, parents_array, scores=pre_scores, beam_size=K, end_id=EOS)
+    return src, seq_ids, seq_scores
+
+
+def _reorder_and_append(pref3, parent, sel_ids, K, cur):
+    """pref3 [B, K, t]; parent/sel_ids [B, K] -> [B, K, t+1]."""
+    L = fluid.layers
+    # one-hot matmul reorder: perm[b, k, j] = 1 where j == parent[b, k]
+    onehot = L.one_hot(L.reshape(parent, [-1, K]), K)       # [B*? K, K] -> [B, K, K]
+    onehot = L.reshape(onehot, [-1, K, K])
+    gathered = L.matmul(onehot, L.cast(pref3, "float32"))   # [B, K, t]
+    gathered = L.cast(gathered, "int64")
+    return L.concat([gathered, L.reshape(sel_ids, [-1, K, 1])], axis=2)
+
+
+# ---------------------------------------------------------------------------
+# batching helper for the wmt16-style readers
+# ---------------------------------------------------------------------------
+
+
+def pad_batch(samples, src_len, trg_len):
+    """samples: list of (src_ids, trg_ids, trg_next) -> padded arrays +
+    per-token weights (0 on padding)."""
+    n = len(samples)
+    src = np.full((n, src_len), EOS, "int64")
+    trg = np.full((n, trg_len), EOS, "int64")
+    nxt = np.full((n, trg_len), EOS, "int64")
+    w = np.zeros((n, trg_len), "float32")
+    for i, (s, t, tn) in enumerate(samples):
+        s = list(s)[:src_len]
+        t = list(t)[:trg_len]
+        tn = list(tn)[:trg_len]
+        src[i, : len(s)] = s
+        trg[i, : len(t)] = t
+        nxt[i, : len(tn)] = tn
+        w[i, : len(tn)] = 1.0
+    return src, trg, nxt, w

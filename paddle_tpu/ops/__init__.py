@@ -15,3 +15,4 @@ from . import metrics  # noqa: F401
 from . import collective  # noqa: F401
 from . import control_flow  # noqa: F401
 from . import sequence  # noqa: F401
+from . import beam_search  # noqa: F401

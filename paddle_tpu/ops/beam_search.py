@@ -1,0 +1,111 @@
+"""Beam-search decode ops.
+
+Parity: paddle/fluid/operators/beam_search_op.cc and
+beam_search_decode_op.cc.  The reference works on LoD-ragged candidate
+lists (variable beams per source); XLA needs static shapes, so the TPU
+design keeps a dense fixed [batch, beam] layout and represents pruned /
+finished beams with masked (-inf) scores — the LoD→mask translation from
+SURVEY §5.
+
+Protocol (mirrors the reference's decode loop in its transformer/NMT
+examples): the caller seeds pre_scores with [0, -inf, ..., -inf] per batch
+row so step 0 expands only beam 0 (all beams start identical), then each
+step calls `beam_search` with the accumulated per-beam scores and the
+next-token log-probs, writes selected ids/parents into tensor arrays, and
+finally `beam_search_decode` backtracks parent pointers into full
+sequences.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+_NEG_INF = -1e9
+
+
+@register_op(
+    "beam_search",
+    inputs=("pre_ids", "pre_scores", "ids", "scores"),
+    outputs=("selected_ids", "selected_scores", "parent_idx"),
+    attrs={"beam_size": 4, "end_id": 1, "level": 0, "is_accumulated": True},
+    optional_inputs=("ids",),
+    grad_maker=None,
+)
+def beam_search(ctx, pre_ids, pre_scores, ids, scores, beam_size=4, end_id=1,
+                level=0, is_accumulated=True, **_):
+    """One expansion step.
+
+    pre_ids [B, K] int: last token per beam; pre_scores [B, K] float:
+    accumulated log-prob per beam; scores [B, K, V] float: next-token
+    log-probs (already accumulated with pre_scores when is_accumulated).
+    Returns selected_ids [B, K], selected_scores [B, K], parent_idx [B, K].
+    """
+    B, K, V = scores.shape
+    if not is_accumulated:
+        scores = jnp.log(jnp.maximum(scores, 1e-20)) + pre_scores[..., None]
+    finished = pre_ids.astype(jnp.int32) == end_id
+    # finished beams emit only end_id, carrying their score unchanged
+    only_end = jnp.full((B, K, V), _NEG_INF, scores.dtype)
+    only_end = only_end.at[..., end_id].set(pre_scores)
+    cand = jnp.where(finished[..., None], only_end, scores)
+    flat = cand.reshape(B, K * V)
+    sel_scores, flat_idx = jax.lax.top_k(flat, beam_size)
+    parent = (flat_idx // V).astype(pre_ids.dtype)
+    token = (flat_idx % V).astype(pre_ids.dtype)
+    return token, sel_scores, parent
+
+
+def _beam_search_infer(op, block):
+    sv = block._find_var_recursive(op.input("scores")[0])
+    K = int(op.attrs.get("beam_size", 4))
+    if sv is not None and sv.shape is not None:
+        B = sv.shape[0]
+        for slot, dt in (("selected_ids", "int64"), ("selected_scores", None),
+                         ("parent_idx", "int64")):
+            ov = block._find_var_recursive(op.output(slot)[0])
+            if ov is not None:
+                ov.shape = (B, K)
+                if ov.dtype is None:
+                    ov.dtype = dt or sv.dtype
+
+
+beam_search.opdef.infer_shape = _beam_search_infer
+
+
+@register_op(
+    "beam_search_decode",
+    inputs=("Ids", "ParentIdx", "Scores"),
+    outputs=("SentenceIds", "SentenceScores"),
+    attrs={"beam_size": 4, "end_id": 1},
+    optional_inputs=("Scores",),
+    grad_maker=None,
+)
+def beam_search_decode(ctx, ids, parents, scores, beam_size=4, end_id=1, **_):
+    """Backtrack parent pointers into full sequences.
+
+    Ids / ParentIdx are tensor arrays (one [B, K] entry per step); Scores is
+    the final [B, K] accumulated log-probs.  Returns SentenceIds [B, K, T]
+    (end_id-padded past each beam's stop) and SentenceScores [B, K].
+    """
+    T = len(ids)
+    B, K = ids[0].shape
+    rows = jnp.arange(B)[:, None]
+    beam = jnp.arange(K)[None, :].astype(ids[0].dtype) * jnp.ones(
+        (B, 1), ids[0].dtype)
+    seq = []
+    for t in range(T - 1, -1, -1):
+        b = beam.astype(jnp.int32)
+        seq.append(ids[t][rows, b])
+        beam = parents[t][rows, b]
+    seq.reverse()
+    sent = jnp.stack(seq, axis=-1)  # [B, K, T]
+    if scores is None:
+        scores = jnp.zeros((B, K), jnp.float32)
+    # pad everything after the first end_id with end_id
+    hit = jnp.cumsum((sent == end_id).astype(jnp.int32), axis=-1)
+    sent = jnp.where(hit > 1, jnp.asarray(end_id, sent.dtype), sent)
+    return sent, scores
+
+
+beam_search_decode.opdef.infer_shape = lambda op, block: None

@@ -1,0 +1,122 @@
+"""BASELINE config 4: Transformer NMT seq2seq — variable-length path.
+
+Mirrors the reference's transformer book/dist tests: train on a
+deterministic synthetic translation task (reverse + shift, wmt16 module),
+then beam-search decode and check the model actually learned the mapping.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.models import transformer as T
+from paddle_tpu.datasets import wmt16
+from paddle_tpu.reader_decorator import batch as rbatch
+
+VOCAB = 24
+SRC_LEN, TRG_LEN = 8, 10
+
+
+def _cfg():
+    return T.TransformerConfig(
+        src_vocab=VOCAB, trg_vocab=VOCAB, d_model=32, heads=2,
+        enc_layers=1, dec_layers=1, ffn=64, max_len=32, dropout=0.0,
+        label_smooth=0.1)
+
+
+def test_transformer_trains_and_beam_decodes():
+    cfg = _cfg()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        feeds, loss = T.build_train(cfg, SRC_LEN, TRG_LEN, warmup=100)
+
+    infer_prog, infer_startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(infer_prog, infer_startup):
+        src_v, seq_ids, seq_scores = T.build_beam_infer(
+            cfg, SRC_LEN, beam_size=2, max_out_len=TRG_LEN)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = []
+        for _ep in range(6):
+            for b in rbatch(wmt16.train(VOCAB, VOCAB, min_len=3, max_len=7), 64, drop_last=True)():
+                src, trg, nxt, w = T.pad_batch(b, SRC_LEN, TRG_LEN)
+                lo, = exe.run(main, feed={
+                    "src_ids": src, "trg_ids": trg, "trg_next": nxt,
+                    "trg_weight": w}, fetch_list=[loss])
+                losses.append(float(lo[0]))
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+        # beam decode on held-out data: top beam must reproduce the
+        # deterministic reverse+shift mapping for most tokens
+        test_batch = next(rbatch(wmt16.test(VOCAB, VOCAB, min_len=3, max_len=7), 16,
+                                 drop_last=True)())
+        src, _trg, nxt, w = T.pad_batch(test_batch, SRC_LEN, TRG_LEN)
+        ids, scores = exe.run(infer_prog, feed={"src_ids": src},
+                              fetch_list=[seq_ids, seq_scores])
+        ids = np.asarray(ids)  # [B, K, T]
+        assert ids.shape == (16, 2, TRG_LEN)
+        top = ids[:, 0, :]
+        ref = np.asarray(nxt)
+        mask = np.asarray(w) > 0
+        token_acc = float((top[mask] == ref[mask]).mean())
+        assert token_acc > 0.6, token_acc
+        # scores sorted descending across beams
+        sc = np.asarray(scores)
+        assert (sc[:, 0] + 1e-6 >= sc[:, 1]).all()
+
+
+def test_beam_search_op_semantics():
+    """Golden test for the dense beam_search op (reference
+    beam_search_op.cc behavior on a hand-computed case)."""
+    import jax.numpy as jnp
+    from paddle_tpu.ops.beam_search import beam_search as bs_op
+
+    # B=1, K=2, V=4; beam 0 alive (score -1), beam 1 finished (ended, -2)
+    pre_ids = jnp.array([[3, 1]], dtype=jnp.int64)  # end_id = 1
+    pre_scores = jnp.array([[-1.0, -2.0]], dtype=jnp.float32)
+    step = jnp.log(jnp.array([[0.1, 0.2, 0.3, 0.4]], jnp.float32))
+    acc = pre_scores[..., None] + jnp.stack([step[0], step[0]])[None]
+    ids, scores, parent = bs_op(None, pre_ids, pre_scores, None, acc,
+                                beam_size=2, end_id=1)
+    # candidates: beam0 continues with any token (best: 3 @ -1+log0.4),
+    # beam1 only emits end_id at -2.0
+    assert int(ids[0, 0]) == 3 and int(parent[0, 0]) == 0
+    np.testing.assert_allclose(float(scores[0, 0]), -1 + np.log(0.4),
+                               rtol=1e-5)
+    assert int(ids[0, 1]) == 1 and int(parent[0, 1]) == 1
+    np.testing.assert_allclose(float(scores[0, 1]), -2.0, rtol=1e-5)
+
+
+def test_beam_search_decode_backtrack():
+    import jax.numpy as jnp
+    from paddle_tpu.ops.beam_search import beam_search_decode as bsd
+
+    # B=1, K=2, T=2: step0 picks tokens [5, 6]; step1 both select parent 1
+    ids = [jnp.array([[5, 6]], jnp.int64), jnp.array([[7, 8]], jnp.int64)]
+    parents = [jnp.array([[0, 0]], jnp.int64), jnp.array([[1, 0]], jnp.int64)]
+    sent, sc = bsd(None, ids, parents, jnp.zeros((1, 2), jnp.float32),
+                   beam_size=2, end_id=1)
+    np.testing.assert_array_equal(np.asarray(sent[0, 0]), [6, 7])
+    np.testing.assert_array_equal(np.asarray(sent[0, 1]), [5, 8])
+
+
+def test_gru_lstm_layers_run():
+    """dynamic_gru / dynamic_lstm smoke: shapes + finite outputs."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[-1, 5, 8], dtype="float32",
+                              append_batch_size=False)
+        g = fluid.layers.dynamic_gru(x, size=12)
+        l = fluid.layers.dynamic_lstm(x, size=4 * 6)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        xv = np.random.RandomState(0).randn(3, 5, 8).astype("float32")
+        go, lo = exe.run(main, feed={"x": xv}, fetch_list=[g, l])
+    assert np.asarray(go).shape == (3, 5, 12)
+    assert np.asarray(lo).shape == (3, 5, 6)
+    assert np.isfinite(np.asarray(go)).all()
+    assert np.isfinite(np.asarray(lo)).all()
