@@ -48,6 +48,7 @@ from .reader import DataLoader, PyReader
 from .dataset import DatasetFactory
 from . import dataset
 from . import datasets
+from . import dygraph
 from . import reader  # DataLoader module; also re-exports the decorators
 from .reader_decorator import batch
 

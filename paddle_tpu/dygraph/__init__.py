@@ -1,0 +1,20 @@
+"""Imperative (dygraph) mode — eager op execution on jax.Arrays with tape
+autograd.  Parity: python/paddle/fluid/dygraph/ + paddle/fluid/imperative/."""
+
+from .base import guard, enabled, to_variable, no_grad, Tracer
+from .layers import Layer
+from .nn import (
+    Conv2D, Conv2DTranspose, Pool2D, FC, Linear, BatchNorm, Embedding,
+    LayerNorm, GroupNorm, PRelu, Dropout,
+)
+from .checkpoint import save_dygraph, load_dygraph
+from .jit import TracedLayer
+from .parallel import prepare_context, Env, ParallelEnv, DataParallel
+
+__all__ = [
+    "guard", "enabled", "to_variable", "no_grad", "Tracer", "Layer",
+    "Conv2D", "Conv2DTranspose", "Pool2D", "FC", "Linear", "BatchNorm",
+    "Embedding", "LayerNorm", "GroupNorm", "PRelu", "Dropout",
+    "save_dygraph", "load_dygraph", "TracedLayer",
+    "prepare_context", "Env", "ParallelEnv", "DataParallel",
+]

@@ -1,0 +1,270 @@
+"""Dygraph (imperative) mode: eager per-op execution with tape autograd.
+
+TPU-native analog of the reference's imperative runtime
+(paddle/fluid/imperative/tracer.cc:82 Tracer::TraceOp,
+python/paddle/fluid/dygraph/base.py:111 guard, :176 to_variable): instead of
+dispatching per-op CUDA kernels, each traced op calls its registered JAX
+lowering eagerly on concrete ``jax.Array`` values.  Gradients come from a
+recorded tape replayed through ``jax.vjp`` (engine.py) — the functional
+equivalent of the reference's OpBase grad chain + BasicEngine
+(imperative/engine.h:69).
+"""
+
+import contextlib
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import framework
+from ..core.lowering import LowerCtx
+from ..core.registry import get_op_def, _lower_attrs
+
+__all__ = ["guard", "enabled", "to_variable", "no_grad", "Tracer"]
+
+
+def _as_var_objs(block, v):
+    """Normalize a slot value to a list of Variable objects (None allowed)."""
+    if v is None:
+        return []
+    if not isinstance(v, (list, tuple)):
+        v = [v]
+    out = []
+    for x in v:
+        if isinstance(x, framework.Variable):
+            out.append(x)
+        elif isinstance(x, str):
+            out.append(block._find_var_recursive(x))
+        elif x is None:
+            out.append(None)
+        else:
+            raise TypeError("expected Variable or str, got %r" % (x,))
+    return out
+
+
+class _TapeEntry:
+    __slots__ = ("opdef", "attrs", "rng_key", "in_slots", "out_slots")
+
+    def __init__(self, opdef, attrs, rng_key, in_slots, out_slots):
+        self.opdef = opdef
+        self.attrs = attrs
+        self.rng_key = rng_key
+        # in_slots: [(slot, [(var|None, array|None), ...]), ...] in
+        # opdef.input_slots order; arrays snapshot trace-time values (params
+        # mutate in place between forward and backward).
+        self.in_slots = in_slots
+        # out_slots: [(slot, [(var|None, shape, dtype), ...]), ...]
+        self.out_slots = out_slots
+
+
+class Tracer:
+    """Eager op executor + autograd tape (imperative/tracer.cc:82 analog)."""
+
+    def __init__(self, seed=0):
+        self._base_key = jax.random.key(seed)
+        self._key_n = 0
+        self.tape = []
+        self._has_grad = True
+        self.params = {}  # name -> Parameter created under this tracer
+        self.train_mode = True
+
+    # -- rng -----------------------------------------------------------------
+    def _next_key(self):
+        k = jax.random.fold_in(self._base_key, self._key_n)
+        self._key_n += 1
+        return k
+
+    # -- parameters ----------------------------------------------------------
+    def track_parameter(self, param):
+        self.params[param.name] = param
+
+    def all_parameters(self):
+        return list(self.params.values())
+
+    # -- op execution --------------------------------------------------------
+    def trace_op(self, block, type, inputs=None, outputs=None, attrs=None):
+        opdef = get_op_def(type)
+        if opdef is None or opdef.lower is None:
+            raise NotImplementedError(
+                "op %r has no lowering; cannot run in dygraph mode" % type
+            )
+        op = framework.Operator(block, type, inputs, outputs, attrs)
+        opdef.validate(op)
+
+        in_objs = {k: _as_var_objs(block, v) for k, v in (inputs or {}).items()}
+        out_objs = {k: _as_var_objs(block, v) for k, v in (outputs or {}).items()}
+
+        args = []
+        for slot in opdef.input_slots:
+            vars_ = in_objs.get(slot, [])
+            vals = []
+            for v in vars_:
+                if v is None:
+                    vals.append(None)
+                    continue
+                if v._ivar is None:
+                    if slot in opdef.optional_inputs:
+                        vals.append(None)
+                        continue
+                    raise RuntimeError(
+                        "op %s input %s=%s has no value (uninitialized "
+                        "variable in dygraph mode)" % (type, slot, v.name)
+                    )
+                vals.append(v._ivar)
+            if slot in opdef.duplicable_inputs:
+                args.append(vals)
+            elif not vals:
+                args.append(None)
+            else:
+                args.append(vals[0])
+
+        rng_key = self._next_key() if opdef.n_rng else None
+        ctx = LowerCtx(rng_key=rng_key, op=op, block=block, mode="eager")
+        out = opdef.lower(ctx, *args, **_lower_attrs(op.attrs))
+        out = _normalize_outputs(opdef, out)
+
+        # does any differentiable input require grad?
+        requires = False
+        if self._has_grad and opdef.grad_maker is not None:
+            for slot in opdef.input_slots:
+                if slot in opdef.no_grad_inputs:
+                    continue
+                for v in in_objs.get(slot, []):
+                    if v is not None and not v.stop_gradient:
+                        requires = True
+                        break
+                if requires:
+                    break
+
+        out_slots_rec = []
+        for slot, val in zip(opdef.output_slots, out):
+            vars_ = out_objs.get(slot, [])
+            items = (
+                list(val) if slot in opdef.duplicable_outputs and val is not None
+                else [val]
+            )
+            recs = []
+            for v, item in zip(vars_, items):
+                if v is None or item is None:
+                    recs.append((None, (), None))
+                    continue
+                item = jnp.asarray(item)
+                v._ivar = item
+                v.shape = tuple(item.shape)
+                # temp outputs inherit differentiability; Parameters keep
+                # their own flag (an eager initializer/optimizer op writing a
+                # param must not mark it stop_gradient)
+                if not isinstance(v, framework.Parameter):
+                    v.stop_gradient = not requires
+                recs.append((v, tuple(item.shape), item.dtype))
+            out_slots_rec.append((slot, recs))
+
+        # eager mode keeps no graph: drop temp outputs from the block's
+        # symbol table so their arrays die with the last user/tape reference
+        # (the scratch Program would otherwise pin every step's activations)
+        for slot, recs in out_slots_rec:
+            for v, _, _ in recs:
+                if v is not None and not v.persistable:
+                    block.vars.pop(v.name, None)
+
+        if requires:
+            in_slots_rec = []
+            for slot in opdef.input_slots:
+                recs = [
+                    (v, v._ivar if v is not None else None)
+                    for v in in_objs.get(slot, [])
+                ]
+                in_slots_rec.append((slot, recs))
+            self.tape.append(
+                _TapeEntry(opdef, dict(op.attrs), rng_key, in_slots_rec,
+                           out_slots_rec)
+            )
+        return op
+
+    def clear_tape(self):
+        self.tape = []
+
+
+def _normalize_outputs(opdef, out):
+    if len(opdef.output_slots) == 1 and not isinstance(out, (tuple, list)):
+        out = (out,)
+    elif isinstance(out, list):
+        out = tuple(out)
+    if len(opdef.output_slots) == 1 and len(out) != 1:
+        out = (list(out),)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# mode switches
+# ---------------------------------------------------------------------------
+
+
+def enabled():
+    return framework.in_dygraph_mode()
+
+
+@contextlib.contextmanager
+def guard(place=None, seed=0):
+    """Enter dygraph mode (reference dygraph/base.py:111).
+
+    Pushes one scratch Program as BOTH the main and startup program so that
+    layer helpers and initializers work unchanged — their appended ops are
+    executed eagerly by the tracer instead of accumulating in a graph.
+    """
+    tracer = Tracer(seed=seed)
+    prog = framework.Program()
+    with framework.program_guard(prog, prog):
+        prev = framework._dygraph_tracer_
+        framework._dygraph_tracer_ = tracer
+        try:
+            yield
+        finally:
+            framework._dygraph_tracer_ = prev
+
+
+@contextlib.contextmanager
+def no_grad_guard():
+    tracer = framework._dygraph_tracer()
+    if tracer is None:
+        yield
+        return
+    prev = tracer._has_grad
+    tracer._has_grad = False
+    try:
+        yield
+    finally:
+        tracer._has_grad = prev
+
+
+def no_grad(fn=None):
+    """Decorator or context manager disabling tape recording."""
+    if fn is None:
+        return no_grad_guard()
+
+    def wrapper(*args, **kwargs):
+        with no_grad_guard():
+            return fn(*args, **kwargs)
+
+    return wrapper
+
+
+def to_variable(value, name=None, zero_copy=None):
+    """numpy/jax array -> eager Variable (reference dygraph/base.py:176)."""
+    if isinstance(value, framework.Variable):
+        return value
+    if not framework.in_dygraph_mode():
+        raise RuntimeError("to_variable requires dygraph mode (use "
+                           "fluid.dygraph.guard())")
+    np_val = np.asarray(value)
+    arr = jnp.asarray(np_val)
+    block = framework.default_main_program().current_block()
+    # construct directly (NOT block.create_var): eager tensors are not part
+    # of any symbol table — avoids aliasing an existing var of the same name
+    # and keeps the scratch block from pinning every input array
+    var = framework.Variable(
+        block, name=name, shape=arr.shape, dtype=np_val.dtype,
+        stop_gradient=True,
+    )
+    var._ivar = arr
+    return var

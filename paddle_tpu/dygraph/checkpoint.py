@@ -1,0 +1,41 @@
+"""save_dygraph / load_dygraph (reference dygraph/checkpoint.py).
+
+State dicts are name->ndarray maps saved as a single ``.npz`` (the TPU
+build's container format; the reference used per-var LoDTensor streams).
+Optimizer state (accumulators) saves the same way.
+"""
+
+import os
+
+import numpy as np
+
+__all__ = ["save_dygraph", "load_dygraph"]
+
+_SUFFIX = ".pdparams.npz"
+_OPT_SUFFIX = ".pdopt.npz"
+
+
+def save_dygraph(state_dict, model_path):
+    """state_dict: from Layer.state_dict() or Optimizer.state_dict()."""
+    d = os.path.dirname(model_path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    arrays = {k: np.asarray(v) for k, v in state_dict.items()}
+    np.savez(model_path + _SUFFIX, **arrays)
+
+
+def load_dygraph(model_path):
+    """Returns (param_state_dict, optimizer_state_dict_or_None)."""
+    params = None
+    opt = None
+    p = model_path + _SUFFIX
+    if os.path.exists(p):
+        with np.load(p) as z:
+            params = {k: z[k] for k in z.files}
+    o = model_path + _OPT_SUFFIX
+    if os.path.exists(o):
+        with np.load(o) as z:
+            opt = {k: z[k] for k in z.files}
+    if params is None:
+        raise ValueError("no checkpoint found at %s" % p)
+    return params, opt

@@ -1,0 +1,141 @@
+"""Tape-based autograd for dygraph mode.
+
+Functional analog of the reference's ``BasicEngine``
+(paddle/fluid/imperative/engine.h:69) + ``GradientAccumulator``
+(imperative/gradient_accumulator.cc): instead of running recorded grad
+OpBases, each tape entry's forward lowering is replayed under ``jax.vjp``
+with its snapshot inputs and original PRNG key, and input cotangents are
+accumulated per Variable.  XLA CSE/fusion make the replayed forward cheap
+under jit; in eager mode it is the straightforward O(ops) reverse sweep.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.lowering import LowerCtx
+from ..core.registry import _lower_attrs
+
+__all__ = ["run_backward"]
+
+
+def _entry_backward(entry, grads):
+    """Compute input cotangents for one tape entry.  Returns list of
+    (var, grad_array) for differentiable inputs, or None if no output of
+    this entry has a gradient."""
+    opdef = entry.opdef
+
+    # cotangents per output, flat in (slot, item) order; skip entries whose
+    # outputs carry no incoming gradient at all.
+    out_cts = []
+    any_grad = False
+    for slot, recs in entry.out_slots:
+        for v, shape, dtype in recs:
+            g = grads.get(id(v)) if v is not None else None
+            if g is not None:
+                any_grad = True
+                if g.dtype != dtype:
+                    g = g.astype(dtype)
+            out_cts.append((g, shape, dtype))
+    if not any_grad:
+        return None
+
+    # positions of differentiable inputs
+    diff_pos = []  # (slot_index, item_index, var)
+    for si, (slot, recs) in enumerate(entry.in_slots):
+        if slot in opdef.no_grad_inputs:
+            continue
+        for ii, (v, arr) in enumerate(recs):
+            if v is not None and arr is not None and not v.stop_gradient:
+                diff_pos.append((si, ii, v))
+    if not diff_pos:
+        return []
+
+    diff_vals = tuple(entry.in_slots[si][1][ii][1] for si, ii, _ in diff_pos)
+
+    def replay(*dvals):
+        # rebuild slot args with the traced values substituted
+        subst = {}
+        for (si, ii, _), val in zip(diff_pos, dvals):
+            subst[(si, ii)] = val
+        args = []
+        for si, (slot, recs) in enumerate(entry.in_slots):
+            vals = [
+                subst.get((si, ii), arr)
+                for ii, (v, arr) in enumerate(recs)
+            ]
+            if slot in opdef.duplicable_inputs:
+                args.append(vals)
+            elif not vals:
+                args.append(None)
+            else:
+                args.append(vals[0])
+        ctx = LowerCtx(rng_key=entry.rng_key, mode="eager")
+        out = opdef.lower(ctx, *args, **_lower_attrs(entry.attrs))
+        if len(opdef.output_slots) == 1 and not isinstance(out, (tuple, list)):
+            out = (out,)
+        elif isinstance(out, list):
+            out = tuple(out)
+        if len(opdef.output_slots) == 1 and len(out) != 1:
+            out = (list(out),)
+        flat = []
+        for slot, val in zip(opdef.output_slots, out):
+            items = (
+                list(val)
+                if slot in opdef.duplicable_outputs and val is not None
+                else [val]
+            )
+            for item in items:
+                flat.append(item)
+        # only outputs that were produced at trace time participate
+        return tuple(x for x in flat if x is not None)
+
+    _, vjp_fn = jax.vjp(replay, *diff_vals)
+    cts = tuple(
+        g if g is not None else jnp.zeros(shape, dtype)
+        for g, shape, dtype in out_cts
+        if dtype is not None
+    )
+    in_cts = vjp_fn(cts)
+    return [(v, ct) for (_, _, v), ct in zip(diff_pos, in_cts)]
+
+
+def run_backward(tracer, root, retain_graph=False):
+    """Reverse sweep over the tape from ``root`` (a scalar-ish Variable)."""
+    if root._ivar is None:
+        raise RuntimeError("backward() on a variable with no value")
+    grads = {id(root): jnp.ones(root._ivar.shape, root._ivar.dtype)}
+    varmap = {id(root): root}
+
+    for entry in reversed(tracer.tape):
+        res = _entry_backward(entry, grads)
+        if res is None:
+            continue
+        for v, ct in res:
+            k = id(v)
+            varmap[k] = v
+            prev = grads.get(k)
+            grads[k] = ct if prev is None else prev + ct
+
+    # materialize .gradient() on LEAF vars only (params & user-held inputs
+    # that no taped op produced): accumulate across backward() calls until
+    # clear_gradient(), matching the reference's GradientAccumulator
+    # semantics.  Intermediates' cotangents stay local to this sweep so
+    # their arrays are freed with `grads`.
+    produced = set()
+    for entry in tracer.tape:
+        for _, recs in entry.out_slots:
+            for v, _, _ in recs:
+                if v is not None:
+                    produced.add(id(v))
+    from ..framework import Parameter
+
+    for k, g in grads.items():
+        v = varmap[k]
+        if k in produced and not isinstance(v, Parameter):
+            continue
+        if v._grad_ivar is None:
+            v._grad_ivar = g
+        else:
+            v._grad_ivar = v._grad_ivar + g
+    if not retain_graph:
+        tracer.clear_tape()

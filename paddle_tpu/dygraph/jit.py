@@ -1,0 +1,101 @@
+"""TracedLayer: trace a dygraph Layer into a static Program
+(reference python/paddle/fluid/dygraph/jit.py:82 + the C++
+ProgramDescTracer, paddle/fluid/imperative/jit/program_desc_tracer.cc).
+
+Because dygraph layers emit the SAME ops as the static builders, tracing is
+re-running ``forward`` with dygraph mode switched off under a fresh
+program_guard; parameters are mirrored into the new program's global block
+and their current values copied into a private Scope.  The result executes
+through the normal block-compiling Executor (whole-program XLA compilation —
+this is how a dygraph model gets the fused/compiled TPU fast path).
+"""
+
+import numpy as np
+
+from .. import framework
+from ..core.executor import Executor, scope_guard
+from ..core.scope import Scope
+
+__all__ = ["TracedLayer"]
+
+
+def _persistable_vars_of(layer):
+    """All Parameters + persistable state vars (e.g. BatchNorm running
+    stats) owned by `layer` and its sublayers."""
+    seen = {}
+    for p in layer.parameters():
+        seen[p.name] = p
+    for l in [layer] + layer.sublayers():
+        for v in l.__dict__.values():
+            if isinstance(v, framework.Variable) and v.persistable:
+                seen.setdefault(v.name, v)
+    return list(seen.values())
+
+
+class TracedLayer:
+    def __init__(self, program, feed_vars, fetch_vars, scope, place=None):
+        self.program = program
+        self._feed_names = [v.name for v in feed_vars]
+        self._fetch_vars = fetch_vars
+        self._scope = scope
+        self._place = place or framework.CPUPlace()
+        self._exe = Executor(self._place)
+
+    @staticmethod
+    def trace(layer, inputs):
+        """Returns (outputs_in_dygraph, traced_layer)."""
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        # run once eagerly for the dygraph-side outputs
+        dy_out = layer(*inputs)
+
+        state = _persistable_vars_of(layer)
+        tracer = framework._dygraph_tracer_
+        framework._dygraph_tracer_ = None
+        try:
+            main, startup = framework.Program(), framework.Program()
+            with framework.program_guard(main, startup):
+                gblock = main.global_block()
+                for v in state:
+                    gblock.create_var(
+                        name=v.name, shape=v.shape, dtype=v.dtype,
+                        persistable=True)
+                feed_vars = []
+                for i, x in enumerate(inputs):
+                    arr = np.asarray(x.numpy())
+                    feed_vars.append(gblock.create_var(
+                        name="traced_in_%d" % i, shape=arr.shape,
+                        dtype=arr.dtype, is_data=True, stop_gradient=True))
+                out = layer.forward(*feed_vars)
+            fetch_vars = list(out) if isinstance(out, (list, tuple)) else [out]
+        finally:
+            framework._dygraph_tracer_ = tracer
+
+        scope = Scope()
+        for v in state:
+            scope.var(v.name).set(np.asarray(v._ivar))
+        traced = TracedLayer(main, feed_vars, fetch_vars, scope)
+        return dy_out, traced
+
+    def __call__(self, inputs):
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        feed = {}
+        for name, x in zip(self._feed_names, inputs):
+            feed[name] = x.numpy() if isinstance(x, framework.Variable) else np.asarray(x)
+        with scope_guard(self._scope):
+            return self._exe.run(self.program, feed=feed,
+                                 fetch_list=self._fetch_vars)
+
+    def save_inference_model(self, dirname, feed=None, fetch=None):
+        from .. import io
+
+        fetch_vars = self._fetch_vars
+        if fetch is not None:
+            fetch_vars = [fetch_vars[i] for i in fetch]
+        feed_names = self._feed_names
+        if feed is not None:
+            feed_names = [feed_names[i] for i in feed]
+        with scope_guard(self._scope):
+            io.save_inference_model(dirname, feed_names, fetch_vars,
+                                    self._exe, main_program=self.program)

@@ -1,0 +1,140 @@
+"""Dygraph Layer base class (reference python/paddle/fluid/dygraph/layers.py).
+
+A Layer owns Parameters (created once, initialized eagerly by the tracer) and
+sub-layers; ``__call__`` dispatches to ``forward``, which emits ops that the
+dygraph tracer executes immediately on jax.Arrays.
+"""
+
+import collections
+
+import numpy as np
+
+from .. import framework
+from ..layer_helper import LayerHelper
+from ..utils import unique_name
+
+__all__ = ["Layer"]
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        if name_scope is None:
+            name_scope = self.__class__.__name__.lower()
+        self._full_name = unique_name.generate(name_scope)
+        self._dtype = dtype
+        self._parameters = collections.OrderedDict()
+        self._sub_layers = collections.OrderedDict()
+        self._helper = LayerHelper(self._full_name)
+        self.training = True
+
+    def full_name(self):
+        return self._full_name
+
+    # -- parameters ----------------------------------------------------------
+    def create_parameter(self, attr, shape, dtype="float32", is_bias=False,
+                         default_initializer=None):
+        return self._helper.create_parameter(attr, shape, dtype, is_bias,
+                                             default_initializer)
+
+    def parameters(self, include_sublayers=True):
+        ret, seen = [], set()
+        for p in self._parameters.values():
+            if id(p) not in seen:
+                seen.add(id(p))
+                ret.append(p)
+        if include_sublayers:
+            for l in self._sub_layers.values():
+                for p in l.parameters():
+                    if id(p) not in seen:
+                        seen.add(id(p))
+                        ret.append(p)
+        return ret
+
+    def sublayers(self, include_sublayers=True):
+        ret = list(self._sub_layers.values())
+        if include_sublayers:
+            for l in self._sub_layers.values():
+                ret.extend(l.sublayers())
+        return ret
+
+    def named_parameters(self, prefix=""):
+        for name, p in self._parameters.items():
+            yield (prefix + name if not prefix else prefix + "." + name), p
+        for lname, l in self._sub_layers.items():
+            sub_prefix = prefix + "." + lname if prefix else lname
+            yield from l.named_parameters(sub_prefix)
+
+    def add_parameter(self, name, parameter):
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    # -- train/eval ----------------------------------------------------------
+    def train(self):
+        self.training = True
+        for l in self._sub_layers.values():
+            l.train()
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self._sub_layers.values():
+            l.eval()
+        return self
+
+    # -- state dict ----------------------------------------------------------
+    def state_dict(self, include_sublayers=True):
+        out = collections.OrderedDict()
+        for p in self.parameters(include_sublayers):
+            out[p.name] = p.numpy()
+        return out
+
+    def set_dict(self, state_dict, include_sublayers=True):
+        import jax.numpy as jnp
+
+        for p in self.parameters(include_sublayers):
+            if p.name in state_dict:
+                val = np.asarray(state_dict[p.name])
+                if tuple(val.shape) != tuple(p.shape):
+                    raise ValueError(
+                        "shape mismatch for %s: checkpoint %s vs param %s"
+                        % (p.name, val.shape, p.shape)
+                    )
+                p._ivar = jnp.asarray(val)
+        return self
+
+    load_dict = set_dict
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_gradient()
+
+    # -- call ----------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        return self.forward(*inputs, **kwargs)
+
+    # -- attribute magic -----------------------------------------------------
+    def __setattr__(self, name, value):
+        if isinstance(value, framework.Parameter):
+            self._parameters[name] = value
+        elif isinstance(value, Layer):
+            self._sub_layers[name] = value
+        object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        # only called when normal lookup fails
+        params = self.__dict__.get("_parameters")
+        if params is not None and name in params:
+            return params[name]
+        subs = self.__dict__.get("_sub_layers")
+        if subs is not None and name in subs:
+            return subs[name]
+        raise AttributeError(
+            "%r object has no attribute %r" % (type(self).__name__, name)
+        )

