@@ -10,6 +10,7 @@ from ..ops.common import dtype_enum
 __all__ = [
     "fc",
     "embedding",
+    "flash_attention",
     "dropout",
     "softmax",
     "log_softmax",
@@ -1429,5 +1430,28 @@ def pixel_shuffle(x, upscale_factor):
         inputs={"X": [x]},
         outputs={"Out": [out]},
         attrs={"upscale_factor": upscale_factor},
+    )
+    return out
+
+
+def flash_attention(q, k, v, bias_qk=None, causal=False, scale=0.0,
+                    name=None):
+    """Fused blockwise multi-head attention on [B, H, S, D] tensors
+    (Pallas TPU kernel; see paddle_tpu/pallas_kernels/flash_attention.py).
+    Analog of the reference's fused attention (multihead_matmul_op.cu) but
+    differentiable/trainable.
+
+    bias_qk is an additive mask (no gradient flows to it).  scale=0.0 means
+    "use 1/sqrt(head_dim)"; pass scale=1.0 if q is already pre-scaled."""
+    helper = LayerHelper("flash_attention", name=name)
+    out = helper.create_variable_for_type_inference(dtype=q.dtype)
+    inputs = {"Q": [q], "K": [k], "V": [v]}
+    if bias_qk is not None:
+        inputs["BiasQK"] = [bias_qk]
+    helper.append_op(
+        type="flash_attention",
+        inputs=inputs,
+        outputs={"Out": [out]},
+        attrs={"causal": causal, "scale": float(scale)},
     )
     return out

@@ -54,16 +54,21 @@ def multi_head_attention(x, cfg, prefix, is_test=False, use_tp=False,
         return fluid.layers.transpose(t, [0, 2, 1, 3])
 
     q, k, v = split_heads(q), split_heads(k), split_heads(v)
-    scores = fluid.layers.matmul(q, k, transpose_y=True,
-                                 alpha=d ** -0.5)
-    if attn_mask is not None:
-        scores = fluid.layers.elementwise_add(scores, attn_mask)
-    probs = fluid.layers.softmax(scores)
-    if cfg.dropout and not is_test:
+    if is_test or not cfg.dropout:
+        # fast path: one fused Pallas flash-attention kernel (no
+        # attention-prob dropout in this mode, so semantics are identical)
+        ctxv = fluid.layers.flash_attention(q, k, v, bias_qk=attn_mask,
+                                            scale=d ** -0.5)
+    else:
+        scores = fluid.layers.matmul(q, k, transpose_y=True,
+                                     alpha=d ** -0.5)
+        if attn_mask is not None:
+            scores = fluid.layers.elementwise_add(scores, attn_mask)
+        probs = fluid.layers.softmax(scores)
         probs = fluid.layers.dropout(
             probs, cfg.dropout, is_test=is_test,
             dropout_implementation="upscale_in_train")
-    ctxv = fluid.layers.matmul(probs, v)
+        ctxv = fluid.layers.matmul(probs, v)
     ctxv = fluid.layers.transpose(ctxv, [0, 2, 1, 3])
     ctxv = fluid.layers.reshape(ctxv, [0, 0, h])
     out = fluid.layers.fc(ctxv, h, num_flatten_dims=2,

@@ -547,3 +547,30 @@ def uniform_random_batch_size_like(ctx, input, shape=(), input_dim_idx=0,
     key = jax.random.key(seed) if seed else ctx.rng()
     return jax.random.uniform(key, tuple(out_shape), dtype=attr_dtype(dtype),
                               minval=min, maxval=max)
+
+
+@register_op(
+    "flash_attention",
+    inputs=("Q", "K", "V", "BiasQK"),
+    outputs=("Out",),
+    attrs={"causal": False, "scale": 0.0},
+    optional_inputs=("BiasQK",),
+    no_grad_inputs=("BiasQK",),
+)
+def flash_attention_op(ctx, q, k, v, bias_qk=None, causal=False, scale=0.0):
+    """Fused blockwise attention (Pallas TPU kernel with jnp fallback).
+
+    TPU-native replacement for the reference's fused inference attention
+    (paddle/fluid/operators/fused/multihead_matmul_op.cu) — but trainable:
+    the kernel carries a FlashAttention backward (pallas_kernels/
+    flash_attention.py).  q/k/v: [B, H, S, D]; bias_qk: [B, 1|H, Sq, Sk].
+
+    BiasQK is an additive MASK, not a trainable tensor: the TPU backward
+    kernel returns no bias cotangent, so it is registered no-grad on every
+    backend.  scale=0.0 (the default) means "use 1/sqrt(head_dim)"; pass
+    scale=1.0 explicitly if the scaling is already folded into q.
+    """
+    from ..pallas_kernels import flash_attention as _fa
+
+    sm_scale = scale if scale else None
+    return _fa(q, k, v, bias=bias_qk, causal=causal, sm_scale=sm_scale)

@@ -1,0 +1,5 @@
+"""Hand-written Pallas TPU kernels — the TPU-native analog of the
+reference's fused CUDA ops (paddle/fluid/operators/fused/,
+multihead_matmul_op.cu) and its xbyak JIT CPU codegen (operators/jit/)."""
+
+from .flash_attention import flash_attention  # noqa: F401

@@ -1,0 +1,134 @@
+"""Flash attention kernel tests.
+
+On the CI CPU mesh the Pallas TPU kernel runs in interpreter mode
+(exercises the real kernel logic, small shapes); the public
+``flash_attention`` entry falls back to the jnp reference on CPU, which the
+model/op-level tests cover.  On real TPU the kernel path is exercised by
+the verify drive + bench.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.pallas_kernels.flash_attention import (
+    flash_attention, _ref_attention, _fwd_pallas, _bwd_pallas)
+
+
+def _rand(shape, seed):
+    return jnp.asarray(np.random.RandomState(seed).randn(*shape).astype("f"))
+
+
+def _naive(q, k, v, bias, causal):
+    return _ref_attention(q, k, v, bias, causal, q.shape[-1] ** -0.5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("with_bias", [False, True])
+def test_fwd_kernel_interpret(causal, with_bias):
+    B, H, S, D = 1, 2, 256, 64
+    q, k, v = _rand((B, H, S, D), 0), _rand((B, H, S, D), 1), _rand((B, H, S, D), 2)
+    bias = None
+    if with_bias:
+        m = (np.random.RandomState(3).rand(B, 1, 1, S) > 0.2).astype("f")
+        bias = jnp.asarray(np.broadcast_to((1 - m) * -1e4, (B, 1, S, S)).copy())
+    out, lse = _fwd_pallas(q, k, v, bias, causal, D ** -0.5, 128, 128,
+                           interpret=True)
+    ref = _naive(q, k, v, bias, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("with_bias", [False, True])
+def test_bwd_kernel_interpret(causal, with_bias):
+    B, H, S, D = 1, 1, 256, 64
+    q, k, v = _rand((B, H, S, D), 0), _rand((B, H, S, D), 1), _rand((B, H, S, D), 2)
+    bias = None
+    if with_bias:
+        m = (np.random.RandomState(7).rand(B, 1, 1, S) > 0.2).astype("f")
+        bias = jnp.asarray(np.broadcast_to((1 - m) * -1e4, (B, 1, S, S)).copy())
+    out, lse = _fwd_pallas(q, k, v, bias, causal, D ** -0.5, 128, 128,
+                           interpret=True)
+    do = _rand((B, H, S, D), 4)
+    dq, dk, dv = _bwd_pallas(q, k, v, bias, causal, D ** -0.5, 128, 128,
+                             True, out, lse, do)
+    # reference grads via jax.vjp of the naive composition
+    ref_fn = lambda q_, k_, v_: _naive(q_, k_, v_, bias, causal)
+    _, vjp = jax.vjp(ref_fn, q, k, v)
+    rdq, rdk, rdv = vjp(do)
+    np.testing.assert_allclose(np.asarray(dq), np.asarray(rdq), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(dk), np.asarray(rdk), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(dv), np.asarray(rdv), rtol=1e-4, atol=1e-4)
+
+
+def test_public_entry_fallback_matches_reference():
+    # on CPU the public entry silently uses the jnp path — must equal naive
+    B, H, S, D = 2, 2, 100, 32  # S=100: untileable, forces fallback anywhere
+    q, k, v = _rand((B, H, S, D), 0), _rand((B, H, S, D), 1), _rand((B, H, S, D), 2)
+    out = flash_attention(q, k, v, causal=True)
+    ref = _naive(q, k, v, None, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6)
+
+
+def test_flash_attention_op_and_layer():
+    import paddle_tpu as fluid
+
+    B, H, S, D = 2, 2, 64, 16
+    rng = np.random.RandomState(0)
+    qv = rng.randn(B, H, S, D).astype("f")
+    kv = rng.randn(B, H, S, D).astype("f")
+    vv = rng.randn(B, H, S, D).astype("f")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        q = fluid.layers.data("q", shape=[H, S, D])
+        q.stop_gradient = False
+        k = fluid.layers.data("k", shape=[H, S, D])
+        v = fluid.layers.data("v", shape=[H, S, D])
+        out = fluid.layers.flash_attention(q, k, v, scale=D ** -0.5)
+        loss = fluid.layers.reduce_sum(out)
+        grads = fluid.backward.gradients([loss], [q])
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        o, g = exe.run(main, feed={"q": qv, "k": kv, "v": vv},
+                       fetch_list=[out, grads[0]])
+    ref = _naive(jnp.asarray(qv), jnp.asarray(kv), jnp.asarray(vv), None, False)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref), rtol=1e-5)
+    ref_g = jax.grad(
+        lambda q_: jnp.sum(_naive(q_, jnp.asarray(kv), jnp.asarray(vv),
+                                  None, False)))(jnp.asarray(qv))
+    np.testing.assert_allclose(np.asarray(g), np.asarray(ref_g), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_bert_flash_path_builds_and_trains():
+    import paddle_tpu as fluid
+    from paddle_tpu.models import bert
+
+    cfg = bert.BertConfig(vocab_size=128, hidden=32, layers=1, heads=2,
+                          ffn=64, max_pos=32, dropout=0.0)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        inputs, loss = bert.build_pretrain(cfg, seq_len=16, lr=1e-3)
+    assert any(op.type == "flash_attention"
+               for op in main.global_block().ops)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    feed = {
+        "src_ids": rng.randint(0, 128, (2, 16, 1)).astype("int64"),
+        "pos_ids": np.tile(np.arange(16).reshape(1, 16, 1), (2, 1, 1)).astype("int64"),
+        "sent_ids": np.zeros((2, 16, 1), "int64"),
+        "input_mask": np.ones((2, 16, 1), "float32"),
+        "mask_pos": rng.randint(0, 32, (4,)).astype("int64"),
+        "mask_label": rng.randint(0, 128, (4, 1)).astype("int64"),
+    }
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        l0, = exe.run(main, feed=feed, fetch_list=[loss])
+        for _ in range(5):
+            l1, = exe.run(main, feed=feed, fetch_list=[loss])
+    assert float(np.asarray(l1).ravel()[0]) < float(np.asarray(l0).ravel()[0])
