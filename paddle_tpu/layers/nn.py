@@ -11,6 +11,7 @@ __all__ = [
     "fc",
     "embedding",
     "flash_attention",
+    "ring_attention",
     "dropout",
     "softmax",
     "log_softmax",
@@ -1453,5 +1454,20 @@ def flash_attention(q, k, v, bias_qk=None, causal=False, scale=0.0,
         inputs=inputs,
         outputs={"Out": [out]},
         attrs={"causal": causal, "scale": float(scale)},
+    )
+    return out
+
+
+def ring_attention(q, k, v, causal=False, scale=0.0, axis="sp", name=None):
+    """Context-parallel ring attention over mesh axis `axis` (sequence dim
+    sharded); dense flash attention when unsharded.  See
+    paddle_tpu/parallel/ring_attention.py."""
+    helper = LayerHelper("ring_attention", name=name)
+    out = helper.create_variable_for_type_inference(dtype=q.dtype)
+    helper.append_op(
+        type="ring_attention",
+        inputs={"Q": [q], "K": [k], "V": [v]},
+        outputs={"Out": [out]},
+        attrs={"causal": causal, "scale": float(scale), "axis": axis},
     )
     return out

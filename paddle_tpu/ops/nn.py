@@ -574,3 +574,28 @@ def flash_attention_op(ctx, q, k, v, bias_qk=None, causal=False, scale=0.0):
 
     sm_scale = scale if scale else None
     return _fa(q, k, v, bias=bias_qk, causal=causal, sm_scale=sm_scale)
+
+
+@register_op(
+    "ring_attention",
+    inputs=("Q", "K", "V"),
+    outputs=("Out",),
+    attrs={"causal": False, "scale": 0.0, "axis": "sp"},
+)
+def ring_attention_op(ctx, q, k, v, causal=False, scale=0.0, axis="sp"):
+    """Context-parallel attention: when lowered inside a shard_map whose
+    mesh has `axis`, runs the K/V-rotation ring (parallel/ring_attention.py)
+    with the sequence dim sharded over that axis; otherwise falls back to
+    dense flash attention (single-device semantics are identical).
+
+    NEW capability vs the reference (no CP/SP existed; SURVEY.md §5).
+    scale=0.0 means 1/sqrt(head_dim).
+    """
+    sm_scale = scale if scale else None
+    if axis in ctx.axis_names:
+        from ..parallel import ring_attention as _ring
+
+        return _ring(q, k, v, axis, causal=causal, sm_scale=sm_scale)
+    from ..pallas_kernels import flash_attention as _fa
+
+    return _fa(q, k, v, causal=causal, sm_scale=sm_scale)

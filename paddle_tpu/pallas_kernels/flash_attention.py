@@ -92,7 +92,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
     @pl.when(j == nk - 1)
     def _finish():
         l = l_scr[:, :1]
-        # fully-masked rows (l == 0) produce 0 output, not NaN
+        # NB: rows masked everywhere (finite -1e30 bias) degenerate to a
+        # uniform softmax (row max contributes p=1, so l >= 1) — output is
+        # mean(V), matching the jnp fallback's softmax-over--inf behavior;
+        # the l==0 guard is pure belt-and-braces against future NaN masks
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
         lse = m_scr[:, :1] + jnp.log(l_safe)
@@ -455,29 +458,8 @@ def _can_use_pallas(q, k, interpret):
     return True, (bq, bk), interpret
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, sm_scale, blocks, interpret):
-    out, _ = _fwd_pallas(q, k, v, None, causal, sm_scale, blocks[0],
-                         blocks[1], interpret)
-    return out
-
-
-def _flash_fwd(q, k, v, causal, sm_scale, blocks, interpret):
-    out, lse = _fwd_pallas(q, k, v, None, causal, sm_scale, blocks[0],
-                           blocks[1], interpret)
-    return out, (q, k, v, out, lse)
-
-
-def _flash_bwd(causal, sm_scale, blocks, interpret, res, do):
-    q, k, v, out, lse = res
-    dq, dk, dv = _bwd_pallas(q, k, v, None, causal, sm_scale, blocks[0],
-                             blocks[1], interpret, out, lse, do)
-    return dq, dk, dv
-
-
-_flash.defvjp(_flash_fwd, _flash_bwd)
-
-
+# bias=None routes through the same vjp (None is a valid empty pytree for a
+# differentiable argument; bwd returns None for its cotangent)
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
 def _flash_b(q, k, v, bias, causal, sm_scale, blocks, interpret):
     out, _ = _fwd_pallas(q, k, v, bias, causal, sm_scale, blocks[0],
@@ -515,6 +497,4 @@ def flash_attention(q, k, v, bias=None, causal=False, sm_scale=None,
     ok, blocks, interp = _can_use_pallas(q, k, interpret)
     if not ok:
         return _ref_attention(q, k, v, bias, causal, sm_scale)
-    if bias is None:
-        return _flash(q, k, v, causal, sm_scale, blocks, interp)
     return _flash_b(q, k, v, bias, causal, sm_scale, blocks, interp)

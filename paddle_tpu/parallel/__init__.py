@@ -1,0 +1,19 @@
+"""Sequence/context parallelism over the device mesh.
+
+NEW capability relative to the reference (SURVEY.md §5: ring attention /
+context parallelism are ABSENT in the 2019 codebase — long sequences were
+handled only by LoDTensor ragged batching).  Here they are first-class:
+
+- ring_attention: blockwise attention with K/V chunks rotating around the
+  mesh axis via lax.ppermute (ICI neighbor exchange), online-softmax
+  accumulation, O(S/P) memory per chip.
+- ulysses_attention: DeepSpeed-Ulysses-style all-to-all that swaps the
+  sequence shard for a heads shard, runs dense local attention (the Pallas
+  flash kernel when on TPU), and swaps back.
+"""
+
+from .ring_attention import (  # noqa: F401
+    ring_attention,
+    ulysses_attention,
+    make_ring_attention_sharded,
+)

@@ -26,15 +26,18 @@ def _naive(q, k, v, bias, causal):
 
 @pytest.mark.parametrize("causal", [False, True])
 @pytest.mark.parametrize("with_bias", [False, True])
-def test_fwd_kernel_interpret(causal, with_bias):
+@pytest.mark.parametrize("blocks", [(128, 128), (128, 64), (64, 128)])
+def test_fwd_kernel_interpret(causal, with_bias, blocks):
+    # unequal blocks exercise the causal clamp arithmetic the TPU heuristic
+    # actually selects (bq=512/bk=1024)
     B, H, S, D = 1, 2, 256, 64
     q, k, v = _rand((B, H, S, D), 0), _rand((B, H, S, D), 1), _rand((B, H, S, D), 2)
     bias = None
     if with_bias:
         m = (np.random.RandomState(3).rand(B, 1, 1, S) > 0.2).astype("f")
         bias = jnp.asarray(np.broadcast_to((1 - m) * -1e4, (B, 1, S, S)).copy())
-    out, lse = _fwd_pallas(q, k, v, bias, causal, D ** -0.5, 128, 128,
-                           interpret=True)
+    out, lse = _fwd_pallas(q, k, v, bias, causal, D ** -0.5, blocks[0],
+                           blocks[1], interpret=True)
     ref = _naive(q, k, v, bias, causal)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
@@ -42,18 +45,19 @@ def test_fwd_kernel_interpret(causal, with_bias):
 
 @pytest.mark.parametrize("causal", [False, True])
 @pytest.mark.parametrize("with_bias", [False, True])
-def test_bwd_kernel_interpret(causal, with_bias):
+@pytest.mark.parametrize("blocks", [(128, 128), (128, 64), (64, 128)])
+def test_bwd_kernel_interpret(causal, with_bias, blocks):
     B, H, S, D = 1, 1, 256, 64
     q, k, v = _rand((B, H, S, D), 0), _rand((B, H, S, D), 1), _rand((B, H, S, D), 2)
     bias = None
     if with_bias:
         m = (np.random.RandomState(7).rand(B, 1, 1, S) > 0.2).astype("f")
         bias = jnp.asarray(np.broadcast_to((1 - m) * -1e4, (B, 1, S, S)).copy())
-    out, lse = _fwd_pallas(q, k, v, bias, causal, D ** -0.5, 128, 128,
-                           interpret=True)
+    out, lse = _fwd_pallas(q, k, v, bias, causal, D ** -0.5, blocks[0],
+                           blocks[1], interpret=True)
     do = _rand((B, H, S, D), 4)
-    dq, dk, dv = _bwd_pallas(q, k, v, bias, causal, D ** -0.5, 128, 128,
-                             True, out, lse, do)
+    dq, dk, dv = _bwd_pallas(q, k, v, bias, causal, D ** -0.5, blocks[0],
+                             blocks[1], True, out, lse, do)
     # reference grads via jax.vjp of the naive composition
     ref_fn = lambda q_, k_, v_: _naive(q_, k_, v_, bias, causal)
     _, vjp = jax.vjp(ref_fn, q, k, v)
