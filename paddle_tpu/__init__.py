@@ -49,6 +49,10 @@ from .dataset import DatasetFactory
 from . import dataset
 from . import datasets
 from . import dygraph
+from . import metrics
+from . import profiler
+from . import parallel
+from .flags import set_flags, get_flags
 from . import reader  # DataLoader module; also re-exports the decorators
 from .reader_decorator import batch
 

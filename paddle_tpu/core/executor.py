@@ -167,11 +167,27 @@ class Executor:
 
         dev = self._jax_device(mesh)
         ctx = jax.default_device(dev) if dev is not None else contextlib.nullcontext()
-        with ctx:
+        from ..profiler import RecordEvent
+
+        with ctx, RecordEvent("Executor::Run"):
             fetches, updated = entry.jfn(feed_arrays, params_ro, params_rw, rng)
 
         for n, val in updated.items():
             scope.var(n).set(val)
+
+        from ..flags import flag as _flag
+
+        if _flag("check_nan_inf"):
+            # reference FLAGS_check_nan_inf (operator.cc:947): scan outputs;
+            # block compilation means we check fetches + updated state vars
+            for name, val in list(zip(fetch_names, fetches)) + list(
+                    updated.items()):
+                arr = np.asarray(val)
+                if np.issubdtype(arr.dtype, np.floating) and not np.isfinite(
+                        arr).all():
+                    raise RuntimeError(
+                        "Operator output contains NaN/Inf: variable %r "
+                        "(FLAGS_check_nan_inf)" % name)
 
         if return_numpy:
             return [as_numpy(f) for f in fetches]

@@ -25,7 +25,8 @@ class LowerCtx:
     """
 
     def __init__(self, rng_key=None, op=None, block=None, mesh=None,
-                 axis_names=(), mode="traced", runner=None, env=None):
+                 axis_names=(), mode="traced", runner=None, env=None,
+                 data_axis=None):
         self._rng_key = rng_key
         self._rng_n = 0
         self.op = op
@@ -33,6 +34,9 @@ class LowerCtx:
         self.mesh = mesh
         self.axis_names = tuple(axis_names)
         self.mode = mode  # "traced" | "abstract" | "eager"
+        # which mesh axis (if any) shards the BATCH dim of feeds —
+        # sequence-parallel ops must not mistake it for a sequence axis
+        self.data_axis = data_axis
         self.runner = runner  # BlockRunner for ops with sub-blocks
         # live name->value environment of the enclosing block trace; used by
         # control-flow ops (while/conditional_block) whose sub-blocks read
@@ -194,13 +198,15 @@ def _any_tracer(args):
     return False
 
 
-def run_op(op, env, rng_key, mesh=None, axis_names=(), runner=None):
+def run_op(op, env, rng_key, mesh=None, axis_names=(), runner=None,
+           data_axis=None):
     """Lower one op: gather inputs from env, call the lowering, scatter
     outputs back into env."""
     opdef = get_op_def(op.type)
     args = [_gather_slot(opdef, op, s, env) for s in opdef.input_slots]
     ctx = LowerCtx(rng_key=rng_key, op=op, block=op.block, mesh=mesh,
-                   axis_names=axis_names, runner=runner, env=env)
+                   axis_names=axis_names, runner=runner, env=env,
+                   data_axis=data_axis)
     # Constant folding at trace time: ops whose inputs are all trace-time
     # constants evaluate eagerly.  This keeps loop counters / bounds concrete
     # so `while` can unroll and tensor arrays can grow (ops/control_flow.py).
@@ -225,6 +231,22 @@ def run_op(op, env, rng_key, mesh=None, axis_names=(), runner=None):
         _scatter_slot(opdef, op, slot, val, env)
 
 
+def shard_map_compat(f, mesh, in_specs, out_specs):
+    """jax.shard_map across the API rename (new: check_vma; old
+    jax.experimental.shard_map: check_rep).  Single shim shared by the SPMD
+    executor and paddle_tpu.parallel."""
+    try:
+        from jax import shard_map as _new
+
+        return _new(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                    check_vma=False)
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _old
+
+        return _old(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                    check_rep=False)
+
+
 def has_collective_ops(block):
     """True if the block contains program-level collectives (fleet/transpiler
     path) that require manual SPMD (shard_map) execution."""
@@ -243,19 +265,6 @@ def build_spmd_block_fn(plan, mesh, axis="data"):
     """
     from jax.sharding import PartitionSpec as P
 
-    try:
-        from jax import shard_map as _new_shard_map
-
-        def _shard_map(f, mesh, in_specs, out_specs):
-            return _new_shard_map(f, mesh=mesh, in_specs=in_specs,
-                                  out_specs=out_specs, check_vma=False)
-    except ImportError:
-        from jax.experimental.shard_map import shard_map as _old_shard_map
-
-        def _shard_map(f, mesh, in_specs, out_specs):
-            return _old_shard_map(f, mesh=mesh, in_specs=in_specs,
-                                  out_specs=out_specs, check_rep=False)
-
     block = plan.block
     fetch_names = plan.fetch_names
     persist_written = plan.persist_written
@@ -270,7 +279,8 @@ def build_spmd_block_fn(plan, mesh, axis="data"):
             key = None
             if rng is not None:
                 key = jax.random.fold_in(jax.random.fold_in(rng, i), rank)
-            run_op(op, env, key, mesh=mesh, axis_names=(axis,))
+            run_op(op, env, key, mesh=mesh, axis_names=(axis,),
+                   data_axis=axis)
         fetches = [env[n] for n in fetch_names]
         updated = {n: env[n] for n in persist_written if n in env}
         return fetches, updated
@@ -293,7 +303,7 @@ def build_spmd_block_fn(plan, mesh, axis="data"):
         # reference's DP, where device-0's copy is the one saved
         # (parallel_executor.cc BCastParamsToDevices / save from scope 0).
         out_specs = ([P(axis)] * len(fetch_names), {n: P() for n in persist_written})
-        sm = _shard_map(
+        sm = shard_map_compat(
             local,
             mesh,
             (feed_specs, param_ro_specs, param_rw_specs, P()),

@@ -120,8 +120,24 @@ class Tracer:
 
         rng_key = self._next_key() if opdef.n_rng else None
         ctx = LowerCtx(rng_key=rng_key, op=op, block=block, mode="eager")
-        out = opdef.lower(ctx, *args, **_lower_attrs(op.attrs))
+        from ..profiler import RecordEvent
+
+        with RecordEvent(type):
+            out = opdef.lower(ctx, *args, **_lower_attrs(op.attrs))
         out = _normalize_outputs(opdef, out)
+
+        from ..flags import flag as _flag
+
+        if _flag("check_nan_inf"):
+            for slot, val in zip(opdef.output_slots, out):
+                for item in (val if isinstance(val, (list, tuple)) else [val]):
+                    if item is None:
+                        continue
+                    a = np.asarray(item)
+                    if np.issubdtype(a.dtype, np.floating) and not np.isfinite(a).all():
+                        raise RuntimeError(
+                            "NaN/Inf in output %s of op %s "
+                            "(FLAGS_check_nan_inf)" % (slot, type))
 
         # does any differentiable input require grad?
         requires = False

@@ -1,0 +1,79 @@
+"""Runtime flags (analog of the reference's gflags surface,
+paddle/fluid/platform/flags.cc + __bootstrap__ env reading in
+python/paddle/fluid/__init__.py:132-220 + the pybind
+global_value_getter_setter).
+
+Flags whose semantics dissolve into XLA/PJRT (allocator strategy, GPU memory
+fractions, eager deletion thresholds) are accepted as inert for API
+compatibility; behavioral ones (check_nan_inf, benchmark) are honored by the
+executor/dygraph paths.
+"""
+
+import os
+
+__all__ = ["set_flags", "get_flags"]
+
+_DEFAULTS = {
+    # honored
+    "FLAGS_check_nan_inf": False,       # flags.cc:44 — scan outputs for NaN/Inf
+    # accepted no-ops (XLA/PJRT owns these concerns; benchmark's per-op
+    # sync has no meaning under whole-block compilation)
+    "FLAGS_benchmark": False,
+    "FLAGS_allocator_strategy": "auto_growth",
+    "FLAGS_eager_delete_tensor_gb": 0.0,
+    "FLAGS_fraction_of_gpu_memory_to_use": 0.92,
+    "FLAGS_fuse_parameter_memory_size": -1,
+    "FLAGS_cudnn_deterministic": False,
+    "FLAGS_enable_parallel_graph": False,
+    "FLAGS_use_system_allocator": False,
+}
+
+_flags = {}
+
+
+def _coerce(cur_default, value):
+    if isinstance(cur_default, bool):
+        if isinstance(value, str):
+            return value.lower() in ("1", "true", "yes")
+        return bool(value)
+    if isinstance(cur_default, float):
+        return float(value)
+    if isinstance(cur_default, int):
+        return int(value)
+    return value
+
+
+def _init_from_env():
+    for k, dflt in _DEFAULTS.items():
+        env = os.environ.get(k)
+        _flags[k] = _coerce(dflt, env) if env is not None else dflt
+
+
+_init_from_env()
+
+
+def _norm(name):
+    return name if name.startswith("FLAGS_") else "FLAGS_" + name
+
+
+def set_flags(flags):
+    """fluid.set_flags({'FLAGS_check_nan_inf': True}).  Unknown names raise
+    (matching the reference's gflags registry check) so typos can't silently
+    disable a debug flag."""
+    for k, v in flags.items():
+        k = _norm(k)
+        if k not in _DEFAULTS:
+            raise ValueError(
+                "unknown flag %r (known: %s)" % (k, ", ".join(sorted(_DEFAULTS))))
+        _flags[k] = _coerce(_DEFAULTS[k], v)
+
+
+def get_flags(names):
+    if isinstance(names, str):
+        names = [names]
+    return {(_norm(n)): _flags.get(_norm(n)) for n in names}
+
+
+def flag(name):
+    """Internal fast read."""
+    return _flags.get(_norm(name))

@@ -584,15 +584,21 @@ def flash_attention_op(ctx, q, k, v, bias_qk=None, causal=False, scale=0.0):
 )
 def ring_attention_op(ctx, q, k, v, causal=False, scale=0.0, axis="sp"):
     """Context-parallel attention: when lowered inside a shard_map whose
-    mesh has `axis`, runs the K/V-rotation ring (parallel/ring_attention.py)
-    with the sequence dim sharded over that axis; otherwise falls back to
-    dense flash attention (single-device semantics are identical).
+    mesh has `axis` sharding the SEQUENCE dim, runs the K/V-rotation ring
+    (parallel/ring_attention.py); otherwise falls back to dense flash
+    attention (single-device semantics are identical).
 
     NEW capability vs the reference (no CP/SP existed; SURVEY.md §5).
     scale=0.0 means 1/sqrt(head_dim).
+
+    The batch-DP executor shards feeds on dim 0 over ctx.data_axis — that
+    axis must NOT trigger the ring (each rank already holds full sequences;
+    treating batch shards as sequence chunks would be silently wrong).  The
+    ring engages only for a distinct sequence axis, i.e. under a
+    seq-sharded shard_map such as parallel.make_ring_attention_sharded.
     """
     sm_scale = scale if scale else None
-    if axis in ctx.axis_names:
+    if axis in ctx.axis_names and axis != ctx.data_axis:
         from ..parallel import ring_attention as _ring
 
         return _ring(q, k, v, axis, causal=causal, sm_scale=sm_scale)

@@ -75,14 +75,19 @@ def ring_attention(q, k, v, axis_name, causal=False, sm_scale=None):
         m, l, acc = _chunk_attn_update(
             q, kc, vc, sm_scale, m, l, acc,
             q_off=r * Sq, k_off=owner * Sk, causal=causal)
-        # rotate chunks to the next rank (neighbor ICI exchange); after the
-        # final step the chunks have completed the ring and are home again
+        # rotate chunks to the next rank (neighbor ICI exchange)
         kc = lax.ppermute(kc, axis_name, perm)
         vc = lax.ppermute(vc, axis_name, perm)
         return (m, l, acc, kc, vc), None
 
-    (m, l, acc, _, _), _ = lax.scan(
-        step, (m0, l0, acc0, k, v), jnp.arange(P))
+    # last chunk is peeled out of the scan so the final (dead) rotation —
+    # a full K+V neighbor transfer — is never issued
+    (m, l, acc, kc, vc), _ = lax.scan(
+        step, (m0, l0, acc0, k, v), jnp.arange(P - 1))
+    owner_last = (r - (P - 1)) % P
+    m, l, acc = _chunk_attn_update(
+        q, kc, vc, sm_scale, m, l, acc,
+        q_off=r * Sq, k_off=owner_last * Sk, causal=causal)
     l_safe = jnp.where(l == 0.0, 1.0, l)
     return (acc / l_safe).astype(q.dtype)
 
@@ -127,18 +132,7 @@ def make_ring_attention_sharded(mesh, axis_name="sp", causal=False,
     the shard_map'ed region."""
     from jax.sharding import PartitionSpec as P
 
-    try:
-        from jax import shard_map as _sm
-
-        def _shard_map(f, in_specs, out_specs):
-            return _sm(f, mesh=mesh, in_specs=in_specs,
-                       out_specs=out_specs, check_vma=False)
-    except ImportError:  # older jax
-        from jax.experimental.shard_map import shard_map as _osm
-
-        def _shard_map(f, in_specs, out_specs):
-            return _osm(f, mesh=mesh, in_specs=in_specs,
-                        out_specs=out_specs, check_rep=False)
+    from ..core.lowering import shard_map_compat
 
     spec = P(None, None, axis_name, None)
     fn = ring_attention if impl == "ring" else ulysses_attention
@@ -146,4 +140,4 @@ def make_ring_attention_sharded(mesh, axis_name="sp", causal=False,
     def per_shard(q, k, v):
         return fn(q, k, v, axis_name, causal=causal, sm_scale=sm_scale)
 
-    return _shard_map(per_shard, (spec, spec, spec), spec)
+    return shard_map_compat(per_shard, mesh, (spec, spec, spec), spec)

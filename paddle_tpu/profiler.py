@@ -1,0 +1,160 @@
+"""Profiler: host event recording + chrome-trace export + device tracing.
+
+TPU-native analog of the reference's profiler stack
+(paddle/fluid/platform/profiler.h:81 RecordEvent, :166 Enable/DisableProfiler;
+python/paddle/fluid/profiler.py:228 profiler context manager; CUPTI device
+tracing in platform/device_tracer.h; tools/timeline.py chrome-trace export).
+
+Host events come from RAII `RecordEvent` scopes placed on the executor and
+dygraph hot paths (zero-cost when disabled, mirroring the
+`IsProfileEnabled()` guard at operator.cc:162-171).  Device-side profiling
+delegates to jax.profiler (XPlane/TensorBoard) — the TPU replacement for
+CUPTI.  `save_chrome_trace` writes the host timeline in the same
+chrome://tracing JSON format timeline.py produced.
+"""
+
+import contextlib
+import json
+import threading
+import time
+
+__all__ = [
+    "RecordEvent", "profiler", "start_profiler", "stop_profiler",
+    "reset_profiler", "save_chrome_trace", "cuda_profiler",
+]
+
+_enabled = False
+_events = []  # (name, tid, start_us, dur_us)
+_lock = threading.Lock()
+_device_trace_dir = None
+
+
+def is_profiler_enabled():
+    return _enabled
+
+
+class RecordEvent:
+    """RAII host event (platform/profiler.h:81).  Usable as a context
+    manager or via push/pop."""
+
+    __slots__ = ("name", "_t0")
+
+    def __init__(self, name):
+        self.name = name
+        self._t0 = None
+
+    def __enter__(self):
+        if _enabled:
+            self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        if self._t0 is not None:
+            dur = (time.perf_counter_ns() - self._t0) // 1000
+            with _lock:
+                _events.append((self.name, threading.get_ident(),
+                                self._t0 // 1000, dur))
+        return False
+
+
+def reset_profiler():
+    with _lock:
+        _events.clear()
+
+
+def start_profiler(state="All", tracer_option=None, device_trace_dir=None):
+    """state: CPU | GPU | All (kept for API parity; host events always on,
+    device tracing via jax.profiler when device_trace_dir is given)."""
+    global _enabled, _device_trace_dir
+    _enabled = True
+    if device_trace_dir:
+        import jax
+
+        jax.profiler.start_trace(device_trace_dir)
+        _device_trace_dir = device_trace_dir
+
+
+def stop_profiler(sorted_key=None, profile_path=None):
+    """Print the event summary (reference profiler's table) and optionally
+    dump the chrome trace to `profile_path`."""
+    global _enabled, _device_trace_dir
+    _enabled = False
+    if _device_trace_dir:
+        import jax
+
+        jax.profiler.stop_trace()
+        _device_trace_dir = None
+    if profile_path:
+        save_chrome_trace(profile_path)
+    _print_summary(sorted_key)
+
+
+def _print_summary(sorted_key=None):
+    with _lock:
+        events = list(_events)
+    agg = {}
+    for name, _, _, dur in events:
+        tot, cnt, mn, mx = agg.get(name, (0, 0, float("inf"), 0))
+        agg[name] = (tot + dur, cnt + 1, min(mn, dur), max(mx, dur))
+    rows = [
+        (name, cnt, tot / 1e3, (tot / cnt) / 1e3, mn / 1e3, mx / 1e3)
+        for name, (tot, cnt, mn, mx) in agg.items()
+    ]
+    keyfns = {
+        None: lambda r: -r[2], "default": lambda r: -r[2],
+        "calls": lambda r: -r[1], "total": lambda r: -r[2],
+        "ave": lambda r: -r[3], "min": lambda r: r[4], "max": lambda r: -r[5],
+    }
+    if sorted_key not in keyfns:
+        raise ValueError(
+            "sorted_key must be one of %s, got %r"
+            % (sorted(k for k in keyfns if k), sorted_key))
+    rows.sort(key=keyfns[sorted_key])
+    if not rows:
+        print("profiler: no events recorded")
+        return
+    print("%-40s %8s %12s %10s %10s %10s"
+          % ("Event", "Calls", "Total(ms)", "Ave(ms)", "Min(ms)", "Max(ms)"))
+    for r in rows:
+        print("%-40s %8d %12.3f %10.3f %10.3f %10.3f" % r)
+
+
+def save_chrome_trace(path):
+    """chrome://tracing JSON (tools/timeline.py:131 analog)."""
+    with _lock:
+        events = list(_events)
+    trace = {
+        "traceEvents": [
+            {"name": name, "ph": "X", "pid": 0, "tid": tid,
+             "ts": ts, "dur": dur, "cat": "host"}
+            for name, tid, ts, dur in events
+        ]
+    }
+    with open(path, "w") as f:
+        json.dump(trace, f)
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path=None,
+             tracer_option=None, device_trace_dir=None):
+    """`with fluid.profiler.profiler('All', 'total', '/tmp/profile.json'):`"""
+    if sorted_key not in (None, "default", "calls", "total", "ave", "min",
+                          "max"):
+        # fail before running the profiled body, not from the finally block
+        raise ValueError("invalid sorted_key %r" % (sorted_key,))
+    start_profiler(state, tracer_option, device_trace_dir)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+@contextlib.contextmanager
+def cuda_profiler(*a, **k):
+    """API-parity shim (profiler.py cuda_profiler): no CUDA on TPU builds;
+    behaves as the generic profiler."""
+    start_profiler()
+    try:
+        yield
+    finally:
+        stop_profiler()
