@@ -53,6 +53,8 @@ from . import metrics
 from . import profiler
 from . import parallel
 from .flags import set_flags, get_flags
+from . import inference
+from .inference import AnalysisConfig, create_paddle_predictor
 from . import reader  # DataLoader module; also re-exports the decorators
 from .reader_decorator import batch
 

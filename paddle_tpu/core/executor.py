@@ -34,6 +34,9 @@ def global_scope():
 
 
 import contextlib
+import threading
+
+_RNG_COUNTER_LOCK = threading.Lock()
 
 
 @contextlib.contextmanager
@@ -155,10 +158,14 @@ class Executor:
         for n in plan.rw_names:
             params_rw[n] = self._scope_value(scope, n, block)
 
-        # deterministic functional PRNG: (program seed, per-scope step counter)
+        # deterministic functional PRNG: (program seed, per-scope step
+        # counter).  Locked: pipeline section workers run concurrently
+        # against one scope and must never draw the same key.
         seed = program.random_seed or 0
-        rng = jax.random.fold_in(jax.random.key(seed), scope._rng_counter)
-        scope._rng_counter += 1
+        with _RNG_COUNTER_LOCK:
+            counter = scope._rng_counter
+            scope._rng_counter = counter + 1
+        rng = jax.random.fold_in(jax.random.key(seed), counter)
 
         if mesh is not None:
             feed_arrays = self._shard_feeds(feed_arrays, mesh, data_axis)

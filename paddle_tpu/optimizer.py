@@ -41,6 +41,7 @@ __all__ = [
     "ModelAverage",
     "RecomputeOptimizer",
     "LookaheadOptimizer",
+    "PipelineOptimizer",
 ]
 
 
@@ -749,3 +750,128 @@ RMSProp = RMSPropOptimizer
 Ftrl = FtrlOptimizer
 Lamb = LambOptimizer
 LarsMomentum = LarsMomentumOptimizer
+
+
+class PipelineOptimizer:
+    """Pipeline-parallel training (reference optimizer.py:3103
+    PipelineOptimizer + framework/pipeline_trainer.cc SectionWorker).
+
+    Splits the trained program at `cut_list` boundaries into sections, each
+    assigned a place from `place_list` (e.g. CPUPlace for the embedding/IO
+    stage, TPUPlace for the dense stage — the reference's CTR pipeline
+    shape).  Execution is the host-queue scheduler in trainer.py: one
+    worker thread per section, microbatches flowing through native blocking
+    queues, parameters updated per-microbatch in the shared scope (the
+    reference's async SectionWorker semantics).  Entered via
+    ``exe.train_from_dataset`` exactly like the reference
+    (PipelineTrainer).
+
+    TPU note: within one process a single chip serializes device sections;
+    the win is overlapping host (parse/embedding/CPU math) stages with the
+    compiled XLA stage.  Multi-chip GPipe-style stage sharding over a mesh
+    is the transpiler-level roadmap item, not this class.
+    """
+
+    def __init__(self, optimizer, cut_list=None, place_list=None,
+                 concurrency_list=None, queue_size=30, sync_steps=1,
+                 start_cpu_core_id=0):
+        self._optimizer = optimizer
+        self._cut_list = cut_list or []
+        self._place_list = place_list or []
+        self._concurrency_list = concurrency_list
+        self._queue_size = queue_size
+        self._sync_steps = sync_steps
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        program = loss.block.program
+        opt_ops, params_grads = self._optimizer.minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+        self._split_program(program)
+        return opt_ops, params_grads
+
+    # -- splitting -----------------------------------------------------------
+    def _split_program(self, program):
+        block = program.global_block()
+        ops = list(block.ops)
+
+        # section boundaries: a section closes once every cut var of its
+        # boundary has been produced
+        bounds = []
+        cut_idx = 0
+        pending = (set(v.name for v in self._cut_list[cut_idx])
+                   if cut_idx < len(self._cut_list) else None)
+        start = 0
+        for i, op in enumerate(ops):
+            if pending is None:
+                continue
+            pending -= set(op.output_arg_names)
+            if not pending:
+                bounds.append((start, i + 1))
+                start = i + 1
+                cut_idx += 1
+                pending = (set(v.name for v in self._cut_list[cut_idx])
+                           if cut_idx < len(self._cut_list) else None)
+        if pending:
+            raise ValueError(
+                "PipelineOptimizer: cut vars %s are never produced by any "
+                "op — check cut_list (data vars and typos cannot be cut "
+                "points)" % sorted(pending))
+        bounds.append((start, len(ops)))
+
+        def is_persistable(name):
+            v = block._find_var_recursive(name)
+            return v is not None and v.persistable
+
+        def is_data(name):
+            v = block._find_var_recursive(name)
+            return v is not None and v.is_data
+
+        produced = [
+            set(n for op in ops[s:e] for n in op.output_arg_names if n)
+            for s, e in bounds
+        ]
+        reads = [
+            set(n for op in ops[s:e] for n in op.input_arg_names
+                if n and not is_persistable(n))
+            for s, e in bounds
+        ]
+        K = len(bounds)
+
+        def carry_into(i):
+            """Names section i must receive from upstream."""
+            out = set()
+            for j in range(i, K):
+                for n in reads[j]:
+                    made_before = any(n in produced[t] for t in range(i))
+                    made_between = any(n in produced[t] for t in range(i, j))
+                    if made_between:
+                        continue
+                    if made_before or (is_data(n) and i > 0):
+                        out.add(n)
+                    elif is_data(n) and i == 0:
+                        out.add(n)  # dataset feeds enter at section 0
+            return out
+
+        sections = []
+        for i, (s, e) in enumerate(bounds):
+            sec_prog = program.clone()
+            sb = sec_prog.global_block()
+            sb.ops = sb.ops[s:e]
+            sec_prog._bump_version()
+            in_names = sorted(carry_into(i))
+            out_names = sorted(carry_into(i + 1)) if i + 1 < K else []
+            place = (self._place_list[i] if i < len(self._place_list)
+                     else None)
+            sections.append({
+                "program": sec_prog,
+                "place": place,
+                "in_names": in_names,
+                "out_names": out_names,
+            })
+        program._pipeline_opt = {
+            "sections": sections,
+            "queue_size": self._queue_size,
+            "sync_steps": self._sync_steps,
+        }
+        return sections

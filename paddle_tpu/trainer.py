@@ -95,8 +95,6 @@ def _run_loop(exe, program, dataset, scope, thread, fetch_list, fetch_info,
                 arrs = queue.pop()
             except QueueClosed:
                 break
-            if arrs is None:
-                break
             feed = dict(zip(names, arrs))
             out = exe.run(program, feed=feed, fetch_list=fetch_list,
                           scope=scope)
@@ -116,8 +114,116 @@ def _run_loop(exe, program, dataset, scope, thread, fetch_list, fetch_info,
     return results
 
 
+def _pipeline_train(exe, program, dataset, scope, fetch_list, fetch_info,
+                    print_period):
+    """Host-queue pipeline scheduler (reference PipelineTrainer +
+    SectionWorker, framework/pipeline_trainer.cc:24, section_worker.cc:141):
+    one worker thread per section, microbatch feed dicts flowing through
+    native blocking queues, sections running on their own places against
+    the SHARED scope (per-microbatch param updates, the reference's async
+    section semantics)."""
+    from .core.executor import Executor, global_scope
+    from .native.queue import NativeBlockingQueue, QueueClosed
+
+    popt = program._pipeline_opt
+    sections = popt["sections"]
+    scope = scope or global_scope()
+    fetch_list = fetch_list or []
+    fetch_info = fetch_info or [getattr(v, "name", str(v)) for v in fetch_list]
+    qsize = max(int(popt.get("queue_size", 30)), 2)
+    queues = [NativeBlockingQueue(capacity=qsize) for _ in sections]
+
+    results = []
+    stats = {"step": 0, "t0": time.time()}
+    errors = []
+
+    def abort():
+        # unblock every producer AND consumer so join() can't deadlock on a
+        # failed stage (push/pop block indefinitely otherwise)
+        for q in queues:
+            q.kill()
+
+    def feeder():
+        names = sections[0]["in_names"]
+        try:
+            for feed in dataset._iter_batches(drop_last=True):
+                try:
+                    queues[0].push([feed[n] for n in names])
+                except QueueClosed:
+                    return
+        except Exception as e:
+            errors.append(e)
+            abort()
+        finally:
+            queues[0].close()
+
+    def section_worker(i):
+        sec = sections[i]
+        place = sec["place"]
+        sec_exe = Executor(place) if place is not None else exe
+        in_names, out_names = sec["in_names"], sec["out_names"]
+        last = i == len(sections) - 1
+        # names this section itself (re)produces must be fetched, never
+        # forwarded from the incoming feed (stale pre-section values)
+        produced_here = set(
+            n for op in sec["program"].global_block().ops
+            for n in op.output_arg_names if n)
+        try:
+            while True:
+                try:
+                    arrs = queues[i].pop()
+                except QueueClosed:
+                    break
+                feed = dict(zip(in_names, arrs))
+                fetches = fetch_list if last else [
+                    n for n in out_names if n in produced_here]
+                out = sec_exe.run(sec["program"], feed=feed,
+                                  fetch_list=fetches, scope=scope)
+                if last:
+                    stats["step"] += 1
+                    if fetch_list:
+                        results[:] = out
+                        if print_period and stats["step"] % print_period == 0:
+                            vals = ", ".join(
+                                "%s=%s" % (info, np.asarray(v).reshape(-1)[:1])
+                                for info, v in zip(fetch_info, out))
+                            print("[pipeline] step %d (%.1f steps/s): %s" % (
+                                stats["step"],
+                                stats["step"] / max(time.time() - stats["t0"],
+                                                    1e-9), vals))
+                else:
+                    produced = dict(zip(fetches, out))
+                    try:
+                        queues[i + 1].push([
+                            produced[n] if n in produced else feed[n]
+                            for n in out_names])
+                    except QueueClosed:
+                        break
+        except Exception as e:  # propagate worker failures to the driver
+            errors.append(e)
+            abort()
+        finally:
+            if not last:
+                queues[i + 1].close()
+
+    threads = [threading.Thread(target=feeder, daemon=True)]
+    threads += [threading.Thread(target=section_worker, args=(i,), daemon=True)
+                for i in range(len(sections))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    abort()
+    if errors:
+        raise errors[0]
+    return results
+
+
 def train_from_dataset(exe, program, dataset, scope, thread, fetch_list,
                        fetch_info, print_period):
+    if getattr(program, "_pipeline_opt", None):
+        return _pipeline_train(exe, program, dataset, scope, fetch_list,
+                               fetch_info, print_period)
     return _run_loop(exe, program, dataset, scope, thread, fetch_list,
                      fetch_info, print_period, train=True)
 

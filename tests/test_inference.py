@@ -1,0 +1,98 @@
+"""AnalysisPredictor / AnalysisConfig inference engine tests
+(reference: paddle/fluid/inference/tests/api/ patterns)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.inference import (AnalysisConfig, PaddleTensor,
+                                  create_paddle_predictor)
+
+
+@pytest.fixture()
+def saved_model(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8])
+        h = fluid.layers.fc(x, 16, act="relu")
+        out = fluid.layers.fc(h, 4, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.save_inference_model(str(tmp_path / "model"), ["x"], [out], exe,
+                                   main_program=main)
+        xv = np.random.RandomState(0).rand(3, 8).astype("f")
+        want, = exe.run(main, feed={"x": xv}, fetch_list=[out])
+    return str(tmp_path / "model"), xv, np.asarray(want)
+
+
+def test_paddle_tensor_run(saved_model):
+    dirname, xv, want = saved_model
+    cfg = AnalysisConfig(dirname)
+    cfg.disable_gpu()
+    pred = create_paddle_predictor(cfg)
+    outs = pred.run([PaddleTensor(xv, name="x")])
+    np.testing.assert_allclose(outs[0].as_ndarray(), want, rtol=1e-5)
+
+
+def test_zero_copy_run(saved_model):
+    dirname, xv, want = saved_model
+    cfg = AnalysisConfig(dirname)
+    cfg.disable_gpu()
+    pred = create_paddle_predictor(cfg)
+    assert pred.get_input_names() == ["x"]
+    inp = pred.get_input_tensor("x")
+    inp.copy_from_cpu(xv)
+    pred.zero_copy_run()
+    out = pred.get_output_tensor(pred.get_output_names()[0])
+    np.testing.assert_allclose(out.copy_to_cpu(), want, rtol=1e-5)
+    # errors
+    with pytest.raises(RuntimeError):
+        inp.copy_to_cpu()
+    with pytest.raises(KeyError):
+        pred.get_input_tensor("nope")
+
+
+def test_clone_shares_params(saved_model):
+    dirname, xv, want = saved_model
+    cfg = AnalysisConfig(dirname)
+    cfg.disable_gpu()
+    pred = create_paddle_predictor(cfg)
+    clone = pred.clone()
+    assert clone._scope is pred._scope
+    outs = clone.run([PaddleTensor(xv, name="x")])
+    np.testing.assert_allclose(outs[0].as_ndarray(), want, rtol=1e-5)
+
+
+def test_repeated_runs_use_cache(saved_model):
+    dirname, xv, _ = saved_model
+    cfg = AnalysisConfig(dirname)
+    cfg.disable_gpu()
+    pred = create_paddle_predictor(cfg)
+    r1 = pred.run([PaddleTensor(xv, name="x")])[0].as_ndarray()
+    for _ in range(3):
+        r2 = pred.run([PaddleTensor(xv, name="x")])[0].as_ndarray()
+    np.testing.assert_allclose(r1, r2)
+    assert len(pred._exe._cache) == 1  # one compiled executable
+
+
+def test_two_file_config_form(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        out = fluid.layers.fc(x, 2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.save_inference_model(
+            str(tmp_path / "m2"), ["x"], [out], exe, main_program=main,
+            model_filename="model.json", params_filename="params.npz")
+        xv = np.ones((2, 4), "f")
+        want, = exe.run(main, feed={"x": xv}, fetch_list=[out])
+    cfg = AnalysisConfig(str(tmp_path / "m2" / "model.json"),
+                         str(tmp_path / "m2" / "params.npz"))
+    cfg.disable_gpu()
+    pred = create_paddle_predictor(cfg)
+    got = pred.run([PaddleTensor(xv, name="x")])[0].as_ndarray()
+    np.testing.assert_allclose(got, np.asarray(want), rtol=1e-5)

@@ -1,0 +1,141 @@
+"""PipelineOptimizer tests (reference: test_pipeline.py pattern —
+optimizer.py:3103 PipelineOptimizer + pipeline_trainer.cc).
+
+A 2-section pipeline: section 0 (embedding-ish fc) on CPUPlace feeding
+section 1 (head + loss + sgd) — split correctness, queue scheduling, and
+loss improvement over the dataset."""
+
+import os
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _write_multislot(dirname, n=64, seed=0):
+    """Two slots: 4 floats + 1 int label, the MultiSlot text format."""
+    rng = np.random.RandomState(seed)
+    path = os.path.join(dirname, "pipe_data.txt")
+    with open(path, "w") as f:
+        for _ in range(n):
+            xs = rng.rand(4)
+            y = int(xs.sum() > 2.0)
+            f.write("4 " + " ".join("%.6f" % v for v in xs) +
+                    " 1 %d\n" % y)
+    return path
+
+
+def _build(cut_on):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        y = fluid.layers.data("y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, 8, act="relu")
+        logits = fluid.layers.fc(h, 2)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        opt = fluid.optimizer.PipelineOptimizer(
+            fluid.optimizer.SGD(0.1),
+            cut_list=[[h]] if cut_on else [],
+            place_list=[fluid.CPUPlace(), fluid.CPUPlace()],
+            queue_size=4)
+        opt.minimize(loss)
+    return main, startup, x, y, h, loss
+
+
+def test_split_structure():
+    main, startup, x, y, h, loss = _build(cut_on=True)
+    popt = main._pipeline_opt
+    secs = popt["sections"]
+    assert len(secs) == 2
+    # section 0 consumes the data var x and produces the cut var h
+    assert "x" in secs[0]["in_names"]
+    assert h.name in secs[0]["out_names"]
+    # label y crosses sections untouched; section 1 needs h and y
+    assert "y" in secs[0]["in_names"] and "y" in secs[1]["in_names"]
+    assert h.name in secs[1]["in_names"]
+    assert secs[1]["out_names"] == []
+    # no op lost or duplicated in the split
+    n_ops = sum(len(s["program"].global_block().ops) for s in secs)
+    assert n_ops == len(main.global_block().ops)
+    # backward of section-0 ops lands in section 1 (produced after the cut)
+    types1 = [op.type for op in secs[1]["program"].global_block().ops]
+    assert any(t == "sgd" for t in types1)
+
+
+def test_pipeline_trains(tmp_path):
+    path = _write_multislot(str(tmp_path), n=64)
+    main, startup, x, y, h, loss = _build(cut_on=True)
+
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(16)
+    ds.set_filelist([path])
+    ds.set_use_var([x, y])
+    ds.load_into_memory()
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        first = None
+        for epoch in range(6):
+            out = exe.train_from_dataset(
+                main, ds, fetch_list=[loss], fetch_info=["loss"],
+                print_period=0)
+            val = float(np.asarray(out[0]).ravel()[0])
+            if first is None:
+                first = val
+        assert np.isfinite(val)
+        assert val < first, (first, val)
+
+
+def test_single_section_degenerates_to_plain_loop(tmp_path):
+    path = _write_multislot(str(tmp_path), n=32)
+    main, startup, x, y, h, loss = _build(cut_on=False)
+    assert len(main._pipeline_opt["sections"]) == 1
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(8)
+    ds.set_filelist([path])
+    ds.set_use_var([x, y])
+    ds.load_into_memory()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        out = exe.train_from_dataset(main, ds, fetch_list=[loss],
+                                     fetch_info=["loss"], print_period=0)
+        assert np.isfinite(float(np.asarray(out[0]).ravel()[0]))
+
+
+def test_unproducible_cut_var_raises():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        h = fluid.layers.fc(x, 4)
+        loss = fluid.layers.mean(h)
+        opt = fluid.optimizer.PipelineOptimizer(
+            fluid.optimizer.SGD(0.1), cut_list=[[x]])  # data var: never produced
+        try:
+            opt.minimize(loss)
+        except ValueError as e:
+            assert "never produced" in str(e)
+        else:
+            raise AssertionError("expected ValueError for bad cut var")
+
+
+def test_failing_section_raises_not_hangs(tmp_path):
+    # a section whose feed name is missing from the dataset must raise
+    # promptly (not deadlock the queue scheduler)
+    path = _write_multislot(str(tmp_path), n=32)
+    main, startup, x, y, h, loss = _build(cut_on=True)
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(8)
+    ds.set_filelist([path])
+    ds.set_use_var([x])  # y missing -> feeder KeyError
+    ds.load_into_memory()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        import pytest
+        with pytest.raises(KeyError):
+            exe.train_from_dataset(main, ds, fetch_list=[loss],
+                                   fetch_info=["loss"], print_period=0)
