@@ -38,6 +38,7 @@ from . import clip
 from . import backward
 from . import contrib
 from . import transpiler
+from .transpiler import DistributeTranspiler, DistributeTranspilerConfig
 from . import incubate
 from . import distributed
 from . import unique_name_compat as unique_name  # noqa: F401
@@ -69,6 +70,65 @@ def data(name, shape, dtype="float32", lod_level=0):
     )
 
 
-class DataFeedDesc:  # placeholder until dataset/trainer path lands
+class DataFeedDesc:
+    """Parsed data-feed description (reference data_feed.proto +
+    python/paddle/fluid/data_feed_desc.py).  Reads the prototxt slot config
+    the reference uses (name/type/dense flags under multi_slot_desc) into a
+    plain object the Dataset facade consumes."""
+
     def __init__(self, proto_file=None):
         self.proto_file = proto_file
+        self.batch_size = 32
+        self.pipe_command = "cat"
+        self.slots = []  # [{"name","type","is_dense","is_used"}]
+        if proto_file:
+            self._parse(proto_file)
+
+    def _parse(self, path):
+        import re
+
+        text = open(path).read()
+        self.slots = []
+        for m in re.finditer(r"slots\s*\{([^}]*)\}", text):
+            body = m.group(1)
+
+            def field(key, default=None):
+                fm = re.search(r"%s\s*:\s*(\S+)" % key, body)
+                return fm.group(1).strip('"') if fm else default
+
+            self.slots.append({
+                "name": field("name", ""),
+                "type": field("type", "float"),
+                "is_dense": field("is_dense", "false") == "true",
+                "is_used": field("is_used", "false") == "true",
+            })
+        bm = re.search(r"batch_size\s*:\s*(\d+)", text)
+        if bm:
+            self.batch_size = int(bm.group(1))
+
+    def set_batch_size(self, bs):
+        self.batch_size = int(bs)
+
+    def set_dense_slots(self, names):
+        for s in self.slots:
+            if s["name"] in names:
+                s["is_dense"] = True
+
+    def set_use_slots(self, names):
+        for s in self.slots:
+            if s["name"] in names:
+                s["is_used"] = True
+
+    def desc(self):
+        lines = ["batch_size: %d" % self.batch_size,
+                 'pipe_command: "%s"' % self.pipe_command,
+                 "multi_slot_desc {"]
+        for s in self.slots:
+            lines += ["  slots {",
+                      '    name: "%s"' % s["name"],
+                      '    type: "%s"' % s["type"],
+                      "    is_dense: %s" % str(s["is_dense"]).lower(),
+                      "    is_used: %s" % str(s["is_used"]).lower(),
+                      "  }"]
+        lines.append("}")
+        return "\n".join(lines)

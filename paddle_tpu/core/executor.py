@@ -80,6 +80,11 @@ class Executor:
         self._cache = {}
 
     def close(self):
+        """Release cached executables and notify pservers this trainer is
+        done (reference Executor::Close -> SendComplete, executor.cc:110)."""
+        for comm in getattr(self, "_ps_comms", []):
+            comm.complete()
+        self._ps_comms = []
         self._cache.clear()
 
     # -- main entry ----------------------------------------------------------
@@ -99,6 +104,30 @@ class Executor:
         scope = scope if scope is not None else global_scope()
         fetch_list = fetch_list or []
         fetch_names = [_fetch_name(f) for f in fetch_list]
+
+        # parameter-server program: block in the server loop
+        # (listen_and_serv_op.cc:110 RunSyncLoop analog)
+        if program is not None and getattr(program, "_ps_server", None):
+            from ..distributed.ps import run_pserver
+
+            return run_pserver(self, program, scope)
+
+        # PS trainer program: ensure comms + initial param pull, and fetch
+        # this step's grads for the send/recv exchange after the run
+        ps_meta = getattr(program, "_ps_trainer", None) if program else None
+        ps_grad_names = []
+        if ps_meta is not None:
+            if getattr(scope, "_ps_comm", None) is None:
+                from ..distributed.ps import TrainerPSComm
+
+                scope._ps_comm = TrainerPSComm(ps_meta)
+                scope._ps_comm.pull_initial_params(scope)
+                if not hasattr(self, "_ps_comms"):
+                    self._ps_comms = []
+                self._ps_comms.append(scope._ps_comm)
+            ps_grad_names = [g for g in ps_meta["param_grad"].values()
+                             if g not in fetch_names]
+            fetch_names = fetch_names + ps_grad_names
 
         mesh = None
         data_axis = None
@@ -196,6 +225,21 @@ class Executor:
                         "Operator output contains NaN/Inf: variable %r "
                         "(FLAGS_check_nan_inf)" % name)
 
+        if ps_meta is not None:
+            # send grads -> barrier -> pull params (the transpiler-
+            # rewritten send/recv op sequence, executed by the runtime so
+            # the compiled step stays pure).  Taken from the FULL fetch
+            # list: a grad the user fetches themselves is still a grad.
+            all_grads = set(ps_meta["param_grad"].values())
+            grad_vals = {
+                name: np.asarray(v)
+                for name, v in zip(fetch_names, fetches)
+                if name in all_grads
+            }
+            scope._ps_comm.step(scope, grad_vals)
+            n_user = len(fetches) - len(ps_grad_names)
+            fetches = fetches[:n_user]
+
         if return_numpy:
             return [as_numpy(f) for f in fetches]
         return list(fetches)
@@ -232,6 +276,10 @@ class Executor:
 
         block = program.global_block()
         plan = BlockPlan(block, feed_names, fetch_names)
+        # pipeline sections share param buffers across concurrently
+        # running executors — donation would let one section delete an
+        # array another still reads (real on TPU; CPU ignores donation)
+        donate = () if getattr(program, '_no_donate', False) else (2,)
         if mesh is None and has_collective_ops(block):
             # fleet/transpiler collective path: program-level c_* ops ->
             # manual SPMD over all local devices (reference: one process
@@ -243,11 +291,11 @@ class Executor:
 
             mesh = Mesh(np.array(jax.devices()), ("data",))
             fn = build_spmd_block_fn(plan, mesh, axis="data")
-            jfn = jax.jit(fn, donate_argnums=(2,))
+            jfn = jax.jit(fn, donate_argnums=donate)
             return _CompiledPlan(plan, jfn, mesh, "data")
         fn = build_block_fn(plan, mesh=mesh)
         if mesh is None:
-            jfn = jax.jit(fn, donate_argnums=(2,))
+            jfn = jax.jit(fn, donate_argnums=donate)
         else:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -255,7 +303,7 @@ class Executor:
             out_shardings = ([replicated] * len(fetch_names),
                              {n: self._param_sharding(mesh, block, n)
                               for n in plan.persist_written})
-            jfn = jax.jit(fn, donate_argnums=(2,), out_shardings=out_shardings)
+            jfn = jax.jit(fn, donate_argnums=donate, out_shardings=out_shardings)
         return _CompiledPlan(plan, jfn)
 
     def _param_sharding(self, mesh, block, name):

@@ -1,0 +1,143 @@
+"""Parameter-server runtime: pserver event loop + trainer comm.
+
+Analog of the reference's PS stack (SURVEY.md §3.4):
+- pserver: listen_and_serv_op.cc:110 RunSyncLoop — wait for all trainer
+  grad sends, run the optimizer sub-program, publish updated params, repeat;
+  exit when every trainer sends COMPLETE (executor.cc:110 SendComplete).
+- trainer: send_op/send_barrier/recv_op sequence around each step
+  (distribute_transpiler.py's rewritten program), here executed by the
+  runtime after the compiled XLA step instead of as graph ops — the compiled
+  program stays pure/functional (TPU-idiomatic), communication happens at
+  step boundaries over the native C++ transport (native/csrc/tensor_rpc.cc).
+
+Round consistency is VERSION-GATED instead of barrier-gated: round r's
+params are published under "name#r" and a GET for that key blocks until the
+server finishes round r.  A fast trainer therefore cannot lap the sync
+protocol (it blocks in its own round-r GET until every trainer's round-r
+grads arrived) — this replaces the reference's fetch_barrier op.
+
+Sync mode only (async Communicator is the reference's communicator.h:285
+path; tracked as follow-up).
+"""
+
+import collections
+
+import numpy as np
+
+from ..native.rpc import RpcClient, RpcServer, EV_BARRIER, EV_COMPLETE, EV_SEND
+
+__all__ = ["run_pserver", "TrainerPSComm"]
+
+
+def _vkey(name, version):
+    return "%s#%d" % (name, version)
+
+
+def run_pserver(exe, program, scope):
+    """Blocking pserver loop for a transpiled pserver program (the program
+    holds one `listen_and_serv` op; metadata on program._ps_server)."""
+    from ..core.executor import scope_guard
+
+    meta = program._ps_server
+    endpoint = meta["endpoint"]
+    port = int(endpoint.rsplit(":", 1)[1])
+    params = meta["params"]              # param names owned by this server
+    grad_to_param = meta["grad_map"]     # grad name -> param name
+    trainers = int(meta["trainers"])
+    opt_prog = meta["optimize_program"]
+
+    server = RpcServer(port)
+    server.serve(True)
+    completed = [0]
+
+    def publish(version):
+        for p in params:
+            server.set_var(
+                _vkey(p, version),
+                np.asarray(scope.find_var(p).get_tensor().numpy()))
+            if version > 0:
+                server.del_var(_vkey(p, version - 1))
+
+    def collect_round(grads):
+        """Consume events until `trainers` send-barriers arrive; SEND events
+        land in grad buckets.  False => shut down (all trainers complete)."""
+        seen = 0
+        while seen < trainers:
+            t, name, arr = server.poll()
+            if t == 0:
+                return False
+            if t == EV_COMPLETE:
+                completed[0] += 1
+                if completed[0] >= trainers:
+                    return False
+            elif t == EV_BARRIER and name == "send":
+                seen += 1
+            elif t == EV_SEND:
+                grads[name].append(arr)
+        return True
+
+    try:
+        publish(0)  # pserver startup already ran: serve initial params
+        version = 0
+        while True:
+            grads = collections.defaultdict(list)
+            if not collect_round(grads):
+                return
+            feed = {}
+            for gname, parts in grads.items():
+                if gname not in grad_to_param:
+                    continue
+                agg = parts[0].astype(np.float32)
+                for p in parts[1:]:
+                    agg = agg + p
+                feed[gname] = (agg / max(len(parts), 1)).astype(parts[0].dtype)
+            with scope_guard(scope):
+                exe.run(opt_prog, feed=feed, fetch_list=[])
+            version += 1
+            publish(version)
+    finally:
+        server.shutdown()
+
+
+class TrainerPSComm:
+    """Per-trainer connections to every pserver + the sync-step protocol."""
+
+    def __init__(self, meta):
+        self.meta = meta
+        self.endpoints = meta["endpoints"]
+        self.param_to_ep = meta["param_to_ep"]
+        self.param_to_grad = meta["param_grad"]
+        self.trainer_id = int(meta["trainer_id"])
+        self._clients = {ep: RpcClient(ep) for ep in self.endpoints}
+        self._round = 0
+        self._closed = False
+
+    def _pull(self, scope, version):
+        for p, ep in self.param_to_ep.items():
+            scope.var(p).set(self._clients[ep].get_var(_vkey(p, version)))
+
+    # initial param pull (reference: recv ops in the rewritten startup)
+    def pull_initial_params(self, scope):
+        self._pull(scope, 0)
+
+    def step(self, scope, grad_values):
+        """grad_values: grad name -> ndarray for THIS trainer's step."""
+        for p, g in self.param_to_grad.items():
+            if g in grad_values:
+                self._clients[self.param_to_ep[p]].send_var(g, grad_values[g])
+        for c in self._clients.values():
+            c.barrier("send")
+        self._round += 1
+        self._pull(scope, self._round)  # blocks until every trainer's round
+        # arrived and the optimizer ran — the sync point
+
+    def complete(self):
+        if self._closed:
+            return
+        self._closed = True
+        for c in self._clients.values():
+            try:
+                c.complete()
+                c.close()
+            except Exception:
+                pass

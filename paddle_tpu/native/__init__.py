@@ -76,6 +76,44 @@ def _declare(lib):
         c.POINTER(c.c_int64),
     ]
 
+    # tensor RPC (tensor_rpc.cc) — PS transport
+    lib.rpcs_create.restype = c.c_void_p
+    lib.rpcs_create.argtypes = [c.c_int]
+    lib.rpcs_port.restype = c.c_int
+    lib.rpcs_port.argtypes = [c.c_void_p]
+    lib.rpcs_poll.restype = c.c_int
+    lib.rpcs_poll.argtypes = [
+        c.c_void_p, c.c_char_p, c.c_int, c.POINTER(c.c_ubyte),
+        c.POINTER(c.c_longlong), c.c_int, c.POINTER(c.c_int),
+        c.POINTER(c.c_void_p), c.POINTER(c.c_longlong),
+    ]
+    lib.rpcs_set_var.argtypes = [
+        c.c_void_p, c.c_char_p, c.c_ubyte, c.POINTER(c.c_longlong),
+        c.c_int, c.c_void_p, c.c_longlong,
+    ]
+    lib.rpcs_serve.argtypes = [c.c_void_p, c.c_int]
+    lib.rpcs_del_var.argtypes = [c.c_void_p, c.c_char_p]
+    lib.rpcs_destroy.argtypes = [c.c_void_p]
+    lib.rpcc_connect.restype = c.c_void_p
+    lib.rpcc_connect.argtypes = [c.c_char_p, c.c_int]
+    lib.rpcc_send_var.restype = c.c_int
+    lib.rpcc_send_var.argtypes = [
+        c.c_void_p, c.c_char_p, c.c_ubyte, c.POINTER(c.c_longlong),
+        c.c_int, c.c_void_p, c.c_longlong,
+    ]
+    lib.rpcc_barrier.restype = c.c_int
+    lib.rpcc_barrier.argtypes = [c.c_void_p, c.c_char_p]
+    lib.rpcc_complete.restype = c.c_int
+    lib.rpcc_complete.argtypes = [c.c_void_p]
+    lib.rpcc_get_var.restype = c.c_longlong
+    lib.rpcc_get_var.argtypes = [
+        c.c_void_p, c.c_char_p, c.POINTER(c.c_ubyte),
+        c.POINTER(c.c_longlong), c.c_int, c.POINTER(c.c_int),
+        c.POINTER(c.c_void_p),
+    ]
+    lib.rpc_free.argtypes = [c.c_void_p]
+    lib.rpcc_close.argtypes = [c.c_void_p]
+
 
 def load():
     """Compile (if stale) and load the native library. Thread-safe."""

@@ -1,0 +1,135 @@
+"""Python wrappers over the native tensor-RPC transport (csrc/tensor_rpc.cc).
+
+Analog of the reference's RPCClient/RPCServer interfaces
+(paddle/fluid/operators/distributed/rpc_client.h, rpc_server.h) with the
+VariableResponse-style tensor framing done in C++.
+"""
+
+import ctypes
+
+import numpy as np
+
+from . import load
+
+__all__ = ["RpcServer", "RpcClient"]
+
+# numpy dtype <-> wire enum
+_DTYPES = ["float32", "float64", "int32", "int64", "uint8", "int8",
+           "float16", "bool"]
+_DT_TO_CODE = {np.dtype(d): i for i, d in enumerate(_DTYPES)}
+
+EV_SEND = 1
+EV_BARRIER = 3
+EV_COMPLETE = 4
+
+
+class RpcServer:
+    def __init__(self, port=0):
+        self._lib = load()
+        self._h = self._lib.rpcs_create(int(port))
+        if not self._h:
+            raise OSError("cannot bind RPC server on port %d" % port)
+        self.port = self._lib.rpcs_port(self._h)
+
+    def poll(self):
+        """Block for the next inbound event.
+        Returns (type, name, array_or_None); type 0 => shutdown."""
+        c = ctypes
+        name = c.create_string_buffer(1024)
+        dtype = c.c_ubyte()
+        dims = (c.c_longlong * 16)()
+        ndim = c.c_int()
+        data = c.c_void_p()
+        dlen = c.c_longlong()
+        t = self._lib.rpcs_poll(self._h, name, 1024, c.byref(dtype), dims, 16,
+                                c.byref(ndim), c.byref(data), c.byref(dlen))
+        if t == 0:
+            return 0, None, None
+        arr = None
+        if t == EV_SEND:
+            shape = tuple(dims[i] for i in range(ndim.value))
+            np_dt = np.dtype(_DTYPES[dtype.value])
+            buf = ctypes.string_at(data.value, dlen.value)
+            arr = np.frombuffer(buf, dtype=np_dt).reshape(shape).copy()
+        return t, name.value.decode(), arr
+
+    def set_var(self, name, arr):
+        arr = np.ascontiguousarray(arr)
+        dims = (ctypes.c_longlong * arr.ndim)(*arr.shape)
+        self._lib.rpcs_set_var(
+            self._h, name.encode(), _DT_TO_CODE[arr.dtype], dims, arr.ndim,
+            arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes)
+
+    def serve(self, enable=True):
+        self._lib.rpcs_serve(self._h, 1 if enable else 0)
+
+    def del_var(self, name):
+        self._lib.rpcs_del_var(self._h, name.encode())
+
+    def shutdown(self):
+        if self._h:
+            self._lib.rpcs_destroy(self._h)
+            self._h = None
+
+
+class RpcClient:
+    def __init__(self, endpoint, connect_timeout=60.0):
+        """Retries until the server is up (the reference client's
+        wait-for-server behavior; grpc_client.cc connect deadline)."""
+        import time
+
+        self._lib = load()
+        host, port = endpoint.rsplit(":", 1)
+        if host in ("localhost", ""):
+            host = "127.0.0.1"
+        deadline = time.time() + connect_timeout
+        self._h = None
+        while True:
+            self._h = self._lib.rpcc_connect(host.encode(), int(port))
+            if self._h or time.time() > deadline:
+                break
+            time.sleep(0.1)
+        if not self._h:
+            raise ConnectionError("cannot connect to pserver %s within %.0fs"
+                                  % (endpoint, connect_timeout))
+        self.endpoint = endpoint
+
+    def send_var(self, name, arr):
+        arr = np.ascontiguousarray(arr)
+        dims = (ctypes.c_longlong * max(arr.ndim, 1))(*(arr.shape or (0,)))
+        rc = self._lib.rpcc_send_var(
+            self._h, name.encode(), _DT_TO_CODE[arr.dtype], dims, arr.ndim,
+            arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes)
+        if rc != 0:
+            raise ConnectionError("send_var(%s) to %s failed"
+                                  % (name, self.endpoint))
+
+    def get_var(self, name):
+        c = ctypes
+        dtype = c.c_ubyte()
+        dims = (c.c_longlong * 16)()
+        ndim = c.c_int()
+        data = c.c_void_p()
+        n = self._lib.rpcc_get_var(self._h, name.encode(), c.byref(dtype),
+                                   dims, 16, c.byref(ndim), c.byref(data))
+        if n < 0:
+            raise ConnectionError("get_var(%s) from %s failed"
+                                  % (name, self.endpoint))
+        shape = tuple(dims[i] for i in range(ndim.value))
+        buf = ctypes.string_at(data.value, n)
+        self._lib.rpc_free(data)
+        return np.frombuffer(buf, dtype=np.dtype(_DTYPES[dtype.value])) \
+            .reshape(shape).copy()
+
+    def barrier(self, kind):
+        if self._lib.rpcc_barrier(self._h, kind.encode()) != 0:
+            raise ConnectionError("barrier(%s) to %s failed"
+                                  % (kind, self.endpoint))
+
+    def complete(self):
+        self._lib.rpcc_complete(self._h)
+
+    def close(self):
+        if self._h:
+            self._lib.rpcc_close(self._h)
+            self._h = None

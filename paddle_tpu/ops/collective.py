@@ -148,3 +148,15 @@ def allreduce(ctx, x, reduce_type=0):
              attrs={"root": 0, "sync_mode": False}, grad_maker=None)
 def broadcast(ctx, x, root=0, sync_mode=False):
     return c_broadcast(ctx, x, root=root)
+
+
+@register_op("listen_and_serv", inputs=("X",), outputs=(),
+             attrs={"endpoint": "", "Fanin": 1}, grad_maker=None,
+             optional_inputs=("X",))
+def listen_and_serv(ctx, x=None, endpoint="", Fanin=1):
+    """Pserver event-loop op (listen_and_serv_op.cc:110).  Never lowered:
+    the executor intercepts programs carrying _ps_server metadata and runs
+    the blocking server loop (distributed/ps.py) instead."""
+    raise RuntimeError(
+        "listen_and_serv cannot be lowered to XLA; run the pserver program "
+        "through Executor.run (it blocks in the PS server loop)")

@@ -845,12 +845,9 @@ class PipelineOptimizer:
                 for n in reads[j]:
                     made_before = any(n in produced[t] for t in range(i))
                     made_between = any(n in produced[t] for t in range(i, j))
-                    if made_between:
-                        continue
-                    if made_before or (is_data(n) and i > 0):
+                    # dataset feeds enter at section 0 and are relayed down
+                    if not made_between and (made_before or is_data(n)):
                         out.add(n)
-                    elif is_data(n) and i == 0:
-                        out.add(n)  # dataset feeds enter at section 0
             return out
 
         sections = []
@@ -858,6 +855,10 @@ class PipelineOptimizer:
             sec_prog = program.clone()
             sb = sec_prog.global_block()
             sb.ops = sb.ops[s:e]
+            # sections share param buffers across concurrent executors: XLA
+            # buffer donation in one section would delete arrays another
+            # section still reads (core/executor.py honors this flag)
+            sec_prog._no_donate = True
             sec_prog._bump_version()
             in_names = sorted(carry_into(i))
             out_names = sorted(carry_into(i + 1)) if i + 1 < K else []

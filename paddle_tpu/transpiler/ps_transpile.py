@@ -1,11 +1,22 @@
 """Parameter-server program splitting (reference
 transpiler/distribute_transpiler.py:495 `transpile`, :230).
 
-Rewrites a trainer program into (trainer half, per-pserver halves): grads are
-sent to their owning pserver, the pserver runs the optimizer sub-program per
-received grad, and updated params are pulled back (reference flow §3.4 in
-SURVEY.md).
+Rewrites a trained program into (trainer half, per-pserver halves):
+
+- trainer program: forward + backward only (Optimize/LRSched-role ops
+  removed); annotated with ``_ps_trainer`` metadata the executor uses to
+  send grads / pull params over the C++ RPC transport after each step
+  (distributed/ps.py).
+- pserver programs: one `listen_and_serv` op each (executor routes it to
+  the blocking server loop) + an optimizer sub-program holding exactly the
+  update ops of the params this server owns — the analog of the per-param
+  optimize sub-blocks listen_and_serv_op.cc executes.
+
+Placement is whole-param round-robin by size (the reference's
+``slice_var_up=False`` configuration; block slicing is a follow-up).
 """
+
+from ..framework import OP_ROLE_KEY, OpRole
 
 
 class PSState:
@@ -17,10 +28,123 @@ class PSState:
         self.param_map = param_map
 
 
+def _role(op):
+    return int(op.attr(OP_ROLE_KEY) or 0)
+
+
 def transpile_pserver_mode(t):
-    raise NotImplementedError(
-        "parameter-server transpile mode is not implemented yet; use "
-        "mode='collective' (fleet collective DP over the mesh) — the PS "
-        "runtime (listen_and_serv / send / recv over the C++ RPC backend) "
-        "is tracked in SURVEY.md §7 step 8"
-    )
+    program, startup = t.program, t.startup_program
+    eps = t.pserver_endpoints
+    block = program.global_block()
+
+    # param -> grad from the Optimize ops' own slots (robust to clipping /
+    # regularization rewrites of the grad name)
+    opt_ops = [op for op in block.ops if _role(op) & OpRole.Optimize]
+    param_grad = {}
+    param_opt_ops = {}
+    for op in opt_ops:
+        pnames = op.input("Param")
+        if not pnames:
+            continue
+        p = pnames[0]
+        param_opt_ops.setdefault(p, []).append(op)
+        g = op.input("Grad")
+        if g:
+            param_grad[p] = g[0]
+    if not param_grad:
+        raise ValueError(
+            "PS transpile: no optimizer ops found — call "
+            "optimizer.minimize(loss) before transpile()")
+
+    # whole-param round-robin by size desc (reference slice_variable's
+    # balance goal without block slicing)
+    def size_of(name):
+        v = block._find_var_recursive(name)
+        n = 1
+        for d in (v.shape or ()):
+            n *= max(int(d), 1)
+        return n
+
+    param_to_ep = {}
+    loads = {ep: 0 for ep in eps}
+    for p in sorted(param_grad, key=size_of, reverse=True):
+        ep = min(eps, key=lambda e: loads[e])
+        param_to_ep[p] = ep
+        loads[ep] += size_of(p)
+
+    # ---- trainer program: strip update + lr ops ---------------------------
+    trainer_prog = program.clone()
+    tb = trainer_prog.global_block()
+    tb.ops = [op for op in tb.ops
+              if not (_role(op) & OpRole.Optimize)
+              and _role(op) != OpRole.LRSched]
+    trainer_prog._bump_version()
+    trainer_prog._ps_trainer = {
+        "endpoints": list(eps),
+        "param_to_ep": param_to_ep,
+        "param_grad": param_grad,
+        "trainer_id": t.trainer_id,
+        "trainers": t.trainers,
+        "sync": t.sync_mode,
+    }
+
+    # ---- pserver programs -------------------------------------------------
+    def startup_for(needed):
+        sp = startup.clone()
+        sb = sp.global_block()
+        sb.ops = [op for op in sb.ops
+                  if any(n in needed for n in op.output_arg_names)]
+        sp._bump_version()
+        return sp
+
+    pserver_programs = {}
+    pserver_startups = {}
+    for ep in eps:
+        owned = [p for p, e in param_to_ep.items() if e == ep]
+        opt_prog = program.clone()
+        ob = opt_prog.global_block()
+        keep = []
+        for op in ob.ops:
+            role = _role(op)
+            if role == OpRole.LRSched:
+                keep.append(op)
+            elif role & OpRole.Optimize:
+                pn = op.input("Param")
+                if pn and pn[0] in owned:
+                    keep.append(op)
+                elif not pn:
+                    keep.append(op)  # e.g. global counters
+        ob.ops = keep
+        opt_prog._bump_version()
+
+        # persistable state this server must initialize: params, their
+        # accumulators, lr vars
+        needed = set()
+        for op in keep:
+            for n in list(op.input_arg_names) + list(op.output_arg_names):
+                v = ob._find_var_recursive(n)
+                if v is not None and v.persistable:
+                    needed.add(n)
+
+        sp = startup_for(needed)
+
+        serv_prog = program.clone()
+        svb = serv_prog.global_block()
+        svb.ops = []
+        serv_prog._bump_version()
+        svb.append_op(
+            type="listen_and_serv",
+            inputs={}, outputs={},
+            attrs={"endpoint": ep, "Fanin": t.trainers})
+        serv_prog._ps_server = {
+            "endpoint": ep,
+            "params": owned,
+            "grad_map": {param_grad[p]: p for p in owned},
+            "trainers": t.trainers,
+            "optimize_program": opt_prog,
+        }
+        pserver_programs[ep] = serv_prog
+        pserver_startups[ep] = sp
+
+    return PSState(trainer_prog, pserver_programs, pserver_startups,
+                   param_to_ep)

@@ -337,3 +337,32 @@ def test_dataloader_next_advances():
     assert vals == [0.0, 1.0, 2.0]
     with pytest.raises(StopIteration):
         loader.next()
+
+
+def test_data_feed_desc_prototxt(tmp_path):
+    proto = tmp_path / "feed.prototxt"
+    proto.write_text("""
+batch_size: 64
+multi_slot_desc {
+  slots {
+    name: "words"
+    type: "uint64"
+    is_dense: false
+    is_used: true
+  }
+  slots {
+    name: "label"
+    type: "uint64"
+    is_dense: false
+    is_used: true
+  }
+}
+""")
+    d = fluid.DataFeedDesc(str(proto))
+    assert d.batch_size == 64
+    assert [s["name"] for s in d.slots] == ["words", "label"]
+    d.set_batch_size(128)
+    d.set_dense_slots(["label"])
+    assert d.batch_size == 128
+    assert d.slots[1]["is_dense"] and not d.slots[0]["is_dense"]
+    assert 'name: "words"' in d.desc()
