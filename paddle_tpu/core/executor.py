@@ -62,6 +62,18 @@ def as_numpy(t):
     return np.asarray(t)
 
 
+
+def _with_seed_counter(fn):
+    """Adapt fn(feeds, ro, rw, key) to take a [seed, counter] uint32 pair,
+    deriving the key inside the trace (no eager key ops per step)."""
+
+    def wrapped(feeds, params_ro, params_rw, sc):
+        key = jax.random.fold_in(jax.random.key(sc[0]), sc[1])
+        return fn(feeds, params_ro, params_rw, key)
+
+    return wrapped
+
+
 class _CompiledPlan:
     __slots__ = ("plan", "jfn", "mesh", "data_axis")
 
@@ -105,6 +117,16 @@ class Executor:
         fetch_list = fetch_list or []
         fetch_names = [_fetch_name(f) for f in fetch_list]
 
+        # unwrap CompiledProgram FIRST so PS metadata on the inner program
+        # is seen (a wrapped PS trainer must still send/recv)
+        mesh = None
+        data_axis = None
+        if isinstance(program, CompiledProgram):
+            compiled = program
+            program = compiled._program
+            mesh = compiled._mesh()
+            data_axis = compiled._data_axis
+
         # parameter-server program: block in the server loop
         # (listen_and_serv_op.cc:110 RunSyncLoop analog)
         if program is not None and getattr(program, "_ps_server", None):
@@ -129,13 +151,6 @@ class Executor:
                              if g not in fetch_names]
             fetch_names = fetch_names + ps_grad_names
 
-        mesh = None
-        data_axis = None
-        if isinstance(program, CompiledProgram):
-            compiled = program
-            program = compiled._program
-            mesh = compiled._mesh()
-            data_axis = compiled._data_axis
         if program is None:
             program = default_main_program()
 
@@ -194,7 +209,10 @@ class Executor:
         with _RNG_COUNTER_LOCK:
             counter = scope._rng_counter
             scope._rng_counter = counter + 1
-        rng = jax.random.fold_in(jax.random.key(seed), counter)
+        # key derivation happens inside the compiled fn (kept out of the
+        # eager path: per-op dispatch through the device tunnel is slow)
+        rng = np.asarray([seed & 0xFFFFFFFF, counter & 0xFFFFFFFF],
+                         dtype=np.uint32)
 
         if mesh is not None:
             feed_arrays = self._shard_feeds(feed_arrays, mesh, data_axis)
@@ -290,10 +308,10 @@ class Executor:
             from jax.sharding import Mesh
 
             mesh = Mesh(np.array(jax.devices()), ("data",))
-            fn = build_spmd_block_fn(plan, mesh, axis="data")
+            fn = _with_seed_counter(build_spmd_block_fn(plan, mesh, axis="data"))
             jfn = jax.jit(fn, donate_argnums=donate)
             return _CompiledPlan(plan, jfn, mesh, "data")
-        fn = build_block_fn(plan, mesh=mesh)
+        fn = _with_seed_counter(build_block_fn(plan, mesh=mesh))
         if mesh is None:
             jfn = jax.jit(fn, donate_argnums=donate)
         else:

@@ -59,10 +59,12 @@ def run_pserver(exe, program, scope):
                 server.del_var(_vkey(p, version - 1))
 
     def collect_round(grads):
-        """Consume events until `trainers` send-barriers arrive; SEND events
-        land in grad buckets.  False => shut down (all trainers complete)."""
+        """Consume events until every LIVE trainer's send-barrier arrives;
+        SEND events land in grad buckets.  A COMPLETE decrements the round
+        fanin (the reference decrements the barrier counter on SendComplete
+        so stragglers don't deadlock).  False => all trainers done."""
         seen = 0
-        while seen < trainers:
+        while seen < trainers - completed[0]:
             t, name, arr = server.poll()
             if t == 0:
                 return False
@@ -122,6 +124,10 @@ class TrainerPSComm:
 
     def step(self, scope, grad_values):
         """grad_values: grad name -> ndarray for THIS trainer's step."""
+        if self._closed:
+            raise RuntimeError(
+                "PS trainer already completed (Executor.close() was called); "
+                "create a new scope/executor to train again")
         for p, g in self.param_to_grad.items():
             if g in grad_values:
                 self._clients[self.param_to_ep[p]].send_var(g, grad_values[g])

@@ -132,6 +132,16 @@ struct Server {
   bool serving = false;  // GETs blocked until Python publishes + enables
   bool stop = false;
 
+  void forget_fd(int fd) {
+    std::lock_guard<std::mutex> lk(mu);
+    for (auto it = conn_fds.begin(); it != conn_fds.end(); ++it) {
+      if (*it == fd) {
+        conn_fds.erase(it);
+        break;
+      }
+    }
+  }
+
   void handle_conn(int fd) {
     int one = 1;
     ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
@@ -157,6 +167,9 @@ struct Server {
         if (!write_frame(fd, kReplyVar, f.name, &t)) break;
       }
     }
+    // drop from conn_fds BEFORE closing: destroy() must never shutdown()
+    // a number the OS may have already reassigned to an unrelated socket
+    forget_fd(fd);
     ::close(fd);
   }
 

@@ -31,9 +31,12 @@ def _amp_dot(ctx, x, y, contract_fn):
     uniform and the dot/conv transpose rules are well-typed under vjp.
     (XLA:CPU may round-trip partials through bf16 — test-only backend.)
     TPU-native replacement for the reference's fp16 cast-rewrite."""
-    if ctx is not None and ctx.amp_bf16() and x.dtype == jnp.float32:
+    if ctx is not None and ctx.amp_bf16() and x.dtype in (jnp.float32,
+                                                          jnp.bfloat16):
         out = contract_fn(x.astype(jnp.bfloat16), y.astype(jnp.bfloat16))
-        return out.astype(jnp.float32)
+        # bf16-carry: keep bf16 activations bf16; f32 inputs (e.g. the loss
+        # head) cast back up so downstream softmax/CE stay f32
+        return out if x.dtype == jnp.bfloat16 else out.astype(jnp.float32)
     return contract_fn(x, y)
 
 

@@ -52,7 +52,8 @@ def conv2d(ctx, x, w, strides=(1, 1), paddings=(0, 0), dilations=(1, 1),
     # AMP: bf16 operands (MXU accumulates f32 internally), cast up after —
     # keeping operand/cotangent dtypes uniform so the conv transpose rule
     # stays well-typed under vjp
-    amp = ctx is not None and ctx.amp_bf16() and x.dtype == jnp.float32
+    amp = ctx is not None and ctx.amp_bf16() and x.dtype in (
+        jnp.float32, jnp.bfloat16)
     xc, wc = (x.astype(jnp.bfloat16), w.astype(jnp.bfloat16)) if amp else (x, w)
     out = lax.conv_general_dilated(
         xc, wc,
@@ -62,7 +63,9 @@ def conv2d(ctx, x, w, strides=(1, 1), paddings=(0, 0), dilations=(1, 1),
         dimension_numbers=dn,
         feature_group_count=groups,
     )
-    return out.astype(x.dtype)
+    # bf16-carry policy: under AMP the activation stays bf16 (weights remain
+    # f32 master copies); without AMP preserve the input dtype
+    return out if amp else out.astype(x.dtype)
 
 
 @register_op(
@@ -230,22 +233,25 @@ def batch_norm(ctx, x, scale, bias, mean, variance, momentum=0.9,
     c_ax = 1 if nchw else x.ndim - 1
     cshape[c_ax] = x.shape[c_ax]
 
+    xf = x.astype(jnp.float32)  # statistics always accumulate in f32
     if is_test or use_global_stats:
         m, v = mean, variance
         new_mean, new_var = mean, variance
         saved_mean = mean
         saved_var = 1.0 / jnp.sqrt(variance + epsilon)
     else:
-        m = jnp.mean(x, axis=axes)
-        v = jnp.var(x, axis=axes)
+        m = jnp.mean(xf, axis=axes)
+        v = jnp.var(xf, axis=axes)
         new_mean = momentum * mean + (1 - momentum) * m
         new_var = momentum * variance + (1 - momentum) * v
         saved_mean = m
         saved_var = 1.0 / jnp.sqrt(v + epsilon)
     inv = 1.0 / jnp.sqrt(v + epsilon)
-    y = (x - m.reshape(cshape)) * inv.reshape(cshape)
+    y = (xf - m.reshape(cshape)) * inv.reshape(cshape)
     y = y * scale.reshape(cshape) + bias.reshape(cshape)
-    return y, new_mean, new_var, saved_mean, saved_var, None
+    # output keeps the input dtype: bf16 activations under the AMP policy
+    return (y.astype(x.dtype), new_mean, new_var, saved_mean, saved_var,
+            None)
 
 
 @register_op(

@@ -41,16 +41,13 @@ def transpile_pserver_mode(t):
     # regularization rewrites of the grad name)
     opt_ops = [op for op in block.ops if _role(op) & OpRole.Optimize]
     param_grad = {}
-    param_opt_ops = {}
     for op in opt_ops:
         pnames = op.input("Param")
         if not pnames:
             continue
-        p = pnames[0]
-        param_opt_ops.setdefault(p, []).append(op)
         g = op.input("Grad")
         if g:
-            param_grad[p] = g[0]
+            param_grad[pnames[0]] = g[0]
     if not param_grad:
         raise ValueError(
             "PS transpile: no optimizer ops found — call "
