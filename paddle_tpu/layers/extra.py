@@ -1,0 +1,686 @@
+"""Layer wrappers for the extended op surface (vision / detection /
+losses / misc) — parity fills for python/paddle/fluid/layers/nn.py and
+layers/detection.py entries not covered by the core modules."""
+
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    # vision
+    "lrn", "affine_channel", "shuffle_channel", "space_to_depth",
+    "temporal_shift", "grid_sampler", "affine_grid", "conv3d",
+    "conv3d_transpose", "pool3d", "adaptive_pool3d", "row_conv",
+    "bilinear_tensor_product", "spectral_norm", "data_norm", "fsp_matrix",
+    # losses
+    "bpr_loss", "rank_loss", "margin_rank_loss", "sigmoid_focal_loss",
+    "teacher_student_sigmoid_loss", "mean_iou", "center_loss", "dice_loss",
+    "warpctc", "edit_distance",
+    # misc
+    "multiplex", "crop", "crop_tensor", "pad_constant_like", "scatter_nd",
+    "scatter_nd_add", "shard_index", "sampling_id", "random_crop",
+    "unique_with_counts", "gather_tree", "add_position_encoding", "selu",
+    "soft_relu", "rank", "size", "sum", "uniform_random", "expand_as",
+    "logical_xor", "hard_swish", "autoincreased_step_counter",
+    # detection
+    "iou_similarity", "prior_box", "density_prior_box", "anchor_generator",
+    "box_coder", "box_clip", "yolo_box", "bipartite_match", "target_assign",
+    "multiclass_nms", "roi_align", "roi_pool",
+]
+
+
+def _simple(op_type, io=None, n_out=1, helper_name=None, attr_names=()):
+    """Factory: layer fn appending one op; io maps python kwarg -> slot.
+    Positional args beyond the input slots map to `attr_names` in order
+    (the fluid convention: e.g. space_to_depth(x, blocksize))."""
+    in_slots = io or {"x": "X"}
+
+    def layer(*args, name=None, **kwargs):
+        helper = LayerHelper(helper_name or op_type, name=name)
+        inputs = {}
+        pos = list(in_slots.items())
+        for i, a in enumerate(args[:len(pos)]):
+            if a is not None:
+                inputs[pos[i][1]] = [a]
+        for i, a in enumerate(args[len(pos):]):
+            if i >= len(attr_names):
+                raise TypeError(
+                    "%s: too many positional arguments" % op_type)
+            kwargs[attr_names[i]] = a
+        for k, slot in in_slots.items():
+            if k in kwargs and kwargs[k] is not None:
+                inputs[slot] = [kwargs.pop(k)]
+        ref = next(iter(inputs.values()))[0]
+        outs = [helper.create_variable_for_type_inference(ref.dtype)
+                for _ in range(n_out)]
+        from ..core.registry import get_op_def
+
+        opdef = get_op_def(op_type)
+        helper.append_op(
+            type=op_type, inputs=inputs,
+            outputs={s: [o] for s, o in zip(opdef.output_slots, outs)},
+            attrs=kwargs)
+        return outs[0] if n_out == 1 else tuple(outs)
+
+    layer.__name__ = helper_name or op_type
+    return layer
+
+
+# -- vision -------------------------------------------------------------------
+
+lrn = _simple("lrn", attr_names=("n", "k", "alpha", "beta"))
+affine_channel = _simple("affine_channel",
+                         {"x": "X", "scale": "Scale", "bias": "Bias"}, 1)
+shuffle_channel = _simple("shuffle_channel", attr_names=("group",))
+space_to_depth = _simple("space_to_depth", attr_names=("blocksize",))
+temporal_shift = _simple("temporal_shift",
+                         attr_names=("seg_num", "shift_ratio"))
+grid_sampler = _simple("grid_sampler", {"x": "X", "grid": "Grid"})
+fsp_matrix = _simple("fsp", {"x": "X", "y": "Y"})
+
+
+def affine_grid(theta, out_shape, name=None):
+    helper = LayerHelper("affine_grid", name=name)
+    out = helper.create_variable_for_type_inference(theta.dtype)
+    inputs = {"Theta": [theta]}
+    attrs = {}
+    if isinstance(out_shape, Variable):
+        inputs["OutputShape"] = [out_shape]
+    else:
+        attrs["output_shape"] = [int(v) for v in out_shape]
+    helper.append_op(type="affine_grid", inputs=inputs,
+                     outputs={"Output": [out]}, attrs=attrs)
+    return out
+
+
+def _conv3d_impl(op_type, input, num_filters, filter_size, stride, padding,
+                 dilation, groups, param_attr, bias_attr, act, name,
+                 transpose=False):
+    helper = LayerHelper(op_type, bias_attr=bias_attr, act=act, name=name)
+    c = input.shape[1]
+    groups = groups or 1
+    fs = [filter_size] * 3 if isinstance(filter_size, int) else list(filter_size)
+    st = [stride] * 3 if isinstance(stride, int) else list(stride)
+    pd = [padding] * 3 if isinstance(padding, int) else list(padding)
+    dl = [dilation] * 3 if isinstance(dilation, int) else list(dilation)
+    if transpose:
+        shape = [c, num_filters // groups] + fs
+    else:
+        shape = [num_filters, c // groups] + fs
+    w = helper.create_parameter(attr=param_attr, shape=shape,
+                                dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type=op_type, inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [out]},
+        attrs={"strides": st, "paddings": pd, "dilations": dl,
+               "groups": groups})
+    pre = helper.append_bias_op(out, dim_start=1, dim_end=2)
+    return helper.append_activation(pre)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCDHW"):
+    return _conv3d_impl("conv3d", input, num_filters, filter_size, stride,
+                        padding, dilation, groups, param_attr, bias_attr,
+                        act, name)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCDHW"):
+    return _conv3d_impl("conv3d_transpose", input, num_filters, filter_size,
+                        stride, padding, dilation, groups, param_attr,
+                        bias_attr, act, name, transpose=True)
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, name=None, exclusive=True, data_format="NCDHW"):
+    helper = LayerHelper("pool3d", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ks = [pool_size] * 3 if isinstance(pool_size, int) else list(pool_size)
+    st = [pool_stride] * 3 if isinstance(pool_stride, int) else list(pool_stride)
+    pd = [pool_padding] * 3 if isinstance(pool_padding, int) else list(pool_padding)
+    helper.append_op(
+        type="pool3d", inputs={"X": [input]}, outputs={"Out": [out]},
+        attrs={"pooling_type": pool_type, "ksize": ks, "strides": st,
+               "paddings": pd, "global_pooling": global_pooling,
+               "ceil_mode": ceil_mode, "exclusive": exclusive})
+    return out
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    helper = LayerHelper("adaptive_pool3d", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ks = [pool_size] * 3 if isinstance(pool_size, int) else list(pool_size)
+    helper.append_op(
+        type="pool3d", inputs={"X": [input]}, outputs={"Out": [out]},
+        attrs={"pooling_type": pool_type, "ksize": ks, "adaptive": True,
+               "strides": [1, 1, 1], "paddings": [0, 0, 0]})
+    return out
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    helper = LayerHelper("row_conv", act=act)
+    w = helper.create_parameter(
+        attr=param_attr, shape=[future_context_size + 1, input.shape[-1]],
+        dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="row_conv",
+                     inputs={"X": [input], "Filter": [w]},
+                     outputs={"Out": [out]})
+    return helper.append_activation(out)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    helper = LayerHelper("bilinear_tensor_product", bias_attr=bias_attr,
+                         act=act, name=name)
+    w = helper.create_parameter(
+        attr=param_attr, shape=[size, x.shape[1], y.shape[1]],
+        dtype=x.dtype)
+    inputs = {"X": [x], "Y": [y], "Weight": [w]}
+    if bias_attr is not False:
+        b = helper.create_parameter(attr=bias_attr, shape=[size],
+                                    dtype=x.dtype, is_bias=True)
+        if b is not None:
+            inputs["Bias"] = [b]
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="bilinear_tensor_product", inputs=inputs,
+                     outputs={"Out": [out]})
+    return helper.append_activation(out)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    helper = LayerHelper("spectral_norm", name=name)
+    shape = weight.shape
+    perm_dim = shape[dim]
+    rest = 1
+    for i, d in enumerate(shape):
+        if i != dim:
+            rest *= int(d)
+    from ..initializer import Normal
+
+    u = helper.create_parameter(attr=None, shape=[perm_dim],
+                                dtype=weight.dtype,
+                                default_initializer=Normal(0, 1))
+    u.stop_gradient = True
+    v = helper.create_parameter(attr=None, shape=[rest], dtype=weight.dtype,
+                                default_initializer=Normal(0, 1))
+    v.stop_gradient = True
+    out = helper.create_variable_for_type_inference(weight.dtype)
+    helper.append_op(
+        type="spectral_norm",
+        inputs={"Weight": [weight], "U": [u], "V": [v]},
+        outputs={"Out": [out]},
+        attrs={"dim": dim, "power_iters": power_iters, "eps": eps})
+    return out
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None, name=None,
+              **kwargs):
+    helper = LayerHelper("data_norm", act=act, name=name)
+    c = input.shape[-1]
+    from ..initializer import Constant
+
+    bsize = helper.create_parameter(attr=None, shape=[c], dtype=input.dtype,
+                                    default_initializer=Constant(1e4))
+    bsum = helper.create_parameter(attr=None, shape=[c], dtype=input.dtype,
+                                   default_initializer=Constant(0.0))
+    bsq = helper.create_parameter(attr=None, shape=[c], dtype=input.dtype,
+                                  default_initializer=Constant(1e4))
+    for p in (bsize, bsum, bsq):
+        p.stop_gradient = True
+    out = helper.create_variable_for_type_inference(input.dtype)
+    means = helper.create_variable_for_type_inference(input.dtype)
+    scales = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="data_norm",
+        inputs={"X": [input], "BatchSize": [bsize], "BatchSum": [bsum],
+                "BatchSquareSum": [bsq]},
+        outputs={"Y": [out], "Means": [means], "Scales": [scales]},
+        attrs={"epsilon": epsilon})
+    return helper.append_activation(out)
+
+
+# -- losses -------------------------------------------------------------------
+
+bpr_loss = _simple("bpr_loss", {"input": "X", "label": "Label"})
+rank_loss = _simple("rank_loss",
+                    {"label": "Label", "left": "Left", "right": "Right"})
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    helper = LayerHelper("margin_rank_loss", name=name)
+    out = helper.create_variable_for_type_inference(left.dtype)
+    act = helper.create_variable_for_type_inference(left.dtype)
+    helper.append_op(
+        type="margin_rank_loss",
+        inputs={"Label": [label], "X1": [left], "X2": [right]},
+        outputs={"Out": [out], "Activated": [act]},
+        attrs={"margin": margin})
+    return out
+
+
+def sigmoid_focal_loss(x, label, fg_num, gamma=2, alpha=0.25):
+    helper = LayerHelper("sigmoid_focal_loss")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="sigmoid_focal_loss",
+        inputs={"X": [x], "Label": [label], "FgNum": [fg_num]},
+        outputs={"Out": [out]},
+        attrs={"gamma": float(gamma), "alpha": float(alpha)})
+    return out
+
+
+teacher_student_sigmoid_loss = _simple(
+    "teacher_student_sigmoid_loss", {"input": "X", "label": "Label"})
+
+
+def mean_iou(input, label, num_classes):
+    helper = LayerHelper("mean_iou")
+    miou = helper.create_variable_for_type_inference("float32")
+    wrong = helper.create_variable_for_type_inference("int32")
+    correct = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="mean_iou",
+        inputs={"Predictions": [input], "Labels": [label]},
+        outputs={"OutMeanIou": [miou], "OutWrong": [wrong],
+                 "OutCorrect": [correct]},
+        attrs={"num_classes": num_classes})
+    return miou, wrong, correct
+
+
+def center_loss(input, label, num_classes, alpha, param_attr=None,
+                update_center=True):
+    helper = LayerHelper("center_loss")
+    from ..initializer import Constant
+
+    centers = helper.create_parameter(
+        attr=param_attr, shape=[num_classes, input.shape[1]],
+        dtype=input.dtype, default_initializer=Constant(0.0))
+    centers.stop_gradient = True
+    rate = helper.create_or_get_global_variable(
+        name=helper.name + ".rate", shape=[1], dtype="float32",
+        persistable=True)
+    Constant(float(alpha))(rate)
+    rate.stop_gradient = True
+    loss = helper.create_variable_for_type_inference(input.dtype)
+    diff = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="center_loss",
+        inputs={"X": [input], "Label": [label], "Centers": [centers],
+                "CenterUpdateRate": [rate]},
+        outputs={"CentersOut": [centers], "SampleCenterDiff": [diff],
+                 "Loss": [loss]},
+        attrs={"cluster_num": num_classes, "need_update": update_center})
+    return loss
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    """Pure composition (reference layers/nn.py dice_loss)."""
+    from . import nn as L
+    from . import tensor as T
+
+    label = L.one_hot(label, input.shape[-1])
+    reduce_dims = list(range(1, len(input.shape)))
+    inse = L.reduce_sum(input * label, dim=reduce_dims)
+    dice_denominator = L.reduce_sum(input, dim=reduce_dims) + L.reduce_sum(
+        label, dim=reduce_dims)
+    dice_score = 1 - inse * 2 / (dice_denominator + epsilon)
+    return L.reduce_mean(dice_score)
+
+
+def warpctc(input, label, blank=0, norm_by_times=False,
+            input_length=None, label_length=None):
+    helper = LayerHelper("warpctc")
+    loss = helper.create_variable_for_type_inference(input.dtype)
+    grad = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="warpctc", inputs={"Logits": [input], "Label": [label]},
+        outputs={"WarpCTCGrad": [grad], "Loss": [loss]},
+        attrs={"blank": blank, "norm_by_times": norm_by_times})
+    return loss
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None):
+    helper = LayerHelper("edit_distance")
+    out = helper.create_variable_for_type_inference("float32")
+    seq_num = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        type="edit_distance", inputs={"Hyps": [input], "Refs": [label]},
+        outputs={"Out": [out], "SequenceNum": [seq_num]},
+        attrs={"normalized": normalized})
+    return out, seq_num
+
+
+# -- misc ---------------------------------------------------------------------
+
+
+def multiplex(inputs, index):
+    helper = LayerHelper("multiplex")
+    out = helper.create_variable_for_type_inference(inputs[0].dtype)
+    helper.append_op(type="multiplex",
+                     inputs={"Ids": [index], "X": list(inputs)},
+                     outputs={"Out": [out]})
+    return out
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    helper = LayerHelper("crop_tensor", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="crop_tensor", inputs={"X": [x]}, outputs={"Out": [out]},
+        attrs={"shape": list(shape or []), "offsets": list(offsets or [])})
+    return out
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    helper = LayerHelper("crop", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {"X": [x]}
+    attrs = {"offsets": list(offsets or [])}
+    if isinstance(shape, Variable):
+        inputs["Y"] = [shape]
+    else:
+        attrs["shape"] = list(shape or [])
+    helper.append_op(type="crop", inputs=inputs, outputs={"Out": [out]},
+                     attrs=attrs)
+    return out
+
+
+pad_constant_like = _simple("pad_constant_like", {"x": "X", "y": "Y"})
+scatter_nd_add = _simple(
+    "scatter_nd_add", {"ref": "X", "index": "Index", "updates": "Updates"})
+
+
+def scatter_nd(index, updates, shape, name=None):
+    helper = LayerHelper("scatter_nd", name=name)
+    out = helper.create_variable_for_type_inference(updates.dtype)
+    helper.append_op(
+        type="scatter_nd",
+        inputs={"Index": [index], "Updates": [updates]},
+        outputs={"Out": [out]},
+        attrs={"shape": [int(v) for v in shape]})
+    return out
+
+
+shard_index = _simple("shard_index", attr_names=(
+    "index_num", "nshards", "shard_id", "ignore_value"))
+sampling_id = _simple("sampling_id", attr_names=("min", "max", "seed"))
+gather_tree = _simple("gather_tree", {"ids": "Ids", "parents": "Parents"})
+add_position_encoding = _simple("add_position_encoding")
+selu = _simple("selu")
+soft_relu = _simple("soft_relu")
+
+
+def random_crop(x, shape=None, seed=None):
+    helper = LayerHelper("random_crop")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    seed_out = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        type="random_crop", inputs={"X": [x]},
+        outputs={"Out": [out], "SeedOut": [seed_out]},
+        attrs={"shape": [int(v) for v in (shape or [])]})
+    return out
+
+
+def unique_with_counts(x, dtype="int32"):
+    helper = LayerHelper("unique_with_counts")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    index = helper.create_variable_for_type_inference(dtype)
+    count = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="unique_with_counts", inputs={"X": [x]},
+        outputs={"Out": [out], "Index": [index], "Count": [count]})
+    return out, index, count
+
+
+def rank(input):
+    from . import tensor as T
+
+    return T.fill_constant([1], "int32", len(input.shape))
+
+
+def size(input):
+    from . import tensor as T
+
+    n = 1
+    for d in input.shape:
+        n *= int(d)
+    return T.fill_constant([1], "int64", n)
+
+
+def sum(x):
+    helper = LayerHelper("sum")
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    out = helper.create_variable_for_type_inference(xs[0].dtype)
+    helper.append_op(type="sum", inputs={"X": list(xs)},
+                     outputs={"Out": [out]})
+    return out
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0,
+                   name=None):
+    helper = LayerHelper("uniform_random", name=name)
+    out = helper.create_variable_for_type_inference(dtype)
+    from ..ops.common import dtype_enum
+
+    helper.append_op(
+        type="uniform_random", inputs={}, outputs={"Out": [out]},
+        attrs={"shape": [int(v) for v in shape], "min": float(min),
+               "max": float(max), "seed": seed, "dtype": dtype_enum(dtype)})
+    return out
+
+
+def expand_as(x, target_tensor, name=None):
+    helper = LayerHelper("expand_as", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="expand_as", inputs={"X": [x], "target_tensor": [target_tensor]},
+        outputs={"Out": [out]})
+    return out
+
+
+def logical_xor(x, y, out=None, name=None):
+    helper = LayerHelper("logical_xor", name=name)
+    if out is None:
+        out = helper.create_variable_for_type_inference("bool")
+    helper.append_op(type="logical_xor", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def hard_swish(x, threshold=6.0, scale=6.0, offset=3.0, name=None):
+    helper = LayerHelper("hard_swish", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="hard_swish", inputs={"X": [x]}, outputs={"Out": [out]},
+        attrs={"threshold": threshold, "scale": scale, "offset": offset})
+    return out
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """Persistable step counter incremented each run
+    (layers/nn.py autoincreased_step_counter)."""
+    from ..initializer import Constant
+
+    helper = LayerHelper("global_step_counter")
+    counter = helper.create_or_get_global_variable(
+        name=counter_name or "@STEP_COUNTER@", shape=[1], dtype="int64",
+        persistable=True)
+    if not getattr(counter, "_step_init", False):
+        Constant(float(begin - step))(counter)
+        counter._step_init = True
+    counter.stop_gradient = True
+    helper.append_op(type="increment", inputs={"X": [counter]},
+                     outputs={"Out": [counter]}, attrs={"step": float(step)})
+    return counter
+
+
+# -- detection ----------------------------------------------------------------
+
+iou_similarity = _simple("iou_similarity", {"x": "X", "y": "Y"})
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, name=None,
+              min_max_aspect_ratios_order=False):
+    helper = LayerHelper("prior_box", name=name)
+    boxes = helper.create_variable_for_type_inference(input.dtype)
+    var = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="prior_box", inputs={"Input": [input], "Image": [image]},
+        outputs={"Boxes": [boxes], "Variances": [var]},
+        attrs={"min_sizes": [float(v) for v in min_sizes],
+               "max_sizes": [float(v) for v in (max_sizes or [])],
+               "aspect_ratios": [float(v) for v in aspect_ratios],
+               "variances": [float(v) for v in variance],
+               "flip": flip, "clip": clip,
+               "step_w": float(steps[0]), "step_h": float(steps[1]),
+               "offset": offset,
+               "min_max_aspect_ratios_order": min_max_aspect_ratios_order})
+    return boxes, var
+
+
+def density_prior_box(input, image, densities=None, fixed_sizes=None,
+                      fixed_ratios=None, variance=(0.1, 0.1, 0.2, 0.2),
+                      clip=False, steps=(0.0, 0.0), offset=0.5,
+                      flatten_to_2d=False, name=None):
+    helper = LayerHelper("density_prior_box", name=name)
+    boxes = helper.create_variable_for_type_inference(input.dtype)
+    var = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="density_prior_box",
+        inputs={"Input": [input], "Image": [image]},
+        outputs={"Boxes": [boxes], "Variances": [var]},
+        attrs={"densities": [int(v) for v in (densities or [])],
+               "fixed_sizes": [float(v) for v in (fixed_sizes or [])],
+               "fixed_ratios": [float(v) for v in (fixed_ratios or [])],
+               "variances": [float(v) for v in variance], "clip": clip,
+               "step_w": float(steps[0]), "step_h": float(steps[1]),
+               "offset": offset, "flatten_to_2d": flatten_to_2d})
+    return boxes, var
+
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
+                     variance=(0.1, 0.1, 0.2, 0.2), stride=None, offset=0.5,
+                     name=None):
+    helper = LayerHelper("anchor_generator", name=name)
+    anchors = helper.create_variable_for_type_inference(input.dtype)
+    var = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="anchor_generator", inputs={"Input": [input]},
+        outputs={"Anchors": [anchors], "Variances": [var]},
+        attrs={"anchor_sizes": [float(v) for v in (anchor_sizes or [64.0])],
+               "aspect_ratios": [float(v) for v in (aspect_ratios or [1.0])],
+               "variances": [float(v) for v in variance],
+               "stride": [float(v) for v in (stride or [16.0, 16.0])],
+               "offset": offset})
+    return anchors, var
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, name=None,
+              axis=0):
+    helper = LayerHelper("box_coder", name=name)
+    out = helper.create_variable_for_type_inference(target_box.dtype)
+    inputs = {"PriorBox": [prior_box], "TargetBox": [target_box]}
+    attrs = {"code_type": code_type, "box_normalized": box_normalized,
+             "axis": axis}
+    if isinstance(prior_box_var, Variable):
+        inputs["PriorBoxVar"] = [prior_box_var]
+    elif prior_box_var is not None:
+        attrs["variance"] = [float(v) for v in prior_box_var]
+    helper.append_op(type="box_coder", inputs=inputs,
+                     outputs={"OutputBox": [out]}, attrs=attrs)
+    return out
+
+
+box_clip = _simple("box_clip", {"input": "Input", "im_info": "ImInfo"})
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None):
+    helper = LayerHelper("yolo_box", name=name)
+    boxes = helper.create_variable_for_type_inference(x.dtype)
+    scores = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="yolo_box", inputs={"X": [x], "ImgSize": [img_size]},
+        outputs={"Boxes": [boxes], "Scores": [scores]},
+        attrs={"anchors": [int(v) for v in anchors], "class_num": class_num,
+               "conf_thresh": conf_thresh,
+               "downsample_ratio": downsample_ratio, "clip_bbox": clip_bbox})
+    return boxes, scores
+
+
+def bipartite_match(dist_matrix, match_type="bipartite", dist_threshold=0.5,
+                    name=None):
+    helper = LayerHelper("bipartite_match", name=name)
+    idx = helper.create_variable_for_type_inference("int32")
+    d = helper.create_variable_for_type_inference(dist_matrix.dtype)
+    helper.append_op(
+        type="bipartite_match", inputs={"DistMat": [dist_matrix]},
+        outputs={"ColToRowMatchIndices": [idx], "ColToRowMatchDist": [d]},
+        attrs={"match_type": match_type, "dist_threshold": dist_threshold})
+    return idx, d
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=0, name=None):
+    helper = LayerHelper("target_assign", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    w = helper.create_variable_for_type_inference("float32")
+    inputs = {"X": [input], "MatchIndices": [matched_indices]}
+    if negative_indices is not None:
+        inputs["NegIndices"] = [negative_indices]
+    helper.append_op(type="target_assign", inputs=inputs,
+                     outputs={"Out": [out], "OutWeight": [w]},
+                     attrs={"mismatch_value": mismatch_value})
+    return out, w
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                   nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                   background_label=0, name=None):
+    helper = LayerHelper("multiclass_nms", name=name)
+    out = helper.create_variable_for_type_inference(bboxes.dtype)
+    helper.append_op(
+        type="multiclass_nms", inputs={"BBoxes": [bboxes], "Scores": [scores]},
+        outputs={"Out": [out]},
+        attrs={"background_label": background_label,
+               "score_threshold": score_threshold, "nms_top_k": nms_top_k,
+               "nms_threshold": nms_threshold, "nms_eta": nms_eta,
+               "keep_top_k": keep_top_k, "normalized": normalized})
+    return out
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, name=None):
+    helper = LayerHelper("roi_align", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="roi_align", inputs={"X": [input], "ROIs": [rois]},
+        outputs={"Out": [out]},
+        attrs={"pooled_height": pooled_height, "pooled_width": pooled_width,
+               "spatial_scale": spatial_scale,
+               "sampling_ratio": sampling_ratio})
+    return out
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0, name=None):
+    helper = LayerHelper("roi_pool", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    argmax = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="roi_pool", inputs={"X": [input], "ROIs": [rois]},
+        outputs={"Out": [out], "Argmax": [argmax]},
+        attrs={"pooled_height": pooled_height, "pooled_width": pooled_width,
+               "spatial_scale": spatial_scale})
+    return out

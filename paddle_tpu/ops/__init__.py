@@ -16,3 +16,7 @@ from . import collective  # noqa: F401
 from . import control_flow  # noqa: F401
 from . import sequence  # noqa: F401
 from . import beam_search  # noqa: F401
+from . import vision  # noqa: F401
+from . import detection  # noqa: F401
+from . import loss_extra  # noqa: F401
+from . import misc2  # noqa: F401

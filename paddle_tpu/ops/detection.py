@@ -1,0 +1,452 @@
+"""Detection ops (parity: paddle/fluid/operators/detection/).
+
+Static-shape XLA designs: NMS keeps a fixed-size candidate set with -1
+padding (the reference emits a ragged LoDTensor); box/anchor generators and
+coders are pure jnp math.  Covered: prior_box, density_prior_box,
+anchor_generator, box_coder, iou_similarity, box_clip, yolo_box,
+bipartite_match, target_assign, multiclass_nms, roi_align, roi_pool.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+
+
+def _box_area(b):
+    return jnp.maximum(b[..., 2] - b[..., 0], 0) * jnp.maximum(
+        b[..., 3] - b[..., 1], 0)
+
+
+def _iou(a, b, eps=1e-10):
+    """a [N,4], b [M,4] -> [N,M] IoU (xmin,ymin,xmax,ymax)."""
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = _box_area(a)[:, None] + _box_area(b)[None, :] - inter
+    return inter / jnp.maximum(union, eps)
+
+
+@register_op("iou_similarity", inputs=("X", "Y"), outputs=("Out",),
+             attrs={"box_normalized": True}, grad_maker=None)
+def iou_similarity(ctx, x, y, box_normalized=True):
+    return _iou(x, y)
+
+
+@register_op("prior_box", inputs=("Input", "Image"),
+             outputs=("Boxes", "Variances"),
+             attrs={"min_sizes": [], "max_sizes": [], "aspect_ratios": [1.0],
+                    "variances": [0.1, 0.1, 0.2, 0.2], "flip": False,
+                    "clip": False, "step_w": 0.0, "step_h": 0.0,
+                    "offset": 0.5, "min_max_aspect_ratios_order": False},
+             grad_maker=None)
+def prior_box(ctx, feat, image, min_sizes=(), max_sizes=(),
+              aspect_ratios=(1.0,), variances=(0.1, 0.1, 0.2, 0.2),
+              flip=False, clip=False, step_w=0.0, step_h=0.0, offset=0.5,
+              min_max_aspect_ratios_order=False):
+    """SSD prior boxes (detection/prior_box_op.cc): feat [N,C,H,W],
+    image [N,C,IH,IW] -> boxes [H,W,A,4] normalized."""
+    H, W = feat.shape[2], feat.shape[3]
+    IH, IW = image.shape[2], image.shape[3]
+    sw = step_w or IW / W
+    sh = step_h or IH / H
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if all(abs(ar - a) > 1e-6 for a in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+    whs = []
+    for ms in min_sizes:
+        if min_max_aspect_ratios_order:
+            # reference flag (prior_box_op.cc): min square, max square, then
+            # the remaining aspect-ratio boxes — matches pretrained SSD
+            # weight layouts
+            whs.append((ms, ms))
+            if max_sizes:
+                mx = max_sizes[list(min_sizes).index(ms)]
+                whs.append(((ms * mx) ** 0.5, (ms * mx) ** 0.5))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                whs.append((ms * (ar ** 0.5), ms / (ar ** 0.5)))
+        else:
+            for ar in ars:
+                whs.append((ms * (ar ** 0.5), ms / (ar ** 0.5)))
+            if max_sizes:
+                mx = max_sizes[list(min_sizes).index(ms)]
+                whs.append(((ms * mx) ** 0.5, (ms * mx) ** 0.5))
+    whs = jnp.asarray(whs, jnp.float32)          # [A, 2]
+    cx = (jnp.arange(W) + offset) * sw
+    cy = (jnp.arange(H) + offset) * sh
+    gy, gx = jnp.meshgrid(cy, cx, indexing="ij")  # [H, W]
+    centers = jnp.stack([gx, gy], -1)[:, :, None, :]  # [H,W,1,2]
+    half = whs[None, None, :, :] / 2
+    mins = (centers - half) / jnp.asarray([IW, IH], jnp.float32)
+    maxs = (centers + half) / jnp.asarray([IW, IH], jnp.float32)
+    boxes = jnp.concatenate([mins, maxs], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32), boxes.shape)
+    return boxes, var
+
+
+@register_op("density_prior_box", inputs=("Input", "Image"),
+             outputs=("Boxes", "Variances"),
+             attrs={"densities": [], "fixed_sizes": [], "fixed_ratios": [],
+                    "variances": [0.1, 0.1, 0.2, 0.2], "clip": False,
+                    "step_w": 0.0, "step_h": 0.0, "offset": 0.5,
+                    "flatten_to_2d": False},
+             grad_maker=None)
+def density_prior_box(ctx, feat, image, densities=(), fixed_sizes=(),
+                      fixed_ratios=(), variances=(0.1, 0.1, 0.2, 0.2),
+                      clip=False, step_w=0.0, step_h=0.0, offset=0.5,
+                      flatten_to_2d=False):
+    H, W = feat.shape[2], feat.shape[3]
+    IH, IW = image.shape[2], image.shape[3]
+    sw = step_w or IW / W
+    sh = step_h or IH / H
+    whs, offs = [], []
+    for size, dens in zip(fixed_sizes, densities):
+        for ar in (fixed_ratios or [1.0]):
+            w = size * (ar ** 0.5)
+            h = size / (ar ** 0.5)
+            step = 1.0 / dens
+            for di in range(dens):
+                for dj in range(dens):
+                    offs.append(((dj + 0.5) * step - 0.5,
+                                 (di + 0.5) * step - 0.5))
+                    whs.append((w, h))
+    whs = jnp.asarray(whs, jnp.float32)
+    offs = jnp.asarray(offs, jnp.float32)
+    cx = (jnp.arange(W) + offset) * sw
+    cy = (jnp.arange(H) + offset) * sh
+    gy, gx = jnp.meshgrid(cy, cx, indexing="ij")
+    centers = jnp.stack([gx, gy], -1)[:, :, None, :]
+    centers = centers + offs[None, None] * jnp.asarray([sw, sh], jnp.float32)
+    half = whs[None, None] / 2
+    mins = (centers - half) / jnp.asarray([IW, IH], jnp.float32)
+    maxs = (centers + half) / jnp.asarray([IW, IH], jnp.float32)
+    boxes = jnp.concatenate([mins, maxs], -1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32), boxes.shape)
+    if flatten_to_2d:
+        boxes = boxes.reshape(-1, 4)
+        var = var.reshape(-1, 4)
+    return boxes, var
+
+
+@register_op("anchor_generator", inputs=("Input",),
+             outputs=("Anchors", "Variances"),
+             attrs={"anchor_sizes": [64.0], "aspect_ratios": [1.0],
+                    "variances": [0.1, 0.1, 0.2, 0.2],
+                    "stride": [16.0, 16.0], "offset": 0.5},
+             grad_maker=None)
+def anchor_generator(ctx, feat, anchor_sizes=(64.0,), aspect_ratios=(1.0,),
+                     variances=(0.1, 0.1, 0.2, 0.2), stride=(16.0, 16.0),
+                     offset=0.5):
+    """RPN anchors in pixel coords (detection/anchor_generator_op.cc)."""
+    H, W = feat.shape[2], feat.shape[3]
+    whs = []
+    for ar in aspect_ratios:
+        for s in anchor_sizes:
+            whs.append((s * (ar ** -0.5), s * (ar ** 0.5)))
+    whs = jnp.asarray(whs, jnp.float32)
+    cx = (jnp.arange(W) + offset) * stride[0]
+    cy = (jnp.arange(H) + offset) * stride[1]
+    gy, gx = jnp.meshgrid(cy, cx, indexing="ij")
+    centers = jnp.stack([gx, gy], -1)[:, :, None, :]
+    half = whs[None, None] / 2
+    anchors = jnp.concatenate([centers - half, centers + half], -1)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32), anchors.shape)
+    return anchors, var
+
+
+@register_op("box_coder", inputs=("PriorBox", "PriorBoxVar", "TargetBox"),
+             outputs=("OutputBox",),
+             attrs={"code_type": "encode_center_size",
+                    "box_normalized": True, "axis": 0, "variance": []},
+             optional_inputs=("PriorBoxVar",), grad_maker=None)
+def box_coder(ctx, prior, prior_var, target, code_type="encode_center_size",
+              box_normalized=True, axis=0, variance=()):
+    """Encode/decode boxes against priors (detection/box_coder_op.cc)."""
+    norm = 0.0 if box_normalized else 1.0
+    pw = prior[:, 2] - prior[:, 0] + norm
+    ph = prior[:, 3] - prior[:, 1] + norm
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    if prior_var is not None:
+        pv = prior_var
+    elif variance:
+        pv = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                              prior.shape)
+    else:
+        pv = jnp.ones_like(prior)
+    if code_type.startswith("encode"):
+        tw = target[:, 2] - target[:, 0] + norm
+        th = target[:, 3] - target[:, 1] + norm
+        tcx = target[:, 0] + tw * 0.5
+        tcy = target[:, 1] + th * 0.5
+        # target rows x prior rows: [T, P, 4]
+        out = jnp.stack([
+            (tcx[:, None] - pcx[None]) / pw[None] / pv[None, :, 0],
+            (tcy[:, None] - pcy[None]) / ph[None] / pv[None, :, 1],
+            jnp.log(jnp.maximum(tw[:, None] / pw[None], 1e-10)) / pv[None, :, 2],
+            jnp.log(jnp.maximum(th[:, None] / ph[None], 1e-10)) / pv[None, :, 3],
+        ], axis=-1)
+        return out
+    # decode: target [N, P, 4] or [P, C*4] style; support [P, 4] & [N, P, 4]
+    t = target
+    if t.ndim == 2:
+        t = t[None]
+    dx = pv[None, :, 0] * t[..., 0]
+    dy = pv[None, :, 1] * t[..., 1]
+    dw = pv[None, :, 2] * t[..., 2]
+    dh = pv[None, :, 3] * t[..., 3]
+    ocx = dx * pw[None] + pcx[None]
+    ocy = dy * ph[None] + pcy[None]
+    ow = jnp.exp(dw) * pw[None]
+    oh = jnp.exp(dh) * ph[None]
+    out = jnp.stack([ocx - ow * 0.5, ocy - oh * 0.5,
+                     ocx + ow * 0.5 - norm, ocy + oh * 0.5 - norm], axis=-1)
+    return out if target.ndim == 3 else out[0]
+
+
+@register_op("box_clip", inputs=("Input", "ImInfo"), outputs=("Output",),
+             grad_maker=None)
+def box_clip(ctx, boxes, im_info):
+    """Clip boxes to image bounds (detection/box_clip_op.cc); im_info
+    [N, 3] = (h, w, scale)."""
+    h = im_info[0, 0] / im_info[0, 2] - 1
+    w = im_info[0, 1] / im_info[0, 2] - 1
+    return jnp.stack([
+        jnp.clip(boxes[..., 0], 0, w), jnp.clip(boxes[..., 1], 0, h),
+        jnp.clip(boxes[..., 2], 0, w), jnp.clip(boxes[..., 3], 0, h),
+    ], axis=-1)
+
+
+@register_op("yolo_box", inputs=("X", "ImgSize"), outputs=("Boxes", "Scores"),
+             attrs={"anchors": [], "class_num": 1, "conf_thresh": 0.01,
+                    "downsample_ratio": 32, "clip_bbox": True},
+             grad_maker=None)
+def yolo_box(ctx, x, img_size, anchors=(), class_num=1, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True):
+    """YOLOv3 head decode (detection/yolo_box_op.cc): x [N, A*(5+C), H, W]
+    -> boxes [N, A*H*W, 4], scores [N, A*H*W, C]."""
+    N, _, H, W = x.shape
+    A = len(anchors) // 2
+    C = class_num
+    anc = jnp.asarray(anchors, jnp.float32).reshape(A, 2)
+    x = x.reshape(N, A, 5 + C, H, W)
+    tx, ty, tw, th, conf = x[:, :, 0], x[:, :, 1], x[:, :, 2], x[:, :, 3], x[:, :, 4]
+    cls = x[:, :, 5:]
+    gx = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+    gy = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+    bx = (jax.nn.sigmoid(tx) + gx) / W
+    by = (jax.nn.sigmoid(ty) + gy) / H
+    input_w = downsample_ratio * W
+    input_h = downsample_ratio * H
+    bw = jnp.exp(tw) * anc[None, :, 0, None, None] / input_w
+    bh = jnp.exp(th) * anc[None, :, 1, None, None] / input_h
+    conf_s = jax.nn.sigmoid(conf)
+    mask = conf_s > conf_thresh
+    imgh = img_size[:, 0].astype(jnp.float32)[:, None, None, None]
+    imgw = img_size[:, 1].astype(jnp.float32)[:, None, None, None]
+    x0 = (bx - bw / 2) * imgw
+    y0 = (by - bh / 2) * imgh
+    x1 = (bx + bw / 2) * imgw
+    y1 = (by + bh / 2) * imgh
+    if clip_bbox:
+        x0 = jnp.clip(x0, 0, imgw - 1)
+        y0 = jnp.clip(y0, 0, imgh - 1)
+        x1 = jnp.clip(x1, 0, imgw - 1)
+        y1 = jnp.clip(y1, 0, imgh - 1)
+    boxes = jnp.stack([x0, y0, x1, y1], axis=-1)
+    boxes = jnp.where(mask[..., None], boxes, 0.0)
+    scores = jax.nn.sigmoid(cls) * conf_s[:, :, None]
+    scores = jnp.where(mask[:, :, None], scores, 0.0)
+    boxes = boxes.transpose(0, 1, 2, 3, 4).reshape(N, A * H * W, 4)
+    scores = scores.transpose(0, 1, 3, 4, 2).reshape(N, A * H * W, C)
+    return boxes, scores
+
+
+@register_op("bipartite_match", inputs=("DistMat",),
+             outputs=("ColToRowMatchIndices", "ColToRowMatchDist"),
+             attrs={"match_type": "bipartite", "dist_threshold": 0.5},
+             grad_maker=None)
+def bipartite_match(ctx, dist, match_type="bipartite", dist_threshold=0.5):
+    """Greedy bipartite matching (detection/bipartite_match_op.cc):
+    dist [R, C] similarity; returns per-column matched row (-1 = none)."""
+    R, C = dist.shape
+
+    def step(carry, _):
+        d, col2row, col2dist = carry
+        flat = jnp.argmax(d)
+        r, c = flat // C, flat % C
+        best = d[r, c]
+        do = best > 0
+        col2row = jnp.where(do, col2row.at[c].set(r), col2row)
+        col2dist = jnp.where(do, col2dist.at[c].set(best), col2dist)
+        d = jnp.where(do, d.at[r, :].set(-1.0).at[:, c].set(-1.0), d)
+        return (d, col2row, col2dist), None
+
+    init = (dist, jnp.full((C,), -1, jnp.int32), jnp.zeros((C,), dist.dtype))
+    (d, col2row, col2dist), _ = lax.scan(step, init, None,
+                                         length=min(R, C))
+    if match_type == "per_prediction":
+        best_row = jnp.argmax(dist, axis=0)
+        best_val = jnp.max(dist, axis=0)
+        extra = (col2row < 0) & (best_val >= dist_threshold)
+        col2row = jnp.where(extra, best_row.astype(jnp.int32), col2row)
+        col2dist = jnp.where(extra, best_val, col2dist)
+    return col2row[None, :], col2dist[None, :]
+
+
+@register_op("target_assign", inputs=("X", "MatchIndices", "NegIndices"),
+             outputs=("Out", "OutWeight"), attrs={"mismatch_value": 0},
+             optional_inputs=("NegIndices",), grad_maker=None)
+def target_assign(ctx, x, match_indices, neg_indices=None, mismatch_value=0):
+    """Gather per-prior targets by match indices
+    (detection/target_assign_op.cc): x [N, M, K], match [N, P]."""
+    mi = match_indices.astype(jnp.int32)
+    gathered = jnp.take_along_axis(
+        x, jnp.clip(mi, 0, x.shape[1] - 1)[..., None], axis=1)
+    matched = (mi >= 0)[..., None]
+    out = jnp.where(matched, gathered, mismatch_value)
+    weight = matched.astype(jnp.float32)
+    return out, weight
+
+
+@register_op("multiclass_nms", inputs=("BBoxes", "Scores"), outputs=("Out",),
+             attrs={"background_label": 0, "score_threshold": 0.0,
+                    "nms_top_k": 64, "nms_threshold": 0.3, "nms_eta": 1.0,
+                    "keep_top_k": 16, "normalized": True},
+             grad_maker=None)
+def multiclass_nms(ctx, bboxes, scores, background_label=0,
+                   score_threshold=0.0, nms_top_k=64, nms_threshold=0.3,
+                   nms_eta=1.0, keep_top_k=16, normalized=True):
+    """Per-class NMS (detection/multiclass_nms_op.cc).  Static-shape
+    output: [N, keep_top_k, 6] rows (class, score, x0, y0, x1, y1), padded
+    with class = -1 (the reference emits a ragged LoD result)."""
+    N, M, _ = bboxes.shape
+    C = scores.shape[1]
+    k = min(nms_top_k, M)
+
+    def nms_one_class(boxes, sc):
+        val, idx = lax.top_k(sc, k)
+        b = boxes[idx]
+        iou = _iou(b, b)
+        keep = jnp.ones((k,), bool)
+
+        def body(i, keep):
+            sup = (iou[i] > nms_threshold) & (jnp.arange(k) > i) & keep[i]
+            return keep & ~sup
+
+        keep = lax.fori_loop(0, k, body, keep)
+        keep = keep & (val > score_threshold)
+        return val, idx, keep
+
+    def per_image(boxes, sc):
+        fg = [c for c in range(C) if c != background_label]
+        if not fg:
+            # single class flagged as background: treat it as foreground
+            # (a 1-class detector with the default background_label=0)
+            fg = list(range(C))
+        outs = []
+        for c in fg:
+            val, idx, keep = nms_one_class(boxes, sc[c])
+            cls = jnp.full((k,), c, jnp.float32)
+            row = jnp.concatenate([
+                jnp.where(keep, cls, -1.0)[:, None],
+                val[:, None], boxes[idx]], axis=1)
+            outs.append(jnp.where(keep[:, None], row,
+                                  jnp.full_like(row, -1.0)))
+        allr = jnp.concatenate(outs, axis=0)
+        order = jnp.argsort(-jnp.where(allr[:, 0] >= 0, allr[:, 1], -1e30))
+        return allr[order][:keep_top_k]
+
+    return jax.vmap(per_image)(bboxes, scores)
+
+
+def _roi_pool_common(x, rois, spatial_scale, ph, pw, align):
+    """Shared gather for roi_pool/roi_align on [N,C,H,W] with rois [R,5]
+    (batch_idx, x0, y0, x1, y1)."""
+    N, C, H, W = x.shape
+
+    def one(roi):
+        b = roi[0].astype(jnp.int32)
+        img = x[b]
+        if align:
+            x0 = roi[1] * spatial_scale
+            y0 = roi[2] * spatial_scale
+            x1 = roi[3] * spatial_scale
+            y1 = roi[4] * spatial_scale
+            rw = jnp.maximum(x1 - x0, 1.0)
+            rh = jnp.maximum(y1 - y0, 1.0)
+            # 1 sample per bin center, bilinear
+            bx = x0 + (jnp.arange(pw) + 0.5) * rw / pw
+            by = y0 + (jnp.arange(ph) + 0.5) * rh / ph
+            gy, gx = jnp.meshgrid(by, bx, indexing="ij")
+            x0i = jnp.clip(jnp.floor(gx), 0, W - 1).astype(jnp.int32)
+            y0i = jnp.clip(jnp.floor(gy), 0, H - 1).astype(jnp.int32)
+            x1i = jnp.clip(x0i + 1, 0, W - 1)
+            y1i = jnp.clip(y0i + 1, 0, H - 1)
+            wx = jnp.clip(gx - x0i, 0, 1)
+            wy = jnp.clip(gy - y0i, 0, 1)
+            v00 = img[:, y0i, x0i]
+            v01 = img[:, y0i, x1i]
+            v10 = img[:, y1i, x0i]
+            v11 = img[:, y1i, x1i]
+            return (v00 * (1 - wx) * (1 - wy) + v01 * wx * (1 - wy)
+                    + v10 * (1 - wx) * wy + v11 * wx * wy)
+        # roi_pool: max over integer bins
+        x0 = jnp.round(roi[1] * spatial_scale).astype(jnp.int32)
+        y0 = jnp.round(roi[2] * spatial_scale).astype(jnp.int32)
+        x1 = jnp.maximum(jnp.round(roi[3] * spatial_scale).astype(jnp.int32),
+                         x0 + 1)
+        y1 = jnp.maximum(jnp.round(roi[4] * spatial_scale).astype(jnp.int32),
+                         y0 + 1)
+        ys = jnp.arange(H)
+        xs = jnp.arange(W)
+        out = jnp.zeros((C, ph, pw), x.dtype)
+        for i in range(ph):
+            for j in range(pw):
+                by0 = y0 + ((y1 - y0) * i) // ph
+                by1 = jnp.maximum(y0 + ((y1 - y0) * (i + 1) + ph - 1) // ph,
+                                  by0 + 1)
+                bx0 = x0 + ((x1 - x0) * j) // pw
+                bx1 = jnp.maximum(x0 + ((x1 - x0) * (j + 1) + pw - 1) // pw,
+                                  bx0 + 1)
+                m = ((ys[:, None] >= by0) & (ys[:, None] < by1)
+                     & (xs[None, :] >= bx0) & (xs[None, :] < bx1))
+                out = out.at[:, i, j].set(
+                    jnp.max(jnp.where(m[None], img, -jnp.inf), axis=(1, 2)))
+        return out
+
+    return jax.vmap(one)(rois)
+
+
+@register_op("roi_align", inputs=("X", "ROIs"), outputs=("Out",),
+             attrs={"pooled_height": 1, "pooled_width": 1,
+                    "spatial_scale": 1.0, "sampling_ratio": -1},
+             no_grad_inputs=("ROIs",))
+def roi_align(ctx, x, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1):
+    """ROI align (detection/roi_align_op.cc); rois [R, 5] with leading
+    batch index (dense replacement for the reference's LoD rois)."""
+    return _roi_pool_common(x, rois, spatial_scale, pooled_height,
+                            pooled_width, align=True)
+
+
+@register_op("roi_pool", inputs=("X", "ROIs"), outputs=("Out", "Argmax"),
+             attrs={"pooled_height": 1, "pooled_width": 1,
+                    "spatial_scale": 1.0},
+             no_grad_inputs=("ROIs",))
+def roi_pool(ctx, x, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0):
+    out = _roi_pool_common(x, rois, spatial_scale, pooled_height,
+                           pooled_width, align=False)
+    return out, jnp.zeros(out.shape, jnp.int32)

@@ -36,7 +36,10 @@ def softmax_with_cross_entropy(ctx, logits, label, soft_label=False,
                                ignore_index=-100, numeric_stable_mode=True,
                                axis=-1):
     ax = axis if axis >= 0 else logits.ndim + axis
-    logp = jax.nn.log_softmax(logits, axis=ax)
+    # the loss head always computes in f32: under the bf16-carry AMP policy
+    # logits arrive as bf16, and an 8-bit-mantissa log-softmax would cost
+    # loss-curve parity (BASELINE.md tolerance tiers)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=ax)
     softmax = jnp.exp(logp)
     if soft_label:
         loss = -jnp.sum(label * logp, axis=ax, keepdims=True)
@@ -57,7 +60,7 @@ def softmax_with_cross_entropy(ctx, logits, label, soft_label=False,
     no_grad_inputs=("Label",),
 )
 def cross_entropy(ctx, x, label, soft_label=False, ignore_index=-100):
-    logp = jnp.log(jnp.clip(x, 1e-20, None))
+    logp = jnp.log(jnp.clip(x.astype(jnp.float32), 1e-20, None))
     if soft_label:
         return -jnp.sum(label * logp, axis=-1, keepdims=True)
     picked = _take_label(logp, label, x.ndim - 1)

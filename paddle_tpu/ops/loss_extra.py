@@ -1,0 +1,226 @@
+"""Additional loss / metric ops.
+
+Parity (paddle/fluid/operators/): bpr_loss_op.cc, rank_loss_op.cc,
+margin_rank_loss_op.cc, sigmoid_focal_loss_op.cc,
+teacher_student_sigmoid_loss_op.cc, mean_iou_op.cc, center_loss_op.cc,
+warpctc_op.cc (CTC forward via lax.scan instead of the vendored warp-ctc
+CUDA lib), edit_distance_op.cc.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+
+_NEG_INF = -1e30
+
+
+@register_op("bpr_loss", inputs=("X", "Label"), outputs=("Y",),
+             no_grad_inputs=("Label",))
+def bpr_loss(ctx, x, label):
+    """Bayesian personalized ranking loss (bpr_loss_op.cc): for each row,
+    -mean_j log(sigmoid(x[label] - x[j])) over j != label."""
+    n, c = x.shape
+    lbl = label.reshape(-1).astype(jnp.int32)
+    pos = jnp.take_along_axis(x, lbl[:, None], axis=1)
+    diff = pos - x
+    logsig = jax.nn.log_sigmoid(diff)
+    mask = jnp.ones((n, c), bool).at[jnp.arange(n), lbl].set(False)
+    loss = -jnp.sum(jnp.where(mask, logsig, 0.0), axis=1) / (c - 1)
+    return loss[:, None]
+
+
+@register_op("rank_loss", inputs=("Label", "Left", "Right"),
+             outputs=("Out",), no_grad_inputs=("Label",))
+def rank_loss(ctx, label, left, right):
+    """RankNet pairwise loss (rank_loss_op.cc)."""
+    d = left - right
+    return d * (1 - label) + jnp.log1p(jnp.exp(-jnp.abs(d))) + jnp.maximum(
+        -d, 0.0)
+
+
+@register_op("margin_rank_loss", inputs=("Label", "X1", "X2"),
+             outputs=("Out", "Activated"), attrs={"margin": 0.0},
+             no_grad_inputs=("Label",))
+def margin_rank_loss(ctx, label, x1, x2, margin=0.0):
+    out = jnp.maximum(0.0, -label * (x1 - x2) + margin)
+    return out, (out > 0).astype(x1.dtype)
+
+
+@register_op("sigmoid_focal_loss", inputs=("X", "Label", "FgNum"),
+             outputs=("Out",), attrs={"gamma": 2.0, "alpha": 0.25},
+             no_grad_inputs=("Label", "FgNum"))
+def sigmoid_focal_loss(ctx, x, label, fg_num, gamma=2.0, alpha=0.25):
+    """Focal loss (sigmoid_focal_loss_op.cc): x [N, C] logits, label [N, 1]
+    in [0, C] with 0 = background (class c is column c-1)."""
+    n, c = x.shape
+    lbl = label.reshape(-1).astype(jnp.int32)
+    target = (lbl[:, None] == jnp.arange(1, c + 1)[None, :]).astype(x.dtype)
+    p = jax.nn.sigmoid(x)
+    ce = jnp.logaddexp(0.0, jnp.where(target == 1, -x, x))
+    p_t = jnp.where(target == 1, p, 1 - p)
+    a_t = jnp.where(target == 1, alpha, 1 - alpha)
+    fg = jnp.maximum(fg_num.reshape(()).astype(x.dtype), 1.0)
+    return a_t * jnp.power(1 - p_t, gamma) * ce / fg
+
+
+@register_op("teacher_student_sigmoid_loss", inputs=("X", "Label"),
+             outputs=("Y",), attrs={"soft_max_up_bound": 15.0,
+                                    "soft_max_lower_bound": -15.0},
+             no_grad_inputs=("Label",))
+def teacher_student_sigmoid_loss(ctx, x, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    """CTR distillation loss (teacher_student_sigmoid_loss_op.cc): label<0
+    is teacher score -(label+1); else binary click label."""
+    x = jnp.clip(x, soft_max_lower_bound, soft_max_up_bound)
+    lbl = label.reshape(x.shape)
+    ce = jnp.logaddexp(0.0, x) - x * (lbl > 0).astype(x.dtype)
+    teacher = -(lbl + 1)
+    tce = jnp.logaddexp(0.0, x) - x * teacher
+    return jnp.where(lbl < 0, tce, ce)
+
+
+@register_op("mean_iou", inputs=("Predictions", "Labels"),
+             outputs=("OutMeanIou", "OutWrong", "OutCorrect"),
+             attrs={"num_classes": 2}, grad_maker=None)
+def mean_iou(ctx, pred, labels, num_classes=2):
+    """Mean intersection-over-union over classes (mean_iou_op.cc)."""
+    p = pred.reshape(-1).astype(jnp.int32)
+    l = labels.reshape(-1).astype(jnp.int32)
+    valid = (l >= 0) & (l < num_classes)
+    cid = jnp.arange(num_classes)
+    inter = jnp.sum((p[:, None] == cid) & (l[:, None] == cid)
+                    & valid[:, None], axis=0).astype(jnp.float32)
+    pred_cnt = jnp.sum((p[:, None] == cid) & valid[:, None],
+                       axis=0).astype(jnp.float32)
+    lbl_cnt = jnp.sum((l[:, None] == cid) & valid[:, None],
+                      axis=0).astype(jnp.float32)
+    union = pred_cnt + lbl_cnt - inter
+    iou = jnp.where(union > 0, inter / jnp.maximum(union, 1.0), 0.0)
+    present = (union > 0).sum().astype(jnp.float32)
+    miou = jnp.sum(iou) / jnp.maximum(present, 1.0)
+    wrong = (lbl_cnt - inter).astype(jnp.int32)
+    correct = inter.astype(jnp.int32)
+    return miou, wrong, correct
+
+
+@register_op("center_loss", inputs=("X", "Label", "Centers", "CenterUpdateRate"),
+             outputs=("CentersOut", "SampleCenterDiff", "Loss"),
+             attrs={"cluster_num": 2, "need_update": True},
+             no_grad_inputs=("Label", "Centers", "CenterUpdateRate"))
+def center_loss(ctx, x, label, centers, update_rate, cluster_num=2,
+                need_update=True):
+    """Center loss (center_loss_op.cc): pulls features toward per-class
+    centers; centers update by averaged in-batch diffs."""
+    lbl = label.reshape(-1).astype(jnp.int32)
+    cx = centers[lbl]                      # [N, D]
+    diff = x - cx
+    loss = 0.5 * jnp.sum(jnp.square(diff), axis=1, keepdims=True)
+    if need_update:
+        rate = update_rate.reshape(())
+        counts = jnp.zeros((centers.shape[0],), x.dtype).at[lbl].add(1.0)
+        sums = jnp.zeros_like(centers).at[lbl].add(diff)
+        centers_new = centers + rate * sums / (counts[:, None] + 1.0)
+    else:
+        centers_new = centers
+    return centers_new, diff, loss
+
+
+@register_op("warpctc", inputs=("Logits", "Label"),
+             outputs=("WarpCTCGrad", "Loss"),
+             attrs={"blank": 0, "norm_by_times": False},
+             no_grad_inputs=("Label",),
+             grad_maker="auto")
+def warpctc(ctx, logits, label, blank=0, norm_by_times=False):
+    """CTC loss (warpctc_op.cc) on dense inputs: logits [B, T, C] (padded),
+    label [B, L] padded with -1.  Forward-algorithm in log space via
+    lax.scan — the TPU-native replacement for the vendored warp-ctc CUDA
+    library.  Returns (grad placeholder, loss [B, 1]); gradients flow via
+    the auto vjp of this forward."""
+    if logits.ndim == 2:
+        logits = logits[None]
+    B, T, C = logits.shape
+    L = label.shape[1]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+
+    # extended label: blank, l1, blank, l2, ... blank (length 2L+1)
+    lbl = label.astype(jnp.int32)
+    ext = jnp.full((B, 2 * L + 1), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(jnp.where(lbl >= 0, lbl, blank))
+    valid_ext = jnp.ones((B, 2 * L + 1), bool)
+    valid_ext = valid_ext.at[:, 1::2].set(lbl >= 0)
+    # label length per batch
+    lab_len = jnp.sum(lbl >= 0, axis=1)
+    ext_len = 2 * lab_len + 1
+
+    # can skip from s-2 to s when ext[s] != blank and ext[s] != ext[s-2]
+    ext_m2 = jnp.pad(ext, ((0, 0), (2, 0)), constant_values=-2)[:, :-2]
+    can_skip = (ext != blank) & (ext != ext_m2)
+
+    a0 = jnp.full((B, 2 * L + 1), _NEG_INF)
+    a0 = a0.at[:, 0].set(logp[:, 0, blank])
+    first_lbl = jnp.take_along_axis(
+        logp[:, 0, :], jnp.clip(ext[:, 1:2], 0, C - 1), axis=1)[:, 0]
+    a0 = a0.at[:, 1].set(jnp.where(lab_len > 0, first_lbl, _NEG_INF))
+
+    def step(alpha, t):
+        lp = jnp.take_along_axis(logp[:, t, :], jnp.clip(ext, 0, C - 1),
+                                 axis=1)
+        am1 = jnp.pad(alpha, ((0, 0), (1, 0)),
+                      constant_values=_NEG_INF)[:, :-1]
+        am2 = jnp.pad(alpha, ((0, 0), (2, 0)),
+                      constant_values=_NEG_INF)[:, :-2]
+        am2 = jnp.where(can_skip, am2, _NEG_INF)
+        new = jnp.logaddexp(jnp.logaddexp(alpha, am1), am2) + lp
+        return new, None
+
+    alpha, _ = lax.scan(step, a0, jnp.arange(1, T))
+    idx_last = jnp.maximum(ext_len - 1, 0)
+    idx_prev = jnp.maximum(ext_len - 2, 0)
+    last = jnp.take_along_axis(alpha, idx_last[:, None], axis=1)[:, 0]
+    prev = jnp.take_along_axis(alpha, idx_prev[:, None], axis=1)[:, 0]
+    loss = -jnp.logaddexp(last, prev)
+    if norm_by_times:
+        loss = loss / T
+    return jnp.zeros_like(logits), loss[:, None]
+
+
+@register_op("edit_distance", inputs=("Hyps", "Refs"),
+             outputs=("Out", "SequenceNum"),
+             attrs={"normalized": False}, grad_maker=None)
+def edit_distance(ctx, hyps, refs, normalized=False):
+    """Levenshtein distance per pair (edit_distance_op.cc) on dense int
+    sequences padded with -1."""
+    B, Lh = hyps.shape
+    Lr = refs.shape[1]
+    h = hyps.astype(jnp.int32)
+    r = refs.astype(jnp.int32)
+    hlen = jnp.sum(h >= 0, axis=1)
+    rlen = jnp.sum(r >= 0, axis=1)
+
+    def one(hrow, rrow, hl, rl):
+        row0 = jnp.arange(Lr + 1, dtype=jnp.float32)
+
+        def outer(i, row):
+            def inner(j, cur):
+                cost = jnp.where(hrow[i - 1] == rrow[j - 1], 0.0, 1.0)
+                val = jnp.minimum(jnp.minimum(cur[j - 1] + 1, row[j] + 1),
+                                  row[j - 1] + cost)
+                return cur.at[j].set(val)
+
+            cur = jnp.full((Lr + 1,), 0.0).at[0].set(i * 1.0)
+            cur = lax.fori_loop(1, Lr + 1, inner, cur)
+            return cur
+
+        def body(i, row):
+            return jnp.where(i <= hl, outer(i, row), row)
+
+        final = lax.fori_loop(1, Lh + 1, body, row0)
+        d = final[rl]
+        return jnp.where(rl == 0, hl.astype(jnp.float32), d)
+
+    d = jax.vmap(one)(h, r, hlen, rlen)
+    if normalized:
+        d = d / jnp.maximum(rlen.astype(jnp.float32), 1.0)
+    return d[:, None], jnp.asarray(B, jnp.int64)

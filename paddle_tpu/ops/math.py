@@ -34,8 +34,8 @@ def _amp_dot(ctx, x, y, contract_fn):
     if ctx is not None and ctx.amp_bf16() and x.dtype in (jnp.float32,
                                                           jnp.bfloat16):
         out = contract_fn(x.astype(jnp.bfloat16), y.astype(jnp.bfloat16))
-        # bf16-carry: keep bf16 activations bf16; f32 inputs (e.g. the loss
-        # head) cast back up so downstream softmax/CE stay f32
+        # bf16-carry: bf16 activations stay bf16 (the loss lowerings upcast
+        # to f32 themselves); f32 inputs cast back up
         return out if x.dtype == jnp.bfloat16 else out.astype(jnp.float32)
     return contract_fn(x, y)
 

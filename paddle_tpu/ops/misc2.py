@@ -1,0 +1,165 @@
+"""Misc tensor ops rounding out the layer API surface.
+
+Parity (paddle/fluid/operators/): multiplex_op.cc, crop_op.cc /
+crop_tensor_op.cc, pad_constant_like_op.cc, scatter_nd_add_op.cc,
+shard_index_op.cc, sampling_id_op.cc, random_crop_op.cc, unique_op.cc /
+unique_with_counts_op.cc (padded static-shape variant), gather_tree_op.cc,
+add_position_encoding_op.cc, selu_op.cc, activation_op.cc (soft_relu).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+
+
+@register_op("multiplex", inputs=("Ids", "X"), outputs=("Out",),
+             duplicable_inputs=("X",), no_grad_inputs=("Ids",))
+def multiplex(ctx, ids, xs):
+    """Row-wise select among candidate tensors (multiplex_op.cc)."""
+    stacked = jnp.stack(xs, axis=0)          # [K, N, ...]
+    idx = ids.reshape(-1).astype(jnp.int32)  # [N]
+    return stacked[idx, jnp.arange(stacked.shape[1])]
+
+
+@register_op("crop_tensor", inputs=("X",), outputs=("Out",),
+             attrs={"offsets": [], "shape": []})
+def crop_tensor(ctx, x, offsets=(), shape=()):
+    offs = list(offsets) or [0] * x.ndim
+    shp = [x.shape[i] - offs[i] if s in (-1, 0) else s
+           for i, s in enumerate(shape or list(x.shape))]
+    return lax.slice(x, offs, [o + s for o, s in zip(offs, shp)])
+
+
+@register_op("crop", inputs=("X", "Y"), outputs=("Out",),
+             attrs={"offsets": [], "shape": []},
+             optional_inputs=("Y",), no_grad_inputs=("Y",))
+def crop(ctx, x, y=None, offsets=(), shape=()):
+    shp = list(y.shape) if y is not None else list(shape)
+    return crop_tensor(ctx, x, offsets=offsets, shape=shp)
+
+
+@register_op("pad_constant_like", inputs=("X", "Y"), outputs=("Out",),
+             attrs={"pad_value": 0.0}, no_grad_inputs=("X",))
+def pad_constant_like(ctx, x, y, pad_value=0.0):
+    """Pad y up to x's shape (pad_constant_like_op.cc)."""
+    pads = [(0, xd - yd) for xd, yd in zip(x.shape, y.shape)]
+    return jnp.pad(y, pads, constant_values=pad_value)
+
+
+@register_op("scatter_nd", inputs=("Index", "Updates", "Shape"),
+             outputs=("Out",), attrs={"shape": []},
+             optional_inputs=("Shape",), no_grad_inputs=("Index", "Shape"))
+def scatter_nd(ctx, index, updates, shape_t=None, shape=()):
+    import numpy as _np
+
+    shp = [int(v) for v in (_np.asarray(shape_t) if shape_t is not None
+                            else shape)]
+    zeros = jnp.zeros(shp, updates.dtype)
+    idx = tuple(index[..., i] for i in range(index.shape[-1]))
+    return zeros.at[idx].add(updates)
+
+
+@register_op("shard_index", inputs=("X",), outputs=("Out",),
+             attrs={"index_num": 1, "nshards": 1, "shard_id": 0,
+                    "ignore_value": -1}, grad_maker=None)
+def shard_index(ctx, x, index_num=1, nshards=1, shard_id=0, ignore_value=-1):
+    """Relabel ids owned by this shard; others -> ignore_value
+    (shard_index_op.cc, model-parallel classification)."""
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (x // shard_size) == shard_id
+    return jnp.where(in_shard, x % shard_size, ignore_value)
+
+
+@register_op("sampling_id", inputs=("X",), outputs=("Out",),
+             attrs={"min": 0.0, "max": 1.0, "seed": 0}, grad_maker=None,
+             n_rng=1)
+def sampling_id(ctx, x, min=0.0, max=1.0, seed=0):
+    """Sample a column id per row from probability rows (sampling_id_op.cc)."""
+    return jax.random.categorical(ctx.rng(), jnp.log(
+        jnp.maximum(x, 1e-20)), axis=-1)
+
+
+@register_op("random_crop", inputs=("X", "Seed"), outputs=("Out", "SeedOut"),
+             attrs={"shape": [], "startup_seed": 0}, grad_maker=None,
+             optional_inputs=("Seed",), n_rng=1)
+def random_crop(ctx, x, seed=None, shape=(), startup_seed=0):
+    """Random crop of the trailing dims to `shape` (random_crop_op.cc)."""
+    shp = list(shape)
+    k = len(shp)
+    lead = x.ndim - k
+    key = ctx.rng()
+    starts = []
+    for i, s in enumerate(shp):
+        hi = x.shape[lead + i] - s
+        key, sub = jax.random.split(key)
+        starts.append(jax.random.randint(sub, (), 0, hi + 1))
+    begin = [0] * lead + starts
+    sizes = list(x.shape[:lead]) + shp
+    out = lax.dynamic_slice(x, begin, sizes)
+    return out, (seed if seed is not None else jnp.zeros((1,), jnp.int64))
+
+
+@register_op("unique_with_counts", inputs=("X",),
+             outputs=("Out", "Index", "Count"),
+             attrs={"dtype": 2}, grad_maker=None)
+def unique_with_counts(ctx, x, dtype=2):
+    """Static-shape unique (unique_with_counts_op.cc): outputs are padded
+    to len(x) (XLA needs static shapes); Count is 0 beyond the distinct
+    prefix."""
+    flat = x.reshape(-1)
+    uniq, idx, counts = jnp.unique(flat, return_inverse=True,
+                                   return_counts=True, size=flat.shape[0],
+                                   fill_value=flat[0])
+    n_uniq = jnp.sum(counts > 0)
+    counts = jnp.where(jnp.arange(flat.shape[0]) <
+                       jnp.maximum(n_uniq, 1), counts, 0)
+    return uniq, idx.reshape(x.shape).astype(jnp.int32), counts.astype(
+        jnp.int32)
+
+
+@register_op("gather_tree", inputs=("Ids", "Parents"), outputs=("Out",),
+             grad_maker=None)
+def gather_tree(ctx, ids, parents):
+    """Backtrack beam-search parent pointers (gather_tree_op.cc):
+    ids/parents [T, B, K] -> full sequences [T, B, K]."""
+    T, B, K = ids.shape
+
+    def step(beams, t):
+        # beams: [B, K] current beam slot per output column
+        out_t = jnp.take_along_axis(ids[t], beams, axis=1)
+        beams_next = jnp.take_along_axis(parents[t], beams, axis=1)
+        return beams_next, out_t
+
+    init = jnp.tile(jnp.arange(K)[None, :], (B, 1))
+    _, outs = lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+    return outs[::-1]
+
+
+@register_op("add_position_encoding", inputs=("X",), outputs=("Out",),
+             attrs={"alpha": 1.0, "beta": 1.0})
+def add_position_encoding(ctx, x, alpha=1.0, beta=1.0):
+    """Sinusoidal position encoding added to [B, T, D] input
+    (add_position_encoding_op.cc)."""
+    B, T, D = x.shape
+    half = D // 2
+    pos = jnp.arange(T, dtype=jnp.float32)[:, None]
+    div = jnp.power(10000.0, jnp.arange(half, dtype=jnp.float32) / half)
+    enc = jnp.concatenate([jnp.sin(pos / div), jnp.cos(pos / div)], axis=1)
+    if enc.shape[1] < D:
+        enc = jnp.pad(enc, ((0, 0), (0, D - enc.shape[1])))
+    return alpha * x + beta * enc[None, :, :].astype(x.dtype)
+
+
+@register_op("selu", inputs=("X",), outputs=("Out",),
+             attrs={"scale": 1.0507009873554805,
+                    "alpha": 1.6732632423543772})
+def selu(ctx, x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * (jnp.exp(x) - 1))
+
+
+@register_op("soft_relu", inputs=("X",), outputs=("Out",),
+             attrs={"threshold": 40.0})
+def soft_relu(ctx, x, threshold=40.0):
+    return jnp.log1p(jnp.exp(jnp.clip(x, -threshold, threshold)))

@@ -1,0 +1,287 @@
+"""Vision / 3-D / channel ops.
+
+Parity targets (paddle/fluid/operators/): lrn_op.cc, affine_channel_op.cc,
+shuffle_channel_op.cc, space_to_depth_op.cc, temporal_shift_op.cc,
+grid_sampler_op.cc, affine_grid_op.cc, conv_op.cc (3d), pool_op.cc (3d),
+row_conv_op.cc, bilinear_tensor_product_op.cc, spectral_norm_op.cc,
+data_norm_op.cc, fsp_op.cc.  All are jnp/lax compositions XLA fuses; convs
+ride the MXU.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+
+
+@register_op("lrn", inputs=("X",), outputs=("Out", "MidOut"),
+             attrs={"n": 5, "k": 2.0, "alpha": 1e-4, "beta": 0.75,
+                    "data_format": "NCHW"})
+def lrn(ctx, x, n=5, k=2.0, alpha=1e-4, beta=0.75, data_format="NCHW"):
+    """Local response normalization across channels (lrn_op.cc)."""
+    sq = jnp.square(x)
+    half = n // 2
+    # sum over a window of `n` channels via padded cumulative trick
+    pad = [(0, 0)] * x.ndim
+    c_ax = 1 if data_format == "NCHW" else x.ndim - 1
+    pad[c_ax] = (half, n - 1 - half)
+    sq_p = jnp.pad(sq, pad)
+    acc = jnp.zeros_like(x)
+    for i in range(n):
+        acc = acc + lax.slice_in_dim(sq_p, i, i + x.shape[c_ax], axis=c_ax)
+    mid = k + alpha * acc
+    return x / jnp.power(mid, beta), mid
+
+
+@register_op("affine_channel", inputs=("X", "Scale", "Bias"),
+             outputs=("Out",), attrs={"data_layout": "NCHW"})
+def affine_channel(ctx, x, scale, bias, data_layout="NCHW"):
+    shape = [1] * x.ndim
+    c_ax = 1 if data_layout == "NCHW" else x.ndim - 1
+    shape[c_ax] = x.shape[c_ax]
+    return x * scale.reshape(shape) + bias.reshape(shape)
+
+
+@register_op("shuffle_channel", inputs=("X",), outputs=("Out",),
+             attrs={"group": 1})
+def shuffle_channel(ctx, x, group=1):
+    n, c, h, w = x.shape
+    return x.reshape(n, group, c // group, h, w).swapaxes(1, 2).reshape(
+        n, c, h, w)
+
+
+@register_op("space_to_depth", inputs=("X",), outputs=("Out",),
+             attrs={"blocksize": 2})
+def space_to_depth(ctx, x, blocksize=2):
+    n, c, h, w = x.shape
+    b = blocksize
+    x = x.reshape(n, c, h // b, b, w // b, b)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+@register_op("temporal_shift", inputs=("X",), outputs=("Out",),
+             attrs={"seg_num": 1, "shift_ratio": 0.25})
+def temporal_shift(ctx, x, seg_num=1, shift_ratio=0.25):
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    x = x.reshape(n, seg_num, c, h, w)
+    c1 = int(c * shift_ratio)
+    c2 = int(c * 2 * shift_ratio)
+    fwd = jnp.concatenate(
+        [jnp.zeros_like(x[:, :1, :c1]), x[:, :-1, :c1]], axis=1)
+    bwd = jnp.concatenate(
+        [x[:, 1:, c1:c2], jnp.zeros_like(x[:, :1, c1:c2])], axis=1)
+    out = jnp.concatenate([fwd, bwd, x[:, :, c2:]], axis=2)
+    return out.reshape(nt, c, h, w)
+
+
+@register_op("grid_sampler", inputs=("X", "Grid"), outputs=("Output",),
+             attrs={"align_corners": True, "mode": "bilinear",
+                    "padding_mode": "zeros"})
+def grid_sampler(ctx, x, grid, align_corners=True, mode="bilinear",
+                 padding_mode="zeros"):
+    """Bilinear grid sampling (grid_sampler_op.cc): x [N,C,H,W], grid
+    [N,H',W',2] in [-1,1]."""
+    N, C, H, W = x.shape
+    gx, gy = grid[..., 0], grid[..., 1]
+    if align_corners:
+        fx = (gx + 1) * 0.5 * (W - 1)
+        fy = (gy + 1) * 0.5 * (H - 1)
+    else:
+        fx = ((gx + 1) * W - 1) * 0.5
+        fy = ((gy + 1) * H - 1) * 0.5
+    x0 = jnp.floor(fx)
+    y0 = jnp.floor(fy)
+    wx = fx - x0
+    wy = fy - y0
+
+    def sample(yi, xi):
+        valid = ((yi >= 0) & (yi < H) & (xi >= 0) & (xi < W))
+        yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+        # gather per batch: x [N,C,H,W], idx [N,H',W']
+        g = jax.vmap(lambda img, yy, xx: img[:, yy, xx])(x, yc, xc)
+        return g * valid[:, None].astype(x.dtype)
+
+    v00 = sample(y0, x0)
+    v01 = sample(y0, x0 + 1)
+    v10 = sample(y0 + 1, x0)
+    v11 = sample(y0 + 1, x0 + 1)
+    wx = wx[:, None]
+    wy = wy[:, None]
+    return (v00 * (1 - wx) * (1 - wy) + v01 * wx * (1 - wy)
+            + v10 * (1 - wx) * wy + v11 * wx * wy)
+
+
+@register_op("affine_grid", inputs=("Theta", "OutputShape"),
+             outputs=("Output",),
+             attrs={"align_corners": True, "output_shape": []},
+             optional_inputs=("OutputShape",), no_grad_inputs=("OutputShape",))
+def affine_grid(ctx, theta, out_shape=None, align_corners=True,
+                output_shape=()):
+    """[N,2,3] affine params -> [N,H,W,2] sampling grid."""
+    if out_shape is not None:
+        import numpy as _np
+
+        shp = [int(v) for v in _np.asarray(out_shape)]
+    else:
+        shp = [int(v) for v in output_shape]
+    N, _, H, W = shp
+    if align_corners:
+        ys = jnp.linspace(-1.0, 1.0, H)
+        xs = jnp.linspace(-1.0, 1.0, W)
+    else:
+        ys = (jnp.arange(H) * 2 + 1) / H - 1
+        xs = (jnp.arange(W) * 2 + 1) / W - 1
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1)  # [H,W,3]
+    return jnp.einsum("hwk,nik->nhwi", base, theta.astype(jnp.float32))
+
+
+# -- 3-D convolution / pooling ----------------------------------------------
+
+
+@register_op("conv3d", inputs=("Input", "Filter"), outputs=("Output",),
+             attrs={"strides": [1, 1, 1], "paddings": [0, 0, 0],
+                    "dilations": [1, 1, 1], "groups": 1,
+                    "data_format": "NCDHW"})
+def conv3d(ctx, x, w, strides=(1, 1, 1), paddings=(0, 0, 0),
+           dilations=(1, 1, 1), groups=1, data_format="NCDHW", **_):
+    p = list(paddings)
+    pad = [(p[0], p[0]), (p[1], p[1]), (p[2], p[2])]
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    ("NCDHW", "OIDHW", "NCDHW"))
+    amp = ctx is not None and ctx.amp_bf16() and x.dtype in (jnp.float32,
+                                                             jnp.bfloat16)
+    xc, wc = (x.astype(jnp.bfloat16), w.astype(jnp.bfloat16)) if amp else (x, w)
+    out = lax.conv_general_dilated(
+        xc, wc, window_strides=tuple(strides), padding=pad,
+        rhs_dilation=tuple(dilations), dimension_numbers=dn,
+        feature_group_count=groups)
+    return out if amp else out.astype(x.dtype)
+
+
+@register_op("conv3d_transpose", inputs=("Input", "Filter"),
+             outputs=("Output",),
+             attrs={"strides": [1, 1, 1], "paddings": [0, 0, 0],
+                    "dilations": [1, 1, 1], "groups": 1,
+                    "data_format": "NCDHW", "output_size": []})
+def conv3d_transpose(ctx, x, w, strides=(1, 1, 1), paddings=(0, 0, 0),
+                     dilations=(1, 1, 1), groups=1, data_format="NCDHW",
+                     output_size=(), **_):
+    p = list(paddings)
+    kd, kh, kw = w.shape[2], w.shape[3], w.shape[4]
+    wt = jnp.flip(w, axis=(2, 3, 4)).swapaxes(0, 1)
+    dn = lax.conv_dimension_numbers(x.shape, wt.shape,
+                                    ("NCDHW", "OIDHW", "NCDHW"))
+    return lax.conv_general_dilated(
+        x, wt, window_strides=(1, 1, 1),
+        padding=[(kd - 1 - p[0], kd - 1 - p[0]),
+                 (kh - 1 - p[1], kh - 1 - p[1]),
+                 (kw - 1 - p[2], kw - 1 - p[2])],
+        lhs_dilation=tuple(strides), rhs_dilation=tuple(dilations),
+        dimension_numbers=dn, feature_group_count=groups)
+
+
+@register_op("pool3d", inputs=("X",), outputs=("Out",),
+             attrs={"pooling_type": "max", "ksize": [1, 1, 1],
+                    "strides": [1, 1, 1], "paddings": [0, 0, 0],
+                    "global_pooling": False, "ceil_mode": False,
+                    "exclusive": True, "adaptive": False,
+                    "data_format": "NCDHW"})
+def pool3d(ctx, x, pooling_type="max", ksize=(1, 1, 1), strides=(1, 1, 1),
+           paddings=(0, 0, 0), global_pooling=False, ceil_mode=False,
+           exclusive=True, adaptive=False, data_format="NCDHW", **_):
+    if global_pooling:
+        fn = jnp.max if pooling_type == "max" else jnp.mean
+        return fn(x, axis=(2, 3, 4), keepdims=True)
+    if adaptive:
+        od, oh, ow = int(ksize[0]), int(ksize[1]), int(ksize[2])
+        N, C, D, H, W = x.shape
+        r = x.reshape(N, C, od, D // od, oh, H // oh, ow, W // ow)
+        fn = jnp.max if pooling_type == "max" else jnp.mean
+        return fn(r, axis=(3, 5, 7))
+    kd, kh, kw = [int(v) for v in ksize]
+    sd, sh, sw = [int(v) for v in strides]
+    pd, ph, pw = [int(v) for v in paddings]
+    window = (1, 1, kd, kh, kw)
+    strides_ = (1, 1, sd, sh, sw)
+    pads = ((0, 0), (0, 0), (pd, pd), (ph, ph), (pw, pw))
+    if pooling_type == "max":
+        return lax.reduce_window(x, -jnp.inf, lax.max, window, strides_, pads)
+    s = lax.reduce_window(x, 0.0, lax.add, window, strides_, pads)
+    return s / (kd * kh * kw)
+
+
+@register_op("row_conv", inputs=("X", "Filter"), outputs=("Out",))
+def row_conv(ctx, x, w):
+    """Lookahead row convolution (row_conv_op.cc) on dense [B, T, D] input
+    with filter [future_context+1, D] (LoD batching replaced by padding)."""
+    ctx_len = w.shape[0]
+    T = x.shape[1]
+    pad = jnp.pad(x, ((0, 0), (0, ctx_len - 1), (0, 0)))
+    out = jnp.zeros_like(x)
+    for i in range(ctx_len):
+        out = out + pad[:, i:i + T, :] * w[i]
+    return out
+
+
+@register_op("bilinear_tensor_product", inputs=("X", "Y", "Weight", "Bias"),
+             outputs=("Out",), optional_inputs=("Bias",))
+def bilinear_tensor_product(ctx, x, y, w, bias=None):
+    """out[:, k] = x W_k y^T (bilinear_tensor_product_op.cc); W: [K, Dx, Dy]."""
+    out = jnp.einsum("bi,kij,bj->bk", x, w, y)
+    if bias is not None:
+        out = out + bias.reshape(1, -1)
+    return out
+
+
+@register_op("spectral_norm", inputs=("Weight", "U", "V"), outputs=("Out",),
+             attrs={"dim": 0, "power_iters": 1, "eps": 1e-12},
+             no_grad_inputs=("U", "V"))
+def spectral_norm(ctx, w, u, v, dim=0, power_iters=1, eps=1e-12):
+    """Weight / sigma_max(weight) via power iteration (spectral_norm_op.cc)."""
+    shape = w.shape
+    if dim != 0:
+        perm = [dim] + [i for i in range(len(shape)) if i != dim]
+        w_t = jnp.transpose(w, perm)
+    else:
+        w_t = w
+    h = w_t.shape[0]
+    mat = w_t.reshape(h, -1)
+    for _ in range(max(power_iters, 1)):
+        v = mat.T @ u
+        v = v / (jnp.linalg.norm(v) + eps)
+        u = mat @ v
+        u = u / (jnp.linalg.norm(u) + eps)
+    sigma = u @ mat @ v
+    out = w_t / sigma
+    if dim != 0:
+        inv = [perm.index(i) for i in range(len(shape))]
+        out = jnp.transpose(out, inv)
+    return out
+
+
+@register_op("data_norm", inputs=("X", "BatchSize", "BatchSum",
+                                  "BatchSquareSum"),
+             outputs=("Y", "Means", "Scales"),
+             attrs={"epsilon": 1e-4},
+             no_grad_inputs=("BatchSize", "BatchSum", "BatchSquareSum"))
+def data_norm(ctx, x, batch_size, batch_sum, batch_square_sum, epsilon=1e-4):
+    """Global data normalization from accumulated statistics
+    (data_norm_op.cc — CTR feature scaling)."""
+    means = batch_sum / batch_size
+    scales = jnp.sqrt(batch_size / (batch_square_sum - batch_size * means ** 2
+                                    + epsilon))
+    return (x - means) * scales, means, scales
+
+
+@register_op("fsp", inputs=("X", "Y"), outputs=("Out",))
+def fsp(ctx, x, y):
+    """Flow-of-solution-procedure matrix (fsp_op.cc, distillation):
+    [N,Cx,H,W] x [N,Cy,H,W] -> [N,Cx,Cy]."""
+    n, cx, h, w = x.shape
+    return jnp.einsum("nchw,ndhw->ncd", x, y) / (h * w)

@@ -1,0 +1,339 @@
+"""Tests for the extended op surface (vision/detection/losses/misc),
+following the reference's OpTest pattern: numpy reference vs op output
+(tests/unittests/test_*_op.py analogs)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.lowering import LowerCtx
+from paddle_tpu.core.registry import get_op_def
+
+
+def run_op(op_type, *args, **attrs):
+    """Eager single-op evaluation through the registry (OpTest-style)."""
+    opdef = get_op_def(op_type)
+    n_rng = opdef.n_rng
+    import jax
+
+    ctx = LowerCtx(rng_key=jax.random.key(0) if n_rng else None, mode="eager")
+    full = dict(opdef.default_attrs)
+    full.update(attrs)
+    return opdef.lower(ctx, *args, **full)
+
+
+def test_lrn_matches_naive():
+    x = np.random.RandomState(0).rand(2, 8, 4, 4).astype("f")
+    out, mid = run_op("lrn", jnp.asarray(x), n=5, k=2.0, alpha=1e-4, beta=0.75)
+    # naive
+    sq = x ** 2
+    want = np.zeros_like(x)
+    for c in range(8):
+        lo, hi = max(0, c - 2), min(8, c + 3)
+        acc = sq[:, lo:hi].sum(1)
+        want[:, c] = x[:, c] / (2.0 + 1e-4 * acc) ** 0.75
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5)
+
+
+def test_shuffle_space_temporal():
+    x = np.arange(2 * 4 * 4 * 4, dtype="f").reshape(2, 4, 4, 4)
+    out = run_op("shuffle_channel", jnp.asarray(x), group=2)
+    want = x.reshape(2, 2, 2, 4, 4).transpose(0, 2, 1, 3, 4).reshape(2, 4, 4, 4)
+    np.testing.assert_array_equal(np.asarray(out), want)
+    s2d = run_op("space_to_depth", jnp.asarray(x), blocksize=2)
+    assert s2d.shape == (2, 16, 2, 2)
+    ts = run_op("temporal_shift", jnp.asarray(x), seg_num=2, shift_ratio=0.25)
+    assert ts.shape == x.shape
+    # first quarter channels shifted forward: segment 0 reads zeros
+    np.testing.assert_array_equal(np.asarray(ts)[0, 0], np.zeros((4, 4)))
+
+
+def test_grid_sampler_identity():
+    x = np.random.RandomState(0).rand(1, 2, 5, 5).astype("f")
+    ys, xs = np.meshgrid(np.linspace(-1, 1, 5), np.linspace(-1, 1, 5),
+                         indexing="ij")
+    grid = np.stack([xs, ys], -1)[None].astype("f")
+    out = run_op("grid_sampler", jnp.asarray(x), jnp.asarray(grid))
+    np.testing.assert_allclose(np.asarray(out), x, atol=1e-5)
+
+
+def test_conv3d_pool3d_shapes():
+    x = np.random.RandomState(0).rand(2, 3, 8, 8, 8).astype("f")
+    w = np.random.RandomState(1).rand(4, 3, 3, 3, 3).astype("f")
+    out = run_op("conv3d", jnp.asarray(x), jnp.asarray(w),
+                 strides=[1, 1, 1], paddings=[1, 1, 1])
+    assert out.shape == (2, 4, 8, 8, 8)
+    p = run_op("pool3d", jnp.asarray(x), pooling_type="max",
+               ksize=[2, 2, 2], strides=[2, 2, 2], paddings=[0, 0, 0])
+    assert p.shape == (2, 3, 4, 4, 4)
+    np.testing.assert_allclose(
+        np.asarray(p)[0, 0, 0, 0, 0], x[0, 0, :2, :2, :2].max(), rtol=1e-6)
+
+
+def test_bilinear_tensor_product():
+    x = np.random.RandomState(0).rand(3, 4).astype("f")
+    y = np.random.RandomState(1).rand(3, 5).astype("f")
+    w = np.random.RandomState(2).rand(2, 4, 5).astype("f")
+    out = run_op("bilinear_tensor_product", jnp.asarray(x), jnp.asarray(y),
+                 jnp.asarray(w), None)
+    want = np.einsum("bi,kij,bj->bk", x, w, y)
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5)
+
+
+def test_spectral_norm_normalizes():
+    w = np.random.RandomState(0).randn(6, 4).astype("f")
+    u = np.random.RandomState(1).randn(6).astype("f")
+    v = np.random.RandomState(2).randn(4).astype("f")
+    out = run_op("spectral_norm", jnp.asarray(w), jnp.asarray(u),
+                 jnp.asarray(v), dim=0, power_iters=20)
+    sigma = np.linalg.svd(w, compute_uv=False)[0]
+    np.testing.assert_allclose(np.linalg.svd(np.asarray(out),
+                                             compute_uv=False)[0],
+                               1.0, rtol=1e-3)
+    np.testing.assert_allclose(np.asarray(out), w / sigma, rtol=1e-3)
+
+
+# -- losses -------------------------------------------------------------------
+
+
+def test_rank_and_margin_losses():
+    lbl = np.array([[1.0], [0.0]], "f")
+    l = np.array([[2.0], [0.5]], "f")
+    r = np.array([[1.0], [1.5]], "f")
+    out = run_op("rank_loss", jnp.asarray(lbl), jnp.asarray(l), jnp.asarray(r))
+    want = l - r
+    want = want * (1 - lbl) + np.log1p(np.exp(-np.abs(want))) + np.maximum(
+        -(l - r), 0)
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5)
+
+    mlbl = np.array([[1.0], [-1.0]], "f")
+    out, act = run_op("margin_rank_loss", jnp.asarray(mlbl), jnp.asarray(l),
+                      jnp.asarray(r), margin=0.1)
+    want = np.maximum(0, -mlbl * (l - r) + 0.1)
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5)
+
+
+def test_bpr_loss_positive():
+    x = np.random.RandomState(0).rand(4, 5).astype("f")
+    lbl = np.array([[0], [1], [2], [3]], "int64")
+    out = run_op("bpr_loss", jnp.asarray(x), jnp.asarray(lbl))
+    assert out.shape == (4, 1)
+    assert (np.asarray(out) > 0).all()
+
+
+def test_mean_iou_perfect_and_half():
+    pred = np.array([0, 1, 1, 0], "int64")
+    lbl = np.array([0, 1, 0, 0], "int64")
+    miou, wrong, correct = run_op("mean_iou", jnp.asarray(pred),
+                                  jnp.asarray(lbl), num_classes=2)
+    # class0: inter 2, union 3 -> 2/3; class1: inter 1, union 2 -> 0.5
+    np.testing.assert_allclose(float(miou), (2 / 3 + 0.5) / 2, rtol=1e-5)
+
+
+def test_warpctc_matches_simple_case():
+    # single sequence, T=2, single label: loss = -log P(paths)
+    B, T, C, L = 1, 2, 3, 1
+    logits = np.log(np.array([[[0.6, 0.3, 0.1], [0.5, 0.4, 0.1]]], "f"))
+    label = np.array([[1]], "int64")
+    _, loss = run_op("warpctc", jnp.asarray(logits), jnp.asarray(label),
+                     blank=0)
+    # paths for label [1]: (b,1),(1,b),(1,1)
+    p = 0.6 * 0.4 + 0.3 * 0.5 + 0.3 * 0.4
+    np.testing.assert_allclose(float(np.asarray(loss)[0, 0]), -np.log(p),
+                               rtol=1e-4)
+
+
+def test_warpctc_trains_in_program():
+    rng = np.random.RandomState(0)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8, 16])
+        lbl = fluid.layers.data("lbl", shape=[3], dtype="int64")
+        logits = fluid.layers.fc(x, 5, num_flatten_dims=2)
+        loss = fluid.layers.mean(fluid.layers.warpctc(logits, lbl))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    feed = {"x": rng.rand(2, 8, 16).astype("f"),
+            "lbl": np.array([[1, 2, -1], [3, -1, -1]], "int64")}
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        l0, = exe.run(main, feed=feed, fetch_list=[loss])
+        for _ in range(10):
+            l1, = exe.run(main, feed=feed, fetch_list=[loss])
+    assert float(np.asarray(l1).ravel()[0]) < float(np.asarray(l0).ravel()[0])
+
+
+def test_edit_distance():
+    hyps = np.array([[1, 2, 3, -1], [1, -1, -1, -1]], "int64")
+    refs = np.array([[1, 3, -1], [2, 2, -1]], "int64")
+    out, n = run_op("edit_distance", jnp.asarray(hyps), jnp.asarray(refs),
+                    normalized=False)
+    np.testing.assert_allclose(np.asarray(out).ravel(), [1.0, 2.0])
+
+
+# -- misc ---------------------------------------------------------------------
+
+
+def test_multiplex_and_crop():
+    x1 = np.ones((3, 2), "f")
+    x2 = np.full((3, 2), 2.0, "f")
+    ids = np.array([[1], [0], [1]], "int32")
+    out = run_op("multiplex", jnp.asarray(ids),
+                 [jnp.asarray(x1), jnp.asarray(x2)])
+    np.testing.assert_array_equal(np.asarray(out)[:, 0], [2, 1, 2])
+
+    x = np.arange(16, dtype="f").reshape(4, 4)
+    c = run_op("crop_tensor", jnp.asarray(x), offsets=[1, 1], shape=[2, 2])
+    np.testing.assert_array_equal(np.asarray(c), x[1:3, 1:3])
+
+
+def test_shard_index_and_unique():
+    x = np.array([[0], [5], [9], [3]], "int64")
+    out = run_op("shard_index", jnp.asarray(x), index_num=10, nshards=2,
+                 shard_id=0, ignore_value=-1)
+    np.testing.assert_array_equal(np.asarray(out).ravel(), [0, -1, -1, 3])
+    u, idx, cnt = run_op("unique_with_counts",
+                         jnp.asarray(np.array([2, 3, 2, 5], "int64")))
+    c = np.asarray(cnt)
+    assert c.sum() == 4 and (c > 0).sum() == 3
+
+
+def test_gather_tree():
+    ids = np.array([[[2, 2]], [[3, 4]], [[5, 6]]], "int64")      # [T=3,B=1,K=2]
+    parents = np.array([[[0, 0]], [[0, 0]], [[1, 0]]], "int64")
+    out = run_op("gather_tree", jnp.asarray(ids), jnp.asarray(parents))
+    # beam 0 at t=2 came from parent 1 at t=1 (id 4), which came from 0 (2)
+    np.testing.assert_array_equal(np.asarray(out)[:, 0, 0], [2, 4, 5])
+
+
+# -- detection ----------------------------------------------------------------
+
+
+def test_iou_and_box_coder_roundtrip():
+    a = np.array([[0, 0, 2, 2]], "f")
+    b = np.array([[1, 1, 3, 3], [0, 0, 2, 2]], "f")
+    iou = run_op("iou_similarity", jnp.asarray(a), jnp.asarray(b))
+    np.testing.assert_allclose(np.asarray(iou).ravel(), [1 / 7, 1.0],
+                               rtol=1e-5)
+
+    prior = np.array([[0.1, 0.1, 0.5, 0.5], [0.2, 0.2, 0.8, 0.8]], "f")
+    target = np.array([[0.15, 0.2, 0.55, 0.7]], "f")
+    enc = run_op("box_coder", jnp.asarray(prior), None, jnp.asarray(target),
+                 code_type="encode_center_size")
+    dec = run_op("box_coder", jnp.asarray(prior), None, jnp.asarray(enc),
+                 code_type="decode_center_size")
+    np.testing.assert_allclose(np.asarray(dec)[0][0], target[0], atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dec)[0][1], target[0], atol=1e-5)
+
+
+def test_prior_box_properties():
+    feat = np.zeros((1, 8, 4, 4), "f")
+    img = np.zeros((1, 3, 32, 32), "f")
+    boxes, var = run_op("prior_box", jnp.asarray(feat), jnp.asarray(img),
+                        min_sizes=[8.0], aspect_ratios=[1.0, 2.0],
+                        variances=[0.1, 0.1, 0.2, 0.2], clip=True)
+    assert boxes.shape == (4, 4, 2, 4)  # aspect ratios {1, 2}, no max_size
+    b = np.asarray(boxes)
+    assert (b >= 0).all() and (b <= 1).all()
+    assert (b[..., 2] >= b[..., 0]).all()
+
+
+def test_bipartite_match_greedy():
+    dist = np.array([[0.9, 0.1], [0.8, 0.7]], "f")
+    idx, d = run_op("bipartite_match", jnp.asarray(dist))
+    # greedy: (0,0)=0.9 first, then (1,1)=0.7
+    np.testing.assert_array_equal(np.asarray(idx).ravel(), [0, 1])
+    np.testing.assert_allclose(np.asarray(d).ravel(), [0.9, 0.7], rtol=1e-6)
+
+
+def test_multiclass_nms_suppresses():
+    boxes = np.array([[[0, 0, 10, 10], [0, 0, 10.5, 10.5],
+                       [20, 20, 30, 30]]], "f")
+    scores = np.array([[[0.9, 0.85, 0.6]]], "f")  # [N=1, C=1... wrong]
+    scores = np.transpose(scores, (0, 2, 1))  # [1, 1, 3]? need [N,C,M]
+    scores = np.array([[[0.9, 0.85, 0.6]]], "f")  # [1, 1, 3] = N,C,M
+    out = run_op("multiclass_nms", jnp.asarray(boxes), jnp.asarray(scores),
+                 background_label=-1, nms_threshold=0.5, nms_top_k=3,
+                 keep_top_k=3, score_threshold=0.1)
+    o = np.asarray(out)[0]
+    kept = o[o[:, 0] >= 0]
+    # the two overlapping boxes collapse to one; the far box survives
+    assert kept.shape[0] == 2
+    np.testing.assert_allclose(sorted(kept[:, 1]), [0.6, 0.9], rtol=1e-6)
+
+
+def test_roi_align_pool_shapes_and_values():
+    x = np.arange(16, dtype="f").reshape(1, 1, 4, 4)
+    rois = np.array([[0, 0, 0, 4, 4]], "f")  # whole image
+    out = run_op("roi_pool", jnp.asarray(x), jnp.asarray(rois),
+                 pooled_height=2, pooled_width=2, spatial_scale=1.0)[0]
+    np.testing.assert_allclose(np.asarray(out)[0, 0],
+                               [[5, 7], [13, 15]])
+    oa = run_op("roi_align", jnp.asarray(x), jnp.asarray(rois),
+                pooled_height=2, pooled_width=2, spatial_scale=1.0)
+    assert oa.shape == (1, 1, 2, 2)
+
+
+def test_yolo_box_shapes():
+    N, A, C, H, W = 1, 2, 3, 2, 2
+    x = np.random.RandomState(0).randn(N, A * (5 + C), H, W).astype("f")
+    img = np.array([[64, 64]], "int32")
+    boxes, scores = run_op("yolo_box", jnp.asarray(x), jnp.asarray(img),
+                           anchors=[10, 14, 23, 27], class_num=C,
+                           conf_thresh=0.0, downsample_ratio=32)
+    assert boxes.shape == (N, A * H * W, 4)
+    assert scores.shape == (N, A * H * W, C)
+    b = np.asarray(boxes)
+    assert (b >= 0).all() and (b <= 64).all()
+
+
+def test_detection_layers_in_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        feat = fluid.layers.data("feat", shape=[8, 4, 4])
+        img = fluid.layers.data("img", shape=[3, 32, 32])
+        boxes, var = fluid.layers.prior_box(feat, img, min_sizes=[8.0])
+        out = fluid.layers.reduce_sum(boxes)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        o, = exe.run(main, feed={"feat": np.zeros((1, 8, 4, 4), "f"),
+                                 "img": np.zeros((1, 3, 32, 32), "f")},
+                     fetch_list=[out])
+    assert np.isfinite(np.asarray(o)).all()
+
+
+def test_positional_attr_layers():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4, 4, 4])
+        a = fluid.layers.space_to_depth(x, 2)
+        b = fluid.layers.shuffle_channel(x, 2)
+        c = fluid.layers.lrn(x, 5)
+    assert a.name and b.name and c.name
+
+
+def test_single_class_nms_no_crash():
+    boxes = np.array([[[0, 0, 10, 10], [20, 20, 30, 30]]], "f")
+    scores = np.array([[[0.9, 0.6]]], "f")  # [N=1, C=1, M=2]
+    out = run_op("multiclass_nms", jnp.asarray(boxes), jnp.asarray(scores),
+                 background_label=0, nms_threshold=0.5, nms_top_k=2,
+                 keep_top_k=2, score_threshold=0.1)
+    o = np.asarray(out)[0]
+    assert (o[:, 0] >= 0).sum() == 2
+
+
+def test_prior_box_min_max_order():
+    feat = np.zeros((1, 8, 2, 2), "f")
+    img = np.zeros((1, 3, 16, 16), "f")
+    boxes, _ = run_op("prior_box", jnp.asarray(feat), jnp.asarray(img),
+                      min_sizes=[4.0], max_sizes=[8.0],
+                      aspect_ratios=[1.0, 2.0],
+                      variances=[0.1, 0.1, 0.2, 0.2],
+                      min_max_aspect_ratios_order=True)
+    b = np.asarray(boxes)
+    # order: min square, max square, ar=2 — widths at cell (0,0):
+    w = (b[0, 0, :, 2] - b[0, 0, :, 0]) * 16
+    np.testing.assert_allclose(w, [4.0, (4 * 8) ** 0.5, 4 * 2 ** 0.5],
+                               rtol=1e-5)
