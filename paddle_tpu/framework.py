@@ -10,6 +10,7 @@ the IR, and execution lowers whole blocks into a single jitted XLA computation
 """
 
 import contextlib
+import threading
 import copy
 import json
 
@@ -728,26 +729,50 @@ class Program:
 _main_program = Program()
 _startup_program = Program()
 
+# Per-thread default-program overrides: concurrent builder threads (e.g.
+# pserver/worker role threads standing in for the reference's separate
+# processes, test harnesses) must not race on the global defaults.  The
+# MAIN thread keeps using the module globals so that programs built in the
+# main thread remain visible to helper threads that never called
+# program_guard themselves (trainer feed threads, pipeline sections).
+_prog_tls = threading.local()
+
+
+def _is_main_thread():
+    return threading.current_thread() is threading.main_thread()
+
 
 def default_main_program():
+    if not _is_main_thread() and getattr(_prog_tls, "main", None) is not None:
+        return _prog_tls.main
     return _main_program
 
 
 def default_startup_program():
+    if not _is_main_thread() and getattr(_prog_tls, "startup", None) is not None:
+        return _prog_tls.startup
     return _startup_program
 
 
 def switch_main_program(program):
     global _main_program
-    old = _main_program
-    _main_program = program
+    if _is_main_thread():
+        old = _main_program
+        _main_program = program
+    else:
+        old = getattr(_prog_tls, "main", None)
+        _prog_tls.main = program
     return old
 
 
 def switch_startup_program(program):
     global _startup_program
-    old = _startup_program
-    _startup_program = program
+    if _is_main_thread():
+        old = _startup_program
+        _startup_program = program
+    else:
+        old = getattr(_prog_tls, "startup", None)
+        _prog_tls.startup = program
     return old
 
 
@@ -772,7 +797,8 @@ def name_scope(prefix=None):
 
 
 def _current_role():
-    return _main_program._op_role if _main_program else OpRole.Forward
+    prog = default_main_program()
+    return prog._op_role if prog else OpRole.Forward
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,132 @@
+"""fleet parameter-server backend (parity:
+python/paddle/fluid/incubate/fleet/parameter_server/distribute_transpiler/
+__init__.py:407 DistributedTranspiler(Fleet)) over the native PS runtime
+(distributed/ps.py + the C++ tensor RPC transport).
+
+Usage mirrors the reference:
+
+    fleet.init(role_maker)
+    opt = fleet.distributed_optimizer(fluid.optimizer.SGD(0.1), config)
+    opt.minimize(loss)
+    if fleet.is_server():
+        fleet.init_server(); fleet.run_server()      # blocks in the loop
+    else:
+        fleet.init_worker()
+        exe.run(fleet.main_program, ...)             # grads sync'd per step
+        fleet.stop_worker()
+"""
+
+from ....framework import default_main_program, default_startup_program
+from ....transpiler import DistributeTranspiler, DistributeTranspilerConfig
+from ..base.fleet_base import DistributedOptimizer, Fleet, Mode
+
+__all__ = ["fleet", "DistributedTranspiler", "TranspilerOptimizer"]
+
+
+class DistributedTranspilerFleet(Fleet):
+    def __init__(self):
+        super().__init__(Mode.TRANSPILER)
+        self._transpiler = None
+        self.main_program = None
+        self.startup_program = None
+        self._server_program = None
+        self._server_startup = None
+
+    # -- worker side ---------------------------------------------------------
+    def init_worker(self):
+        pass  # comms are created lazily on the first exe.run (executor.py)
+
+    def run_worker(self, main_programs=None, scopes=None):
+        pass
+
+    def stop_worker(self):
+        """Send COMPLETE to every pserver (reference: fleet.stop_worker ->
+        Communicator stop + SendComplete)."""
+        self._executor.close()
+        from ....core.executor import global_scope
+
+        comm = getattr(global_scope(), "_ps_comm", None)
+        if comm is not None:
+            comm.complete()
+
+    # -- server side ---------------------------------------------------------
+    def init_server(self, model_dir=None):
+        ep = self.server_endpoints[self.server_index()]
+        self._server_program, self._server_startup = \
+            self._transpiler.get_pserver_programs(ep)
+        self._executor.run(self._server_startup)
+        if model_dir:
+            from .... import io
+
+            io.load_persistables(self._executor, model_dir,
+                                 self._server_program)
+
+    def run_server(self):
+        if self._server_program is None:
+            raise RuntimeError("call init_server() before run_server()")
+        self._executor.run(self._server_program)  # blocks in the PS loop
+
+    # -- optimizer -----------------------------------------------------------
+    def distributed_optimizer(self, optimizer, strategy=None):
+        self._optimizer = TranspilerOptimizer(optimizer, strategy, self)
+        return self._optimizer
+
+    def _transpile(self, config):
+        t = DistributeTranspiler(config=config)
+        t.transpile(
+            trainer_id=self.worker_index(),
+            pservers=",".join(self.server_endpoints),
+            trainers=self.worker_num(),
+            sync_mode=getattr(config, "sync_mode", True))
+        self._transpiler = t
+        if self.is_worker():
+            self.main_program = t.get_trainer_program()
+            self.startup_program = default_startup_program()
+        else:
+            self.main_program = default_main_program()
+            self.startup_program = default_startup_program()
+
+    # -- save ----------------------------------------------------------------
+    def save_inference_model(self, executor, dirname, feeded_var_names=None,
+                             target_vars=None, main_program=None,
+                             export_for_deployment=True):
+        from .... import io
+
+        return io.save_inference_model(
+            dirname, feeded_var_names, target_vars, executor,
+            main_program or self.main_program)
+
+    def save_persistables(self, executor, dirname, main_program=None):
+        from .... import io
+
+        return io.save_persistables(executor, dirname,
+                                    main_program or self.main_program)
+
+
+class TranspilerOptimizer(DistributedOptimizer):
+    def __init__(self, optimizer, strategy=None, fleet_obj=None):
+        super().__init__(optimizer, strategy)
+        if strategy is None:
+            strategy = DistributeTranspilerConfig()
+        elif not isinstance(strategy, DistributeTranspilerConfig):
+            raise TypeError(
+                "strategy must be a DistributeTranspilerConfig")
+        self._strategy = strategy
+        self._fleet = fleet_obj
+
+    def backward(self, *a, **k):
+        return self._optimizer.backward(*a, **k)
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def minimize(self, losses, scopes=None, startup_programs=None,
+                 parameter_list=None, no_grad_set=None):
+        out = self._optimizer.minimize(
+            losses, startup_programs, parameter_list, no_grad_set)
+        self._fleet._transpile(self._strategy)
+        return out
+
+
+fleet = DistributedTranspilerFleet()
+DistributedTranspiler = DistributedTranspilerFleet

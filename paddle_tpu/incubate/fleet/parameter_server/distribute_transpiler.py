@@ -1,0 +1,4 @@
+"""Alias module matching the reference import path
+(incubate/fleet/parameter_server/distribute_transpiler/__init__.py)."""
+
+from . import fleet, DistributedTranspiler, TranspilerOptimizer  # noqa: F401

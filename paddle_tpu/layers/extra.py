@@ -25,6 +25,8 @@ __all__ = [
     "iou_similarity", "prior_box", "density_prior_box", "anchor_generator",
     "box_coder", "box_clip", "yolo_box", "bipartite_match", "target_assign",
     "multiclass_nms", "roi_align", "roi_pool",
+    "linear_chain_crf", "crf_decoding",
+    "nce", "hsigmoid", "py_func",
 ]
 
 
@@ -683,4 +685,139 @@ def roi_pool(input, rois, pooled_height=1, pooled_width=1,
         outputs={"Out": [out], "Argmax": [argmax]},
         attrs={"pooled_height": pooled_height, "pooled_width": pooled_width,
                "spatial_scale": spatial_scale})
+    return out
+
+
+# -- CRF ----------------------------------------------------------------------
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None):
+    """Linear-chain CRF NLL (reference layers/nn.py linear_chain_crf).
+    input [B, T, C] dense emissions (LoD replaced by `length`)."""
+    helper = LayerHelper("linear_chain_crf")
+    num_tags = input.shape[-1]
+    transition = helper.create_parameter(
+        attr=param_attr, shape=[num_tags + 2, num_tags], dtype=input.dtype)
+    alpha = helper.create_variable_for_type_inference(input.dtype)
+    eexp = helper.create_variable_for_type_inference(input.dtype)
+    texp = helper.create_variable_for_type_inference(input.dtype)
+    ll = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"Emission": [input], "Transition": [transition],
+              "Label": [label]}
+    if length is not None:
+        inputs["Length"] = [length]
+    helper.append_op(
+        type="linear_chain_crf", inputs=inputs,
+        outputs={"Alpha": [alpha], "EmissionExps": [eexp],
+                 "TransitionExps": [texp], "LogLikelihood": [ll]})
+    return ll
+
+
+def crf_decoding(input, param_attr, label=None, length=None):
+    helper = LayerHelper("crf_decoding")
+    if isinstance(param_attr, Variable):
+        transition = param_attr
+    elif getattr(param_attr, "name", None):
+        transition = helper.main_program.global_block().var(param_attr.name)
+    else:
+        raise ValueError(
+            "crf_decoding: param_attr must be the CRF transition Variable "
+            "or a ParamAttr naming it (the parameter created by "
+            "linear_chain_crf)")
+    out = helper.create_variable_for_type_inference("int64")
+    inputs = {"Emission": [input], "Transition": [transition]}
+    if label is not None:
+        inputs["Label"] = [label]
+    if length is not None:
+        inputs["Length"] = [length]
+    helper.append_op(type="crf_decoding", inputs=inputs,
+                     outputs={"ViterbiPath": [out]})
+    return out
+
+
+# -- sampled classifiers + host callback -------------------------------------
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=None, name=None, sampler="uniform",
+        custom_dist=None, seed=0, is_sparse=False):
+    """Noise contrastive estimation (reference layers/nn.py nce)."""
+    if custom_dist is not None:
+        raise NotImplementedError(
+            "nce: custom_dist sampling is not supported (uniform only)")
+    if sampler not in ("uniform",):
+        raise NotImplementedError(
+            "nce: sampler=%r not supported (uniform only; the functional "
+            "PRNG makes runs deterministic without a seed)" % (sampler,))
+    helper = LayerHelper("nce", name=name)
+    dim = input.shape[1]
+    w = helper.create_parameter(attr=param_attr,
+                                shape=[num_total_classes, dim],
+                                dtype=input.dtype)
+    inputs = {"Input": [input], "Label": [label], "Weight": [w]}
+    if bias_attr is not False:
+        b = helper.create_parameter(attr=bias_attr,
+                                    shape=[num_total_classes],
+                                    dtype=input.dtype, is_bias=True)
+        if b is not None:
+            inputs["Bias"] = [b]
+    cost = helper.create_variable_for_type_inference(input.dtype)
+    sl = helper.create_variable_for_type_inference(input.dtype)
+    slab = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        type="nce", inputs=inputs,
+        outputs={"Cost": [cost], "SampleLogits": [sl], "SampleLabels": [slab]},
+        attrs={"num_total_classes": num_total_classes,
+               "num_neg_samples": num_neg_samples or 10, "seed": seed,
+               "sampler": 0, "is_sparse": is_sparse})
+    return cost
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None, path_table=None, path_code=None, is_custom=False,
+             is_sparse=False):
+    """Hierarchical sigmoid (reference layers/nn.py hsigmoid, SimpleCode
+    complete-binary-tree mode)."""
+    helper = LayerHelper("hierarchical_sigmoid", name=name)
+    dim = input.shape[1]
+    w = helper.create_parameter(attr=param_attr,
+                                shape=[num_classes, dim], dtype=input.dtype)
+    inputs = {"X": [input], "W": [w], "Label": [label]}
+    if bias_attr is not False:
+        b = helper.create_parameter(attr=bias_attr, shape=[num_classes],
+                                    dtype=input.dtype, is_bias=True)
+        if b is not None:
+            inputs["Bias"] = [b]
+    out = helper.create_variable_for_type_inference(input.dtype)
+    pre = helper.create_variable_for_type_inference(input.dtype)
+    wout = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="hierarchical_sigmoid", inputs=inputs,
+        outputs={"Out": [out], "PreOut": [pre], "W_Out": [wout]},
+        attrs={"num_classes": num_classes, "is_sparse": is_sparse})
+    return out
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host Python callback (reference layers/nn.py py_func): `out` is a
+    pre-created Variable (or list) fixing shapes/dtypes.  backward_func is
+    not supported on the XLA path (forward-only host op)."""
+    from ..ops.sampled import register_py_func
+
+    helper = LayerHelper("py_func")
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    for o in outs:
+        if any(int(d) < 0 for d in (o.shape or [])):
+            raise ValueError(
+                "py_func: out variable %r has a dynamic dim %s — pre-create "
+                "it with a concrete shape (XLA host callbacks need static "
+                "result shapes)" % (o.name, tuple(o.shape)))
+    fid = register_py_func(func)
+    helper.append_op(
+        type="py_func", inputs={"X": list(xs)},
+        outputs={"Out": list(outs)},
+        attrs={"forward_callable_id": fid, "backward_callable_id": -1,
+               "out_shapes": [list(o.shape) for o in outs],
+               "out_dtypes": [str(o.dtype) for o in outs]})
     return out

@@ -7,6 +7,7 @@ Parity: python/paddle/fluid/layers/rnn.py + layers/nn.py beam_search
 from ..layer_helper import LayerHelper
 
 __all__ = ["beam_search", "beam_search_decode", "gru_unit", "lstm_unit",
+           "dynamic_lstmp", "lstm",
            "dynamic_gru", "dynamic_lstm"]
 
 
@@ -127,9 +128,11 @@ def dynamic_gru(input, size, seq_len=None, h_0=None, reverse=False,
 
 
 def dynamic_lstm(input, size, seq_len=None, h_0=None, c_0=None,
-                 reverse=False, param_attr=None, bias_attr=None, name=None):
+                 reverse=False, param_attr=None, bias_attr=None, name=None,
+                 return_cell=False):
     """LSTM over the time axis via StaticRNN/lax.scan (reference
-    operators/lstm_op.cc)."""
+    operators/lstm_op.cc).  With return_cell=True also returns the cell
+    trajectory [B, T, D] (consumed by layers.lstm for last_c)."""
     from .control_flow import StaticRNN
     from . import nn
 
@@ -147,5 +150,123 @@ def dynamic_lstm(input, size, seq_len=None, h_0=None, c_0=None,
         rnn.update_memory(h_prev, h)
         rnn.update_memory(c_prev, c)
         rnn.step_output(h)
+        if return_cell:
+            rnn.step_output(c)
+    if return_cell:
+        out, cells = rnn()
+        return nn.transpose(out, [1, 0, 2]), nn.transpose(cells, [1, 0, 2])
     out = rnn()
     return nn.transpose(out, [1, 0, 2])
+
+
+def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
+                  use_peepholes=False, is_reverse=False,
+                  gate_activation="sigmoid", cell_activation="tanh",
+                  candidate_activation="tanh", proj_activation="tanh",
+                  dtype="float32", name=None, h_0=None, c_0=None,
+                  cell_clip=None, proj_clip=None):
+    """LSTM with a projection layer (reference operators/lstmp_op.cc):
+    the recurrent state is the projection r [B, P] of the hidden state."""
+    from .control_flow import StaticRNN
+    from . import nn
+
+    name = name or "dynamic_lstmp"
+    D = size // 4
+    x = _reverse_time(input) if is_reverse else input
+    x_t_all = nn.transpose(x, [1, 0, 2])
+    rnn = StaticRNN()
+    with rnn.step():
+        x_t = rnn.step_input(x_t_all)
+        r_prev = rnn.memory(init=h_0, shape=(-1, proj_size), batch_ref=input,
+                            init_value=0.0, ref_batch_dim_idx=0)
+        c_prev = rnn.memory(init=c_0, shape=(-1, D), batch_ref=input,
+                            init_value=0.0, ref_batch_dim_idx=0)
+        # lstmp cell: gates sized by D (cell width), recurrent input is
+        # the projection r_prev [B, P]
+        from . import tensor as _T
+
+        gates = nn.fc(_T.concat([x_t, r_prev], axis=-1), 4 * D,
+                      param_attr=param_attr, bias_attr=bias_attr,
+                      name=name + "_gates")
+        gi, gf, gc, go = nn.split(gates, 4, dim=-1)
+        gi = _act(gate_activation, gi)
+        gf = _act(gate_activation, gf)
+        go = _act(gate_activation, go)
+        gc = _act(candidate_activation, gc)
+        c = gf * c_prev + gi * gc
+        h = go * _act(cell_activation, c)
+        # projection weight must be a DISTINCT parameter from the gates
+        # (a shared named ParamAttr would alias two different shapes)
+        proj_attr = None
+        if param_attr is not None and getattr(param_attr, "name", None):
+            from ..param_attr import ParamAttr as _PA
+
+            proj_attr = _PA(name=param_attr.name + "_proj")
+        r = nn.fc(h, proj_size, param_attr=proj_attr, bias_attr=False,
+                  act=proj_activation, name=name + "_proj")
+        rnn.update_memory(r_prev, r)
+        rnn.update_memory(c_prev, c)
+        rnn.step_output(r)
+        rnn.step_output(c)
+    proj_out, cells = rnn()
+    proj_out = nn.transpose(proj_out, [1, 0, 2])
+    cells = nn.transpose(cells, [1, 0, 2])
+    if is_reverse:
+        proj_out = _reverse_time(proj_out)
+        cells = _reverse_time(cells)
+    return proj_out, cells
+
+
+def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
+         dropout_prob=0.0, is_bidirec=False, is_test=False, name=None,
+         default_initializer=None, seed=-1):
+    """Stacked (cuDNN-style) LSTM (reference operators/cudnn_lstm_op.cu):
+    input [B, T, F] -> (out [B, T, H or 2H], last_h, last_c).  Composed
+    from per-layer dynamic_lstm scans; bidirectional runs a reversed pass
+    and concatenates.  init_h/init_c accepted for API parity (zero state
+    when None)."""
+    from . import nn
+
+    name = name or "lstm"
+    x = input
+    for layer in range(num_layers):
+        # lstm_unit projects concat(x, h) itself — no input fc needed
+        fwd, fwd_c = dynamic_lstm(
+            x, 4 * hidden_size, name="%s_l%d_fwd" % (name, layer),
+            return_cell=True)
+        if is_bidirec:
+            bwd, bwd_c = dynamic_lstm(
+                _reverse_time(x), 4 * hidden_size,
+                name="%s_l%d_bwd" % (name, layer), return_cell=True)
+            x = nn.concat([fwd, _reverse_time(bwd)], axis=2)
+        else:
+            x = fwd
+        if dropout_prob and not is_test and layer + 1 < num_layers:
+            x = nn.dropout(x, dropout_prob,
+                           dropout_implementation="upscale_in_train")
+    T = fwd.shape[1]
+
+    def _last(t):  # final recurrent state = step T-1 in scan order
+        return nn.slice(t, axes=[1], starts=[T - 1], ends=[T])
+
+    if is_bidirec:
+        # bwd's final state (after consuming the whole sequence) is its own
+        # step T-1, which sits at index 0 AFTER un-reversal — slice the
+        # pre-reversal trajectory instead
+        last_h = nn.concat([_last(fwd), _last(bwd)], axis=2)
+        last_c = nn.concat([_last(fwd_c), _last(bwd_c)], axis=2)
+    else:
+        last_h, last_c = _last(fwd), _last(fwd_c)
+    return x, last_h, last_c
+
+
+def _reverse_time(x):
+    """Reverse a [B, T, D] tensor along the time axis (reverse op)."""
+    from ..layer_helper import LayerHelper
+
+    helper = LayerHelper("reverse_time")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="reverse", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"axis": [1]})
+    out.shape = x.shape
+    return out

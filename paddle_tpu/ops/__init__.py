@@ -20,3 +20,5 @@ from . import vision  # noqa: F401
 from . import detection  # noqa: F401
 from . import loss_extra  # noqa: F401
 from . import misc2  # noqa: F401
+from . import crf  # noqa: F401
+from . import sampled  # noqa: F401

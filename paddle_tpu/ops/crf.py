@@ -1,0 +1,142 @@
+"""Linear-chain CRF ops (parity: paddle/fluid/operators/linear_chain_crf_op.cc,
+crf_decoding_op.cc).
+
+Dense [B, T, C] emissions with int length mask replace the reference's LoD
+batching.  Transition layout follows the reference: row 0 = start weights,
+row 1 = stop weights, rows 2.. = [C, C] transition matrix.  Forward
+(log-likelihood) runs as a lax.scan over time — differentiable, so the grad
+comes from the auto vjp; decoding is a Viterbi scan + backtrack.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+
+_NEG_INF = -1e30
+
+
+def _split_transition(transition):
+    start = transition[0]
+    stop = transition[1]
+    trans = transition[2:]
+    return start, stop, trans
+
+
+@register_op("linear_chain_crf", inputs=("Emission", "Transition", "Label",
+                                         "Length"),
+             outputs=("Alpha", "EmissionExps", "TransitionExps",
+                      "LogLikelihood"),
+             optional_inputs=("Length",),
+             no_grad_inputs=("Label", "Length"))
+def linear_chain_crf(ctx, emission, transition, label, length=None):
+    """Negative log-likelihood of label paths under a linear-chain CRF.
+
+    emission [B, T, C] (or [T, C] for one sequence), label [B, T]/[B, T, 1],
+    length [B] valid steps (None = all T).  Returns per-sequence NLL
+    [B, 1] in the LogLikelihood slot (matching the reference's sign: the
+    op's output is minimized directly).
+    """
+    if emission.ndim == 2:
+        emission = emission[None]
+    B, T, C = emission.shape
+    if label.ndim == 3:
+        label = label[..., 0]
+    if label.ndim == 1:
+        label = label[None]
+    label = label.astype(jnp.int32)
+    start, stop, trans = _split_transition(transition)
+    em = emission.astype(jnp.float32)
+    if length is None:
+        lens = jnp.full((B,), T, jnp.int32)
+    else:
+        lens = length.reshape(-1).astype(jnp.int32)
+
+    # ---- partition function: forward algorithm over time ------------------
+    alpha0 = start[None, :] + em[:, 0, :]                     # [B, C]
+
+    def fwd(alpha, t):
+        # [B, C_prev] -> [B, C]: logsumexp over previous tag
+        scores = alpha[:, :, None] + trans[None, :, :]
+        new = jax.nn.logsumexp(scores, axis=1) + em[:, t, :]
+        keep = (t < lens)[:, None]
+        return jnp.where(keep, new, alpha), alpha
+
+    alpha_final, alphas = lax.scan(fwd, alpha0, jnp.arange(1, T))
+    logZ = jax.nn.logsumexp(alpha_final + stop[None, :], axis=1)
+
+    # ---- score of the gold path -------------------------------------------
+    b_idx = jnp.arange(B)
+    first_em = em[:, 0, :][b_idx, label[:, 0]]
+    gold = start[label[:, 0]] + first_em
+
+    def gold_step(g, t):
+        prev = label[:, t - 1]
+        cur = label[:, t]
+        add = trans[prev, cur] + em[:, t, :][b_idx, cur]
+        return g + jnp.where(t < lens, add, 0.0), None
+
+    gold, _ = lax.scan(gold_step, gold, jnp.arange(1, T))
+    last_idx = jnp.clip(lens - 1, 0, T - 1)
+    last_tag = jnp.take_along_axis(label, last_idx[:, None], axis=1)[:, 0]
+    gold = gold + stop[last_tag]
+
+    nll = (logZ - gold)[:, None]
+    # Alpha / exps outputs kept for API parity (consumed by nothing on TPU —
+    # the grad comes from the auto vjp of this forward)
+    return (jnp.concatenate([alpha0[:, None, :],
+                             jnp.swapaxes(alphas, 0, 1)], axis=1),
+            jnp.exp(em), jnp.exp(transition), nll)
+
+
+@register_op("crf_decoding", inputs=("Emission", "Transition", "Label",
+                                     "Length"),
+             outputs=("ViterbiPath",),
+             optional_inputs=("Label", "Length"), grad_maker=None)
+def crf_decoding(ctx, emission, transition, label=None, length=None):
+    """Viterbi decode (crf_decoding_op.cc).  With Label given, emits 1 where
+    the decoded tag disagrees with the label (the reference's error-mask
+    mode); otherwise the best tag path [B, T]."""
+    if emission.ndim == 2:
+        emission = emission[None]
+    B, T, C = emission.shape
+    start, stop, trans = _split_transition(transition)
+    em = emission.astype(jnp.float32)
+    if length is None:
+        lens = jnp.full((B,), T, jnp.int32)
+    else:
+        lens = length.reshape(-1).astype(jnp.int32)
+
+    v0 = start[None, :] + em[:, 0, :]
+
+    def vit(v, t):
+        scores = v[:, :, None] + trans[None, :, :]          # [B, Cp, C]
+        best_prev = jnp.argmax(scores, axis=1)              # [B, C]
+        new = jnp.max(scores, axis=1) + em[:, t, :]
+        keep = (t < lens)[:, None]
+        return jnp.where(keep, new, v), best_prev
+
+    v_final, backptrs = lax.scan(vit, v0, jnp.arange(1, T))  # [T-1, B, C]
+    last = jnp.argmax(v_final + stop[None, :], axis=1)       # [B]
+
+    # walk from T-2 down to 0 emitting the tag at step t+1;
+    # backptrs[t] maps tags at step t+1 -> best tag at step t
+    def back_scan(tag, t):
+        bp = backptrs[t]
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        within = (t + 1) < lens
+        new_tag = jnp.where(within, prev, tag)
+        return new_tag, tag
+
+    tag_T, emitted = lax.scan(back_scan, last, jnp.arange(T - 2, -1, -1))
+    # emitted holds tags for steps T-1..1 (in reverse); prepend step 0
+    path = jnp.concatenate([tag_T[None, :], emitted[::-1]], axis=0)  # [T, B]
+    path = jnp.swapaxes(path, 0, 1).astype(jnp.int64)                # [B, T]
+    if label is not None:
+        if label.ndim == 3:
+            label = label[..., 0]
+        if label.ndim == 1:
+            label = label[None]
+        return (path != label.astype(jnp.int64)).astype(jnp.int64)
+    return path

@@ -1,0 +1,131 @@
+"""Sampled / hierarchical classification ops + host callback.
+
+Parity (paddle/fluid/operators/): nce_op.cc (noise contrastive estimation,
+uniform sampler), hierarchical_sigmoid_op.cc (SimpleCode complete binary
+tree, matrix_bit_code.h), py_func_op.cc (host Python callback — lowered via
+jax.pure_callback instead of holding the GIL inside an op kernel).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+@register_op("nce", inputs=("Input", "Label", "Weight", "Bias",
+                            "SampleWeight"),
+             outputs=("Cost", "SampleLogits", "SampleLabels"),
+             attrs={"num_total_classes": 2, "num_neg_samples": 10,
+                    "seed": 0, "sampler": 0, "is_sparse": False},
+             optional_inputs=("Bias", "SampleWeight"),
+             no_grad_inputs=("Label", "SampleWeight"), n_rng=1)
+def nce(ctx, x, label, weight, bias=None, sample_weight=None,
+        num_total_classes=2, num_neg_samples=10, seed=0, sampler=0,
+        is_sparse=False, **_):
+    """NCE loss with a uniform negative sampler (nce_op.cc): x [B, D],
+    label [B, 1], weight [C, D], bias [C]."""
+    B = x.shape[0]
+    lbl = label.reshape(-1).astype(jnp.int32)
+    neg = jax.random.randint(ctx.rng(), (B, num_neg_samples), 0,
+                             num_total_classes)
+
+    def logit(ids):
+        w = weight[ids]                       # [..., D]
+        out = jnp.sum(w * x[:, None, :] if ids.ndim == 2 else w * x, axis=-1)
+        if bias is not None:
+            out = out + bias[ids]
+        return out
+
+    pos_logit = logit(lbl)                    # [B]
+    neg_logit = logit(neg)                    # [B, S]
+    # uniform sampler: log q = log(1/C) per sample (nce_op.h sampler prob)
+    log_q = -jnp.log(float(num_total_classes))
+    s = float(num_neg_samples)
+    pos = jax.nn.log_sigmoid(pos_logit - jnp.log(s) - log_q)
+    neg_ = jax.nn.log_sigmoid(-(neg_logit - jnp.log(s) - log_q))
+    cost = -(pos + jnp.sum(neg_, axis=1))
+    sample_logits = jnp.concatenate([pos_logit[:, None], neg_logit], axis=1)
+    sample_labels = jnp.concatenate([lbl[:, None], neg], axis=1)
+    return cost[:, None], sample_logits, sample_labels.astype(jnp.int64)
+
+
+@register_op("hierarchical_sigmoid",
+             inputs=("X", "W", "Label", "PathTable", "PathCode", "Bias"),
+             outputs=("Out", "PreOut", "W_Out"),
+             attrs={"num_classes": 2, "is_sparse": False},
+             optional_inputs=("PathTable", "PathCode", "Bias"),
+             no_grad_inputs=("Label", "PathTable", "PathCode"))
+def hierarchical_sigmoid(ctx, x, w, label, path_table=None, path_code=None,
+                         bias=None, num_classes=2, is_sparse=False, **_):
+    """Hierarchical sigmoid over the SimpleCode complete binary tree
+    (hierarchical_sigmoid_op.cc + matrix_bit_code.h): code(c) = c + C;
+    path node i = (code >> (len-i)) - 1, bit i = (code >> (len-1-i)) & 1.
+    x [B, D], w [C-1+pad, D], label [B, 1]."""
+    import math
+
+    B, D = x.shape
+    C = num_classes
+    max_len = max(int(math.floor(math.log2(max(C, 2)))) + 1, 1)
+    lbl = label.reshape(-1).astype(jnp.int32)
+    code = lbl + C
+    # length = floor(log2(code)); compute via comparisons (static max_len)
+    length = jnp.zeros_like(code)
+    for k in range(1, max_len + 2):
+        length = jnp.where(code >= (1 << k), k, length)
+    steps = jnp.arange(max_len)[None, :]                       # [1, L]
+    valid = steps < length[:, None]
+    node = jnp.where(valid, (code[:, None] >> (length[:, None] - steps)) - 1,
+                     0)
+    bit = jnp.where(valid,
+                    (code[:, None] >> (length[:, None] - 1 - steps)) & 1, 0)
+    wn = w[node]                                               # [B, L, D]
+    pre = jnp.einsum("bld,bd->bl", wn, x)
+    if bias is not None:
+        pre = pre + bias.reshape(-1)[node]
+    # label bit 1 -> sigmoid(pre), 0 -> 1 - sigmoid(pre); NLL sum over path
+    sign = 1.0 - 2.0 * bit.astype(pre.dtype)
+    losses = jnp.logaddexp(0.0, sign * pre)
+    loss = jnp.sum(jnp.where(valid, losses, 0.0), axis=1)
+    return loss[:, None], pre, w
+
+
+_PYFUNC_REGISTRY = {}
+
+
+def register_py_func(fn):
+    """Register a host callback; returns its id (py_func_op.cc's
+    py_func registry analog)."""
+    fid = len(_PYFUNC_REGISTRY)
+    _PYFUNC_REGISTRY[fid] = fn
+    return fid
+
+
+@register_op("py_func", inputs=("X",), outputs=("Out",),
+             attrs={"forward_callable_id": 0, "backward_callable_id": -1,
+                    "out_shapes": [], "out_dtypes": []},
+             duplicable_inputs=("X",), duplicable_outputs=("Out",),
+             grad_maker=None)
+def py_func(ctx, xs, forward_callable_id=0, backward_callable_id=-1,
+            out_shapes=(), out_dtypes=()):
+    """Host Python callback inside a compiled program via
+    jax.pure_callback (py_func_op.cc analog; the callback must be
+    functionally pure — it runs outside the XLA graph on the host)."""
+    import numpy as np
+
+    fn = _PYFUNC_REGISTRY[forward_callable_id]
+    shapes = [tuple(s) for s in out_shapes]
+    dtypes = [np.dtype(d) for d in out_dtypes]
+    result_shape = [jax.ShapeDtypeStruct(s, d)
+                    for s, d in zip(shapes, dtypes)]
+
+    def host_fn(*arrays):
+        out = fn(*arrays)
+        if not isinstance(out, (list, tuple)):
+            out = (out,)
+        return tuple(np.asarray(o, dtype=d)
+                     for o, d in zip(out, dtypes))
+
+    out = jax.pure_callback(host_fn, tuple(result_shape), *xs)
+    # tuple-wrapped list: "one duplicable output slot holding len(out)
+    # items" (a bare 1-element list would be mis-split by the scatter)
+    return (list(out),)

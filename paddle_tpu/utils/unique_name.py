@@ -23,18 +23,27 @@ class _NameGenerator:
         return "%s%s_%d" % (self._prefix, key, idx)
 
 
-_generator = _NameGenerator()
+# One shared default generator (uniqueness across ALL threads appending to
+# the same program), with per-thread overrides: a thread that wants an
+# isolated, reproducible name sequence (pserver/worker role threads standing
+# in for the reference's separate processes) opts in via guard()/switch().
+_default_generator = _NameGenerator()
+_tls = threading.local()
+
+
+def _gen():
+    return getattr(_tls, "generator", None) or _default_generator
 
 
 def generate(key):
     """Generate a unique name like ``fc_0.w_0`` for the given key."""
-    return _generator.generate(key)
+    return _gen().generate(key)
 
 
 def switch(new_generator=None):
-    global _generator
-    old = _generator
-    _generator = new_generator if new_generator is not None else _NameGenerator()
+    old = getattr(_tls, "generator", None)
+    _tls.generator = (new_generator if new_generator is not None
+                      else _NameGenerator())
     return old
 
 
@@ -46,4 +55,6 @@ def guard(new_generator=None):
     try:
         yield
     finally:
-        switch(old)
+        # restore exactly: None means "no thread-local override" (shared
+        # default generator), not a fresh generator
+        _tls.generator = old

@@ -337,3 +337,167 @@ def test_prior_box_min_max_order():
     w = (b[0, 0, :, 2] - b[0, 0, :, 0]) * 16
     np.testing.assert_allclose(w, [4.0, (4 * 8) ** 0.5, 4 * 2 ** 0.5],
                                rtol=1e-5)
+
+
+# -- CRF ----------------------------------------------------------------------
+
+
+def _crf_brute(em, trans_full, lens):
+    """Enumerate all paths: returns (logZ, best_path) per sequence."""
+    import itertools
+    start, stop, trans = trans_full[0], trans_full[1], trans_full[2:]
+    B, T, C = em.shape
+    logZs, paths = [], []
+    for b in range(B):
+        L = lens[b]
+        scores = {}
+        for path in itertools.product(range(C), repeat=L):
+            s = start[path[0]] + em[b, 0, path[0]]
+            for t in range(1, L):
+                s += trans[path[t - 1], path[t]] + em[b, t, path[t]]
+            s += stop[path[-1]]
+            scores[path] = s
+        vals = np.array(list(scores.values()))
+        m = vals.max()
+        logZs.append(m + np.log(np.exp(vals - m).sum()))
+        paths.append(list(max(scores, key=scores.get)))
+    return np.array(logZs), paths
+
+
+def test_linear_chain_crf_matches_bruteforce():
+    rng = np.random.RandomState(0)
+    B, T, C = 2, 4, 3
+    em = rng.randn(B, T, C).astype("f")
+    trans = rng.randn(C + 2, C).astype("f") * 0.5
+    label = rng.randint(0, C, (B, T)).astype("int64")
+    lens = np.array([4, 3], "int64")
+    _, _, _, nll = run_op("linear_chain_crf", jnp.asarray(em),
+                          jnp.asarray(trans), jnp.asarray(label),
+                          jnp.asarray(lens))
+    logZ, _ = _crf_brute(em, trans, lens)
+    # gold scores by hand
+    start, stop, tr = trans[0], trans[1], trans[2:]
+    for b in range(B):
+        L = lens[b]
+        g = start[label[b, 0]] + em[b, 0, label[b, 0]]
+        for t in range(1, L):
+            g += tr[label[b, t - 1], label[b, t]] + em[b, t, label[b, t]]
+        g += stop[label[b, L - 1]]
+        np.testing.assert_allclose(float(np.asarray(nll)[b, 0]),
+                                   logZ[b] - g, rtol=1e-4)
+
+
+def test_crf_decoding_matches_bruteforce():
+    rng = np.random.RandomState(1)
+    B, T, C = 2, 4, 3
+    em = rng.randn(B, T, C).astype("f")
+    trans = rng.randn(C + 2, C).astype("f") * 0.5
+    lens = np.array([4, 3], "int64")
+    path = run_op("crf_decoding", jnp.asarray(em), jnp.asarray(trans),
+                  None, jnp.asarray(lens))
+    _, best = _crf_brute(em, trans, lens)
+    p = np.asarray(path)
+    for b in range(B):
+        np.testing.assert_array_equal(p[b, :lens[b]], best[b])
+
+
+def test_crf_trains_in_program():
+    rng = np.random.RandomState(0)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[5, 8])
+        lbl = fluid.layers.data("lbl", shape=[5], dtype="int64")
+        em = fluid.layers.fc(x, 4, num_flatten_dims=2)
+        nll = fluid.layers.linear_chain_crf(em, lbl)
+        loss = fluid.layers.mean(nll)
+        fluid.optimizer.SGD(0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    feed = {"x": rng.rand(3, 5, 8).astype("f"),
+            "lbl": rng.randint(0, 4, (3, 5)).astype("int64")}
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        l0, = exe.run(main, feed=feed, fetch_list=[loss])
+        for _ in range(20):
+            l1, = exe.run(main, feed=feed, fetch_list=[loss])
+    assert float(np.asarray(l1).ravel()[0]) < float(np.asarray(l0).ravel()[0])
+
+
+def test_stacked_lstm_and_lstmp():
+    rng = np.random.RandomState(0)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[6, 8])
+        out, lh, lc = fluid.layers.lstm(x, None, None, 6, hidden_size=10,
+                                        num_layers=2, is_bidirec=True)
+        proj, cells = fluid.layers.dynamic_lstmp(
+            fluid.layers.fc(x, 32, num_flatten_dims=2), 32, proj_size=5)
+        loss = fluid.layers.reduce_mean(out) + fluid.layers.reduce_mean(proj)
+        fluid.optimizer.SGD(0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    feed = {"x": rng.rand(2, 6, 8).astype("f")}
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        o, p, l0 = exe.run(main, feed=feed, fetch_list=[out, proj, loss])
+        for _ in range(5):
+            _, _, l1 = exe.run(main, feed=feed, fetch_list=[out, proj, loss])
+    assert np.asarray(o).shape == (2, 6, 20)   # bidirectional 2*10
+    assert np.asarray(p).shape == (2, 6, 5)
+    assert float(np.asarray(l1).ravel()[0]) < float(np.asarray(l0).ravel()[0])
+
+
+def test_nce_hsigmoid_train():
+    rng = np.random.RandomState(0)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8])
+        y = fluid.layers.data("y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, 16, act="relu")
+        nce_cost = fluid.layers.nce(h, y, num_total_classes=20,
+                                    num_neg_samples=5)
+        hs_cost = fluid.layers.hsigmoid(h, y, num_classes=20)
+        loss = fluid.layers.mean(nce_cost) + fluid.layers.mean(hs_cost)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    feed = {"x": rng.rand(16, 8).astype("f"),
+            "y": rng.randint(0, 20, (16, 1)).astype("int64")}
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        l0, = exe.run(main, feed=feed, fetch_list=[loss])
+        for _ in range(15):
+            l1, = exe.run(main, feed=feed, fetch_list=[loss])
+    assert float(np.asarray(l1).ravel()[0]) < float(np.asarray(l0).ravel()[0])
+
+
+def test_hsigmoid_is_valid_distribution():
+    # sum over classes of exp(-loss(c)) must be 1 for a binary tree
+    import jax
+    x = jnp.asarray(np.random.RandomState(0).rand(1, 4).astype("f"))
+    w = jnp.asarray(np.random.RandomState(1).randn(8, 4).astype("f") * 0.5)
+    tot = 0.0
+    for c in range(8):
+        loss, _, _ = run_op("hierarchical_sigmoid", x, w,
+                            jnp.asarray(np.array([[c]], "int64")), None,
+                            None, None, num_classes=8)
+        tot += float(np.exp(-np.asarray(loss)[0, 0]))
+    np.testing.assert_allclose(tot, 1.0, rtol=1e-4)
+
+
+def test_py_func_callback():
+    def double_plus_one(a):
+        return a * 2 + 1
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        out = fluid.layers.data("out_placeholder", shape=[4])
+        out = main.global_block().create_var(name="pyout", shape=(2, 4),
+                                             dtype="float32")
+        fluid.layers.py_func(double_plus_one, x, out)
+        s = fluid.layers.reduce_sum(out)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.arange(8, dtype="f").reshape(2, 4)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        o, = exe.run(main, feed={"x": xv}, fetch_list=[s])
+    np.testing.assert_allclose(float(np.asarray(o).ravel()[0]),
+                               (xv * 2 + 1).sum(), rtol=1e-6)

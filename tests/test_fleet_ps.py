@@ -1,0 +1,112 @@
+"""fleet parameter-server backend test (reference test_dist_fleet_base.py
+pattern): 1 pserver + 2 workers as threads through the fleet API."""
+
+import socket
+import threading
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.incubate.fleet.base.role_maker import (Role,
+                                                       UserDefinedRoleMaker)
+from paddle_tpu.incubate.fleet.parameter_server import (
+    DistributedTranspiler, TranspilerOptimizer)
+from paddle_tpu.initializer import Constant
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _build():
+    # identical var names across server/worker threads (separate processes
+    # in the reference; here the shared name counter must be scoped)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        y = fluid.layers.data("y", shape=[1])
+        pred = fluid.layers.fc(
+            x, 1, param_attr=fluid.ParamAttr(initializer=Constant(0.0)),
+            bias_attr=fluid.ParamAttr(initializer=Constant(0.0)))
+        diff = fluid.layers.elementwise_sub(pred, y)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.elementwise_mul(diff, diff))
+    return main, startup, loss
+
+
+def test_fleet_ps_end_to_end():
+    eps = ["127.0.0.1:%d" % _free_port()]
+    errors = []
+    workers = 2
+    rng = np.random.RandomState(0)
+    w_true = np.array([[1.0], [-1.0], [2.0], [0.5]], "f")
+    xs = rng.rand(8, 16, 4).astype("f")
+    ys = xs @ w_true
+
+    def server_thread():
+        try:
+            f = DistributedTranspiler()
+            f.init(UserDefinedRoleMaker(current_id=0, role=Role.SERVER,
+                                        worker_num=workers,
+                                        server_endpoints=eps))
+            from paddle_tpu.utils import unique_name as _un
+
+            with _un.guard():
+                main, startup, loss = _build()
+                with fluid.program_guard(main, startup):
+                    opt = f.distributed_optimizer(fluid.optimizer.SGD(0.2))
+                    opt.minimize(loss)
+                    f.init_server()
+            f.run_server()
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    results = [None] * workers
+
+    def worker_thread(wid):
+        try:
+            f = DistributedTranspiler()
+            f.init(UserDefinedRoleMaker(current_id=wid, role=Role.WORKER,
+                                        worker_num=workers,
+                                        server_endpoints=eps))
+            from paddle_tpu.utils import unique_name as _un
+
+            with _un.guard():
+                main, startup, loss = _build()
+                with fluid.program_guard(main, startup):
+                    opt = f.distributed_optimizer(fluid.optimizer.SGD(0.2))
+                    opt.minimize(loss)
+            f.init_worker()
+            with fluid.program_guard(main, startup):
+                exe = fluid.Executor(fluid.CPUPlace())
+                scope = fluid.Scope()
+                with fluid.scope_guard(scope):
+                    exe.run(f.startup_program)
+                    half = slice(wid * 8, (wid + 1) * 8)
+                    for i in range(8):
+                        out, = exe.run(f.main_program,
+                                       feed={"x": xs[i][half],
+                                             "y": ys[i][half]},
+                                       fetch_list=[loss], scope=scope)
+                    results[wid] = float(np.asarray(out).ravel()[0])
+                    scope._ps_comm.complete()
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    st = threading.Thread(target=server_thread, daemon=True)
+    st.start()
+    wts = [threading.Thread(target=worker_thread, args=(i,), daemon=True)
+           for i in range(workers)]
+    for t in wts:
+        t.start()
+    for t in wts:
+        t.join(timeout=120)
+    st.join(timeout=30)
+    assert not errors, errors
+    assert all(r is not None for r in results)
+    # loss decreased from initial (params start at 0 -> loss = mean(y^2))
+    assert results[0] < float((ys ** 2).mean())
