@@ -26,28 +26,46 @@ from .scope import Scope
 
 __all__ = ["Executor", "global_scope", "scope_guard"]
 
-_global_scope = Scope()
-
-
-def global_scope():
-    return _global_scope
-
-
 import contextlib
 import threading
 
 _RNG_COUNTER_LOCK = threading.Lock()
 
+_global_scope = Scope()
+# Per-thread scope override (same design as framework's default-program TLS):
+# role threads (pserver/worker standing in for separate processes) each
+# scope_guard their own Scope without racing on the module global; threads
+# that never call scope_guard see the main thread's current scope.
+_scope_tls = threading.local()
+
+
+def _is_main_thread():
+    return threading.current_thread() is threading.main_thread()
+
+
+def global_scope():
+    if not _is_main_thread() and getattr(_scope_tls, "scope", None) is not None:
+        return _scope_tls.scope
+    return _global_scope
+
 
 @contextlib.contextmanager
 def scope_guard(scope):
     global _global_scope
-    old = _global_scope
-    _global_scope = scope
-    try:
-        yield
-    finally:
-        _global_scope = old
+    if _is_main_thread():
+        old = _global_scope
+        _global_scope = scope
+        try:
+            yield
+        finally:
+            _global_scope = old
+    else:
+        old = getattr(_scope_tls, "scope", None)
+        _scope_tls.scope = scope
+        try:
+            yield
+        finally:
+            _scope_tls.scope = old
 
 
 def _fetch_name(f):
