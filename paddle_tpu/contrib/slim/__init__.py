@@ -1,0 +1,3 @@
+"""contrib.slim: model compression (parity: fluid/contrib/slim/)."""
+
+from . import quantization  # noqa: F401

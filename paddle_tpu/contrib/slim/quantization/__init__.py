@@ -1,0 +1,4 @@
+from .quantization_pass import (  # noqa: F401
+    QuantizationTransformPass,
+    QuantizationFreezePass,
+)

@@ -1,0 +1,133 @@
+"""Quantization-aware-training program rewriting (parity:
+fluid/contrib/slim/quantization/quantization_pass.py
+QuantizationTransformPass / QuantizationFreezePass).
+
+The reference rewrites an ir::Graph; here the pass rewrites the Program's
+op list directly: for every quantizable op (mul/matmul/conv2d/
+depthwise_conv2d), the activation input is routed through a
+fake_quantize_moving_average_abs_max op and the weight input through
+fake_channel_wise_quantize_abs_max — forward simulates int8, backward is
+straight-through, weights stay float (QAT).
+"""
+
+from ....framework import OP_ROLE_KEY, OpRole
+from ....initializer import Constant
+from ....utils import unique_name
+
+QUANTIZABLE = ("mul", "matmul", "conv2d", "depthwise_conv2d")
+
+
+class QuantizationTransformPass:
+    def __init__(self, scope=None, place=None, weight_bits=8,
+                 activation_bits=8, moving_rate=0.9,
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_quantize_type="channel_wise_abs_max",
+                 quantizable_op_type=QUANTIZABLE):
+        self._weight_bits = weight_bits
+        self._activation_bits = activation_bits
+        self._moving_rate = moving_rate
+        self._ops = tuple(quantizable_op_type)
+
+    def apply(self, program, startup_program=None, is_test=False):
+        """Insert fake-quant ops in front of every quantizable op's inputs.
+        Returns the number of rewritten ops."""
+        block = program.global_block()
+        quantized = {}  # original name -> quantized name
+        new_ops = []
+        n = 0
+        for op in list(block.ops):
+            if op.type in self._ops and not (
+                    int(op.attr(OP_ROLE_KEY) or 0) & OpRole.Backward):
+                n += 1
+                for slot in ("X", "Y", "Input", "Filter"):
+                    names = op.input(slot)
+                    if not names:
+                        continue
+                    name = names[0]
+                    v = block._find_var_recursive(name)
+                    if v is None or v.dtype not in ("float32", "bfloat16",
+                                                    None):
+                        continue
+                    if name not in quantized:
+                        # weight vs activation by persistability (the
+                        # reference's rule) — a matmul(act, act) must NOT
+                        # take the channel-wise weight path
+                        is_weight = bool(getattr(v, "persistable", False))
+                        qname = unique_name.generate(name + ".quantized")
+                        qv = block.create_var(name=qname, dtype=v.dtype,
+                                              shape=v.shape)
+                        if is_weight:
+                            scale = block.create_var(
+                                name=qname + ".scale", dtype="float32")
+                            qop = _make_op(
+                                block, "fake_channel_wise_quantize_abs_max",
+                                {"X": [name]},
+                                {"Out": [qname], "OutScale": [scale.name]},
+                                {"bit_length": self._weight_bits,
+                                 "quant_axis": 0})
+                        else:
+                            def mkstate(suffix, init):
+                                sv = block.create_var(
+                                    name=unique_name.generate(
+                                        name + suffix),
+                                    dtype="float32", shape=(1,),
+                                    persistable=True)
+                                sv.stop_gradient = True
+                                Constant(init)(sv, startup_program
+                                               .global_block()
+                                               if startup_program else None)
+                                return sv
+
+                            # separate running scale / accumulator / state
+                            # (aliasing them breaks the moving average:
+                            # scale = accum/state must not feed accum back
+                            # into the scale slot)
+                            in_scale = mkstate(".in_scale", 1.0)
+                            accum = mkstate(".accum", 1.0)
+                            state = mkstate(".state", 1.0)
+                            scale = block.create_var(
+                                name=qname + ".scale", dtype="float32")
+                            qop = _make_op(
+                                block,
+                                "fake_quantize_moving_average_abs_max",
+                                {"X": [name], "InScale": [in_scale.name],
+                                 "InAccum": [accum.name],
+                                 "InState": [state.name]},
+                                {"Out": [qname], "OutScale": [in_scale.name],
+                                 "OutAccum": [accum.name],
+                                 "OutState": [state.name]},
+                                {"bit_length": self._activation_bits,
+                                 "moving_rate": self._moving_rate,
+                                 "is_test": is_test})
+                        new_ops.append((op, qop))
+                        quantized[name] = qname
+                    op.inputs[slot] = [quantized[name]]
+        # splice each quant op right before its consumer
+        for consumer, qop in new_ops:
+            idx = block.ops.index(consumer)
+            block.ops.insert(idx, qop)
+        program._bump_version()
+        return n
+
+
+class QuantizationFreezePass:
+    """Post-training freeze (reference QuantizationFreezePass): on this
+    backend the fake-quant ops already simulate the int grid in forward, so
+    freezing only flips moving-average quantizers to is_test=True."""
+
+    def __init__(self, scope=None, place=None, weight_bits=8,
+                 activation_bits=8, weight_quantize_type="abs_max"):
+        pass
+
+    def apply(self, program):
+        for op in program.global_block().ops:
+            if op.type == "fake_quantize_moving_average_abs_max":
+                op.attrs["is_test"] = True
+        program._bump_version()
+
+
+def _make_op(block, type, inputs, outputs, attrs):
+    """Build an Operator without appending (spliced later)."""
+    from ....framework import Operator
+
+    return Operator(block, type, inputs, outputs, attrs)

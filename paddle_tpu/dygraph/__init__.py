@@ -10,6 +10,9 @@ from .nn import (
 from .checkpoint import save_dygraph, load_dygraph
 from .jit import TracedLayer
 from .parallel import prepare_context, Env, ParallelEnv, DataParallel
+from .learning_rate_scheduler import (
+    LearningRateDecay, PiecewiseDecay, NaturalExpDecay, ExponentialDecay,
+    InverseTimeDecay, PolynomialDecay, CosineDecay, NoamDecay)
 
 __all__ = [
     "guard", "enabled", "to_variable", "no_grad", "Tracer", "Layer",
@@ -17,4 +20,7 @@ __all__ = [
     "Embedding", "LayerNorm", "GroupNorm", "PRelu", "Dropout",
     "save_dygraph", "load_dygraph", "TracedLayer",
     "prepare_context", "Env", "ParallelEnv", "DataParallel",
+    "LearningRateDecay", "PiecewiseDecay", "NaturalExpDecay",
+    "ExponentialDecay", "InverseTimeDecay", "PolynomialDecay",
+    "CosineDecay", "NoamDecay",
 ]

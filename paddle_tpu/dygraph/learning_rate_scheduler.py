@@ -1,0 +1,120 @@
+"""Dygraph learning-rate schedulers (parity:
+python/paddle/fluid/dygraph/learning_rate_scheduler.py).
+
+Each scheduler is a callable whose value advances one step per optimizer
+update (the optimizer calls `step()` when it refreshes the lr variable)."""
+
+import math
+
+__all__ = ["LearningRateDecay", "PiecewiseDecay", "NaturalExpDecay",
+           "ExponentialDecay", "InverseTimeDecay", "PolynomialDecay",
+           "CosineDecay", "NoamDecay"]
+
+
+class LearningRateDecay:
+    def __init__(self, begin=0, step=1):
+        self.step_num = begin
+        self.step_size = step
+
+    def __call__(self):
+        return float(self.value())
+
+    def step(self):
+        val = self.value()
+        self.step_num += self.step_size
+        return float(val)
+
+    def value(self):
+        raise NotImplementedError
+
+
+class PiecewiseDecay(LearningRateDecay):
+    def __init__(self, boundaries, values, begin=0, step=1):
+        super().__init__(begin, step)
+        self.boundaries = list(boundaries)
+        self.values = list(values)
+
+    def value(self):
+        for b, v in zip(self.boundaries, self.values):
+            if self.step_num < b:
+                return v
+        return self.values[len(self.boundaries)]
+
+
+class NaturalExpDecay(LearningRateDecay):
+    def __init__(self, learning_rate, decay_steps, decay_rate,
+                 staircase=False, begin=0, step=1):
+        super().__init__(begin, step)
+        self.lr = learning_rate
+        self.decay_steps = decay_steps
+        self.decay_rate = decay_rate
+        self.staircase = staircase
+
+    def value(self):
+        n = self.step_num / self.decay_steps
+        if self.staircase:
+            n = math.floor(n)
+        return self.lr * math.exp(-self.decay_rate * n)
+
+
+class ExponentialDecay(NaturalExpDecay):
+    def value(self):
+        n = self.step_num / self.decay_steps
+        if self.staircase:
+            n = math.floor(n)
+        return self.lr * (self.decay_rate ** n)
+
+
+class InverseTimeDecay(NaturalExpDecay):
+    def value(self):
+        n = self.step_num / self.decay_steps
+        if self.staircase:
+            n = math.floor(n)
+        return self.lr / (1 + self.decay_rate * n)
+
+
+class PolynomialDecay(LearningRateDecay):
+    def __init__(self, learning_rate, decay_steps, end_learning_rate=1e-4,
+                 power=1.0, cycle=False, begin=0, step=1):
+        super().__init__(begin, step)
+        self.lr = learning_rate
+        self.decay_steps = decay_steps
+        self.end_lr = end_learning_rate
+        self.power = power
+        self.cycle = cycle
+
+    def value(self):
+        t = self.step_num
+        steps = self.decay_steps
+        if self.cycle:
+            mult = max(math.ceil(t / steps), 1)
+            steps = steps * mult
+        else:
+            t = min(t, steps)
+        return ((self.lr - self.end_lr)
+                * (1 - t / steps) ** self.power + self.end_lr)
+
+
+class CosineDecay(LearningRateDecay):
+    def __init__(self, learning_rate, step_each_epoch, epochs, begin=0,
+                 step=1):
+        super().__init__(begin, step)
+        self.lr = learning_rate
+        self.step_each_epoch = step_each_epoch
+        self.epochs = epochs
+
+    def value(self):
+        epoch = self.step_num // self.step_each_epoch
+        return self.lr * 0.5 * (math.cos(epoch * math.pi / self.epochs) + 1)
+
+
+class NoamDecay(LearningRateDecay):
+    def __init__(self, d_model, warmup_steps, begin=1, step=1):
+        super().__init__(begin, step)
+        self.d_model = d_model
+        self.warmup_steps = warmup_steps
+
+    def value(self):
+        n = max(self.step_num, 1)
+        return (self.d_model ** -0.5) * min(n ** -0.5,
+                                            n * self.warmup_steps ** -1.5)

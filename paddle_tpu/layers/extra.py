@@ -26,7 +26,7 @@ __all__ = [
     "box_coder", "box_clip", "yolo_box", "bipartite_match", "target_assign",
     "multiclass_nms", "roi_align", "roi_pool",
     "linear_chain_crf", "crf_decoding",
-    "nce", "hsigmoid", "py_func",
+    "nce", "hsigmoid", "py_func", "sync_batch_norm_layer", "Print",
 ]
 
 
@@ -820,4 +820,64 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
         attrs={"forward_callable_id": fid, "backward_callable_id": -1,
                "out_shapes": [list(o.shape) for o in outs],
                "out_dtypes": [str(o.dtype) for o in outs]})
+    return out
+
+
+def sync_batch_norm_layer(input, act=None, is_test=False, momentum=0.9,
+                          epsilon=1e-5, param_attr=None, bias_attr=None,
+                          data_layout="NCHW", moving_mean_name=None,
+                          moving_variance_name=None, name=None):
+    """layers-style sync BN builder (the dygraph-era paddle exposes this as
+    paddle.nn.SyncBatchNorm; in fluid it is batch_norm + build-strategy
+    sync_batch_norm=True — here the op is explicit)."""
+    from ..initializer import Constant
+
+    helper = LayerHelper("sync_batch_norm", act=act, name=name)
+    dtype = input.dtype
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    scale_p = helper.create_parameter(attr=param_attr, shape=[c], dtype=dtype,
+                                      default_initializer=Constant(1.0))
+    bias_p = helper.create_parameter(attr=bias_attr, shape=[c], dtype=dtype,
+                                     is_bias=True,
+                                     default_initializer=Constant(0.0))
+    mean = helper.create_or_get_global_variable(
+        name=moving_mean_name or helper.name + ".mean", shape=[c],
+        dtype=dtype, persistable=True)
+    var = helper.create_or_get_global_variable(
+        name=moving_variance_name or helper.name + ".var", shape=[c],
+        dtype=dtype, persistable=True)
+    mean.stop_gradient = var.stop_gradient = True
+    if not getattr(mean, "_bn_initialized", False):
+        Constant(0.0)(mean)
+        Constant(1.0)(var)
+        mean._bn_initialized = True
+    sm = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    sv = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    rs = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="sync_batch_norm",
+        inputs={"X": [input], "Scale": [scale_p], "Bias": [bias_p],
+                "Mean": [mean], "Variance": [var]},
+        outputs={"Y": [out], "MeanOut": [mean], "VarianceOut": [var],
+                 "SavedMean": [sm], "SavedVariance": [sv],
+                 "ReserveSpace": [rs]},
+        attrs={"momentum": momentum, "epsilon": epsilon, "is_test": is_test,
+               "data_layout": data_layout})
+    return helper.append_activation(out)
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both"):
+    """Runtime tensor print (reference layers/control_flow.py Print)."""
+    helper = LayerHelper("print")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="print", inputs={"In": [input]}, outputs={"Out": [out]},
+        attrs={"first_n": first_n, "message": message or "",
+               "summarize": summarize,
+               "print_tensor_name": print_tensor_name,
+               "print_tensor_shape": print_tensor_shape})
     return out

@@ -22,3 +22,4 @@ from . import loss_extra  # noqa: F401
 from . import misc2  # noqa: F401
 from . import crf  # noqa: F401
 from . import sampled  # noqa: F401
+from . import quant  # noqa: F401

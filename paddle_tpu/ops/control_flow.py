@@ -366,8 +366,31 @@ recurrent.opdef.infer_shape = _recurrent_infer
            "print_phase": "BOTH"},
     grad_maker=None,
 )
-def print_op(ctx, x, message="", **_):
-    jax.debug.print(message + "{x}", x=x)
+def print_op(ctx, x, message="", first_n=-1, summarize=20,
+             print_tensor_name=True, print_tensor_shape=True, **_):
+    # host-side callback: first_n gating and summarize truncation run in
+    # Python on each executed step (print_op.cc semantics)
+    import numpy as _np
+
+    count = [0]
+    name = ctx.op.output("Out")[0] if ctx is not None and ctx.op else ""
+
+    def _emit(val):
+        count[0] += 1
+        if first_n >= 0 and count[0] > first_n:
+            return
+        arr = _np.asarray(val)
+        flat = arr.reshape(-1)
+        shown = _np.array2string(flat[:summarize] if summarize >= 0 else flat)
+        parts = [message]
+        if print_tensor_name and name:
+            parts.append(name)
+        if print_tensor_shape:
+            parts.append(str(arr.shape))
+        parts.append(shown)
+        print(" ".join(p for p in parts if p))
+
+    jax.debug.callback(_emit, x)
     return x
 
 

@@ -191,6 +191,31 @@ def pool2d(ctx, x, pooling_type="max", ksize=(1, 1), strides=(1, 1),
 # -- normalization -----------------------------------------------------------
 
 
+def _bn_impl(x, scale, bias, mean, variance, axes, cshape, momentum,
+             epsilon, use_stored_stats, axis_name=None):
+    """Shared batch_norm / sync_batch_norm body: f32 statistics (optionally
+    pmean'd over the data-parallel axis — the reference's in-kernel
+    ncclAllReduce, sync_batch_norm_op.cu), bf16-carry output."""
+    xf = x.astype(jnp.float32)
+    if use_stored_stats:
+        m, v = mean, variance
+        new_mean, new_var = mean, variance
+    else:
+        m = jnp.mean(xf, axis=axes)
+        msq = jnp.mean(jnp.square(xf), axis=axes)
+        if axis_name is not None:
+            # cross-replica moments: mean of means is exact for equal shards
+            m = lax.pmean(m, axis_name)
+            msq = lax.pmean(msq, axis_name)
+        v = msq - jnp.square(m)
+        new_mean = momentum * mean + (1 - momentum) * m
+        new_var = momentum * variance + (1 - momentum) * v
+    inv = 1.0 / jnp.sqrt(v + epsilon)
+    y = (xf - m.reshape(cshape)) * inv.reshape(cshape)
+    y = y * scale.reshape(cshape) + bias.reshape(cshape)
+    return (y.astype(x.dtype), new_mean, new_var, m, inv, None)
+
+
 def _bn_grad_maker(op, no_grad_set):
     """batch_norm grad: differentiate through Y only (running stats are
     stop-gradient); uses SavedMean/SavedVariance like batch_norm_grad op."""
@@ -233,25 +258,8 @@ def batch_norm(ctx, x, scale, bias, mean, variance, momentum=0.9,
     c_ax = 1 if nchw else x.ndim - 1
     cshape[c_ax] = x.shape[c_ax]
 
-    xf = x.astype(jnp.float32)  # statistics always accumulate in f32
-    if is_test or use_global_stats:
-        m, v = mean, variance
-        new_mean, new_var = mean, variance
-        saved_mean = mean
-        saved_var = 1.0 / jnp.sqrt(variance + epsilon)
-    else:
-        m = jnp.mean(xf, axis=axes)
-        v = jnp.var(xf, axis=axes)
-        new_mean = momentum * mean + (1 - momentum) * m
-        new_var = momentum * variance + (1 - momentum) * v
-        saved_mean = m
-        saved_var = 1.0 / jnp.sqrt(v + epsilon)
-    inv = 1.0 / jnp.sqrt(v + epsilon)
-    y = (xf - m.reshape(cshape)) * inv.reshape(cshape)
-    y = y * scale.reshape(cshape) + bias.reshape(cshape)
-    # output keeps the input dtype: bf16 activations under the AMP policy
-    return (y.astype(x.dtype), new_mean, new_var, saved_mean, saved_var,
-            None)
+    return _bn_impl(x, scale, bias, mean, variance, axes, cshape, momentum,
+                    epsilon, is_test or use_global_stats, axis_name=None)
 
 
 @register_op(
@@ -611,3 +619,35 @@ def ring_attention_op(ctx, q, k, v, causal=False, scale=0.0, axis="sp"):
     from ..pallas_kernels import flash_attention as _fa
 
     return _fa(q, k, v, causal=causal, sm_scale=sm_scale)
+
+
+@register_op(
+    "sync_batch_norm",
+    inputs=("X", "Scale", "Bias", "Mean", "Variance"),
+    outputs=("Y", "MeanOut", "VarianceOut", "SavedMean", "SavedVariance",
+             "ReserveSpace"),
+    attrs={"momentum": 0.9, "epsilon": 1e-5, "is_test": False,
+           "data_layout": "NCHW", "use_global_stats": False},
+    grad_maker="auto",
+    no_grad_inputs=("Mean", "Variance"),
+)
+def sync_batch_norm(ctx, x, scale, bias, mean, variance, momentum=0.9,
+                    epsilon=1e-5, is_test=False, data_layout="NCHW",
+                    use_global_stats=False, **_):
+    """Cross-replica batch norm (sync_batch_norm_op.cu): statistics are
+    reduced over the data-parallel mesh axis with lax.pmean — the TPU
+    replacement for the reference's in-kernel ncclAllReduce.  Outside a
+    shard_map (single device) it degenerates to plain batch_norm."""
+    nchw = data_layout in ("NCHW", "AnyLayout")
+    c_ax = 1 if nchw else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != c_ax)
+    cshape = [1] * x.ndim
+    cshape[c_ax] = x.shape[c_ax]
+
+    axis_name = ctx.axis_names[0] if (ctx is not None and ctx.axis_names) \
+        else None
+    return _bn_impl(x, scale, bias, mean, variance, axes, cshape, momentum,
+                    epsilon, is_test or use_global_stats,
+                    axis_name=axis_name)
+
+

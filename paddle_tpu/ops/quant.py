@@ -1,0 +1,84 @@
+"""Fake-quantization ops for quantization-aware training.
+
+Parity (paddle/fluid/operators/): fake_quantize_op.cc
+(fake_quantize_abs_max, fake_quantize_moving_average_abs_max,
+fake_channel_wise_quantize_abs_max, fake_quantize_range_abs_max) and
+fake_dequantize_op.cc.  Quantize+dequantize in one op (the QAT contract):
+forward rounds through the int grid, backward is straight-through
+(identity), implemented with a custom grad that passes dY through.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import GradOpDesc, register_op
+from ..framework import _grad_var_name
+
+
+def _ste_grad(op, no_grad_set):
+    """Straight-through estimator: dX = dOut (fake_quantize_op grad)."""
+    out_name = op.output("Out")[0]
+    x_name = op.input("X")[0]
+    return [GradOpDesc(
+        "assign", inputs={"X": [_grad_var_name(out_name)]},
+        outputs={"Out": [_grad_var_name(x_name)]})]
+
+
+def _quant_dequant(x, scale, bit_length):
+    bnt = (1 << (bit_length - 1)) - 1
+    s = jnp.maximum(scale, 1e-8)
+    return jnp.round(jnp.clip(x / s, -1.0, 1.0) * bnt) * s / bnt
+
+
+@register_op("fake_quantize_abs_max", inputs=("X",),
+             outputs=("Out", "OutScale"), attrs={"bit_length": 8},
+             grad_maker=_ste_grad)
+def fake_quantize_abs_max(ctx, x, bit_length=8):
+    scale = jnp.max(jnp.abs(x))
+    return _quant_dequant(x, scale, bit_length), scale.reshape(1)
+
+
+@register_op("fake_channel_wise_quantize_abs_max", inputs=("X",),
+             outputs=("Out", "OutScale"),
+             attrs={"bit_length": 8, "quant_axis": 0},
+             grad_maker=_ste_grad)
+def fake_channel_wise_quantize_abs_max(ctx, x, bit_length=8, quant_axis=0):
+    axes = tuple(i for i in range(x.ndim) if i != quant_axis)
+    scale = jnp.max(jnp.abs(x), axis=axes)
+    shape = [1] * x.ndim
+    shape[quant_axis] = x.shape[quant_axis]
+    return (_quant_dequant(x, scale.reshape(shape), bit_length), scale)
+
+
+@register_op("fake_quantize_moving_average_abs_max",
+             inputs=("X", "InScale", "InAccum", "InState"),
+             outputs=("Out", "OutScale", "OutAccum", "OutState"),
+             attrs={"bit_length": 8, "moving_rate": 0.9, "is_test": False},
+             optional_inputs=("InAccum", "InState"),
+             no_grad_inputs=("InScale", "InAccum", "InState"),
+             grad_maker=_ste_grad)
+def fake_quantize_moving_average_abs_max(ctx, x, in_scale, in_accum=None,
+                                         in_state=None, bit_length=8,
+                                         moving_rate=0.9, is_test=False):
+    cur = jnp.max(jnp.abs(x))
+    if is_test:
+        scale = in_scale.reshape(())
+        accum, state = in_accum, in_state
+    else:
+        state0 = in_state.reshape(()) if in_state is not None else 1.0
+        accum0 = in_accum.reshape(()) if in_accum is not None else \
+            in_scale.reshape(())
+        state = moving_rate * state0 + 1.0
+        accum = moving_rate * accum0 + cur
+        scale = accum / state
+        accum = accum.reshape(1)
+        state = jnp.asarray(state).reshape(1)
+    return (_quant_dequant(x, scale, bit_length), jnp.asarray(scale).reshape(1),
+            accum, state)
+
+
+@register_op("fake_dequantize_max_abs", inputs=("X", "Scale"),
+             outputs=("Out",), attrs={"max_range": 127.0},
+             no_grad_inputs=("Scale",))
+def fake_dequantize_max_abs(ctx, x, scale, max_range=127.0):
+    return x.astype(jnp.float32) * scale.reshape(()) / max_range

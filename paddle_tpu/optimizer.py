@@ -59,8 +59,25 @@ class Optimizer:
 
     # -- learning rate -------------------------------------------------------
     def _create_global_learning_rate(self):
+        from .framework import in_dygraph_mode
+
         program = default_main_program()
         lr = self._learning_rate_map.get(program)
+        if in_dygraph_mode() and not isinstance(self._learning_rate,
+                                                (int, float, Variable)):
+            # dygraph scheduler (dygraph/learning_rate_scheduler.py): advance
+            # one step per update and refresh the eager lr value
+            import jax.numpy as jnp
+
+            val = float(self._learning_rate.step())
+            if lr is None:
+                lr = program.global_block().create_var(
+                    name=unique_name.generate("learning_rate"), shape=(1,),
+                    dtype="float32", persistable=True)
+                lr.stop_gradient = True
+                self._learning_rate_map[program] = lr
+            lr._ivar = jnp.asarray([val], jnp.float32)
+            return
         if lr is not None:
             return
         if isinstance(self._learning_rate, Variable):
@@ -96,6 +113,8 @@ class Optimizer:
         lr = self._global_learning_rate()
         if lr is None:
             return self._learning_rate
+        if lr._ivar is not None:  # dygraph: eager value (scheduler or const)
+            return float(np.asarray(lr._ivar).ravel()[0])
         t = global_scope().find_var(lr.name)
         return float(np.asarray(t.get_tensor().numpy())[0]) if t else None
 

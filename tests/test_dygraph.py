@@ -220,3 +220,19 @@ def test_data_parallel_single_rank_noop():
         loss.backward()
         dp.apply_collective_grads()  # no-op at nranks=1
         assert model.weight.gradient() is not None
+
+
+def test_dygraph_lr_scheduler():
+    with dygraph.guard():
+        model = dnn.Linear(4, 2)
+        sched = dygraph.PiecewiseDecay([2, 4], [0.1, 0.01, 0.001], begin=0)
+        opt = fluid.optimizer.SGDOptimizer(learning_rate=sched)
+        lrs = []
+        for i in range(5):
+            x = dygraph.to_variable(np.ones((2, 4), "f"))
+            loss = fluid.layers.reduce_mean(model(x))
+            loss.backward()
+            opt.minimize(loss, parameter_list=model.parameters())
+            model.clear_gradients()
+            lrs.append(float(opt._global_learning_rate().numpy()[0]))
+    np.testing.assert_allclose(lrs, [0.1, 0.1, 0.01, 0.01, 0.001], rtol=1e-6)

@@ -501,3 +501,103 @@ def test_py_func_callback():
         o, = exe.run(main, feed={"x": xv}, fetch_list=[s])
     np.testing.assert_allclose(float(np.asarray(o).ravel()[0]),
                                (xv * 2 + 1).sum(), rtol=1e-6)
+
+
+# -- sync BN / QAT / Print ----------------------------------------------------
+
+
+def test_sync_batch_norm_matches_bn_on_mesh():
+    # 4-way data-parallel sync BN must equal single-device BN on the full
+    # batch (the exact property the reference's NCCL kernel provides)
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+    from paddle_tpu.core.lowering import shard_map_compat
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(8, 6, 4, 4).astype("f"))
+    scale = jnp.ones((6,), "float32")
+    bias = jnp.zeros((6,), "float32")
+    mean = jnp.zeros((6,), "float32")
+    var = jnp.ones((6,), "float32")
+
+    # single-device reference
+    y_ref, m_ref, v_ref, _, _, _ = run_op(
+        "batch_norm", x, scale, bias, mean, var, is_test=False)
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("data",))
+    opdef = get_op_def("sync_batch_norm")
+    from paddle_tpu.core.lowering import LowerCtx
+
+    def shard_fn(xs):
+        ctx = LowerCtx(mode="eager", axis_names=("data",))
+        y, m, v, _, _, _ = opdef.lower(ctx, xs, scale, bias, mean, var,
+                                       momentum=0.9, epsilon=1e-5,
+                                       is_test=False, data_layout="NCHW",
+                                       use_global_stats=False)
+        return y, m, v
+
+    fn = shard_map_compat(shard_fn, mesh, (P("data"),),
+                          (P("data"), P(), P()))
+    y, m, v = jax.jit(fn)(x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), rtol=2e-4,
+                               atol=2e-5)
+    np.testing.assert_allclose(np.asarray(m), np.asarray(m_ref), rtol=1e-5)
+
+
+def test_fake_quantize_ops():
+    x = jnp.asarray(np.array([[0.5, -1.0], [0.25, 0.74]], "f"))
+    out, scale = run_op("fake_quantize_abs_max", x, bit_length=8)
+    assert float(scale[0]) == 1.0
+    np.testing.assert_allclose(np.asarray(out),
+                               np.round(np.asarray(x) * 127) / 127,
+                               rtol=1e-6)
+    w = jnp.asarray(np.random.RandomState(0).randn(4, 3).astype("f"))
+    qw, sc = run_op("fake_channel_wise_quantize_abs_max", w, quant_axis=0)
+    assert sc.shape == (4,)
+    np.testing.assert_allclose(np.asarray(sc),
+                               np.abs(np.asarray(w)).max(1), rtol=1e-6)
+
+
+def test_qat_pass_rewrites_and_trains():
+    from paddle_tpu.contrib.slim.quantization import (
+        QuantizationTransformPass)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8])
+        y = fluid.layers.data("y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, 16, act="relu")
+        logits = fluid.layers.fc(h, 4)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+    n = QuantizationTransformPass().apply(main, startup)
+    assert n == 2  # both fc muls rewritten
+    with fluid.program_guard(main, startup):
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    types = [op.type for op in main.global_block().ops]
+    assert "fake_channel_wise_quantize_abs_max" in types
+    assert "fake_quantize_moving_average_abs_max" in types
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(16, 8).astype("f"),
+            "y": rng.randint(0, 4, (16, 1)).astype("int64")}
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        l0, = exe.run(main, feed=feed, fetch_list=[loss])
+        for _ in range(20):
+            l1, = exe.run(main, feed=feed, fetch_list=[loss])
+    assert float(np.asarray(l1).ravel()[0]) < float(np.asarray(l0).ravel()[0])
+
+
+def test_print_layer_passthrough(capsys):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[2])
+        p = fluid.layers.Print(x, message="dbg: ")
+        out = fluid.layers.reduce_sum(p)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        o, = exe.run(main, feed={"x": np.ones((1, 2), "f")},
+                     fetch_list=[out])
+    assert float(np.asarray(o).ravel()[0]) == 2.0
