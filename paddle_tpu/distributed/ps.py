@@ -16,8 +16,13 @@ server finishes round r.  A fast trainer therefore cannot lap the sync
 protocol (it blocks in its own round-r GET until every trainer's round-r
 grads arrived) — this replaces the reference's fetch_barrier op.
 
-Sync mode only (async Communicator is the reference's communicator.h:285
-path; tracked as follow-up).
+Two consistency modes, selected by the transpiler's sync_mode:
+- sync: barrier-gated rounds, mean-aggregated grads (RunSyncLoop).
+- async: per-arrival updates with no barriers — each grad immediately runs
+  its param's optimizer sub-program and republishes (the reference's
+  AsyncCommunicator / RunAsyncLoop, communicator.h:285).  LR-schedule ops
+  advance once per logical step (every owned*trainers arrivals), not per
+  arrival.
 """
 
 import collections
@@ -78,7 +83,7 @@ def run_pserver(exe, program, scope):
                 grads[name].append(arr)
         return True
 
-    try:
+    def run_sync():
         publish(0)  # pserver startup already ran: serve initial params
         version = 0
         while True:
@@ -97,6 +102,49 @@ def run_pserver(exe, program, scope):
                 exe.run(opt_prog, feed=feed, fetch_list=[])
             version += 1
             publish(version)
+
+    def run_async():
+        """Async mode (reference AsyncCommunicator / RunAsyncLoop,
+        communicator.h:285): every grad arrival applies its param's
+        optimizer sub-program immediately and republishes — no barriers,
+        no versions; trainers always read the freshest params."""
+        per_param = meta["optimize_programs"]
+        lr_prog = meta.get("lr_program")
+        arrivals = [0]
+        per_step = max(len(params) * trainers, 1)
+
+        def publish_async(p):
+            server.set_var(
+                _vkey(p, -1),
+                np.asarray(scope.find_var(p).get_tensor().numpy()))
+
+        for p in params:
+            publish_async(p)
+        while True:
+            t, name, arr = server.poll()
+            if t == 0:
+                return
+            if t == EV_COMPLETE:
+                completed[0] += 1
+                if completed[0] >= trainers:
+                    return
+            elif t == EV_SEND and name in grad_to_param:
+                pname = grad_to_param[name]
+                with scope_guard(scope):
+                    exe.run(per_param[pname], feed={name: arr},
+                            fetch_list=[])
+                    arrivals[0] += 1
+                    if (lr_prog is not None
+                            and lr_prog.global_block().ops
+                            and arrivals[0] % per_step == 0):
+                        exe.run(lr_prog, fetch_list=[])
+                publish_async(pname)
+
+    try:
+        if meta.get("sync", True):
+            run_sync()
+        else:
+            run_async()
     finally:
         server.shutdown()
 
@@ -110,6 +158,7 @@ class TrainerPSComm:
         self.param_to_ep = meta["param_to_ep"]
         self.param_to_grad = meta["param_grad"]
         self.trainer_id = int(meta["trainer_id"])
+        self.sync = bool(meta.get("sync", True))
         self._clients = {ep: RpcClient(ep) for ep in self.endpoints}
         self._round = 0
         self._closed = False
@@ -120,7 +169,7 @@ class TrainerPSComm:
 
     # initial param pull (reference: recv ops in the rewritten startup)
     def pull_initial_params(self, scope):
-        self._pull(scope, 0)
+        self._pull(scope, 0 if self.sync else -1)
 
     def step(self, scope, grad_values):
         """grad_values: grad name -> ndarray for THIS trainer's step."""
@@ -131,6 +180,10 @@ class TrainerPSComm:
         for p, g in self.param_to_grad.items():
             if g in grad_values:
                 self._clients[self.param_to_ep[p]].send_var(g, grad_values[g])
+        if not self.sync:
+            # async (communicator.h:285): no barrier, read freshest params
+            self._pull(scope, -1)
+            return
         for c in self._clients.values():
             c.barrier("send")
         self._round += 1

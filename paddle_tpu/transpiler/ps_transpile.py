@@ -133,12 +133,38 @@ def transpile_pserver_mode(t):
             type="listen_and_serv",
             inputs={}, outputs={},
             attrs={"endpoint": ep, "Fanin": t.trainers})
+        # async mode only: per-param update programs (run on each grad
+        # arrival) + a shared program holding LRSched and param-less
+        # Optimize ops (global counters), run once per logical step — NOT
+        # per arrival, or decay would advance owned*trainers times too fast
+        per_param = {}
+        lr_prog = None
+        if not t.sync_mode:
+            for p in owned:
+                pp = program.clone()
+                ppb = pp.global_block()
+                ppb.ops = [op for op in ppb.ops
+                           if (_role(op) & OpRole.Optimize)
+                           and op.input("Param")
+                           and op.input("Param")[0] == p]
+                pp._bump_version()
+                per_param[p] = pp
+            lr_prog = program.clone()
+            lb = lr_prog.global_block()
+            lb.ops = [op for op in lb.ops
+                      if _role(op) == OpRole.LRSched
+                      or ((_role(op) & OpRole.Optimize)
+                          and not op.input("Param"))]
+            lr_prog._bump_version()
         serv_prog._ps_server = {
             "endpoint": ep,
             "params": owned,
             "grad_map": {param_grad[p]: p for p in owned},
             "trainers": t.trainers,
             "optimize_program": opt_prog,
+            "optimize_programs": per_param,
+            "lr_program": lr_prog,
+            "sync": t.sync_mode,
         }
         pserver_programs[ep] = serv_prog
         pserver_startups[ep] = sp

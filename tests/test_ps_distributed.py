@@ -29,7 +29,7 @@ def _free_ports(n):
     return ports
 
 
-def _build():
+def _build(lr=0.1):
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
         x = fluid.layers.data("x", shape=[4])
@@ -41,7 +41,7 @@ def _build():
         diff = fluid.layers.elementwise_sub(pred, y)
         loss = fluid.layers.reduce_mean(
             fluid.layers.elementwise_mul(diff, diff))
-        fluid.optimizer.SGD(0.1).minimize(loss)
+        fluid.optimizer.SGD(lr).minimize(loss)
     return main, startup, loss
 
 
@@ -168,3 +168,87 @@ def test_ps_training_matches_local():
     for a, b, c in zip(local_sorted, t0_sorted, t1_sorted):
         np.testing.assert_allclose(b, a, rtol=1e-4, atol=1e-5)
         np.testing.assert_allclose(c, b, rtol=1e-6)
+
+
+def test_async_ps_converges():
+    """Async mode (no barriers, per-arrival updates): must converge on the
+    linear task and shut down cleanly (reference AsyncCommunicator path)."""
+    steps, bs = 40, 8
+    eps = ["127.0.0.1:%d" % p for p in _free_ports(2)]
+    xs, ys = _make_data(steps, 2 * bs, seed=11)
+    main, startup, loss = _build(lr=0.02)
+    errs = []
+
+    def run_pserver(ep):
+        try:
+            t = fluid.DistributeTranspiler()
+            t.transpile(trainer_id=0, program=main, startup_program=startup,
+                        pservers=",".join(eps), trainers=2, sync_mode=False)
+            prog, sprog = t.get_pserver_programs(ep)
+            exe = fluid.Executor(fluid.CPUPlace())
+            scope = fluid.Scope()
+            with fluid.scope_guard(scope):
+                exe.run(sprog)
+                exe.run(prog, scope=scope)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    for ep in eps:
+        threading.Thread(target=run_pserver, args=(ep,), daemon=True).start()
+
+    final = [None, None]
+
+    def run_trainer(tid):
+        try:
+            t = fluid.DistributeTranspiler()
+            t.transpile(trainer_id=tid, program=main,
+                        startup_program=startup, pservers=",".join(eps),
+                        trainers=2, sync_mode=False)
+            tp = t.get_trainer_program()
+            assert tp._ps_trainer["sync"] is False
+            exe = fluid.Executor(fluid.CPUPlace())
+            scope = fluid.Scope()
+            with fluid.scope_guard(scope):
+                exe.run(startup)
+                # eval program: same forward, NO _ps_trainer metadata, so
+                # an eval run neither sends grads nor trains on the batch
+                eval_prog = tp.clone()
+                if hasattr(eval_prog, "_ps_trainer"):
+                    del eval_prog._ps_trainer
+
+                def eval_loss():
+                    lv = eval_prog.global_block().var(loss.name)
+                    ev, = exe.run(eval_prog, feed={"x": xs[0][half],
+                                                   "y": ys[0][half]},
+                                  fetch_list=[lv], scope=scope)
+                    return float(np.asarray(ev).ravel()[0])
+
+                half = slice(tid * bs, (tid + 1) * bs)
+                first = eval_loss()
+                import time as _time
+
+                for i in range(steps):
+                    exe.run(tp, feed={"x": xs[i][half], "y": ys[i][half]},
+                            fetch_list=[], scope=scope)
+                    # async has no staleness bound: pace the trainer so the
+                    # server's (jit-compiling) update loop can keep up —
+                    # otherwise all 40 steps can finish against the initial
+                    # params, which is legal async behavior but untestable
+                    _time.sleep(0.02)
+                final[tid] = (first, eval_loss())
+                scope._ps_comm.complete()
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    ts = [threading.Thread(target=run_trainer, args=(i,), daemon=True)
+          for i in range(2)]
+    for th in ts:
+        th.start()
+    for th in ts:
+        th.join(timeout=120)
+    assert not errs, errs
+    # eval loss on the fixed batch must drop well below its initial value
+    for pair in final:
+        assert pair is not None, final
+        first, last = pair
+        assert last < 0.6 * first, final
