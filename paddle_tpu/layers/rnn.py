@@ -8,7 +8,8 @@ from ..layer_helper import LayerHelper
 
 __all__ = ["beam_search", "beam_search_decode", "gru_unit", "lstm_unit",
            "dynamic_lstmp", "lstm",
-           "dynamic_gru", "dynamic_lstm"]
+           "dynamic_gru", "dynamic_lstm",
+           "RNNCell", "GRUCell", "LSTMCell", "rnn", "dynamic_decode"]
 
 
 def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
@@ -270,3 +271,218 @@ def _reverse_time(x):
                      outputs={"Out": [out]}, attrs={"axis": [1]})
     out.shape = x.shape
     return out
+
+
+# ---------------------------------------------------------------------------
+# Cell-class API (reference layers/rnn.py: RNNCell/GRUCell/LSTMCell, rnn(),
+# dynamic_decode) — class-based recurrence over the StaticRNN/lax.scan
+# machinery.
+# ---------------------------------------------------------------------------
+
+
+def _derived_attr(attr, suffix):
+    """Distinct parameter per use-site: a shared named ParamAttr would alias
+    differently-shaped weights (same hazard as dynamic_lstmp's projection)."""
+    if attr is None or getattr(attr, "name", None) is None:
+        return attr
+    from ..param_attr import ParamAttr as _PA
+
+    return _PA(name=attr.name + suffix)
+
+
+class RNNCell:
+    """Base cell: call(inputs, states) -> (outputs, new_states)."""
+
+    def call(self, inputs, states):
+        raise NotImplementedError
+
+    def __call__(self, inputs, states):
+        return self.call(inputs, states)
+
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32",
+                           init_value=0.0, batch_dim_idx=0):
+        from . import tensor as T
+
+        shape = list(shape or [self.hidden_size])
+        return T.fill_constant_batch_size_like(
+            batch_ref, [-1] + shape, dtype, init_value,
+            input_dim_idx=batch_dim_idx)
+
+    @property
+    def state_shape(self):
+        return [self.hidden_size]
+
+
+class GRUCell(RNNCell):
+    """GRU cell (reference layers/rnn.py GRUCell over gru_unit)."""
+
+    def __init__(self, hidden_size, param_attr=None, bias_attr=None,
+                 gate_activation="sigmoid", activation="tanh",
+                 dtype="float32", name="GRUCell"):
+        self.hidden_size = hidden_size
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._gate_activation = gate_activation or "sigmoid"
+        self._activation = activation or "tanh"
+        self._name = name
+
+    def call(self, inputs, states):
+        from . import nn
+
+        proj = nn.fc(inputs, 3 * self.hidden_size,
+                     param_attr=_derived_attr(self._param_attr, "_in"),
+                     bias_attr=self._bias_attr, name=self._name + "_in")
+        h, _, _ = gru_unit(proj, states, 3 * self.hidden_size,
+                           param_attr=_derived_attr(self._param_attr, "_rec"),
+                           bias_attr=self._bias_attr,
+                           activation=self._activation,
+                           gate_activation=self._gate_activation,
+                           name=self._name)
+        return h, h
+
+
+class LSTMCell(RNNCell):
+    """LSTM cell (reference layers/rnn.py LSTMCell over lstm_unit);
+    states = [hidden, cell]."""
+
+    def __init__(self, hidden_size, param_attr=None, bias_attr=None,
+                 gate_activation=None, activation=None, forget_bias=1.0,
+                 dtype="float32", name="LSTMCell"):
+        if gate_activation not in (None, "sigmoid") or activation not in (
+                None, "tanh"):
+            raise NotImplementedError(
+                "LSTMCell supports only sigmoid gates / tanh activation "
+                "(lstm_unit's fixed nonlinearity)")
+        self.hidden_size = hidden_size
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._forget_bias = forget_bias
+        self._name = name
+
+    def call(self, inputs, states):
+        h_prev, c_prev = states
+        h, c = lstm_unit(inputs, h_prev, c_prev,
+                         forget_bias=self._forget_bias,
+                         param_attr=self._param_attr,
+                         bias_attr=self._bias_attr, name=self._name)
+        return h, [h, c]
+
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32",
+                           init_value=0.0, batch_dim_idx=0):
+        mk = super().get_initial_states
+        return [mk(batch_ref, shape, dtype, init_value, batch_dim_idx),
+                mk(batch_ref, shape, dtype, init_value, batch_dim_idx)]
+
+    @property
+    def state_shape(self):
+        return [[self.hidden_size], [self.hidden_size]]
+
+
+def rnn(cell, inputs, initial_states=None, sequence_length=None,
+        time_major=False, is_reverse=False, **kwargs):
+    """Run `cell` over the time axis (reference layers/rnn.py rnn):
+    inputs [B, T, F] (or [T, B, F] when time_major).  Returns
+    (outputs, final_states) with final_states mirroring the cell's state
+    structure ([B, H], or [h, c] for LSTM)."""
+    from .control_flow import StaticRNN
+    from . import nn
+
+    if sequence_length is not None:
+        raise NotImplementedError(
+            "rnn(): sequence_length masking is not implemented — pad-safe "
+            "models should mask outputs downstream (sequence ops) instead")
+    # state batch dim comes from the BATCH axis of inputs: 0 normally,
+    # 1 when time_major
+    batch_dim = 1 if time_major else 0
+    if is_reverse:
+        # reverse along the time axis before going time-major (single
+        # transpose; the outputs are un-reversed below)
+        if time_major:
+            x_bt = nn.transpose(inputs, [1, 0, 2])
+            x = nn.transpose(_reverse_time(x_bt), [1, 0, 2])
+        else:
+            x = nn.transpose(_reverse_time(inputs), [1, 0, 2])
+    else:
+        x = inputs if time_major else nn.transpose(inputs, [1, 0, 2])
+    multi_state = isinstance(cell.state_shape[0], (list, tuple))
+
+    srnn = StaticRNN()
+    with srnn.step():
+        x_t = srnn.step_input(x)
+        if multi_state:
+            shapes = cell.state_shape
+            inits = initial_states or [None] * len(shapes)
+            states = [srnn.memory(init=inits[i], shape=(-1, shapes[i][0]),
+                                  batch_ref=inputs, init_value=0.0,
+                                  ref_batch_dim_idx=batch_dim)
+                      for i in range(len(shapes))]
+            out, new_states = cell.call(x_t, states)
+            for s, ns in zip(states, new_states):
+                srnn.update_memory(s, ns)
+            srnn.step_output(out)
+            for ns in new_states:
+                srnn.step_output(ns)
+        else:
+            state = srnn.memory(init=initial_states,
+                                shape=(-1, cell.state_shape[0]),
+                                batch_ref=inputs, init_value=0.0,
+                                ref_batch_dim_idx=batch_dim)
+            out, new_state = cell.call(x_t, state)
+            srnn.update_memory(state, new_state)
+            srnn.step_output(out)
+            srnn.step_output(new_state)
+    results = srnn()
+    if not isinstance(results, (list, tuple)):
+        results = [results]
+    outs = results[0]                       # [T, B, H]
+    state_trajs = results[1:]
+    T_len = outs.shape[0]
+
+    def _final(traj):  # last SCAN step = final recurrent state, [B, H]
+        last = nn.slice(traj, axes=[0], starts=[T_len - 1], ends=[T_len])
+        return nn.squeeze(last, [0])
+
+    final_states = [_final(t) for t in state_trajs]
+    outs_bt = nn.transpose(outs, [1, 0, 2])
+    if is_reverse:
+        outs_bt = _reverse_time(outs_bt)
+    result = outs_bt if not time_major else nn.transpose(outs_bt, [1, 0, 2])
+    if multi_state:
+        return result, final_states
+    return result, final_states[0]
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None, **kwargs):
+    """Greedy unrolled decode (reference layers/rnn.py dynamic_decode with
+    a Decoder implementing initialize/step).  Static unroll to
+    max_step_num (XLA static shapes).  Once a sample's `finished` flag is
+    set its states are frozen (the reference's _maybe_copy); outputs after
+    finish repeat the step output and should be masked by the caller.
+    Returns (outputs [B, T, ...], final_states)."""
+    from . import nn, tensor as T
+
+    if max_step_num is None:
+        raise ValueError("dynamic_decode requires max_step_num on TPU "
+                         "(static shapes)")
+    inputs, states, _ = decoder.initialize(inits)
+    step_outputs = []
+    fin = None
+
+    def _freeze(old, new):
+        if fin is None:
+            return new
+        keep = nn.cast(fin, "float32")
+        return old * keep + new * (1.0 - keep)
+
+    for t in range(int(max_step_num)):
+        out, new_states, inputs, finished = decoder.step(t, inputs, states)
+        if isinstance(new_states, (list, tuple)):
+            states = [_freeze(o, n) for o, n in zip(states, new_states)]
+        else:
+            states = _freeze(states, new_states)
+        if finished is not None:
+            f = nn.cast(finished, "bool")
+            fin = f if fin is None else nn.logical_or(fin, f)
+        step_outputs.append(nn.unsqueeze(out, [1]))
+    outputs = T.concat(step_outputs, axis=1)
+    return outputs, states

@@ -601,3 +601,46 @@ def test_print_layer_passthrough(capsys):
         o, = exe.run(main, feed={"x": np.ones((1, 2), "f")},
                      fetch_list=[out])
     assert float(np.asarray(o).ravel()[0]) == 2.0
+
+
+def test_rnn_cell_classes():
+    rng = np.random.RandomState(0)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[5, 6])
+        gout, glast = fluid.layers.rnn(fluid.layers.GRUCell(8), x)
+        lout, llast = fluid.layers.rnn(fluid.layers.LSTMCell(8), x)
+        loss = fluid.layers.reduce_mean(gout) + fluid.layers.reduce_mean(lout)
+        fluid.optimizer.SGD(0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    feed = {"x": rng.rand(3, 5, 6).astype("f")}
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        g, l, l0 = exe.run(main, feed=feed, fetch_list=[gout, lout, loss])
+        for _ in range(5):
+            _, _, l1 = exe.run(main, feed=feed, fetch_list=[gout, lout, loss])
+    assert np.asarray(g).shape == (3, 5, 8)
+    assert np.asarray(l).shape == (3, 5, 8)
+    assert float(np.asarray(l1).ravel()[0]) < float(np.asarray(l0).ravel()[0])
+
+
+def test_rnn_cell_final_states_structure():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4, 3])
+        out, (h, c) = fluid.layers.rnn(fluid.layers.LSTMCell(6), x)
+        gout, gh = fluid.layers.rnn(fluid.layers.GRUCell(6), x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        o, hv, cv, go, ghv = exe.run(
+            main, feed={"x": np.random.RandomState(0).rand(2, 4, 3).astype("f")},
+            fetch_list=[out, h, c, gout, gh])
+    assert np.asarray(hv).shape == (2, 6)
+    assert np.asarray(cv).shape == (2, 6)
+    # final h equals the last output step
+    np.testing.assert_allclose(np.asarray(hv), np.asarray(o)[:, -1], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(ghv), np.asarray(go)[:, -1],
+                               rtol=1e-6)
+    # LSTM cell state differs from hidden (c != h)
+    assert not np.allclose(np.asarray(cv), np.asarray(hv))
