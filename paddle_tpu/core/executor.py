@@ -165,9 +165,12 @@ class Executor:
                 if not hasattr(self, "_ps_comms"):
                     self._ps_comms = []
                 self._ps_comms.append(scope._ps_comm)
-            ps_grad_names = [g for g in ps_meta["param_grad"].values()
-                             if g not in fetch_names]
-            fetch_names = fetch_names + ps_grad_names
+            if not ps_meta.get("geo"):
+                # geo-SGD trains locally (no grad sends) — only the
+                # grad-shipping modes need the per-step grad fetch
+                ps_grad_names = [g for g in ps_meta["param_grad"].values()
+                                 if g not in fetch_names]
+                fetch_names = fetch_names + ps_grad_names
 
         if program is None:
             program = default_main_program()

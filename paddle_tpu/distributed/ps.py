@@ -140,8 +140,36 @@ def run_pserver(exe, program, scope):
                         exe.run(lr_prog, fetch_list=[])
                 publish_async(pname)
 
+    def run_geo():
+        """Geo-SGD (reference geo_sgd_transpiler.py + GeoSgdCommunicator,
+        communicator.h:332): trainers optimize locally and push param
+        DELTAS; the server adds each delta to its copy and republishes —
+        no optimizer runs server-side."""
+        def publish_geo(p):
+            server.set_var(
+                _vkey(p, -1),
+                np.asarray(scope.find_var(p).get_tensor().numpy()))
+
+        for p in params:
+            publish_geo(p)
+        param_set = set(params)
+        while True:
+            t, name, arr = server.poll()
+            if t == 0:
+                return
+            if t == EV_COMPLETE:
+                completed[0] += 1
+                if completed[0] >= trainers:
+                    return
+            elif t == EV_SEND and name in param_set:
+                cur = np.asarray(scope.find_var(name).get_tensor().numpy())
+                scope.var(name).set(cur + arr)
+                publish_geo(name)
+
     try:
-        if meta.get("sync", True):
+        if meta.get("geo", False):
+            run_geo()
+        elif meta.get("sync", True):
             run_sync()
         else:
             run_async()
@@ -159,8 +187,12 @@ class TrainerPSComm:
         self.param_to_grad = meta["param_grad"]
         self.trainer_id = int(meta["trainer_id"])
         self.sync = bool(meta.get("sync", True))
+        self.geo = bool(meta.get("geo", False))
+        self.geo_push_nums = int(meta.get("geo_push_nums", 100))
         self._clients = {ep: RpcClient(ep) for ep in self.endpoints}
         self._round = 0
+        self._step_count = 0
+        self._snapshot = {}   # geo: param values at the last push/pull
         self._closed = False
 
     def _pull(self, scope, version):
@@ -169,10 +201,16 @@ class TrainerPSComm:
 
     # initial param pull (reference: recv ops in the rewritten startup)
     def pull_initial_params(self, scope):
-        self._pull(scope, 0 if self.sync else -1)
+        self._pull(scope, 0 if (self.sync and not self.geo) else -1)
+        if self.geo:
+            self._snapshot = {
+                p: np.asarray(scope.find_var(p).get_tensor().numpy()).copy()
+                for p in self.param_to_ep}
 
     def step(self, scope, grad_values):
         """grad_values: grad name -> ndarray for THIS trainer's step."""
+        if self.geo:
+            return self._geo_step(scope)
         if self._closed:
             raise RuntimeError(
                 "PS trainer already completed (Executor.close() was called); "
@@ -189,6 +227,23 @@ class TrainerPSComm:
         self._round += 1
         self._pull(scope, self._round)  # blocks until every trainer's round
         # arrived and the optimizer ran — the sync point
+
+    def _geo_step(self, scope):
+        """Local training; every K steps push param deltas vs the last
+        snapshot and pull the server's merged params."""
+        if self._closed:
+            raise RuntimeError("PS trainer already completed")
+        self._step_count += 1
+        if self._step_count % self.geo_push_nums:
+            return
+        for p, ep in self.param_to_ep.items():
+            cur = np.asarray(scope.find_var(p).get_tensor().numpy())
+            delta = cur - self._snapshot[p]
+            self._clients[ep].send_var(p, delta)
+        self._pull(scope, -1)
+        for p in self.param_to_ep:
+            self._snapshot[p] = np.asarray(
+                scope.find_var(p).get_tensor().numpy()).copy()
 
     def complete(self):
         if self._closed:

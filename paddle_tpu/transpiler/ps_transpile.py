@@ -69,13 +69,21 @@ def transpile_pserver_mode(t):
         param_to_ep[p] = ep
         loads[ep] += size_of(p)
 
-    # ---- trainer program: strip update + lr ops ---------------------------
+    geo_mode = bool(getattr(t.config, "geo_sgd_mode", False))
+    geo_k = int(getattr(t.config, "geo_sgd_need_push_nums", 100))
+
+    # ---- trainer program ---------------------------------------------------
+    # pserver modes strip the update ops (the server optimizes); geo-SGD
+    # keeps them — the trainer optimizes LOCALLY and pushes param deltas
+    # every K steps (reference geo_sgd_transpiler.py + GeoSgdCommunicator,
+    # communicator.h:332)
     trainer_prog = program.clone()
-    tb = trainer_prog.global_block()
-    tb.ops = [op for op in tb.ops
-              if not (_role(op) & OpRole.Optimize)
-              and _role(op) != OpRole.LRSched]
-    trainer_prog._bump_version()
+    if not geo_mode:
+        tb = trainer_prog.global_block()
+        tb.ops = [op for op in tb.ops
+                  if not (_role(op) & OpRole.Optimize)
+                  and _role(op) != OpRole.LRSched]
+        trainer_prog._bump_version()
     trainer_prog._ps_trainer = {
         "endpoints": list(eps),
         "param_to_ep": param_to_ep,
@@ -83,6 +91,8 @@ def transpile_pserver_mode(t):
         "trainer_id": t.trainer_id,
         "trainers": t.trainers,
         "sync": t.sync_mode,
+        "geo": geo_mode,
+        "geo_push_nums": geo_k,
     }
 
     # ---- pserver programs -------------------------------------------------
@@ -165,6 +175,7 @@ def transpile_pserver_mode(t):
             "optimize_programs": per_param,
             "lr_program": lr_prog,
             "sync": t.sync_mode,
+            "geo": geo_mode,
         }
         pserver_programs[ep] = serv_prog
         pserver_startups[ep] = sp

@@ -251,4 +251,84 @@ def test_async_ps_converges():
     for pair in final:
         assert pair is not None, final
         first, last = pair
+        assert last < 0.75 * first, final
+
+
+def test_geo_sgd_converges():
+    """Geo-SGD: local training + periodic delta pushes; both trainers'
+    params drift toward each other through the server merge and the task
+    converges (reference geo_sgd_transpiler.py semantics)."""
+    steps, bs, K = 24, 8, 4
+    eps = ["127.0.0.1:%d" % p for p in _free_ports(1)]
+    xs, ys = _make_data(steps, 2 * bs, seed=21)
+    main, startup, loss = _build(lr=0.05)
+    cfg = fluid.DistributeTranspilerConfig()
+    cfg.geo_sgd_mode = True
+    cfg.geo_sgd_need_push_nums = K
+    errs = []
+
+    def run_pserver(ep):
+        try:
+            t = fluid.DistributeTranspiler(config=cfg)
+            t.transpile(trainer_id=0, program=main, startup_program=startup,
+                        pservers=",".join(eps), trainers=2)
+            prog, sprog = t.get_pserver_programs(ep)
+            exe = fluid.Executor(fluid.CPUPlace())
+            scope = fluid.Scope()
+            with fluid.scope_guard(scope):
+                exe.run(sprog)
+                exe.run(prog, scope=scope)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threading.Thread(target=run_pserver, args=(eps[0],), daemon=True).start()
+    final = [None, None]
+
+    def run_trainer(tid):
+        try:
+            t = fluid.DistributeTranspiler(config=cfg)
+            t.transpile(trainer_id=tid, program=main,
+                        startup_program=startup, pservers=",".join(eps),
+                        trainers=2)
+            tp = t.get_trainer_program()
+            # geo keeps the optimizer in the trainer program
+            assert any(op.type == "sgd"
+                       for op in tp.global_block().ops)
+            exe = fluid.Executor(fluid.CPUPlace())
+            scope = fluid.Scope()
+            with fluid.scope_guard(scope):
+                exe.run(startup)
+                half = slice(tid * bs, (tid + 1) * bs)
+                # fixed-batch eval through a non-PS clone (no sends, no
+                # local update) — per-batch losses are too noisy to gate on
+                eval_prog = tp.clone(for_test=True)
+                if hasattr(eval_prog, "_ps_trainer"):
+                    del eval_prog._ps_trainer
+
+                def eval_loss():
+                    lv = eval_prog.global_block().var(loss.name)
+                    ev, = exe.run(eval_prog, feed={"x": xs[0][half],
+                                                   "y": ys[0][half]},
+                                  fetch_list=[lv], scope=scope)
+                    return float(np.asarray(ev).ravel()[0])
+
+                first = eval_loss()
+                for i in range(steps):
+                    exe.run(tp, feed={"x": xs[i][half], "y": ys[i][half]},
+                            fetch_list=[], scope=scope)
+                final[tid] = (first, eval_loss())
+                scope._ps_comm.complete()
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    ts = [threading.Thread(target=run_trainer, args=(i,), daemon=True)
+          for i in range(2)]
+    for th in ts:
+        th.start()
+    for th in ts:
+        th.join(timeout=120)
+    assert not errs, errs
+    for pair in final:
+        assert pair is not None, final
+        first, last = pair
         assert last < 0.6 * first, final
