@@ -33,6 +33,12 @@ from ..native.rpc import RpcClient, RpcServer, EV_BARRIER, EV_COMPLETE, EV_SEND
 
 __all__ = ["run_pserver", "TrainerPSComm"]
 
+# pservers running as THREADS of this process (tests; the reference runs
+# separate processes).  complete() waits for them to leave the native poll
+# so interpreter exit can't abort a daemon thread parked in C++.
+_LIVE_SERVERS = set()
+_LIVE_LOCK = __import__("threading").Lock()
+
 
 def _vkey(name, version):
     return "%s#%d" % (name, version)
@@ -166,6 +172,8 @@ def run_pserver(exe, program, scope):
                 scope.var(name).set(cur + arr)
                 publish_geo(name)
 
+    with _LIVE_LOCK:
+        _LIVE_SERVERS.add(id(server))
     try:
         if meta.get("geo", False):
             run_geo()
@@ -175,6 +183,8 @@ def run_pserver(exe, program, scope):
             run_async()
     finally:
         server.shutdown()
+        with _LIVE_LOCK:
+            _LIVE_SERVERS.discard(id(server))
 
 
 class TrainerPSComm:
@@ -255,3 +265,17 @@ class TrainerPSComm:
                 c.close()
             except Exception:
                 pass
+        # wait (bounded) for IN-PROCESS pserver threads to leave the
+        # native poll: a daemon thread parked in C++ at interpreter exit
+        # trips CPython's pthread_exit unwinding (abort).  Costs nothing
+        # when pservers run as separate processes (registry empty); with
+        # several trainer threads only the last COMPLETE releases the
+        # servers, so earlier completers may wait out the bound.
+        import time
+
+        deadline = time.time() + 2.0
+        while time.time() < deadline:
+            with _LIVE_LOCK:
+                if not _LIVE_SERVERS:
+                    return
+            time.sleep(0.01)
