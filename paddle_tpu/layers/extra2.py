@@ -1,0 +1,417 @@
+"""Second batch of API-surface fills: control-flow multiplexers, readers,
+sequence extras, detection compositions, misc (parity:
+python/paddle/fluid/layers/{control_flow,io,nn,detection,sequence_lod}.py).
+"""
+
+import numpy as np
+
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "case", "switch_case", "ctc_greedy_decoder", "chunk_eval",
+    "detection_output", "image_resize_short", "resize_trilinear",
+    "gaussian_random_batch_size_like", "hash", "im2sequence", "lod_append",
+    "merge_selected_rows", "get_tensor_from_selected_rows", "unique",
+    "tensor_array_to_tensor", "sequence_reshape", "sequence_slice",
+    "sequence_scatter", "py_reader", "create_py_reader_by_data",
+    "double_buffer", "read_file", "Decoder", "multi_box_head", "ssd_loss",
+]
+
+
+# -- control-flow multiplexers ------------------------------------------------
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """Multi-branch select (reference layers/control_flow.py case): chained
+    layers.cond — the first true predicate's branch wins."""
+    from .control_flow import cond
+
+    if not pred_fn_pairs:
+        raise ValueError("case: pred_fn_pairs must be non-empty")
+
+    def build(pairs):
+        pred, fn = pairs[0]
+        if len(pairs) == 1:
+            if default is None:
+                return cond(pred, fn, fn)
+            return cond(pred, fn, default)
+        return cond(pred, fn, lambda: build(pairs[1:]))
+
+    return build(list(pred_fn_pairs))
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Integer-indexed branch select (reference switch_case)."""
+    from . import tensor as T
+
+    from ..layer_helper import LayerHelper as _LH
+
+    def eq(a, b):
+        helper = _LH("switch_case_eq")
+        out = helper.create_variable_for_type_inference("bool")
+        helper.append_op(type="equal", inputs={"X": [a], "Y": [b]},
+                         outputs={"Out": [out]})
+        return out
+
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    elif branch_fns and isinstance(branch_fns[0], (list, tuple)) \
+            and not callable(branch_fns[0]):
+        items = sorted((int(i), fn) for i, fn in branch_fns)
+    else:
+        items = list(enumerate(branch_fns))
+    pairs = []
+    for idx, fn in items:
+        c = T.fill_constant([1], "int64", idx)
+        pairs.append((eq(branch_index, c), fn))
+    return case(pairs, default=default, name=name)
+
+
+# -- CTC / chunk metrics ------------------------------------------------------
+
+
+def ctc_greedy_decoder(input, blank, name=None):
+    """Greedy CTC decode (reference ctc_greedy_decoder): argmax per step,
+    collapse repeats, drop blanks.  Dense [B, T, C] in, [B, T] out padded
+    with -1 (the reference emits ragged LoD)."""
+    helper = LayerHelper("ctc_greedy_decoder", name=name)
+    out = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        type="ctc_align", inputs={"Input": [input]},
+        outputs={"Output": [out]}, attrs={"blank": blank})
+    return out
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    """Chunk counting for NER F1 (reference chunk_eval, IOB scheme):
+    returns (precision, recall, f1, num_infer, num_label, num_correct)."""
+    helper = LayerHelper("chunk_eval")
+    outs = [helper.create_variable_for_type_inference("float32")
+            for _ in range(3)]
+    counts = [helper.create_variable_for_type_inference("int64")
+              for _ in range(3)]
+    helper.append_op(
+        type="chunk_eval",
+        inputs={"Inference": [input], "Label": [label]},
+        outputs={"Precision": [outs[0]], "Recall": [outs[1]],
+                 "F1-Score": [outs[2]], "NumInferChunks": [counts[0]],
+                 "NumLabelChunks": [counts[1]],
+                 "NumCorrectChunks": [counts[2]]},
+        attrs={"chunk_scheme": chunk_scheme,
+               "num_chunk_types": num_chunk_types,
+               "excluded_chunk_types": list(excluded_chunk_types or [])})
+    return tuple(outs) + tuple(counts)
+
+
+# -- detection compositions ---------------------------------------------------
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0):
+    """SSD output: decode loc vs priors then NMS (reference
+    detection_output = box_coder + multiclass_nms)."""
+    from . import extra as D
+    from . import nn
+
+    decoded = D.box_coder(prior_box, prior_box_var, loc,
+                          code_type="decode_center_size")
+    scores_t = nn.transpose(scores, [0, 2, 1])
+    return D.multiclass_nms(decoded, scores_t,
+                            score_threshold=score_threshold,
+                            nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                            nms_threshold=nms_threshold, nms_eta=nms_eta,
+                            background_label=background_label)
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD head over multiple feature maps (reference multi_box_head):
+    per-map prior boxes + loc/conf convs, concatenated."""
+    from . import extra as D
+    from . import nn, tensor as T
+
+    n_maps = len(inputs)
+    if min_sizes is None:
+        # reference ratio schedule
+        min_sizes, max_sizes = [], []
+        step = int(np.floor((max_ratio - min_ratio) / (n_maps - 2)))
+        for r in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * r / 100.0)
+            max_sizes.append(base_size * (r + step) / 100.0)
+        min_sizes = [base_size * 0.1] + min_sizes
+        max_sizes = [base_size * 0.2] + max_sizes
+
+    locs, confs, boxes_all, vars_all = [], [], [], []
+    for i, feat in enumerate(inputs):
+        ms = min_sizes[i]
+        mx = max_sizes[i] if max_sizes else None
+        ar = aspect_ratios[i] if isinstance(aspect_ratios[i],
+                                            (list, tuple)) else [aspect_ratios[i]]
+        box, var = D.prior_box(
+            feat, image, min_sizes=[ms], max_sizes=[mx] if mx else None,
+            aspect_ratios=ar, variance=variance, flip=flip, clip=clip,
+            steps=(steps[i], steps[i]) if steps else (0.0, 0.0),
+            offset=offset,
+            min_max_aspect_ratios_order=min_max_aspect_ratios_order)
+        box2 = nn.reshape(box, [-1, 4])
+        var2 = nn.reshape(var, [-1, 4])
+        a = box.shape[2]
+        loc = nn.conv2d(feat, a * 4, kernel_size, padding=pad, stride=stride)
+        conf = nn.conv2d(feat, a * num_classes, kernel_size, padding=pad,
+                         stride=stride)
+        loc = nn.reshape(nn.transpose(loc, [0, 2, 3, 1]), [0, -1, 4])
+        conf = nn.reshape(nn.transpose(conf, [0, 2, 3, 1]),
+                          [0, -1, num_classes])
+        locs.append(loc)
+        confs.append(conf)
+        boxes_all.append(box2)
+        vars_all.append(var2)
+    mbox_locs = T.concat(locs, axis=1)
+    mbox_confs = T.concat(confs, axis=1)
+    boxes = T.concat(boxes_all, axis=0)
+    variances = T.concat(vars_all, axis=0)
+    return mbox_locs, mbox_confs, boxes, variances
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type="per_prediction",
+             mismatch_value=0, normalize=True, sample_size=None):
+    """SSD multibox loss (reference ssd_loss, simplified): IoU matching +
+    per-prior encoded smooth-L1 loc loss + softmax conf loss.  Hard
+    negative mining is replaced by full-negative weighting (all background
+    priors contribute to the conf loss) — XLA-friendly static shapes.
+    Single-image convention: gt_box [G, 4], gt_label [G], location
+    [P, 4] or [1, P, 4], confidence [P, C] or [1, P, C]."""
+    from . import extra as D
+    from . import nn
+
+    iou = D.iou_similarity(gt_box, prior_box)            # [G, P]
+    midx, _ = D.bipartite_match(iou, match_type, overlap_threshold)
+    from . import tensor as T
+
+    lbl = nn.reshape(T.cast(gt_label, "float32"), [1, -1, 1])
+    tgt_lbl, _ = D.target_assign(lbl, midx,
+                                 mismatch_value=background_label)
+    tgt_box, box_w = D.target_assign(
+        nn.reshape(gt_box, [1, -1, 4]), midx, mismatch_value=0)
+
+    helper = LayerHelper("ssd_loss")
+    enc = helper.create_variable_for_type_inference("float32")
+    enc_inputs = {"PriorBox": [prior_box],
+                  "TargetBox": [nn.reshape(tgt_box, [-1, 4])]}
+    enc_attrs = {"variance": []}
+    if isinstance(prior_box_var, Variable):
+        enc_inputs["PriorBoxVar"] = [prior_box_var]
+    elif prior_box_var is not None:
+        enc_attrs["variance"] = [float(v) for v in prior_box_var]
+    helper.append_op(type="box_encode_paired", inputs=enc_inputs,
+                     outputs={"OutputBox": [enc]}, attrs=enc_attrs)
+
+    num_classes = int(confidence.shape[-1])
+    loc2 = nn.reshape(location, [-1, 4])
+    l1 = nn.smooth_l1(loc2, enc)
+    matched = nn.reshape(T.cast(box_w, "float32"), [-1, 1])
+    loc_loss = nn.reduce_sum(l1 * matched) * loc_loss_weight
+    conf_loss = nn.softmax_with_cross_entropy(
+        nn.reshape(confidence, [-1, num_classes]),
+        nn.reshape(T.cast(tgt_lbl, "int64"), [-1, 1]))
+    conf_loss = nn.reduce_sum(conf_loss) * conf_loss_weight
+    total = loc_loss + conf_loss
+    if normalize:
+        total = total / (nn.reduce_sum(matched) + 1e-6)
+    return total
+
+
+# -- misc ---------------------------------------------------------------------
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    from . import nn
+
+    h, w = int(input.shape[2]), int(input.shape[3])
+    short = min(h, w)
+    scale = out_short_len / float(short)
+    return nn.image_resize(input, out_shape=[int(round(h * scale)),
+                                             int(round(w * scale))],
+                           resample=resample)
+
+
+def resize_trilinear(input, out_shape=None, scale=None, name=None,
+                     align_corners=True, align_mode=1, data_format="NCDHW"):
+    helper = LayerHelper("resize_trilinear", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="trilinear_interp", inputs={"X": [input]},
+        outputs={"Out": [out]},
+        attrs={"out_shape": [int(v) for v in (out_shape or [])],
+               "scale": float(scale or 0.0),
+               "align_corners": align_corners})
+    return out
+
+
+def gaussian_random_batch_size_like(input, shape, mean=0.0, std=1.0,
+                                    input_dim_idx=0, output_dim_idx=0,
+                                    dtype="float32"):
+    from . import tensor as T
+    from . import nn
+
+    base = T.fill_constant_batch_size_like(input, shape, dtype, 0.0,
+                                           input_dim_idx=input_dim_idx)
+    helper = LayerHelper("gaussian_random_batch_size_like")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="gaussian_random_like", inputs={"X": [base]},
+        outputs={"Out": [out]}, attrs={"mean": float(mean),
+                                       "std": float(std)})
+    return out
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    helper = LayerHelper("hash", name=name)
+    out = helper.create_variable_for_type_inference("int64")
+    helper.append_op(type="hash", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"mod_by": hash_size, "num_hash": num_hash})
+    return out
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None,
+                out_stride=1, name=None):
+    helper = LayerHelper("im2sequence", name=name)
+    fs = [filter_size] * 2 if isinstance(filter_size, int) else list(filter_size)
+    st = [stride] * 2 if isinstance(stride, int) else list(stride)
+    pd = [padding] * 2 if isinstance(padding, int) else list(padding)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="im2sequence", inputs={"X": [input]}, outputs={"Out": [out]},
+        attrs={"kernels": fs, "strides": st, "paddings": pd})
+    return out
+
+
+def lod_append(x, level):
+    """LoD metadata append — dense tensors carry lod only as metadata."""
+    return x
+
+
+def merge_selected_rows(x, name=None):
+    """SelectedRows dissolve into dense on XLA: identity."""
+    return x
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    return x
+
+
+def unique(x, dtype="int32"):
+    from . import extra as E
+
+    out, idx, _ = E.unique_with_counts(x, dtype)
+    return out, idx
+
+
+def tensor_array_to_tensor(input, axis=1, name=None, use_stack=False):
+    """Concat/stack a tensor array (reference tensor_array_to_tensor)."""
+    from . import tensor as T
+
+    if isinstance(input, (list, tuple)):
+        arrs = list(input)
+        if use_stack:
+            out = T.stack(arrs, axis=0)
+            sizes = [1] * len(arrs)
+        else:
+            out = T.concat(arrs, axis=axis)
+            sizes = [int(a.shape[axis]) for a in arrs]
+        idx = T.assign(np.asarray(sizes, "int32")) if hasattr(T, "assign") \
+            else T.fill_constant([len(arrs)], "int32", sizes[0])
+        return out, idx
+    raise NotImplementedError(
+        "tensor_array_to_tensor on a runtime LoDTensorArray requires the "
+        "array ops path; pass a Python list of Variables")
+
+
+# -- sequence extras (dense/padded semantics) --------------------------------
+
+
+def sequence_reshape(input, new_dim):
+    from . import nn
+
+    return nn.reshape(input, [0, -1, new_dim]) if len(input.shape) == 3 \
+        else nn.reshape(input, [-1, new_dim])
+
+
+def sequence_slice(input, offset, length, name=None):
+    helper = LayerHelper("sequence_slice", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="sequence_slice_dense",
+        inputs={"X": [input], "Offset": [offset], "Length": [length]},
+        outputs={"Out": [out]})
+    return out
+
+
+def sequence_scatter(input, index, updates, name=None):
+    from . import nn
+
+    return nn.scatter(input, index, updates)
+
+
+# -- reader aliases -----------------------------------------------------------
+
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    """Static py_reader (reference layers/io.py py_reader): returns a
+    PyReader-like object whose decorate/start/reset drive the program's
+    attached-loader feed path."""
+    from .. import data as _data
+    from ..reader import PyReader
+
+    feed_list = [
+        _data("_py_reader_in_%d" % i, shape=list(s)[1:], dtype=d)
+        for i, (s, d) in enumerate(zip(shapes, dtypes))]
+    r = PyReader(feed_list=feed_list, capacity=capacity,
+                 use_double_buffer=use_double_buffer, iterable=False)
+    r.read_vars = feed_list
+    return r
+
+
+def create_py_reader_by_data(capacity, feed_list, name=None,
+                             use_double_buffer=True):
+    from ..reader import PyReader
+
+    r = PyReader(feed_list=feed_list, capacity=capacity,
+                 use_double_buffer=use_double_buffer, iterable=False)
+    r.read_vars = feed_list
+    return r
+
+
+def double_buffer(reader, place=None, name=None):
+    return reader  # buffering handled inside the native queue pipeline
+
+
+def read_file(reader):
+    """Pull the next batch's variables from a started reader."""
+    if hasattr(reader, "read_vars"):
+        return reader.read_vars
+    raise ValueError("read_file expects a py_reader-created reader")
+
+
+class Decoder:
+    """Decode-step protocol for dynamic_decode (reference layers/rnn.py
+    Decoder): implement initialize(inits) -> (inputs, states, finished) and
+    step(time, inputs, states) -> (outputs, states, next_inputs, finished)."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError

@@ -23,3 +23,4 @@ from . import misc2  # noqa: F401
 from . import crf  # noqa: F401
 from . import sampled  # noqa: F401
 from . import quant  # noqa: F401
+from . import misc3  # noqa: F401

@@ -1,0 +1,236 @@
+"""Third op batch: CTC alignment, chunk evaluation, hashing, image patch
+extraction, dense sequence slice, trilinear resize, per-pair box encode.
+
+Parity (paddle/fluid/operators/): ctc_align_op.cc, chunk_eval_op.cc,
+hash_op.cc, im2sequence_op.cc, sequence_ops/sequence_slice_op.cc,
+interpolate_op.cc (trilinear), detection/box_coder_op.cc (paired form),
+gaussian_random_op.cc (batch-size-like form).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+
+
+@register_op("ctc_align", inputs=("Input",), outputs=("Output",),
+             attrs={"blank": 0, "merge_repeated": True}, grad_maker=None)
+def ctc_align(ctx, x, blank=0, merge_repeated=True):
+    """Greedy CTC decode (ctc_align_op.cc): [B, T, C] logits (or [B, T]
+    argmax ids) -> [B, T] token ids padded with -1."""
+    ids = jnp.argmax(x, axis=-1) if x.ndim == 3 else x.astype(jnp.int32)
+    B, T = ids.shape
+    prev = jnp.pad(ids, ((0, 0), (1, 0)), constant_values=-1)[:, :-1]
+    keep = (ids != blank)
+    if merge_repeated:
+        keep = keep & (ids != prev)
+    # stable left-compaction: position = cumsum(keep) - 1
+    pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+    b_idx = jnp.broadcast_to(jnp.arange(B)[:, None], (B, T))
+    # scatter kept ids left-compacted; unkept writes land in a scratch slot
+    scratch = jnp.full((B, T + 1), -1, jnp.int64)
+    scat_pos = jnp.where(keep, pos, T)
+    scratch = scratch.at[b_idx, scat_pos].set(
+        jnp.where(keep, ids.astype(jnp.int64), -1))
+    return scratch[:, :T]
+
+
+@register_op("chunk_eval", inputs=("Inference", "Label"),
+             outputs=("Precision", "Recall", "F1-Score", "NumInferChunks",
+                      "NumLabelChunks", "NumCorrectChunks"),
+             attrs={"chunk_scheme": "IOB", "num_chunk_types": 1,
+                    "excluded_chunk_types": []},
+             grad_maker=None)
+def chunk_eval(ctx, inference, label, chunk_scheme="IOB", num_chunk_types=1,
+               excluded_chunk_types=()):
+    """Chunk P/R/F1 (chunk_eval_op.cc) for the IOB scheme on dense [B, T]
+    tag ids padded with -1: tag = B-type (2*t), I-type (2*t+1), outside =
+    2*num_chunk_types."""
+    if chunk_scheme != "IOB":
+        raise NotImplementedError("chunk_eval: only the IOB scheme is "
+                                  "implemented on this backend")
+    inf = inference.reshape(inference.shape[0], -1).astype(jnp.int32)
+    lab = label.reshape(label.shape[0], -1).astype(jnp.int32)
+    valid = lab >= 0
+
+    def chunk_starts(tags):
+        # B-tag always starts; I-tag starts a chunk if it follows a
+        # different chunk type or outside (IOB2-ish robust reading)
+        is_b = (tags % 2 == 0) & (tags < 2 * num_chunk_types)
+        is_i = (tags % 2 == 1) & (tags < 2 * num_chunk_types)
+        ctype = tags // 2
+        prev = jnp.pad(tags, ((0, 0), (1, 0)), constant_values=-2)[:, :-1]
+        prev_in = (prev >= 0) & (prev < 2 * num_chunk_types)
+        prev_type = jnp.where(prev_in, prev // 2, -1)
+        start = is_b | (is_i & (prev_type != ctype))
+        inside = is_b | is_i
+        return start, inside, ctype
+
+    si, ii_, ti = chunk_starts(inf)
+    sl, il, tl = chunk_starts(lab)
+    si, sl = si & valid, sl & valid
+    ii_, il = ii_ & valid, il & valid
+    if excluded_chunk_types:
+        excl = jnp.zeros_like(ti, dtype=bool)
+        for et in excluded_chunk_types:
+            excl = excl | (ti == int(et)) | (tl == int(et))
+        si, sl = si & ~(excl & ii_), sl & ~(excl & il)
+        ii_, il = ii_ & ~excl, il & ~excl
+    n_inf = jnp.sum(si)
+    n_lab = jnp.sum(sl)
+    B, T = ii_.shape
+    # positional structural agreement inside the label chunk
+    same = (ti == tl) & (si == sl) & (ii_ == il) & ii_ & il
+    span_bad = (il & ~same)
+    # exact-span requirement: the inference chunk must END where the label
+    # chunk ends — a continuation (inside, not start) right after a label
+    # chunk end invalidates it
+    inf_cont_next = jnp.pad(ii_ & ~si, ((0, 0), (0, 1)))[:, 1:]
+    lab_cont_next = jnp.pad(il & ~sl, ((0, 0), (0, 1)))[:, 1:]
+    label_end = il & ~lab_cont_next
+    span_bad = span_bad | (label_end & inf_cont_next)
+    # propagate badness to the chunk's start via reverse cumulative or:
+    def row_propagate(sl_row, bad_row):
+        def step(carry, t):
+            # iterate right-to-left: carry = badness of current open chunk
+            bad = carry | bad_row[t]
+            out = bad
+            carry2 = jnp.where(sl_row[t], False, bad)
+            return carry2, (out, t)
+
+        _, (outs, _) = lax.scan(step, False, jnp.arange(T - 1, -1, -1))
+        return outs[::-1]
+
+    bad_at = jax.vmap(row_propagate)(sl, span_bad)
+    n_correct = jnp.sum(sl & ~bad_at)
+    prec = n_correct / jnp.maximum(n_inf, 1)
+    rec = n_correct / jnp.maximum(n_lab, 1)
+    f1 = jnp.where(n_correct > 0, 2 * prec * rec / (prec + rec), 0.0)
+    i64 = lambda v: v.astype(jnp.int64)
+    return (prec.astype(jnp.float32), rec.astype(jnp.float32),
+            f1.astype(jnp.float32), i64(n_inf), i64(n_lab), i64(n_correct))
+
+
+@register_op("hash", inputs=("X",), outputs=("Out",),
+             attrs={"mod_by": 1, "num_hash": 1}, grad_maker=None)
+def hash_op(ctx, x, mod_by=1, num_hash=1):
+    """Multi-hash of int id rows into [N, num_hash] buckets (hash_op.cc,
+    xxHash replaced by splitmix64-style mixing)."""
+    ids = x.astype(jnp.uint32).reshape(x.shape[0], -1)
+
+    def mix(v, salt):
+        v = (v ^ (v >> 16)) * jnp.uint32((0x85EBCA6B + salt) & 0xFFFFFFFF)
+        v = (v ^ (v >> 13)) * jnp.uint32(0xC2B2AE35)
+        return v ^ (v >> 16)
+
+    outs = []
+    for h in range(num_hash):
+        mixed = mix(ids, (2654435761 * (h + 1)) & 0xFFFFFFFF)
+        combined = jnp.sum(mixed, axis=1) % jnp.uint32(mod_by)
+        outs.append(combined)
+    return jnp.stack(outs, axis=1).astype(jnp.int64)
+
+
+@register_op("im2sequence", inputs=("X",), outputs=("Out",),
+             attrs={"kernels": [1, 1], "strides": [1, 1],
+                    "paddings": [0, 0]})
+def im2sequence(ctx, x, kernels=(1, 1), strides=(1, 1), paddings=(0, 0)):
+    """Image -> patch sequence (im2sequence_op.cc): [N, C, H, W] ->
+    [N, OH*OW, C*kh*kw] (dense; the reference flattens batch into LoD)."""
+    kh, kw = kernels
+    p = list(paddings)
+    if len(p) == 2:
+        pads = [(p[0], p[0]), (p[1], p[1])]
+    else:  # [up, left, down, right] (im2sequence_op.cc)
+        pads = [(p[0], p[2]), (p[1], p[3])]
+    patches = lax.conv_general_dilated_patches(
+        x, (kh, kw), tuple(strides), pads,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    N, CKK, OH, OW = patches.shape
+    return patches.reshape(N, CKK, OH * OW).transpose(0, 2, 1)
+
+
+@register_op("sequence_slice_dense", inputs=("X", "Offset", "Length"),
+             outputs=("Out",), no_grad_inputs=("Offset", "Length"))
+def sequence_slice_dense(ctx, x, offset, length):
+    """Per-row slice of padded sequences (sequence_slice_op.cc on dense
+    [B, T, ...]): out[b] = x[b, off[b]:off[b]+len[b]] left-aligned, padded
+    with zeros to max(length)."""
+    B, T = x.shape[0], x.shape[1]
+    off = offset.reshape(-1).astype(jnp.int32)
+    ln = length.reshape(-1).astype(jnp.int32)
+    idx = jnp.arange(T)[None, :] + off[:, None]
+    idx = jnp.clip(idx, 0, T - 1)
+    gathered = jnp.take_along_axis(
+        x, idx.reshape(B, T, *([1] * (x.ndim - 2))), axis=1)
+    mask = (jnp.arange(T)[None, :] < ln[:, None])
+    mask = mask.reshape(B, T, *([1] * (x.ndim - 2)))
+    return jnp.where(mask, gathered, 0)
+
+
+@register_op("trilinear_interp", inputs=("X",), outputs=("Out",),
+             attrs={"out_shape": [], "scale": 0.0, "align_corners": True})
+def trilinear_interp(ctx, x, out_shape=(), scale=0.0, align_corners=True):
+    N, C, D, H, W = x.shape
+    if out_shape:
+        od, oh, ow = [int(v) for v in out_shape]
+    else:
+        od, oh, ow = int(D * scale), int(H * scale), int(W * scale)
+    if not align_corners:
+        return jax.image.resize(x, (N, C, od, oh, ow), method="trilinear")
+    # align_corners=True: sample at linspace(0, in-1, out) per axis
+    from jax.scipy.ndimage import map_coordinates
+
+    def axis_coords(n_in, n_out):
+        if n_out == 1:
+            return jnp.zeros((1,), jnp.float32)
+        return jnp.linspace(0.0, n_in - 1.0, n_out)
+
+    dz = axis_coords(D, od)
+    dy = axis_coords(H, oh)
+    dx = axis_coords(W, ow)
+    gz, gy, gx = jnp.meshgrid(dz, dy, dx, indexing="ij")
+
+    def one(img):  # [D, H, W]
+        return map_coordinates(img, [gz, gy, gx], order=1)
+
+    return jax.vmap(jax.vmap(one))(x)
+
+
+@register_op("gaussian_random_like", inputs=("X",), outputs=("Out",),
+             attrs={"mean": 0.0, "std": 1.0}, grad_maker=None, n_rng=1)
+def gaussian_random_like(ctx, x, mean=0.0, std=1.0):
+    return mean + std * jax.random.normal(ctx.rng(), x.shape, jnp.float32)
+
+
+@register_op("box_encode_paired",
+             inputs=("PriorBox", "TargetBox", "PriorBoxVar"),
+             outputs=("OutputBox",), attrs={"variance": []},
+             optional_inputs=("PriorBoxVar",),
+             no_grad_inputs=("PriorBoxVar",), grad_maker=None)
+def box_encode_paired(ctx, prior, target, prior_var=None, variance=()):
+    """Row-paired center-size encode: prior[i] vs target[i] -> [P, 4]
+    (the diagonal of box_coder's [T, P, 4] encode, used by ssd_loss)."""
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    tw = target[:, 2] - target[:, 0]
+    th = target[:, 3] - target[:, 1]
+    tcx = target[:, 0] + tw * 0.5
+    tcy = target[:, 1] + th * 0.5
+    if prior_var is not None:
+        # per-prior variances [P, 4]
+        v = [prior_var[:, i] for i in range(4)]
+    elif variance:
+        vv = jnp.asarray(variance, jnp.float32)
+        v = [vv[i] for i in range(4)]
+    else:
+        v = [1.0] * 4
+    return jnp.stack([
+        (tcx - pcx) / jnp.maximum(pw, 1e-10) / v[0],
+        (tcy - pcy) / jnp.maximum(ph, 1e-10) / v[1],
+        jnp.log(jnp.maximum(tw / jnp.maximum(pw, 1e-10), 1e-10)) / v[2],
+        jnp.log(jnp.maximum(th / jnp.maximum(ph, 1e-10), 1e-10)) / v[3],
+    ], axis=1)

@@ -644,3 +644,142 @@ def test_rnn_cell_final_states_structure():
                                rtol=1e-6)
     # LSTM cell state differs from hidden (c != h)
     assert not np.allclose(np.asarray(cv), np.asarray(hv))
+
+
+# -- batch 3: control-flow mux / ctc decode / chunk eval / detection comp ----
+
+
+def test_ctc_greedy_decoder():
+    # ids over time: blank=0
+    logits = np.zeros((2, 6, 4), "f")
+    seq = [[1, 1, 0, 2, 2, 0], [0, 3, 0, 3, 1, 1]]
+    for b in range(2):
+        for t, c in enumerate(seq[b]):
+            logits[b, t, c] = 5.0
+    out = run_op("ctc_align", jnp.asarray(logits), blank=0)
+    o = np.asarray(out)
+    np.testing.assert_array_equal(o[0][:2], [1, 2])
+    assert (o[0][2:] == -1).all()
+    np.testing.assert_array_equal(o[1][:3], [3, 3, 1])
+
+
+def test_chunk_eval_iob():
+    # IOB with 1 type: B=0, I=1, O=2
+    lab = np.array([[0, 1, 2, 0, 1, -1]], "int64")   # 2 chunks
+    inf = np.array([[0, 1, 2, 0, 2, -1]], "int64")   # 1st exact, 2nd short
+    p, r, f1, ni, nl, nc = run_op("chunk_eval", jnp.asarray(inf),
+                                  jnp.asarray(lab), num_chunk_types=1)
+    assert int(ni) == 2 and int(nl) == 2 and int(nc) == 1
+    np.testing.assert_allclose(float(p), 0.5)
+    np.testing.assert_allclose(float(r), 0.5)
+
+
+def test_hash_deterministic_in_range():
+    x = np.array([[1], [2], [1]], "int64")
+    out = run_op("hash", jnp.asarray(x), mod_by=100, num_hash=2)
+    o = np.asarray(out)
+    assert o.shape == (3, 2)
+    assert (o >= 0).all() and (o < 100).all()
+    np.testing.assert_array_equal(o[0], o[2])  # same input, same hash
+    assert not np.array_equal(o[0], o[1])
+
+
+def test_im2sequence_and_seq_slice():
+    x = np.arange(16, dtype="f").reshape(1, 1, 4, 4)
+    out = run_op("im2sequence", jnp.asarray(x), kernels=[2, 2],
+                 strides=[2, 2], paddings=[0, 0])
+    assert out.shape == (1, 4, 4)
+    np.testing.assert_array_equal(np.asarray(out)[0, 0], [0, 1, 4, 5])
+
+    s = np.arange(12, dtype="f").reshape(2, 6)
+    sl = run_op("sequence_slice_dense", jnp.asarray(s),
+                jnp.asarray(np.array([1, 2], "int64")),
+                jnp.asarray(np.array([3, 2], "int64")))
+    np.testing.assert_array_equal(np.asarray(sl)[0][:3], [1, 2, 3])
+    np.testing.assert_array_equal(np.asarray(sl)[1][:2], [8, 9])
+    assert np.asarray(sl)[1][2] == 0
+
+
+def test_case_switch_case():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[1])
+        import paddle_tpu.layers.tensor as T
+
+        two = T.fill_constant([1], "float32", 2.0)
+
+        def b1():
+            return x * 10.0
+
+        def b2():
+            return x + 100.0
+
+        pred = fluid.layers.reduce_sum(x) > fluid.layers.reduce_sum(two)
+        out = fluid.layers.case([(pred, b1)], default=b2)
+        idx = T.fill_constant([1], "int64", 1)
+        sout = fluid.layers.switch_case(idx, {0: b1, 1: b2})
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        o, so = exe.run(main, feed={"x": np.array([[5.0]], "f")},
+                        fetch_list=[out, sout])
+    assert float(np.asarray(o).ravel()[0]) == 50.0     # pred true -> b1
+    assert float(np.asarray(so).ravel()[0]) == 105.0   # branch 1 -> +100
+
+
+def test_detection_output_and_ssd_loss():
+    rng = np.random.RandomState(0)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        loc = fluid.layers.data("loc", shape=[8, 4])
+        conf = fluid.layers.data("conf", shape=[8, 3])
+        pb = fluid.layers.data("pb", shape=[4])      # [P,4] no batch? use -1
+        pb2 = fluid.layers.data("pb2", shape=[4])
+        gt = fluid.layers.data("gt", shape=[4])
+        gl = fluid.layers.data("gl", shape=[1], dtype="int64")
+        nms = fluid.layers.detection_output(
+            loc, fluid.layers.softmax(conf), pb, [0.1, 0.1, 0.2, 0.2],
+            keep_top_k=4, nms_top_k=8, score_threshold=0.01)
+        loss = fluid.layers.ssd_loss(
+            loc, conf, gt, gl, pb, prior_box_var=[0.1, 0.1, 0.2, 0.2])
+    exe = fluid.Executor(fluid.CPUPlace())
+    P = 8
+    priors = np.stack([np.linspace(0, 0.8, P), np.linspace(0, 0.8, P),
+                       np.linspace(0.2, 1.0, P), np.linspace(0.2, 1.0, P)],
+                      1).astype("f")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        o, l = exe.run(main, feed={
+            "loc": rng.randn(1, P, 4).astype("f") * 0.1,
+            "conf": rng.randn(1, P, 3).astype("f"),
+            "pb": priors, "pb2": priors,
+            "gt": np.array([[0.1, 0.1, 0.4, 0.4]], "f"),
+            "gl": np.array([[1]], "int64"),
+        }, fetch_list=[nms, loss])
+    assert np.asarray(o).shape == (1, 4, 6)
+    assert np.isfinite(float(np.asarray(l).ravel()[0]))
+
+
+def test_chunk_eval_exact_span_and_exclusion():
+    # inference chunk extends past the label chunk end -> NOT correct
+    lab = np.array([[0, 2]], "int64")      # B, O  (1 chunk, len 1)
+    inf = np.array([[0, 1]], "int64")      # B, I  (1 chunk, len 2)
+    p, r, f1, ni, nl, nc = run_op("chunk_eval", jnp.asarray(inf),
+                                  jnp.asarray(lab), num_chunk_types=1)
+    assert int(nc) == 0 and float(p) == 0.0
+
+    # excluded chunk type drops from all counts
+    lab2 = np.array([[0, 1, 2]], "int64")
+    inf2 = np.array([[0, 1, 2]], "int64")
+    _, _, _, ni2, nl2, nc2 = run_op(
+        "chunk_eval", jnp.asarray(inf2), jnp.asarray(lab2),
+        num_chunk_types=1, excluded_chunk_types=[0])
+    assert int(ni2) == 0 and int(nl2) == 0 and int(nc2) == 0
+
+
+def test_trilinear_align_corners():
+    x = np.arange(4, dtype="f").reshape(1, 1, 1, 1, 4)
+    out = run_op("trilinear_interp", jnp.asarray(x), out_shape=[1, 1, 7],
+                 align_corners=True)
+    o = np.asarray(out).ravel()
+    np.testing.assert_allclose(o, np.linspace(0, 3, 7), rtol=1e-5)
