@@ -4,12 +4,15 @@ Parity: python/paddle/fluid/layers/rnn.py + layers/nn.py beam_search
 (wrapping operators/beam_search_op.cc) and the dynamic/static RNN units.
 """
 
+import numpy as np
+
 from ..layer_helper import LayerHelper
 
 __all__ = ["beam_search", "beam_search_decode", "gru_unit", "lstm_unit",
            "dynamic_lstmp", "lstm",
            "dynamic_gru", "dynamic_lstm",
-           "RNNCell", "GRUCell", "LSTMCell", "rnn", "dynamic_decode"]
+           "RNNCell", "GRUCell", "LSTMCell", "rnn", "dynamic_decode",
+           "BeamSearchDecoder"]
 
 
 def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
@@ -471,8 +474,12 @@ def dynamic_decode(decoder, inits=None, max_step_num=None, **kwargs):
     def _freeze(old, new):
         if fin is None:
             return new
-        keep = nn.cast(fin, "float32")
-        return old * keep + new * (1.0 - keep)
+        # fluid broadcast: fin [B,1] onto state [B,H] aligns at axis=0
+        keep = nn.elementwise_mul(old, fin, axis=0)
+        upd = nn.elementwise_mul(new, 1.0 - fin, axis=0)
+        out = nn.elementwise_add(keep, upd)
+        out.shape = new.shape
+        return out
 
     for t in range(int(max_step_num)):
         out, new_states, inputs, finished = decoder.step(t, inputs, states)
@@ -481,8 +488,161 @@ def dynamic_decode(decoder, inits=None, max_step_num=None, **kwargs):
         else:
             states = _freeze(states, new_states)
         if finished is not None:
-            f = nn.cast(finished, "bool")
-            fin = f if fin is None else nn.logical_or(fin, f)
+            f = T.cast(finished, "float32")
+            fin = f if fin is None else nn.elementwise_max(fin, f)
         step_outputs.append(nn.unsqueeze(out, [1]))
     outputs = T.concat(step_outputs, axis=1)
     return outputs, states
+
+
+class BeamSearchDecoder:
+    """Beam-search decoder for dynamic_decode (reference layers/rnn.py
+    BeamSearchDecoder): wraps an RNNCell; each step expands K beams over the
+    vocab, keeps the top K continuations, and tracks parent pointers for
+    gather_tree backtracking.
+
+    Works on flattened [B*K, ...] tensors.  step() emits
+    concat([token_ids, parent_ids], axis=1) as its per-step output
+    ([B, 2K]); finalize() splits them and backtracks with gather_tree.
+    """
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- helpers -------------------------------------------------------------
+    def _merge(self, x):      # [B, K, ...] -> [B*K, ...]
+        from . import nn
+
+        shape = [-1] + [int(d) for d in x.shape[2:]]
+        out = nn.reshape(x, shape)
+        out.shape = tuple(shape)
+        return out
+
+    def _split(self, x):      # [B*K, ...] -> [B, K, ...]
+        from . import nn
+
+        shape = [-1, self.beam_size] + [int(d) for d in x.shape[1:]]
+        out = nn.reshape(x, shape)
+        out.shape = tuple(shape)
+        return out
+
+    def initialize(self, inits):
+        """inits: cell initial states with batch dim B (single tensor or
+        list).  Tiles everything beam_size times.  Decoder state layout:
+        [*cell_states, logp [B*K,1], last_tokens [B*K,1]]."""
+        from . import nn, tensor as T
+
+        K = self.beam_size
+        states = inits if isinstance(inits, (list, tuple)) else [inits]
+
+        def tile(s):  # [B, H] -> [B*K, H]
+            e = nn.unsqueeze(s, [1])
+            e.shape = (s.shape[0], 1) + tuple(s.shape[1:])
+            e = nn.expand(e, [1, K, 1])
+            e.shape = (s.shape[0], K) + tuple(s.shape[1:])
+            return self._merge(e)
+
+        tiled = [tile(s) for s in states]
+        b = states[0]
+        # log-prob state [B*K, 1]: beam 0 starts at 0, others at -inf so
+        # the first expansion draws only from beam 0.  Built as an outer
+        # product ones[B,1] @ bias[1,K] (fluid's y-broadcast rules cannot
+        # express a leading-1 bias add)
+        ones_col = T.fill_constant_batch_size_like(b, [-1, 1], "float32",
+                                                   1.0)
+        beam_bias = T.assign(
+            np.array([[0.0] + [-1e9] * (K - 1)], "float32"))   # [1, K]
+        logp = nn.reshape(nn.matmul(ones_col, beam_bias), [-1, 1])
+        logp.shape = (-1, 1)
+        start = T.fill_constant_batch_size_like(
+            logp, [-1, 1], "int64", self.start_token)
+        start.shape = (-1, 1)
+        inputs = self.embedding_fn(start) if self.embedding_fn else start
+        return inputs, tiled + [logp, start], None
+
+    def step(self, time, inputs, states):
+        from . import nn, tensor as T
+
+        K = self.beam_size
+        cell_states, logp, last_tok = states[:-2], states[-2], states[-1]
+        cs = cell_states if len(cell_states) > 1 else cell_states[0]
+        out, new_states = self.cell.call(inputs, cs)
+        if not isinstance(new_states, (list, tuple)):
+            new_states = [new_states]
+        logits = self.output_fn(out) if self.output_fn else out
+        lp_step = nn.log_softmax(logits)                 # [B*K, V]
+        lp_step.shape = logits.shape
+        V = int(lp_step.shape[-1])
+        # fluid broadcast: y [B*K,1] onto x [B*K,V] aligns at axis=0
+        total = nn.elementwise_add(lp_step, logp, axis=0)
+        total.shape = lp_step.shape
+        total3 = nn.reshape(total, [-1, K, V])
+        total3.shape = (-1, K, V)
+        pre_ids = nn.reshape(last_tok, [-1, K])
+        pre_ids.shape = (-1, K)
+        pre_scores = nn.reshape(logp, [-1, K])
+        pre_scores.shape = (-1, K)
+        # the beam_search op owns selection AND finished-beam semantics:
+        # a beam whose last token is end_id emits only end_id with its
+        # score unchanged (ops/beam_search.py)
+        tokens, sel_scores, parents = beam_search(
+            pre_ids, pre_scores, None, total3, K, self.end_token)
+        tokens.shape = parents.shape = sel_scores.shape = (-1, K)
+
+        def gather_beams(s):  # s: [B*K, H] -> [B*K, H] reordered
+            sk = self._split(s)                          # [B, K, H]
+            return self._merge(_batched_gather(sk, parents))
+
+        new_states = [gather_beams(s) for s in new_states]
+        sv = nn.unsqueeze(sel_scores, [2])
+        sv.shape = (-1, K, 1)
+        new_logp = self._merge(sv)                       # [B*K, 1]
+        tok_flat = nn.reshape(tokens, [-1, 1])           # [B*K, 1]
+        tok_flat.shape = (-1, 1)
+        inputs = self.embedding_fn(tok_flat) if self.embedding_fn else \
+            T.cast(tok_flat, "float32")
+        out_pair = nn.concat([tokens, parents], axis=1)  # [B, 2K]
+        # finished handling lives inside the beam_search op; no positional
+        # freeze (beams are reordered every step, a positional mask would
+        # clobber live beams)
+        return out_pair, new_states + [new_logp, tok_flat], inputs, None
+
+    def finalize(self, outputs):
+        """outputs [B, T, 2K] from dynamic_decode -> (sequences [T, B, K],
+        final beam scores are in the last state)."""
+        from . import nn
+
+        K = self.beam_size
+        ids = nn.transpose(nn.slice(outputs, axes=[2], starts=[0],
+                                    ends=[K]), [1, 0, 2])      # [T, B, K]
+        parents = nn.transpose(nn.slice(outputs, axes=[2], starts=[K],
+                                        ends=[2 * K]), [1, 0, 2])
+        from .extra import gather_tree
+
+        return gather_tree(ids, parents)
+
+
+def _batched_gather(x, idx):
+    """x [B, K, ...], idx [B, K] -> x[b, idx[b, k]] via one-hot matmul
+    (XLA-friendly, avoids gather_nd index building)."""
+    from . import nn, tensor as T
+
+    K = int(x.shape[1])
+    # one_hot follows fluid's trailing-1 replacement rule: feed [B, K, 1]
+    # so the output is [B, K, K] for every K (incl. K=1)
+    idx3 = nn.unsqueeze(idx, [2])
+    idx3.shape = (-1, K, 1)
+    oh = nn.one_hot(idx3, K)                 # [B, K, K]
+    oh.shape = (-1, K, K)
+    flat = nn.reshape(x, [0, K, -1])         # [B, K, H]
+    out = nn.matmul(oh, flat)                # [B, K, H]
+    shape = [0, K] + [int(d) for d in x.shape[2:]]
+    out2 = nn.reshape(out, shape)
+    out2.shape = tuple([-1] + list(shape[1:]))
+    return out2

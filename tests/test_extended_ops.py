@@ -783,3 +783,105 @@ def test_trilinear_align_corners():
                  align_corners=True)
     o = np.asarray(out).ravel()
     np.testing.assert_allclose(o, np.linspace(0, 3, 7), rtol=1e-5)
+
+
+def test_beam_search_decoder_greedy_consistency():
+    """Analytic check: with state-independent constant logits, every step's
+    best continuation is the same argmax token, so the backtracked best
+    beam must be that token repeated; greedy (K=1) must agree."""
+    import paddle_tpu.layers.tensor as T
+    from paddle_tpu.initializer import Constant
+
+    V, H, B, Tmax = 6, 8, 2, 4
+    bias_vals = np.array([0.1, 0.4, 0.2, 3.0, 0.3, 0.25], "f")  # argmax = 3
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        init_h = fluid.layers.data("h0", shape=[H])
+        cell = fluid.layers.GRUCell(H)
+
+        def embed(ids):
+            return fluid.layers.embedding(
+                ids, (V, H), param_attr=fluid.ParamAttr(name="bsd_emb"))
+
+        def out_fn(h):
+            # zero weight + fixed per-class bias -> constant logits
+            z = fluid.layers.fc(
+                h, V, param_attr=fluid.ParamAttr(initializer=Constant(0.0),
+                                                 name="bsd_zero_w"),
+                bias_attr=False)
+            bias_row = T.assign(bias_vals.reshape(1, V))
+            return fluid.layers.elementwise_add(z, bias_row)
+
+        def make(K):
+            bsd = fluid.layers.BeamSearchDecoder(
+                cell, start_token=1, end_token=0, beam_size=K,
+                embedding_fn=embed, output_fn=out_fn)
+            outs, st = fluid.layers.dynamic_decode(bsd, inits=init_h,
+                                                   max_step_num=Tmax)
+            return bsd.finalize(outs), st[-2]  # [..., logp, last_tok]
+
+        seqs1, _ = make(1)
+        seqs3, score3 = make(3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        s1, s3, sc3 = exe.run(main, feed={"h0": rng.randn(B, H).astype("f")},
+                              fetch_list=[seqs1, seqs3, score3])
+    s1, s3 = np.asarray(s1), np.asarray(s3)
+    sc3 = np.asarray(sc3).reshape(B, 3)
+    assert s1.shape == (Tmax, B, 1) and s3.shape == (Tmax, B, 3)
+    # greedy and beam-best must both be the argmax token (3) every step
+    np.testing.assert_array_equal(s1[:, :, 0], np.full((Tmax, B), 3))
+    np.testing.assert_array_equal(s3[:, :, 0], np.full((Tmax, B), 3))
+    # best-beam score == Tmax * log_softmax(bias)[3]
+    expect = Tmax * (bias_vals[3] - np.log(np.exp(bias_vals).sum()))
+    np.testing.assert_allclose(sc3[:, 0], expect, rtol=1e-4)
+
+
+def test_beam_search_decoder_finished_beam_semantics():
+    """A beam that emits end_token must keep its score FROZEN and keep
+    emitting end_token (the beam_search op's finished handling)."""
+    import paddle_tpu.layers.tensor as T
+    from paddle_tpu.initializer import Constant
+
+    V, H, B, Tmax = 5, 4, 1, 4
+    # argmax token IS the end token -> best beam finishes at step 1
+    bias_vals = np.array([0.1, 5.0, 0.2, 0.3, 0.15], "f")  # argmax = 1
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        init_h = fluid.layers.data("h0", shape=[H])
+        cell = fluid.layers.GRUCell(H)
+
+        def embed(ids):
+            return fluid.layers.embedding(
+                ids, (V, H), param_attr=fluid.ParamAttr(name="fb_emb"))
+
+        def out_fn(h):
+            z = fluid.layers.fc(
+                h, V, param_attr=fluid.ParamAttr(initializer=Constant(0.0),
+                                                 name="fb_zero_w"),
+                bias_attr=False)
+            return fluid.layers.elementwise_add(z, T.assign(
+                bias_vals.reshape(1, V)))
+
+        bsd = fluid.layers.BeamSearchDecoder(
+            cell, start_token=2, end_token=1, beam_size=2,
+            embedding_fn=embed, output_fn=out_fn)
+        outs, st = fluid.layers.dynamic_decode(bsd, inits=init_h,
+                                               max_step_num=Tmax)
+        seqs = bsd.finalize(outs)
+        scores = st[-2]
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        s, sc = exe.run(main, feed={"h0": np.zeros((B, H), "f")},
+                        fetch_list=[seqs, scores])
+    s = np.asarray(s)          # [T, B, K]
+    sc = np.asarray(sc).reshape(B, 2)
+    logp = bias_vals - np.log(np.exp(bias_vals).sum())
+    # best beam: end at step 0 with score logp[1], FROZEN thereafter
+    assert s[0, 0, 0] == 1
+    np.testing.assert_allclose(sc[0, 0], logp[1], rtol=1e-4)
+    # after finishing, the beam emits only end_token
+    assert (s[1:, 0, 0] == 1).all()
