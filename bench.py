@@ -19,7 +19,7 @@ H100_RESNET50_IMG_PER_SEC = 2400.0
 
 
 def bench_resnet(batch=512, image_size=224, warmup=5, iters=30, depth=50,
-                 amp=True):
+                 amp=True, data_format="NCHW"):
     import paddle_tpu as fluid
     from paddle_tpu.models import resnet
 
@@ -27,7 +27,7 @@ def bench_resnet(batch=512, image_size=224, warmup=5, iters=30, depth=50,
     with fluid.program_guard(main, startup):
         img, label, loss, acc = resnet.build_train(
             depth=depth, class_dim=1000, image_size=image_size, lr=0.1,
-            amp=amp)
+            amp=amp, data_format=data_format)
 
     exe = fluid.Executor(fluid.TPUPlace(0))
     scope = fluid.Scope()
@@ -72,7 +72,9 @@ def main():
     batch = int(os.environ.get("BENCH_BATCH", "512"))
     iters = int(os.environ.get("BENCH_ITERS", "30"))
     amp = os.environ.get("BENCH_AMP", "1") == "1"
-    img_per_sec, last_loss = bench_resnet(batch=batch, iters=iters, amp=amp)
+    data_format = os.environ.get("BENCH_DATA_FORMAT", "NCHW")
+    img_per_sec, last_loss = bench_resnet(batch=batch, iters=iters, amp=amp,
+                                          data_format=data_format)
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(img_per_sec, 2),

@@ -16,59 +16,90 @@ DEPTH_CFG = {
 }
 
 
-def conv_bn(x, filters, size, stride=1, act=None, is_test=False, name=None):
+def conv_bn(x, filters, size, stride=1, act=None, is_test=False, name=None,
+            data_format="NCHW"):
     c = fluid.layers.conv2d(
         x, filters, size, stride=stride, padding=(size - 1) // 2,
-        bias_attr=False, name=name,
+        bias_attr=False, name=name, data_format=data_format,
     )
-    return fluid.layers.batch_norm(c, act=act, is_test=is_test)
+    return fluid.layers.batch_norm(c, act=act, is_test=is_test,
+                                   data_layout=data_format)
 
 
-def basic_block(x, filters, stride, is_test=False):
-    conv0 = conv_bn(x, filters, 3, stride, act="relu", is_test=is_test)
-    conv1 = conv_bn(conv0, filters, 3, 1, is_test=is_test)
-    if stride != 1 or x.shape[1] != filters:
-        shortcut = conv_bn(x, filters, 1, stride, is_test=is_test)
+def _channels(x, data_format):
+    return x.shape[1] if data_format == "NCHW" else x.shape[-1]
+
+
+def basic_block(x, filters, stride, is_test=False, data_format="NCHW"):
+    conv0 = conv_bn(x, filters, 3, stride, act="relu", is_test=is_test,
+                    data_format=data_format)
+    conv1 = conv_bn(conv0, filters, 3, 1, is_test=is_test,
+                    data_format=data_format)
+    if stride != 1 or _channels(x, data_format) != filters:
+        shortcut = conv_bn(x, filters, 1, stride, is_test=is_test,
+                           data_format=data_format)
     else:
         shortcut = x
     return fluid.layers.relu(fluid.layers.elementwise_add(conv1, shortcut))
 
 
-def bottleneck_block(x, filters, stride, is_test=False):
-    conv0 = conv_bn(x, filters, 1, 1, act="relu", is_test=is_test)
-    conv1 = conv_bn(conv0, filters, 3, stride, act="relu", is_test=is_test)
-    conv2 = conv_bn(conv1, filters * 4, 1, 1, is_test=is_test)
-    if stride != 1 or x.shape[1] != filters * 4:
-        shortcut = conv_bn(x, filters * 4, 1, stride, is_test=is_test)
+def bottleneck_block(x, filters, stride, is_test=False, data_format="NCHW"):
+    conv0 = conv_bn(x, filters, 1, 1, act="relu", is_test=is_test,
+                    data_format=data_format)
+    conv1 = conv_bn(conv0, filters, 3, stride, act="relu", is_test=is_test,
+                    data_format=data_format)
+    conv2 = conv_bn(conv1, filters * 4, 1, 1, is_test=is_test,
+                    data_format=data_format)
+    if stride != 1 or _channels(x, data_format) != filters * 4:
+        shortcut = conv_bn(x, filters * 4, 1, stride, is_test=is_test,
+                           data_format=data_format)
     else:
         shortcut = x
     return fluid.layers.relu(fluid.layers.elementwise_add(conv2, shortcut))
 
 
-def resnet(img, class_dim=1000, depth=50, is_test=False):
+def resnet(img, class_dim=1000, depth=50, is_test=False, data_format="NCHW"):
+    """`img` must already be in `data_format` layout."""
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError("data_format must be NCHW or NHWC, got %r"
+                         % (data_format,))
     block_fn, counts = (
         (basic_block, DEPTH_CFG[depth][1])
         if DEPTH_CFG[depth][0] == "basic"
         else (bottleneck_block, DEPTH_CFG[depth][1])
     )
-    x = conv_bn(img, 64, 7, 2, act="relu", is_test=is_test)
-    x = fluid.layers.pool2d(x, pool_size=3, pool_stride=2, pool_padding=1)
+    x = conv_bn(img, 64, 7, 2, act="relu", is_test=is_test,
+                data_format=data_format)
+    x = fluid.layers.pool2d(x, pool_size=3, pool_stride=2, pool_padding=1,
+                            data_format=data_format)
     for stage, n in enumerate(counts):
         filters = 64 * (2 ** stage)
         for i in range(n):
             stride = 2 if (i == 0 and stage > 0) else 1
-            x = block_fn(x, filters, stride, is_test=is_test)
-    x = fluid.layers.pool2d(x, pool_type="avg", global_pooling=True)
+            x = block_fn(x, filters, stride, is_test=is_test,
+                         data_format=data_format)
+    x = fluid.layers.pool2d(x, pool_type="avg", global_pooling=True,
+                            data_format=data_format)
     logits = fluid.layers.fc(x, class_dim)
     return logits
 
 
 def build_train(depth=50, class_dim=1000, image_size=224, lr=0.1,
-                momentum=0.9, weight_decay=1e-4, is_test=False, amp=False):
+                momentum=0.9, weight_decay=1e-4, is_test=False, amp=False,
+                data_format="NCHW"):
     """Returns (img, label, loss, acc) inside the current program guard."""
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError("data_format must be NCHW or NHWC, got %r"
+                         % (data_format,))
     img = fluid.layers.data("img", shape=[3, image_size, image_size])
     label = fluid.layers.data("label", shape=[1], dtype="int64")
-    logits = resnet(img, class_dim, depth, is_test=is_test)
+    net_in = img
+    if data_format == "NHWC":
+        # feed data stays NCHW; one transpose at the boundary keeps the
+        # whole network in the channels-last layout
+        net_in = fluid.layers.transpose(img, [0, 2, 3, 1])
+    logits = resnet(net_in, class_dim, depth, is_test=is_test,
+                    data_format=data_format)
     loss = fluid.layers.mean(
         fluid.layers.softmax_with_cross_entropy(logits, label))
     acc = fluid.layers.accuracy(fluid.layers.softmax(logits), label)

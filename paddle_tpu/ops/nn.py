@@ -22,9 +22,11 @@ from .common import attr_dtype, dtype_enum
 
 
 def _conv_dims(data_format):
+    # Filters are always OIHW (the layer API creates them that way, so
+    # checkpoints are layout-independent); only the activation layout varies.
     if data_format in ("NCHW", "AnyLayout"):
         return ("NCHW", "OIHW", "NCHW")
-    return ("NHWC", "HWIO", "NHWC")
+    return ("NHWC", "OIHW", "NHWC")
 
 
 @register_op(

@@ -28,6 +28,29 @@ def test_resnet18_tiny_trains():
     assert losses[-1] < losses[0]
 
 
+def test_resnet_nhwc_matches_nchw():
+    """channels-last layout must produce the same forward loss (same OIHW
+    params, layout-only difference)."""
+    def first_loss(fmt):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            img, label, loss, acc = resnet.build_train(
+                depth=18, class_dim=10, image_size=32, lr=0.01,
+                data_format=fmt)
+        exe = fluid.Executor(fluid.CPUPlace())
+        rng = np.random.RandomState(0)
+        xb = rng.randn(4, 3, 32, 32).astype("float32")
+        yb = rng.randint(0, 10, (4, 1)).astype("int64")
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            lo, = exe.run(main, feed={"img": xb, "label": yb},
+                          fetch_list=[loss])
+        return float(lo[0])
+
+    np.testing.assert_allclose(first_loss("NCHW"), first_loss("NHWC"),
+                               rtol=1e-5)
+
+
 def test_resnet50_builds():
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
