@@ -1,0 +1,155 @@
+"""Host side of the C API (parity: paddle/fluid/framework/c/c_api.cc +
+inference/capi/).
+
+The C library (native/csrc_capi/paddle_tpu_c.cc) embeds CPython and calls
+these functions; each returns only C-friendly scalars/bytes so the C layer
+stays a thin marshalling shim.  Handles are integers into module-level
+registries (the C side owns their lifetime via *_destroy)."""
+
+import threading
+
+import numpy as np
+
+_registry = {}
+_next_handle = [1]
+_lock = threading.Lock()
+
+
+def _new_handle(obj):
+    with _lock:
+        h = _next_handle[0]
+        _next_handle[0] += 1
+        _registry[h] = obj
+    return h
+
+
+def _get(h):
+    return _registry[h]
+
+
+def destroy(h):
+    with _lock:
+        _registry.pop(h, None)
+    return 0
+
+
+# -- op registry query (framework/c/c_api.cc analog) --------------------------
+
+
+def num_ops():
+    import paddle_tpu  # noqa: F401  (populates the registry)
+    from paddle_tpu.core.registry import all_op_types
+
+    return len(all_op_types())
+
+
+def op_names():
+    import paddle_tpu  # noqa: F401
+    from paddle_tpu.core.registry import all_op_types
+
+    return "\n".join(sorted(all_op_types()))
+
+
+# -- trainer ------------------------------------------------------------------
+
+
+class _Trainer:
+    def __init__(self, model_dir, place):
+        import paddle_tpu as fluid
+
+        self.fluid = fluid
+        main, startup, feeds, fetches = fluid.io.load_train_model(model_dir)
+        self.main, self.startup = main, startup
+        self.feed_names, self.fetch_names = feeds, fetches
+        p = fluid.TPUPlace(0) if place == "tpu" else fluid.CPUPlace()
+        self.exe = fluid.Executor(p)
+        self.scope = fluid.Scope()
+        with fluid.scope_guard(self.scope):
+            self.exe.run(startup)
+        self.pending_feed = {}
+
+    def feed(self, name, arr):
+        self.pending_feed[name] = arr
+
+    def step(self):
+        with self.fluid.scope_guard(self.scope):
+            fetch = [self.main.global_block().var(n)
+                     for n in self.fetch_names]
+            outs = self.exe.run(self.main, feed=dict(self.pending_feed),
+                                fetch_list=fetch)
+        self.pending_feed.clear()
+        return [np.asarray(o) for o in outs]
+
+
+def trainer_create(model_dir, place):
+    return _new_handle(_Trainer(model_dir, place))
+
+
+# -- predictor ----------------------------------------------------------------
+
+
+class _Predictor:
+    def __init__(self, model_dir, place):
+        import paddle_tpu as fluid
+
+        self.fluid = fluid
+        p = fluid.TPUPlace(0) if place == "tpu" else fluid.CPUPlace()
+        self.exe = fluid.Executor(p)
+        self.scope = fluid.Scope()
+        with fluid.scope_guard(self.scope):
+            prog, feeds, fetches = fluid.io.load_inference_model(
+                model_dir, self.exe)
+        self.prog, self.feed_names = prog, feeds
+        self.fetch_vars = fetches
+        self.pending_feed = {}
+        self.outputs = []
+
+    def feed(self, name, arr):
+        self.pending_feed[name] = arr
+
+    def run(self):
+        with self.fluid.scope_guard(self.scope):
+            outs = self.exe.run(self.prog, feed=dict(self.pending_feed),
+                                fetch_list=self.fetch_vars)
+        self.pending_feed.clear()
+        self.outputs = [np.ascontiguousarray(np.asarray(o)) for o in outs]
+        return len(self.outputs)
+
+
+def predictor_create(model_dir, place):
+    return _new_handle(_Predictor(model_dir, place))
+
+
+# -- shared marshalling (both handle kinds) -----------------------------------
+
+_DTYPES = {"float32": np.float32, "float64": np.float64,
+           "int32": np.int32, "int64": np.int64}
+
+
+def feed_buffer(handle, name, data_bytes, dtype, dims):
+    arr = np.frombuffer(data_bytes, dtype=_DTYPES[dtype]).reshape(
+        [int(d) for d in dims]).copy()
+    _get(handle).feed(name, arr)
+    return 0
+
+
+def trainer_step(handle):
+    """Run one step; returns the first fetch as a float (loss)."""
+    outs = _get(handle).step()
+    return float(np.asarray(outs[0]).reshape(-1)[0])
+
+
+def predictor_run(handle):
+    return _get(handle).run()
+
+
+def output_ndim(handle, i):
+    return len(_get(handle).outputs[i].shape)
+
+
+def output_dim(handle, i, d):
+    return int(_get(handle).outputs[i].shape[d])
+
+
+def output_bytes(handle, i):
+    return _get(handle).outputs[i].astype(np.float32).tobytes()

@@ -26,6 +26,8 @@ __all__ = [
     "load_persistables",
     "save_inference_model",
     "load_inference_model",
+    "save_train_model",
+    "load_train_model",
 ]
 
 
@@ -182,3 +184,40 @@ def load_inference_model(dirname, executor, model_filename=None,
         pass
     fetch_vars = [program.global_block().var(n) for n in model["fetch_names"]]
     return program, model["feed_names"], fetch_vars
+
+
+def save_train_model(dirname, feed_names, fetch_vars, executor,
+                     main_program=None, startup_program=None):
+    """Save a full *training* bundle (main + startup programs + names) for
+    the standalone C++ trainer (parity: the reference's
+    train/demo workflow, which saves main/startup ProgramDescs via
+    fluid.io and loads them from C++, train/demo/demo_trainer.cc:25-45)."""
+    from .framework import default_startup_program
+
+    main = main_program or _default_main()
+    startup = startup_program or default_startup_program()
+    fetch_names = [v.name if isinstance(v, Variable) else v
+                   for v in fetch_vars]
+    os.makedirs(dirname, exist_ok=True)
+    bundle = {
+        "main_program": main.to_dict(),
+        "startup_program": startup.to_dict(),
+        "feed_names": list(feed_names),
+        "fetch_names": fetch_names,
+    }
+    with open(os.path.join(dirname, "__train_model__.json"), "w") as f:
+        json.dump(bundle, f)
+    # persist current params too so training can resume (optional at load)
+    if executor is not None:
+        save_persistables(executor, dirname, main)
+    return fetch_names
+
+
+def load_train_model(dirname, executor=None):
+    """Load a bundle saved by save_train_model ->
+    (main, startup, feed_names, fetch_names)."""
+    with open(os.path.join(dirname, "__train_model__.json")) as f:
+        bundle = json.load(f)
+    main = Program.from_dict(bundle["main_program"])
+    startup = Program.from_dict(bundle["startup_program"])
+    return main, startup, bundle["feed_names"], bundle["fetch_names"]

@@ -1,0 +1,431 @@
+"""Layer wrappers for the detection long tail (parity:
+python/paddle/fluid/layers/detection.py + the deformable/psroi entries of
+layers/nn.py).  Ops live in ops/detection2.py; ragged-output reference
+semantics become fixed-size padded outputs (see the op docstrings)."""
+
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "polygon_box_transform", "yolov3_loss", "psroi_pool", "prroi_pool",
+    "roi_perspective_transform", "deformable_conv", "deformable_roi_pooling",
+    "generate_proposals", "rpn_target_assign", "retinanet_target_assign",
+    "generate_proposal_labels", "generate_mask_labels",
+    "retinanet_detection_output", "locality_aware_nms",
+    "distribute_fpn_proposals", "collect_fpn_proposals",
+    "box_decoder_and_assign", "similarity_focus", "filter_by_instag",
+    "continuous_value_model",
+]
+
+
+def polygon_box_transform(input, name=None):
+    helper = LayerHelper("polygon_box_transform", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="polygon_box_transform", inputs={"Input": [input]},
+                     outputs={"Output": [out]})
+    return out
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=True, name=None):
+    helper = LayerHelper("yolov3_loss", name=name)
+    loss = helper.create_variable_for_type_inference(x.dtype)
+    obj_mask = helper.create_variable_for_type_inference(x.dtype)
+    gt_match = helper.create_variable_for_type_inference("int32")
+    inputs = {"X": [x], "GTBox": [gt_box], "GTLabel": [gt_label]}
+    if gt_score is not None:
+        inputs["GTScore"] = [gt_score]
+    helper.append_op(
+        type="yolov3_loss", inputs=inputs,
+        outputs={"Loss": [loss], "ObjectnessMask": [obj_mask],
+                 "GTMatchMask": [gt_match]},
+        attrs={"anchors": [int(a) for a in anchors],
+               "anchor_mask": [int(a) for a in anchor_mask],
+               "class_num": class_num, "ignore_thresh": ignore_thresh,
+               "downsample_ratio": downsample_ratio,
+               "use_label_smooth": use_label_smooth})
+    return loss
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, name=None):
+    helper = LayerHelper("psroi_pool", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="psroi_pool", inputs={"X": [input], "ROIs": [rois]},
+        outputs={"Out": [out]},
+        attrs={"output_channels": output_channels,
+               "spatial_scale": spatial_scale, "pooled_height": pooled_height,
+               "pooled_width": pooled_width})
+    return out
+
+
+def prroi_pool(input, rois, output_channels=None, spatial_scale=1.0,
+               pooled_height=1, pooled_width=1, name=None):
+    helper = LayerHelper("prroi_pool", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="prroi_pool", inputs={"X": [input], "ROIs": [rois]},
+        outputs={"Out": [out]},
+        attrs={"spatial_scale": float(spatial_scale),
+               "pooled_height": pooled_height, "pooled_width": pooled_width})
+    return out
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0,
+                              name=None):
+    helper = LayerHelper("roi_perspective_transform", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    mask = helper.create_variable_for_type_inference("int32")
+    mat = helper.create_variable_for_type_inference(input.dtype)
+    o2i = helper.create_variable_for_type_inference("int32")
+    o2w = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="roi_perspective_transform",
+        inputs={"X": [input], "ROIs": [rois]},
+        outputs={"Out": [out], "Mask": [mask], "TransformMatrix": [mat],
+                 "Out2InIdx": [o2i], "Out2InWeights": [o2w]},
+        attrs={"transformed_height": transformed_height,
+               "transformed_width": transformed_width,
+               "spatial_scale": spatial_scale})
+    return out, mask, mat
+
+
+def deformable_conv(input, offset, mask, num_filters, filter_size, stride=1,
+                    padding=0, dilation=1, groups=None, deformable_groups=None,
+                    im2col_step=None, param_attr=None, bias_attr=None,
+                    modulated=True, name=None):
+    """Deformable conv v2 (modulated=True, layers/nn.py:12714) / v1."""
+    helper = LayerHelper("deformable_conv", bias_attr=bias_attr, name=name)
+    dtype = input.dtype
+    groups = groups or 1
+    deformable_groups = deformable_groups or 1
+    num_channels = input.shape[1]
+    if isinstance(filter_size, int):
+        filter_size = [filter_size, filter_size]
+    stride = [stride, stride] if isinstance(stride, int) else list(stride)
+    padding = [padding, padding] if isinstance(padding, int) else list(padding)
+    dilation = ([dilation, dilation] if isinstance(dilation, int)
+                else list(dilation))
+    filter_shape = [num_filters, num_channels // groups] + list(filter_size)
+
+    import math as _math
+    from ..initializer import Normal
+
+    fan_in = (num_channels // groups) * filter_size[0] * filter_size[1]
+    w = helper.create_parameter(
+        attr=param_attr, shape=filter_shape, dtype=dtype,
+        default_initializer=Normal(0.0, _math.sqrt(2.0 / fan_in)))
+    out = helper.create_variable_for_type_inference(dtype)
+    attrs = {"strides": stride, "paddings": padding, "dilations": dilation,
+             "groups": groups, "deformable_groups": deformable_groups,
+             "im2col_step": im2col_step or 64}
+    if modulated:
+        helper.append_op(
+            type="deformable_conv",
+            inputs={"Input": [input], "Offset": [offset], "Mask": [mask],
+                    "Filter": [w]},
+            outputs={"Output": [out]}, attrs=attrs)
+    else:
+        helper.append_op(
+            type="deformable_conv_v1",
+            inputs={"Input": [input], "Offset": [offset], "Filter": [w]},
+            outputs={"Output": [out]}, attrs=attrs)
+    if bias_attr:
+        b = helper.create_parameter(attr=bias_attr, shape=[num_filters],
+                                    dtype=dtype, is_bias=True)
+        pre = out
+        out = helper.create_variable_for_type_inference(dtype)
+        helper.append_op(type="elementwise_add",
+                         inputs={"X": [pre], "Y": [b]},
+                         outputs={"Out": [out]}, attrs={"axis": 1})
+    return out
+
+
+def deformable_roi_pooling(input, rois, trans, no_trans=False,
+                           spatial_scale=1.0, group_size=(1, 1),
+                           pooled_height=1, pooled_width=1, part_size=None,
+                           sample_per_part=1, trans_std=0.1,
+                           position_sensitive=False, name=None):
+    helper = LayerHelper("deformable_roi_pooling", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    top = helper.create_variable_for_type_inference(input.dtype)
+    gs = (list(group_size) if not isinstance(group_size, int)
+          else [group_size, group_size])
+    if len(gs) == 1:
+        gs = [gs[0], gs[0]]
+    if part_size is None:
+        part_size = [pooled_height, pooled_width]
+    elif isinstance(part_size, int):
+        part_size = [part_size, part_size]
+    if position_sensitive:
+        output_dim = input.shape[1] // (gs[0] * gs[1])
+    else:
+        # non-PS mode: treat every channel independently (group 1)
+        output_dim = input.shape[1]
+        gs = [1, 1]
+    helper.append_op(
+        type="deformable_psroi_pooling",
+        inputs={"Input": [input], "ROIs": [rois], "Trans": [trans]},
+        outputs={"Output": [out], "TopCount": [top]},
+        attrs={"no_trans": no_trans, "spatial_scale": float(spatial_scale),
+               "output_dim": output_dim, "group_size": gs,
+               "pooled_height": pooled_height, "pooled_width": pooled_width,
+               "part_size": list(part_size),
+               "sample_per_part": sample_per_part,
+               "trans_std": trans_std})
+    return out
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       return_rois_num=False, name=None):
+    helper = LayerHelper("generate_proposals", name=name)
+    rois = helper.create_variable_for_type_inference(scores.dtype)
+    probs = helper.create_variable_for_type_inference(scores.dtype)
+    num = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="generate_proposals",
+        inputs={"Scores": [scores], "BboxDeltas": [bbox_deltas],
+                "ImInfo": [im_info], "Anchors": [anchors],
+                "Variances": [variances]},
+        outputs={"RpnRois": [rois], "RpnRoiProbs": [probs],
+                 "RpnRoisNum": [num]},
+        attrs={"pre_nms_topN": pre_nms_top_n, "post_nms_topN": post_nms_top_n,
+               "nms_thresh": nms_thresh, "min_size": min_size, "eta": eta})
+    if return_rois_num:
+        return rois, probs, num
+    return rois, probs
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var, gt_boxes,
+                      is_crowd, im_info, rpn_batch_size_per_im=256,
+                      rpn_straddle_thresh=0.0, rpn_fg_fraction=0.5,
+                      rpn_positive_overlap=0.7, rpn_negative_overlap=0.3,
+                      use_random=True):
+    """detection.py:289.  Static-shape inputs: gt_boxes [N, G, 4] padded,
+    is_crowd [N, G] (batch-padded in place of LoD).  `use_random` maps to
+    deterministic IoU-priority sampling (see op docstring)."""
+    from . import nn as _nn
+    from . import tensor as _tensor
+
+    helper = LayerHelper("rpn_target_assign")
+    loc_index = helper.create_variable_for_type_inference("int32")
+    score_index = helper.create_variable_for_type_inference("int32")
+    target_label = helper.create_variable_for_type_inference("int32")
+    target_bbox = helper.create_variable_for_type_inference(anchor_box.dtype)
+    inside_w = helper.create_variable_for_type_inference(anchor_box.dtype)
+    helper.append_op(
+        type="rpn_target_assign",
+        inputs={"Anchor": [anchor_box], "GtBoxes": [gt_boxes],
+                "IsCrowd": [is_crowd], "ImInfo": [im_info]},
+        outputs={"LocationIndex": [loc_index], "ScoreIndex": [score_index],
+                 "TargetLabel": [target_label], "TargetBBox": [target_bbox],
+                 "BBoxInsideWeight": [inside_w]},
+        attrs={"rpn_batch_size_per_im": rpn_batch_size_per_im,
+               "rpn_straddle_thresh": rpn_straddle_thresh,
+               "rpn_positive_overlap": rpn_positive_overlap,
+               "rpn_negative_overlap": rpn_negative_overlap,
+               "rpn_fg_fraction": rpn_fg_fraction,
+               "use_random": use_random})
+    pred_loc = _nn.gather(_nn.reshape(bbox_pred, [-1, 4]),
+                          _nn.reshape(loc_index, [-1]))
+    pred_score = _nn.gather(_nn.reshape(cls_logits, [-1, 1]),
+                            _nn.reshape(score_index, [-1]))
+    return pred_score, pred_loc, target_label, target_bbox, inside_w
+
+
+def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                            gt_boxes, gt_labels, is_crowd, im_info,
+                            num_classes=1, positive_overlap=0.5,
+                            negative_overlap=0.4):
+    from . import nn as _nn
+
+    helper = LayerHelper("retinanet_target_assign")
+    loc_index = helper.create_variable_for_type_inference("int32")
+    score_index = helper.create_variable_for_type_inference("int32")
+    target_label = helper.create_variable_for_type_inference("int32")
+    target_bbox = helper.create_variable_for_type_inference(anchor_box.dtype)
+    inside_w = helper.create_variable_for_type_inference(anchor_box.dtype)
+    fg_num = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="retinanet_target_assign",
+        inputs={"Anchor": [anchor_box], "GtBoxes": [gt_boxes],
+                "GtLabels": [gt_labels], "IsCrowd": [is_crowd],
+                "ImInfo": [im_info]},
+        outputs={"LocationIndex": [loc_index], "ScoreIndex": [score_index],
+                 "TargetLabel": [target_label], "TargetBBox": [target_bbox],
+                 "BBoxInsideWeight": [inside_w],
+                 "ForegroundNumber": [fg_num]},
+        attrs={"positive_overlap": positive_overlap,
+               "negative_overlap": negative_overlap})
+    pred_loc = _nn.gather(_nn.reshape(bbox_pred, [-1, 4]),
+                          _nn.reshape(loc_index, [-1]))
+    pred_score = _nn.gather(
+        _nn.reshape(cls_logits, [-1, num_classes]),
+        _nn.reshape(score_index, [-1]))
+    return (pred_score, pred_loc, target_label, target_bbox, inside_w,
+            fg_num)
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info, batch_size_per_im=256, fg_fraction=0.25,
+                             fg_thresh=0.25, bg_thresh_hi=0.5,
+                             bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=None, use_random=True,
+                             is_cls_agnostic=False, is_cascade_rcnn=False):
+    """detection.py:2437.  rpn_rois here is [N, R, 4] per-image (reshape of
+    generate_proposals output); gt_* are [N, G, ...] padded."""
+    helper = LayerHelper("generate_proposal_labels")
+    rois = helper.create_variable_for_type_inference(rpn_rois.dtype)
+    labels = helper.create_variable_for_type_inference("int32")
+    bbox_targets = helper.create_variable_for_type_inference(rpn_rois.dtype)
+    inside_w = helper.create_variable_for_type_inference(rpn_rois.dtype)
+    outside_w = helper.create_variable_for_type_inference(rpn_rois.dtype)
+    helper.append_op(
+        type="generate_proposal_labels",
+        inputs={"RpnRois": [rpn_rois], "GtClasses": [gt_classes],
+                "IsCrowd": [is_crowd], "GtBoxes": [gt_boxes],
+                "ImInfo": [im_info]},
+        outputs={"Rois": [rois], "LabelsInt32": [labels],
+                 "BboxTargets": [bbox_targets],
+                 "BboxInsideWeights": [inside_w],
+                 "BboxOutsideWeights": [outside_w]},
+        attrs={"batch_size_per_im": batch_size_per_im,
+               "fg_fraction": fg_fraction, "fg_thresh": fg_thresh,
+               "bg_thresh_hi": bg_thresh_hi, "bg_thresh_lo": bg_thresh_lo,
+               "bbox_reg_weights": list(bbox_reg_weights),
+               "class_nums": class_nums or 81, "use_random": use_random,
+               "is_cls_agnostic": is_cls_agnostic,
+               "is_cascade_rcnn": is_cascade_rcnn})
+    return rois, labels, bbox_targets, inside_w, outside_w
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         labels_int32, num_classes, resolution):
+    helper = LayerHelper("generate_mask_labels")
+    mask_rois = helper.create_variable_for_type_inference(rois.dtype)
+    has_mask = helper.create_variable_for_type_inference("int32")
+    mask_int32 = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="generate_mask_labels",
+        inputs={"ImInfo": [im_info], "GtClasses": [gt_classes],
+                "IsCrowd": [is_crowd], "GtSegms": [gt_segms],
+                "Rois": [rois], "LabelsInt32": [labels_int32]},
+        outputs={"MaskRois": [mask_rois], "RoiHasMaskInt32": [has_mask],
+                 "MaskInt32": [mask_int32]},
+        attrs={"num_classes": num_classes, "resolution": resolution})
+    return mask_rois, has_mask, mask_int32
+
+
+def retinanet_detection_output(bboxes, scores, anchors, im_info,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.3,
+                               nms_eta=1.0):
+    helper = LayerHelper("retinanet_detection_output")
+    out = helper.create_variable_for_type_inference(bboxes[0].dtype)
+    num = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="retinanet_detection_output",
+        inputs={"BBoxes": list(bboxes), "Scores": list(scores),
+                "Anchors": list(anchors), "ImInfo": [im_info]},
+        outputs={"Out": [out], "OutNum": [num]},
+        attrs={"score_threshold": score_threshold, "nms_top_k": nms_top_k,
+               "keep_top_k": keep_top_k, "nms_threshold": nms_threshold,
+               "nms_eta": nms_eta})
+    return out
+
+
+def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                       nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                       background_label=-1, name=None):
+    helper = LayerHelper("locality_aware_nms", name=name)
+    out = helper.create_variable_for_type_inference(bboxes.dtype)
+    helper.append_op(
+        type="locality_aware_nms",
+        inputs={"BBoxes": [bboxes], "Scores": [scores]},
+        outputs={"Out": [out]},
+        attrs={"background_label": background_label,
+               "score_threshold": score_threshold, "nms_top_k": nms_top_k,
+               "nms_threshold": nms_threshold, "nms_eta": nms_eta,
+               "keep_top_k": keep_top_k, "normalized": normalized})
+    return out
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, name=None):
+    helper = LayerHelper("distribute_fpn_proposals", name=name)
+    n_lvl = max_level - min_level + 1
+    outs = [helper.create_variable_for_type_inference(fpn_rois.dtype)
+            for _ in range(n_lvl)]
+    restore = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="distribute_fpn_proposals", inputs={"FpnRois": [fpn_rois]},
+        outputs={"MultiFpnRois": outs, "RestoreIndex": [restore]},
+        attrs={"min_level": min_level, "max_level": max_level,
+               "refer_level": refer_level, "refer_scale": refer_scale})
+    return outs, restore
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, name=None):
+    helper = LayerHelper("collect_fpn_proposals", name=name)
+    n = max_level - min_level + 1
+    out = helper.create_variable_for_type_inference(multi_rois[0].dtype)
+    helper.append_op(
+        type="collect_fpn_proposals",
+        inputs={"MultiLevelRois": list(multi_rois[:n]),
+                "MultiLevelScores": list(multi_scores[:n])},
+        outputs={"FpnRois": [out]},
+        attrs={"post_nms_topN": post_nms_top_n})
+    return out
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                           box_clip, name=None):
+    helper = LayerHelper("box_decoder_and_assign", name=name)
+    dec = helper.create_variable_for_type_inference(prior_box.dtype)
+    assign = helper.create_variable_for_type_inference(prior_box.dtype)
+    helper.append_op(
+        type="box_decoder_and_assign",
+        inputs={"PriorBox": [prior_box], "PriorBoxVar": [prior_box_var],
+                "TargetBox": [target_box], "BoxScore": [box_score]},
+        outputs={"DecodeBox": [dec], "OutputAssignBox": [assign]},
+        attrs={"box_clip": box_clip})
+    return dec, assign
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    helper = LayerHelper("similarity_focus", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="similarity_focus", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"axis": axis, "indexes": list(indexes)})
+    return out
+
+
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod):
+    helper = LayerHelper("filter_by_instag")
+    out = helper.create_variable_for_type_inference(ins.dtype)
+    loss_weight = helper.create_variable_for_type_inference("float32")
+    index_map = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        type="filter_by_instag",
+        inputs={"Ins": [ins], "Ins_tag": [ins_tag],
+                "Filter_tag": [filter_tag]},
+        outputs={"Out": [out], "LossWeight": [loss_weight],
+                 "IndexMap": [index_map]},
+        attrs={"is_lod": is_lod})
+    return out, loss_weight, index_map
+
+
+def continuous_value_model(input, cvm, use_cvm=True):
+    helper = LayerHelper("cvm")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="cvm", inputs={"X": [input], "CVM": [cvm]},
+                     outputs={"Y": [out]}, attrs={"use_cvm": use_cvm})
+    return out

@@ -1,0 +1,200 @@
+"""Probability distributions (parity: python/paddle/fluid/layers/
+distributions.py:28-633): Uniform, Normal, Categorical,
+MultivariateNormalDiag, composed from layer ops so they work in static
+graphs and dygraph alike."""
+
+import math
+
+import numpy as np
+
+from ..framework import Variable
+from . import tensor
+from . import control_flow
+
+__all__ = ["Distribution", "Uniform", "Normal", "Categorical",
+           "MultivariateNormalDiag"]
+
+
+def _lay():
+    import paddle_tpu.layers as _L
+
+    return _L
+
+
+def _log(x):
+    return _lay().log(x)
+
+
+def _exp(x):
+    return _lay().exp(x)
+
+
+class Distribution(object):
+    """Abstract base (distributions.py:28)."""
+
+    def sample(self, shape, seed=0):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def _to_variable(self, *args):
+        out = []
+        for a in args:
+            if isinstance(a, Variable):
+                out.append(a)
+            else:
+                arr = np.array(a, dtype="float32")
+                if arr.ndim == 0:
+                    arr = arr.reshape(1)
+                v = tensor.create_tensor(dtype="float32")
+                tensor.assign(arr, v)
+                out.append(v)
+        return tuple(out)
+
+    def _validate_args(self, *args):
+        is_var = [isinstance(a, Variable) for a in args]
+        if any(is_var) and not all(is_var):
+            return False
+        return all(is_var)
+
+
+class Uniform(Distribution):
+    """U(low, high) (distributions.py:113)."""
+
+    def __init__(self, low, high):
+        self.all_arg_is_float = (isinstance(low, float)
+                                 and isinstance(high, float))
+        self.low, self.high = self._to_variable(low, high)
+
+    def sample(self, shape, seed=0):
+        batch_shape = list((self.low + self.high).shape)
+        output_shape = list(shape) + batch_shape
+        u = _lay().uniform_random(output_shape, seed=seed, min=0.0, max=1.0)
+        out = u * (tensor.zeros(output_shape, dtype="float32")
+                   + (self.high - self.low)) + self.low
+        if self.all_arg_is_float:
+            return _lay().reshape(out, shape)
+        return out
+
+    def log_prob(self, value):
+        lb = tensor.cast(control_flow.less_than(self.low, value),
+                         dtype=value.dtype)
+        ub = tensor.cast(control_flow.less_than(value, self.high),
+                         dtype=value.dtype)
+        return _log(lb * ub) - _log(self.high - self.low)
+
+    def entropy(self):
+        return _log(self.high - self.low)
+
+
+class Normal(Distribution):
+    """N(loc, scale) (distributions.py:247)."""
+
+    def __init__(self, loc, scale):
+        self.all_arg_is_float = (isinstance(loc, float)
+                                 and isinstance(scale, float))
+        self.loc, self.scale = self._to_variable(loc, scale)
+
+    def sample(self, shape, seed=0):
+        batch_shape = list((self.loc + self.scale).shape)
+        output_shape = list(shape) + batch_shape
+        z = _lay().gaussian_random(output_shape, mean=0.0, std=1.0, seed=seed)
+        out = z * (tensor.zeros(output_shape, dtype="float32")
+                   + self.scale) + self.loc
+        if self.all_arg_is_float:
+            return _lay().reshape(out, shape)
+        return out
+
+    def entropy(self):
+        return 0.5 + 0.5 * math.log(2 * math.pi) + _log(self.scale)
+
+    def log_prob(self, value):
+        var = self.scale * self.scale
+        log_scale = _log(self.scale)
+        return (-1.0 * ((value - self.loc) * (value - self.loc)) / (2.0 * var)
+                - log_scale - math.log(math.sqrt(2.0 * math.pi)))
+
+    def kl_divergence(self, other):
+        assert isinstance(other, Normal), \
+            "another distribution must be Normal"
+        var_ratio = self.scale / other.scale
+        var_ratio = var_ratio * var_ratio
+        t1 = (self.loc - other.loc) / other.scale
+        t1 = t1 * t1
+        return 0.5 * (var_ratio + t1 - 1.0 - _log(var_ratio))
+
+
+class Categorical(Distribution):
+    """Categorical over unnormalized logits (distributions.py:400); the
+    reference implements entropy and kl_divergence only."""
+
+    def __init__(self, logits):
+        if isinstance(logits, Variable):
+            self.logits = logits
+        else:
+            (self.logits,) = self._to_variable(logits)
+
+    def _norm(self, logits):
+        shifted = logits - _lay().reduce_max(logits, dim=-1, keep_dim=True)
+        e = _exp(shifted)
+        z = _lay().reduce_sum(e, dim=-1, keep_dim=True)
+        return shifted, e, z
+
+    def kl_divergence(self, other):
+        assert isinstance(other, Categorical)
+        logits, e, z = self._norm(self.logits)
+        o_logits, o_e, o_z = self._norm(other.logits)
+        prob = e / z
+        return _lay().reduce_sum(
+            prob * (logits - _log(z) - o_logits + _log(o_z)),
+            dim=-1, keep_dim=True)
+
+    def entropy(self):
+        logits, e, z = self._norm(self.logits)
+        prob = e / z
+        return -1.0 * _lay().reduce_sum(prob * (logits - _log(z)),
+                                    dim=-1, keep_dim=True)
+
+
+class MultivariateNormalDiag(Distribution):
+    """Multivariate normal with diagonal covariance given as a full [k,k]
+    matrix (distributions.py:503)."""
+
+    def __init__(self, loc, scale):
+        self.loc, self.scale = self._to_variable(loc, scale)
+
+    def _det(self, value):
+        k = value.shape[0]
+        one_diag = tensor.eye(k, dtype=value.dtype)
+        return _lay().reduce_prod(_lay().reduce_sum(value * one_diag, dim=-1))
+
+    def _inv(self, value):
+        k = value.shape[0]
+        one_diag = tensor.eye(k, dtype=value.dtype)
+        one_all = tensor.fill_constant([k, k], value.dtype, 1.0)
+        # exponent is -1 on the diagonal (1/x) and 1 off it (0 stays 0)
+        return _lay().elementwise_pow(value, one_all - 2.0 * one_diag)
+
+    def entropy(self):
+        k = self.scale.shape[0]
+        return 0.5 * (k * (1.0 + math.log(2 * math.pi))
+                      + _log(self._det(self.scale)))
+
+    def kl_divergence(self, other):
+        assert isinstance(other, MultivariateNormalDiag)
+        tr = _lay().reduce_sum(self._inv(other.scale) * self.scale)
+        d = other.loc - self.loc
+        loc_cov = _lay().matmul(d, self._inv(other.scale))
+        tri = _lay().matmul(loc_cov, _lay().transpose(d, [1, 0])
+                        if len(d.shape) == 2 else d)
+        k = list(self.scale.shape)[0]
+        ln_cov = _log(self._det(other.scale)) - _log(
+            self._det(self.scale))
+        return 0.5 * (tr + tri - k + ln_cov)

@@ -1,0 +1,283 @@
+"""Final API-surface fills: DynamicRNN, load, reorder_lod_tensor_by_rank,
+and the layer-codegen/doc helpers (parity: layers/control_flow.py DynamicRNN,
+layers/io.py:884 load, layers/control_flow.py reorder_lod_tensor_by_rank,
+layer_function_generator.py)."""
+
+import contextlib
+import functools
+import warnings
+
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "DynamicRNN", "load", "reorder_lod_tensor_by_rank", "lod_rank_table",
+    "autodoc", "templatedoc", "deprecated", "generate_layer_fn",
+    "generate_activation_fn",
+]
+
+
+# -- codegen/doc helpers (layer_function_generator.py) ------------------------
+
+
+def autodoc(comment=""):
+    """Decorator stamping a generated docstring (reference
+    layer_function_generator.py autodoc)."""
+
+    def deco(func):
+        func.__doc__ = comment + (func.__doc__ or "")
+        return func
+
+    return deco
+
+
+def templatedoc(op_type=None):
+    """Decorator filling ${comment}-style slots from the op's registered
+    metadata; our registry has no OpProto comments, so the template slots
+    are stripped (API-compatible no-op)."""
+
+    def deco(func):
+        doc = func.__doc__ or ""
+        func.__doc__ = doc.replace("${comment}", "").strip()
+        return func
+
+    return deco
+
+
+def deprecated(since, instead, extra_message=""):
+    """Decorator emitting a DeprecationWarning (reference deprecated.py)."""
+
+    def deco(func):
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            warnings.warn(
+                "API %r is deprecated since %s, use %s instead. %s"
+                % (func.__name__, since, instead, extra_message),
+                DeprecationWarning, stacklevel=2)
+            return func(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+def generate_layer_fn(op_type):
+    """Build a layer function from a registered op (reference
+    layer_function_generator.py:generate_layer_fn): inputs map positionally
+    to the op's input slots, keywords to attrs, one output."""
+    from ..core.registry import get_op_def
+
+    opdef = get_op_def(op_type)
+
+    def layer(*args, **kwargs):
+        helper = LayerHelper(op_type, name=kwargs.pop("name", None))
+        inputs = {}
+        for slot, a in zip(opdef.input_slots, args):
+            if a is not None:
+                inputs[slot] = a if isinstance(a, (list, tuple)) else [a]
+        for slot in opdef.input_slots:
+            if slot in kwargs:
+                v = kwargs.pop(slot)
+            elif slot.lower() in kwargs and isinstance(
+                    kwargs[slot.lower()], Variable):
+                # only claim the lowercase spelling when it is a Variable —
+                # attrs may share the name (e.g. a "shape" attr vs "Shape"
+                # input slot)
+                v = kwargs.pop(slot.lower())
+            else:
+                continue
+            if v is not None and slot not in inputs:
+                inputs[slot] = v if isinstance(v, (list, tuple)) else [v]
+        ref = next(iter(inputs.values()))[0] if inputs else None
+        dtype = kwargs.pop("dtype", None) or (
+            ref.dtype if ref is not None else "float32")
+        outs = [helper.create_variable_for_type_inference(dtype)
+                for _ in opdef.output_slots]
+        helper.append_op(
+            type=op_type, inputs=inputs,
+            outputs={s: [o] for s, o in zip(opdef.output_slots, outs)},
+            attrs=kwargs)
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    layer.__name__ = op_type
+    return layer
+
+
+def generate_activation_fn(op_type):
+    """One-input one-output activation layer from the registry (reference
+    layer_function_generator.py:generate_activation_fn)."""
+
+    def layer(x, name=None):
+        helper = LayerHelper(op_type, name=name)
+        out = helper.create_variable_for_type_inference(x.dtype)
+        helper.append_op(type=op_type, inputs={"X": [x]},
+                         outputs={"Out": [out]})
+        return out
+
+    layer.__name__ = op_type
+    return layer
+
+
+# -- load / reorder -----------------------------------------------------------
+
+
+def load(out, file_path, load_as_fp16=None):
+    """Load a tensor saved by the `save` op into `out` (layers/io.py:884)."""
+    helper = LayerHelper("load")
+    attrs = {"file_path": file_path}
+    if load_as_fp16 is not None:
+        attrs["load_as_fp16"] = load_as_fp16
+    helper.append_op(type="load", inputs={}, outputs={"Out": [out]},
+                     attrs=attrs)
+
+
+def lod_rank_table(x, level=0, seq_len=None):
+    """Rank table: batch indices sorted by sequence length descending
+    (reference control_flow.py lod_rank_table).  Padded design: lengths come
+    from `seq_len` [B]; without it every row ranks equally (identity)."""
+    from . import nn as _nn
+    from . import tensor as _tensor
+
+    if seq_len is None:
+        raise ValueError(
+            "lod_rank_table needs seq_len in the padded-batch design "
+            "(the reference reads it from the LoD)")
+    neg = _tensor.cast(seq_len, "float32") * -1.0
+    _, idx = _nn.argsort(neg, axis=0)
+    return idx
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    """Permute batch rows by a rank table (reference
+    reorder_lod_tensor_by_rank_op.cc); rank_table is the index tensor
+    produced by lod_rank_table."""
+    from . import nn as _nn
+
+    return _nn.gather(x, rank_table)
+
+
+# -- DynamicRNN ---------------------------------------------------------------
+
+
+class DynamicRNN:
+    """Variable-length RNN (reference layers/control_flow.py DynamicRNN).
+
+    Padded-batch design: the reference sorts sequences by length and shrinks
+    the active batch each step; here every step runs the full padded batch
+    and a per-step mask freezes memories of finished rows (identical math,
+    XLA-friendly static shapes).
+
+    Usage::
+
+        drnn = DynamicRNN()
+        with drnn.block():
+            x_t = drnn.step_input(x, seq_len=lens)   # x: [B, T, D]
+            h = drnn.memory(shape=[H], value=0.0)
+            nh = fluid.layers.fc(x_t, H) + h
+            drnn.update_memory(h, nh)
+            drnn.output(nh)
+        out, = drnn()                                # [B, T, H] zero-padded
+    """
+
+    def __init__(self, name=None):
+        from .control_flow import StaticRNN
+
+        self._rnn = StaticRNN(name=name)
+        self._in_block = False
+        self._mask = None          # inner [B, 1] mask for this step
+        self._seq_len = None
+        self._outer_inputs = []    # original [B, T, ...] vars
+        self._outputs = []
+
+    @contextlib.contextmanager
+    def block(self):
+        with self._rnn.step():
+            self._in_block = True
+            yield
+            self._in_block = False
+
+    @contextlib.contextmanager
+    def _parent_block(self):
+        """Build ops in the RNN's parent block (the scan's outer inputs must
+        be produced outside the sub-block, cf. StaticRNN.memory)."""
+        program = self._rnn.helper.main_program
+        cur = program.current_block_idx
+        program.current_block_idx = self._rnn._parent.idx
+        try:
+            yield
+        finally:
+            program.current_block_idx = cur
+
+    def step_input(self, x, level=0, seq_len=None):
+        """x [B, T, ...] batch-major (the reference takes a LoD tensor);
+        `seq_len` [B] activates masking (first call wins)."""
+        from . import nn as _nn
+
+        if not self._in_block:
+            raise ValueError("step_input must be called inside block()")
+        # StaticRNN scans time-major
+        perm = [1, 0] + list(range(2, len(x.shape)))
+        with self._parent_block():
+            xt = _nn.transpose(x, perm)
+        inner = self._rnn.step_input(xt)
+        if seq_len is not None and self._mask is None:
+            self._seq_len = seq_len
+            T = x.shape[1]
+            from .sequence_lod import sequence_mask
+
+            with self._parent_block():
+                m = sequence_mask(seq_len, maxlen=T, dtype=x.dtype)  # [B, T]
+                mt = _nn.transpose(m, [1, 0])                        # [T, B]
+                mt = _nn.reshape(mt, [T, -1, 1])                     # [T, B, 1]
+            self._mask = self._rnn.step_input(mt)                    # [B, 1]
+        self._outer_inputs.append(x)
+        return inner
+
+    def static_input(self, x):
+        """Non-stepped input; captured automatically by the scan body."""
+        return x
+
+    def memory(self, init=None, shape=None, value=0.0, need_reorder=False,
+               dtype="float32"):
+        if not self._in_block:
+            raise ValueError("memory must be called inside block()")
+        if init is not None:
+            return self._rnn.memory(init=init)
+        if not self._outer_inputs:
+            raise ValueError("call step_input before memory(shape=...)")
+        return self._rnn.memory(shape=list(shape), batch_ref=self._outer_inputs[0],
+                                init_value=value, ref_batch_dim_idx=0,
+                                dtype=dtype)
+
+    def _apply_mask(self, x, mask):
+        """x*mask broadcasting [B,1] over [B,...] (fluid axis=0 semantics)."""
+        from . import nn as _nn
+
+        return _nn.elementwise_mul(x, mask, axis=0)
+
+    def update_memory(self, ex_mem, new_mem):
+        if self._mask is not None:
+            # freeze finished rows: new = new*m + old*(1-m)
+            new_mem = self._apply_mask(new_mem, self._mask) + \
+                self._apply_mask(ex_mem, 1.0 - self._mask)
+        self._rnn.update_memory(ex_mem, new_mem)
+
+    def output(self, *outputs):
+        for o in outputs:
+            if self._mask is not None:
+                o = self._apply_mask(o, self._mask)
+            self._rnn.step_output(o)
+            self._outputs.append(o)
+
+    def __call__(self):
+        from . import nn as _nn
+
+        rnn_outs = self._rnn()
+        if not isinstance(rnn_outs, (list, tuple)):
+            rnn_outs = [rnn_outs]
+        outs = []
+        for ov in rnn_outs:
+            # back to batch-major [B, T, ...]
+            perm = [1, 0] + list(range(2, len(ov.shape or (0, 0))))
+            outs.append(_nn.transpose(ov, perm))
+        return outs

@@ -1,0 +1,50 @@
+"""Build helpers for the C API library and the standalone C++ demo trainer
+(parity: cmake/generic.cmake cc_library/cc_binary for c_api.cc +
+train/demo/CMakeLists)."""
+
+import os
+import subprocess
+import sysconfig
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(os.path.dirname(_HERE))
+_CAPI_SRC = os.path.join(_HERE, "csrc_capi", "paddle_tpu_c.cc")
+_CAPI_LIB = os.path.join(_HERE, "_libpaddle_tpu_c.so")
+
+
+def _py_flags():
+    inc = sysconfig.get_path("include")
+    libdir = sysconfig.get_config_var("LIBDIR")
+    ver = sysconfig.get_config_var("LDVERSION") or sysconfig.get_config_var(
+        "VERSION")
+    return ["-I" + inc], ["-L" + libdir, "-Wl,-rpath," + libdir,
+                          "-lpython" + ver, "-ldl", "-lm"]
+
+
+def build_capi(force=False):
+    """Compile native/csrc_capi/paddle_tpu_c.cc -> _libpaddle_tpu_c.so."""
+    if not force and os.path.exists(_CAPI_LIB) and (
+            os.path.getmtime(_CAPI_LIB) >= os.path.getmtime(_CAPI_SRC)):
+        return _CAPI_LIB
+    cflags, ldflags = _py_flags()
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           *cflags, _CAPI_SRC, "-o", _CAPI_LIB + ".tmp", *ldflags]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(_CAPI_LIB + ".tmp", _CAPI_LIB)
+    return _CAPI_LIB
+
+
+def build_demo_trainer(out_path=None, force=False):
+    """Compile tools/demo_trainer.cc linking the C API library."""
+    lib = build_capi(force=force)
+    src = os.path.join(_REPO, "tools", "demo_trainer.cc")
+    out = out_path or os.path.join(_HERE, "_demo_trainer")
+    if not force and os.path.exists(out) and (
+            os.path.getmtime(out) >= max(os.path.getmtime(src),
+                                         os.path.getmtime(lib))):
+        return out
+    cmd = ["g++", "-O2", "-std=c++17", src, lib,
+           "-Wl,-rpath," + os.path.dirname(lib), "-o", out + ".tmp"]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(out + ".tmp", out)
+    return out

@@ -1,0 +1,213 @@
+// C API for paddle_tpu (parity: paddle/fluid/framework/c/c_api.cc op-info
+// query + inference/capi/ predictor C bindings + train/demo C++ training).
+//
+// Design: the compute substrate is XLA/PJRT reached through the Python
+// runtime, so this library embeds CPython and marshals C buffers to
+// paddle_tpu.capi_host.  Everything exported here is plain C ABI — usable
+// from C, C++, Rust-ffi, dlopen, etc.
+//
+// Build: native/capi.py::build_capi() (g++ + python3-config --embed flags).
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+std::once_flag g_init_once;
+PyObject* g_host = nullptr;  // paddle_tpu.capi_host module
+
+void EnsureInit(const char* repo_root) {
+  std::call_once(g_init_once, [repo_root] {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+    }
+    PyGILState_STATE s = PyGILState_Ensure();
+    if (repo_root && *repo_root) {
+      PyObject* sys_path = PySys_GetObject("path");  // borrowed
+      PyObject* p = PyUnicode_FromString(repo_root);
+      PyList_Insert(sys_path, 0, p);
+      Py_DECREF(p);
+    }
+    g_host = PyImport_ImportModule("paddle_tpu.capi_host");
+    if (!g_host) PyErr_Print();
+    PyGILState_Release(s);
+    // Hand the GIL to whichever thread calls next.
+    if (PyGILState_Check()) {
+      PyEval_SaveThread();
+    }
+  });
+}
+
+// Call host.fn(args...) -> new ref (nullptr on error, with error printed)
+PyObject* Call(const char* fn, PyObject* args) {
+  if (!g_host) {  // PT_Init not called, or the host module failed to import
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject* f = PyObject_GetAttrString(g_host, fn);
+  if (!f) {
+    PyErr_Print();
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject* r = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  Py_XDECREF(args);
+  if (!r) PyErr_Print();
+  return r;
+}
+
+// NB: args must be built while holding the GIL — these helpers take a
+// format string + varargs and do Py_VaBuildValue inside Ensure/Release.
+int64_t CallI64(const char* fn, const char* fmt, ...) {
+  PyGILState_STATE s = PyGILState_Ensure();
+  va_list va;
+  va_start(va, fmt);
+  PyObject* args = fmt ? Py_VaBuildValue(fmt, va) : PyTuple_New(0);
+  va_end(va);
+  PyObject* r = Call(fn, args);
+  int64_t out = r ? PyLong_AsLongLong(r) : -1;
+  Py_XDECREF(r);
+  PyGILState_Release(s);
+  return out;
+}
+
+double CallF64(const char* fn, const char* fmt, ...) {
+  PyGILState_STATE s = PyGILState_Ensure();
+  va_list va;
+  va_start(va, fmt);
+  PyObject* args = fmt ? Py_VaBuildValue(fmt, va) : PyTuple_New(0);
+  va_end(va);
+  PyObject* r = Call(fn, args);
+  // NAN on failure: a poisoned value can't satisfy accuracy checks the way
+  // a numeric sentinel could
+  double out = r ? PyFloat_AsDouble(r) : std::nan("");
+  Py_XDECREF(r);
+  PyGILState_Release(s);
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Initialize the runtime. repo_root: directory containing paddle_tpu/
+// (may be "" if already importable). Safe to call multiple times.
+void PT_Init(const char* repo_root) { EnsureInit(repo_root); }
+
+// -- op registry query --------------------------------------------------------
+
+int64_t PT_NumOps() { return CallI64("num_ops", nullptr); }
+
+// Write newline-separated op names into buf (truncated to buf_len).
+// Returns the untruncated length.
+int64_t PT_OpNames(char* buf, int64_t buf_len) {
+  PyGILState_STATE s = PyGILState_Ensure();
+  PyObject* r = Call("op_names", PyTuple_New(0));
+  int64_t full = -1;
+  if (r) {
+    Py_ssize_t n = 0;
+    const char* str = PyUnicode_AsUTF8AndSize(r, &n);
+    full = static_cast<int64_t>(n);
+    if (buf && buf_len > 0) {
+      int64_t c = full < buf_len - 1 ? full : buf_len - 1;
+      std::memcpy(buf, str, static_cast<size_t>(c));
+      buf[c] = '\0';
+    }
+    Py_DECREF(r);
+  }
+  PyGILState_Release(s);
+  return full;
+}
+
+// -- trainer ------------------------------------------------------------------
+
+// place: "cpu" or "tpu". Returns handle > 0, or <= 0 on failure.
+int64_t PT_TrainerCreate(const char* model_dir, const char* place) {
+  return CallI64("trainer_create", "(ss)", model_dir, place);
+}
+
+// dtype: "float32" | "float64" | "int32" | "int64"
+int PT_Feed(int64_t handle, const char* name, const void* data,
+            const char* dtype, const int64_t* dims, int ndim) {
+  PyGILState_STATE s = PyGILState_Ensure();
+  int64_t elems = 1;
+  for (int i = 0; i < ndim; ++i) elems *= dims[i];
+  int64_t esize = (std::strcmp(dtype, "float64") == 0 ||
+                   std::strcmp(dtype, "int64") == 0)
+                      ? 8
+                      : 4;
+  PyObject* dims_list = PyList_New(ndim);
+  for (int i = 0; i < ndim; ++i) {
+    PyList_SetItem(dims_list, i, PyLong_FromLongLong(dims[i]));
+  }
+  PyObject* args = Py_BuildValue(
+      "(Lsy#sN)", static_cast<long long>(handle), name,
+      static_cast<const char*>(data),
+      static_cast<Py_ssize_t>(elems * esize), dtype, dims_list);
+  PyObject* r = Call("feed_buffer", args);
+  int ok = r ? 0 : -1;
+  Py_XDECREF(r);
+  PyGILState_Release(s);
+  return ok;
+}
+
+// Run one training step; returns the first fetch (the loss) as double.
+double PT_TrainerStep(int64_t handle) {
+  return CallF64("trainer_step", "(L)", static_cast<long long>(handle));
+}
+
+// -- predictor ----------------------------------------------------------------
+
+int64_t PT_PredictorCreate(const char* model_dir, const char* place) {
+  return CallI64("predictor_create", "(ss)", model_dir, place);
+}
+
+// Returns number of outputs, or -1.
+int64_t PT_PredictorRun(int64_t handle) {
+  return CallI64("predictor_run", "(L)", static_cast<long long>(handle));
+}
+
+int64_t PT_OutputNdim(int64_t handle, int64_t i) {
+  return CallI64("output_ndim", "(LL)", static_cast<long long>(handle),
+                 static_cast<long long>(i));
+}
+
+int64_t PT_OutputDim(int64_t handle, int64_t i, int64_t d) {
+  return CallI64("output_dim", "(LLL)", static_cast<long long>(handle),
+                 static_cast<long long>(i), static_cast<long long>(d));
+}
+
+// Copy output i (as float32) into buf; returns number of bytes copied.
+int64_t PT_OutputCopy(int64_t handle, int64_t i, void* buf, int64_t buf_len) {
+  PyGILState_STATE s = PyGILState_Ensure();
+  PyObject* r = Call("output_bytes",
+                     Py_BuildValue("(LL)", static_cast<long long>(handle),
+                                   static_cast<long long>(i)));
+  int64_t copied = -1;
+  if (r) {
+    char* bytes = nullptr;
+    Py_ssize_t n = 0;
+    if (PyBytes_AsStringAndSize(r, &bytes, &n) == 0) {
+      copied = n < buf_len ? n : buf_len;
+      std::memcpy(buf, bytes, static_cast<size_t>(copied));
+    }
+    Py_DECREF(r);
+  }
+  PyGILState_Release(s);
+  return copied;
+}
+
+int PT_Destroy(int64_t handle) {
+  return static_cast<int>(
+      CallI64("destroy", "(L)", static_cast<long long>(handle)));
+}
+
+}  // extern "C"

@@ -24,3 +24,4 @@ from . import crf  # noqa: F401
 from . import sampled  # noqa: F401
 from . import quant  # noqa: F401
 from . import misc3  # noqa: F401
+from . import detection2  # noqa: F401

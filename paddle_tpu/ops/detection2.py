@@ -1,0 +1,1215 @@
+"""Detection op long tail (parity: paddle/fluid/operators/detection/ and the
+deformable/psroi family under operators/).
+
+Static-shape XLA designs (same conventions as ops/detection.py): ragged
+LoDTensor outputs become fixed-size padded tensors (-1 or zero padding plus
+weight/mask outputs); the reference's `use_random` subsampling becomes
+deterministic highest-priority sampling so programs stay replayable under jit
+(documented per op).
+
+Covered here: polygon_box_transform, yolov3_loss, psroi_pool, prroi_pool,
+roi_perspective_transform, deformable_conv (v1+v2), deformable_roi_pooling,
+generate_proposals, rpn_target_assign, retinanet_target_assign,
+retinanet_detection_output, locality_aware_nms, distribute_fpn_proposals,
+collect_fpn_proposals, box_decoder_and_assign, generate_proposal_labels,
+generate_mask_labels, similarity_focus, filter_by_instag, cvm.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+from .detection import _iou
+
+
+# -- small ones --------------------------------------------------------------
+
+
+@register_op("polygon_box_transform", inputs=("Input",), outputs=("Output",),
+             grad_maker=None)
+def polygon_box_transform(ctx, x):
+    """EAST text geo-map decode (polygon_box_transform_op.cc:38-51):
+    even channels: out = 4*w_idx - in; odd: out = 4*h_idx - in."""
+    N, G, H, W = x.shape
+    wi = jnp.arange(W, dtype=x.dtype).reshape(1, 1, 1, W)
+    hi = jnp.arange(H, dtype=x.dtype).reshape(1, 1, H, 1)
+    even = (jnp.arange(G) % 2 == 0).reshape(1, G, 1, 1)
+    return jnp.where(even, 4.0 * wi - x, 4.0 * hi - x)
+
+
+@register_op("cvm", inputs=("X", "CVM"), outputs=("Y",),
+             attrs={"use_cvm": True}, no_grad_inputs=("CVM",))
+def cvm(ctx, x, cvm_in, use_cvm=True):
+    """Continuous-value model op (cvm_op.h:30-40): x rows start with
+    [show, click, ...]; use_cvm keeps width and rewrites the two lead
+    columns to log(show+1), log(click+1)-log(show+1); else drops them."""
+    if use_cvm:
+        c0 = jnp.log(x[:, :1] + 1)
+        c1 = jnp.log(x[:, 1:2] + 1) - c0
+        return jnp.concatenate([c0, c1, x[:, 2:]], axis=1)
+    return x[:, 2:]
+
+
+@register_op("similarity_focus", inputs=("X",), outputs=("Out",),
+             attrs={"axis": 1, "indexes": []}, grad_maker=None)
+def similarity_focus(ctx, x, axis=1, indexes=()):
+    """similarity_focus_op.cc: for each selected slice along `axis`, greedily
+    mark per-(rest-dims) maxima: walking the sorted values of the slice, a
+    cell is selected if its row and column were not yet covered; selected
+    cells get 1.0 in every channel.  Vectorized equivalence: a cell (i,j) of
+    the [A,B] slice is kept iff its value is the max of row i AND of col j
+    after removing earlier-chosen rows/cols — the greedy fixed point equals
+    iteratively pairing the global argmax; we implement the exact greedy with
+    a fori_loop over min(A,B) steps."""
+    if x.ndim != 4:
+        raise NotImplementedError("similarity_focus expects rank-4 input")
+    if axis not in (1, 2, 3):
+        raise ValueError("axis must be 1, 2 or 3")
+    N = x.shape[0]
+    out = jnp.zeros_like(x)
+
+    # move `axis` to position 1 -> slices [N, K, A, B]
+    perm = {1: (0, 1, 2, 3), 2: (0, 2, 1, 3), 3: (0, 3, 1, 2)}[axis]
+    xt = jnp.transpose(x, perm)
+    A, B = xt.shape[2], xt.shape[3]
+    steps = min(A, B)
+
+    def one_slice(sl):  # [A, B] -> mask [A, B]
+        def body(_, carry):
+            mask, rowf, colf = carry
+            masked = jnp.where(rowf[:, None] | colf[None, :], -jnp.inf, sl)
+            idx = jnp.argmax(masked)
+            i, j = idx // B, idx % B
+            ok = masked.reshape(-1)[idx] != -jnp.inf
+            mask = jnp.where(ok, mask.at[i, j].set(1.0), mask)
+            rowf = jnp.where(ok, rowf.at[i].set(True), rowf)
+            colf = jnp.where(ok, colf.at[j].set(True), colf)
+            return mask, rowf, colf
+
+        m, _, _ = lax.fori_loop(
+            0, steps, body,
+            (jnp.zeros_like(sl), jnp.zeros(A, bool), jnp.zeros(B, bool)))
+        return m
+
+    sel = xt[:, jnp.asarray(list(indexes), jnp.int32)]  # [N, S, A, B]
+    masks = jax.vmap(jax.vmap(one_slice))(sel)          # [N, S, A, B]
+    merged = jnp.max(masks, axis=1)                     # [N, A, B]
+    # broadcast selection across the focused axis
+    inv = {1: (0, 1, 2, 3), 2: (0, 2, 1, 3), 3: (0, 2, 3, 1)}[axis]
+    full = jnp.broadcast_to(merged[:, None], xt.shape)
+    return jnp.transpose(full, inv).astype(x.dtype)
+
+
+@register_op("filter_by_instag", inputs=("Ins", "Ins_tag", "Filter_tag"),
+             outputs=("Out", "LossWeight", "IndexMap"),
+             attrs={"is_lod": True}, grad_maker=None)
+def filter_by_instag(ctx, ins, ins_tag, filter_tag, is_lod=True):
+    """filter_by_instag_op.cc, static-shape variant: instead of compacting
+    matching rows (ragged), keep all rows and zero out non-matching ones;
+    LossWeight is the 0/1 match mask, IndexMap maps row -> row."""
+    match = jnp.isin(ins_tag.reshape(-1), filter_tag.reshape(-1))
+    w = match.astype(ins.dtype)
+    out = ins * w.reshape((-1,) + (1,) * (ins.ndim - 1))
+    n = ins.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int64)
+    return out, w.reshape(-1, 1), jnp.stack([idx, idx], axis=1)
+
+
+# -- yolov3 loss --------------------------------------------------------------
+
+
+def _bce(x, t):
+    return jnp.maximum(x, 0) - x * t + jnp.log1p(jnp.exp(-jnp.abs(x)))
+
+
+def _box_iou_cw(b1, b2):
+    """IoU of center-format boxes; b1 [..., 4], b2 [..., 4]."""
+    ox = jnp.minimum(b1[..., 0] + b1[..., 2] / 2, b2[..., 0] + b2[..., 2] / 2) \
+        - jnp.maximum(b1[..., 0] - b1[..., 2] / 2, b2[..., 0] - b2[..., 2] / 2)
+    oy = jnp.minimum(b1[..., 1] + b1[..., 3] / 2, b2[..., 1] + b2[..., 3] / 2) \
+        - jnp.maximum(b1[..., 1] - b1[..., 3] / 2, b2[..., 1] - b2[..., 3] / 2)
+    inter = jnp.where((ox < 0) | (oy < 0), 0.0, ox * oy)
+    union = b1[..., 2] * b1[..., 3] + b2[..., 2] * b2[..., 3] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+@register_op("yolov3_loss", inputs=("X", "GTBox", "GTLabel", "GTScore"),
+             outputs=("Loss", "ObjectnessMask", "GTMatchMask"),
+             attrs={"anchors": [], "anchor_mask": [], "class_num": 1,
+                    "ignore_thresh": 0.7, "downsample_ratio": 32,
+                    "use_label_smooth": True},
+             optional_inputs=("GTScore",),
+             no_grad_inputs=("GTBox", "GTLabel", "GTScore"))
+def yolov3_loss(ctx, x, gt_box, gt_label, gt_score=None, anchors=(),
+                anchor_mask=(), class_num=1, ignore_thresh=0.7,
+                downsample_ratio=32, use_label_smooth=True):
+    """YOLOv3 loss (yolov3_loss_op.h:255-420), vectorized: x
+    [N, mask*(5+C), H, W]; gt_box [N, B, 4] center-normalized; outputs
+    per-image Loss [N], ObjectnessMask [N, mask, H, W] (-1 ignored /
+    score positive / 0 negative), GTMatchMask [N, B]."""
+    anchors = [int(a) for a in anchors]
+    anchor_mask = [int(a) for a in anchor_mask]
+    N, _, H, W = x.shape
+    mask_num = len(anchor_mask)
+    an_num = len(anchors) // 2
+    B = gt_box.shape[1]
+    input_size = downsample_ratio * H
+    C = class_num
+
+    xr = x.reshape(N, mask_num, 5 + C, H, W)
+    tx, ty, tw, th, tobj = (xr[:, :, 0], xr[:, :, 1], xr[:, :, 2],
+                            xr[:, :, 3], xr[:, :, 4])
+    tcls = xr[:, :, 5:]  # [N, mask, C, H, W]
+
+    if gt_score is None:
+        gt_score = jnp.ones((N, B), x.dtype)
+    else:
+        gt_score = gt_score.reshape(N, B)
+
+    gt_valid = (gt_box[..., 2] > 1e-6) & (gt_box[..., 3] > 1e-6)  # [N,B]
+
+    # -- predicted boxes per cell/anchor (normalized center format)
+    gi = jnp.arange(W, dtype=x.dtype).reshape(1, 1, 1, W)
+    gj = jnp.arange(H, dtype=x.dtype).reshape(1, 1, H, 1)
+    aw = jnp.asarray([anchors[2 * m] for m in anchor_mask],
+                     x.dtype).reshape(1, mask_num, 1, 1)
+    ah = jnp.asarray([anchors[2 * m + 1] for m in anchor_mask],
+                     x.dtype).reshape(1, mask_num, 1, 1)
+    px = (gi + jax.nn.sigmoid(tx)) / W
+    py = (gj + jax.nn.sigmoid(ty)) / H
+    pw = jnp.exp(tw) * aw / input_size
+    ph = jnp.exp(th) * ah / input_size
+    pred = jnp.stack([px, py, pw, ph], axis=-1)  # [N,mask,H,W,4]
+
+    # best IoU of each predicted box vs any valid gt -> ignore mask
+    iou_all = _box_iou_cw(pred[:, :, :, :, None, :],
+                          gt_box[:, None, None, None, :, :])  # [N,m,H,W,B]
+    iou_all = jnp.where(gt_valid[:, None, None, None, :], iou_all, 0.0)
+    best_iou = jnp.max(iou_all, axis=-1) if B else jnp.zeros_like(px)
+    obj_mask = jnp.where(best_iou > ignore_thresh, -1.0, 0.0)  # [N,m,H,W]
+
+    # -- per-gt best anchor over the FULL anchor set (shape-only IoU)
+    an_w = jnp.asarray(anchors[0::2], x.dtype) / input_size  # [A]
+    an_h = jnp.asarray(anchors[1::2], x.dtype) / input_size
+    shape_boxes = jnp.stack([jnp.zeros_like(an_w), jnp.zeros_like(an_w),
+                             an_w, an_h], axis=-1)           # [A,4]
+    gt_shift = gt_box.at[..., 0].set(0.0).at[..., 1].set(0.0)  # [N,B,4]
+    iou_an = _box_iou_cw(gt_shift[:, :, None, :],
+                         shape_boxes[None, None, :, :])      # [N,B,A]
+    best_n = jnp.argmax(iou_an, axis=-1)                     # [N,B]
+    # map anchor index -> mask slot (-1 when not in anchor_mask)
+    lut = -jnp.ones((an_num,), jnp.int32)
+    for slot, m in enumerate(anchor_mask):
+        lut = lut.at[m].set(slot)
+    match_slot = jnp.where(gt_valid, lut[best_n], -1)        # [N,B]
+
+    g_i = jnp.clip((gt_box[..., 0] * W).astype(jnp.int32), 0, W - 1)
+    g_j = jnp.clip((gt_box[..., 1] * H).astype(jnp.int32), 0, H - 1)
+    pos = match_slot >= 0                                    # [N,B]
+    slot_safe = jnp.maximum(match_slot, 0)
+
+    # scatter positive-sample scores into the objectness mask
+    bidx = jnp.broadcast_to(jnp.arange(N)[:, None], (N, B))
+    obj_mask = obj_mask.at[bidx, slot_safe, g_j, g_i].set(
+        jnp.where(pos, gt_score, obj_mask[bidx, slot_safe, g_j, g_i]),
+        mode="drop")
+
+    # -- objectness loss over all cells
+    obj_pred = tobj  # [N,m,H,W]
+    pos_l = _bce(obj_pred, 1.0) * jnp.maximum(obj_mask, 0.0)
+    neg_l = jnp.where(obj_mask == 0.0, _bce(obj_pred, 0.0), 0.0)
+    loss = jnp.sum(jnp.where(obj_mask > 1e-5, pos_l, neg_l), axis=(1, 2, 3))
+
+    # -- location + class loss at matched cells (gather per gt)
+    bx = gt_box[..., 0] * W - g_i.astype(x.dtype)
+    by = gt_box[..., 1] * H - g_j.astype(x.dtype)
+    aw_full = jnp.asarray(anchors[0::2], x.dtype)
+    ah_full = jnp.asarray(anchors[1::2], x.dtype)
+    bw = jnp.log(jnp.maximum(gt_box[..., 2] * input_size, 1e-9)
+                 / aw_full[best_n])
+    bh = jnp.log(jnp.maximum(gt_box[..., 3] * input_size, 1e-9)
+                 / ah_full[best_n])
+    scale = (2.0 - gt_box[..., 2] * gt_box[..., 3]) * gt_score  # [N,B]
+
+    ptx = tx[bidx, slot_safe, g_j, g_i]
+    pty = ty[bidx, slot_safe, g_j, g_i]
+    ptw = tw[bidx, slot_safe, g_j, g_i]
+    pth = th[bidx, slot_safe, g_j, g_i]
+    loc = (_bce(ptx, bx) + _bce(pty, by)
+           + jnp.abs(ptw - bw) + jnp.abs(pth - bh)) * scale
+    loss = loss + jnp.sum(jnp.where(pos, loc, 0.0), axis=1)
+
+    if use_label_smooth:
+        sm = min(1.0 / C, 1.0 / 40.0)
+        lab_pos, lab_neg = 1.0 - sm, sm
+    else:
+        lab_pos, lab_neg = 1.0, 0.0
+    pcls = tcls[bidx, slot_safe, :, g_j, g_i]                # [N,B,C]
+    onehot = jax.nn.one_hot(gt_label.reshape(N, B), C, dtype=x.dtype)
+    tgt = onehot * lab_pos + (1 - onehot) * lab_neg
+    cls_l = jnp.sum(_bce(pcls, tgt), axis=-1) * gt_score
+    loss = loss + jnp.sum(jnp.where(pos, cls_l, 0.0), axis=1)
+
+    return (loss, lax.stop_gradient(obj_mask),
+            jnp.where(gt_valid, match_slot, -1).astype(jnp.int32))
+
+
+# -- RoI pooling family -------------------------------------------------------
+
+
+def _bilinear(img, y, x):
+    """img [C,H,W]; y,x [...] continuous coords -> [C, ...] samples
+    (zero outside)."""
+    C, H, W = img.shape
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    wy1, wx1 = y - y0, x - x0
+    vals = 0.0
+    for dy, wy in ((0, 1 - wy1), (1, wy1)):
+        for dx, wx in ((0, 1 - wx1), (1, wx1)):
+            yy = (y0 + dy).astype(jnp.int32)
+            xx = (x0 + dx).astype(jnp.int32)
+            ok = (yy >= 0) & (yy < H) & (xx >= 0) & (xx < W)
+            yy = jnp.clip(yy, 0, H - 1)
+            xx = jnp.clip(xx, 0, W - 1)
+            v = img[:, yy, xx]
+            vals = vals + v * (wy * wx * ok)[None]
+    return vals
+
+
+@register_op("psroi_pool", inputs=("X", "ROIs"), outputs=("Out",),
+             attrs={"output_channels": 1, "spatial_scale": 1.0,
+                    "pooled_height": 1, "pooled_width": 1},
+             no_grad_inputs=("ROIs",))
+def psroi_pool(ctx, x, rois, output_channels=1, spatial_scale=1.0,
+               pooled_height=1, pooled_width=1):
+    """Position-sensitive RoI average pooling (psroi_pool_op.h:25-140).
+    rois [R, 5] = (batch_idx, x1, y1, x2, y2) — batch index in column 0
+    replaces the reference's LoD row partition."""
+    N, C, H, W = x.shape
+    ph_, pw_ = pooled_height, pooled_width
+    oc = output_channels
+    assert C == oc * ph_ * pw_, "C must equal output_channels*ph*pw"
+
+    def one(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1]) * spatial_scale
+        y1 = jnp.round(roi[2]) * spatial_scale
+        x2 = (jnp.round(roi[3]) + 1.0) * spatial_scale
+        y2 = (jnp.round(roi[4]) + 1.0) * spatial_scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bsh, bsw = rh / ph_, rw / pw_
+        img = x[b]  # [C,H,W]
+        out = jnp.zeros((oc, ph_, pw_), x.dtype)
+        for phi in range(ph_):
+            for pwi in range(pw_):
+                hs = jnp.clip(jnp.floor(phi * bsh + y1), 0, H).astype(jnp.int32)
+                he = jnp.clip(jnp.ceil((phi + 1) * bsh + y1), 0, H).astype(jnp.int32)
+                ws = jnp.clip(jnp.floor(pwi * bsw + x1), 0, W).astype(jnp.int32)
+                we = jnp.clip(jnp.ceil((pwi + 1) * bsw + x1), 0, W).astype(jnp.int32)
+                hm = (jnp.arange(H) >= hs) & (jnp.arange(H) < he)
+                wm = (jnp.arange(W) >= ws) & (jnp.arange(W) < we)
+                m = hm[:, None] & wm[None, :]
+                cnt = jnp.maximum(jnp.sum(m), 1)
+                ch = jnp.arange(oc) * ph_ * pw_ + phi * pw_ + pwi
+                plane = img[ch]  # [oc,H,W]
+                s = jnp.sum(jnp.where(m[None], plane, 0.0), axis=(1, 2))
+                empty = (he <= hs) | (we <= ws)
+                out = out.at[:, phi, pwi].set(
+                    jnp.where(empty, 0.0, s / cnt))
+        return out
+
+    return jax.vmap(one)(rois)
+
+
+def _hat_integral(lo, hi, n):
+    """∫_{lo}^{hi} max(0, 1-|t-p|) dt for p = 0..n-1, vectorized -> [n]."""
+    p = jnp.arange(n, dtype=lo.dtype)
+
+    def F(t):
+        # antiderivative of hat centered at p, F(p-1)=0, F(p+1)=1
+        u = jnp.clip(t - (p - 1.0), 0.0, 2.0)
+        return jnp.where(u <= 1.0, 0.5 * u * u, 1.0 - 0.5 * (2.0 - u) ** 2)
+
+    return F(hi) - F(lo)
+
+
+@register_op("prroi_pool", inputs=("X", "ROIs"), outputs=("Out",),
+             attrs={"spatial_scale": 1.0, "pooled_height": 1,
+                    "pooled_width": 1})
+def prroi_pool(ctx, x, rois, spatial_scale=1.0, pooled_height=1,
+               pooled_width=1, output_channels=None):
+    """Precise RoI pooling (prroi_pool_op.h, arXiv:1807.11590): the exact
+    integral of the bilinearly-interpolated feature over each bin — the
+    2-D integral factorizes into per-axis hat-function integrals, so each
+    bin value is wy^T F wx / area.  Fully differentiable (incl. rois)."""
+    N, C, H, W = x.shape
+    ph_, pw_ = pooled_height, pooled_width
+
+    def one(roi):
+        b = roi[0].astype(jnp.int32)
+        x1, y1 = roi[1] * spatial_scale, roi[2] * spatial_scale
+        x2, y2 = roi[3] * spatial_scale, roi[4] * spatial_scale
+        rw = jnp.maximum(x2 - x1, 0.0)
+        rh = jnp.maximum(y2 - y1, 0.0)
+        bw, bh = rw / pw_, rh / ph_
+        img = x[b]
+
+        def bin_val(phi, pwi):
+            wx = _hat_integral(x1 + pwi * bw, x1 + (pwi + 1) * bw, W)
+            wy = _hat_integral(y1 + phi * bh, y1 + (phi + 1) * bh, H)
+            area = jnp.maximum(bw * bh, 1e-9)
+            return jnp.einsum("h,chw,w->c", wy, img, wx) / area
+
+        rows = [jnp.stack([bin_val(i, j) for j in range(pw_)], -1)
+                for i in range(ph_)]
+        return jnp.stack(rows, -2)  # [C, ph, pw]
+
+    return jax.vmap(one)(rois)
+
+
+@register_op("roi_perspective_transform", inputs=("X", "ROIs"),
+             outputs=("Out", "Mask", "TransformMatrix",
+                      "Out2InIdx", "Out2InWeights"),
+             attrs={"transformed_height": 1, "transformed_width": 1,
+                    "spatial_scale": 1.0},
+             no_grad_inputs=("ROIs",))
+def roi_perspective_transform(ctx, x, rois, transformed_height=1,
+                              transformed_width=1, spatial_scale=1.0):
+    """Perspective-warp quadrilateral rois to a fixed grid
+    (roi_perspective_transform_op.cc): rois [R, 9] = (batch_idx, 8 corner
+    coords x1..y4 clockwise from top-left); output [R, C, th, tw]."""
+    N, C, H, W = x.shape
+    th_, tw_ = transformed_height, transformed_width
+
+    def transform_matrix(q):
+        # q: 8 coords scaled; solve the homography mapping the output grid
+        # corners (0,0),(tw-1,0),(tw-1,th-1),(0,th-1) to the quad
+        x1, y1, x2, y2, x3, y3, x4, y4 = [q[i] for i in range(8)]
+        dst = jnp.asarray([[0.0, 0.0], [tw_ - 1.0, 0.0],
+                           [tw_ - 1.0, th_ - 1.0], [0.0, th_ - 1.0]],
+                          q.dtype)
+        src = jnp.stack([jnp.stack([x1, y1]), jnp.stack([x2, y2]),
+                         jnp.stack([x3, y3]), jnp.stack([x4, y4])])
+        rows = []
+        rhs = []
+        for k in range(4):
+            X, Y = dst[k, 0], dst[k, 1]
+            u, v = src[k, 0], src[k, 1]
+            rows.append(jnp.stack([X, Y, jnp.ones_like(X),
+                                   jnp.zeros_like(X), jnp.zeros_like(X),
+                                   jnp.zeros_like(X), -X * u, -Y * u]))
+            rhs.append(u)
+            rows.append(jnp.stack([jnp.zeros_like(X), jnp.zeros_like(X),
+                                   jnp.zeros_like(X), X, Y,
+                                   jnp.ones_like(X), -X * v, -Y * v]))
+            rhs.append(v)
+        A = jnp.stack(rows)
+        bv = jnp.stack(rhs)
+        h = jnp.linalg.solve(A, bv)
+        return jnp.concatenate([h, jnp.ones((1,), q.dtype)])
+
+    def one(roi):
+        b = roi[0].astype(jnp.int32)
+        quad = roi[1:] * spatial_scale
+        hmat = transform_matrix(quad)
+        Hm = hmat.reshape(3, 3)
+        gy, gx = jnp.meshgrid(jnp.arange(th_, dtype=x.dtype),
+                              jnp.arange(tw_, dtype=x.dtype), indexing="ij")
+        ones = jnp.ones_like(gx)
+        pts = jnp.stack([gx, gy, ones], axis=0).reshape(3, -1)
+        mapped = Hm @ pts
+        u = mapped[0] / mapped[2]
+        v = mapped[1] / mapped[2]
+        inside = (u >= -0.5) & (u < W - 0.5) & (v >= -0.5) & (v < H - 0.5)
+        samples = _bilinear(x[b], v, u)  # [C, th*tw]
+        out = (samples * inside[None]).reshape(C, th_, tw_)
+        return out, inside.reshape(th_, tw_).astype(jnp.int32), hmat
+
+    outs, masks, mats = jax.vmap(one)(rois)
+    R = rois.shape[0]
+    dummy_idx = jnp.zeros((R, 4), jnp.int32)
+    dummy_w = jnp.zeros((R, 4), x.dtype)
+    return outs, masks[:, None], mats, dummy_idx, dummy_w
+
+
+# -- deformable ---------------------------------------------------------------
+
+
+@register_op("deformable_conv", inputs=("Input", "Offset", "Mask", "Filter"),
+             outputs=("Output",),
+             attrs={"strides": [1, 1], "paddings": [0, 0],
+                    "dilations": [1, 1], "groups": 1,
+                    "deformable_groups": 1, "im2col_step": 64})
+def deformable_conv(ctx, x, offset, mask, w, strides=(1, 1), paddings=(0, 0),
+                    dilations=(1, 1), groups=1, deformable_groups=1,
+                    im2col_step=64):
+    """Modulated deformable conv v2 (deformable_conv_op.h; arXiv:1811.11168).
+    x [N,C,H,W]; offset [N, 2*dg*kh*kw, OH, OW] (y,x interleaved per kernel
+    point, reference layout); mask [N, dg*kh*kw, OH, OW]; w [O, C/g, kh, kw].
+    Implemented as bilinear gather -> grouped einsum (im2col_step is a CUDA
+    tiling knob — XLA handles tiling)."""
+    return _deform_conv_impl(x, offset, mask, w, strides, paddings,
+                             dilations, groups, deformable_groups)
+
+
+@register_op("deformable_conv_v1", inputs=("Input", "Offset", "Filter"),
+             outputs=("Output",),
+             attrs={"strides": [1, 1], "paddings": [0, 0],
+                    "dilations": [1, 1], "groups": 1,
+                    "deformable_groups": 1, "im2col_step": 64})
+def deformable_conv_v1(ctx, x, offset, w, strides=(1, 1), paddings=(0, 0),
+                       dilations=(1, 1), groups=1, deformable_groups=1,
+                       im2col_step=64):
+    return _deform_conv_impl(x, offset, None, w, strides, paddings,
+                             dilations, groups, deformable_groups)
+
+
+def _deform_conv_impl(x, offset, mask, w, strides, paddings, dilations,
+                      groups, dg):
+    N, C, H, W = x.shape
+    O, _, kh, kw = w.shape
+    sh, sw = int(strides[0]), int(strides[1])
+    ph, pw = int(paddings[0]), int(paddings[1])
+    dh, dw = int(dilations[0]), int(dilations[1])
+    OH = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    OW = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    K = kh * kw
+
+    oy, ox = jnp.meshgrid(jnp.arange(OH, dtype=x.dtype),
+                          jnp.arange(OW, dtype=x.dtype), indexing="ij")
+    ky, kx = jnp.meshgrid(jnp.arange(kh, dtype=x.dtype),
+                          jnp.arange(kw, dtype=x.dtype), indexing="ij")
+    base_y = oy[None] * sh - ph + ky.reshape(K, 1, 1) * dh  # [K,OH,OW]
+    base_x = ox[None] * sw - pw + kx.reshape(K, 1, 1) * dw
+
+    off = offset.reshape(N, dg, K, 2, OH, OW)
+    samp_y = base_y[None, None] + off[:, :, :, 0]  # [N,dg,K,OH,OW]
+    samp_x = base_x[None, None] + off[:, :, :, 1]
+    if mask is not None:
+        mk = mask.reshape(N, dg, K, OH, OW)
+    else:
+        mk = jnp.ones((N, dg, K, OH, OW), x.dtype)
+
+    cg = C // dg  # channels per deformable group
+
+    def per_image(img, sy, sx, m):
+        # img [C,H,W]; sy/sx/m [dg,K,OH,OW]
+        def per_dg(ch_img, dy, dx, dm):
+            # ch_img [cg,H,W]
+            v = _bilinear(ch_img, dy.reshape(-1), dx.reshape(-1))
+            v = v.reshape(cg, K, OH, OW) * dm[None]
+            return v
+
+        cols = jax.vmap(per_dg)(img.reshape(dg, cg, H, W), sy, sx, m)
+        return cols.reshape(C, K, OH, OW)
+
+    cols = jax.vmap(per_image)(x, samp_y, samp_x, mk)  # [N,C,K,OH,OW]
+
+    cpg = C // groups
+    opg = O // groups
+    cols_g = cols.reshape(N, groups, cpg, K, OH, OW)
+    w_g = w.reshape(groups, opg, cpg, K)
+    out = jnp.einsum("ngckhw,gock->ngohw", cols_g, w_g)
+    return out.reshape(N, O, OH, OW)
+
+
+@register_op("deformable_psroi_pooling",
+             inputs=("Input", "ROIs", "Trans"),
+             outputs=("Output", "TopCount"),
+             attrs={"no_trans": False, "spatial_scale": 1.0,
+                    "output_dim": 1, "group_size": [1], "pooled_height": 1,
+                    "pooled_width": 1, "part_size": [1], "sample_per_part": 4,
+                    "trans_std": 0.1},
+             optional_inputs=("Trans",), no_grad_inputs=("ROIs",))
+def deformable_psroi_pooling(ctx, x, rois, trans=None, no_trans=False,
+                             spatial_scale=1.0, output_dim=1, group_size=(1,),
+                             pooled_height=1, pooled_width=1, part_size=(1,),
+                             sample_per_part=4, trans_std=0.1):
+    """Deformable PS-RoI pooling (deformable_psroi_pooling_op.h): bins are
+    shifted by learned normalized offsets then average-pooled with
+    sample_per_part bilinear samples per axis."""
+    N, C, H, W = x.shape
+    ph_, pw_ = pooled_height, pooled_width
+    if isinstance(group_size, (list, tuple)):
+        gh_n = int(group_size[0])
+        gw_n = int(group_size[1]) if len(group_size) > 1 else gh_n
+    else:
+        gh_n = gw_n = int(group_size)
+    psz = part_size[0] if isinstance(part_size, (list, tuple)) else part_size
+    sp = sample_per_part
+    od = output_dim
+
+    def one(roi, tr):
+        b = roi[0].astype(jnp.int32)
+        x1 = roi[1] * spatial_scale - 0.5
+        y1 = roi[2] * spatial_scale - 0.5
+        x2 = (roi[3] + 1.0) * spatial_scale - 0.5
+        y2 = (roi[4] + 1.0) * spatial_scale - 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bh, bw = rh / ph_, rw / pw_
+        sub_h, sub_w = bh / sp, bw / sp
+        img = x[b]
+        out = jnp.zeros((od, ph_, pw_), x.dtype)
+        cnt = jnp.zeros((od, ph_, pw_), x.dtype)
+        for phi in range(ph_):
+            for pwi in range(pw_):
+                if no_trans or trans is None:
+                    off_y = jnp.zeros(())
+                    off_x = jnp.zeros(())
+                else:
+                    part_h = int(phi * psz / ph_)
+                    part_w = int(pwi * psz / pw_)
+                    off_y = tr[0, part_h, part_w] * trans_std * rh
+                    off_x = tr[1, part_h, part_w] * trans_std * rw
+                ys = y1 + phi * bh + off_y
+                xs = x1 + pwi * bw + off_x
+                sy = ys + (jnp.arange(sp, dtype=x.dtype) + 0.5) * sub_h
+                sx = xs + (jnp.arange(sp, dtype=x.dtype) + 0.5) * sub_w
+                gy, gx = jnp.meshgrid(sy, sx, indexing="ij")
+                gch = jnp.arange(od)
+                # position-sensitive channel: c = (ctop*gh_n + gh)*gw_n + gw
+                gh_idx = min(int(phi * gh_n / ph_), gh_n - 1)
+                gw_idx = min(int(pwi * gw_n / pw_), gw_n - 1)
+                ch = (gch * gh_n + gh_idx) * gw_n + gw_idx
+                v = _bilinear(img[ch], gy.reshape(-1), gx.reshape(-1))
+                ok = ((gy.reshape(-1) >= -0.5) & (gy.reshape(-1) < H - 0.5)
+                      & (gx.reshape(-1) >= -0.5) & (gx.reshape(-1) < W - 0.5))
+                s = jnp.sum(v * ok[None], axis=1)
+                c = jnp.maximum(jnp.sum(ok), 1).astype(x.dtype)
+                out = out.at[:, phi, pwi].set(s / c)
+                cnt = cnt.at[:, phi, pwi].set(c)
+        return out, cnt
+
+    if trans is None or no_trans:
+        tr_in = jnp.zeros((rois.shape[0], 2, 1, 1), x.dtype)
+    else:
+        tr_in = trans
+    outs, cnts = jax.vmap(one)(rois, tr_in)
+    return outs, lax.stop_gradient(cnts)
+
+
+# -- proposal generation / target assignment ---------------------------------
+
+
+def _decode_anchor(anchor, var, delta):
+    """bbox_util: anchors [A,4] corner fmt, deltas [A,4] -> decoded corners."""
+    aw = anchor[:, 2] - anchor[:, 0] + 1.0
+    ah = anchor[:, 3] - anchor[:, 1] + 1.0
+    acx = anchor[:, 0] + 0.5 * aw
+    acy = anchor[:, 1] + 0.5 * ah
+    dx, dy, dw, dh = (delta[:, 0] * var[:, 0], delta[:, 1] * var[:, 1],
+                      delta[:, 2] * var[:, 2], delta[:, 3] * var[:, 3])
+    cx = dx * aw + acx
+    cy = dy * ah + acy
+    w = jnp.exp(jnp.minimum(dw, 10.0)) * aw
+    h = jnp.exp(jnp.minimum(dh, 10.0)) * ah
+    return jnp.stack([cx - 0.5 * w, cy - 0.5 * h,
+                      cx + 0.5 * w - 1.0, cy + 0.5 * h - 1.0], axis=1)
+
+
+def _encode_anchor(anchor, gt, var=None):
+    aw = anchor[:, 2] - anchor[:, 0] + 1.0
+    ah = anchor[:, 3] - anchor[:, 1] + 1.0
+    acx = anchor[:, 0] + 0.5 * aw
+    acy = anchor[:, 1] + 0.5 * ah
+    gw = gt[:, 2] - gt[:, 0] + 1.0
+    gh = gt[:, 3] - gt[:, 1] + 1.0
+    gcx = gt[:, 0] + 0.5 * gw
+    gcy = gt[:, 1] + 0.5 * gh
+    t = jnp.stack([(gcx - acx) / aw, (gcy - acy) / ah,
+                   jnp.log(jnp.maximum(gw / aw, 1e-9)),
+                   jnp.log(jnp.maximum(gh / ah, 1e-9))], axis=1)
+    if var is not None:
+        t = t / var
+    return t
+
+
+def _nms_keep(boxes, scores, thresh, max_keep):
+    """Greedy NMS over a fixed candidate set ordered by score desc.
+    Returns keep mask [M] with at most max_keep kept."""
+    M = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    b = boxes[order]
+    iou = _iou(b, b)
+
+    def body(i, keep):
+        sup = jnp.sum(jnp.where(jnp.arange(M) < i, (iou[i] > thresh) & keep,
+                                False)) > 0
+        return keep.at[i].set(~sup & keep[i])
+
+    keep0 = scores[order] > -jnp.inf
+    keep = lax.fori_loop(0, M, body, keep0)
+    # cap at max_keep
+    rank = jnp.cumsum(keep.astype(jnp.int32)) - 1
+    keep = keep & (rank < max_keep)
+    inv = jnp.zeros((M,), jnp.int32).at[order].set(jnp.arange(M))
+    return keep[inv]
+
+
+@register_op("generate_proposals",
+             inputs=("Scores", "BboxDeltas", "ImInfo", "Anchors",
+                     "Variances"),
+             outputs=("RpnRois", "RpnRoiProbs", "RpnRoisNum"),
+             attrs={"pre_nms_topN": 6000, "post_nms_topN": 1000,
+                    "nms_thresh": 0.5, "min_size": 0.1, "eta": 1.0},
+             grad_maker=None)
+def generate_proposals(ctx, scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_topN=6000, post_nms_topN=1000, nms_thresh=0.5,
+                       min_size=0.1, eta=1.0):
+    """RPN proposal generation (generate_proposals_op.cc): decode -> clip ->
+    filter small -> topk -> NMS.  Fixed-size output [N*post_nms_topN, 5]
+    (batch_idx, x1, y1, x2, y2) zero-padded; RpnRoisNum [N] gives valid
+    counts (replaces the reference's LoD)."""
+    N = scores.shape[0]
+    A4 = anchors.reshape(-1, 4)
+    V4 = variances.reshape(-1, 4)
+    M = A4.shape[0]
+    pre_n = min(pre_nms_topN, M)
+    post_n = min(post_nms_topN, pre_n)
+
+    def per_image(sc, bd, info):
+        s = sc.transpose(1, 2, 0).reshape(-1)            # [M] anchor-major
+        d = bd.transpose(1, 2, 0).reshape(-1, 4)
+        props = _decode_anchor(A4, V4, d)
+        hgt, wdt = info[0], info[1]
+        props = jnp.stack([
+            jnp.clip(props[:, 0], 0.0, wdt - 1.0),
+            jnp.clip(props[:, 1], 0.0, hgt - 1.0),
+            jnp.clip(props[:, 2], 0.0, wdt - 1.0),
+            jnp.clip(props[:, 3], 0.0, hgt - 1.0)], axis=1)
+        ms = min_size * info[2]
+        keep_sz = ((props[:, 2] - props[:, 0] + 1.0 >= ms)
+                   & (props[:, 3] - props[:, 1] + 1.0 >= ms))
+        s = jnp.where(keep_sz, s, -jnp.inf)
+        top_s, top_i = lax.top_k(s, pre_n)
+        top_b = props[top_i]
+        keep = _nms_keep(top_b, top_s, nms_thresh, post_n)
+        keep = keep & (top_s > -jnp.inf)
+        # compact kept entries to the front (stable by score order)
+        order = jnp.argsort(~keep)  # kept first, already score-sorted
+        kb = top_b[order][:post_n]
+        ks = top_s[order][:post_n]
+        km = keep[order][:post_n]
+        return (jnp.where(km[:, None], kb, 0.0),
+                jnp.where(km, ks, 0.0), jnp.sum(km.astype(jnp.int32)))
+
+    rois, probs, nums = jax.vmap(per_image)(scores, bbox_deltas, im_info)
+    bidx = jnp.repeat(jnp.arange(N, dtype=rois.dtype), post_n).reshape(
+        N, post_n, 1)
+    rois5 = jnp.concatenate([bidx, rois], axis=-1).reshape(-1, 5)
+    return rois5, probs.reshape(-1, 1), nums
+
+
+@register_op("rpn_target_assign",
+             inputs=("Anchor", "GtBoxes", "IsCrowd", "ImInfo"),
+             outputs=("LocationIndex", "ScoreIndex", "TargetLabel",
+                      "TargetBBox", "BBoxInsideWeight"),
+             attrs={"rpn_batch_size_per_im": 256, "rpn_straddle_thresh": 0.0,
+                    "rpn_positive_overlap": 0.7, "rpn_negative_overlap": 0.3,
+                    "rpn_fg_fraction": 0.5, "use_random": True},
+             grad_maker=None)
+def rpn_target_assign(ctx, anchor, gt_boxes, is_crowd, im_info,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_positive_overlap=0.7, rpn_negative_overlap=0.3,
+                      rpn_fg_fraction=0.5, use_random=True):
+    """RPN anchor sampling (rpn_target_assign_op.cc).  Static-shape design:
+    gt_boxes [N, G, 4] padded (zero-area rows invalid; replaces LoD),
+    is_crowd [N, G].  Outputs are fixed-size per batch: fg slots
+    F = batch*fg_fraction, total slots S = batch size per im; padded slots
+    carry index 0 with zero BBoxInsideWeight / label 0.  `use_random`
+    subsampling is deterministic highest-IoU-first (replayable under jit)."""
+    N, G, _ = gt_boxes.shape
+    A = anchor.shape[0]
+    S = rpn_batch_size_per_im
+    F = int(S * rpn_fg_fraction)
+    dt = anchor.dtype
+
+    def per_image(gts, crowd, info):
+        valid_gt = ((gts[:, 2] - gts[:, 0]) > 0) & ((gts[:, 3] - gts[:, 1]) > 0)
+        valid_gt = valid_gt & (crowd == 0)
+        inside = jnp.ones((A,), bool)
+        if rpn_straddle_thresh >= 0:
+            hgt, wdt = info[0], info[1]
+            st = rpn_straddle_thresh
+            inside = ((anchor[:, 0] >= -st) & (anchor[:, 1] >= -st)
+                      & (anchor[:, 2] < wdt + st) & (anchor[:, 3] < hgt + st))
+        iou = _iou(anchor, gts)                        # [A,G]
+        iou = jnp.where(valid_gt[None, :] & inside[:, None], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=1)              # [A]
+        best_iou = jnp.max(iou, axis=1)
+        # (i) best anchor per gt is fg
+        best_anchor_iou = jnp.max(iou, axis=0)         # [G]
+        is_best = jnp.any(
+            (iou == best_anchor_iou[None, :]) & (best_anchor_iou[None, :] > 0)
+            & valid_gt[None, :], axis=1)
+        fg = (best_iou >= rpn_positive_overlap) | is_best
+        fg = fg & inside
+        bg = (~fg) & inside & (best_iou < rpn_negative_overlap) & (
+            best_iou >= 0)
+        # deterministic sampling: fg by IoU desc, bg by IoU desc; pad the
+        # candidate axis so top_k(k) is valid when A < slots
+        pad_n = max(S, F) - A if max(S, F) > A else 0
+        pad = jnp.full((pad_n,), -jnp.inf, dt)
+        fg_score = jnp.concatenate(
+            [jnp.where(fg, best_iou + 2.0, -jnp.inf), pad])
+        fg_val, fg_idx = lax.top_k(fg_score, F)
+        n_fg = jnp.minimum(jnp.sum(fg.astype(jnp.int32)), F)
+        fg_ok = fg_val > -jnp.inf
+        n_bg_want = S - n_fg
+        bg_score = jnp.concatenate(
+            [jnp.where(bg, best_iou + 1.0, -jnp.inf), pad])
+        bg_val, bg_idx = lax.top_k(bg_score, S)
+        bg_ok = (bg_val > -jnp.inf) & (jnp.arange(S) < n_bg_want)
+        loc_idx = jnp.where(fg_ok, fg_idx, 0)
+        tbox = _encode_anchor(anchor[loc_idx], gts[best_gt[loc_idx]])
+        tbox = jnp.where(fg_ok[:, None], tbox, 0.0)
+        inw = jnp.where(fg_ok[:, None], jnp.ones((F, 4), dt), 0.0)
+        score_idx = jnp.concatenate([
+            jnp.where(fg_ok, fg_idx, 0),
+            jnp.where(bg_ok, bg_idx, 0)])
+        labels = jnp.concatenate([
+            fg_ok.astype(jnp.int32),
+            jnp.zeros((S,), jnp.int32)])
+        return loc_idx, score_idx, labels, tbox, inw
+
+    li, si, lab, tb, iw = jax.vmap(per_image)(gt_boxes, is_crowd, im_info)
+    # offset indices per image into the flattened [N*A] anchor axis
+    off = (jnp.arange(N, dtype=jnp.int32) * A)[:, None]
+    return ((li + off).reshape(-1, 1), (si + off).reshape(-1, 1),
+            lab.reshape(-1, 1), tb.reshape(-1, 4), iw.reshape(-1, 4))
+
+
+@register_op("retinanet_target_assign",
+             inputs=("Anchor", "GtBoxes", "GtLabels", "IsCrowd", "ImInfo"),
+             outputs=("LocationIndex", "ScoreIndex", "TargetLabel",
+                      "TargetBBox", "BBoxInsideWeight", "ForegroundNumber"),
+             attrs={"positive_overlap": 0.5, "negative_overlap": 0.4},
+             grad_maker=None)
+def retinanet_target_assign(ctx, anchor, gt_boxes, gt_labels, is_crowd,
+                            im_info, positive_overlap=0.5,
+                            negative_overlap=0.4):
+    """RetinaNet target assign (detection.py:65-288): every non-ignored
+    anchor is used (no subsampling); fg label = gt class, bg label = 0.
+    Static-shape: all N*A anchors appear in ScoreIndex; ignored anchors
+    (neg<iou<pos) carry label -1 which the focal-loss path masks out."""
+    N, G, _ = gt_boxes.shape
+    A = anchor.shape[0]
+    dt = anchor.dtype
+
+    def per_image(gts, glab, crowd):
+        valid_gt = ((gts[:, 2] - gts[:, 0]) > 0) & ((gts[:, 3] - gts[:, 1]) > 0)
+        valid_gt = valid_gt & (crowd == 0)
+        iou = jnp.where(valid_gt[None, :], _iou(anchor, gts), -1.0)
+        best_gt = jnp.argmax(iou, axis=1)
+        best_iou = jnp.max(iou, axis=1)
+        best_anchor_iou = jnp.max(iou, axis=0)
+        is_best = jnp.any(
+            (iou == best_anchor_iou[None, :]) & (best_anchor_iou[None, :] > 0)
+            & valid_gt[None, :], axis=1)
+        fg = (best_iou >= positive_overlap) | is_best
+        bg = (~fg) & (best_iou < negative_overlap) & (best_iou >= 0)
+        label = jnp.where(fg, glab[best_gt].astype(jnp.int32),
+                          jnp.where(bg, 0, -1))
+        tbox = _encode_anchor(anchor, gts[best_gt])
+        tbox = jnp.where(fg[:, None], tbox, 0.0)
+        inw = jnp.where(fg[:, None], jnp.ones((A, 4), dt), 0.0)
+        return label, tbox, inw, jnp.sum(fg.astype(jnp.int32))
+
+    lab, tb, iw, nfg = jax.vmap(per_image)(
+        gt_boxes, gt_labels.reshape(N, G), is_crowd)
+    idx = (jnp.arange(N * A, dtype=jnp.int32)).reshape(-1, 1)
+    return (idx, idx, lab.reshape(-1, 1), tb.reshape(-1, 4),
+            iw.reshape(-1, 4), jnp.maximum(nfg, 1).reshape(N, 1))
+
+
+@register_op("generate_proposal_labels",
+             inputs=("RpnRois", "GtClasses", "IsCrowd", "GtBoxes", "ImInfo"),
+             outputs=("Rois", "LabelsInt32", "BboxTargets",
+                      "BboxInsideWeights", "BboxOutsideWeights"),
+             attrs={"batch_size_per_im": 256, "fg_fraction": 0.25,
+                    "fg_thresh": 0.5, "bg_thresh_hi": 0.5, "bg_thresh_lo": 0.0,
+                    "bbox_reg_weights": [0.1, 0.1, 0.2, 0.2],
+                    "class_nums": 81, "use_random": True,
+                    "is_cls_agnostic": False, "is_cascade_rcnn": False},
+             grad_maker=None)
+def generate_proposal_labels(ctx, rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info, batch_size_per_im=256, fg_fraction=0.25,
+                             fg_thresh=0.5, bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=81, use_random=True,
+                             is_cls_agnostic=False, is_cascade_rcnn=False):
+    """Fast-RCNN RoI sampling (generate_proposal_labels_op.cc).  Static
+    design: rpn_rois [N, R, 4] per image (from generate_proposals reshaped),
+    gt_* [N, G, .] padded.  Output fixed [N*batch_size_per_im, .] with
+    deterministic IoU-priority sampling; BboxTargets are per-class expanded
+    ([S, 4*class_nums]) as the reference does."""
+    N, R, _ = rpn_rois.shape
+    G = gt_boxes.shape[1]
+    S = batch_size_per_im
+    F = int(S * fg_fraction)
+    dt = rpn_rois.dtype
+    wts = jnp.asarray(bbox_reg_weights, dt)
+
+    def per_image(rois, gcls, crowd, gts):
+        valid_gt = ((gts[:, 2] - gts[:, 0]) > 0) & ((gts[:, 3] - gts[:, 1]) > 0)
+        not_crowd = valid_gt & (crowd == 0)
+        # gt boxes join the candidate set (reference concatenates them)
+        cand = jnp.concatenate([rois, gts], axis=0)      # [R+G,4]
+        valid_cand = jnp.concatenate([
+            (rois[:, 2] - rois[:, 0]) > 0, not_crowd])
+        iou = jnp.where(not_crowd[None, :], _iou(cand, gts), -1.0)
+        best_gt = jnp.argmax(iou, axis=1)
+        best_iou = jnp.max(iou, axis=1)
+        fg = valid_cand & (best_iou >= fg_thresh)
+        bg = valid_cand & (best_iou < bg_thresh_hi) & (
+            best_iou >= bg_thresh_lo)
+        pad_n = max(S, F) - (R + G) if max(S, F) > (R + G) else 0
+        pad = jnp.full((pad_n,), -jnp.inf, dt)
+        fg_val, fg_idx = lax.top_k(
+            jnp.concatenate([jnp.where(fg, best_iou, -jnp.inf), pad]), F)
+        fg_ok = fg_val > -jnp.inf
+        bg_val, bg_idx = lax.top_k(
+            jnp.concatenate([jnp.where(bg, best_iou, -jnp.inf), pad]), S)
+        bg_has = bg_val > -jnp.inf
+        # compact: valid fg slots first, then bg fill, then take S — so
+        # backgrounds backfill unclaimed fg quota (n_fg < F keeps the RoI
+        # batch full, matching the reference's S-n_fg background count)
+        prio = jnp.concatenate([
+            jnp.where(fg_ok, 0, 2), jnp.where(bg_has, 1, 2)])
+        order = jnp.argsort(prio, stable=True)[:S]
+        all_idx = jnp.concatenate([fg_idx, bg_idx])
+        all_fg = jnp.concatenate([fg_ok, jnp.zeros((S,), bool)])
+        all_ok = jnp.concatenate([fg_ok, bg_has])
+        sel = jnp.where(all_ok[order], all_idx[order], 0)
+        sel_fg = all_fg[order]
+        sel_ok = all_ok[order]
+        out_rois = jnp.where(sel_ok[:, None], cand[sel], 0.0)
+        lbl = jnp.where(sel_fg, gcls[best_gt[sel]].astype(jnp.int32), 0)
+        tgt = _encode_anchor(cand[sel], gts[best_gt[sel]], wts[None, :])
+        tgt = jnp.where(sel_fg[:, None], tgt, 0.0)
+        # per-class expansion
+        ncls = 2 if is_cls_agnostic else class_nums
+        cls_slot = jnp.where(sel_fg, 1 if is_cls_agnostic else lbl, 0)
+        bt = jnp.zeros((S, 4 * ncls), dt)
+        col = cls_slot[:, None] * 4 + jnp.arange(4)[None, :]
+        bt = jax.vmap(lambda row, c, v: row.at[c].set(v))(bt, col, tgt)
+        iw = jnp.zeros((S, 4 * ncls), dt)
+        iw = jax.vmap(lambda row, c, v: row.at[c].set(v))(
+            iw, col, jnp.where(sel_fg[:, None], 1.0, 0.0) * jnp.ones((S, 4), dt))
+        return out_rois, lbl, bt, iw, iw
+
+    ro, lb, bt, iw, ow = jax.vmap(per_image)(
+        rpn_rois, gt_classes.reshape(N, G), is_crowd.reshape(N, G), gt_boxes)
+    return (ro.reshape(-1, 4), lb.reshape(-1, 1),
+            bt.reshape(N * S, -1), iw.reshape(N * S, -1),
+            ow.reshape(N * S, -1))
+
+
+def _rasterize_polys(polys, lens, box, M):
+    """Host rasterizer: even-odd point-in-polygon on an MxM grid over `box`.
+    polys: [P, 2] flattened vertex list; lens: [n_poly] vertex counts."""
+    x1, y1, x2, y2 = box
+    # sample bin centers (half-pixel offsets), COCO-style
+    xs = x1 + (x2 - x1) * (np.arange(M) + 0.5) / M
+    ys = y1 + (y2 - y1) * (np.arange(M) + 0.5) / M
+    gx, gy = np.meshgrid(xs, ys)
+    mask = np.zeros((M, M), bool)
+    start = 0
+    for ln in lens:
+        ln = int(ln)
+        if ln < 3:
+            start += ln
+            continue
+        v = polys[start:start + ln]
+        start += ln
+        inside = np.zeros((M, M), bool)
+        j = ln - 1
+        for i in range(ln):
+            xi, yi = v[i]
+            xj, yj = v[j]
+            cond = ((yi > gy) != (yj > gy)) & (
+                gx < (xj - xi) * (gy - yi) / (yj - yi + 1e-12) + xi)
+            inside ^= cond
+            j = i
+        mask |= inside
+    return mask.astype(np.float32)
+
+
+@register_op("generate_mask_labels",
+             inputs=("ImInfo", "GtClasses", "IsCrowd", "GtSegms", "Rois",
+                     "LabelsInt32"),
+             outputs=("MaskRois", "RoiHasMaskInt32", "MaskInt32"),
+             attrs={"num_classes": 81, "resolution": 14},
+             grad_maker=None)
+def generate_mask_labels(ctx, im_info, gt_classes, is_crowd, gt_segms, rois,
+                         labels_int32, num_classes=81, resolution=14):
+    """Mask-RCNN mask targets (generate_mask_labels_op.cc).  Static design:
+    gt_segms [N, G, P, 2] padded polygon (single polygon per gt, padded
+    vertices repeat the last point); rois [N, S, 4]; fg rois (label>0) get a
+    rasterized class-slotted mask, others -1.  Rasterization runs on host
+    via pure_callback (CPU-only op in the reference too)."""
+    N, S, _ = rois.shape
+    G, P = gt_segms.shape[1], gt_segms.shape[2]
+    M = resolution
+
+    def host(rois_h, labels_h, segms_h, classes_h, crowd_h):
+        NS = rois_h.shape[0] * rois_h.shape[1]
+        out = -np.ones((rois_h.shape[0], rois_h.shape[1],
+                        num_classes * M * M), np.int32)
+        for n in range(rois_h.shape[0]):
+            # greedily match each fg roi to the gt with max IoU
+            for s in range(rois_h.shape[1]):
+                lab = int(labels_h[n, s])
+                if lab <= 0:
+                    continue
+                roi = rois_h[n, s]
+                best, best_g = 0.0, -1
+                for g in range(segms_h.shape[1]):
+                    if crowd_h[n, g] or int(classes_h[n, g]) != lab:
+                        continue
+                    poly = segms_h[n, g]
+                    px1, py1 = poly[:, 0].min(), poly[:, 1].min()
+                    px2, py2 = poly[:, 0].max(), poly[:, 1].max()
+                    ix1, iy1 = max(roi[0], px1), max(roi[1], py1)
+                    ix2, iy2 = min(roi[2], px2), min(roi[3], py2)
+                    inter = max(ix2 - ix1, 0) * max(iy2 - iy1, 0)
+                    a1 = (roi[2] - roi[0]) * (roi[3] - roi[1])
+                    a2 = (px2 - px1) * (py2 - py1)
+                    iou = inter / max(a1 + a2 - inter, 1e-9)
+                    if iou > best:
+                        best, best_g = iou, g
+                if best_g < 0:
+                    continue
+                m = _rasterize_polys(segms_h[n, best_g],
+                                     [segms_h.shape[2]], roi, M)
+                full = np.zeros((num_classes, M, M), np.int32)
+                full[lab] = m.astype(np.int32)
+                out[n, s] = full.reshape(-1)
+        return out
+
+    mask = jax.pure_callback(
+        host,
+        jax.ShapeDtypeStruct((N, S, num_classes * M * M), jnp.int32),
+        rois, labels_int32.reshape(N, S), gt_segms,
+        gt_classes.reshape(N, G), is_crowd.reshape(N, G))
+    has = (labels_int32.reshape(N, S) > 0).astype(jnp.int32)
+    bidx = jnp.repeat(jnp.arange(N, dtype=rois.dtype), S).reshape(N, S, 1)
+    rois5 = jnp.concatenate([bidx, rois], axis=-1)
+    return (rois5.reshape(-1, 5), has.reshape(-1, 1),
+            mask.reshape(N * S, -1))
+
+
+# -- FPN / output-stage ops ---------------------------------------------------
+
+
+@register_op("distribute_fpn_proposals", inputs=("FpnRois",),
+             outputs=("MultiFpnRois", "RestoreIndex"),
+             attrs={"min_level": 2, "max_level": 5, "refer_level": 4,
+                    "refer_scale": 224},
+             duplicable_outputs=("MultiFpnRois",), grad_maker=None)
+def distribute_fpn_proposals(ctx, fpn_rois, min_level=2, max_level=5,
+                             refer_level=4, refer_scale=224):
+    """distribute_fpn_proposals_op.cc: route each roi to pyramid level
+    floor(refer+log2(sqrt(area)/scale)).  Static design: each level output
+    keeps the full [R, 4] shape with non-member rows zeroed (a row's level
+    is recoverable from RestoreIndex ordering in the reference; here masks
+    do that job)."""
+    rois = fpn_rois[:, -4:]
+    R = rois.shape[0]
+    area = jnp.maximum((rois[:, 2] - rois[:, 0] + 1.0)
+                       * (rois[:, 3] - rois[:, 1] + 1.0), 1e-12)
+    lvl = jnp.floor(refer_level + jnp.log2(jnp.sqrt(area) / refer_scale + 1e-12))
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+    outs = []
+    for L in range(min_level, max_level + 1):
+        m = (lvl == L)
+        outs.append(jnp.where(m[:, None], rois, 0.0))
+    restore = jnp.argsort(jnp.argsort(lvl, stable=True), stable=True)
+    return outs, restore.reshape(-1, 1).astype(jnp.int32)
+
+
+@register_op("collect_fpn_proposals",
+             inputs=("MultiLevelRois", "MultiLevelScores"),
+             outputs=("FpnRois",),
+             attrs={"post_nms_topN": -1},
+             duplicable_inputs=("MultiLevelRois", "MultiLevelScores"),
+             grad_maker=None)
+def collect_fpn_proposals(ctx, rois_list, scores_list, post_nms_topN=-1):
+    """collect_fpn_proposals_op.cc: concat levels, take global top-k by
+    score.  Fixed output [post_nms_topN, 4] zero-padded."""
+    if not isinstance(rois_list, (list, tuple)):
+        rois_list, scores_list = [rois_list], [scores_list]
+    rois = jnp.concatenate([r[:, -4:] for r in rois_list], axis=0)
+    scores = jnp.concatenate([s.reshape(-1) for s in scores_list], axis=0)
+    k = post_nms_topN if post_nms_topN > 0 else scores.shape[0]
+    k = min(k, scores.shape[0])
+    top_s, top_i = lax.top_k(scores, k)
+    return rois[top_i]
+
+
+@register_op("box_decoder_and_assign",
+             inputs=("PriorBox", "PriorBoxVar", "TargetBox", "BoxScore"),
+             outputs=("DecodeBox", "OutputAssignBox"),
+             attrs={"box_clip": 4.135},
+             grad_maker=None)
+def box_decoder_and_assign(ctx, prior_box, prior_box_var, target_box,
+                           box_score, box_clip=4.135):
+    """box_decoder_and_assign_op.cc: decode per-class deltas against priors,
+    then pick each prior's best-scoring class box."""
+    R = prior_box.shape[0]
+    C = box_score.shape[1]
+    pw = prior_box[:, 2] - prior_box[:, 0] + 1.0
+    ph = prior_box[:, 3] - prior_box[:, 1] + 1.0
+    pcx = prior_box[:, 0] + 0.5 * pw
+    pcy = prior_box[:, 1] + 0.5 * ph
+    t = target_box.reshape(R, C, 4)
+    var = prior_box_var.reshape(R, 1, 4)
+    dx = t[..., 0] * var[..., 0]
+    dy = t[..., 1] * var[..., 1]
+    dw = jnp.clip(t[..., 2] * var[..., 2], -box_clip, box_clip)
+    dh = jnp.clip(t[..., 3] * var[..., 3], -box_clip, box_clip)
+    cx = dx * pw[:, None] + pcx[:, None]
+    cy = dy * ph[:, None] + pcy[:, None]
+    w = jnp.exp(dw) * pw[:, None]
+    h = jnp.exp(dh) * ph[:, None]
+    dec = jnp.stack([cx - 0.5 * w, cy - 0.5 * h,
+                     cx + 0.5 * w - 1.0, cy + 0.5 * h - 1.0], axis=-1)
+    best = jnp.argmax(box_score, axis=1)
+    assign = dec[jnp.arange(R), best]
+    return dec.reshape(R, C * 4), assign
+
+
+@register_op("retinanet_detection_output",
+             inputs=("BBoxes", "Scores", "Anchors", "ImInfo"),
+             outputs=("Out", "OutNum"),
+             attrs={"score_threshold": 0.05, "nms_top_k": 1000,
+                    "keep_top_k": 100, "nms_threshold": 0.3, "nms_eta": 1.0},
+             duplicable_inputs=("BBoxes", "Scores", "Anchors"),
+             grad_maker=None)
+def retinanet_detection_output(ctx, bboxes_list, scores_list, anchors_list,
+                               im_info, score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.3, nms_eta=1.0):
+    """retinanet_detection_output_op.cc: per-level decode + threshold, then
+    class-wise NMS, keep top keep_top_k.  Fixed output [N*keep_top_k, 6]
+    (label, score, x1, y1, x2, y2), -1-padded; OutNum [N]."""
+    if not isinstance(bboxes_list, (list, tuple)):
+        bboxes_list = [bboxes_list]
+        scores_list = [scores_list]
+        anchors_list = [anchors_list]
+    N = bboxes_list[0].shape[0]
+    C = scores_list[0].shape[-1]
+
+    def per_image(args):
+        deltas_l, scores_l, info = args
+        all_boxes, all_scores, all_cls = [], [], []
+        for deltas, sc, anc in zip(deltas_l, scores_l, anchors_list):
+            A = anc.reshape(-1, 4)
+            var = jnp.full_like(A, 1.0)
+            dec = _decode_anchor(A, var, deltas.reshape(-1, 4))
+            hgt, wdt = info[0] / info[2], info[1] / info[2]
+            dec = jnp.stack([
+                jnp.clip(dec[:, 0], 0.0, wdt - 1.0),
+                jnp.clip(dec[:, 1], 0.0, hgt - 1.0),
+                jnp.clip(dec[:, 2], 0.0, wdt - 1.0),
+                jnp.clip(dec[:, 3], 0.0, hgt - 1.0)], axis=1)
+            s = sc.reshape(-1, C)
+            # per-level top nms_top_k by best class score
+            k = min(nms_top_k, s.shape[0])
+            best = jnp.max(s, axis=1)
+            _, ti = lax.top_k(best, k)
+            all_boxes.append(dec[ti])
+            all_scores.append(s[ti])
+        boxes = jnp.concatenate(all_boxes, 0)     # [M,4]
+        scores = jnp.concatenate(all_scores, 0)   # [M,C]
+        M = boxes.shape[0]
+        outs = []
+        for c in range(1, C):  # 0 is background
+            sc = jnp.where(scores[:, c] > score_threshold, scores[:, c],
+                           -jnp.inf)
+            keep = _nms_keep(boxes, sc, nms_threshold, keep_top_k)
+            keep = keep & (sc > -jnp.inf)
+            outs.append((jnp.full((M,), float(c)), sc, keep))
+        labs = jnp.concatenate([o[0] for o in outs])
+        scs = jnp.concatenate([o[1] for o in outs])
+        kps = jnp.concatenate([o[2] for o in outs])
+        bxs = jnp.concatenate([boxes] * (C - 1), 0)
+        scs = jnp.where(kps, scs, -jnp.inf)
+        k = keep_top_k
+        top_s, top_i = lax.top_k(scs, k)
+        ok = top_s > -jnp.inf
+        det = jnp.concatenate([
+            jnp.where(ok, labs[top_i], -1.0)[:, None],
+            jnp.where(ok, top_s, -1.0)[:, None],
+            jnp.where(ok[:, None], bxs[top_i], -1.0)], axis=1)
+        return det, jnp.sum(ok.astype(jnp.int32))
+
+    dets, nums = [], []
+    for n in range(N):
+        d, m = per_image(([b[n] for b in bboxes_list],
+                          [s[n] for s in scores_list], im_info[n]))
+        dets.append(d)
+        nums.append(m)
+    return jnp.concatenate(dets, 0), jnp.stack(nums)
+
+
+@register_op("locality_aware_nms", inputs=("BBoxes", "Scores"),
+             outputs=("Out",),
+             attrs={"background_label": -1, "score_threshold": 0.0,
+                    "nms_top_k": -1, "nms_threshold": 0.3, "nms_eta": 1.0,
+                    "keep_top_k": 100, "normalized": True},
+             grad_maker=None)
+def locality_aware_nms(ctx, bboxes, scores, background_label=-1,
+                       score_threshold=0.0, nms_top_k=-1, nms_threshold=0.3,
+                       nms_eta=1.0, keep_top_k=100, normalized=True):
+    """locality_aware_nms_op.cc (EAST): first weighted-merge consecutive
+    overlapping boxes (score-weighted average of coordinates), then standard
+    NMS.  bboxes [N, M, 4]; scores [N, 1, M].  Output [N*keep_top_k, 6]
+    -1-padded."""
+    N, M, _ = bboxes.shape
+
+    def per_image(boxes, sc):
+        sc = sc.reshape(-1)
+        # locality merge: walk boxes in order; merge row-adjacent overlaps
+        def body(i, carry):
+            mb, ms, cnt = carry  # merged boxes/scores, count of merged slots
+            cur_b, cur_s = boxes[i], sc[i]
+            prev = jnp.maximum(cnt - 1, 0)
+            iou = _iou(cur_b[None], mb[prev][None])[0, 0]
+            do_merge = (cnt > 0) & (iou > nms_threshold)
+            wsum = ms[prev] + cur_s
+            merged = (mb[prev] * ms[prev] + cur_b * cur_s) / jnp.maximum(
+                wsum, 1e-12)
+            mb = jnp.where(do_merge, mb.at[prev].set(merged),
+                           mb.at[cnt].set(cur_b))
+            ms = jnp.where(do_merge, ms.at[prev].set(wsum),
+                           ms.at[cnt].set(cur_s))
+            cnt = jnp.where(do_merge, cnt, cnt + 1)
+            return mb, ms, cnt
+
+        mb0 = jnp.zeros_like(boxes)
+        ms0 = jnp.full((M,), -jnp.inf, sc.dtype)
+        mb, ms, cnt = lax.fori_loop(0, M, body, (mb0, ms0, 0))
+        ms = jnp.where(jnp.arange(M) < cnt, ms, -jnp.inf)
+        ms = jnp.where(ms > score_threshold, ms, -jnp.inf)
+        keep = _nms_keep(mb, ms, nms_threshold, keep_top_k)
+        keep = keep & (ms > -jnp.inf)
+        k = keep_top_k
+        sckeep = jnp.where(keep, ms, -jnp.inf)
+        top_s, top_i = lax.top_k(sckeep, min(k, M))
+        ok = top_s > -jnp.inf
+        det = jnp.concatenate([
+            jnp.where(ok, 0.0, -1.0)[:, None],
+            jnp.where(ok, top_s, -1.0)[:, None],
+            jnp.where(ok[:, None], mb[top_i], -1.0)], axis=1)
+        if det.shape[0] < k:
+            det = jnp.concatenate([
+                det, -jnp.ones((k - det.shape[0], 6), det.dtype)])
+        return det
+
+    return jax.vmap(per_image)(bboxes, scores).reshape(-1, 6)

@@ -7,6 +7,8 @@ interpolate_op.cc (trilinear), detection/box_coder_op.cc (paired form),
 gaussian_random_op.cc (batch-size-like form).
 """
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -234,3 +236,45 @@ def box_encode_paired(ctx, prior, target, prior_var=None, variance=()):
         jnp.log(jnp.maximum(tw / jnp.maximum(pw, 1e-10), 1e-10)) / v[2],
         jnp.log(jnp.maximum(th / jnp.maximum(ph, 1e-10), 1e-10)) / v[3],
     ], axis=1)
+
+
+# -- save/load ops (operators/save_op.h, load_op) -----------------------------
+
+
+@register_op("save", inputs=("X",), outputs=(), attrs={"file_path": "",
+             "overwrite": True, "save_as_fp16": False}, grad_maker=None,
+             stateful=True)
+def save_op(ctx, x, file_path="", overwrite=True, save_as_fp16=False):
+    """Write one variable to `file_path` as .npy (reference writes a custom
+    binary stream; format differs, granularity matches)."""
+    import os
+
+    # np.save appends .npy when the suffix is missing — guard the real target
+    target = file_path if file_path.endswith(".npy") else file_path + ".npy"
+    if not overwrite and os.path.exists(target):
+        raise RuntimeError("%s exists and overwrite is False" % target)
+    d = os.path.dirname(file_path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+
+    def _write(arr):
+        np.save(file_path, np.asarray(arr), allow_pickle=False)
+
+    jax.debug.callback(_write, x.astype(jnp.float16) if save_as_fp16 else x)
+    return ()
+
+
+@register_op("load", inputs=(), outputs=("Out",), attrs={"file_path": "",
+             "load_as_fp16": False}, grad_maker=None, stateful=True)
+def load_op(ctx, file_path="", load_as_fp16=False):
+    """Load a variable saved by the `save` op.  The file is read at trace
+    (compile) time — static shapes require it; re-reading a changed file
+    needs a fresh program (documented deviation from the reference's
+    run-time read)."""
+    p = file_path if file_path.endswith(".npy") else file_path + ".npy"
+    import os
+
+    arr = np.load(p if os.path.exists(p) else file_path, allow_pickle=False)
+    if load_as_fp16:
+        arr = arr.astype(np.float16)
+    return jnp.asarray(arr)

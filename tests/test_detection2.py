@@ -1,0 +1,633 @@
+"""Tests for the detection long tail + distributions + DynamicRNN + misc
+fills (ops/detection2.py, layers/detection2.py, layers/distributions.py,
+layers/misc_fills.py)."""
+
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def run_prog(build, feeds=None, n_fetch=1):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        fetches = build()
+    if not isinstance(fetches, (list, tuple)):
+        fetches = [fetches]
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        outs = exe.run(main, feed=feeds or {}, fetch_list=list(fetches))
+    return [np.asarray(o) for o in outs]
+
+
+def test_polygon_box_transform():
+    x = np.random.RandomState(0).randn(1, 4, 3, 5).astype("f")
+
+    def build():
+        v = fluid.layers.data("x", shape=list(x.shape[1:]))
+        return fluid.layers.polygon_box_transform(v)
+
+    out, = run_prog(build, {"x": x})
+    wi = np.arange(5).reshape(1, 1, 1, 5)
+    hi = np.arange(3).reshape(1, 1, 3, 1)
+    exp = np.where((np.arange(4) % 2 == 0).reshape(1, 4, 1, 1),
+                   4.0 * wi - x, 4.0 * hi - x)
+    np.testing.assert_allclose(out, exp, rtol=1e-6)
+
+
+def test_cvm():
+    x = np.abs(np.random.RandomState(1).randn(4, 6)).astype("f")
+    cvm = x[:, :2].copy()
+
+    def build(use_cvm):
+        def b():
+            v = fluid.layers.data("x", shape=[6])
+            c = fluid.layers.data("c", shape=[2])
+            return fluid.layers.continuous_value_model(v, c, use_cvm)
+        return b
+
+    out, = run_prog(build(True), {"x": x, "c": cvm})
+    np.testing.assert_allclose(out[:, 0], np.log(x[:, 0] + 1), rtol=1e-5)
+    np.testing.assert_allclose(
+        out[:, 1], np.log(x[:, 1] + 1) - np.log(x[:, 0] + 1), rtol=1e-5)
+    np.testing.assert_allclose(out[:, 2:], x[:, 2:], rtol=1e-6)
+    out2, = run_prog(build(False), {"x": x, "c": cvm})
+    assert out2.shape == (4, 4)
+    np.testing.assert_allclose(out2, x[:, 2:], rtol=1e-6)
+
+
+def test_psroi_pool_uniform():
+    # constant feature -> every bin equals the channel constant
+    C, ph, pw = 2, 2, 2
+    x = np.zeros((1, C * ph * pw, 8, 8), "f")
+    for c in range(C * ph * pw):
+        x[0, c] = c + 1.0
+    rois = np.array([[0, 0, 0, 7, 7]], "f")
+
+    def build():
+        v = fluid.layers.data("x", shape=[C * ph * pw, 8, 8])
+        r = fluid.layers.data("rois", shape=[5])
+        return fluid.layers.psroi_pool(v, r, C, 1.0, ph, pw)
+
+    out, = run_prog(build, {"x": x, "rois": rois})
+    assert out.shape == (1, C, ph, pw)
+    # channel c of output bin (i,j) reads input channel c*ph*pw + i*pw + j
+    for c in range(C):
+        for i in range(ph):
+            for j in range(pw):
+                np.testing.assert_allclose(
+                    out[0, c, i, j], c * ph * pw + i * pw + j + 1.0,
+                    rtol=1e-5)
+
+
+def test_prroi_pool_constant():
+    x = np.full((1, 3, 6, 6), 2.5, "f")
+    rois = np.array([[0, 1.0, 1.0, 4.0, 4.0]], "f")
+
+    def build():
+        v = fluid.layers.data("x", shape=[3, 6, 6])
+        r = fluid.layers.data("rois", shape=[5])
+        return fluid.layers.prroi_pool(v, r, spatial_scale=1.0,
+                                       pooled_height=2, pooled_width=2)
+
+    out, = run_prog(build, {"x": x, "rois": rois})
+    np.testing.assert_allclose(out, 2.5, rtol=1e-5)
+
+
+def test_deformable_conv_zero_offset_matches_conv():
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 3, 8, 8).astype("f")
+    kh = kw = 3
+    off = np.zeros((2, 2 * kh * kw, 8, 8), "f")
+    msk = np.ones((2, kh * kw, 8, 8), "f")
+
+    def build():
+        v = fluid.layers.data("x", shape=[3, 8, 8])
+        o = fluid.layers.data("off", shape=[2 * kh * kw, 8, 8])
+        m = fluid.layers.data("msk", shape=[kh * kw, 8, 8])
+        y1 = fluid.layers.deformable_conv(
+            v, o, m, 4, 3, padding=1,
+            param_attr=fluid.ParamAttr(name="shared_w"), bias_attr=False)
+        y2 = fluid.layers.conv2d(
+            v, 4, 3, padding=1,
+            param_attr=fluid.ParamAttr(name="shared_w"), bias_attr=False)
+        return y1, y2
+
+    y1, y2 = run_prog(build, {"x": x, "off": off, "msk": msk}, 2)
+    np.testing.assert_allclose(y1, y2, rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_roi_pooling_runs():
+    x = np.random.RandomState(4).randn(1, 8, 6, 6).astype("f")
+    rois = np.array([[0, 0, 0, 5, 5]], "f")
+    trans = np.zeros((1, 2, 2, 2), "f")
+
+    def build():
+        v = fluid.layers.data("x", shape=[8, 6, 6])
+        r = fluid.layers.data("rois", shape=[5])
+        t = fluid.layers.data("trans", shape=[2, 2, 2])
+        return fluid.layers.deformable_roi_pooling(
+            v, r, t, pooled_height=2, pooled_width=2, sample_per_part=2,
+            position_sensitive=True, group_size=[2, 2])
+
+    out, = run_prog(build, {"x": x, "rois": rois, "trans": trans})
+    assert out.shape == (1, 2, 2, 2)
+    assert np.isfinite(out).all()
+
+
+def test_yolov3_loss_no_gt_is_negative_objectness():
+    """With no valid gt boxes the loss must equal sum of BCE(obj, 0)."""
+    rng = np.random.RandomState(5)
+    C, m, H = 2, 3, 4
+    x = rng.randn(1, m * (5 + C), H, H).astype("f")
+    gt = np.zeros((1, 5, 4), "f")   # all invalid (w=h=0)
+    lab = np.zeros((1, 5), "int32")
+
+    def build():
+        v = fluid.layers.data("x", shape=[m * (5 + C), H, H])
+        g = fluid.layers.data("gt", shape=[5, 4])
+        l = fluid.layers.data("lab", shape=[5], dtype="int32")
+        return fluid.layers.yolov3_loss(
+            v, g, l, anchors=[10, 13, 16, 30, 33, 23],
+            anchor_mask=[0, 1, 2], class_num=C, ignore_thresh=0.7,
+            downsample_ratio=32)
+
+    loss, = run_prog(build, {"x": x, "gt": gt, "lab": lab})
+    obj = x.reshape(1, m, 5 + C, H, H)[:, :, 4]
+    bce = np.maximum(obj, 0) - obj * 0 + np.log1p(np.exp(-np.abs(obj)))
+    np.testing.assert_allclose(loss[0], bce.sum(), rtol=1e-4)
+
+
+def test_generate_proposals_counts():
+    # two anchors, one tiny (filtered by min_size), one good
+    anchors = np.array([[[[0, 0, 10, 10], [2, 2, 3, 3]]]], "f")  # [1,1,2,4]
+    anchors = anchors.reshape(1, 1, 2, 4).astype("f")
+    var = np.full_like(anchors, 1.0)
+    scores = np.array([0.9, 0.8], "f").reshape(1, 2, 1, 1)
+    deltas = np.zeros((1, 8, 1, 1), "f")
+    im_info = np.array([[20.0, 20.0, 1.0]], "f")
+
+    def build():
+        s = fluid.layers.data("s", shape=[2, 1, 1])
+        d = fluid.layers.data("d", shape=[8, 1, 1])
+        ii = fluid.layers.data("ii", shape=[3])
+        a = fluid.layers.data("a", shape=[1, 2, 4])
+        v = fluid.layers.data("v", shape=[1, 2, 4])
+        rois, probs, num = fluid.layers.generate_proposals(
+            s, d, ii, a, v, pre_nms_top_n=2, post_nms_top_n=2,
+            min_size=4.0, return_rois_num=True)
+        return rois, probs, num
+
+    rois, probs, num = run_prog(
+        build, {"s": scores, "d": deltas, "ii": im_info,
+                "a": anchors[0], "v": var[0]}, 3)
+    assert num[0] == 1                      # small anchor filtered
+    np.testing.assert_allclose(rois[0], [0, 0, 0, 10, 10], atol=1e-4)
+    assert probs[0] == pytest.approx(0.9, rel=1e-5)
+
+
+def test_rpn_target_assign_labels():
+    anchor = np.array([[0, 0, 10, 10], [20, 20, 30, 30], [100, 100, 110, 110]],
+                      "f")
+    gt = np.array([[[0, 0, 10, 10]]], "f")         # matches anchor 0
+    crowd = np.zeros((1, 1), "int32")
+    im_info = np.array([[200.0, 200.0, 1.0]], "f")
+    bbox_pred = np.zeros((1, 3, 4), "f")
+    cls_logits = np.zeros((1, 3, 1), "f")
+
+    # anchor input is [A, 4]: rows are anchors (feed through the batch dim)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = fluid.layers.data("a", shape=[4])          # rows = anchors
+        g = fluid.layers.data("g", shape=[1, 4])
+        c = fluid.layers.data("c", shape=[1], dtype="int32")
+        ii = fluid.layers.data("ii", shape=[3])
+        bp = fluid.layers.data("bp", shape=[3, 4])
+        cl = fluid.layers.data("cl", shape=[3, 1])
+        sc, loc, lab, tb, iw = fluid.layers.rpn_target_assign(
+            bp, cl, a, a, g, c, ii, rpn_batch_size_per_im=4,
+            rpn_fg_fraction=0.5, rpn_straddle_thresh=-1.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        lab_v, tb_v, iw_v = exe.run(
+            main, feed={"a": anchor, "g": gt, "c": crowd, "ii": im_info,
+                        "bp": bbox_pred, "cl": cls_logits},
+            fetch_list=[lab, tb, iw])
+    lab_v = np.asarray(lab_v).reshape(-1)
+    # slot 0..F-1 are fg: exactly one fg (anchor 0, IoU 1.0)
+    assert lab_v[0] == 1
+    assert (np.asarray(iw_v)[0] == 1).all()     # real fg has inside weight
+    assert (np.asarray(tb_v)[0] == pytest.approx(0.0, abs=1e-5))  # exact match
+
+
+def test_retinanet_target_assign_runs():
+    anchor = np.array([[0, 0, 10, 10], [50, 50, 60, 60]], "f")
+    gt = np.array([[[0, 0, 10, 10]]], "f")
+    glab = np.array([[3]], "int32")
+    crowd = np.zeros((1, 1), "int32")
+    im_info = np.array([[100.0, 100.0, 1.0]], "f")
+    bp = np.zeros((1, 2, 4), "f")
+    cl = np.zeros((1, 2, 5), "f")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = fluid.layers.data("a", shape=[4])
+        g = fluid.layers.data("g", shape=[1, 4])
+        gl = fluid.layers.data("gl", shape=[1], dtype="int32")
+        c = fluid.layers.data("c", shape=[1], dtype="int32")
+        ii = fluid.layers.data("ii", shape=[3])
+        bpv = fluid.layers.data("bp", shape=[2, 4])
+        clv = fluid.layers.data("cl", shape=[2, 5])
+        outs = fluid.layers.retinanet_target_assign(
+            bpv, clv, a, a, g, gl, c, ii, num_classes=5)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        lab, fg = exe.run(main, feed={"a": anchor, "g": gt, "gl": glab,
+                                      "c": crowd, "ii": im_info,
+                                      "bp": bp, "cl": cl},
+                          fetch_list=[outs[2], outs[5]])
+    lab = np.asarray(lab).reshape(-1)
+    assert lab[0] == 3          # fg anchor carries gt class
+    assert lab[1] == 0          # far anchor is bg
+    assert np.asarray(fg).reshape(-1)[0] == 1
+
+
+def test_generate_proposal_labels_smoke():
+    rois = np.array([[[0, 0, 10, 10], [40, 40, 50, 50]]], "f")
+    gcls = np.array([[2]], "int32")
+    crowd = np.zeros((1, 1), "int32")
+    gt = np.array([[[0, 0, 10, 10]]], "f")
+    im_info = np.array([[100.0, 100.0, 1.0]], "f")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        r = fluid.layers.data("r", shape=[2, 4])
+        gc = fluid.layers.data("gc", shape=[1], dtype="int32")
+        c = fluid.layers.data("c", shape=[1], dtype="int32")
+        g = fluid.layers.data("g", shape=[1, 4])
+        ii = fluid.layers.data("ii", shape=[3])
+        outs = fluid.layers.generate_proposal_labels(
+            r, gc, c, g, ii, batch_size_per_im=4, fg_fraction=0.5,
+            fg_thresh=0.5, class_nums=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        ro, lb, bt = exe.run(main, feed={"r": rois, "gc": gcls, "c": crowd,
+                                         "g": gt, "ii": im_info},
+                             fetch_list=[outs[0], outs[1], outs[2]])
+    lb = np.asarray(lb).reshape(-1)
+    assert lb[0] == 2           # fg roi labeled with gt class
+    assert np.asarray(bt).shape == (4, 12)  # 4 rois x 4*class_nums
+
+
+def test_generate_proposal_labels_bg_backfills_fg_quota():
+    """With zero foregrounds the full RoI batch must still fill with
+    backgrounds (reference samples S-n_fg backgrounds)."""
+    rois = np.array([[[40 + 10 * i, 40, 50 + 10 * i, 50] for i in range(6)]],
+                    "f")
+    gcls = np.array([[2]], "int32")
+    crowd = np.zeros((1, 1), "int32")
+    gt = np.array([[[0, 0, 10, 10]]], "f")   # no roi overlaps it
+    im_info = np.array([[200.0, 200.0, 1.0]], "f")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        r = fluid.layers.data("r", shape=[6, 4])
+        gc = fluid.layers.data("gc", shape=[1], dtype="int32")
+        c = fluid.layers.data("c", shape=[1], dtype="int32")
+        g = fluid.layers.data("g", shape=[1, 4])
+        ii = fluid.layers.data("ii", shape=[3])
+        outs = fluid.layers.generate_proposal_labels(
+            r, gc, c, g, ii, batch_size_per_im=4, fg_fraction=0.5,
+            fg_thresh=0.5, class_nums=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        ro, lb = exe.run(main, feed={"r": rois, "gc": gcls, "c": crowd,
+                                     "g": gt, "ii": im_info},
+                         fetch_list=[outs[0], outs[1]])
+    ro = np.asarray(ro)
+    lb = np.asarray(lb).reshape(-1)
+    # the gt box itself is the only fg candidate (reference concatenates
+    # gts into the roi set); the unused second fg slot must backfill with
+    # a background so all 4 slots hold valid samples
+    assert lb[0] == 2
+    assert (lb[1:] == 0).all()
+    assert (np.abs(ro).sum(axis=1) > 0).all()
+
+
+def test_generate_mask_labels_square():
+    # roi == polygon == [0,0,8,8]; resolution 4 -> all-ones mask in class 1
+    im_info = np.array([[16.0, 16.0, 1.0]], "f")
+    gcls = np.array([[1]], "int32")
+    crowd = np.zeros((1, 1), "int32")
+    segs = np.array([[[[0, 0], [8, 0], [8, 8], [0, 8]]]], "f")  # [1,1,4,2]
+    rois = np.array([[[0.0, 0.0, 8.0, 8.0]]], "f")
+    labs = np.array([[1]], "int32")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ii = fluid.layers.data("ii", shape=[3])
+        gc = fluid.layers.data("gc", shape=[1], dtype="int32")
+        c = fluid.layers.data("c", shape=[1], dtype="int32")
+        s = fluid.layers.data("s", shape=[1, 4, 2])
+        r = fluid.layers.data("r", shape=[1, 4])
+        l = fluid.layers.data("l", shape=[1], dtype="int32")
+        mr, hm, mi = fluid.layers.generate_mask_labels(
+            ii, gc, c, s, r, l, num_classes=2, resolution=4)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        mr_v, hm_v, mi_v = exe.run(
+            main, feed={"ii": im_info, "gc": gcls, "c": crowd, "s": segs,
+                        "r": rois, "l": labs},
+            fetch_list=[mr, hm, mi])
+    assert np.asarray(hm_v).reshape(-1)[0] == 1
+    m = np.asarray(mi_v).reshape(2, 4, 4)
+    assert m[0].sum() == 0
+    assert m[1].sum() == 16     # roi == polygon -> every bin center inside
+
+
+def test_fpn_distribute_collect():
+    # areas 32^2 and 224^2 -> levels 2 (min) and 4 (refer)
+    rois = np.array([[0, 0, 31, 31], [0, 0, 223, 223]], "f")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        r = fluid.layers.data("r", shape=[4])
+        outs, restore = fluid.layers.distribute_fpn_proposals(
+            r, 2, 5, 4, 224)
+        scores = fluid.layers.data("sc", shape=[1])
+        col = fluid.layers.collect_fpn_proposals(
+            [r], [scores], 2, 2, post_nms_top_n=1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        l2, l4, col_v = exe.run(
+            main, feed={"r": rois, "sc": np.array([[0.3], [0.9]], "f")},
+            fetch_list=[outs[0], outs[2], col])
+    np.testing.assert_allclose(np.asarray(l2)[0], rois[0])
+    np.testing.assert_allclose(np.asarray(l2)[1], 0.0)
+    np.testing.assert_allclose(np.asarray(l4)[1], rois[1])
+    np.testing.assert_allclose(np.asarray(col_v)[0], rois[1])  # higher score
+
+
+def test_box_decoder_and_assign():
+    prior = np.array([[0, 0, 10, 10]], "f")
+    var = np.array([[0.1, 0.1, 0.2, 0.2]], "f")
+    deltas = np.zeros((1, 8), "f")      # 2 classes
+    score = np.array([[0.2, 0.8]], "f")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        p = fluid.layers.data("p", shape=[4])
+        v = fluid.layers.data("v", shape=[4])
+        t = fluid.layers.data("t", shape=[8])
+        s = fluid.layers.data("s", shape=[2])
+        dec, asg = fluid.layers.box_decoder_and_assign(p, v, t, s, 4.135)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        dec_v, asg_v = exe.run(main, feed={"p": prior, "v": var, "t": deltas,
+                                           "s": score},
+                               fetch_list=[dec, asg])
+    # zero deltas decode back to the prior box
+    np.testing.assert_allclose(np.asarray(asg_v)[0], prior[0], atol=1e-4)
+
+
+def test_locality_aware_nms_merges():
+    boxes = np.array([[[0, 0, 10, 10], [0, 0, 10, 10.5]]], "f")
+    scores = np.array([[[0.6, 0.4]]], "f")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        b = fluid.layers.data("b", shape=[2, 4])
+        s = fluid.layers.data("s", shape=[1, 2])
+        out = fluid.layers.locality_aware_nms(b, s, 0.01, 10, 5,
+                                              nms_threshold=0.3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        o, = exe.run(main, feed={"b": boxes, "s": scores}, fetch_list=[out])
+    o = np.asarray(o)
+    valid = o[o[:, 0] >= 0]
+    assert len(valid) == 1                  # merged into one detection
+    assert valid[0, 1] == pytest.approx(1.0, rel=1e-5)  # score sum .6+.4
+    # coordinates are score-weighted average
+    np.testing.assert_allclose(valid[0, 2:], [0, 0, 10, 10.2], atol=1e-4)
+
+
+def test_similarity_focus():
+    x = np.zeros((1, 2, 2, 2), "f")
+    x[0, 0] = [[1.0, 0.1], [0.2, 0.3]]
+    x[0, 1] = [[0.5, 0.6], [0.7, 0.8]]
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        v = fluid.layers.data("x", shape=[2, 2, 2])
+        out = fluid.layers.similarity_focus(v, axis=1, indexes=[0])
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        o, = exe.run(main, feed={"x": x}, fetch_list=[out])
+    o = np.asarray(o)
+    # greedy: (0,0) is global max; then (1,1) remains
+    exp = np.array([[1.0, 0.0], [0.0, 1.0]], "f")
+    np.testing.assert_allclose(o[0, 0], exp)
+    np.testing.assert_allclose(o[0, 1], exp)   # broadcast across channels
+
+
+def test_filter_by_instag():
+    ins = np.arange(8, dtype="f").reshape(4, 2)
+    tags = np.array([1, 2, 1, 3], "int64")
+    filt = np.array([1], "int64")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = fluid.layers.data("i", shape=[2])
+        t = fluid.layers.data("t", shape=[1], dtype="int64")
+        f = fluid.layers.data("f", shape=[1], dtype="int64")
+        out, w, m = fluid.layers.filter_by_instag(i, t, f, True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        o, wv = exe.run(main, feed={"i": ins, "t": tags, "f": filt},
+                        fetch_list=[out, w])
+    np.testing.assert_allclose(np.asarray(wv).reshape(-1), [1, 0, 1, 0])
+    np.testing.assert_allclose(np.asarray(o)[1], 0.0)
+    np.testing.assert_allclose(np.asarray(o)[0], ins[0])
+
+
+# -- distributions ------------------------------------------------------------
+
+
+def test_uniform_distribution():
+    def build():
+        u = fluid.layers.Uniform([0.0], [2.0])
+        return u.entropy(), u.log_prob(fluid.layers.fill_constant(
+            [1], "float32", 0.5)), u.sample([3])
+
+    ent, lp, samp = run_prog(build, n_fetch=3)
+    np.testing.assert_allclose(ent, math.log(2.0), rtol=1e-5)
+    np.testing.assert_allclose(lp, math.log(0.5), rtol=1e-5)
+    assert samp.shape[0] == 3
+    assert ((samp >= 0) & (samp <= 2)).all()
+
+
+def test_normal_distribution():
+    def build():
+        n1 = fluid.layers.Normal([0.0], [1.0])
+        n2 = fluid.layers.Normal([1.0], [2.0])
+        val = fluid.layers.fill_constant([1], "float32", 0.3)
+        return n1.entropy(), n1.log_prob(val), n1.kl_divergence(n2)
+
+    ent, lp, kl = run_prog(build, n_fetch=3)
+    np.testing.assert_allclose(ent, 0.5 + 0.5 * math.log(2 * math.pi),
+                               rtol=1e-5)
+    np.testing.assert_allclose(
+        lp, -0.5 * 0.09 - math.log(math.sqrt(2 * math.pi)), rtol=1e-5)
+    # closed form KL(N(0,1) || N(1,2))
+    exp_kl = 0.5 * (0.25 + 0.25 - 1 - math.log(0.25))
+    np.testing.assert_allclose(kl, exp_kl, rtol=1e-5)
+
+
+def test_categorical_distribution():
+    logits = np.array([[1.0, 2.0, 3.0]], "f")
+
+    def build():
+        lv = fluid.layers.data("lg", shape=[3])
+        c = fluid.layers.Categorical(lv)
+        c2 = fluid.layers.Categorical(lv * 1.0)
+        return c.entropy(), c.kl_divergence(c2)
+
+    ent, kl = run_prog(build, {"lg": logits}, 2)
+    p = np.exp(logits) / np.exp(logits).sum()
+    np.testing.assert_allclose(ent.reshape(-1)[0], -(p * np.log(p)).sum(),
+                               rtol=1e-5)
+    np.testing.assert_allclose(kl.reshape(-1)[0], 0.0, atol=1e-6)
+
+
+def test_mvn_diag_distribution():
+    def build():
+        mvn1 = fluid.layers.MultivariateNormalDiag(
+            [[0.0, 0.0]], [[2.0, 0.0], [0.0, 3.0]])
+        mvn2 = fluid.layers.MultivariateNormalDiag(
+            [[0.0, 0.0]], [[2.0, 0.0], [0.0, 3.0]])
+        return mvn1.entropy(), mvn1.kl_divergence(mvn2)
+
+    ent, kl = run_prog(build, n_fetch=2)
+    exp_ent = 0.5 * (2 * (1 + math.log(2 * math.pi)) + math.log(6.0))
+    np.testing.assert_allclose(ent, exp_ent, rtol=1e-5)
+    np.testing.assert_allclose(kl, 0.0, atol=1e-5)
+
+
+# -- DynamicRNN / misc --------------------------------------------------------
+
+
+def test_dynamic_rnn_masks_finished_rows():
+    B, T, D, H = 2, 4, 3, 3
+    rng = np.random.RandomState(7)
+    x = rng.randn(B, T, D).astype("f")
+    lens = np.array([2, 4], "int64")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data("x", shape=[T, D])
+        lv = fluid.layers.data("lens", shape=[], dtype="int64")
+        drnn = fluid.layers.DynamicRNN()
+        with drnn.block():
+            x_t = drnn.step_input(xv, seq_len=lv)
+            h = drnn.memory(shape=[D], value=0.0)
+            nh = x_t + h
+            drnn.update_memory(h, nh)
+            drnn.output(nh)
+        out = drnn()[0]
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        o, = exe.run(main, feed={"x": x, "lens": lens}, fetch_list=[out])
+    o = np.asarray(o)
+    assert o.shape == (B, T, D)
+    # row 0 (len 2): cumsum for t<2, zeros after
+    np.testing.assert_allclose(o[0, 1], x[0, :2].sum(0), rtol=1e-5)
+    np.testing.assert_allclose(o[0, 2:], 0.0, atol=1e-6)
+    # row 1 (len 4): full cumsum
+    np.testing.assert_allclose(o[1, 3], x[1].sum(0), rtol=1e-4)
+
+
+def test_save_load_layer_roundtrip(tmp_path):
+    path = str(tmp_path / "t.npy")
+    val = np.arange(6, dtype="f").reshape(2, 3)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[3])
+        y = x * 2.0
+        from paddle_tpu.layer_helper import LayerHelper
+        helper = LayerHelper("save")
+        helper.append_op(type="save", inputs={"X": [y]}, outputs={},
+                         attrs={"file_path": path})
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        exe.run(main, feed={"x": val}, fetch_list=[y])
+    saved = np.load(path)
+    np.testing.assert_allclose(saved, val * 2.0)
+
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, startup2):
+        out = fluid.layers.create_tensor(dtype="float32")
+        fluid.layers.load(out, path)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup2)
+        o, = exe.run(main2, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(o), val * 2.0)
+
+
+def test_reorder_lod_tensor_by_rank():
+    x = np.arange(12, dtype="f").reshape(3, 4)
+    lens = np.array([1, 3, 2], "int64")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data("x", shape=[4])
+        lv = fluid.layers.data("lens", shape=[], dtype="int64")
+        table = fluid.layers.lod_rank_table(xv, seq_len=lv)
+        out = fluid.layers.reorder_lod_tensor_by_rank(xv, table)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        o, = exe.run(main, feed={"x": x, "lens": lens}, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(o), x[[1, 2, 0]])
+
+
+def test_generate_layer_fn():
+    relu = fluid.layers.generate_layer_fn("relu")
+    x = np.array([[-1.0, 2.0]], "f")
+
+    def build():
+        v = fluid.layers.data("x", shape=[2])
+        return relu(v)
+
+    o, = run_prog(build, {"x": x})
+    np.testing.assert_allclose(o, [[0.0, 2.0]])
+
+
+def test_doc_helpers():
+    @fluid.layers.templatedoc()
+    def f():
+        """doc ${comment} tail"""
+
+    assert "${comment}" not in f.__doc__
+
+    @fluid.layers.deprecated("1.6", "new_api")
+    def g():
+        return 42
+
+    with pytest.warns(DeprecationWarning):
+        assert g() == 42
