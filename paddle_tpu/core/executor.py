@@ -350,7 +350,11 @@ class Executor:
 
         v = block._find_var_recursive(name)
         if v is not None and v.sharding:
-            return NamedSharding(mesh, P(*v.sharding))
+            # drop axis names the mesh doesn't have (e.g. a table annotated
+            # ("model", None) running on a data-only mesh stays replicated)
+            spec = tuple(a if (a is None or a in mesh.axis_names) else None
+                         for a in v.sharding)
+            return NamedSharding(mesh, P(*spec))
         return NamedSharding(mesh, P())
 
     def _shard_params(self, params, mesh, block):

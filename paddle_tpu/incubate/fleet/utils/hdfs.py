@@ -1,0 +1,6 @@
+"""fleet.utils.hdfs compatibility module (reference
+python/paddle/fluid/incubate/fleet/utils/hdfs.py)."""
+
+from ....utils.fs import HDFSClient  # noqa: F401
+
+__all__ = ["HDFSClient"]

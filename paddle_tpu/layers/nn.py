@@ -172,6 +172,13 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
               padding_idx=None, param_attr=None, dtype="float32", name=None):
     helper = LayerHelper("embedding", name=name)
     w = helper.create_parameter(attr=param_attr, shape=list(size), dtype=dtype)
+    if is_distributed and not w.sharding:
+        # TPU-native equivalent of the reference's pserver-sharded table
+        # (distributed_lookup_table_op + parameter_prefetch): row-shard the
+        # table over the mesh "model" axis; under pjit XLA inserts the
+        # gather collectives over ICI.  On meshes without a "model" axis the
+        # annotation is dropped (table replicated).
+        w.sharding = ("model", None)
     out = helper.create_variable_for_type_inference(dtype)
     pad = -1 if padding_idx is None else (
         padding_idx if padding_idx >= 0 else size[0] + padding_idx

@@ -160,3 +160,62 @@ def listen_and_serv(ctx, x=None, endpoint="", Fanin=1):
     raise RuntimeError(
         "listen_and_serv cannot be lowered to XLA; run the pserver program "
         "through Executor.run (it blocks in the PS server loop)")
+
+
+@register_op("distributed_lookup_table", inputs=("Ids", "W"),
+             outputs=("Out",),
+             attrs={"ring_id": 0, "table_size": 0, "padding_idx": -1})
+def distributed_lookup_table(ctx, ids, w, ring_id=0, table_size=0,
+                             padding_idx=-1):
+    """Row-sharded embedding lookup (TPU-native analog of
+    distributed_lookup_table_op.cc + parameter_prefetch.cc: ids routed to
+    the pserver owning each row-section; here each mesh rank owns a
+    contiguous row block and contributes masked partial gathers summed over
+    ICI).
+
+    Inside shard_map: `w` is the LOCAL shard [V/n, D]; rank r owns global
+    rows [r*V/n, (r+1)*V/n).  Outside a mesh: plain gather (w is the full
+    table).  Fully differentiable — the vjp scatter-adds into the local
+    shard and the psum transposes to identity."""
+    idx = ids.reshape(-1)
+    axis = _axis_for_ring(ctx, ring_id)
+    if axis is None:
+        out = w[idx]
+    else:
+        vlocal = w.shape[0]
+        rank = lax.axis_index(axis)
+        offset = rank * vlocal
+        local = idx - offset
+        valid = (local >= 0) & (local < vlocal)
+        safe = jnp.clip(local, 0, vlocal - 1)
+        part = jnp.where(valid[:, None], w[safe], 0.0)
+        out = lax.psum(part, axis)
+    if padding_idx >= 0:
+        out = jnp.where((idx == padding_idx)[:, None], 0.0, out)
+    return out.reshape(ids.shape[:-1] + (w.shape[-1],)) if (
+        ids.ndim > 1 and ids.shape[-1] == 1) else out.reshape(
+        ids.shape + (w.shape[-1],))
+
+
+@register_op("moe_ffn", inputs=("X", "GateW", "W1", "B1", "W2", "B2"),
+             outputs=("Out", "AuxLoss"),
+             attrs={"top_k": 2, "capacity_factor": 1.25, "ring_id": -1,
+                    "axis_name": ""})
+def moe_ffn_op(ctx, x, gate_w, w1, b1, w2, b2, top_k=2,
+               capacity_factor=1.25, ring_id=-1, axis_name=""):
+    """Mixture-of-experts FFN (parallel/moe.py).  Expert parallelism over a
+    mesh axis selected by `axis_name` (string) or `ring_id` >= 0 (index);
+    otherwise all experts are local (single device / auto-SPMD)."""
+    from ..parallel.moe import moe_ffn as _moe
+
+    if axis_name and ctx is not None and axis_name in (ctx.axis_names or ()):
+        axis = axis_name
+    elif ring_id >= 0:
+        axis = _axis_for_ring(ctx, ring_id)
+    else:
+        axis = None
+    shp = x.shape
+    flat = x.reshape(-1, shp[-1])
+    out, aux = _moe(flat, gate_w, w1, b1, w2, b2, top_k=top_k,
+                    capacity_factor=capacity_factor, axis_name=axis)
+    return out.reshape(shp), aux

@@ -630,13 +630,28 @@ def _encode_anchor(anchor, gt, var=None):
     return t
 
 
-def _nms_keep(boxes, scores, thresh, max_keep):
+def _iou_off(a, b, offset=0.0, eps=1e-10):
+    """IoU with the pixel-coordinate +1 convention when offset=1
+    (bbox_util's normalized=False path)."""
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt + offset, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.maximum(a[:, 2] - a[:, 0] + offset, 0) * jnp.maximum(
+        a[:, 3] - a[:, 1] + offset, 0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0] + offset, 0) * jnp.maximum(
+        b[:, 3] - b[:, 1] + offset, 0)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return inter / jnp.maximum(union, eps)
+
+
+def _nms_keep(boxes, scores, thresh, max_keep, iou_offset=0.0):
     """Greedy NMS over a fixed candidate set ordered by score desc.
     Returns keep mask [M] with at most max_keep kept."""
     M = boxes.shape[0]
     order = jnp.argsort(-scores)
     b = boxes[order]
-    iou = _iou(b, b)
+    iou = _iou_off(b, b, iou_offset)
 
     def body(i, keep):
         sup = jnp.sum(jnp.where(jnp.arange(M) < i, (iou[i] > thresh) & keep,
@@ -750,8 +765,9 @@ def rpn_target_assign(ctx, anchor, gt_boxes, is_crowd, im_info,
             & valid_gt[None, :], axis=1)
         fg = (best_iou >= rpn_positive_overlap) | is_best
         fg = fg & inside
-        bg = (~fg) & inside & (best_iou < rpn_negative_overlap) & (
-            best_iou >= 0)
+        # an image with no valid gt (best_iou stays -1) still yields
+        # backgrounds — every inside anchor is negative
+        bg = (~fg) & inside & (best_iou < rpn_negative_overlap)
         # deterministic sampling: fg by IoU desc, bg by IoU desc; pad the
         # candidate axis so top_k(k) is valid when A < slots
         pad_n = max(S, F) - A if max(S, F) > A else 0
@@ -773,9 +789,13 @@ def rpn_target_assign(ctx, anchor, gt_boxes, is_crowd, im_info,
         score_idx = jnp.concatenate([
             jnp.where(fg_ok, fg_idx, 0),
             jnp.where(bg_ok, bg_idx, 0)])
+        # padded slots carry label -100 — the DEFAULT ignore_index of
+        # fluid.layers.sigmoid_cross_entropy_with_logits — so reference-style
+        # loss code drops them without extra arguments (the reference
+        # returns ragged sampled-only indices instead)
         labels = jnp.concatenate([
-            fg_ok.astype(jnp.int32),
-            jnp.zeros((S,), jnp.int32)])
+            jnp.where(fg_ok, 1, -100).astype(jnp.int32),
+            jnp.where(bg_ok, 0, -100).astype(jnp.int32)])
         return loc_idx, score_idx, labels, tbox, inw
 
     li, si, lab, tb, iw = jax.vmap(per_image)(gt_boxes, is_crowd, im_info)
@@ -1149,13 +1169,11 @@ def retinanet_detection_output(ctx, bboxes_list, scores_list, anchors_list,
             jnp.where(ok[:, None], bxs[top_i], -1.0)], axis=1)
         return det, jnp.sum(ok.astype(jnp.int32))
 
-    dets, nums = [], []
-    for n in range(N):
-        d, m = per_image(([b[n] for b in bboxes_list],
-                          [s[n] for s in scores_list], im_info[n]))
-        dets.append(d)
-        nums.append(m)
-    return jnp.concatenate(dets, 0), jnp.stack(nums)
+    # one trace for the whole batch: the per-level lists form a vmappable
+    # pytree (program size stays O(1) in N)
+    dets, nums = jax.vmap(per_image)(
+        (list(bboxes_list), list(scores_list), im_info))
+    return dets.reshape(-1, 6), nums
 
 
 @register_op("locality_aware_nms", inputs=("BBoxes", "Scores"),
@@ -1169,9 +1187,11 @@ def locality_aware_nms(ctx, bboxes, scores, background_label=-1,
                        nms_eta=1.0, keep_top_k=100, normalized=True):
     """locality_aware_nms_op.cc (EAST): first weighted-merge consecutive
     overlapping boxes (score-weighted average of coordinates), then standard
-    NMS.  bboxes [N, M, 4]; scores [N, 1, M].  Output [N*keep_top_k, 6]
-    -1-padded."""
+    NMS capped at nms_top_k.  bboxes [N, M, 4]; scores [N, 1, M].  Output
+    [N*keep_top_k, 6] -1-padded.  normalized=False applies the +1
+    pixel-coordinate IoU convention."""
     N, M, _ = bboxes.shape
+    off = 0.0 if normalized else 1.0
 
     def per_image(boxes, sc):
         sc = sc.reshape(-1)
@@ -1180,7 +1200,7 @@ def locality_aware_nms(ctx, bboxes, scores, background_label=-1,
             mb, ms, cnt = carry  # merged boxes/scores, count of merged slots
             cur_b, cur_s = boxes[i], sc[i]
             prev = jnp.maximum(cnt - 1, 0)
-            iou = _iou(cur_b[None], mb[prev][None])[0, 0]
+            iou = _iou_off(cur_b[None], mb[prev][None], off)[0, 0]
             do_merge = (cnt > 0) & (iou > nms_threshold)
             wsum = ms[prev] + cur_s
             merged = (mb[prev] * ms[prev] + cur_b * cur_s) / jnp.maximum(
@@ -1197,7 +1217,11 @@ def locality_aware_nms(ctx, bboxes, scores, background_label=-1,
         mb, ms, cnt = lax.fori_loop(0, M, body, (mb0, ms0, 0))
         ms = jnp.where(jnp.arange(M) < cnt, ms, -jnp.inf)
         ms = jnp.where(ms > score_threshold, ms, -jnp.inf)
-        keep = _nms_keep(mb, ms, nms_threshold, keep_top_k)
+        if nms_top_k > 0 and nms_top_k < M:
+            # pre-truncate to the top nms_top_k candidates before NMS
+            kth = lax.top_k(ms, nms_top_k)[0][-1]
+            ms = jnp.where(ms >= kth, ms, -jnp.inf)
+        keep = _nms_keep(mb, ms, nms_threshold, keep_top_k, iou_offset=off)
         keep = keep & (ms > -jnp.inf)
         k = keep_top_k
         sckeep = jnp.where(keep, ms, -jnp.inf)

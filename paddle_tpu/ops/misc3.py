@@ -251,13 +251,15 @@ def save_op(ctx, x, file_path="", overwrite=True, save_as_fp16=False):
 
     # np.save appends .npy when the suffix is missing — guard the real target
     target = file_path if file_path.endswith(".npy") else file_path + ".npy"
-    if not overwrite and os.path.exists(target):
-        raise RuntimeError("%s exists and overwrite is False" % target)
     d = os.path.dirname(file_path)
     if d:
         os.makedirs(d, exist_ok=True)
 
     def _write(arr):
+        # checked inside the callback: the guard must fire per EXECUTION,
+        # not once at trace time (save_op.h checks at each run)
+        if not overwrite and os.path.exists(target):
+            raise RuntimeError("%s exists and overwrite is False" % target)
         np.save(file_path, np.asarray(arr), allow_pickle=False)
 
     jax.debug.callback(_write, x.astype(jnp.float16) if save_as_fp16 else x)

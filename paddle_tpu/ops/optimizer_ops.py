@@ -9,6 +9,7 @@ so updates are in-place at the XLA level).
 """
 
 import jax.numpy as jnp
+from jax import lax
 
 from ..core.registry import register_op
 
@@ -243,3 +244,32 @@ def dpsgd(ctx, param, grad, lr, clip=10.0, batch_size=16.0, sigma=1.0, seed=0):
     key = jax.random.key(seed) if seed else ctx.rng()
     noise = jax.random.normal(key, param.shape, dtype=param.dtype) * sigma * clip
     return param - _lr(lr) * (g + noise / batch_size)
+
+
+@register_op("dgc", inputs=("U", "V", "Grad"),
+             outputs=("UOut", "VOut", "EncodeGrad", "GradOut"),
+             attrs={"m": 0.9, "ratio": 0.001, "use_nesterov": False,
+                    "rampup_begin_step": 0.0, "rampup_step": 0.0,
+                    "current_step": 0.0},
+             grad_maker=None)
+def dgc(ctx, u, v, grad, m=0.9, ratio=0.001, use_nesterov=False,
+        rampup_begin_step=0.0, rampup_step=0.0, current_step=0.0):
+    """Deep Gradient Compression (dgc_op.h; Lin et al. 2017): momentum
+    correction + local gradient accumulation + top-ratio sparsification
+    with error feedback.  EncodeGrad is dense-with-zeros (the reference
+    allgathers sparse (idx, val) pairs; summing dense-with-zeros over the
+    ring computes the same allreduce on TPU, where the dense psum rides
+    ICI).  k = max(1, ratio * numel)."""
+    g = grad.astype(jnp.float32)
+    u_new = m * u + g                     # momentum correction
+    # nesterov variant accumulates the lookahead m*u + g (dgc_op.h)
+    v_new = v + (m * u_new + g if use_nesterov else u_new)
+    flat = v_new.reshape(-1)
+    n = flat.shape[0]
+    k = max(int(n * float(ratio)), 1)
+    thr = lax.top_k(jnp.abs(flat), k)[0][-1]
+    mask = (jnp.abs(v_new) >= thr).astype(g.dtype)
+    encode = v_new * mask
+    v_out = v_new * (1.0 - mask)          # error feedback residual
+    u_out = u_new * (1.0 - mask)
+    return u_out, v_out, encode, encode.astype(grad.dtype)

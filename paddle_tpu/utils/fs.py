@@ -1,0 +1,133 @@
+"""Filesystem shims: local FS + HDFS via shell (parity:
+paddle/fluid/framework/io/fs.cc + shell.cc — the reference shells out to
+`hadoop fs` through popen; so do we — and
+python/paddle/fluid/incubate/fleet/utils/hdfs.py HDFSClient)."""
+
+import os
+import shutil
+import subprocess
+
+__all__ = ["LocalFS", "HDFSClient"]
+
+
+class LocalFS:
+    """Local filesystem with the fs.cc surface (localfs_* functions)."""
+
+    def ls_dir(self, path):
+        if not os.path.exists(path):
+            return []
+        return sorted(os.listdir(path))
+
+    def is_exist(self, path):
+        return os.path.exists(path)
+
+    def is_dir(self, path):
+        return os.path.isdir(path)
+
+    def is_file(self, path):
+        return os.path.isfile(path)
+
+    def mkdirs(self, path):
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path):
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def touch(self, path):
+        open(path, "a").close()
+
+    def mv(self, src, dst):
+        shutil.move(src, dst)
+
+    def upload(self, local, remote):
+        shutil.copy(local, remote)
+
+    def download(self, remote, local):
+        shutil.copy(remote, local)
+
+
+class HDFSClient:
+    """HDFS client shelling out to `hadoop fs` (hdfs.py:HDFSClient;
+    fs.cc hdfs_* commands run the same shell pipeline).
+
+    hadoop_home: directory containing bin/hadoop.  configs: dict of
+    hadoop config key->value passed as -D options (e.g.
+    fs.default.name, hadoop.job.ugi)."""
+
+    def __init__(self, hadoop_home=None, configs=None, retry_times=3):
+        self.hadoop_home = hadoop_home or os.environ.get("HADOOP_HOME", "")
+        self.configs = dict(configs or {})
+        self.retry_times = retry_times
+        self._bin = (os.path.join(self.hadoop_home, "bin", "hadoop")
+                     if self.hadoop_home else "hadoop")
+
+    def _base_cmd(self):
+        cmd = [self._bin, "fs"]
+        for k, v in self.configs.items():
+            cmd += ["-D%s=%s" % (k, v)]
+        return cmd
+
+    def _run(self, args, check=True, retry=True):
+        if shutil.which(self._bin) is None and not os.path.exists(self._bin):
+            raise RuntimeError(
+                "hadoop binary not found (%r); set hadoop_home or "
+                "HADOOP_HOME" % self._bin)
+        last = None
+        for _ in range(max(self.retry_times, 1) if retry else 1):
+            p = subprocess.run(self._base_cmd() + args, capture_output=True,
+                               text=True)
+            last = p
+            if p.returncode == 0:
+                return p
+        if check:
+            raise RuntimeError("hadoop fs %s failed: %s"
+                               % (" ".join(args), last.stderr))
+        return last
+
+    # -- HDFSClient surface (hdfs.py) -----------------------------------------
+
+    def ls(self, path):
+        p = self._run(["-ls", path])
+        out = []
+        for line in p.stdout.splitlines():
+            parts = line.split()
+            if len(parts) >= 8:
+                out.append(parts[-1])
+        return out
+
+    def is_exist(self, path):
+        p = self._run(["-test", "-e", path], check=False, retry=False)
+        return p is not None and p.returncode == 0
+
+    def is_dir(self, path):
+        p = self._run(["-test", "-d", path], check=False, retry=False)
+        return p is not None and p.returncode == 0
+
+    def is_file(self, path):
+        return self.is_exist(path) and not self.is_dir(path)
+
+    def delete(self, path):
+        self._run(["-rmr", path], check=False)
+
+    def makedirs(self, path):
+        self._run(["-mkdir", "-p", path])
+
+    def rename(self, src, dst):
+        self._run(["-mv", src, dst])
+
+    def upload(self, hdfs_path, local_path, overwrite=False):
+        args = ["-put"]
+        if overwrite:
+            args.append("-f")
+        self._run(args + [local_path, hdfs_path])
+
+    def download(self, hdfs_path, local_path, overwrite=False):
+        if overwrite and os.path.exists(local_path):
+            LocalFS().delete(local_path)
+        self._run(["-get", hdfs_path, local_path])
+
+    def touch(self, path):
+        self._run(["-touchz", path])

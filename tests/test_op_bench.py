@@ -1,0 +1,40 @@
+"""Config-driven op micro-bench harness test (op_tester.cc parity)."""
+
+import json
+import os
+import subprocess
+import sys
+
+
+def test_op_bench_runs(tmp_path):
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import op_bench
+
+    r = op_bench.bench_op({
+        "op_type": "matmul",
+        "inputs": {"X": {"dims": [8, 16]}, "Y": {"dims": [16, 4]}},
+        "repeat": 3, "warmup": 1,
+    }, device="cpu")
+    assert r["op_type"] == "matmul"
+    assert r["mean_ms"] > 0
+    assert r["min_ms"] <= r["p50_ms"]
+
+    # the CLI path: natural/zeros initializers + multiple configs
+    cfg = [{"op_type": "relu",
+            "inputs": {"X": {"dims": [4, 4], "initializer": "natural"}},
+            "repeat": 2, "warmup": 1},
+           {"op_type": "scale",
+            "inputs": {"X": {"dims": [4], "initializer": "zeros"}},
+            "attrs": {"scale": 2.0}, "repeat": 2, "warmup": 1}]
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps(cfg))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "op_bench.py"),
+         str(p), "--device", "cpu"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert out.returncode == 0, out.stderr
+    lines = [json.loads(l) for l in out.stdout.strip().splitlines()]
+    assert [l["op_type"] for l in lines] == ["relu", "scale"]
