@@ -52,7 +52,6 @@ class SparseTableServer:
         self.rows = {}            # global id -> np[D]
         self.g2sum = {}           # adagrad accumulator
         self.rng = np.random.RandomState(seed)
-        self._pending = {}        # (kind, client, seq) -> ids waiting for pair
         self._thread = None
 
     # -- row access -----------------------------------------------------------
@@ -80,6 +79,7 @@ class SparseTableServer:
         """Blocking poll loop; returns after COMPLETE or shutdown."""
         self.server.serve(True)
         pending_push = {}
+        last_rows_var = {}   # client tag prefix -> last published var name
         while True:
             t, name, arr = self.server.poll()
             if t == 0 or t == EV_COMPLETE:
@@ -92,8 +92,20 @@ class SparseTableServer:
                 ids = arr.astype(np.int64).reshape(-1)
                 out = np.stack([self._row(int(g)) for g in ids]) \
                     if len(ids) else np.zeros((0, self.dim), np.float32)
-                self.server.set_var("%s.rows@%s" % (tbl, tag), out)
+                var = "%s.rows@%s" % (tbl, tag)
+                self.server.set_var(var, out)
+                # GC the previous pull's published rows for this client —
+                # pulls are sequential per client, so seq-1 was consumed
+                # before seq was requested (cf. dense PS version GC,
+                # distributed/ps.py publish())
+                client = tag.split("#", 1)[0]
+                prev = last_rows_var.get((tbl, client))
+                if prev is not None and prev != var:
+                    self.server.del_var(prev)
+                last_rows_var[(tbl, client)] = var
             elif kind == "push_ids":
+                if len(pending_push) > 1024:
+                    pending_push.pop(next(iter(pending_push)))  # orphan cap
                 pending_push[tag] = arr.astype(np.int64).reshape(-1)
             elif kind == "push_grads":
                 ids = pending_push.pop(tag, None)
@@ -115,11 +127,15 @@ class SparseTableClient:
     """Trainer-side pull/push routing ids to shards by id % n_servers
     (FleetWrapper::PullSparseVarsSync / PushSparseVarsAsync analog)."""
 
-    def __init__(self, table, endpoints, client_id=0):
+    def __init__(self, table, endpoints, client_id=None):
+        import os
+
         self.table = table
         self.clients = [RpcClient(ep) for ep in endpoints]
         self.n = len(endpoints)
-        self.client_id = client_id
+        # default to the pid so two trainer processes can't collide on
+        # pull/push tags without explicitly choosing ids
+        self.client_id = os.getpid() if client_id is None else client_id
         self._seq = 0
 
     def pull(self, ids):

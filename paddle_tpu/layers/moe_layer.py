@@ -1,0 +1,62 @@
+"""Mixture-of-experts layer (NEW capability vs the reference — EP is
+absent in the 2019 codebase).  Kernel: parallel/moe.py; op: ops/collective.py
+moe_ffn."""
+
+from ..layer_helper import LayerHelper
+
+__all__ = ["moe"]
+
+
+def moe(input, num_experts, hidden_size, top_k=2, capacity_factor=1.25,
+        param_attr=None, expert_parallel_axis=None, name=None):
+    """Mixture-of-experts FFN layer (NEW capability vs the reference — EP
+    is absent in the 2019 codebase; see parallel/moe.py).  input [..., D];
+    returns (out [..., D], aux_loss scalar).  `expert_parallel_axis` maps
+    to a mesh-axis ring_id for shard_map EP; None shards via auto-SPMD
+    (expert dim annotated over the "expert" axis when present)."""
+    from ..param_attr import ParamAttr
+    from ..initializer import Normal
+
+    helper = LayerHelper("moe", name=name)
+    dtype = input.dtype
+    D, H, E = input.shape[-1], hidden_size, num_experts
+
+    def attr(suffix, shard):
+        base = param_attr if isinstance(param_attr, ParamAttr) else None
+        a = ParamAttr(
+            name=((base.name if base and base.name else helper.name)
+                  + "_" + suffix),
+            initializer=(base.initializer if base else None),
+            sharding=shard if expert_parallel_axis is None else None)
+        return a
+
+    gate_w = helper.create_parameter(
+        attr=attr("gate", None), shape=[D, E], dtype=dtype,
+        default_initializer=Normal(0.0, 0.02))
+    w1 = helper.create_parameter(
+        attr=attr("w1", ("expert", None, None)), shape=[E, D, H],
+        dtype=dtype, default_initializer=Normal(0.0, 0.02))
+    b1 = helper.create_parameter(
+        attr=attr("b1", ("expert", None)), shape=[E, H], dtype=dtype,
+        is_bias=True)
+    w2 = helper.create_parameter(
+        attr=attr("w2", ("expert", None, None)), shape=[E, H, D],
+        dtype=dtype, default_initializer=Normal(0.0, 0.02))
+    b2 = helper.create_parameter(
+        attr=attr("b2", ("expert", None)), shape=[E, D], dtype=dtype,
+        is_bias=True)
+    out = helper.create_variable_for_type_inference(dtype)
+    aux = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="moe_ffn",
+        inputs={"X": [input], "GateW": [gate_w], "W1": [w1], "B1": [b1],
+                "W2": [w2], "B2": [b2]},
+        outputs={"Out": [out], "AuxLoss": [aux]},
+        attrs={"top_k": top_k, "capacity_factor": capacity_factor,
+               "axis_name": (expert_parallel_axis
+                             if isinstance(expert_parallel_axis, str)
+                             else ""),
+               "ring_id": (expert_parallel_axis
+                           if isinstance(expert_parallel_axis, int)
+                           else -1)})
+    return out, aux

@@ -19,13 +19,22 @@ def _box_area(b):
         b[..., 3] - b[..., 1], 0)
 
 
-def _iou(a, b, eps=1e-10):
-    """a [N,4], b [M,4] -> [N,M] IoU (xmin,ymin,xmax,ymax)."""
+def _iou(a, b, offset=0.0, eps=1e-10):
+    """a [N,4], b [M,4] -> [N,M] IoU (xmin,ymin,xmax,ymax).
+    offset=1.0 applies the pixel-coordinate +1 convention
+    (bbox_util's normalized=False path)."""
     lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
     rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
-    wh = jnp.maximum(rb - lt, 0)
+    wh = jnp.maximum(rb - lt + offset, 0)
     inter = wh[..., 0] * wh[..., 1]
-    union = _box_area(a)[:, None] + _box_area(b)[None, :] - inter
+    if offset:
+        area_a = jnp.maximum(a[:, 2] - a[:, 0] + offset, 0) * jnp.maximum(
+            a[:, 3] - a[:, 1] + offset, 0)
+        area_b = jnp.maximum(b[:, 2] - b[:, 0] + offset, 0) * jnp.maximum(
+            b[:, 3] - b[:, 1] + offset, 0)
+        union = area_a[:, None] + area_b[None, :] - inter
+    else:
+        union = _box_area(a)[:, None] + _box_area(b)[None, :] - inter
     return inter / jnp.maximum(union, eps)
 
 

@@ -630,19 +630,7 @@ def _encode_anchor(anchor, gt, var=None):
     return t
 
 
-def _iou_off(a, b, offset=0.0, eps=1e-10):
-    """IoU with the pixel-coordinate +1 convention when offset=1
-    (bbox_util's normalized=False path)."""
-    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
-    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
-    wh = jnp.maximum(rb - lt + offset, 0)
-    inter = wh[..., 0] * wh[..., 1]
-    area_a = jnp.maximum(a[:, 2] - a[:, 0] + offset, 0) * jnp.maximum(
-        a[:, 3] - a[:, 1] + offset, 0)
-    area_b = jnp.maximum(b[:, 2] - b[:, 0] + offset, 0) * jnp.maximum(
-        b[:, 3] - b[:, 1] + offset, 0)
-    union = area_a[:, None] + area_b[None, :] - inter
-    return inter / jnp.maximum(union, eps)
+_iou_off = _iou  # shared helper (ops/detection.py) — offset param covers both
 
 
 def _nms_keep(boxes, scores, thresh, max_keep, iou_offset=0.0):

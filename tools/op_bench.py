@@ -97,7 +97,7 @@ def bench_op(cfg, device=None):
     times = []
     with fluid.scope_guard(fluid.Scope()):
         exe.run(startup)
-        for _ in range(warmup):
+        for _ in range(max(warmup, 1)):  # >=1: the first run compiles
             o = exe.run(main, feed=feeds, fetch_list=fetch[:1],
                         return_numpy=False)
         np.asarray(o[0])
