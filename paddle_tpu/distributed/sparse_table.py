@@ -127,22 +127,27 @@ class SparseTableClient:
     """Trainer-side pull/push routing ids to shards by id % n_servers
     (FleetWrapper::PullSparseVarsSync / PushSparseVarsAsync analog)."""
 
+    _instance_counter = __import__("itertools").count()
+
     def __init__(self, table, endpoints, client_id=None):
         import os
 
         self.table = table
         self.clients = [RpcClient(ep) for ep in endpoints]
         self.n = len(endpoints)
-        # default to the pid so two trainer processes can't collide on
-        # pull/push tags without explicitly choosing ids
-        self.client_id = os.getpid() if client_id is None else client_id
+        # default id is unique across processes (pid) AND across instances
+        # within one process (counter) so pull/push tags never collide
+        if client_id is None:
+            client_id = "%d-%d" % (os.getpid(),
+                                   next(SparseTableClient._instance_counter))
+        self.client_id = client_id
         self._seq = 0
 
     def pull(self, ids):
         """ids: int array of global row ids -> rows [len(ids), D] in order."""
         ids = np.asarray(ids, np.int64).reshape(-1)
         self._seq += 1
-        tag = "%d#%d" % (self.client_id, self._seq)
+        tag = "%s#%d" % (self.client_id, self._seq)
         per = [ids[ids % self.n == s] for s in range(self.n)]
         for s, cl in enumerate(self.clients):
             cl.send_var("%s.pull_ids@%s" % (self.table, tag), per[s])
@@ -159,7 +164,7 @@ class SparseTableClient:
         ids = np.asarray(ids, np.int64).reshape(-1)
         grads = np.asarray(grads, np.float32).reshape(len(ids), -1)
         self._seq += 1
-        tag = "%d#%d" % (self.client_id, self._seq)
+        tag = "%s#%d" % (self.client_id, self._seq)
         for s, cl in enumerate(self.clients):
             m = ids % self.n == s
             cl.send_var("%s.push_ids@%s" % (self.table, tag), ids[m])

@@ -14,9 +14,9 @@ from jax import lax
 from ..core.registry import register_op
 
 
-def _box_area(b):
-    return jnp.maximum(b[..., 2] - b[..., 0], 0) * jnp.maximum(
-        b[..., 3] - b[..., 1], 0)
+def _box_area(b, offset=0.0):
+    return jnp.maximum(b[..., 2] - b[..., 0] + offset, 0) * jnp.maximum(
+        b[..., 3] - b[..., 1] + offset, 0)
 
 
 def _iou(a, b, offset=0.0, eps=1e-10):
@@ -27,14 +27,8 @@ def _iou(a, b, offset=0.0, eps=1e-10):
     rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
     wh = jnp.maximum(rb - lt + offset, 0)
     inter = wh[..., 0] * wh[..., 1]
-    if offset:
-        area_a = jnp.maximum(a[:, 2] - a[:, 0] + offset, 0) * jnp.maximum(
-            a[:, 3] - a[:, 1] + offset, 0)
-        area_b = jnp.maximum(b[:, 2] - b[:, 0] + offset, 0) * jnp.maximum(
-            b[:, 3] - b[:, 1] + offset, 0)
-        union = area_a[:, None] + area_b[None, :] - inter
-    else:
-        union = _box_area(a)[:, None] + _box_area(b)[None, :] - inter
+    union = _box_area(a, offset)[:, None] + _box_area(b, offset)[None, :] \
+        - inter
     return inter / jnp.maximum(union, eps)
 
 

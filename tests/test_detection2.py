@@ -631,3 +631,119 @@ def test_doc_helpers():
 
     with pytest.warns(DeprecationWarning):
         assert g() == 42
+
+
+# -- numeric gradient checks (op_test.py check_grad analog: analytic jax
+# vjp vs central finite differences on the op lowerings) -----------------
+
+
+def _numeric_vs_autodiff(fn, args, wrt, delta=1e-3, rtol=5e-2, atol=1e-3):
+    import jax
+    import jax.numpy as jnp
+
+    loss = lambda *a: jnp.sum(fn(*a))
+    g = np.asarray(jax.grad(loss, argnums=wrt)(*args))
+    a0 = np.asarray(args[wrt], "float64").copy()
+    flat = a0.reshape(-1)
+    idx = np.linspace(0, flat.size - 1, min(24, flat.size)).astype(int)
+    for i in idx:
+        pert = flat.copy()
+        pert[i] += delta
+        ap = [np.asarray(a) for a in args]
+        ap[wrt] = pert.reshape(a0.shape).astype("float32")
+        up = float(np.sum(np.asarray(fn(*[jnp.asarray(a) for a in ap]))))
+        pert[i] -= 2 * delta
+        ap[wrt] = pert.reshape(a0.shape).astype("float32")
+        dn = float(np.sum(np.asarray(fn(*[jnp.asarray(a) for a in ap]))))
+        num = (up - dn) / (2 * delta)
+        got = float(g.reshape(-1)[i])
+        assert abs(got - num) <= atol + rtol * abs(num), (
+            "grad mismatch at %d: analytic=%g numeric=%g" % (i, got, num))
+
+
+def test_prroi_pool_gradients():
+    import jax.numpy as jnp
+    from paddle_tpu.core.registry import get_op_def
+
+    opdef = get_op_def("prroi_pool")
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(1, 2, 6, 6).astype("f"))
+    rois = jnp.asarray(np.array([[0, 1.0, 1.0, 4.2, 4.7]], "f"))
+
+    fn = lambda xv, rv: opdef.lower(None, xv, rv, spatial_scale=1.0,
+                                    pooled_height=2, pooled_width=2)
+    _numeric_vs_autodiff(fn, [x, rois], 0)   # d/dx
+    _numeric_vs_autodiff(fn, [x, rois], 1)   # d/drois (PrRoI is roi-diff'able)
+
+
+def test_psroi_pool_gradient_wrt_x():
+    import jax.numpy as jnp
+    from paddle_tpu.core.registry import get_op_def
+
+    opdef = get_op_def("psroi_pool")
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(1, 8, 6, 6).astype("f"))
+    rois = jnp.asarray(np.array([[0, 0, 0, 5, 5]], "f"))
+    fn = lambda xv: opdef.lower(None, xv, rois, output_channels=2,
+                                spatial_scale=1.0, pooled_height=2,
+                                pooled_width=2)
+    _numeric_vs_autodiff(fn, [x], 0)
+
+
+def test_deformable_conv_gradients():
+    import jax.numpy as jnp
+    from paddle_tpu.core.registry import get_op_def
+
+    opdef = get_op_def("deformable_conv")
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(1, 2, 5, 5).astype("f"))
+    # keep sample points off the pixel lattice: bilinear interpolation has
+    # kinks at integer coords where finite differences straddle the
+    # non-smooth point (analytic grad is one-sided there, by design)
+    off = jnp.asarray((0.2 * rng.randn(1, 2 * 9, 5, 5) + 0.37).astype("f"))
+    msk = jnp.asarray(rng.rand(1, 9, 5, 5).astype("f"))
+    w = jnp.asarray(0.2 * rng.randn(3, 2, 3, 3).astype("f"))
+    fn = lambda xv, ov, mv, wv: opdef.lower(
+        None, xv, ov, mv, wv, strides=(1, 1), paddings=(1, 1))
+    args = [x, off, msk, w]
+    for i in range(4):   # x, offset (bilinear-diff'able), mask, filter
+        _numeric_vs_autodiff(fn, args, i)
+
+
+def test_yolov3_loss_gradient_wrt_x():
+    import jax.numpy as jnp
+    from paddle_tpu.core.registry import get_op_def
+
+    opdef = get_op_def("yolov3_loss")
+    rng = np.random.RandomState(3)
+    C, m, H = 2, 3, 4
+    x = jnp.asarray(rng.randn(1, m * (5 + C), H, H).astype("f"))
+    gt = jnp.asarray(np.array(
+        [[[0.4, 0.4, 0.3, 0.3], [0.7, 0.7, 0.2, 0.2]]], "f"))
+    lab = jnp.asarray(np.array([[0, 1]], "int32"))
+
+    fn = lambda xv: opdef.lower(
+        None, xv, gt, lab, None, anchors=[10, 13, 16, 30, 33, 23],
+        anchor_mask=[0, 1, 2], class_num=C, ignore_thresh=0.9,
+        downsample_ratio=32)[0]
+    # ignore_thresh=0.9 keeps the ignore mask stable under the perturbation
+    _numeric_vs_autodiff(fn, [x], 0, delta=5e-3, rtol=8e-2, atol=5e-3)
+
+
+def test_moe_ffn_gradients():
+    import jax.numpy as jnp
+    from paddle_tpu.parallel.moe import moe_ffn
+
+    rng = np.random.RandomState(4)
+    T, D, Hd, E = 6, 4, 8, 2
+    x = jnp.asarray(rng.randn(T, D).astype("f"))
+    gw = jnp.asarray(rng.randn(D, E).astype("f"))
+    w1 = jnp.asarray(0.2 * rng.randn(E, D, Hd).astype("f"))
+    b1 = jnp.asarray(0.1 * rng.randn(E, Hd).astype("f"))
+    w2 = jnp.asarray(0.2 * rng.randn(E, Hd, D).astype("f"))
+    b2 = jnp.asarray(0.1 * rng.randn(E, D).astype("f"))
+
+    fn = lambda *a: moe_ffn(*a, top_k=2, capacity_factor=100.0)[0]
+    args = [x, gw, w1, b1, w2, b2]
+    for i in (0, 2, 3, 4, 5):   # x and expert params (gate grad has
+        _numeric_vs_autodiff(fn, args, i)   # top-k discontinuities)

@@ -1,0 +1,156 @@
+"""Numerical parity vs torch (CPU): the BASELINE loss-parity gate proxy.
+
+The reference's correctness bar is loss-curve parity with its CUDA kernels;
+torch's CPU kernels are the accessible stand-in here.  Same weights, same
+data, fp32: forward losses and per-step training trajectories must agree to
+fp32-accumulation tolerance."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import paddle_tpu as fluid
+
+
+def _set_param(scope, name, value):
+    scope.find_var(name).get_tensor().set(np.asarray(value, "float32"))
+
+
+def test_convnet_loss_and_training_match_torch():
+    B, C, H, W, K = 8, 3, 16, 16, 5
+    rng = np.random.RandomState(0)
+    xb = rng.randn(B, C, H, W).astype("f")
+    yb = rng.randint(0, K, (B, 1)).astype("int64")
+
+    # weights shared by both frameworks
+    w1 = (rng.randn(8, C, 3, 3) * 0.1).astype("f")
+    w2 = (rng.randn(K, 8 * 8 * 8) * 0.1).astype("f")   # after 2x2 pool
+    b2 = np.zeros(K, "f")
+
+    # -- ours
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[C, H, W])
+        y = fluid.layers.data("y", shape=[1], dtype="int64")
+        conv = fluid.layers.conv2d(
+            x, 8, 3, padding=1, bias_attr=False,
+            param_attr=fluid.ParamAttr(name="w1"))
+        act = fluid.layers.relu(conv)
+        pool = fluid.layers.pool2d(act, pool_size=2, pool_stride=2)
+        logits = fluid.layers.fc(
+            pool, K, param_attr=fluid.ParamAttr(name="w2"),
+            bias_attr=fluid.ParamAttr(name="b2"))
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    ours = []
+    with fluid.scope_guard(fluid.Scope()) as _:
+        scope = fluid.core.executor.global_scope()
+        exe.run(startup)
+        _set_param(scope, "w1", w1)
+        # fluid fc keeps [in, out]
+        _set_param(scope, "w2", w2.T)
+        _set_param(scope, "b2", b2)
+        for _ in range(5):
+            lo, = exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+            ours.append(float(np.asarray(lo).reshape(-1)[0]))
+
+    # -- torch
+    tconv = torch.nn.Conv2d(C, 8, 3, padding=1, bias=False)
+    tfc = torch.nn.Linear(8 * 8 * 8, K)
+    with torch.no_grad():
+        tconv.weight.copy_(torch.from_numpy(w1))
+        tfc.weight.copy_(torch.from_numpy(w2))
+        tfc.bias.copy_(torch.from_numpy(b2))
+    opt = torch.optim.SGD(list(tconv.parameters()) + list(tfc.parameters()),
+                          lr=0.1)
+    tx = torch.from_numpy(xb)
+    ty = torch.from_numpy(yb.ravel())
+    theirs = []
+    for _ in range(5):
+        opt.zero_grad()
+        h = torch.nn.functional.max_pool2d(torch.relu(tconv(tx)), 2)
+        logits_t = tfc(h.reshape(B, -1))
+        l = torch.nn.functional.cross_entropy(logits_t, ty)
+        l.backward()
+        opt.step()
+        theirs.append(float(l.detach()))
+
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-5)
+
+
+def test_adam_trajectory_matches_torch():
+    rng = np.random.RandomState(1)
+    w0 = rng.randn(6, 4).astype("f")
+    xb = rng.randn(12, 6).astype("f")
+    yb = rng.randn(12, 4).astype("f")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[6])
+        y = fluid.layers.data("y", shape=[4])
+        pred = fluid.layers.fc(x, 4, param_attr=fluid.ParamAttr(name="w"),
+                               bias_attr=False)
+        loss = fluid.layers.mean(fluid.layers.square(pred - y))
+        fluid.optimizer.Adam(learning_rate=1e-2, beta1=0.9, beta2=0.999,
+                             epsilon=1e-8).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    ours = []
+    with fluid.scope_guard(fluid.Scope()):
+        scope = fluid.core.executor.global_scope()
+        exe.run(startup)
+        _set_param(scope, "w", w0)
+        for _ in range(10):
+            lo, = exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+            ours.append(float(np.asarray(lo).reshape(-1)[0]))
+        w_final = np.asarray(scope.find_var("w").get_tensor().numpy())
+
+    wt = torch.nn.Parameter(torch.from_numpy(w0.copy()))
+    opt = torch.optim.Adam([wt], lr=1e-2, betas=(0.9, 0.999), eps=1e-8)
+    tx, ty = torch.from_numpy(xb), torch.from_numpy(yb)
+    theirs = []
+    for _ in range(10):
+        opt.zero_grad()
+        l = torch.mean((tx @ wt - ty) ** 2)
+        l.backward()
+        opt.step()
+        theirs.append(float(l.detach()))
+
+    np.testing.assert_allclose(ours, theirs, rtol=2e-5, atol=1e-6)
+    np.testing.assert_allclose(w_final, wt.detach().numpy(), rtol=2e-4,
+                               atol=2e-5)
+
+
+def test_layernorm_gelu_block_matches_torch():
+    rng = np.random.RandomState(2)
+    B, D, Hd = 4, 16, 32
+    xb = rng.randn(B, D).astype("f")
+    w1 = (rng.randn(D, Hd) * 0.1).astype("f")
+    w2 = (rng.randn(Hd, D) * 0.1).astype("f")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[D])
+        h = fluid.layers.fc(x, Hd, act="gelu",
+                            param_attr=fluid.ParamAttr(name="w1"),
+                            bias_attr=False)
+        o = fluid.layers.fc(h, D, param_attr=fluid.ParamAttr(name="w2"),
+                            bias_attr=False)
+        res = fluid.layers.layer_norm(x + o, begin_norm_axis=1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        scope = fluid.core.executor.global_scope()
+        exe.run(startup)
+        _set_param(scope, "w1", w1)
+        _set_param(scope, "w2", w2)
+        ours, = exe.run(main, feed={"x": xb}, fetch_list=[res])
+    ours = np.asarray(ours)
+
+    tx = torch.from_numpy(xb)
+    th = torch.nn.functional.gelu(tx @ torch.from_numpy(w1))
+    to = th @ torch.from_numpy(w2)
+    want = torch.nn.functional.layer_norm(tx + to, (D,)).numpy()
+    np.testing.assert_allclose(ours, want, rtol=1e-3, atol=2e-4)
