@@ -4,3 +4,5 @@ from . import mnist  # noqa: F401
 from . import resnet  # noqa: F401
 from . import bert  # noqa: F401
 from . import transformer  # noqa: F401
+from . import yolov3  # noqa: F401
+from . import word2vec  # noqa: F401

@@ -1,0 +1,33 @@
+"""Word2vec n-gram (CBOW-style) model (reference book test:
+python/paddle/fluid/tests/book/test_word2vec.py — 4 context words predict
+the next word through a shared embedding)."""
+
+import paddle_tpu as fluid
+
+
+def build_train(dict_size, embed_size=32, hidden_size=64, lr=1e-3,
+                is_test=False, is_sparse=False):
+    """N-gram LM exactly like the book test: four context words feed one
+    shared embedding table, concat -> fc -> softmax over the vocab.
+    Returns (word_vars, next_word_var, avg_cost)."""
+    words = [fluid.layers.data("firstw", shape=[1], dtype="int64"),
+             fluid.layers.data("secondw", shape=[1], dtype="int64"),
+             fluid.layers.data("thirdw", shape=[1], dtype="int64"),
+             fluid.layers.data("forthw", shape=[1], dtype="int64")]
+    next_word = fluid.layers.data("nextw", shape=[1], dtype="int64")
+
+    embeds = []
+    for w in words:
+        e = fluid.layers.embedding(
+            w, size=[dict_size, embed_size], dtype="float32",
+            is_sparse=is_sparse,
+            param_attr=fluid.ParamAttr(name="shared_w"))
+        embeds.append(e)
+    concat = fluid.layers.concat(embeds, axis=1)
+    hidden = fluid.layers.fc(concat, hidden_size, act="sigmoid")
+    predict = fluid.layers.fc(hidden, dict_size, act="softmax")
+    cost = fluid.layers.cross_entropy(predict, next_word)
+    avg_cost = fluid.layers.mean(cost)
+    if not is_test:
+        fluid.optimizer.SGD(learning_rate=lr).minimize(avg_cost)
+    return words, next_word, avg_cost

@@ -1,0 +1,97 @@
+"""YOLOv3-tiny detection model (reference analog: the yolov3 config family
+served by detection ops — yolov3_loss_op.cc + yolo_box_op.cc; book-test
+style train/infer builders).
+
+Backbone: reduced darknet (conv-bn-leaky stacks with stride-2
+downsampling); two detection heads at strides 32 and 16 with the standard
+tiny anchor set."""
+
+import paddle_tpu as fluid
+
+TINY_ANCHORS = [10, 14, 23, 27, 37, 58, 81, 82, 135, 169, 344, 319]
+TINY_MASKS = [[3, 4, 5], [0, 1, 2]]
+
+
+def conv_bn_leaky(x, ch, ksize, stride=1, is_test=False, name=None):
+    c = fluid.layers.conv2d(x, ch, ksize, stride=stride,
+                            padding=(ksize - 1) // 2, bias_attr=False,
+                            name=name)
+    b = fluid.layers.batch_norm(c, is_test=is_test)
+    return fluid.layers.leaky_relu(b, alpha=0.1)
+
+
+def backbone(img, is_test=False, width=16):
+    """Returns (route (stride 16), deep (stride 32)) feature maps."""
+    x = conv_bn_leaky(img, width, 3, is_test=is_test)
+    x = fluid.layers.pool2d(x, 2, pool_stride=2)
+    x = conv_bn_leaky(x, width * 2, 3, is_test=is_test)
+    x = fluid.layers.pool2d(x, 2, pool_stride=2)
+    x = conv_bn_leaky(x, width * 4, 3, is_test=is_test)
+    x = fluid.layers.pool2d(x, 2, pool_stride=2)
+    x = conv_bn_leaky(x, width * 8, 3, is_test=is_test)
+    route = fluid.layers.pool2d(x, 2, pool_stride=2)          # stride 16
+    route = conv_bn_leaky(route, width * 16, 3, is_test=is_test)
+    deep = fluid.layers.pool2d(route, 2, pool_stride=2)       # stride 32
+    deep = conv_bn_leaky(deep, width * 32, 3, is_test=is_test)
+    return route, deep
+
+
+def heads(route, deep, class_num, is_test=False, width=16):
+    """Two yolo heads -> list of (feature_map, anchor_mask, downsample)."""
+    n_out = 3 * (5 + class_num)
+    d = conv_bn_leaky(deep, width * 16, 1, is_test=is_test)
+    head32 = fluid.layers.conv2d(d, n_out, 1)
+    up = fluid.layers.resize_nearest(d, scale=2.0)
+    cat = fluid.layers.concat([up, route], axis=1)
+    c = conv_bn_leaky(cat, width * 8, 3, is_test=is_test)
+    head16 = fluid.layers.conv2d(c, n_out, 1)
+    return [(head32, TINY_MASKS[0], 32), (head16, TINY_MASKS[1], 16)]
+
+
+def build_train(class_num=4, image_size=224, max_boxes=6, lr=1e-3,
+                is_test=False, width=8):
+    """Returns (img, gt_box, gt_label, loss)."""
+    img = fluid.layers.data("img", shape=[3, image_size, image_size])
+    gt_box = fluid.layers.data("gt_box", shape=[max_boxes, 4])
+    gt_label = fluid.layers.data("gt_label", shape=[max_boxes],
+                                 dtype="int32")
+    route, deep = backbone(img, is_test=is_test, width=width)
+    losses = []
+    for fm, mask, down in heads(route, deep, class_num, is_test=is_test,
+                                width=width):
+        l = fluid.layers.yolov3_loss(
+            fm, gt_box, gt_label, anchors=TINY_ANCHORS, anchor_mask=mask,
+            class_num=class_num, ignore_thresh=0.7, downsample_ratio=down)
+        losses.append(fluid.layers.mean(l))
+    loss = fluid.layers.sum(losses)
+    if not is_test:
+        fluid.optimizer.Adam(learning_rate=lr).minimize(loss)
+    return img, gt_box, gt_label, loss
+
+
+def build_infer(class_num=4, image_size=224, width=8,
+                conf_thresh=0.1, nms_thresh=0.45):
+    """Returns (img, im_shape, pred): pred is the multiclass_nms output
+    [kept, 6] rows of (label, score, x1, y1, x2, y2), -1-padded."""
+    img = fluid.layers.data("img", shape=[3, image_size, image_size])
+    im_shape = fluid.layers.data("im_shape", shape=[2], dtype="int32")
+    route, deep = backbone(img, is_test=True, width=width)
+    all_boxes, all_scores = [], []
+    for fm, mask, down in heads(route, deep, class_num, is_test=True,
+                                width=width):
+        anchors = []
+        for m in mask:
+            anchors += TINY_ANCHORS[2 * m:2 * m + 2]
+        b, s = fluid.layers.yolo_box(fm, im_shape, anchors, class_num,
+                                     conf_thresh, down)
+        all_boxes.append(b)
+        all_scores.append(fluid.layers.transpose(s, [0, 2, 1]))
+    boxes = fluid.layers.concat(all_boxes, axis=1)
+    scores = fluid.layers.concat(all_scores, axis=2)
+    # background_label=-1: YOLO scores carry no background slot — class 0
+    # is a real class (the reference yolov3 configs do the same)
+    pred = fluid.layers.multiclass_nms(boxes, scores, score_threshold=0.005,
+                                       nms_top_k=100, keep_top_k=50,
+                                       nms_threshold=nms_thresh,
+                                       background_label=-1)
+    return img, im_shape, pred

@@ -127,3 +127,77 @@ def test_lr_scheduler_noam_and_warmup():
             vals.append(float(out[0]))
     # warmup region: increasing
     assert vals[1] > vals[0]
+
+
+def test_yolov3_tiny_trains():
+    from paddle_tpu.models import yolov3
+
+    rng = np.random.RandomState(0)
+    B, S, MB = 2, 64, 3
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img, gt_box, gt_label, loss = yolov3.build_train(
+            class_num=3, image_size=S, max_boxes=MB, lr=5e-3, width=4)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xb = rng.rand(B, 3, S, S).astype("f")
+    # fixed normalized center-format boxes
+    gb = np.zeros((B, MB, 4), "f")
+    gb[:, 0] = [0.5, 0.5, 0.3, 0.4]
+    gb[:, 1] = [0.25, 0.25, 0.2, 0.2]
+    gl = rng.randint(0, 3, (B, MB)).astype("int32")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(8):
+            lo, = exe.run(main, feed={"img": xb, "gt_box": gb,
+                                      "gt_label": gl}, fetch_list=[loss])
+            losses.append(float(np.asarray(lo).reshape(-1)[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_yolov3_infer_builds_and_runs():
+    from paddle_tpu.models import yolov3
+
+    S = 64
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img, im_shape, pred = yolov3.build_infer(class_num=3, image_size=S,
+                                                 width=4)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(1)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        out, = exe.run(main, feed={
+            "img": rng.rand(1, 3, S, S).astype("f"),
+            "im_shape": np.array([[S, S]], "int32")}, fetch_list=[pred])
+    out = np.asarray(out)
+    assert out.shape[-1] == 6          # (label, score, x1, y1, x2, y2)
+    labels = out[..., 0].reshape(-1)
+    # class 0 is a real YOLO class (background_label=-1): with an untrained
+    # net all classes clear the 0.005 threshold, so 0 must appear
+    assert (labels == 0).any()
+
+
+def test_word2vec_trains():
+    from paddle_tpu.models import word2vec
+
+    rng = np.random.RandomState(2)
+    V, B = 50, 32
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        words, nextw, cost = word2vec.build_train(V, lr=0.5)
+    exe = fluid.Executor(fluid.CPUPlace())
+    # deterministic "language": next word = (sum of context) % V
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(30):
+            ws = rng.randint(0, V, (4, B, 1)).astype("int64")
+            nx = (ws.sum(axis=0) % V).astype("int64")
+            feed = {"firstw": ws[0], "secondw": ws[1], "thirdw": ws[2],
+                    "forthw": ws[3], "nextw": nx}
+            lo, = exe.run(main, feed=feed, fetch_list=[cost])
+            losses.append(float(np.asarray(lo).reshape(-1)[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
