@@ -1,3 +1,3 @@
 from .executor import Executor, global_scope, scope_guard
 from .registry import register_op, get_op_def, has_op_def, all_op_types
-from .scope import Scope, TpuTensor
+from .scope import Scope, SelectedRows, TpuTensor

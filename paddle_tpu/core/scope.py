@@ -8,7 +8,7 @@ allocator — HBM allocation is XLA's job on TPU).
 
 import numpy as np
 
-__all__ = ["Scope", "TpuTensor"]
+__all__ = ["Scope", "TpuTensor", "SelectedRows"]
 
 
 class TpuTensor:
@@ -64,15 +64,74 @@ class TpuTensor:
         return list(np.shape(self._value)) if self._value is not None else []
 
 
+class SelectedRows:
+    """Sparse row-set tensor (API parity: framework/selected_rows.h:32).
+
+    On XLA the gradient math is dense (SURVEY §2.1 Tensor-stack note), so
+    SelectedRows is a host-side view: `rows` are the touched indices into a
+    conceptual [height, ...] tensor whose values live in `get_tensor()`.
+    `to_dense()` scatters into the dense shape; `from_dense` compacts the
+    nonzero rows (the executor's sparse-grad consumers — sgd/adagrad on
+    is_sparse embeddings — accept either form)."""
+
+    def __init__(self, rows=None, height=0):
+        self._rows = list(rows or [])
+        self._height = int(height)
+        self._tensor = TpuTensor()
+
+    def rows(self):
+        return list(self._rows)
+
+    def set_rows(self, rows):
+        self._rows = [int(r) for r in rows]
+
+    def height(self):
+        return self._height
+
+    def set_height(self, h):
+        self._height = int(h)
+
+    def get_tensor(self):
+        return self._tensor
+
+    def sync_index(self):  # reference API no-op (index is the rows list)
+        return None
+
+    def to_dense(self):
+        vals = self._tensor.numpy()
+        rows = np.asarray(self._rows, np.int64)
+        if rows.size and (rows.min() < 0 or rows.max() >= self._height):
+            raise ValueError(
+                "SelectedRows row ids out of range [0, %d)" % self._height)
+        dense = np.zeros((self._height,) + vals.shape[1:], vals.dtype)
+        np.add.at(dense, rows, vals)
+        return dense
+
+    @staticmethod
+    def from_dense(arr):
+        arr = np.asarray(arr)
+        nz = np.nonzero(np.any(arr.reshape(arr.shape[0], -1) != 0, axis=1))[0]
+        sr = SelectedRows(rows=nz.tolist(), height=arr.shape[0])
+        sr.get_tensor().set(arr[nz])
+        return sr
+
+
 class _ScopeVar:
-    __slots__ = ("name", "tensor")
+    __slots__ = ("name", "tensor", "_selected_rows")
 
     def __init__(self, name):
         self.name = name
         self.tensor = TpuTensor()
+        self._selected_rows = None
 
     def get_tensor(self):
         return self.tensor
+
+    def get_selected_rows(self):
+        if self._selected_rows is None:
+            self._selected_rows = SelectedRows()
+            self._selected_rows._tensor = self.tensor
+        return self._selected_rows
 
     def set(self, value):
         self.tensor.set(value)

@@ -31,7 +31,7 @@ import numpy as np
 
 from ..native.rpc import RpcClient, RpcServer, EV_BARRIER, EV_COMPLETE, EV_SEND
 
-__all__ = ["run_pserver", "TrainerPSComm"]
+__all__ = ["run_pserver", "TrainerPSComm", "HeartBeatMonitor"]
 
 # pservers running as THREADS of this process (tests; the reference runs
 # separate processes).  complete() waits for them to leave the native poll
@@ -279,3 +279,43 @@ class TrainerPSComm:
                 if not _LIVE_SERVERS:
                     return
             time.sleep(0.01)
+
+
+class HeartBeatMonitor:
+    """Pserver-side worker liveness tracking (parity:
+    operators/distributed/heart_beat_monitor.h:54): records each worker's
+    last-contact timestamp; `check` logs workers silent for longer than
+    `timeout_s`.  The reference runs this only in UPDATE mode and only
+    logs — no eviction — and so do we."""
+
+    def __init__(self, n_workers, timeout_s=60.0, name="ps"):
+        import time
+
+        self._time = time.time
+        self.n_workers = n_workers
+        self.timeout_s = timeout_s
+        self.name = name
+        # seed every worker at construction (heart_beat_monitor.h does the
+        # same) so a worker that dies before its first heartbeat is caught
+        now = self._time()
+        self._last_seen = {w: now for w in range(n_workers)}
+        self._warned = set()
+
+    def update(self, worker_id):
+        self._last_seen[int(worker_id)] = self._time()
+        self._warned.discard(int(worker_id))
+
+    def check(self):
+        """Returns the list of currently-dead worker ids (and logs new
+        ones once, like the monitor thread's LOG(WARNING))."""
+        import logging
+
+        now = self._time()
+        dead = [w for w, t in self._last_seen.items()
+                if now - t > self.timeout_s]
+        for w in dead:
+            if w not in self._warned:
+                logging.warning("[%s] worker %d silent for %.0fs",
+                                self.name, w, now - self._last_seen[w])
+                self._warned.add(w)
+        return dead

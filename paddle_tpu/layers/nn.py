@@ -553,10 +553,18 @@ def clip_by_norm(x, max_norm, name=None):
 
 
 def l2_normalize(x, axis, epsilon=1e-12, name=None):
-    sq = elementwise_mul(x, x)
-    ssum = reduce_sum(sq, dim=[axis if axis >= 0 else axis], keep_dim=True)
-    norm = _single_out_layer("sqrt")(scale(ssum, bias=epsilon))
-    return elementwise_div(x, norm)
+    """x / sqrt(sum(x^2, axis) + eps) via the norm op (norm_op.cc) — the
+    fluid elementwise broadcast rules can't express a same-rank keepdim
+    divisor at axis=-1, so this must NOT be composed from elementwise_div."""
+    helper = LayerHelper("l2_normalize", name=name)
+    norm_out = helper.create_variable_for_type_inference(x.dtype)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="norm", inputs={"X": [x]},
+        outputs={"Norm": [norm_out], "Out": [out]},
+        attrs={"axis": int(axis), "epsilon": float(epsilon)},
+    )
+    return out
 
 
 def cos_sim(X, Y):

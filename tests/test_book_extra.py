@@ -1,0 +1,113 @@
+"""Additional book-test analogs (reference tests/book/): sentiment LSTM
+(test_understand_sentiment.py: embedding -> LSTM -> pool -> fc) and a
+recommender-style two-tower dot model (test_recommender_system.py core).
+Plus SelectedRows API and HeartBeatMonitor units."""
+
+import time
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def test_sentiment_lstm_trains():
+    V, E, H, B, T = 40, 16, 16, 8, 10
+    rng = np.random.RandomState(0)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        words = fluid.layers.data("words", shape=[T, 1], dtype="int64")
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        lens = fluid.layers.data("lens", shape=[], dtype="int64")
+        emb = fluid.layers.embedding(words, size=[V, E])
+        fc = fluid.layers.fc(emb, H * 4, num_flatten_dims=2)
+        h = fluid.layers.dynamic_lstm(fc, H * 4, seq_len=lens)
+        pooled = fluid.layers.sequence_pool(h, "max", seq_len=lens)
+        logits = fluid.layers.fc(pooled, 2)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.Adam(1e-2).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(80):
+            lens_b = rng.randint(3, T + 1, (B,)).astype("int64")
+            w = rng.randint(0, V, (B, T, 1)).astype("int64")
+            # sentiment = whether the first token is < V/2
+            y = (w[:, 0, 0] < V // 2).astype("int64").reshape(B, 1)
+            lo, = exe.run(main, feed={"words": w, "label": y,
+                                      "lens": lens_b}, fetch_list=[loss])
+            losses.append(float(np.asarray(lo).reshape(-1)[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < 0.35 < losses[0]
+
+
+def test_recommender_two_tower_trains():
+    NU, NI, D, B = 20, 30, 8, 16
+    rng = np.random.RandomState(1)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        uid = fluid.layers.data("uid", shape=[1], dtype="int64")
+        iid = fluid.layers.data("iid", shape=[1], dtype="int64")
+        score = fluid.layers.data("score", shape=[1])
+        ue = fluid.layers.fc(fluid.layers.embedding(uid, [NU, D]), D,
+                             act="relu")
+        ie = fluid.layers.fc(fluid.layers.embedding(iid, [NI, D]), D,
+                             act="relu")
+        sim = fluid.layers.cos_sim(ue, ie)
+        pred = fluid.layers.scale(sim, scale=5.0)
+        loss = fluid.layers.mean(fluid.layers.square(pred - score))
+        fluid.optimizer.Adam(1e-2).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    true_u = rng.randn(NU, 3).astype("f")
+    true_i = rng.randn(NI, 3).astype("f")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(60):
+            u = rng.randint(0, NU, (B, 1)).astype("int64")
+            i = rng.randint(0, NI, (B, 1)).astype("int64")
+            s = np.sum(true_u[u.ravel()] * true_i[i.ravel()],
+                       axis=1, keepdims=True)
+            s = np.clip(s, -5, 5).astype("f")
+            lo, = exe.run(main, feed={"uid": u, "iid": i, "score": s},
+                          fetch_list=[loss])
+            losses.append(float(np.asarray(lo).reshape(-1)[0]))
+    assert losses[-1] < losses[0]
+
+
+def test_selected_rows_api():
+    from paddle_tpu.core import SelectedRows
+
+    sr = SelectedRows(rows=[2, 0], height=4)
+    sr.get_tensor().set(np.array([[1.0, 1.0], [2.0, 2.0]], "f"))
+    assert sr.rows() == [2, 0]
+    assert sr.height() == 4
+    d = sr.to_dense()
+    np.testing.assert_allclose(d[2], [1, 1])
+    np.testing.assert_allclose(d[0], [2, 2])
+    np.testing.assert_allclose(d[1], 0)
+    # duplicate rows accumulate (reference merge semantics)
+    sr2 = SelectedRows(rows=[1, 1], height=3)
+    sr2.get_tensor().set(np.ones((2, 2), "f"))
+    np.testing.assert_allclose(sr2.to_dense()[1], [2, 2])
+    # scope vars expose the view lazily
+    sc = fluid.Scope()
+    v = sc.var("g")
+    v.get_tensor().set(np.zeros((2, 2), "f"))
+    assert v.get_selected_rows().get_tensor() is v.get_tensor()
+
+
+def test_heartbeat_monitor():
+    from paddle_tpu.distributed.ps import HeartBeatMonitor
+
+    mon = HeartBeatMonitor(n_workers=2, timeout_s=0.05)
+    mon.update(0)
+    mon.update(1)
+    assert mon.check() == []
+    time.sleep(0.08)
+    mon.update(1)
+    dead = mon.check()
+    assert dead == [0]
+    mon.update(0)            # recovery clears the warning
+    assert mon.check() == []
