@@ -60,6 +60,7 @@ def run_pserver(exe, program, scope):
     server = RpcServer(port)
     server.serve(True)
     completed = [0]
+    monitor = HeartBeatMonitor(trainers, name="ps:%s" % endpoint)
 
     def publish(version):
         for p in params:
@@ -86,7 +87,11 @@ def run_pserver(exe, program, scope):
             elif t == EV_BARRIER and name == "send":
                 seen += 1
             elif t == EV_SEND:
-                grads[name].append(arr)
+                if name.startswith("__hb__"):
+                    monitor.update(int(name[6:]))
+                    monitor.check()
+                else:
+                    grads[name].append(arr)
         return True
 
     def run_sync():
@@ -134,6 +139,9 @@ def run_pserver(exe, program, scope):
                 completed[0] += 1
                 if completed[0] >= trainers:
                     return
+            elif t == EV_SEND and name.startswith("__hb__"):
+                monitor.update(int(name[6:]))
+                monitor.check()
             elif t == EV_SEND and name in grad_to_param:
                 pname = grad_to_param[name]
                 with scope_guard(scope):
@@ -225,6 +233,11 @@ class TrainerPSComm:
             raise RuntimeError(
                 "PS trainer already completed (Executor.close() was called); "
                 "create a new scope/executor to train again")
+        # heartbeat: one tiny var per step so the server's HeartBeatMonitor
+        # tracks this worker's liveness (heart_beat_monitor.h UPDATE mode)
+        hb = np.asarray([self.trainer_id], np.int64)
+        for c in self._clients.values():
+            c.send_var("__hb__%d" % self.trainer_id, hb)
         for p, g in self.param_to_grad.items():
             if g in grad_values:
                 self._clients[self.param_to_ep[p]].send_var(g, grad_values[g])

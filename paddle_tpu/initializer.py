@@ -55,6 +55,25 @@ class Initializer:
             )
 
 
+    def _resolve_seed(self, var, block):
+        """Reference behavior (framework.py): a zero op seed falls back to
+        block.program.random_seed.  We additionally key it by the op's
+        emission position (the reference reuses the bare program seed, so
+        same-shape params get identical draws — a known fluid quirk this
+        avoids).  The resolved value is MATERIALIZED into the op attr, so:
+        - rebuilding the same model in-process reproduces it (emission
+          order is deterministic, unique-name counters don't matter), and
+        - the PS transpiler's pserver startup (a clone of these ops,
+          ps_transpile.py startup_for) carries the same seeds across
+          processes."""
+        if getattr(self, "_seed", 0):
+            return self._seed
+        prog_seed = getattr(block.program, "random_seed", 0) or 0
+        if prog_seed:
+            return (prog_seed * 1000003 + len(block.ops) + 1) & 0x7FFFFFFF
+        return 0
+
+
 class ConstantInitializer(Initializer):
     def __init__(self, value=0.0, force_cpu=False):
         self._value = value
@@ -88,7 +107,7 @@ class UniformInitializer(Initializer):
                 "dtype": dtype_enum(var.dtype),
                 "min": self._low,
                 "max": self._high,
-                "seed": self._seed,
+                "seed": self._resolve_seed(var, block),
             },
         )
 
@@ -108,7 +127,7 @@ class NormalInitializer(Initializer):
                 "dtype": dtype_enum(var.dtype),
                 "mean": self._mean,
                 "std": self._std,
-                "seed": self._seed,
+                "seed": self._resolve_seed(var, block),
             },
         )
 
@@ -128,7 +147,7 @@ class TruncatedNormalInitializer(Initializer):
                 "dtype": dtype_enum(var.dtype),
                 "mean": self._mean,
                 "std": self._std,
-                "seed": self._seed,
+                "seed": self._resolve_seed(var, block),
             },
         )
 
@@ -171,7 +190,7 @@ class XavierInitializer(Initializer):
                     "dtype": dtype_enum(var.dtype),
                     "min": -limit,
                     "max": limit,
-                    "seed": self._seed,
+                    "seed": self._resolve_seed(var, block),
                 },
             )
         std = math.sqrt(2.0 / (fan_in + fan_out))
@@ -183,7 +202,7 @@ class XavierInitializer(Initializer):
                 "dtype": dtype_enum(var.dtype),
                 "mean": 0.0,
                 "std": std,
-                "seed": self._seed,
+                "seed": self._resolve_seed(var, block),
             },
         )
 
@@ -205,7 +224,8 @@ class MSRAInitializer(Initializer):
             attrs = {"mean": 0.0, "std": math.sqrt(2.0 / fan_in)}
             op_type = "gaussian_random"
         attrs.update(
-            shape=list(var.shape), dtype=dtype_enum(var.dtype), seed=self._seed
+            shape=list(var.shape), dtype=dtype_enum(var.dtype),
+            seed=self._resolve_seed(var, block),
         )
         return block.append_op(
             type=op_type, outputs={"Out": [var.name]}, attrs=attrs

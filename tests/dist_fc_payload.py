@@ -1,0 +1,117 @@
+"""Runnable distributed-test payload (reference protocol:
+test_dist_base.py TestDistRunnerBase + dist_mnist.py payloads): one process
+per role, role and cluster read from PADDLE_* env vars, per-step losses
+printed to stdout for the harness to parse."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+STEPS = 8
+BS = 8  # per trainer
+
+
+def build():
+    main, startup = fluid.Program(), fluid.Program()
+    # fixed seeds: the pserver's startup init must equal the local
+    # baseline's across PROCESSES (the reference payloads do the same)
+    main.random_seed = 123
+    startup.random_seed = 123
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        y = fluid.layers.data("y", shape=[1])
+        h = fluid.layers.fc(x, 8, act="relu",
+                            param_attr=fluid.ParamAttr(name="w1"))
+        pred = fluid.layers.fc(h, 1, param_attr=fluid.ParamAttr(name="w2"))
+        loss = fluid.layers.mean(fluid.layers.square(pred - y))
+        fluid.optimizer.SGD(0.05).minimize(loss)
+    return main, startup, loss
+
+
+def make_data(n_trainers):
+    rng = np.random.RandomState(7)
+    w = rng.randn(4, 1).astype("f")
+    xs, ys = [], []
+    for _ in range(STEPS):
+        x = rng.randn(n_trainers * BS, 4).astype("f")
+        xs.append(x)
+        ys.append((x @ w).astype("f"))
+    return xs, ys
+
+
+def run_local():
+    main, startup, loss = build()
+    xs, ys = make_data(2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for i in range(STEPS):
+            lo, = exe.run(main, feed={"x": xs[i], "y": ys[i]},
+                          fetch_list=[loss])
+            print("loss:%.8f" % float(np.asarray(lo).reshape(-1)[0]),
+                  flush=True)
+        scope = fluid.core.executor.global_scope()
+        for pname in ("w1", "w2"):
+            v = np.asarray(scope.find_var(pname).get_tensor().numpy())
+            print("param:%s:%.8f" % (pname, float(np.abs(v).sum())),
+                  flush=True)
+
+
+def run_pserver():
+    eps = os.environ["PADDLE_PSERVER_ENDPOINTS"]
+    cur = os.environ["PADDLE_CURRENT_ENDPOINT"]
+    n_trainers = int(os.environ["PADDLE_TRAINERS_NUM"])
+    main, startup, loss = build()
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, startup_program=startup,
+                pservers=eps, trainers=n_trainers)
+    prog, sprog = t.get_pserver_programs(cur)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(sprog)
+        print("pserver:ready", flush=True)
+        exe.run(prog, scope=scope)
+    print("pserver:done", flush=True)
+
+
+def run_trainer():
+    eps = os.environ["PADDLE_PSERVER_ENDPOINTS"]
+    tid = int(os.environ["PADDLE_TRAINER_ID"])
+    n_trainers = int(os.environ["PADDLE_TRAINERS_NUM"])
+    main, startup, loss = build()
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=tid, program=main, startup_program=startup,
+                pservers=eps, trainers=n_trainers)
+    tp = t.get_trainer_program()
+    xs, ys = make_data(n_trainers)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        half = slice(tid * BS, (tid + 1) * BS)
+        for i in range(STEPS):
+            lo, = exe.run(tp, feed={"x": xs[i][half], "y": ys[i][half]},
+                          fetch_list=[loss], scope=scope)
+            print("loss:%.8f" % float(np.asarray(lo).reshape(-1)[0]),
+                  flush=True)
+        for pname in ("w1", "w2"):
+            v = np.asarray(scope.find_var(pname).get_tensor().numpy())
+            print("param:%s:%.8f" % (pname, float(np.abs(v).sum())),
+                  flush=True)
+        scope._ps_comm.complete()
+
+
+if __name__ == "__main__":
+    role = os.environ.get("PADDLE_TRAINING_ROLE", "LOCAL")
+    if role == "PSERVER":
+        run_pserver()
+    elif role == "TRAINER":
+        run_trainer()
+    else:
+        run_local()

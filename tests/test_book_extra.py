@@ -101,12 +101,13 @@ def test_selected_rows_api():
 def test_heartbeat_monitor():
     from paddle_tpu.distributed.ps import HeartBeatMonitor
 
-    mon = HeartBeatMonitor(n_workers=2, timeout_s=0.05)
+    # generous timeout so scheduler stalls can't flake the assertions
+    mon = HeartBeatMonitor(n_workers=2, timeout_s=2.0)
     mon.update(0)
     mon.update(1)
     assert mon.check() == []
-    time.sleep(0.08)
-    mon.update(1)
+    # simulate worker 0 going silent by back-dating its last heartbeat
+    mon._last_seen[0] -= 10.0
     dead = mon.check()
     assert dead == [0]
     mon.update(0)            # recovery clears the warning

@@ -1,0 +1,108 @@
+"""Multi-process distributed test harness (reference: test_dist_base.py
+TestDistBase — REAL subprocesses on localhost with PADDLE_* env, per-step
+losses captured from stdout, trainer-vs-local parity asserted)."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _parse_losses(stdout):
+    return [float(l.split("loss:")[1]) for l in stdout.splitlines()
+            if l.startswith("loss:")]
+
+
+def _parse_params(stdout):
+    out = {}
+    for l in stdout.splitlines():
+        if l.startswith("param:"):
+            _, name, v = l.split(":")
+            out[name] = float(v)
+    return out
+
+
+def test_ps_dist_subprocess_matches_local():
+    here = os.path.dirname(os.path.abspath(__file__))
+    payload = os.path.join(here, "dist_fc_payload.py")
+    base_env = dict(os.environ, JAX_PLATFORMS="cpu")
+    base_env.pop("PADDLE_TRAINING_ROLE", None)
+
+    # local baseline (own process, like the reference's _run_local)
+    local = subprocess.run([sys.executable, payload], env=base_env,
+                           capture_output=True, text=True, timeout=300)
+    assert local.returncode == 0, local.stderr
+    local_losses = _parse_losses(local.stdout)
+    assert len(local_losses) == 8
+
+    # 2 pservers + 2 trainers as real processes on free localhost ports
+    ports = _free_ports(2)
+    eps = ",".join("127.0.0.1:%d" % p for p in ports)
+    procs = []
+    try:
+        for ep in eps.split(","):
+            env = dict(base_env, PADDLE_TRAINING_ROLE="PSERVER",
+                       PADDLE_PSERVER_ENDPOINTS=eps,
+                       PADDLE_CURRENT_ENDPOINT=ep,
+                       PADDLE_TRAINERS_NUM="2")
+            procs.append(("ps:" + ep, subprocess.Popen(
+                [sys.executable, payload], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)))
+        trainers = []
+        for tid in range(2):
+            env = dict(base_env, PADDLE_TRAINING_ROLE="TRAINER",
+                       PADDLE_PSERVER_ENDPOINTS=eps,
+                       PADDLE_TRAINER_ID=str(tid),
+                       PADDLE_TRAINERS_NUM="2")
+            p = subprocess.Popen([sys.executable, payload], env=env,
+                                 stdout=subprocess.PIPE,
+                                 stderr=subprocess.PIPE, text=True)
+            trainers.append(p)
+            procs.append(("tr:%d" % tid, p))
+
+        touts = []
+        for p in trainers:
+            out, err = p.communicate(timeout=300)
+            assert p.returncode == 0, err
+            touts.append(out)
+        # pservers drain and exit after trainers COMPLETE
+        for name, p in procs:
+            if name.startswith("ps:"):
+                out, err = p.communicate(timeout=120)
+                assert p.returncode == 0, (name, err)
+                assert "pserver:done" in out
+    finally:
+        for _, p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    # parity: sync-PS trainer params equal the local full-batch run
+    # (mean of the two half-batch grads == full-batch grad; reference
+    # asserts per-step parity with assertAlmostEqual delta=1e-3)
+    local_params = _parse_params(local.stdout)
+    assert set(local_params) == {"w1", "w2"}
+    for out in touts:
+        dist_losses = _parse_losses(out)
+        assert len(dist_losses) == 8
+        assert all(np.isfinite(dist_losses))
+        # NB: per-trainer losses are computed on different half-batches, so
+        # no per-step loss comparison is meaningful here; the sync-SGD
+        # invariant is exact PARAM parity with the full-batch local run
+        dist_params = _parse_params(out)
+        for name in ("w1", "w2"):
+            np.testing.assert_allclose(dist_params[name],
+                                       local_params[name], rtol=1e-3)
