@@ -44,6 +44,21 @@ def _vkey(name, version):
     return "%s#%d" % (name, version)
 
 
+_HB_PREFIX = "__hb__"
+_HB_BYE_PREFIX = "__hb_bye__"
+
+
+def _handle_hb(monitor, name):
+    """Returns True if `name` was a heartbeat/bye event (consumed)."""
+    if name.startswith(_HB_BYE_PREFIX):
+        monitor.remove(int(name[len(_HB_BYE_PREFIX):]))
+        return True
+    if name.startswith(_HB_PREFIX):
+        monitor.update(int(name[len(_HB_PREFIX):]))
+        return True
+    return False
+
+
 def run_pserver(exe, program, scope):
     """Blocking pserver loop for a transpiled pserver program (the program
     holds one `listen_and_serv` op; metadata on program._ps_server)."""
@@ -61,6 +76,16 @@ def run_pserver(exe, program, scope):
     server.serve(True)
     completed = [0]
     monitor = HeartBeatMonitor(trainers, name="ps:%s" % endpoint)
+    # dedicated checker thread (heart_beat_monitor.h runs the monitor in its
+    # own thread): a dead trainer in sync mode leaves the server blocked in
+    # poll(), so arrival-driven checks alone would never fire
+    _mon_stop = __import__("threading").Event()
+
+    def _mon_loop():
+        while not _mon_stop.wait(max(monitor.timeout_s / 2, 0.5)):
+            monitor.check()
+
+    __import__("threading").Thread(target=_mon_loop, daemon=True).start()
 
     def publish(version):
         for p in params:
@@ -87,10 +112,7 @@ def run_pserver(exe, program, scope):
             elif t == EV_BARRIER and name == "send":
                 seen += 1
             elif t == EV_SEND:
-                if name.startswith("__hb__"):
-                    monitor.update(int(name[6:]))
-                    monitor.check()
-                else:
+                if not _handle_hb(monitor, name):
                     grads[name].append(arr)
         return True
 
@@ -139,9 +161,8 @@ def run_pserver(exe, program, scope):
                 completed[0] += 1
                 if completed[0] >= trainers:
                     return
-            elif t == EV_SEND and name.startswith("__hb__"):
-                monitor.update(int(name[6:]))
-                monitor.check()
+            elif t == EV_SEND and _handle_hb(monitor, name):
+                pass
             elif t == EV_SEND and name in grad_to_param:
                 pname = grad_to_param[name]
                 with scope_guard(scope):
@@ -190,6 +211,7 @@ def run_pserver(exe, program, scope):
         else:
             run_async()
     finally:
+        _mon_stop.set()
         server.shutdown()
         with _LIVE_LOCK:
             _LIVE_SERVERS.discard(id(server))
@@ -272,8 +294,10 @@ class TrainerPSComm:
         if self._closed:
             return
         self._closed = True
+        bye = np.asarray([self.trainer_id], np.int64)
         for c in self._clients.values():
             try:
+                c.send_var(_HB_BYE_PREFIX + str(self.trainer_id), bye)
                 c.complete()
                 c.close()
             except Exception:
@@ -316,6 +340,11 @@ class HeartBeatMonitor:
 
     def update(self, worker_id):
         self._last_seen[int(worker_id)] = self._time()
+        self._warned.discard(int(worker_id))
+
+    def remove(self, worker_id):
+        """Worker exited cleanly (SendComplete) — stop tracking it."""
+        self._last_seen.pop(int(worker_id), None)
         self._warned.discard(int(worker_id))
 
     def check(self):

@@ -70,7 +70,8 @@ class Initializer:
             return self._seed
         prog_seed = getattr(block.program, "random_seed", 0) or 0
         if prog_seed:
-            return (prog_seed * 1000003 + len(block.ops) + 1) & 0x7FFFFFFF
+            return ((prog_seed * 1000003 + len(block.ops) + 1)
+                    & 0x7FFFFFFF) or 1  # 0 would mean 'unseeded' to the op
         return 0
 
 

@@ -118,8 +118,11 @@ def embeddings(src_ids, pos_ids, sent_ids, cfg, is_test=False):
     return emb
 
 
-def bert_encoder(cfg, seq_len, is_test=False, use_tp=False):
-    """Declare inputs + build the encoder stack; returns (inputs, sequence_output)."""
+def bert_encoder(cfg, seq_len, is_test=False, use_tp=False,
+                 return_checkpoints=False):
+    """Declare inputs + build the encoder stack; returns (inputs,
+    sequence_output[, checkpoints]).  `checkpoints` are the per-layer
+    outputs for RecomputeOptimizer (remat segment boundaries)."""
     src_ids = fluid.layers.data("src_ids", shape=[seq_len, 1], dtype="int64")
     pos_ids = fluid.layers.data("pos_ids", shape=[seq_len, 1], dtype="int64")
     sent_ids = fluid.layers.data("sent_ids", shape=[seq_len, 1], dtype="int64")
@@ -129,8 +132,12 @@ def bert_encoder(cfg, seq_len, is_test=False, use_tp=False):
     mask2d = fluid.layers.matmul(input_mask, input_mask, transpose_y=True)
     attn_mask = fluid.layers.scale(mask2d, scale=1e4, bias=-1e4)
     attn_mask = fluid.layers.unsqueeze(attn_mask, [1])  # [B,1,S,S]
+    checkpoints = []
     for i in range(cfg.layers):
         x = encoder_layer(x, cfg, "layer_%d" % i, is_test, use_tp, attn_mask)
+        checkpoints.append(x)
+    if return_checkpoints:
+        return (src_ids, pos_ids, sent_ids, input_mask), x, checkpoints
     return (src_ids, pos_ids, sent_ids, input_mask), x
 
 

@@ -1,0 +1,17 @@
+"""Shared helpers for the distributed test families."""
+
+import socket
+
+
+def free_ports(n):
+    """Grab n free localhost ports (bind-then-close; the usual TOCTOU
+    caveat applies — tests retry at connect level)."""
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports

@@ -3,23 +3,12 @@ TestDistBase — REAL subprocesses on localhost with PADDLE_* env, per-step
 losses captured from stdout, trainer-vs-local parity asserted)."""
 
 import os
-import socket
 import subprocess
 import sys
 
 import numpy as np
 
-
-def _free_ports(n):
-    socks, ports = [], []
-    for _ in range(n):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        socks.append(s)
-        ports.append(s.getsockname()[1])
-    for s in socks:
-        s.close()
-    return ports
+from dist_utils import free_ports as _free_ports
 
 
 def _parse_losses(stdout):

@@ -17,16 +17,7 @@ import paddle_tpu as fluid
 from paddle_tpu.initializer import Constant
 
 
-def _free_ports(n):
-    socks, ports = [], []
-    for _ in range(n):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        ports.append(s.getsockname()[1])
-        socks.append(s)
-    for s in socks:
-        s.close()
-    return ports
+from dist_utils import free_ports as _free_ports  # noqa: E402
 
 
 def _build(lr=0.1):
