@@ -85,7 +85,10 @@ def run_pserver(exe, program, scope):
         while not _mon_stop.wait(max(monitor.timeout_s / 2, 0.5)):
             monitor.check()
 
-    __import__("threading").Thread(target=_mon_loop, daemon=True).start()
+    if not meta.get("geo", False):
+        # geo trainers push only sparse param deltas (no heartbeats), so
+        # the checker would log false positives there
+        __import__("threading").Thread(target=_mon_loop, daemon=True).start()
 
     def publish(version):
         for p in params:
@@ -259,7 +262,7 @@ class TrainerPSComm:
         # tracks this worker's liveness (heart_beat_monitor.h UPDATE mode)
         hb = np.asarray([self.trainer_id], np.int64)
         for c in self._clients.values():
-            c.send_var("__hb__%d" % self.trainer_id, hb)
+            c.send_var(_HB_PREFIX + str(self.trainer_id), hb)
         for p, g in self.param_to_grad.items():
             if g in grad_values:
                 self._clients[self.param_to_ep[p]].send_var(g, grad_values[g])
@@ -337,15 +340,18 @@ class HeartBeatMonitor:
         now = self._time()
         self._last_seen = {w: now for w in range(n_workers)}
         self._warned = set()
+        self._lock = __import__("threading").Lock()
 
     def update(self, worker_id):
-        self._last_seen[int(worker_id)] = self._time()
-        self._warned.discard(int(worker_id))
+        with self._lock:
+            self._last_seen[int(worker_id)] = self._time()
+            self._warned.discard(int(worker_id))
 
     def remove(self, worker_id):
         """Worker exited cleanly (SendComplete) — stop tracking it."""
-        self._last_seen.pop(int(worker_id), None)
-        self._warned.discard(int(worker_id))
+        with self._lock:
+            self._last_seen.pop(int(worker_id), None)
+            self._warned.discard(int(worker_id))
 
     def check(self):
         """Returns the list of currently-dead worker ids (and logs new
@@ -353,11 +359,12 @@ class HeartBeatMonitor:
         import logging
 
         now = self._time()
-        dead = [w for w, t in self._last_seen.items()
-                if now - t > self.timeout_s]
-        for w in dead:
-            if w not in self._warned:
-                logging.warning("[%s] worker %d silent for %.0fs",
-                                self.name, w, now - self._last_seen[w])
-                self._warned.add(w)
-        return dead
+        with self._lock:
+            dead = [(w, now - t) for w, t in self._last_seen.items()
+                    if now - t > self.timeout_s]
+            fresh = [wt for wt in dead if wt[0] not in self._warned]
+            self._warned.update(w for w, _ in fresh)
+        for w, silent in fresh:
+            logging.warning("[%s] worker %d silent for %.0fs",
+                            self.name, w, silent)
+        return [w for w, _ in dead]

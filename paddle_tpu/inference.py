@@ -183,6 +183,15 @@ class AnalysisPredictor:
                 self._program, self._feed_names, self._fetch_vars = \
                     _io.load_inference_model(dirname, self._exe,
                                              model_filename, params_filename)
+            if config.ir_optim():
+                # analysis pass pipeline (analysis_predictor.cc:461
+                # OptimizeInferenceProgram): graph-rewriting passes whose
+                # wins XLA can't recover (they rewrite parameter values /
+                # delete stateful ops); everything else is XLA's job
+                from . import ir as _ir
+
+                for pname in ("delete_dropout_pass", "conv_bn_fuse_pass"):
+                    _ir.apply_pass(pname, self._program, self._scope)
         self._fetch_names = [v.name for v in self._fetch_vars]
         self._staged_feed = {}
         self._last_outputs = None

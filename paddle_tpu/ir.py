@@ -1,0 +1,169 @@
+"""Program-level IR passes (parity: paddle/fluid/framework/ir/ —
+ir::Pass + PassRegistry, ir/pass.h:38).
+
+Most of the reference's ~75 passes dissolve into XLA (fusion, memory reuse,
+placement).  What remains meaningful at the program level are
+*graph-rewriting* optimizations whose benefit XLA cannot recover because
+they change the parameter values themselves or delete stateful ops:
+
+- conv_bn_fuse_pass (ir/conv_bn_fuse_pass.cc): fold an inference-mode
+  batch_norm into the preceding conv2d's weights/bias.  Removes the BN op
+  and its four parameter reads entirely.
+- delete_dropout_pass (delete_dropout_op_pass): drop is_test dropout ops
+  (identity at inference).
+
+Passes run on (Program, Scope) pairs — the scope carries the parameter
+values a folding pass rewrites, mirroring how the reference's passes read
+the global scope for persistables."""
+
+import numpy as np
+
+__all__ = ["Pass", "register_pass", "get_pass", "apply_pass", "all_passes"]
+
+_PASS_REGISTRY = {}
+
+
+class Pass:
+    """Base class (ir/pass.h:38 analog): override apply(program, scope)."""
+
+    name = None
+
+    def apply(self, program, scope):
+        raise NotImplementedError
+
+
+def register_pass(name):
+    def deco(cls):
+        cls.name = name
+        _PASS_REGISTRY[name] = cls
+        return cls
+
+    return deco
+
+
+def get_pass(name):
+    return _PASS_REGISTRY[name]()
+
+
+def all_passes():
+    return sorted(_PASS_REGISTRY)
+
+
+def apply_pass(name, program, scope):
+    """Apply one registered pass in place; returns the program."""
+    get_pass(name).apply(program, scope)
+    return program
+
+
+@register_pass("delete_dropout_pass")
+class DeleteDropoutPass(Pass):
+    """Replace is_test dropout ops with `assign` (identity).  Using assign
+    instead of deleting + rewiring keeps every output var produced — fetch
+    targets and chained dropouts stay valid — and XLA folds the copy."""
+
+    def apply(self, program, scope):
+        from .framework import Operator
+
+        block = program.global_block()
+        new_ops = []
+        for op in block.ops:
+            if (op.type == "dropout" and op.attrs.get("is_test")
+                    and op.attrs.get("dropout_implementation")
+                    == "upscale_in_train"):
+                # upscale_in_train is identity at test time; downgrade
+                # mode rescales, so only upscale is replaceable
+                new_ops.append(Operator(
+                    block, type="assign",
+                    inputs={"X": [op.input("X")[0]]},
+                    outputs={"Out": [op.output("Out")[0]]}, attrs={}))
+            else:
+                new_ops.append(op)
+        block.ops = new_ops
+        program._bump_version()
+
+
+@register_pass("conv_bn_fuse_pass")
+class ConvBNFusePass(Pass):
+    """Fold inference batch_norm into the preceding conv2d
+    (ir/conv_bn_fuse_pass.cc): W' = W * gamma/std (per out-channel),
+    b' = beta - mean * gamma/std; the BN op is replaced by one
+    elementwise_add of b'."""
+
+    def apply(self, program, scope):
+        block = program.global_block()
+        # conv output name -> conv op, only when that output feeds exactly
+        # one consumer (the BN)
+        consumers = {}
+        filter_uses = {}
+        for op in block.ops:
+            for n in op.input_arg_names:
+                consumers.setdefault(n, []).append(op)
+            if op.type == "conv2d":
+                f = op.input("Filter")[0]
+                filter_uses[f] = filter_uses.get(f, 0) + 1
+
+        new_ops = []
+        i = 0
+        ops = block.ops
+        while i < len(ops):
+            op = ops[i]
+            fused = False
+            if op.type == "conv2d":
+                out = op.output("Output")[0]
+                cons = consumers.get(out, [])
+                w_name = op.input("Filter")[0]
+                # a filter shared by several convs (siamese nets) can't be
+                # folded — scaling it would corrupt the other conv
+                if (len(cons) == 1 and cons[0].type == "batch_norm"
+                        and cons[0].attrs.get("is_test")
+                        and filter_uses.get(w_name, 0) == 1):
+                    bn = cons[0]
+                    names = {s: bn.input(s)[0] for s in
+                             ("Scale", "Bias", "Mean", "Variance")}
+                    vals = {}
+                    ok = True
+                    for s, n in names.items():
+                        v = scope.find_var(n)
+                        if v is None or not v.get_tensor()._is_initialized():
+                            ok = False
+                            break
+                        vals[s] = np.asarray(v.get_tensor().numpy())
+                    wvar = scope.find_var(w_name)
+                    if ok and wvar is not None and \
+                            wvar.get_tensor()._is_initialized():
+                        eps = float(bn.attrs.get("epsilon", 1e-5))
+                        std = np.sqrt(vals["Variance"] + eps)
+                        factor = vals["Scale"] / std          # [O]
+                        W = np.asarray(wvar.get_tensor().numpy())
+                        wvar.get_tensor().set(
+                            (W * factor.reshape(-1, 1, 1, 1)).astype(W.dtype))
+                        bias = vals["Bias"] - vals["Mean"] * factor
+                        # keyed by the BN output: unique per fused pair
+                        bias_name = bn.output("Y")[0] + "@bn_fused_bias"
+                        bvar = block.create_var(
+                            name=bias_name, shape=[len(bias)],
+                            dtype="float32", persistable=True)
+                        scope.var(bias_name).set(bias.astype("float32"))
+                        bn_out = bn.output("Y")[0]
+                        from .framework import Operator
+
+                        add = Operator(
+                            block, type="elementwise_add",
+                            inputs={"X": [out], "Y": [bias_name]},
+                            outputs={"Out": [bn_out]},
+                            attrs={"axis": 1})
+                        new_ops.append(op)
+                        new_ops.append(add)
+                        i += 1
+                        # skip every op up to and including the BN (they
+                        # are contiguous in topological emit order)
+                        while ops[i] is not bn:
+                            new_ops.append(ops[i])
+                            i += 1
+                        i += 1  # past the bn
+                        fused = True
+            if not fused:
+                new_ops.append(op)
+                i += 1
+        block.ops = new_ops
+        program._bump_version()

@@ -1,0 +1,148 @@
+"""IR pass tests (ir.py: Pass registry + conv_bn_fuse + delete_dropout;
+reference ir/conv_bn_fuse_pass.cc + delete_dropout_op_pass)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import ir
+
+
+def _build_convnet(tmpdir):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[3, 8, 8])
+        c = fluid.layers.conv2d(x, 6, 3, padding=1, bias_attr=False)
+        b = fluid.layers.batch_norm(c, is_test=True)
+        d = fluid.layers.dropout(b, 0.3, is_test=True,
+                                 dropout_implementation="upscale_in_train")
+        out = fluid.layers.fc(d, 4, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        # non-trivial BN stats so folding actually changes weights
+        for n, v in (("batch_norm_0.mean", rng.rand(6).astype("f")),
+                     ("batch_norm_0.var", (rng.rand(6) + 0.5).astype("f"))):
+            sv = scope.find_var(n)
+            if sv is not None:
+                sv.get_tensor().set(v)
+        fluid.io.save_inference_model(str(tmpdir), ["x"], [out], exe,
+                                      main_program=main)
+    return main, startup, out
+
+
+def test_conv_bn_fuse_preserves_outputs(tmp_path):
+    main, startup, out = _build_convnet(tmp_path)
+    rng = np.random.RandomState(1)
+    xb = rng.randn(2, 3, 8, 8).astype("f")
+
+    cfg0 = fluid.inference.AnalysisConfig(str(tmp_path))
+    cfg0.switch_ir_optim(False)
+    p0 = fluid.inference.create_paddle_predictor(cfg0)
+    base, = p0.run([fluid.inference.PaddleTensor(xb, name="x")])
+
+    cfg1 = fluid.inference.AnalysisConfig(str(tmp_path))
+    cfg1.switch_ir_optim(True)
+    p1 = fluid.inference.create_paddle_predictor(cfg1)
+    opt, = p1.run([fluid.inference.PaddleTensor(xb, name="x")])
+
+    np.testing.assert_allclose(np.asarray(opt.data), np.asarray(base.data),
+                               rtol=1e-4, atol=1e-5)
+    # the optimized program has no batch_norm and no dropout ops
+    types = [op.type for op in p1._program.global_block().ops]
+    assert "batch_norm" not in types
+    assert "dropout" not in types
+    assert "conv2d" in types
+    # the unoptimized one still does
+    types0 = [op.type for op in p0._program.global_block().ops]
+    assert "batch_norm" in types0
+
+
+def test_pass_registry():
+    assert "conv_bn_fuse_pass" in ir.all_passes()
+    assert "delete_dropout_pass" in ir.all_passes()
+    p = ir.get_pass("conv_bn_fuse_pass")
+    assert isinstance(p, ir.Pass)
+    assert p.name == "conv_bn_fuse_pass"
+
+
+def test_conv_bn_fuse_direct_numeric():
+    """Direct numeric check: folded conv == conv + BN on a fresh scope."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[2, 5, 5])
+        c = fluid.layers.conv2d(x, 4, 3, padding=1, bias_attr=False)
+        b = fluid.layers.batch_norm(c, is_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(2)
+    xb = rng.randn(1, 2, 5, 5).astype("f")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        # perturb every BN input so the fold is numerically non-trivial
+        bn_op = [op for op in main.global_block().ops
+                 if op.type == "batch_norm"][0]
+        for slot, lo in (("Scale", 0.5), ("Bias", 0.0), ("Mean", 0.0),
+                         ("Variance", 0.3)):
+            name = bn_op.input(slot)[0]
+            scope.find_var(name).get_tensor().set(
+                (rng.rand(4) + lo).astype("f"))
+        ref, = exe.run(main, feed={"x": xb}, fetch_list=[b])
+        ir.apply_pass("conv_bn_fuse_pass", main, scope)
+        fused, = exe.run(main, feed={"x": xb}, fetch_list=[b])
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+    assert "batch_norm" not in [op.type for op in main.global_block().ops]
+
+
+def test_delete_dropout_fetch_target_and_chain():
+    """Regressions: a fetched dropout output and chained dropouts must stay
+    valid after the pass (ops become assigns, vars stay produced)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        d1 = fluid.layers.dropout(x, 0.5, is_test=True,
+                                  dropout_implementation="upscale_in_train")
+        d2 = fluid.layers.dropout(d1, 0.5, is_test=True,
+                                  dropout_implementation="upscale_in_train")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    xb = np.random.RandomState(0).randn(2, 4).astype("f")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        ir.apply_pass("delete_dropout_pass", main, scope)
+        # fetch BOTH the chained output and the intermediate
+        o2, o1 = exe.run(main, feed={"x": xb}, fetch_list=[d2, d1])
+    np.testing.assert_allclose(np.asarray(o2), xb)
+    np.testing.assert_allclose(np.asarray(o1), xb)
+    assert "dropout" not in [op.type for op in main.global_block().ops]
+
+
+def test_conv_bn_fuse_skips_shared_filter():
+    """Regression: a filter shared by two conv+BN pairs must NOT be folded
+    (scaling it would corrupt the sibling conv)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[2, 5, 5])
+        shared = fluid.ParamAttr(name="siamese_w")
+        c1 = fluid.layers.conv2d(x, 4, 3, padding=1, bias_attr=False,
+                                 param_attr=shared)
+        b1 = fluid.layers.batch_norm(c1, is_test=True)
+        c2 = fluid.layers.conv2d(x, 4, 3, padding=1, bias_attr=False,
+                                 param_attr=fluid.ParamAttr(name="siamese_w"))
+        b2 = fluid.layers.batch_norm(c2, is_test=True)
+        out = fluid.layers.elementwise_add(b1, b2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    xb = np.random.RandomState(1).randn(1, 2, 5, 5).astype("f")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        ref, = exe.run(main, feed={"x": xb}, fetch_list=[out])
+        ir.apply_pass("conv_bn_fuse_pass", main, scope)
+        after, = exe.run(main, feed={"x": xb}, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(after), np.asarray(ref),
+                               rtol=1e-5)
+    # both BNs must survive (shared filter -> no fusing)
+    types = [op.type for op in main.global_block().ops]
+    assert types.count("batch_norm") == 2

@@ -7,7 +7,6 @@ transport, and parity is exact: sync-PS SGD over 2 trainers with mean
 aggregation must equal local SGD on the concatenated batch.
 """
 
-import socket
 import threading
 
 import numpy as np
