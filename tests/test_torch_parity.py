@@ -154,3 +154,65 @@ def test_layernorm_gelu_block_matches_torch():
     to = th @ torch.from_numpy(w2)
     want = torch.nn.functional.layer_norm(tx + to, (D,)).numpy()
     np.testing.assert_allclose(ours, want, rtol=1e-3, atol=2e-4)
+
+
+def test_batch_norm_training_matches_torch():
+    """Train-mode BN: normalized output, running-stat updates, and the
+    gradient flow through a conv+BN+SGD step must match torch."""
+    B, C, H, W = 4, 3, 6, 6
+    rng = np.random.RandomState(3)
+    xb = rng.randn(B, C, H, W).astype("f")
+    w = (rng.randn(C, C, 3, 3) * 0.2).astype("f")
+    momentum = 0.9
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[C, H, W])
+        conv = fluid.layers.conv2d(x, C, 3, padding=1, bias_attr=False,
+                                   param_attr=fluid.ParamAttr(name="w"))
+        bn = fluid.layers.batch_norm(conv, momentum=momentum,
+                                     moving_mean_name="rm",
+                                     moving_variance_name="rv")
+        loss = fluid.layers.mean(fluid.layers.square(bn))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    ours = []
+    with fluid.scope_guard(fluid.Scope()):
+        scope = fluid.core.executor.global_scope()
+        exe.run(startup)
+        _set_param(scope, "w", w)
+        for _ in range(3):
+            lo, = exe.run(main, feed={"x": xb}, fetch_list=[loss])
+            ours.append(float(np.asarray(lo).reshape(-1)[0]))
+        rm = np.asarray(scope.find_var("rm").get_tensor().numpy())
+        rv = np.asarray(scope.find_var("rv").get_tensor().numpy())
+        w_f = np.asarray(scope.find_var("w").get_tensor().numpy())
+
+    tconv = torch.nn.Conv2d(C, C, 3, padding=1, bias=False)
+    tbn = torch.nn.BatchNorm2d(C, momentum=1 - momentum)  # torch: 1-m conv.
+    with torch.no_grad():
+        tconv.weight.copy_(torch.from_numpy(w))
+    opt = torch.optim.SGD(list(tconv.parameters()) + list(tbn.parameters()),
+                          lr=0.1)
+    tx = torch.from_numpy(xb)
+    theirs = []
+    for _ in range(3):
+        opt.zero_grad()
+        l = torch.mean(tbn(tconv(tx)) ** 2)
+        l.backward()
+        opt.step()
+        theirs.append(float(l.detach()))
+
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=1e-5)
+    np.testing.assert_allclose(rm, tbn.running_mean.numpy(), rtol=2e-3,
+                               atol=1e-5)
+    # fluid stores the BIASED batch variance in the moving average while
+    # torch's running_var is unbiased: batch contributions differ by
+    # (n-1)/n (n = B*H*W) but the initial value 1.0 decays uncorrected
+    # through m^steps — the exact relation after k steps is
+    #   ours = torch_rv * (n-1)/n + m^k * (1/n)
+    n = B * H * W
+    expected = tbn.running_var.numpy() * (n - 1) / n + momentum ** 3 / n
+    np.testing.assert_allclose(rv, expected, rtol=2e-4, atol=1e-5)
+    np.testing.assert_allclose(w_f, tconv.weight.detach().numpy(),
+                               rtol=2e-3, atol=2e-5)
