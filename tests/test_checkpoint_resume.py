@@ -1,0 +1,101 @@
+"""Checkpoint/resume cycle (SURVEY §5: io.py persistables; the reference's
+book tests run full train→save→load→infer cycles — this adds the
+train→save→load→CONTINUE-training leg, including optimizer accumulators)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _build():
+    # unique_name.guard(): each build starts a fresh name counter, like a
+    # fresh process would (accumulator names embed the counter — the
+    # reference has the same property, resumed via fluid.unique_name.guard)
+    guard = fluid.unique_name.guard() if hasattr(fluid, "unique_name") else None
+    if guard is not None:
+        guard.__enter__()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 11
+    startup.random_seed = 11
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[6])
+        y = fluid.layers.data("y", shape=[1])
+        # explicit param names: a resume run must address the same vars the
+        # checkpoint saved (auto-generated names shift across rebuilds)
+        h = fluid.layers.fc(x, 12, act="relu",
+                            param_attr=fluid.ParamAttr(name="ck_w1"),
+                            bias_attr=fluid.ParamAttr(name="ck_b1"))
+        pred = fluid.layers.fc(h, 1,
+                               param_attr=fluid.ParamAttr(name="ck_w2"),
+                               bias_attr=fluid.ParamAttr(name="ck_b2"))
+        loss = fluid.layers.mean(fluid.layers.square(pred - y))
+        fluid.optimizer.Adam(1e-2).minimize(loss)
+    if guard is not None:
+        guard.__exit__(None, None, None)
+    return main, startup, loss
+
+
+def _data(step, rng_seed=5):
+    rng = np.random.RandomState(rng_seed + step)
+    x = rng.randn(16, 6).astype("f")
+    w = np.linspace(-1, 1, 6).astype("f").reshape(6, 1)
+    return x, (x @ w).astype("f")
+
+
+def test_train_save_resume_matches_uninterrupted(tmp_path):
+    ckpt = str(tmp_path / "ckpt")
+
+    # -- uninterrupted run: 10 steps
+    main, startup, loss = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    full = []
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for i in range(10):
+            xb, yb = _data(i)
+            lo, = exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+            full.append(float(np.asarray(lo).reshape(-1)[0]))
+
+    # -- interrupted run: 5 steps, save, fresh scope, load, 5 more steps
+    main2, startup2, loss2 = _build()
+    part1 = []
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup2)
+        for i in range(5):
+            xb, yb = _data(i)
+            lo, = exe.run(main2, feed={"x": xb, "y": yb}, fetch_list=[loss2])
+            part1.append(float(np.asarray(lo).reshape(-1)[0]))
+        fluid.io.save_persistables(exe, ckpt, main_program=main2)
+
+    main3, startup3, loss3 = _build()
+    part2 = []
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup3)             # re-init, then overwrite from disk
+        fluid.io.load_persistables(exe, ckpt, main_program=main3)
+        for i in range(5, 10):
+            xb, yb = _data(i)
+            lo, = exe.run(main3, feed={"x": xb, "y": yb}, fetch_list=[loss3])
+            part2.append(float(np.asarray(lo).reshape(-1)[0]))
+
+    # same seeds -> part1 matches the first half exactly; the resumed half
+    # must match the uninterrupted run (params AND adam moments restored)
+    np.testing.assert_allclose(part1, full[:5], rtol=1e-6)
+    np.testing.assert_allclose(part2, full[5:], rtol=1e-4)
+
+
+def test_save_persistables_includes_optimizer_state(tmp_path):
+    ckpt = str(tmp_path / "ck2")
+    main, startup, loss = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        xb, yb = _data(0)
+        exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+        fluid.io.save_persistables(exe, ckpt, main_program=main)
+    import os
+
+    bundle = np.load(os.path.join(ckpt, "__params__.npz"))
+    names = set(bundle.files)
+    # adam moments + beta pow accumulators persisted alongside params
+    assert any("moment" in n for n in names), names
+    assert any("beta1" in n or "beta2" in n for n in names), names
