@@ -1,5 +1,13 @@
-"""Test config: run JAX on a virtual 8-device CPU mesh (multi-chip sharding
-tests run here; the driver separately dry-runs the real TPU path).
+"""Test config: two tiers.
+
+Default tier: JAX on a virtual 8-device CPU mesh (multi-chip sharding tests
+run here; fast, deterministic, no hardware needed).
+
+TPU tier (``PADDLE_TPU_TESTS=1 pytest -m tpu``): leaves the real accelerator
+backend enabled so ``@pytest.mark.tpu`` tests exercise TPUPlace on the chip —
+the per-place parametrization the reference applies through
+``check_output_with_place`` (reference op_test.py:782,988).  TPU-marked tests
+auto-skip in the default tier, so the plain suite stays green anywhere.
 
 NB: the axon sitecustomize registers the TPU plugin and overrides
 jax_platforms at interpreter start, so env vars alone are not enough — the
@@ -9,11 +17,58 @@ config updates below force the CPU backend before any backend is created.
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
+import pytest
+
+TPU_TIER = os.environ.get("PADDLE_TPU_TESTS") == "1"
+
+if not TPU_TIER:
+    os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+if not TPU_TIER:
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _have_accelerator():
+    try:
+        return any(d.platform != "cpu" for d in jax.devices())
+    except Exception:
+        return False
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "tpu: needs a real TPU chip; run via PADDLE_TPU_TESTS=1 pytest -m tpu",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    if TPU_TIER and _have_accelerator():
+        # inverse guard: the default-tier tests need the 8-device CPU mesh
+        # this process did not configure — running them against the TPU
+        # backend would exercise the wrong topology
+        skip = pytest.mark.skip(
+            reason="default tier needs the CPU mesh (unset PADDLE_TPU_TESTS)")
+        for item in items:
+            if "tpu" not in item.keywords:
+                item.add_marker(skip)
+        return
+    if TPU_TIER:
+        # PADDLE_TPU_TESTS=1 without an accelerator: neither tier can run
+        # (the CPU mesh was not configured in this process either)
+        skip = pytest.mark.skip(
+            reason="PADDLE_TPU_TESTS=1 but no accelerator present; unset it "
+                   "to run the CPU-mesh tier")
+        for item in items:
+            item.add_marker(skip)
+        return
+    skip = pytest.mark.skip(reason="TPU tier: set PADDLE_TPU_TESTS=1 on a "
+                                   "TPU host")
+    for item in items:
+        if "tpu" in item.keywords:
+            item.add_marker(skip)

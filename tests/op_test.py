@@ -6,12 +6,60 @@ expected `outputs`; `check_output` runs the single op through the real
 executor comparing to numpy; `check_grad` compares analytic gradients (built
 via append_backward over the registered grad ops) against central-difference
 numeric gradients of the same scalar loss.
+
+Per-place parametrization (reference op_test.py:782 check_output_with_place,
+:988): ``check_output_with_place(place)`` / ``check_grad_with_place(place)``
+run the same program on an explicit place (TPUPlace exercises the real chip
+when the TPU test tier is enabled — see conftest.py).  Tolerance tiers: a
+``dtype="bfloat16"`` kwarg casts floating inputs to bf16 before the run and
+compares against the f32 golden at the bf16 tier (~3 decimal digits);
+TPU f32 runs default to the TPU tier (MXU matmuls accumulate differently
+from numpy's float64-ish dot).
 """
 
 import numpy as np
 
 import paddle_tpu as fluid
 from paddle_tpu.framework import Program, convert_np_dtype_to_dtype_
+
+# tolerance tiers, keyed by (compute dtype, place kind)
+TOL_TIERS = {
+    "f32_cpu": (1e-5, 1e-4),     # harness defaults (atol, rtol)
+    "f32_tpu": (1e-3, 1e-3),     # MXU f32 pass / different reduce order
+    "bf16": (2e-2, 2e-2),        # bf16 has ~8 mantissa bits
+}
+
+
+def _is_float(arr):
+    return np.asarray(arr).dtype.kind == "f"
+
+
+def _precision_ctx(place, dtype=None):
+    """f32 goldens on TPU run at HIGHEST matmul precision: the default TPU
+    f32 precision is a bf16 MXU pass (~2e-2 error), which the separate bf16
+    tier covers; the f32 tier verifies the lowering itself."""
+    import contextlib
+
+    import jax
+
+    if isinstance(place, fluid.TPUPlace) and dtype is None:
+        return jax.default_matmul_precision("highest")
+    return contextlib.nullcontext()
+
+
+def _cast_feed_bf16(feed):
+    """Cast float32 feeds to bfloat16 (via jnp so numpy-without-ml_dtypes
+    still works); integer/bool feeds pass through."""
+    import jax.numpy as jnp
+
+    out = {}
+    for k, v in feed.items():
+        a = np.asarray(v)
+        if a.dtype.kind == "f":  # mirror _var_dtype's float-kind re-declare
+            out[k] = np.asarray(jnp.asarray(a, dtype=jnp.bfloat16))
+        else:
+            out[k] = v
+    return out
 
 
 def _as_items(val):
@@ -27,16 +75,28 @@ class OpTest:
     op_type = None
     atol = 1e-5
     rtol = 1e-4
+    # place used by plain check_output/check_grad; None -> CPUPlace
+    place = None
 
     # subclasses set these in setup_method or directly
     inputs = {}
     outputs = {}
     attrs = {}
 
+    def _default_place(self):
+        return self.place if self.place is not None else fluid.CPUPlace()
+
     def _build_program(self, extra_grad=False, inputs_to_check=(),
-                       output_names=None):
+                       output_names=None, feed_dtype=None):
         main, startup = Program(), Program()
         feed = {}
+
+        def _var_dtype(arr):
+            d = convert_np_dtype_to_dtype_(arr.dtype)
+            if feed_dtype is not None and np.asarray(arr).dtype.kind == "f":
+                return feed_dtype
+            return d
+
         with fluid.program_guard(main, startup):
             block = main.global_block()
             in_slots = {}
@@ -48,7 +108,7 @@ class OpTest:
                         v = block.create_var(
                             name=name,
                             shape=arr.shape,
-                            dtype=convert_np_dtype_to_dtype_(arr.dtype),
+                            dtype=_var_dtype(arr),
                             stop_gradient=(name not in inputs_to_check
                                            and slot not in inputs_to_check),
                         )
@@ -61,7 +121,7 @@ class OpTest:
                     block.create_var(
                         name=name,
                         shape=arr.shape,
-                        dtype=convert_np_dtype_to_dtype_(arr.dtype),
+                        dtype=_var_dtype(arr),
                         stop_gradient=slot not in inputs_to_check,
                     )
                     feed[name] = arr
@@ -99,11 +159,26 @@ class OpTest:
         return main, startup, feed, out_names, loss
 
     # -- forward check -------------------------------------------------------
-    def check_output(self, atol=None, rtol=None, no_check_set=()):
-        atol = atol if atol is not None else self.atol
-        rtol = rtol if rtol is not None else self.rtol
-        main, startup, feed, out_names, _ = self._build_program()
-        exe = fluid.Executor(fluid.CPUPlace())
+    def check_output_with_place(self, place, atol=None, rtol=None,
+                                no_check_set=(), dtype=None):
+        """Run the op on an explicit place (reference op_test.py:782).
+
+        ``dtype="bfloat16"`` runs the op in bf16 (inputs cast, vars declared
+        bf16) and compares against the f32 golden at the bf16 tolerance tier.
+        """
+        if dtype == "bfloat16":
+            tier = TOL_TIERS["bf16"]
+        elif isinstance(place, fluid.TPUPlace):
+            tier = TOL_TIERS["f32_tpu"]
+        else:
+            tier = (self.atol, self.rtol)
+        atol = atol if atol is not None else max(tier[0], self.atol)
+        rtol = rtol if rtol is not None else max(tier[1], self.rtol)
+        main, startup, feed, out_names, _ = self._build_program(
+            feed_dtype=dtype)
+        if dtype == "bfloat16":
+            feed = _cast_feed_bf16(feed)
+        exe = fluid.Executor(place)
         scope = fluid.Scope()
         fetch = []
         expected = []
@@ -120,7 +195,7 @@ class OpTest:
                     continue
                 fetch.append(out_names[slot][0])
                 expected.append(np.asarray(val))
-        with fluid.scope_guard(scope):
+        with _precision_ctx(place, dtype), fluid.scope_guard(scope):
             exe.run(startup)
             got = exe.run(main, feed=feed, fetch_list=fetch)
         for name, g, e in zip(fetch, got, expected):
@@ -136,7 +211,32 @@ class OpTest:
                     err_msg="output %s of op %s" % (name, self.op_type),
                 )
 
+    def check_output(self, atol=None, rtol=None, no_check_set=()):
+        self.check_output_with_place(self._default_place(), atol=atol,
+                                     rtol=rtol, no_check_set=no_check_set)
+
     # -- gradient check ------------------------------------------------------
+    def check_grad_with_place(self, place, inputs_to_check, output_names=None,
+                              max_relative_error=None, numeric_delta=5e-3,
+                              no_grad_set=None, max_elements=512):
+        """check_grad on an explicit place (reference op_test.py:1033):
+        analytic gradients run on `place`; numeric finite differences stay on
+        CPU (the f64-ish golden path).  TPU f32 tier loosens the default
+        relative error to the MXU accumulation tier."""
+        if max_relative_error is None:
+            max_relative_error = (0.04 if isinstance(place, fluid.TPUPlace)
+                                  else 0.01)
+        old = self.place
+        self.place = place
+        try:
+            self.check_grad(inputs_to_check, output_names=output_names,
+                            max_relative_error=max_relative_error,
+                            numeric_delta=numeric_delta,
+                            no_grad_set=no_grad_set,
+                            max_elements=max_elements)
+        finally:
+            self.place = old
+
     def check_grad(self, inputs_to_check, output_names=None,
                    max_relative_error=0.01, numeric_delta=5e-3,
                    no_grad_set=None, max_elements=512):
@@ -165,8 +265,9 @@ class OpTest:
                 grad_names.append("in_%s@GRAD" % slot)
             else:
                 grad_names.append("%s@GRAD" % slot)  # by var name
-        exe = fluid.Executor(fluid.CPUPlace())
-        with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(self._default_place())
+        with _precision_ctx(self._default_place()), \
+                fluid.scope_guard(fluid.Scope()):
             exe.run(startup)
             res = exe.run(main, feed=feed,
                           fetch_list=[loss.name] + grad_names)
