@@ -255,12 +255,68 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
             rel, flow = _relevant_ops(block, t.name, no_grad)
             relevant_all |= set(rel)
             flow_all |= flow
+        gops_with_def = []
         for idx in sorted(relevant_all, reverse=True):
             op = block.ops[idx]
             opdef = get_op_def(op.type)
             ng = no_grad | {n for n in op.input_arg_names
                             if n and n not in flow_all}
-            grad_op_descs.extend(opdef.make_grad_ops(op, ng))
+            for gop in opdef.make_grad_ops(op, ng):
+                gops_with_def.append((opdef, gop))
+
+        # Second-and-later differentiation passes (double-grad: the block
+        # already holds grad ops from an earlier append_backward/gradients
+        # call) reuse @GRAD names; a pass-local gradient that collides with
+        # an existing var would silently alias the *previous* pass's
+        # gradient.  Rename pass-local gradients consistently (the
+        # reference's calc_gradient does this via _rename_grad_,
+        # backward.py:1199).  Pass-local = any gop output, plus any input in
+        # a "GRAD@<out_slot>" slot (the upstream gradient flowing within
+        # this pass) — other input slots reference existing forward vars.
+        created = {_grad_var_name(t.name) for t in targets}
+        rename = {}
+
+        def _fresh(n):
+            k = 2
+            while True:
+                cand = "%s@D%d" % (n, k)
+                if not block.has_var_recursive(cand) and cand not in rename.values():
+                    return cand
+                k += 1
+
+        local = set()
+        for opdef, gop in gops_with_def:
+            for names in gop.outputs.values():
+                local.update(n for n in names if n)
+            for slot, names in gop.inputs.items():
+                if slot.startswith("GRAD@") and slot[5:] in opdef.output_slots:
+                    local.update(n for n in names if n)
+        for n in sorted(local):
+            if n not in created and block.has_var_recursive(n):
+                rename[n] = _fresh(n)
+
+        # Apply the map in emission order.  An input is pass-local when its
+        # slot carries the upstream gradient ("GRAD@<out_slot>") OR when an
+        # earlier grad op of this pass already produced that name — the
+        # latter catches hand-written grad makers that pipe gradients
+        # through generic ops (e.g. the quant STE's assign), whose slot
+        # names say nothing about gradient-ness.  Grad ops are emitted in
+        # reverse topological order, so a consumer of a pass-local gradient
+        # always follows its producer.
+        grad_op_descs = []
+        produced = set()
+        for opdef, gop in gops_with_def:
+            for slot, names in list(gop.inputs.items()):
+                is_grad_slot = (slot.startswith("GRAD@")
+                                and slot[5:] in opdef.output_slots)
+                gop.inputs[slot] = [
+                    rename.get(n, n) if (is_grad_slot or n in produced) else n
+                    for n in names
+                ]
+            for slot, names in list(gop.outputs.items()):
+                produced.update(n for n in names if n)
+                gop.outputs[slot] = [rename.get(n, n) for n in names]
+            grad_op_descs.append(gop)
 
         grad_op_descs = _dedup_grad_ops(grad_op_descs)
         for gop in grad_op_descs:
@@ -269,6 +325,7 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
     outs = []
     for v in inputs:
         gname = _grad_var_name(v.name)
+        gname = rename.get(gname, gname)
         outs.append(block.var(gname) if block.has_var_recursive(gname) else None)
     return outs
 

@@ -122,8 +122,11 @@ class OpDef:
         for slot in self.output_slots:
             if op.output(slot):
                 inputs["Out@" + slot] = list(op.output(slot))
+                # "" holes (outputs the maker declined, e.g. grads of
+                # non-float inputs on a grad op) must stay holes, not
+                # become the bogus name "@GRAD"
                 inputs["GRAD@" + slot] = [
-                    _grad_var_name(n) for n in op.output(slot)
+                    _grad_var_name(n) if n else "" for n in op.output(slot)
                 ]
         outputs = {}
         block = op.block
@@ -186,10 +189,30 @@ def _synthesize_grad_opdef(base):
         out_grads = [rest[2 * i + 1] for i in range(n_out)]
 
         op = ctx.op
+        if op is not None and op.type != base.type + "_grad":
+            # replayed inside a higher-order (grad-of-grad) lowering: ctx.op
+            # is the outer op, whose output slots do not describe this replay
+            # — differentiate wrt every float input and let XLA DCE the rest
+            op = None
         requested = []
         for i, s in enumerate(base.input_slots):
-            names = op.output("X@" + s) if op is not None else []
-            requested.append(bool(names) and any(names) and fwd_ins[i] is not None)
+            if op is not None:
+                names = op.output("X@" + s)
+                want = bool(names) and any(names)
+            else:
+                want = True
+            x = fwd_ins[i]
+            is_float = (
+                x is not None
+                and not isinstance(x, (list, tuple))
+                and jnp.issubdtype(jnp.asarray(x).dtype
+                                   if not hasattr(x, "dtype") else x.dtype,
+                                   jnp.inexact)
+            ) or (
+                isinstance(x, (list, tuple)) and x
+                and all(jnp.issubdtype(xi.dtype, jnp.inexact) for xi in x)
+            )
+            requested.append(want and is_float)
         diff_idx = [i for i, r in enumerate(requested) if r]
         if not diff_idx:
             return tuple(None for _ in out_slots)
@@ -205,7 +228,9 @@ def _synthesize_grad_opdef(base):
         outs, vjp_fn = jax.vjp(fwd, *primals)
         cots = []
         for o, g in zip(outs, out_grads):
-            if g is None:
+            if o is None:
+                cots.append(None)
+            elif g is None:
                 cots.append(jax.tree_util.tree_map(jnp.zeros_like, o))
             elif isinstance(o, (list, tuple)):
                 cots.append(
@@ -246,7 +271,11 @@ def _synthesize_grad_opdef(base):
         outputs=out_slots,
         lower=grad_lower,
         infer_shape=grad_infer_shape,
-        grad_maker=None,
+        # grad ops are themselves differentiable (vjp of grad_lower), which
+        # is what double-grad rides: <op>_grad_grad is synthesized on demand
+        # the same way (reference registers conv2d_grad_grad et al. by hand,
+        # conv_op.cc:652)
+        grad_maker="auto",
         optional_inputs=opt_in,
         duplicable_inputs=dup_in,
         duplicable_outputs=dup_out,
@@ -304,7 +333,12 @@ def get_op_def(type):
     _ensure_ops_loaded()
     if type not in _OP_REGISTRY:
         if type.endswith("_grad"):
-            base = _OP_REGISTRY.get(type[: -len("_grad")])
+            # recursive: "X_grad_grad" synthesizes "X_grad" (itself possibly
+            # synthesized) on demand
+            try:
+                base = get_op_def(type[: -len("_grad")])
+            except ValueError:
+                base = None
             if base is not None and base.grad_maker == "auto":
                 _OP_REGISTRY[type] = _synthesize_grad_opdef(base)
                 return _OP_REGISTRY[type]

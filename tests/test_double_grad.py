@@ -1,0 +1,243 @@
+"""Double-grad (grad-of-grad) checks.
+
+Port of the reference's ``gradient_checker.py`` double_grad_check: the
+reference registers explicit grad-of-grad ops (conv2d_grad_grad at
+conv_op.cc:652, elementwise add/mul grad_grad, reshape2_grad_grad,
+instance_norm_grad_grad) and verifies them against numeric second
+differences.  Here ``<op>_grad_grad`` is synthesized by vjp-of-vjp through
+the registered lowering (core/registry.py), and ``fluid.gradients`` renames
+pass-local gradients so a second differentiation pass over the same block is
+well-defined.
+
+Protocol per test: build y = f(x); dx = gradients(y, x) [pass 1]; build the
+scalar s = sum(dx * u) for a fixed random vector u; grads2 = gradients(s, x)
+[pass 2 — runs the synthesized _grad_grad ops]; compare grads2 against
+central differences of s(x).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _second_order_check(build_fn, feed, wrt, atol=5e-3, rtol=5e-2,
+                        max_elements=48, delta=1e-2):
+    """build_fn(block-scope) -> (y, [x_vars]); checks d(sum(dy/dx * u))/dx
+    against numeric differences for each name in `wrt`."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        y, xs = build_fn()
+        first = fluid.gradients(y, xs)
+        assert all(g is not None for g in first), "first-order grad missing"
+        # s = sum_i sum(dx_i * u_i): exercises every first-grad output
+        rng = np.random.RandomState(7)
+        terms = []
+        for g in first:
+            u = rng.uniform(0.5, 1.5, [d if d > 0 else 1 for d in
+                                       g.shape or (1,)]).astype("float32")
+            uv = fluid.layers.assign(u)
+            terms.append(fluid.layers.reduce_sum(
+                fluid.layers.elementwise_mul(g, uv)))
+        s = terms[0]
+        for t in terms[1:]:
+            s = fluid.layers.elementwise_add(s, t)
+        second = fluid.gradients(s, xs)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    name_by_x = {x.name: g for x, g in zip(xs, second)}
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fetch = [s.name] + [name_by_x[n].name for n in wrt]
+        res = exe.run(main, feed=feed, fetch_list=fetch)
+    analytic = {n: np.asarray(g) for n, g in zip(wrt, res[1:])}
+
+    # numeric: central differences of s(x) using a fresh program (the same
+    # build + first pass + s head, no second pass)
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, startup2):
+        y2, xs2 = build_fn()
+        first2 = fluid.gradients(y2, xs2)
+        rng = np.random.RandomState(7)
+        terms = []
+        for g in first2:
+            u = rng.uniform(0.5, 1.5, [d if d > 0 else 1 for d in
+                                       g.shape or (1,)]).astype("float32")
+            uv = fluid.layers.assign(u)
+            terms.append(fluid.layers.reduce_sum(
+                fluid.layers.elementwise_mul(g, uv)))
+        s2 = terms[0]
+        for t in terms[1:]:
+            s2 = fluid.layers.elementwise_add(s2, t)
+    fexe = fluid.Executor(fluid.CPUPlace())
+    fscope = fluid.Scope()
+    with fluid.scope_guard(fscope):
+        fexe.run(startup2)
+
+        def eval_s(fd):
+            out, = fexe.run(main2, feed=fd, fetch_list=[s2.name])
+            return float(np.asarray(out).reshape(-1)[0])
+
+        prng = np.random.RandomState(0)
+        for n in wrt:
+            base = np.asarray(feed[n], dtype="float64")
+            flat = base.reshape(-1)
+            size = flat.size
+            idxs = (np.arange(size) if size <= max_elements
+                    else prng.choice(size, max_elements, replace=False))
+            a = analytic[n].reshape(-1)
+            for i in idxs:
+                p = flat.copy(); p[i] += delta
+                fp = dict(feed); fp[n] = p.reshape(base.shape).astype("float32")
+                m = flat.copy(); m[i] -= delta
+                fm = dict(feed); fm[n] = m.reshape(base.shape).astype("float32")
+                num = (eval_s(fp) - eval_s(fm)) / (2 * delta)
+                diff = abs(a[i] - num)
+                denom = max(abs(a[i]), abs(num), 1e-2)
+                assert diff / denom <= rtol or diff <= atol, (
+                    "double-grad mismatch wrt %s elem %d: analytic=%g "
+                    "numeric=%g" % (n, i, a[i], num))
+
+
+def _data(name, shape, arr):
+    v = fluid.layers.data(name, shape=list(shape), dtype="float32",
+                          append_batch_size=False)
+    v.stop_gradient = False
+    return v
+
+
+class TestSquareDoubleGrad:
+    def test_square(self):
+        x = np.random.RandomState(1).uniform(0.2, 1.0, (3, 4)).astype("float32")
+
+        def build():
+            xv = _data("x", (3, 4), x)
+            y = fluid.layers.square(xv)
+            return y, [xv]
+
+        _second_order_check(build, {"x": x}, ["x"])
+
+
+class TestSigmoidDoubleGrad:
+    def test_sigmoid(self):
+        x = np.random.RandomState(2).uniform(-1, 1, (4, 5)).astype("float32")
+
+        def build():
+            xv = _data("x", (4, 5), x)
+            return fluid.layers.sigmoid(xv), [xv]
+
+        _second_order_check(build, {"x": x}, ["x"])
+
+
+class TestElementwiseDoubleGrad:
+    def test_mul(self):
+        r = np.random.RandomState(3)
+        x = r.uniform(0.5, 1.5, (3, 4)).astype("float32")
+        y = r.uniform(0.5, 1.5, (3, 4)).astype("float32")
+
+        def build():
+            xv = _data("x", (3, 4), x)
+            yv = _data("y", (3, 4), y)
+            return fluid.layers.elementwise_mul(xv, yv), [xv, yv]
+
+        _second_order_check(build, {"x": x, "y": y}, ["x", "y"])
+
+    def test_add_then_tanh(self):
+        r = np.random.RandomState(4)
+        x = r.uniform(-0.5, 0.5, (2, 6)).astype("float32")
+        y = r.uniform(-0.5, 0.5, (2, 6)).astype("float32")
+
+        def build():
+            xv = _data("x", (2, 6), x)
+            yv = _data("y", (2, 6), y)
+            return fluid.layers.tanh(
+                fluid.layers.elementwise_add(xv, yv)), [xv, yv]
+
+        _second_order_check(build, {"x": x, "y": y}, ["x", "y"])
+
+
+class TestReshapeDoubleGrad:
+    def test_reshape2_square(self):
+        x = np.random.RandomState(5).uniform(0.2, 1.0, (2, 6)).astype("float32")
+
+        def build():
+            xv = _data("x", (2, 6), x)
+            r = fluid.layers.reshape(xv, shape=[3, 4])
+            return fluid.layers.square(r), [xv]
+
+        _second_order_check(build, {"x": x}, ["x"])
+
+
+class TestConv2dDoubleGrad:
+    def test_conv2d(self):
+        r = np.random.RandomState(6)
+        x = r.uniform(-0.5, 0.5, (1, 2, 5, 5)).astype("float32")
+
+        def build():
+            xv = _data("x", (1, 2, 5, 5), x)
+            # conv via the layer (creates a filter parameter); square head
+            # makes the first grad depend on x so d2/dx2 is nonzero
+            c = fluid.layers.conv2d(xv, num_filters=3, filter_size=3,
+                                    padding=1,
+                                    param_attr=fluid.ParamAttr(
+                                        name="dg_conv_w",
+                                        initializer=fluid.initializer.
+                                        NormalInitializer(seed=11)),
+                                    bias_attr=False)
+            return fluid.layers.square(c), [xv]
+
+        _second_order_check(build, {"x": x}, ["x"], max_elements=24)
+
+
+class TestInstanceNormDoubleGrad:
+    def test_instance_norm(self):
+        x = np.random.RandomState(8).uniform(
+            0.5, 1.5, (2, 3, 4, 4)).astype("float32")
+
+        def build():
+            xv = _data("x", (2, 3, 4, 4), x)
+            out = fluid.layers.instance_norm(xv)
+            return out, [xv]
+
+        _second_order_check(build, {"x": x}, ["x"], max_elements=24,
+                            rtol=8e-2, delta=5e-3)
+
+
+class TestSTEDoubleGrad:
+    def test_quant_ste_through_square(self):
+        """Hand-written grad makers piping gradients through generic ops
+        (quant STE emits an `assign` whose gradient rides slot X, not a
+        GRAD@ slot) must still see this pass's gradient, not the stale
+        first-pass one.  h = x^2 -> STE quant -> y = sum(q^2): with STE
+        identity, ddx = 12 x^2 modulo quantization rounding."""
+        x = np.array([[1.0, 2.0, 3.0, 4.0]], dtype="float32")
+
+        def build():
+            xv = _data("x", (1, 4), x)
+            h = fluid.layers.square(xv)
+            from paddle_tpu.layer_helper import LayerHelper
+            helper = LayerHelper("fake_quantize_abs_max")
+            q = helper.create_variable_for_type_inference("float32")
+            s = helper.create_variable_for_type_inference("float32")
+            helper.append_op(
+                type="fake_quantize_abs_max", inputs={"X": [h.name]},
+                outputs={"Out": [q.name], "OutScale": [s.name]},
+                attrs={"bit_length": 16})
+            return fluid.layers.square(q), [xv]
+
+        _second_order_check(build, {"x": x}, ["x"], rtol=8e-2, delta=5e-3)
+
+
+class TestMatmulDoubleGrad:
+    def test_matmul(self):
+        r = np.random.RandomState(9)
+        a = r.uniform(-0.5, 0.5, (3, 4)).astype("float32")
+        b = r.uniform(-0.5, 0.5, (4, 2)).astype("float32")
+
+        def build():
+            av = _data("a", (3, 4), a)
+            bv = _data("b", (4, 2), b)
+            return fluid.layers.tanh(fluid.layers.matmul(av, bv)), [av, bv]
+
+        _second_order_check(build, {"a": a, "b": b}, ["a", "b"])
