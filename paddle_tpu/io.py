@@ -156,11 +156,20 @@ def _prune_for_inference(program, feed_names, target_names):
 def save_inference_model(dirname, feeded_var_names, target_vars, executor,
                          main_program=None, model_filename=None,
                          params_filename=None, export_for_deployment=True,
-                         program_only=False):
+                         program_only=False, legacy_format=False):
+    """``legacy_format=True`` writes the reference's on-disk format
+    (``__model__`` ProgramDesc protobuf + LoDTensor param streams,
+    framework.proto:212 / lod_tensor.cc:219) so a reference install can load
+    the directory; default is the JSON IR."""
     program = main_program or _default_main()
     target_names = [v.name if isinstance(v, Variable) else v for v in target_vars]
     pruned = _prune_for_inference(program, feeded_var_names, target_names)
     os.makedirs(dirname, exist_ok=True)
+    if legacy_format:
+        _save_legacy_model(dirname, feeded_var_names, target_names, pruned,
+                           model_filename, params_filename,
+                           program_only=program_only)
+        return target_names
     model = {
         "program": pruned.to_dict(),
         "feed_names": list(feeded_var_names),
@@ -175,7 +184,23 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
 
 def load_inference_model(dirname, executor, model_filename=None,
                          params_filename=None):
-    with open(os.path.join(dirname, model_filename or "__model__.json")) as f:
+    """Loads either our JSON IR or a reference-saved directory (``__model__``
+    ProgramDesc protobuf + per-var / combined LoDTensor streams).  The format
+    is sniffed from the file content, so an explicit model_filename works for
+    both."""
+    if model_filename is not None:
+        candidates = [os.path.join(dirname, model_filename)]
+    else:
+        candidates = [os.path.join(dirname, "__model__.json"),
+                      os.path.join(dirname, "__model__")]
+    path = next((p for p in candidates if os.path.exists(p)), candidates[0])
+    from . import proto_compat
+
+    with open(path, "rb") as f:
+        head = f.read(1)
+    if proto_compat.is_program_desc(head):
+        return _load_legacy_model(dirname, path, params_filename)
+    with open(path) as f:
         model = json.load(f)
     program = Program.from_dict(model["program"])
     try:
@@ -184,6 +209,118 @@ def load_inference_model(dirname, executor, model_filename=None,
         pass
     fetch_vars = [program.global_block().var(n) for n in model["fetch_names"]]
     return program, model["feed_names"], fetch_vars
+
+
+def _strip_feed_fetch(prog_dict):
+    """Remove reference-style feed/fetch plumbing ops from a parsed program
+    (reference load_inference_model keeps them and its executor consumes
+    them; our executor feeds/fetches by var name).  Returns
+    (feed_names by col, fetch_names by col)."""
+    feeds, fetches = {}, {}
+    for bd in prog_dict["blocks"]:
+        kept = []
+        for od in bd["ops"]:
+            if od["type"] == "feed":
+                col = od["attrs"].get("col", len(feeds))
+                feeds[col] = od["outputs"]["Out"][0]
+            elif od["type"] == "fetch":
+                col = od["attrs"].get("col", len(fetches))
+                fetches[col] = od["inputs"]["X"][0]
+            else:
+                kept.append(od)
+        bd["ops"] = kept
+        bd["vars"] = [v for v in bd["vars"]
+                      if v["name"] not in ("feed", "fetch")]
+    return ([feeds[k] for k in sorted(feeds)],
+            [fetches[k] for k in sorted(fetches)])
+
+
+def _load_legacy_model(dirname, model_path, params_filename):
+    from . import proto_compat
+
+    with open(model_path, "rb") as f:
+        prog_dict = proto_compat.parse_program_desc(f.read())
+    feed_names, fetch_names = _strip_feed_fetch(prog_dict)
+    program = Program.from_dict(prog_dict)
+    block = program.global_block()
+    # mark data vars so executors treat feeds normally
+    for n in feed_names:
+        if block.has_var(n):
+            block.var(n).is_data = True
+    scope = global_scope()
+    persistables = sorted(
+        v.name for v in program.list_vars()
+        if v.persistable and not v.is_data and v.type == "lod_tensor")
+    if params_filename is not None:
+        with open(os.path.join(dirname, params_filename), "rb") as f:
+            # combine format: one stream per var, sorted by name
+            # (reference io.py:718 loads sorted(load_var_map))
+            for name in persistables:
+                arr, _lod = proto_compat.read_lod_tensor(f)
+                scope.var(name).set(arr)
+    else:
+        for name in persistables:
+            path = os.path.join(dirname, name)
+            if not os.path.exists(path):
+                raise FileNotFoundError(
+                    "parameter file %r missing from legacy model dir %s"
+                    % (name, dirname))
+            with open(path, "rb") as f:
+                arr, _lod = proto_compat.read_lod_tensor(f)
+            scope.var(name).set(arr)
+    fetch_vars = [block.var(n) for n in fetch_names]
+    return program, feed_names, fetch_vars
+
+
+def _save_legacy_model(dirname, feed_names, fetch_names, pruned,
+                       model_filename, params_filename, program_only=False):
+    from . import proto_compat
+
+    prog_dict = pruned.to_dict()
+    b0 = prog_dict["blocks"][0]
+    # reference-style plumbing: feed/fetch vars + ops with col attrs
+    b0["vars"].append({"name": "feed", "shape": None, "dtype": None,
+                       "lod_level": 0, "persistable": True,
+                       "stop_gradient": True, "type": "raw",
+                       "is_data": False, "is_parameter": False})
+    b0["vars"].append({"name": "fetch", "shape": None, "dtype": None,
+                       "lod_level": 0, "persistable": True,
+                       "stop_gradient": True, "type": "raw",
+                       "is_data": False, "is_parameter": False})
+    feed_ops = [{"type": "feed", "inputs": {"X": ["feed"]},
+                 "outputs": {"Out": [n]}, "attrs": {"col": i}}
+                for i, n in enumerate(feed_names)]
+    fetch_ops = [{"type": "fetch", "inputs": {"X": [n]},
+                  "outputs": {"Out": ["fetch"]}, "attrs": {"col": i}}
+                 for i, n in enumerate(fetch_names)]
+    b0["ops"] = feed_ops + b0["ops"] + fetch_ops
+    with open(os.path.join(dirname, model_filename or "__model__"),
+              "wb") as f:
+        f.write(proto_compat.serialize_program_desc(prog_dict))
+    if program_only:
+        return
+    scope = global_scope()
+    persistables = sorted(
+        v.name for v in pruned.list_vars()
+        if v.persistable and not v.is_data and v.type == "lod_tensor")
+    arrays = {}
+    for name in persistables:
+        sv = scope.find_var(name)
+        if sv is None or not sv.get_tensor()._is_initialized():
+            # a silent skip would misalign the combined stream against the
+            # loader's sorted(persistables) walk (reference save raises too)
+            raise RuntimeError(
+                "persistable variable %r is not initialized in scope; run "
+                "the startup program before save_inference_model" % name)
+        arrays[name] = np.asarray(sv.get_tensor().numpy())
+    if params_filename is not None:
+        with open(os.path.join(dirname, params_filename), "wb") as f:
+            for name in sorted(arrays):
+                proto_compat.write_lod_tensor(f, arrays[name])
+    else:
+        for name, arr in arrays.items():
+            with open(os.path.join(dirname, name), "wb") as f:
+                proto_compat.write_lod_tensor(f, arr)
 
 
 def save_train_model(dirname, feed_names, fetch_vars, executor,

@@ -1,0 +1,510 @@
+"""Reference model-format compatibility: the ``__model__`` ProgramDesc
+protobuf and per-variable LoDTensor binary streams.
+
+The reference serializes models as a proto2 ``ProgramDesc``
+(paddle/fluid/framework/framework.proto:212 — blocks:1, version:4) and each
+parameter as a binary stream (lod_tensor.cc:219 SerializeToStream: uint32
+version, LoD levels, then tensor_util.cc:383 TensorToStream: uint32 version,
+int32 desc-size + VarType.TensorDesc proto, raw data).  This module reads
+AND writes both formats with a minimal hand-rolled proto2 wire codec (no
+generated code, no protobuf dependency), so
+
+* ``load_inference_model`` accepts a directory saved by the reference
+  (completing the "swap CUDAPlace for TPUPlace, keep everything" story for
+  saved models, not just code), and
+* ``save_inference_model(..., legacy_format=True)`` emits a directory the
+  reference can load.
+
+Field numbers below cite framework.proto lines.
+"""
+
+import struct
+
+import numpy as np
+
+__all__ = [
+    "parse_program_desc",
+    "serialize_program_desc",
+    "read_lod_tensor",
+    "write_lod_tensor",
+    "is_program_desc",
+]
+
+# -- proto2 wire format ------------------------------------------------------
+
+_WT_VARINT = 0
+_WT_64BIT = 1
+_WT_LEN = 2
+_WT_32BIT = 5
+
+
+def _read_varint(buf, pos):
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 70:
+            raise ValueError("malformed varint")
+
+
+def _parse_fields(buf):
+    """Decode one message into {field_number: [raw values]} (repeated fields
+    accumulate in order)."""
+    fields = {}
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        key, pos = _read_varint(buf, pos)
+        fno, wt = key >> 3, key & 7
+        if wt == _WT_VARINT:
+            val, pos = _read_varint(buf, pos)
+        elif wt == _WT_64BIT:
+            val = struct.unpack_from("<q", buf, pos)[0]
+            pos += 8
+        elif wt == _WT_LEN:
+            ln, pos = _read_varint(buf, pos)
+            val = bytes(buf[pos:pos + ln])
+            pos += ln
+        elif wt == _WT_32BIT:
+            val = struct.unpack_from("<i", buf, pos)[0]
+            pos += 4
+        else:
+            raise ValueError("unsupported wire type %d" % wt)
+        fields.setdefault(fno, []).append(val)
+    return fields
+
+
+def _first(fields, fno, default=None):
+    v = fields.get(fno)
+    return v[0] if v else default
+
+
+def _signed64(v):
+    """proto int32/int64 varints are two's-complement 64-bit."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _unpack_repeated_varints(fields, fno):
+    """repeated int (possibly packed): packed entries arrive as one LEN
+    payload, unpacked as individual varints."""
+    out = []
+    for v in fields.get(fno, []):
+        if isinstance(v, bytes):
+            pos = 0
+            while pos < len(v):
+                x, pos = _read_varint(v, pos)
+                out.append(_signed64(x))
+        else:
+            out.append(_signed64(v))
+    return out
+
+
+def _unpack_repeated_floats(fields, fno):
+    out = []
+    for v in fields.get(fno, []):
+        if isinstance(v, bytes):
+            out.extend(struct.unpack("<%df" % (len(v) // 4), v))
+        else:
+            out.append(struct.unpack("<f", struct.pack("<i", v))[0])
+    return out
+
+
+class _Writer:
+    def __init__(self):
+        self.parts = []
+
+    def varint(self, fno, val):
+        self._key(fno, _WT_VARINT)
+        self._varint(val if val >= 0 else val + (1 << 64))
+        return self
+
+    def _key(self, fno, wt):
+        self._varint((fno << 3) | wt)
+
+    def _varint(self, v):
+        out = bytearray()
+        while True:
+            b = v & 0x7F
+            v >>= 7
+            if v:
+                out.append(b | 0x80)
+            else:
+                out.append(b)
+                break
+        self.parts.append(bytes(out))
+
+    def bytes_field(self, fno, payload):
+        self._key(fno, _WT_LEN)
+        self._varint(len(payload))
+        self.parts.append(payload)
+        return self
+
+    def string(self, fno, s):
+        return self.bytes_field(fno, s.encode("utf-8"))
+
+    def float32(self, fno, f):
+        self._key(fno, _WT_32BIT)
+        self.parts.append(struct.pack("<f", f))
+        return self
+
+    def getvalue(self):
+        return b"".join(self.parts)
+
+
+# -- enums (framework.proto) -------------------------------------------------
+
+# VarType.Type (framework.proto:105): pod dtypes + container kinds
+_DTYPE_FROM_PB = {
+    0: "bool", 1: "int16", 2: "int32", 3: "int64",
+    4: "float16", 5: "float32", 6: "float64", 20: "uint8", 21: "int8",
+}
+_DTYPE_TO_PB = {v: k for k, v in _DTYPE_FROM_PB.items()}
+
+_PB_LOD_TENSOR = 7
+_PB_SELECTED_ROWS = 8
+_PB_FEED_MINIBATCH = 9
+_PB_FETCH_LIST = 10
+_PB_STEP_SCOPES = 11
+_PB_LOD_TENSOR_ARRAY = 13
+_PB_READER = 15
+_PB_RAW = 17
+
+_VARTYPE_FROM_PB = {
+    _PB_LOD_TENSOR: "lod_tensor",
+    _PB_SELECTED_ROWS: "selected_rows",
+    _PB_LOD_TENSOR_ARRAY: "lod_tensor_array",
+    _PB_READER: "reader",
+    _PB_STEP_SCOPES: "step_scopes",
+    _PB_RAW: "raw",
+    _PB_FEED_MINIBATCH: "lod_tensor",
+    _PB_FETCH_LIST: "lod_tensor",
+}
+
+# AttrType (framework.proto:26) -> OpDesc.Attr value field number
+_ATTR_INT, _ATTR_FLOAT, _ATTR_STRING = 0, 1, 2
+_ATTR_INTS, _ATTR_FLOATS, _ATTR_STRINGS = 3, 4, 5
+_ATTR_BOOLEAN, _ATTR_BOOLEANS, _ATTR_BLOCK = 6, 7, 8
+_ATTR_LONG, _ATTR_BLOCKS, _ATTR_LONGS = 9, 10, 11
+
+
+# -- ProgramDesc decode ------------------------------------------------------
+
+
+def is_program_desc(data):
+    """Cheap sniff: our JSON IR starts with '{'; a ProgramDesc starts with a
+    field-1 LEN key (0x0a) for blocks."""
+    return bool(data) and data[:1] == b"\x0a"
+
+
+def _parse_attr(buf):
+    """OpDesc.Attr (framework.proto:44): name=1, type=2, i=3, f=4, s=5,
+    ints=6, floats=7, strings=8, b=10, bools=11, block_idx=12, l=13,
+    blocks_idx=14, longs=15."""
+    f = _parse_fields(buf)
+    name = _first(f, 1, b"").decode("utf-8")
+    atype = _first(f, 2, 0)
+    if atype == _ATTR_INT:
+        val = _signed64(_first(f, 3, 0)) & 0xFFFFFFFF
+        val = val - (1 << 32) if val >= (1 << 31) else val
+    elif atype == _ATTR_FLOAT:
+        raw = _first(f, 4, 0)
+        val = struct.unpack("<f", struct.pack("<I", raw & 0xFFFFFFFF))[0] \
+            if not isinstance(raw, float) else raw
+    elif atype == _ATTR_STRING:
+        val = _first(f, 5, b"").decode("utf-8")
+    elif atype == _ATTR_INTS:
+        val = [v - (1 << 32) if v >= (1 << 31) else v
+               for v in (x & 0xFFFFFFFF for x in
+                         _unpack_repeated_varints(f, 6))]
+    elif atype == _ATTR_FLOATS:
+        val = _unpack_repeated_floats(f, 7)
+    elif atype == _ATTR_STRINGS:
+        val = [s.decode("utf-8") for s in f.get(8, [])]
+    elif atype == _ATTR_BOOLEAN:
+        val = bool(_first(f, 10, 0))
+    elif atype == _ATTR_BOOLEANS:
+        val = [bool(v) for v in _unpack_repeated_varints(f, 11)]
+    elif atype == _ATTR_BLOCK:
+        val = _first(f, 12, 0)
+    elif atype == _ATTR_LONG:
+        val = _signed64(_first(f, 13, 0))
+    elif atype == _ATTR_BLOCKS:
+        val = _unpack_repeated_varints(f, 14)
+    elif atype == _ATTR_LONGS:
+        val = _unpack_repeated_varints(f, 15)
+    else:
+        val = None
+    return name, val
+
+
+def _parse_op_var(buf):
+    """OpDesc.Var (framework.proto:62): parameter=1, arguments=2."""
+    f = _parse_fields(buf)
+    slot = _first(f, 1, b"").decode("utf-8")
+    args = [a.decode("utf-8") for a in f.get(2, [])]
+    return slot, args
+
+
+def _parse_tensor_desc(buf):
+    """VarType.TensorDesc (framework.proto:139): data_type=1, dims=2."""
+    f = _parse_fields(buf)
+    dtype = _DTYPE_FROM_PB.get(_first(f, 1, 5), "float32")
+    dims = _unpack_repeated_varints(f, 2)
+    return dtype, dims
+
+
+def _parse_var_type(buf):
+    """VarType (framework.proto:105): type=1, lod_tensor=3 (LoDTensorDesc:
+    tensor=1, lod_level=2), tensor_array=4."""
+    f = _parse_fields(buf)
+    kind = _first(f, 1, _PB_LOD_TENSOR)
+    dtype, dims, lod_level = None, None, 0
+    sub = _first(f, 3) or _first(f, 4)
+    if sub is not None:
+        sf = _parse_fields(sub)
+        td = _first(sf, 1)
+        if td is not None:
+            dtype, dims = _parse_tensor_desc(td)
+        lod_level = _first(sf, 2, 0)
+    return _VARTYPE_FROM_PB.get(kind, "lod_tensor"), dtype, dims, lod_level
+
+
+def _parse_var_desc(buf):
+    """VarDesc (framework.proto:166): name=1, type=2, persistable=3,
+    need_check_feed=4."""
+    f = _parse_fields(buf)
+    name = _first(f, 1, b"").decode("utf-8")
+    vtype, dtype, dims, lod_level = _parse_var_type(_first(f, 2, b""))
+    return {
+        "name": name,
+        "shape": list(dims) if dims is not None else None,
+        "dtype": dtype,
+        "lod_level": lod_level,
+        "persistable": bool(_first(f, 3, 0)),
+        "stop_gradient": False,
+        "type": vtype,
+        "is_data": bool(_first(f, 4, 0)),
+        "is_parameter": False,
+    }
+
+
+def _parse_op_desc(buf):
+    """OpDesc (framework.proto:42): inputs=1, outputs=2, type=3, attrs=4."""
+    f = _parse_fields(buf)
+    inputs = dict(_parse_op_var(v) for v in f.get(1, []))
+    outputs = dict(_parse_op_var(v) for v in f.get(2, []))
+    attrs = dict(_parse_attr(a) for a in f.get(4, []))
+    return {
+        "type": _first(f, 3, b"").decode("utf-8"),
+        "inputs": inputs,
+        "outputs": outputs,
+        "attrs": attrs,
+    }
+
+
+def _parse_block_desc(buf):
+    """BlockDesc (framework.proto:175): idx=1, parent_idx=2, vars=3, ops=4."""
+    f = _parse_fields(buf)
+    parent = _signed64(_first(f, 2, 0)) & 0xFFFFFFFF
+    if parent >= (1 << 31):
+        parent -= 1 << 32
+    return {
+        "idx": _first(f, 1, 0),
+        "parent_idx": parent,
+        "vars": [_parse_var_desc(v) for v in f.get(3, [])],
+        "ops": [_parse_op_desc(o) for o in f.get(4, [])],
+    }
+
+
+def parse_program_desc(data):
+    """ProgramDesc bytes -> the JSON-IR dict Program.from_dict accepts
+    (framework.proto:212: blocks=1, version=4)."""
+    f = _parse_fields(data)
+    blocks = [_parse_block_desc(b) for b in f.get(1, [])]
+    for b in blocks:
+        # reference marks parameters only via persistable + initializer
+        # convention; mark persistable non-data lod_tensor vars consumed by
+        # no producer op as parameters so optimizers/io see them
+        produced = {n for op in b["ops"] for ns in op["outputs"].values()
+                    for n in ns}
+        for v in b["vars"]:
+            if (v["persistable"] and v["type"] == "lod_tensor"
+                    and v["name"] not in produced
+                    and v["name"] not in ("feed", "fetch")):
+                v["is_parameter"] = True
+    return {"version": 1, "random_seed": 0, "blocks": blocks}
+
+
+# -- ProgramDesc encode ------------------------------------------------------
+
+
+def _emit_attr(name, val):
+    w = _Writer()
+    w.string(1, name)
+    if isinstance(val, bool):
+        w.varint(2, _ATTR_BOOLEAN).varint(10, int(val))
+    elif isinstance(val, int):
+        if -(1 << 31) <= val < (1 << 31):
+            w.varint(2, _ATTR_INT).varint(3, val & 0xFFFFFFFF)
+        else:
+            w.varint(2, _ATTR_LONG).varint(13, val)
+    elif isinstance(val, float):
+        w.varint(2, _ATTR_FLOAT).float32(4, val)
+    elif isinstance(val, str):
+        w.varint(2, _ATTR_STRING).string(5, val)
+    elif isinstance(val, (list, tuple)):
+        if not val:
+            # the element type is unknowable from an empty value; INTS is
+            # the overwhelmingly common case (shape/axis/sections defaults)
+            w.varint(2, _ATTR_INTS)
+        elif all(isinstance(v, bool) for v in val):
+            w.varint(2, _ATTR_BOOLEANS)
+            for v in val:
+                w.varint(11, int(v))
+        elif all(isinstance(v, int) for v in val):
+            if all(-(1 << 31) <= v < (1 << 31) for v in val):
+                w.varint(2, _ATTR_INTS)
+                for v in val:
+                    w.varint(6, v & 0xFFFFFFFF)
+            else:
+                w.varint(2, _ATTR_LONGS)
+                for v in val:
+                    w.varint(15, v)
+        elif all(isinstance(v, float) for v in val):
+            w.varint(2, _ATTR_FLOATS)
+            for v in val:
+                w.float32(7, v)
+        elif all(isinstance(v, str) for v in val):
+            w.varint(2, _ATTR_STRINGS)
+            for v in val:
+                w.string(8, v)
+        else:
+            return None  # mixed list: not representable
+    else:
+        return None  # dicts etc.: framework-internal, skip
+    return w.getvalue()
+
+
+def _emit_tensor_desc(dtype, dims):
+    w = _Writer()
+    w.varint(1, _DTYPE_TO_PB.get(dtype or "float32", 5))
+    for d in dims or ():
+        w.varint(2, d if d is not None else -1)
+    return w.getvalue()
+
+
+_VARTYPE_TO_PB = {
+    "lod_tensor": _PB_LOD_TENSOR,
+    "selected_rows": _PB_SELECTED_ROWS,
+    "lod_tensor_array": _PB_LOD_TENSOR_ARRAY,
+    "reader": _PB_READER,
+    "step_scopes": _PB_STEP_SCOPES,
+    "raw": _PB_RAW,
+}
+
+
+def _emit_var_desc(vd):
+    kind = _VARTYPE_TO_PB.get(vd.get("type", "lod_tensor"), _PB_LOD_TENSOR)
+    t = _Writer()
+    t.varint(1, kind)
+    if kind in (_PB_LOD_TENSOR, _PB_SELECTED_ROWS, _PB_LOD_TENSOR_ARRAY) \
+            and vd.get("dtype") is not None:
+        ltd = _Writer()
+        ltd.bytes_field(1, _emit_tensor_desc(vd["dtype"], vd.get("shape")))
+        if vd.get("lod_level"):
+            ltd.varint(2, vd["lod_level"])
+        fno = {_PB_LOD_TENSOR: 3, _PB_SELECTED_ROWS: 2,
+               _PB_LOD_TENSOR_ARRAY: 4}[kind]
+        if kind == _PB_SELECTED_ROWS:
+            t.bytes_field(2, _emit_tensor_desc(vd["dtype"], vd.get("shape")))
+        else:
+            t.bytes_field(fno, ltd.getvalue())
+    w = _Writer()
+    w.string(1, vd["name"])
+    w.bytes_field(2, t.getvalue())
+    if vd.get("persistable"):
+        w.varint(3, 1)
+    if vd.get("is_data"):
+        w.varint(4, 1)
+    return w.getvalue()
+
+
+def _emit_op_desc(od):
+    w = _Writer()
+    for fno, slots in ((1, od["inputs"]), (2, od["outputs"])):
+        for slot, args in sorted(slots.items()):
+            v = _Writer()
+            v.string(1, slot)
+            for a in args:
+                v.string(2, a)
+            w.bytes_field(fno, v.getvalue())
+    w.string(3, od["type"])
+    for name, val in sorted(od["attrs"].items()):
+        enc = _emit_attr(name, val)
+        if enc is not None:
+            w.bytes_field(4, enc)
+    return w.getvalue()
+
+
+def serialize_program_desc(prog_dict):
+    """JSON-IR dict -> ProgramDesc bytes the reference can parse."""
+    w = _Writer()
+    for bd in prog_dict["blocks"]:
+        b = _Writer()
+        b.varint(1, bd["idx"])
+        b.varint(2, bd["parent_idx"] or 0)
+        for vd in bd["vars"]:
+            b.bytes_field(3, _emit_var_desc(vd))
+        for od in bd["ops"]:
+            b.bytes_field(4, _emit_op_desc(od))
+        w.bytes_field(1, b.getvalue())
+    ver = _Writer()
+    ver.varint(1, 0)
+    w.bytes_field(4, ver.getvalue())
+    return w.getvalue()
+
+
+# -- LoDTensor binary streams ------------------------------------------------
+
+
+def read_lod_tensor(f):
+    """One SerializeToStream record (lod_tensor.cc:219) -> (ndarray, lod)."""
+    version = struct.unpack("<I", f.read(4))[0]
+    if version != 0:
+        raise ValueError("unsupported LoDTensor version %d" % version)
+    lod_level = struct.unpack("<Q", f.read(8))[0]
+    lod = []
+    for _ in range(lod_level):
+        nbytes = struct.unpack("<Q", f.read(8))[0]
+        lod.append(list(struct.unpack("<%dQ" % (nbytes // 8),
+                                      f.read(nbytes))))
+    tversion = struct.unpack("<I", f.read(4))[0]
+    if tversion != 0:
+        raise ValueError("unsupported Tensor version %d" % tversion)
+    desc_size = struct.unpack("<i", f.read(4))[0]
+    dtype_name, dims = _parse_tensor_desc(f.read(desc_size))
+    npdtype = np.dtype(dtype_name)
+    count = int(np.prod(dims)) if dims else 1
+    data = np.frombuffer(f.read(count * np.dtype(npdtype).itemsize),
+                         dtype=npdtype)
+    return data.reshape(dims), lod
+
+
+def write_lod_tensor(f, arr, lod=()):
+    """ndarray -> one SerializeToStream record."""
+    arr = np.ascontiguousarray(arr)
+    f.write(struct.pack("<I", 0))
+    f.write(struct.pack("<Q", len(lod)))
+    for level in lod:
+        f.write(struct.pack("<Q", len(level) * 8))
+        f.write(struct.pack("<%dQ" % len(level), *level))
+    f.write(struct.pack("<I", 0))
+    desc = _emit_tensor_desc(arr.dtype.name, list(arr.shape))
+    f.write(struct.pack("<i", len(desc)))
+    f.write(desc)
+    f.write(arr.tobytes())
