@@ -16,6 +16,9 @@ __all__ = ["set_flags", "get_flags"]
 _DEFAULTS = {
     # honored
     "FLAGS_check_nan_inf": False,       # flags.cc:44 — scan outputs for NaN/Inf
+    # ghost-batch BN statistics: estimate batch stats from every k-th
+    # sample (1 = exact reference semantics); read at layer-build time
+    "FLAGS_bn_stat_subsample": 1,
     # accepted no-ops (XLA/PJRT owns these concerns; benchmark's per-op
     # sync has no meaning under whole-block compilation)
     "FLAGS_benchmark": False,

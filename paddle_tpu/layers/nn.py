@@ -3,6 +3,7 @@
 Each function appends ops to the current block and returns output Variables.
 """
 
+from .. import flags as _flags
 from ..framework import Variable, convert_np_dtype_to_dtype_
 from ..layer_helper import LayerHelper
 from ..ops.common import dtype_enum
@@ -960,6 +961,10 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
             "is_test": is_test,
             "data_layout": data_layout,
             "use_global_stats": use_global_stats,
+            # ghost-batch statistics (FLAGS_bn_stat_subsample, default 1 =
+            # exact): estimate batch stats from every k-th sample — cuts the
+            # dominant stat-pass HBM traffic on bandwidth-bound devices
+            "stat_subsample": int(_flags.flag("bn_stat_subsample") or 1),
         },
     )
     return helper.append_activation(out)
