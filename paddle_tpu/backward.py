@@ -126,6 +126,130 @@ def _append_grad_op(block, gop, grad_to_var):
     )
 
 
+class _RematPlan:
+    """Segment-recompute plan for activation checkpointing (reference
+    `_append_backward_ops_with_checkpoints_`, backward.py:576).
+
+    `checkpoints` are variable names that stay materialized.  Ops between
+    consecutive checkpoints form a segment; each segment's interior forward
+    ops are replayed in the backward region (cloned with `@RECOMPUTE`
+    output names, reading segment-boundary values through a
+    `remat_barrier` so XLA CSE cannot merge the replay with the original
+    forward), and the segment's grad ops read the recomputed values.  RNG /
+    stateful ops (dropout) are never replayed — their outputs count as
+    saved, so the replay reuses the original mask and stays bit-identical.
+    """
+
+    def __init__(self, block, relevant, checkpoints):
+        from .core.registry import get_op_def as _get
+
+        self.block = block
+        ckpt = {c.name if hasattr(c, "name") else c for c in checkpoints}
+        fwd_idx = sorted(relevant)
+        # segment id per op: split AFTER an op that produces a checkpoint
+        self._seg_of = {}
+        seg = 0
+        for i in fwd_idx:
+            self._seg_of[i] = seg
+            outs = set(block.ops[i].output_arg_names)
+            if outs & ckpt:
+                seg += 1
+        n_seg = seg + 1
+        # the tail segment (after the last checkpoint, the loss head) is
+        # not replayed: its grads run first, its activations die young
+        self._tail = n_seg - 1
+        self._ops_in = {}
+        for i in fwd_idx:
+            self._ops_in.setdefault(self._seg_of[i], []).append(i)
+        self._saved = ckpt
+        self._clone_map = {}   # seg -> {inner name -> replay name}
+        self._boundary = {}    # seg -> [external input names]
+        for s, idxs in self._ops_in.items():
+            if s == self._tail:
+                continue
+            inner, produced = {}, set()
+            boundary = []
+            for i in idxs:
+                op = block.ops[i]
+                opdef = _get(op.type)
+                replayable = not (opdef.stateful or opdef.n_rng > 0)
+                for n in op.input_arg_names:
+                    if not n or n in produced or n in inner:
+                        continue
+                    v = block._find_var_recursive(n)
+                    if n not in boundary and (
+                            v is None or not v.persistable):
+                        boundary.append(n)
+                for n in op.output_arg_names:
+                    if not n:
+                        continue
+                    produced.add(n)
+                    if replayable and n not in ckpt:
+                        inner[n] = n + "@RECOMPUTE"
+            self._clone_map[s] = inner
+            self._boundary[s] = boundary
+
+    def segment_of(self, idx):
+        s = self._seg_of.get(idx)
+        if s is None or s == self._tail:
+            return None
+        return s
+
+    def clone_descs(self, seg):
+        """remat_barrier + forward replay clones for one segment, in
+        forward order."""
+        from .core.registry import GradOpDesc, get_op_def as _get
+        from .framework import OP_ROLE_KEY, OpRole
+
+        cmap = self._clone_map[seg]
+        if not cmap:
+            return []
+        boundary = self._boundary[seg]
+        bar = {n: "%s@RMTBAR%d" % (n, seg) for n in boundary}
+        descs = []
+        if boundary:
+            descs.append(GradOpDesc(
+                "remat_barrier",
+                {"X": list(boundary)},
+                {"Out": [bar[n] for n in boundary]},
+                {OP_ROLE_KEY: OpRole.Backward},
+            ))
+
+        def rd(n):
+            return cmap.get(n, bar.get(n, n))
+
+        for i in self._ops_in[seg]:
+            op = self.block.ops[i]
+            opdef = _get(op.type)
+            if opdef.stateful or opdef.n_rng > 0:
+                continue  # outputs treated as saved
+            if not any(n in cmap for names in op.outputs.values()
+                       for n in names):
+                continue  # op only produces checkpoints/saved values
+            # non-inner outputs (checkpoints, running-stat state) must not
+            # be overwritten by the replay: route them to dead names
+            outs = {slot: [cmap.get(n, n + "@RMTDEAD") if n else n
+                           for n in names]
+                    for slot, names in op.outputs.items()}
+            descs.append(GradOpDesc(
+                op.type,
+                {slot: [rd(n) if n else n for n in names]
+                 for slot, names in op.inputs.items()},
+                outs,
+                dict(op.attrs),
+            ))
+        return descs
+
+    def remap_gop(self, seg, gop):
+        """Point a grad op's forward-value inputs at the replayed names.
+        GRAD@* slots carry gradients (original naming chain) — untouched."""
+        cmap = self._clone_map[seg]
+        for slot, names in list(gop.inputs.items()):
+            if slot.startswith("GRAD@"):
+                continue
+            gop.inputs[slot] = [cmap.get(n, n) if n else n for n in names]
+
+
 def append_backward(loss, parameter_list=None, no_grad_set=None,
                     callbacks=None, checkpoints=None):
     """Append grad ops for `loss` to its program; return [(param, grad)].
@@ -137,6 +261,8 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
     program = loss.block.program
     block = program.global_block()
     no_grad = _collect_no_grad(block, no_grad_set)
+    if checkpoints is None:
+        checkpoints = getattr(program, "_recompute_checkpoints", None)
 
     with program._backward_role_guard():
         # d(loss)/d(loss) = 1
@@ -158,13 +284,27 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
 
         relevant, grad_flow = _relevant_ops(block, loss.name, no_grad)
 
+        remat = _RematPlan(block, relevant, checkpoints) if checkpoints \
+            else None
+
         grad_op_descs = []
+        emitted_segments = set()
         for idx in relevant:
             op = block.ops[idx]
             opdef = get_op_def(op.type)
             ng = no_grad | {n for n in op.input_arg_names
                             if n and n not in grad_flow}
             gops = opdef.make_grad_ops(op, ng)
+            if remat is not None:
+                seg = remat.segment_of(idx)
+                if seg is not None and seg not in emitted_segments:
+                    # first grad op of this segment (reverse order): emit
+                    # the barrier + forward replay clones ahead of it
+                    emitted_segments.add(seg)
+                    grad_op_descs.extend(remat.clone_descs(seg))
+                if seg is not None:
+                    for gop in gops:
+                        remat.remap_gop(seg, gop)
             grad_op_descs.extend(gops)
 
         grad_op_descs = _dedup_grad_ops(grad_op_descs)

@@ -163,3 +163,18 @@ def selu(ctx, x, scale=1.0507009873554805, alpha=1.6732632423543772):
              attrs={"threshold": 40.0})
 def soft_relu(ctx, x, threshold=40.0):
     return jnp.log1p(jnp.exp(jnp.clip(x, -threshold, threshold)))
+
+
+@register_op("remat_barrier", inputs=("X",), outputs=("Out",),
+             duplicable_inputs=("X",), duplicable_outputs=("Out",),
+             grad_maker=None)
+def remat_barrier(ctx, xs):
+    """Optimization barrier for activation recompute (RecomputeOptimizer):
+    prevents XLA CSE from merging the backward-region forward replay with
+    the original forward, which would keep the inner activations live and
+    defeat rematerialization (same mechanism as jax.checkpoint's
+    prevent_cse; reference recompute: backward.py:576)."""
+    from jax import lax
+
+    outs = lax.optimization_barrier(tuple(xs))
+    return (list(outs),)
