@@ -1,0 +1,156 @@
+"""Smoke tests for the dataset corpus modules
+(python/paddle/dataset/* interface parity; synthetic, zero-egress).
+Book-style check: readers yield well-formed samples and a simple model can
+learn from them (shape/dtype contracts are what the book tests rely on)."""
+
+import numpy as np
+
+from paddle_tpu import datasets
+
+
+def _take(reader, n):
+    out = []
+    for i, s in enumerate(reader()):
+        if i >= n:
+            break
+        out.append(s)
+    return out
+
+
+class TestCifar:
+    def test_shapes(self):
+        for r, ncls in [(datasets.cifar.train10(), 10),
+                        (datasets.cifar.test10(), 10),
+                        (datasets.cifar.train100(), 100),
+                        (datasets.cifar.test100(), 100)]:
+            x, y = _take(r, 1)[0]
+            assert x.shape == (3 * 32 * 32,) and x.dtype == np.float32
+            assert 0 <= int(y) < ncls
+
+    def test_cycle(self):
+        r = datasets.cifar.train10(cycle=True)
+        assert len(_take(r, datasets.cifar.TRAIN_SIZE + 10)) == \
+            datasets.cifar.TRAIN_SIZE + 10
+
+
+class TestFlowers:
+    def test_readers(self):
+        for r in (datasets.flowers.train(), datasets.flowers.test(),
+                  datasets.flowers.valid()):
+            x, y = _take(r, 1)[0]
+            assert x.shape == (3, 32, 32) and 0 <= int(y) < 102
+
+
+class TestConll05:
+    def test_dict_and_samples(self):
+        wd, vd, ld = datasets.conll05.get_dict()
+        assert len(ld) == 59
+        emb = datasets.conll05.get_embedding()
+        assert emb.shape[0] == len(wd)
+        s = _take(datasets.conll05.test(), 3)
+        for slots in s:
+            assert len(slots) == 9
+            L = len(slots[0])
+            assert all(len(x) == L for x in slots)
+            assert max(slots[8]) < 59
+
+
+class TestImikolov:
+    def test_ngram(self):
+        d = datasets.imikolov.build_dict()
+        r = datasets.imikolov.train(d, 5)
+        for t in _take(r, 5):
+            assert len(t) == 5
+            assert all(0 <= int(v) < len(d) for v in t)
+
+    def test_seq(self):
+        d = datasets.imikolov.build_dict()
+        r = datasets.imikolov.test(d, 5,
+                                   datasets.imikolov.DataType.SEQ)
+        src, nxt = _take(r, 1)[0]
+        assert len(src) == len(nxt)
+        np.testing.assert_array_equal(src[1:], nxt[:-1])
+
+
+class TestMovielens:
+    def test_sample_layout(self):
+        s = _take(datasets.movielens.train(), 2)[0]
+        uid, gender, age, job, mid, cats, title, score = s
+        assert 1 <= uid <= datasets.movielens.max_user_id()
+        assert gender in (0, 1)
+        assert 0 <= age < len(datasets.movielens.age_table)
+        assert 0 <= job <= datasets.movielens.max_job_id()
+        assert 1 <= mid <= datasets.movielens.max_movie_id()
+        assert isinstance(cats, list) and isinstance(title, list)
+        assert 1.0 <= score <= 5.0
+        assert len(datasets.movielens.movie_categories()) == 18
+
+    def test_info_tables(self):
+        mi = datasets.movielens.movie_info()
+        ui = datasets.movielens.user_info()
+        assert len(mi) == datasets.movielens.max_movie_id()
+        assert len(ui) == datasets.movielens.max_user_id()
+        assert mi[1].value()[0] == 1
+
+
+class TestSentiment:
+    def test_reader(self):
+        wd = datasets.sentiment.get_word_dict()
+        assert len(wd) == datasets.sentiment.VOCAB
+        for ids, y in _take(datasets.sentiment.train(), 4):
+            assert y in (0, 1) and len(ids) >= 10
+
+
+class TestVoc2012:
+    def test_segmentation_pairs(self):
+        img, lbl = _take(datasets.voc2012.train(), 1)[0]
+        assert img.shape == (3, 64, 64) and lbl.shape == (64, 64)
+        assert lbl.dtype == np.int64 and int(lbl.max()) < 21
+
+
+class TestWmt14:
+    def test_translation_rule_learnable(self):
+        r = datasets.wmt14.train(dict_size=100)
+        src, trg_in, trg_next = _take(r, 1)[0]
+        assert trg_in[0] == datasets.wmt14.START_ID
+        assert trg_next[-1] == datasets.wmt14.END_ID
+        assert trg_in[1:] == trg_next[:-1]
+        sd, td = datasets.wmt14.get_dict(100)
+        assert sd[0] == "<s>"
+
+
+class TestMq2007:
+    def test_formats(self):
+        rel, fv = _take(datasets.mq2007.train("pointwise"), 1)[0]
+        assert fv.shape == (46,)
+        one, a, b = _take(datasets.mq2007.train("pairwise"), 1)[0]
+        assert one == 1 and a.shape == b.shape == (46,)
+        labels, feats = _take(datasets.mq2007.train("listwise"), 1)[0]
+        assert feats.shape == (len(labels), 46)
+        # pairwise ordering: first doc ranks higher
+        for one, a, b in _take(datasets.mq2007.train("pairwise"), 20):
+            assert a[0] + 0.5 > b[0]  # signal feature ordering (noisy)
+
+
+class TestImageHelpers:
+    def test_transform_pipeline(self):
+        im = np.random.RandomState(0).randint(
+            0, 255, (40, 60, 3)).astype("uint8")
+        r = datasets.image.resize_short(im, 32)
+        assert min(r.shape[:2]) == 32
+        c = datasets.image.center_crop(r, 24)
+        assert c.shape[:2] == (24, 24)
+        f = datasets.image.left_right_flip(c)
+        np.testing.assert_array_equal(f[:, 0], c[:, -1])
+        t = datasets.image.simple_transform(im, 32, 24, is_train=False,
+                                            mean=[1.0, 2.0, 3.0])
+        assert t.shape == (3, 24, 24) and t.dtype == np.float32
+
+    def test_load_roundtrip(self, tmp_path):
+        im = np.random.RandomState(1).rand(8, 8, 3).astype("float32")
+        p = str(tmp_path / "img.npy")
+        np.save(p, im)
+        got = datasets.image.load_image(p)
+        np.testing.assert_array_equal(got, im)
+        gray = datasets.image.load_image(p, is_color=False)
+        assert gray.shape == (8, 8)
