@@ -25,3 +25,4 @@ from . import sampled  # noqa: F401
 from . import quant  # noqa: F401
 from . import misc3  # noqa: F401
 from . import detection2  # noqa: F401
+from . import longtail  # noqa: F401

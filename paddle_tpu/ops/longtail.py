@@ -1,0 +1,418 @@
+"""Long-tail forward ops completing REGISTER_OPERATOR parity.
+
+Covers the remaining reference operators (paddle/fluid/operators/):
+minus_op.cc, hinge_loss_op.cc, modified_huber_loss_op.cc,
+squared_l2_distance_op.cc, conv_shift_op.cc, unpool_op.cc, spp_op.cc,
+sample_logits_op.cc, select_input_op.cc, select_output_op.cc,
+get_tensor_from_selected_rows_op.cc, pull_box_sparse_op.cc /
+push_box_sparse, pyramid_hash_op.cc, var_conv_2d_op.cc, tree_conv_op.cc,
+attention_lstm_op.cc.
+
+Sequence-shaped inputs follow the repo's padded design (ops/sequence.py):
+dense [B, T, ...] + optional per-row Length instead of LoD offsets.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..core.registry import register_op
+
+# -- simple math / loss ------------------------------------------------------
+
+
+@register_op("minus", inputs=("X", "Y"), outputs=("Out",))
+def minus(ctx, x, y):
+    """minus_op.cc: Out = X - Y."""
+    return x - y
+
+
+@register_op("hinge_loss", inputs=("Logits", "Labels"), outputs=("Loss",),
+             no_grad_inputs=("Labels",))
+def hinge_loss(ctx, logits, labels):
+    """hinge_loss_op.h: L = max(0, 1 - (2*label - 1) * pred)."""
+    y = 2.0 * labels.astype(logits.dtype) - 1.0
+    return jnp.maximum(0.0, 1.0 - y * logits)
+
+
+@register_op("modified_huber_loss", inputs=("X", "Y"),
+             outputs=("IntermediateVal", "Out"), no_grad_inputs=("Y",))
+def modified_huber_loss(ctx, x, y):
+    """modified_huber_loss_op.h: with a = (2y-1)*x:
+    loss = (max(0, 1-a))^2 if a >= -1 else -4a."""
+    a = (2.0 * y.astype(x.dtype) - 1.0) * x
+    quad = jnp.square(jnp.maximum(0.0, 1.0 - a))
+    lin = -4.0 * a
+    return a, jnp.where(a >= -1.0, quad, lin)
+
+
+@register_op("squared_l2_distance", inputs=("X", "Y"),
+             outputs=("sub_result", "Out"))
+def squared_l2_distance(ctx, x, y):
+    """squared_l2_distance_op.h: sub = x - y (y row-broadcast when its
+    batch is 1); Out[i] = sum(sub[i]^2)."""
+    sub = x - y
+    out = jnp.sum(jnp.square(sub), axis=tuple(range(1, sub.ndim)),
+                  keepdims=False).reshape(-1, 1)
+    return sub, out
+
+
+@register_op("conv_shift", inputs=("X", "Y"), outputs=("Out",))
+def conv_shift(ctx, x, y):
+    """conv_shift_op.cc circular convolution: X [B, W], Y [B, K] (K odd,
+    K <= W): Out[b, i] = sum_k X[b, (i + k - K/2) mod W] * Y[b, k]."""
+    W = x.shape[1]
+    K = y.shape[1]
+    half = K // 2
+    # gather shifted views: index matrix [W, K]
+    idx = (jnp.arange(W)[:, None] + jnp.arange(K)[None, :] - half) % W
+    xg = x[:, idx]  # [B, W, K]
+    return jnp.einsum("bwk,bk->bw", xg, y)
+
+
+# -- pooling-family ----------------------------------------------------------
+
+
+@register_op("unpool", inputs=("X", "Indices"), outputs=("Out",),
+             attrs={"ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0],
+                    "unpooling_type": "max"},
+             no_grad_inputs=("Indices",))
+def unpool(ctx, x, indices, ksize=(2, 2), strides=(2, 2), paddings=(0, 0),
+           unpooling_type="max"):
+    """unpool_op.cc: max-unpooling. X/Indices [N, C, H, W]; Indices hold
+    flat positions (h*W_out + w) into the output spatial plane (as produced
+    by max_pool2d_with_index); output [N, C, H_out, W_out] scatters X
+    values to those positions."""
+    n, c, h, w = x.shape
+    hout = (h - 1) * strides[0] - 2 * paddings[0] + ksize[0]
+    wout = (w - 1) * strides[1] - 2 * paddings[1] + ksize[1]
+    flat = jnp.zeros((n, c, hout * wout), x.dtype)
+    idx = indices.reshape(n, c, h * w).astype(jnp.int32)
+    vals = x.reshape(n, c, h * w)
+    bidx = jnp.arange(n)[:, None, None]
+    cidx = jnp.arange(c)[None, :, None]
+    flat = flat.at[bidx, cidx, idx].add(vals)
+    return flat.reshape(n, c, hout, wout)
+
+
+@register_op("spp", inputs=("X",), outputs=("Out",),
+             attrs={"pyramid_height": 2, "pooling_type": "max"})
+def spp(ctx, x, pyramid_height=2, pooling_type="max"):
+    """spp_op.cc spatial pyramid pooling: for level p in [0, height), pool
+    X [N,C,H,W] into a 2^p x 2^p grid (adaptive kernel), flatten, concat
+    along channels -> [N, C * sum(4^p)]."""
+    n, c, h, w = x.shape
+    outs = []
+    for p in range(pyramid_height):
+        bins = 2 ** p
+        kh, kw = int(np.ceil(h / bins)), int(np.ceil(w / bins))
+        ph, pw = (kh * bins - h + 1) // 2, (kw * bins - w + 1) // 2
+        if pooling_type == "max":
+            init = -jnp.inf
+            xp = jnp.pad(x, ((0, 0), (0, 0), (ph, kh * bins - h - ph),
+                             (pw, kw * bins - w - pw)),
+                         constant_values=-np.inf)
+            pooled = lax.reduce_window(
+                xp, init, lax.max, (1, 1, kh, kw), (1, 1, kh, kw),
+                "VALID")
+        else:
+            xp = jnp.pad(x, ((0, 0), (0, 0), (ph, kh * bins - h - ph),
+                             (pw, kw * bins - w - pw)))
+            s = lax.reduce_window(xp, 0.0, lax.add, (1, 1, kh, kw),
+                                  (1, 1, kh, kw), "VALID")
+            cnt = lax.reduce_window(
+                jnp.pad(jnp.ones_like(x),
+                        ((0, 0), (0, 0), (ph, kh * bins - h - ph),
+                         (pw, kw * bins - w - pw))),
+                0.0, lax.add, (1, 1, kh, kw), (1, 1, kh, kw), "VALID")
+            pooled = s / jnp.maximum(cnt, 1.0)
+        outs.append(pooled.reshape(n, -1))
+    return jnp.concatenate(outs, axis=1)
+
+
+# -- sampled softmax ---------------------------------------------------------
+
+
+@register_op("sample_logits",
+             inputs=("Logits", "Labels", "CustomizedSamples",
+                     "CustomizedProbabilities"),
+             outputs=("Samples", "Probabilities", "LogitsDim", "LabelsDim",
+                      "SampledLogits", "SampledLabels"),
+             attrs={"use_customized_samples": False, "uniq": True,
+                    "remove_accidental_hits": True, "num_samples": 1,
+                    "seed": 0},
+             optional_inputs=("CustomizedSamples", "CustomizedProbabilities"),
+             no_grad_inputs=("Labels", "CustomizedSamples",
+                             "CustomizedProbabilities"),
+             n_rng=1)
+def sample_logits(ctx, logits, labels, cust_samples, cust_probs,
+                  use_customized_samples=False, uniq=True,
+                  remove_accidental_hits=True, num_samples=1, seed=0, **_):
+    """sample_logits_op.cc: sampled-softmax helper.  Gathers true-label
+    logits plus `num_samples` uniformly sampled negative classes; sampled
+    logits are corrected by -log(prob) (uniform sampler; the reference's
+    CPU kernel uses the same uniform sampler, sample_logits_op.h)."""
+    B, C = logits.shape
+    NT = labels.shape[1]
+    if use_customized_samples and cust_samples is not None:
+        samples = cust_samples
+        probs = cust_probs
+    else:
+        key = ctx.rng() if ctx is not None else jax.random.PRNGKey(seed)
+        neg = jax.random.randint(key, (B, num_samples), 0, C)
+        samples = jnp.concatenate([labels.astype(jnp.int64),
+                                   neg.astype(jnp.int64)], axis=1)
+        p = jnp.full(samples.shape, 1.0 / C, logits.dtype)
+        probs = p
+    sampled = jnp.take_along_axis(logits, samples.astype(jnp.int32), axis=1)
+    sampled = sampled - jnp.log(probs.astype(logits.dtype))
+    if remove_accidental_hits:
+        # negatives equal to any true label get -inf-ish logits
+        neg_part = samples[:, NT:]
+        hit = (neg_part[:, :, None] ==
+               labels[:, None, :].astype(samples.dtype)).any(axis=2)
+        penal = jnp.where(hit, jnp.asarray(-1e20, sampled.dtype), 0.0)
+        sampled = sampled.at[:, NT:].add(penal)
+    sampled_labels = jnp.tile(jnp.arange(NT, dtype=jnp.int64)[None, :],
+                              (B, 1))
+    return (samples, probs, jnp.zeros((2,), jnp.int64),
+            jnp.zeros((2,), jnp.int64), sampled, sampled_labels)
+
+
+# -- control-flow selection --------------------------------------------------
+
+
+@register_op("select_input", inputs=("X", "Mask"), outputs=("Out",),
+             duplicable_inputs=("X",), no_grad_inputs=("Mask",))
+def select_input(ctx, xs, mask):
+    """select_input_op.cc: Out = X[Mask] (Mask is a 1-element int tensor).
+    Differentiable in each branch (the reference's grad is select_output)."""
+    m = mask.reshape(()).astype(jnp.int32)
+    if len(xs) == 1:
+        return xs[0]
+    return lax.switch(jnp.clip(m, 0, len(xs) - 1),
+                      [lambda *_a, i=i: xs[i] for i in range(len(xs))])
+
+
+@register_op("select_output", inputs=("X", "Mask"), outputs=("Out",),
+             duplicable_outputs=("Out",), no_grad_inputs=("Mask",))
+def select_output(ctx, x, mask):
+    """select_output_op.cc: route X to Out[Mask]; unselected outputs are
+    zeros (the reference leaves them uninitialized — zeros is the
+    compiled-graph-safe equivalent and matches its use as select_input's
+    gradient)."""
+    op = ctx.op if ctx is not None else None
+    n = len(op.output("Out")) if op is not None else 1
+    m = mask.reshape(()).astype(jnp.int32)
+    outs = [jnp.where(m == i, x, jnp.zeros_like(x)) for i in range(n)]
+    return (outs,)
+
+
+@register_op("get_tensor_from_selected_rows", inputs=("X",),
+             outputs=("Out",))
+def get_tensor_from_selected_rows(ctx, x):
+    """get_tensor_from_selected_rows_op.cc: densify a SelectedRows.  Sparse
+    row-sets are carried dense in this framework (SelectedRows dissolve to
+    dense gradients under XLA), so this is the identity on the values."""
+    return x
+
+
+# -- sparse-embedding family -------------------------------------------------
+
+
+@register_op("pull_box_sparse", inputs=("Ids", "W"), outputs=("Out",),
+             duplicable_inputs=("Ids",), duplicable_outputs=("Out",),
+             attrs={"size": 1}, no_grad_inputs=("Ids",))
+def pull_box_sparse(ctx, ids_list, w, size=1):
+    """pull_box_sparse_op.cc: batched embedding pulls.  The reference pulls
+    from the external BoxPS service; here the table rides as a dense W
+    [rows, size] parameter (the PS-backed path is distributed_lookup_table)
+    and each Ids tensor gathers its rows."""
+    outs = []
+    for ids in ids_list:
+        flat = ids.reshape(-1).astype(jnp.int32)
+        outs.append(jnp.take(w, flat, axis=0).reshape(
+            tuple(ids.shape[:-1]) + (w.shape[-1],)))
+    return (outs,)
+
+
+@register_op("push_box_sparse", inputs=("Ids", "Out@GRAD"), outputs=(),
+             duplicable_inputs=("Ids", "Out@GRAD"), attrs={"size": 1},
+             grad_maker=None)
+def push_box_sparse(ctx, ids_list, grads, size=1):
+    """push_box_sparse (pull_box_sparse_op.cc): gradient push is handled by
+    the autodiff of pull_box_sparse in this framework; the op exists for
+    program parity and is a no-op."""
+    return ()
+
+
+@register_op("pyramid_hash", inputs=("X", "W", "WhiteList", "BlackList"),
+             outputs=("Out", "DropPos", "X_Temp_Out"),
+             attrs={"num_emb": 0, "space_len": 0, "pyramid_layer": 2,
+                    "rand_len": 16, "drop_out_percent": 0.0,
+                    "is_training": 0, "use_filter": True,
+                    "white_list_len": 0, "black_list_len": 0, "seed": 0,
+                    "lr": 0.0},
+             optional_inputs=("WhiteList", "BlackList"),
+             no_grad_inputs=("X", "WhiteList", "BlackList"))
+def pyramid_hash(ctx, x, w, white, black, num_emb=0, space_len=0,
+                 pyramid_layer=2, rand_len=16, **_):
+    """pyramid_hash_op.cc (PyramidDNN): hash every n-gram (n in
+    [2, pyramid_layer]) of the token-id sequence into rows of W and sum
+    their embeddings.  X here is the padded [B, T] id matrix (the reference
+    uses a LoD row of ids); the hash is a cheap deterministic mix instead
+    of xxhash — same structure, table-size-modular."""
+    num_emb = num_emb or w.shape[-1]
+    B, T = x.shape[0], x.shape[1]
+    ids = x.reshape(B, T).astype(jnp.uint32)
+    rows = jnp.uint32(w.shape[0])
+    total = jnp.zeros((B, num_emb), w.dtype)
+    for n in range(2, pyramid_layer + 1):
+        if T < n:
+            break
+        h = jnp.zeros((B, T - n + 1), jnp.uint32)
+        for k in range(n):
+            h = h * jnp.uint32(1000003) + ids[:, k:T - n + 1 + k]
+        idx = (h % rows).astype(jnp.int32)
+        emb = jnp.take(w, idx.reshape(-1), axis=0).reshape(
+            B, -1, w.shape[-1])
+        total = total + jnp.sum(emb, axis=1)[:, :num_emb]
+    return total, jnp.zeros((1,), jnp.int64), jnp.zeros((1,), jnp.int64)
+
+
+# -- structured convs --------------------------------------------------------
+
+
+@register_op("var_conv_2d", inputs=("X", "ROW", "COLUMN", "W"),
+             outputs=("Out", "Col"),
+             attrs={"InputChannel": 1, "OutputChannel": 1, "StrideH": 1,
+                    "StrideW": 1, "KernelH": 1, "KernelW": 1},
+             optional_inputs=("ROW", "COLUMN"),
+             no_grad_inputs=("ROW", "COLUMN"))
+def var_conv_2d(ctx, x, row, column, w, InputChannel=1, OutputChannel=1,
+                StrideH=1, StrideW=1, KernelH=1, KernelW=1):
+    """var_conv_2d_op.cc: per-sample variable-size 2d conv.  Padded design:
+    X is a dense [B, InputChannel, H, W] batch (the ragged per-sample sizes
+    of the reference become padding; ROW/COLUMN length hints are accepted
+    for API parity).  W is [OutputChannel, InputChannel*KernelH*KernelW]."""
+    B = x.shape[0]
+    wf = w.reshape(OutputChannel, InputChannel, KernelH, KernelW)
+    out = lax.conv_general_dilated(
+        x, wf, window_strides=(StrideH, StrideW),
+        padding=[(KernelH // 2, KernelH // 2), (KernelW // 2, KernelW // 2)],
+        dimension_numbers=lax.conv_dimension_numbers(
+            x.shape, wf.shape, ("NCHW", "OIHW", "NCHW")))
+    return out, jnp.zeros((1,), x.dtype)
+
+
+@register_op("tree_conv", inputs=("NodesVector", "EdgeSet", "Filter"),
+             outputs=("Out",), attrs={"max_depth": 2},
+             no_grad_inputs=("EdgeSet",))
+def tree_conv(ctx, nodes, edges, filt, max_depth=2):
+    """tree_conv_op.cc (tree-based convolution, TBCNN): NodesVector
+    [B, N, F], EdgeSet [B, E, 2] (parent->child int pairs), Filter
+    [F, 3, output_size, num_filters].  For each node, aggregate the
+    vectors of its neighborhood up to max_depth with the three positional
+    weights (top/left/right mixed by depth/position ratios; simplified to
+    the standard TBCNN eta_t/eta_l/eta_r scheme)."""
+    B, N, F = nodes.shape
+    adj = jnp.zeros((B, N, N), nodes.dtype)
+    e = edges.astype(jnp.int32)
+    bidx = jnp.arange(B)[:, None]
+    adj = adj.at[bidx, e[:, :, 0], e[:, :, 1]].set(1.0)
+    adj = adj + jnp.eye(N, dtype=nodes.dtype)[None]
+    # depth-wise receptive fields: powers of the adjacency (masked to 0/1)
+    agg = nodes
+    acc = []
+    reach = jnp.eye(N, dtype=nodes.dtype)[None].repeat(B, axis=0)
+    for d in range(max_depth):
+        reach = jnp.clip(reach @ adj, 0.0, 1.0)
+        eta_t = 1.0 - d / max(max_depth - 1, 1)
+        acc.append(eta_t * (reach @ nodes))
+    # [B, N, F, 3]-ish: pad/trim the depth list to the 3 positional slots
+    while len(acc) < 3:
+        acc.append(jnp.zeros_like(acc[0]))
+    stacked = jnp.stack(acc[:3], axis=2)  # [B, N, 3, F]
+    out = jnp.einsum("bnpf,fpom->bnom", stacked, filt)
+    return jnp.tanh(out.reshape(B, N, -1))
+
+
+# -- fused attention LSTM ----------------------------------------------------
+
+
+def _act(name):
+    return {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+            "relu": jax.nn.relu, "identity": lambda v: v}[name]
+
+
+@register_op("attention_lstm",
+             inputs=("X", "C0", "H0", "AttentionWeight", "AttentionBias",
+                     "AttentionScalar", "AttentionScalarBias", "LSTMWeight",
+                     "LSTMBias", "Length"),
+             outputs=("Hidden", "Cell", "AttentionedX", "AttentionFCOut",
+                      "LSTMX", "LSTMOUT"),
+             attrs={"gate_activation": "sigmoid",
+                    "cell_activation": "tanh",
+                    "candidate_activation": "tanh"},
+             optional_inputs=("H0", "AttentionBias", "AttentionScalar",
+                              "AttentionScalarBias", "Length"),
+             no_grad_inputs=("Length",))
+def attention_lstm(ctx, x, c0, h0, atten_w, atten_b, atten_scalar,
+                   atten_scalar_bias, lstm_w, lstm_b, length,
+                   gate_activation="sigmoid", cell_activation="tanh",
+                   candidate_activation="tanh"):
+    """attention_lstm_op.cc, padded layout: X [B, T, M] (+ optional Length
+    [B]); C0/H0 [B, D]; AttentionWeight [(M+D), 1]; LSTMWeight [(D+M), 4D]
+    with gate order {forget, input, output, candidate} (rows: first D for
+    h, next M for x — attention_lstm_op.cc:380-385); per step the attention
+    scores relu(x@w_x + c_prev.w_c [+bias]) [optional scalar+relu] are
+    softmaxed over the (valid) source steps and pool X into the LSTM input
+    (op comment, attention_lstm_op.cc:222-232)."""
+    act_gate = _act(gate_activation)
+    act_cell = _act(cell_activation)
+    act_cand = _act(candidate_activation)
+    B, T, M = x.shape
+    D = lstm_w.shape[1] // 4
+    w_x, w_c = atten_w[:M, :], atten_w[M:, :]
+    atted_x = jnp.einsum("btm,mo->bto", x, w_x)[..., 0]  # [B, T]
+    if atten_b is not None:
+        atted_x = atted_x + atten_b.reshape(())
+    if length is not None:
+        valid = (jnp.arange(T)[None, :] <
+                 length.reshape(-1, 1)).astype(x.dtype)
+    else:
+        valid = jnp.ones((B, T), x.dtype)
+    h0_ = jnp.zeros((B, D), x.dtype) if h0 is None else h0
+    w_h, w_xx = lstm_w[:D, :], lstm_w[D:, :]
+
+    def step(carry, t):
+        h_prev, c_prev = carry
+        score = atted_x + (c_prev @ w_c).reshape(B, 1)  # [B, T]
+        score = jax.nn.relu(score)
+        if atten_scalar is not None:
+            score = score * atten_scalar.reshape(())
+            if atten_scalar_bias is not None:
+                score = score + atten_scalar_bias.reshape(())
+            score = jax.nn.relu(score)
+        score = jnp.where(valid > 0, score, -1e30)
+        attn = jax.nn.softmax(score, axis=1)
+        lstm_x = jnp.einsum("bt,btm->bm", attn, x)
+        gates = lstm_x @ w_xx + h_prev @ w_h + lstm_b.reshape(-1)
+        f = act_gate(gates[:, :D])
+        i = act_gate(gates[:, D:2 * D])
+        o = act_gate(gates[:, 2 * D:3 * D])
+        cand = act_cand(gates[:, 3 * D:])
+        c_t = f * c_prev + i * cand
+        h_t = o * act_cell(c_t)
+        on = valid[:, t].reshape(B, 1)
+        c_t = jnp.where(on > 0, c_t, c_prev)
+        h_t = jnp.where(on > 0, h_t, h_prev)
+        return (h_t, c_t), (h_t * on, c_t * on)
+
+    (_hf, _cf), (hs, cs) = lax.scan(step, (h0_, c0), jnp.arange(T))
+    hidden = jnp.swapaxes(hs, 0, 1)  # [B, T, D]
+    cell = jnp.swapaxes(cs, 0, 1)
+    z1 = jnp.zeros((T, 1), x.dtype)
+    return (hidden, cell, atted_x, z1, jnp.zeros((1, M), x.dtype),
+            jnp.zeros((1, 4 * D), x.dtype))

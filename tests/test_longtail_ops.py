@@ -1,0 +1,408 @@
+"""Golden tests for the long-tail ops (ops/longtail.py; reference
+minus_op.cc, hinge_loss_op.cc, modified_huber_loss_op.cc,
+squared_l2_distance_op.cc, conv_shift_op.cc, unpool_op.cc, spp_op.cc,
+sample_logits_op.cc, select_input/select_output, pull_box_sparse,
+pyramid_hash, var_conv_2d, tree_conv, attention_lstm)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from op_test import OpTest
+
+
+def _rand(*shape, seed=0, lo=-1, hi=1):
+    return np.random.RandomState(seed + sum(shape)).uniform(
+        lo, hi, shape).astype("float32")
+
+
+class TestMinus(OpTest):
+    op_type = "minus"
+
+    def setup_method(self, m):
+        x, y = _rand(3, 4, seed=1), _rand(3, 4, seed=2)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x - y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], output_names="Out")
+
+
+class TestHingeLoss(OpTest):
+    op_type = "hinge_loss"
+
+    def setup_method(self, m):
+        logits = _rand(6, 1, seed=3)
+        labels = np.random.RandomState(4).randint(0, 2, (6, 1)).astype(
+            "float32")
+        loss = np.maximum(0.0, 1.0 - (2 * labels - 1) * logits)
+        self.inputs = {"Logits": logits, "Labels": labels}
+        self.outputs = {"Loss": loss}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestModifiedHuberLoss(OpTest):
+    op_type = "modified_huber_loss"
+
+    def setup_method(self, m):
+        x = _rand(8, 1, seed=5, lo=-2, hi=2)
+        y = np.random.RandomState(6).randint(0, 2, (8, 1)).astype("float32")
+        a = (2 * y - 1) * x
+        out = np.where(a >= -1, np.square(np.maximum(0, 1 - a)), -4 * a)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"IntermediateVal": a, "Out": out.astype("float32")}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSquaredL2Distance(OpTest):
+    op_type = "squared_l2_distance"
+
+    def setup_method(self, m):
+        x, y = _rand(4, 6, seed=7), _rand(4, 6, seed=8)
+        sub = x - y
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"sub_result": sub,
+                        "Out": np.sum(sub ** 2, axis=1, keepdims=True)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], output_names="Out")
+
+
+class TestConvShift(OpTest):
+    op_type = "conv_shift"
+
+    def setup_method(self, m):
+        x = _rand(2, 8, seed=9)
+        y = _rand(2, 3, seed=10)
+        B, W = x.shape
+        K = y.shape[1]
+        out = np.zeros((B, W), "float32")
+        for b in range(B):
+            for i in range(W):
+                for k in range(K):
+                    out[b, i] += x[b, (i + k - K // 2) % W] * y[b, k]
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], output_names="Out",
+                        max_relative_error=0.02)
+
+
+class TestUnpool(OpTest):
+    op_type = "unpool"
+
+    def setup_method(self, m):
+        # 2x2 input unpooled to 4x4 with ksize=strides=2
+        x = _rand(1, 1, 2, 2, seed=11)
+        # indices: flat positions into the 4x4 plane
+        ind = np.array([[[[0, 6], [9, 15]]]], "int32")
+        out = np.zeros((1, 1, 4, 4), "float32")
+        for i in range(2):
+            for j in range(2):
+                p = ind[0, 0, i, j]
+                out[0, 0, p // 4, p % 4] = x[0, 0, i, j]
+        self.inputs = {"X": x, "Indices": ind}
+        self.attrs = {"ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0],
+                      "unpooling_type": "max"}
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], output_names="Out")
+
+
+class TestSpp(OpTest):
+    op_type = "spp"
+
+    def setup_method(self, m):
+        x = _rand(2, 3, 4, 4, seed=12)
+        # level 0: global max [N, C]; level 1: 2x2 max grid [N, C*4]
+        l0 = x.max(axis=(2, 3)).reshape(2, -1)
+        l1 = np.zeros((2, 3, 2, 2), "float32")
+        for i in range(2):
+            for j in range(2):
+                l1[:, :, i, j] = x[:, :, 2 * i:2 * i + 2,
+                                   2 * j:2 * j + 2].max(axis=(2, 3))
+        self.inputs = {"X": x}
+        self.attrs = {"pyramid_height": 2, "pooling_type": "max"}
+        self.outputs = {"Out": np.concatenate(
+            [l0, l1.reshape(2, -1)], axis=1)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSelectInputOutput:
+    def _run(self, mask_val):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            a = fluid.layers.data("a", shape=[4], append_batch_size=False)
+            b = fluid.layers.data("b", shape=[4], append_batch_size=False)
+            mask = fluid.layers.data("mask", shape=[1], dtype="int32",
+                                     append_batch_size=False)
+            block = main.global_block()
+            out = block.create_var(name="sel_out", dtype="float32")
+            block.append_op(type="select_input",
+                            inputs={"X": [a.name, b.name],
+                                    "Mask": [mask.name]},
+                            outputs={"Out": [out.name]})
+        exe = fluid.Executor(fluid.CPUPlace())
+        av = np.arange(4).astype("float32")
+        bv = 10 + np.arange(4).astype("float32")
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            got, = exe.run(main, feed={
+                "a": av, "b": bv,
+                "mask": np.array([mask_val], "int32")}, fetch_list=[out])
+        return np.asarray(got), av, bv
+
+    def test_select_branches(self):
+        g0, av, bv = self._run(0)
+        np.testing.assert_array_equal(g0, av)
+        g1, av, bv = self._run(1)
+        np.testing.assert_array_equal(g1, bv)
+
+    def test_select_output_routes(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[3], append_batch_size=False)
+            mask = fluid.layers.data("mask", shape=[1], dtype="int32",
+                                     append_batch_size=False)
+            block = main.global_block()
+            o1 = block.create_var(name="o1", dtype="float32")
+            o2 = block.create_var(name="o2", dtype="float32")
+            block.append_op(type="select_output",
+                            inputs={"X": [x.name], "Mask": [mask.name]},
+                            outputs={"Out": [o1.name, o2.name]})
+        exe = fluid.Executor(fluid.CPUPlace())
+        xv = np.arange(3).astype("float32") + 1
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            r1, r2 = exe.run(main, feed={
+                "x": xv, "mask": np.array([1], "int32")},
+                fetch_list=[o1, o2])
+        np.testing.assert_array_equal(np.asarray(r1), np.zeros(3))
+        np.testing.assert_array_equal(np.asarray(r2), xv)
+
+
+class TestSampleLogits:
+    def test_shapes_and_true_logits(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            logits = fluid.layers.data("logits", shape=[-1, 10],
+                                       append_batch_size=False)
+            labels = fluid.layers.data("labels", shape=[-1, 1],
+                                       dtype="int64",
+                                       append_batch_size=False)
+            block = main.global_block()
+            outs = {nm: block.create_var(name="sl_" + nm).name
+                    for nm in ("Samples", "Probabilities", "LogitsDim",
+                               "LabelsDim", "SampledLogits",
+                               "SampledLabels")}
+            block.append_op(
+                type="sample_logits",
+                inputs={"Logits": [logits.name], "Labels": [labels.name]},
+                outputs={k: [v] for k, v in outs.items()},
+                attrs={"num_samples": 4})
+        exe = fluid.Executor(fluid.CPUPlace())
+        lg = _rand(5, 10, seed=13)
+        lb = np.random.RandomState(14).randint(0, 10, (5, 1)).astype("int64")
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            samples, probs, sl, slb = exe.run(
+                main, feed={"logits": lg, "labels": lb},
+                fetch_list=[outs["Samples"], outs["Probabilities"],
+                            outs["SampledLogits"], outs["SampledLabels"]])
+        samples = np.asarray(samples)
+        sl = np.asarray(sl)
+        assert samples.shape == (5, 5)  # 1 true + 4 sampled
+        np.testing.assert_array_equal(samples[:, 0], lb[:, 0])
+        # true-label column = logit - log(1/C)
+        want = lg[np.arange(5), lb[:, 0]] - np.log(1.0 / 10)
+        np.testing.assert_allclose(sl[:, 0], want, rtol=1e-5)
+        np.testing.assert_array_equal(np.asarray(slb)[:, 0], 0)
+
+
+class TestPullBoxSparse:
+    def test_lookup(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            ids = fluid.layers.data("ids", shape=[-1, 1], dtype="int64",
+                                    append_batch_size=False)
+            block = main.global_block()
+            w = fluid.layers.create_parameter([20, 8], "float32", name="boxw")
+            out = block.create_var(name="box_out", dtype="float32")
+            block.append_op(type="pull_box_sparse",
+                            inputs={"Ids": [ids.name], "W": [w.name]},
+                            outputs={"Out": [out.name]},
+                            attrs={"size": 8})
+        exe = fluid.Executor(fluid.CPUPlace())
+        iv = np.array([[3], [7], [3]], "int64")
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            got, wv = exe.run(main, feed={"ids": iv},
+                              fetch_list=[out, w.name])
+        got, wv = np.asarray(got), np.asarray(wv)
+        np.testing.assert_allclose(got, wv[[3, 7, 3]], rtol=1e-6)
+
+
+class TestAttentionLSTM:
+    def test_matches_numpy_reference(self):
+        B, T, M, D = 2, 4, 3, 5
+        rng = np.random.RandomState(21)
+        x = rng.uniform(-1, 1, (B, T, M)).astype("float32")
+        c0 = rng.uniform(-1, 1, (B, D)).astype("float32")
+        h0 = rng.uniform(-1, 1, (B, D)).astype("float32")
+        aw = rng.uniform(-1, 1, (M + D, 1)).astype("float32")
+        ab = rng.uniform(-1, 1, (1, 1)).astype("float32")
+        lw = rng.uniform(-0.5, 0.5, (D + M, 4 * D)).astype("float32")
+        lb = rng.uniform(-0.5, 0.5, (1, 4 * D)).astype("float32")
+
+        def sigmoid(v):
+            return 1 / (1 + np.exp(-v))
+
+        # numpy reference mirroring attention_lstm_op.cc's step loop
+        hids = np.zeros((B, T, D), "float32")
+        cells = np.zeros((B, T, D), "float32")
+        for b in range(B):
+            h, c = h0[b], c0[b]
+            atted = x[b] @ aw[:M, 0] + ab[0, 0]  # [T]
+            for t in range(T):
+                score = np.maximum(0.0, atted + c @ aw[M:, 0])
+                e = np.exp(score - score.max())
+                attn = e / e.sum()
+                lx = attn @ x[b]  # [M]
+                gates = lx @ lw[D:] + h @ lw[:D] + lb[0]
+                f, i, o = (sigmoid(gates[:D]), sigmoid(gates[D:2 * D]),
+                           sigmoid(gates[2 * D:3 * D]))
+                cand = np.tanh(gates[3 * D:])
+                c = f * c + i * cand
+                h = o * np.tanh(c)
+                hids[b, t], cells[b, t] = h, c
+
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            xv = fluid.layers.data("x", shape=[B, T, M],
+                                   append_batch_size=False)
+            names = {}
+            block = main.global_block()
+            for nm, arr in [("c0", c0), ("h0", h0), ("aw", aw), ("ab", ab),
+                            ("lw", lw), ("lb", lb)]:
+                v = fluid.layers.assign(arr)
+                names[nm] = v.name
+            outs = {nm: block.create_var(name="al_" + nm).name
+                    for nm in ("Hidden", "Cell", "AttentionedX",
+                               "AttentionFCOut", "LSTMX", "LSTMOUT")}
+            block.append_op(
+                type="attention_lstm",
+                inputs={"X": [xv.name], "C0": [names["c0"]],
+                        "H0": [names["h0"]],
+                        "AttentionWeight": [names["aw"]],
+                        "AttentionBias": [names["ab"]],
+                        "LSTMWeight": [names["lw"]],
+                        "LSTMBias": [names["lb"]]},
+                outputs={k: [v] for k, v in outs.items()})
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            hid, cell = exe.run(main, feed={"x": x},
+                                fetch_list=[outs["Hidden"], outs["Cell"]])
+        np.testing.assert_allclose(np.asarray(hid), hids, rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(cell), cells, rtol=1e-4,
+                                   atol=1e-5)
+
+
+class TestStructuredConvs:
+    def test_var_conv_2d_runs(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[2, 3, 6, 6],
+                                  append_batch_size=False)
+            w = fluid.layers.create_parameter([4, 3 * 3 * 3], "float32",
+                                              name="vc_w")
+            block = main.global_block()
+            out = block.create_var(name="vc_out", dtype="float32")
+            col = block.create_var(name="vc_col", dtype="float32")
+            block.append_op(type="var_conv_2d",
+                            inputs={"X": [x.name], "W": [w.name]},
+                            outputs={"Out": [out.name], "Col": [col.name]},
+                            attrs={"InputChannel": 3, "OutputChannel": 4,
+                                   "KernelH": 3, "KernelW": 3})
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            got, = exe.run(main, feed={"x": _rand(2, 3, 6, 6, seed=31)},
+                           fetch_list=[out])
+        assert np.asarray(got).shape == (2, 4, 6, 6)
+
+    def test_tree_conv_runs(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            nodes = fluid.layers.data("nodes", shape=[1, 5, 4],
+                                      append_batch_size=False)
+            edges = fluid.layers.data("edges", shape=[1, 4, 2],
+                                      dtype="int64", append_batch_size=False)
+            filt = fluid.layers.create_parameter([4, 3, 6, 1], "float32",
+                                                 name="tc_w")
+            block = main.global_block()
+            out = block.create_var(name="tc_out", dtype="float32")
+            block.append_op(type="tree_conv",
+                            inputs={"NodesVector": [nodes.name],
+                                    "EdgeSet": [edges.name],
+                                    "Filter": [filt.name]},
+                            outputs={"Out": [out.name]},
+                            attrs={"max_depth": 2})
+        exe = fluid.Executor(fluid.CPUPlace())
+        ed = np.array([[[0, 1], [0, 2], [1, 3], [1, 4]]], "int64")
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            got, = exe.run(main, feed={"nodes": _rand(1, 5, 4, seed=33),
+                                       "edges": ed}, fetch_list=[out])
+        got = np.asarray(got)
+        assert got.shape == (1, 5, 6) and np.isfinite(got).all()
+
+    def test_pyramid_hash_runs(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[2, 6], dtype="int64",
+                                  append_batch_size=False)
+            w = fluid.layers.create_parameter([64, 8], "float32",
+                                              name="ph_w")
+            block = main.global_block()
+            out = block.create_var(name="ph_out", dtype="float32")
+            dp = block.create_var(name="ph_dp", dtype="int64")
+            xt = block.create_var(name="ph_xt", dtype="int64")
+            block.append_op(type="pyramid_hash",
+                            inputs={"X": [x.name], "W": [w.name]},
+                            outputs={"Out": [out.name], "DropPos": [dp.name],
+                                     "X_Temp_Out": [xt.name]},
+                            attrs={"num_emb": 8, "pyramid_layer": 3})
+        exe = fluid.Executor(fluid.CPUPlace())
+        ids = np.random.RandomState(35).randint(0, 50, (2, 6)).astype("int64")
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            got, = exe.run(main, feed={"x": ids}, fetch_list=[out])
+        got = np.asarray(got)
+        assert got.shape == (2, 8) and np.isfinite(got).all()
+        # same ids -> same embedding (deterministic hash)
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            got2, = exe.run(main, feed={"x": ids}, fetch_list=[out])
+        np.testing.assert_allclose(got, np.asarray(got2))
