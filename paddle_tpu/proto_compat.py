@@ -253,9 +253,13 @@ def _parse_op_var(buf):
 def _parse_tensor_desc(buf):
     """VarType.TensorDesc (framework.proto:139): data_type=1, dims=2."""
     f = _parse_fields(buf)
-    dtype = _DTYPE_FROM_PB.get(_first(f, 1, 5), "float32")
+    enum = _first(f, 1, 5)
+    if enum not in _DTYPE_FROM_PB:
+        raise ValueError(
+            "unsupported VarType.Type enum %r in TensorDesc (pod dtypes "
+            "only; framework.proto:105)" % (enum,))
     dims = _unpack_repeated_varints(f, 2)
-    return dtype, dims
+    return _DTYPE_FROM_PB[enum], dims
 
 
 def _parse_var_type(buf):
@@ -343,10 +347,24 @@ def parse_program_desc(data):
 # -- ProgramDesc encode ------------------------------------------------------
 
 
+# attrs that are block references in the reference schema (framework.proto
+# AttrType BLOCK/BLOCKS; e.g. conditional_block/while's sub_block) — they
+# ride as plain ints in our IR, so the emitter keys on the attr name
+_BLOCK_ATTRS = {"sub_block", "block"}
+_BLOCKS_ATTRS = {"sub_blocks", "blocks"}
+
+
 def _emit_attr(name, val):
     w = _Writer()
     w.string(1, name)
-    if isinstance(val, bool):
+    if name in _BLOCK_ATTRS and isinstance(val, int):
+        w.varint(2, _ATTR_BLOCK).varint(12, val)
+    elif name in _BLOCKS_ATTRS and isinstance(val, (list, tuple)) \
+            and all(isinstance(v, int) for v in val):
+        w.varint(2, _ATTR_BLOCKS)
+        for v in val:
+            w.varint(14, v)
+    elif isinstance(val, bool):
         w.varint(2, _ATTR_BOOLEAN).varint(10, int(val))
     elif isinstance(val, int):
         if -(1 << 31) <= val < (1 << 31):
@@ -391,8 +409,14 @@ def _emit_attr(name, val):
 
 
 def _emit_tensor_desc(dtype, dims):
+    dtype = dtype or "float32"
+    if dtype not in _DTYPE_TO_PB:
+        raise ValueError(
+            "dtype %r has no reference VarType.Type (framework.proto:105 "
+            "predates bf16); cast the variable before legacy-format save"
+            % (dtype,))
     w = _Writer()
-    w.varint(1, _DTYPE_TO_PB.get(dtype or "float32", 5))
+    w.varint(1, _DTYPE_TO_PB[dtype])
     for d in dims or ():
         w.varint(2, d if d is not None else -1)
     return w.getvalue()
