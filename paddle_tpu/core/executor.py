@@ -77,6 +77,23 @@ def _fetch_name(f):
 
 
 def as_numpy(t):
+    if isinstance(t, jax.Array) and not t.is_fully_addressable:
+        # multi-process fetch: materialize this process's shards only (the
+        # reference's nccl2-mode trainers likewise see their local loss).
+        # Dedupe by global index (replicated copies on several local
+        # devices collapse to one) and order batch shards by their dim-0
+        # offset; slice objects themselves are unorderable.
+        uniq = {}
+        for s in t.addressable_shards:
+            key = tuple(sl.start or 0 for sl in s.index)
+            uniq.setdefault(key, s)
+        if not uniq:
+            raise RuntimeError(
+                "fetch spans no devices addressable by this process")
+        arrs = [np.asarray(s.data) for _, s in sorted(uniq.items())]
+        if len(arrs) == 1 or arrs[0].ndim == 0:
+            return arrs[0]
+        return np.concatenate(arrs, axis=0)
     return np.asarray(t)
 
 
@@ -358,20 +375,55 @@ class Executor:
         return NamedSharding(mesh, P())
 
     def _shard_params(self, params, mesh, block):
+        multi = jax.process_count() > 1
         out = {}
         for n, v in params.items():
-            out[n] = jax.device_put(v, self._param_sharding(mesh, block, n))
+            sh = self._param_sharding(mesh, block, n)
+            if multi:
+                if isinstance(v, jax.Array) and v.sharding.device_set == \
+                        sh.device_set:
+                    out[n] = jax.device_put(v, sh)
+                    continue
+                # multi-process (nccl2-mode analog): every process holds
+                # the full (identically-seeded) value — locally-committed
+                # arrays (e.g. from a single-device startup run) included;
+                # assemble the global array from process-local data
+                out[n] = jax.make_array_from_process_local_data(
+                    sh, np.asarray(v))
+            else:
+                out[n] = jax.device_put(v, sh)
         return out
 
     def _shard_feeds(self, feed_arrays, mesh, data_axis):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        multi = jax.process_count() > 1
         out = {}
         for n, a in feed_arrays.items():
-            if a.ndim >= 1 and data_axis and a.shape[0] % mesh.shape[data_axis] == 0:
-                spec = P(data_axis, *([None] * (a.ndim - 1)))
-            else:
-                spec = P()
+            batch_ok = (a.ndim >= 1 and data_axis
+                        and a.shape[0] % mesh.shape[data_axis] == 0)
+            if multi:
+                if isinstance(a, jax.Array) and not a.is_fully_addressable:
+                    out[n] = a  # already a correctly-assembled global array
+                    continue
+                # reference nccl2-mode protocol: each trainer process feeds
+                # its LOCAL batch shard (numpy or a locally-committed jax
+                # array, e.g. from the double-buffered DataLoader); the
+                # global batch is the concatenation over processes
+                local = np.asarray(a)
+                local_dev = max(
+                    len([d for d in mesh.devices.flat
+                         if d.process_index == jax.process_index()]), 1)
+                if local.ndim >= 1 and data_axis \
+                        and local.shape[0] % local_dev == 0:
+                    spec = P(data_axis, *([None] * (local.ndim - 1)))
+                else:
+                    spec = P()
+                out[n] = jax.make_array_from_process_local_data(
+                    NamedSharding(mesh, spec), local)
+                continue
+            spec = (P(data_axis, *([None] * (a.ndim - 1)))
+                    if batch_ok else P())
             out[n] = jax.device_put(a, NamedSharding(mesh, spec))
         return out
 

@@ -215,7 +215,11 @@ def run_op(op, env, rng_key, mesh=None, axis_names=(), runner=None,
     # key is usually traced anyway).
     if (op.type not in _AXIS_OPS
             and (opdef.n_rng == 0 or rng_key is None)
-            and not _any_tracer(args)):
+            and not _any_tracer(args)
+            and jax.process_count() == 1):
+        # multi-process excluded: compile-time-eval arrays get committed
+        # with shardings spanning non-addressable devices, which cannot be
+        # closed over as constants in the per-process trace
         with jax.ensure_compile_time_eval():
             out = opdef.lower(ctx, *args, **_lower_attrs(op.attrs))
     else:

@@ -131,7 +131,10 @@ class Place:
         import jax
 
         kind = "cpu" if isinstance(self, CPUPlace) else None
-        devs = jax.devices(kind) if kind else jax.devices()
+        # process-LOCAL devices: under multi-controller jax (nccl2-mode
+        # analog) eager values and single-device programs must live on a
+        # device this process addresses, never on another host's
+        devs = jax.local_devices(backend=kind) if kind else jax.local_devices()
         if kind is None:
             # prefer an accelerator backend if present
             try:

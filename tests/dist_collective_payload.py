@@ -1,0 +1,110 @@
+"""Runnable multi-process COLLECTIVE training payload (reference protocol:
+test_dist_base.py:839 _run_cluster_nccl2 + dist_mnist.py).  Modes:
+
+  local — single process, global batch, plain SGD
+  dist  — one of N processes: jax.distributed bootstrap from the PADDLE_*
+          launcher env (distributed/launch.py:init_multihost), fleet
+          Collective transpiler inserts c_allreduce over the grads, each
+          process feeds its LOCAL batch shard; collectives ride gloo
+          across processes (ICI on real pods)
+
+Per-step losses print as "loss:<float>" for the harness to compare."""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 1)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+STEPS = 6
+BS = 8  # per trainer
+N_TRAINERS = 2
+
+
+def build():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 321
+    startup.random_seed = 321
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[6])
+        y = fluid.layers.data("y", shape=[1])
+        h = fluid.layers.fc(x, 16, act="relu",
+                            param_attr=fluid.ParamAttr(name="cw1"))
+        pred = fluid.layers.fc(h, 1, param_attr=fluid.ParamAttr(name="cw2"))
+        loss = fluid.layers.mean(fluid.layers.square(pred - y))
+    return main, startup, loss
+
+
+def make_data():
+    rng = np.random.RandomState(11)
+    w = rng.randn(6, 1).astype("f")
+    xs, ys = [], []
+    for _ in range(STEPS):
+        x = rng.randn(N_TRAINERS * BS, 6).astype("f")
+        xs.append(x)
+        ys.append((x @ w).astype("f"))
+    return xs, ys
+
+
+def finish(main, startup, loss, dist_rank=None):
+    xs, ys = make_data()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for i in range(STEPS):
+            if dist_rank is None:
+                feed = {"x": xs[i], "y": ys[i]}
+            else:
+                lo_ = dist_rank * BS
+                feed = {"x": xs[i][lo_:lo_ + BS], "y": ys[i][lo_:lo_ + BS]}
+            lo, = exe.run(main, feed=feed, fetch_list=[loss])
+            print("loss:%.8f" % float(np.asarray(lo).reshape(-1)[0]),
+                  flush=True)
+
+
+def run_local():
+    main, startup, loss = build()
+    with fluid.program_guard(main, startup):
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    finish(main, startup, loss)
+
+
+def run_dist():
+    from paddle_tpu.distributed.launch import init_multihost
+
+    assert init_multihost(), "PADDLE_* env missing"
+    assert jax.process_count() == N_TRAINERS, jax.process_count()
+    print("bootstrap:%d/%d" % (jax.process_index(), jax.process_count()),
+          flush=True)
+
+    main, startup, loss = build()
+    with fluid.program_guard(main, startup):
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    # fleet Collective transpile: scale loss-grad by 1/nranks + c_allreduce
+    # per grad (transpiler/collective.py GradAllReduce)
+    from paddle_tpu.transpiler.collective import GradAllReduce
+
+    t = GradAllReduce()
+    t.transpile(startup_program=startup, main_program=main,
+                rank=jax.process_index(),
+                endpoints=os.environ["PADDLE_TRAINER_ENDPOINTS"],
+                current_endpoint=os.environ["PADDLE_CURRENT_ENDPOINT"],
+                wait_port=False)
+    finish(main, startup, loss, dist_rank=jax.process_index())
+
+
+if __name__ == "__main__":
+    if sys.argv[1] == "local":
+        run_local()
+    else:
+        run_dist()

@@ -1,0 +1,80 @@
+"""Real multi-PROCESS collective training test (reference
+test_dist_base.py:839 _run_cluster_nccl2: 2 NCCL trainer processes on
+localhost vs 1 local run, per-step loss parity at delta 1e-3).
+
+Here: 2 subprocesses, each 1 CPU device, bootstrap through
+distributed/launch.py's PADDLE_* env -> jax.distributed.initialize (gloo
+CPU collectives stand in for ICI); the fleet GradAllReduce transpiler
+inserts the c_allreduce ops.  Each trainer feeds its LOCAL batch shard.
+Loss parity: dist trainers see per-shard losses whose MEAN must track the
+local global-batch loss (identical parameters each step, exact gradient
+equality by linearity of the mean)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from dist_utils import free_ports
+
+_PAYLOAD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "dist_collective_payload.py")
+
+
+def _parse_losses(out):
+    return [float(l.split("loss:")[1]) for l in out.splitlines()
+            if l.startswith("loss:")]
+
+
+def _clean_env():
+    env = dict(os.environ)
+    # the suite conftest pins the 8-device CPU mesh through JAX_PLATFORMS;
+    # payloads configure their own backends
+    env.pop("XLA_FLAGS", None)
+    return env
+
+
+def test_two_process_collective_loss_parity():
+    base = free_ports(2)
+    eps = ["127.0.0.1:%d" % p for p in base]
+
+    local = subprocess.run(
+        [sys.executable, "-u", _PAYLOAD, "local"], env=_clean_env(),
+        capture_output=True, text=True, timeout=240)
+    assert local.returncode == 0, local.stderr[-2000:]
+    local_losses = _parse_losses(local.stdout)
+    assert len(local_losses) == 6
+
+    procs = []
+    for rank in range(2):
+        env = _clean_env()
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": "2",
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(eps),
+            "PADDLE_CURRENT_ENDPOINT": eps[rank],
+            "PADDLE_COORDINATOR": eps[0],
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, "-u", _PAYLOAD, "dist"], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=240)
+        outs.append(out)
+        assert p.returncode == 0, out[-2000:]
+
+    # the launcher env handshake reached jax.distributed on both ranks
+    for rank, out in enumerate(outs):
+        assert ("bootstrap:%d/2" % rank) in out, out[-500:]
+
+    dist_losses = [_parse_losses(o) for o in outs]
+    assert len(dist_losses[0]) == len(dist_losses[1]) == 6
+    # parity: mean of the two trainers' per-shard losses == local
+    # global-batch loss each step (same params by exact grad averaging)
+    for i, want in enumerate(local_losses):
+        got = 0.5 * (dist_losses[0][i] + dist_losses[1][i])
+        assert abs(got - want) < 1e-3, (i, want, dist_losses[0][i],
+                                        dist_losses[1][i])
