@@ -19,6 +19,9 @@ _DEFAULTS = {
     # ghost-batch BN statistics: estimate batch stats from every k-th
     # sample (1 = exact reference semantics); read at layer-build time
     "FLAGS_bn_stat_subsample": 1,
+    # capacity of tensor arrays carried through data-dependent while loops
+    # (XLA needs a static bound; reference while_op grows arrays freely)
+    "FLAGS_tensor_array_max_len": 256,
     # accepted no-ops (XLA/PJRT owns these concerns; benchmark's per-op
     # sync has no meaning under whole-block compilation)
     "FLAGS_benchmark": False,

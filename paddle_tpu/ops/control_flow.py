@@ -47,8 +47,68 @@ _MAX_UNROLL = 10000
 
 
 # ---------------------------------------------------------------------------
-# tensor arrays (LoDTensorArray analog: a Python list in the trace env)
+# tensor arrays.  Two representations:
+#  * a Python list in the trace env (trace-time-indexed writes; grows freely
+#    under unrolled loops — the fast, exact path), and
+#  * BoundedTensorArray — a dense [capacity, ...] buffer + traced length,
+#    registered as a jax pytree so arrays can be LOOP-CARRIED through
+#    data-dependent `lax.while_loop`s and written at traced indices
+#    (the reference's while_op + lod_tensor_to_array dynamic path,
+#    controlflow/while_op.cc; capacity = FLAGS_tensor_array_max_len).
 # ---------------------------------------------------------------------------
+
+
+class BoundedTensorArray:
+    """XLA-compatible tensor array: [capacity, *elem] buffer + int32 length."""
+
+    def __init__(self, buffer, length):
+        self.buffer = buffer
+        self.length = length
+
+    @property
+    def capacity(self):
+        return self.buffer.shape[0]
+
+
+jax.tree_util.register_pytree_node(
+    BoundedTensorArray,
+    lambda a: ((a.buffer, a.length), None),
+    lambda aux, ch: BoundedTensorArray(*ch),
+)
+
+
+def _array_capacity():
+    from ..flags import flag
+
+    return int(flag("tensor_array_max_len") or 256)
+
+
+def _list_to_bounded(arr, template=None, capacity=None):
+    """Materialize a python-list tensor array as a BoundedTensorArray.
+    `template` supplies element shape/dtype when the list is empty.
+
+    NB: jax clamps/drops out-of-bounds scatter updates SILENTLY, so
+    capacity violations are checked wherever the index is known at trace
+    time; a data-dependent loop must be bounded below
+    FLAGS_tensor_array_max_len (raise the flag for longer decodes)."""
+    elems = [e for e in (arr or []) if e is not None]
+    if template is None:
+        if not elems:
+            raise ValueError(
+                "cannot infer tensor-array element shape from an empty "
+                "array; write one element before the dynamic loop")
+        template = elems[0]
+    cap = capacity or _array_capacity()
+    n = len(arr or [])
+    if n > cap:
+        raise ValueError(
+            "tensor array holds %d elements, over the dynamic-loop "
+            "capacity %d (FLAGS_tensor_array_max_len)" % (n, cap))
+    buf = jnp.zeros((cap,) + tuple(template.shape), template.dtype)
+    for k, e in enumerate(arr or []):
+        if e is not None:
+            buf = buf.at[k].set(e.astype(buf.dtype))
+    return BoundedTensorArray(buf, jnp.asarray(n, jnp.int32))
 
 
 @register_op(
@@ -60,12 +120,23 @@ _MAX_UNROLL = 10000
     stateful=True,
 )
 def write_to_array(ctx, x, i, array):
-    if not _is_concrete(i):
-        raise NotImplementedError(
-            "write_to_array index must be a trace-time constant (use a "
-            "concrete loop counter, or `recurrent`/lax.scan for traced "
-            "indices)"
-        )
+    if isinstance(array, BoundedTensorArray) or not _is_concrete(i):
+        if not isinstance(array, BoundedTensorArray):
+            array = _list_to_bounded(array, template=x)
+        if _is_concrete(i):
+            ci = int(np.asarray(i).reshape(()))
+            if ci >= array.capacity:
+                raise ValueError(
+                    "write_to_array index %d exceeds the dynamic-loop "
+                    "capacity %d (FLAGS_tensor_array_max_len)"
+                    % (ci, array.capacity))
+            idx = jnp.asarray(ci, jnp.int32)
+        else:
+            idx = i.astype(jnp.int32).reshape(())
+        buf = jax.lax.dynamic_update_index_in_dim(
+            array.buffer, x.astype(array.buffer.dtype), idx, 0)
+        length = jnp.maximum(array.length, idx + 1)
+        return (BoundedTensorArray(buf, length),)
     idx = int(np.asarray(i).reshape(()))
     arr = list(array) if array is not None else []
     while len(arr) <= idx:
@@ -81,6 +152,10 @@ def write_to_array(ctx, x, i, array):
     grad_maker=None,
 )
 def read_from_array(ctx, x, i):
+    if isinstance(x, BoundedTensorArray):
+        idx = i.astype(jnp.int32).reshape(())
+        return jax.lax.dynamic_index_in_dim(x.buffer, idx, 0,
+                                            keepdims=False)
     if isinstance(x, list):
         if _is_concrete(i):
             return x[int(np.asarray(i).reshape(()))]
@@ -97,6 +172,8 @@ def read_from_array(ctx, x, i):
     grad_maker=None,
 )
 def lod_array_length(ctx, x):
+    if isinstance(x, BoundedTensorArray):
+        return x.length.astype(jnp.int64)
     return jnp.asarray(len(x) if isinstance(x, list) else x.shape[0],
                        dtype=jnp.int64)
 
@@ -108,6 +185,8 @@ def lod_array_length(ctx, x):
     grad_maker=None,
 )
 def is_empty(ctx, x):
+    if isinstance(x, BoundedTensorArray):
+        return x.length == 0
     if isinstance(x, list):
         return jnp.asarray(len(x) == 0)
     return jnp.asarray(int(np.prod(x.shape)) == 0)
@@ -148,25 +227,19 @@ def while_op(ctx, xs, cond, sub_block=-1, is_test=False, **_):
     block = ctx.block.program.block(sub_block)
     cond_name = ctx.op.input("Condition")[0]
 
-    if _is_concrete(cond):
-        # trace-time unroll: condition chain stays concrete as long as no
-        # traced value flows into it
-        it = 0
-        while True:
-            c = env[cond_name]
-            if not _is_concrete(c):
-                raise RuntimeError(
-                    "while condition %r became data-dependent mid-loop; "
-                    "seed the loop with a traced condition instead" % cond_name
-                )
-            if not bool(np.asarray(c).reshape(())):
-                break
-            key = jax.random.fold_in(ctx.rng(), it) if ctx._rng_key is not None else None
-            ctx.run_sub_block(sub_block, env, key)
-            it += 1
-            if it > _MAX_UNROLL:
-                raise RuntimeError("while unrolled past %d iterations" % _MAX_UNROLL)
-        return None, None
+    # trace-time unroll while the condition chain stays concrete; the
+    # moment it becomes data-dependent (e.g. the loop body derives the
+    # keep-going flag from decoded data), fall through to lax.while_loop
+    # for the remaining iterations
+    it = 0
+    while _is_concrete(env[cond_name]):
+        if not bool(np.asarray(env[cond_name]).reshape(())):
+            return None, None
+        key = jax.random.fold_in(ctx.rng(), it) if ctx._rng_key is not None else None
+        ctx.run_sub_block(sub_block, env, key)
+        it += 1
+        if it > _MAX_UNROLL:
+            raise RuntimeError("while unrolled past %d iterations" % _MAX_UNROLL)
 
     # data-dependent: lax.while_loop over automatically discovered carries
     reads, writes = _sub_block_reads_writes(block)
@@ -178,13 +251,11 @@ def while_op(ctx, xs, cond, sub_block=-1, is_test=False, **_):
         raise RuntimeError(
             "while sub-block never updates its condition %r" % cond_name
         )
+    # python-list tensor arrays become BoundedTensorArrays (dense buffer +
+    # length, a registered pytree) so they carry through lax.while_loop
     for n in carried:
         if isinstance(env[n], list):
-            raise NotImplementedError(
-                "tensor arrays cannot be loop-carried through a "
-                "data-dependent while (XLA static shapes); bound the loop "
-                "with a concrete counter or use `recurrent`"
-            )
+            env[n] = _list_to_bounded(env[n])
     outer = {k: v for k, v in env.items() if k not in carried}
 
     def cond_fn(carry):

@@ -1,0 +1,142 @@
+"""Dynamic tensor arrays inside data-dependent while loops
+(ops/control_flow.py BoundedTensorArray; reference controlflow/while_op.cc
+grows LoDTensorArrays freely — here a dense [capacity] buffer + traced
+length carries through lax.while_loop).  Exercised by a beam-search-style
+greedy decode whose length is decided by the DATA (an EOS transition), not
+by a trace-time counter."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.layers import control_flow as cf
+
+
+def _greedy_chain(trans, start, eos, max_len):
+    """numpy reference: follow argmax transitions until EOS or max_len."""
+    out = [start]
+    tok = start
+    while len(out) < max_len:
+        tok = int(np.argmax(trans[tok]))
+        out.append(tok)
+        if tok == eos:
+            break
+    return out
+
+
+class TestDynamicArrayWhile:
+    def _build_and_run(self, trans, start, eos, max_len):
+        V = trans.shape[0]
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            # trans is a FEED, so the decoded token (and with it the loop
+            # condition) is traced data: the while must take the
+            # lax.while_loop path with the array as a loop carry
+            tr = fluid.layers.data("tr", shape=[V, V], dtype="float32",
+                                   append_batch_size=False)
+            tok = fluid.layers.assign(np.array([start], "int64"))
+            i = fluid.layers.fill_constant([1], "int64", 0)
+            going = fluid.layers.assign(np.array([True]))
+            arr = cf.create_array("int64")
+            arr = cf.array_write(tok, i, array=arr)
+
+            w = cf.While(cond=going)
+            with w.block():
+                cf.increment(i, value=1, in_place=True)
+                row = fluid.layers.gather(tr, tok)
+                nxt = fluid.layers.argmax(row, axis=-1)
+                nxt = fluid.layers.reshape(nxt, [1])
+                nxt = fluid.layers.cast(nxt, "int64")
+                fluid.layers.assign(nxt, output=tok)
+                cf.array_write(nxt, i, array=arr)
+                not_eos = fluid.layers.not_equal(
+                    nxt, fluid.layers.fill_constant([1], "int64", eos))
+                below = fluid.layers.less_than(
+                    i, fluid.layers.fill_constant([1], "int64", max_len - 1))
+                keep = fluid.layers.logical_and(not_eos, below)
+                fluid.layers.assign(keep, output=going)
+            length = cf.array_length(arr)
+            # post-loop dynamic reads: one per possible step, gated by
+            # length at fetch time
+            reads = []
+            for k in range(max_len):
+                idx = fluid.layers.fill_constant([1], "int64", k)
+                reads.append(cf.array_read(arr, idx))
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            res = exe.run(main, feed={"tr": trans},
+                          fetch_list=[length] + reads)
+        n = int(np.asarray(res[0]).reshape(()))
+        toks = [int(np.asarray(t).reshape(())) for t in res[1:]]
+        return n, toks
+
+    def test_eos_terminates_early(self):
+        rng = np.random.RandomState(0)
+        V, eos, max_len = 12, 0, 10
+        trans = rng.rand(V, V).astype("float32")
+        # make a deterministic chain 3 -> 7 -> 5 -> 0(eos)
+        trans[3] = 0; trans[3, 7] = 1
+        trans[7] = 0; trans[7, 5] = 1
+        trans[5] = 0; trans[5, eos] = 1
+        want = _greedy_chain(trans, 3, eos, max_len)
+        n, toks = self._build_and_run(trans, 3, eos, max_len)
+        assert n == len(want) == 4
+        assert toks[:n] == want
+
+    def test_max_len_bound_hits(self):
+        rng = np.random.RandomState(1)
+        V, eos, max_len = 8, 0, 6
+        trans = rng.rand(V, V).astype("float32")
+        # cycle that never reaches eos: 1 -> 2 -> 1
+        trans[1] = 0; trans[1, 2] = 1
+        trans[2] = 0; trans[2, 1] = 1
+        want = _greedy_chain(trans, 1, eos, max_len)
+        n, toks = self._build_and_run(trans, 1, eos, max_len)
+        assert n == max_len == len(want)
+        assert toks[:n] == want
+
+    def test_data_dependent_length_varies_with_feed(self):
+        """Same compiled program, different data -> different lengths."""
+        V, eos, max_len = 6, 0, 6
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            tr = fluid.layers.data("tr", shape=[V, V], dtype="float32",
+                                   append_batch_size=False)
+            tok = fluid.layers.assign(np.array([1], "int64"))
+            i = fluid.layers.fill_constant([1], "int64", 0)
+            going = fluid.layers.assign(np.array([True]))
+            arr = cf.create_array("int64")
+            arr = cf.array_write(tok, i, array=arr)
+            w = cf.While(cond=going)
+            with w.block():
+                cf.increment(i, value=1, in_place=True)
+                row = fluid.layers.gather(tr, tok)
+                nxt = fluid.layers.cast(fluid.layers.reshape(
+                    fluid.layers.argmax(row, axis=-1), [1]), "int64")
+                fluid.layers.assign(nxt, output=tok)
+                cf.array_write(nxt, i, array=arr)
+                keep = fluid.layers.logical_and(
+                    fluid.layers.not_equal(
+                        nxt, fluid.layers.fill_constant([1], "int64", eos)),
+                    fluid.layers.less_than(
+                        i, fluid.layers.fill_constant([1], "int64",
+                                                      max_len - 1)))
+                fluid.layers.assign(keep, output=going)
+            length = cf.array_length(arr)
+        exe = fluid.Executor(fluid.CPUPlace())
+
+        def run(trans):
+            with fluid.scope_guard(fluid.Scope()):
+                exe.run(startup)
+                n, = exe.run(main, feed={"tr": trans}, fetch_list=[length])
+            return int(np.asarray(n).reshape(()))
+
+        short = np.zeros((V, V), "float32")
+        short[1, eos] = 1  # 1 -> eos immediately
+        long = np.zeros((V, V), "float32")
+        long[1, 2] = 1
+        long[2, 3] = 1
+        long[3, eos] = 1
+        assert run(short) == 2
+        assert run(long) == 4
