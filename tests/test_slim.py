@@ -1,0 +1,197 @@
+"""contrib.slim pruning + distillation tests (reference
+contrib/slim/prune/pruner.py, prune_strategy.py; distillation/distiller.py).
+Train -> prune -> eval: pruning must zero whole groups, masks must survive
+re-application, and distillation losses must pull a student toward a
+teacher."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.contrib.slim.prune import (MagnitudePruner,
+                                           SensitivePruneStrategy,
+                                           StructurePruner,
+                                           UniformPruneStrategy)
+from paddle_tpu.contrib.slim.distillation import (DistillationStrategy,
+                                                  L2Distiller,
+                                                  SoftLabelDistiller,
+                                                  merge_teacher)
+
+
+def _toy_problem(seed=0):
+    rng = np.random.RandomState(seed)
+    C = rng.randn(4, 12).astype("float32") * 2
+    ys = rng.randint(0, 4, (256, 1)).astype("int64")
+    xs = (C[ys.ravel()] + rng.randn(256, 12)).astype("float32")
+    return xs, ys
+
+
+def _build_mlp(prefix=""):
+    x = fluid.layers.data("x", shape=[12], dtype="float32")
+    y = fluid.layers.data("y", shape=[1], dtype="int64")
+    h = fluid.layers.fc(x, 32, act="relu",
+                        param_attr=fluid.ParamAttr(name=prefix + "w1"))
+    logits = fluid.layers.fc(h, 4,
+                             param_attr=fluid.ParamAttr(name=prefix + "w2"))
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, y))
+    return x, y, logits, loss
+
+
+class TestStructurePruner:
+    def test_cal_pruned_idx_l1(self):
+        p = StructurePruner(pruning_axis={"*": 0},
+                            criterions={"*": "l1_norm"})
+        w = np.array([[3, 3], [0.1, 0.1], [2, 2], [0.2, 0.2]], "float32")
+        idx = p.cal_pruned_idx("w", w, 0.5)
+        assert set(idx) == {1, 3}
+        pruned, mask = p.prune_tensor(w, idx, 0)
+        assert pruned[1].sum() == 0 and pruned[3].sum() == 0
+        assert pruned[0].sum() != 0
+        assert mask.tolist() == [True, False, True, False]
+
+    def test_magnitude_pruner(self):
+        w = np.arange(1, 101).astype("float32").reshape(10, 10)
+        m = MagnitudePruner().cal_mask(w, 0.25)
+        assert m.sum() == 75
+        assert not m.reshape(-1)[:25].any()
+
+
+class TestTrainPruneEval:
+    def test_uniform_prune_and_finetune(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            xv, yv, logits, loss = _build_mlp()
+            fluid.optimizer.SGDOptimizer(learning_rate=0.2).minimize(loss)
+        xs, ys = _toy_problem()
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for _ in range(30):
+                lo, = exe.run(main, feed={"x": xs, "y": ys},
+                              fetch_list=[loss])
+            trained = float(np.asarray(lo).reshape(-1)[0])
+
+            strat = UniformPruneStrategy(
+                pruner=StructurePruner({"*": 1}, {"*": "l1_norm"}),
+                ratio=0.5)
+            report = strat.apply(main, scope)
+            assert set(report) == {"w1", "w2"}
+            # half the output groups of w1 are zero columns now
+            w1 = np.asarray(scope.find_var("w1").get_tensor().numpy())
+            zero_cols = int((np.abs(w1).sum(axis=0) == 0).sum())
+            assert zero_cols == 16
+
+            lo, = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+            pruned_loss = float(np.asarray(lo).reshape(-1)[0])
+
+            # finetune with mask re-application recovers accuracy
+            for _ in range(30):
+                lo, = exe.run(main, feed={"x": xs, "y": ys},
+                              fetch_list=[loss])
+                strat.apply_masks(scope)
+            final = float(np.asarray(lo).reshape(-1)[0])
+            w1 = np.asarray(scope.find_var("w1").get_tensor().numpy())
+            assert int((np.abs(w1).sum(axis=0) == 0).sum()) == 16, \
+                "masks must persist through finetuning"
+        assert final < pruned_loss or final < trained * 1.5
+        assert final < 0.8, (trained, pruned_loss, final)
+
+    def test_sensitive_strategy(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            xv, yv, logits, loss = _build_mlp()
+        xs, ys = _toy_problem(1)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+
+            def eval_fn():
+                lo, = exe.run(main, feed={"x": xs, "y": ys},
+                              fetch_list=[loss])
+                return float(np.asarray(lo).reshape(-1)[0])
+
+            strat = SensitivePruneStrategy(
+                pruner=StructurePruner({"*": 1}, {"*": "l1_norm"}),
+                eval_fn=eval_fn, ratios_step=0.25, max_ratio=0.5)
+            sens = strat.compute_sensitivities(main, scope)
+            assert set(sens) == {"w1", "w2"}
+            assert all(len(c) == 2 for c in sens.values())
+            report = strat.apply(main, scope)
+            assert report and all(0 < v <= 1 for v in report.values())
+
+
+class TestDistillation:
+    def test_merge_and_soft_label_distill(self):
+        # teacher: trained model; student: fresh model distilled without
+        # ground-truth labels — student loss vs labels must drop anyway
+        xs, ys = _toy_problem(2)
+
+        tmain, tstartup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(tmain, tstartup):
+            _, _, tlogits, tloss = _build_mlp(prefix="t_")
+            fluid.optimizer.SGDOptimizer(learning_rate=0.2).minimize(tloss)
+        texe = fluid.Executor(fluid.CPUPlace())
+        tscope = fluid.Scope()
+        with fluid.scope_guard(tscope):
+            texe.run(tstartup)
+            for _ in range(40):
+                texe.run(tmain, feed={"x": xs, "y": ys}, fetch_list=[tloss])
+
+        # teacher inference program (pruned of backward/optimize ops)
+        tinfer = tmain.clone(for_test=True)
+
+        smain, sstartup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(smain, sstartup):
+            _, _, slogits, sloss = _build_mlp(prefix="s_")
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            rename = merge_teacher(smain, tinfer, scope=scope,
+                                   teacher_scope=tscope)
+            with fluid.program_guard(smain, sstartup):
+                dist = SoftLabelDistiller(
+                    slogits.name, rename[tlogits.name],
+                    student_temperature=1.0, teacher_temperature=1.0)
+                dloss = dist.distiller_loss(smain)
+                student_params = [
+                    p.name for p in smain.global_block().all_parameters()
+                    if not p.name.startswith("teacher_")]
+                fluid.optimizer.AdamOptimizer(5e-3).minimize(
+                    dloss, parameter_list=student_params)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(sstartup)
+            task_losses = []
+            for _ in range(60):
+                dl, tl = exe.run(smain, feed={"x": xs, "y": ys},
+                                 fetch_list=[dloss, sloss])
+                task_losses.append(float(np.asarray(tl).reshape(-1)[0]))
+        assert task_losses[-1] < task_losses[0] * 0.6, (
+            task_losses[0], task_losses[-1])
+
+    def test_l2_distiller_and_strategy(self):
+        xs, ys = _toy_problem(3)
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[12], dtype="float32")
+            y = fluid.layers.data("y", shape=[1], dtype="int64")
+            s_feat = fluid.layers.fc(x, 8, name="sfeat")
+            t_feat = fluid.layers.fc(x, 8, name="tfeat")
+            t_feat.stop_gradient = True
+            logits = fluid.layers.fc(s_feat, 4)
+            task = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, y))
+            strat = DistillationStrategy(
+                [L2Distiller(s_feat.name, t_feat.name,
+                             distillation_loss_weight=2.0)],
+                task_loss_weight=1.0)
+            total = strat.build_loss(main, task_loss=task)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            tv, taskv = exe.run(main, feed={"x": xs, "y": ys},
+                                fetch_list=[total, task])
+            tv = float(np.asarray(tv).reshape(-1)[0])
+            taskv = float(np.asarray(taskv).reshape(-1)[0])
+        assert tv > taskv  # l2 part contributes
