@@ -2,6 +2,8 @@
 losses / misc) — parity fills for python/paddle/fluid/layers/nn.py and
 layers/detection.py entries not covered by the core modules."""
 
+import numpy as np
+
 from ..framework import Variable
 from ..layer_helper import LayerHelper
 
@@ -741,20 +743,27 @@ def crf_decoding(input, param_attr, label=None, length=None):
 def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
         bias_attr=None, num_neg_samples=None, name=None, sampler="uniform",
         custom_dist=None, seed=0, is_sparse=False):
-    """Noise contrastive estimation (reference layers/nn.py nce)."""
-    if custom_dist is not None:
-        raise NotImplementedError(
-            "nce: custom_dist sampling is not supported (uniform only)")
-    if sampler not in ("uniform",):
-        raise NotImplementedError(
-            "nce: sampler=%r not supported (uniform only; the functional "
-            "PRNG makes runs deterministic without a seed)" % (sampler,))
+    """Noise contrastive estimation (reference layers/nn.py nce).
+    Samplers: "uniform", "log_uniform" (Zipfian), "custom_dist" (pass the
+    per-class probabilities via `custom_dist`)."""
+    sampler_ids = {"uniform": 0, "log_uniform": 1, "custom_dist": 2}
+    if sampler not in sampler_ids:
+        raise ValueError("nce: unknown sampler %r (have %s)"
+                         % (sampler, sorted(sampler_ids)))
+    if sampler == "custom_dist" and custom_dist is None:
+        raise ValueError("nce: sampler='custom_dist' needs custom_dist")
     helper = LayerHelper("nce", name=name)
     dim = input.shape[1]
     w = helper.create_parameter(attr=param_attr,
                                 shape=[num_total_classes, dim],
                                 dtype=input.dtype)
     inputs = {"Input": [input], "Label": [label], "Weight": [w]}
+    if custom_dist is not None:
+        from . import tensor as _T
+
+        probs = _T.assign(np.asarray(custom_dist, "float32"))
+        probs.stop_gradient = True
+        inputs["CustomDistProbs"] = [probs]
     if bias_attr is not False:
         b = helper.create_parameter(attr=bias_attr,
                                     shape=[num_total_classes],
@@ -769,7 +778,7 @@ def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
         outputs={"Cost": [cost], "SampleLogits": [sl], "SampleLabels": [slab]},
         attrs={"num_total_classes": num_total_classes,
                "num_neg_samples": num_neg_samples or 10, "seed": seed,
-               "sampler": 0, "is_sparse": is_sparse})
+               "sampler": sampler_ids[sampler], "is_sparse": is_sparse})
     return cost
 
 

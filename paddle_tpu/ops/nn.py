@@ -144,18 +144,36 @@ def pool2d(ctx, x, pooling_type="max", ksize=(1, 1), strides=(1, 1),
     if adaptive:
         oh, ow = int(ksize[0]), int(ksize[1])
         H, W = x.shape[h_ax], x.shape[w_ax]
-        if H % oh or W % ow:
-            raise NotImplementedError(
-                "adaptive pool needs divisible sizes on TPU (static shapes)"
-            )
-        fh, fw = H // oh, W // ow
+        if H % oh == 0 and W % ow == 0:
+            fh, fw = H // oh, W // ow
+            if nchw:
+                r = x.reshape(x.shape[0], x.shape[1], oh, fh, ow, fw)
+                return (jnp.max(r, axis=(3, 5)) if pooling_type == "max"
+                        else jnp.mean(r, axis=(3, 5)))
+            r = x.reshape(x.shape[0], oh, fh, ow, fw, x.shape[3])
+            return (jnp.max(r, axis=(2, 4)) if pooling_type == "max"
+                    else jnp.mean(r, axis=(2, 4)))
+        # arbitrary output sizes (reference pooling.h AdaptStartIndex/
+        # AdaptEndIndex: start = floor(i*I/O), end = ceil((i+1)*I/O)).
+        # Bin boundaries are Python ints at trace time, so this stays
+        # static-shaped: one slice-reduce per output cell, fused by XLA.
+        red = jnp.max if pooling_type == "max" else jnp.mean
+        rows = []
+        for i in range(oh):
+            hs, he = (i * H) // oh, -((-(i + 1) * H) // oh)
+            cols = []
+            for j in range(ow):
+                ws, we = (j * W) // ow, -((-(j + 1) * W) // ow)
+                if nchw:
+                    patch = x[:, :, hs:he, ws:we]
+                    cols.append(red(patch, axis=(2, 3)))
+                else:
+                    patch = x[:, hs:he, ws:we, :]
+                    cols.append(red(patch, axis=(1, 2)))
+            rows.append(jnp.stack(cols, axis=-1 if nchw else 1))
         if nchw:
-            r = x.reshape(x.shape[0], x.shape[1], oh, fh, ow, fw)
-            return (jnp.max(r, axis=(3, 5)) if pooling_type == "max"
-                    else jnp.mean(r, axis=(3, 5)))
-        r = x.reshape(x.shape[0], oh, fh, ow, fw, x.shape[3])
-        return (jnp.max(r, axis=(2, 4)) if pooling_type == "max"
-                else jnp.mean(r, axis=(2, 4)))
+            return jnp.stack(rows, axis=2)  # [N, C, oh, ow]
+        return jnp.stack(rows, axis=1)      # [N, oh, ow, C]
 
     kh, kw = int(ksize[0]), int(ksize[1])
     sh, sw = int(strides[0]), int(strides[1])

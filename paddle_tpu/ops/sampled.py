@@ -13,21 +13,55 @@ from ..core.registry import register_op
 
 
 @register_op("nce", inputs=("Input", "Label", "Weight", "Bias",
-                            "SampleWeight"),
+                            "SampleWeight", "CustomDistProbs",
+                            "CustomDistAlias", "CustomDistAliasProbs"),
              outputs=("Cost", "SampleLogits", "SampleLabels"),
              attrs={"num_total_classes": 2, "num_neg_samples": 10,
                     "seed": 0, "sampler": 0, "is_sparse": False},
-             optional_inputs=("Bias", "SampleWeight"),
-             no_grad_inputs=("Label", "SampleWeight"), n_rng=1)
+             optional_inputs=("Bias", "SampleWeight", "CustomDistProbs",
+                              "CustomDistAlias", "CustomDistAliasProbs"),
+             no_grad_inputs=("Label", "SampleWeight", "CustomDistProbs",
+                             "CustomDistAlias", "CustomDistAliasProbs"),
+             n_rng=1)
 def nce(ctx, x, label, weight, bias=None, sample_weight=None,
+        custom_probs=None, custom_alias=None, custom_alias_probs=None,
         num_total_classes=2, num_neg_samples=10, seed=0, sampler=0,
         is_sparse=False, **_):
-    """NCE loss with a uniform negative sampler (nce_op.cc): x [B, D],
-    label [B, 1], weight [C, D], bias [C]."""
+    """NCE loss (nce_op.cc): x [B, D], label [B, 1], weight [C, D],
+    bias [C].  Samplers (nce_op.h + math/sampler.cc): 0=uniform,
+    1=log_uniform (Zipfian, inverse-CDF draw), 2=custom_dist
+    (CustomDistProbs [C]; drawn with jax.random.categorical — the
+    reference's alias tables are a CPU-side speedup for the same
+    distribution, so Alias/AliasProbs are accepted and unused)."""
     B = x.shape[0]
+    C = num_total_classes
     lbl = label.reshape(-1).astype(jnp.int32)
-    neg = jax.random.randint(ctx.rng(), (B, num_neg_samples), 0,
-                             num_total_classes)
+    key = ctx.rng()
+    if sampler == 1:
+        # P(k) = (log(k+2) - log(k+1)) / log(C+1); inverse CDF of
+        # F(k) = log(k+2)/log(C+1) from u~U(0,1): k = floor((C+1)^u) - 1
+        u = jax.random.uniform(key, (B, num_neg_samples))
+        neg = jnp.clip(
+            jnp.floor(jnp.exp(u * jnp.log(float(C + 1)))) - 1.0,
+            0, C - 1).astype(jnp.int32)
+
+        def log_q(ids):
+            idf = ids.astype(jnp.float32)
+            return jnp.log((jnp.log(idf + 2.0) - jnp.log(idf + 1.0))
+                           / jnp.log(float(C + 1)))
+    elif sampler == 2:
+        probs = custom_probs.reshape(-1).astype(jnp.float32)
+        logits_dist = jnp.log(jnp.maximum(probs, 1e-30))
+        neg = jax.random.categorical(
+            key, logits_dist, shape=(B, num_neg_samples)).astype(jnp.int32)
+
+        def log_q(ids):
+            return jnp.log(jnp.maximum(probs[ids], 1e-30))
+    else:
+        neg = jax.random.randint(key, (B, num_neg_samples), 0, C)
+
+        def log_q(ids):
+            return jnp.full(ids.shape, -jnp.log(float(C)))
 
     def logit(ids):
         w = weight[ids]                       # [..., D]
@@ -38,11 +72,9 @@ def nce(ctx, x, label, weight, bias=None, sample_weight=None,
 
     pos_logit = logit(lbl)                    # [B]
     neg_logit = logit(neg)                    # [B, S]
-    # uniform sampler: log q = log(1/C) per sample (nce_op.h sampler prob)
-    log_q = -jnp.log(float(num_total_classes))
     s = float(num_neg_samples)
-    pos = jax.nn.log_sigmoid(pos_logit - jnp.log(s) - log_q)
-    neg_ = jax.nn.log_sigmoid(-(neg_logit - jnp.log(s) - log_q))
+    pos = jax.nn.log_sigmoid(pos_logit - jnp.log(s) - log_q(lbl))
+    neg_ = jax.nn.log_sigmoid(-(neg_logit - jnp.log(s) - log_q(neg)))
     cost = -(pos + jnp.sum(neg_, axis=1))
     sample_logits = jnp.concatenate([pos_logit[:, None], neg_logit], axis=1)
     sample_labels = jnp.concatenate([lbl[:, None], neg], axis=1)

@@ -1,0 +1,103 @@
+"""Tests for capability gaps closed in round 2: NCE log_uniform /
+custom_dist samplers (reference nce_op.cc + math/sampler.cc) and adaptive
+pooling with non-divisible output sizes (reference pooling.h
+AdaptStartIndex/AdaptEndIndex)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+class TestNCESamplers:
+    def _run(self, sampler, custom_dist=None, C=20):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 5
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[8])
+            y = fluid.layers.data("y", shape=[1], dtype="int64")
+            cost = fluid.layers.nce(x, y, num_total_classes=C,
+                                    num_neg_samples=6, sampler=sampler,
+                                    custom_dist=custom_dist)
+            loss = fluid.layers.mean(cost)
+        exe = fluid.Executor(fluid.CPUPlace())
+        rng = np.random.RandomState(0)
+        xb = rng.rand(16, 8).astype("f")
+        yb = rng.randint(0, C, (16, 1)).astype("int64")
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            lo, = exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+        return float(np.asarray(lo).reshape(-1)[0])
+
+    def test_all_samplers_run_finite(self):
+        for sampler, dist in [("uniform", None), ("log_uniform", None),
+                              ("custom_dist",
+                               (np.arange(1, 21) / np.arange(1, 21).sum()))]:
+            v = self._run(sampler, dist)
+            assert np.isfinite(v), (sampler, v)
+
+    def test_unknown_sampler_rejected(self):
+        with pytest.raises(ValueError):
+            self._run("bernoulli")
+
+    def test_custom_dist_required(self):
+        with pytest.raises(ValueError):
+            self._run("custom_dist", None)
+
+    def test_log_uniform_distribution_shape(self):
+        """Direct op check: the Zipfian sampler must strongly prefer small
+        class ids (P(0) ~ log(2)/log(C+1))."""
+        import os
+        from paddle_tpu.core.registry import get_op_def
+        import jax, jax.numpy as jnp
+
+        C, S = 1000, 4000
+        opdef = get_op_def("nce")
+        x = jnp.ones((1, 4)); w = jnp.ones((C, 4))
+        lbl = jnp.zeros((1, 1), jnp.int32)
+
+        class Ctx:
+            def rng(self):
+                return jax.random.PRNGKey(7)
+
+        cost, logits, labels = opdef.lower(
+            Ctx(), x, lbl, w, None, None, None, None, None,
+            num_total_classes=C, num_neg_samples=S, sampler=1)
+        neg = np.asarray(labels)[0, 1:]
+        frac_small = float((neg < 10).mean())
+        # sum_{k<10} P(k) = log(11)/log(1001) ~ 0.347
+        assert 0.25 < frac_small < 0.45, frac_small
+        frac_large = float((neg >= C // 2).mean())
+        assert frac_large < 0.15, frac_large
+
+
+class TestAdaptivePoolArbitrary:
+    def _ref(self, x, oh, ow, kind):
+        N, C, H, W = x.shape
+        out = np.zeros((N, C, oh, ow), "float32")
+        for i in range(oh):
+            hs, he = (i * H) // oh, int(np.ceil((i + 1) * H / oh))
+            for j in range(ow):
+                ws, we = (j * W) // ow, int(np.ceil((j + 1) * W / ow))
+                patch = x[:, :, hs:he, ws:we]
+                out[:, :, i, j] = (patch.max(axis=(2, 3)) if kind == "max"
+                                   else patch.mean(axis=(2, 3)))
+        return out
+
+    @pytest.mark.parametrize("kind", ["max", "avg"])
+    @pytest.mark.parametrize("shape_out", [(3, 3), (5, 2), (7, 7)])
+    def test_non_divisible(self, kind, shape_out):
+        oh, ow = shape_out
+        x = np.random.RandomState(3).rand(2, 4, 11, 13).astype("f")
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            xv = fluid.layers.data("x", shape=[4, 11, 13])
+            out = fluid.layers.adaptive_pool2d(xv, [oh, ow],
+                                               pool_type=kind)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            got, = exe.run(main, feed={"x": x}, fetch_list=[out])
+        np.testing.assert_allclose(np.asarray(got),
+                                   self._ref(x, oh, ow, kind),
+                                   rtol=1e-5, atol=1e-6)
