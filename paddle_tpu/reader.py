@@ -162,7 +162,10 @@ class GeneratorLoader:
         if self._queue is not None:
             self._queue.kill()
         if self._thread is not None:
-            self._thread.join(timeout=5)
+            try:
+                self._thread.join(timeout=5)
+            except TypeError:
+                pass  # interpreter teardown: threading internals cleared
         self._queue = None
         self._thread = None
         self._started = False
