@@ -31,13 +31,20 @@ class DataToTensorConverter:
                 if len(concrete) == len(self.shape):
                     arr = arr.reshape([-1] + list(self.shape)[1:]) if -1 in self.shape else arr
             return arr
-        # ragged: pad to max length, also return lengths
-        seqs = [np.asarray(s, dtype=dtype_to_np(self.dtype)) for s in self.data]
-        maxlen = max(s.shape[0] for s in seqs)
-        tail = seqs[0].shape[1:]
-        out = np.zeros((len(seqs), maxlen) + tail, dtype=dtype_to_np(self.dtype))
-        for i, s in enumerate(seqs):
-            out[i, : s.shape[0]] = s
+        np_dtype = dtype_to_np(self.dtype)
+        if self.lod_level >= 2:
+            # nested sequences (reference LoD level 2, lod_tensor.h:52):
+            # list-of-lists-of-seqs -> [B, S, T, ...] padded
+            from .lod import pad_nested_sequences
+
+            out, _nseq, _lens = pad_nested_sequences(self.data, np_dtype)
+            return out
+        # ragged: pad to max length (lod.pad_sequences is the one
+        # implementation of the padding rule)
+        from .lod import pad_sequences
+
+        out, _lens = pad_sequences(
+            [np.asarray(s, dtype=np_dtype) for s in self.data], np_dtype)
         return out
 
 

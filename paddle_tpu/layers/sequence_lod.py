@@ -69,11 +69,17 @@ def sequence_softmax(input, use_cudnn=False, name=None, seq_len=None):
     return out
 
 
-def sequence_expand(x, y, ref_level=-1, name=None):
+def sequence_expand(x, y, ref_level=-1, name=None, ref_length=None):
+    """`ref_length` (optional [B] Variable) carries the chosen LoD level's
+    true per-sample counts so the expansion masks the padded tail (the
+    multi-level LoD path; see ops/sequence.py:sequence_expand)."""
     helper = LayerHelper("sequence_expand", name=name)
     out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    inputs = {"X": [x], "Y": [y]}
+    if ref_length is not None:
+        inputs["RefLength"] = [ref_length]
     helper.append_op(
-        type="sequence_expand", inputs={"X": [x], "Y": [y]},
+        type="sequence_expand", inputs=inputs,
         outputs={"Out": [out]}, attrs={"ref_level": ref_level},
     )
     return out

@@ -127,17 +127,28 @@ def sequence_softmax(ctx, x, length):
 
 @register_op(
     "sequence_expand",
-    inputs=("X", "Y"),
+    inputs=("X", "Y", "RefLength"),
     outputs=("Out",),
     attrs={"ref_level": -1},
-    no_grad_inputs=("Y",),
+    optional_inputs=("RefLength",),
+    no_grad_inputs=("Y", "RefLength"),
 )
-def sequence_expand(ctx, x, y, ref_level=-1):
-    # padded semantics: broadcast x [B, ...] along y's time axis -> [B, T, ...]
-    T = y.shape[1]
-    return jnp.broadcast_to(
-        x[:, None], (x.shape[0], T) + tuple(x.shape[1:])
-    )
+def sequence_expand(ctx, x, y, ref_length=None, ref_level=-1):
+    """Padded semantics of sequence_expand_op.cc: broadcast x [B, ...]
+    along y's padded expansion axis -> [B, R, ...].  Multi-level LoD
+    (ref_level selecting which nesting level's counts drive the expansion,
+    lod_tensor.h:52): the caller passes y padded at that level — for a
+    level-2 y [B, S, T, ...], ref_level=0 expands over S (pass y's
+    [B, S, ...] view), ref_level=1 over T — and the optional RefLength [B]
+    carries that level's true counts, masking rows past each sample's
+    count (the ragged tail)."""
+    R = y.shape[1]
+    out = jnp.broadcast_to(x[:, None], (x.shape[0], R) + tuple(x.shape[1:]))
+    if ref_length is not None:
+        mask = (jnp.arange(R)[None, :]
+                < ref_length.reshape(-1, 1)).astype(out.dtype)
+        out = out * mask.reshape(mask.shape + (1,) * (out.ndim - 2))
+    return out
 
 
 @register_op(

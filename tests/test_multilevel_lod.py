@@ -1,0 +1,171 @@
+"""Multi-level LoD tests (reference lod_tensor.h:52 nested LoD;
+sequence_expand ref_level).  Padded-design mapping: paddle_tpu/lod.py
+pads nested ragged structure to [B, S, T, ...] + per-level lengths;
+DataFeeder handles lod_level=2 feeds; TpuTensor carries multi-level lod
+metadata; sequence_expand masks by the selected level's counts."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import lod as L
+
+
+class TestLodHelpers:
+    def test_offsets_roundtrip(self):
+        lengths = [[2, 1], [3, 2, 4]]
+        lod = L.lengths_to_lod(lengths)
+        assert lod == [[0, 2, 3], [0, 3, 5, 9]]
+        assert L.lod_to_lengths(lod) == lengths
+
+    def test_pad_nested_and_unpad(self):
+        nested = [
+            [[1, 2, 3], [4]],            # 2 sentences
+            [[5, 6]],                    # 1 sentence
+            [[7], [8, 9], [10, 11, 12]], # 3 sentences
+        ]
+        arr, nseq, lens = L.pad_nested_sequences(
+            [[np.asarray(s) for s in row] for row in nested])
+        assert arr.shape == (3, 3, 3)
+        assert nseq.tolist() == [2, 1, 3]
+        assert lens[0].tolist() == [3, 1, 0]
+        assert arr[0, 0].tolist() == [1, 2, 3]
+        assert arr[2, 2].tolist() == [10, 11, 12]
+        back = L.unpad_nested_sequences(arr, nseq, lens)
+        for row, want in zip(back, nested):
+            assert [s.tolist() for s in row] == want
+
+
+class TestTensorLodMetadata:
+    def test_two_level_lod_roundtrip(self):
+        scope = fluid.Scope()
+        t = scope.var("v").get_tensor()
+        t.set(np.zeros((9, 2), "float32"))
+        t.set_recursive_sequence_lengths([[2, 1], [3, 2, 4]])
+        assert t.lod() == [[0, 2, 3], [0, 3, 5, 9]]
+        assert t.recursive_sequence_lengths() == [[2, 1], [3, 2, 4]]
+
+
+class TestDataFeederLevel2:
+    def test_nested_feed_pads(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            words = fluid.layers.data("words", shape=[1], dtype="int64",
+                                      lod_level=2)
+        feeder = fluid.DataFeeder([words], fluid.CPUPlace(), program=main)
+        batch = [
+            ([[1, 2], [3, 4, 5]],),
+            ([[6]],),
+        ]
+        feed = feeder.feed(batch)
+        arr = feed["words"]
+        assert arr.shape[:2] == (2, 2) and arr.shape[2] == 3
+        assert arr[0, 1, :3].tolist() == [3, 4, 5]
+        assert arr[1, 0, 0] == 6 and arr[1, 1].sum() == 0
+
+
+class TestSequenceExpandRefLevel:
+    def test_masked_expansion(self):
+        """x [B, D] expanded over a level's padded dim with true counts:
+        rows past each sample's count must be zero."""
+        x = np.array([[1.0, 2.0], [3.0, 4.0]], "float32")
+        nseq = np.array([2, 1], "int64")  # sample 0: 2 sents, sample 1: 1
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            xv = fluid.layers.data("x", shape=[2, 2],
+                                   append_batch_size=False)
+            yv = fluid.layers.data("y", shape=[2, 3, 4],
+                                   append_batch_size=False)
+            nv = fluid.layers.data("n", shape=[2], dtype="int64",
+                                   append_batch_size=False)
+            out = fluid.layers.sequence_expand(xv, yv, ref_level=0,
+                                               ref_length=nv)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            got, = exe.run(main, feed={
+                "x": x, "y": np.zeros((2, 3, 4), "float32"), "n": nseq},
+                fetch_list=[out])
+        got = np.asarray(got)
+        assert got.shape == (2, 3, 2)
+        np.testing.assert_allclose(got[0, 0], x[0])
+        np.testing.assert_allclose(got[0, 1], x[0])
+        assert got[0, 2].sum() == 0          # past sample 0's 2 sentences
+        np.testing.assert_allclose(got[1, 0], x[1])
+        assert got[1, 1:].sum() == 0         # sample 1 has 1 sentence
+
+
+class TestNestedEndToEnd:
+    def test_hierarchical_model_learns(self):
+        """Level-2 pipeline: nested word ids -> embedding -> word-sum per
+        sentence (mask by word lens) -> sentence-mean (mask by nseq) ->
+        classifier.  The class is decided by the first word id parity, so
+        the padded hierarchy must preserve per-level masking to learn."""
+        rng = np.random.RandomState(0)
+        B, V = 32, 50
+
+        def sample():
+            nsent = rng.randint(1, 4)
+            sents = [list(rng.randint(1, V, rng.randint(1, 5)))
+                     for _ in range(nsent)]
+            label = sents[0][0] % 2
+            return sents, label
+
+        data = [sample() for _ in range(B)]
+        from paddle_tpu.lod import pad_nested_sequences
+
+        arr, nseq, lens = pad_nested_sequences(
+            [[np.asarray(s, "int64") for s in row] for row, _ in data],
+            "int64")
+        labels = np.array([[l] for _, l in data], "int64")
+        S, T = arr.shape[1], arr.shape[2]
+
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 7
+        with fluid.program_guard(main, startup):
+            w = fluid.layers.data("w", shape=[B, S, T],
+                                  append_batch_size=False, dtype="int64")
+            wl = fluid.layers.data("wl", shape=[B, S],
+                                   append_batch_size=False, dtype="int64")
+            ns = fluid.layers.data("ns", shape=[B],
+                                   append_batch_size=False, dtype="int64")
+            y = fluid.layers.data("y", shape=[B, 1],
+                                  append_batch_size=False, dtype="int64")
+            emb = fluid.layers.embedding(
+                fluid.layers.reshape(w, [B, S, T, 1]), size=[V, 16])
+            # word mask [B, S, T], broadcast over the feature dim via the
+            # fluid elementwise axis rule (y is a leading sub-shape of x)
+            t_idx = fluid.layers.assign(
+                np.broadcast_to(np.arange(T, dtype="float32")
+                                .reshape(1, 1, T), (B, S, T)).copy())
+            wl_f = fluid.layers.cast(
+                fluid.layers.expand(
+                    fluid.layers.reshape(wl, [B, S, 1]), [1, 1, T]),
+                "float32")
+            wmask = fluid.layers.cast(
+                fluid.layers.less_than(t_idx, wl_f), "float32")
+            masked = fluid.layers.elementwise_mul(emb, wmask, axis=0)
+            sent = fluid.layers.reduce_sum(masked, dim=2)  # [B, S, 16]
+            s_idx = fluid.layers.assign(
+                np.broadcast_to(np.arange(S, dtype="float32")
+                                .reshape(1, S), (B, S)).copy())
+            ns_f = fluid.layers.cast(
+                fluid.layers.expand(
+                    fluid.layers.reshape(ns, [B, 1]), [1, S]), "float32")
+            smask = fluid.layers.cast(
+                fluid.layers.less_than(s_idx, ns_f), "float32")
+            sent_m = fluid.layers.elementwise_mul(sent, smask, axis=0)
+            doc = fluid.layers.reduce_sum(sent_m, dim=1)  # [B, 16]
+            logits = fluid.layers.fc(doc, 2)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, y))
+            fluid.optimizer.Adam(5e-2).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        feed = {"w": arr, "wl": lens, "ns": nseq, "y": labels}
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            losses = []
+            for _ in range(60):
+                lo, = exe.run(main, feed=feed, fetch_list=[loss])
+                losses.append(float(np.asarray(lo).reshape(-1)[0]))
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
