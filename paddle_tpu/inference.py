@@ -23,6 +23,21 @@ __all__ = [
 ]
 
 
+def _enable_executable_cache(path):
+    """Route XLA's persistent compilation cache to `path`: executables
+    serialize to disk and later processes deserialize instead of
+    recompiling (jax compilation_cache; min-compile-time/entry-size gates
+    dropped so even small inference programs cache)."""
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", str(path))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    try:
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:
+        pass  # older knob name; defaults are fine
+
+
 class AnalysisConfig:
     """Mirror of paddle_analysis_config.h's commonly-used surface."""
 
@@ -83,6 +98,18 @@ class AnalysisConfig:
         self._cpu_math_threads = n
 
     # -- optimization toggles (XLA owns these; kept for API parity) ---------
+    # -- serialized executable cache ----------------------------------------
+    def set_optim_cache_dir(self, path):
+        """Persist compiled executables across processes (the reference's
+        TensorRT SetOptimCacheDir serialized-engine cache,
+        paddle_analysis_config.h): compiled XLA executables are serialized
+        into `path` and re-loaded by later predictors/processes, skipping
+        compilation."""
+        self._optim_cache_dir = path
+
+    def optim_cache_dir(self):
+        return getattr(self, "_optim_cache_dir", None)
+
     def switch_ir_optim(self, flag=True):
         self._ir_optim = flag
 
@@ -151,6 +178,8 @@ class ZeroCopyTensor:
 class AnalysisPredictor:
     def __init__(self, config, _shared=None):
         self._config = config
+        if config.optim_cache_dir():
+            _enable_executable_cache(config.optim_cache_dir())
         place = TPUPlace(config.gpu_device_id()) if config.use_gpu() \
             else CPUPlace()
         self._exe = Executor(place)

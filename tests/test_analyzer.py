@@ -1,0 +1,145 @@
+"""Inference hardening tests (reference inference/tests/api/
+analyzer_*_tester.cc + tester_helper.h): per-model latency+accuracy
+regression through the analyzer harness, and the serialized executable
+cache (AnalysisConfig.set_optim_cache_dir -> XLA persistent compilation
+cache) surviving across PROCESSES."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+_TOOL = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools", "analyzer_tester.py")
+
+
+def _save_model(tmp, kind):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        if kind == "mlp":
+            x = fluid.layers.data("x", shape=[16])
+            h = fluid.layers.fc(x, 24, act="relu")
+            out = fluid.layers.fc(h, 5, act="softmax")
+            feeds = ["x"]
+        else:  # conv
+            x = fluid.layers.data("x", shape=[3, 12, 12])
+            c = fluid.layers.conv2d(x, num_filters=4, filter_size=3,
+                                    padding=1, act="relu")
+            p = fluid.layers.pool2d(c, pool_size=2, pool_stride=2)
+            out = fluid.layers.fc(p, 6)
+            feeds = ["x"]
+    exe = fluid.Executor(fluid.CPUPlace())
+    d = str(tmp / ("model_" + kind))
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.io.save_inference_model(d, feeds, [out], exe,
+                                      main_program=main)
+    return d
+
+
+def _inputs_for(kind, tmp):
+    rng = np.random.RandomState(1)
+    if kind == "mlp":
+        arrs = {"x": rng.rand(4, 16).astype("float32")}
+    else:
+        arrs = {"x": rng.rand(2, 3, 12, 12).astype("float32")}
+    p = str(tmp / ("inputs_%s.npz" % kind))
+    np.savez(p, **arrs)
+    return p
+
+
+@pytest.mark.parametrize("kind", ["mlp", "conv"])
+def test_analyzer_capture_then_regress(tmp_path, kind):
+    """Reference analyzer flow: run once capturing goldens, then the
+    regression run must pass and report latency stats."""
+    import json
+
+    model = _save_model(tmp_path, kind)
+    inputs = _inputs_for(kind, tmp_path)
+    golden = str(tmp_path / ("golden_%s.npz" % kind))
+
+    from tools.analyzer_tester import main as tester_main  # noqa: F401
+    sys.path.insert(0, os.path.dirname(os.path.dirname(_TOOL)))
+    import tools.analyzer_tester as at
+
+    rc = at.main(["--model_dir", model, "--inputs", inputs, "--golden",
+                  golden, "--capture", "--repeat", "3", "--warmup", "1"])
+    assert rc == 0 and os.path.exists(golden)
+
+    import io as _io
+    import contextlib
+
+    buf = _io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = at.main(["--model_dir", model, "--inputs", inputs, "--golden",
+                      golden, "--repeat", "5", "--warmup", "1"])
+    assert rc == 0
+    rec = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert rec["status"] == "ok"
+    assert rec["max_abs_diff"] == 0.0  # same process, deterministic
+    assert rec["avg_ms"] > 0 and rec["p99_ms"] >= rec["p50_ms"]
+
+
+def test_analyzer_detects_accuracy_regression(tmp_path):
+    import json
+
+    model = _save_model(tmp_path, "mlp")
+    inputs = _inputs_for("mlp", tmp_path)
+    golden = str(tmp_path / "golden.npz")
+    import tools.analyzer_tester as at
+
+    rc = at.main(["--model_dir", model, "--inputs", inputs, "--golden",
+                  golden, "--capture", "--repeat", "2", "--warmup", "0"])
+    assert rc == 0
+    # corrupt the golden: the tester must fail
+    g = dict(np.load(golden))
+    k = list(g)[0]
+    g[k] = g[k] + 0.1
+    np.savez(golden, **g)
+    rc = at.main(["--model_dir", model, "--inputs", inputs, "--golden",
+                  golden, "--repeat", "2", "--warmup", "0"])
+    assert rc == 1
+
+
+def test_executable_cache_across_processes(tmp_path):
+    """set_optim_cache_dir must persist serialized executables a FRESH
+    process reuses (reference: TRT serialized-engine cache)."""
+    model = _save_model(tmp_path, "mlp")
+    inputs = _inputs_for("mlp", tmp_path)
+    cache = str(tmp_path / "exe_cache")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+
+    script = r"""
+import sys, numpy as np
+sys.path.insert(0, %(repo)r)
+from paddle_tpu.inference import AnalysisConfig, create_paddle_predictor
+config = AnalysisConfig(%(model)r)
+config.disable_gpu()
+config.set_optim_cache_dir(%(cache)r)
+p = create_paddle_predictor(config)
+ins = dict(np.load(%(inputs)r))
+for n in p.get_input_names():
+    p.get_input_tensor(n).copy_from_cpu(ins[n])
+p.zero_copy_run()
+out = p.get_output_tensor(p.get_output_names()[0]).copy_to_cpu()
+print("OUT", float(np.asarray(out).ravel()[0]))
+""" % {"repo": os.path.dirname(os.path.dirname(_TOOL)) or ".",
+       "model": model, "cache": cache, "inputs": inputs}
+
+    outs = []
+    for _ in range(2):
+        r = subprocess.run([sys.executable, "-c", script], env=env,
+                           capture_output=True, text=True, timeout=180)
+        assert r.returncode == 0, r.stderr[-1500:]
+        outs.append([l for l in r.stdout.splitlines()
+                     if l.startswith("OUT")][0])
+    # cache got populated by process 1 and both processes agree
+    assert os.path.isdir(cache) and os.listdir(cache), \
+        "executable cache dir is empty"
+    assert outs[0] == outs[1]

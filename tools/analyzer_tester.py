@@ -1,0 +1,110 @@
+"""Per-model inference regression tester (reference
+inference/tests/api/analyzer_*_tester.cc + tester_helper.h): loads a saved
+inference model through AnalysisPredictor, measures latency over --repeat
+runs, and checks accuracy against a golden outputs file.
+
+Usage:
+    python tools/analyzer_tester.py --model_dir DIR --inputs inputs.npz \
+        [--golden golden.npz] [--capture] [--repeat 100] [--warmup 10] \
+        [--atol 1e-5] [--cache_dir DIR] [--use_tpu]
+
+  --capture writes the current outputs as the new golden.
+  Exit code 0 = pass; prints one JSON line with latency stats + max|diff|.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def run_model(model_dir, inputs, repeat=50, warmup=5, use_tpu=False,
+              cache_dir=None):
+    import paddle_tpu as fluid
+    from paddle_tpu.inference import AnalysisConfig, create_paddle_predictor
+
+    config = AnalysisConfig(model_dir)
+    if use_tpu:
+        config.enable_use_tpu()
+    else:
+        config.disable_gpu()
+    if cache_dir:
+        config.set_optim_cache_dir(cache_dir)
+    predictor = create_paddle_predictor(config)
+
+    names = predictor.get_input_names()
+    for n in names:
+        t = predictor.get_input_tensor(n)
+        t.copy_from_cpu(inputs[n])
+
+    predictor.zero_copy_run()  # compile
+    for _ in range(warmup):
+        predictor.zero_copy_run()
+    lats = []
+    for _ in range(repeat):
+        t0 = time.perf_counter()
+        predictor.zero_copy_run()
+        # pull one output: latency includes device->host like the
+        # reference testers' PaddleTensor copies
+        out0 = predictor.get_output_tensor(
+            predictor.get_output_names()[0]).copy_to_cpu()
+        lats.append((time.perf_counter() - t0) * 1000)
+    outs = {n: predictor.get_output_tensor(n).copy_to_cpu()
+            for n in predictor.get_output_names()}
+    lats = np.array(lats)
+    stats = {
+        "avg_ms": float(lats.mean()),
+        "p50_ms": float(np.percentile(lats, 50)),
+        "p99_ms": float(np.percentile(lats, 99)),
+        "repeat": repeat,
+    }
+    return outs, stats
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model_dir", required=True)
+    ap.add_argument("--inputs", required=True, help=".npz of input arrays")
+    ap.add_argument("--golden", default=None, help=".npz of expected outputs")
+    ap.add_argument("--capture", action="store_true",
+                    help="write outputs to --golden instead of comparing")
+    ap.add_argument("--repeat", type=int, default=50)
+    ap.add_argument("--warmup", type=int, default=5)
+    ap.add_argument("--atol", type=float, default=1e-5)
+    ap.add_argument("--rtol", type=float, default=1e-4)
+    ap.add_argument("--use_tpu", action="store_true")
+    ap.add_argument("--cache_dir", default=None)
+    args = ap.parse_args(argv)
+
+    inputs = dict(np.load(args.inputs, allow_pickle=False))
+    outs, stats = run_model(args.model_dir, inputs, args.repeat, args.warmup,
+                            args.use_tpu, args.cache_dir)
+
+    max_diff = None
+    status = "ok"
+    if args.capture:
+        if not args.golden:
+            ap.error("--capture needs --golden")
+        np.savez(args.golden, **outs)
+    elif args.golden:
+        golden = dict(np.load(args.golden, allow_pickle=False))
+        max_diff = 0.0
+        for n, want in golden.items():
+            got = outs[n]
+            d = float(np.max(np.abs(np.asarray(got, "float64")
+                                    - np.asarray(want, "float64"))))
+            max_diff = max(max_diff, d)
+            if not np.allclose(got, want, atol=args.atol, rtol=args.rtol):
+                status = "accuracy_fail"
+    print(json.dumps({"model": args.model_dir, "status": status,
+                      "max_abs_diff": max_diff, **stats}))
+    return 0 if status == "ok" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
