@@ -1,0 +1,272 @@
+"""Functional golden tests for representative coverage-tail ops
+(ops/coverage_tail.py): RNN op family vs numpy recurrences, indexed max
+pool + unpool round trip, LoD machinery, fused compositions, quant tail."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from op_test import OpTest
+
+
+def _run_single_op(op_type, inputs, attrs, out_slots, n_outs=None):
+    """Build a one-op program feeding `inputs` (dict slot->array or
+    slot->list[(name, arr)]), fetch `out_slots`."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        block = main.global_block()
+        in_map = {}
+        feed = {}
+        from paddle_tpu.framework import convert_np_dtype_to_dtype_
+
+        for slot, val in inputs.items():
+            if isinstance(val, list):
+                names = []
+                for nm, arr in val:
+                    block.create_var(name=nm, shape=arr.shape,
+                                     dtype=convert_np_dtype_to_dtype_(
+                                         arr.dtype))
+                    feed[nm] = arr
+                    names.append(nm)
+                in_map[slot] = names
+            else:
+                nm = "in_" + slot
+                block.create_var(name=nm, shape=val.shape,
+                                 dtype=convert_np_dtype_to_dtype_(val.dtype))
+                feed[nm] = val
+                in_map[slot] = [nm]
+        out_map = {}
+        for slot in out_slots:
+            v = block.create_var(name="out_" + slot)
+            out_map[slot] = [v.name]
+        block.append_op(type=op_type, inputs=in_map, outputs=out_map,
+                        attrs=attrs)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        res = exe.run(main, feed=feed,
+                      fetch_list=["out_" + s for s in out_slots])
+    return [np.asarray(r) for r in res]
+
+
+def _sigmoid(v):
+    return 1.0 / (1.0 + np.exp(-v))
+
+
+class TestLstmOp:
+    def test_matches_numpy_scan(self):
+        rng = np.random.RandomState(0)
+        B, T, D = 2, 5, 4
+        x = rng.uniform(-1, 1, (B, T, 4 * D)).astype("f")
+        wh = rng.uniform(-0.5, 0.5, (D, 4 * D)).astype("f")
+        bias = rng.uniform(-0.2, 0.2, (1, 4 * D)).astype("f")
+        h = np.zeros((B, D), "f")
+        c = np.zeros((B, D), "f")
+        want = np.zeros((B, T, D), "f")
+        for t in range(T):
+            g = x[:, t] + bias + h @ wh
+            i, f = _sigmoid(g[:, :D]), _sigmoid(g[:, D:2 * D])
+            cand = np.tanh(g[:, 2 * D:3 * D])
+            o = _sigmoid(g[:, 3 * D:])
+            c = f * c + i * cand
+            h = o * np.tanh(c)
+            want[:, t] = h
+        hid, cell = _run_single_op(
+            "lstm", {"Input": x, "Weight": wh, "Bias": bias},
+            {"use_peepholes": False}, ["Hidden", "Cell"])
+        np.testing.assert_allclose(hid, want, rtol=1e-5, atol=1e-6)
+
+    def test_gru_matches_numpy(self):
+        rng = np.random.RandomState(1)
+        B, T, D = 2, 4, 3
+        x = rng.uniform(-1, 1, (B, T, 3 * D)).astype("f")
+        wh = rng.uniform(-0.5, 0.5, (D, 3 * D)).astype("f")
+        h = np.zeros((B, D), "f")
+        want = np.zeros((B, T, D), "f")
+        for t in range(T):
+            ur = x[:, t, :2 * D] + h @ wh[:, :2 * D]
+            u, r = _sigmoid(ur[:, :D]), _sigmoid(ur[:, D:])
+            cnd = np.tanh(x[:, t, 2 * D:] + (r * h) @ wh[:, 2 * D:])
+            h = u * h + (1 - u) * cnd
+            want[:, t] = h
+        _bg, _brh, _bh, hid = _run_single_op(
+            "gru", {"Input": x, "Weight": wh}, {},
+            ["BatchGate", "BatchResetHiddenPrev", "BatchHidden", "Hidden"])
+        np.testing.assert_allclose(hid, want, rtol=1e-5, atol=1e-6)
+
+    def test_lstm_unit_and_gru_unit(self):
+        rng = np.random.RandomState(2)
+        B, D = 3, 4
+        x = rng.uniform(-1, 1, (B, 4 * D)).astype("f")
+        c_prev = rng.uniform(-1, 1, (B, D)).astype("f")
+        c, h = _run_single_op("lstm_unit", {"X": x, "C_prev": c_prev},
+                              {"forget_bias": 0.5}, ["C", "H"])
+        i = _sigmoid(x[:, :D]); g = np.tanh(x[:, D:2 * D])
+        f = _sigmoid(x[:, 2 * D:3 * D] + 0.5); o = _sigmoid(x[:, 3 * D:])
+        cw = f * c_prev + i * g
+        np.testing.assert_allclose(c, cw, rtol=1e-5)
+        np.testing.assert_allclose(h, o * np.tanh(cw), rtol=1e-5)
+
+        xg = rng.uniform(-1, 1, (B, 3 * D)).astype("f")
+        hp = rng.uniform(-1, 1, (B, D)).astype("f")
+        w = rng.uniform(-0.5, 0.5, (D, 3 * D)).astype("f")
+        gate, rh, hid = _run_single_op(
+            "gru_unit", {"Input": xg, "HiddenPrev": hp, "Weight": w},
+            {"activation": 2, "gate_activation": 1},
+            ["Gate", "ResetHiddenPrev", "Hidden"])
+        ur = xg[:, :2 * D] + hp @ w[:, :2 * D]
+        u, r = _sigmoid(ur[:, :D]), _sigmoid(ur[:, D:])
+        cnd = np.tanh(xg[:, 2 * D:] + (r * hp) @ w[:, 2 * D:])
+        np.testing.assert_allclose(hid, u * hp + (1 - u) * cnd, rtol=1e-5)
+
+
+class TestIndexPoolUnpoolRoundtrip:
+    def test_maxpool_index_then_unpool(self):
+        rng = np.random.RandomState(3)
+        x = rng.rand(2, 3, 6, 6).astype("f")
+        out, mask = _run_single_op(
+            "max_pool2d_with_index", {"X": x},
+            {"ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0]},
+            ["Out", "Mask"])
+        assert out.shape == (2, 3, 3, 3)
+        # numpy reference
+        want = x.reshape(2, 3, 3, 2, 3, 2).max(axis=(3, 5))
+        np.testing.assert_allclose(out, want, rtol=1e-6)
+        # indices decode back to the max positions
+        flat = x.reshape(2, 3, 36)
+        got_vals = np.take_along_axis(flat, mask.reshape(2, 3, 9), axis=2)
+        np.testing.assert_allclose(got_vals.reshape(out.shape), out)
+        # unpool scatters back
+        up, = _run_single_op(
+            "unpool", {"X": out, "Indices": mask.astype("int32")},
+            {"ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0],
+             "unpooling_type": "max"}, ["Out"])
+        assert up.shape == x.shape
+        nz = up != 0
+        np.testing.assert_allclose(up[nz], x[nz])
+
+    def test_maxpool3d_index(self):
+        rng = np.random.RandomState(4)
+        x = rng.rand(1, 2, 4, 4, 4).astype("f")
+        out, mask = _run_single_op(
+            "max_pool3d_with_index", {"X": x},
+            {"ksize": [2, 2, 2], "strides": [2, 2, 2],
+             "paddings": [0, 0, 0]}, ["Out", "Mask"])
+        want = x.reshape(1, 2, 2, 2, 2, 2, 2, 2).max(axis=(3, 5, 7))
+        np.testing.assert_allclose(out, want, rtol=1e-6)
+
+
+class TestLodMachinery:
+    def test_rank_table_reorder(self):
+        x = np.arange(12, dtype="f").reshape(4, 3)
+        lens = np.array([2, 5, 1, 4], "int64")
+        table, = _run_single_op(
+            "lod_rank_table", {"X": x.reshape(4, 3, 1)[:, :, 0:1],
+                               "Length": lens}, {}, ["Out"])
+        assert table[:, 1].tolist() == [5, 4, 2, 1]
+        assert table[:, 0].tolist() == [1, 3, 0, 2]
+        reordered, = _run_single_op(
+            "reorder_lod_tensor_by_rank",
+            {"X": x, "RankTable": table.astype("int64")}, {}, ["Out"])
+        np.testing.assert_allclose(reordered, x[[1, 3, 0, 2]])
+
+    def test_split_merge_lod_tensor(self):
+        x = np.arange(8, dtype="f").reshape(4, 2)
+        mask = np.array([1, 0, 1, 0], "int32")
+        t, f = _run_single_op(
+            "split_lod_tensor", {"X": x, "Mask": mask}, {"level": 0},
+            ["OutTrue", "OutFalse"])
+        assert t[1].sum() == 0 and f[0].sum() == 0
+        merged, = _run_single_op(
+            "merge_lod_tensor",
+            {"X": x, "Mask": mask, "InTrue": t, "InFalse": f},
+            {"level": 0}, ["Out"])
+        np.testing.assert_allclose(merged, x)
+
+
+class TestFusedOps:
+    def test_fusion_squared_mat_sub(self):
+        rng = np.random.RandomState(5)
+        x = rng.rand(3, 4).astype("f"); y = rng.rand(4, 2).astype("f")
+        sx, sy, sxy, out = _run_single_op(
+            "fusion_squared_mat_sub", {"X": x, "Y": y}, {"scalar": 2.0},
+            ["SquaredX", "SquaredY", "SquaredXY", "Out"])
+        want = 2.0 * ((x @ y) ** 2 - (x ** 2) @ (y ** 2))
+        np.testing.assert_allclose(out, want, rtol=1e-5)
+
+    def test_fused_fc_elementwise_layernorm(self):
+        rng = np.random.RandomState(6)
+        x = rng.rand(4, 5).astype("f"); w = rng.rand(5, 3).astype("f")
+        y = rng.rand(4, 3).astype("f")
+        out, m, v = _run_single_op(
+            "fused_fc_elementwise_layernorm",
+            {"X": x, "W": w, "Y": y}, {"epsilon": 1e-5},
+            ["Out", "Mean", "Variance"])
+        z = x @ w + y
+        zm = z.mean(axis=1, keepdims=True)
+        zv = z.var(axis=1, keepdims=True)
+        np.testing.assert_allclose(out, (z - zm) / np.sqrt(zv + 1e-5),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_fused_embedding_seq_pool(self):
+        rng = np.random.RandomState(7)
+        w = rng.rand(10, 4).astype("f")
+        ids = rng.randint(0, 10, (3, 5, 1)).astype("int64")
+        out, = _run_single_op("fused_embedding_seq_pool",
+                              {"W": w, "Ids": ids}, {"combiner": "sum"},
+                              ["Out"])
+        want = w[ids.reshape(3, 5)].sum(axis=1)
+        np.testing.assert_allclose(out, want, rtol=1e-6)
+
+    def test_fc_and_cos_sim_and_l1(self):
+        rng = np.random.RandomState(8)
+        x = rng.rand(3, 4).astype("f"); w = rng.rand(4, 2).astype("f")
+        b = rng.rand(2).astype("f")
+        out, = _run_single_op("fc", {"Input": x, "W": w, "Bias": b},
+                              {"in_num_col_dims": 1}, ["Out"])
+        np.testing.assert_allclose(out, x @ w + b, rtol=1e-5)
+        y = rng.rand(3, 4).astype("f")
+        cs, xn, yn = _run_single_op("cos_sim", {"X": x, "Y": y}, {},
+                                    ["Out", "XNorm", "YNorm"])
+        want = (x * y).sum(1) / (np.linalg.norm(x, axis=1)
+                                 * np.linalg.norm(y, axis=1))
+        np.testing.assert_allclose(cs.ravel(), want, rtol=1e-4)
+        l1, = _run_single_op("l1_norm", {"X": x}, {}, ["Out"])
+        np.testing.assert_allclose(l1, np.abs(x).sum(), rtol=1e-5)
+
+
+class TestQuantTail:
+    def test_dequantize_abs_max(self):
+        x = np.array([[-127, 64, 127]], "int8")
+        s = np.array([0.5], "f")
+        out, = _run_single_op("dequantize_abs_max",
+                              {"X": x.astype("int8"), "Scale": s},
+                              {"max_range": 127.0}, ["Out"])
+        np.testing.assert_allclose(out, x.astype("f") * 0.5 / 127.0,
+                                   rtol=1e-6)
+
+    def test_moving_average_scale_passthrough(self):
+        x = np.array([[1.0, -3.0]], "f")
+        out, scale, acc, st = _run_single_op(
+            "moving_average_abs_max_scale", {"X": x}, {"moving_rate": 0.9},
+            ["Out", "OutScale", "OutAccum", "OutState"])
+        np.testing.assert_allclose(out, x)
+        np.testing.assert_allclose(scale, [3.0], rtol=1e-6)
+
+
+class TestPSIdHelpers:
+    def test_split_then_merge_ids_roundtrip(self):
+        """merge_ids must return the full [N, D] merged matrix (regression:
+        a bare array under the duplicable Out slot bound only row 0)."""
+        ids = np.array([[0], [1], [2], [3]], "int64")
+        w = np.arange(8, dtype="f").reshape(4, 2)
+        shard0_rows = np.array([0, 2], "int64")
+        shard1_rows = np.array([1, 3], "int64")
+        merged, = _run_single_op(
+            "merge_ids",
+            {"Ids": [("mi_ids", ids)],
+             "Rows": [("mi_r0", shard0_rows), ("mi_r1", shard1_rows)],
+             "X": [("mi_x0", w[[0, 2]]), ("mi_x1", w[[1, 3]])]},
+            {}, ["Out"])
+        assert merged.shape == (4, 2), merged.shape
+        np.testing.assert_allclose(merged, w)
