@@ -90,9 +90,7 @@ def bench_resnet(batch=512, image_size=224, warmup=5, iters=30, depth=50,
                 while True:
                     yield [xs, ys]
 
-            import paddle_tpu as fluid_mod
-
-            loader.set_batch_generator(gen, places=[fluid_mod.TPUPlace(0)])
+            loader.set_batch_generator(gen, places=[fluid.TPUPlace(0)])
             it = iter(loader)
 
             def step():
@@ -233,7 +231,7 @@ def bench_nmt(batch=128, src_len=64, tgt_len=64, warmup=3, iters=15):
     return tokens / med, float(np.asarray(out).reshape(-1)[0])
 
 
-def bench_scaling(batch_per_chip=64, warmup=3, iters=9):
+def bench_scaling(batch_per_chip=256, warmup=3, iters=9):
     """Config 5: data-parallel ResNet-50 scaling efficiency across the local
     mesh (fleet Collective path -> shard_map + psum over ICI).  On the
     1-chip bench host this measures 1-chip throughput and emits
@@ -257,8 +255,12 @@ def bench_scaling(batch_per_chip=64, warmup=3, iters=9):
         exe = fluid.Executor(fluid.TPUPlace(0))
         scope = fluid.Scope()
         rng = np.random.RandomState(0)
-        xb = rng.rand(batch, 3, 224, 224).astype("float32")
-        yb = rng.randint(0, 1000, (batch, 1)).astype("int32")
+        # stage once on device: the tunneled bench host moves ~11 MB/s, so
+        # per-step host feeds would measure the link, not the collectives
+        xb = jax.device_put(
+            rng.rand(batch, 3, 224, 224).astype("float32"), _device())
+        yb = jax.device_put(
+            rng.randint(0, 1000, (batch, 1)).astype("int32"), _device())
         with fluid.scope_guard(scope):
             exe.run(startup)
             feed = {"img": xb, "label": yb}
