@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# CI harness (reference paddle/scripts/paddle_build.sh analog): build the
+# native pieces, run the full test pyramid, smoke the bench + graft entry.
+# Usage: tools/run_ci.sh [quick|full|tpu]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+MODE="${1:-full}"
+
+echo "== native build (compiles on import) =="
+python -c "import paddle_tpu.native; print('native OK')"
+
+echo "== unit + integration tests (virtual 8-device CPU mesh) =="
+case "$MODE" in
+  quick)
+    python -m pytest tests/ -x -q -k "not subprocess and not torch_parity" ;;
+  tpu)
+    # real-chip tier (needs a TPU host)
+    PADDLE_TPU_TESTS=1 python -m pytest tests/ -m tpu -q ;;
+  *)
+    python -m pytest tests/ -x -q ;;
+esac
+
+echo "== multichip dryrun (8-device virtual mesh) =="
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun OK')"
+
+if [ "$MODE" = "tpu" ]; then
+  echo "== bench (real chip) =="
+  python bench.py
+fi
+
+echo "CI $MODE: PASS"

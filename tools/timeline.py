@@ -1,0 +1,99 @@
+"""Chrome-trace timeline exporter CLI (reference tools/timeline.py:131 —
+converts profiler output into chrome://tracing format).
+
+Two sources:
+  --profile_path  a profile dump written by fluid.profiler (the host
+                  RecordEvent stream; already chrome-trace JSON here)
+  --xplane_dir    a jax.profiler trace dir (plugins/profile/*/*.xplane.pb);
+                  the device timeline is decoded with the in-repo proto
+                  reader (no tensorboard needed) and emitted as chrome
+                  trace events
+
+Usage:
+    python tools/timeline.py --profile_path prof.json --timeline_path out.json
+    python tools/timeline.py --xplane_dir /tmp/trace --timeline_path out.json
+
+Open chrome://tracing and load the output.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def from_profiler(profile_path):
+    with open(profile_path) as f:
+        data = json.load(f)
+    # fluid.profiler already emits chrome-trace dicts ({"traceEvents": ...}
+    # or a bare list)
+    if isinstance(data, dict) and "traceEvents" in data:
+        return data
+    return {"traceEvents": data}
+
+
+def from_xplane(xplane_dir):
+    from paddle_tpu.proto_compat import _parse_fields, _first, _signed64
+
+    paths = glob.glob(os.path.join(xplane_dir,
+                                   "plugins/profile/*/*.xplane.pb"))
+    if not paths:
+        paths = glob.glob(os.path.join(xplane_dir, "*.xplane.pb"))
+    if not paths:
+        raise FileNotFoundError("no .xplane.pb under %s" % xplane_dir)
+    events = []
+    for path in paths:
+        space = _parse_fields(open(path, "rb").read())
+        for plane_buf in space.get(1, []):
+            p = _parse_fields(plane_buf)
+            pname = _first(p, 2, b"").decode()
+            emeta = {}
+            for entry in p.get(4, []):
+                e = _parse_fields(entry)
+                v = _parse_fields(_first(e, 2, b""))
+                emeta[_signed64(_first(e, 1, 0))] = _first(
+                    v, 2, b"").decode()
+            for line_buf in p.get(3, []):
+                l = _parse_fields(line_buf)
+                lname = _first(l, 2, b"").decode()
+                ts0 = _signed64(_first(l, 3, 0))  # ns
+                for ev_buf in l.get(4, []):
+                    ev = _parse_fields(ev_buf)
+                    name = emeta.get(_signed64(_first(ev, 1, 0)), "?")
+                    off_ps = _signed64(_first(ev, 2, 0))
+                    dur_ps = _signed64(_first(ev, 3, 0))
+                    events.append({
+                        "name": name[:120],
+                        "ph": "X",
+                        "pid": pname,
+                        "tid": lname,
+                        "ts": (ts0 * 1000 + off_ps) / 1e6,  # us
+                        "dur": dur_ps / 1e6,
+                    })
+    return {"traceEvents": events}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--profile_path", default=None)
+    ap.add_argument("--xplane_dir", default=None)
+    ap.add_argument("--timeline_path", required=True)
+    args = ap.parse_args(argv)
+    if args.profile_path:
+        trace = from_profiler(args.profile_path)
+    elif args.xplane_dir:
+        trace = from_xplane(args.xplane_dir)
+    else:
+        ap.error("need --profile_path or --xplane_dir")
+    with open(args.timeline_path, "w") as f:
+        json.dump(trace, f)
+    print("wrote %d events to %s" % (len(trace["traceEvents"]),
+                                     args.timeline_path))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
