@@ -10,6 +10,10 @@ Default (driver) metric: ResNet-50 training throughput on one chip
                                        on a 1-chip host this runs the 8-way
                                        virtual CPU mesh as a smoke + emits
                                        the single-chip reference number)
+    BENCH_CONFIG=longctx               long-context flash attention fwd+bwd
+                                       tokens/s vs the XLA-composed path
+                                       (BENCH_SEQ selects sequence length;
+                                       vs_baseline=-1 = composed path OOMs)
 
 Each run prints ONE JSON line {"metric","value","unit","vs_baseline"}.
 
@@ -231,6 +235,63 @@ def bench_nmt(batch=128, src_len=64, tgt_len=64, warmup=3, iters=15):
     return tokens / med, float(np.asarray(out).reshape(-1)[0])
 
 
+def bench_longctx(seq_len=4096, batch=1, heads=12, head_dim=64, warmup=3,
+                  iters=12, causal=True):
+    """Long-context attention (the new-capability tier, SURVEY §5): fwd+bwd
+    through the Pallas flash kernel at long sequence vs the XLA-composed
+    reference path; reports tokens/s and the speedup."""
+    import importlib
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    fa = importlib.import_module("paddle_tpu.pallas_kernels.flash_attention")
+    rng = np.random.RandomState(0)
+    shape = (batch, heads, seq_len, head_dim)
+    q, k, v = (jax.device_put(rng.uniform(-1, 1, shape).astype("float32"),
+                              _device()).astype(jnp.bfloat16)
+               for _ in range(3))
+
+    def make_loss(attn):
+        def loss(q, k, v):
+            return jnp.sum(attn(q, k, v, causal=causal).astype(jnp.float32)
+                           ** 2)
+        return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+    def timeit(fn):
+        g = fn(q, k, v)
+        np.asarray(jax.tree_util.tree_leaves(g)[0].ravel()[0:1])
+        for _ in range(warmup):
+            g = fn(q, k, v)
+        np.asarray(jax.tree_util.tree_leaves(g)[0].ravel()[0:1])
+        t0 = _time.perf_counter()
+        for _ in range(iters):
+            g = fn(q, k, v)
+        np.asarray(jax.tree_util.tree_leaves(g)[0].ravel()[0:1])
+        return (_time.perf_counter() - t0) / iters
+
+    t_flash = timeit(make_loss(
+        lambda q, k, v, causal: fa.flash_attention(q, k, v, causal=causal)))
+    try:
+        t_ref = timeit(make_loss(
+            lambda q, k, v, causal: fa._ref_attention(
+                q, k, v, None, causal, q.shape[-1] ** -0.5)))
+        speedup = t_ref / t_flash
+    except Exception as e:
+        # the composed path materializes the [S, S] score matrix and OOMs
+        # at long sequence — the capability gap the flash kernel closes.
+        # Anything that is NOT an out-of-memory failure is a real bug and
+        # must surface.
+        msg = str(e)
+        if not ("RESOURCE_EXHAUSTED" in msg or "out of memory" in msg
+                or "Ran out of memory" in msg):
+            raise
+        speedup = float("inf")
+    toks = batch * seq_len / t_flash
+    return toks, speedup, seq_len
+
+
 def bench_scaling(batch_per_chip=256, warmup=3, iters=9):
     """Config 5: data-parallel ResNet-50 scaling efficiency across the local
     mesh (fleet Collective path -> shard_map + psum over ICI).  On the
@@ -304,6 +365,17 @@ def main():
             "value": round(toks, 2),
             "unit": "tokens/sec",
             "vs_baseline": 0.0,  # no public per-chip anchor (BASELINE.md)
+        }))
+    elif cfg == "longctx":
+        seq = int(os.environ.get("BENCH_SEQ", "4096"))
+        toks, speedup, seq = bench_longctx(seq_len=seq)
+        print(json.dumps({
+            "metric": "flash_attention_fwdbwd_tokens_per_sec_seq%d" % seq,
+            "value": round(toks, 1),
+            "unit": "tokens/sec",
+            # vs XLA-composed attention; inf-> -1 = composed path OOMs
+            "vs_baseline": (round(speedup, 3)
+                            if speedup != float("inf") else -1),
         }))
     elif cfg == "scaling":
         eff, ips, n = bench_scaling()

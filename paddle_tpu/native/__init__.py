@@ -14,7 +14,22 @@ import threading
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _CSRC = os.path.join(_HERE, "csrc")
-_LIB_PATH = os.path.join(_HERE, "_libpaddle_tpu_native.so")
+
+
+def _lib_dir():
+    """Build output location: next to the sources when writable (dev
+    checkout), else a per-user cache dir (read-only wheel installs)."""
+    if os.access(_HERE, os.W_OK):
+        return _HERE
+    cache = os.path.join(
+        os.environ.get("XDG_CACHE_HOME",
+                       os.path.join(os.path.expanduser("~"), ".cache")),
+        "paddle_tpu")
+    os.makedirs(cache, exist_ok=True)
+    return cache
+
+
+_LIB_PATH = os.path.join(_lib_dir(), "_libpaddle_tpu_native.so")
 
 _lib = None
 _lib_lock = threading.Lock()

@@ -20,6 +20,7 @@ setup(
                  "graphs compiled whole-block to XLA, dygraph, fleet "
                  "distribution, PS runtime, inference engine"),
     packages=find_packages(include=["paddle_tpu", "paddle_tpu.*"]),
+    py_modules=["bench"],
     package_data={
         "paddle_tpu": ["native/csrc/*.cc", "native/csrc_capi/*.cc"],
     },

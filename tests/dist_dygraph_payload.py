@@ -1,0 +1,112 @@
+"""Runnable multi-process DYGRAPH DataParallel payload (reference
+imperative/nccl_context.cc + dygraph/parallel.py:84 one-process-per-GPU
+protocol): each process traces eagerly, scale_loss + apply_collective_grads
+average the gradients across processes over gloo."""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 1)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import dygraph
+from paddle_tpu.dygraph import nn as dnn
+
+STEPS = 5
+BS = 8
+N_TRAINERS = 2
+
+
+def make_data():
+    rng = np.random.RandomState(17)
+    w = rng.randn(5, 1).astype("f")
+    xs, ys = [], []
+    for _ in range(STEPS):
+        x = rng.randn(N_TRAINERS * BS, 5).astype("f")
+        xs.append(x)
+        ys.append((x @ w).astype("f"))
+    return xs, ys
+
+
+def build_layers():
+    # fixed-seed params identical across processes
+    rng = np.random.RandomState(99)
+    l1 = dnn.Linear(5, 8, act="relu")
+    l2 = dnn.Linear(8, 1)
+    for layer, shapes in ((l1, (5, 8)), (l2, (8, 1))):
+        w = rng.uniform(-0.3, 0.3, shapes).astype("f")
+        b = np.zeros((shapes[1],), "f")
+        layer.weight.set_value(w)
+        layer.bias.set_value(b)
+    return l1, l2
+
+
+def run(mode):
+    dist = mode == "dist"
+    rank = 0
+    if dist:
+        from paddle_tpu.distributed.launch import init_multihost
+
+        assert init_multihost()
+        rank = jax.process_index()
+        print("bootstrap:%d/%d" % (rank, jax.process_count()), flush=True)
+    xs, ys = make_data()
+    with dygraph.guard():
+        l1, l2 = build_layers()
+        model = None
+        if dist:
+            strategy = dygraph.prepare_context()
+
+            class _Both:
+                def __init__(self, a, b):
+                    self.a, self.b = a, b
+
+                def __call__(self, v):
+                    return self.b(self.a(v))
+
+                def parameters(self, include_sublayers=True):
+                    return self.a.parameters() + self.b.parameters()
+
+                def clear_gradients(self):
+                    self.a.clear_gradients(); self.b.clear_gradients()
+
+            model = fluid.dygraph.DataParallel(_Both(l1, l2), strategy)
+        opt = fluid.optimizer.SGDOptimizer(learning_rate=0.1)
+        for i in range(STEPS):
+            if dist:
+                lo_ = rank * BS
+                xb, yb = xs[i][lo_:lo_ + BS], ys[i][lo_:lo_ + BS]
+            else:
+                xb, yb = xs[i], ys[i]
+            x = dygraph.to_variable(xb)
+            y = dygraph.to_variable(yb)
+            pred = model(x) if dist else l2(l1(x))
+            loss = fluid.layers.mean(fluid.layers.square(pred - y))
+            if dist:
+                loss = model.scale_loss(loss)
+            loss.backward()
+            if dist:
+                model.apply_collective_grads()
+            params = (model.parameters() if dist
+                      else l1.parameters() + l2.parameters())
+            opt.minimize(loss, parameter_list=params)
+            (model if dist else l1).clear_gradients()
+            if not dist:
+                l2.clear_gradients()
+            v = float(np.asarray(loss.numpy()).reshape(-1)[0])
+            if dist:
+                v = v * N_TRAINERS  # undo scale_loss for comparison
+            print("loss:%.8f" % v, flush=True)
+
+
+if __name__ == "__main__":
+    run(sys.argv[1])

@@ -1,0 +1,62 @@
+"""Multi-process dygraph DataParallel test (reference
+parallel_dygraph_mnist.py via test_dist_base: per-process tracers, grads
+averaged across processes).  2 subprocesses over gloo vs 1 local run; the
+mean of the per-shard losses must track the global-batch loss each step
+(exact gradient equality by linearity)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+from dist_utils import free_ports
+
+_PAYLOAD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "dist_dygraph_payload.py")
+
+
+def _losses(out):
+    return [float(l.split("loss:")[1]) for l in out.splitlines()
+            if l.startswith("loss:")]
+
+
+def _env(extra=None):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update(extra or {})
+    return env
+
+
+def test_two_process_dygraph_dataparallel_parity():
+    local = subprocess.run([sys.executable, "-u", _PAYLOAD, "local"],
+                           env=_env(), capture_output=True, text=True,
+                           timeout=240)
+    assert local.returncode == 0, local.stderr[-2000:]
+    want = _losses(local.stdout)
+    assert len(want) == 5
+
+    ports = free_ports(2)
+    eps = ["127.0.0.1:%d" % p for p in ports]
+    procs = []
+    for rank in range(2):
+        procs.append(subprocess.Popen(
+            [sys.executable, "-u", _PAYLOAD, "dist"],
+            env=_env({"PADDLE_TRAINER_ID": str(rank),
+                      "PADDLE_TRAINERS_NUM": "2",
+                      "PADDLE_TRAINER_ENDPOINTS": ",".join(eps),
+                      "PADDLE_CURRENT_ENDPOINT": eps[rank],
+                      "PADDLE_COORDINATOR": eps[0]}),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=240)
+        outs.append(out)
+        assert p.returncode == 0, out[-2000:]
+    for rank, out in enumerate(outs):
+        assert ("bootstrap:%d/2" % rank) in out
+    d0, d1 = _losses(outs[0]), _losses(outs[1])
+    assert len(d0) == len(d1) == 5
+    for i, w in enumerate(want):
+        got = 0.5 * (d0[i] + d1[i])
+        assert abs(got - w) < 1e-3, (i, w, d0[i], d1[i])
