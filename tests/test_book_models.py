@@ -1,0 +1,159 @@
+"""Book-model end-to-end tests for the families the reference's
+tests/book/ covers but round 1 did not: recommender system
+(test_recommender_system.py — embeddings + cos_sim over movielens),
+sentiment LSTM (test_understand_sentiment.py — embedding + dynamic_lstm),
+and semantic role labeling (test_label_semantic_roles.py — CRF over
+conll05).  Each trains on the new synthetic dataset modules and must make
+decisive loss progress."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import datasets
+
+
+def _batchify(reader, n):
+    out = []
+    for i, s in enumerate(reader()):
+        if i >= n:
+            break
+        out.append(s)
+    return out
+
+
+class TestRecommenderSystem:
+    def test_embedding_cos_sim_regression(self):
+        """usr/mov embeddings -> cos_sim -> scale to [0,5] -> square error
+        (the book recommender's core scoring path)."""
+        samples = _batchify(datasets.movielens.train(), 256)
+        uid = np.array([[s[0]] for s in samples], "int64")
+        mid = np.array([[s[4]] for s in samples], "int64")
+        score = np.array([[s[7]] for s in samples], "float32")
+
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 3
+        with fluid.program_guard(main, startup):
+            u = fluid.layers.data("uid", shape=[1], dtype="int64")
+            m = fluid.layers.data("mid", shape=[1], dtype="int64")
+            y = fluid.layers.data("score", shape=[1], dtype="float32")
+            uemb = fluid.layers.embedding(
+                u, size=[datasets.movielens.max_user_id() + 1, 16])
+            memb = fluid.layers.embedding(
+                m, size=[datasets.movielens.max_movie_id() + 1, 16])
+            uvec = fluid.layers.fc(fluid.layers.reshape(uemb, [-1, 16]), 16,
+                                   act="relu")
+            mvec = fluid.layers.fc(fluid.layers.reshape(memb, [-1, 16]), 16,
+                                   act="relu")
+            sim = fluid.layers.cos_sim(uvec, mvec)
+            pred = fluid.layers.scale(sim, scale=5.0)
+            loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.Adam(5e-2).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        feed = {"uid": uid, "mid": mid, "score": score}
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            losses = []
+            for _ in range(40):
+                lo, = exe.run(main, feed=feed, fetch_list=[loss])
+                losses.append(float(np.asarray(lo).reshape(-1)[0]))
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+class TestUnderstandSentiment:
+    def test_lstm_classifier_learns(self):
+        """embedding -> dynamic_lstm -> mean pool -> fc softmax over
+        the sentiment corpus (class-conditional vocab halves)."""
+        T = 32
+        samples = _batchify(datasets.sentiment.train(), 128)
+        ids = np.zeros((len(samples), T), "int64")
+        for i, (seq, _y) in enumerate(samples):
+            seq = seq[:T]
+            ids[i, :len(seq)] = seq
+        labels = np.array([[y] for _, y in samples], "int64")
+
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 5
+        with fluid.program_guard(main, startup):
+            w = fluid.layers.data("w", shape=[T], dtype="int64")
+            y = fluid.layers.data("y", shape=[1], dtype="int64")
+            emb = fluid.layers.embedding(
+                fluid.layers.reshape(w, [-1, T, 1]),
+                size=[datasets.sentiment.VOCAB, 32])
+            hidden = fluid.layers.dynamic_lstm(
+                fluid.layers.fc(emb, 4 * 16, num_flatten_dims=2), 4 * 16)
+            # mean-pool the hidden trajectory (padding included — pad id 0
+            # is rare enough in the synthetic corpus not to matter)
+            last = fluid.layers.reduce_mean(hidden, dim=1)
+            logits = fluid.layers.fc(last, 2)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, y))
+            acc = fluid.layers.accuracy(fluid.layers.softmax(logits), y)
+            fluid.optimizer.Adam(1e-2).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        feed = {"w": ids, "y": labels}
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            accs, losses = [], []
+            for _ in range(40):
+                lo, ac = exe.run(main, feed=feed, fetch_list=[loss, acc])
+                losses.append(float(np.asarray(lo).reshape(-1)[0]))
+                accs.append(float(np.asarray(ac).reshape(-1)[0]))
+        assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
+        assert accs[-1] > 0.8, accs[-1]
+
+
+class TestLabelSemanticRoles:
+    def test_crf_tagger_learns(self):
+        """embedding -> fc emissions -> linear_chain_crf over conll05-style
+        slots; the NLL must drop (the book SRL pipeline's training core)."""
+        T = 12
+        samples = _batchify(datasets.conll05.test(), 64)
+        wd, vd, ld = datasets.conll05.get_dict()
+        n_labels = len(ld)
+        words = np.zeros((len(samples), T), "int64")
+        labels = np.zeros((len(samples), T), "int64")
+        lens = np.zeros((len(samples),), "int64")
+        for i, slots in enumerate(samples):
+            seq = slots[0][:T]
+            lab = slots[8][:T]
+            words[i, :len(seq)] = seq
+            labels[i, :len(lab)] = lab
+            lens[i] = len(seq)
+
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 7
+        with fluid.program_guard(main, startup):
+            w = fluid.layers.data("w", shape=[T], dtype="int64")
+            y = fluid.layers.data("y", shape=[T], dtype="int64")
+            ln = fluid.layers.data("len", shape=[], dtype="int64")
+            emb = fluid.layers.embedding(
+                fluid.layers.reshape(w, [-1, T, 1]),
+                size=[len(wd), 24])
+            emission = fluid.layers.fc(emb, n_labels, num_flatten_dims=2)
+            # LogLikelihood output is already the per-sequence NLL
+            # (ops/crf.py) — minimize it directly
+            crf_cost = fluid.layers.linear_chain_crf(
+                emission, y, param_attr=fluid.ParamAttr(name="crfw"),
+                length=ln)
+            loss = fluid.layers.mean(crf_cost)
+            fluid.optimizer.Adam(2e-2).minimize(loss)
+            decoded = fluid.layers.crf_decoding(
+                emission, param_attr=main.global_block().var("crfw"),
+                length=ln)
+        exe = fluid.Executor(fluid.CPUPlace())
+        feed = {"w": words, "y": labels, "len": lens}
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            losses = []
+            for _ in range(30):
+                lo, = exe.run(main, feed=feed, fetch_list=[loss])
+                losses.append(float(np.asarray(lo).reshape(-1)[0]))
+            path, = exe.run(main, feed=feed, fetch_list=[decoded])
+        assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+        # Viterbi decode must actually agree with the labels it trained on
+        # (valid positions only)
+        path = np.asarray(path)
+        valid = np.arange(T)[None, :] < lens[:, None]
+        agree = float((path == labels)[valid].mean())
+        assert agree > 0.6, agree
