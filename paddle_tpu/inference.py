@@ -219,8 +219,14 @@ class AnalysisPredictor:
                 # delete stateful ops); everything else is XLA's job
                 from . import ir as _ir
 
-                for pname in ("delete_dropout_pass", "conv_bn_fuse_pass"):
-                    _ir.apply_pass(pname, self._program, self._scope)
+                # fetch targets have no op consumers after load (feed/fetch
+                # ops are stripped) — protect them from fusion swallowing
+                protected = set(self._feed_names) | {
+                    v.name for v in self._fetch_vars}
+                for pname in ("delete_dropout_pass", "conv_bn_fuse_pass",
+                              "fc_fuse_pass", "repeated_fc_relu_fuse_pass"):
+                    _ir.apply_pass(pname, self._program, self._scope,
+                                   protected=protected)
         self._fetch_names = [v.name for v in self._fetch_vars]
         self._staged_feed = {}
         self._last_outputs = None

@@ -24,9 +24,15 @@ _PASS_REGISTRY = {}
 
 
 class Pass:
-    """Base class (ir/pass.h:38 analog): override apply(program, scope)."""
+    """Base class (ir/pass.h:38 analog): override apply(program, scope).
+
+    `protected` holds variable names a pass must keep PRODUCED (feed/fetch
+    targets of a loaded inference model — fetch ops are stripped at load,
+    io.py _strip_feed_fetch, so fetched vars have no op consumers and
+    would otherwise look swallowable)."""
 
     name = None
+    protected = frozenset()
 
     def apply(self, program, scope):
         raise NotImplementedError
@@ -49,10 +55,22 @@ def all_passes():
     return sorted(_PASS_REGISTRY)
 
 
-def apply_pass(name, program, scope):
-    """Apply one registered pass in place; returns the program."""
-    get_pass(name).apply(program, scope)
+def apply_pass(name, program, scope, protected=()):
+    """Apply one registered pass in place; returns the program.
+    `protected`: var names that must stay produced (fetch targets)."""
+    p = get_pass(name)
+    p.protected = frozenset(protected)
+    p.apply(program, scope)
     return program
+
+
+def _build_consumers(block):
+    """name -> [ops reading it] (shared by the fusion passes)."""
+    consumers = {}
+    for op in block.ops:
+        for n in op.input_arg_names:
+            consumers.setdefault(n, []).append(op)
+    return consumers
 
 
 @register_pass("delete_dropout_pass")
@@ -93,11 +111,9 @@ class ConvBNFusePass(Pass):
         block = program.global_block()
         # conv output name -> conv op, only when that output feeds exactly
         # one consumer (the BN)
-        consumers = {}
+        consumers = _build_consumers(block)
         filter_uses = {}
         for op in block.ops:
-            for n in op.input_arg_names:
-                consumers.setdefault(n, []).append(op)
             if op.type == "conv2d":
                 f = op.input("Filter")[0]
                 filter_uses[f] = filter_uses.get(f, 0) + 1
@@ -166,4 +182,154 @@ class ConvBNFusePass(Pass):
                 new_ops.append(op)
                 i += 1
         block.ops = new_ops
+        program._bump_version()
+
+
+@register_pass("fc_fuse_pass")
+class FCFusePass(Pass):
+    """Fuse mul(X, W) + elementwise_add(., b) [+ relu] into one `fc` op
+    (ir/fc_fuse_pass.cc).  Conditions mirror the reference pattern: the mul
+    output feeds exactly the add, the bias is a 1-D persistable, and (for
+    the act variant) the add output feeds exactly the relu."""
+
+    def apply(self, program, scope):
+        from .framework import Operator
+
+        block = program.global_block()
+        consumers = _build_consumers(block)
+
+        def only_consumer(name, want_type):
+            cons = consumers.get(name, [])
+            if (len(cons) == 1 and cons[0].type == want_type
+                    and name not in self.protected):
+                return cons[0]
+            return None
+
+        skip = set()
+        new_ops = []
+        for op in block.ops:
+            if id(op) in skip:
+                continue
+            if (op.type == "mul"
+                    and int(op.attrs.get("y_num_col_dims", 1)) == 1):
+                mul_out = op.output("Out")[0]
+                add = only_consumer(mul_out, "elementwise_add")
+                if add is not None:
+                    b_name = add.input("Y")[0]
+                    bvar = block._find_var_recursive(b_name)
+                    # bias must broadcast along the LAST dim (fc semantics):
+                    # for a 2-D mul output that is axis -1 or 1
+                    axis_ok = int(add.attrs.get("axis", -1)) in (-1, 1)
+                    if (bvar is not None and bvar.persistable
+                            and bvar.shape is not None
+                            and len(bvar.shape) == 1 and axis_ok
+                            and add.input("X")[0] == mul_out):
+                        act = ""
+                        out_name = add.output("Out")[0]
+                        relu = only_consumer(out_name, "relu")
+                        tail_ops = [add]
+                        if relu is not None:
+                            act = "relu"
+                            out_name = relu.output("Out")[0]
+                            tail_ops.append(relu)
+                        new_ops.append(Operator(
+                            block, type="fc",
+                            inputs={"Input": [op.input("X")[0]],
+                                    "W": [op.input("Y")[0]],
+                                    "Bias": [b_name]},
+                            outputs={"Out": [out_name]},
+                            attrs={"in_num_col_dims": int(op.attrs.get(
+                                "x_num_col_dims", 1)),
+                                "activation_type": act}))
+                        skip.update(id(t) for t in tail_ops)
+                        continue
+            new_ops.append(op)
+        block.ops = new_ops
+        program._bump_version()
+
+
+@register_pass("repeated_fc_relu_fuse_pass")
+class RepeatedFCReluFusePass(Pass):
+    """Fuse chains of `fc`(relu) ending in a plain `fc` into one
+    fusion_repeated_fc_relu op (ir/repeated_fc_relu_fuse_pass.cc).  Run
+    after fc_fuse_pass, which creates the fc ops this pass stitches."""
+
+    MIN_CHAIN = 2
+
+    def apply(self, program, scope):
+        from .framework import Operator
+
+        block = program.global_block()
+        consumers = _build_consumers(block)
+        producers = {}
+        for op in block.ops:
+            for n in op.output_arg_names:
+                producers[n] = op
+
+        def _eligible(o):
+            # fusion_repeated_fc_relu does raw x @ w (no flattening) and
+            # requires a Bias per fc: only fuse plain 2-D fcs with bias
+            if int(o.attrs.get("in_num_col_dims", 1)) != 1:
+                return False
+            if not o.input("Bias"):
+                return False
+            v = block._find_var_recursive(o.input("Input")[0])
+            return (v is not None and v.shape is not None
+                    and len(v.shape) == 2)
+
+        chains = []  # list of op lists
+        used = set()
+        for op in block.ops:
+            if op.type != "fc" or id(op) in used:
+                continue
+            # only start a chain at a relu-activated fc whose input is NOT
+            # produced by another chain-eligible fc (true chain head)
+            if op.attrs.get("activation_type") != "relu":
+                continue
+            if not _eligible(op):
+                continue
+            prev = producers.get(op.input("Input")[0])
+            if (prev is not None and prev.type == "fc"
+                    and prev.attrs.get("activation_type") == "relu"):
+                continue
+            chain = [op]
+            cur = op
+            while True:
+                out_n = cur.output("Out")[0]
+                nxt_cons = consumers.get(out_n, [])
+                if (len(nxt_cons) != 1 or nxt_cons[0].type != "fc"
+                        or out_n in self.protected
+                        or not _eligible(nxt_cons[0])):
+                    chain = None
+                    break
+                nxt = nxt_cons[0]
+                chain.append(nxt)
+                if nxt.attrs.get("activation_type") != "relu":
+                    break  # plain fc terminates the chain
+                cur = nxt
+            if chain and len(chain) >= self.MIN_CHAIN:
+                chains.append(chain)
+                used.update(id(o) for o in chain)
+
+        if not chains:
+            return
+        replaced = {}
+        for chain in chains:
+            head, tail = chain[0], chain[-1]
+            relu_outs = [o.output("Out")[0] + "@fused_relu"
+                         for o in chain[:-1]]
+            for n in relu_outs:
+                block.create_var(name=n)
+            fused = Operator(
+                block, type="fusion_repeated_fc_relu",
+                inputs={"X": [head.input("Input")[0]],
+                        "W": [o.input("W")[0] for o in chain],
+                        "Bias": [o.input("Bias")[0] for o in chain]},
+                outputs={"ReluOut": relu_outs,
+                         "Out": [tail.output("Out")[0]]})
+            replaced[id(head)] = fused
+            for o in chain[1:]:
+                replaced[id(o)] = None
+        block.ops = [replaced.get(id(op), op) for op in block.ops
+                     if replaced.get(id(op), op) is not None]
         program._bump_version()

@@ -146,3 +146,77 @@ def test_conv_bn_fuse_skips_shared_filter():
     # both BNs must survive (shared filter -> no fusing)
     types = [op.type for op in main.global_block().ops]
     assert types.count("batch_norm") == 2
+
+
+class TestFCFusePass:
+    def _mlp(self):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 11
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[8])
+            h1 = fluid.layers.fc(x, 16, act="relu")
+            h2 = fluid.layers.fc(h1, 16, act="relu")
+            out = fluid.layers.fc(h2, 4)
+        return main, startup, out
+
+    def _run(self, main, startup, out, scope, xb):
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(scope):
+            got, = exe.run(main, feed={"x": xb}, fetch_list=[out])
+        return np.asarray(got)
+
+    def test_fc_fuse_preserves_output(self):
+        main, startup, out = self._mlp()
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        xb = np.random.RandomState(0).rand(4, 8).astype("f")
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+        want = self._run(main, startup, out, scope, xb)
+        n_before = len(main.global_block().ops)
+        ir.apply_pass("fc_fuse_pass", main, scope)
+        types = [op.type for op in main.global_block().ops]
+        assert types.count("fc") == 3
+        assert "mul" not in types and "elementwise_add" not in types
+        assert len(main.global_block().ops) < n_before
+        got = self._run(main, startup, out, scope, xb)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_repeated_fc_relu_fuse(self):
+        main, startup, out = self._mlp()
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        xb = np.random.RandomState(1).rand(4, 8).astype("f")
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+        want = self._run(main, startup, out, scope, xb)
+        ir.apply_pass("fc_fuse_pass", main, scope)
+        ir.apply_pass("repeated_fc_relu_fuse_pass", main, scope)
+        types = [op.type for op in main.global_block().ops]
+        assert "fusion_repeated_fc_relu" in types
+        assert "fc" not in types  # the whole relu-relu-plain chain fused
+        got = self._run(main, startup, out, scope, xb)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_protected_fetch_not_swallowed(self):
+        """Fetch targets of a loaded inference model have no op consumers;
+        the fusion passes must not swallow their producers."""
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[8])
+            h = fluid.layers.fc(x, 16, act="relu")   # fetch the pre-logits
+            out = fluid.layers.fc(h, 4)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        xb = np.random.RandomState(2).rand(4, 8).astype("f")
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+        ir.apply_pass("fc_fuse_pass", main, scope, protected={h.name})
+        ir.apply_pass("repeated_fc_relu_fuse_pass", main, scope,
+                      protected={h.name})
+        # h's producer must survive (fc ok, fusion_repeated must NOT have
+        # consumed it)
+        with fluid.scope_guard(scope):
+            hv, ov = exe.run(main, feed={"x": xb}, fetch_list=[h, out])
+        assert np.asarray(hv).shape == (4, 16)
+        assert np.asarray(ov).shape == (4, 4)
