@@ -31,6 +31,7 @@ from .compiler import BuildStrategy, CompiledProgram, ExecutionStrategy
 from .param_attr import ParamAttr, WeightNormParamAttr
 from .backward import append_backward, gradients
 from . import layers
+from . import nets
 from . import initializer
 from . import optimizer
 from . import regularizer

@@ -1,0 +1,94 @@
+"""fluid.nets composite blocks (reference python/paddle/fluid/nets.py):
+simple_img_conv_pool, img_conv_group, sequence_conv_pool, glu,
+scaled_dot_product_attention — the book models' building blocks."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _run(main, startup, feed, fetch):
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        res = exe.run(main, feed=feed, fetch_list=fetch)
+    return [np.asarray(r) for r in res]
+
+
+class TestNets:
+    def test_simple_img_conv_pool(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            img = fluid.layers.data("img", shape=[1, 8, 8])
+            out = fluid.nets.simple_img_conv_pool(
+                img, num_filters=4, filter_size=3, pool_size=2,
+                pool_stride=2, conv_padding=1, act="relu")
+        x = np.random.RandomState(0).rand(2, 1, 8, 8).astype("f")
+        got, = _run(main, startup, {"img": x}, [out])
+        assert got.shape == (2, 4, 4, 4)
+        assert (got >= 0).all()  # relu
+
+    def test_img_conv_group_with_bn(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            img = fluid.layers.data("img", shape=[3, 8, 8])
+            out = fluid.nets.img_conv_group(
+                img, conv_num_filter=[4, 4], pool_size=2,
+                conv_act="relu", conv_with_batchnorm=True,
+                conv_batchnorm_drop_rate=0.0, pool_stride=2)
+        x = np.random.RandomState(1).rand(2, 3, 8, 8).astype("f")
+        got, = _run(main, startup, {"img": x}, [out])
+        assert got.shape == (2, 4, 4, 4)
+
+    def test_sequence_conv_pool(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            seq = fluid.layers.data("seq", shape=[6, 8],
+                                    append_batch_size=True)
+            out = fluid.nets.sequence_conv_pool(
+                seq, num_filters=5, filter_size=3, pool_type="max")
+        x = np.random.RandomState(2).rand(3, 6, 8).astype("f")
+        got, = _run(main, startup, {"seq": x}, [out])
+        assert got.shape == (3, 5)
+
+    def test_glu(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            v = fluid.layers.data("v", shape=[8])
+            out = fluid.nets.glu(v, dim=-1)
+        x = np.random.RandomState(3).rand(4, 8).astype("f")
+        got, = _run(main, startup, {"v": x}, [out])
+        a, b = x[:, :4], x[:, 4:]
+        want = a * (1.0 / (1.0 + np.exp(-b)))
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    @pytest.mark.parametrize("heads", [1, 2])
+    def test_scaled_dot_product_attention(self, heads):
+        B, T, D = 2, 5, 8
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            q = fluid.layers.data("q", shape=[B, T, D],
+                                  append_batch_size=False)
+            k = fluid.layers.data("k", shape=[B, T, D],
+                                  append_batch_size=False)
+            v = fluid.layers.data("v", shape=[B, T, D],
+                                  append_batch_size=False)
+            ctx = fluid.nets.scaled_dot_product_attention(
+                q, k, v, num_heads=heads)
+        rng = np.random.RandomState(4)
+        qa, ka, va = (rng.rand(B, T, D).astype("f") for _ in range(3))
+        got, = _run(main, startup, {"q": qa, "k": ka, "v": va}, [ctx])
+        assert got.shape == (B, T, D)
+        # numpy reference
+        hd = D // heads
+        want = np.zeros((B, T, D), "f")
+        for h in range(heads):
+            qs = qa[..., h*hd:(h+1)*hd] if heads > 1 else qa
+            ks = ka[..., h*hd:(h+1)*hd] if heads > 1 else ka
+            vs = va[..., h*hd:(h+1)*hd] if heads > 1 else va
+            s = (qs * hd ** -0.5) @ ks.transpose(0, 2, 1)
+            e = np.exp(s - s.max(-1, keepdims=True))
+            w = e / e.sum(-1, keepdims=True)
+            want[..., h*hd:(h+1)*hd] = w @ vs
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
