@@ -32,6 +32,16 @@ from .param_attr import ParamAttr, WeightNormParamAttr
 from .backward import append_backward, gradients
 from . import layers
 from . import nets
+from . import input
+from .input import one_hot, embedding
+from . import lod_tensor
+from .lod_tensor import create_lod_tensor, create_random_int_lodtensor
+from . import average
+from . import evaluator
+from . import install_check
+from . import debugger
+from . import parallel_executor
+from .parallel_executor import ParallelExecutor
 from . import initializer
 from . import optimizer
 from . import regularizer
@@ -59,6 +69,9 @@ from . import inference
 from .inference import AnalysisConfig, create_paddle_predictor
 from . import reader  # DataLoader module; also re-exports the decorators
 from .reader_decorator import batch
+from .core.scope import TpuTensor as LoDTensor  # reference core.LoDTensor
+from . import compat_modules as _compat_modules
+_compat_modules.wire_aliases()
 
 __version__ = "0.1.0"
 

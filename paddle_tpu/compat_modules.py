@@ -1,0 +1,163 @@
+"""Small import-path compat shims for reference-internal modules user code
+occasionally imports (python/paddle/fluid/{log_helper, wrapped_decorator,
+annotations, default_scope_funcs, op, data_feed_desc, trainer_desc,
+trainer_factory, device_worker, executor, parallel_executor,
+communicator, dygraph_grad_clip}.py).  Each is registered in sys.modules
+as paddle_tpu.<name> pointing at the live implementation or a faithful
+mini-module."""
+
+import contextlib
+import functools
+import logging
+import sys
+import types
+
+
+def _module(name):
+    m = types.ModuleType("paddle_tpu." + name)
+    sys.modules["paddle_tpu." + name] = m
+    return m
+
+
+# -- log_helper --------------------------------------------------------------
+_log = _module("log_helper")
+
+
+def get_logger(name, level, fmt=None):
+    logger = logging.getLogger(name)
+    logger.setLevel(level)
+    if not logger.handlers:
+        h = logging.StreamHandler()
+        if fmt:
+            h.setFormatter(logging.Formatter(fmt))
+        logger.addHandler(h)
+    logger.propagate = False
+    return logger
+
+
+_log.get_logger = get_logger
+
+# -- wrapped_decorator -------------------------------------------------------
+_wd = _module("wrapped_decorator")
+
+
+def wrap_decorator(decorator_func):
+    @functools.wraps(decorator_func)
+    def _decorate(func):
+        return functools.wraps(func)(decorator_func(func))
+
+    return _decorate
+
+
+def signature_safe_contextmanager(func):
+    return functools.wraps(func)(contextlib.contextmanager(func))
+
+
+_wd.wrap_decorator = wrap_decorator
+_wd.signature_safe_contextmanager = signature_safe_contextmanager
+
+# -- annotations -------------------------------------------------------------
+_ann = _module("annotations")
+
+
+def deprecated(since, instead, extra_message=""):
+    def decorator(func):
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            import warnings
+
+            warnings.warn(
+                "%s is deprecated since %s, use %s instead. %s"
+                % (func.__name__, since, instead, extra_message),
+                DeprecationWarning)
+            return func(*args, **kwargs)
+
+        return wrapper
+
+    return decorator
+
+
+_ann.deprecated = deprecated
+
+# -- default_scope_funcs -----------------------------------------------------
+_dsf = _module("default_scope_funcs")
+
+
+def _wire_scope_funcs():
+    from .core import executor as _exe
+
+    _dsf.get_cur_scope = _exe.global_scope
+    _dsf.scoped_function = lambda fn: fn()
+    _dsf.find_var = lambda name: _exe.global_scope().find_var(name)
+    _dsf.var = lambda name: _exe.global_scope().var(name)
+
+
+_wire_scope_funcs()
+
+# -- module aliases to live implementations ---------------------------------
+
+
+def _alias(name, target_module):
+    sys.modules["paddle_tpu." + name] = target_module
+
+
+class Communicator:
+    """Async-PS communicator facade (reference
+    python/paddle/fluid/communicator.py): the actual send/merge threads
+    live in the runtime PS communicator (distributed/ps.py TrainerPSComm,
+    driven by the executor at step boundaries), so start/stop only track
+    state for API parity."""
+
+    def __init__(self, program=None, mode=None, **kwargs):
+        self._program = program
+        self._running = False
+
+    def start(self):
+        self._running = True
+
+    def stop(self):
+        self._running = False
+
+    def is_running(self):
+        return self._running
+
+
+def wire_aliases():
+    """Called at the end of paddle_tpu/__init__ once the real modules
+    exist.  Each alias carries the canonical symbols the reference import
+    path exports."""
+    import paddle_tpu as _p
+
+    from . import trainer as _trainer
+    from .core import executor as _core_exe
+
+    _alias("executor", _core_exe)
+    _alias("trainer_factory", _trainer)
+    _alias("trainer_desc", _trainer)
+    _alias("device_worker", _trainer)
+
+    # data_feed_desc.DataFeedDesc: the class lives on the package root
+    # (defined after this call runs) — resolve lazily via PEP 562
+    dfd = _module("data_feed_desc")
+    dfd.__dict__["__getattr__"] = (
+        lambda name: getattr(__import__("paddle_tpu"), name))
+
+    comm = _module("communicator")
+    comm.Communicator = Communicator
+
+    from . import clip as _clip
+
+    _alias("dygraph_grad_clip", _clip)
+    from . import debugger as _dbg
+
+    _alias("graphviz", _dbg)
+    nd = _module("net_drawer")
+    nd.draw_block_graphviz = _dbg.draw_block_graphviz
+
+    def draw_graph(startup_program, main_program, **kwargs):
+        """net_drawer.py:draw_graph: dot-file dump of the main block."""
+        path = kwargs.get("graph_path", "./graph.dot")
+        return _dbg.draw_block_graphviz(main_program.global_block(),
+                                        path=path)
+
+    nd.draw_graph = draw_graph

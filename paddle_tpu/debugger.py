@@ -1,0 +1,42 @@
+"""Program visualization (reference python/paddle/fluid/debugger.py
+draw_block_graphviz + graphviz.py/net_drawer.py): emit a Graphviz dot of a
+block's op/var graph."""
+
+__all__ = ["draw_block_graphviz", "pprint_program_codes"]
+
+
+def _dot_escape(s):
+    return str(s).replace('"', '\\"')
+
+
+def draw_block_graphviz(block, highlights=None, path="./temp.dot"):
+    """Write the block's dataflow as a .dot file; vars are ellipses, ops
+    are boxes (debugger.py draw_block_graphviz)."""
+    highlights = set(highlights or [])
+    lines = ["digraph G {", "  rankdir=TB;"]
+    var_ids = {}
+    for i, (name, var) in enumerate(block.vars.items()):
+        var_ids[name] = "var_%d" % i
+        color = ', style=filled, fillcolor="yellow"' \
+            if name in highlights else ""
+        label = "%s\\n%s %s" % (_dot_escape(name), var.dtype,
+                                list(var.shape) if var.shape else "?")
+        lines.append('  var_%d [shape=ellipse, label="%s"%s];'
+                     % (i, label, color))
+    for j, op in enumerate(block.ops):
+        lines.append('  op_%d [shape=box, style=rounded, label="%s"];'
+                     % (j, _dot_escape(op.type)))
+        for n in op.input_arg_names:
+            if n in var_ids:
+                lines.append("  %s -> op_%d;" % (var_ids[n], j))
+        for n in op.output_arg_names:
+            if n in var_ids:
+                lines.append("  op_%d -> %s;" % (j, var_ids[n]))
+    lines.append("}")
+    with open(path, "w") as f:
+        f.write("\n".join(lines))
+    return path
+
+
+def pprint_program_codes(program):
+    print(program.to_string(throw_on_error=False))

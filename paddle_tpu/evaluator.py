@@ -1,0 +1,115 @@
+"""Deprecated Evaluator classes (reference
+python/paddle/fluid/evaluator.py: ChunkEvaluator:127, EditDistance:218,
+DetectionMAP:299).  The reference itself deprecates these in favor of
+fluid.metrics; kept for API parity as thin delegates that build the same
+metric ops and accumulate across batches via fluid.metrics."""
+
+import numpy as np
+
+from . import layers, metrics
+
+__all__ = ["ChunkEvaluator", "EditDistance", "DetectionMAP"]
+
+
+class Evaluator:
+    """Base (evaluator.py:40): create states in the startup program and
+    update them per batch; reset() zeroes the python-side accumulator."""
+
+    def __init__(self, name=None):
+        self._name = name
+        self._metric = None
+
+    def reset(self, executor=None, reset_program=None):
+        if self._metric is not None:
+            self._metric.reset()
+
+    def eval(self, executor=None, eval_program=None):
+        return self._metric.eval()
+
+
+class ChunkEvaluator(Evaluator):
+    """Precision/recall/F1 over chunked sequence labels
+    (evaluator.py:127): wraps layers.chunk_eval + metrics.ChunkEvaluator
+    accumulation."""
+
+    def __init__(self, input, label, chunk_scheme, num_chunk_types,
+                 excluded_chunk_types=None):
+        super().__init__()
+        (precision, recall, f1, num_infer_chunks, num_label_chunks,
+         num_correct_chunks) = layers.chunk_eval(
+            input=input, label=label, chunk_scheme=chunk_scheme,
+            num_chunk_types=num_chunk_types,
+            excluded_chunk_types=excluded_chunk_types)
+        self._metric = metrics.ChunkEvaluator()
+        self.metrics = [precision, recall, f1]
+        self.fetches = [num_infer_chunks, num_label_chunks,
+                        num_correct_chunks]
+
+    def update(self, num_infer_chunks, num_label_chunks,
+               num_correct_chunks):
+        self._metric.update(int(np.asarray(num_infer_chunks).sum()),
+                            int(np.asarray(num_label_chunks).sum()),
+                            int(np.asarray(num_correct_chunks).sum()))
+
+
+class EditDistance(Evaluator):
+    """Average edit distance accumulation (evaluator.py:218)."""
+
+    def __init__(self, input, label, ignored_tokens=None):
+        super().__init__()
+        distances, seq_num = layers.edit_distance(
+            input=input, label=label, ignored_tokens=ignored_tokens)
+        self._metric = metrics.EditDistance()
+        self.metrics = [distances]
+        self.fetches = [distances, seq_num]
+
+    def update(self, distances, seq_num):
+        d = np.asarray(distances, "float64")
+        self._metric.update(d, int(np.asarray(seq_num).sum()))
+
+
+class DetectionMAP(Evaluator):
+    """mAP over detection batches (evaluator.py:299): builds the
+    detection_map op per batch and averages its MAP output."""
+
+    def __init__(self, input, gt_label, gt_box, gt_difficult=None,
+                 class_num=None, background_label=0,
+                 overlap_threshold=0.5, evaluate_difficult=True,
+                 ap_version="integral"):
+        super().__init__()
+        if gt_difficult is not None:
+            label = layers.concat([gt_label, gt_difficult, gt_box], axis=1)
+        else:
+            label = layers.concat([gt_label, gt_box], axis=1)
+        # build the detection_map op directly (no layer wrapper exists;
+        # ops/coverage_tail.py detection_map)
+        from .layer_helper import LayerHelper
+
+        helper = LayerHelper("detection_map_eval")
+        outs = {nm: helper.create_variable_for_type_inference("float32")
+                for nm in ("AccumPosCount", "AccumTruePos",
+                           "AccumFalsePos", "MAP")}
+        helper.append_op(
+            type="detection_map",
+            inputs={"DetectRes": [input], "Label": [label]},
+            outputs={k: [v] for k, v in outs.items()},
+            attrs={"overlap_threshold": overlap_threshold,
+                   "evaluate_difficult": evaluate_difficult,
+                   "class_num": class_num or 1,
+                   "background_label": background_label,
+                   "ap_type": ap_version})
+        helper_map = outs["MAP"]
+        self._maps = []
+        self.metrics = [helper_map]
+        self.fetches = [helper_map]
+
+    def reset(self, executor=None, reset_program=None):
+        self._maps = []
+
+    def update(self, batch_map):
+        self._maps.append(float(np.asarray(batch_map).reshape(-1)[0]))
+
+    def eval(self, executor=None, eval_program=None):
+        if not self._maps:
+            raise ValueError("eval() before update()")
+        return float(np.mean(self._maps))

@@ -1,0 +1,38 @@
+"""Top-level input helpers (reference python/paddle/fluid/input.py:
+one_hot:24, embedding:126).  These are the *_v2 semantics: ids carry NO
+mandatory trailing [1] dim, and the output appends the new axis to the ids
+shape (unlike layers.one_hot / layers.embedding, whose v1 ops squeeze a
+trailing [1])."""
+
+from .layer_helper import LayerHelper
+
+__all__ = ["one_hot", "embedding"]
+
+
+def one_hot(input, depth, allow_out_of_range=False):
+    """fluid.one_hot (input.py:24): out shape = ids.shape + [depth]."""
+    from . import layers
+
+    flat = layers.reshape(input, shape=[-1, 1])
+    oh = layers.one_hot(flat, depth, allow_out_of_range=allow_out_of_range)
+    out_shape = [d if d > 0 else -1 for d in (input.shape or [-1])]
+    return layers.reshape(oh, shape=out_shape + [depth])
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    """fluid.embedding (input.py:126): lookup_table_v2 — out shape =
+    ids.shape + [emb_dim]."""
+    helper = LayerHelper("embedding", param_attr=param_attr)
+    w = helper.create_parameter(attr=param_attr, shape=size, dtype=dtype,
+                                is_bias=False)
+    out = helper.create_variable_for_type_inference(dtype)
+    pad = -1 if padding_idx is None else (
+        padding_idx if padding_idx >= 0 else size[0] + padding_idx)
+    helper.append_op(
+        type="lookup_table_v2",
+        inputs={"W": [w], "Ids": [input]},
+        outputs={"Out": [out]},
+        attrs={"is_sparse": is_sparse, "is_distributed": is_distributed,
+               "padding_idx": pad})
+    return out

@@ -1,0 +1,63 @@
+"""LoDTensor construction helpers (reference
+python/paddle/fluid/lod_tensor.py: create_lod_tensor:24,
+create_random_int_lodtensor:114).
+
+Padded-design mapping: the returned TpuTensor holds the flat [total, ...]
+data (as the reference does) with the recursive sequence lengths recorded
+as lod metadata; the sequence ops consume padded views built by lod.py."""
+
+import numpy as np
+
+from .core.scope import TpuTensor
+
+__all__ = ["create_lod_tensor", "create_random_int_lodtensor"]
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None):
+    if isinstance(data, TpuTensor):
+        t = TpuTensor()
+        t.set(np.asarray(data.numpy()))
+        t.set_recursive_sequence_lengths(recursive_seq_lens)
+        return t
+    if isinstance(data, list):
+        # ragged python list of SCALAR sequences: flatten to [total, 1]
+        # like the reference, which also asserts the caller's lengths match
+        # (lod_tensor.py:24 "the length-based LoD ... should be consistent
+        # with the data")
+        new_lens = [len(seq) for seq in data]
+        if (len(recursive_seq_lens) != 1
+                or list(recursive_seq_lens[0]) != new_lens):
+            raise ValueError(
+                "recursive_seq_lens %s does not match the list structure "
+                "(lengths %s)" % (recursive_seq_lens, new_lens))
+        flat = []
+        for seq in data:
+            for v in seq:
+                if isinstance(v, (list, tuple)):
+                    raise ValueError(
+                        "list data must hold scalar sequences; pass a "
+                        "numpy array for multi-dim rows")
+                flat.append(v)
+        arr = np.asarray(flat).reshape(-1, 1)
+        t = TpuTensor()
+        t.set(arr)
+        t.set_recursive_sequence_lengths([new_lens])
+        return t
+    arr = np.asarray(data)
+    total = sum(recursive_seq_lens[-1])
+    if arr.shape[0] != total:
+        raise ValueError(
+            "data rows (%d) must equal the sum of the last-level lengths "
+            "(%d)" % (arr.shape[0], total))
+    t = TpuTensor()
+    t.set(arr)
+    t.set_recursive_sequence_lengths(recursive_seq_lens)
+    return t
+
+
+def create_random_int_lodtensor(recursive_seq_lens, base_shape, place, low,
+                                high):
+    total = sum(recursive_seq_lens[-1])
+    data = np.random.randint(low, high + 1,
+                             [total] + list(base_shape)).astype("int64")
+    return create_lod_tensor(data, recursive_seq_lens, place)

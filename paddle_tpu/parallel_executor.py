@@ -1,0 +1,39 @@
+"""ParallelExecutor facade (reference
+python/paddle/fluid/parallel_executor.py:28): the legacy multi-device API.
+On TPU it wraps CompiledProgram.with_data_parallel over the mesh — the SSA
+op-handle engine dissolves into SPMD (COMPONENTS.md §2.1)."""
+
+from .compiler import BuildStrategy, CompiledProgram, ExecutionStrategy
+from .core.executor import Executor, global_scope
+from .framework import CPUPlace, TPUPlace, default_main_program
+
+__all__ = ["ParallelExecutor"]
+
+
+class ParallelExecutor:
+    def __init__(self, use_cuda, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None,
+                 build_strategy=None, num_trainers=1, trainer_id=0,
+                 scope=None):
+        self._place = TPUPlace(0) if use_cuda else CPUPlace()
+        self._main = main_program or default_main_program()
+        self._scope = scope or global_scope()
+        self._compiled = CompiledProgram(self._main).with_data_parallel(
+            loss_name=loss_name, build_strategy=build_strategy,
+            exec_strategy=exec_strategy,
+            share_vars_from=getattr(share_vars_from, "_compiled",
+                                    share_vars_from))
+        self._exe = Executor(self._place)
+
+    def run(self, fetch_list, feed=None, feed_dict=None,
+            return_numpy=True):
+        feed = feed if feed is not None else feed_dict
+        from .core.executor import scope_guard
+
+        with scope_guard(self._scope):
+            return self._exe.run(self._compiled, feed=feed,
+                                 fetch_list=fetch_list,
+                                 return_numpy=return_numpy)
+
+    def drop_local_exe_scopes(self):
+        pass  # scope lifetime is owned by XLA/PJRT buffers here

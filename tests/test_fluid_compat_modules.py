@@ -1,0 +1,124 @@
+"""Top-level fluid module-surface parity (reference python/paddle/fluid/
+input.py, lod_tensor.py, average.py, evaluator.py, install_check.py,
+parallel_executor.py, debugger.py + the import-path shims)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+class TestInputModule:
+    def test_one_hot_and_embedding(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            ids = fluid.layers.data("ids", shape=[4], dtype="int64",
+                                    append_batch_size=False)
+            oh = fluid.one_hot(ids, depth=6)
+            emb = fluid.embedding(ids, size=[6, 3])
+        exe = fluid.Executor(fluid.CPUPlace())
+        iv = np.array([0, 2, 5, 2], "int64")
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            o, e = exe.run(main, feed={"ids": iv}, fetch_list=[oh, emb])
+        o = np.asarray(o)
+        assert o.shape == (4, 6)
+        np.testing.assert_array_equal(o.argmax(1), iv)
+        assert np.asarray(e).shape == (4, 3)
+
+
+class TestLoDTensorHelpers:
+    def test_create_lod_tensor_from_list(self):
+        t = fluid.create_lod_tensor([[1, 2, 3], [4, 5]], [[3, 2]],
+                                    fluid.CPUPlace())
+        assert t.recursive_sequence_lengths() == [[3, 2]]
+        np.testing.assert_array_equal(
+            t.numpy().ravel(), [1, 2, 3, 4, 5])
+
+    def test_create_lod_tensor_shape_check(self):
+        with pytest.raises(ValueError):
+            fluid.create_lod_tensor(np.zeros((4, 2), "f"), [[3, 2]],
+                                    fluid.CPUPlace())
+
+    def test_create_random_int(self):
+        t = fluid.create_random_int_lodtensor([[2, 3]], [1],
+                                              fluid.CPUPlace(), 0, 9)
+        arr = t.numpy()
+        assert arr.shape == (5, 1)
+        assert arr.min() >= 0 and arr.max() <= 9
+
+
+class TestAverage:
+    def test_weighted_average(self):
+        w = fluid.average.WeightedAverage()
+        w.add(2.0, 1)
+        w.add(4.0, 3)
+        assert abs(w.eval() - 3.5) < 1e-9
+        w.reset()
+        with pytest.raises(ValueError):
+            w.eval()
+
+
+class TestParallelExecutorFacade:
+    def test_train_step(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[4])
+            y = fluid.layers.data("y", shape=[1])
+            pred = fluid.layers.fc(x, 1)
+            loss = fluid.layers.mean(fluid.layers.square(pred - y))
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        rng = np.random.RandomState(0)
+        xb = rng.rand(16, 4).astype("f")
+        yb = (xb.sum(1, keepdims=True)).astype("f")
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            pe = fluid.ParallelExecutor(use_cuda=False,
+                                        loss_name=loss.name,
+                                        main_program=main, scope=scope)
+            first = pe.run(feed={"x": xb, "y": yb},
+                           fetch_list=[loss.name])[0]
+            for _ in range(20):
+                last = pe.run(feed={"x": xb, "y": yb},
+                              fetch_list=[loss.name])[0]
+        assert float(np.asarray(last).reshape(-1)[0]) < \
+            float(np.asarray(first).reshape(-1)[0])
+
+
+class TestDebugger:
+    def test_draw_block_graphviz(self, tmp_path):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[4])
+            fluid.layers.fc(x, 2)
+        p = str(tmp_path / "g.dot")
+        fluid.debugger.draw_block_graphviz(main.global_block(), path=p)
+        dot = open(p).read()
+        assert dot.startswith("digraph G {") and "mul" in dot
+
+
+class TestImportShims:
+    def test_shim_modules_importable(self):
+        import paddle_tpu.log_helper as lh
+        import paddle_tpu.wrapped_decorator as wd
+        import paddle_tpu.annotations as ann
+        import paddle_tpu.default_scope_funcs as dsf
+        import paddle_tpu.executor as exe_mod
+        import paddle_tpu.trainer_factory as tf
+        import paddle_tpu.communicator as comm
+
+        assert hasattr(exe_mod, "Executor")
+        assert callable(lh.get_logger)
+        assert callable(wd.signature_safe_contextmanager)
+        assert callable(ann.deprecated)
+        assert callable(dsf.get_cur_scope)
+        assert comm is not None and tf is not None
+
+    def test_install_check(self, capsys):
+        fluid.install_check.run_check()
+        out = capsys.readouterr().out
+        assert "installed successfully" in out
