@@ -417,7 +417,19 @@ class Executor:
                 if local.ndim >= 1 and data_axis \
                         and local.shape[0] % local_dev == 0:
                     spec = P(data_axis, *([None] * (local.ndim - 1)))
+                elif local.ndim >= 1 and data_axis and local.shape[0] > 1:
+                    # A replicated P() spec would require every process to
+                    # supply IDENTICAL data; each trainer feeds a distinct
+                    # local shard here, so falling back to replication
+                    # silently diverges per-device values. Fail loudly.
+                    raise ValueError(
+                        "multi-process feed '%s': local batch %d is not "
+                        "divisible by the %d local device(s); pad the batch "
+                        "or adjust batch size per trainer"
+                        % (n, local.shape[0], local_dev))
                 else:
+                    # leading dim 1 (or scalar): broadcast-like feed (lr,
+                    # beta_pow) — identical across processes, replicate
                     spec = P()
                 out[n] = jax.make_array_from_process_local_data(
                     NamedSharding(mesh, spec), local)

@@ -224,6 +224,13 @@ def run_op(op, env, rng_key, mesh=None, axis_names=(), runner=None,
             out = opdef.lower(ctx, *args, **_lower_attrs(op.attrs))
     else:
         out = opdef.lower(ctx, *args, **_lower_attrs(op.attrs))
+    if (len(opdef.output_slots) == 1
+            and opdef.output_slots[0] in opdef.duplicable_outputs
+            and isinstance(out, list)):
+        # a bare list from a single-duplicable-output lowering IS the item
+        # list — wrap unconditionally so a 1-element list is not mistaken
+        # for a positional slot tuple (unstack with num=1, c_sync_comm)
+        out = (out,)
     if len(opdef.output_slots) == 1 and not isinstance(out, (tuple, list)):
         out = (out,)
     elif isinstance(out, list):

@@ -281,11 +281,11 @@ def _save_legacy_model(dirname, feed_names, fetch_names, pruned,
     # reference-style plumbing: feed/fetch vars + ops with col attrs
     b0["vars"].append({"name": "feed", "shape": None, "dtype": None,
                        "lod_level": 0, "persistable": True,
-                       "stop_gradient": True, "type": "raw",
+                       "stop_gradient": True, "type": "feed_minibatch",
                        "is_data": False, "is_parameter": False})
     b0["vars"].append({"name": "fetch", "shape": None, "dtype": None,
                        "lod_level": 0, "persistable": True,
-                       "stop_gradient": True, "type": "raw",
+                       "stop_gradient": True, "type": "fetch_list",
                        "is_data": False, "is_parameter": False})
     feed_ops = [{"type": "feed", "inputs": {"X": ["feed"]},
                  "outputs": {"Out": [n]}, "attrs": {"col": i}}

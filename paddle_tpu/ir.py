@@ -250,9 +250,12 @@ class FCFusePass(Pass):
 
 @register_pass("repeated_fc_relu_fuse_pass")
 class RepeatedFCReluFusePass(Pass):
-    """Fuse chains of `fc`(relu) ending in a plain `fc` into one
-    fusion_repeated_fc_relu op (ir/repeated_fc_relu_fuse_pass.cc).  Run
-    after fc_fuse_pass, which creates the fc ops this pass stitches."""
+    """Fuse chains of relu-activated `fc` ops into one
+    fusion_repeated_fc_relu op (ir/repeated_fc_relu_fuse_pass.cc).  The
+    fused kernel applies fc+bias+relu to EVERY layer
+    (fusion_repeated_fc_relu_op.cc:118-139), so only all-relu chains are
+    eligible; a terminal plain fc stays unfused.  Run after fc_fuse_pass,
+    which creates the fc ops this pass stitches."""
 
     MIN_CHAIN = 2
 
@@ -282,15 +285,22 @@ class RepeatedFCReluFusePass(Pass):
         for op in block.ops:
             if op.type != "fc" or id(op) in used:
                 continue
-            # only start a chain at a relu-activated fc whose input is NOT
-            # produced by another chain-eligible fc (true chain head)
+            # only start a chain at a relu-activated fc whose producer
+            # could NOT itself chain into it (true chain head): the skip
+            # must mirror the extension conditions below, else a producer
+            # with a multi-consumer/protected output blocks its consumer
+            # from heading a valid chain
             if op.attrs.get("activation_type") != "relu":
                 continue
             if not _eligible(op):
                 continue
-            prev = producers.get(op.input("Input")[0])
+            in_name = op.input("Input")[0]
+            prev = producers.get(in_name)
             if (prev is not None and prev.type == "fc"
-                    and prev.attrs.get("activation_type") == "relu"):
+                    and prev.attrs.get("activation_type") == "relu"
+                    and _eligible(prev)
+                    and len(consumers.get(in_name, [])) == 1
+                    and in_name not in self.protected):
                 continue
             chain = [op]
             cur = op
@@ -299,15 +309,13 @@ class RepeatedFCReluFusePass(Pass):
                 nxt_cons = consumers.get(out_n, [])
                 if (len(nxt_cons) != 1 or nxt_cons[0].type != "fc"
                         or out_n in self.protected
-                        or not _eligible(nxt_cons[0])):
-                    chain = None
+                        or not _eligible(nxt_cons[0])
+                        or nxt_cons[0].attrs.get(
+                            "activation_type") != "relu"):
                     break
-                nxt = nxt_cons[0]
-                chain.append(nxt)
-                if nxt.attrs.get("activation_type") != "relu":
-                    break  # plain fc terminates the chain
-                cur = nxt
-            if chain and len(chain) >= self.MIN_CHAIN:
+                cur = nxt_cons[0]
+                chain.append(cur)
+            if len(chain) >= self.MIN_CHAIN:
                 chains.append(chain)
                 used.update(id(o) for o in chain)
 

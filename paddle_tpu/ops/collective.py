@@ -97,7 +97,9 @@ def c_sync_calc_stream(ctx, x):
              attrs={"ring_id": 0}, grad_maker=None,
              duplicable_inputs=("X",), duplicable_outputs=("Out",))
 def c_sync_comm_stream(ctx, xs, ring_id=0):
-    return list(xs)
+    # tuple-wrap: the duplicable-output convention (a bare 1-element list
+    # would be mistaken for a positional slot tuple by run_op)
+    return (list(xs),)
 
 
 @register_op("c_gen_nccl_id", inputs=(), outputs=("Out",),

@@ -42,19 +42,27 @@ def l1_norm(ctx, x):
 
 @register_op("size", inputs=("Input",), outputs=("Out",), grad_maker=None)
 def size(ctx, x):
-    """size_op.cc: number of elements."""
-    return jnp.asarray(int(np.prod(x.shape)), jnp.int64)
+    """size_op.cc: number of elements, int64 output.  Canonicalized so the
+    no-x64 default lowers to int32 without a truncation warning while x64
+    builds keep true int64."""
+    n = int(np.prod(x.shape))
+    return jnp.asarray(n, jax.dtypes.canonicalize_dtype(jnp.int64))
 
 
 @register_op("fill", inputs=(), outputs=("Out",),
              attrs={"value": [], "shape": [], "dtype": 5, "force_cpu": False},
              grad_maker=None)
 def fill(ctx, value=(), shape=(), dtype=5, force_cpu=False):
-    """fill_op.cc: materialize a tensor from attr data."""
+    """fill_op.cc: materialize a tensor from attr data.  ``force_cpu`` is a
+    placement hint that dissolves under XLA (the compiler owns placement,
+    flags.py policy); the dtype attr is respected with wide types
+    canonicalized rather than silently truncated."""
     from .common import attr_dtype
 
-    return jnp.asarray(np.asarray(value, attr_dtype(dtype)).reshape(
-        [int(s) for s in shape]))
+    np_val = np.asarray(value, attr_dtype(dtype)).reshape(
+        [int(s) for s in shape])
+    return jnp.asarray(
+        np_val, jax.dtypes.canonicalize_dtype(np_val.dtype))
 
 
 @register_op("fill_zeros_like2", inputs=("X",), outputs=("Out",),
@@ -858,14 +866,14 @@ def fused_embedding_fc_lstm(ctx, ids, embeddings, wh, bias, h0, c0,
              duplicable_inputs=("W", "Bias"),
              duplicable_outputs=("ReluOut",))
 def fusion_repeated_fc_relu(ctx, x, ws, biases):
-    """fusion_repeated_fc_relu_op.cc: chain of fc+relu; the last fc has
-    no relu."""
+    """fusion_repeated_fc_relu_op.cc:118-139: chain of fc+bias+relu, relu
+    applied to EVERY layer including the last (all kernel calls are
+    fc_relu); ReluOut holds the first N-1 activations."""
     relus = []
     out = x
     for i, (w, b) in enumerate(zip(ws, biases)):
-        out = out @ w + b.reshape(1, -1)
+        out = jax.nn.relu(out @ w + b.reshape(1, -1))
         if i + 1 < len(ws):
-            out = jax.nn.relu(out)
             relus.append(out)
     return (relus, out)
 
@@ -1223,40 +1231,81 @@ def mine_hard_examples(ctx, cls_loss, loc_loss, match_indices, match_dist,
 def detection_map(ctx, det, label, has_state, pos_count, tp, fp,
                   overlap_threshold=0.5, evaluate_difficult=True,
                   class_num=1, background_label=0, ap_type="integral"):
-    """detection_map_op.cc: mean average precision over padded detection
-    results [N, 6] (label, score, box) vs labels [M, 6].  Simplified
-    single-pass integral AP on the padded batch (the streaming-state
-    accumulation rides the returned accumulators)."""
-    scores = det[:, 1]
+    """detection_map_op.cc mAP over padded detections.
+
+    DetectRes [N, 6] rows are (label, score, xmin, ymin, xmax, ymax);
+    Label rows are (label, xmin, ymin, xmax, ymax) or
+    (label, difficult, xmin, ymin, xmax, ymax).  Per class: detections
+    sorted by score greedily claim the best-IoU unmatched ground truth
+    (one TP per gt, detection_map_op.h GetTpFpAccum analog); AP is
+    integral or 11point; MAP averages classes with positives.  Rows with
+    negative label are padding.  The streaming accumulators ride the
+    returned slots (zeros when no incoming state)."""
+    six_col = label.shape[1] >= 6
+    gl = label[:, 0]
+    gbox = label[:, 2:6] if six_col else label[:, 1:5]
+    difficult = (label[:, 1] > 0.5) if six_col else jnp.zeros(
+        label.shape[0], bool)
+    gt_pad = gl < 0
     dl = det[:, 0]
-    # NB: simplified matching — detections are matched independently by
-    # best IoU (no per-gt dedup), unlike the reference's greedy assignment
+    scores = det[:, 1]
+    dbox = det[:, 2:6]
+    det_pad = dl < 0
+
     def iou(a, b):
-        ix = jnp.maximum(0.0, jnp.minimum(a[3], b[3])
+        ix = jnp.maximum(0.0, jnp.minimum(a[2], b[2])
+                         - jnp.maximum(a[0], b[0]))
+        iy = jnp.maximum(0.0, jnp.minimum(a[3], b[3])
                          - jnp.maximum(a[1], b[1]))
-        iy = jnp.maximum(0.0, jnp.minimum(a[4], b[4])
-                         - jnp.maximum(a[2], b[2]))
         inter = ix * iy
-        ar_a = (a[3] - a[1]) * (a[4] - a[2])
-        ar_b = (b[3] - b[1]) * (b[4] - b[2])
+        ar_a = (a[2] - a[0]) * (a[3] - a[1])
+        ar_b = (b[2] - b[0]) * (b[3] - b[1])
         return inter / jnp.maximum(ar_a + ar_b - inter, 1e-10)
 
-    ious = jax.vmap(lambda d: jax.vmap(lambda g: iou(d, g))(label))(det)
-    same = dl[:, None] == label[:, 0][None, :]
-    best = jnp.max(jnp.where(same, ious, 0.0), axis=1)
-    tp_mask = best >= overlap_threshold
+    ious = jax.vmap(lambda d: jax.vmap(lambda g: iou(d, g))(gbox))(dbox)
     order = jnp.argsort(-scores)
-    tp_sorted = tp_mask[order].astype(jnp.float32)
-    fp_sorted = 1.0 - tp_sorted
-    ctp = jnp.cumsum(tp_sorted)
-    cfp = jnp.cumsum(fp_sorted)
-    npos = jnp.maximum(label.shape[0], 1)
-    recall = ctp / npos
-    precision = ctp / jnp.maximum(ctp + cfp, 1e-10)
-    ap = jnp.sum((recall - jnp.concatenate([jnp.zeros(1), recall[:-1]]))
-                 * precision)
+    aps, has_pos = [], []
+    for c in range(int(class_num)):
+        if c == background_label:
+            continue
+        gt_c = (gl == c) & ~gt_pad
+        count_gt = gt_c if evaluate_difficult else (gt_c & ~difficult)
+        npos = jnp.sum(count_gt.astype(jnp.float32))
+        det_c = (dl == c) & ~det_pad
+
+        def step(used, d):
+            cand = jnp.where(gt_c & ~used, ious[d], -1.0)
+            j = jnp.argmax(cand)
+            hit = det_c[d] & (cand[j] >= overlap_threshold)
+            if evaluate_difficult:
+                tp_d = hit
+            else:
+                # a match to a difficult gt is ignored: not TP, not FP
+                tp_d = hit & ~difficult[j]
+            fp_d = det_c[d] & ~hit
+            return used.at[j].set(used[j] | hit), (
+                tp_d.astype(jnp.float32), fp_d.astype(jnp.float32))
+
+        _, (tps, fps) = lax.scan(
+            step, jnp.zeros(label.shape[0], bool), order)
+        ctp = jnp.cumsum(tps)
+        cfp = jnp.cumsum(fps)
+        recall = ctp / jnp.maximum(npos, 1.0)
+        precision = ctp / jnp.maximum(ctp + cfp, 1e-10)
+        if ap_type == "11point":
+            pts = [jnp.max(jnp.where(recall >= t, precision, 0.0))
+                   for t in np.arange(0.0, 1.01, 0.1)]
+            ap = jnp.sum(jnp.stack(pts)) / 11.0
+        else:
+            prev = jnp.concatenate([jnp.zeros(1), recall[:-1]])
+            ap = jnp.sum((recall - prev) * precision)
+        aps.append(ap)
+        has_pos.append((npos > 0).astype(jnp.float32))
+    aps_v = jnp.stack(aps) if aps else jnp.zeros(1)
+    w = jnp.stack(has_pos) if has_pos else jnp.zeros(1)
+    mean_ap = jnp.sum(aps_v * w) / jnp.maximum(jnp.sum(w), 1.0)
     z = jnp.zeros((1,), jnp.float32)
-    return z, z, z, ap.reshape(1)
+    return z, z, z, mean_ap.reshape(1)
 
 
 @register_op("multiclass_nms2",

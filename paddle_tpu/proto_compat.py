@@ -354,6 +354,22 @@ _BLOCK_ATTRS = {"sub_block", "block"}
 _BLOCKS_ATTRS = {"sub_blocks", "blocks"}
 
 
+# Attr names whose values are string/float lists in the reference op
+# definitions; an empty value must still round-trip with the right AttrType
+# (op_proto_maker.h op_role_var/op_callstack are STRINGS; the detection-op
+# geometry attrs are FLOATS).
+_EMPTY_STRINGS_ATTRS = frozenset({
+    "op_role_var", "op_callstack", "readers", "grad_var_names",
+    "original_var_names", "table_names", "epmap", "endpoints",
+    "feed_var_names", "fetch_var_names", "input_names", "output_names",
+})
+_EMPTY_FLOATS_ATTRS = frozenset({
+    "min_sizes", "max_sizes", "aspect_ratios", "variances", "anchor_sizes",
+    "stride", "densities", "fixed_sizes", "fixed_ratios", "scales",
+    "expand_ratios", "steps",
+})
+
+
 def _emit_attr(name, val):
     w = _Writer()
     w.string(1, name)
@@ -377,9 +393,17 @@ def _emit_attr(name, val):
         w.varint(2, _ATTR_STRING).string(5, val)
     elif isinstance(val, (list, tuple)):
         if not val:
-            # the element type is unknowable from an empty value; INTS is
-            # the overwhelmingly common case (shape/axis/sections defaults)
-            w.varint(2, _ATTR_INTS)
+            # the element type is unknowable from an empty value; the
+            # reference's typed attr access (boost::get) throws on a type
+            # mismatch, so consult a hint table for the known float-list /
+            # string-list attr names before defaulting to INTS (the
+            # overwhelmingly common case: shape/axis/sections defaults).
+            if name in _EMPTY_STRINGS_ATTRS:
+                w.varint(2, _ATTR_STRINGS)
+            elif name in _EMPTY_FLOATS_ATTRS:
+                w.varint(2, _ATTR_FLOATS)
+            else:
+                w.varint(2, _ATTR_INTS)
         elif all(isinstance(v, bool) for v in val):
             w.varint(2, _ATTR_BOOLEANS)
             for v in val:
@@ -429,6 +453,11 @@ _VARTYPE_TO_PB = {
     "reader": _PB_READER,
     "step_scopes": _PB_STEP_SCOPES,
     "raw": _PB_RAW,
+    # feed/fetch holder vars: the reference executor enforces these exact
+    # types on the holders (executor.cc:240,:284), so exported legacy models
+    # must carry them or the reference refuses to run the model.
+    "feed_minibatch": _PB_FEED_MINIBATCH,
+    "fetch_list": _PB_FETCH_LIST,
 }
 
 

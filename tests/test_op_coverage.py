@@ -54,6 +54,35 @@ def test_forward_op_coverage():
         "%d reference forward ops unregistered: %s" % (len(missing), missing))
 
 
+def test_every_op_has_a_numeric_test():
+    """Companion audit (round-2 verdict item 1): registration alone is not
+    verification — every reference forward op name must appear in at least
+    one test module, so a numeric assertion covers it (directly via
+    run_op/OpTest goldens, or through the layer API that emits it).  New
+    ops land with tests or this fails."""
+    import glob
+
+    import re
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    corpus = ""
+    for path in glob.glob(os.path.join(here, "*.py")):
+        if os.path.basename(path) == "test_op_coverage.py":
+            continue
+        with open(path) as f:
+            corpus += f.read()
+    # identifier-boundary match: "size" must not pass via "batch_size",
+    # "fill" not via "fill_constant"
+    untested = [
+        name for name in _ref_ops()
+        if name not in ALLOWLIST and not re.search(
+            r"(?<![A-Za-z0-9_])%s(?![A-Za-z0-9_])" % re.escape(name),
+            corpus)]
+    assert not untested, (
+        "%d registered ops appear in no test module: %s"
+        % (len(untested), untested))
+
+
 def test_allowlist_is_tight():
     """Every allowlisted name must actually be a reference op (no stale
     entries) and must actually be absent (no shadowing a real lowering)."""

@@ -1,0 +1,856 @@
+"""Numeric golden tests for the coverage-tail op families the round-2
+verdict flagged as registered-but-unverified: the fusion family (each
+fusion_* checked against its unfused composition, the reference's own test
+contract — test_fusion_gru_op.py etc.), cudnn_lstm, the quant tail vs
+numpy quantizers (test_fake_quantize_op.py), detection metrics vs numpy
+references (test_detection_map_op.py, test_multiclass_nms_op.py), the
+sequence tail, the PS/LoD helpers, and assorted singletons
+(average_accumulates, depthwise_conv2d_transpose, fill/size dtypes)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def run_op(op_type, inputs, attrs, out_slots):
+    """Run one op.  `inputs`: slot -> array | list[(name, arr)].
+    `out_slots`: slot -> 1 (single) | N (duplicable, N outputs).
+    Returns a dict slot -> array | [arrays]."""
+    from paddle_tpu.framework import convert_np_dtype_to_dtype_
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        block = main.global_block()
+        in_map, feed = {}, {}
+        for slot, val in inputs.items():
+            entries = val if isinstance(val, list) else [
+                ("in_" + slot, val)]
+            names = []
+            for nm, arr in entries:
+                block.create_var(
+                    name=nm, shape=arr.shape,
+                    dtype=convert_np_dtype_to_dtype_(arr.dtype))
+                feed[nm] = arr
+                names.append(nm)
+            in_map[slot] = names
+        out_map, fetch = {}, []
+        for slot, n in out_slots.items():
+            names = ["out_%s_%d" % (slot, i) for i in range(n)]
+            for nm in names:
+                block.create_var(name=nm)
+            out_map[slot] = names
+            fetch.extend(names)
+        block.append_op(type=op_type, inputs=in_map, outputs=out_map,
+                        attrs=attrs)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        res = exe.run(main, feed=feed, fetch_list=fetch)
+    res = [np.asarray(r) for r in res]
+    out, i = {}, 0
+    for slot, n in out_slots.items():
+        out[slot] = res[i] if n == 1 else res[i:i + n]
+        i += n
+    return out
+
+
+def _sigmoid(v):
+    return 1.0 / (1.0 + np.exp(-v))
+
+
+def _np_lstm(proj, wh, h0=None, c0=None, reverse=False):
+    """proj [B,T,4D] pre-activations; gate order i,f,cand,o."""
+    B, T, D4 = proj.shape
+    D = D4 // 4
+    h = np.zeros((B, D), proj.dtype) if h0 is None else h0.copy()
+    c = np.zeros((B, D), proj.dtype) if c0 is None else c0.copy()
+    hs = np.zeros((B, T, D), proj.dtype)
+    cs = np.zeros((B, T, D), proj.dtype)
+    ts = range(T - 1, -1, -1) if reverse else range(T)
+    for t in ts:
+        g = proj[:, t] + h @ wh
+        i, f = _sigmoid(g[:, :D]), _sigmoid(g[:, D:2 * D])
+        cand = np.tanh(g[:, 2 * D:3 * D])
+        o = _sigmoid(g[:, 3 * D:])
+        c = f * c + i * cand
+        h = o * np.tanh(c)
+        hs[:, t], cs[:, t] = h, c
+    return hs, cs
+
+
+def _np_gru(proj, wh, h0=None, origin_mode=False, reverse=False):
+    B, T, D3 = proj.shape
+    D = D3 // 3
+    h = np.zeros((B, D), proj.dtype) if h0 is None else h0.copy()
+    hs = np.zeros((B, T, D), proj.dtype)
+    ts = range(T - 1, -1, -1) if reverse else range(T)
+    for t in ts:
+        ur = proj[:, t, :2 * D] + h @ wh[:, :2 * D]
+        u, r = _sigmoid(ur[:, :D]), _sigmoid(ur[:, D:])
+        c = np.tanh(proj[:, t, 2 * D:] + (r * h) @ wh[:, 2 * D:])
+        h = ((1 - u) * h + u * c) if origin_mode else (u * h + (1 - u) * c)
+        hs[:, t] = h
+    return hs
+
+
+# -- fused RNN family --------------------------------------------------------
+
+
+class TestFusionRNNFamily:
+    def test_fusion_gru_vs_numpy(self):
+        rng = np.random.RandomState(0)
+        B, T, F, D = 2, 5, 6, 4
+        x = rng.uniform(-1, 1, (B, T, F)).astype("f")
+        wx = rng.uniform(-0.5, 0.5, (F, 3 * D)).astype("f")
+        wh = rng.uniform(-0.5, 0.5, (D, 3 * D)).astype("f")
+        b = rng.uniform(-0.2, 0.2, (1, 3 * D)).astype("f")
+        out = run_op("fusion_gru",
+                     {"X": x, "WeightX": wx, "WeightH": wh, "Bias": b},
+                     {}, {"Hidden": 1})
+        want = _np_gru(x @ wx + b.reshape(1, 1, -1), wh)
+        np.testing.assert_allclose(out["Hidden"], want, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_fusion_gru_reverse_origin_mode(self):
+        rng = np.random.RandomState(1)
+        B, T, F, D = 2, 4, 3, 3
+        x = rng.uniform(-1, 1, (B, T, F)).astype("f")
+        wx = rng.uniform(-0.5, 0.5, (F, 3 * D)).astype("f")
+        wh = rng.uniform(-0.5, 0.5, (D, 3 * D)).astype("f")
+        out = run_op("fusion_gru", {"X": x, "WeightX": wx, "WeightH": wh},
+                     {"is_reverse": True, "origin_mode": True},
+                     {"Hidden": 1})
+        want = _np_gru(x @ wx, wh, origin_mode=True, reverse=True)
+        np.testing.assert_allclose(out["Hidden"], want, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_fusion_lstm_vs_numpy(self):
+        rng = np.random.RandomState(2)
+        B, T, F, D = 2, 5, 6, 4
+        x = rng.uniform(-1, 1, (B, T, F)).astype("f")
+        wx = rng.uniform(-0.5, 0.5, (F, 4 * D)).astype("f")
+        wh = rng.uniform(-0.5, 0.5, (D, 4 * D)).astype("f")
+        b = rng.uniform(-0.2, 0.2, (1, 4 * D)).astype("f")
+        h0 = rng.uniform(-0.5, 0.5, (B, D)).astype("f")
+        c0 = rng.uniform(-0.5, 0.5, (B, D)).astype("f")
+        out = run_op("fusion_lstm",
+                     {"X": x, "WeightX": wx, "WeightH": wh, "Bias": b,
+                      "H0": h0, "C0": c0}, {}, {"Hidden": 1, "Cell": 1})
+        want_h, want_c = _np_lstm(x @ wx + b.reshape(1, 1, -1), wh, h0, c0)
+        np.testing.assert_allclose(out["Hidden"], want_h, rtol=1e-5,
+                                   atol=1e-6)
+        np.testing.assert_allclose(out["Cell"], want_c, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_fusion_lstm_equals_unfused_composition(self):
+        """fusion_lstm == mul + lstm (reference test_fusion_lstm_op.py
+        contract: fused output equals the composed ops)."""
+        rng = np.random.RandomState(3)
+        B, T, F, D = 2, 4, 5, 3
+        x = rng.uniform(-1, 1, (B, T, F)).astype("f")
+        wx = rng.uniform(-0.5, 0.5, (F, 4 * D)).astype("f")
+        wh = rng.uniform(-0.5, 0.5, (D, 4 * D)).astype("f")
+        fused = run_op("fusion_lstm",
+                       {"X": x, "WeightX": wx, "WeightH": wh}, {},
+                       {"Hidden": 1})
+        proj = (x.reshape(-1, F) @ wx).reshape(B, T, 4 * D)
+        unfused = run_op("lstm", {"Input": proj, "Weight": wh},
+                         {"use_peepholes": False}, {"Hidden": 1})
+        np.testing.assert_allclose(fused["Hidden"], unfused["Hidden"],
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_fused_embedding_fc_lstm(self):
+        rng = np.random.RandomState(4)
+        B, T, V, D = 2, 4, 11, 3
+        ids = rng.randint(0, V, (B, T)).astype("i8")
+        emb = rng.uniform(-0.5, 0.5, (V, 4 * D)).astype("f")
+        wh = rng.uniform(-0.5, 0.5, (D, 4 * D)).astype("f")
+        b = rng.uniform(-0.2, 0.2, (1, 4 * D)).astype("f")
+        out = run_op("fused_embedding_fc_lstm",
+                     {"Ids": ids, "Embeddings": emb, "WeightH": wh,
+                      "Bias": b}, {}, {"Hidden": 1, "Cell": 1})
+        proj = emb[ids] + b.reshape(1, 1, -1)
+        want_h, want_c = _np_lstm(proj.astype("f"), wh)
+        np.testing.assert_allclose(out["Hidden"], want_h, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_cudnn_lstm_packed_blob(self):
+        """2-layer unidirectional stacked LSTM over the cuDNN flat weight
+        layout [Wx | Wh | b_x | b_h] per layer (cudnn_lstm_op.cu)."""
+        rng = np.random.RandomState(5)
+        B, T, F, D = 2, 4, 5, 3
+        x = rng.uniform(-1, 1, (B, T, F)).astype("f")
+        blob, params = [], []
+        fin = F
+        for _layer in range(2):
+            wx = rng.uniform(-0.5, 0.5, (fin, 4 * D)).astype("f")
+            wh = rng.uniform(-0.5, 0.5, (D, 4 * D)).astype("f")
+            bx = rng.uniform(-0.2, 0.2, (4 * D,)).astype("f")
+            bh = rng.uniform(-0.2, 0.2, (4 * D,)).astype("f")
+            blob += [wx.ravel(), wh.ravel(), bx, bh]
+            params.append((wx, wh, bx + bh))
+            fin = D
+        w = np.concatenate(blob)
+        out = run_op("cudnn_lstm", {"Input": x, "W": w},
+                     {"hidden_size": D, "num_layers": 2},
+                     {"Out": 1, "last_h": 1, "last_c": 1})
+        cur = x
+        for wx, wh, b in params:
+            proj = cur @ wx + b.reshape(1, 1, -1)
+            cur, cs = _np_lstm(proj.astype("f"), wh)
+        np.testing.assert_allclose(out["Out"], cur, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(out["last_h"][-1], cur[:, -1],
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_cudnn_lstm_bidirectional(self):
+        rng = np.random.RandomState(6)
+        B, T, F, D = 2, 3, 4, 2
+        x = rng.uniform(-1, 1, (B, T, F)).astype("f")
+        blob, params = [], []
+        for _d in range(2):
+            wx = rng.uniform(-0.5, 0.5, (F, 4 * D)).astype("f")
+            wh = rng.uniform(-0.5, 0.5, (D, 4 * D)).astype("f")
+            bx = rng.uniform(-0.2, 0.2, (4 * D,)).astype("f")
+            bh = rng.uniform(-0.2, 0.2, (4 * D,)).astype("f")
+            blob += [wx.ravel(), wh.ravel(), bx, bh]
+            params.append((wx, wh, bx + bh))
+        out = run_op("cudnn_lstm",
+                     {"Input": x, "W": np.concatenate(blob)},
+                     {"hidden_size": D, "num_layers": 1,
+                      "is_bidirec": True}, {"Out": 1})
+        fwd, _ = _np_lstm((x @ params[0][0]
+                           + params[0][2].reshape(1, 1, -1)).astype("f"),
+                          params[0][1])
+        bwd, _ = _np_lstm((x @ params[1][0]
+                           + params[1][2].reshape(1, 1, -1)).astype("f"),
+                          params[1][1], reverse=True)
+        want = np.concatenate([fwd, bwd], axis=-1)
+        np.testing.assert_allclose(out["Out"], want, rtol=1e-5, atol=1e-5)
+
+
+# -- fusion (non-RNN) family -------------------------------------------------
+
+
+class TestFusionOps:
+    def test_fusion_seqconv_eltadd_relu_vs_composition(self):
+        rng = np.random.RandomState(7)
+        B, T, D, M, ctx_len = 2, 6, 4, 5, 3
+        x = rng.uniform(-1, 1, (B, T, D)).astype("f")
+        filt = rng.uniform(-0.5, 0.5, (ctx_len * D, M)).astype("f")
+        bias = rng.uniform(-0.2, 0.2, (M,)).astype("f")
+        fused = run_op("fusion_seqconv_eltadd_relu",
+                       {"X": x, "Filter": filt, "Bias": bias},
+                       {"contextLength": ctx_len, "contextStart": -1},
+                       {"Out": 1})
+        seqconv = run_op("sequence_conv", {"X": x, "Filter": filt},
+                         {"contextLength": ctx_len, "contextStart": -1},
+                         {"Out": 1})
+        want = np.maximum(seqconv["Out"] + bias.reshape(1, 1, -1), 0.0)
+        np.testing.assert_allclose(fused["Out"], want, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_fusion_seqexpand_concat_fc(self):
+        rng = np.random.RandomState(8)
+        B, T, D0, D1, M = 2, 4, 3, 2, 5
+        seq = rng.uniform(-1, 1, (B, T, D0)).astype("f")
+        side = rng.uniform(-1, 1, (B, D1)).astype("f")
+        w = rng.uniform(-0.5, 0.5, (D0 + D1, M)).astype("f")
+        b = rng.uniform(-0.2, 0.2, (M,)).astype("f")
+        out = run_op("fusion_seqexpand_concat_fc",
+                     {"X": [("seq", seq), ("side", side)],
+                      "FCWeight": w, "FCBias": b},
+                     {"fc_activation": "relu"}, {"Out": 1})
+        expanded = np.broadcast_to(side[:, None], (B, T, D1))
+        cat = np.concatenate([seq, expanded], axis=-1)
+        want = np.maximum(cat @ w + b.reshape(1, 1, -1), 0.0)
+        np.testing.assert_allclose(out["Out"], want, rtol=1e-5, atol=1e-6)
+
+    @pytest.mark.parametrize("pooltype", ["SUM", "AVERAGE", "SQRT"])
+    def test_fusion_seqpool_concat(self, pooltype):
+        rng = np.random.RandomState(9)
+        B, T = 3, 5
+        xs = [rng.uniform(-1, 1, (B, T, d)).astype("f") for d in (2, 4)]
+        out = run_op("fusion_seqpool_concat",
+                     {"X": [("x0", xs[0]), ("x1", xs[1])]},
+                     {"pooltype": pooltype}, {"Out": 1})
+        pools = []
+        for x in xs:
+            if pooltype == "SUM":
+                pools.append(x.sum(1))
+            elif pooltype == "AVERAGE":
+                pools.append(x.mean(1))
+            else:
+                pools.append(x.sum(1) / np.sqrt(T))
+        want = np.concatenate(pools, axis=-1)
+        np.testing.assert_allclose(out["Out"], want, rtol=1e-5, atol=1e-6)
+
+    def test_fusion_seqpool_cvm_concat(self):
+        rng = np.random.RandomState(10)
+        B, T, D = 2, 4, 5
+        xs = [np.abs(rng.uniform(0, 2, (B, T, D))).astype("f")
+              for _ in range(2)]
+        cvm_in = np.ones((B, 2), "f")
+        out = run_op("fusion_seqpool_cvm_concat",
+                     {"X": [("x0", xs[0]), ("x1", xs[1])], "CVM": cvm_in},
+                     {"pooltype": "SUM", "use_cvm": True}, {"Out": 1})
+        pools = []
+        for x in xs:
+            v = x.sum(1)
+            c0 = np.log(v[:, :1] + 1)
+            c1 = np.log(v[:, 1:2] + 1) - c0
+            pools.append(np.concatenate([c0, c1, v[:, 2:]], axis=1))
+        want = np.concatenate(pools, axis=-1)
+        np.testing.assert_allclose(out["Out"], want, rtol=1e-5, atol=1e-6)
+
+    def test_fusion_transpose_flatten_concat(self):
+        rng = np.random.RandomState(11)
+        xs = [rng.uniform(-1, 1, (2, 3, 4)).astype("f") for _ in range(2)]
+        out = run_op("fusion_transpose_flatten_concat",
+                     {"X": [("x0", xs[0]), ("x1", xs[1])]},
+                     {"trans_axis": [0, 2, 1], "flatten_axis": 1,
+                      "concat_axis": 1}, {"Out": 1})
+        flat = [np.transpose(x, (0, 2, 1)).reshape(2, -1) for x in xs]
+        np.testing.assert_allclose(out["Out"],
+                                   np.concatenate(flat, axis=1),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_conv2d_fusion_vs_composition(self):
+        rng = np.random.RandomState(12)
+        x = rng.uniform(-1, 1, (2, 3, 8, 8)).astype("f")
+        w = rng.uniform(-0.5, 0.5, (4, 3, 3, 3)).astype("f")
+        bias = rng.uniform(-0.2, 0.2, (4,)).astype("f")
+        conv = run_op("conv2d", {"Input": x, "Filter": w},
+                      {"strides": [1, 1], "paddings": [1, 1]},
+                      {"Output": 1})["Output"]
+        residual = rng.uniform(-1, 1, conv.shape).astype("f")
+        fused = run_op("conv2d_fusion",
+                       {"Input": x, "Filter": w, "Bias": bias,
+                        "ResidualData": residual},
+                       {"strides": [1, 1], "paddings": [1, 1],
+                        "activation": "relu"}, {"Output": 1})
+        want = np.maximum(conv + bias.reshape(1, -1, 1, 1) + residual, 0.0)
+        np.testing.assert_allclose(fused["Output"], want, rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_conv2d_inception_fusion_vs_composition(self):
+        rng = np.random.RandomState(13)
+        C = 3
+        x = rng.uniform(-1, 1, (2, C, 6, 6)).astype("f")
+        ws = [rng.uniform(-0.5, 0.5, (2, C, k, k)).astype("f")
+              for k in (1, 3)]
+        bs = [rng.uniform(-0.2, 0.2, (2,)).astype("f") for _ in range(2)]
+        fused = run_op(
+            "conv2d_inception_fusion",
+            {"Input": x, "Filter": [("w0", ws[0]), ("w1", ws[1])],
+             "Bias": [("b0", bs[0]), ("b1", bs[1])]},
+            {"pooling_type": "max", "activation": "relu"},
+            {"Output": 1, "TempOutput": 2})
+        branches = []
+        for w, b in zip(ws, bs):
+            k = w.shape[2]
+            o = run_op("conv2d", {"Input": x, "Filter": w},
+                       {"strides": [1, 1], "paddings": [k // 2, k // 2]},
+                       {"Output": 1})["Output"]
+            branches.append(np.maximum(o + b.reshape(1, -1, 1, 1), 0.0))
+        pool = run_op("pool2d", {"X": x},
+                      {"pooling_type": "max", "ksize": [3, 3],
+                       "strides": [1, 1], "paddings": [1, 1]},
+                      {"Out": 1})["Out"]
+        want = np.concatenate(branches + [pool], axis=1)
+        np.testing.assert_allclose(fused["Output"], want, rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_fused_elemwise_activation(self):
+        rng = np.random.RandomState(14)
+        x = rng.uniform(-1, 1, (3, 4)).astype("f")
+        y = rng.uniform(-1, 1, (3, 4)).astype("f")
+        out = run_op("fused_elemwise_activation", {"X": x, "Y": y},
+                     {"functor_list": ["relu", "elementwise_add"]},
+                     {"Out": 1, "IntermediateOut": 1})
+        np.testing.assert_allclose(out["Out"], np.maximum(x + y, 0.0),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(out["IntermediateOut"], x + y,
+                                   rtol=1e-5)
+        out2 = run_op("fused_elemwise_activation", {"X": x, "Y": y},
+                      {"functor_list": ["elementwise_add", "scale"],
+                       "scale": 2.0}, {"Out": 1})
+        np.testing.assert_allclose(out2["Out"], x + 2.0 * y, rtol=1e-5)
+
+    def test_fusion_repeated_fc_relu_all_layers_relu(self):
+        """The fused kernel applies fc+bias+relu to every layer including
+        the last (fusion_repeated_fc_relu_op.cc:118-139)."""
+        rng = np.random.RandomState(15)
+        x = rng.uniform(-1, 1, (3, 4)).astype("f")
+        w1 = rng.uniform(-0.5, 0.5, (4, 5)).astype("f")
+        b1 = rng.uniform(-0.2, 0.2, (5,)).astype("f")
+        w2 = rng.uniform(-0.5, 0.5, (5, 2)).astype("f")
+        b2 = rng.uniform(-0.2, 0.2, (2,)).astype("f")
+        out = run_op("fusion_repeated_fc_relu",
+                     {"X": x, "W": [("w1", w1), ("w2", w2)],
+                      "Bias": [("b1", b1), ("b2", b2)]}, {},
+                     {"ReluOut": 1, "Out": 1})
+        h1 = np.maximum(x @ w1 + b1, 0.0)
+        want = np.maximum(h1 @ w2 + b2, 0.0)
+        np.testing.assert_allclose(out["Out"], want, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(out["ReluOut"], h1, rtol=1e-5,
+                                   atol=1e-6)
+
+
+# -- quantization tail -------------------------------------------------------
+
+
+def _np_quant_dequant(x, scale, bits=8):
+    bnt = (1 << (bits - 1)) - 1
+    s = max(float(scale), 1e-8)
+    return np.round(np.clip(x / s, -1.0, 1.0) * bnt) * s / bnt
+
+
+class TestQuantTail:
+    def test_fake_quantize_range_abs_max_train_window(self):
+        rng = np.random.RandomState(16)
+        x = rng.uniform(-2, 2, (4, 5)).astype("f")
+        window = 4
+        in_scale = np.asarray([0.5], "f")
+        in_scales = np.asarray([0.5, 3.0, 0.1, 0.2], "f")
+        it = np.asarray([5], "i8")  # slot 5 % 4 == 1 -> overwrites the 3.0
+        out = run_op("fake_quantize_range_abs_max",
+                     {"X": x, "InScale": in_scale, "InScales": in_scales,
+                      "Iter": it},
+                     {"window_size": window, "bit_length": 8},
+                     {"Out": 1, "OutScale": 1, "OutScales": 1})
+        cur = np.abs(x).max()
+        hist = in_scales.copy()
+        hist[1] = cur
+        scale = hist.max()
+        np.testing.assert_allclose(out["OutScale"], [scale], rtol=1e-6)
+        np.testing.assert_allclose(out["OutScales"], hist, rtol=1e-6)
+        np.testing.assert_allclose(out["Out"],
+                                   _np_quant_dequant(x, scale), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_fake_quantize_range_abs_max_test_mode(self):
+        rng = np.random.RandomState(17)
+        x = rng.uniform(-2, 2, (3, 3)).astype("f")
+        in_scale = np.asarray([1.5], "f")
+        out = run_op("fake_quantize_range_abs_max",
+                     {"X": x, "InScale": in_scale},
+                     {"is_test": True, "bit_length": 8},
+                     {"Out": 1, "OutScale": 1})
+        np.testing.assert_allclose(out["Out"], _np_quant_dequant(x, 1.5),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_fake_quantize_dequantize_moving_average(self):
+        rng = np.random.RandomState(18)
+        x = rng.uniform(-2, 2, (4, 4)).astype("f")
+        in_scale = np.asarray([0.7], "f")
+        in_accum = np.asarray([1.2], "f")
+        in_state = np.asarray([2.0], "f")
+        out = run_op("fake_quantize_dequantize_moving_average_abs_max",
+                     {"X": x, "InScale": in_scale, "InAccum": in_accum,
+                      "InState": in_state}, {"moving_rate": 0.9},
+                     {"Out": 1, "OutScale": 1, "OutAccum": 1,
+                      "OutState": 1})
+        cur = np.abs(x).max()
+        state = 0.9 * 2.0 + 1.0
+        accum = 0.9 * 1.2 + cur
+        scale = accum / state
+        np.testing.assert_allclose(out["OutState"], [state], rtol=1e-6)
+        np.testing.assert_allclose(out["OutAccum"], [accum], rtol=1e-6)
+        np.testing.assert_allclose(out["OutScale"], [scale], rtol=1e-6)
+        np.testing.assert_allclose(out["Out"],
+                                   _np_quant_dequant(x, scale), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_fake_channel_wise_dequantize_max_abs(self):
+        rng = np.random.RandomState(19)
+        x = rng.randint(-127, 128, (3, 4)).astype("f")
+        scales = np.asarray([0.5, 1.0, 2.0], "f")
+        out = run_op("fake_channel_wise_dequantize_max_abs",
+                     {"X": x, "Scales": [("s0", scales)]},
+                     {"quant_bits": [8], "quant_axis": 0}, {"Out": 1})
+        want = x * scales.reshape(3, 1) / 127.0
+        np.testing.assert_allclose(out["Out"], want, rtol=1e-6)
+
+    def test_fake_channel_wise_dequantize_two_scales(self):
+        rng = np.random.RandomState(20)
+        x = rng.randint(-127, 128, (2, 3)).astype("f")
+        s0 = np.asarray([0.5, 2.0], "f")
+        s1 = np.asarray([3.0], "f")
+        out = run_op("fake_channel_wise_dequantize_max_abs",
+                     {"X": x, "Scales": [("s0", s0), ("s1", s1)]},
+                     {"quant_bits": [8, 8], "quant_axis": 0}, {"Out": 1})
+        want = x * s0.reshape(2, 1) / 127.0 * 3.0 / 127.0
+        np.testing.assert_allclose(out["Out"], want, rtol=1e-6)
+
+    def test_requantize(self):
+        x = np.asarray([[-100, 0, 50], [127, -128, 10]], np.int8)
+        out = run_op("requantize", {"Input": x},
+                     {"Scale_in": 2.0, "Scale_out": 4.0}, {"Output": 1})
+        want = np.clip(np.round(x.astype("f") * 2.0), -128, 127)
+        np.testing.assert_array_equal(out["Output"],
+                                      want.astype(np.int8))
+
+
+# -- detection metrics -------------------------------------------------------
+
+
+class TestDetectionMetrics:
+    def test_mine_hard_examples(self):
+        """SSD hard-negative mining vs a numpy replica: top
+        neg_pos_ratio*num_pos negatives by loss per row."""
+        cls_loss = np.asarray([[0.1, 0.9, 0.5, 0.3, 0.8],
+                               [0.2, 0.1, 0.7, 0.4, 0.6]], "f")
+        match = np.asarray([[0, -1, -1, -1, -1],
+                            [1, 2, -1, -1, -1]], np.int32)
+        dist = np.zeros_like(cls_loss)
+        out = run_op("mine_hard_examples",
+                     {"ClsLoss": cls_loss, "MatchIndices": match,
+                      "MatchDist": dist},
+                     {"neg_pos_ratio": 2.0},
+                     {"NegIndices": 1, "UpdatedMatchIndices": 1})
+        # row 0: 1 pos -> 2 negs: the two highest-loss negatives (idx 1, 4)
+        np.testing.assert_array_equal(out["NegIndices"][0],
+                                      [0, 1, 0, 0, 1])
+        # row 1: 2 pos -> up to 4 negs: all 3 negatives selected
+        np.testing.assert_array_equal(out["NegIndices"][1],
+                                      [0, 0, 1, 1, 1])
+        np.testing.assert_array_equal(out["UpdatedMatchIndices"], match)
+
+    def _np_map(self, det, label, class_num, thresh=0.5,
+                ap_type="integral"):
+        """Greedy per-class mAP reference (detection_map_op.h semantics,
+        5-col labels, background 0)."""
+        aps = []
+        for c in range(1, class_num):
+            gt_idx = [i for i in range(len(label)) if label[i, 0] == c]
+            order = np.argsort(-det[:, 1])
+            used = set()
+            tps, fps = [], []
+            for d in order:
+                if det[d, 0] != c:
+                    continue
+                best, bj = 0.0, -1
+                for j in gt_idx:
+                    if j in used:
+                        continue
+                    a, b = det[d, 2:6], label[j, 1:5]
+                    ix = max(0, min(a[2], b[2]) - max(a[0], b[0]))
+                    iy = max(0, min(a[3], b[3]) - max(a[1], b[1]))
+                    inter = ix * iy
+                    u = ((a[2] - a[0]) * (a[3] - a[1])
+                         + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+                    v = inter / max(u, 1e-10)
+                    if v > best:
+                        best, bj = v, j
+                if best >= thresh:
+                    used.add(bj)
+                    tps.append(1.0)
+                    fps.append(0.0)
+                else:
+                    tps.append(0.0)
+                    fps.append(1.0)
+            npos = len(gt_idx)
+            if npos == 0:
+                continue
+            ctp = np.cumsum(tps) if tps else np.zeros(1)
+            cfp = np.cumsum(fps) if fps else np.zeros(1)
+            recall = ctp / npos
+            prec = ctp / np.maximum(ctp + cfp, 1e-10)
+            prev = np.concatenate([[0.0], recall[:-1]])
+            aps.append(np.sum((recall - prev) * prec))
+        return np.mean(aps) if aps else 0.0
+
+    def test_detection_map_perfect(self):
+        det = np.asarray([[1, 0.9, 0.1, 0.1, 0.4, 0.4],
+                          [2, 0.8, 0.5, 0.5, 0.9, 0.9]], "f")
+        label = np.asarray([[1, 0.1, 0.1, 0.4, 0.4],
+                            [2, 0.5, 0.5, 0.9, 0.9]], "f")
+        out = run_op("detection_map", {"DetectRes": det, "Label": label},
+                     {"class_num": 3}, {"MAP": 1})
+        np.testing.assert_allclose(out["MAP"], [1.0], atol=1e-6)
+
+    def test_detection_map_greedy_dedup(self):
+        """Two detections on one gt: only the higher-scoring one is TP
+        (greedy per-gt dedup, unlike independent matching)."""
+        det = np.asarray([[1, 0.9, 0.1, 0.1, 0.4, 0.4],
+                          [1, 0.8, 0.12, 0.1, 0.42, 0.4],
+                          [1, 0.7, 0.5, 0.5, 0.9, 0.9]], "f")
+        label = np.asarray([[1, 0.1, 0.1, 0.4, 0.4],
+                            [1, 0.5, 0.5, 0.9, 0.9]], "f")
+        out = run_op("detection_map", {"DetectRes": det, "Label": label},
+                     {"class_num": 2}, {"MAP": 1})
+        want = self._np_map(det, label, 2)
+        np.testing.assert_allclose(out["MAP"], [want], rtol=1e-5)
+
+    def test_detection_map_multiclass_vs_numpy(self):
+        rng = np.random.RandomState(21)
+        n_det, n_gt, n_cls = 12, 6, 4
+        boxes = rng.uniform(0, 1, (n_det, 2, 2))
+        det = np.zeros((n_det, 6), "f")
+        det[:, 0] = rng.randint(1, n_cls, n_det)
+        det[:, 1] = rng.uniform(0.1, 1.0, n_det)
+        det[:, 2:4] = boxes.min(1)
+        det[:, 4:6] = boxes.min(1) + rng.uniform(0.1, 0.5, (n_det, 2))
+        gb = rng.uniform(0, 1, (n_gt, 2, 2))
+        label = np.zeros((n_gt, 5), "f")
+        label[:, 0] = rng.randint(1, n_cls, n_gt)
+        label[:, 1:3] = gb.min(1)
+        label[:, 3:5] = gb.min(1) + rng.uniform(0.1, 0.5, (n_gt, 2))
+        # overlap some detections exactly with gts so TPs exist
+        det[:n_gt, 2:6] = label[:, 1:5]
+        det[:n_gt, 0] = label[:, 0]
+        out = run_op("detection_map", {"DetectRes": det, "Label": label},
+                     {"class_num": n_cls}, {"MAP": 1})
+        want = self._np_map(det, label, n_cls)
+        np.testing.assert_allclose(out["MAP"], [want], rtol=1e-5)
+
+    def test_multiclass_nms2_suppression(self):
+        # 3 boxes: two heavily overlapping (one suppressed), one distinct
+        bboxes = np.asarray([[[0.1, 0.1, 0.4, 0.4],
+                              [0.11, 0.1, 0.41, 0.4],
+                              [0.6, 0.6, 0.9, 0.9]]], "f")
+        scores = np.asarray([[[0.0, 0.0, 0.0],
+                              [0.9, 0.8, 0.7]]], "f")  # class 1 scores
+        out = run_op("multiclass_nms2",
+                     {"BBoxes": bboxes, "Scores": scores},
+                     {"background_label": 0, "score_threshold": 0.1,
+                      "nms_threshold": 0.5, "keep_top_k": 8,
+                      "nms_top_k": 8}, {"Out": 1, "Index": 1})
+        res = np.asarray(out["Out"]).reshape(-1, 6)
+        kept = res[res[:, 0] >= 0]  # drop class=-1 padding rows
+        assert kept.shape[0] == 2
+        # the two kept boxes are the 0.9 and the 0.7 ones
+        np.testing.assert_allclose(sorted(kept[:, 1], reverse=True),
+                                   [0.9, 0.7], atol=1e-6)
+        assert out["Index"].shape[-1] == 1
+
+
+# -- sequence tail -----------------------------------------------------------
+
+
+class TestSequenceTail:
+    def test_sequence_reshape(self):
+        rng = np.random.RandomState(22)
+        x = rng.uniform(-1, 1, (2, 4, 6)).astype("f")
+        out = run_op("sequence_reshape", {"X": x}, {"new_dim": 8},
+                     {"Out": 1})
+        np.testing.assert_allclose(out["Out"], x.reshape(2, 3, 8))
+
+    def test_sequence_scatter(self):
+        x = np.zeros((2, 6), "f")
+        ids = np.asarray([[1, 3, 1], [0, 5, 2]], np.int32)
+        upd = np.asarray([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]], "f")
+        out = run_op("sequence_scatter",
+                     {"X": x, "Ids": ids, "Updates": upd}, {}, {"Out": 1})
+        want = np.zeros((2, 6), "f")
+        for b in range(2):
+            for t in range(3):
+                want[b, ids[b, t]] += upd[b, t]
+        np.testing.assert_allclose(out["Out"], want)
+
+    def test_sequence_topk_avg_pooling(self):
+        rng = np.random.RandomState(23)
+        B, C, L = 2, 3, 7
+        x = rng.uniform(-1, 1, (B, C, L)).astype("f")
+        out = run_op("sequence_topk_avg_pooling", {"X": x},
+                     {"topks": [1, 3], "channel_num": C}, {"Out": 1})
+        srt = np.sort(x.reshape(B, C, L), axis=-1)[..., ::-1]
+        want = np.stack([srt[..., :1].mean(-1), srt[..., :3].mean(-1)],
+                        axis=-1).reshape(B, -1)
+        np.testing.assert_allclose(out["Out"], want, rtol=1e-5, atol=1e-6)
+
+    def test_match_matrix_tensor(self):
+        rng = np.random.RandomState(24)
+        B, Tx, Ty, D1, D2, dim_t = 2, 3, 4, 5, 6, 2
+        x = rng.uniform(-1, 1, (B, Tx, D1)).astype("f")
+        y = rng.uniform(-1, 1, (B, Ty, D2)).astype("f")
+        w = rng.uniform(-0.5, 0.5, (D1, dim_t, D2)).astype("f")
+        out = run_op("match_matrix_tensor",
+                     {"X": x, "Y": y, "W": w.reshape(D1, -1)},
+                     {"dim_t": dim_t}, {"Out": 1, "Tmp": 1})
+        want = np.einsum("bid,dte,bje->btij", x, w, y).reshape(B, -1)
+        np.testing.assert_allclose(out["Out"], want, rtol=1e-4, atol=1e-5)
+
+    def test_merge_lod_tensor_infer(self):
+        """merge_lod_tensor_infer: inference variant of the IfElse merge —
+        rows routed by mask (split_lod_tensor_op.cc counterpart)."""
+        rng = np.random.RandomState(30)
+        t = rng.uniform(-1, 1, (4, 3)).astype("f")
+        f = rng.uniform(-1, 1, (4, 3)).astype("f")
+        mask = np.asarray([[1], [0], [1], [0]], np.int32)
+        out = run_op("merge_lod_tensor_infer",
+                     {"Mask": mask, "InTrue": t, "InFalse": f},
+                     {"level": 0}, {"Out": 1})["Out"]
+        want = np.where(mask.astype(bool), t, f)
+        np.testing.assert_allclose(out, want)
+
+    def test_lod_reset_passthrough_and_max_sequence_len(self):
+        rng = np.random.RandomState(25)
+        x = rng.uniform(-1, 1, (3, 4)).astype("f")
+        out = run_op("lod_reset", {"X": x}, {"target_lod": [0, 2, 3]},
+                     {"Out": 1})
+        np.testing.assert_allclose(out["Out"], x)
+        lens = np.asarray([2, 5, 3], np.int64)
+        xs = np.zeros((3, 6, 2), "f")
+        table = run_op("lod_rank_table", {"X": xs, "Length": lens}, {},
+                       {"Out": 1})["Out"]
+        msl = run_op("max_sequence_len", {"RankTable": table}, {},
+                     {"Out": 1})["Out"]
+        assert int(msl) == 5
+
+
+# -- PS / selected-rows helpers ----------------------------------------------
+
+
+class TestPSHelpers:
+    def test_split_ids_merge_ids_roundtrip(self):
+        ids = np.asarray([3, 7, 2, 8, 5, 0], np.int64)
+        n = 2
+        split = run_op("split_ids", {"Ids": [("ids", ids)]}, {},
+                       {"Out": n})["Out"]
+        # shard k owns ids with id % n == k; others marked -1
+        for k in range(n):
+            mine = ids[ids % n == k]
+            got = split[k][split[k] >= 0]
+            assert set(got.tolist()) == set(mine.tolist())
+        # merge back: each shard's table rows keyed by its Rows list
+        V, D = 10, 4
+        table = np.arange(V * D, dtype=np.float32).reshape(V, D)
+        rows = [np.where(split[k] >= 0, split[k], 0).astype(np.int64)
+                for k in range(n)]
+        xs = [table[rows[k]] for k in range(n)]
+        merged = run_op(
+            "merge_ids",
+            {"Ids": [("mi", ids)],
+             "Rows": [("r0", rows[0]), ("r1", rows[1])],
+             "X": [("x0", xs[0]), ("x1", xs[1])]}, {},
+            {"Out": 1})["Out"]
+        np.testing.assert_allclose(merged, table[ids])
+
+    def test_split_byref(self):
+        rng = np.random.RandomState(26)
+        x = rng.uniform(-1, 1, (7, 3)).astype("f")
+        out = run_op("split_byref", {"X": x}, {"sections": [3, 4]},
+                     {"Out": 2})["Out"]
+        np.testing.assert_allclose(out[0], x[:3])
+        np.testing.assert_allclose(out[1], x[3:])
+
+    def test_lookup_sparse_table(self):
+        rng = np.random.RandomState(27)
+        w = rng.uniform(-1, 1, (9, 4)).astype("f")
+        ids = np.asarray([[1, 3], [8, 0]], np.int64)
+        out = run_op("lookup_sparse_table", {"W": w, "Ids": ids}, {},
+                     {"Out": 1})["Out"]
+        np.testing.assert_allclose(out, w[ids])
+
+    def test_merge_and_split_selected_rows(self):
+        rng = np.random.RandomState(28)
+        x = rng.uniform(-1, 1, (6, 3)).astype("f")
+        merged = run_op("merge_selected_rows", {"X": x}, {},
+                        {"Out": 1})["Out"]
+        np.testing.assert_allclose(merged, x)
+        parts = run_op("split_selected_rows", {"X": x},
+                       {"height_sections": [2, 4]}, {"Out": 2})["Out"]
+        np.testing.assert_allclose(parts[0], x[:2])
+        np.testing.assert_allclose(parts[1], x[2:])
+
+
+# -- singletons --------------------------------------------------------------
+
+
+class TestTailSingletons:
+    def test_average_accumulates_no_roll(self):
+        p = np.ones((2, 2), "f")
+        s1 = np.full((2, 2), 3.0, "f")
+        s2 = np.zeros((2, 2), "f")
+        s3 = np.zeros((2, 2), "f")
+        na = np.asarray([2], np.int64)
+        ona = np.asarray([0], np.int64)
+        nu = np.asarray([2], np.int64)
+        out = run_op("average_accumulates",
+                     {"param": p, "in_sum_1": s1, "in_sum_2": s2,
+                      "in_sum_3": s3, "in_num_accumulates": na,
+                      "in_old_num_accumulates": ona, "in_num_updates": nu},
+                     {"average_window": 0.0, "max_average_window": 100,
+                      "min_average_window": 10},
+                     {"out_sum_1": 1, "out_num_accumulates": 1,
+                      "out_num_updates": 1})
+        # below min window: accumulate param into sum_1, counters advance
+        np.testing.assert_allclose(out["out_sum_1"], s1 + p)
+        assert int(out["out_num_accumulates"]) == 3
+        assert int(out["out_num_updates"]) == 3
+
+    def test_average_accumulates_roll(self):
+        p = np.ones((2,), "f")
+        s1 = np.full((2,), 5.0, "f")
+        s2 = np.full((2,), 7.0, "f")
+        s3 = np.zeros((2,), "f")
+        na = np.asarray([9], np.int64)
+        ona = np.asarray([0], np.int64)
+        nu = np.asarray([9], np.int64)
+        out = run_op("average_accumulates",
+                     {"param": p, "in_sum_1": s1, "in_sum_2": s2,
+                      "in_sum_3": s3, "in_num_accumulates": na,
+                      "in_old_num_accumulates": ona, "in_num_updates": nu},
+                     {"average_window": 0.0, "max_average_window": 5,
+                      "min_average_window": 5},
+                     {"out_sum_1": 1, "out_sum_2": 1, "out_sum_3": 1,
+                      "out_num_accumulates": 1,
+                      "out_old_num_accumulates": 1})
+        # window full: sum_1 -> sum_2, sum_2 -> sum_3, sum_1 resets
+        np.testing.assert_allclose(out["out_sum_1"], np.zeros(2))
+        np.testing.assert_allclose(out["out_sum_2"], s1 + p)
+        np.testing.assert_allclose(out["out_sum_3"], s2)
+        assert int(out["out_num_accumulates"]) == 0
+        assert int(out["out_old_num_accumulates"]) == 10
+
+    def test_depthwise_conv2d_transpose_vs_per_channel(self):
+        rng = np.random.RandomState(29)
+        C = 3
+        x = rng.uniform(-1, 1, (2, C, 5, 5)).astype("f")
+        w = rng.uniform(-0.5, 0.5, (C, 1, 3, 3)).astype("f")
+        fused = run_op("depthwise_conv2d_transpose",
+                       {"Input": x, "Filter": w},
+                       {"strides": [2, 2], "paddings": [1, 1],
+                        "groups": C}, {"Output": 1})["Output"]
+        chans = []
+        for c in range(C):
+            o = run_op("conv2d_transpose",
+                       {"Input": x[:, c:c + 1], "Filter": w[c:c + 1]},
+                       {"strides": [2, 2], "paddings": [1, 1]},
+                       {"Output": 1})["Output"]
+            chans.append(o)
+        want = np.concatenate(chans, axis=1)
+        np.testing.assert_allclose(fused, want, rtol=1e-5, atol=1e-5)
+
+    def test_gaussian_random_batch_size_like(self):
+        x = np.zeros((64, 3), "f")
+        out = run_op("gaussian_random_batch_size_like", {"Input": x},
+                     {"shape": [1, 256], "mean": 2.0, "std": 0.5},
+                     {"Out": 1})["Out"]
+        assert out.shape == (64, 256)
+        assert abs(out.mean() - 2.0) < 0.05
+        assert abs(out.std() - 0.5) < 0.05
+
+    def test_fill_zeros_like2(self):
+        x = np.ones((3, 4), "f")
+        out = run_op("fill_zeros_like2", {"X": x}, {}, {"Out": 1})["Out"]
+        np.testing.assert_array_equal(out, np.zeros((3, 4), "f"))
+        assert out.dtype == np.float32
+
+    def test_fill_and_size(self):
+        out = run_op("fill", {},
+                     {"value": [1.0, 2.0, 3.0, 4.0], "shape": [2, 2],
+                      "dtype": 5}, {"Out": 1})["Out"]
+        np.testing.assert_allclose(out, [[1.0, 2.0], [3.0, 4.0]])
+        assert out.dtype == np.float32
+        # int dtype round-trips (dtype 2 = int32)
+        outi = run_op("fill", {},
+                      {"value": [1, 2], "shape": [2], "dtype": 2},
+                      {"Out": 1})["Out"]
+        assert outi.dtype == np.int32
+        x = np.zeros((3, 5), "f")
+        n = run_op("size", {"Input": x}, {}, {"Out": 1})["Out"]
+        assert int(n) == 15
+        assert n.dtype in (np.int32, np.int64)
