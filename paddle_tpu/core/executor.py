@@ -418,14 +418,19 @@ class Executor:
                         and local.shape[0] % local_dev == 0:
                     spec = P(data_axis, *([None] * (local.ndim - 1)))
                 elif local.ndim >= 1 and data_axis and local.shape[0] > 1:
-                    # A replicated P() spec would require every process to
-                    # supply IDENTICAL data; each trainer feeds a distinct
-                    # local shard here, so falling back to replication
-                    # silently diverges per-device values. Fail loudly.
+                    # Reference contract (feed_and_split_tensor_into_local_
+                    # scopes): every multi-device feed is a batch split
+                    # across devices, and an indivisible batch is an error.
+                    # Replicating here instead would silently diverge
+                    # per-device values when trainers feed distinct shards.
+                    # Genuinely replicated constants should be shape
+                    # [1, ...] or pre-committed replicated jax.Arrays (the
+                    # is_fully_addressable path above).
                     raise ValueError(
-                        "multi-process feed '%s': local batch %d is not "
-                        "divisible by the %d local device(s); pad the batch "
-                        "or adjust batch size per trainer"
+                        "multi-process feed '%s': local leading dim %d is "
+                        "not divisible by the %d local device(s); pad the "
+                        "batch, or feed replicated constants with leading "
+                        "dim 1 / as pre-committed jax.Arrays"
                         % (n, local.shape[0], local_dev))
                 else:
                     # leading dim 1 (or scalar): broadcast-like feed (lr,

@@ -224,7 +224,10 @@ class AnalysisPredictor:
                 protected = set(self._feed_names) | {
                     v.name for v in self._fetch_vars}
                 for pname in ("delete_dropout_pass", "conv_bn_fuse_pass",
-                              "fc_fuse_pass", "repeated_fc_relu_fuse_pass"):
+                              "multihead_matmul_fuse_pass",
+                              "fc_fuse_pass", "repeated_fc_relu_fuse_pass",
+                              "seqpool_concat_fuse_pass",
+                              "fuse_elewise_add_act_pass"):
                     _ir.apply_pass(pname, self._program, self._scope,
                                    protected=protected)
         self._fetch_names = [v.name for v in self._fetch_vars]

@@ -338,6 +338,216 @@ class RepeatedFCReluFusePass(Pass):
             replaced[id(head)] = fused
             for o in chain[1:]:
                 replaced[id(o)] = None
-        block.ops = [replaced.get(id(op), op) for op in block.ops
-                     if replaced.get(id(op), op) is not None]
-        program._bump_version()
+        _commit_replacements(program, block, replaced)
+
+
+def _sole_consumer(consumers, name, protected):
+    """The single op reading `name`, or None if 0/many or protected."""
+    cons = consumers.get(name, [])
+    if len(cons) != 1 or name in protected:
+        return None
+    return cons[0]
+
+
+def _commit_replacements(program, block, replaced):
+    """Rewrite block.ops from a {id(op): new_op|None} map (None deletes;
+    missing keeps) and bump the program version.  Shared epilogue of the
+    fusion passes."""
+    if not replaced:
+        return
+    block.ops = [replaced.get(id(op), op) for op in block.ops
+                 if replaced.get(id(op), op) is not None]
+    program._bump_version()
+
+
+@register_pass("multihead_matmul_fuse_pass")
+class MultiheadMatmulFusePass(Pass):
+    """Rewrite composed scaled-dot-product attention into the fused
+    `flash_attention` op (the TPU-native analog of
+    ir/multihead_matmul_fuse_pass.cc constructing multihead_matmul_op.cu).
+
+    Pattern (the repo's own layer emission, models/bert.py and
+    nets.scaled_dot_product_attention):
+
+        matmul(Q, K, transpose_Y=True[, alpha])
+          -> [elementwise_add(scores, mask)]
+          -> softmax
+          -> [assign        # residue of delete_dropout_pass]
+          -> matmul(probs, V)
+
+    with Q/K/V rank-4 [B, H, S, D].  Replaced by one flash_attention op
+    (Pallas blockwise kernel above the measured seq cutoff, XLA-fused jnp
+    composition below it — either way >= the op-at-a-time composition).
+    alpha becomes the kernel scale; alpha == 1.0 passes scale=1.0 ("already
+    scaled", e.g. a separate upstream scale op) rather than the 1/sqrt(d)
+    default that scale=0.0 selects."""
+
+    def apply(self, program, scope):
+        from .framework import Operator
+
+        block = program.global_block()
+        consumers = _build_consumers(block)
+
+        def rank(name):
+            v = block._find_var_recursive(name)
+            return None if v is None or v.shape is None else len(v.shape)
+
+        matches = []
+        for op in block.ops:
+            if op.type != "matmul":
+                continue
+            if not op.attrs.get("transpose_Y") or op.attrs.get(
+                    "transpose_X"):
+                continue
+            q_name, k_name = op.input("X")[0], op.input("Y")[0]
+            if rank(q_name) != 4 or rank(k_name) != 4:
+                continue
+            chain = [op]
+            mask_name = None
+            cur = _sole_consumer(consumers, op.output("Out")[0],
+                                 self.protected)
+            if cur is not None and cur.type == "elementwise_add":
+                if cur.input("X")[0] != op.output("Out")[0]:
+                    continue  # scores must be the X side
+                if rank(cur.input("Y")[0]) != 4:
+                    continue  # kernel bias contract: [B, 1|H, Sq, Sk]
+                mask_name = cur.input("Y")[0]
+                chain.append(cur)
+                cur = _sole_consumer(consumers, cur.output("Out")[0],
+                                     self.protected)
+            if cur is None or cur.type != "softmax":
+                continue
+            ax = cur.attrs.get("axis", -1)
+            if ax not in (-1, 3):
+                continue
+            chain.append(cur)
+            cur = _sole_consumer(consumers, cur.output("Out")[0],
+                                 self.protected)
+            while cur is not None and cur.type == "assign":
+                chain.append(cur)
+                cur = _sole_consumer(consumers, cur.output("Out")[0],
+                                     self.protected)
+            if (cur is None or cur.type != "matmul"
+                    or cur.attrs.get("transpose_X")
+                    or cur.attrs.get("transpose_Y")
+                    or float(cur.attrs.get("alpha", 1.0)) != 1.0
+                    or cur.input("X")[0] != chain[-1].output("Out")[0]):
+                continue
+            v_name = cur.input("Y")[0]
+            if rank(v_name) != 4:
+                continue
+            chain.append(cur)
+            matches.append((chain, q_name, k_name, v_name, mask_name))
+
+        if not matches:
+            return
+        replaced = {}
+        for chain, q_name, k_name, v_name, mask_name in matches:
+            alpha = float(chain[0].attrs.get("alpha", 1.0))
+            inputs = {"Q": [q_name], "K": [k_name], "V": [v_name]}
+            if mask_name is not None:
+                inputs["BiasQK"] = [mask_name]
+            fused = Operator(
+                block, type="flash_attention", inputs=inputs,
+                outputs={"Out": [chain[-1].output("Out")[0]]},
+                attrs={"causal": False, "scale": alpha})
+            replaced[id(chain[0])] = fused
+            for o in chain[1:]:
+                replaced[id(o)] = None
+        _commit_replacements(program, block, replaced)
+
+
+@register_pass("fuse_elewise_add_act_pass")
+class FuseElewiseAddActPass(Pass):
+    """elementwise_add -> {relu,tanh,sigmoid} becomes one
+    fused_elemwise_activation op (ir/fuse_elewise_add_act_pass.cc)."""
+
+    ACTS = ("relu", "tanh", "sigmoid")
+
+    def apply(self, program, scope):
+        from .framework import Operator
+
+        block = program.global_block()
+        consumers = _build_consumers(block)
+        replaced = {}
+        for op in block.ops:
+            if op.type != "elementwise_add" or id(op) in replaced:
+                continue
+            nxt = _sole_consumer(consumers, op.output("Out")[0],
+                                 self.protected)
+            if nxt is None or nxt.type not in self.ACTS:
+                continue
+            if id(nxt) in replaced:
+                continue
+            fused = Operator(
+                block, type="fused_elemwise_activation",
+                inputs={"X": [op.input("X")[0]],
+                        "Y": [op.input("Y")[0]]},
+                outputs={"Out": [nxt.output("Out")[0]],
+                         "IntermediateOut": [op.output("Out")[0]]},
+                attrs={"functor_list": [nxt.type, "elementwise_add"],
+                       "axis": int(op.attrs.get("axis", -1)),
+                       "save_intermediate_out": True})
+            replaced[id(op)] = fused
+            replaced[id(nxt)] = None
+        _commit_replacements(program, block, replaced)
+
+
+@register_pass("seqpool_concat_fuse_pass")
+class SeqPoolConcatFusePass(Pass):
+    """N sequence_pool(pooltype) branches feeding one concat fuse into
+    fusion_seqpool_concat (ir/seqpool_concat_fuse_pass.cc)."""
+
+    POOLTYPES = ("SUM", "AVERAGE", "SQRT")
+
+    def apply(self, program, scope):
+        from .framework import Operator
+
+        block = program.global_block()
+        consumers = _build_consumers(block)
+        producers = {}
+        for op in block.ops:
+            for n in op.output_arg_names:
+                producers[n] = op
+        replaced = {}
+        for op in block.ops:
+            if op.type != "concat" or id(op) in replaced:
+                continue
+            if int(op.attrs.get("axis", 0)) not in (1, -1):
+                continue
+            branches = []
+            pooltype = None
+            ok = True
+            for n in op.input("X"):
+                prod = producers.get(n)
+                if (prod is None or prod.type != "sequence_pool"
+                        or id(prod) in replaced
+                        or prod.input("Length")
+                        or _sole_consumer(consumers, n,
+                                          self.protected) is not op):
+                    ok = False
+                    break
+                # pooled output must be rank-2 (input [B, T, D]) so the
+                # fused op's axis=-1 concat equals this concat's axis=1
+                xv = block._find_var_recursive(prod.input("X")[0])
+                if xv is None or xv.shape is None or len(xv.shape) != 3:
+                    ok = False
+                    break
+                pt = prod.attrs.get("pooltype", "AVERAGE").upper()
+                if pt not in self.POOLTYPES or (pooltype is not None
+                                                and pt != pooltype):
+                    ok = False
+                    break
+                pooltype = pt
+                branches.append(prod)
+            if not ok or len(branches) < 2:
+                continue
+            fused = Operator(
+                block, type="fusion_seqpool_concat",
+                inputs={"X": [b.input("X")[0] for b in branches]},
+                outputs={"Out": [op.output("Out")[0]]},
+                attrs={"pooltype": pooltype, "axis": 1})
+            replaced[id(op)] = fused
+            for b in branches:
+                replaced[id(b)] = None
+        _commit_replacements(program, block, replaced)

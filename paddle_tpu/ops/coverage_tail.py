@@ -1264,46 +1264,49 @@ def detection_map(ctx, det, label, has_state, pos_count, tp, fp,
 
     ious = jax.vmap(lambda d: jax.vmap(lambda g: iou(d, g))(gbox))(dbox)
     order = jnp.argsort(-scores)
-    aps, has_pos = [], []
-    for c in range(int(class_num)):
-        if c == background_label:
-            continue
-        gt_c = (gl == c) & ~gt_pad
-        count_gt = gt_c if evaluate_difficult else (gt_c & ~difficult)
-        npos = jnp.sum(count_gt.astype(jnp.float32))
-        det_c = (dl == c) & ~det_pad
 
-        def step(used, d):
-            cand = jnp.where(gt_c & ~used, ious[d], -1.0)
-            j = jnp.argmax(cand)
-            hit = det_c[d] & (cand[j] >= overlap_threshold)
-            if evaluate_difficult:
-                tp_d = hit
-            else:
-                # a match to a difficult gt is ignored: not TP, not FP
-                tp_d = hit & ~difficult[j]
-            fp_d = det_c[d] & ~hit
-            return used.at[j].set(used[j] | hit), (
-                tp_d.astype(jnp.float32), fp_d.astype(jnp.float32))
-
-        _, (tps, fps) = lax.scan(
-            step, jnp.zeros(label.shape[0], bool), order)
-        ctp = jnp.cumsum(tps)
-        cfp = jnp.cumsum(fps)
-        recall = ctp / jnp.maximum(npos, 1.0)
-        precision = ctp / jnp.maximum(ctp + cfp, 1e-10)
-        if ap_type == "11point":
-            pts = [jnp.max(jnp.where(recall >= t, precision, 0.0))
-                   for t in np.arange(0.0, 1.01, 0.1)]
-            ap = jnp.sum(jnp.stack(pts)) / 11.0
+    # One scan over score-sorted detections: matching is intra-class (the
+    # candidate set is the unmatched gts of the DETECTION's class), so a
+    # single class-agnostic pass yields every class's TP/FP stream at once
+    # — no per-class unroll (class_num=81 COCO configs trace one scan).
+    def step(used, d):
+        cand = jnp.where((gl == dl[d]) & ~gt_pad & ~used, ious[d], -1.0)
+        j = jnp.argmax(cand)
+        hit = (~det_pad[d]) & (cand[j] >= overlap_threshold)
+        if evaluate_difficult:
+            tp_d = hit
         else:
-            prev = jnp.concatenate([jnp.zeros(1), recall[:-1]])
-            ap = jnp.sum((recall - prev) * precision)
-        aps.append(ap)
-        has_pos.append((npos > 0).astype(jnp.float32))
-    aps_v = jnp.stack(aps) if aps else jnp.zeros(1)
-    w = jnp.stack(has_pos) if has_pos else jnp.zeros(1)
-    mean_ap = jnp.sum(aps_v * w) / jnp.maximum(jnp.sum(w), 1.0)
+            # a match to a difficult gt is ignored: not TP, not FP
+            tp_d = hit & ~difficult[j]
+        fp_d = (~det_pad[d]) & ~hit
+        return used.at[j].set(used[j] | hit), (
+            tp_d.astype(jnp.float32), fp_d.astype(jnp.float32))
+
+    _, (tps, fps) = lax.scan(step, jnp.zeros(label.shape[0], bool), order)
+    dl_sorted = dl[order]
+
+    classes = jnp.arange(int(class_num))
+    fg = classes != background_label                       # [C]
+    in_c = (dl_sorted[None, :] == classes[:, None])        # [C, N]
+    ctp = jnp.cumsum(tps[None, :] * in_c, axis=1)
+    cfp = jnp.cumsum(fps[None, :] * in_c, axis=1)
+    count_gt = ~gt_pad if evaluate_difficult else (~gt_pad & ~difficult)
+    npos = jnp.sum((gl[None, :] == classes[:, None])
+                   & count_gt[None, :], axis=1).astype(jnp.float32)  # [C]
+    recall = ctp / jnp.maximum(npos[:, None], 1.0)
+    precision = ctp / jnp.maximum(ctp + cfp, 1e-10)
+    if ap_type == "11point":
+        thresholds = jnp.asarray(np.arange(0.0, 1.01, 0.1), jnp.float32)
+        pts = jnp.max(
+            jnp.where(recall[:, None, :] >= thresholds[None, :, None],
+                      precision[:, None, :], 0.0), axis=2)  # [C, 11]
+        aps = jnp.sum(pts, axis=1) / 11.0
+    else:
+        prev = jnp.concatenate([jnp.zeros_like(recall[:, :1]),
+                                recall[:, :-1]], axis=1)
+        aps = jnp.sum((recall - prev) * precision, axis=1)
+    w = fg.astype(jnp.float32) * (npos > 0).astype(jnp.float32)
+    mean_ap = jnp.sum(aps * w) / jnp.maximum(jnp.sum(w), 1.0)
     z = jnp.zeros((1,), jnp.float32)
     return z, z, z, mean_ap.reshape(1)
 

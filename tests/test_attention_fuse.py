@@ -1,0 +1,255 @@
+"""Fusion passes that CONSTRUCT the registered fusion ops (round-2 verdict
+item 3): multihead_matmul_fuse_pass (composed attention ->
+flash_attention), seqpool_concat_fuse_pass, fuse_elewise_add_act_pass —
+plus the end-to-end predictor check that a saved BERT-style model engages
+the fused attention path with unchanged outputs.  Reference analogs:
+ir/multihead_matmul_fuse_pass.cc, seqpool_concat_fuse_pass.cc,
+fuse_elewise_add_act_pass.cc."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import ir
+
+
+def _run(main, startup, fetch, scope, feed):
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        res = exe.run(main, feed=feed, fetch_list=[fetch])
+    return np.asarray(res[0])
+
+
+def _build_attention(mask=True, heads=2, seq=8, d=4):
+    """Composed attention exactly as models/bert.py emits it in dropout
+    mode (minus the dropout, which delete_dropout_pass strips)."""
+    B = 2
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        q = fluid.layers.data("q", shape=[heads, seq, d],
+                              append_batch_size=True)
+        k = fluid.layers.data("k", shape=[heads, seq, d],
+                              append_batch_size=True)
+        v = fluid.layers.data("v", shape=[heads, seq, d],
+                              append_batch_size=True)
+        inputs = {"q": None, "k": None, "v": None}
+        scores = fluid.layers.matmul(q, k, transpose_y=True,
+                                     alpha=d ** -0.5)
+        if mask:
+            m = fluid.layers.data("m", shape=[1, seq, seq],
+                                  append_batch_size=True)
+            scores = fluid.layers.elementwise_add(scores, m)
+        probs = fluid.layers.softmax(scores)
+        out = fluid.layers.matmul(probs, v)
+    return main, startup, out, B
+
+
+class TestMultiheadMatmulFusePass:
+    @pytest.mark.parametrize("mask", [False, True])
+    def test_fuses_and_matches(self, mask):
+        heads, seq, d = 2, 8, 4
+        main, startup, out, B = _build_attention(mask, heads, seq, d)
+        rng = np.random.RandomState(0)
+        feed = {n: rng.uniform(-1, 1, (B, heads, seq, d)).astype("f")
+                for n in ("q", "k", "v")}
+        if mask:
+            feed["m"] = rng.uniform(-0.5, 0, (B, 1, seq, seq)).astype("f")
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+        want = _run(main, startup, out, scope, feed)
+        ir.apply_pass("multihead_matmul_fuse_pass", main, scope,
+                      protected={out.name})
+        types = [op.type for op in main.global_block().ops]
+        assert "flash_attention" in types
+        assert "softmax" not in types
+        assert "matmul" not in types
+        got = _run(main, startup, out, scope, feed)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_protected_scores_not_fused(self):
+        """If the intermediate scores are a fetch target the chain must
+        survive."""
+        main, startup, out, B = _build_attention(False)
+        scores_name = None
+        for op in main.global_block().ops:
+            if op.type == "softmax":
+                scores_name = op.input("X")[0]
+        scope = fluid.Scope()
+        ir.apply_pass("multihead_matmul_fuse_pass", main, scope,
+                      protected={out.name, scores_name})
+        types = [op.type for op in main.global_block().ops]
+        assert "flash_attention" not in types
+
+    def test_survives_delete_dropout_assign(self):
+        """After delete_dropout_pass an assign sits between softmax and
+        the context matmul — the pattern must follow it."""
+        heads, seq, d = 2, 8, 4
+        B = 2
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            q = fluid.layers.data("q", shape=[heads, seq, d])
+            k = fluid.layers.data("k", shape=[heads, seq, d])
+            v = fluid.layers.data("v", shape=[heads, seq, d])
+            scores = fluid.layers.matmul(q, k, transpose_y=True,
+                                         alpha=d ** -0.5)
+            probs = fluid.layers.softmax(scores)
+            probs = fluid.layers.dropout(
+                probs, 0.1, is_test=True,
+                dropout_implementation="upscale_in_train")
+            out = fluid.layers.matmul(probs, v)
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+        rng = np.random.RandomState(1)
+        feed = {n: rng.uniform(-1, 1, (B, heads, seq, d)).astype("f")
+                for n in ("q", "k", "v")}
+        want = _run(main, startup, out, scope, feed)
+        ir.apply_pass("delete_dropout_pass", main, scope)
+        ir.apply_pass("multihead_matmul_fuse_pass", main, scope,
+                      protected={out.name})
+        types = [op.type for op in main.global_block().ops]
+        assert "flash_attention" in types
+        got = _run(main, startup, out, scope, feed)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+class TestFuseElewiseAddAct:
+    def test_fuses_and_matches(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[6])
+            y = fluid.layers.data("y", shape=[6])
+            out = fluid.layers.relu(fluid.layers.elementwise_add(x, y))
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+        rng = np.random.RandomState(2)
+        feed = {"x": rng.uniform(-1, 1, (3, 6)).astype("f"),
+                "y": rng.uniform(-1, 1, (3, 6)).astype("f")}
+        want = _run(main, startup, out, scope, feed)
+        ir.apply_pass("fuse_elewise_add_act_pass", main, scope,
+                      protected={out.name})
+        types = [op.type for op in main.global_block().ops]
+        assert "fused_elemwise_activation" in types
+        assert "elementwise_add" not in types and "relu" not in types
+        got = _run(main, startup, out, scope, feed)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_multi_consumer_add_not_fused(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[4])
+            y = fluid.layers.data("y", shape=[4])
+            s = fluid.layers.elementwise_add(x, y)
+            a = fluid.layers.relu(s)
+            b = fluid.layers.tanh(s)  # second consumer of the add
+            out = fluid.layers.elementwise_add(a, b)
+        scope = fluid.Scope()
+        ir.apply_pass("fuse_elewise_add_act_pass", main, scope,
+                      protected={out.name})
+        types = [op.type for op in main.global_block().ops]
+        assert "fused_elemwise_activation" not in types
+
+
+class TestSeqPoolConcatFuse:
+    def test_fuses_and_matches(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            a = fluid.layers.data("a", shape=[5, 3])
+            b = fluid.layers.data("b", shape=[5, 2])
+            pa = fluid.layers.sequence_pool(a, "sum")
+            pb = fluid.layers.sequence_pool(b, "sum")
+            out = fluid.layers.concat([pa, pb], axis=1)
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+        rng = np.random.RandomState(3)
+        feed = {"a": rng.uniform(-1, 1, (2, 5, 3)).astype("f"),
+                "b": rng.uniform(-1, 1, (2, 5, 2)).astype("f")}
+        want = _run(main, startup, out, scope, feed)
+        ir.apply_pass("seqpool_concat_fuse_pass", main, scope,
+                      protected={out.name})
+        types = [op.type for op in main.global_block().ops]
+        assert "fusion_seqpool_concat" in types
+        assert "sequence_pool" not in types and "concat" not in types
+        got = _run(main, startup, out, scope, feed)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_mixed_pooltypes_not_fused(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            a = fluid.layers.data("a", shape=[5, 3])
+            b = fluid.layers.data("b", shape=[5, 2])
+            pa = fluid.layers.sequence_pool(a, "sum")
+            pb = fluid.layers.sequence_pool(b, "max")
+            out = fluid.layers.concat([pa, pb], axis=1)
+        scope = fluid.Scope()
+        ir.apply_pass("seqpool_concat_fuse_pass", main, scope,
+                      protected={out.name})
+        types = [op.type for op in main.global_block().ops]
+        assert "fusion_seqpool_concat" not in types
+
+
+class TestPredictorEngagesFusedAttention:
+    def test_saved_bert_style_model(self, tmp_path):
+        """End-to-end (verdict item 3 done-criterion): save a BERT-style
+        composed-attention model, load through AnalysisPredictor, assert
+        the optimized program contains flash_attention and the outputs
+        match the unoptimized path."""
+        from paddle_tpu.inference import (AnalysisConfig, PaddleTensor,
+                                          create_paddle_predictor)
+
+        heads, seq, d = 2, 8, 4
+        h = heads * d
+        B = 2
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[seq, h])
+            q = fluid.layers.fc(x, h, num_flatten_dims=2)
+            k = fluid.layers.fc(x, h, num_flatten_dims=2)
+            v = fluid.layers.fc(x, h, num_flatten_dims=2)
+
+            def split(t):
+                t = fluid.layers.reshape(t, [0, 0, heads, d])
+                return fluid.layers.transpose(t, [0, 2, 1, 3])
+
+            qh, kh, vh = split(q), split(k), split(v)
+            scores = fluid.layers.matmul(qh, kh, transpose_y=True,
+                                         alpha=d ** -0.5)
+            probs = fluid.layers.softmax(scores)
+            probs = fluid.layers.dropout(
+                probs, 0.1, is_test=True,
+                dropout_implementation="upscale_in_train")
+            ctxv = fluid.layers.matmul(probs, vh)
+            ctxv = fluid.layers.transpose(ctxv, [0, 2, 1, 3])
+            ctxv = fluid.layers.reshape(ctxv, [0, 0, h])
+            out = fluid.layers.fc(ctxv, h, num_flatten_dims=2)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        dirname = str(tmp_path / "bert_style")
+        rng = np.random.RandomState(4)
+        xv = rng.uniform(-1, 1, (B, seq, h)).astype("f")
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            want, = exe.run(main, feed={"x": xv}, fetch_list=[out])
+            fluid.io.save_inference_model(dirname, ["x"], [out], exe,
+                                          main_program=main)
+
+        cfg = AnalysisConfig(dirname)
+        cfg.disable_gpu()
+        assert cfg.ir_optim()
+        pred = create_paddle_predictor(cfg)
+        types = [op.type for op in pred._program.global_block().ops]
+        assert "flash_attention" in types, types
+        assert "softmax" not in types
+        outs = pred.run([PaddleTensor(xv, name="x")])
+        np.testing.assert_allclose(outs[0].as_ndarray(),
+                                   np.asarray(want), rtol=1e-4,
+                                   atol=1e-5)
