@@ -787,9 +787,13 @@ class PipelineOptimizer:
     (PipelineTrainer).
 
     TPU note: within one process a single chip serializes device sections;
-    the win is overlapping host (parse/embedding/CPU math) stages with the
-    compiled XLA stage.  Multi-chip GPipe-style stage sharding over a mesh
-    is the transpiler-level roadmap item, not this class.
+    this class's win is overlapping host (parse/embedding/CPU math) stages
+    with the compiled XLA stage.  Multi-chip GPipe-style stage sharding
+    over a mesh axis is `paddle_tpu.parallel.make_pipeline_step`
+    (parallel/pipeline.py): stage-sharded params, ppermute activation
+    handoffs, jax.grad through the skewed microbatch schedule — the
+    reference's distinct-device section placement
+    (pipeline_trainer.cc:24), done the SPMD way.
     """
 
     def __init__(self, optimizer, cut_list=None, place_list=None,

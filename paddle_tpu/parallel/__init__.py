@@ -17,3 +17,10 @@ from .ring_attention import (  # noqa: F401
     ulysses_attention,
     make_ring_attention_sharded,
 )
+
+from .pipeline import (  # noqa: F401
+    gpipe_spmd,
+    make_pipeline_step,
+    reference_step,
+    stack_stage_params,
+)

@@ -1,0 +1,141 @@
+"""Mesh pipeline parallelism (parallel/pipeline.py): GPipe over a `pp`
+mesh axis with stage-sharded parameters and ppermute activation handoffs.
+The round-2 verdict's last §2.5 gap — stages must live on DISJOINT
+devices, with loss/grad parity vs the single-device sequential program
+(reference analog: pipeline_trainer.cc places sections on distinct
+devices)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from paddle_tpu.parallel import (make_pipeline_step, reference_step,
+                                 stack_stage_params)
+
+
+def _mlp_setup(S, D=16, seed=0):
+    rng = np.random.RandomState(seed)
+    per_stage = [{"w": rng.randn(D, D).astype("f") * 0.3,
+                  "b": rng.randn(D).astype("f") * 0.1} for _ in range(S)]
+
+    def stage_fn(p, h):
+        return jnp.tanh(h @ p["w"] + p["b"])
+
+    def loss_fn(outs, lab):
+        return jnp.mean((outs - lab) ** 2)
+
+    return per_stage, stage_fn, loss_fn
+
+
+@pytest.mark.parametrize("S,n_micro", [(2, 4), (4, 8), (8, 8)])
+def test_loss_and_grad_parity(S, n_micro):
+    B, D = 32, 16
+    per_stage, stage_fn, loss_fn = _mlp_setup(S, D)
+    rng = np.random.RandomState(1)
+    x = rng.randn(B, D).astype("f")
+    labels = rng.randn(B, D).astype("f")
+    mesh = Mesh(np.array(jax.devices()[:S]), ("pp",))
+    stacked = stack_stage_params(per_stage, mesh, "pp")
+    step = make_pipeline_step(stage_fn, loss_fn, mesh, n_micro, "pp")
+    loss, grads = step(stacked, x, labels)
+    ref_loss, ref_grads = reference_step(stage_fn, loss_fn, per_stage, x,
+                                         labels, n_micro)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    for n in ("w", "b"):
+        want = np.stack([np.asarray(g[n]) for g in ref_grads])
+        np.testing.assert_allclose(np.asarray(grads[n]), want, rtol=1e-4,
+                                   atol=1e-5)
+
+
+def test_stages_on_disjoint_devices():
+    """Each pipe rank must hold ONLY its own stage's weights (true stage
+    sharding, not replication)."""
+    S = 4
+    per_stage, _, _ = _mlp_setup(S)
+    mesh = Mesh(np.array(jax.devices()[:S]), ("pp",))
+    stacked = stack_stage_params(per_stage, mesh, "pp")
+    w = stacked["w"]
+    assert len(w.sharding.device_set) == S
+    shard_devices = set()
+    for shard in w.addressable_shards:
+        # one stage slice per device, no overlap
+        assert shard.data.shape[0] == 1
+        assert shard.device not in shard_devices
+        shard_devices.add(shard.device)
+        np.testing.assert_allclose(
+            np.asarray(shard.data[0]),
+            per_stage[shard.index[0].start]["w"], rtol=1e-6)
+    assert len(shard_devices) == S
+
+
+def test_training_convergence_with_optimizer():
+    """A few pipelined SGD steps must track the sequential program's
+    parameter trajectory."""
+    S, n_micro, B, D = 4, 4, 16, 8
+    per_stage, stage_fn, loss_fn = _mlp_setup(S, D, seed=2)
+    rng = np.random.RandomState(3)
+    x = rng.randn(B, D).astype("f")
+    labels = np.tanh(rng.randn(B, D)).astype("f")
+    mesh = Mesh(np.array(jax.devices()[:S]), ("pp",))
+    stacked = stack_stage_params(per_stage, mesh, "pp")
+    lr = 0.1
+    step = make_pipeline_step(stage_fn, loss_fn, mesh, n_micro, "pp",
+                              optimizer=lambda p, g: p - lr * g)
+    losses = []
+    for _ in range(5):
+        loss, stacked = step(stacked, x, labels)
+        losses.append(float(loss))
+    # sequential oracle
+    ref = [dict(p) for p in per_stage]
+    ref_losses = []
+    for _ in range(5):
+        l, grads = reference_step(stage_fn, loss_fn, ref, x, labels,
+                                  n_micro)
+        ref_losses.append(float(l))
+        ref = [{n: p[n] - lr * g[n] for n in p}
+               for p, g in zip(ref, grads)]
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-4)
+    assert losses[-1] < losses[0]  # actually learning
+
+
+def test_embed_fn_outside_pipeline():
+    """embed_fn runs before the pipelined stages (the replicated
+    embedding/head pattern)."""
+    S, n_micro, B, V, D = 2, 2, 8, 12, 6
+    rng = np.random.RandomState(4)
+    emb = jnp.asarray(rng.randn(V, D).astype("f"))
+    per_stage = [{"w": rng.randn(D, D).astype("f") * 0.3,
+                  "b": np.zeros(D, "f")} for _ in range(S)]
+
+    def stage_fn(p, h):
+        return jnp.tanh(h @ p["w"] + p["b"])
+
+    def loss_fn(outs, lab):
+        return jnp.mean((outs - lab) ** 2)
+
+    def embed_fn(ids):
+        return emb[ids]
+
+    ids = rng.randint(0, V, (B,)).astype(np.int32)
+    labels = rng.randn(B, D).astype("f")
+    mesh = Mesh(np.array(jax.devices()[:S]), ("pp",))
+    stacked = stack_stage_params(per_stage, mesh, "pp")
+    step = make_pipeline_step(stage_fn, loss_fn, mesh, n_micro, "pp",
+                              embed_fn=embed_fn)
+    loss, _ = step(stacked, ids, labels)
+    ref_loss, _ = reference_step(stage_fn, loss_fn, per_stage, ids,
+                                 labels, n_micro, embed_fn=embed_fn)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+
+
+def test_batch_not_divisible_raises():
+    S = 2
+    per_stage, stage_fn, loss_fn = _mlp_setup(S)
+    mesh = Mesh(np.array(jax.devices()[:S]), ("pp",))
+    stacked = stack_stage_params(per_stage, mesh, "pp")
+    step = make_pipeline_step(stage_fn, loss_fn, mesh, 3, "pp")
+    with pytest.raises(ValueError, match="not divisible"):
+        step(stacked, np.zeros((8, 16), "f"), np.zeros((8, 16), "f"))
