@@ -1,0 +1,92 @@
+"""Decoupled weight decay as an optimizer mixin (parity:
+contrib/extend_optimizer/extend_optimizer_with_weight_decay.py:34-152).
+
+`extend_with_decoupled_weight_decay(Adam)` returns an AdamW-style class:
+after the base optimizer's update, each decayed parameter is additionally
+shifted by ``-coeff * parameter_before_update`` (arXiv:1711.05101) via ops
+appended to the program, so the decay runs inside the same compiled step.
+"""
+
+from ... import optimizer as _optimizer
+from ...framework import Variable, name_scope
+
+__all__ = ["extend_with_decoupled_weight_decay"]
+
+
+class DecoupledWeightDecay(object):
+    def __init__(self, coeff=0.0, apply_decay_param_fun=None, **kwargs):
+        if not isinstance(coeff, (float, Variable)):
+            raise TypeError("coeff should be float or Variable.")
+        self._params_name = set()
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._coeff = coeff
+        super(DecoupledWeightDecay, self).__init__(**kwargs)
+
+    def _scale_parameters(self, params_and_grads):
+        """Capture param * coeff BEFORE the optimizer update ops run."""
+        if isinstance(self._coeff, float) and self._coeff == 0.0:
+            return []
+        from ... import layers
+
+        scaled_params = []
+        for param, grad in params_and_grads:
+            if grad is None:
+                continue
+            if self._apply_decay_param_fun is not None \
+                    and not self._apply_decay_param_fun(param.name):
+                continue
+            if param.name in self._params_name:
+                raise RuntimeError(
+                    "parameter %r decayed twice" % param.name)
+            with name_scope("weight_decay"):
+                scaled_params.append(
+                    (param, grad, param * self._coeff))
+            self._params_name.add(param.name)
+        return scaled_params
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from ... import layers
+
+        params_grads = self.backward(
+            loss=loss, startup_program=startup_program,
+            parameter_list=parameter_list, no_grad_set=no_grad_set)
+        scaled_params = self._scale_parameters(params_grads)
+        optimize_ops = self.apply_optimize(
+            loss=loss, params_grads=params_grads,
+            startup_program=startup_program)
+        # post-update decoupled decay: p = p_updated - coeff * p_before
+        for param, grad, scaled in scaled_params:
+            with name_scope("weight_decay"):
+                updated = layers.elementwise_sub(x=param, y=scaled)
+                layers.assign(input=updated, output=param)
+        return optimize_ops, params_grads
+
+    def __str__(self):
+        return " ".join(["Weight Decay, params:",
+                         ",".join(self._params_name)])
+
+
+def extend_with_decoupled_weight_decay(base_optimizer):
+    """Class decorator: returns `base_optimizer` with decoupled weight
+    decay (new_parameter = optimized_parameter - coeff * old_parameter).
+
+    Example::
+
+        AdamW = fluid.contrib.extend_with_decoupled_weight_decay(
+            fluid.optimizer.Adam)
+        AdamW(learning_rate=0.1, weight_decay=0.01).minimize(cost)
+    """
+    if not (isinstance(base_optimizer, type)
+            and issubclass(base_optimizer, _optimizer.Optimizer)):
+        raise TypeError("The input(base_optimizer) should be a derived "
+                        "class of Optimizer.")
+
+    class OptimizerWithDecoupledWeightDecay(DecoupledWeightDecay,
+                                            base_optimizer):
+        def __init__(self, weight_decay, apply_decay_param_fun=None,
+                     **kwargs):
+            super(OptimizerWithDecoupledWeightDecay, self).__init__(
+                weight_decay, apply_decay_param_fun, **kwargs)
+
+    return OptimizerWithDecoupledWeightDecay

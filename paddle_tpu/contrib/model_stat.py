@@ -1,0 +1,117 @@
+"""Model PARAMs/FLOPs summary table (parity: contrib/model_stat.py:36-194
+`summary`).  Counts conv2d/depthwise_conv2d, pool2d, mul/fc-style matmul,
+relu/sigmoid family, batch_norm — same per-op formulas as the reference
+(NVIDIA convention, mul+add = 2 FLOPs).  Renders an ASCII table without
+the prettytable dependency (zero-egress environment)."""
+
+from collections import OrderedDict
+
+__all__ = ["summary"]
+
+_ACTS = ("relu", "sigmoid", "tanh", "relu6", "leaky_relu")
+
+
+def _summary_model(block, op):
+    def shape(name):
+        v = block._find_var_recursive(name)
+        return tuple(v.shape) if v is not None and v.shape else None
+
+    if op.type in ("conv2d", "depthwise_conv2d"):
+        k = shape(op.input("Filter")[0])
+        inp = shape(op.input("Input")[0])
+        out = shape(op.output("Output")[0])
+        if None in (k, inp, out):
+            return None
+        c_out, c_in_per_group, k_h, k_w = k
+        _, c_out_, h_out, w_out = out
+        # Filter shape is [O, C/groups, kh, kw] (layers/nn.py conv2d):
+        # the channel dim is ALREADY per-group — dividing by groups again
+        # would zero out depthwise convs
+        kernel_ops = k_h * k_w * c_in_per_group
+        bias_ops = 0 if not op.input("Bias") else 1
+        params = c_out * (kernel_ops + bias_ops)
+        flops = 2 * h_out * w_out * c_out * (kernel_ops + bias_ops)
+        return inp, out, params, flops
+    if op.type == "pool2d":
+        inp = shape(op.input("X")[0])
+        out = shape(op.output("Out")[0])
+        if None in (inp, out):
+            return None
+        _, c_out, h_out, w_out = out
+        ksize = op.attrs.get("ksize", [1, 1])
+        params = 0
+        flops = h_out * w_out * c_out * ksize[0] * ksize[1]
+        return inp, out, params, flops
+    if op.type in ("mul", "matmul"):
+        x = shape(op.input("X")[0])
+        y = shape(op.input("Y")[0])
+        out = shape(op.output("Out")[0])
+        if None in (x, y, out):
+            return None
+        params = 1
+        for d in y:
+            params *= d
+        flops = 2 * params * (x[0] if x[0] and x[0] > 0 else 1)
+        return x, out, params, flops
+    if op.type in _ACTS:
+        inp = shape(op.input("X")[0])
+        out = shape(op.output("Out")[0])
+        if None in (inp, out):
+            return None
+        n = 1
+        for d in out[1:]:
+            n *= d
+        return inp, out, 0, n
+    if op.type == "batch_norm":
+        inp = shape(op.input("X")[0])
+        out = shape(op.output("Y")[0])
+        if None in (inp, out):
+            return None
+        c = out[1]
+        n = 1
+        for d in out[1:]:
+            n *= d
+        # gamma, beta, mean, var
+        return inp, out, 4 * c, n
+    return None
+
+
+def _render(rows, total_params, total_flops):
+    headers = ["No.", "TYPE", "INPUT", "OUTPUT", "PARAMs", "FLOPs"]
+    table = [[str(i), r["type"], str(r["input_shape"]),
+              str(r["out_shape"]), str(r["PARAMs"]), str(r["FLOPs"])]
+             for i, r in enumerate(rows)]
+    widths = [max(len(h), *(len(t[c]) for t in table)) if table else len(h)
+              for c, h in enumerate(headers)]
+    sep = "+-" + "-+-".join("-" * w for w in widths) + "-+"
+    lines = [sep,
+             "| " + " | ".join(h.rjust(w)
+                               for h, w in zip(headers, widths)) + " |",
+             sep]
+    for t in table:
+        lines.append("| " + " | ".join(v.rjust(w)
+                                       for v, w in zip(t, widths)) + " |")
+    lines.append(sep)
+    lines.append("Total PARAMs: %d(%.4fG)"
+                 % (total_params, total_params / 1e9))
+    lines.append("Total FLOPs: %d(%.2fG)" % (total_flops, total_flops / 1e9))
+    return "\n".join(lines)
+
+
+def summary(main_prog):
+    """Print (and return) the per-op PARAMs/FLOPs table for a program."""
+    rows = []
+    for block in main_prog.blocks:
+        for op in block.ops:
+            res = _summary_model(block, op)
+            if res is None:
+                continue
+            rows.append(OrderedDict(
+                type=op.type,
+                input_shape=res[0][1:], out_shape=res[1][1:],
+                PARAMs=res[2], FLOPs=res[3]))
+    total_params = sum(r["PARAMs"] for r in rows)
+    total_flops = sum(r["FLOPs"] for r in rows)
+    text = _render(rows, total_params, total_flops)
+    print(text)
+    return total_params, total_flops

@@ -1,0 +1,56 @@
+"""Op-frequency statistics over a program (parity:
+contrib/op_frequence.py:23-104 `op_freq_statistic`): single-op counts and
+adjacent-pair counts (producer->consumer through non-parameter vars),
+both sorted descending."""
+
+from collections import OrderedDict
+
+from ..framework import Program
+
+__all__ = ["op_freq_statistic"]
+
+
+def op_freq_statistic(program):
+    """Returns (uni_op_freq, adj_2_op_freq): OrderedDicts of
+    "type" -> count and "producer,consumer" -> count, sorted by count
+    descending."""
+    if not isinstance(program, Program):
+        raise TypeError("The input type should be Porgram."
+                        "But you passed in %s" % type(program))
+
+    uni_op_freq = OrderedDict()
+    adj_2_op_freq = OrderedDict()
+    block = program.global_block()
+    parameters = {p.name for p in block.all_parameters()}
+
+    for op in block.ops:
+        recorded = False
+        for name in op.output_arg_names:
+            if name in parameters:
+                continue
+            if not recorded:
+                uni_op_freq[op.type] = uni_op_freq.get(op.type, 0) + 1
+                recorded = True
+
+    var_gen_op = {}
+    op_in_ops = OrderedDict()
+    for op in block.ops:
+        for name in op.input_arg_names:
+            if name in parameters:
+                continue
+            gens = var_gen_op.get(name)
+            if gens:
+                op_in_ops.setdefault(op.type, []).append(gens[-1])
+        for name in op.output_arg_names:
+            if name in parameters:
+                continue
+            var_gen_op.setdefault(name, []).append(op.type)
+
+    for op_type, in_ops in op_in_ops.items():
+        for in_op in in_ops:
+            key = in_op + "," + op_type
+            adj_2_op_freq[key] = adj_2_op_freq.get(key, 0) + 1
+
+    uni = OrderedDict(sorted(uni_op_freq.items(), key=lambda kv: -kv[1]))
+    adj = OrderedDict(sorted(adj_2_op_freq.items(), key=lambda kv: -kv[1]))
+    return uni, adj

@@ -1,0 +1,7 @@
+from .controller_server import ControllerServer  # noqa: F401
+from .light_nas_strategy import LightNASStrategy  # noqa: F401
+from .search_agent import SearchAgent  # noqa: F401
+from .search_space import SearchSpace  # noqa: F401
+
+__all__ = ["SearchSpace", "ControllerServer", "SearchAgent",
+           "LightNASStrategy"]

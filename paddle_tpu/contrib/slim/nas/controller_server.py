@@ -1,0 +1,76 @@
+"""Socket server wrapping a search controller (parity:
+contrib/slim/nas/controller_server.py:28-107).
+
+Protocol (newline-stripped UTF-8, one request per connection):
+    "next_tokens"                  -> "t0,t1,..."
+    "<key>\\t<tokens>\\t<reward>"  -> updates, replies next tokens
+"""
+
+import socket
+import threading
+
+__all__ = ["ControllerServer"]
+
+
+class ControllerServer(object):
+    def __init__(self, controller=None, address=("", 0),
+                 max_client_num=100, search_steps=None, key="light-nas"):
+        self._controller = controller
+        self._address = address
+        self._max_client_num = max_client_num
+        self._search_steps = search_steps
+        self._closed = False
+        self._ip, self._port = address
+        self._key = key
+        self._socket = None
+        self._thread = None
+
+    def start(self):
+        self._socket = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._socket.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._socket.bind(self._address)
+        self._socket.listen(self._max_client_num)
+        self._socket.settimeout(0.5)  # poll so close() can stop accept()
+        self._ip, self._port = self._socket.getsockname()[:2]
+        self._thread = threading.Thread(target=self.run, daemon=True)
+        self._thread.start()
+        return self._thread
+
+    def close(self):
+        self._closed = True
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def port(self):
+        return self._port
+
+    def ip(self):
+        return self._ip
+
+    def run(self):
+        while not self._closed and (
+                self._search_steps is None
+                or self._controller._iter < self._search_steps):
+            try:
+                conn, _addr = self._socket.accept()
+            except socket.timeout:
+                continue
+            try:
+                message = conn.recv(1024).decode().strip("\n")
+                if message == "next_tokens":
+                    tokens = self._controller.next_tokens()
+                else:
+                    parts = message.split("\t")
+                    if len(parts) < 3 or parts[0] != self._key:
+                        continue  # noise / wrong key: drop
+                    tokens = [int(t) for t in parts[1].split(",")]
+                    self._controller.update(tokens, float(parts[2]))
+                    tokens = self._controller.next_tokens()
+                conn.send(",".join(str(t) for t in tokens).encode())
+            except (ValueError, OSError):
+                # malformed numbers / client hangups must not kill the
+                # server thread (the search would hang on the next recv)
+                continue
+            finally:
+                conn.close()
+        self._socket.close()

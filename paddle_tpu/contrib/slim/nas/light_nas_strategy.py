@@ -1,0 +1,124 @@
+"""Light-NAS search strategy (parity:
+contrib/slim/nas/light_nas_strategy.py:34-196).
+
+The reference version plugs into its slim Compressor epoch callbacks
+(on_compression_begin / on_epoch_begin / on_epoch_end) and talks to the
+shared controller through a SearchAgent.  This framework's slim package
+has no epoch-callback Compressor; the same search loop is exposed
+directly: `search()` iterates propose -> constrain (flops/latency retry
+with a min-flops fallback, the reference's _max_try_times loop) ->
+train/evaluate -> report reward (zeroed when the winning candidate
+violates constraints, as the reference does on_epoch_end)."""
+
+import socket
+
+from ..searcher import SAController
+from .controller_server import ControllerServer
+from .search_agent import SearchAgent
+
+__all__ = ["LightNASStrategy"]
+
+
+class LightNASStrategy(object):
+    def __init__(self, controller=None, search_steps=10,
+                 target_flops=629145600, target_latency=0,
+                 metric_name="top1_acc", server_ip="127.0.0.1",
+                 server_port=0, is_server=True, max_client_num=100,
+                 max_try_times=100, key="light-nas"):
+        self._controller = controller or SAController()
+        self._search_steps = search_steps
+        self._max_flops = target_flops
+        self._max_latency = target_latency
+        self._metric_name = metric_name
+        self._server_ip = server_ip or socket.gethostbyname(
+            socket.gethostname())
+        self._server_port = server_port
+        self._is_server = is_server
+        self._max_client_num = max_client_num
+        self._max_try_times = max_try_times
+        self._key = key
+        self._server = None
+        self._search_agent = None
+
+    def __getstate__(self):
+        """Sockets can't be pickled (reference __getstate__)."""
+        return {k: v for k, v in self.__dict__.items()
+                if k not in ("_search_agent", "_server")}
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self, search_space):
+        """Reset the controller and bring up server + agent."""
+        self._current_tokens = search_space.init_tokens()
+        self._controller.reset(search_space.range_table(),
+                               self._current_tokens, None)
+        if self._is_server:
+            self._server = ControllerServer(
+                controller=self._controller,
+                address=(self._server_ip, self._server_port),
+                max_client_num=self._max_client_num,
+                search_steps=None, key=self._key)
+            self._server.start()
+            self._server_port = self._server.port()
+        self._search_agent = SearchAgent(
+            self._server_ip, self._server_port, key=self._key)
+
+    def stop(self):
+        if self._server is not None:
+            self._server.close()
+            self._server = None
+
+    # -- one search round ----------------------------------------------------
+    def propose(self, search_space, flops_fn, latency_fn=None):
+        """Find a candidate satisfying the constraints, retrying through
+        the controller with the min-flops tokens as mutation base (the
+        reference's on_epoch_begin loop)."""
+        min_flops, min_tokens = -1, None
+        for _ in range(self._max_try_times):
+            net = search_space.create_net(self._current_tokens)
+            flops = flops_fn(net)
+            if min_flops < 0 or flops < min_flops:
+                min_flops, min_tokens = flops, list(self._current_tokens)
+            latency = 0
+            if self._max_latency > 0:
+                latency = (latency_fn or
+                           search_space.get_model_latency)(net)
+            if flops > self._max_flops or (self._max_latency > 0
+                                           and latency > self._max_latency):
+                self._current_tokens = self._controller.next_tokens(
+                    min_tokens)
+            else:
+                return self._current_tokens, net
+        return self._current_tokens, net
+
+    def report(self, reward, flops=None, latency=None):
+        """Send the evaluated reward (zeroed on constraint violation, per
+        the reference on_epoch_end) and adopt the next proposal."""
+        if flops is not None and flops > self._max_flops:
+            reward = 0.0
+        if self._max_latency > 0 and latency is not None \
+                and latency > self._max_latency:
+            reward = 0.0
+        self._current_tokens = self._search_agent.update(
+            self._current_tokens, reward)
+        return self._current_tokens
+
+    # -- full loop -----------------------------------------------------------
+    def search(self, search_space, eval_fn, flops_fn, latency_fn=None):
+        """Run `search_steps` rounds: propose -> eval_fn(net) -> report.
+        Returns (best_tokens, best_reward) from the controller."""
+        self.start(search_space)
+        try:
+            for _ in range(self._search_steps):
+                tokens, net = self.propose(search_space, flops_fn,
+                                           latency_fn)
+                reward = eval_fn(net)
+                latency = None
+                if self._max_latency > 0:
+                    latency = (latency_fn
+                               or search_space.get_model_latency)(net)
+                self.report(reward, flops=flops_fn(net),
+                            latency=latency)
+            return self._controller.best_tokens, \
+                self._controller.max_reward
+        finally:
+            self.stop()

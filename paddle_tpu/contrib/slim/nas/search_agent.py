@@ -1,0 +1,32 @@
+"""Client side of the NAS controller protocol (parity:
+contrib/slim/nas/search_agent.py:25-67)."""
+
+import socket
+
+__all__ = ["SearchAgent"]
+
+
+class SearchAgent(object):
+    def __init__(self, server_ip=None, server_port=None, key="light-nas"):
+        self.server_ip = server_ip
+        self.server_port = server_port
+        self._key = key
+
+    def _roundtrip(self, payload):
+        client = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        try:
+            client.connect((self.server_ip, self.server_port))
+            client.send(payload.encode())
+            reply = client.recv(1024).decode().strip("\n")
+        finally:
+            client.close()
+        return [int(t) for t in reply.split(",")]
+
+    def update(self, tokens, reward):
+        """Report (tokens, reward); returns the controller's next
+        proposal."""
+        return self._roundtrip("%s\t%s\t%s" % (
+            self._key, ",".join(str(t) for t in tokens), reward))
+
+    def next_tokens(self):
+        return self._roundtrip("next_tokens")

@@ -1,0 +1,28 @@
+"""NAS search-space interface (parity: contrib/slim/nas/search_space.py).
+
+A SearchSpace maps integer token vectors to concrete (Program, metrics)
+tuples; the controller explores token space."""
+
+__all__ = ["SearchSpace"]
+
+
+class SearchSpace(object):
+    """Abstract search space for neural architecture search."""
+
+    def init_tokens(self):
+        """Initial token vector."""
+        raise NotImplementedError("Abstract method.")
+
+    def range_table(self):
+        """Per-position token ranges: tokens[i] in [0, range_table()[i])."""
+        raise NotImplementedError("Abstract method.")
+
+    def create_net(self, tokens):
+        """tokens -> (startup_program, train_program, eval_program,
+        train_metrics, test_metrics)."""
+        raise NotImplementedError("Abstract method.")
+
+    def get_model_latency(self, program):
+        """Measured (or estimated) latency of a candidate program — the
+        LightNAS constraint signal."""
+        raise NotImplementedError("Abstract method.")

@@ -1,0 +1,3 @@
+from .controller import EvolutionaryController, SAController  # noqa: F401
+
+__all__ = ["EvolutionaryController", "SAController"]

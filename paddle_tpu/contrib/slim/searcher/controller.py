@@ -1,0 +1,101 @@
+"""Hyperparameter/architecture search controllers (parity:
+contrib/slim/searcher/controller.py:28-150)."""
+
+import math
+
+import numpy as np
+
+__all__ = ["EvolutionaryController", "SAController"]
+
+
+class EvolutionaryController(object):
+    """Abstract evolutionary-search controller."""
+
+    def update(self, tokens, reward):
+        """Record a (tokens, reward) observation."""
+        raise NotImplementedError("Abstract method.")
+
+    def reset(self, range_table, init_tokens, constrain_func=None):
+        """Reset with a search-space range table (tokens[i] in
+        [0, range_table[i])) and optional constraint callback."""
+        raise NotImplementedError("Abstract method.")
+
+    def next_tokens(self):
+        """Propose the next solution."""
+        raise NotImplementedError("Abstract method.")
+
+
+class SAController(EvolutionaryController):
+    """Simulated annealing: accept a worse solution with probability
+    exp((reward - best_so_far) / T), T decaying geometrically per
+    iteration (searcher/controller.py:59-150)."""
+
+    def __init__(self, range_table=None, reduce_rate=0.85,
+                 init_temperature=1024, max_iter_number=300, seed=None):
+        super(SAController, self).__init__()
+        self._range_table = range_table
+        self._reduce_rate = reduce_rate
+        self._init_temperature = init_temperature
+        self._max_iter_number = max_iter_number
+        self._reward = -1
+        self._tokens = None
+        self._constrain_func = None
+        self._max_reward = -1
+        self._best_tokens = None
+        self._iter = 0
+        self._rng = np.random.RandomState(seed)
+
+    def __getstate__(self):
+        return {k: v for k, v in self.__dict__.items()
+                if k != "_constrain_func"}
+
+    @property
+    def best_tokens(self):
+        return self._best_tokens
+
+    @property
+    def max_reward(self):
+        return self._max_reward
+
+    def reset(self, range_table, init_tokens, constrain_func=None):
+        self._range_table = list(range_table)
+        self._constrain_func = constrain_func
+        self._tokens = list(init_tokens)
+        self._iter = 0
+
+    def update(self, tokens, reward):
+        self._iter += 1
+        temperature = self._init_temperature * (
+            self._reduce_rate ** self._iter)
+        if reward > self._reward or self._rng.random_sample() <= math.exp(
+                (reward - self._reward) / max(temperature, 1e-10)):
+            self._reward = reward
+            self._tokens = list(tokens)
+        if reward > self._max_reward:
+            self._max_reward = reward
+            self._best_tokens = list(tokens)
+
+    def next_tokens(self, control_token=None):
+        tokens = list(control_token) if control_token else \
+            list(self._tokens)
+        new_tokens = self._mutate(tokens)
+        if self._constrain_func is None:
+            return new_tokens
+        for _ in range(self._max_iter_number):
+            if self._constrain_func(new_tokens):
+                return new_tokens
+            new_tokens = list(tokens)
+            idx = self._rng.randint(len(self._range_table))
+            new_tokens[idx] = self._rng.randint(self._range_table[idx])
+        return new_tokens
+
+    def _mutate(self, tokens):
+        new_tokens = list(tokens)
+        idx = self._rng.randint(len(self._range_table))
+        # shift to a DIFFERENT value in [0, range) (the +1 offset
+        # guarantees a change)
+        new_tokens[idx] = (
+            new_tokens[idx] + self._rng.randint(
+                max(self._range_table[idx] - 1, 1)) + 1
+        ) % self._range_table[idx]
+        return new_tokens

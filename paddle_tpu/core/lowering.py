@@ -285,11 +285,14 @@ def build_spmd_block_fn(plan, mesh, axis="data"):
         env.update(params_ro)
         env.update(params_rw)
         env.update(feeds)
-        rank = jax.lax.axis_index(axis)
+        one_rank = mesh.shape[axis] == 1
+        rank = None if one_rank else jax.lax.axis_index(axis)
         for i, op in enumerate(_iter_runtime_ops(block)):
             key = None
             if rng is not None:
-                key = jax.random.fold_in(jax.random.fold_in(rng, i), rank)
+                key = jax.random.fold_in(rng, i)
+                if rank is not None:  # distinct dropout masks per rank
+                    key = jax.random.fold_in(key, rank)
             run_op(op, env, key, mesh=mesh, axis_names=(axis,),
                    data_axis=axis)
         fetches = [env[n] for n in fetch_names]

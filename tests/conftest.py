@@ -45,6 +45,10 @@ def pytest_configure(config):
         "markers",
         "tpu: needs a real TPU chip; run via PADDLE_TPU_TESTS=1 pytest -m tpu",
     )
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-process / long-running tests",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,198 @@
+"""CTR-style distributed payload (reference: dist_ctr.py + dist_save_load.py):
+a sparse PS-hosted embedding (DistributedEmbedding over the sparse-table
+RPC runtime) feeding dense fc layers trained through the dense-PS
+transpiler — 2 pservers x 2 trainers as real processes, per-step losses on
+stdout, final params saved for the harness's save/load round-trip check.
+
+Determinism contract for exact trainer-vs-local parity:
+- each trainer touches a DISJOINT id space (ids ≡ trainer parity mod 2),
+  so sparse pulls never race the other trainer's pushes;
+- sparse push grads are scaled 1/n_trainers (the sync-mode grad scale the
+  dense transpiler applies), with plain-SGD server rows so updates
+  commute;
+- the dense half barriers per step through the sync-PS program.
+The local baseline runs the full batch against in-process sparse servers
+with the SAME shard seeds, so lazily-initialized rows are bit-identical.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.distributed.sparse_table import (DistributedEmbedding,
+                                                 SparseTableClient,
+                                                 SparseTableServer)
+
+STEPS = 6
+BS = 8           # per trainer
+DIM = 8          # embedding dim
+VOCAB = 64
+MAX_ROWS = 16    # static unique-rows bound per batch
+N_TRAINERS = 2
+
+
+def build(demb):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 321
+    startup.random_seed = 321
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data("ids", shape=[1], dtype="int64")
+        dense_x = fluid.layers.data("dense_x", shape=[4])
+        y = fluid.layers.data("y", shape=[1])
+        emb = demb.lookup(ids, batch_ids_max=MAX_ROWS)
+        feat = fluid.layers.concat([emb, dense_x], axis=1)
+        h = fluid.layers.fc(feat, 16, act="relu",
+                            param_attr=fluid.ParamAttr(name="ctr_w1"))
+        pred = fluid.layers.fc(h, 1,
+                               param_attr=fluid.ParamAttr(name="ctr_w2"))
+        loss = fluid.layers.mean(fluid.layers.square(pred - y))
+        fluid.optimizer.SGD(0.05).minimize(loss)
+    return main, startup, loss
+
+
+def make_data():
+    """Global batches; trainer t consumes rows [t*BS:(t+1)*BS].  Ids are
+    disjoint by trainer parity (trainer 0: even ids, trainer 1: odd)."""
+    rng = np.random.RandomState(11)
+    batches = []
+    for _ in range(STEPS):
+        ids = np.zeros((N_TRAINERS * BS, 1), np.int64)
+        for t in range(N_TRAINERS):
+            ids[t * BS:(t + 1) * BS, 0] = (
+                rng.randint(0, VOCAB // 2, BS) * 2 + t)
+        dense = rng.randn(N_TRAINERS * BS, 4).astype("f")
+        yb = rng.randn(N_TRAINERS * BS, 1).astype("f")
+        batches.append((ids, dense, yb))
+    return batches
+
+
+def sparse_endpoints():
+    return os.environ["SPARSE_TABLE_ENDPOINTS"].split(",")
+
+
+def _train_loop(exe, prog, scope, demb, loss, batches, lo_slice,
+                grad_scale):
+    with fluid.scope_guard(scope):
+        for ids, dense, yb in batches:
+            ids_t = ids[lo_slice]
+            feed, info = demb.prepare_feed(ids_t.reshape(-1))
+            outs = exe.run(
+                prog,
+                feed={"ids": ids_t, "dense_x": dense[lo_slice],
+                      "y": yb[lo_slice], **feed},
+                fetch_list=[loss, demb.grad_var(prog)], scope=scope)
+            demb.push_grads(
+                info, np.asarray(outs[1]) * grad_scale)
+            print("loss:%.8f" % float(np.asarray(outs[0]).reshape(-1)[0]),
+                  flush=True)
+
+
+def _dump_state(scope, demb, client, touched_ids, save_dir=None,
+                main=None, exe=None):
+    with fluid.scope_guard(scope):
+        for pname in ("ctr_w1", "ctr_w2"):
+            v = np.asarray(scope.find_var(pname).get_tensor().numpy())
+            print("param:%s:%.8f" % (pname, float(np.abs(v).sum())),
+                  flush=True)
+        rows = client.pull(np.asarray(sorted(touched_ids), np.int64))
+        print("sparse_rows:%.8f" % float(np.abs(rows).sum()), flush=True)
+        if save_dir and main is not None:
+            fluid.io.save_persistables(exe, save_dir, main_program=main)
+            print("saved:%s" % save_dir, flush=True)
+
+
+def run_local():
+    # in-process sparse servers with the same per-shard seeds as the
+    # subprocess run (seed = shard index)
+    servers = [SparseTableServer(0, dim=DIM, optimizer="sgd", lr=0.05,
+                                 seed=s) for s in range(2)]
+    for s in servers:
+        s.start_thread()
+    client = SparseTableClient("ctr_emb",
+                               ["127.0.0.1:%d" % s.port for s in servers])
+    demb = DistributedEmbedding("ctr_emb", dim=DIM, client=client)
+    main, startup, loss = build(demb)
+    batches = make_data()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    _train_loop(exe, main, scope, demb, loss, batches, slice(None), 1.0)
+    touched = set(int(v) for b in batches for v in b[0].ravel())
+    _dump_state(scope, demb, client, touched,
+                save_dir=os.environ.get("CTR_SAVE_DIR"), main=main,
+                exe=exe)
+    client.complete()
+    for s in servers:
+        s.shutdown()
+
+
+def run_pserver():
+    """Dense pserver + one sparse-table shard in the same process (the
+    reference pserver hosts both dense blocks and sparse tables)."""
+    eps = os.environ["PADDLE_PSERVER_ENDPOINTS"]
+    cur = os.environ["PADDLE_CURRENT_ENDPOINT"]
+    shard = int(os.environ["SPARSE_SHARD_ID"])
+    sparse_port = int(sparse_endpoints()[shard].split(":")[1])
+    sserver = SparseTableServer(sparse_port, dim=DIM, optimizer="sgd",
+                                lr=0.05, seed=shard)
+    sserver.start_thread()
+    demb = DistributedEmbedding("ctr_emb", dim=DIM)
+    main, startup, loss = build(demb)
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, startup_program=startup,
+                pservers=eps, trainers=N_TRAINERS)
+    prog, sprog = t.get_pserver_programs(cur)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(sprog)
+        print("pserver:ready", flush=True)
+        exe.run(prog, scope=scope)
+    # dense program returning means every trainer sent COMPLETE; only now
+    # is the sparse shard safe to stop (a trainer-side sparse COMPLETE
+    # would kill the shard while the other trainer still pulls)
+    sserver.shutdown()
+    print("pserver:done", flush=True)
+
+
+def run_trainer():
+    eps = os.environ["PADDLE_PSERVER_ENDPOINTS"]
+    tid = int(os.environ["PADDLE_TRAINER_ID"])
+    client = SparseTableClient("ctr_emb", sparse_endpoints())
+    demb = DistributedEmbedding("ctr_emb", dim=DIM, client=client)
+    main, startup, loss = build(demb)
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=tid, program=main, startup_program=startup,
+                pservers=eps, trainers=N_TRAINERS)
+    tp = t.get_trainer_program()
+    batches = make_data()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    half = slice(tid * BS, (tid + 1) * BS)
+    _train_loop(exe, tp, scope, demb, loss, batches, half,
+                1.0 / N_TRAINERS)
+    touched = set(int(v) for b in batches for v in b[0].ravel())
+    save_dir = os.environ.get("CTR_SAVE_DIR") if tid == 0 else None
+    _dump_state(scope, demb, client, touched, save_dir=save_dir,
+                main=main, exe=exe)
+    # no sparse COMPLETE from trainers (see run_pserver); dense COMPLETE
+    # coordinates shutdown for both planes
+    with fluid.scope_guard(scope):
+        scope._ps_comm.complete()
+
+
+if __name__ == "__main__":
+    role = os.environ.get("PADDLE_TRAINING_ROLE", "LOCAL")
+    if role == "PSERVER":
+        run_pserver()
+    elif role == "TRAINER":
+        run_trainer()
+    else:
+        run_local()

@@ -1,0 +1,136 @@
+"""dist_ctr-analog subprocess test (reference dist_ctr.py +
+dist_save_load.py over test_dist_base.py): sparse PS-hosted embedding +
+dense sync-PS fc net, 2 pservers (each also hosting one sparse-table
+shard) x 2 trainers as real processes.  Asserts exact dense-param AND
+sparse-row parity vs the full-batch local baseline, plus a dist
+save/load round-trip of the persistables trainer 0 saved."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from dist_utils import free_ports as _free_ports
+
+
+def _parse(stdout, prefix):
+    return [l.split(prefix)[1] for l in stdout.splitlines()
+            if l.startswith(prefix)]
+
+
+def _parse_params(stdout):
+    out = {}
+    for l in stdout.splitlines():
+        if l.startswith("param:"):
+            _, name, v = l.split(":")
+            out[name] = float(v)
+    return out
+
+
+@pytest.mark.slow
+def test_dist_ctr_sparse_ps_matches_local(tmp_path):
+    here = os.path.dirname(os.path.abspath(__file__))
+    payload = os.path.join(here, "dist_ctr_payload.py")
+    sparse_ports = _free_ports(2)
+    sparse_eps = ",".join("127.0.0.1:%d" % p for p in sparse_ports)
+    local_dir = str(tmp_path / "local_save")
+    dist_dir = str(tmp_path / "dist_save")
+
+    base_env = dict(os.environ, JAX_PLATFORMS="cpu")
+    base_env.pop("PADDLE_TRAINING_ROLE", None)
+
+    # local full-batch baseline with in-process sparse shards (same seeds)
+    lports = _free_ports(2)
+    env = dict(base_env, CTR_SAVE_DIR=local_dir,
+               SPARSE_TABLE_ENDPOINTS=",".join(
+                   "127.0.0.1:%d" % p for p in lports))
+    local = subprocess.run([sys.executable, payload], env=env,
+                           capture_output=True, text=True, timeout=300)
+    assert local.returncode == 0, local.stderr
+    local_params = _parse_params(local.stdout)
+    local_rows = float(_parse(local.stdout, "sparse_rows:")[0])
+    assert set(local_params) == {"ctr_w1", "ctr_w2"}
+
+    dense_ports = _free_ports(2)
+    eps = ",".join("127.0.0.1:%d" % p for p in dense_ports)
+    procs = []
+    try:
+        for i, ep in enumerate(eps.split(",")):
+            env = dict(base_env, PADDLE_TRAINING_ROLE="PSERVER",
+                       PADDLE_PSERVER_ENDPOINTS=eps,
+                       PADDLE_CURRENT_ENDPOINT=ep,
+                       PADDLE_TRAINERS_NUM="2",
+                       SPARSE_TABLE_ENDPOINTS=sparse_eps,
+                       SPARSE_SHARD_ID=str(i))
+            procs.append(("ps:%d" % i, subprocess.Popen(
+                [sys.executable, payload], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True)))
+        trainers = []
+        for tid in range(2):
+            env = dict(base_env, PADDLE_TRAINING_ROLE="TRAINER",
+                       PADDLE_PSERVER_ENDPOINTS=eps,
+                       PADDLE_TRAINER_ID=str(tid),
+                       PADDLE_TRAINERS_NUM="2",
+                       SPARSE_TABLE_ENDPOINTS=sparse_eps,
+                       CTR_SAVE_DIR=dist_dir)
+            p = subprocess.Popen([sys.executable, payload], env=env,
+                                 stdout=subprocess.PIPE,
+                                 stderr=subprocess.PIPE, text=True)
+            trainers.append(p)
+            procs.append(("tr:%d" % tid, p))
+
+        touts = []
+        for p in trainers:
+            out, err = p.communicate(timeout=300)
+            assert p.returncode == 0, err
+            touts.append(out)
+        for name, p in procs:
+            if name.startswith("ps:"):
+                out, err = p.communicate(timeout=120)
+                assert p.returncode == 0, (name, err)
+                assert "pserver:done" in out
+    finally:
+        for _, p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    # parity: disjoint-id sparse pushes (1/n-scaled, commuting SGD) +
+    # sync dense PS must reproduce the full-batch local run exactly
+    for out in touts:
+        losses = [float(v) for v in _parse(out, "loss:")]
+        assert len(losses) == 6 and all(np.isfinite(losses))
+        dist_params = _parse_params(out)
+        for name in ("ctr_w1", "ctr_w2"):
+            np.testing.assert_allclose(dist_params[name],
+                                       local_params[name], rtol=1e-3)
+        dist_rows = float(_parse(out, "sparse_rows:")[0])
+        np.testing.assert_allclose(dist_rows, local_rows, rtol=1e-3)
+
+    # dist save/load round-trip (dist_save_load.py analog): trainer 0's
+    # saved persistables load back equal to the local baseline's
+    import paddle_tpu as fluid
+    from paddle_tpu.distributed.sparse_table import DistributedEmbedding
+
+    assert os.path.isdir(dist_dir), "trainer 0 saved nothing"
+    sys.path.insert(0, here)
+    import dist_ctr_payload as payload_mod
+
+    for check_dir in (dist_dir, local_dir):
+        demb = DistributedEmbedding("ctr_emb", dim=payload_mod.DIM)
+        main, startup, _ = payload_mod.build(demb)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            fluid.io.load_persistables(exe, check_dir, main_program=main)
+            vals = {n: np.asarray(scope.find_var(n).get_tensor().numpy())
+                    for n in ("ctr_w1", "ctr_w2")}
+        if check_dir == dist_dir:
+            dist_vals = vals
+        else:
+            for n in ("ctr_w1", "ctr_w2"):
+                np.testing.assert_allclose(dist_vals[n], vals[n],
+                                           rtol=1e-3, atol=1e-5)
