@@ -273,3 +273,121 @@ def dgc(ctx, u, v, grad, m=0.9, ratio=0.001, use_nesterov=False,
     v_out = v_new * (1.0 - mask)          # error feedback residual
     u_out = u_new * (1.0 - mask)
     return u_out, v_out, encode, encode.astype(grad.dtype)
+
+
+# -- horizontally-fused optimizer families -----------------------------------
+#
+# The reference fuses per-parameter optimizer ops into one kernel over
+# coalesced buffers (ir/fuse_optimizer_ops_pass.cc + coalesce_tensor).
+# TPU profile (round 3): 315 tiny per-weight update fusions cost ~46 ms of
+# a 211 ms ResNet-50 step — each ~64 KB fusion pays a fixed launch/DMA
+# cost.  The fused lowerings concatenate the flattened group into ONE
+# update computation (a single elementwise pass over ~100 MB), then split
+# back; emitted by ir.py fuse_optimizer_ops_pass.
+
+
+def _flatten_group(tensors):
+    import numpy as _np
+
+    sizes = [int(_np.prod(t.shape)) for t in tensors]
+    flat = jnp.concatenate([t.reshape(-1) for t in tensors])
+    return flat, sizes
+
+
+def _split_group(flat, sizes, shapes):
+    outs, off = [], 0
+    for n, shp in zip(sizes, shapes):
+        outs.append(flat[off:off + n].reshape(shp))
+        off += n
+    return outs
+
+
+@register_op(
+    "fused_sgd",
+    inputs=("Param", "Grad", "LearningRate"),
+    outputs=("ParamOut",),
+    duplicable_inputs=("Param", "Grad"),
+    duplicable_outputs=("ParamOut",),
+    grad_maker=None,
+)
+def fused_sgd(ctx, params, grads, lr):
+    lr_ = _lr(lr).astype(params[0].dtype)
+    p_flat, sizes = _flatten_group(params)
+    g_flat, _ = _flatten_group([g.astype(params[0].dtype) for g in grads])
+    out = p_flat - lr_ * g_flat
+    return (_split_group(out, sizes, [p.shape for p in params]),)
+
+
+@register_op(
+    "fused_momentum",
+    inputs=("Param", "Grad", "Velocity", "LearningRate"),
+    outputs=("ParamOut", "VelocityOut"),
+    duplicable_inputs=("Param", "Grad", "Velocity"),
+    duplicable_outputs=("ParamOut", "VelocityOut"),
+    attrs={"mu": 0.0, "use_nesterov": False, "regularization_method": "",
+           "regularization_coeff": 0.0},
+    grad_maker=None,
+)
+def fused_momentum(ctx, params, grads, vels, lr, mu=0.0,
+                   use_nesterov=False, regularization_method="",
+                   regularization_coeff=0.0):
+    dt = params[0].dtype
+    lr_ = _lr(lr).astype(dt)
+    p_flat, sizes = _flatten_group(params)
+    g_flat, _ = _flatten_group([g.astype(dt) for g in grads])
+    v_flat, _ = _flatten_group(vels)
+    if regularization_method == "l2_decay":
+        g_flat = g_flat + regularization_coeff * p_flat
+    v_new = mu * v_flat + g_flat
+    if use_nesterov:
+        p_new = p_flat - (g_flat + mu * v_new) * lr_
+    else:
+        p_new = p_flat - lr_ * v_new
+    shapes = [p.shape for p in params]
+    return (_split_group(p_new, sizes, shapes),
+            _split_group(v_new, sizes, shapes))
+
+
+@register_op(
+    "fused_adam",
+    inputs=("Param", "Grad", "Moment1", "Moment2", "LearningRate",
+            "Beta1Pow", "Beta2Pow"),
+    outputs=("ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut",
+             "Beta2PowOut"),
+    duplicable_inputs=("Param", "Grad", "Moment1", "Moment2", "Beta1Pow",
+                       "Beta2Pow"),
+    duplicable_outputs=("ParamOut", "Moment1Out", "Moment2Out",
+                        "Beta1PowOut", "Beta2PowOut"),
+    attrs={"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8},
+    grad_maker=None,
+)
+def fused_adam(ctx, params, grads, m1s, m2s, lr, b1pows, b2pows,
+               beta1=0.9, beta2=0.999, epsilon=1e-8):
+    dt = params[0].dtype
+    lr_ = _lr(lr).astype(dt)
+    b1 = jnp.asarray(beta1, dt)
+    b2 = jnp.asarray(beta2, dt)
+    p_flat, sizes = _flatten_group(params)
+    g_flat, _ = _flatten_group([g.astype(dt) for g in grads])
+    m1_flat, _ = _flatten_group(m1s)
+    m2_flat, _ = _flatten_group(m2s)
+    m1n = b1 * m1_flat + (1.0 - b1) * g_flat
+    m2n = b2 * m2_flat + (1.0 - b2) * g_flat * g_flat
+    # per-member bias correction: beta-pow accumulators may diverge
+    # (param added mid-training, partial checkpoint restore), so each
+    # param slice gets ITS OWN lr_t, expanded to a flat vector with the
+    # static slice sizes — exact parity with the unfused ops
+    lr_ts = []
+    for b1pow, b2pow, n in zip(b1pows, b2pows, sizes):
+        b1p = b1pow.reshape(()).astype(dt)
+        b2p = b2pow.reshape(()).astype(dt)
+        lr_ts.append(jnp.full(
+            (n,), lr_ * jnp.sqrt(1.0 - b2p) / (1.0 - b1p), dt))
+    lr_t = jnp.concatenate(lr_ts)
+    p_new = p_flat - lr_t * m1n / (jnp.sqrt(m2n) + epsilon)
+    shapes = [p.shape for p in params]
+    return (_split_group(p_new, sizes, shapes),
+            _split_group(m1n, sizes, shapes),
+            _split_group(m2n, sizes, shapes),
+            [(b.reshape(()) * b1).reshape(b.shape) for b in b1pows],
+            [(b.reshape(()) * b2).reshape(b.shape) for b in b2pows])

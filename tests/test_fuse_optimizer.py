@@ -1,0 +1,142 @@
+"""Horizontal optimizer fusion (ir.py fuse_optimizer_ops_pass +
+fused_sgd/fused_momentum/fused_adam ops; reference
+ir/fuse_optimizer_ops_pass.cc + BuildStrategy fuse_all_optimizer_ops).
+Exact numeric parity fused-vs-unfused is the contract."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import ir
+
+
+def _train(opt_factory, fuse, steps=4, rank_cap=0):
+    old = ir.FuseOptimizerOpsPass.max_param_rank
+    ir.FuseOptimizerOpsPass.max_param_rank = rank_cap
+    fluid.flags.set_flags({"FLAGS_fuse_optimizer_ops": fuse})
+    try:
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 5
+        startup.random_seed = 5
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[6])
+            y = fluid.layers.data("y", shape=[1])
+            h = fluid.layers.fc(x, 8, act="relu")
+            h = fluid.layers.fc(h, 8, act="tanh")
+            h = fluid.layers.fc(h, 8, act="relu")
+            pred = fluid.layers.fc(h, 1)
+            loss = fluid.layers.mean(fluid.layers.square(pred - y))
+            opt_factory().minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        rng = np.random.RandomState(0)
+        xb = rng.randn(8, 6).astype("f")
+        yb = rng.randn(8, 1).astype("f")
+        losses = []
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for _ in range(steps):
+                lo, = exe.run(main, feed={"x": xb, "y": yb},
+                              fetch_list=[loss])
+                losses.append(float(np.asarray(lo).ravel()[0]))
+        types = [op.type for op in main.global_block().ops]
+        return losses, types
+    finally:
+        fluid.flags.set_flags({"FLAGS_fuse_optimizer_ops": True})
+        ir.FuseOptimizerOpsPass.max_param_rank = old
+
+
+@pytest.mark.parametrize("name,factory,raw_type", [
+    ("sgd", lambda: fluid.optimizer.SGD(0.1), "sgd"),
+    ("momentum", lambda: fluid.optimizer.Momentum(0.1, 0.9), "momentum"),
+    ("adam", lambda: fluid.optimizer.Adam(0.01), "adam"),
+])
+def test_fused_matches_unfused(name, factory, raw_type):
+    base, t0 = _train(factory, fuse=False)
+    fused, t1 = _train(factory, fuse=True)
+    assert t0.count(raw_type) == 8          # 4 fc layers: w + b each
+    assert t1.count("fused_" + raw_type) == 1
+    assert t1.count(raw_type) == 0
+    np.testing.assert_allclose(fused, base, rtol=1e-6, atol=1e-7)
+
+
+def test_rank_cap_partial_fusion():
+    """max_param_rank=1 fuses only the biases; weights stay per-op."""
+    base, _ = _train(lambda: fluid.optimizer.Momentum(0.1, 0.9),
+                     fuse=False)
+    capped, types = _train(lambda: fluid.optimizer.Momentum(0.1, 0.9),
+                           fuse=True, rank_cap=1)
+    assert types.count("fused_momentum") == 1   # the 4 rank-1 biases
+    assert types.count("momentum") == 4         # the 4 rank-2 weights
+    np.testing.assert_allclose(capped, base, rtol=1e-6, atol=1e-7)
+
+
+def test_mixed_lr_not_fused_together():
+    """Different LearningRate vars must not share a fused group."""
+    fluid.flags.set_flags({"FLAGS_fuse_optimizer_ops": False})
+    try:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[4])
+            y = fluid.layers.data("y", shape=[1])
+            h = fluid.layers.fc(x, 8, act="relu")
+            pred = fluid.layers.fc(h, 1)
+            loss = fluid.layers.mean(fluid.layers.square(pred - y))
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        block = main.global_block()
+        # split the sgd ops onto two different LR vars
+        lr2 = block.create_var(name="lr_b", shape=[1], dtype="float32",
+                               persistable=True)
+        sgds = [op for op in block.ops if op.type == "sgd"]
+        for op in sgds[:2]:
+            op.inputs["LearningRate"] = ["lr_b"]
+        ir.FuseOptimizerOpsPass.max_param_rank = 0
+        try:
+            ir.apply_pass("fuse_optimizer_ops_pass", main, None)
+        finally:
+            ir.FuseOptimizerOpsPass.max_param_rank = 1
+        types = [op.type for op in block.ops]
+        # 2+2 split: neither group reaches MIN_GROUP=4 -> nothing fused
+        assert types.count("sgd") == 4
+        assert "fused_sgd" not in types
+    finally:
+        fluid.flags.set_flags({"FLAGS_fuse_optimizer_ops": True})
+
+
+def test_hazard_blocks_fusion():
+    """An op between group members that reads a param must block the
+    group (ordering hazard)."""
+    from paddle_tpu.framework import Operator
+
+    fluid.flags.set_flags({"FLAGS_fuse_optimizer_ops": False})
+    try:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[4])
+            y = fluid.layers.data("y", shape=[1])
+            h = fluid.layers.fc(x, 8, act="relu")
+            h = fluid.layers.fc(h, 8, act="relu")
+            pred = fluid.layers.fc(h, 1)
+            loss = fluid.layers.mean(fluid.layers.square(pred - y))
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        block = main.global_block()
+        sgds = [i for i, op in enumerate(block.ops) if op.type == "sgd"]
+        pname = block.ops[sgds[0]].input("Param")[0]
+        # reader of an updated param wedged between the sgd ops
+        block.create_var(name="hz_out")
+        reader = Operator(block, type="assign",
+                          inputs={"X": [pname]},
+                          outputs={"Out": ["hz_out"]}, attrs={})
+        ops = list(block.ops)
+        ops.insert(sgds[2], reader)
+        block.ops = ops
+        ir.FuseOptimizerOpsPass.max_param_rank = 0
+        try:
+            ir.apply_pass("fuse_optimizer_ops_pass", main, None)
+        finally:
+            ir.FuseOptimizerOpsPass.max_param_rank = 1
+        types = [op.type for op in block.ops]
+        assert "fused_sgd" not in types
+        assert types.count("sgd") == 6
+    finally:
+        fluid.flags.set_flags({"FLAGS_fuse_optimizer_ops": True})

@@ -1,0 +1,225 @@
+"""Numeric-vs-analytic gradient checks for the differentiable
+coverage-tail ops (completing round-2 verdict item 1's "OpTest goldens +
+grad checks": forward goldens live in test_op_tail_goldens.py; these
+verify the auto-vjp grads against central finite differences)."""
+
+import numpy as np
+
+from op_test import OpTest
+
+
+def _sigmoid(v):
+    return 1.0 / (1.0 + np.exp(-v))
+
+
+def _np_gru(proj, wh):
+    B, T, D3 = proj.shape
+    D = D3 // 3
+    h = np.zeros((B, D), "f")
+    hs = np.zeros((B, T, D), "f")
+    for t in range(T):
+        ur = proj[:, t, :2 * D] + h @ wh[:, :2 * D]
+        u, r = _sigmoid(ur[:, :D]), _sigmoid(ur[:, D:])
+        c = np.tanh(proj[:, t, 2 * D:] + (r * h) @ wh[:, 2 * D:])
+        h = u * h + (1 - u) * c
+        hs[:, t] = h
+    return hs
+
+
+def _np_lstm(proj, wh):
+    B, T, D4 = proj.shape
+    D = D4 // 4
+    h = np.zeros((B, D), "f")
+    c = np.zeros((B, D), "f")
+    hs = np.zeros((B, T, D), "f")
+    for t in range(T):
+        g = proj[:, t] + h @ wh
+        i, f = _sigmoid(g[:, :D]), _sigmoid(g[:, D:2 * D])
+        cand = np.tanh(g[:, 2 * D:3 * D])
+        o = _sigmoid(g[:, 3 * D:])
+        c = f * c + i * cand
+        h = o * np.tanh(c)
+        hs[:, t] = h
+    return hs
+
+
+class TestFusionGruGrad(OpTest):
+    op_type = "fusion_gru"
+
+    def setup_method(self, m):
+        rng = np.random.RandomState(0)
+        B, T, F, D = 2, 4, 3, 2
+        x = rng.uniform(-1, 1, (B, T, F)).astype("f")
+        wx = rng.uniform(-0.5, 0.5, (F, 3 * D)).astype("f")
+        wh = rng.uniform(-0.5, 0.5, (D, 3 * D)).astype("f")
+        self.inputs = {"X": x, "WeightX": wx, "WeightH": wh}
+        self.attrs = {}
+        self.outputs = {"Hidden": _np_gru(x @ wx, wh)}
+
+    def test_grad(self):
+        self.check_grad(["X", "WeightX", "WeightH"],
+                        output_names="Hidden", max_relative_error=0.02)
+
+
+class TestFusionLstmGrad(OpTest):
+    op_type = "fusion_lstm"
+
+    def setup_method(self, m):
+        rng = np.random.RandomState(1)
+        B, T, F, D = 2, 3, 3, 2
+        x = rng.uniform(-1, 1, (B, T, F)).astype("f")
+        wx = rng.uniform(-0.5, 0.5, (F, 4 * D)).astype("f")
+        wh = rng.uniform(-0.5, 0.5, (D, 4 * D)).astype("f")
+        self.inputs = {"X": x, "WeightX": wx, "WeightH": wh}
+        self.attrs = {}
+        self.outputs = {"Hidden": _np_lstm(x @ wx, wh)}
+
+    def test_grad(self):
+        self.check_grad(["X", "WeightX", "WeightH"],
+                        output_names="Hidden", max_relative_error=0.02)
+
+
+class TestFusedElemwiseActivationGrad(OpTest):
+    op_type = "fused_elemwise_activation"
+
+    def setup_method(self, m):
+        rng = np.random.RandomState(2)
+        x = rng.uniform(0.2, 1.0, (3, 4)).astype("f")
+        y = rng.uniform(0.2, 1.0, (3, 4)).astype("f")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"functor_list": ["tanh", "elementwise_add"]}
+        self.outputs = {"Out": np.tanh(x + y),
+                        "IntermediateOut": x + y}
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], output_names="Out")
+
+
+class TestFusionRepeatedFcReluGrad(OpTest):
+    op_type = "fusion_repeated_fc_relu"
+
+    def setup_method(self, m):
+        rng = np.random.RandomState(3)
+        x = rng.uniform(0.1, 1, (3, 4)).astype("f")
+        w1 = rng.uniform(0.1, 0.5, (4, 5)).astype("f")
+        b1 = rng.uniform(0.1, 0.2, (5,)).astype("f")
+        w2 = rng.uniform(0.1, 0.5, (5, 2)).astype("f")
+        b2 = rng.uniform(0.1, 0.2, (2,)).astype("f")
+        h1 = np.maximum(x @ w1 + b1, 0.0)
+        out = np.maximum(h1 @ w2 + b2, 0.0)
+        self.inputs = {"X": x, "W": [("gw1", w1), ("gw2", w2)],
+                       "Bias": [("gb1", b1), ("gb2", b2)]}
+        self.attrs = {}
+        self.outputs = {"ReluOut": [("gr1", h1)], "Out": out}
+
+    def test_grad(self):
+        # positive-orthant inputs keep relu away from its kink (finite
+        # differences are ill-defined there)
+        self.check_grad(["X"], output_names="Out")
+
+
+class TestSequenceScatterGrad(OpTest):
+    op_type = "sequence_scatter"
+
+    def setup_method(self, m):
+        rng = np.random.RandomState(4)
+        x = rng.uniform(-1, 1, (2, 6)).astype("f")
+        ids = np.asarray([[1, 3, 5], [0, 2, 4]], np.int64)
+        upd = rng.uniform(-1, 1, (2, 3)).astype("f")
+        want = x.copy()
+        for b in range(2):
+            for t in range(3):
+                want[b, ids[b, t]] += upd[b, t]
+        self.inputs = {"X": x, "Ids": ids, "Updates": upd}
+        self.attrs = {}
+        self.outputs = {"Out": want}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Updates"], output_names="Out")
+
+
+class TestMatchMatrixTensorGrad(OpTest):
+    op_type = "match_matrix_tensor"
+
+    def setup_method(self, m):
+        rng = np.random.RandomState(5)
+        B, Tx, Ty, D1, D2, dim_t = 2, 3, 3, 2, 2, 2
+        x = rng.uniform(-1, 1, (B, Tx, D1)).astype("f")
+        y = rng.uniform(-1, 1, (B, Ty, D2)).astype("f")
+        w = rng.uniform(-0.5, 0.5, (D1, dim_t, D2)).astype("f")
+        out = np.einsum("bid,dte,bje->btij", x, w, y).reshape(B, -1)
+        tmp = np.einsum("bid,dte->bite", x, w).reshape(B, -1)
+        self.inputs = {"X": x, "Y": y, "W": w.reshape(D1, -1)}
+        self.attrs = {"dim_t": dim_t}
+        self.outputs = {"Out": out, "Tmp": tmp}
+
+    def test_grad(self):
+        self.check_grad(["X", "Y", "W"], output_names="Out")
+
+
+class TestFusedFcElementwiseLayernormGrad(OpTest):
+    op_type = "fused_fc_elementwise_layernorm"
+
+    def setup_method(self, m):
+        rng = np.random.RandomState(6)
+        B, F, D = 3, 4, 5
+        x = rng.uniform(-1, 1, (B, F)).astype("f")
+        w = rng.uniform(-0.5, 0.5, (F, D)).astype("f")
+        y = rng.uniform(-1, 1, (B, D)).astype("f")
+        z = x @ w + y
+        mu = z.mean(1, keepdims=True)
+        var = z.var(1, keepdims=True)
+        out = (z - mu) / np.sqrt(var + 1e-5)
+        self.inputs = {"X": x, "W": w, "Y": y}
+        self.attrs = {"epsilon": 1e-5}
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output(no_check_set=("Mean", "Variance"))
+
+    def test_grad(self):
+        self.check_grad(["X", "W", "Y"], output_names="Out",
+                        max_relative_error=0.02)
+
+
+class TestRowConvGrad(OpTest):
+    op_type = "row_conv"
+
+    def setup_method(self, m):
+        rng = np.random.RandomState(7)
+        B, T, D, Fut = 2, 5, 3, 2
+        x = rng.uniform(-1, 1, (B, T, D)).astype("f")
+        w = rng.uniform(-0.5, 0.5, (Fut + 1, D)).astype("f")
+        pad = np.concatenate([x, np.zeros((B, Fut, D), "f")], 1)
+        want = sum(pad[:, i:i + T] * w[i] for i in range(Fut + 1))
+        self.inputs = {"X": x, "Filter": w}
+        self.attrs = {}
+        self.outputs = {"Out": want}
+
+    def test_grad(self):
+        self.check_grad(["X", "Filter"], output_names="Out")
+
+
+class TestCudnnLstmGrad(OpTest):
+    op_type = "cudnn_lstm"
+
+    def setup_method(self, m):
+        rng = np.random.RandomState(8)
+        B, T, F, D = 2, 3, 3, 2
+        x = rng.uniform(-1, 1, (B, T, F)).astype("f")
+        wx = rng.uniform(-0.5, 0.5, (F, 4 * D)).astype("f")
+        wh = rng.uniform(-0.5, 0.5, (D, 4 * D)).astype("f")
+        bx = rng.uniform(-0.2, 0.2, (4 * D,)).astype("f")
+        bh = rng.uniform(-0.2, 0.2, (4 * D,)).astype("f")
+        blob = np.concatenate([wx.ravel(), wh.ravel(), bx, bh])
+        proj = x @ wx + (bx + bh).reshape(1, 1, -1)
+        self.inputs = {"Input": x, "W": blob}
+        self.attrs = {"hidden_size": D, "num_layers": 1}
+        self.outputs = {"Out": _np_lstm(proj.astype("f"), wh)}
+
+    def test_grad(self):
+        self.check_grad(["Input", "W"], output_names="Out",
+                        max_relative_error=0.02)
