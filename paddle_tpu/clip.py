@@ -73,7 +73,8 @@ class GradientClipByGlobalNorm(BaseGradientClipAttr):
         from . import layers
 
         program = default_main_program()
-        block = program.global_block()
+        # current_block: see regularizer.append_regularization_ops
+        block = program.current_block()
         norms = []
         with program._backward_role_guard():
             for p, g in params_grads:

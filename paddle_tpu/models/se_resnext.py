@@ -1,0 +1,90 @@
+"""SE-ResNeXt for ImageNet (the reference's heavyweight dist-test model:
+dist_se_resnext.py / test_parallel_executor_seresnext payloads).
+
+ResNeXt bottleneck (grouped 3x3 conv, cardinality 32) with a
+squeeze-and-excitation gate per block; built from the fluid layer API
+like the reference model scripts — the grouped conv rides conv2d's
+`groups` (XLA feature-group convolution on TPU)."""
+
+import paddle_tpu as fluid
+
+DEPTH_CFG = {
+    50: [3, 4, 6, 3],
+    101: [3, 4, 23, 3],
+    152: [3, 8, 36, 3],
+}
+
+
+def _conv_bn(x, filters, size, stride=1, groups=1, act=None,
+             is_test=False):
+    c = fluid.layers.conv2d(
+        x, filters, size, stride=stride, padding=(size - 1) // 2,
+        groups=groups, bias_attr=False)
+    return fluid.layers.batch_norm(c, act=act, is_test=is_test)
+
+
+def _squeeze_excitation(x, reduction_ratio=16):
+    c = x.shape[1]
+    pool = fluid.layers.pool2d(x, pool_type="avg", global_pooling=True)
+    pool = fluid.layers.reshape(pool, shape=[0, c])
+    squeeze = fluid.layers.fc(pool, max(c // reduction_ratio, 4),
+                              act="relu")
+    excite = fluid.layers.fc(squeeze, c, act="sigmoid")
+    excite = fluid.layers.reshape(excite, shape=[0, c, 1, 1])
+    return fluid.layers.elementwise_mul(x, excite, axis=0)
+
+
+def bottleneck_block(x, filters, stride, cardinality=32, is_test=False):
+    conv0 = _conv_bn(x, filters, 1, act="relu", is_test=is_test)
+    conv1 = _conv_bn(conv0, filters, 3, stride=stride,
+                     groups=cardinality, act="relu", is_test=is_test)
+    conv2 = _conv_bn(conv1, filters * 2, 1, is_test=is_test)
+    scale = _squeeze_excitation(conv2)
+    if x.shape[1] != filters * 2 or stride != 1:
+        shortcut = _conv_bn(x, filters * 2, 1, stride=stride,
+                            is_test=is_test)
+    else:
+        shortcut = x
+    return fluid.layers.relu(
+        fluid.layers.elementwise_add(shortcut, scale))
+
+
+def se_resnext(img, class_dim=1000, depth=50, cardinality=32,
+               is_test=False):
+    layers_per_stage = DEPTH_CFG[depth]
+    x = _conv_bn(img, 64, 7, stride=2, act="relu", is_test=is_test)
+    x = fluid.layers.pool2d(x, pool_size=3, pool_stride=2, pool_padding=1,
+                            pool_type="max")
+    filters = 128
+    for stage, n_blocks in enumerate(layers_per_stage):
+        for b in range(n_blocks):
+            stride = 2 if (b == 0 and stage != 0) else 1
+            x = bottleneck_block(x, filters, stride,
+                                 cardinality=cardinality,
+                                 is_test=is_test)
+        filters *= 2
+    pool = fluid.layers.pool2d(x, pool_type="avg", global_pooling=True)
+    pool = fluid.layers.reshape(pool, shape=[0, pool.shape[1]])
+    drop = fluid.layers.dropout(pool, dropout_prob=0.2, is_test=is_test)
+    return fluid.layers.fc(drop, class_dim, act="softmax")
+
+
+def build_train(depth=50, class_dim=1000, image_size=224, lr=0.1,
+                cardinality=32, is_test=False, amp=False):
+    """Training graph inside the current program guard: returns
+    (img, label, avg_loss, acc)."""
+    img = fluid.layers.data("img", shape=[3, image_size, image_size])
+    label = fluid.layers.data("label", shape=[1], dtype="int64")
+    prob = se_resnext(img, class_dim=class_dim, depth=depth,
+                      cardinality=cardinality, is_test=is_test)
+    loss = fluid.layers.cross_entropy(prob, label)
+    avg_loss = fluid.layers.mean(loss)
+    acc = fluid.layers.accuracy(prob, label)
+    if not is_test:
+        opt = fluid.optimizer.Momentum(
+            learning_rate=lr, momentum=0.9,
+            regularization=fluid.regularizer.L2Decay(1e-4))
+        if amp:
+            opt = fluid.contrib.mixed_precision.decorate(opt)
+        opt.minimize(avg_loss)
+    return img, label, avg_loss, acc

@@ -38,6 +38,7 @@ __all__ = [
     "LambOptimizer",
     "LarsMomentum",
     "LarsMomentumOptimizer",
+    "GradientMergeOptimizer",
     "ExponentialMovingAverage",
     "ModelAverage",
     "RecomputeOptimizer",
@@ -206,16 +207,21 @@ class Optimizer:
             program.global_block(), [p for p, g in params_grads if g is not None]
         )
         optimize_ops = []
+        # current_block (not global_block): under a conditional (e.g.
+        # GradientMergeOptimizer's k-step boundary Switch) the update ops
+        # must land INSIDE the branch; outside any control flow the
+        # current block IS the global block
+        target_block = program.current_block()
         for param_and_grad in params_grads:
             if param_and_grad[1] is None:
                 continue
             if not param_and_grad[0].trainable:
                 continue
             with program._optimized_guard(param_and_grad):
-                op = self._append_optimize_op(program.global_block(),
+                op = self._append_optimize_op(target_block,
                                               param_and_grad)
                 optimize_ops.append(op)
-        self._finish_update(program.global_block(), params_grads)
+        self._finish_update(target_block, params_grads)
         return optimize_ops
 
     def _create_accumulators(self, block, parameters):
@@ -718,6 +724,89 @@ class RecomputeOptimizer(Optimizer):
         ]
         return self._optimizer.minimize(loss, startup_program, parameter_list,
                                         no_grad_set)
+
+
+class GradientMergeOptimizer:
+    """Batch-merge gradient accumulation (reference
+    ir/multi_batch_merge_pass.cc + the dist_mnist_batch_merge.py payload;
+    later-fluid exposes the same thing as GradientMergeOptimizer).
+
+    Every step accumulates grads into persistable ``@GRAD@MERGED``
+    buffers; every ``k_steps``-th step a conditional_block applies the
+    inner optimizer to the merged (optionally averaged) grads and zeroes
+    the buffers — k microbatches behave like one k-times-larger batch.
+    The conditional lowers to lax.cond (traced predicate), so the whole
+    thing stays inside the one compiled step."""
+
+    def __init__(self, inner_optimizer, k_steps=1, avg=True):
+        if k_steps < 1:
+            raise ValueError("k_steps must be >= 1")
+        self.inner_optimizer = inner_optimizer
+        self.k_steps = int(k_steps)
+        self.avg = avg
+
+    def __getattr__(self, item):
+        return getattr(self.inner_optimizer, item)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, grad_clip=None):
+        from . import layers
+        from .layers.control_flow import Switch
+
+        if self.k_steps == 1:
+            return self.inner_optimizer.minimize(
+                loss, startup_program, parameter_list, no_grad_set)
+        params_grads = self.inner_optimizer.backward(
+            loss, startup_program, parameter_list, no_grad_set)
+        main = loss.block.program
+        block = main.global_block()
+
+        # persistable step counter (int64 [1], zero-initialized)
+        counter = layers.create_global_var(
+            shape=[1], value=0, dtype="int64", persistable=True,
+            name=unique_name.generate("gradient_merge_step"))
+        layers.increment(counter, value=1, in_place=True)
+
+        merged_pgs = []
+        for p, g in params_grads:
+            if g is None:
+                continue
+            # NB: no "@GRAD" in the name — the lowering treats @GRAD-
+            # suffixed vars as optional gradient temporaries, but this
+            # buffer is persistable cross-step state
+            m = block.create_var(
+                name=unique_name.generate(p.name + ".merged_grad"),
+                shape=p.shape, dtype=p.dtype, persistable=True)
+            m.stop_gradient = True
+            Constant(0.0)(m)
+            # m += g
+            block.append_op(type="elementwise_add",
+                            inputs={"X": [m.name], "Y": [g.name]},
+                            outputs={"Out": [m.name]}, attrs={})
+            merged_pgs.append((p, m))
+
+        k_var = layers.fill_constant(shape=[1], dtype="int64",
+                                     value=self.k_steps)
+        zero = layers.fill_constant(shape=[1], dtype="int64", value=0)
+        rem = layers.elementwise_mod(counter, k_var)
+        is_boundary = layers.equal(rem, zero)
+
+        ops = None
+        sw = Switch()
+        with sw.case(is_boundary):
+            apply_pgs = []
+            for p, m in merged_pgs:
+                if self.avg:
+                    eff = layers.scale(m, scale=1.0 / self.k_steps)
+                else:
+                    eff = m
+                apply_pgs.append((p, eff))
+            ops = self.inner_optimizer.apply_gradients(apply_pgs)
+            for _p, m in merged_pgs:
+                layers.assign(layers.scale(m, scale=0.0), m)
+        with sw.default():
+            pass
+        return ops, params_grads
 
 
 class LookaheadOptimizer:

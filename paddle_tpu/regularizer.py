@@ -37,7 +37,9 @@ def append_regularization_ops(parameters_and_grads, regularization=None):
     """grad += coeff * penalty'(param) for each param with a regularizer
     (reference regularizer.py:append_regularization_ops)."""
     program = default_main_program()
-    block = program.global_block()
+    # current_block: under a conditional (GradientMergeOptimizer boundary
+    # Switch) the decay ops must land in the branch with their inputs
+    block = program.current_block()
     out = []
     for param, grad in parameters_and_grads:
         if grad is None:

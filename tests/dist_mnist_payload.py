@@ -1,0 +1,123 @@
+"""dist_mnist-analog payload (reference dist_mnist.py over
+test_dist_base.py): a REAL conv model — conv-pool-conv-pool-fc, the
+reference's mnist shape — trained sync-PS across 2 pservers x 2 trainers,
+per-step losses on stdout, final param abs-sums for the parity check."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+STEPS = 5
+BS = 8  # per trainer
+PARAMS = ("mn_c1", "mn_c2", "mn_fc")
+
+
+def build():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 77
+    startup.random_seed = 77
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[1, 14, 14])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        c1 = fluid.layers.conv2d(
+            img, 8, 3, padding=1, act="relu",
+            param_attr=fluid.ParamAttr(name="mn_c1"), bias_attr=False)
+        p1 = fluid.layers.pool2d(c1, pool_size=2, pool_stride=2)
+        c2 = fluid.layers.conv2d(
+            p1, 16, 3, padding=1, act="relu",
+            param_attr=fluid.ParamAttr(name="mn_c2"), bias_attr=False)
+        p2 = fluid.layers.pool2d(c2, pool_size=2, pool_stride=2)
+        flat = fluid.layers.reshape(p2, shape=[0, 16 * 3 * 3])
+        logits = fluid.layers.fc(flat, 10,
+                                 param_attr=fluid.ParamAttr(name="mn_fc"))
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss
+
+
+def make_data(n_trainers):
+    rng = np.random.RandomState(123)
+    batches = []
+    for _ in range(STEPS):
+        xs = rng.rand(n_trainers * BS, 1, 14, 14).astype("f")
+        ys = rng.randint(0, 10, (n_trainers * BS, 1)).astype("int64")
+        batches.append((xs, ys))
+    return batches
+
+
+def _dump(scope):
+    for pname in PARAMS:
+        v = np.asarray(scope.find_var(pname).get_tensor().numpy())
+        print("param:%s:%.8f" % (pname, float(np.abs(v).sum())),
+              flush=True)
+
+
+def run_local():
+    main, startup, loss = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for xs, ys in make_data(2):
+            lo, = exe.run(main, feed={"img": xs, "label": ys},
+                          fetch_list=[loss])
+            print("loss:%.8f" % float(np.asarray(lo).reshape(-1)[0]),
+                  flush=True)
+        _dump(scope)
+
+
+def run_pserver():
+    eps = os.environ["PADDLE_PSERVER_ENDPOINTS"]
+    cur = os.environ["PADDLE_CURRENT_ENDPOINT"]
+    n = int(os.environ["PADDLE_TRAINERS_NUM"])
+    main, startup, loss = build()
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, startup_program=startup,
+                pservers=eps, trainers=n)
+    prog, sprog = t.get_pserver_programs(cur)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(sprog)
+        print("pserver:ready", flush=True)
+        exe.run(prog, scope=scope)
+    print("pserver:done", flush=True)
+
+
+def run_trainer():
+    eps = os.environ["PADDLE_PSERVER_ENDPOINTS"]
+    tid = int(os.environ["PADDLE_TRAINER_ID"])
+    n = int(os.environ["PADDLE_TRAINERS_NUM"])
+    main, startup, loss = build()
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=tid, program=main, startup_program=startup,
+                pservers=eps, trainers=n)
+    tp = t.get_trainer_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        half = slice(tid * BS, (tid + 1) * BS)
+        for xs, ys in make_data(n):
+            lo, = exe.run(tp, feed={"img": xs[half], "label": ys[half]},
+                          fetch_list=[loss], scope=scope)
+            print("loss:%.8f" % float(np.asarray(lo).reshape(-1)[0]),
+                  flush=True)
+        _dump(scope)
+        scope._ps_comm.complete()
+
+
+if __name__ == "__main__":
+    role = os.environ.get("PADDLE_TRAINING_ROLE", "LOCAL")
+    if role == "PSERVER":
+        run_pserver()
+    elif role == "TRAINER":
+        run_trainer()
+    else:
+        run_local()
