@@ -172,7 +172,9 @@ class _RematPlan:
             for i in idxs:
                 op = block.ops[i]
                 opdef = _get(op.type)
-                replayable = not (opdef.stateful or opdef.n_rng > 0)
+                uses_rng = opdef.n_rng > 0 and (
+                    opdef.rng_when is None or opdef.rng_when(op.attrs))
+                replayable = not (opdef.stateful or uses_rng)
                 for n in op.input_arg_names:
                     if not n or n in produced or n in inner:
                         continue

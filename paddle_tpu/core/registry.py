@@ -72,6 +72,11 @@ class OpDef:
         self.duplicable_outputs = frozenset(duplicable_outputs)
         self.stateful = stateful
         self.n_rng = n_rng  # number of PRNG keys the lowering consumes
+        # optional per-op predicate attrs -> bool: does THIS instance
+        # actually consume rng?  (flash_attention only draws when its
+        # dropout is active; the recompute planner uses this to keep the
+        # dropout-free instances replayable)
+        self.rng_when = None
 
     # -- validation ----------------------------------------------------------
     def validate(self, op):

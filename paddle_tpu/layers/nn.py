@@ -1456,24 +1456,36 @@ def pixel_shuffle(x, upscale_factor):
 
 
 def flash_attention(q, k, v, bias_qk=None, causal=False, scale=0.0,
+                    layout="BHSD", dropout_prob=0.0, is_test=False,
                     name=None):
-    """Fused blockwise multi-head attention on [B, H, S, D] tensors
-    (Pallas TPU kernel; see paddle_tpu/pallas_kernels/flash_attention.py).
-    Analog of the reference's fused attention (multihead_matmul_op.cu) but
+    """Fused blockwise multi-head attention (Pallas TPU kernel; see
+    paddle_tpu/pallas_kernels/flash_attention.py).  Analog of the
+    reference's fused attention (multihead_matmul_op.cu) but
     differentiable/trainable.
 
-    bias_qk is an additive mask (no gradient flows to it).  scale=0.0 means
-    "use 1/sqrt(head_dim)"; pass scale=1.0 if q is already pre-scaled."""
+    layout: "BHSD" (default) or "BSHD" ([B, S, H, D] — transpose-free
+    emission: split heads with a reshape, no transpose, no relayout
+    copies).  dropout_prob > 0 applies attention-prob dropout inside the
+    op when not is_test.  bias_qk is an additive mask (no gradient flows
+    to it).  scale=0.0 means "use 1/sqrt(head_dim)"; pass scale=1.0 if q
+    is already pre-scaled."""
     helper = LayerHelper("flash_attention", name=name)
     out = helper.create_variable_for_type_inference(dtype=q.dtype)
+    # Mask must be DECLARED: with dropout active the custom grad replays
+    # with this saved mask (an undeclared slot would silently drop it and
+    # the backward would run mask-free — decoupled from the sampled loss)
+    mask = helper.create_variable_for_type_inference(dtype="uint8")
+    mask.stop_gradient = True
     inputs = {"Q": [q], "K": [k], "V": [v]}
     if bias_qk is not None:
         inputs["BiasQK"] = [bias_qk]
     helper.append_op(
         type="flash_attention",
         inputs=inputs,
-        outputs={"Out": [out]},
-        attrs={"causal": causal, "scale": float(scale)},
+        outputs={"Out": [out], "Mask": [mask]},
+        attrs={"causal": causal, "scale": float(scale),
+               "layout": layout, "dropout_prob": float(dropout_prob),
+               "is_test": is_test},
     )
     return out
 

@@ -615,31 +615,163 @@ def uniform_random_batch_size_like(ctx, input, shape=(), input_dim_idx=0,
                               minval=min, maxval=max)
 
 
+def _attention_composed(q, k, v, bias, causal, sm_scale, keep_mask=None,
+                        dropout_prob=0.0, bshd=True):
+    """Composed attention with optional attention-prob dropout
+    (upscale_in_train) applied via an explicit KEEP MASK (so forward and
+    backward share the exact same mask — cf. the dropout op's saved
+    Mask).  Einsums run in the carry dtype (bf16 under AMP; the MXU
+    accumulates f32 internally); softmax normalizes in f32 like
+    _ref_attention.  bshd=True takes [B, S, H, D] operands transpose-free
+    (dot_general batches the non-adjacent dims); bshd=False [B, H, S, D].
+    """
+    eq_s = "bqhd,bkhd->bhqk" if bshd else "bhqd,bhkd->bhqk"
+    eq_o = "bhqk,bkhd->bqhd" if bshd else "bhqk,bhkd->bhqd"
+    s = jnp.einsum(eq_s, q, k) * jnp.asarray(sm_scale, q.dtype)
+    if bias is not None:
+        s = s + bias.astype(s.dtype)
+    if causal:
+        qi = lax.broadcasted_iota(jnp.int32, s.shape[-2:], 0)
+        kj = lax.broadcasted_iota(jnp.int32, s.shape[-2:], 1)
+        s = jnp.where(kj <= qi, s, jnp.asarray(-1e30, s.dtype))
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if keep_mask is not None:
+        p = jnp.where(keep_mask.astype(bool),
+                      p / jnp.asarray(1.0 - dropout_prob, p.dtype),
+                      jnp.asarray(0.0, p.dtype))
+    return jnp.einsum(eq_o, p, v)
+
+
+def _fa_check_layout(layout):
+    if layout not in ("BHSD", "BSHD"):
+        raise ValueError(
+            "flash_attention layout must be 'BHSD' or 'BSHD', got %r"
+            % (layout,))
+
+
+def _fa_uses_dropout(attrs):
+    return (float(attrs.get("dropout_prob", 0.0) or 0.0) > 0.0
+            and not attrs.get("is_test", False))
+
+
+def _flash_attention_grad_maker(op, no_grad_set):
+    inputs = {
+        "Q": list(op.input("Q")),
+        "K": list(op.input("K")),
+        "V": list(op.input("V")),
+        "Mask": list(op.output("Mask")),
+        "GRAD@Out": [_grad_var_name(op.output("Out")[0])],
+    }
+    if op.input("BiasQK"):
+        inputs["BiasQK"] = list(op.input("BiasQK"))
+    outputs = {}
+    for slot in ("Q", "K", "V"):
+        n = op.input(slot)[0]
+        if n not in no_grad_set:
+            outputs["X@" + slot] = [_grad_var_name(n)]
+    if not outputs:
+        return []
+    return [GradOpDesc("flash_attention_grad", inputs, outputs,
+                       dict(op.attrs))]
+
+
 @register_op(
     "flash_attention",
     inputs=("Q", "K", "V", "BiasQK"),
-    outputs=("Out",),
-    attrs={"causal": False, "scale": 0.0},
+    outputs=("Out", "Mask"),
+    attrs={"causal": False, "scale": 0.0, "layout": "BHSD",
+           "dropout_prob": 0.0, "is_test": False},
     optional_inputs=("BiasQK",),
     no_grad_inputs=("BiasQK",),
+    grad_maker=_flash_attention_grad_maker,
+    n_rng=1,  # drawn only when dropout is active — see rng_when below
 )
-def flash_attention_op(ctx, q, k, v, bias_qk=None, causal=False, scale=0.0):
+def flash_attention_op(ctx, q, k, v, bias_qk=None, causal=False, scale=0.0,
+                       layout="BHSD", dropout_prob=0.0, is_test=False):
     """Fused blockwise attention (Pallas TPU kernel with jnp fallback).
 
     TPU-native replacement for the reference's fused inference attention
     (paddle/fluid/operators/fused/multihead_matmul_op.cu) — but trainable:
     the kernel carries a FlashAttention backward (pallas_kernels/
-    flash_attention.py).  q/k/v: [B, H, S, D]; bias_qk: [B, 1|H, Sq, Sk].
+    flash_attention.py).  q/k/v: [B, H, S, D] (layout="BHSD", default) or
+    [B, S, H, D] (layout="BSHD" — transpose-free: the head split is a
+    plain reshape and dot_general batches over non-adjacent dims; on the
+    bench chip XLA re-inserts equivalent layout copies, so this is a
+    capability, not a measured win — BASELINE.md); bias_qk:
+    [B, 1|H, Sq, Sk].
 
-    BiasQK is an additive MASK, not a trainable tensor: the TPU backward
-    kernel returns no bias cotangent, so it is registered no-grad on every
+    dropout_prob > 0 (training mode) applies attention-prob dropout
+    (upscale_in_train) inside the op via a sampled keep mask that is
+    SAVED as the Mask output, so the custom backward replays with the
+    exact forward mask (the dropout-op contract; an rng re-draw in the
+    backward would decouple gradients from the sampled loss).  The Pallas
+    kernel engages for dropout-free BHSD at the measured seq cutoff.
+
+    BiasQK is an additive MASK, not a trainable tensor: the backward
+    returns no bias cotangent, so it is registered no-grad on every
     backend.  scale=0.0 (the default) means "use 1/sqrt(head_dim)"; pass
     scale=1.0 explicitly if the scaling is already folded into q.
     """
     from ..pallas_kernels import flash_attention as _fa
 
-    sm_scale = scale if scale else None
-    return _fa(q, k, v, bias=bias_qk, causal=causal, sm_scale=sm_scale)
+    _fa_check_layout(layout)
+    head_dim = q.shape[-1]
+    sm_scale = scale if scale else head_dim ** -0.5
+    bshd = layout == "BSHD"
+    if _fa_uses_dropout({"dropout_prob": dropout_prob,
+                         "is_test": is_test}):
+        B = q.shape[0]
+        H = q.shape[2] if bshd else q.shape[1]
+        Sq = q.shape[1] if bshd else q.shape[2]
+        Sk = k.shape[1] if bshd else k.shape[2]
+        keep = jax.random.bernoulli(ctx.rng(), 1.0 - dropout_prob,
+                                    (B, H, Sq, Sk))
+        out = _attention_composed(q, k, v, bias_qk, causal, sm_scale,
+                                  keep, dropout_prob, bshd)
+        return out, keep.astype(jnp.uint8)
+    mask_placeholder = jnp.zeros((1,), jnp.uint8)
+    if bshd:
+        return (_attention_composed(q, k, v, bias_qk, causal, sm_scale,
+                                    bshd=True), mask_placeholder)
+    return (_fa(q, k, v, bias=bias_qk, causal=causal, sm_scale=sm_scale),
+            mask_placeholder)
+
+
+@register_op(
+    "flash_attention_grad",
+    inputs=("Q", "K", "V", "BiasQK", "Mask", "GRAD@Out"),
+    outputs=("X@Q", "X@K", "X@V"),
+    attrs={"causal": False, "scale": 0.0, "layout": "BHSD",
+           "dropout_prob": 0.0, "is_test": False},
+    optional_inputs=("BiasQK",),
+    grad_maker=None,
+)
+def flash_attention_grad_op(ctx, q, k, v, bias_qk, mask, dy, causal=False,
+                            scale=0.0, layout="BHSD", dropout_prob=0.0,
+                            is_test=False):
+    """Backward: vjp of the composed forward replayed with the SAVED
+    dropout mask (exact forward/backward mask agreement); the
+    dropout-free path differentiates the kernel's own custom vjp."""
+    from ..pallas_kernels import flash_attention as _fa
+
+    _fa_check_layout(layout)
+    sm_scale = scale if scale else q.shape[-1] ** -0.5
+    bshd = layout == "BSHD"
+    if _fa_uses_dropout({"dropout_prob": dropout_prob,
+                         "is_test": is_test}):
+        fn = lambda a, b, c: _attention_composed(
+            a, b, c, bias_qk, causal, sm_scale, mask, dropout_prob, bshd)
+    elif bshd:
+        fn = lambda a, b, c: _attention_composed(
+            a, b, c, bias_qk, causal, sm_scale, bshd=True)
+    else:
+        fn = lambda a, b, c: _fa(a, b, c, bias=bias_qk, causal=causal,
+                                 sm_scale=sm_scale)
+    _, vjp = jax.vjp(fn, q, k, v)
+    return vjp(dy)
+
+
+flash_attention_op.opdef.rng_when = _fa_uses_dropout
 
 
 @register_op(

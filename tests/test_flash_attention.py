@@ -136,3 +136,131 @@ def test_bert_flash_path_builds_and_trains():
         for _ in range(5):
             l1, = exe.run(main, feed=feed, fetch_list=[loss])
     assert float(np.asarray(l1).ravel()[0]) < float(np.asarray(l0).ravel()[0])
+
+
+class TestFlashAttentionLayoutAndDropout:
+    """Round-3 op extensions: layout="BSHD" (transpose-free operands) and
+    in-op attention-prob dropout (upscale_in_train)."""
+
+    def _run_op(self, q, k, v, attrs, seed=None):
+        import paddle_tpu as fluid
+        from paddle_tpu.framework import convert_np_dtype_to_dtype_
+
+        main, startup = fluid.Program(), fluid.Program()
+        if seed is not None:
+            main.random_seed = seed
+        with fluid.program_guard(main, startup):
+            block = main.global_block()
+            for nm, arr in (("faq", q), ("fak", k), ("fav", v)):
+                block.create_var(name=nm, shape=arr.shape,
+                                 dtype=convert_np_dtype_to_dtype_(
+                                     arr.dtype))
+            block.create_var(name="fao")
+            block.append_op(type="flash_attention",
+                            inputs={"Q": ["faq"], "K": ["fak"],
+                                    "V": ["fav"]},
+                            outputs={"Out": ["fao"]}, attrs=attrs)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            out, = exe.run(main, feed={"faq": q, "fak": k, "fav": v},
+                           fetch_list=["fao"])
+        return np.asarray(out)
+
+    def test_bshd_matches_bhsd(self):
+        rng = np.random.RandomState(0)
+        B, H, S, D = 2, 3, 8, 4
+        q = rng.randn(B, H, S, D).astype("f")
+        k = rng.randn(B, H, S, D).astype("f")
+        v = rng.randn(B, H, S, D).astype("f")
+        bhsd = self._run_op(q, k, v, {"causal": False, "scale": 0.0})
+        bshd = self._run_op(
+            q.transpose(0, 2, 1, 3).copy(), k.transpose(0, 2, 1, 3).copy(),
+            v.transpose(0, 2, 1, 3).copy(),
+            {"causal": False, "scale": 0.0, "layout": "BSHD"})
+        np.testing.assert_allclose(bshd.transpose(0, 2, 1, 3), bhsd,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_in_op_dropout_semantics(self):
+        """Dropout inside the op: is_test passes through exactly; training
+        zeroes some prob mass but keeps the expected output scale."""
+        rng = np.random.RandomState(1)
+        B, H, S, D = 2, 2, 16, 4
+        q = rng.randn(B, H, S, D).astype("f")
+        k = rng.randn(B, H, S, D).astype("f")
+        v = rng.randn(B, H, S, D).astype("f")
+        base = self._run_op(q, k, v, {"causal": False, "scale": 0.0})
+        test_mode = self._run_op(
+            q, k, v, {"causal": False, "scale": 0.0,
+                      "dropout_prob": 0.5, "is_test": True})
+        np.testing.assert_allclose(test_mode, base, rtol=1e-4, atol=1e-5)
+        trained = self._run_op(
+            q, k, v, {"causal": False, "scale": 0.0,
+                      "dropout_prob": 0.5, "is_test": False}, seed=3)
+        # not identical (masking happened)...
+        assert np.abs(trained - base).max() > 1e-3
+        # ...but unbiased in scale: means stay in the same ballpark
+        assert np.abs(trained.mean() - base.mean()) < 0.2
+
+
+def test_in_op_dropout_grad_uses_saved_mask():
+    """The backward must replay with the SAVED forward mask: analytic
+    grads fetched from the program must equal the numpy backward computed
+    from the fetched Mask output (a re-drawn mask would diverge)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.framework import convert_np_dtype_to_dtype_
+
+    rng = np.random.RandomState(7)
+    B, H, S, D = 1, 2, 8, 4
+    qv = rng.randn(B, H, S, D).astype("f")
+    kv = rng.randn(B, H, S, D).astype("f")
+    vv = rng.randn(B, H, S, D).astype("f")
+    prob = 0.5
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        block = main.global_block()
+        names = {}
+        for nm, arr in (("gq", qv), ("gk", kv), ("gv", vv)):
+            v = block.create_var(name=nm, shape=arr.shape,
+                                 dtype=convert_np_dtype_to_dtype_(
+                                     arr.dtype))
+            v.stop_gradient = False
+            names[nm] = v
+        out_v = block.create_var(name="gout")
+        mask_v = block.create_var(name="gmask")
+        block.append_op(type="flash_attention",
+                        inputs={"Q": ["gq"], "K": ["gk"], "V": ["gv"]},
+                        outputs={"Out": ["gout"], "Mask": ["gmask"]},
+                        attrs={"causal": False, "scale": 0.0,
+                               "dropout_prob": prob, "is_test": False})
+        out_v.shape = qv.shape
+        out_v.dtype = names["gq"].dtype
+        out_v.stop_gradient = False
+        loss = fluid.layers.reduce_sum(out_v)
+        grads = fluid.gradients([loss], [names["gq"], names["gk"],
+                                         names["gv"]])
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        res = exe.run(main, feed={"gq": qv, "gk": kv, "gv": vv},
+                      fetch_list=["gout", "gmask"] + [g.name
+                                                      for g in grads])
+    out, mask, dq, dk, dv = [np.asarray(r) for r in res]
+    keep = mask.astype(bool)
+    scale = D ** -0.5
+    s = np.einsum("bhqd,bhkd->bhqk", qv, kv) * scale
+    e = np.exp(s - s.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    pd = np.where(keep, p / (1 - prob), 0.0).astype("f")
+    np.testing.assert_allclose(out, np.einsum("bhqk,bhkd->bhqd", pd, vv),
+                               rtol=1e-4, atol=1e-5)
+    dy = np.ones_like(out)
+    want_dv = np.einsum("bhqk,bhqd->bhkd", pd, dy)
+    dpd = np.einsum("bhqd,bhkd->bhqk", dy, vv)
+    dp = np.where(keep, dpd / (1 - prob), 0.0)
+    ds = p * (dp - (dp * p).sum(-1, keepdims=True))
+    want_dq = np.einsum("bhqk,bhkd->bhqd", ds, kv) * scale
+    want_dk = np.einsum("bhqk,bhqd->bhkd", ds, qv) * scale
+    np.testing.assert_allclose(dv, want_dv, rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(dq, want_dq, rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(dk, want_dk, rtol=1e-3, atol=1e-4)
