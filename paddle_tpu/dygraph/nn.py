@@ -392,3 +392,382 @@ def np_prod(xs):
     for x in xs:
         out *= int(x)
     return out
+
+
+def _triple(v):
+    return [v, v, v] if isinstance(v, int) else list(v)
+
+
+class Conv3D(Layer):
+    """3-D convolution (reference dygraph/nn.py Conv3D:270)."""
+
+    def __init__(self, name_scope, num_channels, num_filters, filter_size,
+                 stride=1, padding=0, dilation=1, groups=1,
+                 param_attr=None, bias_attr=None, use_cudnn=True,
+                 act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        groups = groups or 1
+        fs = _triple(filter_size)
+        self._attrs = {"strides": _triple(stride),
+                       "paddings": _triple(padding),
+                       "dilations": _triple(dilation), "groups": groups,
+                       "data_format": "NCDHW"}
+        self._act = act
+        import math
+
+        fan_in = (num_channels // groups) * fs[0] * fs[1] * fs[2]
+        self.weight = self.create_parameter(
+            attr=param_attr,
+            shape=[num_filters, num_channels // groups] + fs, dtype=dtype,
+            default_initializer=Normal(0.0, math.sqrt(2.0 / fan_in)))
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                attr=bias_attr, shape=[num_filters], dtype=dtype,
+                is_bias=True)
+
+    def forward(self, input):
+        h = self._helper
+        pre = h.create_variable_for_type_inference(self._dtype)
+        h.append_op(type="conv3d",
+                    inputs={"Input": [input], "Filter": [self.weight]},
+                    outputs={"Output": [pre]}, attrs=dict(self._attrs))
+        if self.bias is not None:
+            out = h.create_variable_for_type_inference(self._dtype)
+            h.append_op(type="elementwise_add",
+                        inputs={"X": [pre], "Y": [self.bias]},
+                        outputs={"Out": [out]}, attrs={"axis": 1})
+            pre = out
+        return h.append_activation(pre, self._act)
+
+
+class Conv3DTranspose(Layer):
+    """3-D transposed convolution (reference dygraph/nn.py:491)."""
+
+    def __init__(self, name_scope, num_channels, num_filters, filter_size,
+                 output_size=None, padding=0, stride=1, dilation=1,
+                 groups=1, param_attr=None, bias_attr=None,
+                 use_cudnn=True, act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        groups = groups or 1
+        fs = _triple(filter_size)
+        self._attrs = {"strides": _triple(stride),
+                       "paddings": _triple(padding),
+                       "dilations": _triple(dilation), "groups": groups,
+                       "data_format": "NCDHW",
+                       "output_size": list(output_size) if output_size
+                       else []}
+        self._act = act
+        self.weight = self.create_parameter(
+            attr=param_attr,
+            shape=[num_channels, num_filters // groups] + fs, dtype=dtype)
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                attr=bias_attr, shape=[num_filters], dtype=dtype,
+                is_bias=True)
+
+    def forward(self, input):
+        h = self._helper
+        pre = h.create_variable_for_type_inference(self._dtype)
+        h.append_op(type="conv3d_transpose",
+                    inputs={"Input": [input], "Filter": [self.weight]},
+                    outputs={"Output": [pre]}, attrs=dict(self._attrs))
+        if self.bias is not None:
+            out = h.create_variable_for_type_inference(self._dtype)
+            h.append_op(type="elementwise_add",
+                        inputs={"X": [pre], "Y": [self.bias]},
+                        outputs={"Out": [out]}, attrs={"axis": 1})
+            pre = out
+        return h.append_activation(pre, self._act)
+
+
+class GRUUnit(Layer):
+    """Single GRU step (reference dygraph/nn.py GRUUnit:1653): input is
+    the projected [B, 3D] gates, hidden [B, D]."""
+
+    def __init__(self, name_scope, size, param_attr=None, bias_attr=None,
+                 activation="tanh", gate_activation="sigmoid",
+                 origin_mode=False, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._size = size  # 3 * D, reference convention
+        D = size // 3
+        self._attrs = {
+            "activation": {"identity": 0, "sigmoid": 1, "tanh": 2,
+                           "relu": 3}[activation],
+            "gate_activation": {"identity": 0, "sigmoid": 1, "tanh": 2,
+                                "relu": 3}[gate_activation],
+            "origin_mode": origin_mode,
+        }
+        self.weight = self.create_parameter(
+            attr=param_attr, shape=[D, 3 * D], dtype=dtype)
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                attr=bias_attr, shape=[1, 3 * D], dtype=dtype,
+                is_bias=True)
+
+    def forward(self, input, hidden):
+        h = self._helper
+        gate = h.create_variable_for_type_inference(self._dtype)
+        reset = h.create_variable_for_type_inference(self._dtype)
+        out = h.create_variable_for_type_inference(self._dtype)
+        ins = {"Input": [input], "HiddenPrev": [hidden],
+               "Weight": [self.weight]}
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        h.append_op(type="gru_unit", inputs=ins,
+                    outputs={"Gate": [gate], "ResetHiddenPrev": [reset],
+                             "Hidden": [out]}, attrs=dict(self._attrs))
+        return out, reset, gate
+
+
+class NCE(Layer):
+    """Noise-contrastive estimation loss (reference dygraph/nn.py
+    NCE:1837)."""
+
+    def __init__(self, name_scope, num_total_classes, dim,
+                 sample_weight=None, param_attr=None, bias_attr=None,
+                 num_neg_samples=10, sampler="uniform",
+                 custom_dist=None, seed=0, is_sparse=False,
+                 dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._attrs = {
+            "num_total_classes": int(num_total_classes),
+            "num_neg_samples": int(num_neg_samples),
+            "seed": int(seed),
+            "sampler": {"uniform": 0, "log_uniform": 1,
+                        "custom_dist": 2}[sampler],
+            "is_sparse": is_sparse,
+        }
+        if sampler == "custom_dist" and custom_dist is None:
+            raise ValueError(
+                "sampler='custom_dist' requires the custom_dist "
+                "probability vector")
+        self._custom_dist = custom_dist
+        self._sample_weight = sample_weight
+        self.weight = self.create_parameter(
+            attr=param_attr, shape=[num_total_classes, dim], dtype=dtype)
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                attr=bias_attr, shape=[num_total_classes], dtype=dtype,
+                is_bias=True)
+
+    def forward(self, input, label, sample_weight=None):
+        from .base import to_variable
+
+        h = self._helper
+        cost = h.create_variable_for_type_inference(self._dtype)
+        slog = h.create_variable_for_type_inference(self._dtype)
+        slab = h.create_variable_for_type_inference("int64")
+        ins = {"Input": [input], "Label": [label],
+               "Weight": [self.weight]}
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        if self._custom_dist is not None:
+            import numpy as _np
+
+            ins["CustomDistProbs"] = [to_variable(
+                _np.asarray(self._custom_dist, "float32"))]
+        sw = sample_weight if sample_weight is not None \
+            else self._sample_weight
+        if sw is not None:
+            if not hasattr(sw, "numpy"):
+                import numpy as _np
+
+                sw = to_variable(_np.asarray(sw, "float32"))
+            ins["SampleWeight"] = [sw]
+        h.append_op(type="nce", inputs=ins,
+                    outputs={"Cost": [cost], "SampleLogits": [slog],
+                             "SampleLabels": [slab]},
+                    attrs=dict(self._attrs))
+        return cost
+
+
+class BilinearTensorProduct(Layer):
+    """out[:, k] = x W_k y^T (reference dygraph/nn.py:2178)."""
+
+    def __init__(self, name_scope, size, x_dim, y_dim, name=None,
+                 act=None, param_attr=None, bias_attr=None,
+                 dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._act = act
+        self.weight = self.create_parameter(
+            attr=param_attr, shape=[size, x_dim, y_dim], dtype=dtype)
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                attr=bias_attr, shape=[1, size], dtype=dtype, is_bias=True)
+
+    def forward(self, x, y):
+        h = self._helper
+        out = h.create_variable_for_type_inference(self._dtype)
+        ins = {"X": [x], "Y": [y], "Weight": [self.weight]}
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        h.append_op(type="bilinear_tensor_product", inputs=ins,
+                    outputs={"Out": [out]})
+        return h.append_activation(out, self._act)
+
+
+class SequenceConv(Layer):
+    """Sequence convolution over [B, T, D] (reference dygraph/nn.py
+    SequenceConv:2554; LoD ragged batching becomes the padded design)."""
+
+    def __init__(self, name_scope, num_filters, filter_size=3,
+                 filter_stride=1, padding=None, bias_attr=None,
+                 param_attr=None, act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._num_filters = num_filters
+        self._filter_size = filter_size
+        self._act = act
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self.weight = None
+        self.bias = None
+
+    def forward(self, input):
+        h = self._helper
+        if self.weight is None:
+            D = int(input.shape[-1])
+            self.weight = self.create_parameter(
+                attr=self._param_attr,
+                shape=[self._filter_size * D, self._num_filters],
+                dtype=self._dtype)
+            if self._bias_attr is not False:
+                self.bias = self.create_parameter(
+                    attr=self._bias_attr, shape=[self._num_filters],
+                    dtype=self._dtype, is_bias=True)
+        pre = h.create_variable_for_type_inference(self._dtype)
+        h.append_op(type="sequence_conv",
+                    inputs={"X": [input], "Filter": [self.weight]},
+                    outputs={"Out": [pre]},
+                    attrs={"contextLength": self._filter_size,
+                           "contextStart": -(self._filter_size // 2),
+                           "contextStride": 1})
+        if self.bias is not None:
+            out = h.create_variable_for_type_inference(self._dtype)
+            h.append_op(type="elementwise_add",
+                        inputs={"X": [pre], "Y": [self.bias]},
+                        outputs={"Out": [out]}, attrs={"axis": -1})
+            pre = out
+        return h.append_activation(pre, self._act)
+
+
+class RowConv(Layer):
+    """Lookahead row convolution (reference dygraph/nn.py RowConv:2648)."""
+
+    def __init__(self, name_scope, future_context_size, param_attr=None,
+                 act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._act = act
+        self._future = future_context_size
+        self._param_attr = param_attr
+        self.weight = None
+
+    def forward(self, input):
+        h = self._helper
+        if self.weight is None:
+            D = int(input.shape[-1])
+            self.weight = self.create_parameter(
+                attr=self._param_attr, shape=[self._future + 1, D],
+                dtype=self._dtype)
+        out = h.create_variable_for_type_inference(self._dtype)
+        h.append_op(type="row_conv",
+                    inputs={"X": [input], "Filter": [self.weight]},
+                    outputs={"Out": [out]})
+        return h.append_activation(out, self._act)
+
+
+class SpectralNorm(Layer):
+    """Spectral weight normalization (reference dygraph/nn.py
+    SpectralNorm:2827): persistent u/v power-iteration state."""
+
+    def __init__(self, name_scope, dim=0, power_iters=1, eps=1e-12,
+                 dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._attrs = {"dim": dim, "power_iters": power_iters, "eps": eps}
+        self._u = None
+        self._v = None
+
+    def forward(self, weight):
+        h = self._helper
+        if self._u is None:
+            import numpy as _np
+
+            shape = [int(d) for d in weight.shape]
+            dim = self._attrs["dim"]
+            hh = shape[dim]
+            ww = 1
+            for i, d in enumerate(shape):
+                if i != dim:
+                    ww *= d
+            self._u = self.create_parameter(
+                attr=None, shape=[hh], dtype=self._dtype,
+                default_initializer=Normal(0.0, 1.0))
+            self._u.stop_gradient = True
+            self._v = self.create_parameter(
+                attr=None, shape=[ww], dtype=self._dtype,
+                default_initializer=Normal(0.0, 1.0))
+            self._v.stop_gradient = True
+        out = h.create_variable_for_type_inference(self._dtype)
+        h.append_op(type="spectral_norm",
+                    inputs={"Weight": [weight], "U": [self._u],
+                            "V": [self._v]},
+                    outputs={"Out": [out]}, attrs=dict(self._attrs))
+        return out
+
+
+class TreeConv(Layer):
+    """Tree-based convolution (reference dygraph/nn.py TreeConv:2927).
+    The tree_conv op emits the raw pre-activation conv; bias and the
+    activation (default tanh) are applied here, matching the reference
+    layer semantics."""
+
+    def __init__(self, name_scope, output_size, num_filters=1,
+                 max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+                 name=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._attrs = {"max_depth": max_depth}
+        self._act = act
+        self._output_size = output_size
+        self._num_filters = num_filters
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self.weight = None
+        self.bias = None
+
+    def forward(self, nodes_vector, edge_set):
+        h = self._helper
+        if self.weight is None:
+            F = int(nodes_vector.shape[-1])
+            self.weight = self.create_parameter(
+                attr=self._param_attr,
+                shape=[F, 3, self._output_size, self._num_filters],
+                dtype=self._dtype)
+            if self._bias_attr is not False:
+                # the op emits [B, N, output_size*num_filters] (flattened
+                # feature dim in the padded design): bias matches it
+                self.bias = self.create_parameter(
+                    attr=self._bias_attr,
+                    shape=[self._output_size * self._num_filters],
+                    dtype=self._dtype, is_bias=True)
+        out = h.create_variable_for_type_inference(self._dtype)
+        h.append_op(type="tree_conv",
+                    inputs={"NodesVector": [nodes_vector],
+                            "EdgeSet": [edge_set],
+                            "Filter": [self.weight]},
+                    outputs={"Out": [out]}, attrs=dict(self._attrs))
+        if self.bias is not None:
+            pre = h.create_variable_for_type_inference(self._dtype)
+            h.append_op(type="elementwise_add",
+                        inputs={"X": [out], "Y": [self.bias]},
+                        outputs={"Out": [pre]}, attrs={"axis": -1})
+            out = pre
+        return h.append_activation(out, self._act)
+
+
+__all__ += ["Conv3D", "Conv3DTranspose", "GRUUnit", "NCE",
+            "BilinearTensorProduct", "SequenceConv", "RowConv",
+            "SpectralNorm", "TreeConv"]

@@ -335,7 +335,10 @@ def tree_conv(ctx, nodes, edges, filt, max_depth=2):
         acc.append(jnp.zeros_like(acc[0]))
     stacked = jnp.stack(acc[:3], axis=2)  # [B, N, 3, F]
     out = jnp.einsum("bnpf,fpom->bnom", stacked, filt)
-    return jnp.tanh(out.reshape(B, N, -1))
+    # raw conv result: activation/bias belong to the layer API (the
+    # reference kernel likewise emits pre-activation patch sums —
+    # tree_conv_op.h Tree2ColFunctor + blas gemm, no act)
+    return out.reshape(B, N, -1)
 
 
 # -- fused attention LSTM ----------------------------------------------------

@@ -85,6 +85,40 @@ def depthwise_conv2d(ctx, x, w, strides=(1, 1), paddings=(0, 0),
                   data_format, padding_algorithm)
 
 
+def _transpose_conv_filter(w, groups, spatial_axes):
+    """Fluid transpose-conv filter [C_in, F/g, *k] -> grouped forward-conv
+    filter [F, C_in/g, *k] (flipped spatially).  groups=1 reduces to the
+    classic flip+swapaxes; groups>1 needs the block regrouping or
+    feature_group_count rejects the shape."""
+    wf = jnp.flip(w, axis=spatial_axes)
+    if groups == 1:
+        return jnp.swapaxes(wf, 0, 1)
+    c_in, f_per_g = wf.shape[0], wf.shape[1]
+    k = wf.shape[2:]
+    wg = wf.reshape((groups, c_in // groups, f_per_g) + k)
+    wg = jnp.swapaxes(wg, 1, 2)  # [g, F/g, C_in/g, *k]
+    return wg.reshape((groups * f_per_g, c_in // groups) + k)
+
+
+def _transpose_conv_extra_pad(in_sizes, k_sizes, strides, pads, dilations,
+                              output_size):
+    """Per-dim extra high-side padding so the lhs-dilated conv emits
+    exactly `output_size` (the stride>1 inverse is ambiguous; the
+    reference uses output_size/output_padding to disambiguate —
+    conv_transpose_op.cc)."""
+    extras = []
+    for i, tgt in enumerate(output_size):
+        default = ((in_sizes[i] - 1) * strides[i] - pads[i][0] - pads[i][1]
+                   + dilations[i] * (k_sizes[i] - 1) + 1)
+        extra = int(tgt) - default
+        if extra < 0 or extra >= strides[i]:
+            raise ValueError(
+                "output_size[%d]=%s unreachable (valid range [%d, %d))"
+                % (i, tgt, default, default + strides[i]))
+        extras.append(extra)
+    return extras
+
+
 @register_op(
     "conv2d_transpose",
     inputs=("Input", "Filter"),
@@ -103,17 +137,22 @@ def conv2d_transpose(ctx, x, w, strides=(1, 1), paddings=(0, 0),
     ]
     kh, kw = w.shape[2], w.shape[3]
     sh, sw = strides
+    dil = list(dilations)
+    extra = [0, 0]
+    if output_size:
+        extra = _transpose_conv_extra_pad(
+            (x.shape[2], x.shape[3]), (kh, kw), (sh, sw), pads, dil,
+            output_size)
     # transpose conv = lhs-dilated conv with flipped kernel
-    wt = jnp.flip(w, axis=(2, 3))  # IOHW flipped
-    wt = jnp.swapaxes(wt, 0, 1)  # -> OIHW with O=out_c/g*g? handle groups=1
+    wt = _transpose_conv_filter(w, groups, (2, 3))
     dn = lax.conv_dimension_numbers(x.shape, wt.shape, ("NCHW", "OIHW", "NCHW"))
     out = lax.conv_general_dilated(
         x, wt,
         window_strides=(1, 1),
-        padding=[(kh - 1 - pads[0][0], kh - 1 - pads[0][1]),
-                 (kw - 1 - pads[1][0], kw - 1 - pads[1][1])],
+        padding=[(kh - 1 - pads[0][0], kh - 1 - pads[0][1] + extra[0]),
+                 (kw - 1 - pads[1][0], kw - 1 - pads[1][1] + extra[1])],
         lhs_dilation=(sh, sw),
-        rhs_dilation=tuple(dilations),
+        rhs_dilation=tuple(dil),
         dimension_numbers=dn,
         feature_group_count=groups,
     )

@@ -172,16 +172,24 @@ def conv3d(ctx, x, w, strides=(1, 1, 1), paddings=(0, 0, 0),
 def conv3d_transpose(ctx, x, w, strides=(1, 1, 1), paddings=(0, 0, 0),
                      dilations=(1, 1, 1), groups=1, data_format="NCDHW",
                      output_size=(), **_):
+    from .nn import _transpose_conv_extra_pad, _transpose_conv_filter
+
     p = list(paddings)
     kd, kh, kw = w.shape[2], w.shape[3], w.shape[4]
-    wt = jnp.flip(w, axis=(2, 3, 4)).swapaxes(0, 1)
+    pads = [(p[0], p[0]), (p[1], p[1]), (p[2], p[2])]
+    extra = [0, 0, 0]
+    if output_size:
+        extra = _transpose_conv_extra_pad(
+            (x.shape[2], x.shape[3], x.shape[4]), (kd, kh, kw),
+            tuple(strides), pads, list(dilations), output_size)
+    wt = _transpose_conv_filter(w, groups, (2, 3, 4))
     dn = lax.conv_dimension_numbers(x.shape, wt.shape,
                                     ("NCDHW", "OIDHW", "NCDHW"))
     return lax.conv_general_dilated(
         x, wt, window_strides=(1, 1, 1),
-        padding=[(kd - 1 - p[0], kd - 1 - p[0]),
-                 (kh - 1 - p[1], kh - 1 - p[1]),
-                 (kw - 1 - p[2], kw - 1 - p[2])],
+        padding=[(kd - 1 - p[0], kd - 1 - p[0] + extra[0]),
+                 (kh - 1 - p[1], kh - 1 - p[1] + extra[1]),
+                 (kw - 1 - p[2], kw - 1 - p[2] + extra[2])],
         lhs_dilation=tuple(strides), rhs_dilation=tuple(dilations),
         dimension_numbers=dn, feature_group_count=groups)
 

@@ -49,6 +49,10 @@ def pytest_configure(config):
         "markers",
         "slow: multi-process / long-running tests",
     )
+    config.addinivalue_line(
+        "markers",
+        "flaky_ports: retries once on the free-port TOCTOU race",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

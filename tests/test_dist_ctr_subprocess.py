@@ -30,7 +30,19 @@ def _parse_params(stdout):
 
 
 @pytest.mark.slow
+@pytest.mark.flaky_ports
 def test_dist_ctr_sparse_ps_matches_local(tmp_path):
+    """free_ports has an inherent bind-then-release TOCTOU (dist_utils):
+    under a loaded machine another process can steal a port between probe
+    and server bind.  One retry absorbs it (matches the reference's
+    RUN_SERIAL + retry discipline for its dist suite)."""
+    try:
+        _run_dist_ctr(tmp_path)
+    except (AssertionError, OSError):
+        _run_dist_ctr(tmp_path)
+
+
+def _run_dist_ctr(tmp_path):
     here = os.path.dirname(os.path.abspath(__file__))
     payload = os.path.join(here, "dist_ctr_payload.py")
     sparse_ports = _free_ports(2)

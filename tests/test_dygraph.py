@@ -236,3 +236,190 @@ def test_dygraph_lr_scheduler():
             model.clear_gradients()
             lrs.append(float(opt._global_learning_rate().numpy()[0]))
     np.testing.assert_allclose(lrs, [0.1, 0.1, 0.01, 0.01, 0.001], rtol=1e-6)
+
+
+class TestDygraphNnTail:
+    """Round-3 dygraph layer-surface completion (reference dygraph/nn.py:
+    Conv3D, Conv3DTranspose, GRUUnit, NCE, BilinearTensorProduct,
+    SequenceConv, RowConv, SpectralNorm, TreeConv)."""
+
+    def test_conv3d_and_transpose(self):
+        from paddle_tpu import dygraph
+        from paddle_tpu.dygraph import nn as dnn
+
+        rng = np.random.RandomState(0)
+        with dygraph.guard():
+            x = dygraph.to_variable(
+                rng.rand(2, 3, 4, 5, 5).astype("f"))
+            c = dnn.Conv3D("c3", 3, 6, 3, padding=1, act="relu")
+            y = c(x)
+            assert tuple(np.asarray(y.numpy()).shape) == (2, 6, 4, 5, 5)
+            ct = dnn.Conv3DTranspose("c3t", 3, 6, 2, stride=2)
+            yt = ct(x)
+            assert tuple(np.asarray(yt.numpy()).shape) == (2, 6, 8, 10, 10)
+
+    def test_gru_unit_matches_numpy(self):
+        from paddle_tpu import dygraph
+        from paddle_tpu.dygraph import nn as dnn
+
+        rng = np.random.RandomState(1)
+        B, D = 2, 3
+        with dygraph.guard():
+            g = dnn.GRUUnit("gru", 3 * D, bias_attr=False)
+            xg = rng.uniform(-1, 1, (B, 3 * D)).astype("f")
+            hp = rng.uniform(-1, 1, (B, D)).astype("f")
+            hid, _, _ = g(dygraph.to_variable(xg), dygraph.to_variable(hp))
+            w = np.asarray(g.weight.numpy())
+            ur = xg[:, :2 * D] + hp @ w[:, :2 * D]
+            u = 1 / (1 + np.exp(-ur[:, :D]))
+            r = 1 / (1 + np.exp(-ur[:, D:]))
+            cnd = np.tanh(xg[:, 2 * D:] + (r * hp) @ w[:, 2 * D:])
+            want = u * hp + (1 - u) * cnd
+            np.testing.assert_allclose(np.asarray(hid.numpy()), want,
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_nce_trains(self):
+        from paddle_tpu import dygraph
+        from paddle_tpu.dygraph import nn as dnn
+        import paddle_tpu as fluid
+
+        rng = np.random.RandomState(2)
+        with dygraph.guard():
+            nce = dnn.NCE("nce", num_total_classes=12, dim=6,
+                          num_neg_samples=4)
+            x = dygraph.to_variable(rng.rand(8, 6).astype("f"))
+            lbl = dygraph.to_variable(
+                rng.randint(0, 12, (8, 1)).astype("int64"))
+            cost = nce(x, lbl)
+            loss = fluid.layers.mean(cost)
+            loss.backward()
+            assert np.isfinite(float(np.asarray(loss.numpy()).ravel()[0]))
+            assert nce.weight._grad_ivar is not None
+
+    def test_bilinear_seqconv_rowconv(self):
+        from paddle_tpu import dygraph
+        from paddle_tpu.dygraph import nn as dnn
+
+        rng = np.random.RandomState(3)
+        with dygraph.guard():
+            b = dnn.BilinearTensorProduct("blt", size=4, x_dim=3, y_dim=5)
+            out = b(dygraph.to_variable(rng.rand(2, 3).astype("f")),
+                    dygraph.to_variable(rng.rand(2, 5).astype("f")))
+            assert tuple(np.asarray(out.numpy()).shape) == (2, 4)
+            sc = dnn.SequenceConv("sc", num_filters=7, filter_size=3)
+            out = sc(dygraph.to_variable(rng.rand(2, 6, 4).astype("f")))
+            assert tuple(np.asarray(out.numpy()).shape) == (2, 6, 7)
+            rc = dnn.RowConv("rc", future_context_size=2)
+            out = rc(dygraph.to_variable(rng.rand(2, 6, 4).astype("f")))
+            assert tuple(np.asarray(out.numpy()).shape) == (2, 6, 4)
+
+    def test_spectral_norm_and_tree_conv(self):
+        from paddle_tpu import dygraph
+        from paddle_tpu.dygraph import nn as dnn
+
+        rng = np.random.RandomState(4)
+        with dygraph.guard():
+            sn = dnn.SpectralNorm("sn", dim=0, power_iters=2)
+            w = dygraph.to_variable(rng.rand(6, 4).astype("f"))
+            out = sn(w)
+            arr = np.asarray(out.numpy())
+            # spectral norm of the output is ~1
+            s = np.linalg.svd(arr, compute_uv=False)
+            assert abs(s[0] - 1.0) < 0.2
+            tc = dnn.TreeConv("tc", output_size=5, num_filters=2)
+            nodes = dygraph.to_variable(rng.rand(1, 6, 4).astype("f"))
+            edges = dygraph.to_variable(
+                rng.randint(0, 6, (1, 5, 2)).astype("int64"))
+            out = tc(nodes, edges)
+            assert np.asarray(out.numpy()).ndim >= 2
+
+
+class TestDygraphNnTailFixes:
+    """Review-fix regressions: grouped transpose conv, output_size,
+    NCE custom_dist wiring, TreeConv single activation."""
+
+    def test_conv2d_transpose_grouped(self):
+        from paddle_tpu import dygraph
+        from paddle_tpu.dygraph import nn as dnn
+
+        rng = np.random.RandomState(5)
+        with dygraph.guard():
+            ct = dnn.Conv2DTranspose("ctg", 4, 6, 2, stride=2, groups=2,
+                                     bias_attr=False)
+            x = dygraph.to_variable(rng.rand(1, 4, 3, 3).astype("f"))
+            y = ct(x)
+            arr = np.asarray(y.numpy())
+            assert arr.shape == (1, 6, 6, 6)
+            # group 0 output depends only on input channels 0..1
+            x2 = rng.rand(1, 4, 3, 3).astype("f")
+            x2[:, :2] = np.asarray(x.numpy())[:, :2]
+            y2 = ct(dygraph.to_variable(x2))
+            np.testing.assert_allclose(np.asarray(y2.numpy())[:, :3],
+                                       arr[:, :3], rtol=1e-5)
+
+    def test_conv3d_transpose_output_size(self):
+        from paddle_tpu import dygraph
+        from paddle_tpu.dygraph import nn as dnn
+
+        rng = np.random.RandomState(6)
+        with dygraph.guard():
+            # stride 2, k=2: default out = 2*in; output_size selects the
+            # +1 variant
+            ct = dnn.Conv3DTranspose("c3os", 2, 3, 2, stride=2,
+                                     output_size=[9, 9, 9],
+                                     bias_attr=False)
+            x = dygraph.to_variable(rng.rand(1, 2, 4, 4, 4).astype("f"))
+            y = ct(x)
+            assert tuple(np.asarray(y.numpy()).shape) == (1, 3, 9, 9, 9)
+
+    def test_nce_custom_dist(self):
+        from paddle_tpu import dygraph
+        from paddle_tpu.dygraph import nn as dnn
+        import paddle_tpu as fluid
+        import pytest as _pytest
+
+        rng = np.random.RandomState(7)
+        probs = np.full(10, 0.1, "f")
+        with dygraph.guard():
+            with _pytest.raises(ValueError):
+                dnn.NCE("nce_bad", num_total_classes=10, dim=4,
+                        sampler="custom_dist")
+            nce = dnn.NCE("nce_cd", num_total_classes=10, dim=4,
+                          num_neg_samples=3, sampler="custom_dist",
+                          custom_dist=probs)
+            cost = nce(dygraph.to_variable(rng.rand(4, 4).astype("f")),
+                       dygraph.to_variable(
+                           rng.randint(0, 10, (4, 1)).astype("int64")))
+            assert np.isfinite(np.asarray(cost.numpy())).all()
+
+    def test_tree_conv_single_activation(self):
+        """tree_conv op emits raw conv; the layer applies tanh ONCE: the
+        layer output must equal tanh(raw + bias)."""
+        from paddle_tpu import dygraph
+        from paddle_tpu.dygraph import nn as dnn
+        import paddle_tpu as fluid
+
+        rng = np.random.RandomState(8)
+        with dygraph.guard():
+            tc = dnn.TreeConv("tc1", output_size=5, num_filters=2,
+                              bias_attr=False)
+            nodes = dygraph.to_variable(rng.rand(1, 6, 4).astype("f"))
+            edges = dygraph.to_variable(
+                rng.randint(0, 6, (1, 5, 2)).astype("int64"))
+            out = np.asarray(tc(nodes, edges).numpy())
+            # |tanh| < 1 strictly, and the raw conv (pre-tanh) regularly
+            # exceeds 1 for these magnitudes — double-tanh would compress
+            # the distribution measurably below tanh(raw)
+            assert np.abs(out).max() < 1.0
+            w = np.asarray(tc.weight.numpy())
+            raw_nodes = np.asarray(nodes.numpy())
+            raw_edges = np.asarray(edges.numpy())
+        # recompute the raw conv OUTSIDE the dygraph guard (run_op builds
+        # a static program)
+        from test_op_tail_goldens import run_op
+
+        raw = run_op("tree_conv",
+                     {"NodesVector": raw_nodes, "EdgeSet": raw_edges,
+                      "Filter": w}, {"max_depth": 2}, {"Out": 1})["Out"]
+        np.testing.assert_allclose(out, np.tanh(raw), rtol=1e-4,
+                                   atol=1e-5)
