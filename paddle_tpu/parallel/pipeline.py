@@ -90,7 +90,7 @@ def gpipe_spmd(stage_fn, n_stages, n_micro, axis="pp"):
 
 
 def make_pipeline_step(stage_fn, loss_fn, mesh, n_micro, axis="pp",
-                       optimizer=None, embed_fn=None):
+                       optimizer=None, embed_fn=None, n_chunks=1):
     """Jitted stage-sharded GPipe train step.
 
     stage_fn(params, h) -> h'      one stage (params = that stage's slice)
@@ -98,13 +98,24 @@ def make_pipeline_step(stage_fn, loss_fn, mesh, n_micro, axis="pp",
     embed_fn(x) -> h               optional replicated pre-pipeline embed
     optimizer(p, g) -> p'          optional sgd-style update per leaf
 
+    n_chunks > 1 bounds activation memory: the n_micro microbatches run
+    as n_chunks sequential GPipe passes of n_micro/n_chunks each, with
+    gradients accumulated between passes (lax.scan) — the jax.grad stash
+    holds one CHUNK's activations instead of the whole batch's, at the
+    cost of (n_chunks-1) extra pipeline fills.  loss_fn must be a MEAN
+    over its microbatch outputs (chunk means are averaged).
+
     Returns step(params_stacked, x, labels) -> (loss, params_or_grads):
     x [B, ...] is split into n_micro microbatches; loss is replicated; the
     second output is updated params when `optimizer` is given, else grads
     (stage-sharded like the input params).
     """
     n_stages = mesh.shape[axis]
-    fwd = gpipe_spmd(stage_fn, n_stages, n_micro, axis)
+    if n_micro % n_chunks:
+        raise ValueError("n_micro %d not divisible by n_chunks %d"
+                         % (n_micro, n_chunks))
+    micro_per_chunk = n_micro // n_chunks
+    fwd = gpipe_spmd(stage_fn, n_stages, micro_per_chunk, axis)
 
     def loss_spmd(params_local, x_micro, labels_micro):
         outs = fwd(params_local, x_micro)
@@ -118,8 +129,34 @@ def make_pipeline_step(stage_fn, loss_fn, mesh, n_micro, axis="pp",
         return jnp.where(stage == n_stages - 1, raw, 0.0)
 
     def spmd_body(params_local, x_micro, labels_micro):
-        loss_local, grads = jax.value_and_grad(loss_spmd)(
-            params_local, x_micro, labels_micro)
+        if n_chunks == 1:
+            loss_local, grads = jax.value_and_grad(loss_spmd)(
+                params_local, x_micro, labels_micro)
+        else:
+            xc = x_micro.reshape((n_chunks, micro_per_chunk)
+                                 + x_micro.shape[1:])
+            yc = labels_micro.reshape((n_chunks, micro_per_chunk)
+                                      + labels_micro.shape[1:])
+
+            def chunk(carry, xy):
+                l_acc, g_acc = carry
+                l, g = jax.value_and_grad(loss_spmd)(
+                    params_local, xy[0], xy[1])
+                return (l_acc + l, jax.tree_util.tree_map(
+                    jnp.add, g_acc, g)), None
+
+            zeros = jax.tree_util.tree_map(jnp.zeros_like, params_local)
+            # derive the accumulator dtype from the actual loss (a
+            # hardcoded f32 init would break the scan carry contract
+            # under x64 / f64 losses)
+            loss_shape = jax.eval_shape(
+                loss_spmd, params_local, xc[0], yc[0])
+            (loss_sum, grads_sum), _ = lax.scan(
+                chunk, (jnp.zeros((), loss_shape.dtype), zeros), (xc, yc))
+            # loss_fn is a mean per chunk: average the chunk means/grads
+            loss_local = loss_sum / n_chunks
+            grads = jax.tree_util.tree_map(lambda g: g / n_chunks,
+                                           grads_sum)
         # replicate the loss for reporting OUTSIDE the differentiated path
         loss = lax.psum(lax.stop_gradient(loss_local), axis)
         if optimizer is not None:

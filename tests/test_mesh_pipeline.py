@@ -139,3 +139,27 @@ def test_batch_not_divisible_raises():
     step = make_pipeline_step(stage_fn, loss_fn, mesh, 3, "pp")
     with pytest.raises(ValueError, match="not divisible"):
         step(stacked, np.zeros((8, 16), "f"), np.zeros((8, 16), "f"))
+
+
+def test_chunked_schedule_matches_unchunked():
+    """n_chunks > 1 (memory-bounded grad accumulation across sequential
+    GPipe passes) must equal the single-pass schedule exactly."""
+    S, n_micro, B, D = 4, 8, 32, 16
+    per_stage, stage_fn, loss_fn = _mlp_setup(S, D, seed=5)
+    rng = np.random.RandomState(6)
+    x = rng.randn(B, D).astype("f")
+    labels = rng.randn(B, D).astype("f")
+    mesh = Mesh(np.array(jax.devices()[:S]), ("pp",))
+    stacked = stack_stage_params(per_stage, mesh, "pp")
+    one = make_pipeline_step(stage_fn, loss_fn, mesh, n_micro, "pp")
+    four = make_pipeline_step(stage_fn, loss_fn, mesh, n_micro, "pp",
+                              n_chunks=4)
+    l1, g1 = one(stacked, x, labels)
+    l4, g4 = four(stacked, x, labels)
+    np.testing.assert_allclose(float(l4), float(l1), rtol=1e-5)
+    for n in ("w", "b"):
+        np.testing.assert_allclose(np.asarray(g4[n]), np.asarray(g1[n]),
+                                   rtol=1e-4, atol=1e-6)
+    with pytest.raises(ValueError, match="not divisible"):
+        make_pipeline_step(stage_fn, loss_fn, mesh, n_micro, "pp",
+                           n_chunks=3)
