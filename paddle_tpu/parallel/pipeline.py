@@ -111,6 +111,8 @@ def make_pipeline_step(stage_fn, loss_fn, mesh, n_micro, axis="pp",
     (stage-sharded like the input params).
     """
     n_stages = mesh.shape[axis]
+    if n_chunks < 1:
+        raise ValueError("n_chunks must be >= 1, got %d" % n_chunks)
     if n_micro % n_chunks:
         raise ValueError("n_micro %d not divisible by n_chunks %d"
                          % (n_micro, n_chunks))

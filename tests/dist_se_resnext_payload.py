@@ -1,0 +1,129 @@
+"""dist_se_resnext-analog payload (reference dist_se_resnext.py): one
+SE-ResNeXt bottleneck block (cardinality-8 grouped conv + squeeze-excite
+gate) + classifier head, trained sync-PS across 2 pservers x 2 trainers.
+BN running stats stay trainer-local (reference behavior: only parameters
+ride the PS; stats are saved from trainer 0)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.models.se_resnext import bottleneck_block
+
+STEPS = 4
+BS = 4  # per trainer
+
+
+def build(merge_k=1):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 55
+    startup.random_seed = 55
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[8, 8, 8])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        x = bottleneck_block(img, filters=8, stride=1, cardinality=8)
+        pool = fluid.layers.pool2d(x, pool_type="avg",
+                                   global_pooling=True)
+        pool = fluid.layers.reshape(pool, shape=[0, int(pool.shape[1])])
+        logits = fluid.layers.fc(pool, 4)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        opt = fluid.optimizer.SGD(0.05)
+        if merge_k > 1:
+            # the EXACT local equivalent of k sync-PS trainers: each
+            # trainer normalizes BN over its OWN shard, grads averaged —
+            # locally that is k grad-merged shard sub-steps (BN stats per
+            # shard), not one full-batch step
+            opt = fluid.optimizer.GradientMergeOptimizer(
+                opt, k_steps=merge_k, avg=True)
+        opt.minimize(loss)
+    return main, startup, loss
+
+
+def make_data(n_trainers):
+    rng = np.random.RandomState(321)
+    out = []
+    for _ in range(STEPS):
+        xs = rng.rand(n_trainers * BS, 8, 8, 8).astype("f")
+        ys = rng.randint(0, 4, (n_trainers * BS, 1)).astype("int64")
+        out.append((xs, ys))
+    return out
+
+
+def _dump(scope, program):
+    for p in sorted(program.global_block().all_parameters(),
+                    key=lambda v: v.name):
+        v = np.asarray(scope.find_var(p.name).get_tensor().numpy())
+        print("param:%s:%.8f" % (p.name, float(np.abs(v).sum())),
+              flush=True)
+
+
+def run_local():
+    main, startup, loss = build(merge_k=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for xs, ys in make_data(2):
+            for half in (slice(0, BS), slice(BS, 2 * BS)):
+                lo, = exe.run(main,
+                              feed={"img": xs[half], "label": ys[half]},
+                              fetch_list=[loss])
+                print("loss:%.8f"
+                      % float(np.asarray(lo).reshape(-1)[0]), flush=True)
+        _dump(scope, main)
+
+
+def run_pserver():
+    eps = os.environ["PADDLE_PSERVER_ENDPOINTS"]
+    cur = os.environ["PADDLE_CURRENT_ENDPOINT"]
+    n = int(os.environ["PADDLE_TRAINERS_NUM"])
+    main, startup, loss = build()
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, startup_program=startup,
+                pservers=eps, trainers=n)
+    prog, sprog = t.get_pserver_programs(cur)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(sprog)
+        print("pserver:ready", flush=True)
+        exe.run(prog, scope=scope)
+    print("pserver:done", flush=True)
+
+
+def run_trainer():
+    eps = os.environ["PADDLE_PSERVER_ENDPOINTS"]
+    tid = int(os.environ["PADDLE_TRAINER_ID"])
+    n = int(os.environ["PADDLE_TRAINERS_NUM"])
+    main, startup, loss = build()
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=tid, program=main, startup_program=startup,
+                pservers=eps, trainers=n)
+    tp = t.get_trainer_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        half = slice(tid * BS, (tid + 1) * BS)
+        for xs, ys in make_data(n):
+            lo, = exe.run(tp, feed={"img": xs[half], "label": ys[half]},
+                          fetch_list=[loss], scope=scope)
+            print("loss:%.8f" % float(np.asarray(lo).reshape(-1)[0]),
+                  flush=True)
+        _dump(scope, main)
+        scope._ps_comm.complete()
+
+
+if __name__ == "__main__":
+    role = os.environ.get("PADDLE_TRAINING_ROLE", "LOCAL")
+    if role == "PSERVER":
+        run_pserver()
+    elif role == "TRAINER":
+        run_trainer()
+    else:
+        run_local()

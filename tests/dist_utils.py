@@ -15,3 +15,57 @@ def free_ports(n):
     for s in socks:
         s.close()
     return ports
+
+
+def run_ps_cluster(payload, base_env, n_pservers=2, n_trainers=2,
+                   ps_extra_env=None, trainer_extra_env=None,
+                   timeout=300):
+    """Spawn the standard sync-PS topology (reference test_dist_base.py
+    _run_cluster): n pservers + n trainers as real subprocesses on free
+    localhost ports.  Returns the list of trainer stdouts; asserts every
+    process exits 0.  `*_extra_env(i) -> dict` adds per-process env."""
+    import subprocess
+    import sys
+
+    ports = free_ports(n_pservers)
+    eps = ",".join("127.0.0.1:%d" % p for p in ports)
+    procs = []
+    try:
+        for i, ep in enumerate(eps.split(",")):
+            env = dict(base_env, PADDLE_TRAINING_ROLE="PSERVER",
+                       PADDLE_PSERVER_ENDPOINTS=eps,
+                       PADDLE_CURRENT_ENDPOINT=ep,
+                       PADDLE_TRAINERS_NUM=str(n_trainers))
+            if ps_extra_env:
+                env.update(ps_extra_env(i))
+            procs.append(("ps:%d" % i, subprocess.Popen(
+                [sys.executable, payload], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True)))
+        trainers = []
+        for tid in range(n_trainers):
+            env = dict(base_env, PADDLE_TRAINING_ROLE="TRAINER",
+                       PADDLE_PSERVER_ENDPOINTS=eps,
+                       PADDLE_TRAINER_ID=str(tid),
+                       PADDLE_TRAINERS_NUM=str(n_trainers))
+            if trainer_extra_env:
+                env.update(trainer_extra_env(tid))
+            p = subprocess.Popen([sys.executable, payload], env=env,
+                                 stdout=subprocess.PIPE,
+                                 stderr=subprocess.PIPE, text=True)
+            trainers.append(p)
+            procs.append(("tr:%d" % tid, p))
+        touts = []
+        for p in trainers:
+            out, err = p.communicate(timeout=timeout)
+            assert p.returncode == 0, err
+            touts.append(out)
+        for name, p in procs:
+            if name.startswith("ps:"):
+                out, err = p.communicate(timeout=120)
+                assert p.returncode == 0, (name, err)
+        return touts
+    finally:
+        for _, p in procs:
+            if p.poll() is None:
+                p.kill()
