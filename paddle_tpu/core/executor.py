@@ -222,12 +222,21 @@ class Executor:
         # force a full recompile on the next step
         self._maybe_fuse_optimizers(program, program.global_block(),
                                     list(feed_arrays), fetch_names)
+        # trace-affecting flags must key the cache: a cached executable
+        # baked the flag value it was traced under, and flipping the flag
+        # without a cache miss would silently keep the old lowering
+        from .. import flags as _flags
+
+        trace_flags = tuple(sorted(_flags.get_flags(
+            ["FLAGS_use_pallas_layer_norm", "FLAGS_check_nan_inf",
+             "FLAGS_bn_stat_subsample"]).items()))
         key = (
             id(program),
             program.version,
             tuple(sorted((n, a.shape, str(a.dtype)) for n, a in feed_arrays.items())),
             tuple(fetch_names),
             id(mesh) if mesh is not None else None,
+            trace_flags,
         )
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None:

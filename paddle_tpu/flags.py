@@ -27,6 +27,10 @@ _DEFAULTS = {
     # per-parameter sgd/momentum/adam ops into one flat update — ~46 ms
     # of a 211 ms ResNet-50 step was per-weight launch overhead
     "FLAGS_fuse_optimizer_ops": True,
+    # opt-in fused Pallas LayerNorm (pallas_kernels/layer_norm.py): wins
+    # standalone microbenches, measured -1.5% inside full BERT on the
+    # bench chip (breaks XLA's LN-neighbor fusions) — see ops/nn.py
+    "FLAGS_use_pallas_layer_norm": False,
     # accepted no-ops (XLA/PJRT owns these concerns; benchmark's per-op
     # sync has no meaning under whole-block compilation)
     "FLAGS_benchmark": False,
