@@ -15,6 +15,7 @@ def _rand(*shape):
 
 
 class TestMulOp(OpTest):
+    tpu_grad = {"inputs_to_check": ["X", "Y"]}
     op_type = "mul"
 
     def setup_method(self, m):
@@ -45,6 +46,7 @@ class TestMulOpFlatten(OpTest):
 
 
 class TestMatMulOp(OpTest):
+    tpu_grad = {"inputs_to_check": ["X", "Y"]}
     op_type = "matmul"
 
     def setup_method(self, m):
@@ -186,6 +188,7 @@ def test_reduce_all_flag():
 
 
 class TestMeanOp(OpTest):
+    tpu_grad = {"inputs_to_check": ["X"]}
     op_type = "mean"
 
     def setup_method(self, m):
@@ -214,6 +217,7 @@ class TestClipOp(OpTest):
 
 
 class TestSoftmaxOp(OpTest):
+    tpu_grad = {"inputs_to_check": ["X"]}
     op_type = "softmax"
 
     def setup_method(self, m):

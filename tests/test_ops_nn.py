@@ -33,6 +33,9 @@ def _conv2d_ref(x, w, stride, pad):
 
 class TestConv2dOp(OpTest):
     op_type = "conv2d"
+    # on-chip grad-check config (tests/test_tpu_tier_ops.py)
+    tpu_grad = {"inputs_to_check": ["Input", "Filter"],
+                "max_elements": 64}
     atol = 1e-4
 
     def setup_method(self, m):
@@ -186,6 +189,8 @@ class TestBatchNormInfer(OpTest):
 class TestLayerNorm(OpTest):
     op_type = "layer_norm"
     atol = 1e-4
+    tpu_grad = {"inputs_to_check": ["X", "Scale", "Bias"],
+                "output_names": ["y"], "max_elements": 48}
 
     def setup_method(self, m):
         x = _rand(4, 6)

@@ -300,3 +300,35 @@ class TestPallasLayerNormOnChip:
         want = (x64 - m) / np.sqrt(v + 1e-5)
         assert (np.abs(yk - want).max()
                 <= np.abs(yj - want).max() + 1e-4)
+
+
+def _grad_params():
+    """Classes opt into the on-chip grad check by declaring a `tpu_grad`
+    dict (inputs_to_check + optional check_grad kwargs) — single source
+    of truth next to each class's own test_grad."""
+    out = []
+    for mod_name in _MODULES:
+        mod = importlib.import_module(mod_name)
+        for name in sorted(vars(mod)):
+            cls = vars(mod)[name]
+            if (isinstance(cls, type) and issubclass(cls, OpTest)
+                    and getattr(cls, "tpu_grad", None)):
+                out.append(pytest.param((mod_name, name),
+                                        id="%s.%s" % (mod_name, name)))
+    return out
+
+
+@pytest.mark.parametrize("case", _grad_params())
+def test_grad_on_chip(case):
+    """Analytic-vs-numeric gradients ON THE CHIP for core training ops
+    (check_grad_with_place, reference op_test.py:1033: analytic grads run
+    on the TPU, finite differences stay on CPU; the TPU tolerance tier
+    applies via the helper's place-aware default)."""
+    mod, cls_name = case
+    cls = getattr(importlib.import_module(mod), cls_name)
+    t = cls()
+    if hasattr(t, "setup_method"):
+        t.setup_method(None)
+    kwargs = dict(cls.tpu_grad)
+    inputs = kwargs.pop("inputs_to_check")
+    t.check_grad_with_place(fluid.TPUPlace(0), inputs, **kwargs)
