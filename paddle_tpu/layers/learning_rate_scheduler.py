@@ -33,7 +33,11 @@ def _decay_step_counter(begin=0):
         for op in program.global_block().ops
     )
     if not already:
-        Constant(float(begin))(counter)
+        # init to begin - 1: the increment runs BEFORE the schedule math
+        # each step, so the first observed value is `begin` — matching
+        # the reference's autoincreased_step_counter (step 0 on the first
+        # run, noam's begin=1 counter starting at 1)
+        Constant(float(begin) - 1.0)(counter)
         with program._lr_schedule_guard():
             program.global_block().append_op(
                 type="increment",

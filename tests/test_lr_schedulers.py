@@ -4,15 +4,12 @@ layers/learning_rate_scheduler.py): each schedule's per-step value is
 fetched from a running program and compared against the numpy formula."""
 
 import numpy as np
-import pytest
-
 import paddle_tpu as fluid
 from paddle_tpu.layers import learning_rate_scheduler as lrs
 
 
-# NB: the step counter increments before the LR is computed, so the
-# value fetched on the t-th run (0-based) is the schedule at step t+1 —
-# goldens below use 1-based steps
+# Reference step semantics (autoincreased_step_counter): the first run
+# observes step 0 (noam: step 1) — goldens below are 0-based
 def _run_schedule(build_lr, steps):
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
@@ -36,28 +33,28 @@ def test_exponential_decay():
     got = _run_schedule(
         lambda: lrs.exponential_decay(0.1, decay_steps=4, decay_rate=0.5),
         8)
-    want = 0.1 * 0.5 ** (np.arange(1, 9) / 4.0)
+    want = 0.1 * 0.5 ** (np.arange(8) / 4.0)
     np.testing.assert_allclose(got, want, rtol=1e-5)
 
 
 def test_exponential_decay_staircase():
     got = _run_schedule(
         lambda: lrs.exponential_decay(0.1, 4, 0.5, staircase=True), 8)
-    want = 0.1 * 0.5 ** np.floor(np.arange(1, 9) / 4.0)
+    want = 0.1 * 0.5 ** np.floor(np.arange(8) / 4.0)
     np.testing.assert_allclose(got, want, rtol=1e-5)
 
 
 def test_natural_exp_decay():
     got = _run_schedule(
         lambda: lrs.natural_exp_decay(0.1, 4, 0.5), 6)
-    want = 0.1 * np.exp(-0.5 * (np.arange(1, 7) / 4.0))
+    want = 0.1 * np.exp(-0.5 * (np.arange(6) / 4.0))
     np.testing.assert_allclose(got, want, rtol=1e-5)
 
 
 def test_inverse_time_decay():
     got = _run_schedule(
         lambda: lrs.inverse_time_decay(0.1, 4, 0.5), 6)
-    want = 0.1 / (1.0 + 0.5 * (np.arange(1, 7) / 4.0))
+    want = 0.1 / (1.0 + 0.5 * (np.arange(6) / 4.0))
     np.testing.assert_allclose(got, want, rtol=1e-5)
 
 
@@ -66,7 +63,7 @@ def test_polynomial_decay():
         lambda: lrs.polynomial_decay(0.1, decay_steps=5,
                                      end_learning_rate=0.01, power=2.0),
         8)
-    t = np.minimum(np.arange(1, 9), 5)
+    t = np.minimum(np.arange(8), 5)
     want = (0.1 - 0.01) * (1 - t / 5.0) ** 2 + 0.01
     np.testing.assert_allclose(got, want, rtol=1e-5)
 
@@ -74,7 +71,7 @@ def test_polynomial_decay():
 def test_piecewise_decay():
     got = _run_schedule(
         lambda: lrs.piecewise_decay([3, 6], [0.1, 0.05, 0.01]), 8)
-    t = np.arange(1, 9)
+    t = np.arange(8)
     want = np.where(t < 3, 0.1, np.where(t < 6, 0.05, 0.01))
     np.testing.assert_allclose(got, want, rtol=1e-6)
 
@@ -82,14 +79,14 @@ def test_piecewise_decay():
 def test_cosine_decay():
     got = _run_schedule(
         lambda: lrs.cosine_decay(0.1, step_each_epoch=2, epochs=4), 8)
-    epoch = np.floor(np.arange(1, 9) / 2.0)
+    epoch = np.floor(np.arange(8) / 2.0)
     want = 0.1 * 0.5 * (np.cos(epoch * np.pi / 4.0) + 1)
     np.testing.assert_allclose(got, want, rtol=1e-5)
 
 
 def test_noam_decay():
     got = _run_schedule(lambda: lrs.noam_decay(64, warmup_steps=4), 8)
-    step = np.arange(2, 10, dtype="f")
+    step = np.arange(1, 9, dtype="f")
     want = 64 ** -0.5 * np.minimum(step ** -0.5, step * 4.0 ** -1.5)
     np.testing.assert_allclose(got, want, rtol=1e-4)
 
@@ -98,8 +95,34 @@ def test_linear_lr_warmup():
     got = _run_schedule(
         lambda: lrs.linear_lr_warmup(
             lrs.piecewise_decay([100], [0.1, 0.01]),
-            warmup_steps=4, start_lr=0.0, end_lr=0.1), 8)
-    t = np.arange(1, 9)
-    warm = t / 4.0 * 0.1
+            warmup_steps=4, start_lr=0.0, end_lr=0.2), 8)
+    t = np.arange(8)
+    warm = t / 4.0 * 0.2
     want = np.where(t < 4, warm, 0.1)
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-7)
+
+
+def test_polynomial_decay_cycle():
+    got = _run_schedule(
+        lambda: lrs.polynomial_decay(0.1, decay_steps=3,
+                                     end_learning_rate=0.01, power=1.0,
+                                     cycle=True), 8)
+    t = np.arange(8, dtype="f")
+    cycles = np.maximum(np.ceil(t / 3.0), 1.0)
+    span = cycles * 3.0
+    want = (0.1 - 0.01) * (1 - t / span) + 0.01
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_natural_exp_decay_staircase():
+    got = _run_schedule(
+        lambda: lrs.natural_exp_decay(0.1, 4, 0.5, staircase=True), 8)
+    want = 0.1 * np.exp(-0.5 * np.floor(np.arange(8) / 4.0))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_inverse_time_decay_staircase():
+    got = _run_schedule(
+        lambda: lrs.inverse_time_decay(0.1, 4, 0.5, staircase=True), 8)
+    want = 0.1 / (1.0 + 0.5 * np.floor(np.arange(8) / 4.0))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
