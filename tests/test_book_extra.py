@@ -112,3 +112,123 @@ def test_heartbeat_monitor():
     assert dead == [0]
     mon.update(0)            # recovery clears the warning
     assert mon.check() == []
+
+
+def test_fit_a_line_train_save_infer(tmp_path):
+    """Book test 1 (reference book/test_fit_a_line.py): linear regression
+    to convergence, save_inference_model -> load_inference_model ->
+    predictions match the trained program."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 1
+    startup.random_seed = 1
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[13])
+        y = fluid.layers.data("y", shape=[1])
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.05).minimize(loss)
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(13, 1).astype("f")
+    xs = rng.randn(256, 13).astype("f")
+    ys = (xs @ w_true + 0.01 * rng.randn(256, 1)).astype("f")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for i in range(200):
+            lo, = exe.run(main, feed={"x": xs, "y": ys},
+                          fetch_list=[loss])
+            losses.append(float(np.asarray(lo).ravel()[0]))
+        assert losses[-1] < losses[0] * 0.05, "did not converge"
+        d = str(tmp_path)
+        fluid.io.save_inference_model(d, ["x"], [pred], exe,
+                                      main_program=main)
+        want, = exe.run(main, feed={"x": xs[:8], "y": ys[:8]},
+                        fetch_list=[pred])
+        prog, feeds, fetches = fluid.io.load_inference_model(d, exe)
+        got, = exe.run(prog, feed={feeds[0]: xs[:8]},
+                       fetch_list=fetches)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_rnn_encoder_decoder_trains():
+    """Book test (reference book/test_rnn_encoder_decoder.py): GRU
+    encoder + teacher-forced GRU decoder (StaticRNN, real gru_unit
+    gating) with a projection head.  Decoder inputs are the targets
+    SHIFTED one step (BOS zeros at t=0) so predicting trg[t] requires
+    the recurrence/context, not the current input's own embedding."""
+    import paddle_tpu.layers as layers
+
+    T, B, V, D = 6, 4, 24, 16
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 7
+    startup.random_seed = 7
+    with fluid.program_guard(main, startup):
+        src = layers.data("src", shape=[T, B], dtype="int64",
+                          append_batch_size=False)
+        trg = layers.data("trg", shape=[T, B], dtype="int64",
+                          append_batch_size=False)
+
+        def embed(ids, name, steps):
+            flat = layers.reshape(ids, [steps * B, 1])
+            e = layers.embedding(flat, size=[V, D],
+                                 param_attr=fluid.ParamAttr(name=name))
+            return layers.reshape(e, [steps, B, D])
+
+        def gru_cell(xt, hp, prefix):
+            # real GRU gating: project input to 3D gates, gru_unit cell
+            proj = layers.fc(xt, 3 * D,
+                             param_attr=fluid.ParamAttr(
+                                 name=prefix + "_xproj"))
+            hn, _, _ = layers.gru_unit(proj, hp, 3 * D,
+                                       name=prefix + "_gru")
+            return hn
+
+        src_e = embed(src, "enc_emb", T)
+        enc = layers.StaticRNN()
+        h0 = layers.fill_constant(shape=[B, D], dtype="float32",
+                                  value=0.0)
+        with enc.step():
+            xt = enc.step_input(src_e)
+            hp = enc.memory(init=h0)
+            hn = gru_cell(xt, hp, "enc")
+            enc.update_memory(hp, hn)
+            enc.step_output(hn)
+        enc_out = enc()
+        ctx0 = layers.slice(enc_out, axes=[0], starts=[T - 1], ends=[T])
+        ctx0 = layers.reshape(ctx0, [B, D])
+        # teacher forcing with SHIFTED targets: input at t is trg[t-1]
+        trg_in = layers.concat(
+            [layers.fill_constant(shape=[1, B], dtype="int64", value=0),
+             layers.slice(trg, axes=[0], starts=[0], ends=[T - 1])],
+            axis=0)
+        trg_e = embed(trg_in, "dec_emb", T)
+        dec = layers.StaticRNN()
+        with dec.step():
+            yt = dec.step_input(trg_e)
+            hp = dec.memory(init=ctx0)
+            hn = gru_cell(yt, hp, "dec")
+            dec.update_memory(hp, hn)
+            dec.step_output(hn)
+        dec_out = dec()
+        logits = layers.fc(layers.reshape(dec_out, [T * B, D]), V,
+                           num_flatten_dims=1)
+        labels = layers.reshape(trg, [T * B, 1])
+        loss = layers.reduce_mean(
+            fluid.layers.softmax_with_cross_entropy(logits, labels))
+        fluid.optimizer.Adam(1e-2).minimize(loss)
+    rng = np.random.RandomState(2)
+    src_v = rng.randint(0, V, (T, B)).astype("int64")
+    trg_v = rng.randint(0, V, (T, B)).astype("int64")
+    exe = fluid.Executor(fluid.CPUPlace())
+    losses = []
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _ in range(80):
+            lo, = exe.run(main, feed={"src": src_v, "trg": trg_v},
+                          fetch_list=[loss])
+            losses.append(float(np.asarray(lo).ravel()[0]))
+    assert losses[-1] < losses[0] * 0.35, (losses[0], losses[-1])
