@@ -23,8 +23,12 @@ from .framework import (
     name_scope,
     program_guard,
     tpu_places,
+    require_version,
+    load_op_library,
     core,
 )
+from . import distribute_lookup_table
+from .core.scope import LoDTensorArray
 from .core.executor import Executor, global_scope, scope_guard
 from .core.scope import Scope
 from .compiler import BuildStrategy, CompiledProgram, ExecutionStrategy
@@ -56,6 +60,7 @@ from . import unique_name_compat as unique_name  # noqa: F401
 from .data_feeder import DataFeeder
 from . import io
 from .io import save_inference_model, load_inference_model
+from .io import save, load, load_program_state, set_program_state
 from .reader import DataLoader, PyReader
 from .dataset import DatasetFactory
 from . import dataset

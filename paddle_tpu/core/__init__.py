@@ -1,3 +1,6 @@
 from .executor import Executor, global_scope, scope_guard
 from .registry import register_op, get_op_def, has_op_def, all_op_types
-from .scope import Scope, SelectedRows, TpuTensor
+from .scope import Scope, SelectedRows, TpuTensor, LoDTensorArray
+
+# reference pybind-core aliases (fluid.core.LoDTensor etc.)
+LoDTensor = TpuTensor

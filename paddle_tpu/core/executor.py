@@ -230,12 +230,18 @@ class Executor:
         trace_flags = tuple(sorted(_flags.get_flags(
             ["FLAGS_use_pallas_layer_norm", "FLAGS_check_nan_inf",
              "FLAGS_bn_stat_subsample"]).items()))
+        # mesh keyed by content, not id(): a GC'd Mesh's successor can alias
+        # the address exactly like the Program case above
+        mesh_key = None
+        if mesh is not None:
+            mesh_key = (tuple(mesh.shape.items()),
+                        tuple(d.id for d in mesh.devices.flat))
         key = (
-            id(program),
+            program._uid,
             program.version,
             tuple(sorted((n, a.shape, str(a.dtype)) for n, a in feed_arrays.items())),
             tuple(fetch_names),
-            id(mesh) if mesh is not None else None,
+            mesh_key,
             trace_flags,
         )
         entry = self._cache.get(key) if use_program_cache else None
@@ -386,7 +392,7 @@ class Executor:
         per (program, version): with the rank-capped default most groups
         stay unfused, so without memoization every step would pay a full
         pass scan that is guaranteed to change nothing."""
-        key = (id(program), program.version)
+        key = (program._uid, program.version)
         if key in self._fuse_attempted:
             return
         self._fuse_attempted.add(key)
@@ -405,7 +411,7 @@ class Executor:
                        protected=set(feed_names) | set(fetch_names))
         # the pass bumps the version when it fuses; mark the new version
         # attempted too so the next run doesn't rescan
-        self._fuse_attempted.add((id(program), program.version))
+        self._fuse_attempted.add((program._uid, program.version))
 
     def _param_sharding(self, mesh, block, name):
         from jax.sharding import NamedSharding, PartitionSpec as P

@@ -8,7 +8,7 @@ allocator — HBM allocation is XLA's job on TPU).
 
 import numpy as np
 
-__all__ = ["Scope", "TpuTensor", "SelectedRows"]
+__all__ = ["Scope", "TpuTensor", "SelectedRows", "LoDTensorArray"]
 
 
 class TpuTensor:
@@ -174,3 +174,17 @@ class Scope:
 
     def local_var_names(self):
         return list(self._vars)
+
+
+class LoDTensorArray(list):
+    """A resizable array of LoDTensors (reference core.LoDTensorArray,
+    pybind.cc binding over std::vector<LoDTensor>; used by array_write /
+    array_read and the dynamic-RNN memory API).  Plain values are wrapped
+    into TpuTensor on append for drop-in use with exe.run feeds."""
+
+    def append(self, value):
+        if not isinstance(value, TpuTensor):
+            t = TpuTensor()
+            t.set(value)
+            value = t
+        super().append(value)

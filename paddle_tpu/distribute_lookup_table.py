@@ -1,0 +1,56 @@
+"""Locate the (single) distributed lookup table in a Program.
+
+Parity: python/paddle/fluid/distribute_lookup_table.py — used by the
+DistributeTranspiler and fleet PS paths to find the large-scale sparse
+embedding table marked ``is_distributed=True``."""
+
+LOOKUP_TABLE_TYPE = "lookup_table"
+
+__all__ = [
+    "find_distributed_lookup_table",
+    "find_distributed_lookup_table_inputs",
+    "find_distributed_lookup_table_outputs",
+]
+
+
+def _table_ops(program, table_name):
+    for op in program.global_block().ops:
+        if op.type == LOOKUP_TABLE_TYPE and table_name == op.input("W")[0]:
+            yield op
+
+
+def find_distributed_lookup_table_inputs(program, table_name):
+    """All Ids variables feeding lookup_table ops over ``table_name``."""
+    local_vars = program.current_block().vars
+    inputs = []
+    for op in _table_ops(program, table_name):
+        inputs.extend(local_vars[name] for name in op.input("Ids"))
+    return inputs
+
+
+def find_distributed_lookup_table_outputs(program, table_name):
+    """All Out variables produced by lookup_table ops over ``table_name``."""
+    local_vars = program.current_block().vars
+    outputs = []
+    for op in _table_ops(program, table_name):
+        outputs.extend(local_vars[name] for name in op.output("Out"))
+    return outputs
+
+
+def find_distributed_lookup_table(program):
+    """-> the unique distributed table's parameter name, or None.  Raises
+    if two different tables are marked distributed (only one supported)."""
+    table_name = None
+    for op in program.global_block().ops:
+        if op.type != LOOKUP_TABLE_TYPE:
+            continue
+        if op.attr("is_distributed") is True:
+            if table_name is None:
+                table_name = op.input("W")[0]
+            if table_name != op.input("W")[0]:
+                raise RuntimeError("all distributed lookup_table_ops"
+                                   " should have only one table")
+        else:
+            if table_name is not None:
+                assert op.input("W")[0] != table_name
+    return table_name

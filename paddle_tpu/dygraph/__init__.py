@@ -8,6 +8,8 @@ from .nn import (
     LayerNorm, GroupNorm, PRelu, Dropout,
 )
 from .checkpoint import save_dygraph, load_dygraph
+from .container import Sequential
+from .backward_strategy import BackwardStrategy
 from .jit import TracedLayer
 from .parallel import prepare_context, Env, ParallelEnv, DataParallel
 from .learning_rate_scheduler import (
@@ -19,6 +21,7 @@ __all__ = [
     "Conv2D", "Conv2DTranspose", "Pool2D", "FC", "Linear", "BatchNorm",
     "Embedding", "LayerNorm", "GroupNorm", "PRelu", "Dropout",
     "save_dygraph", "load_dygraph", "TracedLayer",
+    "Sequential", "BackwardStrategy",
     "prepare_context", "Env", "ParallelEnv", "DataParallel",
     "LearningRateDecay", "PiecewiseDecay", "NaturalExpDecay",
     "ExponentialDecay", "InverseTimeDecay", "PolynomialDecay",

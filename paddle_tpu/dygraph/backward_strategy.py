@@ -1,0 +1,15 @@
+"""BackwardStrategy (reference dygraph/backward_strategy.py:17, backed by
+the pybind class in imperative.cc with one knob, ``sort_sum_gradient``).
+
+The knob selects deterministic sorted gradient summation in the reference's
+autograd engine.  Our tape replays in deterministic reverse-registration
+order and sums cotangents in a fixed order already, so both settings are
+equivalent here; the class is accepted (and carried by ``backward()``) for
+source compatibility."""
+
+__all__ = ["BackwardStrategy"]
+
+
+class BackwardStrategy:
+    def __init__(self):
+        self.sort_sum_gradient = False

@@ -1,0 +1,40 @@
+"""Sequential container (reference dygraph/container.py:20)."""
+
+from .layers import Layer
+
+__all__ = ["Sequential"]
+
+
+class Sequential(Layer):
+    """Runs sub-layers in registration order.  Accepts either iterable
+    Layers or (name, Layer) pairs; supports item access/assignment/deletion
+    by index-or-name like the reference."""
+
+    def __init__(self, name_scope, *layers):
+        super().__init__(name_scope)
+        if len(layers) > 0 and isinstance(layers[0], tuple):
+            for name, layer in layers:
+                self.add_sublayer(name, layer)
+        else:
+            for idx, layer in enumerate(layers):
+                self.add_sublayer(str(idx), layer)
+
+    def __getitem__(self, name):
+        return self._sub_layers[str(name)]
+
+    def __setitem__(self, name, layer):
+        assert isinstance(layer, Layer)
+        setattr(self, str(name), layer)
+
+    def __delitem__(self, name):
+        name = str(name)
+        assert name in self._sub_layers
+        del self._sub_layers[name]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def forward(self, input):
+        for layer in self._sub_layers.values():
+            input = layer(input)
+        return input

@@ -10,6 +10,7 @@ the IR, and execution lowers whole blocks into a single jitted XLA computation
 """
 
 import contextlib
+import itertools
 import threading
 import copy
 import json
@@ -184,6 +185,66 @@ def is_compiled_with_cuda():
 
 def is_compiled_with_tpu():
     return True
+
+
+def require_version(min_version, max_version=None):
+    """Raise unless the installed version is within [min_version,
+    max_version] (reference framework.py:66).  Version strings are 1-4
+    dot-separated integers; missing components compare as 0."""
+    import re as _re
+
+    if not isinstance(min_version, str):
+        raise TypeError(
+            "The type of 'min_version' in require_version must be str, but "
+            "received %s." % type(min_version))
+    if not isinstance(max_version, (str, type(None))):
+        raise TypeError(
+            "The type of 'max_version' in require_version must be str or "
+            "type(None), but received %s." % type(max_version))
+
+    def parse(ver, arg):
+        m = _re.match(r"\d+(\.\d+){0,3}", ver)
+        if m is None or m.group() != ver:
+            raise ValueError(
+                "The value of '%s' in require_version must be in format "
+                "'\\d+(\\.\\d+){0,3}', like '1.5.2.0', but received %s"
+                % (arg, ver))
+        parts = [int(p) for p in ver.split(".")]
+        return parts + [0] * (4 - len(parts))
+
+    lo = parse(min_version, "min_version")
+    hi = parse(max_version, "max_version") if max_version is not None else None
+    from . import __version__ as _v
+
+    m = _re.match(r"\d+(\.\d+){0,3}", _v)
+    if m is None:
+        # dev/rc build with no leading numeric component: reference warns
+        # and accepts rather than blaming the caller's argument
+        import warnings
+
+        warnings.warn(
+            "paddle_tpu version %s or higher is required, but a development "
+            "version (%s) is installed; please make sure the version is "
+            "good with your code." % (min_version, _v))
+        return
+    parts = [int(p) for p in m.group().split(".")]
+    installed = parts + [0] * (4 - len(parts))
+    if installed < lo or (hi is not None and installed > hi):
+        raise Exception(
+            "VersionError: paddle_tpu version %s does not satisfy the "
+            "requirement [%s, %s]" % (_v, min_version, max_version or "any"))
+
+
+def load_op_library(lib_filename):
+    """Reference framework.py:4772 loads a .so of custom C++ OpKernels and
+    refreshes the proto registry.  TPU custom ops are Python/Pallas
+    lowerings registered through core.registry.register_op instead; a
+    shared library of CUDA kernels cannot be mapped onto the XLA path, so
+    this raises with the supported alternative spelled out."""
+    raise NotImplementedError(
+        "load_op_library(%r): custom ops on the TPU backend are added with "
+        "paddle_tpu.core.registry.register_op (a JAX/Pallas lowering), not "
+        "a dynamic library of CUDA kernels" % (lib_filename,))
 
 
 # ---------------------------------------------------------------------------
@@ -542,7 +603,12 @@ class Block:
 class Program:
     """A whole model: list of blocks, block 0 is global (reference framework.py:3515)."""
 
+    _uid_counter = itertools.count()
+
     def __init__(self):
+        # monotonic process-wide UID: executor caches key on this instead of
+        # id(program), which a GC'd Program's successor can alias
+        self._uid = next(Program._uid_counter)
         self.blocks = [Block(self, 0)]
         self.current_block_idx = 0
         self.random_seed = 0
@@ -834,6 +900,11 @@ class _CoreShim:
     @staticmethod
     def is_compiled_with_cuda():
         return False
+
+    # NOTE: fluid.core resolves to the paddle_tpu.core package (the
+    # submodule import rebinds the attribute after this shim); the pybind
+    # aliases (LoDTensor, LoDTensorArray, Scope) live in core/__init__.py
+    # only, so there is a single alias table.
 
 
 core = _CoreShim()

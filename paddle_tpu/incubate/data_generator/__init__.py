@@ -1,0 +1,157 @@
+"""User-side authoring API for the Dataset multislot text format.
+
+Parity: python/paddle/fluid/incubate/data_generator/__init__.py.  A user
+subclasses DataGenerator, overrides ``generate_sample`` (and optionally
+``generate_batch``), then runs the script as a pipe filter: each input line
+becomes one or more output records of the MultiSlotDataFeed text format
+``<ids_num> id1 id2 ... <ids_num> ...`` — the same format our native
+``multislot.cc`` parser and the Dataset/trainer path consume."""
+
+import sys
+
+__all__ = ["MultiSlotDataGenerator", "MultiSlotStringDataGenerator"]
+
+
+class DataGenerator:
+    """Base class: drives generate_sample/generate_batch over stdin or an
+    in-memory source and serializes records with the subclass ``_gen_str``."""
+
+    def __init__(self):
+        self._proto_info = None
+        self.batch_size_ = 32
+
+    def _set_line_limit(self, line_limit):
+        if not isinstance(line_limit, int):
+            raise ValueError("line_limit%s must be in int type"
+                             % type(line_limit))
+        if line_limit < 1:
+            raise ValueError("line_limit can not less than 1")
+        self._line_limit = line_limit
+
+    def set_batch(self, batch_size):
+        """Batch size seen by generate_batch (only relevant if overridden)."""
+        self.batch_size_ = batch_size
+
+    def _flush(self, batch_samples, write):
+        batch_iter = self.generate_batch(batch_samples)
+        for sample in batch_iter():
+            write(self._gen_str(sample))
+
+    def _run(self, lines, write):
+        batch_samples = []
+        for line in lines:
+            line_iter = self.generate_sample(line)
+            for parsed in line_iter():
+                if parsed is None:
+                    continue
+                batch_samples.append(parsed)
+                if len(batch_samples) == self.batch_size_:
+                    self._flush(batch_samples, write)
+                    batch_samples = []
+        if batch_samples:
+            self._flush(batch_samples, write)
+
+    def run_from_memory(self):
+        """Generate from memory (generate_sample is called with line=None);
+        for debugging and benchmarks."""
+        self._run([None], sys.stdout.write)
+
+    def run_from_stdin(self):
+        """Pipe-filter mode: stdin lines -> multislot records on stdout."""
+        self._run(sys.stdin, sys.stdout.write)
+
+    # -- test/TPU-pipeline convenience (not in the reference API) ------------
+    def run_to_file(self, lines, path):
+        """Run the generator over an iterable of lines into a file — the
+        same serialization as run_from_stdin without process plumbing, so a
+        Dataset can point at the result directly."""
+        with open(path, "w") as f:
+            self._run(lines, f.write)
+
+    def _gen_str(self, line):
+        raise NotImplementedError(
+            "pls use MultiSlotDataGenerator or PairWiseDataGenerator")
+
+    def generate_sample(self, line):
+        """Override: return a no-arg generator yielding
+        ``[(slot_name, [feasign, ...]), ...]`` per record."""
+        raise NotImplementedError(
+            "Please rewrite this function to return a list or tuple: "
+            "[(name, [feasign, ...]), ...] or ((name, [feasign, ...]), ...)")
+
+    def generate_batch(self, samples):
+        """Override for batch-level preprocessing (e.g. padding); default
+        passes samples through one by one."""
+
+        def local_iter():
+            for sample in samples:
+                yield sample
+
+        return local_iter
+
+
+def _check_slot(item):
+    name, elements = item
+    if not isinstance(name, str):
+        raise ValueError("name%s must be in str type" % type(name))
+    if not isinstance(elements, list):
+        raise ValueError("elements%s must be in list type" % type(elements))
+    if not elements:
+        raise ValueError(
+            "the elements of each field can not be empty, you need padding "
+            "it in process().")
+    return name, elements
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    """Serializes ``[(name, [str, ...]), ...]`` records; values are emitted
+    verbatim (fastest path — no type bookkeeping)."""
+
+    def _gen_str(self, line):
+        if not isinstance(line, (list, tuple)):
+            raise ValueError(
+                "the output of process() must be in list or tuple type"
+                "Examples: [('words', ['1926', '08', '17']), "
+                "('label', ['1'])]")
+        out = []
+        for name, elements in line:
+            out.append(str(len(elements)))
+            out.extend(elements)
+        return " ".join(out) + "\n"
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """Serializes ``[(name, [int|float, ...]), ...]`` records, tracking the
+    per-slot dtype in ``_proto_info`` (a slot becomes "float" as soon as any
+    float appears) and validating slot-set consistency across records."""
+
+    def _gen_str(self, line):
+        if not isinstance(line, (list, tuple)):
+            raise ValueError(
+                "the output of process() must be in list or tuple type"
+                "Example: [('words', [1926, 08, 17]), ('label', [1])]")
+        first = self._proto_info is None
+        if first:
+            self._proto_info = [(_check_slot(item)[0], "uint64")
+                                for item in line]
+        elif len(line) != len(self._proto_info):
+            raise ValueError(
+                "the complete field set of two given line are inconsistent.")
+        out = []
+        for index, item in enumerate(line):
+            name, elements = _check_slot(item)
+            if name != self._proto_info[index][0]:
+                raise ValueError(
+                    "the field name of two given line are not match: "
+                    "require<%s>, get<%s>."
+                    % (self._proto_info[index][0], name))
+            out.append(str(len(elements)))
+            for elem in elements:
+                if isinstance(elem, float):
+                    self._proto_info[index] = (name, "float")
+                elif not isinstance(elem, int):
+                    raise ValueError(
+                        "the type of element%s must be in int or float"
+                        % type(elem))
+                out.append(str(elem))
+        return " ".join(out) + "\n"

@@ -28,6 +28,10 @@ __all__ = [
     "load_inference_model",
     "save_train_model",
     "load_train_model",
+    "save",
+    "load",
+    "load_program_state",
+    "set_program_state",
 ]
 
 
@@ -358,3 +362,162 @@ def load_train_model(dirname, executor=None):
     main = Program.from_dict(bundle["main_program"])
     startup = Program.from_dict(bundle["startup_program"])
     return main, startup, bundle["feed_names"], bundle["fetch_names"]
+
+
+# -- fluid.save / fluid.load (v1.6 single-call training state) ---------------
+
+def _is_belong_to_optimizer(var):
+    """Persistable non-Parameter state: optimizer accumulators, LR counters
+    (reference io.py:109 is_belong_to_optimizer)."""
+    return _is_persistable(var) and not isinstance(var, Parameter)
+
+
+def save(program, model_path):
+    """Save parameters (``.pdparams``), optimizer state (``.pdopt``, only
+    written when non-empty) and the network description (``.pdmodel``) under
+    a ``dirname/file_prefix`` path (reference io.py:1493 ``save``).
+
+    The reference pickles name->ndarray dicts and serializes the ProgramDesc
+    protobuf; we pickle the same dicts and store the JSON program IR."""
+    import pickle
+
+    base_name = os.path.basename(model_path)
+    assert base_name != "", (
+        "model_path MUST be format of dirname/filename, Now filename is "
+        "empty str")
+    dirname = os.path.dirname(model_path)
+    if dirname:
+        os.makedirs(dirname, exist_ok=True)
+    scope = global_scope()
+
+    def get_tensor(var):
+        sv = scope.find_var(var.name)
+        assert sv is not None and sv.get_tensor()._is_initialized(), (
+            "variable %r is not initialized; run the startup program before "
+            "fluid.save" % var.name)
+        return np.asarray(sv.get_tensor().numpy())
+
+    param_dict = {v.name: get_tensor(v)
+                  for v in program.list_vars() if _is_parameter(v)}
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(param_dict, f)
+
+    opt_dict = {v.name: get_tensor(v)
+                for v in program.list_vars() if _is_belong_to_optimizer(v)}
+    if opt_dict:  # reference: "If the optimizer have no variable ... the
+        # file will not generated" (SGD has no accumulators)
+        with open(model_path + ".pdopt", "wb") as f:
+            pickle.dump(opt_dict, f)
+
+    with open(model_path + ".pdmodel", "w") as f:
+        json.dump(program.to_dict(), f)
+
+
+def _check_var_match(var_name, old_np, new_np):
+    """Shape/dtype guard shared by load() and set_program_state()
+    (reference io.py set_var / set_program_state asserts)."""
+    assert tuple(old_np.shape) == tuple(new_np.shape), (
+        "Shape not matching: the Program requires a parameter with a shape "
+        "of ({}), while the loaded parameter (namely [ {} ]) has a shape of "
+        "({}).".format(tuple(old_np.shape), var_name, tuple(new_np.shape)))
+    assert old_np.dtype == new_np.dtype, (
+        "Dtype not matching: the Program requires a parameter with a dtype "
+        "of ({}), while the loaded parameter (namely [ {} ]) has a dtype of "
+        "({}).".format(old_np.dtype, var_name, new_np.dtype))
+
+
+def load(program, model_path, executor=None):
+    """Restore parameters + optimizer state saved by :func:`save` into the
+    global scope, checking shape/dtype (reference io.py:1547 ``load``).
+
+    Without ``executor`` the startup program must have run (the reference
+    dereferences the scope tensor and errors on a missing var); passing an
+    executor allows loading into a fresh scope (the reference pre-creates
+    the tensors via _create_loaded_parameter)."""
+    import pickle
+
+    parameter_file_name = model_path + ".pdparams"
+    assert os.path.exists(parameter_file_name), (
+        "Parameter file [{}] not exits".format(parameter_file_name))
+    scope = global_scope()
+
+    def set_var(var, nd):
+        sv = scope.find_var(var.name)
+        if sv is None or not sv.get_tensor()._is_initialized():
+            if executor is None:
+                raise RuntimeError(
+                    "Variable [ %s ] is not initialized in the scope; run "
+                    "the startup program before fluid.load, or pass "
+                    "executor= to create it" % var.name)
+        else:
+            _check_var_match(var.name, np.asarray(sv.get_tensor().numpy()),
+                             nd)
+        scope.var(var.name).set(nd)
+
+    with open(parameter_file_name, "rb") as f:
+        load_dict = pickle.load(f)
+    for v in program.list_vars():
+        if not _is_parameter(v):
+            continue
+        assert v.name in load_dict, (
+            "Can not find [{}] in model file [{}]".format(
+                v.name, parameter_file_name))
+        set_var(v, load_dict[v.name])
+
+    opt_vars = [v for v in program.list_vars() if _is_belong_to_optimizer(v)]
+    if opt_vars:
+        opt_file_name = model_path + ".pdopt"
+        assert os.path.exists(opt_file_name), (
+            "Optimizer file [{}] not exits".format(opt_file_name))
+        with open(opt_file_name, "rb") as f:
+            load_dict = pickle.load(f)
+        for v in opt_vars:
+            assert v.name in load_dict, (
+                "Can not find [{}] in model file [{}]".format(
+                    v.name, opt_file_name))
+            set_var(v, load_dict[v.name])
+
+
+def load_program_state(model_path):
+    """-> merged name->ndarray dict of params + optimizer state
+    (reference io.py:1630)."""
+    import pickle
+
+    parameter_file_name = model_path + ".pdparams"
+    assert os.path.exists(parameter_file_name), (
+        "Parameter file [{}] not exits".format(parameter_file_name))
+    with open(parameter_file_name, "rb") as f:
+        para_dict = pickle.load(f)
+    opt_file_name = model_path + ".pdopt"
+    if os.path.exists(opt_file_name):
+        with open(opt_file_name, "rb") as f:
+            para_dict.update(pickle.load(f))
+    return para_dict
+
+
+def set_program_state(program, state_dict):
+    """Set persistable vars from a state dict, warning about unused keys
+    (reference io.py:1672).  MUST be called after the startup program ran."""
+    import warnings
+
+    scope = global_scope()
+    used = set()
+    for var in program.list_vars():
+        if not _is_persistable(var):
+            continue
+        sv = scope.find_var(var.name)
+        assert sv is not None, (
+            "Variable [ {} ] Not found, Please make sure run startup "
+            "program".format(var.name))
+        if var.name not in state_dict:
+            continue
+        new_np = np.asarray(state_dict[var.name])
+        old_np = np.asarray(sv.get_tensor().numpy())
+        _check_var_match(var.name, old_np, new_np)
+        scope.var(var.name).set(new_np)
+        used.add(var.name)
+    unused = [k for k in state_dict if k not in used]
+    if unused:
+        warnings.warn(
+            "This list is not set, Because of Paramerter not found in "
+            "program. There are: {}".format(" ".join(unused)))

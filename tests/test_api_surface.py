@@ -1,0 +1,393 @@
+"""Round-4 API-surface parity: fluid.save/load (io.py:1493,1547),
+load_program_state/set_program_state (io.py:1630,1672), dygraph.Sequential
+(container.py:20), BackwardStrategy (backward_strategy.py:17),
+LoDTensorArray, distribute_lookup_table, require_version/load_op_library
+(framework.py:66,4772), incubate.data_generator round-trip."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _small_net(opt_factory):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        y = fluid.layers.data("y", shape=[1])
+        h = fluid.layers.fc(x, 8, act="relu")
+        pred = fluid.layers.fc(h, 1)
+        loss = fluid.layers.mean(fluid.layers.square(pred - y))
+        opt_factory().minimize(loss)
+    return main, startup, loss
+
+
+def _feed(rng):
+    return {"x": rng.randn(16, 4).astype("float32"),
+            "y": rng.randn(16, 1).astype("float32")}
+
+
+# ---------------------------------------------------------------------------
+# fluid.save / fluid.load
+# ---------------------------------------------------------------------------
+
+
+def test_save_load_roundtrip_adam(tmp_path):
+    """Adam has accumulators -> .pdopt written; after load, training resumes
+    bit-identically to an uninterrupted run."""
+    rng = np.random.RandomState(0)
+    feeds = [_feed(rng) for _ in range(6)]
+    path = os.path.join(str(tmp_path), "ckpt", "model")
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    main, startup, loss = _small_net(lambda: fluid.optimizer.Adam(0.01))
+    main.random_seed = 3
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for f in feeds[:3]:
+            exe.run(main, feed=f, fetch_list=[loss])
+        fluid.save(main, path)
+        expect = [exe.run(main, feed=f, fetch_list=[loss])[0]
+                  for f in feeds[3:]]
+    assert os.path.exists(path + ".pdparams")
+    assert os.path.exists(path + ".pdopt")  # Adam accumulators
+    assert os.path.exists(path + ".pdmodel")
+
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.load(main, path)
+        got = [exe.run(main, feed=f, fetch_list=[loss])[0]
+               for f in feeds[3:]]
+    for e, g in zip(expect, got):
+        np.testing.assert_allclose(g, e, rtol=1e-6)
+
+
+def test_save_without_optimizer_writes_no_pdopt(tmp_path):
+    """Reference: 'If the optimizer have no variable need to save ... the
+    file will not generated'.  (Even SGD carries a persistable
+    learning_rate_0 through is_belong_to_optimizer — reference io.py:109 —
+    so the no-.pdopt case is a forward-only program.)"""
+    path = os.path.join(str(tmp_path), "model")
+    exe = fluid.Executor(fluid.CPUPlace())
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        fluid.layers.fc(x, 2)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.save(main, path)
+    assert os.path.exists(path + ".pdparams")
+    assert not os.path.exists(path + ".pdopt")
+
+    path2 = os.path.join(str(tmp_path), "model_sgd")
+    main2, startup2, _ = _small_net(lambda: fluid.optimizer.SGD(0.1))
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup2)
+        fluid.save(main2, path2)
+    assert os.path.exists(path2 + ".pdopt")  # learning_rate_0
+
+
+def test_save_empty_basename_rejected(tmp_path):
+    main, startup, _ = _small_net(lambda: fluid.optimizer.SGD(0.1))
+    with pytest.raises(AssertionError):
+        fluid.save(main, str(tmp_path) + os.sep)
+
+
+def test_load_shape_mismatch_rejected(tmp_path):
+    path = os.path.join(str(tmp_path), "model")
+    exe = fluid.Executor(fluid.CPUPlace())
+    main, startup, loss = _small_net(lambda: fluid.optimizer.SGD(0.1))
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.save(main, path)
+
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, startup2):
+        x = fluid.layers.data("x", shape=[4])
+        # same param names (fc_0.w_0 ...) but different width -> shape clash
+        fluid.layers.fc(x, 16)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup2)
+        with pytest.raises(AssertionError, match="[Ss]hape"):
+            fluid.load(main2, path)
+
+
+def test_load_program_state_and_set_program_state(tmp_path):
+    rng = np.random.RandomState(1)
+    path = os.path.join(str(tmp_path), "model")
+    exe = fluid.Executor(fluid.CPUPlace())
+    main, startup, loss = _small_net(lambda: fluid.optimizer.Momentum(0.01, 0.9))
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        exe.run(main, feed=_feed(rng), fetch_list=[loss])
+        saved = {
+            v.name: np.asarray(
+                fluid.global_scope().find_var(v.name).get_tensor().numpy())
+            for v in main.list_vars() if v.persistable and not v.is_data
+        }
+        fluid.save(main, path)
+
+    state = fluid.load_program_state(path)
+    # merged dict: params AND momentum accumulators
+    assert set(saved) <= set(state)
+    for k, v in saved.items():
+        np.testing.assert_array_equal(state[k], v)
+
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.set_program_state(main, state)
+        for k, v in saved.items():
+            got = fluid.global_scope().find_var(k).get_tensor().numpy()
+            np.testing.assert_array_equal(got, v)
+
+
+def test_set_program_state_warns_on_unused(tmp_path):
+    import warnings
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    main, startup, _ = _small_net(lambda: fluid.optimizer.SGD(0.1))
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            fluid.set_program_state(main, {"not_a_var": np.zeros(3, "f")})
+        assert any("not_a_var" in str(x.message) for x in w)
+
+
+# ---------------------------------------------------------------------------
+# dygraph.Sequential + BackwardStrategy
+# ---------------------------------------------------------------------------
+
+
+def test_sequential_forward_and_container_protocol():
+    with fluid.dygraph.guard():
+        model = fluid.dygraph.Sequential(
+            "model",
+            fluid.dygraph.Linear(10, 4),
+            fluid.dygraph.Linear(4, 2),
+        )
+        assert len(model) == 2
+        assert model[0] is model._sub_layers["0"]
+        x = fluid.dygraph.to_variable(
+            np.random.RandomState(0).rand(3, 10).astype("float32"))
+        out = model(x)
+        assert tuple(out.numpy().shape) == (3, 2)
+        # named pairs + add/del
+        m2 = fluid.dygraph.Sequential(
+            "m2",
+            ("l1", fluid.dygraph.Linear(10, 4)),
+            ("l2", fluid.dygraph.Linear(4, 2)),
+        )
+        assert m2["l1"] is m2._sub_layers["l1"]
+        m2.add_sublayer("l3", fluid.dygraph.Linear(2, 2))
+        assert len(m2) == 3
+        del m2["l3"]
+        assert len(m2) == 2
+        out2 = m2(x)
+        assert tuple(out2.numpy().shape) == (3, 2)
+
+
+def test_sequential_trains():
+    with fluid.dygraph.guard():
+        model = fluid.dygraph.Sequential(
+            "trainme", fluid.dygraph.Linear(4, 4), fluid.dygraph.Linear(4, 1))
+        opt = fluid.optimizer.SGD(0.1)
+        rng = np.random.RandomState(0)
+        xv = rng.rand(8, 4).astype("float32")
+        losses = []
+        for _ in range(5):
+            x = fluid.dygraph.to_variable(xv)
+            loss = fluid.layers.mean(fluid.layers.square(model(x)))
+            loss.backward()
+            opt.minimize(loss, parameter_list=model.parameters())
+            model.clear_gradients()
+            losses.append(float(np.asarray(loss.numpy()).reshape(())))
+        assert losses[-1] < losses[0]
+
+
+def test_backward_strategy_accepted():
+    with fluid.dygraph.guard():
+        strat = fluid.dygraph.BackwardStrategy()
+        strat.sort_sum_gradient = True
+        x = fluid.dygraph.to_variable(np.ones((2, 3), "float32"))
+        fc = fluid.dygraph.Linear(3, 1)
+        loss = fluid.layers.reduce_sum(fc(x))
+        loss.backward(strat)  # positional, like reference user code
+        assert fc.weight.gradient() is not None
+
+
+# ---------------------------------------------------------------------------
+# small surface: LoDTensorArray, distribute_lookup_table, versions
+# ---------------------------------------------------------------------------
+
+
+def test_lod_tensor_array():
+    arr = fluid.LoDTensorArray()
+    arr.append(np.arange(4, dtype="float32"))
+    t = fluid.LoDTensor()
+    t.set(np.ones((2, 2), "float32"))
+    arr.append(t)
+    assert len(arr) == 2
+    np.testing.assert_array_equal(arr[0].numpy(), np.arange(4, dtype="float32"))
+    assert fluid.core.LoDTensorArray is fluid.LoDTensorArray
+
+
+def test_distribute_lookup_table_finders():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data("ids", shape=[1], dtype="int64")
+        emb = fluid.layers.embedding(
+            ids, size=[100, 8], is_distributed=True,
+            param_attr=fluid.ParamAttr(name="dist_table"))
+    name = fluid.distribute_lookup_table.find_distributed_lookup_table(main)
+    assert name == "dist_table"
+    ins = fluid.distribute_lookup_table.find_distributed_lookup_table_inputs(
+        main, name)
+    outs = fluid.distribute_lookup_table.find_distributed_lookup_table_outputs(
+        main, name)
+    assert [v.name for v in ins] == ["ids"]
+    assert len(outs) == 1
+
+
+def test_require_version():
+    fluid.require_version("0.0.1")
+    fluid.require_version("0.1.0", "9.0")
+    with pytest.raises(Exception):
+        fluid.require_version("99.0")
+    with pytest.raises(TypeError):
+        fluid.require_version(1)
+    with pytest.raises(ValueError):
+        fluid.require_version("not-a-version")
+
+
+def test_load_op_library_raises_with_guidance():
+    with pytest.raises(NotImplementedError, match="register_op"):
+        fluid.load_op_library("custom_op.so")
+
+
+# ---------------------------------------------------------------------------
+# incubate.data_generator: author -> parse -> train round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_multislot_string_data_generator_format():
+    import paddle_tpu.incubate.data_generator as dg
+
+    class G(dg.MultiSlotStringDataGenerator):
+        def generate_sample(self, line):
+            def it():
+                yield [("words", ["1926", "08", "17"]), ("label", ["1"])]
+            return it
+
+    out = G()._gen_str([("words", ["1926", "08", "17"]), ("label", ["1"])])
+    assert out == "3 1926 08 17 1 1\n"
+
+
+def test_multislot_data_generator_types_and_validation():
+    import paddle_tpu.incubate.data_generator as dg
+
+    g = dg.MultiSlotDataGenerator()
+    out = g._gen_str([("words", [1926, 8, 17]), ("label", [1])])
+    assert out == "3 1926 8 17 1 1\n"
+    assert g._proto_info == [("words", "uint64"), ("label", "uint64")]
+    # float promotes the slot dtype
+    g._gen_str([("words", [1.5, 2, 3]), ("label", [0])])
+    assert g._proto_info[0] == ("words", "float")
+    with pytest.raises(ValueError):  # inconsistent slot set
+        g._gen_str([("words", [1])])
+    with pytest.raises(ValueError):  # wrong name
+        g._gen_str([("wordz", [1]), ("label", [0])])
+    with pytest.raises(ValueError):  # empty slot
+        g._gen_str([("words", []), ("label", [0])])
+
+
+def test_data_generator_dataset_roundtrip(tmp_path):
+    """Author with MultiSlotDataGenerator -> parse with the native multislot
+    store -> train a step (VERDICT round-3 item 4 round-trip)."""
+    import paddle_tpu.incubate.data_generator as dg
+
+    rng = np.random.RandomState(7)
+    w = np.array([0.5, -1.0, 2.0, 0.25], "float32")
+    raw_lines = []
+    for _ in range(64):
+        x = rng.randn(4).astype("float32")
+        raw_lines.append(" ".join("%.6f" % v for v in x)
+                         + " %d" % int(x @ w > 0))
+
+    class MyGen(dg.MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def it():
+                vals = line.split()
+                yield [("x", [float(v) for v in vals[:4]]),
+                       ("y", [int(vals[4])])]
+            return it
+
+    path = os.path.join(str(tmp_path), "part-0.txt")
+    MyGen().run_to_file(raw_lines, path)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        y = fluid.layers.data("y", shape=[1], dtype="int64")
+        logits = fluid.layers.fc(x, 2)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(16)
+    ds.set_filelist([path])
+    ds.set_use_var([x, y])
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 64
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        out = exe.train_from_dataset(main, ds, thread=2, fetch_list=[loss],
+                                     fetch_info=["loss"], print_period=100)
+        assert out and np.isfinite(float(out[0][0]))
+
+
+def test_data_generator_run_from_stdin(tmp_path, monkeypatch):
+    """The reference workflow: script as a pipe filter over stdin/stdout."""
+    import io as _io
+    import sys
+
+    import paddle_tpu.incubate.data_generator as dg
+
+    class MyGen(dg.MultiSlotStringDataGenerator):
+        def generate_sample(self, line):
+            def it():
+                vals = line.split()
+                yield [("words", vals[:-1]), ("label", [vals[-1]])]
+            return it
+
+    monkeypatch.setattr(sys, "stdin", _io.StringIO("a b c 1\nd e 0\n"))
+    cap = _io.StringIO()
+    monkeypatch.setattr(sys, "stdout", cap)
+    MyGen().run_from_stdin()
+    assert cap.getvalue() == "3 a b c 1 1\n2 d e 1 0\n"
+
+
+def test_load_without_startup_rejected(tmp_path):
+    """Review finding r4: load() into a fresh scope without running startup
+    must error (reference dereferences the missing scope tensor), not
+    silently skip shape validation."""
+    path = os.path.join(str(tmp_path), "model")
+    exe = fluid.Executor(fluid.CPUPlace())
+    main, startup, _ = _small_net(lambda: fluid.optimizer.SGD(0.1))
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.save(main, path)
+    with fluid.scope_guard(fluid.Scope()):
+        with pytest.raises(RuntimeError, match="startup"):
+            fluid.load(main, path)
+        # the executor= escape hatch creates the vars (reference
+        # _create_loaded_parameter path)
+        fluid.load(main, path, executor=exe)
+        for v in main.list_vars():
+            if isinstance(v, fluid.framework.Parameter):
+                got = fluid.global_scope().find_var(v.name)
+                assert got is not None and got.get_tensor()._is_initialized()

@@ -102,3 +102,44 @@ def test_varying_batch_size_recompiles():
             xv = np.ones((bs, 3), "float32")
             out, = exe.run(main, feed={"x": xv}, fetch_list=[y])
             assert float(out[0]) == bs * 3
+
+
+def test_program_uid_survives_gc_aliasing():
+    """Cache keys must use Program._uid, not id(program): after a Program is
+    GC'd, a new Program can land at the same id() with a colliding version
+    (reference analog: ExecutorPrepareContext keyed by program address is
+    rebuilt per Prepare call, executor.cc)."""
+    import gc
+
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    def build(scale):
+        main, startup = _new_progs()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[3])
+            y = fluid.layers.scale(x, scale=scale)
+        return main, startup, y
+
+    xv = np.ones((2, 3), "float32")
+    seen_ids, uids = set(), set()
+    for scale in (2.0, 3.0, 5.0):
+        main, startup, y = build(scale)
+        seen_ids.add(id(main))
+        uids.update((main._uid, startup._uid))
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            out, = exe.run(main, feed={"x": xv}, fetch_list=[y])
+            np.testing.assert_allclose(out, xv * scale, rtol=1e-6)
+        del main, startup, y
+        gc.collect()
+    # UIDs never collide even if CPython reuses the address (id collision
+    # is likely but not guaranteed; the UID guarantee is what we assert)
+    assert len(uids) == 6
+    # every cache key inserted used the uid namespace, not the address one
+    assert {k[0] for k in exe._cache} <= uids
+
+
+def test_program_clone_gets_fresh_uid():
+    main, _ = _new_progs()
+    assert main.clone()._uid != main._uid
+    assert main.clone(for_test=True)._uid != main._uid
