@@ -1,0 +1,159 @@
+"""Tensor-parallel numeric parity (VERDICT r3 item 2).
+
+The TP path threads ("model",)-axis PartitionSpecs through BERT's qkv/ffn
+weights (models/bert.py) and runs under jit+GSPMD over a (data, model) mesh.
+These tests assert the sharded run matches the plain single-device run
+numerically — loss, gradients' effect (updated params), and optimizer state
+— over multiple steps, so a dropped psum / wrong-axis sharding cannot pass
+silently.  Reference pattern: parallel_executor_test_base.py
+(same-model two-config parity)."""
+
+import numpy as np
+import pytest
+
+import jax
+import paddle_tpu as fluid
+from paddle_tpu.models import bert
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8 or jax.devices()[0].platform != "cpu",
+    reason="needs the 8-device virtual CPU mesh")
+
+
+CFG = dict(vocab_size=512, hidden=64, layers=2, heads=4, ffn=128, max_pos=32)
+
+
+def _feeds(cfg, batch, seq, steps, n_mask=4):
+    rng = np.random.RandomState(0)
+    out = []
+    for _ in range(steps):
+        out.append({
+            "src_ids": rng.randint(0, cfg.vocab_size,
+                                   (batch, seq, 1)).astype("int64"),
+            "pos_ids": np.tile(np.arange(seq).reshape(1, seq, 1),
+                               (batch, 1, 1)).astype("int64"),
+            "sent_ids": np.zeros((batch, seq, 1), "int64"),
+            "input_mask": np.ones((batch, seq, 1), "float32"),
+            "mask_pos": rng.randint(0, batch * seq, (n_mask,)).astype("int64"),
+            "mask_label": rng.randint(0, cfg.vocab_size,
+                                      (n_mask, 1)).astype("int64"),
+        })
+    return out
+
+
+def _build(cfg, seq, use_tp, dropout):
+    cfg = bert.BertConfig(dropout=dropout, **cfg)
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 42
+    startup.random_seed = 42
+    with fluid.program_guard(main, startup):
+        _, loss = bert.build_pretrain(cfg, seq_len=seq, lr=1e-3,
+                                      use_tp=use_tp)
+    return cfg, main, startup, loss
+
+
+def _watched_params(main):
+    """qkv (column-sharded), ffn2 (row-sharded: the psum-critical matmul),
+    and a replicated non-TP param."""
+    names = [n for n in (v.name for v in main.list_vars())
+             if n.endswith("_q_w") or n.endswith("_ffn2_w")]
+    names.append("word_emb")
+    assert len(names) >= 3
+    return sorted(names)
+
+
+def _run(cfg, main, startup, loss, feeds, mesh=None):
+    exe = fluid.Executor(fluid.CPUPlace())
+    prog = main
+    if mesh is not None:
+        prog = fluid.CompiledProgram(main)._with_mesh(mesh, data_axis="data")
+    losses, params, shardings = [], {}, {}
+    watched = _watched_params(main)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for f in feeds:
+            out, = exe.run(prog, feed=f, fetch_list=[loss])
+            losses.append(float(np.asarray(out).reshape(-1)[0]))
+        scope = fluid.global_scope()
+        for n in watched:
+            params[n] = np.asarray(scope.find_var(n).get_tensor().numpy())
+        # grab one Adam accumulator for optimizer-state parity
+        moments = [v.name for v in main.list_vars()
+                   if "moment" in v.name and v.persistable]
+        if moments:
+            params[moments[0]] = np.asarray(
+                scope.find_var(moments[0]).get_tensor().numpy())
+        if mesh is not None:
+            shardings = {n: scope.find_var(n).get_tensor().get()
+                         for n in watched}
+    return losses, params, shardings
+
+
+def _mesh(dp, tp):
+    from jax.sharding import Mesh
+
+    devices = np.array(jax.devices()[:dp * tp]).reshape(dp, tp)
+    return Mesh(devices, ("data", "model"))
+
+
+@pytest.mark.parametrize("dropout", [0.0, 0.1])
+def test_tp2_matches_single_device(dropout):
+    """dp=1 x tp=2 vs plain single-device: pure tensor parallelism."""
+    seq, batch, steps = 16, 4, 3
+    cfg, main, startup, loss = _build(CFG, seq, use_tp=True, dropout=dropout)
+    feeds = _feeds(cfg, batch, seq, steps)
+
+    plain_losses, plain_params, _ = _run(cfg, main, startup, loss, feeds)
+    tp_losses, tp_params, tp_arrays = _run(cfg, main, startup, loss, feeds,
+                                           mesh=_mesh(1, 2))
+
+    np.testing.assert_allclose(tp_losses, plain_losses, rtol=2e-5, atol=1e-6)
+    for n in plain_params:
+        np.testing.assert_allclose(
+            tp_params[n], plain_params[n], rtol=2e-4, atol=1e-5,
+            err_msg="param %s diverged under tp=2" % n)
+    # the TP run must actually have sharded the annotated params over
+    # "model" (2 distinct single-param shards), not silently replicated
+    q_names = [n for n in tp_arrays if n.endswith("_q_w")]
+    for n in q_names:
+        arr = tp_arrays[n]
+        assert isinstance(arr, jax.Array)
+        shard_shapes = {s.data.shape for s in arr.addressable_shards}
+        full = arr.shape
+        assert shard_shapes == {(full[0], full[1] // 2)}, (
+            "expected %s column-sharded 2-way, got shards %s"
+            % (n, shard_shapes))
+
+
+def test_dp4_tp2_matches_single_device():
+    """The dryrun topology (dp=4 x tp=2) with dropout on: batch sharded over
+    data, weights over model, still numerically the plain program."""
+    seq, batch, steps = 16, 8, 3
+    cfg, main, startup, loss = _build(CFG, seq, use_tp=True, dropout=0.1)
+    feeds = _feeds(cfg, batch, seq, steps)
+
+    plain_losses, plain_params, _ = _run(cfg, main, startup, loss, feeds)
+    tp_losses, tp_params, _ = _run(cfg, main, startup, loss, feeds,
+                                   mesh=_mesh(4, 2))
+    np.testing.assert_allclose(tp_losses, plain_losses, rtol=2e-5, atol=1e-6)
+    for n in plain_params:
+        np.testing.assert_allclose(
+            tp_params[n], plain_params[n], rtol=2e-4, atol=1e-5,
+            err_msg="param %s diverged under dp=4 tp=2" % n)
+
+
+def test_tp_sharding_specs_present():
+    """grep-able guarantee: use_tp=True threads model-axis specs into the
+    qkv/out/ffn weights and leaves everything else replicated."""
+    cfg, main, startup, loss = _build(CFG, 16, use_tp=True, dropout=0.0)
+    specs = {v.name: getattr(v, "sharding", None)
+             for v in main.list_vars()}
+    col = [n for n in specs if n.endswith(("_q_w", "_k_w", "_v_w",
+                                           "_ffn1_w"))]
+    row = [n for n in specs if n.endswith(("_out_w", "_ffn2_w"))]
+    assert col and row
+    for n in col:
+        assert tuple(specs[n]) == (None, "model"), (n, specs[n])
+    for n in row:
+        assert tuple(specs[n]) == ("model", None), (n, specs[n])
+    assert specs["word_emb"] is None
