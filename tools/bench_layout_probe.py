@@ -1,0 +1,113 @@
+"""Probe: 4D BN-stats reduce bandwidth by layout + chained matmul peak.
+
+Follow-up to bench_reduce_pallas.py: the round-2 roofline (60-76 GB/s
+reduce cap / 128-147 GB/s stream / 83 TF/s matmul peak) was a per-call-RTT
+artifact.  Protocol here: lax.scan chains with lax.optimization_barrier on
+the loop-invariant operand (defeats hoisting/algebraic elision — plain
+scalar-add carries got simplified away: slice-of-dot, (x+c)^2 expansion),
+host-fetch sync, RTT subtracted, REP sized so device time >> RTT noise.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def _time(fn, *args, r=5):
+    f = jax.jit(fn)
+    o = f(*args)
+    np.asarray(o[0])
+    ts = []
+    for _ in range(r):
+        t0 = time.perf_counter()
+        o = f(*args)
+        np.asarray(o[0])
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def _rtt():
+    f = jax.jit(lambda s: s + 1.0)
+    s = jnp.float32(0.0)
+    np.asarray(f(s))
+    ts = []
+    for _ in range(9):
+        t0 = time.perf_counter()
+        np.asarray(f(s))
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def stats4d(x, axes, rep):
+    def body(c, _):
+        xb, cb = lax.optimization_barrier((x, c))
+        xf = xb.astype(jnp.float32)
+        s = jnp.sum(xf, axis=axes)
+        ss = jnp.sum(xf * xf, axis=axes)
+        return (jnp.sum(s) + jnp.sum(ss)) * 1e-12 + cb * 0.0, ()
+
+    out, _ = lax.scan(body, jnp.float32(0.0), None, length=rep)
+    return (out,)
+
+
+def stream(x, rep):
+    def body(y, _):
+        yb = lax.optimization_barrier(y)
+        return yb * jnp.bfloat16(1.0000001), ()
+
+    y, _ = lax.scan(body, x, None, length=rep)
+    return (y.reshape(-1)[0].astype(jnp.float32), y)
+
+
+def matmul_chain(a, b, rep):
+    def body(y, _):
+        ab, yb = lax.optimization_barrier((a, y))
+        return jnp.dot(ab + yb.reshape(-1)[0] * 0, b), ()
+
+    y, _ = lax.scan(body, jnp.zeros_like(a), None, length=rep)
+    return (y.reshape(-1)[0].astype(jnp.float32), y)
+
+
+def main():
+    rtt = _rtt()
+    print(f"device: {jax.devices()[0]}  RTT {rtt*1e3:.1f} ms")
+    key = jax.random.PRNGKey(0)
+
+    REP = 256
+    for name, shape, axes in [
+        ("NCHW [512,64,56,56] red(0,2,3)", (512, 64, 56, 56), (0, 2, 3)),
+        ("NHWC [512,56,56,64] red(0,1,2)", (512, 56, 56, 64), (0, 1, 2)),
+        ("NCHW [512,256,28,28]", (512, 256, 28, 28), (0, 2, 3)),
+        ("NHWC [512,28,28,256]", (512, 28, 28, 256), (0, 1, 2)),
+        ("NCHW [512,2048,7,7]", (512, 2048, 7, 7), (0, 2, 3)),
+        ("NHWC [512,7,7,2048]", (512, 7, 7, 2048), (0, 1, 2)),
+    ]:
+        x = jax.random.normal(key, shape, dtype=jnp.bfloat16)
+        t = _time(lambda x, a=axes: stats4d(x, a, REP), x)
+        nb = int(np.prod(shape)) * 2 * REP
+        dev = max(t - rtt, 1e-9)
+        print(f"{name:34s} {dev*1e3/REP:7.3f} ms/pass "
+              f"{nb/dev/1e9:7.1f} GB/s")
+
+    x = jax.random.normal(key, (1605632, 64), dtype=jnp.bfloat16)
+    t = _time(lambda x: stream(x, REP), x)
+    dev = max(t - rtt, 1e-9)
+    nb = 1605632 * 64 * 2 * REP * 2
+    print(f"{'stream 1r1w [1605632,64]':34s} {dev*1e3/REP:7.3f} ms/pass "
+          f"{nb/dev/1e9:7.1f} GB/s")
+
+    for n in (4096, 8192):
+        a = jax.random.normal(key, (n, n), dtype=jnp.bfloat16)
+        b = jax.random.normal(key, (n, n), dtype=jnp.bfloat16)
+        t = _time(lambda a, b, n=n: matmul_chain(a, b, 32), a, b)
+        dev = max(t - rtt, 1e-9)
+        fl = 2 * n**3 * 32
+        print(f"matmul {n}^3 bf16{'':18s} {dev*1e3/32:7.3f} ms/pass "
+              f"{fl/dev/1e12:7.1f} TF/s")
+
+
+if __name__ == "__main__":
+    main()

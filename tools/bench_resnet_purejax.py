@@ -1,0 +1,282 @@
+"""Pure-JAX ResNet-50 train-step reference: what can this chip really do?
+
+Strips the framework away: hand-rolled ResNet-50 (lax.conv + train-mode BN
++ momentum SGD, bf16 AMP carry exactly like models/resnet.py), donated
+params, 5-step dispatch chunks with host-fetch sync — the same protocol as
+bench.py.  Establishes the device-capability anchor for the framework's
+emission to match.
+
+Env: PJ_LAYOUT=NCHW|NHWC  PJ_BATCH=512  PJ_ITERS=30
+"""
+
+import functools
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+LAYOUT = os.environ.get("PJ_LAYOUT", "NCHW")
+BATCH = int(os.environ.get("PJ_BATCH", "512"))
+ITERS = int(os.environ.get("PJ_ITERS", "30"))
+# fusion-structure experiments: keep BN stats / optimizer updates OUT of
+# the conv fusions (the profile shows conv+epilogue fusions at ~19% MXU
+# while isolated convs hit 130-190 TF/s)
+BARRIER_CONV = os.environ.get("PJ_BARRIER_CONV", "0") == "1"
+BARRIER_OPT = os.environ.get("PJ_BARRIER_OPT", "0") == "1"
+
+# (blocks, out_channels) per stage for ResNet-50
+STAGES = [(3, 256), (4, 512), (6, 1024), (3, 2048)]
+
+
+def conv(x, w, stride=1):
+    if LAYOUT == "NCHW":
+        dn = ("NCHW", "OIHW", "NCHW")
+    else:
+        dn = ("NHWC", "HWIO", "NHWC")
+    kh = w.shape[2] if LAYOUT == "NCHW" else w.shape[0]
+    pad = (kh - 1) // 2
+    y = lax.conv_general_dilated(
+        x, w, (stride, stride), [(pad, pad), (pad, pad)],
+        dimension_numbers=dn, preferred_element_type=jnp.bfloat16)
+    if BARRIER_CONV:
+        y = lax.optimization_barrier(y)
+    return y
+
+
+# y-saving BN: backward reconstructs xhat from the PRE-relu output y
+# ((y - beta)/gamma) instead of re-reading the conv output x, removing one
+# full-tensor read from every BN backward fusion.  The closed-form dx
+# includes the mean/var paths, so gradients match plain autodiff BN.
+Y_SAVING = os.environ.get("PJ_YSAVE", "0") == "1"
+
+
+@jax.custom_vjp
+def _bn_train_core(x, g, b):
+    y, _, _ = _bn_train_fwd_math(x, g, b)
+    return y
+
+
+def _bn_train_fwd_math(x, g, b, eps=1e-5):
+    c_ax = 1 if LAYOUT == "NCHW" else 3
+    axes = tuple(i for i in range(4) if i != c_ax)
+    cshape = [1, 1, 1, 1]
+    cshape[c_ax] = x.shape[c_ax]
+    xf = x.astype(jnp.float32)
+    m = jnp.mean(xf, axis=axes)
+    msq = jnp.mean(jnp.square(xf), axis=axes)
+    v = msq - jnp.square(m)
+    inv = jax.lax.rsqrt(v + eps)
+    a = (inv * g).reshape(cshape)
+    bb = (b - m * inv * g).reshape(cshape)
+    y = x * a.astype(x.dtype) + bb.astype(x.dtype)
+    return y, m, inv
+
+
+def _bn_core_fwd(x, g, b):
+    y, m, inv = _bn_train_fwd_math(x, g, b)
+    return y, (y, g, b, m, inv)
+
+
+def _bn_core_bwd(res, dy):
+    y, g, b, m, inv = res
+    c_ax = 1 if LAYOUT == "NCHW" else 3
+    axes = tuple(i for i in range(4) if i != c_ax)
+    cshape = [1, 1, 1, 1]
+    cshape[c_ax] = y.shape[c_ax]
+    n = 1
+    for i in axes:
+        n *= y.shape[i]
+    f32 = jnp.float32
+    dyf = dy.astype(f32)
+    yf = y.astype(f32)
+    s1 = jnp.sum(dyf, axis=axes)
+    sdy_y = jnp.sum(dyf * yf, axis=axes)
+    u = 1.0 / g
+    s2 = u * sdy_y + (-b * u) * s1      # = sum(dy * xhat)
+    gi = g * inv
+    a1 = gi.reshape(cshape)
+    a2 = (-(inv * u) * s2 / n).reshape(cshape)
+    a3 = ((-gi * s1 + inv * b * u * s2) / n).reshape(cshape)
+    dx = (dy * a1.astype(dy.dtype) + y * a2.astype(y.dtype)
+          + a3.astype(dy.dtype))
+    return dx, s2.astype(g.dtype), s1.astype(b.dtype)
+
+
+_bn_train_core.defvjp(_bn_core_fwd, _bn_core_bwd)
+
+
+def bn(x, p, state, name, momentum=0.9, eps=1e-5):
+    if Y_SAVING:
+        c_ax = 1 if LAYOUT == "NCHW" else 3
+        axes = tuple(i for i in range(4) if i != c_ax)
+        xf = jax.lax.stop_gradient(x).astype(jnp.float32)
+        m = jnp.mean(xf, axis=axes)
+        v = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(m)
+        state[name + "_mean"] = (momentum * state[name + "_mean"]
+                                 + (1 - momentum) * m)
+        state[name + "_var"] = (momentum * state[name + "_var"]
+                                + (1 - momentum) * v)
+        return _bn_train_core(x, p[name + "_g"], p[name + "_b"])
+    return _bn_plain(x, p, state, name, momentum, eps)
+
+
+def _bn_plain(x, p, state, name, momentum=0.9, eps=1e-5):
+    c_ax = 1 if LAYOUT == "NCHW" else 3
+    axes = tuple(i for i in range(4) if i != c_ax)
+    cshape = [1, 1, 1, 1]
+    cshape[c_ax] = x.shape[c_ax]
+    xf = x.astype(jnp.float32)
+    m = jnp.mean(xf, axis=axes)
+    msq = jnp.mean(jnp.square(xf), axis=axes)
+    v = msq - jnp.square(m)
+    state[name + "_mean"] = momentum * state[name + "_mean"] + (1 - momentum) * m
+    state[name + "_var"] = momentum * state[name + "_var"] + (1 - momentum) * v
+    inv = 1.0 / jnp.sqrt(v + eps)
+    a = (inv * p[name + "_g"]).reshape(cshape)
+    b = (p[name + "_b"] - m * inv * p[name + "_g"]).reshape(cshape)
+    return x * a.astype(x.dtype) + b.astype(x.dtype)
+
+
+def make_params(key):
+    p = {}
+
+    def cw(name, o, i, k):
+        nonlocal key
+        key, sub = jax.random.split(key)
+        fan = i * k * k
+        w = jax.random.normal(sub, (o, i, k, k), jnp.float32) * np.sqrt(
+            2.0 / fan)
+        if LAYOUT != "NCHW":
+            w = jnp.transpose(w, (2, 3, 1, 0))
+        p[name] = w
+
+    def bnp(name, c):
+        p[name + "_g"] = jnp.ones((c,), jnp.float32)
+        p[name + "_b"] = jnp.zeros((c,), jnp.float32)
+
+    cw("conv0", 64, 3, 7)
+    bnp("bn0", 64)
+    cin = 64
+    for si, (blocks, cout) in enumerate(STAGES):
+        mid = cout // 4
+        for bi in range(blocks):
+            pre = f"s{si}b{bi}"
+            cw(pre + "c1", mid, cin, 1)
+            bnp(pre + "n1", mid)
+            cw(pre + "c2", mid, mid, 3)
+            bnp(pre + "n2", mid)
+            cw(pre + "c3", cout, mid, 1)
+            bnp(pre + "n3", cout)
+            if bi == 0:
+                cw(pre + "cs", cout, cin, 1)
+                bnp(pre + "ns", cout)
+            cin = cout
+    key, sub = jax.random.split(key)
+    p["fc_w"] = jax.random.normal(sub, (2048, 1000), jnp.float32) * 0.01
+    p["fc_b"] = jnp.zeros((1000,), jnp.float32)
+    return p
+
+
+def make_state(p):
+    s = {}
+    for k in p:
+        if k.endswith("_g"):
+            c = p[k].shape[0]
+            s[k[:-2] + "_mean"] = jnp.zeros((c,), jnp.float32)
+            s[k[:-2] + "_var"] = jnp.ones((c,), jnp.float32)
+    return s
+
+
+def forward(p, state, x):
+    x = x.astype(jnp.bfloat16)
+    x = conv(x, p["conv0"].astype(jnp.bfloat16), 2)
+    x = bn(x, p, state, "bn0")
+    x = jnp.maximum(x, 0)
+    if LAYOUT == "NCHW":
+        window, strides = (1, 1, 3, 3), (1, 1, 2, 2)
+        pads = ((0, 0), (0, 0), (1, 1), (1, 1))
+    else:
+        window, strides = (1, 3, 3, 1), (1, 2, 2, 1)
+        pads = ((0, 0), (1, 1), (1, 1), (0, 0))
+    x = lax.reduce_window(x, -np.inf, lax.max, window, strides, pads)
+    cin = 64
+    for si, (blocks, cout) in enumerate(STAGES):
+        for bi in range(blocks):
+            pre = f"s{si}b{bi}"
+            stride = 2 if (si > 0 and bi == 0) else 1
+            h = conv(x, p[pre + "c1"].astype(jnp.bfloat16), 1)
+            h = jnp.maximum(bn(h, p, state, pre + "n1"), 0)
+            h = conv(h, p[pre + "c2"].astype(jnp.bfloat16), stride)
+            h = jnp.maximum(bn(h, p, state, pre + "n2"), 0)
+            h = conv(h, p[pre + "c3"].astype(jnp.bfloat16), 1)
+            h = bn(h, p, state, pre + "n3")
+            if bi == 0:
+                sc = conv(x, p[pre + "cs"].astype(jnp.bfloat16), stride)
+                sc = bn(sc, p, state, pre + "ns")
+            else:
+                sc = x
+            x = jnp.maximum(h + sc, 0)
+        cin = cout
+    axes = (2, 3) if LAYOUT == "NCHW" else (1, 2)
+    x = jnp.mean(x.astype(jnp.float32), axis=axes)
+    logits = x @ p["fc_w"] + p["fc_b"]
+    return logits
+
+
+def loss_fn(p, state, x, y):
+    state = dict(state)
+    logits = forward(p, state, x)
+    logp = jax.nn.log_softmax(logits)
+    loss = -jnp.mean(jnp.take_along_axis(logp, y, axis=1))
+    return loss, state
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+def train_step(p, vel, state, x, y, lr=0.1, mu=0.9):
+    (loss, new_state), g = jax.value_and_grad(loss_fn, has_aux=True)(
+        p, state, x, y)
+    if BARRIER_OPT:
+        g = lax.optimization_barrier(g)
+    new_p, new_vel = {}, {}
+    for k in p:
+        v = mu * vel[k] + g[k]
+        new_vel[k] = v
+        new_p[k] = p[k] - lr * v
+    return new_p, new_vel, new_state, loss
+
+
+def main():
+    print(f"device={jax.devices()[0]} layout={LAYOUT} batch={BATCH}")
+    key = jax.random.PRNGKey(0)
+    p = make_params(key)
+    state = make_state(p)
+    vel = {k: jnp.zeros_like(v) for k, v in p.items()}
+    rng = np.random.RandomState(0)
+    if LAYOUT == "NCHW":
+        xs = rng.rand(BATCH, 3, 224, 224).astype("float32")
+    else:
+        xs = rng.rand(BATCH, 224, 224, 3).astype("float32")
+    x = jax.device_put(xs)
+    y = jax.device_put(rng.randint(0, 1000, (BATCH, 1)))
+
+    for _ in range(5):
+        p, vel, state, loss = train_step(p, vel, state, x, y)
+    np.asarray(loss)
+    times = []
+    chunk = 5
+    for _ in range(max(ITERS // chunk, 1)):
+        t0 = time.perf_counter()
+        for _ in range(chunk):
+            p, vel, state, loss = train_step(p, vel, state, x, y)
+        np.asarray(loss)
+        times.append((time.perf_counter() - t0) / chunk)
+    med = float(np.median(times))
+    print(f"step {med*1e3:.1f} ms  -> {BATCH/med:.1f} img/s  "
+          f"loss={float(np.asarray(loss)):.4f}")
+
+
+if __name__ == "__main__":
+    main()
