@@ -42,6 +42,11 @@ def multi_head_attention(x, cfg, prefix, is_test=False, use_tp=False,
     fast path replaces the inner three ops when enabled."""
     h, heads = cfg.hidden, cfg.heads
     d = h // heads
+    # three separate projections: a fused [h, 3h] QKV emission (the
+    # reference's multihead_matmul_op.cu input layout) was measured SLOWER
+    # on-chip — 843.5 vs 896.0 seqs/s at bs256/seq128 bf16-carry — the
+    # q/k/v slices force an extra materialization pass that outweighs the
+    # larger MXU tile (BASELINE.md round-4 table)
     q = fluid.layers.fc(x, h, num_flatten_dims=2,
                         param_attr=_attr(prefix + "_q_w", (None, "model"), use_tp))
     k = fluid.layers.fc(x, h, num_flatten_dims=2,

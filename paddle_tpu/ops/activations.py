@@ -105,7 +105,11 @@ _register_act(
 )
 _register_act(
     "gelu",
-    lambda x, approximate=False: jax.nn.gelu(x, approximate=approximate),
+    # f32 internal erf/tanh for the bf16 carry dtype (cheap VPU work; the
+    # converts fuse into the surrounding elementwise fusion)
+    lambda x, approximate=False: jax.nn.gelu(
+        x.astype(jnp.float32) if x.dtype == jnp.bfloat16 else x,
+        approximate=approximate).astype(x.dtype),
     attrs={"approximate": False},
 )
 _register_act(
@@ -118,6 +122,11 @@ _register_act(
 @register_op("softmax", inputs=("X",), outputs=("Out",),
              attrs={"axis": -1, "use_cudnn": False, "use_mkldnn": False})
 def softmax(ctx, x, axis=-1, **_):
+    if x.dtype == jnp.bfloat16:
+        # f32 internal exp/sum (flash_attention and the loss head do the
+        # same); output restores the bf16 carry dtype
+        return jax.nn.softmax(x.astype(jnp.float32), axis=axis).astype(
+            x.dtype)
     return jax.nn.softmax(x, axis=axis)
 
 

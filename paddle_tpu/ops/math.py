@@ -24,8 +24,8 @@ def _flatten2d(x, num_col_dims):
 
 
 def _amp_dot(ctx, x, y, contract_fn):
-    """Matmul helper honoring the program's AMP policy: bf16 operands with
-    the result cast back to f32.  On TPU the MXU accumulates bf16 products
+    """Matmul helper honoring the program's AMP policy: bf16 operands AND
+    a bf16 result (bf16-carry).  On TPU the MXU accumulates bf16 products
     in f32 in hardware; the output dtype stays bf16 (not
     preferred_element_type=f32) so operand and cotangent dtypes remain
     uniform and the dot/conv transpose rules are well-typed under vjp.
@@ -33,10 +33,14 @@ def _amp_dot(ctx, x, y, contract_fn):
     TPU-native replacement for the reference's fp16 cast-rewrite."""
     if ctx is not None and ctx.amp_bf16() and x.dtype in (jnp.float32,
                                                           jnp.bfloat16):
-        out = contract_fn(x.astype(jnp.bfloat16), y.astype(jnp.bfloat16))
-        # bf16-carry: bf16 activations stay bf16 (the loss lowerings upcast
-        # to f32 themselves); f32 inputs cast back up
-        return out if x.dtype == jnp.bfloat16 else out.astype(jnp.float32)
+        # bf16-carry: the output STAYS bf16 even for f32 inputs, so the
+        # whole activation stream downstream of the first matmul rides
+        # bf16 (the loss lowerings upcast to f32 themselves).  The old
+        # cast-back-to-f32-for-f32-inputs rule made the entire BERT
+        # encoder carry f32 activations — every LN / residual / dropout /
+        # attention-transpose pass moved twice the bytes (measured 28 ms
+        # of f32 layout copies alone in the bs256 step).
+        return contract_fn(x.astype(jnp.bfloat16), y.astype(jnp.bfloat16))
     return contract_fn(x, y)
 
 
@@ -102,6 +106,14 @@ def _register_elementwise(name, fn):
         attrs={"axis": -1},
     )
     def _low(ctx, x, y, axis=-1, _fn=fn):
+        if (ctx is not None and ctx.amp_bf16()
+                and jnp.bfloat16 in (x.dtype, y.dtype)
+                and jnp.float32 in (x.dtype, y.dtype)):
+            # bf16-carry: a mixed bf16/f32 pair (bf16 activation + f32
+            # bias/param) computes in bf16 — jnp promotion would silently
+            # lift the whole activation stream back to f32
+            x = x.astype(jnp.bfloat16)
+            y = y.astype(jnp.bfloat16)
         yb = bcast_y(x, y, axis)
         return _fn(x, yb)
 

@@ -15,7 +15,8 @@ from jax import lax
 
 from ..core.registry import GradOpDesc, register_op
 from ..framework import _grad_var_name
-from .common import attr_dtype, dtype_enum
+from .common import (attr_dtype, bernoulli_bytes, dtype_enum,
+                     realized_keep_prob)
 
 
 # -- conv --------------------------------------------------------------------
@@ -432,14 +433,19 @@ def layer_norm(ctx, x, scale, bias, epsilon=1e-5, begin_norm_axis=1):
                         m2.astype(x.dtype).reshape(lead),
                         v2.astype(x.dtype).reshape(lead))
     axes = tuple(range(begin_norm_axis, x.ndim))
-    m = jnp.mean(x, axis=axes, keepdims=True)
-    v = jnp.var(x, axis=axes, keepdims=True)
-    y = (x - m) / jnp.sqrt(v + epsilon)
+    # bf16 inputs (the AMP carry dtype) get f32 internal statistics — an
+    # 8-bit-mantissa mean/var costs accuracy (same policy as the Pallas
+    # kernel and _bn_impl); the carry dtype is restored on the outputs
+    xf = x.astype(jnp.float32) if x.dtype == jnp.bfloat16 else x
+    m = jnp.mean(xf, axis=axes, keepdims=True)
+    v = jnp.var(xf, axis=axes, keepdims=True)
+    y = (xf - m) / jnp.sqrt(v + epsilon)
     if scale is not None:
         y = y * scale.reshape(tail)
     if bias is not None:
         y = y + bias.reshape(tail)
-    return y, m.reshape(lead), v.reshape(lead)
+    return (y.astype(x.dtype), m.astype(x.dtype).reshape(lead),
+            v.astype(x.dtype).reshape(lead))
 
 
 @register_op(
@@ -536,14 +542,19 @@ def _dropout_grad_maker(op, no_grad_set):
 def dropout(ctx, x, dropout_prob=0.5, is_test=False, fix_seed=False, seed=0,
             dropout_implementation="downgrade_in_infer", **_):
     if is_test:
+        # deterministic inference path: NOMINAL scale, exact reference
+        # parity for imported models (no sampling happens here)
         if dropout_implementation == "upscale_in_train":
             return x, jnp.ones_like(x, dtype=jnp.uint8)
         return x * (1.0 - dropout_prob), jnp.ones_like(x, dtype=jnp.uint8)
+    # training scale factors use the REALIZED keep probability of the
+    # quantized byte draw (round(keep*256)/256) so E[out] = x exactly
+    q = realized_keep_prob(1.0 - dropout_prob)
     key = jax.random.key(seed) if fix_seed else ctx.rng()
-    keep = jax.random.bernoulli(key, 1.0 - dropout_prob, x.shape)
+    keep = bernoulli_bytes(key, 1.0 - dropout_prob, x.shape)
     mask = keep.astype(jnp.uint8)
     if dropout_implementation == "upscale_in_train":
-        out = jnp.where(keep, x / (1.0 - dropout_prob), 0.0)
+        out = jnp.where(keep, x / q, 0.0)
     else:
         out = jnp.where(keep, x, 0.0)
     return out, mask
@@ -561,7 +572,8 @@ def dropout_grad(ctx, mask, dy, dropout_prob=0.5, is_test=False,
                  dropout_implementation="downgrade_in_infer", **_):
     m = mask.astype(dy.dtype)
     if dropout_implementation == "upscale_in_train":
-        return dy * m / (1.0 - dropout_prob)
+        # same realized-keep divisor as the forward (see dropout)
+        return dy * m / realized_keep_prob(1.0 - dropout_prob)
     return dy * m
 
 
@@ -704,8 +716,9 @@ def _attention_composed(q, k, v, bias, causal, sm_scale, keep_mask=None,
         s = jnp.where(kj <= qi, s, jnp.asarray(-1e30, s.dtype))
     p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
     if keep_mask is not None:
+        kq = realized_keep_prob(1.0 - dropout_prob)
         p = jnp.where(keep_mask.astype(bool),
-                      p / jnp.asarray(1.0 - dropout_prob, p.dtype),
+                      p / jnp.asarray(kq, p.dtype),
                       jnp.asarray(0.0, p.dtype))
     return jnp.einsum(eq_o, p, v)
 
@@ -792,8 +805,8 @@ def flash_attention_op(ctx, q, k, v, bias_qk=None, causal=False, scale=0.0,
         H = q.shape[2] if bshd else q.shape[1]
         Sq = q.shape[1] if bshd else q.shape[2]
         Sk = k.shape[1] if bshd else k.shape[2]
-        keep = jax.random.bernoulli(ctx.rng(), 1.0 - dropout_prob,
-                                    (B, H, Sq, Sk))
+        keep = bernoulli_bytes(ctx.rng(), 1.0 - dropout_prob,
+                               (B, H, Sq, Sk))
         out = _attention_composed(q, k, v, bias_qk, causal, sm_scale,
                                   keep, dropout_prob, bshd)
         return out, keep.astype(jnp.uint8)

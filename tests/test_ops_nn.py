@@ -245,7 +245,14 @@ def test_dropout_train_statistics():
     drop_rate = (np.asarray(out) == 0).mean()
     assert abs(drop_rate - 0.4) < 0.03
     kept = np.asarray(out)[np.asarray(out) != 0]
-    np.testing.assert_allclose(kept, 1 / 0.6, rtol=1e-5)
+    # the byte-threshold draw keeps with probability round(0.6*256)/256 and
+    # upscales by exactly that realized probability (ops/common.py
+    # bernoulli_bytes), so E[out] = x holds exactly under the quantized draw
+    from paddle_tpu.ops.common import realized_keep_prob
+
+    q = realized_keep_prob(0.6)
+    assert abs(q - 0.6) <= 1 / 512 + 1e-12
+    np.testing.assert_allclose(kept, 1 / q, rtol=1e-5)
 
 
 class TestLookupTableV2(OpTest):

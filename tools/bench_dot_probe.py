@@ -1,0 +1,61 @@
+"""Probe: isolated dot efficiency at BERT-base bs256/seq128 shapes.
+
+In-program matmul-class fusions run at ~43% MXU; this measures each dot
+shape alone (barrier-chained, host-fetch sync) to separate "XLA dots are
+slow at these shapes" from "the fused epilogues/layouts slow them down".
+"""
+
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import sys as _sys, os as _os
+_sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
+from bench_util import timed as _time, tunnel_rtt as _rtt
+from jax import lax
+
+REP = 64
+
+
+def dot_chain(a, b, rep, batched=False):
+    def body(c, _):
+        ab, cb = lax.optimization_barrier((a, c))
+        if batched:
+            y = jnp.einsum("bik,bkj->bij", ab, b)
+        else:
+            y = jnp.dot(ab, b)
+        yb = lax.optimization_barrier(y)
+        return yb.reshape(-1)[0].astype(jnp.float32) * 1e-9 + cb * 0, ()
+
+    out, _ = lax.scan(body, jnp.float32(0.0), None, length=rep)
+    return (out,)
+
+
+def main():
+    rtt = _rtt()
+    print(f"device: {jax.devices()[0]}  RTT {rtt*1e3:.1f} ms")
+    key = jax.random.PRNGKey(0)
+    cases = [
+        ("qkv/proj [32768,768]x[768,768]", (32768, 768), (768, 768), False),
+        ("ffn1 [32768,768]x[768,3072]", (32768, 768), (768, 3072), False),
+        ("ffn2 [32768,3072]x[3072,768]", (32768, 3072), (3072, 768), False),
+        ("wgrad [768,32768]x[32768,3072]", (768, 32768), (32768, 3072),
+         False),
+        ("head [4915,768]x[768,30522]", (4915, 768), (768, 30522), False),
+        ("scores [3072,128,64]x[3072,64,128]", (3072, 128, 64),
+         (3072, 64, 128), True),
+    ]
+    for name, sa, sb, batched in cases:
+        a = jax.random.normal(key, sa, jnp.bfloat16)
+        b = jax.random.normal(key, sb, jnp.bfloat16)
+        if batched:
+            fl = 2 * sa[0] * sa[1] * sa[2] * sb[2]
+        else:
+            fl = 2 * sa[0] * sa[1] * sb[1]
+        t = _time(lambda a, b, bt=batched: dot_chain(a, b, REP, bt), a, b)
+        dev = max(t - rtt, 1e-9) / REP
+        print(f"{name:36s} {dev*1e3:7.3f} ms  {fl/dev/1e12:6.1f} TF/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -8,37 +8,14 @@ scalar-add carries got simplified away: slice-of-dot, (x+c)^2 expansion),
 host-fetch sync, RTT subtracted, REP sized so device time >> RTT noise.
 """
 
-import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+import sys as _sys, os as _os
+_sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
+from bench_util import timed as _time, tunnel_rtt as _rtt
 from jax import lax
-
-
-def _time(fn, *args, r=5):
-    f = jax.jit(fn)
-    o = f(*args)
-    np.asarray(o[0])
-    ts = []
-    for _ in range(r):
-        t0 = time.perf_counter()
-        o = f(*args)
-        np.asarray(o[0])
-        ts.append(time.perf_counter() - t0)
-    return float(np.median(ts))
-
-
-def _rtt():
-    f = jax.jit(lambda s: s + 1.0)
-    s = jnp.float32(0.0)
-    np.asarray(f(s))
-    ts = []
-    for _ in range(9):
-        t0 = time.perf_counter()
-        np.asarray(f(s))
-        ts.append(time.perf_counter() - t0)
-    return float(np.median(ts))
 
 
 def stats4d(x, axes, rep):

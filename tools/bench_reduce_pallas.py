@@ -16,11 +16,13 @@ Usage: python tools/bench_reduce_pallas.py [variant ...]
 
 import functools
 import sys
-import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+import sys as _sys, os as _os
+_sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
+from bench_util import timed as _time, tunnel_rtt as _rtt
 from jax import lax
 from jax.experimental import pallas as pl
 
@@ -31,32 +33,6 @@ SHAPES = {
     "c256": (512 * 28 * 28, 256),
 }
 REP = 64  # chained passes per jit call
-R = 5     # timed calls
-
-
-def _time(fn, *args):
-    f = jax.jit(fn)
-    o = f(*args)
-    np.asarray(o[0])
-    ts = []
-    for _ in range(R):
-        t0 = time.perf_counter()
-        o = f(*args)
-        np.asarray(o[0])
-        ts.append(time.perf_counter() - t0)
-    return float(np.median(ts))
-
-
-def _rtt():
-    f = jax.jit(lambda s: s + 1.0)
-    s = jnp.float32(0.0)
-    np.asarray(f(s))
-    ts = []
-    for _ in range(7):
-        t0 = time.perf_counter()
-        np.asarray(f(s))
-        ts.append(time.perf_counter() - t0)
-    return float(np.median(ts))
 
 
 def _report(name, shape, t, rtt, passes=1.0):
@@ -187,7 +163,7 @@ def jnp_affine_stats(x, a, b):
 
 
 def main():
-    want = set(sys.argv[1:])
+    want = set(_sys.argv[1:])
     print(f"device: {jax.devices()[0]}")
     rtt = _rtt()
     print(f"tunnel RTT: {rtt*1e3:.1f} ms (subtracted)")

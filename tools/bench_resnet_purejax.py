@@ -97,9 +97,11 @@ def _bn_core_bwd(res, dy):
     u = 1.0 / g
     s2 = u * sdy_y + (-b * u) * s1      # = sum(dy * xhat)
     gi = g * inv
+    # dx = gi*(dy - S1/n - xhat*S2/n); gi*xhat = inv*(y - b), so the y
+    # coefficient is plain inv (NOT inv/g — xhat's 1/g cancels against gi)
     a1 = gi.reshape(cshape)
-    a2 = (-(inv * u) * s2 / n).reshape(cshape)
-    a3 = ((-gi * s1 + inv * b * u * s2) / n).reshape(cshape)
+    a2 = (-inv * s2 / n).reshape(cshape)
+    a3 = ((-gi * s1 + inv * b * s2) / n).reshape(cshape)
     dx = (dy * a1.astype(dy.dtype) + y * a2.astype(y.dtype)
           + a3.astype(dy.dtype))
     return dx, s2.astype(g.dtype), s1.astype(b.dtype)

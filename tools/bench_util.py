@@ -1,0 +1,45 @@
+"""Shared measurement harness for the device probes.
+
+The axon tunnel adds ~95-120 ms of host round-trip to every dispatch+fetch
+and `block_until_ready` does not actually wait on this platform, so every
+probe must: chain repetitions inside ONE jit call (lax.scan with
+lax.optimization_barrier on loop-invariant operands — XLA otherwise elides
+work via slice-of-dot/slice-of-conv/hoisted algebra), sync via a host
+fetch of a scalar that data-depends on all outputs, and subtract the
+separately-measured RTT.  The round-2 roofline in BASELINE.md was wrong
+precisely because its harness skipped these steps.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timed(fn, *args, r=5):
+    """Median wall time of r calls of jit(fn)(*args), host-fetch synced on
+    the first element of the result tuple."""
+    f = jax.jit(fn)
+    o = f(*args)
+    np.asarray(o[0])
+    ts = []
+    for _ in range(r):
+        t0 = time.perf_counter()
+        o = f(*args)
+        np.asarray(o[0])
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def tunnel_rtt(r=9):
+    """Median dispatch+fetch round-trip for a trivial computation."""
+    f = jax.jit(lambda s: s + 1.0)
+    s = jnp.float32(0.0)
+    np.asarray(f(s))
+    ts = []
+    for _ in range(r):
+        t0 = time.perf_counter()
+        np.asarray(f(s))
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
