@@ -202,7 +202,10 @@ def run_op(op, env, rng_key, mesh=None, axis_names=(), runner=None,
            data_axis=None):
     """Lower one op: gather inputs from env, call the lowering, scatter
     outputs back into env."""
+    from .registry import record_executed
+
     opdef = get_op_def(op.type)
+    record_executed(op.type)
     args = [_gather_slot(opdef, op, s, env) for s in opdef.input_slots]
     ctx = LowerCtx(rng_key=rng_key, op=op, block=op.block, mesh=mesh,
                    axis_names=axis_names, runner=runner, env=env,

@@ -334,6 +334,20 @@ def register_op(
     return deco
 
 
+# -- executed-op recording ---------------------------------------------------
+# Every op type actually LOWERED for execution (graph run_op + dygraph
+# trace_op; shape-inference's abstract evaluation does not count).  The
+# op-coverage audit (tests/test_op_coverage.py + conftest sessionfinish)
+# reads this so coverage means "a test executed the lowering", not "the op
+# name appears somewhere in test text" — a golden replaced by a comment
+# containing the op name now fails the audit (round-3 verdict weak #3).
+EXECUTED_OP_TYPES = set()
+
+
+def record_executed(type):
+    EXECUTED_OP_TYPES.add(type)
+
+
 def get_op_def(type):
     _ensure_ops_loaded()
     if type not in _OP_REGISTRY:

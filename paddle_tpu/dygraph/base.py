@@ -90,6 +90,9 @@ class Tracer:
             )
         op = framework.Operator(block, type, inputs, outputs, attrs)
         opdef.validate(op)
+        from ..core.registry import record_executed
+
+        record_executed(type)
 
         in_objs = {k: _as_var_objs(block, v) for k, v in (inputs or {}).items()}
         out_objs = {k: _as_var_objs(block, v) for k, v in (outputs or {}).items()}

@@ -27,6 +27,11 @@ _DEFAULTS = {
     # per-parameter sgd/momentum/adam ops into one flat update — ~46 ms
     # of a 211 ms ResNet-50 step was per-weight launch overhead
     "FLAGS_fuse_optimizer_ops": True,
+    # per-request PS RPC deadline in MILLISECONDS (reference units —
+    # paddle/fluid/operators/distributed/ FLAGS_rpc_deadline, default
+    # 180000): a pserver that hangs mid-round raises ConnectionError on
+    # the trainer instead of blocking its recv() forever.  <=0 disables.
+    "FLAGS_rpc_deadline": 180000,
     # opt-in fused Pallas LayerNorm (pallas_kernels/layer_norm.py): wins
     # standalone microbenches, measured -1.5% inside full BERT on the
     # bench chip (breaks XLA's LN-neighbor fusions) — see ops/nn.py

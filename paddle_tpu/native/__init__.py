@@ -127,6 +127,7 @@ def _declare(lib):
         c.POINTER(c.c_void_p),
     ]
     lib.rpc_free.argtypes = [c.c_void_p]
+    lib.rpcc_set_deadline.argtypes = [c.c_void_p, c.c_double]
     lib.rpcc_close.argtypes = [c.c_void_p]
 
 

@@ -19,6 +19,7 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
+#include <sys/time.h>
 #include <unistd.h>
 
 #include <condition_variable>
@@ -325,6 +326,22 @@ void* rpcc_connect(const char* host, int port) {
   auto* c = new Client();
   c->fd = fd;
   return c;
+}
+
+// Per-request deadline (reference FLAGS_rpc_deadline,
+// paddle/fluid/operators/distributed/grpc/grpc_client.cc): a pserver that
+// hangs mid-round must surface as an error on the trainer, not block its
+// recv() forever.  seconds <= 0 restores fully-blocking behavior.
+void rpcc_set_deadline(void* h, double seconds) {
+  auto* c = static_cast<Client*>(h);
+  timeval tv{};
+  if (seconds > 0) {
+    tv.tv_sec = static_cast<time_t>(seconds);
+    tv.tv_usec =
+        static_cast<suseconds_t>((seconds - static_cast<double>(tv.tv_sec)) * 1e6);
+  }
+  ::setsockopt(c->fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  ::setsockopt(c->fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
 }
 
 int rpcc_send_var(void* h, const char* name, unsigned char dtype,

@@ -73,9 +73,15 @@ class RpcServer:
 
 
 class RpcClient:
-    def __init__(self, endpoint, connect_timeout=60.0):
+    def __init__(self, endpoint, connect_timeout=60.0, rpc_deadline=None):
         """Retries until the server is up (the reference client's
-        wait-for-server behavior; grpc_client.cc connect deadline)."""
+        wait-for-server behavior; grpc_client.cc connect deadline).
+
+        rpc_deadline: per-REQUEST deadline in seconds; a pserver that hangs
+        mid-round raises ConnectionError on the trainer instead of blocking
+        forever (reference FLAGS_rpc_deadline + grpc_client.cc deadline
+        handling).  None reads FLAGS_rpc_deadline (milliseconds, reference
+        units; <=0 disables)."""
         import time
 
         self._lib = load()
@@ -93,6 +99,22 @@ class RpcClient:
             raise ConnectionError("cannot connect to pserver %s within %.0fs"
                                   % (endpoint, connect_timeout))
         self.endpoint = endpoint
+        if rpc_deadline is None:
+            from .. import flags as _flags
+
+            ms = _flags.get_flags(["FLAGS_rpc_deadline"])[
+                "FLAGS_rpc_deadline"]
+            rpc_deadline = float(ms) / 1000.0 if ms and ms > 0 else 0.0
+        self.rpc_deadline = float(rpc_deadline or 0.0)
+        if self.rpc_deadline > 0:
+            self._lib.rpcc_set_deadline(self._h, self.rpc_deadline)
+
+    def _err(self, what):
+        hint = (" (deadline %.0fs — pserver hung or connection lost)"
+                % self.rpc_deadline if self.rpc_deadline > 0
+                else " (connection lost)")
+        return ConnectionError("%s to %s failed%s"
+                               % (what, self.endpoint, hint))
 
     def send_var(self, name, arr):
         arr = np.ascontiguousarray(arr)
@@ -101,8 +123,7 @@ class RpcClient:
             self._h, name.encode(), _DT_TO_CODE[arr.dtype], dims, arr.ndim,
             arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes)
         if rc != 0:
-            raise ConnectionError("send_var(%s) to %s failed"
-                                  % (name, self.endpoint))
+            raise self._err("send_var(%s)" % name)
 
     def get_var(self, name):
         c = ctypes
@@ -113,8 +134,7 @@ class RpcClient:
         n = self._lib.rpcc_get_var(self._h, name.encode(), c.byref(dtype),
                                    dims, 16, c.byref(ndim), c.byref(data))
         if n < 0:
-            raise ConnectionError("get_var(%s) from %s failed"
-                                  % (name, self.endpoint))
+            raise self._err("get_var(%s)" % name)
         shape = tuple(dims[i] for i in range(ndim.value))
         buf = ctypes.string_at(data.value, n)
         self._lib.rpc_free(data)
@@ -123,8 +143,7 @@ class RpcClient:
 
     def barrier(self, kind):
         if self._lib.rpcc_barrier(self._h, kind.encode()) != 0:
-            raise ConnectionError("barrier(%s) to %s failed"
-                                  % (kind, self.endpoint))
+            raise self._err("barrier(%s)" % kind)
 
     def complete(self):
         self._lib.rpcc_complete(self._h)

@@ -90,13 +90,19 @@ def gpipe_spmd(stage_fn, n_stages, n_micro, axis="pp"):
 
 
 def make_pipeline_step(stage_fn, loss_fn, mesh, n_micro, axis="pp",
-                       optimizer=None, embed_fn=None, n_chunks=1):
+                       optimizer=None, embed_fn=None, n_chunks=1,
+                       data_axis=None):
     """Jitted stage-sharded GPipe train step.
 
     stage_fn(params, h) -> h'      one stage (params = that stage's slice)
     loss_fn(outs, labels) -> scalar   computed on last-stage outputs
     embed_fn(x) -> h               optional replicated pre-pipeline embed
     optimizer(p, g) -> p'          optional sgd-style update per leaf
+    data_axis                      optional SECOND mesh axis for composed
+        data x pipeline parallelism (a real pod job's topology): the
+        microbatch dim is sharded over it, params stay replicated across
+        it, and gradients/loss are pmean'd over it — loss_fn must be a
+        mean over its microbatch outputs so shard means average exactly.
 
     n_chunks > 1 bounds activation memory: the n_micro microbatches run
     as n_chunks sequential GPipe passes of n_micro/n_chunks each, with
@@ -161,6 +167,13 @@ def make_pipeline_step(stage_fn, loss_fn, mesh, n_micro, axis="pp",
                                            grads_sum)
         # replicate the loss for reporting OUTSIDE the differentiated path
         loss = lax.psum(lax.stop_gradient(loss_local), axis)
+        if data_axis is not None:
+            # composed dp: every data shard ran the full pipeline on its
+            # slice of each microbatch; average across the data axis
+            # (outside the differentiated path, like the loss psum above)
+            grads = jax.tree_util.tree_map(
+                lambda g: lax.pmean(g, data_axis), grads)
+            loss = lax.pmean(loss, data_axis)
         if optimizer is not None:
             new_params = jax.tree_util.tree_map(optimizer, params_local,
                                                 grads)
@@ -185,15 +198,21 @@ def make_pipeline_step(stage_fn, loss_fn, mesh, n_micro, axis="pp",
             raise ValueError("batch %d not divisible by n_micro %d"
                              % (B, n_micro))
         mb = B // n_micro
+        if data_axis is not None and mb % mesh.shape[data_axis]:
+            raise ValueError(
+                "microbatch size %d not divisible by data axis %r size %d"
+                % (mb, data_axis, mesh.shape[data_axis]))
         x_micro = x.reshape((n_micro, mb) + x.shape[1:])
         if embed_fn is not None:
             x_micro = jax.vmap(embed_fn)(x_micro)
         labels_micro = labels.reshape((n_micro, mb) + labels.shape[1:])
         pspec = jax.tree_util.tree_map(
             lambda v: P(axis, *([None] * (v.ndim - 1))), params_stacked)
+        # composed dp x pp: shard the within-microbatch dim over data_axis
+        xspec = P(None, data_axis) if data_axis is not None else P()
         body = shard_map_compat(
             spmd_body, mesh,
-            in_specs=(pspec, P(), P()),
+            in_specs=(pspec, xspec, xspec),
             out_specs=(P(), pspec))
         return body(params_stacked, x_micro, labels_micro)
 

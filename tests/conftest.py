@@ -80,3 +80,43 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if "tpu" in item.keywords:
             item.add_marker(skip)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Executed-op coverage: dump and (on full default-tier runs) enforce.
+
+    Recording happens in core/registry.py record_executed (graph run_op +
+    dygraph trace_op).  Enforcement runs only for a clean, unfiltered run
+    of the whole tests/ directory, so partial runs (-k, -m, single files)
+    stay usable.
+    """
+    from paddle_tpu.core.registry import EXECUTED_OP_TYPES
+
+    out = os.environ.get("PADDLE_TPU_OP_COVERAGE_OUT")
+    if out:
+        with open(out, "w") as f:
+            f.write("\n".join(sorted(EXECUTED_OP_TYPES)) + "\n")
+    if TPU_TIER or exitstatus != 0:
+        return
+    opt = session.config.option
+    if (getattr(opt, "keyword", "") or getattr(opt, "markexpr", "")
+            or getattr(opt, "collectonly", False)):
+        return
+    here = os.path.dirname(os.path.abspath(__file__))
+    roots = {here, os.path.dirname(here)}
+    if not session.config.args or not all(
+            os.path.abspath(a.rstrip("/")) in roots
+            for a in session.config.args):
+        return
+    from test_op_coverage import executed_required_ops
+
+    missing = sorted(executed_required_ops() - EXECUTED_OP_TYPES)
+    if missing:
+        tr = session.config.pluginmanager.get_plugin("terminalreporter")
+        msg = ("op-coverage audit: %d required reference ops were never "
+               "EXECUTED by this test session: %s" % (len(missing), missing))
+        if tr:
+            tr.write_line("FAILED " + msg, red=True)
+        else:
+            print(msg)
+        session.exitstatus = 1

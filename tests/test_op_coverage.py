@@ -89,3 +89,46 @@ def test_allowlist_is_tight():
     ref = set(_ref_ops())
     for name in ALLOWLIST:
         assert name in ref, "stale allowlist entry %r" % name
+
+
+# ops that are REGISTERED and text-covered but legitimately cannot be
+# EXECUTED inside the default-tier pytest session; each entry must carry a
+# reason.  Populated from the empirical executed-op dump — keep this list
+# shrinking, not growing.
+EXEC_ALLOWLIST = {}
+
+
+def executed_required_ops():
+    """The op set the sessionfinish audit (tests/conftest.py) requires to
+    have been EXECUTED (lowered for a real run, not just name-dropped in
+    test text) by a full default-tier session."""
+    return {n for n in _ref_ops()
+            if n not in ALLOWLIST and n not in EXEC_ALLOWLIST}
+
+
+def test_execution_recording_works():
+    """Meta-test: the audit's recording hook actually records — run one op
+    through the executor and one through dygraph and see both land in
+    EXECUTED_OP_TYPES.  If recording silently broke, the sessionfinish
+    audit would fail the whole run; this localizes the failure."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.core.registry import EXECUTED_OP_TYPES
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        y = fluid.layers.sqrt(x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        exe.run(main, feed={"x": np.ones((2, 4), "float32")},
+                fetch_list=[y])
+    assert "sqrt" in EXECUTED_OP_TYPES
+    from paddle_tpu import dygraph
+
+    with dygraph.guard():
+        v = dygraph.to_variable(np.ones((2, 3), "float32"))
+        (v * v).numpy()
+    assert "elementwise_mul" in EXECUTED_OP_TYPES
