@@ -19,10 +19,15 @@ Each run prints ONE JSON line {"metric","value","unit","vs_baseline"}.
 
 Anchors: H100 ResNet-50 train ~3000 img/s/chip (NVIDIA NGC MLPerf-era
 mixed-precision single-GPU; the former 2400 figure was generous), BERT-base
-seq128 pretrain ~2300 seqs/s/chip (NGC LAMB phase-1 class).  BASELINE.md
-records the measured device roofline (this v5e-lite tunnel measures ~83
-TF/s bf16 matmul peak and ~65-150 GB/s effective HBM) alongside, since
-H100-relative gates presume hardware ratios this chip does not have.
+seq128 pretrain ~2300 seqs/s/chip (NGC LAMB phase-1 class), Transformer-base
+NMT ~200k tokens/s/chip (see bench_nmt for the derivation).  Device roofline
+(round-4 CORRECTED, measured with dependency-chained scans + optimization
+barriers + RTT subtracted — tools/bench_dot_probe.py, bench_conv_probe.py,
+bench_layout_probe.py): **193 TF/s bf16 matmul peak (8192^3), 155-164 TF/s
+at BERT-shape dots, 700-886 GB/s reduce/stream HBM bandwidth** — a
+full-spec v5e.  The round-2 "83 TF/s / 65-150 GB/s" numbers were a
+tunnel-RTT measurement artifact (every dispatch+fetch pays ~95-120 ms of
+host round trip); they are falsified and must not be cited.
 Protocol per BASELINE.md: warmup, then median of timed chunks.
 """
 
@@ -50,9 +55,11 @@ def _timed_loop(run_step, sync, warmup, iters, chunk=None):
     # The axon tunnel costs ~95-120 ms per dispatch+fetch round trip (the
     # host-sync at each chunk boundary).  At chunk=5 that is ~21 ms/step of
     # pure tunnel artifact on top of ~210 ms device time — and its jitter
-    # is the round-3 "2160 vs 2202" capture variance.  chunk=15 amortizes
-    # it to ~7 ms/step; the RTT is a property of the test tunnel, not the
-    # chip, so deeper chunks are the more honest steady-state measurement.
+    # is the round-3 "2160 vs 2202" capture variance.  The default
+    # BENCH_CHUNK=30 amortizes it to ~3.5 ms/step; the RTT is a property
+    # of the test tunnel, not the chip, so deeper chunks are the more
+    # honest steady-state measurement.  Numbers are only comparable across
+    # rounds at the same chunk — BASELINE.md rows record it.
     if chunk is None:
         chunk = int(os.environ.get("BENCH_CHUNK", "30"))
     out = None
@@ -201,6 +208,33 @@ def _bert_train_flops_per_seq(seq_len=128, layers=12, hidden=768,
     enc = layers * (per_layer * seq_len + 2 * 2 * seq_len * seq_len * hidden)
     head = seq_len * hidden * vocab * 2
     return 3 * (enc + head)
+
+
+def _nmt_train_flops_per_token(src_len=64, tgt_len=64, d=512, ffn=2048,
+                               enc_layers=6, dec_layers=6, vocab=30000):
+    # transformer-base matmul flops per batch element, fwd; train = 3x.
+    # enc layer/token: qkv+proj 4*d^2*2, ffn 2*(d*ffn*2); dec layer adds
+    # cross-attention projections (another 4*d^2*2); head: d*vocab*2 per
+    # TARGET token; attention scores 2*2*s*d per token.
+    enc_tok = 4 * d * d * 2 + 2 * d * ffn * 2 + 2 * 2 * src_len * d
+    dec_tok = 8 * d * d * 2 + 2 * d * ffn * 2 + 2 * 2 * tgt_len * d * 2
+    fwd = (src_len * enc_layers * enc_tok + tgt_len * dec_layers * dec_tok
+           + tgt_len * d * vocab * 2)
+    return 3 * fwd / (src_len + tgt_len)
+
+
+# H100 transformer-base NMT anchor, derived (BASELINE.md config 4 note):
+# the recorded H100 BERT anchor implies 2300 seqs/s * 85 GFLOP/seq =
+# ~196 TF/s = ~20% MFU of the 989 TF/s bf16 peak; applying that SAME MFU
+# to transformer-base's train FLOPs/token gives the tokens/s an H100
+# would post on this config.  This is generous to the H100 (small d=512 /
+# seq-64 models run at LOWER MFU than BERT-base), hence an honest upper
+# anchor.  Note the physics: H100:v5e peak ratio is ~5:1, so any
+# compute-bound config on ONE chip is bounded near vs_baseline ~0.2
+# at matched MFU (the BERT r1 note; ResNet escapes it by being
+# bandwidth-bound on the H100).
+H100_NMT_TOKENS_PER_SEC = (H100_BERT_SEQ_PER_SEC * _bert_train_flops_per_seq()
+                           / _nmt_train_flops_per_token())
 
 
 def bench_nmt(batch=128, src_len=64, tgt_len=64, warmup=3, iters=15):
@@ -372,11 +406,17 @@ def main():
     elif cfg == "nmt":
         batch = int(os.environ.get("BENCH_BATCH", "128"))
         toks, _loss = bench_nmt(batch=batch, iters=max(iters // 2, 5))
+        tfs = toks * _nmt_train_flops_per_token() / 1e12
         print(json.dumps({
             "metric": "transformer_nmt_tokens_per_sec_per_chip",
             "value": round(toks, 2),
             "unit": "tokens/sec",
-            "vs_baseline": 0.0,  # no public per-chip anchor (BASELINE.md)
+            # anchor: H100 at its BERT-anchor MFU applied to this model's
+            # FLOPs/token (derivation at H100_NMT_TOKENS_PER_SEC; ~0.2 is
+            # the peak-ratio bound for compute-bound 1-chip configs)
+            "vs_baseline": round(toks / H100_NMT_TOKENS_PER_SEC, 4),
+            "model_tflops_per_sec": round(tfs, 1),
+            "mfu_vs_v5e_peak": round(tfs / V5E_BF16_PEAK_TFLOPS, 4),
         }))
     elif cfg == "longctx":
         seq = int(os.environ.get("BENCH_SEQ", "4096"))

@@ -81,7 +81,13 @@ class RpcClient:
         mid-round raises ConnectionError on the trainer instead of blocking
         forever (reference FLAGS_rpc_deadline + grpc_client.cc deadline
         handling).  None reads FLAGS_rpc_deadline (milliseconds, reference
-        units; <=0 disables)."""
+        units; <=0 disables).  Semantics note: the deadline is enforced as
+        a per-syscall IDLE timeout (SO_RCVTIMEO/SO_SNDTIMEO), not an
+        elapsed-wall-clock deadline like the reference's gRPC one — a
+        server that keeps trickling bytes resets it; a silent one trips
+        it.  On the first deadline failure the client is POISONED (handle
+        closed): the socket may be mid-frame, so retrying on it would
+        silently desync framing; reconnect with a new RpcClient."""
         import time
 
         self._lib = load()
@@ -113,10 +119,22 @@ class RpcClient:
         hint = (" (deadline %.0fs — pserver hung or connection lost)"
                 % self.rpc_deadline if self.rpc_deadline > 0
                 else " (connection lost)")
+        # a timed-out socket may be mid-frame: a retried call on the same
+        # connection would read misaligned frames (silent desync), so the
+        # first failure poisons the client — callers must reconnect
+        self.close()
         return ConnectionError("%s to %s failed%s"
                                % (what, self.endpoint, hint))
 
+    def _check_open(self, what):
+        if not self._h:
+            raise ConnectionError(
+                "%s to %s: client closed after a previous deadline/transport "
+                "failure — reconnect with a new RpcClient" %
+                (what, self.endpoint))
+
     def send_var(self, name, arr):
+        self._check_open("send_var(%s)" % name)
         arr = np.ascontiguousarray(arr)
         dims = (ctypes.c_longlong * max(arr.ndim, 1))(*(arr.shape or (0,)))
         rc = self._lib.rpcc_send_var(
@@ -126,6 +144,7 @@ class RpcClient:
             raise self._err("send_var(%s)" % name)
 
     def get_var(self, name):
+        self._check_open("get_var(%s)" % name)
         c = ctypes
         dtype = c.c_ubyte()
         dims = (c.c_longlong * 16)()
@@ -142,10 +161,13 @@ class RpcClient:
             .reshape(shape).copy()
 
     def barrier(self, kind):
+        self._check_open("barrier(%s)" % kind)
         if self._lib.rpcc_barrier(self._h, kind.encode()) != 0:
             raise self._err("barrier(%s)" % kind)
 
     def complete(self):
+        if not self._h:
+            return  # fire-and-forget; tolerate a poisoned/closed client
         self._lib.rpcc_complete(self._h)
 
     def close(self):

@@ -64,6 +64,15 @@ def bcast_y(x, y, axis=-1):
     return jnp.reshape(y, new_shape)
 
 
+def realized_prob(keep_prob):
+    """The keep probability bernoulli_bytes actually samples with:
+    round(keep_prob*256)/256, clamped to [0, 1].  Use wherever the
+    SAMPLING distribution matters (e.g. the downgrade_in_infer inference
+    multiply); realized_keep_prob below is the NaN-guarded DIVISOR
+    variant."""
+    return min(max(int(round(float(keep_prob) * 256.0)), 0), 256) / 256.0
+
+
 def realized_keep_prob(keep_prob):
     """The keep probability bernoulli_bytes actually samples with —
     round(keep_prob*256)/256 — as a SCALE DIVISOR: clamped to >= 1/256 so
@@ -87,15 +96,15 @@ def bernoulli_bytes(key, keep_prob, shape):
     dropout regularization (the reference's float-compare draw has its own
     f32 rounding).  Deterministic for a given key, like bernoulli.
     """
+    thr = int(round(float(keep_prob) * 256.0))
     if not all(isinstance(d, (int, np.integer)) and d >= 0 for d in shape):
         # symbolic dims (graph-build shape inference) take the reference
-        # per-element draw — only traced/concrete lowerings get the fast
-        # path, and both have identical output shape/dtype
-        return jax.random.bernoulli(key, keep_prob, shape)
+        # per-element draw — with the same REALIZED prob as the byte path
+        # so callers' realized_keep_prob divisor matches either way
+        return jax.random.bernoulli(key, realized_prob(keep_prob), shape)
     n = 1
     for d in shape:
         n *= int(d)
-    thr = int(round(float(keep_prob) * 256.0))
     if thr >= 256:
         return jnp.ones(shape, bool)
     if thr <= 0:

@@ -16,7 +16,7 @@ from jax import lax
 from ..core.registry import GradOpDesc, register_op
 from ..framework import _grad_var_name
 from .common import (attr_dtype, bernoulli_bytes, dtype_enum,
-                     realized_keep_prob)
+                     realized_keep_prob, realized_prob)
 
 
 # -- conv --------------------------------------------------------------------
@@ -542,11 +542,14 @@ def _dropout_grad_maker(op, no_grad_set):
 def dropout(ctx, x, dropout_prob=0.5, is_test=False, fix_seed=False, seed=0,
             dropout_implementation="downgrade_in_infer", **_):
     if is_test:
-        # deterministic inference path: NOMINAL scale, exact reference
-        # parity for imported models (no sampling happens here)
         if dropout_implementation == "upscale_in_train":
             return x, jnp.ones_like(x, dtype=jnp.uint8)
-        return x * (1.0 - dropout_prob), jnp.ones_like(x, dtype=jnp.uint8)
+        # downgrade scaling uses the REALIZED keep prob of the quantized
+        # training draw (realized_prob: no 1/256 NaN-guard floor — this is
+        # a multiply, not a divisor) so E[train out] == infer out exactly;
+        # <=1/512 absolute deviation from the reference's nominal scale
+        return (x * realized_prob(1.0 - dropout_prob),
+                jnp.ones_like(x, dtype=jnp.uint8))
     # training scale factors use the REALIZED keep probability of the
     # quantized byte draw (round(keep*256)/256) so E[out] = x exactly
     q = realized_keep_prob(1.0 - dropout_prob)
