@@ -215,9 +215,12 @@ def _nmt_train_flops_per_token(src_len=64, tgt_len=64, d=512, ffn=2048,
     # transformer-base matmul flops per batch element, fwd; train = 3x.
     # enc layer/token: qkv+proj 4*d^2*2, ffn 2*(d*ffn*2); dec layer adds
     # cross-attention projections (another 4*d^2*2); head: d*vocab*2 per
-    # TARGET token; attention scores 2*2*s*d per token.
+    # TARGET token; attention scores 2*2*span*d per token, where the span
+    # is tgt_len for decoder self-attention but SRC_len for
+    # cross-attention (the decoder attends over the encoder sequence).
     enc_tok = 4 * d * d * 2 + 2 * d * ffn * 2 + 2 * 2 * src_len * d
-    dec_tok = 8 * d * d * 2 + 2 * d * ffn * 2 + 2 * 2 * tgt_len * d * 2
+    dec_tok = (8 * d * d * 2 + 2 * d * ffn * 2
+               + 2 * 2 * tgt_len * d + 2 * 2 * src_len * d)
     fwd = (src_len * enc_layers * enc_tok + tgt_len * dec_layers * dec_tok
            + tgt_len * d * vocab * 2)
     return 3 * fwd / (src_len + tgt_len)

@@ -31,6 +31,7 @@ __all__ = [
     "adaptive_pool2d",
     "batch_norm",
     "layer_norm",
+    "fused_dropout_add_ln",
     "group_norm",
     "instance_norm",
     "relu",
@@ -1003,6 +1004,52 @@ def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
         attrs={"epsilon": epsilon, "begin_norm_axis": begin_norm_axis},
     )
     return helper.append_activation(out)
+
+
+def fused_dropout_add_ln(x, y, dropout_prob=0.0, is_test=False,
+                         begin_norm_axis=1, epsilon=1e-5, param_attr=None,
+                         bias_attr=None, name=None, seed=None):
+    """LayerNorm(x + dropout(y)) as ONE op — the transformer-encoder
+    epilogue, lowered to a fused single-pass Pallas kernel on TPU (see
+    ops/nn.py fused_dropout_add_ln; reference analog:
+    paddle/fluid/operators/fused/fused_fc_elementwise_layernorm_op.cu,
+    extended with in-kernel dropout for training).  Exactly equivalent to
+
+        layer_norm(elementwise_add(x, dropout(y, dropout_prob,
+                   dropout_implementation="upscale_in_train")), ...)
+
+    with dropout's keep probability realized at 2^-32 granularity."""
+    helper = LayerHelper("fused_dropout_add_ln", name=name)
+    dtype = x.dtype
+    norm_size = 1
+    for d in x.shape[begin_norm_axis:]:
+        norm_size *= int(d)
+    from ..initializer import Constant
+
+    scale_p = helper.create_parameter(
+        attr=param_attr, shape=[norm_size], dtype=dtype,
+        default_initializer=Constant(1.0))
+    bias_p = helper.create_parameter(
+        attr=bias_attr, shape=[norm_size], dtype=dtype, is_bias=True)
+    out = helper.create_variable_for_type_inference(dtype)
+    r_out = helper.create_variable_for_type_inference(
+        dtype, stop_gradient=True)
+    mean_out = helper.create_variable_for_type_inference(
+        dtype, stop_gradient=True)
+    var_out = helper.create_variable_for_type_inference(
+        dtype, stop_gradient=True)
+    seed_out = helper.create_variable_for_type_inference(
+        "int32", stop_gradient=True)
+    helper.append_op(
+        type="fused_dropout_add_ln",
+        inputs={"X": [x], "Y": [y], "Scale": [scale_p], "Bias": [bias_p]},
+        outputs={"Out": [out], "R": [r_out], "Mean": [mean_out],
+                 "Variance": [var_out], "Seed": [seed_out]},
+        attrs={"dropout_prob": float(dropout_prob), "is_test": is_test,
+               "epsilon": epsilon, "begin_norm_axis": begin_norm_axis,
+               "fix_seed": seed is not None, "seed": seed or 0},
+    )
+    return out
 
 
 def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,

@@ -103,13 +103,43 @@ _register_act(
     lambda x, scale_a=0.67, scale_b=1.7159: scale_b * jnp.tanh(scale_a * x),
     attrs={"scale_a": 0.67, "scale_b": 1.7159},
 )
+import functools as _functools
+
+
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _gelu_bf16(x, approximate):
+    return jax.nn.gelu(x.astype(jnp.float32),
+                       approximate=approximate).astype(x.dtype)
+
+
+def _gelu_bf16_fwd(x, approximate):
+    return _gelu_bf16(x, approximate), x
+
+
+def _gelu_bf16_bwd(approximate, x, dy):
+    # The barrier stops XLA from CSE-ing this f32 upcast with the
+    # forward's: without it the shared f32 pre-activation is MATERIALIZED
+    # for the backward — an extra f32 tensor write+read per gelu (402 MB
+    # per BERT-base ffn layer; profiled as
+    # (bf16[32768,3072], f32[32768,3072]) dual-output fusions) — instead
+    # of a free in-register recompute from the saved bf16 activation.
+    xf = jax.lax.optimization_barrier(x).astype(jnp.float32)
+    _, vjp = jax.vjp(
+        lambda u: jax.nn.gelu(u, approximate=approximate), xf)
+    (df,) = vjp(dy.astype(jnp.float32))
+    return (df.astype(x.dtype),)
+
+
+_gelu_bf16.defvjp(_gelu_bf16_fwd, _gelu_bf16_bwd)
+
 _register_act(
     "gelu",
     # f32 internal erf/tanh for the bf16 carry dtype (cheap VPU work; the
-    # converts fuse into the surrounding elementwise fusion)
-    lambda x, approximate=False: jax.nn.gelu(
-        x.astype(jnp.float32) if x.dtype == jnp.bfloat16 else x,
-        approximate=approximate).astype(x.dtype),
+    # converts fuse into the surrounding elementwise fusion).  bf16 takes
+    # the custom vjp above so the backward re-casts instead of saving f32.
+    lambda x, approximate=False: (
+        _gelu_bf16(x, approximate) if x.dtype == jnp.bfloat16
+        else jax.nn.gelu(x, approximate=approximate).astype(x.dtype)),
     attrs={"approximate": False},
 )
 _register_act(

@@ -858,6 +858,102 @@ def flash_attention_grad_op(ctx, q, k, v, bias_qk, mask, dy, causal=False,
 flash_attention_op.opdef.rng_when = _fa_uses_dropout
 
 
+def _fdaln_uses_dropout(attrs):
+    return (float(attrs.get("dropout_prob", 0.0) or 0.0) > 0.0
+            and not attrs.get("is_test", False))
+
+
+def _fused_dropout_add_ln_grad_maker(op, no_grad_set):
+    inputs = {
+        "R": list(op.output("R")),
+        "Scale": list(op.input("Scale")),
+        "Seed": list(op.output("Seed")),
+        "Mean": list(op.output("Mean")),
+        "Variance": list(op.output("Variance")),
+        "GRAD@Out": [_grad_var_name(op.output("Out")[0])],
+    }
+    outputs = {}
+    for slot in ("X", "Y", "Scale"):
+        n = op.input(slot)[0]
+        if n not in no_grad_set:
+            outputs["X@" + slot] = [_grad_var_name(n)]
+    n = op.input("Bias")[0]
+    if n not in no_grad_set:
+        outputs["X@Bias"] = [_grad_var_name(n)]
+    if not outputs:
+        return []
+    return [GradOpDesc("fused_dropout_add_ln_grad", inputs, outputs,
+                       dict(op.attrs))]
+
+
+@register_op(
+    "fused_dropout_add_ln",
+    inputs=("X", "Y", "Scale", "Bias"),
+    outputs=("Out", "R", "Mean", "Variance", "Seed"),
+    attrs={"dropout_prob": 0.0, "is_test": False, "epsilon": 1e-5,
+           "begin_norm_axis": 1, "fix_seed": False, "seed": 0},
+    grad_maker=_fused_dropout_add_ln_grad_maker,
+    n_rng=1,
+)
+def fused_dropout_add_ln_op(ctx, x, y, scale, bias, dropout_prob=0.0,
+                            is_test=False, epsilon=1e-5, begin_norm_axis=1,
+                            fix_seed=False, seed=0, **_):
+    """Out = LayerNorm(X + dropout_upscale(Y)): the transformer-encoder
+    epilogue as ONE op, lowered to a single-HBM-pass Pallas kernel on TPU
+    (pallas_kernels/fused_ln.py; jnp fallback elsewhere).
+
+    TPU-native counterpart of the reference's
+    fused_fc_elementwise_layernorm op
+    (paddle/fluid/operators/fused/fused_fc_elementwise_layernorm_op.cu —
+    inference-only there), extended with in-kernel dropout for training:
+    measured 1.82x the composed dropout->add->layer_norm emission fwd+bwd
+    at the flagship BERT shape (tools/bench_fused_ln_probe.py).
+
+    The dropout mask is never materialized: the forward draws it from the
+    on-core PRNG seeded by the Seed output (two u32 words stored as
+    int32), and the grad op re-draws the identical mask from that saved
+    seed — the Mask-output contract of the dropout op at 1/12288th the
+    memory.  The backward's only large residual is the R output (the
+    post-dropout residual sum); X and Y are NOT saved for it (dx == dr,
+    dy == mask*dr/q).  Dropout semantics are upscale_in_train with the
+    realized keep probability round(q*2^32)/2^32.
+    """
+    from ..pallas_kernels import fused_ln as _fln
+
+    p = 0.0 if is_test else float(dropout_prob)
+    if p > 0.0:
+        key = jax.random.key(seed) if fix_seed else ctx.rng()
+        seed_arr = jax.random.bits(key, (2,), jnp.uint32)
+    else:
+        seed_arr = jnp.zeros((2,), jnp.uint32)
+    z, r, mean, var = _fln.fused_ln_fwd(x, y, scale, bias, p, seed_arr,
+                                        epsilon, begin_norm_axis)
+    return z, r, mean, var, seed_arr.astype(jnp.int32)
+
+
+@register_op(
+    "fused_dropout_add_ln_grad",
+    inputs=("R", "Scale", "Seed", "Mean", "Variance", "GRAD@Out"),
+    outputs=("X@X", "X@Y", "X@Scale", "X@Bias"),
+    attrs={"dropout_prob": 0.0, "is_test": False, "epsilon": 1e-5,
+           "begin_norm_axis": 1, "fix_seed": False, "seed": 0},
+    grad_maker=None,
+)
+def fused_dropout_add_ln_grad_op(ctx, r, scale, seed_words, mean, var,
+                                 dz, dropout_prob=0.0, is_test=False,
+                                 epsilon=1e-5, begin_norm_axis=1, **_):
+    # NB: the Seed INPUT is named seed_words because the attr dict also
+    # carries a (fix_seed-mode) "seed" attr passed as a kwarg
+    from ..pallas_kernels import fused_ln as _fln
+
+    p = 0.0 if is_test else float(dropout_prob)
+    return _fln.fused_ln_bwd(r, scale, seed_words, mean, var, dz, p,
+                             epsilon, begin_norm_axis)
+
+
+fused_dropout_add_ln_op.opdef.rng_when = _fdaln_uses_dropout
+
+
 @register_op(
     "ring_attention",
     inputs=("Q", "K", "V"),

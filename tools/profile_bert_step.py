@@ -1,0 +1,116 @@
+"""Capture a jax.profiler trace of the BERT bench step and print the
+per-fusion device-time decomposition (the round-4/5 optimization loop's
+measurement tool).
+
+Usage: python tools/profile_bert_step.py [steps]
+"""
+
+import os
+import sys
+import tempfile
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import numpy as np
+
+    import bench
+    from timeline import from_xplane
+
+    steps = int(sys.argv[1]) if len(sys.argv) > 1 else 3
+
+    # build the bench step exactly as bench_bert does, but hand-run it
+    import paddle_tpu as fluid
+    from paddle_tpu.models import bert as bert_model
+
+    batch, seq = int(os.environ.get("PROFILE_BATCH", "192")), 128
+    cfg = bert_model.BERT_BASE
+    # AMP like bench_bert — the f32 and bf16-carry programs have entirely
+    # different fusion structures, so profiling the wrong one misleads
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        inputs, seq_out = bert_model.bert_encoder(cfg, seq)
+        mask_pos = fluid.layers.data("mask_pos", shape=[1], dtype="int64")
+        mask_label = fluid.layers.data("mask_label", shape=[1],
+                                       dtype="int64")
+        flat = fluid.layers.reshape(seq_out, [-1, cfg.hidden])
+        picked = fluid.layers.gather(flat, mask_pos)
+        trans = fluid.layers.fc(picked, cfg.hidden, act="gelu")
+        trans = fluid.layers.layer_norm(trans, begin_norm_axis=1)
+        logits = fluid.layers.fc(trans, cfg.vocab_size)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, mask_label))
+        opt = fluid.optimizer.Adam(learning_rate=1e-4)
+        opt = fluid.contrib.mixed_precision.decorate(opt)
+        opt.minimize(loss)
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    n_mask = batch * int(seq * 0.15)
+    feed = {
+        "src_ids": rng.randint(0, cfg.vocab_size, (batch, seq, 1)).astype("int64"),
+        "pos_ids": np.tile(np.arange(seq)[None, :, None], (batch, 1, 1)).astype("int64"),
+        "sent_ids": rng.randint(0, 2, (batch, seq, 1)).astype("int64"),
+        "input_mask": np.ones((batch, seq, 1), "float32"),
+        "mask_pos": rng.randint(0, batch * seq, (n_mask, 1)).astype("int64"),
+        "mask_label": rng.randint(0, cfg.vocab_size, (n_mask, 1)).astype("int64"),
+    }
+    feed = {k: jax.device_put(v) for k, v in feed.items()}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+
+        def step():
+            out, = exe.run(main_p, feed=feed, fetch_list=[loss],
+                           return_numpy=False)
+            return out
+
+        for _ in range(3):
+            out = step()
+        np.asarray(out)
+
+        tmpd = tempfile.mkdtemp(prefix="bert_prof_")
+        with jax.profiler.trace(tmpd):
+            for _ in range(steps):
+                out = step()
+            np.asarray(out)
+
+    trace = from_xplane(tmpd)
+    # device lane "XLA Ops"; async -start/-done spans cover their whole
+    # in-flight window and OVERLAP compute, so they are not device time —
+    # excluded from the totals
+    buckets = defaultdict(float)
+    total = 0.0
+    for ev in trace["traceEvents"]:
+        if "XLA Ops" not in ev["tid"]:
+            continue
+        name = ev["name"]
+        if ("-start" in name or "-done" in name or "slice-s" in name
+                or "copy-s" in name or "copy-d" in name):
+            continue
+        key = name.split(".")[0].split("(")[0].split("=")[0].strip()
+        buckets[key] += ev["dur"] / 1e3  # ms
+        total += ev["dur"] / 1e3
+    print("total sync device ms over %d steps: %.1f (%.1f ms/step)" %
+          (steps, total, total / steps))
+    for k, v in sorted(buckets.items(), key=lambda kv: -kv[1])[:28]:
+        print("  %-46s %8.2f ms/step" % (k, v / steps))
+    if os.environ.get("PROFILE_TOP_OPS") == "1":
+        per_op = defaultdict(float)
+        for ev in trace["traceEvents"]:
+            if "XLA Ops" not in ev["tid"]:
+                continue
+            name = ev["name"]
+            if ("-start" in name or "-done" in name):
+                continue
+            per_op[name] += ev["dur"] / 1e3
+        print("\ntop individual ops:")
+        for k, v in sorted(per_op.items(), key=lambda kv: -kv[1])[:40]:
+            print("  %9.3f ms/step  %s" % (v / steps, k))
+
+
+if __name__ == "__main__":
+    main()
