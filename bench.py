@@ -157,6 +157,62 @@ def _bert_feed(rng, cfg, batch, seq_len, mask_frac=0.15):
 
 def bench_bert(batch=256, seq_len=128, warmup=3, iters=15, amp=True,
                use_amp_decorator=True):
+    """Falls back bs256 -> 240 -> 224 on device OOM: round 5 sits within
+    ~1% of the 16G HBM at bs256 and the allocator tips over
+    NONDETERMINISTICALLY run to run (same binary: 1194.5 seqs/s one run,
+    ResourceExhausted the next — BASELINE.md r5 note).  The achieved
+    batch is reported alongside the number."""
+    import subprocess as _sp
+    import sys as _sys
+
+    batches = [batch] + [x for x in (240, 224, 192) if x < batch]
+    last_err = ""
+    for i, b in enumerate(batches):
+        if i == 0:
+            try:
+                r = _bench_bert_at(b, seq_len, warmup, iters, amp)
+                return r[0], r[1], b
+            except Exception as e:
+                if "RESOURCE_EXHAUSTED" not in str(e):
+                    raise
+                last_err = str(e)[:300]
+            # free as much of the failed attempt as the runtime allows
+            # before a retry shares the chip with this process
+            try:
+                import gc
+
+                import jax
+
+                gc.collect()
+                jax.clear_caches()
+            except Exception:
+                pass
+        else:
+            # fresh SUBPROCESS per retry: a failed in-process attempt
+            # pins its device buffers somewhere in the runtime (gc +
+            # jax.clear_caches measured insufficient — every smaller
+            # retry OOMed in-process while the same batch ran fine in a
+            # fresh interpreter)
+            code = ("import bench; r = bench._bench_bert_at(%d, %d, %d, "
+                    "%d, %s); print('BENCH_RESULT', r[0], r[1])"
+                    % (b, seq_len, warmup, iters, amp))
+            p = _sp.run([_sys.executable, "-c", code],
+                        capture_output=True, text=True,
+                        cwd=os.path.dirname(os.path.abspath(__file__)))
+            for line in p.stdout.splitlines():
+                if line.startswith("BENCH_RESULT"):
+                    _, v, l = line.split()
+                    return float(v), float(l), b
+            last_err = (p.stderr or p.stdout)[-300:]
+            if "RESOURCE_EXHAUSTED" not in last_err:
+                raise RuntimeError("bench_bert subprocess bs%d failed: %s"
+                                   % (b, last_err))
+        print("bench_bert: bs%d OOM, retrying smaller" % b,
+              file=_sys.stderr)
+    raise RuntimeError("bench_bert: all batch sizes OOMed: %s" % last_err)
+
+
+def _bench_bert_at(batch, seq_len, warmup, iters, amp):
     import jax
 
     import paddle_tpu as fluid
@@ -396,7 +452,8 @@ def main():
     iters = int(os.environ.get("BENCH_ITERS", "60"))
     if cfg == "bert":
         batch = int(os.environ.get("BENCH_BATCH", "256"))
-        seqs, _loss = bench_bert(batch=batch, iters=max(iters // 2, 5))
+        seqs, _loss, got_batch = bench_bert(batch=batch,
+                                            iters=max(iters // 2, 5))
         tfs = seqs * _bert_train_flops_per_seq() / 1e12
         print(json.dumps({
             "metric": "bert_base_pretrain_seqs_per_sec_per_chip",
@@ -405,6 +462,9 @@ def main():
             "vs_baseline": round(seqs / H100_BERT_SEQ_PER_SEC, 4),
             "model_tflops_per_sec": round(tfs, 1),
             "mfu_vs_v5e_peak": round(tfs / V5E_BF16_PEAK_TFLOPS, 4),
+            # the HBM-edge fallback may have reduced the batch: per-chip
+            # throughput is still comparable, but record what actually ran
+            "batch": got_batch,
         }))
     elif cfg == "nmt":
         batch = int(os.environ.get("BENCH_BATCH", "128"))

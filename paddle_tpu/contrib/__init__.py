@@ -1,2 +1,44 @@
-from . import mixed_precision  # noqa: F401
+"""contrib package — experimental / incubating APIs.
+
+Export surface mirrors the reference's contrib/__init__.py:17-50
+(python/paddle/fluid/contrib): decoder, memory_usage_calc, op_frequence,
+quantize, reader, slim, utils, extend_optimizer, model_stat,
+mixed_precision, layers — every name in the reference's __all__ resolves
+as fluid.contrib.<name> here — plus the deprecated trainer/inferencer
+shims (contrib/trainer.py:34, inferencer.py:28).
+"""
+
+from . import decoder  # noqa: F401
+from .decoder import *  # noqa: F401,F403
+from . import memory_usage_calc  # noqa: F401
+from .memory_usage_calc import *  # noqa: F401,F403
+from . import op_frequence  # noqa: F401
+from .op_frequence import *  # noqa: F401,F403
+from . import quantize  # noqa: F401
+from .quantize import *  # noqa: F401,F403
+from . import reader  # noqa: F401
+from .reader import *  # noqa: F401,F403
 from . import slim  # noqa: F401
+from . import utils  # noqa: F401
+from .utils import *  # noqa: F401,F403
+from . import extend_optimizer  # noqa: F401
+from .extend_optimizer import *  # noqa: F401,F403
+from . import model_stat  # noqa: F401
+from . import mixed_precision  # noqa: F401
+from . import layers  # noqa: F401
+from .layers import *  # noqa: F401,F403
+from . import trainer  # noqa: F401
+from . import inferencer  # noqa: F401
+from .trainer import Trainer  # noqa: F401
+from .inferencer import Inferencer  # noqa: F401
+
+__all__ = []
+__all__ += decoder.__all__
+__all__ += memory_usage_calc.__all__
+__all__ += op_frequence.__all__
+__all__ += quantize.__all__
+__all__ += reader.__all__
+__all__ += utils.__all__
+__all__ += extend_optimizer.__all__
+__all__ += ["mixed_precision"]
+__all__ += layers.__all__

@@ -1,0 +1,55 @@
+"""Deprecated high-level Inferencer API.
+
+Parity: python/paddle/fluid/contrib/inferencer.py:31 (deprecated
+upstream; kept for user-code compatibility).
+"""
+
+import contextlib
+
+from .. import io
+from ..core.executor import Executor, scope_guard
+from ..core.scope import Scope
+from ..framework import Program, program_guard
+from .trainer import check_and_get_place
+
+__all__ = ["Inferencer"]
+
+
+class Inferencer(object):
+    """infer_func() rebuilds the prediction network; parameters load from
+    param_path; infer(inputs) runs a feed-dict through it."""
+
+    def __init__(self, infer_func, param_path, place=None, parallel=False):
+        self.param_path = param_path
+        self.scope = Scope()
+        self.parallel = parallel
+        self.place = check_and_get_place(place)
+        from ..utils import unique_name
+
+        self.inference_program = Program()
+        with program_guard(self.inference_program):
+            # fresh name scope so infer_func recreates the SAME parameter
+            # names train_func did (the reference wraps infer_func in
+            # unique_name.guard())
+            with unique_name.guard():
+                self.predict_var = infer_func()
+        self.exe = Executor(self.place)
+        with self._prog_and_scope_guard():
+            io.load_persistables(self.exe, param_path,
+                                 main_program=self.inference_program)
+        self.inference_program = self.inference_program.clone(for_test=True)
+
+    def infer(self, inputs, return_numpy=True):
+        if not isinstance(inputs, dict):
+            raise ValueError(
+                "inputs should be a map of {'input_name': input_var}")
+        with self._prog_and_scope_guard():
+            return self.exe.run(self.inference_program, feed=inputs,
+                                fetch_list=[self.predict_var.name],
+                                return_numpy=return_numpy)
+
+    @contextlib.contextmanager
+    def _prog_and_scope_guard(self):
+        with program_guard(main_program=self.inference_program):
+            with scope_guard(self.scope):
+                yield

@@ -1,0 +1,19 @@
+"""contrib.layers — experimental composite layers.
+
+Parity with the reference package
+(python/paddle/fluid/contrib/layers/__init__.py:15-27): the 8 fused /
+variable-length layer wrappers (nn.py), the composite basic_gru /
+basic_lstm RNN API (rnn_impl.py), and ctr_metric_bundle (metric_op.py).
+"""
+
+from . import nn
+from .nn import *  # noqa: F401,F403
+from . import rnn_impl
+from .rnn_impl import *  # noqa: F401,F403
+from . import metric_op
+from .metric_op import *  # noqa: F401,F403
+
+__all__ = []
+__all__ += nn.__all__
+__all__ += rnn_impl.__all__
+__all__ += metric_op.__all__

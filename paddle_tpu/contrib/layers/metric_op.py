@@ -1,0 +1,77 @@
+"""contrib metric layers.
+
+Parity: python/paddle/fluid/contrib/layers/metric_op.py:27
+(ctr_metric_bundle) — CTR metric accumulators built from the same op
+sequence as the reference (squared_l2_norm / l1_norm / reduce_sum into
+persistable accumulators updated in place each step).
+"""
+
+from ...initializer import Constant
+from ...layer_helper import LayerHelper
+
+__all__ = ["ctr_metric_bundle"]
+
+
+def ctr_metric_bundle(input, label):
+    """CTR metric accumulators: returns (local_sqrerr, local_abserr,
+    local_prob, local_q, local_pos_num, local_ins_num) — persistable sums
+    updated every step; divide by instance number (and allreduce under
+    distribution) to get RMSE/MAE/predicted-ctr/q exactly as the
+    reference documents."""
+    assert list(input.shape) == list(label.shape)
+    helper = LayerHelper("ctr_metric_bundle")
+
+    def acc():
+        v = helper.create_global_variable(persistable=True, dtype="float32",
+                                          shape=[1])
+        helper.set_variable_initializer(v, Constant(value=0.0))
+        return v
+
+    local_abserr, local_sqrerr = acc(), acc()
+    local_prob, local_q = acc(), acc()
+    local_pos_num, local_ins_num = acc(), acc()
+
+    def tmp(shape=(1,)):
+        return helper.create_variable_for_type_inference("float32")
+
+    tmp_res_elesub = tmp()
+    tmp_res_sigmoid = tmp()
+    tmp_ones = tmp()
+    batch_sqrerr, batch_abserr = tmp(), tmp()
+    batch_prob, batch_q = tmp(), tmp()
+    batch_pos_num, batch_ins_num = tmp(), tmp()
+
+    def op(type_, ins, outs, attrs=None):
+        helper.append_op(type=type_, inputs=ins, outputs=outs,
+                         attrs=attrs or {})
+
+    op("elementwise_sub", {"X": [input], "Y": [label]},
+       {"Out": [tmp_res_elesub]})
+    op("squared_l2_norm", {"X": [tmp_res_elesub]}, {"Out": [batch_sqrerr]})
+    op("elementwise_add", {"X": [batch_sqrerr], "Y": [local_sqrerr]},
+       {"Out": [local_sqrerr]})
+    op("l1_norm", {"X": [tmp_res_elesub]}, {"Out": [batch_abserr]})
+    op("elementwise_add", {"X": [batch_abserr], "Y": [local_abserr]},
+       {"Out": [local_abserr]})
+    op("reduce_sum", {"X": [input]}, {"Out": [batch_prob]},
+       {"reduce_all": True, "keep_dim": False})
+    op("elementwise_add", {"X": [batch_prob], "Y": [local_prob]},
+       {"Out": [local_prob]})
+    op("sigmoid", {"X": [input]}, {"Out": [tmp_res_sigmoid]})
+    op("reduce_sum", {"X": [tmp_res_sigmoid]}, {"Out": [batch_q]},
+       {"reduce_all": True, "keep_dim": False})
+    op("elementwise_add", {"X": [batch_q], "Y": [local_q]},
+       {"Out": [local_q]})
+    op("reduce_sum", {"X": [label]}, {"Out": [batch_pos_num]},
+       {"reduce_all": True, "keep_dim": False})
+    op("elementwise_add", {"X": [batch_pos_num], "Y": [local_pos_num]},
+       {"Out": [local_pos_num]})
+    op("fill_constant_batch_size_like", {"Input": [label]},
+       {"Out": [tmp_ones]},
+       {"shape": [-1, 1], "dtype": 5, "value": 1.0})
+    op("reduce_sum", {"X": [tmp_ones]}, {"Out": [batch_ins_num]},
+       {"reduce_all": True, "keep_dim": False})
+    op("elementwise_add", {"X": [batch_ins_num], "Y": [local_ins_num]},
+       {"Out": [local_ins_num]})
+    return (local_sqrerr, local_abserr, local_prob, local_q, local_pos_num,
+            local_ins_num)

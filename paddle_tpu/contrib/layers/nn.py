@@ -1,0 +1,244 @@
+"""contrib experimental layer wrappers.
+
+Parity: python/paddle/fluid/contrib/layers/nn.py:27 — the 8 wrappers over
+ops that already exist in this repo's registry (fused_elemwise_activation,
+var_conv_2d, match_matrix_tensor, sequence_topk_avg_pooling, tree_conv,
+fused_embedding_seq_pool, multiclass_nms2, pyramid_hash).  The wrappers
+reproduce the reference's parameter-creation shapes, op slots, attrs and
+return contracts exactly; the op lowerings are the TPU-native ones.
+"""
+
+from ...layer_helper import LayerHelper
+
+__all__ = [
+    "fused_elemwise_activation",
+    "sequence_topk_avg_pooling",
+    "var_conv_2d",
+    "match_matrix_tensor",
+    "tree_conv",
+    "fused_embedding_seq_pool",
+    "multiclass_nms2",
+    "search_pyramid_hash",
+]
+
+
+def _pair(v, name):
+    if isinstance(v, (list, tuple)):
+        if len(v) != 2:
+            raise ValueError("%s must have two elements" % name)
+        return [int(v[0]), int(v[1])]
+    return [int(v), int(v)]
+
+
+def fused_elemwise_activation(x, y, functor_list, axis=-1, scale=0.0,
+                              save_intermediate_out=True):
+    """out = Unary(Binary(x, y)) or Binary(x, Unary(y)) as one op
+    (reference contrib/layers/nn.py:39; op: fused_elemwise_activation_op.cc).
+    functor_list: two of {elementwise_add, elementwise_mul, scale, relu,
+    tanh}, e.g. ['elementwise_add', 'relu']."""
+    if isinstance(functor_list, str):
+        functor_list = functor_list.split(",")
+    if not isinstance(functor_list, list) or len(functor_list) != 2:
+        raise ValueError(
+            "functor_list should be a list of str, and the length should "
+            "be 2.")
+    helper = LayerHelper("fused_elemwise_activation")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    intermediate_out = helper.create_variable_for_type_inference(
+        dtype=x.dtype)
+    helper.append_op(
+        type="fused_elemwise_activation",
+        inputs={"X": [x], "Y": [y]},
+        outputs={"Out": [out], "IntermediateOut": [intermediate_out]},
+        attrs={"axis": axis, "scale": scale,
+               "save_intermediate_out": save_intermediate_out,
+               "functor_list": list(functor_list)},
+    )
+    return out
+
+
+def var_conv_2d(input, row, col, input_channel, output_channel, filter_size,
+                stride=1, param_attr=None, act=None, dtype="float32",
+                name=None):
+    """Variable-size 2-D convolution over per-sequence row/col extents
+    (reference contrib/layers/nn.py:103; op var_conv_2d_op.cc)."""
+    helper = LayerHelper("var_conv_2d", param_attr=param_attr, act=act,
+                         name=name)
+    filter_size = _pair(filter_size, "filter_size")
+    stride = _pair(stride, "stride")
+    filter_shape = [int(output_channel),
+                    int(input_channel) * filter_size[0] * filter_size[1]]
+    filter_param = helper.create_parameter(attr=param_attr,
+                                           shape=filter_shape, dtype=dtype)
+    conv_res = helper.create_variable_for_type_inference(dtype)
+    tmp_res = helper.create_variable_for_type_inference(
+        dtype, stop_gradient=True)
+    helper.append_op(
+        type="var_conv_2d",
+        inputs={"X": [input], "ROW": [row], "COLUMN": [col],
+                "W": [filter_param]},
+        outputs={"Out": [conv_res], "Col": [tmp_res]},
+        attrs={"InputChannel": int(input_channel),
+               "OutputChannel": int(output_channel),
+               "StrideH": stride[0], "StrideW": stride[1],
+               "KernelH": filter_size[0], "KernelW": filter_size[1]},
+    )
+    return helper.append_activation(conv_res)
+
+
+def match_matrix_tensor(x, y, channel_num, act=None, param_attr=None,
+                        dtype="float32", name=None):
+    """Semantic matching matrix x W y^T with a [h, channel_num, h]
+    learnable W (reference contrib/layers/nn.py:219; op
+    match_matrix_tensor_op.cc).  Returns (out, tmp)."""
+    helper = LayerHelper("match_matrix_tensor", param_attr=param_attr,
+                         act=act, name=name)
+    x_shape, y_shape = list(x.shape), list(y.shape)
+    assert (len(x_shape) == 2 and len(y_shape) == 2
+            and x_shape[-1] == y_shape[-1])
+    w = helper.create_parameter(
+        attr=param_attr, shape=[x_shape[-1], int(channel_num), y_shape[-1]],
+        dtype=dtype)
+    mm_res = helper.create_variable_for_type_inference(dtype)
+    tmp_res = helper.create_variable_for_type_inference(
+        dtype, stop_gradient=True)
+    helper.append_op(
+        type="match_matrix_tensor",
+        inputs={"X": [x], "Y": [y], "W": [w]},
+        outputs={"Out": [mm_res], "Tmp": [tmp_res]},
+        attrs={"dim_t": int(channel_num)},
+    )
+    return helper.append_activation(mm_res), tmp_res
+
+
+def sequence_topk_avg_pooling(input, row, col, topks, channel_num):
+    """Per-channel top-k average pooling over variable-size feature maps
+    (reference contrib/layers/nn.py:302; op
+    sequence_topk_avg_pooling_op.cc)."""
+    helper = LayerHelper("sequence_topk_avg_pooling")
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    pos = helper.create_variable_for_type_inference(
+        dtype=input.dtype, stop_gradient=True)
+    helper.append_op(
+        type="sequence_topk_avg_pooling",
+        inputs={"X": [input], "ROW": [row], "COLUMN": [col]},
+        outputs={"Out": [out], "pos": [pos]},
+        attrs={"topks": list(topks), "channel_num": int(channel_num)},
+    )
+    return out
+
+
+def tree_conv(nodes_vector, edge_set, output_size, num_filters=1,
+              max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+              name=None):
+    """Tree-based convolution (TBCNN) over node vectors + an edge set
+    (reference contrib/layers/nn.py:370; op tree_conv_op.h)."""
+    helper = LayerHelper("tree_conv", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = nodes_vector.dtype
+    feature_size = nodes_vector.shape[2]
+    W = helper.create_parameter(
+        attr=param_attr,
+        shape=[feature_size, 3, int(output_size), int(num_filters)],
+        dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op(
+        type="tree_conv",
+        inputs={"NodesVector": [nodes_vector], "EdgeSet": [edge_set],
+                "Filter": [W]},
+        outputs={"Out": [out]},
+        attrs={"max_depth": int(max_depth)},
+    )
+    if bias_attr:
+        pre_activation = helper.append_bias_op(out, dim_start=2)
+    else:
+        pre_activation = out
+    return helper.append_activation(pre_activation)
+
+
+def fused_embedding_seq_pool(input, size, is_sparse=False, padding_idx=None,
+                             combiner="sum", param_attr=None,
+                             dtype="float32"):
+    """Fusion of lookup_table + sequence_pool(sum)
+    (reference contrib/layers/nn.py:435; op
+    fused_embedding_seq_pool_op.cc)."""
+    helper = LayerHelper("fused_embedding_seq_pool", param_attr=param_attr)
+    w = helper.create_parameter(attr=param_attr, shape=list(size),
+                                dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    padding_idx = (-1 if padding_idx is None
+                   else padding_idx if padding_idx >= 0
+                   else (int(size[0]) + padding_idx))
+    helper.append_op(
+        type="fused_embedding_seq_pool",
+        inputs={"Ids": [input], "W": [w]},
+        outputs={"Out": [out]},
+        attrs={"is_sparse": is_sparse, "combiner": combiner,
+               "padding_idx": padding_idx},
+    )
+    return out
+
+
+def multiclass_nms2(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                    nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                    background_label=0, return_index=False, name=None):
+    """multiclass_nms that can also return the kept indices
+    (reference contrib/layers/nn.py:501; op multiclass_nms_op.cc)."""
+    helper = LayerHelper("multiclass_nms2", name=name)
+    output = helper.create_variable_for_type_inference(dtype=bboxes.dtype)
+    index = helper.create_variable_for_type_inference(dtype="int32")
+    helper.append_op(
+        type="multiclass_nms2",
+        inputs={"BBoxes": [bboxes], "Scores": [scores]},
+        outputs={"Out": [output], "Index": [index]},
+        attrs={"background_label": background_label,
+               "score_threshold": score_threshold,
+               "nms_top_k": nms_top_k, "nms_threshold": nms_threshold,
+               "nms_eta": nms_eta, "keep_top_k": keep_top_k,
+               "normalized": normalized},
+    )
+    output.stop_gradient = True
+    index.stop_gradient = True
+    if return_index:
+        return output, index
+    return output
+
+
+def search_pyramid_hash(input, num_emb, space_len, pyramid_layer, rand_len,
+                        drop_out_percent, is_training, use_filter,
+                        white_list_len, black_list_len, seed, lr,
+                        param_attr=None, param_attr_wl=None,
+                        param_attr_bl=None, name=None, dtype="float32"):
+    """Pyramid hash embedding (reference contrib/layers/nn.py:631; op
+    pyramid_hash_op.h — deterministic bloom-filter hash embedding)."""
+    helper = LayerHelper("search_pyramid_hash", name=name)
+    w = helper.create_parameter(attr=param_attr,
+                                shape=[space_len + rand_len, 1], dtype=dtype)
+    w.stop_gradient = True
+    inputs = {"X": [input], "W": [w]}
+    if white_list_len > 0:
+        wl = helper.create_parameter(attr=param_attr_wl,
+                                     shape=[white_list_len, 1], dtype=dtype)
+        wl.stop_gradient = True
+        inputs["WhiteList"] = [wl]
+    if black_list_len > 0:
+        bl = helper.create_parameter(attr=param_attr_bl,
+                                     shape=[black_list_len, 1], dtype=dtype)
+        bl.stop_gradient = True
+        inputs["BlackList"] = [bl]
+    res = helper.create_variable_for_type_inference(dtype)
+    drop_pos = helper.create_variable_for_type_inference(dtype)
+    x_temp_out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="pyramid_hash",
+        inputs=inputs,
+        outputs={"Out": [res], "X_Temp_Out": [x_temp_out],
+                 "DropPos": [drop_pos]},
+        attrs={"num_emb": num_emb, "space_len": space_len,
+               "pyramid_layer": pyramid_layer, "rand_len": rand_len,
+               "drop_out_percent": drop_out_percent,
+               "is_training": is_training, "use_filter": use_filter,
+               "white_list_len": white_list_len,
+               "black_list_len": black_list_len, "seed": seed, "lr": lr},
+    )
+    return res

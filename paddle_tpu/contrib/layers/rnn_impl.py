@@ -1,0 +1,344 @@
+"""Composite basic GRU / LSTM API built from basic operators.
+
+Parity: python/paddle/fluid/contrib/layers/rnn_impl.py:19
+(BasicGRUUnit :22, basic_gru :139, basic_lstm :358, BasicLSTMUnit :632).
+The reference composes these with StaticRNN (a per-step unrolled
+sub-graph); here the whole single-direction multi-layer recurrence is ONE
+op lowering to `lax.scan` (ops/contrib_rnn.py) — static shapes and
+compiler-friendly control flow, the idiomatic XLA emission for an RNN —
+while the unit classes remain eager dygraph Layers with exactly the
+reference's equations and parameter shapes.
+"""
+
+from ... import layers
+from ...dygraph import Layer
+from ...layer_helper import LayerHelper
+
+__all__ = ["BasicGRUUnit", "basic_gru", "BasicLSTMUnit", "basic_lstm"]
+
+_ACT_NAMES = {None: None, "sigmoid": "sigmoid", "tanh": "tanh",
+              "relu": "relu", "identity": "identity"}
+
+
+def _act_name(fn, default):
+    """Map a layers.* activation callable (or string) to the op attr."""
+    if fn is None:
+        return default
+    if isinstance(fn, str):
+        if fn not in _ACT_NAMES:
+            raise NotImplementedError("activation %r" % fn)
+        return fn
+    name = getattr(fn, "__name__", None)
+    if name in ("sigmoid", "tanh", "relu"):
+        return name
+    raise NotImplementedError(
+        "basic_gru/basic_lstm support sigmoid/tanh/relu activations; got %r"
+        % (fn,))
+
+
+class BasicGRUUnit(Layer):
+    """Single GRU step from basic operators (reference rnn_impl.py:22):
+
+        r, u = sigmoid(W_g [x, h] + b_g).split(2)
+        m = tanh(W_c [x, r*h] + b_c)
+        h' = u * h + (1 - u) * m
+    """
+
+    def __init__(self, name_scope, hidden_size, param_attr=None,
+                 bias_attr=None, gate_activation=None, activation=None,
+                 dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._hidden_size = hidden_size
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._gate_activation = gate_activation or layers.sigmoid
+        self._activation = activation or layers.tanh
+        self._dtype = dtype
+        self._built = False
+
+    def _build_once(self, input):
+        input_size = input.shape[-1]
+        H = self._hidden_size
+        self._gate_weight = self.create_parameter(
+            attr=self._param_attr, shape=[input_size + H, 2 * H],
+            dtype=self._dtype)
+        self._candidate_weight = self.create_parameter(
+            attr=self._param_attr, shape=[input_size + H, H],
+            dtype=self._dtype)
+        self._gate_bias = self.create_parameter(
+            self._bias_attr, shape=[2 * H], dtype=self._dtype, is_bias=True)
+        self._candidate_bias = self.create_parameter(
+            self._bias_attr, shape=[H], dtype=self._dtype, is_bias=True)
+        self._built = True
+
+    def forward(self, input, pre_hidden):
+        if not self._built:
+            self._build_once(input)
+        cat = layers.concat([input, pre_hidden], 1)
+        gate = self._gate_activation(
+            layers.elementwise_add(
+                layers.matmul(cat, self._gate_weight), self._gate_bias))
+        r, u = layers.split(gate, num_or_sections=2, dim=1)
+        cand_in = layers.concat(
+            [input, layers.elementwise_mul(r, pre_hidden)], 1)
+        c = self._activation(
+            layers.elementwise_add(
+                layers.matmul(cand_in, self._candidate_weight),
+                self._candidate_bias))
+        one_minus_u = layers.scale(u, scale=-1.0, bias=1.0)
+        return layers.elementwise_add(
+            layers.elementwise_mul(u, pre_hidden),
+            layers.elementwise_mul(one_minus_u, c))
+
+
+class BasicLSTMUnit(Layer):
+    """Single LSTM step from basic operators (reference rnn_impl.py:632):
+
+        i, j, f, o = (W [x, h] + b).split(4)
+        c' = c * sigmoid(f + forget_bias) + sigmoid(i) * tanh(j)
+        h' = tanh(c') * sigmoid(o)
+    """
+
+    def __init__(self, name_scope, hidden_size, param_attr=None,
+                 bias_attr=None, gate_activation=None, activation=None,
+                 forget_bias=1.0, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._hidden_size = hidden_size
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._gate_activation = gate_activation or layers.sigmoid
+        self._activation = activation or layers.tanh
+        self._forget_bias = float(forget_bias)
+        self._dtype = dtype
+        self._built = False
+
+    def _build_once(self, input):
+        input_size = input.shape[-1]
+        H = self._hidden_size
+        self._weight = self.create_parameter(
+            attr=self._param_attr, shape=[input_size + H, 4 * H],
+            dtype=self._dtype)
+        self._bias = self.create_parameter(
+            attr=self._bias_attr, shape=[4 * H], dtype=self._dtype,
+            is_bias=True)
+        self._built = True
+
+    def forward(self, input, pre_hidden, pre_cell):
+        if not self._built:
+            self._build_once(input)
+        cat = layers.concat([input, pre_hidden], 1)
+        gate = layers.elementwise_add(
+            layers.matmul(cat, self._weight), self._bias)
+        i, j, f, o = layers.split(gate, num_or_sections=4, dim=-1)
+        new_cell = layers.elementwise_add(
+            layers.elementwise_mul(
+                pre_cell,
+                self._gate_activation(
+                    layers.scale(f, bias=self._forget_bias))),
+            layers.elementwise_mul(self._gate_activation(i),
+                                   self._activation(j)))
+        new_hidden = layers.elementwise_mul(
+            self._activation(new_cell), self._gate_activation(o))
+        return new_hidden, new_cell
+
+
+
+def _per_param_attr(attr, pname, suffix):
+    """Uniquify a (possibly named) ParamAttr per layer/direction/slot: a
+    user-supplied name like 'gru_w' must become gru_w_<dir>_layers_<i>_<slot>
+    or every weight matrix would silently alias ONE parameter (the
+    reference renames through the per-layer BasicGRUUnit name scopes)."""
+    from ...param_attr import ParamAttr
+
+    if attr is None or attr is False:
+        return attr
+    attr = ParamAttr._to_attr(attr)
+    if not attr.name:
+        return attr
+    import copy
+
+    new = copy.copy(attr)
+    new.name = "%s_%s_%s" % (attr.name, pname, suffix)
+    return new
+
+
+def _rnn_prologue(input, batch_first, sequence_length):
+    """Shared input normalization: time-major input + optional [T, B]
+    mask from per-batch lengths (reference basic_gru body)."""
+    if batch_first:
+        input = layers.transpose(input, [1, 0, 2])
+    mask = None
+    if sequence_length is not None:
+        max_seq_len = input.shape[0]
+        mask = layers.sequence_mask(sequence_length, maxlen=max_seq_len,
+                                    dtype="float32")
+        mask = layers.transpose(mask, [1, 0])
+    return input, mask
+
+
+def basic_gru(input, init_hidden, hidden_size, num_layers=1,
+              sequence_length=None, dropout_prob=0.0, bidirectional=False,
+              batch_first=True, param_attr=None, bias_attr=None,
+              gate_activation=None, activation=None, dtype="float32",
+              name="basic_gru"):
+    """Multi-layer (optionally bidirectional) GRU
+    (reference contrib/layers/rnn_impl.py:139; one lax.scan op per
+    direction, ops/contrib_rnn.py basic_gru_rnn).
+
+    Returns (rnn_out, last_hidden): rnn_out [T,B,H*dirs] (or batch-first),
+    last_hidden [num_layers*dirs, B, H]."""
+    g_act = _act_name(gate_activation, "sigmoid")
+    c_act = _act_name(activation, "tanh")
+    helper = LayerHelper(name)
+    input, mask = _rnn_prologue(input, batch_first, sequence_length)
+    input_size = input.shape[2]
+    direc_num = 2 if bidirectional else 1
+    if init_hidden is not None:
+        init_hidden = layers.reshape(
+            init_hidden, shape=[num_layers, direc_num, -1, hidden_size])
+
+    def one_direction(rnn_input, rnn_mask, direc_index, dname):
+        gw, cw, gb, cb = [], [], [], []
+        for i in range(num_layers):
+            layer_in = input_size if i == 0 else hidden_size
+            pname = "%s_layers_%d" % (dname, i)
+            gw.append(helper.create_parameter(
+                attr=_per_param_attr(param_attr, pname, "gate_w"),
+                shape=[layer_in + hidden_size, 2 * hidden_size],
+                dtype=dtype))
+            cw.append(helper.create_parameter(
+                attr=_per_param_attr(param_attr, pname, "cand_w"),
+                shape=[layer_in + hidden_size, hidden_size], dtype=dtype))
+            gb.append(helper.create_parameter(
+                attr=_per_param_attr(bias_attr, pname, "gate_b"),
+                shape=[2 * hidden_size], dtype=dtype, is_bias=True))
+            cb.append(helper.create_parameter(
+                attr=_per_param_attr(bias_attr, pname, "cand_b"),
+                shape=[hidden_size], dtype=dtype, is_bias=True))
+        h0 = None
+        if init_hidden is not None:
+            h0 = layers.reshape(
+                layers.slice(init_hidden, axes=[1], starts=[direc_index],
+                             ends=[direc_index + 1]),
+                shape=[num_layers, -1, hidden_size])
+        out = helper.create_variable_for_type_inference(dtype)
+        last_h = helper.create_variable_for_type_inference(dtype)
+        inputs = {"Input": [rnn_input], "GateWeight": gw, "CandWeight": cw,
+                  "GateBias": gb, "CandBias": cb}
+        if h0 is not None:
+            inputs["InitHidden"] = [h0]
+        if rnn_mask is not None:
+            inputs["Mask"] = [rnn_mask]
+        helper.append_op(
+            type="basic_gru_rnn",
+            inputs=inputs,
+            outputs={"Out": [out], "LastHidden": [last_h]},
+            attrs={"hidden_size": hidden_size, "num_layers": num_layers,
+                   "dropout_prob": float(dropout_prob or 0.0),
+                   "is_test": False, "gate_activation": g_act,
+                   "activation": c_act},
+        )
+        return out, last_h
+
+    fw_out, fw_last = one_direction(input, mask, 0, "fw")
+    if bidirectional:
+        bw_in = layers.reverse(input, axis=[0])
+        bw_mask = layers.reverse(mask, axis=[0]) if mask is not None else None
+        bw_out, bw_last = one_direction(bw_in, bw_mask, 1, "bw")
+        bw_out = layers.reverse(bw_out, axis=[0])
+        rnn_out = layers.concat([fw_out, bw_out], axis=2)
+        last_hidden = layers.concat([fw_last, bw_last], axis=1)
+        last_hidden = layers.reshape(
+            last_hidden, shape=[num_layers * direc_num, -1, hidden_size])
+    else:
+        rnn_out, last_hidden = fw_out, fw_last
+    if batch_first:
+        rnn_out = layers.transpose(rnn_out, [1, 0, 2])
+    return rnn_out, last_hidden
+
+
+def basic_lstm(input, init_hidden, init_cell, hidden_size, num_layers=1,
+               sequence_length=None, dropout_prob=0.0, bidirectional=False,
+               batch_first=True, param_attr=None, bias_attr=None,
+               gate_activation=None, activation=None, forget_bias=1.0,
+               dtype="float32", name="basic_lstm"):
+    """Multi-layer (optionally bidirectional) LSTM
+    (reference contrib/layers/rnn_impl.py:358; one lax.scan op per
+    direction, ops/contrib_rnn.py basic_lstm_rnn).
+
+    Returns (rnn_out, last_hidden, last_cell)."""
+    g_act = _act_name(gate_activation, "sigmoid")
+    c_act = _act_name(activation, "tanh")
+    helper = LayerHelper(name)
+    input, mask = _rnn_prologue(input, batch_first, sequence_length)
+    input_size = input.shape[2]
+    direc_num = 2 if bidirectional else 1
+    if init_hidden is not None:
+        init_hidden = layers.reshape(
+            init_hidden, shape=[num_layers, direc_num, -1, hidden_size])
+    if init_cell is not None:
+        init_cell = layers.reshape(
+            init_cell, shape=[num_layers, direc_num, -1, hidden_size])
+
+    def one_direction(rnn_input, rnn_mask, direc_index, dname):
+        ws, bs = [], []
+        for i in range(num_layers):
+            layer_in = input_size if i == 0 else hidden_size
+            pname = "%s_layers_%d" % (dname, i)
+            ws.append(helper.create_parameter(
+                attr=_per_param_attr(param_attr, pname, "w"),
+                shape=[layer_in + hidden_size, 4 * hidden_size],
+                dtype=dtype))
+            bs.append(helper.create_parameter(
+                attr=_per_param_attr(bias_attr, pname, "b"),
+                shape=[4 * hidden_size], dtype=dtype, is_bias=True))
+
+        def pick(init):
+            if init is None:
+                return None
+            return layers.reshape(
+                layers.slice(init, axes=[1], starts=[direc_index],
+                             ends=[direc_index + 1]),
+                shape=[num_layers, -1, hidden_size])
+
+        h0, c0 = pick(init_hidden), pick(init_cell)
+        out = helper.create_variable_for_type_inference(dtype)
+        last_h = helper.create_variable_for_type_inference(dtype)
+        last_c = helper.create_variable_for_type_inference(dtype)
+        inputs = {"Input": [rnn_input], "Weight": ws, "Bias": bs}
+        if h0 is not None:
+            inputs["InitHidden"] = [h0]
+        if c0 is not None:
+            inputs["InitCell"] = [c0]
+        if rnn_mask is not None:
+            inputs["Mask"] = [rnn_mask]
+        helper.append_op(
+            type="basic_lstm_rnn",
+            inputs=inputs,
+            outputs={"Out": [out], "LastHidden": [last_h],
+                     "LastCell": [last_c]},
+            attrs={"hidden_size": hidden_size, "num_layers": num_layers,
+                   "dropout_prob": float(dropout_prob or 0.0),
+                   "is_test": False, "forget_bias": float(forget_bias),
+                   "gate_activation": g_act, "activation": c_act},
+        )
+        return out, last_h, last_c
+
+    fw_out, fw_last_h, fw_last_c = one_direction(input, mask, 0, "fw")
+    if bidirectional:
+        bw_in = layers.reverse(input, axis=[0])
+        bw_mask = layers.reverse(mask, axis=[0]) if mask is not None else None
+        bw_out, bw_last_h, bw_last_c = one_direction(bw_in, bw_mask, 1, "bw")
+        bw_out = layers.reverse(bw_out, axis=[0])
+        rnn_out = layers.concat([fw_out, bw_out], axis=2)
+        last_hidden = layers.reshape(
+            layers.concat([fw_last_h, bw_last_h], axis=1),
+            shape=[num_layers * direc_num, -1, hidden_size])
+        last_cell = layers.reshape(
+            layers.concat([fw_last_c, bw_last_c], axis=1),
+            shape=[num_layers * direc_num, -1, hidden_size])
+    else:
+        rnn_out, last_hidden, last_cell = fw_out, fw_last_h, fw_last_c
+    if batch_first:
+        rnn_out = layers.transpose(rnn_out, [1, 0, 2])
+    return rnn_out, last_hidden, last_cell

@@ -1,0 +1,9 @@
+"""contrib.quantize — the pre-slim program-transpiling QAT API.
+
+Parity: python/paddle/fluid/contrib/quantize/__init__.py:15.
+"""
+
+from . import quantize_transpiler
+from .quantize_transpiler import *  # noqa: F401,F403
+
+__all__ = quantize_transpiler.__all__

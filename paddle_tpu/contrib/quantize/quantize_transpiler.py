@@ -1,0 +1,99 @@
+"""QuantizeTranspiler — the older program-transpiling QAT API.
+
+Parity: python/paddle/fluid/contrib/quantize/quantize_transpiler.py:80.
+The reference predates the slim pass family and rewrites the program in
+place; here it is a thin, faithful facade over the same machinery the
+slim API uses (contrib/slim/quantization/quantization_pass.py) — one
+quantization implementation, two API generations, like the reference's
+own later consolidation.
+"""
+
+import numpy as np
+
+from ..slim.quantization.quantization_pass import (
+    QuantizationFreezePass, QuantizationTransformPass)
+from ... import framework
+
+__all__ = ["QuantizeTranspiler"]
+
+_QUANT_TYPES = ("abs_max", "range_abs_max", "moving_average_abs_max")
+
+
+class QuantizeTranspiler(object):
+    """Rewrite a fluid Program for quantization-aware training.
+
+    training_transpile() inserts fake-quant/dequant ops in front of the
+    quantizable ops (mul/matmul/conv2d/depthwise_conv2d);
+    freeze_program() flips the trained quantizers to inference mode;
+    convert_to_int8() rewrites the quantized weight persistables to int8
+    in the scope (reference quantize_transpiler.py:349)."""
+
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 activation_quantize_type="abs_max",
+                 weight_quantize_type="abs_max", window_size=10000,
+                 moving_rate=0.9):
+        if weight_quantize_type not in _QUANT_TYPES:
+            raise ValueError(
+                "Unknown weight_quantize_type: %r (supported: %s)"
+                % (weight_quantize_type, list(_QUANT_TYPES)))
+        if activation_quantize_type not in _QUANT_TYPES:
+            raise ValueError(
+                "Unknown activation_quantize_type: %r (supported: %s)"
+                % (activation_quantize_type, list(_QUANT_TYPES)))
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.weight_quantize_type = weight_quantize_type
+        self.activation_quantize_type = activation_quantize_type
+        self.window_size = window_size
+        self.moving_rate = moving_rate
+
+    def training_transpile(self, program=None, startup_program=None):
+        program = (framework.default_main_program()
+                   if program is None else program)
+        startup_program = (framework.default_startup_program()
+                           if startup_program is None else startup_program)
+        # the older API's abs_max defaults map onto the pass's
+        # quantize-type knobs; weights quantize per-tensor here (the
+        # reference transpiler has no channel-wise mode)
+        pass_ = QuantizationTransformPass(
+            weight_bits=self.weight_bits,
+            activation_bits=self.activation_bits,
+            moving_rate=self.moving_rate,
+            activation_quantize_type=self.activation_quantize_type,
+            weight_quantize_type="abs_max")
+        return pass_.apply(program, startup_program, is_test=False)
+
+    def freeze_program(self, program, place, scope=None):
+        QuantizationFreezePass(
+            scope=scope, place=place, weight_bits=self.weight_bits,
+            activation_bits=self.activation_bits,
+            weight_quantize_type=self.weight_quantize_type).apply(program)
+        return program
+
+    def convert_to_int8(self, program, place, scope=None):
+        """Rewrite quantized weight persistables to int8 in `scope`
+        (reference :349 convert_to_int8): w_int8 = round(w / scale *
+        (2^(bits-1) - 1)) stored as int8, for weight-only int8 export."""
+        from ...core.executor import global_scope
+
+        scope = global_scope() if scope is None else scope
+        bound = float(2 ** (self.weight_bits - 1) - 1)
+        seen = set()
+        for op in program.global_block().ops:
+            if "quantize" not in op.type:
+                continue
+            for name in op.input("X"):
+                v = program.global_block()._find_var_recursive(name)
+                if (v is None or not getattr(v, "persistable", False)
+                        or name in seen):
+                    continue
+                var = scope.find_var(name)
+                if var is None:
+                    continue
+                w = np.asarray(var.get_tensor())
+                scale = np.max(np.abs(w)) or 1.0
+                q = np.clip(np.round(w / scale * bound), -bound - 1,
+                            bound).astype(np.int8)
+                var.get_tensor().set(q, place)
+                seen.add(name)
+        return program

@@ -1,0 +1,7 @@
+"""contrib.reader (parity:
+python/paddle/fluid/contrib/reader/__init__.py:15)."""
+
+from . import distributed_reader
+from .distributed_reader import *  # noqa: F401,F403
+
+__all__ = list(distributed_reader.__all__)

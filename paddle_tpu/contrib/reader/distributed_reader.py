@@ -1,0 +1,41 @@
+"""Round-robin batch sharding for multi-process training.
+
+Parity: python/paddle/fluid/contrib/reader/distributed_reader.py:21 —
+each trainer keeps the batch whose round-robin slot matches its
+PADDLE_TRAINER_ID, so N trainers consume disjoint batch streams from
+the same underlying reader.
+"""
+
+import os
+
+__all__ = ["distributed_batch_reader"]
+
+
+def distributed_batch_reader(batch_reader):
+    """Wrap a batch reader so each trainer yields only its 1-in-N share
+    (read PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ID from the environment,
+    like the launch utilities set them)."""
+    trainers_num = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    trainer_id = int(os.getenv("PADDLE_TRAINER_ID", 0))
+    assert trainer_id < trainers_num
+
+    def decorate_for_multi_process():
+        if trainers_num > 1:
+            print("start data reader (trainers_num: {}, trainer_id: {})"
+                  .format(trainers_num, trainer_id))
+        train_data, idx = None, 1
+        for _batch_id, data in enumerate(batch_reader()):
+            if trainers_num > 1:
+                if idx == trainer_id + 1:
+                    train_data = data
+                if idx < trainers_num:
+                    idx += 1
+                else:
+                    assert train_data is not None, \
+                        "train data should not be None."
+                    yield train_data
+                    train_data, idx = None, 1
+            else:
+                yield data
+
+    return decorate_for_multi_process

@@ -1,0 +1,217 @@
+"""Deprecated high-level Trainer API.
+
+Parity: python/paddle/fluid/contrib/trainer.py:34 (deprecated upstream in
+favor of the Executor/fleet APIs, kept for user-code compatibility).
+A compact but functional implementation: the event classes, the
+epoch/step training loop with event callbacks, test(), save_params(),
+save/load/clean_checkpoint and CheckpointConfig over this repo's
+Executor + io machinery.
+"""
+
+import os
+import shutil
+
+from .. import framework, io, optimizer as _optimizer_mod
+from ..core.executor import Executor, scope_guard
+from ..core.scope import Scope
+from ..data_feeder import DataFeeder
+from ..framework import CPUPlace, Program, program_guard
+
+__all__ = [
+    "BeginEpochEvent", "EndEpochEvent", "BeginStepEvent", "EndStepEvent",
+    "CheckpointConfig", "Trainer", "save_checkpoint", "load_checkpoint",
+    "clean_checkpoint",
+]
+
+
+class BeginEpochEvent(object):
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class EndEpochEvent(object):
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class BeginStepEvent(object):
+    def __init__(self, epoch_id, step_id):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.fetch_metrics = True
+
+
+class EndStepEvent(object):
+    def __init__(self, epoch_id, step_id, metrics):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.metrics = metrics
+
+
+class CheckpointConfig(object):
+    def __init__(self, checkpoint_dir=None, max_num_checkpoints=3,
+                 epoch_interval=1, step_interval=10):
+        self.checkpoint_dir = checkpoint_dir or os.getcwd()
+        self.max_num_checkpoints = max_num_checkpoints
+        self.epoch_interval = max(int(epoch_interval), 1)
+        self.step_interval = max(int(step_interval), 1)
+        self.epoch_id = 0
+        self.step_id = 0
+        self.load_serial = None
+
+
+def check_and_get_place(place):
+    if place is None:
+        return CPUPlace()
+    return place
+
+
+class Trainer(object):
+    """train_func() builds the forward and returns the loss (first return
+    value); optimizer_func() returns the Optimizer.  train() runs the
+    epoch/step loop, posting Begin/End Epoch/Step events to
+    event_handler exactly like the reference."""
+
+    def __init__(self, train_func, optimizer_func, param_path=None,
+                 place=None, parallel=False, checkpoint_config=None):
+        self.__stop = False
+        self.parallel = parallel
+        self.checkpoint_cfg = checkpoint_config
+        self.place = check_and_get_place(place)
+        self.scope = Scope()
+        self.startup_program = Program()
+        self.train_program = Program()
+        from ..utils import unique_name
+
+        with program_guard(self.train_program, self.startup_program):
+            # fresh name scope so a later Inferencer's infer_func (also
+            # guarded) recreates the same parameter names
+            with unique_name.guard():
+                loss = train_func()
+                if isinstance(loss, (list, tuple)):
+                    self.train_func_outputs = list(loss)
+                    loss = loss[0]
+                else:
+                    self.train_func_outputs = [loss]
+                self.loss = loss
+                self.test_program = self.train_program.clone(for_test=True)
+                opt = optimizer_func()
+                if not isinstance(opt, _optimizer_mod.Optimizer):
+                    raise TypeError(
+                        "The optimizer should be an instance of Optimizer")
+                opt.minimize(loss)
+        self.exe = Executor(self.place)
+        with scope_guard(self.scope):
+            self.exe.run(self.startup_program)
+        if param_path and os.path.isdir(param_path):
+            with scope_guard(self.scope):
+                io.load_persistables(self.exe, param_path,
+                                     main_program=self.train_program)
+
+    def stop(self):
+        """Ask the training loop to stop after the current step."""
+        self.__stop = True
+
+    def train(self, num_epochs, event_handler, reader=None, feed_order=None):
+        feeder = DataFeeder(feed_list=[
+            self.train_program.global_block().var(n)
+            for n in (feed_order or [])
+        ], place=self.place) if feed_order else None
+        with scope_guard(self.scope):
+            for epoch_id in range(num_epochs):
+                event_handler(BeginEpochEvent(epoch_id))
+                for step_id, data in enumerate(reader()):
+                    if self.__stop:
+                        return
+                    begin_event = BeginStepEvent(epoch_id, step_id)
+                    event_handler(begin_event)
+                    fetch = (self.train_func_outputs
+                             if begin_event.fetch_metrics else [])
+                    metrics = self.exe.run(
+                        self.train_program,
+                        feed=feeder.feed(data) if feeder else data,
+                        fetch_list=fetch)
+                    event_handler(EndStepEvent(epoch_id, step_id, metrics))
+                    if (self.checkpoint_cfg
+                            and step_id % self.checkpoint_cfg.step_interval
+                            == 0):
+                        self._save_checkpoint(epoch_id, step_id)
+                event_handler(EndEpochEvent(epoch_id))
+
+    def test(self, reader, feed_order):
+        feeder = DataFeeder(feed_list=[
+            self.train_program.global_block().var(n) for n in feed_order
+        ], place=self.place)
+        accumulated = [0.0] * len(self.train_func_outputs)
+        count = 0
+        with scope_guard(self.scope):
+            for data in reader():
+                outs = self.exe.run(self.test_program,
+                                    feed=feeder.feed(data),
+                                    fetch_list=self.train_func_outputs)
+                accumulated = [a + float(o[0]) for a, o in
+                               zip(accumulated, outs)]
+                count += 1
+        return [a / max(count, 1) for a in accumulated]
+
+    def save_params(self, param_path):
+        with scope_guard(self.scope):
+            io.save_persistables(self.exe, param_path,
+                                 main_program=self.train_program)
+
+    def save_inference_model(self, param_path, feeded_var_names,
+                             target_var_indexes):
+        with scope_guard(self.scope):
+            io.save_inference_model(
+                param_path, feeded_var_names,
+                [self.train_func_outputs[i] for i in target_var_indexes],
+                self.exe, program=self.test_program)
+
+    def _save_checkpoint(self, epoch_id, step_id):
+        cfg = self.checkpoint_cfg
+        if epoch_id % cfg.epoch_interval != 0:
+            return
+        serial_dir = os.path.join(cfg.checkpoint_dir,
+                                  "checkpoint_%d_%d" % (epoch_id, step_id))
+        save_checkpoint(self.exe, serial_dir, self.train_program)
+        existing = sorted(
+            d for d in os.listdir(cfg.checkpoint_dir)
+            if d.startswith("checkpoint_"))
+        while len(existing) > cfg.max_num_checkpoints:
+            shutil.rmtree(os.path.join(cfg.checkpoint_dir, existing.pop(0)),
+                          ignore_errors=True)
+
+
+def build_feed_var_list(program, feed_order):
+    if feed_order is None:
+        feed_order = []
+    if isinstance(feed_order, dict):
+        feed_order = [k for k, _ in
+                      sorted(feed_order.items(), key=lambda kv: kv[1])]
+    return [program.global_block().var(name) for name in feed_order]
+
+
+def save_checkpoint(executor, checkpoint_dir, main_program=None):
+    """Persist all persistables of main_program under checkpoint_dir
+    (reference trainer.py:663)."""
+    os.makedirs(checkpoint_dir, exist_ok=True)
+    io.save_persistables(executor, checkpoint_dir,
+                         main_program=main_program)
+
+
+def load_checkpoint(executor, checkpoint_dir, main_program=None):
+    """Restore persistables saved by save_checkpoint
+    (reference trainer.py:763)."""
+    io.load_persistables(executor, checkpoint_dir,
+                         main_program=main_program)
+
+
+def clean_checkpoint(checkpoint_dir, delete_dir=False):
+    if checkpoint_dir is None:
+        raise ValueError("'checkpoint_dir' should not be None")
+    for d in os.listdir(checkpoint_dir):
+        if d.startswith("checkpoint_"):
+            shutil.rmtree(os.path.join(checkpoint_dir, d),
+                          ignore_errors=True)
+    if delete_dir and not os.listdir(checkpoint_dir):
+        os.rmdir(checkpoint_dir)

@@ -1,0 +1,10 @@
+"""contrib.utils — HDFS transfer helpers + distributed-lookup-table
+checkpoint utilities (parity:
+python/paddle/fluid/contrib/utils/__init__.py:15)."""
+
+from . import hdfs_utils
+from .hdfs_utils import *  # noqa: F401,F403
+from . import lookup_table_utils
+from .lookup_table_utils import *  # noqa: F401,F403
+
+__all__ = hdfs_utils.__all__ + lookup_table_utils.__all__

@@ -1,0 +1,148 @@
+"""Distributed-lookup-table checkpoint/conversion helpers.
+
+Parity: python/paddle/fluid/contrib/utils/lookup_table_utils.py:28 —
+convert_dist_to_sparse_program, load_persistables_for_increment,
+load_persistables_for_inference, get_inference_model.
+
+The reference operates on transpiled trainer/pserver programs whose
+distributed lookups are prefetch-op triples; in this framework the
+transpiler emits `distributed_lookup_table` ops (layers/nn.py embedding
+with is_distributed=True; distributed/sparse_table.py holds the sharded
+table).  The conversions therefore rewrite between that op and the plain
+local `lookup_table`, and the loaders combine the repo's persistable
+loader with the sharded-table piece files the PS path saves.
+"""
+
+import logging
+import os
+
+import numpy as np
+
+from ... import io as _io
+from ...distribute_lookup_table import find_distributed_lookup_table
+from ...framework import Program
+
+__all__ = [
+    "load_persistables_for_increment", "load_persistables_for_inference",
+    "convert_dist_to_sparse_program",
+]
+
+_logger = logging.getLogger(__name__)
+
+model_filename = "__model__"
+lookup_table_dir = "__lookup_table__"
+
+
+def convert_dist_to_sparse_program(program):
+    """Rewrite `distributed_lookup_table` ops to LOCAL lookups so a
+    program trained against remote sharded tables can run local
+    inference over the merged table (reference
+    lookup_table_utils.py:85).  Returns the same program, modified."""
+    table_name = find_distributed_lookup_table(program)
+    if not table_name:
+        _logger.warning(
+            "There are no distributed lookup tables need to be converted")
+        return program
+    block = program.global_block()
+    for op in block.ops:
+        if (op.type == "distributed_lookup_table"
+                and table_name in op.input("W")):
+            op.type = "lookup_table"
+            op.attrs.setdefault("is_sparse", True)
+            op.attrs["is_distributed"] = False
+            op.attrs.pop("endpoints", None)
+            op.attrs.pop("table_names", None)
+        elif (op.type == "lookup_table" and table_name in op.input("W")
+              and op.attrs.get("is_distributed")):
+            op.attrs["is_distributed"] = False
+    program._bump_version()
+    return program
+
+
+def _load_table_pieces(dirname_or_path):
+    """Merge sharded lookup-table piece files (id -> row) saved by the
+    pserver path: each piece is an .npz with `ids` and `rows`."""
+    paths = []
+    if os.path.isdir(dirname_or_path):
+        for name in sorted(os.listdir(dirname_or_path)):
+            paths.append(os.path.join(dirname_or_path, name))
+    elif os.path.exists(dirname_or_path):
+        paths = [dirname_or_path]
+    merged = {}
+    for path in paths:
+        try:
+            with np.load(path) as z:
+                for gid, row in zip(z["ids"], z["rows"]):
+                    merged[int(gid)] = row
+        except Exception:
+            continue
+    return merged
+
+
+def load_persistables_for_increment(dirname, executor, program,
+                                    lookup_table_var,
+                                    lookup_table_var_path):
+    """Load dense persistables AND the correctly-sliced lookup-table var
+    for resuming distributed training (reference :136).  The sliced
+    rows in `lookup_table_var_path` overwrite their ids' rows in the
+    in-scope table."""
+    _io.load_persistables(executor, dirname, main_program=program)
+    from ...core.executor import global_scope
+
+    scope = global_scope()
+    var = scope.find_var(lookup_table_var)
+    if var is None:
+        _logger.warning("lookup table var %r not found in scope",
+                        lookup_table_var)
+        return
+    table = np.array(np.asarray(var.get_tensor()))
+    for gid, row in _load_table_pieces(lookup_table_var_path).items():
+        if 0 <= gid < table.shape[0]:
+            table[gid] = row
+    var.get_tensor().set(table, executor.place)
+
+
+def load_persistables_for_inference(dirname, executor, program,
+                                    lookup_table_var_name):
+    """Load every persistable (excluding the usual fluid framework vars)
+    plus the FULL merged lookup table for local inference
+    (reference :260)."""
+    _io.load_persistables(executor, dirname, main_program=program)
+    table_dir = os.path.join(dirname, lookup_table_dir)
+    pieces = _load_table_pieces(table_dir)
+    if not pieces:
+        return
+    from ...core.executor import global_scope
+
+    scope = global_scope()
+    var = scope.find_var(lookup_table_var_name)
+    if var is None:
+        return
+    table = np.array(np.asarray(var.get_tensor()))
+    for gid, row in pieces.items():
+        if 0 <= gid < table.shape[0]:
+            table[gid] = row
+    var.get_tensor().set(table, executor.place)
+
+
+def get_inference_model(main_program, feeded_var_names, target_vars):
+    """Prune `main_program` to an inference program over the given
+    feeds/fetches, converting distributed lookups to local ones
+    (reference :413)."""
+    from ...framework import Variable, default_main_program
+
+    if main_program is None:
+        main_program = default_main_program()
+    if not isinstance(feeded_var_names, list) or not all(
+            isinstance(n, str) for n in feeded_var_names):
+        raise ValueError("feeded_var_names should be a list of str.")
+    if not isinstance(target_vars, list) or not all(
+            isinstance(v, Variable) for v in target_vars):
+        raise ValueError("target_vars should be a list of Variable.")
+    pruned = main_program.clone(for_test=True)
+    convert_dist_to_sparse_program(pruned)
+    # prune to the fetch targets (the repo's inference-save pipeline)
+    names = [v.name for v in target_vars]
+    pruned = pruned._prune_with_input(feeded_var_names, names) \
+        if hasattr(pruned, "_prune_with_input") else pruned
+    return pruned

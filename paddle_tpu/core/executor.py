@@ -229,7 +229,8 @@ class Executor:
 
         trace_flags = tuple(sorted(_flags.get_flags(
             ["FLAGS_use_pallas_layer_norm", "FLAGS_check_nan_inf",
-             "FLAGS_bn_stat_subsample"]).items()))
+             "FLAGS_bn_stat_subsample",
+             "FLAGS_fused_small_attention"]).items()))
         # mesh keyed by content, not id(): a GC'd Mesh's successor can alias
         # the address exactly like the Program case above
         mesh_key = None

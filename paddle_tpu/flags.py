@@ -36,6 +36,13 @@ _DEFAULTS = {
     # standalone microbenches, measured -1.5% inside full BERT on the
     # bench chip (breaks XLA's LN-neighbor fusions) — see ops/nn.py
     "FLAGS_use_pallas_layer_norm": False,
+    # small-seq fused training attention (in-kernel mask+dropout,
+    # pallas_kernels/flash_attention.py small_attention_*): measured
+    # 3.1x faster fwd in isolation but 18% SLOWER in-step at bs224
+    # (889 vs 1081 seqs/s — the recompute backward's serial per-head
+    # VPU chain loses to XLA's materialized-probs backward), so the
+    # composed emission stays the default training path (BASELINE.md r5)
+    "FLAGS_fused_small_attention": False,
     # accepted no-ops (XLA/PJRT owns these concerns; benchmark's per-op
     # sync has no meaning under whole-block compilation)
     "FLAGS_benchmark": False,

@@ -1520,16 +1520,23 @@ def flash_attention(q, k, v, bias_qk=None, causal=False, scale=0.0,
     out = helper.create_variable_for_type_inference(dtype=q.dtype)
     # Mask must be DECLARED: with dropout active the custom grad replays
     # with this saved mask (an undeclared slot would silently drop it and
-    # the backward would run mask-free — decoupled from the sampled loss)
+    # the backward would run mask-free — decoupled from the sampled loss).
+    # On the small-seq fused-kernel path the mask is never materialized:
+    # Seed (2 words) + Lse replay it instead (see ops/nn.py).
     mask = helper.create_variable_for_type_inference(dtype="uint8")
     mask.stop_gradient = True
+    seed_out = helper.create_variable_for_type_inference(dtype="int32")
+    seed_out.stop_gradient = True
+    lse = helper.create_variable_for_type_inference(dtype="float32")
+    lse.stop_gradient = True
     inputs = {"Q": [q], "K": [k], "V": [v]}
     if bias_qk is not None:
         inputs["BiasQK"] = [bias_qk]
     helper.append_op(
         type="flash_attention",
         inputs=inputs,
-        outputs={"Out": [out], "Mask": [mask]},
+        outputs={"Out": [out], "Mask": [mask], "Seed": [seed_out],
+                 "Lse": [lse]},
         attrs={"causal": causal, "scale": float(scale),
                "layout": layout, "dropout_prob": float(dropout_prob),
                "is_test": is_test},

@@ -59,16 +59,28 @@ def multi_head_attention(x, cfg, prefix, is_test=False, use_tp=False,
         return fluid.layers.transpose(t, [0, 2, 1, 3])
 
     q, k, v = split_heads(q), split_heads(k), split_heads(v)
+    import os as _os
+
     if is_test or not cfg.dropout:
         # fast path: one fused Pallas flash-attention kernel (no
         # attention-prob dropout in this mode, so semantics are identical)
         ctxv = fluid.layers.flash_attention(q, k, v, bias_qk=attn_mask,
                                             scale=d ** -0.5)
+    elif _os.environ.get("BERT_FUSED_ATTN") == "1":
+        # A/B probe path: the flash_attention op with in-op dropout — on
+        # TPU with FLAGS_fused_small_attention it lowers to the small-seq
+        # fused kernel (bias + softmax + dropout drawn in-kernel, nothing
+        # but Out/Lse ever in HBM).  MEASURED NEGATIVE in-step at the
+        # flagship shape (889 vs 1081 seqs/s at bs224, r5 — the recompute
+        # backward loses to XLA's materialized-probs backward), so the
+        # composed emission below stays the default (BASELINE.md r5)
+        ctxv = fluid.layers.flash_attention(
+            q, k, v, bias_qk=attn_mask, scale=d ** -0.5,
+            dropout_prob=cfg.dropout, is_test=is_test)
     else:
         # composed emission for the dropout training path: measured
-        # fastest on this chip (round 3: the single-op in-op-dropout
-        # variant and a transpose-free BSHD variant both landed 1.5-2%
-        # below it; flash_attention(dropout_prob=...) remains available)
+        # fastest on this chip across rounds 3-5 (in-op dropout, BSHD,
+        # and the round-5 Pallas small-seq kernel all landed below it)
         scores = fluid.layers.matmul(q, k, transpose_y=True,
                                      alpha=d ** -0.5)
         if attn_mask is not None:

@@ -27,3 +27,4 @@ from . import misc3  # noqa: F401
 from . import detection2  # noqa: F401
 from . import longtail  # noqa: F401
 from . import coverage_tail  # noqa: F401
+from . import contrib_rnn  # noqa: F401

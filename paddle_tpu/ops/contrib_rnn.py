@@ -1,0 +1,194 @@
+"""contrib basic_gru / basic_lstm RNN ops.
+
+TPU-native lowering of the reference's contrib composite RNN API
+(python/paddle/fluid/contrib/layers/rnn_impl.py:139 basic_gru, :358
+basic_lstm, :22 BasicGRUUnit, :632 BasicLSTMUnit).  The reference builds
+these with StaticRNN — a per-step unrolled graph; here ONE op lowers the
+whole single-direction multi-layer recurrence to a `lax.scan` (static
+shapes, compiler-friendly control flow, weights stay resident in the
+loop), which is the idiomatic XLA shape for an RNN.  The layer-stacking,
+per-step dropout-between-layers, and padded-step masking semantics are
+the reference's exactly:
+
+    u_t, r_t = actGate(W_g [x_t, h_{t-1}] + b_g).split(2)   (GRU; r first)
+    m_t = actNode(W_c [x_t, r_t*h_{t-1}] + b_c)
+    h_t = u_t * h_{t-1} + (1 - u_t) * m_t
+    masked:  h_t = h_t * m + h_{t-1} * (1 - m)
+
+    i,j,f,o = (W [x_t, h_{t-1}] + b).split(4)               (LSTM)
+    c_t = c_{t-1} * sigmoid(f + forget_bias) + sigmoid(i) * tanh(j)
+    h_t = tanh(c_t) * sigmoid(o)
+
+Dropout applies to the layer-(i) output as it feeds layer i+1 AND to the
+final layer's step output (the reference appends the post-dropout
+step_input as the last step_output and returns it), but NOT to the
+per-layer last_hidden states.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+_ACTS = {
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "identity": lambda x: x,
+}
+
+
+def _act(name):
+    try:
+        return _ACTS[name]
+    except KeyError:
+        raise NotImplementedError(
+            "basic_gru/basic_lstm activation %r (supported: %s)"
+            % (name, sorted(_ACTS)))
+
+
+def _uses_dropout(attrs):
+    return (float(attrs.get("dropout_prob", 0.0) or 0.0) > 0.0
+            and not attrs.get("is_test", False))
+
+
+def _step_keys(ctx, attrs, t_steps):
+    if _uses_dropout(attrs):
+        return jax.random.split(ctx.rng(), t_steps)
+    return jnp.zeros((t_steps, 2), jnp.uint32)
+
+
+def _dropout(x, p, key):
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+
+
+@register_op(
+    "basic_gru_rnn",
+    inputs=("Input", "InitHidden", "Mask", "GateWeight", "CandWeight",
+            "GateBias", "CandBias"),
+    outputs=("Out", "LastHidden"),
+    attrs={"hidden_size": 0, "num_layers": 1, "dropout_prob": 0.0,
+           "is_test": False, "gate_activation": "sigmoid",
+           "activation": "tanh"},
+    optional_inputs=("InitHidden", "Mask"),
+    duplicable_inputs=("GateWeight", "CandWeight", "GateBias", "CandBias"),
+    n_rng=1,
+)
+def basic_gru_rnn(ctx, x, h0, mask, gate_w, cand_w, gate_b, cand_b,
+                  hidden_size=0, num_layers=1, dropout_prob=0.0,
+                  is_test=False, gate_activation="sigmoid",
+                  activation="tanh"):
+    """Single-direction multi-layer GRU over time-major input [T, B, I].
+
+    h0: [L, B, H] or None (zeros).  mask: [T, B] or None.  Per-layer
+    weights: gate_w[i] [I_i+H, 2H], cand_w[i] [I_i+H, H].  Returns
+    (out [T, B, H], last_hidden [L, B, H])."""
+    g_act = _act(gate_activation)
+    c_act = _act(activation)
+    T, B = x.shape[0], x.shape[1]
+    H, L = int(hidden_size), int(num_layers)
+    p = 0.0 if is_test else float(dropout_prob)
+    if h0 is None:
+        h0 = jnp.zeros((L, B, H), x.dtype)
+    else:
+        h0 = h0.reshape(L, B, H).astype(x.dtype)
+    keys = _step_keys(ctx, {"dropout_prob": p, "is_test": is_test}, T)
+    ms = mask if mask is not None else jnp.ones((T, B), x.dtype)
+
+    def step(h_carry, xs):
+        x_t, m_t, key_t = xs
+        step_in = x_t
+        new_h = []
+        for i in range(L):
+            h_prev = h_carry[i]
+            cat = jnp.concatenate([step_in, h_prev], axis=1)
+            gate = g_act(jnp.dot(cat, gate_w[i]) + gate_b[i])
+            r, u = jnp.split(gate, 2, axis=1)
+            cand_in = jnp.concatenate([step_in, r * h_prev], axis=1)
+            m = c_act(jnp.dot(cand_in, cand_w[i]) + cand_b[i])
+            nh = u * h_prev + (1.0 - u) * m
+            if mask is not None:
+                mt = m_t[:, None].astype(nh.dtype)
+                nh = nh * mt + h_prev * (1.0 - mt)
+            new_h.append(nh)
+            step_in = nh
+            if p > 0.0:
+                step_in = _dropout(step_in,
+                                   p, jax.random.fold_in(
+                                       jax.random.wrap_key_data(key_t), i))
+        return jnp.stack(new_h), step_in
+
+    last_h, out = jax.lax.scan(step, h0, (x, ms, keys))
+    return out, last_h
+
+
+@register_op(
+    "basic_lstm_rnn",
+    inputs=("Input", "InitHidden", "InitCell", "Mask", "Weight", "Bias"),
+    outputs=("Out", "LastHidden", "LastCell"),
+    attrs={"hidden_size": 0, "num_layers": 1, "dropout_prob": 0.0,
+           "is_test": False, "forget_bias": 1.0,
+           "gate_activation": "sigmoid", "activation": "tanh"},
+    optional_inputs=("InitHidden", "InitCell", "Mask"),
+    duplicable_inputs=("Weight", "Bias"),
+    n_rng=1,
+)
+def basic_lstm_rnn(ctx, x, h0, c0, mask, weight, bias, hidden_size=0,
+                   num_layers=1, dropout_prob=0.0, is_test=False,
+                   forget_bias=1.0, gate_activation="sigmoid",
+                   activation="tanh"):
+    """Single-direction multi-layer LSTM over time-major input [T, B, I].
+
+    weight[i]: [I_i+H, 4H] (i, j, f, o gate order — reference
+    BasicLSTMUnit.forward); bias[i]: [4H].  Returns (out, last_hidden
+    [L,B,H], last_cell [L,B,H])."""
+    g_act = _act(gate_activation)
+    c_act = _act(activation)
+    T, B = x.shape[0], x.shape[1]
+    H, L = int(hidden_size), int(num_layers)
+    p = 0.0 if is_test else float(dropout_prob)
+    fb = jnp.asarray(forget_bias, jnp.float32)
+    h0 = (jnp.zeros((L, B, H), x.dtype) if h0 is None
+          else h0.reshape(L, B, H).astype(x.dtype))
+    c0 = (jnp.zeros((L, B, H), x.dtype) if c0 is None
+          else c0.reshape(L, B, H).astype(x.dtype))
+    keys = _step_keys(ctx, {"dropout_prob": p, "is_test": is_test}, T)
+    ms = mask if mask is not None else jnp.ones((T, B), x.dtype)
+
+    def step(carry, xs):
+        h_carry, c_carry = carry
+        x_t, m_t, key_t = xs
+        step_in = x_t
+        new_h, new_c = [], []
+        for i in range(L):
+            h_prev, c_prev = h_carry[i], c_carry[i]
+            cat = jnp.concatenate([step_in, h_prev], axis=1)
+            gates = jnp.dot(cat, weight[i]) + bias[i]
+            gi, gj, gf, go = jnp.split(gates, 4, axis=1)
+            nc = (c_prev * g_act(gf + fb.astype(gf.dtype))
+                  + g_act(gi) * c_act(gj))
+            nh = c_act(nc) * g_act(go)
+            if mask is not None:
+                mt = m_t[:, None].astype(nh.dtype)
+                nh = nh * mt + h_prev * (1.0 - mt)
+                nc = nc * mt + c_prev * (1.0 - mt)
+            new_h.append(nh)
+            new_c.append(nc)
+            step_in = nh
+            if p > 0.0:
+                step_in = _dropout(step_in,
+                                   p, jax.random.fold_in(
+                                       jax.random.wrap_key_data(key_t), i))
+        return (jnp.stack(new_h), jnp.stack(new_c)), step_in
+
+    (last_h, last_c), out = jax.lax.scan(step, (h0, c0), (x, ms, keys))
+    return out, last_h, last_c
+
+
+def _rnn_rng_when(attrs):
+    return _uses_dropout(attrs)
+
+
+basic_gru_rnn.opdef.rng_when = _rnn_rng_when
+basic_lstm_rnn.opdef.rng_when = _rnn_rng_when

@@ -744,6 +744,9 @@ def _flash_attention_grad_maker(op, no_grad_set):
         "K": list(op.input("K")),
         "V": list(op.input("V")),
         "Mask": list(op.output("Mask")),
+        "Out": list(op.output("Out")),
+        "Seed": list(op.output("Seed")),
+        "Lse": list(op.output("Lse")),
         "GRAD@Out": [_grad_var_name(op.output("Out")[0])],
     }
     if op.input("BiasQK"):
@@ -759,10 +762,43 @@ def _flash_attention_grad_maker(op, no_grad_set):
                        dict(op.attrs))]
 
 
+def _fa_module():
+    """The flash_attention MODULE — the package __init__ re-exports the
+    function under the same name, so a plain from-import gets the
+    function; every site needing module attributes goes through here."""
+    import importlib
+
+    return importlib.import_module(
+        "paddle_tpu.pallas_kernels.flash_attention")
+
+
+def _fa_small_kernel_ok(q_shape, k_shape, bias_shape, attrs):
+    """Static routing predicate for the small-seq fused training kernel.
+    Shared by the forward and grad lowerings: both MUST route identically
+    (the grad replays the in-kernel dropout mask from Seed)."""
+    import jax as _jax
+
+    from .. import flags as _flags
+
+    # opt-in (FLAGS_fused_small_attention): measured 18% slower in-step
+    # than the composed training emission at bs224 — see flags.py note
+    if not _flags.get_flags(["FLAGS_fused_small_attention"])[
+            "FLAGS_fused_small_attention"]:
+        return False
+    _fam = _fa_module()
+    if not _fa_uses_dropout(attrs):
+        return False
+    if _jax.default_backend() != "tpu":
+        return False
+    return _fam.small_attention_shapes_ok(
+        q_shape, k_shape, bias_shape, attrs.get("causal", False),
+        attrs.get("layout", "BHSD"))
+
+
 @register_op(
     "flash_attention",
     inputs=("Q", "K", "V", "BiasQK"),
-    outputs=("Out", "Mask"),
+    outputs=("Out", "Mask", "Seed", "Lse"),
     attrs={"causal": False, "scale": 0.0, "layout": "BHSD",
            "dropout_prob": 0.0, "is_test": False},
     optional_inputs=("BiasQK",),
@@ -798,12 +834,27 @@ def flash_attention_op(ctx, q, k, v, bias_qk=None, causal=False, scale=0.0,
     """
     from ..pallas_kernels import flash_attention as _fa
 
+    _fam = _fa_module()
     _fa_check_layout(layout)
     head_dim = q.shape[-1]
     sm_scale = scale if scale else head_dim ** -0.5
     bshd = layout == "BSHD"
-    if _fa_uses_dropout({"dropout_prob": dropout_prob,
-                         "is_test": is_test}):
+    attrs = {"dropout_prob": dropout_prob, "is_test": is_test,
+             "causal": causal, "layout": layout}
+    seed_ph = jnp.zeros((2,), jnp.int32)
+    lse_ph = jnp.zeros((1, 1, 1, 1), jnp.float32)
+    if _fa_small_kernel_ok(q.shape, k.shape,
+                           None if bias_qk is None else bias_qk.shape,
+                           attrs):
+        # small-seq fused training kernel: bias + softmax + in-kernel
+        # dropout in one pass; Seed+Lse (not a materialized mask) carry
+        # the backward's replay state
+        seed_arr = jax.random.bits(ctx.rng(), (2,), jnp.uint32)
+        out, lse = _fam.small_attention_fwd(q, k, v, bias_qk, sm_scale,
+                                            dropout_prob, seed_arr)
+        return (out, jnp.zeros((1,), jnp.uint8),
+                seed_arr.astype(jnp.int32), lse)
+    if _fa_uses_dropout(attrs):
         B = q.shape[0]
         H = q.shape[2] if bshd else q.shape[1]
         Sq = q.shape[1] if bshd else q.shape[2]
@@ -812,37 +863,50 @@ def flash_attention_op(ctx, q, k, v, bias_qk=None, causal=False, scale=0.0,
                                (B, H, Sq, Sk))
         out = _attention_composed(q, k, v, bias_qk, causal, sm_scale,
                                   keep, dropout_prob, bshd)
-        return out, keep.astype(jnp.uint8)
+        return out, keep.astype(jnp.uint8), seed_ph, lse_ph
     mask_placeholder = jnp.zeros((1,), jnp.uint8)
     if bshd:
         return (_attention_composed(q, k, v, bias_qk, causal, sm_scale,
-                                    bshd=True), mask_placeholder)
+                                    bshd=True), mask_placeholder, seed_ph,
+                lse_ph)
     return (_fa(q, k, v, bias=bias_qk, causal=causal, sm_scale=sm_scale),
-            mask_placeholder)
+            mask_placeholder, seed_ph, lse_ph)
 
 
 @register_op(
     "flash_attention_grad",
-    inputs=("Q", "K", "V", "BiasQK", "Mask", "GRAD@Out"),
+    inputs=("Q", "K", "V", "BiasQK", "Mask", "Out", "Seed", "Lse",
+            "GRAD@Out"),
     outputs=("X@Q", "X@K", "X@V"),
     attrs={"causal": False, "scale": 0.0, "layout": "BHSD",
            "dropout_prob": 0.0, "is_test": False},
     optional_inputs=("BiasQK",),
     grad_maker=None,
 )
-def flash_attention_grad_op(ctx, q, k, v, bias_qk, mask, dy, causal=False,
-                            scale=0.0, layout="BHSD", dropout_prob=0.0,
+def flash_attention_grad_op(ctx, q, k, v, bias_qk, mask, out, seed_words,
+                            lse, dy, causal=False, scale=0.0,
+                            layout="BHSD", dropout_prob=0.0,
                             is_test=False):
-    """Backward: vjp of the composed forward replayed with the SAVED
-    dropout mask (exact forward/backward mask agreement); the
-    dropout-free path differentiates the kernel's own custom vjp."""
+    """Backward: the small-seq fused kernel re-draws its in-kernel mask
+    from the saved Seed and recomputes probs from Lse; the composed
+    dropout path replays with the SAVED Mask; the dropout-free path
+    differentiates the kernel's own custom vjp.  Routing must mirror the
+    forward exactly (same static predicate)."""
     from ..pallas_kernels import flash_attention as _fa
 
+    _fam = _fa_module()
     _fa_check_layout(layout)
     sm_scale = scale if scale else q.shape[-1] ** -0.5
     bshd = layout == "BSHD"
-    if _fa_uses_dropout({"dropout_prob": dropout_prob,
-                         "is_test": is_test}):
+    attrs = {"dropout_prob": dropout_prob, "is_test": is_test,
+             "causal": causal, "layout": layout}
+    if _fa_small_kernel_ok(q.shape, k.shape,
+                           None if bias_qk is None else bias_qk.shape,
+                           attrs):
+        return _fam.small_attention_bwd(
+            q, k, v, bias_qk, sm_scale, dropout_prob,
+            seed_words.astype(jnp.uint32), out, lse, dy)
+    if _fa_uses_dropout(attrs):
         fn = lambda a, b, c: _attention_composed(
             a, b, c, bias_qk, causal, sm_scale, mask, dropout_prob, bshd)
     elif bshd:

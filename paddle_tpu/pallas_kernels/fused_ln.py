@@ -44,24 +44,16 @@ try:
 except ImportError:  # pragma: no cover
     _HAS_PALLAS = False
 
+from . import prng as _prng
+
 __all__ = ["fused_dropout_add_ln", "fused_ln_fwd", "fused_ln_bwd",
            "ln_stat_shapes"]
 
 _LANES = 128
-_TWO32 = 1 << 32
 
-
-def _keep_threshold(dropout_prob):
-    """u32 compare threshold for the keep draw; None = no dropout."""
-    q = 1.0 - float(dropout_prob)
-    thr = int(round(q * _TWO32))
-    if thr >= _TWO32:
-        return None
-    return max(thr, 1)
-
-
-def _realized_q(thr):
-    return thr / _TWO32
+# shared realized-keep-probability contract (pallas_kernels/prng.py)
+_keep_threshold = _prng.keep_threshold
+_realized_q = _prng.realized_q
 
 
 def _pick_rows(n, h, itemsize):
@@ -104,13 +96,8 @@ def ln_stat_shapes(x_shape, begin_norm_axis):
 
 
 def _draw_keep(seed_ref, rows, h, thr):
-    # Mosaic caps prng_seed at 2 words: fold the block index into word 0
-    # (Knuth multiplicative hash) so every grid step draws an independent,
-    # reproducible stream — the backward re-seeds identically per block
-    pid = pl.program_id(0).astype(jnp.uint32) * jnp.uint32(2654435761)
-    pltpu.prng_seed(seed_ref[0] ^ pid, seed_ref[1])
-    bits = pltpu.bitcast(pltpu.prng_random_bits((rows, h)), jnp.uint32)
-    return bits < jnp.uint32(thr)
+    _prng.seed_block_prng(seed_ref)
+    return _prng.draw_keep_bits((rows, h), thr)
 
 
 def _fwd_kernel(seed_ref, x_ref, y_ref, g_ref, b_ref,

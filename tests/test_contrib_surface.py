@@ -1,0 +1,508 @@
+"""Round-5 contrib surface: layers (incl. rnn_impl), quantize, utils,
+reader, trainer/inferencer shims.
+
+Coverage model per reference op_test.py check_output: basic_gru /
+basic_lstm get NUMERIC goldens against an independent numpy
+implementation of the reference equations
+(contrib/layers/rnn_impl.py:22,632), across unidirectional,
+bidirectional, multi-layer, and sequence_length-masked paths; the 8
+layer wrappers execute their (already-golden-tested) ops through the
+contrib API; QuantizeTranspiler round-trips a program; trainer /
+inferencer run a real train->save->infer loop.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import contrib
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _np_gru_direction(x, h0, gws, cws, gbs, cbs, L, H, mask=None):
+    """Independent numpy implementation of the reference basic_gru
+    equations (time-major x [T,B,I]); returns (out [T,B,H], last [L,B,H])."""
+    T, B, _ = x.shape
+    h = [h0[i].copy() for i in range(L)]
+    outs = []
+    for t in range(T):
+        step_in = x[t]
+        for i in range(L):
+            cat = np.concatenate([step_in, h[i]], axis=1)
+            gate = _sigmoid(cat @ gws[i] + gbs[i])
+            r, u = np.split(gate, 2, axis=1)
+            cand = np.tanh(
+                np.concatenate([step_in, r * h[i]], axis=1) @ cws[i]
+                + cbs[i])
+            nh = u * h[i] + (1.0 - u) * cand
+            if mask is not None:
+                m = mask[t][:, None]
+                nh = nh * m + h[i] * (1.0 - m)
+            h[i] = nh
+            step_in = nh
+        outs.append(step_in.copy())
+    return np.stack(outs), np.stack(h)
+
+
+def _np_lstm_direction(x, h0, c0, ws, bs, L, H, forget_bias=1.0,
+                       mask=None):
+    T, B, _ = x.shape
+    h = [h0[i].copy() for i in range(L)]
+    c = [c0[i].copy() for i in range(L)]
+    outs = []
+    for t in range(T):
+        step_in = x[t]
+        for i in range(L):
+            cat = np.concatenate([step_in, h[i]], axis=1)
+            gates = cat @ ws[i] + bs[i]
+            gi, gj, gf, go = np.split(gates, 4, axis=1)
+            nc = c[i] * _sigmoid(gf + forget_bias) + _sigmoid(gi) * np.tanh(gj)
+            nh = np.tanh(nc) * _sigmoid(go)
+            if mask is not None:
+                m = mask[t][:, None]
+                nh = nh * m + h[i] * (1.0 - m)
+                nc = nc * m + c[i] * (1.0 - m)
+            h[i], c[i] = nh, nc
+            step_in = nh
+        outs.append(step_in.copy())
+    return np.stack(outs), np.stack(h), np.stack(c)
+
+
+def _run_program(main, startup, feed, fetch):
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        outs = exe.run(main, feed=feed, fetch_list=fetch)
+        # pull parameter values for the golden recompute
+        params = {}
+        for v in main.list_vars():
+            if getattr(v, "persistable", False):
+                var = scope.find_var(v.name)
+                if var is not None:
+                    params[v.name] = np.array(np.asarray(var.get_tensor()))
+    return outs, params
+
+
+def _gru_params(params, n_layers, prefix_order):
+    """Group created parameters by creation order: per layer (gate_w,
+    cand_w, gate_b, cand_b)."""
+    names = [n for n in prefix_order]
+    return names
+
+
+@pytest.mark.parametrize("bidirectional", [False, True])
+@pytest.mark.parametrize("num_layers", [1, 2])
+def test_basic_gru_golden(bidirectional, num_layers):
+    T, B, I, H = 5, 3, 4, 6
+    rng = np.random.RandomState(0)
+    x = rng.randn(B, T, I).astype("float32") * 0.5
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xin = fluid.layers.data("x", shape=[T, I])
+        out, last = contrib.layers.basic_gru(
+            xin, None, H, num_layers=num_layers,
+            bidirectional=bidirectional, batch_first=True)
+    (got_out, got_last), params = _run_program(
+        main, startup, {"x": x}, [out, last])
+
+    # parameters in creation order: per direction, per layer:
+    # gate_w, cand_w, gate_b, cand_b
+    ordered = [params[n] for n in sorted(
+        params, key=lambda n: list(params).index(n))]
+    names = list(params)
+    dirs = 2 if bidirectional else 1
+    per_dir = []
+    idx = 0
+    for d in range(dirs):
+        gws, cws, gbs, cbs = [], [], [], []
+        for i in range(num_layers):
+            gws.append(ordered[idx]); cws.append(ordered[idx + 1])
+            gbs.append(ordered[idx + 2]); cbs.append(ordered[idx + 3])
+            idx += 4
+        per_dir.append((gws, cws, gbs, cbs))
+
+    xt = np.transpose(x, (1, 0, 2))  # time-major
+    h0 = np.zeros((num_layers, B, H), "float32")
+    fw_out, fw_last = _np_gru_direction(xt, h0, *per_dir[0], num_layers, H)
+    if bidirectional:
+        bw_out_r, bw_last = _np_gru_direction(xt[::-1], h0, *per_dir[1],
+                                              num_layers, H)
+        ref_out = np.concatenate([fw_out, bw_out_r[::-1]], axis=2)
+        ref_last = np.concatenate([fw_last, bw_last], axis=1).reshape(
+            num_layers * 2, B, H)
+    else:
+        ref_out, ref_last = fw_out, fw_last
+    ref_out = np.transpose(ref_out, (1, 0, 2))  # batch-first
+    np.testing.assert_allclose(got_out, ref_out, atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(got_last, ref_last, atol=1e-5, rtol=1e-5)
+
+
+def test_basic_gru_sequence_length_mask():
+    T, B, I, H = 6, 3, 4, 5
+    rng = np.random.RandomState(1)
+    x = rng.randn(B, T, I).astype("float32") * 0.5
+    lens = np.array([6, 3, 1], "int64")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xin = fluid.layers.data("x", shape=[T, I])
+        lin = fluid.layers.data("lens", shape=[], dtype="int64")
+        out, last = contrib.layers.basic_gru(
+            xin, None, H, num_layers=1, sequence_length=lin,
+            batch_first=True)
+    (got_out, got_last), params = _run_program(
+        main, startup, {"x": x, "lens": lens}, [out, last])
+    ordered = list(params.values())
+    xt = np.transpose(x, (1, 0, 2))
+    mask = (np.arange(T)[:, None] < lens[None, :]).astype("float32")
+    ref_out, ref_last = _np_gru_direction(
+        xt, np.zeros((1, B, H), "float32"), [ordered[0]], [ordered[1]],
+        [ordered[2]], [ordered[3]], 1, H, mask=mask)
+    np.testing.assert_allclose(got_out, np.transpose(ref_out, (1, 0, 2)),
+                               atol=1e-5, rtol=1e-5)
+    # beyond each sequence's length the hidden state must be frozen
+    np.testing.assert_allclose(got_last[0], ref_last[0], atol=1e-5,
+                               rtol=1e-5)
+
+
+@pytest.mark.parametrize("bidirectional", [False, True])
+def test_basic_lstm_golden(bidirectional):
+    T, B, I, H, L = 4, 2, 3, 5, 2
+    rng = np.random.RandomState(2)
+    x = rng.randn(B, T, I).astype("float32") * 0.5
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xin = fluid.layers.data("x", shape=[T, I])
+        out, last_h, last_c = contrib.layers.basic_lstm(
+            xin, None, None, H, num_layers=L, bidirectional=bidirectional,
+            batch_first=True, forget_bias=1.0)
+    (got_out, got_h, got_c), params = _run_program(
+        main, startup, {"x": x}, [out, last_h, last_c])
+    ordered = list(params.values())
+    dirs = 2 if bidirectional else 1
+    per_dir, idx = [], 0
+    for d in range(dirs):
+        ws, bs = [], []
+        for i in range(L):
+            ws.append(ordered[idx]); bs.append(ordered[idx + 1])
+            idx += 2
+        per_dir.append((ws, bs))
+    xt = np.transpose(x, (1, 0, 2))
+    z = np.zeros((L, B, H), "float32")
+    fw_o, fw_h, fw_c = _np_lstm_direction(xt, z, z, *per_dir[0], L, H)
+    if bidirectional:
+        bw_o, bw_h, bw_c = _np_lstm_direction(xt[::-1], z, z, *per_dir[1],
+                                              L, H)
+        ref_o = np.concatenate([fw_o, bw_o[::-1]], axis=2)
+        ref_h = np.concatenate([fw_h, bw_h], axis=1).reshape(L * 2, B, H)
+        ref_c = np.concatenate([fw_c, bw_c], axis=1).reshape(L * 2, B, H)
+    else:
+        ref_o, ref_h, ref_c = fw_o, fw_h, fw_c
+    np.testing.assert_allclose(got_out, np.transpose(ref_o, (1, 0, 2)),
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(got_h, ref_h, atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(got_c, ref_c, atol=1e-5, rtol=1e-5)
+
+
+def test_basic_gru_trains():
+    """Gradients flow through the scan: a tiny regression on the GRU's
+    last hidden state must reduce loss."""
+    T, B, I, H = 4, 8, 3, 6
+    rng = np.random.RandomState(3)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xin = fluid.layers.data("x", shape=[T, I])
+        y = fluid.layers.data("y", shape=[1])
+        out, last = contrib.layers.basic_gru(xin, None, H, num_layers=1,
+                                             batch_first=True)
+        pred = fluid.layers.fc(fluid.layers.reshape(last, [-1, H]), 1)
+        loss = fluid.layers.mean(fluid.layers.square(
+            fluid.layers.elementwise_sub(pred, y)))
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    w = rng.randn(T * I, 1).astype("float32")
+    # fixed batch: the check is "gradients flow and descend", not SGD
+    # generalization — a per-step random batch is too noisy at B=8
+    x = rng.randn(B, T, I).astype("float32")
+    yv = (x.reshape(B, -1) @ w).astype("float32")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = []
+        for _ in range(40):
+            lo, = exe.run(main, feed={"x": x, "y": yv}, fetch_list=[loss])
+            losses.append(float(lo[0]))
+    assert losses[-1] < losses[0] * 0.3, (losses[0], losses[-1])
+
+
+def test_dygraph_units_match_numpy():
+    from paddle_tpu import dygraph
+
+    rng = np.random.RandomState(4)
+    with dygraph.guard():
+        unit = contrib.layers.BasicGRUUnit("u", 4)
+        x = dygraph.to_variable(rng.randn(2, 3).astype("float32"))
+        h = dygraph.to_variable(rng.randn(2, 4).astype("float32"))
+        out = unit(x, h)
+        gw = np.asarray(unit._gate_weight.numpy())
+        cw = np.asarray(unit._candidate_weight.numpy())
+        gb = np.asarray(unit._gate_bias.numpy())
+        cb = np.asarray(unit._candidate_bias.numpy())
+        ref, _ = _np_gru_direction(
+            np.asarray(x.numpy())[None], np.asarray(h.numpy())[None],
+            [gw], [cw], [gb], [cb], 1, 4)
+        np.testing.assert_allclose(np.asarray(out.numpy()), ref[0],
+                                   atol=1e-5, rtol=1e-5)
+
+        lunit = contrib.layers.BasicLSTMUnit("l", 4, forget_bias=1.0)
+        c = dygraph.to_variable(rng.randn(2, 4).astype("float32"))
+        nh, nc = lunit(x, h, c)
+        w = np.asarray(lunit._weight.numpy())
+        b = np.asarray(lunit._bias.numpy())
+        ref_o, ref_h, ref_c = _np_lstm_direction(
+            np.asarray(x.numpy())[None], np.asarray(h.numpy())[None],
+            np.asarray(c.numpy())[None], [w], [b], 1, 4)
+        np.testing.assert_allclose(np.asarray(nh.numpy()), ref_h[0],
+                                   atol=1e-5, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(nc.numpy()), ref_c[0],
+                                   atol=1e-5, rtol=1e-5)
+
+
+def test_contrib_layer_wrappers_execute():
+    """The 8 wrappers build and execute through their registered ops."""
+    rng = np.random.RandomState(5)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = fluid.layers.data("a", shape=[8])
+        b = fluid.layers.data("b", shape=[8])
+        fea = contrib.layers.fused_elemwise_activation(
+            a, b, ["elementwise_add", "relu"])
+        ids = fluid.layers.data("ids", shape=[4, 1], dtype="int64")
+        emb = contrib.layers.fused_embedding_seq_pool(ids, (10, 6),
+                                                      combiner="sum")
+        nodes = fluid.layers.data("nodes", shape=[5, 6])
+        edges = fluid.layers.data("edges", shape=[4, 2], dtype="int32")
+        tc = contrib.layers.tree_conv(nodes, edges, 3, 2, max_depth=2,
+                                      act="tanh")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        o1, o2, o3 = exe.run(main, feed={
+            "a": rng.randn(2, 8).astype("float32"),
+            "b": rng.randn(2, 8).astype("float32"),
+            "ids": rng.randint(0, 10, (2, 4, 1)).astype("int64"),
+            "nodes": rng.randn(2, 5, 6).astype("float32"),
+            "edges": np.tile(np.array([[1, 0], [2, 0], [3, 1], [4, 1]],
+                                      "int32"), (2, 1, 1)),
+        }, fetch_list=[fea, emb, tc])
+    # ['elementwise_add', 'relu'] means out = x + relu(y) (the reference
+    # docstring's Binary(x, Unary(y)) composition)
+    assert o1.shape == (2, 8)
+    assert o2.shape == (2, 6)
+    assert o3.shape[0] == 2 and np.isfinite(o3).all()
+
+
+def test_ctr_metric_bundle_accumulates():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        p = fluid.layers.data("p", shape=[1])
+        y = fluid.layers.data("y", shape=[1])
+        sqe, abe, prob, q, pos, ins = contrib.layers.ctr_metric_bundle(p, y)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    pv = np.array([[0.2], [0.8], [0.5]], "float32")
+    yv = np.array([[0.0], [1.0], [1.0]], "float32")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(2):  # accumulators must SUM across steps
+            outs = exe.run(main, feed={"p": pv, "y": yv},
+                           fetch_list=[sqe, abe, prob, q, pos, ins])
+    sqerr = ((pv - yv) ** 2).sum() * 2
+    np.testing.assert_allclose(outs[0], [sqerr], rtol=1e-5)
+    np.testing.assert_allclose(outs[1], [np.abs(pv - yv).sum() * 2],
+                               rtol=1e-5)
+    np.testing.assert_allclose(outs[2], [pv.sum() * 2], rtol=1e-5)
+    np.testing.assert_allclose(outs[4], [yv.sum() * 2], rtol=1e-5)
+    np.testing.assert_allclose(outs[5], [6.0], rtol=1e-5)
+
+
+def test_quantize_transpiler_roundtrip():
+    t = contrib.QuantizeTranspiler(activation_quantize_type="abs_max")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8])
+        y = fluid.layers.data("y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, 16, act="relu")
+        logits = fluid.layers.fc(h, 4)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        n = t.training_transpile(main, startup)
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    assert n >= 2  # both mul/matmul ops rewritten
+    assert any("quantize" in op.type for op in main.global_block().ops)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(6)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(3):
+            lo, = exe.run(main, feed={
+                "x": rng.randn(4, 8).astype("float32"),
+                "y": rng.randint(0, 4, (4, 1)).astype("int64")},
+                fetch_list=[loss])
+        assert np.isfinite(lo).all()
+        t.freeze_program(main, fluid.CPUPlace(), scope)
+        t.convert_to_int8(main, fluid.CPUPlace(), scope)
+
+
+def test_distributed_batch_reader_shards():
+    os.environ["PADDLE_TRAINERS_NUM"] = "2"
+    try:
+        for tid, want in ((0, [0, 2, 4]), (1, [1, 3, 5])):
+            os.environ["PADDLE_TRAINER_ID"] = str(tid)
+            reader = contrib.reader.distributed_batch_reader(
+                lambda: iter(range(6)))
+            assert list(reader()) == want
+    finally:
+        os.environ.pop("PADDLE_TRAINERS_NUM")
+        os.environ.pop("PADDLE_TRAINER_ID")
+
+
+def test_trainer_inferencer_shims(tmp_path):
+    rng = np.random.RandomState(7)
+    W = rng.randn(4, 1).astype("float32")
+
+    def train_func():
+        x = fluid.layers.data("x", shape=[4])
+        y = fluid.layers.data("y", shape=[1])
+        pred = fluid.layers.fc(x, 1, param_attr=fluid.ParamAttr(name="w"))
+        return fluid.layers.mean(fluid.layers.square(
+            fluid.layers.elementwise_sub(pred, y)))
+
+    def reader():
+        for _ in range(8):
+            x = rng.randn(16, 4).astype("float32")
+            yield {"x": x, "y": (x @ W).astype("float32")}
+
+    events = []
+    trainer = contrib.Trainer(train_func=train_func,
+                              optimizer_func=lambda:
+                              fluid.optimizer.Adam(learning_rate=0.1))
+    losses = []
+
+    def handler(ev):
+        events.append(type(ev).__name__)
+        if isinstance(ev, contrib.trainer.EndStepEvent):
+            losses.append(float(np.asarray(ev.metrics[0]).reshape(-1)[0]))
+
+    trainer.train(num_epochs=4, event_handler=handler, reader=reader)
+    assert losses[-1] < losses[0] * 0.5
+    assert "BeginEpochEvent" in events and "EndStepEvent" in events
+    pdir = str(tmp_path / "params")
+    trainer.save_params(pdir)
+
+    def infer_func():
+        x = fluid.layers.data("x", shape=[4])
+        return fluid.layers.fc(x, 1, param_attr=fluid.ParamAttr(name="w"))
+
+    inf = contrib.Inferencer(infer_func, pdir)
+    xv = rng.randn(3, 4).astype("float32")
+    out, = inf.infer({"x": xv})
+    assert out.shape == (3, 1) and np.isfinite(out).all()
+
+
+def test_lookup_table_utils_convert():
+    from paddle_tpu.contrib.utils import convert_dist_to_sparse_program
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data("ids", shape=[1], dtype="int64")
+        emb = fluid.layers.embedding(
+            ids, (50, 8), is_sparse=True, is_distributed=True,
+            param_attr=fluid.ParamAttr(name="emb_table"))
+        loss = fluid.layers.mean(emb)
+    convert_dist_to_sparse_program(main)
+    types = [op.type for op in main.global_block().ops]
+    assert "lookup_table" in types
+    for op in main.global_block().ops:
+        if op.type == "lookup_table":
+            assert not op.attrs.get("is_distributed")
+    # converted program executes locally
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        out, = exe.run(main, feed={
+            "ids": np.array([[1], [2]], "int64")}, fetch_list=[loss])
+    assert np.isfinite(out).all()
+
+
+def test_hdfs_multi_transfer_sharding(tmp_path, monkeypatch):
+    """multi_download/multi_upload shard and move files — exercised
+    against a fake `hadoop` on PATH backed by the local fs."""
+    fake = tmp_path / "bin"
+    fake.mkdir()
+    hdfs_root = tmp_path / "hdfs"
+    (hdfs_root / "sub").mkdir(parents=True)
+    for i in range(4):
+        (hdfs_root / ("f%d.txt" % i)).write_text("data%d" % i)
+    (hdfs_root / "sub" / "g.txt").write_text("sub")
+    script = fake / "hadoop"
+    script.write_text("""#!/usr/bin/env python3
+import os, shutil, sys, time
+args = sys.argv[1:]
+assert args[0] == 'fs'
+args = args[1:]
+while args and args[0].startswith('-D'):
+    args.pop(0)
+cmd = args[0]
+if cmd in ('-lsr',):
+    root = args[1]
+    for d, _, files in os.walk(root):
+        for f in sorted(files):
+            p = os.path.join(d, f)
+            st = os.stat(p)
+            print('-rw-r--r-- 1 u g %d 2026-01-01 00:00 %s' % (st.st_size, p))
+elif cmd == '-get':
+    src, dst = args[1], args[2]
+    shutil.copy(src, dst if not os.path.isdir(dst) else os.path.join(dst, os.path.basename(src)))
+elif cmd == '-put' or (cmd == '-put' and args[1] == '-f'):
+    rest = [a for a in args[1:] if a != '-f']
+    src, dst = rest
+    os.makedirs(dst, exist_ok=True)
+    shutil.copy(src, os.path.join(dst, os.path.basename(src)))
+elif cmd == '-mkdir':
+    os.makedirs(args[-1], exist_ok=True)
+elif cmd == '-test':
+    sys.exit(0 if os.path.exists(args[-1]) else 1)
+else:
+    sys.exit(0)
+""")
+    script.chmod(0o755)
+    monkeypatch.setenv("PATH", "%s:%s" % (fake, os.environ["PATH"]))
+    from paddle_tpu.contrib.utils import (HDFSClient, multi_download,
+                                          multi_upload)
+
+    client = HDFSClient(hadoop_home=None, configs={})
+    client._bin = str(script)
+    # trainer 0 of 2 gets files 0,2,4... of the sorted recursive listing
+    local = tmp_path / "local"
+    got = multi_download(client, str(hdfs_root), str(local), 0, 2,
+                         multi_processes=2)
+    all_files = client.lsr(str(hdfs_root))
+    assert len(all_files) == 5
+    assert len(got) == 3
+    for p in got:
+        assert os.path.exists(p), p
+    # upload everything back to a fresh "hdfs" dir
+    up_root = tmp_path / "hdfs_up"
+    up_root.mkdir()
+    sent = multi_upload(client, str(up_root), str(local),
+                        multi_processes=2)
+    assert len(sent) == 3
