@@ -78,7 +78,12 @@ def _timed_loop(run_step, sync, warmup, iters, chunk=None):
 
 
 def bench_resnet(batch=512, image_size=224, warmup=5, iters=30, depth=50,
-                 amp=True, data_format="NCHW"):
+                 amp=True, data_format="NCHW", chunk=None):
+    """The headline config measures at chunk=120 (set by main): at ~211 ms
+    device step the tunnel's ~100 ms dispatch+fetch RTT costs 3.3 ms/step
+    at chunk=30 but 0.8 ms/step at chunk=120 — the steady-state device
+    number a real training loop (which syncs rarely) sees.  Numbers are
+    only comparable at matched chunk (BASELINE.md records it)."""
     import jax
 
     import paddle_tpu as fluid
@@ -130,7 +135,8 @@ def bench_resnet(batch=512, image_size=224, warmup=5, iters=30, depth=50,
                                return_numpy=False)
                 return out
 
-        med, out = _timed_loop(step, lambda o: np.asarray(o), warmup, iters)
+        med, out = _timed_loop(step, lambda o: np.asarray(o), warmup,
+                               iters, chunk=chunk)
     return batch / med, float(np.asarray(out).reshape(-1)[0])
 
 
@@ -203,8 +209,11 @@ def bench_bert(batch=256, seq_len=128, warmup=3, iters=15, amp=True,
                 if line.startswith("BENCH_RESULT"):
                     _, v, l = line.split()
                     return float(v), float(l), b
-            last_err = (p.stderr or p.stdout)[-300:]
-            if "RESOURCE_EXHAUSTED" not in last_err:
+            full = (p.stderr or "") + (p.stdout or "")
+            last_err = full[-300:]
+            # search the FULL output: TPU OOMs append a multi-KB hbm
+            # allocation dump after the RESOURCE_EXHAUSTED line
+            if "RESOURCE_EXHAUSTED" not in full:
                 raise RuntimeError("bench_bert subprocess bs%d failed: %s"
                                    % (b, last_err))
         print("bench_bert: bs%d OOM, retrying smaller" % b,
@@ -513,7 +522,10 @@ def main():
         batch = int(os.environ.get("BENCH_BATCH", "512"))
         amp = os.environ.get("BENCH_AMP", "1") == "1"
         data_format = os.environ.get("BENCH_DATA_FORMAT", "NCHW")
-        img_per_sec, _loss = bench_resnet(batch=batch, iters=iters, amp=amp,
+        img_per_sec, _loss = bench_resnet(batch=batch,
+                                          iters=max(iters, 240), amp=amp,
+                                          chunk=int(os.environ.get(
+                                              "BENCH_CHUNK", "120")),
                                           data_format=data_format)
         tfs = img_per_sec * _resnet50_train_flops_per_image() / 1e12
         print(json.dumps({

@@ -174,9 +174,16 @@ class Trainer(object):
         serial_dir = os.path.join(cfg.checkpoint_dir,
                                   "checkpoint_%d_%d" % (epoch_id, step_id))
         save_checkpoint(self.exe, serial_dir, self.train_program)
+        def ckpt_key(d):
+            try:  # numeric (epoch, step): 'checkpoint_10_0' > 'checkpoint_9_0'
+                _, e, st = d.split("_")
+                return (int(e), int(st))
+            except ValueError:
+                return (-1, -1)
+
         existing = sorted(
-            d for d in os.listdir(cfg.checkpoint_dir)
-            if d.startswith("checkpoint_"))
+            (d for d in os.listdir(cfg.checkpoint_dir)
+             if d.startswith("checkpoint_")), key=ckpt_key)
         while len(existing) > cfg.max_num_checkpoints:
             shutil.rmtree(os.path.join(cfg.checkpoint_dir, existing.pop(0)),
                           ignore_errors=True)

@@ -70,21 +70,20 @@ def multi_download(client, hdfs_path, local_path, trainer_id, trainers,
     _logger.info("multi_download: %d of %d files from %s", len(need),
                  len(all_files), hdfs_path)
 
-    def download_one(data):
+    def _dest_dir(data):
         re_path = os.path.relpath(os.path.dirname(data), hdfs_path)
-        sub = (local_path if re_path == os.curdir
-               else os.path.join(local_path, re_path))
+        return (local_path if re_path == os.curdir
+                else os.path.join(local_path, re_path))
+
+    def download_one(data):
+        sub = _dest_dir(data)
         os.makedirs(sub, exist_ok=True)
         client.download(data, sub)
 
     _pool_run(download_one, need, multi_processes)
-    out = []
-    for data in need:
-        re_path = os.path.relpath(os.path.dirname(data), hdfs_path)
-        base = os.path.basename(data)
-        out.append(os.path.join(local_path, base) if re_path == os.curdir
-                   else os.path.join(local_path, re_path, base))
-    return out
+    # single source of truth for destinations: the same helper the
+    # workers used
+    return [os.path.join(_dest_dir(d), os.path.basename(d)) for d in need]
 
 
 def getfilelist(path):

@@ -53,6 +53,9 @@ def _uses_dropout(attrs):
 
 
 def _step_keys(ctx, attrs, t_steps):
+    # typed key array: lax.scan unstacks it per step and fold_in(key, i)
+    # derives the per-layer streams (wrap_key_data would reject the
+    # scan-unstacked 0-d typed key)
     if _uses_dropout(attrs):
         return jax.random.split(ctx.rng(), t_steps)
     return jnp.zeros((t_steps, 2), jnp.uint32)
@@ -114,9 +117,8 @@ def basic_gru_rnn(ctx, x, h0, mask, gate_w, cand_w, gate_b, cand_b,
             new_h.append(nh)
             step_in = nh
             if p > 0.0:
-                step_in = _dropout(step_in,
-                                   p, jax.random.fold_in(
-                                       jax.random.wrap_key_data(key_t), i))
+                step_in = _dropout(step_in, p,
+                                   jax.random.fold_in(key_t, i))
         return jnp.stack(new_h), step_in
 
     last_h, out = jax.lax.scan(step, h0, (x, ms, keys))
@@ -177,9 +179,8 @@ def basic_lstm_rnn(ctx, x, h0, c0, mask, weight, bias, hidden_size=0,
             new_c.append(nc)
             step_in = nh
             if p > 0.0:
-                step_in = _dropout(step_in,
-                                   p, jax.random.fold_in(
-                                       jax.random.wrap_key_data(key_t), i))
+                step_in = _dropout(step_in, p,
+                                   jax.random.fold_in(key_t, i))
         return (jnp.stack(new_h), jnp.stack(new_c)), step_in
 
     (last_h, last_c), out = jax.lax.scan(step, (h0, c0), (x, ms, keys))

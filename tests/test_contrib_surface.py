@@ -89,13 +89,6 @@ def _run_program(main, startup, feed, fetch):
     return outs, params
 
 
-def _gru_params(params, n_layers, prefix_order):
-    """Group created parameters by creation order: per layer (gate_w,
-    cand_w, gate_b, cand_b)."""
-    names = [n for n in prefix_order]
-    return names
-
-
 @pytest.mark.parametrize("bidirectional", [False, True])
 @pytest.mark.parametrize("num_layers", [1, 2])
 def test_basic_gru_golden(bidirectional, num_layers):
@@ -113,9 +106,7 @@ def test_basic_gru_golden(bidirectional, num_layers):
 
     # parameters in creation order: per direction, per layer:
     # gate_w, cand_w, gate_b, cand_b
-    ordered = [params[n] for n in sorted(
-        params, key=lambda n: list(params).index(n))]
-    names = list(params)
+    ordered = list(params.values())  # creation order
     dirs = 2 if bidirectional else 1
     per_dir = []
     idx = 0
@@ -238,6 +229,43 @@ def test_basic_gru_trains():
             lo, = exe.run(main, feed={"x": x, "y": yv}, fetch_list=[loss])
             losses.append(float(lo[0]))
     assert losses[-1] < losses[0] * 0.3, (losses[0], losses[-1])
+
+
+@pytest.mark.parametrize("api", ["gru", "lstm"])
+def test_basic_rnn_dropout_path(api):
+    """dropout_prob > 0 in training: the per-step key plumbing must trace
+    (regression: wrap_key_data rejected scan-unstacked typed keys), the
+    output must differ from the dropout-free run, and the inference clone
+    must be deterministic."""
+    T, B, I, H = 4, 3, 4, 6
+    rng = np.random.RandomState(8)
+    x = rng.randn(B, T, I).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xin = fluid.layers.data("x", shape=[T, I])
+        if api == "gru":
+            out, _ = contrib.layers.basic_gru(
+                xin, None, H, num_layers=2, dropout_prob=0.4,
+                batch_first=True)
+        else:
+            out, _, _ = contrib.layers.basic_lstm(
+                xin, None, None, H, num_layers=2, dropout_prob=0.4,
+                batch_first=True)
+        loss = fluid.layers.mean(out)
+    test_prog = main.clone(for_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        a, = exe.run(main, feed={"x": x}, fetch_list=[loss])
+        b, = exe.run(main, feed={"x": x}, fetch_list=[loss])
+        assert np.isfinite(a).all()
+        # training: fresh mask each step
+        assert not np.array_equal(a, b)
+        # inference clone: dropout off, deterministic
+        c, = exe.run(test_prog, feed={"x": x}, fetch_list=[loss])
+        d, = exe.run(test_prog, feed={"x": x}, fetch_list=[loss])
+        np.testing.assert_array_equal(c, d)
 
 
 def test_dygraph_units_match_numpy():
