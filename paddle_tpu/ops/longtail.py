@@ -310,34 +310,95 @@ def var_conv_2d(ctx, x, row, column, w, InputChannel=1, OutputChannel=1,
              outputs=("Out",), attrs={"max_depth": 2},
              no_grad_inputs=("EdgeSet",))
 def tree_conv(ctx, nodes, edges, filt, max_depth=2):
-    """tree_conv_op.cc (tree-based convolution, TBCNN): NodesVector
-    [B, N, F], EdgeSet [B, E, 2] (parent->child int pairs), Filter
-    [F, 3, output_size, num_filters].  For each node, aggregate the
-    vectors of its neighborhood up to max_depth with the three positional
-    weights (top/left/right mixed by depth/position ratios; simplified to
-    the standard TBCNN eta_t/eta_l/eta_r scheme)."""
+    """tree_conv_op.h (tree-based convolution, TBCNN) with the reference
+    Tree2Col semantics EXACTLY (math/tree2col.cc):
+
+    NodesVector [B, N, F]; EdgeSet [B, E, 2] of 1-BASED (parent, child)
+    pairs — a pair containing 0 terminates the edge list (tree2col.cc
+    construct_tree); Filter [F, 3, out, filters].  Each node u collects
+    its descendants v with dist(u, v) < max_depth; v contributes its
+    feature vector to three positional slots weighted by (tree2col.h
+    TreeNode):
+
+        eta_t = (D - depth) / D                      (D = max_depth)
+        temp  = 0.5 if pclen == 1 else (index-1)/(pclen-1)
+        eta_l = (1 - eta_t) * temp
+        eta_r = (1 - eta_t) * (1 - eta_l)            # NB: full eta_l
+
+    where (index, pclen) are v's 1-based position among its parent's
+    children and the child count — except the patch ROOT uses
+    (index=1, pclen=1, depth=0).  Vectorized as a static max_depth walk
+    up parent chains with scatter-adds (a lax-friendly emission of the
+    reference's DFS patch construction; exact for trees, the op's
+    contract)."""
     B, N, F = nodes.shape
-    adj = jnp.zeros((B, N, N), nodes.dtype)
+    E = edges.shape[1]
+    D = float(max_depth)
     e = edges.astype(jnp.int32)
+    parent_e, child_e = e[:, :, 0], e[:, :, 1]  # [B, E], 1-based
+    # the reference STOPS at the first pair containing a zero
+    ok = (parent_e != 0) & (child_e != 0)
+    valid = jnp.cumprod(ok.astype(jnp.int32), axis=1).astype(bool)
+
+    # per-node parent (1-based; 0 = none/root), child index (1-based)
+    # and parent's child count, from edge order (tr[u].push_back(v))
+    parent = jnp.zeros((B, N + 1), jnp.int32)
+    child_safe = jnp.where(valid, child_e, 0)
     bidx = jnp.arange(B)[:, None]
-    adj = adj.at[bidx, e[:, :, 0], e[:, :, 1]].set(1.0)
-    adj = adj + jnp.eye(N, dtype=nodes.dtype)[None]
-    # depth-wise receptive fields: powers of the adjacency (masked to 0/1)
-    agg = nodes
-    acc = []
-    reach = jnp.eye(N, dtype=nodes.dtype)[None].repeat(B, axis=0)
-    for d in range(max_depth):
-        reach = jnp.clip(reach @ adj, 0.0, 1.0)
-        eta_t = 1.0 - d / max(max_depth - 1, 1)
-        acc.append(eta_t * (reach @ nodes))
-    # [B, N, F, 3]-ish: pad/trim the depth list to the 3 positional slots
-    while len(acc) < 3:
-        acc.append(jnp.zeros_like(acc[0]))
-    stacked = jnp.stack(acc[:3], axis=2)  # [B, N, 3, F]
-    out = jnp.einsum("bnpf,fpom->bnom", stacked, filt)
-    # raw conv result: activation/bias belong to the layer API (the
-    # reference kernel likewise emits pre-activation patch sums —
-    # tree_conv_op.h Tree2ColFunctor + blas gemm, no act)
+    parent = parent.at[bidx, child_safe].set(
+        jnp.where(valid, parent_e, 0), mode="drop")
+    # index of v within its parent's list = 1 + #earlier edges with the
+    # same parent
+    same_parent = (parent_e[:, None, :] == parent_e[:, :, None]) & \
+        valid[:, None, :] & valid[:, :, None]
+    earlier = jnp.tril(jnp.ones((E, E), bool), k=-1)[None]
+    index_e = 1 + jnp.sum(same_parent & earlier, axis=2)  # [B, E]
+    pclen_e = jnp.sum(same_parent, axis=2)                # [B, E]
+    index = jnp.zeros((B, N + 1), jnp.int32).at[bidx, child_safe].set(
+        jnp.where(valid, index_e, 0), mode="drop")
+    pclen = jnp.zeros((B, N + 1), jnp.int32).at[bidx, child_safe].set(
+        jnp.where(valid, pclen_e, 0), mode="drop")
+
+    # node_count: nodes 1..node_count have patches (reference:
+    # #valid edges + 1)
+    node_count = jnp.sum(valid, axis=1) + 1  # [B]
+    node_ids = jnp.arange(1, N + 1)[None, :]  # [B, N] candidate v
+    exists = node_ids <= node_count[:, None]
+
+    def etas(idx, pcl, depth):
+        idx = idx.astype(jnp.float32)
+        pcl = pcl.astype(jnp.float32)
+        eta_t = jnp.full_like(idx, (D - depth) / D)
+        temp = jnp.where(pcl == 1, 0.5,
+                         (idx - 1.0) / jnp.maximum(pcl - 1.0, 1.0))
+        eta_l = (1.0 - eta_t) * temp
+        eta_r = (1.0 - eta_t) * (1.0 - eta_l)
+        return eta_l, eta_r, eta_t
+
+    feats = nodes  # features[id-1] = nodes[:, id-1]
+    patch = jnp.zeros((B, N, 3, F), nodes.dtype)
+    anc = node_ids  # ancestor at distance k (1-based; 0 = none)
+    for k in range(max_depth):
+        if k == 0:
+            el, er, et = etas(jnp.ones_like(node_ids),
+                              jnp.ones_like(node_ids), 0.0)
+        else:
+            anc = jnp.where(anc > 0,
+                            jnp.take_along_axis(
+                                parent, jnp.maximum(anc, 0), axis=1), 0)
+            el, er, et = etas(
+                jnp.take_along_axis(index, node_ids, axis=1),
+                jnp.take_along_axis(pclen, node_ids, axis=1), float(k))
+        contrib_ok = (anc > 0) & exists
+        w = jnp.stack([el, er, et], axis=-1).astype(nodes.dtype)  # [B,N,3]
+        vals = jnp.where(contrib_ok[..., None, None],
+                         w[..., :, None] * feats[:, :, None, :], 0.0)
+        rows = jnp.where(contrib_ok, anc - 1, N)  # N = dropped
+        patch = patch.at[bidx, rows].add(vals, mode="drop")
+    # patch slots interleave per feature as (l, r, t) — i*3 + slot — and
+    # W flattens [F, 3] row-major the same way, so einsum over (f, slot)
+    out = jnp.einsum("bnsf,fsom->bnom", patch, filt)
+    out = jnp.where(exists[:, :, None, None], out, 0.0)
     return out.reshape(B, N, -1)
 
 

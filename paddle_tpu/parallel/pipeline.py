@@ -91,7 +91,7 @@ def gpipe_spmd(stage_fn, n_stages, n_micro, axis="pp"):
 
 def make_pipeline_step(stage_fn, loss_fn, mesh, n_micro, axis="pp",
                        optimizer=None, embed_fn=None, n_chunks=1,
-                       data_axis=None):
+                       data_axis=None, reduce_grad_axes=()):
     """Jitted stage-sharded GPipe train step.
 
     stage_fn(params, h) -> h'      one stage (params = that stage's slice)
@@ -174,6 +174,19 @@ def make_pipeline_step(stage_fn, loss_fn, mesh, n_micro, axis="pp",
             grads = jax.tree_util.tree_map(
                 lambda g: lax.pmean(g, data_axis), grads)
             loss = lax.pmean(loss, data_axis)
+        for ax in reduce_grad_axes:
+            # composed tp inside a stage (3-axis dp x tp x pp): each
+            # model rank holds its shard's scatter of the param grads,
+            # and because the stage's activations/cotangents are
+            # replicated over the axis, every covered element carries an
+            # extra axis-size factor (the collective's transpose sums
+            # identical per-rank cotangents).  pmean both combines the
+            # disjoint shards and cancels that factor — EXACT for stage
+            # fns whose params are all consumed in sliced form BEFORE the
+            # output collective (column-parallel w AND b, like
+            # tests/test_composed_parallelism.py test_three_axis_mesh)
+            grads = jax.tree_util.tree_map(
+                lambda g: lax.pmean(g, ax), grads)
         if optimizer is not None:
             new_params = jax.tree_util.tree_map(optimizer, params_local,
                                                 grads)

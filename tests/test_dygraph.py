@@ -328,8 +328,9 @@ class TestDygraphNnTail:
             assert abs(s[0] - 1.0) < 0.2
             tc = dnn.TreeConv("tc", output_size=5, num_filters=2)
             nodes = dygraph.to_variable(rng.rand(1, 6, 4).astype("f"))
-            edges = dygraph.to_variable(
-                rng.randint(0, 6, (1, 5, 2)).astype("int64"))
+            # 1-based tree edges (r5 reference Tree2Col convention)
+            edges = dygraph.to_variable(np.array(
+                [[[1, 2], [1, 3], [2, 4], [3, 5], [3, 6]]], "int64"))
             out = tc(nodes, edges)
             assert np.asarray(out.numpy()).ndim >= 2
 
@@ -404,8 +405,8 @@ class TestDygraphNnTailFixes:
             tc = dnn.TreeConv("tc1", output_size=5, num_filters=2,
                               bias_attr=False)
             nodes = dygraph.to_variable(rng.rand(1, 6, 4).astype("f"))
-            edges = dygraph.to_variable(
-                rng.randint(0, 6, (1, 5, 2)).astype("int64"))
+            edges = dygraph.to_variable(np.array(
+                [[[1, 2], [1, 3], [2, 4], [3, 5], [3, 6]]], "int64"))
             out = np.asarray(tc(nodes, edges).numpy())
             # |tanh| < 1 strictly, and the raw conv (pre-tanh) regularly
             # exceeds 1 for these magnitudes — double-tanh would compress

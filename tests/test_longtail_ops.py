@@ -370,7 +370,9 @@ class TestStructuredConvs:
                             outputs={"Out": [out.name]},
                             attrs={"max_depth": 2})
         exe = fluid.Executor(fluid.CPUPlace())
-        ed = np.array([[[0, 1], [0, 2], [1, 3], [1, 4]]], "int64")
+        # 1-based parent->child pairs (r5: the reference Tree2Col convention;
+        # a pair containing 0 terminates the edge list)
+        ed = np.array([[[1, 2], [1, 3], [2, 4], [2, 5]]], "int64")
         with fluid.scope_guard(fluid.Scope()):
             exe.run(startup)
             got, = exe.run(main, feed={"nodes": _rand(1, 5, 4, seed=33),

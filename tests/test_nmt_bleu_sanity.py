@@ -1,0 +1,121 @@
+"""BASELINE config-4 gate: "bucketing/masking path correct vs ragged
+reference" + BLEU sanity (VERDICT r4 weak #7: the gate was never
+recorded as a test).
+
+Trains the transformer NMT model on a deterministic toy translation
+(copy-with-shift over variable-length sequences, padded exactly the way
+the reference's ragged LoD batches pad), then beam-decodes and checks
+corpus BLEU against the references — the config can now pass or fail.
+"""
+
+import math
+from collections import Counter
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.models import transformer
+
+# the module's conventions (transformer.py:17): BOS=0 seeds decode
+# prefixes, EOS=1 is the end/pad token the masks and beam stops key on
+from paddle_tpu.models.transformer import BOS, EOS  # noqa: E402 (0, 1)
+
+
+def _corpus_bleu(cands, refs, max_n=4):
+    """Standard corpus BLEU with brevity penalty (independent
+    implementation; no external deps)."""
+    p_logs = []
+    for n in range(1, max_n + 1):
+        match, total = 0, 0
+        for c, r in zip(cands, refs):
+            c_ngrams = Counter(tuple(c[i:i + n])
+                               for i in range(len(c) - n + 1))
+            r_ngrams = Counter(tuple(r[i:i + n])
+                               for i in range(len(r) - n + 1))
+            match += sum(min(v, r_ngrams[k]) for k, v in c_ngrams.items())
+            total += max(len(c) - n + 1, 0)
+        if total == 0 or match == 0:
+            return 0.0
+        p_logs.append(math.log(match / total))
+    c_len = sum(len(c) for c in cands)
+    r_len = sum(len(r) for r in refs)
+    bp = 1.0 if c_len > r_len else math.exp(1 - r_len / max(c_len, 1))
+    return bp * math.exp(sum(p_logs) / max_n)
+
+
+def _toy_pair(rng, vocab, max_len):
+    """Variable-length 'translation': target = source tokens + 1, i.e. a
+    deterministic mapping a seq2seq model can learn."""
+    n = rng.randint(2, max_len - 1)
+    src = rng.randint(3, vocab - 1, n)
+    trg = src + 1
+    return src.tolist(), trg.tolist()
+
+
+def _pad_batch(pairs, src_len, trg_len):
+    B = len(pairs)
+    src = np.zeros((B, src_len), "int64")
+    trg_in = np.zeros((B, trg_len), "int64")
+    trg_next = np.zeros((B, trg_len), "int64")
+    w = np.zeros((B, trg_len), "float32")
+    src[:] = EOS
+    trg_in[:] = EOS
+    trg_next[:] = EOS
+    for i, (s, t) in enumerate(pairs):
+        src[i, :len(s)] = s  # EOS padding, like the ragged reference
+        trg_in[i, 0] = BOS
+        trg_in[i, 1:len(t) + 1] = t[:trg_len - 1]
+        trg_next[i, :len(t)] = t
+        trg_next[i, len(t)] = EOS
+        w[i, :len(t) + 1] = 1.0
+    return {"src_ids": src, "trg_ids": trg_in, "trg_next": trg_next,
+            "trg_weight": w}
+
+
+def test_nmt_trains_to_bleu_on_toy_translation():
+    vocab, src_len, trg_len = 32, 10, 10
+    cfg = transformer.TransformerConfig(
+        src_vocab=vocab, trg_vocab=vocab, d_model=32, heads=4,
+        enc_layers=1, dec_layers=1, ffn=64, max_len=16, dropout=0.0,
+        label_smooth=0.0)
+    rng = np.random.RandomState(0)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        feeds, loss = transformer.build_train(cfg, src_len, trg_len,
+                                              lr=1.0, warmup=200)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        first = None
+        for step in range(600):
+            pairs = [_toy_pair(rng, vocab, src_len) for _ in range(16)]
+            feed = _pad_batch(pairs, src_len, trg_len)
+            lo, = exe.run(main, feed=feed, fetch_list=[loss])
+            if first is None:
+                first = float(lo[0])
+        final = float(lo[0])
+        assert final < 0.2, (first, final)
+
+        # beam decode unseen sentences and score BLEU (the reference's
+        # beam_search/beam_search_decode path; config-4 gate)
+        infer_prog = fluid.Program()
+        with fluid.program_guard(infer_prog):
+            src_v, ids_v, scores_v = transformer.build_beam_infer(
+                cfg, src_len, beam_size=2, max_out_len=trg_len)
+        pairs = [_toy_pair(rng, vocab, src_len) for _ in range(12)]
+        src = np.full((len(pairs), src_len), EOS, "int64")
+        for i, (s, _) in enumerate(pairs):
+            src[i, :len(s)] = s
+        out_ids, = exe.run(infer_prog, feed={src_v.name: src},
+                           fetch_list=[ids_v])
+        cands = []
+        for i in range(len(pairs)):
+            best = np.asarray(out_ids)[i, 0]
+            toks = [int(t) for t in best if t not in (BOS, EOS)]
+            cands.append(toks)
+        refs = [t for (_, t) in pairs]
+        bleu = _corpus_bleu(cands, refs)
+        # deterministic toy mapping: a correct bucketing/masking path
+        # learns it essentially perfectly; BLEU > 0.5 is a loose floor
+        assert bleu > 0.5, (bleu, cands[:2], refs[:2])
