@@ -83,7 +83,9 @@ def softmax_with_cross_entropy(ctx, logits, label, soft_label=False,
     softmax = jnp.exp(logp)
     if soft_label:
         loss = -jnp.sum(label * logp, axis=ax, keepdims=True)
-        return softmax, loss
+        # same Softmax-output contract as the hard path: the reference
+        # grad op drops Softmax@GRAD in both label modes
+        return jax.lax.stop_gradient(softmax), loss
     # hard labels: custom vjp whose only large residual is the softmax in
     # the logits' CARRY dtype (f32 stays f32; bf16 halves the ~600 MB
     # MLM-head residual).  The Softmax output is the reference's
@@ -121,7 +123,12 @@ def cross_entropy(ctx, x, label, soft_label=False, ignore_index=-100):
 def cross_entropy2(ctx, x, label, ignore_index=-100):
     logp = jnp.log(jnp.clip(x, 1e-20, None))
     picked = _take_label(logp, label, x.ndim - 1)
-    return -picked, None, jnp.exp(picked)
+    lab = (label if label.ndim == picked.ndim
+           else jnp.expand_dims(label, -1))
+    ignored = lab == ignore_index
+    # masked rows: loss 0, MatchX 1 (the reference's ignored-row fill)
+    return (jnp.where(ignored, 0.0, -picked), None,
+            jnp.where(ignored, 1.0, jnp.exp(picked)))
 
 
 @register_op(
