@@ -54,13 +54,16 @@ class QuantizeTranspiler(object):
                            if startup_program is None else startup_program)
         # the older API's abs_max defaults map onto the pass's
         # quantize-type knobs; weights quantize per-tensor here (the
-        # reference transpiler has no channel-wise mode)
+        # reference transpiler has no channel-wise mode).  The weight type
+        # must be the CONSTRUCTOR's — freeze_program uses the same field,
+        # and training under abs_max while freezing under range_abs_max
+        # would silently produce an inconsistent train/freeze pair
         pass_ = QuantizationTransformPass(
             weight_bits=self.weight_bits,
             activation_bits=self.activation_bits,
             moving_rate=self.moving_rate,
             activation_quantize_type=self.activation_quantize_type,
-            weight_quantize_type="abs_max")
+            weight_quantize_type=self.weight_quantize_type)
         return pass_.apply(program, startup_program, is_test=False)
 
     def freeze_program(self, program, place, scope=None):

@@ -6,8 +6,11 @@ The reference rewrites an ir::Graph; here the pass rewrites the Program's
 op list directly: for every quantizable op (mul/matmul/conv2d/
 depthwise_conv2d), the activation input is routed through a
 fake_quantize_moving_average_abs_max op and the weight input through
-fake_channel_wise_quantize_abs_max — forward simulates int8, backward is
-straight-through, weights stay float (QAT).
+fake_channel_wise_quantize_abs_max (weight_quantize_type=
+"channel_wise_abs_max", the default) or per-tensor fake_quantize_abs_max
+(any other weight type — QuantizeTranspiler's "abs_max" lands here) —
+forward simulates int8, backward is straight-through, weights stay float
+(QAT).
 """
 
 from ....framework import OP_ROLE_KEY, OpRole
@@ -27,6 +30,10 @@ class QuantizationTransformPass:
         self._activation_bits = activation_bits
         self._moving_rate = moving_rate
         self._ops = tuple(quantizable_op_type)
+        # "channel_wise_abs_max" keeps a scale per output channel; anything
+        # else quantizes weights per-tensor (weights are re-read each step,
+        # so the range/moving-average variants reduce to abs_max for them)
+        self._weight_quantize_type = weight_quantize_type
 
     def apply(self, program, startup_program=None, is_test=False):
         """Insert fake-quant ops in front of every quantizable op's inputs.
@@ -59,12 +66,23 @@ class QuantizationTransformPass:
                         if is_weight:
                             scale = block.create_var(
                                 name=qname + ".scale", dtype="float32")
-                            qop = _make_op(
-                                block, "fake_channel_wise_quantize_abs_max",
-                                {"X": [name]},
-                                {"Out": [qname], "OutScale": [scale.name]},
-                                {"bit_length": self._weight_bits,
-                                 "quant_axis": 0})
+                            if (self._weight_quantize_type
+                                    == "channel_wise_abs_max"):
+                                qop = _make_op(
+                                    block,
+                                    "fake_channel_wise_quantize_abs_max",
+                                    {"X": [name]},
+                                    {"Out": [qname],
+                                     "OutScale": [scale.name]},
+                                    {"bit_length": self._weight_bits,
+                                     "quant_axis": 0})
+                            else:
+                                qop = _make_op(
+                                    block, "fake_quantize_abs_max",
+                                    {"X": [name]},
+                                    {"Out": [qname],
+                                     "OutScale": [scale.name]},
+                                    {"bit_length": self._weight_bits})
                         else:
                             def mkstate(suffix, init):
                                 sv = block.create_var(

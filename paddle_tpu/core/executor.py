@@ -499,11 +499,13 @@ class Executor:
     # -- dataset/trainer entry points (C++ trainer path analog) --------------
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
-                           fetch_info=None, print_period=100):
+                           fetch_info=None, print_period=100,
+                           checkpoint_manager=None):
         from ..trainer import train_from_dataset
 
         return train_from_dataset(self, program, dataset, scope, thread,
-                                  fetch_list, fetch_info, print_period)
+                                  fetch_list, fetch_info, print_period,
+                                  checkpoint_manager=checkpoint_manager)
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,

@@ -8,13 +8,23 @@ PADDLE_TRAINER_ENDPOINTS).  Multi-host jobs additionally get
 PADDLE_COORDINATOR for jax.distributed.initialize.
 
 Usage: python -m paddle_tpu.distributed.launch [--started_port P]
-           [--cluster_node_ips ip1,ip2] [--node_ip ip] training_script args...
+           [--cluster_node_ips ip1,ip2] [--node_ip ip] [--restart_failed N]
+           training_script args...
+
+Supervision: ``--restart_failed N`` relaunches the training script up to N
+times after a nonzero exit (including death by signal — a SIGKILLed trainer
+comes back).  Each incarnation sees PADDLE_RESTART_COUNT in its env (0 for
+the first launch), so training scripts can resume from
+io.CheckpointManager.latest_valid() instead of step 0 and fault-injection
+specs can disarm themselves after the first life.
 """
 
 import argparse
+import logging
 import os
 import subprocess
 import sys
+import time
 
 __all__ = ["launch", "init_multihost"]
 
@@ -30,6 +40,17 @@ def _parse_args(argv=None):
     parser.add_argument("--selected_gpus", type=str, default=None,
                         help="compat alias, ignored")
     parser.add_argument("--log_dir", type=str, default=None)
+    parser.add_argument("--restart_failed", type=int, default=0,
+                        help="supervised relaunch: restart the script up "
+                             "to N times after a nonzero exit/signal death")
+    parser.add_argument("--restart_delay", type=float, default=1.0,
+                        help="seconds between supervised relaunches")
+    parser.add_argument("--trainer_id", type=int, default=None,
+                        help="override the node-ip-derived trainer id "
+                             "(single-node multi-process clusters)")
+    parser.add_argument("--trainers_num", type=int, default=None,
+                        help="override the cluster size when launching "
+                             "one member of a larger local cluster")
     parser.add_argument("training_script", type=str)
     parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return parser.parse_args(argv)
@@ -38,22 +59,44 @@ def _parse_args(argv=None):
 def launch(args=None):
     args = args or _parse_args()
     node_ips = [ip.strip() for ip in args.cluster_node_ips.split(",")]
-    node_id = node_ips.index(args.node_ip) if args.node_ip in node_ips else 0
-    endpoints = ["%s:%d" % (ip, args.started_port) for ip in node_ips]
+    trainers = (args.trainers_num if args.trainers_num is not None
+                else len(node_ips))
+    if trainers == len(node_ips):
+        endpoints = ["%s:%d" % (ip, args.started_port) for ip in node_ips]
+    else:
+        # single-node multi-process: one endpoint per trainer on node_ip
+        endpoints = ["%s:%d" % (args.node_ip, args.started_port + i)
+                     for i in range(trainers)]
+    if args.trainer_id is not None:
+        node_id = args.trainer_id
+    else:
+        node_id = (node_ips.index(args.node_ip)
+                   if args.node_ip in node_ips else 0)
 
     env = dict(os.environ)
     env.update({
         "PADDLE_TRAINER_ID": str(node_id),
         "PADDLE_CURRENT_ENDPOINT": endpoints[node_id],
-        "PADDLE_TRAINERS_NUM": str(len(node_ips)),
+        "PADDLE_TRAINERS_NUM": str(trainers),
         "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
         "PADDLE_COORDINATOR": endpoints[0],
     })
-    cmd = [sys.executable, "-u", args.training_script] + args.training_script_args
-    proc = subprocess.Popen(cmd, env=env)
-    proc.wait()
-    if proc.returncode != 0:
-        raise subprocess.CalledProcessError(proc.returncode, cmd)
+    cmd = ([sys.executable, "-u", args.training_script]
+           + args.training_script_args)
+    restarts = 0
+    while True:
+        env["PADDLE_RESTART_COUNT"] = str(restarts)
+        proc = subprocess.Popen(cmd, env=env)
+        proc.wait()
+        if proc.returncode == 0:
+            return
+        if restarts >= max(args.restart_failed, 0):
+            raise subprocess.CalledProcessError(proc.returncode, cmd)
+        restarts += 1
+        logging.warning(
+            "training script exited with %s — supervised relaunch %d/%d",
+            proc.returncode, restarts, args.restart_failed)
+        time.sleep(max(args.restart_delay, 0.0))
 
 
 def init_multihost():

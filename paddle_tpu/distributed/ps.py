@@ -23,13 +23,37 @@ Two consistency modes, selected by the transpiler's sync_mode:
   AsyncCommunicator / RunAsyncLoop, communicator.h:285).  LR-schedule ops
   advance once per logical step (every owned*trainers arrivals), not per
   arrival.
+
+Fault tolerance (this layer owns the at-most-once + liveness contracts; the
+transport's retry/backoff lives in native/rpc.py):
+
+- Dedupe-by-sequence: every trainer frame that MUTATES server state (grad
+  sends, geo deltas, send-barriers) is tagged ``base@@s<tid>:<nonce>:<seq>``
+  with a per-client monotonically increasing seq.  An RPC retry after an
+  ACK-lost transport failure replays the frame under the SAME tag, so the
+  server applies each logical send at most once (_ReplayFilter).  The nonce
+  is drawn fresh per trainer incarnation so a relaunched trainer (seq back
+  at 0) is not mistaken for a replay.  Heartbeats/byes stay untagged —
+  they are idempotent.
+- Eviction / re-quorum (sync mode): the HeartBeatMonitor's checker thread
+  EVICTS trainers silent longer than FLAGS_worker_hb_timeout, delivering
+  the eviction as a ``__evict__<tid>`` self-RPC so it wakes the round loop
+  even when it is parked in poll().  A round's barrier quorum is the LIVE
+  set (all - completed - evicted), so rounds keep flowing on survivors.
+  Any later contact from an evicted trainer re-admits it.
+- Rejoin: the current round number is published under ``__round__`` and the
+  last TWO param versions stay available, so a supervised relaunch
+  (distributed/launch.py --restart_failed) can sync its round counter and
+  pull a live version despite racing the round it missed.
 """
 
 import collections
+import logging
 
 import numpy as np
 
 from ..native.rpc import RpcClient, RpcServer, EV_BARRIER, EV_COMPLETE, EV_SEND
+from ..utils.fault_injection import maybe_fail
 
 __all__ = ["run_pserver", "TrainerPSComm", "HeartBeatMonitor"]
 
@@ -46,6 +70,44 @@ def _vkey(name, version):
 
 _HB_PREFIX = "__hb__"
 _HB_BYE_PREFIX = "__hb_bye__"
+_EVICT_PREFIX = "__evict__"
+_ROUND_KEY = "__round__"
+
+_SEQ_SEP = "@@s"
+
+
+def _untag(name):
+    """Split ``base@@s<tid>:<nonce>:<seq>`` -> (base, tid, nonce, seq);
+    untagged names come back as (name, None, 0, 0)."""
+    i = name.rfind(_SEQ_SEP)
+    if i < 0:
+        return name, None, 0, 0
+    try:
+        tid_s, nonce_s, seq_s = name[i + len(_SEQ_SEP):].split(":")
+        return name[:i], int(tid_s), int(nonce_s), int(seq_s)
+    except ValueError:
+        return name, None, 0, 0
+
+
+class _ReplayFilter:
+    """At-most-once filter for tagged trainer frames.  A retry after an
+    ACK-lost failure replays the frame under its original tag, and frames
+    from one client arrive in send order (sequential client, ordered
+    connection), so a frame is a replay iff its seq is <= the last seq seen
+    for that (tid, nonce).  A different nonce is a new trainer incarnation:
+    accept and re-key."""
+
+    def __init__(self):
+        self._last = {}   # tid -> (nonce, last_seq)
+
+    def fresh(self, tid, nonce, seq):
+        if tid is None:
+            return True
+        cur = self._last.get(tid)
+        if cur is not None and cur[0] == nonce and seq <= cur[1]:
+            return False
+        self._last[tid] = (nonce, seq)
+        return True
 
 
 def _handle_hb(monitor, name):
@@ -76,14 +138,37 @@ def run_pserver(exe, program, scope):
     server.serve(True)
     completed = [0]
     monitor = HeartBeatMonitor(trainers, name="ps:%s" % endpoint)
+    # sync mode graduates the monitor from logging to EVICTION: the round
+    # loop re-quorums on survivors.  Async eviction is an open item
+    # (ROADMAP) — there a dead trainer cannot deadlock a barrier anyway.
+    evict_enabled = bool(meta.get("sync", True)) and not meta.get("geo", False)
     # dedicated checker thread (heart_beat_monitor.h runs the monitor in its
     # own thread): a dead trainer in sync mode leaves the server blocked in
-    # poll(), so arrival-driven checks alone would never fire
+    # poll(), so arrival-driven checks alone would never fire.  Evictions
+    # are delivered as __evict__ self-RPCs for the same reason — only an
+    # inbound event can wake the round loop.
     _mon_stop = __import__("threading").Event()
 
     def _mon_loop():
-        while not _mon_stop.wait(max(monitor.timeout_s / 2, 0.5)):
-            monitor.check()
+        evict_client = [None]
+        tick = max(min(monitor.timeout_s / 2.0, 5.0), 0.25)
+        while not _mon_stop.wait(tick):
+            dead = monitor.check()
+            if not dead or not evict_enabled or _mon_stop.is_set():
+                continue
+            try:
+                if evict_client[0] is None:
+                    evict_client[0] = RpcClient(
+                        "127.0.0.1:%d" % server.port, connect_timeout=5.0,
+                        rpc_deadline=5.0, retry_times=0)
+                for w in dead:
+                    evict_client[0].send_var(_EVICT_PREFIX + str(w),
+                                             np.asarray([w], np.int64))
+            except Exception:
+                # server busy/shutting down — drop the tick, reconnect next
+                evict_client[0] = None
+        if evict_client[0] is not None:
+            evict_client[0].close()
 
     if not meta.get("geo", False):
         # geo trainers push only sparse param deltas (no heartbeats), so
@@ -95,37 +180,112 @@ def run_pserver(exe, program, scope):
             server.set_var(
                 _vkey(p, version),
                 np.asarray(scope.find_var(p).get_tensor().numpy()))
-            if version > 0:
-                server.del_var(_vkey(p, version - 1))
-
-    def collect_round(grads):
-        """Consume events until every LIVE trainer's send-barrier arrives;
-        SEND events land in grad buckets.  A COMPLETE decrements the round
-        fanin (the reference decrements the barrier counter on SendComplete
-        so stragglers don't deadlock).  False => all trainers done."""
-        seen = 0
-        while seen < trainers - completed[0]:
-            t, name, arr = server.poll()
-            if t == 0:
-                return False
-            if t == EV_COMPLETE:
-                completed[0] += 1
-                if completed[0] >= trainers:
-                    return False
-            elif t == EV_BARRIER and name == "send":
-                seen += 1
-            elif t == EV_SEND:
-                if not _handle_hb(monitor, name):
-                    grads[name].append(arr)
-        return True
+            if version > 1:
+                # keep the last TWO versions: a relaunched trainer that just
+                # read __round__ == version-1 must still be able to pull it
+                # even if this publish races its GETs
+                server.del_var(_vkey(p, version - 2))
+        # rejoin protocol: relaunched trainers read the round counter to
+        # sync TrainerPSComm._round before their first pull
+        server.set_var(_ROUND_KEY, np.asarray([version], np.int64))
 
     def run_sync():
+        import time as _time
+
         publish(0)  # pserver startup already ran: serve initial params
         version = 0
-        while True:
-            grads = collections.defaultdict(list)
-            if not collect_round(grads):
+        replay = _ReplayFilter()
+        evicted = set()
+        done = set()          # tids that sent __hb_bye__ (clean exit)
+        idle_since = [None]   # wall clock when the live set went empty
+
+        def contact(tid):
+            """Any frame from a trainer proves liveness and re-admits it."""
+            if tid is None or tid in done:
                 return
+            monitor.update(tid)
+            idle_since[0] = None
+            if tid in evicted:
+                evicted.discard(tid)
+                logging.warning("[ps:%s] re-admitted trainer %d",
+                                endpoint, tid)
+
+        while True:
+            round_fault = maybe_fail("ps.round")
+            if round_fault == "error":
+                raise RuntimeError(
+                    "injected pserver failure at round %d" % version)
+            grads = collections.defaultdict(list)
+            barrier_tids = set()
+            anon_barriers = [0]   # untagged barriers (raw clients)
+            while True:
+                live = set(range(trainers)) - done - evicted
+                if live and len(barrier_tids & live) + anon_barriers[0] \
+                        >= len(live):
+                    break
+                if not live:
+                    # every tracked trainer is done or evicted
+                    if completed[0] >= trainers or not evicted:
+                        return
+                    # supervised relaunch may bring evicted trainers back:
+                    # linger for a grace window (woken by the monitor's
+                    # periodic __evict__ ticks) before giving up on them
+                    now = _time.time()
+                    if idle_since[0] is None:
+                        idle_since[0] = now
+                    elif now - idle_since[0] > 2.0 * monitor.timeout_s:
+                        logging.warning(
+                            "[ps:%s] all live trainers gone for %.0fs "
+                            "(evicted: %s) — shutting down round loop",
+                            endpoint, now - idle_since[0], sorted(evicted))
+                        return
+                t, name, arr = server.poll()
+                if t == 0:
+                    return
+                if t == EV_COMPLETE:
+                    completed[0] += 1
+                    if completed[0] >= trainers:
+                        return
+                    continue
+                base, tid, nonce, seq = _untag(name)
+                if t == EV_BARRIER:
+                    if base != "send":
+                        continue
+                    contact(tid)
+                    if not replay.fresh(tid, nonce, seq):
+                        continue
+                    if tid is None:
+                        anon_barriers[0] += 1
+                    else:
+                        barrier_tids.add(tid)
+                    continue
+                if t != EV_SEND:
+                    continue
+                if base.startswith(_HB_BYE_PREFIX):
+                    w = int(base[len(_HB_BYE_PREFIX):])
+                    done.add(w)
+                    evicted.discard(w)
+                    monitor.remove(w)
+                    continue
+                if base.startswith(_HB_PREFIX):
+                    contact(int(base[len(_HB_PREFIX):]))
+                    continue
+                if base.startswith(_EVICT_PREFIX):
+                    w = int(base[len(_EVICT_PREFIX):])
+                    if w not in done and w not in evicted:
+                        evicted.add(w)
+                        logging.warning(
+                            "[ps:%s] evicting silent trainer %d — round "
+                            "re-quorums on survivors", endpoint, w)
+                    continue
+                contact(tid)
+                if not replay.fresh(tid, nonce, seq):
+                    continue
+                grads[base].append(arr)
+            if round_fault == "drop":
+                # injected round drop: lose the round's gradients; params
+                # republish unchanged so trainers still make progress
+                grads.clear()
             feed = {}
             for gname, parts in grads.items():
                 if gname not in grad_to_param:
@@ -134,8 +294,9 @@ def run_pserver(exe, program, scope):
                 for p in parts[1:]:
                     agg = agg + p
                 feed[gname] = (agg / max(len(parts), 1)).astype(parts[0].dtype)
-            with scope_guard(scope):
-                exe.run(opt_prog, feed=feed, fetch_list=[])
+            if feed:
+                with scope_guard(scope):
+                    exe.run(opt_prog, feed=feed, fetch_list=[])
             version += 1
             publish(version)
 
@@ -148,6 +309,7 @@ def run_pserver(exe, program, scope):
         lr_prog = meta.get("lr_program")
         arrivals = [0]
         per_step = max(len(params) * trainers, 1)
+        replay = _ReplayFilter()
 
         def publish_async(p):
             server.set_var(
@@ -164,12 +326,20 @@ def run_pserver(exe, program, scope):
                 completed[0] += 1
                 if completed[0] >= trainers:
                     return
-            elif t == EV_SEND and _handle_hb(monitor, name):
-                pass
-            elif t == EV_SEND and name in grad_to_param:
-                pname = grad_to_param[name]
+                continue
+            if t != EV_SEND:
+                continue
+            base, tid, nonce, seq = _untag(name)
+            if _handle_hb(monitor, base):
+                continue
+            if base.startswith(_EVICT_PREFIX):
+                continue
+            if base in grad_to_param:
+                if not replay.fresh(tid, nonce, seq):
+                    continue  # replayed send: already applied this grad
+                pname = grad_to_param[base]
                 with scope_guard(scope):
-                    exe.run(per_param[pname], feed={name: arr},
+                    exe.run(per_param[pname], feed={base: arr},
                             fetch_list=[])
                     arrivals[0] += 1
                     if (lr_prog is not None
@@ -182,7 +352,10 @@ def run_pserver(exe, program, scope):
         """Geo-SGD (reference geo_sgd_transpiler.py + GeoSgdCommunicator,
         communicator.h:332): trainers optimize locally and push param
         DELTAS; the server adds each delta to its copy and republishes —
-        no optimizer runs server-side."""
+        no optimizer runs server-side.  Deltas are NOT idempotent (the
+        server accumulates them), so dedupe matters doubly here."""
+        replay = _ReplayFilter()
+
         def publish_geo(p):
             server.set_var(
                 _vkey(p, -1),
@@ -199,10 +372,16 @@ def run_pserver(exe, program, scope):
                 completed[0] += 1
                 if completed[0] >= trainers:
                     return
-            elif t == EV_SEND and name in param_set:
-                cur = np.asarray(scope.find_var(name).get_tensor().numpy())
-                scope.var(name).set(cur + arr)
-                publish_geo(name)
+                continue
+            if t != EV_SEND:
+                continue
+            base, tid, nonce, seq = _untag(name)
+            if base in param_set:
+                if not replay.fresh(tid, nonce, seq):
+                    continue  # replayed delta would double-apply
+                cur = np.asarray(scope.find_var(base).get_tensor().numpy())
+                scope.var(base).set(cur + arr)
+                publish_geo(base)
 
     with _LIVE_LOCK:
         _LIVE_SERVERS.add(id(server))
@@ -224,6 +403,8 @@ class TrainerPSComm:
     """Per-trainer connections to every pserver + the sync-step protocol."""
 
     def __init__(self, meta):
+        import random
+
         self.meta = meta
         self.endpoints = meta["endpoints"]
         self.param_to_ep = meta["param_to_ep"]
@@ -237,6 +418,17 @@ class TrainerPSComm:
         self._step_count = 0
         self._snapshot = {}   # geo: param values at the last push/pull
         self._closed = False
+        # dedupe-by-sequence tag state: nonce identifies this incarnation
+        # (a relaunched trainer must not look like a replay of its previous
+        # life), seq orders this incarnation's state-mutating frames
+        self._nonce = random.getrandbits(31)
+        self._seq = 0
+
+    def _tag(self, base):
+        s = self._seq
+        self._seq += 1
+        return "%s%s%d:%d:%d" % (base, _SEQ_SEP, self.trainer_id,
+                                 self._nonce, s)
 
     def _pull(self, scope, version):
         for p, ep in self.param_to_ep.items():
@@ -244,7 +436,19 @@ class TrainerPSComm:
 
     # initial param pull (reference: recv ops in the rewritten startup)
     def pull_initial_params(self, scope):
-        self._pull(scope, 0 if (self.sync and not self.geo) else -1)
+        if self.sync and not self.geo:
+            # rejoin protocol: a relaunched trainer joins at the cluster's
+            # CURRENT round, not 0.  Servers publish __round__ with every
+            # version; they stay within one round of each other (lockstep),
+            # and the laggard completes its in-flight round on the
+            # survivors' quorum, so max() is always pullable (servers keep
+            # the last two versions).
+            self._round = max(
+                int(self._clients[ep].get_var(_ROUND_KEY).ravel()[0])
+                for ep in self.endpoints)
+            self._pull(scope, self._round)
+        else:
+            self._pull(scope, -1)
         if self.geo:
             self._snapshot = {
                 p: np.asarray(scope.find_var(p).get_tensor().numpy()).copy()
@@ -259,19 +463,21 @@ class TrainerPSComm:
                 "PS trainer already completed (Executor.close() was called); "
                 "create a new scope/executor to train again")
         # heartbeat: one tiny var per step so the server's HeartBeatMonitor
-        # tracks this worker's liveness (heart_beat_monitor.h UPDATE mode)
+        # tracks this worker's liveness (heart_beat_monitor.h UPDATE mode).
+        # Untagged: heartbeats are idempotent, replays are harmless.
         hb = np.asarray([self.trainer_id], np.int64)
         for c in self._clients.values():
             c.send_var(_HB_PREFIX + str(self.trainer_id), hb)
         for p, g in self.param_to_grad.items():
             if g in grad_values:
-                self._clients[self.param_to_ep[p]].send_var(g, grad_values[g])
+                self._clients[self.param_to_ep[p]].send_var(
+                    self._tag(g), grad_values[g])
         if not self.sync:
             # async (communicator.h:285): no barrier, read freshest params
             self._pull(scope, -1)
             return
         for c in self._clients.values():
-            c.barrier("send")
+            c.barrier(self._tag("send"))
         self._round += 1
         self._pull(scope, self._round)  # blocks until every trainer's round
         # arrived and the optimizer ran — the sync point
@@ -287,7 +493,7 @@ class TrainerPSComm:
         for p, ep in self.param_to_ep.items():
             cur = np.asarray(scope.find_var(p).get_tensor().numpy())
             delta = cur - self._snapshot[p]
-            self._clients[ep].send_var(p, delta)
+            self._clients[ep].send_var(self._tag(p), delta)
         self._pull(scope, -1)
         for p in self.param_to_ep:
             self._snapshot[p] = np.asarray(
@@ -324,21 +530,35 @@ class TrainerPSComm:
 class HeartBeatMonitor:
     """Pserver-side worker liveness tracking (parity:
     operators/distributed/heart_beat_monitor.h:54): records each worker's
-    last-contact timestamp; `check` logs workers silent for longer than
-    `timeout_s`.  The reference runs this only in UPDATE mode and only
-    logs — no eviction — and so do we."""
+    last-contact timestamp; `check` returns (and logs once) workers silent
+    for longer than `timeout_s`.  The reference runs this only in UPDATE
+    mode and only LOGS; here run_pserver's checker thread turns the dead
+    list into sync-quorum EVICTIONS (see module docstring) — the monitor
+    itself stays a passive bookkeeper.
 
-    def __init__(self, n_workers, timeout_s=60.0, name="ps"):
+    timeout_s=None reads FLAGS_worker_hb_timeout.  Workers are seeded at
+    construction + startup_grace_s (default: one extra timeout) so a
+    worker that dies before its first heartbeat IS eventually caught, but
+    a slow start (process spawn + jax import can take tens of seconds)
+    is not mistaken for death."""
+
+    def __init__(self, n_workers, timeout_s=None, name="ps",
+                 startup_grace_s=None):
         import time
 
+        if timeout_s is None:
+            from .. import flags as _flags
+
+            timeout_s = float(_flags.flag("worker_hb_timeout") or 60.0)
         self._time = time.time
         self.n_workers = n_workers
         self.timeout_s = timeout_s
+        self.startup_grace_s = (timeout_s if startup_grace_s is None
+                                else startup_grace_s)
         self.name = name
-        # seed every worker at construction (heart_beat_monitor.h does the
-        # same) so a worker that dies before its first heartbeat is caught
         now = self._time()
-        self._last_seen = {w: now for w in range(n_workers)}
+        self._last_seen = {w: now + self.startup_grace_s
+                           for w in range(n_workers)}
         self._warned = set()
         self._lock = __import__("threading").Lock()
 
@@ -356,8 +576,6 @@ class HeartBeatMonitor:
     def check(self):
         """Returns the list of currently-dead worker ids (and logs new
         ones once, like the monitor thread's LOG(WARNING))."""
-        import logging
-
         now = self._time()
         with self._lock:
             dead = [(w, now - t) for w, t in self._last_seen.items()

@@ -32,6 +32,19 @@ _DEFAULTS = {
     # 180000): a pserver that hangs mid-round raises ConnectionError on
     # the trainer instead of blocking its recv() forever.  <=0 disables.
     "FLAGS_rpc_deadline": 180000,
+    # bounded reconnect-and-retry on RPC deadline/transport failures
+    # (reference FLAGS_rpc_retry_times, grpc_client.cc): each retry opens
+    # a FRESH connection (a timed-out socket may be mid-frame) after an
+    # exponential backoff with jitter.  0 restores poison-on-first-failure.
+    "FLAGS_rpc_retry_times": 3,
+    # fault-injection spec "point:kind:prob[:count[:skip]];..." checked by
+    # utils/fault_injection.maybe_fail at named runtime fault points
+    # (rpc.send, rpc.get, ps.round, ckpt.write).  Empty = disarmed.
+    "FLAGS_fault_spec": "",
+    # pserver-side worker liveness timeout in SECONDS
+    # (heart_beat_monitor.h): a trainer silent this long is EVICTED from
+    # the sync quorum (rounds re-quorum on survivors) until it re-contacts.
+    "FLAGS_worker_hb_timeout": 60.0,
     # opt-in fused Pallas LayerNorm (pallas_kernels/layer_norm.py): wins
     # standalone microbenches, measured -1.5% inside full BERT on the
     # bench chip (breaks XLA's LN-neighbor fusions) — see ops/nn.py

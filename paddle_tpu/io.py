@@ -5,17 +5,31 @@ load_vars:588, load_persistables:801, save_inference_model:1011,
 load_inference_model:1215) + the save/load ops (operators/save_op.h).
 Format: one .npz per var-set + a JSON program desc (instead of the
 reference's per-var binary streams + __model__ protobuf).
+
+Crash safety: every writer here goes through write-temp-then-atomic-rename
+(_atomic_write / LocalFS.atomic_write_dir) and checks the ``ckpt.write``
+fault point between the temp write and the rename, so a process killed
+mid-save can never leave a torn file under the final name — the previous
+checkpoint survives intact.  CheckpointManager adds the rolling-directory
+layer: save every N steps, keep the last K, and ``latest_valid()`` trusts
+only directories whose ``_SUCCESS`` manifest exists and whose content
+checksums match (parity target: the incubate fleet checkpoint utilities'
+_SUCCESS convention, python/paddle/fluid/incubate/fleet/utils/fleet_util.py).
 """
 
 import json
 import os
+import zlib
 
 import numpy as np
 
 from .core.executor import global_scope
 from .framework import Parameter, Program, Variable
+from .utils.fault_injection import maybe_fail
+from .utils.fs import LocalFS
 
 __all__ = [
+    "CheckpointManager",
     "DataLoader",
     "PyReader",
     "save_vars",
@@ -51,6 +65,21 @@ def _is_parameter(var):
     return isinstance(var, Parameter)
 
 
+def _atomic_write(path, write_fn, mode="wb"):
+    """Write via temp file + os.replace so the final `path` is only ever
+    complete or absent.  The ``ckpt.write`` fault point sits between the
+    two: an injected kill tears only the temp file (crash-safety tests)."""
+    tmp = "%s._tmp.%d" % (path, os.getpid())
+    try:
+        with open(tmp, mode) as f:
+            write_fn(f)
+        maybe_fail("ckpt.write")
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+
+
 def _gather(executor, dirname, program, predicate, filename):
     program = program or _default_main()
     scope = global_scope()
@@ -64,7 +93,7 @@ def _gather(executor, dirname, program, predicate, filename):
         out[var.name] = np.asarray(sv.get_tensor().numpy())
     os.makedirs(dirname, exist_ok=True)
     path = os.path.join(dirname, filename or "__params__.npz")
-    np.savez(path, **out)
+    _atomic_write(path, lambda f: np.savez(f, **out))
     return path
 
 
@@ -364,6 +393,145 @@ def load_train_model(dirname, executor=None):
     return main, startup, bundle["feed_names"], bundle["fetch_names"]
 
 
+# -- crash-safe rolling checkpoints ------------------------------------------
+
+_SUCCESS_NAME = "_SUCCESS"
+
+
+def _file_crc32(path, chunk=1 << 20):
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                return crc
+            crc = zlib.crc32(b, crc)
+
+
+class CheckpointManager:
+    """Rolling crash-safe checkpoints under ``ckpt_dir/ckpt-<step>``.
+
+    Each checkpoint directory is materialized through
+    LocalFS.atomic_write_dir (temp dir -> atomic rename) and carries a
+    ``_SUCCESS`` manifest — written LAST — recording the step, optional
+    user extra state, and a crc32 per file.  ``latest_valid()`` walks steps
+    descending and returns the newest directory whose manifest exists and
+    verifies, silently skipping torn/partial saves (a SIGKILL mid-save, a
+    crashed rename window, a truncated npz).  Retention keeps the newest
+    ``max_num`` checkpoints.
+
+    Typical supervised-relaunch flow (distributed/launch.py
+    --restart_failed): the trainer calls ``maybe_save`` every step; after
+    a crash the relaunched process calls ``restore`` and resumes from the
+    returned step instead of 0.
+    """
+
+    _PREFIX = "ckpt-"
+
+    def __init__(self, ckpt_dir, save_interval=10, max_num=3, fs=None):
+        if int(save_interval) < 1:
+            raise ValueError("save_interval must be >= 1")
+        if int(max_num) < 1:
+            raise ValueError("max_num must be >= 1")
+        self.ckpt_dir = ckpt_dir
+        self.save_interval = int(save_interval)
+        self.max_num = int(max_num)
+        self._fs = fs or LocalFS()
+
+    # -- enumeration --------------------------------------------------------
+
+    def _step_dirs(self):
+        """Sorted [(step, path)] of plausible checkpoint dirs (validity is
+        latest_valid's job)."""
+        out = []
+        for name in self._fs.ls_dir(self.ckpt_dir):
+            if not name.startswith(self._PREFIX):
+                continue
+            try:
+                step = int(name[len(self._PREFIX):])
+            except ValueError:
+                continue
+            out.append((step, os.path.join(self.ckpt_dir, name)))
+        return sorted(out)
+
+    def _manifest(self, path):
+        try:
+            with open(os.path.join(path, _SUCCESS_NAME)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _is_valid(self, path):
+        man = self._manifest(path)
+        if man is None:
+            return False
+        for fname, crc in man.get("files", {}).items():
+            fpath = os.path.join(path, fname)
+            try:
+                if _file_crc32(fpath) != crc:
+                    return False
+            except OSError:
+                return False
+        return True
+
+    def latest_valid(self):
+        """-> (step, path) of the newest checkpoint whose _SUCCESS manifest
+        verifies, or None when no usable checkpoint exists."""
+        for step, path in reversed(self._step_dirs()):
+            if self._is_valid(path):
+                return step, path
+        return None
+
+    # -- write side ---------------------------------------------------------
+
+    def save(self, executor, program, step, extra=None):
+        """Write checkpoint ``ckpt-<step>`` (persistables + manifest) and
+        prune beyond max_num.  Returns the checkpoint path."""
+        self._fs.mkdirs(self.ckpt_dir)
+        target = os.path.join(self.ckpt_dir, "%s%d" % (self._PREFIX, step))
+        with self._fs.atomic_write_dir(target) as tmp:
+            save_persistables(executor, tmp, program)
+            files = {
+                name: _file_crc32(os.path.join(tmp, name))
+                for name in sorted(os.listdir(tmp))
+                if name != _SUCCESS_NAME
+            }
+            manifest = {"step": int(step), "files": files}
+            if extra is not None:
+                manifest["extra"] = extra
+            # manifest last: its presence asserts every file above is
+            # complete (the _SUCCESS convention)
+            with open(os.path.join(tmp, _SUCCESS_NAME), "w") as f:
+                json.dump(manifest, f)
+        self._prune()
+        return target
+
+    def maybe_save(self, executor, program, step, extra=None):
+        """save() every save_interval steps (step counts from 1)."""
+        if step and step % self.save_interval == 0:
+            return self.save(executor, program, step, extra=extra)
+        return None
+
+    def _prune(self):
+        dirs = self._step_dirs()
+        for _, path in dirs[:-self.max_num]:
+            self._fs.delete(path)
+
+    # -- read side ----------------------------------------------------------
+
+    def restore(self, executor, program):
+        """Load the newest valid checkpoint into the global scope.
+        Returns (step, extra) — or (0, None) when nothing valid exists, so
+        callers can resume their loop unconditionally from the result."""
+        found = self.latest_valid()
+        if found is None:
+            return 0, None
+        step, path = found
+        load_persistables(executor, path, program)
+        man = self._manifest(path)
+        return step, (man or {}).get("extra")
+
+
 # -- fluid.save / fluid.load (v1.6 single-call training state) ---------------
 
 def _is_belong_to_optimizer(var):
@@ -399,18 +567,18 @@ def save(program, model_path):
 
     param_dict = {v.name: get_tensor(v)
                   for v in program.list_vars() if _is_parameter(v)}
-    with open(model_path + ".pdparams", "wb") as f:
-        pickle.dump(param_dict, f)
+    _atomic_write(model_path + ".pdparams",
+                  lambda f: pickle.dump(param_dict, f))
 
     opt_dict = {v.name: get_tensor(v)
                 for v in program.list_vars() if _is_belong_to_optimizer(v)}
     if opt_dict:  # reference: "If the optimizer have no variable ... the
         # file will not generated" (SGD has no accumulators)
-        with open(model_path + ".pdopt", "wb") as f:
-            pickle.dump(opt_dict, f)
+        _atomic_write(model_path + ".pdopt",
+                      lambda f: pickle.dump(opt_dict, f))
 
-    with open(model_path + ".pdmodel", "w") as f:
-        json.dump(program.to_dict(), f)
+    _atomic_write(model_path + ".pdmodel",
+                  lambda f: json.dump(program.to_dict(), f), mode="w")
 
 
 def _check_var_match(var_name, old_np, new_np):

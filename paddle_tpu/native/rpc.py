@@ -10,8 +10,22 @@ import ctypes
 import numpy as np
 
 from . import load
+from ..utils.fault_injection import FaultInjected, maybe_fail
 
-__all__ = ["RpcServer", "RpcClient"]
+__all__ = ["RpcServer", "RpcClient", "backoff_delay"]
+
+
+def backoff_delay(attempt, base=0.05, cap=2.0, rng=None):
+    """Exponential backoff with equal jitter for retry `attempt` (0-based):
+    uniformly in [d/2, d] where d = min(cap, base * 2**attempt) — the
+    reference client re-queues failed RPCs with a growing delay; jitter
+    keeps N trainers retrying a recovered pserver from re-arriving in
+    lockstep."""
+    import random
+
+    d = min(float(cap), float(base) * (2.0 ** attempt))
+    r = (rng or random).random()
+    return d * (0.5 + 0.5 * r)
 
 # numpy dtype <-> wire enum
 _DTYPES = ["float32", "float64", "int32", "int64", "uint8", "int8",
@@ -73,7 +87,8 @@ class RpcServer:
 
 
 class RpcClient:
-    def __init__(self, endpoint, connect_timeout=60.0, rpc_deadline=None):
+    def __init__(self, endpoint, connect_timeout=60.0, rpc_deadline=None,
+                 retry_times=None):
         """Retries until the server is up (the reference client's
         wait-for-server behavior; grpc_client.cc connect deadline).
 
@@ -84,27 +99,27 @@ class RpcClient:
         units; <=0 disables).  Semantics note: the deadline is enforced as
         a per-syscall IDLE timeout (SO_RCVTIMEO/SO_SNDTIMEO), not an
         elapsed-wall-clock deadline like the reference's gRPC one — a
-        server that keeps trickling bytes resets it; a silent one trips
-        it.  On the first deadline failure the client is POISONED (handle
-        closed): the socket may be mid-frame, so retrying on it would
-        silently desync framing; reconnect with a new RpcClient."""
-        import time
+        server that keeps trickling bytes resets it; a silent one trips it.
+
+        retry_times: bounded reconnect-and-retry on deadline/transport
+        failure (reference FLAGS_rpc_retry_times; None reads the flag).
+        A failed socket may be mid-frame, so a retry NEVER reuses it:
+        the handle is closed and the retry opens a fresh connection after
+        an exponential backoff with jitter (backoff_delay).  With
+        retry_times=0 the first failure poisons the client (handle
+        closed); callers must reconnect with a new RpcClient — the
+        pre-retry semantics, still used by tests that assert deadline
+        behavior in isolation."""
+        import random
 
         self._lib = load()
         host, port = endpoint.rsplit(":", 1)
         if host in ("localhost", ""):
             host = "127.0.0.1"
-        deadline = time.time() + connect_timeout
-        self._h = None
-        while True:
-            self._h = self._lib.rpcc_connect(host.encode(), int(port))
-            if self._h or time.time() > deadline:
-                break
-            time.sleep(0.1)
-        if not self._h:
-            raise ConnectionError("cannot connect to pserver %s within %.0fs"
-                                  % (endpoint, connect_timeout))
+        self._host, self._port = host, int(port)
         self.endpoint = endpoint
+        self._h = None
+        self._rng = random.Random()
         if rpc_deadline is None:
             from .. import flags as _flags
 
@@ -112,6 +127,26 @@ class RpcClient:
                 "FLAGS_rpc_deadline"]
             rpc_deadline = float(ms) / 1000.0 if ms and ms > 0 else 0.0
         self.rpc_deadline = float(rpc_deadline or 0.0)
+        if retry_times is None:
+            from .. import flags as _flags
+
+            retry_times = _flags.get_flags(["FLAGS_rpc_retry_times"])[
+                "FLAGS_rpc_retry_times"]
+        self.retry_times = max(int(retry_times or 0), 0)
+        self._connect(connect_timeout)
+
+    def _connect(self, connect_timeout):
+        import time
+
+        deadline = time.time() + connect_timeout
+        while True:
+            self._h = self._lib.rpcc_connect(self._host.encode(), self._port)
+            if self._h or time.time() > deadline:
+                break
+            time.sleep(0.1)
+        if not self._h:
+            raise ConnectionError("cannot connect to pserver %s within %.0fs"
+                                  % (self.endpoint, connect_timeout))
         if self.rpc_deadline > 0:
             self._lib.rpcc_set_deadline(self._h, self.rpc_deadline)
 
@@ -119,9 +154,9 @@ class RpcClient:
         hint = (" (deadline %.0fs — pserver hung or connection lost)"
                 % self.rpc_deadline if self.rpc_deadline > 0
                 else " (connection lost)")
-        # a timed-out socket may be mid-frame: a retried call on the same
-        # connection would read misaligned frames (silent desync), so the
-        # first failure poisons the client — callers must reconnect
+        # a timed-out socket may be mid-frame: reusing this connection
+        # would read misaligned frames (silent desync), so every failure
+        # closes the handle — retries reconnect fresh
         self.close()
         return ConnectionError("%s to %s failed%s"
                                % (what, self.endpoint, hint))
@@ -133,37 +168,101 @@ class RpcClient:
                 "failure — reconnect with a new RpcClient" %
                 (what, self.endpoint))
 
+    def _with_retry(self, what, attempt_fn):
+        """Run one RPC with up to retry_times reconnect-and-retry rounds.
+        Safe for sends because the PS frames are tagged with sequence ids
+        and the pserver dedupes replays (distributed/ps.py)."""
+        import time
+
+        last = None
+        for i in range(self.retry_times + 1):
+            if i:
+                time.sleep(backoff_delay(i - 1, rng=self._rng))
+            try:
+                if not self._h:
+                    # retry_times=0 keeps the poison contract: a closed
+                    # client stays closed.  With retries, reconnect —
+                    # bounded per attempt so remaining attempts still get
+                    # to back off while the server restarts
+                    if self.retry_times == 0:
+                        self._check_open(what)
+                    self._connect(connect_timeout=5.0)
+                return attempt_fn()
+            except ConnectionError as e:
+                last = e
+        raise last
+
     def send_var(self, name, arr):
-        self._check_open("send_var(%s)" % name)
         arr = np.ascontiguousarray(arr)
         dims = (ctypes.c_longlong * max(arr.ndim, 1))(*(arr.shape or (0,)))
-        rc = self._lib.rpcc_send_var(
-            self._h, name.encode(), _DT_TO_CODE[arr.dtype], dims, arr.ndim,
-            arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes)
-        if rc != 0:
-            raise self._err("send_var(%s)" % name)
+        what = "send_var(%s)" % name
+
+        def attempt():
+            self._check_open(what)
+            # fault point rpc.send: "drop" = frame lost before the wire
+            # (client sees the same deadline error a lost ACK produces);
+            # "error" = transport dies AFTER delivery (ACK lost) — the
+            # retry then REPLAYS a frame the server already applied, which
+            # is exactly what dedupe-by-sequence must absorb
+            kind = maybe_fail("rpc.send")
+            if kind == "drop":
+                self.close()
+                raise FaultInjected("%s to %s: injected frame drop"
+                                    % (what, self.endpoint))
+            rc = self._lib.rpcc_send_var(
+                self._h, name.encode(), _DT_TO_CODE[arr.dtype], dims,
+                arr.ndim, arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes)
+            if rc != 0:
+                raise self._err(what)
+            if kind == "error":
+                self.close()
+                raise FaultInjected("%s to %s: injected transport error "
+                                    "after delivery" % (what, self.endpoint))
+
+        return self._with_retry(what, attempt)
 
     def get_var(self, name):
-        self._check_open("get_var(%s)" % name)
-        c = ctypes
-        dtype = c.c_ubyte()
-        dims = (c.c_longlong * 16)()
-        ndim = c.c_int()
-        data = c.c_void_p()
-        n = self._lib.rpcc_get_var(self._h, name.encode(), c.byref(dtype),
-                                   dims, 16, c.byref(ndim), c.byref(data))
-        if n < 0:
-            raise self._err("get_var(%s)" % name)
-        shape = tuple(dims[i] for i in range(ndim.value))
-        buf = ctypes.string_at(data.value, n)
-        self._lib.rpc_free(data)
-        return np.frombuffer(buf, dtype=np.dtype(_DTYPES[dtype.value])) \
-            .reshape(shape).copy()
+        what = "get_var(%s)" % name
+
+        def attempt():
+            self._check_open(what)
+            kind = maybe_fail("rpc.get")
+            if kind == "drop":
+                self.close()
+                raise FaultInjected("%s to %s: injected request drop"
+                                    % (what, self.endpoint))
+            c = ctypes
+            dtype = c.c_ubyte()
+            dims = (c.c_longlong * 16)()
+            ndim = c.c_int()
+            data = c.c_void_p()
+            n = self._lib.rpcc_get_var(self._h, name.encode(), c.byref(dtype),
+                                       dims, 16, c.byref(ndim), c.byref(data))
+            if n < 0:
+                raise self._err(what)
+            shape = tuple(dims[i] for i in range(ndim.value))
+            buf = ctypes.string_at(data.value, n)
+            self._lib.rpc_free(data)
+            if kind == "error":
+                # reply lost on the way back: discard it and fail (GET is
+                # idempotent — the retry simply re-asks)
+                self.close()
+                raise FaultInjected("%s to %s: injected reply loss"
+                                    % (what, self.endpoint))
+            return np.frombuffer(buf, dtype=np.dtype(_DTYPES[dtype.value])) \
+                .reshape(shape).copy()
+
+        return self._with_retry(what, attempt)
 
     def barrier(self, kind):
-        self._check_open("barrier(%s)" % kind)
-        if self._lib.rpcc_barrier(self._h, kind.encode()) != 0:
-            raise self._err("barrier(%s)" % kind)
+        what = "barrier(%s)" % kind
+
+        def attempt():
+            self._check_open(what)
+            if self._lib.rpcc_barrier(self._h, kind.encode()) != 0:
+                raise self._err(what)
+
+        return self._with_retry(what, attempt)
 
     def complete(self):
         if not self._h:

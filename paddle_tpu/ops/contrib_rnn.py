@@ -22,7 +22,13 @@ the reference's exactly:
 Dropout applies to the layer-(i) output as it feeds layer i+1 AND to the
 final layer's step output (the reference appends the post-dropout
 step_input as the last step_output and returns it), but NOT to the
-per-layer last_hidden states.
+per-layer last_hidden states.  The two APIs use DIFFERENT dropout
+implementations, matching the reference exactly: basic_gru
+(rnn_impl.py:302) calls layers.dropout with the default
+``downgrade_in_infer`` — train masks WITHOUT upscaling, inference scales
+by (1-p) — while basic_lstm (rnn_impl.py:532) passes
+``dropout_implementation='upscale_in_train'`` — train masks and divides
+by (1-p), inference is the identity.
 """
 
 import jax
@@ -61,9 +67,14 @@ def _step_keys(ctx, attrs, t_steps):
     return jnp.zeros((t_steps, 2), jnp.uint32)
 
 
-def _dropout(x, p, key):
+def _dropout(x, p, key, upscale):
+    """upscale=True: upscale_in_train (mask + x/(1-p)) — the LSTM path.
+    upscale=False: downgrade_in_infer's TRAIN side (mask only, no
+    rescale) — the GRU path; its (1-p) inference scaling is applied by
+    the callers on their is_test branch."""
     keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
-    return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+    kept = x / (1.0 - p) if upscale else x
+    return jnp.where(keep, kept, 0.0).astype(x.dtype)
 
 
 @register_op(
@@ -92,6 +103,10 @@ def basic_gru_rnn(ctx, x, h0, mask, gate_w, cand_w, gate_b, cand_b,
     T, B = x.shape[0], x.shape[1]
     H, L = int(hidden_size), int(num_layers)
     p = 0.0 if is_test else float(dropout_prob)
+    # downgrade_in_infer (reference basic_gru's layers.dropout default):
+    # inference multiplies by (1-p) where training masked
+    infer_scale = (1.0 - float(dropout_prob)
+                   if is_test and float(dropout_prob) > 0.0 else None)
     if h0 is None:
         h0 = jnp.zeros((L, B, H), x.dtype)
     else:
@@ -118,7 +133,10 @@ def basic_gru_rnn(ctx, x, h0, mask, gate_w, cand_w, gate_b, cand_b,
             step_in = nh
             if p > 0.0:
                 step_in = _dropout(step_in, p,
-                                   jax.random.fold_in(key_t, i))
+                                   jax.random.fold_in(key_t, i),
+                                   upscale=False)
+            elif infer_scale is not None:
+                step_in = (step_in * infer_scale).astype(step_in.dtype)
         return jnp.stack(new_h), step_in
 
     last_h, out = jax.lax.scan(step, h0, (x, ms, keys))
@@ -179,8 +197,10 @@ def basic_lstm_rnn(ctx, x, h0, c0, mask, weight, bias, hidden_size=0,
             new_c.append(nc)
             step_in = nh
             if p > 0.0:
+                # reference basic_lstm passes upscale_in_train explicitly
                 step_in = _dropout(step_in, p,
-                                   jax.random.fold_in(key_t, i))
+                                   jax.random.fold_in(key_t, i),
+                                   upscale=True)
         return (jnp.stack(new_h), jnp.stack(new_c)), step_in
 
     (last_h, last_c), out = jax.lax.scan(step, (h0, c0), (x, ms, keys))

@@ -16,7 +16,7 @@ from jax import lax
 from ..core.registry import GradOpDesc, register_op
 from ..framework import _grad_var_name
 from .common import (attr_dtype, bernoulli_bytes, dtype_enum,
-                     realized_keep_prob, realized_prob)
+                     realized_keep_prob)
 
 
 # -- conv --------------------------------------------------------------------
@@ -544,11 +544,14 @@ def dropout(ctx, x, dropout_prob=0.5, is_test=False, fix_seed=False, seed=0,
     if is_test:
         if dropout_implementation == "upscale_in_train":
             return x, jnp.ones_like(x, dtype=jnp.uint8)
-        # downgrade scaling uses the REALIZED keep prob of the quantized
-        # training draw (realized_prob: no 1/256 NaN-guard floor — this is
-        # a multiply, not a divisor) so E[train out] == infer out exactly;
-        # <=1/512 absolute deviation from the reference's nominal scale
-        return (x * realized_prob(1.0 - dropout_prob),
+        # downgrade inference scales by the NOMINAL (1-p) — exact reference
+        # parity for imported models (no sampling happens at inference, so
+        # nothing forces the quantized grid here).  Known asymmetry: the
+        # TRAIN side masks with the 256-quantized realized keep prob, so
+        # E[train out] and this infer out differ by up to 2^-9 relative —
+        # inference parity is deliberately preferred over expectation
+        # consistency (ADVICE round 5).
+        return (x * (1.0 - dropout_prob),
                 jnp.ones_like(x, dtype=jnp.uint8))
     # training scale factors use the REALIZED keep probability of the
     # quantized byte draw (round(keep*256)/256) so E[out] = x exactly

@@ -55,7 +55,13 @@ class MultiTrainer:
 
 
 def _run_loop(exe, program, dataset, scope, thread, fetch_list, fetch_info,
-              print_period, train):
+              print_period, train, checkpoint_manager=None):
+    """checkpoint_manager: an io.CheckpointManager; every step the loop
+    offers it a crash-safe save (maybe_save fires on its save_interval).
+    Restoring is the CALLER's move — run the startup program, then
+    CheckpointManager.restore(), then enter this loop — because only the
+    caller knows whether a fresh scope or a supervised relaunch is in
+    play."""
     from .core.executor import global_scope
     from .native.queue import NativeBlockingQueue, QueueClosed
 
@@ -107,6 +113,8 @@ def _run_loop(exe, program, dataset, scope, thread, fetch_list, fetch_info,
                       % (step, step / max(time.time() - t0, 1e-9), vals))
             if fetch_list:
                 results = out
+            if train and checkpoint_manager is not None:
+                checkpoint_manager.maybe_save(exe, program, step)
     finally:
         queue.kill()
         for w in workers:
@@ -220,12 +228,13 @@ def _pipeline_train(exe, program, dataset, scope, fetch_list, fetch_info,
 
 
 def train_from_dataset(exe, program, dataset, scope, thread, fetch_list,
-                       fetch_info, print_period):
+                       fetch_info, print_period, checkpoint_manager=None):
     if getattr(program, "_pipeline_opt", None):
         return _pipeline_train(exe, program, dataset, scope, fetch_list,
                                fetch_info, print_period)
     return _run_loop(exe, program, dataset, scope, thread, fetch_list,
-                     fetch_info, print_period, train=True)
+                     fetch_info, print_period, train=True,
+                     checkpoint_manager=checkpoint_manager)
 
 
 def infer_from_dataset(exe, program, dataset, scope, thread, fetch_list,

@@ -3,6 +3,7 @@ paddle/fluid/framework/io/fs.cc + shell.cc — the reference shells out to
 `hadoop fs` through popen; so do we — and
 python/paddle/fluid/incubate/fleet/utils/hdfs.py HDFSClient)."""
 
+import contextlib
 import os
 import shutil
 import subprocess
@@ -12,6 +13,26 @@ __all__ = ["LocalFS", "HDFSClient"]
 
 class LocalFS:
     """Local filesystem with the fs.cc surface (localfs_* functions)."""
+
+    @contextlib.contextmanager
+    def atomic_write_dir(self, path):
+        """Context manager yielding a temp directory that becomes `path`
+        on clean exit (write-temp-then-rename, the crash-safe checkpoint
+        idiom: a SIGKILL mid-write leaves only an invisible temp dir, never
+        a torn `path`).  The rename is atomic when `path` does not already
+        exist; a pre-existing `path` is deleted first — that narrow window
+        is why checkpoint readers must also gate on the _SUCCESS manifest
+        (io.CheckpointManager.latest_valid)."""
+        tmp = "%s._tmp.%d" % (path, os.getpid())
+        self.delete(tmp)
+        os.makedirs(tmp)
+        try:
+            yield tmp
+        except BaseException:
+            self.delete(tmp)
+            raise
+        self.delete(path)
+        os.replace(tmp, path)
 
     def ls_dir(self, path):
         if not os.path.exists(path):

@@ -23,12 +23,23 @@ TPU_TIER = os.environ.get("PADDLE_TPU_TESTS") == "1"
 
 if not TPU_TIER:
     os.environ["JAX_PLATFORMS"] = "cpu"
+    # jax_num_cpu_devices only exists on newer jax; the XLA flag is the
+    # backward-compatible spelling and must be set before the backend
+    # initializes (i.e. before the first jax import in this process)
+    if "--xla_force_host_platform_device_count" not in os.environ.get(
+            "XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8").strip()
 
 import jax
 
 if not TPU_TIER:
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:  # older jax: the XLA_FLAGS env above covers it
+        pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -8,10 +8,24 @@ import sys
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
+# exactly ONE local device per process (DataParallel is one-process-per-
+# device).  The parent pytest env forces an 8-device CPU mesh via
+# XLA_FLAGS, so rewrite that before jax imports; jax_num_cpu_devices only
+# exists on newer jax.
+import re as _re
+
+_xf = _re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+              os.environ.get("XLA_FLAGS", ""))
+os.environ["XLA_FLAGS"] = (
+    _xf + " --xla_force_host_platform_device_count=1").strip()
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 1)
+try:
+    jax.config.update("jax_num_cpu_devices", 1)
+except AttributeError:  # older jax: XLA_FLAGS above covers it
+    pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

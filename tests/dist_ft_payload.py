@@ -1,0 +1,79 @@
+"""Fault-tolerance distributed payload (dist_fc_payload topology + the FT
+runtime pieces): every trainer step is checkpointed through
+io.CheckpointManager, and trainer 1 optionally SIGKILLs itself mid-round via
+a fault-injection spec.  Run under distributed/launch.py --restart_failed
+the killed trainer comes back, restores from its latest valid checkpoint,
+rejoins the cluster at its CURRENT round, and finishes the job.
+
+Env contract (on top of the PADDLE_* cluster vars):
+- PADDLE_CKPT_DIR      — checkpoint root; each trainer uses a per-tid subdir
+- PADDLE_FT_KILL=1     — arm ``rpc.send:kill`` on trainer 1's FIRST life
+                         (dies during step 5's gradient sends: after the
+                         heartbeat, before the round completes)
+- PADDLE_RESTART_COUNT — set by the launcher; >0 means this is a relaunch,
+                         so restore instead of arming the kill again
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.utils import fault_injection as fi
+
+from dist_fc_payload import BS, STEPS, build, make_data, run_pserver
+
+
+def run_trainer():
+    eps = os.environ["PADDLE_PSERVER_ENDPOINTS"]
+    tid = int(os.environ["PADDLE_TRAINER_ID"])
+    n_trainers = int(os.environ["PADDLE_TRAINERS_NUM"])
+    restart_count = int(os.environ.get("PADDLE_RESTART_COUNT", "0"))
+    ckpt_dir = os.path.join(os.environ["PADDLE_CKPT_DIR"],
+                            "trainer-%d" % tid)
+    main, startup, loss = build()
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=tid, program=main, startup_program=startup,
+                pservers=eps, trainers=n_trainers)
+    tp = t.get_trainer_program()
+    xs, ys = make_data(n_trainers)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    mgr = fluid.io.CheckpointManager(ckpt_dir, save_interval=1, max_num=2)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        start_step = 0
+        if restart_count > 0:
+            # relaunched life: resume the step counter from the newest
+            # valid checkpoint (params themselves are re-pulled from the
+            # pservers at the cluster's current round on the first run)
+            start_step, _ = mgr.restore(exe, tp)
+            print("resumed_from:%d" % start_step, flush=True)
+        elif os.environ.get("PADDLE_FT_KILL") == "1" and tid == 1:
+            # 5 rpc.send checks per step (1 hb + 4 grads: w1/w2 and the two
+            # fc biases, single pserver); skip=21 → SIGKILL on check 22 =
+            # step 5's second grad send — after the heartbeat and a partial
+            # gradient set, squarely mid-round
+            fi.arm("rpc.send:kill:1:1:21")
+        half = slice(tid * BS, (tid + 1) * BS)
+        final = None
+        for i in range(start_step, STEPS):
+            lo, = exe.run(tp, feed={"x": xs[i][half], "y": ys[i][half]},
+                          fetch_list=[loss], scope=scope)
+            final = float(np.asarray(lo).reshape(-1)[0])
+            print("loss:%.8f" % final, flush=True)
+            mgr.save(exe, tp, i + 1)
+        print("final_loss:%.8f" % final, flush=True)
+        scope._ps_comm.complete()
+
+
+if __name__ == "__main__":
+    role = os.environ.get("PADDLE_TRAINING_ROLE", "LOCAL")
+    if role == "PSERVER":
+        run_pserver()
+    else:
+        run_trainer()

@@ -1,6 +1,10 @@
 """Shared helpers for the distributed test families."""
 
+import os
+import signal
 import socket
+import subprocess
+import sys
 
 
 def free_ports(n):
@@ -17,16 +21,50 @@ def free_ports(n):
     return ports
 
 
+def kill_proc_tree(p):
+    """SIGKILL a subprocess and everything in its process group (payloads
+    spawned with start_new_session=True lead their own group, so children
+    they forked — e.g. a launcher's training script — die too)."""
+    try:
+        os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+    except (ProcessLookupError, PermissionError, OSError):
+        try:
+            p.kill()
+        except OSError:
+            pass
+
+
+def gather_tails(procs, limit=2000):
+    """Kill every process in `procs` ([(name, Popen)]) and return a
+    formatted string of each one's return code + stderr tail, for embedding
+    in a pytest failure message (a bare TimeoutExpired hides everything the
+    cluster printed)."""
+    for _, p in procs:
+        if p.poll() is None:
+            kill_proc_tree(p)
+    chunks = []
+    for name, p in procs:
+        try:
+            out, err = p.communicate(timeout=10)
+        except (subprocess.TimeoutExpired, ValueError, OSError):
+            out, err = "", "<unreadable>"
+        chunks.append("--- %s rc=%s stderr tail ---\n%s\n--- %s stdout "
+                      "tail ---\n%s" % (name, p.returncode,
+                                        (err or "")[-limit:], name,
+                                        (out or "")[-limit:]))
+    return "\n".join(chunks)
+
+
 def run_ps_cluster(payload, base_env, n_pservers=2, n_trainers=2,
                    ps_extra_env=None, trainer_extra_env=None,
                    timeout=300):
     """Spawn the standard sync-PS topology (reference test_dist_base.py
     _run_cluster): n pservers + n trainers as real subprocesses on free
     localhost ports.  Returns the list of trainer stdouts; asserts every
-    process exits 0.  `*_extra_env(i) -> dict` adds per-process env."""
-    import subprocess
-    import sys
+    process exits 0.  `*_extra_env(i) -> dict` adds per-process env.
 
+    On a trainer timeout the WHOLE cluster (process groups included) is
+    killed and every member's stderr tail lands in the failure message."""
     ports = free_ports(n_pservers)
     eps = ",".join("127.0.0.1:%d" % p for p in ports)
     procs = []
@@ -41,7 +79,7 @@ def run_ps_cluster(payload, base_env, n_pservers=2, n_trainers=2,
             procs.append(("ps:%d" % i, subprocess.Popen(
                 [sys.executable, payload], env=env,
                 stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-                text=True)))
+                text=True, start_new_session=True)))
         trainers = []
         for tid in range(n_trainers):
             env = dict(base_env, PADDLE_TRAINING_ROLE="TRAINER",
@@ -52,20 +90,38 @@ def run_ps_cluster(payload, base_env, n_pservers=2, n_trainers=2,
                 env.update(trainer_extra_env(tid))
             p = subprocess.Popen([sys.executable, payload], env=env,
                                  stdout=subprocess.PIPE,
-                                 stderr=subprocess.PIPE, text=True)
+                                 stderr=subprocess.PIPE, text=True,
+                                 start_new_session=True)
             trainers.append(p)
             procs.append(("tr:%d" % tid, p))
         touts = []
-        for p in trainers:
-            out, err = p.communicate(timeout=timeout)
-            assert p.returncode == 0, err
+        for tid, p in enumerate(trainers):
+            try:
+                out, err = p.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                raise AssertionError(
+                    "trainer %d timed out after %ss; cluster state:\n%s"
+                    % (tid, timeout, gather_tails(procs)))
+            if p.returncode != 0:
+                raise AssertionError(
+                    "trainer %d exited rc=%s\nstderr tail:\n%s\nrest of "
+                    "cluster:\n%s" % (tid, p.returncode,
+                                      (err or "")[-2000:],
+                                      gather_tails(
+                                          [pr for pr in procs
+                                           if pr[1] is not p])))
             touts.append(out)
         for name, p in procs:
             if name.startswith("ps:"):
-                out, err = p.communicate(timeout=120)
-                assert p.returncode == 0, (name, err)
+                try:
+                    out, err = p.communicate(timeout=120)
+                except subprocess.TimeoutExpired:
+                    raise AssertionError(
+                        "%s did not exit after trainers completed; cluster "
+                        "state:\n%s" % (name, gather_tails(procs)))
+                assert p.returncode == 0, (name, (err or "")[-2000:])
         return touts
     finally:
         for _, p in procs:
             if p.poll() is None:
-                p.kill()
+                kill_proc_tree(p)

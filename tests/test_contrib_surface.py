@@ -268,6 +268,53 @@ def test_basic_rnn_dropout_path(api):
         np.testing.assert_array_equal(c, d)
 
 
+@pytest.mark.parametrize("api", ["gru", "lstm"])
+def test_basic_rnn_dropout_scaling_semantics(api):
+    """Regression (ADVICE round 5): basic_gru's inter-layer dropout is the
+    reference's default downgrade_in_infer — training masks WITHOUT the
+    1/(1-p) upscale and inference scales by (1-p) — while basic_lstm is
+    upscale_in_train (train mask + x/(1-p), inference identity).  With one
+    layer the dropout only touches the emitted output (the recurrence is
+    undisturbed), so surviving elements can be compared elementwise against
+    the inference run."""
+    T, B, I, H, p = 4, 3, 4, 6, 0.4
+    rng = np.random.RandomState(21)
+    x = rng.randn(B, T, I).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xin = fluid.layers.data("x", shape=[T, I])
+        if api == "gru":
+            out, _ = contrib.layers.basic_gru(
+                xin, None, H, num_layers=1, dropout_prob=p,
+                batch_first=True)
+        else:
+            out, _, _ = contrib.layers.basic_lstm(
+                xin, None, None, H, num_layers=1, dropout_prob=p,
+                batch_first=True)
+    test_prog = main.clone(for_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        train_out, = exe.run(main, feed={"x": x}, fetch_list=[out])
+        infer_out, = exe.run(test_prog, feed={"x": x}, fetch_list=[out])
+    train_out = np.asarray(train_out)
+    infer_out = np.asarray(infer_out)
+    if api == "gru":
+        # infer = clean * (1-p); train survivors = clean (NO upscale)
+        clean = infer_out / (1.0 - p)
+        expected = clean
+    else:
+        # infer = clean; train survivors = clean / (1-p) (upscaled)
+        clean = infer_out
+        expected = clean / (1.0 - p)
+    survivors = train_out != 0.0
+    # dropout actually dropped something and kept something
+    assert 0 < survivors.sum() < train_out.size
+    np.testing.assert_allclose(train_out[survivors], expected[survivors],
+                               rtol=1e-5, atol=1e-6)
+
+
 def test_dygraph_units_match_numpy():
     from paddle_tpu import dygraph
 
@@ -358,6 +405,35 @@ def test_ctr_metric_bundle_accumulates():
     np.testing.assert_allclose(outs[2], [pv.sum() * 2], rtol=1e-5)
     np.testing.assert_allclose(outs[4], [yv.sum() * 2], rtol=1e-5)
     np.testing.assert_allclose(outs[5], [6.0], rtol=1e-5)
+
+
+def test_quantize_transpiler_passes_weight_quantize_type():
+    """Regression (ADVICE round 5): training_transpile hardcoded
+    'abs_max' regardless of the constructor's weight_quantize_type, so the
+    train/freeze pair could silently disagree.  The transpiler's configured
+    type must reach the transform pass: 'abs_max' weights quantize
+    per-tensor, while the slim pass's own default stays channel-wise."""
+    from paddle_tpu.contrib.slim.quantization.quantization_pass import (
+        QuantizationTransformPass)
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[8])
+            fluid.layers.fc(x, 4)
+        return main, startup
+
+    main, startup = build()
+    contrib.QuantizeTranspiler().training_transpile(main, startup)
+    types = [op.type for op in main.global_block().ops]
+    assert "fake_quantize_abs_max" in types, types
+    assert "fake_channel_wise_quantize_abs_max" not in types, types
+
+    main2, startup2 = build()
+    QuantizationTransformPass().apply(main2, startup2)
+    types2 = [op.type for op in main2.global_block().ops]
+    assert "fake_channel_wise_quantize_abs_max" in types2, types2
+    assert "fake_quantize_abs_max" not in types2, types2
 
 
 def test_quantize_transpiler_roundtrip():

@@ -1,0 +1,138 @@
+"""Unit tests for the named fault-point runtime
+(paddle_tpu/utils/fault_injection.py) and the PS-side replay filter that
+fault-driven RPC retries exercise (paddle_tpu/distributed/ps.py)."""
+
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from paddle_tpu import flags
+from paddle_tpu.distributed.ps import _ReplayFilter, _untag
+from paddle_tpu.utils import fault_injection as fi
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    yield
+    flags.set_flags({"FLAGS_fault_spec": ""})
+    fi.disarm()
+
+
+def test_spec_parse_errors():
+    with pytest.raises(ValueError):
+        fi.arm("rpc.send:explode:1")      # unknown kind
+    with pytest.raises(ValueError):
+        fi.arm("rpc.send:drop")           # missing prob
+    with pytest.raises(ValueError):
+        fi.arm("rpc.send:drop:not_a_prob")
+
+
+def test_disarmed_is_noop():
+    fi.disarm()
+    for _ in range(3):
+        assert fi.maybe_fail("rpc.send") is None
+    assert fi.fault_stats() == {}
+
+
+def test_drop_and_error_kinds_returned():
+    fi.arm("a:drop:1;b:error:1")
+    assert fi.maybe_fail("a") == "drop"
+    assert fi.maybe_fail("b") == "error"
+    # unarmed point name passes through untouched
+    assert fi.maybe_fail("c") is None
+    stats = fi.fault_stats()
+    assert stats["a"] == (1, 1) and stats["b"] == (1, 1)
+
+
+def test_count_limits_firings():
+    fi.arm("p:error:1:2")
+    assert [fi.maybe_fail("p") for _ in range(4)] == [
+        "error", "error", None, None]
+    assert fi.fault_stats()["p"] == (4, 2)
+
+
+def test_skip_defers_first_firing():
+    # skip=3, count=1: checks 1-3 pass, check 4 fires, check 5+ pass again
+    fi.arm("p:drop:1:1:3")
+    assert [fi.maybe_fail("p") for _ in range(5)] == [
+        None, None, None, "drop", None]
+
+
+def test_seeded_probability_is_reproducible():
+    fi.arm("p:drop:0.5", seed=1234)
+    first = [fi.maybe_fail("p") for _ in range(32)]
+    fi.arm("p:drop:0.5", seed=1234)
+    assert [fi.maybe_fail("p") for _ in range(32)] == first
+    assert "drop" in first and None in first  # both outcomes at p=0.5
+
+
+def test_delay_sleeps():
+    fi.arm("p:delay:1:1")
+    t0 = time.monotonic()
+    assert fi.maybe_fail("p") is None  # delay proceeds after sleeping
+    assert time.monotonic() - t0 >= 0.5 * fi.DELAY_SECONDS
+
+
+def test_fault_injected_is_connection_error():
+    # retry paths catch ConnectionError; injected faults must qualify
+    assert issubclass(fi.FaultInjected, ConnectionError)
+
+
+def test_flag_driven_arming():
+    # production arming path: the flag is read lazily on the next check
+    fi.disarm()
+    flags.set_flags({"FLAGS_fault_spec": "p:error:1:1"})
+    assert fi.maybe_fail("p") == "error"
+    assert fi.maybe_fail("p") is None  # count exhausted
+
+
+def test_kill_sigkills_the_process():
+    code = (
+        "from paddle_tpu.utils import fault_injection as fi\n"
+        "fi.arm('p:kill:1:1:2')\n"
+        "for i in range(10):\n"
+        "    fi.maybe_fail('p')\n"
+        "    print('survived', i, flush=True)\n"
+    )
+    p = subprocess.run([sys.executable, "-c", code],
+                       capture_output=True, text=True, timeout=60)
+    assert p.returncode == -signal.SIGKILL, (p.returncode, p.stderr)
+    # skip=2 → dies on the third check, after two survived prints
+    assert p.stdout.splitlines() == ["survived 0", "survived 1"]
+
+
+# --- replay filter / sequence tagging (dedupe across RPC retries) ---
+
+
+def test_untag_roundtrip():
+    assert _untag("w1@@s3:12345:7") == ("w1", 3, 12345, 7)
+    assert _untag("plain_name") == ("plain_name", None, 0, 0)
+    # malformed suffixes degrade to untagged rather than crashing the server
+    assert _untag("w1@@snot:an:int")[1] is None
+
+
+def test_replay_filter_drops_duplicate_seq():
+    f = _ReplayFilter()
+    assert f.fresh(1, 99, 1)
+    assert f.fresh(1, 99, 2)
+    assert not f.fresh(1, 99, 2)  # retry replay of an ACK-lost frame
+    assert not f.fresh(1, 99, 1)
+    assert f.fresh(1, 99, 3)
+
+
+def test_replay_filter_accepts_new_incarnation():
+    # a relaunched trainer restarts seq at 0 under a fresh nonce; the filter
+    # must not mistake its frames for replays of the old life
+    f = _ReplayFilter()
+    assert f.fresh(1, 99, 5)
+    assert f.fresh(1, 42, 1)
+    assert not f.fresh(1, 42, 1)
+
+
+def test_replay_filter_passes_untagged():
+    f = _ReplayFilter()
+    assert f.fresh(None, 0, 0)
+    assert f.fresh(None, 0, 0)  # untagged traffic is never deduped

@@ -229,6 +229,24 @@ class TestDropoutTestMode(OpTest):
         self.check_output(no_check_set=("Mask",))
 
 
+class TestDropoutTestModeDowngrade(OpTest):
+    """Regression (ADVICE round 5): the downgrade_in_infer is_test path
+    must scale by the NOMINAL (1-p), not the 256-quantized realized keep
+    prob — imported reference models expect exact inference parity."""
+
+    op_type = "dropout"
+
+    def setup_method(self, m):
+        x = _rand(4, 8)
+        self.inputs = {"X": x}
+        self.attrs = {"dropout_prob": 0.3, "is_test": True,
+                      "dropout_implementation": "downgrade_in_infer"}
+        self.outputs = {"Out": x * np.float32(1.0 - 0.3), "Mask": None}
+
+    def test_output(self):
+        self.check_output(no_check_set=("Mask",))
+
+
 def test_dropout_train_statistics():
     import paddle_tpu as fluid
 

@@ -1,9 +1,13 @@
-"""PS RPC per-request deadline (round-3 verdict weak #5).
+"""PS RPC per-request deadline (round-3 verdict weak #5) + retry/backoff.
 
 The reference carries FLAGS_rpc_deadline + retry on its gRPC client
 (/root/reference/paddle/fluid/operators/distributed/grpc/grpc_client.cc);
 before this, a pserver that hung mid-round blocked the trainer's GET
 forever (the 60 s connect timeout only covered connection establishment).
+
+The deadline tests pass retry_times=0 to assert the deadline/poison
+contract in isolation; the retry tests below cover the reconnect-and-retry
+layer (FLAGS_rpc_retry_times) on top of it.
 """
 
 import socket
@@ -13,7 +17,9 @@ import time
 import numpy as np
 import pytest
 
-from paddle_tpu.native.rpc import RpcClient, RpcServer
+from paddle_tpu.native.rpc import (RpcClient, RpcServer, EV_SEND,
+                                   backoff_delay)
+from paddle_tpu.utils import fault_injection
 
 
 def _silent_server():
@@ -40,7 +46,7 @@ def test_get_var_times_out_on_hung_server():
     lsock, conns = _silent_server()
     try:
         cli = RpcClient("127.0.0.1:%d" % lsock.getsockname()[1],
-                        rpc_deadline=2.0)
+                        rpc_deadline=2.0, retry_times=0)
         t0 = time.time()
         with pytest.raises(ConnectionError, match="deadline"):
             cli.get_var("w@0")
@@ -59,7 +65,7 @@ def test_send_var_times_out_on_hung_server():
     lsock, conns = _silent_server()
     try:
         cli = RpcClient("127.0.0.1:%d" % lsock.getsockname()[1],
-                        rpc_deadline=2.0)
+                        rpc_deadline=2.0, retry_times=0)
         t0 = time.time()
         with pytest.raises(ConnectionError, match="deadline"):
             cli.send_var("g@0", np.ones((4 << 20,), "float32"))
@@ -89,7 +95,8 @@ def test_trainer_surfaces_dead_pserver_not_hang():
     srv = RpcServer()
     srv.set_var("w@0", np.zeros((4,), "float32"))
     srv.serve(True)
-    cli = RpcClient("127.0.0.1:%d" % srv.port, rpc_deadline=3.0)
+    cli = RpcClient("127.0.0.1:%d" % srv.port, rpc_deadline=3.0,
+                    retry_times=0)
     # round 0 works
     np.testing.assert_array_equal(cli.get_var("w@0"), np.zeros(4, "f"))
     # pserver dies (socket closes -> fast error) — and a FROZEN pserver
@@ -101,3 +108,112 @@ def test_trainer_surfaces_dead_pserver_not_hang():
             cli.get_var("w@0")
     assert time.time() - t0 < 10.0
     cli.close()
+
+
+# ---- retry / backoff ------------------------------------------------------
+
+
+def test_backoff_schedule():
+    """Exponential growth with equal jitter: delay(i) is uniform in
+    [d/2, d] for d = min(cap, base * 2^i)."""
+    import random
+
+    for attempt in range(8):
+        d = min(2.0, 0.05 * 2 ** attempt)
+        for seed in range(20):
+            got = backoff_delay(attempt, rng=random.Random(seed))
+            assert d / 2 <= got <= d, (attempt, got, d)
+    # the cap binds from attempt 6 on (0.05 * 2^6 = 3.2 > 2.0)
+    assert backoff_delay(12, rng=random.Random(0)) <= 2.0
+
+
+def test_send_retry_absorbs_injected_drop():
+    """A transient frame drop (prob<1 via a bounded count) is absorbed by
+    the retry: the call succeeds and the server sees the frame ONCE."""
+    srv = RpcServer()
+    try:
+        srv.serve(True)
+        cli = RpcClient("127.0.0.1:%d" % srv.port, rpc_deadline=5.0,
+                        retry_times=3)
+        fault_injection.arm("rpc.send:drop:1:1")  # first send drops, once
+        try:
+            cli.send_var("g", np.arange(3, dtype="float32"))
+        finally:
+            fault_injection.disarm()
+        t, name, arr = srv.poll()
+        assert t == EV_SEND and name == "g"
+        np.testing.assert_array_equal(arr, np.arange(3, dtype="float32"))
+        # exactly once: the drop happened BEFORE the wire, so only the
+        # retry's frame exists (a second poll would block forever — the
+        # duplicate case is the injected-error test below)
+        cli.close()
+    finally:
+        srv.shutdown()
+
+
+def test_send_retry_replays_after_injected_error():
+    """An ACK-lost transport error AFTER delivery makes the retry REPLAY
+    the frame: the server sees it twice — the duplicate the PS layer's
+    dedupe-by-sequence exists to absorb (tests/test_fault_injection.py
+    covers the filter itself)."""
+    srv = RpcServer()
+    try:
+        srv.serve(True)
+        cli = RpcClient("127.0.0.1:%d" % srv.port, rpc_deadline=5.0,
+                        retry_times=3)
+        fault_injection.arm("rpc.send:error:1:1")
+        try:
+            cli.send_var("g", np.ones((2,), "float32"))
+        finally:
+            fault_injection.disarm()
+        names = [srv.poll()[1], srv.poll()[1]]
+        assert names == ["g", "g"], names
+        cli.close()
+    finally:
+        srv.shutdown()
+
+
+def test_get_retry_recovers_from_injected_reply_loss():
+    srv = RpcServer()
+    try:
+        srv.set_var("w", np.full((4,), 7.0, "float32"))
+        srv.serve(True)
+        cli = RpcClient("127.0.0.1:%d" % srv.port, rpc_deadline=5.0,
+                        retry_times=2)
+        fault_injection.arm("rpc.get:error:1:1")
+        try:
+            out = cli.get_var("w")
+        finally:
+            fault_injection.disarm()
+        np.testing.assert_array_equal(out, np.full((4,), 7.0, "float32"))
+        cli.close()
+    finally:
+        srv.shutdown()
+
+
+def test_retry_reconnects_after_server_restart():
+    """The bounded retry opens a FRESH connection per attempt, so a client
+    whose server died and came back on the same port recovers in-place
+    (the supervised-relaunch story for pservers)."""
+    srv = RpcServer()
+    port = srv.port
+    srv.set_var("w", np.zeros((2,), "float32"))
+    srv.serve(True)
+    cli = RpcClient("127.0.0.1:%d" % port, rpc_deadline=3.0, retry_times=4)
+    np.testing.assert_array_equal(cli.get_var("w"), np.zeros(2, "f"))
+    srv.shutdown()
+
+    def revive():
+        time.sleep(0.5)
+        s2 = RpcServer(port)
+        s2.set_var("w", np.ones((2,), "float32"))
+        s2.serve(True)
+        revive.srv = s2
+
+    th = threading.Thread(target=revive, daemon=True)
+    th.start()
+    out = cli.get_var("w")  # first attempts fail; a later one reconnects
+    np.testing.assert_array_equal(out, np.ones(2, "f"))
+    cli.close()
+    th.join(timeout=5)
+    revive.srv.shutdown()
