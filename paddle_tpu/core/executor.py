@@ -99,12 +99,12 @@ def as_numpy(t):
 
 
 def _with_seed_counter(fn):
-    """Adapt fn(feeds, ro, rw, key) to take a [seed, counter] uint32 pair,
-    deriving the key inside the trace (no eager key ops per step)."""
+    """Adapt fn(feeds, ro, rw, carry, key) to take a [seed, counter] uint32
+    pair, deriving the key inside the trace (no eager key ops per step)."""
 
-    def wrapped(feeds, params_ro, params_rw, sc):
+    def wrapped(feeds, params_ro, params_rw, params_carry, sc):
         key = jax.random.fold_in(jax.random.key(sc[0]), sc[1])
-        return fn(feeds, params_ro, params_rw, key)
+        return fn(feeds, params_ro, params_rw, params_carry, key)
 
     return wrapped
 
@@ -230,7 +230,8 @@ class Executor:
         trace_flags = tuple(sorted(_flags.get_flags(
             ["FLAGS_use_pallas_layer_norm", "FLAGS_check_nan_inf",
              "FLAGS_bn_stat_subsample",
-             "FLAGS_fused_small_attention"]).items()))
+             "FLAGS_fused_small_attention",
+             "FLAGS_layout_match_params"]).items()))
         # mesh keyed by content, not id(): a GC'd Mesh's successor can alias
         # the address exactly like the Program case above
         mesh_key = None
@@ -261,6 +262,7 @@ class Executor:
             params_ro[n] = self._scope_value(scope, n, block)
         for n in plan.rw_names:
             params_rw[n] = self._scope_value(scope, n, block)
+        params_carry = self._gather_carry(scope, plan, block)
 
         # deterministic functional PRNG: (program seed, per-scope step
         # counter).  Locked: pipeline section workers run concurrently
@@ -283,11 +285,42 @@ class Executor:
         ctx = jax.default_device(dev) if dev is not None else contextlib.nullcontext()
         from ..profiler import RecordEvent
 
-        with ctx, RecordEvent("Executor::Run"):
-            fetches, updated = entry.jfn(feed_arrays, params_ro, params_rw, rng)
+        from ..flags import flag as _trace_flag
+
+        if _trace_flag("hbm_audit"):
+            from .memory_audit import maybe_audit
+
+            maybe_audit(entry, feed_arrays, params_ro, params_rw,
+                        params_carry, rng)
+
+        try:
+            with ctx, RecordEvent("Executor::Run"):
+                fetches, updated, updated_carry = entry.jfn(
+                    feed_arrays, params_ro, params_rw, params_carry, rng)
+        except Exception:
+            if params_carry:
+                # the carry inputs were donated: a failed call may have
+                # consumed them, so drop the cache (next run reconverts
+                # from the still-live f32 masters)
+                cache = scope.__dict__.get("_layout_carry_cache") or {}
+                for n in params_carry:
+                    cache.pop(n, None)
+            raise
 
         for n, val in updated.items():
             scope.var(n).set(val)
+        if updated_carry:
+            # refresh the carry cache: pair each bf16 copy with the scope
+            # object it mirrors so staleness is caught by identity (an
+            # external scope.set — checkpoint restore — forces reconvert)
+            cache = scope.__dict__.setdefault("_layout_carry_cache", {})
+            for n, bf in updated_carry.items():
+                if n in updated:
+                    cache[n] = (scope.var(n).get_tensor().get(), bf)
+                elif n in cache:
+                    cache[n] = (cache[n][0], bf)
+                else:
+                    cache[n] = (None, bf)
 
         from ..flags import flag as _flag
 
@@ -349,16 +382,54 @@ class Executor:
             val = np.asarray(val, dtype=dtype_to_np(v.dtype))
         return val
 
+    def _gather_carry(self, scope, plan, block):
+        """bf16 layout-matched copies for plan.carry_names, cached per scope
+        and validated against the f32 master by OBJECT IDENTITY: as long as
+        the scope still holds the exact array the copy was derived from
+        (i.e. only the compiled step has updated it), the cached bf16 array
+        is current; any external scope.set (checkpoint restore, manual
+        assignment) breaks identity and forces a fresh convert."""
+        carry_names = getattr(plan, "carry_names", None)
+        if not carry_names:
+            return {}
+        cache = scope.__dict__.setdefault("_layout_carry_cache", {})
+        out = {}
+        for n in carry_names:
+            master = self._scope_value(scope, n, block)
+            ent = cache.get(n)
+            if ent is not None and ent[0] is master:
+                out[n] = ent[1]
+                continue
+            bf = jnp.asarray(master).astype(jnp.bfloat16)
+            cache[n] = (master, bf)
+            out[n] = bf
+        return out
+
     def _compile(self, program, feed_names, fetch_names, mesh, data_axis):
         from .lowering import build_spmd_block_fn, has_collective_ops
 
+        from .. import flags as _flags
+
         block = program.global_block()
-        plan = BlockPlan(block, feed_names, fetch_names)
+        no_donate = getattr(program, '_no_donate', False)
+        spmd = mesh is None and has_collective_ops(block)
+        # layout-matched param carry: single-process, single-device-program,
+        # donated programs only — carry buffers alias across steps via
+        # donation, and the SPMD/mesh paths spec params per-name
+        allow_carry = (
+            bool(_flags.flag("layout_match_params"))
+            and mesh is None and not spmd and not no_donate
+            and jax.process_count() == 1
+        )
+        plan = BlockPlan(block, feed_names, fetch_names,
+                         allow_carry=allow_carry)
         # pipeline sections share param buffers across concurrently
         # running executors — donation would let one section delete an
-        # array another still reads (real on TPU; CPU ignores donation)
-        donate = () if getattr(program, '_no_donate', False) else (2,)
-        if mesh is None and has_collective_ops(block):
+        # array another still reads (real on TPU; CPU ignores donation).
+        # The bf16 carry dict (arg 3) is donated alongside params_rw so a
+        # read-only carry aliases its output and survives step to step.
+        donate = () if no_donate else (2, 3)
+        if spmd:
             # fleet/transpiler collective path: program-level c_* ops ->
             # manual SPMD over all local devices (reference: one process
             # per GPU + NCCL ring; here: shard_map over the mesh axis).
@@ -368,8 +439,14 @@ class Executor:
             from jax.sharding import Mesh
 
             mesh = Mesh(np.array(jax.devices()), ("data",))
-            fn = _with_seed_counter(build_spmd_block_fn(plan, mesh, axis="data"))
-            jfn = jax.jit(fn, donate_argnums=donate)
+            sfn = build_spmd_block_fn(plan, mesh, axis="data")
+
+            def fn5(feeds, params_ro, params_rw, params_carry, key,
+                    _sfn=sfn):
+                fetches, updated = _sfn(feeds, params_ro, params_rw, key)
+                return fetches, updated, {}
+
+            jfn = jax.jit(_with_seed_counter(fn5), donate_argnums=donate)
             return _CompiledPlan(plan, jfn, mesh, "data")
         fn = _with_seed_counter(build_block_fn(plan, mesh=mesh))
         if mesh is None:
@@ -380,7 +457,8 @@ class Executor:
             replicated = NamedSharding(mesh, P())
             out_shardings = ([replicated] * len(fetch_names),
                              {n: self._param_sharding(mesh, block, n)
-                              for n in plan.persist_written})
+                              for n in plan.persist_written},
+                             {})
             jfn = jax.jit(fn, donate_argnums=donate, out_shardings=out_shardings)
         return _CompiledPlan(plan, jfn)
 

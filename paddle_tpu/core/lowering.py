@@ -13,7 +13,8 @@ import jax.numpy as jnp
 
 from .registry import get_op_def, _lower_attrs
 
-__all__ = ["LowerCtx", "BlockPlan", "analyze_block", "build_block_fn"]
+__all__ = ["LowerCtx", "BlockPlan", "analyze_block", "analyze_param_carry",
+           "build_block_fn"]
 
 
 class LowerCtx:
@@ -125,7 +126,7 @@ def analyze_block(block, feed_names):
 class BlockPlan:
     """Compiled execution plan for one block + feed/fetch signature."""
 
-    def __init__(self, block, feed_names, fetch_names):
+    def __init__(self, block, feed_names, fetch_names, allow_carry=False):
         self.block = block
         self.feed_names = list(feed_names)
         self.fetch_names = list(fetch_names)
@@ -136,6 +137,114 @@ class BlockPlan:
         self.rw_names = [n for n in ext if n in set(persist_written)]
         rw = set(self.rw_names)
         self.ro_names = [n for n in ext if n not in rw]
+        # layout-matched param carry (FLAGS_layout_match_params): persistent
+        # f32 weights whose every read is a bf16 matmul/conv consumption
+        # enter the compiled step as bf16 arrays pinned across steps
+        self.carry_names = (
+            analyze_param_carry(block, self.feed_names, fetch_names,
+                                self.ro_names, self.rw_names)
+            if allow_carry else [])
+        if self.carry_names:
+            carried = set(self.carry_names)
+            # read-only carried params drop out of the f32 argument list
+            # entirely: the trace only ever sees their bf16 carry copy
+            self.ro_names = [n for n in self.ro_names if n not in carried]
+
+
+# forward op types whose lowerings consume their (weight) operands in bf16
+# under the AMP policy — the set a carried param may be read by.  The
+# synthesized `<type>_grad` ops replay the forward via jax.vjp, so they
+# consume the same bf16 value and yield a bf16 cotangent (the same value
+# the old astype-vjp upcast produced, so the optimizer's astype(f32) is
+# bitwise-identical to the per-step-cast scheme).
+_CARRY_CONSUMERS = frozenset((
+    "mul", "matmul", "matmul_v2", "conv2d", "depthwise_conv2d",
+))
+
+# optimizer op types: their "Param" slot must read the f32 MASTER value
+# (redirected to <name>@MASTER by _gather_slot), never the bf16 carry
+_OPTIMIZER_TYPES = frozenset((
+    "sgd", "momentum", "adam", "adamax", "adagrad", "decayed_adagrad",
+    "adadelta", "rmsprop", "lars_momentum", "lamb", "ftrl", "dpsgd",
+    "fused_sgd", "fused_momentum", "fused_adam",
+))
+
+# ops with sub-blocks read outer vars through ctx.env without appearing in
+# the top-level input scan — carry analysis cannot see those reads
+_SUBBLOCK_OPS = frozenset((
+    "while", "conditional_block", "recurrent", "py_func",
+))
+
+_MASTER_SUFFIX = "@MASTER"
+
+
+def analyze_param_carry(block, feed_names, fetch_names, ro_names, rw_names):
+    """Names of persistable f32 params safe to pin in bf16 across steps.
+
+    Eligible: every reader is either (a) an optimizer op reading the param
+    via its "Param" slot (redirected to the f32 master inside the trace) or
+    (b) one forward op in _CARRY_CONSUMERS plus at most one matching grad
+    op (whose vjp replay consumes the identical bf16 value); the only
+    writer, if any, is that optimizer's in-place ParamOut.  Feed/fetch
+    targets and blocks containing sub-block ops are excluded — a fetched
+    param must come back f32, and sub-blocks read outer vars invisibly to
+    this scan.  The single-forward-consumer rule keeps gradient
+    accumulation out of scope: two bf16 branch grads would sum in bf16
+    where the per-step-cast scheme summed their f32 upcasts."""
+    import numpy as np
+
+    from ..framework import dtype_to_np
+
+    if any(op.type in _SUBBLOCK_OPS for op in block.ops):
+        return []
+    prog = block.program
+    if not getattr(prog, "_amp_bf16", False):
+        return []
+    candidates = [n for n in list(ro_names) + list(rw_names)
+                  if n not in set(feed_names) and n not in set(fetch_names)]
+    readers = {}
+    writers = {}
+    for op in _iter_runtime_ops(block):
+        for n in op.input_arg_names:
+            if n:
+                readers.setdefault(n, []).append(op)
+        for n in op.output_arg_names:
+            if n:
+                writers.setdefault(n, []).append(op)
+    out = []
+    for n in candidates:
+        v = block._find_var_recursive(n)
+        if v is None or not v.persistable or v.shape is None:
+            continue
+        try:
+            if v.dtype is None or dtype_to_np(v.dtype) != np.float32:
+                continue
+        except Exception:
+            continue
+        n_fwd = n_grad = 0
+        ok = True
+        for op in readers.get(n, ()):  # classify every reader
+            if (op.type in _OPTIMIZER_TYPES
+                    and n in op.input("Param")):
+                continue  # master read (redirected inside the trace)
+            if op.type in _CARRY_CONSUMERS:
+                n_fwd += 1
+            elif (op.type.endswith("_grad")
+                    and op.type[:-5] in _CARRY_CONSUMERS):
+                n_grad += 1
+            else:
+                ok = False
+                break
+        if not ok or n_fwd != 1 or n_grad > 1:
+            continue
+        for op in writers.get(n, ()):  # only in-place optimizer ParamOut
+            if not (op.type in _OPTIMIZER_TYPES
+                    and n in op.output("ParamOut")):
+                ok = False
+                break
+        if ok:
+            out.append(n)
+    return out
 
 
 def _gather_slot(opdef, op, slot, env):
@@ -146,12 +255,19 @@ def _gather_slot(opdef, op, slot, env):
         or slot.startswith("GRAD@")
         or slot.startswith("Out@")
     )
+    # layout-matched carry: an optimizer's Param slot must read the f32
+    # MASTER value, not the bf16 carry copy the forward/grad ops consume.
+    # Only optimizer ops have a "Param" input slot, and carry eligibility
+    # already guarantees every other reader wants the bf16 value.
+    master = slot == "Param"
     vals = []
     for n in names:
         if not n:
             vals.append(None)
             continue
-        if n in env:
+        if master and (n + _MASTER_SUFFIX) in env:
+            vals.append(env[n + _MASTER_SUFFIX])
+        elif n in env:
             vals.append(env[n])
         elif optional or n.endswith("@GRAD") or "@GRAD@" in n:
             vals.append(None)
@@ -284,6 +400,9 @@ def build_spmd_block_fn(plan, mesh, axis="data"):
     persist_written = plan.persist_written
 
     def local(feeds, params_ro, params_rw, rng):
+        # param carry is disabled under SPMD (plan.carry_names empty): the
+        # shard_map in/out specs are built per-name and the donation
+        # aliasing story differs — carry is a single-process optimization
         env = {}
         env.update(params_ro)
         env.update(params_rw)
@@ -332,19 +451,33 @@ def build_spmd_block_fn(plan, mesh, axis="data"):
 
 
 def build_block_fn(plan, mesh=None, axis_names=()):
-    """Return fn(feeds, params_ro, params_rw, rng) -> (fetches, updated_rw).
+    """Return fn(feeds, params_ro, params_rw, params_carry, rng) ->
+    (fetches, updated_rw, updated_carry).
 
     feeds/params are dicts name->array. `rng` is a jax PRNG key; op i uses
     fold_in(rng, i) so randomness is deterministic per (seed, step, op).
-    """
+
+    `params_carry` holds the bf16 layout-matched copies of carried params
+    (plan.carry_names): inside the trace the f32 master of a carried
+    read-write param moves to <name>@MASTER (read only by the optimizer's
+    Param slot via _gather_slot) while every forward/grad op reads the bf16
+    carry under the original name.  The returned `updated_carry` is the
+    next step's carry dict: the f32 ParamOut refreshed to bf16 (the convert
+    fuses into the update kernel), or the unchanged donated input for
+    read-only carries (aliased, zero-copy)."""
     block = plan.block
     fetch_names = plan.fetch_names
     persist_written = plan.persist_written
+    carry_names = list(getattr(plan, "carry_names", ()))
 
-    def fn(feeds, params_ro, params_rw, rng):
+    def fn(feeds, params_ro, params_rw, params_carry, rng):
         env = {}
         env.update(params_ro)
         env.update(params_rw)
+        for n in carry_names:
+            if n in env:  # rw-carried: keep the f32 master under @MASTER
+                env[n + _MASTER_SUFFIX] = env.pop(n)
+        env.update(params_carry)
         env.update(feeds)
         for i, op in enumerate(_iter_runtime_ops(block)):
             key = jax.random.fold_in(rng, i) if rng is not None else None
@@ -355,6 +488,12 @@ def build_block_fn(plan, mesh=None, axis_names=()):
                 raise KeyError("fetch target %r was never produced" % n)
             fetches.append(env[n])
         updated = {n: env[n] for n in persist_written if n in env}
-        return fetches, updated
+        updated_carry = {}
+        for n in carry_names:
+            v = env[n]  # f32 new master after ParamOut, else the bf16 carry
+            if v.dtype != jnp.bfloat16:
+                v = v.astype(jnp.bfloat16)
+            updated_carry[n] = v
+        return fetches, updated, updated_carry
 
     return fn

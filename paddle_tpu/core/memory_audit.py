@@ -1,0 +1,141 @@
+"""HBM footprint auditor: attribute a compiled step's live-buffer peak to
+program variables (FLAGS_hbm_audit; tools/profile_bert_step.py --audit).
+
+XLA's ``compiled.memory_analysis()`` reports the executable's buffer
+budget — argument / output / temp / alias bytes — but not which *program
+var* each argument byte belongs to.  This module pairs that analysis with
+the BlockPlan's name->array mapping so the report reads in model terms:
+which params ride f32 vs the bf16 carry, which feeds dominate, and how much
+of the peak is activation temp (the remat lever) vs resident state (the
+donation lever).
+
+The audit runs through the AOT path (``jit(fn).lower(...).compile()``),
+which does NOT share jax's call-site executable cache — with the flag on,
+each cache entry compiles twice.  That is acceptable for a diagnostic flag
+that defaults off.
+"""
+
+import logging
+
+import numpy as np
+
+__all__ = ["memory_report", "format_report", "maybe_audit"]
+
+
+def _nbytes(x):
+    try:
+        return int(np.prod(x.shape)) * x.dtype.itemsize
+    except Exception:
+        return 0
+
+
+def _analysis_dict(ma):
+    out = {}
+    for k in ("argument_size_in_bytes", "output_size_in_bytes",
+              "temp_size_in_bytes", "alias_size_in_bytes",
+              "generated_code_size_in_bytes"):
+        v = getattr(ma, k, None)
+        if v is not None:
+            out[k] = int(v)
+    return out
+
+
+def memory_report(jfn, feeds, params_ro, params_rw, params_carry, rng,
+                  plan=None):
+    """Compile `jfn` AOT for this signature and return a dict report:
+    XLA's memory_analysis totals plus per-variable argument attribution
+    (name, bytes, dtype, class) sorted largest-first."""
+    lowered = jfn.lower(feeds, params_ro, params_rw, params_carry, rng)
+    compiled = lowered.compile()
+    try:
+        ma = compiled.memory_analysis()
+        analysis = _analysis_dict(ma) if ma is not None else {}
+    except Exception as e:  # backend without the query (older PJRT)
+        analysis = {"error": str(e)}
+    groups = (("feed", feeds), ("param_ro", params_ro),
+              ("param_rw", params_rw), ("carry_bf16", params_carry))
+    by_var = []
+    totals = {}
+    for cls, d in groups:
+        sub = 0
+        for n, v in d.items():
+            b = _nbytes(v)
+            sub += b
+            by_var.append({"name": n, "class": cls, "bytes": b,
+                           "dtype": str(getattr(v, "dtype", "?")),
+                           "shape": list(getattr(v, "shape", ()))})
+        totals[cls] = sub
+    by_var.sort(key=lambda r: -r["bytes"])
+    report = {
+        "analysis": analysis,
+        "arg_bytes_by_class": totals,
+        "vars": by_var,
+    }
+    if plan is not None:
+        report["carry_names"] = list(getattr(plan, "carry_names", ()))
+        # what the carry saves: carried params would otherwise enter f32
+        # AND pay a per-step bf16 convert copy inside the program
+        report["carry_saved_bytes"] = sum(
+            r["bytes"] for r in by_var if r["class"] == "carry_bf16")
+    return report
+
+
+def _fmt_mb(b):
+    return "%.1f MB" % (b / 1e6)
+
+
+def format_report(report, top=12):
+    lines = []
+    a = report.get("analysis", {})
+    if a and "error" not in a:
+        lines.append(
+            "hbm_audit: args=%s output=%s temp=%s alias=%s" % (
+                _fmt_mb(a.get("argument_size_in_bytes", 0)),
+                _fmt_mb(a.get("output_size_in_bytes", 0)),
+                _fmt_mb(a.get("temp_size_in_bytes", 0)),
+                _fmt_mb(a.get("alias_size_in_bytes", 0))))
+        peak = (a.get("argument_size_in_bytes", 0)
+                + a.get("output_size_in_bytes", 0)
+                + a.get("temp_size_in_bytes", 0)
+                - a.get("alias_size_in_bytes", 0))
+        lines.append("hbm_audit: upper-bound live peak ~%s "
+                     "(args+outputs+temp-aliased)" % _fmt_mb(peak))
+    elif a:
+        lines.append("hbm_audit: memory_analysis unavailable: %s"
+                     % a.get("error"))
+    cls = report.get("arg_bytes_by_class", {})
+    lines.append("hbm_audit: by class  " + "  ".join(
+        "%s=%s" % (k, _fmt_mb(v)) for k, v in sorted(cls.items())))
+    if report.get("carry_names"):
+        lines.append(
+            "hbm_audit: %d params ride the bf16 carry (%s resident bf16 "
+            "instead of a per-step f32->bf16 copy)" % (
+                len(report["carry_names"]),
+                _fmt_mb(report.get("carry_saved_bytes", 0))))
+    for r in report.get("vars", [])[:top]:
+        lines.append("hbm_audit:   %-40s %10s  %-10s %s" % (
+            r["name"][:40], _fmt_mb(r["bytes"]), r["dtype"],
+            "x".join(str(s) for s in r["shape"])))
+    return "\n".join(lines)
+
+
+_audited = set()
+
+
+def maybe_audit(entry, feeds, params_ro, params_rw, params_carry, rng,
+                log=None):
+    """Audit one _CompiledPlan at most once (keyed by the entry object);
+    called from Executor.run when FLAGS_hbm_audit is set."""
+    key = id(entry)
+    if key in _audited:
+        return None
+    _audited.add(key)
+    try:
+        report = memory_report(entry.jfn, feeds, params_ro, params_rw,
+                               params_carry, rng, plan=entry.plan)
+    except Exception as e:
+        logging.warning("hbm_audit failed: %s", e)
+        return None
+    text = format_report(report)
+    (log or logging.warning)(text)
+    return report

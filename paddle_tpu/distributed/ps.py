@@ -41,6 +41,12 @@ transport's retry/backoff lives in native/rpc.py):
   even when it is parked in poll().  A round's barrier quorum is the LIVE
   set (all - completed - evicted), so rounds keep flowing on survivors.
   Any later contact from an evicted trainer re-admits it.
+- Eviction / state reclaim (async mode): the same ``__evict__`` self-RPC
+  drops the silent trainer's _ReplayFilter entry and liveness slot, so
+  server-side per-trainer state stays bounded by the LIVE trainer set.  A
+  relaunched incarnation re-keys under a fresh nonce and its first
+  heartbeat re-registers with the monitor — re-admission is automatic.
+  Geo mode pushes no heartbeats, so eviction stays disabled there.
 - Rejoin: the current round number is published under ``__round__`` and the
   last TWO param versions stay available, so a supervised relaunch
   (distributed/launch.py --restart_failed) can sync its round counter and
@@ -109,6 +115,14 @@ class _ReplayFilter:
         self._last[tid] = (nonce, seq)
         return True
 
+    def evict(self, tid):
+        """Forget a trainer's dedupe state (heartbeat eviction): bounds the
+        filter to live trainers.  Safe because a relaunched incarnation
+        re-keys under a fresh nonce regardless, and the evicted trainer has
+        been silent past the heartbeat timeout — far beyond the RPC retry
+        budget, so no replayed frame of its old incarnation is in flight."""
+        self._last.pop(tid, None)
+
 
 def _handle_hb(monitor, name):
     """Returns True if `name` was a heartbeat/bye event (consumed)."""
@@ -139,9 +153,12 @@ def run_pserver(exe, program, scope):
     completed = [0]
     monitor = HeartBeatMonitor(trainers, name="ps:%s" % endpoint)
     # sync mode graduates the monitor from logging to EVICTION: the round
-    # loop re-quorums on survivors.  Async eviction is an open item
-    # (ROADMAP) — there a dead trainer cannot deadlock a barrier anyway.
-    evict_enabled = bool(meta.get("sync", True)) and not meta.get("geo", False)
+    # loop re-quorums on survivors.  Async mode has no barrier to deadlock,
+    # but a dead trainer still pins server state (replay-filter entry +
+    # liveness slot), so eviction reclaims those instead.  Geo stays
+    # log-only: geo trainers push no heartbeats, so there is no liveness
+    # signal to evict on.
+    evict_enabled = not meta.get("geo", False)
     # dedicated checker thread (heart_beat_monitor.h runs the monitor in its
     # own thread): a dead trainer in sync mode leaves the server blocked in
     # poll(), so arrival-driven checks alone would never fire.  Evictions
@@ -333,6 +350,17 @@ def run_pserver(exe, program, scope):
             if _handle_hb(monitor, base):
                 continue
             if base.startswith(_EVICT_PREFIX):
+                # reclaim the silent trainer's server-side state: its
+                # replay-filter entry and liveness slot would otherwise
+                # live forever.  A relaunched incarnation re-keys under a
+                # fresh nonce, and its first heartbeat re-registers with
+                # the monitor, so re-admission is automatic.
+                w = int(base[len(_EVICT_PREFIX):])
+                replay.evict(w)
+                monitor.remove(w)
+                logging.warning(
+                    "[ps:%s] evicted silent trainer %d (async) — "
+                    "replay/liveness state reclaimed", endpoint, w)
                 continue
             if base in grad_to_param:
                 if not replay.fresh(tid, nonce, seq):

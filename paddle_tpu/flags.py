@@ -45,6 +45,26 @@ _DEFAULTS = {
     # (heart_beat_monitor.h): a trainer silent this long is EVICTED from
     # the sync quorum (rounds re-quorum on survivors) until it re-contacts.
     "FLAGS_worker_hb_timeout": 60.0,
+    # layout-matched persistent params (core/lowering.py param carry): AMP
+    # programs pin eligible weights in their bf16 compute dtype ACROSS steps
+    # (the scope keeps the f32 master for the optimizer), so the compiled
+    # step stops re-materializing f32->bf16 converts + layout copies of
+    # ~85 MB of encoder weights every iteration.  Safe default-on: carry
+    # engages only where it is bitwise-identical to the per-step cast
+    # (single-consumer matmul/conv weights, single-process, no mesh).
+    "FLAGS_layout_match_params": True,
+    # HBM footprint auditor (core/memory_audit.py): after each compile, log
+    # the executable's memory_analysis (arg/output/temp/alias bytes) with
+    # per-variable attribution of the argument footprint.  Diagnostic; adds
+    # one extra AOT compile per cache entry, so default-off.
+    "FLAGS_hbm_audit": False,
+    # max param rank eligible for horizontal optimizer fusion
+    # (ir.py FuseOptimizerOpsPass).  2 fuses BERT's [h,h]/[h,4h] encoder
+    # weights into one fused_adam group (the r5 wgrad/Adam residue) while
+    # keeping 4-D conv kernels unfused — flattening tiled TPU layouts
+    # costs relayout copies exceeding the launch savings (round-3:
+    # fuse-everything = 1786 img/s vs 2200 unfused).  0 = no restriction.
+    "FLAGS_fuse_optimizer_max_rank": 2,
     # opt-in fused Pallas LayerNorm (pallas_kernels/layer_norm.py): wins
     # standalone microbenches, measured -1.5% inside full BERT on the
     # bench chip (breaks XLA's LN-neighbor fusions) — see ops/nn.py

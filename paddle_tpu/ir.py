@@ -568,9 +568,13 @@ class FuseOptimizerOpsPass(Pass):
     bias correction."""
 
     MIN_GROUP = 4
-    # fuse only params of rank <= this (0 = no restriction); 1-D params
-    # (BN gamma/beta, biases) are linear-layout so concat is copy-free
-    max_param_rank = 1
+    # fuse only params of rank <= this (0 = no restriction).  None reads
+    # FLAGS_fuse_optimizer_max_rank at apply time (default 2: BERT's 2-D
+    # encoder weights + embeddings fuse into one adam group; 4-D conv
+    # kernels stay unfused — flattening tiled TPU layouts costs relayout
+    # copies that exceed the launch savings).  1-D params (BN gamma/beta,
+    # biases) are linear-layout so concat is copy-free at any setting.
+    max_param_rank = None
     _STATE_SLOTS = {
         "sgd": ("Param", "Grad"),
         "momentum": ("Param", "Grad", "Velocity"),
@@ -607,7 +611,11 @@ class FuseOptimizerOpsPass(Pass):
                    None if pv is None else pv.dtype, attrs_key)
             groups.setdefault(key, []).append(op)
 
-        max_rank = int(self.max_param_rank)
+        if self.max_param_rank is None:
+            from .flags import flag as _flag
+            max_rank = int(_flag("fuse_optimizer_max_rank") or 0)
+        else:
+            max_rank = int(self.max_param_rank)
         replaced = {}
         for (op_type, lr_name, _dt, _ak), ops in groups.items():
             if max_rank:

@@ -71,18 +71,35 @@ def mul(ctx, x, y, x_num_col_dims=1, y_num_col_dims=1, **_):
 )
 def matmul(ctx, x, y, transpose_X=False, transpose_Y=False, alpha=1.0,
            head_number=1):
-    def t(a, flag):
-        if not flag:
-            return a
-        if a.ndim == 1:
-            return a
-        perm = list(range(a.ndim))
-        perm[-1], perm[-2] = perm[-2], perm[-1]
-        return jnp.transpose(a, perm)
+    if x.ndim == y.ndim and x.ndim >= 2 and x.shape[:-2] == y.shape[:-2]:
+        # dimension-order canonicalization: express the transpose flags as
+        # dot_general contracting dims instead of materializing
+        # jnp.transpose copies.  XLA folds the dimension numbers into the
+        # MXU pass directly, so q@k^T / weight^T consumers stop paying a
+        # layout copy per step.  Output is [batch..., M, N] for every flag
+        # combination — identical to transpose-then-matmul.
+        n = x.ndim
+        batch = tuple(range(n - 2))
+        cx = n - 2 if transpose_X else n - 1
+        cy = n - 1 if transpose_Y else n - 2
+        out = _amp_dot(
+            ctx, x, y,
+            lambda a, b: jax.lax.dot_general(
+                a, b, (((cx,), (cy,)), (batch, batch))))
+    else:
+        # 1-D / rank-broadcast operands: numpy matmul semantics
+        def t(a, flag):
+            if not flag:
+                return a
+            if a.ndim == 1:
+                return a
+            perm = list(range(a.ndim))
+            perm[-1], perm[-2] = perm[-2], perm[-1]
+            return jnp.transpose(a, perm)
 
-    x_, y_ = t(x, transpose_X), t(y, transpose_Y)
-    # fluid allows [K] vectors: matmul handles 1-D semantics like numpy
-    out = _amp_dot(ctx, x_, y_, jnp.matmul)
+        x_, y_ = t(x, transpose_X), t(y, transpose_Y)
+        # fluid allows [K] vectors: matmul handles 1-D semantics like numpy
+        out = _amp_dot(ctx, x_, y_, jnp.matmul)
     if alpha != 1.0:
         out = out * jnp.asarray(alpha, dtype=out.dtype)
     return out

@@ -235,6 +235,72 @@ class TestFusedOps:
         np.testing.assert_allclose(l1, np.abs(x).sum(), rtol=1e-5)
 
 
+class TestMatmulFamilySecondConfigs:
+    """Second shape/dtype/attr golden configs for the matmul/mul/fc
+    family: the dot_general dimension-order canonicalization (ops/math.py)
+    expresses the transpose flags as contracting dims instead of
+    materialized transposes, and must stay output-identical to
+    transpose-then-matmul for every flag combination."""
+
+    def test_matmul_3d_batched_transpose_x(self):
+        rng = np.random.RandomState(9)
+        x = rng.rand(2, 4, 3).astype("f")   # [B, K, M] under transpose_X
+        y = rng.rand(2, 4, 5).astype("f")   # [B, K, N]
+        out, = _run_single_op("matmul", {"X": x, "Y": y},
+                              {"transpose_X": True}, ["Out"])
+        want = np.matmul(x.transpose(0, 2, 1), y)
+        np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+
+    def test_matmul_2d_transpose_y_alpha(self):
+        rng = np.random.RandomState(10)
+        x = rng.rand(3, 4).astype("f")
+        y = rng.rand(5, 4).astype("f")
+        out, = _run_single_op("matmul", {"X": x, "Y": y},
+                              {"transpose_Y": True, "alpha": 0.5}, ["Out"])
+        np.testing.assert_allclose(out, 0.5 * (x @ y.T), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_matmul_both_transposed_batched(self):
+        rng = np.random.RandomState(11)
+        x = rng.rand(2, 4, 3).astype("f")
+        y = rng.rand(2, 5, 4).astype("f")
+        out, = _run_single_op("matmul", {"X": x, "Y": y},
+                              {"transpose_X": True, "transpose_Y": True},
+                              ["Out"])
+        want = np.matmul(x.transpose(0, 2, 1), y.transpose(0, 2, 1))
+        np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+
+    def test_matmul_v2_4d_trans_y(self):
+        # the attention q@k^T shape class: [B, H, S, D] x [B, H, S, D]^T
+        rng = np.random.RandomState(12)
+        q = rng.rand(2, 3, 4, 5).astype("f")
+        k = rng.rand(2, 3, 4, 5).astype("f")
+        out, = _run_single_op("matmul_v2", {"X": q, "Y": k},
+                              {"trans_y": True}, ["Out"])
+        want = np.matmul(q, k.transpose(0, 1, 3, 2))
+        np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+
+    def test_mul_x_num_col_dims_2(self):
+        rng = np.random.RandomState(13)
+        x = rng.rand(2, 3, 4).astype("f")   # flattens to [6, 4]
+        y = rng.rand(4, 5).astype("f")
+        out, = _run_single_op("mul", {"X": x, "Y": y},
+                              {"x_num_col_dims": 2, "y_num_col_dims": 1},
+                              ["Out"])
+        want = (x.reshape(6, 4) @ y).reshape(2, 3, 5)
+        np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+
+    def test_fc_in_num_col_dims_2(self):
+        rng = np.random.RandomState(14)
+        x = rng.rand(2, 3, 6).astype("f")
+        w = rng.rand(6, 4).astype("f")
+        b = rng.rand(4).astype("f")
+        out, = _run_single_op("fc", {"Input": x, "W": w, "Bias": b},
+                              {"in_num_col_dims": 2}, ["Out"])
+        want = (x.reshape(6, 6) @ w + b).reshape(2, 3, 4)
+        np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+
+
 class TestQuantTail:
     def test_dequantize_abs_max(self):
         x = np.array([[-127, 64, 127]], "int8")

@@ -90,11 +90,12 @@ def test_mixed_lr_not_fused_together():
         sgds = [op for op in block.ops if op.type == "sgd"]
         for op in sgds[:2]:
             op.inputs["LearningRate"] = ["lr_b"]
+        old = ir.FuseOptimizerOpsPass.max_param_rank
         ir.FuseOptimizerOpsPass.max_param_rank = 0
         try:
             ir.apply_pass("fuse_optimizer_ops_pass", main, None)
         finally:
-            ir.FuseOptimizerOpsPass.max_param_rank = 1
+            ir.FuseOptimizerOpsPass.max_param_rank = old
         types = [op.type for op in block.ops]
         # 2+2 split: neither group reaches MIN_GROUP=4 -> nothing fused
         assert types.count("sgd") == 4
@@ -130,11 +131,12 @@ def test_hazard_blocks_fusion():
         ops = list(block.ops)
         ops.insert(sgds[2], reader)
         block.ops = ops
+        old = ir.FuseOptimizerOpsPass.max_param_rank
         ir.FuseOptimizerOpsPass.max_param_rank = 0
         try:
             ir.apply_pass("fuse_optimizer_ops_pass", main, None)
         finally:
-            ir.FuseOptimizerOpsPass.max_param_rank = 1
+            ir.FuseOptimizerOpsPass.max_param_rank = old
         types = [op.type for op in block.ops]
         assert "fused_sgd" not in types
         assert types.count("sgd") == 6

@@ -1,0 +1,216 @@
+"""Layout-matched param carry (FLAGS_layout_match_params; core/lowering.py
+analyze_param_carry + build_block_fn carry plumbing, core/executor.py
+_gather_carry).
+
+The contract: under AMP bf16-carry, eligible persistent f32 weights enter
+the compiled step as bf16 arrays pinned ACROSS steps (the scope keeps the
+f32 master for the optimizer), so the traced program contains NO per-step
+f32->bf16 convert of those params — and training is bitwise-identical to
+the per-step-cast scheme.  CPU-tier regression: inspect the jaxpr instead
+of a TPU profile.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu.core.lowering import BlockPlan, build_block_fn
+
+
+def _build_amp_net():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 7
+    startup.random_seed = 7
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8])
+        y = fluid.layers.data("y", shape=[1])
+        h = fluid.layers.fc(x, 16, act="relu",
+                            param_attr=fluid.ParamAttr(name="w1"))
+        pred = fluid.layers.fc(h, 1,
+                               param_attr=fluid.ParamAttr(name="w2"))
+        loss = fluid.layers.mean(fluid.layers.square(pred - y))
+        opt = fluid.contrib.mixed_precision.decorate(
+            fluid.optimizer.Adam(learning_rate=1e-2))
+        opt.minimize(loss)
+    return main, startup, loss
+
+
+def _plan_and_args(main, startup, loss, allow_carry):
+    """BlockPlan + concrete (feeds, ro, rw, carry, key) for tracing."""
+    block = main.global_block()
+    plan = BlockPlan(block, ["x", "y"], [loss.name],
+                     allow_carry=allow_carry)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        ro = {n: np.asarray(exe._scope_value(scope, n, block))
+              for n in plan.ro_names}
+        rw = {n: np.asarray(exe._scope_value(scope, n, block))
+              for n in plan.rw_names}
+        carry = {n: jnp.asarray(
+            exe._scope_value(scope, n, block)).astype(jnp.bfloat16)
+            for n in plan.carry_names}
+    feeds = {"x": np.zeros((4, 8), "float32"),
+             "y": np.zeros((4, 1), "float32")}
+    return plan, (feeds, ro, rw, carry, jax.random.key(0))
+
+
+def _count_param_bf16_converts(jaxpr, args):
+    """convert_element_type(2-D param INPUT -> bf16) equations: the
+    per-step weight cast the carry eliminates.  Invars are labeled by
+    flattening a same-structure label pytree, so feed casts don't count;
+    1-D params (biases — elementwise consumers, out of carry scope) keep
+    their per-step cast by design and don't count either."""
+    feeds, ro, rw, carry, key = args
+    labels = ({k: "feed" for k in feeds}, {k: "param" for k in ro},
+              {k: "param" for k in rw}, {k: "carry" for k in carry}, "key")
+    flat_labels = jax.tree_util.tree_flatten(labels)[0]
+    assert len(flat_labels) == len(jaxpr.jaxpr.invars)
+    param_invars = {v for v, lab in zip(jaxpr.jaxpr.invars, flat_labels)
+                    if lab == "param" and getattr(v.aval, "ndim", 0) == 2}
+    n = 0
+    for eqn in jaxpr.jaxpr.eqns:
+        if (eqn.primitive.name == "convert_element_type"
+                and eqn.params.get("new_dtype") == jnp.bfloat16
+                and eqn.invars[0] in param_invars):
+            n += 1
+    return n
+
+
+class TestCarryAnalysis:
+    def test_weights_carried_biases_not(self):
+        main, startup, loss = _build_amp_net()
+        plan, _ = _plan_and_args(main, startup, loss, allow_carry=True)
+        assert set(plan.carry_names) == {"w1", "w2"}
+
+    def test_requires_amp(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[8])
+            y = fluid.layers.data("y", shape=[1])
+            pred = fluid.layers.fc(x, 1,
+                                   param_attr=fluid.ParamAttr(name="wf"))
+            loss = fluid.layers.mean(fluid.layers.square(pred - y))
+            fluid.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+        block = main.global_block()
+        plan = BlockPlan(block, ["x", "y"], [loss.name], allow_carry=True)
+        # pure-f32 program: nothing consumes bf16, nothing to carry
+        assert plan.carry_names == []
+
+    def test_multi_consumer_not_carried(self):
+        """A weight read by TWO forward matmuls stays f32: its two bf16
+        branch grads would sum in bf16 where the per-step-cast scheme sums
+        their f32 upcasts (the divergence the single-consumer rule
+        forbids)."""
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[8])
+            y = fluid.layers.data("y", shape=[1])
+            w = fluid.layers.create_parameter([8, 8], "float32",
+                                              name="wshare")
+            h = fluid.layers.elementwise_add(
+                fluid.layers.matmul(x, w), fluid.layers.matmul(x, w))
+            pred = fluid.layers.fc(h, 1,
+                                   param_attr=fluid.ParamAttr(name="wp"))
+            loss = fluid.layers.mean(fluid.layers.square(pred - y))
+            opt = fluid.contrib.mixed_precision.decorate(
+                fluid.optimizer.Adam(learning_rate=1e-2))
+            opt.minimize(loss)
+        block = main.global_block()
+        plan = BlockPlan(block, ["x", "y"], [loss.name], allow_carry=True)
+        assert "wshare" not in plan.carry_names
+        assert "wp" in plan.carry_names
+
+    def test_fetched_param_not_carried(self):
+        main, startup, loss = _build_amp_net()
+        block = main.global_block()
+        plan = BlockPlan(block, ["x", "y"], [loss.name, "w1"],
+                         allow_carry=True)
+        # a fetched param must come back f32 under its own name
+        assert "w1" not in plan.carry_names
+
+
+class TestNoPerStepConverts:
+    def test_carry_eliminates_param_converts(self):
+        main, startup, loss = _build_amp_net()
+        plan_on, args_on = _plan_and_args(main, startup, loss,
+                                          allow_carry=True)
+        plan_off, args_off = _plan_and_args(main, startup, loss,
+                                            allow_carry=False)
+        jx_on = jax.make_jaxpr(build_block_fn(plan_on))(*args_on)
+        jx_off = jax.make_jaxpr(build_block_fn(plan_off))(*args_off)
+        # flag off: every 2-D weight pays an in-trace f32->bf16 cast
+        assert _count_param_bf16_converts(jx_off, args_off) >= 2
+        # flag on: carried weights enter bf16; the f32 masters are read
+        # only by the optimizer (in f32) and are never cast down
+        assert _count_param_bf16_converts(jx_on, args_on) == 0
+
+    def test_carry_inputs_are_bf16(self):
+        main, startup, loss = _build_amp_net()
+        plan, args = _plan_and_args(main, startup, loss, allow_carry=True)
+        jx = jax.make_jaxpr(build_block_fn(plan))(*args)
+        dtypes = [v.aval.dtype for v in jx.jaxpr.invars
+                  if getattr(v.aval, "ndim", 0) == 2]
+        assert jnp.bfloat16 in dtypes
+
+
+class TestEndToEndParity:
+    def _train(self, n_steps=5):
+        main, startup, loss = _build_amp_net()
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        rng = np.random.RandomState(0)
+        xb = rng.rand(8, 8).astype("float32")
+        yb = rng.rand(8, 1).astype("float32")
+        losses = []
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for _ in range(n_steps):
+                lo, = exe.run(main, feed={"x": xb, "y": yb},
+                              fetch_list=[loss])
+                losses.append(float(np.asarray(lo).reshape(-1)[0]))
+            w1 = np.asarray(scope.find_var("w1").get_tensor().numpy())
+        return losses, w1
+
+    def test_bitwise_parity_and_master_stays_f32(self):
+        """The carry is an identity transform on the numerics: the forward
+        consumes bf16(master) either way (converted once outside the step
+        vs in-trace every step), and the optimizer updates the f32 master
+        from the identical bf16-valued grad."""
+        try:
+            fluid.flags.set_flags({"FLAGS_layout_match_params": False})
+            base_losses, base_w1 = self._train()
+            fluid.flags.set_flags({"FLAGS_layout_match_params": True})
+            carry_losses, carry_w1 = self._train()
+        finally:
+            fluid.flags.set_flags({"FLAGS_layout_match_params": True})
+        assert carry_w1.dtype == np.float32
+        np.testing.assert_array_equal(carry_losses, base_losses)
+        np.testing.assert_array_equal(carry_w1, base_w1)
+
+    def test_external_set_invalidates_carry(self):
+        """An out-of-band scope write breaks the identity pairing and
+        forces a reconvert from the new master (checkpoint-restore path) —
+        the step must NOT keep computing with the stale bf16 copy."""
+        fluid.flags.set_flags({"FLAGS_layout_match_params": True})
+        main, startup, loss = _build_amp_net()
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        rng = np.random.RandomState(0)
+        xb = rng.rand(8, 8).astype("float32")
+        yb = rng.rand(8, 1).astype("float32")
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            lo0, = exe.run(main, feed={"x": xb, "y": yb},
+                           fetch_list=[loss])
+            # blow up w2 out-of-band: a stale carry would keep the small
+            # trained weights (loss ~ O(1)); the reconverted step sees the
+            # huge ones (loss ~ O(1e3))
+            scope.var("w2").set(np.full((16, 1), 100.0, "float32"))
+            lo1, = exe.run(main, feed={"x": xb, "y": yb},
+                           fetch_list=[loss])
+        assert float(np.asarray(lo0).reshape(-1)[0]) < 10.0
+        assert float(np.asarray(lo1).reshape(-1)[0]) > 100.0

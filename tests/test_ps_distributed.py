@@ -244,6 +244,101 @@ def test_async_ps_converges():
         assert last < 0.75 * first, final
 
 
+def test_async_eviction_reclaims_replay_state():
+    """Async-mode eviction (ps.py run_async __evict__ handler): a trainer
+    that stops heartbeating past FLAGS_worker_hb_timeout gets its replay-
+    filter entry and liveness slot reclaimed, so a frame reusing its old
+    (nonce, seq) tag is fresh again and applies.  A raw RpcClient plays the
+    trainer so the dedupe tag is fully controlled."""
+    import time
+
+    from paddle_tpu.distributed import ps as ps_mod
+    from paddle_tpu.native.rpc import RpcClient
+
+    old_to = fluid.flags.flag("worker_hb_timeout")
+    fluid.flags.set_flags({"FLAGS_worker_hb_timeout": 1.0})
+    errs = []
+    client = None
+    try:
+        ep = "127.0.0.1:%d" % _free_ports(1)[0]
+        main, startup, loss = _build(lr=0.5)
+        t = fluid.DistributeTranspiler()
+        t.transpile(trainer_id=0, program=main, startup_program=startup,
+                    pservers=ep, trainers=1, sync_mode=False)
+        prog, sprog = t.get_pserver_programs(ep)
+        grad_map = prog._ps_server["grad_map"]
+
+        def run_pserver():
+            try:
+                exe = fluid.Executor(fluid.CPUPlace())
+                scope = fluid.Scope()
+                with fluid.scope_guard(scope):
+                    exe.run(sprog)
+                    exe.run(prog, scope=scope)
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        th = threading.Thread(target=run_pserver, daemon=True)
+        th.start()
+
+        gname = next(iter(grad_map))
+        pname = grad_map[gname]
+        shape = tuple(main.global_block().var(pname).shape)
+        g = np.ones(shape, "float32")
+        pkey = ps_mod._vkey(pname, -1)
+        client = RpcClient(ep)
+
+        def wait_param(differs_from, timeout=20.0):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                cur = client.get_var(pkey)
+                if not np.array_equal(cur, differs_from):
+                    return cur
+                time.sleep(0.05)
+            return client.get_var(pkey)
+
+        v0 = client.get_var(pkey)
+        # heartbeat registers liveness, then one tagged grad applies
+        hb = np.asarray([0], np.int64)
+        client.send_var(ps_mod._HB_PREFIX + "0", hb)
+        tag = "%s%s0:123:0" % (gname, ps_mod._SEQ_SEP)
+        client.send_var(tag, g)
+        v1 = wait_param(v0)
+        assert not np.array_equal(v1, v0)
+
+        # replayed frame (same tag, live trainer): at-most-once filter
+        # drops it — the param must NOT move again
+        client.send_var(tag, g)
+        time.sleep(0.6)
+        np.testing.assert_array_equal(client.get_var(pkey), v1)
+
+        # go silent: no more heartbeats.  The checker thread evicts after
+        # the 1s timeout, reclaiming the (tid 0) replay entry; from then
+        # on the SAME tag is a fresh frame and applies.
+        applied = False
+        deadline = time.time() + 20.0
+        v_prev = client.get_var(pkey)
+        while time.time() < deadline:
+            client.send_var(tag, g)
+            time.sleep(0.4)
+            cur = client.get_var(pkey)
+            if not np.array_equal(cur, v_prev):
+                applied = True
+                break
+        assert applied, "evicted trainer's tag never became fresh again"
+
+        client.complete()
+        th.join(timeout=30)
+        assert not errs, errs
+    finally:
+        if client is not None:
+            try:
+                client.close()
+            except Exception:
+                pass
+        fluid.flags.set_flags({"FLAGS_worker_hb_timeout": old_to})
+
+
 def test_geo_sgd_converges():
     """Geo-SGD: local training + periodic delta pushes; both trainers'
     params drift toward each other through the server merge and the task

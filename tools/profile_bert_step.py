@@ -1,8 +1,20 @@
 """Capture a jax.profiler trace of the BERT bench step and print the
 per-fusion device-time decomposition (the round-4/5 optimization loop's
-measurement tool).
+measurement tool), plus an optional HBM footprint audit.
 
-Usage: python tools/profile_bert_step.py [steps]
+Usage: python tools/profile_bert_step.py [steps] [--steps N] [--audit]
+                                         [--tiny] [--no-trace]
+
+  --steps N    profiled steps (default 3; bare positional N still works)
+  --audit      print the compiled step's memory_analysis with per-var
+               attribution (core/memory_audit.py; same report as
+               FLAGS_hbm_audit=1) before the timing trace
+  --tiny       BERT_TINY config at batch 8 — a seconds-long CPU dry pass
+               (the run_ci.sh --layout-smoke leg)
+  --no-trace   skip the jax.profiler trace (audit/step-run only; the
+               profiler needs a real TPU to produce XLA-Ops lanes)
+
+Env: PROFILE_BATCH (default 192), PROFILE_TOP_OPS=1 for per-op listing.
 """
 
 import os
@@ -14,21 +26,43 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _parse_args(argv):
+    steps, audit, tiny, trace = 3, False, False, True
+    it = iter(argv)
+    for a in it:
+        if a == "--steps":
+            steps = int(next(it))
+        elif a.startswith("--steps="):
+            steps = int(a.split("=", 1)[1])
+        elif a == "--audit":
+            audit = True
+        elif a == "--tiny":
+            tiny = True
+        elif a == "--no-trace":
+            trace = False
+        elif a.lstrip("-").isdigit():
+            steps = int(a)
+        else:
+            raise SystemExit("unknown arg %r (see module docstring)" % a)
+    return steps, audit, tiny, trace
+
+
 def main():
     import jax
     import numpy as np
 
-    import bench
-    from timeline import from_xplane
-
-    steps = int(sys.argv[1]) if len(sys.argv) > 1 else 3
+    steps, audit, tiny, do_trace = _parse_args(sys.argv[1:])
 
     # build the bench step exactly as bench_bert does, but hand-run it
     import paddle_tpu as fluid
     from paddle_tpu.models import bert as bert_model
 
-    batch, seq = int(os.environ.get("PROFILE_BATCH", "192")), 128
-    cfg = bert_model.BERT_BASE
+    if tiny:
+        batch, seq = 8, 32
+        cfg = bert_model.BERT_TINY
+    else:
+        batch, seq = int(os.environ.get("PROFILE_BATCH", "192")), 128
+        cfg = bert_model.BERT_BASE
     # AMP like bench_bert — the f32 and bf16-carry programs have entirely
     # different fusion structures, so profiling the wrong one misleads
     main_p, startup = fluid.Program(), fluid.Program()
@@ -47,7 +81,16 @@ def main():
         opt = fluid.optimizer.Adam(learning_rate=1e-4)
         opt = fluid.contrib.mixed_precision.decorate(opt)
         opt.minimize(loss)
-    exe = fluid.Executor(fluid.TPUPlace(0))
+    if audit:
+        # route the executor's first-run audit hook to stdout
+        fluid.flags.set_flags({"FLAGS_hbm_audit": True})
+        import logging as _logging
+
+        _logging.basicConfig()
+        _logging.getLogger().setLevel(_logging.WARNING)
+    place = fluid.CPUPlace() if jax.default_backend() == "cpu" \
+        else fluid.TPUPlace(0)
+    exe = fluid.Executor(place)
     scope = fluid.Scope()
     rng = np.random.RandomState(0)
     n_mask = batch * int(seq * 0.15)
@@ -68,9 +111,22 @@ def main():
                            return_numpy=False)
             return out
 
-        for _ in range(3):
+        for _ in range(max(min(3, steps), 1)):
             out = step()
         np.asarray(out)
+        print("profile_bert_step: cfg=%s batch=%d seq=%d backend=%s "
+              "loss=%.4f" % ("tiny" if tiny else "base", batch, seq,
+                             jax.default_backend(),
+                             float(np.asarray(out).reshape(-1)[0])))
+
+        if not do_trace:
+            for _ in range(steps):
+                out = step()
+            np.asarray(out)
+            print("profile_bert_step: %d steps ran (trace skipped)" % steps)
+            return
+
+        from timeline import from_xplane
 
         tmpd = tempfile.mkdtemp(prefix="bert_prof_")
         with jax.profiler.trace(tmpd):

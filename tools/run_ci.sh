@@ -1,11 +1,24 @@
 #!/usr/bin/env bash
 # CI harness (reference paddle/scripts/paddle_build.sh analog): build the
 # native pieces, run the full test pyramid, smoke the bench + graft entry.
-# Usage: tools/run_ci.sh [quick|full|tpu]
+# Usage: tools/run_ci.sh [quick|full|tpu|--layout-smoke]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 MODE="${1:-full}"
+
+if [ "$MODE" = "--layout-smoke" ]; then
+  # layout/carry fast leg: the HLO-level regression test (compiled AMP
+  # step has no per-step f32 converts of carried params) plus a tiny
+  # 2-step CPU dry pass of the profiler harness with the HBM audit on
+  echo "== layout smoke: HLO regression test =="
+  JAX_PLATFORMS=cpu python -m pytest tests/test_layout_match.py -q
+  echo "== layout smoke: profile_bert_step CPU dry pass =="
+  JAX_PLATFORMS=cpu python tools/profile_bert_step.py --steps 2 --tiny \
+    --audit --no-trace
+  echo "CI --layout-smoke: PASS"
+  exit 0
+fi
 
 echo "== native build (compiles on import) =="
 python -c "import paddle_tpu.native; print('native OK')"
