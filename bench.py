@@ -51,6 +51,38 @@ def _device():
     return fluid.TPUPlace(0).jax_device()
 
 
+def _telemetry_stats():
+    """Step stats from the runtime metrics registry (core/telemetry.py).
+
+    The executor records per-step wall time and compile time into the
+    registry; the headline seqs/img numbers stay on _timed_loop's chunked
+    host timing (the tunnel-RTT amortization is load-bearing — see
+    _timed_loop), and these registry keys ride along so a BENCH JSON also
+    says how much was spent compiling, whether anything RECOMPILED
+    mid-run (a recompile inside the timed region invalidates the median),
+    and what the per-step distribution looked like.  Empty when
+    FLAGS_telemetry is off."""
+    try:
+        from paddle_tpu import telemetry
+    except Exception:
+        return {}
+    if not telemetry.enabled():
+        return {}
+    snap = telemetry.snapshot()
+    hists = snap.get("histograms", {})
+    out = {"recompiles": int(
+        telemetry.counter_total("executor_cache_miss_total"))}
+    comp = hists.get("executor_compile_ms")
+    if comp:
+        out["compile_ms"] = round(comp["sum"], 1)
+    step = hists.get("executor_step_ms")
+    if step:
+        out["step_ms_p50"] = step["p50"]
+        out["step_ms_p90"] = step["p90"]
+        out["step_ms_p99"] = step["p99"]
+    return out
+
+
 def _timed_loop(run_step, sync, warmup, iters, chunk=None):
     # The axon tunnel costs ~95-120 ms per dispatch+fetch round trip (the
     # host-sync at each chunk boundary).  At chunk=5 that is ~21 ms/step of
@@ -479,6 +511,11 @@ def bench_scaling(batch_per_chip=512, warmup=3, iters=9):
 
 
 def main():
+    # arm the metrics registry before the lazy paddle_tpu import (flags
+    # read FLAGS_* env at import time; env also reaches the bench_bert
+    # OOM-retry subprocesses).  BENCH_TELEMETRY=0 opts out.
+    if os.environ.get("BENCH_TELEMETRY", "1") == "1":
+        os.environ.setdefault("FLAGS_telemetry", "1")
     cfg = os.environ.get("BENCH_CONFIG", "resnet50")
     iters = int(os.environ.get("BENCH_ITERS", "60"))
     if cfg == "bert":
@@ -486,7 +523,7 @@ def main():
         seqs, _loss, got_batch, stable = bench_bert(batch=batch,
                                                     iters=max(iters // 2, 5))
         tfs = seqs * _bert_train_flops_per_seq() / 1e12
-        print(json.dumps({
+        rec = {
             "metric": "bert_base_pretrain_seqs_per_sec_per_chip",
             "value": round(seqs, 2),
             "unit": "seqs/sec",
@@ -500,12 +537,18 @@ def main():
             # (no OOM fallback fired), i.e. the number is repeatable at
             # this batch run to run — see bench_bert
             "stable": stable,
-        }))
+        }
+        if stable:
+            # on the OOM-fallback path the number came from a retry
+            # subprocess, so this process's registry holds the FAILED
+            # attempt — only merge when the stats describe the run
+            rec.update(_telemetry_stats())
+        print(json.dumps(rec))
     elif cfg == "nmt":
         batch = int(os.environ.get("BENCH_BATCH", "128"))
         toks, _loss = bench_nmt(batch=batch, iters=max(iters // 2, 5))
         tfs = toks * _nmt_train_flops_per_token() / 1e12
-        print(json.dumps({
+        print(json.dumps(dict({
             "metric": "transformer_nmt_tokens_per_sec_per_chip",
             "value": round(toks, 2),
             "unit": "tokens/sec",
@@ -515,7 +558,7 @@ def main():
             "vs_baseline": round(toks / H100_NMT_TOKENS_PER_SEC, 4),
             "model_tflops_per_sec": round(tfs, 1),
             "mfu_vs_v5e_peak": round(tfs / V5E_BF16_PEAK_TFLOPS, 4),
-        }))
+        }, **_telemetry_stats())))
     elif cfg == "longctx":
         seq = int(os.environ.get("BENCH_SEQ", "4096"))
         toks, speedup, seq = bench_longctx(seq_len=seq)
@@ -535,7 +578,7 @@ def main():
         # legs use the same _timed_loop harness (chunk=5, 3 chunks) — a
         # mismatched chunking previously read as a phantom 7-15% overhead
         plain_ips, _ = bench_resnet(batch=512, warmup=3, iters=15)
-        print(json.dumps({
+        print(json.dumps(dict({
             "metric": "resnet50_dp_scaling_efficiency",
             "value": round(eff, 4),
             "unit": "fraction_linear_%dchips" % n,
@@ -543,7 +586,7 @@ def main():
             "images_per_sec_total": round(ips, 2),
             "plain_images_per_sec": round(plain_ips, 2),
             "spmd_over_plain": round(one_chip / plain_ips, 4),
-        }))
+        }, **_telemetry_stats())))
     else:
         batch = int(os.environ.get("BENCH_BATCH", "512"))
         amp = os.environ.get("BENCH_AMP", "1") == "1"
@@ -554,14 +597,14 @@ def main():
                                               "BENCH_CHUNK", "120")),
                                           data_format=data_format)
         tfs = img_per_sec * _resnet50_train_flops_per_image() / 1e12
-        print(json.dumps({
+        print(json.dumps(dict({
             "metric": "resnet50_train_images_per_sec_per_chip",
             "value": round(img_per_sec, 2),
             "unit": "images/sec",
             "vs_baseline": round(img_per_sec / H100_RESNET50_IMG_PER_SEC, 4),
             "model_tflops_per_sec": round(tfs, 1),
             "mfu_vs_v5e_peak": round(tfs / V5E_BF16_PEAK_TFLOPS, 4),
-        }))
+        }, **_telemetry_stats())))
 
 
 if __name__ == "__main__":

@@ -68,6 +68,8 @@ from . import datasets
 from . import dygraph
 from . import metrics
 from . import profiler
+from .core import telemetry
+from . import flags
 from . import parallel
 from .flags import set_flags, get_flags
 from . import inference

@@ -9,6 +9,8 @@ the updated values are stored back.  Data-parallel / sharded execution reuses
 the same path with a `jax.sharding.Mesh` (see paddle_tpu.compiler).
 """
 
+import time
+
 import numpy as np
 
 import jax
@@ -23,6 +25,7 @@ from ..framework import (
 )
 from .lowering import BlockPlan, build_block_fn
 from .scope import Scope
+from . import telemetry as _telemetry
 
 __all__ = ["Executor", "global_scope", "scope_guard"]
 
@@ -134,6 +137,9 @@ class Executor:
             comm.complete()
         self._ps_comms = []
         self._cache.clear()
+        # end-of-run telemetry snapshot (metrics.json/.prom under
+        # FLAGS_telemetry_dir; atexit covers executors never closed)
+        _telemetry.maybe_dump()
 
     # -- main entry ----------------------------------------------------------
     def run(
@@ -246,9 +252,14 @@ class Executor:
             mesh_key,
             trace_flags,
         )
+        tel = _telemetry.enabled()
         entry = self._cache.get(key) if use_program_cache else None
+        cache_hit = entry is not None
+        build_s = 0.0
         if entry is None:
+            t_build = time.perf_counter()
             entry = self._compile(program, list(feed_arrays), fetch_names, mesh, data_axis)
+            build_s = time.perf_counter() - t_build
             if use_program_cache:
                 self._cache[key] = entry
         plan = entry.plan
@@ -262,7 +273,14 @@ class Executor:
             params_ro[n] = self._scope_value(scope, n, block)
         for n in plan.rw_names:
             params_rw[n] = self._scope_value(scope, n, block)
-        params_carry = self._gather_carry(scope, plan, block)
+        params_carry, carry_hits, carry_converts = self._gather_carry(
+            scope, plan, block)
+        # host->device transfer volume: numpy feeds cross the PCIe/tunnel
+        # boundary; device-resident jax.Arrays are already there
+        feed_bytes = 0
+        if tel:
+            feed_bytes = sum(int(a.nbytes) for a in feed_arrays.values()
+                             if not isinstance(a, jax.Array))
 
         # deterministic functional PRNG: (program seed, per-scope step
         # counter).  Locked: pipeline section workers run concurrently
@@ -290,9 +308,14 @@ class Executor:
         if _trace_flag("hbm_audit"):
             from .memory_audit import maybe_audit
 
-            maybe_audit(entry, feed_arrays, params_ro, params_rw,
-                        params_carry, rng)
+            report = maybe_audit(entry, feed_arrays, params_ro, params_rw,
+                                 params_carry, rng)
+            if report is not None:
+                # fold the HBM report into the telemetry dump so one
+                # metrics.json answers both "how slow" and "how big"
+                _telemetry.set_info("memory_audit", report)
 
+        t_step = time.perf_counter() if tel else 0.0
         try:
             with ctx, RecordEvent("Executor::Run"):
                 fetches, updated, updated_carry = entry.jfn(
@@ -305,7 +328,27 @@ class Executor:
                 cache = scope.__dict__.get("_layout_carry_cache") or {}
                 for n in params_carry:
                     cache.pop(n, None)
+            if tel:
+                _telemetry.inc("executor_step_errors_total")
+                _telemetry.event("step_error", step=int(counter))
             raise
+
+        if tel:
+            step_ms = (time.perf_counter() - t_step) * 1e3
+            fetch_bytes = sum(int(getattr(f, "nbytes", 0)) for f in fetches)
+            no_donate = getattr(program, "_no_donate", False)
+            _telemetry.record_step(
+                step_ms, cache_hit,
+                # a cache miss pays plan/trace build + the first call's XLA
+                # compile (jit compiles lazily inside that call)
+                compile_ms=None if cache_hit else (build_s * 1e3 + step_ms),
+                donated=0 if no_donate else
+                len(params_rw) + len(params_carry),
+                feed_bytes=feed_bytes, fetch_bytes=fetch_bytes,
+                carry_hits=carry_hits, carry_converts=carry_converts)
+        from ..profiler import mark_instant
+
+        mark_instant("step", args={"step": int(counter)})
 
         for n, val in updated.items():
             scope.var(n).set(val)
@@ -388,22 +431,28 @@ class Executor:
         the scope still holds the exact array the copy was derived from
         (i.e. only the compiled step has updated it), the cached bf16 array
         is current; any external scope.set (checkpoint restore, manual
-        assignment) breaks identity and forces a fresh convert."""
+        assignment) breaks identity and forces a fresh convert.
+
+        Returns (carry dict, cache hits, fresh converts) — the counts feed
+        the telemetry step record."""
         carry_names = getattr(plan, "carry_names", None)
         if not carry_names:
-            return {}
+            return {}, 0, 0
         cache = scope.__dict__.setdefault("_layout_carry_cache", {})
         out = {}
+        hits = converts = 0
         for n in carry_names:
             master = self._scope_value(scope, n, block)
             ent = cache.get(n)
             if ent is not None and ent[0] is master:
                 out[n] = ent[1]
+                hits += 1
                 continue
             bf = jnp.asarray(master).astype(jnp.bfloat16)
             cache[n] = (master, bf)
             out[n] = bf
-        return out
+            converts += 1
+        return out, hits, converts
 
     def _compile(self, program, feed_names, fetch_names, mesh, data_axis):
         from .lowering import build_spmd_block_fn, has_collective_ops

@@ -1,0 +1,377 @@
+"""Unified runtime telemetry: metrics registry + structured step-event log.
+
+The reference framework answers "why was step N slow" with the profiler's
+RecordEvent tables (platform/profiler.h) and ad-hoc VLOG counters scattered
+through the distributed runtime.  Here the runtime keeps ONE process-wide
+registry of counters, gauges, and histograms (with labels), plus a JSONL
+step-event log, so step/compile/retry/eviction history is attributable
+after the fact:
+
+- gating: ``FLAGS_telemetry`` (off by default) with the same guard pattern
+  as ``profiler.is_profiler_enabled`` — every public mutator early-returns
+  when the flag is off, so instrumented call sites cost one dict lookup in
+  production.  ``FLAGS_telemetry_dir`` selects where the JSONL stream and
+  ``dump()`` snapshots land; with no dir, events stay in a bounded
+  in-memory ring.
+- export: ``dump()`` writes a Prometheus-style text file (metrics.prom)
+  and a JSON snapshot (metrics.json); pservers publish the snapshot under
+  the ``__metrics__`` RPC key (``publish_rpc``) so trainers and
+  tools/metrics_dump.py can scrape a live server.
+- instrumented layers: core/executor.py (step wall time, compile time,
+  cache hit/miss, donation, feed/fetch bytes, bf16 carry hits, hbm-audit
+  fold), distributed/ps.py + native/rpc.py (send/retry/dedupe-drop,
+  heartbeat misses, evictions), utils/fault_injection.py (fired faults),
+  io.py CheckpointManager (save/restore durations).
+"""
+
+import atexit
+import json
+import os
+import threading
+import time
+
+__all__ = [
+    "enabled", "inc", "set_gauge", "observe", "event", "set_info",
+    "record_step", "snapshot", "counter_total", "prometheus_text",
+    "dump", "maybe_dump", "reset", "publish_rpc", "decode_snapshot",
+    "scrape", "METRICS_RPC_KEY",
+]
+
+METRICS_RPC_KEY = "__metrics__"
+
+# histogram observations kept for percentile estimation; beyond the cap the
+# sample set is decimated (every other kept) so long runs stay bounded
+_HIST_SAMPLE_CAP = 8192
+_EVENT_RING_CAP = 4096
+
+_lock = threading.RLock()
+_counters = {}     # (name, labels) -> float
+_gauges = {}       # (name, labels) -> float
+_hists = {}        # (name, labels) -> _Hist
+_info = {}         # one-off structured payloads (e.g. memory_audit report)
+_events = []       # bounded in-memory ring of event dicts
+_event_seq = {}    # kind -> next sequence number
+_event_sink = [None, None]  # (path, open file handle) for the JSONL stream
+
+
+def _flags():
+    from .. import flags
+
+    return flags
+
+
+def enabled():
+    """One flag read — the profiler.is_profiler_enabled guard pattern."""
+    return bool(_flags().flag("telemetry"))
+
+
+def telemetry_dir():
+    return _flags().flag("telemetry_dir") or ""
+
+
+class _Hist:
+    __slots__ = ("count", "sum", "min", "max", "samples")
+
+    def __init__(self):
+        self.count = 0
+        self.sum = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+        self.samples = []
+
+    def add(self, v):
+        v = float(v)
+        self.count += 1
+        self.sum += v
+        self.min = min(self.min, v)
+        self.max = max(self.max, v)
+        self.samples.append(v)
+        if len(self.samples) > _HIST_SAMPLE_CAP:
+            del self.samples[::2]
+
+    def percentile(self, q):
+        if not self.samples:
+            return 0.0
+        s = sorted(self.samples)
+        i = min(int(q * len(s)), len(s) - 1)
+        return s[i]
+
+
+def _key(name, labels):
+    return (name, tuple(sorted(labels.items())) if labels else ())
+
+
+def _flat(name, labels):
+    if not labels:
+        return name
+    return "%s{%s}" % (name, ",".join("%s=%s" % kv for kv in labels))
+
+
+# -- mutators (no-ops when FLAGS_telemetry is off) ---------------------------
+
+def inc(name, value=1, **labels):
+    if not enabled():
+        return
+    k = _key(name, labels)
+    with _lock:
+        _counters[k] = _counters.get(k, 0) + value
+
+
+def set_gauge(name, value, **labels):
+    if not enabled():
+        return
+    with _lock:
+        _gauges[_key(name, labels)] = float(value)
+
+
+def observe(name, value, **labels):
+    if not enabled():
+        return
+    k = _key(name, labels)
+    with _lock:
+        h = _hists.get(k)
+        if h is None:
+            h = _hists[k] = _Hist()
+        h.add(value)
+
+
+def set_info(key, value):
+    """Attach a one-off structured payload (folded into the JSON dump) —
+    e.g. the FLAGS_hbm_audit memory report."""
+    if not enabled():
+        return
+    with _lock:
+        _info[key] = value
+
+
+def event(kind, **fields):
+    """Append one structured event to the JSONL step log.  Events stream to
+    ``<FLAGS_telemetry_dir>/steps.jsonl`` when a dir is set; a bounded
+    in-memory ring keeps the tail either way."""
+    if not enabled():
+        return
+    with _lock:
+        seq = _event_seq.get(kind, 0)
+        _event_seq[kind] = seq + 1
+        rec = {"ev": kind, "seq": seq, "t": round(time.time(), 6)}
+        rec.update(fields)
+        _events.append(rec)
+        if len(_events) > _EVENT_RING_CAP:
+            del _events[: len(_events) - _EVENT_RING_CAP]
+        d = telemetry_dir()
+        if d:
+            fh = _event_fh(d)
+            if fh is not None:
+                fh.write(json.dumps(rec) + "\n")
+                fh.flush()
+
+
+def _event_fh(d):
+    path = os.path.join(d, "steps.jsonl")
+    if _event_sink[0] != path:
+        if _event_sink[1] is not None:
+            try:
+                _event_sink[1].close()
+            except OSError:
+                pass
+        try:
+            os.makedirs(d, exist_ok=True)
+            _event_sink[0] = path
+            _event_sink[1] = open(path, "a")
+        except OSError:
+            _event_sink[0] = _event_sink[1] = None
+    return _event_sink[1]
+
+
+def record_step(wall_ms, cache_hit, compile_ms=None, donated=0,
+                feed_bytes=0, fetch_bytes=0, carry_hits=0, carry_converts=0):
+    """One executor step: bundle the counter/histogram updates plus the
+    step event so the hot path pays a single enabled() check."""
+    if not enabled():
+        return
+    inc("executor_steps_total")
+    inc("executor_cache_hit_total" if cache_hit
+        else "executor_cache_miss_total")
+    observe("executor_step_ms", wall_ms)
+    fields = {"wall_ms": round(wall_ms, 3), "cache_hit": bool(cache_hit)}
+    if compile_ms is not None:
+        observe("executor_compile_ms", compile_ms)
+        fields["compile_ms"] = round(compile_ms, 3)
+    if donated:
+        inc("executor_donated_buffers_total", donated)
+        fields["donated"] = donated
+    if feed_bytes:
+        inc("executor_feed_bytes_total", feed_bytes)
+        fields["feed_bytes"] = feed_bytes
+    if fetch_bytes:
+        inc("executor_fetch_bytes_total", fetch_bytes)
+        fields["fetch_bytes"] = fetch_bytes
+    if carry_hits:
+        inc("executor_carry_hit_total", carry_hits)
+        fields["carry_hits"] = carry_hits
+    if carry_converts:
+        inc("executor_carry_convert_total", carry_converts)
+        fields["carry_converts"] = carry_converts
+    event("step", **fields)
+
+
+# -- read side ---------------------------------------------------------------
+
+def snapshot():
+    """Flat JSON-ready view: counters/gauges keyed ``name`` or
+    ``name{k=v,...}``; histograms as count/sum/min/max/p50/p90/p99."""
+    with _lock:
+        out = {
+            "counters": {_flat(n, l): v for (n, l), v in _counters.items()},
+            "gauges": {_flat(n, l): v for (n, l), v in _gauges.items()},
+            "histograms": {
+                _flat(n, l): {
+                    "count": h.count,
+                    "sum": round(h.sum, 3),
+                    "min": round(h.min, 3) if h.count else 0.0,
+                    "max": round(h.max, 3) if h.count else 0.0,
+                    "p50": round(h.percentile(0.50), 3),
+                    "p90": round(h.percentile(0.90), 3),
+                    "p99": round(h.percentile(0.99), 3),
+                }
+                for (n, l), h in _hists.items()
+            },
+            "events_logged": dict(_event_seq),
+        }
+        if _info:
+            out["info"] = dict(_info)
+        return out
+
+
+def counter_total(name):
+    """Sum of a counter across all label sets (0.0 when never touched)."""
+    with _lock:
+        return float(sum(v for (n, _), v in _counters.items() if n == name))
+
+
+def prometheus_text(snap=None):
+    """Prometheus exposition format: counters/gauges verbatim, histograms
+    as summaries (quantile labels + _sum/_count)."""
+    snap = snap if snap is not None else snapshot()
+
+    def split(flat):
+        if "{" in flat:
+            name, rest = flat.split("{", 1)
+            return name, rest.rstrip("}")
+        return flat, ""
+
+    def fmt(name, extra_labels, value):
+        lbl = ",".join(x for x in extra_labels if x)
+        return "%s%s %s" % (name, "{%s}" % lbl if lbl else "", value)
+
+    lines = []
+    for kind, d in (("counter", snap.get("counters", {})),
+                    ("gauge", snap.get("gauges", {}))):
+        seen = set()
+        for flat in sorted(d):
+            name, lbls = split(flat)
+            if name not in seen:
+                seen.add(name)
+                lines.append("# TYPE %s %s" % (name, kind))
+            labeled = ",".join('%s="%s"' % tuple(kv.split("=", 1))
+                               for kv in lbls.split(",") if kv)
+            lines.append(fmt(name, [labeled], d[flat]))
+    seen = set()
+    for flat in sorted(snap.get("histograms", {})):
+        name, lbls = split(flat)
+        h = snap["histograms"][flat]
+        labeled = ",".join('%s="%s"' % tuple(kv.split("=", 1))
+                           for kv in lbls.split(",") if kv)
+        if name not in seen:
+            seen.add(name)
+            lines.append("# TYPE %s summary" % name)
+        for q in ("0.5", "0.9", "0.99"):
+            lines.append(fmt(name, [labeled, 'quantile="%s"' % q],
+                             h["p" + q.replace("0.", "").ljust(2, "0")]))
+        lines.append(fmt(name + "_sum", [labeled], h["sum"]))
+        lines.append(fmt(name + "_count", [labeled], h["count"]))
+    return "\n".join(lines) + "\n"
+
+
+def dump(dirname=None):
+    """Write metrics.json + metrics.prom under `dirname` (default:
+    FLAGS_telemetry_dir).  Returns (json_path, prom_path)."""
+    d = dirname or telemetry_dir()
+    if not d:
+        raise ValueError(
+            "telemetry.dump() needs a directory (argument or "
+            "FLAGS_telemetry_dir)")
+    os.makedirs(d, exist_ok=True)
+    snap = snapshot()
+    jpath = os.path.join(d, "metrics.json")
+    ppath = os.path.join(d, "metrics.prom")
+    with open(jpath, "w") as f:
+        json.dump(snap, f, indent=1, default=str)
+    with open(ppath, "w") as f:
+        f.write(prometheus_text(snap))
+    return jpath, ppath
+
+
+def maybe_dump():
+    """dump() iff telemetry is on and a dir is configured — the end-of-run
+    hook (Executor.close + atexit)."""
+    if enabled() and telemetry_dir():
+        try:
+            dump()
+        except OSError:
+            pass
+
+
+def reset():
+    """Clear the registry and the event stream (tests)."""
+    with _lock:
+        _counters.clear()
+        _gauges.clear()
+        _hists.clear()
+        _info.clear()
+        _events.clear()
+        _event_seq.clear()
+        if _event_sink[1] is not None:
+            try:
+                _event_sink[1].close()
+            except OSError:
+                pass
+        _event_sink[0] = _event_sink[1] = None
+
+
+# -- distributed scrape ------------------------------------------------------
+
+def publish_rpc(server, key=METRICS_RPC_KEY):
+    """Publish the current snapshot on a pserver's variable store so any
+    RpcClient can GET it (the pserver __metrics__ RPC)."""
+    if not enabled():
+        return
+    import numpy as np
+
+    buf = json.dumps(snapshot(), default=str).encode("utf-8")
+    server.set_var(key, np.frombuffer(buf, dtype=np.uint8).copy())
+
+
+def decode_snapshot(arr):
+    """Inverse of publish_rpc's encoding (uint8 JSON bytes -> dict)."""
+    import numpy as np
+
+    return json.loads(np.asarray(arr, dtype=np.uint8).tobytes().decode(
+        "utf-8"))
+
+
+def scrape(endpoint, timeout=10.0, key=METRICS_RPC_KEY):
+    """GET a live pserver's metrics snapshot (tools/metrics_dump.py
+    --scrape).  Fails fast when the server runs with telemetry off (the
+    key is never published, so the bounded-deadline GET errors)."""
+    from ..native.rpc import RpcClient
+
+    client = RpcClient(endpoint, connect_timeout=timeout,
+                       rpc_deadline=timeout, retry_times=0)
+    try:
+        return decode_snapshot(client.get_var(key))
+    finally:
+        client.close()
+
+
+atexit.register(maybe_dump)

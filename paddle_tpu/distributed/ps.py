@@ -58,6 +58,7 @@ import logging
 
 import numpy as np
 
+from ..core import telemetry as _tm
 from ..native.rpc import RpcClient, RpcServer, EV_BARRIER, EV_COMPLETE, EV_SEND
 from ..utils.fault_injection import maybe_fail
 
@@ -205,6 +206,9 @@ def run_pserver(exe, program, scope):
         # rejoin protocol: relaunched trainers read the round counter to
         # sync TrainerPSComm._round before their first pull
         server.set_var(_ROUND_KEY, np.asarray([version], np.int64))
+        # __metrics__ RPC: republish the telemetry snapshot with every
+        # round so trainers/tools scrape a fresh view (no-op when off)
+        _tm.publish_rpc(server)
 
     def run_sync():
         import time as _time
@@ -226,8 +230,11 @@ def run_pserver(exe, program, scope):
                 evicted.discard(tid)
                 logging.warning("[ps:%s] re-admitted trainer %d",
                                 endpoint, tid)
+                _tm.inc("ps_readmit_total", ps=endpoint)
+                _tm.event("readmit", ps=endpoint, trainer=tid)
 
         while True:
+            t_round = _time.time()
             round_fault = maybe_fail("ps.round")
             if round_fault == "error":
                 raise RuntimeError(
@@ -270,6 +277,7 @@ def run_pserver(exe, program, scope):
                         continue
                     contact(tid)
                     if not replay.fresh(tid, nonce, seq):
+                        _tm.inc("ps_dedupe_drop_total", ps=endpoint)
                         continue
                     if tid is None:
                         anon_barriers[0] += 1
@@ -294,9 +302,14 @@ def run_pserver(exe, program, scope):
                         logging.warning(
                             "[ps:%s] evicting silent trainer %d — round "
                             "re-quorums on survivors", endpoint, w)
+                        _tm.inc("ps_eviction_total", ps=endpoint,
+                                mode="sync")
+                        _tm.event("eviction", ps=endpoint, trainer=w,
+                                  mode="sync", round=version)
                     continue
                 contact(tid)
                 if not replay.fresh(tid, nonce, seq):
+                    _tm.inc("ps_dedupe_drop_total", ps=endpoint)
                     continue
                 grads[base].append(arr)
             if round_fault == "drop":
@@ -316,6 +329,11 @@ def run_pserver(exe, program, scope):
                     exe.run(opt_prog, feed=feed, fetch_list=[])
             version += 1
             publish(version)
+            if _tm.enabled():
+                _tm.observe("ps_round_ms", (_time.time() - t_round) * 1e3,
+                            ps=endpoint)
+                _tm.event("ps_round", ps=endpoint, round=version,
+                          grads=len(grads), dropped=round_fault == "drop")
 
     def run_async():
         """Async mode (reference AsyncCommunicator / RunAsyncLoop,
@@ -332,6 +350,7 @@ def run_pserver(exe, program, scope):
             server.set_var(
                 _vkey(p, -1),
                 np.asarray(scope.find_var(p).get_tensor().numpy()))
+            _tm.publish_rpc(server)
 
         for p in params:
             publish_async(p)
@@ -361,10 +380,14 @@ def run_pserver(exe, program, scope):
                 logging.warning(
                     "[ps:%s] evicted silent trainer %d (async) — "
                     "replay/liveness state reclaimed", endpoint, w)
+                _tm.inc("ps_eviction_total", ps=endpoint, mode="async")
+                _tm.event("eviction", ps=endpoint, trainer=w, mode="async")
                 continue
             if base in grad_to_param:
                 if not replay.fresh(tid, nonce, seq):
-                    continue  # replayed send: already applied this grad
+                    # replayed send: already applied this grad
+                    _tm.inc("ps_dedupe_drop_total", ps=endpoint)
+                    continue
                 pname = grad_to_param[base]
                 with scope_guard(scope):
                     exe.run(per_param[pname], feed={base: arr},
@@ -388,6 +411,7 @@ def run_pserver(exe, program, scope):
             server.set_var(
                 _vkey(p, -1),
                 np.asarray(scope.find_var(p).get_tensor().numpy()))
+            _tm.publish_rpc(server)
 
         for p in params:
             publish_geo(p)
@@ -406,7 +430,9 @@ def run_pserver(exe, program, scope):
             base, tid, nonce, seq = _untag(name)
             if base in param_set:
                 if not replay.fresh(tid, nonce, seq):
-                    continue  # replayed delta would double-apply
+                    # replayed delta would double-apply
+                    _tm.inc("ps_dedupe_drop_total", ps=endpoint)
+                    continue
                 cur = np.asarray(scope.find_var(base).get_tensor().numpy())
                 scope.var(base).set(cur + arr)
                 publish_geo(base)
@@ -610,6 +636,9 @@ class HeartBeatMonitor:
                     if now - t > self.timeout_s]
             fresh = [wt for wt in dead if wt[0] not in self._warned]
             self._warned.update(w for w, _ in fresh)
+        _tm.set_gauge("ps_dead_workers", len(dead), ps=self.name)
+        if fresh:
+            _tm.inc("ps_heartbeat_miss_total", len(fresh), ps=self.name)
         for w, silent in fresh:
             logging.warning("[%s] worker %d silent for %.0fs",
                             self.name, w, silent)

@@ -53,6 +53,15 @@ _DEFAULTS = {
     # engages only where it is bitwise-identical to the per-step cast
     # (single-consumer matmul/conv weights, single-process, no mesh).
     "FLAGS_layout_match_params": True,
+    # unified runtime telemetry (core/telemetry.py): process-wide metrics
+    # registry (counters/gauges/histograms) + JSONL step-event log.  Zero
+    # cost when off (every mutator early-returns on this flag, the
+    # profiler.is_profiler_enabled guard pattern).
+    "FLAGS_telemetry": False,
+    # where telemetry streams steps.jsonl and dump() writes metrics.json /
+    # metrics.prom; empty = in-memory only (snapshot()/__metrics__ RPC
+    # still work, nothing touches disk)
+    "FLAGS_telemetry_dir": "",
     # HBM footprint auditor (core/memory_audit.py): after each compile, log
     # the executable's memory_analysis (arg/output/temp/alias bytes) with
     # per-variable attribution of the argument footprint.  Diagnostic; adds

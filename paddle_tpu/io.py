@@ -19,6 +19,7 @@ _SUCCESS convention, python/paddle/fluid/incubate/fleet/utils/fleet_util.py).
 
 import json
 import os
+import time
 import zlib
 
 import numpy as np
@@ -487,6 +488,9 @@ class CheckpointManager:
     def save(self, executor, program, step, extra=None):
         """Write checkpoint ``ckpt-<step>`` (persistables + manifest) and
         prune beyond max_num.  Returns the checkpoint path."""
+        from .core import telemetry as _tm
+
+        t0 = time.perf_counter()
         self._fs.mkdirs(self.ckpt_dir)
         target = os.path.join(self.ckpt_dir, "%s%d" % (self._PREFIX, step))
         with self._fs.atomic_write_dir(target) as tmp:
@@ -504,6 +508,11 @@ class CheckpointManager:
             with open(os.path.join(tmp, _SUCCESS_NAME), "w") as f:
                 json.dump(manifest, f)
         self._prune()
+        if _tm.enabled():
+            ms = (time.perf_counter() - t0) * 1e3
+            _tm.observe("checkpoint_save_ms", ms)
+            _tm.event("checkpoint_save", step=int(step),
+                      ms=round(ms, 3), files=len(files))
         return target
 
     def maybe_save(self, executor, program, step, extra=None):
@@ -523,12 +532,20 @@ class CheckpointManager:
         """Load the newest valid checkpoint into the global scope.
         Returns (step, extra) — or (0, None) when nothing valid exists, so
         callers can resume their loop unconditionally from the result."""
+        from .core import telemetry as _tm
+
+        t0 = time.perf_counter()
         found = self.latest_valid()
         if found is None:
             return 0, None
         step, path = found
         load_persistables(executor, path, program)
         man = self._manifest(path)
+        if _tm.enabled():
+            ms = (time.perf_counter() - t0) * 1e3
+            _tm.observe("checkpoint_restore_ms", ms)
+            _tm.event("checkpoint_restore", step=int(step),
+                      ms=round(ms, 3))
         return step, (man or {}).get("extra")
 
 

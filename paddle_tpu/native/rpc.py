@@ -10,6 +10,7 @@ import ctypes
 import numpy as np
 
 from . import load
+from ..core import telemetry as _tm
 from ..utils.fault_injection import FaultInjected, maybe_fail
 
 __all__ = ["RpcServer", "RpcClient", "backoff_delay"]
@@ -174,9 +175,11 @@ class RpcClient:
         and the pserver dedupes replays (distributed/ps.py)."""
         import time
 
+        op = what.split("(", 1)[0]
         last = None
         for i in range(self.retry_times + 1):
             if i:
+                _tm.inc("rpc_retry_total", op=op)
                 time.sleep(backoff_delay(i - 1, rng=self._rng))
             try:
                 if not self._h:
@@ -190,12 +193,17 @@ class RpcClient:
                 return attempt_fn()
             except ConnectionError as e:
                 last = e
+                _tm.inc("rpc_failure_total", op=op)
+        _tm.inc("rpc_exhausted_total", op=op)
         raise last
 
     def send_var(self, name, arr):
         arr = np.ascontiguousarray(arr)
         dims = (ctypes.c_longlong * max(arr.ndim, 1))(*(arr.shape or (0,)))
         what = "send_var(%s)" % name
+        if _tm.enabled():
+            _tm.inc("rpc_send_total")
+            _tm.inc("rpc_send_bytes_total", int(arr.nbytes))
 
         def attempt():
             self._check_open(what)
@@ -223,6 +231,7 @@ class RpcClient:
 
     def get_var(self, name):
         what = "get_var(%s)" % name
+        _tm.inc("rpc_get_total")
 
         def attempt():
             self._check_open(what)

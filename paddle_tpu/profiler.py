@@ -20,11 +20,12 @@ import time
 
 __all__ = [
     "RecordEvent", "profiler", "start_profiler", "stop_profiler",
-    "reset_profiler", "save_chrome_trace", "cuda_profiler",
+    "reset_profiler", "save_chrome_trace", "cuda_profiler", "mark_instant",
 ]
 
 _enabled = False
 _events = []  # (name, tid, start_us, dur_us)
+_instants = []  # (name, tid, ts_us, args) — ph:"i" step markers
 _lock = threading.Lock()
 _device_trace_dir = None
 
@@ -57,9 +58,21 @@ class RecordEvent:
         return False
 
 
+def mark_instant(name, args=None):
+    """Record an instant marker (chrome-trace ph:"i", e.g. the executor's
+    per-step boundary) so host spans, step edges, and XPlane device
+    timelines line up in Perfetto.  Zero-cost when the profiler is off."""
+    if not _enabled:
+        return
+    with _lock:
+        _instants.append((name, threading.get_ident(),
+                          time.perf_counter_ns() // 1000, args))
+
+
 def reset_profiler():
     with _lock:
         _events.clear()
+        _instants.clear()
 
 
 def start_profiler(state="All", tracer_option=None, device_trace_dir=None):
@@ -82,8 +95,13 @@ def stop_profiler(sorted_key=None, profile_path=None):
     if _device_trace_dir:
         import jax
 
-        jax.profiler.stop_trace()
-        _device_trace_dir = None
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            # a failed device-trace stop must still clear the global:
+            # leaving it dangling would make the NEXT start_profiler pair a
+            # fresh start_trace with a stop for the dead session
+            _device_trace_dir = None
     if profile_path:
         save_chrome_trace(profile_path)
     _print_summary(sorted_key)
@@ -120,16 +138,39 @@ def _print_summary(sorted_key=None):
 
 
 def save_chrome_trace(path):
-    """chrome://tracing JSON (tools/timeline.py:131 analog)."""
+    """chrome://tracing JSON (tools/timeline.py:131 analog).
+
+    Besides the ph:"X" host spans, the trace carries ph:"M" process/thread
+    name metadata (labeled tracks instead of bare tids in Perfetto) and the
+    ph:"i" per-step instant markers recorded by mark_instant, so step
+    boundaries line up against both host spans and XPlane device lanes."""
     with _lock:
         events = list(_events)
-    trace = {
-        "traceEvents": [
-            {"name": name, "ph": "X", "pid": 0, "tid": tid,
-             "ts": ts, "dur": dur, "cat": "host"}
-            for name, tid, ts, dur in events
-        ]
-    }
+        instants = list(_instants)
+    tids = sorted({tid for _, tid, _, _ in events}
+                  | {tid for _, tid, _, _ in instants})
+    trace_events = [
+        {"name": "process_name", "ph": "M", "pid": 0,
+         "args": {"name": "paddle_tpu host"}},
+        {"name": "process_sort_index", "ph": "M", "pid": 0,
+         "args": {"sort_index": 0}},
+    ]
+    for i, tid in enumerate(tids):
+        trace_events.append(
+            {"name": "thread_name", "ph": "M", "pid": 0, "tid": tid,
+             "args": {"name": "host thread %d" % i
+                      if i else "host main"}})
+    trace_events += [
+        {"name": name, "ph": "X", "pid": 0, "tid": tid,
+         "ts": ts, "dur": dur, "cat": "host"}
+        for name, tid, ts, dur in events
+    ]
+    trace_events += [
+        {"name": name, "ph": "i", "s": "g", "pid": 0, "tid": tid,
+         "ts": ts, "cat": "step", "args": args or {}}
+        for name, tid, ts, args in instants
+    ]
+    trace = {"traceEvents": trace_events}
     with open(path, "w") as f:
         json.dump(trace, f)
 

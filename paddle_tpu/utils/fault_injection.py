@@ -146,6 +146,12 @@ def maybe_fail(point):
     if p.prob < 1.0 and _rng.random() >= p.prob:
         return None
     p.fired += 1
+    # telemetry BEFORE the fault acts: a "kill" never returns, and the
+    # post-mortem registry (pserver __metrics__ scrape / relaunch logs)
+    # should still attribute the crash to the injected point
+    from ..core import telemetry as _tm
+
+    _tm.inc("fault_injected_total", point=p.name, kind=p.kind)
     if p.kind == "delay":
         import time
 

@@ -1,0 +1,91 @@
+"""Inspect paddle_tpu telemetry: pretty-print a dumped snapshot or scrape
+a live pserver's ``__metrics__`` RPC.
+
+Usage:
+    python tools/metrics_dump.py --json  RUN_DIR/metrics.json
+    python tools/metrics_dump.py --scrape HOST:PORT [--timeout SECS]
+    python tools/metrics_dump.py ... --prom          # Prometheus text
+    python tools/metrics_dump.py ... --raw           # raw JSON passthrough
+
+``--json`` reads what ``telemetry.dump()`` / the Executor end-of-run hook
+wrote under FLAGS_telemetry_dir; ``--scrape`` asks a running pserver
+(distributed/ps.py publishes a fresh snapshot every round).  The default
+output is a human table; --prom re-renders either source in Prometheus
+exposition format for scrapers.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def render_table(snap, out=sys.stdout):
+    counters = snap.get("counters", {})
+    gauges = snap.get("gauges", {})
+    hists = snap.get("histograms", {})
+    if counters:
+        out.write("counters:\n")
+        for k in sorted(counters):
+            out.write("  %-52s %g\n" % (k, counters[k]))
+    if gauges:
+        out.write("gauges:\n")
+        for k in sorted(gauges):
+            out.write("  %-52s %g\n" % (k, gauges[k]))
+    if hists:
+        out.write("histograms (ms unless the name says otherwise):\n")
+        for k in sorted(hists):
+            h = hists[k]
+            out.write("  %-40s n=%-6d sum=%-10g p50=%-8g p90=%-8g "
+                      "p99=%g\n" % (k, h["count"], h["sum"], h["p50"],
+                                    h["p90"], h["p99"]))
+    ev = snap.get("events_logged", {})
+    if ev:
+        out.write("events logged: %s\n"
+                  % ", ".join("%s=%d" % kv for kv in sorted(ev.items())))
+    info = snap.get("info", {})
+    if info:
+        out.write("info payloads: %s\n" % ", ".join(sorted(info)))
+    if not (counters or gauges or hists or ev):
+        out.write("(empty snapshot — was FLAGS_telemetry on?)\n")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--json", dest="json_path",
+                     help="metrics.json written by telemetry.dump()")
+    src.add_argument("--scrape", dest="endpoint",
+                     help="live pserver HOST:PORT (__metrics__ RPC)")
+    ap.add_argument("--timeout", type=float, default=10.0,
+                    help="scrape connect/RPC deadline in seconds")
+    ap.add_argument("--prom", action="store_true",
+                    help="emit Prometheus exposition text")
+    ap.add_argument("--raw", action="store_true",
+                    help="emit the raw JSON snapshot")
+    args = ap.parse_args(argv)
+
+    if args.json_path:
+        with open(args.json_path) as f:
+            snap = json.load(f)
+    else:
+        from paddle_tpu import telemetry
+
+        snap = telemetry.scrape(args.endpoint, timeout=args.timeout)
+
+    if args.raw:
+        json.dump(snap, sys.stdout, indent=1)
+        sys.stdout.write("\n")
+    elif args.prom:
+        from paddle_tpu import telemetry
+
+        sys.stdout.write(telemetry.prometheus_text(snap))
+    else:
+        render_table(snap)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -47,6 +47,26 @@ def _parse_args(argv):
     return steps, audit, tiny, trace
 
 
+def _print_telemetry(fluid):
+    """Host-side step stats from the metrics registry — complements the
+    device-time decomposition below (which only a real TPU trace gives)."""
+    tel = fluid.telemetry
+    if not tel.enabled():
+        return
+    snap = tel.snapshot()
+    hists = snap.get("histograms", {})
+    step = hists.get("executor_step_ms") or {}
+    comp = hists.get("executor_compile_ms") or {}
+    print("telemetry: steps=%d recompiles=%d cache_hits=%d "
+          "compile_ms=%.1f step_ms p50=%.2f p90=%.2f p99=%.2f" % (
+              tel.counter_total("executor_steps_total"),
+              tel.counter_total("executor_cache_miss_total"),
+              tel.counter_total("executor_cache_hit_total"),
+              comp.get("sum", 0.0),
+              step.get("p50", 0.0), step.get("p90", 0.0),
+              step.get("p99", 0.0)))
+
+
 def main():
     import jax
     import numpy as np
@@ -56,6 +76,10 @@ def main():
     # build the bench step exactly as bench_bert does, but hand-run it
     import paddle_tpu as fluid
     from paddle_tpu.models import bert as bert_model
+
+    # host-side step stats ride the same run (core/telemetry.py); the
+    # jax.profiler trace below still owns the device-time story
+    fluid.set_flags({"FLAGS_telemetry": True})
 
     if tiny:
         batch, seq = 8, 32
@@ -124,6 +148,7 @@ def main():
                 out = step()
             np.asarray(out)
             print("profile_bert_step: %d steps ran (trace skipped)" % steps)
+            _print_telemetry(fluid)
             return
 
         from timeline import from_xplane
@@ -150,6 +175,7 @@ def main():
         key = name.split(".")[0].split("(")[0].split("=")[0].strip()
         buckets[key] += ev["dur"] / 1e3  # ms
         total += ev["dur"] / 1e3
+    _print_telemetry(fluid)
     print("total sync device ms over %d steps: %.1f (%.1f ms/step)" %
           (steps, total, total / steps))
     for k, v in sorted(buckets.items(), key=lambda kv: -kv[1])[:28]:

@@ -1,11 +1,29 @@
 #!/usr/bin/env bash
 # CI harness (reference paddle/scripts/paddle_build.sh analog): build the
 # native pieces, run the full test pyramid, smoke the bench + graft entry.
-# Usage: tools/run_ci.sh [quick|full|tpu|--layout-smoke]
+# Usage: tools/run_ci.sh [quick|full|tpu|--layout-smoke|--obs-smoke]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 MODE="${1:-full}"
+
+if [ "$MODE" = "--obs-smoke" ]; then
+  # observability fast leg: telemetry + timeline-tool tests, then a tiny
+  # telemetry-on executor run dumped and re-read through the CLI
+  echo "== obs smoke: telemetry + timeline tests =="
+  JAX_PLATFORMS=cpu python -m pytest tests/test_telemetry.py \
+    tests/test_timeline_tool.py tests/test_profiler_metrics.py -q
+  echo "== obs smoke: dump -> metrics_dump round trip =="
+  OBS_DIR="$(mktemp -d)"
+  JAX_PLATFORMS=cpu FLAGS_telemetry=1 FLAGS_telemetry_dir="$OBS_DIR" \
+    python tools/profile_bert_step.py --steps 2 --tiny --no-trace
+  python tools/metrics_dump.py --json "$OBS_DIR/metrics.json"
+  python tools/metrics_dump.py --json "$OBS_DIR/metrics.json" --prom \
+    | grep -q executor_steps_total
+  rm -rf "$OBS_DIR"
+  echo "CI --obs-smoke: PASS"
+  exit 0
+fi
 
 if [ "$MODE" = "--layout-smoke" ]; then
   # layout/carry fast leg: the HLO-level regression test (compiled AMP
