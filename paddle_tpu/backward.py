@@ -26,6 +26,39 @@ def _collect_no_grad(block, no_grad_set):
     for name, var in block.vars.items():
         if var.stop_gradient:
             ng.add(name)
+    return _propagate_no_grad(block, ng)
+
+
+def _propagate_no_grad(block, ng):
+    """Forward-close the no-grad set (reference _find_no_grad_vars /
+    _find_op_path_ pruning, backward.py:1090): a var computed ONLY from
+    no-grad inputs — or by an op with no gradient maker, or with no inputs
+    at all (constants, random fills) — can never receive a gradient, so
+    the backward pass must not build grad chains below it.  Without this,
+    attention-mask plumbing (cast/scale/matmul of stop-gradient data)
+    left whole chains of dead sum/reshape_grad/scale_grad ops in every
+    BERT and transformer program."""
+    for op in block.ops:
+        if op.attr(OP_ROLE_KEY) == OpRole.Optimize:
+            continue
+        try:
+            opdef = get_op_def(op.type)
+        except ValueError:
+            continue
+        outs = [n for n in op.output_arg_names if n]
+        if not outs:
+            continue
+        if opdef.grad_maker is None:
+            dead = True
+        else:
+            ins = [n for slot in opdef.input_slots
+                   if slot not in opdef.no_grad_inputs
+                   for n in op.input(slot) if n]
+            dead = all(n in ng for n in ins)  # vacuous for zero-input ops
+        if dead:
+            # never absorb an in-place alias of a differentiable var (a
+            # counter/accumulator written over itself stays as-is)
+            ng.update(n for n in outs if n not in op.input_arg_names)
     return ng
 
 

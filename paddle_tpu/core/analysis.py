@@ -1,0 +1,855 @@
+"""Static Program verifier: abstract interpretation over blocks before
+lowering.
+
+The reference framework validates ops only at runtime (``OperatorBase::Run``
+plus scattered ``PADDLE_ENFORCE``s, operator.cc:947), so a malformed program
+— a dangling input, dtype drift between forward and grad, a param assigned
+to two pservers — surfaces as an opaque XLA lowering error or silent wrong
+numbers deep inside ``executor.run``.  This module checks the Program IR
+statically and emits structured diagnostics (severity, rule id, op index,
+var names, suggested fix), the TensorFlow shape-inference-at-construction
+design applied to this runtime's four program rewriters (backward, IR
+passes, DistributeTranspiler, lowering).
+
+Rule families
+-------------
+well-formedness   WF001 use-before-def / dangling input
+                  WF002 unknown op type
+                  WF003 unused op output                        (info)
+                  WF004 op unreachable from the fetch targets   (warning)
+                  WF005 undeclared input/output slot
+type/shape flow   TS001 dtype mismatch (declared vs re-inferred)
+                  TS002 shape contradiction (declared vs re-inferred)
+                  TS003 grad var inconsistent with its forward var
+donation/alias    DA001 donated param read after its in-place update
+                  DA002 donated param is a fetch target          (info)
+                  DA003 double write without a read dependency   (warning)
+distributed lint  DL001 param not assigned to exactly one pserver
+                  DL002 param/grad send-recv pairing broken
+                  DL003 collective ring_id missing/negative/mixed
+                  DL004 side-effecting op duplicated into trainer + pserver
+
+Gating: ``FLAGS_static_check`` = ``off`` | ``warn`` (default) | ``error``.
+``off`` costs one flag read per executor compile (the telemetry early-return
+pattern); ``warn`` logs a ``ProgramVerifyWarning`` and bumps the
+``static_check_warnings`` telemetry counter; ``error`` raises a single
+readable ``ProgramVerificationError`` report instead of an XLA traceback.
+Entry points: the executor compile path (cache-miss only), the
+post-transpile hook in ``transpiler/distribute_transpiler.py``, and the
+standalone ``tools/proglint.py`` CLI.
+"""
+
+import warnings
+
+__all__ = [
+    "Diagnostic",
+    "VerifyReport",
+    "ProgramVerifyWarning",
+    "ProgramVerificationError",
+    "RULES",
+    "verify_program",
+    "verify_transpiled",
+    "check_before_compile",
+    "check_transpiled",
+]
+
+ERROR = "error"
+WARNING = "warning"
+INFO = "info"
+
+# rule id -> one-line catalog entry (README "Static checking" renders this)
+RULES = {
+    "WF001": "input read before any definition (dangling input)",
+    "WF002": "unknown op type (no registry entry)",
+    "WF003": "op output produced but never consumed",
+    "WF004": "op cannot reach any fetch target or persistable state",
+    "WF005": "input/output slot not declared by the op's registry entry",
+    "TS001": "declared dtype disagrees with re-inferred dtype",
+    "TS002": "declared shape contradicts re-inferred shape",
+    "TS003": "grad var shape/dtype disagrees with its forward var",
+    "DA001": "donated var read after its in-place update",
+    "DA002": "donated var is a fetch target (fetch sees the updated value)",
+    "DA003": "var written twice with no read of the first value",
+    "DL001": "param not assigned to exactly one pserver",
+    "DL002": "param/grad send-recv pairing broken",
+    "DL003": "collective op ring_id missing, negative, or mixed",
+    "DL004": "side-effecting op duplicated into trainer and pserver",
+}
+
+
+class ProgramVerifyWarning(UserWarning):
+    """Category for warn-mode diagnostics (filterable without muting all
+    UserWarnings)."""
+
+
+class Diagnostic:
+    """One structured finding: severity, rule id, location, vars, fix."""
+
+    __slots__ = ("severity", "rule", "message", "block_idx", "op_idx",
+                 "var_names", "suggestion")
+
+    def __init__(self, severity, rule, message, block_idx=None, op_idx=None,
+                 var_names=(), suggestion=None):
+        self.severity = severity
+        self.rule = rule
+        self.message = message
+        self.block_idx = block_idx
+        self.op_idx = op_idx
+        self.var_names = tuple(var_names)
+        self.suggestion = suggestion
+
+    def location(self):
+        if self.op_idx is None:
+            return "program"
+        return "block %s op %s" % (
+            0 if self.block_idx is None else self.block_idx, self.op_idx)
+
+    def format(self):
+        line = "%s %s [%s]: %s" % (self.rule, self.severity.upper(),
+                                   self.location(), self.message)
+        if self.suggestion:
+            line += "\n    fix: %s" % self.suggestion
+        return line
+
+    def __repr__(self):
+        return "Diagnostic(%s, %s, %s)" % (self.rule, self.severity,
+                                           self.location())
+
+
+class VerifyReport:
+    """Ordered diagnostic list with severity views and a readable render."""
+
+    def __init__(self, diagnostics=(), label="program"):
+        self.diagnostics = list(diagnostics)
+        self.label = label
+
+    def add(self, *args, **kwargs):
+        self.diagnostics.append(Diagnostic(*args, **kwargs))
+
+    def extend(self, other):
+        self.diagnostics.extend(other.diagnostics)
+
+    @property
+    def errors(self):
+        return [d for d in self.diagnostics if d.severity == ERROR]
+
+    @property
+    def warnings(self):
+        return [d for d in self.diagnostics if d.severity == WARNING]
+
+    @property
+    def infos(self):
+        return [d for d in self.diagnostics if d.severity == INFO]
+
+    def by_rule(self, rule):
+        return [d for d in self.diagnostics if d.rule == rule]
+
+    @property
+    def ok(self):
+        """No errors and no warnings (infos are advisory)."""
+        return not self.errors and not self.warnings
+
+    def format(self, max_items=50, include_info=True):
+        shown = [d for d in self.diagnostics
+                 if include_info or d.severity != INFO]
+        head = "static check of %s: %d error(s), %d warning(s), %d info" % (
+            self.label, len(self.errors), len(self.warnings),
+            len(self.infos))
+        lines = [head]
+        for d in shown[:max_items]:
+            lines.append("  " + d.format().replace("\n", "\n  "))
+        if len(shown) > max_items:
+            lines.append("  ... %d more" % (len(shown) - max_items))
+        return "\n".join(lines)
+
+    def __repr__(self):
+        return "<VerifyReport %s: %dE/%dW/%dI>" % (
+            self.label, len(self.errors), len(self.warnings),
+            len(self.infos))
+
+
+class ProgramVerificationError(RuntimeError):
+    """Raised by FLAGS_static_check=error: the full diagnostic report, not
+    an XLA traceback."""
+
+    def __init__(self, report):
+        self.report = report
+        super().__init__(report.format())
+
+
+# ---------------------------------------------------------------------------
+# shared helpers
+# ---------------------------------------------------------------------------
+
+# ops the executor strips before lowering (legacy plumbing)
+_PLUMBING = ("feed", "fetch")
+
+# ops whose execution has effects beyond their declared outputs: always live
+# for the WF004 reachability walk
+_SIDE_EFFECT_OPS = frozenset((
+    "while", "conditional_block", "recurrent", "py_func",
+    "send", "recv", "send_barrier", "fetch_barrier", "prefetch",
+    "listen_and_serv", "save", "save_combine", "load", "load_combine",
+    "print", "assert", "c_sync_calc_stream", "c_sync_comm_stream",
+    "c_gen_nccl_id", "c_comm_init", "c_wait_comm", "c_wait_compute",
+))
+
+# program-level collectives (mirrors core/lowering._AXIS_OPS + broadcastish
+# variants); DL003 checks their ring_id discipline
+_COLLECTIVE_OPS = frozenset((
+    "c_allreduce_sum", "c_allreduce_max", "c_allreduce_min",
+    "c_allreduce_prod", "c_broadcast", "c_allgather", "c_reducescatter",
+    "allreduce", "broadcast",
+))
+
+_GRAD_SUFFIX = "@GRAD"
+
+
+def _is_gradish(name):
+    return name.endswith(_GRAD_SUFFIX) or (_GRAD_SUFFIX + "@") in name
+
+
+def _runtime_ops(block):
+    """(op_idx, op) pairs excluding legacy feed/fetch plumbing; indices are
+    positions in block.ops so diagnostics point at the real op list."""
+    return [(i, op) for i, op in enumerate(block.ops)
+            if op.type not in _PLUMBING]
+
+
+def _opdef_or_none(op_type):
+    from .registry import get_op_def
+
+    try:
+        return get_op_def(op_type)
+    except ValueError:
+        return None
+    except Exception:
+        return None
+
+
+def _shapes_conflict(declared, inferred):
+    """True when two declared shapes cannot describe the same tensor:
+    different rank, or a dim where both are static and differ (-1 is the
+    symbolic batch wildcard and matches anything)."""
+    if declared is None or inferred is None:
+        return False
+    if len(declared) != len(inferred):
+        return True
+    for d, i in zip(declared, inferred):
+        if d >= 0 and i >= 0 and d != i:
+            return True
+    return False
+
+
+def _canon_dtype(dtype):
+    """Canonicalize declared dtypes through the same 64->32 bit truncation
+    JAX applies when x64 is disabled, so TS001 compares what actually runs
+    (the IR declares reference dtypes like int64; eval_shape yields the
+    truncated int32)."""
+    if dtype is None:
+        return None
+    from jax import config as jax_config
+
+    if not getattr(jax_config, "jax_enable_x64", False):
+        return {"int64": "int32", "uint64": "uint32",
+                "float64": "float32"}.get(dtype, dtype)
+    return dtype
+
+
+def _dtype_kind(dtype):
+    if dtype is None:
+        return None
+    if dtype.startswith(("float", "bfloat")):
+        return "float"
+    if dtype == "bool":
+        return "bool"
+    return "int"
+
+
+# ---------------------------------------------------------------------------
+# family 1: well-formedness
+# ---------------------------------------------------------------------------
+
+
+def _ancestor_names(block):
+    names = set()
+    blk = block.parent_block
+    while blk is not None:
+        names.update(blk.vars)
+        blk = blk.parent_block
+    return names
+
+
+def _check_wellformed(program, feed_names, fetch_names, scope_names, rep):
+    feed = set(feed_names)
+    fetch = set(fetch_names)
+    scope = set(scope_names or ())
+
+    # reads across ALL blocks: sub-block ops consume outer names through the
+    # trace env without appearing in the outer block's op list
+    global_reads = set()
+    for blk in program.blocks:
+        for _, op in _runtime_ops(blk):
+            global_reads.update(n for n in op.input_arg_names if n)
+
+    for blk in program.blocks:
+        defined = feed | scope | _ancestor_names(blk)
+        ops = _runtime_ops(blk)
+        for op_idx, op in ops:
+            opdef = _opdef_or_none(op.type)
+            if opdef is None:
+                rep.add(ERROR, "WF002",
+                        "op %r is not registered" % op.type,
+                        blk.idx, op_idx,
+                        suggestion="register it via core.registry."
+                        "register_op or remove the op")
+                # unknown slots can't be checked; still track writes below
+            else:
+                bad_in = [s for s in op.inputs if s not in opdef.input_slots]
+                bad_out = [s for s in op.outputs
+                           if s not in opdef.output_slots]
+                for s in bad_in:
+                    rep.add(ERROR, "WF005",
+                            "op %s has no input slot %r (declares %s)"
+                            % (op.type, s, list(opdef.input_slots)),
+                            blk.idx, op_idx, op.input(s))
+                for s in bad_out:
+                    rep.add(ERROR, "WF005",
+                            "op %s has no output slot %r (declares %s)"
+                            % (op.type, s, list(opdef.output_slots)),
+                            blk.idx, op_idx, op.output(s))
+
+            optional = set()
+            if opdef is not None:
+                optional = {s for s in op.inputs
+                            if s in opdef.optional_inputs
+                            or s.startswith(("GRAD@", "Out@"))}
+            for slot, names in op.inputs.items():
+                for n in names:
+                    if not n or n in defined:
+                        continue
+                    if _is_gradish(n):
+                        continue  # implicit-zero grads are legitimate holes
+                    if slot in optional:
+                        continue  # lowering resolves absent optionals to None
+                    v = blk._find_var_recursive(n)
+                    if v is None:
+                        rep.add(ERROR, "WF001",
+                                "op %s reads %r which has no variable entry "
+                                "in any reachable block" % (op.type, n),
+                                blk.idx, op_idx, (n,),
+                                suggestion="declare the variable or fix the "
+                                "name in slot %r" % slot)
+                        continue
+                    if v.persistable or v.is_data:
+                        continue  # scope-resident / feed target
+                    if getattr(v, "type", None) == "lod_tensor_array":
+                        continue  # trace-local; first array_write creates it
+                    rep.add(ERROR, "WF001",
+                            "op %s reads %r before any op produces it (not "
+                            "persistable, not a feed)" % (op.type, n),
+                            blk.idx, op_idx, (n,),
+                            suggestion="feed it, mark it persistable, or "
+                            "reorder the producing op before op %d" % op_idx)
+            for n in op.output_arg_names:
+                if n:
+                    defined.add(n)
+
+        # WF003: outputs nobody consumes (advisory — auxiliary outputs like
+        # softmax_with_cross_entropy's Softmax are routinely unused)
+        for op_idx, op in ops:
+            for n in op.output_arg_names:
+                if not n or n in global_reads or n in fetch:
+                    continue
+                v = blk._find_var_recursive(n)
+                if v is not None and (v.persistable or v.is_data):
+                    continue
+                if _is_gradish(n):
+                    continue  # param grads are consumed by the runtime (PS
+                    # send / fetch-time grad exchange), not always by an op
+                rep.add(INFO, "WF003",
+                        "output %r of op %s is never read, fetched, or "
+                        "persisted" % (n, op.type),
+                        blk.idx, op_idx, (n,))
+
+    _check_reachability(program, fetch_names, rep)
+
+
+def _check_reachability(program, fetch_names, rep):
+    """WF004: reverse reachability from the fetch targets + persistable
+    writes.  Needs fetch targets to mean anything — skipped without them."""
+    if not fetch_names:
+        return
+    block = program.global_block()
+    ops = _runtime_ops(block)
+    needed = set(fetch_names)
+    # PS trainer: param grads have no in-program consumer — the executor's
+    # per-step grad exchange fetches and ships them (core/executor.py
+    # ps_grad_names), so they are live roots for reachability
+    ps_meta = getattr(program, "_ps_trainer", None)
+    if ps_meta:
+        needed.update(ps_meta.get("param_grad", {}).values())
+    live = set()
+    sub_reads = set()
+    for blk in program.blocks:
+        if blk.idx == 0:
+            continue
+        for _, op in _runtime_ops(blk):
+            sub_reads.update(n for n in op.input_arg_names if n)
+    for op_idx, op in reversed(ops):
+        opdef = _opdef_or_none(op.type)
+        is_live = (
+            op.type in _SIDE_EFFECT_OPS
+            or op.type in _COLLECTIVE_OPS
+            or (opdef is not None and opdef.stateful)
+            or op.has_attr("sub_block")
+        )
+        if not is_live:
+            for n in op.output_arg_names:
+                if not n:
+                    continue
+                if n in needed or n in sub_reads:
+                    is_live = True
+                    break
+                v = block._find_var_recursive(n)
+                if v is not None and v.persistable:
+                    is_live = True
+                    break
+                # a parameter's gradient is the PRODUCT of a grad program:
+                # the runtime (optimizer application, PS send, user fetch
+                # of append_backward results) consumes it, not an op
+                base = n.split("@RENAME@")[0].split("@D")[0]
+                if base.endswith(_GRAD_SUFFIX):
+                    fwd = block._find_var_recursive(
+                        base[: -len(_GRAD_SUFFIX)])
+                    if fwd is not None and fwd.persistable:
+                        is_live = True
+                        break
+        if is_live:
+            live.add(op_idx)
+            needed.update(n for n in op.input_arg_names if n)
+    for op_idx, op in ops:
+        if op_idx not in live:
+            rep.add(WARNING, "WF004",
+                    "op %s (outputs %s) cannot reach any fetch target or "
+                    "persistable state — dead code"
+                    % (op.type, [n for n in op.output_arg_names if n]),
+                    block.idx, op_idx,
+                    tuple(n for n in op.output_arg_names if n),
+                    suggestion="remove the op or fetch one of its outputs")
+
+
+# ---------------------------------------------------------------------------
+# family 2: type / shape flow
+# ---------------------------------------------------------------------------
+
+
+def _check_type_shape(program, rep):
+    """Re-run the registry's shape inference (symbolic batch dim) over a
+    CLONE of the program and compare against the declared metadata.  The
+    clone is essential: ``run_infer_shape`` writes shapes/dtypes into the
+    block, and the verifier must never mutate the program it checks."""
+    clone = program.clone()
+    for blk in clone.blocks:
+        for op_idx, op in _runtime_ops(blk):
+            opdef = _opdef_or_none(op.type)
+            if opdef is None:
+                continue  # WF002 already reported
+            in_names = set(op.input_arg_names)
+            declared = {}
+            for n in op.output_arg_names:
+                if not n or n in declared or n in in_names:
+                    continue  # in-place outputs keep their declared meta
+                v = blk._find_var_recursive(n)
+                if v is None:
+                    continue
+                declared[n] = (v.shape, v.dtype)
+                v.dtype = None  # let inference re-derive the dtype
+            try:
+                with warnings.catch_warnings():
+                    warnings.simplefilter("ignore")
+                    opdef.run_infer_shape(op, blk)
+            except Exception:
+                for n, (shape, dtype) in declared.items():
+                    v = blk._find_var_recursive(n)
+                    v.shape, v.dtype = shape, dtype
+                continue
+            for n, (shape, dtype) in declared.items():
+                v = blk._find_var_recursive(n)
+                if v.dtype is None:
+                    v.dtype = dtype  # inference had no opinion
+                elif (dtype is not None
+                      and _canon_dtype(v.dtype) != _canon_dtype(dtype)):
+                    rep.add(ERROR, "TS001",
+                            "op %s output %r is declared %s but the "
+                            "lowering produces %s"
+                            % (op.type, n, dtype, v.dtype),
+                            blk.idx, op_idx, (n,),
+                            suggestion="fix the var's declared dtype (or "
+                            "the op's lowering/infer_shape)")
+                if shape is not None and _shapes_conflict(shape, v.shape):
+                    rep.add(ERROR, "TS002",
+                            "op %s output %r is declared shape %s but the "
+                            "lowering produces %s"
+                            % (op.type, n, list(shape), list(v.shape)),
+                            blk.idx, op_idx, (n,),
+                            suggestion="fix the var's declared shape (or "
+                            "the op's lowering/infer_shape)")
+
+    _check_grad_consistency(program, rep)
+
+
+def _check_grad_consistency(program, rep):
+    """TS003: every ``X@GRAD`` var must agree with its forward var ``X`` —
+    grad-program vs forward consistency through backward.py's naming.
+    Pass-local renames (``@RENAME@k``, ``@D2``) are stripped first.  AMP
+    mixed precision legitimately narrows float widths, so only kind-level
+    dtype drift (float vs int/bool) and shape contradictions are flagged."""
+    for blk in program.blocks:
+        for name, gvar in list(blk.vars.items()):
+            base = name.split("@RENAME@")[0].split("@D")[0]
+            if not base.endswith(_GRAD_SUFFIX):
+                continue
+            fwd_name = base[: -len(_GRAD_SUFFIX)]
+            fvar = blk._find_var_recursive(fwd_name)
+            if fvar is None:
+                continue
+            if _shapes_conflict(fvar.shape, gvar.shape):
+                rep.add(WARNING, "TS003",
+                        "grad var %r has shape %s but forward var %r has "
+                        "shape %s"
+                        % (name, list(gvar.shape), fwd_name,
+                           list(fvar.shape)),
+                        blk.idx, None, (name, fwd_name),
+                        suggestion="the grad maker or infer_shape for the "
+                        "producing op disagrees with the forward")
+            fk, gk = _dtype_kind(fvar.dtype), _dtype_kind(gvar.dtype)
+            if fk is not None and gk is not None and fk != gk:
+                rep.add(WARNING, "TS003",
+                        "grad var %r is %s but forward var %r is %s"
+                        % (name, gvar.dtype, fwd_name, fvar.dtype),
+                        blk.idx, None, (name, fwd_name))
+
+
+# ---------------------------------------------------------------------------
+# family 3: donation / aliasing hazards
+# ---------------------------------------------------------------------------
+
+
+def _check_donation(program, feed_names, fetch_names, rep):
+    """The executor donates every persistable var the block overwrites
+    (core/lowering.py BlockPlan rw_names + the FLAGS_layout_match_params
+    carry dict), so its pre-step buffer is dead the moment the update runs.
+    DA001 flags a read of such a var AFTER its in-place update: the reader
+    silently observes the updated value and, under donation, the buffer it
+    "remembers" no longer exists.  DA003 is program-level race detection:
+    two writes to one scope var where the second write never reads the
+    first — no data dependency orders them, so a rewriter that reorders
+    ops (IR passes, transpilers) silently changes which value survives."""
+    from .lowering import analyze_block
+
+    if getattr(program, "_no_donate", False):
+        donated = set()
+    else:
+        block = program.global_block()
+        try:
+            ext, _written, persist_written = analyze_block(block, feed_names)
+        except Exception:
+            return
+        donated = set(ext) & set(persist_written)
+
+    from ..framework import OP_ROLE_KEY, OpRole
+
+    block = program.global_block()
+    ops = _runtime_ops(block)
+
+    writes = {}
+    for op_idx, op in ops:
+        for n in op.output_arg_names:
+            if n:
+                writes.setdefault(n, []).append(op_idx)
+
+    for name in sorted(donated):
+        idxs = writes.get(name, ())
+        if not idxs:
+            continue
+        first_w = idxs[0]
+        wop = block.ops[first_w]
+        role = int(wop.attr(OP_ROLE_KEY) or 0)
+        if not role & OpRole.Optimize:
+            # a pure (re)definition — an LR-schedule counter increment or
+            # a metric accumulator — where the later read WANTS the new
+            # value; only optimizer updates invalidate a param's old buffer
+            continue
+        for op_idx, op in ops:
+            if op_idx <= first_w:
+                continue
+            ins = op.input_arg_names
+            if name in ins and name not in op.output_arg_names:
+                rep.add(ERROR, "DA001",
+                        "op %s reads donated var %r after op %d updated it "
+                        "in place — the pre-update buffer is consumed by "
+                        "donation and the read observes the new value"
+                        % (op.type, name, first_w),
+                        block.idx, op_idx, (name,),
+                        suggestion="read %r before the update at op %d, or "
+                        "snapshot it into a fresh var first"
+                        % (name, first_w))
+                break
+
+    fetched_donated = sorted(donated & set(fetch_names))
+    for name in fetched_donated:
+        rep.add(INFO, "DA002",
+                "fetch target %r is donated and updated in this block; the "
+                "fetch observes the post-update value" % name,
+                var_names=(name,))
+
+    # DA003: double write with no intervening read of the first value
+    for name, idxs in sorted(writes.items()):
+        if len(idxs) < 2:
+            continue
+        v = block._find_var_recursive(name)
+        if v is None or not v.persistable:
+            continue  # trace-local SSA renames handle temporaries
+        for prev, nxt in zip(idxs, idxs[1:]):
+            nop = block.ops[nxt]
+            if name not in nop.input_arg_names:
+                rep.add(WARNING, "DA003",
+                        "op %s overwrites %r (already written by op %d) "
+                        "without reading it — no data dependency orders "
+                        "the two writes" % (nop.type, name, prev),
+                        block.idx, nxt, (name,),
+                        suggestion="drop the dead first write or make the "
+                        "second write read the var")
+                break
+
+
+# ---------------------------------------------------------------------------
+# family 4: distributed lint
+# ---------------------------------------------------------------------------
+
+
+def _check_collectives(program, rep):
+    """DL003 ring_id discipline for program-level collectives."""
+    for blk in program.blocks:
+        rings = []
+        missing = []
+        for op_idx, op in _runtime_ops(blk):
+            if op.type not in _COLLECTIVE_OPS:
+                continue
+            ring = op.attr("ring_id")
+            if ring is None:
+                missing.append((op_idx, op))
+                continue
+            if int(ring) < 0:
+                rep.add(ERROR, "DL003",
+                        "collective op %s has negative ring_id %s"
+                        % (op.type, ring), blk.idx, op_idx)
+            else:
+                rings.append(int(ring))
+        for op_idx, op in missing:
+            sev = WARNING if not rings else ERROR
+            rep.add(sev, "DL003",
+                    "collective op %s has no ring_id attr%s"
+                    % (op.type,
+                       " while others in the block use rings %s"
+                       % sorted(set(rings)) if rings else ""),
+                    blk.idx, op_idx,
+                    suggestion="assign a ring_id (transpiler round-robins "
+                    "0..nrings-1)")
+
+
+def verify_transpiled(ps_state, rep=None):
+    """Distributed lint over a DistributeTranspiler result (PSState):
+    placement, send/recv pairing, and trainer/pserver duplication."""
+    if rep is None:
+        rep = VerifyReport(label="transpiled PS programs")
+
+    trainer = ps_state.trainer_program
+    meta = getattr(trainer, "_ps_trainer", None) or {}
+    param_to_ep = dict(getattr(ps_state, "param_map", None) or
+                       meta.get("param_to_ep", {}))
+    param_grad = dict(meta.get("param_grad", {}))
+    geo = bool(meta.get("geo"))
+
+    # DL001: every param owned by exactly one pserver, and the trainer's
+    # placement map agrees with the servers' owned lists
+    owners = {}
+    for ep, prog in ps_state.pserver_programs.items():
+        smeta = getattr(prog, "_ps_server", None) or {}
+        for p in smeta.get("params", ()):
+            owners.setdefault(p, []).append(ep)
+    for p, eps in sorted(owners.items()):
+        if len(eps) != 1:
+            rep.add(ERROR, "DL001",
+                    "param %r is assigned to %d pservers (%s)"
+                    % (p, len(eps), sorted(eps)), var_names=(p,),
+                    suggestion="each param must have exactly one owner; "
+                    "fix the transpiler placement map")
+    for p, ep in sorted(param_to_ep.items()):
+        got = owners.get(p, [])
+        if not got:
+            rep.add(ERROR, "DL001",
+                    "param %r is mapped to %s by the trainer but no "
+                    "pserver program owns it" % (p, ep), var_names=(p,))
+        elif got != [ep]:
+            rep.add(ERROR, "DL001",
+                    "trainer maps param %r to %s but pserver(s) %s own it"
+                    % (p, ep, got), var_names=(p,))
+    for p in sorted(set(owners) - set(param_to_ep)):
+        rep.add(ERROR, "DL001",
+                "pserver(s) %s own param %r the trainer never sends to"
+                % (owners[p], p), var_names=(p,))
+
+    # DL002: send/recv var pairing — every placed param needs a grad the
+    # trainer ships, every server-side grad key must map back to a placed
+    # param, and no grad may serve two params
+    for p in sorted(param_to_ep):
+        if p not in param_grad:
+            rep.add(ERROR, "DL002",
+                    "param %r is placed on a pserver but has no grad to "
+                    "send" % p, var_names=(p,),
+                    suggestion="the optimizer op for %r vanished during "
+                    "transpile" % p)
+    grad_owner = {}
+    for p, g in sorted(param_grad.items()):
+        if g in grad_owner:
+            rep.add(ERROR, "DL002",
+                    "grad %r is paired with both %r and %r"
+                    % (g, grad_owner[g], p), var_names=(g, p))
+        grad_owner[g] = p
+    for ep, prog in ps_state.pserver_programs.items():
+        smeta = getattr(prog, "_ps_server", None) or {}
+        for g, p in sorted(smeta.get("grad_map", {}).items()):
+            if param_grad.get(p) != g:
+                rep.add(ERROR, "DL002",
+                        "pserver %s expects grad %r for param %r but the "
+                        "trainer sends %r"
+                        % (ep, g, p, param_grad.get(p)), var_names=(g, p))
+
+    # DL004: a side-effecting (optimizer) op left in BOTH halves applies
+    # the update twice per step.  Geo-SGD keeps trainer-local optimizers by
+    # design (the server applies deltas, not grads), so it is exempt.
+    if not geo:
+        from ..framework import OP_ROLE_KEY, OpRole
+
+        def opt_params(prog):
+            out = set()
+            for op in prog.global_block().ops:
+                if int(op.attr(OP_ROLE_KEY) or 0) & OpRole.Optimize:
+                    pn = op.input("Param")
+                    if pn:
+                        out.add((op.type, pn[0]))
+            return out
+
+        trainer_opts = opt_params(trainer)
+        for ep, prog in ps_state.pserver_programs.items():
+            smeta = getattr(prog, "_ps_server", None) or {}
+            server_opts = opt_params(smeta.get("optimize_program", None)
+                                     or prog)
+            for op_type, p in sorted(trainer_opts & server_opts):
+                rep.add(ERROR, "DL004",
+                        "optimizer op %s(Param=%r) runs on BOTH the "
+                        "trainer and pserver %s — the update applies "
+                        "twice per step" % (op_type, p, ep),
+                        var_names=(p,),
+                        suggestion="strip Optimize-role ops from the "
+                        "trainer program (non-geo modes)")
+
+    return rep
+
+
+# ---------------------------------------------------------------------------
+# top-level entry points
+# ---------------------------------------------------------------------------
+
+
+def verify_program(program, feed_names=(), fetch_names=(), scope_names=None,
+                   label=None):
+    """Run all single-program rule families; returns a VerifyReport.
+
+    `feed_names`/`fetch_names` sharpen WF001/WF004/DA002 exactly like the
+    executor's view; `scope_names` (names resident in the run scope) keeps
+    WF001 precise for programs reading pre-seeded scope vars."""
+    rep = VerifyReport(label=label or ("program #%d"
+                                       % getattr(program, "_uid", -1)))
+    checks = (
+        lambda: _check_wellformed(program, feed_names, fetch_names,
+                                  scope_names, rep),
+        lambda: _check_type_shape(program, rep),
+        lambda: _check_donation(program, feed_names, fetch_names, rep),
+        lambda: _check_collectives(program, rep),
+    )
+    for chk in checks:
+        try:
+            chk()
+        except Exception as exc:  # a verifier crash must never kill a run
+            warnings.warn("static check pass failed internally: %r" % exc,
+                          ProgramVerifyWarning, stacklevel=2)
+    return rep
+
+
+def _mode():
+    from .. import flags
+
+    return flags.flag("static_check") or "off"
+
+
+def _dispatch(rep, mode):
+    """Shared warn/error policy: count every error+warning diagnostic into
+    the telemetry registry, warn once with the report, and in error mode
+    raise the readable report when any error-severity finding exists."""
+    from . import telemetry
+
+    flagged = rep.errors + rep.warnings
+    if not flagged:
+        return rep
+    for d in flagged:
+        telemetry.inc("static_check_warnings", 1, rule=d.rule)
+    if mode == "error" and rep.errors:
+        raise ProgramVerificationError(rep)
+    warnings.warn(rep.format(include_info=False), ProgramVerifyWarning,
+                  stacklevel=3)
+    return rep
+
+
+_checked = {}
+_CHECKED_CAP = 1024
+
+
+def check_before_compile(program, feed_names, fetch_names, scope=None):
+    """Executor compile-path hook (cache-miss only).  Flag-gated:
+    ``off`` returns after one flag read; ``warn`` logs + counts; ``error``
+    raises ProgramVerificationError.  Results are memoized per (program,
+    version, signature) so repeated compiles of one program (new feed
+    shapes) don't re-verify."""
+    mode = _mode()
+    if mode == "off":
+        return None
+    key = (getattr(program, "_uid", id(program)), program.version,
+           tuple(sorted(feed_names)), tuple(fetch_names), mode)
+    if key in _checked:
+        return _checked[key]
+    scope_names = set()
+    s = scope
+    while s is not None:
+        try:
+            scope_names.update(s.local_var_names())
+        except Exception:
+            pass
+        s = getattr(s, "parent", None)
+    rep = verify_program(program, feed_names, fetch_names, scope_names)
+    if len(_checked) >= _CHECKED_CAP:
+        _checked.clear()
+    _checked[key] = rep
+    return _dispatch(rep, mode)
+
+
+def check_transpiled(ps_state):
+    """Post-transpile hook (DistributeTranspiler pserver mode): same flag
+    policy as check_before_compile, over the trainer/pserver split."""
+    mode = _mode()
+    if mode == "off":
+        return None
+    rep = verify_transpiled(ps_state)
+    return _dispatch(rep, mode)

@@ -257,6 +257,14 @@ class Executor:
         cache_hit = entry is not None
         build_s = 0.0
         if entry is None:
+            # static verifier runs only on the compile path (cache misses),
+            # memoized per program signature inside check_before_compile —
+            # steady-state steps never pay for it, and FLAGS_static_check=
+            # off is a single flag read
+            from .analysis import check_before_compile
+
+            check_before_compile(program, list(feed_arrays), fetch_names,
+                                 scope=scope)
             t_build = time.perf_counter()
             entry = self._compile(program, list(feed_arrays), fetch_names, mesh, data_axis)
             build_s = time.perf_counter() - t_build

@@ -70,6 +70,24 @@ class OpDef:
         self.optional_inputs = frozenset(optional_inputs)
         self.duplicable_inputs = frozenset(duplicable_inputs)
         self.duplicable_outputs = frozenset(duplicable_outputs)
+        # def-level consistency: every slot-qualifier set must name real
+        # slots — a typo here (or an output slot listed as an optional
+        # input) is silent metadata rot the per-instance validate() can
+        # never catch, because instances only carry slots they use
+        ins, outs = set(self.input_slots), set(self.output_slots)
+        for label, members, universe in (
+            ("no_grad_inputs", self.no_grad_inputs, ins),
+            ("optional_inputs", self.optional_inputs, ins),
+            ("duplicable_inputs", self.duplicable_inputs, ins),
+            ("duplicable_outputs", self.duplicable_outputs, outs),
+        ):
+            unknown = members - universe
+            if unknown:
+                raise ValueError(
+                    "op %r: %s %s are not declared %s slots (%s)"
+                    % (type, label, sorted(unknown),
+                       "input" if universe is ins else "output",
+                       sorted(universe)))
         self.stateful = stateful
         self.n_rng = n_rng  # number of PRNG keys the lowering consumes
         # optional per-op predicate attrs -> bool: does THIS instance

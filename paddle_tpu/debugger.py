@@ -1,8 +1,9 @@
 """Program visualization (reference python/paddle/fluid/debugger.py
 draw_block_graphviz + graphviz.py/net_drawer.py): emit a Graphviz dot of a
-block's op/var graph."""
+block's op/var graph, or a plain-text op graph with verifier diagnostics
+annotated onto the offending ops (``tools/proglint.py --dump``)."""
 
-__all__ = ["draw_block_graphviz", "pprint_program_codes"]
+__all__ = ["draw_block_graphviz", "draw_program", "pprint_program_codes"]
 
 
 def _dot_escape(s):
@@ -38,5 +39,56 @@ def draw_block_graphviz(block, highlights=None, path="./temp.dot"):
     return path
 
 
-def pprint_program_codes(program):
-    print(program.to_string(throw_on_error=False))
+def _diags_by_op(diagnostics, block_idx):
+    by_op = {}
+    for d in diagnostics or ():
+        if d.op_idx is not None and (d.block_idx or 0) == block_idx:
+            by_op.setdefault(d.op_idx, []).append(d)
+    return by_op
+
+
+_SEV_MARK = {"error": "!!", "warning": " !", "info": " ."}
+
+
+def draw_program(program, diagnostics=None, max_var_width=40):
+    """Render a program as a plain-text op graph, one line per op
+    (``idx: type(inputs) -> outputs``), with any verifier diagnostics
+    attached under the op they point at.  Program-level diagnostics (no op
+    index) are listed in a trailing section.  Returns the string."""
+    lines = []
+    diagnostics = list(diagnostics or ())
+    for blk in program.blocks:
+        lines.append("block %d (%d ops, %d vars):"
+                     % (blk.idx, len(blk.ops), len(blk.vars)))
+        by_op = _diags_by_op(diagnostics, blk.idx)
+        for i, op in enumerate(blk.ops):
+            ins = ", ".join(n for n in op.input_arg_names if n)
+            outs = ", ".join(n for n in op.output_arg_names if n)
+            if len(ins) > max_var_width:
+                ins = ins[: max_var_width - 3] + "..."
+            if len(outs) > max_var_width:
+                outs = outs[: max_var_width - 3] + "..."
+            lines.append("  %4d: %s(%s) -> %s" % (i, op.type, ins, outs))
+            for d in by_op.get(i, ()):
+                lines.append("        %s %s %s: %s"
+                             % (_SEV_MARK.get(d.severity, "??"), d.rule,
+                                d.severity.upper(), d.message))
+                if d.suggestion:
+                    lines.append("           fix: %s" % d.suggestion)
+    prog_level = [d for d in diagnostics if d.op_idx is None]
+    if prog_level:
+        lines.append("program-level:")
+        for d in prog_level:
+            lines.append("  %s %s %s: %s"
+                         % (_SEV_MARK.get(d.severity, "??"), d.rule,
+                            d.severity.upper(), d.message))
+    return "\n".join(lines)
+
+
+def pprint_program_codes(program, diagnostics=None):
+    """Print the program repr; with verifier diagnostics, print the
+    annotated text graph instead of the bare dump."""
+    if diagnostics:
+        print(draw_program(program, diagnostics))
+    else:
+        print(program.to_string(throw_on_error=False))

@@ -62,6 +62,14 @@ _DEFAULTS = {
     # metrics.prom; empty = in-memory only (snapshot()/__metrics__ RPC
     # still work, nothing touches disk)
     "FLAGS_telemetry_dir": "",
+    # static Program verifier (core/analysis.py): off | warn | error.
+    # "warn" (default) runs the four rule families (well-formedness,
+    # type/shape flow, donation/aliasing, distributed lint) on every
+    # executor cache-miss compile and post-transpile, logging a
+    # ProgramVerifyWarning + counting static_check_warnings into telemetry;
+    # "error" raises one readable ProgramVerificationError report instead
+    # of an opaque XLA traceback; "off" costs a single flag read
+    "FLAGS_static_check": "warn",
     # HBM footprint auditor (core/memory_audit.py): after each compile, log
     # the executable's memory_analysis (arg/output/temp/alias bytes) with
     # per-variable attribution of the argument footprint.  Diagnostic; adds

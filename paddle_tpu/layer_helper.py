@@ -58,6 +58,24 @@ class LayerHelper:
             attr.name = unique_name.generate(
                 "%s.%s" % (self.name, "b" if is_bias else "w")
             )
+        # shared parameters (an explicit attr.name reused across layers,
+        # e.g. word2vec's one embedding table behind four lookups) must
+        # resolve to the ONE existing Parameter — re-creating it appended a
+        # duplicate initializer op into the startup program per reuse
+        # (N racing writes to one var; the verifier's DA003 flags it)
+        existing = self.main_program.global_block().vars.get(attr.name)
+        if existing is not None:
+            from .framework import Parameter
+
+            if not isinstance(existing, Parameter):
+                raise ValueError(
+                    "variable %r already exists and is not a Parameter"
+                    % attr.name)
+            if tuple(existing.shape) != tuple(shape):
+                raise ValueError(
+                    "shared parameter %r re-requested with shape %s != %s"
+                    % (attr.name, list(shape), list(existing.shape)))
+            return existing
         if default_initializer is None:
             default_initializer = Constant(0.0) if is_bias else Xavier()
         init = attr.initializer or default_initializer

@@ -6,3 +6,57 @@ from . import bert  # noqa: F401
 from . import transformer  # noqa: F401
 from . import yolov3  # noqa: F401
 from . import word2vec  # noqa: F401
+
+
+def bundled_builders():
+    """name -> zero-arg builder for every bundled model, at the tiny
+    configs the test suite exercises.  Each builder must run inside a
+    ``fluid.program_guard`` and returns ``(feed_vars, fetch_vars)``; the
+    training builders include their optimizer, so the returned program
+    already contains the grad sub-graph.  Shared by ``tools/proglint.py``
+    and ``tests/test_program_verifier.py`` so the lint surface and the
+    test surface cannot drift apart."""
+
+    def _mnist_mlp():
+        img, label, logits, loss, acc = mnist.build_mlp()
+        return [img, label], [loss, acc]
+
+    def _mnist_conv():
+        img, label, logits, loss, acc = mnist.build_conv()
+        return [img, label], [loss, acc]
+
+    def _resnet18():
+        img, label, loss, acc = resnet.build_train(
+            depth=18, class_dim=10, image_size=32)
+        return [img, label], [loss, acc]
+
+    def _bert_tiny():
+        inputs, loss = bert.build_pretrain(bert.BERT_TINY, seq_len=16,
+                                           lr=1e-3)
+        return list(inputs), [loss]
+
+    def _transformer_tiny():
+        cfg = transformer.TransformerConfig(
+            src_vocab=64, trg_vocab=64, d_model=32, heads=2, enc_layers=1,
+            dec_layers=1, ffn=64, max_len=16)
+        feeds, loss = transformer.build_train(cfg, src_len=8, trg_len=8)
+        return list(feeds), [loss]
+
+    def _yolov3_tiny():
+        img, gt_box, gt_label, loss = yolov3.build_train(
+            class_num=3, image_size=64, max_boxes=4, width=4)
+        return [img, gt_box, gt_label], [loss]
+
+    def _word2vec():
+        words, nextw, cost = word2vec.build_train(dict_size=100)
+        return list(words) + [nextw], [cost]
+
+    return {
+        "mnist_mlp": _mnist_mlp,
+        "mnist_conv": _mnist_conv,
+        "resnet18": _resnet18,
+        "bert_tiny": _bert_tiny,
+        "transformer_tiny": _transformer_tiny,
+        "yolov3_tiny": _yolov3_tiny,
+        "word2vec": _word2vec,
+    }

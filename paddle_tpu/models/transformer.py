@@ -173,12 +173,12 @@ def build_train(cfg, src_len, trg_len, lr=1.0, warmup=400):
     dec_out = decoder(trg_emb, enc_out, cfg, _causal_mask(trg_len), src_mask)
     logits = _logits(dec_out, cfg)
 
-    label = L.reshape(lbl, [-1, trg_len, 1])
     if cfg.label_smooth:
         one_hot = L.one_hot(L.reshape(lbl, [-1, trg_len]), cfg.trg_vocab)
         smooth = L.label_smooth(one_hot, epsilon=cfg.label_smooth)
         ce = L.softmax_with_cross_entropy(logits, smooth, soft_label=True)
     else:
+        label = L.reshape(lbl, [-1, trg_len, 1])
         ce = L.softmax_with_cross_entropy(logits, label)
     ce = L.reshape(ce, [-1, trg_len])
     token_loss = L.elementwise_mul(ce, weights)

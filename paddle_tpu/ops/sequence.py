@@ -69,7 +69,10 @@ def sequence_mask(ctx, x, maxlen_tensor, maxlen=-1, out_dtype=5):
     inputs=("X", "Length"),
     outputs=("Out", "MaxIndex"),
     attrs={"pooltype": "AVERAGE", "pad_value": 0.0},
-    optional_inputs=("Length", "MaxIndex"),
+    # MaxIndex is an OUTPUT (reference sequence_pool_op.cc emits it for the
+    # MAX pool's backward); it was mistakenly listed as an optional input
+    # here until OpDef grew def-level slot validation
+    optional_inputs=("Length",),
 )
 def sequence_pool(ctx, x, length, pooltype="AVERAGE", pad_value=0.0):
     pooltype = pooltype.upper()

@@ -100,12 +100,23 @@ class DistributeTranspiler:
             t.transpile(self.startup_program, self.program, trainer_id, eps,
                         "%d" % trainer_id)
             self._transpiled = True
+            # post-transpile static lint: ring_id discipline on the
+            # collectives the pass just inserted (FLAGS_static_check-gated)
+            from ..core.analysis import check_before_compile
+
+            check_before_compile(self.program, [], [])
             return
 
         from .ps_transpile import transpile_pserver_mode
 
         self._ps_state = transpile_pserver_mode(self)
         self._transpiled = True
+        # post-transpile static lint over the trainer/pserver split:
+        # placement (DL001), send/recv pairing (DL002), duplicated
+        # side-effecting ops (DL004) — FLAGS_static_check-gated
+        from ..core.analysis import check_transpiled
+
+        check_transpiled(self._ps_state)
 
     def get_trainer_program(self, wait_port=True):
         if self.config.mode in ("nccl2", "collective"):

@@ -1,0 +1,476 @@
+"""Static Program verifier (core/analysis.py): seeded-defect fixtures for
+each rule family, clean-run assertions over the bundled model zoo and a
+transpiled 2-pserver split, executor wiring (warn/error/off), and
+regression tests for the defects the verifier surfaced (backward.py dead
+grad chains, the sequence_pool registry slot typo, shared-parameter
+double initialization)."""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, models
+from paddle_tpu.core import analysis, telemetry
+from paddle_tpu.core.analysis import (
+    ProgramVerificationError,
+    ProgramVerifyWarning,
+)
+from paddle_tpu.framework import OP_ROLE_KEY, OpRole
+
+
+@pytest.fixture
+def static_check_flag():
+    """Restore FLAGS_static_check (and telemetry) after each wiring test."""
+    before = fluid.get_flags(["FLAGS_static_check", "FLAGS_telemetry"])
+    yield
+    fluid.set_flags(before)
+    telemetry.reset()
+
+
+def _programs():
+    main, startup = fluid.Program(), fluid.Program()
+    return main, startup
+
+
+# -- family 1: well-formedness ----------------------------------------------
+
+
+def test_wf001_use_before_def():
+    main, startup = _programs()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        y = layers.relu(x)
+        blk = main.global_block()
+        ghost = blk.create_var(name="ghost", shape=[-1, 4], dtype="float32")
+        blk.append_op(type="relu", inputs={"X": [ghost]},
+                      outputs={"Out": [y]})
+        bad_idx = len(blk.ops) - 1
+    rep = analysis.verify_program(main, feed_names=["x"], label="wf001")
+    hits = rep.by_rule("WF001")
+    assert hits, rep.format()
+    assert hits[0].severity == analysis.ERROR
+    assert hits[0].op_idx == bad_idx
+    assert "ghost" in hits[0].var_names
+
+
+def test_wf002_unknown_op_type():
+    main, startup = _programs()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        y = layers.relu(x)
+    # splice in an unregistered op type behind append_op's back (the same
+    # hole Program.from_dict leaves open)
+    blk = main.global_block()
+    op = blk.ops[-1]
+    op.type = "definitely_not_an_op"
+    rep = analysis.verify_program(main, feed_names=["x"], label="wf002")
+    hits = rep.by_rule("WF002")
+    assert hits and hits[0].severity == analysis.ERROR
+    assert hits[0].op_idx == len(blk.ops) - 1
+
+
+# -- family 2: type/shape flow ----------------------------------------------
+
+
+def test_ts001_dtype_mismatch():
+    main, startup = _programs()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        y = layers.relu(x)
+        bad_idx = len(main.global_block().ops) - 1
+    # corrupt the declared dtype: relu of f32 cannot produce int32
+    y.dtype = "int32"
+    rep = analysis.verify_program(main, feed_names=["x"], label="ts001")
+    hits = rep.by_rule("TS001")
+    assert hits and hits[0].severity == analysis.ERROR
+    assert hits[0].op_idx == bad_idx
+    assert y.name in hits[0].var_names
+    # and the verifier must not have mutated the checked program
+    assert main.global_block().var(y.name).dtype == "int32"
+
+
+def test_ts002_shape_contradiction():
+    main, startup = _programs()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        y = layers.relu(x)
+        bad_idx = len(main.global_block().ops) - 1
+    y.shape = (-1, 7)  # relu preserves [-1, 4]
+    rep = analysis.verify_program(main, feed_names=["x"], label="ts002")
+    hits = rep.by_rule("TS002")
+    assert hits and hits[0].op_idx == bad_idx
+
+
+# -- family 3: donation/aliasing --------------------------------------------
+
+
+def test_da001_donated_then_read():
+    main, startup = _programs()
+    with fluid.program_guard(main, startup):
+        w = layers.create_parameter([4], "float32", name="w0")
+        g = layers.create_parameter([4], "float32", name="g0")
+        lr = layers.fill_constant([1], "float32", 0.1)
+        blk = main.global_block()
+        blk.append_op(
+            type="sgd",
+            inputs={"Param": [w], "Grad": [g], "LearningRate": [lr]},
+            outputs={"ParamOut": [w]},
+            attrs={OP_ROLE_KEY: OpRole.Optimize},
+        )
+        y = layers.scale(w, scale=2.0)  # reads w AFTER its in-place update
+        read_idx = len(blk.ops) - 1
+    rep = analysis.verify_program(main, label="da001")
+    hits = rep.by_rule("DA001")
+    assert hits and hits[0].severity == analysis.ERROR
+    assert hits[0].op_idx == read_idx
+    assert "w0" in hits[0].var_names
+    # reading w BEFORE the update is fine: no diagnostic on that pattern
+    main2, startup2 = _programs()
+    with fluid.program_guard(main2, startup2):
+        w = layers.create_parameter([4], "float32", name="w0")
+        g = layers.create_parameter([4], "float32", name="g0")
+        lr = layers.fill_constant([1], "float32", 0.1)
+        y = layers.scale(w, scale=2.0)
+        blk2 = main2.global_block()
+        blk2.append_op(
+            type="sgd",
+            inputs={"Param": [w], "Grad": [g], "LearningRate": [lr]},
+            outputs={"ParamOut": [w]},
+            attrs={OP_ROLE_KEY: OpRole.Optimize},
+        )
+    assert not analysis.verify_program(main2).by_rule("DA001")
+
+
+def test_da003_double_write_no_read():
+    main, startup = _programs()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        v = layers.create_parameter([4], "float32", name="acc")
+        blk = main.global_block()
+        blk.append_op(type="scale", inputs={"X": [x]},
+                      outputs={"Out": [v]}, attrs={"scale": 1.0})
+        blk.append_op(type="scale", inputs={"X": [x]},
+                      outputs={"Out": [v]}, attrs={"scale": 2.0})
+        second = len(blk.ops) - 1
+    rep = analysis.verify_program(main, feed_names=["x"], label="da003")
+    hits = rep.by_rule("DA003")
+    assert hits and hits[0].op_idx == second
+
+
+# -- family 4: distributed lint ---------------------------------------------
+
+
+def _transpiled_word2vec(n_pservers=2):
+    main, startup = _programs()
+    with fluid.program_guard(main, startup):
+        words, nextw, cost = models.word2vec.build_train(dict_size=64)
+    eps = ",".join("127.0.0.1:%d" % (7170 + i) for i in range(n_pservers))
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, pservers=eps, trainers=2,
+                startup_program=startup)
+    return t, [v.name for v in words + [nextw]], [cost.name]
+
+
+def test_dl001_double_assigned_pserver_param():
+    t, _, _ = _transpiled_word2vec()
+    state = t._ps_state
+    eps = sorted(state.pserver_programs)
+    metas = [state.pserver_programs[ep]._ps_server for ep in eps]
+    # seed the defect: give the second pserver a param the first owns
+    stolen = next(p for p in metas[0]["params"])
+    metas[1]["params"] = list(metas[1]["params"]) + [stolen]
+    rep = analysis.verify_transpiled(state)
+    hits = rep.by_rule("DL001")
+    assert hits and hits[0].severity == analysis.ERROR
+    assert stolen in hits[0].var_names
+
+
+def test_dl002_broken_send_recv_pairing():
+    t, _, _ = _transpiled_word2vec()
+    state = t._ps_state
+    meta = state.trainer_program._ps_trainer
+    victim = sorted(meta["param_grad"])[0]
+    del meta["param_grad"][victim]
+    rep = analysis.verify_transpiled(state)
+    assert any(victim in d.var_names for d in rep.by_rule("DL002")), \
+        rep.format()
+
+
+def test_dl004_optimizer_on_both_sides():
+    t, _, _ = _transpiled_word2vec()
+    state = t._ps_state
+    trainer = state.trainer_program
+    blk = trainer.global_block()
+    # seed the defect: re-apply one param's update on the trainer too
+    ep = sorted(state.pserver_programs)[0]
+    smeta = state.pserver_programs[ep]._ps_server
+    opt_prog = smeta.get("optimize_program") or state.pserver_programs[ep]
+    src = next(op for op in opt_prog.global_block().ops
+               if int(op.attr(OP_ROLE_KEY) or 0) & OpRole.Optimize
+               and op.input("Param"))
+    blk.append_op(type=src.type, inputs=dict(src.inputs),
+                  outputs=dict(src.outputs),
+                  attrs={OP_ROLE_KEY: OpRole.Optimize})
+    rep = analysis.verify_transpiled(state)
+    hits = rep.by_rule("DL004")
+    assert hits and hits[0].severity == analysis.ERROR
+
+
+def test_dl003_ring_id_lint():
+    main, startup = _programs()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        blk = main.global_block()
+        out = blk.create_var(name="cout", shape=[-1, 4], dtype="float32")
+        blk.append_op(type="c_allreduce_sum", inputs={"X": [x]},
+                      outputs={"Out": [out]}, attrs={"ring_id": -3})
+        bad = len(blk.ops) - 1
+    rep = analysis.verify_program(main, feed_names=["x"], label="dl003")
+    hits = rep.by_rule("DL003")
+    assert hits and hits[0].op_idx == bad
+
+
+# -- clean runs over the bundled zoo ----------------------------------------
+
+
+@pytest.mark.parametrize("name", sorted(models.bundled_builders()))
+def test_bundled_model_is_clean(name):
+    build = models.bundled_builders()[name]
+    main, startup = _programs()
+    with fluid.program_guard(main, startup):
+        feeds, fetches = build()
+    has_backward = any(int(op.attr(OP_ROLE_KEY) or 0) & OpRole.Backward
+                       for op in main.global_block().ops)
+    if not has_backward:  # mnist builders: lint the grad program too
+        with fluid.program_guard(main, startup):
+            fluid.backward.append_backward(fetches[0])
+    rep = analysis.verify_program(
+        main, feed_names=[v.name for v in feeds],
+        fetch_names=[v.name for v in fetches], label=name)
+    assert not rep.errors and not rep.warnings, rep.format()
+    srep = analysis.verify_program(startup, label=name + "/startup")
+    assert not srep.errors and not srep.warnings, srep.format()
+
+
+def test_transpiled_2pserver_is_clean():
+    t, feed_names, fetch_names = _transpiled_word2vec()
+    rep = analysis.verify_transpiled(t._ps_state)
+    assert rep.ok, rep.format()
+    trainer = t.get_trainer_program()
+    rep = analysis.verify_program(trainer, feed_names, fetch_names,
+                                  label="ps-trainer")
+    assert not rep.errors and not rep.warnings, rep.format()
+    for ep in sorted(t._ps_state.pserver_programs):
+        prep = analysis.verify_program(t.get_pserver_program(ep),
+                                       label="pserver")
+        assert not prep.errors and not prep.warnings, prep.format()
+
+
+# -- executor wiring: off / warn / error ------------------------------------
+
+
+def _dead_op_program():
+    """Runs fine but carries one WF004 warning (a dead scale op)."""
+    main, startup = _programs()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        dead = layers.scale(x, scale=3.0)  # never consumed or fetched
+        y = layers.scale(x, scale=2.0)
+    return main, startup, y
+
+
+def test_flag_off_never_invokes_verifier(static_check_flag, monkeypatch):
+    fluid.set_flags({"FLAGS_static_check": "off"})
+
+    def boom(*a, **k):  # any call = the early-return contract is broken
+        raise AssertionError("verifier ran with FLAGS_static_check=off")
+
+    monkeypatch.setattr(analysis, "verify_program", boom)
+    main, startup, y = _dead_op_program()
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        out = exe.run(main, feed={"x": np.ones((2, 4), "float32")},
+                      fetch_list=[y])[0]
+    np.testing.assert_allclose(out, 2 * np.ones((2, 4)), rtol=1e-6)
+
+
+def test_warn_mode_warns_counts_and_memoizes(static_check_flag,
+                                             monkeypatch):
+    fluid.set_flags({"FLAGS_static_check": "warn", "FLAGS_telemetry": True})
+    telemetry.reset()
+    calls = []
+    real = analysis.verify_program
+    monkeypatch.setattr(
+        analysis, "verify_program",
+        lambda *a, **k: calls.append(1) or real(*a, **k))
+    main, startup, y = _dead_op_program()
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        feed = {"x": np.ones((2, 4), "float32")}
+        with warnings.catch_warnings(record=True) as got:
+            warnings.simplefilter("always")
+            exe.run(main, feed=feed, fetch_list=[y])
+        assert any(issubclass(w.category, ProgramVerifyWarning)
+                   and "WF004" in str(w.message) for w in got)
+        n_after_first = len(calls)
+        assert n_after_first >= 1
+        # steady-state steps hit the program cache: no re-verification
+        with warnings.catch_warnings(record=True) as again:
+            warnings.simplefilter("always")
+            exe.run(main, feed=feed, fetch_list=[y])
+        assert len(calls) == n_after_first
+        assert not any(issubclass(w.category, ProgramVerifyWarning)
+                       for w in again)
+    assert telemetry.counter_total("static_check_warnings") >= 1
+
+
+def test_error_mode_raises_readable_report(static_check_flag):
+    fluid.set_flags({"FLAGS_static_check": "error"})
+    main, startup = _programs()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        y = layers.relu(x)
+        blk = main.global_block()
+        ghost = blk.create_var(name="ghost", shape=[-1, 4],
+                               dtype="float32")
+        blk.append_op(type="relu", inputs={"X": [ghost]},
+                      outputs={"Out": [y]})
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        with pytest.raises(ProgramVerificationError) as ei:
+            exe.run(main, feed={"x": np.ones((2, 4), "float32")},
+                    fetch_list=[y])
+    assert "WF001" in str(ei.value)
+    assert "ghost" in str(ei.value)
+
+
+def test_error_mode_clean_program_still_runs(static_check_flag):
+    fluid.set_flags({"FLAGS_static_check": "error"})
+    main, startup = _programs()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        y = layers.scale(x, scale=2.0)
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        out = exe.run(main, feed={"x": np.ones((2, 4), "float32")},
+                      fetch_list=[y])[0]
+    np.testing.assert_allclose(out, 2 * np.ones((2, 4)), rtol=1e-6)
+
+
+# -- debugger rendering ------------------------------------------------------
+
+
+def test_draw_program_annotates_offending_op():
+    from paddle_tpu import debugger
+
+    main, startup = _programs()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        y = layers.relu(x)
+    y.dtype = "int32"
+    rep = analysis.verify_program(main, feed_names=["x"],
+                                  fetch_names=[y.name])
+    text = debugger.draw_program(main, rep.diagnostics)
+    assert "relu" in text
+    assert "TS001" in text
+    # the annotation sits under the relu op line, not in a detached list
+    relu_line = next(i for i, l in enumerate(text.splitlines())
+                     if " relu(" in l)
+    assert "TS001" in text.splitlines()[relu_line + 1]
+
+
+# -- regression tests for verifier-surfaced defects -------------------------
+
+
+def test_no_dead_grad_chains_below_stop_gradient_masks():
+    """backward.py used to emit whole chains of dead grad ops under
+    attention-mask plumbing (vars derived only from stop-gradient data);
+    _propagate_no_grad must suppress them at generation time."""
+    main, startup = _programs()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4])          # stop_gradient data
+        mask = layers.scale(x, scale=-1e9)       # derived only from data
+        h = layers.fc(x, 4)                      # differentiable via params
+        out = layers.elementwise_add(h, mask)
+        loss = layers.mean(out)
+        pg = fluid.backward.append_backward(loss)
+    assert pg, "param grads must survive pruning"
+    blk = main.global_block()
+    produced = {n for op in blk.ops for n in op.output_arg_names if n}
+    assert mask.name + "@GRAD" not in produced
+    assert x.name + "@GRAD" not in produced
+    rep = analysis.verify_program(main, feed_names=["x"],
+                                  fetch_names=[loss.name])
+    assert not rep.by_rule("WF004"), rep.format()
+    # and the surviving grads are numerically right: d loss/d w for
+    # loss = mean(x@w + b + mask) is mean over batch of x (per column)
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        xv = np.random.RandomState(0).rand(3, 4).astype("float32")
+        (gw,) = exe.run(main, feed={"x": xv},
+                        fetch_list=[pg[0][1].name])
+        expect = np.tile(xv.mean(0, keepdims=True).T / 4.0, (1, 4))
+        np.testing.assert_allclose(gw, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_registry_rejects_unknown_qualifier_slots():
+    """sequence_pool listed its MaxIndex OUTPUT as an optional INPUT for
+    four PRs before def-level validation caught it; the registration-time
+    check must reject that class of typo outright."""
+    from paddle_tpu.core.registry import (
+        _OP_REGISTRY,
+        get_op_def,
+        register_op,
+    )
+
+    with pytest.raises(ValueError, match="optional_inputs"):
+        @register_op("__lint_bad_optional__", inputs=("X",),
+                     outputs=("Out",), optional_inputs=("Y",))
+        def _bad1(ctx, x):
+            return x
+    with pytest.raises(ValueError, match="duplicable_outputs"):
+        @register_op("__lint_bad_dup__", inputs=("X",), outputs=("Out",),
+                     duplicable_outputs=("X",))
+        def _bad2(ctx, x):
+            return x
+    assert "__lint_bad_optional__" not in _OP_REGISTRY
+    assert "__lint_bad_dup__" not in _OP_REGISTRY
+    # the fixed entry: MaxIndex is an output, Length the only optional in
+    sp = get_op_def("sequence_pool")
+    assert sp.optional_inputs == frozenset({"Length"})
+    assert "MaxIndex" in sp.output_slots
+    # registry-wide: no other entry carries an unknown qualifier slot
+    for name, od in _OP_REGISTRY.items():
+        ins, outs = set(od.input_slots), set(od.output_slots)
+        assert od.optional_inputs <= ins, name
+        assert od.duplicable_inputs <= ins, name
+        assert od.no_grad_inputs <= ins, name
+        assert od.duplicable_outputs <= outs, name
+
+
+def test_shared_parameter_initialized_once():
+    """Four embedding lookups sharing one table appended four racing
+    initializer ops into the startup program (the verifier's DA003);
+    LayerHelper.create_parameter must reuse the existing Parameter."""
+    main, startup = _programs()
+    with fluid.program_guard(main, startup):
+        words, nextw, cost = models.word2vec.build_train(dict_size=32)
+    inits = [op for op in startup.global_block().ops
+             if "shared_w" in op.output_arg_names]
+    assert len(inits) == 1, [op.type for op in inits]
+    assert not analysis.verify_program(startup).by_rule("DA003")
+    # shape disagreement on a shared name must fail loudly, not alias
+    main2, startup2 = _programs()
+    with fluid.program_guard(main2, startup2):
+        x = layers.data("x", shape=[4])
+        layers.fc(x, 8, param_attr=fluid.ParamAttr(name="shared_fc_w"))
+        with pytest.raises(ValueError, match="shared_fc_w"):
+            layers.fc(x, 16,
+                      param_attr=fluid.ParamAttr(name="shared_fc_w"))

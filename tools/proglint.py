@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""proglint: standalone static Program verifier CLI (core/analysis.py).
+
+Runs the four rule families (well-formedness, type/shape flow,
+donation/aliasing hazards, distributed lint) over a saved inference model
+or the bundled model zoo, and prints structured diagnostics.
+
+    # lint a saved inference model directory (__model__.json)
+    python tools/proglint.py --model /path/to/saved_model
+
+    # lint every bundled model (main + startup programs)
+    python tools/proglint.py
+
+    # one model, with the annotated text op-graph
+    python tools/proglint.py --builtin mnist_mlp --dump
+
+    # also lint grad programs and a transpiled 2-pserver split
+    python tools/proglint.py --grad --transpile 2
+
+Exit status: 0 when clean, 1 when any error- or warning-severity
+diagnostic was found (info findings are advisory; --strict makes them
+fail too).  The run_ci.sh --lint leg runs this with
+FLAGS_static_check=error over all bundled models.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="proglint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--model", metavar="DIR",
+                    help="saved inference model directory (__model__.json)")
+    ap.add_argument("--builtin", action="append", metavar="NAME",
+                    help="bundled model to lint (repeatable; default all)")
+    ap.add_argument("--list", action="store_true",
+                    help="list bundled model names and exit")
+    ap.add_argument("--grad", action="store_true",
+                    help="also lint grad programs (append_backward on "
+                    "builders that do not already include an optimizer)")
+    ap.add_argument("--transpile", type=int, default=0, metavar="N",
+                    help="also lint each trainable model transpiled onto "
+                    "N pservers (placement/pairing/duplication rules)")
+    ap.add_argument("--dump", action="store_true",
+                    help="print the annotated text op-graph per program")
+    ap.add_argument("--strict", action="store_true",
+                    help="info-severity findings also fail the run")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import paddle_tpu as fluid
+    from paddle_tpu import debugger, models
+    from paddle_tpu.core import analysis
+    from paddle_tpu.framework import OP_ROLE_KEY, OpRole, Program
+
+    builders = models.bundled_builders()
+    if args.list:
+        print("\n".join(sorted(builders)))
+        return 0
+
+    failed = [0]
+
+    def check(rep, program=None):
+        print(rep.format())
+        bad = len(rep.errors) + len(rep.warnings)
+        if args.strict:
+            bad += len(rep.infos)
+        failed[0] += bad
+        if args.dump and program is not None:
+            print(debugger.draw_program(program, rep.diagnostics))
+
+    if args.model:
+        path = os.path.join(args.model, "__model__.json")
+        with open(path) as f:
+            bundle = json.load(f)
+        program = Program.from_dict(bundle["program"])
+        check(analysis.verify_program(
+            program, bundle.get("feed_names", ()),
+            bundle.get("fetch_names", ()), label=args.model), program)
+        return 1 if failed[0] else 0
+
+    names = args.builtin or sorted(builders)
+    unknown = [n for n in names if n not in builders]
+    if unknown:
+        ap.error("unknown builtin model(s) %s (have: %s)"
+                 % (unknown, ", ".join(sorted(builders))))
+
+    for name in names:
+        main_p, startup_p = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main_p, startup_p):
+            feeds, fetches = builders[name]()
+        has_backward = any(
+            int(op.attr(OP_ROLE_KEY) or 0) & OpRole.Backward
+            for op in main_p.global_block().ops)
+        if args.grad and not has_backward:
+            with fluid.program_guard(main_p, startup_p):
+                fluid.backward.append_backward(fetches[0])
+        feed_names = [v.name for v in feeds]
+        fetch_names = [v.name for v in fetches]
+        check(analysis.verify_program(main_p, feed_names, fetch_names,
+                                      label=name), main_p)
+        check(analysis.verify_program(startup_p, label=name + "/startup"),
+              startup_p)
+
+        has_optimize = any(
+            int(op.attr(OP_ROLE_KEY) or 0) & OpRole.Optimize
+            for op in main_p.global_block().ops)
+        if args.transpile > 0 and has_optimize:
+            eps = ",".join("127.0.0.1:%d" % (6174 + i)
+                           for i in range(args.transpile))
+            t = fluid.DistributeTranspiler()
+            t.transpile(trainer_id=0, program=main_p, pservers=eps,
+                        trainers=2, startup_program=startup_p)
+            check(analysis.verify_transpiled(t._ps_state))
+            trainer_p = t.get_trainer_program()
+            check(analysis.verify_program(
+                trainer_p, feed_names, fetch_names,
+                label=name + "/ps-trainer"), trainer_p)
+            for ep in eps.split(","):
+                check(analysis.verify_program(
+                    t.get_pserver_program(ep),
+                    label="%s/pserver %s" % (name, ep)))
+
+    print("proglint: %s" % ("FAIL (%d finding(s))" % failed[0]
+                            if failed[0] else "PASS"))
+    return 1 if failed[0] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,11 +1,24 @@
 #!/usr/bin/env bash
 # CI harness (reference paddle/scripts/paddle_build.sh analog): build the
 # native pieces, run the full test pyramid, smoke the bench + graft entry.
-# Usage: tools/run_ci.sh [quick|full|tpu|--layout-smoke|--obs-smoke]
+# Usage: tools/run_ci.sh [quick|full|tpu|--layout-smoke|--obs-smoke|--lint]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 MODE="${1:-full}"
+
+if [ "$MODE" = "--lint" ]; then
+  # static-analysis leg: verifier unit tests, then proglint over every
+  # bundled model (+ grad programs + a transpiled 2-pserver split) with
+  # FLAGS_static_check=error — any error/warning diagnostic fails the leg
+  echo "== lint: program verifier tests =="
+  JAX_PLATFORMS=cpu python -m pytest tests/test_program_verifier.py -q
+  echo "== lint: proglint over bundled models (FLAGS_static_check=error) =="
+  JAX_PLATFORMS=cpu FLAGS_static_check=error \
+    python tools/proglint.py --grad --transpile 2
+  echo "CI --lint: PASS"
+  exit 0
+fi
 
 if [ "$MODE" = "--obs-smoke" ]; then
   # observability fast leg: telemetry + timeline-tool tests, then a tiny
