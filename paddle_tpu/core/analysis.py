@@ -28,6 +28,7 @@ distributed lint  DL001 param not assigned to exactly one pserver
                   DL002 param/grad send-recv pairing broken
                   DL003 collective ring_id missing/negative/mixed
                   DL004 side-effecting op duplicated into trainer + pserver
+                  DL005 gradient-scale constant stale vs collective world
 
 Gating: ``FLAGS_static_check`` = ``off`` | ``warn`` (default) | ``error``.
 ``off`` costs one flag read per executor compile (the telemetry early-return
@@ -74,6 +75,7 @@ RULES = {
     "DL002": "param/grad send-recv pairing broken",
     "DL003": "collective op ring_id missing, negative, or mixed",
     "DL004": "side-effecting op duplicated into trainer and pserver",
+    "DL005": "gradient-scale constant stale vs collective world size",
 }
 
 
@@ -629,14 +631,37 @@ def _check_donation(program, feed_names, fetch_names, rep):
 # ---------------------------------------------------------------------------
 
 
-def _check_collectives(program, rep):
-    """DL003 ring_id discipline for program-level collectives."""
+def _check_collectives(program, rep, expected_nranks=None):
+    """DL003 ring_id discipline + DL005 world-size agreement for
+    program-level collectives.
+
+    DL005 compares every world-size-derived constant against the expected
+    collective world size: the transpiler stamps programs with
+    ``_collective_meta`` (nranks/endpoints/rank) at transpile time, and the
+    elastic re-quorum layer passes ``expected_nranks`` for the NEW world —
+    a stale 1/nranks gradient scale or c_comm_init nranks attr means the
+    program was transpiled for a cluster that no longer exists."""
+    from ..framework import OP_ROLE_KEY, OpRole
+
+    meta = getattr(program, "_collective_meta", None) or {}
+    nranks = expected_nranks if expected_nranks else meta.get("nranks")
+    if (expected_nranks and meta.get("nranks")
+            and int(meta["nranks"]) != int(expected_nranks)):
+        rep.add(ERROR, "DL005",
+                "program was transpiled for %d ranks but the collective "
+                "world now has %d members"
+                % (meta["nranks"], expected_nranks),
+                suggestion="re-run GradAllReduce.transpile for the new "
+                "endpoint list before recompiling")
     for blk in program.blocks:
         rings = []
         missing = []
+        has_allreduce = False
         for op_idx, op in _runtime_ops(blk):
             if op.type not in _COLLECTIVE_OPS:
                 continue
+            if op.type.startswith("c_allreduce"):
+                has_allreduce = True
             ring = op.attr("ring_id")
             if ring is None:
                 missing.append((op_idx, op))
@@ -657,6 +682,35 @@ def _check_collectives(program, rep):
                     blk.idx, op_idx,
                     suggestion="assign a ring_id (transpiler round-robins "
                     "0..nrings-1)")
+        if not nranks or int(nranks) <= 0:
+            continue
+        for op_idx, op in _runtime_ops(blk):
+            if op.type == "c_comm_init":
+                got = op.attr("nranks")
+                if got is not None and int(got) != int(nranks):
+                    rep.add(ERROR, "DL005",
+                            "c_comm_init nranks=%d but the collective world "
+                            "has %d members" % (int(got), int(nranks)),
+                            blk.idx, op_idx,
+                            suggestion="re-transpile startup for the "
+                            "current endpoint list")
+            elif (has_allreduce and op.type == "scale"
+                  and op.input_arg_names == op.output_arg_names
+                  and int(op.attr(OP_ROLE_KEY) or 0) == int(OpRole.Backward)):
+                # the in-place Backward-role scale the transpiler inserts
+                # after the loss grad: must be exactly 1/world
+                got = float(op.attr("scale") or 0.0)
+                if abs(got * int(nranks) - 1.0) > 1e-6:
+                    rep.add(ERROR, "DL005",
+                            "gradient scale %.8g does not match 1/%d — "
+                            "program was transpiled for world size %s"
+                            % (got, int(nranks),
+                               round(1.0 / got) if got else "?"),
+                            blk.idx, op_idx,
+                            var_names=tuple(op.input_arg_names),
+                            suggestion="re-run GradAllReduce.transpile so "
+                            "the loss-grad scale matches the %d-member "
+                            "world" % int(nranks))
 
 
 def verify_transpiled(ps_state, rep=None):
@@ -765,12 +819,15 @@ def verify_transpiled(ps_state, rep=None):
 
 
 def verify_program(program, feed_names=(), fetch_names=(), scope_names=None,
-                   label=None):
+                   label=None, expected_nranks=None):
     """Run all single-program rule families; returns a VerifyReport.
 
     `feed_names`/`fetch_names` sharpen WF001/WF004/DA002 exactly like the
     executor's view; `scope_names` (names resident in the run scope) keeps
-    WF001 precise for programs reading pre-seeded scope vars."""
+    WF001 precise for programs reading pre-seeded scope vars.
+    `expected_nranks` asserts the collective world size the program must be
+    transpiled for (DL005) — defaults to the program's own transpile-time
+    stamp, so passing the post-requorum world size catches stale rewrites."""
     rep = VerifyReport(label=label or ("program #%d"
                                        % getattr(program, "_uid", -1)))
     checks = (
@@ -778,7 +835,8 @@ def verify_program(program, feed_names=(), fetch_names=(), scope_names=None,
                                   scope_names, rep),
         lambda: _check_type_shape(program, rep),
         lambda: _check_donation(program, feed_names, fetch_names, rep),
-        lambda: _check_collectives(program, rep),
+        lambda: _check_collectives(program, rep,
+                                   expected_nranks=expected_nranks),
     )
     for chk in checks:
         try:

@@ -130,6 +130,15 @@ class Executor:
         self._cache = {}
         self._fuse_attempted = set()
 
+    def reset_device_state(self):
+        """Drop every compiled executable and fusion memo.  The elastic
+        re-quorum layer (distributed/elastic.py) calls this after
+        re-initializing jax.distributed: cached jfns close over the dead
+        world's Mesh/devices and must never run again — the next run()
+        recompiles against the new backend."""
+        self._cache.clear()
+        self._fuse_attempted = set()
+
     def close(self):
         """Release cached executables and notify pservers this trainer is
         done (reference Executor::Close -> SendComplete, executor.cc:110)."""

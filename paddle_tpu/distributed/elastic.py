@@ -1,0 +1,733 @@
+"""Elastic re-quorum for the collective all-reduce path.
+
+The PS runtime (distributed/ps.py) evicts dead trainers and re-quorums
+sync rounds; this module gives collective (NCCL-style all-reduce) jobs the
+same survival story.  A quorum/membership layer runs over the
+``PADDLE_COORDINATOR`` control channel (native RpcServer/RpcClient, one
+port above each member's data endpoint):
+
+  1. every member heartbeats the quorum coordinator (the lowest-rank live
+     member); the coordinator tracks liveness with the PS
+     ``HeartBeatMonitor`` and declares a member dead after
+     ``FLAGS_elastic_hb_timeout`` seconds of silence;
+  2. on death (or a pending rejoin) the coordinator aborts the step gate,
+     bumps the quorum *epoch*, and publishes a new membership view: the
+     dense rank remap, the survivor count, and a fresh jax.distributed
+     coordinator port (``base + epoch * FLAGS_elastic_port_stride``);
+  3. every survivor re-runs jax.distributed initialization against the new
+     view, re-transpiles its pristine main/startup programs with
+     ``GradAllReduce`` for the new ``nranks``/endpoints, passes them
+     through ``core/analysis.verify_program`` in **error** mode (including
+     DL005: the 1/nranks gradient scale must match the new world), restores
+     params from ``io.CheckpointManager.latest_valid()``, and resumes;
+  4. a member relaunched by ``launch.py --restart_failed`` rejoins at the
+     next epoch: it probes the member list for the live coordinator, posts
+     ``__ejoin__``, and adopts the first view that includes it.
+
+Why the old jax world is *parked*, not shut down: jaxlib's coordination
+service terminates any process that learns of a peer failure
+(``LOG(FATAL)`` in xla/pjrt/distributed/client.h — both the missed-
+heartbeat callback and the error-polling thread), and the pybind
+``missed_heartbeat_callback`` escape hatch is unusable from Python in this
+jaxlib (invoking a Python callback from the C++ thread raises
+``std::bad_cast``).  So clients/services are constructed directly with
+``shutdown_on_destruction=False`` and heartbeat windows far longer than
+any job (the control channel above owns failure detection), and on
+re-quorum the dead world's client/service objects are kept referenced
+forever: their threads idle on a healthy-looking socket and can never
+observe an error.  A process that ever *hosted* a coordination service
+must exit via ``finalize()`` (``os._exit``) — C++ destructor order at
+interpreter teardown would otherwise close the service under its own
+pollers and abort.  Survivor ordering at clean exit: members leave first,
+the coordinator leaves last, so no live poller ever sees a dead service.
+"""
+
+import logging
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+
+from ..core import telemetry as _tm
+from ..native.rpc import RpcClient, RpcServer, EV_SEND
+from .ps import HeartBeatMonitor
+
+__all__ = ["ElasticMember", "View", "member_env"]
+
+# control-plane variable names (PS-style __dunder__ namespace)
+_HB = "__ehb__"          # <rank>: member heartbeat            [rank, epoch]
+_READY = "__eready__"    # <rank>: at the step gate            [epoch, step]
+_JOIN = "__ejoin__"      # <rank>: admit me at the next epoch  [rank]
+_DONE = "__edone__"      # <rank>: clean completion            [rank]
+_ALIVE = "__alive__"     # served by every member's local server
+_VIEW = "__eview__"      # latest view; __eview__#<epoch> per epoch
+_GATE = "__ego__"        # __ego__#<epoch>:<step> -> [1] go | [0] re-quorum
+
+_GO = 1
+_ABORT = 0
+
+# parked-world heartbeat windows: long enough that the coordination
+# service never declares anyone dead on its own (the control plane owns
+# detection), short enough to be a sane int
+_JAX_HB_INTERVAL_S = 3600
+_JAX_HB_MAX_MISSING = 10000
+
+
+def _flag(name):
+    from .. import flags
+
+    return flags.flag(name)
+
+
+def _host_port(endpoint):
+    host, port = endpoint.rsplit(":", 1)
+    if host in ("localhost", ""):
+        host = "127.0.0.1"
+    return host, int(port)
+
+
+def _ctrl_endpoint(member_endpoint):
+    host, port = _host_port(member_endpoint)
+    return "%s:%d" % (host, port + int(_flag("elastic_ctrl_offset") or 1000))
+
+
+def _port_free(port):
+    s = socket.socket()
+    try:
+        s.bind(("", port))
+        return True
+    except OSError:
+        return False
+    finally:
+        s.close()
+
+
+def member_env():
+    """(rank, endpoints, restart_count) from the launcher env."""
+    eps = [e for e in os.getenv("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+           if e]
+    rank = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+    restarts = int(os.getenv("PADDLE_RESTART_COUNT", "0"))
+    return rank, eps, restarts
+
+
+class View:
+    """One quorum epoch's membership: which original ranks are in, who
+    coordinates, and where the epoch's jax.distributed service lives."""
+
+    __slots__ = ("epoch", "coord_rank", "jax_port", "restore_step", "ranks")
+
+    def __init__(self, epoch, coord_rank, jax_port, restore_step, ranks):
+        self.epoch = int(epoch)
+        self.coord_rank = int(coord_rank)
+        self.jax_port = int(jax_port)
+        self.restore_step = int(restore_step)
+        self.ranks = tuple(int(r) for r in ranks)
+
+    def encode(self):
+        return np.asarray([self.epoch, self.coord_rank, self.jax_port,
+                           self.restore_step, len(self.ranks)]
+                          + list(self.ranks), np.int64)
+
+    @classmethod
+    def decode(cls, arr):
+        a = np.asarray(arr).reshape(-1).astype(np.int64)
+        n = int(a[4])
+        return cls(a[0], a[1], a[2], a[3], [int(x) for x in a[5:5 + n]])
+
+    def __repr__(self):
+        return ("View(epoch=%d, coord=%d, jax_port=%d, restore=%d, "
+                "ranks=%s)" % (self.epoch, self.coord_rank, self.jax_port,
+                               self.restore_step, list(self.ranks)))
+
+
+class _JaxWorld:
+    """Direct construction of the jax.distributed client/service so peer
+    death cannot abort the process (see module docstring).  Old worlds are
+    parked in ``_parked`` — never destroyed."""
+
+    _parked = []
+    hosted_service = False
+
+    @classmethod
+    def reinit(cls, coord_host, coord_port, num_processes, process_id,
+               host_service):
+        import jax
+        from jax._src import distributed as _dist
+        from jax._src.lib import xla_extension as _xe
+        from jax.extend import backend as _jexb
+
+        if os.getenv("JAX_PLATFORMS", "").startswith("cpu"):
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        gs = _dist.global_state
+        if gs.client is not None:
+            cls._parked.append((gs.client, gs.service,
+                                gs.preemption_sync_manager))
+            gs.client = None
+            gs.service = None
+            gs.preemption_sync_manager = None
+        _jexb.clear_backends()
+        if host_service:
+            gs.service = _xe.get_distributed_runtime_service(
+                "[::]:%d" % coord_port, num_processes,
+                heartbeat_interval=_JAX_HB_INTERVAL_S,
+                max_missing_heartbeats=_JAX_HB_MAX_MISSING)
+            cls.hosted_service = True
+        gs.client = _xe.get_distributed_runtime_client(
+            "%s:%d" % (coord_host, coord_port), process_id,
+            init_timeout=120, heartbeat_interval=_JAX_HB_INTERVAL_S,
+            max_missing_heartbeats=_JAX_HB_MAX_MISSING,
+            shutdown_on_destruction=False)
+        gs.client.connect()
+        gs.process_id = process_id
+        gs.num_processes = num_processes
+        gs.coordinator_address = "%s:%d" % (coord_host, coord_port)
+
+
+class _Coordinator(threading.Thread):
+    """Quorum state machine; runs inside the coordinator member's process
+    on that member's control RpcServer."""
+
+    def __init__(self, member, epoch, ranks, join_window_s=0.0):
+        super().__init__(name="elastic-coord", daemon=True)
+        self.m = member
+        self.srv = member._server
+        self.epoch = int(epoch)
+        self.live = set(int(r) for r in ranks)
+        self.joins = set()
+        self.done = set()
+        self.ready = {}          # (epoch, step) -> set(ranks)
+        self.released = []       # published gate keys (pruned)
+        self.aborted = set()     # epochs whose gates answer [0]
+        self._stop = False
+        self._detect_t0 = None
+        # a freshly failed-over coordinator waits for survivors to rejoin
+        # before forming its first view
+        self._join_deadline = (time.time() + join_window_s
+                               if join_window_s else None)
+        timeout = float(_flag("elastic_hb_timeout") or 5.0)
+        self.mon = HeartBeatMonitor(0, timeout_s=timeout, name="elastic",
+                                    worker_ids=sorted(self.live))
+        self.all_done = threading.Event()
+        self._publish_view(View(self.epoch, member.rank,
+                                self._pick_port(self.epoch),
+                                self._restore_step(), sorted(self.live)))
+
+    # -- helpers ------------------------------------------------------------
+
+    def _restore_step(self):
+        ckpt = self.m.ckpt
+        if ckpt is None:
+            return 0
+        try:
+            found = ckpt.latest_valid()
+        except Exception:
+            found = None
+        return found[0] if found else 0
+
+    def _pick_port(self, epoch):
+        base = _host_port(self.m.members[self.m.rank])[1]
+        stride = int(_flag("elastic_port_stride") or 29)
+        port = base + stride * epoch
+        for _ in range(32):
+            if epoch == 0 or _port_free(port):
+                return port
+            port += stride
+        return port
+
+    def _publish_view(self, view):
+        self.view = view
+        enc = view.encode()
+        self.srv.set_var("%s#%d" % (_VIEW, view.epoch), enc)
+        self.srv.set_var(_VIEW, enc)
+        self.srv.serve(True)
+        _tm.set_gauge("elastic_epoch", view.epoch)
+        _tm.set_gauge("elastic_world_size", len(view.ranks))
+
+    def _release(self, epoch, step, value):
+        key = "%s#%d:%d" % (_GATE, epoch, step)
+        self.srv.set_var(key, np.asarray([value], np.int64))
+        if key not in self.released:
+            self.released.append(key)
+        while len(self.released) > 16:
+            self.srv.del_var(self.released.pop(0))
+
+    # -- event handling -----------------------------------------------------
+
+    def run(self):
+        while not self._stop:
+            t, name, arr = self.srv.poll()
+            if t == 0:
+                return
+            if t == EV_SEND:
+                self._on_event(name, arr)
+            self._tick()
+
+    def _on_event(self, name, arr):
+        if name.startswith(_HB):
+            r = int(arr[0])
+            if r in self.live:
+                self.mon.update(r)
+        elif name.startswith(_READY):
+            r = int(name[len(_READY):])
+            epoch, step = int(arr[0]), int(arr[1])
+            if r in self.live:
+                self.mon.update(r)
+            if epoch in self.aborted or epoch < self.epoch:
+                self._release(epoch, step, _ABORT)
+                return
+            got = self.ready.setdefault((epoch, step), set())
+            got.add(r)
+            if got >= self.live:
+                self._release(epoch, step, _GO)
+                self.ready.pop((epoch, step), None)
+        elif name.startswith(_JOIN):
+            r = int(arr[0])
+            if r not in self.live:
+                self.joins.add(r)
+        elif name.startswith(_DONE):
+            r = int(arr[0])
+            self.done.add(r)
+            self.mon.remove(r)
+            self.live.discard(r)
+            # release any gate the remaining members are parked on
+            for (epoch, step), got in list(self.ready.items()):
+                if epoch == self.epoch and got >= self.live:
+                    self._release(epoch, step, _GO)
+                    self.ready.pop((epoch, step), None)
+            if not self.live - {self.m.rank}:
+                self.all_done.set()
+
+    def _tick(self):
+        dead = [r for r in self.mon.check() if r in self.live]
+        joining = self.joins - self.live
+        if dead and self._detect_t0 is None:
+            self._detect_t0 = time.perf_counter()
+        if self._join_deadline is not None:
+            if time.time() < self._join_deadline:
+                return
+            self._join_deadline = None
+            self._requorum(dead)
+            return
+        if dead or joining:
+            self._requorum(dead)
+
+    def _requorum(self, dead):
+        t0 = self._detect_t0 or time.perf_counter()
+        self._detect_t0 = None
+        old_epoch = self.epoch
+        evicted = sorted(set(dead) & self.live)
+        joined = sorted(self.joins - self.live)
+        self.live = (self.live - set(evicted)) | set(joined)
+        self.joins.clear()
+        self.epoch += 1
+        self.aborted.add(old_epoch)
+        # wake every member parked at an old-epoch gate
+        for (epoch, step), _ in list(self.ready.items()):
+            if epoch <= old_epoch:
+                self._release(epoch, step, _ABORT)
+                self.ready.pop((epoch, step), None)
+        view = View(self.epoch, self.m.rank, self._pick_port(self.epoch),
+                    self._restore_step(), sorted(self.live))
+        # grace: a joiner needs time to init jax + transpile + restore
+        timeout = float(_flag("elastic_hb_timeout") or 5.0)
+        self.mon = HeartBeatMonitor(0, timeout_s=timeout, name="elastic",
+                                    worker_ids=sorted(self.live))
+        self._publish_view(view)
+        ms = (time.perf_counter() - t0) * 1e3
+        if evicted:
+            _tm.inc("elastic_evictions_total", len(evicted))
+        if joined:
+            _tm.inc("elastic_rejoins_total", len(joined))
+        _tm.observe("elastic_requorum_ms", ms, role="coordinator")
+        _tm.event("elastic_epoch", epoch=self.epoch,
+                  world=len(view.ranks), evicted=evicted, joined=joined,
+                  restore_step=view.restore_step, ms=round(ms, 3))
+        logging.warning(
+            "[elastic] epoch %d: world=%s evicted=%s joined=%s "
+            "jax_port=%d restore_step=%d", self.epoch, sorted(self.live),
+            evicted, joined, view.jax_port, view.restore_step)
+
+    def stop(self):
+        self._stop = True
+
+
+class ElasticMember:
+    """Member-side elastic runtime for a collective all-reduce job.
+
+    Usage (see tests/dist_elastic_payload.py)::
+
+        member = ElasticMember(main, startup, executor=exe, ckpt=mgr,
+                               feed_names=["x", "y"],
+                               fetch_names=[loss.name])
+        member.start()                    # quorum + jax init + transpile +
+        step = member.restore_step        #   verify + restore
+        while step < total_steps:
+            if not member.gate(step):     # False -> re-quorumed
+                step = member.restore_step
+                continue
+            out = exe.run(member.main_program, feed=shard(step, member),
+                          fetch_list=[member.fetch_names[0]])
+            step += 1
+            ...checkpoint via member.maybe_save(step)...
+        member.finalize()
+
+    ``main``/``startup`` are the PRISTINE (un-transpiled) programs; every
+    epoch clones them and applies ``GradAllReduce`` for that epoch's world,
+    then verifies the rewrite in error mode (DL001-005) before the executor
+    may recompile it."""
+
+    def __init__(self, main_program, startup_program, executor=None,
+                 ckpt=None, feed_names=(), fetch_names=(), members=None,
+                 rank=None, nrings=1, scope=None):
+        env_rank, env_eps, env_restarts = member_env()
+        self.rank = env_rank if rank is None else int(rank)
+        self.members = list(members) if members is not None else env_eps
+        if not self.members:
+            raise ValueError("no member endpoints: pass members= or set "
+                             "PADDLE_TRAINER_ENDPOINTS")
+        self.restart_count = env_restarts
+        self.base_main = main_program
+        self.base_startup = startup_program
+        self.executor = executor
+        self.ckpt = ckpt
+        self.scope = scope
+        self.feed_names = list(feed_names)
+        self.fetch_names = list(fetch_names)
+        self.nrings = int(nrings)
+        self.view = None
+        self.main_program = None
+        self.startup_program = None
+        self.restore_step = 0
+        self._server = None
+        self._coord = None
+        self._ctrl = None        # send-side client to the coordinator
+        self._gate_c = None      # blocking-get client to the coordinator
+        self._hb_thread = None
+        self._stop_hb = threading.Event()
+        self._finalized = False
+
+    # -- properties ----------------------------------------------------------
+
+    @property
+    def epoch(self):
+        return self.view.epoch if self.view else -1
+
+    @property
+    def world(self):
+        return len(self.view.ranks) if self.view else 0
+
+    @property
+    def pid(self):
+        """Dense process id in the current view (jax process_id)."""
+        return self.view.ranks.index(self.rank)
+
+    def is_coordinator(self):
+        return self.view is not None and self.view.coord_rank == self.rank
+
+    def fetch_var(self, name):
+        return self.main_program.global_block().var(name)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self):
+        """Form or join the quorum, then adopt the current view (jax init,
+        transpile, verify, startup + checkpoint restore)."""
+        host, port = _host_port(self.members[self.rank])
+        ctrl_port = port + int(_flag("elastic_ctrl_offset") or 1000)
+        self._server = RpcServer(port=ctrl_port)
+        self._server.set_var(_ALIVE, np.asarray([self.rank, 0, 0], np.int64))
+        self._server.serve(True)
+
+        fresh_seed = self.restart_count == 0
+        if self.rank == min(range(len(self.members))) and fresh_seed:
+            self._become_coordinator(epoch=0,
+                                     ranks=range(len(self.members)))
+            coord_rank = self.rank
+        else:
+            coord_rank = self._find_coordinator()
+        self._connect_ctrl(coord_rank)
+        self._start_heartbeat()
+
+        view = self._wait_view_with_me()
+        self._adopt(view)
+        return self
+
+    def _become_coordinator(self, epoch, ranks, join_window_s=0.0):
+        self._coord = _Coordinator(self, epoch, ranks,
+                                   join_window_s=join_window_s)
+        self._server.set_var(
+            _ALIVE, np.asarray([self.rank, epoch, 1], np.int64))
+        self._coord.start()
+
+    def _find_coordinator(self, window_s=90.0):
+        """Probe the member list (rank order) for the live coordinator."""
+        deadline = time.time() + window_s
+        while time.time() < deadline:
+            for r, ep in enumerate(self.members):
+                if r == self.rank:
+                    continue
+                got = self._probe_alive(ep)
+                if got is not None and got[2] == 1:
+                    return r
+            time.sleep(0.3)
+        raise ConnectionError(
+            "[elastic] rank %d: no live coordinator among %s within %.0fs"
+            % (self.rank, self.members, window_s))
+
+    def _probe_alive(self, member_endpoint):
+        try:
+            c = RpcClient(_ctrl_endpoint(member_endpoint),
+                          connect_timeout=1.0, rpc_deadline=3.0,
+                          retry_times=0)
+        except ConnectionError:
+            return None
+        try:
+            return [int(x) for x in c.get_var(_ALIVE)]
+        except Exception:
+            return None
+        finally:
+            try:
+                c.close()
+            except Exception:
+                pass
+
+    def _connect_ctrl(self, coord_rank):
+        for c in (self._ctrl, self._gate_c):
+            if c is not None:
+                try:
+                    c.close()
+                except Exception:
+                    pass
+        ep = _ctrl_endpoint(self.members[coord_rank])
+        self._coord_rank_hint = coord_rank
+        self._ctrl = RpcClient(ep, connect_timeout=60.0, rpc_deadline=10.0,
+                               retry_times=3)
+        self._gate_c = RpcClient(ep, connect_timeout=60.0, rpc_deadline=60.0,
+                                 retry_times=1)
+
+    def _start_heartbeat(self):
+        if self._hb_thread is not None:
+            return
+
+        def loop():
+            interval = float(_flag("elastic_hb_interval") or 0.5)
+            name = _HB + str(self.rank)
+            while not self._stop_hb.wait(interval):
+                try:
+                    self._ctrl.send_var(name, np.asarray(
+                        [self.rank, self.epoch], np.int64))
+                except Exception:
+                    pass  # gate() owns failure handling
+
+        self._hb_thread = threading.Thread(target=loop, name="elastic-hb",
+                                           daemon=True)
+        self._hb_thread.start()
+
+    def _wait_view_with_me(self, window_s=180.0):
+        """Fetch the current view; if this member was evicted (or is a
+        rejoiner), post __ejoin__ and wait for an epoch that includes it."""
+        deadline = time.time() + window_s
+        asked = False
+        while time.time() < deadline:
+            view = View.decode(self._gate_c.get_var(_VIEW))
+            if self.rank in view.ranks:
+                return view
+            if not asked:
+                self._ctrl.send_var(_JOIN + str(self.rank),
+                                    np.asarray([self.rank], np.int64))
+                _tm.event("elastic_join_request", rank=self.rank,
+                          epoch=view.epoch)
+                asked = True
+            time.sleep(0.3)
+        raise TimeoutError("[elastic] rank %d not admitted within %.0fs"
+                           % (self.rank, window_s))
+
+    # -- epoch adoption ------------------------------------------------------
+
+    def _numpyify_scope(self):
+        """Detach scope tensors from the dying backend: every value becomes
+        a host numpy array before clear_backends invalidates jax.Arrays."""
+        scope = self.scope
+        if scope is None and self.executor is not None:
+            from ..core.executor import global_scope
+
+            scope = global_scope()
+        if scope is None:
+            return
+        s = scope
+        while s is not None:
+            for name in s.local_var_names():
+                var = s.find_var(name)
+                t = var.get_tensor() if var else None
+                if t is not None and t._is_initialized():
+                    try:
+                        t.set(np.asarray(t.get()))
+                    except Exception:
+                        pass
+            s = getattr(s, "parent", None)
+
+    def _adopt(self, view):
+        """Make `view` this process's world: jax re-init, re-transpile,
+        verify (error mode incl. DL005), restore checkpoint."""
+        t0 = time.perf_counter()
+        old_epoch = self.epoch
+        self.view = view
+        self._server.set_var(_ALIVE, np.asarray(
+            [self.rank, view.epoch, 1 if self._coord else 0], np.int64))
+        pid = view.ranks.index(self.rank)
+        world = len(view.ranks)
+        coord_host = _host_port(self.members[view.coord_rank])[0]
+
+        self._numpyify_scope()
+        if self.executor is not None:
+            self.executor.reset_device_state()
+        _JaxWorld.reinit(coord_host, view.jax_port, world, pid,
+                         host_service=self.rank == view.coord_rank)
+
+        # re-transpile pristine programs for the new world + verify the
+        # rewrite loudly BEFORE any recompile (DL001-005, error mode)
+        endpoints = [self.members[r] for r in view.ranks]
+        main = self.base_main.clone()
+        startup = self.base_startup.clone()
+        from ..transpiler.collective import GradAllReduce
+
+        t = GradAllReduce(self.nrings)
+        t.transpile(startup_program=startup, main_program=main, rank=pid,
+                    endpoints=endpoints,
+                    current_endpoint=self.members[self.rank],
+                    wait_port=False)
+        self._verify(main, startup, world)
+        self.main_program = main
+        self.startup_program = startup
+
+        self.restore_step = 0
+        if self.executor is not None:
+            self.executor.run(startup)
+            if self.ckpt is not None:
+                step, _extra = self.ckpt.restore(self.executor, main)
+                self.restore_step = int(step)
+                _tm.event("elastic_restore", rank=self.rank,
+                          epoch=view.epoch, step=self.restore_step)
+        ms = (time.perf_counter() - t0) * 1e3
+        _tm.observe("elastic_requorum_ms", ms, role="member")
+        _tm.set_gauge("elastic_epoch", view.epoch)
+        if old_epoch >= 0:
+            _tm.event("elastic_adopt", rank=self.rank, epoch=view.epoch,
+                      world=world, ms=round(ms, 3))
+        logging.info("[elastic] rank %d adopted %r (pid %d/%d) in %.0fms",
+                     self.rank, view, pid, world, ms)
+
+    def _verify(self, main, startup, world):
+        from ..core import analysis
+
+        for prog, label in ((main, "main"), (startup, "startup")):
+            rep = analysis.verify_program(
+                prog, feed_names=self.feed_names if prog is main else (),
+                fetch_names=self.fetch_names if prog is main else (),
+                label="elastic epoch %d %s" % (self.view.epoch, label),
+                expected_nranks=world)
+            if rep.errors:
+                raise analysis.ProgramVerificationError(rep)
+
+    # -- step gate -----------------------------------------------------------
+
+    def gate(self, step):
+        """Barrier before `step`.  True -> proceed; False -> the quorum
+        re-formed: programs/restore_step were replaced, restart the loop
+        from self.restore_step."""
+        epoch = self.epoch
+        try:
+            self._ctrl.send_var(_READY + str(self.rank),
+                                np.asarray([epoch, step], np.int64))
+            verdict = int(self._gate_c.get_var(
+                "%s#%d:%d" % (_GATE, epoch, step))[0])
+        except Exception:
+            self._failover()
+            return False
+        if verdict == _GO:
+            return True
+        # re-quorum: the next view may take a moment to publish
+        view = View.decode(self._gate_c.get_var("%s#%d" % (_VIEW, epoch + 1)))
+        if self.rank not in view.ranks:
+            view = self._wait_view_with_me()
+        self._adopt(view)
+        return False
+
+    def _failover(self):
+        """The coordinator stopped answering.  The lowest live rank becomes
+        the new coordinator; everyone else rejoins it."""
+        logging.warning("[elastic] rank %d: coordinator unreachable — "
+                        "failing over", self.rank)
+        timeout = float(_flag("elastic_hb_timeout") or 5.0)
+        lower_alive = None
+        for r in range(self.rank):
+            if r >= len(self.members):
+                break
+            got = self._probe_alive(self.members[r])
+            if got is not None:
+                lower_alive = r
+                break
+        if lower_alive is None and self._coord is None:
+            self._become_coordinator(epoch=self.epoch + 1,
+                                     ranks=[self.rank],
+                                     join_window_s=2.0 * timeout)
+            self._connect_ctrl(self.rank)
+        else:
+            coord = (lower_alive if lower_alive is not None
+                     else self._find_coordinator())
+            self._connect_ctrl(coord)
+        view = self._wait_view_with_me()
+        self._adopt(view)
+
+    # -- checkpoints ---------------------------------------------------------
+
+    def maybe_save(self, step):
+        """Checkpoint from the view's first member only (shared ckpt_dir);
+        all members restore the same latest_valid() at re-quorum."""
+        if self.ckpt is None or self.executor is None:
+            return None
+        if self.pid != 0:
+            return None
+        return self.ckpt.maybe_save(self.executor, self.main_program, step)
+
+    # -- teardown ------------------------------------------------------------
+
+    def finalize(self, exit_code=0):
+        """Clean completion.  Members leave first (hard-exit right after
+        their DONE, so no destructor ever touches a parked client); the
+        coordinator waits for every DONE plus a grace period — its process
+        holds every epoch's coordination service, so it must be the last
+        one whose sockets close (see module docstring).  Does not return
+        unless exit_code is None."""
+        if self._finalized:
+            return
+        self._finalized = True
+        self._stop_hb.set()
+        try:
+            self._ctrl.send_var(_DONE + str(self.rank),
+                                np.asarray([self.rank], np.int64))
+        except Exception:
+            pass
+        if self._coord is not None:
+            self._coord.all_done.wait(timeout=60.0)
+            self._coord.stop()
+        _tm.event("elastic_finalize", rank=self.rank, epoch=self.epoch)
+        _tm.maybe_dump()
+        if exit_code is None:
+            return
+        import sys
+
+        sys.stdout.flush()
+        sys.stderr.flush()
+        if self._coord is not None or _JaxWorld.hosted_service:
+            # members os._exit milliseconds after their DONE lands; ride
+            # out interpreter-teardown stragglers before our service
+            # sockets vanish under their parked pollers
+            time.sleep(2.0)
+        # skip interpreter teardown entirely: C++ destructor order would
+        # close coordination-service/client sockets under live poll
+        # threads -> LOG(FATAL) (client.h:80)
+        os._exit(exit_code)

@@ -20,8 +20,10 @@ specs can disarm themselves after the first life.
 """
 
 import argparse
+import atexit
 import logging
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -51,6 +53,13 @@ def _parse_args(argv=None):
     parser.add_argument("--trainers_num", type=int, default=None,
                         help="override the cluster size when launching "
                              "one member of a larger local cluster")
+    parser.add_argument("--endpoints_file", type=str, default=None,
+                        help="path to a file holding the live cluster view "
+                             "(first line: comma-separated trainer "
+                             "endpoints; optional second line: coordinator "
+                             "endpoint); re-read before every (re)launch so "
+                             "a rejoining member sees the post-requorum "
+                             "cluster instead of the stale seed one")
     parser.add_argument("training_script", type=str)
     parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return parser.parse_args(argv)
@@ -86,8 +95,13 @@ def launch(args=None):
     restarts = 0
     while True:
         env["PADDLE_RESTART_COUNT"] = str(restarts)
-        proc = subprocess.Popen(cmd, env=env)
-        proc.wait()
+        _apply_endpoints_file(env, args.endpoints_file, node_id)
+        proc = subprocess.Popen(cmd, env=env, start_new_session=True)
+        cleanup = _supervise(proc)
+        try:
+            proc.wait()
+        finally:
+            cleanup()
         if proc.returncode == 0:
             return
         if restarts >= max(args.restart_failed, 0):
@@ -99,6 +113,70 @@ def launch(args=None):
         time.sleep(max(args.restart_delay, 0.0))
 
 
+def _apply_endpoints_file(env, path, node_id):
+    """Refresh the cluster view from ``--endpoints_file`` before a launch.
+
+    The elastic runtime rewrites this file at every re-quorum, so a member
+    relaunched by ``--restart_failed`` rejoins the *current* cluster (new
+    coordinator, shrunken endpoint list) instead of the stale seed one."""
+    if not path or not os.path.exists(path):
+        return
+    try:
+        with open(path) as f:
+            lines = [ln.strip() for ln in f.read().splitlines() if ln.strip()]
+    except OSError as e:
+        logging.warning("endpoints_file %s unreadable: %s", path, e)
+        return
+    if not lines:
+        return
+    endpoints = [ep.strip() for ep in lines[0].split(",") if ep.strip()]
+    if not endpoints:
+        return
+    env["PADDLE_TRAINER_ENDPOINTS"] = ",".join(endpoints)
+    env["PADDLE_TRAINERS_NUM"] = str(len(endpoints))
+    env["PADDLE_COORDINATOR"] = (lines[1] if len(lines) > 1
+                                 else endpoints[0])
+    if node_id < len(endpoints):
+        env["PADDLE_CURRENT_ENDPOINT"] = endpoints[node_id]
+
+
+def _supervise(proc):
+    """Forward SIGTERM/SIGINT to the supervised child's process group and
+    killpg it if the launcher itself dies, so a terminated launcher cannot
+    orphan a trainer.  Returns a callable undoing the handlers."""
+
+    def _killpg(sig):
+        try:
+            os.killpg(proc.pid, sig)
+        except (ProcessLookupError, PermissionError, OSError):
+            pass
+
+    def _forward(signum, _frame):
+        _killpg(signum)
+
+    def _reap():
+        if proc.poll() is None:
+            _killpg(signal.SIGKILL)
+
+    prev = {}
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            prev[sig] = signal.signal(sig, _forward)
+        except (ValueError, OSError):  # non-main thread
+            pass
+    atexit.register(_reap)
+
+    def _cleanup():
+        atexit.unregister(_reap)
+        for sig, handler in prev.items():
+            try:
+                signal.signal(sig, handler)
+            except (ValueError, OSError):
+                pass
+
+    return _cleanup
+
+
 def init_multihost():
     """Bootstrap jax.distributed from the launcher env (DCN control plane);
     call once at the top of a multi-host training script."""
@@ -107,6 +185,10 @@ def init_multihost():
         return False
     import jax
 
+    if os.getenv("JAX_PLATFORMS", "").startswith("cpu"):
+        # cross-process CPU collectives need the gloo transport; without it
+        # XLA rejects multiprocess computations on the CPU backend
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
     jax.distributed.initialize(
         coordinator_address=os.getenv("PADDLE_COORDINATOR"),
         num_processes=n,

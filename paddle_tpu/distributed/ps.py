@@ -597,7 +597,7 @@ class HeartBeatMonitor:
     is not mistaken for death."""
 
     def __init__(self, n_workers, timeout_s=None, name="ps",
-                 startup_grace_s=None):
+                 startup_grace_s=None, worker_ids=None):
         import time
 
         if timeout_s is None:
@@ -605,14 +605,17 @@ class HeartBeatMonitor:
 
             timeout_s = float(_flags.flag("worker_hb_timeout") or 60.0)
         self._time = time.time
-        self.n_workers = n_workers
+        if worker_ids is None:
+            worker_ids = range(n_workers)
+        worker_ids = [int(w) for w in worker_ids]
+        self.n_workers = len(worker_ids)
         self.timeout_s = timeout_s
         self.startup_grace_s = (timeout_s if startup_grace_s is None
                                 else startup_grace_s)
         self.name = name
         now = self._time()
         self._last_seen = {w: now + self.startup_grace_s
-                           for w in range(n_workers)}
+                           for w in worker_ids}
         self._warned = set()
         self._lock = __import__("threading").Lock()
 

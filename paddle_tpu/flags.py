@@ -93,6 +93,19 @@ _DEFAULTS = {
     # VPU chain loses to XLA's materialized-probs backward), so the
     # composed emission stays the default training path (BASELINE.md r5)
     "FLAGS_fused_small_attention": False,
+    # elastic collective re-quorum (distributed/elastic.py): member
+    # heartbeat period over the PADDLE_COORDINATOR control channel, and how
+    # long a member may stay silent before the quorum evicts it and the
+    # survivors re-form the world (seconds)
+    "FLAGS_elastic_hb_interval": 0.5,
+    "FLAGS_elastic_hb_timeout": 5.0,
+    # control-channel port = member endpoint port + this offset (the member
+    # endpoint port itself belongs to jax.distributed / the data plane)
+    "FLAGS_elastic_ctrl_offset": 1000,
+    # each quorum epoch moves the jax.distributed coordinator to
+    # base_port + epoch * stride (the old world's sockets are parked, not
+    # closed — see elastic.py on why tearing them down is fatal)
+    "FLAGS_elastic_port_stride": 29,
     # accepted no-ops (XLA/PJRT owns these concerns; benchmark's per-op
     # sync has no meaning under whole-block compilation)
     "FLAGS_benchmark": False,

@@ -144,7 +144,7 @@ class CollectiveOptimizer(DistributedOptimizer):
             eps = worker_endpoints
             if len(eps) < nranks:
                 eps = ["local:%d" % i for i in range(nranks)]
-                current = eps[0]
+                current = eps[trainer_id] if trainer_id < nranks else eps[0]
             else:
                 current = current_endpoint
             t.transpile(startup_program, main_program, trainer_id, eps,

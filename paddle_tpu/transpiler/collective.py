@@ -36,6 +36,12 @@ class Collective:
         self.nranks = len(endpoints)
         self._transpile_startup_program()
         self._transpile_main_program()
+        # world-size provenance for the static verifier (DL005) and the
+        # elastic re-quorum layer: which cluster this program was built for
+        meta = {"nranks": self.nranks, "rank": rank,
+                "endpoints": list(endpoints), "nrings": self.nrings}
+        main_program._collective_meta = dict(meta)
+        startup_program._collective_meta = dict(meta)
 
     # -- startup: communicator bootstrap ops (collective.py:99-131) ---------
     def _init_communicator(self, program, current_endpoint, endpoints, rank,

@@ -1,0 +1,145 @@
+"""Runnable elastic-collective training payload (3 members, all-reduce
+data parallelism under distributed/elastic.py's quorum layer).
+
+Each process builds the same toy regression, wraps it in an
+``ElasticMember`` (pristine programs; the member re-transpiles
+GradAllReduce per quorum epoch and verifies the rewrite in error mode),
+gates every step, shards the deterministic global batch by its CURRENT
+dense pid/world, and checkpoints through a shared CheckpointManager.
+
+Markers on stdout, one per line, for the test harness:
+
+  start: rank=R epoch=E world=W restore=S     after the first adoption
+  mark:step=S world=W epoch=E                 before running step S
+  loss:<float>                                after running a step
+  requorum: epoch=E world=W restore=S         after adopting a new view
+  done: rank=R epoch=E world=W                clean completion
+
+Flags:
+  --ckpt_dir DIR     shared checkpoint directory (required)
+  --pause_at S       print "pause:S" before gating step S, then sleep —
+                     the test SIGKILLs this member there (outside any
+                     collective, so gloo never wedges mid-all-reduce)
+  --hold_at S N      at step S, spin on the gate until the world has
+                     grown back to N members (deterministic rejoin rendezvous)
+"""
+
+import argparse
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# exactly ONE local device per process (collectives span processes); the
+# parent pytest env forces an 8-device CPU mesh via XLA_FLAGS
+import re as _re
+
+_xf = _re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+              os.environ.get("XLA_FLAGS", ""))
+os.environ["XLA_FLAGS"] = (
+    _xf + " --xla_force_host_platform_device_count=1").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", 1)
+except AttributeError:
+    pass
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.distributed.elastic import ElasticMember
+from paddle_tpu.io import CheckpointManager
+
+STEPS = 12
+ROWS = 12  # global batch rows per step; divisible by worlds 3 and 2
+
+
+def build():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 321
+    startup.random_seed = 321
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[6])
+        y = fluid.layers.data("y", shape=[1])
+        h = fluid.layers.fc(x, 16, act="relu",
+                            param_attr=fluid.ParamAttr(name="ew1"))
+        pred = fluid.layers.fc(h, 1, param_attr=fluid.ParamAttr(name="ew2"))
+        loss = fluid.layers.mean(fluid.layers.square(pred - y))
+    return main, startup, loss
+
+
+def make_data():
+    rng = np.random.RandomState(23)
+    w = rng.randn(6, 1).astype("f")
+    xs, ys = [], []
+    for _ in range(STEPS):
+        x = rng.randn(ROWS, 6).astype("f")
+        xs.append(x)
+        ys.append((x @ w).astype("f"))
+    return xs, ys
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ckpt_dir", required=True)
+    ap.add_argument("--pause_at", type=int, default=None)
+    ap.add_argument("--hold_at", type=int, nargs=2, default=None,
+                    metavar=("STEP", "WORLD"))
+    args = ap.parse_args()
+
+    main_p, startup_p, loss = build()
+    with fluid.program_guard(main_p, startup_p):
+        fluid.optimizer.SGD(0.05).minimize(loss)
+
+    xs, ys = make_data()
+    exe = fluid.Executor(fluid.CPUPlace())
+    ckpt = CheckpointManager(args.ckpt_dir, save_interval=2, max_num=4)
+    member = ElasticMember(main_p, startup_p, executor=exe, ckpt=ckpt,
+                           feed_names=["x", "y"], fetch_names=[loss.name])
+    member.start()
+    print("start: rank=%d epoch=%d world=%d restore=%d"
+          % (member.rank, member.epoch, member.world, member.restore_step),
+          flush=True)
+
+    step = member.restore_step
+    while step < STEPS:
+        if args.pause_at is not None and step == args.pause_at:
+            print("pause:%d" % step, flush=True)
+            time.sleep(600)  # SIGKILLed here by the test
+        if args.hold_at is not None and step == args.hold_at[0]:
+            while member.world < args.hold_at[1]:
+                if not member.gate(step):
+                    step = member.restore_step
+                    print("requorum: epoch=%d world=%d restore=%d"
+                          % (member.epoch, member.world, step), flush=True)
+                time.sleep(0.2)
+        if not member.gate(step):
+            step = member.restore_step
+            print("requorum: epoch=%d world=%d restore=%d"
+                  % (member.epoch, member.world, step), flush=True)
+            continue
+        shard = ROWS // member.world
+        lo = shard * member.pid
+        print("mark:step=%d world=%d epoch=%d"
+              % (step, member.world, member.epoch), flush=True)
+        out, = exe.run(member.main_program,
+                       feed={"x": xs[step][lo:lo + shard],
+                             "y": ys[step][lo:lo + shard]},
+                       fetch_list=[loss.name])
+        print("loss:%.8f" % float(np.asarray(out).reshape(-1)[0]),
+              flush=True)
+        step += 1
+        member.maybe_save(step)
+    print("done: rank=%d epoch=%d world=%d"
+          % (member.rank, member.epoch, member.world), flush=True)
+    member.finalize()
+
+
+if __name__ == "__main__":
+    main()

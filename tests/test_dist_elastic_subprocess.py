@@ -1,0 +1,277 @@
+"""Elastic re-quorum for the collective all-reduce path, end to end
+(distributed/elastic.py over real subprocesses) plus the DL005 verifier
+rule it leans on.
+
+Subprocess scenario: 3 members train data-parallel over gloo; one
+non-coordinator member is SIGKILLed mid-training (parked outside any
+collective, so gloo can't wedge); the survivors must
+
+  * detect the death over the control channel, evict the member, and
+    re-form a 2-member world (new quorum epoch, re-transpiled programs
+    that PASS the static verifier in error mode, params restored from the
+    shared CheckpointManager),
+  * keep the loss trajectory decreasing from the restored step,
+  * admit the relaunched victim (PADDLE_RESTART_COUNT=1, the launcher's
+    --restart_failed env) at the next epoch and finish as a 3-world.
+
+The survivors hold at a late step until the world is back to 3, making
+the rejoin a deterministic rendezvous instead of a race against the
+relaunched process's interpreter start-up."""
+
+import os
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from dist_utils import free_ports, kill_proc_tree
+
+_PAYLOAD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "dist_elastic_payload.py")
+
+N = 3
+VICTIM = 2
+PAUSE_AT = 5    # ckpts land at steps 2 and 4 -> survivors restore step 4
+HOLD_AT = 8     # survivors spin here until the victim rejoins
+
+
+class _Tail:
+    """Sole consumer of a member's merged stdout/stderr pipe: a reader
+    thread drains lines as they arrive (select+buffered-readline mixes
+    lose lines to the TextIO buffer), the test polls the collected list."""
+
+    def __init__(self, name, proc):
+        self.name = name
+        self.proc = proc
+        self.lines = []
+        self._t = threading.Thread(target=self._drain, daemon=True)
+        self._t.start()
+
+    def _drain(self):
+        for line in self.proc.stdout:
+            self.lines.append(line)
+
+    def wait_for(self, marker, timeout):
+        """First line containing `marker`, or None on deadline/EOF."""
+        deadline = time.time() + timeout
+        while True:
+            for line in list(self.lines):
+                if marker in line:
+                    return line
+            if not self._t.is_alive() or time.time() >= deadline:
+                for line in list(self.lines):  # post-EOF stragglers
+                    if marker in line:
+                        return line
+                return None
+            time.sleep(0.1)
+
+    def text(self):
+        return "".join(self.lines)
+
+    def finish(self, timeout):
+        rc = self.proc.wait(timeout=timeout)
+        self._t.join(timeout=15)
+        return rc, self.text()
+
+
+def _dump(tails):
+    return "\n".join("--- %s rc=%s tail ---\n%s"
+                     % (t.name, t.proc.poll(), t.text()[-2000:])
+                     for t in tails)
+
+
+def _clean_env():
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # payloads force their own 1-device mesh
+    return env
+
+
+def _member_env(rank, eps, tmp, restart=0):
+    env = _clean_env()
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(N),
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(eps),
+        "PADDLE_CURRENT_ENDPOINT": eps[rank],
+        "PADDLE_COORDINATOR": eps[0],
+        "PADDLE_RESTART_COUNT": str(restart),
+        "FLAGS_elastic_hb_interval": "0.3",
+        "FLAGS_elastic_hb_timeout": "3",
+        "FLAGS_static_check": "error",
+        "FLAGS_telemetry": "1",
+        "FLAGS_telemetry_dir": os.path.join(str(tmp), "tm-%d-%d"
+                                            % (rank, restart)),
+    })
+    return env
+
+
+def _spawn(name, rank, eps, tmp, ckpt_dir, extra=(), restart=0):
+    cmd = [sys.executable, "-u", _PAYLOAD, "--ckpt_dir", ckpt_dir]
+    cmd += list(extra)
+    proc = subprocess.Popen(cmd, env=_member_env(rank, eps, tmp, restart),
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True,
+                            start_new_session=True)
+    return _Tail(name, proc)
+
+
+def _losses(text):
+    return [float(m) for m in re.findall(r"^loss:([-\d.e]+)", text,
+                                         re.MULTILINE)]
+
+
+def test_evict_requorum_and_rejoin(tmp_path):
+    ports = free_ports(N)
+    eps = ["127.0.0.1:%d" % p for p in ports]
+    ckpt_dir = str(tmp_path / "ckpt")
+
+    hold = ("--hold_at", str(HOLD_AT), str(N))
+    tails = [_spawn("m:%d" % r, r, eps, tmp_path, ckpt_dir, extra=hold)
+             for r in range(N - 1)]
+    victim = _spawn("victim", VICTIM, eps, tmp_path, ckpt_dir,
+                    extra=("--pause_at", str(PAUSE_AT)))
+    tails.append(victim)
+    try:
+        # 1. victim reaches the pause point -> SIGKILL it (mid-training,
+        #    but parked outside any collective)
+        got = victim.wait_for("pause:%d" % PAUSE_AT, 240)
+        assert got is not None, (
+            "victim never reached pause:\n" + _dump(tails))
+        os.killpg(os.getpgid(victim.proc.pid), signal.SIGKILL)
+        victim.proc.wait(timeout=30)
+
+        # 2. a survivor notices, and the quorum re-forms at world 2 with
+        #    params restored from the last valid checkpoint (step 4)
+        line = tails[0].wait_for("requorum:", 120)
+        assert line is not None, (
+            "survivor 0 never re-quorumed:\n" + _dump(tails))
+        assert "world=2" in line and "restore=4" in line, line
+
+        # 3. relaunch the victim the way launch.py --restart_failed would
+        #    (same rank/endpoints, PADDLE_RESTART_COUNT bumped)
+        rejoin = _spawn("rejoin", VICTIM, eps, tmp_path, ckpt_dir,
+                        restart=1)
+        tails.append(rejoin)
+
+        outs = {}
+        for t in tails:
+            if t is victim:
+                continue
+            try:
+                rc, out = t.finish(timeout=240)
+            except subprocess.TimeoutExpired:
+                raise AssertionError("%s hung:\n%s" % (t.name, _dump(tails)))
+            outs[t.name] = out
+            assert rc == 0, (t.name, out[-3000:])
+    finally:
+        for t in tails:
+            if t.proc.poll() is None:
+                kill_proc_tree(t.proc)
+
+    # the SIGKILLed incarnation died by signal, not a clean exit
+    assert victim.proc.returncode < 0
+
+    # survivors: world 3 -> 2 -> 3, and training FINISHED as a 3-world
+    for r in range(N - 1):
+        out = outs["m:%d" % r]
+        assert "start: rank=%d epoch=0 world=3" % r in out, out[-2000:]
+        assert re.search(r"requorum: epoch=\d+ world=2 restore=4", out), \
+            out[-2000:]
+        assert re.search(r"mark:step=\d+ world=3 epoch=[1-9]", out), \
+            "never returned to world 3:\n" + out[-2000:]
+        assert re.search(r"done: rank=%d epoch=\d+ world=3" % r, out), \
+            out[-2000:]
+
+    # loss keeps decreasing across the re-quorum from the restored step
+    ls = _losses(outs["m:0"])
+    assert len(ls) >= 10 and all(l == l and abs(l) < 1e9 for l in ls), ls
+    assert ls[-1] < ls[0], ls
+
+    # the relaunched victim rejoined an existing quorum as rank 2 and
+    # finished with everyone else
+    out = outs["rejoin"]
+    assert re.search(r"start: rank=2 epoch=[1-9]\d* world=3", out), \
+        out[-2000:]
+    assert "done: rank=2" in out, out[-2000:]
+
+    # telemetry: the coordinator counted the eviction and the rejoin
+    tm = os.path.join(str(tmp_path), "tm-0-0", "metrics.json")
+    if os.path.exists(tm):
+        import json
+
+        with open(tm) as fh:
+            blob = json.dumps(json.load(fh))
+        assert "elastic_evictions_total" in blob, blob[:500]
+        assert "elastic_rejoins_total" in blob, blob[:500]
+
+
+# ---------------------------------------------------------------------------
+# DL005: world-size agreement (unit level, no subprocesses)
+
+
+def _transpiled_pair(nranks=3):
+    import paddle_tpu as fluid
+    from paddle_tpu.transpiler.collective import GradAllReduce
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        y = fluid.layers.data("y", shape=[1])
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(fluid.layers.square(pred - y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    eps = ["127.0.0.1:%d" % (6170 + i) for i in range(nranks)]
+    GradAllReduce().transpile(startup_program=startup, main_program=main,
+                              rank=0, endpoints=eps,
+                              current_endpoint=eps[0], wait_port=False)
+    return main, startup, loss
+
+
+def test_dl005_stale_gradient_scale_is_flagged():
+    from paddle_tpu.core import analysis
+
+    main, _startup, loss = _transpiled_pair(nranks=3)
+    rep = analysis.verify_program(main, feed_names=["x", "y"],
+                                  fetch_names=[loss.name],
+                                  expected_nranks=2)
+    errs = [d for d in rep.errors if d.rule == "DL005"]
+    assert errs, rep.format()
+    # one of them pins the exact in-place 1/nranks scale op
+    blk = main.global_block()
+    scale_idx = [i for i, op in enumerate(blk.ops)
+                 if op.type == "scale"
+                 and op.input_arg_names == op.output_arg_names]
+    assert scale_idx, [op.type for op in blk.ops]
+    assert any(d.op_idx in scale_idx for d in errs), \
+        (scale_idx, [(d.op_idx, d.message) for d in errs])
+
+
+def test_dl005_c_comm_init_nranks_is_flagged():
+    from paddle_tpu.core import analysis
+
+    _main, startup, _loss = _transpiled_pair(nranks=3)
+    rep = analysis.verify_program(startup, expected_nranks=2)
+    errs = [d for d in rep.errors if d.rule == "DL005"]
+    assert errs, rep.format()
+    blk = startup.global_block()
+    hits = [d for d in errs if d.op_idx is not None
+            and blk.ops[d.op_idx].type == "c_comm_init"]
+    assert hits, [(d.op_idx, d.message) for d in errs]
+
+
+def test_dl005_matching_world_is_clean():
+    from paddle_tpu.core import analysis
+
+    main, startup, loss = _transpiled_pair(nranks=3)
+    for prog, feeds, fetches in ((main, ["x", "y"], [loss.name]),
+                                 (startup, (), ())):
+        rep = analysis.verify_program(prog, feed_names=feeds,
+                                      fetch_names=fetches,
+                                      expected_nranks=3)
+        assert not [d for d in rep.errors if d.rule == "DL005"], \
+            rep.format()

@@ -22,6 +22,21 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _filter_snap(snap, prefix):
+    """Keep only metric families whose FLAT name starts with `prefix`
+    (label suffixes ride along)."""
+    kept = dict(snap)
+    for fam in ("counters", "gauges", "histograms"):
+        kept[fam] = {k: v for k, v in snap.get(fam, {}).items()
+                     if k.startswith(prefix)}
+    kept["events_logged"] = {k: v
+                             for k, v in snap.get("events_logged",
+                                                  {}).items()
+                             if k.startswith(prefix)}
+    kept["info"] = {}
+    return kept
+
+
 def render_table(snap, out=sys.stdout):
     counters = snap.get("counters", {})
     gauges = snap.get("gauges", {})
@@ -65,6 +80,10 @@ def main(argv=None):
                     help="emit Prometheus exposition text")
     ap.add_argument("--raw", action="store_true",
                     help="emit the raw JSON snapshot")
+    ap.add_argument("--elastic", action="store_true",
+                    help="show only the elastic re-quorum health metrics "
+                    "(elastic_epoch/world gauges, eviction/rejoin "
+                    "counters, re-quorum duration histogram)")
     args = ap.parse_args(argv)
 
     if args.json_path:
@@ -74,6 +93,9 @@ def main(argv=None):
         from paddle_tpu import telemetry
 
         snap = telemetry.scrape(args.endpoint, timeout=args.timeout)
+
+    if args.elastic:
+        snap = _filter_snap(snap, "elastic_")
 
     if args.raw:
         json.dump(snap, sys.stdout, indent=1)

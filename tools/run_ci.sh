@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI harness (reference paddle/scripts/paddle_build.sh analog): build the
 # native pieces, run the full test pyramid, smoke the bench + graft entry.
-# Usage: tools/run_ci.sh [quick|full|tpu|--layout-smoke|--obs-smoke|--lint]
+# Usage: tools/run_ci.sh [quick|full|tpu|--layout-smoke|--obs-smoke|--lint|--elastic-smoke]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -17,6 +17,18 @@ if [ "$MODE" = "--lint" ]; then
   JAX_PLATFORMS=cpu FLAGS_static_check=error \
     python tools/proglint.py --grad --transpile 2
   echo "CI --lint: PASS"
+  exit 0
+fi
+
+if [ "$MODE" = "--elastic-smoke" ]; then
+  # elastic re-quorum leg: DL005 verifier units + the full 3-member
+  # SIGKILL/evict/restore/rejoin subprocess scenario, everything under
+  # FLAGS_static_check=error so any post-requorum rewrite that fails the
+  # verifier kills the run instead of limping into XLA
+  echo "== elastic smoke: DL005 + evict/rejoin subprocess scenario =="
+  JAX_PLATFORMS=cpu FLAGS_static_check=error \
+    python -m pytest tests/test_dist_elastic_subprocess.py -q
+  echo "CI --elastic-smoke: PASS"
   exit 0
 fi
 
