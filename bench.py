@@ -243,15 +243,19 @@ def bench_bert(batch=256, seq_len=128, warmup=3, iters=15, amp=True,
             # retry OOMed in-process while the same batch ran fine in a
             # fresh interpreter)
             code = ("import bench; r = bench._bench_bert_at(%d, %d, %d, "
-                    "%d, %s, remat=%s); print('BENCH_RESULT', r[0], r[1])"
+                    "%d, %s, remat=%s); print('BENCH_RESULT', r[0], r[1], "
+                    "bench._BERT_WIRE_BYTES)"
                     % (b, seq_len, warmup, iters, amp, rm))
             p = _sp.run([_sys.executable, "-c", code],
                         capture_output=True, text=True,
                         cwd=os.path.dirname(os.path.abspath(__file__)))
             for line in p.stdout.splitlines():
                 if line.startswith("BENCH_RESULT"):
-                    _, v, l = line.split()
-                    return float(v), float(l), b, False
+                    parts = line.split()
+                    global _BERT_WIRE_BYTES
+                    _BERT_WIRE_BYTES = (float(parts[3])
+                                        if len(parts) > 3 else 0.0)
+                    return float(parts[1]), float(parts[2]), b, False
             full = (p.stderr or "") + (p.stdout or "")
             last_err = full[-300:]
             # search the FULL output: TPU OOMs append a multi-KB hbm
@@ -263,6 +267,12 @@ def bench_bert(batch=256, seq_len=128, warmup=3, iters=15, amp=True,
                                                     else ""),
               file=_sys.stderr)
     raise RuntimeError("bench_bert: all batch sizes OOMed: %s" % last_err)
+
+
+# analytic ICI wire bytes per step of the last _bench_bert_at program —
+# stamped by the collective transpiler into _collective_meta (0.0 when the
+# bench ran single-device / untranspiled)
+_BERT_WIRE_BYTES = 0.0
 
 
 def _bench_bert_at(batch, seq_len, warmup, iters, amp, remat=False):
@@ -301,6 +311,25 @@ def _bench_bert_at(batch, seq_len, warmup, iters, amp, remat=False):
             opt = fluid.optimizer.RecomputeOptimizer(opt)
             opt._set_checkpoints(ckpts)
         opt.minimize(loss)
+
+    # BENCH_COLLECTIVE=1: run the data-parallel exchange path (GradAllReduce
+    # or, under FLAGS_collective_mode=zero1, ShardedGradAllReduce +
+    # quantized wire per FLAGS_allreduce_dtype) over the local mesh and
+    # report the transpiler's analytic bytes-on-ICI per step
+    global _BERT_WIRE_BYTES
+    _BERT_WIRE_BYTES = 0.0
+    if os.environ.get("BENCH_COLLECTIVE", "0") == "1":
+        n = len(jax.devices())
+        if n > 1:
+            from paddle_tpu.transpiler.collective import \
+                select_grad_transpiler
+
+            eps = ["local:%d" % i for i in range(n)]
+            select_grad_transpiler().transpile(
+                startup_program=startup, main_program=main, rank=0,
+                endpoints=eps, current_endpoint=eps[0], wait_port=False)
+            _BERT_WIRE_BYTES = float(
+                main._collective_meta.get("wire_bytes_per_step", 0.0))
 
     exe = fluid.Executor(fluid.TPUPlace(0))
     scope = fluid.Scope()
@@ -537,6 +566,12 @@ def main():
             # (no OOM fallback fired), i.e. the number is repeatable at
             # this batch run to run — see bench_bert
             "stable": stable,
+            # analytic per-rank ICI wire bytes per step of the gradient
+            # exchange (BENCH_COLLECTIVE=1 + multi-device; else 0.0).
+            # FLAGS_allreduce_dtype=int8 should read ~0.25x the f32 row;
+            # FLAGS_collective_mode=zero1 at f32 matches replicated (the
+            # RS+AG pair costs exactly one ring allreduce)
+            "bytes_on_ici_per_step": round(_BERT_WIRE_BYTES, 1),
         }
         if stable:
             # on the OOM-fallback path the number came from a retry

@@ -29,6 +29,7 @@ distributed lint  DL001 param not assigned to exactly one pserver
                   DL003 collective ring_id missing/negative/mixed
                   DL004 side-effecting op duplicated into trainer + pserver
                   DL005 gradient-scale constant stale vs collective world
+                  DL006 ZeRO-1 shard coverage / dequant scale / shard world
 
 Gating: ``FLAGS_static_check`` = ``off`` | ``warn`` (default) | ``error``.
 ``off`` costs one flag read per executor compile (the telemetry early-return
@@ -76,6 +77,7 @@ RULES = {
     "DL003": "collective op ring_id missing, negative, or mixed",
     "DL004": "side-effecting op duplicated into trainer and pserver",
     "DL005": "gradient-scale constant stale vs collective world size",
+    "DL006": "ZeRO-1 shard coverage / dequant-scale / shard-world broken",
 }
 
 
@@ -201,7 +203,15 @@ _SIDE_EFFECT_OPS = frozenset((
 _COLLECTIVE_OPS = frozenset((
     "c_allreduce_sum", "c_allreduce_max", "c_allreduce_min",
     "c_allreduce_prod", "c_broadcast", "c_allgather", "c_reducescatter",
-    "allreduce", "broadcast",
+    "c_shard_slice", "c_allreduce_qsum", "c_reducescatter_q",
+    "c_allgather_q", "allreduce", "broadcast",
+))
+
+# the reduction collectives that carry the folded 1/nranks averaging scale
+# (transpiler/collective.py); DL005 checks the attr against the world
+_SCALED_REDUCE_OPS = frozenset((
+    "c_allreduce_sum", "c_reducescatter", "c_allreduce_qsum",
+    "c_reducescatter_q",
 ))
 
 _GRAD_SUFFIX = "@GRAD"
@@ -694,6 +704,25 @@ def _check_collectives(program, rep, expected_nranks=None):
                             blk.idx, op_idx,
                             suggestion="re-transpile startup for the "
                             "current endpoint list")
+            elif (op.type in _SCALED_REDUCE_OPS
+                  and op.attr("scale") is not None
+                  and float(op.attr("scale")) != 1.0):
+                # the folded-form averaging scale the transpiler stamps on
+                # the reduction collective itself: must be exactly 1/world
+                # (scale == 1.0 is a plain sum — user collectives keep it)
+                got = float(op.attr("scale"))
+                if abs(got * int(nranks) - 1.0) > 1e-6:
+                    rep.add(ERROR, "DL005",
+                            "folded gradient scale %.8g on %s does not "
+                            "match 1/%d — program was transpiled for world "
+                            "size %s"
+                            % (got, op.type, int(nranks),
+                               round(1.0 / got) if got else "?"),
+                            blk.idx, op_idx,
+                            var_names=tuple(op.input("X")),
+                            suggestion="re-run the collective transpiler "
+                            "so the folded scale matches the %d-member "
+                            "world" % int(nranks))
             elif (has_allreduce and op.type == "scale"
                   and op.input_arg_names == op.output_arg_names
                   and int(op.attr(OP_ROLE_KEY) or 0) == int(OpRole.Backward)):
@@ -711,6 +740,157 @@ def _check_collectives(program, rep, expected_nranks=None):
                             suggestion="re-run GradAllReduce.transpile so "
                             "the loss-grad scale matches the %d-member "
                             "world" % int(nranks))
+
+
+_ZERO1_DEQUANT_OPS = ("c_allreduce_qsum", "c_reducescatter_q")
+_ZERO1_WORLD_OPS = ("c_shard_slice", "c_reducescatter", "c_reducescatter_q",
+                    "c_allgather", "c_allgather_q", "c_allreduce_qsum",
+                    "c_quant_pack")
+
+
+def _check_zero1(program, rep, expected_nranks=None):
+    """DL006: ZeRO-1 / quantized-exchange structural invariants.
+
+    (a) shard coverage — under ``_collective_meta["mode"] == "zero1"``
+        every param in the shard table is owned by EXACTLY one update
+        chain: one c_shard_slice, one optimizer write of the shard, one
+        c_allgather back (a double-owned shard means two ranks' updates
+        race on the same rows; a missing leg means rows never update).
+    (b) dequant-scale pinning — a c_allreduce_qsum / c_reducescatter_q
+        must read the Scale its own c_quant_pack produced, with matching
+        bucket/dtype/nranks geometry.  A drifted scale dequantizes with
+        the wrong magnitudes and silently corrupts every gradient.
+    (c) shard-world agreement — nranks baked into the shard/quant ops
+        must equal the collective world (``expected_nranks`` after an
+        elastic re-quorum), mirroring what DL005 does for the scales.
+    """
+    meta = getattr(program, "_collective_meta", None) or {}
+    nranks = expected_nranks if expected_nranks else meta.get("nranks")
+    for blk in program.blocks:
+        ops = _runtime_ops(blk)
+        producers = {}
+        for op_idx, op in ops:
+            for nm in op.output_arg_names:
+                if nm:
+                    producers[nm] = (op_idx, op)
+        for op_idx, op in ops:
+            # (b) dequant pinned to its quantize op
+            if op.type in _ZERO1_DEQUANT_OPS:
+                xs, ss = op.input("X"), op.input("Scale")
+                if len(xs) == 1 and len(ss) == 1:
+                    prod = producers.get(xs[0])
+                    if prod is None or prod[1].type != "c_quant_pack":
+                        rep.add(ERROR, "DL006",
+                                "%s payload %r is not the output of a "
+                                "c_quant_pack op" % (op.type, xs[0]),
+                                blk.idx, op_idx, (xs[0],),
+                                suggestion="pack the tensor with "
+                                "c_quant_pack in the same block")
+                    else:
+                        qidx, qop = prod
+                        if qop.output("Scale") != ss:
+                            rep.add(ERROR, "DL006",
+                                    "%s dequantizes with scale %r but its "
+                                    "payload was packed with %r (op %d) — "
+                                    "the dequant scale must be pinned to "
+                                    "the quantize op's"
+                                    % (op.type, ss[0],
+                                       (qop.output("Scale") or ["?"])[0],
+                                       qidx),
+                                    blk.idx, op_idx, tuple(ss),
+                                    suggestion="wire Scale to op %d's "
+                                    "Scale output" % qidx)
+                        for a in ("bucket", "dtype", "nranks"):
+                            if op.attr(a) != qop.attr(a):
+                                rep.add(ERROR, "DL006",
+                                        "%s %s=%r drifted from its "
+                                        "c_quant_pack's %s=%r (op %d)"
+                                        % (op.type, a, op.attr(a), a,
+                                           qop.attr(a), qidx),
+                                        blk.idx, op_idx, tuple(xs),
+                                        suggestion="keep the pack/dequant "
+                                        "pair's quantization geometry "
+                                        "identical")
+            # (c) shard world agreement
+            if (nranks and op.type in _ZERO1_WORLD_OPS):
+                got = op.attr("nranks")
+                if got is not None and int(got) > 1 \
+                        and int(got) != int(nranks):
+                    rep.add(ERROR, "DL006",
+                            "%s was built for nranks=%d but the collective "
+                            "world has %d members"
+                            % (op.type, int(got), int(nranks)),
+                            blk.idx, op_idx,
+                            suggestion="re-run the collective transpiler "
+                            "for the current world")
+
+    # (a) shard coverage over the global block's update chains
+    shards = meta.get("zero1_shards")
+    if meta.get("mode") != "zero1" or shards is None:
+        return
+    from ..framework import OP_ROLE_KEY, OpRole
+
+    block = program.global_block()
+    ops = _runtime_ops(block)
+    updaters = [(i, op) for i, op in ops
+                if int(op.attr(OP_ROLE_KEY) or 0) & OpRole.Optimize
+                and op.output("ParamOut")]
+    if not updaters:
+        return  # startup / inference program: no update chains to cover
+    slices, gathers = {}, {}
+    for i, op in ops:
+        if op.type == "c_shard_slice" and len(op.input("X")) == 1:
+            slices.setdefault(op.input("X")[0], []).append((i, op))
+        elif op.type in ("c_allgather", "c_allgather_q") \
+                and len(op.output("Out")) == 1:
+            gathers.setdefault(op.output("Out")[0], []).append((i, op))
+
+    def _owners(name):
+        return [i for i, op in updaters if name in op.output("ParamOut")]
+
+    def _exactly_one(idxs, what, param):
+        if len(idxs) == 1:
+            return
+        pin = idxs[-1] if idxs else None
+        rep.add(ERROR, "DL006",
+                "param %r is covered by %d %s (expected exactly one) — "
+                "the shard assignment does not own every row exactly once"
+                % (param, len(idxs), what),
+                block.idx, pin, (param,),
+                suggestion="re-run ShardedGradAllReduce.transpile; every "
+                "param must map to one shard-update chain")
+
+    covered = set()
+    for param, entry in sorted(shards.items()):
+        covered.add(param)
+        if entry.get("sharded"):
+            sl = slices.get(param, [])
+            _exactly_one([i for i, _ in sl], "c_shard_slice ops", param)
+            _exactly_one([i for i, _ in gathers.get(param, [])],
+                         "c_allgather writes", param)
+            if len(sl) == 1:
+                shard_var = (sl[0][1].output("Out") or [None])[0]
+                _exactly_one(_owners(shard_var), "optimizer shard updates",
+                             param)
+        else:
+            _exactly_one(_owners(param), "optimizer updates", param)
+            if param in slices or param in gathers:
+                rep.add(ERROR, "DL006",
+                        "param %r is marked replicated in the shard table "
+                        "but has shard ops in the block" % param,
+                        block.idx, slices.get(param, gathers.get(param))
+                        [0][0], (param,))
+    # every optimizer-updated param must appear in the shard table
+    for i, op in updaters:
+        for name in op.output("ParamOut"):
+            base = name[:-len("@ZSHARD")] if name.endswith("@ZSHARD") \
+                else name
+            if base not in covered:
+                rep.add(ERROR, "DL006",
+                        "optimizer updates %r but the ZeRO-1 shard table "
+                        "does not cover it" % base, block.idx, i, (base,),
+                        suggestion="re-transpile so the shard assignment "
+                        "covers every trainable param")
 
 
 def verify_transpiled(ps_state, rep=None):
@@ -837,6 +1017,8 @@ def verify_program(program, feed_names=(), fetch_names=(), scope_names=None,
         lambda: _check_donation(program, feed_names, fetch_names, rep),
         lambda: _check_collectives(program, rep,
                                    expected_nranks=expected_nranks),
+        lambda: _check_zero1(program, rep,
+                             expected_nranks=expected_nranks),
     )
     for chk in checks:
         try:

@@ -363,6 +363,14 @@ class Executor:
                 len(params_rw) + len(params_carry),
                 feed_bytes=feed_bytes, fetch_bytes=fetch_bytes,
                 carry_hits=carry_hits, carry_converts=carry_converts)
+            cmeta = getattr(program, "_collective_meta", None)
+            if cmeta and cmeta.get("wire_bytes_per_step"):
+                # analytic bytes-on-ICI for the step's gradient exchange
+                # (stamped by the collective transpiler; see
+                # transpiler/collective.py _wire_bytes)
+                wire = float(cmeta["wire_bytes_per_step"])
+                _telemetry.inc("collective_wire_bytes_total", wire)
+                _telemetry.set_gauge("collective_wire_bytes_per_step", wire)
         from ..profiler import mark_instant
 
         mark_instant("step", args={"step": int(counter)})

@@ -300,6 +300,8 @@ def _scatter_slot(opdef, op, slot, value, env):
 _AXIS_OPS = frozenset((
     "c_allreduce_sum", "c_allreduce_max", "c_allreduce_min",
     "c_allreduce_prod", "c_broadcast", "c_allgather", "c_reducescatter",
+    "c_shard_slice", "c_allreduce_qsum", "c_reducescatter_q",
+    "c_allgather_q",
     "allreduce", "broadcast",
 ))
 
@@ -399,6 +401,16 @@ def build_spmd_block_fn(plan, mesh, axis="data"):
     fetch_names = plan.fetch_names
     persist_written = plan.persist_written
 
+    def _var_spec(name):
+        # var-level sharding annotation (tuple of axis names / None per
+        # dim, stamped by the ZeRO-1 transpiler) -> PartitionSpec; axis
+        # names the mesh does not carry degrade to replicated dims
+        v = block._find_var_recursive(name)
+        ann = getattr(v, "sharding", None) if v is not None else None
+        if not ann:
+            return P()
+        return P(*[a if a == axis else None for a in ann])
+
     def local(feeds, params_ro, params_rw, rng):
         # param carry is disabled under SPMD (plan.carry_names empty): the
         # shard_map in/out specs are built per-name and the donation
@@ -430,15 +442,19 @@ def build_spmd_block_fn(plan, mesh, axis="data"):
                 feed_specs[n] = P(axis, *([None] * (v.ndim - 1)))
             else:
                 feed_specs[n] = P()  # 0-d / non-divisible: replicate
-        param_ro_specs = {n: P() for n in params_ro}
-        param_rw_specs = {n: P() for n in params_rw}
-        # persist_written declared replicated: grads are allreduced before any
-        # optimizer write, so params stay bitwise-identical across ranks.
+        param_ro_specs = {n: _var_spec(n) for n in params_ro}
+        param_rw_specs = {n: _var_spec(n) for n in params_rw}
+        # persist_written defaults to replicated: grads are allreduced before
+        # any optimizer write, so params stay bitwise-identical across ranks.
         # Rank-local persistable state (e.g. non-sync batch_norm running
         # stats) resolves to one rank's value — same semantics as the
         # reference's DP, where device-0's copy is the one saved
         # (parallel_executor.cc BCastParamsToDevices / save from scope 0).
-        out_specs = ([P(axis)] * len(fetch_names), {n: P() for n in persist_written})
+        # ZeRO-1 optimizer slots carry a var-level `sharding` annotation
+        # (axis-name tuple), which maps straight onto the mesh axis here so
+        # each rank holds only its 1/nranks slot shard.
+        out_specs = ([P(axis)] * len(fetch_names),
+                     {n: _var_spec(n) for n in persist_written})
         sm = shard_map_compat(
             local,
             mesh,

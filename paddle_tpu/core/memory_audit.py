@@ -29,6 +29,21 @@ def _nbytes(x):
         return 0
 
 
+def _nbytes_replica(x):
+    """Bytes this array occupies PER REPLICA: a mesh-sharded jax.Array
+    (e.g. a ZeRO-1 optimizer slot annotated with Variable.sharding) only
+    materializes its shard_shape slice on each device; replicated arrays
+    cost full size everywhere."""
+    try:
+        sh = getattr(x, "sharding", None)
+        if sh is not None and hasattr(sh, "shard_shape"):
+            shp = sh.shard_shape(x.shape)
+            return int(np.prod(shp)) * x.dtype.itemsize
+    except Exception:
+        pass
+    return _nbytes(x)
+
+
 def _analysis_dict(ma):
     out = {}
     for k in ("argument_size_in_bytes", "output_size_in_bytes",
@@ -56,19 +71,25 @@ def memory_report(jfn, feeds, params_ro, params_rw, params_carry, rng,
               ("param_rw", params_rw), ("carry_bf16", params_carry))
     by_var = []
     totals = {}
+    totals_replica = {}
     for cls, d in groups:
-        sub = 0
+        sub = sub_r = 0
         for n, v in d.items():
             b = _nbytes(v)
+            br = _nbytes_replica(v)
             sub += b
+            sub_r += br
             by_var.append({"name": n, "class": cls, "bytes": b,
+                           "bytes_per_replica": br,
                            "dtype": str(getattr(v, "dtype", "?")),
                            "shape": list(getattr(v, "shape", ()))})
         totals[cls] = sub
+        totals_replica[cls] = sub_r
     by_var.sort(key=lambda r: -r["bytes"])
     report = {
         "analysis": analysis,
         "arg_bytes_by_class": totals,
+        "arg_bytes_per_replica_by_class": totals_replica,
         "vars": by_var,
     }
     if plan is not None:
@@ -106,6 +127,11 @@ def format_report(report, top=12):
     cls = report.get("arg_bytes_by_class", {})
     lines.append("hbm_audit: by class  " + "  ".join(
         "%s=%s" % (k, _fmt_mb(v)) for k, v in sorted(cls.items())))
+    cls_r = report.get("arg_bytes_per_replica_by_class", {})
+    if cls_r and cls_r != cls:
+        # sharded state (ZeRO-1 slots): what each replica materializes
+        lines.append("hbm_audit: per replica  " + "  ".join(
+            "%s=%s" % (k, _fmt_mb(v)) for k, v in sorted(cls_r.items())))
     if report.get("carry_names"):
         lines.append(
             "hbm_audit: %d params ride the bf16 carry (%s resident bf16 "

@@ -592,9 +592,13 @@ class ElasticMember:
         endpoints = [self.members[r] for r in view.ranks]
         main = self.base_main.clone()
         startup = self.base_startup.clone()
-        from ..transpiler.collective import GradAllReduce
+        # FLAGS_collective_mode-aware: a zero1 job re-shards the optimizer
+        # state for the new world here (the re-transpiled shard assignment
+        # covers `world` ranks; shard-local slots rematerialize from the
+        # full arrays the checkpoint restore puts back into the scope)
+        from ..transpiler.collective import select_grad_transpiler
 
-        t = GradAllReduce(self.nrings)
+        t = select_grad_transpiler(self.nrings)
         t.transpile(startup_program=startup, main_program=main, rank=pid,
                     endpoints=endpoints,
                     current_endpoint=self.members[self.rank],

@@ -93,6 +93,26 @@ _DEFAULTS = {
     # VPU chain loses to XLA's materialized-probs backward), so the
     # composed emission stays the default training path (BASELINE.md r5)
     "FLAGS_fused_small_attention": False,
+    # collective gradient-exchange strategy (transpiler/collective.py):
+    # "allreduce" = replicated GradAllReduce (every rank updates every
+    # param); "zero1" = ShardedGradAllReduce, the ZeRO-1 weight-update
+    # sharding pass (arXiv 2004.13336): reduce-scatter the gradients,
+    # each rank runs the optimizer only on its 1/nranks param shard
+    # (optimizer-state HBM drops by nranks), then all-gather the updated
+    # params.  Params whose dim 0 does not divide the world, or whose
+    # optimizer is not elementwise (lamb/lars need global norms), fall
+    # back per-param to the replicated update.
+    "FLAGS_collective_mode": "allreduce",
+    # wire dtype for the gradient exchange (EQuARX, arXiv 2506.17615):
+    # f32 = bitwise-parity escape hatch (plain psum / psum_scatter);
+    # bf16 / int8 = bucketed per-tensor-scale quantization before the
+    # wire, dequant after.  int8 cuts bytes-on-ICI per step to ~0.25x of
+    # the f32 ring all-reduce (payload + per-bucket f32 scales).
+    "FLAGS_allreduce_dtype": "f32",
+    # quantization bucket (elements) for FLAGS_allreduce_dtype=int8:
+    # one f32 max-abs scale per bucket per destination rank.  Smaller =
+    # tighter scales (less quant error) but more scale bytes on the wire.
+    "FLAGS_allreduce_quant_bucket": 512,
     # elastic collective re-quorum (distributed/elastic.py): member
     # heartbeat period over the PADDLE_COORDINATOR control channel, and how
     # long a member may stay silent before the quorum evicts it and the

@@ -121,7 +121,8 @@ class CollectiveOptimizer(DistributedOptimizer):
             if trainer_id < len(worker_endpoints) else worker_endpoints[0]
         )
 
-        from ....transpiler.collective import GradAllReduce, LocalSGD
+        from ....transpiler.collective import (LocalSGD,
+                                               select_grad_transpiler)
 
         # nranks for gradient scaling: number of SPMD ranks = local devices
         # per host x hosts (each rank sees 1/nranks of the global batch)
@@ -139,8 +140,12 @@ class CollectiveOptimizer(DistributedOptimizer):
                 "building the model so jax.distributed exposes all chips"
                 % (len(worker_endpoints), n_dev))
         if nranks > 1:
-            cls = LocalSGD if strategy.use_local_sgd else GradAllReduce
-            t = cls(strategy.nccl_comm_num)
+            if strategy.use_local_sgd:
+                t = LocalSGD(strategy.nccl_comm_num)
+            else:
+                # FLAGS_collective_mode: replicated GradAllReduce vs
+                # ZeRO-1 ShardedGradAllReduce (weight-update sharding)
+                t = select_grad_transpiler(strategy.nccl_comm_num)
             eps = worker_endpoints
             if len(eps) < nranks:
                 eps = ["local:%d" % i for i in range(nranks)]

@@ -58,7 +58,22 @@ def _pprod(x, axis):
     return jnp.prod(gathered, axis=0)
 
 
-_register_allreduce("sum", lambda x, a: lax.psum(x, a))
+@register_op("c_allreduce_sum", inputs=("X",), outputs=("Out",),
+             attrs={"ring_id": 0, "scale": 1.0, "use_calc_stream": False,
+                    "use_model_parallel": False},
+             grad_maker=None)
+def c_allreduce_sum(ctx, x, ring_id=0, scale=1.0, **_):
+    """psum with the gradient-averaging scale folded in (post-reduce
+    multiply), so the transpilers stop emitting a standalone per-gradient
+    scale op.  scale=1.0 is a plain sum."""
+    axis = _axis_for_ring(ctx, ring_id)
+    if axis is not None:
+        x = lax.psum(x, axis)
+    if scale != 1.0:
+        x = x * jnp.asarray(scale, x.dtype)
+    return x
+
+
 _register_allreduce("max", lambda x, a: lax.pmax(x, a))
 _register_allreduce("min", lambda x, a: lax.pmin(x, a))
 _register_allreduce("prod", _pprod)
@@ -76,24 +91,272 @@ def c_broadcast(ctx, x, ring_id=0, root=0, **_):
     return lax.psum(masked, axis)
 
 
+def _allgather_infer(op, block):
+    x = block.var(op.input("X")[0])
+    out = block.var(op.output("Out")[0])
+    n = max(int(op.attr("nranks") or 1), 1)
+    if x.shape:
+        shp = list(x.shape)
+        shp[0] = shp[0] * n
+        out.shape = tuple(shp)
+    if out.dtype is None:
+        out.dtype = x.dtype
+
+
+def _dim0_split_infer(op, block):
+    x = block.var(op.input("X")[0])
+    out = block.var(op.output("Out")[0])
+    n = max(int(op.attr("nranks") or 1), 1)
+    if x.shape:
+        shp = list(x.shape)
+        shp[0] = shp[0] // n
+        out.shape = tuple(shp)
+    if out.dtype is None:
+        out.dtype = x.dtype
+
+
 @register_op("c_allgather", inputs=("X",), outputs=("Out",),
              attrs={"ring_id": 0, "nranks": 1, "use_calc_stream": False},
-             grad_maker=None)
+             grad_maker=None, infer_shape=_allgather_infer)
 def c_allgather(ctx, x, ring_id=0, nranks=1, **_):
     axis = _axis_for_ring(ctx, ring_id)
     if axis is None:
-        return x
+        n = max(int(nranks), 1)
+        # degenerate world: keep the declared [n*d0, ...] shape
+        return jnp.concatenate([x] * n, axis=0) if n > 1 else x
     return lax.all_gather(x, axis, tiled=True)
 
 
 @register_op("c_reducescatter", inputs=("X",), outputs=("Out",),
-             attrs={"ring_id": 0, "nranks": 1, "use_calc_stream": False},
-             grad_maker=None)
-def c_reducescatter(ctx, x, ring_id=0, nranks=1, **_):
+             attrs={"ring_id": 0, "nranks": 1, "scale": 1.0,
+                    "use_calc_stream": False},
+             grad_maker=None, infer_shape=_dim0_split_infer)
+def c_reducescatter(ctx, x, ring_id=0, nranks=1, scale=1.0, **_):
     axis = _axis_for_ring(ctx, ring_id)
     if axis is None:
+        n = max(int(nranks), 1)
+        # degenerate world: rank-0 chunk, keeping the declared shard shape
+        out = lax.slice_in_dim(x, 0, x.shape[0] // n, axis=0) if n > 1 else x
+    else:
+        out = lax.psum_scatter(x, axis, tiled=True)
+    if scale != 1.0:
+        out = out * jnp.asarray(scale, out.dtype)
+    return out
+
+
+@register_op("c_shard_slice", inputs=("X",), outputs=("Out",),
+             attrs={"ring_id": 0, "nranks": 1}, grad_maker=None,
+             infer_shape=_dim0_split_infer)
+def c_shard_slice(ctx, x, ring_id=0, nranks=1, **_):
+    """This rank's 1/nranks dim-0 block of a replicated tensor (the ZeRO-1
+    param shard feeding the shard-local optimizer update).  Purely local —
+    nothing crosses the wire — but axis_index makes it mesh-dependent."""
+    n = max(int(nranks), 1)
+    if n <= 1:
         return x
-    return lax.psum_scatter(x, axis, tiled=True)
+    shard = x.shape[0] // n
+    axis = _axis_for_ring(ctx, ring_id)
+    if axis is None:
+        return lax.slice_in_dim(x, 0, shard, axis=0)
+    return lax.dynamic_slice_in_dim(x, lax.axis_index(axis) * shard, shard,
+                                    axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Quantized gradient exchange (EQuARX-style, FLAGS_allreduce_dtype=bf16|int8):
+# c_quant_pack chunks a gradient into nranks rank-aligned rows of
+# bucket-padded payload with one f32 max-abs scale per (rank, bucket), then
+# c_allreduce_qsum / c_reducescatter_q move only the narrow payload + scales
+# over the wire (all_to_all, dequant-accumulate in f32, and — for the
+# allreduce form — requantize before the all-gather phase so both wire
+# phases stay narrow: int8 lands at ~0.25x the f32 ring-allreduce bytes).
+
+_QMAX = 127.0
+
+
+def _ceil_div(a, b):
+    return -(-a // b)
+
+
+def _pack_chunks(x, nranks, bucket):
+    """[*orig] -> [nranks, nb, bucket] f32.  Chunk boundaries sit at
+    ceil(S/nranks) elements so row r holds exactly the elements destined
+    for rank r (for a ZeRO-1 grad with dim0 % nranks == 0, row r IS the
+    dim-0 shard r); bucket padding is per-chunk trailing zeros."""
+    flat = x.astype(jnp.float32).reshape(-1)
+    s = flat.shape[0]
+    n = max(int(nranks), 1)
+    b = int(bucket)
+    chunk = _ceil_div(s, n)
+    if chunk * n != s:
+        flat = jnp.pad(flat, (0, chunk * n - s))
+    g = flat.reshape(n, chunk)
+    nb = _ceil_div(chunk, b)
+    if nb * b != chunk:
+        g = jnp.pad(g, ((0, 0), (0, nb * b - chunk)))
+    return g.reshape(n, nb, b)
+
+
+def _quantize(g, dtype):
+    """[..., bucket] f32 -> (payload, [...] f32 scales)."""
+    if dtype == "bf16":
+        return g.astype(jnp.bfloat16), jnp.ones(g.shape[:-1], jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(g), axis=-1) / _QMAX,
+                        jnp.float32(1e-30))
+    q = jnp.clip(jnp.round(g / scale[..., None]), -_QMAX, _QMAX)
+    return q.astype(jnp.int8), scale
+
+
+def _wire_dtype(dtype):
+    return "bfloat16" if dtype == "bf16" else "int8"
+
+
+def _quant_pack_infer(op, block):
+    x = block.var(op.input("X")[0])
+    out = block.var(op.output("Out")[0])
+    sc = block.var(op.output("Scale")[0])
+    n = max(int(op.attr("nranks") or 1), 1)
+    b = int(op.attr("bucket") or 512)
+    s = 1
+    for d in (x.shape or ()):
+        s *= d
+    nb = _ceil_div(_ceil_div(s, n), b)
+    out.shape = (n, nb, b)
+    out.dtype = _wire_dtype(op.attr("dtype"))
+    sc.shape = (n, nb)
+    sc.dtype = "float32"
+
+
+@register_op("c_quant_pack", inputs=("X",), outputs=("Out", "Scale"),
+             attrs={"ring_id": 0, "nranks": 1, "bucket": 512,
+                    "dtype": "int8"},
+             grad_maker=None, infer_shape=_quant_pack_infer)
+def c_quant_pack(ctx, x, ring_id=0, nranks=1, bucket=512, dtype="int8", **_):
+    g = _pack_chunks(x, nranks, bucket)
+    return _quantize(g, dtype)
+
+
+def _a2a_dequant_shard(ctx, q, scale, ring_id):
+    """all_to_all payload+scales, dequant, f32-accumulate this rank's
+    chunk: [n, nb, bucket] -> [nb, bucket].  axis-None (degenerate world)
+    keeps the rank-0-chunk convention of c_shard_slice."""
+    axis = _axis_for_ring(ctx, ring_id)
+    if axis is None:
+        return q[0].astype(jnp.float32) * scale[0][..., None]
+    q = lax.all_to_all(q, axis, split_axis=0, concat_axis=0, tiled=True)
+    scale = lax.all_to_all(scale, axis, split_axis=0, concat_axis=0,
+                           tiled=True)
+    return jnp.sum(q.astype(jnp.float32) * scale[..., None], axis=0)
+
+
+def _qsum_infer(op, block):
+    out = block.var(op.output("Out")[0])
+    out.shape = tuple(op.attr("orig_shape"))
+    if out.dtype is None:
+        out.dtype = "float32"
+
+
+def _rs_q_infer(op, block):
+    out = block.var(op.output("Out")[0])
+    orig = tuple(op.attr("orig_shape"))
+    n = max(int(op.attr("nranks") or 1), 1)
+    out.shape = (orig[0] // n,) + orig[1:]
+    if out.dtype is None:
+        out.dtype = "float32"
+
+
+@register_op("c_allreduce_qsum", inputs=("X", "Scale"), outputs=("Out",),
+             attrs={"ring_id": 0, "nranks": 1, "bucket": 512,
+                    "dtype": "int8", "scale": 1.0, "orig_shape": []},
+             grad_maker=None, infer_shape=_qsum_infer)
+def c_allreduce_qsum(ctx, q, qscale, ring_id=0, nranks=1, bucket=512,
+                     dtype="int8", scale=1.0, orig_shape=(), **_):
+    """Quantized sum-allreduce of the tensor c_quant_pack packed into (X,
+    Scale).  Out is the full f32 result (replicated-path form)."""
+    n = max(int(nranks), 1)
+    orig = tuple(orig_shape)
+    s = 1
+    for d in orig:
+        s *= d
+    chunk = _ceil_div(s, n)
+    shard = _a2a_dequant_shard(ctx, q, qscale, ring_id)  # [nb, bucket]
+    if scale != 1.0:
+        shard = shard * jnp.float32(scale)
+    axis = _axis_for_ring(ctx, ring_id)
+    if axis is None:
+        full = jnp.concatenate([shard[None]] * n, axis=0)  # degenerate
+    else:
+        # requantize the accumulated chunk so the gather phase is as
+        # narrow as the scatter phase
+        q2, s2 = _quantize(shard, dtype)
+        g2 = lax.all_gather(q2, axis, tiled=True)       # [n*nb, bucket]
+        sc2 = lax.all_gather(s2, axis, tiled=True)      # [n*nb]
+        full = (g2.astype(jnp.float32) * sc2[:, None]).reshape(n, -1)
+    flat = full.reshape(n, -1)[:, :chunk].reshape(-1)
+    return flat[:s].reshape(orig)
+
+
+@register_op("c_reducescatter_q", inputs=("X", "Scale"), outputs=("Out",),
+             attrs={"ring_id": 0, "nranks": 1, "bucket": 512,
+                    "dtype": "int8", "scale": 1.0, "orig_shape": []},
+             grad_maker=None, infer_shape=_rs_q_infer)
+def c_reducescatter_q(ctx, q, qscale, ring_id=0, nranks=1, bucket=512,
+                      dtype="int8", scale=1.0, orig_shape=(), **_):
+    """Quantized reduce-scatter: this rank's dim-0 shard of the f32 sum
+    (the ZeRO-1 gradient exchange).  Requires orig dim0 % nranks == 0 so
+    the chunk is exactly the shard — the transpiler guarantees it."""
+    n = max(int(nranks), 1)
+    orig = tuple(orig_shape)
+    chunk = 1
+    for d in (orig[0] // n,) + orig[1:]:
+        chunk *= d
+    shard = _a2a_dequant_shard(ctx, q, qscale, ring_id)  # [nb, bucket]
+    if scale != 1.0:
+        shard = shard * jnp.float32(scale)
+    return shard.reshape(-1)[:chunk].reshape((orig[0] // n,) + orig[1:])
+
+
+def _allgather_q_infer(op, block):
+    out = block.var(op.output("Out")[0])
+    out.shape = tuple(op.attr("orig_shape"))
+    if out.dtype is None:
+        out.dtype = "float32"
+
+
+@register_op("c_allgather_q", inputs=("X",), outputs=("Out",),
+             attrs={"ring_id": 0, "nranks": 1, "bucket": 512,
+                    "dtype": "int8", "orig_shape": []},
+             grad_maker=None, infer_shape=_allgather_q_infer)
+def c_allgather_q(ctx, x, ring_id=0, nranks=1, bucket=512, dtype="int8",
+                  orig_shape=(), **_):
+    """Quantized weight all-gather (ZeRO-1 param reassembly): each rank
+    bucket-quantizes its own updated f32 shard, gathers the narrow payload
+    + scales, dequantizes — then splices its OWN exact f32 shard back over
+    its block.  The master shard (what c_shard_slice hands the optimizer
+    next step) therefore never accumulates quantization error; only the
+    local replicas of OTHER ranks' blocks are lossy."""
+    n = max(int(nranks), 1)
+    orig = tuple(orig_shape)
+    axis = _axis_for_ring(ctx, ring_id)
+    if axis is None or n <= 1:
+        # degenerate world: replicate the exact shard, keep declared shape
+        return jnp.concatenate([x] * n, axis=0) if n > 1 else x
+    s = 1
+    for d in x.shape:
+        s *= d
+    b = max(1, min(int(bucket), s))
+    nb = _ceil_div(s, b)
+    flat = x.astype(jnp.float32).reshape(-1)
+    if nb * b != s:
+        flat = jnp.pad(flat, (0, nb * b - s))
+    q, sc = _quantize(flat.reshape(nb, b), dtype)
+    g = lax.all_gather(q, axis, tiled=True)          # [n*nb, b]
+    gs = lax.all_gather(sc, axis, tiled=True)        # [n*nb]
+    full = (g.astype(jnp.float32) * gs[:, None]).reshape(n, -1)
+    full = full[:, :s].reshape(orig)
+    shard_d0 = orig[0] // n
+    return lax.dynamic_update_slice_in_dim(
+        full, x.astype(jnp.float32), lax.axis_index(axis) * shard_d0, axis=0)
 
 
 @register_op("c_sync_calc_stream", inputs=("X",), outputs=("Out",),

@@ -47,6 +47,31 @@ __all__ = [
 ]
 
 
+# ZeRO-1 weight-update sharding support table (transpiler/collective.py
+# ShardedGradAllReduce): optimizer op types whose update is elementwise
+# over the param — a dim-0 shard of (Param, Grad, state) computes exactly
+# the shard of the full update, so each replica can own 1/nranks of the
+# rows.  Values are the param-shaped state slots (input names; the
+# matching *Out output slots alias the same vars).  Scalar state
+# (LearningRate, Beta*Pow) stays replicated.  lamb / lars_momentum /
+# dpsgd are deliberately absent: their updates take global norms (or
+# fresh noise) over the whole param, which a shard cannot reproduce.
+ZERO1_SHARDABLE_SLOTS = {
+    "sgd": (),
+    "momentum": (("Velocity", "VelocityOut"),),
+    "adam": (("Moment1", "Moment1Out"), ("Moment2", "Moment2Out")),
+    "adagrad": (("Moment", "MomentOut"),),
+    "adamax": (("Moment", "MomentOut"), ("InfNorm", "InfNormOut")),
+    "decayed_adagrad": (("Moment", "MomentOut"),),
+    "adadelta": (("AvgSquaredGrad", "AvgSquaredGradOut"),
+                 ("AvgSquaredUpdate", "AvgSquaredUpdateOut")),
+    "rmsprop": (("Moment", "MomentOut"), ("MeanSquare", "MeanSquareOut"),
+                ("MeanGrad", "MeanGradOut")),
+    "ftrl": (("SquaredAccumulator", "SquaredAccumOut"),
+             ("LinearAccumulator", "LinearAccumOut")),
+}
+
+
 class Optimizer:
     def __init__(self, learning_rate, regularization=None, name=None,
                  grad_clip=None):

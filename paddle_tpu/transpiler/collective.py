@@ -4,17 +4,83 @@ data-parallel program with explicit collectives.
 Port of python/paddle/fluid/transpiler/collective.py (Collective:36,
 GradAllReduce:178, LocalSGD:269).  The transpiled program carries
 c_gen_nccl_id/c_comm_init in startup (structural on TPU — the mesh is the
-communicator) and scale + c_allreduce_sum per gradient in main, keyed off
-the op_role_var {param, grad} annotations exactly like the reference; the
-executor runs such programs under shard_map with lax.psum as the allreduce.
+communicator) and a c_allreduce_sum per gradient in main (the 1/nranks
+averaging scale is folded into the reduce as a post-sum multiply — no
+standalone scale op), keyed off the op_role_var {param, grad} annotations
+exactly like the reference; the executor runs such programs under
+shard_map with lax.psum as the allreduce.
+
+Two extensions beyond the reference:
+
+* ShardedGradAllReduce (FLAGS_collective_mode=zero1) applies ZeRO-1
+  weight-update sharding (arXiv 2004.13336): per eligible gradient the
+  allreduce becomes a reduce-scatter, the optimizer op is rewired to
+  update only this rank's 1/nranks dim-0 shard of the param (its
+  param-shaped state slots shrink to the shard, cutting optimizer-state
+  HBM by nranks), and the updated shards are all-gathered back into the
+  replicated param after the last optimizer op.
+
+* FLAGS_allreduce_dtype=bf16|int8 (EQuARX, arXiv 2506.17615) swaps the
+  f32 gradient exchange for a quantized one: c_quant_pack buckets the
+  gradient with one f32 max-abs scale per (destination rank, bucket) and
+  c_allreduce_qsum / c_reducescatter_q move only the narrow payload +
+  scales over the wire.  f32 stays the bitwise-parity escape hatch.
+
+Every transpile stamps `_collective_meta` with the world it was built for
+plus the shard assignment and the analytic per-rank bytes-on-ICI per step
+(`wire_bytes_per_step`) — the verifier (DL005/DL006), the elastic
+re-quorum layer, telemetry, and bench.py's bytes-on-ICI column all read
+from it.
 """
 
+from ..flags import flag as _flag
 from ..framework import OP_ROLE_KEY, OP_ROLE_VAR_KEY, OpRole
 
-__all__ = ["Collective", "GradAllReduce", "LocalSGD"]
+__all__ = ["Collective", "GradAllReduce", "ShardedGradAllReduce",
+           "LocalSGD", "select_grad_transpiler"]
+
+# the mesh axis name the executor's SPMD path runs collectives over
+_DATA_AXIS = "data"
+_F32 = 4  # bytes
+
+
+def select_grad_transpiler(nrings=1):
+    """The gradient-exchange transpiler FLAGS_collective_mode selects —
+    the single switch shared by fleet's CollectiveOptimizer, the
+    DistributeTranspiler collective mode, and the elastic re-quorum
+    re-transpile (so a zero1 job re-shards for every new world)."""
+    mode = str(_flag("collective_mode") or "allreduce")
+    if mode == "zero1":
+        return ShardedGradAllReduce(nrings)
+    if mode != "allreduce":
+        raise ValueError("unknown FLAGS_collective_mode=%r "
+                         "(expected allreduce | zero1)" % mode)
+    return GradAllReduce(nrings)
+
+
+def _numel(shape):
+    n = 1
+    for d in (shape or ()):
+        n *= int(d)
+    return n
+
+
+def _static_shape(v):
+    return (v is not None and v.shape
+            and all(int(d) > 0 for d in v.shape))
+
+
+def _ceil_div(a, b):
+    return -(-a // b)
+
+
+def _payload_width(dtype):
+    return 1 if dtype == "int8" else 2  # bf16
 
 
 class Collective:
+    mode = "allreduce"
+
     def __init__(self, nrings=1):
         self.nrings = nrings
         self.endpoints = None
@@ -23,6 +89,9 @@ class Collective:
         self.nranks = 1
         self.main_program = None
         self.startup_program = None
+        # per-ring accumulated exchange bytes: rings are load-balanced by
+        # bytes, and sum(values) is the per-rank bytes-on-ICI per step
+        self._ring_bytes = [0.0]
 
     def transpile(self, startup_program, main_program, rank, endpoints,
                   current_endpoint, wait_port=True):
@@ -34,14 +103,41 @@ class Collective:
         self.endpoints = endpoints
         self.current_endpoint = current_endpoint
         self.nranks = len(endpoints)
+        self._ring_bytes = [0.0] * max(int(self.nrings), 1)
         self._transpile_startup_program()
         self._transpile_main_program()
-        # world-size provenance for the static verifier (DL005) and the
-        # elastic re-quorum layer: which cluster this program was built for
+        # world-size provenance for the static verifier (DL005/DL006) and
+        # the elastic re-quorum layer: which cluster this program was built
+        # for, how the update is sharded, and what one step costs on ICI
         meta = {"nranks": self.nranks, "rank": rank,
-                "endpoints": list(endpoints), "nrings": self.nrings}
+                "endpoints": list(endpoints), "nrings": self.nrings,
+                "mode": self.mode,
+                "allreduce_dtype": str(_flag("allreduce_dtype") or "f32"),
+                "wire_bytes_per_step": float(sum(self._ring_bytes))}
+        meta.update(self._meta_extra())
         main_program._collective_meta = dict(meta)
         startup_program._collective_meta = dict(meta)
+        self._record_telemetry(meta)
+
+    def _meta_extra(self):
+        return {}
+
+    def _record_telemetry(self, meta):
+        from ..core import telemetry as _tel
+
+        if not _tel.enabled():
+            return
+        _tel.set_gauge("collective_nranks", meta["nranks"])
+        _tel.set_gauge("collective_wire_bytes_per_step",
+                       meta["wire_bytes_per_step"])
+        shards = meta.get("zero1_shards")
+        if shards is not None:
+            sharded = [s for s in shards.values() if s["sharded"]]
+            _tel.set_gauge("zero1_sharded_params", len(sharded))
+            _tel.set_gauge("zero1_replicated_params",
+                           len(shards) - len(sharded))
+            _tel.set_gauge("zero1_shard_bytes_per_rank",
+                           sum(s["bytes_per_rank"] for s in sharded))
 
     # -- startup: communicator bootstrap ops (collective.py:99-131) ---------
     def _init_communicator(self, program, current_endpoint, endpoints, rank,
@@ -81,71 +177,358 @@ class Collective:
         role = op.attr(OP_ROLE_KEY)
         return role is not None and int(role) & OpRole.Optimize
 
+    def _pick_ring(self, nbytes):
+        """Least-loaded ring by accumulated bytes (balances multi-ring
+        setups by payload instead of blind round-robin)."""
+        ring = min(range(len(self._ring_bytes)),
+                   key=lambda r: self._ring_bytes[r])
+        self._ring_bytes[ring] += nbytes
+        return ring
 
-class GradAllReduce(Collective):
-    """Insert scale(1/nranks) + c_allreduce_sum per gradient between
-    backward and optimize (collective.py:178-266)."""
+    def _exchange_dtype(self, block, name):
+        """The wire dtype for one tensor: FLAGS_allreduce_dtype, demoted
+        to f32 when the tensor can't be quantized (non-f32 or dynamic
+        shape — the pack geometry needs static element counts)."""
+        dt = str(_flag("allreduce_dtype") or "f32")
+        if dt not in ("f32", "bf16", "int8"):
+            raise ValueError("unknown FLAGS_allreduce_dtype=%r "
+                             "(expected f32 | bf16 | int8)" % dt)
+        if dt == "f32":
+            return dt
+        v = block._find_var_recursive(name)
+        if not _static_shape(v) or v.dtype not in (None, "float32"):
+            return "f32"
+        return dt
 
-    def __init__(self, nrings=1):
-        super().__init__(nrings)
+    def _quant_geometry(self, numel, bucket):
+        """Clamp the bucket to the per-rank chunk so small tensors are not
+        padded out to a full bucket (the stamped attr is what the lowering
+        packs with, so wire accounting and payload stay consistent)."""
+        chunk = _ceil_div(numel, self.nranks)
+        bucket = max(1, min(int(bucket), chunk))
+        nb = _ceil_div(chunk, bucket)
+        return chunk, nb, bucket
 
-    def _transpile_main_program(self):
-        self._insert_scale_loss_grad_ops()
-        self._insert_allreduce_ops()
+    def _quant_wire_bytes(self, nb, bucket, dtype, phases):
+        """Per-rank wire bytes of `phases` quantized exchange phases (1 =
+        reduce-scatter-shaped all_to_all, 2 = + the requantized
+        all-gather): each phase moves (nranks-1) chunks of nb buckets of
+        payload plus one f32 scale per bucket."""
+        return float(phases * (self.nranks - 1)
+                     * nb * (bucket * _payload_width(dtype) + _F32))
 
-    def _insert_scale_loss_grad_ops(self):
-        block = self.main_program.global_block()
-        for idx, op in reversed(list(enumerate(block.ops))):
-            if self._is_loss_grad_op(op):
-                out = op.output_arg_names[0]
-                block._insert_op(
-                    idx + 1,
-                    type="scale",
-                    inputs={"X": [out]},
-                    outputs={"Out": [out]},
-                    attrs={"scale": 1.0 / self.nranks,
-                           OP_ROLE_KEY: OpRole.Backward},
-                )
-
-    def _is_loss_grad_op(self, op):
-        role = op.attr(OP_ROLE_KEY)
-        return role is not None and int(role) == (OpRole.Backward | OpRole.Loss)
-
-    def _insert_allreduce_ops(self):
-        block = self.main_program.global_block()
-        ring_id = -1
-        grads = []
-        first_optimize_idx = None
-        for idx, op in enumerate(block.ops):
-            if self._is_backward_op(op) and OP_ROLE_VAR_KEY in op.attrs:
-                rv = op.attrs[OP_ROLE_VAR_KEY]
-                if not rv:
-                    continue
-                assert len(rv) % 2 == 0
-                for i in range(1, len(rv), 2):
-                    grads.append(rv[i])
-            if first_optimize_idx is None and self._is_optimizer_op(op):
-                first_optimize_idx = idx
-        if first_optimize_idx is None:
-            first_optimize_idx = len(block.ops)
-        insert_at = first_optimize_idx
-        for i, grad in enumerate(dict.fromkeys(grads)):
-            ring_id = (ring_id + 1) % self.nrings
+    def _insert_grad_allreduce(self, block, insert_at, grad, fold):
+        """Replicated-path exchange of one gradient: grad := fold *
+        sum_ranks(grad), quantized per FLAGS_allreduce_dtype.  Returns the
+        next insert position."""
+        n = self.nranks
+        v = block._find_var_recursive(grad)
+        numel = _numel(v.shape) if _static_shape(v) else 0
+        dtype = self._exchange_dtype(block, grad)
+        if dtype == "f32":
+            ring = self._pick_ring(2.0 * (n - 1) / max(n, 1) * _F32 * numel)
             block._insert_op(
                 insert_at,
                 type="c_allreduce_sum",
                 inputs={"X": [grad]},
                 outputs={"Out": [grad]},
-                attrs={"ring_id": ring_id, OP_ROLE_KEY: OpRole.Backward},
+                attrs={"ring_id": ring, "scale": fold,
+                       OP_ROLE_KEY: OpRole.Backward},
+            )
+            return insert_at + 1
+        _chunk, nb, bucket = self._quant_geometry(
+            numel, _flag("allreduce_quant_bucket"))
+        ring = self._pick_ring(self._quant_wire_bytes(nb, bucket, dtype, 2))
+        pack, scale = self._quant_pack(block, insert_at, grad, ring, dtype,
+                                       bucket, nb)
+        block._insert_op(
+            insert_at + 1,
+            type="c_allreduce_qsum",
+            inputs={"X": [pack], "Scale": [scale]},
+            outputs={"Out": [grad]},
+            attrs={"ring_id": ring, "nranks": n, "bucket": bucket,
+                   "dtype": dtype, "scale": fold,
+                   "orig_shape": [int(d) for d in v.shape],
+                   OP_ROLE_KEY: OpRole.Backward},
+        )
+        return insert_at + 2
+
+    def _quant_pack(self, block, insert_at, grad, ring, dtype, bucket, nb):
+        n = self.nranks
+        wire = "bfloat16" if dtype == "bf16" else "int8"
+        pack = block.create_var(name=grad + "@QPACK",
+                                shape=(n, nb, bucket), dtype=wire)
+        scale = block.create_var(name=grad + "@QSCALE",
+                                 shape=(n, nb), dtype="float32")
+        block._insert_op(
+            insert_at,
+            type="c_quant_pack",
+            inputs={"X": [grad]},
+            outputs={"Out": [pack], "Scale": [scale]},
+            attrs={"ring_id": ring, "nranks": n, "bucket": bucket,
+                   "dtype": dtype, OP_ROLE_KEY: OpRole.Backward},
+        )
+        return pack.name, scale.name
+
+    def _collect_grad_pairs(self, block):
+        """(param, grad) pairs from the op_role_var annotations, dedup by
+        grad, backward order; plus the first optimizer op index."""
+        pairs, seen = [], set()
+        first_optimize_idx = None
+        for idx, op in enumerate(block.ops):
+            if self._is_backward_op(op) and OP_ROLE_VAR_KEY in op.attrs:
+                rv = op.attrs[OP_ROLE_VAR_KEY] or []
+                assert len(rv) % 2 == 0
+                for i in range(0, len(rv) - 1, 2):
+                    if rv[i + 1] not in seen:
+                        seen.add(rv[i + 1])
+                        pairs.append((rv[i], rv[i + 1]))
+            if first_optimize_idx is None and self._is_optimizer_op(op):
+                first_optimize_idx = idx
+        if first_optimize_idx is None:
+            first_optimize_idx = len(block.ops)
+        return pairs, first_optimize_idx
+
+
+class GradAllReduce(Collective):
+    """One folded-scale c_allreduce_sum (or quant_pack + qsum) per gradient
+    between backward and optimize (collective.py:178-266).  The reference's
+    standalone scale(1/nranks) on the loss grad is folded into the reduce
+    as a post-sum multiply — one op less per gradient, and bitwise-stable
+    parity between the replicated and ZeRO-1 paths (both scale after the
+    same psum-family reduction)."""
+
+    def _transpile_main_program(self):
+        block = self.main_program.global_block()
+        pairs, first_optimize_idx = self._collect_grad_pairs(block)
+        fold = 1.0 / self.nranks
+        insert_at = first_optimize_idx
+        for _param, grad in pairs:
+            insert_at = self._insert_grad_allreduce(block, insert_at, grad,
+                                                    fold)
+
+
+class ShardedGradAllReduce(Collective):
+    """ZeRO-1 weight-update sharding (arXiv 2004.13336).
+
+    Per eligible (param, grad): reduce-scatter the gradient (folding the
+    1/nranks average), slice this rank's dim-0 param shard, rewire the
+    optimizer op — including what FuseOptimizerOpsPass later folds into
+    fused_adam — onto the shards (its param-shaped state vars shrink to
+    the shard and carry a ("data", ...) sharding annotation, so each
+    replica holds 1/nranks of the optimizer state in HBM), and all-gather
+    the updated shards back into the replicated params after the last
+    optimizer op.  Ineligible pairs (dim0 not divisible by the world,
+    non-elementwise optimizers like lamb, grads with extra consumers such
+    as clip/regularizer chains) fall back per-param to the replicated
+    exchange, so one program may mix both forms.  Shard assignment is
+    dim-0 uniform — every rank owns exactly 1/nranks of each sharded
+    param's bytes, balanced by construction — and is stamped into
+    `_collective_meta["zero1_shards"]` for DL006 and the tools."""
+
+    mode = "zero1"
+
+    def __init__(self, nrings=1):
+        super().__init__(nrings)
+        self._shards = {}
+
+    def _meta_extra(self):
+        return {"zero1_shards": dict(self._shards)}
+
+    def _optimizer_ops_by_grad(self, block):
+        by_grad = {}
+        for op in block.ops:
+            if self._is_optimizer_op(op) and len(op.input("Grad")) == 1:
+                by_grad.setdefault(op.input("Grad")[0], []).append(op)
+        return by_grad
+
+    def _shardable(self, block, param, grad, opt_by_grad, slot_table):
+        """(ok, reason): can this (param, grad) take the sharded update?"""
+        n = self.nranks
+        pv = block._find_var_recursive(param)
+        gv = block._find_var_recursive(grad)
+        if not _static_shape(pv) or not _static_shape(gv):
+            return False, "dynamic shape"
+        if tuple(pv.shape) != tuple(gv.shape):
+            return False, "grad shape differs from param"
+        if gv.dtype not in (None, "float32"):
+            return False, "non-f32 grad"
+        d0 = int(pv.shape[0])
+        if d0 < n or d0 % n != 0:
+            return False, "dim0 %d not divisible by world %d" % (d0, n)
+        opts = opt_by_grad.get(grad, [])
+        if len(opts) != 1:
+            return False, "grad feeds %d optimizer ops" % len(opts)
+        op = opts[0]
+        if op.type not in slot_table:
+            return False, "optimizer %r is not elementwise" % op.type
+        if op.input("Param") != [param] or op.output("ParamOut") != [param]:
+            return False, "optimizer does not update %r in place" % param
+        for in_slot, out_slot in slot_table[op.type]:
+            names = op.input(in_slot)
+            if len(names) != 1 or op.output(out_slot) != names:
+                return False, "state slot %s is not in-place" % in_slot
+            sv = block._find_var_recursive(names[0])
+            if sv is None or tuple(sv.shape or ()) != tuple(pv.shape):
+                return False, "state %s is not param-shaped" % in_slot
+        # the exchanged grad must feed ONLY this optimizer op — an extra
+        # non-backward consumer (grad clip, regularizer accumulation,
+        # DGC...) would observe a shard where it expects the full tensor
+        for other in block.ops:
+            if other is op or self._is_backward_op(other):
+                continue
+            if grad in other.input_arg_names:
+                return False, "grad has non-optimizer consumer %r" % other.type
+        return True, "sharded"
+
+    def _transpile_main_program(self):
+        from ..optimizer import ZERO1_SHARDABLE_SLOTS
+
+        block = self.main_program.global_block()
+        n = self.nranks
+        pairs, first_optimize_idx = self._collect_grad_pairs(block)
+        opt_by_grad = self._optimizer_ops_by_grad(block)
+        fold = 1.0 / n
+        insert_at = first_optimize_idx
+        gathers = []  # (param, shard var, ring, bytes)
+        for param, grad in pairs:
+            pv = block._find_var_recursive(param)
+            ok, why = (False, "single-rank world") if n <= 1 else \
+                self._shardable(block, param, grad, opt_by_grad,
+                                ZERO1_SHARDABLE_SLOTS)
+            nbytes = _numel(pv.shape) * _F32 if _static_shape(pv) else 0
+            if not ok:
+                self._shards[param] = {"sharded": False, "reason": why,
+                                       "bytes_per_rank": nbytes}
+                insert_at = self._insert_grad_allreduce(block, insert_at,
+                                                        grad, fold)
+                continue
+            opt_op = opt_by_grad[grad][0]
+            shape = tuple(int(d) for d in pv.shape)
+            rows = shape[0] // n
+            shard_shape = (rows,) + shape[1:]
+            insert_at, gshard = self._insert_reduce_scatter(
+                block, insert_at, grad, shape, shard_shape, fold)
+            pshard = block.create_var(name=param + "@ZSHARD",
+                                      shape=shard_shape, dtype=pv.dtype)
+            # weight all-gather: quantized (ZeRO++-style, own-shard-exact)
+            # when FLAGS_allreduce_dtype is narrow, else plain f32
+            gdtype = self._exchange_dtype(block, param)
+            if gdtype == "f32":
+                gbucket = 0
+                gbytes = (n - 1) / n * _F32 * _numel(shape)
+            else:
+                _c, gnb, gbucket = self._quant_geometry(
+                    _numel(shape), _flag("allreduce_quant_bucket"))
+                gbytes = self._quant_wire_bytes(gnb, gbucket, gdtype, 1)
+            ring = self._pick_ring(gbytes)
+            block._insert_op(
+                insert_at,
+                type="c_shard_slice",
+                inputs={"X": [param]},
+                outputs={"Out": [pshard]},
+                attrs={"ring_id": ring, "nranks": n,
+                       OP_ROLE_KEY: OpRole.Optimize},
             )
             insert_at += 1
+            self._rewire_optimizer(block, opt_op, param, grad,
+                                   pshard.name, gshard,
+                                   ZERO1_SHARDABLE_SLOTS[opt_op.type],
+                                   shard_shape)
+            self._shards[param] = {
+                "sharded": True, "reason": "sharded", "dim0": shape[0],
+                "rows_per_rank": rows,
+                "bytes_per_rank": _numel(shard_shape) * _F32,
+            }
+            gathers.append((param, pshard.name, ring, gdtype, gbucket,
+                            shape))
+        # updated shards -> replicated params, after the LAST optimizer op
+        # (keeps the optimizer ops contiguous for FuseOptimizerOpsPass's
+        # hazard scan, and the params consistent before the next forward)
+        at = max((i for i, op in enumerate(block.ops)
+                  if self._is_optimizer_op(op)), default=len(block.ops) - 1)
+        at += 1
+        for param, pshard, ring, gdtype, gbucket, shape in gathers:
+            if gdtype == "f32":
+                block._insert_op(
+                    at,
+                    type="c_allgather",
+                    inputs={"X": [pshard]},
+                    outputs={"Out": [param]},
+                    attrs={"ring_id": ring, "nranks": n,
+                           OP_ROLE_KEY: OpRole.Optimize},
+                )
+            else:
+                block._insert_op(
+                    at,
+                    type="c_allgather_q",
+                    inputs={"X": [pshard]},
+                    outputs={"Out": [param]},
+                    attrs={"ring_id": ring, "nranks": n, "bucket": gbucket,
+                           "dtype": gdtype,
+                           "orig_shape": [int(d) for d in shape],
+                           OP_ROLE_KEY: OpRole.Optimize},
+                )
+            at += 1
+
+    def _insert_reduce_scatter(self, block, insert_at, grad, shape,
+                               shard_shape, fold):
+        """grad -> grad@ZSHARD := fold * reduce_scatter(grad); quantized
+        per FLAGS_allreduce_dtype.  Returns (next insert_at, shard name)."""
+        n = self.nranks
+        gshard = block.create_var(name=grad + "@ZSHARD", shape=shard_shape,
+                                  dtype="float32")
+        dtype = self._exchange_dtype(block, grad)
+        if dtype == "f32":
+            ring = self._pick_ring((n - 1) / n * _F32 * _numel(shape))
+            block._insert_op(
+                insert_at,
+                type="c_reducescatter",
+                inputs={"X": [grad]},
+                outputs={"Out": [gshard]},
+                attrs={"ring_id": ring, "nranks": n, "scale": fold,
+                       OP_ROLE_KEY: OpRole.Backward},
+            )
+            return insert_at + 1, gshard.name
+        _chunk, nb, bucket = self._quant_geometry(
+            _numel(shape), _flag("allreduce_quant_bucket"))
+        ring = self._pick_ring(self._quant_wire_bytes(nb, bucket, dtype, 1))
+        pack, scale = self._quant_pack(block, insert_at, grad, ring, dtype,
+                                       bucket, nb)
+        block._insert_op(
+            insert_at + 1,
+            type="c_reducescatter_q",
+            inputs={"X": [pack], "Scale": [scale]},
+            outputs={"Out": [gshard]},
+            attrs={"ring_id": ring, "nranks": n, "bucket": bucket,
+                   "dtype": dtype, "scale": fold,
+                   "orig_shape": [int(d) for d in shape],
+                   OP_ROLE_KEY: OpRole.Backward},
+        )
+        return insert_at + 2, gshard.name
+
+    def _rewire_optimizer(self, block, op, param, grad, pshard, gshard,
+                          slots, shard_shape):
+        """Point the update at the shards.  State vars KEEP their names —
+        the scope/checkpoints hold the full arrays and the executor's
+        sharding annotation (`Variable.sharding`) maps them onto the mesh
+        axis, so each replica materializes only its 1/nranks slice."""
+        op.inputs["Param"] = [pshard]
+        op.inputs["Grad"] = [gshard]
+        op.outputs["ParamOut"] = [pshard]
+        for in_slot, _out_slot in slots:
+            sv = block.var(op.input(in_slot)[0])
+            sv.shape = tuple(shard_shape)
+            sv.sharding = (_DATA_AXIS,) + (None,) * (len(shard_shape) - 1)
+        self.main_program._bump_version()
 
 
 class LocalSGD(Collective):
     """Local steps + periodic parameter averaging via snapshot diff allreduce
     (collective.py:269-372).  Simplified to every-step averaging of params
     after the optimizer (K=1); the reference's K-step schedule needs
-    program-level conditionals, provided via layers.cond later."""
+    program-level conditionals, provided via layers.cond later.  The
+    1/nranks averaging scale rides the allreduce's folded scale attr."""
 
     def __init__(self, nrings=1):
         super().__init__(nrings)
@@ -153,25 +536,21 @@ class LocalSGD(Collective):
 
     def _transpile_main_program(self):
         block = self.main_program.global_block()
-        ring_id = -1
         params = []
         for op in block.ops:
             if self._is_optimizer_op(op) and OP_ROLE_VAR_KEY in op.attrs:
                 rv = op.attrs[OP_ROLE_VAR_KEY]
                 for i in range(0, len(rv), 2):
                     params.append(rv[i])
+        n = self.nranks
         for param in dict.fromkeys(params):
-            ring_id = (ring_id + 1) % self.nrings
-            block.append_op(
-                type="scale",
-                inputs={"X": [param]},
-                outputs={"Out": [param]},
-                attrs={"scale": 1.0 / self.nranks,
-                       OP_ROLE_KEY: OpRole.Optimize},
-            )
+            v = block._find_var_recursive(param)
+            numel = _numel(v.shape) if _static_shape(v) else 0
+            ring = self._pick_ring(2.0 * (n - 1) / max(n, 1) * _F32 * numel)
             block.append_op(
                 type="c_allreduce_sum",
                 inputs={"X": [param]},
                 outputs={"Out": [param]},
-                attrs={"ring_id": ring_id, OP_ROLE_KEY: OpRole.Optimize},
+                attrs={"ring_id": ring, "scale": 1.0 / n,
+                       OP_ROLE_KEY: OpRole.Optimize},
             )

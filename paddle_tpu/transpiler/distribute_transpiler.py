@@ -93,9 +93,10 @@ class DistributeTranspiler:
 
         if self.config.mode in ("nccl2", "collective"):
             # collective modes delegate to the Collective transpilers
-            from .collective import GradAllReduce
+            # (FLAGS_collective_mode picks replicated vs ZeRO-1 sharded)
+            from .collective import select_grad_transpiler
 
-            t = GradAllReduce(self.config.nccl_comm_num)
+            t = select_grad_transpiler(self.config.nccl_comm_num)
             eps = ["%d" % i for i in range(trainers)]
             t.transpile(self.startup_program, self.program, trainer_id, eps,
                         "%d" % trainer_id)

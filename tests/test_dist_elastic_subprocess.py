@@ -233,22 +233,29 @@ def _transpiled_pair(nranks=3):
 
 
 def test_dl005_stale_gradient_scale_is_flagged():
+    # the 1/nranks gradient average now rides the reduce op's own `scale`
+    # attr (no standalone scale op exists to pin) — DL005's folded-form
+    # check must flag the c_allreduce_sum ops whose fold disagrees with
+    # the expected world
     from paddle_tpu.core import analysis
 
     main, _startup, loss = _transpiled_pair(nranks=3)
+    blk = main.global_block()
+    assert not [op for op in blk.ops if op.type == "scale"
+                and op.input_arg_names == op.output_arg_names], \
+        "standalone per-grad scale ops should be folded away"
     rep = analysis.verify_program(main, feed_names=["x", "y"],
                                   fetch_names=[loss.name],
                                   expected_nranks=2)
     errs = [d for d in rep.errors if d.rule == "DL005"]
     assert errs, rep.format()
-    # one of them pins the exact in-place 1/nranks scale op
-    blk = main.global_block()
-    scale_idx = [i for i, op in enumerate(blk.ops)
-                 if op.type == "scale"
-                 and op.input_arg_names == op.output_arg_names]
-    assert scale_idx, [op.type for op in blk.ops]
-    assert any(d.op_idx in scale_idx for d in errs), \
-        (scale_idx, [(d.op_idx, d.message) for d in errs])
+    # one of them pins an all-reduce carrying the stale 1/3 fold
+    ar_idx = [i for i, op in enumerate(blk.ops)
+              if op.type == "c_allreduce_sum"
+              and abs(float(op.attr("scale")) - 1.0 / 3) < 1e-7]
+    assert ar_idx, [op.type for op in blk.ops]
+    assert any(d.op_idx in ar_idx for d in errs), \
+        (ar_idx, [(d.op_idx, d.message) for d in errs])
 
 
 def test_dl005_c_comm_init_nranks_is_flagged():
@@ -275,3 +282,134 @@ def test_dl005_matching_world_is_clean():
                                       expected_nranks=3)
         assert not [d for d in rep.errors if d.rule == "DL005"], \
             rep.format()
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-1 x elastic: a re-quorum re-shards optimizer state for the new world
+# (distributed/elastic._adopt re-runs select_grad_transpiler over pristine
+# program clones), and shard-local slots restore from the FULL checkpoint
+# (the scope always holds global arrays; the executor's sharding annotation
+# re-slices them onto whatever mesh the new world compiles).
+
+
+def _zero1_pair(nranks):
+    import paddle_tpu as fluid
+    from paddle_tpu.transpiler.collective import ShardedGradAllReduce
+
+    with fluid.unique_name.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 11
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[4])
+            y = fluid.layers.data("y", shape=[1])
+            h = fluid.layers.fc(x, 8, act="relu",
+                                param_attr=fluid.ParamAttr(name="z1_w1"),
+                                bias_attr=fluid.ParamAttr(name="z1_b1"))
+            pred = fluid.layers.fc(h, 1,
+                                   param_attr=fluid.ParamAttr(name="z1_w2"),
+                                   bias_attr=fluid.ParamAttr(name="z1_b2"))
+            loss = fluid.layers.mean(fluid.layers.square(pred - y))
+            fluid.optimizer.Adam(1e-2).minimize(loss)
+    eps = ["127.0.0.1:%d" % (6170 + i) for i in range(nranks)]
+    ShardedGradAllReduce().transpile(
+        startup_program=startup, main_program=main, rank=0, endpoints=eps,
+        current_endpoint=eps[0], wait_port=False)
+    return main, startup, loss
+
+
+def _adam_slot_shapes(main, param_shard):
+    blk = main.global_block()
+    out = {}
+    for op in blk.ops:
+        if op.type == "adam" and param_shard in op.input("Param"):
+            for slot in ("Moment1", "Moment2"):
+                v = blk._find_var_recursive(op.input(slot)[0])
+                out[slot] = tuple(v.shape)
+    return out
+
+
+def test_zero1_requorum_reshards_optimizer_state():
+    from paddle_tpu.core import analysis
+
+    # world 4: z1_w1 (4x8) shards to 1 row/rank, slots carry LOCAL shapes
+    main4, _s4, loss4 = _zero1_pair(4)
+    meta = main4._collective_meta
+    assert meta["mode"] == "zero1" and meta["nranks"] == 4
+    assert meta["zero1_shards"]["z1_w1"]["sharded"]
+    assert meta["zero1_shards"]["z1_w1"]["rows_per_rank"] == 1
+    assert _adam_slot_shapes(main4, "z1_w1@ZSHARD") == {
+        "Moment1": (1, 8), "Moment2": (1, 8)}
+    rep = analysis.verify_program(main4, feed_names=["x", "y"],
+                                  fetch_names=[loss4.name],
+                                  expected_nranks=4)
+    assert not [d for d in rep.errors if d.rule in ("DL005", "DL006")], \
+        rep.format()
+
+    # the old-world program against the re-quorumed 2-world: stale fold
+    # (DL005) AND stale shard geometry (DL006) must both fire
+    rep = analysis.verify_program(main4, feed_names=["x", "y"],
+                                  fetch_names=[loss4.name],
+                                  expected_nranks=2)
+    rules = {d.rule for d in rep.errors}
+    assert "DL005" in rules and "DL006" in rules, rep.format()
+
+    # what _adopt does: re-transpile pristine programs at the new world —
+    # the SAME params now shard 2 rows/rank and verify clean
+    main2, _s2, loss2 = _zero1_pair(2)
+    assert main2._collective_meta["nranks"] == 2
+    assert main2._collective_meta["zero1_shards"]["z1_w1"][
+        "rows_per_rank"] == 2
+    assert _adam_slot_shapes(main2, "z1_w1@ZSHARD") == {
+        "Moment1": (2, 8), "Moment2": (2, 8)}
+    rep = analysis.verify_program(main2, feed_names=["x", "y"],
+                                  fetch_names=[loss2.name],
+                                  expected_nranks=2)
+    assert not [d for d in rep.errors if d.rule in ("DL005", "DL006")], \
+        rep.format()
+
+
+def test_zero1_shard_slots_restore_from_full_checkpoint(tmp_path):
+    # save at step 3 of a world-8 ZeRO-1 run, restore into a FRESH build +
+    # scope, continue: the trajectory must match an uninterrupted run
+    # exactly (f32 path is deterministic) — proving the shard-local adam
+    # moments rematerialize from the full checkpoint arrays
+    import numpy as np
+
+    import paddle_tpu as fluid
+
+    ckpt = str(tmp_path / "z1ckpt")
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    def data(i):
+        rng = np.random.RandomState(300 + i)
+        x = rng.randn(16, 4).astype("f")
+        w = np.linspace(-1, 1, 4).astype("f").reshape(4, 1)
+        return x, (x @ w).astype("f")
+
+    def steps(main, loss, lo, hi):
+        out = []
+        for i in range(lo, hi):
+            xb, yb = data(i)
+            lv, = exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+            out.append(float(np.asarray(lv).reshape(-1)[0]))
+        return out
+
+    main, startup, loss = _zero1_pair(8)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        full = steps(main, loss, 0, 6)
+
+    main2, startup2, loss2 = _zero1_pair(8)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup2)
+        part1 = steps(main2, loss2, 0, 3)
+        fluid.io.save_persistables(exe, ckpt, main_program=main2)
+
+    main3, startup3, loss3 = _zero1_pair(8)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup3)
+        fluid.io.load_persistables(exe, ckpt, main_program=main3)
+        part2 = steps(main3, loss3, 3, 6)
+
+    assert part1 == full[:3], (part1, full)
+    assert part2 == full[3:], (part2, full)

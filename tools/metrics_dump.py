@@ -84,6 +84,10 @@ def main(argv=None):
                     help="show only the elastic re-quorum health metrics "
                     "(elastic_epoch/world gauges, eviction/rejoin "
                     "counters, re-quorum duration histogram)")
+    ap.add_argument("--collective", action="store_true",
+                    help="show only the collective-exchange metrics "
+                    "(collective_nranks/wire_bytes gauges+counters and "
+                    "the zero1_* shard accounting)")
     args = ap.parse_args(argv)
 
     if args.json_path:
@@ -96,6 +100,9 @@ def main(argv=None):
 
     if args.elastic:
         snap = _filter_snap(snap, "elastic_")
+    if args.collective:
+        # str.startswith takes a tuple: both metric families in one pass
+        snap = _filter_snap(snap, ("collective_", "zero1_"))
 
     if args.raw:
         json.dump(snap, sys.stdout, indent=1)

@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI harness (reference paddle/scripts/paddle_build.sh analog): build the
 # native pieces, run the full test pyramid, smoke the bench + graft entry.
-# Usage: tools/run_ci.sh [quick|full|tpu|--layout-smoke|--obs-smoke|--lint|--elastic-smoke]
+# Usage: tools/run_ci.sh [quick|full|tpu|--layout-smoke|--obs-smoke|--lint|--elastic-smoke|--zero1-smoke]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -29,6 +29,20 @@ if [ "$MODE" = "--elastic-smoke" ]; then
   JAX_PLATFORMS=cpu FLAGS_static_check=error \
     python -m pytest tests/test_dist_elastic_subprocess.py -q
   echo "CI --elastic-smoke: PASS"
+  exit 0
+fi
+
+if [ "$MODE" = "--zero1-smoke" ]; then
+  # ZeRO-1 + quantized-allreduce leg: the sharding/parity/DL006 unit
+  # tests, then an 8-device dryrun of the sharded int8 path with the
+  # static verifier in error mode (a stale shard table or drifted
+  # dequant scale kills the run instead of limping into XLA)
+  echo "== zero1 smoke: sharding + quantized allreduce tests =="
+  JAX_PLATFORMS=cpu python -m pytest tests/test_zero1_sharding.py -q
+  echo "== zero1 smoke: 8-device int8 sharded dryrun =="
+  JAX_PLATFORMS=cpu FLAGS_static_check=error FLAGS_collective_mode=zero1 \
+    FLAGS_allreduce_dtype=int8 python tools/zero1_smoke.py
+  echo "CI --zero1-smoke: PASS"
   exit 0
 fi
 

@@ -1,0 +1,94 @@
+"""ZeRO-1 dryrun payload for the `tools/run_ci.sh --zero1-smoke` leg.
+
+Builds a small fc+Adam model, transpiles it through select_grad_transpiler
+(honoring FLAGS_collective_mode / FLAGS_allreduce_dtype from the
+environment), verifies it (the CI leg exports FLAGS_static_check=error so
+any DL005/DL006 diagnostic is fatal), runs a few steps over the virtual
+8-device mesh, and prints the shard table + analytic wire bytes.  Exits
+non-zero if the sharded run diverges or no param actually sharded.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as fluid  # noqa: E402
+from paddle_tpu import flags  # noqa: E402
+from paddle_tpu.core import analysis  # noqa: E402
+from paddle_tpu.transpiler.collective import \
+    select_grad_transpiler  # noqa: E402
+
+NRANKS = 8
+STEPS = 3
+
+
+def main():
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup.random_seed = 7
+    with fluid.program_guard(main_p, startup):
+        x = fluid.layers.data("x", shape=[16])
+        y = fluid.layers.data("y", shape=[1])
+        h = fluid.layers.fc(x, 64, act="relu")
+        pred = fluid.layers.fc(h, 1)
+        loss = fluid.layers.mean(fluid.layers.square(pred - y))
+        fluid.optimizer.Adam(0.01).minimize(loss)
+
+    eps = ["local:%d" % i for i in range(NRANKS)]
+    t = select_grad_transpiler()
+    t.transpile(startup_program=startup, main_program=main_p, rank=0,
+                endpoints=eps, current_endpoint=eps[0], wait_port=False)
+    meta = main_p._collective_meta
+    print("zero1_smoke: mode=%s dtype=%s wire_bytes_per_step=%.0f"
+          % (meta["mode"], meta["allreduce_dtype"],
+             meta["wire_bytes_per_step"]))
+
+    # explicit verify on top of the FLAGS_static_check gate, so the smoke
+    # fails loudly even when the env forgot to export the flag
+    rep = analysis.verify_program(main_p, feed_names=["x", "y"],
+                                  fetch_names=[loss.name],
+                                  expected_nranks=NRANKS)
+    if rep.errors:
+        print(rep.format())
+        return 1
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for i in range(STEPS):
+            xb = rng.randn(16, 16).astype(np.float32)
+            yb = rng.randn(16, 1).astype(np.float32)
+            lv, = exe.run(main_p, feed={"x": xb, "y": yb},
+                          fetch_list=[loss.name])
+            val = float(np.asarray(lv).reshape(-1)[0])
+            print("zero1_smoke: step=%d loss=%.6f" % (i, val))
+            if not np.isfinite(val):
+                print("zero1_smoke: FAIL (non-finite loss)")
+                return 1
+
+    shards = meta.get("zero1_shards")
+    if flags.flag("collective_mode") == "zero1":
+        if not shards or not any(e["sharded"] for e in shards.values()):
+            print("zero1_smoke: FAIL (nothing sharded)")
+            return 1
+        for p, e in sorted(shards.items()):
+            print("zero1_smoke: shard %-24s %s" % (
+                p, "rows/rank=%d bytes/rank=%d" % (
+                    e["rows_per_rank"], e["bytes_per_rank"])
+                if e["sharded"] else "replicated (%s)" % e["reason"]))
+    print("zero1_smoke: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
