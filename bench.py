@@ -51,6 +51,24 @@ def _device():
     return fluid.TPUPlace(0).jax_device()
 
 
+# executor_cache_miss_total delta across the TIMED region of the last
+# _timed_loop (post-warmup).  This is the BENCH "recompiles" number: any
+# miss after warmup means an executable was built inside the timed window
+# and the median is invalid (BASELINE.md round-8 protocol).  A second
+# same-process run of a config trivially reports 0 — the in-memory cache
+# serves every step — and with FLAGS_compile_cache_dir armed a second
+# PROCESS reports compile_ms_cold ~0 as well (tier-B restore).
+_TIMED_RECOMPILES = None
+
+
+def _miss_total():
+    try:
+        from paddle_tpu import telemetry
+        return int(telemetry.counter_total("executor_cache_miss_total"))
+    except Exception:
+        return 0
+
+
 def _telemetry_stats():
     """Step stats from the runtime metrics registry (core/telemetry.py).
 
@@ -61,7 +79,13 @@ def _telemetry_stats():
     says how much was spent compiling, whether anything RECOMPILED
     mid-run (a recompile inside the timed region invalidates the median),
     and what the per-step distribution looked like.  Empty when
-    FLAGS_telemetry is off."""
+    FLAGS_telemetry is off.
+
+    Compile latency splits two ways (the persistent-cache story):
+    ``compile_ms_cold`` is real trace+lower+XLA time paid this process;
+    ``compile_ms_warm`` is tier-B disk-restore time.  A cold process
+    reports (cold>0, warm=0); re-running the same config against the same
+    FLAGS_compile_cache_dir flips it to (cold~0, warm=restore-ms)."""
     try:
         from paddle_tpu import telemetry
     except Exception:
@@ -70,8 +94,15 @@ def _telemetry_stats():
         return {}
     snap = telemetry.snapshot()
     hists = snap.get("histograms", {})
-    out = {"recompiles": int(
-        telemetry.counter_total("executor_cache_miss_total"))}
+    out = {"recompiles": int(_TIMED_RECOMPILES
+                             if _TIMED_RECOMPILES is not None
+                             else telemetry.counter_total(
+                                 "executor_cache_miss_total"))}
+    cold = sum(hists.get(k, {}).get("sum", 0.0)
+               for k in ("executor_trace_lower_ms", "executor_xla_compile_ms"))
+    warm = hists.get("compile_cache_load_ms", {}).get("sum", 0.0)
+    out["compile_ms_cold"] = round(cold, 1)
+    out["compile_ms_warm"] = round(warm, 1)
     comp = hists.get("executor_compile_ms")
     if comp:
         out["compile_ms"] = round(comp["sum"], 1)
@@ -99,6 +130,7 @@ def _timed_loop(run_step, sync, warmup, iters, chunk=None):
         out = run_step()
     if out is not None:
         sync(out)
+    miss0 = _miss_total()
     times = []
     for _ in range(max(iters // chunk, 1)):
         t0 = time.perf_counter()
@@ -106,6 +138,8 @@ def _timed_loop(run_step, sync, warmup, iters, chunk=None):
             out = run_step()
         sync(out)
         times.append((time.perf_counter() - t0) / chunk)
+    global _TIMED_RECOMPILES
+    _TIMED_RECOMPILES = _miss_total() - miss0
     return float(np.median(times)), out
 
 
@@ -545,6 +579,18 @@ def main():
     # OOM-retry subprocesses).  BENCH_TELEMETRY=0 opts out.
     if os.environ.get("BENCH_TELEMETRY", "1") == "1":
         os.environ.setdefault("FLAGS_telemetry", "1")
+    # persistent two-tier compilation cache (core/compile_cache.py): on by
+    # default so a repeat of the same config pays compile_ms_cold ~0 —
+    # restore from disk instead of XLA.  BENCH_COMPILE_CACHE=<dir> picks
+    # the location, ="" disables; env (not set_flags) so the bench_bert
+    # OOM-retry subprocesses share it.
+    cc_dir = os.environ.get("BENCH_COMPILE_CACHE")
+    if cc_dir is None:
+        import tempfile
+
+        cc_dir = os.path.join(tempfile.gettempdir(), "paddle_tpu_bench_cc")
+    if cc_dir:
+        os.environ.setdefault("FLAGS_compile_cache_dir", cc_dir)
     cfg = os.environ.get("BENCH_CONFIG", "resnet50")
     iters = int(os.environ.get("BENCH_ITERS", "60"))
     if cfg == "bert":

@@ -9,6 +9,7 @@ the updated values are stored back.  Data-parallel / sharded execution reuses
 the same path with a `jax.sharding.Mesh` (see paddle_tpu.compiler).
 """
 
+import logging
 import time
 
 import numpy as np
@@ -113,13 +114,38 @@ def _with_seed_counter(fn):
 
 
 class _CompiledPlan:
-    __slots__ = ("plan", "jfn", "mesh", "data_axis")
+    """One cache entry.  ``jfn`` is what run() calls: normally an
+    AOT-``Compiled`` executable (eager compile on the miss path, possibly
+    deserialized from the tier-B disk cache), or the lazy ``jax.jit``
+    wrapper when the eager path had to fall back.  ``jit_fn`` keeps the
+    jit wrapper either way for tools that need ``.lower()`` (hbm audit)."""
 
-    def __init__(self, plan, jfn, mesh=None, data_axis=None):
+    __slots__ = ("plan", "jfn", "mesh", "data_axis", "jit_fn")
+
+    def __init__(self, plan, jfn, mesh=None, data_axis=None, jit_fn=None):
         self.plan = plan
         self.jfn = jfn
         self.mesh = mesh
         self.data_axis = data_axis
+        self.jit_fn = jit_fn if jit_fn is not None else jfn
+
+
+class _BuildResult:
+    """Stage-1 compile product: the BlockPlan plus the raw python callable
+    and jit parameters — everything needed to gather/shard inputs and then
+    trace, without having traced anything yet."""
+
+    __slots__ = ("plan", "fn", "donate", "mesh", "data_axis",
+                 "out_shardings")
+
+    def __init__(self, plan, fn, donate, mesh=None, data_axis=None,
+                 out_shardings=None):
+        self.plan = plan
+        self.fn = fn
+        self.donate = donate
+        self.mesh = mesh
+        self.data_axis = data_axis
+        self.out_shardings = out_shardings
 
 
 class Executor:
@@ -264,6 +290,7 @@ class Executor:
         tel = _telemetry.enabled()
         entry = self._cache.get(key) if use_program_cache else None
         cache_hit = entry is not None
+        build = None
         build_s = 0.0
         if entry is None:
             # static verifier runs only on the compile path (cache misses),
@@ -271,18 +298,24 @@ class Executor:
             # steady-state steps never pay for it, and FLAGS_static_check=
             # off is a single flag read
             from .analysis import check_before_compile
+            from . import compile_cache as _cc
 
+            _cc.enable_xla_cache()
             check_before_compile(program, list(feed_arrays), fetch_names,
                                  scope=scope)
             t_build = time.perf_counter()
-            entry = self._compile(program, list(feed_arrays), fetch_names, mesh, data_axis)
+            build = self._build(program, list(feed_arrays), fetch_names,
+                                mesh, data_axis)
             build_s = time.perf_counter() - t_build
-            if use_program_cache:
-                self._cache[key] = entry
-        plan = entry.plan
-        if entry.mesh is not None and mesh is None:
-            mesh = entry.mesh
-            data_axis = entry.data_axis
+            plan = build.plan
+            if build.mesh is not None and mesh is None:
+                mesh = build.mesh
+                data_axis = build.data_axis
+        else:
+            plan = entry.plan
+            if entry.mesh is not None and mesh is None:
+                mesh = entry.mesh
+                data_axis = entry.data_axis
 
         # gather params from scope
         params_ro, params_rw = {}, {}
@@ -317,6 +350,19 @@ class Executor:
             params_rw = self._shard_params(params_rw, mesh, block)
 
         dev = self._jax_device(mesh)
+        cstats = None
+        if entry is None:
+            # eager AOT compile (or tier-B cache restore) with the real
+            # first-step inputs — shapes, dtypes AND shardings are exactly
+            # what every subsequent call passes, and compile_ms stops being
+            # conflated with the first step's wall time
+            disk_key = self._disk_key(program, plan, feed_arrays,
+                                      fetch_names, trace_flags, mesh, dev)
+            entry, cstats = self._finalize_compile(
+                build, feed_arrays, params_ro, params_rw, params_carry,
+                rng, disk_key, dev)
+            if use_program_cache:
+                self._cache[key] = entry
         ctx = jax.default_device(dev) if dev is not None else contextlib.nullcontext()
         from ..profiler import RecordEvent
 
@@ -354,11 +400,19 @@ class Executor:
             step_ms = (time.perf_counter() - t_step) * 1e3
             fetch_bytes = sum(int(getattr(f, "nbytes", 0)) for f in fetches)
             no_donate = getattr(program, "_no_donate", False)
+            if cache_hit:
+                compile_ms = None
+            elif cstats is not None and cstats["source"] != "fallback":
+                # eager AOT path: plan build + trace/lower + XLA compile
+                # (or tier-B deserialize) — measured apart from the step
+                compile_ms = build_s * 1e3 + cstats["compile_ms"]
+            else:
+                # lazy fallback: jit compiles inside the first call, so the
+                # pre-PR conflation is the honest number
+                compile_ms = build_s * 1e3 + step_ms
             _telemetry.record_step(
                 step_ms, cache_hit,
-                # a cache miss pays plan/trace build + the first call's XLA
-                # compile (jit compiles lazily inside that call)
-                compile_ms=None if cache_hit else (build_s * 1e3 + step_ms),
+                compile_ms=compile_ms,
                 donated=0 if no_donate else
                 len(params_rw) + len(params_carry),
                 feed_bytes=feed_bytes, fetch_bytes=fetch_bytes,
@@ -479,7 +533,13 @@ class Executor:
             converts += 1
         return out, hits, converts
 
-    def _compile(self, program, feed_names, fetch_names, mesh, data_axis):
+    def _build(self, program, feed_names, fetch_names, mesh, data_axis,
+               devices=None):
+        """Stage 1 of a compile: BlockPlan + raw callable + jit params.
+        No tracing happens here — run()/warmup() gather and shard the real
+        inputs first, then _finalize_compile traces with them.  ``devices``
+        overrides the SPMD mesh's device list (elastic standby pre-compiles
+        a smaller world over a device prefix of the current backend)."""
         from .lowering import build_spmd_block_fn, has_collective_ops
 
         from .. import flags as _flags
@@ -512,7 +572,8 @@ class Executor:
             # with a real — if degenerate — allreduce.
             from jax.sharding import Mesh
 
-            mesh = Mesh(np.array(jax.devices()), ("data",))
+            devs = list(devices) if devices is not None else jax.devices()
+            mesh = Mesh(np.array(devs), ("data",))
             sfn = build_spmd_block_fn(plan, mesh, axis="data")
 
             def fn5(feeds, params_ro, params_rw, params_carry, key,
@@ -520,21 +581,305 @@ class Executor:
                 fetches, updated = _sfn(feeds, params_ro, params_rw, key)
                 return fetches, updated, {}
 
-            jfn = jax.jit(_with_seed_counter(fn5), donate_argnums=donate)
-            return _CompiledPlan(plan, jfn, mesh, "data")
+            return _BuildResult(plan, _with_seed_counter(fn5), donate,
+                                mesh, "data")
         fn = _with_seed_counter(build_block_fn(plan, mesh=mesh))
         if mesh is None:
-            jfn = jax.jit(fn, donate_argnums=donate)
-        else:
-            from jax.sharding import NamedSharding, PartitionSpec as P
+            return _BuildResult(plan, fn, donate)
+        from jax.sharding import NamedSharding, PartitionSpec as P
 
-            replicated = NamedSharding(mesh, P())
-            out_shardings = ([replicated] * len(fetch_names),
-                             {n: self._param_sharding(mesh, block, n)
-                              for n in plan.persist_written},
-                             {})
-            jfn = jax.jit(fn, donate_argnums=donate, out_shardings=out_shardings)
-        return _CompiledPlan(plan, jfn)
+        replicated = NamedSharding(mesh, P())
+        out_shardings = ([replicated] * len(fetch_names),
+                         {n: self._param_sharding(mesh, block, n)
+                          for n in plan.persist_written},
+                         {})
+        return _BuildResult(plan, fn, donate, out_shardings=out_shardings)
+
+    def _disk_key(self, program, plan, feed_arrays, fetch_names, trace_flags,
+                  mesh, dev):
+        """Tier-B content key for this executable, or None when the disk
+        cache is off (or the key can't be derived — never fatal)."""
+        from . import compile_cache as _cc
+
+        if not _cc.enabled():
+            return None
+        try:
+            feed_sig = sorted((n, tuple(a.shape), str(a.dtype))
+                              for n, a in feed_arrays.items())
+            mesh_sig = None
+            if mesh is not None:
+                # axis names/sizes only: device ids are reassigned when the
+                # backend re-initializes (elastic), and must not split keys
+                mesh_sig = [[str(k), int(v)] for k, v in mesh.shape.items()]
+            extra = {
+                "donate": not getattr(program, "_no_donate", False),
+                "dev": str(dev) if dev is not None else None,
+                "carry": sorted(getattr(plan, "carry_names", None) or ()),
+            }
+            return _cc.artifact_key(program, feed_sig, fetch_names,
+                                    trace_flags, mesh_sig=mesh_sig,
+                                    extra=extra)
+        except Exception as e:
+            logging.warning("compile_cache: key derivation failed: %s", e)
+            return None
+
+    def _finalize_compile(self, build, feeds, params_ro, params_rw,
+                          params_carry, rng, disk_key, dev):
+        """Stage 2: produce the executable for already-gathered inputs.
+        Order: tier-B disk restore -> eager jit(...).lower(...).compile()
+        (serialized back to disk) -> lazy jit fallback if either explodes.
+        Returns (entry, {"source", "compile_ms"})."""
+        from . import compile_cache as _cc
+
+        def mkctx():
+            # jax.default_device is a single-use context manager
+            return (jax.default_device(dev) if dev is not None
+                    else contextlib.nullcontext())
+
+        if build.out_shardings is not None:
+            jfn = jax.jit(build.fn, donate_argnums=build.donate,
+                          out_shardings=build.out_shardings)
+        else:
+            jfn = jax.jit(build.fn, donate_argnums=build.donate)
+        tel = _telemetry.enabled()
+        cstats = {"source": "fallback", "compile_ms": 0.0}
+        compiled = None
+        t0 = time.perf_counter()
+        if disk_key is not None:
+            got = _cc.load(disk_key)
+            if got is not None:
+                try:
+                    from jax.experimental import serialize_executable as _se
+
+                    with mkctx():
+                        compiled = _se.deserialize_and_load(
+                            got["payload"], got["in_tree"], got["out_tree"])
+                    cstats["source"] = "disk"
+                    if tel:
+                        _telemetry.observe(
+                            "compile_cache_load_ms",
+                            (time.perf_counter() - t0) * 1e3)
+                except Exception as e:
+                    compiled = None
+                    logging.warning(
+                        "compile_cache: deserialize of %s failed (%s); "
+                        "recompiling", disk_key[:12], e)
+                    _telemetry.inc("compile_cache_errors_total",
+                                   kind="deserialize")
+                    # crc-valid but unloadable (e.g. XLA build drift):
+                    # drop it so the store below rewrites the entry
+                    _cc.invalidate(disk_key)
+        if compiled is None:
+            try:
+                with mkctx():
+                    t_tr = time.perf_counter()
+                    lowered = jfn.lower(feeds, params_ro, params_rw,
+                                        params_carry, rng)
+                    t_lo = time.perf_counter()
+                    compiled = lowered.compile()
+                cstats["source"] = "compiled"
+                if tel:
+                    _telemetry.inc("executor_xla_compile_total")
+                    _telemetry.observe("executor_trace_lower_ms",
+                                       (t_lo - t_tr) * 1e3)
+                    _telemetry.observe(
+                        "executor_xla_compile_ms",
+                        (time.perf_counter() - t_lo) * 1e3)
+                if disk_key is not None:
+                    try:
+                        from jax.experimental import \
+                            serialize_executable as _se
+
+                        def roundtrips(parts):
+                            # an executable restored from jax's persistent
+                            # XLA cache (tier A) serializes WITHOUT its JIT
+                            # object code on XLA:CPU — the payload
+                            # deserializes to "Symbols not found".  Trial-
+                            # load before storing so tier B only ever holds
+                            # self-contained artifacts.
+                            try:
+                                with mkctx():
+                                    _se.deserialize_and_load(*parts)
+                                return True
+                            except Exception:
+                                return False
+
+                        parts = _se.serialize(compiled)
+                        if not roundtrips(parts):
+                            _telemetry.inc(
+                                "compile_cache_roundtrip_retry_total")
+                            # jax memoizes the is_cache_used verdict the
+                            # first time any compile runs, so flipping the
+                            # flag alone is a no-op — reset_cache() forces
+                            # the re-check (and again after, so tier A
+                            # resumes for subsequent compiles)
+                            from jax._src import \
+                                compilation_cache as _jcc
+                            cfg = jax.config
+                            old = cfg.jax_enable_compilation_cache
+                            try:
+                                cfg.update("jax_enable_compilation_cache",
+                                           False)
+                                _jcc.reset_cache()
+                                # in-memory weakref memo (pxla.
+                                # _cached_compilation) would hand back the
+                                # same poisoned executable for the
+                                # identical HLO — drop it too
+                                jax.clear_caches()
+                                with mkctx():
+                                    compiled = jfn.lower(
+                                        feeds, params_ro, params_rw,
+                                        params_carry, rng).compile()
+                            finally:
+                                cfg.update("jax_enable_compilation_cache",
+                                           old)
+                                _jcc.reset_cache()
+                            parts = _se.serialize(compiled)
+                        if roundtrips(parts):
+                            _cc.store(
+                                disk_key, *parts,
+                                meta={"fetch":
+                                      list(build.plan.fetch_names),
+                                      "n_feeds": len(feeds)})
+                        else:
+                            logging.warning(
+                                "compile_cache: %s does not serialize "
+                                "round-trippably; not stored",
+                                disk_key[:12])
+                            _telemetry.inc("compile_cache_errors_total",
+                                           kind="serialize")
+                    except Exception as e:
+                        logging.warning(
+                            "compile_cache: serialize failed: %s", e)
+                        _telemetry.inc("compile_cache_errors_total",
+                                       kind="serialize")
+            except Exception as e:
+                # the lazy path compiles inside the first call — identical
+                # semantics, just conflated timing (pre-PR behavior)
+                logging.warning(
+                    "executor: eager AOT compile failed (%s); falling back "
+                    "to lazy jit", e)
+                _telemetry.inc("executor_aot_fallback_total")
+                compiled = None
+        cstats["compile_ms"] = (time.perf_counter() - t0) * 1e3
+        entry = _CompiledPlan(
+            build.plan, compiled if compiled is not None else jfn,
+            build.mesh, build.data_axis, jit_fn=jfn)
+        return entry, cstats
+
+    def warmup(self, program=None, feed_specs=None, fetch_list=None,
+               scope=None, devices=None):
+        """Pre-compile `program` for the given feed signature WITHOUT
+        running a step: populates the in-memory executable cache and, when
+        FLAGS_compile_cache_dir is set, the on-disk tier-B cache (elastic
+        standby / serving-bucket prewarm path).
+
+        ``feed_specs`` maps feed name -> concrete array OR (shape, dtype).
+        Parameters must already be initialized in ``scope`` (run the
+        startup program first).  ``devices`` overrides the SPMD mesh's
+        device list (used by elastic standby to compile a smaller world);
+        entries built with an override are only written to disk, never
+        into the in-memory cache (their mesh is not this world's).
+
+        Returns {"source": "memory"|"disk"|"compiled"|"fallback",
+        "compile_ms": float, "key": tier-B key or None}."""
+        from ..compiler import CompiledProgram
+
+        scope = scope if scope is not None else global_scope()
+        fetch_list = fetch_list or []
+        fetch_names = [_fetch_name(f) for f in fetch_list]
+        mesh = None
+        data_axis = None
+        if isinstance(program, CompiledProgram):
+            compiled_prog = program
+            program = compiled_prog._program
+            mesh = compiled_prog._mesh()
+            data_axis = compiled_prog._data_axis
+        if program is None:
+            program = default_main_program()
+        block = program.global_block()
+        feed_arrays = {}
+        for name, spec in (feed_specs or {}).items():
+            if isinstance(spec, (tuple, list)) and len(spec) == 2 and \
+                    isinstance(spec[0], (tuple, list)):
+                shape, dt = spec
+                if dt is None:
+                    v = block._find_var_recursive(name)
+                    dt = dtype_to_np(v.dtype) if v is not None else np.float32
+                feed_arrays[name] = np.zeros(tuple(shape), dtype=np.dtype(dt))
+            elif isinstance(spec, jax.Array):
+                feed_arrays[name] = spec
+            else:
+                arr = np.asarray(spec)
+                v = block._find_var_recursive(name)
+                if v is not None and v.dtype is not None and \
+                        arr.dtype != dtype_to_np(v.dtype):
+                    arr = np.asarray(arr, dtype=dtype_to_np(v.dtype))
+                feed_arrays[name] = arr
+
+        self._maybe_fuse_optimizers(program, block, list(feed_arrays),
+                                    fetch_names)
+        from .. import flags as _flags
+
+        trace_flags = tuple(sorted(_flags.get_flags(
+            ["FLAGS_use_pallas_layer_norm", "FLAGS_check_nan_inf",
+             "FLAGS_bn_stat_subsample",
+             "FLAGS_fused_small_attention",
+             "FLAGS_layout_match_params"]).items()))
+        mesh_key = None
+        if mesh is not None:
+            mesh_key = (tuple(mesh.shape.items()),
+                        tuple(d.id for d in mesh.devices.flat))
+        key = (
+            program._uid,
+            program.version,
+            tuple(sorted((n, a.shape, str(a.dtype))
+                         for n, a in feed_arrays.items())),
+            tuple(fetch_names),
+            mesh_key,
+            trace_flags,
+        )
+        if devices is None and key in self._cache:
+            return {"source": "memory", "compile_ms": 0.0, "key": None}
+        from .analysis import check_before_compile
+        from . import compile_cache as _cc
+
+        _cc.enable_xla_cache()
+        check_before_compile(program, list(feed_arrays), fetch_names,
+                             scope=scope)
+        t0 = time.perf_counter()
+        build = self._build(program, list(feed_arrays), fetch_names, mesh,
+                            data_axis, devices=devices)
+        plan = build.plan
+        if build.mesh is not None and mesh is None:
+            mesh = build.mesh
+            data_axis = build.data_axis
+        params_ro, params_rw = {}, {}
+        for n in plan.ro_names:
+            params_ro[n] = self._scope_value(scope, n, block)
+        for n in plan.rw_names:
+            params_rw[n] = self._scope_value(scope, n, block)
+        params_carry, _h, _c = self._gather_carry(scope, plan, block)
+        rng = np.asarray([(program.random_seed or 0) & 0xFFFFFFFF, 0],
+                         dtype=np.uint32)
+        if mesh is not None:
+            feed_arrays = self._shard_feeds(feed_arrays, mesh, data_axis)
+            params_ro = self._shard_params(params_ro, mesh, block)
+            params_rw = self._shard_params(params_rw, mesh, block)
+        dev = self._jax_device(mesh)
+        disk_key = self._disk_key(program, plan, feed_arrays, fetch_names,
+                                  trace_flags, mesh, dev)
+        entry, cstats = self._finalize_compile(
+            build, feed_arrays, params_ro, params_rw, params_carry, rng,
+            disk_key, dev)
+        if devices is None:
+            self._cache[key] = entry
+        ms = (time.perf_counter() - t0) * 1e3
+        _telemetry.inc("executor_warmup_total")
+        _telemetry.event("warmup", source=cstats["source"],
+                         compile_ms=round(ms, 3))
+        return {"source": cstats["source"], "compile_ms": ms,
+                "key": disk_key}
 
     def _maybe_fuse_optimizers(self, program, block, feed_names,
                                fetch_names):

@@ -157,7 +157,10 @@ def maybe_audit(entry, feeds, params_ro, params_rw, params_carry, rng,
         return None
     _audited.add(key)
     try:
-        report = memory_report(entry.jfn, feeds, params_ro, params_rw,
+        # entry.jfn may be an AOT Compiled (no .lower); the jit wrapper is
+        # kept on the entry for exactly this re-lower
+        jfn = getattr(entry, "jit_fn", None) or entry.jfn
+        report = memory_report(jfn, feeds, params_ro, params_rw,
                                params_carry, rng, plan=entry.plan)
     except Exception as e:
         logging.warning("hbm_audit failed: %s", e)

@@ -381,7 +381,7 @@ class ElasticMember:
 
     def __init__(self, main_program, startup_program, executor=None,
                  ckpt=None, feed_names=(), fetch_names=(), members=None,
-                 rank=None, nrings=1, scope=None):
+                 rank=None, nrings=1, scope=None, feed_specs=None):
         env_rank, env_eps, env_restarts = member_env()
         self.rank = env_rank if rank is None else int(rank)
         self.members = list(members) if members is not None else env_eps
@@ -397,6 +397,11 @@ class ElasticMember:
         self.feed_names = list(feed_names)
         self.fetch_names = list(fetch_names)
         self.nrings = int(nrings)
+        # feed signature for pre-compilation: {name: (shape, dtype)} or a
+        # callable world_size -> that dict (per-member batch shards shrink
+        # when the world does).  Enables the standby pre-compile and the
+        # post-adopt warmup; without it only transpile+verify are standby.
+        self.feed_specs = feed_specs
         self.view = None
         self.main_program = None
         self.startup_program = None
@@ -408,6 +413,16 @@ class ElasticMember:
         self._hb_thread = None
         self._stop_hb = threading.Event()
         self._finalized = False
+        # standby views: frozenset(ranks) -> pre-transpiled/verified (and,
+        # with feed_specs, tier-B pre-compiled) programs for a world this
+        # member might shrink into (see _spawn_standby)
+        self._standby = {}
+        self._standby_lock = threading.Lock()
+        self._standby_thread = None
+        # last adoption's phase breakdown (ms) + whether a standby view
+        # served it — payloads/tests read these after gate() returns False
+        self.last_adopt_phases = {}
+        self.last_adopt_standby = False
 
     # -- properties ----------------------------------------------------------
 
@@ -570,8 +585,13 @@ class ElasticMember:
             s = getattr(s, "parent", None)
 
     def _adopt(self, view):
-        """Make `view` this process's world: jax re-init, re-transpile,
-        verify (error mode incl. DL005), restore checkpoint."""
+        """Make `view` this process's world: jax re-init, then either
+        consume a fresh standby view (transpile+verify already done and the
+        executable pre-compiled into the tier-B disk cache — re-quorum
+        collapses to cache-restore + checkpoint-restore) or re-transpile +
+        re-verify from the pristine programs; finally startup + warmup +
+        restore.  Each phase lands in the elastic_requorum_phase_ms
+        histogram so the breakdown is auditable."""
         t0 = time.perf_counter()
         old_epoch = self.epoch
         self.view = view
@@ -586,43 +606,93 @@ class ElasticMember:
             self.executor.reset_device_state()
         _JaxWorld.reinit(coord_host, view.jax_port, world, pid,
                          host_service=self.rank == view.coord_rank)
+        phases = {"init": (time.perf_counter() - t0) * 1e3}
 
-        # re-transpile pristine programs for the new world + verify the
-        # rewrite loudly BEFORE any recompile (DL001-005, error mode)
-        endpoints = [self.members[r] for r in view.ranks]
-        main = self.base_main.clone()
-        startup = self.base_startup.clone()
-        # FLAGS_collective_mode-aware: a zero1 job re-shards the optimizer
-        # state for the new world here (the re-transpiled shard assignment
-        # covers `world` ranks; shard-local slots rematerialize from the
-        # full arrays the checkpoint restore puts back into the scope)
-        from ..transpiler.collective import select_grad_transpiler
+        standby = self._take_standby(view) if old_epoch >= 0 else None
+        if standby is not None:
+            # pre-transpiled + pre-verified in the background after the
+            # last adoption: both phases are already paid
+            main, startup = standby["main"], standby["startup"]
+            phases["transpile"] = 0.0
+            phases["verify"] = 0.0
+        else:
+            # re-transpile pristine programs for the new world + verify the
+            # rewrite loudly BEFORE any recompile (DL001-006, error mode)
+            endpoints = [self.members[r] for r in view.ranks]
+            main = self.base_main.clone()
+            startup = self.base_startup.clone()
+            # FLAGS_collective_mode-aware: a zero1 job re-shards the
+            # optimizer state for the new world here (the re-transpiled
+            # shard assignment covers `world` ranks; shard-local slots
+            # rematerialize from the full arrays the checkpoint restore
+            # puts back into the scope)
+            from ..transpiler.collective import select_grad_transpiler
 
-        t = select_grad_transpiler(self.nrings)
-        t.transpile(startup_program=startup, main_program=main, rank=pid,
-                    endpoints=endpoints,
-                    current_endpoint=self.members[self.rank],
-                    wait_port=False)
-        self._verify(main, startup, world)
+            t1 = time.perf_counter()
+            t = select_grad_transpiler(self.nrings)
+            t.transpile(startup_program=startup, main_program=main,
+                        rank=pid, endpoints=endpoints,
+                        current_endpoint=self.members[self.rank],
+                        wait_port=False)
+            t2 = time.perf_counter()
+            self._verify(main, startup, world)
+            phases["transpile"] = (t2 - t1) * 1e3
+            phases["verify"] = (time.perf_counter() - t2) * 1e3
+        # the pool only held subsets of the OLD view; rebuild below
+        with self._standby_lock:
+            self._standby.clear()
         self.main_program = main
         self.startup_program = startup
 
         self.restore_step = 0
+        phases["compile"] = phases["restore"] = 0.0
         if self.executor is not None:
+            tc = time.perf_counter()
             self.executor.run(startup)
+            if self.feed_specs is not None and self.fetch_names:
+                # pre-compile the training step now so the compile cost is
+                # attributed to this phase, not smeared into the first
+                # post-restore step; with a pre-compiled standby this is a
+                # tier-B disk restore, not an XLA compile
+                specs = (self.feed_specs(world) if callable(self.feed_specs)
+                         else self.feed_specs)
+                try:
+                    got = self.executor.warmup(
+                        main, feed_specs=specs,
+                        fetch_list=list(self.fetch_names))
+                    _tm.event("elastic_warmup", rank=self.rank,
+                              epoch=view.epoch, source=got["source"],
+                              ms=round(got["compile_ms"], 3))
+                except Exception as e:
+                    logging.warning("[elastic] post-adopt warmup failed: "
+                                    "%s", e)
+            phases["compile"] = (time.perf_counter() - tc) * 1e3
+            tr = time.perf_counter()
             if self.ckpt is not None:
                 step, _extra = self.ckpt.restore(self.executor, main)
                 self.restore_step = int(step)
                 _tm.event("elastic_restore", rank=self.rank,
                           epoch=view.epoch, step=self.restore_step)
+            phases["restore"] = (time.perf_counter() - tr) * 1e3
         ms = (time.perf_counter() - t0) * 1e3
         _tm.observe("elastic_requorum_ms", ms, role="member")
+        for ph in ("transpile", "verify", "compile", "restore"):
+            _tm.observe("elastic_requorum_phase_ms", phases[ph], phase=ph)
         _tm.set_gauge("elastic_epoch", view.epoch)
         if old_epoch >= 0:
             _tm.event("elastic_adopt", rank=self.rank, epoch=view.epoch,
-                      world=world, ms=round(ms, 3))
-        logging.info("[elastic] rank %d adopted %r (pid %d/%d) in %.0fms",
-                     self.rank, view, pid, world, ms)
+                      world=world, ms=round(ms, 3),
+                      standby=standby is not None,
+                      phases={k: round(v, 3) for k, v in phases.items()})
+        self.last_adopt_phases = dict(phases)
+        self.last_adopt_standby = standby is not None
+        logging.info(
+            "[elastic] rank %d adopted %r (pid %d/%d) in %.0fms "
+            "(standby=%s transpile=%.0f verify=%.0f compile=%.0f "
+            "restore=%.0f)", self.rank, view, pid, world, ms,
+            standby is not None, phases["transpile"], phases["verify"],
+            phases["compile"], phases["restore"])
+        self._spawn_standby()
 
     def _verify(self, main, startup, world):
         from ..core import analysis
@@ -635,6 +705,166 @@ class ElasticMember:
                 expected_nranks=world)
             if rep.errors:
                 raise analysis.ProgramVerificationError(rep)
+
+    # -- standby views -------------------------------------------------------
+    #
+    # After every adoption a background thread prepares the worlds this
+    # member is most likely to shrink into — every single-member loss
+    # (world N-1) and the loss of the two highest-ranked peers (world N-2)
+    # — by cloning + re-transpiling + verifying the pristine programs NOW,
+    # and (when feed_specs is known) pre-compiling the step executable over
+    # a device-prefix mesh into the tier-B disk cache.  A later re-quorum
+    # that lands on a prepared rank set skips transpile + verify outright
+    # and restores the executable from disk instead of recompiling.
+
+    def _standby_flags_sig(self):
+        from .. import flags as _flags
+
+        return tuple(sorted(_flags.get_flags(
+            ["FLAGS_collective_mode", "FLAGS_allreduce_dtype",
+             "FLAGS_allreduce_quant_bucket"]).items()))
+
+    def _standby_candidates(self):
+        """Rank subsets (each containing this member) for worlds N-1/N-2,
+        by FLAGS_elastic_standby depth."""
+        if self.view is None:
+            return []
+        depth = int(_flag("elastic_standby") or 0)
+        ranks = set(self.view.ranks)
+        others = sorted(r for r in ranks if r != self.rank)
+        cands = []
+        if depth >= 1 and len(ranks) >= 2:
+            for r in others:
+                cands.append(tuple(sorted(ranks - {r})))
+        if depth >= 2 and len(ranks) >= 3:
+            cands.append(tuple(sorted(ranks - set(others[-2:]))))
+        return cands
+
+    def _build_standby(self, ranks):
+        """Transpile + verify (error mode) one candidate world; with
+        feed_specs, also pre-compile its step into the tier-B cache over
+        jax.devices()[:world] (device ids are not part of the tier-B key,
+        so the artifact is loadable by the re-initialized backend)."""
+        ranks = tuple(sorted(int(r) for r in ranks))
+        if self.rank not in ranks:
+            raise ValueError("standby ranks %s exclude self (%d)"
+                             % (list(ranks), self.rank))
+        pid = ranks.index(self.rank)
+        world = len(ranks)
+        endpoints = [self.members[r] for r in ranks]
+        from ..transpiler.collective import select_grad_transpiler
+
+        main = self.base_main.clone()
+        startup = self.base_startup.clone()
+        t = select_grad_transpiler(self.nrings)
+        t.transpile(startup_program=startup, main_program=main, rank=pid,
+                    endpoints=endpoints,
+                    current_endpoint=self.members[self.rank],
+                    wait_port=False)
+        self._verify(main, startup, world)
+        rec = {"ranks": ranks, "main": main, "startup": startup,
+               "flags_sig": self._standby_flags_sig(),
+               "base_versions": (self.base_main.version,
+                                 self.base_startup.version),
+               "compiled": False}
+        if self.executor is not None and self.feed_specs is not None \
+                and self.fetch_names:
+            import jax
+
+            specs = (self.feed_specs(world) if callable(self.feed_specs)
+                     else self.feed_specs)
+            devs = jax.devices()[:world]
+            try:
+                # the startup program bakes the world size into its
+                # c_comm_init nranks attr, so the shrunk world's startup is
+                # a distinct executable — pre-compile it too or the
+                # re-quorum's executor.run(startup) pays a fresh XLA compile
+                self.executor.warmup(startup, feed_specs={}, fetch_list=[],
+                                     devices=devs)
+            except Exception as e:
+                logging.warning("[elastic] standby startup pre-compile for "
+                                "world %s failed: %s", list(ranks), e)
+            for attempt in (0, 1):
+                try:
+                    got = self.executor.warmup(
+                        main, feed_specs=specs,
+                        fetch_list=list(self.fetch_names), devices=devs)
+                    rec["compiled"] = got["source"] in ("compiled", "disk")
+                    break
+                except Exception as e:
+                    # racing the training loop: a donated param can vanish
+                    # mid-gather — retry once, then settle for
+                    # transpile+verify-only standby
+                    if attempt:
+                        logging.warning("[elastic] standby pre-compile for "
+                                        "world %s failed: %s", list(ranks), e)
+                        _tm.inc("elastic_standby_errors_total")
+        with self._standby_lock:
+            self._standby[frozenset(ranks)] = rec
+        _tm.inc("elastic_standby_built_total")
+        _tm.event("elastic_standby", rank=self.rank, world=world,
+                  ranks=list(ranks), compiled=rec["compiled"])
+        return rec
+
+    def _take_standby(self, view):
+        """Fresh standby programs for exactly `view.ranks`, or None.
+        Freshness: built from the current base program versions under the
+        current transpile-affecting flags."""
+        with self._standby_lock:
+            rec = self._standby.get(frozenset(view.ranks))
+        if rec is None:
+            _tm.inc("elastic_standby_miss_total")
+            return None
+        if (rec["flags_sig"] != self._standby_flags_sig()
+                or rec["base_versions"] != (self.base_main.version,
+                                            self.base_startup.version)):
+            _tm.inc("elastic_standby_stale_total")
+            return None
+        _tm.inc("elastic_standby_hits_total")
+        return rec
+
+    def prepare_standby_views(self, ranks_list=None):
+        """Synchronously build standby views (tests / explicit prewarm).
+        Defaults to the automatic N-1/N-2 candidate set."""
+        built = []
+        for ranks in (ranks_list if ranks_list is not None
+                      else self._standby_candidates()):
+            built.append(self._build_standby(ranks))
+        return built
+
+    def _spawn_standby(self):
+        if int(_flag("elastic_standby") or 0) <= 0:
+            return
+        cands = self._standby_candidates()
+        if not cands:
+            return
+
+        def work():
+            for ranks in cands:
+                if self._stop_hb.is_set():
+                    return
+                try:
+                    self._build_standby(ranks)
+                except Exception as e:
+                    logging.warning("[elastic] standby build %s failed: %s",
+                                    list(ranks), e)
+                    _tm.inc("elastic_standby_errors_total")
+
+        th = threading.Thread(target=work, name="elastic-standby",
+                              daemon=True)
+        self._standby_thread = th
+        th.start()
+
+    def wait_standby(self, timeout=60.0):
+        """Block until the background standby builder finishes; -> dict of
+        prepared rank tuples -> pre-compiled?  (tests use this to make the
+        standby-hit deterministic)."""
+        th = self._standby_thread
+        if th is not None:
+            th.join(timeout)
+        with self._standby_lock:
+            return {tuple(sorted(k)): v["compiled"]
+                    for k, v in self._standby.items()}
 
     # -- step gate -----------------------------------------------------------
 

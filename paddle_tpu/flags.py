@@ -93,6 +93,23 @@ _DEFAULTS = {
     # VPU chain loses to XLA's materialized-probs backward), so the
     # composed emission stays the default training path (BASELINE.md r5)
     "FLAGS_fused_small_attention": False,
+    # two-tier persistent compilation cache (core/compile_cache.py).
+    # Non-empty = enabled: <dir>/xla holds JAX's native persistent XLA
+    # cache (jax_compilation_cache_dir, tier A — dedupes identical HLO
+    # even across different programs); <dir>/aot holds framework-level
+    # serialized executables keyed by (program content hash, trace-flag
+    # fingerprint, collective world, feed shapes/dtypes) (tier B — a hit
+    # skips trace + lower + compile entirely).  Empty = both tiers off.
+    "FLAGS_compile_cache_dir": "",
+    # tier-B size cap in bytes; least-recently-used entries are evicted
+    # after each store once the total exceeds it.  <=0 disables eviction.
+    "FLAGS_compile_cache_max_bytes": 1 << 30,
+    # elastic standby worlds (distributed/elastic.py): after each epoch
+    # adoption, a background thread pre-transpiles + pre-verifies views
+    # for worlds N-1 and N-2 (every single-member loss, plus the
+    # two-member loss) and pre-compiles them into the tier-B cache, so a
+    # re-quorum becomes cache-restore + checkpoint-restore.  0 disables.
+    "FLAGS_elastic_standby": 2,
     # collective gradient-exchange strategy (transpiler/collective.py):
     # "allreduce" = replicated GradAllReduce (every rank updates every
     # param); "zero1" = ShardedGradAllReduce, the ZeRO-1 weight-update

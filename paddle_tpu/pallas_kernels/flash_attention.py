@@ -213,6 +213,9 @@ try:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    # renamed TPUCompilerParams -> CompilerParams across jax releases
+    _CompilerParams = getattr(pltpu, "CompilerParams", None) \
+        or pltpu.TPUCompilerParams
     _HAS_PALLAS = True
 except ImportError:  # pragma: no cover
     _HAS_PALLAS = False
@@ -318,7 +321,7 @@ def _fwd_pallas(q, k, v, bias, causal, sm_scale, block_q, block_k,
             pltpu.VMEM((block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, D), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
@@ -374,7 +377,7 @@ def _bwd_pallas(q, k, v, bias, causal, sm_scale, block_q, block_k,
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
@@ -426,7 +429,7 @@ def _bwd_pallas(q, k, v, bias, causal, sm_scale, block_q, block_k,
             pltpu.VMEM((block_k, D), jnp.float32),
             pltpu.VMEM((block_k, D), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
@@ -641,7 +644,7 @@ def small_attention_fwd(q, k, v, bias, sm_scale, dropout_prob, seed):
             in_specs=in_specs, out_specs=[qspec, lspec]),
         out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype),
                    jax.ShapeDtypeStruct((B, H, S, 1), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel",)),
     )(seed, *args)
     return out, lse
@@ -687,7 +690,7 @@ def small_attention_bwd(q, k, v, bias, sm_scale, dropout_prob, seed, out,
         out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype),
                    jax.ShapeDtypeStruct(k.shape, k.dtype),
                    jax.ShapeDtypeStruct(v.shape, v.dtype)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel",)),
     )(seed, *args)
     return dq, dk, dv

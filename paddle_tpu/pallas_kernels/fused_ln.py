@@ -40,6 +40,9 @@ try:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    # renamed TPUCompilerParams -> CompilerParams across jax releases
+    _CompilerParams = getattr(pltpu, "CompilerParams", None) \
+        or pltpu.TPUCompilerParams
     _HAS_PALLAS = True
 except ImportError:  # pragma: no cover
     _HAS_PALLAS = False
@@ -175,7 +178,7 @@ def _fwd_pallas(x2, y2, gamma, beta, seed, thr, eps, rows):
             jax.ShapeDtypeStruct((n, 1), jnp.float32),
             jax.ShapeDtypeStruct((n, 1), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel",)),
     )(seed, x2, y2, gamma.reshape(1, h), beta.reshape(1, h))
 
@@ -202,7 +205,7 @@ def _bwd_pallas(r2, gamma, seed, mean, var, dz2, thr, eps, rows):
             jax.ShapeDtypeStruct((n // rows * 8, h), jnp.float32),
             jax.ShapeDtypeStruct((n // rows * 8, h), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel",)),
     )(seed, r2, gamma.reshape(1, h), mean, var, dz2)
     return dx, dy, jnp.sum(dgp, axis=0), jnp.sum(dbp, axis=0)

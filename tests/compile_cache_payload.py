@@ -1,0 +1,63 @@
+"""Runnable payload for the cross-process compile-cache reuse test.
+
+Builds a small deterministic regression (seeded init, fixed feeds), runs
+three steps under FLAGS_compile_cache_dir=argv[1], and prints:
+
+  counters: xla=N disk_hits=N stores=N aot_fallback=N
+  fetch: <hex of the three losses, bitwise>
+
+The first process populates the tier-B cache (xla>0, stores>0); a second
+process pointed at the same directory must report xla=0 (every
+executable restored from disk) with a bitwise-identical fetch line.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def main():
+    fluid.set_flags({"FLAGS_compile_cache_dir": sys.argv[1],
+                     "FLAGS_telemetry": True})
+    main_p, startup_p = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup_p.random_seed = 7
+    with fluid.program_guard(main_p, startup_p):
+        x = fluid.layers.data("x", shape=[4])
+        y = fluid.layers.data("y", shape=[1])
+        h = fluid.layers.fc(x, 8, act="relu",
+                            param_attr=fluid.ParamAttr(name="ccp_w1"))
+        pred = fluid.layers.fc(h, 1,
+                               param_attr=fluid.ParamAttr(name="ccp_w2"))
+        loss = fluid.layers.mean(fluid.layers.square(pred - y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup_p)
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(8, 4).astype("f"),
+            "y": rng.rand(8, 1).astype("f")}
+    out = [np.asarray(exe.run(main_p, feed=feed, fetch_list=[loss.name])[0])
+           for _ in range(3)]
+
+    from paddle_tpu.core import telemetry as tm
+
+    c = tm.snapshot()["counters"]
+    print("counters: xla=%d disk_hits=%d stores=%d aot_fallback=%d"
+          % (c.get("executor_xla_compile_total", 0),
+             c.get("compile_cache_disk_hit_total", 0),
+             c.get("compile_cache_store_total", 0),
+             c.get("executor_aot_fallback_total", 0)), flush=True)
+    print("fetch: %s" % np.concatenate(
+        [o.reshape(-1) for o in out]).astype("f").tobytes().hex(),
+        flush=True)
+
+
+if __name__ == "__main__":
+    main()

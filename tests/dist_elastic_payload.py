@@ -10,9 +10,15 @@ dense pid/world, and checkpoints through a shared CheckpointManager.
 Markers on stdout, one per line, for the test harness:
 
   start: rank=R epoch=E world=W restore=S     after the first adoption
+  start_phases: compile=C                     cold compile ms of that adoption
   mark:step=S world=W epoch=E                 before running step S
   loss:<float>                                after running a step
   requorum: epoch=E world=W restore=S         after adopting a new view
+  requorum_phases: standby=B transpile=T verify=V compile=C restore=R
+                                              phase breakdown (ms) of the
+                                              same adoption
+  standby: {(ranks): compiled, ...}           after wait_standby (with
+                                              --wait_standby)
   done: rank=R epoch=E world=W                clean completion
 
 Flags:
@@ -22,6 +28,9 @@ Flags:
                      collective, so gloo never wedges mid-all-reduce)
   --hold_at S N      at step S, spin on the gate until the world has
                      grown back to N members (deterministic rejoin rendezvous)
+  --wait_standby     block until the background standby builder finishes
+                     before entering the training loop (makes the
+                     standby-hit path deterministic for the test)
 """
 
 import argparse
@@ -91,6 +100,7 @@ def main():
     ap.add_argument("--pause_at", type=int, default=None)
     ap.add_argument("--hold_at", type=int, nargs=2, default=None,
                     metavar=("STEP", "WORLD"))
+    ap.add_argument("--wait_standby", action="store_true")
     args = ap.parse_args()
 
     main_p, startup_p, loss = build()
@@ -100,12 +110,33 @@ def main():
     xs, ys = make_data()
     exe = fluid.Executor(fluid.CPUPlace())
     ckpt = CheckpointManager(args.ckpt_dir, save_interval=2, max_num=4)
-    member = ElasticMember(main_p, startup_p, executor=exe, ckpt=ckpt,
-                           feed_names=["x", "y"], fetch_names=[loss.name])
+    member = ElasticMember(
+        main_p, startup_p, executor=exe, ckpt=ckpt,
+        feed_names=["x", "y"], fetch_names=[loss.name],
+        # per-world feed signature: lets the member pre-compile the step
+        # for standby worlds and warm the adopted world eagerly
+        feed_specs=lambda world: {"x": ((ROWS // world, 6), "float32"),
+                                  "y": ((ROWS // world, 1), "float32")})
     member.start()
     print("start: rank=%d epoch=%d world=%d restore=%d"
           % (member.rank, member.epoch, member.world, member.restore_step),
           flush=True)
+    print("start_phases: compile=%.3f"
+          % member.last_adopt_phases.get("compile", -1.0), flush=True)
+    if args.wait_standby:
+        built = member.wait_standby(timeout=300.0)
+        print("standby: %s" % sorted(built.items()), flush=True)
+
+    def report_requorum():
+        ph = member.last_adopt_phases
+        print("requorum: epoch=%d world=%d restore=%d"
+              % (member.epoch, member.world, member.restore_step), flush=True)
+        print("requorum_phases: standby=%d transpile=%.3f verify=%.3f "
+              "compile=%.3f restore=%.3f"
+              % (1 if member.last_adopt_standby else 0,
+                 ph.get("transpile", -1.0), ph.get("verify", -1.0),
+                 ph.get("compile", -1.0), ph.get("restore", -1.0)),
+              flush=True)
 
     step = member.restore_step
     while step < STEPS:
@@ -116,13 +147,11 @@ def main():
             while member.world < args.hold_at[1]:
                 if not member.gate(step):
                     step = member.restore_step
-                    print("requorum: epoch=%d world=%d restore=%d"
-                          % (member.epoch, member.world, step), flush=True)
+                    report_requorum()
                 time.sleep(0.2)
         if not member.gate(step):
             step = member.restore_step
-            print("requorum: epoch=%d world=%d restore=%d"
-                  % (member.epoch, member.world, step), flush=True)
+            report_requorum()
             continue
         shard = ROWS // member.world
         lo = shard * member.pid

@@ -106,6 +106,10 @@ def _member_env(rank, eps, tmp, restart=0):
         "FLAGS_telemetry": "1",
         "FLAGS_telemetry_dir": os.path.join(str(tmp), "tm-%d-%d"
                                             % (rank, restart)),
+        # shared two-tier compile cache: standby views pre-compile into it,
+        # the re-quorum adoption restores from it (tier-B keys carry no
+        # device ids precisely so they survive the jax re-init)
+        "FLAGS_compile_cache_dir": os.path.join(str(tmp), "cc"),
     })
     return env
 
@@ -130,11 +134,15 @@ def test_evict_requorum_and_rejoin(tmp_path):
     eps = ["127.0.0.1:%d" % p for p in ports]
     ckpt_dir = str(tmp_path / "ckpt")
 
-    hold = ("--hold_at", str(HOLD_AT), str(N))
+    # --wait_standby: members block until the background standby builder
+    # has pre-transpiled + pre-compiled the shrink candidates, making the
+    # post-eviction standby HIT deterministic instead of a race between
+    # the builder thread and the victim's death
+    hold = ("--hold_at", str(HOLD_AT), str(N), "--wait_standby")
     tails = [_spawn("m:%d" % r, r, eps, tmp_path, ckpt_dir, extra=hold)
              for r in range(N - 1)]
     victim = _spawn("victim", VICTIM, eps, tmp_path, ckpt_dir,
-                    extra=("--pause_at", str(PAUSE_AT)))
+                    extra=("--pause_at", str(PAUSE_AT), "--wait_standby"))
     tails.append(victim)
     try:
         # 1. victim reaches the pause point -> SIGKILL it (mid-training,
@@ -151,6 +159,25 @@ def test_evict_requorum_and_rejoin(tmp_path):
         assert line is not None, (
             "survivor 0 never re-quorumed:\n" + _dump(tails))
         assert "world=2" in line and "restore=4" in line, line
+
+        # the standby view made the adoption skip transpile + verify
+        # outright, and the compile phase collapsed to a tier-B cache
+        # restore — strictly cheaper than the cold world-3 compile
+        pline = tails[0].wait_for("requorum_phases:", 60)
+        assert pline is not None, _dump(tails)
+        pm = re.search(r"standby=(\d) transpile=([\d.]+) verify=([\d.]+) "
+                       r"compile=([\d.]+) restore=([\d.]+)", pline)
+        assert pm, pline
+        assert pm.group(1) == "1", "standby view missed:\n" + pline
+        assert float(pm.group(2)) == 0.0, pline  # no re-transpile
+        assert float(pm.group(3)) == 0.0, pline  # no re-verify
+        sline = tails[0].wait_for("start_phases:", 10)
+        assert sline is not None, _dump(tails)
+        cold = float(re.search(r"compile=([\d.]+)", sline).group(1))
+        warm = float(pm.group(4))
+        assert warm < cold, (
+            "standby restore (%.0fms) not cheaper than the cold "
+            "compile (%.0fms)" % (warm, cold))
 
         # 3. relaunch the victim the way launch.py --restart_failed would
         #    (same rank/endpoints, PADDLE_RESTART_COUNT bumped)

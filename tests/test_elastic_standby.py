@@ -1,0 +1,121 @@
+"""Elastic standby views, unit level (no subprocesses, no jax.distributed):
+distributed/elastic.py pre-transpiles + pre-verifies the worlds a member is
+likely to shrink into, and _take_standby serves exactly the fresh ones.
+
+The end-to-end property — a re-quorum onto a prepared world skips
+re-transpile + re-verify and restores its executable from the tier-B
+cache — is exercised over real processes in
+tests/test_dist_elastic_subprocess.py; here we pin the candidate
+enumeration, the per-world transpile/verify of each view, and the
+freshness rules (transpile-affecting flags and base program versions).
+"""
+
+import contextlib
+
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.distributed.elastic import ElasticMember, View
+
+_EPS = ["127.0.0.1:%d" % (6350 + i) for i in range(3)]
+
+
+@contextlib.contextmanager
+def _flags(**kv):
+    kv = {("FLAGS_" + k if not k.startswith("FLAGS_") else k): v
+          for k, v in kv.items()}
+    old = fluid.get_flags(list(kv))
+    fluid.set_flags(kv)
+    try:
+        yield
+    finally:
+        fluid.set_flags(old)
+
+
+def _member(rank=0):
+    """A member with a hand-set view: start() (quorum + jax init) never
+    runs, so only the program-rewrite layer is exercised."""
+    with fluid.unique_name.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 13
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[4])
+            y = fluid.layers.data("y", shape=[1])
+            h = fluid.layers.fc(x, 8, act="relu",
+                                param_attr=fluid.ParamAttr(name="es_w1"))
+            pred = fluid.layers.fc(h, 1,
+                                   param_attr=fluid.ParamAttr(name="es_w2"))
+            loss = fluid.layers.mean(fluid.layers.square(pred - y))
+            fluid.optimizer.SGD(0.1).minimize(loss)
+    m = ElasticMember(main, startup, feed_names=["x", "y"],
+                      fetch_names=[loss.name], members=_EPS, rank=rank)
+    m.view = View(epoch=0, coord_rank=0, jax_port=23450, restore_step=0,
+                  ranks=[0, 1, 2])
+    return m
+
+
+def test_candidates_cover_n1_and_n2():
+    m = _member(rank=0)
+    with _flags(elastic_standby=2):
+        cands = m._standby_candidates()
+    # every single-member loss containing self, plus the two-highest-other
+    # loss; all sorted, all containing rank 0
+    assert cands == [(0, 2), (0, 1), (0,)]
+    with _flags(elastic_standby=1):
+        assert m._standby_candidates() == [(0, 2), (0, 1)]
+    with _flags(elastic_standby=0):
+        assert m._standby_candidates() == []
+
+
+def test_build_standby_transpiles_and_verifies_each_world():
+    m = _member(rank=0)
+    built = m.prepare_standby_views([(0, 1), (0,)])
+    assert len(built) == 2
+    rec2 = m._standby[frozenset((0, 1))]
+    # the standby main really is the WORLD-2 rewrite, verified in error mode
+    assert rec2["main"]._collective_meta["nranks"] == 2
+    assert rec2["startup"] is not m.base_startup
+    assert rec2["compiled"] is False  # no executor/feed_specs attached
+    rec1 = m._standby[frozenset((0,))]
+    assert rec1["main"]._collective_meta["nranks"] == 1
+
+
+def test_take_standby_serves_fresh_exact_match_once():
+    m = _member(rank=0)
+    m.prepare_standby_views([(0, 1)])
+    v = View(epoch=1, coord_rank=0, jax_port=23479, restore_step=4,
+             ranks=[0, 1])
+    rec = m._take_standby(v)
+    assert rec is not None
+    assert rec["main"]._collective_meta["nranks"] == 2
+    # a different rank set is a miss, not a near-match
+    v3 = View(epoch=1, coord_rank=0, jax_port=23479, restore_step=4,
+              ranks=[0, 2])
+    assert m._take_standby(v3) is None
+
+
+def test_take_standby_rejects_stale_flags():
+    m = _member(rank=0)
+    m.prepare_standby_views([(0, 1)])
+    v = View(epoch=1, coord_rank=0, jax_port=23479, restore_step=0,
+             ranks=[0, 1])
+    # the view was transpiled under f32 exchange; flipping the wire dtype
+    # after the build must invalidate it (the rewrite baked the old mode)
+    with _flags(allreduce_dtype="bf16"):
+        assert m._take_standby(v) is None
+    assert m._take_standby(v) is not None  # flags restored -> fresh again
+
+
+def test_take_standby_rejects_stale_base_program():
+    m = _member(rank=0)
+    m.prepare_standby_views([(0, 1)])
+    v = View(epoch=1, coord_rank=0, jax_port=23479, restore_step=0,
+             ranks=[0, 1])
+    m.base_main._bump_version()
+    assert m._take_standby(v) is None
+
+
+def test_build_standby_rejects_ranks_excluding_self():
+    m = _member(rank=0)
+    with pytest.raises(ValueError):
+        m._build_standby((1, 2))

@@ -88,6 +88,11 @@ def main(argv=None):
                     help="show only the collective-exchange metrics "
                     "(collective_nranks/wire_bytes gauges+counters and "
                     "the zero1_* shard accounting)")
+    ap.add_argument("--compile", action="store_true", dest="compile_only",
+                    help="show only compilation metrics: the two-tier "
+                    "cache (compile_cache_* hit/miss/store/eviction/error "
+                    "counters, load/store latency) and the executor's "
+                    "trace/lower/XLA-compile breakdown")
     args = ap.parse_args(argv)
 
     if args.json_path:
@@ -103,6 +108,11 @@ def main(argv=None):
     if args.collective:
         # str.startswith takes a tuple: both metric families in one pass
         snap = _filter_snap(snap, ("collective_", "zero1_"))
+    if args.compile_only:
+        snap = _filter_snap(snap, ("compile_cache_", "executor_compile",
+                                   "executor_xla_", "executor_trace_",
+                                   "executor_cache_", "executor_aot_",
+                                   "executor_warmup"))
 
     if args.raw:
         json.dump(snap, sys.stdout, indent=1)

@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI harness (reference paddle/scripts/paddle_build.sh analog): build the
 # native pieces, run the full test pyramid, smoke the bench + graft entry.
-# Usage: tools/run_ci.sh [quick|full|tpu|--layout-smoke|--obs-smoke|--lint|--elastic-smoke|--zero1-smoke]
+# Usage: tools/run_ci.sh [quick|full|tpu|--layout-smoke|--obs-smoke|--lint|--elastic-smoke|--zero1-smoke|--cache-smoke]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -43,6 +43,28 @@ if [ "$MODE" = "--zero1-smoke" ]; then
   JAX_PLATFORMS=cpu FLAGS_static_check=error FLAGS_collective_mode=zero1 \
     FLAGS_allreduce_dtype=int8 python tools/zero1_smoke.py
   echo "CI --zero1-smoke: PASS"
+  exit 0
+fi
+
+if [ "$MODE" = "--cache-smoke" ]; then
+  # persistent-compilation-cache leg: the cache + standby unit/subprocess
+  # tests, then a two-process reuse dryrun through the CLI — process 1
+  # prewarms a bundled model, process 2 must restore it from disk (the
+  # "disk" source assertion) — all under FLAGS_static_check=error
+  echo "== cache smoke: compile cache + elastic standby tests =="
+  JAX_PLATFORMS=cpu FLAGS_static_check=error \
+    python -m pytest tests/test_compile_cache.py \
+    tests/test_elastic_standby.py -q
+  echo "== cache smoke: two-process prewarm -> restore dryrun =="
+  CC_DIR="$(mktemp -d)"
+  JAX_PLATFORMS=cpu FLAGS_static_check=error \
+    python tools/compile_cache.py --dir "$CC_DIR" prewarm --model mnist_mlp
+  JAX_PLATFORMS=cpu FLAGS_static_check=error \
+    python tools/compile_cache.py --dir "$CC_DIR" prewarm --model mnist_mlp \
+    | grep -q " disk "
+  python tools/compile_cache.py --dir "$CC_DIR" stats
+  rm -rf "$CC_DIR"
+  echo "CI --cache-smoke: PASS"
   exit 0
 fi
 
