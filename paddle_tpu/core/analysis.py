@@ -78,6 +78,19 @@ RULES = {
     "DL004": "side-effecting op duplicated into trainer and pserver",
     "DL005": "gradient-scale constant stale vs collective world size",
     "DL006": "ZeRO-1 shard coverage / dequant-scale / shard-world broken",
+    # world-level rules (core/world_analysis.py): every rank's transpiled
+    # program is materialized and the collective schedules are matched in
+    # lockstep — these catch the cross-rank failures a one-rank check
+    # cannot see (the static deadlock class)
+    "DL101": "cross-rank collective sequence mismatch (static deadlock)",
+    "DL102": "matched collectives disagree on shape/dtype/reduction/quant",
+    "DL103": "collective emitted under rank-divergent control flow",
+    "DL104": "ring/world membership does not cover the declared mesh",
+    # static memory estimator (same liveness pass): per-replica bytes with
+    # NamedSharding-aware attribution, pre-compile
+    "MEM001": "static per-replica peak-HBM estimate (informational)",
+    "MEM002": "donation opportunity the executor is not exploiting",
+    "MEM003": "predicted peak HBM exceeds FLAGS_hbm_budget_bytes",
 }
 
 
@@ -90,10 +103,10 @@ class Diagnostic:
     """One structured finding: severity, rule id, location, vars, fix."""
 
     __slots__ = ("severity", "rule", "message", "block_idx", "op_idx",
-                 "var_names", "suggestion")
+                 "var_names", "suggestion", "block_path", "rank")
 
     def __init__(self, severity, rule, message, block_idx=None, op_idx=None,
-                 var_names=(), suggestion=None):
+                 var_names=(), suggestion=None, block_path=None, rank=None):
         self.severity = severity
         self.rule = rule
         self.message = message
@@ -101,12 +114,24 @@ class Diagnostic:
         self.op_idx = op_idx
         self.var_names = tuple(var_names)
         self.suggestion = suggestion
+        # enclosing control-flow chain of block_idx, e.g.
+        # "while@block0.op3 > conditional_block@block1.op2" (None/"" at top
+        # level) — makes sub-block findings actionable from proglint output
+        self.block_path = block_path
+        # rank the finding belongs to, for world-level (DL1xx/MEM) rules
+        self.rank = rank
 
     def location(self):
         if self.op_idx is None:
-            return "program"
-        return "block %s op %s" % (
-            0 if self.block_idx is None else self.block_idx, self.op_idx)
+            where = "program"
+        else:
+            where = "block %s op %s" % (
+                0 if self.block_idx is None else self.block_idx, self.op_idx)
+            if self.block_path:
+                where += " in %s" % self.block_path
+        if self.rank is not None:
+            where = "rank %s %s" % (self.rank, where)
+        return where
 
     def format(self):
         line = "%s %s [%s]: %s" % (self.rule, self.severity.upper(),
@@ -226,6 +251,32 @@ def _runtime_ops(block):
     positions in block.ops so diagnostics point at the real op list."""
     return [(i, op) for i, op in enumerate(block.ops)
             if op.type not in _PLUMBING]
+
+
+def _block_paths(program):
+    """Map block idx -> enclosing control-flow chain as a readable string
+    (e.g. ``"while@block0.op3 > conditional_block@block1.op2"``; "" for the
+    global block).  Built from the ``sub_block`` attr the control-flow
+    layers stamp on while/conditional_block/recurrent ops, so a diagnostic
+    raised inside a nested sub-block names the op chain that reaches it."""
+    parent_edge = {}  # child block idx -> (op type, parent block idx, op idx)
+    for blk in program.blocks:
+        for op_idx, op in enumerate(blk.ops):
+            sub = op.attr("sub_block")
+            if sub is None:
+                continue
+            sub = getattr(sub, "idx", sub)  # attr may hold a Block or an int
+            parent_edge[int(sub)] = (op.type, blk.idx, op_idx)
+    paths = {}
+    for blk in program.blocks:
+        segs, idx, seen = [], blk.idx, set()
+        while idx in parent_edge and idx not in seen:
+            seen.add(idx)
+            op_type, pidx, oidx = parent_edge[idx]
+            segs.append("%s@block%d.op%d" % (op_type, pidx, oidx))
+            idx = pidx
+        paths[blk.idx] = " > ".join(reversed(segs))
+    return paths
 
 
 def _opdef_or_none(op_type):
@@ -663,6 +714,7 @@ def _check_collectives(program, rep, expected_nranks=None):
                 % (meta["nranks"], expected_nranks),
                 suggestion="re-run GradAllReduce.transpile for the new "
                 "endpoint list before recompiling")
+    paths = _block_paths(program)
     for blk in program.blocks:
         rings = []
         missing = []
@@ -679,7 +731,8 @@ def _check_collectives(program, rep, expected_nranks=None):
             if int(ring) < 0:
                 rep.add(ERROR, "DL003",
                         "collective op %s has negative ring_id %s"
-                        % (op.type, ring), blk.idx, op_idx)
+                        % (op.type, ring), blk.idx, op_idx,
+                        block_path=paths.get(blk.idx))
             else:
                 rings.append(int(ring))
         for op_idx, op in missing:
@@ -690,6 +743,7 @@ def _check_collectives(program, rep, expected_nranks=None):
                        " while others in the block use rings %s"
                        % sorted(set(rings)) if rings else ""),
                     blk.idx, op_idx,
+                    block_path=paths.get(blk.idx),
                     suggestion="assign a ring_id (transpiler round-robins "
                     "0..nrings-1)")
         if not nranks or int(nranks) <= 0:
@@ -1057,17 +1111,27 @@ _checked = {}
 _CHECKED_CAP = 1024
 
 
-def check_before_compile(program, feed_names, fetch_names, scope=None):
+def check_before_compile(program, feed_names, fetch_names, scope=None,
+                         feed_shapes=None):
     """Executor compile-path hook (cache-miss only).  Flag-gated:
     ``off`` returns after one flag read; ``warn`` logs + counts; ``error``
     raises ProgramVerificationError.  Results are memoized per (program,
     version, signature) so repeated compiles of one program (new feed
-    shapes) don't re-verify."""
+    shapes) don't re-verify.
+
+    Beyond the single-program families, this runs the per-rank subset of
+    the world-level checks (core/world_analysis.py): DL103 divergent
+    control flow, DL104-lite ring allocation, and the MEM001-003 static
+    peak-HBM estimator — `feed_shapes` (name -> concrete shape) lets the
+    estimate use the real batch instead of -1 placeholders, so the
+    FLAGS_hbm_budget_bytes gate fires pre-compile instead of on chip."""
     mode = _mode()
     if mode == "off":
         return None
+    shape_sig = tuple(sorted((n, tuple(s))
+                             for n, s in (feed_shapes or {}).items()))
     key = (getattr(program, "_uid", id(program)), program.version,
-           tuple(sorted(feed_names)), tuple(fetch_names), mode)
+           tuple(sorted(feed_names)), tuple(fetch_names), shape_sig, mode)
     if key in _checked:
         return _checked[key]
     scope_names = set()
@@ -1079,6 +1143,15 @@ def check_before_compile(program, feed_names, fetch_names, scope=None):
             pass
         s = getattr(s, "parent", None)
     rep = verify_program(program, feed_names, fetch_names, scope_names)
+    try:
+        from . import world_analysis
+
+        world_analysis.annotate_rank_checks(program, rep, feed_names,
+                                            fetch_names,
+                                            feed_shapes=feed_shapes)
+    except Exception as exc:  # estimator crash must never kill a run
+        warnings.warn("static world check failed internally: %r" % exc,
+                      ProgramVerifyWarning, stacklevel=2)
     if len(_checked) >= _CHECKED_CAP:
         _checked.clear()
     _checked[key] = rep

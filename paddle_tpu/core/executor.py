@@ -302,7 +302,10 @@ class Executor:
 
             _cc.enable_xla_cache()
             check_before_compile(program, list(feed_arrays), fetch_names,
-                                 scope=scope)
+                                 scope=scope,
+                                 feed_shapes={n: tuple(a.shape)
+                                              for n, a in
+                                              feed_arrays.items()})
             t_build = time.perf_counter()
             build = self._build(program, list(feed_arrays), fetch_names,
                                 mesh, data_axis)
@@ -846,7 +849,9 @@ class Executor:
 
         _cc.enable_xla_cache()
         check_before_compile(program, list(feed_arrays), fetch_names,
-                             scope=scope)
+                             scope=scope,
+                             feed_shapes={n: tuple(a.shape)
+                                          for n, a in feed_arrays.items()})
         t0 = time.perf_counter()
         build = self._build(program, list(feed_arrays), fetch_names, mesh,
                             data_axis, devices=devices)

@@ -42,6 +42,8 @@ pollers and abort.  Survivor ordering at clean exit: members leave first,
 the coordinator leaves last, so no live poller ever sees a dead service.
 """
 
+import hashlib
+import json
 import logging
 import os
 import socket
@@ -102,6 +104,24 @@ def _port_free(port):
         return False
     finally:
         s.close()
+
+
+def _world_fingerprint(*programs):
+    """Structural hash of program IR (op types, wiring, attrs).
+
+    Taken at standby build time — right after the full world-level verify —
+    and checked again at adoption: an equal fingerprint proves the view is
+    byte-for-byte the IR that already passed DL101-104, so adoption can
+    skip the (expensive) sibling-rank materialization; any mutation in
+    between forces the full blocking re-verify instead."""
+    h = hashlib.sha1()
+    for prog in programs:
+        for blk in prog.blocks:
+            for op in blk.ops:
+                h.update(json.dumps(op.to_dict(), sort_keys=True,
+                                    default=repr).encode())
+            h.update(b"|")
+    return h.hexdigest()
 
 
 def member_env():
@@ -611,10 +631,23 @@ class ElasticMember:
         standby = self._take_standby(view) if old_epoch >= 0 else None
         if standby is not None:
             # pre-transpiled + pre-verified in the background after the
-            # last adoption: both phases are already paid
+            # last adoption: the transpile phase is already paid, and the
+            # verify is too IF the IR fingerprint still matches what was
+            # hashed right after the standby-build verify.  In error mode
+            # a view tampered or staled between build and adoption fails
+            # that check and goes through the full world-level re-verify,
+            # which raises — it can never be adopted with a latent
+            # deadlock.
             main, startup = standby["main"], standby["startup"]
             phases["transpile"] = 0.0
+            tampered = (_flag("static_check") == "error"
+                        and _world_fingerprint(main, startup)
+                        != standby.get("verified_fp"))
             phases["verify"] = 0.0
+            if tampered:
+                tv = time.perf_counter()
+                self._verify(main, startup, world, pid=pid)
+                phases["verify"] = (time.perf_counter() - tv) * 1e3
         else:
             # re-transpile pristine programs for the new world + verify the
             # rewrite loudly BEFORE any recompile (DL001-006, error mode)
@@ -635,7 +668,7 @@ class ElasticMember:
                         current_endpoint=self.members[self.rank],
                         wait_port=False)
             t2 = time.perf_counter()
-            self._verify(main, startup, world)
+            self._verify(main, startup, world, pid=pid)
             phases["transpile"] = (t2 - t1) * 1e3
             phases["verify"] = (time.perf_counter() - t2) * 1e3
         # the pool only held subsets of the OLD view; rebuild below
@@ -694,7 +727,7 @@ class ElasticMember:
             phases["compile"], phases["restore"])
         self._spawn_standby()
 
-    def _verify(self, main, startup, world):
+    def _verify(self, main, startup, world, pid=None):
         from ..core import analysis
 
         for prog, label in ((main, "main"), (startup, "startup")):
@@ -703,6 +736,24 @@ class ElasticMember:
                 fetch_names=self.fetch_names if prog is main else (),
                 label="elastic epoch %d %s" % (self.view.epoch, label),
                 expected_nranks=world)
+            if rep.errors:
+                raise analysis.ProgramVerificationError(rep)
+        # whole-world pass: materialize the sibling ranks from the
+        # pristine base programs and match THIS view's collective schedule
+        # against them in lockstep (DL101-104 + the MEM estimator) — a
+        # standby or re-transpiled view carrying a latent cross-rank
+        # deadlock can never be adopted
+        if pid is not None and int(world) > 1:
+            from ..core import world_analysis
+
+            rep = world_analysis.verify_world(
+                self.base_main, self.base_startup, world,
+                nrings=self.nrings,
+                actual={int(pid): (main, startup)},
+                feed_names=list(self.feed_names or ()) or None,
+                fetch_names=list(self.fetch_names or ()),
+                label="elastic epoch %d world of %d"
+                      % (self.view.epoch, int(world)))
             if rep.errors:
                 raise analysis.ProgramVerificationError(rep)
 
@@ -761,7 +812,7 @@ class ElasticMember:
                     endpoints=endpoints,
                     current_endpoint=self.members[self.rank],
                     wait_port=False)
-        self._verify(main, startup, world)
+        self._verify(main, startup, world, pid=pid)
         rec = {"ranks": ranks, "main": main, "startup": startup,
                "flags_sig": self._standby_flags_sig(),
                "base_versions": (self.base_main.version,
@@ -799,6 +850,10 @@ class ElasticMember:
                         logging.warning("[elastic] standby pre-compile for "
                                         "world %s failed: %s", list(ranks), e)
                         _tm.inc("elastic_standby_errors_total")
+        # hash AFTER the warmup pre-compile: the executor may fuse
+        # optimizer ops in place there, and the adoption-time check must
+        # see the IR exactly as it will be handed over
+        rec["verified_fp"] = _world_fingerprint(main, startup)
         with self._standby_lock:
             self._standby[frozenset(ranks)] = rec
         _tm.inc("elastic_standby_built_total")

@@ -75,6 +75,12 @@ _DEFAULTS = {
     # per-variable attribution of the argument footprint.  Diagnostic; adds
     # one extra AOT compile per cache entry, so default-off.
     "FLAGS_hbm_audit": False,
+    # per-replica HBM budget (bytes) for the static peak estimator
+    # (core/world_analysis.py MEM003): when > 0, a predicted peak above
+    # the budget becomes a MEM003 diagnostic pre-compile (error mode
+    # raises) instead of an on-chip band-edge trip.  0 disables the gate;
+    # MEM001 (the estimate itself) is always reported at info level.
+    "FLAGS_hbm_budget_bytes": 0,
     # max param rank eligible for horizontal optimizer fusion
     # (ir.py FuseOptimizerOpsPass).  2 fuses BERT's [h,h]/[h,4h] encoder
     # weights into one fused_adam group (the r5 wgrad/Adam residue) while

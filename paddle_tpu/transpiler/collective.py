@@ -104,6 +104,20 @@ class Collective:
         self.current_endpoint = current_endpoint
         self.nranks = len(endpoints)
         self._ring_bytes = [0.0] * max(int(self.nrings), 1)
+        # whole-world post-transpile check (FLAGS_static_check=error only):
+        # keep pristine clones so world_analysis can materialize the
+        # sibling ranks and match this rank's collective schedule against
+        # them — a stale or divergent rewrite (DL101/DL102) raises here
+        # instead of hanging the world at the first exchange.  The
+        # materializer's own transpiles skip this (reentrancy guard).
+        pristine_main = pristine_startup = None
+        from ..core import analysis as _analysis
+        from ..core import world_analysis as _world
+
+        if (self.nranks > 1 and _analysis._mode() == "error"
+                and not _world._materializing()):
+            pristine_main = main_program.clone()
+            pristine_startup = startup_program.clone()
         self._transpile_startup_program()
         self._transpile_main_program()
         # world-size provenance for the static verifier (DL005/DL006) and
@@ -118,6 +132,10 @@ class Collective:
         main_program._collective_meta = dict(meta)
         startup_program._collective_meta = dict(meta)
         self._record_telemetry(meta)
+        if pristine_main is not None:
+            _world.check_world_transpiled(
+                pristine_main, pristine_startup, main_program,
+                startup_program, rank, self.nranks, nrings=self.nrings)
 
     def _meta_extra(self):
         return {}

@@ -93,6 +93,11 @@ def main(argv=None):
                     "cache (compile_cache_* hit/miss/store/eviction/error "
                     "counters, load/store latency) and the executor's "
                     "trace/lower/XLA-compile breakdown")
+    ap.add_argument("--lint", action="store_true", dest="lint_only",
+                    help="show only static-checker metrics: per-rule "
+                    "static_check_warnings counters and the whole-world "
+                    "verifier's static_check_world_* run/finding counters "
+                    "and rank/peak-HBM gauges")
     args = ap.parse_args(argv)
 
     if args.json_path:
@@ -113,6 +118,9 @@ def main(argv=None):
                                    "executor_xla_", "executor_trace_",
                                    "executor_cache_", "executor_aot_",
                                    "executor_warmup"))
+    if args.lint_only:
+        # covers static_check_warnings{rule=} and static_check_world_*
+        snap = _filter_snap(snap, "static_check")
 
     if args.raw:
         json.dump(snap, sys.stdout, indent=1)

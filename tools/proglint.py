@@ -17,6 +17,15 @@ or the bundled model zoo, and prints structured diagnostics.
     # also lint grad programs and a transpiled 2-pserver split
     python tools/proglint.py --grad --transpile 2
 
+    # whole-world check: materialize every rank of an 8-device 4x2
+    # (dp x tp) world, match collective schedules across ranks
+    # (DL101-DL104) and report the static per-replica peak-HBM
+    # estimate (MEM001-MEM003)
+    python tools/proglint.py --world 8 --mesh 4x2
+
+    # same, over the ZeRO-1 int8-wire collective path with a budget
+    python tools/proglint.py --world 4 --zero1 --mem-budget 8e9
+
 Exit status: 0 when clean, 1 when any error- or warning-severity
 diagnostic was found (info findings are advisory; --strict makes them
 fail too).  The run_ci.sh --lint leg runs this with
@@ -51,7 +60,41 @@ def main(argv=None):
                     help="print the annotated text op-graph per program")
     ap.add_argument("--strict", action="store_true",
                     help="info-severity findings also fail the run")
+    ap.add_argument("--world", type=int, default=0, metavar="N",
+                    help="materialize every rank of an N-device world and "
+                    "run the cross-rank collective-schedule + peak-HBM "
+                    "checks (DL101-DL104, MEM001-MEM003)")
+    ap.add_argument("--mesh", metavar="DPxTP", default=None,
+                    help="world layout as dpxtp, e.g. 4x2 (default Nx1); "
+                    "dp is the collective world, tp shards within a rank")
+    ap.add_argument("--zero1", action="store_true",
+                    help="verify the ZeRO-1 sharded collective path "
+                    "(int8 wire) instead of plain allreduce")
+    ap.add_argument("--mem-budget", type=float, default=0, metavar="BYTES",
+                    help="per-replica HBM budget for the static estimator; "
+                    "a predicted peak above this is a MEM003 error")
+    ap.add_argument("--batch", type=int, default=32, metavar="B",
+                    help="batch size assumed for -1 dims in the static "
+                    "peak-HBM estimate (default 32)")
+    ap.add_argument("--seed-defect", choices=["dl101"], default=None,
+                    help="self-test: drop the last rank's first "
+                    "collective from its materialized program before "
+                    "matching — must be reported as DL101 with that "
+                    "rank and op index (verifies the checker detects "
+                    "a rank-divergent schedule end to end)")
     args = ap.parse_args(argv)
+
+    mesh = None
+    if args.mesh:
+        try:
+            dp, tp = (int(p) for p in args.mesh.lower().split("x"))
+        except ValueError:
+            ap.error("--mesh wants DPxTP, e.g. 4x2; got %r" % args.mesh)
+        mesh = (dp, tp)
+        if not args.world:
+            args.world = dp * tp
+    if args.world and mesh is None:
+        mesh = (args.world, 1)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import paddle_tpu as fluid
@@ -126,6 +169,59 @@ def main(argv=None):
                 check(analysis.verify_program(
                     t.get_pserver_program(ep),
                     label="%s/pserver %s" % (name, ep)))
+
+        if args.world > 0:
+            from paddle_tpu.core import world_analysis
+            # rebuild fresh: --transpile may have rewritten main_p in
+            # place, and inference-only builders need a grad graph
+            # before the collective transpiler has anything to rewrite
+            wmain, wstartup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(wmain, wstartup):
+                _, wfetches = builders[name]()
+                if not any(int(op.attr(OP_ROLE_KEY) or 0) & OpRole.Optimize
+                           for op in wmain.global_block().ops):
+                    fluid.optimizer.SGD(learning_rate=0.01).minimize(
+                        wfetches[0])
+            actual = None
+            if args.seed_defect == "dl101":
+                # materialize under the same collective mode verify_world
+                # will use, or the seeded rank diverges for the wrong
+                # reason (mode mismatch instead of the dropped op)
+                overrides = {"FLAGS_collective_mode": "zero1",
+                             "FLAGS_allreduce_dtype": "int8"} \
+                    if args.zero1 else {}
+                saved = fluid.get_flags(list(overrides))
+                fluid.set_flags(overrides)
+                try:
+                    worlds = world_analysis.materialize_world(
+                        wmain, wstartup, mesh[0])
+                finally:
+                    fluid.set_flags(saved)
+                tm, ts = worlds[mesh[0] - 1]
+                tb = tm.global_block()
+                drop = next(
+                    (i for i, op in enumerate(tb.ops)
+                     if op.type.startswith("c_allgather")),
+                    next(i for i, op in enumerate(tb.ops)
+                         if op.type in world_analysis._COLLECTIVE_OPS))
+                print("%s: seeded defect — dropped %s at op %d from "
+                      "rank %d" % (name, tb.ops[drop].type, drop,
+                                   mesh[0] - 1))
+                del tb.ops[drop]
+                actual = {mesh[0] - 1: (tm, ts)}
+            check(world_analysis.verify_world(
+                wmain, wstartup, mesh[0],
+                mesh=mesh,
+                declared_world=args.world,
+                actual=actual,
+                feed_names=feed_names, fetch_names=fetch_names,
+                batch=args.batch,
+                mem_budget=int(args.mem_budget) or None,
+                collective_mode="zero1" if args.zero1 else None,
+                wire_dtype="int8" if args.zero1 else None,
+                label="%s world %d mesh %dx%d%s"
+                      % (name, args.world, mesh[0], mesh[1],
+                         " zero1" if args.zero1 else "")))
 
     print("proglint: %s" % ("FAIL (%d finding(s))" % failed[0]
                             if failed[0] else "PASS"))

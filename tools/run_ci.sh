@@ -16,6 +16,20 @@ if [ "$MODE" = "--lint" ]; then
   echo "== lint: proglint over bundled models (FLAGS_static_check=error) =="
   JAX_PLATFORMS=cpu FLAGS_static_check=error \
     python tools/proglint.py --grad --transpile 2
+  echo "== lint: world verifier tests =="
+  JAX_PLATFORMS=cpu python -m pytest tests/test_world_verifier.py -q
+  echo "== lint: whole-world checks (dp2 / dp4xtp2 / zero1) =="
+  # every rank of each world is materialized and its collective schedule
+  # lockstep-matched (DL101-DL104) + peak-HBM-estimated (MEM001-MEM003);
+  # keep to the two fast zoo models so the leg stays O(seconds)
+  JAX_PLATFORMS=cpu FLAGS_static_check=error \
+    python tools/proglint.py --builtin mnist_mlp --builtin word2vec --world 2
+  JAX_PLATFORMS=cpu FLAGS_static_check=error \
+    python tools/proglint.py --builtin mnist_mlp --builtin word2vec \
+    --world 8 --mesh 4x2
+  JAX_PLATFORMS=cpu FLAGS_static_check=error \
+    python tools/proglint.py --builtin mnist_mlp --builtin word2vec \
+    --world 2 --zero1
   echo "CI --lint: PASS"
   exit 0
 fi
