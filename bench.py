@@ -678,6 +678,8 @@ def main():
                                               "BENCH_CHUNK", "120")),
                                           data_format=data_format)
         tfs = img_per_sec * _resnet50_train_flops_per_image() / 1e12
+        from paddle_tpu.pallas_kernels import adoption
+
         print(json.dumps(dict({
             "metric": "resnet50_train_images_per_sec_per_chip",
             "value": round(img_per_sec, 2),
@@ -685,6 +687,10 @@ def main():
             "vs_baseline": round(img_per_sec / H100_RESNET50_IMG_PER_SEC, 4),
             "model_tflops_per_sec": round(tfs, 1),
             "mfu_vs_v5e_peak": round(tfs / V5E_BF16_PEAK_TFLOPS, 4),
+            # which Pallas fused-block kernels actually engaged during the
+            # run (BASELINE.md round-9: a kernel adopted without a probe
+            # row next to BENCH_*.json is an invalid capture)
+            "pallas_kernels_active": adoption.active_kernels(),
         }, **_telemetry_stats())))
 
 

@@ -272,7 +272,11 @@ class Executor:
             ["FLAGS_use_pallas_layer_norm", "FLAGS_check_nan_inf",
              "FLAGS_bn_stat_subsample",
              "FLAGS_fused_small_attention",
-             "FLAGS_layout_match_params"]).items()))
+             "FLAGS_layout_match_params",
+             "FLAGS_use_pallas_conv_block",
+             "FLAGS_use_pallas_fused_opt",
+             "FLAGS_use_pallas_embedding_bag",
+             "FLAGS_deterministic_reduction"]).items()))
         # mesh keyed by content, not id(): a GC'd Mesh's successor can alias
         # the address exactly like the Program case above
         mesh_key = None
@@ -828,7 +832,11 @@ class Executor:
             ["FLAGS_use_pallas_layer_norm", "FLAGS_check_nan_inf",
              "FLAGS_bn_stat_subsample",
              "FLAGS_fused_small_attention",
-             "FLAGS_layout_match_params"]).items()))
+             "FLAGS_layout_match_params",
+             "FLAGS_use_pallas_conv_block",
+             "FLAGS_use_pallas_fused_opt",
+             "FLAGS_use_pallas_embedding_bag",
+             "FLAGS_deterministic_reduction"]).items()))
         mesh_key = None
         if mesh is not None:
             mesh_key = (tuple(mesh.shape.items()),

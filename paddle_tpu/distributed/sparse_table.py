@@ -221,6 +221,68 @@ class DistributedEmbedding:
         out = fluid.layers.gather(rows, local)
         return out
 
+    def lookup_bag(self, batch_size, bag_size, batch_ids_max):
+        """Bagged (multi-hot) lookup: each sample carries up to `bag_size`
+        feature ids; the step computes Out[b] = sum of that sample's rows —
+        the recommender read pattern.  Emits ONE `embedding_bag` op over
+        the pulled [batch_ids_max, D] rows with [B, K] local ids (-1 pads
+        ragged bags), which routes to the block-sparse Pallas gather/sum
+        kernel under FLAGS_use_pallas_embedding_bag (probe-gated,
+        pallas_kernels/adoption.py) and to the masked take+sum composition
+        otherwise.  Feed with prepare_feed_bags()."""
+        import paddle_tpu as fluid
+        from ..layer_helper import LayerHelper
+
+        self.max_rows = batch_ids_max
+        self.bag_size = bag_size
+        rows = fluid.layers.data(self.rows_name,
+                                 shape=[batch_ids_max, self.dim],
+                                 append_batch_size=False,
+                                 stop_gradient=False)
+        local = fluid.layers.data(self.local_ids_name,
+                                  shape=[batch_size, bag_size],
+                                  dtype="int64", append_batch_size=False)
+        helper = LayerHelper("embedding_bag", name=self.table + "_bag")
+        out = helper.create_variable_for_type_inference(rows.dtype)
+        helper.append_op(
+            type="embedding_bag",
+            inputs={"W": [rows], "Ids": [local]},
+            outputs={"Out": [out]},
+            attrs={"mode": "sum"},
+        )
+        return out
+
+    def prepare_feed_bags(self, bags):
+        """Pull rows for ragged per-sample id bags; returns
+        (feed_dict, push_info).  `bags`: sequence of B id sequences (each
+        at most bag_size long); shorter bags are -1-padded."""
+        if self.max_rows is None or getattr(self, "bag_size", None) is None:
+            raise RuntimeError("call lookup_bag() during program build first")
+        flat = np.concatenate(
+            [np.asarray(b, np.int64).reshape(-1) for b in bags]) \
+            if len(bags) else np.zeros((0,), np.int64)
+        uniq, inverse = np.unique(flat, return_inverse=True)
+        U = len(uniq)
+        if U > self.max_rows:
+            raise ValueError(
+                "batch touches %d unique rows > batch_ids_max=%d"
+                % (U, self.max_rows))
+        rows = self.client.pull(uniq)
+        padded = np.zeros((self.max_rows, self.dim), np.float32)
+        padded[:U] = rows
+        local = np.full((len(bags), self.bag_size), -1, np.int64)
+        off = 0
+        for i, b in enumerate(bags):
+            k = len(b)
+            if k > self.bag_size:
+                raise ValueError("bag %d has %d ids > bag_size=%d"
+                                 % (i, k, self.bag_size))
+            local[i, :k] = inverse[off:off + k]
+            off += k
+        return ({self.rows_name: padded,
+                 self.local_ids_name: local},
+                {"uniq": uniq, "n": U, "batch": len(bags)})
+
     def grad_var(self, program):
         name = self.rows_name + "@GRAD"
         return program.global_block().var(name)

@@ -26,6 +26,7 @@ __all__ = [
     "matmul",
     "mul",
     "conv2d",
+    "conv2d_bn_relu",
     "conv2d_transpose",
     "pool2d",
     "adaptive_pool2d",
@@ -799,6 +800,105 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
                 attrs={"axis": 1 if data_format == "NCHW" else 3},
             )
     return helper.append_activation(pre_act)
+
+
+def conv2d_bn_relu(input, num_filters, filter_size, stride=1, padding=0,
+                   dilation=1, groups=1, param_attr=None, bn_param_attr=None,
+                   bn_bias_attr=None, act="relu", momentum=0.9, epsilon=1e-5,
+                   is_test=False, moving_mean_name=None,
+                   moving_variance_name=None, name=None, data_format="NCHW"):
+    """Fused conv + batch-norm (+ relu) trunk block: ONE `conv2d_bn_relu`
+    op instead of the conv2d / batch_norm / relu triple, so the lowering
+    can route the whole block to the Pallas fused kernel
+    (FLAGS_use_pallas_conv_block, probe-gated — pallas_kernels/adoption.py)
+    and falls back to the exact composition otherwise.  The conv carries
+    no bias: the BN affine absorbs it (the reference's conv_bn_fuse_pass
+    precondition).  Only act in (None, "relu") is expressible."""
+    if act not in (None, "relu"):
+        raise ValueError("conv2d_bn_relu supports act None or 'relu', got %r"
+                         % (act,))
+    helper = LayerHelper("conv2d_bn_relu", name=name)
+    dtype = input.dtype
+    num_channels = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    groups = groups or 1
+    if isinstance(filter_size, int):
+        filter_size = [filter_size, filter_size]
+    stride = [stride, stride] if isinstance(stride, int) else list(stride)
+    padding = [padding, padding] if isinstance(padding, int) else list(padding)
+    dilation = [dilation, dilation] if isinstance(dilation, int) \
+        else list(dilation)
+    filter_shape = [num_filters, num_channels // groups] + list(filter_size)
+
+    import math as _math
+
+    from ..initializer import Constant, Normal
+
+    fan_in = (num_channels // groups) * filter_size[0] * filter_size[1]
+    w = helper.create_parameter(
+        attr=param_attr, shape=filter_shape, dtype=dtype,
+        default_initializer=Normal(0.0, _math.sqrt(2.0 / fan_in)),
+    )
+    scale_p = helper.create_parameter(
+        attr=bn_param_attr, shape=[num_filters], dtype=dtype,
+        default_initializer=Constant(1.0)
+    )
+    bias_p = helper.create_parameter(
+        attr=bn_bias_attr, shape=[num_filters], dtype=dtype, is_bias=True,
+        default_initializer=Constant(0.0)
+    )
+    mean = helper.create_or_get_global_variable(
+        name=moving_mean_name or helper.name + ".mean",
+        shape=[num_filters], dtype=dtype, persistable=True
+    )
+    mean.stop_gradient = True
+    variance = helper.create_or_get_global_variable(
+        name=moving_variance_name or helper.name + ".var",
+        shape=[num_filters], dtype=dtype, persistable=True
+    )
+    variance.stop_gradient = True
+    if not getattr(mean, "_bn_initialized", False):
+        Constant(0.0)(mean)
+        Constant(1.0)(variance)
+        mean._bn_initialized = True
+        variance._bn_initialized = True
+
+    saved_mean = helper.create_variable_for_type_inference(
+        dtype, stop_gradient=True
+    )
+    saved_var = helper.create_variable_for_type_inference(
+        dtype, stop_gradient=True
+    )
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="conv2d_bn_relu",
+        inputs={
+            "Input": [input],
+            "Filter": [w],
+            "Scale": [scale_p],
+            "Bias": [bias_p],
+            "Mean": [mean],
+            "Variance": [variance],
+        },
+        outputs={
+            "Output": [out],
+            "MeanOut": [mean],
+            "VarianceOut": [variance],
+            "SavedMean": [saved_mean],
+            "SavedVariance": [saved_var],
+        },
+        attrs={
+            "strides": stride,
+            "paddings": padding,
+            "dilations": dilation,
+            "groups": groups,
+            "data_format": data_format,
+            "momentum": momentum,
+            "epsilon": epsilon,
+            "is_test": is_test,
+            "with_relu": act == "relu",
+        },
+    )
+    return out
 
 
 def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,

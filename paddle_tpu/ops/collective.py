@@ -65,10 +65,36 @@ def _pprod(x, axis):
 def c_allreduce_sum(ctx, x, ring_id=0, scale=1.0, **_):
     """psum with the gradient-averaging scale folded in (post-reduce
     multiply), so the transpilers stop emitting a standalone per-gradient
-    scale op.  scale=1.0 is a plain sum."""
+    scale op.  scale=1.0 is a plain sum.
+
+    FLAGS_deterministic_reduction replaces psum with all_gather + a
+    fixed-order pairwise tree reduce: psum's reduction order is the
+    backend's choice (ring segments, rank topology), so the same shards
+    can sum to different bits on different launches/world sizes — the
+    dp-sharded reduction-reassociation term in the dp4_tp2 parity gap.
+    The tree below is a pure function of nranks, so the grad sum is
+    bit-reproducible across launches (and matches any other consumer of
+    the same tree).  Costs gather bandwidth (n*|x| vs the ring's 2*|x|);
+    a debug/parity tool, not the fast path."""
     axis = _axis_for_ring(ctx, ring_id)
     if axis is not None:
-        x = lax.psum(x, axis)
+        from .. import flags as _flags
+
+        if _flags.flag("deterministic_reduction"):
+            gathered = lax.all_gather(x, axis)  # [nranks, ...]
+            terms = [gathered[i] for i in range(gathered.shape[0])]
+            # fixed-order pairwise tree: adjacent pairs each level, odd
+            # tail promoted unchanged.  Static python loop — the order is
+            # baked into the HLO, identical on every rank and launch.
+            while len(terms) > 1:
+                nxt = [terms[i] + terms[i + 1]
+                       for i in range(0, len(terms) - 1, 2)]
+                if len(terms) % 2:
+                    nxt.append(terms[-1])
+                terms = nxt
+            x = terms[0]
+        else:
+            x = lax.psum(x, axis)
     if scale != 1.0:
         x = x * jnp.asarray(scale, x.dtype)
     return x

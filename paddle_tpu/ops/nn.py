@@ -394,6 +394,70 @@ def batch_norm_grad(ctx, x, scale, bias, saved_mean, saved_inv_std, dy,
 
 
 @register_op(
+    "conv2d_bn_relu",
+    inputs=("Input", "Filter", "Scale", "Bias", "Mean", "Variance"),
+    outputs=("Output", "MeanOut", "VarianceOut", "SavedMean",
+             "SavedVariance"),
+    attrs={"strides": [1, 1], "paddings": [0, 0], "dilations": [1, 1],
+           "groups": 1, "data_format": "NCHW", "momentum": 0.9,
+           "epsilon": 1e-5, "is_test": False, "with_relu": True},
+    no_grad_inputs=("Mean", "Variance"),
+)
+def conv2d_bn_relu(ctx, x, w, scale, bias, mean, variance, strides=(1, 1),
+                   paddings=(0, 0), dilations=(1, 1), groups=1,
+                   data_format="NCHW", momentum=0.9, epsilon=1e-5,
+                   is_test=False, with_relu=True, **_):
+    """Fused conv + batch-norm (+ relu) trunk block — the reference's
+    conv_bn_fuse_pass / conv2d_fusion analogue.  Routes to the Pallas
+    block kernel when FLAGS_use_pallas_conv_block + eligibility + the
+    probe gate all pass (pallas_kernels/adoption.py); otherwise lowers to
+    the exact conv2d + _bn_impl (+ relu) composition, so the op is safe to
+    emit unconditionally.  SavedVariance holds the INVERSE std, mirroring
+    batch_norm.  Gradients come from the auto grad maker (jax.vjp over
+    this lowering; the kernel path carries a custom_vjp that routes its
+    backward through the reference composition)."""
+    from ..pallas_kernels import adoption, conv_block
+
+    f32 = jnp.float32
+    checks = conv_block.conv_block_checks(
+        x.shape, w.shape, strides, paddings, dilations, groups, data_format,
+        jnp.dtype(x.dtype).itemsize)
+    use_kernel, _ = adoption.decide(
+        "conv_block", flag="FLAGS_use_pallas_conv_block", checks=checks)
+    if use_kernel:
+        stride, pad = int(strides[0]), int(paddings[0])
+        if is_test:
+            y = conv_block.conv_bn_relu_inference(
+                x, w, scale, bias, mean, variance, epsilon, stride, pad,
+                bool(with_relu))
+            m, v = mean.astype(f32), variance.astype(f32)
+            new_mean, new_var = mean, variance
+        else:
+            y, m, v = conv_block.conv_bn_relu_train(
+                x, w, scale, bias, epsilon, stride, pad, bool(with_relu))
+            new_mean = momentum * mean + (1 - momentum) * m.astype(mean.dtype)
+            new_var = momentum * variance + (1 - momentum) * v.astype(
+                variance.dtype)
+        inv = 1.0 / jnp.sqrt(v + epsilon)
+        return y, new_mean, new_var, m, inv
+    # fallback: the general composition (any stride/padding/dilation/groups,
+    # AMP handled by the conv2d lowering)
+    conv = conv2d(ctx, x, w, strides, paddings, dilations, groups,
+                  data_format)
+    nchw = data_format in ("NCHW", "AnyLayout")
+    c_ax = 1 if nchw else conv.ndim - 1
+    axes = tuple(i for i in range(conv.ndim) if i != c_ax)
+    cshape = [1] * conv.ndim
+    cshape[c_ax] = conv.shape[c_ax]
+    y, new_mean, new_var, m, inv, _r = _bn_impl(
+        conv, scale, bias, mean, variance, axes, cshape, momentum, epsilon,
+        is_test)
+    if with_relu:
+        y = jnp.maximum(y, jnp.zeros((), y.dtype))
+    return y, new_mean, new_var, m, inv
+
+
+@register_op(
     "layer_norm",
     inputs=("X", "Scale", "Bias"),
     outputs=("Y", "Mean", "Variance"),
@@ -409,29 +473,27 @@ def layer_norm(ctx, x, scale, bias, epsilon=1e-5, begin_norm_axis=1):
     # int-only np.prod below — they take the jnp composition branch
     concrete = all(isinstance(d, int) and d > 0 for d in x.shape)
     if concrete and scale is not None and bias is not None:
-        from .. import flags as _flags
+        from ..pallas_kernels import adoption
+        from ..pallas_kernels.layer_norm import layer_norm_2d, ln_checks
 
-        use_kernel = _flags.get_flags(["FLAGS_use_pallas_layer_norm"])[
-            "FLAGS_use_pallas_layer_norm"]
+        R = int(_np.prod(lead)) if lead else 1
+        C = int(_np.prod(tail)) if tail else 1
+        use_kernel, _ = adoption.decide(
+            "layer_norm", flag="FLAGS_use_pallas_layer_norm",
+            checks=ln_checks(R, C))
         if use_kernel:
-            from ..pallas_kernels.layer_norm import (can_use_pallas_ln,
-                                                     layer_norm_2d)
-
-            R = int(_np.prod(lead)) if lead else 1
-            C = int(_np.prod(tail)) if tail else 1
-            if can_use_pallas_ln(R, C):
-                # fused single-pass kernel: wins standalone (5.44 vs
-                # 6.27 ms at BERT shapes, f32-stat accuracy) but loses
-                # in-program on the bench chip (719.7 vs 730.6 seqs/s —
-                # it breaks XLA's LN-neighbor fusions), hence opt-in.
-                # Mean/Variance cast to x.dtype so the op's output
-                # dtypes don't depend on the flag
-                y2, m2, v2 = layer_norm_2d(
-                    x.reshape(R, C), scale.reshape(C), bias.reshape(C),
-                    epsilon)
-                return (y2.reshape(x.shape),
-                        m2.astype(x.dtype).reshape(lead),
-                        v2.astype(x.dtype).reshape(lead))
+            # fused single-pass kernel: wins standalone (5.44 vs
+            # 6.27 ms at BERT shapes, f32-stat accuracy) but loses
+            # in-program on the bench chip (719.7 vs 730.6 seqs/s —
+            # it breaks XLA's LN-neighbor fusions), hence opt-in.
+            # Mean/Variance cast to x.dtype so the op's output
+            # dtypes don't depend on the flag
+            y2, m2, v2 = layer_norm_2d(
+                x.reshape(R, C), scale.reshape(C), bias.reshape(C),
+                epsilon)
+            return (y2.reshape(x.shape),
+                    m2.astype(x.dtype).reshape(lead),
+                    v2.astype(x.dtype).reshape(lead))
     axes = tuple(range(begin_norm_axis, x.ndim))
     # bf16 inputs (the AMP carry dtype) get f32 internal statistics — an
     # 8-bit-mantissa mean/var costs accuracy (same policy as the Pallas

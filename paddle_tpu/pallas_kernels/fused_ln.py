@@ -269,14 +269,29 @@ def _use_pallas(x2, y2):
     if x2.dtype != y2.dtype:
         raise AssertionError(
             "fused_ln internal: operands must share a dtype by this point")
-    if not _HAS_PALLAS or jax.default_backend() != "tpu":
-        return None
+    from . import adoption
+
     n, h = x2.shape
-    if not isinstance(n, int):
-        return None  # symbolic shape inference: take the jnp path
-    if h % _LANES != 0:
-        return None
-    return _pick_rows(n, h, x2.dtype.itemsize)
+    concrete = isinstance(n, int)
+    rows = None
+    if _HAS_PALLAS and concrete and h % _LANES == 0:
+        rows = _pick_rows(n, h, x2.dtype.itemsize)
+    # the shared adoption funnel (counts fallbacks; flag-less: this kernel
+    # engages by default on TPU).  require_probe=False: adoption predates
+    # the probe protocol and is pinned by in-step BASELINE r5 captures —
+    # the round-3 LN lesson is that a microbench probe is necessary but
+    # not sufficient, so the in-step number outranks it here.
+    use, _ = adoption.decide(
+        "fused_ln",
+        checks=[
+            ("no_pallas", _HAS_PALLAS),
+            ("backend", jax.default_backend() == "tpu"),
+            ("symbolic_shape", concrete),
+            ("lanes", h % _LANES == 0),
+            ("block_rows", rows is not None),
+        ],
+        require_probe=False)
+    return rows if use else None
 
 
 def _fwd_any(x2, y2, gamma, beta, seed, thr, eps):

@@ -49,9 +49,23 @@ def _pick_block_r(R):
     return None
 
 
+def ln_checks(R, C):
+    """Ordered (reason, ok) eligibility pairs for adoption.decide() — the
+    shared funnel that replaced this module's private copy of the gate
+    (fused_ln.py carried a near-duplicate; both now feed adoption.py so a
+    fallback is a counted event, not a silent branch)."""
+    return [
+        ("no_pallas", _HAS_PALLAS),
+        ("backend", jax.default_backend() == "tpu"),
+        ("lanes", C % 128 == 0),
+        ("block_rows", _pick_block_r(R) is not None),
+    ]
+
+
 def can_use_pallas_ln(R, C):
-    return (_HAS_PALLAS and jax.default_backend() == "tpu"
-            and C % 128 == 0 and _pick_block_r(R) is not None)
+    """Pure eligibility (no flag/probe/telemetry) — tests use this to
+    assert the kernel would engage for a shape."""
+    return all(ok for _, ok in ln_checks(R, C))
 
 
 def _fwd_pallas(x, g, b, eps):

@@ -1,0 +1,569 @@
+"""Pallas fused-block kernels (conv+bn+relu, fused optimizer step,
+block-sparse embedding-bag) + the shared probe-gated adoption funnel.
+
+Coverage model:
+
+- interpret-mode CPU parity for every kernel family against its jnp
+  fallback (the ISSUE acceptance bar) — forward AND gradients, where the
+  gradients must route through the fallback's VJP;
+- the fused optimizer step is held to BITWISE equality with the unfused
+  fused_adam/fused_momentum jnp path over 3 chained steps, including the
+  bf16 param-carry copies;
+- adoption.decide() unit behavior: flag-off inertness, first-failing-check
+  reason ordering, the >=1.1x probe gate (disk rows + in-memory
+  registrations + the interpret-mode waiver), and the telemetry counters;
+- FLAGS_deterministic_reduction: the fixed-order pairwise tree in
+  c_allreduce_sum is bit-reproducible against a host-side replay of the
+  same tree.
+
+Everything here runs on the CPU tier: PADDLE_PALLAS_INTERPRET=1 (set per
+test by the autouse fixture) routes the kernels through the Pallas
+interpreter and waives the backend/probe adoption checks.
+"""
+
+import types
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu.core import telemetry
+from paddle_tpu.distributed.sparse_table import DistributedEmbedding
+from paddle_tpu.ops import collective as coll_ops
+from paddle_tpu.ops import manip as manip_ops
+from paddle_tpu.ops import nn as nn_ops
+from paddle_tpu.ops import optimizer_ops as opt_ops
+from paddle_tpu.pallas_kernels import adoption
+from paddle_tpu.pallas_kernels import conv_block
+from paddle_tpu.pallas_kernels import embedding_bag as bag
+from paddle_tpu.pallas_kernels import fused_opt
+
+_FLAGS = ("FLAGS_use_pallas_conv_block", "FLAGS_use_pallas_fused_opt",
+          "FLAGS_use_pallas_embedding_bag", "FLAGS_use_pallas_layer_norm",
+          "FLAGS_deterministic_reduction", "FLAGS_telemetry")
+
+
+@pytest.fixture(autouse=True)
+def _pallas_env(monkeypatch):
+    """Interpret mode on, adoption/telemetry state clean, flags restored."""
+    monkeypatch.setenv("PADDLE_PALLAS_INTERPRET", "1")
+    saved = fluid.get_flags(list(_FLAGS))
+    adoption.reset()
+    telemetry.reset()
+    yield
+    fluid.set_flags(saved)
+    adoption.reset()
+    telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# adoption funnel
+# ---------------------------------------------------------------------------
+
+
+class TestAdoption:
+    def test_flag_off_is_inert(self):
+        fluid.set_flags({"FLAGS_telemetry": True,
+                         "FLAGS_use_pallas_conv_block": False})
+        use, reason = adoption.decide(
+            "conv_block", flag="FLAGS_use_pallas_conv_block",
+            checks=[("never_reached", False)])
+        assert (use, reason) == (False, "flag_off")
+        # inert: neither counter moved, nothing recorded active
+        assert telemetry.counter_total("pallas_kernel_used_total") == 0
+        assert telemetry.counter_total("pallas_kernel_fallback_total") == 0
+        assert adoption.active_kernels() == []
+
+    def test_first_failing_check_is_the_reason(self):
+        fluid.set_flags({"FLAGS_telemetry": True,
+                         "FLAGS_use_pallas_conv_block": True})
+        use, reason = adoption.decide(
+            "conv_block", flag="FLAGS_use_pallas_conv_block",
+            checks=[("a", True), ("b", False), ("c", False)])
+        assert (use, reason) == (False, "b")
+        assert telemetry.counter_total("pallas_kernel_fallback_total") == 1
+        assert adoption.active_kernels() == []
+
+    def test_probe_gate(self, monkeypatch, tmp_path):
+        # outside interpret mode the >=1.1x probe row is mandatory
+        monkeypatch.delenv("PADDLE_PALLAS_INTERPRET", raising=False)
+        monkeypatch.setenv("PADDLE_PALLAS_PROBE_DIR", str(tmp_path))
+        adoption.reset()
+        fluid.set_flags({"FLAGS_use_pallas_fused_opt": True})
+        assert adoption.decide(
+            "fused_opt", flag="FLAGS_use_pallas_fused_opt") \
+            == (False, "no_probe")
+        adoption.register_probe("fused_opt", 1.05)
+        assert adoption.decide(
+            "fused_opt", flag="FLAGS_use_pallas_fused_opt") \
+            == (False, "probe_below_min")
+        adoption.register_probe("fused_opt", 1.4)
+        assert adoption.decide(
+            "fused_opt", flag="FLAGS_use_pallas_fused_opt") == (True, "ok")
+        assert adoption.active_kernels() == ["fused_opt"]
+
+    def test_probe_rows_load_from_disk(self, monkeypatch, tmp_path):
+        # JSONL rows as op_bench --pallas --save-probe writes them; the
+        # best speedup across rows wins
+        monkeypatch.delenv("PADDLE_PALLAS_INTERPRET", raising=False)
+        (tmp_path / "embedding_bag.json").write_text(
+            '{"kernel": "embedding_bag", "speedup": 1.3}\n'
+            '{"kernel": "embedding_bag", "speedup": 1.7}\n')
+        (tmp_path / "corrupt.json").write_text("{not json")
+        monkeypatch.setenv("PADDLE_PALLAS_PROBE_DIR", str(tmp_path))
+        adoption.reset()
+        assert adoption.probe_speedup("embedding_bag") == 1.7
+        fluid.set_flags({"FLAGS_use_pallas_embedding_bag": True})
+        assert adoption.decide(
+            "embedding_bag", flag="FLAGS_use_pallas_embedding_bag") \
+            == (True, "ok")
+
+    def test_interpret_mode_waives_probe(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("PADDLE_PALLAS_PROBE_DIR", str(tmp_path))
+        adoption.reset()
+        fluid.set_flags({"FLAGS_use_pallas_conv_block": True})
+        assert adoption.decide(
+            "conv_block", flag="FLAGS_use_pallas_conv_block") == (True, "ok")
+
+    def test_used_counter_and_flagless_kernel(self):
+        fluid.set_flags({"FLAGS_telemetry": True})
+        # fused_ln is flag-less (default-on family): flag=None skips the
+        # flag read entirely
+        assert adoption.decide("fused_ln", require_probe=False) == (True, "ok")
+        assert telemetry.counter_total("pallas_kernel_used_total") == 1
+        assert adoption.active_kernels() == ["fused_ln"]
+
+
+class TestLayerNormGate:
+    def test_ln_checks_consolidated(self):
+        from paddle_tpu.pallas_kernels.layer_norm import (can_use_pallas_ln,
+                                                          ln_checks)
+        reasons = dict(ln_checks(256, 256))
+        # backend stays STRICT for this family (its pallas_call has no
+        # interpret plumbing), so on the CPU tier the kernel never engages
+        # even under PADDLE_PALLAS_INTERPRET=1
+        if jax.default_backend() != "tpu":
+            assert reasons["backend"] is False
+            assert can_use_pallas_ln(256, 256) is False
+        assert dict(ln_checks(256, 100))["lanes"] is False
+
+
+# ---------------------------------------------------------------------------
+# conv + bn + relu block
+# ---------------------------------------------------------------------------
+
+
+def _conv_inputs(seed=0, n=2, c=8, h=8, co=8, k=3):
+    rng = np.random.RandomState(seed)
+    x = jnp.asarray(rng.randn(n, c, h, h), jnp.float32)
+    w = jnp.asarray(rng.randn(co, c, k, k) * 0.1, jnp.float32)
+    scale = jnp.asarray(rng.rand(co) + 0.5, jnp.float32)
+    bias = jnp.asarray(rng.randn(co) * 0.1, jnp.float32)
+    mean = jnp.asarray(rng.randn(co) * 0.1, jnp.float32)
+    var = jnp.asarray(rng.rand(co) + 0.5, jnp.float32)
+    return x, w, scale, bias, mean, var
+
+
+class TestConvBlock:
+    @pytest.mark.parametrize("stride,relu", [(1, True), (2, True),
+                                             (1, False)])
+    def test_train_forward_parity(self, stride, relu):
+        x, w, scale, bias, _, _ = _conv_inputs()
+        y, m, v = conv_block.conv_bn_relu_train(x, w, scale, bias, 1e-5,
+                                                stride, 1, relu)
+        yr, mr, vr = conv_block.conv_bn_relu_reference(
+            x, w, scale, bias, None, None, eps=1e-5, stride=stride, pad=1,
+            relu=relu, is_test=False)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(yr),
+                                   atol=1e-4, rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(m), np.asarray(mr),
+                                   atol=1e-4, rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(v), np.asarray(vr),
+                                   atol=1e-4, rtol=1e-4)
+
+    @pytest.mark.parametrize("stride,relu", [(1, True), (2, False)])
+    def test_inference_forward_parity(self, stride, relu):
+        x, w, scale, bias, mean, var = _conv_inputs(seed=1)
+        y = conv_block.conv_bn_relu_inference(x, w, scale, bias, mean, var,
+                                              1e-5, stride, 1, relu)
+        yr, _, _ = conv_block.conv_bn_relu_reference(
+            x, w, scale, bias, mean, var, eps=1e-5, stride=stride, pad=1,
+            relu=relu, is_test=True)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(yr),
+                                   atol=1e-4, rtol=1e-4)
+
+    def test_train_grads_via_fallback_vjp(self):
+        """The kernel path's backward IS the reference composition's VJP,
+        so its grads must match jax.grad through the reference exactly."""
+        x, w, scale, bias, _, _ = _conv_inputs(seed=2)
+        rng = np.random.RandomState(3)
+        ct = jnp.asarray(rng.randn(2, 8, 8, 8), jnp.float32)
+
+        def k_loss(x, w, s, b):
+            y, _, _ = conv_block.conv_bn_relu_train(x, w, s, b, 1e-5, 1, 1,
+                                                    True)
+            return jnp.sum(y * ct)
+
+        def r_loss(x, w, s, b):
+            y, _, _ = conv_block.conv_bn_relu_reference(
+                x, w, s, b, None, None, eps=1e-5, stride=1, pad=1,
+                relu=True, is_test=False)
+            return jnp.sum(y * ct)
+
+        gk = jax.grad(k_loss, (0, 1, 2, 3))(x, w, scale, bias)
+        gr = jax.grad(r_loss, (0, 1, 2, 3))(x, w, scale, bias)
+        for a, b in zip(gk, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-5, rtol=1e-5)
+
+    def test_inference_grads_via_fallback_vjp(self):
+        x, w, scale, bias, mean, var = _conv_inputs(seed=4)
+        rng = np.random.RandomState(5)
+        ct = jnp.asarray(rng.randn(2, 8, 8, 8), jnp.float32)
+        k = jax.grad(lambda *a: jnp.sum(
+            conv_block.conv_bn_relu_inference(*a, 1e-5, 1, 1, True) * ct),
+            (0, 1))(x, w, scale, bias, mean, var)
+        r = jax.grad(lambda *a: jnp.sum(conv_block.conv_bn_relu_reference(
+            *a, eps=1e-5, stride=1, pad=1, relu=True, is_test=True)[0] * ct),
+            (0, 1))(x, w, scale, bias, mean, var)
+        for a, b in zip(k, r):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-5, rtol=1e-5)
+
+    def test_op_level_kernel_vs_fallback(self):
+        """The registered conv2d_bn_relu lowering: flag on (kernel) vs
+        flag off (conv2d + _bn_impl composition), all five outputs."""
+        x, w, scale, bias, mean, var = _conv_inputs(seed=6)
+        args = dict(strides=[1, 1], paddings=[1, 1], momentum=0.9,
+                    epsilon=1e-5, is_test=False, with_relu=True)
+        fluid.set_flags({"FLAGS_use_pallas_conv_block": False})
+        ref = nn_ops.conv2d_bn_relu(None, x, w, scale, bias, mean, var,
+                                    **args)
+        assert adoption.active_kernels() == []
+        fluid.set_flags({"FLAGS_use_pallas_conv_block": True})
+        got = nn_ops.conv2d_bn_relu(None, x, w, scale, bias, mean, var,
+                                    **args)
+        assert "conv_block" in adoption.active_kernels()
+        for a, b in zip(got, ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-4, rtol=1e-4)
+
+    def test_checks_reasons(self):
+        # eligible ResNet-ish shape: every check passes (backend is waived
+        # by the fixture's PADDLE_PALLAS_INTERPRET=1)
+        assert all(v for _, v in conv_block.conv_block_checks(
+            (2, 8, 8, 8), (8, 8, 3, 3), [1, 1], [1, 1]))
+        assert dict(conv_block.conv_block_checks(
+            (2, 8, 8, 8), (8, 4, 3, 3), [1, 1], [1, 1],
+            groups=2))["groups"] is False
+        assert dict(conv_block.conv_block_checks(
+            (2, 8, 8, 8), (8, 8, 3, 3), [1, 1], [1, 1],
+            dilations=(2, 2)))["dilation"] is False
+        assert dict(conv_block.conv_block_checks(
+            (2, 8, 8, 8), (8, 8, 3, 3), [1, 1], [1, 1],
+            data_format="NHWC"))["layout"] is False
+        assert dict(conv_block.conv_block_checks(
+            (2, 8, 8, 8), (8, 8, 3, 3), [3, 3], [1, 1]))["stride"] is False
+
+    def test_program_level_layer(self):
+        """layers.conv2d_bn_relu through the Executor: same program, same
+        scope, flag off then on (the flag is part of the executor's trace
+        cache key, so the second run recompiles on the kernel path)."""
+        rng = np.random.RandomState(7)
+        xv = rng.randn(2, 8, 8, 8).astype(np.float32)
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[8, 8, 8], dtype="float32")
+            out = fluid.layers.conv2d_bn_relu(x, num_filters=8,
+                                              filter_size=3, padding=1)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            fluid.set_flags({"FLAGS_use_pallas_conv_block": False})
+            ref, = exe.run(main, feed={"x": xv}, fetch_list=[out])
+            fluid.set_flags({"FLAGS_use_pallas_conv_block": True})
+            got, = exe.run(main, feed={"x": xv}, fetch_list=[out])
+        np.testing.assert_allclose(got, ref, atol=1e-4, rtol=1e-4)
+        assert "conv_block" in adoption.active_kernels()
+
+
+# ---------------------------------------------------------------------------
+# fused optimizer step
+# ---------------------------------------------------------------------------
+
+
+def _opt_group(seed=0, shapes=((7,), (33, 9), (8, 128))):
+    rng = np.random.RandomState(seed)
+    params = [jnp.asarray(rng.randn(*s), jnp.float32) for s in shapes]
+    return rng, shapes, params
+
+
+class TestFusedOpt:
+    def test_adam_bitwise_three_steps(self):
+        """Kernel path vs the unfused jnp path of the SAME registered
+        fused_adam op: every output bitwise-equal over 3 chained steps
+        (odd member sizes force the block zero-padding)."""
+        rng, shapes, params = _opt_group()
+        lr = jnp.asarray([1e-3], jnp.float32)
+        z = lambda: [jnp.zeros(s, jnp.float32) for s in shapes]
+        one = lambda v: [jnp.asarray([v], jnp.float32) for _ in shapes]
+        ref = {"p": params, "m1": z(), "m2": z(),
+               "b1": one(0.9), "b2": one(0.999)}
+        ker = {k: list(v) for k, v in ref.items()}
+        # the bitwise contract is for the executor's setting, where the
+        # whole step is traced and compiled together — jit both paths (a
+        # FRESH jit per flag value: the flag is read at trace time).
+        # Eagerly-dispatched primitives may differ by an FMA-fusion ulp.
+        step = lambda p, g, m1, m2, b1, b2: opt_ops.fused_adam(
+            None, p, g, m1, m2, lr, b1, b2)
+        for _step in range(3):
+            grads = [jnp.asarray(rng.randn(*s), jnp.float32)
+                     for s in shapes]
+            fluid.set_flags({"FLAGS_use_pallas_fused_opt": False})
+            r = jax.jit(lambda *a: step(*a))(
+                ref["p"], grads, ref["m1"], ref["m2"], ref["b1"], ref["b2"])
+            fluid.set_flags({"FLAGS_use_pallas_fused_opt": True})
+            k = jax.jit(lambda *a: step(*a))(
+                ker["p"], grads, ker["m1"], ker["m2"], ker["b1"], ker["b2"])
+            for r_list, k_list in zip(r, k):
+                for a, b in zip(r_list, k_list):
+                    np.testing.assert_array_equal(np.asarray(a),
+                                                  np.asarray(b))
+            ref = dict(zip(("p", "m1", "m2", "b1", "b2"), r))
+            ker = dict(zip(("p", "m1", "m2", "b1", "b2"), k))
+        assert "fused_opt" in adoption.active_kernels()
+
+    def test_adam_bf16_carry_bitwise(self):
+        """The kernel's bf16 copies must equal p_new.astype(bfloat16) —
+        the exact cast build_block_fn would emit for the param carry."""
+        rng, shapes, params = _opt_group(seed=1)
+        grads = [jnp.asarray(rng.randn(*s), jnp.float32) for s in shapes]
+        z = [jnp.zeros(s, jnp.float32) for s in shapes]
+        pows = [jnp.asarray([0.9], jnp.float32) for _ in shapes]
+        p_news, _, _, _, _, bfs = fused_opt.fused_adam_step(
+            params, grads, z, list(z), jnp.asarray([1e-3], jnp.float32),
+            pows, [jnp.asarray([0.999], jnp.float32) for _ in shapes])
+        for p, bf in zip(p_news, bfs):
+            assert bf.dtype == jnp.bfloat16
+            np.testing.assert_array_equal(
+                np.asarray(bf), np.asarray(p.astype(jnp.bfloat16)))
+
+    @pytest.mark.parametrize("nesterov", [False, True])
+    def test_momentum_bitwise(self, nesterov):
+        rng, shapes, params = _opt_group(seed=2)
+        grads = [jnp.asarray(rng.randn(*s), jnp.float32) for s in shapes]
+        vels = [jnp.asarray(rng.randn(*s), jnp.float32) for s in shapes]
+        lr = jnp.asarray([0.01], jnp.float32)
+        step = lambda p, g, v: opt_ops.fused_momentum(
+            None, p, g, v, lr, mu=0.9, use_nesterov=nesterov)
+        fluid.set_flags({"FLAGS_use_pallas_fused_opt": False})
+        r = jax.jit(lambda *a: step(*a))(params, grads, vels)
+        fluid.set_flags({"FLAGS_use_pallas_fused_opt": True})
+        k = jax.jit(lambda *a: step(*a))(params, grads, vels)
+        for r_list, k_list in zip(r, k):
+            for a, b in zip(r_list, k_list):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert "fused_opt" in adoption.active_kernels()
+
+    def test_momentum_l2_decay_stays_on_jnp_path(self):
+        # the l2 fold reads p_flat anyway, so the kernel is not consulted
+        rng, shapes, params = _opt_group(seed=3)
+        grads = [jnp.asarray(rng.randn(*s), jnp.float32) for s in shapes]
+        vels = [jnp.zeros(s, jnp.float32) for s in shapes]
+        fluid.set_flags({"FLAGS_use_pallas_fused_opt": True})
+        opt_ops.fused_momentum(None, params, grads, vels,
+                               jnp.asarray([0.01], jnp.float32), mu=0.9,
+                               regularization_method="l2_decay",
+                               regularization_coeff=1e-4)
+        assert adoption.active_kernels() == []
+
+    def test_stash_bf16_carry(self):
+        op = types.SimpleNamespace(input=lambda slot: ["w0", "w1"])
+        env = {"w0@MASTER": object()}
+        ctx = types.SimpleNamespace(op=op, env=env)
+        bfs = [jnp.zeros((2,), jnp.bfloat16), jnp.ones((2,), jnp.bfloat16)]
+        fused_opt.stash_bf16_carry(ctx, bfs)
+        assert "w0@PALLAS_BF16" in env       # carried param: stashed
+        assert "w1@PALLAS_BF16" not in env   # no master: no stash
+        fused_opt.stash_bf16_carry(None, bfs)  # ctx-less call is a no-op
+
+    def test_checks(self):
+        _, _, params = _opt_group(seed=4)
+        assert all(ok for _, ok in fused_opt.fused_opt_checks(
+            params, params, (params,)))
+        assert dict(fused_opt.fused_opt_checks([], []))["empty_group"] \
+            is False
+        bf = [p.astype(jnp.bfloat16) for p in params]
+        assert dict(fused_opt.fused_opt_checks(bf, params))["dtype"] is False
+
+
+# ---------------------------------------------------------------------------
+# block-sparse embedding bag
+# ---------------------------------------------------------------------------
+
+
+class TestEmbeddingBag:
+    def _data(self, seed=0, u=32, d=128, b=4, k=6, ragged=False):
+        rng = np.random.RandomState(seed)
+        rows = jnp.asarray(rng.randn(u, d), jnp.float32)
+        ids = rng.randint(0, u, size=(b, k)).astype(np.int64)
+        if ragged:
+            # ragged bags: tail of each bag -1-padded; one bag fully empty
+            for i in range(b):
+                ids[i, rng.randint(1, k):] = -1
+            ids[b - 1, :] = -1
+        return rows, jnp.asarray(ids)
+
+    def _expected(self, rows, ids):
+        rows, ids = np.asarray(rows), np.asarray(ids)
+        out = np.zeros((ids.shape[0], rows.shape[1]), np.float64)
+        for bi, row_ids in enumerate(ids):
+            for i in row_ids:
+                if i >= 0:
+                    out[bi] += rows[i]
+        return out.astype(np.float32)
+
+    @pytest.mark.parametrize("ragged", [False, True])
+    def test_forward_parity(self, ragged):
+        rows, ids = self._data(ragged=ragged)
+        out = bag.embedding_bag(rows, ids)
+        ref = bag.embedding_bag_reference(rows, ids)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(out),
+                                   self._expected(rows, ids),
+                                   atol=1e-4, rtol=1e-4)
+        if ragged:
+            # the all-padding bag sums to exactly zero
+            np.testing.assert_array_equal(np.asarray(out[-1]),
+                                          np.zeros(rows.shape[1],
+                                                   np.float32))
+
+    def test_grads_route_through_reference_vjp(self):
+        rows, ids = self._data(seed=1, ragged=True)
+        rng = np.random.RandomState(2)
+        ct = jnp.asarray(rng.randn(*(ids.shape[0], rows.shape[1])),
+                         jnp.float32)
+        # linear loss: the cotangent is `ct` on both paths, and the kernel
+        # backward IS the reference VJP, so the row grads match bitwise
+        gk = jax.grad(lambda r: jnp.sum(bag.embedding_bag(r, ids) * ct))(
+            rows)
+        gr = jax.grad(lambda r: jnp.sum(
+            bag.embedding_bag_reference(r, ids) * ct))(rows)
+        np.testing.assert_array_equal(np.asarray(gk), np.asarray(gr))
+
+    def test_op_level_flag_routing(self):
+        rows, ids = self._data(seed=3)
+        fluid.set_flags({"FLAGS_use_pallas_embedding_bag": False})
+        ref = manip_ops.embedding_bag(None, rows, ids)
+        assert adoption.active_kernels() == []
+        fluid.set_flags({"FLAGS_use_pallas_embedding_bag": True})
+        got = manip_ops.embedding_bag(None, rows, ids)
+        assert "embedding_bag" in adoption.active_kernels()
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+        with pytest.raises(ValueError):
+            manip_ops.embedding_bag(None, rows, ids, mode="mean")
+
+    def test_bag_checks_reasons(self):
+        f32 = jnp.float32
+        assert all(ok for _, ok in bag.bag_checks((32, 128), (4, 6), f32))
+        assert dict(bag.bag_checks((32, 100), (4, 6), f32))["row_width"] \
+            is False
+        assert dict(bag.bag_checks((32, 128), (24,), f32))["rank"] is False
+        assert dict(bag.bag_checks((32, 128), (4, 6),
+                                   jnp.int32))["dtype"] is False
+        assert dict(bag.bag_checks((0, 128), (4, 6), f32))["empty"] is False
+
+
+class TestSparseTableBags:
+    class _StubClient:
+        """pull() returns row i filled with i+1 — sums are predictable."""
+
+        def __init__(self, dim):
+            self.dim = dim
+
+        def pull(self, ids):
+            ids = np.asarray(ids, np.int64).reshape(-1)
+            if not len(ids):
+                return np.zeros((0, self.dim), np.float32)
+            return np.stack([np.full((self.dim,), float(i + 1), np.float32)
+                             for i in ids])
+
+    def test_lookup_bag_end_to_end(self):
+        """lookup_bag + prepare_feed_bags through the Executor, fallback
+        vs kernel path of the emitted embedding_bag op."""
+        d = 128
+        demb = DistributedEmbedding("tbl", d, client=self._StubClient(d))
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            out = demb.lookup_bag(batch_size=3, bag_size=4, batch_ids_max=8)
+        feed, info = demb.prepare_feed_bags([[5, 9], [9], []])
+        assert info["n"] == 2 and list(info["uniq"]) == [5, 9]
+        local = feed[demb.local_ids_name]
+        np.testing.assert_array_equal(
+            local, [[0, 1, -1, -1], [1, -1, -1, -1], [-1, -1, -1, -1]])
+        expected = np.zeros((3, d), np.float32)
+        expected[0] = 6.0 + 10.0   # rows 5 and 9 hold i+1
+        expected[1] = 10.0
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            fluid.set_flags({"FLAGS_use_pallas_embedding_bag": False})
+            ref, = exe.run(main, feed=feed, fetch_list=[out])
+            fluid.set_flags({"FLAGS_use_pallas_embedding_bag": True})
+            got, = exe.run(main, feed=feed, fetch_list=[out])
+        np.testing.assert_allclose(ref, expected, atol=1e-5)
+        np.testing.assert_allclose(got, expected, atol=1e-5)
+        assert "embedding_bag" in adoption.active_kernels()
+
+    def test_prepare_feed_bags_validates(self):
+        d = 128
+        demb = DistributedEmbedding("tbl2", d, client=self._StubClient(d))
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            demb.lookup_bag(batch_size=2, bag_size=2, batch_ids_max=3)
+        with pytest.raises(ValueError):       # bag longer than bag_size
+            demb.prepare_feed_bags([[1, 2, 3], [4]])
+        with pytest.raises(ValueError):       # too many unique rows
+            demb.prepare_feed_bags([[1, 2], [3, 4]])
+
+
+# ---------------------------------------------------------------------------
+# deterministic collective reduction
+# ---------------------------------------------------------------------------
+
+
+class TestDeterministicReduction:
+    def test_tree_reduce_is_bit_reproducible(self):
+        ndev = len(jax.devices())
+        if ndev < 2:
+            pytest.skip("needs >= 2 devices (virtual CPU mesh)")
+        ctx = types.SimpleNamespace(axis_names=("dp",), mesh=None)
+        rng = np.random.RandomState(0)
+        # wildly varying magnitudes make f32 summation order observable
+        xs = jnp.asarray(rng.randn(ndev, 4, 3)
+                         * (10.0 ** rng.randint(-4, 5, (ndev, 4, 3))),
+                         jnp.float32)
+        fluid.set_flags({"FLAGS_deterministic_reduction": True})
+        out = jax.pmap(lambda x: coll_ops.c_allreduce_sum(ctx, x),
+                       axis_name="dp")(xs)
+        # host-side replay of the same fixed-order pairwise tree, in f32
+        terms = [np.asarray(xs[i]) for i in range(ndev)]
+        while len(terms) > 1:
+            nxt = [terms[i] + terms[i + 1]
+                   for i in range(0, len(terms) - 1, 2)]
+            if len(terms) % 2:
+                nxt.append(terms[-1])
+            terms = nxt
+        for r in range(ndev):                 # every rank, identical bits
+            np.testing.assert_array_equal(np.asarray(out[r]), terms[0])
+        # and the tree agrees with psum up to reassociation error
+        fluid.set_flags({"FLAGS_deterministic_reduction": False})
+        psum = jax.pmap(lambda x: coll_ops.c_allreduce_sum(ctx, x),
+                        axis_name="dp")(xs)
+        np.testing.assert_allclose(np.asarray(out[0]), np.asarray(psum[0]),
+                                   rtol=1e-4, atol=1e-4)

@@ -93,6 +93,11 @@ def main(argv=None):
                     "cache (compile_cache_* hit/miss/store/eviction/error "
                     "counters, load/store latency) and the executor's "
                     "trace/lower/XLA-compile breakdown")
+    ap.add_argument("--kernels", action="store_true", dest="kernels_only",
+                    help="show only Pallas kernel-adoption metrics: the "
+                    "pallas_kernel_used_total{kernel} / "
+                    "pallas_kernel_fallback_total{kernel,reason} counters "
+                    "(pallas_kernels/adoption.py)")
     ap.add_argument("--lint", action="store_true", dest="lint_only",
                     help="show only static-checker metrics: per-rule "
                     "static_check_warnings counters and the whole-world "
@@ -118,6 +123,8 @@ def main(argv=None):
                                    "executor_xla_", "executor_trace_",
                                    "executor_cache_", "executor_aot_",
                                    "executor_warmup"))
+    if args.kernels_only:
+        snap = _filter_snap(snap, "pallas_kernel_")
     if args.lint_only:
         # covers static_check_warnings{rule=} and static_check_world_*
         snap = _filter_snap(snap, "static_check")
