@@ -909,8 +909,15 @@ class Executor:
         self._fuse_attempted.add(key)
         from .. import flags as _flags
 
-        if not _flags.get_flags(["FLAGS_fuse_optimizer_ops"])[
-                "FLAGS_fuse_optimizer_ops"]:
+        f = _flags.get_flags(["FLAGS_fuse_optimizer_ops",
+                              "FLAGS_deterministic_reduction"])
+        if not f["FLAGS_fuse_optimizer_ops"]:
+            return
+        if f["FLAGS_deterministic_reduction"]:
+            # the fused flat-buffer update lets XLA regroup FMAs with the
+            # surrounding HLO, so the same update computes different last
+            # ulps in different programs — incompatible with the bitwise
+            # cross-program parity deterministic mode promises
             return
         n_opt = sum(op.type in ("sgd", "momentum", "adam")
                     for op in block.ops)

@@ -316,6 +316,23 @@ def _any_tracer(args):
     return False
 
 
+def _constrain_replicated(a, sharding):
+    """Pin traced op inputs to a replicated layout (deterministic mode).
+
+    Under GSPMD the partitioner picks per-op shardings, and shard-shape-
+    dependent kernels (Eigen gemm tiling, fused FMA grouping) reassociate
+    f32 sums relative to the single-device program.  Forcing every op to
+    consume replicated operands makes the mesh trace reduce in exactly the
+    single-device order — bitwise parity, at gather-bandwidth cost.  Only
+    tracers are constrained; concrete compile-time constants pass through
+    untouched so constant folding keeps working."""
+    if isinstance(a, (list, tuple)):
+        return type(a)(_constrain_replicated(x, sharding) for x in a)
+    if isinstance(a, jax.core.Tracer):
+        return jax.lax.with_sharding_constraint(a, sharding)
+    return a
+
+
 def run_op(op, env, rng_key, mesh=None, axis_names=(), runner=None,
            data_axis=None):
     """Lower one op: gather inputs from env, call the lowering, scatter
@@ -325,6 +342,19 @@ def run_op(op, env, rng_key, mesh=None, axis_names=(), runner=None,
     opdef = get_op_def(op.type)
     record_executed(op.type)
     args = [_gather_slot(opdef, op, s, env) for s in opdef.input_slots]
+    if mesh is not None and not axis_names and op.type not in _AXIS_OPS:
+        from .. import flags as _flags
+
+        if _flags.flag("deterministic_reduction"):
+            # GSPMD mesh path: replicate every traced operand so sharded
+            # and single-device programs sum f32 in the same order (the
+            # dp-grad all-reduce becomes gather-then-reduce in canonical
+            # order).  Param/feed shardings at the block boundary are
+            # untouched — storage stays sharded.
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            repl = NamedSharding(mesh, PartitionSpec())
+            args = [_constrain_replicated(a, repl) for a in args]
     ctx = LowerCtx(rng_key=rng_key, op=op, block=op.block, mesh=mesh,
                    axis_names=axis_names, runner=runner, env=env,
                    data_axis=data_axis)

@@ -33,8 +33,8 @@ import time
 __all__ = [
     "enabled", "inc", "set_gauge", "observe", "event", "set_info",
     "record_step", "snapshot", "counter_total", "prometheus_text",
-    "dump", "maybe_dump", "reset", "publish_rpc", "decode_snapshot",
-    "scrape", "METRICS_RPC_KEY",
+    "dump", "maybe_dump", "reset", "publish_rpc", "start_publisher",
+    "decode_snapshot", "scrape", "METRICS_RPC_KEY",
 ]
 
 METRICS_RPC_KEY = "__metrics__"
@@ -350,6 +350,27 @@ def publish_rpc(server, key=METRICS_RPC_KEY):
 
     buf = json.dumps(snapshot(), default=str).encode("utf-8")
     server.set_var(key, np.frombuffer(buf, dtype=np.uint8).copy())
+
+
+def start_publisher(server, interval_s=1.0, key=METRICS_RPC_KEY,
+                    stop_event=None):
+    """Republish the snapshot on `server` every `interval_s` so scrapes
+    always read a fresh view (publish_rpc is one-shot).  Returns the stop
+    Event; set it to end the daemon thread.  The serving frontend uses
+    this for its __metrics__ endpoint."""
+    stop = stop_event or threading.Event()
+
+    def loop():
+        while not stop.wait(interval_s):
+            try:
+                publish_rpc(server, key=key)
+            except Exception:
+                return  # server shut down under us
+
+    publish_rpc(server, key=key)
+    threading.Thread(target=loop, name="telemetry-publisher",
+                     daemon=True).start()
+    return stop
 
 
 def decode_snapshot(arr):

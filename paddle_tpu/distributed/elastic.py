@@ -513,21 +513,10 @@ class ElasticMember:
             % (self.rank, self.members, window_s))
 
     def _probe_alive(self, member_endpoint):
-        try:
-            c = RpcClient(_ctrl_endpoint(member_endpoint),
-                          connect_timeout=1.0, rpc_deadline=3.0,
-                          retry_times=0)
-        except ConnectionError:
-            return None
-        try:
-            return [int(x) for x in c.get_var(_ALIVE)]
-        except Exception:
-            return None
-        finally:
-            try:
-                c.close()
-            except Exception:
-                pass
+        from ..native import rpc as _rpc
+
+        got = _rpc.probe(_ctrl_endpoint(member_endpoint), key=_ALIVE)
+        return None if got is None else [int(x) for x in got]
 
     def _connect_ctrl(self, coord_rank):
         for c in (self._ctrl, self._gate_c):

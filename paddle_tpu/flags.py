@@ -174,6 +174,28 @@ _DEFAULTS = {
     # base_port + epoch * stride (the old world's sockets are parked, not
     # closed — see elastic.py on why tearing them down is fatal)
     "FLAGS_elastic_port_stride": 29,
+    # continuous-batching inference serving (paddle_tpu/serving/):
+    # shape buckets the batcher pads request batches to — every bucket is
+    # AOT-compiled at startup (Executor.warmup against
+    # FLAGS_compile_cache_dir) so no request ever pays an XLA compile
+    "FLAGS_serving_buckets": "1,4,16,64",
+    # admission-queue depth cap; beyond it requests are shed with a
+    # retry-after instead of queued
+    "FLAGS_serving_max_queue": 256,
+    # default per-tenant deadline budget (ms): admission sheds a request
+    # when projected queue wait already exceeds it
+    "FLAGS_serving_deadline_ms": 2000.0,
+    # how long the batcher waits to coalesce more same-model requests
+    # toward the next larger bucket before dispatching (ms)
+    "FLAGS_serving_batch_window_ms": 2.0,
+    # serving-fleet replica heartbeat period / silence-eviction timeout
+    # (seconds) — the serving analog of the elastic quorum knobs; the
+    # fleet coordinator rewrites the endpoints file when a replica dies
+    "FLAGS_serving_hb_interval": 0.3,
+    "FLAGS_serving_hb_timeout": 2.0,
+    # where the fleet coordinator publishes the live endpoints JSON
+    # (clients re-read it to fail over); empty = no file
+    "FLAGS_serving_endpoints_file": "",
     # accepted no-ops (XLA/PJRT owns these concerns; benchmark's per-op
     # sync has no meaning under whole-block compilation)
     "FLAGS_benchmark": False,

@@ -24,18 +24,18 @@ __all__ = [
 
 
 def _enable_executable_cache(path):
-    """Route XLA's persistent compilation cache to `path`: executables
-    serialize to disk and later processes deserialize instead of
-    recompiling (jax compilation_cache; min-compile-time/entry-size gates
-    dropped so even small inference programs cache)."""
-    import jax
+    """Route compiled executables through the unified two-tier cache
+    (core/compile_cache.py): tier A is XLA's persistent cache wired by
+    enable_xla_cache(), tier B holds whole-step AOT artifacts — the same
+    store Executor.warmup and the elastic standby path use, so a serving
+    replica restores the buckets a trainer or earlier replica compiled."""
+    from . import flags as _flags
+    from .core import compile_cache as _cc
 
-    jax.config.update("jax_compilation_cache_dir", str(path))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
-    try:
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-    except Exception:
-        pass  # older knob name; defaults are fine
+    path = str(path)
+    if _flags.flag("compile_cache_dir") != path:
+        _flags.set_flags({"FLAGS_compile_cache_dir": path})
+    _cc.enable_xla_cache()
 
 
 class AnalysisConfig:
@@ -180,14 +180,20 @@ class AnalysisPredictor:
         self._config = config
         if config.optim_cache_dir():
             _enable_executable_cache(config.optim_cache_dir())
-        place = TPUPlace(config.gpu_device_id()) if config.use_gpu() \
-            else CPUPlace()
-        self._exe = Executor(place)
         if _shared is not None:
-            # clone: share program + scope (shared params, private caches)
-            self._program, self._feed_names, self._fetch_vars, self._scope = \
-                _shared
+            # clone: share program + scope (shared params, reference
+            # AnalysisPredictor::Clone) AND the Executor — its executable
+            # cache is per-instance, so a private Executor would recompile
+            # per clone; sharing it means N threaded clones hit ONE
+            # compiled executable (Executor.run is thread-safe for
+            # inference programs: the compiled fn is pure, params read from
+            # the shared scope)
+            (self._program, self._feed_names, self._fetch_vars, self._scope,
+             self._exe) = _shared
         else:
+            place = TPUPlace(config.gpu_device_id()) if config.use_gpu() \
+                else CPUPlace()
+            self._exe = Executor(place)
             import os
 
             self._scope = Scope()
@@ -279,7 +285,7 @@ class AnalysisPredictor:
         return AnalysisPredictor(
             self._config,
             _shared=(self._program, self._feed_names, self._fetch_vars,
-                     self._scope))
+                     self._scope, self._exe))
 
     def program(self):
         return self._program

@@ -13,7 +13,30 @@ from . import load
 from ..core import telemetry as _tm
 from ..utils.fault_injection import FaultInjected, maybe_fail
 
-__all__ = ["RpcServer", "RpcClient", "backoff_delay"]
+__all__ = ["RpcServer", "RpcClient", "backoff_delay", "probe"]
+
+
+def probe(endpoint, key="__alive__", timeout=3.0):
+    """One bounded GET of `key` against a server, None on any failure.
+
+    The shared liveness-probe idiom of the elastic control plane and the
+    serving fleet: connect fast (1 s), GET with a hard deadline, never
+    retry — a dead, hung, or not-yet-listening server all read as None,
+    and the probing caller decides what that means."""
+    try:
+        c = RpcClient(endpoint, connect_timeout=1.0, rpc_deadline=timeout,
+                      retry_times=0)
+    except ConnectionError:
+        return None
+    try:
+        return c.get_var(key)
+    except Exception:
+        return None
+    finally:
+        try:
+            c.close()
+        except Exception:
+            pass
 
 
 def backoff_delay(attempt, base=0.05, cap=2.0, rng=None):

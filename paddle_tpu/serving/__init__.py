@@ -1,0 +1,24 @@
+"""Continuous-batching inference serving (ROADMAP "Production inference
+serving").
+
+Composes the earlier subsystems into a multi-tenant serving path:
+``ServingEngine`` (admission queue + shape-bucketed continuous batching +
+AOT bucket prewarm through Executor.warmup / FLAGS_compile_cache_dir),
+``ServingServer``/``ServingClient`` (request-reply wire protocol over
+native/rpc.py with ``__metrics__`` scraping), and ``ServingFleet``
+(heartbeat/eviction membership reusing the elastic layer's liveness
+machinery, with client failover via the endpoints file).
+
+Entry points: ``tools/serve.py`` and ``tools/loadgen.py``.
+"""
+
+from .client import ServingClient, read_endpoints_file  # noqa: F401
+from .engine import InferReply, ServingEngine, parse_buckets  # noqa: F401
+from .fleet import ServingFleet, write_endpoints_file  # noqa: F401
+from .server import ServingServer  # noqa: F401
+
+__all__ = [
+    "ServingEngine", "ServingServer", "ServingClient", "ServingFleet",
+    "InferReply", "parse_buckets", "read_endpoints_file",
+    "write_endpoints_file",
+]

@@ -1,0 +1,156 @@
+"""Serving client: request/reply over the tensor-RPC wire + fleet
+failover.
+
+One ``infer`` is a send (``__infer__:<req_id>``) followed by a
+deadline-bounded blocking GET on ``__reply__:<req_id>`` — the transport
+parks the GET server-side until the dispatcher publishes the reply, so
+there is no polling loop.  Inference is pure (no server-side state
+mutation beyond counters), so on a dead/hung replica the request is
+simply REPLAYED against the next live endpoint; the endpoints file the
+fleet coordinator maintains (FLAGS_serving_endpoints_file) is re-read on
+every failure so a shrunk fleet stops receiving traffic for dead
+replicas.  A request is "dropped" only when every endpoint attempt fails
+— the loadgen asserts that count is zero through a SIGKILL.
+"""
+
+import json
+import os
+import time
+import uuid
+
+from ..native.rpc import RpcClient
+from . import codec
+from .engine import InferReply
+
+__all__ = ["ServingClient", "read_endpoints_file"]
+
+
+def _flag(name):
+    from .. import flags
+
+    return flags.flag(name)
+
+
+def read_endpoints_file(path):
+    """{"epoch": N, "endpoints": [...]} written by the fleet coordinator
+    (atomic rename, so a partial read can't happen)."""
+    with open(path) as f:
+        doc = json.load(f)
+    return [str(e) for e in doc.get("endpoints", [])]
+
+
+class ServingClient:
+    def __init__(self, endpoints=None, endpoints_file=None,
+                 tenant="default", deadline_ms=None):
+        self.endpoints_file = endpoints_file or \
+            _flag("serving_endpoints_file") or None
+        self._static = list(endpoints or [])
+        self.tenant = tenant
+        self.default_deadline_ms = float(
+            deadline_ms if deadline_ms is not None
+            else _flag("serving_deadline_ms"))
+        self._rr = 0
+        self.failovers = 0
+        if not self._static and not self.endpoints_file:
+            raise ValueError("ServingClient needs endpoints or an "
+                             "endpoints file")
+
+    def endpoints(self):
+        if self.endpoints_file:
+            try:
+                eps = read_endpoints_file(self.endpoints_file)
+                if eps:
+                    return eps
+            except (OSError, ValueError):
+                pass
+        return list(self._static)
+
+    # -- one-shot GET helpers ------------------------------------------------
+
+    def _get_packed(self, endpoint, key, timeout):
+        c = RpcClient(endpoint, connect_timeout=min(timeout, 5.0),
+                      rpc_deadline=timeout, retry_times=0)
+        try:
+            return codec.unpack(c.get_var(key))
+        finally:
+            c.close()
+
+    def spec(self, model, timeout=10.0):
+        """Feed/fetch signature published by the server (__spec__ RPC)."""
+        for ep in self.endpoints():
+            try:
+                meta, _ = self._get_packed(ep, codec.SPEC_KEY + model,
+                                           timeout)
+                return meta
+            except ConnectionError:
+                continue
+        raise ConnectionError("no live endpoint answered __spec__:%s"
+                              % model)
+
+    # -- inference -----------------------------------------------------------
+
+    def infer(self, model, feeds, deadline_ms=None, max_attempts=None):
+        """Run one request; fails over across live endpoints.  Returns an
+        InferReply whose status is ok|shed|timeout|error, or "dropped"
+        when every endpoint attempt failed."""
+        deadline_ms = float(deadline_ms or self.default_deadline_ms)
+        req_id = uuid.uuid4().hex
+        names = list(feeds)
+        payload = codec.pack(
+            {"model": model, "tenant": self.tenant, "req_id": req_id,
+             "deadline_ms": deadline_ms, "feeds": names},
+            [feeds[n] for n in names])
+        # reply wait: the request may sit a full deadline in the queue and
+        # then still be served — bound the GET at deadline + slack
+        get_timeout = deadline_ms / 1e3 + 30.0
+        t0 = time.perf_counter()
+        last_err = None
+        eps = self.endpoints()
+        attempts = int(max_attempts or max(2 * len(eps), 2))
+        for i in range(attempts):
+            if i:
+                self.failovers += 1
+                time.sleep(min(0.05 * i, 0.5))
+                eps = self.endpoints()
+            if not eps:
+                last_err = "endpoints file empty"
+                continue
+            ep = eps[self._rr % len(eps)]
+            self._rr += 1
+            try:
+                c = RpcClient(ep, connect_timeout=2.0,
+                              rpc_deadline=get_timeout, retry_times=0)
+                try:
+                    c.send_var(codec.INFER_KEY + req_id, payload)
+                    meta, arrays = codec.unpack(
+                        c.get_var(codec.REPLY_KEY + req_id))
+                finally:
+                    c.close()
+            except ConnectionError as e:
+                last_err = str(e)
+                continue
+            reply = InferReply(
+                meta.get("status", "error"),
+                outputs=dict(zip(meta.get("outputs", []), arrays)),
+                error=meta.get("error"),
+                retry_after_ms=meta.get("retry_after_ms", 0.0))
+            reply.latency_ms = (time.perf_counter() - t0) * 1e3
+            return reply
+        return InferReply(
+            "dropped", error="all %d attempts failed: %s"
+            % (attempts, last_err),
+            latency_ms=(time.perf_counter() - t0) * 1e3)
+
+    def alive(self, endpoint, timeout=3.0):
+        """[rank, epoch, is_coordinator] or None (rpc.probe contract)."""
+        from ..native import rpc as _rpc
+
+        got = _rpc.probe(endpoint, key=codec.ALIVE_KEY, timeout=timeout)
+        return None if got is None else [int(x) for x in got]
+
+    def scrape(self, endpoint=None, timeout=10.0):
+        """Live __metrics__ snapshot from one replica (default: first)."""
+        from ..core import telemetry
+
+        ep = endpoint or self.endpoints()[0]
+        return telemetry.scrape(ep, timeout=timeout)

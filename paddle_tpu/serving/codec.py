@@ -1,0 +1,64 @@
+"""Wire codec for the serving protocol (serving/server.py + client.py).
+
+The native tensor-RPC transport (native/rpc.py) moves ONE named ndarray
+per frame; an inference request/reply carries several arrays of mixed
+dtype plus metadata (model, tenant, deadline, status).  This codec packs
+that bundle into a single uint8 tensor: an 8-byte little-endian header
+length, a JSON header (metadata + per-array dtype/shape), then the raw
+array bytes concatenated — so one ``send_var``/``get_var`` round trip
+moves a whole request, and the existing framing/dedupe/retry machinery
+applies unchanged.
+
+Wire keys (PS-style __dunder__ namespace, next to ``__metrics__`` and the
+elastic ``__alive__``):
+
+  ``__infer__:<req_id>``   client -> server, packed request
+                           meta: model / tenant / req_id / deadline_ms
+  ``__reply__:<req_id>``   server -> client, packed reply
+                           meta: status ok|shed|timeout|error,
+                           retry_after_ms on shed, outputs name order
+  ``__spec__:<model>``     server-published feed/fetch signature + buckets
+                           (loadgen synthesizes valid feeds from it)
+"""
+
+import json
+
+import numpy as np
+
+__all__ = ["pack", "unpack", "INFER_KEY", "REPLY_KEY", "SPEC_KEY",
+           "ALIVE_KEY"]
+
+INFER_KEY = "__infer__:"
+REPLY_KEY = "__reply__:"
+SPEC_KEY = "__spec__:"
+ALIVE_KEY = "__alive__"
+
+
+def pack(meta, arrays=()):
+    """(meta dict, [ndarray, ...]) -> one uint8 ndarray."""
+    arrays = [np.ascontiguousarray(a) for a in arrays]
+    header = json.dumps({
+        "meta": meta,
+        "arrays": [{"dtype": a.dtype.str, "shape": list(a.shape)}
+                   for a in arrays],
+    }).encode("utf-8")
+    parts = [len(header).to_bytes(8, "little"), header]
+    parts.extend(a.tobytes() for a in arrays)
+    return np.frombuffer(b"".join(parts), dtype=np.uint8).copy()
+
+
+def unpack(arr):
+    """Inverse of pack: uint8 ndarray -> (meta dict, [ndarray, ...])."""
+    buf = np.ascontiguousarray(np.asarray(arr, dtype=np.uint8)).tobytes()
+    hlen = int.from_bytes(buf[:8], "little")
+    head = json.loads(buf[8:8 + hlen].decode("utf-8"))
+    out, off = [], 8 + hlen
+    for spec in head["arrays"]:
+        dt = np.dtype(spec["dtype"])
+        shape = tuple(spec["shape"])
+        n = dt.itemsize * int(np.prod(shape, dtype=np.int64)) \
+            if shape else dt.itemsize
+        out.append(np.frombuffer(buf[off:off + n], dtype=dt)
+                   .reshape(shape).copy())
+        off += n
+    return head["meta"], out

@@ -1,0 +1,452 @@
+"""Continuous-batching serving engine over AnalysisPredictor.
+
+The reference inference stack answers one request at a time
+(AnalysisPredictor::Run); under "heavy traffic from millions of users"
+(ROADMAP north star) that wastes the accelerator on batch-1 launches and
+recompiles on every new request shape.  The engine closes both gaps:
+
+- **admission queue with deadline-aware backpressure**: ``submit`` sheds
+  a request (status "shed" + retry_after_ms) instead of queueing it when
+  the projected wait — queue depth x the model's EWMA batch service time —
+  already exceeds the request's deadline budget, or when the queue is at
+  ``FLAGS_serving_max_queue``.  Queued requests whose deadline expires
+  before dispatch complete with status "timeout".
+- **shape-bucketed batching**: the dispatcher coalesces queued same-model
+  requests for up to ``FLAGS_serving_batch_window_ms`` and pads the
+  concatenated batch to the smallest configured bucket that fits
+  (``FLAGS_serving_buckets``), so every dispatch hits one of a FIXED set
+  of executable shapes.
+- **AOT bucket prewarm**: ``prewarm()`` runs ``Executor.warmup`` for every
+  (model, bucket) against ``FLAGS_compile_cache_dir`` — all executables
+  exist before the first request, and the prewarm manifest records where
+  each came from (memory/disk/compiled).  After that, a request can only
+  ever hit the in-memory executable cache: zero runtime compiles, provable
+  from the ``executor_cache_miss_total`` / ``compile_cache_*`` counters.
+
+Telemetry: ``serving_queue_depth`` gauge, ``serving_batch_fill`` +
+``serving_latency_ms`` histograms, ``serving_qps`` gauge (5 s window),
+``serving_requests_total{model,tenant}``, ``serving_shed_total{reason}``,
+``serving_timeout_total``, ``serving_batches_total{model,bucket}``.
+"""
+
+import threading
+import time
+import uuid
+
+import numpy as np
+
+from ..core import telemetry as _tm
+from ..core.executor import scope_guard
+
+__all__ = ["ServingEngine", "InferReply", "parse_buckets"]
+
+_QPS_WINDOW_S = 5.0
+
+
+def _flag(name):
+    from .. import flags
+
+    return flags.flag(name)
+
+
+def parse_buckets(spec=None):
+    """\"1,4,16\" (or an int sequence) -> sorted unique bucket tuple."""
+    if spec is None:
+        spec = _flag("serving_buckets")
+    if isinstance(spec, str):
+        sizes = [int(s) for s in spec.replace(" ", "").split(",") if s]
+    else:
+        sizes = [int(s) for s in spec]
+    if not sizes or any(s <= 0 for s in sizes):
+        raise ValueError("serving buckets must be positive ints: %r" % spec)
+    return tuple(sorted(set(sizes)))
+
+
+class InferReply:
+    """Terminal state of one request: status ok|shed|timeout|error."""
+
+    __slots__ = ("status", "outputs", "error", "retry_after_ms",
+                 "latency_ms")
+
+    def __init__(self, status, outputs=None, error=None,
+                 retry_after_ms=0.0, latency_ms=0.0):
+        self.status = status
+        self.outputs = outputs or {}
+        self.error = error
+        self.retry_after_ms = float(retry_after_ms)
+        self.latency_ms = float(latency_ms)
+
+    @property
+    def ok(self):
+        return self.status == "ok"
+
+    def to_meta(self):
+        return {"status": self.status, "error": self.error,
+                "retry_after_ms": round(self.retry_after_ms, 3),
+                "latency_ms": round(self.latency_ms, 3),
+                "outputs": list(self.outputs)}
+
+
+class _Pending:
+    """Handle returned by submit(): wait() blocks for the InferReply."""
+
+    __slots__ = ("model", "tenant", "feeds", "rows", "deadline",
+                 "t_submit", "req_id", "callback", "_done", "reply")
+
+    def __init__(self, model, tenant, feeds, rows, deadline_ms, req_id,
+                 callback):
+        self.model = model
+        self.tenant = tenant
+        self.feeds = feeds
+        self.rows = rows
+        self.t_submit = time.perf_counter()
+        self.deadline = self.t_submit + deadline_ms / 1e3
+        self.req_id = req_id
+        self.callback = callback
+        self._done = threading.Event()
+        self.reply = None
+
+    def complete(self, reply):
+        reply.latency_ms = (time.perf_counter() - self.t_submit) * 1e3
+        self.reply = reply
+        self._done.set()
+        if self.callback is not None:
+            try:
+                self.callback(self)
+            except Exception:
+                pass
+
+    def wait(self, timeout=None):
+        self._done.wait(timeout)
+        return self.reply
+
+
+class _ModelEntry:
+    __slots__ = ("name", "predictor", "feed_specs", "svc_ms")
+
+    def __init__(self, name, predictor):
+        self.name = name
+        self.predictor = predictor
+        block = predictor.program().global_block()
+        self.feed_specs = {}
+        for fname in predictor.get_input_names():
+            v = block._find_var_recursive(fname)
+            shape = tuple(v.shape)
+            if shape and shape[0] in (-1, 0):
+                shape = shape[1:]
+            self.feed_specs[fname] = (shape, v.dtype)
+        # EWMA of one dispatched batch's wall time; seeds pessimistic so
+        # the first admission estimates err toward accepting
+        self.svc_ms = 0.0
+
+
+class ServingEngine:
+    def __init__(self, buckets=None, max_queue=None, deadline_ms=None,
+                 batch_window_ms=None):
+        self.buckets = parse_buckets(buckets)
+        self.max_queue = int(max_queue if max_queue is not None
+                             else _flag("serving_max_queue"))
+        self.default_deadline_ms = float(
+            deadline_ms if deadline_ms is not None
+            else _flag("serving_deadline_ms"))
+        self.batch_window_ms = float(
+            batch_window_ms if batch_window_ms is not None
+            else _flag("serving_batch_window_ms"))
+        self._models = {}
+        self._queue = []          # FIFO of _Pending
+        self._cond = threading.Condition()
+        self._running = False
+        self._thread = None
+        self.in_batch = False
+        # fleet hook: called (outside the queue lock) after every
+        # dispatched batch — the fleet coordinator publishes membership
+        # changes here, so a shrink lands at a batch boundary
+        self.on_batch_boundary = None
+        self._done_times = []     # completion stamps for the QPS gauge
+
+    # -- registry ------------------------------------------------------------
+
+    def add_model(self, name, predictor_or_dir):
+        """Register a model under `name`: an AnalysisPredictor, or a
+        save_inference_model dir to load one from."""
+        from ..inference import AnalysisConfig, AnalysisPredictor
+
+        if isinstance(predictor_or_dir, str):
+            cfg = AnalysisConfig(predictor_or_dir)
+            cfg.disable_gpu()
+            cache = _flag("compile_cache_dir")
+            if cache:
+                cfg.set_optim_cache_dir(cache)
+            predictor_or_dir = AnalysisPredictor(cfg)
+        self._models[name] = _ModelEntry(name, predictor_or_dir)
+        return self._models[name].predictor
+
+    def models(self):
+        return list(self._models)
+
+    def spec(self, model):
+        """JSON-able feed/fetch signature for `model` (the __spec__ RPC)."""
+        from ..framework import dtype_to_np
+
+        e = self._models[model]
+        return {
+            "model": model,
+            "buckets": list(self.buckets),
+            "feeds": {n: {"shape": list(shape),
+                          "dtype": np.dtype(dtype_to_np(dt)).str}
+                      for n, (shape, dt) in e.feed_specs.items()},
+            "outputs": e.predictor.get_output_names(),
+        }
+
+    # -- AOT bucket prewarm --------------------------------------------------
+
+    def prewarm(self):
+        """Executor.warmup every (model, bucket); returns the manifest
+        {model: {bucket: {"source", "compile_ms"}}}.  With
+        FLAGS_compile_cache_dir set, compiled buckets land in the tier-B
+        store and later replicas restore from disk."""
+        manifest = {}
+        for name, e in self._models.items():
+            pred = e.predictor
+            per = {}
+            for b in self.buckets:
+                specs = {n: ((b,) + tuple(shape), None)
+                         for n, (shape, _dt) in e.feed_specs.items()}
+                got = pred._exe.warmup(
+                    pred.program(), feed_specs=specs,
+                    fetch_list=pred._fetch_vars, scope=pred._scope)
+                per[b] = {"source": got["source"],
+                          "compile_ms": round(got["compile_ms"], 3)}
+                _tm.inc("serving_prewarm_total", model=name,
+                        source=got["source"])
+                _tm.event("serving_prewarm", model=name, bucket=b,
+                          source=got["source"],
+                          ms=round(got["compile_ms"], 3))
+            manifest[name] = per
+        return manifest
+
+    # -- admission -----------------------------------------------------------
+
+    def _projected_wait_ms(self, entry, depth):
+        """Queue-drain estimate: batches ahead x EWMA batch service time."""
+        if entry.svc_ms <= 0.0:
+            return 0.0
+        batches_ahead = depth // max(self.buckets) + 1
+        return batches_ahead * entry.svc_ms
+
+    def submit(self, model, feeds, tenant="default", deadline_ms=None,
+               callback=None, req_id=None):
+        """Enqueue one request; returns a _Pending (wait() for the reply).
+        Shed/timeout/error requests complete immediately."""
+        deadline_ms = float(deadline_ms or self.default_deadline_ms)
+        req = _Pending(model, tenant, feeds, 0, deadline_ms,
+                       req_id or uuid.uuid4().hex, callback)
+        entry = self._models.get(model)
+        if entry is None or not self._running:
+            req.complete(InferReply(
+                "error", error="unknown model %r" % model if entry is None
+                else "engine not running"))
+            return req
+        try:
+            req.feeds, req.rows = self._normalize(entry, feeds)
+        except Exception as e:
+            req.complete(InferReply("error", error=str(e)))
+            return req
+        _tm.inc("serving_requests_total", model=model, tenant=tenant)
+        with self._cond:
+            depth = len(self._queue)
+            if depth >= self.max_queue:
+                wait_ms = self._projected_wait_ms(entry, depth)
+                _tm.inc("serving_shed_total", reason="queue_full")
+                req.complete(InferReply(
+                    "shed", error="queue full (%d)" % depth,
+                    retry_after_ms=max(wait_ms, entry.svc_ms, 1.0)))
+                return req
+            wait_ms = self._projected_wait_ms(entry, depth)
+            if wait_ms > deadline_ms:
+                _tm.inc("serving_shed_total", reason="deadline_budget")
+                req.complete(InferReply(
+                    "shed",
+                    error="projected wait %.0fms exceeds deadline %.0fms"
+                          % (wait_ms, deadline_ms),
+                    retry_after_ms=wait_ms - deadline_ms + entry.svc_ms))
+                return req
+            self._queue.append(req)
+            _tm.set_gauge("serving_queue_depth", len(self._queue))
+            self._cond.notify_all()
+        return req
+
+    def infer(self, model, feeds, tenant="default", deadline_ms=None):
+        """Synchronous submit + wait."""
+        req = self.submit(model, feeds, tenant=tenant,
+                          deadline_ms=deadline_ms)
+        deadline_ms = float(deadline_ms or self.default_deadline_ms)
+        reply = req.wait(timeout=deadline_ms / 1e3 + 30.0)
+        return reply if reply is not None else InferReply(
+            "timeout", error="no reply within deadline")
+
+    def _normalize(self, entry, feeds):
+        """Validate + coerce request feeds; returns (feeds, rows)."""
+        from ..framework import dtype_to_np
+
+        rows = None
+        out = {}
+        for name, (shape, dt) in entry.feed_specs.items():
+            if name not in feeds:
+                raise ValueError("missing feed %r" % name)
+            arr = np.ascontiguousarray(feeds[name],
+                                       dtype=dtype_to_np(dt))
+            if tuple(arr.shape[1:]) != tuple(shape):
+                raise ValueError(
+                    "feed %r: expected trailing shape %s, got %s"
+                    % (name, tuple(shape), tuple(arr.shape[1:])))
+            if rows is None:
+                rows = arr.shape[0]
+            elif arr.shape[0] != rows:
+                raise ValueError("inconsistent batch rows across feeds")
+            out[name] = arr
+        if rows is None or rows == 0:
+            raise ValueError("empty request")
+        if rows > max(self.buckets):
+            raise ValueError("request rows %d exceed largest bucket %d"
+                             % (rows, max(self.buckets)))
+        return out, rows
+
+    # -- dispatcher ----------------------------------------------------------
+
+    def start(self):
+        if self._running:
+            return self
+        self._running = True
+        self._thread = threading.Thread(target=self._dispatch_loop,
+                                        name="serving-dispatch", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, drain_s=5.0):
+        with self._cond:
+            self._running = False
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(drain_s)
+            self._thread = None
+        with self._cond:
+            for req in self._queue:
+                req.complete(InferReply("error", error="engine stopped"))
+            self._queue.clear()
+
+    def _bucket_for(self, rows):
+        for b in self.buckets:
+            if rows <= b:
+                return b
+        return max(self.buckets)
+
+    def _collect(self):
+        """Under the lock: wait for work, then coalesce same-model
+        requests within the batch window up to the largest bucket."""
+        while self._running and not self._queue:
+            self._cond.wait(0.2)
+        if not self._queue:
+            return None, []
+        model = self._queue[0].model
+        window_end = time.perf_counter() + self.batch_window_ms / 1e3
+        max_rows = max(self.buckets)
+        while self._running:
+            rows = sum(r.rows for r in self._queue if r.model == model)
+            if rows >= max_rows:
+                break
+            left = window_end - time.perf_counter()
+            if left <= 0:
+                break
+            self._cond.wait(min(left, 0.002))
+        batch, rest, rows = [], [], 0
+        for r in self._queue:
+            if r.model == model and rows + r.rows <= max_rows:
+                batch.append(r)
+                rows += r.rows
+            else:
+                rest.append(r)
+        self._queue[:] = rest
+        _tm.set_gauge("serving_queue_depth", len(self._queue))
+        return model, batch
+
+    def _dispatch_loop(self):
+        while True:
+            with self._cond:
+                if not self._running:
+                    return
+                model, batch = self._collect()
+            if not batch:
+                continue
+            now = time.perf_counter()
+            live = []
+            for r in batch:
+                if now > r.deadline:
+                    _tm.inc("serving_timeout_total", model=r.model)
+                    r.complete(InferReply(
+                        "timeout", error="deadline expired in queue"))
+                else:
+                    live.append(r)
+            if live:
+                self.in_batch = True
+                try:
+                    self._run_batch(self._models[model], live)
+                finally:
+                    self.in_batch = False
+            if self.on_batch_boundary is not None:
+                try:
+                    self.on_batch_boundary()
+                except Exception:
+                    pass
+
+    def _run_batch(self, entry, batch):
+        rows = sum(r.rows for r in batch)
+        bucket = self._bucket_for(rows)
+        pred = entry.predictor
+        feed = {}
+        for name in entry.feed_specs:
+            parts = [r.feeds[name] for r in batch]
+            stacked = np.concatenate(parts, axis=0) if len(parts) > 1 \
+                else parts[0]
+            if rows < bucket:
+                pad = np.zeros((bucket - rows,) + stacked.shape[1:],
+                               dtype=stacked.dtype)
+                stacked = np.concatenate([stacked, pad], axis=0)
+            feed[name] = stacked
+        t0 = time.perf_counter()
+        try:
+            with scope_guard(pred._scope):
+                vals = pred._exe.run(pred.program(), feed=feed,
+                                     fetch_list=pred._fetch_vars)
+        except Exception as e:
+            for r in batch:
+                r.complete(InferReply("error", error=str(e)))
+            _tm.inc("serving_batch_errors_total", model=entry.name)
+            return
+        ms = (time.perf_counter() - t0) * 1e3
+        entry.svc_ms = ms if entry.svc_ms <= 0 else \
+            0.7 * entry.svc_ms + 0.3 * ms
+        outs = [np.asarray(v) for v in vals]
+        names = pred.get_output_names()
+        off = 0
+        for r in batch:
+            sliced = {}
+            for n, o in zip(names, outs):
+                # slice per-request rows when the output carries the batch
+                # dim; batch-free outputs replicate to every request
+                sliced[n] = o[off:off + r.rows].copy() \
+                    if o.ndim and o.shape[0] == bucket else o
+            off += r.rows
+            r.complete(InferReply("ok", outputs=sliced))
+            _tm.observe("serving_latency_ms", r.reply.latency_ms,
+                        model=entry.name)
+        _tm.inc("serving_batches_total", model=entry.name,
+                bucket=str(bucket))
+        _tm.observe("serving_batch_fill", rows / float(bucket),
+                    model=entry.name)
+        now = time.time()
+        self._done_times.extend([now] * len(batch))
+        cut = now - _QPS_WINDOW_S
+        while self._done_times and self._done_times[0] < cut:
+            self._done_times.pop(0)
+        _tm.set_gauge("serving_qps", len(self._done_times) / _QPS_WINDOW_S)

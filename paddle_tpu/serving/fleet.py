@@ -1,0 +1,242 @@
+"""Elastic serving replicas: fleet membership over the serving RPC port.
+
+The elastic training layer (distributed/elastic.py) re-forms a collective
+WORLD when a member dies; serving replicas are independent (no cross-
+replica collectives), so the fleet layer only needs the membership half
+of that machinery: the same ``HeartBeatMonitor`` liveness bookkeeping
+(distributed/ps.py), the same ``__alive__`` probe contract
+(``native.rpc.probe``), and the same publish-a-view flow — except the
+view here is the **endpoints file** clients read to fail over
+(FLAGS_serving_endpoints_file), plus a ``__fview__`` var for scraping.
+
+Mechanics:
+
+- every replica heartbeats the coordinator (lowest live rank) with
+  ``__fhb__<rank>`` on the coordinator's SERVING port — heartbeats ride
+  the same event stream as requests, so the coordinator's poll loop
+  drives eviction checks with no extra socket;
+- a SIGKILLed replica goes silent; after ``FLAGS_serving_hb_timeout`` the
+  coordinator marks it dead and stages a shrunken view.  The view is
+  PUBLISHED at a batch boundary (the engine's ``on_batch_boundary`` hook
+  calls ``tick``) so a membership change never lands mid-batch — queued
+  requests on the survivors are untouched, and the killed replica's
+  in-flight clients replay against the new endpoints file;
+- if the coordinator itself dies, the next-lowest live rank notices its
+  heartbeats failing, probes every lower rank, and promotes itself
+  (rewriting the endpoints file from a fresh probe of the member list).
+"""
+
+import json
+import logging
+import os
+import threading
+import time
+
+import numpy as np
+
+from ..core import telemetry as _tm
+from ..distributed.ps import HeartBeatMonitor
+from ..native import rpc as _rpc
+from . import codec
+
+__all__ = ["ServingFleet", "FLEET_HB", "FLEET_VIEW"]
+
+FLEET_HB = "__fhb__"
+FLEET_VIEW = "__fview__"
+_PROMOTE_AFTER = 4  # consecutive heartbeat failures before probing
+
+
+def _flag(name):
+    from .. import flags
+
+    return flags.flag(name)
+
+
+def write_endpoints_file(path, epoch, endpoints):
+    """Atomic (tmp + rename) so client reads never see a torn view."""
+    doc = {"epoch": int(epoch), "endpoints": list(endpoints)}
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+
+
+class ServingFleet:
+    def __init__(self, rank, endpoints, server, endpoints_file=None):
+        self.rank = int(rank)
+        self.endpoints = list(endpoints)
+        self.server = server                     # ServingServer
+        self.endpoints_file = endpoints_file or \
+            _flag("serving_endpoints_file") or None
+        self.epoch = 0
+        self.live = set(range(len(self.endpoints)))
+        self.mon = None                          # coordinator only
+        self._coord_rank = min(self.live)
+        self._hb_thread = None
+        self._tick_thread = None
+        self._stop = threading.Event()
+        self._hb_failures = 0
+        self._lock = threading.Lock()
+        self._pending_view = False
+
+    def is_coordinator(self):
+        return self._coord_rank == self.rank
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self):
+        self.server.attach_fleet(self)
+        if self.is_coordinator():
+            self._become_coordinator(initial=True)
+        else:
+            self.server.set_alive(self.epoch, False)
+        self._start_heartbeat()
+        return self
+
+    def _become_coordinator(self, initial=False):
+        timeout = float(_flag("serving_hb_timeout") or 2.0)
+        if not initial:
+            # promotion: rebuild liveness from a fresh probe of the list
+            self.live = {r for r, ep in enumerate(self.endpoints)
+                         if r == self.rank
+                         or _rpc.probe(ep, key=codec.ALIVE_KEY,
+                                       timeout=1.0) is not None}
+            self.epoch += 1
+            _tm.inc("serving_fleet_promotions_total")
+            logging.warning("[serving-fleet] rank %d promoted to "
+                            "coordinator (live=%s)", self.rank,
+                            sorted(self.live))
+        self._coord_rank = self.rank
+        self.mon = HeartBeatMonitor(
+            0, timeout_s=timeout, name="serving-fleet",
+            worker_ids=sorted(self.live - {self.rank}))
+        self.server.set_alive(self.epoch, True)
+        self._publish_view()
+        # heartbeats only wake the poll loop while peers are alive; a
+        # self-tick keeps eviction checks running even with a silent fleet
+        if self._tick_thread is None:
+            self._tick_thread = threading.Thread(
+                target=self._self_tick, name="fleet-tick", daemon=True)
+            self._tick_thread.start()
+
+    def _self_tick(self):
+        interval = float(_flag("serving_hb_interval") or 0.3)
+        me = self.endpoints[self.rank]
+        while not self._stop.wait(interval):
+            if not self.is_coordinator():
+                continue
+            try:
+                c = _rpc.RpcClient(me, connect_timeout=1.0,
+                                   rpc_deadline=2.0, retry_times=0)
+                try:
+                    c.send_var(FLEET_HB + str(self.rank),
+                               np.asarray([self.rank], np.int64))
+                finally:
+                    c.close()
+            except Exception:
+                pass
+
+    def _start_heartbeat(self):
+        def loop():
+            interval = float(_flag("serving_hb_interval") or 0.3)
+            client = None
+            while not self._stop.wait(interval):
+                if self.is_coordinator():
+                    continue
+                try:
+                    if client is None:
+                        client = _rpc.RpcClient(
+                            self.endpoints[self._coord_rank],
+                            connect_timeout=1.0, rpc_deadline=2.0,
+                            retry_times=0)
+                    client.send_var(FLEET_HB + str(self.rank),
+                                    np.asarray([self.rank], np.int64))
+                    self._hb_failures = 0
+                except Exception:
+                    client = None
+                    self._hb_failures += 1
+                    if self._hb_failures >= _PROMOTE_AFTER:
+                        self._hb_failures = 0
+                        self._coordinator_lost()
+
+        self._hb_thread = threading.Thread(target=loop, name="fleet-hb",
+                                           daemon=True)
+        self._hb_thread.start()
+
+    def _coordinator_lost(self):
+        """The coordinator stopped answering: lowest live rank takes over."""
+        for r in sorted(self.live):
+            if r == self.rank:
+                break
+            if r == self._coord_rank:
+                continue
+            if _rpc.probe(self.endpoints[r], key=codec.ALIVE_KEY,
+                          timeout=1.0) is not None:
+                self.live.discard(self._coord_rank)
+                self._coord_rank = r
+                return
+        self.live.discard(self._coord_rank)
+        self._become_coordinator()
+
+    # -- event stream (called from the server poll loop) ---------------------
+
+    def on_event(self, name, arr):
+        if name.startswith(FLEET_HB) and self.mon is not None:
+            r = int(arr[0])
+            if r in self.live:
+                self.mon.update(r)
+            elif r != self.rank:
+                # a relaunched/late replica re-announces itself
+                self.live.add(r)
+                self.mon.update(r)
+                with self._lock:
+                    self._pending_view = True
+
+    def tick(self):
+        """Eviction check + deferred view publication.  Runs on the poll
+        loop after every event AND on the engine's batch-boundary hook, so
+        a shrink always lands between batches."""
+        if not self.is_coordinator() or self.mon is None:
+            return
+        dead = [r for r in self.mon.check() if r in self.live]
+        if dead:
+            for r in dead:
+                self.live.discard(r)
+                self.mon.remove(r)
+            self.epoch += 1
+            _tm.inc("serving_fleet_evictions_total", len(dead))
+            _tm.event("serving_fleet_evict", dead=dead, epoch=self.epoch,
+                      live=sorted(self.live))
+            logging.warning("[serving-fleet] epoch %d: evicted %s, "
+                            "live=%s", self.epoch, dead, sorted(self.live))
+            with self._lock:
+                self._pending_view = True
+        publish = False
+        with self._lock:
+            if self._pending_view and not self.server.engine.in_batch:
+                self._pending_view = False
+                publish = True
+        if publish:
+            self._publish_view()
+
+    def _publish_view(self):
+        live_eps = [self.endpoints[r] for r in sorted(self.live)]
+        self.server.rpc.set_var(
+            FLEET_VIEW,
+            np.asarray([self.epoch] + sorted(self.live), np.int64))
+        if self.endpoints_file:
+            try:
+                write_endpoints_file(self.endpoints_file, self.epoch,
+                                     live_eps)
+            except OSError as e:
+                logging.warning("[serving-fleet] endpoints file write "
+                                "failed: %s", e)
+        _tm.set_gauge("serving_fleet_size", len(self.live))
+        _tm.set_gauge("serving_fleet_epoch", self.epoch)
+
+    def view(self):
+        return {"epoch": self.epoch, "live": sorted(self.live),
+                "coordinator": self._coord_rank}
+
+    def stop(self):
+        self._stop.set()

@@ -1,0 +1,134 @@
+"""RPC serving frontend: wire protocol over native/rpc.py.
+
+One ``RpcServer`` per replica carries the whole protocol:
+
+  ``__infer__:<req_id>``  inbound SEND: packed request (serving/codec.py);
+                          the reply is published as ``__reply__:<req_id>``
+                          and the client's blocking GET picks it up (the
+                          transport parks GETs until the var exists)
+  ``__alive__``           [rank, epoch, is_coordinator] — same probe
+                          contract as the elastic control plane
+  ``__metrics__``         telemetry snapshot, republished every second
+                          (core/telemetry.start_publisher) for
+                          tools/metrics_dump.py --scrape
+  ``__spec__:<model>``    feed/fetch signature + buckets, so loadgen can
+                          synthesize valid requests without the model dir
+  ``__fhb__<rank>``       fleet replica heartbeats (serving/fleet.py)
+
+Replies are garbage-collected FIFO beyond a bounded ring — a crashed
+client can never grow the server's var store unboundedly.
+"""
+
+import threading
+
+import numpy as np
+
+from ..core import telemetry as _tm
+from ..native.rpc import EV_SEND, RpcServer
+from . import codec
+
+__all__ = ["ServingServer"]
+
+_REPLY_RING = 1024
+
+
+class ServingServer:
+    def __init__(self, engine, port=0, rank=0):
+        self.engine = engine
+        self.rank = int(rank)
+        self.rpc = RpcServer(port=port)
+        self.port = self.rpc.port
+        self.fleet = None
+        self._reply_keys = []
+        self._reply_lock = threading.Lock()
+        self._thread = None
+        self._pub_stop = None
+        self._stopped = threading.Event()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self):
+        self.engine.start()
+        self.rpc.set_var(codec.ALIVE_KEY,
+                         np.asarray([self.rank, 0, 0], np.int64))
+        for name in self.engine.models():
+            self.rpc.set_var(codec.SPEC_KEY + name,
+                             codec.pack(self.engine.spec(name)))
+        self.rpc.serve(True)
+        if _tm.enabled():
+            self._pub_stop = _tm.start_publisher(self.rpc, interval_s=1.0)
+        self._thread = threading.Thread(target=self._poll_loop,
+                                        name="serving-rpc", daemon=True)
+        self._thread.start()
+        return self
+
+    def attach_fleet(self, fleet):
+        """Wire a serving fleet: its heartbeats arrive on this server's
+        event stream, and membership changes publish at batch boundaries
+        via the engine hook."""
+        self.fleet = fleet
+        self.engine.on_batch_boundary = fleet.tick
+
+    def _poll_loop(self):
+        while True:
+            t, name, arr = self.rpc.poll()
+            if t == 0:
+                return
+            if t != EV_SEND or name is None:
+                continue
+            if name.startswith(codec.INFER_KEY):
+                self._on_infer(name[len(codec.INFER_KEY):], arr)
+            elif self.fleet is not None:
+                self.fleet.on_event(name, arr)
+            if self.fleet is not None:
+                self.fleet.tick()
+
+    def _on_infer(self, req_id, arr):
+        try:
+            meta, arrays = codec.unpack(arr)
+            feeds = dict(zip(meta["feeds"], arrays))
+        except Exception as e:
+            self._publish(req_id, None)
+            _tm.inc("serving_bad_request_total")
+            del e
+            return
+        self.engine.submit(
+            meta.get("model", ""), feeds,
+            tenant=meta.get("tenant", "default"),
+            deadline_ms=meta.get("deadline_ms"),
+            req_id=req_id,
+            callback=lambda pending: self._publish(pending.req_id,
+                                                   pending.reply))
+
+    def _publish(self, req_id, reply):
+        from .engine import InferReply
+
+        if reply is None:
+            reply = InferReply("error", error="malformed request")
+        names = list(reply.outputs)
+        buf = codec.pack(reply.to_meta(),
+                         [reply.outputs[n] for n in names])
+        key = codec.REPLY_KEY + req_id
+        self.rpc.set_var(key, buf)
+        with self._reply_lock:
+            self._reply_keys.append(key)
+            while len(self._reply_keys) > _REPLY_RING:
+                self.rpc.del_var(self._reply_keys.pop(0))
+
+    def set_alive(self, epoch, is_coordinator):
+        self.rpc.set_var(codec.ALIVE_KEY, np.asarray(
+            [self.rank, int(epoch), 1 if is_coordinator else 0], np.int64))
+
+    def shutdown(self):
+        if self._stopped.is_set():
+            return
+        self._stopped.set()
+        if self._pub_stop is not None:
+            self._pub_stop.set()
+        if self.fleet is not None:
+            self.fleet.stop()
+        self.engine.stop()
+        self.rpc.shutdown()
+        if self._thread is not None:
+            self._thread.join(5.0)
+            self._thread = None

@@ -65,6 +65,54 @@ def test_clone_shares_params(saved_model):
     np.testing.assert_allclose(outs[0].as_ndarray(), want, rtol=1e-5)
 
 
+def test_clone_threaded_concurrency(saved_model):
+    """Clones share ONE Executor (so one executable cache): N threads
+    hammering their own clones corrupt nothing and compile nothing beyond
+    the single warmed executable (reference AnalysisPredictor::Clone is
+    documented for exactly this thread-per-clone serving pattern)."""
+    import threading
+
+    dirname, xv, want = saved_model
+    cfg = AnalysisConfig(dirname)
+    cfg.disable_gpu()
+    pred = create_paddle_predictor(cfg)
+    # warm once before threading so the compile happens exactly once and
+    # the threads only ever hit the cache
+    pred.run([PaddleTensor(xv, name="x")])
+    assert len(pred._exe._cache) == 1
+
+    clones = [pred.clone() for _ in range(4)]
+    assert all(c._exe is pred._exe for c in clones)
+    rng = np.random.RandomState(1)
+    inputs = [rng.rand(3, 8).astype("f") for _ in clones]
+    wants = [pred.run([PaddleTensor(x, name="x")])[0].as_ndarray()
+             for x in inputs]
+    errors, outs = [], {}
+
+    def hammer(i):
+        try:
+            for _ in range(20):
+                got = clones[i].run([PaddleTensor(inputs[i], name="x")])
+                outs.setdefault(i, []).append(got[0].as_ndarray())
+        except Exception as e:  # surface in the main thread
+            errors.append((i, e))
+
+    threads = [threading.Thread(target=hammer, args=(i,))
+               for i in range(len(clones))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120.0)
+    assert not errors, errors
+    for i, per in outs.items():
+        assert len(per) == 20
+        for got in per:
+            # no cross-clone output corruption: every run returns its own
+            # clone's answer bit-for-bit
+            np.testing.assert_array_equal(got, wants[i])
+    assert len(pred._exe._cache) == 1  # still exactly one compile
+
+
 def test_repeated_runs_use_cache(saved_model):
     dirname, xv, _ = saved_model
     cfg = AnalysisConfig(dirname)
@@ -75,6 +123,32 @@ def test_repeated_runs_use_cache(saved_model):
         r2 = pred.run([PaddleTensor(xv, name="x")])[0].as_ndarray()
     np.testing.assert_allclose(r1, r2)
     assert len(pred._exe._cache) == 1  # one compiled executable
+
+
+def test_optim_cache_dir_routes_through_compile_cache(saved_model,
+                                                      tmp_path):
+    """set_optim_cache_dir feeds the unified two-tier cache
+    (core/compile_cache.py) instead of poking jax config directly: the
+    flag is set and XLA's persistent cache is wired under <dir>/xla."""
+    import jax
+
+    from paddle_tpu import flags
+    from paddle_tpu.core import compile_cache as cc
+
+    dirname, xv, want = saved_model
+    prev = flags.flag("compile_cache_dir")
+    cfg = AnalysisConfig(dirname)
+    cfg.disable_gpu()
+    cfg.set_optim_cache_dir(str(tmp_path / "cc"))
+    try:
+        pred = create_paddle_predictor(cfg)
+        assert flags.flag("compile_cache_dir") == str(tmp_path / "cc")
+        assert cc.xla_dir() == str(tmp_path / "cc" / "xla")
+        assert jax.config.jax_compilation_cache_dir == cc.xla_dir()
+        got = pred.run([PaddleTensor(xv, name="x")])[0].as_ndarray()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+    finally:
+        flags.set_flags({"FLAGS_compile_cache_dir": prev})
 
 
 def test_two_file_config_form(tmp_path):

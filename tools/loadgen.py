@@ -1,0 +1,150 @@
+"""Open-loop Poisson load generator for the serving stack
+(tools/serve.py).
+
+Open-loop means arrivals are scheduled from a Poisson process and fired
+on time whether or not earlier requests finished — the discipline that
+exposes queueing collapse, unlike closed-loop clients whose arrival rate
+politely slows with the server.  Each arrival runs on its own thread so
+a slow reply never delays the next arrival.
+
+Feeds are synthesized from the server's ``__spec__`` RPC (zeros for
+integer feeds, ones for floats) so the generator needs no model files.
+Batch sizes are sampled from --batch-mix so traffic exercises several
+buckets.
+
+Emits one JSON report (default BENCH_serving.json): p50/p99 end-to-end
+latency, achieved QPS under load, server-side mean batch fill, shed
+rate, and the dropped count (requests no live endpoint answered).
+--assert-no-drops makes a nonzero dropped count a nonzero exit — the CI
+SIGKILL leg's invariant that elastic shrink loses no admitted requests.
+"""
+
+import argparse
+import json
+import os
+import random
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def synth_feeds(spec, rows):
+    """Zero/one-filled feeds matching the server-published signature."""
+    import numpy as np
+
+    feeds = {}
+    for name, s in spec["feeds"].items():
+        dt = np.dtype(s["dtype"])
+        shape = (rows,) + tuple(s["shape"])
+        feeds[name] = np.zeros(shape, dt) if dt.kind in "iu" \
+            else np.ones(shape, dt)
+    return feeds
+
+
+def percentile(xs, q):
+    if not xs:
+        return 0.0
+    s = sorted(xs)
+    return s[min(int(q * len(s)), len(s) - 1)]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--endpoints", default=None,
+                    help="comma list of replica endpoints")
+    ap.add_argument("--endpoints-file", default=None,
+                    help="fleet endpoints file (failover re-reads it)")
+    ap.add_argument("--model", required=True)
+    ap.add_argument("--requests", type=int, default=200)
+    ap.add_argument("--qps", type=float, default=50.0,
+                    help="mean Poisson arrival rate")
+    ap.add_argument("--batch-mix", default="1,1,2,4",
+                    help="per-request row counts sampled uniformly")
+    ap.add_argument("--deadline-ms", type=float, default=None)
+    ap.add_argument("--tenant", default="loadgen")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default="BENCH_serving.json")
+    ap.add_argument("--assert-no-drops", action="store_true",
+                    help="exit 1 if any request was dropped (all "
+                    "endpoint attempts failed)")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.serving import ServingClient
+
+    endpoints = [e.strip() for e in (args.endpoints or "").split(",")
+                 if e.strip()]
+    client = ServingClient(endpoints=endpoints or None,
+                           endpoints_file=args.endpoints_file,
+                           tenant=args.tenant)
+    spec = client.spec(args.model)
+    mix = [int(b) for b in args.batch_mix.split(",") if b]
+    rng = random.Random(args.seed)
+
+    lock = threading.Lock()
+    latencies, statuses = [], {}
+    threads = []
+
+    def fire(rows):
+        r = client.infer(args.model, synth_feeds(spec, rows),
+                         deadline_ms=args.deadline_ms)
+        with lock:
+            statuses[r.status] = statuses.get(r.status, 0) + 1
+            if r.ok:
+                latencies.append(r.latency_ms)
+
+    t_start = time.perf_counter()
+    next_at = t_start
+    for _ in range(args.requests):
+        next_at += rng.expovariate(args.qps)
+        delay = next_at - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        t = threading.Thread(target=fire, args=(rng.choice(mix),),
+                             daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(timeout=120.0)
+    wall_s = time.perf_counter() - t_start
+
+    # server-side batch fill from the scrape (best-effort: a SIGKILLed
+    # coordinator can leave no scrapeable replica in tiny test fleets)
+    batch_fill = None
+    try:
+        snap = client.scrape()
+        h = [v for k, v in snap.get("histograms", {}).items()
+             if k.startswith("serving_batch_fill")]
+        n = sum(x["count"] for x in h)
+        if n:
+            batch_fill = round(sum(x["sum"] for x in h) / n, 4)
+    except Exception:
+        pass
+
+    total = max(sum(statuses.values()), 1)
+    dropped = statuses.get("dropped", 0)
+    report = {
+        "model": args.model,
+        "requests": args.requests,
+        "offered_qps": args.qps,
+        "statuses": statuses,
+        "latency_ms_p50": round(percentile(latencies, 0.50), 3),
+        "latency_ms_p99": round(percentile(latencies, 0.99), 3),
+        "achieved_qps": round(len(latencies) / wall_s, 2) if wall_s else 0.0,
+        "batch_fill": batch_fill,
+        "shed_rate": round(statuses.get("shed", 0) / total, 4),
+        "dropped": dropped,
+        "failovers": client.failovers,
+    }
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps(report), flush=True)
+    if args.assert_no_drops and dropped:
+        print("FAIL: %d requests dropped" % dropped, file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

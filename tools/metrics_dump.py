@@ -98,6 +98,11 @@ def main(argv=None):
                     "pallas_kernel_used_total{kernel} / "
                     "pallas_kernel_fallback_total{kernel,reason} counters "
                     "(pallas_kernels/adoption.py)")
+    ap.add_argument("--serving", action="store_true", dest="serving_only",
+                    help="show only inference-serving metrics: queue "
+                    "depth / qps / fleet gauges, request / shed / timeout "
+                    "/ batch counters, latency + batch-fill histograms "
+                    "(serving/engine.py + fleet.py)")
     ap.add_argument("--lint", action="store_true", dest="lint_only",
                     help="show only static-checker metrics: per-rule "
                     "static_check_warnings counters and the whole-world "
@@ -125,6 +130,8 @@ def main(argv=None):
                                    "executor_warmup"))
     if args.kernels_only:
         snap = _filter_snap(snap, "pallas_kernel_")
+    if args.serving_only:
+        snap = _filter_snap(snap, "serving_")
     if args.lint_only:
         # covers static_check_warnings{rule=} and static_check_world_*
         snap = _filter_snap(snap, "static_check")

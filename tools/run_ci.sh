@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI harness (reference paddle/scripts/paddle_build.sh analog): build the
 # native pieces, run the full test pyramid, smoke the bench + graft entry.
-# Usage: tools/run_ci.sh [quick|full|tpu|--layout-smoke|--obs-smoke|--lint|--elastic-smoke|--zero1-smoke|--cache-smoke|--kernel-smoke]
+# Usage: tools/run_ci.sh [quick|full|tpu|--layout-smoke|--obs-smoke|--lint|--elastic-smoke|--zero1-smoke|--cache-smoke|--kernel-smoke|--serve-smoke]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -96,6 +96,53 @@ if [ "$MODE" = "--kernel-smoke" ]; then
     python tools/op_bench.py tools/probes/embedding_bag.json \
     --pallas --device cpu --repeat 2 --warmup 1
   echo "CI --kernel-smoke: PASS"
+  exit 0
+fi
+
+if [ "$MODE" = "--serve-smoke" ]; then
+  # continuous-batching serving leg: the engine/wire/clone unit tests,
+  # then a live 2-replica fleet — prewarm both buckets AOT, stream 200
+  # open-loop requests through the endpoints file while one replica is
+  # SIGKILLed mid-stream — 0 dropped requests is the hard invariant, and
+  # the scraped serving_* metrics must answer over the survivor
+  echo "== serve smoke: serving + threaded-clone tests =="
+  JAX_PLATFORMS=cpu FLAGS_static_check=error \
+    python -m pytest tests/test_serving.py \
+    tests/test_serving_fleet_subprocess.py tests/test_inference.py -q
+  echo "== serve smoke: 2-replica fleet + SIGKILL under load =="
+  SRV_DIR="$(mktemp -d)"
+  JAX_PLATFORMS=cpu python tools/serve.py --save-demo-model "$SRV_DIR/model"
+  SRV_ENV=(JAX_PLATFORMS=cpu FLAGS_static_check=error FLAGS_telemetry=1
+           FLAGS_serving_hb_interval=0.2 FLAGS_serving_hb_timeout=1.5
+           FLAGS_compile_cache_dir="$SRV_DIR/cc")
+  env "${SRV_ENV[@]}" python tools/serve.py --model fc="$SRV_DIR/model" \
+    --rank 0 --fleet 127.0.0.1:9460,127.0.0.1:9461 --buckets 1,4 \
+    --endpoints-file "$SRV_DIR/eps.json" > "$SRV_DIR/r0.log" 2>&1 &
+  R0=$!
+  env "${SRV_ENV[@]}" python tools/serve.py --model fc="$SRV_DIR/model" \
+    --rank 1 --fleet 127.0.0.1:9460,127.0.0.1:9461 --buckets 1,4 \
+    --endpoints-file "$SRV_DIR/eps.json" > "$SRV_DIR/r1.log" 2>&1 &
+  R1=$!
+  trap 'kill -9 $R0 $R1 2>/dev/null || true' EXIT
+  for _ in $(seq 60); do
+    grep -q READY "$SRV_DIR/r0.log" && grep -q READY "$SRV_DIR/r1.log" \
+      && break
+    sleep 1
+  done
+  grep -q READY "$SRV_DIR/r0.log" && grep -q READY "$SRV_DIR/r1.log"
+  # both buckets must be present in rank 0's prewarm manifest
+  grep -q '"1"' "$SRV_DIR/r0.log" && grep -q '"4"' "$SRV_DIR/r0.log"
+  ( sleep 2; kill -9 $R1 2>/dev/null || true ) &
+  JAX_PLATFORMS=cpu python tools/loadgen.py \
+    --endpoints-file "$SRV_DIR/eps.json" --model fc --requests 200 \
+    --qps 50 --out "$SRV_DIR/BENCH_serving.json" --assert-no-drops
+  # grep -c (not -q): -q's early exit SIGPIPEs the dump under pipefail
+  python tools/metrics_dump.py --scrape 127.0.0.1:9460 --serving \
+    | grep -c serving_batches_total > /dev/null
+  kill $R0 2>/dev/null || true
+  trap - EXIT
+  rm -rf "$SRV_DIR"
+  echo "CI --serve-smoke: PASS"
   exit 0
 fi
 

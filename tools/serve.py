@@ -1,0 +1,131 @@
+"""Continuous-batching inference server entry point (serving/ subsystem).
+
+Usage:
+    # single replica, two models, AOT-compile the buckets, serve
+    python tools/serve.py --model fc=/path/to/model \
+        --model bert=/path/to/bert --port 9000 --buckets 1,4,16 \
+        --cache-dir /tmp/cc
+
+    # CI-style: compile every (model, bucket) into the cache and exit
+    python tools/serve.py --model fc=/path --prewarm-only --cache-dir /tmp/cc
+
+    # elastic fleet of N replicas: run once per replica with the SAME
+    # --fleet list; the coordinator (lowest live rank) maintains
+    # --endpoints-file for client failover
+    python tools/serve.py --model fc=/path --rank 0 \
+        --fleet 127.0.0.1:9000,127.0.0.1:9001 \
+        --endpoints-file /tmp/eps.json
+
+    # helper for smoke tests: save a tiny fc inference model and exit
+    python tools/serve.py --save-demo-model /tmp/model
+
+The prewarm manifest prints one JSON line (PREWARM {...}) so harnesses
+can assert every bucket exists before traffic starts; "READY port=N" on
+stdout marks the server accepting requests.
+"""
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def save_demo_model(dirname, in_dim=8, out_dim=4):
+    """Tiny fc softmax model via save_inference_model (smoke tests)."""
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[in_dim])
+        h = fluid.layers.fc(x, 16, act="relu")
+        out = fluid.layers.fc(h, out_dim, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.save_inference_model(dirname, ["x"], [out], exe,
+                                   main_program=main)
+    return dirname
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", action="append", default=[],
+                    metavar="NAME=DIR",
+                    help="register a model (repeatable): serving name = "
+                    "save_inference_model directory")
+    ap.add_argument("--port", type=int, default=0,
+                    help="RPC port (0 = ephemeral; printed on READY)")
+    ap.add_argument("--buckets", default=None,
+                    help="batch buckets, e.g. 1,4,16,64 "
+                    "(default FLAGS_serving_buckets)")
+    ap.add_argument("--cache-dir", default=None,
+                    help="FLAGS_compile_cache_dir for AOT bucket artifacts")
+    ap.add_argument("--prewarm-only", action="store_true",
+                    help="compile every (model, bucket), print the "
+                    "manifest, exit")
+    ap.add_argument("--rank", type=int, default=0,
+                    help="this replica's rank in --fleet")
+    ap.add_argument("--fleet", default=None,
+                    help="comma list of ALL replica endpoints (host:port); "
+                    "enables fleet membership")
+    ap.add_argument("--endpoints-file", default=None,
+                    help="coordinator-maintained live-endpoints file "
+                    "(client failover)")
+    ap.add_argument("--save-demo-model", metavar="DIR", default=None,
+                    help="write a tiny fc inference model to DIR and exit")
+    args = ap.parse_args(argv)
+
+    if args.save_demo_model:
+        print("saved demo model:", save_demo_model(args.save_demo_model))
+        return 0
+
+    import paddle_tpu as fluid
+    from paddle_tpu.serving import ServingEngine, ServingFleet, ServingServer
+
+    if args.cache_dir:
+        fluid.set_flags({"FLAGS_compile_cache_dir": args.cache_dir})
+    if not args.model:
+        ap.error("at least one --model NAME=DIR is required")
+
+    engine = ServingEngine(buckets=args.buckets)
+    for spec in args.model:
+        name, _, dirname = spec.partition("=")
+        if not dirname:
+            ap.error("--model wants NAME=DIR, got %r" % spec)
+        engine.add_model(name, dirname)
+
+    manifest = engine.prewarm()
+    print("PREWARM " + json.dumps(manifest), flush=True)
+    if args.prewarm_only:
+        return 0
+
+    if args.fleet:
+        endpoints = [e.strip() for e in args.fleet.split(",") if e.strip()]
+        port = args.port or int(endpoints[args.rank].rsplit(":", 1)[1])
+    else:
+        endpoints, port = None, args.port
+
+    server = ServingServer(engine, port=port, rank=args.rank).start()
+    fleet = None
+    if endpoints:
+        fleet = ServingFleet(args.rank, endpoints, server,
+                             endpoints_file=args.endpoints_file).start()
+    print("READY port=%d" % server.port, flush=True)
+
+    done = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: done.set())
+    done.wait()
+    if fleet is not None:
+        fleet.stop()
+    server.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
