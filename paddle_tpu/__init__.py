@@ -82,6 +82,7 @@ from . import dygraph
 from . import metrics
 from . import profiler
 from .core import telemetry
+from .core import tracing
 from . import flags
 from . import parallel
 from .flags import set_flags, get_flags

@@ -27,6 +27,7 @@ from ..framework import (
 from .lowering import BlockPlan, build_block_fn
 from .scope import Scope
 from . import telemetry as _telemetry
+from . import tracing as _tracing
 
 __all__ = ["Executor", "global_scope", "scope_guard"]
 
@@ -387,9 +388,15 @@ class Executor:
 
         t_step = time.perf_counter() if tel else 0.0
         try:
-            with ctx, RecordEvent("Executor::Run"):
-                fetches, updated, updated_carry = entry.jfn(
-                    feed_arrays, params_ro, params_rw, params_carry, rng)
+            # nests under whatever span is active on this thread — the
+            # serving dispatcher's serving.execute, or a training loop's
+            # root — so cross-process traces reach down to the step
+            with _tracing.span("executor.step", step=int(counter),
+                               cache_hit=cache_hit):
+                with ctx, RecordEvent("Executor::Run"):
+                    fetches, updated, updated_carry = entry.jfn(
+                        feed_arrays, params_ro, params_rw, params_carry,
+                        rng)
         except Exception:
             if params_carry:
                 # the carry inputs were donated: a failed call may have
@@ -435,6 +442,7 @@ class Executor:
         from ..profiler import mark_instant
 
         mark_instant("step", args={"step": int(counter)})
+        _tracing.instant("step", step=int(counter))
 
         for n, val in updated.items():
             scope.var(n).set(val)
@@ -653,6 +661,8 @@ class Executor:
         compiled = None
         t0 = time.perf_counter()
         if disk_key is not None:
+            rspan = _tracing.start_span("executor.cache_restore",
+                                        key=disk_key[:12])
             got = _cc.load(disk_key)
             if got is not None:
                 try:
@@ -676,7 +686,9 @@ class Executor:
                     # crc-valid but unloadable (e.g. XLA build drift):
                     # drop it so the store below rewrites the entry
                     _cc.invalidate(disk_key)
+            rspan.annotate(hit=compiled is not None).end()
         if compiled is None:
+            cspan = _tracing.start_span("executor.compile")
             try:
                 with mkctx():
                     t_tr = time.perf_counter()
@@ -768,6 +780,7 @@ class Executor:
                     "to lazy jit", e)
                 _telemetry.inc("executor_aot_fallback_total")
                 compiled = None
+            cspan.annotate(source=cstats["source"]).end()
         cstats["compile_ms"] = (time.perf_counter() - t0) * 1e3
         entry = _CompiledPlan(
             build.plan, compiled if compiled is not None else jfn,
@@ -861,30 +874,35 @@ class Executor:
                              feed_shapes={n: tuple(a.shape)
                                           for n, a in feed_arrays.items()})
         t0 = time.perf_counter()
-        build = self._build(program, list(feed_arrays), fetch_names, mesh,
-                            data_axis, devices=devices)
-        plan = build.plan
-        if build.mesh is not None and mesh is None:
-            mesh = build.mesh
-            data_axis = build.data_axis
-        params_ro, params_rw = {}, {}
-        for n in plan.ro_names:
-            params_ro[n] = self._scope_value(scope, n, block)
-        for n in plan.rw_names:
-            params_rw[n] = self._scope_value(scope, n, block)
-        params_carry, _h, _c = self._gather_carry(scope, plan, block)
-        rng = np.asarray([(program.random_seed or 0) & 0xFFFFFFFF, 0],
-                         dtype=np.uint32)
-        if mesh is not None:
-            feed_arrays = self._shard_feeds(feed_arrays, mesh, data_axis)
-            params_ro = self._shard_params(params_ro, mesh, block)
-            params_rw = self._shard_params(params_rw, mesh, block)
-        dev = self._jax_device(mesh)
-        disk_key = self._disk_key(program, plan, feed_arrays, fetch_names,
-                                  trace_flags, mesh, dev)
-        entry, cstats = self._finalize_compile(
-            build, feed_arrays, params_ro, params_rw, params_carry, rng,
-            disk_key, dev)
+        # the warmup span stacks over the whole build+compile so the
+        # cache_restore/compile child spans nest under it
+        with _tracing.span("executor.warmup") as wspan:
+            build = self._build(program, list(feed_arrays), fetch_names,
+                                mesh, data_axis, devices=devices)
+            plan = build.plan
+            if build.mesh is not None and mesh is None:
+                mesh = build.mesh
+                data_axis = build.data_axis
+            params_ro, params_rw = {}, {}
+            for n in plan.ro_names:
+                params_ro[n] = self._scope_value(scope, n, block)
+            for n in plan.rw_names:
+                params_rw[n] = self._scope_value(scope, n, block)
+            params_carry, _h, _c = self._gather_carry(scope, plan, block)
+            rng = np.asarray([(program.random_seed or 0) & 0xFFFFFFFF, 0],
+                             dtype=np.uint32)
+            if mesh is not None:
+                feed_arrays = self._shard_feeds(feed_arrays, mesh,
+                                                data_axis)
+                params_ro = self._shard_params(params_ro, mesh, block)
+                params_rw = self._shard_params(params_rw, mesh, block)
+            dev = self._jax_device(mesh)
+            disk_key = self._disk_key(program, plan, feed_arrays,
+                                      fetch_names, trace_flags, mesh, dev)
+            entry, cstats = self._finalize_compile(
+                build, feed_arrays, params_ro, params_rw, params_carry,
+                rng, disk_key, dev)
+            wspan.annotate(source=cstats["source"])
         if devices is None:
             self._cache[key] = entry
         ms = (time.perf_counter() - t0) * 1e3

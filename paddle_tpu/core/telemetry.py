@@ -166,18 +166,71 @@ def event(kind, **fields):
                 fh.flush()
 
 
+class _RotatingFile:
+    """Append-only JSONL stream with size-bounded rotate-and-keep-one:
+    when the file would exceed ``FLAGS_telemetry_max_bytes`` (or the
+    explicit ``max_bytes``), it is renamed to ``<path>.1`` (replacing any
+    previous generation) and writing restarts on a fresh file — long
+    fleet soaks stay disk-bounded at ~2x the cap.  Shared by the
+    steps.jsonl event stream and the tracing trace-<pid>.jsonl sink."""
+
+    __slots__ = ("path", "_fh", "_size", "_max")
+
+    def __init__(self, path, max_bytes=None):
+        self.path = path
+        self._max = max_bytes
+        self._fh = open(path, "a")
+        self._size = self._fh.tell()
+
+    def _limit(self):
+        if self._max is not None:
+            return int(self._max)
+        v = _flags().flag("telemetry_max_bytes")
+        return int(v) if v else 0
+
+    def write(self, s):
+        if self._fh is None:
+            return
+        limit = self._limit()
+        if limit > 0 and self._size > 0 and self._size + len(s) > limit:
+            try:
+                self._fh.close()
+                os.replace(self.path, self.path + ".1")
+                self._fh = open(self.path, "a")
+                self._size = 0
+            except OSError:
+                pass
+        try:
+            self._fh.write(s)
+            self._size += len(s)
+        except (OSError, ValueError):
+            pass
+
+    def flush(self):
+        try:
+            if self._fh is not None:
+                self._fh.flush()
+        except (OSError, ValueError):
+            pass
+
+    def close(self):
+        try:
+            if self._fh is not None:
+                self._fh.close()
+        except (OSError, ValueError):
+            pass
+        self._fh = None
+
+
 def _event_fh(d):
     path = os.path.join(d, "steps.jsonl")
     if _event_sink[0] != path:
         if _event_sink[1] is not None:
-            try:
-                _event_sink[1].close()
-            except OSError:
-                pass
+            _event_sink[1].close()
         try:
             os.makedirs(d, exist_ok=True)
             _event_sink[0] = path
-            _event_sink[1] = open(path, "a")
+            _event_sink[1] = _RotatingFile(path)
         except OSError:
             _event_sink[0] = _event_sink[1] = None
     return _event_sink[1]
@@ -332,10 +385,7 @@ def reset():
         _events.clear()
         _event_seq.clear()
         if _event_sink[1] is not None:
-            try:
-                _event_sink[1].close()
-            except OSError:
-                pass
+            _event_sink[1].close()
         _event_sink[0] = _event_sink[1] = None
 
 
@@ -352,24 +402,48 @@ def publish_rpc(server, key=METRICS_RPC_KEY):
     server.set_var(key, np.frombuffer(buf, dtype=np.uint8).copy())
 
 
+class PublisherHandle(threading.Event):
+    """Stop handle for the publisher daemon: an Event (``set()`` alone
+    keeps the legacy contract working) that also knows its thread, so
+    shutdown can ``stop()`` — set AND join — instead of leaking the
+    thread into the next test.  Idempotent: double-stop is a no-op."""
+
+    def __init__(self):
+        super(PublisherHandle, self).__init__()
+        self.thread = None
+
+    def stop(self, timeout=5.0):
+        self.set()
+        t = self.thread
+        if t is not None and t.is_alive() \
+                and t is not threading.current_thread():
+            t.join(timeout)
+        self.thread = None
+
+
 def start_publisher(server, interval_s=1.0, key=METRICS_RPC_KEY,
                     stop_event=None):
     """Republish the snapshot on `server` every `interval_s` so scrapes
-    always read a fresh view (publish_rpc is one-shot).  Returns the stop
-    Event; set it to end the daemon thread.  The serving frontend uses
-    this for its __metrics__ endpoint."""
-    stop = stop_event or threading.Event()
+    always read a fresh view (publish_rpc is one-shot).  Returns a
+    PublisherHandle — call ``.stop()`` to end AND join the daemon thread
+    (``.set()`` alone still ends it, legacy contract).  The serving
+    frontend uses this for its __metrics__ endpoint."""
+    stop = PublisherHandle()
 
     def loop():
         while not stop.wait(interval_s):
+            if stop_event is not None and stop_event.is_set():
+                return
             try:
                 publish_rpc(server, key=key)
             except Exception:
                 return  # server shut down under us
 
     publish_rpc(server, key=key)
-    threading.Thread(target=loop, name="telemetry-publisher",
-                     daemon=True).start()
+    t = threading.Thread(target=loop, name="telemetry-publisher",
+                         daemon=True)
+    stop.thread = t
+    t.start()
     return stop
 
 

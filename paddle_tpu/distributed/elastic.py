@@ -53,6 +53,7 @@ import time
 import numpy as np
 
 from ..core import telemetry as _tm
+from ..core import tracing as _tr
 from ..native.rpc import RpcClient, RpcServer, EV_SEND
 from .ps import HeartBeatMonitor
 
@@ -700,6 +701,20 @@ class ElasticMember:
         _tm.observe("elastic_requorum_ms", ms, role="member")
         for ph in ("transpile", "verify", "compile", "restore"):
             _tm.observe("elastic_requorum_phase_ms", phases[ph], phase=ph)
+        if _tr.enabled():
+            # the phases were measured as perf_counter deltas; lay them
+            # out retroactively as one span tree per adoption epoch, the
+            # phase children sequential from the adoption's wall start
+            wall0 = time.time() - ms / 1e3
+            root = _tr.record_span(
+                "elastic.requorum", wall0, ms, epoch=view.epoch,
+                world=world, rank=self.rank, standby=standby is not None)
+            cursor = wall0
+            for ph in ("init", "transpile", "verify", "compile",
+                       "restore"):
+                _tr.record_span("elastic." + ph, cursor, phases[ph],
+                                parent=root)
+                cursor += phases[ph] / 1e3
         _tm.set_gauge("elastic_epoch", view.epoch)
         if old_epoch >= 0:
             _tm.event("elastic_adopt", rank=self.rank, epoch=view.epoch,

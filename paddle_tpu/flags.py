@@ -62,6 +62,20 @@ _DEFAULTS = {
     # metrics.prom; empty = in-memory only (snapshot()/__metrics__ RPC
     # still work, nothing touches disk)
     "FLAGS_telemetry_dir": "",
+    # size bound (bytes) for the append-only JSONL streams under
+    # FLAGS_telemetry_dir (steps.jsonl + the tracing trace-<pid>.jsonl):
+    # when a stream exceeds it, the file is rotated to <name>.1 (one
+    # previous generation kept) so long fleet soaks stay disk-bounded.
+    # <=0 disables rotation.
+    "FLAGS_telemetry_max_bytes": 256 << 20,
+    # distributed tracing (core/tracing.py): cross-process request/step
+    # spans (trace_id/span_id/parent_id, W3C-style traceparent propagated
+    # through the serving meta + RPC SEND frames) streamed as JSONL
+    # (trace-<pid>.jsonl under FLAGS_telemetry_dir) and merged by
+    # tools/trace_view.py into one Chrome/Perfetto trace.json.  Zero cost
+    # when off: every span call early-returns on this one flag read, and
+    # no trace file is ever created.
+    "FLAGS_tracing": False,
     # static Program verifier (core/analysis.py): off | warn | error.
     # "warn" (default) runs the four rule families (well-formedness,
     # type/shape flow, donation/aliasing, distributed lint) on every

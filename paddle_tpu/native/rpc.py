@@ -11,6 +11,7 @@ import numpy as np
 
 from . import load
 from ..core import telemetry as _tm
+from ..core import tracing as _tr
 from ..utils.fault_injection import FaultInjected, maybe_fail
 
 __all__ = ["RpcServer", "RpcClient", "backoff_delay", "probe"]
@@ -89,7 +90,12 @@ class RpcServer:
             np_dt = np.dtype(_DTYPES[dtype.value])
             buf = ctypes.string_at(data.value, dlen.value)
             arr = np.frombuffer(buf, dtype=np_dt).reshape(shape).copy()
-        return t, name.value.decode(), arr
+        # SEND frames may carry a trace context appended to the name
+        # (tracing.stamp_wire_name); hand callers the bare name always
+        bare, tp = _tr.strip_wire_name(name.value.decode())
+        if tp is not None:
+            _tr.wire_received(bare, tp)
+        return t, bare, arr
 
     def set_var(self, name, arr):
         arr = np.ascontiguousarray(arr)
@@ -224,6 +230,10 @@ class RpcClient:
         arr = np.ascontiguousarray(arr)
         dims = (ctypes.c_longlong * max(arr.ndim, 1))(*(arr.shape or (0,)))
         what = "send_var(%s)" % name
+        # stamp the active trace context onto the frame name — SEND names
+        # only surface via server poll (which strips them) and never
+        # enter the var store, so GET-by-name semantics are untouched
+        wire_name = _tr.stamp_wire_name(name)
         if _tm.enabled():
             _tm.inc("rpc_send_total")
             _tm.inc("rpc_send_bytes_total", int(arr.nbytes))
@@ -241,7 +251,7 @@ class RpcClient:
                 raise FaultInjected("%s to %s: injected frame drop"
                                     % (what, self.endpoint))
             rc = self._lib.rpcc_send_var(
-                self._h, name.encode(), _DT_TO_CODE[arr.dtype], dims,
+                self._h, wire_name.encode(), _DT_TO_CODE[arr.dtype], dims,
                 arr.ndim, arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes)
             if rc != 0:
                 raise self._err(what)

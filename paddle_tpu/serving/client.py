@@ -18,6 +18,7 @@ import os
 import time
 import uuid
 
+from ..core import tracing as _tr
 from ..native.rpc import RpcClient
 from . import codec
 from .engine import InferReply
@@ -95,11 +96,17 @@ class ServingClient:
         when every endpoint attempt failed."""
         deadline_ms = float(deadline_ms or self.default_deadline_ms)
         req_id = uuid.uuid4().hex
+        # root span of the cross-process trace; its context rides the
+        # request meta so the server parents its admission span under it
+        root = _tr.start_span("client.infer", model=model,
+                              tenant=self.tenant, req_id=req_id)
         names = list(feeds)
-        payload = codec.pack(
-            {"model": model, "tenant": self.tenant, "req_id": req_id,
-             "deadline_ms": deadline_ms, "feeds": names},
-            [feeds[n] for n in names])
+        meta_req = {"model": model, "tenant": self.tenant,
+                    "req_id": req_id, "deadline_ms": deadline_ms,
+                    "feeds": names}
+        if root.traceparent:
+            meta_req[codec.TRACEPARENT] = root.traceparent
+        payload = codec.pack(meta_req, [feeds[n] for n in names])
         # reply wait: the request may sit a full deadline in the queue and
         # then still be served — bound the GET at deadline + slack
         get_timeout = deadline_ms / 1e3 + 30.0
@@ -121,9 +128,12 @@ class ServingClient:
                 c = RpcClient(ep, connect_timeout=2.0,
                               rpc_deadline=get_timeout, retry_times=0)
                 try:
-                    c.send_var(codec.INFER_KEY + req_id, payload)
-                    meta, arrays = codec.unpack(
-                        c.get_var(codec.REPLY_KEY + req_id))
+                    # activate the root so the SEND frame gets stamped
+                    # with its context (native/rpc.py stamp_wire_name)
+                    with _tr.activate(root):
+                        c.send_var(codec.INFER_KEY + req_id, payload)
+                        meta, arrays = codec.unpack(
+                            c.get_var(codec.REPLY_KEY + req_id))
                 finally:
                     c.close()
             except ConnectionError as e:
@@ -133,9 +143,18 @@ class ServingClient:
                 meta.get("status", "error"),
                 outputs=dict(zip(meta.get("outputs", []), arrays)),
                 error=meta.get("error"),
-                retry_after_ms=meta.get("retry_after_ms", 0.0))
+                retry_after_ms=meta.get("retry_after_ms", 0.0),
+                phases=dict(meta.get("phases") or {}))
             reply.latency_ms = (time.perf_counter() - t0) * 1e3
+            # wire_ms: what the client saw minus what the server spent
+            srv_ms = float(meta.get("latency_ms") or 0.0)
+            if srv_ms > 0.0:
+                reply.phases["wire_ms"] = round(
+                    max(reply.latency_ms - srv_ms, 0.0), 3)
+            root.annotate(status=reply.status, endpoint=ep,
+                          attempts=i + 1).end()
             return reply
+        root.annotate(status="dropped", attempts=attempts).end()
         return InferReply(
             "dropped", error="all %d attempts failed: %s"
             % (attempts, last_err),

@@ -19,6 +19,12 @@ elastic ``__alive__``):
                            retry_after_ms on shed, outputs name order
   ``__spec__:<model>``     server-published feed/fetch signature + buckets
                            (loadgen synthesizes valid feeds from it)
+
+Distributed tracing (core/tracing.py) rides the meta under the
+``TRACEPARENT`` key: the client stamps its root span's W3C-style
+``traceparent`` into the request meta, the server parents its admission
+span under it, and the reply meta echoes it (plus per-phase timings under
+``"phases"``) so one trace_id spans client and replica processes.
 """
 
 import json
@@ -26,12 +32,14 @@ import json
 import numpy as np
 
 __all__ = ["pack", "unpack", "INFER_KEY", "REPLY_KEY", "SPEC_KEY",
-           "ALIVE_KEY"]
+           "ALIVE_KEY", "TRACEPARENT"]
 
 INFER_KEY = "__infer__:"
 REPLY_KEY = "__reply__:"
 SPEC_KEY = "__spec__:"
 ALIVE_KEY = "__alive__"
+# meta key carrying the W3C-style trace context across the wire
+TRACEPARENT = "traceparent"
 
 
 def pack(meta, arrays=()):

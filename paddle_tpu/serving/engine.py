@@ -36,6 +36,7 @@ import uuid
 import numpy as np
 
 from ..core import telemetry as _tm
+from ..core import tracing as _tr
 from ..core.executor import scope_guard
 
 __all__ = ["ServingEngine", "InferReply", "parse_buckets"]
@@ -66,45 +67,57 @@ class InferReply:
     """Terminal state of one request: status ok|shed|timeout|error."""
 
     __slots__ = ("status", "outputs", "error", "retry_after_ms",
-                 "latency_ms")
+                 "latency_ms", "phases")
 
     def __init__(self, status, outputs=None, error=None,
-                 retry_after_ms=0.0, latency_ms=0.0):
+                 retry_after_ms=0.0, latency_ms=0.0, phases=None):
         self.status = status
         self.outputs = outputs or {}
         self.error = error
         self.retry_after_ms = float(retry_after_ms)
         self.latency_ms = float(latency_ms)
+        # SLO phase attribution (always on, tracing-independent):
+        # queue_wait_ms / execute_ms / bucket / rows — the client adds
+        # wire_ms as its end-to-end latency minus our latency_ms
+        self.phases = phases or {}
 
     @property
     def ok(self):
         return self.status == "ok"
 
     def to_meta(self):
-        return {"status": self.status, "error": self.error,
+        meta = {"status": self.status, "error": self.error,
                 "retry_after_ms": round(self.retry_after_ms, 3),
                 "latency_ms": round(self.latency_ms, 3),
                 "outputs": list(self.outputs)}
+        if self.phases:
+            meta["phases"] = self.phases
+        return meta
 
 
 class _Pending:
     """Handle returned by submit(): wait() blocks for the InferReply."""
 
     __slots__ = ("model", "tenant", "feeds", "rows", "deadline",
-                 "t_submit", "req_id", "callback", "_done", "reply")
+                 "t_submit", "t_dispatch", "req_id", "callback", "_done",
+                 "reply", "traceparent", "span", "qspan")
 
     def __init__(self, model, tenant, feeds, rows, deadline_ms, req_id,
-                 callback):
+                 callback, traceparent=None):
         self.model = model
         self.tenant = tenant
         self.feeds = feeds
         self.rows = rows
         self.t_submit = time.perf_counter()
+        self.t_dispatch = None
         self.deadline = self.t_submit + deadline_ms / 1e3
         self.req_id = req_id
         self.callback = callback
         self._done = threading.Event()
         self.reply = None
+        self.traceparent = traceparent  # wire context echoed in the reply
+        self.span = None    # serving.request (submit -> complete)
+        self.qspan = None   # serving.queue_wait child (submit -> dispatch)
 
     def complete(self, reply):
         reply.latency_ms = (time.perf_counter() - self.t_submit) * 1e3
@@ -235,12 +248,13 @@ class ServingEngine:
         return batches_ahead * entry.svc_ms
 
     def submit(self, model, feeds, tenant="default", deadline_ms=None,
-               callback=None, req_id=None):
+               callback=None, req_id=None, traceparent=None):
         """Enqueue one request; returns a _Pending (wait() for the reply).
         Shed/timeout/error requests complete immediately."""
         deadline_ms = float(deadline_ms or self.default_deadline_ms)
         req = _Pending(model, tenant, feeds, 0, deadline_ms,
-                       req_id or uuid.uuid4().hex, callback)
+                       req_id or uuid.uuid4().hex, callback,
+                       traceparent=traceparent)
         entry = self._models.get(model)
         if entry is None or not self._running:
             req.complete(InferReply(
@@ -271,6 +285,14 @@ class ServingEngine:
                           % (wait_ms, deadline_ms),
                     retry_after_ms=wait_ms - deadline_ms + entry.svc_ms))
                 return req
+            # admitted: open the request span (parents under the server's
+            # admission span when submit runs inside it) and its
+            # queue-wait child, ended at dispatch or deadline expiry
+            req.span = _tr.start_span(
+                "serving.request", model=model, tenant=tenant,
+                rows=req.rows, req_id=req.req_id)
+            req.qspan = _tr.start_span("serving.queue_wait",
+                                       parent=req.span, depth=depth)
             self._queue.append(req)
             _tm.set_gauge("serving_queue_depth", len(self._queue))
             self._cond.notify_all()
@@ -333,6 +355,10 @@ class ServingEngine:
         with self._cond:
             for req in self._queue:
                 req.complete(InferReply("error", error="engine stopped"))
+                if req.qspan is not None:
+                    req.qspan.end()
+                if req.span is not None:
+                    req.span.annotate(status="error").end()
             self._queue.clear()
 
     def _bucket_for(self, rows):
@@ -384,8 +410,18 @@ class ServingEngine:
                 if now > r.deadline:
                     _tm.inc("serving_timeout_total", model=r.model)
                     r.complete(InferReply(
-                        "timeout", error="deadline expired in queue"))
+                        "timeout", error="deadline expired in queue",
+                        phases={"queue_wait_ms":
+                                round((now - r.t_submit) * 1e3, 3),
+                                "rows": r.rows}))
+                    if r.qspan is not None:
+                        r.qspan.annotate(expired=True).end()
+                    if r.span is not None:
+                        r.span.annotate(status="timeout").end()
                 else:
+                    r.t_dispatch = now
+                    if r.qspan is not None:
+                        r.qspan.end()
                     live.append(r)
             if live:
                 self.in_batch = True
@@ -399,30 +435,61 @@ class ServingEngine:
                 except Exception:
                     pass
 
+    @staticmethod
+    def _phases(r, execute_ms, bucket):
+        """Per-request SLO phase attribution for the reply meta (always
+        on — the client derives wire_ms as e2e minus server latency)."""
+        t_d = r.t_dispatch if r.t_dispatch is not None else r.t_submit
+        return {"queue_wait_ms": round((t_d - r.t_submit) * 1e3, 3),
+                "execute_ms": round(execute_ms, 3),
+                "bucket": bucket, "rows": r.rows}
+
     def _run_batch(self, entry, batch):
         rows = sum(r.rows for r in batch)
         bucket = self._bucket_for(rows)
         pred = entry.predictor
-        feed = {}
-        for name in entry.feed_specs:
-            parts = [r.feeds[name] for r in batch]
-            stacked = np.concatenate(parts, axis=0) if len(parts) > 1 \
-                else parts[0]
-            if rows < bucket:
-                pad = np.zeros((bucket - rows,) + stacked.shape[1:],
-                               dtype=stacked.dtype)
-                stacked = np.concatenate([stacked, pad], axis=0)
-            feed[name] = stacked
-        t0 = time.perf_counter()
-        try:
-            with scope_guard(pred._scope):
-                vals = pred._exe.run(pred.program(), feed=feed,
-                                     fetch_list=pred._fetch_vars)
-        except Exception as e:
-            for r in batch:
-                r.complete(InferReply("error", error=str(e)))
-            _tm.inc("serving_batch_errors_total", model=entry.name)
-            return
+        # a batch serves N requests from (up to) N different traces, so
+        # the batch span is a root that LINKS them rather than parenting
+        bspan = _tr.start_span("serving.batch", model=entry.name,
+                               bucket=bucket, rows=rows,
+                               requests=len(batch))
+        for r in batch:
+            bspan.link(r.span.context if r.span is not None else None)
+        with _tr.activate(bspan):
+            with _tr.span("serving.pad_to_bucket", rows=rows,
+                          bucket=bucket):
+                feed = {}
+                for name in entry.feed_specs:
+                    parts = [r.feeds[name] for r in batch]
+                    stacked = np.concatenate(parts, axis=0) \
+                        if len(parts) > 1 else parts[0]
+                    if rows < bucket:
+                        pad = np.zeros(
+                            (bucket - rows,) + stacked.shape[1:],
+                            dtype=stacked.dtype)
+                        stacked = np.concatenate([stacked, pad], axis=0)
+                    feed[name] = stacked
+            # write-through breadcrumb: if this replica is SIGKILLed
+            # mid-execute, flightrec-<pid>.json already names the batch
+            _tr.note("batch_start", model=entry.name, bucket=bucket,
+                     req_ids=[r.req_id for r in batch])
+            t0 = time.perf_counter()
+            try:
+                with _tr.span("serving.execute", bucket=bucket):
+                    with scope_guard(pred._scope):
+                        vals = pred._exe.run(pred.program(), feed=feed,
+                                             fetch_list=pred._fetch_vars)
+            except Exception as e:
+                ms = (time.perf_counter() - t0) * 1e3
+                for r in batch:
+                    r.complete(InferReply(
+                        "error", error=str(e),
+                        phases=self._phases(r, ms, bucket)))
+                    if r.span is not None:
+                        r.span.annotate(status="error").end()
+                _tm.inc("serving_batch_errors_total", model=entry.name)
+                bspan.annotate(error=str(e)[:200]).end()
+                return
         ms = (time.perf_counter() - t0) * 1e3
         entry.svc_ms = ms if entry.svc_ms <= 0 else \
             0.7 * entry.svc_ms + 0.3 * ms
@@ -437,13 +504,17 @@ class ServingEngine:
                 sliced[n] = o[off:off + r.rows].copy() \
                     if o.ndim and o.shape[0] == bucket else o
             off += r.rows
-            r.complete(InferReply("ok", outputs=sliced))
+            r.complete(InferReply("ok", outputs=sliced,
+                                  phases=self._phases(r, ms, bucket)))
+            if r.span is not None:
+                r.span.annotate(status="ok", bucket=bucket).end()
             _tm.observe("serving_latency_ms", r.reply.latency_ms,
                         model=entry.name)
         _tm.inc("serving_batches_total", model=entry.name,
                 bucket=str(bucket))
         _tm.observe("serving_batch_fill", rows / float(bucket),
                     model=entry.name)
+        bspan.end()
         now = time.time()
         self._done_times.extend([now] * len(batch))
         cut = now - _QPS_WINDOW_S

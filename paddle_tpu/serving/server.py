@@ -24,6 +24,7 @@ import threading
 import numpy as np
 
 from ..core import telemetry as _tm
+from ..core import tracing as _tr
 from ..native.rpc import EV_SEND, RpcServer
 from . import codec
 
@@ -92,24 +93,39 @@ class ServingServer:
             _tm.inc("serving_bad_request_total")
             del e
             return
-        self.engine.submit(
-            meta.get("model", ""), feeds,
-            tenant=meta.get("tenant", "default"),
-            deadline_ms=meta.get("deadline_ms"),
-            req_id=req_id,
-            callback=lambda pending: self._publish(pending.req_id,
-                                                   pending.reply))
+        tp = meta.get(codec.TRACEPARENT)
+        # the admission span parents under the client's root span (wire
+        # context), and engine.submit opens the request span inside it
+        with _tr.remote_parent(tp):
+            with _tr.span("serving.admission", req_id=req_id,
+                          model=meta.get("model", ""), rank=self.rank):
+                self.engine.submit(
+                    meta.get("model", ""), feeds,
+                    tenant=meta.get("tenant", "default"),
+                    deadline_ms=meta.get("deadline_ms"),
+                    req_id=req_id,
+                    traceparent=tp,
+                    callback=lambda pending: self._publish(
+                        pending.req_id, pending.reply, pending))
 
-    def _publish(self, req_id, reply):
+    def _publish(self, req_id, reply, pending=None):
         from .engine import InferReply
 
         if reply is None:
             reply = InferReply("error", error="malformed request")
-        names = list(reply.outputs)
-        buf = codec.pack(reply.to_meta(),
-                         [reply.outputs[n] for n in names])
-        key = codec.REPLY_KEY + req_id
-        self.rpc.set_var(key, buf)
+        # runs inside _Pending.complete(), so parent explicitly under the
+        # request span rather than whatever is on the completing thread
+        with _tr.span("serving.reply_publish",
+                      parent=getattr(pending, "span", None),
+                      req_id=req_id, status=reply.status):
+            meta = reply.to_meta()
+            tp = getattr(pending, "traceparent", None)
+            if tp:
+                meta[codec.TRACEPARENT] = tp
+            names = list(reply.outputs)
+            buf = codec.pack(meta, [reply.outputs[n] for n in names])
+            key = codec.REPLY_KEY + req_id
+            self.rpc.set_var(key, buf)
         with self._reply_lock:
             self._reply_keys.append(key)
             while len(self._reply_keys) > _REPLY_RING:
@@ -124,7 +140,9 @@ class ServingServer:
             return
         self._stopped.set()
         if self._pub_stop is not None:
-            self._pub_stop.set()
+            # stop AND join (idempotent) — a leaked publisher thread
+            # would republish __metrics__ into the next test's server
+            self._pub_stop.stop()
         if self.fleet is not None:
             self.fleet.stop()
         self.engine.stop()

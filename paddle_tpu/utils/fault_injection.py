@@ -152,6 +152,14 @@ def maybe_fail(point):
     from ..core import telemetry as _tm
 
     _tm.inc("fault_injected_total", point=p.name, kind=p.kind)
+    # flight-recorder dump BEFORE the fault acts, same reasoning: the
+    # note() write-through puts the postmortem on disk even for "kill"
+    try:
+        from ..core import tracing as _tracing
+
+        _tracing.note("fault", point=p.name, fault_kind=p.kind)
+    except Exception:
+        pass
     if p.kind == "delay":
         import time
 

@@ -36,6 +36,10 @@ def _env(tmp):
         "FLAGS_serving_hb_interval": "0.2",
         "FLAGS_serving_hb_timeout": "1.5",
         "FLAGS_compile_cache_dir": os.path.join(str(tmp), "cc"),
+        # tracing on: a SIGKILLed replica must leave a flight-recorder
+        # postmortem under the telemetry dir (asserted below)
+        "FLAGS_tracing": "1",
+        "FLAGS_telemetry_dir": os.path.join(str(tmp), "tel"),
     })
     return env
 
@@ -120,6 +124,19 @@ def test_sigkill_replica_drops_nothing(tmp_path):
             time.sleep(0.2)
         else:
             raise AssertionError("fleet never shrank: %r" % (doc,))
+
+        # the SIGKILLed replica left a flight-recorder postmortem naming
+        # its in-flight work: the write-through note("batch_start") puts
+        # the dump on disk BEFORE execute, so even -9 can't lose it
+        victim_fr = os.path.join(str(tmp_path), "tel",
+                                 "flightrec-%d.json" % victim.pid)
+        assert os.path.exists(victim_fr), \
+            "SIGKILLed replica left no flight record"
+        with open(victim_fr) as f:
+            doc = json.load(f)
+        batches = [r for r in doc.get("records", [])
+                   if r.get("kind") == "batch_start"]
+        assert batches and all(b.get("req_ids") for b in batches), doc
 
         stream(10, 0.02)                     # post-shrink traffic
         statuses = [r.status for r in replies]

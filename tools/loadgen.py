@@ -13,8 +13,11 @@ Batch sizes are sampled from --batch-mix so traffic exercises several
 buckets.
 
 Emits one JSON report (default BENCH_serving.json): p50/p99 end-to-end
-latency, achieved QPS under load, server-side mean batch fill, shed
-rate, and the dropped count (requests no live endpoint answered).
+latency, per-phase p50/p99 attribution (queue_wait_ms / execute_ms from
+the server's reply meta, wire_ms = client e2e minus server time — so a
+p99 regression localizes to queueing, compute, or the wire), achieved
+QPS under load, server-side mean batch fill, shed rate, and the dropped
+count (requests no live endpoint answered).
 --assert-no-drops makes a nonzero dropped count a nonzero exit — the CI
 SIGKILL leg's invariant that elastic shrink loses no admitted requests.
 """
@@ -84,6 +87,7 @@ def main(argv=None):
 
     lock = threading.Lock()
     latencies, statuses = [], {}
+    phase_samples = {"queue_wait_ms": [], "execute_ms": [], "wire_ms": []}
     threads = []
 
     def fire(rows):
@@ -93,6 +97,10 @@ def main(argv=None):
             statuses[r.status] = statuses.get(r.status, 0) + 1
             if r.ok:
                 latencies.append(r.latency_ms)
+                for ph, xs in phase_samples.items():
+                    v = r.phases.get(ph)
+                    if v is not None:
+                        xs.append(float(v))
 
     t_start = time.perf_counter()
     next_at = t_start
@@ -131,6 +139,18 @@ def main(argv=None):
         "statuses": statuses,
         "latency_ms_p50": round(percentile(latencies, 0.50), 3),
         "latency_ms_p99": round(percentile(latencies, 0.99), 3),
+        "queue_wait_ms_p50": round(
+            percentile(phase_samples["queue_wait_ms"], 0.50), 3),
+        "queue_wait_ms_p99": round(
+            percentile(phase_samples["queue_wait_ms"], 0.99), 3),
+        "execute_ms_p50": round(
+            percentile(phase_samples["execute_ms"], 0.50), 3),
+        "execute_ms_p99": round(
+            percentile(phase_samples["execute_ms"], 0.99), 3),
+        "wire_ms_p50": round(
+            percentile(phase_samples["wire_ms"], 0.50), 3),
+        "wire_ms_p99": round(
+            percentile(phase_samples["wire_ms"], 0.99), 3),
         "achieved_qps": round(len(latencies) / wall_s, 2) if wall_s else 0.0,
         "batch_fill": batch_fill,
         "shed_rate": round(statuses.get("shed", 0) / total, 4),

@@ -103,6 +103,11 @@ def main(argv=None):
                     "depth / qps / fleet gauges, request / shed / timeout "
                     "/ batch counters, latency + batch-fill histograms "
                     "(serving/engine.py + fleet.py)")
+    ap.add_argument("--tracing", action="store_true", dest="tracing_only",
+                    help="show only distributed-tracing health metrics: "
+                    "tracing_records_total{kind} and "
+                    "tracing_flightrec_dumps_total{reason} "
+                    "(core/tracing.py)")
     ap.add_argument("--lint", action="store_true", dest="lint_only",
                     help="show only static-checker metrics: per-rule "
                     "static_check_warnings counters and the whole-world "
@@ -132,6 +137,8 @@ def main(argv=None):
         snap = _filter_snap(snap, "pallas_kernel_")
     if args.serving_only:
         snap = _filter_snap(snap, "serving_")
+    if args.tracing_only:
+        snap = _filter_snap(snap, "tracing_")
     if args.lint_only:
         # covers static_check_warnings{rule=} and static_check_world_*
         snap = _filter_snap(snap, "static_check")

@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI harness (reference paddle/scripts/paddle_build.sh analog): build the
 # native pieces, run the full test pyramid, smoke the bench + graft entry.
-# Usage: tools/run_ci.sh [quick|full|tpu|--layout-smoke|--obs-smoke|--lint|--elastic-smoke|--zero1-smoke|--cache-smoke|--kernel-smoke|--serve-smoke]
+# Usage: tools/run_ci.sh [quick|full|tpu|--layout-smoke|--obs-smoke|--lint|--elastic-smoke|--zero1-smoke|--cache-smoke|--kernel-smoke|--serve-smoke|--trace-smoke]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -143,6 +143,51 @@ if [ "$MODE" = "--serve-smoke" ]; then
   trap - EXIT
   rm -rf "$SRV_DIR"
   echo "CI --serve-smoke: PASS"
+  exit 0
+fi
+
+if [ "$MODE" = "--trace-smoke" ]; then
+  # distributed-tracing leg: the tracing unit tests, then a live
+  # 2-replica fleet under FLAGS_tracing=1 — the per-process trace JSONL
+  # files must merge into one Perfetto-loadable trace.json containing at
+  # least one cross-process flow (client span -> replica span)
+  echo "== trace smoke: tracing tests =="
+  JAX_PLATFORMS=cpu python -m pytest tests/test_tracing.py -q
+  echo "== trace smoke: 2-replica fleet under FLAGS_tracing=1 =="
+  TRC_DIR="$(mktemp -d)"
+  JAX_PLATFORMS=cpu python tools/serve.py --save-demo-model "$TRC_DIR/model"
+  TRC_ENV=(JAX_PLATFORMS=cpu FLAGS_tracing=1 FLAGS_telemetry=1
+           FLAGS_telemetry_dir="$TRC_DIR/tel"
+           FLAGS_serving_hb_interval=0.2 FLAGS_serving_hb_timeout=1.5
+           FLAGS_compile_cache_dir="$TRC_DIR/cc")
+  env "${TRC_ENV[@]}" python tools/serve.py --model fc="$TRC_DIR/model" \
+    --rank 0 --fleet 127.0.0.1:9470,127.0.0.1:9471 --buckets 1,4 \
+    --endpoints-file "$TRC_DIR/eps.json" > "$TRC_DIR/r0.log" 2>&1 &
+  T0=$!
+  env "${TRC_ENV[@]}" python tools/serve.py --model fc="$TRC_DIR/model" \
+    --rank 1 --fleet 127.0.0.1:9470,127.0.0.1:9471 --buckets 1,4 \
+    --endpoints-file "$TRC_DIR/eps.json" > "$TRC_DIR/r1.log" 2>&1 &
+  T1=$!
+  trap 'kill -9 $T0 $T1 2>/dev/null || true' EXIT
+  for _ in $(seq 60); do
+    grep -q READY "$TRC_DIR/r0.log" && grep -q READY "$TRC_DIR/r1.log" \
+      && break
+    sleep 1
+  done
+  grep -q READY "$TRC_DIR/r0.log" && grep -q READY "$TRC_DIR/r1.log"
+  env "${TRC_ENV[@]}" python tools/loadgen.py \
+    --endpoints-file "$TRC_DIR/eps.json" --model fc --requests 40 \
+    --qps 40 --out "$TRC_DIR/BENCH_serving.json" --assert-no-drops
+  kill $T0 $T1 2>/dev/null || true
+  wait $T0 $T1 2>/dev/null || true
+  trap - EXIT
+  # one trace.json over client + both replicas, >=1 cross-process flow
+  python tools/trace_view.py --telemetry_dir "$TRC_DIR/tel" \
+    --out "$TRC_DIR/trace.json" --require-flow
+  python -c "import json,sys; json.load(open(sys.argv[1]))" \
+    "$TRC_DIR/trace.json"
+  rm -rf "$TRC_DIR"
+  echo "CI --trace-smoke: PASS"
   exit 0
 fi
 

@@ -85,10 +85,13 @@ def main(argv=None):
         return 0
 
     import paddle_tpu as fluid
+    from paddle_tpu.core import tracing
     from paddle_tpu.serving import ServingEngine, ServingFleet, ServingServer
 
     if args.cache_dir:
         fluid.set_flags({"FLAGS_compile_cache_dir": args.cache_dir})
+    # names this replica's track in the merged trace_view.py output
+    tracing.set_process_name("serving-replica-%d" % args.rank)
     if not args.model:
         ap.error("at least one --model NAME=DIR is required")
 

@@ -25,6 +25,20 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def track_meta(pid, name, tid=None, thread_name=None, sort_index=None):
+    """Chrome-trace metadata events (ph "M") naming a process track and
+    optionally one of its threads — shared with tools/trace_view.py."""
+    evs = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": name}}]
+    if sort_index is not None:
+        evs.append({"name": "process_sort_index", "ph": "M", "pid": pid,
+                    "tid": 0, "args": {"sort_index": sort_index}})
+    if tid is not None and thread_name is not None:
+        evs.append({"name": "thread_name", "ph": "M", "pid": pid,
+                    "tid": tid, "args": {"name": thread_name}})
+    return evs
+
+
 def from_profiler(profile_path):
     with open(profile_path) as f:
         data = json.load(f)
