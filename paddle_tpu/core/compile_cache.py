@@ -53,7 +53,8 @@ from . import telemetry as _tm
 
 __all__ = [
     "enabled", "cache_dir", "aot_dir", "xla_dir", "enable_xla_cache",
-    "program_fingerprint", "artifact_key", "load", "store", "invalidate",
+    "program_fingerprint", "artifact_key", "raw_artifact_key", "load",
+    "store", "invalidate",
     "entries", "stats", "clear", "evict_to_cap",
 ]
 
@@ -170,6 +171,22 @@ def artifact_key(program, feed_sig, fetch_names, trace_flags, mesh_sig=None,
         "extra": extra,
     }
     blob = json.dumps(payload, sort_keys=True, default=_json_default)
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def raw_artifact_key(kind, payload):
+    """Content key for a non-Program executable (the decode-serving
+    CarriedStepFn path): ``payload`` is any JSON-able description of
+    everything that affects the compiled artifact — model weight
+    fingerprint, cache geometry, argument signature, trace flags.  The
+    jax version + backend are folded in for the same reason as
+    ``artifact_key``."""
+    import jax
+
+    blob = json.dumps({"format": FORMAT, "kind": str(kind),
+                       "payload": payload, "jax": jax.__version__,
+                       "backend": jax.default_backend()},
+                      sort_keys=True, default=_json_default)
     return hashlib.sha256(blob.encode()).hexdigest()
 
 

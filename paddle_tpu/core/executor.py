@@ -29,7 +29,8 @@ from .scope import Scope
 from . import telemetry as _telemetry
 from . import tracing as _tracing
 
-__all__ = ["Executor", "global_scope", "scope_guard"]
+__all__ = ["Executor", "global_scope", "scope_guard", "CarriedStepFn",
+           "aot_compile_cached"]
 
 import contextlib
 import threading
@@ -147,6 +148,217 @@ class _BuildResult:
         self.mesh = mesh
         self.data_axis = data_axis
         self.out_shardings = out_shardings
+
+
+def aot_compile_cached(jfn, args, disk_key, dev=None, meta=None):
+    """Produce an AOT ``Compiled`` for ``jfn(*args)`` with tier-B disk
+    persistence: disk restore -> eager ``lower().compile()`` (serialized
+    back, round-trip-trialed) -> ``(None, cstats)`` when the eager path
+    explodes (the caller falls back to the lazy jit wrapper).
+
+    Shared by the Program path (``Executor._finalize_compile``) and the
+    decode-serving step path (``CarriedStepFn``) — one implementation of
+    the restore/compile/serialize discipline, including the tier-A
+    poisoned-executable retry."""
+    from . import compile_cache as _cc
+
+    def mkctx():
+        # jax.default_device is a single-use context manager
+        return (jax.default_device(dev) if dev is not None
+                else contextlib.nullcontext())
+
+    tel = _telemetry.enabled()
+    cstats = {"source": "fallback", "compile_ms": 0.0}
+    compiled = None
+    t0 = time.perf_counter()
+    if disk_key is not None:
+        rspan = _tracing.start_span("executor.cache_restore",
+                                    key=disk_key[:12])
+        got = _cc.load(disk_key)
+        if got is not None:
+            try:
+                from jax.experimental import serialize_executable as _se
+
+                with mkctx():
+                    compiled = _se.deserialize_and_load(
+                        got["payload"], got["in_tree"], got["out_tree"])
+                cstats["source"] = "disk"
+                if tel:
+                    _telemetry.observe(
+                        "compile_cache_load_ms",
+                        (time.perf_counter() - t0) * 1e3)
+            except Exception as e:
+                compiled = None
+                logging.warning(
+                    "compile_cache: deserialize of %s failed (%s); "
+                    "recompiling", disk_key[:12], e)
+                _telemetry.inc("compile_cache_errors_total",
+                               kind="deserialize")
+                # crc-valid but unloadable (e.g. XLA build drift):
+                # drop it so the store below rewrites the entry
+                _cc.invalidate(disk_key)
+        rspan.annotate(hit=compiled is not None).end()
+    if compiled is None:
+        cspan = _tracing.start_span("executor.compile")
+        try:
+            with mkctx():
+                t_tr = time.perf_counter()
+                lowered = jfn.lower(*args)
+                t_lo = time.perf_counter()
+                compiled = lowered.compile()
+            cstats["source"] = "compiled"
+            if tel:
+                _telemetry.inc("executor_xla_compile_total")
+                _telemetry.observe("executor_trace_lower_ms",
+                                   (t_lo - t_tr) * 1e3)
+                _telemetry.observe(
+                    "executor_xla_compile_ms",
+                    (time.perf_counter() - t_lo) * 1e3)
+            if disk_key is not None:
+                try:
+                    from jax.experimental import \
+                        serialize_executable as _se
+
+                    def roundtrips(parts):
+                        # an executable restored from jax's persistent
+                        # XLA cache (tier A) serializes WITHOUT its JIT
+                        # object code on XLA:CPU — the payload
+                        # deserializes to "Symbols not found".  Trial-
+                        # load before storing so tier B only ever holds
+                        # self-contained artifacts.
+                        try:
+                            with mkctx():
+                                _se.deserialize_and_load(*parts)
+                            return True
+                        except Exception:
+                            return False
+
+                    parts = _se.serialize(compiled)
+                    if not roundtrips(parts):
+                        _telemetry.inc(
+                            "compile_cache_roundtrip_retry_total")
+                        # jax memoizes the is_cache_used verdict the
+                        # first time any compile runs, so flipping the
+                        # flag alone is a no-op — reset_cache() forces
+                        # the re-check (and again after, so tier A
+                        # resumes for subsequent compiles)
+                        from jax._src import \
+                            compilation_cache as _jcc
+                        cfg = jax.config
+                        old = cfg.jax_enable_compilation_cache
+                        try:
+                            cfg.update("jax_enable_compilation_cache",
+                                       False)
+                            _jcc.reset_cache()
+                            # in-memory weakref memo (pxla.
+                            # _cached_compilation) would hand back the
+                            # same poisoned executable for the
+                            # identical HLO — drop it too
+                            jax.clear_caches()
+                            with mkctx():
+                                compiled = jfn.lower(*args).compile()
+                        finally:
+                            cfg.update("jax_enable_compilation_cache",
+                                       old)
+                            _jcc.reset_cache()
+                        parts = _se.serialize(compiled)
+                    if roundtrips(parts):
+                        _cc.store(disk_key, *parts, meta=meta or {})
+                    else:
+                        logging.warning(
+                            "compile_cache: %s does not serialize "
+                            "round-trippably; not stored",
+                            disk_key[:12])
+                        _telemetry.inc("compile_cache_errors_total",
+                                       kind="serialize")
+                except Exception as e:
+                    logging.warning(
+                        "compile_cache: serialize failed: %s", e)
+                    _telemetry.inc("compile_cache_errors_total",
+                                   kind="serialize")
+        except Exception as e:
+            # the lazy path compiles inside the first call — identical
+            # semantics, just conflated timing (pre-PR behavior)
+            logging.warning(
+                "executor: eager AOT compile failed (%s); falling back "
+                "to lazy jit", e)
+            _telemetry.inc("executor_aot_fallback_total")
+            compiled = None
+        cspan.annotate(source=cstats["source"]).end()
+    cstats["compile_ms"] = (time.perf_counter() - t0) * 1e3
+    return compiled, cstats
+
+
+class CarriedStepFn:
+    """AOT-compiled step function with a persistent donated carry — the
+    decode-serving analog of the Program path's bf16 param-carry: the
+    carry (the paged KV cache) lives on device across steps, every call
+    donates it back in, and the compiled executable is keyed per argument
+    signature with tier-B disk persistence (``aot_compile_cached``).
+
+    ``key_parts`` is a JSON-able description of everything that affects
+    the lowering besides the argument signature (model fingerprint, cache
+    geometry, trace flags) — it feeds ``compile_cache.raw_artifact_key``.
+    ``warmup()`` compiles eagerly for one signature (the serving
+    prewarm); a ``__call__`` on a signature never warmed compiles on the
+    spot and counts ``executor_cache_miss_total``, so "zero runtime
+    compiles under decode load" stays provable from the same counter the
+    Program path uses."""
+
+    def __init__(self, fn, donate_argnums=(0,), key_parts=None):
+        self._jfn = jax.jit(fn, donate_argnums=donate_argnums)
+        self._key_parts = key_parts
+        self._compiled = {}
+
+    @staticmethod
+    def _sig(args):
+        leaves, tree = jax.tree_util.tree_flatten(args)
+        return (str(tree),
+                tuple((tuple(x.shape), str(x.dtype))
+                      if hasattr(x, "shape") else (None, str(type(x)))
+                      for x in leaves))
+
+    def _disk_key(self, sig):
+        from . import compile_cache as _cc
+
+        if not _cc.enabled() or self._key_parts is None:
+            return None
+        try:
+            _cc.enable_xla_cache()
+            return _cc.raw_artifact_key(
+                "carried_step", {"parts": self._key_parts,
+                                 "sig": [list(map(str, s)) for s in sig[1]],
+                                 "tree": sig[0]})
+        except Exception as e:
+            logging.warning("carried_step: key derivation failed: %s", e)
+            return None
+
+    def warmup(self, *args):
+        """Eager-compile for this signature; {"source", "compile_ms",
+        "key"}.  Memory hits are free (idempotent prewarm)."""
+        sig = self._sig(args)
+        if sig in self._compiled:
+            return {"source": "memory", "compile_ms": 0.0, "key": None}
+        disk_key = self._disk_key(sig)
+        compiled, cstats = aot_compile_cached(
+            self._jfn, args, disk_key, meta={"kind": "carried_step"})
+        self._compiled[sig] = compiled if compiled is not None \
+            else self._jfn
+        if _telemetry.enabled():
+            _telemetry.inc("executor_cache_miss_total")
+        return {"source": cstats["source"],
+                "compile_ms": cstats["compile_ms"], "key": disk_key}
+
+    def __call__(self, *args):
+        sig = self._sig(args)
+        fn = self._compiled.get(sig)
+        if fn is None:
+            self.warmup(*args)
+            fn = self._compiled[sig]
+        elif _telemetry.enabled():
+            _telemetry.inc("executor_cache_hit_total")
+            _telemetry.inc("executor_steps_total")
+        return fn(*args)
 
 
 class Executor:
@@ -644,144 +856,16 @@ class Executor:
         Order: tier-B disk restore -> eager jit(...).lower(...).compile()
         (serialized back to disk) -> lazy jit fallback if either explodes.
         Returns (entry, {"source", "compile_ms"})."""
-        from . import compile_cache as _cc
-
-        def mkctx():
-            # jax.default_device is a single-use context manager
-            return (jax.default_device(dev) if dev is not None
-                    else contextlib.nullcontext())
-
         if build.out_shardings is not None:
             jfn = jax.jit(build.fn, donate_argnums=build.donate,
                           out_shardings=build.out_shardings)
         else:
             jfn = jax.jit(build.fn, donate_argnums=build.donate)
-        tel = _telemetry.enabled()
-        cstats = {"source": "fallback", "compile_ms": 0.0}
-        compiled = None
-        t0 = time.perf_counter()
-        if disk_key is not None:
-            rspan = _tracing.start_span("executor.cache_restore",
-                                        key=disk_key[:12])
-            got = _cc.load(disk_key)
-            if got is not None:
-                try:
-                    from jax.experimental import serialize_executable as _se
-
-                    with mkctx():
-                        compiled = _se.deserialize_and_load(
-                            got["payload"], got["in_tree"], got["out_tree"])
-                    cstats["source"] = "disk"
-                    if tel:
-                        _telemetry.observe(
-                            "compile_cache_load_ms",
-                            (time.perf_counter() - t0) * 1e3)
-                except Exception as e:
-                    compiled = None
-                    logging.warning(
-                        "compile_cache: deserialize of %s failed (%s); "
-                        "recompiling", disk_key[:12], e)
-                    _telemetry.inc("compile_cache_errors_total",
-                                   kind="deserialize")
-                    # crc-valid but unloadable (e.g. XLA build drift):
-                    # drop it so the store below rewrites the entry
-                    _cc.invalidate(disk_key)
-            rspan.annotate(hit=compiled is not None).end()
-        if compiled is None:
-            cspan = _tracing.start_span("executor.compile")
-            try:
-                with mkctx():
-                    t_tr = time.perf_counter()
-                    lowered = jfn.lower(feeds, params_ro, params_rw,
-                                        params_carry, rng)
-                    t_lo = time.perf_counter()
-                    compiled = lowered.compile()
-                cstats["source"] = "compiled"
-                if tel:
-                    _telemetry.inc("executor_xla_compile_total")
-                    _telemetry.observe("executor_trace_lower_ms",
-                                       (t_lo - t_tr) * 1e3)
-                    _telemetry.observe(
-                        "executor_xla_compile_ms",
-                        (time.perf_counter() - t_lo) * 1e3)
-                if disk_key is not None:
-                    try:
-                        from jax.experimental import \
-                            serialize_executable as _se
-
-                        def roundtrips(parts):
-                            # an executable restored from jax's persistent
-                            # XLA cache (tier A) serializes WITHOUT its JIT
-                            # object code on XLA:CPU — the payload
-                            # deserializes to "Symbols not found".  Trial-
-                            # load before storing so tier B only ever holds
-                            # self-contained artifacts.
-                            try:
-                                with mkctx():
-                                    _se.deserialize_and_load(*parts)
-                                return True
-                            except Exception:
-                                return False
-
-                        parts = _se.serialize(compiled)
-                        if not roundtrips(parts):
-                            _telemetry.inc(
-                                "compile_cache_roundtrip_retry_total")
-                            # jax memoizes the is_cache_used verdict the
-                            # first time any compile runs, so flipping the
-                            # flag alone is a no-op — reset_cache() forces
-                            # the re-check (and again after, so tier A
-                            # resumes for subsequent compiles)
-                            from jax._src import \
-                                compilation_cache as _jcc
-                            cfg = jax.config
-                            old = cfg.jax_enable_compilation_cache
-                            try:
-                                cfg.update("jax_enable_compilation_cache",
-                                           False)
-                                _jcc.reset_cache()
-                                # in-memory weakref memo (pxla.
-                                # _cached_compilation) would hand back the
-                                # same poisoned executable for the
-                                # identical HLO — drop it too
-                                jax.clear_caches()
-                                with mkctx():
-                                    compiled = jfn.lower(
-                                        feeds, params_ro, params_rw,
-                                        params_carry, rng).compile()
-                            finally:
-                                cfg.update("jax_enable_compilation_cache",
-                                           old)
-                                _jcc.reset_cache()
-                            parts = _se.serialize(compiled)
-                        if roundtrips(parts):
-                            _cc.store(
-                                disk_key, *parts,
-                                meta={"fetch":
-                                      list(build.plan.fetch_names),
-                                      "n_feeds": len(feeds)})
-                        else:
-                            logging.warning(
-                                "compile_cache: %s does not serialize "
-                                "round-trippably; not stored",
-                                disk_key[:12])
-                            _telemetry.inc("compile_cache_errors_total",
-                                           kind="serialize")
-                    except Exception as e:
-                        logging.warning(
-                            "compile_cache: serialize failed: %s", e)
-                        _telemetry.inc("compile_cache_errors_total",
-                                       kind="serialize")
-            except Exception as e:
-                # the lazy path compiles inside the first call — identical
-                # semantics, just conflated timing (pre-PR behavior)
-                logging.warning(
-                    "executor: eager AOT compile failed (%s); falling back "
-                    "to lazy jit", e)
-                _telemetry.inc("executor_aot_fallback_total")
-                compiled = None
-            cspan.annotate(source=cstats["source"]).end()
-        cstats["compile_ms"] = (time.perf_counter() - t0) * 1e3
+        compiled, cstats = aot_compile_cached(
+            jfn, (feeds, params_ro, params_rw, params_carry, rng),
+            disk_key, dev,
+            meta={"fetch": list(build.plan.fetch_names),
+                  "n_feeds": len(feeds)})
         entry = _CompiledPlan(
             build.plan, compiled if compiled is not None else jfn,
             build.mesh, build.data_axis, jit_fn=jfn)

@@ -688,12 +688,27 @@ def check_memory(program, rep, rank=None, budget=None, batch=1,
     est = estimate_program_hbm(program, feed_names=feed_names,
                                fetch_names=fetch_names, batch=batch,
                                mesh_axes=mesh_axes, feed_shapes=feed_shapes)
+    # engine-owned paged KV pools (serving/kv_cache.py) are allocated
+    # OUTSIDE any Program's scope but are just as resident on the chip —
+    # fold live caches into the static peak so a decode replica's MEM003
+    # budget gate sees them
+    try:
+        import sys
+
+        _kvmod = sys.modules.get("paddle_tpu.serving.kv_cache")
+        kv_bytes = int(_kvmod.engine_owned_kv_bytes()) if _kvmod else 0
+    except Exception:
+        kv_bytes = 0
+    est["kv_cache_bytes"] = kv_bytes
+    est["peak_bytes"] += kv_bytes
+    kv_note = " + kv_cache %s" % _fmt_mb(kv_bytes) if kv_bytes else ""
     rep.add(INFO, "MEM001",
             "static per-replica peak ~%s (resident %s + feeds %s + "
-            "transient %s, batch %d)"
+            "transient %s%s, batch %d)"
             % (_fmt_mb(est["peak_bytes"]), _fmt_mb(est["resident_bytes"]),
                _fmt_mb(est["feed_bytes"]),
-               _fmt_mb(est["transient_peak_bytes"]), est["batch"]),
+               _fmt_mb(est["transient_peak_bytes"]), kv_note,
+               est["batch"]),
             rank=rank)
     if est["no_donate"] and est["rw_bytes"]:
         rep.add(WARNING, "MEM002",
@@ -720,7 +735,10 @@ def check_memory(program, rep, rank=None, budget=None, batch=1,
                 rank=rank,
                 suggestion="shrink the batch, enable BENCH_REMAT=auto "
                 "recompute, or shard optimizer state "
-                "(FLAGS_collective_mode=zero1)")
+                "(FLAGS_collective_mode=zero1)"
+                + (", or shrink the paged KV pool "
+                   "(FLAGS_kv_cache_blocks / FLAGS_kv_cache_dtype=int8)"
+                   if kv_bytes else ""))
     return est
 
 

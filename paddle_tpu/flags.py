@@ -210,6 +210,32 @@ _DEFAULTS = {
     # where the fleet coordinator publishes the live endpoints JSON
     # (clients re-read it to fail over); empty = no file
     "FLAGS_serving_endpoints_file": "",
+    # -- autoregressive decode serving (serving/kv_cache.py + DecodeEngine) --
+    # decode-lane buckets: the running token batch pads to the smallest
+    # bucket that fits the live sequences; one decode-step executable is
+    # AOT-compiled per bucket at prewarm, so mixed-length traffic never
+    # triggers a runtime XLA compile
+    "FLAGS_serving_decode_buckets": "4,8",
+    # "token" = continuous batching at token granularity (sequences
+    # join/leave the running batch at every decode step); "request" =
+    # request-level static batching (the batch drains fully before new
+    # sequences join) — kept as the loadgen comparison baseline
+    "FLAGS_serving_decode_mode": "token",
+    # paged KV-cache geometry: tokens per block, and how many blocks the
+    # engine owns per model.  0 blocks = size from FLAGS_hbm_budget_bytes
+    # (kv_cache.plan_num_blocks), falling back to 64 when no budget is set.
+    "FLAGS_kv_block_size": 16,
+    "FLAGS_kv_cache_blocks": 0,
+    # KV-block residency dtype: f32 (bitwise parity with the unpaged
+    # reference) or int8 (quantize-for-the-residency, EQuARX idiom: per
+    # (block, position, head) max-abs scales; ~4x the f32 capacity per
+    # byte of HBM at a small accuracy cost)
+    "FLAGS_kv_cache_dtype": "f32",
+    # opt-in Pallas paged-attention gather kernel
+    # (pallas_kernels/paged_attention.py): scalar-prefetched block tables
+    # steer the K/V block DMA so the gathered [B, S, H, D] intermediate
+    # never materializes in HBM.  Probe-gated like every PR-9 kernel.
+    "FLAGS_use_pallas_paged_attention": False,
     # accepted no-ops (XLA/PJRT owns these concerns; benchmark's per-op
     # sync has no meaning under whole-block compilation)
     "FLAGS_benchmark": False,

@@ -36,9 +36,9 @@ import threading
 __all__ = ["decide", "active_kernels", "probe_speedup", "register_probe",
            "reset", "interpret_mode", "KERNELS", "MIN_SPEEDUP"]
 
-# the five kernel families sharing this funnel
+# the kernel families sharing this funnel
 KERNELS = ("layer_norm", "fused_ln", "conv_block", "fused_opt",
-           "embedding_bag")
+           "embedding_bag", "paged_attention")
 
 # adoption threshold: a probe row below this keeps the fallback
 MIN_SPEEDUP = 1.1

@@ -9,16 +9,26 @@ native/rpc.py with ``__metrics__`` scraping), and ``ServingFleet``
 (heartbeat/eviction membership reusing the elastic layer's liveness
 machinery, with client failover via the endpoints file).
 
+Autoregressive decode rides the same wire: ``DecodeEngine`` schedules
+token-level continuous batches over an engine-owned ``PagedKVCache``
+(serving/kv_cache.py), stepping the minimal decoder in
+serving/decode_model.py through one AOT-compiled executable per lane
+bucket; generated tokens stream back as ``__stream__`` chunks.
+
 Entry points: ``tools/serve.py`` and ``tools/loadgen.py``.
 """
 
 from .client import ServingClient, read_endpoints_file  # noqa: F401
-from .engine import InferReply, ServingEngine, parse_buckets  # noqa: F401
+from .engine import DecodeEngine, InferReply, ServingEngine, \
+    parse_buckets  # noqa: F401
 from .fleet import ServingFleet, write_endpoints_file  # noqa: F401
+from .kv_cache import BlockAllocator, KVCacheConfig, PagedKVCache, \
+    engine_owned_kv_bytes, plan_num_blocks  # noqa: F401
 from .server import ServingServer  # noqa: F401
 
 __all__ = [
-    "ServingEngine", "ServingServer", "ServingClient", "ServingFleet",
-    "InferReply", "parse_buckets", "read_endpoints_file",
-    "write_endpoints_file",
+    "ServingEngine", "DecodeEngine", "ServingServer", "ServingClient",
+    "ServingFleet", "InferReply", "parse_buckets", "read_endpoints_file",
+    "write_endpoints_file", "KVCacheConfig", "BlockAllocator",
+    "PagedKVCache", "plan_num_blocks", "engine_owned_kv_bytes",
 ]

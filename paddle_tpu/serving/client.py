@@ -11,12 +11,22 @@ fleet coordinator maintains (FLAGS_serving_endpoints_file) is re-read on
 every failure so a shrunk fleet stops receiving traffic for dead
 replicas.  A request is "dropped" only when every endpoint attempt fails
 — the loadgen asserts that count is zero through a SIGKILL.
+
+Replays trigger on ConnectionError AND on a server-side "timeout" reply
+(a replica that answered "deadline expired in queue" is overloaded, not
+authoritative — another replica may still make the SLO).  For the
+autoregressive path (``generate``/``generate_stream``), the client sends
+``__abort__:<req_id>`` to the endpoint it is abandoning before replaying
+elsewhere, so a half-prefilled sequence can't pin paged KV blocks on a
+replica that will never be asked for the answer.
 """
 
 import json
 import os
 import time
 import uuid
+
+import numpy as np
 
 from ..core import tracing as _tr
 from ..native.rpc import RpcClient
@@ -112,6 +122,7 @@ class ServingClient:
         get_timeout = deadline_ms / 1e3 + 30.0
         t0 = time.perf_counter()
         last_err = None
+        last_reply = None
         eps = self.endpoints()
         attempts = int(max_attempts or max(2 * len(eps), 2))
         for i in range(attempts):
@@ -151,14 +162,156 @@ class ServingClient:
             if srv_ms > 0.0:
                 reply.phases["wire_ms"] = round(
                     max(reply.latency_ms - srv_ms, 0.0), 3)
+            if reply.status == "timeout" and i + 1 < attempts:
+                # overloaded replica, not a verdict — replay elsewhere
+                last_err = "server timeout: %s" % reply.error
+                last_reply = reply
+                continue
             root.annotate(status=reply.status, endpoint=ep,
                           attempts=i + 1).end()
             return reply
+        if last_reply is not None:
+            root.annotate(status=last_reply.status,
+                          attempts=attempts).end()
+            return last_reply
         root.annotate(status="dropped", attempts=attempts).end()
         return InferReply(
             "dropped", error="all %d attempts failed: %s"
             % (attempts, last_err),
             latency_ms=(time.perf_counter() - t0) * 1e3)
+
+    # -- autoregressive decode -----------------------------------------------
+
+    def _abort(self, endpoint, req_id):
+        """Best-effort abandonment notice before replaying elsewhere —
+        frees the sequence's paged KV blocks on the old replica."""
+        try:
+            c = RpcClient(endpoint, connect_timeout=1.0, rpc_deadline=3.0,
+                          retry_times=0)
+            try:
+                c.send_var(codec.ABORT_KEY + req_id,
+                           codec.pack({"req_id": req_id}))
+            finally:
+                c.close()
+        except Exception:
+            pass
+
+    def generate(self, model, prompt_ids, max_new_tokens=16,
+                 deadline_ms=None, eos_id=-1, stream=True, on_token=None,
+                 max_attempts=None):
+        """One autoregressive request; returns an InferReply whose
+        outputs["tokens"] holds the generated ids.  With ``stream`` the
+        client walks per-token ``__stream__`` chunks, so the reply phases
+        gain client-observed ``client_ttft_ms`` / ``client_itl_ms_samples``
+        (wire-inclusive, what a user would feel); ``on_token(i, token)``
+        fires per chunk.  Fails over across endpoints on ConnectionError
+        and on server-side timeout replies, sending ``__abort__`` for the
+        abandoned attempt first."""
+        deadline_ms = float(deadline_ms or self.default_deadline_ms)
+        req_id = uuid.uuid4().hex
+        root = _tr.start_span("client.generate", model=model,
+                              tenant=self.tenant, req_id=req_id)
+        prompt = np.ascontiguousarray(
+            np.asarray(prompt_ids, np.int32).reshape(-1))
+        meta_req = {"model": model, "tenant": self.tenant,
+                    "req_id": req_id, "deadline_ms": deadline_ms,
+                    "max_new_tokens": int(max_new_tokens),
+                    "eos_id": int(eos_id), "stream": bool(stream)}
+        if root.traceparent:
+            meta_req[codec.TRACEPARENT] = root.traceparent
+        payload = codec.pack(meta_req, [prompt])
+        get_timeout = deadline_ms / 1e3 + 30.0
+        t0 = time.perf_counter()
+        last_err, last_reply = None, None
+        eps = self.endpoints()
+        attempts = int(max_attempts or max(2 * len(eps), 2))
+        for i in range(attempts):
+            if i:
+                self.failovers += 1
+                time.sleep(min(0.05 * i, 0.5))
+                eps = self.endpoints()
+            if not eps:
+                last_err = "endpoints file empty"
+                continue
+            ep = eps[self._rr % len(eps)]
+            self._rr += 1
+            chunk_times = []
+            try:
+                c = RpcClient(ep, connect_timeout=2.0,
+                              rpc_deadline=get_timeout, retry_times=0)
+                try:
+                    with _tr.activate(root):
+                        c.send_var(codec.GEN_KEY + req_id, payload)
+                        if stream:
+                            k = 0
+                            while True:
+                                cm, _ = codec.unpack(c.get_var(
+                                    "%s%s:%d" % (codec.STREAM_KEY,
+                                                 req_id, k)))
+                                if cm.get("token") is not None:
+                                    chunk_times.append(
+                                        time.perf_counter())
+                                    if on_token is not None:
+                                        on_token(int(cm["i"]),
+                                                 int(cm["token"]))
+                                if cm.get("done"):
+                                    break
+                                k += 1
+                        meta, arrays = codec.unpack(
+                            c.get_var(codec.REPLY_KEY + req_id))
+                finally:
+                    c.close()
+            except ConnectionError as e:
+                last_err = str(e)
+                self._abort(ep, req_id)  # free the abandoned prefill
+                continue
+            reply = InferReply(
+                meta.get("status", "error"),
+                outputs=dict(zip(meta.get("outputs", []), arrays)),
+                error=meta.get("error"),
+                retry_after_ms=meta.get("retry_after_ms", 0.0),
+                phases=dict(meta.get("phases") or {}))
+            reply.latency_ms = (time.perf_counter() - t0) * 1e3
+            srv_ms = float(meta.get("latency_ms") or 0.0)
+            if srv_ms > 0.0:
+                reply.phases["wire_ms"] = round(
+                    max(reply.latency_ms - srv_ms, 0.0), 3)
+            if chunk_times:
+                reply.phases["client_ttft_ms"] = round(
+                    (chunk_times[0] - t0) * 1e3, 3)
+                reply.phases["client_itl_ms_samples"] = [
+                    round((b - a) * 1e3, 3) for a, b in
+                    zip(chunk_times, chunk_times[1:])]
+            if reply.status == "timeout" and i + 1 < attempts:
+                last_err = "server timeout: %s" % reply.error
+                last_reply = reply
+                self._abort(ep, req_id)
+                continue
+            root.annotate(status=reply.status, endpoint=ep,
+                          attempts=i + 1,
+                          tokens=len(reply.outputs.get("tokens", ()))
+                          ).end()
+            return reply
+        if last_reply is not None:
+            root.annotate(status=last_reply.status,
+                          attempts=attempts).end()
+            return last_reply
+        root.annotate(status="dropped", attempts=attempts).end()
+        return InferReply(
+            "dropped", error="all %d attempts failed: %s"
+            % (attempts, last_err),
+            latency_ms=(time.perf_counter() - t0) * 1e3)
+
+    def generate_stream(self, model, prompt_ids, **kw):
+        """Generator over (index, token) yielded as chunks arrive; the
+        final InferReply is returned via StopIteration.value."""
+        got = []
+        kw["stream"] = True
+        kw["on_token"] = lambda i, t: got.append((i, t))
+        reply = self.generate(model, prompt_ids, **kw)
+        for item in got:
+            yield item
+        return reply
 
     def alive(self, endpoint, timeout=3.0):
         """[rank, epoch, is_coordinator] or None (rpc.probe contract)."""

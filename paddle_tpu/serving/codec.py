@@ -19,6 +19,16 @@ elastic ``__alive__``):
                            retry_after_ms on shed, outputs name order
   ``__spec__:<model>``     server-published feed/fetch signature + buckets
                            (loadgen synthesizes valid feeds from it)
+  ``__generate__:<id>``    autoregressive request: prompt ids array +
+                           meta model / max_new_tokens / stream
+  ``__stream__:<id>:<k>``  k-th generated-token chunk (meta token / i /
+                           done / status); the client's parked GETs walk
+                           k = 0, 1, ... until done — token-level TTFT
+                           and inter-token latency fall out client-side
+  ``__abort__:<id>``       client gave up (timeout replay): the decode
+                           engine drops the sequence and frees its paged
+                           KV blocks so an abandoned prefill can't pin
+                           the pool
 
 Distributed tracing (core/tracing.py) rides the meta under the
 ``TRACEPARENT`` key: the client stamps its root span's W3C-style
@@ -32,12 +42,18 @@ import json
 import numpy as np
 
 __all__ = ["pack", "unpack", "INFER_KEY", "REPLY_KEY", "SPEC_KEY",
-           "ALIVE_KEY", "TRACEPARENT"]
+           "ALIVE_KEY", "GEN_KEY", "STREAM_KEY", "ABORT_KEY",
+           "TRACEPARENT"]
 
 INFER_KEY = "__infer__:"
 REPLY_KEY = "__reply__:"
 SPEC_KEY = "__spec__:"
 ALIVE_KEY = "__alive__"
+# autoregressive decode: request, per-token stream chunks (suffixed
+# ":<index>"), and client-side abandonment (frees the paged KV blocks)
+GEN_KEY = "__generate__:"
+STREAM_KEY = "__stream__:"
+ABORT_KEY = "__abort__:"
 # meta key carrying the W3C-style trace context across the wire
 TRACEPARENT = "traceparent"
 

@@ -1,0 +1,264 @@
+"""Tiny pure-JAX transformer decoder for the autoregressive serving path.
+
+The encoder serving stack (PR 10) runs Program-built models through
+``AnalysisPredictor``; autoregressive decode instead needs a *step
+function over a donated KV carry* — one token in, one token out, cache
+updated in place on device.  Threading per-token cache scatters through
+the Program op set would rebuild half an interpreter for no modeling
+win, so the decode scenario carries its own minimal decoder (pre-LN
+transformer: embed + learned positions, per-layer MHA + GELU MLP, tied
+vocab head kept separate for clarity) and plugs into the SAME executor
+machinery the Program path uses: ``core.executor.CarriedStepFn`` AOT-
+compiles the step per lane bucket with tier-B disk persistence, and the
+attention gather runs through the probe-gated
+``pallas_kernels.paged_attention`` funnel.
+
+Two step builders share every layer of math through one ``attend``
+callback:
+
+* ``make_paged_step``   — writes this token's K/V into the paged cache
+  (block ids steered by the per-lane block table) and attends through
+  ``paged_attention`` over the block pool.
+* ``make_unpaged_step`` — the reference: contiguous per-lane K/V
+  ``[L, B, S, H, D]`` updated at ``pos`` and attended via the same
+  ``masked_attention`` core.
+
+Because both paths feed bitwise-identical K/V values into the identical
+attention/MLP expressions at identical shapes, paged decode is
+bitwise-equal to the unpaged loop on the CPU tier — the acceptance bar
+``unpaged_generate`` exists to prove.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..pallas_kernels.paged_attention import masked_attention, \
+    paged_attention
+from . import kv_cache as _kv
+
+__all__ = ["DecoderConfig", "init_decoder_params", "save_decoder",
+           "load_decoder", "is_decoder_dir", "make_paged_step",
+           "make_unpaged_step", "unpaged_generate"]
+
+
+class DecoderConfig:
+    __slots__ = ("vocab", "layers", "heads", "head_dim", "ffn", "max_seq")
+
+    def __init__(self, vocab, layers, heads, head_dim, ffn=None,
+                 max_seq=64):
+        self.vocab = int(vocab)
+        self.layers = int(layers)
+        self.heads = int(heads)
+        self.head_dim = int(head_dim)
+        self.ffn = int(ffn if ffn is not None else 4 * heads * head_dim)
+        self.max_seq = int(max_seq)
+
+    @property
+    def hidden(self):
+        return self.heads * self.head_dim
+
+    def to_dict(self):
+        return {s: getattr(self, s) for s in self.__slots__}
+
+
+def init_decoder_params(cfg, seed=0):
+    """name -> np.float32 array; 0.02-normal weights, identity LN."""
+    r = np.random.RandomState(seed)
+    h, f, v = cfg.hidden, cfg.ffn, cfg.vocab
+
+    def w(*shape):
+        return (r.standard_normal(shape) * 0.02).astype(np.float32)
+
+    p = {"embed": w(v, h), "pos_embed": w(cfg.max_seq, h),
+         "lnf_g": np.ones(h, np.float32), "lnf_b": np.zeros(h, np.float32),
+         "head": w(h, v)}
+    for l in range(cfg.layers):
+        p.update({
+            "l%d_ln1_g" % l: np.ones(h, np.float32),
+            "l%d_ln1_b" % l: np.zeros(h, np.float32),
+            "l%d_wq" % l: w(h, h), "l%d_wk" % l: w(h, h),
+            "l%d_wv" % l: w(h, h), "l%d_wo" % l: w(h, h),
+            "l%d_ln2_g" % l: np.ones(h, np.float32),
+            "l%d_ln2_b" % l: np.zeros(h, np.float32),
+            "l%d_w1" % l: w(h, f), "l%d_b1" % l: np.zeros(f, np.float32),
+            "l%d_w2" % l: w(f, h), "l%d_b2" % l: np.zeros(h, np.float32),
+        })
+    return p
+
+
+def save_decoder(dirname, cfg, params):
+    """params.npz + decoder.json under `dirname` (tools/serve.py loads
+    decode models from such a dir)."""
+    os.makedirs(dirname, exist_ok=True)
+    np.savez(os.path.join(dirname, "params.npz"), **params)
+    with open(os.path.join(dirname, "decoder.json"), "w") as fp:
+        json.dump(cfg.to_dict(), fp, indent=1, sort_keys=True)
+    return dirname
+
+
+def load_decoder(dirname):
+    with open(os.path.join(dirname, "decoder.json")) as fp:
+        cfg = DecoderConfig(**json.load(fp))
+    with np.load(os.path.join(dirname, "params.npz")) as z:
+        params = {k: z[k] for k in z.files}
+    return cfg, params
+
+
+def is_decoder_dir(dirname):
+    return os.path.exists(os.path.join(dirname, "decoder.json"))
+
+
+# -- shared forward ----------------------------------------------------------
+
+def _ln(x, g, b):
+    m = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - m), axis=-1, keepdims=True)
+    return (x - m) * jax.lax.rsqrt(var + 1e-5) * g + b
+
+
+def _token_logits(params, cfg, tok, pos, attend):
+    """One token per lane through every layer; ``attend(l, q, k, v)``
+    owns the KV write + history attention (the only paged/unpaged
+    difference)."""
+    bb = tok.shape[0]
+    x = jnp.take(params["embed"], tok, axis=0) \
+        + jnp.take(params["pos_embed"], pos, axis=0)
+    for l in range(cfg.layers):
+        def p(n, _l=l):
+            return params["l%d_%s" % (_l, n)]
+
+        h = _ln(x, p("ln1_g"), p("ln1_b"))
+        q = (h @ p("wq")).reshape(bb, cfg.heads, cfg.head_dim)
+        k = (h @ p("wk")).reshape(bb, cfg.heads, cfg.head_dim)
+        v = (h @ p("wv")).reshape(bb, cfg.heads, cfg.head_dim)
+        a = attend(l, q, k, v).reshape(bb, cfg.hidden)
+        x = x + a @ p("wo")
+        h2 = _ln(x, p("ln2_g"), p("ln2_b"))
+        x = x + jax.nn.gelu(h2 @ p("w1") + p("b1")) @ p("w2") + p("b2")
+    x = _ln(x, params["lnf_g"], params["lnf_b"])
+    return x @ params["head"]
+
+
+# -- paged step --------------------------------------------------------------
+
+def make_paged_step(cfg, kv_config):
+    """-> step(kv_carry, params, tok, pos, block_tables, context_lens)
+    returning (new_kv_carry, next_tokens, logits).
+
+    All shapes are static per lane bucket: tok/pos/context_lens [B],
+    block_tables [B, MAXB].  ``context_lens[b]`` counts the tokens valid
+    AFTER this step's write (pos + 1 for live lanes, 0 for idle lanes,
+    whose table points at the reserved scratch block 0)."""
+    bs = kv_config.block_size
+    int8 = kv_config.dtype == "int8"
+
+    def step(kv_carry, params, tok, pos, block_tables, context_lens):
+        tok = tok.astype(jnp.int32)
+        pos = pos.astype(jnp.int32)
+        block_tables = block_tables.astype(jnp.int32)
+        context_lens = context_lens.astype(jnp.int32)
+        blk_ids = jnp.take_along_axis(
+            jnp.maximum(block_tables, 0), (pos // bs)[:, None], axis=1)[:, 0]
+        offs = pos % bs
+        if int8:
+            k_c, v_c, k_s, v_s = kv_carry
+        else:
+            k_c, v_c = kv_carry
+
+        def attend(l, q, k, v):
+            nonlocal k_c, v_c
+            if not int8:
+                k_c = k_c.at[l, blk_ids, offs].set(k)
+                v_c = v_c.at[l, blk_ids, offs].set(v)
+                return paged_attention(q, k_c[l], v_c[l], block_tables,
+                                       context_lens)
+            nonlocal k_s, v_s
+            qk, sk = _kv.quantize_kv(k)
+            qv, sv = _kv.quantize_kv(v)
+            k_c = k_c.at[l, blk_ids, offs].set(qk)
+            v_c = v_c.at[l, blk_ids, offs].set(qv)
+            k_s = k_s.at[l, blk_ids, offs].set(sk)
+            v_s = v_s.at[l, blk_ids, offs].set(sv)
+            idx = jnp.maximum(block_tables, 0)
+            bb, maxb = block_tables.shape
+            kk = _kv.dequantize_kv(jnp.take(k_c[l], idx, axis=0),
+                                   jnp.take(k_s[l], idx, axis=0))
+            vv = _kv.dequantize_kv(jnp.take(v_c[l], idx, axis=0),
+                                   jnp.take(v_s[l], idx, axis=0))
+            kk = kk.reshape(bb, maxb * bs, cfg.heads, cfg.head_dim)
+            vv = vv.reshape(bb, maxb * bs, cfg.heads, cfg.head_dim)
+            return masked_attention(q, kk, vv, context_lens)
+
+        logits = _token_logits(params, cfg, tok, pos, attend)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        carry = (k_c, v_c, k_s, v_s) if int8 else (k_c, v_c)
+        return carry, nxt, logits
+
+    return step
+
+
+# -- unpaged reference -------------------------------------------------------
+
+def make_unpaged_step(cfg, pad_len):
+    """Reference step over contiguous per-lane K/V [L, B, pad_len, H, D].
+    Same ``masked_attention`` core at the same [B, pad_len, H, D] shapes
+    as the paged gather path — the bitwise comparison target."""
+
+    def step(kv_carry, params, tok, pos, context_lens):
+        tok = tok.astype(jnp.int32)
+        pos = pos.astype(jnp.int32)
+        context_lens = context_lens.astype(jnp.int32)
+        k_c, v_c = kv_carry
+        lanes = jnp.arange(k_c.shape[1], dtype=jnp.int32)
+
+        def attend(l, q, k, v):
+            nonlocal k_c, v_c
+            k_c = k_c.at[l, lanes, pos].set(k)
+            v_c = v_c.at[l, lanes, pos].set(v)
+            return masked_attention(q, k_c[l], v_c[l], context_lens)
+
+        logits = _token_logits(params, cfg, tok, pos, attend)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (k_c, v_c), nxt, logits
+
+    return step
+
+
+def unpaged_generate(cfg, params, prompt_ids, max_new, pad_len=None,
+                     eos_id=-1, return_logits=False):
+    """Greedy single-sequence reference loop (no paging, no batching):
+    feed the prompt one token per step, then decode ``max_new`` tokens.
+    ``pad_len`` must match the paged path's gathered history length
+    (MAXB * block_size) for the bitwise comparison."""
+    if pad_len is None:
+        pad_len = cfg.max_seq
+    step = jax.jit(make_unpaged_step(cfg, pad_len), donate_argnums=(0,))
+    jparams = {k: jnp.asarray(v) for k, v in params.items()}
+    kv = (jnp.zeros((cfg.layers, 1, pad_len, cfg.heads, cfg.head_dim),
+                    jnp.float32),
+          jnp.zeros((cfg.layers, 1, pad_len, cfg.heads, cfg.head_dim),
+                    jnp.float32))
+    prompt_ids = [int(t) for t in prompt_ids]
+    out, logits_hist = [], []
+    tok = prompt_ids[0]
+    pos = 0
+    while len(out) < max_new:
+        kv, nxt, logits = step(
+            kv, jparams, jnp.asarray([tok], jnp.int32),
+            jnp.asarray([pos], jnp.int32),
+            jnp.asarray([pos + 1], jnp.int32))
+        pos += 1
+        if pos < len(prompt_ids):
+            tok = prompt_ids[pos]          # still feeding the prompt
+            continue
+        tok = int(nxt[0])
+        out.append(tok)
+        if return_logits:
+            logits_hist.append(np.asarray(logits[0]))
+        if tok == eos_id:
+            break
+    return (out, logits_hist) if return_logits else out

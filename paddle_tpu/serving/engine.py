@@ -39,7 +39,7 @@ from ..core import telemetry as _tm
 from ..core import tracing as _tr
 from ..core.executor import scope_guard
 
-__all__ = ["ServingEngine", "InferReply", "parse_buckets"]
+__all__ = ["ServingEngine", "DecodeEngine", "InferReply", "parse_buckets"]
 
 _QPS_WINDOW_S = 5.0
 
@@ -521,3 +521,594 @@ class ServingEngine:
         while self._done_times and self._done_times[0] < cut:
             self._done_times.pop(0)
         _tm.set_gauge("serving_qps", len(self._done_times) / _QPS_WINDOW_S)
+
+
+# ===========================================================================
+# Autoregressive decode serving: paged KV-cache + token-level batching
+# ===========================================================================
+
+class _DecodeSeq:
+    """One autoregressive sequence moving through the decode scheduler.
+
+    Prefill is token-feed: the prompt is fed one token per step through
+    the SAME bucketed step executable as generation, so mixed-phase
+    batches never force a second compiled shape.  ``n_fed`` counts
+    positions already written to the KV cache; once it passes the last
+    prompt position every step's argmax is a generated token."""
+
+    __slots__ = ("pending", "prompt", "max_new", "eos_id", "on_token",
+                 "blocks", "table", "n_fed", "next_tok", "out",
+                 "t_admit", "t_first", "token_times", "admit_seq",
+                 "aborted")
+
+    def __init__(self, pending, prompt, max_new, eos_id, on_token, maxb):
+        self.pending = pending
+        self.prompt = [int(t) for t in prompt]
+        self.max_new = int(max_new)
+        self.eos_id = int(eos_id)
+        self.on_token = on_token
+        self.blocks = []                      # allocator block ids held
+        self.table = np.full(maxb, -1, np.int32)
+        self.n_fed = 0
+        self.next_tok = self.prompt[0]
+        self.out = []
+        self.t_admit = None
+        self.t_first = None                   # first *generated* token
+        self.token_times = []                 # perf_counter per token
+        self.admit_seq = 0                    # preemption picks max()
+        self.aborted = False
+
+    @property
+    def in_prefill(self):
+        return self.n_fed < len(self.prompt)
+
+    def reset_for_recompute(self):
+        """Preempted: blocks were freed; replay the prompt from scratch.
+        Greedy decode is deterministic, so re-emitted tokens are
+        identical and stream chunks republish byte-for-byte."""
+        self.blocks = []
+        self.table.fill(-1)
+        self.n_fed = 0
+        self.next_tok = self.prompt[0]
+        self.out = []
+        self.t_first = None
+        self.token_times = []
+
+
+class _DecodeModel:
+    __slots__ = ("name", "cfg", "params", "kv_config", "cache", "stepfn",
+                 "maxb", "step_ms")
+
+    def __init__(self, name, cfg, params, kv_config, cache, stepfn):
+        self.name = name
+        self.cfg = cfg
+        self.params = params        # jnp arrays (device-resident)
+        self.kv_config = kv_config
+        self.cache = cache
+        self.stepfn = stepfn        # CarriedStepFn over make_paged_step
+        self.maxb = -(-cfg.max_seq // kv_config.block_size)
+        self.step_ms = 0.0          # EWMA of one decode step
+
+
+class DecodeEngine:
+    """Token-level continuous batching over an engine-owned paged
+    KV-cache.
+
+    Every iteration of the decode loop:
+
+    1. expires deadline-passed sequences, then admits waiting sequences
+       into free lanes while the allocator can cover their prompts (in
+       ``request`` mode admission only happens when no lane is active —
+       the comparison baseline for the token-level win);
+    2. picks the smallest configured lane bucket >= active count and
+       rebuilds tok/pos/block_tables/context_lens arrays for it — idle
+       lanes point at the reserved scratch block with context_len 0;
+    3. runs ONE AOT-compiled step (``CarriedStepFn``; the paged KV carry
+       is donated and swapped back into the cache), so mixed-length
+       sequences never trigger a runtime compile;
+    4. appends each live lane's sampled token, finishing sequences at
+       max_new/EOS and freeing their blocks in the SAME iteration so the
+       next step's admission sees the space.
+
+    Mid-decode allocation failure preempts the youngest active sequence
+    (blocks freed, sequence re-queued for deterministic recompute) —
+    counted as ``kv_block_evictions_total``.  Admission-time shortage
+    sheds with ``retry_after_ms`` derived from the EWMA step time."""
+
+    def __init__(self, buckets=None, max_queue=None, deadline_ms=None,
+                 mode=None):
+        self.buckets = parse_buckets(
+            buckets if buckets is not None
+            else _flag("serving_decode_buckets"))
+        self.max_queue = int(max_queue if max_queue is not None
+                             else _flag("serving_max_queue"))
+        self.default_deadline_ms = float(
+            deadline_ms if deadline_ms is not None
+            else _flag("serving_deadline_ms"))
+        mode = mode if mode is not None else _flag("serving_decode_mode")
+        if mode not in ("token", "request"):
+            raise ValueError("serving_decode_mode must be token|request, "
+                             "got %r" % (mode,))
+        self.mode = mode
+        self._models = {}
+        self._waiting = []          # FIFO of _DecodeSeq
+        self._active = []
+        self._cond = threading.Condition()
+        self._running = False
+        self._thread = None
+        self._admit_seq = 0
+        self._step_no = 0
+        self.in_batch = False
+        self.on_batch_boundary = None
+
+    # -- registry ------------------------------------------------------------
+
+    def add_model(self, name, source, kv_blocks=None):
+        """Register a decode model: `source` is a save_decoder() dir or a
+        (DecoderConfig, params) pair.  KV pool size comes from
+        kv_blocks / FLAGS_kv_cache_blocks, capped by
+        FLAGS_hbm_budget_bytes net of the weights' footprint."""
+        import jax.numpy as jnp
+
+        from . import decode_model as _dm
+        from . import kv_cache as _kvc
+        from ..core.executor import CarriedStepFn
+
+        if isinstance(source, str):
+            cfg, params = _dm.load_decoder(source)
+        else:
+            cfg, params = source
+        resident = sum(int(np.asarray(v).nbytes) for v in params.values())
+        kv_config = _kvc.KVCacheConfig(
+            layers=cfg.layers, heads=cfg.heads, head_dim=cfg.head_dim,
+            block_size=int(_flag("kv_block_size")),
+            num_blocks=2,  # placeholder; plan_num_blocks decides below
+            dtype=str(_flag("kv_cache_dtype")))
+        n, capped = _kvc.plan_num_blocks(kv_config,
+                                         model_resident_bytes=resident,
+                                         requested=kv_blocks)
+        kv_config.num_blocks = n
+        cache = _kvc.PagedKVCache(kv_config)
+        jparams = {k: jnp.asarray(v) for k, v in params.items()}
+        stepfn = CarriedStepFn(
+            _dm.make_paged_step(cfg, kv_config), donate_argnums=(0,),
+            key_parts={"kind": "decode_step", "model": name,
+                       "cfg": cfg.to_dict(),
+                       "kv": {"block_size": kv_config.block_size,
+                              "num_blocks": kv_config.num_blocks,
+                              "dtype": kv_config.dtype},
+                       "pallas": bool(_flag("use_pallas_paged_attention"))})
+        self._models[name] = _DecodeModel(name, cfg, jparams, kv_config,
+                                          cache, stepfn)
+        _tm.event("decode_model_added", model=name, blocks=n,
+                  budget_capped=capped, kv_bytes=cache.nbytes)
+        return self._models[name]
+
+    def models(self):
+        return list(self._models)
+
+    def spec(self, model):
+        m = self._models[model]
+        return {"model": model, "type": "decode",
+                "vocab": m.cfg.vocab, "max_seq": m.cfg.max_seq,
+                "buckets": list(self.buckets), "mode": self.mode,
+                "block_size": m.kv_config.block_size,
+                "num_blocks": m.kv_config.num_blocks,
+                "kv_dtype": m.kv_config.dtype}
+
+    # -- AOT bucket prewarm --------------------------------------------------
+
+    def prewarm(self):
+        """Compile (or restore from the tier-B disk cache) the decode
+        step for EVERY lane bucket before the first request.  After
+        this, mixed-length continuous batching can only hit the
+        in-memory executables: ``executor_cache_miss_total`` stays flat
+        under load — the zero-runtime-compile proof."""
+        manifest = {}
+        for name, m in self._models.items():
+            per = {}
+            for b in self.buckets:
+                got = m.stepfn.warmup(*self._step_args(
+                    m, b, np.zeros(b, np.int32), np.zeros(b, np.int32),
+                    np.full((b, m.maxb), -1, np.int32),
+                    np.zeros(b, np.int32)))
+                per[b] = {"source": got["source"],
+                          "compile_ms": round(got["compile_ms"], 3)}
+                _tm.inc("serving_prewarm_total", model=name,
+                        source=got["source"])
+                _tm.event("serving_prewarm", model=name, bucket=b,
+                          source=got["source"], decode=True,
+                          ms=round(got["compile_ms"], 3))
+            manifest[name] = per
+        return manifest
+
+    def _step_args(self, m, bucket, tok, pos, tables, lens):
+        return (m.cache.carry(), m.params, tok, pos, tables, lens)
+
+    # -- admission -----------------------------------------------------------
+
+    def _retry_after_ms(self, m):
+        """Time for roughly one block's worth of tokens to drain."""
+        per = m.step_ms if m.step_ms > 0 else 1.0
+        return max(per * m.kv_config.block_size, 1.0)
+
+    def submit(self, model, prompt_ids, max_new_tokens=16, tenant="default",
+               deadline_ms=None, eos_id=-1, callback=None, on_token=None,
+               req_id=None, traceparent=None):
+        """Enqueue one autoregressive request; returns a _Pending whose
+        reply carries outputs={"tokens"} plus TTFT/ITL phases.
+        ``on_token(req_id, index, token, done, status)`` fires per
+        generated token (the server publishes stream chunks from it);
+        the terminal call carries token=None on non-ok completion."""
+        deadline_ms = float(deadline_ms or self.default_deadline_ms)
+        prompt_ids = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
+        req = _Pending(model, tenant, None, len(prompt_ids), deadline_ms,
+                       req_id or uuid.uuid4().hex, callback,
+                       traceparent=traceparent)
+
+        def _early(reply):
+            """Terminal before admission: also emit the done stream chunk
+            so a streaming client unblocks instead of hanging on k=0."""
+            req.complete(reply)
+            if on_token is not None:
+                try:
+                    on_token(req.req_id, 0, None, True, reply.status)
+                except Exception:
+                    pass
+            return req
+
+        m = self._models.get(model)
+        if m is None or not self._running:
+            return _early(InferReply(
+                "error", error="unknown decode model %r" % model
+                if m is None else "decode engine not running"))
+        if not prompt_ids:
+            return _early(InferReply("error", error="empty prompt"))
+        total = len(prompt_ids) + int(max_new_tokens)
+        if total > m.cfg.max_seq:
+            return _early(InferReply(
+                "error",
+                error="prompt+max_new %d exceeds max_seq %d"
+                      % (total, m.cfg.max_seq)))
+        if any(t < 0 or t >= m.cfg.vocab for t in prompt_ids):
+            return _early(InferReply("error", error="token out of vocab"))
+        need_cap = m.cache.blocks_for_tokens(total)
+        if need_cap > m.cache.allocator.capacity:
+            return _early(InferReply(
+                "error",
+                error="sequence needs %d KV blocks, pool holds %d"
+                      % (need_cap, m.cache.allocator.capacity)))
+        _tm.inc("serving_decode_requests_total", model=model, tenant=tenant)
+        seq = _DecodeSeq(req, prompt_ids, max_new_tokens, eos_id, on_token,
+                         m.maxb)
+        with self._cond:
+            if len(self._waiting) >= self.max_queue:
+                _tm.inc("serving_shed_total", reason="queue_full")
+                return _early(InferReply(
+                    "shed", error="queue full (%d)" % len(self._waiting),
+                    retry_after_ms=self._retry_after_ms(m)))
+            # admission-time KV pressure: blocks already promised to the
+            # queue ahead plus this prompt must fit the free pool, else
+            # shed with a drain-time hint instead of queueing behind an
+            # out-of-memory head-of-line
+            promised = sum(
+                m.cache.blocks_for_tokens(len(s.prompt))
+                for s in self._waiting if s.pending.model == model)
+            if promised + m.cache.blocks_for_tokens(len(prompt_ids)) \
+                    > m.cache.allocator.num_free:
+                _tm.inc("serving_shed_total", reason="kv_oom")
+                return _early(InferReply(
+                    "shed",
+                    error="KV pool exhausted (%d free blocks)"
+                          % m.cache.allocator.num_free,
+                    retry_after_ms=self._retry_after_ms(m)))
+            req.span = _tr.start_span(
+                "serving.request", model=model, tenant=tenant,
+                decode=True, prompt_tokens=len(prompt_ids),
+                max_new=int(max_new_tokens), req_id=req.req_id)
+            req.qspan = _tr.start_span("serving.queue_wait",
+                                       parent=req.span,
+                                       depth=len(self._waiting))
+            self._waiting.append(seq)
+            _tm.set_gauge("serving_queue_depth",
+                          len(self._waiting))
+            self._cond.notify_all()
+        return req
+
+    def generate(self, model, prompt_ids, max_new_tokens=16, **kw):
+        """Synchronous submit + wait."""
+        deadline_ms = float(kw.get("deadline_ms")
+                            or self.default_deadline_ms)
+        req = self.submit(model, prompt_ids,
+                          max_new_tokens=max_new_tokens, **kw)
+        reply = req.wait(timeout=deadline_ms / 1e3 + 30.0)
+        return reply if reply is not None else InferReply(
+            "timeout", error="no reply within deadline")
+
+    def abort(self, req_id):
+        """Drop a sequence by request id (client replay after a timeout
+        sends this so an abandoned prefill frees its blocks).  Returns
+        True when a waiting/active sequence was found."""
+        with self._cond:
+            for i, s in enumerate(self._waiting):
+                if s.pending.req_id == req_id:
+                    self._waiting.pop(i)
+                    _tm.set_gauge("serving_queue_depth", len(self._waiting))
+                    self._finish(s, InferReply("aborted",
+                                               error="aborted by client"))
+                    _tm.inc("serving_abort_total", phase="queued")
+                    return True
+            for s in self._active:
+                if s.pending.req_id == req_id and not s.aborted:
+                    s.aborted = True   # decode loop frees at next boundary
+                    _tm.inc("serving_abort_total",
+                            phase="prefill" if s.in_prefill else "decode")
+                    return True
+        return False
+
+    # -- decode loop ---------------------------------------------------------
+
+    def start(self):
+        if self._running:
+            return self
+        self._running = True
+        self._thread = threading.Thread(target=self._decode_loop,
+                                        name="serving-decode", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, drain_s=5.0):
+        with self._cond:
+            self._running = False
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(drain_s)
+            self._thread = None
+        with self._cond:
+            leftovers = self._active + self._waiting
+            self._active, self._waiting = [], []
+        for s in leftovers:
+            self._free_blocks(s)
+            self._finish(s, InferReply("error", error="engine stopped"))
+
+    def _model_of(self, seq):
+        return self._models[seq.pending.model]
+
+    def _free_blocks(self, seq):
+        if seq.blocks:
+            self._model_of(seq).cache.allocator.free(seq.blocks)
+            seq.blocks = []
+            seq.table.fill(-1)
+
+    def _finish(self, seq, reply):
+        r = seq.pending
+        if reply.ok or reply.status == "timeout":
+            now = time.perf_counter()
+            phases = {"queue_wait_ms": round(
+                ((seq.t_admit or now) - r.t_submit) * 1e3, 3),
+                "tokens": len(seq.out),
+                "prompt_tokens": len(seq.prompt)}
+            if seq.t_first is not None:
+                phases["ttft_ms"] = round(
+                    (seq.t_first - r.t_submit) * 1e3, 3)
+            if len(seq.token_times) > 1:
+                gaps = [(b - a) * 1e3 for a, b in
+                        zip(seq.token_times, seq.token_times[1:])]
+                phases["itl_ms_samples"] = [round(g, 3) for g in gaps]
+            reply.phases = phases
+        out_tokens = np.asarray(seq.out, np.int32)
+        if reply.ok:
+            reply.outputs = {"tokens": out_tokens}
+        r.complete(reply)
+        if r.qspan is not None:
+            r.qspan.end()
+            r.qspan = None
+        if r.span is not None:
+            r.span.annotate(status=reply.status,
+                            tokens=len(seq.out)).end()
+            r.span = None
+        if seq.on_token is not None and not reply.ok:
+            # terminal stream chunk so a streaming client unblocks even
+            # on shed/timeout/abort/error
+            try:
+                seq.on_token(r.req_id, len(seq.out), None, True,
+                             reply.status)
+            except Exception:
+                pass
+
+    def _expire_and_admit(self):
+        """Under the lock: time out stale waiters, then admit while
+        lanes + blocks allow.  Returns the per-model active map."""
+        now = time.perf_counter()
+        keep = []
+        for s in self._waiting:
+            if now > s.pending.deadline:
+                _tm.inc("serving_timeout_total", model=s.pending.model)
+                self._finish(s, InferReply(
+                    "timeout", error="deadline expired in queue"))
+            else:
+                keep.append(s)
+        self._waiting[:] = keep
+        max_lanes = max(self.buckets)
+        while self._waiting and len(self._active) < max_lanes:
+            if self.mode == "request" and self._active:
+                break  # request-level baseline: no mid-flight joins
+            s = self._waiting[0]
+            m = self._model_of(s)
+            if self._active and self._active[0].pending.model != \
+                    s.pending.model:
+                break  # one model per step batch
+            if m.cache.blocks_for_tokens(len(s.prompt)) > \
+                    m.cache.allocator.num_free:
+                break  # head-of-line waits for blocks to free
+            self._waiting.pop(0)
+            self._admit_seq += 1
+            s.admit_seq = self._admit_seq
+            s.t_admit = now
+            if s.pending.qspan is not None:
+                s.pending.qspan.end()
+                s.pending.qspan = None
+            self._active.append(s)
+        _tm.set_gauge("serving_queue_depth", len(self._waiting))
+
+    def _ensure_block(self, seq):
+        """Make sure the block for seq's next write position exists;
+        preempt the youngest OTHER active sequence on pool exhaustion.
+        Returns False when seq itself got preempted is impossible here —
+        False means seq must skip this step (should not happen)."""
+        m = self._model_of(seq)
+        slot = seq.n_fed // m.kv_config.block_size
+        while seq.table[slot] < 0:
+            got = m.cache.allocator.alloc(1)
+            if got is not None:
+                seq.blocks.extend(got)
+                seq.table[slot] = got[0]
+                break
+            victims = [s for s in self._active if s is not seq]
+            if not victims:
+                # submit() capped total need at pool capacity, so a lone
+                # sequence can always allocate; defensive completion
+                self._active.remove(seq)
+                self._free_blocks(seq)
+                self._finish(seq, InferReply(
+                    "error", error="KV pool exhausted with no victim"))
+                return False
+            v = max(victims, key=lambda s: s.admit_seq)
+            self._active.remove(v)
+            self._free_blocks(v)
+            v.reset_for_recompute()
+            self._waiting.insert(0, v)
+            _tm.inc("kv_block_evictions_total",
+                    model=v.pending.model)
+            _tm.event("decode_preempt", victim=v.pending.req_id,
+                      for_req=seq.pending.req_id)
+        return True
+
+    def _bucket_for(self, lanes):
+        for b in self.buckets:
+            if lanes <= b:
+                return b
+        return max(self.buckets)
+
+    def _decode_loop(self):
+        while True:
+            with self._cond:
+                if not self._running:
+                    return
+                self._expire_and_admit()
+                if not self._active:
+                    self._cond.wait(0.05)
+                    continue
+                step_ok = self._decode_step_locked()
+            if self.on_batch_boundary is not None:
+                try:
+                    self.on_batch_boundary()
+                except Exception:
+                    pass
+            if not step_ok:
+                time.sleep(0.001)
+
+    def _decode_step_locked(self):
+        """One token for every active lane (call with self._cond held).
+
+        NOTE: the step executes under the lock — sequences can only
+        join/leave at iteration boundaries, which is exactly the
+        continuous-batching contract.  submit()/abort() block for at
+        most one step (milliseconds at serving batch sizes), and in
+        exchange the active set and block tables need no second lock."""
+        m = self._model_of(self._active[0])
+        # drop client-aborted + deadline-expired actives first, freeing
+        # their blocks before this step's allocations
+        now = time.perf_counter()
+        for s in list(self._active):
+            if s.aborted:
+                self._active.remove(s)
+                self._free_blocks(s)
+                self._finish(s, InferReply("aborted",
+                                           error="aborted by client"))
+            elif now > s.pending.deadline:
+                self._active.remove(s)
+                self._free_blocks(s)
+                _tm.inc("serving_timeout_total", model=s.pending.model)
+                self._finish(s, InferReply(
+                    "timeout", error="deadline expired mid-decode"))
+        for s in list(self._active):
+            if s in self._active and not self._ensure_block(s):
+                pass  # defensively completed inside _ensure_block
+        if not self._active:
+            return True
+        lanes = self._active[:max(self.buckets)]
+        bucket = self._bucket_for(len(lanes))
+        tok = np.zeros(bucket, np.int32)
+        pos = np.zeros(bucket, np.int32)
+        tables = np.full((bucket, m.maxb), -1, np.int32)
+        lens = np.zeros(bucket, np.int32)
+        for i, s in enumerate(lanes):
+            tok[i] = s.next_tok
+            pos[i] = s.n_fed
+            tables[i] = s.table
+            lens[i] = s.n_fed + 1    # token valid AFTER this step's write
+        self._step_no += 1
+        sspan = _tr.start_span(
+            "serving.decode_step", model=m.name, bucket=bucket,
+            lanes=len(lanes), step=self._step_no)
+        for s in lanes:
+            sspan.link(s.pending.span.context
+                       if s.pending.span is not None else None)
+        _tr.note("decode_step", model=m.name, step=self._step_no,
+                 req_ids=[s.pending.req_id for s in lanes])
+        self.in_batch = True
+        t0 = time.perf_counter()
+        try:
+            with _tr.activate(sspan):
+                carry, nxt, _logits = m.stepfn(
+                    *self._step_args(m, bucket, tok, pos, tables, lens))
+            m.cache.replace_carry(carry)
+            nxt = np.asarray(nxt)
+        except Exception as e:
+            for s in lanes:
+                self._active.remove(s)
+                self._free_blocks(s)
+                self._finish(s, InferReply("error", error=str(e)))
+            _tm.inc("serving_batch_errors_total", model=m.name)
+            sspan.annotate(error=str(e)[:200]).end()
+            self.in_batch = False
+            return False
+        self.in_batch = False
+        ms = (time.perf_counter() - t0) * 1e3
+        m.step_ms = ms if m.step_ms <= 0 else 0.8 * m.step_ms + 0.2 * ms
+        t_tok = time.perf_counter()
+        n_generated = 0
+        for i, s in enumerate(lanes):
+            s.n_fed += 1
+            if s.in_prefill:
+                s.next_tok = s.prompt[s.n_fed]
+                continue
+            token = int(nxt[i])
+            s.next_tok = token
+            s.out.append(token)
+            s.token_times.append(t_tok)
+            if s.t_first is None:
+                s.t_first = t_tok
+            n_generated += 1
+            done = (len(s.out) >= s.max_new or token == s.eos_id)
+            if s.on_token is not None:
+                try:
+                    s.on_token(s.pending.req_id, len(s.out) - 1, token,
+                               done, "ok")
+                except Exception:
+                    pass
+            if done:
+                self._active.remove(s)
+                self._free_blocks(s)   # same-step free: next admission
+                self._finish(s, InferReply("ok"))
+                _tm.observe("serving_latency_ms",
+                            s.pending.reply.latency_ms, model=m.name)
+        if n_generated:
+            _tm.inc("serving_tokens_generated_total", n_generated,
+                    model=m.name)
+        _tm.inc("serving_decode_steps_total", model=m.name)
+        _tm.observe("decode_batch_occupancy",
+                    len(lanes) / float(bucket), model=m.name)
+        sspan.annotate(generated=n_generated, ms=round(ms, 3)).end()
+        return True

@@ -1,0 +1,247 @@
+"""Paged KV-cache for autoregressive decode serving.
+
+The decode engine owns a pool of fixed-size KV blocks (``[layers,
+num_blocks, block_size, heads, head_dim]`` device arrays) and hands each
+admitted sequence a *block table* — the list of physical blocks holding
+its history, grown one block per ``block_size`` generated tokens.  The
+physical layout is the point: sequences of wildly different lengths all
+present the decode step with the same static shapes (token ids, tables
+padded to ``max_seq // block_size`` slots, context lengths), so ONE
+AOT-compiled step per lane bucket serves every mixture of lengths with
+zero runtime XLA compiles, and a finished sequence returns its blocks to
+the free list the same step it finishes.
+
+``BlockAllocator`` is the host-side free list (LIFO for reuse locality;
+all-or-nothing ``alloc`` so a half-admitted sequence never holds blocks).
+``PagedKVCache`` owns the device arrays as a donated carry: every decode
+step consumes the current arrays and returns the updated ones
+(``carry()``/``replace_carry()``), so the cache is updated in place on
+device instead of being copied per token.
+
+Residency dtype (FLAGS_kv_cache_dtype): ``f32`` keeps bitwise parity
+with the unpaged reference loop; ``int8`` stores quantized blocks plus
+per-(block, position, head) max-abs scales — the EQuARX
+quantize-for-the-wire idiom (PAPERS.md arXiv 2506.17615) applied to
+residency, ~4x the tokens per HBM byte.
+
+Sizing is budget-gated (the MEM001/MEM003 satellite):
+``plan_num_blocks`` fits the pool under ``FLAGS_hbm_budget_bytes`` after
+the model's resident bytes, and every live cache registers its footprint
+so ``core/world_analysis.check_memory`` counts engine-owned KV blocks in
+the static per-replica peak estimate.
+"""
+
+import threading
+import weakref
+
+import jax.numpy as jnp
+
+from ..core import telemetry as _tm
+
+__all__ = ["KVCacheConfig", "BlockAllocator", "PagedKVCache",
+           "plan_num_blocks", "block_bytes", "engine_owned_kv_bytes",
+           "quantize_kv", "dequantize_kv"]
+
+# default pool size when neither FLAGS_kv_cache_blocks nor an HBM budget
+# pins one (CPU-tier tests and demos)
+_DEFAULT_BLOCKS = 64
+
+
+class KVCacheConfig:
+    """Static cache geometry; hidden = heads * head_dim per layer."""
+
+    __slots__ = ("layers", "heads", "head_dim", "block_size", "num_blocks",
+                 "dtype")
+
+    def __init__(self, layers, heads, head_dim, block_size, num_blocks,
+                 dtype="f32"):
+        if dtype not in ("f32", "int8"):
+            raise ValueError("kv_cache dtype must be f32|int8: %r" % dtype)
+        if block_size <= 0 or num_blocks <= 1:
+            raise ValueError("need block_size > 0 and num_blocks > 1 "
+                             "(block 0 is the idle-lane scratch)")
+        self.layers = int(layers)
+        self.heads = int(heads)
+        self.head_dim = int(head_dim)
+        self.block_size = int(block_size)
+        self.num_blocks = int(num_blocks)
+        self.dtype = dtype
+
+
+def block_bytes(config):
+    """HBM bytes ONE block costs across all layers (K + V, + scales for
+    int8)."""
+    per_tok = config.heads * config.head_dim
+    if config.dtype == "int8":
+        tok = per_tok * 1 + config.heads * 4        # int8 payload + scales
+    else:
+        tok = per_tok * 4
+    return 2 * config.layers * config.block_size * tok
+
+
+def plan_num_blocks(config, model_resident_bytes=0, requested=None,
+                    budget=None):
+    """Budget-gated pool sizing -> (num_blocks, capped).
+
+    ``requested`` (default FLAGS_kv_cache_blocks; <=0 = auto) asks for a
+    pool size; ``budget`` (default FLAGS_hbm_budget_bytes; 0 = no gate)
+    caps it at what fits beside the model's resident bytes.  A budget too
+    small for even a 2-block pool raises — the engine must not start with
+    a cache it cannot hold (FLAGS_hbm_budget_bytes gates cache sizing,
+    not just the model)."""
+    from .. import flags as _flags
+
+    if requested is None:
+        requested = int(_flags.flag("kv_cache_blocks") or 0)
+    if budget is None:
+        budget = int(_flags.flag("hbm_budget_bytes") or 0)
+    per = block_bytes(config)
+    if budget > 0:
+        fit = int((budget - int(model_resident_bytes)) // per)
+        if fit < 2:
+            raise ValueError(
+                "FLAGS_hbm_budget_bytes=%d leaves room for %d KV block(s) "
+                "of %d bytes beside %d model-resident bytes; the decode "
+                "cache needs >= 2 (shrink the model, raise the budget, or "
+                "set FLAGS_kv_cache_dtype=int8)"
+                % (budget, max(fit, 0), per, model_resident_bytes))
+        if requested > 0:
+            return min(requested, fit), fit < requested
+        return fit, False
+    return (requested if requested > 0 else _DEFAULT_BLOCKS), False
+
+
+class BlockAllocator:
+    """Host-side free list over physical block ids.
+
+    ``reserve`` low ids never enter circulation (the cache reserves block
+    0 as the idle-lane write scratch).  ``alloc`` is all-or-nothing: a
+    request the free list cannot fully satisfy takes nothing (the engine
+    sheds or preempts instead of deadlocking on a half-allocation)."""
+
+    def __init__(self, num_blocks, reserve=0):
+        if num_blocks <= reserve:
+            raise ValueError("num_blocks %d <= reserve %d"
+                             % (num_blocks, reserve))
+        self.num_blocks = int(num_blocks)
+        self.reserve = int(reserve)
+        # LIFO: the most recently freed block is the next handed out, so a
+        # churning batch keeps touching the same hot cache lines
+        self._free = list(range(num_blocks - 1, reserve - 1, -1))
+        self._owned = set()
+        self._lock = threading.Lock()
+        self.high_water = 0
+
+    @property
+    def capacity(self):
+        return self.num_blocks - self.reserve
+
+    @property
+    def num_free(self):
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def in_use(self):
+        with self._lock:
+            return len(self._owned)
+
+    def alloc(self, n):
+        """n blocks or None (OOM — nothing is taken)."""
+        if n <= 0:
+            return []
+        with self._lock:
+            if n > len(self._free):
+                _tm.inc("kv_block_oom_total")
+                return None
+            got = [self._free.pop() for _ in range(n)]
+            self._owned.update(got)
+            self.high_water = max(self.high_water, len(self._owned))
+            _tm.inc("kv_block_alloc_total", n)
+            _tm.set_gauge("kv_blocks_in_use", len(self._owned))
+        return got
+
+    def free(self, blocks):
+        """Return blocks to the free list; double-free or a foreign id
+        raises (an engine bug must be loud, not silent corruption)."""
+        blocks = list(blocks)
+        with self._lock:
+            for b in blocks:
+                if b not in self._owned:
+                    raise ValueError("free of unallocated block %r" % (b,))
+            for b in blocks:
+                self._owned.discard(b)
+                self._free.append(b)
+            _tm.inc("kv_block_free_total", len(blocks))
+            _tm.set_gauge("kv_blocks_in_use", len(self._owned))
+
+    def stats(self):
+        with self._lock:
+            return {"capacity": self.capacity, "free": len(self._free),
+                    "in_use": len(self._owned),
+                    "high_water": self.high_water}
+
+
+# live caches, summed into the MEM001 static peak estimate
+_LIVE = weakref.WeakSet()
+
+
+def engine_owned_kv_bytes():
+    """Total HBM bytes of every live PagedKVCache in this process —
+    world_analysis.check_memory folds this into MEM001/MEM003."""
+    return sum(c.nbytes for c in list(_LIVE))
+
+
+def quantize_kv(x):
+    """f32 [..., H, D] -> (int8 payload, f32 per-[..., H] max-abs scale).
+    Symmetric round-to-nearest into [-127, 127]."""
+    scale = jnp.max(jnp.abs(x), axis=-1) / 127.0
+    safe = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(jnp.round(x / safe[..., None]), -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def dequantize_kv(q, scale):
+    return q.astype(jnp.float32) * scale[..., None]
+
+
+class PagedKVCache:
+    """Engine-owned paged K/V device arrays, carried (donated) through
+    the decode step.  Block 0 is reserved: idle lanes in a partially-full
+    bucket point their table at it, so their (masked, discarded) writes
+    never touch a sequence's history."""
+
+    def __init__(self, config):
+        self.config = config
+        self.allocator = BlockAllocator(config.num_blocks, reserve=1)
+        shape = (config.layers, config.num_blocks, config.block_size,
+                 config.heads, config.head_dim)
+        if config.dtype == "int8":
+            self._carry = (jnp.zeros(shape, jnp.int8),
+                           jnp.zeros(shape, jnp.int8),
+                           jnp.zeros(shape[:-1], jnp.float32),
+                           jnp.zeros(shape[:-1], jnp.float32))
+        else:
+            self._carry = (jnp.zeros(shape, jnp.float32),
+                           jnp.zeros(shape, jnp.float32))
+        _LIVE.add(self)
+        _tm.set_gauge("kv_cache_bytes", self.nbytes)
+
+    @property
+    def nbytes(self):
+        return block_bytes(self.config) * self.config.num_blocks
+
+    def carry(self):
+        """The current device arrays, in decode-step argument order."""
+        return self._carry
+
+    def replace_carry(self, new_carry):
+        """Install the step's returned (donated) arrays."""
+        if len(new_carry) != len(self._carry):
+            raise ValueError("carry arity changed")
+        self._carry = tuple(new_carry)
+
+    def blocks_for_tokens(self, n_tokens):
+        """How many blocks a sequence of n_tokens needs."""
+        bs = self.config.block_size
+        return max(1, -(-int(n_tokens) // bs))

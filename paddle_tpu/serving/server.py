@@ -14,6 +14,12 @@ One ``RpcServer`` per replica carries the whole protocol:
   ``__spec__:<model>``    feed/fetch signature + buckets, so loadgen can
                           synthesize valid requests without the model dir
   ``__fhb__<rank>``       fleet replica heartbeats (serving/fleet.py)
+  ``__generate__:<id>``   inbound SEND: autoregressive request for the
+                          paged-KV decode engine; generated tokens stream
+                          as ``__stream__:<id>:<k>`` chunks and the final
+                          reply still lands on ``__reply__:<id>``
+  ``__abort__:<id>``      inbound SEND: drop the sequence, free its KV
+                          blocks (client timeout-replay abandonment)
 
 Replies are garbage-collected FIFO beyond a bounded ring — a crashed
 client can never grow the server's var store unboundedly.
@@ -34,8 +40,9 @@ _REPLY_RING = 1024
 
 
 class ServingServer:
-    def __init__(self, engine, port=0, rank=0):
+    def __init__(self, engine, port=0, rank=0, decode_engine=None):
         self.engine = engine
+        self.decode_engine = decode_engine
         self.rank = int(rank)
         self.rpc = RpcServer(port=port)
         self.port = self.rpc.port
@@ -55,6 +62,11 @@ class ServingServer:
         for name in self.engine.models():
             self.rpc.set_var(codec.SPEC_KEY + name,
                              codec.pack(self.engine.spec(name)))
+        if self.decode_engine is not None:
+            self.decode_engine.start()
+            for name in self.decode_engine.models():
+                self.rpc.set_var(codec.SPEC_KEY + name,
+                                 codec.pack(self.decode_engine.spec(name)))
         self.rpc.serve(True)
         if _tm.enabled():
             self._pub_stop = _tm.start_publisher(self.rpc, interval_s=1.0)
@@ -69,6 +81,8 @@ class ServingServer:
         via the engine hook."""
         self.fleet = fleet
         self.engine.on_batch_boundary = fleet.tick
+        if self.decode_engine is not None:
+            self.decode_engine.on_batch_boundary = fleet.tick
 
     def _poll_loop(self):
         while True:
@@ -79,6 +93,11 @@ class ServingServer:
                 continue
             if name.startswith(codec.INFER_KEY):
                 self._on_infer(name[len(codec.INFER_KEY):], arr)
+            elif name.startswith(codec.GEN_KEY):
+                self._on_generate(name[len(codec.GEN_KEY):], arr)
+            elif name.startswith(codec.ABORT_KEY):
+                if self.decode_engine is not None:
+                    self.decode_engine.abort(name[len(codec.ABORT_KEY):])
             elif self.fleet is not None:
                 self.fleet.on_event(name, arr)
             if self.fleet is not None:
@@ -107,6 +126,54 @@ class ServingServer:
                     traceparent=tp,
                     callback=lambda pending: self._publish(
                         pending.req_id, pending.reply, pending))
+
+    def _on_generate(self, req_id, arr):
+        from .engine import InferReply
+
+        try:
+            meta, arrays = codec.unpack(arr)
+            prompt = arrays[0]
+        except Exception:
+            self._publish(req_id, None)
+            _tm.inc("serving_bad_request_total")
+            return
+        if self.decode_engine is None:
+            self._publish(req_id, InferReply(
+                "error", error="replica has no decode engine"))
+            return
+        stream = bool(meta.get("stream"))
+        on_token = self._stream_publisher(req_id) if stream else None
+        tp = meta.get(codec.TRACEPARENT)
+        with _tr.remote_parent(tp):
+            with _tr.span("serving.admission", req_id=req_id, decode=True,
+                          model=meta.get("model", ""), rank=self.rank):
+                self.decode_engine.submit(
+                    meta.get("model", ""), prompt,
+                    max_new_tokens=int(meta.get("max_new_tokens", 16)),
+                    tenant=meta.get("tenant", "default"),
+                    deadline_ms=meta.get("deadline_ms"),
+                    eos_id=int(meta.get("eos_id", -1)),
+                    req_id=req_id,
+                    traceparent=tp,
+                    on_token=on_token,
+                    callback=lambda pending: self._publish(
+                        pending.req_id, pending.reply, pending))
+
+    def _stream_publisher(self, req_id):
+        """Per-token chunk publisher: ``__stream__:<id>:<k>`` carries the
+        k-th generated token; the final/terminal chunk sets done.  Chunk
+        keys join the reply GC ring so crashed streamers can't leak."""
+
+        def on_token(rid, index, token, done, status):
+            key = "%s%s:%d" % (codec.STREAM_KEY, rid, index)
+            self.rpc.set_var(key, codec.pack(
+                {"i": int(index), "done": bool(done), "status": status,
+                 "token": None if token is None else int(token)}))
+            with self._reply_lock:
+                self._reply_keys.append(key)
+                while len(self._reply_keys) > _REPLY_RING:
+                    self.rpc.del_var(self._reply_keys.pop(0))
+        return on_token
 
     def _publish(self, req_id, reply, pending=None):
         from .engine import InferReply
@@ -146,6 +213,8 @@ class ServingServer:
         if self.fleet is not None:
             self.fleet.stop()
         self.engine.stop()
+        if self.decode_engine is not None:
+            self.decode_engine.stop()
         self.rpc.shutdown()
         if self._thread is not None:
             self._thread.join(5.0)

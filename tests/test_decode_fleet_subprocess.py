@@ -1,0 +1,157 @@
+"""Decode serving under replica loss (real subprocesses): 2 replicas of
+tools/serve.py serve the SAME tiny decoder from a shared compile cache;
+a client streams ``generate`` requests against the fleet endpoints file
+while replica 1 is SIGKILLed mid-stream.  Every submitted request must
+still be answered — and answered CORRECTLY: greedy decode is
+deterministic and both replicas hold identical weights, so a failed-over
+request re-decodes to the same tokens as the unpaged reference.  The
+SIGKILLed replica must also leave write-through ``decode_step`` records
+(req_ids of the lanes in flight) in its flight-recorder postmortem."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+
+from dist_utils import free_ports, gather_tails
+
+_SERVE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools", "serve.py")
+
+
+def _env(tmp):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "FLAGS_telemetry": "1",
+        "FLAGS_serving_hb_interval": "0.2",
+        "FLAGS_serving_hb_timeout": "1.5",
+        "FLAGS_kv_block_size": "8",
+        "FLAGS_kv_cache_blocks": "64",
+        "FLAGS_compile_cache_dir": os.path.join(str(tmp), "cc"),
+        "FLAGS_tracing": "1",
+        "FLAGS_telemetry_dir": os.path.join(str(tmp), "tel"),
+    })
+    return env
+
+
+def _wait_ready(proc, timeout=120.0):
+    deadline = time.time() + timeout
+    lines = []
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        lines.append(line)
+        if line.startswith("READY"):
+            return lines
+    raise AssertionError("server not READY:\n" + "".join(lines))
+
+
+def test_sigkill_mid_decode_drops_nothing(tmp_path):
+    from paddle_tpu.serving import ServingClient
+    from paddle_tpu.serving.decode_model import load_decoder, \
+        unpaged_generate
+
+    sys.path.insert(0, os.path.dirname(_SERVE))
+    from serve import save_demo_decoder
+
+    dec_dir = save_demo_decoder(str(tmp_path / "dec"))
+    cfg, params = load_decoder(dec_dir)
+    # pad to maxb * block_size (block_size 8 via the env) for bitwise
+    # parity with the replicas' paged step
+    pad = -(-cfg.max_seq // 8) * 8
+    prompt, max_new = [1, 2, 3], 6
+    want = np.asarray(unpaged_generate(cfg, params, prompt, max_new,
+                                       pad_len=pad), np.int32)
+
+    eps_file = str(tmp_path / "eps.json")
+    ports = free_ports(2)
+    eps = ["127.0.0.1:%d" % p for p in ports]
+
+    procs = []
+    try:
+        for rank in range(2):
+            procs.append(("replica%d" % rank, subprocess.Popen(
+                [sys.executable, "-u", _SERVE, "--model",
+                 "toy=" + dec_dir, "--decode-buckets", "4",
+                 "--rank", str(rank), "--fleet", ",".join(eps),
+                 "--endpoints-file", eps_file],
+                env=_env(tmp_path), stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True,
+                start_new_session=True)))
+        for _, p in procs:
+            _wait_ready(p)
+        for _, p in procs:
+            threading.Thread(target=p.stdout.read, daemon=True).start()
+
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            try:
+                with open(eps_file) as f:
+                    if len(json.load(f)["endpoints"]) == 2:
+                        break
+            except (OSError, ValueError):
+                pass
+            time.sleep(0.1)
+        else:
+            raise AssertionError("coordinator never published 2 endpoints")
+
+        cli = ServingClient(endpoints_file=eps_file)
+        replies = []
+
+        def stream(n, every_s):
+            for _ in range(n):
+                replies.append(cli.generate("toy", prompt,
+                                            max_new_tokens=max_new,
+                                            deadline_ms=15000.0))
+                time.sleep(every_s)
+
+        stream(10, 0.02)                 # both replicas serve decode steps
+        victim = procs[1][1]
+        killer = threading.Thread(
+            target=lambda: (time.sleep(0.3), victim.kill()), daemon=True)
+        killer.start()
+        stream(20, 0.05)                 # straddles the SIGKILL
+        killer.join()
+        assert victim.wait(10) == -9
+
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            with open(eps_file) as f:
+                doc = json.load(f)
+            if doc["endpoints"] == [eps[0]] and doc["epoch"] >= 1:
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("fleet never shrank: %r" % (doc,))
+
+        # write-through decode_step notes survive -9: the postmortem
+        # names the request ids that were in flight
+        victim_fr = os.path.join(str(tmp_path), "tel",
+                                 "flightrec-%d.json" % victim.pid)
+        assert os.path.exists(victim_fr), \
+            "SIGKILLed replica left no flight record"
+        with open(victim_fr) as f:
+            doc = json.load(f)
+        steps = [r for r in doc.get("records", [])
+                 if r.get("kind") == "decode_step"]
+        assert steps and all(s.get("req_ids") for s in steps), doc
+
+        stream(10, 0.02)                 # post-shrink traffic
+        statuses = [r.status for r in replies]
+        assert len(statuses) == 40
+        assert statuses.count("dropped") == 0, statuses
+        assert all(s == "ok" for s in statuses), statuses
+        # deterministic greedy decode: every answer, including the
+        # failed-over ones, matches the unpaged reference bitwise
+        for r in replies:
+            assert np.array_equal(r.outputs["tokens"], want)
+    finally:
+        fail_dump = gather_tails(procs)
+        del fail_dump

@@ -1,0 +1,407 @@
+"""Autoregressive decode serving (serving/engine.py DecodeEngine +
+decode_model.py + the wire protocol): bitwise parity of the paged step
+against the unpaged reference loop, the zero-runtime-compile invariant
+under mixed-length continuous batching, token-level join/leave
+mid-batch, admission-time KV-pressure shed with a drain-time hint,
+deterministic preemption-recompute, client abort, the streaming
+``__generate__``/``__stream__`` wire path, client replay on server
+timeout, int8 KV residency, and the probe-gated Pallas paged-attention
+funnel (interpret-mode parity)."""
+
+import contextlib
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core import telemetry as _tm
+from paddle_tpu.pallas_kernels import adoption
+from paddle_tpu.pallas_kernels import paged_attention as pa
+from paddle_tpu.serving import (DecodeEngine, ServingClient, ServingEngine,
+                                ServingServer)
+from paddle_tpu.serving.decode_model import (DecoderConfig,
+                                             init_decoder_params,
+                                             unpaged_generate)
+
+CFG = DecoderConfig(vocab=31, layers=2, heads=2, head_dim=8, max_seq=48)
+PARAMS = init_decoder_params(CFG, seed=7)
+BS = 4                      # FLAGS_kv_block_size for every engine here
+PAD = 48                    # maxb(12) * BS: the paged step's context width
+
+
+def _unpaged(prompt, max_new, eos_id=-1):
+    return np.asarray(unpaged_generate(CFG, PARAMS, prompt, max_new,
+                                       pad_len=PAD, eos_id=eos_id),
+                      np.int32)
+
+
+@contextlib.contextmanager
+def _flags(**kv):
+    kv = {"FLAGS_" + k: v for k, v in kv.items()}
+    old = fluid.get_flags(list(kv))
+    fluid.set_flags(kv)
+    try:
+        yield
+    finally:
+        fluid.set_flags(old)
+
+
+@pytest.fixture(scope="module")
+def cache_dir(tmp_path_factory):
+    """Tier-B disk cache shared by every engine in this module, so
+    repeated (cfg, kv geometry) pairs restore instead of recompiling."""
+    d = str(tmp_path_factory.mktemp("cc"))
+    old = fluid.get_flags(["FLAGS_compile_cache_dir"])
+    fluid.set_flags({"FLAGS_compile_cache_dir": d})
+    yield d
+    fluid.set_flags(old)
+
+
+@pytest.fixture(scope="module")
+def eng(cache_dir):
+    """Started token-mode engine with a roomy pool, prewarmed."""
+    with _flags(kv_block_size=BS, kv_cache_dtype="f32"):
+        e = DecodeEngine(buckets="2,4", deadline_ms=30000.0)
+        e.add_model("toy", (CFG, PARAMS), kv_blocks=64)
+    e.prewarm()
+    e.start()
+    yield e
+    e.stop()
+
+
+@pytest.fixture()
+def telemetry_on():
+    fluid.set_flags({"FLAGS_telemetry": True})
+    _tm.reset()
+    yield
+    _tm.reset()
+    fluid.set_flags({"FLAGS_telemetry": False})
+
+
+def _mkengine(cache_dir, kv_blocks, buckets="1", mode="token",
+              source=(CFG, PARAMS), **flag_kw):
+    flag_kw.setdefault("kv_block_size", BS)
+    with _flags(**flag_kw):
+        e = DecodeEngine(buckets=buckets, mode=mode, deadline_ms=30000.0)
+        e.add_model("toy", source, kv_blocks=kv_blocks)
+    return e.start()
+
+
+# -- parity ------------------------------------------------------------------
+
+
+def test_engine_bitwise_parity_vs_unpaged(cache_dir):
+    e = _mkengine(cache_dir, 64)
+    try:
+        for prompt in ([1], [2, 3, 4], [5, 6, 7, 8, 9]):
+            r = e.generate("toy", prompt, max_new_tokens=8,
+                           deadline_ms=30000.0)
+            assert r.status == "ok", r.error
+            # greedy paged decode == the unpaged reference, bitwise
+            assert np.array_equal(r.outputs["tokens"],
+                                  _unpaged(prompt, 8)), prompt
+            assert r.phases["prompt_tokens"] == len(prompt)
+    finally:
+        e.stop()
+
+
+def test_eos_stops_early(cache_dir, eng):
+    full = _unpaged([1, 2], 8)
+    eos = int(full[2])
+    r = eng.generate("toy", [1, 2], max_new_tokens=8, eos_id=eos,
+                     deadline_ms=30000.0)
+    assert r.status == "ok"
+    assert np.array_equal(r.outputs["tokens"], full[:3])
+
+
+# -- zero runtime compiles under mixed-length continuous batching ------------
+
+
+def test_mixed_lengths_share_one_executable(eng, telemetry_on):
+    prompts = [[1], [2, 3, 4], [5, 6], [7, 8, 9, 10, 11]]
+    miss0 = _tm.counter_total("executor_cache_miss_total")
+    reqs = [eng.submit("toy", p, max_new_tokens=6, deadline_ms=30000.0)
+            for p in prompts]
+    replies = [r.wait(timeout=60.0) for r in reqs]
+    assert all(r is not None and r.status == "ok" for r in replies)
+    for p, r in zip(prompts, replies):
+        assert np.array_equal(r.outputs["tokens"], _unpaged(p, 6)), p
+    # the invariant: mixed lengths + mixed phases hit the prewarmed
+    # executables only — no runtime XLA compile
+    assert _tm.counter_total("executor_cache_miss_total") == miss0
+    assert _tm.counter_total("serving_tokens_generated_total") == 24
+    snap = _tm.snapshot()
+    occ = [v for k, v in snap["histograms"].items()
+           if k.startswith("decode_batch_occupancy")]
+    assert occ and sum(h["count"] for h in occ) > 0
+    # every sequence finished: its blocks went back the same step
+    assert eng._models["toy"].cache.allocator.in_use == 0
+
+
+def test_streaming_phases_and_on_token(eng):
+    got = []
+    r = eng.generate("toy", [4, 5], max_new_tokens=5,
+                     deadline_ms=30000.0,
+                     on_token=lambda rid, i, tok, done, st:
+                     got.append((i, tok, done, st)))
+    assert r.status == "ok"
+    assert [g[1] for g in got] == list(r.outputs["tokens"])
+    assert got[-1][2] is True and all(g[3] == "ok" for g in got)
+    assert r.phases["tokens"] == 5 and r.phases["ttft_ms"] > 0
+    assert len(r.phases["itl_ms_samples"]) == 4
+    assert r.phases["queue_wait_ms"] >= 0
+
+
+# -- token-level join/leave --------------------------------------------------
+
+
+def test_join_and_leave_mid_batch(eng):
+    started = threading.Event()
+    order = []
+    ra = eng.submit("toy", [1, 2], max_new_tokens=40,
+                    deadline_ms=30000.0,
+                    callback=lambda r: order.append("A"),
+                    on_token=lambda *a: started.set())
+    assert started.wait(20.0), "long sequence never produced a token"
+    rb = eng.submit("toy", [3], max_new_tokens=2, deadline_ms=30000.0,
+                    callback=lambda r: order.append("B"))
+    b = rb.wait(timeout=60.0)
+    a = ra.wait(timeout=60.0)
+    assert a.status == "ok" and b.status == "ok"
+    # B joined the running batch and LEFT it while A kept decoding
+    assert order == ["B", "A"]
+    assert len(a.outputs["tokens"]) == 40
+    assert np.array_equal(b.outputs["tokens"], _unpaged([3], 2))
+
+
+def test_abort_queued_and_active(eng, telemetry_on):
+    # queued: submit under the scheduler lock so the loop cannot admit
+    # it before the abort lands
+    with eng._cond:
+        rq = eng.submit("toy", [1], max_new_tokens=4, deadline_ms=30000.0)
+        assert eng.abort(rq.req_id)
+    assert rq.wait(timeout=10.0).status == "aborted"
+    # active: abort mid-decode frees the blocks
+    started = threading.Event()
+    ra = eng.submit("toy", [1, 2], max_new_tokens=40,
+                    deadline_ms=30000.0,
+                    on_token=lambda *a: started.set())
+    assert started.wait(20.0)
+    assert eng.abort(ra.req_id)
+    assert ra.wait(timeout=10.0).status == "aborted"
+    deadline = time.time() + 5
+    while time.time() < deadline and \
+            eng._models["toy"].cache.allocator.in_use:
+        time.sleep(0.01)
+    assert eng._models["toy"].cache.allocator.in_use == 0
+    assert _tm.counter_total("serving_abort_total") >= 2
+
+
+# -- admission control -------------------------------------------------------
+
+
+def test_submit_validation_errors(eng):
+    assert eng.generate("nope", [1]).status == "error"
+    assert eng.generate("toy", []).status == "error"
+    r = eng.generate("toy", [1], max_new_tokens=99)
+    assert r.status == "error" and "max_seq" in r.error
+    assert eng.generate("toy", [31]).status == "error"
+
+
+def test_kv_pressure_sheds_with_retry_hint(cache_dir, telemetry_on):
+    e = _mkengine(cache_dir, 3)          # capacity 2 beside the scratch
+    try:
+        # sequence needing more blocks than the pool holds is an error,
+        # not a shed — retrying can never admit it
+        r = e.generate("toy", [1] * 9, max_new_tokens=8)
+        assert r.status == "error" and "pool holds" in r.error
+        # under the lock: A's promised prompt blocks + B's exceed the
+        # free pool, so B sheds at admission with a drain-time hint
+        with e._cond:
+            ra = e.submit("toy", [1] * 5, max_new_tokens=3,
+                          deadline_ms=30000.0)
+            rb = e.submit("toy", [2] * 4, max_new_tokens=4,
+                          deadline_ms=30000.0)
+        assert rb.reply.status == "shed"
+        assert "KV pool" in rb.reply.error
+        assert rb.reply.retry_after_ms >= 1.0
+        assert _tm.counter_total("serving_shed_total") == 1
+        a = ra.wait(timeout=60.0)
+        assert a.status == "ok"
+        assert np.array_equal(a.outputs["tokens"], _unpaged([1] * 5, 3))
+    finally:
+        e.stop()
+
+
+def test_preemption_recompute_is_deterministic(cache_dir, telemetry_on):
+    # capacity 3: A wants 3 blocks (12 tokens), B wants 2 (8 tokens) —
+    # 5 > 3 forces mid-decode preemption; greedy recompute must re-emit
+    # identical tokens
+    e = _mkengine(cache_dir, 4, buckets="2")
+    try:
+        with e._cond:       # both admitted at the same iteration boundary
+            ra = e.submit("toy", [1, 2, 3, 4], max_new_tokens=8,
+                          deadline_ms=30000.0)
+            rb = e.submit("toy", [5, 6, 7, 8], max_new_tokens=4,
+                          deadline_ms=30000.0)
+        a = ra.wait(timeout=60.0)
+        b = rb.wait(timeout=60.0)
+        assert a is not None and a.status == "ok", a and a.error
+        assert b is not None and b.status == "ok", b and b.error
+        assert np.array_equal(a.outputs["tokens"],
+                              _unpaged([1, 2, 3, 4], 8))
+        assert np.array_equal(b.outputs["tokens"],
+                              _unpaged([5, 6, 7, 8], 4))
+        assert _tm.counter_total("kv_block_evictions_total") >= 1
+        assert e._models["toy"].cache.allocator.in_use == 0
+    finally:
+        e.stop()
+
+
+# -- int8 KV residency -------------------------------------------------------
+
+
+def test_int8_residency_generates(cache_dir):
+    e = _mkengine(cache_dir, 16, kv_cache_dtype="int8")
+    try:
+        assert e.spec("toy")["kv_dtype"] == "int8"
+        assert len(e._models["toy"].cache.carry()) == 4
+        r = e.generate("toy", [1, 2, 3], max_new_tokens=4,
+                       deadline_ms=30000.0)
+        assert r.status == "ok"
+        toks = r.outputs["tokens"]
+        assert len(toks) == 4 and all(0 <= t < 31 for t in toks)
+    finally:
+        e.stop()
+
+
+# -- wire protocol -----------------------------------------------------------
+
+
+def test_generate_over_the_wire_stream_and_not(cache_dir):
+    with _flags(kv_block_size=BS, kv_cache_dtype="f32"):
+        e = DecodeEngine(buckets="2", deadline_ms=30000.0)
+        e.add_model("toy", (CFG, PARAMS), kv_blocks=64)
+    srv = ServingServer(ServingEngine(), port=0, decode_engine=e).start()
+    try:
+        cli = ServingClient(endpoints=["127.0.0.1:%d" % srv.port])
+        spec = cli.spec("toy")
+        assert spec["type"] == "decode" and spec["block_size"] == BS
+        want = _unpaged([2, 3], 5)
+        r = cli.generate("toy", [2, 3], max_new_tokens=5,
+                         deadline_ms=30000.0, stream=False)
+        assert r.status == "ok" and np.array_equal(r.outputs["tokens"],
+                                                   want)
+        seen = []
+        r = cli.generate("toy", [2, 3], max_new_tokens=5,
+                         deadline_ms=30000.0, stream=True,
+                         on_token=lambda i, t: seen.append(t))
+        assert r.status == "ok" and seen == list(want)
+        # wire-inclusive client-side latency attribution
+        assert r.phases["client_ttft_ms"] > 0
+        assert len(r.phases["client_itl_ms_samples"]) == 4
+        chunks = list(cli.generate_stream("toy", [2, 3], max_new_tokens=5,
+                                          deadline_ms=30000.0))
+        assert [t for _, t in chunks] == list(want)
+        # streaming error terminal chunk: bad model doesn't hang
+        assert cli.generate("zzz", [1], deadline_ms=4000.0).status \
+            == "error"
+    finally:
+        srv.shutdown()
+
+
+def test_client_replays_on_server_timeout(cache_dir):
+    """Replica A (request mode) is busy with a long generation, so the
+    client's request expires in A's queue; the server's timeout reply
+    must trigger replay on replica B, which answers correctly."""
+    big = DecoderConfig(vocab=31, layers=6, heads=4, head_dim=32,
+                        max_seq=512)
+    ea = _mkengine(cache_dir, 140, mode="request",
+                   source=(big, init_decoder_params(big, seed=3)))
+    eb = _mkengine(cache_dir, 64)
+    sa = ServingServer(ServingEngine(), port=0, decode_engine=ea).start()
+    sb = ServingServer(ServingEngine(), port=0, decode_engine=eb).start()
+    try:
+        # request mode runs one sequence at a time: three queued 500-token
+        # generations keep A busy for well past the client's deadline
+        busy = [ea.submit("toy", [1, 2], max_new_tokens=500,
+                          deadline_ms=120000.0) for _ in range(3)]
+        deadline = time.time() + 20
+        while time.time() < deadline and not ea._active:
+            time.sleep(0.01)
+        assert ea._active, "busy sequence never admitted"
+        cli = ServingClient(endpoints=["127.0.0.1:%d" % sa.port,
+                                       "127.0.0.1:%d" % sb.port])
+        r = cli.generate("toy", [9, 8, 7], max_new_tokens=4,
+                         deadline_ms=300.0)
+        assert r.status == "ok", (r.status, r.error)
+        assert cli.failovers >= 1
+        assert np.array_equal(r.outputs["tokens"], _unpaged([9, 8, 7], 4))
+        for b in busy:
+            ea.abort(b.req_id)
+    finally:
+        sa.shutdown()
+        sb.shutdown()
+
+
+# -- Pallas paged-attention funnel -------------------------------------------
+
+
+def _paged_fixture(rng, bb=2, blocks=4, bs=8, h=1, d=128, maxb=2):
+    q = rng.randn(bb, h, d).astype(np.float32)
+    k = rng.randn(blocks, bs, h, d).astype(np.float32)
+    v = rng.randn(blocks, bs, h, d).astype(np.float32)
+    tables = np.array([[1, 3], [2, -1]], np.int32)
+    lens = np.array([12, 5], np.int32)
+    return q, k, v, tables, lens
+
+
+def test_paged_attention_interpret_parity(monkeypatch, telemetry_on):
+    monkeypatch.setenv("PADDLE_PALLAS_INTERPRET", "1")
+    adoption.reset()
+    try:
+        fluid.set_flags({"FLAGS_use_pallas_paged_attention": True})
+        args = _paged_fixture(np.random.RandomState(0))
+        out = np.asarray(pa.paged_attention(*args))
+        ref = np.asarray(pa.paged_attention_reference(*args))
+        # online-softmax accumulation vs one-shot softmax: allclose, and
+        # the funnel actually adopted the kernel
+        assert np.allclose(out, ref, atol=1e-5), np.abs(out - ref).max()
+        assert "paged_attention" in adoption.active_kernels()
+        assert _tm.counter_total("pallas_kernel_used_total") >= 1
+    finally:
+        fluid.set_flags({"FLAGS_use_pallas_paged_attention": False})
+        adoption.reset()
+
+
+def test_paged_attention_funnel_falls_back_off_tpu(monkeypatch,
+                                                   telemetry_on):
+    monkeypatch.delenv("PADDLE_PALLAS_INTERPRET", raising=False)
+    adoption.reset()
+    try:
+        fluid.set_flags({"FLAGS_use_pallas_paged_attention": True})
+        args = _paged_fixture(np.random.RandomState(1))
+        out = np.asarray(pa.paged_attention(*args))
+        ref = np.asarray(pa.paged_attention_reference(*args))
+        # CPU backend, no interpret: the funnel must refuse the kernel
+        # and the jnp fallback is the reference itself
+        assert np.array_equal(out, ref)
+        assert adoption.active_kernels() == []
+        assert _tm.counter_total("pallas_kernel_fallback_total") >= 1
+    finally:
+        fluid.set_flags({"FLAGS_use_pallas_paged_attention": False})
+        adoption.reset()
+
+
+def test_paged_attention_checks_catch_bad_geometry():
+    reasons = dict(pa.paged_attention_checks((2, 1, 64), (4, 8, 1, 64),
+                                             np.float32, 8))
+    assert reasons["head_dim"] is False      # 64 % 128 != 0
+    reasons = dict(pa.paged_attention_checks((2, 1, 128), (4, 6, 1, 128),
+                                             np.float32, 6))
+    assert reasons["block_size"] is False    # 6 % 8 != 0
+    reasons = dict(pa.paged_attention_checks((2, 1, 128), (4, 8, 1, 128),
+                                             np.float16, 8))
+    assert reasons["dtype"] is False

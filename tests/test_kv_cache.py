@@ -1,0 +1,265 @@
+"""Paged KV-cache pool (serving/kv_cache.py): block-allocator units
+(all-or-nothing OOM, LIFO reuse, loud double-free, high-water),
+budget-gated sizing via FLAGS_hbm_budget_bytes / FLAGS_kv_cache_blocks,
+int8 residency quantization round-trips, and the MEM001 fold of
+engine-owned KV bytes into the static per-replica peak estimate."""
+
+import contextlib
+import gc
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, optimizer
+from paddle_tpu.core import telemetry as _tm
+from paddle_tpu.core import world_analysis
+from paddle_tpu.serving import kv_cache
+from paddle_tpu.serving.kv_cache import (BlockAllocator, KVCacheConfig,
+                                         PagedKVCache, block_bytes,
+                                         dequantize_kv,
+                                         engine_owned_kv_bytes,
+                                         plan_num_blocks, quantize_kv)
+
+
+@contextlib.contextmanager
+def _flags(**kv):
+    kv = {"FLAGS_" + k: v for k, v in kv.items()}
+    old = fluid.get_flags(list(kv))
+    fluid.set_flags(kv)
+    try:
+        yield
+    finally:
+        fluid.set_flags(old)
+
+
+def _cfg(**kw):
+    base = dict(layers=2, heads=2, head_dim=8, block_size=4, num_blocks=8)
+    base.update(kw)
+    return KVCacheConfig(**base)
+
+
+# -- BlockAllocator ----------------------------------------------------------
+
+
+def test_alloc_free_roundtrip():
+    a = BlockAllocator(8, reserve=1)
+    assert a.capacity == 7 and a.num_free == 7 and a.in_use == 0
+    got = a.alloc(3)
+    assert len(got) == 3 and a.in_use == 3 and a.num_free == 4
+    # the reserved block never circulates
+    assert 0 not in got
+    a.free(got)
+    assert a.in_use == 0 and a.num_free == 7
+
+
+def test_alloc_is_all_or_nothing_on_oom():
+    a = BlockAllocator(4, reserve=1)
+    assert a.alloc(3) is not None
+    before = a.stats()
+    assert a.alloc(2) is None          # only 0 free: takes NOTHING
+    assert a.stats() == before
+    assert a.alloc(0) == []
+
+
+def test_lifo_reuse_locality():
+    a = BlockAllocator(8, reserve=1)
+    first = a.alloc(2)
+    a.free(first)
+    again = a.alloc(2)
+    # most recently freed block is handed out first
+    assert again[0] == first[-1]
+
+
+def test_double_free_and_foreign_free_raise():
+    a = BlockAllocator(4, reserve=1)
+    got = a.alloc(2)
+    a.free(got)
+    with pytest.raises(ValueError):
+        a.free(got)
+    with pytest.raises(ValueError):
+        a.free([99])
+
+
+def test_high_water_tracks_peak_not_current():
+    a = BlockAllocator(8, reserve=1)
+    g1 = a.alloc(5)
+    a.free(g1)
+    a.alloc(2)
+    assert a.stats()["high_water"] == 5
+
+
+def test_reserve_validation():
+    with pytest.raises(ValueError):
+        BlockAllocator(2, reserve=2)
+
+
+def test_oom_increments_counter():
+    fluid.set_flags({"FLAGS_telemetry": True})
+    _tm.reset()
+    try:
+        a = BlockAllocator(3, reserve=1)
+        assert a.alloc(5) is None
+        assert _tm.counter_total("kv_block_oom_total") == 1
+    finally:
+        _tm.reset()
+        fluid.set_flags({"FLAGS_telemetry": False})
+
+
+# -- sizing (plan_num_blocks) ------------------------------------------------
+
+
+def test_block_bytes_int8_smaller_than_f32():
+    f32 = block_bytes(_cfg())
+    i8 = block_bytes(_cfg(dtype="int8"))
+    # int8 payload + f32 per-(pos, head) scales: well under half of f32
+    assert i8 < f32 / 2
+    # exact: 2 sides * layers * block_size * (H*D payload + H scales)
+    assert i8 == 2 * 2 * 4 * (2 * 8 * 1 + 2 * 4)
+    assert f32 == 2 * 2 * 4 * (2 * 8 * 4)
+
+
+def test_plan_respects_request_without_budget():
+    n, capped = plan_num_blocks(_cfg(), requested=17, budget=0)
+    assert (n, capped) == (17, False)
+
+
+def test_plan_defaults_when_unpinned():
+    with _flags(kv_cache_blocks=0, hbm_budget_bytes=0):
+        n, capped = plan_num_blocks(_cfg())
+    assert (n, capped) == (64, False)
+
+
+def test_plan_budget_caps_request():
+    cfg = _cfg()
+    per = block_bytes(cfg)
+    n, capped = plan_num_blocks(cfg, model_resident_bytes=per,
+                                requested=100, budget=per * 11)
+    assert n == 10 and capped
+
+
+def test_plan_budget_autosizes_fit():
+    cfg = _cfg()
+    per = block_bytes(cfg)
+    n, capped = plan_num_blocks(cfg, requested=0, budget=per * 6 + 1)
+    assert n == 6 and not capped
+
+
+def test_plan_raises_when_budget_cannot_hold_two_blocks():
+    cfg = _cfg()
+    with pytest.raises(ValueError) as ei:
+        plan_num_blocks(cfg, model_resident_bytes=0, requested=8,
+                        budget=block_bytes(cfg))
+    assert "FLAGS_hbm_budget_bytes" in str(ei.value)
+
+
+def test_plan_reads_flags():
+    with _flags(kv_cache_blocks=9, hbm_budget_bytes=0):
+        n, _ = plan_num_blocks(_cfg())
+    assert n == 9
+
+
+# -- int8 residency quantization ---------------------------------------------
+
+
+def test_quantize_roundtrip_bounded_error():
+    rng = np.random.RandomState(0)
+    x = rng.randn(3, 4, 2, 8).astype(np.float32)
+    q, scale = quantize_kv(x)
+    assert np.asarray(q).dtype == np.int8
+    back = np.asarray(dequantize_kv(q, scale))
+    # symmetric per-[..., H] max-abs: error bounded by half a quant step
+    step = np.asarray(scale)[..., None]
+    assert np.all(np.abs(back - x) <= step * 0.5 + 1e-7)
+
+
+def test_quantize_all_zero_block_is_safe():
+    q, scale = quantize_kv(np.zeros((2, 4, 2, 8), np.float32))
+    assert not np.any(np.isnan(np.asarray(scale)))
+    assert np.all(np.asarray(dequantize_kv(q, scale)) == 0.0)
+
+
+# -- PagedKVCache ------------------------------------------------------------
+
+
+def test_cache_reserves_scratch_block_and_carry_shapes():
+    c = PagedKVCache(_cfg())
+    assert c.allocator.reserve == 1 and c.allocator.capacity == 7
+    k, v = c.carry()
+    assert k.shape == (2, 8, 4, 2, 8) and str(k.dtype) == "float32"
+    assert c.blocks_for_tokens(1) == 1
+    assert c.blocks_for_tokens(4) == 1
+    assert c.blocks_for_tokens(5) == 2
+    assert c.nbytes == block_bytes(c.config) * 8
+
+
+def test_cache_int8_carry_has_scales():
+    c = PagedKVCache(_cfg(dtype="int8"))
+    k, v, ks, vs = c.carry()
+    assert str(k.dtype) == "int8" and ks.shape == (2, 8, 4, 2)
+
+
+def test_replace_carry_arity_guard():
+    c = PagedKVCache(_cfg())
+    with pytest.raises(ValueError):
+        c.replace_carry(c.carry() + (c.carry()[0],))
+
+
+def test_engine_owned_bytes_tracks_live_caches():
+    gc.collect()
+    base = engine_owned_kv_bytes()
+    c = PagedKVCache(_cfg())
+    assert engine_owned_kv_bytes() == base + c.nbytes
+    del c
+    gc.collect()
+    assert engine_owned_kv_bytes() == base
+
+
+# -- MEM001 fold: engine-owned KV counted in the static peak -----------------
+
+
+def _fc_world():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [-1, 4])
+        y = fluid.data("y", [-1, 1])
+        p = layers.fc(layers.fc(x, size=8, act="relu"), size=1)
+        loss = layers.reduce_mean(layers.square_error_cost(p, y))
+        optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def test_mem001_counts_engine_owned_kv_blocks():
+    main, startup, loss = _fc_world()
+    gc.collect()
+    cache = PagedKVCache(_cfg())
+    rep = world_analysis.verify_world(main, startup, 1, batch=4,
+                                      feed_names=["x", "y"],
+                                      fetch_names=[loss.name])
+    est = rep.hbm[0]
+    assert est["kv_cache_bytes"] >= cache.nbytes
+    assert est["peak_bytes"] >= (est["resident_bytes"] + est["feed_bytes"]
+                                 + est["transient_peak_bytes"]
+                                 + cache.nbytes)
+    hits = rep.by_rule("MEM001")
+    assert hits and any("kv_cache" in h.message for h in hits)
+    # without a live cache the fold is zero and the message stays clean
+    del cache
+    gc.collect()
+    rep2 = world_analysis.verify_world(main, startup, 1, batch=4,
+                                       feed_names=["x", "y"],
+                                       fetch_names=[loss.name])
+    assert rep2.hbm[0]["kv_cache_bytes"] == 0
+    assert all("kv_cache" not in h.message for h in rep2.by_rule("MEM001"))
+
+
+def test_mem003_suggests_shrinking_kv_pool():
+    main, startup, loss = _fc_world()
+    cache = PagedKVCache(_cfg())
+    with _flags(hbm_budget_bytes=64):
+        rep = world_analysis.verify_world(main, startup, 1, batch=4,
+                                          feed_names=["x", "y"])
+    hits = rep.by_rule("MEM003")
+    assert hits, rep.format()
+    assert "FLAGS_kv_cache_blocks" in hits[0].suggestion
+    del cache

@@ -20,6 +20,16 @@ QPS under load, server-side mean batch fill, shed rate, and the dropped
 count (requests no live endpoint answered).
 --assert-no-drops makes a nonzero dropped count a nonzero exit — the CI
 SIGKILL leg's invariant that elastic shrink loses no admitted requests.
+
+When the model's ``__spec__`` says ``type: decode`` the generator
+switches to autoregressive traffic: prompts of --prompt-mix lengths,
+--max-new generated tokens each, fired through ``client.generate``
+(streaming, so TTFT and inter-token latency are measured at the client).
+The report gains token-level serving metrics: ``tokens_per_sec``
+(aggregate generated-token throughput), ``ttft_ms_p50/p99`` and
+``itl_ms_p50/p99``, plus the engine's batching mode — run once against
+a ``--decode-mode token`` server and once against ``request`` to
+measure the continuous-batching win on the same traffic.
 """
 
 import argparse
@@ -72,6 +82,18 @@ def main(argv=None):
     ap.add_argument("--assert-no-drops", action="store_true",
                     help="exit 1 if any request was dropped (all "
                     "endpoint attempts failed)")
+    ap.add_argument("--prompt-mix", default="2,4,8",
+                    help="decode traffic: prompt lengths sampled "
+                    "uniformly (mixed lengths exercise the shared "
+                    "bucketed executable)")
+    ap.add_argument("--max-new", type=int, default=8,
+                    help="decode traffic: generated tokens per request")
+    ap.add_argument("--no-stream", action="store_true",
+                    help="decode traffic: skip per-token streaming "
+                    "(TTFT/ITL then come from the server's phases)")
+    ap.add_argument("--retry-shed", type=int, default=0,
+                    help="resubmit a shed request up to N times after "
+                    "its retry_after_ms hint")
     args = ap.parse_args(argv)
 
     from paddle_tpu.serving import ServingClient
@@ -82,17 +104,33 @@ def main(argv=None):
                            endpoints_file=args.endpoints_file,
                            tenant=args.tenant)
     spec = client.spec(args.model)
+    decode = spec.get("type") == "decode"
     mix = [int(b) for b in args.batch_mix.split(",") if b]
+    pmix = [int(b) for b in args.prompt_mix.split(",") if b]
     rng = random.Random(args.seed)
 
     lock = threading.Lock()
     latencies, statuses = [], {}
     phase_samples = {"queue_wait_ms": [], "execute_ms": [], "wire_ms": []}
+    ttfts, itls, tokens_out = [], [], [0]
     threads = []
 
-    def fire(rows):
-        r = client.infer(args.model, synth_feeds(spec, rows),
-                         deadline_ms=args.deadline_ms)
+    def run_once(rows, prompt):
+        if not decode:
+            return client.infer(args.model, synth_feeds(spec, rows),
+                                deadline_ms=args.deadline_ms)
+        return client.generate(args.model, prompt,
+                               max_new_tokens=args.max_new,
+                               stream=not args.no_stream,
+                               deadline_ms=args.deadline_ms)
+
+    def fire(rows, prompt):
+        r = run_once(rows, prompt)
+        retries = args.retry_shed
+        while r.status == "shed" and retries > 0:
+            time.sleep(max(r.retry_after_ms, 1.0) / 1e3)
+            retries -= 1
+            r = run_once(rows, prompt)
         with lock:
             statuses[r.status] = statuses.get(r.status, 0) + 1
             if r.ok:
@@ -101,15 +139,28 @@ def main(argv=None):
                     v = r.phases.get(ph)
                     if v is not None:
                         xs.append(float(v))
+                if decode:
+                    tokens_out[0] += len(r.outputs.get("tokens", ()))
+                    # client-observed (wire-inclusive) when streaming,
+                    # server-side phase attribution otherwise
+                    ttft = r.phases.get("client_ttft_ms",
+                                        r.phases.get("ttft_ms"))
+                    if ttft is not None:
+                        ttfts.append(float(ttft))
+                    itls.extend(float(g) for g in r.phases.get(
+                        "client_itl_ms_samples",
+                        r.phases.get("itl_ms_samples", [])))
 
     t_start = time.perf_counter()
     next_at = t_start
     for _ in range(args.requests):
         next_at += rng.expovariate(args.qps)
+        prompt = [rng.randrange(int(spec.get("vocab", 2)))
+                  for _ in range(rng.choice(pmix))] if decode else None
         delay = next_at - time.perf_counter()
         if delay > 0:
             time.sleep(delay)
-        t = threading.Thread(target=fire, args=(rng.choice(mix),),
+        t = threading.Thread(target=fire, args=(rng.choice(mix), prompt),
                              daemon=True)
         t.start()
         threads.append(t)
@@ -157,6 +208,18 @@ def main(argv=None):
         "dropped": dropped,
         "failovers": client.failovers,
     }
+    if decode:
+        report.update({
+            "decode_mode": spec.get("mode"),
+            "max_new_tokens": args.max_new,
+            "tokens_generated": tokens_out[0],
+            "tokens_per_sec": round(tokens_out[0] / wall_s, 2)
+            if wall_s else 0.0,
+            "ttft_ms_p50": round(percentile(ttfts, 0.50), 3),
+            "ttft_ms_p99": round(percentile(ttfts, 0.99), 3),
+            "itl_ms_p50": round(percentile(itls, 0.50), 3),
+            "itl_ms_p99": round(percentile(itls, 0.99), 3),
+        })
     with open(args.out, "w") as f:
         json.dump(report, f, indent=1)
     print(json.dumps(report), flush=True)

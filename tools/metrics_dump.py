@@ -103,6 +103,12 @@ def main(argv=None):
                     "depth / qps / fleet gauges, request / shed / timeout "
                     "/ batch counters, latency + batch-fill histograms "
                     "(serving/engine.py + fleet.py)")
+    ap.add_argument("--decode", action="store_true", dest="decode_only",
+                    help="show only autoregressive-decode metrics: paged "
+                    "KV pool counters/gauges (kv_block_*, kv_blocks_in_use"
+                    ", kv_cache_bytes, kv_block_evictions_total), "
+                    "serving_decode_* / serving_tokens_generated_total, "
+                    "and the decode_batch_occupancy histogram")
     ap.add_argument("--tracing", action="store_true", dest="tracing_only",
                     help="show only distributed-tracing health metrics: "
                     "tracing_records_total{kind} and "
@@ -137,6 +143,11 @@ def main(argv=None):
         snap = _filter_snap(snap, "pallas_kernel_")
     if args.serving_only:
         snap = _filter_snap(snap, "serving_")
+    if args.decode_only:
+        snap = _filter_snap(snap, ("kv_block", "kv_cache_",
+                                   "kv_blocks_in_use", "serving_decode_",
+                                   "serving_tokens_", "serving_abort_",
+                                   "decode_batch_occupancy"))
     if args.tracing_only:
         snap = _filter_snap(snap, "tracing_")
     if args.lint_only:

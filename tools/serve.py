@@ -19,6 +19,12 @@ Usage:
     # helper for smoke tests: save a tiny fc inference model and exit
     python tools/serve.py --save-demo-model /tmp/model
 
+    # autoregressive decode serving: a --model DIR holding a
+    # save_decoder() bundle (decoder.json + params.npz) is routed to the
+    # paged-KV DecodeEngine instead; helper to create one:
+    python tools/serve.py --save-demo-decoder /tmp/dec
+    python tools/serve.py --model toy=/tmp/dec --decode-buckets 4,8
+
 The prewarm manifest prints one JSON line (PREWARM {...}) so harnesses
 can assert every bucket exists before traffic starts; "READY port=N" on
 stdout marks the server accepting requests.
@@ -52,6 +58,18 @@ def save_demo_model(dirname, in_dim=8, out_dim=4):
     return dirname
 
 
+def save_demo_decoder(dirname, vocab=31, layers=2, heads=2, head_dim=8,
+                      max_seq=48, seed=7):
+    """Tiny decode model via serving.decode_model.save_decoder."""
+    from paddle_tpu.serving.decode_model import (DecoderConfig,
+                                                 init_decoder_params,
+                                                 save_decoder)
+
+    cfg = DecoderConfig(vocab=vocab, layers=layers, heads=heads,
+                        head_dim=head_dim, max_seq=max_seq)
+    return save_decoder(dirname, cfg, init_decoder_params(cfg, seed=seed))
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", action="append", default=[],
@@ -78,10 +96,27 @@ def main(argv=None):
                     "(client failover)")
     ap.add_argument("--save-demo-model", metavar="DIR", default=None,
                     help="write a tiny fc inference model to DIR and exit")
+    ap.add_argument("--save-demo-decoder", metavar="DIR", default=None,
+                    help="write a tiny autoregressive decoder to DIR "
+                    "and exit")
+    ap.add_argument("--decode-buckets", default=None,
+                    help="decode lane buckets, e.g. 4,8 "
+                    "(default FLAGS_serving_decode_buckets)")
+    ap.add_argument("--decode-mode", default=None,
+                    choices=("token", "request"),
+                    help="token-level continuous batching (default) or "
+                    "the request-level baseline")
+    ap.add_argument("--kv-blocks", type=int, default=None,
+                    help="paged KV pool size in blocks "
+                    "(default FLAGS_kv_cache_blocks / HBM budget)")
     args = ap.parse_args(argv)
 
     if args.save_demo_model:
         print("saved demo model:", save_demo_model(args.save_demo_model))
+        return 0
+    if args.save_demo_decoder:
+        print("saved demo decoder:",
+              save_demo_decoder(args.save_demo_decoder))
         return 0
 
     import paddle_tpu as fluid
@@ -95,14 +130,27 @@ def main(argv=None):
     if not args.model:
         ap.error("at least one --model NAME=DIR is required")
 
+    from paddle_tpu.serving import DecodeEngine
+    from paddle_tpu.serving.decode_model import is_decoder_dir
+
     engine = ServingEngine(buckets=args.buckets)
+    decode_engine = None
     for spec in args.model:
         name, _, dirname = spec.partition("=")
         if not dirname:
             ap.error("--model wants NAME=DIR, got %r" % spec)
-        engine.add_model(name, dirname)
+        if is_decoder_dir(dirname):
+            if decode_engine is None:
+                decode_engine = DecodeEngine(buckets=args.decode_buckets,
+                                             mode=args.decode_mode)
+            decode_engine.add_model(name, dirname,
+                                    kv_blocks=args.kv_blocks)
+        else:
+            engine.add_model(name, dirname)
 
     manifest = engine.prewarm()
+    if decode_engine is not None:
+        manifest.update(decode_engine.prewarm())
     print("PREWARM " + json.dumps(manifest), flush=True)
     if args.prewarm_only:
         return 0
@@ -113,7 +161,8 @@ def main(argv=None):
     else:
         endpoints, port = None, args.port
 
-    server = ServingServer(engine, port=port, rank=args.rank).start()
+    server = ServingServer(engine, port=port, rank=args.rank,
+                           decode_engine=decode_engine).start()
     fleet = None
     if endpoints:
         fleet = ServingFleet(args.rank, endpoints, server,
