@@ -305,9 +305,15 @@ class CarriedStepFn:
     compiles under decode load" stays provable from the same counter the
     Program path uses."""
 
-    def __init__(self, fn, donate_argnums=(0,), key_parts=None):
+    def __init__(self, fn, donate_argnums=(0,), key_parts=None, name=None):
         self._jfn = jax.jit(fn, donate_argnums=donate_argnums)
         self._key_parts = key_parts
+        # labels the hit/miss counters (fn=<name>) so a serving stack
+        # running several step kinds per model — decode, draft rollout,
+        # speculative verify — can prove flat misses per kind;
+        # counter_total() still sums across the labels, so the
+        # zero-runtime-compile asserts stay one prefix sum
+        self._name = name
         self._compiled = {}
 
     @staticmethod
@@ -345,7 +351,8 @@ class CarriedStepFn:
         self._compiled[sig] = compiled if compiled is not None \
             else self._jfn
         if _telemetry.enabled():
-            _telemetry.inc("executor_cache_miss_total")
+            labels = {"fn": self._name} if self._name else {}
+            _telemetry.inc("executor_cache_miss_total", **labels)
         return {"source": cstats["source"],
                 "compile_ms": cstats["compile_ms"], "key": disk_key}
 
@@ -356,7 +363,8 @@ class CarriedStepFn:
             self.warmup(*args)
             fn = self._compiled[sig]
         elif _telemetry.enabled():
-            _telemetry.inc("executor_cache_hit_total")
+            labels = {"fn": self._name} if self._name else {}
+            _telemetry.inc("executor_cache_hit_total", **labels)
             _telemetry.inc("executor_steps_total")
         return fn(*args)
 

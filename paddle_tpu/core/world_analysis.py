@@ -697,11 +697,20 @@ def check_memory(program, rep, rank=None, budget=None, batch=1,
 
         _kvmod = sys.modules.get("paddle_tpu.serving.kv_cache")
         kv_bytes = int(_kvmod.engine_owned_kv_bytes()) if _kvmod else 0
+        dec_bytes = int(_kvmod.engine_owned_resident_bytes()) \
+            if _kvmod else 0
     except Exception:
         kv_bytes = 0
+        dec_bytes = 0
     est["kv_cache_bytes"] = kv_bytes
     est["peak_bytes"] += kv_bytes
+    # decode-model weights (target + speculative draft params) are
+    # engine-resident the same way the KV pools are
+    est["decoder_resident_bytes"] = dec_bytes
+    est["peak_bytes"] += dec_bytes
     kv_note = " + kv_cache %s" % _fmt_mb(kv_bytes) if kv_bytes else ""
+    if dec_bytes:
+        kv_note += " + decoder_params %s" % _fmt_mb(dec_bytes)
     rep.add(INFO, "MEM001",
             "static per-replica peak ~%s (resident %s + feeds %s + "
             "transient %s%s, batch %d)"

@@ -236,6 +236,17 @@ _DEFAULTS = {
     # steer the K/V block DMA so the gathered [B, S, H, D] intermediate
     # never materializes in HBM.  Probe-gated like every PR-9 kernel.
     "FLAGS_use_pallas_paged_attention": False,
+    # draft-model speculative decoding on the paged decode path
+    # (DecodeEngine): 0 = off; k > 0 runs the model's bundled draft
+    # decoder (save_decoder(draft=...) / <model_dir>/draft) k tokens
+    # ahead per sequence through its own paged KV lanes, then verifies
+    # all k+1 positions with ONE bucketed multi-token target step.
+    # Greedy verification accepts the longest draft prefix matching the
+    # target argmax chain, so output stays bitwise-equal to k=0;
+    # rollback is free (context_lens truncation + same-iteration block
+    # free).  Requires a draft bundle — a model without one decodes
+    # non-speculatively regardless of k.
+    "FLAGS_speculative_k": 0,
     # accepted no-ops (XLA/PJRT owns these concerns; benchmark's per-op
     # sync has no meaning under whole-block compilation)
     "FLAGS_benchmark": False,

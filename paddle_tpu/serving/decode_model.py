@@ -41,8 +41,9 @@ from ..pallas_kernels.paged_attention import masked_attention, \
 from . import kv_cache as _kv
 
 __all__ = ["DecoderConfig", "init_decoder_params", "save_decoder",
-           "load_decoder", "is_decoder_dir", "make_paged_step",
-           "make_unpaged_step", "unpaged_generate"]
+           "load_decoder", "is_decoder_dir", "has_draft", "load_draft",
+           "truncate_decoder", "make_paged_step", "make_paged_step_multi",
+           "make_draft_rollout", "make_unpaged_step", "unpaged_generate"]
 
 
 class DecoderConfig:
@@ -90,13 +91,22 @@ def init_decoder_params(cfg, seed=0):
     return p
 
 
-def save_decoder(dirname, cfg, params):
+def save_decoder(dirname, cfg, params, draft=None):
     """params.npz + decoder.json under `dirname` (tools/serve.py loads
-    decode models from such a dir)."""
+    decode models from such a dir).  ``draft`` — an optional
+    (DecoderConfig, params) pair — lands as a nested bundle under
+    ``<dirname>/draft`` so the speculative-decode draft ships beside its
+    target and the two can never drift apart."""
     os.makedirs(dirname, exist_ok=True)
     np.savez(os.path.join(dirname, "params.npz"), **params)
     with open(os.path.join(dirname, "decoder.json"), "w") as fp:
         json.dump(cfg.to_dict(), fp, indent=1, sort_keys=True)
+    if draft is not None:
+        dcfg, dparams = draft
+        if dcfg.vocab != cfg.vocab:
+            raise ValueError("draft vocab %d != target vocab %d"
+                             % (dcfg.vocab, cfg.vocab))
+        save_decoder(os.path.join(dirname, "draft"), dcfg, dparams)
     return dirname
 
 
@@ -110,6 +120,33 @@ def load_decoder(dirname):
 
 def is_decoder_dir(dirname):
     return os.path.exists(os.path.join(dirname, "decoder.json"))
+
+
+def has_draft(dirname):
+    return is_decoder_dir(os.path.join(dirname, "draft"))
+
+
+def load_draft(dirname):
+    """The bundled draft decoder, or None when the target ships alone."""
+    return load_decoder(os.path.join(dirname, "draft")) \
+        if has_draft(dirname) else None
+
+
+def truncate_decoder(cfg, params, layers=1):
+    """A cheap draft from a target: keep the first ``layers`` transformer
+    layers plus the embeddings / final LN / head verbatim.  With the
+    residual stream dominated by the embedding, the truncated argmax
+    tracks the full model's closely — a distillation-free draft for
+    demos and smokes (real deployments train one)."""
+    layers = min(int(layers), cfg.layers)
+    dcfg = DecoderConfig(vocab=cfg.vocab, layers=layers, heads=cfg.heads,
+                         head_dim=cfg.head_dim, ffn=cfg.ffn,
+                         max_seq=cfg.max_seq)
+    keep = {"embed", "pos_embed", "lnf_g", "lnf_b", "head"}
+    dparams = {k: np.asarray(v) for k, v in params.items()
+               if k in keep or (k.startswith("l")
+                                and int(k[1:k.index("_")]) < layers)}
+    return dcfg, dparams
 
 
 # -- shared forward ----------------------------------------------------------
@@ -197,6 +234,80 @@ def make_paged_step(cfg, kv_config):
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         carry = (k_c, v_c, k_s, v_s) if int8 else (k_c, v_c)
         return carry, nxt, logits
+
+    return step
+
+
+# -- multi-token paged step (speculative verify / prefill chunks) ------------
+
+def make_paged_step_multi(cfg, kv_config, width):
+    """-> step(kv_carry, params, tok, pos, block_tables, context_lens)
+    scoring ``width`` query tokens per lane in ONE call: tok/pos/
+    context_lens are [B, width], block_tables stays [B, MAXB]; returns
+    (new_kv_carry, next_tokens [B, width], logits [B, width, vocab]).
+
+    The body is the single-token step composed ``width`` times inside
+    one jit — each position runs the IDENTICAL write-then-attend op
+    sequence at identical shapes, which is what keeps a speculative
+    verify's argmax chain bitwise-equal to ``width`` non-speculative
+    steps (the acceptance bar the spec parity tests assert).  Column j
+    of pos/context_lens belongs to query j; lanes feeding fewer than
+    ``width`` real tokens freeze their later columns' lens so the junk
+    columns' (discarded) logits never read an unwritten position, and
+    their writes land beyond every lens — overwritten before any later
+    step can attend to them."""
+    base = make_paged_step(cfg, kv_config)
+
+    def step(kv_carry, params, tok, pos, block_tables, context_lens):
+        tok = tok.astype(jnp.int32)
+        pos = pos.astype(jnp.int32)
+        context_lens = context_lens.astype(jnp.int32)
+        nxts, logits = [], []
+        for j in range(width):
+            kv_carry, nxt, lg = base(kv_carry, params, tok[:, j],
+                                     pos[:, j], block_tables,
+                                     context_lens[:, j])
+            nxts.append(nxt)
+            logits.append(lg)
+        return kv_carry, jnp.stack(nxts, axis=1), jnp.stack(logits, axis=1)
+
+    return step
+
+
+# -- draft rollout (speculative proposals) -----------------------------------
+
+def make_draft_rollout(cfg, kv_config, k):
+    """-> step(kv_carry, params, tok, pos, block_tables, context_lens,
+    max_pos) proposing ``k`` tokens per lane in ONE call: feed tok[b] at
+    pos[b], take the argmax, feed it at pos[b]+1, ... — the draft's
+    greedy chain, writing its K/V through the draft's own paged lanes as
+    it goes.  tok/pos/context_lens/max_pos are [B] (context_lens 0 marks
+    an idle lane, whose writes land in the scratch block and whose lens
+    stays frozen at 0).  ``max_pos`` clamps the chain's write position:
+    a lane whose sequence budget ends before p+k-1 keeps re-writing its
+    final reserved position instead of touching blocks it never
+    reserved — those clamped writes sit beyond the accepted
+    context_lens, so they are re-written before anything attends them.
+    Returns (new_kv_carry, proposals [B, k])."""
+    base = make_paged_step(cfg, kv_config)
+
+    def step(kv_carry, params, tok, pos, block_tables, context_lens,
+             max_pos):
+        tok = tok.astype(jnp.int32)
+        pos = pos.astype(jnp.int32)
+        context_lens = context_lens.astype(jnp.int32)
+        max_pos = max_pos.astype(jnp.int32)
+        live = context_lens > 0
+        props = []
+        for j in range(k):
+            kv_carry, nxt, _lg = base(
+                kv_carry, params, tok,
+                jnp.minimum(pos + j, max_pos), block_tables,
+                jnp.where(live,
+                          jnp.minimum(context_lens + j, max_pos + 1), 0))
+            props.append(nxt)
+            tok = nxt
+        return kv_carry, jnp.stack(props, axis=1)
 
     return step
 

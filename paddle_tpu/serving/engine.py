@@ -537,7 +537,8 @@ class _DecodeSeq:
     prompt position every step's argmax is a generated token."""
 
     __slots__ = ("pending", "prompt", "max_new", "eos_id", "on_token",
-                 "blocks", "table", "n_fed", "next_tok", "out",
+                 "blocks", "table", "draft_blocks", "draft_table",
+                 "n_fed", "next_tok", "out",
                  "t_admit", "t_first", "token_times", "admit_seq",
                  "aborted")
 
@@ -549,6 +550,8 @@ class _DecodeSeq:
         self.on_token = on_token
         self.blocks = []                      # allocator block ids held
         self.table = np.full(maxb, -1, np.int32)
+        self.draft_blocks = []                # speculative draft KV lanes
+        self.draft_table = np.full(maxb, -1, np.int32)
         self.n_fed = 0
         self.next_tok = self.prompt[0]
         self.out = []
@@ -562,12 +565,18 @@ class _DecodeSeq:
     def in_prefill(self):
         return self.n_fed < len(self.prompt)
 
+    @property
+    def total(self):
+        return len(self.prompt) + self.max_new
+
     def reset_for_recompute(self):
         """Preempted: blocks were freed; replay the prompt from scratch.
         Greedy decode is deterministic, so re-emitted tokens are
         identical and stream chunks republish byte-for-byte."""
         self.blocks = []
         self.table.fill(-1)
+        self.draft_blocks = []
+        self.draft_table.fill(-1)
         self.n_fed = 0
         self.next_tok = self.prompt[0]
         self.out = []
@@ -577,7 +586,12 @@ class _DecodeSeq:
 
 class _DecodeModel:
     __slots__ = ("name", "cfg", "params", "kv_config", "cache", "stepfn",
-                 "maxb", "step_ms")
+                 "maxb", "step_ms", "__weakref__",
+                 # speculative decode (spec_k == 0 means off): the draft
+                 # decoder runs k tokens ahead through its own paged pool,
+                 # then verifyfn scores all k+1 positions in one target call
+                 "spec_k", "draft_cfg", "draft_params", "draft_kv_config",
+                 "draft_cache", "rolloutfn", "ingestfn", "verifyfn")
 
     def __init__(self, name, cfg, params, kv_config, cache, stepfn):
         self.name = name
@@ -588,6 +602,14 @@ class _DecodeModel:
         self.stepfn = stepfn        # CarriedStepFn over make_paged_step
         self.maxb = -(-cfg.max_seq // kv_config.block_size)
         self.step_ms = 0.0          # EWMA of one decode step
+        self.spec_k = 0
+        self.draft_cfg = None
+        self.draft_params = None
+        self.draft_kv_config = None
+        self.draft_cache = None
+        self.rolloutfn = None       # draft: k chained proposals per lane
+        self.ingestfn = None        # draft: multi-token catch-up writes
+        self.verifyfn = None        # target: [B, k+1] multi-token step
 
 
 class DecodeEngine:
@@ -643,11 +665,21 @@ class DecodeEngine:
 
     # -- registry ------------------------------------------------------------
 
-    def add_model(self, name, source, kv_blocks=None):
+    def add_model(self, name, source, kv_blocks=None, draft=None,
+                  speculative_k=None):
         """Register a decode model: `source` is a save_decoder() dir or a
         (DecoderConfig, params) pair.  KV pool size comes from
         kv_blocks / FLAGS_kv_cache_blocks, capped by
-        FLAGS_hbm_budget_bytes net of the weights' footprint."""
+        FLAGS_hbm_budget_bytes net of the weights' footprint.
+
+        ``draft`` is an optional (DecoderConfig, params) draft decoder
+        (a dir `source` auto-loads its bundled ``<dir>/draft``);
+        ``speculative_k`` (default FLAGS_speculative_k) > 0 with a draft
+        present turns on speculative decoding: the draft gets its own
+        paged pool with the SAME block count as the target (equal token
+        capacity keeps the two allocators in lockstep), and three AOT
+        step fns replace the single-token one — verify ([B, k+1] target),
+        rollout (k chained draft proposals), ingest (draft catch-up)."""
         import jax.numpy as jnp
 
         from . import decode_model as _dm
@@ -656,32 +688,91 @@ class DecodeEngine:
 
         if isinstance(source, str):
             cfg, params = _dm.load_decoder(source)
+            if draft is None:
+                draft = _dm.load_draft(source)
         else:
             cfg, params = source
+        k = int(speculative_k if speculative_k is not None
+                else _flag("speculative_k") or 0)
+        if draft is None:
+            k = 0   # no draft bundle -> non-speculative regardless of k
         resident = sum(int(np.asarray(v).nbytes) for v in params.values())
+        draft_resident = 0
+        if k > 0:
+            dcfg, dparams = draft
+            if dcfg.vocab != cfg.vocab:
+                raise ValueError("draft vocab %d != target vocab %d"
+                                 % (dcfg.vocab, cfg.vocab))
+            if dcfg.max_seq != cfg.max_seq:
+                raise ValueError("draft max_seq %d != target max_seq %d "
+                                 "(block tables must line up)"
+                                 % (dcfg.max_seq, cfg.max_seq))
+            draft_resident = sum(int(np.asarray(v).nbytes)
+                                 for v in dparams.values())
         kv_config = _kvc.KVCacheConfig(
             layers=cfg.layers, heads=cfg.heads, head_dim=cfg.head_dim,
             block_size=int(_flag("kv_block_size")),
             num_blocks=2,  # placeholder; plan_num_blocks decides below
             dtype=str(_flag("kv_cache_dtype")))
-        n, capped = _kvc.plan_num_blocks(kv_config,
-                                         model_resident_bytes=resident,
-                                         requested=kv_blocks)
+        n, capped = _kvc.plan_num_blocks(
+            kv_config, model_resident_bytes=resident + draft_resident,
+            requested=kv_blocks)
         kv_config.num_blocks = n
         cache = _kvc.PagedKVCache(kv_config)
-        jparams = {k: jnp.asarray(v) for k, v in params.items()}
+        jparams = {key: jnp.asarray(v) for key, v in params.items()}
         stepfn = CarriedStepFn(
             _dm.make_paged_step(cfg, kv_config), donate_argnums=(0,),
+            name="decode_step",
             key_parts={"kind": "decode_step", "model": name,
                        "cfg": cfg.to_dict(),
                        "kv": {"block_size": kv_config.block_size,
                               "num_blocks": kv_config.num_blocks,
                               "dtype": kv_config.dtype},
                        "pallas": bool(_flag("use_pallas_paged_attention"))})
-        self._models[name] = _DecodeModel(name, cfg, jparams, kv_config,
-                                          cache, stepfn)
+        entry = _DecodeModel(name, cfg, jparams, kv_config, cache, stepfn)
+        if k > 0:
+            # draft pool mirrors the target's block COUNT (draft blocks
+            # are strictly smaller at fewer layers), so any sequence the
+            # target pool can hold, the draft pool can shadow; the budget
+            # plan above already counted both param sets, and MEM001
+            # reports the exact combined pool bytes afterwards
+            draft_kv = _kvc.KVCacheConfig(
+                layers=dcfg.layers, heads=dcfg.heads,
+                head_dim=dcfg.head_dim, block_size=kv_config.block_size,
+                num_blocks=n, dtype=kv_config.dtype)
+            base_parts = {"model": name, "kv": {
+                "block_size": kv_config.block_size, "num_blocks": n,
+                "dtype": kv_config.dtype},
+                "pallas": bool(_flag("use_pallas_paged_attention"))}
+            entry.spec_k = k
+            entry.draft_cfg = dcfg
+            entry.draft_params = {key: jnp.asarray(v)
+                                  for key, v in dparams.items()}
+            entry.draft_kv_config = draft_kv
+            entry.draft_cache = _kvc.PagedKVCache(draft_kv)
+            entry.verifyfn = CarriedStepFn(
+                _dm.make_paged_step_multi(cfg, kv_config, k + 1),
+                donate_argnums=(0,), name="decode_verify",
+                key_parts=dict(base_parts, kind="decode_verify",
+                               cfg=cfg.to_dict(), width=k + 1))
+            entry.rolloutfn = CarriedStepFn(
+                _dm.make_draft_rollout(dcfg, draft_kv, k),
+                donate_argnums=(0,), name="draft_rollout",
+                key_parts=dict(base_parts, kind="draft_rollout",
+                               cfg=dcfg.to_dict(), k=k))
+            entry.ingestfn = CarriedStepFn(
+                _dm.make_paged_step_multi(dcfg, draft_kv, k + 1),
+                donate_argnums=(0,), name="draft_ingest",
+                key_parts=dict(base_parts, kind="draft_ingest",
+                               cfg=dcfg.to_dict(), width=k + 1))
+        # engine-owned resident weights (target + draft) fold into the
+        # MEM001 static peak beside the KV pool bytes
+        _kvc.register_resident_bytes(entry, resident + draft_resident)
+        self._models[name] = entry
         _tm.event("decode_model_added", model=name, blocks=n,
-                  budget_capped=capped, kv_bytes=cache.nbytes)
+                  budget_capped=capped, kv_bytes=cache.nbytes,
+                  speculative_k=k,
+                  draft_kv_bytes=entry.draft_cache.nbytes if k else 0)
         return self._models[name]
 
     def models(self):
@@ -689,12 +780,18 @@ class DecodeEngine:
 
     def spec(self, model):
         m = self._models[model]
-        return {"model": model, "type": "decode",
-                "vocab": m.cfg.vocab, "max_seq": m.cfg.max_seq,
-                "buckets": list(self.buckets), "mode": self.mode,
-                "block_size": m.kv_config.block_size,
-                "num_blocks": m.kv_config.num_blocks,
-                "kv_dtype": m.kv_config.dtype}
+        out = {"model": model, "type": "decode",
+               "vocab": m.cfg.vocab, "max_seq": m.cfg.max_seq,
+               "buckets": list(self.buckets), "mode": self.mode,
+               "block_size": m.kv_config.block_size,
+               "num_blocks": m.kv_config.num_blocks,
+               "kv_dtype": m.kv_config.dtype,
+               "speculative_k": m.spec_k}
+        if m.spec_k > 0:
+            out["draft"] = {"layers": m.draft_cfg.layers,
+                            "num_blocks": m.draft_kv_config.num_blocks,
+                            "kv_bytes": m.draft_cache.nbytes}
+        return out
 
     # -- AOT bucket prewarm --------------------------------------------------
 
@@ -708,6 +805,41 @@ class DecodeEngine:
         for name, m in self._models.items():
             per = {}
             for b in self.buckets:
+                if m.spec_k > 0:
+                    # speculation replaces the single-token step with
+                    # three fns; warm each per (model, bucket, k)
+                    w = m.spec_k + 1
+                    warms = {
+                        "verify": m.verifyfn.warmup(
+                            m.cache.carry(), m.params,
+                            np.zeros((b, w), np.int32),
+                            np.zeros((b, w), np.int32),
+                            np.full((b, m.maxb), -1, np.int32),
+                            np.zeros((b, w), np.int32)),
+                        "draft_rollout": m.rolloutfn.warmup(
+                            m.draft_cache.carry(), m.draft_params,
+                            np.zeros(b, np.int32), np.zeros(b, np.int32),
+                            np.full((b, m.maxb), -1, np.int32),
+                            np.zeros(b, np.int32), np.zeros(b, np.int32)),
+                        "draft_ingest": m.ingestfn.warmup(
+                            m.draft_cache.carry(), m.draft_params,
+                            np.zeros((b, w), np.int32),
+                            np.zeros((b, w), np.int32),
+                            np.full((b, m.maxb), -1, np.int32),
+                            np.zeros((b, w), np.int32)),
+                    }
+                    per[b] = {}
+                    for kind, got in warms.items():
+                        per[b][kind] = {
+                            "source": got["source"],
+                            "compile_ms": round(got["compile_ms"], 3)}
+                        _tm.inc("serving_prewarm_total", model=name,
+                                source=got["source"])
+                        _tm.event("serving_prewarm", model=name, bucket=b,
+                                  source=got["source"], decode=True,
+                                  fn=kind, k=m.spec_k,
+                                  ms=round(got["compile_ms"], 3))
+                    continue
                 got = m.stepfn.warmup(*self._step_args(
                     m, b, np.zeros(b, np.int32), np.zeros(b, np.int32),
                     np.full((b, m.maxb), -1, np.int32),
@@ -778,6 +910,13 @@ class DecodeEngine:
                 "error",
                 error="sequence needs %d KV blocks, pool holds %d"
                       % (need_cap, m.cache.allocator.capacity)))
+        if m.spec_k > 0 and m.draft_cache.blocks_for_tokens(total) > \
+                m.draft_cache.allocator.capacity:
+            return _early(InferReply(
+                "error",
+                error="sequence needs %d draft KV blocks, pool holds %d"
+                      % (m.draft_cache.blocks_for_tokens(total),
+                         m.draft_cache.allocator.capacity)))
         _tm.inc("serving_decode_requests_total", model=model, tenant=tenant)
         seq = _DecodeSeq(req, prompt_ids, max_new_tokens, eos_id, on_token,
                          m.maxb)
@@ -788,19 +927,24 @@ class DecodeEngine:
                     "shed", error="queue full (%d)" % len(self._waiting),
                     retry_after_ms=self._retry_after_ms(m)))
             # admission-time KV pressure: blocks already promised to the
-            # queue ahead plus this prompt must fit the free pool, else
-            # shed with a drain-time hint instead of queueing behind an
-            # out-of-memory head-of-line
+            # queue ahead plus this prompt must fit the free pool — BOTH
+            # pools when speculating (the draft shadows every sequence) —
+            # else shed with a drain-time hint instead of queueing behind
+            # an out-of-memory head-of-line
             promised = sum(
                 m.cache.blocks_for_tokens(len(s.prompt))
                 for s in self._waiting if s.pending.model == model)
-            if promised + m.cache.blocks_for_tokens(len(prompt_ids)) \
-                    > m.cache.allocator.num_free:
+            need_now = promised + m.cache.blocks_for_tokens(len(prompt_ids))
+            free_now = m.cache.allocator.num_free
+            if m.spec_k > 0:
+                # equal block geometry -> the same block count applies;
+                # the binding pool is whichever has fewer free blocks
+                free_now = min(free_now, m.draft_cache.allocator.num_free)
+            if need_now > free_now:
                 _tm.inc("serving_shed_total", reason="kv_oom")
                 return _early(InferReply(
                     "shed",
-                    error="KV pool exhausted (%d free blocks)"
-                          % m.cache.allocator.num_free,
+                    error="KV pool exhausted (%d free blocks)" % free_now,
                     retry_after_ms=self._retry_after_ms(m)))
             req.span = _tr.start_span(
                 "serving.request", model=model, tenant=tenant,
@@ -875,10 +1019,15 @@ class DecodeEngine:
         return self._models[seq.pending.model]
 
     def _free_blocks(self, seq):
+        m = self._model_of(seq)
         if seq.blocks:
-            self._model_of(seq).cache.allocator.free(seq.blocks)
+            m.cache.allocator.free(seq.blocks)
             seq.blocks = []
             seq.table.fill(-1)
+        if seq.draft_blocks:
+            m.draft_cache.allocator.free(seq.draft_blocks)
+            seq.draft_blocks = []
+            seq.draft_table.fill(-1)
 
     def _finish(self, seq, reply):
         r = seq.pending
@@ -938,8 +1087,10 @@ class DecodeEngine:
             if self._active and self._active[0].pending.model != \
                     s.pending.model:
                 break  # one model per step batch
-            if m.cache.blocks_for_tokens(len(s.prompt)) > \
-                    m.cache.allocator.num_free:
+            free = m.cache.allocator.num_free
+            if m.spec_k > 0:
+                free = min(free, m.draft_cache.allocator.num_free)
+            if m.cache.blocks_for_tokens(len(s.prompt)) > free:
                 break  # head-of-line waits for blocks to free
             self._waiting.pop(0)
             self._admit_seq += 1
@@ -952,22 +1103,26 @@ class DecodeEngine:
         _tm.set_gauge("serving_queue_depth", len(self._waiting))
 
     def _ensure_block(self, seq):
-        """Make sure the block for seq's next write position exists;
-        preempt the youngest OTHER active sequence on pool exhaustion.
-        Returns False when seq itself got preempted is impossible here —
-        False means seq must skip this step (should not happen)."""
+        """Single-token path: cover seq's next write position."""
+        return self._ensure_capacity(seq, seq.n_fed + 1)
+
+    def _ensure_capacity(self, seq, upto, draft_upto=0):
+        """Grow seq's block table(s) to cover ``upto`` tokens (and the
+        draft's to ``draft_upto`` when speculating) with all-or-nothing
+        multi-block allocations; preempt the youngest OTHER active
+        sequence on pool exhaustion.  False means seq itself was
+        defensively completed (should not happen — submit() capped every
+        sequence's total need at pool capacity)."""
         m = self._model_of(seq)
-        slot = seq.n_fed // m.kv_config.block_size
-        while seq.table[slot] < 0:
-            got = m.cache.allocator.alloc(1)
-            if got is not None:
-                seq.blocks.extend(got)
-                seq.table[slot] = got[0]
-                break
+        while True:
+            ok = m.cache.ensure_table(seq.table, seq.blocks, upto)
+            if ok and draft_upto > 0:
+                ok = m.draft_cache.ensure_table(
+                    seq.draft_table, seq.draft_blocks, draft_upto)
+            if ok:
+                return True
             victims = [s for s in self._active if s is not seq]
             if not victims:
-                # submit() capped total need at pool capacity, so a lone
-                # sequence can always allocate; defensive completion
                 self._active.remove(seq)
                 self._free_blocks(seq)
                 self._finish(seq, InferReply(
@@ -982,7 +1137,6 @@ class DecodeEngine:
                     model=v.pending.model)
             _tm.event("decode_preempt", victim=v.pending.req_id,
                       for_req=seq.pending.req_id)
-        return True
 
     def _bucket_for(self, lanes):
         for b in self.buckets:
@@ -1032,6 +1186,10 @@ class DecodeEngine:
                 _tm.inc("serving_timeout_total", model=s.pending.model)
                 self._finish(s, InferReply(
                     "timeout", error="deadline expired mid-decode"))
+        if not self._active:
+            return True
+        if m.spec_k > 0:
+            return self._spec_step_locked(m)
         for s in list(self._active):
             if s in self._active and not self._ensure_block(s):
                 pass  # defensively completed inside _ensure_block
@@ -1111,4 +1269,240 @@ class DecodeEngine:
         _tm.observe("decode_batch_occupancy",
                     len(lanes) / float(bucket), model=m.name)
         sspan.annotate(generated=n_generated, ms=round(ms, 3)).end()
+        return True
+
+    def _spec_step_locked(self, m):
+        """One speculative iteration (lock held): the draft decoder
+        proposes k tokens per generating lane through its own paged
+        pool, ONE bucketed multi-token target step verifies all k+1
+        positions, the longest draft prefix matching the target's greedy
+        argmax chain is accepted, and over-reserved blocks roll back to
+        both free lists in the same iteration.  Prefill lanes ride the
+        same verify step as a chunked prefill (up to k+1 prompt tokens
+        per iteration, auto-accepted, mirrored into the draft cache).
+        Greedy accept keeps the emitted stream bitwise equal to the
+        non-speculative engine; draft quality only moves throughput.
+
+        Verify/ingest lane layout is junk-first: a lane with span < k+1
+        valid tokens pads the LEADING columns with context_len-0 writes
+        aimed at the first valid position, which the first real column
+        then overwrites before anything attends — so short lanes never
+        touch positions past their reservation and junk never survives
+        into attended history."""
+        k = m.spec_k
+        width = k + 1
+        plans = {}
+        for s in list(self._active):
+            if s not in self._active:
+                continue   # preempted by an earlier lane's allocation
+            p = s.n_fed
+            if s.in_prefill:
+                span = min(width, len(s.prompt) - p)
+                spec = False
+                draft_upto = p + span   # prompt chunk mirrors into draft
+            else:
+                span = min(width, s.max_new - len(s.out))
+                spec = span > 1         # last token needs no proposals
+                # rollout writes up to p+k-1 (position-clamped to the
+                # sequence end); a full accept ingests d_k at p+k
+                draft_upto = min(p + k + 1, s.total) if spec else 0
+            if not self._ensure_capacity(s, p + span, draft_upto):
+                continue   # defensively completed
+            plans[id(s)] = (span, spec)
+        if not self._active:
+            return True
+        lanes = self._active[:max(self.buckets)]
+        bucket = self._bucket_for(len(lanes))
+        tok = np.zeros((bucket, width), np.int32)
+        pos = np.zeros((bucket, width), np.int32)
+        lens = np.zeros((bucket, width), np.int32)
+        tables = np.full((bucket, m.maxb), -1, np.int32)
+        rtok = np.zeros(bucket, np.int32)
+        rpos = np.zeros(bucket, np.int32)
+        rlens = np.zeros(bucket, np.int32)
+        rmax = np.zeros(bucket, np.int32)
+        rtables = np.full((bucket, m.maxb), -1, np.int32)
+        n_spec = 0
+        for i, s in enumerate(lanes):
+            span, spec = plans[id(s)]
+            p = s.n_fed
+            pad = width - span
+            tables[i] = s.table
+            pos[i, :pad] = p
+            feed = s.prompt[p:p + span] if s.in_prefill else [s.next_tok]
+            for j in range(span):
+                pos[i, pad + j] = p + j
+                lens[i, pad + j] = p + j + 1
+            for j, t in enumerate(feed):
+                tok[i, pad + j] = t
+            if spec:
+                n_spec += 1
+                rtok[i] = s.next_tok
+                rpos[i] = p
+                rlens[i] = p + 1
+                rmax[i] = s.total - 1
+                rtables[i] = s.draft_table
+        self._step_no += 1
+        sspan = _tr.start_span(
+            "serving.decode_step", model=m.name, bucket=bucket,
+            lanes=len(lanes), step=self._step_no, speculative=True, k=k)
+        for s in lanes:
+            sspan.link(s.pending.span.context
+                       if s.pending.span is not None else None)
+        req_ids = [s.pending.req_id for s in lanes]
+        self.in_batch = True
+        t0 = time.perf_counter()
+        props = None
+        try:
+            with _tr.activate(sspan):
+                if n_spec:
+                    _tr.note("decode_step", model=m.name,
+                             step=self._step_no, phase="draft",
+                             req_ids=req_ids)
+                    with _tr.span("serving.draft", lanes=n_spec, k=k):
+                        dcarry, props = m.rolloutfn(
+                            m.draft_cache.carry(), m.draft_params,
+                            rtok, rpos, rtables, rlens, rmax)
+                    m.draft_cache.replace_carry(dcarry)
+                    props = np.asarray(props)
+                    for i, s in enumerate(lanes):
+                        span, spec = plans[id(s)]
+                        if spec:
+                            for j in range(span - 1):
+                                tok[i, width - span + 1 + j] = props[i, j]
+                _tr.note("decode_step", model=m.name, step=self._step_no,
+                         phase="verify", req_ids=req_ids)
+                with _tr.span("serving.verify", lanes=len(lanes),
+                              width=width):
+                    carry, nxt, _logits = m.verifyfn(
+                        m.cache.carry(), m.params, tok, pos, tables, lens)
+                m.cache.replace_carry(carry)
+                nxt = np.asarray(nxt)
+        except Exception as e:
+            for s in lanes:
+                self._active.remove(s)
+                self._free_blocks(s)
+                self._finish(s, InferReply("error", error=str(e)))
+            _tm.inc("serving_batch_errors_total", model=m.name)
+            sspan.annotate(error=str(e)[:200]).end()
+            self.in_batch = False
+            return False
+        self.in_batch = False
+        ms = (time.perf_counter() - t0) * 1e3
+        m.step_ms = ms if m.step_ms <= 0 else 0.8 * m.step_ms + 0.2 * ms
+        t_tok = time.perf_counter()
+        n_generated = 0
+        k_proposed = 0
+        k_accepted = 0
+        ingest = []    # (seq, start_pos, tokens) draft catch-up writes
+        for i, s in enumerate(lanes):
+            span, spec = plans[id(s)]
+            p = s.n_fed
+            pad = width - span
+            accepted = 0
+            if s.in_prefill:
+                s.n_fed += span
+                ingest.append((s, p, s.prompt[p:p + span]))
+                if s.in_prefill:
+                    s.next_tok = s.prompt[s.n_fed]
+                    continue
+                # chunk crossed the prompt boundary: its last column's
+                # argmax is the first generated token
+                emitted = [int(nxt[i, pad + span - 1])]
+            else:
+                # accept-longest-prefix: column j's argmax continues the
+                # chain only while proposal j matched the previous argmax
+                emitted = [int(nxt[i, pad])]
+                while accepted < span - 1 and \
+                        int(props[i, accepted]) == emitted[-1]:
+                    emitted.append(int(nxt[i, pad + accepted + 1]))
+                    accepted += 1
+                if spec:
+                    k_proposed += span - 1
+                    k_accepted += accepted
+                    _tm.observe("spec_acceptance",
+                                accepted / float(span - 1), model=m.name)
+                s.n_fed += len(emitted)
+            done = False
+            for t in emitted:
+                s.out.append(t)
+                s.token_times.append(t_tok)
+                if s.t_first is None:
+                    s.t_first = t_tok
+                n_generated += 1
+                done = (len(s.out) >= s.max_new or t == s.eos_id)
+                if s.on_token is not None:
+                    try:
+                        s.on_token(s.pending.req_id, len(s.out) - 1, t,
+                                   done, "ok")
+                    except Exception:
+                        pass
+                if done:
+                    break
+            if done:
+                self._active.remove(s)
+                self._free_blocks(s)   # same-step free, both pools
+                self._finish(s, InferReply("ok"))
+                _tm.observe("serving_latency_ms",
+                            s.pending.reply.latency_ms, model=m.name)
+                continue
+            s.next_tok = emitted[-1]
+            if accepted == k:
+                # full accept: the rollout never wrote position p+k; its
+                # token is d_k (== the target's g_k), caught up below
+                ingest.append((s, p + k, [int(props[i, k - 1])]))
+        # free rollback: every block past the accepted frontier returns
+        # to its pool in the SAME iteration (context_lens truncation next
+        # step masks the stale writes)
+        rolled = 0
+        for s in lanes:
+            if s not in self._active:
+                continue
+            rolled += m.cache.trim_table(s.table, s.blocks, s.n_fed)
+            rolled += m.draft_cache.trim_table(
+                s.draft_table, s.draft_blocks, s.n_fed)
+        if rolled:
+            _tm.inc("spec_blocks_rolled_back_total", rolled, model=m.name)
+        ingest = [(s, q, t) for (s, q, t) in ingest if s in self._active]
+        if ingest:
+            itok = np.zeros((bucket, width), np.int32)
+            ipos = np.zeros((bucket, width), np.int32)
+            ilens = np.zeros((bucket, width), np.int32)
+            itables = np.full((bucket, m.maxb), -1, np.int32)
+            for r, (s, q, toks) in enumerate(ingest):
+                ipad = width - len(toks)
+                itables[r] = s.draft_table
+                ipos[r, :ipad] = q
+                for j, t in enumerate(toks):
+                    ipos[r, ipad + j] = q + j
+                    ilens[r, ipad + j] = q + j + 1
+                    itok[r, ipad + j] = t
+            try:
+                with _tr.activate(sspan):
+                    _tr.note("decode_step", model=m.name,
+                             step=self._step_no, phase="draft",
+                             ingest=len(ingest))
+                    with _tr.span("serving.draft_ingest",
+                                  lanes=len(ingest)):
+                        dcarry, _nx, _lg = m.ingestfn(
+                            m.draft_cache.carry(), m.draft_params,
+                            itok, ipos, itables, ilens)
+                m.draft_cache.replace_carry(dcarry)
+            except Exception:
+                # a stale draft cache only costs acceptance, never
+                # correctness — the verify step guards every token
+                _tm.inc("spec_ingest_errors_total", model=m.name)
+        if n_spec:
+            _tm.inc("spec_tokens_proposed_total", k_proposed,
+                    model=m.name)
+            _tm.inc("spec_tokens_accepted_total", k_accepted,
+                    model=m.name)
+        if n_generated:
+            _tm.inc("serving_tokens_generated_total", n_generated,
+                    model=m.name)
+        _tm.inc("serving_decode_steps_total", model=m.name)
+        _tm.observe("decode_batch_occupancy",
+                    len(lanes) / float(bucket), model=m.name)
+        sspan.annotate(generated=n_generated, ms=round(ms, 3),
+                       k_proposed=k_proposed, k_accepted=k_accepted).end()
         return True

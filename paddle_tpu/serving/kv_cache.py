@@ -40,6 +40,7 @@ from ..core import telemetry as _tm
 
 __all__ = ["KVCacheConfig", "BlockAllocator", "PagedKVCache",
            "plan_num_blocks", "block_bytes", "engine_owned_kv_bytes",
+           "engine_owned_resident_bytes", "register_resident_bytes",
            "quantize_kv", "dequantize_kv"]
 
 # default pool size when neither FLAGS_kv_cache_blocks nor an HBM budget
@@ -185,11 +186,29 @@ class BlockAllocator:
 # live caches, summed into the MEM001 static peak estimate
 _LIVE = weakref.WeakSet()
 
+# engine-owned resident weights (target + draft decoder params), keyed by
+# the owning object so the registration dies with its model entry
+_LIVE_RESIDENT = weakref.WeakKeyDictionary()
+
 
 def engine_owned_kv_bytes():
     """Total HBM bytes of every live PagedKVCache in this process —
     world_analysis.check_memory folds this into MEM001/MEM003."""
     return sum(c.nbytes for c in list(_LIVE))
+
+
+def register_resident_bytes(owner, nbytes):
+    """Register `nbytes` of engine-owned resident weights (e.g. a decode
+    model's target + draft params) against `owner` — the registration is
+    weak, so it disappears with the owning model entry.  Folded into
+    MEM001 beside the KV pool bytes."""
+    _LIVE_RESIDENT[owner] = int(nbytes)
+
+
+def engine_owned_resident_bytes():
+    """Total engine-owned resident weight bytes (decoder params, incl.
+    the speculative draft's) across live registrations."""
+    return sum(_LIVE_RESIDENT.values())
 
 
 def quantize_kv(x):
@@ -245,3 +264,40 @@ class PagedKVCache:
         """How many blocks a sequence of n_tokens needs."""
         bs = self.config.block_size
         return max(1, -(-int(n_tokens) // bs))
+
+    # -- multi-token growth / rollback (the speculative-decode contract) -----
+
+    def ensure_table(self, table, blocks, upto_tokens):
+        """Grow a sequence's block table to cover positions
+        ``[0, upto_tokens)`` with ONE all-or-nothing allocation: either
+        every missing slot is filled (True) or nothing is taken (False —
+        the engine preempts or sheds).  This is the multi-token append
+        API: a k-token speculative write (and a k-token prefill chunk)
+        reserves all the blocks it may touch in one call instead of one
+        alloc per token."""
+        need = self.blocks_for_tokens(upto_tokens)
+        have = len(blocks)
+        if need <= have:
+            return True
+        got = self.allocator.alloc(need - have)
+        if got is None:
+            return False
+        for i, b in enumerate(got):
+            table[have + i] = b
+        blocks.extend(got)
+        return True
+
+    def trim_table(self, table, blocks, upto_tokens):
+        """Rollback: free every block beyond the one holding position
+        ``upto_tokens - 1`` and clear its table slot.  With paged tables
+        a rejected speculation costs no copies — the over-allocated
+        blocks return to the free list and ``context_lens`` truncation
+        masks the stale writes.  Returns the number of blocks freed."""
+        keep = self.blocks_for_tokens(upto_tokens) if upto_tokens > 0 else 0
+        if len(blocks) <= keep:
+            return 0
+        extra = blocks[keep:]
+        del blocks[keep:]
+        table[keep:keep + len(extra)] = -1
+        self.allocator.free(extra)
+        return len(extra)

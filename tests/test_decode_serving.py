@@ -81,11 +81,13 @@ def telemetry_on():
 
 
 def _mkengine(cache_dir, kv_blocks, buckets="1", mode="token",
-              source=(CFG, PARAMS), **flag_kw):
+              source=(CFG, PARAMS), draft=None, speculative_k=None,
+              **flag_kw):
     flag_kw.setdefault("kv_block_size", BS)
     with _flags(**flag_kw):
         e = DecodeEngine(buckets=buckets, mode=mode, deadline_ms=30000.0)
-        e.add_model("toy", source, kv_blocks=kv_blocks)
+        e.add_model("toy", source, kv_blocks=kv_blocks, draft=draft,
+                    speculative_k=speculative_k)
     return e.start()
 
 
@@ -405,3 +407,242 @@ def test_paged_attention_checks_catch_bad_geometry():
     reasons = dict(pa.paged_attention_checks((2, 1, 128), (4, 8, 1, 128),
                                              np.float16, 8))
     assert reasons["dtype"] is False
+
+
+# -- speculative decoding ----------------------------------------------------
+
+from paddle_tpu.serving.decode_model import (has_draft, load_draft,  # noqa: E402
+                                             save_decoder,
+                                             truncate_decoder)
+
+DRAFT = truncate_decoder(CFG, PARAMS, layers=1)
+
+
+def _spec_engine(cache_dir, kv_blocks=64, buckets="2,4", k=3, **kw):
+    return _mkengine(cache_dir, kv_blocks, buckets=buckets, draft=DRAFT,
+                     speculative_k=k, **kw)
+
+
+def test_spec_bitwise_parity_and_eos(cache_dir):
+    e = _spec_engine(cache_dir)
+    try:
+        for prompt in ([1], [2, 3, 4], [5, 6, 7, 8, 9]):
+            r = e.generate("toy", prompt, max_new_tokens=8,
+                           deadline_ms=30000.0)
+            assert r.status == "ok", r.error
+            # accept-longest-prefix greedy verification == the plain
+            # greedy chain, bitwise — speculation may only change speed
+            assert np.array_equal(r.outputs["tokens"],
+                                  _unpaged(prompt, 8)), prompt
+        # an EOS inside an accepted run must truncate the emission
+        full = _unpaged([1, 2], 8)
+        eos = int(full[2])
+        r = e.generate("toy", [1, 2], max_new_tokens=8, eos_id=eos,
+                       deadline_ms=30000.0)
+        assert r.status == "ok"
+        assert np.array_equal(r.outputs["tokens"], full[:3])
+        m = e._models["toy"]
+        assert m.cache.allocator.in_use == 0
+        assert m.draft_cache.allocator.in_use == 0
+    finally:
+        e.stop()
+
+
+def test_spec_mixed_join_leave_parity_and_flat_misses(cache_dir,
+                                                      telemetry_on):
+    e = _spec_engine(cache_dir)
+    try:
+        e.prewarm()
+        miss0 = _tm.counter_total("executor_cache_miss_total")
+        # stagger submissions so sequences join a running speculative
+        # batch and leave it at different iterations
+        started = threading.Event()
+        ra = e.submit("toy", [1, 2], max_new_tokens=12,
+                      deadline_ms=30000.0,
+                      on_token=lambda *a: started.set())
+        assert started.wait(30.0)
+        prompts = [[3], [4, 5, 6], [7, 8, 9, 10, 11]]
+        reqs = [e.submit("toy", p, max_new_tokens=6, deadline_ms=30000.0)
+                for p in prompts]
+        a = ra.wait(timeout=60.0)
+        replies = [r.wait(timeout=60.0) for r in reqs]
+        assert a.status == "ok"
+        assert np.array_equal(a.outputs["tokens"], _unpaged([1, 2], 12))
+        for p, r in zip(prompts, replies):
+            assert r is not None and r.status == "ok", p
+            assert np.array_equal(r.outputs["tokens"], _unpaged(p, 6)), p
+        # rollout/verify/ingest were all prewarmed per bucket: the
+        # mixed join/leave traffic may not compile anything at runtime
+        assert _tm.counter_total("executor_cache_miss_total") == miss0
+        prop = _tm.counter_total("spec_tokens_proposed_total")
+        acc = _tm.counter_total("spec_tokens_accepted_total")
+        assert prop > 0 and 0 < acc <= prop
+        snap = _tm.snapshot()
+        hist = [k for k in snap["histograms"]
+                if k.startswith("spec_acceptance")]
+        assert hist, "acceptance histogram missing"
+    finally:
+        e.stop()
+
+
+def test_spec_rollback_returns_blocks_same_iteration(cache_dir,
+                                                     telemetry_on):
+    e = _spec_engine(cache_dir, kv_blocks=64, buckets="2")
+    try:
+        reqs = [e.submit("toy", p, max_new_tokens=10,
+                         deadline_ms=30000.0)
+                for p in ([1, 2, 3], [9, 8, 7, 6])]
+        assert all(r.wait(timeout=60.0).status == "ok" for r in reqs)
+        m = e._models["toy"]
+        # every over-reserved block came back: nothing leaked in either
+        # pool after the accepted-frontier trims + same-step frees
+        assert m.cache.allocator.in_use == 0
+        assert m.draft_cache.allocator.in_use == 0
+        prop = _tm.counter_total("spec_tokens_proposed_total")
+        acc = _tm.counter_total("spec_tokens_accepted_total")
+        assert prop > 0 and acc <= prop
+    finally:
+        e.stop()
+
+
+def test_spec_shed_mid_decode_keeps_decoding(cache_dir, telemetry_on):
+    # pool sized so a deep-into-decode speculating A leaves no room for
+    # B: B sheds at admission mid-speculation with a drain-time hint,
+    # A's stream is untouched
+    e = _spec_engine(cache_dir, kv_blocks=10, buckets="1", k=3)
+    try:
+        deep = threading.Event()
+
+        def on_tok(rid, i, tok, done, st):
+            if i >= 20:     # A holds >= 7 of the 9 usable blocks now
+                deep.set()
+
+        ra = e.submit("toy", [1] * 5, max_new_tokens=30,
+                      deadline_ms=30000.0, on_token=on_tok)
+        assert deep.wait(60.0)      # A is actively speculating, deep in
+        rb = e.submit("toy", [2] * 12, max_new_tokens=4,
+                      deadline_ms=30000.0)
+        b = rb.wait(timeout=30.0)
+        assert b.status == "shed", b.status
+        assert b.retry_after_ms >= 1.0
+        assert _tm.counter_total("serving_shed_total") >= 1
+        a = ra.wait(timeout=60.0)
+        assert a.status == "ok"
+        assert np.array_equal(a.outputs["tokens"], _unpaged([1] * 5, 30))
+    finally:
+        e.stop()
+
+
+def test_spec_preemption_of_speculating_sequence(cache_dir, telemetry_on):
+    # two speculating sequences over a pool too small for both peaks:
+    # the youngest gets preempted MID-SPECULATION (draft + target blocks
+    # freed together) and its deterministic recompute re-emits the
+    # identical stream
+    e = _spec_engine(cache_dir, kv_blocks=4, buckets="2", k=3)
+    try:
+        with e._cond:       # both admitted at the same iteration boundary
+            ra = e.submit("toy", [1, 2, 3, 4], max_new_tokens=8,
+                          deadline_ms=30000.0)
+            rb = e.submit("toy", [5, 6, 7, 8], max_new_tokens=4,
+                          deadline_ms=30000.0)
+        a = ra.wait(timeout=60.0)
+        b = rb.wait(timeout=60.0)
+        assert a is not None and a.status == "ok", a and a.error
+        assert b is not None and b.status == "ok", b and b.error
+        assert np.array_equal(a.outputs["tokens"],
+                              _unpaged([1, 2, 3, 4], 8))
+        assert np.array_equal(b.outputs["tokens"],
+                              _unpaged([5, 6, 7, 8], 4))
+        assert _tm.counter_total("kv_block_evictions_total") >= 1
+        m = e._models["toy"]
+        assert m.cache.allocator.in_use == 0
+        assert m.draft_cache.allocator.in_use == 0
+    finally:
+        e.stop()
+
+
+def test_spec_decode_step_span_has_acceptance_attrs(cache_dir,
+                                                    telemetry_on,
+                                                    tmp_path):
+    import glob
+    import json as _json
+
+    from paddle_tpu.core import tracing as _trc
+    fluid.set_flags({"FLAGS_tracing": True,
+                     "FLAGS_telemetry_dir": str(tmp_path)})
+    try:
+        e = _spec_engine(cache_dir)
+        try:
+            r = e.generate("toy", [1, 2, 3], max_new_tokens=8,
+                           deadline_ms=30000.0)
+            assert r.status == "ok"
+        finally:
+            e.stop()
+        _trc.flush()
+        recs = []
+        for p in glob.glob(str(tmp_path / "trace-*.jsonl")):
+            with open(p) as f:
+                recs += [_json.loads(line) for line in f if line.strip()]
+        spans = [s for s in recs if s.get("t") == "span"]
+        steps = [s for s in spans
+                 if s.get("name") == "serving.decode_step"
+                 and (s.get("attrs") or {}).get("speculative")]
+        assert steps, "no speculative decode_step span recorded"
+        assert all("k_proposed" in s["attrs"] and "k_accepted" in s["attrs"]
+                   for s in steps)
+        step_ids = {x.get("sid") for x in steps}
+        kids = {s.get("name") for s in spans
+                if s.get("parent") in step_ids}
+        # draft and verify phases are children of the step span
+        assert "serving.verify" in kids
+        assert "serving.draft" in kids
+        # the flight ring names the phase per decode_step note
+        phases = {n.get("phase") for n in recs
+                  if n.get("t") == "note" and n.get("kind") == "decode_step"}
+        assert {"draft", "verify"} <= phases
+    finally:
+        _trc.reset()
+        fluid.set_flags({"FLAGS_tracing": False,
+                         "FLAGS_telemetry_dir": ""})
+
+
+def test_draft_bundle_roundtrip_and_flag_gate(cache_dir, tmp_path):
+    d = str(tmp_path / "bundle")
+    save_decoder(d, CFG, PARAMS, draft=DRAFT)
+    assert has_draft(d)
+    dcfg, dparams = load_draft(d)
+    assert dcfg.layers == 1 and dcfg.vocab == CFG.vocab
+    assert dcfg.max_seq == CFG.max_seq
+    assert set(dparams) < set(PARAMS) | {"embed", "pos_embed"}
+    # a dir source auto-loads its bundled draft; FLAGS_speculative_k
+    # turns speculation on without touching call sites
+    with _flags(kv_block_size=BS, speculative_k=2):
+        e = DecodeEngine(buckets="1", deadline_ms=30000.0)
+        m = e.add_model("toy", d, kv_blocks=32)
+    assert m.spec_k == 2 and e.spec("toy")["speculative_k"] == 2
+    e.start()
+    try:
+        r = e.generate("toy", [3, 1, 4], max_new_tokens=6,
+                       deadline_ms=30000.0)
+        assert r.status == "ok"
+        assert np.array_equal(r.outputs["tokens"], _unpaged([3, 1, 4], 6))
+    finally:
+        e.stop()
+    # without a draft bundle, k is ignored: the model decodes plain
+    with _flags(kv_block_size=BS, speculative_k=2):
+        e2 = DecodeEngine(buckets="1", deadline_ms=30000.0)
+        m2 = e2.add_model("toy", (CFG, PARAMS), kv_blocks=32)
+    assert m2.spec_k == 0
+
+
+def test_draft_vocab_mismatch_rejected(tmp_path):
+    bad_cfg = DecoderConfig(vocab=7, layers=1, heads=2, head_dim=8,
+                            max_seq=48)
+    bad = (bad_cfg, init_decoder_params(bad_cfg, seed=1))
+    with pytest.raises(ValueError, match="vocab"):
+        save_decoder(str(tmp_path / "x"), CFG, PARAMS, draft=bad)
+    with _flags(kv_block_size=BS):
+        e = DecodeEngine(buckets="1", deadline_ms=30000.0)
+        with pytest.raises(ValueError, match="vocab"):
+            e.add_model("toy", (CFG, PARAMS), kv_blocks=16, draft=bad,
+                        speculative_k=2)

@@ -215,6 +215,56 @@ def test_engine_owned_bytes_tracks_live_caches():
     assert engine_owned_kv_bytes() == base
 
 
+# -- multi-token append / rollback (ensure_table, trim_table) ----------------
+
+
+def test_ensure_table_grows_all_or_nothing():
+    c = PagedKVCache(_cfg())          # capacity 7, block_size 4
+    table = np.full(8, -1, np.int32)
+    blocks = []
+    assert c.ensure_table(table, blocks, 5)      # 2 blocks in one call
+    assert len(blocks) == 2 and list(table[:2]) == blocks
+    assert c.allocator.in_use == 2
+    # idempotent when coverage already suffices
+    assert c.ensure_table(table, blocks, 8)
+    assert len(blocks) == 2 and c.allocator.in_use == 2
+    # ask beyond capacity: takes NOTHING, pool state untouched
+    before = c.allocator.stats()
+    assert not c.ensure_table(table, blocks, 4 * 8)
+    assert c.allocator.stats() == before
+    assert len(blocks) == 2 and np.all(table[2:] == -1)
+
+
+def test_trim_table_frees_speculative_overallocation():
+    c = PagedKVCache(_cfg())
+    table = np.full(8, -1, np.int32)
+    blocks = []
+    # a k-token speculative reservation out to position 15...
+    assert c.ensure_table(table, blocks, 16)
+    assert len(blocks) == 4
+    # ...rolled back to 6 accepted tokens frees the trailing blocks
+    freed = c.trim_table(table, blocks, 6)
+    assert freed == 2 and len(blocks) == 2
+    assert np.all(table[2:] == -1) and c.allocator.in_use == 2
+    # already tight: nothing to free
+    assert c.trim_table(table, blocks, 6) == 0
+    # full rollback (dead sequence) returns everything
+    assert c.trim_table(table, blocks, 0) == 2
+    assert c.allocator.in_use == 0 and np.all(table == -1)
+
+
+def test_trim_then_ensure_reuses_lifo_blocks():
+    c = PagedKVCache(_cfg())
+    table = np.full(8, -1, np.int32)
+    blocks = []
+    assert c.ensure_table(table, blocks, 12)
+    tail = blocks[-1]
+    c.trim_table(table, blocks, 8)
+    # re-speculating immediately gets the just-freed block back (LIFO)
+    assert c.ensure_table(table, blocks, 12)
+    assert blocks[-1] == tail
+
+
 # -- MEM001 fold: engine-owned KV counted in the static peak -----------------
 
 

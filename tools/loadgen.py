@@ -29,10 +29,17 @@ The report gains token-level serving metrics: ``tokens_per_sec``
 (aggregate generated-token throughput), ``ttft_ms_p50/p99`` and
 ``itl_ms_p50/p99``, plus the engine's batching mode — run once against
 a ``--decode-mode token`` server and once against ``request`` to
-measure the continuous-batching win on the same traffic.
+measure the continuous-batching win on the same traffic.  Against a
+speculating server (FLAGS_speculative_k > 0) the report also carries
+``speculative_k``, the scraped ``spec_tokens_proposed/accepted`` totals
+and their ``spec_acceptance_rate``, and ``outputs_sha256`` — a
+fingerprint of every (prompt -> output tokens) pair, so the same seeded
+traffic replayed with speculation on and off can assert bitwise-equal
+output next to the tokens/sec comparison.
 """
 
 import argparse
+import hashlib
 import json
 import os
 import random
@@ -113,6 +120,7 @@ def main(argv=None):
     latencies, statuses = [], {}
     phase_samples = {"queue_wait_ms": [], "execute_ms": [], "wire_ms": []}
     ttfts, itls, tokens_out = [], [], [0]
+    out_map = {}    # prompt tuple -> generated tokens (greedy => unique)
     threads = []
 
     def run_once(rows, prompt):
@@ -140,7 +148,10 @@ def main(argv=None):
                     if v is not None:
                         xs.append(float(v))
                 if decode:
-                    tokens_out[0] += len(r.outputs.get("tokens", ()))
+                    toks = list(int(t) for t in
+                                r.outputs.get("tokens", ()))
+                    tokens_out[0] += len(toks)
+                    out_map[tuple(prompt)] = toks
                     # client-observed (wire-inclusive) when streaming,
                     # server-side phase attribution otherwise
                     ttft = r.phases.get("client_ttft_ms",
@@ -168,16 +179,34 @@ def main(argv=None):
         t.join(timeout=120.0)
     wall_s = time.perf_counter() - t_start
 
-    # server-side batch fill from the scrape (best-effort: a SIGKILLed
-    # coordinator can leave no scrapeable replica in tiny test fleets)
+    # server-side batch fill + speculation counters from the scrape
+    # (best-effort: a SIGKILLed coordinator can leave no scrapeable
+    # replica in tiny test fleets)
     batch_fill = None
+    spec_proposed = spec_accepted = 0.0
     try:
         snap = client.scrape()
+        if decode and tokens_out[0]:
+            # __metrics__ is republished once a second: right after the
+            # last reply the snapshot may predate the final decode
+            # steps, so wait out one publish period when it is behind
+            gen = sum(v for k, v in snap.get("counters", {}).items()
+                      if k.startswith("serving_tokens_generated_total"))
+            if gen < tokens_out[0]:
+                time.sleep(1.2)
+                snap = client.scrape()
         h = [v for k, v in snap.get("histograms", {}).items()
              if k.startswith("serving_batch_fill")]
         n = sum(x["count"] for x in h)
         if n:
             batch_fill = round(sum(x["sum"] for x in h) / n, 4)
+        counters = snap.get("counters", {})
+        spec_proposed = sum(
+            v for k, v in counters.items()
+            if k.startswith("spec_tokens_proposed_total"))
+        spec_accepted = sum(
+            v for k, v in counters.items()
+            if k.startswith("spec_tokens_accepted_total"))
     except Exception:
         pass
 
@@ -209,6 +238,12 @@ def main(argv=None):
         "failovers": client.failovers,
     }
     if decode:
+        # outputs_sha256 fingerprints every (prompt -> tokens) pair so
+        # two runs of the SAME seeded traffic can assert bitwise-equal
+        # output (the speculative-vs-greedy parity check in run_ci.sh)
+        digest = hashlib.sha256(
+            json.dumps(sorted((list(p), t) for p, t in out_map.items()))
+            .encode()).hexdigest()
         report.update({
             "decode_mode": spec.get("mode"),
             "max_new_tokens": args.max_new,
@@ -219,6 +254,13 @@ def main(argv=None):
             "ttft_ms_p99": round(percentile(ttfts, 0.99), 3),
             "itl_ms_p50": round(percentile(itls, 0.50), 3),
             "itl_ms_p99": round(percentile(itls, 0.99), 3),
+            "speculative_k": spec.get("speculative_k", 0),
+            "spec_tokens_proposed": spec_proposed,
+            "spec_tokens_accepted": spec_accepted,
+            "spec_acceptance_rate": round(
+                spec_accepted / spec_proposed, 4) if spec_proposed else None,
+            "outputs_sha256": digest,
+            "outputs_distinct": len(out_map),
         })
     with open(args.out, "w") as f:
         json.dump(report, f, indent=1)

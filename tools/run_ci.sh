@@ -152,7 +152,10 @@ if [ "$MODE" = "--decode-smoke" ]; then
   # mixed-length burst — zero runtime compiles after the bucket prewarm
   # is the hard invariant (flat executor_cache_miss_total), and the same
   # traffic against a request-level replica must be >=1.5x slower in
-  # generated tokens/sec (the continuous-batching win)
+  # generated tokens/sec (the continuous-batching win); a third replica
+  # with --speculative-k 3 replays the identical seeded traffic and must
+  # produce bitwise-equal outputs (outputs_sha256) with its own flat
+  # miss count (buckets x 3 speculative stepfn kinds)
   echo "== decode smoke: paged KV cache + decode serving tests =="
   JAX_PLATFORMS=cpu FLAGS_static_check=error \
     python -m pytest tests/test_kv_cache.py tests/test_decode_serving.py -q
@@ -227,6 +230,65 @@ print("token-level TTFT p50/p99 = %s/%s ms, ITL p50/p99 = %s/%s ms"
          tok["itl_ms_p50"], tok["itl_ms_p99"]))
 assert tok["ttft_ms_p50"] > 0, "no TTFT samples"
 assert ratio >= 1.5, "continuous-batching win %.2fx < 1.5x" % ratio
+EOF
+  echo "== decode smoke: speculative decoding, same traffic =="
+  # third replica: same bundle (save_demo_decoder ships a draft), same
+  # seeded traffic, FLAGS_speculative_k=3 — greedy accept-longest-prefix
+  # must be BITWISE identical to the non-speculative token run
+  # (outputs_sha256), and the miss counter must stay flat at
+  # 2 buckets x 3 stepfn kinds (verify + draft rollout + draft ingest)
+  env "${DEC_ENV[@]}" python tools/serve.py --model dec="$DEC_DIR/dec" \
+    --port 9482 --decode-buckets 4,8 --decode-mode token \
+    --speculative-k 3 > "$DEC_DIR/spec.log" 2>&1 &
+  D2=$!
+  trap 'kill -9 $D2 2>/dev/null || true' EXIT
+  for _ in $(seq 60); do
+    grep -q READY "$DEC_DIR/spec.log" && break; sleep 1
+  done
+  grep -q READY "$DEC_DIR/spec.log"
+  JAX_PLATFORMS=cpu python tools/loadgen.py --endpoints 127.0.0.1:9482 \
+    --model dec --requests 48 --qps 400 --prompt-mix 2,4,24 --max-new 8 \
+    --deadline-ms 30000 --retry-shed 4 \
+    --out "$DEC_DIR/BENCH_decode_spec.json" --assert-no-drops
+  python - <<'EOF'
+from paddle_tpu.core import telemetry
+snap = telemetry.scrape("127.0.0.1:9482")
+miss = sum(v for k, v in snap["counters"].items()
+           if k.startswith("executor_cache_miss_total"))
+assert miss == 6, \
+    "runtime compiles under speculation: miss=%s != 2 buckets x 3" % miss
+print("flat executor_cache_miss_total OK under speculation: %d" % miss)
+EOF
+  python tools/metrics_dump.py --scrape 127.0.0.1:9482 --decode \
+    | grep -c spec_tokens_proposed_total > /dev/null
+  kill -9 $D2 2>/dev/null || true
+  trap - EXIT
+  python - "$DEC_DIR/BENCH_decode_spec.json" \
+    "$DEC_DIR/BENCH_decode_token.json" <<'EOF'
+import json, sys
+spec = json.load(open(sys.argv[1]))
+base = json.load(open(sys.argv[2]))
+assert spec["speculative_k"] == 3, spec["speculative_k"]
+assert spec["outputs_sha256"] == base["outputs_sha256"], \
+    "speculative outputs differ from greedy baseline: %s != %s" \
+    % (spec["outputs_sha256"], base["outputs_sha256"])
+assert spec["spec_tokens_proposed"] > 0, "speculation never ran"
+acc = spec["spec_acceptance_rate"]
+assert acc is not None and 0.0 < acc <= 1.0, acc
+rs, rb = spec["tokens_per_sec"], base["tokens_per_sec"]
+ratio = rs / max(rb, 1e-9)
+print("speculative %.1f tok/s vs greedy %.1f tok/s -> %.2fx "
+      "(acceptance %.0f%%)" % (rs, rb, ratio, acc * 100))
+print("bitwise-equal outputs OK (%d distinct prompts)"
+      % spec["outputs_distinct"])
+if ratio < 1.3:
+    # the 1-layer toy draft on a loaded CI box can miss the perf bar
+    # even with high acceptance; parity + flat-miss asserted above are
+    # the correctness gates, so the throughput bar alone degrades to a
+    # loud notice instead of a hard failure
+    print("SKIP-NOTICE: speculative speedup %.2fx < 1.3x target "
+          "(acceptance %.0f%%) — correctness gates passed"
+          % (ratio, acc * 100))
 EOF
   rm -rf "$DEC_DIR"
   echo "CI --decode-smoke: PASS"

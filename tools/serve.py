@@ -60,14 +60,19 @@ def save_demo_model(dirname, in_dim=8, out_dim=4):
 
 def save_demo_decoder(dirname, vocab=31, layers=2, heads=2, head_dim=8,
                       max_seq=48, seed=7):
-    """Tiny decode model via serving.decode_model.save_decoder."""
+    """Tiny decode model via serving.decode_model.save_decoder, bundled
+    with a first-layer-truncation draft so FLAGS_speculative_k > 0 can
+    speculate out of the box."""
     from paddle_tpu.serving.decode_model import (DecoderConfig,
                                                  init_decoder_params,
-                                                 save_decoder)
+                                                 save_decoder,
+                                                 truncate_decoder)
 
     cfg = DecoderConfig(vocab=vocab, layers=layers, heads=heads,
                         head_dim=head_dim, max_seq=max_seq)
-    return save_decoder(dirname, cfg, init_decoder_params(cfg, seed=seed))
+    params = init_decoder_params(cfg, seed=seed)
+    return save_decoder(dirname, cfg, params,
+                        draft=truncate_decoder(cfg, params, layers=1))
 
 
 def main(argv=None):
@@ -109,6 +114,10 @@ def main(argv=None):
     ap.add_argument("--kv-blocks", type=int, default=None,
                     help="paged KV pool size in blocks "
                     "(default FLAGS_kv_cache_blocks / HBM budget)")
+    ap.add_argument("--speculative-k", type=int, default=None,
+                    help="draft-model speculation depth for decode "
+                    "models with a bundled draft (default "
+                    "FLAGS_speculative_k; 0 = off)")
     args = ap.parse_args(argv)
 
     if args.save_demo_model:
@@ -144,7 +153,8 @@ def main(argv=None):
                 decode_engine = DecodeEngine(buckets=args.decode_buckets,
                                              mode=args.decode_mode)
             decode_engine.add_model(name, dirname,
-                                    kv_blocks=args.kv_blocks)
+                                    kv_blocks=args.kv_blocks,
+                                    speculative_k=args.speculative_k)
         else:
             engine.add_model(name, dirname)
 

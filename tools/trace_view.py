@@ -94,6 +94,14 @@ def load_dir(telemetry_dir):
     return [(pid, nm, rc) for pid, (nm, rc) in sorted(procs.items())]
 
 
+# chrome://tracing reserved color names for the speculative-decode child
+# spans (engine._spec_step_locked): draft work yellow-ish, the target
+# verify step green, so accept/reject economics show up visually
+_SPEC_COLORS = {"serving.draft": "thread_state_iowait",
+                "serving.draft_ingest": "thread_state_iowait",
+                "serving.verify": "thread_state_running"}
+
+
 def merge(procs):
     """-> (chrome trace dict, number of cross-process flows)."""
     events = []
@@ -122,10 +130,17 @@ def merge(procs):
                 args["span_id"] = r.get("sid")
                 if r.get("parent"):
                     args["parent_id"] = r["parent"]
-                events.append({"name": r.get("name", "?"), "ph": "X",
-                               "pid": pid, "tid": tid, "ts": ts,
-                               "dur": max(r.get("dur", 0), 1),
-                               "cat": "span", "args": args})
+                ev = {"name": r.get("name", "?"), "ph": "X",
+                      "pid": pid, "tid": tid, "ts": ts,
+                      "dur": max(r.get("dur", 0), 1),
+                      "cat": "span", "args": args}
+                # speculation phases nest under serving.decode_step;
+                # fixed colors make the draft/verify split readable at
+                # a glance in a dense decode track
+                cname = _SPEC_COLORS.get(ev["name"])
+                if cname:
+                    ev["cname"] = cname
+                events.append(ev)
                 span_home[r.get("sid")] = (pid, tid, ts,
                                            r.get("name", "?"))
                 if r.get("parent"):
