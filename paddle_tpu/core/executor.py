@@ -386,6 +386,32 @@ class Executor:
         self._cache.clear()
         self._fuse_attempted = set()
 
+    def snapshot_state(self, program, predicate=None):
+        """Host-copy snapshot of the program's persistable scope state:
+        one D2H device_get per tensor, returning {name: np.ndarray} with
+        arrays the caller owns (copy=True — later steps can mutate scope
+        tensors without corrupting an in-flight background checkpoint
+        write).  This is the only step-path cost of an async
+        CheckpointManager.save; serialization/crc/rename happen off-thread
+        against this dict."""
+        if predicate is None:
+            predicate = lambda v: v.persistable and not v.is_data  # noqa: E731
+        scope = global_scope()
+        t0 = time.perf_counter()
+        with _tracing.span("executor.snapshot"):
+            out = {}
+            for var in program.list_vars():
+                if not predicate(var):
+                    continue
+                sv = scope.find_var(var.name)
+                if sv is None or not sv.get_tensor()._is_initialized():
+                    continue
+                out[var.name] = np.array(sv.get_tensor().numpy(), copy=True)
+        if _telemetry.enabled():
+            _telemetry.observe("executor_snapshot_ms",
+                               (time.perf_counter() - t0) * 1e3)
+        return out
+
     def close(self):
         """Release cached executables and notify pservers this trainer is
         done (reference Executor::Close -> SendComplete, executor.cc:110)."""

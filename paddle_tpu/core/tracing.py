@@ -336,10 +336,13 @@ def remote_parent(tp):
 
 
 def record_span(name, wall_start_s, dur_ms, parent=None, trace_id=None,
-                **attrs):
+                links=None, **attrs):
     """Emit a span RETROACTIVELY from measured timestamps (the elastic
     re-quorum phases are measured as perf_counter deltas first, then laid
-    out as a span tree).  Returns the span (already ended)."""
+    out as a span tree).  ``links`` associates other spans without
+    parenting them — each entry a Span or (trace_id, span_id) tuple, e.g.
+    the elastic restore phase linking the checkpoint.restore span that
+    served it.  Returns the span (already ended)."""
     if not enabled():
         return _NULL_SPAN
     if isinstance(parent, Span):
@@ -358,6 +361,9 @@ def record_span(name, wall_start_s, dur_ms, parent=None, trace_id=None,
     s.dur_ms = float(dur_ms)
     s.attrs = dict(attrs) if attrs else {}
     s.links = []
+    for other in (links or ()):
+        if other is not None and not isinstance(other, _NullSpan):
+            s.link(other)
     s.thread = threading.current_thread().name
     s._ended = True
     _emit(s._record())

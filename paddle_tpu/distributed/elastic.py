@@ -67,6 +67,8 @@ _DONE = "__edone__"      # <rank>: clean completion            [rank]
 _ALIVE = "__alive__"     # served by every member's local server
 _VIEW = "__eview__"      # latest view; __eview__#<epoch> per epoch
 _GATE = "__ego__"        # __ego__#<epoch>:<step> -> [1] go | [0] re-quorum
+_STATE = "__estate__"    # peer-restore payload: __estate__#<epoch> meta
+                         # (json, uint8) + __estate__#<epoch>#<var> arrays
 
 _GO = 1
 _ABORT = 0
@@ -136,32 +138,49 @@ def member_env():
 
 class View:
     """One quorum epoch's membership: which original ranks are in, who
-    coordinates, and where the epoch's jax.distributed service lives."""
+    coordinates, and where the epoch's jax.distributed service lives.
 
-    __slots__ = ("epoch", "coord_rank", "jax_port", "restore_step", "ranks")
+    ``peer_step``/``peer_src`` carry the peer-to-peer restore offer: the
+    newest live post-step state any survivor holds and the lowest rank
+    holding it.  (0, -1) means no offer — restore from the filesystem.
+    They ride at the TAIL of the wire encoding so old decoders (and
+    encodings from old coordinators) stay compatible."""
 
-    def __init__(self, epoch, coord_rank, jax_port, restore_step, ranks):
+    __slots__ = ("epoch", "coord_rank", "jax_port", "restore_step", "ranks",
+                 "peer_step", "peer_src")
+
+    def __init__(self, epoch, coord_rank, jax_port, restore_step, ranks,
+                 peer_step=0, peer_src=-1):
         self.epoch = int(epoch)
         self.coord_rank = int(coord_rank)
         self.jax_port = int(jax_port)
         self.restore_step = int(restore_step)
         self.ranks = tuple(int(r) for r in ranks)
+        self.peer_step = int(peer_step)
+        self.peer_src = int(peer_src)
 
     def encode(self):
         return np.asarray([self.epoch, self.coord_rank, self.jax_port,
                            self.restore_step, len(self.ranks)]
-                          + list(self.ranks), np.int64)
+                          + list(self.ranks)
+                          + [self.peer_step, self.peer_src], np.int64)
 
     @classmethod
     def decode(cls, arr):
         a = np.asarray(arr).reshape(-1).astype(np.int64)
         n = int(a[4])
-        return cls(a[0], a[1], a[2], a[3], [int(x) for x in a[5:5 + n]])
+        tail = a[5 + n:]
+        peer_step, peer_src = ((int(tail[0]), int(tail[1]))
+                               if len(tail) >= 2 else (0, -1))
+        return cls(a[0], a[1], a[2], a[3], [int(x) for x in a[5:5 + n]],
+                   peer_step, peer_src)
 
     def __repr__(self):
         return ("View(epoch=%d, coord=%d, jax_port=%d, restore=%d, "
-                "ranks=%s)" % (self.epoch, self.coord_rank, self.jax_port,
-                               self.restore_step, list(self.ranks)))
+                "ranks=%s, peer=%d@%d)"
+                % (self.epoch, self.coord_rank, self.jax_port,
+                   self.restore_step, list(self.ranks), self.peer_step,
+                   self.peer_src))
 
 
 class _JaxWorld:
@@ -222,6 +241,7 @@ class _Coordinator(threading.Thread):
         self.ready = {}          # (epoch, step) -> set(ranks)
         self.released = []       # published gate keys (pruned)
         self.aborted = set()     # epochs whose gates answer [0]
+        self.state = {}          # rank -> (state_step, has_state) from HB/READY
         self._stop = False
         self._detect_t0 = None
         # a freshly failed-over coordinator waits for survivors to rejoin
@@ -247,6 +267,26 @@ class _Coordinator(threading.Thread):
         except Exception:
             found = None
         return found[0] if found else 0
+
+    def _peer_fields(self, fs_step):
+        """(peer_step, peer_src) offer for the next view: the newest live
+        post-step state among surviving members, preferred over the
+        filesystem whenever it is at least as fresh as latest_valid() —
+        survivors that stepped past the last checkpoint would DIVERGE the
+        world if a rejoiner read the stale fs copy.  (0, -1) when p2p
+        restore is off (the coordinator's flag decides for the whole world,
+        so every member takes the same path) or nobody holds usable state."""
+        if not _flag("checkpoint_p2p_restore"):
+            return 0, -1
+        cands = {r: s for r, (s, h) in self.state.items()
+                 if r in self.live and h and s > 0}
+        if not cands:
+            return 0, -1
+        peer_step = max(cands.values())
+        if peer_step < int(fs_step):
+            return 0, -1
+        src = min(r for r, s in cands.items() if s == peer_step)
+        return int(peer_step), int(src)
 
     def _pick_port(self, epoch):
         base = _host_port(self.m.members[self.m.rank])[1]
@@ -288,14 +328,19 @@ class _Coordinator(threading.Thread):
 
     def _on_event(self, name, arr):
         if name.startswith(_HB):
-            r = int(arr[0])
+            a = np.asarray(arr).reshape(-1)
+            r = int(a[0])
             if r in self.live:
                 self.mon.update(r)
+            if len(a) >= 4:  # extended HB carries (state_step, has_state)
+                self.state[r] = (int(a[2]), int(a[3]))
         elif name.startswith(_READY):
             r = int(name[len(_READY):])
             epoch, step = int(arr[0]), int(arr[1])
             if r in self.live:
                 self.mon.update(r)
+            # a member at the gate holds live state for `step` done steps
+            self.state[r] = (step, 1)
             if epoch in self.aborted or epoch < self.epoch:
                 self._release(epoch, step, _ABORT)
                 return
@@ -322,6 +367,12 @@ class _Coordinator(threading.Thread):
                 self.all_done.set()
 
     def _tick(self):
+        # the coordinator's own process is trivially alive while this code
+        # runs — never let a stalled local HB thread (GIL contention from a
+        # standby compile, a wedged shared RPC client) self-evict the
+        # quorum's anchor; coordinator death is the members' failover path
+        if self.m.rank in self.live:
+            self.mon.update(self.m.rank)
         dead = [r for r in self.mon.check() if r in self.live]
         joining = self.joins - self.live
         if dead and self._detect_t0 is None:
@@ -345,13 +396,16 @@ class _Coordinator(threading.Thread):
         self.joins.clear()
         self.epoch += 1
         self.aborted.add(old_epoch)
+        self.state = {r: v for r, v in self.state.items() if r in self.live}
         # wake every member parked at an old-epoch gate
         for (epoch, step), _ in list(self.ready.items()):
             if epoch <= old_epoch:
                 self._release(epoch, step, _ABORT)
                 self.ready.pop((epoch, step), None)
+        fs_step = self._restore_step()
+        peer_step, peer_src = self._peer_fields(fs_step)
         view = View(self.epoch, self.m.rank, self._pick_port(self.epoch),
-                    self._restore_step(), sorted(self.live))
+                    fs_step, sorted(self.live), peer_step, peer_src)
         # grace: a joiner needs time to init jax + transpile + restore
         timeout = float(_flag("elastic_hb_timeout") or 5.0)
         self.mon = HeartBeatMonitor(0, timeout_s=timeout, name="elastic",
@@ -365,11 +419,13 @@ class _Coordinator(threading.Thread):
         _tm.observe("elastic_requorum_ms", ms, role="coordinator")
         _tm.event("elastic_epoch", epoch=self.epoch,
                   world=len(view.ranks), evicted=evicted, joined=joined,
-                  restore_step=view.restore_step, ms=round(ms, 3))
+                  restore_step=view.restore_step, ms=round(ms, 3),
+                  peer_step=view.peer_step, peer_src=view.peer_src)
         logging.warning(
             "[elastic] epoch %d: world=%s evicted=%s joined=%s "
-            "jax_port=%d restore_step=%d", self.epoch, sorted(self.live),
-            evicted, joined, view.jax_port, view.restore_step)
+            "jax_port=%d restore_step=%d peer=%d@%d", self.epoch,
+            sorted(self.live), evicted, joined, view.jax_port,
+            view.restore_step, view.peer_step, view.peer_src)
 
     def stop(self):
         self._stop = True
@@ -444,6 +500,14 @@ class ElasticMember:
         # served it — payloads/tests read these after gate() returns False
         self.last_adopt_phases = {}
         self.last_adopt_standby = False
+        # where the last adoption's state came from: "peer" | "fs" | None
+        self.last_restore_source = None
+        # live-state bookkeeping for peer-to-peer restore: how many steps
+        # this member has COMPLETED (updated at the gate and after adopt)
+        # and whether the scope holds adopted state at all
+        self._state_step = 0
+        self._has_state = False
+        self._published_state = []  # __estate__ keys served for rejoiners
 
     # -- properties ----------------------------------------------------------
 
@@ -542,8 +606,11 @@ class ElasticMember:
             name = _HB + str(self.rank)
             while not self._stop_hb.wait(interval):
                 try:
+                    # extended HB: (state_step, has_state) lets the
+                    # coordinator compute the next view's peer-restore offer
                     self._ctrl.send_var(name, np.asarray(
-                        [self.rank, self.epoch], np.int64))
+                        [self.rank, self.epoch, int(self._state_step),
+                         1 if self._has_state else 0], np.int64))
                 except Exception:
                     pass  # gate() owns failure handling
 
@@ -589,7 +656,11 @@ class ElasticMember:
                 t = var.get_tensor() if var else None
                 if t is not None and t._is_initialized():
                     try:
-                        t.set(np.asarray(t.get()))
+                        # np.asarray of a CPU jax.Array can alias the XLA
+                        # buffer — a real copy is required or the "detached"
+                        # value dangles once clear_backends frees the buffer
+                        # (the peer-restore path reads these post-reset)
+                        t.set(np.array(t.get(), copy=True))
                     except Exception:
                         pass
             s = getattr(s, "parent", None)
@@ -612,6 +683,21 @@ class ElasticMember:
         coord_host = _host_port(self.members[view.coord_rank])[0]
 
         self._numpyify_scope()
+        # survivors hold live post-step state right here (numpy, detached
+        # from the dying backend) — capture the refs BEFORE run(startup)
+        # re-initializes the scope; scope.var().set replaces array objects,
+        # so these refs stay intact.  If this member is the view's peer
+        # source, serve the state on the ctrl server NOW so a rejoining
+        # member can fetch it while we transpile/compile.
+        live_state = self._capture_live_state(view) if old_epoch >= 0 else None
+        if live_state is not None and view.peer_src == self.rank:
+            self._publish_live_state(view, live_state)
+        # everything below mutates the scope (run(startup) re-inits, warmup
+        # may touch buffers) — if this adoption dies mid-way and another
+        # re-quorum follows, a capture against the half-rebuilt scope would
+        # serve init values as if they were step-N state.  Invalidate until
+        # the adoption completes; live_state above is already detached.
+        self._has_state = False
         if self.executor is not None:
             self.executor.reset_device_state()
         _JaxWorld.reinit(coord_host, view.jax_port, world, pid,
@@ -668,6 +754,7 @@ class ElasticMember:
         self.startup_program = startup
 
         self.restore_step = 0
+        self.last_restore_source = None
         phases["compile"] = phases["restore"] = 0.0
         if self.executor is not None:
             tc = time.perf_counter()
@@ -691,11 +778,41 @@ class ElasticMember:
                                     "%s", e)
             phases["compile"] = (time.perf_counter() - tc) * 1e3
             tr = time.perf_counter()
-            if self.ckpt is not None:
+            src = None
+            if view.peer_step > 0 and live_state is not None \
+                    and self._state_step == view.peer_step:
+                # survivor: its own pre-requorum state IS the adopted state
+                self._set_state(main, live_state)
+                self.restore_step = int(view.peer_step)
+                src = "peer"
+            elif view.peer_step > 0 and 0 <= view.peer_src < len(self.members) \
+                    and view.peer_src != self.rank:
+                # rejoiner (or a lagging survivor): fetch from the peer
+                # source over the native-RPC fabric instead of the fs
+                try:
+                    self._peer_fetch(view, main)
+                    self.restore_step = int(view.peer_step)
+                    src = "peer"
+                except Exception as e:
+                    logging.warning(
+                        "[elastic] rank %d: peer restore from rank %d "
+                        "failed (%s) — falling back to filesystem",
+                        self.rank, view.peer_src, e)
+            if src is None and self.ckpt is not None:
+                try:
+                    self.ckpt.wait()  # drain an in-flight async write
+                except Exception as e:
+                    logging.warning("[elastic] pending checkpoint write "
+                                    "failed: %s", e)
                 step, _extra = self.ckpt.restore(self.executor, main)
                 self.restore_step = int(step)
+                src = "fs"
+            if src is not None:
+                _tm.inc("checkpoint_restore_source_total", source=src)
                 _tm.event("elastic_restore", rank=self.rank,
-                          epoch=view.epoch, step=self.restore_step)
+                          epoch=view.epoch, step=self.restore_step,
+                          source=src)
+            self.last_restore_source = src
             phases["restore"] = (time.perf_counter() - tr) * 1e3
         ms = (time.perf_counter() - t0) * 1e3
         _tm.observe("elastic_requorum_ms", ms, role="member")
@@ -712,8 +829,18 @@ class ElasticMember:
             cursor = wall0
             for ph in ("init", "transpile", "verify", "compile",
                        "restore"):
+                attrs, links = {}, None
+                if ph == "restore" and self.last_restore_source:
+                    # flow from the checkpoint span tree into the phase:
+                    # the fs path links the checkpoint.restore span that
+                    # served it (trace_view renders the arrow)
+                    attrs["source"] = self.last_restore_source
+                    if (self.last_restore_source == "fs"
+                            and self.ckpt is not None):
+                        links = [getattr(self.ckpt, "last_restore_span",
+                                         None)]
                 _tr.record_span("elastic." + ph, cursor, phases[ph],
-                                parent=root)
+                                parent=root, links=links, **attrs)
                 cursor += phases[ph] / 1e3
         _tm.set_gauge("elastic_epoch", view.epoch)
         if old_epoch >= 0:
@@ -723,6 +850,10 @@ class ElasticMember:
                       phases={k: round(v, 3) for k, v in phases.items()})
         self.last_adopt_phases = dict(phases)
         self.last_adopt_standby = standby is not None
+        # adopted state covers restore_step completed steps; gate() keeps
+        # _state_step current from here on
+        self._state_step = int(self.restore_step)
+        self._has_state = self.executor is not None
         logging.info(
             "[elastic] rank %d adopted %r (pid %d/%d) in %.0fms "
             "(standby=%s transpile=%.0f verify=%.0f compile=%.0f "
@@ -730,6 +861,121 @@ class ElasticMember:
             standby is not None, phases["transpile"], phases["verify"],
             phases["compile"], phases["restore"])
         self._spawn_standby()
+
+    # -- peer-to-peer state movement ----------------------------------------
+
+    def _live_scope(self):
+        if self.scope is not None:
+            return self.scope
+        from ..core.executor import global_scope
+
+        return global_scope()
+
+    def _persistable_names(self, program):
+        return {v.name for v in program.list_vars()
+                if v.persistable and not v.is_data}
+
+    def _capture_live_state(self, view):
+        """{name: host ndarray} of the persistable scope state, or None when
+        this member's progress doesn't match the view's peer offer (it
+        crashed behind, or the offer is empty).  Called right after
+        _numpyify_scope, so every ref is already a plain numpy array."""
+        if (view.peer_step <= 0 or self.executor is None
+                or self.main_program is None
+                or not self._has_state
+                or self._state_step != view.peer_step):
+            return None
+        scope = self._live_scope()
+        out = {}
+        for name in self._persistable_names(self.main_program):
+            var = scope.find_var(name)
+            t = var.get_tensor() if var else None
+            if t is None:
+                continue
+            # ALL-OR-NOTHING: a var whose backend buffer was donated away
+            # (deleted jax.Array) or never materialized would silently keep
+            # its startup-init value after _set_state — a partial capture
+            # restored as if complete diverges the rank bitwise.  Fail the
+            # whole capture instead; the adoption falls back to peer-fetch
+            # or the filesystem checkpoint, both of which are complete.
+            try:
+                if not t._is_initialized():
+                    raise RuntimeError("uninitialized")
+                out[name] = np.array(t.get(), copy=True)
+            except Exception as e:
+                logging.warning(
+                    "[elastic] rank %d: live-state capture failed on %r "
+                    "(%s); falling back to peer/fs restore", self.rank,
+                    name, e)
+                return None
+        return out or None
+
+    def _set_state(self, program, state):
+        scope = self._live_scope()
+        names = self._persistable_names(program)
+        for name, arr in state.items():
+            if name in names:
+                scope.var(name).set(arr)
+
+    def _publish_live_state(self, view, state):
+        """Serve this member's live state on its ctrl server for rejoiners:
+        one meta var (json describing step/names/shapes/dtypes — the wire
+        flattens arrays) plus one var per tensor.  Previous epochs' payload
+        is dropped first so state from at most one epoch is ever held."""
+        for key in self._published_state:
+            try:
+                self._server.del_var(key)
+            except Exception:
+                pass
+        self._published_state = []
+        meta = {"step": int(view.peer_step),
+                "names": sorted(state),
+                "shapes": {n: list(np.shape(a)) for n, a in state.items()},
+                "dtypes": {n: str(np.asarray(a).dtype)
+                           for n, a in state.items()}}
+        mkey = "%s#%d" % (_STATE, view.epoch)
+        self._server.set_var(mkey, np.frombuffer(
+            json.dumps(meta).encode(), np.uint8).copy())
+        self._published_state.append(mkey)
+        for name, arr in state.items():
+            key = "%s#%d#%s" % (_STATE, view.epoch, name)
+            self._server.set_var(key, np.asarray(arr))
+            self._published_state.append(key)
+        _tm.event("elastic_state_published", rank=self.rank,
+                  epoch=view.epoch, step=view.peer_step, vars=len(state))
+
+    def _peer_fetch(self, view, program):
+        """Pull the peer source's live state over the native-RPC fabric and
+        set it into the scope (blocking gets: the publisher serves the
+        payload before its own slow adoption phases)."""
+        ep = _ctrl_endpoint(self.members[view.peer_src])
+        c = RpcClient(ep, connect_timeout=60.0, rpc_deadline=60.0,
+                      retry_times=1)
+        try:
+            raw = np.asarray(c.get_var("%s#%d" % (_STATE, view.epoch)))
+            meta = json.loads(raw.astype(np.uint8).tobytes().decode())
+            if int(meta["step"]) != int(view.peer_step):
+                raise RuntimeError("peer state step %s != offered %d"
+                                   % (meta["step"], view.peer_step))
+            scope = self._live_scope()
+            names = self._persistable_names(program)
+            got = 0
+            for name in meta["names"]:
+                if name not in names:
+                    continue
+                arr = np.asarray(c.get_var(
+                    "%s#%d#%s" % (_STATE, view.epoch, name)))
+                arr = arr.reshape(meta["shapes"][name]).astype(
+                    meta["dtypes"][name], copy=False)
+                scope.var(name).set(arr)
+                got += 1
+            _tm.event("elastic_state_fetched", rank=self.rank,
+                      epoch=view.epoch, src=view.peer_src, vars=got)
+        finally:
+            try:
+                c.close()
+            except Exception:
+                pass
 
     def _verify(self, main, startup, world, pid=None):
         from ..core import analysis
@@ -828,7 +1074,18 @@ class ElasticMember:
 
             specs = (self.feed_specs(world) if callable(self.feed_specs)
                      else self.feed_specs)
-            devs = jax.devices()[:world]
+            # jax.devices() is the GLOBAL list: its first `world` entries
+            # need not include any device this process can address, and
+            # materializing params onto a mesh with zero addressable
+            # shards raises a bare StopIteration from deep inside jax.
+            # Put our own device at this rank's standby position and fill
+            # the rest from the remaining global pool — the tier-B key
+            # carries no device ids, so the artifact stays loadable by the
+            # re-initialized post-requorum backend either way.
+            local = jax.local_devices()[0]
+            pool = [d for d in jax.devices() if d != local]
+            devs = [local if i == pid else pool.pop(0)
+                    for i in range(world)]
             try:
                 # the startup program bakes the world size into its
                 # c_comm_init nranks attr, so the shrunk world's startup is
@@ -838,7 +1095,7 @@ class ElasticMember:
                                      devices=devs)
             except Exception as e:
                 logging.warning("[elastic] standby startup pre-compile for "
-                                "world %s failed: %s", list(ranks), e)
+                                "world %s failed: %r", list(ranks), e)
             for attempt in (0, 1):
                 try:
                     got = self.executor.warmup(
@@ -852,7 +1109,7 @@ class ElasticMember:
                     # transpile+verify-only standby
                     if attempt:
                         logging.warning("[elastic] standby pre-compile for "
-                                        "world %s failed: %s", list(ranks), e)
+                                        "world %s failed: %r", list(ranks), e)
                         _tm.inc("elastic_standby_errors_total")
         # hash AFTER the warmup pre-compile: the executor may fuse
         # optimizer ops in place there, and the adoption-time check must
@@ -932,6 +1189,9 @@ class ElasticMember:
         re-formed: programs/restore_step were replaced, restart the loop
         from self.restore_step."""
         epoch = self.epoch
+        # at the gate for `step`, exactly `step` steps are complete — this
+        # is the state a re-quorum's peer-restore offer would broadcast
+        self._state_step = int(step)
         try:
             self._ctrl.send_var(_READY + str(self.rank),
                                 np.asarray([epoch, step], np.int64))
@@ -979,10 +1239,14 @@ class ElasticMember:
 
     def maybe_save(self, step):
         """Checkpoint from the view's first member only (shared ckpt_dir);
-        all members restore the same latest_valid() at re-quorum."""
+        all members restore the same latest_valid() at re-quorum.  Under a
+        sharded zero1 checkpoint every member writes — each rank stages its
+        own shard and pid 0 seals the directory (io._write_sharded)."""
         if self.ckpt is None or self.executor is None:
             return None
-        if self.pid != 0:
+        sharded = getattr(self.ckpt, "_shard_plan",
+                          lambda p: None)(self.main_program)
+        if self.pid != 0 and sharded is None:
             return None
         return self.ckpt.maybe_save(self.executor, self.main_program, step)
 

@@ -9,7 +9,7 @@ PADDLE_COORDINATOR for jax.distributed.initialize.
 
 Usage: python -m paddle_tpu.distributed.launch [--started_port P]
            [--cluster_node_ips ip1,ip2] [--node_ip ip] [--restart_failed N]
-           training_script args...
+           [--ckpt_dir DIR] training_script args...
 
 Supervision: ``--restart_failed N`` relaunches the training script up to N
 times after a nonzero exit (including death by signal — a SIGKILLed trainer
@@ -17,6 +17,14 @@ comes back).  Each incarnation sees PADDLE_RESTART_COUNT in its env (0 for
 the first launch), so training scripts can resume from
 io.CheckpointManager.latest_valid() instead of step 0 and fault-injection
 specs can disarm themselves after the first life.
+
+``--ckpt_dir DIR`` tells the launcher where the supervised script keeps its
+rolling checkpoints; before every (re)launch the launcher sweeps the
+directory for temp-dir orphans left by a killed writer (the same
+``<dir>._tmp.<pid>`` / consumed ``.parts`` rules as
+CheckpointManager._gc_stale_tmps) so crash loops cannot accrete disk.  The
+relaunched incarnation's own manager GCs too — the launcher sweep just
+covers scripts that die before ever constructing one.
 """
 
 import argparse
@@ -53,6 +61,10 @@ def _parse_args(argv=None):
     parser.add_argument("--trainers_num", type=int, default=None,
                         help="override the cluster size when launching "
                              "one member of a larger local cluster")
+    parser.add_argument("--ckpt_dir", type=str, default=None,
+                        help="checkpoint directory of the supervised "
+                             "script; swept for dead-writer temp orphans "
+                             "before every (re)launch")
     parser.add_argument("--endpoints_file", type=str, default=None,
                         help="path to a file holding the live cluster view "
                              "(first line: comma-separated trainer "
@@ -96,6 +108,7 @@ def launch(args=None):
     while True:
         env["PADDLE_RESTART_COUNT"] = str(restarts)
         _apply_endpoints_file(env, args.endpoints_file, node_id)
+        _gc_ckpt_tmps(args.ckpt_dir)
         proc = subprocess.Popen(cmd, env=env, start_new_session=True)
         cleanup = _supervise(proc)
         try:
@@ -111,6 +124,52 @@ def launch(args=None):
             "training script exited with %s — supervised relaunch %d/%d",
             proc.returncode, restarts, args.restart_failed)
         time.sleep(max(args.restart_delay, 0.0))
+
+
+def _gc_ckpt_tmps(ckpt_dir):
+    """Sweep dead-writer orphans out of ``--ckpt_dir`` before a (re)launch.
+
+    Stdlib-only mirror of CheckpointManager._gc_stale_tmps (the launcher
+    deliberately imports neither jax nor the framework): ``<x>._tmp.<pid>``
+    entries whose pid is gone, and ``ckpt-<step>.parts`` staging dirs whose
+    sealed ``ckpt-<step>`` already exists.  Sealed checkpoints are never
+    touched — the relaunched script restores from latest_valid() as usual."""
+    import re
+    import shutil
+
+    if not ckpt_dir or not os.path.isdir(ckpt_dir):
+        return 0
+
+    def _alive(pid):
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            return False
+        except OSError:
+            return True
+        return True
+
+    removed = 0
+    for name in sorted(os.listdir(ckpt_dir)):
+        full = os.path.join(ckpt_dir, name)
+        m = re.search(r"\._tmp\.(\d+)$", name)
+        if m:
+            pid = int(m.group(1))
+            if pid != os.getpid() and not _alive(pid):
+                shutil.rmtree(full, ignore_errors=True)
+                if not os.path.isdir(full) and os.path.exists(full):
+                    os.remove(full)
+                removed += 1
+            continue
+        if (name.startswith("ckpt-") and name.endswith(".parts")
+                and os.path.exists(os.path.join(
+                    ckpt_dir, name[:-len(".parts")], "_SUCCESS"))):
+            shutil.rmtree(full, ignore_errors=True)
+            removed += 1
+    if removed:
+        logging.warning("swept %d stale checkpoint temp(s) from %s",
+                        removed, ckpt_dir)
+    return removed
 
 
 def _apply_endpoints_file(env, path, node_id):

@@ -175,6 +175,34 @@ _DEFAULTS = {
     # one f32 max-abs scale per bucket per destination rank.  Smaller =
     # tighter scales (less quant error) but more scale bytes on the wire.
     "FLAGS_allreduce_quant_bucket": 512,
+    # async snapshot-to-host checkpointing (io.CheckpointManager): save()
+    # costs the step path ONE D2H host snapshot; serialization, crc32 and
+    # the atomic _SUCCESS-sealed directory write run on a background
+    # writer thread (at most one snapshot in flight — a save arriving
+    # while one is writing is dropped LOUDLY via
+    # checkpoint_save_overlap_total + a warning).  The telemetry split
+    # checkpoint_save_stall_ms (foreground) vs checkpoint_write_ms
+    # (background) proves the stall left the step path.
+    "FLAGS_checkpoint_async": False,
+    # shard-aware checkpoints under FLAGS_collective_mode=zero1: each
+    # rank writes only its own dim-0 slice of the sharded optimizer
+    # state (__shard_<r>of<w>__.npz; the _SUCCESS manifest records the
+    # layout exported by the transpiler), rank 0 writes the replicated
+    # vars once and seals.  restore() reassembles from whatever world
+    # the checkpoint was written by, so world changes re-shard for free.
+    # Off = every saver writes the full state (pre-sharding format,
+    # still readable by restore).
+    "FLAGS_checkpoint_sharded": True,
+    # peer-to-peer elastic restore (distributed/elastic.py): on
+    # re-quorum the adopted view prefers live post-step state held by
+    # survivors — their own scope, or an RPC fetch over the control
+    # fabric for a rejoining member — over re-reading the filesystem;
+    # latest_valid() remains the fallback when no survivor has state
+    # (checkpoint_restore_source_total{peer|fs}).  The COORDINATOR's
+    # flag decides for the whole world (the chosen resume step rides
+    # the published view), so members can never disagree on where to
+    # resume.
+    "FLAGS_checkpoint_p2p_restore": True,
     # elastic collective re-quorum (distributed/elastic.py): member
     # heartbeat period over the PADDLE_COORDINATOR control channel, and how
     # long a member may stay silent before the quorum evicts it and the

@@ -18,12 +18,17 @@ _SUCCESS convention, python/paddle/fluid/incubate/fleet/utils/fleet_util.py).
 """
 
 import json
+import logging
 import os
+import queue
+import re
+import threading
 import time
 import zlib
 
 import numpy as np
 
+from . import flags as _flags
 from .core.executor import global_scope
 from .framework import Parameter, Program, Variable
 from .utils.fault_injection import maybe_fail
@@ -31,6 +36,7 @@ from .utils.fs import LocalFS
 
 __all__ = [
     "CheckpointManager",
+    "shard_read_plan",
     "DataLoader",
     "PyReader",
     "save_vars",
@@ -409,6 +415,37 @@ def _file_crc32(path, chunk=1 << 20):
             crc = zlib.crc32(b, crc)
 
 
+def _pid_alive(pid):
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except OSError:  # EPERM etc: exists but not ours
+        return True
+    return True
+
+
+# how long rank 0 waits for peer shard parts before failing the save
+_SHARD_WAIT_S = 60.0
+
+_TMP_RE = re.compile(r"\._tmp\.(\d+)$")
+_SHARD_FILE = "__shard_%dof%d__.npz"
+
+
+def shard_read_plan(manifest, new_world):
+    """Partition a sharded manifest's per-rank shard files across a new
+    world so each file is read by EXACTLY ONE new rank (the world-4 -> 2
+    restore reads each tensor once across ranks, not N full copies).
+    Contiguous blocks: new rank r gets the old shards covering its row
+    range.  -> {new_rank: [old_rank, ...]}"""
+    old_world = int((manifest.get("shards") or {}).get("world", 1))
+    new_world = int(new_world)
+    plan = {r: [] for r in range(new_world)}
+    for old in range(old_world):
+        plan[min((old * new_world) // old_world, new_world - 1)].append(old)
+    return plan
+
+
 class CheckpointManager:
     """Rolling crash-safe checkpoints under ``ckpt_dir/ckpt-<step>``.
 
@@ -425,11 +462,32 @@ class CheckpointManager:
     --restart_failed): the trainer calls ``maybe_save`` every step; after
     a crash the relaunched process calls ``restore`` and resumes from the
     returned step instead of 0.
+
+    Async save (``FLAGS_checkpoint_async`` / ``async_save=True``): the
+    step-path cost of ``save`` collapses to one D2H snapshot
+    (Executor.snapshot_state); serialization, crc32, and the sealed
+    directory write run on a background writer thread.  At most one write
+    is in flight — a save landing while one is still writing is DROPPED
+    loudly (warning + ``checkpoint_save_overlap_total``) rather than
+    queued, so a slow disk can never stack snapshots in host RAM.
+    ``checkpoint_save_stall_ms`` records the foreground stall,
+    ``checkpoint_write_ms`` the background write.
+
+    Sharded save (``FLAGS_checkpoint_sharded``, on by default): when the
+    program carries zero1 collective meta with an exported
+    ``ckpt_shard_layout``, each rank writes only its own dim-0 rows of the
+    layout vars (``__shard_<r>of<w>__.npz`` staged under
+    ``ckpt-<step>.parts/``); rank 0 writes the replicated vars, adopts the
+    peer parts, and seals the manifest (which records the shard layout).
+    ``restore`` reassembles — or, with ``shard_scope="local"``, re-shards —
+    across world changes; :func:`shard_read_plan` partitions the shard
+    files so a world change reads each file exactly once across ranks.
     """
 
     _PREFIX = "ckpt-"
 
-    def __init__(self, ckpt_dir, save_interval=10, max_num=3, fs=None):
+    def __init__(self, ckpt_dir, save_interval=10, max_num=3, fs=None,
+                 async_save=None, sharded=None):
         if int(save_interval) < 1:
             raise ValueError("save_interval must be >= 1")
         if int(max_num) < 1:
@@ -438,6 +496,24 @@ class CheckpointManager:
         self.save_interval = int(save_interval)
         self.max_num = int(max_num)
         self._fs = fs or LocalFS()
+        if async_save is None:
+            async_save = bool(_flags.flag("checkpoint_async"))
+        if sharded is None:
+            sharded = bool(_flags.flag("checkpoint_sharded"))
+        self.async_save = bool(async_save)
+        self.sharded = bool(sharded)
+        # latest_valid() used to re-crc every candidate file on every
+        # call — cache the verdict per directory stat signature instead
+        self._valid_cache = {}
+        # async writer: single-slot queue, one daemon thread, one in-flight
+        self._idle = threading.Event()
+        self._idle.set()
+        self._queue = None
+        self._writer = None
+        self._write_err = None
+        # spans for cross-tree links (elastic requorum restore phase)
+        self.last_save_span = None
+        self.last_restore_span = None
 
     # -- enumeration --------------------------------------------------------
 
@@ -462,7 +538,7 @@ class CheckpointManager:
         except (OSError, ValueError):
             return None
 
-    def _is_valid(self, path):
+    def _verify(self, path):
         man = self._manifest(path)
         if man is None:
             return False
@@ -475,9 +551,43 @@ class CheckpointManager:
                 return False
         return True
 
+    def _dir_sig(self, path):
+        """Stat signature of every file in the checkpoint dir (name, mtime,
+        size) — None when the dir or its _SUCCESS is unreadable."""
+        if not os.path.exists(os.path.join(path, _SUCCESS_NAME)):
+            return None
+        try:
+            sig = []
+            for name in sorted(os.listdir(path)):
+                st = os.stat(os.path.join(path, name))
+                sig.append((name, st.st_mtime_ns, st.st_size))
+            return tuple(sig)
+        except OSError:
+            return None
+
+    def _is_valid(self, path):
+        """_verify with a per-(path, dir stat signature) cache so elastic
+        re-quorum doesn't pay a full-directory hash walk per adoption.  A
+        sealed directory is immutable, so the stat walk (mtime+size of every
+        file) is a sound cache key — any rewrite, replace, or in-place
+        tamper changes it; the crc walk runs only on a signature miss."""
+        sig = self._dir_sig(path)
+        if sig is None:
+            self._valid_cache.pop(path, None)
+            return False
+        hit = self._valid_cache.get(path)
+        if hit is not None and hit[0] == sig:
+            return hit[1]
+        ok = self._verify(path)
+        self._valid_cache[path] = (sig, ok)
+        return ok
+
     def latest_valid(self):
         """-> (step, path) of the newest checkpoint whose _SUCCESS manifest
-        verifies, or None when no usable checkpoint exists."""
+        verifies, or None when no usable checkpoint exists.  Waits out any
+        in-flight background write first so an async save just submitted is
+        visible to the caller."""
+        self._idle.wait()
         for step, path in reversed(self._step_dirs()):
             if self._is_valid(path):
                 return step, path
@@ -485,34 +595,80 @@ class CheckpointManager:
 
     # -- write side ---------------------------------------------------------
 
+    def _snapshot(self, executor, program):
+        """D2H host-copy of the persistable state — the only step-path cost
+        of an async save.  Prefers Executor.snapshot_state (one device_get
+        per tensor, traced); degrades to a direct scope walk for bare
+        executors (tests, legacy callers)."""
+        if hasattr(executor, "snapshot_state"):
+            return executor.snapshot_state(program or _default_main())
+        scope = global_scope()
+        out = {}
+        for var in (program or _default_main()).list_vars():
+            if not _is_persistable(var):
+                continue
+            sv = scope.find_var(var.name)
+            if sv is None or not sv.get_tensor()._is_initialized():
+                continue
+            out[var.name] = np.array(sv.get_tensor().numpy(), copy=True)
+        return out
+
+    def _shard_plan(self, program):
+        """-> {"rank","world","layout"} when this program runs zero1 with an
+        exported checkpoint shard layout and sharded save is on, else None
+        (plain full-state save)."""
+        if not self.sharded or program is None:
+            return None
+        meta = getattr(program, "_collective_meta", None)
+        if not meta or meta.get("mode") != "zero1":
+            return None
+        world = int(meta.get("nranks") or 1)
+        layout = meta.get("ckpt_shard_layout") or {}
+        if world <= 1 or not layout:
+            return None
+        return {"rank": int(meta.get("rank") or 0), "world": world,
+                "layout": layout}
+
     def save(self, executor, program, step, extra=None):
         """Write checkpoint ``ckpt-<step>`` (persistables + manifest) and
-        prune beyond max_num.  Returns the checkpoint path."""
+        prune beyond max_num.  Returns the checkpoint path — which, under
+        async save, the background writer may still be sealing (call
+        ``wait()`` to block on it); returns None when the save was dropped
+        because a previous write is still in flight."""
         from .core import telemetry as _tm
+        from .core import tracing as _tr
 
         t0 = time.perf_counter()
-        self._fs.mkdirs(self.ckpt_dir)
+        mode = "async" if self.async_save else "sync"
+        root = _tr.start_span("checkpoint.save", step=int(step), mode=mode)
+        plan = self._shard_plan(program)
         target = os.path.join(self.ckpt_dir, "%s%d" % (self._PREFIX, step))
-        with self._fs.atomic_write_dir(target) as tmp:
-            save_persistables(executor, tmp, program)
-            files = {
-                name: _file_crc32(os.path.join(tmp, name))
-                for name in sorted(os.listdir(tmp))
-                if name != _SUCCESS_NAME
-            }
-            manifest = {"step": int(step), "files": files}
-            if extra is not None:
-                manifest["extra"] = extra
-            # manifest last: its presence asserts every file above is
-            # complete (the _SUCCESS convention)
-            with open(os.path.join(tmp, _SUCCESS_NAME), "w") as f:
-                json.dump(manifest, f)
-        self._prune()
+        if self.async_save and not self._idle.is_set():
+            logging.warning(
+                "checkpoint save at step %d dropped: previous background "
+                "write still in flight (disk slower than save_interval?)",
+                step)
+            if _tm.enabled():
+                _tm.inc("checkpoint_save_overlap_total")
+            root.annotate(dropped=True).end()
+            return None
+        with _tr.activate(root):
+            state = self._snapshot(executor, program)
+        if self.async_save:
+            self._submit(state, int(step), extra, plan, root)
+        else:
+            self._write_checkpoint(state, int(step), extra, plan,
+                                   parent=root)
+        root.end()
+        self.last_save_span = root
         if _tm.enabled():
-            ms = (time.perf_counter() - t0) * 1e3
-            _tm.observe("checkpoint_save_ms", ms)
+            stall = (time.perf_counter() - t0) * 1e3
+            # checkpoint_save_ms keeps its historical meaning (foreground
+            # cost of save()); the stall/write split is the async story
+            _tm.observe("checkpoint_save_ms", stall)
+            _tm.observe("checkpoint_save_stall_ms", stall)
             _tm.event("checkpoint_save", step=int(step),
-                      ms=round(ms, 3), files=len(files))
+                      ms=round(stall, 3), mode=mode)
         return target
 
     def maybe_save(self, executor, program, step, extra=None):
@@ -521,32 +677,261 @@ class CheckpointManager:
             return self.save(executor, program, step, extra=extra)
         return None
 
+    # -- background writer ---------------------------------------------------
+
+    def _submit(self, state, step, extra, plan, root):
+        if self._writer is None or not self._writer.is_alive():
+            self._queue = queue.Queue(maxsize=1)
+            self._writer = threading.Thread(target=self._writer_loop,
+                                            name="ckpt-writer", daemon=True)
+            self._writer.start()
+        self._idle.clear()
+        self._queue.put((state, step, extra, plan, root))
+
+    def _writer_loop(self):
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            state, step, extra, plan, root = item
+            try:
+                self._write_checkpoint(state, step, extra, plan, parent=root)
+            except BaseException as e:  # surfaced by the next wait()
+                logging.error("checkpoint background write for step %d "
+                              "failed: %s", step, e)
+                self._write_err = e
+            finally:
+                self._idle.set()
+
+    def wait(self, timeout=None):
+        """Block until no background write is in flight; re-raises a stashed
+        writer failure.  -> True when idle (False on timeout)."""
+        ok = self._idle.wait(timeout)
+        err, self._write_err = self._write_err, None
+        if err is not None:
+            raise err
+        return ok
+
+    # -- serialization (runs on the writer thread under async save) ---------
+
+    def _write_checkpoint(self, state, step, extra, plan, parent=None):
+        from .core import telemetry as _tm
+        from .core import tracing as _tr
+
+        t0 = time.perf_counter()
+        self._fs.mkdirs(self.ckpt_dir)
+        target = os.path.join(self.ckpt_dir, "%s%d" % (self._PREFIX, step))
+        with _tr.span("checkpoint.write", parent=parent, step=int(step)):
+            if plan is None:
+                with self._fs.atomic_write_dir(target) as tmp:
+                    _atomic_write(os.path.join(tmp, "__params__.npz"),
+                                  lambda f: np.savez(f, **state))
+                    self._seal(tmp, step, extra, None)
+            else:
+                self._write_sharded(target, state, step, extra, plan)
+            self._prune()
+        if _tm.enabled():
+            ms = (time.perf_counter() - t0) * 1e3
+            _tm.observe("checkpoint_write_ms", ms)
+            _tm.event("checkpoint_write", step=int(step), ms=round(ms, 3),
+                      files=len(state))
+        return target
+
+    def _seal(self, tmp, step, extra, shards):
+        """crc every file then write the _SUCCESS manifest LAST: its
+        presence asserts every file above is complete."""
+        files = {
+            name: _file_crc32(os.path.join(tmp, name))
+            for name in sorted(os.listdir(tmp))
+            if name != _SUCCESS_NAME
+        }
+        manifest = {"step": int(step), "files": files}
+        if shards is not None:
+            manifest["shards"] = shards
+        if extra is not None:
+            manifest["extra"] = extra
+        with open(os.path.join(tmp, _SUCCESS_NAME), "w") as f:
+            json.dump(manifest, f)
+
+    def _write_sharded(self, target, state, step, extra, plan):
+        """zero1 multi-writer: rank r stages only its own dim-0 rows of the
+        layout vars under ``<target>.parts/``; rank 0 writes the replicated
+        vars + its shard, adopts peer parts, and seals.  A rank killed
+        mid-part leaves only temp files / an unsealed parts dir — the
+        previous checkpoint stays the latest valid one."""
+        rank, world, layout = plan["rank"], plan["world"], plan["layout"]
+        parts = target + ".parts"
+        self._fs.mkdirs(parts)
+        mine = {}
+        for name, lay in layout.items():
+            if name not in state:
+                continue
+            rpr = int(lay["rows_per_rank"])
+            mine[name] = state[name][rank * rpr:(rank + 1) * rpr]
+        fname = _SHARD_FILE % (rank, world)
+        if rank != 0:
+            path = os.path.join(parts, fname)
+            _atomic_write(path, lambda f: np.savez(f, **mine))
+            # .ok marker last: tells rank 0 the part is complete
+            _atomic_write(path + ".ok", lambda f: json.dump(
+                {"crc": _file_crc32(path)}, f), mode="w")
+            return
+        repl = {n: a for n, a in state.items() if n not in layout}
+        with self._fs.atomic_write_dir(target) as tmp:
+            _atomic_write(os.path.join(tmp, "__params__.npz"),
+                          lambda f: np.savez(f, **repl))
+            _atomic_write(os.path.join(tmp, fname),
+                          lambda f: np.savez(f, **mine))
+            deadline = time.monotonic() + _SHARD_WAIT_S
+            for r in range(1, world):
+                pf = os.path.join(parts, _SHARD_FILE % (r, world))
+                while not os.path.exists(pf + ".ok"):
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(
+                            "sharded checkpoint step %d: rank %d part not "
+                            "staged within %.0fs (%s)"
+                            % (step, r, _SHARD_WAIT_S, pf))
+                    time.sleep(0.02)
+                os.replace(pf, os.path.join(tmp, _SHARD_FILE % (r, world)))
+                os.remove(pf + ".ok")
+            self._seal(tmp, step, extra, {
+                "world": int(world),
+                "layout": {n: {"dim0": int(lay["dim0"]),
+                               "rows_per_rank": int(lay["rows_per_rank"])}
+                           for n, lay in layout.items()}})
+        self._fs.delete(parts)
+
     def _prune(self):
         dirs = self._step_dirs()
         for _, path in dirs[:-self.max_num]:
             self._fs.delete(path)
+            self._valid_cache.pop(path, None)
+        self._gc_stale_tmps()
+
+    def _gc_stale_tmps(self):
+        """Satellite GC: a SIGKILL mid-atomic_write_dir leaves
+        ``<dir>._tmp.<pid>`` orphans (and a sharded save can leave a
+        ``.parts`` staging dir) that keep-last-K pruning never touched.
+        Temps owned by a live pid are spared — that's a concurrent writer."""
+        from .core import telemetry as _tm
+
+        removed = 0
+        newest = max((s for s, _ in self._step_dirs()), default=None)
+        for name in self._fs.ls_dir(self.ckpt_dir):
+            full = os.path.join(self.ckpt_dir, name)
+            m = _TMP_RE.search(name)
+            if m:
+                pid = int(m.group(1))
+                if pid != os.getpid() and not _pid_alive(pid):
+                    self._fs.delete(full)
+                    removed += 1
+                continue
+            if name.startswith(self._PREFIX) and name.endswith(".parts"):
+                base = name[:-len(".parts")]
+                try:
+                    step = int(base[len(self._PREFIX):])
+                except ValueError:
+                    continue
+                sealed = os.path.join(self.ckpt_dir, base, _SUCCESS_NAME)
+                if os.path.exists(sealed) or (newest is not None
+                                              and step < newest):
+                    self._fs.delete(full)
+                    removed += 1
+        if removed and _tm.enabled():
+            _tm.inc("checkpoint_tmp_gc_total", removed)
+        return removed
 
     # -- read side ----------------------------------------------------------
 
-    def restore(self, executor, program):
+    def restore(self, executor, program, shard_scope="full", world=None,
+                rank=None):
         """Load the newest valid checkpoint into the global scope.
         Returns (step, extra) — or (0, None) when nothing valid exists, so
-        callers can resume their loop unconditionally from the result."""
+        callers can resume their loop unconditionally from the result.
+
+        Sharded checkpoints reassemble the full arrays by default (each
+        shard file opened exactly once per process, any world).  With
+        ``shard_scope="local"`` (+ ``world``/``rank`` overriding the
+        program's collective meta) only the shard files overlapping this
+        rank's dim-0 rows are read — the multi-process path where a world
+        change reads each tensor once ACROSS ranks, per shard_read_plan."""
         from .core import telemetry as _tm
+        from .core import tracing as _tr
 
         t0 = time.perf_counter()
         found = self.latest_valid()
         if found is None:
             return 0, None
         step, path = found
-        load_persistables(executor, path, program)
         man = self._manifest(path)
+        with _tr.span("checkpoint.restore", step=int(step)) as root:
+            if (man or {}).get("shards"):
+                self._load_sharded(path, man, program, shard_scope,
+                                   world, rank)
+            else:
+                load_persistables(executor, path, program)
+        self.last_restore_span = root
         if _tm.enabled():
             ms = (time.perf_counter() - t0) * 1e3
             _tm.observe("checkpoint_restore_ms", ms)
             _tm.event("checkpoint_restore", step=int(step),
                       ms=round(ms, 3))
         return step, (man or {}).get("extra")
+
+    def _load_sharded(self, path, man, program, shard_scope, world, rank):
+        """Reassemble (or locally re-shard) a sharded checkpoint.  The scope
+        holds FULL arrays for zero1 layout vars (the executor's sharding
+        annotation re-slices them onto whatever mesh compiles), so "full"
+        concatenates every shard; "local" fills only this rank's rows into
+        the existing scope array and leaves the rest untouched."""
+        program = program or _default_main()
+        scope = global_scope()
+        shards = man["shards"]
+        old_world = int(shards["world"])
+        layout = shards.get("layout") or {}
+        names = {v.name for v in program.list_vars() if _is_persistable(v)}
+        with np.load(os.path.join(path, "__params__.npz"),
+                     allow_pickle=False) as data:
+            for name in data.files:
+                if name in names:
+                    scope.var(name).set(data[name])
+        wanted = [n for n in layout if n in names]
+        if not wanted:
+            return
+        if shard_scope == "local":
+            if world is None or rank is None:
+                meta = getattr(program, "_collective_meta", None) or {}
+                world = int(meta.get("nranks") or 1)
+                rank = int(meta.get("rank") or 0)
+            reads = shard_read_plan(man, world).get(int(rank), [])
+        else:
+            reads = list(range(old_world))
+        pieces = {n: {} for n in wanted}
+        for old in reads:  # each shard file opened exactly once
+            sf = os.path.join(path, _SHARD_FILE % (old, old_world))
+            with np.load(sf, allow_pickle=False) as sd:
+                for n in wanted:
+                    if n in sd.files:
+                        pieces[n][old] = sd[n]
+        for n in wanted:
+            got = pieces[n]
+            if not got:
+                continue
+            if shard_scope != "local":
+                full = np.concatenate([got[o] for o in sorted(got)], axis=0)
+                scope.var(n).set(full)
+                continue
+            rpr = int(layout[n]["rows_per_rank"])
+            dim0 = int(layout[n]["dim0"])
+            sample = next(iter(got.values()))
+            sv = scope.find_var(n)
+            if sv is not None and sv.get_tensor()._is_initialized():
+                cur = np.array(sv.get_tensor().numpy(), copy=True)
+            else:
+                cur = np.zeros((dim0,) + sample.shape[1:], sample.dtype)
+            for o, arr in got.items():
+                cur[o * rpr:o * rpr + arr.shape[0]] = arr
+            scope.var(n).set(cur)
 
 
 # -- fluid.save / fluid.load (v1.6 single-call training state) ---------------

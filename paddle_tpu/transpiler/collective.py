@@ -349,9 +349,18 @@ class ShardedGradAllReduce(Collective):
     def __init__(self, nrings=1):
         super().__init__(nrings)
         self._shards = {}
+        # shard-resident optimizer-state vars (the rewired slots), keyed
+        # by scope name: the checkpoint layer reads this to write only
+        # this rank's dim-0 slice of each (io.CheckpointManager sharded
+        # save) and to reassemble/re-shard on restore after a world
+        # change.  Slot vars keep their global names and the scope holds
+        # the FULL arrays, so the layout speaks in global dim0 + rows
+        # per rank of the world this program was transpiled for.
+        self._ckpt_layout = {}
 
     def _meta_extra(self):
-        return {"zero1_shards": dict(self._shards)}
+        return {"zero1_shards": dict(self._shards),
+                "ckpt_shard_layout": dict(self._ckpt_layout)}
 
     def _optimizer_ops_by_grad(self, block):
         by_grad = {}
@@ -534,10 +543,15 @@ class ShardedGradAllReduce(Collective):
         op.inputs["Param"] = [pshard]
         op.inputs["Grad"] = [gshard]
         op.outputs["ParamOut"] = [pshard]
+        dim0 = int(shard_shape[0]) * self.nranks
         for in_slot, _out_slot in slots:
             sv = block.var(op.input(in_slot)[0])
             sv.shape = tuple(shard_shape)
             sv.sharding = (_DATA_AXIS,) + (None,) * (len(shard_shape) - 1)
+            self._ckpt_layout[sv.name] = {
+                "param": param, "dim0": dim0,
+                "rows_per_rank": int(shard_shape[0]),
+            }
         self.main_program._bump_version()
 
 

@@ -10,13 +10,21 @@ dense pid/world, and checkpoints through a shared CheckpointManager.
 Markers on stdout, one per line, for the test harness:
 
   start: rank=R epoch=E world=W restore=S     after the first adoption
-  start_phases: compile=C                     cold compile ms of that adoption
+  start_phases: compile=C source=SRC          cold compile ms of that adoption
+                                              + where its state came from
+                                              (peer|fs|none)
   mark:step=S world=W epoch=E                 before running step S
   loss:<float>                                after running a step
   requorum: epoch=E world=W restore=S         after adopting a new view
-  requorum_phases: standby=B transpile=T verify=V compile=C restore=R
+  requorum_phases: standby=B transpile=T verify=V compile=C restore=R source=SRC
                                               phase breakdown (ms) of the
-                                              same adoption
+                                              same adoption + restore source
+  statehash:step=S hash=H                     sha256 (truncated) over the
+                                              restored persistable state,
+                                              after start and after every
+                                              requorum — ranks that restored
+                                              the same step must print the
+                                              same hash, bitwise
   standby: {(ranks): compiled, ...}           after wait_standby (with
                                               --wait_standby)
   done: rank=R epoch=E world=W                clean completion
@@ -117,12 +125,30 @@ def main():
         # for standby worlds and warm the adopted world eagerly
         feed_specs=lambda world: {"x": ((ROWS // world, 6), "float32"),
                                   "y": ((ROWS // world, 1), "float32")})
+    def state_hash():
+        import hashlib
+
+        scope = fluid.global_scope()
+        h = hashlib.sha256()
+        for name in sorted(v.name for v in member.main_program.list_vars()
+                           if v.persistable and not v.is_data):
+            sv = scope.find_var(name)
+            if sv is None or not sv.get_tensor()._is_initialized():
+                continue
+            arr = np.asarray(sv.get_tensor().numpy())
+            h.update(name.encode())
+            h.update(arr.tobytes())
+        return h.hexdigest()[:16]
+
     member.start()
     print("start: rank=%d epoch=%d world=%d restore=%d"
           % (member.rank, member.epoch, member.world, member.restore_step),
           flush=True)
-    print("start_phases: compile=%.3f"
-          % member.last_adopt_phases.get("compile", -1.0), flush=True)
+    print("start_phases: compile=%.3f source=%s"
+          % (member.last_adopt_phases.get("compile", -1.0),
+             member.last_restore_source or "none"), flush=True)
+    print("statehash:step=%d hash=%s"
+          % (member.restore_step, state_hash()), flush=True)
     if args.wait_standby:
         built = member.wait_standby(timeout=300.0)
         print("standby: %s" % sorted(built.items()), flush=True)
@@ -132,11 +158,13 @@ def main():
         print("requorum: epoch=%d world=%d restore=%d"
               % (member.epoch, member.world, member.restore_step), flush=True)
         print("requorum_phases: standby=%d transpile=%.3f verify=%.3f "
-              "compile=%.3f restore=%.3f"
+              "compile=%.3f restore=%.3f source=%s"
               % (1 if member.last_adopt_standby else 0,
                  ph.get("transpile", -1.0), ph.get("verify", -1.0),
-                 ph.get("compile", -1.0), ph.get("restore", -1.0)),
-              flush=True)
+                 ph.get("compile", -1.0), ph.get("restore", -1.0),
+                 member.last_restore_source or "none"), flush=True)
+        print("statehash:step=%d hash=%s"
+              % (member.restore_step, state_hash()), flush=True)
 
     step = member.restore_step
     while step < STEPS:
@@ -163,6 +191,9 @@ def main():
                        fetch_list=[loss.name])
         print("loss:%.8f" % float(np.asarray(out).reshape(-1)[0]),
               flush=True)
+        if os.environ.get("ELASTIC_PAYLOAD_STEP_HASH"):
+            print("shash:step=%d world=%d h=%s"
+                  % (step, member.world, state_hash()), flush=True)
         step += 1
         member.maybe_save(step)
     print("done: rank=%d epoch=%d world=%d"

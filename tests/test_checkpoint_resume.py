@@ -253,3 +253,237 @@ def test_sigkill_mid_save_never_accepts_torn_checkpoint(tmp_path):
     # the torn step-2 attempt only ever existed as a temp dir, which the
     # manager's enumeration ignores
     assert not os.path.exists(os.path.join(ckpt_dir, "ckpt-2"))
+
+
+# --- async save: snapshot on the step path, write in the background ---------
+
+
+def test_async_save_bitwise_matches_sync(tmp_path):
+    """The background writer serializes the SAME bytes the sync path would:
+    train a few steps, save through both modes, compare the bundles
+    bitwise and the manifests structurally."""
+    import os
+
+    main, startup, loss = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    sync_dir = str(tmp_path / "sync")
+    async_dir = str(tmp_path / "async")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for i in range(3):
+            xb, yb = _data(i)
+            exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+        smgr = fluid.io.CheckpointManager(sync_dir, save_interval=1,
+                                          max_num=3, async_save=False)
+        smgr.save(exe, main, 3, extra={"epoch": 7})
+        amgr = fluid.io.CheckpointManager(async_dir, save_interval=1,
+                                          max_num=3, async_save=True)
+        assert amgr.save(exe, main, 3, extra={"epoch": 7}) is not None
+        assert amgr.wait(timeout=120)
+
+    with np.load(os.path.join(sync_dir, "ckpt-3", "__params__.npz")) as sa, \
+            np.load(os.path.join(async_dir, "ckpt-3",
+                                 "__params__.npz")) as aa:
+        assert sorted(sa.files) == sorted(aa.files)
+        for n in sa.files:
+            assert sa[n].dtype == aa[n].dtype
+            np.testing.assert_array_equal(sa[n], aa[n])
+
+    found = amgr.latest_valid()
+    assert found is not None and found[0] == 3
+
+    # and a fresh-process restore resumes from it like any sync checkpoint
+    main2, startup2, _ = _build()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup2)
+        step, extra = fluid.io.CheckpointManager(
+            async_dir, save_interval=1, max_num=3).restore(exe, main2)
+    assert (step, extra) == (3, {"epoch": 7})
+
+
+def test_async_overlap_drops_save_loudly(tmp_path):
+    """Single-slot writer: a save landing while the previous background
+    write is still on disk-time is DROPPED (returns None, counter bumped) —
+    snapshots never stack in host RAM behind a slow disk."""
+    import os
+
+    from paddle_tpu.core import telemetry as _tm
+    from paddle_tpu.utils import fault_injection as fi
+
+    ckpt_dir = str(tmp_path / "ovl")
+    mgr = fluid.io.CheckpointManager(ckpt_dir, save_interval=1, max_num=5,
+                                     async_save=True)
+    main, startup, loss = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    fluid.set_flags({"FLAGS_telemetry": True})
+    base = _tm.counter_total("checkpoint_save_overlap_total") or 0
+    try:
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            xb, yb = _data(0)
+            exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+            fi.arm("ckpt.write:delay:1")  # slow disk: writer sleeps >=50ms
+            try:
+                assert mgr.save(exe, main, 1) is not None
+                # the step-1 write is still in flight -> step 2 is dropped
+                assert mgr.save(exe, main, 2) is None
+                assert mgr.wait(timeout=120)
+            finally:
+                fi.disarm()
+            assert (_tm.counter_total("checkpoint_save_overlap_total")
+                    - base) == 1
+            # dropped means DROPPED: no torn/partial step-2 dir
+            assert mgr.latest_valid()[0] == 1
+            assert not os.path.exists(os.path.join(ckpt_dir, "ckpt-2"))
+            # the writer is reusable after a drop
+            assert mgr.save(exe, main, 3) is not None
+            assert mgr.wait(timeout=120)
+            assert mgr.latest_valid()[0] == 3
+    finally:
+        fluid.set_flags({"FLAGS_telemetry": False})
+
+
+_KILL_MID_ASYNC_SAVE = """
+import sys
+import paddle_tpu as fluid
+from paddle_tpu.utils import fault_injection as fi
+
+ckpt_dir = sys.argv[1]
+main, startup = fluid.Program(), fluid.Program()
+with fluid.program_guard(main, startup):
+    x = fluid.layers.data("x", shape=[4])
+    fluid.layers.fc(x, 2, param_attr=fluid.ParamAttr(name="ka_w"))
+exe = fluid.Executor(fluid.CPUPlace())
+exe.run(startup)
+mgr = fluid.io.CheckpointManager(ckpt_dir, save_interval=1, max_num=3,
+                                 async_save=True)
+mgr.save(exe, main, 1)
+mgr.wait()
+print("saved:1", flush=True)
+fi.arm("ckpt.write:kill:1")   # fires on the BACKGROUND writer thread
+mgr.save(exe, main, 2)
+mgr.wait()
+print("unreachable", flush=True)
+"""
+
+
+def test_sigkill_during_async_write_keeps_previous_checkpoint(tmp_path):
+    """A SIGKILL landing mid background write (the async analogue of the
+    sync torn-save test) leaves the previous sealed checkpoint as the
+    latest valid one, plus an orphan temp dir that the next manager's GC
+    sweep removes."""
+    import os
+    import signal
+    import subprocess
+    import sys
+
+    script = tmp_path / "kill_async_save.py"
+    script.write_text(_KILL_MID_ASYNC_SAVE)
+    ckpt_dir = str(tmp_path / "mgr5")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=repo_root + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+    p = subprocess.run([sys.executable, str(script), ckpt_dir],
+                       capture_output=True, text=True, timeout=120,
+                       env=env)
+    assert p.returncode == -signal.SIGKILL, (p.returncode, p.stderr)
+    assert "saved:1" in p.stdout
+    assert "unreachable" not in p.stdout
+
+    # the kill tore only the step-2 temp dir; step 1 stays latest-valid
+    mgr = fluid.io.CheckpointManager(ckpt_dir, save_interval=1, max_num=3)
+    found = mgr.latest_valid()
+    assert found is not None and found[0] == 1, found
+    assert not os.path.exists(os.path.join(ckpt_dir, "ckpt-2"))
+    orphans = [n for n in os.listdir(ckpt_dir) if "._tmp." in n]
+    assert orphans, os.listdir(ckpt_dir)
+    # the dead writer's pid is gone -> the GC sweep reclaims its temps
+    assert mgr._gc_stale_tmps() >= 1
+    assert not [n for n in os.listdir(ckpt_dir) if "._tmp." in n]
+    assert mgr.latest_valid()[0] == 1
+
+
+def test_gc_stale_tmps_spares_live_writers(tmp_path):
+    """The GC sweep removes temp dirs owned by dead pids and consumed
+    .parts staging dirs, but never a live writer's temp or an unsealed
+    newest .parts (that's a save in progress)."""
+    import os
+    import subprocess
+    import sys
+
+    ckpt_dir = str(tmp_path / "gc")
+    mgr = fluid.io.CheckpointManager(ckpt_dir, save_interval=1, max_num=3)
+    main, startup, loss = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        mgr.save(exe, main, 1)
+
+    # a pid guaranteed dead AND reaped
+    p = subprocess.Popen([sys.executable, "-c", "pass"])
+    p.wait()
+    dead = os.path.join(ckpt_dir, "ckpt-2._tmp.%d" % p.pid)
+    os.makedirs(dead)
+    with open(os.path.join(dead, "partial.npz"), "wb") as f:
+        f.write(b"torn")
+    live = os.path.join(ckpt_dir, "ckpt-3._tmp.%d" % os.getpid())
+    os.makedirs(live)
+    # .parts of an already-sealed step: leftover staging, reclaimable
+    consumed = os.path.join(ckpt_dir, "ckpt-1.parts")
+    os.makedirs(consumed)
+
+    assert mgr._gc_stale_tmps() == 2
+    assert not os.path.exists(dead)
+    assert not os.path.exists(consumed)
+    assert os.path.exists(live)        # our own pid: a concurrent writer
+    assert mgr.latest_valid()[0] == 1  # sealed data untouched
+    os.rmdir(live)
+
+
+def test_latest_valid_caches_crc_verification(tmp_path, monkeypatch):
+    """latest_valid() re-crc'd every candidate file on every call; now the
+    verdict is cached per directory stat signature (name, mtime, size of
+    every file) — any rewrite or tamper invalidates, everything else is a
+    stat-only fast path."""
+    import json
+    import os
+
+    from paddle_tpu import io as pio
+
+    ckpt_dir = str(tmp_path / "vc")
+    mgr = fluid.io.CheckpointManager(ckpt_dir, save_interval=1, max_num=3)
+    main, startup, loss = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        mgr.save(exe, main, 1)
+        mgr.save(exe, main, 2)
+
+    calls = {"n": 0}
+    real = pio._file_crc32
+
+    def counting(path, chunk=1 << 20):
+        calls["n"] += 1
+        return real(path, chunk)
+
+    monkeypatch.setattr(pio, "_file_crc32", counting)
+    assert mgr.latest_valid()[0] == 2
+    first = calls["n"]
+    assert first > 0
+    for _ in range(5):
+        assert mgr.latest_valid()[0] == 2
+    assert calls["n"] == first, "cached verdict re-hashed the directory"
+
+    # a REWRITTEN manifest (new signature) forces re-verification
+    sfile = os.path.join(ckpt_dir, "ckpt-2", "_SUCCESS")
+    with open(sfile) as f:
+        man = json.load(f)
+    with open(sfile, "w") as f:
+        json.dump(man, f, indent=1)
+    assert mgr.latest_valid()[0] == 2
+    assert calls["n"] > first
+
+    # a fresh manager starts cold but converges to the same answer
+    mgr2 = fluid.io.CheckpointManager(ckpt_dir, save_interval=1, max_num=3)
+    assert mgr2.latest_valid()[0] == 2

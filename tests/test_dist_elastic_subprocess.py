@@ -90,7 +90,7 @@ def _clean_env():
     return env
 
 
-def _member_env(rank, eps, tmp, restart=0):
+def _member_env(rank, eps, tmp, restart=0, extra_env=None):
     env = _clean_env()
     env.update({
         "JAX_PLATFORMS": "cpu",
@@ -111,13 +111,17 @@ def _member_env(rank, eps, tmp, restart=0):
         # device ids precisely so they survive the jax re-init)
         "FLAGS_compile_cache_dir": os.path.join(str(tmp), "cc"),
     })
+    if extra_env:
+        env.update(extra_env)
     return env
 
 
-def _spawn(name, rank, eps, tmp, ckpt_dir, extra=(), restart=0):
+def _spawn(name, rank, eps, tmp, ckpt_dir, extra=(), restart=0,
+           extra_env=None):
     cmd = [sys.executable, "-u", _PAYLOAD, "--ckpt_dir", ckpt_dir]
     cmd += list(extra)
-    proc = subprocess.Popen(cmd, env=_member_env(rank, eps, tmp, restart),
+    proc = subprocess.Popen(cmd, env=_member_env(rank, eps, tmp, restart,
+                                                 extra_env=extra_env),
                             stdout=subprocess.PIPE,
                             stderr=subprocess.STDOUT, text=True,
                             start_new_session=True)
@@ -129,20 +133,57 @@ def _losses(text):
                                          re.MULTILINE)]
 
 
+def _state_hashes(text):
+    """{restore_step: hash} from the payload's statehash: markers (printed
+    after start and after every requorum, over the restored persistables)."""
+    return {int(m.group(1)): m.group(2) for m in
+            re.finditer(r"statehash:step=(\d+) hash=(\w+)", text)}
+
+
+def _step_losses(text):
+    """{(step, world): loss} pairing each mark: line with the loss: line
+    that follows it (the LAST occurrence wins — a step re-run after a
+    restore overwrites its pre-requorum entry at the same world)."""
+    out = {}
+    pending = None
+    for line in text.splitlines():
+        m = re.match(r"mark:step=(\d+) world=(\d+)", line)
+        if m:
+            pending = (int(m.group(1)), int(m.group(2)))
+            continue
+        m = re.match(r"loss:([-\d.e]+)", line)
+        if m and pending is not None:
+            out[pending] = float(m.group(1))
+            pending = None
+    return out
+
+
+# handoff between the fs-path scenario below and the peer-path scenario:
+# same topology/schedule, so the peer run can assert its restore phase is
+# cheaper and its trajectory bitwise-equal (pytest runs this file in
+# definition order under tier-1's -p no:randomly)
+_FS_RUN = {}
+
+
 def test_evict_requorum_and_rejoin(tmp_path):
     ports = free_ports(N)
     eps = ["127.0.0.1:%d" % p for p in ports]
     ckpt_dir = str(tmp_path / "ckpt")
 
+    # peer-to-peer restore OFF: this scenario is the filesystem-restore
+    # baseline the peer-path test compares against
+    fs_env = {"FLAGS_checkpoint_p2p_restore": "0"}
     # --wait_standby: members block until the background standby builder
     # has pre-transpiled + pre-compiled the shrink candidates, making the
     # post-eviction standby HIT deterministic instead of a race between
     # the builder thread and the victim's death
     hold = ("--hold_at", str(HOLD_AT), str(N), "--wait_standby")
-    tails = [_spawn("m:%d" % r, r, eps, tmp_path, ckpt_dir, extra=hold)
+    tails = [_spawn("m:%d" % r, r, eps, tmp_path, ckpt_dir, extra=hold,
+                    extra_env=fs_env)
              for r in range(N - 1)]
     victim = _spawn("victim", VICTIM, eps, tmp_path, ckpt_dir,
-                    extra=("--pause_at", str(PAUSE_AT), "--wait_standby"))
+                    extra=("--pause_at", str(PAUSE_AT), "--wait_standby"),
+                    extra_env=fs_env)
     tails.append(victim)
     try:
         # 1. victim reaches the pause point -> SIGKILL it (mid-training,
@@ -169,6 +210,10 @@ def test_evict_requorum_and_rejoin(tmp_path):
                        r"compile=([\d.]+) restore=([\d.]+)", pline)
         assert pm, pline
         assert pm.group(1) == "1", "standby view missed:\n" + pline
+        # with p2p off the survivor restored from the filesystem — record
+        # the phase cost for the peer-path test's comparison
+        assert "source=fs" in pline, pline
+        _FS_RUN["restore_ms"] = float(pm.group(5))
         assert float(pm.group(2)) == 0.0, pline  # no re-transpile
         assert float(pm.group(3)) == 0.0, pline  # no re-verify
         sline = tails[0].wait_for("start_phases:", 10)
@@ -180,9 +225,15 @@ def test_evict_requorum_and_rejoin(tmp_path):
             "compile (%.0fms)" % (warm, cold))
 
         # 3. relaunch the victim the way launch.py --restart_failed would
-        #    (same rank/endpoints, PADDLE_RESTART_COUNT bumped)
+        #    (same rank/endpoints, PADDLE_RESTART_COUNT bumped) — but only
+        #    once the survivors have finished step 7 and are about to park
+        #    at the hold, so the join-triggered requorum always lands at
+        #    step 8 (a mid-schedule admission would fork the trajectory
+        #    and break the peer test's bitwise comparison against this run)
+        assert tails[0].wait_for("mark:step=7", 180) is not None, \
+            _dump(tails)
         rejoin = _spawn("rejoin", VICTIM, eps, tmp_path, ckpt_dir,
-                        restart=1)
+                        restart=1, extra_env=fs_env)
         tails.append(rejoin)
 
         outs = {}
@@ -194,6 +245,10 @@ def test_evict_requorum_and_rejoin(tmp_path):
             except subprocess.TimeoutExpired:
                 raise AssertionError("%s hung:\n%s" % (t.name, _dump(tails)))
             outs[t.name] = out
+            # keep raw member output around for post-mortem (pytest
+            # retains the last few tmp dirs)
+            (tmp_path / ("out-%s.log" % t.name.replace(":", "-"))
+             ).write_text(out)
             assert rc == 0, (t.name, out[-3000:])
     finally:
         for t in tails:
@@ -235,6 +290,159 @@ def test_evict_requorum_and_rejoin(tmp_path):
             blob = json.dumps(json.load(fh))
         assert "elastic_evictions_total" in blob, blob[:500]
         assert "elastic_rejoins_total" in blob, blob[:500]
+
+    # restored state is bitwise-identical across ranks at every adoption
+    h0, h1, hr = (_state_hashes(outs[k]) for k in ("m:0", "m:1", "rejoin"))
+    assert h0.get(4) and h0.get(4) == h1.get(4), (h0, h1)
+    assert h0.get(8) and h0.get(8) == h1.get(8) == hr.get(8), (h0, h1, hr)
+
+    # per-(step, world) trajectory + state hashes for the peer-path
+    # parity comparison
+    _FS_RUN["losses"] = _step_losses(outs["m:0"])
+    _FS_RUN["hash4"] = h0[4]
+    _FS_RUN["hash8"] = h0[8]
+
+
+PAUSE_AT_P2P = 4  # == the last checkpoint step: survivors' live state at
+                  # the gate is bitwise the ckpt-4 state, so the peer run's
+                  # world-2/world-3 segments must match the fs run exactly
+
+
+def test_evict_requorum_peer_restore(tmp_path):
+    """Same topology as the fs scenario, with peer-to-peer restore ON (and
+    async save, exercising the writer thread under the full elastic flow):
+    survivors adopt their OWN live state (source=peer), the rejoiner pulls
+    state from a survivor over the RPC fabric instead of the filesystem,
+    and the restore phase is cheaper than the fs baseline's."""
+    ports = free_ports(N)
+    eps = ["127.0.0.1:%d" % p for p in ports]
+    ckpt_dir = str(tmp_path / "ckpt")
+
+    p2p_env = {"FLAGS_checkpoint_p2p_restore": "1",
+               "FLAGS_checkpoint_async": "1",
+               # roomier than the fs run's 3s: the async writer + standby
+               # pre-compiles add GIL pressure around the early steps, and a
+               # spurious eviction here would deadlock the pause rendezvous
+               # (the compared quantity — restore phase ms — is unaffected)
+               "FLAGS_elastic_hb_timeout": "6"}
+    hold = ("--hold_at", str(HOLD_AT), str(N), "--wait_standby")
+    tails = [_spawn("m:%d" % r, r, eps, tmp_path, ckpt_dir, extra=hold,
+                    extra_env=p2p_env)
+             for r in range(N - 1)]
+    victim = _spawn("victim", VICTIM, eps, tmp_path, ckpt_dir,
+                    extra=("--pause_at", str(PAUSE_AT_P2P),
+                           "--wait_standby"),
+                    extra_env=p2p_env)
+    tails.append(victim)
+    try:
+        got = victim.wait_for("pause:%d" % PAUSE_AT_P2P, 240)
+        assert got is not None, (
+            "victim never reached pause:\n" + _dump(tails))
+        os.killpg(os.getpgid(victim.proc.pid), signal.SIGKILL)
+        victim.proc.wait(timeout=30)
+
+        # survivors re-quorum at world 2 — from their own live state, at
+        # the same step the last checkpoint covers
+        line = tails[0].wait_for("requorum:", 120)
+        assert line is not None, (
+            "survivor 0 never re-quorumed:\n" + _dump(tails))
+        assert "world=2" in line and "restore=%d" % PAUSE_AT_P2P in line, \
+            line
+        pline = tails[0].wait_for("requorum_phases:", 60)
+        assert pline is not None, _dump(tails)
+        assert "source=peer" in pline, (
+            "survivor restored from fs, not peer:\n" + pline)
+        pm = re.search(r"restore=([\d.]+)", pline)
+        assert pm, pline
+        peer_restore_ms = float(pm.group(1))
+
+        # park-then-rejoin rendezvous: same reasoning as the fs scenario —
+        # the admission must land at the step-8 hold for the two runs'
+        # schedules (and therefore trajectories) to be comparable
+        assert tails[0].wait_for("mark:step=7", 180) is not None, \
+            _dump(tails)
+        rejoin = _spawn("rejoin", VICTIM, eps, tmp_path, ckpt_dir,
+                        restart=1, extra_env=p2p_env)
+        tails.append(rejoin)
+
+        # the rejoiner has no local state: it must FETCH from the peer
+        # source (a survivor), landing at the survivors' live step — ahead
+        # of or equal to anything the filesystem holds
+        sline = rejoin.wait_for("start_phases:", 240)
+        assert sline is not None, _dump(tails)
+        assert "source=peer" in sline, (
+            "rejoiner restored from fs, not peer:\n" + sline)
+        rline = rejoin.wait_for("start:", 10)
+        assert rline is not None and "restore=%d" % HOLD_AT in rline, rline
+
+        outs = {}
+        for t in tails:
+            if t is victim:
+                continue
+            try:
+                rc, out = t.finish(timeout=240)
+            except subprocess.TimeoutExpired:
+                raise AssertionError("%s hung:\n%s" % (t.name, _dump(tails)))
+            outs[t.name] = out
+            (tmp_path / ("out-%s.log" % t.name.replace(":", "-"))
+             ).write_text(out)
+            assert rc == 0, (t.name, out[-3000:])
+    finally:
+        for t in tails:
+            if t.proc.poll() is None:
+                kill_proc_tree(t.proc)
+
+    assert victim.proc.returncode < 0
+
+    for r in range(N - 1):
+        out = outs["m:%d" % r]
+        assert re.search(r"requorum: epoch=\d+ world=2 restore=%d"
+                         % PAUSE_AT_P2P, out), out[-2000:]
+        assert re.search(r"done: rank=%d epoch=\d+ world=3" % r, out), \
+            out[-2000:]
+
+    # peer restore source surfaced in telemetry
+    tm = os.path.join(str(tmp_path), "tm-0-0", "metrics.json")
+    if os.path.exists(tm):
+        import json
+
+        with open(tm) as fh:
+            blob = json.dumps(json.load(fh))
+        assert "checkpoint_restore_source_total" in blob, blob[:500]
+        assert '"source": "peer"' in blob or "source=peer" in blob, \
+            blob[:500]
+
+    # restored state bitwise-identical across ranks at every adoption —
+    # survivors kept their own live arrays, the rejoiner fetched over RPC,
+    # and all of it must hash identically to the fs-restored state of the
+    # baseline run at the same steps
+    h0, h1, hr = (_state_hashes(outs[k]) for k in ("m:0", "m:1", "rejoin"))
+    assert h0.get(4) and h0.get(4) == h1.get(4), (h0, h1)
+    assert h0.get(8) and h0.get(8) == h1.get(8) == hr.get(8), (h0, h1, hr)
+    if _FS_RUN.get("hash4"):
+        assert h0[4] == _FS_RUN["hash4"], (h0, _FS_RUN)
+    if _FS_RUN.get("hash8"):
+        assert h0[8] == _FS_RUN["hash8"], (h0, _FS_RUN)
+
+    # f32 bitwise trajectory parity against the fs-baseline run: every
+    # (step, world) both runs executed must produce the IDENTICAL loss —
+    # peer-restored state is bit-for-bit the checkpointed state
+    fs_losses = _FS_RUN.get("losses")
+    if fs_losses:
+        peer_losses = _step_losses(outs["m:0"])
+        common = sorted(set(fs_losses) & set(peer_losses))
+        assert len(common) >= 10, (common, fs_losses, peer_losses)
+        diffs = {k: (fs_losses[k], peer_losses[k]) for k in common
+                 if fs_losses[k] != peer_losses[k]}
+        assert not diffs, "fs vs peer trajectories diverged: %s" % diffs
+
+    # the peer path skips the fs read+crc walk entirely: materially
+    # cheaper restore phase on the same scenario
+    fs_ms = _FS_RUN.get("restore_ms")
+    if fs_ms is not None:
+        assert peer_restore_ms < fs_ms, (
+            "peer restore (%.3fms) not cheaper than fs restore (%.3fms)"
+            % (peer_restore_ms, fs_ms))
 
 
 # ---------------------------------------------------------------------------
@@ -440,3 +648,132 @@ def test_zero1_shard_slots_restore_from_full_checkpoint(tmp_path):
 
     assert part1 == full[:3], (part1, full)
     assert part2 == full[3:], (part2, full)
+
+
+# ---------------------------------------------------------------------------
+# Sharded checkpoints (CheckpointManager x zero1 ckpt_shard_layout): each
+# rank persists only its own dim-0 rows of the optimizer slot arrays, rank 0
+# assembles + seals, restore reassembles (or re-shards) bitwise.  The 8
+# "ranks" here share one process/scope — the shard slices all come from the
+# same full arrays, so reassembly must reproduce them exactly.
+
+
+def test_shard_read_plan_partitions_old_ranks():
+    from paddle_tpu.io import shard_read_plan
+
+    for old_world, new_world in ((4, 2), (8, 2), (8, 4), (2, 4), (3, 2),
+                                 (4, 4), (1, 3)):
+        man = {"shards": {"world": old_world}}
+        plan = shard_read_plan(man, new_world)
+        assert sorted(plan) == list(range(new_world))
+        flat = [o for r in sorted(plan) for o in plan[r]]
+        # every old shard file is read by EXACTLY ONE new rank
+        assert sorted(flat) == list(range(old_world)), (old_world,
+                                                        new_world, plan)
+        assert flat == sorted(flat), plan  # contiguous row blocks
+
+
+def test_sharded_checkpoint_multiwriter_roundtrip(tmp_path):
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.io import CheckpointManager, shard_read_plan
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    ckpt_dir = str(tmp_path / "shard_ckpt")
+
+    def data(i):
+        rng = np.random.RandomState(900 + i)
+        x = rng.randn(16, 4).astype("f")
+        w = np.linspace(-1, 1, 4).astype("f").reshape(4, 1)
+        return x, (x @ w).astype("f")
+
+    main, startup, loss = _zero1_pair(8)
+    meta = main._collective_meta
+    layout = meta["ckpt_shard_layout"]
+    assert layout, meta  # zero1 transpile exports the shard layout
+    world = meta["nranks"]
+
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for i in range(2):  # materialize non-trivial adam moments
+            xb, yb = data(i)
+            exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss.name])
+
+        scope = fluid.global_scope()
+        names = [v.name for v in main.list_vars()
+                 if v.persistable and not v.is_data
+                 and scope.find_var(v.name) is not None]
+        ref = {n: np.array(scope.find_var(n).get_tensor().numpy(),
+                           copy=True) for n in names}
+
+        # every "rank" writes its own shard into the shared dir; rank 0
+        # LAST (it adopts the staged peer parts and seals the manifest)
+        mgr = CheckpointManager(ckpt_dir, save_interval=1, max_num=2,
+                                async_save=False, sharded=True)
+        try:
+            for r in list(range(world - 1, 0, -1)) + [0]:
+                meta["rank"] = r
+                assert mgr.save(exe, main, 2) is not None
+        finally:
+            meta["rank"] = 0
+
+    path = os.path.join(ckpt_dir, "ckpt-2")
+    assert os.path.exists(os.path.join(path, "_SUCCESS"))
+    assert not os.path.exists(path + ".parts")  # staging dir consumed
+    man = mgr._manifest(path)
+    assert man["shards"]["world"] == world
+    assert sorted(man["shards"]["layout"]) == sorted(layout)
+    for n, lay in layout.items():
+        assert man["shards"]["layout"][n]["rows_per_rank"] == \
+            lay["rows_per_rank"]
+    # one shard file per rank, each holding only rows_per_rank rows
+    for r in range(world):
+        sf = os.path.join(path, "__shard_%dof%d__.npz" % (r, world))
+        assert os.path.exists(sf), sorted(os.listdir(path))
+        with np.load(sf) as sd:
+            for n in sd.files:
+                assert sd[n].shape[0] == layout[n]["rows_per_rank"], \
+                    (n, sd[n].shape)
+
+    # full reassembly into a fresh build + scope: bitwise equal
+    mgr2 = CheckpointManager(ckpt_dir, async_save=False, sharded=True)
+    main2, startup2, _loss2 = _zero1_pair(8)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup2)
+        step, _extra = mgr2.restore(exe, main2)
+        assert step == 2
+        scope = fluid.global_scope()
+        for n in names:
+            got = np.asarray(scope.find_var(n).get_tensor().numpy())
+            assert got.dtype == ref[n].dtype, n
+            assert np.array_equal(got, ref[n]), (
+                "full reassembly not bitwise for %s" % n)
+
+    # world-8 -> 2 local re-shard: each new rank reads ONLY its plan's
+    # shard files and fills ONLY its own rows (sentinel elsewhere)
+    plan = shard_read_plan(man, 2)
+    assert plan == {0: [0, 1, 2, 3], 1: [4, 5, 6, 7]}
+    main3, startup3, _loss3 = _zero1_pair(8)
+    for new_rank in (0, 1):
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup3)
+            scope = fluid.global_scope()
+            for n in layout:
+                sent = np.full_like(ref[n], -123.0)
+                scope.var(n).set(sent)
+            step, _extra = mgr2.restore(exe, main3, shard_scope="local",
+                                        world=2, rank=new_rank)
+            assert step == 2
+            for n, lay in layout.items():
+                got = np.asarray(scope.find_var(n).get_tensor().numpy())
+                rpr = int(lay["rows_per_rank"])
+                lo = plan[new_rank][0] * rpr
+                hi = (plan[new_rank][-1] + 1) * rpr
+                assert np.array_equal(got[lo:hi], ref[n][lo:hi]), \
+                    ("local rows not bitwise", n, new_rank)
+                mask = np.ones(got.shape[0], bool)
+                mask[lo:hi] = False
+                assert np.all(got[mask] == -123.0), \
+                    ("rows outside the local plan were touched", n,
+                     new_rank)

@@ -1,0 +1,139 @@
+"""Measure the training-loop cost of checkpointing: mean step time vs the
+foreground stall of ``CheckpointManager.save``.
+
+Trains a small fc regression for ``--steps`` steps, saving every
+``--save-every`` steps, then reports per-save foreground stall
+(``checkpoint_save_stall_ms``) and background write time
+(``checkpoint_write_ms``) from telemetry next to the measured step time.
+With ``--assert-stall-frac F`` the probe exits nonzero unless the mean
+save stall is under ``F`` of the mean step time — the CI ``--ckpt-smoke``
+leg runs it with the BASELINE validity bar (0.05, i.e. a save may not
+cost more than 5% of a step).
+
+    python tools/ckpt_stall_probe.py --steps 30 --save-every 2 \
+        --assert-stall-frac 0.05 --out probe.json
+    python tools/ckpt_stall_probe.py --sync ...   # blocking-save baseline
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core import telemetry as _tm
+
+
+def build_net(hidden):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 17
+    startup.random_seed = 17
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[64])
+        y = fluid.layers.data("y", shape=[1])
+        h = fluid.layers.fc(x, hidden, act="relu",
+                            param_attr=fluid.ParamAttr(name="pr_w1"),
+                            bias_attr=fluid.ParamAttr(name="pr_b1"))
+        h = fluid.layers.fc(h, hidden, act="relu",
+                            param_attr=fluid.ParamAttr(name="pr_w2"),
+                            bias_attr=fluid.ParamAttr(name="pr_b2"))
+        pred = fluid.layers.fc(h, 1,
+                               param_attr=fluid.ParamAttr(name="pr_w3"),
+                               bias_attr=fluid.ParamAttr(name="pr_b3"))
+        loss = fluid.layers.mean(fluid.layers.square(pred - y))
+        fluid.optimizer.Adam(1e-3).minimize(loss)
+    return main, startup, loss
+
+
+def _hist(snap, name):
+    h = snap.get("histograms", {}).get(name)
+    return h if h else {"count": 0, "sum": 0.0, "p50": 0.0, "p90": 0.0,
+                        "p99": 0.0}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--save-every", type=int, default=2)
+    ap.add_argument("--hidden", type=int, default=256)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--sync", action="store_true",
+                    help="blocking saves (the pre-async baseline)")
+    ap.add_argument("--ckpt-dir", type=str, default=None,
+                    help="checkpoint directory (default: a temp dir)")
+    ap.add_argument("--assert-stall-frac", type=float, default=None,
+                    help="fail unless mean save stall < FRAC * mean step")
+    ap.add_argument("--out", type=str, default=None,
+                    help="write the result record as JSON")
+    args = ap.parse_args(argv)
+
+    fluid.set_flags({"FLAGS_telemetry": True})
+    ckpt_dir = args.ckpt_dir or tempfile.mkdtemp(prefix="ckpt_probe_")
+
+    from paddle_tpu.io import CheckpointManager
+
+    main_prog, startup, loss = build_net(args.hidden)
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(startup)
+    mgr = CheckpointManager(ckpt_dir, save_interval=args.save_every,
+                            max_num=2, async_save=not args.sync)
+
+    rng = np.random.RandomState(7)
+    xs = rng.randn(args.batch, 64).astype("f")
+    ys = rng.randn(args.batch, 1).astype("f")
+
+    step_ms = []
+    warm = 2  # exclude compile + first-touch steps from the mean
+    for step in range(1, args.steps + 1):
+        t0 = time.perf_counter()
+        exe.run(main_prog, feed={"x": xs, "y": ys},
+                fetch_list=[loss.name])
+        mgr.maybe_save(exe, main_prog, step)
+        ms = (time.perf_counter() - t0) * 1e3
+        if step > warm:
+            step_ms.append(ms)
+    mgr.wait()
+
+    snap = _tm.snapshot()
+    stall = _hist(snap, "checkpoint_save_stall_ms")
+    write = _hist(snap, "checkpoint_write_ms")
+    mean_step = float(np.mean(step_ms)) if step_ms else 0.0
+    mean_stall = stall["sum"] / stall["count"] if stall["count"] else 0.0
+    mean_write = write["sum"] / write["count"] if write["count"] else 0.0
+    rec = {
+        "mode": "sync" if args.sync else "async",
+        "steps": args.steps,
+        "saves": int(stall["count"]),
+        "mean_step_ms": round(mean_step, 3),
+        "mean_save_stall_ms": round(mean_stall, 3),
+        "p99_save_stall_ms": round(stall["p99"], 3),
+        "mean_write_ms": round(mean_write, 3),
+        "stall_frac_of_step": round(mean_stall / mean_step, 4)
+                              if mean_step else None,
+        "overlap_drops": _tm.counter_total("checkpoint_save_overlap_total"),
+    }
+    print(json.dumps(rec, indent=1, sort_keys=True))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(rec, f, indent=1, sort_keys=True)
+
+    if args.assert_stall_frac is not None:
+        limit = args.assert_stall_frac * mean_step
+        if mean_stall >= limit:
+            print("FAIL: mean save stall %.3fms >= %.1f%% of mean step "
+                  "%.3fms" % (mean_stall, 100 * args.assert_stall_frac,
+                              mean_step), file=sys.stderr)
+            return 1
+        print("OK: mean save stall %.3fms < %.1f%% of mean step %.3fms"
+              % (mean_stall, 100 * args.assert_stall_frac, mean_step))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -115,6 +115,13 @@ def main(argv=None):
                     "tracing_records_total{kind} and "
                     "tracing_flightrec_dumps_total{reason} "
                     "(core/tracing.py)")
+    ap.add_argument("--checkpoint", action="store_true", dest="ckpt_only",
+                    help="show only checkpoint I/O metrics: the "
+                    "checkpoint_save_stall_ms vs checkpoint_write_ms "
+                    "split, restore timings/sources "
+                    "(checkpoint_restore_source_total{source}), overlap "
+                    "drops, temp-GC sweeps, and the executor's D2H "
+                    "snapshot histogram (io.py + core/executor.py)")
     ap.add_argument("--lint", action="store_true", dest="lint_only",
                     help="show only static-checker metrics: per-rule "
                     "static_check_warnings counters and the whole-world "
@@ -151,6 +158,10 @@ def main(argv=None):
                                    "decode_batch_occupancy", "spec_"))
     if args.tracing_only:
         snap = _filter_snap(snap, "tracing_")
+    if args.ckpt_only:
+        # checkpoint_* covers save/write/restore/overlap/tmp-GC; the D2H
+        # snapshot cost lives under the executor family
+        snap = _filter_snap(snap, ("checkpoint_", "executor_snapshot"))
     if args.lint_only:
         # covers static_check_warnings{rule=} and static_check_world_*
         snap = _filter_snap(snap, "static_check")

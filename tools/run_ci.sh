@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI harness (reference paddle/scripts/paddle_build.sh analog): build the
 # native pieces, run the full test pyramid, smoke the bench + graft entry.
-# Usage: tools/run_ci.sh [quick|full|tpu|--layout-smoke|--obs-smoke|--lint|--elastic-smoke|--zero1-smoke|--cache-smoke|--kernel-smoke|--serve-smoke|--trace-smoke|--decode-smoke]
+# Usage: tools/run_ci.sh [quick|full|tpu|--layout-smoke|--obs-smoke|--lint|--elastic-smoke|--zero1-smoke|--cache-smoke|--kernel-smoke|--serve-smoke|--trace-smoke|--decode-smoke|--ckpt-smoke]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -292,6 +292,29 @@ if ratio < 1.3:
 EOF
   rm -rf "$DEC_DIR"
   echo "CI --decode-smoke: PASS"
+  exit 0
+fi
+
+if [ "$MODE" = "--ckpt-smoke" ]; then
+  # checkpoint leg: manager unit tests (async writer, sharded layout,
+  # crash consistency, temp GC, validity cache), then the stall probe —
+  # an async save may not stall the step loop more than 5% of a step
+  # (the BASELINE validity bar) — and the telemetry round trip through
+  # the metrics_dump --checkpoint CLI filter
+  echo "== ckpt smoke: checkpoint manager tests =="
+  JAX_PLATFORMS=cpu FLAGS_static_check=error \
+    python -m pytest tests/test_checkpoint_resume.py -q
+  echo "== ckpt smoke: async save stall probe (<5% of step) =="
+  CKPT_DIR="$(mktemp -d)"
+  JAX_PLATFORMS=cpu FLAGS_telemetry=1 FLAGS_telemetry_dir="$CKPT_DIR/tel" \
+    python tools/ckpt_stall_probe.py --steps 16 --save-every 4 \
+      --batch 4096 --hidden 512 --ckpt-dir "$CKPT_DIR/ckpt" \
+      --assert-stall-frac 0.05 --out "$CKPT_DIR/probe.json"
+  echo "== ckpt smoke: metrics_dump --checkpoint round trip =="
+  python tools/metrics_dump.py --json "$CKPT_DIR/tel/metrics.json" \
+    --checkpoint --prom | grep -q checkpoint_save_stall_ms
+  rm -rf "$CKPT_DIR"
+  echo "CI --ckpt-smoke: PASS"
   exit 0
 fi
 

@@ -16,6 +16,11 @@ document:
   DIFFERENT processes becomes a flow arrow (``ph:"s"``/``"f"``) keyed by
   trace_id, so one request's client.infer -> serving.admission -> ... ->
   serving.reply_publish chain reads as one connected line across tracks
+- a ``link``-kind edge between two span trees of the SAME process also
+  becomes an arrow (same-process parent edges stay implicit in the slice
+  nesting): the elastic re-quorum's restore phase links the
+  ``checkpoint.save``/``checkpoint.restore`` tree that produced its
+  state, so recovery reads as checkpoint I/O flowing into the re-quorum
 
 Usage:
     python tools/trace_view.py --telemetry_dir /tmp/tel --out trace.json
@@ -94,12 +99,19 @@ def load_dir(telemetry_dir):
     return [(pid, nm, rc) for pid, (nm, rc) in sorted(procs.items())]
 
 
-# chrome://tracing reserved color names for the speculative-decode child
-# spans (engine._spec_step_locked): draft work yellow-ish, the target
-# verify step green, so accept/reject economics show up visually
-_SPEC_COLORS = {"serving.draft": "thread_state_iowait",
+# chrome://tracing reserved color names for span families whose phases
+# should be tellable apart at a glance: the speculative-decode children
+# (draft work yellow-ish, the target verify step green, so accept/reject
+# economics show up visually) and the checkpoint tree (the foreground
+# D2H snapshot + save stall vs the background write — the async-save
+# contract is precisely that the yellow I/O slice leaves the step track)
+_SPAN_COLORS = {"serving.draft": "thread_state_iowait",
                 "serving.draft_ingest": "thread_state_iowait",
-                "serving.verify": "thread_state_running"}
+                "serving.verify": "thread_state_running",
+                "executor.snapshot": "thread_state_runnable",
+                "checkpoint.save": "rail_response",
+                "checkpoint.write": "thread_state_iowait",
+                "checkpoint.restore": "rail_load"}
 
 
 def merge(procs):
@@ -134,10 +146,7 @@ def merge(procs):
                       "pid": pid, "tid": tid, "ts": ts,
                       "dur": max(r.get("dur", 0), 1),
                       "cat": "span", "args": args}
-                # speculation phases nest under serving.decode_step;
-                # fixed colors make the draft/verify split readable at
-                # a glance in a dense decode track
-                cname = _SPEC_COLORS.get(ev["name"])
+                cname = _SPAN_COLORS.get(ev["name"])
                 if cname:
                     ev["cname"] = cname
                 events.append(ev)
@@ -160,11 +169,14 @@ def merge(procs):
                                "tid": tid, "ts": ts,
                                "s": "t" if t == "inst" else "p",
                                "cat": t, "args": args})
-    flows = 0
+    flows = local_flows = 0
     for cpid, ctid, cts, trace_id, psid, csid, kind in edges:
         home = span_home.get(psid)
-        if home is None or home[0] == cpid:
-            continue  # unknown or same-process: the nesting shows it
+        if home is None:
+            continue
+        if home[0] == cpid and kind != "link":
+            # same-process parent edge: the slice nesting already shows it
+            continue
         ppid, ptid, pts, pname = home
         fid = "%s:%s" % (trace_id, csid)
         events.append({"name": "trace", "cat": "flow", "ph": "s",
@@ -173,10 +185,17 @@ def merge(procs):
         events.append({"name": "trace", "cat": "flow", "ph": "f",
                        "bp": "e", "id": fid, "pid": cpid, "tid": ctid,
                        "ts": max(cts + 1, pts + 2)})
-        flows += 1
+        if home[0] == cpid:
+            # link between two span TREES of one process — e.g. the
+            # elastic restore phase pointing back at the checkpoint
+            # save/restore tree that produced its state; without the
+            # arrow they read as unrelated tracks
+            local_flows += 1
+        else:
+            flows += 1
     events.sort(key=lambda e: (e.get("ts", 0), e.get("ph") != "M"))
     return {"traceEvents": events,
-            "displayTimeUnit": "ms"}, flows
+            "displayTimeUnit": "ms"}, flows, local_flows
 
 
 def main(argv=None):
@@ -193,11 +212,13 @@ def main(argv=None):
         print("no trace-*.jsonl under %s" % args.telemetry_dir,
               file=sys.stderr)
         return 1
-    trace, flows = merge(procs)
+    trace, flows, local_flows = merge(procs)
     with open(args.out, "w") as f:
         json.dump(trace, f)
-    print("merged %d processes, %d events, %d cross-process flows -> %s"
-          % (len(procs), len(trace["traceEvents"]), flows, args.out))
+    print("merged %d processes, %d events, %d cross-process + %d "
+          "same-process link flows -> %s"
+          % (len(procs), len(trace["traceEvents"]), flows, local_flows,
+             args.out))
     if args.require_flow and flows == 0:
         print("--require-flow: no cross-process flow found",
               file=sys.stderr)
