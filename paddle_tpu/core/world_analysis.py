@@ -691,7 +691,10 @@ def check_memory(program, rep, rank=None, budget=None, batch=1,
     # engine-owned paged KV pools (serving/kv_cache.py) are allocated
     # OUTSIDE any Program's scope but are just as resident on the chip —
     # fold live caches into the static peak so a decode replica's MEM003
-    # budget gate sees them
+    # budget gate sees them.  The pool bytes already INCLUDE the prefix
+    # cache's evictable blocks: cached prefixes live inside the planned
+    # pool (zero-ref blocks parked for reuse, reclaimed on demand), so a
+    # warm cache never grows the peak beyond this estimate
     try:
         import sys
 

@@ -275,6 +275,19 @@ _DEFAULTS = {
     # free).  Requires a draft bundle — a model without one decodes
     # non-speculatively regardless of k.
     "FLAGS_speculative_k": 0,
+    # content-addressed KV prefix caching over the paged pool: admission
+    # matches each prompt's hash chain against sealed full-prompt blocks,
+    # seeds the block table with the shared prefix, and prefill computes
+    # only the uncached tail.  Zero-ref cached blocks park in an LRU
+    # evictable pool (reclaimed on demand), so residency is free under
+    # pressure; outputs stay bitwise-identical cache-on vs cache-off.
+    "FLAGS_prefix_cache": True,
+    # cap on total prefill tokens mixed into one decode iteration
+    # (0 = unlimited).  Under a long-prompt burst, unbudgeted prefill
+    # chunks crowd every iteration and inflate decode ITL p99; the budget
+    # round-robins prefilling lanes so decode lanes always run.  Pure
+    # scheduling: compiles nothing new (misses stay flat).
+    "FLAGS_decode_prefill_token_budget": 0,
     # accepted no-ops (XLA/PJRT owns these concerns; benchmark's per-op
     # sync has no meaning under whole-block compilation)
     "FLAGS_benchmark": False,

@@ -189,7 +189,18 @@ def make_paged_step(cfg, kv_config):
     All shapes are static per lane bucket: tok/pos/context_lens [B],
     block_tables [B, MAXB].  ``context_lens[b]`` counts the tokens valid
     AFTER this step's write (pos + 1 for live lanes, 0 for idle lanes,
-    whose table points at the reserved scratch block 0)."""
+    whose table points at the reserved scratch block 0).
+
+    Feed-planning contract (what prefix caching leans on): the step
+    WRITES exactly one position — ``pos``, into block
+    ``block_tables[b, pos // bs]`` — and only READS every earlier
+    position through the table.  The engine may therefore start a
+    sequence at any ``pos > 0`` whose history blocks already hold valid
+    K/V (shared prefix-cache blocks seeded into the table); those shared
+    blocks are read-only by construction because every write lands at
+    ``pos >= cached_tokens``, i.e. in a private tail block.  The values a
+    cache hit skips recomputing are bitwise the ones this step would
+    have produced, so output parity is structural, not numerical."""
     bs = kv_config.block_size
     int8 = kv_config.dtype == "int8"
 

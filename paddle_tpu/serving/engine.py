@@ -540,7 +540,7 @@ class _DecodeSeq:
                  "blocks", "table", "draft_blocks", "draft_table",
                  "n_fed", "next_tok", "out",
                  "t_admit", "t_first", "token_times", "admit_seq",
-                 "aborted")
+                 "aborted", "hashes", "published", "cached_tokens")
 
     def __init__(self, pending, prompt, max_new, eos_id, on_token, maxb):
         self.pending = pending
@@ -560,6 +560,12 @@ class _DecodeSeq:
         self.token_times = []                 # perf_counter per token
         self.admit_seq = 0                    # preemption picks max()
         self.aborted = False
+        # prefix-cache state, set at admission: the full-prompt hash
+        # chain, how many leading blocks are already indexed (shared hits
+        # + this sequence's publishes), and the matched token count
+        self.hashes = None
+        self.published = 0
+        self.cached_tokens = 0
 
     @property
     def in_prefill(self):
@@ -572,7 +578,10 @@ class _DecodeSeq:
     def reset_for_recompute(self):
         """Preempted: blocks were freed; replay the prompt from scratch.
         Greedy decode is deterministic, so re-emitted tokens are
-        identical and stream chunks republish byte-for-byte."""
+        identical and stream chunks republish byte-for-byte.  (Freed
+        shared blocks only dropped a reference — re-admission re-matches
+        the prefix index, so the replay usually skips straight past the
+        cached prefix again.)"""
         self.blocks = []
         self.table.fill(-1)
         self.draft_blocks = []
@@ -582,11 +591,14 @@ class _DecodeSeq:
         self.out = []
         self.t_first = None
         self.token_times = []
+        self.hashes = None
+        self.published = 0
+        self.cached_tokens = 0
 
 
 class _DecodeModel:
     __slots__ = ("name", "cfg", "params", "kv_config", "cache", "stepfn",
-                 "maxb", "step_ms", "__weakref__",
+                 "maxb", "step_ms", "prefix", "__weakref__",
                  # speculative decode (spec_k == 0 means off): the draft
                  # decoder runs k tokens ahead through its own paged pool,
                  # then verifyfn scores all k+1 positions in one target call
@@ -602,6 +614,7 @@ class _DecodeModel:
         self.stepfn = stepfn        # CarriedStepFn over make_paged_step
         self.maxb = -(-cfg.max_seq // kv_config.block_size)
         self.step_ms = 0.0          # EWMA of one decode step
+        self.prefix = None          # PrefixCache (FLAGS_prefix_cache)
         self.spec_k = 0
         self.draft_cfg = None
         self.draft_params = None
@@ -635,7 +648,22 @@ class DecodeEngine:
     Mid-decode allocation failure preempts the youngest active sequence
     (blocks freed, sequence re-queued for deterministic recompute) —
     counted as ``kv_block_evictions_total``.  Admission-time shortage
-    sheds with ``retry_after_ms`` derived from the EWMA step time."""
+    sheds with ``retry_after_ms`` derived from the EWMA step time; all
+    pressure decisions budget against ``free + evictable`` (a warm
+    prefix cache is reclaimable, never a reason to shed).
+
+    Prefix caching (``FLAGS_prefix_cache``): admission matches each
+    prompt's hash chain against the model's ``PrefixCache``, seeds the
+    block table with shared (refcounted) blocks, and jumps the feed
+    pointer so prefill computes only the uncached tail; prefill-completed
+    full prompt blocks are sealed + published back.  Outputs are bitwise
+    identical cache-on vs cache-off — a hit only skips recomputing KV
+    values the reference run would have produced identically.
+
+    ``FLAGS_decode_prefill_token_budget`` caps the prefill tokens mixed
+    into one iteration (round-robin across prefilling lanes; decode
+    lanes always run), bounding decode ITL under long-prompt bursts
+    without adding compiled shapes."""
 
     def __init__(self, buckets=None, max_queue=None, deadline_ms=None,
                  mode=None):
@@ -660,6 +688,7 @@ class DecodeEngine:
         self._thread = None
         self._admit_seq = 0
         self._step_no = 0
+        self._rr_prefill = 0        # round-robin pointer (token budget)
         self.in_batch = False
         self.on_batch_boundary = None
 
@@ -719,6 +748,16 @@ class DecodeEngine:
             requested=kv_blocks)
         kv_config.num_blocks = n
         cache = _kvc.PagedKVCache(kv_config)
+        prefix = None
+        if bool(_flag("prefix_cache")):
+            # content-addressed prefix reuse over the SAME pool: sealed
+            # full-prompt blocks park evictable at zero refs, the index
+            # revives them on a hash-chain match at admission.  The draft
+            # pool (speculation) is deliberately NOT indexed: its blocks
+            # only steer acceptance, and a tail-only draft prefill can
+            # never change the verified output.
+            prefix = _kvc.PrefixCache(cache.allocator,
+                                      kv_config.block_size, namespace=name)
         jparams = {key: jnp.asarray(v) for key, v in params.items()}
         stepfn = CarriedStepFn(
             _dm.make_paged_step(cfg, kv_config), donate_argnums=(0,),
@@ -730,6 +769,7 @@ class DecodeEngine:
                               "dtype": kv_config.dtype},
                        "pallas": bool(_flag("use_pallas_paged_attention"))})
         entry = _DecodeModel(name, cfg, jparams, kv_config, cache, stepfn)
+        entry.prefix = prefix
         if k > 0:
             # draft pool mirrors the target's block COUNT (draft blocks
             # are strictly smaller at fewer layers), so any sequence the
@@ -771,7 +811,7 @@ class DecodeEngine:
         self._models[name] = entry
         _tm.event("decode_model_added", model=name, blocks=n,
                   budget_capped=capped, kv_bytes=cache.nbytes,
-                  speculative_k=k,
+                  speculative_k=k, prefix_cache=prefix is not None,
                   draft_kv_bytes=entry.draft_cache.nbytes if k else 0)
         return self._models[name]
 
@@ -786,7 +826,8 @@ class DecodeEngine:
                "block_size": m.kv_config.block_size,
                "num_blocks": m.kv_config.num_blocks,
                "kv_dtype": m.kv_config.dtype,
-               "speculative_k": m.spec_k}
+               "speculative_k": m.spec_k,
+               "prefix_cache": m.prefix is not None}
         if m.spec_k > 0:
             out["draft"] = {"layers": m.draft_cfg.layers,
                             "num_blocks": m.draft_kv_config.num_blocks,
@@ -927,24 +968,30 @@ class DecodeEngine:
                     "shed", error="queue full (%d)" % len(self._waiting),
                     retry_after_ms=self._retry_after_ms(m)))
             # admission-time KV pressure: blocks already promised to the
-            # queue ahead plus this prompt must fit the free pool — BOTH
-            # pools when speculating (the draft shadows every sequence) —
-            # else shed with a drain-time hint instead of queueing behind
-            # an out-of-memory head-of-line
+            # queue ahead plus this prompt must fit the RECLAIMABLE pool
+            # (free list + zero-ref evictable cached blocks — a warm
+            # prefix cache never causes a spurious shed; alloc reclaims
+            # evictable LRU-first on demand) — BOTH pools when
+            # speculating (the draft shadows every sequence) — else shed
+            # with a drain-time hint instead of queueing behind an
+            # out-of-memory head-of-line
             promised = sum(
                 m.cache.blocks_for_tokens(len(s.prompt))
                 for s in self._waiting if s.pending.model == model)
             need_now = promised + m.cache.blocks_for_tokens(len(prompt_ids))
-            free_now = m.cache.allocator.num_free
+            free_now = m.cache.allocator.reclaimable
             if m.spec_k > 0:
                 # equal block geometry -> the same block count applies;
-                # the binding pool is whichever has fewer free blocks
-                free_now = min(free_now, m.draft_cache.allocator.num_free)
+                # the binding pool is whichever could free fewer blocks
+                # (the draft pool never seals, so its reclaimable == free)
+                free_now = min(free_now,
+                               m.draft_cache.allocator.reclaimable)
             if need_now > free_now:
                 _tm.inc("serving_shed_total", reason="kv_oom")
                 return _early(InferReply(
                     "shed",
-                    error="KV pool exhausted (%d free blocks)" % free_now,
+                    error="KV pool exhausted (%d reclaimable blocks)"
+                          % free_now,
                     retry_after_ms=self._retry_after_ms(m)))
             req.span = _tr.start_span(
                 "serving.request", model=model, tenant=tenant,
@@ -1036,7 +1083,8 @@ class DecodeEngine:
             phases = {"queue_wait_ms": round(
                 ((seq.t_admit or now) - r.t_submit) * 1e3, 3),
                 "tokens": len(seq.out),
-                "prompt_tokens": len(seq.prompt)}
+                "prompt_tokens": len(seq.prompt),
+                "cached_tokens": seq.cached_tokens}
             if seq.t_first is not None:
                 phases["ttft_ms"] = round(
                     (seq.t_first - r.t_submit) * 1e3, 3)
@@ -1087,15 +1135,36 @@ class DecodeEngine:
             if self._active and self._active[0].pending.model != \
                     s.pending.model:
                 break  # one model per step batch
-            free = m.cache.allocator.num_free
+            # reclaimable = free + zero-ref evictable cached blocks: a
+            # warm prefix cache never blocks admission (alloc reclaims
+            # LRU-first on demand)
+            free = m.cache.allocator.reclaimable
             if m.spec_k > 0:
-                free = min(free, m.draft_cache.allocator.num_free)
+                free = min(free, m.draft_cache.allocator.reclaimable)
             if m.cache.blocks_for_tokens(len(s.prompt)) > free:
                 break  # head-of-line waits for blocks to free
             self._waiting.pop(0)
             self._admit_seq += 1
             s.admit_seq = self._admit_seq
             s.t_admit = now
+            if m.prefix is not None:
+                # longest-prefix match: seed the block table with shared
+                # (ref-taken) blocks and jump the feed pointer past the
+                # cached tokens — prefill computes only the uncached tail.
+                # The match is capped at len(prompt)-1 tokens, so there is
+                # always a next token to feed and every write this
+                # sequence makes lands in a PRIVATE tail block.
+                shared, cached, hashes = m.prefix.match(s.prompt)
+                s.hashes = hashes
+                s.published = len(shared)
+                s.cached_tokens = cached
+                if cached:
+                    s.blocks = list(shared)
+                    s.table[:len(shared)] = shared
+                    s.n_fed = cached
+                    s.next_tok = s.prompt[cached]
+            if s.pending.span is not None:
+                s.pending.span.annotate(cached_tokens=s.cached_tokens)
             if s.pending.qspan is not None:
                 s.pending.qspan.end()
                 s.pending.qspan = None
@@ -1137,6 +1206,56 @@ class DecodeEngine:
                     model=v.pending.model)
             _tm.event("decode_preempt", victim=v.pending.req_id,
                       for_req=seq.pending.req_id)
+
+    def _publish_prefix_locked(self, m, s):
+        """Publish every newly-completed FULL prompt block of ``s`` into
+        the prefix index (first-publisher-wins; a losing duplicate stays
+        private and frees normally).  Only blocks whose every position
+        holds a prompt token are eligible — decode-written and partially
+        fed blocks can never be published, which is what makes a
+        mid-prefill abort safe by construction."""
+        if m.prefix is None or s.hashes is None:
+            return
+        bs = m.kv_config.block_size
+        done = min(s.n_fed, len(s.prompt)) // bs
+        while s.published < min(done, len(s.hashes)):
+            j = s.published
+            m.prefix.publish(s.blocks[j], s.hashes[j])
+            s.published = j + 1
+
+    def _plan_lanes_locked(self, chunk):
+        """Token-budget prefill scheduling -> (participants, span_caps).
+
+        With ``FLAGS_decode_prefill_token_budget`` unset every active
+        lane participates (legacy order).  With a budget B, decode lanes
+        ALWAYS run — bounding decode ITL under a prompt burst is the
+        point — and prefilling lanes join round-robin until their summed
+        prefill spans (up to ``chunk`` tokens each) reach B; the rest sit
+        out this iteration and move to the front of the rotation next
+        time.  ``span_caps`` maps id(seq) -> this iteration's prefill
+        span cap (spec mode feeds multi-token chunks; non-spec feeds one
+        token, so the cap only gates participation).  Pure scheduling:
+        participants still pad to a configured lane bucket, so no new
+        shape is ever compiled."""
+        max_lanes = max(self.buckets)
+        budget = int(_flag("decode_prefill_token_budget") or 0)
+        if budget <= 0:
+            return self._active[:max_lanes], {}
+        decode = [s for s in self._active if not s.in_prefill]
+        prefill = [s for s in self._active if s.in_prefill]
+        if prefill:
+            r = self._rr_prefill % len(prefill)
+            prefill = prefill[r:] + prefill[:r]
+        chosen, caps, left = [], {}, budget
+        for s in prefill:
+            if left <= 0 or len(decode) + len(chosen) >= max_lanes:
+                break
+            span = min(chunk, len(s.prompt) - s.n_fed, left)
+            caps[id(s)] = span
+            left -= span
+            chosen.append(s)
+        self._rr_prefill += max(len(chosen), 1)
+        return (decode + chosen)[:max_lanes], caps
 
     def _bucket_for(self, lanes):
         for b in self.buckets:
@@ -1190,12 +1309,15 @@ class DecodeEngine:
             return True
         if m.spec_k > 0:
             return self._spec_step_locked(m)
-        for s in list(self._active):
+        # token-budget prefill scheduling: decode lanes always run;
+        # prefilling lanes beyond the budget sit this iteration out
+        participants, _caps = self._plan_lanes_locked(1)
+        for s in participants:
             if s in self._active and not self._ensure_block(s):
                 pass  # defensively completed inside _ensure_block
-        if not self._active:
+        lanes = [s for s in participants if s in self._active]
+        if not lanes:
             return True
-        lanes = self._active[:max(self.buckets)]
         bucket = self._bucket_for(len(lanes))
         tok = np.zeros(bucket, np.int32)
         pos = np.zeros(bucket, np.int32)
@@ -1239,6 +1361,9 @@ class DecodeEngine:
         n_generated = 0
         for i, s in enumerate(lanes):
             s.n_fed += 1
+            # seal + publish any prompt block this write completed (the
+            # boundary-crossing write completes the final full block)
+            self._publish_prefix_locked(m, s)
             if s.in_prefill:
                 s.next_tok = s.prompt[s.n_fed]
                 continue
@@ -1291,15 +1416,22 @@ class DecodeEngine:
         into attended history."""
         k = m.spec_k
         width = k + 1
+        # token-budget prefill scheduling: caps[id(s)] trims a prefill
+        # lane's chunk span when the budget runs low this iteration
+        participants, caps = self._plan_lanes_locked(width)
         plans = {}
-        for s in list(self._active):
+        for s in participants:
             if s not in self._active:
                 continue   # preempted by an earlier lane's allocation
             p = s.n_fed
             if s.in_prefill:
-                span = min(width, len(s.prompt) - p)
+                span = caps.get(id(s), min(width, len(s.prompt) - p))
                 spec = False
-                draft_upto = p + span   # prompt chunk mirrors into draft
+                # the prompt chunk mirrors into the draft TAIL-ONLY: with
+                # a cached prefix p starts past it, so draft positions
+                # below p stay zero — that can only lower acceptance,
+                # never correctness (verify guards every emitted token)
+                draft_upto = p + span
             else:
                 span = min(width, s.max_new - len(s.out))
                 spec = span > 1         # last token needs no proposals
@@ -1309,9 +1441,10 @@ class DecodeEngine:
             if not self._ensure_capacity(s, p + span, draft_upto):
                 continue   # defensively completed
             plans[id(s)] = (span, spec)
-        if not self._active:
+        lanes = [s for s in participants
+                 if s in self._active and id(s) in plans]
+        if not lanes:
             return True
-        lanes = self._active[:max(self.buckets)]
         bucket = self._bucket_for(len(lanes))
         tok = np.zeros((bucket, width), np.int32)
         pos = np.zeros((bucket, width), np.int32)
@@ -1402,6 +1535,7 @@ class DecodeEngine:
             accepted = 0
             if s.in_prefill:
                 s.n_fed += span
+                self._publish_prefix_locked(m, s)
                 ingest.append((s, p, s.prompt[p:p + span]))
                 if s.in_prefill:
                     s.next_tok = s.prompt[s.n_fed]

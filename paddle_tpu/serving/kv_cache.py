@@ -13,10 +13,22 @@ the free list the same step it finishes.
 
 ``BlockAllocator`` is the host-side free list (LIFO for reuse locality;
 all-or-nothing ``alloc`` so a half-admitted sequence never holds blocks).
+It is *refcounted*: ``incref`` lets sequences share a block (prefix
+caching), ``free`` decrements, and a ``seal``-ed block whose refcount
+hits zero parks in an LRU *evictable* pool instead of the free list —
+its content stays valid and revivable until ``alloc`` reclaims it under
+pressure, so cache residency costs nothing when blocks are needed.
 ``PagedKVCache`` owns the device arrays as a donated carry: every decode
 step consumes the current arrays and returns the updated ones
 (``carry()``/``replace_carry()``), so the cache is updated in place on
 device instead of being copied per token.
+
+``PrefixCache`` is the content-addressed index over sealed blocks: a
+per-model hash chain ``h_i = sha(h_{i-1}, block_token_ids)`` over *full*
+prompt blocks keys each physical block, ``match`` revives the longest
+cached prefix of a new prompt (capped at ``len(prompt) - 1`` tokens so
+prefill always computes at least one tail token and never writes into a
+shared block), and ``publish`` is first-publisher-wins.
 
 Residency dtype (FLAGS_kv_cache_dtype): ``f32`` keeps bitwise parity
 with the unpaged reference loop; ``int8`` stores quantized blocks plus
@@ -31,14 +43,17 @@ so ``core/world_analysis.check_memory`` counts engine-owned KV blocks in
 the static per-replica peak estimate.
 """
 
+import hashlib
 import threading
 import weakref
+from collections import OrderedDict
 
 import jax.numpy as jnp
 
 from ..core import telemetry as _tm
 
 __all__ = ["KVCacheConfig", "BlockAllocator", "PagedKVCache",
+           "PrefixCache",
            "plan_num_blocks", "block_bytes", "engine_owned_kv_bytes",
            "engine_owned_resident_bytes", "register_resident_bytes",
            "quantize_kv", "dequantize_kv"]
@@ -113,12 +128,23 @@ def plan_num_blocks(config, model_resident_bytes=0, requested=None,
 
 
 class BlockAllocator:
-    """Host-side free list over physical block ids.
+    """Refcounted host-side free list over physical block ids.
 
     ``reserve`` low ids never enter circulation (the cache reserves block
     0 as the idle-lane write scratch).  ``alloc`` is all-or-nothing: a
-    request the free list cannot fully satisfy takes nothing (the engine
-    sheds or preempts instead of deadlocking on a half-allocation)."""
+    request the pool cannot fully satisfy takes nothing (the engine
+    sheds or preempts instead of deadlocking on a half-allocation).
+
+    Sharing: ``alloc`` hands out blocks at refcount 1; ``incref`` takes
+    another share (prefix-cache hits); ``free`` decrements and only a
+    zero-ref block leaves circulation.  A ``seal``-ed block (content
+    complete and content-addressed) parks in the LRU *evictable* pool at
+    zero refs instead of the free list — still resident and revivable via
+    ``incref``, but ``alloc`` reclaims evictable LRU-first once the free
+    list runs dry (firing ``on_evict(block, tag)`` so the index forgets
+    it).  ``reclaimable`` = free + evictable is what admission/shed
+    decisions must budget against: a warm cache never causes a spurious
+    shed."""
 
     def __init__(self, num_blocks, reserve=0):
         if num_blocks <= reserve:
@@ -129,7 +155,11 @@ class BlockAllocator:
         # LIFO: the most recently freed block is the next handed out, so a
         # churning batch keeps touching the same hot cache lines
         self._free = list(range(num_blocks - 1, reserve - 1, -1))
-        self._owned = set()
+        self._owned = set()             # ids with refcount >= 1
+        self._ref = {}                  # id -> refcount (keys == _owned)
+        self._sealed = {}               # id -> content tag (in-use, sealed)
+        self._evictable = OrderedDict()  # id -> tag; zero-ref, LRU order
+        self.on_evict = None            # fn(block, tag) after a reclaim
         self._lock = threading.Lock()
         self.high_water = 0
 
@@ -143,44 +173,212 @@ class BlockAllocator:
             return len(self._free)
 
     @property
+    def num_evictable(self):
+        with self._lock:
+            return len(self._evictable)
+
+    @property
+    def reclaimable(self):
+        """Blocks an ``alloc`` could obtain right now: free list plus the
+        zero-ref evictable pool (cached content it may reclaim)."""
+        with self._lock:
+            return len(self._free) + len(self._evictable)
+
+    @property
     def in_use(self):
         with self._lock:
             return len(self._owned)
 
+    def refcount(self, block):
+        with self._lock:
+            return self._ref.get(block, 0)
+
     def alloc(self, n):
-        """n blocks or None (OOM — nothing is taken)."""
+        """n blocks or None (OOM — nothing is taken).  Prefers the free
+        list; reclaims evictable cached blocks LRU-first only when the
+        free list runs dry (cache residency is free until pressure)."""
         if n <= 0:
             return []
+        evicted = []
         with self._lock:
-            if n > len(self._free):
+            if n > len(self._free) + len(self._evictable):
                 _tm.inc("kv_block_oom_total")
                 return None
-            got = [self._free.pop() for _ in range(n)]
-            self._owned.update(got)
-            self.high_water = max(self.high_water, len(self._owned))
+            got = []
+            while len(got) < n and self._free:
+                got.append(self._free.pop())
+            while len(got) < n:
+                b, tag = self._evictable.popitem(last=False)   # LRU victim
+                evicted.append((b, tag))
+                got.append(b)
+            for b in got:
+                self._owned.add(b)
+                self._ref[b] = 1
+            self._note_high_water_locked()
             _tm.inc("kv_block_alloc_total", n)
             _tm.set_gauge("kv_blocks_in_use", len(self._owned))
+            _tm.set_gauge("kv_blocks_evictable", len(self._evictable))
+            cb = self.on_evict
+        # the index callback runs outside the allocator lock (it takes the
+        # PrefixCache lock; lock order is always index -> allocator)
+        for b, tag in evicted:
+            if cb is not None:
+                cb(b, tag)
         return got
 
+    def incref(self, block):
+        """Take another share of ``block``.  True if it was in use
+        (refcount bumped) or parked evictable (revived at refcount 1);
+        False if it has already been reclaimed — the caller's index entry
+        is stale."""
+        with self._lock:
+            if block in self._owned:
+                self._ref[block] += 1
+                return True
+            tag = self._evictable.pop(block, None)
+            if tag is None:
+                return False
+            self._owned.add(block)
+            self._ref[block] = 1
+            self._sealed[block] = tag        # stays sealed: re-parks at 0
+            self._note_high_water_locked()
+            _tm.set_gauge("kv_blocks_in_use", len(self._owned))
+            return True
+
+    def seal(self, block, tag):
+        """Mark an in-use block's content complete and content-addressed
+        by ``tag``: at refcount zero it parks in the evictable pool
+        (revivable) instead of returning to the free list."""
+        with self._lock:
+            if block not in self._owned:
+                raise ValueError("seal of unallocated block %r" % (block,))
+            self._sealed[block] = tag
+
     def free(self, blocks):
-        """Return blocks to the free list; double-free or a foreign id
-        raises (an engine bug must be loud, not silent corruption)."""
+        """Drop one reference per block; a block released at refcount
+        zero returns to the free list (or parks evictable when sealed).
+        Double-free or a foreign id raises (an engine bug must be loud,
+        not silent corruption)."""
         blocks = list(blocks)
         with self._lock:
             for b in blocks:
                 if b not in self._owned:
                     raise ValueError("free of unallocated block %r" % (b,))
+            released = 0
             for b in blocks:
+                self._ref[b] -= 1
+                if self._ref[b] > 0:
+                    continue
+                del self._ref[b]
                 self._owned.discard(b)
-                self._free.append(b)
-            _tm.inc("kv_block_free_total", len(blocks))
+                released += 1
+                tag = self._sealed.pop(b, None)
+                if tag is not None:
+                    self._evictable[b] = tag     # newest = last (LRU front)
+                else:
+                    self._free.append(b)
+            _tm.inc("kv_block_free_total", released)
             _tm.set_gauge("kv_blocks_in_use", len(self._owned))
+            _tm.set_gauge("kv_blocks_evictable", len(self._evictable))
+
+    def _note_high_water_locked(self):
+        # evictable blocks still occupy physical pool slots
+        occupied = len(self._owned) + len(self._evictable)
+        self.high_water = max(self.high_water, occupied)
 
     def stats(self):
         with self._lock:
             return {"capacity": self.capacity, "free": len(self._free),
                     "in_use": len(self._owned),
+                    "evictable": len(self._evictable),
+                    "reclaimable": len(self._free) + len(self._evictable),
                     "high_water": self.high_water}
+
+
+class PrefixCache:
+    """Content-addressed index of sealed full-prompt KV blocks.
+
+    Keyed by a per-model hash chain ``h_i = sha(h_{i-1},
+    block_token_ids)`` over *full* prompt blocks, so a block's key commits
+    to its entire prefix — equal keys mean bitwise-equal token history.
+    ``match`` revives the longest indexed prefix of a prompt (taking one
+    reference per shared block on the caller's behalf) capped at
+    ``len(prompt) - 1`` tokens: prefill always computes at least one tail
+    token and every KV *write* lands in a private tail block — shared
+    blocks are read-only by construction.  ``publish`` seals a
+    freshly-filled block into the index, first-publisher-wins; the
+    allocator's ``on_evict`` callback un-indexes reclaimed blocks."""
+
+    def __init__(self, allocator, block_size, namespace=""):
+        self.allocator = allocator
+        self.block_size = int(block_size)
+        self._seed = hashlib.sha256(
+            ("kvprefix:%s" % namespace).encode()).digest()
+        self._index = {}                 # hex digest -> physical block id
+        self._lock = threading.Lock()
+        allocator.on_evict = self._on_evict
+
+    def chain(self, token_ids):
+        """Hash chain over the full blocks of ``token_ids`` -> list of hex
+        digests, one per full block."""
+        bs = self.block_size
+        out = []
+        h = self._seed
+        for j in range(len(token_ids) // bs):
+            d = hashlib.sha256(h)
+            d.update(b"".join(int(t).to_bytes(8, "little", signed=True)
+                              for t in token_ids[j * bs:(j + 1) * bs]))
+            h = d.digest()
+            out.append(h.hex())
+        return out
+
+    def match(self, prompt_ids):
+        """Longest cached prefix -> ``(blocks, cached_tokens, hashes)``.
+
+        ``blocks`` arrive with one reference taken per block (the caller
+        frees them like any owned block); ``hashes`` is the full-prompt
+        chain, reused by the caller when publishing the tail."""
+        hashes = self.chain(prompt_ids)
+        max_blocks = max(0, (len(prompt_ids) - 1) // self.block_size)
+        blocks = []
+        with self._lock:
+            for j in range(min(len(hashes), max_blocks)):
+                b = self._index.get(hashes[j])
+                if b is None:
+                    break
+                if not self.allocator.incref(b):
+                    # reclaimed under us without the callback having run
+                    # yet — forget the stale entry and stop matching
+                    self._index.pop(hashes[j], None)
+                    break
+                blocks.append(b)
+        cached = len(blocks) * self.block_size
+        _tm.inc("prefix_cache_lookup_tokens_total", len(prompt_ids))
+        if cached:
+            _tm.inc("prefix_cache_hit_tokens_total", cached)
+        return blocks, cached, hashes
+
+    def publish(self, block, digest):
+        """Index a freshly-filled full-prompt ``block`` under ``digest``.
+        First-publisher-wins: a duplicate digest leaves the block private
+        and returns False."""
+        with self._lock:
+            if digest in self._index:
+                return False
+            self.allocator.seal(block, digest)
+            self._index[digest] = block
+            _tm.inc("prefix_cache_blocks_published_total")
+            return True
+
+    def _on_evict(self, block, tag):
+        with self._lock:
+            if self._index.get(tag) == block:
+                del self._index[tag]
+        _tm.inc("prefix_cache_evictions_total")
+
+    def __len__(self):
+        with self._lock:
+            return len(self._index)
 
 
 # live caches, summed into the MEM001 static peak estimate

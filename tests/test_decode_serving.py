@@ -5,8 +5,10 @@ under mixed-length continuous batching, token-level join/leave
 mid-batch, admission-time KV-pressure shed with a drain-time hint,
 deterministic preemption-recompute, client abort, the streaming
 ``__generate__``/``__stream__`` wire path, client replay on server
-timeout, int8 KV residency, and the probe-gated Pallas paged-attention
-funnel (interpret-mode parity)."""
+timeout, int8 KV residency, the probe-gated Pallas paged-attention
+funnel (interpret-mode parity), content-addressed prefix caching
+(hit parity, abort safety, evictable-pool admission), and the
+token-budget chunked-prefill scheduler."""
 
 import contextlib
 import threading
@@ -646,3 +648,205 @@ def test_draft_vocab_mismatch_rejected(tmp_path):
         with pytest.raises(ValueError, match="vocab"):
             e.add_model("toy", (CFG, PARAMS), kv_blocks=16, draft=bad,
                         speculative_k=2)
+
+
+# -- prefix caching ----------------------------------------------------------
+
+
+def test_prefix_cache_hit_bitwise_parity_and_flat_miss(cache_dir,
+                                                       telemetry_on):
+    e = _mkengine(cache_dir, 64, buckets="2,4")
+    try:
+        e.prewarm()
+        assert e.spec("toy")["prefix_cache"] is True
+        prompt = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5]      # 11 tokens
+        want = _unpaged(prompt, 8)
+        r1 = e.generate("toy", prompt, max_new_tokens=8,
+                        deadline_ms=30000.0)
+        assert r1.status == "ok", r1.error
+        assert r1.phases["cached_tokens"] == 0
+        assert np.array_equal(r1.outputs["tokens"], want)
+        assert _tm.counter_total("prefix_cache_blocks_published_total") \
+            == 2                                         # (11-1)//4
+        miss0 = _tm.counter_total("executor_cache_miss_total")
+        # the repeat skips both cached full prompt blocks, and the cached
+        # entry path runs through the SAME prewarmed executables — a hit
+        # may never trigger a runtime compile
+        r2 = e.generate("toy", prompt, max_new_tokens=8,
+                        deadline_ms=30000.0)
+        assert r2.status == "ok" and r2.phases["cached_tokens"] == 8
+        assert np.array_equal(r2.outputs["tokens"], want)
+        assert _tm.counter_total("prefix_cache_hit_tokens_total") == 8
+        assert _tm.counter_total("executor_cache_miss_total") == miss0
+        # shared prefix, different tail: still a hit, still bitwise
+        p3 = prompt[:8] + [7, 7]
+        r3 = e.generate("toy", p3, max_new_tokens=8, deadline_ms=30000.0)
+        assert r3.status == "ok" and r3.phases["cached_tokens"] == 8
+        assert np.array_equal(r3.outputs["tokens"], _unpaged(p3, 8))
+        assert _tm.counter_total("executor_cache_miss_total") == miss0
+    finally:
+        e.stop()
+
+
+def test_prefix_cache_off_is_bitwise_identical(cache_dir):
+    """FLAGS_prefix_cache only changes speed: the same prompts produce
+    byte-identical token streams with the index on and off."""
+    prompts = ([2, 3, 4, 5, 6, 7], [2, 3, 4, 5, 8, 9], [2, 3, 4, 5, 6, 7])
+    outs = []
+    for on in (True, False):
+        e = _mkengine(cache_dir, 64, buckets="2,4", prefix_cache=on)
+        try:
+            assert e.spec("toy")["prefix_cache"] is on
+            assert (e._models["toy"].prefix is not None) is on
+            outs.append([e.generate("toy", list(p), max_new_tokens=6,
+                                    deadline_ms=30000.0).outputs["tokens"]
+                         for p in prompts])
+        finally:
+            e.stop()
+    for a, b in zip(*outs):
+        assert np.array_equal(a, b)
+
+
+def test_spec_prefix_cache_hit_parity(cache_dir, telemetry_on):
+    # prefix hits compose with speculative decoding: the verify chain
+    # starts past the cached tokens, parity and pool hygiene hold
+    e = _spec_engine(cache_dir)
+    try:
+        prompt = [5, 6, 7, 8, 9, 10, 11, 12, 13]
+        want = _unpaged(prompt, 8)
+        for i, want_cached in enumerate((0, 8)):
+            r = e.generate("toy", prompt, max_new_tokens=8,
+                           deadline_ms=30000.0)
+            assert r.status == "ok", (i, r.error)
+            assert r.phases["cached_tokens"] == want_cached
+            assert np.array_equal(r.outputs["tokens"], want), i
+        m = e._models["toy"]
+        assert m.cache.allocator.in_use == 0
+        assert m.draft_cache.allocator.in_use == 0
+        # the draft pool never holds published blocks
+        assert m.draft_cache.allocator.num_evictable == 0
+    finally:
+        e.stop()
+
+
+def test_abort_mid_prefill_publishes_no_partial_block(cache_dir,
+                                                      telemetry_on):
+    """A client that disconnects mid-prefill frees its private tail
+    blocks, and a partially-filled block is never published into the
+    prefix index — only prompt blocks that were COMPLETELY fed before
+    the abort may appear."""
+    e = _mkengine(cache_dir, 64, buckets="1")
+    try:
+        m = e._models["toy"]
+        prompt = [(i % 29) + 1 for i in range(40)]       # 10 blocks
+        ra = e.submit("toy", prompt, max_new_tokens=4,
+                      deadline_ms=30000.0)
+        n_at_abort = None
+        deadline = time.time() + 30
+        while time.time() < deadline and n_at_abort is None:
+            with e._cond:       # scheduler frozen at a step boundary
+                for s in e._active:
+                    if s.pending.req_id == ra.req_id and s.n_fed > 0:
+                        assert s.in_prefill, "prefill already over"
+                        n_at_abort = s.n_fed
+                        assert e.abort(ra.req_id)
+            time.sleep(0.0005)
+        assert n_at_abort is not None, "never caught the seq mid-prefill"
+        assert ra.wait(timeout=10.0).status == "aborted"
+        # the index holds exactly the COMPLETELY fed blocks (mid-prefill
+        # n_fed < 40, so at most 9 of the 10) — never a partial one
+        assert len(m.prefix) == n_at_abort // BS
+        deadline = time.time() + 5
+        while time.time() < deadline and m.cache.allocator.in_use:
+            time.sleep(0.01)
+        # private tail blocks came back to the free list the same step;
+        # published ones parked zero-ref in the evictable pool
+        assert m.cache.allocator.in_use == 0
+        assert m.cache.allocator.num_evictable == len(m.prefix)
+    finally:
+        e.stop()
+
+
+def test_evictable_pool_counts_as_reclaimable_no_spurious_shed(
+        cache_dir, telemetry_on):
+    """Regression: with the free list empty-ish and the pool full of
+    zero-ref cached blocks, admission must treat evictable blocks as
+    reclaimable capacity instead of shedding."""
+    e = _mkengine(cache_dir, 8, buckets="1")             # 7 usable blocks
+    try:
+        alloc = e._models["toy"].cache.allocator
+        # 24-token prompt = 6 full prompt blocks + 1 decode block; on
+        # finish all 6 prompt blocks (24//4, every one completely fed)
+        # park sealed + evictable, the decode block returns to the free
+        # list — free list is down to a single block
+        pa_ = list(range(1, 25))
+        r = e.generate("toy", pa_, max_new_tokens=2, deadline_ms=30000.0)
+        assert r.status == "ok", r.error
+        assert np.array_equal(r.outputs["tokens"], _unpaged(pa_, 2))
+        deadline = time.time() + 5
+        while time.time() < deadline and alloc.in_use:
+            time.sleep(0.01)
+        assert alloc.in_use == 0
+        assert alloc.num_evictable == 6 and alloc.num_free == 1
+        assert alloc.reclaimable == 7
+        # B promises 3 prompt blocks: more than the free list holds,
+        # fewer than free + evictable — the old num_free admission check
+        # would shed here; reclaimable-based admission must not
+        pb = [29, 28, 27, 26] * 3
+        rb = e.generate("toy", pb, max_new_tokens=4, deadline_ms=30000.0)
+        assert rb.status == "ok", (rb.status, rb.error)
+        assert np.array_equal(rb.outputs["tokens"], _unpaged(pb, 4))
+        assert _tm.counter_total("serving_shed_total") == 0
+        # the allocation reclaimed LRU cached blocks and de-indexed them
+        assert _tm.counter_total("prefix_cache_evictions_total") >= 1
+    finally:
+        e.stop()
+
+
+# -- token-budget chunked prefill --------------------------------------------
+
+
+def test_prefill_token_budget_bitwise_parity_and_flat_miss(cache_dir,
+                                                           telemetry_on):
+    """Four 20-token prompts admitted at once under a 2-token/iteration
+    prefill budget: chunked admission is a pure scheduling change —
+    outputs stay bitwise-identical and no new shapes compile."""
+    e = _mkengine(cache_dir, 64, buckets="2,4")
+    try:
+        e.prewarm()
+        miss0 = _tm.counter_total("executor_cache_miss_total")
+        prompts = [[t] * 20 for t in (1, 2, 3, 4)]
+        with _flags(decode_prefill_token_budget=2):
+            with e._cond:       # all admitted the same iteration
+                reqs = [e.submit("toy", p, max_new_tokens=6,
+                                 deadline_ms=30000.0) for p in prompts]
+            replies = [r.wait(timeout=60.0) for r in reqs]
+        assert all(r is not None and r.status == "ok" for r in replies)
+        for p, r in zip(prompts, replies):
+            assert np.array_equal(r.outputs["tokens"], _unpaged(p, 6)), p[0]
+        assert _tm.counter_total("executor_cache_miss_total") == miss0
+        assert e._models["toy"].cache.allocator.in_use == 0
+    finally:
+        e.stop()
+
+
+def test_prefill_token_budget_spec_parity(cache_dir, telemetry_on):
+    # same scheduling invariant on the speculative path: prefill chunks
+    # are capped by the budget, decode lanes keep speculating, parity
+    # holds for every stream
+    e = _spec_engine(cache_dir, buckets="2,4")
+    try:
+        prompts = [[t] * 16 for t in (9, 8, 7)]
+        with _flags(decode_prefill_token_budget=3):
+            with e._cond:
+                reqs = [e.submit("toy", p, max_new_tokens=5,
+                                 deadline_ms=30000.0) for p in prompts]
+            replies = [r.wait(timeout=60.0) for r in reqs]
+        assert all(r is not None and r.status == "ok" for r in replies)
+        for p, r in zip(prompts, replies):
+            assert np.array_equal(r.outputs["tokens"], _unpaged(p, 5)), p[0]
+        m = e._models["toy"]
+        assert m.cache.allocator.in_use == 0
+        assert m.draft_cache.allocator.in_use == 0
+    finally:
+        e.stop()

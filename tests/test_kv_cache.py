@@ -1,7 +1,10 @@
 """Paged KV-cache pool (serving/kv_cache.py): block-allocator units
 (all-or-nothing OOM, LIFO reuse, loud double-free, high-water),
-budget-gated sizing via FLAGS_hbm_budget_bytes / FLAGS_kv_cache_blocks,
-int8 residency quantization round-trips, and the MEM001 fold of
+refcounted sharing + the sealed/evictable LRU pool behind prefix
+caching, the content-addressed PrefixCache index (hash-chain match,
+first-publisher-wins publish, eviction de-indexing), budget-gated
+sizing via FLAGS_hbm_budget_bytes / FLAGS_kv_cache_blocks, int8
+residency quantization round-trips, and the MEM001 fold of
 engine-owned KV bytes into the static per-replica peak estimate."""
 
 import contextlib
@@ -16,8 +19,8 @@ from paddle_tpu.core import telemetry as _tm
 from paddle_tpu.core import world_analysis
 from paddle_tpu.serving import kv_cache
 from paddle_tpu.serving.kv_cache import (BlockAllocator, KVCacheConfig,
-                                         PagedKVCache, block_bytes,
-                                         dequantize_kv,
+                                         PagedKVCache, PrefixCache,
+                                         block_bytes, dequantize_kv,
                                          engine_owned_kv_bytes,
                                          plan_num_blocks, quantize_kv)
 
@@ -104,6 +107,196 @@ def test_oom_increments_counter():
     finally:
         _tm.reset()
         fluid.set_flags({"FLAGS_telemetry": False})
+
+
+# -- refcounted sharing + the sealed/evictable pool --------------------------
+
+
+def test_incref_shares_and_free_decrefs():
+    a = BlockAllocator(8, reserve=1)
+    (b,) = a.alloc(1)
+    assert a.refcount(b) == 1
+    assert a.incref(b)
+    assert a.refcount(b) == 2
+    a.free([b])                    # one owner down: block stays in use
+    assert a.refcount(b) == 1 and a.in_use == 1 and a.num_free == 6
+    a.free([b])                    # last owner: back to the free list
+    assert a.refcount(b) == 0 and a.in_use == 0 and a.num_free == 7
+
+
+def test_sealed_block_parks_evictable_and_revives():
+    a = BlockAllocator(8, reserve=1)
+    (b,) = a.alloc(1)
+    a.seal(b, "tag-b")
+    a.free([b])
+    # zero-ref but sealed: parked, NOT on the free list
+    assert a.in_use == 0 and a.num_evictable == 1 and a.num_free == 6
+    assert a.reclaimable == 7
+    # revival takes a fresh reference and keeps the seal
+    assert a.incref(b)
+    assert a.refcount(b) == 1 and a.num_evictable == 0
+    a.free([b])
+    assert a.num_evictable == 1    # re-parks at zero refs
+
+
+def test_incref_of_free_or_unknown_block_is_refused():
+    a = BlockAllocator(8, reserve=1)
+    (b,) = a.alloc(1)
+    a.free([b])                    # unsealed: returned to the free list
+    assert not a.incref(b)
+    assert not a.incref(99)
+
+
+def test_unsealed_free_keeps_lifo_reuse():
+    a = BlockAllocator(8, reserve=1)
+    first = a.alloc(2)
+    a.free(first)
+    assert a.alloc(2)[0] == first[-1]
+
+
+def test_alloc_reclaims_evictable_lru_first_and_fires_callback():
+    a = BlockAllocator(5, reserve=1)   # capacity 4
+    evicted = []
+    a.on_evict = lambda b, tag: evicted.append((b, tag))
+    got = a.alloc(4)
+    for i, b in enumerate(got):
+        a.seal(b, "t%d" % i)
+    a.free(got)                        # all parked, free list empty
+    assert a.num_free == 0 and a.num_evictable == 4
+    # free list is preferred... there is none, so the LRU victim is the
+    # longest-parked block, and the index learns it is gone
+    take = a.alloc(2)
+    assert take == [got[0], got[1]]    # park order == free order (LRU)
+    assert evicted == [(got[0], "t0"), (got[1], "t1")]
+    # untouched parked blocks remain revivable
+    assert a.incref(got[2])
+
+
+def test_alloc_all_or_nothing_spans_eviction_reclaim():
+    a = BlockAllocator(5, reserve=1)   # capacity 4
+    evicted = []
+    a.on_evict = lambda b, tag: evicted.append(b)
+    keep = a.alloc(2)
+    (sealed,) = a.alloc(1)
+    a.seal(sealed, "s")
+    a.free([sealed])
+    assert a.num_free == 1 and a.num_evictable == 1
+    # need 3, reclaimable only 2: takes NOTHING — the evictable block
+    # survives and no eviction callback fires
+    before = a.stats()
+    assert a.alloc(3) is None
+    assert a.stats() == before and evicted == []
+    # need 2 spans free list + eviction reclaim in ONE all-or-nothing
+    got = a.alloc(2)
+    assert len(got) == 2 and sealed in got and evicted == [sealed]
+    a.free(got + keep)
+
+
+def test_double_free_still_loud_with_refcounts():
+    a = BlockAllocator(8, reserve=1)
+    (b,) = a.alloc(1)
+    a.seal(b, "t")
+    a.free([b])
+    # parked evictable is NOT owned: freeing it again must raise, not
+    # silently double-park
+    with pytest.raises(ValueError):
+        a.free([b])
+    (c,) = a.alloc(1)
+    a.free([c])
+    with pytest.raises(ValueError):
+        a.free([c])
+
+
+def test_stats_and_high_water_include_evictable():
+    a = BlockAllocator(8, reserve=1)
+    got = a.alloc(3)
+    a.seal(got[0], "t0")
+    a.free(got)
+    st = a.stats()
+    assert st["evictable"] == 1
+    assert st["reclaimable"] == st["free"] + st["evictable"] == 7
+    # evictable blocks still occupy pool slots: parking never lowers the
+    # high-water mark, and occupied (in_use + evictable) peaks count
+    a.alloc(4)
+    assert a.stats()["high_water"] == 5    # 4 in use + 1 parked
+
+
+# -- PrefixCache: hash-chain index over sealed blocks ------------------------
+
+
+def test_hash_chain_commits_to_whole_prefix():
+    a = BlockAllocator(8, reserve=1)
+    pc = PrefixCache(a, block_size=4, namespace="m")
+    base = pc.chain([1, 2, 3, 4, 5, 6, 7, 8])
+    assert len(base) == 2                       # full blocks only
+    assert len(pc.chain([1, 2, 3])) == 0        # no full block yet
+    same_first = pc.chain([1, 2, 3, 4, 9, 9, 9, 9])
+    assert same_first[0] == base[0] and same_first[1] != base[1]
+    # a different namespace (model) never shares an index key space
+    other = PrefixCache(BlockAllocator(8, reserve=1), 4, namespace="n")
+    assert other.chain([1, 2, 3, 4])[0] != base[0]
+
+
+def test_match_publish_roundtrip_with_revival():
+    a = BlockAllocator(8, reserve=1)
+    pc = PrefixCache(a, block_size=4, namespace="m")
+    prompt = list(range(10))                    # 2 full blocks + tail
+    blocks, cached, hashes = pc.match(prompt)
+    assert (blocks, cached) == ([], 0) and len(hashes) == 2
+    owned = a.alloc(3)
+    assert pc.publish(owned[0], hashes[0])
+    assert pc.publish(owned[1], hashes[1])
+    assert len(pc) == 2
+    a.free(owned)                               # published pair parks
+    assert a.num_evictable == 2
+    got, cached, _ = pc.match(prompt)
+    assert got == owned[:2] and cached == 8
+    assert a.refcount(owned[0]) == 1            # revived on our behalf
+    a.free(got)
+
+
+def test_match_caps_at_len_minus_one_tokens():
+    a = BlockAllocator(8, reserve=1)
+    pc = PrefixCache(a, block_size=4, namespace="m")
+    prompt = list(range(8))                     # exactly 2 full blocks
+    owned = a.alloc(2)
+    h = pc.chain(prompt)
+    pc.publish(owned[0], h[0])
+    pc.publish(owned[1], h[1])
+    # a full-prompt match would leave prefill NOTHING to feed — the
+    # match must stop one block short so at least one tail token runs
+    got, cached, _ = pc.match(prompt)
+    assert got == [owned[0]] and cached == 4
+    a.free(got)
+    a.free(owned)
+
+
+def test_publish_is_first_publisher_wins():
+    a = BlockAllocator(8, reserve=1)
+    pc = PrefixCache(a, block_size=4, namespace="m")
+    h = pc.chain([5, 6, 7, 8])
+    b1, b2 = a.alloc(2)
+    assert pc.publish(b1, h[0])
+    assert not pc.publish(b2, h[0])             # duplicate: stays private
+    a.free([b1, b2])
+    assert a.num_evictable == 1                 # only the winner parked
+    assert a.num_free == 6
+
+
+def test_eviction_deindexes_and_match_misses():
+    a = BlockAllocator(4, reserve=1)            # capacity 3
+    pc = PrefixCache(a, block_size=4, namespace="m")
+    prompt = [1, 2, 3, 4, 9]
+    h = pc.chain(prompt)
+    (b,) = a.alloc(1)
+    pc.publish(b, h[0])
+    a.free([b])
+    assert len(pc) == 1
+    # pressure reclaims the parked block -> the index must forget it
+    a.alloc(3)
+    assert len(pc) == 0
+    got, cached, _ = pc.match(prompt)
+    assert got == [] and cached == 0
 
 
 # -- sizing (plan_num_blocks) ------------------------------------------------

@@ -36,6 +36,18 @@ and their ``spec_acceptance_rate``, and ``outputs_sha256`` — a
 fingerprint of every (prompt -> output tokens) pair, so the same seeded
 traffic replayed with speculation on and off can assert bitwise-equal
 output next to the tokens/sec comparison.
+
+``--prefix-share F`` turns on shared-prefix traffic: a fraction F of
+requests prepend one of ``--prefix-pool`` seeded common prefixes of
+``--prefix-tokens`` tokens to their random tail — the system-prompt /
+few-shot-template shape the engine's KV prefix cache exists for.  The
+report then carries ``prefix_share``, ``prefix_tokens``, and
+``prefix_cache_hit_rate`` (client-side exact: Σ cached_tokens from the
+reply phases / Σ prompt tokens — scrape-window independent), plus the
+scraped ``prefix_cache_hit_tokens`` counter.  Replaying the same seed
+with ``FLAGS_prefix_cache`` on and off gives the cache-on/off TTFT and
+tokens/sec comparison on bitwise-identical traffic (equal
+``outputs_sha256`` is the parity precondition).
 """
 
 import argparse
@@ -101,6 +113,14 @@ def main(argv=None):
     ap.add_argument("--retry-shed", type=int, default=0,
                     help="resubmit a shed request up to N times after "
                     "its retry_after_ms hint")
+    ap.add_argument("--prefix-share", type=float, default=0.0,
+                    help="decode traffic: fraction of requests whose "
+                    "prompt starts with a shared common prefix drawn "
+                    "from a small seeded pool (KV prefix-cache traffic)")
+    ap.add_argument("--prefix-tokens", type=int, default=24,
+                    help="length of each shared prefix")
+    ap.add_argument("--prefix-pool", type=int, default=2,
+                    help="number of distinct shared prefixes in the pool")
     args = ap.parse_args(argv)
 
     from paddle_tpu.serving import ServingClient
@@ -116,10 +136,21 @@ def main(argv=None):
     pmix = [int(b) for b in args.prompt_mix.split(",") if b]
     rng = random.Random(args.seed)
 
+    vocab = int(spec.get("vocab", 2))
+    # the shared-prefix pool is drawn from the SAME seeded rng before any
+    # traffic, so two runs of one seed (cache-on vs cache-off) replay
+    # bitwise-identical prompts
+    prefixes = []
+    if decode and args.prefix_share > 0:
+        prefixes = [[rng.randrange(vocab)
+                     for _ in range(args.prefix_tokens)]
+                    for _ in range(args.prefix_pool)]
+
     lock = threading.Lock()
     latencies, statuses = [], {}
     phase_samples = {"queue_wait_ms": [], "execute_ms": [], "wire_ms": []}
     ttfts, itls, tokens_out = [], [], [0]
+    cached_toks, prompt_toks = [0], [0]   # client-side exact hit rate
     out_map = {}    # prompt tuple -> generated tokens (greedy => unique)
     threads = []
 
@@ -152,6 +183,8 @@ def main(argv=None):
                                 r.outputs.get("tokens", ()))
                     tokens_out[0] += len(toks)
                     out_map[tuple(prompt)] = toks
+                    cached_toks[0] += int(r.phases.get("cached_tokens", 0))
+                    prompt_toks[0] += len(prompt)
                     # client-observed (wire-inclusive) when streaming,
                     # server-side phase attribution otherwise
                     ttft = r.phases.get("client_ttft_ms",
@@ -166,8 +199,14 @@ def main(argv=None):
     next_at = t_start
     for _ in range(args.requests):
         next_at += rng.expovariate(args.qps)
-        prompt = [rng.randrange(int(spec.get("vocab", 2)))
-                  for _ in range(rng.choice(pmix))] if decode else None
+        prompt = None
+        if decode:
+            # rng draw order matches the prefix-free generator when
+            # --prefix-share is 0, so legacy seeded traffic is unchanged
+            prompt = [rng.randrange(vocab)
+                      for _ in range(rng.choice(pmix))]
+            if prefixes and rng.random() < args.prefix_share:
+                prompt = rng.choice(prefixes) + prompt
         delay = next_at - time.perf_counter()
         if delay > 0:
             time.sleep(delay)
@@ -184,6 +223,7 @@ def main(argv=None):
     # replica in tiny test fleets)
     batch_fill = None
     spec_proposed = spec_accepted = 0.0
+    prefix_hit_scraped = 0.0
     try:
         snap = client.scrape()
         if decode and tokens_out[0]:
@@ -207,6 +247,9 @@ def main(argv=None):
         spec_accepted = sum(
             v for k, v in counters.items()
             if k.startswith("spec_tokens_accepted_total"))
+        prefix_hit_scraped = sum(
+            v for k, v in counters.items()
+            if k.startswith("prefix_cache_hit_tokens_total"))
     except Exception:
         pass
 
@@ -259,6 +302,17 @@ def main(argv=None):
             "spec_tokens_accepted": spec_accepted,
             "spec_acceptance_rate": round(
                 spec_accepted / spec_proposed, 4) if spec_proposed else None,
+            # shared-prefix traffic + KV prefix-cache effectiveness:
+            # hit rate is client-side exact (Σ cached_tokens from reply
+            # phases / Σ prompt tokens — independent of scrape windows);
+            # the scraped counter is the server-side cross-check
+            "prefix_share": args.prefix_share,
+            "prefix_tokens": args.prefix_tokens
+            if args.prefix_share > 0 else 0,
+            "prefix_cache_hit_rate": round(
+                cached_toks[0] / prompt_toks[0], 4)
+            if prompt_toks[0] else None,
+            "prefix_cache_hit_tokens": prefix_hit_scraped,
             "outputs_sha256": digest,
             "outputs_distinct": len(out_map),
         })

@@ -109,7 +109,8 @@ def main(argv=None):
                     ", kv_cache_bytes, kv_block_evictions_total), "
                     "serving_decode_* / serving_tokens_generated_total, "
                     "speculative-decode spec_* counters and acceptance "
-                    "histogram, and the decode_batch_occupancy histogram")
+                    "histogram, prefix_cache_* hit/publish/eviction "
+                    "counters, and the decode_batch_occupancy histogram")
     ap.add_argument("--tracing", action="store_true", dest="tracing_only",
                     help="show only distributed-tracing health metrics: "
                     "tracing_records_total{kind} and "
@@ -155,7 +156,8 @@ def main(argv=None):
         snap = _filter_snap(snap, ("kv_block", "kv_cache_",
                                    "kv_blocks_in_use", "serving_decode_",
                                    "serving_tokens_", "serving_abort_",
-                                   "decode_batch_occupancy", "spec_"))
+                                   "decode_batch_occupancy", "spec_",
+                                   "prefix_cache_"))
     if args.tracing_only:
         snap = _filter_snap(snap, "tracing_")
     if args.ckpt_only:
