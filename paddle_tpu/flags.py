@@ -238,6 +238,38 @@ _DEFAULTS = {
     # where the fleet coordinator publishes the live endpoints JSON
     # (clients re-read it to fail over); empty = no file
     "FLAGS_serving_endpoints_file": "",
+    # -- serving control plane (tiers / autoscale / rollout) -----------------
+    # SLO tiers: "tier:weight" comma list.  A request's tier scales its
+    # admission deadline budget (shed when projected wait > deadline x
+    # weight) and orders both batch assembly and queue-full eviction, so
+    # under overload the lowest-weight tier sheds first.  Requests with
+    # no tier get weight 1.0 (pre-tier behavior); an unknown tier name
+    # defensively gets the lowest configured weight.
+    "FLAGS_serving_tier_weights": "paid:1.0,free:0.45,batch:0.15",
+    # ServingClient: how many times a shed reply is retried client-side
+    # after its retry_after_ms hint (with backoff+jitter) before the shed
+    # is surfaced to the caller; 0 restores the old return-immediately
+    "FLAGS_serving_client_shed_retries": 2,
+    # replica autoscaler (serving/fleet.py AutoScaler, tools/serve.py
+    # --autoscale): poll period (s); consecutive pressure/idle polls
+    # before scaling (hysteresis); post-action cooldown polls; the mean
+    # queue depth that counts as pressure; and the replica count clamp
+    "FLAGS_serving_autoscale_interval": 0.5,
+    "FLAGS_serving_scale_up_ticks": 3,
+    "FLAGS_serving_scale_down_ticks": 8,
+    "FLAGS_serving_autoscale_cooldown": 6,
+    "FLAGS_serving_scale_up_depth": 4.0,
+    "FLAGS_serving_min_replicas": 1,
+    "FLAGS_serving_max_replicas": 4,
+    # versioned rollout (serving/rollout.py): default canary traffic
+    # fraction, and the auto-rollback gate — trips when the canary's
+    # phase p99 exceeds ratio x the baseline version's, or its per-
+    # request error rate exceeds the cap, judged only after min_samples
+    # canary requests have completed
+    "FLAGS_serving_canary_fraction": 0.25,
+    "FLAGS_rollout_gate_p99_ratio": 2.0,
+    "FLAGS_rollout_gate_error_rate": 0.05,
+    "FLAGS_rollout_gate_min_samples": 20,
     # -- autoregressive decode serving (serving/kv_cache.py + DecodeEngine) --
     # decode-lane buckets: the running token batch pads to the smallest
     # bucket that fits the live sequences; one decode-step executable is

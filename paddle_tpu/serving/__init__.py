@@ -15,20 +15,30 @@ token-level continuous batches over an engine-owned ``PagedKVCache``
 serving/decode_model.py through one AOT-compiled executable per lane
 bucket; generated tokens stream back as ``__stream__`` chunks.
 
+The control plane above the fleet (PR 16) rides the same pieces:
+SLO-tiered deadline-weighted admission in the engines, an ``AutoScaler``
+launching prewarmed standbys / draining idle replicas, and a
+``RolloutController`` canarying ``name@v2`` behind a metrics gate with
+automatic rollback (serving/rollout.py).
+
 Entry points: ``tools/serve.py`` and ``tools/loadgen.py``.
 """
 
 from .client import ServingClient, read_endpoints_file  # noqa: F401
 from .engine import DecodeEngine, InferReply, ServingEngine, \
-    parse_buckets  # noqa: F401
-from .fleet import ServingFleet, write_endpoints_file  # noqa: F401
+    parse_buckets, parse_tier_weights, tier_weight  # noqa: F401
+from .fleet import AutoScaler, ServingFleet, \
+    write_endpoints_file  # noqa: F401
 from .kv_cache import BlockAllocator, KVCacheConfig, PagedKVCache, \
     engine_owned_kv_bytes, plan_num_blocks  # noqa: F401
+from .rollout import RolloutController, evaluate_gate  # noqa: F401
 from .server import ServingServer  # noqa: F401
 
 __all__ = [
     "ServingEngine", "DecodeEngine", "ServingServer", "ServingClient",
-    "ServingFleet", "InferReply", "parse_buckets", "read_endpoints_file",
-    "write_endpoints_file", "KVCacheConfig", "BlockAllocator",
-    "PagedKVCache", "plan_num_blocks", "engine_owned_kv_bytes",
+    "ServingFleet", "AutoScaler", "RolloutController", "evaluate_gate",
+    "InferReply", "parse_buckets", "parse_tier_weights", "tier_weight",
+    "read_endpoints_file", "write_endpoints_file", "KVCacheConfig",
+    "BlockAllocator", "PagedKVCache", "plan_num_blocks",
+    "engine_owned_kv_bytes",
 ]

@@ -14,20 +14,30 @@ replicas.  A request is "dropped" only when every endpoint attempt fails
 
 Replays trigger on ConnectionError AND on a server-side "timeout" reply
 (a replica that answered "deadline expired in queue" is overloaded, not
-authoritative — another replica may still make the SLO).  For the
-autoregressive path (``generate``/``generate_stream``), the client sends
+authoritative — another replica may still make the SLO).  "shed" replies
+are ALSO retried (up to ``FLAGS_serving_client_shed_retries`` extra
+attempts) after honoring the server's ``retry_after_ms`` hint with
+exponential backoff + jitter, so callers stop hand-rolling shed loops;
+``client_shed_retries_total`` counts them.  For the autoregressive path
+(``generate``/``generate_stream``), the client sends
 ``__abort__:<req_id>`` to the endpoint it is abandoning before replaying
 elsewhere, so a half-prefilled sequence can't pin paged KV blocks on a
 replica that will never be asked for the answer.
+
+Requests carry an optional SLO ``tier`` ("paid"/"free"/"batch") in the
+meta; the engine's deadline-weighted admission sheds low tiers first
+under overload.
 """
 
 import json
 import os
+import random
 import time
 import uuid
 
 import numpy as np
 
+from ..core import telemetry as _tm
 from ..core import tracing as _tr
 from ..native.rpc import RpcClient
 from . import codec
@@ -62,6 +72,7 @@ class ServingClient:
             else _flag("serving_deadline_ms"))
         self._rr = 0
         self.failovers = 0
+        self.shed_retries = 0
         if not self._static and not self.endpoints_file:
             raise ValueError("ServingClient needs endpoints or an "
                              "endpoints file")
@@ -100,7 +111,18 @@ class ServingClient:
 
     # -- inference -----------------------------------------------------------
 
-    def infer(self, model, feeds, deadline_ms=None, max_attempts=None):
+    def _shed_backoff(self, reply, sheds):
+        """Honor the server's retry_after_ms hint: exponential backoff on
+        repeat sheds, +-50% jitter so a shed herd doesn't re-arrive in
+        lockstep."""
+        base_s = min(max(reply.retry_after_ms, 1.0), 1000.0) / 1e3
+        delay = min(base_s * (2.0 ** sheds), 2.0)
+        time.sleep(delay * (0.5 + random.random()))
+        self.shed_retries += 1
+        _tm.inc("client_shed_retries_total")
+
+    def infer(self, model, feeds, deadline_ms=None, max_attempts=None,
+              tier=None):
         """Run one request; fails over across live endpoints.  Returns an
         InferReply whose status is ok|shed|timeout|error, or "dropped"
         when every endpoint attempt failed."""
@@ -114,6 +136,8 @@ class ServingClient:
         meta_req = {"model": model, "tenant": self.tenant,
                     "req_id": req_id, "deadline_ms": deadline_ms,
                     "feeds": names}
+        if tier:
+            meta_req[codec.TIER] = tier
         if root.traceparent:
             meta_req[codec.TRACEPARENT] = root.traceparent
         payload = codec.pack(meta_req, [feeds[n] for n in names])
@@ -123,8 +147,10 @@ class ServingClient:
         t0 = time.perf_counter()
         last_err = None
         last_reply = None
+        sheds = 0
+        shed_cap = int(_flag("serving_client_shed_retries") or 0)
         eps = self.endpoints()
-        attempts = int(max_attempts or max(2 * len(eps), 2))
+        attempts = int(max_attempts or max(2 * len(eps), 2) + shed_cap)
         for i in range(attempts):
             if i:
                 self.failovers += 1
@@ -167,6 +193,20 @@ class ServingClient:
                 last_err = "server timeout: %s" % reply.error
                 last_reply = reply
                 continue
+            if reply.status == "shed" and sheds < shed_cap \
+                    and i + 1 < attempts:
+                # the server told us when it expects capacity — wait it
+                # out (with jitter) instead of failing the caller
+                last_err = "shed: %s" % reply.error
+                last_reply = reply
+                self._shed_backoff(reply, sheds)
+                sheds += 1
+                # fresh req_id: the shed reply is already published under
+                # the old one, and a same-endpoint retry must not read it
+                req_id = uuid.uuid4().hex
+                meta_req["req_id"] = req_id
+                payload = codec.pack(meta_req, [feeds[n] for n in names])
+                continue
             root.annotate(status=reply.status, endpoint=ep,
                           attempts=i + 1).end()
             return reply
@@ -198,7 +238,7 @@ class ServingClient:
 
     def generate(self, model, prompt_ids, max_new_tokens=16,
                  deadline_ms=None, eos_id=-1, stream=True, on_token=None,
-                 max_attempts=None):
+                 max_attempts=None, tier=None):
         """One autoregressive request; returns an InferReply whose
         outputs["tokens"] holds the generated ids.  With ``stream`` the
         client walks per-token ``__stream__`` chunks, so the reply phases
@@ -217,14 +257,18 @@ class ServingClient:
                     "req_id": req_id, "deadline_ms": deadline_ms,
                     "max_new_tokens": int(max_new_tokens),
                     "eos_id": int(eos_id), "stream": bool(stream)}
+        if tier:
+            meta_req[codec.TIER] = tier
         if root.traceparent:
             meta_req[codec.TRACEPARENT] = root.traceparent
         payload = codec.pack(meta_req, [prompt])
         get_timeout = deadline_ms / 1e3 + 30.0
         t0 = time.perf_counter()
         last_err, last_reply = None, None
+        sheds = 0
+        shed_cap = int(_flag("serving_client_shed_retries") or 0)
         eps = self.endpoints()
-        attempts = int(max_attempts or max(2 * len(eps), 2))
+        attempts = int(max_attempts or max(2 * len(eps), 2) + shed_cap)
         for i in range(attempts):
             if i:
                 self.failovers += 1
@@ -287,6 +331,19 @@ class ServingClient:
                 last_reply = reply
                 self._abort(ep, req_id)
                 continue
+            if reply.status == "shed" and sheds < shed_cap \
+                    and i + 1 < attempts:
+                # shed at admission: nothing to abort server-side, but a
+                # same-endpoint retry needs a fresh req_id (the shed
+                # reply is already published under the old one)
+                last_err = "shed: %s" % reply.error
+                last_reply = reply
+                self._shed_backoff(reply, sheds)
+                sheds += 1
+                req_id = uuid.uuid4().hex
+                meta_req["req_id"] = req_id
+                payload = codec.pack(meta_req, [prompt])
+                continue
             root.annotate(status=reply.status, endpoint=ep,
                           attempts=i + 1,
                           tokens=len(reply.outputs.get("tokens", ()))
@@ -326,3 +383,43 @@ class ServingClient:
 
         ep = endpoint or self.endpoints()[0]
         return telemetry.scrape(ep, timeout=timeout)
+
+    # -- rollout admin -------------------------------------------------------
+
+    def rollout(self, cmd, timeout=10.0):
+        """Send one RolloutController command (start/flip/abort/status)
+        to the coordinator; returns the reply meta.  Non-coordinator
+        replicas answer "not coordinator" and are skipped."""
+        last_err = None
+        eps = self.endpoints()
+        # try the coordinator first (alive() -> [rank, epoch, is_coord])
+        eps = sorted(eps, key=lambda ep: 0 if (
+            (self.alive(ep) or [0, 0, 0])[2]) else 1)
+        for ep in eps:
+            req_id = uuid.uuid4().hex
+            try:
+                c = RpcClient(ep, connect_timeout=2.0,
+                              rpc_deadline=timeout, retry_times=0)
+                try:
+                    c.send_var(codec.ROLLOUT_CTL_KEY + req_id,
+                               codec.pack(cmd))
+                    meta, _ = codec.unpack(
+                        c.get_var(codec.REPLY_KEY + req_id))
+                finally:
+                    c.close()
+            except ConnectionError as e:
+                last_err = str(e)
+                continue
+            if meta.get("status") == "error" and "coordinator" in (
+                    meta.get("error") or ""):
+                last_err = meta["error"]
+                continue
+            return meta
+        raise ConnectionError("rollout command failed everywhere: %s"
+                              % last_err)
+
+    def rollout_state(self, endpoint, timeout=10.0):
+        """One replica's applied version-routing doc (__rollout__ var):
+        {"models": {base: {active, canary, fraction, state}}}."""
+        meta, _ = self._get_packed(endpoint, codec.ROLLOUT_KEY, timeout)
+        return meta

@@ -30,6 +30,27 @@ elastic ``__alive__``):
                            KV blocks so an abandoned prefill can't pin
                            the pool
 
+Control-plane keys (PR 16):
+
+  ``__retire__``           coordinator -> replica: stop admitting, drain
+                           the queue at a batch boundary, then exit (the
+                           autoscaler's graceful scale-down path)
+  ``__rollout__``          per-replica published rollout state (packed
+                           {"models": {base: {active/canary/fraction/
+                           state}}}) — the chaos leg GETs it from every
+                           survivor to assert version agreement
+  ``__rollout_set__``      coordinator -> replica state broadcast (same
+                           payload); idempotent, re-sent periodically so
+                           a replica that missed a flip converges
+  ``__rollout_ctl__:<id>`` client -> coordinator admin command
+                           (start/flip/abort/status); the reply lands on
+                           ``__reply__:<id>`` like any request
+
+Requests carry their SLO tier in the meta under ``TIER`` ("paid" /
+"free" / "batch"); the engine's deadline-weighted admission sheds
+low-weight tiers first under overload, counted per tier in
+``serving_tier_shed_total{tier}``.
+
 Distributed tracing (core/tracing.py) rides the meta under the
 ``TRACEPARENT`` key: the client stamps its root span's W3C-style
 ``traceparent`` into the request meta, the server parents its admission
@@ -43,7 +64,8 @@ import numpy as np
 
 __all__ = ["pack", "unpack", "INFER_KEY", "REPLY_KEY", "SPEC_KEY",
            "ALIVE_KEY", "GEN_KEY", "STREAM_KEY", "ABORT_KEY",
-           "TRACEPARENT"]
+           "RETIRE_KEY", "ROLLOUT_KEY", "ROLLOUT_SET_KEY",
+           "ROLLOUT_CTL_KEY", "TRACEPARENT", "TIER"]
 
 INFER_KEY = "__infer__:"
 REPLY_KEY = "__reply__:"
@@ -54,8 +76,16 @@ ALIVE_KEY = "__alive__"
 GEN_KEY = "__generate__:"
 STREAM_KEY = "__stream__:"
 ABORT_KEY = "__abort__:"
+# serving control plane: autoscaler drain-and-exit order, rollout state
+# (published per replica / broadcast by the coordinator), admin commands
+RETIRE_KEY = "__retire__"
+ROLLOUT_KEY = "__rollout__"
+ROLLOUT_SET_KEY = "__rollout_set__"
+ROLLOUT_CTL_KEY = "__rollout_ctl__:"
 # meta key carrying the W3C-style trace context across the wire
 TRACEPARENT = "traceparent"
+# meta key carrying the request's SLO tier (paid|free|batch)
+TIER = "tier"
 
 
 def pack(meta, arrays=()):

@@ -23,15 +23,39 @@ recompiles on every new request shape.  The engine closes both gaps:
   ever hit the in-memory executable cache: zero runtime compiles, provable
   from the ``executor_cache_miss_total`` / ``compile_cache_*`` counters.
 
+Serving control plane (PR 16) on top of that:
+
+- **SLO tiers**: a request carries a tier (``paid``/``free``/``batch``),
+  whose configured weight (``FLAGS_serving_tier_weights``) scales its
+  admission budget — shed when projected wait exceeds deadline x weight
+  — orders batch assembly (higher weight dispatches first), and decides
+  queue-full eviction (an arriving higher-weight request evicts the
+  lowest-weight queued one instead of being shed itself).  Under
+  overload the free tier sheds first and paid p99 never starves.
+- **drain hook**: ``drain()`` flips the engine into a shedding-only
+  state and waits for the queue to empty — the autoscaler's graceful
+  scale-down runs it on the victim so retirement lands at a batch
+  boundary with zero dropped requests.
+- **versioned routing**: ``add_model("fc@v2", ...)`` registers a second
+  version beside ``fc``; ``set_route`` splits base-name traffic between
+  active and canary versions by a deterministic per-request hash, so the
+  rollout controller (serving/rollout.py) can canary, flip, and roll
+  back without touching clients.  Reply phases carry the resolved
+  version so per-version p99s fall out of the same attribution.
+
 Telemetry: ``serving_queue_depth`` gauge, ``serving_batch_fill`` +
-``serving_latency_ms`` histograms, ``serving_qps`` gauge (5 s window),
+``serving_latency_ms`` + ``serving_execute_ms`` histograms,
+``serving_qps`` gauge (5 s window),
 ``serving_requests_total{model,tenant}``, ``serving_shed_total{reason}``,
-``serving_timeout_total``, ``serving_batches_total{model,bucket}``.
+``serving_tier_shed_total{tier}``, ``serving_timeout_total``,
+``serving_batches_total{model,bucket}``,
+``serving_request_errors_total{model}``.
 """
 
 import threading
 import time
 import uuid
+import zlib
 
 import numpy as np
 
@@ -39,7 +63,8 @@ from ..core import telemetry as _tm
 from ..core import tracing as _tr
 from ..core.executor import scope_guard
 
-__all__ = ["ServingEngine", "DecodeEngine", "InferReply", "parse_buckets"]
+__all__ = ["ServingEngine", "DecodeEngine", "InferReply", "parse_buckets",
+           "parse_tier_weights", "tier_weight"]
 
 _QPS_WINDOW_S = 5.0
 
@@ -61,6 +86,44 @@ def parse_buckets(spec=None):
     if not sizes or any(s <= 0 for s in sizes):
         raise ValueError("serving buckets must be positive ints: %r" % spec)
     return tuple(sorted(set(sizes)))
+
+
+def parse_tier_weights(spec=None):
+    """\"paid:1.0,free:0.45\" -> {tier: weight}; weights in (0, 1]."""
+    if spec is None:
+        spec = _flag("serving_tier_weights")
+    if isinstance(spec, dict):
+        out = {str(k): float(v) for k, v in spec.items()}
+    else:
+        out = {}
+        for part in str(spec).replace(" ", "").split(","):
+            if not part:
+                continue
+            name, _, w = part.partition(":")
+            if not name or not w:
+                raise ValueError("tier weights want tier:weight, got %r"
+                                 % part)
+            out[name] = float(w)
+    if not out or any(w <= 0.0 or w > 1.0 for w in out.values()):
+        raise ValueError("tier weights must be in (0, 1]: %r" % spec)
+    return out
+
+
+def tier_weight(weights, tier):
+    """(tier label, weight) for one request.  No tier = full budget
+    (pre-tier behavior); an unknown tier gets the lowest configured
+    weight rather than a free upgrade."""
+    if not tier:
+        return "default", 1.0
+    w = weights.get(tier)
+    return (tier, w) if w is not None else (tier, min(weights.values()))
+
+
+def _route_hash(req_id):
+    """Deterministic [0, 1) split point per request (canary routing) —
+    stable across replicas so a replayed request lands on the same
+    version wherever it fails over to."""
+    return (zlib.crc32(req_id.encode("utf-8")) & 0xFFFFFFFF) / 2.0 ** 32
 
 
 class InferReply:
@@ -100,12 +163,14 @@ class _Pending:
 
     __slots__ = ("model", "tenant", "feeds", "rows", "deadline",
                  "t_submit", "t_dispatch", "req_id", "callback", "_done",
-                 "reply", "traceparent", "span", "qspan")
+                 "reply", "traceparent", "span", "qspan", "tier", "weight")
 
     def __init__(self, model, tenant, feeds, rows, deadline_ms, req_id,
-                 callback, traceparent=None):
+                 callback, traceparent=None, tier="default", weight=1.0):
         self.model = model
         self.tenant = tenant
+        self.tier = tier
+        self.weight = float(weight)
         self.feeds = feeds
         self.rows = rows
         self.t_submit = time.perf_counter()
@@ -165,10 +230,14 @@ class ServingEngine:
         self.batch_window_ms = float(
             batch_window_ms if batch_window_ms is not None
             else _flag("serving_batch_window_ms"))
+        self.tier_weights = parse_tier_weights()
         self._models = {}
-        self._queue = []          # FIFO of _Pending
+        self._queue = []          # FIFO of _Pending (tiers reorder at
+        #                           collect time, not at admission)
+        self._routes = {}         # base name -> version route dict
         self._cond = threading.Condition()
         self._running = False
+        self._draining = False
         self._thread = None
         self.in_batch = False
         # fleet hook: called (outside the queue lock) after every
@@ -211,6 +280,65 @@ class ServingEngine:
             "outputs": e.predictor.get_output_names(),
         }
 
+    # -- versioned routing (rollout control plane) ---------------------------
+
+    def set_route(self, base, active=None, canary=None, fraction=0.0,
+                  state="stable"):
+        """Route requests addressed to `base`: `active` serves
+        (1 - fraction) of the traffic, `canary` the rest.  Requests
+        addressed to a registered version name directly always bypass
+        routing.  `state` is bookkeeping for the ``rollout_state`` gauge
+        (stable=0, canary=1, flipped=2, rolled_back=3)."""
+        active = active or base
+        if active not in self._models:
+            raise ValueError("unknown active version %r" % active)
+        if canary is not None and canary not in self._models:
+            raise ValueError("unknown canary version %r" % canary)
+        with self._cond:
+            self._routes[base] = {
+                "active": active,
+                "canary": canary,
+                "fraction": float(fraction) if canary is not None else 0.0,
+                "state": state,
+            }
+        _tm.set_gauge("rollout_state",
+                      {"stable": 0, "canary": 1, "flipped": 2,
+                       "rolled_back": 3}.get(state, 0), model=base)
+
+    def clear_route(self, base):
+        with self._cond:
+            self._routes.pop(base, None)
+
+    def routes(self):
+        """{base: route dict} snapshot (the __rollout__ payload)."""
+        with self._cond:
+            return {b: dict(r) for b, r in self._routes.items()}
+
+    def apply_routes(self, routes):
+        """Adopt a broadcast route table wholesale (idempotent; unknown
+        version names are skipped so a replica that lacks a model never
+        routes into a black hole)."""
+        for base, r in (routes or {}).items():
+            try:
+                self.set_route(base, active=r.get("active"),
+                               canary=r.get("canary"),
+                               fraction=r.get("fraction", 0.0),
+                               state=r.get("state", "stable"))
+            except ValueError:
+                pass
+
+    def resolve(self, model, req_id):
+        """Base name -> version name per the route table; a deterministic
+        per-request hash keeps the canary split consistent across
+        failover replays."""
+        r = self._routes.get(model)
+        if not r:
+            return model
+        if r["canary"] is not None and r["fraction"] > 0.0 \
+                and _route_hash(req_id) < r["fraction"]:
+            return r["canary"]
+        return r["active"]
+
     # -- AOT bucket prewarm --------------------------------------------------
 
     def prewarm(self):
@@ -247,14 +375,30 @@ class ServingEngine:
         batches_ahead = depth // max(self.buckets) + 1
         return batches_ahead * entry.svc_ms
 
+    def _shed(self, req, reason, error, retry_after_ms):
+        _tm.inc("serving_shed_total", reason=reason)
+        _tm.inc("serving_tier_shed_total", tier=req.tier)
+        req.complete(InferReply("shed", error=error,
+                                retry_after_ms=retry_after_ms,
+                                phases={"tier": req.tier,
+                                        "model": req.model}))
+        return req
+
     def submit(self, model, feeds, tenant="default", deadline_ms=None,
-               callback=None, req_id=None, traceparent=None):
+               callback=None, req_id=None, traceparent=None, tier=None):
         """Enqueue one request; returns a _Pending (wait() for the reply).
-        Shed/timeout/error requests complete immediately."""
+        Shed/timeout/error requests complete immediately.  `tier` scales
+        the deadline budget by its configured weight, so under pressure
+        low-weight tiers shed first (deadline-weighted admission)."""
         deadline_ms = float(deadline_ms or self.default_deadline_ms)
-        req = _Pending(model, tenant, feeds, 0, deadline_ms,
-                       req_id or uuid.uuid4().hex, callback,
-                       traceparent=traceparent)
+        req_id = req_id or uuid.uuid4().hex
+        tier, weight = tier_weight(self.tier_weights, tier)
+        # version routing happens at admission: the resolved name decides
+        # the model entry, the metrics labels, and the reply attribution
+        model = self.resolve(model, req_id)
+        req = _Pending(model, tenant, feeds, 0, deadline_ms, req_id,
+                       callback, traceparent=traceparent, tier=tier,
+                       weight=weight)
         entry = self._models.get(model)
         if entry is None or not self._running:
             req.complete(InferReply(
@@ -268,29 +412,47 @@ class ServingEngine:
             return req
         _tm.inc("serving_requests_total", model=model, tenant=tenant)
         with self._cond:
+            if self._draining:
+                # retiring replica: push traffic to the surviving fleet
+                return self._shed(req, "draining", "replica draining",
+                                  max(entry.svc_ms, 1.0))
             depth = len(self._queue)
             if depth >= self.max_queue:
                 wait_ms = self._projected_wait_ms(entry, depth)
-                _tm.inc("serving_shed_total", reason="queue_full")
-                req.complete(InferReply(
-                    "shed", error="queue full (%d)" % depth,
-                    retry_after_ms=max(wait_ms, entry.svc_ms, 1.0)))
-                return req
-            wait_ms = self._projected_wait_ms(entry, depth)
-            if wait_ms > deadline_ms:
-                _tm.inc("serving_shed_total", reason="deadline_budget")
-                req.complete(InferReply(
-                    "shed",
-                    error="projected wait %.0fms exceeds deadline %.0fms"
-                          % (wait_ms, deadline_ms),
-                    retry_after_ms=wait_ms - deadline_ms + entry.svc_ms))
-                return req
+                # tier eviction: a full queue sheds its lowest-weight
+                # member instead of the arrival when the arrival
+                # outranks it — paid traffic is never blocked behind
+                # queued free-tier work
+                victim = min(self._queue, key=lambda r: (r.weight,
+                                                         -r.t_submit)) \
+                    if self._queue else None
+                if victim is not None and victim.weight < req.weight:
+                    self._queue.remove(victim)
+                    if victim.qspan is not None:
+                        victim.qspan.annotate(evicted=True).end()
+                    if victim.span is not None:
+                        victim.span.annotate(status="shed").end()
+                    self._shed(victim, "tier_evicted",
+                               "evicted by %s-tier arrival" % req.tier,
+                               max(wait_ms, entry.svc_ms, 1.0))
+                else:
+                    return self._shed(
+                        req, "queue_full", "queue full (%d)" % depth,
+                        max(wait_ms, entry.svc_ms, 1.0))
+            wait_ms = self._projected_wait_ms(entry, len(self._queue))
+            budget_ms = deadline_ms * req.weight
+            if wait_ms > budget_ms:
+                return self._shed(
+                    req, "deadline_budget",
+                    "projected wait %.0fms exceeds %s-tier budget %.0fms"
+                    % (wait_ms, req.tier, budget_ms),
+                    wait_ms - budget_ms + entry.svc_ms)
             # admitted: open the request span (parents under the server's
             # admission span when submit runs inside it) and its
             # queue-wait child, ended at dispatch or deadline expiry
             req.span = _tr.start_span(
                 "serving.request", model=model, tenant=tenant,
-                rows=req.rows, req_id=req.req_id)
+                rows=req.rows, req_id=req.req_id, tier=tier)
             req.qspan = _tr.start_span("serving.queue_wait",
                                        parent=req.span, depth=depth)
             self._queue.append(req)
@@ -361,6 +523,28 @@ class ServingEngine:
                     req.span.annotate(status="error").end()
             self._queue.clear()
 
+    @property
+    def draining(self):
+        return self._draining
+
+    def drain(self, timeout_s=30.0):
+        """Graceful retirement: stop admitting (new submits shed with
+        reason="draining" so clients fail over), then wait until every
+        already-admitted request has dispatched and the in-flight batch
+        finished.  Returns True when the queue fully drained — the
+        autoscaler's scale-down exits the replica only after that, so a
+        retirement never drops an admitted request."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+        deadline = time.perf_counter() + timeout_s
+        while time.perf_counter() < deadline:
+            with self._cond:
+                if not self._queue and not self.in_batch:
+                    return True
+            time.sleep(0.01)
+        return False
+
     def _bucket_for(self, rows):
         for b in self.buckets:
             if rows <= b:
@@ -385,14 +569,20 @@ class ServingEngine:
             if left <= 0:
                 break
             self._cond.wait(min(left, 0.002))
-        batch, rest, rows = [], [], 0
-        for r in self._queue:
-            if r.model == model and rows + r.rows <= max_rows:
+        # tier-priority assembly: among this model's queued requests the
+        # highest-weight ones board the batch first (FIFO within a
+        # tier), so paid traffic overtakes queued free-tier work instead
+        # of waiting behind it
+        cands = sorted((r for r in self._queue if r.model == model),
+                       key=lambda r: (-r.weight, r.t_submit))
+        batch, rows = [], 0
+        taken = set()
+        for r in cands:
+            if rows + r.rows <= max_rows:
                 batch.append(r)
+                taken.add(id(r))
                 rows += r.rows
-            else:
-                rest.append(r)
-        self._queue[:] = rest
+        self._queue[:] = [r for r in self._queue if id(r) not in taken]
         _tm.set_gauge("serving_queue_depth", len(self._queue))
         return model, batch
 
@@ -438,11 +628,14 @@ class ServingEngine:
     @staticmethod
     def _phases(r, execute_ms, bucket):
         """Per-request SLO phase attribution for the reply meta (always
-        on — the client derives wire_ms as e2e minus server latency)."""
+        on — the client derives wire_ms as e2e minus server latency).
+        Carries the tier and the RESOLVED model version so per-tier and
+        per-version p99s fall out of the same reply stream."""
         t_d = r.t_dispatch if r.t_dispatch is not None else r.t_submit
         return {"queue_wait_ms": round((t_d - r.t_submit) * 1e3, 3),
                 "execute_ms": round(execute_ms, 3),
-                "bucket": bucket, "rows": r.rows}
+                "bucket": bucket, "rows": r.rows,
+                "tier": r.tier, "model": r.model}
 
     def _run_batch(self, entry, batch):
         rows = sum(r.rows for r in batch)
@@ -475,6 +668,13 @@ class ServingEngine:
                      req_ids=[r.req_id for r in batch])
             t0 = time.perf_counter()
             try:
+                # named fault point per model VERSION — a chaos/rollback
+                # leg arms e.g. "serving.execute.fc@v2:error:1.0" to
+                # seed a bad canary without a genuinely broken model
+                from ..utils.fault_injection import maybe_fail
+                if maybe_fail("serving.execute." + entry.name) == "error":
+                    raise RuntimeError("injected execute fault (%s)"
+                                       % entry.name)
                 with _tr.span("serving.execute", bucket=bucket):
                     with scope_guard(pred._scope):
                         vals = pred._exe.run(pred.program(), feed=feed,
@@ -488,6 +688,8 @@ class ServingEngine:
                     if r.span is not None:
                         r.span.annotate(status="error").end()
                 _tm.inc("serving_batch_errors_total", model=entry.name)
+                _tm.inc("serving_request_errors_total", len(batch),
+                        model=entry.name)
                 bspan.annotate(error=str(e)[:200]).end()
                 return
         ms = (time.perf_counter() - t0) * 1e3
@@ -510,6 +712,9 @@ class ServingEngine:
                 r.span.annotate(status="ok", bucket=bucket).end()
             _tm.observe("serving_latency_ms", r.reply.latency_ms,
                         model=entry.name)
+            # per-version execute p99: the rollout gate's scrape-side
+            # signal (phase attribution, not end-to-end latency)
+            _tm.observe("serving_execute_ms", ms, model=entry.name)
         _tm.inc("serving_batches_total", model=entry.name,
                 bucket=str(bucket))
         _tm.observe("serving_batch_fill", rows / float(bucket),
@@ -680,6 +885,8 @@ class DecodeEngine:
             raise ValueError("serving_decode_mode must be token|request, "
                              "got %r" % (mode,))
         self.mode = mode
+        self.tier_weights = parse_tier_weights()
+        self._draining = False
         self._models = {}
         self._waiting = []          # FIFO of _DecodeSeq
         self._active = []
@@ -907,7 +1114,7 @@ class DecodeEngine:
 
     def submit(self, model, prompt_ids, max_new_tokens=16, tenant="default",
                deadline_ms=None, eos_id=-1, callback=None, on_token=None,
-               req_id=None, traceparent=None):
+               req_id=None, traceparent=None, tier=None):
         """Enqueue one autoregressive request; returns a _Pending whose
         reply carries outputs={"tokens"} plus TTFT/ITL phases.
         ``on_token(req_id, index, token, done, status)`` fires per
@@ -915,9 +1122,10 @@ class DecodeEngine:
         the terminal call carries token=None on non-ok completion."""
         deadline_ms = float(deadline_ms or self.default_deadline_ms)
         prompt_ids = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
+        tier, weight = tier_weight(self.tier_weights, tier)
         req = _Pending(model, tenant, None, len(prompt_ids), deadline_ms,
                        req_id or uuid.uuid4().hex, callback,
-                       traceparent=traceparent)
+                       traceparent=traceparent, tier=tier, weight=weight)
 
         def _early(reply):
             """Terminal before admission: also emit the done stream chunk
@@ -962,11 +1170,36 @@ class DecodeEngine:
         seq = _DecodeSeq(req, prompt_ids, max_new_tokens, eos_id, on_token,
                          m.maxb)
         with self._cond:
-            if len(self._waiting) >= self.max_queue:
-                _tm.inc("serving_shed_total", reason="queue_full")
+            if self._draining:
+                _tm.inc("serving_shed_total", reason="draining")
+                _tm.inc("serving_tier_shed_total", tier=tier)
                 return _early(InferReply(
-                    "shed", error="queue full (%d)" % len(self._waiting),
+                    "shed", error="replica draining",
                     retry_after_ms=self._retry_after_ms(m)))
+            if len(self._waiting) >= self.max_queue:
+                # tier eviction mirrors ServingEngine: a full waiting
+                # queue sheds its lowest-weight member when the arrival
+                # outranks it
+                victim = min(self._waiting,
+                             key=lambda s: (s.pending.weight,
+                                            -s.pending.t_submit)) \
+                    if self._waiting else None
+                if victim is not None and victim.pending.weight < weight:
+                    self._waiting.remove(victim)
+                    _tm.inc("serving_shed_total", reason="tier_evicted")
+                    _tm.inc("serving_tier_shed_total",
+                            tier=victim.pending.tier)
+                    self._finish(victim, InferReply(
+                        "shed",
+                        error="evicted by %s-tier arrival" % tier,
+                        retry_after_ms=self._retry_after_ms(m)))
+                else:
+                    _tm.inc("serving_shed_total", reason="queue_full")
+                    _tm.inc("serving_tier_shed_total", tier=tier)
+                    return _early(InferReply(
+                        "shed",
+                        error="queue full (%d)" % len(self._waiting),
+                        retry_after_ms=self._retry_after_ms(m)))
             # admission-time KV pressure: blocks already promised to the
             # queue ahead plus this prompt must fit the RECLAIMABLE pool
             # (free list + zero-ref evictable cached blocks — a warm
@@ -988,6 +1221,7 @@ class DecodeEngine:
                                m.draft_cache.allocator.reclaimable)
             if need_now > free_now:
                 _tm.inc("serving_shed_total", reason="kv_oom")
+                _tm.inc("serving_tier_shed_total", tier=tier)
                 return _early(InferReply(
                     "shed",
                     error="KV pool exhausted (%d reclaimable blocks)"
@@ -1062,6 +1296,24 @@ class DecodeEngine:
             self._free_blocks(s)
             self._finish(s, InferReply("error", error="engine stopped"))
 
+    @property
+    def draining(self):
+        return self._draining
+
+    def drain(self, timeout_s=30.0):
+        """Graceful retirement (ServingEngine.drain contract): shed new
+        arrivals, wait for every waiting AND active sequence to finish."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+        deadline = time.perf_counter() + timeout_s
+        while time.perf_counter() < deadline:
+            with self._cond:
+                if not self._waiting and not self._active:
+                    return True
+            time.sleep(0.01)
+        return False
+
     def _model_of(self, seq):
         return self._models[seq.pending.model]
 
@@ -1084,7 +1336,8 @@ class DecodeEngine:
                 ((seq.t_admit or now) - r.t_submit) * 1e3, 3),
                 "tokens": len(seq.out),
                 "prompt_tokens": len(seq.prompt),
-                "cached_tokens": seq.cached_tokens}
+                "cached_tokens": seq.cached_tokens,
+                "tier": r.tier, "model": r.model}
             if seq.t_first is not None:
                 phases["ttft_ms"] = round(
                     (seq.t_first - r.t_submit) * 1e3, 3)

@@ -39,7 +39,7 @@ from ..distributed.ps import HeartBeatMonitor
 from ..native import rpc as _rpc
 from . import codec
 
-__all__ = ["ServingFleet", "FLEET_HB", "FLEET_VIEW"]
+__all__ = ["ServingFleet", "AutoScaler", "FLEET_HB", "FLEET_VIEW"]
 
 FLEET_HB = "__fhb__"
 FLEET_VIEW = "__fview__"
@@ -52,9 +52,13 @@ def _flag(name):
     return flags.flag(name)
 
 
-def write_endpoints_file(path, epoch, endpoints):
-    """Atomic (tmp + rename) so client reads never see a torn view."""
+def write_endpoints_file(path, epoch, endpoints, rollout=None):
+    """Atomic (tmp + rename) so client reads never see a torn view.  The
+    optional rollout doc rides along so a version flip is published in
+    the SAME epoch bump as any membership change."""
     doc = {"epoch": int(epoch), "endpoints": list(endpoints)}
+    if rollout:
+        doc["rollout"] = rollout
     tmp = "%s.tmp.%d" % (path, os.getpid())
     with open(tmp, "w") as f:
         json.dump(doc, f)
@@ -78,6 +82,8 @@ class ServingFleet:
         self._hb_failures = 0
         self._lock = threading.Lock()
         self._pending_view = False
+        self.rollout_doc = None         # published beside the endpoints
+        self._retiring = set()          # ranks draining out (autoscaler)
 
     def is_coordinator(self):
         return self._coord_rank == self.rank
@@ -185,8 +191,10 @@ class ServingFleet:
             r = int(arr[0])
             if r in self.live:
                 self.mon.update(r)
-            elif r != self.rank:
-                # a relaunched/late replica re-announces itself
+            elif r != self.rank and r not in self._retiring:
+                # a relaunched/late replica re-announces itself (a
+                # RETIRING rank's last heartbeats must NOT re-add it —
+                # the set clears when the autoscaler reuses the slot)
                 self.live.add(r)
                 self.mon.update(r)
                 with self._lock:
@@ -227,16 +235,184 @@ class ServingFleet:
         if self.endpoints_file:
             try:
                 write_endpoints_file(self.endpoints_file, self.epoch,
-                                     live_eps)
+                                     live_eps, rollout=self.rollout_doc)
             except OSError as e:
                 logging.warning("[serving-fleet] endpoints file write "
                                 "failed: %s", e)
         _tm.set_gauge("serving_fleet_size", len(self.live))
         _tm.set_gauge("serving_fleet_epoch", self.epoch)
 
+    # -- control plane (autoscaler / rollout) --------------------------------
+
+    def publish_rollout(self, doc):
+        """Version-routing change: ride the next epoch bump so every
+        client re-reading the endpoints file sees it atomically with the
+        membership view."""
+        self.rollout_doc = doc
+        self.epoch += 1
+        with self._lock:
+            self._pending_view = True
+        self.tick()
+
+    def retire(self, rank):
+        """Graceful scale-down of one replica: drop it from the view
+        FIRST (clients stop routing to it), then order it to drain and
+        exit via ``__retire__``.  Its last heartbeats are ignored via
+        the retiring set so it can't flap back in."""
+        if rank == self.rank or rank not in self.live:
+            return False
+        self.live.discard(rank)
+        self._retiring.add(rank)
+        if self.mon is not None:
+            self.mon.remove(rank)
+        self.epoch += 1
+        _tm.event("serving_fleet_retire", rank=rank, epoch=self.epoch)
+        logging.warning("[serving-fleet] epoch %d: retiring rank %d",
+                        self.epoch, rank)
+        with self._lock:
+            self._pending_view = True
+        self.tick()
+        try:
+            c = _rpc.RpcClient(self.endpoints[rank], connect_timeout=1.0,
+                               rpc_deadline=3.0, retry_times=0)
+            try:
+                c.send_var(codec.RETIRE_KEY,
+                           np.asarray([self.rank], np.int64))
+            finally:
+                c.close()
+        except Exception:
+            pass  # already dead: eviction bookkeeping is done anyway
+        return True
+
+    def notice_relaunch(self, rank):
+        """The autoscaler reused a retired slot: accept its heartbeats
+        again."""
+        self._retiring.discard(rank)
+
     def view(self):
         return {"epoch": self.epoch, "live": sorted(self.live),
-                "coordinator": self._coord_rank}
+                "coordinator": self._coord_rank,
+                "retiring": sorted(self._retiring)}
 
     def stop(self):
         self._stop.set()
+
+
+class AutoScaler:
+    """Replica-count controller (coordinator-side).
+
+    Watches queue depth and shed rate (``metrics_fn`` — in production a
+    closure over the engine gauges + scraped peers, in tests any stub)
+    and drives ``scale_up_fn`` / ``scale_down_fn`` (tools/serve.py wires
+    these to "fork a prewarmed standby into the lowest dead rank slot"
+    and "fleet.retire(highest non-coordinator live rank)").
+
+    Flap protection is layered: PRESSURE must persist for
+    ``FLAGS_serving_scale_up_ticks`` consecutive observations (and idle
+    for ``FLAGS_serving_scale_down_ticks``) before acting, any event
+    starts a ``FLAGS_serving_autoscale_cooldown``-tick refractory
+    window, and the replica count is clamped to
+    [FLAGS_serving_min_replicas, FLAGS_serving_max_replicas].  A
+    one-tick metrics blip therefore never moves the fleet — the unit
+    tests assert exactly that."""
+
+    def __init__(self, metrics_fn, scale_up_fn, scale_down_fn,
+                 replicas_fn, min_replicas=None, max_replicas=None,
+                 up_ticks=None, down_ticks=None, cooldown=None,
+                 up_depth=None, interval_s=None):
+        self.metrics_fn = metrics_fn
+        self.scale_up_fn = scale_up_fn
+        self.scale_down_fn = scale_down_fn
+        self.replicas_fn = replicas_fn
+
+        def _default(v, flag, cast):
+            return cast(v if v is not None else _flag(flag))
+
+        self.min_replicas = _default(min_replicas,
+                                     "serving_min_replicas", int)
+        self.max_replicas = _default(max_replicas,
+                                     "serving_max_replicas", int)
+        self.up_ticks = _default(up_ticks, "serving_scale_up_ticks", int)
+        self.down_ticks = _default(down_ticks,
+                                   "serving_scale_down_ticks", int)
+        self.cooldown_ticks = _default(cooldown,
+                                       "serving_autoscale_cooldown", int)
+        self.up_depth = _default(up_depth, "serving_scale_up_depth", float)
+        self.interval_s = _default(interval_s,
+                                   "serving_autoscale_interval", float)
+        self._up_streak = 0
+        self._down_streak = 0
+        self._cooldown = 0
+        self._last_shed = None
+        self.events = []                # ("up"|"down", tick_no) history
+        self._ticks = 0
+        self._stop = threading.Event()
+        self._thread = None
+
+    def tick(self):
+        """One observation -> maybe one scaling event.  Returns
+        "up" | "down" | None (tests drive this directly)."""
+        self._ticks += 1
+        try:
+            m = self.metrics_fn() or {}
+        except Exception:
+            return None                 # scrape raced a membership change
+        depth = float(m.get("queue_depth", 0.0))
+        shed = float(m.get("shed_total", 0.0))
+        shed_delta = 0.0 if self._last_shed is None \
+            else max(shed - self._last_shed, 0.0)
+        self._last_shed = shed
+        if self._cooldown > 0:
+            # refractory window after an event: observe (the shed
+            # baseline above keeps advancing) but never act or build
+            # streaks, so one overload burst maps to ONE scale-up
+            self._cooldown -= 1
+            self._up_streak = self._down_streak = 0
+            return None
+        pressure = depth >= self.up_depth or shed_delta > 0.0
+        idle = depth <= 0.0 and shed_delta <= 0.0
+        if pressure:
+            self._up_streak += 1
+            self._down_streak = 0
+        elif idle:
+            self._down_streak += 1
+            self._up_streak = 0
+        else:
+            self._up_streak = self._down_streak = 0
+        n = int(self.replicas_fn())
+        if self._up_streak >= self.up_ticks and n < self.max_replicas:
+            self._fire("up", self.scale_up_fn)
+            return "up"
+        if self._down_streak >= self.down_ticks and n > self.min_replicas:
+            self._fire("down", self.scale_down_fn)
+            return "down"
+        return None
+
+    def _fire(self, direction, fn):
+        self._up_streak = self._down_streak = 0
+        self._cooldown = self.cooldown_ticks
+        self.events.append((direction, self._ticks))
+        _tm.inc("autoscale_events_total", dir=direction)
+        _tm.event("autoscale", dir=direction, tick=self._ticks)
+        logging.warning("[autoscale] scale %s at tick %d", direction,
+                        self._ticks)
+        try:
+            fn()
+        except Exception:
+            logging.exception("[autoscale] scale_%s failed", direction)
+
+    def start(self):
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                self.tick()
+
+        self._thread = threading.Thread(target=loop, name="autoscaler",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(5.0)
+            self._thread = None

@@ -20,9 +20,24 @@ One ``RpcServer`` per replica carries the whole protocol:
                           reply still lands on ``__reply__:<id>``
   ``__abort__:<id>``      inbound SEND: drop the sequence, free its KV
                           blocks (client timeout-replay abandonment)
+  ``__rollout__``         this replica's applied version-routing state
+                          (always published, empty when no rollout —
+                          chaos tests GET it from every survivor)
+  ``__rollout_set__``     coordinator broadcast: adopt a routing state
+  ``__rollout_ctl__:<id>`` admin command for the RolloutController; the
+                          reply lands on ``__reply__:<id>``
+  ``__retire__``          coordinator order: drain both engines at a
+                          batch boundary, then hand off to ``on_retire``
+                          (tools/serve.py exits the process)
 
 Replies are garbage-collected FIFO beyond a bounded ring — a crashed
 client can never grow the server's var store unboundedly.
+
+Chaos hooks: the named fault points ``serving.infer`` /
+``serving.generate`` / ``serving.reply`` (utils/fault_injection.py,
+armed by FLAGS_fault_spec) sit on the wire path — ``drop`` loses the
+frame, ``error`` substitutes an error reply — so serving tests inject
+faults without SIGKILLing processes.
 """
 
 import threading
@@ -32,6 +47,7 @@ import numpy as np
 from ..core import telemetry as _tm
 from ..core import tracing as _tr
 from ..native.rpc import EV_SEND, RpcServer
+from ..utils.fault_injection import maybe_fail
 from . import codec
 
 __all__ = ["ServingServer"]
@@ -47,6 +63,9 @@ class ServingServer:
         self.rpc = RpcServer(port=port)
         self.port = self.rpc.port
         self.fleet = None
+        self.rollout = None            # RolloutController (coordinator)
+        self.on_retire = None          # callback after a __retire__ drain
+        self._retire_thread = None
         self._reply_keys = []
         self._reply_lock = threading.Lock()
         self._thread = None
@@ -59,6 +78,9 @@ class ServingServer:
         self.engine.start()
         self.rpc.set_var(codec.ALIVE_KEY,
                          np.asarray([self.rank, 0, 0], np.int64))
+        # always published (empty before any rollout) so a chaos test's
+        # GET never parks forever on a replica that missed every flip
+        self.rpc.set_var(codec.ROLLOUT_KEY, codec.pack({"models": {}}))
         for name in self.engine.models():
             self.rpc.set_var(codec.SPEC_KEY + name,
                              codec.pack(self.engine.spec(name)))
@@ -98,12 +120,28 @@ class ServingServer:
             elif name.startswith(codec.ABORT_KEY):
                 if self.decode_engine is not None:
                     self.decode_engine.abort(name[len(codec.ABORT_KEY):])
+            elif name == codec.ROLLOUT_SET_KEY:
+                self._on_rollout_set(arr)
+            elif name.startswith(codec.ROLLOUT_CTL_KEY):
+                self._on_rollout_ctl(
+                    name[len(codec.ROLLOUT_CTL_KEY):], arr)
+            elif name == codec.RETIRE_KEY:
+                self._on_retire()
             elif self.fleet is not None:
                 self.fleet.on_event(name, arr)
             if self.fleet is not None:
                 self.fleet.tick()
 
     def _on_infer(self, req_id, arr):
+        from .engine import InferReply
+
+        fault = maybe_fail("serving.infer")
+        if fault == "drop":
+            return                     # frame lost: client replays
+        if fault == "error":
+            self._publish(req_id, InferReply(
+                "error", error="injected fault: serving.infer"))
+            return
         try:
             meta, arrays = codec.unpack(arr)
             feeds = dict(zip(meta["feeds"], arrays))
@@ -124,12 +162,20 @@ class ServingServer:
                     deadline_ms=meta.get("deadline_ms"),
                     req_id=req_id,
                     traceparent=tp,
+                    tier=meta.get(codec.TIER),
                     callback=lambda pending: self._publish(
                         pending.req_id, pending.reply, pending))
 
     def _on_generate(self, req_id, arr):
         from .engine import InferReply
 
+        fault = maybe_fail("serving.generate")
+        if fault == "drop":
+            return
+        if fault == "error":
+            self._publish(req_id, InferReply(
+                "error", error="injected fault: serving.generate"))
+            return
         try:
             meta, arrays = codec.unpack(arr)
             prompt = arrays[0]
@@ -155,6 +201,7 @@ class ServingServer:
                     eos_id=int(meta.get("eos_id", -1)),
                     req_id=req_id,
                     traceparent=tp,
+                    tier=meta.get(codec.TIER),
                     on_token=on_token,
                     callback=lambda pending: self._publish(
                         pending.req_id, pending.reply, pending))
@@ -178,8 +225,14 @@ class ServingServer:
     def _publish(self, req_id, reply, pending=None):
         from .engine import InferReply
 
+        fault = maybe_fail("serving.reply")
+        if fault == "drop":
+            return                     # reply lost: client GET times out
         if reply is None:
             reply = InferReply("error", error="malformed request")
+        if fault == "error":
+            reply = InferReply("error",
+                               error="injected fault: serving.reply")
         # runs inside _Pending.complete(), so parent explicitly under the
         # request span rather than whatever is on the completing thread
         with _tr.span("serving.reply_publish",
@@ -198,6 +251,63 @@ class ServingServer:
             while len(self._reply_keys) > _REPLY_RING:
                 self.rpc.del_var(self._reply_keys.pop(0))
 
+    # -- control plane -------------------------------------------------------
+
+    def apply_rollout(self, doc):
+        """Adopt a version-routing state (local command or coordinator
+        ``__rollout_set__`` broadcast) and republish this replica's view
+        under ``__rollout__`` — the chaos leg asserts every survivor
+        converges to the same doc."""
+        self.engine.apply_routes(doc.get("models") or {})
+        self.rpc.set_var(codec.ROLLOUT_KEY,
+                         codec.pack({"models": self.engine.routes()}))
+
+    def _on_rollout_set(self, arr):
+        try:
+            doc, _ = codec.unpack(arr)
+        except Exception:
+            _tm.inc("serving_bad_request_total")
+            return
+        self.apply_rollout(doc)
+
+    def _on_rollout_ctl(self, req_id, arr):
+        from .engine import InferReply
+
+        try:
+            cmd, _ = codec.unpack(arr)
+        except Exception:
+            self._publish(req_id, None)
+            _tm.inc("serving_bad_request_total")
+            return
+        if self.rollout is None:
+            reply = InferReply("error",
+                               error="replica has no rollout controller")
+        else:
+            meta = self.rollout.handle(cmd)
+            reply = InferReply(meta.get("status", "error"),
+                               error=meta.get("error"))
+            reply.phases = {k: v for k, v in meta.items()
+                            if k not in ("status", "error")}
+        self._publish(req_id, reply)
+
+    def _on_retire(self):
+        """Drain both engines at a batch boundary on a side thread (the
+        poll loop must keep serving queued work), then fire on_retire."""
+        if self._retire_thread is not None:
+            return
+
+        def drain():
+            self.engine.drain()
+            if self.decode_engine is not None:
+                self.decode_engine.drain()
+            _tm.event("serving_retired", rank=self.rank)
+            if self.on_retire is not None:
+                self.on_retire()
+
+        self._retire_thread = threading.Thread(
+            target=drain, name="serving-retire", daemon=True)
+        self._retire_thread.start()
+
     def set_alive(self, epoch, is_coordinator):
         self.rpc.set_var(codec.ALIVE_KEY, np.asarray(
             [self.rank, int(epoch), 1 if is_coordinator else 0], np.int64))
@@ -210,6 +320,8 @@ class ServingServer:
             # stop AND join (idempotent) — a leaked publisher thread
             # would republish __metrics__ into the next test's server
             self._pub_stop.stop()
+        if self.rollout is not None:
+            self.rollout.stop()
         if self.fleet is not None:
             self.fleet.stop()
         self.engine.stop()

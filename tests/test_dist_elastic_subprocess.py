@@ -30,6 +30,10 @@ import pytest
 
 from dist_utils import free_ports, kill_proc_tree
 
+# multi-minute subprocess scenario: excluded from the tier-1 wall
+# (-m 'not slow') but still run by tools/run_ci.sh --elastic-smoke
+pytestmark = pytest.mark.slow
+
 _PAYLOAD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "dist_elastic_payload.py")
 

@@ -1,0 +1,406 @@
+"""Serving control plane (PR 16): SLO-tiered admission, versioned
+rollout with a metrics gate, replica autoscaling, and the client/server
+resilience hooks that ride along.
+
+Pure-logic pieces (tier weights, the admission shed order, queue-full
+eviction, canary routing, the rollout gate, autoscaler hysteresis) are
+tested in-process with no dispatcher thread or wire; the client
+shed-retry and fault-injection paths go over a real loopback
+ServingServer.  The chaos/overload *system* behavior lives in
+tools/run_ci.sh --serve-smoke.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core import telemetry as _tm
+from paddle_tpu.serving import (RolloutController, ServingClient,
+                                ServingEngine, ServingServer, evaluate_gate,
+                                parse_tier_weights, tier_weight)
+from paddle_tpu.serving.fleet import AutoScaler
+from paddle_tpu.serving.rollout import merge_stats, stats_from_snapshot
+from paddle_tpu.utils import fault_injection
+
+
+@pytest.fixture()
+def telemetry_on():
+    fluid.set_flags({"FLAGS_telemetry": True})
+    _tm.reset()
+    yield
+    _tm.reset()
+    fluid.set_flags({"FLAGS_telemetry": False})
+
+
+@pytest.fixture()
+def saved_model(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8])
+        h = fluid.layers.fc(x, 16, act="relu")
+        out = fluid.layers.fc(h, 4, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.save_inference_model(str(tmp_path / "model"), ["x"], [out],
+                                   exe, main_program=main)
+    return str(tmp_path / "model")
+
+
+def _engine(saved_model, **kw):
+    kw.setdefault("buckets", (1, 4))
+    eng = ServingEngine(**kw)
+    eng.add_model("fc", saved_model)
+    return eng
+
+
+X1 = np.ones((1, 8), np.float32)
+
+
+# -- tier weights ------------------------------------------------------------
+
+def test_parse_tier_weights():
+    w = parse_tier_weights("paid:1.0,free:0.45, batch:0.15")
+    assert w == {"paid": 1.0, "free": 0.45, "batch": 0.15}
+    with pytest.raises(ValueError):
+        parse_tier_weights("paid:2.0")        # weight outside (0, 1]
+    with pytest.raises(ValueError):
+        parse_tier_weights("paid:nope")
+
+
+def test_tier_weight_lookup():
+    w = {"paid": 1.0, "free": 0.45}
+    assert tier_weight(w, "paid") == ("paid", 1.0)
+    # no tier = full budget; an UNKNOWN label gets the floor weight, so
+    # mislabeling is never a free upgrade
+    assert tier_weight(w, None) == ("default", 1.0)
+    assert tier_weight(w, "mystery") == ("mystery", 0.45)
+
+
+# -- deadline-weighted admission ---------------------------------------------
+
+def test_deadline_weighted_shed_order(saved_model, telemetry_on):
+    """Same queue state, same deadline: the full-weight tier is admitted
+    while the low-weight tier sheds — free sheds FIRST."""
+    eng = _engine(saved_model, max_queue=64)
+    eng.prewarm()
+    eng._running = True             # admission only, no dispatcher
+    eng._models["fc"].svc_ms = 500.0    # projected wait = 500 ms
+    paid = eng.submit("fc", {"x": X1}, deadline_ms=600.0, tier="paid")
+    free = eng.submit("fc", {"x": X1}, deadline_ms=600.0, tier="free")
+    assert paid.reply is None                     # queued (600 >= 500)
+    r = free.wait(1.0)
+    assert r.status == "shed"                     # 600 * 0.45 < 500
+    assert "free-tier budget" in r.error and r.retry_after_ms > 0
+    assert _tm.counter_total("serving_tier_shed_total") == 1
+    snap = _tm.snapshot()["counters"]
+    assert snap.get("serving_tier_shed_total{tier=free}") == 1
+
+
+def test_queue_full_tier_eviction(saved_model, telemetry_on):
+    """A full queue sheds its lowest-weight member for a higher-weight
+    arrival; an arrival that does not outrank anyone sheds itself."""
+    eng = _engine(saved_model, max_queue=1)
+    eng.prewarm()
+    eng._running = True
+    queued_free = eng.submit("fc", {"x": X1}, tier="free")
+    assert queued_free.reply is None
+    paid = eng.submit("fc", {"x": X1}, tier="paid")   # evicts the free
+    assert paid.reply is None
+    r = queued_free.wait(1.0)
+    assert r.status == "shed" and "evicted by paid" in r.error
+    # second free arrival: the queued paid outranks it -> arrival sheds
+    free2 = eng.submit("fc", {"x": X1}, tier="free")
+    assert free2.wait(1.0).status == "shed"
+    assert paid.reply is None                         # paid never shed
+    counters = _tm.snapshot()["counters"]
+    assert counters.get("serving_shed_total{reason=tier_evicted}") == 1
+    assert counters.get("serving_shed_total{reason=queue_full}") == 1
+
+
+def test_drain_sheds_new_admits(saved_model, telemetry_on):
+    eng = _engine(saved_model)
+    eng.prewarm()
+    eng.start()
+    try:
+        assert eng.infer("fc", {"x": X1}).ok
+        assert eng.drain(timeout_s=10.0) is True
+        assert eng.draining
+        r = eng.submit("fc", {"x": X1}).wait(1.0)
+        assert r.status == "shed" and "draining" in r.error
+    finally:
+        eng.stop()
+
+
+# -- version routing ---------------------------------------------------------
+
+def test_canary_routing_deterministic_split(saved_model, telemetry_on):
+    eng = _engine(saved_model)
+    eng.add_model("fc@v2", saved_model)
+    eng.set_route("fc", active="fc", canary="fc@v2", fraction=0.5,
+                  state="canary")
+    ids = ["req-%04d" % i for i in range(400)]
+    resolved = [eng.resolve("fc", rid) for rid in ids]
+    canary_share = resolved.count("fc@v2") / len(resolved)
+    assert 0.3 < canary_share < 0.7           # hash split near fraction
+    # deterministic: a failover REPLAY of the same req_id lands on the
+    # same version
+    assert resolved == [eng.resolve("fc", rid) for rid in ids]
+    # direct version addressing always bypasses the route
+    assert eng.resolve("fc@v2", "anything") == "fc@v2"
+    # flip: 100% canary
+    eng.set_route("fc", active="fc@v2", canary=None, fraction=0.0,
+                  state="flipped")
+    assert all(eng.resolve("fc", rid) == "fc@v2" for rid in ids)
+    assert _tm.snapshot()["gauges"].get("rollout_state{model=fc}") == 2
+
+
+def test_apply_routes_skips_unknown_versions(saved_model):
+    eng = _engine(saved_model)
+    eng.apply_routes({"fc": {"active": "fc", "canary": "fc@v9",
+                             "fraction": 0.5, "state": "canary"},
+                      "ghost": {"active": "ghost@v1", "state": "stable"}})
+    # neither route was adopted: a replica lacking the version must not
+    # route traffic into a black hole
+    assert eng.routes() == {}
+
+
+# -- rollout gate ------------------------------------------------------------
+
+def test_evaluate_gate_verdicts():
+    ok = {"count": 100, "requests": 100, "errors": 1, "p99_ms": 10.0}
+    base = {"count": 100, "requests": 100, "errors": 0, "p99_ms": 9.0}
+    assert evaluate_gate(ok, base, p99_ratio=2.0, error_rate=0.05,
+                         min_samples=20)["verdict"] == "pass"
+    bad_err = dict(ok, errors=50)
+    assert evaluate_gate(bad_err, base, p99_ratio=2.0, error_rate=0.05,
+                         min_samples=20)["verdict"] == "trip"
+    slow = dict(ok, p99_ms=30.0)
+    assert evaluate_gate(slow, base, p99_ratio=2.0, error_rate=0.05,
+                         min_samples=20)["verdict"] == "trip"
+    # a two-request blip must NOT roll back a fleet
+    blip = {"count": 2, "requests": 2, "errors": 2, "p99_ms": 99.0}
+    assert evaluate_gate(blip, base, p99_ratio=2.0, error_rate=0.05,
+                         min_samples=20)["verdict"] == "insufficient"
+
+
+def test_stats_from_snapshot_and_merge():
+    snap = {"histograms": {"serving_execute_ms{model=fc@v2}":
+                           {"count": 30, "p99": 12.5}},
+            "counters": {"serving_requests_total{model=fc@v2,tenant=t}": 40,
+                         "serving_request_errors_total{model=fc@v2}": 10,
+                         "serving_requests_total{model=fc,tenant=t}": 7}}
+    s = stats_from_snapshot(snap, "fc@v2")
+    assert s == {"count": 40.0, "requests": 40.0, "errors": 10.0,
+                 "p99_ms": 12.5}
+    # per-replica fold: counts sum, p99 takes the worst replica
+    m = merge_stats([s, {"count": 5, "requests": 5, "errors": 0,
+                         "p99_ms": 50.0}])
+    assert m["count"] == 45.0 and m["p99_ms"] == 50.0
+
+
+class _FakeServer:
+    """Just enough ServingServer surface for RolloutController."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.applied = []
+
+    def apply_rollout(self, doc):
+        self.applied.append(doc)
+
+
+def test_rollout_controller_auto_rollback(saved_model, telemetry_on):
+    """A seeded all-errors canary trips the gate on one monitor pass and
+    the controller rolls the route back on its own."""
+    eng = _engine(saved_model)
+    eng.add_model("fc@v2", saved_model)
+    bad_snap = {
+        "histograms": {"serving_execute_ms{model=fc}":
+                       {"count": 100, "p99": 5.0}},
+        "counters": {"serving_requests_total{model=fc,tenant=t}": 100,
+                     "serving_requests_total{model=fc@v2,tenant=t}": 30,
+                     "serving_request_errors_total{model=fc@v2}": 30},
+    }
+    srv = _FakeServer(eng)
+    ctl = RolloutController(srv, fleet=None,
+                            snapshot_fn=lambda: bad_snap)
+    got = ctl.handle({"op": "start", "model": "fc", "active": "fc",
+                      "canary": "fc@v2", "fraction": 0.5})
+    assert got["status"] == "ok"
+    assert eng.routes()["fc"]["state"] == "canary"
+
+    fluid.set_flags({"FLAGS_rollout_gate_min_samples": 10})
+    try:
+        verdicts = ctl.check_gates()
+    finally:
+        fluid.set_flags({"FLAGS_rollout_gate_min_samples": 20})
+    assert verdicts["fc"]["verdict"] == "trip"
+    route = eng.routes()["fc"]
+    assert route["state"] == "rolled_back"
+    assert route["active"] == "fc" and route["canary"] is None
+    assert _tm.counter_total("rollout_rollbacks_total") == 1
+    # every mutation (start + rollback) re-applied/broadcast locally
+    assert len(srv.applied) >= 2
+
+
+def test_rollout_controller_flip_and_bad_ops(saved_model):
+    eng = _engine(saved_model)
+    eng.add_model("fc@v2", saved_model)
+    ctl = RolloutController(_FakeServer(eng), fleet=None)
+    assert ctl.handle({"op": "flip", "model": "fc"})["status"] == "error"
+    ctl.handle({"op": "start", "model": "fc", "active": "fc",
+                "canary": "fc@v2", "fraction": 0.25})
+    assert ctl.handle({"op": "flip", "model": "fc"})["status"] == "ok"
+    r = eng.routes()["fc"]
+    assert r == {"active": "fc@v2", "canary": None, "fraction": 0.0,
+                 "state": "flipped"}
+    st = ctl.handle({"op": "status"})
+    assert st["status"] == "ok" and "fc" in st["routes"]
+    assert ctl.handle({"op": "nope"})["status"] == "error"
+
+
+# -- autoscaler hysteresis ---------------------------------------------------
+
+class _Metrics:
+    def __init__(self):
+        self.depth = 0.0
+        self.shed = 0.0
+
+    def __call__(self):
+        return {"queue_depth": self.depth, "shed_total": self.shed}
+
+
+def _scaler(m, replicas, **kw):
+    events = []
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 3)
+    kw.setdefault("up_ticks", 2)
+    kw.setdefault("down_ticks", 3)
+    kw.setdefault("cooldown", 2)
+    kw.setdefault("up_depth", 4.0)
+    kw.setdefault("interval_s", 0.05)
+    sc = AutoScaler(m, lambda: events.append("up"),
+                    lambda: events.append("down"),
+                    replicas_fn=lambda: replicas[0], **kw)
+    return sc, events
+
+
+def test_autoscaler_blip_does_not_flap(telemetry_on):
+    m, replicas = _Metrics(), [1]
+    sc, events = _scaler(m, replicas)
+    m.depth = 10.0                   # one-tick pressure blip
+    assert sc.tick() is None
+    m.depth = 0.0
+    for _ in range(10):              # idle forever after the blip...
+        sc.tick()
+    # ...may scale DOWN-wards never below min, and never UP off a blip
+    assert "up" not in events and events.count("down") == 0
+
+
+def test_autoscaler_sustained_pressure_scales_up_once(telemetry_on):
+    m, replicas = _Metrics(), [1]
+    sc, events = _scaler(m, replicas)
+    m.depth = 10.0
+    assert sc.tick() is None         # streak 1/2
+    assert sc.tick() == "up"         # streak 2/2 -> fire
+    assert events == ["up"]
+    # cooldown: pressure continues but ONE burst maps to ONE event
+    assert sc.tick() is None and sc.tick() is None
+    assert events == ["up"]
+    assert _tm.snapshot()["counters"].get(
+        "autoscale_events_total{dir=up}") == 1
+
+
+def test_autoscaler_clamps_and_scales_down(telemetry_on):
+    m, replicas = _Metrics(), [3]
+    sc, events = _scaler(m, replicas)
+    m.depth = 10.0
+    for _ in range(5):               # at max_replicas: pressure is a no-op
+        sc.tick()
+    assert events == []
+    m.depth = 0.0
+    sc.tick()                        # idle 1/3
+    sc.tick()                        # idle 2/3
+    assert sc.tick() == "down"       # idle 3/3 -> retire one
+    assert events == ["down"]
+    replicas[0] = 1
+    for _ in range(10):              # at min_replicas: idle is a no-op
+        sc.tick()
+    assert events == ["down"]
+
+
+def test_autoscaler_shed_delta_is_pressure(telemetry_on):
+    m, replicas = _Metrics(), [1]
+    sc, events = _scaler(m, replicas)
+    sc.tick()                        # baseline observation (delta 0)
+    m.shed = 5.0                     # sheds while depth stays low
+    assert sc.tick() is None
+    m.shed = 9.0
+    assert sc.tick() == "up"
+    assert events == ["up"]
+
+
+# -- wire: client shed retry + fault points ----------------------------------
+
+@pytest.fixture()
+def live_server(saved_model):
+    eng = ServingEngine(buckets=(1, 4))
+    eng.add_model("fc", saved_model)
+    eng.prewarm()
+    srv = ServingServer(eng, port=0).start()
+    yield srv, eng
+    srv.shutdown()
+
+
+def test_client_shed_retry_backoff(live_server, telemetry_on):
+    srv, eng = live_server
+    eng.max_queue = 0                 # every admission sheds
+    fluid.set_flags({"FLAGS_serving_client_shed_retries": 2})
+    try:
+        client = ServingClient(endpoints=["127.0.0.1:%d" % srv.port])
+        r = client.infer("fc", {"x": X1}, tier="free")
+        assert r.status == "shed"     # still shed after capped retries
+        assert client.shed_retries == 2
+        assert _tm.counter_total("client_shed_retries_total") == 2
+    finally:
+        fluid.set_flags({"FLAGS_serving_client_shed_retries": 0})
+        eng.max_queue = 256
+
+
+def test_wire_fault_point_injects_error(live_server, telemetry_on):
+    srv, eng = live_server
+    client = ServingClient(endpoints=["127.0.0.1:%d" % srv.port])
+    fault_injection.arm("serving.infer:error:1.0")
+    try:
+        r = client.infer("fc", {"x": X1})
+        assert r.status == "error"
+        assert "injected fault" in (r.error or "")
+    finally:
+        fault_injection.disarm()
+    assert _tm.counter_total("fault_injected_total") >= 1
+    # disarmed: traffic flows again
+    assert client.infer("fc", {"x": X1}).ok
+
+
+def test_execute_fault_point_errors_batch(live_server, telemetry_on):
+    srv, eng = live_server
+    client = ServingClient(endpoints=["127.0.0.1:%d" % srv.port])
+    fault_injection.arm("serving.execute.fc:error:1.0:1")   # fire once
+    try:
+        r = client.infer("fc", {"x": X1})
+        assert r.status == "error"
+        assert "injected execute fault" in (r.error or "")
+    finally:
+        fault_injection.disarm()
+    # the reply publishes from complete() just BEFORE the dispatcher
+    # bumps the error counters — give it a beat
+    deadline = time.time() + 2.0
+    while _tm.counter_total("serving_request_errors_total") < 1 \
+            and time.time() < deadline:
+        time.sleep(0.01)
+    assert _tm.counter_total("serving_request_errors_total") >= 1
+    assert client.infer("fc", {"x": X1}).ok

@@ -19,8 +19,13 @@ import threading
 import time
 
 import numpy as np
+import pytest
 
 from dist_utils import free_ports, gather_tails
+
+# multi-minute subprocess scenario: excluded from the tier-1 wall
+# (-m 'not slow') but still run by tools/run_ci.sh --serve-smoke
+pytestmark = pytest.mark.slow
 
 _SERVE = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "tools", "serve.py")

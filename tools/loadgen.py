@@ -37,6 +37,16 @@ fingerprint of every (prompt -> output tokens) pair, so the same seeded
 traffic replayed with speculation on and off can assert bitwise-equal
 output next to the tokens/sec comparison.
 
+``--tier-mix paid:0.35,free:0.65`` stamps each request with a sampled
+SLO tier (the engine's deadline-weighted admission sheds low tiers
+first); the report gains a per-tier breakdown with ``server_ms_p99``
+(queue_wait + execute — the wire-noise-free p99 the overload leg
+asserts on) and shed counts.  ``--canary-assert LABEL:FRAC`` exits
+nonzero unless >= FRAC of ok replies were served by model version
+LABEL (reply phases carry the resolved version) — the post-flip
+consistency check; the report's ``versions`` map counts every resolved
+version seen.
+
 ``--prefix-share F`` turns on shared-prefix traffic: a fraction F of
 requests prepend one of ``--prefix-pool`` seeded common prefixes of
 ``--prefix-tokens`` tokens to their random tail — the system-prompt /
@@ -113,6 +123,14 @@ def main(argv=None):
     ap.add_argument("--retry-shed", type=int, default=0,
                     help="resubmit a shed request up to N times after "
                     "its retry_after_ms hint")
+    ap.add_argument("--tier-mix", default=None,
+                    help="SLO-tiered traffic, e.g. paid:0.35,free:0.65 — "
+                    "each request samples a tier by weight and the "
+                    "report gains per-tier latency/shed breakdowns")
+    ap.add_argument("--canary-assert", default=None, metavar="LABEL:FRAC",
+                    help="exit 1 unless >= FRAC of ok replies were "
+                    "served by model version LABEL (reply phases carry "
+                    "the resolved version) — the post-flip check")
     ap.add_argument("--prefix-share", type=float, default=0.0,
                     help="decode traffic: fraction of requests whose "
                     "prompt starts with a shared common prefix drawn "
@@ -146,33 +164,74 @@ def main(argv=None):
                      for _ in range(args.prefix_tokens)]
                     for _ in range(args.prefix_pool)]
 
+    # tiered traffic: sample each request's SLO tier by weight (seeded,
+    # so two runs replay the same per-request tier assignment)
+    tier_mix = []
+    if args.tier_mix:
+        for part in args.tier_mix.split(","):
+            name, _, w = part.strip().partition(":")
+            tier_mix.append((name, float(w or 1.0)))
+
+    def sample_tier():
+        if not tier_mix:
+            return None
+        x = rng.random() * sum(w for _, w in tier_mix)
+        for name, w in tier_mix:
+            x -= w
+            if x <= 0:
+                return name
+        return tier_mix[-1][0]
+
     lock = threading.Lock()
     latencies, statuses = [], {}
     phase_samples = {"queue_wait_ms": [], "execute_ms": [], "wire_ms": []}
     ttfts, itls, tokens_out = [], [], [0]
     cached_toks, prompt_toks = [0], [0]   # client-side exact hit rate
     out_map = {}    # prompt tuple -> generated tokens (greedy => unique)
+    # per-tier breakdown + per-version counts (phases carry the resolved
+    # tier/model, so both attribute server-side)
+    tier_stats = {}     # tier -> {requests, ok, shed, lat[], server[]}
+    versions = {}       # resolved version name -> ok replies
     threads = []
 
-    def run_once(rows, prompt):
+    def run_once(rows, prompt, tier):
         if not decode:
             return client.infer(args.model, synth_feeds(spec, rows),
-                                deadline_ms=args.deadline_ms)
+                                deadline_ms=args.deadline_ms, tier=tier)
         return client.generate(args.model, prompt,
                                max_new_tokens=args.max_new,
                                stream=not args.no_stream,
-                               deadline_ms=args.deadline_ms)
+                               deadline_ms=args.deadline_ms, tier=tier)
 
-    def fire(rows, prompt):
-        r = run_once(rows, prompt)
+    def fire(rows, prompt, tier):
+        r = run_once(rows, prompt, tier)
         retries = args.retry_shed
         while r.status == "shed" and retries > 0:
             time.sleep(max(r.retry_after_ms, 1.0) / 1e3)
             retries -= 1
-            r = run_once(rows, prompt)
+            r = run_once(rows, prompt, tier)
         with lock:
             statuses[r.status] = statuses.get(r.status, 0) + 1
+            if tier is not None:
+                ts = tier_stats.setdefault(
+                    tier, {"requests": 0, "ok": 0, "shed": 0,
+                           "lat": [], "server": []})
+                ts["requests"] += 1
+                if r.ok:
+                    ts["ok"] += 1
+                    ts["lat"].append(r.latency_ms)
+                    # server-side time (queue + compute): the phase-p99
+                    # the overload assert uses — wire/client noise-free
+                    qw = r.phases.get("queue_wait_ms")
+                    ex = r.phases.get("execute_ms")
+                    if qw is not None and ex is not None:
+                        ts["server"].append(float(qw) + float(ex))
+                elif r.status == "shed":
+                    ts["shed"] += 1
             if r.ok:
+                v = r.phases.get("model")
+                if v:
+                    versions[v] = versions.get(v, 0) + 1
                 latencies.append(r.latency_ms)
                 for ph, xs in phase_samples.items():
                     v = r.phases.get(ph)
@@ -210,7 +269,8 @@ def main(argv=None):
         delay = next_at - time.perf_counter()
         if delay > 0:
             time.sleep(delay)
-        t = threading.Thread(target=fire, args=(rng.choice(mix), prompt),
+        t = threading.Thread(target=fire,
+                             args=(rng.choice(mix), prompt, sample_tier()),
                              daemon=True)
         t.start()
         threads.append(t)
@@ -279,7 +339,19 @@ def main(argv=None):
         "shed_rate": round(statuses.get("shed", 0) / total, 4),
         "dropped": dropped,
         "failovers": client.failovers,
+        "client_shed_retries": client.shed_retries,
     }
+    if versions:
+        report["versions"] = versions
+    if tier_stats:
+        report["tiers"] = {
+            t: {"requests": ts["requests"], "ok": ts["ok"],
+                "shed": ts["shed"],
+                "latency_ms_p50": round(percentile(ts["lat"], 0.50), 3),
+                "latency_ms_p99": round(percentile(ts["lat"], 0.99), 3),
+                "server_ms_p50": round(percentile(ts["server"], 0.50), 3),
+                "server_ms_p99": round(percentile(ts["server"], 0.99), 3)}
+            for t, ts in sorted(tier_stats.items())}
     if decode:
         # outputs_sha256 fingerprints every (prompt -> tokens) pair so
         # two runs of the SAME seeded traffic can assert bitwise-equal
@@ -322,6 +394,18 @@ def main(argv=None):
     if args.assert_no_drops and dropped:
         print("FAIL: %d requests dropped" % dropped, file=sys.stderr)
         return 1
+    if args.canary_assert:
+        label, _, frac = args.canary_assert.partition(":")
+        want = float(frac or 1.0)
+        ok_total = sum(versions.values())
+        got = versions.get(label, 0) / ok_total if ok_total else 0.0
+        if got < want:
+            print("FAIL: version %s served %.3f of ok traffic "
+                  "(wanted >= %.3f); versions=%s"
+                  % (label, got, want, versions), file=sys.stderr)
+            return 1
+        print("CANARY-ASSERT ok: %s served %.3f >= %.3f"
+              % (label, got, want), flush=True)
     return 0
 
 

@@ -101,8 +101,13 @@ def main(argv=None):
     ap.add_argument("--serving", action="store_true", dest="serving_only",
                     help="show only inference-serving metrics: queue "
                     "depth / qps / fleet gauges, request / shed / timeout "
-                    "/ batch counters, latency + batch-fill histograms "
-                    "(serving/engine.py + fleet.py)")
+                    "/ batch counters, latency + batch-fill histograms, "
+                    "plus the control plane — per-tier shed counters "
+                    "(serving_tier_shed_total{tier}), autoscaler events "
+                    "(autoscale_events_total{dir}), rollout_state gauge "
+                    "and rollback counters, client shed retries, and "
+                    "injected wire faults (serving/engine.py + fleet.py "
+                    "+ rollout.py)")
     ap.add_argument("--decode", action="store_true", dest="decode_only",
                     help="show only autoregressive-decode metrics: paged "
                     "KV pool counters/gauges (kv_block_*, kv_blocks_in_use"
@@ -151,7 +156,10 @@ def main(argv=None):
     if args.kernels_only:
         snap = _filter_snap(snap, "pallas_kernel_")
     if args.serving_only:
-        snap = _filter_snap(snap, "serving_")
+        # serving_* plus the PR 16 control-plane families (autoscaler,
+        # rollout gate, client shed retries, injected wire faults)
+        snap = _filter_snap(snap, ("serving_", "autoscale_", "rollout_",
+                                   "client_shed_", "fault_injected_"))
     if args.decode_only:
         snap = _filter_snap(snap, ("kv_block", "kv_cache_",
                                    "kv_blocks_in_use", "serving_decode_",

@@ -141,6 +141,259 @@ if [ "$MODE" = "--serve-smoke" ]; then
     | grep -c serving_batches_total > /dev/null
   kill $R0 2>/dev/null || true
   trap - EXIT
+
+  echo "== serve smoke: SLO-tiered admission under overload =="
+  # single replica, tiny queue, one 4-row bucket.  The armed delay fault
+  # point (satellite: FLAGS_fault_spec on the execute path) makes every
+  # batch take 50-150 ms, so qps 75 of one-row requests is a genuine
+  # ~2x overload of the ~36/s capacity.  The 150 ms batch window makes
+  # the paid-p99 bound meaningful: the uncontended baseline pays a full
+  # coalescing window per solo request, and under overload a paid
+  # arrival evicts queued free work and boards the NEXT dispatch, so
+  # its wait is the in-flight remainder — bounded by that same window —
+  # while free-tier traffic queues behind it and sheds
+  env "${SRV_ENV[@]}" FLAGS_serving_max_queue=4 \
+    FLAGS_serving_batch_window_ms=150 \
+    FLAGS_fault_spec="serving.execute.fc:delay:1.0" \
+    python tools/serve.py --model fc="$SRV_DIR/model" --port 9462 \
+    --buckets 4 > "$SRV_DIR/tier.log" 2>&1 &
+  R2=$!
+  trap 'kill -9 $R2 2>/dev/null || true' EXIT
+  for _ in $(seq 60); do grep -q READY "$SRV_DIR/tier.log" && break; sleep 1; done
+  grep -q READY "$SRV_DIR/tier.log"
+  JAX_PLATFORMS=cpu python tools/loadgen.py --endpoints 127.0.0.1:9462 \
+    --model fc --requests 40 --qps 5 --batch-mix 1 --tier-mix paid:1.0 \
+    --out "$SRV_DIR/BENCH_tier_base.json" --assert-no-drops
+  JAX_PLATFORMS=cpu python tools/loadgen.py --endpoints 127.0.0.1:9462 \
+    --model fc --requests 240 --qps 75 --batch-mix 1 \
+    --tier-mix paid:0.12,free:0.88 \
+    --out "$SRV_DIR/BENCH_tier_overload.json"
+  python tools/metrics_dump.py --scrape 127.0.0.1:9462 --serving \
+    | grep -c serving_tier_shed_total > /dev/null
+  kill -9 $R2 2>/dev/null || true
+  trap - EXIT
+  python - "$SRV_DIR/BENCH_tier_base.json" \
+    "$SRV_DIR/BENCH_tier_overload.json" <<'EOF'
+import json, sys
+base = json.load(open(sys.argv[1]))["tiers"]["paid"]
+over = json.load(open(sys.argv[2]))["tiers"]
+paid, free = over["paid"], over["free"]
+shed = paid["shed"] + free["shed"]
+assert shed > 0, "overload run never shed — not actually overloaded"
+frac_free = free["shed"] / shed
+b, p = base["server_ms_p99"], paid["server_ms_p99"]
+# 1.2x with a small absolute floor: at ms-scale baselines the in-flight
+# batch alone exceeds 1.2x, so the bound is max(1.2x, +20ms)
+bound = max(1.2 * b, b + 20.0)
+print("TIER paid server p99 %.1f ms under overload (uncontended %.1f, "
+      "bound %.1f); %d shed, %.0f%% free-tier"
+      % (p, b, bound, shed, frac_free * 100))
+assert paid["ok"] > 0, "no paid request survived overload"
+assert p <= bound, "paid p99 %.1f ms blew the %.1f ms bound" % (p, bound)
+assert frac_free >= 0.90, \
+    "shed load only %.0f%% free-tier (< 90%%)" % (frac_free * 100)
+EOF
+
+  echo "== serve smoke: chaos canary flip (SIGKILL mid-flip under load) =="
+  # 3 replicas serving fc AND fc@v2 (same weights, both prewarmed); a
+  # 50% canary starts, then the flip lands while rank 1 is SIGKILLed
+  # under open-loop load — 0 drops, and every survivor must converge on
+  # the flipped version (the monitor's re-broadcast heals missed sends).
+  # The metrics gate is parked (huge min_samples): the same-weights
+  # canary must never spuriously roll back mid-chaos
+  CHS_ENV=("${SRV_ENV[@]}" FLAGS_rollout_gate_min_samples=1000000)
+  CFLEET=127.0.0.1:9463,127.0.0.1:9464,127.0.0.1:9465
+  for r in 0 1 2; do
+    env "${CHS_ENV[@]}" python tools/serve.py \
+      --model fc="$SRV_DIR/model" --model fc@v2="$SRV_DIR/model" \
+      --rank $r --fleet "$CFLEET" --buckets 1,4 \
+      --endpoints-file "$SRV_DIR/ceps.json" > "$SRV_DIR/c$r.log" 2>&1 &
+    eval "C$r=\$!"
+  done
+  trap 'kill -9 $C0 $C1 $C2 2>/dev/null || true' EXIT
+  for _ in $(seq 90); do
+    grep -q READY "$SRV_DIR/c0.log" && grep -q READY "$SRV_DIR/c1.log" \
+      && grep -q READY "$SRV_DIR/c2.log" && break
+    sleep 1
+  done
+  grep -q READY "$SRV_DIR/c2.log"
+  JAX_PLATFORMS=cpu python - "$SRV_DIR/ceps.json" <<'EOF'
+import sys
+from paddle_tpu.serving import ServingClient
+c = ServingClient(endpoints_file=sys.argv[1])
+r = c.rollout({"op": "start", "model": "fc", "active": "fc",
+               "canary": "fc@v2", "fraction": 0.5})
+assert r.get("status") == "ok", r
+print("canary started:", r["phases"]["routes"])
+EOF
+  JAX_PLATFORMS=cpu python tools/loadgen.py \
+    --endpoints-file "$SRV_DIR/ceps.json" --model fc --requests 240 \
+    --qps 60 --out "$SRV_DIR/BENCH_chaos_flip.json" --assert-no-drops &
+  LG=$!
+  sleep 1.5
+  # the flip and the SIGKILL race each other mid-stream
+  ( JAX_PLATFORMS=cpu python - "$SRV_DIR/ceps.json" <<'EOF'
+import sys
+from paddle_tpu.serving import ServingClient
+r = ServingClient(endpoints_file=sys.argv[1]).rollout(
+    {"op": "flip", "model": "fc"})
+assert r.get("status") == "ok", r
+print("flipped:", r["phases"]["routes"])
+EOF
+  ) &
+  FLIP=$!
+  kill -9 $C1 2>/dev/null || true
+  wait $FLIP
+  wait $LG   # 0 dropped requests through the kill + flip
+  # every survivor must agree on the flipped version
+  JAX_PLATFORMS=cpu python - <<'EOF'
+import sys, time
+from paddle_tpu.serving import ServingClient
+c = ServingClient(endpoints=["127.0.0.1:9463", "127.0.0.1:9465"])
+deadline = time.time() + 30
+while True:
+    docs = [c.rollout_state(ep) for ep in ("127.0.0.1:9463",
+                                           "127.0.0.1:9465")]
+    routes = [d.get("models", {}).get("fc") for d in docs]
+    if all(r and r["state"] == "flipped" and r["active"] == "fc@v2"
+           for r in routes):
+        print("survivors agree: fc -> fc@v2 (flipped) on both replicas")
+        break
+    if time.time() > deadline:
+        sys.exit("survivors never converged: %s" % routes)
+    time.sleep(0.3)
+EOF
+  # post-flip traffic must be served ~entirely by fc@v2
+  JAX_PLATFORMS=cpu python tools/loadgen.py \
+    --endpoints-file "$SRV_DIR/ceps.json" --model fc --requests 80 \
+    --qps 80 --out "$SRV_DIR/BENCH_postflip.json" --assert-no-drops \
+    --canary-assert fc@v2:0.99
+  kill -9 $C0 $C2 2>/dev/null || true
+  trap - EXIT
+
+  echo "== serve smoke: canary rollback gate (seeded bad v2) =="
+  # single replica; every fc@v2 execution raises via the armed fault
+  # point, so the canary's error rate trips the gate and the monitor
+  # rolls back on its own.  GATE-VERDICT printed beside the BENCH rows
+  # is the BASELINE.md round-16 validity requirement
+  env "${SRV_ENV[@]}" FLAGS_rollout_gate_min_samples=5 \
+    FLAGS_fault_spec="serving.execute.fc@v2:error:1.0" \
+    python tools/serve.py --model fc="$SRV_DIR/model" \
+    --model fc@v2="$SRV_DIR/model" --port 9466 --buckets 1,4 \
+    > "$SRV_DIR/gate.log" 2>&1 &
+  R6=$!
+  trap 'kill -9 $R6 2>/dev/null || true' EXIT
+  for _ in $(seq 60); do grep -q READY "$SRV_DIR/gate.log" && break; sleep 1; done
+  grep -q READY "$SRV_DIR/gate.log"
+  JAX_PLATFORMS=cpu python - <<'EOF'
+from paddle_tpu.serving import ServingClient
+c = ServingClient(endpoints=["127.0.0.1:9466"])
+r = c.rollout({"op": "start", "model": "fc", "active": "fc",
+               "canary": "fc@v2", "fraction": 0.5})
+assert r.get("status") == "ok", r
+EOF
+  JAX_PLATFORMS=cpu python tools/loadgen.py --endpoints 127.0.0.1:9466 \
+    --model fc --requests 60 --qps 60 \
+    --out "$SRV_DIR/BENCH_rollback.json"
+  JAX_PLATFORMS=cpu python - <<'EOF'
+import sys, time
+from paddle_tpu.serving import ServingClient
+c = ServingClient(endpoints=["127.0.0.1:9466"])
+deadline = time.time() + 30
+while True:
+    doc = c.rollout_state("127.0.0.1:9466").get("models", {}).get("fc")
+    if doc and doc["state"] == "rolled_back":
+        break
+    if time.time() > deadline:
+        sys.exit("gate never rolled the canary back: %s" % doc)
+    time.sleep(0.3)
+st = c.rollout({"op": "status"})
+gate = st["phases"]["gates"].get("fc", {})
+print("GATE-VERDICT model=fc verdict=%s reason=%r (state=rolled_back)"
+      % (gate.get("verdict"), gate.get("reason")))
+assert gate.get("verdict") == "trip", gate
+EOF
+  python tools/metrics_dump.py --scrape 127.0.0.1:9466 --serving \
+    | grep -c rollout_rollbacks_total > /dev/null
+  kill -9 $R6 2>/dev/null || true
+  trap - EXIT
+
+  echo "== serve smoke: autoscaler (prewarmed standby up, drain down) =="
+  # rank 0 alone holds a 2-slot fleet; sustained overload must fork the
+  # prewarmed standby into slot 1 (endpoints file grows), sustained idle
+  # must drain + retire it (file shrinks) — hysteresis ticks shortened
+  # for CI wall time
+  env "${SRV_ENV[@]}" FLAGS_serving_max_queue=4 \
+    FLAGS_serving_autoscale_interval=0.25 FLAGS_serving_scale_up_ticks=2 \
+    FLAGS_serving_scale_down_ticks=4 FLAGS_serving_autoscale_cooldown=4 \
+    python tools/serve.py --model fc="$SRV_DIR/model" --rank 0 \
+    --fleet 127.0.0.1:9467,127.0.0.1:9468 --buckets 1 \
+    --endpoints-file "$SRV_DIR/aeps.json" --autoscale --max-replicas 2 \
+    > "$SRV_DIR/a0.log" 2>&1 &
+  A0=$!
+  trap 'kill -9 $A0 2>/dev/null || true; pkill -9 -f "127.0.0.1:9467,127.0.0.1:9468" 2>/dev/null || true' EXIT
+  for _ in $(seq 60); do grep -q READY "$SRV_DIR/a0.log" && break; sleep 1; done
+  grep -q READY "$SRV_DIR/a0.log"
+  # wait out the eviction of the never-started slot 1 (live must be [0])
+  python - "$SRV_DIR/aeps.json" <<'EOF'
+import json, sys, time
+deadline = time.time() + 30
+while time.time() < deadline:
+    try:
+        if len(json.load(open(sys.argv[1]))["endpoints"]) == 1:
+            sys.exit(0)
+    except Exception:
+        pass
+    time.sleep(0.3)
+sys.exit("fleet never settled to 1 live replica")
+EOF
+  JAX_PLATFORMS=cpu python tools/loadgen.py --endpoints 127.0.0.1:9467 \
+    --model fc --requests 800 --qps 500 --batch-mix 1 \
+    --out "$SRV_DIR/BENCH_autoscale.json" &
+  ALG=$!
+  # sustained pressure -> standby forked into slot 1 (cold start is
+  # restore-dominated via the shared compile cache)
+  python - "$SRV_DIR/aeps.json" <<'EOF'
+import json, sys, time
+deadline = time.time() + 90
+while time.time() < deadline:
+    try:
+        if len(json.load(open(sys.argv[1]))["endpoints"]) == 2:
+            print("scaled UP to 2 replicas")
+            sys.exit(0)
+    except Exception:
+        pass
+    time.sleep(0.3)
+sys.exit("autoscaler never scaled up under overload")
+EOF
+  wait $ALG || true
+  # sustained idle -> the standby drains at a batch boundary and retires
+  python - "$SRV_DIR/aeps.json" <<'EOF'
+import json, sys, time
+deadline = time.time() + 90
+while time.time() < deadline:
+    try:
+        if len(json.load(open(sys.argv[1]))["endpoints"]) == 1:
+            print("scaled DOWN to 1 replica")
+            sys.exit(0)
+    except Exception:
+        pass
+    time.sleep(0.3)
+sys.exit("autoscaler never retired the idle standby")
+EOF
+  python - <<'EOF'
+from paddle_tpu.core import telemetry
+snap = telemetry.scrape("127.0.0.1:9467")
+c = snap.get("counters", {})
+up = c.get("autoscale_events_total{dir=up}", 0)
+down = c.get("autoscale_events_total{dir=down}", 0)
+assert up >= 1 and down >= 1, \
+    "autoscale_events_total up=%s down=%s" % (up, down)
+print("autoscale_events_total: up=%d down=%d" % (up, down))
+EOF
+  kill -9 $A0 2>/dev/null || true
+  pkill -9 -f "127.0.0.1:9467,127.0.0.1:9468" 2>/dev/null || true
+  trap - EXIT
   rm -rf "$SRV_DIR"
   echo "CI --serve-smoke: PASS"
   exit 0
@@ -164,7 +417,8 @@ if [ "$MODE" = "--decode-smoke" ]; then
   # bitwise-identical (budgeted prefill is scheduling only)
   echo "== decode smoke: paged KV cache + decode serving tests =="
   JAX_PLATFORMS=cpu FLAGS_static_check=error \
-    python -m pytest tests/test_kv_cache.py tests/test_decode_serving.py -q
+    python -m pytest tests/test_kv_cache.py tests/test_decode_serving.py \
+    tests/test_decode_fleet_subprocess.py -q
   echo "== decode smoke: token-level replica under mixed-length burst =="
   DEC_DIR="$(mktemp -d)"
   JAX_PLATFORMS=cpu python tools/serve.py --save-demo-decoder "$DEC_DIR/dec"

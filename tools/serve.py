@@ -16,6 +16,13 @@ Usage:
         --fleet 127.0.0.1:9000,127.0.0.1:9001 \
         --endpoints-file /tmp/eps.json
 
+    # elastic fleet + autoscaling: the coordinator watches queue depth /
+    # shed rate and forks prewarmed standbys into dead --fleet slots on
+    # sustained pressure, retires the highest rank on sustained idle
+    python tools/serve.py --model fc=/path --rank 0 \
+        --fleet 127.0.0.1:9000,127.0.0.1:9001 --cache-dir /tmp/cc \
+        --endpoints-file /tmp/eps.json --autoscale --max-replicas 2
+
     # helper for smoke tests: save a tiny fc inference model and exit
     python tools/serve.py --save-demo-model /tmp/model
 
@@ -118,6 +125,18 @@ def main(argv=None):
                     help="draft-model speculation depth for decode "
                     "models with a bundled draft (default "
                     "FLAGS_speculative_k; 0 = off)")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="coordinator only: watch queue depth / shed "
+                    "rate and launch prewarmed standby replicas into "
+                    "dead --fleet slots on sustained pressure, drain + "
+                    "retire the highest rank on sustained idle")
+    ap.add_argument("--min-replicas", type=int, default=None,
+                    help="autoscaler floor (default "
+                    "FLAGS_serving_min_replicas)")
+    ap.add_argument("--max-replicas", type=int, default=None,
+                    help="autoscaler ceiling (default "
+                    "FLAGS_serving_max_replicas; also clamped by the "
+                    "--fleet slot count)")
     args = ap.parse_args(argv)
 
     if args.save_demo_model:
@@ -177,12 +196,77 @@ def main(argv=None):
     if endpoints:
         fleet = ServingFleet(args.rank, endpoints, server,
                              endpoints_file=args.endpoints_file).start()
-    print("READY port=%d" % server.port, flush=True)
+
+    # rollout controller: serves __rollout_ctl__ admin commands and runs
+    # the canary metrics gate (auto-rollback); with a fleet, state
+    # changes broadcast to peers and ride the epoch-bumped endpoints file
+    from paddle_tpu.serving import RolloutController
+
+    server.rollout = RolloutController(server, fleet).start()
 
     done = threading.Event()
+    # a drained __retire__ order exits the process like a SIGTERM would
+    server.on_retire = done.set
+
+    scaler = None
+    if args.autoscale and fleet is not None:
+        from paddle_tpu.core import telemetry as _tm
+        from paddle_tpu.serving import AutoScaler
+
+        def child_argv(rank):
+            """Re-exec this invocation for a standby slot (the child
+            shares --cache-dir, so its prewarm is restore-dominated);
+            the child never autoscales itself."""
+            out, it = [sys.executable, os.path.abspath(__file__)], \
+                iter(sys.argv[1:])
+            for a in it:
+                if a == "--autoscale":
+                    continue
+                if a in ("--rank", "--min-replicas", "--max-replicas"):
+                    next(it, None)
+                    continue
+                out.append(a)
+            return out + ["--rank", str(rank)]
+
+        def metrics():
+            depth = len(engine._queue)
+            if decode_engine is not None:
+                depth += len(decode_engine._waiting)
+            return {"queue_depth": depth,
+                    "shed_total": _tm.counter_total("serving_shed_total")}
+
+        def scale_up():
+            import subprocess
+
+            if not fleet.is_coordinator():
+                return
+            dead = [r for r in range(len(fleet.endpoints))
+                    if r not in fleet.live]
+            if not dead:
+                return
+            rank = dead[0]
+            fleet.notice_relaunch(rank)
+            subprocess.Popen(child_argv(rank), start_new_session=True)
+
+        def scale_down():
+            if not fleet.is_coordinator():
+                return
+            cands = [r for r in sorted(fleet.live) if r != fleet.rank]
+            if cands:
+                fleet.retire(cands[-1])
+
+        scaler = AutoScaler(metrics, scale_up, scale_down,
+                            replicas_fn=lambda: len(fleet.live),
+                            min_replicas=args.min_replicas,
+                            max_replicas=args.max_replicas).start()
+
+    print("READY port=%d" % server.port, flush=True)
+
     for sig in (signal.SIGINT, signal.SIGTERM):
         signal.signal(sig, lambda *_: done.set())
     done.wait()
+    if scaler is not None:
+        scaler.stop()
     if fleet is not None:
         fleet.stop()
     server.shutdown()
