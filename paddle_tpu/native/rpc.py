@@ -80,6 +80,8 @@ class RpcServer:
         ndim = c.c_int()
         data = c.c_void_p()
         dlen = c.c_longlong()
+        if self._h is None:
+            return 0, None, None
         t = self._lib.rpcs_poll(self._h, name, 1024, c.byref(dtype), dims, 16,
                                 c.byref(ndim), c.byref(data), c.byref(dlen))
         if t == 0:
@@ -98,6 +100,10 @@ class RpcServer:
         return t, bare, arr
 
     def set_var(self, name, arr):
+        # use-after-shutdown must raise, not hand the native layer a NULL
+        # handle (a late publisher thread would segfault the process)
+        if self._h is None:
+            raise ConnectionError("rpc server already shut down")
         arr = np.ascontiguousarray(arr)
         dims = (ctypes.c_longlong * arr.ndim)(*arr.shape)
         self._lib.rpcs_set_var(
@@ -105,9 +111,13 @@ class RpcServer:
             arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes)
 
     def serve(self, enable=True):
+        if self._h is None:
+            raise ConnectionError("rpc server already shut down")
         self._lib.rpcs_serve(self._h, 1 if enable else 0)
 
     def del_var(self, name):
+        if self._h is None:
+            raise ConnectionError("rpc server already shut down")
         self._lib.rpcs_del_var(self._h, name.encode())
 
     def shutdown(self):

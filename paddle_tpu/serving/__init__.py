@@ -15,6 +15,14 @@ token-level continuous batches over an engine-owned ``PagedKVCache``
 serving/decode_model.py through one AOT-compiled executable per lane
 bucket; generated tokens stream back as ``__stream__`` chunks.
 
+Disaggregated serving (PR 17) splits a fleet into prefill-role and
+decode-role replicas: the prefill half runs admission + chunked prefill
+and streams each sealed KV block to a decode peer as ``__kvxfer__``
+frames (serving/disagg.py ``KVBlockSender``); the decode half adopts
+them into its refcounted pool via the prefix-cache index
+(``AdoptTracker`` + ``DecodeEngine.adopt_kv_block``) and serves the
+stream/reply the client was routed to by the ``__pair__`` hint.
+
 The control plane above the fleet (PR 16) rides the same pieces:
 SLO-tiered deadline-weighted admission in the engines, an ``AutoScaler``
 launching prewarmed standbys / draining idle replicas, and a
@@ -24,7 +32,9 @@ automatic rollback (serving/rollout.py).
 Entry points: ``tools/serve.py`` and ``tools/loadgen.py``.
 """
 
-from .client import ServingClient, read_endpoints_file  # noqa: F401
+from .client import ServingClient, read_endpoints_doc, \
+    read_endpoints_file  # noqa: F401
+from .disagg import AdoptTracker, KVBlockSender  # noqa: F401
 from .engine import DecodeEngine, InferReply, ServingEngine, \
     parse_buckets, parse_tier_weights, tier_weight  # noqa: F401
 from .fleet import AutoScaler, ServingFleet, \
@@ -38,7 +48,7 @@ __all__ = [
     "ServingEngine", "DecodeEngine", "ServingServer", "ServingClient",
     "ServingFleet", "AutoScaler", "RolloutController", "evaluate_gate",
     "InferReply", "parse_buckets", "parse_tier_weights", "tier_weight",
-    "read_endpoints_file", "write_endpoints_file", "KVCacheConfig",
-    "BlockAllocator", "PagedKVCache", "plan_num_blocks",
-    "engine_owned_kv_bytes",
+    "read_endpoints_file", "read_endpoints_doc", "write_endpoints_file",
+    "KVCacheConfig", "BlockAllocator", "PagedKVCache", "plan_num_blocks",
+    "engine_owned_kv_bytes", "KVBlockSender", "AdoptTracker",
 ]

@@ -27,6 +27,14 @@ replica that will never be asked for the answer.
 Requests carry an optional SLO ``tier`` ("paid"/"free"/"batch") in the
 meta; the engine's deadline-weighted admission sheds low tiers first
 under overload.
+
+Disaggregated fleets publish a ``roles`` column beside the endpoints
+(serving/fleet.py): ``generate`` then lands ``__generate__`` on a
+prefill-role replica, reads its ``__pair__:<req_id>`` routing hint, and
+walks the ``__stream__``/``__reply__`` vars on the named decode-role
+replica (or on the same connection when the hint is None — no live
+decode peer).  On failover the abort goes to BOTH halves, decode first,
+so a dead pair can't strand adopted KV blocks on the survivor.
 """
 
 import json
@@ -43,7 +51,7 @@ from ..native.rpc import RpcClient
 from . import codec
 from .engine import InferReply
 
-__all__ = ["ServingClient", "read_endpoints_file"]
+__all__ = ["ServingClient", "read_endpoints_file", "read_endpoints_doc"]
 
 
 def _flag(name):
@@ -60,12 +68,30 @@ def read_endpoints_file(path):
     return [str(e) for e in doc.get("endpoints", [])]
 
 
+def read_endpoints_doc(path):
+    """Endpoints plus the optional disaggregation role column: returns
+    (endpoints, roles-or-None).  A roles list that doesn't parallel the
+    endpoints (torn hand-edit) is dropped rather than misrouting."""
+    with open(path) as f:
+        doc = json.load(f)
+    eps = [str(e) for e in doc.get("endpoints", [])]
+    roles = doc.get("roles")
+    if roles and len(roles) == len(eps):
+        return eps, [str(r) for r in roles]
+    return eps, None
+
+
 class ServingClient:
     def __init__(self, endpoints=None, endpoints_file=None,
-                 tenant="default", deadline_ms=None):
+                 tenant="default", deadline_ms=None, roles=None):
         self.endpoints_file = endpoints_file or \
             _flag("serving_endpoints_file") or None
         self._static = list(endpoints or [])
+        # static role column parallel to ``endpoints`` (tests / no fleet
+        # file); with a file the coordinator's published column wins
+        self._roles = list(roles) if roles else None
+        if self._roles and len(self._roles) != len(self._static):
+            raise ValueError("client roles must parallel endpoints")
         self.tenant = tenant
         self.default_deadline_ms = float(
             deadline_ms if deadline_ms is not None
@@ -86,6 +112,19 @@ class ServingClient:
             except (OSError, ValueError):
                 pass
         return list(self._static)
+
+    def endpoints_with_roles(self):
+        """[(endpoint, role), ...] — role is "serve" when no column is
+        published (monolith fleet, old endpoints file)."""
+        if self.endpoints_file:
+            try:
+                eps, roles = read_endpoints_doc(self.endpoints_file)
+                if eps:
+                    return list(zip(eps, roles or ["serve"] * len(eps)))
+            except (OSError, ValueError):
+                pass
+        return list(zip(self._static,
+                        self._roles or ["serve"] * len(self._static)))
 
     # -- one-shot GET helpers ------------------------------------------------
 
@@ -236,6 +275,27 @@ class ServingClient:
         except Exception:
             pass
 
+    def _abort_pair(self, endpoint, decode_ep, req_id):
+        """Disaggregated abandonment: the decode half holds the adopted
+        KV blocks, so it gets the abort FIRST; the prefill half follows
+        (its __abort__ handler also relays a cancel, so either order
+        alone would eventually converge — both sides free either way)."""
+        if decode_ep and decode_ep != endpoint:
+            self._abort(decode_ep, req_id)
+        self._abort(endpoint, req_id)
+
+    def _gen_candidates(self):
+        """(endpoint, role) pairs eligible for __generate__: prefill-role
+        replicas when the fleet publishes a role column (the pair var
+        then routes the stream to a decode half), every non-decode
+        endpoint otherwise (decode replicas only as a last resort — they
+        can still serve monolith traffic)."""
+        cand = self.endpoints_with_roles()
+        pf = [(e, r) for e, r in cand if r == "prefill"]
+        if pf:
+            return pf
+        return [(e, r) for e, r in cand if r != "decode"] or cand
+
     def generate(self, model, prompt_ids, max_new_tokens=16,
                  deadline_ms=None, eos_id=-1, stream=True, on_token=None,
                  max_attempts=None, tier=None):
@@ -267,29 +327,47 @@ class ServingClient:
         last_err, last_reply = None, None
         sheds = 0
         shed_cap = int(_flag("serving_client_shed_retries") or 0)
-        eps = self.endpoints()
-        attempts = int(max_attempts or max(2 * len(eps), 2) + shed_cap)
+        cand = self._gen_candidates()
+        attempts = int(max_attempts or max(2 * len(cand), 2) + shed_cap)
         for i in range(attempts):
             if i:
                 self.failovers += 1
                 time.sleep(min(0.05 * i, 0.5))
-                eps = self.endpoints()
-            if not eps:
+                cand = self._gen_candidates()
+            if not cand:
                 last_err = "endpoints file empty"
                 continue
-            ep = eps[self._rr % len(eps)]
+            ep, ep_role = cand[self._rr % len(cand)]
             self._rr += 1
             chunk_times = []
+            decode_ep = None
             try:
                 c = RpcClient(ep, connect_timeout=2.0,
                               rpc_deadline=get_timeout, retry_times=0)
+                dc = None
                 try:
                     with _tr.activate(root):
                         c.send_var(codec.GEN_KEY + req_id, payload)
+                        reader = c
+                        if ep_role == "prefill":
+                            # pair routing hint (always published by a
+                            # prefill replica): the stream and reply
+                            # come from the decode half, or from this
+                            # connection when the hint is None (no live
+                            # decode peer — monolith fallback)
+                            pm, _ = codec.unpack(c.get_var(
+                                codec.PAIR_KEY + req_id))
+                            decode_ep = pm.get("decode")
+                            if decode_ep:
+                                dc = RpcClient(decode_ep,
+                                               connect_timeout=2.0,
+                                               rpc_deadline=get_timeout,
+                                               retry_times=0)
+                                reader = dc
                         if stream:
                             k = 0
                             while True:
-                                cm, _ = codec.unpack(c.get_var(
+                                cm, _ = codec.unpack(reader.get_var(
                                     "%s%s:%d" % (codec.STREAM_KEY,
                                                  req_id, k)))
                                 if cm.get("token") is not None:
@@ -302,12 +380,22 @@ class ServingClient:
                                     break
                                 k += 1
                         meta, arrays = codec.unpack(
-                            c.get_var(codec.REPLY_KEY + req_id))
+                            reader.get_var(codec.REPLY_KEY + req_id))
                 finally:
                     c.close()
+                    if dc is not None:
+                        dc.close()
             except ConnectionError as e:
                 last_err = str(e)
-                self._abort(ep, req_id)  # free the abandoned prefill
+                # free the abandoned sequence on BOTH halves of a
+                # disaggregated pair (the decode side holds the blocks),
+                # then replay under a fresh req_id — the abort publishes
+                # a terminal reply under the old one, which a retry that
+                # lands on the same endpoint would read as its own
+                self._abort_pair(ep, decode_ep, req_id)
+                req_id = uuid.uuid4().hex
+                meta_req["req_id"] = req_id
+                payload = codec.pack(meta_req, [prompt])
                 continue
             reply = InferReply(
                 meta.get("status", "error"),
@@ -329,7 +417,10 @@ class ServingClient:
             if reply.status == "timeout" and i + 1 < attempts:
                 last_err = "server timeout: %s" % reply.error
                 last_reply = reply
-                self._abort(ep, req_id)
+                self._abort_pair(ep, decode_ep, req_id)
+                req_id = uuid.uuid4().hex
+                meta_req["req_id"] = req_id
+                payload = codec.pack(meta_req, [prompt])
                 continue
             if reply.status == "shed" and sheds < shed_cap \
                     and i + 1 < attempts:

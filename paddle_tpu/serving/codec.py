@@ -46,6 +46,34 @@ Control-plane keys (PR 16):
                            (start/flip/abort/status); the reply lands on
                            ``__reply__:<id>`` like any request
 
+Disaggregated prefill/decode keys (PR 17):
+
+  ``__kvxfer__:<id>``      prefill -> decode sealed-KV-block stream, one
+                           frame per sealed block plus bracketing control
+                           frames, all sent on one FIFO connection so
+                           arrival order == send order.  Frame kinds
+                           (meta ``kind``): "expect" (req announced, arms
+                           the orphan janitor), "block" (payload arrays:
+                           k/v [L, block, H, D] in the pool's residency
+                           dtype, plus k/v scales [L, block, H] when
+                           int8; meta carries the hash-chain ``pos`` and
+                           ``digest``), "commit" (full prompt + decode
+                           params + prefill-side phase timings; the
+                           decode replica submits from here), "cancel"
+                           (prefill-side abort/shed/timeout: the decode
+                           half frees any adopted blocks and publishes
+                           the terminal reply).  Packed by
+                           ``pack_kvxfer`` and validated LOUDLY by
+                           ``unpack_kvxfer`` — a truncated frame or a
+                           hash-chain position mismatch raises instead
+                           of adopting garbage into the KV pool.
+  ``__pair__:<req_id>``    prefill-replica-published routing hint: meta
+                           {"decode": "host:port" | None}.  The client
+                           GETs it right after ``__generate__`` and walks
+                           ``__stream__``/``__reply__`` on the decode
+                           half; None means the replica serves the
+                           request itself (monolith fallback).
+
 Requests carry their SLO tier in the meta under ``TIER`` ("paid" /
 "free" / "batch"); the engine's deadline-weighted admission sheds
 low-weight tiers first under overload, counted per tier in
@@ -62,10 +90,12 @@ import json
 
 import numpy as np
 
-__all__ = ["pack", "unpack", "INFER_KEY", "REPLY_KEY", "SPEC_KEY",
+__all__ = ["pack", "unpack", "pack_kvxfer", "unpack_kvxfer",
+           "INFER_KEY", "REPLY_KEY", "SPEC_KEY",
            "ALIVE_KEY", "GEN_KEY", "STREAM_KEY", "ABORT_KEY",
            "RETIRE_KEY", "ROLLOUT_KEY", "ROLLOUT_SET_KEY",
-           "ROLLOUT_CTL_KEY", "TRACEPARENT", "TIER"]
+           "ROLLOUT_CTL_KEY", "KVXFER_KEY", "PAIR_KEY",
+           "TRACEPARENT", "TIER"]
 
 INFER_KEY = "__infer__:"
 REPLY_KEY = "__reply__:"
@@ -82,6 +112,10 @@ RETIRE_KEY = "__retire__"
 ROLLOUT_KEY = "__rollout__"
 ROLLOUT_SET_KEY = "__rollout_set__"
 ROLLOUT_CTL_KEY = "__rollout_ctl__:"
+# disaggregated serving: sealed-KV-block transfer frames (prefill ->
+# decode) and the per-request pair-routing hint the client GETs
+KVXFER_KEY = "__kvxfer__:"
+PAIR_KEY = "__pair__:"
 # meta key carrying the W3C-style trace context across the wire
 TRACEPARENT = "traceparent"
 # meta key carrying the request's SLO tier (paid|free|batch)
@@ -116,3 +150,90 @@ def unpack(arr):
                    .reshape(shape).copy())
         off += n
     return head["meta"], out
+
+
+# -- sealed-KV-block transfer frames ------------------------------------------
+#
+# KV payloads are adopted straight into a decode replica's paged pool, so
+# unlike the best-effort request path these frames are validated loudly:
+# a frame whose byte count disagrees with its header (truncation,
+# mid-write connection loss) or whose hash-chain position is not the one
+# the receiver expects raises ValueError instead of quietly corrupting
+# the pool.  ``kvxfer`` magic + declared payload length make both checks
+# cheap and unambiguous.
+
+_KVXFER_KINDS = ("expect", "block", "commit", "cancel")
+
+
+def pack_kvxfer(meta, arrays=()):
+    """Pack one transfer frame.  ``meta`` must carry ``kind`` (one of
+    expect|block|commit|cancel) and ``req_id``; block frames additionally
+    ``pos`` (hash-chain block index) and ``digest`` (sha256 hex)."""
+    kind = meta.get("kind")
+    if kind not in _KVXFER_KINDS:
+        raise ValueError("kvxfer frame kind must be one of %s, got %r"
+                         % ("|".join(_KVXFER_KINDS), kind))
+    if not meta.get("req_id"):
+        raise ValueError("kvxfer frame meta wants a req_id")
+    if kind == "block":
+        pos = meta.get("pos")
+        if not isinstance(pos, int) or pos < 0:
+            raise ValueError("kvxfer block frame wants pos >= 0, got %r"
+                             % (pos,))
+        digest = meta.get("digest")
+        if not (isinstance(digest, str) and len(digest) == 64):
+            raise ValueError("kvxfer block frame wants a sha256 hex "
+                             "digest, got %r" % (digest,))
+    arrays = [np.ascontiguousarray(a) for a in arrays]
+    m = dict(meta)
+    m["kvxfer"] = 1
+    m["payload_bytes"] = int(sum(a.nbytes for a in arrays))
+    return pack(m, arrays)
+
+
+def unpack_kvxfer(arr, expect_pos=None):
+    """Inverse of pack_kvxfer with loud validation.
+
+    Raises ValueError on anything short of a byte-exact frame: missing
+    kvxfer magic, a declared payload length that disagrees with the
+    actual byte count (truncated frame), or — when ``expect_pos`` is
+    given — a block frame whose hash-chain ``pos`` is not the expected
+    next position (out-of-order / dropped frame on the stream)."""
+    buf = np.ascontiguousarray(np.asarray(arr, dtype=np.uint8)).tobytes()
+    if len(buf) < 8:
+        raise ValueError("kvxfer frame truncated: %d bytes is shorter "
+                         "than the 8-byte header length" % len(buf))
+    hlen = int.from_bytes(buf[:8], "little")
+    if 8 + hlen > len(buf):
+        raise ValueError("kvxfer frame truncated: header wants %d bytes,"
+                         " frame holds %d" % (8 + hlen, len(buf)))
+    try:
+        head = json.loads(buf[8:8 + hlen].decode("utf-8"))
+        meta, arrays = head["meta"], head["arrays"]
+    except Exception as e:
+        raise ValueError("kvxfer frame header unreadable: %s" % e)
+    if meta.get("kvxfer") != 1:
+        raise ValueError("not a kvxfer frame (missing kvxfer magic)")
+    declared = int(meta.get("payload_bytes", -1))
+    actual = len(buf) - 8 - hlen
+    if declared != actual:
+        raise ValueError("kvxfer frame truncated: header declares %d "
+                         "payload bytes, frame holds %d"
+                         % (declared, actual))
+    want = 0
+    for spec in arrays:
+        dt = np.dtype(spec["dtype"])
+        shape = tuple(spec["shape"])
+        want += dt.itemsize * int(np.prod(shape, dtype=np.int64)) \
+            if shape else dt.itemsize
+    if want != actual:
+        raise ValueError("kvxfer frame truncated: array specs want %d "
+                         "bytes, frame holds %d" % (want, actual))
+    if expect_pos is not None and meta.get("kind") == "block" \
+            and int(meta.get("pos", -1)) != int(expect_pos):
+        raise ValueError("kvxfer hash-chain position mismatch: got pos="
+                         "%r, expected %d (block stream for req %s is "
+                         "out of order)"
+                         % (meta.get("pos"), expect_pos,
+                            meta.get("req_id")))
+    return unpack(arr)

@@ -1,0 +1,345 @@
+"""Disaggregated prefill/decode serving: sealed-KV-block streaming.
+
+A prefill-role replica runs admission + chunked prefill only.  As each
+full prompt block seals (engine hook ``on_block_sealed``) its payload is
+copied off the carry and streamed to the paired decode-role replica as a
+``__kvxfer__`` frame; when the feed pointer reaches the last full-block
+boundary (``on_handoff``) a *commit* frame follows carrying the full
+prompt + decode params + prefill-side phase timings.  The decode replica
+adopts each block into its own refcounted pool via the prefix index
+(``DecodeEngine.adopt_kv_block``: allocate, install payload, publish
+digest, park evictable) and then the commit frame's ordinary ``submit``
+prefix-matches the adopted blocks exactly like a locally-computed cache
+hit — generation runs through the existing engine unchanged, and outputs
+stay bitwise equal to a monolith because prefill's compiled step is
+deterministic: a transferred block is identical to the one the decode
+replica would have computed itself (for f32 AND int8 residency — the
+wire dtype follows the pool dtype).
+
+Handoff state machine (sender side, per request):
+
+  ``prefill``    registered, engine feeding the prompt
+  ``streaming``  >= 1 sealed-block frame queued/sent
+  ``adopted``    commit frame sent — the decode half owns the request
+
+Reconciliation rules (a kill on either side frees blocks on both):
+
+- prefill-side terminal without handoff (abort / shed / timeout /
+  error): a ``cancel`` frame relays the reply to the decode half, which
+  forgets the adopted digests (``forget_adopted`` truly frees
+  still-evictable blocks) and publishes the terminal reply/stream chunk
+  so the parked client unblocks.
+- prefill replica SIGKILLed mid-transfer: the decode half's orphan
+  janitor notices an uncommitted adoption whose prefill endpoint stopped
+  answering ``__alive__`` probes, frees the adopted blocks, and
+  publishes a "timeout" reply — the client's ordinary timeout-replay
+  path takes over (zero admitted requests dropped).
+- decode half dies: the client's stream GET raises, and its failover
+  best-effort ``__abort__``s BOTH halves before replaying (the
+  satellite-2 leak fix).
+
+Transfers dedupe per peer: the sender keeps a recently-shipped digest
+LRU per decode endpoint, so a warm decode replica skips the wire
+entirely (the receiver additionally skips digests already indexed —
+"cached" adoption).  Every skipped or rejected transfer is safe: the
+commit frame carries the full prompt, so the decode engine simply
+recomputes whatever prefix it does not hold.
+
+``kv_xfer_bytes_total{dtype}`` counts full frame bytes per wire dtype —
+the int8-residency fleet must move <= 0.55x the bytes of the f32 fleet
+on the same traffic.
+"""
+
+import threading
+import time
+from collections import OrderedDict, deque
+
+from ..core import telemetry as _tm
+from ..core import tracing as _tr
+from ..native.rpc import RpcClient, probe
+from . import codec
+
+__all__ = ["KVBlockSender", "AdoptTracker"]
+
+# per-peer recently-shipped digest LRU: bounds sender memory while
+# keeping the warm-peer skip effective across far more digests than any
+# smoke-sized pool holds
+_SHIPPED_CAP = 4096
+# uncommitted adoptions younger than this are never probed (normal
+# prefill queueing easily spans a few hundred ms)
+_ORPHAN_GRACE_S = 2.0
+
+
+class KVBlockSender:
+    """Prefill-side worker: one FIFO + one thread serializes every frame
+    per process, so a request's expect -> block(pos 0..n) -> commit order
+    is preserved on the wire (frames ride send_var, which completes only
+    after the receiver queued the event)."""
+
+    def __init__(self):
+        self._q = deque()
+        self._cond = threading.Condition()
+        self._clients = {}              # endpoint -> RpcClient
+        self._shipped = {}              # endpoint -> OrderedDict(digest)
+        self._reqs = {}                 # req_id -> {"peer", "state", ...}
+        self._running = True
+        self._thread = threading.Thread(target=self._run,
+                                        name="kvxfer-send", daemon=True)
+        self._thread.start()
+
+    # -- registry ------------------------------------------------------------
+
+    def register(self, req_id, peer, model, wire_dtype):
+        with self._cond:
+            self._reqs[req_id] = {"peer": peer, "state": "prefill",
+                                  "model": model, "dtype": wire_dtype}
+
+    def peer_of(self, req_id):
+        with self._cond:
+            e = self._reqs.get(req_id)
+            return e["peer"] if e else None
+
+    def state_of(self, req_id):
+        with self._cond:
+            e = self._reqs.get(req_id)
+            return e["state"] if e else None
+
+    # -- frame producers (engine hooks / server) -----------------------------
+
+    def send_expect_now(self, req_id, meta):
+        """Synchronous expect frame, sent on the caller's thread BEFORE
+        the pair var is published: once a client can learn the pair, the
+        decode half already knows the request exists (arms the orphan
+        janitor).  Returns False when the peer is unreachable — the
+        caller falls back to serving the request itself."""
+        with self._cond:
+            e = self._reqs.get(req_id)
+        if e is None:
+            return False
+        m = dict(meta)
+        m.update(kind="expect", req_id=req_id)
+        return self._send(e["peer"], req_id, m, ())
+
+    def enqueue_block(self, req_id, pos, digest, arrays):
+        with self._cond:
+            e = self._reqs.get(req_id)
+            if e is None:
+                return
+            if e["state"] == "prefill":
+                e["state"] = "streaming"
+            peer = e["peer"]
+            shipped = self._shipped.setdefault(peer, OrderedDict())
+            if digest in shipped:
+                shipped.move_to_end(digest)
+                _tm.inc("kv_xfer_skipped_total", dtype=e["dtype"])
+                return          # warm peer: skip the wire entirely
+            shipped[digest] = True
+            while len(shipped) > _SHIPPED_CAP:
+                shipped.popitem(last=False)
+            meta = {"kind": "block", "req_id": req_id, "pos": int(pos),
+                    "digest": digest, "model": e["model"],
+                    "dtype": e["dtype"]}
+            self._q.append((peer, req_id, meta, list(arrays)))
+            self._cond.notify_all()
+
+    def enqueue_commit(self, req_id, meta):
+        with self._cond:
+            e = self._reqs.get(req_id)
+            if e is None:
+                return
+            m = dict(meta)
+            m.update(kind="commit", req_id=req_id)
+            self._q.append((e["peer"], req_id, m, ()))
+            self._cond.notify_all()
+
+    def enqueue_cancel(self, req_id, reply_meta):
+        """Prefill-side terminal without handoff: drop this request's
+        queued frames and relay the reply so the decode half frees its
+        adoptions and unblocks the parked client."""
+        with self._cond:
+            e = self._reqs.pop(req_id, None)
+            if e is None:
+                return
+            self._q = deque(f for f in self._q if f[1] != req_id)
+            meta = {"kind": "cancel", "req_id": req_id,
+                    "reply": dict(reply_meta or {})}
+            self._q.append((e["peer"], req_id, meta, ()))
+            self._cond.notify_all()
+
+    def mark_adopted(self, req_id):
+        """Commit sent: the decode half owns the request now; the entry
+        is only kept long enough for abort relays to find the peer."""
+        with self._cond:
+            e = self._reqs.get(req_id)
+            if e is not None:
+                e["state"] = "adopted"
+
+    def forget(self, req_id):
+        with self._cond:
+            self._reqs.pop(req_id, None)
+
+    # -- wire ----------------------------------------------------------------
+
+    def _client(self, peer):
+        c = self._clients.get(peer)
+        if c is None:
+            c = self._clients[peer] = RpcClient(
+                peer, connect_timeout=2.0, rpc_deadline=15.0,
+                retry_times=0)
+        return c
+
+    def _send(self, peer, req_id, meta, arrays):
+        frame = codec.pack_kvxfer(meta, arrays)
+        # write-through breadcrumb BEFORE the send: a SIGKILL mid-transfer
+        # leaves the in-flight frame named in flightrec-<pid>.json
+        _tr.note("kvxfer", frame_kind=meta["kind"], req_id=req_id,
+                 peer=peer, pos=meta.get("pos", -1),
+                 digest=meta.get("digest", "")[:16])
+        for _ in range(2):
+            try:
+                self._client(peer).send_var(
+                    codec.KVXFER_KEY + req_id, frame)
+                break
+            except Exception:
+                # poisoned/raced client: reconnect once, then give up —
+                # a lost frame only costs the decode half a recompute
+                # (and the orphan janitor covers a lost commit)
+                dead = self._clients.pop(peer, None)
+                if dead is not None:
+                    try:
+                        dead.close()
+                    except Exception:
+                        pass
+        else:
+            _tm.inc("kv_xfer_send_errors_total")
+            return False
+        if meta["kind"] == "block":
+            _tm.inc("kv_xfer_bytes_total", int(frame.nbytes),
+                    dtype=meta.get("dtype", "f32"))
+            _tm.inc("kv_xfer_blocks_total", dtype=meta.get("dtype", "f32"))
+        _tm.inc("kv_xfer_frames_total", kind=meta["kind"])
+        return True
+
+    def _run(self):
+        while True:
+            with self._cond:
+                while self._running and not self._q:
+                    self._cond.wait(0.2)
+                if not self._running and not self._q:
+                    return
+                peer, req_id, meta, arrays = self._q.popleft()
+            self._send(peer, req_id, meta, arrays)
+            if meta["kind"] == "commit":
+                self.mark_adopted(req_id)
+            elif meta["kind"] == "cancel":
+                self.forget(req_id)
+
+    def close(self):
+        with self._cond:
+            self._running = False
+            self._cond.notify_all()
+        self._thread.join(5.0)
+        for c in self._clients.values():
+            try:
+                c.close()
+            except Exception:
+                pass
+        self._clients.clear()
+
+
+class AdoptTracker:
+    """Decode-side per-request adoption state + orphan janitor.
+
+    An entry lives from the expect (or first block) frame until the
+    commit frame arrives; ``on_orphan(req_id, entry)`` fires for an
+    uncommitted entry whose prefill endpoint stops answering ``__alive__``
+    probes — the server frees the adopted digests and publishes a
+    "timeout" reply so the parked client replays instead of hanging."""
+
+    def __init__(self, on_orphan):
+        self._entries = {}
+        self._lock = threading.Lock()
+        self._on_orphan = on_orphan
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._janitor,
+                                        name="kvxfer-janitor", daemon=True)
+        self._thread.start()
+
+    def _entry(self, req_id):
+        e = self._entries.get(req_id)
+        if e is None:
+            e = self._entries[req_id] = {
+                "model": None, "digests": [], "next_pos": 0,
+                "committed": False, "t0": time.monotonic(),
+                "prefill_ep": None}
+        return e
+
+    def expect(self, req_id, meta):
+        with self._lock:
+            e = self._entry(req_id)
+            e["model"] = meta.get("model") or e["model"]
+            e["prefill_ep"] = meta.get("prefill_ep") or e["prefill_ep"]
+
+    def on_block(self, req_id, meta):
+        """Validate + record one block frame.  Returns None when the
+        frame may be adopted, else a rejection reason.  Skipped positions
+        are legal (the sender dedupes already-shipped digests); a
+        position at or below one already adopted is the loud hash-chain
+        ordering violation."""
+        pos = int(meta.get("pos", -1))
+        with self._lock:
+            e = self._entry(req_id)
+            e["model"] = meta.get("model") or e["model"]
+            if pos < e["next_pos"]:
+                return ("hash-chain position mismatch: pos=%d after "
+                        "pos=%d was already adopted" % (pos,
+                                                        e["next_pos"] - 1))
+            e["next_pos"] = pos + 1
+            e["digests"].append(meta.get("digest"))
+            return None
+
+    def commit(self, req_id):
+        """Commit arrived: the engine owns the blocks' lifecycle now
+        (matched blocks are refcounted to the sequence; unmatched ones
+        stay ordinary evictable cache entries).  Returns the entry."""
+        with self._lock:
+            e = self._entries.pop(req_id, None)
+            if e is not None:
+                e["committed"] = True
+            return e
+
+    def cancel(self, req_id):
+        """Prefill-side cancel (or orphan): drop the entry and return the
+        adopted digests to forget."""
+        with self._lock:
+            e = self._entries.pop(req_id, None)
+            return e
+
+    def _janitor(self):
+        while not self._stop.wait(1.0):
+            now = time.monotonic()
+            with self._lock:
+                stale = [(rid, dict(e)) for rid, e in self._entries.items()
+                         if not e["committed"]
+                         and now - e["t0"] > _ORPHAN_GRACE_S
+                         and e["prefill_ep"]]
+            probed = {}
+            for rid, e in stale:
+                ep = e["prefill_ep"]
+                if ep not in probed:
+                    probed[ep] = probe(ep, codec.ALIVE_KEY,
+                                       timeout=1.0) is not None
+                if probed[ep]:
+                    continue            # prefill half alive: keep waiting
+                with self._lock:
+                    gone = self._entries.pop(rid, None)
+                if gone is not None:
+                    _tm.inc("kv_xfer_orphans_total")
+                    try:
+                        self._on_orphan(rid, gone)
+                    except Exception:
+                        pass
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(3.0)

@@ -745,7 +745,8 @@ class _DecodeSeq:
                  "blocks", "table", "draft_blocks", "draft_table",
                  "n_fed", "next_tok", "out",
                  "t_admit", "t_first", "token_times", "admit_seq",
-                 "aborted", "hashes", "published", "cached_tokens")
+                 "aborted", "hashes", "published", "cached_tokens",
+                 "handoff", "prefill_upto")
 
     def __init__(self, pending, prompt, max_new, eos_id, on_token, maxb):
         self.pending = pending
@@ -771,6 +772,11 @@ class _DecodeSeq:
         self.hashes = None
         self.published = 0
         self.cached_tokens = 0
+        # disaggregated prefill role: a handoff sequence stops at
+        # prefill_upto (the last full-block boundary), streams its sealed
+        # blocks to a decode replica, and never generates a token here
+        self.handoff = False
+        self.prefill_upto = 0
 
     @property
     def in_prefill(self):
@@ -898,6 +904,14 @@ class DecodeEngine:
         self._rr_prefill = 0        # round-robin pointer (token budget)
         self.in_batch = False
         self.on_batch_boundary = None
+        # disaggregated prefill role hooks (serving/disagg.py wires them):
+        # on_block_sealed(m, seq, j, digest) fires under the step lock for
+        # every sealed full-prompt block of a handoff sequence (including
+        # prefix-cache hits at admission — a warm prefill replica still
+        # announces the digests); on_handoff(m, seq) fires once the feed
+        # pointer reaches prefill_upto, before the blocks are freed
+        self.on_block_sealed = None
+        self.on_handoff = None
 
     # -- registry ------------------------------------------------------------
 
@@ -1112,14 +1126,33 @@ class DecodeEngine:
         per = m.step_ms if m.step_ms > 0 else 1.0
         return max(per * m.kv_config.block_size, 1.0)
 
+    def handoff_prefill_upto(self, model, prompt_len):
+        """Tokens a prefill-role replica computes for this prompt: the
+        last full-block boundary below ``len(prompt)`` (the partial tail
+        block can never transfer — the prefix chain only keys FULL
+        blocks, and ``match`` caps at len-1 so the decode half always
+        computes at least one tail token itself).  0 means nothing is
+        transferable and the request should be forwarded whole."""
+        m = self._models.get(model)
+        if m is None or m.prefix is None:
+            return 0
+        bs = m.kv_config.block_size
+        return max(0, ((int(prompt_len) - 1) // bs) * bs)
+
     def submit(self, model, prompt_ids, max_new_tokens=16, tenant="default",
                deadline_ms=None, eos_id=-1, callback=None, on_token=None,
-               req_id=None, traceparent=None, tier=None):
+               req_id=None, traceparent=None, tier=None, handoff=False):
         """Enqueue one autoregressive request; returns a _Pending whose
         reply carries outputs={"tokens"} plus TTFT/ITL phases.
         ``on_token(req_id, index, token, done, status)`` fires per
         generated token (the server publishes stream chunks from it);
-        the terminal call carries token=None on non-ok completion."""
+        the terminal call carries token=None on non-ok completion.
+
+        ``handoff=True`` is the prefill-role mode: the sequence runs
+        chunked prefill up to the last full-block boundary, fires the
+        ``on_block_sealed``/``on_handoff`` hooks as blocks seal, then
+        completes with status "handoff" (never generating a token); the
+        paired decode replica owns generation."""
         deadline_ms = float(deadline_ms or self.default_deadline_ms)
         prompt_ids = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
         tier, weight = tier_weight(self.tier_weights, tier)
@@ -1166,9 +1199,19 @@ class DecodeEngine:
                 error="sequence needs %d draft KV blocks, pool holds %d"
                       % (m.draft_cache.blocks_for_tokens(total),
                          m.draft_cache.allocator.capacity)))
+        if handoff:
+            upto = self.handoff_prefill_upto(model, len(prompt_ids))
+            if upto <= 0:
+                return _early(InferReply(
+                    "error", error="nothing to hand off: prompt of %d has "
+                    "no full %d-token block below its tail"
+                    % (len(prompt_ids), m.kv_config.block_size)))
         _tm.inc("serving_decode_requests_total", model=model, tenant=tenant)
         seq = _DecodeSeq(req, prompt_ids, max_new_tokens, eos_id, on_token,
                          m.maxb)
+        if handoff:
+            seq.handoff = True
+            seq.prefill_upto = upto
         with self._cond:
             if self._draining:
                 _tm.inc("serving_shed_total", reason="draining")
@@ -1270,6 +1313,73 @@ class DecodeEngine:
                             phase="prefill" if s.in_prefill else "decode")
                     return True
         return False
+
+    # -- sealed-block adoption (the decode half of a disaggregated pair) -----
+
+    def adopt_kv_block(self, model, digest, arrays):
+        """Adopt one transferred sealed block into ``model``'s pool:
+        allocate a private block, install the payload into the carry,
+        publish it under ``digest`` and park it evictable — the commit
+        frame's ordinary ``submit`` then prefix-matches it exactly like a
+        locally-computed cache hit (refcount + hash-chain invariants come
+        from the existing machinery, not a parallel path).  Returns
+        "adopted", "cached" (digest already indexed — the warm-replica
+        skip), or "rejected:<reason>"; rejection is always safe because
+        the commit frame carries the full prompt and the engine simply
+        recomputes the prefill locally."""
+        m = self._models.get(model)
+        if m is None:
+            return "rejected:unknown model %r" % (model,)
+        if m.prefix is None:
+            return "rejected:prefix cache disabled"
+        with self._cond:
+            if m.prefix.lookup(digest) is not None:
+                _tm.inc("kv_xfer_adopt_total", result="cached",
+                        model=model)
+                return "cached"
+            got = m.cache.allocator.alloc(1)
+            if got is None:
+                _tm.inc("kv_xfer_adopt_total", result="nopool",
+                        model=model)
+                return "rejected:kv pool exhausted"
+            b = got[0]
+            try:
+                # the step holds self._cond for its whole duration, so
+                # swapping the carry here is race-free
+                m.cache.import_block(b, arrays)
+            except Exception as e:
+                m.cache.allocator.free([b])
+                _tm.inc("kv_xfer_adopt_total", result="geometry",
+                        model=model)
+                return "rejected:%s" % e
+            if not m.prefix.publish(b, digest):
+                # lost a publish race — the digest is resident anyway
+                m.cache.allocator.free([b])
+                _tm.inc("kv_xfer_adopt_total", result="cached",
+                        model=model)
+                return "cached"
+            # drop our reference: the sealed block parks evictable,
+            # resident and revivable until a matching submit arrives
+            m.cache.allocator.free([b])
+            _tm.inc("kv_xfer_adopt_total", result="adopted", model=model)
+            return "adopted"
+
+    def forget_adopted(self, model, digests):
+        """Abort reconciliation: un-index + truly free still-evictable
+        adopted blocks of a request that died on the prefill half.
+        Blocks revived in-use by a live sequence are left to their owner.
+        Returns how many index entries existed."""
+        m = self._models.get(model)
+        if m is None or m.prefix is None:
+            return 0
+        n = 0
+        with self._cond:
+            for d in digests:
+                if m.prefix.forget(d):
+                    n += 1
+        if n:
+            _tm.inc("kv_xfer_forget_total", n, model=model)
+        return n
 
     # -- decode loop ---------------------------------------------------------
 
@@ -1416,6 +1526,13 @@ class DecodeEngine:
                     s.table[:len(shared)] = shared
                     s.n_fed = cached
                     s.next_tok = s.prompt[cached]
+                if s.handoff and self.on_block_sealed is not None:
+                    # a warm prefill replica still announces prefix-hit
+                    # digests: the decode peer may be cold (the sender's
+                    # per-peer dedupe skips already-shipped ones)
+                    want = s.prefill_upto // m.kv_config.block_size
+                    for j in range(min(len(shared), want)):
+                        self.on_block_sealed(m, s, j, hashes[j])
             if s.pending.span is not None:
                 s.pending.span.annotate(cached_tokens=s.cached_tokens)
             if s.pending.qspan is not None:
@@ -1423,6 +1540,19 @@ class DecodeEngine:
                 s.pending.qspan = None
             self._active.append(s)
         _tm.set_gauge("serving_queue_depth", len(self._waiting))
+        # per-model pressure gauges ride the 1s __metrics__ republish:
+        # the role-aware autoscaler scales decode replicas on live
+        # KV-pool occupancy, routers on the prefix hit rate
+        for name, m in self._models.items():
+            alloc = m.cache.allocator
+            cap = float(alloc.capacity) or 1.0
+            _tm.set_gauge("kv_pool_occupancy", alloc.in_use / cap,
+                          model=name)
+            _tm.set_gauge("kv_pool_reclaimable_ratio",
+                          alloc.reclaimable / cap, model=name)
+            if m.prefix is not None:
+                _tm.set_gauge("prefix_cache_hit_rate", m.prefix.hit_rate(),
+                              model=name)
 
     def _ensure_block(self, seq):
         """Single-token path: cover seq's next write position."""
@@ -1475,6 +1605,35 @@ class DecodeEngine:
             j = s.published
             m.prefix.publish(s.blocks[j], s.hashes[j])
             s.published = j + 1
+            if s.handoff and self.on_block_sealed is not None \
+                    and j < s.prefill_upto // bs:
+                self.on_block_sealed(m, s, j, s.hashes[j])
+
+    def _prefill_limit(self, s):
+        """Last position this replica feeds for ``s``: the full prompt,
+        or the handoff boundary for a prefill-role sequence."""
+        return s.prefill_upto if s.handoff else len(s.prompt)
+
+    def _sweep_handoff_locked(self):
+        """Complete handoff sequences whose feed pointer reached the
+        boundary (lock held, before the step builds lanes): fire
+        ``on_handoff`` while the blocks are still owned — the hook
+        snapshots nothing, the sealed blocks were already streamed — then
+        free and finish with status "handoff" (the prefill replica's
+        terminal state; the decode half owns the client-visible reply)."""
+        for s in list(self._active):
+            if not s.handoff or s.n_fed < s.prefill_upto:
+                continue
+            m = self._model_of(s)
+            self._active.remove(s)
+            if self.on_handoff is not None:
+                try:
+                    self.on_handoff(m, s)
+                except Exception:
+                    pass
+            self._free_blocks(s)
+            self._finish(s, InferReply("handoff"))
+            _tm.inc("serving_handoff_total", model=m.name)
 
     def _plan_lanes_locked(self, chunk):
         """Token-budget prefill scheduling -> (participants, span_caps).
@@ -1503,7 +1662,7 @@ class DecodeEngine:
         for s in prefill:
             if left <= 0 or len(decode) + len(chosen) >= max_lanes:
                 break
-            span = min(chunk, len(s.prompt) - s.n_fed, left)
+            span = min(chunk, self._prefill_limit(s) - s.n_fed, left)
             caps[id(s)] = span
             left -= span
             chosen.append(s)
@@ -1558,6 +1717,9 @@ class DecodeEngine:
                 _tm.inc("serving_timeout_total", model=s.pending.model)
                 self._finish(s, InferReply(
                     "timeout", error="deadline expired mid-decode"))
+        # complete prefill-role sequences whose boundary was reached (by
+        # the previous step, or at admission via a warm prefix match)
+        self._sweep_handoff_locked()
         if not self._active:
             return True
         if m.spec_k > 0:
@@ -1678,7 +1840,8 @@ class DecodeEngine:
                 continue   # preempted by an earlier lane's allocation
             p = s.n_fed
             if s.in_prefill:
-                span = caps.get(id(s), min(width, len(s.prompt) - p))
+                span = caps.get(id(s),
+                                min(width, self._prefill_limit(s) - p))
                 spec = False
                 # the prompt chunk mirrors into the draft TAIL-ONLY: with
                 # a cached prefix p starts past it, so draft positions

@@ -52,13 +52,19 @@ def _flag(name):
     return flags.flag(name)
 
 
-def write_endpoints_file(path, epoch, endpoints, rollout=None):
+def write_endpoints_file(path, epoch, endpoints, rollout=None, roles=None):
     """Atomic (tmp + rename) so client reads never see a torn view.  The
     optional rollout doc rides along so a version flip is published in
-    the SAME epoch bump as any membership change."""
+    the SAME epoch bump as any membership change.  ``roles`` is the
+    disaggregation column: a list parallel to ``endpoints`` of
+    "serve" | "prefill" | "decode" — absent means every replica is a
+    monolith (pre-disagg files stay readable, and old clients ignore
+    the extra key)."""
     doc = {"epoch": int(epoch), "endpoints": list(endpoints)}
     if rollout:
         doc["rollout"] = rollout
+    if roles:
+        doc["roles"] = list(roles)
     tmp = "%s.tmp.%d" % (path, os.getpid())
     with open(tmp, "w") as f:
         json.dump(doc, f)
@@ -66,9 +72,18 @@ def write_endpoints_file(path, epoch, endpoints, rollout=None):
 
 
 class ServingFleet:
-    def __init__(self, rank, endpoints, server, endpoints_file=None):
+    def __init__(self, rank, endpoints, server, endpoints_file=None,
+                 roles=None):
         self.rank = int(rank)
         self.endpoints = list(endpoints)
+        # disaggregation role column, parallel to endpoints; None keeps
+        # every rank a monolith ("serve") and the published files
+        # byte-identical to the pre-disagg format
+        if roles is not None and len(roles) != len(self.endpoints):
+            raise ValueError("fleet roles column must parallel endpoints:"
+                             " %d roles for %d endpoints"
+                             % (len(roles), len(self.endpoints)))
+        self.roles = list(roles) if roles is not None else None
         self.server = server                     # ServingServer
         self.endpoints_file = endpoints_file or \
             _flag("serving_endpoints_file") or None
@@ -87,6 +102,20 @@ class ServingFleet:
 
     def is_coordinator(self):
         return self._coord_rank == self.rank
+
+    def role_of(self, rank):
+        if self.roles is None:
+            return "serve"
+        return self.roles[rank]
+
+    def live_role_endpoints(self, role):
+        """Live endpoints holding ``role`` — the prefill side's decode-
+        peer pick and the role-aware autoscaler both route through this."""
+        return [self.endpoints[r] for r in sorted(self.live)
+                if self.role_of(r) == role]
+
+    def live_role_ranks(self, role):
+        return [r for r in sorted(self.live) if self.role_of(r) == role]
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -214,9 +243,12 @@ class ServingFleet:
             self.epoch += 1
             _tm.inc("serving_fleet_evictions_total", len(dead))
             _tm.event("serving_fleet_evict", dead=dead, epoch=self.epoch,
-                      live=sorted(self.live))
-            logging.warning("[serving-fleet] epoch %d: evicted %s, "
-                            "live=%s", self.epoch, dead, sorted(self.live))
+                      live=sorted(self.live),
+                      roles=[self.role_of(r) for r in dead])
+            logging.warning("[serving-fleet] epoch %d: evicted %s (%s), "
+                            "live=%s", self.epoch, dead,
+                            ",".join(self.role_of(r) for r in dead),
+                            sorted(self.live))
             with self._lock:
                 self._pending_view = True
         publish = False
@@ -228,19 +260,28 @@ class ServingFleet:
             self._publish_view()
 
     def _publish_view(self):
-        live_eps = [self.endpoints[r] for r in sorted(self.live)]
+        ranks = sorted(self.live)
+        live_eps = [self.endpoints[r] for r in ranks]
+        live_roles = [self.role_of(r) for r in ranks] \
+            if self.roles is not None else None
         self.server.rpc.set_var(
             FLEET_VIEW,
-            np.asarray([self.epoch] + sorted(self.live), np.int64))
+            np.asarray([self.epoch] + ranks, np.int64))
         if self.endpoints_file:
             try:
                 write_endpoints_file(self.endpoints_file, self.epoch,
-                                     live_eps, rollout=self.rollout_doc)
+                                     live_eps, rollout=self.rollout_doc,
+                                     roles=live_roles)
             except OSError as e:
                 logging.warning("[serving-fleet] endpoints file write "
                                 "failed: %s", e)
         _tm.set_gauge("serving_fleet_size", len(self.live))
         _tm.set_gauge("serving_fleet_epoch", self.epoch)
+        if self.roles is not None:
+            for role in ("prefill", "decode", "serve"):
+                n = sum(1 for r in ranks if self.role_of(r) == role)
+                if n or role != "serve":
+                    _tm.set_gauge("serving_fleet_role_size", n, role=role)
 
     # -- control plane (autoscaler / rollout) --------------------------------
 
@@ -266,9 +307,10 @@ class ServingFleet:
         if self.mon is not None:
             self.mon.remove(rank)
         self.epoch += 1
-        _tm.event("serving_fleet_retire", rank=rank, epoch=self.epoch)
-        logging.warning("[serving-fleet] epoch %d: retiring rank %d",
-                        self.epoch, rank)
+        _tm.event("serving_fleet_retire", rank=rank, epoch=self.epoch,
+                  role=self.role_of(rank))
+        logging.warning("[serving-fleet] epoch %d: retiring rank %d (%s)",
+                        self.epoch, rank, self.role_of(rank))
         with self._lock:
             self._pending_view = True
         self.tick()
@@ -290,9 +332,12 @@ class ServingFleet:
         self._retiring.discard(rank)
 
     def view(self):
-        return {"epoch": self.epoch, "live": sorted(self.live),
-                "coordinator": self._coord_rank,
-                "retiring": sorted(self._retiring)}
+        v = {"epoch": self.epoch, "live": sorted(self.live),
+             "coordinator": self._coord_rank,
+             "retiring": sorted(self._retiring)}
+        if self.roles is not None:
+            v["roles"] = {r: self.role_of(r) for r in sorted(self.live)}
+        return v
 
     def stop(self):
         self._stop.set()
@@ -319,11 +364,18 @@ class AutoScaler:
     def __init__(self, metrics_fn, scale_up_fn, scale_down_fn,
                  replicas_fn, min_replicas=None, max_replicas=None,
                  up_ticks=None, down_ticks=None, cooldown=None,
-                 up_depth=None, interval_s=None):
+                 up_depth=None, interval_s=None, pressure_fn=None):
         self.metrics_fn = metrics_fn
         self.scale_up_fn = scale_up_fn
         self.scale_down_fn = scale_down_fn
         self.replicas_fn = replicas_fn
+        # role-specific pressure signal: callable(metrics) -> (pressure,
+        # idle) booleans, replacing the default queue-depth/shed-delta
+        # rule — a disaggregated fleet runs one AutoScaler per role
+        # (prefill keyed on queue depth / TTFT, decode on KV-pool
+        # occupancy / ITL) with everything else (streaks, cooldown,
+        # clamps) shared
+        self.pressure_fn = pressure_fn
 
         def _default(v, flag, cast):
             return cast(v if v is not None else _flag(flag))
@@ -369,8 +421,11 @@ class AutoScaler:
             self._cooldown -= 1
             self._up_streak = self._down_streak = 0
             return None
-        pressure = depth >= self.up_depth or shed_delta > 0.0
-        idle = depth <= 0.0 and shed_delta <= 0.0
+        if self.pressure_fn is not None:
+            pressure, idle = self.pressure_fn(m)
+        else:
+            pressure = depth >= self.up_depth or shed_delta > 0.0
+            idle = depth <= 0.0 and shed_delta <= 0.0
         if pressure:
             self._up_streak += 1
             self._down_streak = 0

@@ -281,6 +281,24 @@ class BlockAllocator:
             _tm.set_gauge("kv_blocks_in_use", len(self._owned))
             _tm.set_gauge("kv_blocks_evictable", len(self._evictable))
 
+    def discard_evictable(self, block):
+        """Truly free a zero-ref evictable block (back to the free list,
+        content dropped).  The disaggregated abort-reconciliation path:
+        blocks a decode replica adopted for a request that died on the
+        prefill half are parked evictable, and the cancel relay discards
+        them instead of waiting for allocation pressure.  Returns False
+        when the block is not currently evictable (already reclaimed, or
+        revived by a matching sequence — in-use blocks are freed by their
+        owner at finish)."""
+        with self._lock:
+            if block not in self._evictable:
+                return False
+            del self._evictable[block]
+            self._free.append(block)
+            _tm.inc("kv_block_discard_total")
+            _tm.set_gauge("kv_blocks_evictable", len(self._evictable))
+            return True
+
     def _note_high_water_locked(self):
         # evictable blocks still occupy physical pool slots
         occupied = len(self._owned) + len(self._evictable)
@@ -312,10 +330,15 @@ class PrefixCache:
     def __init__(self, allocator, block_size, namespace=""):
         self.allocator = allocator
         self.block_size = int(block_size)
+        self.namespace = str(namespace)
         self._seed = hashlib.sha256(
             ("kvprefix:%s" % namespace).encode()).digest()
         self._index = {}                 # hex digest -> physical block id
         self._lock = threading.Lock()
+        # per-model cumulative token counts behind the advertised
+        # prefix_cache_hit_rate{model=} gauge (1s __metrics__ republish)
+        self.lookup_tokens = 0
+        self.hit_tokens = 0
         allocator.on_evict = self._on_evict
 
     def chain(self, token_ids):
@@ -353,10 +376,26 @@ class PrefixCache:
                     break
                 blocks.append(b)
         cached = len(blocks) * self.block_size
+        with self._lock:
+            self.lookup_tokens += len(prompt_ids)
+            self.hit_tokens += cached
         _tm.inc("prefix_cache_lookup_tokens_total", len(prompt_ids))
         if cached:
             _tm.inc("prefix_cache_hit_tokens_total", cached)
         return blocks, cached, hashes
+
+    def hit_rate(self):
+        """Cumulative per-model hit fraction (0.0 before any lookup)."""
+        with self._lock:
+            if self.lookup_tokens <= 0:
+                return 0.0
+            return self.hit_tokens / float(self.lookup_tokens)
+
+    def lookup(self, digest):
+        """Physical block currently indexed under ``digest``, or None.
+        Takes no reference — a routing/dedupe peek, not an acquisition."""
+        with self._lock:
+            return self._index.get(digest)
 
     def publish(self, block, digest):
         """Index a freshly-filled full-prompt ``block`` under ``digest``.
@@ -369,6 +408,21 @@ class PrefixCache:
             self._index[digest] = block
             _tm.inc("prefix_cache_blocks_published_total")
             return True
+
+    def forget(self, digest):
+        """Un-index ``digest`` and truly free its block when it sits
+        zero-ref in the evictable pool (the adopted-block abort path).
+        A block revived in-use by a live sequence only loses its index
+        entry — its owner frees it at finish.  Returns True when the
+        entry existed."""
+        with self._lock:
+            b = self._index.pop(digest, None)
+        if b is None:
+            return False
+        # outside our lock mirrors the on_evict ordering (index ->
+        # allocator); discard_evictable is a no-op for in-use blocks
+        self.allocator.discard_evictable(b)
+        return True
 
     def _on_evict(self, block, tag):
         with self._lock:
@@ -462,6 +516,45 @@ class PagedKVCache:
         """How many blocks a sequence of n_tokens needs."""
         bs = self.config.block_size
         return max(1, -(-int(n_tokens) // bs))
+
+    # -- sealed-block export/import (the disaggregated transfer unit) --------
+
+    def export_block(self, block):
+        """Host copies of one physical block's slices of every carry
+        array, in carry order: ``[k, v]`` for f32 residency, ``[k, v,
+        k_scales, v_scales]`` for int8.  The wire payload IS the
+        residency payload — prefill's compiled step is deterministic, so
+        an adopted block is bitwise-identical to the one the decode
+        replica would have computed itself."""
+        import numpy as np
+
+        return [np.asarray(c[:, block]) for c in self._carry]
+
+    def import_block(self, block, arrays):
+        """Install transferred payloads into physical ``block``.  The
+        caller must hold the engine step lock (the carry is swapped
+        wholesale) and own the block at refcount 1.  Shape/dtype mismatch
+        raises — adopting a frame cut for different cache geometry would
+        corrupt every sequence that later matches the digest."""
+        import numpy as np
+
+        if len(arrays) != len(self._carry):
+            raise ValueError(
+                "kv import arity mismatch: %d arrays for a %s-dtype "
+                "carry of %d" % (len(arrays), self.config.dtype,
+                                 len(self._carry)))
+        new = []
+        for c, a in zip(self._carry, arrays):
+            a = np.asarray(a)
+            want_shape = tuple(c.shape[:1] + c.shape[2:])
+            if tuple(a.shape) != want_shape or a.dtype != c.dtype:
+                raise ValueError(
+                    "kv import geometry mismatch: got %s%s, carry wants "
+                    "%s%s (block_size/heads/head_dim/dtype must agree "
+                    "across the disaggregated pair)"
+                    % (a.dtype, tuple(a.shape), c.dtype, want_shape))
+            new.append(c.at[:, block].set(jnp.asarray(a)))
+        self._carry = tuple(new)
 
     # -- multi-token growth / rollback (the speculative-decode contract) -----
 

@@ -38,9 +38,19 @@ Chaos hooks: the named fault points ``serving.infer`` /
 armed by FLAGS_fault_spec) sit on the wire path — ``drop`` loses the
 frame, ``error`` substitutes an error reply — so serving tests inject
 faults without SIGKILLing processes.
+
+Disaggregated roles (``role=`` / serving/disagg.py): a ``prefill``-role
+replica answers ``__generate__`` by picking a decode peer, publishing
+``__pair__:<id>``, and running handoff prefill — sealed blocks stream to
+the peer as ``__kvxfer__`` frames and a commit frame delegates
+generation; a ``decode``-role replica adopts inbound blocks into its
+pool and serves the stream/reply for committed requests.  Either role
+still serves plain monolith traffic (the pair var's ``{"decode": None}``
+is the no-peers fallback).
 """
 
 import threading
+import time
 
 import numpy as np
 
@@ -56,10 +66,15 @@ _REPLY_RING = 1024
 
 
 class ServingServer:
-    def __init__(self, engine, port=0, rank=0, decode_engine=None):
+    def __init__(self, engine, port=0, rank=0, decode_engine=None,
+                 role=None, decode_peers=None):
         self.engine = engine
         self.decode_engine = decode_engine
         self.rank = int(rank)
+        self.role = role or "serve"
+        if self.role not in ("serve", "prefill", "decode"):
+            raise ValueError("serving role must be serve|prefill|decode, "
+                             "got %r" % (role,))
         self.rpc = RpcServer(port=port)
         self.port = self.rpc.port
         self.fleet = None
@@ -71,6 +86,14 @@ class ServingServer:
         self._thread = None
         self._pub_stop = None
         self._stopped = threading.Event()
+        # disaggregation state: the prefill side's sealed-block sender +
+        # req -> pair registry; the decode side's adoption tracker
+        self._decode_peers_static = list(decode_peers or [])
+        self._xfer = None              # KVBlockSender (prefill role)
+        self._adopt = None             # AdoptTracker (decode role)
+        self._pairs = {}               # req_id -> request meta (prefill)
+        self._pair_lock = threading.Lock()
+        self._pair_rr = 0
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -89,6 +112,16 @@ class ServingServer:
             for name in self.decode_engine.models():
                 self.rpc.set_var(codec.SPEC_KEY + name,
                                  codec.pack(self.decode_engine.spec(name)))
+        if self.decode_engine is not None and self.role == "prefill":
+            from .disagg import KVBlockSender
+
+            self._xfer = KVBlockSender()
+            self.decode_engine.on_block_sealed = self._on_block_sealed
+            self.decode_engine.on_handoff = self._on_handoff
+        if self.decode_engine is not None and self.role == "decode":
+            from .disagg import AdoptTracker
+
+            self._adopt = AdoptTracker(self._on_orphan)
         self.rpc.serve(True)
         if _tm.enabled():
             self._pub_stop = _tm.start_publisher(self.rpc, interval_s=1.0)
@@ -108,18 +141,27 @@ class ServingServer:
 
     def _poll_loop(self):
         while True:
-            t, name, arr = self.rpc.poll()
+            try:
+                t, name, arr = self.rpc.poll()
+            except ConnectionError:
+                return             # transport torn down under the loop
             if t == 0:
                 return
             if t != EV_SEND or name is None:
                 continue
+            if self._stopped.is_set():
+                return             # late frame raced shutdown(): drop it
             if name.startswith(codec.INFER_KEY):
                 self._on_infer(name[len(codec.INFER_KEY):], arr)
             elif name.startswith(codec.GEN_KEY):
                 self._on_generate(name[len(codec.GEN_KEY):], arr)
             elif name.startswith(codec.ABORT_KEY):
+                rid = name[len(codec.ABORT_KEY):]
                 if self.decode_engine is not None:
-                    self.decode_engine.abort(name[len(codec.ABORT_KEY):])
+                    self.decode_engine.abort(rid)
+                self._reconcile_abort(rid)
+            elif name.startswith(codec.KVXFER_KEY):
+                self._on_kvxfer(name[len(codec.KVXFER_KEY):], arr)
             elif name == codec.ROLLOUT_SET_KEY:
                 self._on_rollout_set(arr)
             elif name.startswith(codec.ROLLOUT_CTL_KEY):
@@ -187,6 +229,9 @@ class ServingServer:
             self._publish(req_id, InferReply(
                 "error", error="replica has no decode engine"))
             return
+        if self.role == "prefill" and self._try_handoff(req_id, meta,
+                                                        prompt):
+            return
         stream = bool(meta.get("stream"))
         on_token = self._stream_publisher(req_id) if stream else None
         tp = meta.get(codec.TRACEPARENT)
@@ -205,6 +250,278 @@ class ServingServer:
                     on_token=on_token,
                     callback=lambda pending: self._publish(
                         pending.req_id, pending.reply, pending))
+
+# -- disaggregated prefill/decode --------------------------------------------
+
+    def _advertised_ep(self):
+        """This replica's endpoint as decode peers should probe it."""
+        if self.fleet is not None and self.rank < len(self.fleet.endpoints):
+            return self.fleet.endpoints[self.rank]
+        return "127.0.0.1:%d" % self.port
+
+    def _pick_decode_peer(self):
+        """Round-robin over live decode-role endpoints (fleet view when
+        attached, else the static ``decode_peers`` list)."""
+        peers = []
+        if self.fleet is not None:
+            peers = self.fleet.live_role_endpoints("decode")
+        if not peers:
+            peers = list(self._decode_peers_static)
+        if not peers:
+            return None
+        self._pair_rr += 1
+        return peers[self._pair_rr % len(peers)]
+
+    def _wire_dtype(self, model):
+        m = self.decode_engine._models.get(model)
+        return m.kv_config.dtype if m is not None else "f32"
+
+    def _publish_pair(self, req_id, peer):
+        key = codec.PAIR_KEY + req_id
+        self.rpc.set_var(key, codec.pack({"decode": peer}))
+        with self._reply_lock:
+            self._reply_keys.append(key)
+            while len(self._reply_keys) > _REPLY_RING:
+                self.rpc.del_var(self._reply_keys.pop(0))
+
+    def _try_handoff(self, req_id, meta, prompt):
+        """Prefill-role admission: pick a decode peer, announce the pair,
+        and either run handoff prefill (blocks stream as they seal) or —
+        for prompts with no transferable full block — forward the commit
+        frame immediately (pure proxy).  Returns False to fall back to
+        serving the request locally (no live peer / peer unreachable);
+        the published ``{"decode": None}`` pair tells the client so."""
+        model = meta.get("model", "")
+        peer = self._pick_decode_peer()
+        if peer is not None and self._xfer is not None:
+            self._xfer.register(req_id, peer, model,
+                                self._wire_dtype(model))
+            # the expect frame goes out synchronously BEFORE the pair is
+            # visible: once a client can learn the pair, the decode half
+            # already knows the request (arms its orphan janitor)
+            if not self._xfer.send_expect_now(req_id, {
+                    "model": model,
+                    "prefill_ep": self._advertised_ep(),
+                    "deadline_ms": meta.get("deadline_ms")}):
+                self._xfer.forget(req_id)
+                peer = None
+        else:
+            peer = None
+        self._publish_pair(req_id, peer)
+        if peer is None:
+            _tm.inc("serving_handoff_fallback_total")
+            return False
+        prompt_list = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        entry = {"decode": peer, "meta": dict(meta),
+                 "prompt": prompt_list,
+                 "t_arrive": time.perf_counter()}
+        with self._pair_lock:
+            self._pairs[req_id] = entry
+            while len(self._pairs) > _REPLY_RING:
+                self._pairs.pop(next(iter(self._pairs)))
+        upto = self.decode_engine.handoff_prefill_upto(model,
+                                                       len(prompt_list))
+        if upto <= 0:
+            # nothing transferable below the tail: the commit frame
+            # carries the whole prompt and the decode half does all work
+            self._xfer.enqueue_commit(req_id, self._commit_meta(
+                entry, digests=[],
+                phases={"prefill_queue_wait_ms": 0.0, "prefill_ms": 0.0}))
+            return True
+        tp = meta.get(codec.TRACEPARENT)
+        with _tr.remote_parent(tp):
+            with _tr.span("serving.admission", req_id=req_id, decode=True,
+                          handoff=True, model=model, rank=self.rank):
+                self.decode_engine.submit(
+                    model, prompt_list,
+                    max_new_tokens=int(meta.get("max_new_tokens", 16)),
+                    tenant=meta.get("tenant", "default"),
+                    deadline_ms=meta.get("deadline_ms"),
+                    eos_id=int(meta.get("eos_id", -1)),
+                    req_id=req_id, traceparent=tp,
+                    tier=meta.get(codec.TIER),
+                    handoff=True, callback=self._handoff_done)
+        return True
+
+    def _commit_meta(self, entry, digests, phases):
+        meta = entry["meta"]
+        dl = meta.get("deadline_ms")
+        remaining = None
+        if dl:
+            used = (time.perf_counter() - entry["t_arrive"]) * 1e3
+            remaining = max(1.0, float(dl) - used)
+        return {"model": meta.get("model", ""), "prompt": entry["prompt"],
+                "max_new": int(meta.get("max_new_tokens", 16)),
+                "eos_id": int(meta.get("eos_id", -1)),
+                "stream": bool(meta.get("stream")),
+                "tenant": meta.get("tenant", "default"),
+                "tier": meta.get(codec.TIER),
+                "deadline_ms": remaining,
+                codec.TRACEPARENT: meta.get(codec.TRACEPARENT),
+                "digests": list(digests), "phases": dict(phases),
+                "sent_unix": time.time(),
+                "prefill_ep": self._advertised_ep()}
+
+    def _on_block_sealed(self, m, s, j, digest):
+        """Engine hook (step lock held): copy the sealed block's payload
+        off the carry and queue the transfer frame."""
+        if self._xfer is None:
+            return
+        try:
+            arrays = m.cache.export_block(s.blocks[j])
+        except Exception:
+            _tm.inc("kv_xfer_send_errors_total")
+            return
+        self._xfer.enqueue_block(s.pending.req_id, j, digest, arrays)
+
+    def _on_handoff(self, m, s):
+        """Engine hook (step lock held): the feed pointer reached the
+        boundary — queue the commit frame with prefill-side phases."""
+        rid = s.pending.req_id
+        with self._pair_lock:
+            entry = self._pairs.get(rid)
+        if entry is None or self._xfer is None:
+            return
+        now = time.perf_counter()
+        t_admit = s.t_admit if s.t_admit is not None else now
+        phases = {
+            "prefill_queue_wait_ms": round(
+                (t_admit - s.pending.t_submit) * 1e3, 3),
+            "prefill_ms": round((now - t_admit) * 1e3, 3),
+            "prefill_cached_tokens": s.cached_tokens}
+        bs = m.kv_config.block_size
+        digests = list(s.hashes[:s.prefill_upto // bs]) if s.hashes else []
+        self._xfer.enqueue_commit(rid, self._commit_meta(entry, digests,
+                                                         phases))
+
+    def _handoff_done(self, pending):
+        """Prefill-side completion callback: "handoff" means the commit
+        frame already went out; any other terminal (shed / abort /
+        timeout / error) relays a cancel so the decode half frees its
+        adoptions and publishes the reply the client is parked on."""
+        if pending.reply.status == "handoff":
+            return
+        self._relay_cancel(pending.req_id, pending.reply.to_meta())
+
+    def _relay_cancel(self, rid, reply_meta):
+        with self._pair_lock:
+            entry = self._pairs.pop(rid, None)
+        if entry is not None and self._xfer is not None:
+            self._xfer.enqueue_cancel(rid, reply_meta)
+
+    def _reconcile_abort(self, rid):
+        """A client ``__abort__`` frees blocks on BOTH halves: the
+        prefill side relays a cancel to its pair's decode half; the
+        decode side forgets any uncommitted adoptions."""
+        self._relay_cancel(rid, {"status": "aborted",
+                                 "error": "aborted by client"})
+        if self._adopt is not None:
+            entry = self._adopt.cancel(rid)
+            if entry is not None and entry["digests"] \
+                    and self.decode_engine is not None:
+                self.decode_engine.forget_adopted(entry["model"],
+                                                  entry["digests"])
+
+    def _tracker(self):
+        if self._adopt is None:
+            from .disagg import AdoptTracker
+
+            self._adopt = AdoptTracker(self._on_orphan)
+        return self._adopt
+
+    def _on_kvxfer(self, req_id, arr):
+        if self.decode_engine is None:
+            return
+        try:
+            meta, arrays = codec.unpack_kvxfer(arr)
+        except ValueError as e:
+            _tm.inc("kv_xfer_rejected_total", reason="frame")
+            _tr.note("kvxfer_reject", req_id=req_id, error=str(e)[:200])
+            return
+        kind = meta.get("kind")
+        tracker = self._tracker()
+        if kind == "expect":
+            tracker.expect(req_id, meta)
+        elif kind == "block":
+            err = tracker.on_block(req_id, meta)
+            if err is not None:
+                _tm.inc("kv_xfer_rejected_total", reason="position")
+                _tr.note("kvxfer_reject", req_id=req_id, error=err)
+                return
+            self.decode_engine.adopt_kv_block(
+                meta.get("model", ""), meta["digest"], arrays)
+        elif kind == "commit":
+            self._on_commit(req_id, meta)
+        elif kind == "cancel":
+            entry = tracker.cancel(req_id)
+            if entry is not None and entry["digests"]:
+                self.decode_engine.forget_adopted(entry["model"],
+                                                  entry["digests"])
+            self._publish_cancel(req_id, meta.get("reply") or {})
+
+    def _on_commit(self, req_id, meta):
+        """Commit frame: submit through the ordinary engine path — the
+        adopted blocks are found by the admission-time prefix match like
+        any warm-cache hit — and merge the prefill-side phases into the
+        reply so loadgen can attribute TTFT per role."""
+        self._tracker().commit(req_id)
+        model = meta.get("model", "")
+        stream = bool(meta.get("stream"))
+        on_token = self._stream_publisher(req_id) if stream else None
+        extra = dict(meta.get("phases") or {})
+        sent = meta.get("sent_unix")
+        if sent:
+            extra["xfer_ms"] = round(
+                max(0.0, (time.time() - float(sent)) * 1e3), 3)
+        extra["role"] = "disagg"
+        tp = meta.get(codec.TRACEPARENT)
+
+        def cb(pending):
+            rep = pending.reply
+            rep.phases.update(extra)
+            self._publish(pending.req_id, rep, pending)
+
+        with _tr.remote_parent(tp):
+            with _tr.span("serving.adopt_commit", req_id=req_id,
+                          model=model, rank=self.rank):
+                self.decode_engine.submit(
+                    model, meta.get("prompt") or [],
+                    max_new_tokens=int(meta.get("max_new", 16)),
+                    tenant=meta.get("tenant", "default"),
+                    deadline_ms=meta.get("deadline_ms"),
+                    eos_id=int(meta.get("eos_id", -1)),
+                    req_id=req_id, traceparent=tp,
+                    tier=meta.get("tier"),
+                    on_token=on_token, callback=cb)
+
+    def _publish_cancel(self, req_id, reply_meta):
+        from .engine import InferReply
+
+        status = reply_meta.get("status") or "aborted"
+        if status in ("ok", "handoff"):
+            status = "error"
+        rep = InferReply(status, error=reply_meta.get("error"),
+                         retry_after_ms=reply_meta.get("retry_after_ms")
+                         or 0.0)
+        # unblock a parked streaming client, then publish the reply
+        self._stream_publisher(req_id)(req_id, 0, None, True, rep.status)
+        self._publish(req_id, rep)
+
+    def _on_orphan(self, rid, entry):
+        """Janitor verdict: the prefill half died before committing this
+        request.  Free the adopted blocks and publish a timeout so the
+        client's ordinary replay path takes over — no admitted request is
+        ever dropped by a prefill SIGKILL."""
+        from .engine import InferReply
+
+        if entry.get("digests") and self.decode_engine is not None:
+            self.decode_engine.forget_adopted(entry.get("model") or "",
+                                              entry["digests"])
+        _tr.note("kvxfer_orphan", req_id=rid)
+        self._stream_publisher(rid)(rid, 0, None, True, "timeout")
+        self._publish(rid, InferReply(
+            "timeout",
+            error="prefill half died before handoff commit"))
 
     def _stream_publisher(self, req_id):
         """Per-token chunk publisher: ``__stream__:<id>:<k>`` carries the
@@ -327,6 +644,10 @@ class ServingServer:
         self.engine.stop()
         if self.decode_engine is not None:
             self.decode_engine.stop()
+        if self._xfer is not None:
+            self._xfer.close()
+        if self._adopt is not None:
+            self._adopt.close()
         self.rpc.shutdown()
         if self._thread is not None:
             self._thread.join(5.0)

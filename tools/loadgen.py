@@ -37,6 +37,14 @@ fingerprint of every (prompt -> output tokens) pair, so the same seeded
 traffic replayed with speculation on and off can assert bitwise-equal
 output next to the tokens/sec comparison.
 
+Against a disaggregated fleet (reply phases carry ``role: disagg``) the
+report gains a ``role_phases`` block splitting the pipeline per role:
+prefill-side queue wait + prefill compute, the sealed-block transfer
+hop (``xfer_ms``), and the decode half's queue wait + execute — so a
+TTFT p99 regression attributes to the prefill queue and an ITL p99
+regression to the decode side, per the disagg capture protocol in
+BASELINE.md.
+
 ``--tier-mix paid:0.35,free:0.65`` stamps each request with a sampled
 SLO tier (the engine's deadline-weighted admission sheds low tiers
 first); the report gains a per-tier breakdown with ``server_ms_p99``
@@ -185,6 +193,15 @@ def main(argv=None):
     lock = threading.Lock()
     latencies, statuses = [], {}
     phase_samples = {"queue_wait_ms": [], "execute_ms": [], "wire_ms": []}
+    # disaggregated replies attribute their phases per role: the prefill
+    # half stamps prefill_queue_wait_ms/prefill_ms, the transfer hop
+    # xfer_ms, and the standard queue_wait_ms/execute_ms then belong to
+    # the DECODE half (reply phases carry role=disagg) — so a TTFT p99
+    # regression localizes to prefill queueing, the stream, or decode
+    role_phase = {"prefill_queue_wait_ms": [], "prefill_ms": [],
+                  "xfer_ms": []}
+    decode_phase = {"queue_wait_ms": [], "execute_ms": []}
+    disagg_n = [0]
     ttfts, itls, tokens_out = [], [], [0]
     cached_toks, prompt_toks = [0], [0]   # client-side exact hit rate
     out_map = {}    # prompt tuple -> generated tokens (greedy => unique)
@@ -237,6 +254,16 @@ def main(argv=None):
                     v = r.phases.get(ph)
                     if v is not None:
                         xs.append(float(v))
+                if r.phases.get("role") == "disagg":
+                    disagg_n[0] += 1
+                    for ph, xs in role_phase.items():
+                        v = r.phases.get(ph)
+                        if v is not None:
+                            xs.append(float(v))
+                    for ph, xs in decode_phase.items():
+                        v = r.phases.get(ph)
+                        if v is not None:
+                            xs.append(float(v))
                 if decode:
                     toks = list(int(t) for t in
                                 r.outputs.get("tokens", ()))
@@ -343,6 +370,32 @@ def main(argv=None):
     }
     if versions:
         report["versions"] = versions
+    if disagg_n[0]:
+        report["role_phases"] = {
+            "disagg_requests": disagg_n[0],
+            "prefill": {
+                "queue_wait_ms_p50": round(percentile(
+                    role_phase["prefill_queue_wait_ms"], 0.50), 3),
+                "queue_wait_ms_p99": round(percentile(
+                    role_phase["prefill_queue_wait_ms"], 0.99), 3),
+                "prefill_ms_p50": round(percentile(
+                    role_phase["prefill_ms"], 0.50), 3),
+                "prefill_ms_p99": round(percentile(
+                    role_phase["prefill_ms"], 0.99), 3)},
+            "xfer": {
+                "xfer_ms_p50": round(percentile(
+                    role_phase["xfer_ms"], 0.50), 3),
+                "xfer_ms_p99": round(percentile(
+                    role_phase["xfer_ms"], 0.99), 3)},
+            "decode": {
+                "queue_wait_ms_p50": round(percentile(
+                    decode_phase["queue_wait_ms"], 0.50), 3),
+                "queue_wait_ms_p99": round(percentile(
+                    decode_phase["queue_wait_ms"], 0.99), 3),
+                "execute_ms_p50": round(percentile(
+                    decode_phase["execute_ms"], 0.50), 3),
+                "execute_ms_p99": round(percentile(
+                    decode_phase["execute_ms"], 0.99), 3)}}
     if tier_stats:
         report["tiers"] = {
             t: {"requests": ts["requests"], "ok": ts["ok"],

@@ -115,7 +115,10 @@ def main(argv=None):
                     "serving_decode_* / serving_tokens_generated_total, "
                     "speculative-decode spec_* counters and acceptance "
                     "histogram, prefix_cache_* hit/publish/eviction "
-                    "counters, and the decode_batch_occupancy histogram")
+                    "counters, the decode_batch_occupancy histogram, "
+                    "disaggregated sealed-block transfer counters "
+                    "(kv_xfer_*, serving_handoff_fallback_total) and the "
+                    "kv_pool_occupancy / prefix_cache_hit_rate gauges")
     ap.add_argument("--tracing", action="store_true", dest="tracing_only",
                     help="show only distributed-tracing health metrics: "
                     "tracing_records_total{kind} and "
@@ -165,7 +168,8 @@ def main(argv=None):
                                    "kv_blocks_in_use", "serving_decode_",
                                    "serving_tokens_", "serving_abort_",
                                    "decode_batch_occupancy", "spec_",
-                                   "prefix_cache_"))
+                                   "prefix_cache_", "kv_xfer_", "kv_pool_",
+                                   "serving_handoff_"))
     if args.tracing_only:
         snap = _filter_snap(snap, "tracing_")
     if args.ckpt_only:

@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI harness (reference paddle/scripts/paddle_build.sh analog): build the
 # native pieces, run the full test pyramid, smoke the bench + graft entry.
-# Usage: tools/run_ci.sh [quick|full|tpu|--layout-smoke|--obs-smoke|--lint|--elastic-smoke|--zero1-smoke|--cache-smoke|--kernel-smoke|--serve-smoke|--trace-smoke|--decode-smoke|--ckpt-smoke]
+# Usage: tools/run_ci.sh [quick|full|tpu|--layout-smoke|--obs-smoke|--lint|--elastic-smoke|--zero1-smoke|--cache-smoke|--kernel-smoke|--serve-smoke|--trace-smoke|--decode-smoke|--disagg-smoke|--ckpt-smoke]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -678,6 +678,260 @@ if ratio > 0.7:
 EOF
   rm -rf "$DEC_DIR"
   echo "CI --decode-smoke: PASS"
+  exit 0
+fi
+
+if [ "$MODE" = "--disagg-smoke" ]; then
+  # disaggregated prefill/decode leg: the __kvxfer__ codec / handoff /
+  # reconciliation unit tests, then a 2-prefill+2-decode fleet replaying
+  # the decode leg's round-15 mixed burst against a 4-monolith twin —
+  # bitwise-equal outputs_sha256 is the hard gate, the per-role phase
+  # p99s print beside it (TTFT/ITL p99 over ~1.10x the monolith twin
+  # degrades to a loud SKIP-NOTICE on a loaded CI box); then a prefill
+  # replica is SIGKILLed mid-transfer under load (zero admitted requests
+  # dropped; the victim's flight recorder must name the in-flight
+  # transfer frames); finally compact 1-prefill+1-decode pairs move the
+  # same long-prompt traffic in f32 and int8 residency — the int8 pair
+  # must be output-equal to an int8 monolith while moving <= 0.55x the
+  # f32 pair's scraped kv_xfer_bytes_total
+  echo "== disagg smoke: kvxfer codec + handoff + reconciliation tests =="
+  JAX_PLATFORMS=cpu FLAGS_static_check=error \
+    python -m pytest tests/test_disagg_serving.py -q
+  echo "== disagg smoke: 2-prefill+2-decode vs 4-monolith, same burst =="
+  DSG_DIR="$(mktemp -d)"
+  JAX_PLATFORMS=cpu python tools/serve.py --save-demo-decoder "$DSG_DIR/dec"
+  DSG_ENV=(JAX_PLATFORMS=cpu FLAGS_telemetry=1
+           FLAGS_kv_block_size=8 FLAGS_kv_cache_blocks=256
+           FLAGS_serving_hb_interval=0.2 FLAGS_serving_hb_timeout=1.5
+           FLAGS_compile_cache_dir="$DSG_DIR/cc")
+  # wait for the coordinator to publish the fleet's endpoints file —
+  # clients learn the role column from THIS file, so traffic fired
+  # before it lands would treat a handing-off prefill as a monolith
+  dsg_wait_eps() {
+    python - "$1" "$2" "$3" <<'EOF'
+import json, sys, time
+path, want_n, roles_csv = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+want_roles = [r for r in roles_csv.split(",") if r] or None
+deadline = time.time() + 30
+while time.time() < deadline:
+    try:
+        doc = json.load(open(path))
+        if len(doc.get("endpoints", [])) == want_n and \
+                (want_roles is None or doc.get("roles") == want_roles):
+            sys.exit(0)
+    except Exception:
+        pass
+    time.sleep(0.3)
+sys.exit("%s never published %d endpoints (roles=%s)"
+         % (path, want_n, roles_csv or None))
+EOF
+  }
+  MFLEET=127.0.0.1:9420,127.0.0.1:9421,127.0.0.1:9422,127.0.0.1:9423
+  for r in 0 1 2 3; do
+    env "${DSG_ENV[@]}" python tools/serve.py --model dec="$DSG_DIR/dec" \
+      --rank $r --fleet "$MFLEET" --decode-buckets 4,8 \
+      --decode-mode token --endpoints-file "$DSG_DIR/meps.json" \
+      > "$DSG_DIR/m$r.log" 2>&1 &
+    eval "M$r=\$!"
+  done
+  trap 'kill -9 $M0 $M1 $M2 $M3 2>/dev/null || true' EXIT
+  for _ in $(seq 120); do
+    grep -q READY "$DSG_DIR/m0.log" && grep -q READY "$DSG_DIR/m1.log" \
+      && grep -q READY "$DSG_DIR/m2.log" && grep -q READY "$DSG_DIR/m3.log" \
+      && break
+    sleep 1
+  done
+  grep -q READY "$DSG_DIR/m3.log"
+  dsg_wait_eps "$DSG_DIR/meps.json" 4 ""
+  JAX_PLATFORMS=cpu python tools/loadgen.py \
+    --endpoints-file "$DSG_DIR/meps.json" --model dec --requests 48 \
+    --qps 400 --prompt-mix 2,4,24 --max-new 8 --deadline-ms 60000 \
+    --retry-shed 4 --out "$DSG_DIR/BENCH_disagg_mono.json" \
+    --assert-no-drops
+  kill -9 $M0 $M1 $M2 $M3 2>/dev/null || true
+  trap - EXIT
+  DFLEET=127.0.0.1:9424,127.0.0.1:9425,127.0.0.1:9426,127.0.0.1:9427
+  for r in 0 1 2 3; do
+    env "${DSG_ENV[@]}" python tools/serve.py --model dec="$DSG_DIR/dec" \
+      --rank $r --fleet "$DFLEET" --roles prefill,prefill,decode,decode \
+      --decode-buckets 4,8 --decode-mode token \
+      --endpoints-file "$DSG_DIR/deps.json" > "$DSG_DIR/d$r.log" 2>&1 &
+    eval "D$r=\$!"
+  done
+  trap 'kill -9 $D0 $D1 $D2 $D3 2>/dev/null || true' EXIT
+  for _ in $(seq 120); do
+    grep -q READY "$DSG_DIR/d0.log" && grep -q READY "$DSG_DIR/d1.log" \
+      && grep -q READY "$DSG_DIR/d2.log" && grep -q READY "$DSG_DIR/d3.log" \
+      && break
+    sleep 1
+  done
+  grep -q READY "$DSG_DIR/d3.log"
+  dsg_wait_eps "$DSG_DIR/deps.json" 4 "prefill,prefill,decode,decode"
+  JAX_PLATFORMS=cpu python tools/loadgen.py \
+    --endpoints-file "$DSG_DIR/deps.json" --model dec --requests 48 \
+    --qps 400 --prompt-mix 2,4,24 --max-new 8 --deadline-ms 60000 \
+    --retry-shed 4 --out "$DSG_DIR/BENCH_disagg_pair.json" \
+    --assert-no-drops
+  # satellite: replicas republish the transfer counters and the
+  # per-model cache-pressure gauges over the 1 s __metrics__ publish —
+  # the role-aware autoscaler's decode signal rides kv_pool_occupancy
+  python tools/metrics_dump.py --scrape 127.0.0.1:9424 --decode \
+    | grep -c kv_xfer_blocks_total > /dev/null
+  python tools/metrics_dump.py --scrape 127.0.0.1:9426 --decode \
+    | grep -c kv_pool_occupancy > /dev/null
+  python tools/metrics_dump.py --scrape 127.0.0.1:9426 --decode \
+    | grep -c prefix_cache_hit_rate > /dev/null
+  kill -9 $D0 $D1 $D2 $D3 2>/dev/null || true
+  trap - EXIT
+  python - "$DSG_DIR/BENCH_disagg_pair.json" \
+    "$DSG_DIR/BENCH_disagg_mono.json" <<'EOF'
+import json, sys
+dis = json.load(open(sys.argv[1]))
+mono = json.load(open(sys.argv[2]))
+assert dis["outputs_sha256"] == mono["outputs_sha256"], \
+    "disagg outputs differ from the monolith twin: %s != %s" \
+    % (dis["outputs_sha256"], mono["outputs_sha256"])
+rp = dis.get("role_phases")
+assert rp and rp["disagg_requests"] > 0, \
+    "no reply carried role=disagg phase attribution: %r" % (rp,)
+print("disagg per-role p99: prefill queue %.1f ms, prefill %.1f ms, "
+      "xfer %.1f ms, decode queue %.1f ms, decode exec %.1f ms "
+      "(%d disagg requests)"
+      % (rp["prefill"]["queue_wait_ms_p99"], rp["prefill"]["prefill_ms_p99"],
+         rp["xfer"]["xfer_ms_p99"], rp["decode"]["queue_wait_ms_p99"],
+         rp["decode"]["execute_ms_p99"], rp["disagg_requests"]))
+for k in ("ttft_ms_p99", "itl_ms_p99"):
+    d, m = dis[k], mono[k]
+    ratio = d / max(m, 1e-9)
+    print("%s: disagg %.1f ms vs monolith %.1f ms -> %.2fx" % (k, d, m, ratio))
+    if ratio > 1.10:
+        # sha parity + no-drops above are the hard gates; tail latency
+        # on a loaded CI box degrades to a loud notice (the real capture
+        # lives in BASELINE.md round 17)
+        print("SKIP-NOTICE: disagg %s %.2fx > 1.10x of the monolith twin "
+              "— parity gates passed" % (k, ratio))
+print("bitwise-equal outputs OK (%d distinct prompts)"
+      % dis["outputs_distinct"])
+EOF
+  echo "== disagg smoke: SIGKILL a prefill replica mid-transfer =="
+  KFLEET=127.0.0.1:9428,127.0.0.1:9429,127.0.0.1:9430,127.0.0.1:9431
+  for r in 0 1 2 3; do
+    env "${DSG_ENV[@]}" FLAGS_tracing=1 \
+      FLAGS_telemetry_dir="$DSG_DIR/tel" \
+      python tools/serve.py --model dec="$DSG_DIR/dec" \
+      --rank $r --fleet "$KFLEET" --roles prefill,prefill,decode,decode \
+      --decode-buckets 4,8 --decode-mode token \
+      --endpoints-file "$DSG_DIR/keps.json" > "$DSG_DIR/k$r.log" 2>&1 &
+    eval "K$r=\$!"
+  done
+  trap 'kill -9 $K0 $K1 $K2 $K3 2>/dev/null || true' EXIT
+  for _ in $(seq 120); do
+    grep -q READY "$DSG_DIR/k0.log" && grep -q READY "$DSG_DIR/k1.log" \
+      && grep -q READY "$DSG_DIR/k2.log" && grep -q READY "$DSG_DIR/k3.log" \
+      && break
+    sleep 1
+  done
+  grep -q READY "$DSG_DIR/k3.log"
+  dsg_wait_eps "$DSG_DIR/keps.json" 4 "prefill,prefill,decode,decode"
+  # long prompts keep sealed-block transfers in flight when the kill
+  # lands; the surviving prefill absorbs the replays — zero drops
+  ( sleep 1; kill -9 $K0 2>/dev/null || true ) &
+  JAX_PLATFORMS=cpu python tools/loadgen.py \
+    --endpoints-file "$DSG_DIR/keps.json" --model dec --requests 96 \
+    --qps 40 --prompt-mix 24 --max-new 8 --deadline-ms 60000 \
+    --retry-shed 4 --out "$DSG_DIR/BENCH_disagg_kill.json" \
+    --assert-no-drops
+  kill -9 $K1 $K2 $K3 2>/dev/null || true
+  trap - EXIT
+  # the victim's write-through flight recorder must already name its
+  # in-flight transfer frames on disk (SIGKILL is uncatchable)
+  grep -q kvxfer "$DSG_DIR/tel/flightrec-$K0.json"
+  echo "flight recorder OK: victim flightrec-$K0.json names kvxfer frames"
+  echo "== disagg smoke: int8 wire residency, pair vs pair vs monolith =="
+  env "${DSG_ENV[@]}" python tools/serve.py --model dec="$DSG_DIR/dec" \
+    --rank 0 --fleet 127.0.0.1:9432,127.0.0.1:9433 \
+    --roles prefill,decode --decode-buckets 4,8 --decode-mode token \
+    --endpoints-file "$DSG_DIR/f32eps.json" > "$DSG_DIR/f32p.log" 2>&1 &
+  F0=$!
+  env "${DSG_ENV[@]}" python tools/serve.py --model dec="$DSG_DIR/dec" \
+    --rank 1 --fleet 127.0.0.1:9432,127.0.0.1:9433 \
+    --roles prefill,decode --decode-buckets 4,8 --decode-mode token \
+    --endpoints-file "$DSG_DIR/f32eps.json" > "$DSG_DIR/f32d.log" 2>&1 &
+  F1=$!
+  env "${DSG_ENV[@]}" FLAGS_kv_cache_dtype=int8 python tools/serve.py \
+    --model dec="$DSG_DIR/dec" \
+    --rank 0 --fleet 127.0.0.1:9434,127.0.0.1:9435 \
+    --roles prefill,decode --decode-buckets 4,8 --decode-mode token \
+    --endpoints-file "$DSG_DIR/i8eps.json" > "$DSG_DIR/i8p.log" 2>&1 &
+  I0=$!
+  env "${DSG_ENV[@]}" FLAGS_kv_cache_dtype=int8 python tools/serve.py \
+    --model dec="$DSG_DIR/dec" \
+    --rank 1 --fleet 127.0.0.1:9434,127.0.0.1:9435 \
+    --roles prefill,decode --decode-buckets 4,8 --decode-mode token \
+    --endpoints-file "$DSG_DIR/i8eps.json" > "$DSG_DIR/i8d.log" 2>&1 &
+  I1=$!
+  env "${DSG_ENV[@]}" FLAGS_kv_cache_dtype=int8 python tools/serve.py \
+    --model dec="$DSG_DIR/dec" --port 9436 --decode-buckets 4,8 \
+    --decode-mode token > "$DSG_DIR/i8m.log" 2>&1 &
+  I2=$!
+  trap 'kill -9 $F0 $F1 $I0 $I1 $I2 2>/dev/null || true' EXIT
+  for _ in $(seq 120); do
+    grep -q READY "$DSG_DIR/f32p.log" && grep -q READY "$DSG_DIR/f32d.log" \
+      && grep -q READY "$DSG_DIR/i8p.log" && grep -q READY "$DSG_DIR/i8d.log" \
+      && grep -q READY "$DSG_DIR/i8m.log" && break
+    sleep 1
+  done
+  grep -q READY "$DSG_DIR/i8m.log"
+  dsg_wait_eps "$DSG_DIR/f32eps.json" 2 "prefill,decode"
+  dsg_wait_eps "$DSG_DIR/i8eps.json" 2 "prefill,decode"
+  JAX_PLATFORMS=cpu python tools/loadgen.py \
+    --endpoints-file "$DSG_DIR/f32eps.json" --model dec --requests 24 \
+    --qps 200 --prompt-mix 24 --max-new 8 --deadline-ms 60000 \
+    --retry-shed 4 --out "$DSG_DIR/BENCH_xfer_f32.json" --assert-no-drops
+  JAX_PLATFORMS=cpu python tools/loadgen.py \
+    --endpoints-file "$DSG_DIR/i8eps.json" --model dec --requests 24 \
+    --qps 200 --prompt-mix 24 --max-new 8 --deadline-ms 60000 \
+    --retry-shed 4 --out "$DSG_DIR/BENCH_xfer_int8.json" --assert-no-drops
+  JAX_PLATFORMS=cpu python tools/loadgen.py \
+    --endpoints 127.0.0.1:9436 --model dec --requests 24 \
+    --qps 200 --prompt-mix 24 --max-new 8 --deadline-ms 60000 \
+    --retry-shed 4 --out "$DSG_DIR/BENCH_xfer_int8_mono.json" \
+    --assert-no-drops
+  # scrape the wire counters off both prefill replicas BEFORE teardown
+  python - <<'EOF'
+import time
+from paddle_tpu.core import telemetry
+time.sleep(1.2)   # one __metrics__ publish period
+def xfer_bytes(ep, dtype):
+    snap = telemetry.scrape(ep)
+    return sum(v for k, v in snap.get("counters", {}).items()
+               if k.startswith("kv_xfer_bytes_total")
+               and "dtype=%s" % dtype in k)
+f32 = xfer_bytes("127.0.0.1:9432", "f32")
+i8 = xfer_bytes("127.0.0.1:9434", "int8")
+assert f32 > 0, "f32 pair never moved a sealed block"
+assert i8 > 0, "int8 pair never moved a sealed block"
+ratio = i8 / f32
+print("kv_xfer_bytes_total: int8 %d B vs f32 %d B on the same traffic "
+      "-> %.2fx" % (i8, f32, ratio))
+assert ratio <= 0.55, \
+    "int8 wire transfer %.2fx > 0.55x of f32 bytes" % ratio
+EOF
+  kill -9 $F0 $F1 $I0 $I1 $I2 2>/dev/null || true
+  trap - EXIT
+  python - "$DSG_DIR/BENCH_xfer_int8.json" \
+    "$DSG_DIR/BENCH_xfer_int8_mono.json" <<'EOF'
+import json, sys
+pair = json.load(open(sys.argv[1]))
+mono = json.load(open(sys.argv[2]))
+assert pair["outputs_sha256"] == mono["outputs_sha256"], \
+    "int8 pair outputs differ from the int8 monolith: %s != %s" \
+    % (pair["outputs_sha256"], mono["outputs_sha256"])
+print("int8 pair == int8 monolith outputs OK (%d distinct prompts)"
+      % pair["outputs_distinct"])
+EOF
+  rm -rf "$DSG_DIR"
+  echo "CI --disagg-smoke: PASS"
   exit 0
 fi
 

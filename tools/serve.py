@@ -23,6 +23,13 @@ Usage:
         --fleet 127.0.0.1:9000,127.0.0.1:9001 --cache-dir /tmp/cc \
         --endpoints-file /tmp/eps.json --autoscale --max-replicas 2
 
+    # disaggregated prefill/decode fleet: role column parallels --fleet;
+    # prefill replicas stream sealed KV blocks to decode replicas and
+    # clients route __generate__ by the published roles
+    python tools/serve.py --model toy=/tmp/dec --rank 0 \
+        --fleet 127.0.0.1:9000,127.0.0.1:9001,127.0.0.1:9002,127.0.0.1:9003 \
+        --roles prefill,prefill,decode,decode --endpoints-file /tmp/eps.json
+
     # helper for smoke tests: save a tiny fc inference model and exit
     python tools/serve.py --save-demo-model /tmp/model
 
@@ -125,6 +132,20 @@ def main(argv=None):
                     help="draft-model speculation depth for decode "
                     "models with a bundled draft (default "
                     "FLAGS_speculative_k; 0 = off)")
+    ap.add_argument("--role", default=None,
+                    choices=("serve", "prefill", "decode"),
+                    help="disaggregated serving role for THIS replica "
+                    "(default: this rank's --roles column entry, else "
+                    "monolith \"serve\")")
+    ap.add_argument("--roles", default=None,
+                    help="comma role column parallel to --fleet "
+                    "(serve|prefill|decode per slot); the coordinator "
+                    "publishes it in the endpoints file so clients "
+                    "route __generate__ to prefill replicas")
+    ap.add_argument("--decode-peers", default=None,
+                    help="comma list of decode-role endpoints a prefill "
+                    "replica streams sealed KV blocks to when no fleet "
+                    "role column is in play (tests / static pairs)")
     ap.add_argument("--autoscale", action="store_true",
                     help="coordinator only: watch queue depth / shed "
                     "rate and launch prewarmed standby replicas into "
@@ -190,12 +211,22 @@ def main(argv=None):
     else:
         endpoints, port = None, args.port
 
+    roles = None
+    if args.roles:
+        roles = [r.strip() for r in args.roles.split(",") if r.strip()]
+        if endpoints is None or len(roles) != len(endpoints):
+            ap.error("--roles must parallel --fleet")
+    role = args.role or (roles[args.rank] if roles else None)
+    decode_peers = [e.strip() for e in (args.decode_peers or "").split(",")
+                    if e.strip()]
     server = ServingServer(engine, port=port, rank=args.rank,
-                           decode_engine=decode_engine).start()
+                           decode_engine=decode_engine, role=role,
+                           decode_peers=decode_peers).start()
     fleet = None
     if endpoints:
         fleet = ServingFleet(args.rank, endpoints, server,
-                             endpoints_file=args.endpoints_file).start()
+                             endpoints_file=args.endpoints_file,
+                             roles=roles).start()
 
     # rollout controller: serves __rollout_ctl__ admin commands and runs
     # the canary metrics gate (auto-rollback); with a fleet, state
@@ -208,64 +239,145 @@ def main(argv=None):
     # a drained __retire__ order exits the process like a SIGTERM would
     server.on_retire = done.set
 
-    scaler = None
+    scalers = []
     if args.autoscale and fleet is not None:
+        from paddle_tpu import flags as _flags
         from paddle_tpu.core import telemetry as _tm
         from paddle_tpu.serving import AutoScaler
 
         def child_argv(rank):
             """Re-exec this invocation for a standby slot (the child
             shares --cache-dir, so its prewarm is restore-dominated);
-            the child never autoscales itself."""
+            the child never autoscales itself and takes its role from
+            its --roles column slot."""
             out, it = [sys.executable, os.path.abspath(__file__)], \
                 iter(sys.argv[1:])
             for a in it:
                 if a == "--autoscale":
                     continue
-                if a in ("--rank", "--min-replicas", "--max-replicas"):
+                if a in ("--rank", "--min-replicas", "--max-replicas",
+                         "--role"):
                     next(it, None)
                     continue
                 out.append(a)
             return out + ["--rank", str(rank)]
 
-        def metrics():
+        def local_depth():
             depth = len(engine._queue)
             if decode_engine is not None:
                 depth += len(decode_engine._waiting)
-            return {"queue_depth": depth,
-                    "shed_total": _tm.counter_total("serving_shed_total")}
+            return depth
 
-        def scale_up():
-            import subprocess
+        def scale_up_for(want_role):
+            def fn():
+                import subprocess
 
-            if not fleet.is_coordinator():
-                return
-            dead = [r for r in range(len(fleet.endpoints))
-                    if r not in fleet.live]
-            if not dead:
-                return
-            rank = dead[0]
-            fleet.notice_relaunch(rank)
-            subprocess.Popen(child_argv(rank), start_new_session=True)
+                if not fleet.is_coordinator():
+                    return
+                dead = [r for r in range(len(fleet.endpoints))
+                        if r not in fleet.live
+                        and (want_role is None
+                             or fleet.role_of(r) == want_role)]
+                if not dead:
+                    return
+                rank = dead[0]
+                fleet.notice_relaunch(rank)
+                subprocess.Popen(child_argv(rank), start_new_session=True)
+            return fn
 
-        def scale_down():
-            if not fleet.is_coordinator():
-                return
-            cands = [r for r in sorted(fleet.live) if r != fleet.rank]
-            if cands:
-                fleet.retire(cands[-1])
+        def scale_down_for(want_role):
+            def fn():
+                if not fleet.is_coordinator():
+                    return
+                cands = [r for r in sorted(fleet.live)
+                         if r != fleet.rank
+                         and (want_role is None
+                              or fleet.role_of(r) == want_role)]
+                if cands:
+                    fleet.retire(cands[-1])
+            return fn
 
-        scaler = AutoScaler(metrics, scale_up, scale_down,
-                            replicas_fn=lambda: len(fleet.live),
-                            min_replicas=args.min_replicas,
-                            max_replicas=args.max_replicas).start()
+        if roles is None:
+            def metrics():
+                return {"queue_depth": local_depth(),
+                        "shed_total": _tm.counter_total(
+                            "serving_shed_total")}
+
+            scalers.append(AutoScaler(
+                metrics, scale_up_for(None), scale_down_for(None),
+                replicas_fn=lambda: len(fleet.live),
+                min_replicas=args.min_replicas,
+                max_replicas=args.max_replicas).start())
+        else:
+            # disaggregated fleet: one controller per role, each with a
+            # role-specific pressure signal — prefill chases admission
+            # queue depth (TTFT pressure), decode chases KV-pool
+            # occupancy (ITL pressure).  Peer replicas are scraped over
+            # __metrics__; this replica contributes locally.
+            def role_metrics(want_role):
+                def fn():
+                    depth = occ = shed = 0.0
+                    for ep in fleet.live_role_endpoints(want_role):
+                        if ep == fleet.endpoints[fleet.rank]:
+                            continue
+                        try:
+                            snap = _tm.scrape(ep, timeout=2.0)
+                        except Exception:
+                            continue
+                        g = snap.get("gauges", {})
+                        depth += max(
+                            (v for k, v in g.items()
+                             if k.startswith("serving_queue_depth")),
+                            default=0.0)
+                        occ = max(occ, max(
+                            (v for k, v in g.items()
+                             if k.startswith("kv_pool_occupancy")),
+                            default=0.0))
+                        shed += sum(
+                            v for k, v in
+                            snap.get("counters", {}).items()
+                            if k.startswith("serving_shed_total"))
+                    if fleet.role_of(fleet.rank) == want_role:
+                        depth += local_depth()
+                        shed += _tm.counter_total("serving_shed_total")
+                        if decode_engine is not None:
+                            for m in decode_engine._models.values():
+                                alloc = m.cache.allocator
+                                occ = max(occ, alloc.in_use /
+                                          (float(alloc.capacity) or 1.0))
+                    return {"queue_depth": depth, "shed_total": shed,
+                            "kv_occupancy": occ}
+                return fn
+
+            up_depth = float(_flags.flag("serving_scale_up_depth"))
+
+            def prefill_pressure(m):
+                d = float(m.get("queue_depth", 0.0))
+                return d >= up_depth, d <= 0.0
+
+            def decode_pressure(m):
+                occ = float(m.get("kv_occupancy", 0.0))
+                return occ >= 0.85, occ <= 0.30
+
+            for want_role, pfn in (("prefill", prefill_pressure),
+                                   ("decode", decode_pressure)):
+                if want_role not in roles:
+                    continue
+                scalers.append(AutoScaler(
+                    role_metrics(want_role), scale_up_for(want_role),
+                    scale_down_for(want_role),
+                    replicas_fn=(lambda wr=want_role:
+                                 len(fleet.live_role_ranks(wr))),
+                    min_replicas=args.min_replicas,
+                    max_replicas=args.max_replicas,
+                    pressure_fn=pfn).start())
 
     print("READY port=%d" % server.port, flush=True)
 
     for sig in (signal.SIGINT, signal.SIGTERM):
         signal.signal(sig, lambda *_: done.set())
     done.wait()
-    if scaler is not None:
+    for scaler in scalers:
         scaler.stop()
     if fleet is not None:
         fleet.stop()
