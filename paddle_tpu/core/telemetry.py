@@ -22,19 +22,33 @@ after the fact:
   fold), distributed/ps.py + native/rpc.py (send/retry/dedupe-drop,
   heartbeat misses, evictions), utils/fault_injection.py (fired faults),
   io.py CheckpointManager (save/restore durations).
+- fleet merge: every histogram also counts into fixed log-spaced bucket
+  bounds (``HIST_BUCKET_BOUNDS``, shared across processes), exported as
+  cumulative ``buckets`` vectors in every snapshot — replicas merge by
+  elementwise sum (``merge_hist_snapshots``) and fleet-exact percentiles
+  come from ``bucket_percentile``.  A bounded time-series ring
+  (``series_record``/``series``/``series_rate``, fed by the 1s
+  publisher) makes windowed rates counter deltas instead of lifetime
+  averages; serving/fleetmon.py builds the fleet aggregation + SLO
+  burn-rate plane on both.
 """
 
 import atexit
+import bisect
 import json
+import math
 import os
 import threading
 import time
 
 __all__ = [
     "enabled", "inc", "set_gauge", "observe", "event", "set_info",
-    "record_step", "snapshot", "counter_total", "prometheus_text",
-    "dump", "maybe_dump", "reset", "publish_rpc", "start_publisher",
-    "decode_snapshot", "scrape", "METRICS_RPC_KEY",
+    "record_step", "snapshot", "counter_total", "label_sets",
+    "prometheus_text", "dump", "maybe_dump", "reset", "publish_rpc",
+    "start_publisher", "decode_snapshot", "scrape", "METRICS_RPC_KEY",
+    "HIST_BUCKET_BOUNDS", "bucket_percentile", "merge_hist_snapshots",
+    "cumulative_to_deltas", "series", "series_record", "series_rate",
+    "rate_from_samples",
 ]
 
 METRICS_RPC_KEY = "__metrics__"
@@ -44,6 +58,25 @@ METRICS_RPC_KEY = "__metrics__"
 _HIST_SAMPLE_CAP = 8192
 _EVENT_RING_CAP = 4096
 
+
+def _log_bounds(lo, hi, growth):
+    out, v = [], float(lo)
+    while v < hi:
+        out.append(round(v, 4))
+        v *= growth
+    out.append(float(hi))
+    return tuple(out)
+
+
+# Fixed log-spaced bucket upper bounds (ms), shared by EVERY histogram in
+# every process: 0.05 ms .. 2 min at 1.25x growth (~67 buckets + overflow).
+# Because the bounds are process-independent constants, bucket count
+# vectors from different replicas merge by elementwise sum, and any
+# consumer can recover a fleet-exact percentile to within one bucket
+# width (<= 25% relative) from the merged cumulative counts — unlike the
+# decimated sample lists, which cannot be merged.
+HIST_BUCKET_BOUNDS = _log_bounds(0.05, 120000.0, 1.25)
+
 _lock = threading.RLock()
 _counters = {}     # (name, labels) -> float
 _gauges = {}       # (name, labels) -> float
@@ -52,6 +85,7 @@ _info = {}         # one-off structured payloads (e.g. memory_audit report)
 _events = []       # bounded in-memory ring of event dicts
 _event_seq = {}    # kind -> next sequence number
 _event_sink = [None, None]  # (path, open file handle) for the JSONL stream
+_series = []       # bounded ring of timestamped counter/gauge samples
 
 
 def _flags():
@@ -70,7 +104,8 @@ def telemetry_dir():
 
 
 class _Hist:
-    __slots__ = ("count", "sum", "min", "max", "samples")
+    __slots__ = ("count", "sum", "min", "max", "samples", "buckets",
+                 "_sorted")
 
     def __init__(self):
         self.count = 0
@@ -78,6 +113,11 @@ class _Hist:
         self.min = float("inf")
         self.max = float("-inf")
         self.samples = []
+        # per-bucket (non-cumulative) observation counts over the fixed
+        # HIST_BUCKET_BOUNDS; last slot is the +Inf overflow bucket.
+        # Never decimated — merges across replicas stay exact.
+        self.buckets = [0] * (len(HIST_BUCKET_BOUNDS) + 1)
+        self._sorted = None       # cached sorted view, invalidated on add
 
     def add(self, v):
         v = float(v)
@@ -85,16 +125,118 @@ class _Hist:
         self.sum += v
         self.min = min(self.min, v)
         self.max = max(self.max, v)
+        self.buckets[bisect.bisect_left(HIST_BUCKET_BOUNDS, v)] += 1
         self.samples.append(v)
         if len(self.samples) > _HIST_SAMPLE_CAP:
             del self.samples[::2]
+        self._sorted = None
 
     def percentile(self, q):
         if not self.samples:
             return 0.0
-        s = sorted(self.samples)
+        if self._sorted is None:
+            self._sorted = sorted(self.samples)
+        s = self._sorted
         i = min(int(q * len(s)), len(s) - 1)
         return s[i]
+
+    def cumulative(self):
+        """Prometheus-style cumulative bucket counts (last == count)."""
+        out, run = [], 0
+        for c in self.buckets:
+            run += c
+            out.append(run)
+        return out
+
+    def merge(self, other):
+        """Fold another histogram in EXACTLY: counts, sums, and bucket
+        vectors add; min/max fold.  Samples are appended (then decimated
+        to the cap) so the local percentile estimate stays usable, but
+        the bucket vector — the mergeable truth — is never decimated."""
+        self.count += other.count
+        self.sum += other.sum
+        self.min = min(self.min, other.min)
+        self.max = max(self.max, other.max)
+        for i, c in enumerate(other.buckets):
+            self.buckets[i] += c
+        self.samples.extend(other.samples)
+        while len(self.samples) > _HIST_SAMPLE_CAP:
+            del self.samples[::2]
+        self._sorted = None
+        return self
+
+
+def bucket_percentile(cum_buckets, q, bounds=None):
+    """Percentile from cumulative bucket counts: the upper bound of the
+    bucket holding the rank-``q`` observation — within one bucket width
+    of the true sample percentile, and exact across merges (bucket
+    vectors sum where sample lists cannot)."""
+    bounds = bounds or HIST_BUCKET_BOUNDS
+    total = int(cum_buckets[-1]) if cum_buckets else 0
+    if total <= 0:
+        return 0.0
+    # same rank convention as _Hist.percentile: s[min(int(q*n), n-1)]
+    rank = min(int(q * total), total - 1) + 1
+    for i, c in enumerate(cum_buckets):
+        if c >= rank:
+            return bounds[min(i, len(bounds) - 1)]
+    return bounds[-1]
+
+
+def cumulative_to_deltas(cum_buckets):
+    """Cumulative bucket vector -> per-bucket counts (inverse of
+    ``_Hist.cumulative``); deltas from different replicas sum directly."""
+    out, prev = [], 0
+    for c in cum_buckets:
+        c = int(c)
+        out.append(c - prev)
+        prev = c
+    return out
+
+
+def merge_hist_snapshots(hists, bounds=None):
+    """Merge per-replica histogram dump dicts (the ``snapshot()`` /
+    ``scrape()`` shape) into one fleet-exact dict: count/sum/buckets
+    sum, min/max fold, percentiles recomputed from the merged cumulative
+    buckets.  Entries without bucket vectors (pre-merge snapshots)
+    degrade to the conservative worst-replica percentile."""
+    bounds = bounds or HIST_BUCKET_BOUNDS
+    out = {"count": 0, "sum": 0.0, "min": float("inf"),
+           "max": float("-inf")}
+    merged = [0] * (len(bounds) + 1)
+    have_buckets = True
+    worst = {"p50": 0.0, "p90": 0.0, "p99": 0.0}
+    for h in hists:
+        if not h:
+            continue
+        out["count"] += int(h.get("count", 0))
+        out["sum"] += float(h.get("sum", 0.0))
+        if h.get("count"):
+            out["min"] = min(out["min"], float(h.get("min", 0.0)))
+            out["max"] = max(out["max"], float(h.get("max", 0.0)))
+        for p in worst:
+            worst[p] = max(worst[p], float(h.get(p, 0.0)))
+        cum = h.get("buckets")
+        if cum is None:
+            have_buckets = False
+        else:
+            prev = 0
+            for i, c in enumerate(cum[:len(merged)]):
+                merged[i] += int(c) - prev
+                prev = int(c)
+    if out["count"] <= 0:
+        out["min"] = out["max"] = 0.0
+    if have_buckets:
+        cum, run = [], 0
+        for c in merged:
+            run += c
+            cum.append(run)
+        out["buckets"] = cum
+        for p, q in (("p50", 0.50), ("p90", 0.90), ("p99", 0.99)):
+            out[p] = bucket_percentile(cum, q, bounds)
+    else:
+        out.update(worst)
+    return out
 
 
 def _key(name, labels):
@@ -270,9 +412,19 @@ def record_step(wall_ms, cache_hit, compile_ms=None, donated=0,
 
 # -- read side ---------------------------------------------------------------
 
+def _finite(v):
+    """inf/-inf/nan would emit non-standard JSON from dump() — clamp to
+    0.0 (empty histograms carry +/-inf min/max sentinels)."""
+    v = float(v)
+    return round(v, 3) if math.isfinite(v) else 0.0
+
+
 def snapshot():
     """Flat JSON-ready view: counters/gauges keyed ``name`` or
-    ``name{k=v,...}``; histograms as count/sum/min/max/p50/p90/p99."""
+    ``name{k=v,...}``; histograms as count/sum/min/max/p50/p90/p99 plus
+    the cumulative ``buckets`` vector over the shared
+    ``bucket_bounds`` (top-level, emitted once) so any consumer can
+    merge replicas exactly and recompute fleet percentiles."""
     with _lock:
         out = {
             "counters": {_flat(n, l): v for (n, l), v in _counters.items()},
@@ -280,16 +432,18 @@ def snapshot():
             "histograms": {
                 _flat(n, l): {
                     "count": h.count,
-                    "sum": round(h.sum, 3),
-                    "min": round(h.min, 3) if h.count else 0.0,
-                    "max": round(h.max, 3) if h.count else 0.0,
-                    "p50": round(h.percentile(0.50), 3),
-                    "p90": round(h.percentile(0.90), 3),
-                    "p99": round(h.percentile(0.99), 3),
+                    "sum": _finite(h.sum),
+                    "min": _finite(h.min) if h.count else 0.0,
+                    "max": _finite(h.max) if h.count else 0.0,
+                    "p50": _finite(h.percentile(0.50)),
+                    "p90": _finite(h.percentile(0.90)),
+                    "p99": _finite(h.percentile(0.99)),
+                    "buckets": h.cumulative(),
                 }
                 for (n, l), h in _hists.items()
             },
             "events_logged": dict(_event_seq),
+            "bucket_bounds": list(HIST_BUCKET_BOUNDS),
         }
         if _info:
             out["info"] = dict(_info)
@@ -300,6 +454,90 @@ def counter_total(name):
     """Sum of a counter across all label sets (0.0 when never touched)."""
     with _lock:
         return float(sum(v for (n, _), v in _counters.items() if n == name))
+
+
+def label_sets(name, kind="counter"):
+    """Every live label set of a counter/gauge family, as
+    ``[(flat_key, {label: value}), ...]`` — consumers that window rates
+    per label (per-tier shed/s, per-namespace hit/s) enumerate through
+    this instead of re-parsing flat keys."""
+    src = _counters if kind == "counter" else _gauges
+    with _lock:
+        return [(_flat(n, l), dict(l)) for (n, l) in src if n == name]
+
+
+# -- time-series ring --------------------------------------------------------
+
+def _series_cap():
+    v = _flags().flag("telemetry_series_cap")
+    return int(v) if v else 1024
+
+
+def series_record(now=None):
+    """Append one timestamped counter/gauge sample to the bounded
+    in-process ring (the 1s publisher calls this every tick).  Windowed
+    RATES — shed/s, tokens/s, cache-miss/s — fall out as counter deltas
+    between ring samples instead of lifetime averages."""
+    if not enabled():
+        return None
+    with _lock:
+        rec = {"t": float(now if now is not None else time.time()),
+               "counters": {_flat(n, l): float(v)
+                            for (n, l), v in _counters.items()},
+               "gauges": {_flat(n, l): v for (n, l), v in _gauges.items()}}
+        _series.append(rec)
+        cap = _series_cap()
+        if len(_series) > cap:
+            del _series[: len(_series) - cap]
+        return rec
+
+
+def series(window_s=None, now=None):
+    """The ring's samples (oldest first), optionally only those within
+    the trailing ``window_s`` seconds."""
+    with _lock:
+        if window_s is None:
+            return list(_series)
+        cut = float(now if now is not None else time.time()) - \
+            float(window_s)
+        return [s for s in _series if s["t"] >= cut]
+
+
+def rate_from_samples(samples, window_s=None, now=None):
+    """Reset-safe per-second rate from ``[(t, value), ...]`` counter
+    samples: positive deltas between consecutive samples sum; a value
+    DROP (replica restart zeroed the counter) contributes the post-reset
+    value instead of a negative delta — the Prometheus ``rate()``
+    counter-reset rule."""
+    pts = [(float(t), float(v)) for t, v in samples]
+    if window_s is not None:
+        cut = float(now if now is not None else time.time()) - \
+            float(window_s)
+        inside = [i for i, (t, _) in enumerate(pts) if t >= cut]
+        if len(inside) >= 2:
+            pts = pts[inside[0]:]
+        elif inside:
+            # a single in-window sample has no delta — reach back to
+            # one pre-cut sample as the baseline
+            pts = pts[max(inside[0] - 1, 0):]
+        else:
+            pts = pts[-1:]
+    if len(pts) < 2:
+        return 0.0
+    total = 0.0
+    for (_, prev), (_, cur) in zip(pts, pts[1:]):
+        d = cur - prev
+        total += cur if d < 0 else d
+    span = pts[-1][0] - pts[0][0]
+    return total / span if span > 0 else 0.0
+
+
+def series_rate(flat_name, window_s, now=None):
+    """Windowed per-second rate of one flat counter key from the ring."""
+    with _lock:
+        pts = [(s["t"], s["counters"].get(flat_name, 0.0))
+               for s in _series]
+    return rate_from_samples(pts, window_s, now=now)
 
 
 def prometheus_text(snap=None):
@@ -384,6 +622,7 @@ def reset():
         _info.clear()
         _events.clear()
         _event_seq.clear()
+        _series.clear()
         if _event_sink[1] is not None:
             _event_sink[1].close()
         _event_sink[0] = _event_sink[1] = None
@@ -422,24 +661,40 @@ class PublisherHandle(threading.Event):
 
 
 def start_publisher(server, interval_s=1.0, key=METRICS_RPC_KEY,
-                    stop_event=None):
+                    stop_event=None, on_publish=None):
     """Republish the snapshot on `server` every `interval_s` so scrapes
     always read a fresh view (publish_rpc is one-shot).  Returns a
     PublisherHandle — call ``.stop()`` to end AND join the daemon thread
     (``.set()`` alone still ends it, legacy contract).  The serving
-    frontend uses this for its __metrics__ endpoint."""
+    frontend uses this for its __metrics__ endpoint.
+
+    Every tick also appends a sample to the time-series ring
+    (``series_record``) BEFORE publishing, so windowed rates are
+    derivable on every replica for free; ``on_publish`` (optional) runs
+    between the two — derived per-window gauges set there (per-tier
+    shed/s, per-namespace hit rate) ride the same republish."""
     stop = PublisherHandle()
+
+    def tick():
+        series_record()
+        if on_publish is not None:
+            try:
+                on_publish()
+            except Exception:
+                pass               # a derived gauge must never kill the
+                                   # publisher
+        publish_rpc(server, key=key)
 
     def loop():
         while not stop.wait(interval_s):
             if stop_event is not None and stop_event.is_set():
                 return
             try:
-                publish_rpc(server, key=key)
+                tick()
             except Exception:
                 return  # server shut down under us
 
-    publish_rpc(server, key=key)
+    tick()
     t = threading.Thread(target=loop, name="telemetry-publisher",
                          daemon=True)
     stop.thread = t

@@ -270,6 +270,29 @@ _DEFAULTS = {
     "FLAGS_rollout_gate_p99_ratio": 2.0,
     "FLAGS_rollout_gate_error_rate": 0.05,
     "FLAGS_rollout_gate_min_samples": 20,
+    # -- fleet observability (serving/fleetmon.py FleetMonitor) --------------
+    # scrape/aggregate cadence (s) and the trailing horizon (s) used for
+    # windowed rates derived from the per-process time-series ring
+    # (per-tier shed/s on the 1s republish, autoscaler fleet rates)
+    "FLAGS_serving_fleetmon_interval": 1.0,
+    "FLAGS_serving_rate_window": 30.0,
+    # burn-rate SLO rules: ";"-separated "name:metric:pQQ:objective_ms".
+    # metric is a histogram flat key or prefix (label sets merge), e.g.
+    # "paid_server:server_ms{tier=paid}:p99:500" alerts when the paid
+    # tier's windowed server-side p99 burns past 500 ms.  Each rule is
+    # evaluated over a fast AND a slow trailing window (multi-window
+    # burn-rate alerting): the alert FIRES when both windows' burn
+    # (windowed pQQ / objective) reach the threshold, and CLEARS with
+    # hysteresis once the fast window drops below threshold x clear_ratio
+    "FLAGS_serving_slo_rules":
+        "paid_server:server_ms{tier=paid}:p99:500;decode_itl:itl_ms:p99:250",
+    "FLAGS_serving_slo_fast_window": 60.0,
+    "FLAGS_serving_slo_slow_window": 900.0,
+    "FLAGS_serving_slo_burn_threshold": 1.0,
+    "FLAGS_serving_slo_clear_ratio": 0.5,
+    # bounded length of the in-process telemetry time-series ring (one
+    # sample per publisher tick; 1024 ~= 17 min of 1s samples)
+    "FLAGS_telemetry_series_cap": 1024,
     # -- autoregressive decode serving (serving/kv_cache.py + DecodeEngine) --
     # decode-lane buckets: the running token batch pads to the smallest
     # bucket that fits the live sequences; one decode-step executable is

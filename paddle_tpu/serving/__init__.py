@@ -29,7 +29,15 @@ launching prewarmed standbys / draining idle replicas, and a
 ``RolloutController`` canarying ``name@v2`` behind a metrics gate with
 automatic rollback (serving/rollout.py).
 
-Entry points: ``tools/serve.py`` and ``tools/loadgen.py``.
+The fleet observability plane (PR 18, serving/fleetmon.py) scrapes
+every live replica each tick, merges histograms exactly via the shared
+telemetry bucket vectors, windows counter deltas into rates, evaluates
+multi-window burn-rate SLO rules, and republishes the merged doc under
+``__fleet__`` — the AutoScaler's pressure and the rollout gate's
+verdicts read these fleet-wide values instead of one-replica instants.
+
+Entry points: ``tools/serve.py``, ``tools/loadgen.py``, and
+``tools/fleet_top.py``.
 """
 
 from .client import ServingClient, read_endpoints_doc, \
@@ -39,6 +47,8 @@ from .engine import DecodeEngine, InferReply, ServingEngine, \
     parse_buckets, parse_tier_weights, tier_weight  # noqa: F401
 from .fleet import AutoScaler, ServingFleet, \
     write_endpoints_file  # noqa: F401
+from .fleetmon import FLEET_RPC_KEY, FleetMonitor, \
+    parse_slo_rules  # noqa: F401
 from .kv_cache import BlockAllocator, KVCacheConfig, PagedKVCache, \
     engine_owned_kv_bytes, plan_num_blocks  # noqa: F401
 from .rollout import RolloutController, evaluate_gate  # noqa: F401
@@ -51,4 +61,5 @@ __all__ = [
     "read_endpoints_file", "read_endpoints_doc", "write_endpoints_file",
     "KVCacheConfig", "BlockAllocator", "PagedKVCache", "plan_num_blocks",
     "engine_owned_kv_bytes", "KVBlockSender", "AdoptTracker",
+    "FleetMonitor", "parse_slo_rules", "FLEET_RPC_KEY",
 ]

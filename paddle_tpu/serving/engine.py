@@ -715,6 +715,17 @@ class ServingEngine:
             # per-version execute p99: the rollout gate's scrape-side
             # signal (phase attribution, not end-to-end latency)
             _tm.observe("serving_execute_ms", ms, model=entry.name)
+            # per-tier server-side latency (queue wait + execute, the
+            # loadgen "server_ms" attribution) as a MERGEABLE histogram:
+            # fleetmon's burn-rate SLO rules window its bucket deltas
+            _tm.observe("server_ms",
+                        r.reply.phases.get("queue_wait_ms", 0.0) + ms,
+                        tier=r.tier)
+            # goodput numerator/denominator: a reply that beat its
+            # deadline is goodput, a late one is only raw throughput
+            met = time.perf_counter() <= r.deadline
+            _tm.inc("serving_deadline_met_total" if met
+                    else "serving_deadline_missed_total", tier=r.tier)
         _tm.inc("serving_batches_total", model=entry.name,
                 bucket=str(bucket))
         _tm.observe("serving_batch_fill", rows / float(bucket),
@@ -1460,6 +1471,24 @@ class DecodeEngine:
         if reply.ok:
             reply.outputs = {"tokens": out_tokens}
         r.complete(reply)
+        if reply.ok:
+            # fleet-mergeable per-phase histograms: per-tier server_ms
+            # (end-to-end on this replica), per-model TTFT and ITL —
+            # fleetmon's SLO rules (decode ITL p99) window their bucket
+            # deltas; deadline-met replies/tokens are the goodput
+            # numerators, raw completions/tokens the denominators
+            _tm.observe("server_ms", reply.latency_ms, tier=r.tier)
+            if "ttft_ms" in reply.phases:
+                _tm.observe("ttft_ms", reply.phases["ttft_ms"],
+                            model=r.model)
+            for g in reply.phases.get("itl_ms_samples") or ():
+                _tm.observe("itl_ms", g, model=r.model)
+            met = time.perf_counter() <= r.deadline
+            _tm.inc("serving_deadline_met_total" if met
+                    else "serving_deadline_missed_total", tier=r.tier)
+            if met:
+                _tm.inc("serving_deadline_tokens_total", len(seq.out),
+                        tier=r.tier)
         if r.qspan is not None:
             r.qspan.end()
             r.qspan = None

@@ -396,6 +396,7 @@ class AutoScaler:
         self._down_streak = 0
         self._cooldown = 0
         self._last_shed = None
+        self._race_logged = False
         self.events = []                # ("up"|"down", tick_no) history
         self._ticks = 0
         self._stop = threading.Event()
@@ -408,7 +409,17 @@ class AutoScaler:
         try:
             m = self.metrics_fn() or {}
         except Exception:
-            return None                 # scrape raced a membership change
+            # scrape raced a membership change: the tick is skipped, but
+            # a flapping endpoints file must not read as an unexplained
+            # scaling stall — count every race, log the first
+            _tm.inc("autoscale_scrape_races_total")
+            if not self._race_logged:
+                self._race_logged = True
+                logging.warning("[autoscale] metrics scrape raced a "
+                                "membership change; skipping tick "
+                                "(counted in autoscale_scrape_races_total,"
+                                " logged once)")
+            return None
         depth = float(m.get("queue_depth", 0.0))
         shed = float(m.get("shed_total", 0.0))
         shed_delta = 0.0 if self._last_shed is None \
@@ -424,8 +435,16 @@ class AutoScaler:
         if self.pressure_fn is not None:
             pressure, idle = self.pressure_fn(m)
         else:
-            pressure = depth >= self.up_depth or shed_delta > 0.0
-            idle = depth <= 0.0 and shed_delta <= 0.0
+            # a fleet-windowed shed rate (shed/s over the rate window,
+            # from FleetMonitor) subsumes the local one-tick shed delta:
+            # it survives replica restarts and catches sheds on peers
+            # the coordinator's own counter never sees
+            if "shed_rate" in m:
+                shedding = float(m.get("shed_rate", 0.0)) > 0.0
+            else:
+                shedding = shed_delta > 0.0
+            pressure = depth >= self.up_depth or shedding
+            idle = depth <= 0.0 and not shedding
         if pressure:
             self._up_streak += 1
             self._down_streak = 0

@@ -382,6 +382,15 @@ class PrefixCache:
         _tm.inc("prefix_cache_lookup_tokens_total", len(prompt_ids))
         if cached:
             _tm.inc("prefix_cache_hit_tokens_total", cached)
+        # namespace-labeled twins of the counters above: the 1s republish
+        # derives a WINDOWED per-namespace hit rate from their series
+        # deltas (prefix_cache_ns_hit_rate{namespace=}) so prefix-aware
+        # routers can bias on recent affinity, not lifetime averages
+        _tm.inc("prefix_cache_ns_lookup_tokens_total", len(prompt_ids),
+                namespace=self.namespace)
+        if cached:
+            _tm.inc("prefix_cache_ns_hit_tokens_total", cached,
+                    namespace=self.namespace)
         return blocks, cached, hashes
 
     def hit_rate(self):

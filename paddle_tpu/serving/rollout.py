@@ -61,21 +61,49 @@ def stats_from_snapshot(snap, model):
         elif flat.startswith("serving_requests_total{") \
                 and "model=%s," % model in flat:
             requests += v
-    return {"count": float(hist.get("count", 0.0)) + errors,
-            "requests": requests,
-            "errors": errors,
-            "p99_ms": float(hist.get("p99", 0.0))}
+    out = {"count": float(hist.get("count", 0.0)) + errors,
+           "requests": requests,
+           "errors": errors,
+           "p99_ms": float(hist.get("p99", 0.0))}
+    # cumulative bucket vector (PR 18 snapshots carry one per histogram)
+    # rides along so merge_stats can compute a fleet-EXACT p99 instead
+    # of the worst-replica upper bound; absent on pre-18 snapshots
+    if hist.get("buckets"):
+        out["buckets"] = list(hist["buckets"])
+    return out
 
 
 def merge_stats(per_replica):
-    """Fold per-replica stats: counts/errors sum, p99 takes the WORST
-    replica (conservative — a canary that is slow anywhere trips)."""
+    """Fold per-replica stats: counts/errors sum.  When every replica
+    shipped a cumulative bucket vector the merged p99 is computed from
+    the summed buckets — exact to within one bucket width across the
+    whole fleet.  Any bucket-less entry (old replica mid-rollout) drops
+    the merge back to the conservative fallback: p99 takes the WORST
+    replica (a canary that is slow anywhere trips)."""
     out = {"count": 0.0, "requests": 0.0, "errors": 0.0, "p99_ms": 0.0}
+    merged_buckets = None
+    exact = True
     for s in per_replica:
         out["count"] += s.get("count", 0.0)
         out["requests"] += s.get("requests", 0.0)
         out["errors"] += s.get("errors", 0.0)
         out["p99_ms"] = max(out["p99_ms"], s.get("p99_ms", 0.0))
+        b = s.get("buckets")
+        if not b:
+            exact = False
+            continue
+        deltas = _tm.cumulative_to_deltas(b)
+        if merged_buckets is None:
+            merged_buckets = deltas
+        else:
+            merged_buckets = [a + d for a, d in zip(merged_buckets, deltas)]
+    if exact and merged_buckets is not None and sum(merged_buckets) > 0:
+        cum, run = [], 0
+        for d in merged_buckets:
+            run += d
+            cum.append(run)
+        out["p99_ms"] = _tm.bucket_percentile(cum, 0.99)
+        out["buckets"] = cum
     return out
 
 

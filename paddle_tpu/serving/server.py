@@ -124,11 +124,37 @@ class ServingServer:
             self._adopt = AdoptTracker(self._on_orphan)
         self.rpc.serve(True)
         if _tm.enabled():
-            self._pub_stop = _tm.start_publisher(self.rpc, interval_s=1.0)
+            self._pub_stop = _tm.start_publisher(
+                self.rpc, interval_s=1.0, on_publish=self._pre_publish)
         self._thread = threading.Thread(target=self._poll_loop,
                                         name="serving-rpc", daemon=True)
         self._thread.start()
         return self
+
+    def _pre_publish(self):
+        """Derived per-window gauges, recomputed on every 1s republish
+        (runs inside the publisher tick, after series_record): per-tier
+        windowed shed RATE from the tier-labeled counter's series
+        deltas, and per-namespace prefix hit rate from the
+        namespace-labeled token counters — the windowed signals the
+        autoscaler's tier policy and the prefix-aware router bias on."""
+        from .. import flags
+
+        window = float(flags.flag("serving_rate_window"))
+        for flat, labels in _tm.label_sets("serving_tier_shed_total"):
+            _tm.set_gauge("serving_tier_shed_rate",
+                          _tm.series_rate(flat, window),
+                          tier=labels.get("tier", "default"))
+        for flat, labels in _tm.label_sets(
+                "prefix_cache_ns_lookup_tokens_total"):
+            ns = labels.get("namespace", "default")
+            lookups = _tm.series_rate(flat, window)
+            hits = _tm.series_rate(
+                "prefix_cache_ns_hit_tokens_total{namespace=%s}" % ns,
+                window)
+            _tm.set_gauge("prefix_cache_ns_hit_rate",
+                          hits / lookups if lookups > 0 else 0.0,
+                          namespace=ns)
 
     def attach_fleet(self, fleet):
         """Wire a serving fleet: its heartbeats arrive on this server's

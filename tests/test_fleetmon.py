@@ -1,0 +1,434 @@
+"""Fleet metrics plane (PR 18): mergeable bucket histograms, the
+time-series ring's windowed-rate math, the FleetMonitor's multi-window
+burn-rate alerting, and the two rewired consumers (rollout gate p99
+from merged buckets, autoscaler pressure from fleet-windowed rates).
+
+Everything here is in-process and clock-injected — no sockets, no
+sleeps.  The live 2-replica wire behavior (``__fleet__`` publish,
+fleet_top --once --json) is in tests/test_fleetmon_subprocess.py and
+the tools/run_ci.sh --fleetmon-smoke leg.
+"""
+
+import bisect
+import json
+
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core import telemetry as _tm
+from paddle_tpu.serving.fleet import AutoScaler
+from paddle_tpu.serving.fleetmon import (FleetMonitor, SLORule,
+                                         parse_slo_rules)
+from paddle_tpu.serving.rollout import (evaluate_gate, merge_stats,
+                                        stats_from_snapshot)
+
+BOUNDS = _tm.HIST_BUCKET_BOUNDS
+
+
+@pytest.fixture()
+def telemetry_on():
+    fluid.set_flags({"FLAGS_telemetry": True})
+    _tm.reset()
+    yield
+    _tm.reset()
+    fluid.set_flags({"FLAGS_telemetry": False})
+
+
+def _hist_dump(samples):
+    """A snapshot()-shaped histogram dict from raw samples (what one
+    replica would publish)."""
+    bk = [0] * (len(BOUNDS) + 1)
+    for v in samples:
+        bk[bisect.bisect_left(BOUNDS, v)] += 1
+    cum, run = [], 0
+    for c in bk:
+        run += c
+        cum.append(run)
+    s = sorted(samples)
+
+    def p(q):
+        return s[min(int(q * len(s)), len(s) - 1)] if s else 0.0
+
+    return {"count": len(samples), "sum": sum(samples),
+            "min": min(samples) if samples else 0.0,
+            "max": max(samples) if samples else 0.0,
+            "p50": p(0.5), "p90": p(0.9), "p99": p(0.99),
+            "buckets": cum}
+
+
+def _union_p(samples, q):
+    s = sorted(samples)
+    return s[min(int(q * len(s)), len(s) - 1)]
+
+
+def _bucket_width_ub(v):
+    """Upper bound of the bucket holding ``v`` — "within one bucket
+    width" means the merged estimate lands exactly here."""
+    return BOUNDS[min(bisect.bisect_left(BOUNDS, v), len(BOUNDS) - 1)]
+
+
+# -- mergeable histograms ----------------------------------------------------
+
+def test_hist_buckets_merge_exact_three_replicas():
+    """Acceptance criterion: the merged p99 equals the union-of-samples
+    percentile to within one bucket width, for three synthetic replica
+    dumps with very different shapes."""
+    reps = [
+        [5.0 + 0.01 * i for i in range(400)],          # uniform fast
+        [40.0] * 350 + [900.0] * 50,                    # bimodal slow tail
+        [0.2] * 450,                                    # all sub-ms
+    ]
+    merged = _tm.merge_hist_snapshots([_hist_dump(r) for r in reps])
+    union = [v for r in reps for v in r]
+    assert merged["count"] == len(union)
+    assert merged["sum"] == pytest.approx(sum(union))
+    assert merged["min"] == pytest.approx(min(union))
+    assert merged["max"] == pytest.approx(max(union))
+    for q, key in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99")):
+        true = _union_p(union, q)
+        assert merged[key] == _bucket_width_ub(true), \
+            "%s: %r != bucket ub of true %r" % (key, merged[key], true)
+
+
+def test_hist_merge_bucketless_falls_back_to_worst():
+    a = _hist_dump([10.0] * 99 + [500.0])
+    b = {"count": 100, "p99": 11.0}     # pre-18 replica: no buckets
+    merged = _tm.merge_hist_snapshots([a, b])
+    assert merged["p99"] == max(a["p99"], 11.0)
+    assert "buckets" not in merged
+
+
+def test_hist_object_merge_and_sorted_cache(telemetry_on):
+    h1, h2 = _tm._Hist(), _tm._Hist()
+    for v in (1.0, 2.0, 3.0):
+        h1.add(v)
+    assert h1.percentile(0.5) == 2.0
+    assert h1._sorted is not None        # cached after the first call
+    h1.add(0.5)                          # add invalidates
+    assert h1._sorted is None
+    assert h1.percentile(0.5) == 2.0
+    for v in (100.0, 200.0):
+        h2.add(v)
+    h1.merge(h2)
+    assert h1.count == 6
+    assert h1.max == 200.0
+    assert h1.buckets[-1] == 0           # nothing in the overflow slot
+    assert sum(h1.buckets) == 6
+
+
+def test_empty_hist_dump_is_finite_json(telemetry_on):
+    _tm._hists[_tm._key("lat_ms", {})] = _tm._Hist()   # empty histogram
+    snap = _tm.snapshot()
+    h = snap["histograms"]["lat_ms"]
+    assert h["min"] == 0.0 and h["max"] == 0.0         # not +/-inf
+    json.dumps(snap, allow_nan=False)                  # strict JSON
+
+
+def test_bucket_percentile_rank_convention():
+    h = _hist_dump([7.0] * 100)
+    assert _tm.bucket_percentile(h["buckets"], 0.99) == \
+        _bucket_width_ub(7.0)
+    assert _tm.bucket_percentile([0] * 5, 0.99) == 0.0
+
+
+# -- time-series ring / windowed rates ---------------------------------------
+
+def test_rate_from_samples_windowed():
+    pts = [(0.0, 0.0), (10.0, 50.0), (20.0, 100.0), (30.0, 160.0)]
+    # full span: 160 over 30s
+    assert _tm.rate_from_samples(pts) == pytest.approx(160.0 / 30.0)
+    # trailing 10s window keeps (20.0, 100.0) as the pre-cut baseline
+    assert _tm.rate_from_samples(pts, window_s=10.0, now=30.0) == \
+        pytest.approx(60.0 / 10.0)
+
+
+def test_rate_from_samples_counter_reset():
+    # replica restart zeroes the counter at t=20: the 5.0 post-reset
+    # value contributes as-is (Prometheus rate() rule), never a
+    # negative delta
+    pts = [(0.0, 0.0), (10.0, 100.0), (20.0, 5.0), (30.0, 15.0)]
+    assert _tm.rate_from_samples(pts) == \
+        pytest.approx((100.0 + 5.0 + 10.0) / 30.0)
+
+
+def test_series_ring_and_series_rate(telemetry_on):
+    for t in range(5):
+        _tm.inc("reqs_total", 10)
+        _tm.series_record(now=float(t))
+    assert len(_tm.series()) == 5
+    assert _tm.series(window_s=2.5, now=4.0)[0]["t"] == 2.0
+    # 30 increments across the 3s window = 10/s
+    assert _tm.series_rate("reqs_total", window_s=3.0, now=4.0) == \
+        pytest.approx(10.0)
+
+
+def test_series_ring_bounded(telemetry_on):
+    fluid.set_flags({"FLAGS_telemetry_series_cap": 8})
+    try:
+        for t in range(50):
+            _tm.series_record(now=float(t))
+        assert len(_tm.series()) == 8
+        assert _tm.series()[0]["t"] == 42.0
+    finally:
+        fluid.set_flags({"FLAGS_telemetry_series_cap": 1024})
+
+
+# -- SLO rules ---------------------------------------------------------------
+
+def test_parse_slo_rules():
+    rules = parse_slo_rules(
+        "paid_server:server_ms{tier=paid}:p99:500;decode_itl:itl_ms:p99:250")
+    assert [(r.name, r.metric, r.quantile, r.objective_ms)
+            for r in rules] == [
+        ("paid_server", "server_ms{tier=paid}", 0.99, 500.0),
+        ("decode_itl", "itl_ms", 0.99, 250.0)]
+    assert rules[0].matches("server_ms{tier=paid}")
+    assert not rules[0].matches("server_ms{tier=free}")
+    # bare family name merges every label set
+    assert rules[1].matches("itl_ms{model=toy}")
+    assert rules[1].matches("itl_ms")
+    # malformed entries are skipped, not fatal
+    assert parse_slo_rules("nonsense;also:bad") == []
+
+
+# -- FleetMonitor ------------------------------------------------------------
+
+def _fleet_rig(state, clock, **kw):
+    """FleetMonitor over a dict of fake replicas: state[ep] is a list of
+    server_ms samples (cumulative — the scrape returns the lifetime
+    histogram, like a real replica) plus counters."""
+
+    def scrape(ep):
+        st = state[ep]
+        return {
+            "counters": dict(st.get("counters", {})),
+            "gauges": dict(st.get("gauges", {})),
+            "histograms": {"server_ms{tier=paid}": _hist_dump(st["lat"])},
+            "bucket_bounds": list(BOUNDS),
+        }
+
+    kw.setdefault("rules", [SLORule("paid", "server_ms{tier=paid}",
+                                    0.99, 100.0)])
+    return FleetMonitor(endpoints=sorted(state), scrape_fn=scrape,
+                        now_fn=lambda: clock[0], interval_s=1.0,
+                        rate_window_s=30.0, fast_window_s=60.0,
+                        slow_window_s=600.0, burn_threshold=1.0,
+                        clear_ratio=0.5, **kw)
+
+
+def test_fleet_merged_p99_reflects_slow_replica(telemetry_on):
+    clock = [0.0]
+    state = {"a": {"lat": [10.0] * 200}, "b": {"lat": [10.0] * 200}}
+    mon = _fleet_rig(state, clock)
+    mon.tick()
+    # replica b develops a 300ms tail: >1% of union observations
+    state["b"]["lat"] += [300.0] * 20
+    clock[0] += 5.0
+    doc = mon.tick()
+    merged = doc["histograms"]["server_ms{tier=paid}"]
+    union = state["a"]["lat"] + state["b"]["lat"]
+    assert merged["count"] == len(union)
+    assert merged["p99"] == _bucket_width_ub(_union_p(union, 0.99))
+    assert merged["p99"] > 250.0        # the slow replica IS visible
+    # while each row still shows its own local view
+    rows = {r["endpoint"]: r for r in doc["replicas"]}
+    assert rows["a"]["p99_ms"]["server_ms"] < 50.0
+
+
+def test_burn_alert_fires_and_clears_with_hysteresis(telemetry_on):
+    clock = [0.0]
+    state = {"a": {"lat": [10.0] * 100}}
+    mon = _fleet_rig(state, clock)
+    mon.tick()
+    assert mon.alert_state["paid"] is False
+    # seeded latency step: every new observation 400ms (objective 100)
+    for _ in range(10):
+        clock[0] += 5.0
+        state["a"]["lat"] = state["a"]["lat"] + [400.0] * 20
+        doc = mon.tick()
+    slo = doc["slo"][0]
+    assert slo["active"] is True
+    assert slo["burn_fast"] >= 1.0 and slo["burn_slow"] >= 1.0
+    snap = _tm.snapshot()
+    assert snap["counters"][
+        "slo_alerts_total{event=fire,slo=paid}"] == 1
+    assert snap["gauges"]["slo_alert_active{slo=paid}"] == 1.0
+    # recovery: fast observations again; fast window must drop below
+    # threshold * clear_ratio before the alert clears (hysteresis)
+    cleared_at = None
+    for i in range(30):
+        clock[0] += 5.0
+        state["a"]["lat"] = state["a"]["lat"] + [10.0] * 50
+        doc = mon.tick()
+        if not doc["slo"][0]["active"]:
+            cleared_at = i
+            break
+    assert cleared_at is not None
+    snap = _tm.snapshot()
+    assert snap["counters"][
+        "slo_alerts_total{event=clear,slo=paid}"] == 1
+    # exactly one fire event: mid-recovery burns between clear_ratio
+    # and threshold never re-fire
+    assert snap["counters"][
+        "slo_alerts_total{event=fire,slo=paid}"] == 1
+
+
+def test_fleetmon_windowed_rates_and_goodput(telemetry_on):
+    clock = [0.0]
+    state = {"a": {"lat": [1.0],
+                   "counters": {"serving_deadline_met_total{tier=paid}": 0.0,
+                                "serving_requests_total{model=fc}": 0.0,
+                                "serving_tokens_generated_total": 0.0,
+                                "serving_deadline_tokens_total{tier=paid}":
+                                    0.0}}}
+    mon = _fleet_rig(state, clock)
+    mon.tick()
+    for _ in range(10):
+        clock[0] += 1.0
+        c = state["a"]["counters"]
+        c["serving_requests_total{model=fc}"] += 8.0
+        c["serving_deadline_met_total{tier=paid}"] += 6.0
+        c["serving_tokens_generated_total"] += 40.0
+        c["serving_deadline_tokens_total{tier=paid}"] += 30.0
+        doc = mon.tick()
+    gp = doc["goodput"]
+    assert gp["raw_replies_per_s"] == pytest.approx(8.0)
+    assert gp["replies_per_s"] == pytest.approx(6.0)
+    assert gp["raw_tokens_per_s"] == pytest.approx(40.0)
+    assert gp["tokens_per_s"] == pytest.approx(30.0)
+    # goodput < raw: the gap is the deadline-missing fraction
+    assert gp["replies_per_s"] < gp["raw_replies_per_s"]
+
+
+def test_fleetmon_scrape_failure_counted(telemetry_on):
+    clock = [0.0]
+
+    def scrape(ep):
+        raise ConnectionError("replica died")
+
+    mon = FleetMonitor(endpoints=["dead:1"], scrape_fn=scrape,
+                       now_fn=lambda: clock[0], interval_s=1.0,
+                       rules=[])
+    doc = mon.tick()
+    assert doc["replicas_up"] == 0
+    assert doc["replicas"][0]["up"] is False
+    assert _tm.counter_total("fleet_scrape_errors_total") == 1.0
+
+
+def test_fleetmon_membership_change_drops_ring(telemetry_on):
+    clock = [0.0]
+    state = {"a": {"lat": [1.0]}, "b": {"lat": [1.0]}}
+    mon = _fleet_rig(state, clock)
+    mon.tick()
+    assert set(mon._rings) == {"a", "b"}
+    mon.static_endpoints = ["a"]         # b retired out of the fleet
+    del state["b"]
+    clock[0] += 1.0
+    doc = mon.tick()
+    assert set(mon._rings) == {"a"}
+    assert [r["endpoint"] for r in doc["replicas"]] == ["a"]
+
+
+# -- consumers: autoscaler + rollout gate ------------------------------------
+
+def test_autoscaler_scrape_race_counted_and_logged_once(telemetry_on,
+                                                        caplog):
+    calls = []
+
+    def racy_metrics():
+        calls.append(1)
+        raise RuntimeError("endpoints flapped")
+
+    sc = AutoScaler(racy_metrics, lambda: None, lambda: None,
+                    replicas_fn=lambda: 1, min_replicas=1, max_replicas=2,
+                    up_ticks=2, down_ticks=2, cooldown=1, up_depth=4.0,
+                    interval_s=10.0)
+    import logging
+    with caplog.at_level(logging.WARNING):
+        for _ in range(5):
+            assert sc.tick() is None
+    assert _tm.counter_total("autoscale_scrape_races_total") == 5.0
+    races = [r for r in caplog.records if "raced" in r.getMessage()]
+    assert len(races) == 1               # logged once, not per tick
+
+
+def test_autoscaler_pressure_from_windowed_shed_rate(telemetry_on):
+    """The default rule prefers the fleet-windowed ``shed_rate`` over
+    the local one-tick shed delta when a FleetMonitor supplies it."""
+    m = {"queue_depth": 0.0, "shed_total": 0.0, "shed_rate": 2.5}
+    sc = AutoScaler(lambda: m, lambda: None, lambda: None,
+                    replicas_fn=lambda: 2, min_replicas=1, max_replicas=3,
+                    up_ticks=2, down_ticks=2, cooldown=1, up_depth=4.0,
+                    interval_s=10.0)
+    assert sc.tick() is None             # streak 1
+    assert sc.tick() == "up"             # sustained windowed shedding
+    # rate back to zero + empty queue -> idle streak -> scale down
+    m["shed_rate"] = 0.0
+    assert sc.tick() is None             # cooldown
+    assert sc.tick() is None             # idle streak 1
+    assert sc.tick() == "down"
+
+
+def test_autoscaler_fleetmon_wiring(telemetry_on):
+    """autoscale_metrics() as the AutoScaler's metrics_fn: fleet-summed
+    queue depth and a windowed shed rate drive the pressure rule."""
+    clock = [0.0]
+    state = {"a": {"lat": [1.0], "counters": {"serving_shed_total": 0.0},
+                   "gauges": {"serving_queue_depth": 0.0}},
+             "b": {"lat": [1.0], "counters": {"serving_shed_total": 0.0},
+                   "gauges": {"serving_queue_depth": 0.0}}}
+    mon = _fleet_rig(state, clock)
+    assert mon.autoscale_metrics() is None     # no doc yet: caller
+    mon.tick()                                 # falls back to local
+    for _ in range(5):
+        clock[0] += 1.0
+        state["a"]["counters"]["serving_shed_total"] += 3.0
+        mon.tick()
+    m = mon.autoscale_metrics()
+    assert m["shed_rate"] == pytest.approx(3.0)
+    assert m["replicas_up"] == 2
+    sc = AutoScaler(mon.autoscale_metrics, lambda: None, lambda: None,
+                    replicas_fn=lambda: 1, min_replicas=1, max_replicas=3,
+                    up_ticks=2, down_ticks=2, cooldown=2, up_depth=4.0,
+                    interval_s=10.0)
+    assert sc.tick() is None
+    assert sc.tick() == "up"                   # windowed fleet pressure
+
+
+def test_rollout_gate_uses_merged_buckets(telemetry_on):
+    """Gate verdicts are fleet-exact: a canary whose p99 is fine on the
+    union (one replica's blip is <1% fleet-wide) PASSES where the old
+    worst-replica fold would have tripped — and still TRIPS when the
+    union really is slow."""
+    def snap_for(version, samples, n_req):
+        return {"histograms":
+                {"serving_execute_ms{model=%s}" % version:
+                 _hist_dump(samples)},
+                "counters":
+                {"serving_requests_total{model=%s,tenant=t}" % version:
+                 float(n_req)}}
+
+    base = merge_stats([
+        stats_from_snapshot(snap_for("fc", [10.0] * 300, 300), "fc"),
+        stats_from_snapshot(snap_for("fc", [12.0] * 300, 300), "fc")])
+    # canary: replica 1 had 2 slow requests out of 600 fleet-wide —
+    # locally that replica's p99 is 400ms (> 2x baseline)
+    c1 = stats_from_snapshot(
+        snap_for("fc@v2", [11.0] * 98 + [400.0] * 2, 100), "fc@v2")
+    c2 = stats_from_snapshot(snap_for("fc@v2", [11.0] * 500, 500),
+                             "fc@v2")
+    assert c1["p99_ms"] == 400.0
+    canary = merge_stats([c1, c2])
+    assert canary["p99_ms"] < 30.0       # union p99: the blip vanishes
+    v = evaluate_gate(canary, base, p99_ratio=2.0, error_rate=0.1,
+                      min_samples=50)
+    assert v["verdict"] == "pass"
+    # genuinely slow canary still trips on the merged value
+    slow = merge_stats([
+        stats_from_snapshot(
+            snap_for("fc@v2", [60.0] * 100, 100), "fc@v2"),
+        stats_from_snapshot(
+            snap_for("fc@v2", [60.0] * 100, 100), "fc@v2")])
+    v = evaluate_gate(slow, base, p99_ratio=2.0, error_rate=0.1,
+                      min_samples=50)
+    assert v["verdict"] == "trip"

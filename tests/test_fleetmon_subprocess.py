@@ -1,0 +1,165 @@
+"""Fleet observability plane end to end (PR 18, real subprocesses).
+
+Scenario: a 2-replica tools/serve.py fleet with telemetry on; traffic
+flows; then ``tools/fleet_top.py --once --json`` is run twice — once in
+local-aggregate mode (its own FleetMonitor scrapes both replicas) and
+once against the coordinator's published ``__fleet__`` doc — and the
+schema round-trips: every top-level key the dashboard renders is
+present, both replicas appear as rows, and the fleet-merged
+``server_ms`` histogram carries the traffic that was just sent.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dist_utils import free_ports, gather_tails
+
+# multi-second subprocess scenario: excluded from the tier-1 wall
+# (-m 'not slow') but still run by tools/run_ci.sh --fleetmon-smoke
+pytestmark = pytest.mark.slow
+
+_TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+_SERVE = os.path.join(_TOOLS, "serve.py")
+_FLEET_TOP = os.path.join(_TOOLS, "fleet_top.py")
+
+SCHEMA_KEYS = {"t", "epoch", "interval_s", "rate_window_s", "replicas",
+               "replicas_up", "histograms", "counters", "rates",
+               "goodput", "slo", "bucket_bounds"}
+ROW_KEYS = {"endpoint", "role", "up", "queue_depth", "batch_fill_p50",
+            "kv_occupancy", "prefix_hit_rate", "p99_ms", "shed_total"}
+
+
+def _env(tmp):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "FLAGS_telemetry": "1",
+        "FLAGS_static_check": "error",
+        "FLAGS_serving_hb_interval": "0.2",
+        "FLAGS_serving_hb_timeout": "1.5",
+        "FLAGS_serving_fleetmon_interval": "0.5",
+        "FLAGS_serving_rate_window": "10.0",
+        "FLAGS_compile_cache_dir": os.path.join(str(tmp), "cc"),
+    })
+    return env
+
+
+def _wait_ready(proc, timeout=120.0):
+    deadline = time.time() + timeout
+    lines = []
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        lines.append(line)
+        if line.startswith("READY"):
+            return lines
+    raise AssertionError("server not READY:\n" + "".join(lines))
+
+
+def _fleet_top(args, env, timeout=60.0):
+    out = subprocess.run(
+        [sys.executable, _FLEET_TOP] + args + ["--once", "--json"],
+        env=env, capture_output=True, text=True, timeout=timeout)
+    assert out.returncode == 0, out.stderr
+    return json.loads(out.stdout)
+
+
+def _check_doc(doc, eps):
+    assert SCHEMA_KEYS <= set(doc), sorted(doc)
+    rows = {r["endpoint"]: r for r in doc["replicas"]}
+    assert set(rows) == set(eps)
+    for r in rows.values():
+        assert ROW_KEYS <= set(r), sorted(r)
+        assert r["up"] is True
+        assert set(r["p99_ms"]) == {"server_ms", "ttft_ms", "itl_ms",
+                                    "serving_execute_ms"}
+    assert doc["replicas_up"] == 2
+    assert len(doc["bucket_bounds"]) == \
+        len(json.loads(json.dumps(doc))["bucket_bounds"])  # JSON-clean
+    merged = [h for flat, h in doc["histograms"].items()
+              if flat.split("{", 1)[0] == "server_ms"]
+    assert merged and sum(h["count"] for h in merged) >= 30
+    for h in merged:
+        assert h["buckets"][-1] == h["count"]
+    # default rules parse from flags: both appear with burn state
+    assert {s["name"] for s in doc["slo"]} == {"paid_server",
+                                               "decode_itl"}
+    for s in doc["slo"]:
+        assert {"burn_fast", "burn_slow", "active"} <= set(s)
+
+
+def test_fleet_top_schema_roundtrip_live_fleet(tmp_path):
+    from paddle_tpu.serving import ServingClient
+
+    sys.path.insert(0, _TOOLS)
+    from serve import save_demo_model
+
+    model_dir = save_demo_model(str(tmp_path / "model"))
+    eps_file = str(tmp_path / "eps.json")
+    ports = free_ports(2)
+    eps = ["127.0.0.1:%d" % p for p in ports]
+    env = _env(tmp_path)
+
+    procs = []
+    try:
+        for rank in range(2):
+            procs.append(("replica%d" % rank, subprocess.Popen(
+                [sys.executable, "-u", _SERVE, "--model",
+                 "fc=" + model_dir, "--rank", str(rank),
+                 "--fleet", ",".join(eps), "--endpoints-file", eps_file],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True,
+                start_new_session=True)))
+        for _, p in procs:
+            _wait_ready(p)
+        for _, p in procs:
+            threading.Thread(target=p.stdout.read, daemon=True).start()
+
+        cli = ServingClient(endpoints_file=eps_file)
+        x = np.ones((2, 8), np.float32)
+        for _ in range(40):
+            r = cli.infer("fc", {"x": x}, deadline_ms=15000)
+            assert r.status == "ok"
+            time.sleep(0.02)
+        time.sleep(1.5)       # > one publisher tick on both replicas
+
+        # local-aggregate mode: fleet_top's own FleetMonitor scrapes
+        # both replicas through the endpoints file
+        doc = _fleet_top(["--endpoints-file", eps_file], env)
+        _check_doc(doc, eps)
+
+        # published-aggregate mode: the coordinator's FleetMonitor has
+        # been republishing under __fleet__; one GET returns the same
+        # schema (poll: its first tick may still be in flight)
+        deadline = time.time() + 30
+        doc = None
+        while time.time() < deadline:
+            try:
+                doc = _fleet_top(["--scrape", eps[0]], env)
+                break
+            except (AssertionError, ValueError):
+                time.sleep(0.5)
+        assert doc is not None, "__fleet__ never published"
+        _check_doc(doc, eps)
+        assert doc["goodput"]["raw_replies_per_s"] > 0.0
+
+        # metrics_dump --fleet reads the same doc
+        out = subprocess.run(
+            [sys.executable, os.path.join(_TOOLS, "metrics_dump.py"),
+             "--scrape", eps[0], "--fleet", "--raw"],
+            env=env, capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr
+        assert SCHEMA_KEYS <= set(json.loads(out.stdout))
+    finally:
+        fail_dump = gather_tails(procs)
+        del fail_dump

@@ -67,6 +67,50 @@ def render_table(snap, out=sys.stdout):
         out.write("(empty snapshot — was FLAGS_telemetry on?)\n")
 
 
+def render_fleet(doc, out=sys.stdout):
+    """Human view of a ``__fleet__`` aggregate (serving/fleetmon.py):
+    per-replica rows, fleet-merged histograms, windowed rates, goodput,
+    and SLO burn state."""
+    out.write("fleet @ t=%.3f epoch=%s replicas_up=%s\n"
+              % (doc.get("t", 0.0), doc.get("epoch", "?"),
+                 doc.get("replicas_up", "?")))
+    for r in doc.get("replicas", []):
+        p99 = r.get("p99_ms", {})
+        out.write("  %-22s role=%-8s up=%-5s q=%-5g kv=%-5.2f "
+                  "hit=%-5.2f server_p99=%-8g itl_p99=%g\n"
+                  % (r.get("endpoint", "?"), r.get("role", "?"),
+                     r.get("up"), r.get("queue_depth", 0.0),
+                     r.get("kv_occupancy", 0.0),
+                     r.get("prefix_hit_rate", 0.0),
+                     p99.get("server_ms", 0.0), p99.get("itl_ms", 0.0)))
+    hists = doc.get("histograms", {})
+    if hists:
+        out.write("fleet-merged histograms:\n")
+        for k in sorted(hists):
+            h = hists[k]
+            out.write("  %-40s n=%-6d p50=%-8g p90=%-8g p99=%g\n"
+                      % (k, h.get("count", 0), h.get("p50", 0.0),
+                         h.get("p90", 0.0), h.get("p99", 0.0)))
+    rates = doc.get("rates", {})
+    if rates:
+        out.write("windowed rates (/s over %gs):\n"
+                  % doc.get("rate_window_s", 0.0))
+        for k in sorted(rates):
+            if rates[k]:
+                out.write("  %-52s %g\n" % (k, rates[k]))
+    gp = doc.get("goodput", {})
+    if gp:
+        out.write("goodput: %s\n"
+                  % ", ".join("%s=%g" % kv for kv in sorted(gp.items())))
+    for s in doc.get("slo", []):
+        out.write("slo %-14s %s p%d obj=%gms burn fast=%.2f slow=%.2f "
+                  "%s\n" % (s["name"], s["metric"],
+                            round(s["quantile"] * 100),
+                            s["objective_ms"], s["burn_fast"],
+                            s["burn_slow"],
+                            "FIRING" if s["active"] else "ok"))
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     src = ap.add_mutually_exclusive_group(required=True)
@@ -76,6 +120,11 @@ def main(argv=None):
                      help="live pserver HOST:PORT (__metrics__ RPC)")
     ap.add_argument("--timeout", type=float, default=10.0,
                     help="scrape connect/RPC deadline in seconds")
+    ap.add_argument("--fleet", action="store_true", dest="fleet_doc",
+                    help="with --scrape: GET the coordinator's merged "
+                    "__fleet__ aggregate (serving/fleetmon.py) instead "
+                    "of one replica's __metrics__ snapshot; with --json "
+                    "render the file as a fleet doc")
     ap.add_argument("--prom", action="store_true",
                     help="emit Prometheus exposition text")
     ap.add_argument("--raw", action="store_true",
@@ -141,10 +190,24 @@ def main(argv=None):
     if args.json_path:
         with open(args.json_path) as f:
             snap = json.load(f)
+    elif args.fleet_doc:
+        from paddle_tpu import telemetry
+        from paddle_tpu.serving.fleetmon import FLEET_RPC_KEY
+
+        snap = telemetry.scrape(args.endpoint, timeout=args.timeout,
+                                key=FLEET_RPC_KEY)
     else:
         from paddle_tpu import telemetry
 
         snap = telemetry.scrape(args.endpoint, timeout=args.timeout)
+
+    if args.fleet_doc:
+        if args.raw:
+            json.dump(snap, sys.stdout, indent=1)
+            sys.stdout.write("\n")
+        else:
+            render_fleet(snap)
+        return 0
 
     if args.elastic:
         snap = _filter_snap(snap, "elastic_")

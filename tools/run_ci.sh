@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI harness (reference paddle/scripts/paddle_build.sh analog): build the
 # native pieces, run the full test pyramid, smoke the bench + graft entry.
-# Usage: tools/run_ci.sh [quick|full|tpu|--layout-smoke|--obs-smoke|--lint|--elastic-smoke|--zero1-smoke|--cache-smoke|--kernel-smoke|--serve-smoke|--trace-smoke|--decode-smoke|--disagg-smoke|--ckpt-smoke]
+# Usage: tools/run_ci.sh [quick|full|tpu|--layout-smoke|--obs-smoke|--lint|--elastic-smoke|--zero1-smoke|--cache-smoke|--kernel-smoke|--serve-smoke|--fleetmon-smoke|--trace-smoke|--decode-smoke|--disagg-smoke|--ckpt-smoke]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -396,6 +396,202 @@ EOF
   trap - EXIT
   rm -rf "$SRV_DIR"
   echo "CI --serve-smoke: PASS"
+  exit 0
+fi
+
+if [ "$MODE" = "--fleetmon-smoke" ]; then
+  # fleet observability leg (PR 18): the mergeable-histogram / windowed-
+  # rate / burn-alert unit tests plus the live fleet_top schema test,
+  # then a 2-replica fleet where rank 1 carries an injected ~100ms
+  # execute delay — the coordinator's FleetMonitor must publish a
+  # fleet-merged server_ms p99 that REFLECTS the slow replica (the
+  # healthy replica's local p99 stays fast), the multi-window burn-rate
+  # alert must FIRE under the seeded Poisson load and CLEAR after the
+  # fault window drains, and a trimmed PR-16 autoscale pass must still
+  # scale 1->2 with pressure now sourced from the monitor's windowed
+  # fleet rates
+  echo "== fleetmon smoke: metrics plane + live fleet_top tests =="
+  JAX_PLATFORMS=cpu FLAGS_static_check=error \
+    python -m pytest tests/test_fleetmon.py \
+    tests/test_fleetmon_subprocess.py -q
+  echo "== fleetmon smoke: 2-replica fleet, one slow replica =="
+  FM_DIR="$(mktemp -d)"
+  JAX_PLATFORMS=cpu python tools/serve.py --save-demo-model "$FM_DIR/model"
+  FM_ENV=(JAX_PLATFORMS=cpu FLAGS_static_check=error FLAGS_telemetry=1
+          FLAGS_serving_hb_interval=0.2 FLAGS_serving_hb_timeout=1.5
+          FLAGS_serving_fleetmon_interval=0.5
+          FLAGS_serving_rate_window=10
+          FLAGS_serving_slo_fast_window=6
+          FLAGS_serving_slo_slow_window=15
+          FLAGS_serving_slo_rules="srv:server_ms:p99:60"
+          FLAGS_compile_cache_dir="$FM_DIR/cc")
+  env "${FM_ENV[@]}" python tools/serve.py --model fc="$FM_DIR/model" \
+    --rank 0 --fleet 127.0.0.1:9470,127.0.0.1:9471 --buckets 1,4 \
+    --endpoints-file "$FM_DIR/eps.json" > "$FM_DIR/f0.log" 2>&1 &
+  F0=$!
+  env "${FM_ENV[@]}" FLAGS_fault_spec="serving.execute.fc:delay:1.0" \
+    python tools/serve.py --model fc="$FM_DIR/model" \
+    --rank 1 --fleet 127.0.0.1:9470,127.0.0.1:9471 --buckets 1,4 \
+    --endpoints-file "$FM_DIR/eps.json" > "$FM_DIR/f1.log" 2>&1 &
+  F1=$!
+  trap 'kill -9 $F0 $F1 2>/dev/null || true; pkill -9 -f "127.0.0.1:9470,127.0.0.1:9471" 2>/dev/null || true' EXIT
+  for _ in $(seq 90); do
+    grep -q READY "$FM_DIR/f0.log" && grep -q READY "$FM_DIR/f1.log" \
+      && break
+    sleep 1
+  done
+  grep -q READY "$FM_DIR/f0.log" && grep -q READY "$FM_DIR/f1.log"
+  # seeded Poisson load, half landing on the delayed replica; runs in
+  # the background while the monitor's windows fill
+  JAX_PLATFORMS=cpu python tools/loadgen.py \
+    --endpoints-file "$FM_DIR/eps.json" --model fc --requests 300 \
+    --qps 40 --seed 7 --deadline-ms 5000 --batch-mix 1 \
+    --out "$FM_DIR/BENCH_fleetmon.json" &
+  FLG=$!
+  # the merged p99 must reflect the slow replica while the healthy
+  # replica's own row stays fast, and the burn alert must fire
+  python - <<'EOF'
+import sys, time
+from paddle_tpu.core import telemetry
+deadline = time.time() + 60
+fired = reflected = False
+while time.time() < deadline and not (fired and reflected):
+    try:
+        doc = telemetry.scrape("127.0.0.1:9470", timeout=3.0,
+                               key="__fleet__")
+    except Exception:
+        time.sleep(0.5)
+        continue
+    merged = [h for k, h in doc["histograms"].items()
+              if k.split("{", 1)[0] == "server_ms"]
+    if merged and max(h["p99"] for h in merged) >= 60.0:
+        rows = {r["endpoint"]: r for r in doc["replicas"]}
+        fast = rows.get("127.0.0.1:9470", {}).get("p99_ms", {})
+        if fast.get("server_ms", 1e9) < max(h["p99"] for h in merged):
+            reflected = True
+    if any(s["active"] for s in doc.get("slo", [])):
+        fired = True
+    time.sleep(0.5)
+if not reflected:
+    sys.exit("fleet-merged p99 never reflected the slow replica")
+if not fired:
+    sys.exit("burn-rate alert never fired under the injected delay")
+print("fleet p99 reflects slow replica; SLO alert FIRED")
+EOF
+  wait $FLG
+  # load is over: the fast window drains and the alert must clear
+  python - <<'EOF'
+import sys, time
+from paddle_tpu.core import telemetry
+deadline = time.time() + 60
+while time.time() < deadline:
+    try:
+        doc = telemetry.scrape("127.0.0.1:9470", timeout=3.0,
+                               key="__fleet__")
+        snap = telemetry.scrape("127.0.0.1:9470", timeout=3.0)
+    except Exception:
+        time.sleep(0.5)
+        continue
+    c = snap.get("counters", {})
+    fires = sum(v for k, v in c.items()
+                if k.startswith("slo_alerts_total{event=fire"))
+    clears = sum(v for k, v in c.items()
+                 if k.startswith("slo_alerts_total{event=clear"))
+    # the __metrics__ snapshot republishes on its own 1s cadence, so
+    # the clear counter can lag the doc's active flag by one tick —
+    # wait for BOTH
+    if not any(s["active"] for s in doc.get("slo", [])) \
+            and fires >= 1 and clears >= 1:
+        print("SLO alert CLEARED (fires=%d clears=%d)"
+              % (fires, clears))
+        sys.exit(0)
+    time.sleep(0.5)
+sys.exit("burn-rate alert never cleared after the fault window")
+EOF
+  # operator surface against the live fleet: fleet_top --once --json
+  # must emit the full schema, goodput included
+  env "${FM_ENV[@]}" python tools/fleet_top.py --scrape 127.0.0.1:9470 \
+    --once --json > "$FM_DIR/fleet_top.json"
+  python - "$FM_DIR/fleet_top.json" <<'EOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+need = {"t", "replicas", "replicas_up", "histograms", "counters",
+        "rates", "goodput", "slo", "bucket_bounds"}
+missing = need - set(doc)
+assert not missing, "fleet_top doc missing %s" % missing
+assert doc["replicas_up"] == 2, doc["replicas_up"]
+assert doc["goodput"]["raw_replies_per_s"] >= 0.0
+print("fleet_top schema OK: %d replicas, %d merged histograms"
+      % (len(doc["replicas"]), len(doc["histograms"])))
+EOF
+  kill -9 $F0 $F1 2>/dev/null || true
+  pkill -9 -f "127.0.0.1:9470,127.0.0.1:9471" 2>/dev/null || true
+  trap - EXIT
+
+  echo "== fleetmon smoke: autoscale 1->2 from windowed fleet rates =="
+  # trimmed PR-16 leg on fresh ports: with the FleetMonitor running,
+  # the coordinator's AutoScaler reads autoscale_metrics() (fleet
+  # queue depth + windowed shed/s) instead of local instants — the
+  # standby must still fork into slot 1 under sustained overload
+  env "${FM_ENV[@]}" FLAGS_serving_max_queue=4 \
+    FLAGS_serving_autoscale_interval=0.25 FLAGS_serving_scale_up_ticks=2 \
+    FLAGS_serving_scale_down_ticks=4 FLAGS_serving_autoscale_cooldown=4 \
+    python tools/serve.py --model fc="$FM_DIR/model" --rank 0 \
+    --fleet 127.0.0.1:9477,127.0.0.1:9478 --buckets 1 \
+    --endpoints-file "$FM_DIR/aeps.json" --autoscale --max-replicas 2 \
+    > "$FM_DIR/a0.log" 2>&1 &
+  FA0=$!
+  trap 'kill -9 $FA0 2>/dev/null || true; pkill -9 -f "127.0.0.1:9477,127.0.0.1:9478" 2>/dev/null || true' EXIT
+  for _ in $(seq 60); do grep -q READY "$FM_DIR/a0.log" && break; sleep 1; done
+  grep -q READY "$FM_DIR/a0.log"
+  python - "$FM_DIR/aeps.json" <<'EOF'
+import json, sys, time
+deadline = time.time() + 30
+while time.time() < deadline:
+    try:
+        if len(json.load(open(sys.argv[1]))["endpoints"]) == 1:
+            sys.exit(0)
+    except Exception:
+        pass
+    time.sleep(0.3)
+sys.exit("fleet never settled to 1 live replica")
+EOF
+  JAX_PLATFORMS=cpu python tools/loadgen.py --endpoints 127.0.0.1:9477 \
+    --model fc --requests 800 --qps 500 --batch-mix 1 --seed 7 \
+    --out "$FM_DIR/BENCH_fm_autoscale.json" &
+  FALG=$!
+  python - "$FM_DIR/aeps.json" <<'EOF'
+import json, sys, time
+deadline = time.time() + 90
+while time.time() < deadline:
+    try:
+        if len(json.load(open(sys.argv[1]))["endpoints"]) == 2:
+            print("scaled UP to 2 replicas")
+            sys.exit(0)
+    except Exception:
+        pass
+    time.sleep(0.3)
+sys.exit("autoscaler never scaled up under overload")
+EOF
+  wait $FALG || true
+  # the monitor was live (fleet_replicas_up published) and the scale-up
+  # event fired — the unit tests pin that the pressure values came from
+  # autoscale_metrics()'s windowed view
+  python - <<'EOF'
+from paddle_tpu.core import telemetry
+snap = telemetry.scrape("127.0.0.1:9477")
+up = snap.get("counters", {}).get("autoscale_events_total{dir=up}", 0)
+assert up >= 1, "autoscale_events_total{dir=up}=%s" % up
+assert snap.get("gauges", {}).get("fleet_replicas_up", 0) >= 1, \
+    "FleetMonitor never ticked on the coordinator"
+print("autoscale up=%d with fleet_replicas_up=%g" % (
+    up, snap["gauges"]["fleet_replicas_up"]))
+EOF
+  kill -9 $FA0 2>/dev/null || true
+  pkill -9 -f "127.0.0.1:9477,127.0.0.1:9478" 2>/dev/null || true
+  trap - EXIT
+  rm -rf "$FM_DIR"
+  echo "CI --fleetmon-smoke: PASS"
   exit 0
 fi
 

@@ -235,6 +235,20 @@ def main(argv=None):
 
     server.rollout = RolloutController(server, fleet).start()
 
+    # fleet observability plane (PR 18): scrape every live replica each
+    # tick, merge histograms / window rates / evaluate burn-rate SLOs,
+    # republish the merged doc under __fleet__ on the coordinator.  The
+    # autoscaler closures below prefer its fleet-windowed view.
+    monitor = None
+    from paddle_tpu.core import telemetry as _tmon
+
+    if _tmon.enabled() and (fleet is not None or args.endpoints_file):
+        from paddle_tpu.serving import FleetMonitor
+
+        monitor = FleetMonitor(server=server, fleet=fleet,
+                               endpoints_file=args.endpoints_file).start()
+    server.fleetmon = monitor
+
     done = threading.Event()
     # a drained __retire__ order exits the process like a SIGTERM would
     server.on_retire = done.set
@@ -299,6 +313,13 @@ def main(argv=None):
 
         if roles is None:
             def metrics():
+                # fleet-windowed view when the monitor has a doc (queue
+                # depth summed across replicas, shed/s over the rate
+                # window); local instants only until its first tick
+                if monitor is not None:
+                    m = monitor.autoscale_metrics()
+                    if m is not None and m.get("replicas_up"):
+                        return m
                 return {"queue_depth": local_depth(),
                         "shed_total": _tm.counter_total(
                             "serving_shed_total")}
@@ -316,6 +337,10 @@ def main(argv=None):
             # __metrics__; this replica contributes locally.
             def role_metrics(want_role):
                 def fn():
+                    if monitor is not None:
+                        m = monitor.autoscale_metrics(want_role)
+                        if m is not None and m.get("replicas_up"):
+                            return m
                     depth = occ = shed = 0.0
                     for ep in fleet.live_role_endpoints(want_role):
                         if ep == fleet.endpoints[fleet.rank]:
@@ -379,6 +404,8 @@ def main(argv=None):
     done.wait()
     for scaler in scalers:
         scaler.stop()
+    if monitor is not None:
+        monitor.stop()
     if fleet is not None:
         fleet.stop()
     server.shutdown()
