@@ -91,6 +91,20 @@ RULES = {
     "MEM001": "static per-replica peak-HBM estimate (informational)",
     "MEM002": "donation opportunity the executor is not exploiting",
     "MEM003": "predicted peak HBM exceeds FLAGS_hbm_budget_bytes",
+    # concurrency rules (core/concurrency_analysis.py, tools/threadlint.py):
+    # AST-only lint of the thread-heavy Python runtime — the layer the
+    # program verifiers cannot see
+    "CC101": "lock-order inversion (acquisition-graph cycle or declared "
+             "LOCK_ORDER violated)",
+    "CC102": "blocking call (RPC, sleep, subprocess, file I/O, join, "
+             "compile/step) while holding a lock",
+    "CC103": "attribute guarded by a lock in some methods but accessed "
+             "lock-free on a thread path",
+    "CC104": "Condition.wait without an enclosing while predicate-recheck "
+             "loop",
+    "CC105": "callback declared fired-unlocked invoked while holding the "
+             "owner's lock",
+    "CC106": "Thread started without daemon=True or a tracked join() path",
 }
 
 

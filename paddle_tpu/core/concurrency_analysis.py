@@ -1,0 +1,1305 @@
+"""Static concurrency analyzer for the Python runtime (CC1xx rules).
+
+The program verifiers (`core/analysis.py`, `core/world_analysis.py`) prove
+graph invariants before anything runs; this module gives the thread-heavy
+Python runtime that grew around them (serving engine, kvxfer sender,
+janitors, autoscaler, fleetmon, checkpoint writer, elastic heartbeats)
+the same treatment.  Pure AST analysis — nothing is imported or executed.
+
+Rules (see ``CC_RULES``):
+
+  CC101  lock-order inversion: the per-class lock inventory plus the
+         acquisition graph (nested ``with``/``acquire`` sites, propagated
+         through resolvable calls) must be acyclic AND consistent with
+         every declared ``LOCK_ORDER`` table.
+  CC102  blocking call while holding a lock (RPC send/recv/probe,
+         ``time.sleep``, ``subprocess``, file I/O, ``Thread.join``,
+         executor compile/step) — waivable inline.
+  CC103  guarded-attribute escape: attribute written under a class's own
+         lock in some methods but read/written lock-free in code
+         reachable from a ``Thread(target=...)`` entry point.
+  CC104  ``Condition.wait`` without an enclosing ``while`` predicate-
+         recheck loop.
+  CC105  callback invoked under a lock that its registration site
+         declares fired-unlocked (``UNLOCKED_CALLBACKS`` registries —
+         the ``on_evict`` "AFTER lock release" contract).
+  CC106  ``Thread(...)`` started without ``daemon=True`` or a tracked
+         ``join()`` path.
+
+Machine-readable registries (module-level literals, merged package-wide):
+
+  LOCK_ORDER = (("PrefixCache._lock", "BlockAllocator._lock"),)
+  UNLOCKED_CALLBACKS = ("BlockAllocator.on_evict",)
+
+Lock identities are ``ClassName._attr`` for instance locks and
+``modstem._name`` for module-level locks.
+
+Inline waivers (spell the rule id literally, e.g. CC102)::
+
+  self._stepfn(feed)   # threadlint: waive CC1xx <why this is safe>
+
+A waiver comment on the finding's line (or the line directly above it)
+downgrades the finding; the report lists every waiver it used and the
+run exits clean only when all error/warning findings are waived.
+"""
+
+import ast
+import os
+import re
+
+from .analysis import ERROR, WARNING, INFO
+
+__all__ = [
+    "CC_RULES", "ThreadDiagnostic", "ThreadLintReport",
+    "analyze_paths", "report_telemetry",
+]
+
+# rule id -> one-line catalog entry (README "Static checking" renders this;
+# core/analysis.py RULES carries the same entries for the shared catalog)
+CC_RULES = {
+    "CC101": "lock-order inversion (acquisition-graph cycle or declared "
+             "LOCK_ORDER violated)",
+    "CC102": "blocking call (RPC, sleep, subprocess, file I/O, join, "
+             "compile/step) while holding a lock",
+    "CC103": "attribute guarded by a lock in some methods but accessed "
+             "lock-free on a thread path",
+    "CC104": "Condition.wait without an enclosing while predicate-recheck "
+             "loop",
+    "CC105": "callback declared fired-unlocked invoked while holding the "
+             "owner's lock",
+    "CC106": "Thread started without daemon=True or a tracked join() path",
+}
+
+_WAIVE_RE = re.compile(
+    r"#\s*threadlint:\s*waive\s+(CC\d{3})(?:\s+(.*?))?\s*$")
+_EXPECT_RE = re.compile(r"#\s*threadlint-expect:\s*(CC\d{3})")
+
+_LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "condition"}
+
+# ("any", name) call hints are resolved to a class method only when the
+# name is defined by exactly ONE class in the analyzed set AND is not one
+# of these generic names (builtin-collection / stdlib-object methods that
+# would otherwise mis-resolve `d.get(...)` to some class's `get`)
+_GENERIC_METHODS = frozenset((
+    "get", "pop", "append", "appendleft", "popleft", "add", "remove",
+    "clear", "update", "items", "keys", "values", "join", "split", "read",
+    "write", "close", "open", "send", "recv", "encode", "decode", "copy",
+    "sort", "extend", "discard", "popitem", "setdefault", "wait", "set",
+    "acquire", "release", "notify", "notify_all", "start", "run", "put",
+    "get_nowait", "put_nowait", "flush", "next", "submit", "result",
+    "shutdown", "is_set", "is_alive", "index", "count", "insert",
+    "reverse", "strip", "format", "sleep", "exists", "mkdirs", "ls_dir",
+    "stop", "tick", "check", "handle", "poll", "serve", "reset", "save",
+    "restore", "load", "dump", "name", "kill", "size", "push", "drain",
+))
+
+_RPC_METHODS = frozenset((
+    "send_var", "get_var", "probe", "barrier", "send_complete",
+    "send_expect_now",
+))
+_EXECUTOR_BLOCKING = frozenset((
+    "stepfn", "warmup", "verifyfn", "rolloutfn", "ingestfn"))
+
+
+def _dotted(node):
+    """Attribute/Name chain -> "a.b.c", or None for anything fancier."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _last_comp(func):
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _is_self_attr(node):
+    return (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self")
+
+
+def _ctor_kind(call):
+    """'lock'/'rlock'/'condition'/'event'/'thread' for a recognized
+    threading-object constructor call, else None."""
+    name = _last_comp(call.func)
+    if name in _LOCK_CTORS:
+        return _LOCK_CTORS[name]
+    if name == "Event":
+        return "event"
+    if name == "Thread":
+        return "thread"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# diagnostics / report
+# ---------------------------------------------------------------------------
+
+class ThreadDiagnostic:
+    """One structured finding: severity, rule id, file:line, fix."""
+
+    __slots__ = ("severity", "rule", "message", "path", "line", "func",
+                 "suggestion", "waived", "waive_reason")
+
+    def __init__(self, severity, rule, message, path, line, func=None,
+                 suggestion=None):
+        self.severity = severity
+        self.rule = rule
+        self.message = message
+        self.path = path
+        self.line = line
+        self.func = func
+        self.suggestion = suggestion
+        self.waived = False
+        self.waive_reason = None
+
+    def location(self):
+        where = "%s:%s" % (self.path, self.line)
+        if self.func:
+            where += " in %s" % self.func
+        return where
+
+    def format(self):
+        line = "%s %s [%s]: %s" % (
+            self.rule, "waived" if self.waived else self.severity.upper(),
+            self.location(), self.message)
+        if self.waived and self.waive_reason:
+            line += "\n    waiver: %s" % self.waive_reason
+        elif self.suggestion:
+            line += "\n    fix: %s" % self.suggestion
+        return line
+
+    def to_dict(self):
+        return {"severity": self.severity, "rule": self.rule,
+                "message": self.message, "path": self.path,
+                "line": self.line, "func": self.func,
+                "suggestion": self.suggestion, "waived": self.waived,
+                "waive_reason": self.waive_reason}
+
+    def __repr__(self):
+        return "ThreadDiagnostic(%s, %s, %s)" % (
+            self.rule, self.severity, self.location())
+
+
+class ThreadLintReport:
+    """Ordered diagnostic list with severity views, waiver accounting and
+    a readable render (mirrors core.analysis.VerifyReport)."""
+
+    def __init__(self, diagnostics=(), label="paddle_tpu"):
+        self.diagnostics = list(diagnostics)
+        self.label = label
+        self.unused_waivers = []   # [(path, line, rule, reason)]
+
+    def add(self, *args, **kwargs):
+        self.diagnostics.append(ThreadDiagnostic(*args, **kwargs))
+
+    def extend(self, other):
+        self.diagnostics.extend(other.diagnostics)
+
+    @property
+    def errors(self):
+        return [d for d in self.diagnostics
+                if d.severity == ERROR and not d.waived]
+
+    @property
+    def warnings(self):
+        return [d for d in self.diagnostics
+                if d.severity == WARNING and not d.waived]
+
+    @property
+    def infos(self):
+        return [d for d in self.diagnostics
+                if d.severity == INFO and not d.waived]
+
+    @property
+    def waived(self):
+        return [d for d in self.diagnostics if d.waived]
+
+    def by_rule(self, rule):
+        return [d for d in self.diagnostics if d.rule == rule]
+
+    @property
+    def ok(self):
+        """No unwaived errors and no unwaived warnings."""
+        return not self.errors and not self.warnings
+
+    def format(self, max_items=80, include_info=True):
+        shown = [d for d in self.diagnostics
+                 if include_info or d.severity != INFO]
+        head = ("concurrency check of %s: %d error(s), %d warning(s), "
+                "%d info, %d waived" % (
+                    self.label, len(self.errors), len(self.warnings),
+                    len(self.infos), len(self.waived)))
+        lines = [head]
+        for d in shown[:max_items]:
+            lines.append("  " + d.format().replace("\n", "\n  "))
+        if len(shown) > max_items:
+            lines.append("  ... %d more" % (len(shown) - max_items))
+        if self.waived:
+            lines.append("waivers in effect:")
+            for d in self.waived:
+                lines.append("  %s %s: %s" % (
+                    d.rule, d.location(), d.waive_reason or "(no reason)"))
+        for path, line, rule, _reason in self.unused_waivers:
+            lines.append("  note: unused waiver for %s at %s:%d"
+                         % (rule, path, line))
+        return "\n".join(lines)
+
+    def to_dict(self):
+        return {"label": self.label, "ok": self.ok,
+                "findings": [d.to_dict() for d in self.diagnostics],
+                "unused_waivers": [list(w) for w in self.unused_waivers]}
+
+    def __repr__(self):
+        return "<ThreadLintReport %s: %dE/%dW/%dI/%dX>" % (
+            self.label, len(self.errors), len(self.warnings),
+            len(self.infos), len(self.waived))
+
+
+# ---------------------------------------------------------------------------
+# pass A: module inventory
+# ---------------------------------------------------------------------------
+
+class _ClassInfo:
+    def __init__(self, name, module):
+        self.name = name
+        self.module = module
+        self.locks = {}         # attr -> kind (lock|rlock|condition)
+        self.events = set()     # Event-typed attrs
+        self.thread_attrs = set()
+        self.methods = {}       # name -> _FuncInfo
+        self.is_thread_subclass = False
+        self.daemon_subclass = False
+        self.joined_attrs = set()     # self.X.join(...) seen anywhere
+        self.thread_entries = set()   # method/nested qualnames run on threads
+
+
+class _FuncInfo:
+    def __init__(self, name, node, module, cls=None, parent=None):
+        self.name = name
+        self.node = node
+        self.module = module
+        self.cls = cls
+        self.parent = parent            # enclosing _FuncInfo for closures
+        self.nested = {}
+        self.qualname = (
+            (cls.name + "." if cls else "")
+            + (parent.name + "." if parent and parent is not cls else "")
+            + name)
+        # filled by pass B
+        self.local_acquires = {}        # lock_id -> line
+        self.edges = []                 # (held_id, acquired_id, line)
+        self.blocking = []              # (line, desc, held tuple, deep_only)
+        self.calls = []                 # (kind, name, line, held tuple)
+        self.cond_waits = []            # (lock_id, line, in_while, held tup)
+        self.attr_writes = []           # (attr, line, own_held, any_held)
+        self.attr_reads = []
+        self.thread_ctors = []          # (line, daemon, target_kind, target)
+        self.local_joins = set()
+        self.cc105_sites = []           # (attr, line, held tuple)
+        self.reentry = []               # (lock_id, line)
+
+
+class _ModuleInfo:
+    def __init__(self, path, display):
+        self.path = path
+        self.display = display
+        stem = os.path.splitext(os.path.basename(path))[0]
+        if stem == "__init__":
+            stem = os.path.basename(os.path.dirname(path)) or stem
+        self.stem = stem
+        self.tree = None
+        self.parse_error = None
+        self.classes = {}
+        self.functions = {}
+        self.module_locks = {}          # name -> kind
+        self.lock_order = []            # list of tuples of lock ids
+        self.unlocked_callbacks = []    # ["Class.attr", ...]
+        self.import_names = set()       # names bound by import statements
+        self.waivers = {}               # line -> [rule, reason, used_flag]
+        try:
+            with open(path, "r", encoding="utf-8", errors="replace") as f:
+                src = f.read()
+        except OSError as e:
+            self.parse_error = str(e)
+            return
+        for i, text in enumerate(src.splitlines(), 1):
+            m = _WAIVE_RE.search(text)
+            if m:
+                self.waivers[i] = [m.group(1),
+                                   (m.group(2) or "").strip(), False]
+        try:
+            self.tree = ast.parse(src, filename=path)
+        except SyntaxError as e:
+            self.parse_error = str(e)
+            return
+        self._scan()
+
+    def _scan(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    self.import_names.add(
+                        alias.asname or alias.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                for alias in node.names:
+                    if alias.name != "*":
+                        self.import_names.add(alias.asname or alias.name)
+        for node in self.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                name = node.targets[0].id
+                if isinstance(node.value, ast.Call):
+                    kind = _ctor_kind(node.value)
+                    if kind in ("lock", "rlock", "condition"):
+                        self.module_locks[name] = kind
+                elif name in ("LOCK_ORDER", "UNLOCKED_CALLBACKS"):
+                    try:
+                        val = ast.literal_eval(node.value)
+                    except (ValueError, SyntaxError):
+                        continue
+                    if name == "LOCK_ORDER":
+                        self.lock_order = [tuple(t) for t in val]
+                    else:
+                        self.unlocked_callbacks = list(val)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = _FuncInfo(
+                    node.name, node, self)
+            elif isinstance(node, ast.ClassDef):
+                self._scan_class(node)
+
+    def _scan_class(self, node):
+        ci = _ClassInfo(node.name, self)
+        for base in node.bases:
+            if _last_comp(base) == "Thread":
+                ci.is_thread_subclass = True
+        for item in node.body:
+            if not isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            ci.methods[item.name] = _FuncInfo(
+                item.name, item, self, cls=ci)
+            # attribute inventory: self.X = threading.<ctor>() anywhere
+            for sub in ast.walk(item):
+                if not isinstance(sub, ast.Assign) \
+                        or not isinstance(sub.value, ast.Call):
+                    continue
+                kind = _ctor_kind(sub.value)
+                if kind is None:
+                    continue
+                for tgt in sub.targets:
+                    if not _is_self_attr(tgt):
+                        continue
+                    if kind in ("lock", "rlock", "condition"):
+                        ci.locks[tgt.attr] = kind
+                    elif kind == "event":
+                        ci.events.add(tgt.attr)
+                    elif kind == "thread":
+                        ci.thread_attrs.add(tgt.attr)
+            if ci.is_thread_subclass and item.name == "__init__":
+                for sub in ast.walk(item):
+                    if isinstance(sub, ast.Call) \
+                            and _last_comp(sub.func) == "__init__":
+                        for kw in sub.keywords:
+                            if kw.arg == "daemon" \
+                                    and isinstance(kw.value, ast.Constant) \
+                                    and kw.value.value is True:
+                                ci.daemon_subclass = True
+                    if isinstance(sub, ast.Assign):
+                        for tgt in sub.targets:
+                            if _is_self_attr(tgt) and tgt.attr == "daemon" \
+                                    and isinstance(sub.value, ast.Constant) \
+                                    and sub.value.value is True:
+                                ci.daemon_subclass = True
+        if ci.is_thread_subclass and "run" in ci.methods:
+            ci.thread_entries.add("run")
+        self.classes[node.name] = ci
+
+
+# ---------------------------------------------------------------------------
+# pass B: per-function analysis
+# ---------------------------------------------------------------------------
+
+class _Index:
+    """Package-wide resolution tables built from every _ModuleInfo."""
+
+    def __init__(self, modules):
+        self.modules = modules
+        self.methods_by_name = {}     # name -> [_FuncInfo]
+        self.lock_attr_owners = {}    # attr -> [class name]
+        self.lock_kinds = {}          # lock_id -> kind
+        self.thread_subclasses = {}   # class name -> _ClassInfo
+        self.lock_order = []
+        self.contracts = set()        # (class name, attr)
+        for mod in modules:
+            self.lock_order.extend(mod.lock_order)
+            for cb in mod.unlocked_callbacks:
+                if "." in cb:
+                    cls, attr = cb.rsplit(".", 1)
+                    self.contracts.add((cls, attr))
+            for name, kind in mod.module_locks.items():
+                self.lock_kinds["%s.%s" % (mod.stem, name)] = kind
+            for ci in mod.classes.values():
+                if ci.is_thread_subclass:
+                    self.thread_subclasses[ci.name] = ci
+                for attr, kind in ci.locks.items():
+                    self.lock_kinds["%s.%s" % (ci.name, attr)] = kind
+                    self.lock_attr_owners.setdefault(attr, []).append(
+                        ci.name)
+                for mname, fi in ci.methods.items():
+                    self.methods_by_name.setdefault(mname, []).append(fi)
+
+    def resolve(self, fi, kind, name):
+        if kind == "self":
+            if fi.cls is not None:
+                return fi.cls.methods.get(name)
+            return None
+        if kind == "mod":
+            if name in fi.nested:
+                return fi.nested[name]
+            if fi.parent is not None and name in fi.parent.nested:
+                return fi.parent.nested[name]
+            return fi.module.functions.get(name)
+        if kind == "any":
+            if name in _GENERIC_METHODS:
+                return None
+            cands = self.methods_by_name.get(name, ())
+            if len(cands) == 1:
+                return cands[0]
+        return None
+
+
+class _FuncScan:
+    """One recursive walk of a function body, tracking the held-lock set
+    and loop depth; fills the _FuncInfo summary fields."""
+
+    def __init__(self, fi, idx):
+        self.fi = fi
+        self.idx = idx
+        self.cls = fi.cls
+        self.mod = fi.module
+        self.alias_cb = {}       # local name -> contract callback attr
+        self.thread_alias = {}   # local name -> thread attr
+        self.local_threads = set()
+        self._consumed = set()   # id(Call) already handled by Assign
+
+    # -- lock reference resolution ------------------------------------------
+
+    def lock_ref(self, node):
+        if _is_self_attr(node) and self.cls is not None:
+            if node.attr in self.cls.locks:
+                return "%s.%s" % (self.cls.name, node.attr)
+            return None
+        if isinstance(node, ast.Name):
+            if node.id in self.mod.module_locks:
+                return "%s.%s" % (self.mod.stem, node.id)
+            return None
+        if isinstance(node, ast.Attribute):
+            owners = self.idx.lock_attr_owners.get(node.attr, ())
+            if len(owners) == 1:
+                return "%s.%s" % (owners[0], node.attr)
+        return None
+
+    def lock_kind(self, lock_id):
+        return self.idx.lock_kinds.get(lock_id, "lock")
+
+    # -- entry ---------------------------------------------------------------
+
+    def run(self):
+        self.stmts(self.fi.node.body, (), 0)
+
+    # -- statement walking ---------------------------------------------------
+
+    def stmts(self, body, held, loop):
+        held = list(held)
+        for st in body:
+            if isinstance(st, ast.Expr) and isinstance(st.value, ast.Call):
+                f = st.value.func
+                if isinstance(f, ast.Attribute) \
+                        and f.attr in ("acquire", "release"):
+                    lid = self.lock_ref(f.value)
+                    if lid is not None:
+                        if f.attr == "acquire":
+                            self.on_acquire(lid, st.lineno, tuple(held))
+                            held.append(lid)
+                        elif lid in held:
+                            held.remove(lid)
+                        continue
+            self.stmt(st, tuple(held), loop)
+
+    def stmt(self, st, held, loop):
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            inner = list(held)
+            for item in st.items:
+                self.expr(item.context_expr, tuple(inner), loop)
+                lid = self.lock_ref(item.context_expr)
+                if lid is not None:
+                    self.on_acquire(lid, item.context_expr.lineno,
+                                    tuple(inner))
+                    inner.append(lid)
+            self.stmts(st.body, tuple(inner), loop)
+        elif isinstance(st, ast.While):
+            self.expr(st.test, held, loop + 1)
+            self.stmts(st.body, held, loop + 1)
+            self.stmts(st.orelse, held, loop)
+        elif isinstance(st, (ast.For, ast.AsyncFor)):
+            self.expr(st.iter, held, loop)
+            self.stmts(st.body, held, loop)
+            self.stmts(st.orelse, held, loop)
+        elif isinstance(st, ast.If):
+            self.expr(st.test, held, loop)
+            self.stmts(st.body, held, loop)
+            self.stmts(st.orelse, held, loop)
+        elif isinstance(st, ast.Try):
+            self.stmts(st.body, held, loop)
+            for h in st.handlers:
+                self.stmts(h.body, held, loop)
+            self.stmts(st.orelse, held, loop)
+            self.stmts(st.finalbody, held, loop)
+        elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            sub = _FuncInfo(st.name, st, self.mod, cls=self.cls,
+                            parent=self.fi)
+            self.fi.nested[st.name] = sub
+            _FuncScan(sub, self.idx).run()
+        elif isinstance(st, ast.ClassDef):
+            pass
+        elif isinstance(st, ast.Assign):
+            self.on_assign(st, held, loop)
+        elif isinstance(st, (ast.AugAssign, ast.AnnAssign)):
+            tgt = st.target
+            self.note_write_target(tgt, held)
+            if isinstance(st, ast.AugAssign) or st.value is not None:
+                self.expr(st.value, held, loop)
+            if isinstance(st, ast.AugAssign):
+                self.expr(tgt, held, loop)
+        elif isinstance(st, ast.Return):
+            if st.value is not None:
+                self.expr(st.value, held, loop)
+        else:
+            for child in ast.iter_child_nodes(st):
+                if isinstance(child, ast.expr):
+                    self.expr(child, held, loop)
+                elif isinstance(child, ast.stmt):
+                    self.stmt(child, held, loop)
+
+    def on_assign(self, st, held, loop):
+        val = st.value
+        if isinstance(val, ast.Call) and _ctor_kind(val) == "thread":
+            target = None
+            tkind = None
+            for tgt in st.targets:
+                if _is_self_attr(tgt):
+                    target, tkind = tgt.attr, "attr"
+                elif isinstance(tgt, ast.Name):
+                    target, tkind = tgt.id, "local"
+                    self.local_threads.add(tgt.id)
+            self.on_thread_ctor(val, held, target=target, tkind=tkind)
+            self._consumed.add(id(val))
+        elif isinstance(val, ast.Name) and len(st.targets) == 1 \
+                and isinstance(st.targets[0], ast.Name):
+            if val.id in self.local_threads:
+                self.local_threads.add(st.targets[0].id)
+        elif _is_self_attr(val) and self.cls is not None:
+            for tgt in st.targets:
+                if not isinstance(tgt, ast.Name):
+                    continue
+                if (self.cls.name, val.attr) in self.idx.contracts:
+                    self.alias_cb[tgt.id] = val.attr
+                if val.attr in self.cls.thread_attrs:
+                    self.thread_alias[tgt.id] = val.attr
+        for tgt in st.targets:
+            self.note_write_target(tgt, held)
+        self.expr(val, held, loop)
+
+    def note_write_target(self, tgt, held):
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self.note_write_target(el, held)
+            return
+        node = tgt
+        if isinstance(node, ast.Subscript):
+            node = node.value
+        if _is_self_attr(node) and self.cls is not None:
+            self.record_attr(self.fi.attr_writes, node.attr, tgt.lineno,
+                             held)
+
+    def record_attr(self, sink, attr, line, held):
+        own = any(l.startswith(self.cls.name + ".") for l in held)
+        sink.append((attr, line, own, bool(held)))
+
+    # -- expression walking --------------------------------------------------
+
+    def expr(self, node, held, loop):
+        if node is None or isinstance(node, ast.Lambda):
+            return
+        if isinstance(node, ast.Call):
+            self.on_call(node, held, loop)
+            self.expr(node.func, held, loop)
+            for a in node.args:
+                self.expr(a, held, loop)
+            for kw in node.keywords:
+                self.expr(kw.value, held, loop)
+            return
+        if _is_self_attr(node) and self.cls is not None \
+                and isinstance(node.ctx, ast.Load):
+            self.record_attr(self.fi.attr_reads, node.attr, node.lineno,
+                             held)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self.expr(child, held, loop)
+
+    # -- events --------------------------------------------------------------
+
+    def on_acquire(self, lid, line, held):
+        kind = self.lock_kind(lid)
+        if lid in held and kind == "lock":
+            self.fi.reentry.append((lid, line))
+        if lid not in self.fi.local_acquires:
+            self.fi.local_acquires[lid] = line
+        for h in held:
+            if h != lid:
+                self.fi.edges.append((h, lid, line))
+
+    def on_call(self, call, held, loop):
+        if id(call) in self._consumed:
+            return
+        func = call.func
+        last = _last_comp(func)
+        if last == "Thread" and isinstance(func, (ast.Attribute, ast.Name)):
+            self.on_thread_ctor(call, held)
+            return
+        if isinstance(func, ast.Name) \
+                and func.id in self.idx.thread_subclasses:
+            self.on_thread_ctor(
+                call, held, subclass=self.idx.thread_subclasses[func.id])
+            return
+        if isinstance(func, ast.Attribute) and func.attr == "wait":
+            if self.on_wait(call, func, held, loop):
+                return
+        desc = self.blocking_desc(call, func, last)
+        if desc is not None:
+            self.fi.blocking.append((call.lineno, desc, held, False))
+        # CC105: direct or aliased unlocked-contract callback call
+        if held:
+            if _is_self_attr(func) and self.cls is not None \
+                    and (self.cls.name, func.attr) in self.idx.contracts:
+                self.fi.cc105_sites.append((func.attr, call.lineno, held))
+            elif isinstance(func, ast.Name) and func.id in self.alias_cb:
+                self.fi.cc105_sites.append(
+                    (self.alias_cb[func.id], call.lineno, held))
+        # call hint for propagation
+        if isinstance(func, ast.Name):
+            self.fi.calls.append(("mod", func.id, call.lineno, held))
+        elif _is_self_attr(func):
+            self.fi.calls.append(("self", func.attr, call.lineno, held))
+        elif isinstance(func, ast.Attribute):
+            root = func.value
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            # a chain rooted at an imported name (os.makedirs, np.stack)
+            # targets that module, never a same-named method elsewhere in
+            # the package — suppress the unique-method-name hint
+            if not (isinstance(root, ast.Name)
+                    and root.id in self.mod.import_names):
+                self.fi.calls.append(("any", func.attr, call.lineno, held))
+
+    def on_wait(self, call, func, held, loop):
+        """-> True when fully handled (condition/event wait)."""
+        recv = func.value
+        lid = self.lock_ref(recv)
+        if lid is not None and self.lock_kind(lid) == "condition":
+            self.fi.cond_waits.append((lid, call.lineno, loop > 0, held))
+            others = tuple(h for h in held if h != lid)
+            if others:
+                self.fi.blocking.append(
+                    (call.lineno, "Condition.wait on %s" % lid, others,
+                     False))
+            elif lid in held:
+                # releases its own lock while parked: only relevant to a
+                # caller that holds an OUTER lock (deep propagation)
+                self.fi.blocking.append(
+                    (call.lineno, "Condition.wait on %s" % lid, held,
+                     True))
+            return True
+        if _is_self_attr(recv) and self.cls is not None \
+                and recv.attr in self.cls.events:
+            if held:
+                self.fi.blocking.append(
+                    (call.lineno, "Event.wait (self.%s)" % recv.attr,
+                     held, False))
+            return True
+        return False
+
+    def blocking_desc(self, call, func, last):
+        dotted = _dotted(func)
+        if dotted == "time.sleep":
+            return "time.sleep"
+        if dotted and dotted.split(".", 1)[0] == "subprocess":
+            return dotted
+        if dotted in ("os.replace", "os.rename"):
+            return dotted
+        if dotted == "open":
+            return "open (file I/O)"
+        if dotted in ("np.savez", "np.savez_compressed", "np.save",
+                      "np.load", "json.dump", "json.load",
+                      "shutil.copytree", "shutil.rmtree", "shutil.move"):
+            return "%s (file I/O)" % dotted
+        if last in _RPC_METHODS:
+            return "RPC %s" % last
+        if last in _EXECUTOR_BLOCKING:
+            return "executor %s (compile/device step)" % last
+        if last == "join" and isinstance(func, ast.Attribute):
+            recv = func.value
+            if _is_self_attr(recv) and self.cls is not None \
+                    and recv.attr in self.cls.thread_attrs:
+                self.cls.joined_attrs.add(recv.attr)
+                return "Thread.join (self.%s)" % recv.attr
+            if isinstance(recv, ast.Name):
+                if recv.id in self.thread_alias:
+                    self.cls.joined_attrs.add(self.thread_alias[recv.id])
+                    return "Thread.join (%s)" % recv.id
+                if recv.id in self.local_threads:
+                    self.fi.local_joins.add(recv.id)
+                    return "Thread.join (%s)" % recv.id
+        return None
+
+    def on_thread_ctor(self, call, held, target=None, tkind=None,
+                       subclass=None):
+        daemon = None
+        if subclass is not None:
+            daemon = True if subclass.daemon_subclass else None
+        for kw in call.keywords:
+            if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+                daemon = bool(kw.value.value)
+            elif kw.arg == "target":
+                self.register_target(kw.value)
+        self.fi.thread_ctors.append((call.lineno, daemon, tkind, target))
+        self._consumed.add(id(call))
+
+    def register_target(self, node):
+        if _is_self_attr(node) and self.cls is not None:
+            self.cls.thread_entries.add(node.attr)
+        elif isinstance(node, ast.Name):
+            if node.id in self.fi.nested:
+                if self.cls is not None:
+                    self.cls.thread_entries.add(
+                        self.fi.nested[node.id].qualname)
+                self.fi.nested[node.id].is_entry = True
+            elif node.id in self.mod.functions:
+                self.mod.functions[node.id].is_entry = True
+
+
+# ---------------------------------------------------------------------------
+# deep propagation + rule evaluation
+# ---------------------------------------------------------------------------
+
+def _all_functions(modules):
+    for mod in modules:
+        stack = list(mod.functions.values())
+        for ci in mod.classes.values():
+            stack.extend(ci.methods.values())
+        while stack:
+            fi = stack.pop()
+            yield fi
+            stack.extend(fi.nested.values())
+
+
+class _Analyzer:
+    def __init__(self, modules, label):
+        self.modules = modules
+        self.idx = _Index(modules)
+        self.report = ThreadLintReport(label=label)
+        self._deep_acq = {}
+        self._deep_blk = {}
+        self._seen = set()
+
+    # -- plumbing ------------------------------------------------------------
+
+    def emit(self, severity, rule, message, mod, line, func=None,
+             suggestion=None):
+        key = (rule, mod.path, line, message)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        self.report.add(severity, rule, message, mod.display, line,
+                        func=func, suggestion=suggestion)
+
+    def run(self):
+        for mod in self.modules:
+            if mod.parse_error is not None:
+                self.report.add(INFO, "CC100",
+                                "file skipped (parse error: %s)"
+                                % mod.parse_error, mod.display, 1)
+        for fi in _all_functions(self.modules):
+            _FuncScan(fi, self.idx).run()
+        self.check_cc101()
+        self.check_cc102()
+        self.check_cc103()
+        self.check_cc104()
+        self.check_cc105()
+        self.check_cc106()
+        self.apply_waivers()
+        return self.report
+
+    # -- deep summaries ------------------------------------------------------
+
+    def deep_acquires(self, fi, stack=()):
+        if fi in self._deep_acq:
+            return self._deep_acq[fi]
+        if fi in stack:
+            return {}
+        out = {lid: (fi, line) for lid, line in fi.local_acquires.items()}
+        for kind, name, line, _held in fi.calls:
+            g = self.idx.resolve(fi, kind, name)
+            if g is None:
+                continue
+            for lid, site in self.deep_acquires(g, stack + (fi,)).items():
+                out.setdefault(lid, site)
+        self._deep_acq[fi] = out
+        return out
+
+    def deep_blocking(self, fi, stack=()):
+        if fi in self._deep_blk:
+            return self._deep_blk[fi]
+        if fi in stack:
+            return []
+        out = [(fi, line, desc) for line, desc, _held, _d in fi.blocking]
+        for kind, name, _line, _held in fi.calls:
+            g = self.idx.resolve(fi, kind, name)
+            if g is None:
+                continue
+            out.extend(self.deep_blocking(g, stack + (fi,)))
+        self._deep_blk[fi] = out
+        return out
+
+    # -- CC101 ---------------------------------------------------------------
+
+    def check_cc101(self):
+        edges = {}   # (a, b) -> (fi, line)
+        for fi in _all_functions(self.modules):
+            for a, b, line in fi.edges:
+                edges.setdefault((a, b), (fi, line))
+            for lid, line in fi.reentry:
+                self.emit(ERROR, "CC101",
+                          "non-reentrant lock %s re-acquired while "
+                          "already held (self-deadlock)" % lid,
+                          fi.module, line, func=fi.qualname,
+                          suggestion="use an RLock or restructure so the "
+                                     "outer holder passes control down")
+            for kind, name, line, held in fi.calls:
+                if not held:
+                    continue
+                g = self.idx.resolve(fi, kind, name)
+                if g is None:
+                    continue
+                for lid, _site in self.deep_acquires(g).items():
+                    if lid in held \
+                            and self.idx.lock_kinds.get(lid) == "lock":
+                        self.emit(
+                            ERROR, "CC101",
+                            "non-reentrant lock %s re-acquired via call "
+                            "to %s while already held" % (lid,
+                                                          g.qualname),
+                            fi.module, line, func=fi.qualname)
+                        continue
+                    for h in held:
+                        if h != lid:
+                            edges.setdefault((h, lid), (fi, line))
+        # declared-order violations
+        for (a, b), (fi, line) in sorted(edges.items()):
+            for order in self.idx.lock_order:
+                if a in order and b in order \
+                        and order.index(a) > order.index(b):
+                    self.emit(
+                        ERROR, "CC101",
+                        "acquisition %s -> %s inverts declared LOCK_ORDER "
+                        "%s" % (a, b, " -> ".join(order)),
+                        fi.module, line, func=fi.qualname,
+                        suggestion="release %s before taking %s, or fix "
+                                   "the registry if the contract changed"
+                                   % (a, b))
+        # cycles in the observed acquisition graph
+        graph = {}
+        for (a, b) in edges:
+            graph.setdefault(a, set()).add(b)
+        for cyc in _find_cycles(graph):
+            pairs = list(zip(cyc, cyc[1:] + cyc[:1]))
+            fi, line = edges[pairs[0]]
+            sites = ", ".join(
+                "%s->%s@%s:%d" % (a, b, edges[(a, b)][0].module.display,
+                                  edges[(a, b)][1])
+                for a, b in pairs if (a, b) in edges)
+            self.emit(ERROR, "CC101",
+                      "lock-order cycle %s (%s)"
+                      % (" -> ".join(cyc + [cyc[0]]), sites),
+                      fi.module, line, func=fi.qualname,
+                      suggestion="declare one order in LOCK_ORDER and "
+                                 "restructure the inverted acquisition")
+        # registry entries that name unknown locks rot silently — surface
+        for order in self.idx.lock_order:
+            for lid in order:
+                if lid not in self.idx.lock_kinds:
+                    mod = next((m for m in self.modules
+                                if order in [tuple(t) for t
+                                             in m.lock_order]),
+                               self.modules[0])
+                    self.emit(INFO, "CC101",
+                              "LOCK_ORDER names unknown lock %s "
+                              "(stale registry entry?)" % lid, mod, 1)
+
+    # -- CC102 ---------------------------------------------------------------
+
+    def check_cc102(self):
+        for fi in _all_functions(self.modules):
+            for line, desc, held, deep_only in fi.blocking:
+                if held and not deep_only:
+                    self.emit(
+                        WARNING, "CC102",
+                        "blocking %s while holding %s"
+                        % (desc, ", ".join(sorted(held))),
+                        fi.module, line, func=fi.qualname,
+                        suggestion="move the blocking call outside the "
+                                   "lock (snapshot state under the lock, "
+                                   "act on it after release)")
+            for kind, name, line, held in fi.calls:
+                if not held:
+                    continue
+                g = self.idx.resolve(fi, kind, name)
+                if g is None:
+                    continue
+                for bfi, bline, desc in self.deep_blocking(g):
+                    self.emit(
+                        WARNING, "CC102",
+                        "blocking %s reachable while %s holds %s "
+                        "(called via %s at %s:%d)"
+                        % (desc, fi.qualname, ", ".join(sorted(held)),
+                           g.qualname, fi.module.display, line),
+                        bfi.module, bline, func=bfi.qualname)
+
+    # -- CC103 ---------------------------------------------------------------
+
+    def check_cc103(self):
+        for mod in self.modules:
+            for ci in mod.classes.values():
+                if not ci.thread_entries:
+                    continue
+                funcs = self._class_functions(ci)
+                fvals = set(funcs.values())
+                locked = self._locked_context(ci, funcs, fvals)
+                guarded = {}
+                for fi in fvals:
+                    for attr, line, own, _any in fi.attr_writes:
+                        if (own or fi in locked) and attr not in guarded:
+                            guarded[attr] = (fi, line)
+                if not guarded:
+                    continue
+                reachable = self._reachable(ci, funcs)
+                skip = (set(ci.locks) | ci.events | ci.thread_attrs)
+                for fi in reachable:
+                    if fi.name == "__init__" or fi in locked:
+                        continue
+                    for attr, line, _own, any_held in (fi.attr_writes
+                                                       + fi.attr_reads):
+                        if attr in guarded and attr not in skip \
+                                and not any_held:
+                            gfi, gline = guarded[attr]
+                            self.emit(
+                                WARNING, "CC103",
+                                "self.%s is written under %s's lock "
+                                "(%s:%d) but accessed lock-free here on "
+                                "a thread path"
+                                % (attr, ci.name, gfi.module.display,
+                                   gline),
+                                fi.module, line, func=fi.qualname,
+                                suggestion="take the lock here too, or "
+                                           "stop guarding the attribute "
+                                           "anywhere if unsynchronized "
+                                           "access is the contract")
+
+    def _class_functions(self, ci):
+        out = {}
+        stack = list(ci.methods.values())
+        while stack:
+            fi = stack.pop()
+            out[fi.qualname] = fi
+            stack.extend(fi.nested.values())
+        return out
+
+    def _entry_funcs(self, ci, funcs):
+        entries = []
+        for ent in ci.thread_entries:
+            if ent in funcs:
+                entries.append(funcs[ent])
+            elif ci.name + "." + ent in funcs:
+                entries.append(funcs[ci.name + "." + ent])
+        return entries
+
+    def _locked_context(self, ci, funcs, fvals):
+        """Fixpoint of methods whose every intra-class call site holds the
+        class's own lock, either lexically or because the caller is itself
+        locked context (the ``_*_locked`` helper convention).  Accesses in
+        such methods are guarded by construction, not escapes."""
+        entries = set(self._entry_funcs(ci, funcs))
+        own_prefix = ci.name + "."
+        sites = {}
+        for fi in fvals:
+            for kind, name, _line, held in fi.calls:
+                g = self.idx.resolve(fi, kind, name)
+                if g is not None and g in fvals and g is not fi:
+                    own = any(h.startswith(own_prefix) for h in held)
+                    sites.setdefault(g, []).append((fi, own))
+        locked = set()
+        changed = True
+        while changed:
+            changed = False
+            for fi in fvals:
+                if fi in locked or fi in entries:
+                    continue
+                ss = sites.get(fi)
+                if not ss:
+                    continue
+                if all(own or caller in locked for caller, own in ss):
+                    locked.add(fi)
+                    changed = True
+        return locked
+
+    def _reachable(self, ci, funcs):
+        entries = self._entry_funcs(ci, funcs)
+        seen = set()
+        stack = list(entries)
+        while stack:
+            fi = stack.pop()
+            if fi in seen:
+                continue
+            seen.add(fi)
+            for kind, name, _line, _held in fi.calls:
+                g = self.idx.resolve(fi, kind, name)
+                if g is not None and g.cls is ci and g in funcs.values():
+                    stack.append(g)
+            stack.extend(fi.nested.values())
+        return seen
+
+    # -- CC104 ---------------------------------------------------------------
+
+    def check_cc104(self):
+        for fi in _all_functions(self.modules):
+            for lid, line, in_while, _held in fi.cond_waits:
+                if not in_while:
+                    self.emit(
+                        ERROR, "CC104",
+                        "%s.wait() without an enclosing while loop — a "
+                        "spurious wakeup or stolen notify proceeds on a "
+                        "false predicate" % lid,
+                        fi.module, line, func=fi.qualname,
+                        suggestion="wrap the wait in "
+                                   "`while not <predicate>:`")
+
+    # -- CC105 ---------------------------------------------------------------
+
+    def check_cc105(self):
+        for fi in _all_functions(self.modules):
+            for attr, line, held in fi.cc105_sites:
+                self.emit(
+                    ERROR, "CC105",
+                    "callback %s.%s is declared fired-unlocked "
+                    "(UNLOCKED_CALLBACKS) but invoked holding %s"
+                    % (fi.cls.name, attr, ", ".join(sorted(held))),
+                    fi.module, line, func=fi.qualname,
+                    suggestion="read the callback under the lock, invoke "
+                               "it after release (the on_evict pattern)")
+
+    # -- CC106 ---------------------------------------------------------------
+
+    def check_cc106(self):
+        for fi in _all_functions(self.modules):
+            for line, daemon, tkind, target in fi.thread_ctors:
+                if daemon is True:
+                    continue
+                ok = False
+                if tkind == "attr" and fi.cls is not None \
+                        and target in fi.cls.joined_attrs:
+                    ok = True
+                elif tkind == "local" and target in fi.local_joins:
+                    ok = True
+                if not ok:
+                    self.emit(
+                        WARNING, "CC106",
+                        "Thread started without daemon=True or a tracked "
+                        "join() path — leaks past interpreter shutdown "
+                        "and across tests",
+                        fi.module, line, func=fi.qualname,
+                        suggestion="pass daemon=True, or keep the handle "
+                                   "and join() it in a stop()/close() "
+                                   "path")
+
+    # -- waivers -------------------------------------------------------------
+
+    def apply_waivers(self):
+        by_display = {m.display: m for m in self.modules}
+        for d in self.report.diagnostics:
+            mod = by_display.get(d.path)
+            if mod is None:
+                continue
+            for ln in (d.line, d.line - 1):
+                w = mod.waivers.get(ln)
+                if w is not None and w[0] == d.rule:
+                    d.waived = True
+                    d.waive_reason = w[1] or None
+                    w[2] = True
+                    break
+        for mod in self.modules:
+            for line, (rule, reason, used) in sorted(mod.waivers.items()):
+                if not used:
+                    self.report.unused_waivers.append(
+                        (mod.display, line, rule, reason))
+
+
+def _find_cycles(graph):
+    """Minimal cycle enumeration: one representative cycle per SCC with
+    more than one node (self-loops are the reentrancy check's job)."""
+    index = {}
+    low = {}
+    on_stack = set()
+    stack = []
+    sccs = []
+    counter = [0]
+
+    def strongconnect(v):
+        work = [(v, iter(sorted(graph.get(v, ()))))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(graph.get(w, ())))))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                if len(scc) > 1:
+                    sccs.append(scc)
+
+    nodes = set(graph)
+    for tos in graph.values():
+        nodes.update(tos)
+    for v in sorted(nodes):
+        if v not in index:
+            strongconnect(v)
+
+    out = []
+    for scc in sccs:
+        members = set(scc)
+        # walk one cycle through the SCC deterministically
+        start = min(members)
+        path = [start]
+        seen = {start}
+        cur = start
+        while True:
+            nxt = min((w for w in graph.get(cur, ())
+                       if w in members), default=None)
+            if nxt is None:
+                break
+            if nxt == start:
+                out.append(path)
+                break
+            if nxt in seen:
+                out.append(path[path.index(nxt):])
+                break
+            path.append(nxt)
+            seen.add(nxt)
+            cur = nxt
+    return out
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def _collect_files(paths):
+    files = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if d not in ("__pycache__", ".git"))
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        files.append(os.path.join(dirpath, fn))
+        elif p.endswith(".py"):
+            files.append(p)
+    return files
+
+
+def analyze_paths(paths, rules=None, label=None):
+    """Run the CC1xx analysis over files/directories.  ``rules`` filters
+    the report to a subset of rule ids.  -> ThreadLintReport."""
+    if isinstance(paths, str):
+        paths = [paths]
+    files = _collect_files(paths)
+    modules = [_ModuleInfo(f, os.path.relpath(f)) for f in files]
+    report = _Analyzer(
+        modules, label or ", ".join(paths)).run()
+    if rules:
+        keep = set(rules)
+        report.diagnostics = [d for d in report.diagnostics
+                              if d.rule in keep]
+    return report
+
+
+def expected_findings(path):
+    """Scan a fixture module for ``# threadlint-expect: CCxxx`` markers;
+    -> [(rule, line)].  Fixture tests and --seed-defect both use this."""
+    out = []
+    with open(path, "r", encoding="utf-8") as f:
+        for i, text in enumerate(f, 1):
+            m = _EXPECT_RE.search(text)
+            if m:
+                out.append((m.group(1), i))
+    return out
+
+
+def report_telemetry(report):
+    """Count findings/waivers into telemetry (mirrors the
+    ``static_check_warnings`` plumbing in core.analysis._dispatch)."""
+    from . import telemetry
+    if not telemetry.enabled():
+        return
+    for d in report.diagnostics:
+        if d.severity == INFO:
+            continue
+        if d.waived:
+            telemetry.inc("static_check_waivers_total", 1, rule=d.rule)
+        else:
+            telemetry.inc("static_check_concurrency_total", 1, rule=d.rule)

@@ -119,9 +119,18 @@ def probe_speedup(kernel):
     with _lock:
         if kernel in _probe_overrides:
             return _probe_overrides[kernel]
-        if _probe_cache is None:
-            _probe_cache = _load_probes()
-        return _probe_cache.get(kernel)
+        cache = _probe_cache
+    if cache is None:
+        # read the archive outside the lock — disk I/O must not stall
+        # register_probe()/decide() callers on other threads.  Two racing
+        # loaders both read the same files; first publish wins and the
+        # loser adopts it, so every caller sees one consistent cache.
+        loaded = _load_probes()
+        with _lock:
+            if _probe_cache is None:
+                _probe_cache = loaded
+            cache = _probe_cache
+    return cache.get(kernel)
 
 
 def register_probe(kernel, speedup):

@@ -68,6 +68,27 @@ __all__ = ["ServingEngine", "DecodeEngine", "InferReply", "parse_buckets",
 
 _QPS_WINDOW_S = 5.0
 
+# Machine-readable concurrency contracts (tools/threadlint.py CC101/CC105;
+# core/concurrency_analysis.py merges every module's registry).  The
+# engine step lock is always OUTERMOST: adopt/seal paths take the cache
+# index and allocator locks (and hand frames to the kvxfer sender) while
+# holding the engine condition, never the reverse.  The rollout
+# controller's state lock wraps engine route mutations.
+LOCK_ORDER = (
+    ("RolloutController._lock", "ServingEngine._cond"),
+    ("DecodeEngine._cond", "PrefixCache._lock", "BlockAllocator._lock"),
+    ("DecodeEngine._cond", "KVBlockSender._cond"),
+)
+
+# Batch-boundary hooks fire between batches with the queue lock released
+# (documented at their assignment sites); CC105 enforces it.  The
+# per-step hooks (on_block_sealed / on_handoff) are intentionally NOT
+# here: their contract is "fired under the step lock".
+UNLOCKED_CALLBACKS = (
+    "ServingEngine.on_batch_boundary",
+    "DecodeEngine.on_batch_boundary",
+)
+
 
 def _flag(name):
     from .. import flags
@@ -1785,6 +1806,7 @@ class DecodeEngine:
         t0 = time.perf_counter()
         try:
             with _tr.activate(sspan):
+                # threadlint: waive CC102 continuous-batching contract: the device step runs under _cond so lane state is frozen for the whole step (see _decode_step_locked docstring); submitters park on the cond, never spin
                 carry, nxt, _logits = m.stepfn(
                     *self._step_args(m, bucket, tok, pos, tables, lens))
             m.cache.replace_carry(carry)
@@ -1938,6 +1960,7 @@ class DecodeEngine:
                              step=self._step_no, phase="draft",
                              req_ids=req_ids)
                     with _tr.span("serving.draft", lanes=n_spec, k=k):
+                        # threadlint: waive CC102 draft rollout runs under _cond by the same frozen-lane contract as stepfn in _decode_step_locked
                         dcarry, props = m.rolloutfn(
                             m.draft_cache.carry(), m.draft_params,
                             rtok, rpos, rtables, rlens, rmax)
@@ -1952,6 +1975,7 @@ class DecodeEngine:
                          phase="verify", req_ids=req_ids)
                 with _tr.span("serving.verify", lanes=len(lanes),
                               width=width):
+                    # threadlint: waive CC102 target-model verify runs under _cond by the same frozen-lane contract as stepfn in _decode_step_locked
                     carry, nxt, _logits = m.verifyfn(
                         m.cache.carry(), m.params, tok, pos, tables, lens)
                 m.cache.replace_carry(carry)
@@ -2063,6 +2087,7 @@ class DecodeEngine:
                              ingest=len(ingest))
                     with _tr.span("serving.draft_ingest",
                                   lanes=len(ingest)):
+                        # threadlint: waive CC102 draft-cache ingest runs under _cond by the same frozen-lane contract as stepfn in _decode_step_locked
                         dcarry, _nx, _lg = m.ingestfn(
                             m.draft_cache.carry(), m.draft_params,
                             itok, ipos, itables, ilens)

@@ -62,6 +62,22 @@ __all__ = ["KVCacheConfig", "BlockAllocator", "PagedKVCache",
 # pins one (CPU-tier tests and demos)
 _DEFAULT_BLOCKS = 64
 
+# Machine-readable concurrency contracts (tools/threadlint.py enforces
+# these; core/concurrency_analysis.py merges every module's registry).
+# Index -> allocator: PrefixCache methods call into BlockAllocator while
+# holding the index lock (match -> incref, publish -> seal), never the
+# reverse — the allocator reaches the index only through on_evict, which
+# fires AFTER the allocator lock is released.
+LOCK_ORDER = (
+    ("PrefixCache._lock", "BlockAllocator._lock"),
+)
+
+# Callbacks whose registration contract is "invoked with no owner lock
+# held" — CC105 flags any invocation site that still holds one.
+UNLOCKED_CALLBACKS = (
+    "BlockAllocator.on_evict",
+)
+
 
 class KVCacheConfig:
     """Static cache geometry; hidden = heads * head_dim per layer."""
@@ -220,7 +236,9 @@ class BlockAllocator:
             _tm.set_gauge("kv_blocks_evictable", len(self._evictable))
             cb = self.on_evict
         # the index callback runs outside the allocator lock (it takes the
-        # PrefixCache lock; lock order is always index -> allocator)
+        # PrefixCache lock; the module-level LOCK_ORDER registry declares
+        # the index -> allocator order and UNLOCKED_CALLBACKS declares
+        # this fired-unlocked contract — threadlint CC101/CC105 enforce it)
         for b, tag in evicted:
             if cb is not None:
                 cb(b, tag)

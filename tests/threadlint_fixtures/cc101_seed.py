@@ -1,0 +1,19 @@
+"""Seeded CC101 defect: acquisition order inverts the declared
+LOCK_ORDER registry.  Never imported — parsed by tools/threadlint.py
+--seed-defect cc101 and tests/test_threadlint.py."""
+
+import threading
+
+LOCK_ORDER = (("CC101Seed._a", "CC101Seed._b"),)
+
+
+class CC101Seed:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.state = 0
+
+    def inverted(self):
+        with self._b:
+            with self._a:  # threadlint-expect: CC101
+                self.state += 1

@@ -1,0 +1,23 @@
+"""Seeded CC102 defect: time.sleep while holding a lock.  The waived
+sibling exercises the inline-waiver syntax (waiver-count tests read
+it).  Never imported — parsed only."""
+
+import time
+import threading
+
+
+class CC102Seed:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.ticks = 0
+
+    def sleepy(self):
+        with self._lock:
+            time.sleep(0.01)  # threadlint-expect: CC102
+            self.ticks += 1
+
+    def waived_sleepy(self):
+        with self._lock:
+            # threadlint: waive CC102 fixture: demonstrates waiver syntax
+            time.sleep(0.01)
+            self.ticks += 1

@@ -1,0 +1,23 @@
+"""Seeded CC103 defect: attribute written under the class lock but read
+lock-free on the thread path.  Never imported — parsed only."""
+
+import threading
+
+
+class CC103Seed:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._thread = None
+        self.count = 0
+
+    def bump(self):
+        with self._lock:
+            self.count += 1
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        snap = self.count  # threadlint-expect: CC103
+        return snap

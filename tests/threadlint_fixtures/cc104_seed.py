@@ -1,0 +1,21 @@
+"""Seeded CC104 defect: Condition.wait guarded by `if`, not a `while`
+predicate-recheck loop.  The good() method is the clean pattern (no
+finding).  Never imported — parsed only."""
+
+import threading
+
+
+class CC104Seed:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self.ready = False
+
+    def bad(self):
+        with self._cond:
+            if not self.ready:
+                self._cond.wait(1.0)  # threadlint-expect: CC104
+
+    def good(self):
+        with self._cond:
+            while not self.ready:
+                self._cond.wait(1.0)

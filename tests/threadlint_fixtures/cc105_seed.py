@@ -1,0 +1,29 @@
+"""Seeded CC105 defect: a callback the registry declares fired-unlocked
+is invoked while the owner's lock is held.  good() is the on_evict
+pattern (alias under the lock, call after release).  Never imported —
+parsed only."""
+
+import threading
+
+UNLOCKED_CALLBACKS = ("CC105Seed.on_done",)
+
+
+class CC105Seed:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.on_done = None
+        self.value = 0
+
+    def bad(self):
+        with self._lock:
+            self.value += 1
+            if self.on_done is not None:
+                self.on_done(self.value)  # threadlint-expect: CC105
+
+    def good(self):
+        with self._lock:
+            self.value += 1
+            cb = self.on_done
+            v = self.value
+        if cb is not None:
+            cb(v)

@@ -1,0 +1,27 @@
+"""Seeded CC106 defect: a non-daemon Thread started with no tracked
+join() path.  good_daemon()/good_joined() are the accepted lifecycles.
+Never imported — parsed only."""
+
+import threading
+
+
+def _work():
+    return None
+
+
+class CC106Seed:
+    def __init__(self):
+        self._thread = None
+
+    def leaky(self):
+        t = threading.Thread(target=_work)  # threadlint-expect: CC106
+        t.start()
+
+    def good_daemon(self):
+        t = threading.Thread(target=_work, daemon=True)
+        t.start()
+
+    def good_joined(self):
+        t = threading.Thread(target=_work)
+        t.start()
+        t.join(1.0)

@@ -182,9 +182,11 @@ def main(argv=None):
                     "snapshot histogram (io.py + core/executor.py)")
     ap.add_argument("--lint", action="store_true", dest="lint_only",
                     help="show only static-checker metrics: per-rule "
-                    "static_check_warnings counters and the whole-world "
+                    "static_check_warnings counters, the whole-world "
                     "verifier's static_check_world_* run/finding counters "
-                    "and rank/peak-HBM gauges")
+                    "and rank/peak-HBM gauges, and the concurrency "
+                    "lint's static_check_concurrency_total / "
+                    "static_check_waivers_total per-rule counters")
     args = ap.parse_args(argv)
 
     if args.json_path:
@@ -240,7 +242,9 @@ def main(argv=None):
         # snapshot cost lives under the executor family
         snap = _filter_snap(snap, ("checkpoint_", "executor_snapshot"))
     if args.lint_only:
-        # covers static_check_warnings{rule=} and static_check_world_*
+        # covers static_check_warnings{rule=}, static_check_world_*, and
+        # the threadlint static_check_concurrency_total /
+        # static_check_waivers_total families
         snap = _filter_snap(snap, "static_check")
 
     if args.raw:

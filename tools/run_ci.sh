@@ -30,6 +30,21 @@ if [ "$MODE" = "--lint" ]; then
   JAX_PLATFORMS=cpu FLAGS_static_check=error \
     python tools/proglint.py --builtin mnist_mlp --builtin word2vec \
     --world 2 --zero1
+  echo "== lint: concurrency lint tests (CC1xx) =="
+  JAX_PLATFORMS=cpu python -m pytest tests/test_threadlint.py -q
+  echo "== lint: threadlint over paddle_tpu/ (must be clean mod waivers) =="
+  JAX_PLATFORMS=cpu python tools/threadlint.py
+  echo "== lint: threadlint seeded-defect self-test (must exit 1) =="
+  # the planted CC101 inversion MUST be detected: exit 1 is the success
+  # path here, anything else (0 = missed, 2 = misattributed) fails CI
+  set +e
+  JAX_PLATFORMS=cpu python tools/threadlint.py --seed-defect cc101
+  seed_rc=$?
+  set -e
+  if [ "$seed_rc" -ne 1 ]; then
+    echo "CI --lint: FAIL (seed-defect cc101 exit=$seed_rc, want 1)"
+    exit 1
+  fi
   echo "CI --lint: PASS"
   exit 0
 fi

@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Static concurrency lint (CC1xx) over the Python runtime.
+
+Mirrors tools/proglint.py for the thread layer: AST-only analysis of
+lock ordering, blocking-under-lock, guarded-state escapes, condition
+waits, callback contracts, and thread lifecycle (see
+paddle_tpu/core/concurrency_analysis.py for the rule catalog).
+
+  tools/threadlint.py                      # lint paddle_tpu/, exit 0/1
+  tools/threadlint.py --path paddle_tpu/serving
+  tools/threadlint.py --rule CC101 --rule CC102
+  tools/threadlint.py --dump json
+  tools/threadlint.py --seed-defect cc101  # self-test: must exit 1
+                                           # naming the exact file:line
+
+Exit codes: 0 clean (all error/warning findings waived or none), 1 any
+unwaived error/warning finding (for --seed-defect this is the SUCCESS
+path), 2 self-test failure (seeded defect missed or misattributed).
+"""
+
+import argparse
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_HERE)
+sys.path.insert(0, _ROOT)
+
+_FIXTURES = os.path.join(_ROOT, "tests", "threadlint_fixtures")
+
+
+def _seed_defect(rule, args):
+    from paddle_tpu.core.concurrency_analysis import (
+        analyze_paths, expected_findings)
+
+    rule = rule.upper()
+    path = os.path.join(_FIXTURES, "%s_seed.py" % rule.lower())
+    if not os.path.exists(path):
+        print("threadlint: no seeded fixture for %s (%s)" % (rule, path))
+        return 2
+    expected = [(r, ln) for r, ln in expected_findings(path) if r == rule]
+    if not expected:
+        print("threadlint: fixture %s carries no threadlint-expect "
+              "markers for %s" % (path, rule))
+        return 2
+    report = analyze_paths([path], label="seeded %s fixture" % rule)
+    print(report.format())
+    got = {(d.rule, d.line) for d in report.diagnostics if not d.waived}
+    missed = [e for e in expected if e not in got]
+    if missed:
+        print("threadlint: SELF-TEST FAILED — seeded %s not reported at %s"
+              % (rule, ", ".join("%s:%d" % (os.path.relpath(path), ln)
+                                 for _r, ln in missed)))
+        return 2
+    for r, ln in expected:
+        print("threadlint: seeded defect detected: %s at %s:%d"
+              % (r, os.path.relpath(path), ln))
+    return 1
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="static concurrency lint (CC1xx rules)")
+    ap.add_argument("--path", action="append", default=None,
+                    help="file or directory to lint (repeatable; "
+                         "default: paddle_tpu)")
+    ap.add_argument("--rule", action="append", default=None,
+                    help="restrict to a rule id, e.g. CC101 (repeatable)")
+    ap.add_argument("--dump", choices=("text", "json"), default="text")
+    ap.add_argument("--strict", action="store_true",
+                    help="info-level findings also fail the run")
+    ap.add_argument("--seed-defect", default=None,
+                    metavar="cc101",
+                    help="analyze the seeded fixture for this rule; the "
+                         "defect MUST be reported (exit 1) or the "
+                         "self-test fails (exit 2)")
+    args = ap.parse_args(argv)
+
+    if args.seed_defect:
+        return _seed_defect(args.seed_defect, args)
+
+    from paddle_tpu.core.concurrency_analysis import (
+        analyze_paths, report_telemetry)
+
+    paths = args.path or [os.path.join(_ROOT, "paddle_tpu")]
+    rules = [r.upper() for r in args.rule] if args.rule else None
+    report = analyze_paths(paths, rules=rules,
+                           label=", ".join(os.path.relpath(p)
+                                           for p in paths))
+    report_telemetry(report)
+    if args.dump == "json":
+        import json
+        print(json.dumps(report.to_dict(), indent=2, sort_keys=True))
+    else:
+        print(report.format())
+    if not report.ok:
+        return 1
+    if args.strict and report.infos:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
