@@ -337,6 +337,27 @@ _DEFAULTS = {
     # evictable pool (reclaimed on demand), so residency is free under
     # pressure; outputs stay bitwise-identical cache-on vs cache-off.
     "FLAGS_prefix_cache": True,
+    # live decode-session migration (serving/migrate.py): on, the engine
+    # publishes each COMPLETED decode-history block into the prefix index
+    # under the full-history hash chain (prompt ++ emitted tokens), so a
+    # crash-resume (`__resume__`) or migrated session re-prefills only
+    # the tokens since the last sealed block; the server also accepts
+    # kind=session `__kvxfer__` frames and resume submissions.  Off, the
+    # wire rejects session frames and resume falls back to full replay.
+    "FLAGS_session_migration": True,
+    # drain-by-migration: a retiring replica (autoscale-down, rollout
+    # flip) pushes its live decode sessions to peers at a batch boundary
+    # instead of waiting out long generations.  Off by default — flipped
+    # on by the --migrate-smoke CI leg and opt-in deployments.
+    "FLAGS_migrate_on_drain": False,
+    # pressure-trigger migration: mid-decode preemption may migrate the
+    # youngest (preempted) sequence to the least-loaded peer (fleetmon's
+    # windowed occupancy signal) instead of deterministic local
+    # recompute.  Off by default; recompute is always the fallback.
+    "FLAGS_migrate_on_pressure": False,
+    # seconds a migration source waits for the destination's
+    # __resumeack__ before aborting the hand-off and resuming locally
+    "FLAGS_migrate_ack_timeout": 10.0,
     # cap on total prefill tokens mixed into one decode iteration
     # (0 = unlimited).  Under a long-prompt burst, unbudgeted prefill
     # chunks crowd every iteration and inflate decode ITL p99; the budget

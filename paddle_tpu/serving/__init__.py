@@ -29,6 +29,15 @@ launching prewarmed standbys / draining idle replicas, and a
 ``RolloutController`` canarying ``name@v2`` behind a metrics gate with
 automatic rollback (serving/rollout.py).
 
+Live session migration (serving/migrate.py) makes in-flight generations
+survive replica death, drain, and rollout without re-prefill: the engine
+publishes each sequence's completed history blocks into the prefix index
+under hash-chain digests, so a session is transferable as (manifest,
+missing sealed blocks, one tail partial block) over the same
+``__kvxfer__`` wire — ``SessionMigrator`` pushes on drain/pressure,
+``ResumeBuffer`` + ``__resume__`` re-admit on the destination, and
+greedy decode makes the continuation bitwise identical.
+
 The fleet observability plane (PR 18, serving/fleetmon.py) scrapes
 every live replica each tick, merges histograms exactly via the shared
 telemetry bucket vectors, windows counter deltas into rates, evaluates
@@ -51,6 +60,8 @@ from .fleetmon import FLEET_RPC_KEY, FleetMonitor, \
     parse_slo_rules  # noqa: F401
 from .kv_cache import BlockAllocator, KVCacheConfig, PagedKVCache, \
     engine_owned_kv_bytes, plan_num_blocks  # noqa: F401
+from .migrate import ResumeBuffer, SessionMigrator, \
+    tail_digest  # noqa: F401
 from .rollout import RolloutController, evaluate_gate  # noqa: F401
 from .server import ServingServer  # noqa: F401
 
@@ -62,4 +73,5 @@ __all__ = [
     "KVCacheConfig", "BlockAllocator", "PagedKVCache", "plan_num_blocks",
     "engine_owned_kv_bytes", "KVBlockSender", "AdoptTracker",
     "FleetMonitor", "parse_slo_rules", "FLEET_RPC_KEY",
+    "SessionMigrator", "ResumeBuffer", "tail_digest",
 ]

@@ -35,6 +35,25 @@ walks the ``__stream__``/``__reply__`` vars on the named decode-role
 replica (or on the same connection when the hint is None — no live
 decode peer).  On failover the abort goes to BOTH halves, decode first,
 so a dead pair can't strand adopted KV blocks on the survivor.
+
+Session migration (serving/migrate.py) adds two recovery upgrades over
+blind replay, both transparent to callers:
+
+- **follow**: a replica that migrated the session away finishes it with
+  status "migrated" (terminal stream chunk + reply phases carrying
+  ``migrated_to``); the client hops to that endpoint and keeps walking
+  the SAME stream indices — the destination resumes emission exactly
+  where the source stopped, so no index is ever skipped or re-yielded.
+- **resume**: on ConnectionError mid-stream the client re-submits
+  ``__resume__:<req_id>`` with the tokens it already holds instead of
+  replaying from scratch; a warm survivor (prior traffic or migration)
+  skips straight past the sealed history.  A refused resume falls back
+  to the ordinary fresh-req_id replay.
+
+Either way ``generate`` dedupes delivered chunks by token index (greedy
+decode is deterministic, so a replayed prefix is bitwise identical):
+``on_token`` and ``generate_stream`` never see an index twice even when
+a slow victim raced extra chunks out before dying.
 """
 
 import json
@@ -327,6 +346,11 @@ class ServingClient:
         last_err, last_reply = None, None
         sheds = 0
         shed_cap = int(_flag("serving_client_shed_retries") or 0)
+        # tokens already DELIVERED to the caller, index == position:
+        # survives failover so a replayed/resumed prefix (deterministic
+        # greedy decode) is deduped instead of re-yielded
+        received = []
+        resume_allowed = bool(_flag("session_migration"))
         cand = self._gen_candidates()
         attempts = int(max_attempts or max(2 * len(cand), 2) + shed_cap)
         for i in range(attempts):
@@ -339,63 +363,142 @@ class ServingClient:
                 continue
             ep, ep_role = cand[self._rr % len(cand)]
             self._rr += 1
+            resuming = bool(stream and received and resume_allowed and i)
             chunk_times = []
             decode_ep = None
             try:
                 c = RpcClient(ep, connect_timeout=2.0,
                               rpc_deadline=get_timeout, retry_times=0)
                 dc = None
+                mc = None              # follow-the-migration connection
                 try:
                     with _tr.activate(root):
-                        c.send_var(codec.GEN_KEY + req_id, payload)
                         reader = c
-                        if ep_role == "prefill":
-                            # pair routing hint (always published by a
-                            # prefill replica): the stream and reply
-                            # come from the decode half, or from this
-                            # connection when the hint is None (no live
-                            # decode peer — monolith fallback)
-                            pm, _ = codec.unpack(c.get_var(
-                                codec.PAIR_KEY + req_id))
-                            decode_ep = pm.get("decode")
-                            if decode_ep:
-                                dc = RpcClient(decode_ep,
-                                               connect_timeout=2.0,
-                                               rpc_deadline=get_timeout,
-                                               retry_times=0)
-                                reader = dc
+                        if resuming:
+                            # crash-resume: same req_id, prompt + tokens
+                            # we hold; the replica re-prefills only what
+                            # its history index doesn't cover and emits
+                            # from index len(received) onward
+                            c.send_var(codec.RESUME_KEY + req_id,
+                                       codec.pack(meta_req, [
+                                           prompt, np.asarray(
+                                               received, np.int32)]))
+                            am, _ = codec.unpack(c.get_var(
+                                codec.RESUME_ACK_KEY + req_id))
+                            if am.get("status") != "resumed":
+                                _tm.inc("client_resume_total",
+                                        result="refused")
+                                last_err = "resume refused: %s" \
+                                    % am.get("error")
+                                # fall back to the ordinary full replay
+                                # under a fresh req_id for good
+                                resume_allowed = False
+                                req_id = uuid.uuid4().hex
+                                meta_req["req_id"] = req_id
+                                payload = codec.pack(meta_req, [prompt])
+                                continue
+                            _tm.inc("client_resume_total",
+                                    result="resumed")
+                        else:
+                            c.send_var(codec.GEN_KEY + req_id, payload)
+                            if ep_role == "prefill":
+                                # pair routing hint (always published by
+                                # a prefill replica): the stream and
+                                # reply come from the decode half, or
+                                # from this connection when the hint is
+                                # None (no live decode peer — monolith
+                                # fallback)
+                                pm, _ = codec.unpack(c.get_var(
+                                    codec.PAIR_KEY + req_id))
+                                decode_ep = pm.get("decode")
+                                if decode_ep:
+                                    dc = RpcClient(decode_ep,
+                                                   connect_timeout=2.0,
+                                                   rpc_deadline=get_timeout,
+                                                   retry_times=0)
+                                    reader = dc
                         if stream:
-                            k = 0
+                            # a resumed session's chunk keys start at
+                            # len(received); a fresh/replayed one at 0
+                            k = len(received) if resuming else 0
                             while True:
                                 cm, _ = codec.unpack(reader.get_var(
                                     "%s%s:%d" % (codec.STREAM_KEY,
                                                  req_id, k)))
                                 if cm.get("token") is not None:
+                                    idx = int(cm["i"])
                                     chunk_times.append(
                                         time.perf_counter())
-                                    if on_token is not None:
-                                        on_token(int(cm["i"]),
-                                                 int(cm["token"]))
+                                    if idx == len(received):
+                                        received.append(int(cm["token"]))
+                                        if on_token is not None:
+                                            on_token(idx, int(cm["token"]))
+                                    else:
+                                        # replayed prefix chunk: already
+                                        # delivered, never re-yield
+                                        _tm.inc("client_stream_dup_total")
                                 if cm.get("done"):
+                                    if cm.get("status") == "migrated":
+                                        # follow the session: the reply
+                                        # names the destination, which
+                                        # continues at this SAME index
+                                        mm, _ = codec.unpack(
+                                            reader.get_var(
+                                                codec.REPLY_KEY + req_id))
+                                        dest = (mm.get("phases") or {}
+                                                ).get("migrated_to")
+                                        if not dest:
+                                            break
+                                        if mc is not None:
+                                            mc.close()
+                                        mc = RpcClient(
+                                            dest, connect_timeout=2.0,
+                                            rpc_deadline=get_timeout,
+                                            retry_times=0)
+                                        reader = mc
+                                        _tm.inc(
+                                            "client_migrate_follow_total")
+                                        continue
                                     break
                                 k += 1
                         meta, arrays = codec.unpack(
                             reader.get_var(codec.REPLY_KEY + req_id))
+                        while meta.get("status") == "migrated":
+                            # non-stream follow: hop to the destination
+                            # replica for the authoritative reply
+                            dest = (meta.get("phases") or {}
+                                    ).get("migrated_to")
+                            if not dest:
+                                break
+                            if mc is not None:
+                                mc.close()
+                            mc = RpcClient(dest, connect_timeout=2.0,
+                                           rpc_deadline=get_timeout,
+                                           retry_times=0)
+                            _tm.inc("client_migrate_follow_total")
+                            meta, arrays = codec.unpack(
+                                mc.get_var(codec.REPLY_KEY + req_id))
                 finally:
                     c.close()
                     if dc is not None:
                         dc.close()
+                    if mc is not None:
+                        mc.close()
             except ConnectionError as e:
                 last_err = str(e)
                 # free the abandoned sequence on BOTH halves of a
-                # disaggregated pair (the decode side holds the blocks),
-                # then replay under a fresh req_id — the abort publishes
-                # a terminal reply under the old one, which a retry that
-                # lands on the same endpoint would read as its own
+                # disaggregated pair (the decode side holds the blocks);
+                # with tokens in hand the next attempt RESUMES under the
+                # SAME req_id (the abort is a no-op on a dead victim),
+                # otherwise replay under a fresh req_id — the abort
+                # publishes a terminal reply under the old one, which a
+                # retry that lands on the same endpoint would read as
+                # its own
                 self._abort_pair(ep, decode_ep, req_id)
-                req_id = uuid.uuid4().hex
-                meta_req["req_id"] = req_id
-                payload = codec.pack(meta_req, [prompt])
+                if not (stream and received and resume_allowed):
+                    req_id = uuid.uuid4().hex
+                    meta_req["req_id"] = req_id
+                    payload = codec.pack(meta_req, [prompt])
                 continue
             reply = InferReply(
                 meta.get("status", "error"),
@@ -452,7 +555,10 @@ class ServingClient:
 
     def generate_stream(self, model, prompt_ids, **kw):
         """Generator over (index, token) yielded as chunks arrive; the
-        final InferReply is returned via StopIteration.value."""
+        final InferReply is returned via StopIteration.value.  Indices
+        are strictly sequential from 0 even across mid-stream failover,
+        migration follow, and crash-resume — ``generate``'s index dedupe
+        swallows any replayed prefix."""
         got = []
         kw["stream"] = True
         kw["on_token"] = lambda i, t: got.append((i, t))

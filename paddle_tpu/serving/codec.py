@@ -74,6 +74,21 @@ Disaggregated prefill/decode keys (PR 17):
                            half; None means the replica serves the
                            request itself (monolith fallback).
 
+Live session migration keys (serving/migrate.py):
+
+  ``__resume__:<id>``      client -> survivor crash-resume: original
+                           prompt + every token already received; the
+                           engine re-admits the sequence against its
+                           prefix index (full-history hash chain) and
+                           continues emitting at the next token index —
+                           never re-emitting a token the client holds.
+  ``__resumeack__:<id>``   migration destination -> source verdict for a
+                           kind=session hand-off ("resumed" | an error
+                           status); the source commits (frees the
+                           victim's blocks, finishes it "migrated") on
+                           "resumed" and falls back to local recompute
+                           on anything else.
+
 Requests carry their SLO tier in the meta under ``TIER`` ("paid" /
 "free" / "batch"); the engine's deadline-weighted admission sheds
 low-weight tiers first under overload, counted per tier in
@@ -95,6 +110,7 @@ __all__ = ["pack", "unpack", "pack_kvxfer", "unpack_kvxfer",
            "ALIVE_KEY", "GEN_KEY", "STREAM_KEY", "ABORT_KEY",
            "RETIRE_KEY", "ROLLOUT_KEY", "ROLLOUT_SET_KEY",
            "ROLLOUT_CTL_KEY", "KVXFER_KEY", "PAIR_KEY",
+           "RESUME_KEY", "RESUME_ACK_KEY",
            "TRACEPARENT", "TIER"]
 
 INFER_KEY = "__infer__:"
@@ -116,6 +132,14 @@ ROLLOUT_CTL_KEY = "__rollout_ctl__:"
 # decode) and the per-request pair-routing hint the client GETs
 KVXFER_KEY = "__kvxfer__:"
 PAIR_KEY = "__pair__:"
+# live session migration (serving/migrate.py): a crash-resume request
+# (client -> survivor; arrays [prompt, tokens-already-received], meta
+# model / max_new_tokens / eos_id / stream / tier) lands under
+# __resume__:<req_id>; a migration destination publishes its admit/
+# reject verdict under __resumeack__:<req_id> for the source to GET
+# (separate key so a replica's poll loop never consumes its own ack)
+RESUME_KEY = "__resume__:"
+RESUME_ACK_KEY = "__resumeack__:"
 # meta key carrying the W3C-style trace context across the wire
 TRACEPARENT = "traceparent"
 # meta key carrying the request's SLO tier (paid|free|batch)
@@ -162,13 +186,18 @@ def unpack(arr):
 # the pool.  ``kvxfer`` magic + declared payload length make both checks
 # cheap and unambiguous.
 
-_KVXFER_KINDS = ("expect", "block", "commit", "cancel")
+_KVXFER_KINDS = ("expect", "block", "commit", "cancel", "session")
 
 
 def pack_kvxfer(meta, arrays=()):
     """Pack one transfer frame.  ``meta`` must carry ``kind`` (one of
-    expect|block|commit|cancel) and ``req_id``; block frames additionally
-    ``pos`` (hash-chain block index) and ``digest`` (sha256 hex)."""
+    expect|block|commit|cancel|session) and ``req_id``; block frames
+    additionally ``pos`` (hash-chain block index) and ``digest`` (sha256
+    hex).  A ``session`` frame carries a live-migration manifest
+    (serving/migrate.py): arrays [prompt, emitted tokens] plus meta
+    model / position / sealed-block digests / tail descriptor — it is
+    sent LAST on the stream, after the session's block frames, so the
+    receiver resumes only once every sealed block has landed."""
     kind = meta.get("kind")
     if kind not in _KVXFER_KINDS:
         raise ValueError("kvxfer frame kind must be one of %s, got %r"

@@ -68,6 +68,17 @@ _SHIPPED_CAP = 4096
 # uncommitted adoptions younger than this are never probed (normal
 # prefill queueing easily spans a few hundred ms)
 _ORPHAN_GRACE_S = 2.0
+# a stale entry's prefill endpoint is probed with capped exponential
+# backoff: each probe that finds the peer alive doubles the delay before
+# the next one, so an alive-but-slow prefill half is not hammered once a
+# second for the life of a long transfer
+_PROBE_BACKOFF_S = 0.5
+_PROBE_BACKOFF_CAP_S = 8.0
+# an uncommitted adoption older than this is reaped even when its
+# prefill half still answers probes (wedged sender, commit frame lost
+# after a reconnect) — and it is the only reaper for entries that never
+# learned their prefill endpoint
+_ORPHAN_HARD_S = 30.0
 
 
 class KVBlockSender:
@@ -254,13 +265,23 @@ class AdoptTracker:
     commit frame arrives; ``on_orphan(req_id, entry)`` fires for an
     uncommitted entry whose prefill endpoint stops answering ``__alive__``
     probes — the server frees the adopted digests and publishes a
-    "timeout" reply so the parked client replays instead of hanging."""
+    "timeout" reply so the parked client replays instead of hanging.
+    Probes back off exponentially per endpoint (capped), and every reaped
+    adoption lands in ``kv_xfer_orphans_total{reason=}``:
+    ``dead_peer`` (probe failed), ``timeout`` (uncommitted past the hard
+    cap with the sender still alive or unknown), ``cancelled`` (explicit
+    cancel frame after blocks were adopted)."""
 
     def __init__(self, on_orphan):
         self._entries = {}
         self._lock = threading.Lock()
         self._on_orphan = on_orphan
         self._stop = threading.Event()
+        # endpoint -> [next_probe_interval_s, not_before_monotonic];
+        # janitor-thread-only state behind the capped exponential probe
+        # backoff (dropped the moment a probe fails, so a relaunched
+        # peer starts fresh)
+        self._probe_state = {}
         self._thread = threading.Thread(target=self._janitor,
                                         name="kvxfer-janitor", daemon=True)
         self._thread.start()
@@ -310,35 +331,57 @@ class AdoptTracker:
 
     def cancel(self, req_id):
         """Prefill-side cancel (or orphan): drop the entry and return the
-        adopted digests to forget."""
+        adopted digests to forget.  An uncommitted entry that had already
+        adopted blocks counts as an orphaned adoption
+        (``kv_xfer_orphans_total{reason=cancelled}``)."""
         with self._lock:
             e = self._entries.pop(req_id, None)
-            return e
+        if e is not None and not e["committed"] and e["digests"]:
+            _tm.inc("kv_xfer_orphans_total", reason="cancelled")
+        return e
 
     def _janitor(self):
-        while not self._stop.wait(1.0):
+        while not self._stop.wait(0.5):
             now = time.monotonic()
             with self._lock:
                 stale = [(rid, dict(e)) for rid, e in self._entries.items()
                          if not e["committed"]
-                         and now - e["t0"] > _ORPHAN_GRACE_S
-                         and e["prefill_ep"]]
-            probed = {}
+                         and now - e["t0"] > _ORPHAN_GRACE_S]
+            alive = {}
             for rid, e in stale:
                 ep = e["prefill_ep"]
-                if ep not in probed:
-                    probed[ep] = probe(ep, codec.ALIVE_KEY,
-                                       timeout=1.0) is not None
-                if probed[ep]:
-                    continue            # prefill half alive: keep waiting
-                with self._lock:
-                    gone = self._entries.pop(rid, None)
-                if gone is not None:
-                    _tm.inc("kv_xfer_orphans_total")
-                    try:
-                        self._on_orphan(rid, gone)
-                    except Exception:
-                        pass
+                if now - e["t0"] > _ORPHAN_HARD_S:
+                    # wedged-but-alive sender (or one that never sent its
+                    # endpoint): the commit is not coming
+                    self._reap(rid, "timeout")
+                    continue
+                if not ep:
+                    continue        # hard timeout is the only reaper
+                if ep not in alive:
+                    st = self._probe_state.setdefault(
+                        ep, [_PROBE_BACKOFF_S, 0.0])
+                    if now < st[1]:
+                        continue    # inside this endpoint's backoff
+                    alive[ep] = probe(ep, codec.ALIVE_KEY,
+                                      timeout=1.0) is not None
+                    if alive[ep]:
+                        # answered: back off the NEXT probe, capped
+                        st[1] = now + st[0]
+                        st[0] = min(_PROBE_BACKOFF_CAP_S, st[0] * 2.0)
+                    else:
+                        self._probe_state.pop(ep, None)
+                if not alive[ep]:
+                    self._reap(rid, "dead_peer")
+
+    def _reap(self, rid, reason):
+        with self._lock:
+            gone = self._entries.pop(rid, None)
+        if gone is not None:
+            _tm.inc("kv_xfer_orphans_total", reason=reason)
+            try:
+                self._on_orphan(rid, gone)
+            except Exception:
+                pass
 
     def close(self):
         self._stop.set()

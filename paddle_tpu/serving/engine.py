@@ -62,6 +62,7 @@ import numpy as np
 from ..core import telemetry as _tm
 from ..core import tracing as _tr
 from ..core.executor import scope_guard
+from ..utils.fault_injection import maybe_fail
 
 __all__ = ["ServingEngine", "DecodeEngine", "InferReply", "parse_buckets",
            "parse_tier_weights", "tier_weight"]
@@ -87,6 +88,7 @@ LOCK_ORDER = (
 UNLOCKED_CALLBACKS = (
     "ServingEngine.on_batch_boundary",
     "DecodeEngine.on_batch_boundary",
+    "DecodeEngine.on_preempt",
 )
 
 
@@ -692,7 +694,6 @@ class ServingEngine:
                 # named fault point per model VERSION — a chaos/rollback
                 # leg arms e.g. "serving.execute.fc@v2:error:1.0" to
                 # seed a bad canary without a genuinely broken model
-                from ..utils.fault_injection import maybe_fail
                 if maybe_fail("serving.execute." + entry.name) == "error":
                     raise RuntimeError("injected execute fault (%s)"
                                        % entry.name)
@@ -778,7 +779,9 @@ class _DecodeSeq:
                  "n_fed", "next_tok", "out",
                  "t_admit", "t_first", "token_times", "admit_seq",
                  "aborted", "hashes", "published", "cached_tokens",
-                 "handoff", "prefill_upto")
+                 "handoff", "prefill_upto",
+                 "replay_upto", "resume_tail",
+                 "hist_hashes", "hist_published")
 
     def __init__(self, pending, prompt, max_new, eos_id, on_token, maxb):
         self.pending = pending
@@ -809,21 +812,48 @@ class _DecodeSeq:
         # blocks to a decode replica, and never generates a token here
         self.handoff = False
         self.prefill_upto = 0
+        # replay/resume state: positions below ``replay_upto`` are
+        # re-fed from KNOWN history (prompt ++ out) with step outputs
+        # discarded — never re-emitted.  A fresh sequence replays
+        # exactly its prompt; a resumed (migrated-in) or preempted one
+        # replays its already-emitted tokens too, so emission always
+        # continues at the next new index.  ``resume_tail`` is an
+        # optional migrated partial-block hand-off consumed once at
+        # admission; ``hist_hashes``/``hist_published`` extend the
+        # prompt hash chain over generated tokens for the history
+        # publication that keeps peer prefix indexes warm
+        # (FLAGS_session_migration).
+        self.replay_upto = len(self.prompt)
+        self.resume_tail = None
+        self.hist_hashes = []
+        self.hist_published = 0
 
     @property
     def in_prefill(self):
-        return self.n_fed < len(self.prompt)
+        return self.n_fed < self.replay_upto
+
+    def feed_tok(self, i):
+        """Token fed at position ``i`` during replay — the history
+        ``prompt ++ out`` (valid for every ``i < replay_upto``)."""
+        p = len(self.prompt)
+        return self.prompt[i] if i < p else self.out[i - p]
+
+    def feed_slice(self, start, span):
+        return [self.feed_tok(i) for i in range(start, start + span)]
 
     @property
     def total(self):
         return len(self.prompt) + self.max_new
 
     def reset_for_recompute(self):
-        """Preempted: blocks were freed; replay the prompt from scratch.
-        Greedy decode is deterministic, so re-emitted tokens are
-        identical and stream chunks republish byte-for-byte.  (Freed
-        shared blocks only dropped a reference — re-admission re-matches
-        the prefix index, so the replay usually skips straight past the
+        """Preempted (or an aborted migration hand-off): blocks were
+        freed; replay known history from scratch.  Emitted tokens are
+        KEPT — greedy decode is deterministic, so the replay re-feeds
+        ``prompt ++ out`` with outputs discarded and emission resumes
+        at the next NEW index, byte-identical to an uninterrupted run.
+        (Freed shared blocks only dropped a reference — re-admission
+        re-matches the prefix index, now including any published
+        history blocks, so the replay usually skips straight past the
         cached prefix again.)"""
         self.blocks = []
         self.table.fill(-1)
@@ -831,12 +861,14 @@ class _DecodeSeq:
         self.draft_table.fill(-1)
         self.n_fed = 0
         self.next_tok = self.prompt[0]
-        self.out = []
+        self.replay_upto = len(self.prompt) + len(self.out)
         self.t_first = None
         self.token_times = []
         self.hashes = None
         self.published = 0
         self.cached_tokens = 0
+        self.hist_hashes = []
+        self.hist_published = 0
 
 
 class _DecodeModel:
@@ -944,6 +976,16 @@ class DecodeEngine:
         # pointer reaches prefill_upto, before the blocks are freed
         self.on_block_sealed = None
         self.on_handoff = None
+        # live session migration (serving/migrate.py): sequences parked
+        # mid-hand-off (export_session -> commit/abort), a bounded ring
+        # of recently committed-away req_ids (loud double-migration
+        # refusal), and pressure-trigger victims reported at the next
+        # batch boundary through ``on_preempt(list of (req_id, model))``
+        # — fired with the step lock RELEASED (CC105 contract)
+        self._migrating = {}
+        self._migrated = []
+        self._preempted = []
+        self.on_preempt = None
 
     # -- registry ------------------------------------------------------------
 
@@ -1173,7 +1215,8 @@ class DecodeEngine:
 
     def submit(self, model, prompt_ids, max_new_tokens=16, tenant="default",
                deadline_ms=None, eos_id=-1, callback=None, on_token=None,
-               req_id=None, traceparent=None, tier=None, handoff=False):
+               req_id=None, traceparent=None, tier=None, handoff=False,
+               resume_from=None, resume_tail=None):
         """Enqueue one autoregressive request; returns a _Pending whose
         reply carries outputs={"tokens"} plus TTFT/ITL phases.
         ``on_token(req_id, index, token, done, status)`` fires per
@@ -1184,7 +1227,19 @@ class DecodeEngine:
         chunked prefill up to the last full-block boundary, fires the
         ``on_block_sealed``/``on_handoff`` hooks as blocks seal, then
         completes with status "handoff" (never generating a token); the
-        paired decode replica owns generation."""
+        paired decode replica owns generation.
+
+        ``resume_from`` (a migrated-in or crash-recovered session) is
+        the list of tokens the client already holds: the sequence seeds
+        its output with them, admission prefix-matches the full-history
+        chain (prompt ++ tokens) instead of the prompt alone, and decode
+        resumes at the next NEW index — no received token is ever
+        re-emitted.  ``resume_tail`` optionally carries the migrated
+        partial tail block ({"digest", "valid", "arrays"}); it is
+        validated against the recomputed tail digest and dropped (the
+        replay recomputes < 1 block) on any mismatch.  A resume for a
+        req_id already live here is loudly refused — double migration
+        must never double-run a session."""
         deadline_ms = float(deadline_ms or self.default_deadline_ms)
         prompt_ids = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
         tier, weight = tier_weight(self.tier_weights, tier)
@@ -1238,13 +1293,48 @@ class DecodeEngine:
                     "error", error="nothing to hand off: prompt of %d has "
                     "no full %d-token block below its tail"
                     % (len(prompt_ids), m.kv_config.block_size)))
+        resume_out = None
+        if resume_from is not None:
+            toks = [int(t) for t in np.asarray(resume_from).reshape(-1)]
+            err = None
+            if handoff:
+                err = "resume_from resumes decode; handoff is prefill-role"
+            elif not toks:
+                err = "resume_from carries no tokens"
+            elif len(toks) >= int(max_new_tokens):
+                err = "resume_from already holds all %d requested " \
+                      "tokens" % int(max_new_tokens)
+            elif int(eos_id) >= 0 and int(eos_id) in toks:
+                err = "resume_from already contains eos"
+            elif any(t < 0 or t >= m.cfg.vocab for t in toks):
+                err = "resume token out of vocab"
+            if err is not None:
+                _tm.inc("kv_migrate_resume_total", result="refused",
+                        model=model)
+                _tm.inc("kv_migrate_refused_total", reason="bad_resume")
+                return _early(InferReply("error", error=err))
+            resume_out = toks
         _tm.inc("serving_decode_requests_total", model=model, tenant=tenant)
         seq = _DecodeSeq(req, prompt_ids, max_new_tokens, eos_id, on_token,
                          m.maxb)
         if handoff:
             seq.handoff = True
             seq.prefill_upto = upto
+        if resume_out is not None:
+            seq.out = resume_out
+            seq.replay_upto = len(prompt_ids) + len(resume_out)
+            seq.resume_tail = resume_tail
         with self._cond:
+            if resume_out is not None and (
+                    req.req_id in self._migrating or any(
+                        s.pending.req_id == req.req_id
+                        for s in self._active + self._waiting)):
+                _tm.inc("kv_migrate_resume_total", result="refused",
+                        model=model)
+                _tm.inc("kv_migrate_refused_total", reason="duplicate")
+                return _early(InferReply(
+                    "error", error="req_id %s is already live here "
+                    "(double migration refused)" % req.req_id))
             if self._draining:
                 _tm.inc("serving_shed_total", reason="draining")
                 _tm.inc("serving_tier_shed_total", tier=tier)
@@ -1284,9 +1374,9 @@ class DecodeEngine:
             # with a drain-time hint instead of queueing behind an
             # out-of-memory head-of-line
             promised = sum(
-                m.cache.blocks_for_tokens(len(s.prompt))
+                m.cache.blocks_for_tokens(s.replay_upto)
                 for s in self._waiting if s.pending.model == model)
-            need_now = promised + m.cache.blocks_for_tokens(len(prompt_ids))
+            need_now = promised + m.cache.blocks_for_tokens(seq.replay_upto)
             free_now = m.cache.allocator.reclaimable
             if m.spec_k > 0:
                 # equal block geometry -> the same block count applies;
@@ -1310,6 +1400,9 @@ class DecodeEngine:
                                        parent=req.span,
                                        depth=len(self._waiting))
             self._waiting.append(seq)
+            if resume_out is not None:
+                _tm.inc("kv_migrate_resume_total", result="accepted",
+                        model=model)
             _tm.set_gauge("serving_queue_depth",
                           len(self._waiting))
             self._cond.notify_all()
@@ -1413,6 +1506,156 @@ class DecodeEngine:
             _tm.inc("kv_xfer_forget_total", n, model=model)
         return n
 
+    # -- live session migration (serving/migrate.py drives these) ------------
+
+    def _refuse_export(self, req_id, reason):
+        _tm.inc("kv_migrate_refused_total", reason=reason)
+        raise ValueError("cannot migrate %s: %s" % (req_id, reason))
+
+    def export_session(self, req_id):
+        """Phase 1 of a migration hand-off: detach a live sequence at
+        the current iteration boundary and snapshot everything a peer
+        needs to continue it — ``(manifest, payloads)``.
+
+        The manifest is the session descriptor (tokens ride as
+        ``_prompt_arr``/``_out_arr`` int32 arrays, stripped onto the
+        wire frame's payload by the migrator); ``payloads`` is one
+        ``(block_index, digest, arrays, is_tail)`` tuple per shippable
+        KV block — every fully-fed history block under its chain
+        digest, plus the partial tail block sealed at migration time
+        under a domain-separated ``tail_digest``.  The sequence stays
+        parked in ``_migrating`` (invisible to the scheduler, blocks
+        refcounted) until ``commit_migration`` or ``abort_migration``
+        decides its fate — at most one replica ever runs it.
+
+        Refusals raise ValueError and leave the engine unperturbed:
+        unknown/finished ids, double migration (parked or recently
+        committed away), aborted/handoff sequences, sequences still in
+        prefill or replay (re-prefill is cheap and a half-fed block has
+        no stable digest), and engines without a prefix cache or with
+        ``FLAGS_session_migration`` off."""
+        with self._cond:
+            seq, waiting = None, False
+            for s in self._active:
+                if s.pending.req_id == req_id:
+                    seq = s
+                    break
+            if seq is None:
+                for s in self._waiting:
+                    if s.pending.req_id == req_id:
+                        seq, waiting = s, True
+                        break
+            if seq is None:
+                if req_id in self._migrating:
+                    self._refuse_export(req_id, "already_migrating")
+                if req_id in self._migrated:
+                    self._refuse_export(req_id, "already_migrated")
+                self._refuse_export(req_id, "unknown")
+            if seq.aborted:
+                self._refuse_export(req_id, "aborted")
+            if seq.handoff:
+                self._refuse_export(req_id, "handoff")
+            if not seq.out or (not waiting and seq.in_prefill):
+                self._refuse_export(req_id, "in_prefill")
+            m = self._model_of(seq)
+            if m.prefix is None or not bool(_flag("session_migration")):
+                self._refuse_export(req_id, "disabled")
+            bs = m.kv_config.block_size
+            # steady decode keeps n_fed == len(prompt ++ out) - 1 (the
+            # last emitted token is fed by the NEXT step); a preempted
+            # waiting victim resumes at the same position
+            pos = len(seq.prompt) + len(seq.out) - 1 if waiting \
+                else seq.n_fed
+            nfull = pos // bs
+            digests = [self._hist_digest_locked(m, seq, j)
+                       for j in range(nfull)]
+            payloads = []
+            if waiting:
+                # preempted victim: its blocks were freed, but published
+                # history blocks may still sit evictable — revive what
+                # survived and ship that; the destination replays the
+                # rest (no tail: the partial block never sealed)
+                borrowed = m.prefix.match_digests(digests)
+                for j, b in enumerate(borrowed):
+                    payloads.append((j, digests[j],
+                                     m.cache.export_block(b), False))
+                if borrowed:
+                    m.cache.allocator.free(borrowed)
+            else:
+                for j in range(nfull):
+                    payloads.append((j, digests[j],
+                                     m.cache.export_block(seq.blocks[j]),
+                                     False))
+                if pos > nfull * bs:
+                    from .migrate import tail_digest as _tail_digest
+                    td = _tail_digest(
+                        digests[-1] if digests else None,
+                        seq.feed_slice(nfull * bs, pos - nfull * bs))
+                    payloads.append((nfull, td,
+                                     m.cache.export_block(seq.blocks[nfull]),
+                                     True))
+            now = time.perf_counter()
+            manifest = {
+                "req_id": req_id, "model": seq.pending.model,
+                "pos": int(pos), "block_size": int(bs),
+                "dtype": str(m.kv_config.dtype), "digests": digests,
+                "max_new_tokens": int(seq.max_new),
+                "eos_id": int(seq.eos_id),
+                "tier": seq.pending.tier, "tenant": seq.pending.tenant,
+                "deadline_ms": max(
+                    round((seq.pending.deadline - now) * 1e3, 3), 1.0),
+                "stream": seq.on_token is not None,
+                "spec_k": int(m.spec_k),
+                "_prompt_arr": np.asarray(seq.prompt, np.int32),
+                "_out_arr": np.asarray(seq.out, np.int32),
+            }
+            if waiting:
+                self._waiting.remove(seq)
+                _tm.set_gauge("serving_queue_depth", len(self._waiting))
+            else:
+                self._active.remove(seq)
+            self._migrating[req_id] = seq
+            _tm.event("session_export", req_id=req_id, pos=int(pos),
+                      model=seq.pending.model, blocks=len(payloads),
+                      waiting=waiting)
+            return manifest, payloads
+
+    def commit_migration(self, req_id, peer):
+        """Phase 3 success: the destination acked "resumed" — free the
+        parked victim's blocks and finish it with status "migrated";
+        reply phases carry ``migrated_to`` so a waiting client follows
+        the session to its new home."""
+        with self._cond:
+            seq = self._migrating.pop(req_id, None)
+            if seq is None:
+                return False
+            self._migrated.append(req_id)
+            del self._migrated[:-256]
+            self._free_blocks(seq)
+            self._finish(seq, InferReply(
+                "migrated", error="session migrated to %s" % peer,
+                phases={"migrated_to": peer}))
+            self._cond.notify_all()
+        _tm.event("session_migrated", req_id=req_id, peer=peer)
+        return True
+
+    def abort_migration(self, req_id):
+        """Phase 3 failure: the push died or the destination refused —
+        re-queue the victim at the FRONT for deterministic local
+        recompute.  Its emitted tokens are kept (replay never
+        re-emits), so the client sees at most a latency blip.  Zero
+        drops, and at most one replica ever runs the session."""
+        with self._cond:
+            seq = self._migrating.pop(req_id, None)
+            if seq is None:
+                return False
+            self._free_blocks(seq)
+            seq.reset_for_recompute()
+            self._waiting.insert(0, seq)
+            _tm.set_gauge("serving_queue_depth", len(self._waiting))
+            self._cond.notify_all()
+        return True
+
     # -- decode loop ---------------------------------------------------------
 
     def start(self):
@@ -1432,8 +1675,10 @@ class DecodeEngine:
             self._thread.join(drain_s)
             self._thread = None
         with self._cond:
-            leftovers = self._active + self._waiting
+            leftovers = self._active + self._waiting + \
+                list(self._migrating.values())
             self._active, self._waiting = [], []
+            self._migrating = {}
         for s in leftovers:
             self._free_blocks(s)
             self._finish(s, InferReply("error", error="engine stopped"))
@@ -1442,17 +1687,49 @@ class DecodeEngine:
     def draining(self):
         return self._draining
 
-    def drain(self, timeout_s=30.0):
+    def drain(self, timeout_s=30.0, migrate=None):
         """Graceful retirement (ServingEngine.drain contract): shed new
-        arrivals, wait for every waiting AND active sequence to finish."""
+        arrivals, wait for every waiting AND active sequence to finish.
+
+        ``migrate`` (``SessionMigrator.drain_push()``) turns the wait
+        into drain-by-migration: each live mid-decode session is pushed
+        to a surviving peer at a batch boundary instead of being waited
+        out — a retiring replica with long generations in flight empties
+        in O(transfer), not O(remaining tokens).  A session whose push
+        fails (no peer, refusal, wire error) is remembered and simply
+        waited out the old way; nothing is ever dropped."""
         with self._cond:
             self._draining = True
             self._cond.notify_all()
         deadline = time.perf_counter() + timeout_s
+        failed = set()
         while time.perf_counter() < deadline:
+            cand = None
             with self._cond:
-                if not self._waiting and not self._active:
+                if not self._waiting and not self._active \
+                        and not self._migrating:
                     return True
+                if migrate is not None:
+                    for s in self._active + self._waiting:
+                        rid = s.pending.req_id
+                        if rid in failed or s.handoff or s.aborted \
+                                or not s.out:
+                            continue
+                        if s in self._active and s.in_prefill:
+                            continue
+                        cand = (rid, s.pending.model)
+                        break
+            if cand is not None:
+                # the push itself runs OUTSIDE the step lock (it is an
+                # RPC); export_session re-checks liveness under the lock
+                ok = False
+                try:
+                    ok = bool(migrate(cand[0], cand[1]))
+                except Exception:
+                    ok = False
+                if not ok:
+                    failed.add(cand[0])
+                continue
             time.sleep(0.01)
         return False
 
@@ -1472,7 +1749,7 @@ class DecodeEngine:
 
     def _finish(self, seq, reply):
         r = seq.pending
-        if reply.ok or reply.status == "timeout":
+        if reply.ok or reply.status in ("timeout", "migrated"):
             now = time.perf_counter()
             phases = {"queue_wait_ms": round(
                 ((seq.t_admit or now) - r.t_submit) * 1e3, 3),
@@ -1480,6 +1757,12 @@ class DecodeEngine:
                 "prompt_tokens": len(seq.prompt),
                 "cached_tokens": seq.cached_tokens,
                 "tier": r.tier, "model": r.model}
+            if seq.replay_upto > len(seq.prompt):
+                # resumed/replayed sessions: tokens that were already
+                # emitted (re-fed, never re-emitted); with cached_tokens
+                # this yields the re-prefill cost of a migration
+                phases["resumed_tokens"] = \
+                    seq.replay_upto - len(seq.prompt)
             if seq.t_first is not None:
                 phases["ttft_ms"] = round(
                     (seq.t_first - r.t_submit) * 1e3, 3)
@@ -1487,6 +1770,8 @@ class DecodeEngine:
                 gaps = [(b - a) * 1e3 for a, b in
                         zip(seq.token_times, seq.token_times[1:])]
                 phases["itl_ms_samples"] = [round(g, 3) for g in gaps]
+            if reply.phases:
+                phases.update(reply.phases)
             reply.phases = phases
         out_tokens = np.asarray(seq.out, np.int32)
         if reply.ok:
@@ -1554,13 +1839,17 @@ class DecodeEngine:
             free = m.cache.allocator.reclaimable
             if m.spec_k > 0:
                 free = min(free, m.draft_cache.allocator.reclaimable)
-            if m.cache.blocks_for_tokens(len(s.prompt)) > free:
+            if m.cache.blocks_for_tokens(s.replay_upto) > free:
                 break  # head-of-line waits for blocks to free
             self._waiting.pop(0)
             self._admit_seq += 1
             s.admit_seq = self._admit_seq
             s.t_admit = now
-            if m.prefix is not None:
+            if m.prefix is not None and s.replay_upto > len(s.prompt):
+                # resumed (migrated-in) or preempted replay: match the
+                # full-history chain instead of the prompt alone
+                self._admit_resume_locked(m, s)
+            elif m.prefix is not None:
                 # longest-prefix match: seed the block table with shared
                 # (ref-taken) blocks and jump the feed pointer past the
                 # cached tokens — prefill computes only the uncached tail.
@@ -1575,7 +1864,7 @@ class DecodeEngine:
                     s.blocks = list(shared)
                     s.table[:len(shared)] = shared
                     s.n_fed = cached
-                    s.next_tok = s.prompt[cached]
+                    s.next_tok = s.feed_tok(cached)
                 if s.handoff and self.on_block_sealed is not None:
                     # a warm prefill replica still announces prefix-hit
                     # digests: the decode peer may be cold (the sender's
@@ -1635,6 +1924,11 @@ class DecodeEngine:
             self._free_blocks(v)
             v.reset_for_recompute()
             self._waiting.insert(0, v)
+            if v.out:
+                # pressure-trigger migration candidate: reported through
+                # on_preempt at the next batch boundary (lock released)
+                self._preempted.append((v.pending.req_id,
+                                        v.pending.model))
             _tm.inc("kv_block_evictions_total",
                     model=v.pending.model)
             _tm.event("decode_preempt", victim=v.pending.req_id,
@@ -1659,10 +1953,101 @@ class DecodeEngine:
                     and j < s.prefill_upto // bs:
                 self.on_block_sealed(m, s, j, s.hashes[j])
 
+    def _hist_digest_locked(self, m, s, j):
+        """``j``-th full-block digest of the prompt ++ out hash chain
+        (memoized in ``s.hist_hashes``; the prompt-only prefix of the
+        chain is identical to ``s.hashes``, so it is reused)."""
+        bs = m.kv_config.block_size
+        while len(s.hist_hashes) <= j:
+            i = len(s.hist_hashes)
+            if s.hashes is not None and i < len(s.hashes):
+                s.hist_hashes.append(s.hashes[i])
+                continue
+            prev = s.hist_hashes[i - 1] if i else None
+            s.hist_hashes.append(m.prefix.extend_chain(
+                prev, s.feed_slice(i * bs, bs)))
+        return s.hist_hashes[j]
+
+    def _publish_history_locked(self, m, s):
+        """Publish every newly-completed history block — full blocks
+        whose tokens extend past the prompt — under its prompt ++ out
+        chain digest (FLAGS_session_migration).  Eligibility mirrors
+        ``_publish_prefix_locked``: a block publishes only once every
+        one of its positions is fed, so its KV content is final (later
+        writes land in later blocks) and any future matcher replays the
+        exact tokens that produced it.  This is what makes crash resume
+        O(tokens since the last sealed block): a replica that ran the
+        same prompt before holds the whole history chain evictable."""
+        if m.prefix is None or s.handoff \
+                or not bool(_flag("session_migration")):
+            return
+        bs = m.kv_config.block_size
+        first = len(s.prompt) // bs    # prompt-only blocks: see above
+        done = s.n_fed // bs
+        if s.hist_published < first:
+            s.hist_published = first
+        while s.hist_published < done:
+            j = s.hist_published
+            m.prefix.publish(s.blocks[j],
+                             self._hist_digest_locked(m, s, j))
+            s.hist_published = j + 1
+
+    def _admit_resume_locked(self, m, s):
+        """Resume-path admission (``replay_upto > len(prompt)``): match
+        the full-history chain — prompt ++ already-emitted tokens —
+        instead of the prompt alone, then adopt the migrated tail
+        partial block when every full block below it matched.  Serves
+        both a migrated-in session and a preempted local replay (whose
+        own published history revives here).  Anything unmatched is
+        simply replayed: outputs are bitwise identical either way."""
+        bs = m.kv_config.block_size
+        pos = s.replay_upto - 1      # the last emitted token is re-fed
+        nfull = pos // bs
+        s.hashes = m.prefix.chain(s.prompt)
+        digests = [self._hist_digest_locked(m, s, j)
+                   for j in range(nfull)]
+        blocks = m.prefix.match_digests(digests)
+        if blocks:
+            s.blocks = list(blocks)
+            s.table[:len(blocks)] = blocks
+            s.n_fed = len(blocks) * bs
+        s.published = min(len(blocks), len(s.hashes))
+        s.hist_published = len(blocks)
+        tail, s.resume_tail = s.resume_tail, None
+        if tail is not None and len(blocks) == nfull \
+                and nfull * bs < pos:
+            from .migrate import tail_digest as _tail_digest
+            want = _tail_digest(digests[-1] if digests else None,
+                                s.feed_slice(nfull * bs, pos - nfull * bs))
+            if tail.get("digest") != want \
+                    or int(tail.get("valid", -1)) != pos - nfull * bs:
+                # a stale/foreign tail is dropped, not trusted: the
+                # replay recomputes it (< 1 block of work)
+                _tm.inc("kv_migrate_refused_total",
+                        reason="tail_mismatch")
+            else:
+                got = m.cache.allocator.alloc(1)
+                if got is not None:
+                    b = got[0]
+                    try:
+                        m.cache.import_block(b, tail["arrays"])
+                    except Exception:
+                        m.cache.allocator.free([b])
+                    else:
+                        # PRIVATE tail block owned by the resumed
+                        # sequence — never indexed (partial blocks must
+                        # not prefix-match)
+                        s.blocks.append(b)
+                        s.table[nfull] = b
+                        s.n_fed = pos
+        s.cached_tokens = s.n_fed
+        s.next_tok = s.feed_tok(s.n_fed)
+
     def _prefill_limit(self, s):
-        """Last position this replica feeds for ``s``: the full prompt,
-        or the handoff boundary for a prefill-role sequence."""
-        return s.prefill_upto if s.handoff else len(s.prompt)
+        """Last position this replica feeds for ``s``: the known
+        history (prompt, plus replayed tokens for a resume), or the
+        handoff boundary for a prefill-role sequence."""
+        return s.prefill_upto if s.handoff else s.replay_upto
 
     def _sweep_handoff_locked(self):
         """Complete handoff sequences whose feed pointer reached the
@@ -1727,6 +2112,11 @@ class DecodeEngine:
 
     def _decode_loop(self):
         while True:
+            # named fault point OUTSIDE the lock: a "delay" spec slows
+            # every decode iteration (slow-replica chaos — keeps
+            # sessions alive across a drain/kill window in CI) without
+            # holding submitters on the cond during the sleep
+            maybe_fail("serving.decode_step")
             with self._cond:
                 if not self._running:
                     return
@@ -1735,6 +2125,15 @@ class DecodeEngine:
                     self._cond.wait(0.05)
                     continue
                 step_ok = self._decode_step_locked()
+                preempted, self._preempted = self._preempted, []
+            if preempted and self.on_preempt is not None:
+                # pressure-trigger migration hook (CC105: fired with the
+                # lock released; the victims are already back in the
+                # waiting queue with their emitted tokens intact)
+                try:
+                    self.on_preempt(preempted)
+                except Exception:
+                    pass
             if self.on_batch_boundary is not None:
                 try:
                     self.on_batch_boundary()
@@ -1828,10 +2227,12 @@ class DecodeEngine:
         for i, s in enumerate(lanes):
             s.n_fed += 1
             # seal + publish any prompt block this write completed (the
-            # boundary-crossing write completes the final full block)
+            # boundary-crossing write completes the final full block),
+            # then any completed history block (session migration)
             self._publish_prefix_locked(m, s)
+            self._publish_history_locked(m, s)
             if s.in_prefill:
-                s.next_tok = s.prompt[s.n_fed]
+                s.next_tok = s.feed_tok(s.n_fed)
                 continue
             token = int(nxt[i])
             s.next_tok = token
@@ -1929,7 +2330,7 @@ class DecodeEngine:
             pad = width - span
             tables[i] = s.table
             pos[i, :pad] = p
-            feed = s.prompt[p:p + span] if s.in_prefill else [s.next_tok]
+            feed = s.feed_slice(p, span) if s.in_prefill else [s.next_tok]
             for j in range(span):
                 pos[i, pad + j] = p + j
                 lens[i, pad + j] = p + j + 1
@@ -2005,9 +2406,10 @@ class DecodeEngine:
             if s.in_prefill:
                 s.n_fed += span
                 self._publish_prefix_locked(m, s)
-                ingest.append((s, p, s.prompt[p:p + span]))
+                self._publish_history_locked(m, s)
+                ingest.append((s, p, s.feed_slice(p, span)))
                 if s.in_prefill:
-                    s.next_tok = s.prompt[s.n_fed]
+                    s.next_tok = s.feed_tok(s.n_fed)
                     continue
                 # chunk crossed the prompt boundary: its last column's
                 # argmax is the first generated token
@@ -2042,6 +2444,11 @@ class DecodeEngine:
                         pass
                 if done:
                     break
+            # history publication must follow the appends: a multi-token
+            # accept advances n_fed past tokens that only exist in
+            # ``emitted`` until this point, and the chain digest replays
+            # them from prompt ++ out
+            self._publish_history_locked(m, s)
             if done:
                 self._active.remove(s)
                 self._free_blocks(s)   # same-step free, both pools

@@ -373,6 +373,37 @@ class PrefixCache:
             out.append(h.hex())
         return out
 
+    def extend_chain(self, prev_hex, block_tokens):
+        """One hash-chain step past an existing digest: ``prev_hex`` is
+        the previous block's hex digest (None for the chain seed) and
+        ``block_tokens`` the next block's token ids -> next hex digest.
+        Lets a decoding sequence extend its prompt chain over generated
+        tokens incrementally (live session migration) without rehashing
+        the whole history per block boundary."""
+        h = self._seed if prev_hex is None else bytes.fromhex(prev_hex)
+        d = hashlib.sha256(h)
+        d.update(b"".join(int(t).to_bytes(8, "little", signed=True)
+                          for t in block_tokens))
+        return d.hexdigest()
+
+    def match_digests(self, digests):
+        """Longest indexed prefix of a precomputed digest chain ->
+        ``blocks`` with one reference taken per block (the resume-path
+        twin of ``match``: the caller already knows the full-history
+        chain — prompt ++ emitted tokens — and, unlike prefill, needs no
+        one-token cap because the next fed token is already decided)."""
+        blocks = []
+        with self._lock:
+            for d in digests:
+                b = self._index.get(d)
+                if b is None:
+                    break
+                if not self.allocator.incref(b):
+                    self._index.pop(d, None)
+                    break
+                blocks.append(b)
+        return blocks
+
     def match(self, prompt_ids):
         """Longest cached prefix -> ``(blocks, cached_tokens, hashes)``.
 

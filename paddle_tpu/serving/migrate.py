@@ -1,0 +1,365 @@
+"""Live decode-session migration: in-flight generations survive replica
+death, drain, and rollout without re-prefill.
+
+A decoding sequence is, at any iteration boundary, fully described by a
+small **manifest** (prompt, emitted tokens, feed position p, decode
+params) plus the KV state for positions ``[0, p)`` — and that KV state
+is already content-addressed: the engine extends each sequence's prefix
+hash chain over *generated* tokens as decode crosses block boundaries
+(``h_i = sha(h_{i-1}, block_tokens)`` over prompt ++ out), publishing
+every completed history block into the local prefix index exactly like a
+prompt block.  Migration is therefore a transfer of (manifest, sealed
+blocks the destination does not hold, one tail partial block), and
+resume is an ordinary admission that prefix-matches the full-history
+chain instead of just the prompt — greedy decode makes the continuation
+bitwise identical to an uninterrupted run.
+
+Wire format (one FIFO ``__kvxfer__:<req_id>`` stream, send order =
+arrival order):
+
+  block frames      ``kind=block, session=1`` — one per sealed history
+                    block not recently shipped to this peer, adopted on
+                    arrival via ``DecodeEngine.adopt_kv_block`` (alloc,
+                    install, publish digest, park evictable: the
+                    destination's prefix index stays warm even if the
+                    resume itself is later refused)
+  tail frame        ``kind=block, session=1, tail=1, valid=n`` — the
+                    partial block holding positions past the last sealed
+                    boundary, "sealed at migration time" under a
+                    domain-separated digest (``tail_digest``) that can
+                    never collide with a chain digest; held host-side by
+                    the destination's ``ResumeBuffer`` until the
+                    manifest lands, then installed into a private block
+                    owned by the resumed sequence (never indexed — a
+                    partial block must not prefix-match)
+  session frame     ``kind=session`` — the manifest, sent LAST; arrays
+                    [prompt, emitted tokens], meta carries position,
+                    chain digests, decode params and remaining deadline.
+                    The destination resumes and publishes its verdict
+                    under ``__resumeack__:<req_id>``.
+
+Trigger matrix:
+
+  crash     the victim is gone; the client re-submits ``__resume__``
+            with the tokens it already holds, and any replica with
+            matching history blocks (warmed by earlier traffic or a
+            prior migration) skips straight to the tail — recovery is
+            O(tokens since last sealed block), not O(context)
+  drain     ``DecodeEngine.drain(migrate=...)``: a retiring replica
+            (autoscale-down, rollout flip) pushes live sessions to
+            peers at a batch boundary instead of waiting out long
+            generations
+  pressure  a preempted-youngest sequence may be pushed to the
+            least-loaded peer (fleetmon occupancy) instead of waiting
+            for local deterministic recompute
+
+Reconciliation rules (no token is ever emitted twice, no session is
+ever dropped OR double-run):
+
+- the source parks the victim outside the active set for the whole
+  hand-off (``export_session``); only after the destination acks
+  "resumed" does it free the blocks and finish the victim with status
+  "migrated" (reply phases carry ``migrated_to`` so the client follows).
+  Any failure — send error, ack timeout, destination refusal — aborts
+  the hand-off and re-queues the victim locally for deterministic
+  recompute: at most one replica ever runs the session.
+- the destination refuses a resume for a req_id it already has live
+  (loud double-migration refusal), refuses manifests whose position
+  disagrees with prompt+tokens, and refuses sessions still in prefill
+  at the source (those re-prefill cheaply anyway).
+- a resumed sequence starts emitting at token index len(tokens): the
+  client's index-dedupe (``generate_stream``) makes re-delivery
+  impossible even when a slow victim raced a few extra chunks out.
+
+Telemetry: ``kv_migrate_sessions_total{trigger,model}``,
+``kv_migrate_blocks_total`` / ``kv_migrate_bytes_total{dtype}``,
+``kv_migrate_failed_total{trigger}``, ``kv_migrate_refused_total
+{reason}``, ``kv_migrate_resume_total{result}``, and the end-to-end
+``migration_ms`` histogram (export -> destination ack).
+"""
+
+import hashlib
+import threading
+import time
+from collections import OrderedDict
+
+from .. import flags as _flags
+from ..core import telemetry as _tm
+from ..core import tracing as _tr
+from ..native.rpc import RpcClient
+from . import codec
+
+__all__ = ["SessionMigrator", "ResumeBuffer", "tail_digest"]
+
+# machine-readable concurrency contracts (tools/threadlint.py):
+# the migrator's lock is a LEAF guarding only in-memory maps (shipped
+# LRUs, the closed flag) — all RPC happens strictly outside it on a
+# dedicated per-hand-off connection, engine calls (export/commit/abort
+# acquire DecodeEngine._cond) happen outside it too, and peer discovery
+# callbacks fire unlocked
+LOCK_ORDER = (
+    ("DecodeEngine._cond", "SessionMigrator._lock"),
+)
+UNLOCKED_CALLBACKS = (
+    "SessionMigrator.peers_fn",
+)
+
+# per-peer recently-shipped digest LRU (same role as the disagg
+# sender's): a peer warmed by earlier migrations or disagg streaming
+# skips the wire for blocks it already indexed
+_SHIPPED_CAP = 4096
+# destination-side tail payloads older than this are purged — the
+# manifest frame follows its tail on the same FIFO connection, so a gap
+# this long means the source died mid-hand-off
+_RESUME_BUF_TTL_S = 60.0
+
+
+def tail_digest(prev_hex, token_ids):
+    """Transfer label for a tail partial block sealed at migration time.
+
+    Chains off the last full block's digest like a real chain step but
+    under a separate domain (the ``#tail`` suffix), so it can never
+    collide with — or be matched as — a full-block chain digest."""
+    h = (bytes.fromhex(prev_hex) if prev_hex
+         else hashlib.sha256(b"kvtail:").digest())
+    d = hashlib.sha256(h)
+    d.update(b"".join(int(t).to_bytes(8, "little", signed=True)
+                      for t in token_ids))
+    d.update(b"#tail")
+    return d.hexdigest()
+
+
+class ResumeBuffer:
+    """Destination-side holding area for in-flight session hand-offs.
+
+    A migration's tail frame precedes its manifest on the wire; the
+    buffer keeps the tail payload (host arrays — one block, a few KB at
+    smoke scale) keyed by req_id until the session frame consumes it.
+    Entries also remember adopted chain digests so a refused resume can
+    be reconciled (the server forgets them, truly freeing still-evictable
+    blocks).  Stale entries are purged lazily on every touch."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}      # req_id -> dict
+
+    def _entry_locked(self, req_id):
+        e = self._entries.get(req_id)
+        if e is None:
+            e = self._entries[req_id] = {
+                "tail": None, "tail_valid": 0, "tail_digest": None,
+                "digests": [], "t0": time.monotonic()}
+        return e
+
+    def _purge_locked(self, now):
+        dead = [rid for rid, e in self._entries.items()
+                if now - e["t0"] > _RESUME_BUF_TTL_S]
+        for rid in dead:
+            del self._entries[rid]
+            _tm.inc("kv_migrate_refused_total", reason="stale_buffer")
+
+    def note_adopted(self, req_id, digest):
+        with self._lock:
+            self._purge_locked(time.monotonic())
+            self._entry_locked(req_id)["digests"].append(digest)
+
+    def put_tail(self, req_id, digest, valid, arrays):
+        with self._lock:
+            self._purge_locked(time.monotonic())
+            e = self._entry_locked(req_id)
+            e["tail"] = list(arrays)
+            e["tail_valid"] = int(valid)
+            e["tail_digest"] = digest
+
+    def take(self, req_id):
+        """Consume and return the buffered entry (None when the
+        migration never shipped blocks — e.g. a pressure-trigger
+        hand-off whose tail was recomputed-away)."""
+        with self._lock:
+            return self._entries.pop(req_id, None)
+
+    def __len__(self):
+        with self._lock:
+            return len(self._entries)
+
+
+class SessionMigrator:
+    """Source-side session-migration manager.
+
+    Orchestrates the three-phase hand-off around the engine's
+    snapshot/commit/abort primitives:
+
+      1. ``engine.export_session(req_id)`` detaches the sequence at an
+         iteration boundary and snapshots manifest + block payloads
+         (host copies) — the engine keeps it parked, invisible to the
+         scheduler, until phase 3 decides its fate;
+      2. frames stream to the peer on this process's one migration
+         connection per peer (FIFO: blocks, tail, then the manifest),
+         and the destination's ``__resumeack__`` verdict is awaited;
+      3. "resumed" -> ``commit_migration`` (free blocks, finish the
+         victim with status "migrated" + ``migrated_to``); anything
+         else -> ``abort_migration`` (re-queue locally, zero drops).
+
+    ``peers_fn`` (fired unlocked) supplies candidate endpoints;
+    ``occupancy_fn`` (optional, fleetmon-backed) maps endpoint ->
+    windowed KV occupancy so ``pick_peer`` prefers the least-loaded
+    survivor."""
+
+    def __init__(self, engine, peers_fn=None, occupancy_fn=None):
+        self.engine = engine
+        self.peers_fn = peers_fn or (lambda: [])
+        self.occupancy_fn = occupancy_fn
+        self._lock = threading.Lock()
+        self._shipped = {}              # endpoint -> OrderedDict(digest)
+        self._closed = False
+
+    # -- peer selection ------------------------------------------------------
+
+    def pick_peer(self, exclude=()):
+        """Least-loaded live candidate, or None when alone."""
+        try:
+            peers = [p for p in (self.peers_fn() or []) if p not in exclude]
+        except Exception:
+            peers = []
+        if not peers:
+            return None
+        if self.occupancy_fn is not None:
+            try:
+                occ = self.occupancy_fn()
+                peers.sort(key=lambda p: occ.get(p, 0.5))
+            except Exception:
+                pass
+        return peers[0]
+
+    # -- hand-off ------------------------------------------------------------
+
+    def migrate(self, req_id, peer=None, trigger="drain"):
+        """Push one live session to ``peer`` (auto-picked when None).
+        True only when the destination acked "resumed" and the victim
+        was committed away; on ANY other outcome the session is back in
+        the local scheduler (or was never detached) and False returns.
+        Raises ValueError for loud refusals (unknown/in-prefill/double
+        migration) — the engine has not been perturbed in that case."""
+        if peer is None:
+            peer = self.pick_peer()
+        if peer is None:
+            return False
+        t0 = time.perf_counter()
+        manifest, payloads = self.engine.export_session(req_id)
+        ok = False
+        try:
+            ok = self._push(peer, manifest, payloads)
+        finally:
+            # commit/abort exactly once, even if _push raised
+            if ok:
+                self.engine.commit_migration(req_id, peer)
+                _tm.inc("kv_migrate_sessions_total", trigger=trigger,
+                        model=manifest["model"])
+                _tm.observe("migration_ms",
+                            (time.perf_counter() - t0) * 1000.0)
+                _tr.note("migrate", req_id=req_id, peer=peer,
+                         trigger=trigger, pos=manifest["pos"])
+            else:
+                self.engine.abort_migration(req_id)
+                _tm.inc("kv_migrate_failed_total", trigger=trigger)
+        return ok
+
+    def drain_push(self, trigger="drain"):
+        """Callback for ``DecodeEngine.drain(migrate=...)``: each live
+        session gets its own (least-loaded) peer pick; refusals read as
+        False so drain falls back to waiting that session out."""
+        def push(req_id, model):
+            del model
+            try:
+                return self.migrate(req_id, trigger=trigger)
+            except ValueError:
+                return False
+        return push
+
+    # -- wire ----------------------------------------------------------------
+
+    def _skip_shipped(self, peer, digest):
+        """True when ``digest`` was recently shipped to ``peer`` (LRU
+        touch).  A racing concurrent hand-off may ship a digest twice —
+        the destination's adopt answers "cached", which is harmless."""
+        with self._lock:
+            shipped = self._shipped.setdefault(peer, OrderedDict())
+            if digest in shipped:
+                shipped.move_to_end(digest)
+                return True
+        return False
+
+    def _mark_shipped(self, peer, digest):
+        with self._lock:
+            shipped = self._shipped.setdefault(peer, OrderedDict())
+            shipped[digest] = True
+            while len(shipped) > _SHIPPED_CAP:
+                shipped.popitem(last=False)
+
+    def _push(self, peer, manifest, payloads):
+        """Stream blocks + tail + manifest, then await the ack — all on
+        one DEDICATED connection, so the frame order the destination
+        sees is trivially FIFO without holding any lock across the wire
+        (the engine's export already guarantees at most one in-flight
+        hand-off per session)."""
+        rid = manifest["req_id"]
+        model = manifest["model"]
+        dtype = manifest.get("dtype", "f32")
+        # token arrays ride the session frame's payload, not its JSON meta
+        p_arr = manifest.pop("_prompt_arr")
+        o_arr = manifest.pop("_out_arr")
+        with self._lock:
+            if self._closed:
+                return False
+        ack_s = float(_flags.flag("migrate_ack_timeout") or 10.0)
+        try:
+            cli = RpcClient(peer, connect_timeout=2.0,
+                            rpc_deadline=max(ack_s, 5.0), retry_times=0)
+        except Exception:
+            return False
+        try:
+            for pos, digest, arrays, is_tail in payloads:
+                if not is_tail and self._skip_shipped(peer, digest):
+                    _tm.inc("kv_migrate_skipped_total", dtype=dtype)
+                    continue
+                meta = {"kind": "block", "req_id": rid,
+                        "pos": int(pos), "digest": digest,
+                        "model": model, "dtype": dtype, "session": 1}
+                if is_tail:
+                    meta["tail"] = 1
+                    meta["valid"] = int(manifest["pos"]
+                                        - pos * manifest["block_size"])
+                frame = codec.pack_kvxfer(meta, arrays)
+                _tr.note("kvxfer", frame_kind="session-block",
+                         req_id=rid, peer=peer, pos=int(pos),
+                         digest=digest[:16])
+                cli.send_var(codec.KVXFER_KEY + rid, frame)
+                if not is_tail:
+                    self._mark_shipped(peer, digest)
+                _tm.inc("kv_migrate_blocks_total", dtype=dtype)
+                _tm.inc("kv_migrate_bytes_total", int(frame.nbytes),
+                        dtype=dtype)
+            sframe = codec.pack_kvxfer(
+                dict(manifest, kind="session"), [p_arr, o_arr])
+            _tr.note("kvxfer", frame_kind="session", req_id=rid,
+                     peer=peer, pos=int(manifest["pos"]), digest="")
+            cli.send_var(codec.KVXFER_KEY + rid, sframe)
+            ack = cli.get_var(codec.RESUME_ACK_KEY + rid)
+        except Exception:
+            return False
+        finally:
+            try:
+                cli.close()
+            except Exception:
+                pass
+        try:
+            meta, _ = codec.unpack(ack)
+        except Exception:
+            return False
+        return meta.get("status") == "resumed"
+
+    def close(self):
+        """Refuse new hand-offs; in-flight pushes finish on their own
+        bounded (rpc_deadline) connections."""
+        with self._lock:
+            self._closed = True

@@ -28,7 +28,15 @@ One ``RpcServer`` per replica carries the whole protocol:
                           reply lands on ``__reply__:<id>``
   ``__retire__``          coordinator order: drain both engines at a
                           batch boundary, then hand off to ``on_retire``
-                          (tools/serve.py exits the process)
+                          (tools/serve.py exits the process); with
+                          FLAGS_migrate_on_drain the decode drain pushes
+                          live sessions to peers instead of waiting them
+                          out (serving/migrate.py)
+  ``__resume__:<id>``     inbound SEND: client crash-resume — prompt +
+                          already-received tokens; the replica resumes
+                          decode at position p, re-prefilling only what
+                          its prefix/history index does not hold, and
+                          acks under ``__resumeack__:<id>``
 
 Replies are garbage-collected FIFO beyond a bounded ring — a crashed
 client can never grow the server's var store unboundedly.
@@ -94,6 +102,11 @@ class ServingServer:
         self._pairs = {}               # req_id -> request meta (prefill)
         self._pair_lock = threading.Lock()
         self._pair_rr = 0
+        # live session migration (serving/migrate.py): source-side
+        # pusher + destination-side tail/digest holding buffer
+        self.migrator = None           # SessionMigrator
+        self._resume_buf = None        # ResumeBuffer
+        self.fleetmon = None           # FleetMonitor (tools/serve.py)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -122,6 +135,18 @@ class ServingServer:
             from .disagg import AdoptTracker
 
             self._adopt = AdoptTracker(self._on_orphan)
+        if self.decode_engine is not None:
+            from .. import flags
+
+            if flags.flag("session_migration"):
+                from .migrate import ResumeBuffer, SessionMigrator
+
+                self._resume_buf = ResumeBuffer()
+                self.migrator = SessionMigrator(
+                    self.decode_engine, peers_fn=self._migration_peers,
+                    occupancy_fn=self._peer_occupancy)
+                if flags.flag("migrate_on_pressure"):
+                    self.decode_engine.on_preempt = self._on_preempt
         self.rpc.serve(True)
         if _tm.enabled():
             self._pub_stop = _tm.start_publisher(
@@ -188,6 +213,8 @@ class ServingServer:
                 self._reconcile_abort(rid)
             elif name.startswith(codec.KVXFER_KEY):
                 self._on_kvxfer(name[len(codec.KVXFER_KEY):], arr)
+            elif name.startswith(codec.RESUME_KEY):
+                self._on_resume(name[len(codec.RESUME_KEY):], arr)
             elif name == codec.ROLLOUT_SET_KEY:
                 self._on_rollout_set(arr)
             elif name.startswith(codec.ROLLOUT_CTL_KEY):
@@ -297,6 +324,31 @@ class ServingServer:
             return None
         self._pair_rr += 1
         return peers[self._pair_rr % len(peers)]
+
+    def _migration_peers(self):
+        """Candidate endpoints for a session push: every live replica
+        that runs a decode engine (decode + monolith roles; prefill-only
+        replicas can't resume), minus this one.  Falls back to the
+        static decode_peers list when no fleet is attached."""
+        me = self._advertised_ep()
+        peers = []
+        if self.fleet is not None:
+            for role in ("decode", "serve"):
+                peers.extend(self.fleet.live_role_endpoints(role))
+        if not peers:
+            peers = list(self._decode_peers_static)
+        return [p for p in dict.fromkeys(peers) if p != me]
+
+    def _peer_occupancy(self):
+        """endpoint -> windowed KV occupancy from the last fleet doc
+        (fleetmon rows), so the migrator prefers the least-loaded
+        survivor.  Empty when no monitor is attached."""
+        mon = self.fleetmon
+        doc = getattr(mon, "last", None) if mon is not None else None
+        if not doc:
+            return {}
+        return {r["endpoint"]: float(r.get("kv_occupancy", 0.0))
+                for r in doc.get("replicas", []) if r.get("up")}
 
     def _wire_dtype(self, model):
         m = self.decode_engine._models.get(model)
@@ -465,6 +517,12 @@ class ServingServer:
             _tr.note("kvxfer_reject", req_id=req_id, error=str(e)[:200])
             return
         kind = meta.get("kind")
+        if kind == "session":
+            self._on_session(req_id, meta, arrays)
+            return
+        if kind == "block" and meta.get("session"):
+            self._on_session_block(req_id, meta, arrays)
+            return
         tracker = self._tracker()
         if kind == "expect":
             tracker.expect(req_id, meta)
@@ -519,6 +577,150 @@ class ServingServer:
                     req_id=req_id, traceparent=tp,
                     tier=meta.get("tier"),
                     on_token=on_token, callback=cb)
+
+    def _on_session_block(self, req_id, meta, arrays):
+        """Session-migration block frame (``kind=block, session=1``):
+        sealed history blocks adopt straight into the pool/prefix index
+        — warming it whether or not the resume itself lands — while the
+        tail partial block is held host-side until the session frame
+        consumes it (a partial block must never be indexed)."""
+        if self._resume_buf is None or self.decode_engine is None:
+            _tm.inc("kv_migrate_refused_total", reason="disabled")
+            return
+        if meta.get("tail"):
+            self._resume_buf.put_tail(req_id, meta.get("digest"),
+                                      meta.get("valid", 0), arrays)
+            return
+        res = self.decode_engine.adopt_kv_block(
+            meta.get("model", ""), meta["digest"], arrays)
+        if res == "adopted":
+            # only freshly-adopted digests are reconciled on refusal —
+            # "cached" blocks belong to earlier traffic, not this hand-off
+            self._resume_buf.note_adopted(req_id, meta["digest"])
+
+    def _publish_resume_ack(self, req_id, status, error=None):
+        doc = {"status": status}
+        if error:
+            doc["error"] = error
+        key = codec.RESUME_ACK_KEY + req_id
+        self.rpc.set_var(key, codec.pack(doc))
+        with self._reply_lock:
+            self._reply_keys.append(key)
+            while len(self._reply_keys) > _REPLY_RING:
+                self.rpc.del_var(self._reply_keys.pop(0))
+
+    def _on_session(self, req_id, meta, arrays):
+        """Session manifest (sent LAST on the migration FIFO): consume
+        the buffered tail, resume through the ordinary submit path
+        (``resume_from`` replays already-emitted tokens without
+        re-emitting them), and publish the verdict under
+        ``__resumeack__`` — the source only finishes its victim as
+        "migrated" after reading "resumed" here."""
+        entry = (self._resume_buf.take(req_id)
+                 if self._resume_buf is not None else None) or {}
+        if self._resume_buf is None or self.decode_engine is None:
+            _tm.inc("kv_migrate_refused_total", reason="disabled")
+            self._publish_resume_ack(req_id, "refused",
+                                     "session migration disabled here")
+            return
+        try:
+            prompt = [int(t) for t in np.asarray(arrays[0]).reshape(-1)]
+            resume_out = np.asarray(arrays[1]).reshape(-1)
+        except Exception:
+            _tm.inc("kv_migrate_refused_total", reason="bad_resume")
+            self._publish_resume_ack(req_id, "refused",
+                                     "malformed session manifest")
+            return
+        if int(meta.get("pos", -1)) != len(prompt) + len(resume_out) - 1:
+            _tm.inc("kv_migrate_refused_total", reason="pos_mismatch")
+            self._publish_resume_ack(
+                req_id, "refused",
+                "manifest pos %s disagrees with prompt+tokens %d"
+                % (meta.get("pos"), len(prompt) + len(resume_out) - 1))
+            return
+        resume_tail = None
+        if entry.get("tail") is not None:
+            resume_tail = {"digest": entry.get("tail_digest"),
+                           "valid": entry.get("tail_valid", 0),
+                           "arrays": entry.get("tail")}
+        self._resume_submit(req_id, meta, prompt, resume_out, resume_tail,
+                            entry.get("digests") or [])
+
+    def _on_resume(self, req_id, arr):
+        """Client crash-resume (``__resume__`` frame): prompt + tokens
+        the client already holds.  Any replica resumes; warm history
+        blocks — earlier traffic or a prior migration — cap re-prefill
+        at O(tokens since last sealed block) instead of O(context)."""
+        try:
+            meta, arrays = codec.unpack(arr)
+            prompt = [int(t) for t in np.asarray(arrays[0]).reshape(-1)]
+            resume_out = np.asarray(arrays[1]).reshape(-1)
+        except Exception:
+            _tm.inc("serving_bad_request_total")
+            self._publish_resume_ack(req_id, "refused",
+                                     "malformed resume request")
+            return
+        if self.decode_engine is None:
+            self._publish_resume_ack(req_id, "refused",
+                                     "replica has no decode engine")
+            return
+        self._resume_submit(req_id, meta, prompt, resume_out, None, [])
+
+    def _resume_submit(self, req_id, meta, prompt, resume_out,
+                       resume_tail, adopted_digests):
+        """Shared resume admission: submit with ``resume_from`` and ack
+        the synchronous verdict.  An admission-time refusal (bad resume
+        state, duplicate req_id, draining) reconciles any blocks this
+        hand-off adopted so the destination's pool is left exactly as
+        found."""
+        model = meta.get("model", "")
+        on_token = (self._stream_publisher(req_id)
+                    if meta.get("stream") else None)
+        tp = meta.get(codec.TRACEPARENT)
+        with _tr.remote_parent(tp):
+            with _tr.span("serving.resume", req_id=req_id, model=model,
+                          rank=self.rank):
+                pending = self.decode_engine.submit(
+                    model, prompt,
+                    max_new_tokens=int(meta.get("max_new_tokens", 16)),
+                    tenant=meta.get("tenant", "default"),
+                    deadline_ms=meta.get("deadline_ms"),
+                    eos_id=int(meta.get("eos_id", -1)),
+                    req_id=req_id, traceparent=tp,
+                    tier=meta.get("tier"),
+                    on_token=on_token,
+                    resume_from=resume_out, resume_tail=resume_tail,
+                    callback=lambda pending: self._publish(
+                        pending.req_id, pending.reply, pending))
+        rep = getattr(pending, "reply", None)
+        if rep is not None and rep.status in ("error", "shed"):
+            if adopted_digests:
+                self.decode_engine.forget_adopted(model, adopted_digests)
+            self._publish_resume_ack(req_id, "refused", rep.error)
+            return False
+        self._publish_resume_ack(req_id, "resumed")
+        return True
+
+    def _on_preempt(self, victims):
+        """Engine preemption hook (fires OUTSIDE the engine lock, on the
+        decode-loop thread): push each preempted-youngest session to the
+        least-loaded peer on a side thread — the destination-ack wait
+        must never block the step loop.  A refused or failed push just
+        leaves the victim queued for local deterministic recompute."""
+        mig = self.migrator
+        if mig is None or not victims:
+            return
+
+        def push():
+            for rid, model in victims:
+                del model
+                try:
+                    mig.migrate(rid, trigger="pressure")
+                except ValueError:
+                    pass           # already finished/recomputed: fine
+
+        threading.Thread(target=push, name="serving-migrate-pressure",
+                         daemon=True).start()
 
     def _publish_cancel(self, req_id, reply_meta):
         from .engine import InferReply
@@ -640,9 +842,15 @@ class ServingServer:
             return
 
         def drain():
+            from .. import flags
+
             self.engine.drain()
             if self.decode_engine is not None:
-                self.decode_engine.drain()
+                mig = None
+                if self.migrator is not None \
+                        and flags.flag("migrate_on_drain"):
+                    mig = self.migrator.drain_push(trigger="drain")
+                self.decode_engine.drain(migrate=mig)
             _tm.event("serving_retired", rank=self.rank)
             if self.on_retire is not None:
                 self.on_retire()
@@ -674,6 +882,8 @@ class ServingServer:
             self._xfer.close()
         if self._adopt is not None:
             self._adopt.close()
+        if self.migrator is not None:
+            self.migrator.close()
         self.rpc.shutdown()
         if self._thread is not None:
             self._thread.join(5.0)

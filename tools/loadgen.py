@@ -66,6 +66,14 @@ scraped ``prefix_cache_hit_tokens`` counter.  Replaying the same seed
 with ``FLAGS_prefix_cache`` on and off gives the cache-on/off TTFT and
 tokens/sec comparison on bitwise-identical traffic (equal
 ``outputs_sha256`` is the parity precondition).
+
+When any reply finished on a replica other than the one that started
+it (client crash resume after a SIGKILL, or a drain/pressure session
+hand-off the stream followed), the report gains a ``resume`` block:
+resumed request count, total resumed tokens, per-session rows of
+(prompt_len, resumed_tokens, cached_tokens), and
+``reprefill_tokens_max`` — the worst-case tokens any destination had
+to re-feed, which --migrate-smoke gates at under one KV block.
 """
 
 import argparse
@@ -202,6 +210,11 @@ def main(argv=None):
                   "xfer_ms": []}
     decode_phase = {"queue_wait_ms": [], "execute_ms": []}
     disagg_n = [0]
+    # live-session migration attribution: a reply whose phases carry
+    # resumed_tokens finished on a replica other than the one that
+    # started it (crash resume or a drain/pressure hand-off the stream
+    # followed) — rows feed the re-prefill gate in --migrate-smoke
+    resume_rows = []
     ttfts, itls, tokens_out = [], [], [0]
     cached_toks, prompt_toks = [0], [0]   # client-side exact hit rate
     out_map = {}    # prompt tuple -> generated tokens (greedy => unique)
@@ -271,6 +284,13 @@ def main(argv=None):
                     out_map[tuple(prompt)] = toks
                     cached_toks[0] += int(r.phases.get("cached_tokens", 0))
                     prompt_toks[0] += len(prompt)
+                    if "resumed_tokens" in r.phases:
+                        resume_rows.append({
+                            "prompt_len": len(prompt),
+                            "resumed_tokens":
+                                int(r.phases["resumed_tokens"]),
+                            "cached_tokens":
+                                int(r.phases.get("cached_tokens", 0))})
                     # client-observed (wire-inclusive) when streaming,
                     # server-side phase attribution otherwise
                     ttft = r.phases.get("client_ttft_ms",
@@ -441,6 +461,19 @@ def main(argv=None):
             "outputs_sha256": digest,
             "outputs_distinct": len(out_map),
         })
+        if resume_rows:
+            # per-resumed-session re-prefill cost: the destination
+            # replays (prompt_len + resumed_tokens - 1) positions of
+            # which cached_tokens came from adopted/matched KV blocks
+            reprefill = [r["prompt_len"] + r["resumed_tokens"] - 1
+                         - r["cached_tokens"] for r in resume_rows]
+            report["resume"] = {
+                "resumed_requests": len(resume_rows),
+                "resumed_tokens": sum(r["resumed_tokens"]
+                                      for r in resume_rows),
+                "reprefill_tokens_max": max(reprefill),
+                "rows": resume_rows,
+            }
     with open(args.out, "w") as f:
         json.dump(report, f, indent=1)
     print(json.dumps(report), flush=True)

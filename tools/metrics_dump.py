@@ -124,7 +124,8 @@ def main(argv=None):
                     help="with --scrape: GET the coordinator's merged "
                     "__fleet__ aggregate (serving/fleetmon.py) instead "
                     "of one replica's __metrics__ snapshot; with --json "
-                    "render the file as a fleet doc")
+                    "render the file as a fleet doc (merged histograms "
+                    "include migration_ms, rates include kv_migrate_*)")
     ap.add_argument("--prom", action="store_true",
                     help="emit Prometheus exposition text")
     ap.add_argument("--raw", action="store_true",
@@ -166,7 +167,9 @@ def main(argv=None):
                     "histogram, prefix_cache_* hit/publish/eviction "
                     "counters, the decode_batch_occupancy histogram, "
                     "disaggregated sealed-block transfer counters "
-                    "(kv_xfer_*, serving_handoff_fallback_total) and the "
+                    "(kv_xfer_*, serving_handoff_fallback_total), live "
+                    "session-migration counters and timing (kv_migrate_*"
+                    ", migration_ms, client_resume/*follow/*dup) and the "
                     "kv_pool_occupancy / prefix_cache_hit_rate gauges")
     ap.add_argument("--tracing", action="store_true", dest="tracing_only",
                     help="show only distributed-tracing health metrics: "
@@ -234,7 +237,9 @@ def main(argv=None):
                                    "serving_tokens_", "serving_abort_",
                                    "decode_batch_occupancy", "spec_",
                                    "prefix_cache_", "kv_xfer_", "kv_pool_",
-                                   "serving_handoff_"))
+                                   "serving_handoff_", "kv_migrate_",
+                                   "migration_ms", "client_resume_",
+                                   "client_migrate_", "client_stream_"))
     if args.tracing_only:
         snap = _filter_snap(snap, "tracing_")
     if args.ckpt_only:

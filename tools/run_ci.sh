@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI harness (reference paddle/scripts/paddle_build.sh analog): build the
 # native pieces, run the full test pyramid, smoke the bench + graft entry.
-# Usage: tools/run_ci.sh [quick|full|tpu|--layout-smoke|--obs-smoke|--lint|--elastic-smoke|--zero1-smoke|--cache-smoke|--kernel-smoke|--serve-smoke|--fleetmon-smoke|--trace-smoke|--decode-smoke|--disagg-smoke|--ckpt-smoke]
+# Usage: tools/run_ci.sh [quick|full|tpu|--layout-smoke|--obs-smoke|--lint|--elastic-smoke|--zero1-smoke|--cache-smoke|--kernel-smoke|--serve-smoke|--fleetmon-smoke|--trace-smoke|--decode-smoke|--disagg-smoke|--migrate-smoke|--ckpt-smoke]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -1143,6 +1143,228 @@ print("int8 pair == int8 monolith outputs OK (%d distinct prompts)"
 EOF
   rm -rf "$DSG_DIR"
   echo "CI --disagg-smoke: PASS"
+  exit 0
+fi
+
+if [ "$MODE" = "--migrate-smoke" ]; then
+  # live decode-session migration leg: the export/adopt/resume unit
+  # tests, then two fleet scenarios.  Crash: a 3-replica fleet is
+  # warmed per-replica with the SAME seeded Poisson traffic (every
+  # replica then holds the full prompt ++ out history chain of every
+  # generation, evictable in its prefix index), one replica is
+  # SIGKILLed mid-decode under load — every request must answer, the
+  # resumed outputs_sha256 must equal the uninterrupted twin's, the
+  # worst resumed session re-feeds under one KV block (the chain
+  # matched instead of re-prefilling), the victim's write-through
+  # flight recorder names its in-flight sessions, and
+  # executor_cache_miss_total stays flat on the survivors.  Drain: an
+  # autoscale-down __retire__ with FLAGS_migrate_on_drain pushes the
+  # victim's live sessions to its peers over __kvxfer__ — zero drops,
+  # parity again, and the victim exits promptly: the resumed sessions
+  # prove hand-off, not completion-wait
+  echo "== migrate smoke: session-migration unit tests =="
+  JAX_PLATFORMS=cpu FLAGS_static_check=error \
+    python -m pytest tests/test_session_migration.py -q
+  MIG_DIR="$(mktemp -d)"
+  JAX_PLATFORMS=cpu python tools/serve.py --save-demo-decoder "$MIG_DIR/dec"
+  MIG_ENV=(JAX_PLATFORMS=cpu FLAGS_telemetry=1
+           FLAGS_kv_block_size=8 FLAGS_kv_cache_blocks=768
+           FLAGS_serving_hb_interval=0.2 FLAGS_serving_hb_timeout=1.5
+           FLAGS_compile_cache_dir="$MIG_DIR/cc")
+  mig_wait_eps() {
+    python - "$1" "$2" <<'EOF'
+import json, sys, time
+path, want_n = sys.argv[1], int(sys.argv[2])
+deadline = time.time() + 30
+while time.time() < deadline:
+    try:
+        if len(json.load(open(path)).get("endpoints", [])) == want_n:
+            sys.exit(0)
+    except Exception:
+        pass
+    time.sleep(0.3)
+sys.exit("%s never published %d endpoints" % (path, want_n))
+EOF
+  }
+  echo "== migrate smoke: SIGKILL a replica mid-decode, clients resume =="
+  CFLEET=127.0.0.1:9490,127.0.0.1:9491,127.0.0.1:9492
+  for r in 0 1 2; do
+    env "${MIG_ENV[@]}" FLAGS_tracing=1 \
+      FLAGS_telemetry_dir="$MIG_DIR/tel" \
+      python tools/serve.py --model dec="$MIG_DIR/dec" \
+      --rank $r --fleet "$CFLEET" --decode-buckets 4,8 \
+      --decode-mode token --endpoints-file "$MIG_DIR/ceps.json" \
+      > "$MIG_DIR/c$r.log" 2>&1 &
+    eval "C$r=\$!"
+  done
+  trap 'kill -9 $C0 $C1 $C2 2>/dev/null || true' EXIT
+  for _ in $(seq 120); do
+    grep -q READY "$MIG_DIR/c0.log" && grep -q READY "$MIG_DIR/c1.log" \
+      && grep -q READY "$MIG_DIR/c2.log" && break
+    sleep 1
+  done
+  grep -q READY "$MIG_DIR/c2.log"
+  mig_wait_eps "$MIG_DIR/ceps.json" 3
+  # warmth: replay the same seeded traffic against EACH replica
+  # individually, so whichever survivor a crashed stream fails over to
+  # already holds the session's full history chain; the last pass
+  # doubles as the uninterrupted parity twin (same seed, same prompts)
+  for port in 9490 9491 9492; do
+    JAX_PLATFORMS=cpu python tools/loadgen.py \
+      --endpoints 127.0.0.1:$port --model dec --requests 48 --qps 60 \
+      --prompt-mix 8,16,24 --max-new 16 --deadline-ms 60000 \
+      --retry-shed 4 --seed 20 --out "$MIG_DIR/BENCH_migrate_twin.json" \
+      --assert-no-drops
+  done
+  # survivor compile-cache baseline: crash resume must reuse the
+  # prewarmed lane buckets, so the miss counter may not move again
+  python - "$MIG_DIR/miss0.json" <<'EOF'
+import json, sys, time
+from paddle_tpu.core import telemetry
+time.sleep(1.2)   # one __metrics__ publish period
+out = {}
+for ep in ("127.0.0.1:9491", "127.0.0.1:9492"):
+    snap = telemetry.scrape(ep)
+    out[ep] = sum(v for k, v in snap.get("counters", {}).items()
+                  if k.startswith("executor_cache_miss_total"))
+json.dump(out, open(sys.argv[1], "w"))
+EOF
+  ( sleep 1.5; kill -9 $C0 2>/dev/null || true ) &
+  JAX_PLATFORMS=cpu python tools/loadgen.py \
+    --endpoints-file "$MIG_DIR/ceps.json" --model dec --requests 48 \
+    --qps 30 --prompt-mix 8,16,24 --max-new 16 --deadline-ms 60000 \
+    --retry-shed 4 --seed 20 --out "$MIG_DIR/BENCH_migrate_kill.json" \
+    --assert-no-drops
+  # the victim's write-through flight recorder must already name its
+  # in-flight decode sessions on disk (req_ids ride the decode_step
+  # notes; SIGKILL is uncatchable)
+  grep -q decode_step "$MIG_DIR/tel/flightrec-$C0.json"
+  echo "flight recorder OK: victim flightrec-$C0.json names live sessions"
+  { python tools/metrics_dump.py --scrape 127.0.0.1:9491 --decode;
+    python tools/metrics_dump.py --scrape 127.0.0.1:9492 --decode; } \
+    | grep -c kv_migrate_resume_total > /dev/null
+  python - "$MIG_DIR/BENCH_migrate_kill.json" \
+    "$MIG_DIR/BENCH_migrate_twin.json" "$MIG_DIR/miss0.json" <<'EOF'
+import json, sys, time
+from paddle_tpu.core import telemetry
+kill = json.load(open(sys.argv[1]))
+twin = json.load(open(sys.argv[2]))
+miss0 = json.load(open(sys.argv[3]))
+assert kill["statuses"].get("ok") == kill["requests"], \
+    "not every request answered across the SIGKILL: %s" % kill["statuses"]
+assert kill["outputs_sha256"] == twin["outputs_sha256"], \
+    "resumed outputs differ from the uninterrupted twin: %s != %s" \
+    % (kill["outputs_sha256"], twin["outputs_sha256"])
+res = kill.get("resume")
+assert res and res["resumed_requests"] >= 1, \
+    "no stream crash-resumed across the kill: %r" % (res,)
+assert res["reprefill_tokens_max"] < 8, \
+    "a resumed session re-fed %d tokens (>= one 8-token KV block): %r" \
+    % (res["reprefill_tokens_max"], res["rows"])
+time.sleep(1.2)   # one __metrics__ publish period
+for ep, before in miss0.items():
+    snap = telemetry.scrape(ep)
+    after = sum(v for k, v in snap.get("counters", {}).items()
+                if k.startswith("executor_cache_miss_total"))
+    assert after == before, \
+        "executor_cache_miss_total moved on %s: %s -> %s" \
+        % (ep, before, after)
+print("crash leg OK: %d resumed sessions, worst re-feed %d tokens, "
+      "sha parity with the twin, survivor compile caches flat"
+      % (res["resumed_requests"], res["reprefill_tokens_max"]))
+EOF
+  kill -9 $C1 $C2 2>/dev/null || true
+  trap - EXIT
+  echo "== migrate smoke: autoscale-down retirement drains by migration =="
+  EFLEET=127.0.0.1:9494,127.0.0.1:9495,127.0.0.1:9496
+  for r in 0 1 2; do
+    # the retirement victim (rank 2) decodes with an injected 100 ms
+    # per-iteration delay — its sessions are deterministically still
+    # live when the drain scans, so the leg proves hand-off, not luck
+    FS=""
+    if [ "$r" = 2 ]; then FS="serving.decode_step:delay:1"; fi
+    env "${MIG_ENV[@]}" FLAGS_migrate_on_drain=1 FLAGS_fault_spec="$FS" \
+      python tools/serve.py --model dec="$MIG_DIR/dec" \
+      --rank $r --fleet "$EFLEET" --decode-buckets 4,8 \
+      --decode-mode token --endpoints-file "$MIG_DIR/eeps.json" \
+      > "$MIG_DIR/e$r.log" 2>&1 &
+    eval "E$r=\$!"
+  done
+  trap 'kill -9 $E0 $E1 $E2 2>/dev/null || true' EXIT
+  for _ in $(seq 120); do
+    grep -q READY "$MIG_DIR/e0.log" && grep -q READY "$MIG_DIR/e1.log" \
+      && grep -q READY "$MIG_DIR/e2.log" && break
+    sleep 1
+  done
+  grep -q READY "$MIG_DIR/e2.log"
+  mig_wait_eps "$MIG_DIR/eeps.json" 3
+  JAX_PLATFORMS=cpu python tools/loadgen.py \
+    --endpoints-file "$MIG_DIR/eeps.json" --model dec --requests 60 \
+    --qps 40 --prompt-mix 16,24 --max-new 24 --deadline-ms 60000 \
+    --retry-shed 6 --seed 21 --out "$MIG_DIR/BENCH_drain_twin.json" \
+    --assert-no-drops
+  # retire rank 2 mid-flight: the coordinator stays up, the victim
+  # drains by PUSHING its live sessions to the surviving peers
+  ( sleep 0.7; python - <<'EOF'
+import numpy as np
+from paddle_tpu.native import rpc
+from paddle_tpu.serving import codec
+c = rpc.RpcClient("127.0.0.1:9496", connect_timeout=2.0,
+                  rpc_deadline=5.0, retry_times=0)
+try:
+    c.send_var(codec.RETIRE_KEY, np.asarray([0], np.int64))
+finally:
+    c.close()
+EOF
+  ) &
+  JAX_PLATFORMS=cpu python tools/loadgen.py \
+    --endpoints-file "$MIG_DIR/eeps.json" --model dec --requests 60 \
+    --qps 40 --prompt-mix 16,24 --max-new 24 --deadline-ms 60000 \
+    --retry-shed 6 --seed 21 --out "$MIG_DIR/BENCH_migrate_drain.json" \
+    --assert-no-drops
+  # zero completion-wait stalls: the drained victim must exit promptly
+  # (its 24-token generations moved, they were not waited out)
+  for _ in $(seq 80); do
+    kill -0 $E2 2>/dev/null || break
+    sleep 0.5
+  done
+  if kill -0 $E2 2>/dev/null; then
+    echo "CI --migrate-smoke: FAIL (retired replica never exited)"
+    exit 1
+  fi
+  python - "$MIG_DIR/BENCH_migrate_drain.json" \
+    "$MIG_DIR/BENCH_drain_twin.json" <<'EOF'
+import json, sys, time
+from paddle_tpu.core import telemetry
+drain = json.load(open(sys.argv[1]))
+twin = json.load(open(sys.argv[2]))
+assert drain["statuses"].get("ok") == drain["requests"], \
+    "not every request answered across the retirement: %s" \
+    % drain["statuses"]
+assert drain["outputs_sha256"] == twin["outputs_sha256"], \
+    "post-drain outputs differ from the uninterrupted twin: %s != %s" \
+    % (drain["outputs_sha256"], twin["outputs_sha256"])
+res = drain.get("resume")
+assert res and res["resumed_requests"] >= 1, \
+    "retirement migrated no live session (completion-wait drain?): %r" \
+    % (res,)
+time.sleep(1.2)   # one __metrics__ publish period
+accepted = 0
+for ep in ("127.0.0.1:9494", "127.0.0.1:9495"):
+    snap = telemetry.scrape(ep)
+    accepted += sum(
+        v for k, v in snap.get("counters", {}).items()
+        if k.startswith("kv_migrate_resume_total")
+        and "result=accepted" in k)
+assert accepted >= 1, "no survivor admitted a migrated session"
+print("drain leg OK: %d sessions followed the hand-off, %d resume "
+      "admissions on the survivors, sha parity with the twin"
+      % (res["resumed_requests"], accepted))
+EOF
+  kill -9 $E0 $E1 2>/dev/null || true
+  trap - EXIT
+  rm -rf "$MIG_DIR"
+  echo "CI --migrate-smoke: PASS"
   exit 0
 fi
 
